@@ -1,0 +1,93 @@
+"""Deferred BatchNorm: running stats must match full-mini-batch BN.
+
+Reference: tests/test_deferred_batch_norm.py:39-62 (running stats equal to
+``nn.BatchNorm2d`` run on the whole mini-batch).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu import GPipe
+from torchgpipe_tpu.batchnorm import convert_deferred_batch_norm
+from torchgpipe_tpu.layers import sequential_init
+from torchgpipe_tpu.ops import batch_norm, dense, relu
+
+
+def layers_with_bn():
+    return [dense(8, name="d0"), batch_norm(name="bn0"), relu("r0"), dense(4, name="d1")]
+
+
+def test_running_stats_match_full_batch():
+    layers = layers_with_bn()
+    model = GPipe(layers, balance=[2, 2], chunks=4, deferred_batch_norm=True)
+    in_spec = jax.ShapeDtypeStruct((16, 8), jnp.float32)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (16, 4))
+
+    _, _, new_state, _ = model.value_and_grad(
+        params, state, x, tgt, lambda o, t: jnp.mean((o - t) ** 2)
+    )
+
+    # Oracle: plain BN on the full (un-chunked) mini-batch, one device.
+    ref_layers = layers_with_bn()
+    ref_params, ref_states, _ = sequential_init(
+        ref_layers, jax.random.PRNGKey(0), in_spec
+    )
+    dev0 = jax.devices()[0]
+    ref_params = jax.device_put(ref_params, dev0)
+    ref_states = jax.device_put(ref_states, dev0)
+    xx = jax.device_put(x, dev0)
+    h, _ = ref_layers[0].apply(ref_params[0], ref_states[0], xx, rng=None, train=True)
+    _, bn_state = ref_layers[1].apply(ref_params[1], ref_states[1], h, rng=None, train=True)
+
+    # deferred BN state for stage 0, layer 1
+    dbn_state = new_state[0][1]
+    np.testing.assert_allclose(
+        np.asarray(dbn_state["mean"]), np.asarray(bn_state["mean"]), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(dbn_state["var"]), np.asarray(bn_state["var"]), rtol=1e-4, atol=1e-6
+    )
+    # Accumulators were reset by the commit.
+    assert int(dbn_state["tracked"]) == 0
+    assert float(dbn_state["count"]) == 0.0
+
+
+def test_conversion_only_touches_bn():
+    layers = layers_with_bn()
+    conv = convert_deferred_batch_norm(layers, chunks=2)
+    assert conv[0] is layers[0]
+    assert conv[1].meta["kind"] == "deferred_batch_norm"
+    assert conv[1].name == "bn0"
+
+
+def test_short_batch_rejected():
+    layers = layers_with_bn()
+    model = GPipe(layers, balance=[2, 2], chunks=4, deferred_batch_norm=True)
+    in_spec = jax.ShapeDtypeStruct((16, 8), jnp.float32)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    x = jnp.ones((3, 8))  # splits into 3 < chunks micro-batches
+    with pytest.raises(ValueError, match="deferred_batch_norm"):
+        model.value_and_grad(
+            params, state, x, jnp.ones((3, 4)), lambda o, t: jnp.mean((o - t) ** 2)
+        )
+
+
+def test_recompute_does_not_double_count():
+    # 'always' checkpointing recomputes every cell; tracking must not run
+    # twice (reference batchnorm.py:52-56 via is_recomputing).
+    layers = layers_with_bn()
+    model = GPipe(
+        layers, balance=[2, 2], chunks=2, checkpoint="always", deferred_batch_norm=True
+    )
+    in_spec = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+    _, _, new_state, _ = model.value_and_grad(
+        params, state, x, jnp.ones((8, 4)), lambda o, t: jnp.mean((o - t) ** 2)
+    )
+    dbn_state = new_state[0][1]
+    assert int(dbn_state["tracked"]) == 0  # committed exactly once

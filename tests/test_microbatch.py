@@ -1,0 +1,64 @@
+"""Micro-batch scatter/gather semantics.
+
+Reference: tests in torchgpipe exercise scatter/gather via GPipe
+(tests/test_gpipe.py:107-126 "indivisible batches") and microbatch directly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu import microbatch
+
+
+def test_check_rejects_non_arrays():
+    with pytest.raises(TypeError):
+        microbatch.check("hello")
+    with pytest.raises(TypeError):
+        microbatch.check((jnp.zeros((2, 2)), "x"))
+
+
+def test_check_rejects_mismatched_batch():
+    with pytest.raises(ValueError):
+        microbatch.check((jnp.zeros((2, 3)), jnp.zeros((3, 3))))
+
+
+def test_scatter_gather_roundtrip():
+    x = jnp.arange(24.0).reshape(8, 3)
+    mbs = microbatch.scatter(x, 4)
+    assert len(mbs) == 4
+    assert all(mb.shape == (2, 3) for mb in mbs)
+    y = microbatch.gather(mbs)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_scatter_indivisible_torch_chunk_semantics():
+    # 7 into 4 -> ceil-sized chunks [2, 2, 2, 1] (torch.chunk semantics).
+    x = jnp.arange(7.0)[:, None]
+    mbs = microbatch.scatter(x, 4)
+    assert [mb.shape[0] for mb in mbs] == [2, 2, 2, 1]
+    # 3 into 4 -> only 3 chunks.
+    mbs = microbatch.scatter(jnp.zeros((3, 1)), 4)
+    assert [mb.shape[0] for mb in mbs] == [1, 1, 1]
+    # 10 into 4 -> [3, 3, 3, 1], unlike numpy's array_split [3, 3, 2, 2].
+    mbs = microbatch.scatter(jnp.zeros((10, 1)), 4)
+    assert [mb.shape[0] for mb in mbs] == [3, 3, 3, 1]
+
+
+def test_scatter_tuple_input():
+    x = (jnp.zeros((8, 2)), jnp.ones((8, 5)))
+    mbs = microbatch.scatter(x, 2)
+    assert len(mbs) == 2
+    a, b = mbs[0]
+    assert a.shape == (4, 2) and b.shape == (4, 5)
+    g = microbatch.gather(mbs)
+    assert g[0].shape == (8, 2) and g[1].shape == (8, 5)
+
+
+def test_scatter_stacked_requires_divisible():
+    with pytest.raises(ValueError):
+        microbatch.scatter_stacked(jnp.zeros((7, 2)), 4)
+    y = microbatch.scatter_stacked(jnp.zeros((8, 2)), 4)
+    assert y.shape == (4, 2, 2)
+    assert microbatch.gather_stacked(y).shape == (8, 2)

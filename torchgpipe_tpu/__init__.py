@@ -1,0 +1,26 @@
+"""torchgpipe_tpu — a TPU-native GPipe: pipeline parallelism + activation
+checkpointing for JAX/XLA.
+
+Capabilities match the reference torchgpipe library (see SURVEY.md), designed
+idiomatically for TPU: stages are XLA-compiled programs on a device mesh,
+hand-off rides ICI, recomputation uses counter-based RNG, and the SPMD engine
+expresses the whole schedule as one compiled ``shard_map`` program.
+
+Public API (reference: torchgpipe/__init__.py:1-6 exports ``GPipe``,
+``is_checkpointing``, ``is_recomputing``).
+"""
+
+from torchgpipe_tpu.checkpoint import is_checkpointing, is_recomputing
+from torchgpipe_tpu.gpipe import GPipe
+from torchgpipe_tpu.layers import Layer, stateless
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "GPipe",
+    "Layer",
+    "stateless",
+    "is_checkpointing",
+    "is_recomputing",
+    "__version__",
+]

@@ -1,0 +1,71 @@
+"""Automatic stage balancing: profile per-layer costs, then block-partition.
+
+Reference: torchgpipe/balance/__init__.py:38-156 (``balance_by_time`` /
+``balance_by_size``).  Usage::
+
+    from torchgpipe_tpu.balance import balance_by_time
+
+    balance = balance_by_time(4, layers, params, states, sample)
+    model = GPipe(layers, balance, chunks=8)
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+from torchgpipe_tpu.balance import blockpartition
+from torchgpipe_tpu.balance.profile import profile_sizes, profile_times
+from torchgpipe_tpu.layers import Layer
+
+__all__ = ["balance_by_time", "balance_by_size", "balance_cost"]
+
+Pytree = Any
+
+
+def balance_cost(costs: Sequence[float], partitions: int) -> List[int]:
+    """Turn per-layer costs into a balance via exact block partitioning.
+
+    Reference: torchgpipe/balance/__init__.py:33-35.
+    """
+    return blockpartition.solve_sizes(costs, partitions)
+
+
+def balance_by_time(
+    partitions: int,
+    layers: Sequence[Layer],
+    params: Sequence[Pytree],
+    states: Sequence[Pytree],
+    sample: Pytree,
+    *,
+    timeout: float = 1.0,
+    device=None,
+) -> List[int]:
+    """Balance by profiled forward+backward time per layer.
+
+    Reference: torchgpipe/balance/__init__.py:38-77.
+    """
+    times = profile_times(
+        layers, params, states, sample, timeout=timeout, device=device
+    )
+    return balance_cost(times, partitions)
+
+
+def balance_by_size(
+    partitions: int,
+    layers: Sequence[Layer],
+    params: Sequence[Pytree],
+    states: Sequence[Pytree],
+    sample: Pytree,
+    *,
+    param_scale: float = 2.0,
+    device=None,
+) -> List[int]:
+    """Balance by per-layer memory footprint (XLA memory analysis + scaled
+    parameter bytes).
+
+    Reference: torchgpipe/balance/__init__.py:80-156.
+    """
+    sizes = profile_sizes(
+        layers, params, states, sample, param_scale=param_scale, device=device
+    )
+    return balance_cost(sizes, partitions)

@@ -1,0 +1,149 @@
+"""Deferred BatchNorm: mini-batch-faithful running statistics under
+micro-batching.
+
+Reference: torchgpipe/batchnorm.py:17-155.  Ordinary BatchNorm inside a
+pipeline would update running stats once per *micro*-batch, skewing them
+relative to non-pipelined training.  DeferredBatchNorm accumulates sum and
+sum-of-squares across the ``chunks`` micro-batches of one mini-batch and
+commits the running statistics exactly once per mini-batch.
+
+Functional TPU re-design: the accumulators live in the layer *state* pytree
+threaded through the micro-batch loop (replacing the reference's in-place
+buffer mutation, batchnorm.py:45-85), and the commit is a ``lax.cond`` on a
+counter carried in state — one traced program serves every micro-batch.
+Normalization of each micro-batch uses that micro-batch's own statistics, as
+in the reference (batchnorm.py:87-121).
+
+During checkpoint recomputation the reference must skip tracking to avoid
+double-counting (batchnorm.py:52-56, via ``is_recomputing()``).  Here the
+recompute trace observes :func:`torchgpipe_tpu.checkpoint.is_recomputing` and
+compiles the tracking out entirely; the engine additionally discards state
+produced by recompute, so the guarantee is structural.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torchgpipe_tpu.checkpoint import is_recomputing
+from torchgpipe_tpu.layers import Layer
+
+
+def deferred_batch_norm(
+    chunks: int,
+    *,
+    momentum: float = 0.9,
+    eps: float = 1e-5,
+    name: str = "deferred_bn",
+) -> Layer:
+    """BatchNorm whose running stats reflect whole mini-batches.
+
+    ``chunks`` must equal the pipeline's micro-batch count (reference:
+    torchgpipe/batchnorm.py:123-155 passes GPipe's ``chunks`` at conversion).
+    """
+
+    def init(rng, in_spec):
+        del rng
+        ch = jax.tree_util.tree_leaves(in_spec)[0].shape[-1]
+        params = {"scale": jnp.ones((ch,)), "bias": jnp.zeros((ch,))}
+        state = {
+            "mean": jnp.zeros((ch,)),
+            "var": jnp.ones((ch,)),
+            "sum": jnp.zeros((ch,)),
+            "ssq": jnp.zeros((ch,)),
+            "count": jnp.zeros((), jnp.int32),
+            "tracked": jnp.zeros((), jnp.int32),
+        }
+        return params, state
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng
+        axes = tuple(range(x.ndim - 1))
+        if not train:
+            y = (x - state["mean"]) * lax.rsqrt(state["var"] + eps)
+            return y * params["scale"] + params["bias"], state
+
+        # Normalize with this micro-batch's own statistics
+        # (reference batchnorm.py:87-99).
+        mean_mb = jnp.mean(x, axes)
+        var_mb = jnp.var(x, axes)
+        y = (x - mean_mb) * lax.rsqrt(var_mb + eps)
+        y = y * params["scale"] + params["bias"]
+
+        if is_recomputing():
+            # Tracking is compiled out of the recompute program
+            # (reference batchnorm.py:52-56).
+            return y, state
+
+        n_mb = 1
+        for a in axes:
+            n_mb *= x.shape[a]
+        new_sum = state["sum"] + jnp.sum(x, axes)
+        new_ssq = state["ssq"] + jnp.sum(x * x, axes)
+        new_count = state["count"] + n_mb
+        new_tracked = state["tracked"] + 1
+
+        def commit(_):
+            # Whole-mini-batch statistics (reference batchnorm.py:61-85).
+            cnt = new_count.astype(x.dtype)
+            mean = new_sum / cnt
+            var = new_ssq / cnt - mean * mean
+            return {
+                "mean": momentum * state["mean"] + (1 - momentum) * mean,
+                "var": momentum * state["var"] + (1 - momentum) * var,
+                "sum": jnp.zeros_like(new_sum),
+                "ssq": jnp.zeros_like(new_ssq),
+                "count": jnp.zeros_like(new_count),
+                "tracked": jnp.zeros_like(new_tracked),
+            }
+
+        def carry(_):
+            return {
+                "mean": state["mean"],
+                "var": state["var"],
+                "sum": new_sum,
+                "ssq": new_ssq,
+                "count": new_count,
+                "tracked": new_tracked,
+            }
+
+        new_state = lax.cond(new_tracked >= chunks, commit, carry, operand=None)
+        return y, new_state
+
+    return Layer(
+        name=name,
+        init=init,
+        apply=apply,
+        meta={"kind": "deferred_batch_norm", "momentum": momentum, "eps": eps},
+    )
+
+
+def convert_deferred_batch_norm(
+    layers: Sequence[Layer], chunks: int
+) -> List[Layer]:
+    """Replace every plain batch-norm layer with its deferred equivalent.
+
+    Reference: torchgpipe/batchnorm.py:123-155
+    (``DeferredBatchNorm.convert_deferred_batch_norm``), driven from
+    GPipe.__init__ (gpipe.py:242).  Conversion happens *before* ``init`` so
+    parameter shapes are unaffected; only the state pytree grows accumulators.
+    """
+    out: List[Layer] = []
+    for layer in layers:
+        meta: Any = layer.meta
+        if isinstance(meta, dict) and meta.get("kind") == "batch_norm":
+            out.append(
+                deferred_batch_norm(
+                    chunks,
+                    momentum=meta["momentum"],
+                    eps=meta["eps"],
+                    name=layer.name,
+                )
+            )
+        else:
+            out.append(layer)
+    return out

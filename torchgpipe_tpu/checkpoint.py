@@ -1,0 +1,90 @@
+"""Checkpointing phases and policies.
+
+The reference implements activation checkpointing as a pair of autograd
+functions (``Checkpoint``/``Recompute``) so recomputation can be scheduled
+*before* the gradient arrives (reference: torchgpipe/checkpoint.py:1-19,
+72-108).  Under JAX the mechanics change completely:
+
+* Within a compiled program, rematerialization is ``jax.checkpoint`` /
+  ``jax.remat`` — used by the SPMD engine.
+* In the MPMD engine, "checkpointing" a pipeline cell means running its
+  forward as a plain compiled function (no residuals kept — functionally
+  equivalent to the reference's ``no_grad`` forward, checkpoint.py:253-254)
+  and re-running a vjp-producing forward during the backward schedule
+  ("recompute-ahead").
+* RNG referential transparency comes for free: micro-batch keys are
+  counter-based (``fold_in``), so recompute reproduces dropout masks exactly —
+  strictly stronger than the reference's RNG state capture/restore
+  (checkpoint.py:191-231).
+
+What carries over unchanged is the *phase introspection* API: user layers can
+ask whether they are being traced for a checkpointed (no-residual) forward or
+for recomputation, mirroring ``is_checkpointing``/``is_recomputing``
+(reference: torchgpipe/checkpoint.py:142-173).  In JAX these are *trace-time*
+flags: each phase corresponds to a separately traced compiled function, and the
+flag is observed while tracing, not at runtime.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator
+
+CHECKPOINT_MODES = ("always", "except_last", "never")
+
+
+def checkpoint_stop(mode: str, chunks: int, *, train: bool) -> int:
+    """Micro-batches ``[0, stop)`` are checkpointed.
+
+    Reference: torchgpipe/gpipe.py:360-367 (and eval-time bypass).
+    """
+    if mode not in CHECKPOINT_MODES:
+        raise ValueError(
+            f"checkpoint is not one of {CHECKPOINT_MODES!r}: {mode!r}"
+        )
+    if not train:
+        return 0
+    return {"always": chunks, "except_last": chunks - 1, "never": 0}[mode]
+
+
+class _Phase(threading.local):
+    def __init__(self) -> None:
+        self.checkpointing = False
+        self.recomputing = False
+
+
+_phase = _Phase()
+
+
+def is_checkpointing() -> bool:
+    """True while tracing a checkpointed (no-residual) forward.
+
+    Reference: torchgpipe/checkpoint.py:142-157.  Trace-time semantics: a layer
+    reading this flag bakes the answer into the compiled program for that
+    phase.
+    """
+    return _phase.checkpointing
+
+
+def is_recomputing() -> bool:
+    """True while tracing the recomputation forward.
+
+    Reference: torchgpipe/checkpoint.py:160-173.  The canonical use is
+    mini-batch-faithful BatchNorm skipping statistics tracking during
+    recompute (torchgpipe/batchnorm.py:52-56); see
+    :mod:`torchgpipe_tpu.batchnorm`.
+    """
+    return _phase.recomputing
+
+
+@contextlib.contextmanager
+def phase(*, checkpointing: bool = False, recomputing: bool = False) -> Iterator[None]:
+    """Set the trace-time phase flags (used by the engines while tracing)."""
+    prev = (_phase.checkpointing, _phase.recomputing)
+    _phase.checkpointing = checkpointing
+    _phase.recomputing = recomputing
+    try:
+        yield
+    finally:
+        _phase.checkpointing, _phase.recomputing = prev

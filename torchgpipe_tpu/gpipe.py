@@ -1,0 +1,213 @@
+"""GPipe — the user-facing pipeline-parallel wrapper.
+
+TPU-native counterpart of the reference's public API (reference:
+torchgpipe/gpipe.py:134-380).  A sequential model (list of
+:class:`~torchgpipe_tpu.layers.Layer`) is split by an explicit ``balance``
+into stages, each stage's parameters live on its own device, a mini-batch is
+scattered into ``chunks`` micro-batches and driven through the GPipe
+fill-drain schedule with activation checkpointing.
+
+Differences forced (for the better) by the functional JAX model:
+
+* No module wrapping/mutation: ``GPipe`` holds layer *definitions*; parameters
+  are explicit pytrees returned by :meth:`init` and threaded by the caller.
+* Training is ``value_and_grad``-shaped rather than ``forward()`` +
+  ``loss.backward()``: the engine runs the backward schedule itself
+  (the reference rides torch autograd, SURVEY.md §3.3).
+* The reference forbids moving a GPipe module off its devices
+  (``MOVING_DENIED``, gpipe.py:130, 289-314); here placement is explicit via
+  :meth:`place` and simply re-places the pytrees.
+
+Example::
+
+    model = GPipe(layers, balance=[2, 2], chunks=4)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    out, _ = model.apply(params, state, x)                      # inference
+    loss, grads, state, _ = model.value_and_grad(
+        params, state, x, y, loss_fn, rng=step_key)             # training
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+
+from torchgpipe_tpu import microbatch
+from torchgpipe_tpu.batchnorm import convert_deferred_batch_norm
+from torchgpipe_tpu.checkpoint import CHECKPOINT_MODES, checkpoint_stop
+from torchgpipe_tpu.layers import Layer, sequential_init
+from torchgpipe_tpu.partition import split_layers, verify_module
+from torchgpipe_tpu.pipeline import Pipeline, StageExec
+from torchgpipe_tpu.skip import inspect_skip_layout, verify_skippables
+
+Pytree = Any
+
+
+class GPipe:
+    """Pipeline parallelism over a sequential layer list.
+
+    Reference: torchgpipe/gpipe.py:211-255 (constructor semantics: balance
+    validation, deferred batch-norm conversion, partition placement).
+    """
+
+    def __init__(
+        self,
+        layers: Sequence[Layer],
+        balance: Optional[Sequence[int]] = None,
+        *,
+        devices: Optional[Sequence] = None,
+        chunks: int = 1,
+        checkpoint: str = "except_last",
+        deferred_batch_norm: bool = False,
+    ) -> None:
+        if balance is None:
+            raise ValueError(
+                "balance is required — use torchgpipe_tpu.balance.balance_by_time "
+                "or balance_by_size for automatic balancing "
+                "(reference: torchgpipe/gpipe.py:34-50)"
+            )
+        if chunks <= 0:
+            raise ValueError("number of chunks must be positive integer")
+        if checkpoint not in CHECKPOINT_MODES:
+            raise ValueError(
+                f"checkpoint is not one of {'|'.join(CHECKPOINT_MODES)}"
+            )
+
+        layers = list(layers)
+        verify_module(layers)
+        verify_skippables(layers)
+
+        self._deferred_batch_norm = deferred_batch_norm
+        if deferred_batch_norm:
+            layers = convert_deferred_batch_norm(layers, chunks)
+
+        self.layers = layers
+        self.balance = list(balance)
+        self.chunks = chunks
+        self.checkpoint = checkpoint
+
+        self.partitions = split_layers(layers, self.balance)
+
+        if devices is None:
+            devices = jax.devices()
+        n = len(self.partitions)
+        # Unlike the reference (which requires one device per partition,
+        # gpipe.py:99-113), stages wrap around the available devices so an
+        # n-stage pipeline runs (serialized) even on a single chip.
+        self.devices = [devices[j % len(devices)] for j in range(n)]
+
+        self.skip_layout = inspect_skip_layout(self.partitions)
+
+        stages: List[StageExec] = []
+        offset = 0
+        for j, part in enumerate(self.partitions):
+            stages.append(
+                StageExec(j, part, offset, self.devices[j], self.skip_layout)
+            )
+            offset += len(part)
+        self._pipeline = Pipeline(stages, self.skip_layout)
+
+    # ------------------------------------------------------------------ #
+    # container protocol (reference gpipe.py:257-285)                    #
+    # ------------------------------------------------------------------ #
+
+    def __len__(self) -> int:
+        return len(self.layers)
+
+    def __getitem__(self, index: int) -> Layer:
+        return self.layers[index]
+
+    def __iter__(self):
+        return iter(self.layers)
+
+    # ------------------------------------------------------------------ #
+    # parameters                                                         #
+    # ------------------------------------------------------------------ #
+
+    def init(
+        self, rng: jax.Array, in_spec: Pytree
+    ) -> Tuple[Tuple[List[Pytree], ...], Tuple[List[Pytree], ...]]:
+        """Initialize parameters/state, grouped per stage and placed on the
+        stage devices (the reference moves partitions in ``split_module``,
+        gpipe.py:117)."""
+        flat_params, flat_state, _ = sequential_init(
+            self.layers, rng, in_spec
+        )
+        params, state = [], []
+        i = 0
+        for part in self.partitions:
+            params.append(flat_params[i : i + len(part)])
+            state.append(flat_state[i : i + len(part)])
+            i += len(part)
+        return self.place(tuple(params)), self.place(tuple(state))
+
+    def place(self, per_stage: Tuple[Pytree, ...]) -> Tuple[Pytree, ...]:
+        """Commit each stage's pytree to that stage's device."""
+        return tuple(
+            jax.device_put(stage_tree, self.devices[j])
+            for j, stage_tree in enumerate(per_stage)
+        )
+
+    # ------------------------------------------------------------------ #
+    # execution                                                          #
+    # ------------------------------------------------------------------ #
+
+    def apply(
+        self,
+        params,
+        state,
+        x: Pytree,
+        *,
+        rng: Optional[jax.Array] = None,
+        train: bool = False,
+    ) -> Tuple[Pytree, Tuple[Pytree, ...]]:
+        """Pipelined forward pass (no gradients).
+
+        Reference: torchgpipe/gpipe.py:330-380 (``forward``): scatter,
+        schedule, gather.
+        """
+        microbatch.check(x)
+        mbatches = microbatch.scatter(x, self.chunks)
+        outs, new_states = self._pipeline.run_forward(
+            params, state, mbatches, rng, train
+        )
+        return microbatch.gather(outs), tuple(new_states)
+
+    def value_and_grad(
+        self,
+        params,
+        state,
+        x: Pytree,
+        target: Pytree,
+        loss_fn,
+        *,
+        rng: Optional[jax.Array] = None,
+    ):
+        """Pipelined training step: forward, loss, backward.
+
+        ``loss_fn(output, target)`` sees the *gathered* mini-batch output, so
+        losses (and therefore gradients) are exactly those of the un-pipelined
+        model — the transparency contract the reference proves with its
+        accuracy benchmarks (SURVEY.md §6).  ``loss_fn`` may return
+        ``(loss, aux)``.
+
+        Returns ``(loss, grads, new_state, aux)`` with ``grads`` shaped like
+        ``params``.
+        """
+        microbatch.check(x)
+        mbatches = microbatch.scatter(x, self.chunks)
+        if self._deferred_batch_norm and len(mbatches) != self.chunks:
+            # Deferred BN commits running stats on the chunks-th micro-batch;
+            # a short batch would never commit and would bleed accumulators
+            # into the next mini-batch.
+            raise ValueError(
+                f"deferred_batch_norm requires the batch to split into exactly "
+                f"chunks={self.chunks} micro-batches, got {len(mbatches)} "
+                f"(batch size {microbatch.batch_size(x)})"
+            )
+        stop = checkpoint_stop(self.checkpoint, len(mbatches), train=True)
+        loss, grads, new_states, aux = self._pipeline.run_train(
+            params, state, mbatches, target, loss_fn, rng, stop
+        )
+        return loss, tuple(grads), tuple(new_states), aux

@@ -1,0 +1,176 @@
+"""Sequential layer abstraction — the model container the pipeline partitions.
+
+The reference wraps ``nn.Sequential`` (reference: torchgpipe/gpipe.py:211-255)
+and relies on PyTorch modules carrying their own parameters.  The TPU-native
+equivalent is functional: a model is a list of :class:`Layer` values, each an
+``(init, apply)`` pair over explicit parameter/state pytrees:
+
+    params, state = layer.init(rng, in_spec)
+    y, new_state  = layer.apply(params, state, x, rng=rng, train=True)
+
+* ``params`` — trainable pytree (differentiated).
+* ``state``  — non-trainable pytree (e.g. BatchNorm running stats), threaded
+  through the micro-batch loop (replaces in-place buffer mutation).
+* ``rng``    — a ``jax.random`` key; counter-based folding replaces the
+  reference's RNG state capture/restore for recompute determinism
+  (reference: torchgpipe/checkpoint.py:191-231).
+* ``train``  — static flag; separate traces for train/eval replace runtime
+  branching.
+
+Layer ``apply`` functions must be pure and traceable (jit/vjp/vmap-safe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+Spec = Any  # pytree of jax.ShapeDtypeStruct
+InitFn = Callable[..., Tuple[Pytree, Pytree]]
+ApplyFn = Callable[..., Tuple[Any, Pytree]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Layer:
+    """One element of a sequential model.
+
+    ``stash``/``pop`` declare named skip connections (see
+    :mod:`torchgpipe_tpu.skip`); plain layers leave them empty.
+    """
+
+    name: str
+    init: InitFn  # (rng, in_spec) -> (params, state)
+    apply: ApplyFn  # (params, state, x, *, rng, train) -> (y, new_state)
+    stash: Tuple[Any, ...] = ()  # names this layer stashes ((ns, name) tuples)
+    pop: Tuple[Any, ...] = ()  # names this layer pops
+    meta: Any = None  # structured description (e.g. batch-norm hyperparams)
+                      # enabling layer conversions like deferred batch-norm
+
+    def out_spec(self, in_spec: Spec, *, train: bool = True) -> Spec:
+        """Shape-infer the layer output without running it."""
+        params, state = jax.eval_shape(
+            lambda r: self.init(r, in_spec), jax.random.PRNGKey(0)
+        )
+
+        def run(p, s, x):
+            y, _ = self.apply(p, s, x, rng=jax.random.PRNGKey(0), train=train)
+            return y
+
+        x = jax.tree_util.tree_map(
+            lambda sd: jnp.zeros(sd.shape, sd.dtype), in_spec
+        )
+        return jax.eval_shape(run, params, state, x)
+
+
+def stateless(name: str, fn: Callable[[Any], Any]) -> Layer:
+    """A parameter-free, state-free layer (activation, reshape, pool...)."""
+
+    def init(rng, in_spec):
+        del rng, in_spec
+        return (), ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del params, rng, train
+        return fn(x), state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def named(layers: Sequence[Layer]) -> List[Layer]:
+    """Disambiguate duplicate layer names by suffixing an index.
+
+    The reference requires children of the wrapped Sequential to be distinct
+    objects (reference: torchgpipe/gpipe.py:53-64 ``verify_module``); names
+    here play the role of identity.
+    """
+    used: set = set()
+    out: List[Layer] = []
+    for layer in layers:
+        name = layer.name
+        if name in used:
+            k = 1
+            while f"{layer.name}_{k}" in used:
+                k += 1
+            name = f"{layer.name}_{k}"
+        used.add(name)
+        out.append(
+            dataclasses.replace(layer, name=name) if name != layer.name else layer
+        )
+    return out
+
+
+def _infer_layer(layer: Layer, params, state, in_spec: Spec, pops_spec):
+    """Shape-infer one layer application (skip-aware) via ``eval_shape``."""
+
+    def run(p, s, x, pops):
+        key = jax.random.PRNGKey(0)
+        if layer.stash or layer.pop:
+            y, stashed, _ = layer.apply(p, s, x, pops=pops, rng=key, train=True)
+            return y, stashed
+        y, _ = layer.apply(p, s, x, rng=key, train=True)
+        return y, {}
+
+    x = jax.tree_util.tree_map(lambda sd: jnp.zeros(sd.shape, sd.dtype), in_spec)
+    return jax.eval_shape(run, params, state, x, pops_spec)
+
+
+def sequential_init(
+    layers: Sequence[Layer], rng: jax.Array, in_spec: Spec
+) -> Tuple[List[Pytree], List[Pytree], List[Spec]]:
+    """Initialize every layer, threading shape inference (and skip-connection
+    specs) through the chain.
+
+    Returns per-layer ``params``, ``state`` and the list of input specs seen by
+    each layer (``specs[i]`` is the input spec of ``layers[i]``; a final entry
+    holds the model output spec).
+    """
+    params_list: List[Pytree] = []
+    state_list: List[Pytree] = []
+    specs: List[Spec] = [in_spec]
+    spec = in_spec
+    skip_specs: dict = {}
+    for i, layer in enumerate(layers):
+        layer_rng = jax.random.fold_in(rng, i)
+        p, s = layer.init(layer_rng, spec)
+        params_list.append(p)
+        state_list.append(s)
+        pops_spec = {k: skip_specs.pop(k) for k in layer.pop}
+        spec, stashed_spec = _infer_layer(layer, p, s, spec, pops_spec)
+        skip_specs.update(stashed_spec)
+        specs.append(spec)
+    return params_list, state_list, specs
+
+
+def sequential_apply(
+    layers: Sequence[Layer],
+    params: Sequence[Pytree],
+    state: Sequence[Pytree],
+    x: Any,
+    *,
+    rng: Optional[jax.Array] = None,
+    train: bool = True,
+) -> Tuple[Any, List[Pytree]]:
+    """Run the full (un-partitioned) sequential model, including skip
+    connections.
+
+    This is the "transparency oracle" path: pipeline outputs must match it
+    exactly (reference: tests/test_transparency.py:7-42).
+    """
+    new_state: List[Pytree] = []
+    skips: dict = {}
+    for i, layer in enumerate(layers):
+        layer_rng = jax.random.fold_in(rng, i) if rng is not None else None
+        if layer.stash or layer.pop:
+            pops = {k: skips.pop(k) for k in layer.pop}
+            x, stashed, s = layer.apply(
+                params[i], state[i], x, pops=pops, rng=layer_rng, train=train
+            )
+            skips.update(stashed)
+        else:
+            x, s = layer.apply(params[i], state[i], x, rng=layer_rng, train=train)
+        new_state.append(s)
+    return x, new_state

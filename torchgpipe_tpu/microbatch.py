@@ -1,0 +1,140 @@
+"""Micro-batch containers: scatter a mini-batch into micro-batches and gather back.
+
+TPU-native re-design of the reference micro-batch layer
+(reference: torchgpipe/microbatch.py:17-177).  The reference wraps
+``Tensor | Tuple[Tensor, ...]`` in a ``Batch`` class with mutation helpers; here
+a micro-batch is simply a pytree of ``jax.Array`` leaves, every leaf sharing the
+same leading (batch) dimension, so the rest of the framework can stay purely
+functional.
+
+Two scatter flavours:
+
+* :func:`scatter` — list of per-chunk pytrees with ``torch.chunk`` size
+  semantics (ceil-sized chunks, possibly fewer chunks than requested; reference:
+  torchgpipe/microbatch.py:143-158, exercised by tests/test_gpipe.py:107-126).
+  Used by the MPMD engine, which tolerates ragged chunk shapes.
+* :func:`scatter_stacked` — a single ``[m, b/m, ...]`` reshape, requiring
+  divisibility.  Used by the SPMD (compiled) engine where loop shapes must be
+  uniform.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def check(value: Pytree) -> None:
+    """Validate a mini-batch: non-empty pytree of arrays with a common leading dim.
+
+    Reference: torchgpipe/microbatch.py:127-140 (``check`` rejects
+    non-tensor inputs with a didactic TypeError).
+    """
+    leaves = jax.tree_util.tree_leaves(value)
+    if not leaves:
+        raise TypeError("expected a non-empty pytree of arrays as input")
+    sizes = set()
+    for leaf in leaves:
+        if not hasattr(leaf, "ndim") or not hasattr(leaf, "shape"):
+            raise TypeError(
+                f"expected arrays as batch leaves, got {type(leaf).__name__}"
+            )
+        if leaf.ndim == 0:
+            raise TypeError("batch leaves must have a leading batch dimension")
+        sizes.add(leaf.shape[0])
+    if len(sizes) != 1:
+        raise ValueError(
+            f"all batch leaves must share the leading batch dimension, got {sorted(sizes)}"
+        )
+
+
+def batch_size(value: Pytree) -> int:
+    """Leading-dimension size of a mini-batch pytree."""
+    return jax.tree_util.tree_leaves(value)[0].shape[0]
+
+
+def chunk_sizes(total: int, chunks: int) -> List[int]:
+    """``torch.chunk`` size semantics: ceil-sized chunks, last chunk short.
+
+    May return fewer than ``chunks`` entries (e.g. 7 into 4 -> [2, 2, 2, 1];
+    3 into 4 -> [1, 1, 1]).  Reference behaviour exercised by
+    tests/test_gpipe.py:107-126 ("indivisible batches").
+    """
+    if total <= 0:
+        raise ValueError("batch size must be positive")
+    if chunks <= 0:
+        raise ValueError("chunks must be positive")
+    size = math.ceil(total / chunks)
+    out: List[int] = []
+    remaining = total
+    while remaining > 0:
+        take = min(size, remaining)
+        out.append(take)
+        remaining -= take
+    return out
+
+
+def scatter(value: Pytree, chunks: int) -> List[Pytree]:
+    """Split a mini-batch pytree into a list of micro-batch pytrees.
+
+    Reference: torchgpipe/microbatch.py:143-158.
+    """
+    check(value)
+    sizes = chunk_sizes(batch_size(value), chunks)
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+
+    def slice_leaf(leaf, lo, hi):
+        return leaf[lo:hi]
+
+    return [
+        jax.tree_util.tree_map(lambda l: slice_leaf(l, offsets[i], offsets[i + 1]), value)
+        for i in range(len(sizes))
+    ]
+
+
+def gather(microbatches: Sequence[Pytree]) -> Pytree:
+    """Concatenate micro-batch pytrees back into one mini-batch.
+
+    Reference: torchgpipe/microbatch.py:161-177.
+    """
+    if not microbatches:
+        raise ValueError("no micro-batches to gather")
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.concatenate(leaves, axis=0), *microbatches
+    )
+
+
+def scatter_stacked(value: Pytree, chunks: int) -> Pytree:
+    """Reshape every leaf ``[b, ...] -> [chunks, b/chunks, ...]``.
+
+    Uniform-shape scatter for the compiled SPMD pipeline; requires the batch to
+    divide evenly (pad-and-mask is the caller's job otherwise).
+    """
+    check(value)
+    b = batch_size(value)
+    if b % chunks != 0:
+        raise ValueError(
+            f"batch size {b} is not divisible by chunks={chunks}; "
+            "use scatter() (MPMD engine) or pad the batch"
+        )
+
+    def reshape(leaf):
+        return leaf.reshape((chunks, b // chunks) + leaf.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, value)
+
+
+def gather_stacked(value: Pytree) -> Pytree:
+    """Inverse of :func:`scatter_stacked`: ``[m, b, ...] -> [m*b, ...]``."""
+
+    def reshape(leaf):
+        return leaf.reshape((leaf.shape[0] * leaf.shape[1],) + leaf.shape[2:])
+
+    return jax.tree_util.tree_map(reshape, value)

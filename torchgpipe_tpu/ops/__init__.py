@@ -1,0 +1,15 @@
+"""TPU-friendly neural-net building blocks used by the model zoo."""
+
+from torchgpipe_tpu.ops.nn import (  # noqa: F401
+    avg_pool2d,
+    batch_norm,
+    conv2d,
+    dense,
+    dropout,
+    flatten,
+    gelu,
+    global_avg_pool,
+    layer_norm,
+    max_pool2d,
+    relu,
+)

@@ -1,0 +1,235 @@
+"""Minimal neural-net layer library over :class:`torchgpipe_tpu.layers.Layer`.
+
+The reference leans on ``torch.nn`` for actual math; this framework supplies
+its own thin layer set so models are plain JAX and lower cleanly onto the MXU:
+
+* images are NHWC (TPU-preferred layout; the reference's NCHW is a CUDA habit),
+* convolutions use ``lax.conv_general_dilated`` with NHWC/HWIO dimension
+  numbers, which XLA tiles onto the systolic array,
+* all layers are pure functions of explicit params/state pytrees.
+"""
+
+from __future__ import annotations
+
+import string
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torchgpipe_tpu.layers import Layer, stateless
+
+
+def _kaiming(rng, shape, fan_in, dtype=jnp.float32):
+    std = (2.0 / fan_in) ** 0.5
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def dense(features: int, *, use_bias: bool = True, name: str = "dense") -> Layer:
+    """Fully-connected layer ``y = x @ W + b`` over the trailing dim."""
+
+    def init(rng, in_spec):
+        in_features = jax.tree_util.tree_leaves(in_spec)[0].shape[-1]
+        wkey, _ = jax.random.split(rng)
+        params = {"w": _kaiming(wkey, (in_features, features), in_features)}
+        if use_bias:
+            params["b"] = jnp.zeros((features,))
+        return params, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        y = x @ params["w"]
+        if use_bias:
+            y = y + params["b"]
+        return y, state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def conv2d(
+    features: int,
+    kernel_size: Tuple[int, int] = (3, 3),
+    *,
+    strides: Tuple[int, int] = (1, 1),
+    padding="SAME",
+    use_bias: bool = False,
+    feature_group_count: int = 1,
+    name: str = "conv",
+) -> Layer:
+    """2-D convolution, NHWC activations, HWIO kernel."""
+
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    if isinstance(strides, int):
+        strides = (strides, strides)
+
+    def init(rng, in_spec):
+        in_ch = jax.tree_util.tree_leaves(in_spec)[0].shape[-1]
+        kh, kw = kernel_size
+        fan_in = kh * kw * in_ch // feature_group_count
+        w = _kaiming(
+            rng, (kh, kw, in_ch // feature_group_count, features), fan_in
+        )
+        params = {"w": w}
+        if use_bias:
+            params["b"] = jnp.zeros((features,))
+        return params, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        y = lax.conv_general_dilated(
+            x,
+            params["w"],
+            window_strides=strides,
+            padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=feature_group_count,
+        )
+        if use_bias:
+            y = y + params["b"]
+        return y, state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def batch_norm(
+    *, momentum: float = 0.9, eps: float = 1e-5, name: str = "bn"
+) -> Layer:
+    """Standard BatchNorm over all but the channel (last) axis.
+
+    Per-micro-batch statistics; see :mod:`torchgpipe_tpu.batchnorm` for the
+    deferred (mini-batch-faithful) variant the pipeline offers
+    (reference: torchgpipe/batchnorm.py:17-121).
+    """
+
+    def init(rng, in_spec):
+        del rng
+        ch = jax.tree_util.tree_leaves(in_spec)[0].shape[-1]
+        params = {"scale": jnp.ones((ch,)), "bias": jnp.zeros((ch,))}
+        state = {"mean": jnp.zeros((ch,)), "var": jnp.ones((ch,))}
+        return params, state
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x, axes)
+            var = jnp.var(x, axes)
+            new_state = {
+                "mean": momentum * state["mean"] + (1 - momentum) * mean,
+                "var": momentum * state["var"] + (1 - momentum) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        y = (x - mean) * lax.rsqrt(var + eps)
+        y = y * params["scale"] + params["bias"]
+        return y, new_state
+
+    return Layer(
+        name=name,
+        init=init,
+        apply=apply,
+        meta={"kind": "batch_norm", "momentum": momentum, "eps": eps},
+    )
+
+
+def layer_norm(*, eps: float = 1e-6, name: str = "ln") -> Layer:
+    """LayerNorm over the trailing dim."""
+
+    def init(rng, in_spec):
+        del rng
+        ch = jax.tree_util.tree_leaves(in_spec)[0].shape[-1]
+        return {"scale": jnp.ones((ch,)), "bias": jnp.zeros((ch,))}, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        mean = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        y = (x - mean) * lax.rsqrt(var + eps)
+        return y * params["scale"] + params["bias"], state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def dropout(rate: float, *, name: str = "dropout") -> Layer:
+    """Inverted dropout; a counter-based key per micro-batch makes recompute
+    deterministic (replaces reference RNG capture, checkpoint.py:191-231)."""
+
+    def init(rng, in_spec):
+        del rng, in_spec
+        return (), ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del params
+        if not train or rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("dropout needs an rng key in train mode")
+        keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+        return jnp.where(keep, x / (1.0 - rate), 0.0), state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def relu(name: str = "relu") -> Layer:
+    return stateless(name, jax.nn.relu)
+
+
+def gelu(name: str = "gelu") -> Layer:
+    return stateless(name, jax.nn.gelu)
+
+
+def _pool(x, window, strides, padding, reducer, init_val):
+    dims = (1, window[0], window[1], 1)
+    strs = (1, strides[0], strides[1], 1)
+    return lax.reduce_window(x, init_val, reducer, dims, strs, padding)
+
+
+def max_pool2d(
+    window: Tuple[int, int] = (2, 2),
+    strides: Optional[Tuple[int, int]] = None,
+    *,
+    padding: str = "VALID",
+    name: str = "maxpool",
+) -> Layer:
+    if isinstance(window, int):
+        window = (window, window)
+    strides = strides or window
+
+    def fn(x):
+        return _pool(x, window, strides, padding, lax.max, -jnp.inf)
+
+    return stateless(name, fn)
+
+
+def avg_pool2d(
+    window: Tuple[int, int] = (2, 2),
+    strides: Optional[Tuple[int, int]] = None,
+    *,
+    padding: str = "VALID",
+    count_include_pad: bool = True,
+    name: str = "avgpool",
+) -> Layer:
+    if isinstance(window, int):
+        window = (window, window)
+    strides = strides or window
+
+    def fn(x):
+        summed = _pool(x, window, strides, padding, lax.add, 0.0)
+        if count_include_pad or padding == "VALID":
+            return summed / (window[0] * window[1])
+        ones = jnp.ones_like(x)
+        counts = _pool(ones, window, strides, padding, lax.add, 0.0)
+        return summed / counts
+
+    return stateless(name, fn)
+
+
+def global_avg_pool(name: str = "gap") -> Layer:
+    return stateless(name, lambda x: jnp.mean(x, axis=(1, 2)))
+
+
+def flatten(name: str = "flatten") -> Layer:
+    return stateless(name, lambda x: x.reshape(x.shape[0], -1))

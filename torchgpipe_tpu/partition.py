@@ -1,0 +1,81 @@
+"""Balance-driven partitioning of a sequential layer list into pipeline stages.
+
+Reference: torchgpipe/gpipe.py:53-127 (``verify_module`` + ``split_module``)
+including its didactic error messages, and gpipe.py:34-50
+(``recommend_auto_balance``).  Device moves happen later, when the engine
+places each stage's params on its device (the reference moves partitions in
+``split_module``, gpipe.py:117).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from torchgpipe_tpu.layers import Layer
+
+_RECOMMEND = (
+    "If your model is still under development, its optimal balance would change\n"
+    "frequently. In this case, we highly recommend "
+    "torchgpipe_tpu.balance for naive automatic balancing:\n"
+    "\n"
+    "  from torchgpipe_tpu import GPipe\n"
+    "  from torchgpipe_tpu.balance import balance_by_time\n"
+    "\n"
+    "  params, states, _ = sequential_init(layers, rng, in_spec)\n"
+    "  balance = balance_by_time(n_stages, layers, params, states, sample)\n"
+    "  model = GPipe(layers, balance, ...)\n"
+)
+
+
+class BalanceError(ValueError):
+    """Reference: torchgpipe/gpipe.py:67-68."""
+
+
+def verify_module(layers: Sequence[Layer]) -> None:
+    """Validate the sequential model: a non-empty sequence of Layers with
+    unique names.
+
+    Reference: torchgpipe/gpipe.py:53-64 (Sequential? unique children? unique
+    params?).  Parameter aliasing cannot happen here — params are per-layer
+    pytrees produced by ``init`` — so name uniqueness is the remaining check.
+    """
+    if not isinstance(layers, (list, tuple)) or not layers:
+        raise TypeError("model must be a non-empty list/tuple of Layers")
+    names = set()
+    for layer in layers:
+        if not isinstance(layer, Layer):
+            raise TypeError(
+                f"model elements must be Layer instances, got {type(layer).__name__}"
+            )
+        if layer.name in names:
+            raise ValueError(
+                f"layer name {layer.name!r} appears twice; layer names identify "
+                "partitions and must be unique (see layers.named)"
+            )
+        names.add(layer.name)
+
+
+def split_layers(
+    layers: Sequence[Layer], balance: Sequence[int]
+) -> List[List[Layer]]:
+    """Split layers into contiguous stages of sizes ``balance``.
+
+    Reference: torchgpipe/gpipe.py:71-127 (``split_module``), with the same
+    failure modes: balance/layer-count mismatch and non-positive entries.
+    """
+    balance = list(balance)
+    if len(layers) != sum(balance):
+        raise BalanceError(
+            f"module and sum of balance have different length "
+            f"(module: {len(layers)}, sum of balance: {sum(balance)})\n\n{_RECOMMEND}"
+        )
+    if any(x <= 0 for x in balance):
+        raise BalanceError(
+            f"all balance numbers must be positive integer (balance: {balance})"
+        )
+    stages: List[List[Layer]] = []
+    i = 0
+    for n in balance:
+        stages.append(list(layers[i : i + n]))
+        i += n
+    return stages

@@ -1,0 +1,325 @@
+"""MPMD pipeline engine: per-stage compiled programs + clock-cycle scheduling.
+
+TPU-native re-design of the reference engine (reference:
+torchgpipe/pipeline.py:49-249).  The reference needs worker threads
+(worker.py:94-151), CUDA copy streams (gpipe.py:316-328) and autograd-graph
+surgery (dependency.py, copy.py) because eager PyTorch has no other way to
+overlap copy with compute and to order backward work.  Under JAX none of that
+machinery survives:
+
+* Each stage is a set of XLA-compiled callables pinned to a device; JAX's
+  async dispatch queues work on every device while the Python scheduler runs
+  ahead — this *replaces* the worker-thread pool (SURVEY.md §2.3).
+* Stage hand-off is ``jax.device_put`` device-to-device (ICI on TPU) issued
+  asynchronously — replacing ``Copy``/``Wait`` stream surgery.
+* Backward ordering is not enforced through phony autograd edges
+  (dependency.py:12-48) but by the scheduler itself: the backward schedule is
+  the exact reverse of the forward clock cycles, which yields the same
+  micro-batch-i-before-i-1 order the reference's ``depend`` fences create
+  (pipeline.py:128-132).
+* Checkpointed cells run a residual-free forward; during backward the
+  scheduler issues a vjp-producing recompute *before* applying the arriving
+  cotangent — recompute-ahead, as in reference checkpoint.py:1-19.
+
+The engine supports arbitrary heterogeneous stages (any balance), ragged
+micro-batches, cross-stage skip routing, and stateful layers.  For
+homogeneous stacked stages inside one jitted program, see
+:mod:`torchgpipe_tpu.spmd` — the fully-compiled SPMD engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu import checkpoint as ckpt
+from torchgpipe_tpu import microbatch
+from torchgpipe_tpu.layers import Layer
+from torchgpipe_tpu.skip.layout import SkipLayout
+
+Pytree = Any
+
+
+def clock_cycles(m: int, n: int):
+    """Generate the GPipe fill-drain schedule.
+
+    Reference: torchgpipe/pipeline.py:49-65.  Cycle ``k`` runs cells
+    ``(i, j)`` with ``i + j == k``: micro-batch ``i`` on stage ``j``.
+    """
+    for k in range(m + n - 1):
+        yield [(k - j, j) for j in range(max(0, k - m + 1), min(k + 1, n))]
+
+
+def _transfer(x: Pytree, device) -> Pytree:
+    """Async device-to-device move (ICI on TPU); no-op if already there."""
+    return jax.device_put(x, device)
+
+
+class StageExec:
+    """Compiled execution variants for one pipeline stage."""
+
+    def __init__(
+        self,
+        index: int,
+        layers: Sequence[Layer],
+        layer_offset: int,
+        device,
+        layout: SkipLayout,
+    ) -> None:
+        self.index = index
+        self.layers = list(layers)
+        self.layer_offset = layer_offset
+        self.device = device
+        self.ext_stash_keys = layout.external_stashes(index)
+        self.ext_pop_keys = layout.external_pops(index)
+        self._layout = layout
+
+        stage_apply = self._make_stage_apply()
+
+        def diff_fwd(params, state, x, skips_in, rng):
+            def g(p, xx, sk):
+                y, ext, new_state = stage_apply(p, state, xx, sk, rng, True)
+                return (y, ext), new_state
+
+            (y, ext), pull, new_state = jax.vjp(g, params, x, skips_in, has_aux=True)
+            return y, ext, new_state, pull
+
+        def plain_fwd_train(params, state, x, skips_in, rng):
+            return stage_apply(params, state, x, skips_in, rng, True)
+
+        def plain_fwd_eval(params, state, x, skips_in, rng):
+            return stage_apply(params, state, x, skips_in, rng, False)
+
+        self.fwd_vjp = self._jit_with_phase(diff_fwd)
+        self.fwd_recompute = self._jit_with_phase(diff_fwd, recomputing=True)
+        self.fwd_ckpt = self._jit_with_phase(plain_fwd_train, checkpointing=True)
+        self.fwd_train = self._jit_with_phase(plain_fwd_train)
+        self.fwd_eval = self._jit_with_phase(plain_fwd_eval)
+        self.bwd = jax.jit(lambda pull, cot: pull(cot))
+        self.accum = jax.jit(
+            lambda a, b: jax.tree_util.tree_map(jnp.add, a, b)
+        )
+
+    @staticmethod
+    def _jit_with_phase(fn, *, checkpointing: bool = False, recomputing: bool = False):
+        def wrapped(*args):
+            with ckpt.phase(checkpointing=checkpointing, recomputing=recomputing):
+                return fn(*args)
+
+        return jax.jit(wrapped)
+
+    def _make_stage_apply(self):
+        layers = self.layers
+        offset = self.layer_offset
+        ext_stash_keys = tuple(self.ext_stash_keys)
+
+        def stage_apply(params, state, x, skips_in, rng, train):
+            skips = dict(skips_in)
+            new_states = []
+            for li, layer in enumerate(layers):
+                lrng = (
+                    jax.random.fold_in(rng, offset + li) if rng is not None else None
+                )
+                if layer.stash or layer.pop:
+                    pops = {k: skips.pop(k) for k in layer.pop}
+                    x, stashed, ns = layer.apply(
+                        params[li], state[li], x, pops=pops, rng=lrng, train=train
+                    )
+                    skips.update(stashed)
+                else:
+                    x, ns = layer.apply(
+                        params[li], state[li], x, rng=lrng, train=train
+                    )
+                new_states.append(ns)
+            ext = {k: skips[k] for k in ext_stash_keys}
+            return x, ext, tuple(new_states)
+
+        return stage_apply
+
+
+class Pipeline:
+    """Schedules micro-batches over stages following GPipe fill-drain.
+
+    Reference: torchgpipe/pipeline.py:68-115 (``Pipeline.run``), with
+    forward *and* backward as explicit schedules (the reference's backward
+    rides the autograd engine, SURVEY.md §3.3).
+    """
+
+    def __init__(self, stages: Sequence[StageExec], layout: SkipLayout) -> None:
+        self.stages = list(stages)
+        self.layout = layout
+        self._loss_grad_cache: Dict = {}
+
+    # ------------------------------------------------------------------ #
+    # forward-only (inference / no-grad)                                 #
+    # ------------------------------------------------------------------ #
+
+    def run_forward(
+        self,
+        params: Sequence[Pytree],
+        states: Sequence[Pytree],
+        mbatches: List[Pytree],
+        rng: Optional[jax.Array],
+        train: bool,
+    ) -> Tuple[List[Pytree], List[Pytree]]:
+        """Run all micro-batches through all stages without building vjps."""
+        n = len(self.stages)
+        m = len(mbatches)
+        acts: Dict[int, Pytree] = {}
+        skip_vals: Dict = {}
+        cur_states = list(states)
+        outs: List[Pytree] = [None] * m
+
+        for cycle in clock_cycles(m, n):
+            for i, j in cycle:
+                stage = self.stages[j]
+                x = mbatches[i] if j == 0 else acts.pop(i)
+                x = _transfer(x, stage.device)
+                skips_in = {k: skip_vals.pop((i, k)) for k in stage.ext_pop_keys}
+                rng_i = jax.random.fold_in(rng, i) if rng is not None else None
+                fwd = stage.fwd_train if train else stage.fwd_eval
+                y, ext, new_state = fwd(params[j], cur_states[j], x, skips_in, rng_i)
+                cur_states[j] = new_state
+                for k, v in ext.items():
+                    dst = self.stages[self.layout.pop_stage(k)].device
+                    skip_vals[(i, k)] = _transfer(v, dst)
+                if j == n - 1:
+                    outs[i] = y
+                else:
+                    acts[i] = y
+        return outs, cur_states
+
+    # ------------------------------------------------------------------ #
+    # forward + backward (training)                                      #
+    # ------------------------------------------------------------------ #
+
+    def run_train(
+        self,
+        params: Sequence[Pytree],
+        states: Sequence[Pytree],
+        mbatches: List[Pytree],
+        target: Pytree,
+        loss_fn,
+        rng: Optional[jax.Array],
+        checkpoint_stop: int,
+    ):
+        """Full pipelined forward, loss, and backward.
+
+        Returns ``(loss, grads_per_stage, new_states, aux)`` where ``aux`` is
+        whatever extra output ``loss_fn`` returns (or None).
+        """
+        n = len(self.stages)
+        m = len(mbatches)
+
+        acts: Dict[int, Pytree] = {}
+        outs: List[Pytree] = [None] * m
+        pulls: Dict[Tuple[int, int], Any] = {}
+        saved: Dict[Tuple[int, int], Any] = {}
+        skip_vals: Dict = {}
+        cur_states = list(states)
+
+        # ---- forward schedule -------------------------------------------------
+        for cycle in clock_cycles(m, n):
+            for i, j in cycle:
+                stage = self.stages[j]
+                x = mbatches[i] if j == 0 else acts.pop(i)
+                x = _transfer(x, stage.device)
+                skips_in = {k: skip_vals.pop((i, k)) for k in stage.ext_pop_keys}
+                rng_i = jax.random.fold_in(rng, i) if rng is not None else None
+                checkpointed = i < checkpoint_stop
+                state_in = cur_states[j]
+                if checkpointed:
+                    y, ext, new_state = stage.fwd_ckpt(
+                        params[j], state_in, x, skips_in, rng_i
+                    )
+                    saved[(i, j)] = (x, skips_in, state_in, rng_i)
+                else:
+                    y, ext, new_state, pull = stage.fwd_vjp(
+                        params[j], state_in, x, skips_in, rng_i
+                    )
+                    pulls[(i, j)] = pull
+                cur_states[j] = new_state
+                for k, v in ext.items():
+                    dst = self.stages[self.layout.pop_stage(k)].device
+                    skip_vals[(i, k)] = _transfer(v, dst)
+                if j == n - 1:
+                    outs[i] = y
+                else:
+                    acts[i] = y
+
+        # ---- loss + output cotangents ----------------------------------------
+        loss, gys_last, aux = self._loss_and_grads(outs, target, loss_fn)
+
+        # ---- backward schedule (reverse clock cycles) ------------------------
+        gys: Dict[Tuple[int, int], Pytree] = {
+            (i, n - 1): gys_last[i] for i in range(m)
+        }
+        gskips: Dict = {}
+        acc: List[Optional[Pytree]] = [None] * n
+
+        cycles = list(clock_cycles(m, n))
+        for cycle in reversed(cycles):
+            for i, j in reversed(cycle):
+                stage = self.stages[j]
+                if (i, j) in saved:
+                    x, skips_in, state_in, rng_i = saved.pop((i, j))
+                    # Recompute-ahead: rebuild the vjp before consuming the
+                    # cotangent (reference checkpoint.py:1-19).
+                    _, _, _, pull = stage.fwd_recompute(
+                        params[j], state_in, x, skips_in, rng_i
+                    )
+                else:
+                    pull = pulls.pop((i, j))
+                gy = gys.pop((i, j))
+                gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
+                gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
+                acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
+                if j > 0:
+                    gys[(i, j - 1)] = _transfer(gx, self.stages[j - 1].device)
+                for k, g in gsk_in.items():
+                    dst = self.stages[self.layout.stash_stage(k)].device
+                    gskips[(i, k)] = _transfer(g, dst)
+
+        return loss, acc, cur_states, aux
+
+    # ------------------------------------------------------------------ #
+
+    def _loss_and_grads(self, outs: List[Pytree], target: Pytree, loss_fn):
+        """Gather outputs on the last stage device, compute the loss on the
+        full mini-batch (transparency with the un-pipelined model), and split
+        the output cotangent back into micro-batch cotangents."""
+        last_dev = self.stages[-1].device
+        outs = [_transfer(o, last_dev) for o in outs]
+        target = _transfer(target, last_dev)
+
+        sizes = tuple(
+            jax.tree_util.tree_leaves(o)[0].shape[0] for o in outs
+        )
+        treedef = jax.tree_util.tree_structure(outs[0])
+        key = (sizes, treedef, loss_fn)
+        if key not in self._loss_grad_cache:
+            # Bound the cache: a user passing a fresh lambda per step would
+            # otherwise grow compiled executables without limit (pass a
+            # stable loss_fn to avoid recompilation entirely).
+            while len(self._loss_grad_cache) >= 16:
+                self._loss_grad_cache.pop(next(iter(self._loss_grad_cache)))
+
+            def gathered_loss(outs_list, tgt):
+                out = microbatch.gather(outs_list)
+                res = loss_fn(out, tgt)
+                if isinstance(res, tuple):
+                    return res[0], res[1]
+                return res, None
+
+            def run(outs_list, tgt):
+                (loss, aux), gouts = jax.value_and_grad(
+                    gathered_loss, has_aux=True
+                )(outs_list, tgt)
+                return loss, gouts, aux
+
+            self._loss_grad_cache[key] = jax.jit(run)
+
+        loss, gouts, aux = self._loss_grad_cache[key](outs, target)
+        return loss, gouts, aux

@@ -1,0 +1,170 @@
+"""Named skip connections that travel directly from stash stage to pop stage.
+
+Functional re-design of the reference skip subsystem (reference:
+torchgpipe/skip/skippable.py:213-289 ``@skippable`` with a generator protocol
+``yield stash(name, t)`` / ``t = yield pop(name)``).  Here a skippable layer is
+an ordinary :class:`~torchgpipe_tpu.layers.Layer` whose ``apply`` takes and
+returns explicit skip dictionaries:
+
+    apply(params, state, x, *, pops: dict, rng, train) -> (y, stashes: dict, new_state)
+
+and whose ``stash``/``pop`` metadata lets the partitioner build a static
+:class:`~torchgpipe_tpu.skip.layout.SkipLayout`.  The reference's portal
+machinery (skip/portal.py) — hiding skip tensors from autograd while routing
+their gradients — has no TPU equivalent to build: in a functional program the
+skip value is just another input/output, XLA liveness handles memory, and the
+MPMD engine's point-to-point routing keeps skips off intermediate stages.
+
+Example (long U-Net skip)::
+
+    ns = Namespace()
+    layers = [
+        ...,
+        stash("enc3", ns=ns),          # stash the encoder feature map
+        ...,
+        pop_cat("enc3", ns=ns),        # concat it into the decoder
+        ...,
+    ]
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from torchgpipe_tpu.layers import Layer
+from torchgpipe_tpu.skip.layout import SkipLayout, inspect_skip_layout  # noqa: F401
+from torchgpipe_tpu.skip.namespace import Namespace, skip_key  # noqa: F401
+
+__all__ = [
+    "Namespace",
+    "SkipLayout",
+    "inspect_skip_layout",
+    "skippable",
+    "stash",
+    "pop_cat",
+    "pop_add",
+    "verify_skippables",
+    "skip_key",
+]
+
+
+def skippable(
+    fn: Callable,
+    *,
+    stash: Sequence[str] = (),
+    pop: Sequence[str] = (),
+    ns: Optional[Namespace] = None,
+    name: str = "skippable",
+) -> Layer:
+    """Wrap ``fn(x, pops: dict) -> (y, stashes: dict)`` into a skip-aware Layer.
+
+    ``pops``/``stashes`` are keyed by the plain string names; namespacing is
+    applied here.  Reference: torchgpipe/skip/skippable.py:213-289.
+    """
+    stash_keys = tuple(skip_key(ns, n) for n in stash)
+    pop_keys = tuple(skip_key(ns, n) for n in pop)
+    name_of = {skip_key(ns, n): n for n in tuple(stash) + tuple(pop)}
+
+    def init(rng, in_spec):
+        del rng, in_spec
+        return (), ()
+
+    def apply(params, state, x, *, pops: Dict, rng=None, train=True):
+        del params, rng, train
+        plain_pops = {name_of[k]: v for k, v in pops.items()}
+        y, stashes = fn(x, plain_pops)
+        missing = set(stash) - set(stashes)
+        if missing:
+            raise RuntimeError(f"skippable layer {name!r} did not stash {sorted(missing)}")
+        undeclared = set(stashes) - set(stash)
+        if undeclared:
+            raise RuntimeError(
+                f"skippable layer {name!r} stashed undeclared {sorted(undeclared)}; "
+                f"declare them in stash=[...] so the layout can route them"
+            )
+        keyed = {skip_key(ns, n): v for n, v in stashes.items()}
+        return y, keyed, state
+
+    return Layer(name=name, init=init, apply=apply, stash=stash_keys, pop=pop_keys)
+
+
+def stash(skip_name: str, *, ns: Optional[Namespace] = None, name: Optional[str] = None) -> Layer:
+    """Identity layer that stashes its input under ``skip_name``.
+
+    Reference pattern: benchmarks/models/unet/__init__.py:18-27 (``Stash``).
+    """
+
+    def fn(x, pops):
+        del pops
+        return x, {skip_name: x}
+
+    return skippable(fn, stash=[skip_name], ns=ns, name=name or f"stash[{skip_name}]")
+
+
+def pop_cat(
+    skip_name: str,
+    *,
+    axis: int = -1,
+    ns: Optional[Namespace] = None,
+    name: Optional[str] = None,
+) -> Layer:
+    """Pop ``skip_name`` and concatenate it to the input along ``axis``.
+
+    Reference pattern: benchmarks/models/unet/__init__.py:30-40 (``PopCat``).
+    """
+
+    def fn(x, pops):
+        return jnp.concatenate([x, pops[skip_name]], axis=axis), {}
+
+    return skippable(fn, pop=[skip_name], ns=ns, name=name or f"pop_cat[{skip_name}]")
+
+
+def pop_add(
+    skip_name: str, *, ns: Optional[Namespace] = None, name: Optional[str] = None
+) -> Layer:
+    """Pop ``skip_name`` and add it to the input (residual connection).
+
+    Reference pattern: benchmarks/models/resnet/bottleneck.py:31-80
+    (``Residual`` via stash/pop Identity pairs).
+    """
+
+    def fn(x, pops):
+        return x + pops[skip_name], {}
+
+    return skippable(fn, pop=[skip_name], ns=ns, name=name or f"pop_add[{skip_name}]")
+
+
+def verify_skippables(layers: Sequence[Layer]) -> None:
+    """Static integrity check of stash/pop matching over the whole model.
+
+    Mirrors the reference's eager validation with didactic messages
+    (reference: torchgpipe/skip/skippable.py:335-416): every pop must follow a
+    matching stash, and every (ns, name) must be stashed/popped exactly once.
+    """
+    msgs = []
+    stashed: Dict[Tuple, str] = {}
+    popped: Dict[Tuple, str] = {}
+    for layer in layers:
+        for key in layer.pop:
+            if key in popped:
+                msgs.append(
+                    f"'{key[1]}' is popped by both {popped[key]!r} and {layer.name!r}; "
+                    "use a different Namespace to isolate them"
+                )
+            elif key not in stashed:
+                msgs.append(f"{layer.name!r} pops '{key[1]}' before it is stashed")
+            popped[key] = layer.name
+        for key in layer.stash:
+            if key in stashed:
+                msgs.append(
+                    f"'{key[1]}' is stashed by both {stashed[key]!r} and {layer.name!r}; "
+                    "use a different Namespace to isolate them"
+                )
+            stashed[key] = layer.name
+    for key, who in stashed.items():
+        if key not in popped:
+            msgs.append(f"no layer pops '{key[1]}' stashed by {who!r}")
+    if msgs:
+        raise TypeError("\n".join(msgs))

@@ -1,0 +1,68 @@
+"""Static skip routing: which (namespace, name) travels between which stages.
+
+Reference: torchgpipe/skip/layout.py:11-83 (``SkipLayout`` /
+``inspect_skip_layout``).  Computed once at partition time from layer
+metadata.  The MPMD engine uses it to route stashed values point-to-point from
+their stash stage's device to their pop stage's device — never materializing
+them on intermediate stages, which is the memory property the reference needed
+portals for (torchgpipe/skip/portal.py:1-8).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from torchgpipe_tpu.layers import Layer
+
+
+class SkipLayout:
+    """Routing table over partitioned layers.
+
+    ``by_key[key] = (stash_stage, pop_stage)`` for every cross-referenced skip.
+    """
+
+    def __init__(self, by_key: Dict[Tuple, Tuple[int, int]]) -> None:
+        self.by_key = dict(by_key)
+
+    def requires_copy(self, key) -> bool:
+        """True if the skip crosses a stage boundary.
+
+        Reference: torchgpipe/skip/layout.py:53-58.
+        """
+        src, dst = self.by_key[key]
+        return src != dst
+
+    def external_stashes(self, stage: int) -> List:
+        """Keys stashed in ``stage`` that are popped in a *later* stage."""
+        return sorted(
+            k for k, (src, dst) in self.by_key.items() if src == stage and dst != stage
+        )
+
+    def external_pops(self, stage: int) -> List:
+        """Keys popped in ``stage`` that were stashed in an *earlier* stage."""
+        return sorted(
+            k for k, (src, dst) in self.by_key.items() if dst == stage and src != stage
+        )
+
+    def pop_stage(self, key) -> int:
+        return self.by_key[key][1]
+
+    def stash_stage(self, key) -> int:
+        return self.by_key[key][0]
+
+
+def inspect_skip_layout(partitions: Sequence[Sequence[Layer]]) -> SkipLayout:
+    """Build the routing table from partitioned layers.
+
+    Reference: torchgpipe/skip/layout.py:61-83.
+    """
+    stash_at: Dict[Tuple, int] = {}
+    by_key: Dict[Tuple, Tuple[int, int]] = {}
+    for j, stage in enumerate(partitions):
+        for layer in stage:
+            for key in layer.stash:
+                stash_at[key] = j
+            for key in layer.pop:
+                if key in stash_at:
+                    by_key[key] = (stash_at[key], j)
+    return SkipLayout(by_key)

@@ -1,0 +1,75 @@
+"""Namespaces isolate skip names so a skippable layer can be reused.
+
+Reference: torchgpipe/skip/namespace.py:11-43 — UUID-identified, orderable,
+hashable; ``None`` acts as the default namespace.  Orderability matters here
+because skip keys appear as dict keys inside jit-traced pytrees, and JAX sorts
+dict keys during flattening.
+"""
+
+from __future__ import annotations
+
+import uuid
+from functools import total_ordering
+
+
+@total_ordering
+class Namespace:
+    __slots__ = ("_id",)
+
+    def __init__(self) -> None:
+        self._id = uuid.uuid4().hex
+
+    def __repr__(self) -> str:
+        return f"<Namespace {self._id[:8]}>"
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Namespace):
+            return self._id == other._id
+        return NotImplemented
+
+    def __lt__(self, other) -> bool:
+        if isinstance(other, Namespace):
+            return self._id < other._id
+        if other is None:
+            return False  # None (default namespace) sorts first
+        return NotImplemented
+
+
+def skip_key(ns, name):
+    """Canonical (namespace, name) key; namespace may be None."""
+    return (_NsKey(ns), name)
+
+
+@total_ordering
+class _NsKey:
+    """Sortable wrapper making ``None`` and :class:`Namespace` comparable."""
+
+    __slots__ = ("ns",)
+
+    def __init__(self, ns) -> None:
+        if not (ns is None or isinstance(ns, Namespace)):
+            raise TypeError("namespace must be a Namespace or None")
+        self.ns = ns
+
+    def __repr__(self) -> str:
+        return repr(self.ns)
+
+    def __hash__(self) -> int:
+        return hash(self.ns)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, _NsKey):
+            return self.ns == other.ns
+        return NotImplemented
+
+    def __lt__(self, other) -> bool:
+        if not isinstance(other, _NsKey):
+            return NotImplemented
+        if self.ns is None:
+            return other.ns is not None
+        if other.ns is None:
+            return False
+        return self.ns < other.ns

@@ -1,0 +1,27 @@
+"""Shared small utilities."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def tree_allclose(a: Pytree, b: Pytree, *, rtol: float = 1e-5, atol: float = 1e-6) -> bool:
+    """Structural + numerical equality of two pytrees (test helper)."""
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    if ta != tb:
+        return False
+    return all(
+        x.shape == y.shape and jnp.allclose(x, y, rtol=rtol, atol=atol)
+        for x, y in zip(la, lb)
+    )
+
+
+def param_count(tree: Pytree) -> int:
+    """Total number of scalar parameters in a pytree."""
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
