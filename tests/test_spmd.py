@@ -1,0 +1,163 @@
+"""SPMD (single-program) pipeline engine: transparency + mesh composition.
+
+The compiled engine must produce the same loss/grads as running the stacked
+blocks sequentially on one device — same oracle discipline as the MPMD tests
+(reference: tests/test_transparency.py), plus data-parallel composition on a
+second mesh axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.layers import chain
+from torchgpipe_tpu.ops import dense, gelu, layer_norm
+from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+
+def make_block(dim=8):
+    return chain([layer_norm(name="ln"), dense(dim, name="fc"), gelu("act")], name="block")
+
+
+def mse(out, tgt):
+    return jnp.mean((out - tgt) ** 2)
+
+
+def seq_oracle(block, params, x, tgt, n_stages):
+    """Run the stacked blocks sequentially on one device."""
+    dev0 = jax.devices()[0]
+    params = jax.device_put(params, dev0)
+    x = jax.device_put(x, dev0)
+    tgt = jax.device_put(tgt, dev0)
+
+    def loss_of(blocks):
+        h = x
+        for j in range(n_stages):
+            pj = jax.tree_util.tree_map(lambda a: a[j], blocks)
+            h, _ = block.apply(pj, (), h, rng=None, train=True)
+        return mse(h, tgt)
+
+    return jax.value_and_grad(loss_of)(params["blocks"])
+
+
+@pytest.mark.parametrize("checkpoint", ["always", "never"])
+def test_spmd_transparency(cpu_devices, checkpoint):
+    n, dim = 4, 8
+    mesh = make_mesh(n, 1, devices=cpu_devices)
+    block = make_block(dim)
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=4, loss_fn=mse, checkpoint=checkpoint, dp_axis="dp"
+    )
+    params = pipe.init(jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, dim), jnp.float32))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, dim))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (16, dim))
+
+    loss, grads = pipe.train_step(params, x, tgt)
+    ref_loss, ref_grads = seq_oracle(block, params, x, tgt, n)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        grads["blocks"],
+        ref_grads,
+    )
+
+
+def test_spmd_with_dp(cpu_devices):
+    n, dp, dim = 4, 2, 8
+    mesh = make_mesh(n, dp, devices=cpu_devices)
+    block = make_block(dim)
+    pipe = SpmdGPipe(block, n, mesh, chunks=2, loss_fn=mse, dp_axis="dp")
+    params = pipe.init(jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, dim), jnp.float32))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, dim))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (16, dim))
+
+    loss, grads = pipe.train_step(params, x, tgt)
+    ref_loss, ref_grads = seq_oracle(block, params, x, tgt, n)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        grads["blocks"],
+        ref_grads,
+    )
+
+
+def test_spmd_pre_post(cpu_devices):
+    n, dim = 4, 8
+    mesh = make_mesh(n, 2, devices=cpu_devices)
+    block = make_block(dim)
+    pre = dense(dim, name="embed")
+    post = dense(3, name="head")
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=2, loss_fn=mse, pre=pre, post=post, dp_axis="dp"
+    )
+    params = pipe.init(jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, 5), jnp.float32))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 5))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (8, 3))
+
+    loss, grads = pipe.train_step(params, x, tgt)
+
+    # Oracle with pre/post on one device.
+    dev0 = jax.devices()[0]
+    p0 = jax.device_put(params, dev0)
+    x0, t0 = jax.device_put((x, tgt), dev0)
+
+    def loss_of(p):
+        h, _ = pre.apply(p["pre"], (), x0, rng=None, train=True)
+        for j in range(n):
+            pj = jax.tree_util.tree_map(lambda a: a[j], p["blocks"])
+            h, _ = block.apply(pj, (), h, rng=None, train=True)
+        h, _ = post.apply(p["post"], (), h, rng=None, train=True)
+        return mse(h, t0)
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_of)(p0)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        grads,
+        ref_grads,
+    )
+
+
+def test_spmd_inference(cpu_devices):
+    n, dim = 4, 8
+    mesh = make_mesh(n, 2, devices=cpu_devices)
+    block = make_block(dim)
+    pipe = SpmdGPipe(block, n, mesh, chunks=2, loss_fn=mse, dp_axis="dp")
+    params = pipe.init(jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, dim), jnp.float32))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, dim))
+
+    out = pipe.apply(params, x)
+
+    dev0 = jax.devices()[0]
+    p0, x0 = jax.device_put((params, x), dev0)
+    h = x0
+    for j in range(n):
+        pj = jax.tree_util.tree_map(lambda a: a[j], p0["blocks"])
+        h, _ = block.apply(pj, (), h, rng=None, train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(h), rtol=1e-4, atol=1e-5)
+
+
+def test_spmd_rejects_shape_changing_block(cpu_devices):
+    mesh = make_mesh(4, 1, devices=cpu_devices)
+    block = dense(16, name="grow")  # 8 -> 16: not stackable
+    pipe = SpmdGPipe(block, 4, mesh, chunks=2, loss_fn=mse)
+    with pytest.raises(ValueError, match="preserve activation"):
+        pipe.init(jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, 8), jnp.float32))
+
+
+def test_spmd_rejects_stateful_block(cpu_devices):
+    from torchgpipe_tpu.ops import batch_norm
+
+    mesh = make_mesh(4, 1, devices=cpu_devices)
+    block = chain([dense(8, name="fc"), batch_norm(name="bn")], name="b")
+    pipe = SpmdGPipe(block, 4, mesh, chunks=2, loss_fn=mse)
+    with pytest.raises(ValueError, match="stateless"):
+        pipe.init(jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, 8), jnp.float32))

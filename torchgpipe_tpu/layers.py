@@ -103,6 +103,44 @@ def named(layers: Sequence[Layer]) -> List[Layer]:
     return out
 
 
+def chain(sub: Sequence[Layer], name: str = "chain") -> Layer:
+    """Compose several layers into one Layer (e.g. one pipeline *stage* of the
+    SPMD engine, or a transformer block built from sub-layers).
+
+    Skip connections are supported as long as every (stash, pop) pair resolves
+    *within* the chain.
+    """
+    sub = list(sub)
+    unresolved_pops = []
+    stashed_names = set()
+    for l in sub:
+        for k in l.pop:
+            if k not in stashed_names:
+                unresolved_pops.append(k)
+        stashed_names.update(l.stash)
+    if unresolved_pops:
+        raise ValueError(
+            f"chain {name!r} has pops with no matching stash inside the chain: "
+            f"{unresolved_pops}"
+        )
+
+    def init(rng, in_spec):
+        params_list, state_list, _ = sequential_init(sub, rng, in_spec)
+        return tuple(params_list), tuple(state_list)
+
+    def apply(params, state, x, *, rng=None, train=True):
+        if not state:
+            # Convention: () means "all sub-layers stateless" — lets callers
+            # (e.g. the SPMD engine) thread an empty state.
+            state = ((),) * len(sub)
+        y, new_states = sequential_apply(
+            sub, params, state, x, rng=rng, train=train
+        )
+        return y, tuple(new_states)
+
+    return Layer(name=name, init=init, apply=apply)
+
+
 def _infer_layer(layer: Layer, params, state, in_spec: Spec, pops_spec):
     """Shape-infer one layer application (skip-aware) via ``eval_shape``."""
 
@@ -145,6 +183,29 @@ def sequential_init(
     return params_list, state_list, specs
 
 
+def apply_layer(
+    layer: Layer,
+    params: Pytree,
+    state: Pytree,
+    x: Any,
+    skips: dict,
+    *,
+    rng: Optional[jax.Array] = None,
+    train: bool = True,
+) -> Tuple[Any, Pytree]:
+    """Apply one layer, routing skip stash/pop through the ``skips`` dict
+    (mutated in place).  Shared by the sequential oracle, chain, the MPMD
+    stage runner, and the profiler, so the dispatch convention cannot drift."""
+    if layer.stash or layer.pop:
+        pops = {k: skips.pop(k) for k in layer.pop}
+        y, stashed, s = layer.apply(
+            params, state, x, pops=pops, rng=rng, train=train
+        )
+        skips.update(stashed)
+        return y, s
+    return layer.apply(params, state, x, rng=rng, train=train)
+
+
 def sequential_apply(
     layers: Sequence[Layer],
     params: Sequence[Pytree],
@@ -164,13 +225,8 @@ def sequential_apply(
     skips: dict = {}
     for i, layer in enumerate(layers):
         layer_rng = jax.random.fold_in(rng, i) if rng is not None else None
-        if layer.stash or layer.pop:
-            pops = {k: skips.pop(k) for k in layer.pop}
-            x, stashed, s = layer.apply(
-                params[i], state[i], x, pops=pops, rng=layer_rng, train=train
-            )
-            skips.update(stashed)
-        else:
-            x, s = layer.apply(params[i], state[i], x, rng=layer_rng, train=train)
+        x, s = apply_layer(
+            layer, params[i], state[i], x, skips, rng=layer_rng, train=train
+        )
         new_state.append(s)
     return x, new_state

@@ -1,0 +1,6 @@
+"""Model zoo: sequential-layer builders for the pipeline engines.
+
+Counterpart of the reference's ``benchmarks/models`` zoo (sequential
+ResNet-101, U-Net, AmoebaNet-D; SURVEY.md §2.4), extended with the
+transformer/Llama family for the SPMD flagship path.
+"""

@@ -1,0 +1,224 @@
+"""Llama-style transformer as a sequential layer list / stacked pipeline block.
+
+The flagship model family for the TPU build (BASELINE.json: "Llama-3-8B as
+nn.Sequential of transformer blocks, 8-stage pipeline on v5p-8").  Design is
+MXU-first: all heavy math is batched einsum/matmul in (optionally) bfloat16,
+static shapes, rotary embeddings computed from shape, grouped-query attention
+(GQA) as in Llama 3.
+
+Two consumption modes:
+
+* :func:`llama` — a flat ``List[Layer]`` (embedding, n blocks, head) for the
+  MPMD :class:`~torchgpipe_tpu.gpipe.GPipe` engine with an explicit balance.
+* :func:`llama_spmd` — ``(block, pre, post)`` for the compiled
+  :class:`~torchgpipe_tpu.spmd.SpmdGPipe` engine: blocks must be stacked, so
+  each pipeline stage runs ``layers_per_stage`` identical blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu.layers import Layer, chain
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    dim: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # None -> MHA; < n_heads -> GQA
+    mlp_ratio: float = 4.0
+    rope_theta: float = 500000.0  # Llama-3 default
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32  # bfloat16 for TPU benches
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def mlp_hidden(self) -> int:
+        # Llama-style 2/3 * 4 * dim, rounded to a multiple of 128 (MXU tile).
+        h = int(2 * self.mlp_ratio * self.dim / 3)
+        return max(128, ((h + 127) // 128) * 128)
+
+
+def _normal(rng, shape, std, dtype):
+    return (std * jax.random.normal(rng, shape)).astype(dtype)
+
+
+def rms_norm(dim: int, *, eps: float = 1e-5, name: str = "rmsnorm") -> Layer:
+    def init(rng, in_spec):
+        del rng, in_spec
+        return {"scale": jnp.ones((dim,))}, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        y = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
+        return y * params["scale"].astype(x.dtype), state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def _rope(x: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary position embedding over the trailing head_dim, positions from
+    shape (x: [b, s, heads, head_dim])."""
+    b, s, h, d = x.shape
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]  # [s, half]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [
+            x1 * cos - x2 * sin,
+            x2 * cos + x1 * sin,
+        ],
+        axis=-1,
+    )
+    return out.astype(x.dtype)
+
+
+def transformer_block(cfg: TransformerConfig, *, name: str = "block") -> Layer:
+    """One pre-norm block: x + attn(norm(x)); x + mlp(norm(x)).
+
+    Residuals are internal to the layer, so a pipeline can split the model at
+    any block boundary without skip routing.
+    """
+    dim, hd = cfg.dim, cfg.head_dim
+    nh, nkv = cfg.n_heads, cfg.kv_heads
+    hidden = cfg.mlp_hidden
+    dt = cfg.dtype
+
+    def init(rng, in_spec):
+        del in_spec
+        ks = jax.random.split(rng, 7)
+        std = dim ** -0.5
+        params = {
+            "ln1": jnp.ones((dim,)),
+            "wq": _normal(ks[0], (dim, nh * hd), std, dt),
+            "wk": _normal(ks[1], (dim, nkv * hd), std, dt),
+            "wv": _normal(ks[2], (dim, nkv * hd), std, dt),
+            "wo": _normal(ks[3], (nh * hd, dim), std, dt),
+            "ln2": jnp.ones((dim,)),
+            "w_gate": _normal(ks[4], (dim, hidden), std, dt),
+            "w_up": _normal(ks[5], (dim, hidden), std, dt),
+            "w_down": _normal(ks[6], (hidden, dim), hidden ** -0.5, dt),
+        }
+        return params, ()
+
+    def norm(x, scale):
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + cfg.norm_eps).astype(x.dtype)) * scale.astype(
+            x.dtype
+        )
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        b, s, _ = x.shape
+
+        h = norm(x, params["ln1"])
+        q = (h @ params["wq"]).reshape(b, s, nh, hd)
+        k = (h @ params["wk"]).reshape(b, s, nkv, hd)
+        v = (h @ params["wv"]).reshape(b, s, nkv, hd)
+        q = _rope(q, cfg.rope_theta)
+        k = _rope(k, cfg.rope_theta)
+        if nkv != nh:
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (hd ** -0.5)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, nh * hd)
+        x = x + attn @ params["wo"]
+
+        h = norm(x, params["ln2"])
+        gate = jax.nn.silu(h @ params["w_gate"])
+        up = h @ params["w_up"]
+        x = x + (gate * up) @ params["w_down"]
+        return x, state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
+    def init(rng, in_spec):
+        del in_spec
+        return {"table": _normal(rng, (cfg.vocab, cfg.dim), 0.02, cfg.dtype)}, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        return jnp.take(params["table"], x, axis=0), state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def lm_head(cfg: TransformerConfig, *, name: str = "head") -> Layer:
+    """Final RMSNorm + vocabulary projection."""
+
+    def init(rng, in_spec):
+        del in_spec
+        return {
+            "scale": jnp.ones((cfg.dim,)),
+            "w": _normal(rng, (cfg.dim, cfg.vocab), cfg.dim ** -0.5, cfg.dtype),
+        }, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        h = (x * jax.lax.rsqrt(var + cfg.norm_eps).astype(x.dtype)) * params[
+            "scale"
+        ].astype(x.dtype)
+        return h @ params["w"], state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def llama(cfg: TransformerConfig) -> List[Layer]:
+    """Flat sequential layer list for the MPMD GPipe engine: embed, blocks,
+    head — the "nn.Sequential of transformer blocks" shape (BASELINE.json)."""
+    layers: List[Layer] = [token_embedding(cfg)]
+    for i in range(cfg.n_layers):
+        layers.append(transformer_block(cfg, name=f"block{i}"))
+    layers.append(lm_head(cfg))
+    return layers
+
+
+def llama_spmd(
+    cfg: TransformerConfig, n_stages: int
+) -> Tuple[Layer, Layer, Layer]:
+    """(block, pre, post) for the SPMD engine: each stage runs
+    ``n_layers // n_stages`` blocks."""
+    if cfg.n_layers % n_stages != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} must divide evenly into {n_stages} stages"
+        )
+    per = cfg.n_layers // n_stages
+    block = chain(
+        [transformer_block(cfg, name=f"b{i}") for i in range(per)], name="stage"
+    )
+    return block, token_embedding(cfg), lm_head(cfg)
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean token cross-entropy at aligned positions; logits [b, s, v], int
+    labels [b, s].  For a causal-LM objective pass *pre-shifted* arrays
+    (``logits`` from ``tokens[:, :-1]``, ``labels = tokens[:, 1:]``) — this
+    function does not shift."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

@@ -11,7 +11,6 @@ its own thin layer set so models are plain JAX and lower cleanly onto the MXU:
 
 from __future__ import annotations
 
-import string
 from typing import Optional, Sequence, Tuple
 
 import jax

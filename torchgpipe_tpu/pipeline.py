@@ -36,7 +36,7 @@ import jax.numpy as jnp
 
 from torchgpipe_tpu import checkpoint as ckpt
 from torchgpipe_tpu import microbatch
-from torchgpipe_tpu.layers import Layer
+from torchgpipe_tpu.layers import Layer, apply_layer
 from torchgpipe_tpu.skip.layout import SkipLayout
 
 Pytree = Any
@@ -122,16 +122,9 @@ class StageExec:
                 lrng = (
                     jax.random.fold_in(rng, offset + li) if rng is not None else None
                 )
-                if layer.stash or layer.pop:
-                    pops = {k: skips.pop(k) for k in layer.pop}
-                    x, stashed, ns = layer.apply(
-                        params[li], state[li], x, pops=pops, rng=lrng, train=train
-                    )
-                    skips.update(stashed)
-                else:
-                    x, ns = layer.apply(
-                        params[li], state[li], x, rng=lrng, train=train
-                    )
+                x, ns = apply_layer(
+                    layer, params[li], state[li], x, skips, rng=lrng, train=train
+                )
                 new_states.append(ns)
             ext = {k: skips[k] for k in ext_stash_keys}
             return x, ext, tuple(new_states)

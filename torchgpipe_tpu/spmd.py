@@ -1,0 +1,416 @@
+"""SPMD pipeline engine: the whole GPipe schedule as ONE compiled XLA program.
+
+This is the TPU-native flagship path.  Where the MPMD engine
+(:mod:`torchgpipe_tpu.pipeline`) drives per-stage programs from Python —
+mirroring the reference's scheduler (torchgpipe/pipeline.py:96-249) — this
+engine expresses the entire fill-drain schedule *inside* one
+``jax.shard_map``-ped, ``jax.jit``-ed training step:
+
+* the ``n`` stages live on a ``"pp"`` mesh axis; every device runs the same
+  block program on its own stage's parameter slice (stacked layout),
+* stage hand-off is ``lax.ppermute`` over the ring — on TPU hardware this is a
+  neighbor ICI transfer that XLA's latency-hiding scheduler overlaps with the
+  block computation,
+* the clock-cycle loop (reference ``clock_cycles``, pipeline.py:49-65) becomes
+  a ``lax.scan`` over ``m + n - 1`` ticks: at tick ``t`` stage ``j`` computes
+  micro-batch ``t - j`` — identical cell scheduling, but the *compiler* sees
+  the whole pipeline and there is no per-tick host round-trip,
+* backward is ``jax.grad`` through the scan: XLA reverses the schedule
+  (transposed ``ppermute`` rings gradients backwards) — the explicit
+  reverse-schedule the reference builds from autograd-edge surgery emerges
+  from the scan transpose,
+* activation checkpointing is ``jax.checkpoint`` on the block: boundary
+  activations (the scan carries) are saved, block internals are recomputed —
+  the GPipe memory profile (reference checkpoint.py:1-19) expressed as a
+  remat policy,
+* data parallelism composes on a second mesh axis: batch sharded over
+  ``"dp"``, gradients ``psum``-reduced across it — replacing the reference
+  fork's RPC+CPU-staging distributed mode (torchgpipe/distributed/) with XLA
+  collectives over ICI/DCN.
+
+Constraints (vs the MPMD engine): stages must be *stacked* — same block
+structure with equal input/output shapes (transformer-style) — the batch must
+divide evenly by ``chunks`` × dp, and layer state must be empty (use the MPMD
+engine for BatchNorm-style stateful CNNs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchgpipe_tpu import microbatch
+from torchgpipe_tpu.layers import Layer
+
+Pytree = Any
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:  # older jax spelling
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+
+@dataclasses.dataclass
+class SpmdGPipe:
+    """GPipe over a stacked block, compiled as a single SPMD program.
+
+    Args:
+      block: the per-stage computation (use :func:`torchgpipe_tpu.layers.chain`
+        to build it from sub-layers).  Input and output specs must match.
+      n_stages: pipeline depth; must equal the ``pp`` mesh axis size.
+      mesh: ``jax.sharding.Mesh`` with at least the ``pp`` axis; optionally a
+        ``dp`` axis for data parallelism.
+      chunks: micro-batches per mini-batch (m).
+      loss_fn: ``loss_fn(output, target) -> scalar`` on gathered outputs.
+      pre / post: optional layers applied before stage 0 / after stage n-1
+        (e.g. embedding / LM head).  Their parameters are replicated over
+        ``pp``; their gradients are psum-shared.
+      checkpoint: 'always' (remat the block per cell — GPipe memory profile)
+        or 'never'.
+    """
+
+    block: Layer
+    n_stages: int
+    mesh: Mesh
+    chunks: int
+    loss_fn: Callable
+    pre: Optional[Layer] = None
+    post: Optional[Layer] = None
+    checkpoint: str = "always"
+    pp_axis: str = "pp"
+    dp_axis: Optional[str] = None
+
+    def __post_init__(self):
+        if self.pp_axis not in self.mesh.axis_names:
+            raise ValueError(f"mesh has no {self.pp_axis!r} axis: {self.mesh}")
+        if self.mesh.shape[self.pp_axis] != self.n_stages:
+            raise ValueError(
+                f"pp mesh axis size {self.mesh.shape[self.pp_axis]} != "
+                f"n_stages {self.n_stages}"
+            )
+        if self.dp_axis is not None and self.dp_axis not in self.mesh.axis_names:
+            raise ValueError(f"mesh has no {self.dp_axis!r} axis: {self.mesh}")
+        if self.checkpoint not in ("always", "never"):
+            raise ValueError("SPMD engine supports checkpoint='always'|'never'")
+
+        raw_apply = self.block.apply
+
+        def block_fn(params, x, rng, train):
+            y, _ = raw_apply(params, (), x, rng=rng, train=train)
+            return y
+
+        if self.checkpoint == "always":
+            block_fn = jax.checkpoint(block_fn, static_argnums=(3,))
+        self._block_fn = block_fn
+        self._train_step_fns: dict = {}  # keyed by use_rng
+        self._apply_fn = None
+
+    # ------------------------------------------------------------------ #
+
+    def init(self, rng: jax.Array, in_spec: Pytree) -> Pytree:
+        """Initialize {'pre', 'blocks', 'post'} params; blocks stacked on a
+        leading stage axis and sharded over ``pp``."""
+        params: dict = {}
+        spec = in_spec
+        if self.pre is not None:
+            p, s = self.pre.init(jax.random.fold_in(rng, 1000), spec)
+            self._check_stateless(s, "pre")
+            params["pre"] = p
+            spec, _ = jax.eval_shape(
+                lambda pp, x: self.pre.apply(
+                    pp, (), x, rng=jax.random.PRNGKey(0), train=True
+                ),
+                p,
+                _zeros(spec),
+            )
+
+        block_params = []
+        for j in range(self.n_stages):
+            p, s = self.block.init(jax.random.fold_in(rng, j), spec)
+            self._check_stateless(s, "block")
+            block_params.append(p)
+        out_spec, _ = jax.eval_shape(
+            lambda pp, x: self.block.apply(
+                pp, (), x, rng=jax.random.PRNGKey(0), train=True
+            ),
+            block_params[0],
+            _zeros(spec),
+        )
+        if jax.tree_util.tree_structure(out_spec) != jax.tree_util.tree_structure(spec) or any(
+            a.shape != b.shape or a.dtype != b.dtype
+            for a, b in zip(
+                jax.tree_util.tree_leaves(out_spec), jax.tree_util.tree_leaves(spec)
+            )
+        ):
+            raise ValueError(
+                "SPMD pipeline blocks must preserve activation shape/dtype "
+                f"(got {spec} -> {out_spec}); use the MPMD GPipe engine for "
+                "heterogeneous stages"
+            )
+        params["blocks"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *block_params
+        )
+
+        if self.post is not None:
+            p, s = self.post.init(jax.random.fold_in(rng, 2000), spec)
+            self._check_stateless(s, "post")
+            params["post"] = p
+
+        return self.place(params)
+
+    def place(self, params: dict) -> dict:
+        """Commit params to the mesh: blocks stage-sharded over ``pp``,
+        pre/post replicated."""
+        repl = NamedSharding(self.mesh, P())
+        stage = NamedSharding(self.mesh, P(self.pp_axis))
+        out = dict(params)
+        out["blocks"] = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, stage), params["blocks"]
+        )
+        for k in ("pre", "post"):
+            if k in params:
+                out[k] = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, repl), params[k]
+                )
+        return out
+
+    @staticmethod
+    def _check_stateless(state, what: str) -> None:
+        if jax.tree_util.tree_leaves(state):
+            raise ValueError(
+                f"SPMD engine requires stateless layers, but {what} carries "
+                "state (e.g. BatchNorm running stats). Use the MPMD GPipe "
+                "engine, or a stateless normalization (LayerNorm/RMSNorm)."
+            )
+
+    # ------------------------------------------------------------------ #
+    # the per-device program                                             #
+    # ------------------------------------------------------------------ #
+
+    def _local_pipeline(self, blocks_local, x_mb, rng, train: bool):
+        """Run the fill-drain schedule locally; returns stacked per-tick
+        outputs ``[T, b, ...]`` (garbage except where tick >= n-1 on the last
+        stage)."""
+        n, m = self.n_stages, self.chunks
+        stage = lax.axis_index(self.pp_axis)
+        params_local = jax.tree_util.tree_map(lambda a: a[0], blocks_local)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        T = m + n - 1
+
+        act0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape[1:], a.dtype), x_mb
+        )
+
+        def tick(act, t):
+            idx = jnp.clip(t, 0, m - 1)
+            inp0 = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, idx, 0, keepdims=False), x_mb
+            )
+            recv = jax.tree_util.tree_map(
+                lambda a: lax.ppermute(a, self.pp_axis, perm), act
+            )
+            x_in = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(stage == 0, a, b), inp0, recv
+            )
+            key = (
+                jax.random.fold_in(jax.random.fold_in(rng, t), stage)
+                if rng is not None
+                else None
+            )
+            y = self._block_fn(params_local, x_in, key, train)
+            return y, y
+
+        _, ys = lax.scan(tick, act0, jnp.arange(T))
+        return ys
+
+    def _outputs_from_ticks(self, ys):
+        """Slice micro-batch outputs [m, b, ...] from the tick stack."""
+        n = self.n_stages
+        return jax.tree_util.tree_map(lambda a: a[n - 1 :], ys)
+
+    # ------------------------------------------------------------------ #
+    # public entry points                                                #
+    # ------------------------------------------------------------------ #
+
+    def _data_specs(self):
+        batch_axes = (None, self.dp_axis) if self.dp_axis else (None,)
+        return P(*batch_axes)
+
+    def _apply_pre(self, pre_params, x_mb, rng, train: bool):
+        """Apply ``pre`` per micro-batch with independent keys (matching the
+        MPMD engine's per-micro-batch ``fold_in``)."""
+        if rng is not None:
+            base = jax.random.fold_in(rng, 0x7FFFFFFF)
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(self.chunks)
+            )
+            return jax.vmap(
+                lambda mb, k: self.pre.apply(pre_params, (), mb, rng=k, train=train)[0]
+            )(x_mb, keys)
+        return jax.vmap(
+            lambda mb: self.pre.apply(pre_params, (), mb, rng=None, train=train)[0]
+        )(x_mb)
+
+    def _build_train_step(self, use_rng: bool):
+        n = self.n_stages
+        data_spec = self._data_specs()
+
+        def local(params, x_mb, tgt_mb, rng=None):
+            stage = lax.axis_index(self.pp_axis)
+
+            def loss_of(params):
+                if self.pre is not None:
+                    x_in = self._apply_pre(params["pre"], x_mb, rng, True)
+                else:
+                    x_in = x_mb
+                ys = self._local_pipeline(params["blocks"], x_in, rng, True)
+                outs = self._outputs_from_ticks(ys)
+                gathered = microbatch.gather_stacked(outs)
+                if self.post is not None:
+                    gathered, _ = self.post.apply(
+                        params["post"], (), gathered,
+                        rng=jax.random.fold_in(rng, 0x7FFFFFFE) if rng is not None else None,
+                        train=True,
+                    )
+                tgt = microbatch.gather_stacked(tgt_mb)
+                l = self.loss_fn(gathered, tgt)
+                # LOCAL loss, nonzero only on the last stage.  Do NOT psum
+                # here: differentiating a replicated (psum'd) output would
+                # seed one cotangent per device and over-count gradients by
+                # the pp size — the transposed ppermutes already carry the
+                # cross-stage cotangents back along the ring.
+                return jnp.where(stage == n - 1, l, 0.0)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            loss = lax.psum(loss, self.pp_axis)  # broadcast for reporting
+            # pre/post grads land on the consuming stage's lane only; share
+            # across pp.  Block grads are per-stage local by construction.
+            if self.pre is not None:
+                grads["pre"] = lax.psum(grads["pre"], self.pp_axis)
+            if self.post is not None:
+                grads["post"] = lax.psum(grads["post"], self.pp_axis)
+            if self.dp_axis:
+                loss = lax.pmean(loss, self.dp_axis)
+                grads = lax.pmean(grads, self.dp_axis)
+            return loss, grads
+
+        param_specs = {"blocks": P(self.pp_axis)}
+        if self.pre is not None:
+            param_specs["pre"] = P()
+        if self.post is not None:
+            param_specs["post"] = P()
+
+        if use_rng:
+            in_specs = (param_specs, data_spec, data_spec, P())
+        else:
+            in_specs = (param_specs, data_spec, data_spec)
+        mapped = _shard_map(
+            local,
+            self.mesh,
+            in_specs=in_specs,
+            out_specs=(P(), param_specs),
+        )
+        return jax.jit(mapped)
+
+    def _check_batch(self, x) -> None:
+        dp = self.mesh.shape[self.dp_axis] if self.dp_axis else 1
+        b = microbatch.batch_size(x)
+        if b % (self.chunks * dp) != 0:
+            raise ValueError(
+                f"batch size {b} must be divisible by chunks*dp = "
+                f"{self.chunks}*{dp} = {self.chunks * dp} for the SPMD engine "
+                "(pad the batch, or use the MPMD GPipe engine for ragged "
+                "micro-batches)"
+            )
+
+    def train_step(self, params, x, target, rng=None):
+        """One pipelined forward+backward; returns ``(loss, grads)``.
+
+        ``x``/``target`` are full mini-batches ``[B, ...]`` with
+        ``B % (chunks * dp) == 0``.  Pass ``rng`` if any layer uses
+        randomness (dropout raises loudly without it, matching the MPMD
+        engine); omit it for deterministic models.
+        """
+        self._check_batch(x)
+        use_rng = rng is not None
+        if use_rng not in self._train_step_fns:
+            self._train_step_fns[use_rng] = self._build_train_step(use_rng)
+        x_mb = microbatch.scatter_stacked(x, self.chunks)
+        tgt_mb = microbatch.scatter_stacked(target, self.chunks)
+        if use_rng:
+            return self._train_step_fns[use_rng](params, x_mb, tgt_mb, rng)
+        return self._train_step_fns[use_rng](params, x_mb, tgt_mb)
+
+    def _build_apply(self):
+        n = self.n_stages
+        data_spec = self._data_specs()
+
+        def local(params, x_mb):
+            stage = lax.axis_index(self.pp_axis)
+            if self.pre is not None:
+                x_mb = self._apply_pre(params["pre"], x_mb, None, False)
+            ys = self._local_pipeline(params["blocks"], x_mb, None, False)
+            outs = self._outputs_from_ticks(ys)  # [m, b_local, ...]
+            if self.post is not None:
+                outs = jax.vmap(
+                    lambda mb: self.post.apply(params["post"], (), mb, rng=None, train=False)[0]
+                )(outs)
+            # Only the last stage holds real outputs; broadcast over pp.
+            masked = jax.tree_util.tree_map(
+                lambda a: jnp.where(stage == n - 1, a, jnp.zeros_like(a)), outs
+            )
+            return jax.tree_util.tree_map(
+                lambda a: lax.psum(a, self.pp_axis), masked
+            )
+
+        param_specs = {"blocks": P(self.pp_axis)}
+        if self.pre is not None:
+            param_specs["pre"] = P()
+        if self.post is not None:
+            param_specs["post"] = P()
+
+        mapped = _shard_map(
+            local,
+            self.mesh,
+            in_specs=(param_specs, data_spec),
+            out_specs=data_spec,
+        )
+        return jax.jit(mapped)
+
+    def apply(self, params, x):
+        """Pipelined inference forward; returns gathered outputs ``[B, ...]``."""
+        self._check_batch(x)
+        if self._apply_fn is None:
+            self._apply_fn = self._build_apply()
+        x_mb = microbatch.scatter_stacked(x, self.chunks)
+        out_mb = self._apply_fn(params, x_mb)
+        return microbatch.gather_stacked(out_mb)
+
+
+def _zeros(spec):
+    return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
+
+
+def make_mesh(
+    n_stages: int, dp: int = 1, *, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a ('pp', 'dp') mesh from the available devices."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_stages * dp
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(n_stages, dp)
+    return Mesh(arr, ("pp", "dp"))
