@@ -1,5 +1,7 @@
 """Transformer model family: MPMD pipeline transparency + SPMD stage stacking."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -92,7 +94,7 @@ def test_llama_spmd_runs(cpu_devices):
 
 def test_graft_entry_single_chip():
     import sys
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import __graft_entry__
 
     fn, args = __graft_entry__.entry()
@@ -102,7 +104,7 @@ def test_graft_entry_single_chip():
 
 def test_graft_dryrun(cpu_devices):
     import sys
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)

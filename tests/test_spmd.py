@@ -153,6 +153,45 @@ def test_spmd_rejects_shape_changing_block(cpu_devices):
         pipe.init(jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, 8), jnp.float32))
 
 
+def test_spmd_replicated_loss_matches_sharded(cpu_devices):
+    """loss_reduction=None (replicated head/loss) must agree with the
+    default sharded path and with the oracle."""
+    n, dim = 4, 8
+    mesh = make_mesh(n, 1, devices=cpu_devices)
+    block = make_block(dim)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, dim))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (16, dim))
+
+    losses, grad_sets = [], []
+    for reduction in ("mean", None):
+        pipe = SpmdGPipe(
+            block, n, mesh, chunks=4, loss_fn=mse, loss_reduction=reduction
+        )
+        params = pipe.init(
+            jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, dim), jnp.float32)
+        )
+        loss, grads = pipe.train_step(params, x, tgt)
+        losses.append(float(loss))
+        grad_sets.append(grads["blocks"])
+
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        grad_sets[0],
+        grad_sets[1],
+    )
+
+
+def test_spmd_rejects_skip_block(cpu_devices):
+    from torchgpipe_tpu.skip import stash
+
+    mesh = make_mesh(4, 1, devices=cpu_devices)
+    with pytest.raises(ValueError, match="skip"):
+        SpmdGPipe(stash("a"), 4, mesh, chunks=2, loss_fn=mse)
+
+
 def test_spmd_rejects_stateful_block(cpu_devices):
     from torchgpipe_tpu.ops import batch_norm
 

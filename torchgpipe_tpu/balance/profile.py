@@ -26,22 +26,22 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from torchgpipe_tpu.layers import Layer
+from torchgpipe_tpu.layers import Layer, apply_layer
 
 Pytree = Any
 
 
 def _layer_fwd_bwd(layer: Layer):
-    """Build a jittable forward+backward for one layer."""
+    """Build a jittable forward+backward for one layer (dispatch shared with
+    the engines via :func:`~torchgpipe_tpu.layers.apply_layer`)."""
 
     def run(params, state, x, pops):
         def f(p, xx, pp):
-            key = jax.random.PRNGKey(0)
-            if layer.stash or layer.pop:
-                y, stashed, _ = layer.apply(p, state, xx, pops=pp, rng=key, train=True)
-                return y, stashed
-            y, _ = layer.apply(p, state, xx, rng=key, train=True)
-            return y, {}
+            skips = dict(pp)
+            y, _ = apply_layer(
+                layer, p, state, xx, skips, rng=jax.random.PRNGKey(0), train=True
+            )
+            return y, skips  # after apply_layer, skips holds the stashes
 
         (y, stashed), pull = jax.vjp(f, params, x, pops)
         cot = jax.tree_util.tree_map(jnp.ones_like, (y, stashed))
@@ -64,15 +64,11 @@ def _thread_inputs(
     x = sample
     key = jax.random.PRNGKey(0)
     for i, layer in enumerate(layers):
-        pops = {k: skips.pop(k) for k in layer.pop}
+        pops = {k: skips[k] for k in layer.pop}
         inputs.append((x, pops))
-        if layer.stash or layer.pop:
-            x, stashed, _ = layer.apply(
-                params[i], states[i], x, pops=pops, rng=key, train=True
-            )
-            skips.update(stashed)
-        else:
-            x, _ = layer.apply(params[i], states[i], x, rng=key, train=True)
+        x, _ = apply_layer(
+            layers[i], params[i], states[i], x, skips, rng=key, train=True
+        )
     return inputs
 
 

@@ -117,11 +117,22 @@ def chain(sub: Sequence[Layer], name: str = "chain") -> Layer:
         for k in l.pop:
             if k not in stashed_names:
                 unresolved_pops.append(k)
+            else:
+                stashed_names.discard(k)
         stashed_names.update(l.stash)
     if unresolved_pops:
         raise ValueError(
             f"chain {name!r} has pops with no matching stash inside the chain: "
             f"{unresolved_pops}"
+        )
+    if stashed_names:
+        # The composed Layer declares stash=(), so leftover stashes would be
+        # silently dropped instead of routed to a later stage — fail fast.
+        raise ValueError(
+            f"chain {name!r} has stashes never popped inside the chain: "
+            f"{sorted(stashed_names)}; a skip crossing the chain boundary "
+            "must be declared on the chain itself (use the flat layer list "
+            "with GPipe for cross-stage skips)"
         )
 
     def init(rng, in_spec):

@@ -57,6 +57,13 @@ def _normal(rng, shape, std, dtype):
     return (std * jax.random.normal(rng, shape)).astype(dtype)
 
 
+def _rms(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """RMS normalization over the trailing dim (f32 accumulation)."""
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
+    return y * scale.astype(x.dtype)
+
+
 def rms_norm(dim: int, *, eps: float = 1e-5, name: str = "rmsnorm") -> Layer:
     def init(rng, in_spec):
         del rng, in_spec
@@ -64,9 +71,7 @@ def rms_norm(dim: int, *, eps: float = 1e-5, name: str = "rmsnorm") -> Layer:
 
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
-        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
-        y = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
-        return y * params["scale"].astype(x.dtype), state
+        return _rms(x, params["scale"], eps), state
 
     return Layer(name=name, init=init, apply=apply)
 
@@ -119,17 +124,11 @@ def transformer_block(cfg: TransformerConfig, *, name: str = "block") -> Layer:
         }
         return params, ()
 
-    def norm(x, scale):
-        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
-        return (x * jax.lax.rsqrt(var + cfg.norm_eps).astype(x.dtype)) * scale.astype(
-            x.dtype
-        )
-
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
         b, s, _ = x.shape
 
-        h = norm(x, params["ln1"])
+        h = _rms(x, params["ln1"], cfg.norm_eps)
         q = (h @ params["wq"]).reshape(b, s, nh, hd)
         k = (h @ params["wk"]).reshape(b, s, nkv, hd)
         v = (h @ params["wv"]).reshape(b, s, nkv, hd)
@@ -146,7 +145,7 @@ def transformer_block(cfg: TransformerConfig, *, name: str = "block") -> Layer:
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, nh * hd)
         x = x + attn @ params["wo"]
 
-        h = norm(x, params["ln2"])
+        h = _rms(x, params["ln2"], cfg.norm_eps)
         gate = jax.nn.silu(h @ params["w_gate"])
         up = h @ params["w_up"]
         x = x + (gate * up) @ params["w_down"]
@@ -179,10 +178,7 @@ def lm_head(cfg: TransformerConfig, *, name: str = "head") -> Layer:
 
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
-        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
-        h = (x * jax.lax.rsqrt(var + cfg.norm_eps).astype(x.dtype)) * params[
-            "scale"
-        ].astype(x.dtype)
+        h = _rms(x, params["scale"], cfg.norm_eps)
         return h @ params["w"], state
 
     return Layer(name=name, init=init, apply=apply)

@@ -79,6 +79,11 @@ class SpmdGPipe:
         ``pp``; their gradients are psum-shared.
       checkpoint: 'always' (remat the block per cell — GPipe memory profile)
         or 'never'.
+      loss_reduction: 'mean' (default) or 'sum' declares that ``post`` and
+        ``loss_fn`` decompose over batch elements with that reduction,
+        letting the engine shard the head + loss over the ``pp`` axis (1/n
+        of the logits per device).  Pass ``None`` for a non-decomposable
+        loss — the head/loss then run replicated on the full batch.
     """
 
     block: Layer
@@ -91,10 +96,21 @@ class SpmdGPipe:
     checkpoint: str = "always"
     pp_axis: str = "pp"
     dp_axis: Optional[str] = None
+    loss_reduction: Optional[str] = "mean"
 
     def __post_init__(self):
         if self.pp_axis not in self.mesh.axis_names:
             raise ValueError(f"mesh has no {self.pp_axis!r} axis: {self.mesh}")
+        for what, lyr in (("block", self.block), ("pre", self.pre), ("post", self.post)):
+            if lyr is not None and (lyr.stash or lyr.pop):
+                raise ValueError(
+                    f"SPMD engine does not support cross-stage skip "
+                    f"connections, but {what} layer {lyr.name!r} declares "
+                    "stash/pop. Resolve the skips inside a chain() stage, or "
+                    "use the MPMD GPipe engine for cross-stage skip routing."
+                )
+        if self.loss_reduction not in ("mean", "sum", None):
+            raise ValueError("loss_reduction must be 'mean', 'sum' or None")
         if self.mesh.shape[self.pp_axis] != self.n_stages:
             raise ValueError(
                 f"pp mesh axis size {self.mesh.shape[self.pp_axis]} != "
@@ -278,13 +294,61 @@ class SpmdGPipe:
                 ys = self._local_pipeline(params["blocks"], x_in, rng, True)
                 outs = self._outputs_from_ticks(ys)
                 gathered = microbatch.gather_stacked(outs)
+                tgt = microbatch.gather_stacked(tgt_mb)
+                B = jax.tree_util.tree_leaves(gathered)[0].shape[0]
+                post_rng = (
+                    jax.random.fold_in(rng, 0x7FFFFFFE) if rng is not None else None
+                )
+                if self.loss_reduction is not None and B % n == 0 and n > 1:
+                    # Shard the post/loss phase over pp: the pipeline's real
+                    # outputs exist only on the last stage, so scatter the
+                    # batch in n slices (one ppermute each, size/n), run the
+                    # head + loss on 1/n of the batch per stage, and sum the
+                    # per-slice losses.  This cuts head FLOPs and the
+                    # [B, ..., vocab]-sized logits memory to 1/n per device.
+                    # Requires loss_fn (and post) to decompose over batch
+                    # elements — 'mean'/'sum' declares which way.
+                    per = B // n
+                    zeroed = jax.tree_util.tree_map(
+                        lambda a: jnp.where(stage == n - 1, a, jnp.zeros_like(a)),
+                        gathered,
+                    )
+                    my = None
+                    for j in range(n):
+                        sl = jax.tree_util.tree_map(
+                            lambda a: lax.dynamic_slice_in_dim(a, j * per, per, 0),
+                            zeroed,
+                        )
+                        # Single-pair ppermute: well-defined transpose, so the
+                        # backward routes each slice's cotangent straight back
+                        # to the last stage (non-destinations receive zeros).
+                        recv = jax.tree_util.tree_map(
+                            lambda a: lax.ppermute(a, self.pp_axis, [(n - 1, j)]),
+                            sl,
+                        )
+                        my = (
+                            recv
+                            if my is None
+                            else jax.tree_util.tree_map(jnp.add, my, recv)
+                        )
+                    tgt_my = jax.tree_util.tree_map(
+                        lambda a: lax.dynamic_slice_in_dim(a, stage * per, per, 0),
+                        tgt,
+                    )
+                    if self.post is not None:
+                        my, _ = self.post.apply(
+                            params["post"], (), my, rng=post_rng, train=True
+                        )
+                    l = self.loss_fn(my, tgt_my)
+                    if self.loss_reduction == "mean":
+                        l = l / n
+                    # LOCAL per-slice loss; the psum after value_and_grad
+                    # reassembles the global loss for reporting.
+                    return l
                 if self.post is not None:
                     gathered, _ = self.post.apply(
-                        params["post"], (), gathered,
-                        rng=jax.random.fold_in(rng, 0x7FFFFFFE) if rng is not None else None,
-                        train=True,
+                        params["post"], (), gathered, rng=post_rng, train=True
                     )
-                tgt = microbatch.gather_stacked(tgt_mb)
                 l = self.loss_fn(gathered, tgt)
                 # LOCAL loss, nonzero only on the last stage.  Do NOT psum
                 # here: differentiating a replicated (psum'd) output would
