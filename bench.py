@@ -112,7 +112,10 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     batch = x.shape[0]
-    samples_per_sec = batch * n_iters / dt
+    # Per-chip normalization: the pipeline spans n_stages chips (stages wrap
+    # around the devices actually present, so chips used = min of the two).
+    n_chips = min(n_stages, len(devices))
+    samples_per_sec = batch * n_iters / dt / n_chips
     print(json.dumps({
         "metric": f"train samples/sec/chip [{name}, {platform}]",
         "value": round(samples_per_sec, 3),

@@ -1,0 +1,190 @@
+"""Model-zoo tests: the CNN families run under the pipeline and match the
+un-pipelined oracle (reference test pattern: tests/test_transparency.py:7-42
+applied to the benchmark models of SURVEY.md §2.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.gpipe import GPipe
+from torchgpipe_tpu.layers import sequential_apply, sequential_init
+from torchgpipe_tpu.models import amoebanetd, build_resnet, unet
+
+
+def _even_balance(n, k):
+    base, rem = divmod(n, k)
+    return [base + (1 if j >= k - rem else 0) for j in range(k)]
+
+
+def _flatten_to_host(per_stage):
+    """Flatten per-stage pytrees and co-locate on device 0 for the oracle."""
+    flat = [leaf for stage in per_stage for leaf in stage]
+    return jax.device_put(flat, jax.devices()[0])
+
+
+def _loss(out, tgt):
+    logits = out.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits.reshape(-1, logits.shape[-1]))
+    return -jnp.mean(logp[jnp.arange(logp.shape[0]), tgt.reshape(-1)])
+
+
+def _oracle(layers, flat_params, flat_state, x, chunks, key, train=True):
+    """Micro-batched sequential oracle with the engine's rng convention.
+
+    Transparency contract: the pipeline computes exactly what the same model
+    computes run micro-batch by micro-batch (batch-statistics layers like
+    BatchNorm see micro-batches in both cases — the reference has the same
+    semantics, which is *why* DeferredBatchNorm exists, torchgpipe/batchnorm.py:1-16).
+    State (running stats) threads across micro-batches in order.
+    """
+    from torchgpipe_tpu import microbatch
+
+    mbs = microbatch.scatter(x, chunks)
+    state = flat_state
+    outs = []
+    for i, mb in enumerate(mbs):
+        key_i = jax.random.fold_in(key, i) if key is not None else None
+        y, state = sequential_apply(
+            layers, flat_params, state, mb, rng=key_i, train=train
+        )
+        outs.append(y)
+    return microbatch.gather(outs), state
+
+
+def _check_transparency(layers, x, n_stages, chunks, checkpoint="except_last"):
+    """Pipeline forward == micro-batched sequential forward."""
+    rng = jax.random.PRNGKey(0)
+    in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    model = GPipe(
+        layers,
+        balance=_even_balance(len(layers), n_stages),
+        chunks=chunks,
+        checkpoint=checkpoint,
+    )
+    params, state = model.init(rng, in_spec)
+
+    flat_params = _flatten_to_host(params)
+    flat_state = _flatten_to_host(state)
+    key = jax.random.PRNGKey(42)
+
+    out, _ = model.apply(params, state, x, rng=key, train=True)
+    ref, _ = _oracle(layers, flat_params, flat_state, x, chunks, key)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+    return model, params, state
+
+
+def test_amoebanet_transparency():
+    layers = amoebanetd(num_classes=10, num_layers=3, num_filters=16)
+    x = jax.random.normal(jax.random.PRNGKey(7), (4, 32, 32, 3))
+    _check_transparency(layers, x, n_stages=3, chunks=2)
+
+
+def test_amoebanet_grads_match_unpipelined():
+    layers = amoebanetd(num_classes=10, num_layers=3, num_filters=16)
+    x = jax.random.normal(jax.random.PRNGKey(7), (4, 32, 32, 3))
+    y = jnp.array([0, 1, 2, 3], jnp.int32)
+    model, params, state = _check_transparency(layers, x, n_stages=2, chunks=2)
+
+    key = jax.random.PRNGKey(42)
+    loss, grads, _, _ = model.value_and_grad(
+        params, state, x, y, _loss, rng=key
+    )
+
+    flat_params = _flatten_to_host(params)
+    flat_state = _flatten_to_host(state)
+
+    def ref_loss(ps):
+        out, _ = _oracle(layers, ps, flat_state, x, 2, key)
+        return _loss(out, y)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(flat_params)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-4)
+    flat_g = [g for stage in grads for g in stage]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(flat_g), jax.tree_util.tree_leaves(ref_g)
+    ):
+        a, b = np.asarray(a), np.asarray(b)
+        scale = np.abs(b).max() + 1e-9
+        assert np.abs(a - b).max() / scale < 5e-3, (a.shape, np.abs(a - b).max(), scale)
+
+
+def test_amoebanet_deferred_batch_norm_converts_compound_cells():
+    layers = amoebanetd(num_classes=10, num_layers=3, num_filters=16)
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 32, 32, 3))
+    y = jnp.array([0, 1, 2, 3], jnp.int32)
+    model = GPipe(
+        layers,
+        balance=_even_balance(len(layers), 2),
+        chunks=2,
+        deferred_batch_norm=True,
+    )
+    params, state = model.init(jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype))
+    # Deferred BN adds accumulators into cell state — prove conversion reached
+    # batch-norms nested inside compound cells.
+    state_leaves = jax.tree_util.tree_leaves(state)
+    assert any(leaf.dtype == jnp.int32 for leaf in state_leaves), (
+        "expected deferred-BN counters inside converted cell state"
+    )
+    loss, grads, new_state, _ = model.value_and_grad(
+        params, state, x, y, _loss, rng=jax.random.PRNGKey(1)
+    )
+    assert np.isfinite(float(loss))
+
+
+def test_resnet_transparency():
+    layers = build_resnet([1, 1, 1, 1], num_classes=10, base_width=8)
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 32, 32, 3))
+    _check_transparency(layers, x, n_stages=4, chunks=2)
+
+
+def test_resnet_cut_inside_block():
+    # Partition boundary lands inside a bottleneck: the residual must travel
+    # across stages through the skip layout (reference capability:
+    # torchgpipe/skip/portal.py routing).
+    layers = build_resnet([1, 1, 1, 1], num_classes=10, base_width=8)
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 32, 32, 3))
+    n = len(layers)
+    # Deliberately odd split so stash/pop of some block straddle stages.
+    balance = [7, n - 7]
+    model = GPipe(layers, balance=balance, chunks=2)
+    params, state = model.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    out, _ = model.apply(params, state, x, rng=jax.random.PRNGKey(42), train=True)
+    flat_params = _flatten_to_host(params)
+    flat_state = _flatten_to_host(state)
+    ref, _ = _oracle(
+        layers, flat_params, flat_state, x, 2, jax.random.PRNGKey(42)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_unet_transparency():
+    layers = unet(depth=2, num_convs=1, base_channels=4)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 16, 3))
+    # U-Net has dropout: rng-dependent. Pipeline folds rng per layer index —
+    # the oracle does the same, so outputs must still match exactly.
+    _check_transparency(layers, x, n_stages=4, chunks=2)
+
+
+def test_unet_odd_input_padding():
+    # Odd spatial size: decoder upsample overshoots/undershoots the encoder
+    # map; PopCat pads (reference: benchmarks/models/unet/__init__.py:30-40).
+    layers = unet(depth=2, num_convs=1, base_channels=4)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 15, 15, 3))
+    model = GPipe(layers, balance=[len(layers)], chunks=1)
+    params, state = model.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    out, _ = model.apply(params, state, x, rng=jax.random.PRNGKey(1), train=False)
+    assert out.shape[0] == 2 and out.shape[-1] == 1
+
+
+@pytest.mark.parametrize("checkpoint", ["always", "never"])
+def test_amoebanet_checkpoint_modes(checkpoint):
+    layers = amoebanetd(num_classes=10, num_layers=3, num_filters=16)
+    x = jax.random.normal(jax.random.PRNGKey(7), (4, 32, 32, 3))
+    _check_transparency(layers, x, n_stages=2, chunks=2, checkpoint=checkpoint)

@@ -80,6 +80,112 @@ def stateless(name: str, fn: Callable[[Any], Any]) -> Layer:
     return Layer(name=name, init=init, apply=apply)
 
 
+def identity(name: str = "identity") -> Layer:
+    """Pass-through layer (reference pattern: nn.Identity in
+    benchmarks/models/amoebanet/operations.py:43-49)."""
+    return stateless(name, lambda x: x)
+
+
+def structured(
+    name: str,
+    children: "dict[str, Layer]",
+    fwd: Callable,
+    *,
+    rebuild: Optional[Callable] = None,
+) -> Layer:
+    """Compound layer: an arbitrary DAG wiring of named sub-layers.
+
+    ``fwd(run, x) -> y`` expresses the wiring, where ``run(child_name, x)``
+    applies the named child exactly once.  This is how non-sequential model
+    cells (AmoebaNet NAS cells, FactorizedReduce, residual projections) are
+    built without a module system: parameters/state are dicts keyed by child
+    name.  The reference reaches for ``nn.Module`` composition here
+    (reference: benchmarks/models/amoebanet/__init__.py:65-135).
+
+    ``init`` runs the same wiring with zero inputs, initializing each child
+    from the spec of the value actually reaching it — so builders never have
+    to hand-propagate intermediate shapes.  The layer carries compound
+    ``meta`` so structural transforms (e.g. deferred batch-norm conversion)
+    can recurse into the children and rebuild the cell.
+    """
+    children = dict(children)
+    order = {k: i for i, k in enumerate(children)}
+
+    def init(rng, in_spec):
+        # Phase 1: abstractly trace the wiring to learn each child's input
+        # spec — no device compute, even for full-size models.
+        in_specs: dict = {}
+
+        def trace(x, trace_rng):
+            def run(cname, xv):
+                child = children[cname]
+                if cname in in_specs:
+                    raise ValueError(
+                        f"structured layer {name!r} applies child {cname!r} twice"
+                    )
+                spec = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), xv
+                )
+                in_specs[cname] = spec
+                p, s = child.init(
+                    jax.random.fold_in(trace_rng, order[cname]), spec
+                )
+                y, _ = child.apply(p, s, xv, rng=None, train=False)
+                return y
+
+            fwd(run, x)
+            return ()
+
+        x = jax.tree_util.tree_map(
+            lambda sd: jnp.zeros(sd.shape, sd.dtype), in_spec
+        )
+        jax.eval_shape(trace, x, rng)
+        missing = set(children) - set(in_specs)
+        if missing:
+            raise ValueError(
+                f"structured layer {name!r} never applied children {sorted(missing)}"
+            )
+
+        # Phase 2: concrete per-child init from the recorded specs.
+        params: dict = {}
+        state: dict = {}
+        for cname, child in children.items():
+            p, s = child.init(
+                jax.random.fold_in(rng, order[cname]), in_specs[cname]
+            )
+            params[cname], state[cname] = p, s
+        return params, state
+
+    def apply(params, state, x, *, rng=None, train=True):
+        st = state if state else {k: () for k in children}
+        new_state: dict = {}
+
+        def run(cname, x):
+            child = children[cname]
+            crng = (
+                jax.random.fold_in(rng, order[cname]) if rng is not None else None
+            )
+            y, ns = child.apply(
+                params[cname], st[cname], x, rng=crng, train=train
+            )
+            new_state[cname] = ns
+            return y
+
+        y = fwd(run, x)
+        return y, new_state
+
+    if rebuild is None:
+        def rebuild(new_children):
+            return structured(name, new_children, fwd)
+
+    return Layer(
+        name=name,
+        init=init,
+        apply=apply,
+        meta={"kind": "compound", "children": children, "rebuild": rebuild},
+    )
+
+
 def named(layers: Sequence[Layer]) -> List[Layer]:
     """Disambiguate duplicate layer names by suffixing an index.
 
@@ -149,7 +255,16 @@ def chain(sub: Sequence[Layer], name: str = "chain") -> Layer:
         )
         return y, tuple(new_states)
 
-    return Layer(name=name, init=init, apply=apply)
+    return Layer(
+        name=name,
+        init=init,
+        apply=apply,
+        meta={
+            "kind": "compound",
+            "children": list(sub),
+            "rebuild": lambda new_sub: chain(new_sub, name),
+        },
+    )
 
 
 def _infer_layer(layer: Layer, params, state, in_spec: Spec, pops_spec):

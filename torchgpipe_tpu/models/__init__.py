@@ -4,3 +4,7 @@ Counterpart of the reference's ``benchmarks/models`` zoo (sequential
 ResNet-101, U-Net, AmoebaNet-D; SURVEY.md §2.4), extended with the
 transformer/Llama family for the SPMD flagship path.
 """
+
+from torchgpipe_tpu.models.amoebanet import amoebanetd  # noqa: F401
+from torchgpipe_tpu.models.resnet import build_resnet, resnet50, resnet101  # noqa: F401
+from torchgpipe_tpu.models.unet import unet  # noqa: F401

@@ -1,0 +1,162 @@
+"""ResNet as a *flat sequential* layer list with @skippable-style residuals.
+
+Capability parity with the reference's sequential ResNet-101
+(reference: benchmarks/models/resnet/__init__.py:18-92,
+bottleneck.py:31-80): every bottleneck block becomes ~10 flat layers whose
+residual travels through the skip subsystem under a per-block
+:class:`~torchgpipe_tpu.skip.Namespace`, so the pipeline partitioner is free
+to cut *inside* a block and the skip layout routes the identity across
+stages.
+
+TPU-native: NHWC layout, :func:`lax.conv_general_dilated` on the MXU,
+pure-functional params/state.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+from torchgpipe_tpu.layers import Layer, chain, named
+from torchgpipe_tpu.ops import (
+    batch_norm,
+    conv2d,
+    dense,
+    global_avg_pool,
+    max_pool2d,
+    relu,
+)
+from torchgpipe_tpu.skip import Namespace, skip_key, stash
+
+__all__ = ["build_resnet", "resnet101", "resnet50"]
+
+
+def _residual(
+    ns: Namespace,
+    downsample: Optional[Layer],
+    in_channels: int,
+    name: str = "residual",
+) -> Layer:
+    """Pop the stashed identity, optionally project it, and add.
+
+    The reference's ``Residual`` skippable owns the downsample module
+    (reference: benchmarks/models/resnet/bottleneck.py:38-51); likewise this
+    layer owns the projection parameters.  ``in_channels`` is the stashed
+    tensor's channel count, needed because a layer's ``init`` only sees the
+    main-path input spec.
+    """
+    key = skip_key(ns, "identity")
+
+    def init(rng, in_spec):
+        if downsample is None:
+            return (), ()
+        leaf = jax.tree_util.tree_leaves(in_spec)[0]
+        fake = jax.ShapeDtypeStruct((1, 1, 1, in_channels), leaf.dtype)
+        return downsample.init(rng, fake)
+
+    def apply(params, state, x, *, pops, rng=None, train=True):
+        ident = pops[key]
+        if downsample is None:
+            return x + ident, {}, state
+        ident, new_state = downsample.apply(
+            params, state, ident, rng=rng, train=train
+        )
+        return x + ident, {}, new_state
+
+    # Compound meta so structural transforms (deferred batch-norm) reach the
+    # batch-norm inside the projection (the reference converts recursively
+    # over child modules, torchgpipe/batchnorm.py:123-155).
+    meta = None
+    if downsample is not None:
+        meta = {
+            "kind": "compound",
+            "children": {"down": downsample},
+            "rebuild": lambda ch: _residual(ns, ch["down"], in_channels, name),
+        }
+
+    return Layer(name=name, init=init, apply=apply, pop=(key,), meta=meta)
+
+
+def bottleneck(
+    inplanes: int,
+    planes: int,
+    stride: int = 1,
+    downsample: Optional[Layer] = None,
+    name: str = "block",
+) -> List[Layer]:
+    """One bottleneck block as flat layers
+    (reference: benchmarks/models/resnet/bottleneck.py:54-80)."""
+    ns = Namespace()
+    pad1 = ((1, 1), (1, 1))
+    return [
+        stash("identity", ns=ns, name=f"{name}_identity"),
+        conv2d(planes, (1, 1), name=f"{name}_conv1"),
+        batch_norm(name=f"{name}_bn1"),
+        relu(f"{name}_relu1"),
+        conv2d(planes, (3, 3), strides=(stride, stride), padding=pad1,
+               name=f"{name}_conv2"),
+        batch_norm(name=f"{name}_bn2"),
+        relu(f"{name}_relu2"),
+        conv2d(planes * 4, (1, 1), name=f"{name}_conv3"),
+        batch_norm(name=f"{name}_bn3"),
+        _residual(ns, downsample, inplanes, name=f"{name}_residual"),
+        relu(f"{name}_relu3"),
+    ]
+
+
+def build_resnet(
+    blocks: List[int],
+    num_classes: int = 1000,
+    base_width: int = 64,
+) -> List[Layer]:
+    """Build a ResNet as one flat sequential layer list
+    (reference: benchmarks/models/resnet/__init__.py:18-92).
+
+    ``base_width`` scales the whole network down for tests (the reference is
+    fixed at 64).
+    """
+    inplanes = base_width
+
+    def make_group(planes: int, n: int, stride: int, gname: str) -> List[Layer]:
+        nonlocal inplanes
+        downsample = None
+        if stride != 1 or inplanes != planes * 4:
+            downsample = chain(
+                [
+                    conv2d(planes * 4, (1, 1), strides=(stride, stride)),
+                    batch_norm(),
+                ],
+                f"{gname}_downsample",
+            )
+        out = bottleneck(inplanes, planes, stride, downsample, f"{gname}_b1")
+        inplanes = planes * 4
+        for i in range(1, n):
+            out += bottleneck(inplanes, planes, name=f"{gname}_b{i + 1}")
+        return out
+
+    w = base_width
+    layers: List[Layer] = [
+        conv2d(w, (7, 7), strides=(2, 2), padding=((3, 3), (3, 3)), name="conv1"),
+        batch_norm(name="bn1"),
+        relu("relu"),
+        max_pool2d((3, 3), (2, 2), padding=((1, 1), (1, 1)), name="maxpool"),
+    ]
+    layers += make_group(w, blocks[0], 1, "layer1")
+    layers += make_group(w * 2, blocks[1], 2, "layer2")
+    layers += make_group(w * 4, blocks[2], 2, "layer3")
+    layers += make_group(w * 8, blocks[3], 2, "layer4")
+    layers += [
+        global_avg_pool("avgpool"),
+        dense(num_classes, name="fc"),
+    ]
+    return named(layers)
+
+
+def resnet101(num_classes: int = 1000, **kwargs) -> List[Layer]:
+    """Sequential ResNet-101 (reference: benchmarks/models/resnet/__init__.py:96-98)."""
+    return build_resnet([3, 4, 23, 3], num_classes, **kwargs)
+
+
+def resnet50(num_classes: int = 1000, **kwargs) -> List[Layer]:
+    return build_resnet([3, 4, 6, 3], num_classes, **kwargs)

@@ -1,0 +1,119 @@
+"""Simplified U-Net as a flat sequential layer list with long skip
+connections through the skip subsystem.
+
+Capability parity with the reference's sequential U-Net
+(reference: benchmarks/models/unet/__init__.py:74-148): ``depth`` encoder
+blocks stash their feature maps under per-depth namespaces; the mirrored
+decoder blocks pop and concatenate them.  Stash and pop can land on
+different pipeline stages — the skip layout then routes the tensor directly
+stash-stage → pop-stage (the capability the reference's portals provide).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from torchgpipe_tpu.layers import Layer, named
+from torchgpipe_tpu.ops import (
+    conv2d,
+    dropout2d,
+    instance_norm,
+    leaky_relu,
+    max_pool2d,
+    upsample2d,
+)
+from torchgpipe_tpu.skip import Namespace, skippable, stash
+
+__all__ = ["unet"]
+
+
+def _conv_block(out_ch: int, name: str) -> List[Layer]:
+    """conv → spatial dropout → instance norm → leaky relu
+    (reference: benchmarks/models/unet/__init__.py:42-49)."""
+    pad1 = ((1, 1), (1, 1))
+    return [
+        conv2d(out_ch, (3, 3), padding=pad1, name=f"{name}_conv"),
+        dropout2d(0.1, name=f"{name}_dropout"),
+        instance_norm(name=f"{name}_norm"),
+        leaky_relu(0.01, name=f"{name}_relu"),
+    ]
+
+
+def _stacked_convs(mid_ch: int, out_ch: int, num_convs: int, name: str) -> List[Layer]:
+    """Reference: benchmarks/models/unet/__init__.py:52-70."""
+    if num_convs <= 0:
+        return []
+    if num_convs == 1:
+        return _conv_block(out_ch, f"{name}_c1")
+    out = _conv_block(mid_ch, f"{name}_c1")
+    for i in range(num_convs - 2):
+        out += _conv_block(mid_ch, f"{name}_c{i + 2}")
+    out += _conv_block(out_ch, f"{name}_c{num_convs}")
+    return out
+
+
+def _pop_cat(ns: Namespace, name: str) -> Layer:
+    """Pop the stashed encoder map, pad the decoder input up to its spatial
+    size if needed, and concatenate on channels
+    (reference: benchmarks/models/unet/__init__.py:25-40 ``PopCat``)."""
+
+    def fn(x, pops):
+        skip_val = pops["skip"]
+        if x.shape[1:-1] != skip_val.shape[1:-1]:
+            pad = [(0, 0)]
+            pad += [
+                (0, s - d) for d, s in zip(x.shape[1:-1], skip_val.shape[1:-1])
+            ]
+            pad += [(0, 0)]
+            x = jnp.pad(x, pad)
+        return jnp.concatenate([x, skip_val], axis=-1), {}
+
+    return skippable(fn, pop=["skip"], ns=ns, name=name)
+
+
+def unet(
+    depth: int = 5,
+    num_convs: int = 5,
+    base_channels: int = 64,
+    input_channels: int = 3,
+    output_channels: int = 1,
+) -> List[Layer]:
+    """Build the simplified U-Net
+    (reference: benchmarks/models/unet/__init__.py:74-148).
+
+    ::
+
+        [ encoder ]--------------[ decoder ]--[ segment ]
+           [ encoder ]--------[ decoder ]
+                [ bottleneck ]
+    """
+    del input_channels  # inferred from the input spec at init time
+    namespaces = [Namespace() for _ in range(depth)]
+    layers: List[Layer] = []
+
+    # Encoder: convs, stash, downsample.
+    for i in range(depth):
+        mid = out = base_channels * (2 ** i)
+        layers += _stacked_convs(mid, out, num_convs, f"enc{i}")
+        layers.append(stash("skip", ns=namespaces[i], name=f"enc{i}_skip"))
+        layers.append(max_pool2d((2, 2), (2, 2), name=f"enc{i}_down"))
+
+    # Bottleneck.
+    layers += _stacked_convs(
+        base_channels * (2 ** depth),
+        base_channels * (2 ** (depth - 1)),
+        num_convs,
+        "bottleneck",
+    )
+
+    # Decoder: upsample, pop+concat, convs.
+    for i in reversed(range(depth)):
+        mid = out = int(base_channels * (2 ** (i - 1)))
+        layers.append(upsample2d(2, name=f"dec{i}_up"))
+        layers.append(_pop_cat(namespaces[i], f"dec{i}_skip"))
+        layers += _stacked_convs(mid, out, num_convs, f"dec{i}")
+
+    layers.append(conv2d(output_channels, (1, 1), name="segment"))
+    return named(layers)

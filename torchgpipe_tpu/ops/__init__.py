@@ -6,10 +6,14 @@ from torchgpipe_tpu.ops.nn import (  # noqa: F401
     conv2d,
     dense,
     dropout,
+    dropout2d,
     flatten,
     gelu,
     global_avg_pool,
+    instance_norm,
     layer_norm,
+    leaky_relu,
     max_pool2d,
     relu,
+    upsample2d,
 )

@@ -183,6 +183,9 @@ def gelu(name: str = "gelu") -> Layer:
 def _pool(x, window, strides, padding, reducer, init_val):
     dims = (1, window[0], window[1], 1)
     strs = (1, strides[0], strides[1], 1)
+    if not isinstance(padding, str):
+        # Spatial ((lo, hi), (lo, hi)) pairs — expand to all 4 NHWC dims.
+        padding = ((0, 0), tuple(padding[0]), tuple(padding[1]), (0, 0))
     return lax.reduce_window(x, init_val, reducer, dims, strs, padding)
 
 
@@ -222,6 +225,54 @@ def avg_pool2d(
         ones = jnp.ones_like(x)
         counts = _pool(ones, window, strides, padding, lax.add, 0.0)
         return summed / counts
+
+    return stateless(name, fn)
+
+
+def instance_norm(*, eps: float = 1e-5, name: str = "in") -> Layer:
+    """InstanceNorm over spatial dims, per sample per channel, no affine
+    params and no running stats (the torch ``InstanceNorm2d`` defaults the
+    reference's U-Net uses, benchmarks/models/unet/__init__.py:46)."""
+
+    def fn(x):
+        axes = tuple(range(1, x.ndim - 1))
+        mean = jnp.mean(x, axes, keepdims=True)
+        var = jnp.var(x, axes, keepdims=True)
+        return (x - mean) * lax.rsqrt(var + eps)
+
+    return stateless(name, fn)
+
+
+def leaky_relu(negative_slope: float = 0.01, *, name: str = "leaky_relu") -> Layer:
+    return stateless(name, lambda x: jax.nn.leaky_relu(x, negative_slope))
+
+
+def dropout2d(rate: float, *, name: str = "dropout2d") -> Layer:
+    """Spatial (channel-wise) dropout: zero whole feature maps, NHWC."""
+
+    def init(rng, in_spec):
+        del rng, in_spec
+        return (), ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del params
+        if not train or rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("dropout2d needs an rng key in train mode")
+        mask_shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        keep = jax.random.bernoulli(rng, 1.0 - rate, mask_shape)
+        return jnp.where(keep, x / (1.0 - rate), 0.0), state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def upsample2d(scale: int = 2, *, name: str = "upsample") -> Layer:
+    """Nearest-neighbour spatial upsampling (NHWC)."""
+
+    def fn(x):
+        x = jnp.repeat(x, scale, axis=1)
+        return jnp.repeat(x, scale, axis=2)
 
     return stateless(name, fn)
 
