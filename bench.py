@@ -17,10 +17,18 @@ number reflects the framework overhead the reference benchmarks measure.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
 import jax.numpy as jnp
+
+# Persistent compilation cache: first-ever compile of the full-size model
+# through the TPU tunnel takes minutes; subsequent bench runs (e.g. the
+# driver's end-of-round run) reuse the cached executables.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 # Reference per-chip throughput: AmoebaNet-D (18,256), n=8 m=32, 8x P40.
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 132.413 / 8

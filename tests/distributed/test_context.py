@@ -1,0 +1,80 @@
+"""Mailbox / transport unit tests (reference pattern:
+tests/distributed/test_context.py:26-77)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.distributed import LocalTransport, TcpTransport
+
+
+def test_mailbox_channels_are_independent():
+    t = LocalTransport()
+    box = t.register("a")
+    t.send("a", "forward", 0, "f0")
+    t.send("a", "forward", 1, "f1")
+    t.send("a", "backward", 0, "b0")
+    t.send("a", ("skip", ("ns", "x")), 0, "s0")
+    assert box.get("forward", 1) == "f1"
+    assert box.get("forward", 0) == "f0"
+    assert box.get("backward", 0) == "b0"
+    assert box.get(("skip", ("ns", "x")), 0) == "s0"
+
+
+def test_mailbox_get_blocks_until_put():
+    t = LocalTransport()
+    box = t.register("a")
+    result = []
+
+    def consumer():
+        result.append(box.get("forward", 0, timeout=5))
+
+    th = threading.Thread(target=consumer)
+    th.start()
+    t.send("a", "forward", 0, 123)
+    th.join(timeout=5)
+    assert result == [123]
+
+
+def test_mailbox_timeout_message():
+    t = LocalTransport()
+    box = t.register("a")
+    with pytest.raises(TimeoutError, match="peer rank alive"):
+        box.get("forward", 7, timeout=0.05)
+
+
+def test_local_transport_unknown_worker():
+    t = LocalTransport()
+    t.register("a")
+    with pytest.raises(KeyError, match="unknown worker"):
+        t.send("nope", "forward", 0, 1)
+    with pytest.raises(ValueError, match="already registered"):
+        t.register("a")
+
+
+def test_tcp_transport_roundtrip():
+    """Two workers over real localhost sockets, numpy pytree payloads."""
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    addrs = {"a": ("127.0.0.1", free_port()), "b": ("127.0.0.1", free_port())}
+    ta = TcpTransport("a", addrs)
+    tb = TcpTransport("b", addrs)
+    try:
+        payload = {"x": np.arange(6, dtype=np.float32).reshape(2, 3), "meta": (1, 2)}
+        ta.send("b", "forward", 3, payload)
+        got = tb.mailbox.get("forward", 3, timeout=5)
+        np.testing.assert_array_equal(got["x"], payload["x"])
+        assert got["meta"] == (1, 2)
+        # And the reverse direction.
+        tb.send("a", "backward", 0, np.float32(2.5))
+        assert tb.addresses == addrs
+        assert float(ta.mailbox.get("backward", 0, timeout=5)) == 2.5
+    finally:
+        ta.close()
+        tb.close()

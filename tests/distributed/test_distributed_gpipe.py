@@ -1,0 +1,268 @@
+"""MPMD pipeline tests: all ranks' stage objects run in one process over the
+in-process transport — multi-node logic without a cluster (reference pattern:
+tests/distributed/test_distributed_gpipe.py:34-117, which mocks RPC with
+queues the same way)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.distributed import (
+    DistributedGPipe,
+    DistributedGPipeDataLoader,
+    LocalTransport,
+    worker,
+)
+from torchgpipe_tpu.layers import sequential_apply, sequential_init
+from torchgpipe_tpu.models import unet
+from torchgpipe_tpu.ops import dense, relu
+
+WORKERS = ["w0", "w1", "w2"]
+
+
+def _mlp():
+    return [
+        dense(16, name="fc1"),
+        relu("r1"),
+        dense(16, name="fc2"),
+        relu("r2"),
+        dense(4, name="fc3"),
+    ]
+
+
+def _loss(out, tgt):
+    return jnp.mean((out - tgt) ** 2)
+
+
+def _make_ranks(layers, balance, chunks, transport, **kw):
+    ranks = []
+    for r in range(len(balance)):
+        box = transport.register(WORKERS[r])
+        ranks.append(
+            DistributedGPipe(
+                layers,
+                r,
+                WORKERS[: len(balance)],
+                balance,
+                chunks=chunks,
+                transport=transport,
+                mailbox=box,
+                **kw,
+            )
+        )
+    return ranks
+
+
+def _run_step(ranks, batch, target, rng, loss_fn=_loss):
+    """Drive all ranks sequentially (channel blocking would interleave them
+    in real processes; in one process the mail is already there)."""
+    outs = None
+    for r, rank in enumerate(ranks):
+        res = rank.forward(
+            rank._params, rank._state, batch if r == 0 else None, rng=rng
+        )
+        if rank.is_last:
+            outs = res
+    loss, gys, _aux = ranks[-1].loss_grads(outs, target, loss_fn)
+    grads = {}
+    states = {}
+    for rank in reversed(ranks):
+        g, s = rank.backward(gys if rank.is_last else None)
+        grads[rank.rank] = g
+        states[rank.rank] = s
+    return loss, grads, states, outs
+
+
+@pytest.mark.parametrize("checkpoint", ["never", "except_last", "always"])
+def test_distributed_matches_sequential(checkpoint):
+    layers = _mlp()
+    transport = LocalTransport()
+    ranks = _make_ranks(layers, [2, 2, 1], 2, transport, checkpoint=checkpoint)
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (6, 8))
+    y = jax.random.normal(jax.random.PRNGKey(2), (6, 4))
+    in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    for rank in ranks:
+        rank._params, rank._state = rank.init(rng, in_spec)
+
+    key = jax.random.PRNGKey(3)
+    loss, grads, _, outs = _run_step(ranks, x, y, key)
+
+    # Oracle: un-partitioned model with the same init rng.
+    flat_params, flat_state, _ = sequential_init(layers, rng, in_spec)
+
+    def ref_loss(ps):
+        from torchgpipe_tpu import microbatch
+
+        mbs = microbatch.scatter(x, 2)
+        outs = []
+        for i, mb in enumerate(mbs):
+            o, _ = sequential_apply(
+                layers, ps, flat_state, mb,
+                rng=jax.random.fold_in(key, i), train=True,
+            )
+            outs.append(o)
+        return _loss(microbatch.gather(outs), y)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(flat_params)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+
+    flat_grads = [g for r in range(len(ranks)) for g in grads[r]]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(flat_grads), jax.tree_util.tree_leaves(ref_g)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_distributed_training_converges():
+    layers = _mlp()
+    transport = LocalTransport()
+    ranks = _make_ranks(layers, [2, 2, 1], 2, transport)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+    y = jax.random.normal(jax.random.PRNGKey(2), (8, 4))
+    for rank in ranks:
+        rank._params, rank._state = rank.init(
+            rng, jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+    losses = []
+    for step in range(8):
+        loss, grads, states, _ = _run_step(
+            ranks, x, y, jax.random.PRNGKey(10 + step)
+        )
+        losses.append(float(loss))
+        for rank in ranks:
+            rank._params = jax.tree_util.tree_map(
+                lambda p, g: p - 0.1 * g, rank._params, grads[rank.rank]
+            )
+            rank._state = states[rank.rank]
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_distributed_cross_rank_skips():
+    """U-Net long skips stash on one rank and pop on another: the skip tensor
+    and its gradient must route point-to-point through the transport (a
+    capability the reference fork does not have)."""
+    layers = unet(depth=2, num_convs=1, base_channels=4)
+    n = len(layers)
+    balance = [n // 3, n // 3, n - 2 * (n // 3)]
+    transport = LocalTransport()
+    ranks = _make_ranks(layers, balance, 2, transport)
+    # Prove this split actually crosses stages with a skip.
+    assert any(ranks[0].stage.ext_stash_keys for _ in [0]) or any(
+        r.stage.ext_pop_keys for r in ranks
+    )
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16, 16, 3))
+    y = jnp.zeros((4, 16, 16, 1))
+    for rank in ranks:
+        rank._params, rank._state = rank.init(
+            rng, jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+    loss, grads, _, outs = _run_step(ranks, x, y, jax.random.PRNGKey(5))
+    assert np.isfinite(float(loss))
+    for r in grads.values():
+        for leaf in jax.tree_util.tree_leaves(r):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_distributed_ragged_batch_agrees_on_microbatch_count():
+    """Batch 3 with chunks=4 -> only 3 micro-batches; non-first ranks must
+    learn the real count instead of blocking on a 4th that never comes."""
+    layers = _mlp()
+    transport = LocalTransport()
+    ranks = _make_ranks(layers, [3, 2], 4, transport)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 8))
+    y = jax.random.normal(jax.random.PRNGKey(2), (3, 4))
+    for rank in ranks:
+        rank._params, rank._state = rank.init(
+            rng, jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+    loss, grads, _, _ = _run_step(ranks, x, y, jax.random.PRNGKey(3))
+    assert np.isfinite(float(loss))
+
+
+def test_distributed_loss_fn_aux_is_returned():
+    layers = _mlp()
+    transport = LocalTransport()
+    ranks = _make_ranks(layers, [3, 2], 2, transport)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    y = jax.random.normal(jax.random.PRNGKey(2), (4, 4))
+    for rank in ranks:
+        rank._params, rank._state = rank.init(
+            rng, jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+
+    def loss_with_aux(out, tgt):
+        return jnp.mean((out - tgt) ** 2), {"mae": jnp.mean(jnp.abs(out - tgt))}
+
+    for r, rank in enumerate(ranks):
+        res = rank.forward(
+            rank._params, rank._state, x if r == 0 else None,
+            rng=jax.random.PRNGKey(3),
+        )
+        if rank.is_last:
+            outs = res
+    loss, gys, aux = ranks[-1].loss_grads(outs, y, loss_with_aux)
+    assert "mae" in aux and np.isfinite(float(aux["mae"]))
+    for rank in reversed(ranks):
+        rank.backward(gys if rank.is_last else None)
+
+
+def test_dataloader_roles():
+    transport = LocalTransport()
+    boxes = {name: transport.register(name) for name in WORKERS}
+    data = [(jnp.ones((4, 2)) * i, jnp.full((4,), i)) for i in range(3)]
+
+    rank0 = DistributedGPipeDataLoader(
+        data, 0, WORKERS, transport=transport, mailbox=boxes["w0"]
+    )
+    out0 = list(rank0)
+    assert all(t is None for _, t in out0)
+    assert [float(d[0, 0]) for d, _ in out0] == [0.0, 1.0, 2.0]
+
+    mid = DistributedGPipeDataLoader(
+        None, 1, WORKERS, transport=transport, mailbox=boxes["w1"], num_batches=3
+    )
+    assert list(mid) == [(None, None)] * 3
+
+    last = DistributedGPipeDataLoader(
+        None, 2, WORKERS, transport=transport, mailbox=boxes["w2"], num_batches=3
+    )
+    outl = list(last)
+    assert all(d is None for d, _ in outl)
+    assert [float(t[0]) for _, t in outl] == [0.0, 1.0, 2.0]
+
+
+def test_worker_context_manager_unregisters():
+    transport = LocalTransport()
+    with worker(transport, "w0") as box:
+        transport.send("w0", "forward", 0, 42)
+        assert box.get("forward", 0) == 42
+    # Re-registering after exit must work.
+    with worker(transport, "w0"):
+        pass
+
+
+def test_forward_backward_api_misuse():
+    layers = _mlp()
+    transport = LocalTransport()
+    ranks = _make_ranks(layers, [3, 2], 2, transport)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    for rank in ranks:
+        rank._params, rank._state = rank.init(
+            rng, jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+    with pytest.raises(RuntimeError, match="before forward"):
+        ranks[0].backward(None)
+    with pytest.raises(ValueError, match="rank 0 must be given"):
+        ranks[0].forward(ranks[0]._params, ranks[0]._state, None)
+    with pytest.raises(ValueError, match="only rank 0"):
+        ranks[1].forward(ranks[1]._params, ranks[1]._state, x)
+    with pytest.raises(RuntimeError, match="only meaningful on the last rank"):
+        ranks[0].loss_grads([x], x, _loss)

@@ -1,0 +1,17 @@
+"""Multi-process (MPMD) pipeline parallelism.
+
+Counterpart of the fork's ``torchgpipe.distributed`` package (SURVEY.md §1-L8):
+per-rank pipeline stages over a pluggable transport, a named-mailbox channel
+registry, and a rank-aware data loader.
+"""
+
+from torchgpipe_tpu.distributed.context import (  # noqa: F401
+    LocalTransport,
+    Mailbox,
+    TcpTransport,
+    worker,
+)
+from torchgpipe_tpu.distributed.gpipe import (  # noqa: F401
+    DistributedGPipe,
+    DistributedGPipeDataLoader,
+)

@@ -1,0 +1,199 @@
+"""Named mailboxes + pluggable transports for the multi-process pipeline.
+
+Re-design of the reference's channel registry
+(reference: torchgpipe/distributed/context.py:19-193): each worker owns a
+:class:`Mailbox` of blocking channels keyed by ``(kind, index)`` — forward
+activations, backward gradients, targets, and cross-rank skip tensors all
+travel through the same mechanism.  Where the reference hard-codes
+``torch.distributed.rpc`` one-way calls with CPU staging
+(reference: torchgpipe/distributed/gpipe.py:86-96, 176-177), transport here
+is pluggable:
+
+* :class:`LocalTransport` — in-process delivery between rank objects living
+  in one process (multi-device single-host runs, and the test harness; the
+  reference tests mock RPC the same way,
+  tests/distributed/test_distributed_gpipe.py:34-117).
+* :class:`TcpTransport` — length-prefixed pickled numpy pytrees over TCP
+  sockets between OS processes/hosts.  Host-staged, as the reference's RPC
+  transport is.  For pod-scale TPU jobs the SPMD engine
+  (:mod:`torchgpipe_tpu.spmd`) over ICI/DCN is the preferred path
+  (SURVEY.md §2.3); this transport exists for capability parity with the
+  reference's multi-process mode on commodity networks.
+
+The reference's channel API (``put_forward``/``get_forward`` etc.,
+distributed/context.py:96-193) maps to ``Mailbox.put/get`` with kinds
+``"forward" | "backward" | "target" | ("skip", key) | ("skip_grad", key)``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+import queue
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+Payload = Any
+ChannelKey = Tuple[Any, int]
+
+
+class Mailbox:
+    """Blocking channels keyed by ``(kind, micro-batch index)``.
+
+    Reference: torchgpipe/distributed/context.py:19-26 (``TrainingContext``
+    holds ``chunks`` forward + ``chunks`` backward queues + a target queue);
+    here channels are created on demand, which also carries skip tensors.
+    """
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._channels: Dict[ChannelKey, queue.Queue] = {}
+        self._lock = threading.Lock()
+
+    def _channel(self, kind: Any, index: int) -> queue.Queue:
+        key = (kind, index)
+        with self._lock:
+            ch = self._channels.get(key)
+            if ch is None:
+                ch = self._channels[key] = queue.Queue()
+            return ch
+
+    def put(self, kind: Any, index: int, payload: Payload) -> None:
+        self._channel(kind, index).put(payload)
+
+    def get(self, kind: Any, index: int, timeout: Optional[float] = None) -> Payload:
+        try:
+            return self._channel(kind, index).get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"worker {self.name!r}: no message on channel {(kind, index)!r} "
+                f"within {timeout}s — is the peer rank alive?"
+            ) from None
+
+
+class LocalTransport:
+    """In-process transport: a shared registry of mailboxes.
+
+    Mirrors the reference's ``GlobalContext`` registry
+    (reference: torchgpipe/distributed/context.py:28-38) without RPC.
+    """
+
+    def __init__(self) -> None:
+        self._mailboxes: Dict[str, Mailbox] = {}
+
+    def register(self, name: str) -> Mailbox:
+        if name in self._mailboxes:
+            raise ValueError(f"worker {name!r} already registered")
+        box = Mailbox(name)
+        self._mailboxes[name] = box
+        return box
+
+    def unregister(self, name: str) -> None:
+        self._mailboxes.pop(name, None)
+
+    def send(self, dst: str, kind: Any, index: int, payload: Payload) -> None:
+        try:
+            box = self._mailboxes[dst]
+        except KeyError:
+            raise KeyError(
+                f"unknown worker {dst!r}; registered: {sorted(self._mailboxes)}"
+            ) from None
+        box.put(kind, index, payload)
+
+
+def _to_host(tree: Payload) -> Payload:
+    """Detach to host numpy (the reference stages through CPU the same way,
+    torchgpipe/distributed/gpipe.py:176-177)."""
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+class _MsgHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        data = b""
+        hdr = self._recv_exact(8)
+        if hdr is None:
+            return
+        (length,) = struct.unpack("!Q", hdr)
+        data = self._recv_exact(length)
+        if data is None:
+            return
+        kind, index, payload = pickle.loads(data)
+        self.server.mailbox.put(kind, index, payload)  # type: ignore[attr-defined]
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+
+class TcpTransport:
+    """Socket transport between OS processes; one listener per worker.
+
+    ``addresses`` maps every worker name to ``(host, port)``; this worker
+    binds its own address and receives into its :class:`Mailbox`.
+    """
+
+    def __init__(self, name: str, addresses: Dict[str, Tuple[str, int]]) -> None:
+        self.name = name
+        self.addresses = dict(addresses)
+        self.mailbox = Mailbox(name)
+        host, port = self.addresses[name]
+        self._server = socketserver.ThreadingTCPServer(
+            (host, port), _MsgHandler, bind_and_activate=False
+        )
+        self._server.allow_reuse_address = True
+        self._server.server_bind()
+        self._server.server_activate()
+        self._server.mailbox = self.mailbox  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def register(self, name: str) -> Mailbox:
+        if name != self.name:
+            raise ValueError(
+                f"TcpTransport for {self.name!r} cannot register {name!r}; "
+                "each process owns exactly one worker"
+            )
+        return self.mailbox
+
+    def send(self, dst: str, kind: Any, index: int, payload: Payload) -> None:
+        blob = pickle.dumps(
+            (kind, index, _to_host(payload)), protocol=pickle.HIGHEST_PROTOCOL
+        )
+        host, port = self.addresses[dst]
+        with socket.create_connection((host, port)) as sock:
+            sock.sendall(struct.pack("!Q", len(blob)) + blob)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@contextlib.contextmanager
+def worker(
+    transport, name: str
+) -> Iterator[Mailbox]:
+    """Register a worker mailbox for the duration of a training run.
+
+    Reference: torchgpipe/distributed/context.py:41-64 (``worker`` context
+    manager / ``@distributed`` decorator).
+    """
+    box = transport.register(name)
+    try:
+        yield box
+    finally:
+        unregister = getattr(transport, "unregister", None)
+        if unregister is not None:
+            unregister(name)

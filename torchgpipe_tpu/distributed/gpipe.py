@@ -1,0 +1,371 @@
+"""Multi-process (MPMD) pipeline: one rank per OS process, one stage per rank.
+
+Capability parity with the fork's ``DistributedGPipe``
+(reference: torchgpipe/distributed/gpipe.py:75-275), re-designed:
+
+* Each rank compiles its stage once (:class:`~torchgpipe_tpu.pipeline.StageExec`)
+  and drives it over micro-batches; activations/gradients travel through a
+  pluggable transport (:mod:`torchgpipe_tpu.distributed.context`) instead of
+  ``torch.distributed.rpc`` with CPU staging.
+* The fork's forward/backward APIs are mutually inconsistent with its own
+  tests and benchmarks (SURVEY.md §2.4 warning); here the contract is fixed
+  and explicit: ``forward`` returns the last rank's micro-batch outputs,
+  ``loss_grads`` turns them into output cotangents, ``backward`` returns
+  parameter gradients and the updated stage state.
+* Activation checkpointing works in the distributed mode too (the fork's
+  does not checkpoint): the rank stores inputs instead of vjp residuals and
+  recomputes ahead of consuming the arriving cotangent.
+* Cross-rank skip connections route point-to-point through the same
+  transport (the fork cannot route @skippable tensors across ranks at all).
+
+The GPipe fill-drain schedule *emerges* from cross-rank channel blocking,
+exactly as in the reference (SURVEY.md §3.5: "fill-drain emerges from
+cross-rank channel blocking, not a scheduler").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from torchgpipe_tpu import microbatch
+from torchgpipe_tpu.batchnorm import convert_deferred_batch_norm
+from torchgpipe_tpu.checkpoint import CHECKPOINT_MODES, checkpoint_stop
+from torchgpipe_tpu.layers import Layer, sequential_specs
+from torchgpipe_tpu.partition import split_layers, verify_module
+from torchgpipe_tpu.pipeline import LossGradRunner, StageExec
+from torchgpipe_tpu.skip import inspect_skip_layout, verify_skippables
+
+Pytree = Any
+
+
+class DistributedGPipe:
+    """One pipeline stage owned by this rank.
+
+    Reference: torchgpipe/distributed/gpipe.py:75-194.  ``workers`` names all
+    ranks in pipeline order; ``workers[rank]`` is this process, whose mailbox
+    must be registered on ``transport`` (see
+    :func:`torchgpipe_tpu.distributed.context.worker`).
+    """
+
+    def __init__(
+        self,
+        layers: Sequence[Layer],
+        rank: int,
+        workers: Sequence[str],
+        balance: Sequence[int],
+        *,
+        chunks: int,
+        transport,
+        mailbox,
+        device=None,
+        checkpoint: str = "except_last",
+        deferred_batch_norm: bool = False,
+    ) -> None:
+        layers = list(layers)
+        verify_module(layers)
+        verify_skippables(layers)
+        if len(balance) != len(workers):
+            raise ValueError(
+                f"balance has {len(balance)} stages but workers names "
+                f"{len(workers)} ranks"
+            )
+        if not (0 <= rank < len(workers)):
+            raise ValueError(f"rank {rank} out of range for {len(workers)} workers")
+        if chunks <= 0:
+            raise ValueError("number of chunks must be positive integer")
+        if checkpoint not in CHECKPOINT_MODES:
+            raise ValueError(
+                f"checkpoint is not one of {'|'.join(CHECKPOINT_MODES)}"
+            )
+
+        if deferred_batch_norm:
+            layers = convert_deferred_batch_norm(layers, chunks)
+
+        self.layers = layers
+        self.rank = rank
+        self.workers = list(workers)
+        self.chunks = chunks
+        self.checkpoint = checkpoint
+        self.transport = transport
+        self.mailbox = mailbox
+
+        partitions = split_layers(layers, balance)
+        self.layout = inspect_skip_layout(partitions)
+        self.partition = partitions[rank]
+        self.offset = sum(balance[:rank])
+        self.device = device if device is not None else jax.devices()[0]
+        self.stage = StageExec(
+            rank, self.partition, self.offset, self.device, self.layout
+        )
+        # Which rank pops / stashes each cross-stage skip key.
+        self._skip_pop_rank = {
+            k: self.layout.pop_stage(k) for k in self.stage.ext_stash_keys
+        }
+        self._skip_stash_rank = {
+            k: self.layout.stash_stage(k) for k in self.stage.ext_pop_keys
+        }
+        self._ctx: Optional[Dict[str, Any]] = None
+        self._loss_grad = LossGradRunner()
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def is_first(self) -> bool:
+        return self.rank == 0
+
+    @property
+    def is_last(self) -> bool:
+        return self.rank == len(self.workers) - 1
+
+    def init(
+        self, rng: jax.Array, in_spec: Pytree
+    ) -> Tuple[List[Pytree], List[Pytree]]:
+        """Initialize THIS rank's partition only.
+
+        Uses the same per-layer rng folding as
+        :func:`~torchgpipe_tpu.layers.sequential_init`, so all ranks together
+        reproduce exactly the single-process model's parameters — the
+        transparency oracle holds across process boundaries.  Shape
+        propagation through earlier ranks' layers is abstract (no FLOPs, no
+        memory).
+        """
+        specs = sequential_specs(self.layers, in_spec)
+        params, state = [], []
+        for li, layer in enumerate(self.partition):
+            g = self.offset + li
+            p, s = layer.init(jax.random.fold_in(rng, g), specs[g])
+            params.append(p)
+            state.append(s)
+        return (
+            jax.device_put(params, self.device),
+            jax.device_put(state, self.device),
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def forward(
+        self,
+        params: Sequence[Pytree],
+        state: Sequence[Pytree],
+        batch: Optional[Pytree] = None,
+        *,
+        rng: Optional[jax.Array] = None,
+        train: bool = True,
+    ) -> Optional[List[Pytree]]:
+        """Run this rank's stage over all micro-batches.
+
+        Rank 0 scatters ``batch``; other ranks pass ``batch=None`` and pull
+        inputs from their mailbox (reference:
+        torchgpipe/distributed/gpipe.py:159-178).  Returns the per-micro-batch
+        outputs on the last rank, else ``None``.
+        """
+        if self.is_first:
+            if batch is None:
+                raise ValueError("rank 0 must be given the input batch")
+            microbatch.check(batch)
+            mbatches = microbatch.scatter(batch, self.chunks)
+            m = len(mbatches)
+            # scatter() may produce fewer micro-batches than ``chunks``
+            # (ceil-sized chunk semantics, microbatch.chunk_sizes); every rank
+            # must agree on m or downstream ranks would block forever waiting
+            # for micro-batches that never come.  Channels are FIFO per key,
+            # so index 0 is safe across steps.
+            for r in range(1, len(self.workers)):
+                self.transport.send(self.workers[r], "meta", 0, m)
+        else:
+            if batch is not None:
+                raise ValueError("only rank 0 feeds the input batch")
+            mbatches = None
+            m = int(self.mailbox.get("meta", 0))
+
+        stop = checkpoint_stop(self.checkpoint, m, train=train)
+        stage = self.stage
+        cur_state = list(state)
+        pulls: Dict[int, Any] = {}
+        saved: Dict[int, Any] = {}
+        outs: List[Pytree] = []
+
+        for i in range(m):
+            if self.is_first:
+                x = mbatches[i]
+            else:
+                x = jax.device_put(
+                    self.mailbox.get("forward", i), self.device
+                )
+            skips_in = {
+                k: jax.device_put(self.mailbox.get(("skip", k), i), self.device)
+                for k in stage.ext_pop_keys
+            }
+            rng_i = jax.random.fold_in(rng, i) if rng is not None else None
+            if train and i < stop:
+                y, ext, new_state = stage.fwd_ckpt(
+                    params, cur_state, x, skips_in, rng_i
+                )
+                saved[i] = (x, skips_in, list(cur_state), rng_i)
+            elif train:
+                y, ext, new_state, pull = stage.fwd_vjp(
+                    params, cur_state, x, skips_in, rng_i
+                )
+                pulls[i] = pull
+            else:
+                y, ext, new_state = stage.fwd_eval(
+                    params, cur_state, x, skips_in, rng_i
+                )
+            cur_state = list(new_state)
+            for k, v in ext.items():
+                dst = self.workers[self._skip_pop_rank[k]]
+                self.transport.send(dst, ("skip", k), i, v)
+            if self.is_last:
+                outs.append(y)
+            else:
+                self.transport.send(self.workers[self.rank + 1], "forward", i, y)
+
+        self._ctx = {
+            "m": m,
+            "pulls": pulls,
+            "saved": saved,
+            "params": params,
+            "state": list(cur_state),
+            "train": train,
+        }
+        return outs if self.is_last else None
+
+    # ------------------------------------------------------------------ #
+
+    def loss_grads(
+        self,
+        outputs: Sequence[Pytree],
+        target: Pytree,
+        loss_fn: Callable,
+    ) -> Tuple[jax.Array, List[Pytree], Any]:
+        """Last-rank helper: mini-batch loss + per-micro-batch output
+        cotangents + ``loss_fn`` aux (or None).
+
+        The loss sees the *gathered* output (transparency with the
+        un-pipelined model); its gradient is split back per micro-batch.  The
+        reference computes per-micro-batch losses in the driver instead
+        (benchmarks/distributed/accuracy/main.py:307-313) — gathering first
+        keeps mean-reduction semantics independent of ragged chunk sizes.
+        """
+        if not self.is_last:
+            raise RuntimeError("loss_grads is only meaningful on the last rank")
+        return self._loss_grad(list(outputs), target, loss_fn)
+
+    def backward(
+        self, grad_outputs: Optional[Sequence[Pytree]] = None
+    ) -> Tuple[List[Pytree], List[Pytree]]:
+        """Reverse schedule over micro-batches.
+
+        The last rank passes the output cotangents from :meth:`loss_grads`;
+        other ranks pass ``None`` and pull cotangents from the mailbox
+        (reference: torchgpipe/distributed/gpipe.py:180-194, done there with
+        backward hooks harvesting input grads).  Returns
+        ``(param_grads, new_state)`` for this rank's partition.
+        """
+        if self._ctx is None:
+            raise RuntimeError("backward called before forward")
+        ctx = self._ctx
+        self._ctx = None
+        if not ctx["train"]:
+            raise RuntimeError("backward after an eval-mode forward")
+        m = ctx["m"]
+        stage = self.stage
+        acc: Optional[Pytree] = None
+
+        if self.is_last:
+            if grad_outputs is None:
+                raise RuntimeError(
+                    "the last rank must pass the output cotangents "
+                    "(see DistributedGPipe.loss_grads)"
+                )
+            grad_outputs = list(grad_outputs)
+        elif grad_outputs is not None:
+            raise ValueError(
+                "only the last rank takes output cotangents; other ranks "
+                "receive theirs from the next rank's backward"
+            )
+
+        for i in reversed(range(m)):
+            if self.is_last:
+                gy = grad_outputs[i]
+            else:
+                gy = jax.device_put(self.mailbox.get("backward", i), self.device)
+            gext = {
+                k: jax.device_put(
+                    self.mailbox.get(("skip_grad", k), i), self.device
+                )
+                for k in stage.ext_stash_keys
+            }
+            if i in ctx["saved"]:
+                x, skips_in, state_in, rng_i = ctx["saved"].pop(i)
+                # Recompute-ahead (reference: torchgpipe/checkpoint.py:1-19).
+                _, _, _, pull = stage.fwd_recompute(
+                    ctx["params"], state_in, x, skips_in, rng_i
+                )
+            else:
+                pull = ctx["pulls"].pop(i)
+            gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
+            acc = gparams if acc is None else stage.accum(acc, gparams)
+            if not self.is_first:
+                self.transport.send(
+                    self.workers[self.rank - 1], "backward", i, gx
+                )
+            for k, g in gsk_in.items():
+                dst = self.workers[self._skip_stash_rank[k]]
+                self.transport.send(dst, ("skip_grad", k), i, g)
+
+        return list(acc), ctx["state"]
+
+
+class DistributedGPipeDataLoader:
+    """Rank-aware loader: rank 0 yields ``(data, None)`` and ships targets to
+    the last rank; the last rank yields ``(None, target)``; middle ranks
+    yield ``(None, None)``.
+
+    Reference: torchgpipe/distributed/gpipe.py:197-275.
+    """
+
+    def __init__(
+        self,
+        loader,
+        rank: int,
+        workers: Sequence[str],
+        *,
+        transport,
+        mailbox,
+        num_batches: Optional[int] = None,
+    ) -> None:
+        self.loader = loader
+        self.rank = rank
+        self.workers = list(workers)
+        self.transport = transport
+        self.mailbox = mailbox
+        if loader is None and num_batches is None:
+            raise ValueError("ranks without a loader need num_batches")
+        self.num_batches = num_batches if num_batches is not None else len(loader)
+
+    def __len__(self) -> int:
+        return self.num_batches
+
+    def __iter__(self):
+        last = len(self.workers) - 1
+        if self.rank == 0:
+            for step, (data, target) in enumerate(self.loader):
+                if step >= self.num_batches:
+                    break
+                if last != 0:
+                    self.transport.send(
+                        self.workers[last], "target", step, target
+                    )
+                    yield data, None
+                else:
+                    yield data, target
+        elif self.rank == last:
+            for step in range(self.num_batches):
+                target = self.mailbox.get("target", step)
+                yield None, target
+        else:
+            for _ in range(self.num_batches):
+                yield None, None

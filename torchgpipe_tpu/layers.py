@@ -282,6 +282,14 @@ def _infer_layer(layer: Layer, params, state, in_spec: Spec, pops_spec):
     return jax.eval_shape(run, params, state, x, pops_spec)
 
 
+def _spec_step(layer: Layer, params, state, spec: Spec, skip_specs: dict) -> Spec:
+    """Thread one layer's shape inference (incl. skip-connection specs)."""
+    pops_spec = {k: skip_specs.pop(k) for k in layer.pop}
+    new_spec, stashed_spec = _infer_layer(layer, params, state, spec, pops_spec)
+    skip_specs.update(stashed_spec)
+    return new_spec
+
+
 def sequential_init(
     layers: Sequence[Layer], rng: jax.Array, in_spec: Spec
 ) -> Tuple[List[Pytree], List[Pytree], List[Spec]]:
@@ -302,11 +310,32 @@ def sequential_init(
         p, s = layer.init(layer_rng, spec)
         params_list.append(p)
         state_list.append(s)
-        pops_spec = {k: skip_specs.pop(k) for k in layer.pop}
-        spec, stashed_spec = _infer_layer(layer, p, s, spec, pops_spec)
-        skip_specs.update(stashed_spec)
+        spec = _spec_step(layer, p, s, spec, skip_specs)
         specs.append(spec)
     return params_list, state_list, specs
+
+
+def sequential_specs(
+    layers: Sequence[Layer], in_spec: Spec
+) -> List[Spec]:
+    """Per-layer input specs of the sequential model, computed abstractly.
+
+    Like :func:`sequential_init` but without materializing any parameters —
+    used by the distributed engine so each rank initializes only its own
+    partition (``specs[i]`` is the input spec of ``layers[i]``; the final
+    entry is the model output spec).
+    """
+    specs: List[Spec] = [in_spec]
+    spec = in_spec
+    skip_specs: dict = {}
+    for layer in layers:
+        p, s = jax.eval_shape(
+            lambda r, layer=layer, spec=spec: layer.init(r, spec),
+            jax.random.PRNGKey(0),
+        )
+        spec = _spec_step(layer, p, s, spec, skip_specs)
+        specs.append(spec)
+    return specs
 
 
 def apply_layer(

@@ -132,6 +132,46 @@ class StageExec:
         return stage_apply
 
 
+class LossGradRunner:
+    """Cached jitted (gathered loss, per-micro-batch cotangents, aux) runner.
+
+    Shared by the single-process engine and the distributed last rank so the
+    hot path never re-traces (cache keyed by chunk sizes / structure /
+    loss_fn; bounded so fresh lambdas can't grow it without limit).
+    """
+
+    def __init__(self, maxsize: int = 16) -> None:
+        self._cache: Dict = {}
+        self._maxsize = maxsize
+
+    def __call__(self, outs: List[Pytree], target: Pytree, loss_fn):
+        sizes = tuple(
+            jax.tree_util.tree_leaves(o)[0].shape[0] for o in outs
+        )
+        treedef = jax.tree_util.tree_structure(outs[0])
+        key = (sizes, treedef, loss_fn)
+        if key not in self._cache:
+            while len(self._cache) >= self._maxsize:
+                self._cache.pop(next(iter(self._cache)))
+
+            def gathered_loss(outs_list, tgt):
+                out = microbatch.gather(outs_list)
+                res = loss_fn(out, tgt)
+                if isinstance(res, tuple):
+                    return res[0], res[1]
+                return res, None
+
+            def run(outs_list, tgt):
+                (loss, aux), gouts = jax.value_and_grad(
+                    gathered_loss, has_aux=True
+                )(outs_list, tgt)
+                return loss, gouts, aux
+
+            self._cache[key] = jax.jit(run)
+
+        return self._cache[key](outs, target)
+
+
 class Pipeline:
     """Schedules micro-batches over stages following GPipe fill-drain.
 
@@ -143,7 +183,7 @@ class Pipeline:
     def __init__(self, stages: Sequence[StageExec], layout: SkipLayout) -> None:
         self.stages = list(stages)
         self.layout = layout
-        self._loss_grad_cache: Dict = {}
+        self._loss_grad = LossGradRunner()
 
     # ------------------------------------------------------------------ #
     # forward-only (inference / no-grad)                                 #
@@ -286,33 +326,4 @@ class Pipeline:
         last_dev = self.stages[-1].device
         outs = [_transfer(o, last_dev) for o in outs]
         target = _transfer(target, last_dev)
-
-        sizes = tuple(
-            jax.tree_util.tree_leaves(o)[0].shape[0] for o in outs
-        )
-        treedef = jax.tree_util.tree_structure(outs[0])
-        key = (sizes, treedef, loss_fn)
-        if key not in self._loss_grad_cache:
-            # Bound the cache: a user passing a fresh lambda per step would
-            # otherwise grow compiled executables without limit (pass a
-            # stable loss_fn to avoid recompilation entirely).
-            while len(self._loss_grad_cache) >= 16:
-                self._loss_grad_cache.pop(next(iter(self._loss_grad_cache)))
-
-            def gathered_loss(outs_list, tgt):
-                out = microbatch.gather(outs_list)
-                res = loss_fn(out, tgt)
-                if isinstance(res, tuple):
-                    return res[0], res[1]
-                return res, None
-
-            def run(outs_list, tgt):
-                (loss, aux), gouts = jax.value_and_grad(
-                    gathered_loss, has_aux=True
-                )(outs_list, tgt)
-                return loss, gouts, aux
-
-            self._loss_grad_cache[key] = jax.jit(run)
-
-        loss, gouts, aux = self._loss_grad_cache[key](outs, target)
-        return loss, gouts, aux
+        return self._loss_grad(outs, target, loss_fn)
