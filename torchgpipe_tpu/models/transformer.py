@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from torchgpipe_tpu.layers import Layer, chain
+from torchgpipe_tpu.parallel import attention
+from torchgpipe_tpu.parallel.ring_attention import axis_bound
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,6 +39,10 @@ class TransformerConfig:
     rope_theta: float = 500000.0  # Llama-3 default
     norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.float32  # bfloat16 for TPU benches
+    # Sequence/context parallelism: name of the mesh axis the sequence is
+    # sharded over (ring attention + sp-offset rotary positions).  None =
+    # single-shard sequences.  See torchgpipe_tpu.parallel.ring_attention.
+    sp_axis: Optional[str] = None
 
     @property
     def kv_heads(self) -> int:
@@ -76,13 +82,15 @@ def rms_norm(dim: int, *, eps: float = 1e-5, name: str = "rmsnorm") -> Layer:
     return Layer(name=name, init=init, apply=apply)
 
 
-def _rope(x: jnp.ndarray, theta: float) -> jnp.ndarray:
+def _rope(x: jnp.ndarray, theta: float, pos_offset=0) -> jnp.ndarray:
     """Rotary position embedding over the trailing head_dim, positions from
-    shape (x: [b, s, heads, head_dim])."""
+    shape plus ``pos_offset`` (x: [b, s, heads, head_dim]).  A non-zero
+    offset gives sequence-parallel shards their *global* token positions."""
     b, s, h, d = x.shape
     half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    ang = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]  # [s, half]
+    positions = pos_offset + jnp.arange(s, dtype=jnp.float32)
+    ang = positions[:, None] * freqs[None, :]  # [s, half]
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
@@ -128,22 +136,27 @@ def transformer_block(cfg: TransformerConfig, *, name: str = "block") -> Layer:
         del rng, train
         b, s, _ = x.shape
 
+        # Sequence parallelism: when the sp axis is bound (inside the SPMD
+        # engine's shard_map), shards carry global rotary positions and run
+        # ring attention; unbound (init-time inference, single-device use)
+        # the local array is the whole sequence.
+        sp_active = axis_bound(cfg.sp_axis)
+        pos_offset = (
+            jax.lax.axis_index(cfg.sp_axis) * s if sp_active else 0
+        )
+
         h = _rms(x, params["ln1"], cfg.norm_eps)
         q = (h @ params["wq"]).reshape(b, s, nh, hd)
         k = (h @ params["wk"]).reshape(b, s, nkv, hd)
         v = (h @ params["wv"]).reshape(b, s, nkv, hd)
-        q = _rope(q, cfg.rope_theta)
-        k = _rope(k, cfg.rope_theta)
-        if nkv != nh:
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (hd ** -0.5)
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, nh * hd)
-        x = x + attn @ params["wo"]
+        q = _rope(q, cfg.rope_theta, pos_offset)
+        k = _rope(k, cfg.rope_theta, pos_offset)
+        # GQA: K/V stay at n_kv heads — the attention kernel groups queries
+        # at the compute site, so the sp ring only moves n_kv-head blocks.
+        attn = attention(
+            q, k, v, axis_name=cfg.sp_axis if sp_active else None, causal=True
+        )
+        x = x + attn.reshape(b, s, nh * hd) @ params["wo"]
 
         h = _rms(x, params["ln2"], cfg.norm_eps)
         gate = jax.nn.silu(h @ params["w_gate"])
@@ -151,7 +164,15 @@ def transformer_block(cfg: TransformerConfig, *, name: str = "block") -> Layer:
         x = x + (gate * up) @ params["w_down"]
         return x, state
 
-    return Layer(name=name, init=init, apply=apply)
+    return Layer(
+        name=name,
+        init=init,
+        apply=apply,
+        # Declares which sp axis (if any) the block's attention collects
+        # over, so the SPMD engine can reject a cfg/engine mismatch instead
+        # of silently computing shard-local attention.
+        meta={"kind": "transformer_block", "sp_axis": cfg.sp_axis},
+    )
 
 
 def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:

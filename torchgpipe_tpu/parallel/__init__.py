@@ -1,0 +1,14 @@
+"""Parallelism building blocks beyond the pipeline engines.
+
+Long-context / sequence-context parallelism is first-class in this framework
+(the reference predates it — SURVEY.md §5 "long-context: ABSENT"; this is new
+TPU-native capability, not ported behavior): ring attention over an ``sp``
+mesh axis composes with the SPMD pipeline's ``pp`` and ``dp`` axes in one
+compiled program.
+"""
+
+from torchgpipe_tpu.parallel.ring_attention import (  # noqa: F401
+    attention,
+    full_attention,
+    ring_attention,
+)

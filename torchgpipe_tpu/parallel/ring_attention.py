@@ -1,0 +1,193 @@
+"""Ring attention: exact attention over sequence shards on a device ring.
+
+Long sequences are sharded over an ``sp`` mesh axis; each device holds
+``[b, s/sp, h, d]`` of Q, K, V.  K/V blocks rotate around the ring via
+``lax.ppermute`` (neighbor ICI transfers on TPU) while each device
+accumulates its queries' attention with the streaming (online-softmax)
+recurrence — numerically exact, never materializing the full ``[s, s]``
+score matrix.  Each ring step is ``jax.checkpoint``-ed, so backward
+recomputes one block at a time: activation memory is O(s/sp) per device,
+which is what makes million-token contexts feasible (Liu et al., "Ring
+Attention with Blockwise Transformers", arXiv:2310.01889 — public
+technique, implemented here from the math).
+
+The reference framework has no sequence/context parallelism at all
+(SURVEY.md §5); this module is the TPU-native new capability that composes
+with the pipeline (``pp``) and data (``dp``) axes in
+:class:`~torchgpipe_tpu.spmd.SpmdGPipe`.
+
+Differentiable end-to-end: the ``ppermute`` transposes route K/V cotangents
+backwards around the ring automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30  # large negative instead of -inf: keeps grads NaN-free
+
+
+def _group(q, n_kv: int):
+    """[b, s, h, d] -> [b, s, g, r, d] with h = g*r grouped onto kv heads.
+
+    GQA support at the compute site: K/V stay at their n_kv heads (so the
+    ring only moves n_kv-head blocks) and queries are grouped to match.
+    Query head ``h`` maps to kv head ``h // r`` — the same pairing as
+    ``jnp.repeat(k, r, axis=2)``.
+    """
+    b, s, h, d = q.shape
+    return q.reshape(b, s, n_kv, h // n_kv, d)
+
+
+def _scores(q, k, sm_scale):
+    # q [b, sq, h, d] x k [b, sk, g, d] (g divides h) -> [b, h, sq, sk];
+    # f32 accumulation on the MXU (inputs may be bf16).
+    g = k.shape[2]
+    qg = _group(q, g)
+    s = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    b, _, r, sq, sk = s.shape
+    return s.reshape(b, g * r, sq, sk)
+
+
+def _weighted_v(p, v):
+    # p [b, h, sq, sk] x v [b, sk, g, d] -> [b, h, sq, d]
+    b, h, sq, sk = p.shape
+    g = v.shape[2]
+    pg = p.reshape(b, g, h // g, sq, sk)
+    o = jnp.einsum(
+        "bgrqk,bkgd->bgrqd", pg, v, preferred_element_type=jnp.float32
+    )
+    return o.reshape(b, h, sq, v.shape[-1])
+
+
+def full_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Plain dense attention (single-device oracle / sp-disabled path).
+
+    ``q``: ``[b, s, h, d]``; ``k, v``: ``[b, s, g, d]`` with ``g`` dividing
+    ``h`` (grouped-query attention; ``g == h`` is plain MHA).  Returns
+    ``[b, s, h, d]``.
+    """
+    d = q.shape[-1]
+    sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+    s = _scores(q, k, sm_scale)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.transpose(
+        _weighted_v(p.astype(v.dtype), v), (0, 2, 1, 3)
+    ).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact attention over sequence shards on the ``axis_name`` ring.
+
+    Must be called inside a ``shard_map`` (or other collective context) where
+    ``axis_name`` is bound; ``q, k, v`` are the local shards
+    ``[b, s_local, h, d]`` of a global ``[b, s, h, d]``, all shards equal
+    size.  Returns the local output shard.
+    """
+    b, sq, h, d = q.shape
+    sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+    sp = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    qpos = rank * sq + jnp.arange(sq)
+
+    def block_update(o, l, m, kc, vc, i):
+        """One streaming-softmax accumulation against the K/V block that
+        originated on rank - i (equal shard sizes give its positions)."""
+        src = (rank - i) % sp
+        s = _scores(q, kc, sm_scale)  # [b, h, sq, sk] f32
+        if causal:
+            kpos = src * sq + jnp.arange(sq)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])  # [b, h, sq, sk]
+        corr = jnp.exp(m - m_new)  # [b, h, sq]
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + _weighted_v(p.astype(vc.dtype), vc)
+        return o_new, l_new, m_new
+
+    def step(carry, i):
+        o, l, m, kc, vc = carry
+        o, l, m = block_update(o, l, m, kc, vc, i)
+        k_next = lax.ppermute(kc, axis_name, perm)
+        v_next = lax.ppermute(vc, axis_name, perm)
+        return (o, l, m, k_next, v_next), ()
+
+    # Step 0 processes the local (diagonal) block, so every causal query row
+    # sees at least itself before any fully-masked block arrives; the running
+    # max is finite from the first step on.
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    m0 = jnp.full((b, h, sq), _NEG, jnp.float32)
+
+    # Rotate only sp-1 times: the last block needs no onward hand-off, so its
+    # ppermute pair never enters the program (it would sit on the critical
+    # path of every attention call).
+    (o, l, m, kc, vc), _ = lax.scan(
+        jax.checkpoint(step), (o0, l0, m0, k, v), jnp.arange(sp - 1)
+    )
+    o, l, m = jax.checkpoint(block_update)(o, l, m, kc, vc, sp - 1)
+    out = o / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def axis_bound(name: Optional[str]) -> bool:
+    """True if ``name`` is a collective axis bound in the current trace.
+
+    Layers use this so one ``apply`` serves both deployment shapes: inside a
+    ``shard_map`` over ``name`` the sequence is sharded (ring path); outside
+    — including init-time shape inference — the local array IS the whole
+    sequence (dense path, same shapes).
+    """
+    if name is None:
+        return False
+    try:
+        lax.psum(1, name)
+    except NameError:
+        return False
+    return True
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: Optional[str] = None,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Dispatch: ring attention when a sequence-parallel axis is bound, dense
+    attention otherwise.  One call site serves both deployment shapes."""
+    if not axis_bound(axis_name):
+        return full_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return ring_attention(
+        q, k, v, axis_name, causal=causal, sm_scale=sm_scale
+    )

@@ -51,6 +51,21 @@ from torchgpipe_tpu.layers import Layer
 Pytree = Any
 
 
+def _declared_sp_axes(layer: Layer) -> list:
+    """Collect ``meta['sp_axis']`` declarations, recursing into compounds."""
+    out = []
+    meta = layer.meta
+    if isinstance(meta, dict):
+        if meta.get("kind") == "compound":
+            children = meta["children"]
+            values = children.values() if isinstance(children, dict) else children
+            for child in values:
+                out.extend(_declared_sp_axes(child))
+        elif "sp_axis" in meta:
+            out.append(meta["sp_axis"])
+    return out
+
+
 def _shard_map(fn, mesh, in_specs, out_specs):
     try:
         return jax.shard_map(
@@ -96,6 +111,7 @@ class SpmdGPipe:
     checkpoint: str = "always"
     pp_axis: str = "pp"
     dp_axis: Optional[str] = None
+    sp_axis: Optional[str] = None
     loss_reduction: Optional[str] = "mean"
 
     def __post_init__(self):
@@ -118,8 +134,30 @@ class SpmdGPipe:
             )
         if self.dp_axis is not None and self.dp_axis not in self.mesh.axis_names:
             raise ValueError(f"mesh has no {self.dp_axis!r} axis: {self.mesh}")
+        if self.sp_axis is not None and self.sp_axis not in self.mesh.axis_names:
+            raise ValueError(f"mesh has no {self.sp_axis!r} axis: {self.mesh}")
         if self.checkpoint not in ("always", "never"):
             raise ValueError("SPMD engine supports checkpoint='always'|'never'")
+        if self.sp_axis is not None and self.loss_reduction is None:
+            raise ValueError(
+                "sequence parallelism needs a batch/token-decomposable loss: "
+                "set loss_reduction='mean' or 'sum'"
+            )
+        # Layers that collect over a sequence axis declare it in meta
+        # (e.g. TransformerConfig.sp_axis); a mismatch with the engine's
+        # sp_axis would silently compute shard-local attention / bogus
+        # rotary offsets, so fail loudly instead.
+        declared = set()
+        for lyr in (self.block, self.pre, self.post):
+            if lyr is not None:
+                declared.update(_declared_sp_axes(lyr))
+        if declared and declared != {self.sp_axis}:
+            raise ValueError(
+                f"model layers declare sp_axis {sorted(map(str, declared))} "
+                f"but the engine was given sp_axis={self.sp_axis!r}; set "
+                "both from the same value (e.g. TransformerConfig.sp_axis "
+                "and SpmdGPipe.sp_axis)"
+            )
 
         raw_apply = self.block.apply
 
@@ -261,8 +299,13 @@ class SpmdGPipe:
     # ------------------------------------------------------------------ #
 
     def _data_specs(self):
-        batch_axes = (None, self.dp_axis) if self.dp_axis else (None,)
-        return P(*batch_axes)
+        # Stacked data is [m, batch, seq, ...]: micro-batch axis unsharded,
+        # batch over dp, sequence over sp (when enabled).
+        if self.sp_axis:
+            return P(None, self.dp_axis, self.sp_axis)
+        if self.dp_axis:
+            return P(None, self.dp_axis)
+        return P(None)
 
     def _apply_pre(self, pre_params, x_mb, rng, train: bool):
         """Apply ``pre`` per micro-batch with independent keys (matching the
@@ -368,6 +411,13 @@ class SpmdGPipe:
             if self.dp_axis:
                 loss = lax.pmean(loss, self.dp_axis)
                 grads = lax.pmean(grads, self.dp_axis)
+            if self.sp_axis:
+                # Params are replicated over sp; each lane differentiated its
+                # own token shard's loss.  mean-reduction: global loss/grad is
+                # the lane mean; sum-reduction: the lane sum.
+                red = lax.pmean if self.loss_reduction == "mean" else lax.psum
+                loss = red(loss, self.sp_axis)
+                grads = red(grads, self.sp_axis)
             return loss, grads
 
         param_specs = {"blocks": P(self.pp_axis)}
@@ -388,7 +438,7 @@ class SpmdGPipe:
         )
         return jax.jit(mapped)
 
-    def _check_batch(self, x) -> None:
+    def _check_batch(self, x, target=None) -> None:
         dp = self.mesh.shape[self.dp_axis] if self.dp_axis else 1
         b = microbatch.batch_size(x)
         if b % (self.chunks * dp) != 0:
@@ -398,6 +448,21 @@ class SpmdGPipe:
                 "(pad the batch, or use the MPMD GPipe engine for ragged "
                 "micro-batches)"
             )
+        if self.sp_axis:
+            sp = self.mesh.shape[self.sp_axis]
+            trees = [("input", x)]
+            if target is not None:
+                # Targets ride the same sharding specs as inputs, so they
+                # need a compatible sequence dim too.
+                trees.append(("target", target))
+            for what, tree in trees:
+                for leaf in jax.tree_util.tree_leaves(tree):
+                    if leaf.ndim < 2 or leaf.shape[1] % sp != 0:
+                        raise ValueError(
+                            f"sequence parallelism shards data dim 1 over "
+                            f"{self.sp_axis}={sp}; got {what} leaf shape "
+                            f"{leaf.shape}"
+                        )
 
     def train_step(self, params, x, target, rng=None):
         """One pipelined forward+backward; returns ``(loss, grads)``.
@@ -407,7 +472,7 @@ class SpmdGPipe:
         randomness (dropout raises loudly without it, matching the MPMD
         engine); omit it for deterministic models.
         """
-        self._check_batch(x)
+        self._check_batch(x, target)
         use_rng = rng is not None
         if use_rng not in self._train_step_fns:
             self._train_step_fns[use_rng] = self._build_train_step(use_rng)
@@ -468,13 +533,16 @@ def _zeros(spec):
 
 
 def make_mesh(
-    n_stages: int, dp: int = 1, *, devices: Optional[Sequence] = None
+    n_stages: int, dp: int = 1, sp: int = 1, *, devices: Optional[Sequence] = None
 ) -> Mesh:
-    """Build a ('pp', 'dp') mesh from the available devices."""
+    """Build a ('pp', 'dp'[, 'sp']) mesh from the available devices."""
     if devices is None:
         devices = jax.devices()
-    need = n_stages * dp
+    need = n_stages * dp * sp
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")
+    if sp > 1:
+        arr = np.array(devices[:need]).reshape(n_stages, dp, sp)
+        return Mesh(arr, ("pp", "dp", "sp"))
     arr = np.array(devices[:need]).reshape(n_stages, dp)
     return Mesh(arr, ("pp", "dp"))
