@@ -1,0 +1,11 @@
+"""Benchmark drivers reproducing the reference's experiment grids on TPU.
+
+Counterpart of the reference's ``benchmarks/`` tree (SURVEY.md §2.4): speed
+(samples/sec) and memory (params + per-device peak bytes) drivers for
+AmoebaNet-D / sequential ResNet-101 / U-Net, an accuracy driver, and the
+multi-process distributed driver.  Run any driver with ``--help``::
+
+    python -m benchmarks.amoebanetd_speed n8m32
+    python -m benchmarks.unet_memory pipeline-4
+    python -m benchmarks.distributed_accuracy --rank 0 --world 2 ...
+"""

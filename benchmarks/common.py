@@ -1,0 +1,146 @@
+"""Shared benchmark-driver plumbing.
+
+Mirrors the reference drivers' structure (timed epochs over synthetic data,
+``HH:MM:SS | throughput`` progress lines — reference:
+benchmarks/amoebanetd-speed/main.py:121-138, 235-265) on the TPU-native
+engine: one :func:`run_speed` / :func:`run_memory` pair serves every model
+family.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu.gpipe import GPipe
+from torchgpipe_tpu.layers import Layer
+
+
+def hr_time(seconds: float) -> str:
+    m, s = divmod(int(seconds), 60)
+    h, m = divmod(m, 60)
+    return f"{h:02d}:{m:02d}:{s:02d}"
+
+
+def even_balance(n_layers: int, n_stages: int) -> List[int]:
+    base, rem = divmod(n_layers, n_stages)
+    return [base + (1 if j >= n_stages - rem else 0) for j in range(n_stages)]
+
+
+def softmax_xent(out, tgt):
+    logits = out.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits.reshape(-1, logits.shape[-1]))
+    return -jnp.mean(logp[jnp.arange(logp.shape[0]), tgt.reshape(-1)])
+
+
+def mse(out, tgt):
+    return jnp.mean((out.astype(jnp.float32) - tgt) ** 2)
+
+
+def build_gpipe(
+    layers: Sequence[Layer],
+    balance: Optional[Sequence[int]],
+    n_stages: int,
+    chunks: int,
+    checkpoint: str,
+    devices=None,
+) -> GPipe:
+    if balance is None:
+        balance = even_balance(len(layers), n_stages)
+    return GPipe(
+        list(layers), balance, chunks=chunks, checkpoint=checkpoint,
+        devices=devices,
+    )
+
+
+def run_speed(
+    model: GPipe,
+    x,
+    y,
+    loss_fn: Callable,
+    *,
+    epochs: int = 3,
+    steps_per_epoch: int = 10,
+    skip_epochs: int = 1,
+    label: str = "experiment",
+) -> float:
+    """Timed training epochs; returns steady-state samples/sec.
+
+    Reference loop shape: benchmarks/amoebanetd-speed/main.py:235-265
+    (first epoch discarded as warm-up/compile).
+    """
+    in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    rng = jax.random.PRNGKey(1)
+    batch = x.shape[0]
+
+    throughputs = []
+    t_start = time.time()
+    for epoch in range(epochs):
+        t0 = time.time()
+        for step in range(steps_per_epoch):
+            key = jax.random.fold_in(rng, epoch * steps_per_epoch + step)
+            loss, grads, state, _ = model.value_and_grad(
+                params, state, x, y, loss_fn, rng=key
+            )
+            params = tuple(
+                jax.tree_util.tree_map(lambda p, g: p - 1e-4 * g, ps, gs)
+                for ps, gs in zip(params, grads)
+            )
+        jax.block_until_ready(params)
+        dt = time.time() - t0
+        tput = batch * steps_per_epoch / dt
+        if epoch >= skip_epochs:
+            throughputs.append(tput)
+        print(
+            f"{hr_time(time.time() - t_start)} | {label} | epoch {epoch + 1}: "
+            f"{tput:.1f} samples/sec, loss {float(loss):.4f}"
+            + ("  (warm-up)" if epoch < skip_epochs else ""),
+            flush=True,
+        )
+    return sum(throughputs) / max(1, len(throughputs))
+
+
+def run_memory(
+    model: GPipe, x, y, loss_fn: Callable, *, label: str = "experiment"
+) -> Tuple[int, List[int]]:
+    """Parameter count + per-device peak memory for one training step.
+
+    The reference reads ``torch.cuda.max_memory_*`` per device
+    (benchmarks/unet-memory/main.py RESULT section); TPU equivalent is
+    ``device.memory_stats()['peak_bytes_in_use']`` where available (real TPU),
+    falling back to live params bytes on host platforms.
+    """
+    in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    n_params = sum(
+        leaf.size for leaf in jax.tree_util.tree_leaves(params)
+    )
+    loss, grads, state, _ = model.value_and_grad(
+        params, state, x, y, loss_fn, rng=jax.random.PRNGKey(1)
+    )
+    jax.block_until_ready((loss, grads))
+
+    peaks: List[int] = []
+    for dev in dict.fromkeys(model.devices):
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        if stats and "peak_bytes_in_use" in stats:
+            peaks.append(int(stats["peak_bytes_in_use"]))
+        else:
+            stage_bytes = 0
+            for j, d in enumerate(model.devices):
+                if d == dev:
+                    stage_bytes += sum(
+                        leaf.size * leaf.dtype.itemsize
+                        for leaf in jax.tree_util.tree_leaves(params[j])
+                    )
+            peaks.append(stage_bytes)
+    print(
+        f"RESULT | {label} | parameters: {n_params / 1e6:.1f}M | "
+        f"per-device peak bytes: {[f'{p / 2**20:.0f}MiB' for p in peaks]}",
+        flush=True,
+    )
+    return n_params, peaks

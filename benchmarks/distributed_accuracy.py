@@ -1,0 +1,164 @@
+"""Multi-process distributed pipeline training driver.
+
+Reference: benchmarks/distributed/accuracy/main.py:106-204, 347-368 — one OS
+process per rank joined over RPC (``--rank/--world/--master``), training a
+sequential model split across ranks.  Here ranks join over
+:class:`~torchgpipe_tpu.distributed.TcpTransport` (host-staged sockets, like
+the reference's RPC transport); for single-host multi-device runs prefer the
+in-process engine, and for pod-scale runs the SPMD engine (SURVEY.md §2.3).
+
+Example (two shells)::
+
+    python -m benchmarks.distributed_accuracy --rank 0 --world 2 \
+        --master 127.0.0.1 --port-base 29500
+    python -m benchmarks.distributed_accuracy --rank 1 --world 2 \
+        --master 127.0.0.1 --port-base 29500
+"""
+
+from __future__ import annotations
+
+import time
+
+import click
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.common import even_balance, hr_time, softmax_xent
+from torchgpipe_tpu.balance import balance_by_time
+from torchgpipe_tpu.distributed import (
+    DistributedGPipe,
+    DistributedGPipeDataLoader,
+    TcpTransport,
+)
+from torchgpipe_tpu.layers import sequential_init
+from torchgpipe_tpu.models import resnet50
+from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+
+def _mlp(classes):
+    from torchgpipe_tpu.ops import dense, flatten, relu
+
+    return [
+        flatten(), dense(64, name="fc1"), relu("r1"),
+        dense(64, name="fc2"), relu("r2"), dense(classes, name="fc3"),
+    ]
+
+
+MODELS = {
+    "resnet50": lambda classes: resnet50(num_classes=classes, base_width=16),
+    "llama-small": lambda classes: llama(
+        TransformerConfig(vocab=classes, dim=128, n_layers=4, n_heads=4)
+    ),
+    "mlp": _mlp,  # tiny smoke-test model
+}
+
+
+@click.command()
+@click.option("--rank", required=True, type=int)
+@click.option("--world", required=True, type=int)
+@click.option("--master", default="127.0.0.1")
+@click.option("--port-base", default=29500)
+@click.option("--model", "model_name", default="resnet50",
+              type=click.Choice(sorted(MODELS)))
+@click.option("--balance", default=None, type=str,
+              help="comma-separated per-rank layer counts; default: profiled "
+                   "balance_by_time on rank 0's layer costs (reference: "
+                   "benchmarks/distributed/accuracy/main.py balance_by_time "
+                   "fallback)")
+@click.option("--chunks", default=4)
+@click.option("--batch-size", default=32)
+@click.option("--epochs", default=2)
+@click.option("--steps", default=8)
+@click.option("--classes", default=10)
+@click.option("--image", default=32)
+def main(rank, world, master, port_base, model_name, balance, chunks,
+         batch_size, epochs, steps, classes, image):
+    layers = MODELS[model_name](classes)
+    workers = [f"rank{r}" for r in range(world)]
+    # Each rank listens on port_base + rank; peers dial the master host.
+    addresses = {f"rank{r}": (master, port_base + r) for r in range(world)}
+    addresses[f"rank{rank}"] = ("0.0.0.0", port_base + rank)
+    transport = TcpTransport(f"rank{rank}", addresses)
+
+    if model_name == "llama-small":
+        x0 = jnp.zeros((batch_size, 64), jnp.int32)
+
+        def make_batch(key):
+            # Next-token LM objective: labels are the inputs shifted by one.
+            tokens = jax.random.randint(key, x0.shape, 0, classes)
+            return tokens, jnp.roll(tokens, -1, axis=1)
+    else:
+        shape = (
+            (batch_size, image, image, 3)
+            if model_name == "resnet50"
+            else (batch_size, 16)
+        )
+        x0 = jnp.zeros(shape, jnp.float32)
+
+        def make_batch(key):
+            kx, ky = jax.random.split(key)
+            return (
+                jax.random.normal(kx, x0.shape),
+                jax.random.randint(ky, (batch_size,), 0, classes),
+            )
+    in_spec = jax.ShapeDtypeStruct(x0.shape, x0.dtype)
+
+    if balance:
+        balance = [int(v) for v in balance.split(",")]
+    elif rank == 0:
+        # Profile on rank 0 only and broadcast: wall-clock profiling on every
+        # rank independently could disagree on the balance and deadlock the
+        # pipe with mismatched stage ownership.
+        params0, states0, _ = sequential_init(
+            layers, jax.random.PRNGKey(0), in_spec
+        )
+        balance = balance_by_time(
+            world, layers, params0, states0, x0, timeout=0.5
+        )
+        print(f"[rank 0] profiled balance: {balance}", flush=True)
+        for r in range(1, world):
+            transport.send(f"rank{r}", "balance", 0, balance)
+    else:
+        balance = list(transport.mailbox.get("balance", 0, timeout=600))
+
+    pipe = DistributedGPipe(
+        layers, rank, workers, balance, chunks=chunks,
+        transport=transport, mailbox=transport.mailbox,
+    )
+    params, state = pipe.init(jax.random.PRNGKey(0), in_spec)
+
+    # Only rank 0 feeds data (the loader ships targets to the last rank).
+    data = (
+        [make_batch(jax.random.PRNGKey(100 + s)) for s in range(steps)]
+        if rank == 0
+        else None
+    )
+    loader = DistributedGPipeDataLoader(
+        data, rank, workers,
+        transport=transport, mailbox=transport.mailbox, num_batches=steps,
+    )
+
+    t0 = time.time()
+    for epoch in range(epochs):
+        for step, (xb, yb) in enumerate(loader):
+            key = jax.random.fold_in(jax.random.PRNGKey(7), epoch * steps + step)
+            outs = pipe.forward(params, state, xb, rng=key)
+            if pipe.is_last:
+                loss, gys, _ = pipe.loss_grads(outs, yb, softmax_xent)
+                grads, state = pipe.backward(gys)
+                print(
+                    f"{hr_time(time.time() - t0)} | epoch {epoch + 1} "
+                    f"step {step + 1}: loss {float(loss):.4f}",
+                    flush=True,
+                )
+            else:
+                grads, state = pipe.backward(None)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - 0.05 * g, params, list(grads)
+            )
+    transport.close()
+    print(f"[rank {rank}] done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

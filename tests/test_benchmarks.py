@@ -1,0 +1,108 @@
+"""Benchmark-driver smoke tests: every driver runs end-to-end at toy scale
+(the reference ships its drivers untested; here CI covers them)."""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+from click.testing import CliRunner
+
+
+def _invoke(cli, args):
+    result = CliRunner().invoke(cli, args, catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    return result.output
+
+
+def test_amoebanetd_speed_driver():
+    from benchmarks.amoebanetd_speed import main
+
+    out = _invoke(main, [
+        "n2m4", "--epochs", "1", "--steps", "1",
+        "--num-layers", "3", "--num-filters", "8",
+        "--image", "32", "--batch", "4",
+    ])
+    assert "FINAL | amoebanetd-speed n2m4" in out
+
+
+def test_resnet_speed_driver():
+    from benchmarks.resnet101_speed import main
+
+    out = _invoke(main, [
+        "pipeline-2", "--epochs", "1", "--steps", "1",
+        "--image", "32", "--batch", "4", "--base-width", "8",
+    ])
+    assert "FINAL | resnet101-speed pipeline-2" in out
+
+
+def test_unet_speed_driver():
+    from benchmarks.unet_speed import main
+
+    out = _invoke(main, [
+        "pipeline-2", "--epochs", "1", "--steps", "1", "--image", "16",
+        "--batch", "4", "--depth", "2", "--num-convs", "1",
+        "--base-channels", "4",
+    ])
+    assert "FINAL | unet-speed pipeline-2" in out
+
+
+def test_unet_memory_driver():
+    from benchmarks.unet_memory import main
+
+    out = _invoke(main, [
+        "baseline", "--image", "16", "--batch", "2", "--chunks", "1",
+        "--depth", "2", "--num-convs", "1", "--base-channels", "4",
+    ])
+    assert "RESULT | unet-memory baseline" in out
+    assert "parameters:" in out
+
+
+def test_resnet_accuracy_driver():
+    from benchmarks.resnet101_accuracy import main
+
+    out = _invoke(main, [
+        "pipeline-256", "--epochs", "1", "--image", "16",
+        "--dataset-size", "4", "--classes", "4", "--base-width", "8",
+    ])
+    assert "top-1" in out
+
+
+def test_distributed_driver_two_real_processes():
+    """Two OS processes over real TCP sockets — the reference never tests its
+    RPC mode cross-process; this does."""
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    port = free_port()
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    env = dict(
+        os.environ,
+        PYTHONPATH=repo,
+        JAX_PLATFORMS="cpu",
+    )
+    cmd = [
+        sys.executable, "-m", "benchmarks.distributed_accuracy",
+        "--world", "2", "--master", "127.0.0.1",
+        "--port-base", str(port), "--model", "mlp",
+        "--balance", "3,3", "--chunks", "2", "--batch-size", "4",
+        "--epochs", "1", "--steps", "2", "--classes", "4",
+    ]
+    procs = [
+        subprocess.Popen(
+            cmd + ["--rank", str(r)], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for r in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    assert all(p.returncode == 0 for p in procs), "\n---\n".join(outs)
+    assert "loss" in outs[1], outs[1]
+    assert "[rank 0] done" in outs[0]

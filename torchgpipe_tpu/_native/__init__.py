@@ -1,0 +1,114 @@
+"""Native (C++) runtime components, built on demand and loaded via ctypes.
+
+The shared library is compiled from ``csrc/tgpu_native.cpp`` with the host
+toolchain the first time it is needed and cached under ``build/`` keyed by a
+source hash; every entry point has a pure-Python fallback, so the framework
+works (slower) without a compiler.  ctypes is used instead of pybind11 by
+design (no build-time Python dependency, trivial cross-version caching).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "csrc", "tgpu_native.cpp")
+_BUILD_DIR = os.path.join(_DIR, "build")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _compile_and_load() -> Optional[ctypes.CDLL]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_BUILD_DIR, f"tgpu_native_{digest}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-o", tmp, _SRC,
+        ]
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+    lib = ctypes.CDLL(so_path)
+    lib.tgpu_blockpartition.restype = ctypes.c_int64
+    lib.tgpu_blockpartition.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.tgpu_clock_cycles.restype = ctypes.c_int64
+    lib.tgpu_clock_cycles.argtypes = [
+        ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None if unavailable (no compiler)."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is None and not _load_failed:
+            try:
+                _lib = _compile_and_load()
+            except Exception:
+                _load_failed = True
+    return _lib
+
+
+def blockpartition_sizes(
+    costs: Sequence[float], partitions: int
+) -> Optional[List[int]]:
+    """Native exact min-max contiguous partition; None if no native lib.
+
+    Identical results (including tie-breaking) to
+    :func:`torchgpipe_tpu.balance.blockpartition.solve_sizes`.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(costs)
+    c_costs = (ctypes.c_double * n)(*[float(c) for c in costs])
+    out = (ctypes.c_int64 * max(1, partitions))()
+    rc = lib.tgpu_blockpartition(c_costs, n, partitions, out)
+    if rc != 0:
+        raise ValueError(
+            f"sequence length is less than intended partitions (sequence: {n}, "
+            f"partitions: {partitions})"
+            if n < partitions
+            else "partitions must be a positive integer"
+        )
+    return [int(v) for v in out]
+
+
+def clock_cycles_native(m: int, n: int) -> Optional[List[List[tuple]]]:
+    """Native fill-drain schedule enumeration; None if no native lib."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    counts = (ctypes.c_int64 * (m + n - 1))()
+    cells = (ctypes.c_int64 * (2 * m * n))()
+    cycles = lib.tgpu_clock_cycles(m, n, counts, cells)
+    if cycles < 0:
+        raise ValueError("m and n must be positive")
+    out: List[List[tuple]] = []
+    w = 0
+    for t in range(cycles):
+        row = []
+        for _ in range(counts[t]):
+            row.append((int(cells[2 * w]), int(cells[2 * w + 1])))
+            w += 1
+        out.append(row)
+    return out

@@ -1,0 +1,98 @@
+// Native runtime components for torchgpipe_tpu.
+//
+// The reference is pure Python (SURVEY.md §2: "no native components
+// anywhere"); this library implements the framework's host-side
+// compute-bound utilities in C++ where Python-level cost is measurable:
+//
+//  * tgpu_blockpartition — exact contiguous block partitioning (min-max
+//    block sum) used by the auto-balancer (counterpart of the reference's
+//    Bárány-Grinberg heuristic, torchgpipe/balance/blockpartition.py:11-89).
+//    Semantics are bit-identical to the Python DP in
+//    torchgpipe_tpu/balance/blockpartition.py (first-best tie-breaking), so
+//    either implementation may serve a call.
+//
+//  * tgpu_clock_cycles — GPipe fill-drain schedule cell enumeration
+//    (reference: torchgpipe/pipeline.py:49-65), used by schedule-analysis
+//    tooling for large m*n grids.
+//
+// Build: g++ -O3 -shared -fPIC (driven by torchgpipe_tpu/_native/__init__.py,
+// cached next to the package; ctypes binding, no pybind11 dependency).
+
+#include <cstddef>
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+extern "C" {
+
+// Split costs[0..n) into k contiguous non-empty blocks minimizing the
+// maximum block sum (tie-break: earliest cut, matching the Python DP).
+// Writes k block lengths into out_sizes. Returns 0 on success, -1 on
+// infeasible input (k < 1 or n < k).
+std::int64_t tgpu_blockpartition(const double* costs, std::int64_t n,
+                                 std::int64_t k, std::int64_t* out_sizes) {
+  if (k < 1 || n < k) return -1;
+  const double INF = std::numeric_limits<double>::infinity();
+
+  std::vector<double> prefix(static_cast<size_t>(n) + 1, 0.0);
+  for (std::int64_t i = 0; i < n; ++i) prefix[i + 1] = prefix[i] + costs[i];
+
+  // dp[kk][j]: minimal max-block-sum splitting costs[0..j) into kk blocks.
+  std::vector<std::vector<double>> dp(
+      k + 1, std::vector<double>(static_cast<size_t>(n) + 1, INF));
+  std::vector<std::vector<std::int64_t>> cut(
+      k + 1, std::vector<std::int64_t>(static_cast<size_t>(n) + 1, 0));
+  dp[0][0] = 0.0;
+  for (std::int64_t kk = 1; kk <= k; ++kk) {
+    for (std::int64_t j = kk; j <= n - (k - kk); ++j) {
+      double best = INF;
+      std::int64_t best_i = kk - 1;
+      for (std::int64_t i = kk - 1; i < j; ++i) {
+        const double block = prefix[j] - prefix[i];
+        const double cand = dp[kk - 1][i] > block ? dp[kk - 1][i] : block;
+        if (cand < best) {
+          best = cand;
+          best_i = i;
+        }
+      }
+      dp[kk][j] = best;
+      cut[kk][j] = best_i;
+    }
+  }
+
+  std::int64_t j = n;
+  for (std::int64_t kk = k; kk >= 1; --kk) {
+    const std::int64_t i = cut[kk][j];
+    out_sizes[kk - 1] = j - i;
+    j = i;
+  }
+  return 0;
+}
+
+// Enumerate the GPipe fill-drain schedule: for m micro-batches over n
+// stages there are m + n - 1 clock cycles; cycle t runs cells (i, j) with
+// i + j == t. Writes per-cycle cell counts into out_counts[m + n - 1] and
+// flattened (i, j) pairs into out_cells[2 * m * n]. Returns the number of
+// cycles, or -1 on invalid input.
+std::int64_t tgpu_clock_cycles(std::int64_t m, std::int64_t n,
+                               std::int64_t* out_counts,
+                               std::int64_t* out_cells) {
+  if (m < 1 || n < 1) return -1;
+  std::int64_t w = 0;
+  const std::int64_t cycles = m + n - 1;
+  for (std::int64_t t = 0; t < cycles; ++t) {
+    std::int64_t count = 0;
+    const std::int64_t j_lo = t - m + 1 > 0 ? t - m + 1 : 0;
+    const std::int64_t j_hi = t + 1 < n ? t + 1 : n;
+    for (std::int64_t j = j_lo; j < j_hi; ++j) {
+      out_cells[2 * w] = t - j;
+      out_cells[2 * w + 1] = j;
+      ++w;
+      ++count;
+    }
+    out_counts[t] = count;
+  }
+  return cycles;
+}
+
+}  // extern "C"
