@@ -47,12 +47,13 @@ def build_gpipe(
     chunks: int,
     checkpoint: str,
     devices=None,
+    tracer=None,
 ) -> GPipe:
     if balance is None:
         balance = even_balance(len(layers), n_stages)
     return GPipe(
         list(layers), balance, chunks=chunks, checkpoint=checkpoint,
-        devices=devices,
+        devices=devices, tracer=tracer,
     )
 
 

@@ -106,3 +106,15 @@ def test_distributed_driver_two_real_processes():
     assert all(p.returncode == 0 for p in procs), "\n---\n".join(outs)
     assert "loss" in outs[1], outs[1]
     assert "[rank 0] done" in outs[0]
+
+
+def test_unet_timeline_driver():
+    from benchmarks.unet_timeline import main
+
+    out = _invoke(main, [
+        "--stages", "2", "--chunks", "2", "--image", "16", "--batch", "4",
+        "--depth", "2", "--num-convs", "1", "--base-channels", "4",
+        "--steps", "1",
+    ])
+    assert "overlap speedup" in out
+    assert "analytic GPipe bubble" in out

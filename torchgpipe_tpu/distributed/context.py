@@ -34,6 +34,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -143,9 +144,16 @@ class TcpTransport:
     binds its own address and receives into its :class:`Mailbox`.
     """
 
-    def __init__(self, name: str, addresses: Dict[str, Tuple[str, int]]) -> None:
+    def __init__(
+        self,
+        name: str,
+        addresses: Dict[str, Tuple[str, int]],
+        *,
+        connect_timeout: float = 120.0,
+    ) -> None:
         self.name = name
         self.addresses = dict(addresses)
+        self.connect_timeout = connect_timeout
         self.mailbox = Mailbox(name)
         host, port = self.addresses[name]
         self._server = socketserver.ThreadingTCPServer(
@@ -173,7 +181,24 @@ class TcpTransport:
             (kind, index, _to_host(payload)), protocol=pickle.HIGHEST_PROTOCOL
         )
         host, port = self.addresses[dst]
-        with socket.create_connection((host, port)) as sock:
+        # Rendezvous tolerance: ranks are launched by hand in separate
+        # shells (see benchmarks.distributed_accuracy), so the peer's
+        # listener may not be up yet — retry refused connections until
+        # connect_timeout instead of crashing the first sender.
+        deadline = time.monotonic() + self.connect_timeout
+        while True:
+            try:
+                sock = socket.create_connection((host, port), timeout=30)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"worker {self.name!r} could not reach {dst!r} at "
+                        f"{host}:{port} within {self.connect_timeout}s — is "
+                        "that rank running?"
+                    ) from None
+                time.sleep(0.5)
+        with sock:
             sock.sendall(struct.pack("!Q", len(blob)) + blob)
 
     def close(self) -> None:

@@ -60,6 +60,7 @@ class GPipe:
         chunks: int = 1,
         checkpoint: str = "except_last",
         deferred_batch_norm: bool = False,
+        tracer=None,
     ) -> None:
         if balance is None:
             raise ValueError(
@@ -106,7 +107,11 @@ class GPipe:
                 StageExec(j, part, offset, self.devices[j], self.skip_layout)
             )
             offset += len(part)
-        self._pipeline = Pipeline(stages, self.skip_layout)
+        # Optional torchgpipe_tpu.utils.tracing.Timeline recording per-cell
+        # dispatch (or, with sync=True, serialized per-cell device time —
+        # the overlap-ablation tool, SURVEY.md §5 tracing).
+        self.tracer = tracer
+        self._pipeline = Pipeline(stages, self.skip_layout, tracer=tracer)
 
     # ------------------------------------------------------------------ #
     # container protocol (reference gpipe.py:257-285)                    #

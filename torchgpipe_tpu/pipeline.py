@@ -189,9 +189,15 @@ class Pipeline:
     rides the autograd engine, SURVEY.md §3.3).
     """
 
-    def __init__(self, stages: Sequence[StageExec], layout: SkipLayout) -> None:
+    def __init__(
+        self,
+        stages: Sequence[StageExec],
+        layout: SkipLayout,
+        tracer=None,
+    ) -> None:
         self.stages = list(stages)
         self.layout = layout
+        self.tracer = tracer  # torchgpipe_tpu.utils.tracing.Timeline or None
         self._loss_grad = LossGradRunner()
 
     # ------------------------------------------------------------------ #
@@ -223,6 +229,8 @@ class Pipeline:
                 rng_i = jax.random.fold_in(rng, i) if rng is not None else None
                 fwd = stage.fwd_train if train else stage.fwd_eval
                 y, ext, new_state = fwd(params[j], cur_states[j], x, skips_in, rng_i)
+                if self.tracer is not None:
+                    self.tracer.record("fwd", j, i, y)
                 cur_states[j] = new_state
                 for k, v in ext.items():
                     dst = self.stages[self.layout.pop_stage(k)].device
@@ -282,6 +290,8 @@ class Pipeline:
                         params[j], state_in, x, skips_in, rng_i
                     )
                     pulls[(i, j)] = pull
+                if self.tracer is not None:
+                    self.tracer.record("fwd", j, i, y)
                 cur_states[j] = new_state
                 for k, v in ext.items():
                     dst = self.stages[self.layout.pop_stage(k)].device
@@ -317,6 +327,8 @@ class Pipeline:
                 gy = gys.pop((i, j))
                 gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
                 gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
+                if self.tracer is not None:
+                    self.tracer.record("bwd", j, i, gx)
                 acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
                 if j > 0:
                     gys[(i, j - 1)] = _transfer(gx, self.stages[j - 1].device)

@@ -1,0 +1,130 @@
+"""Model persistence: named flat state dicts + file save/load.
+
+Counterpart of the reference's persistence story (SURVEY.md §5
+"checkpoint/resume"): the reference relies on ``nn.Module.state_dict`` with
+keys ``partitions.<j>.<name>...`` (tested at reference
+tests/test_gpipe.py:434, 488-497).  Here params/state are explicit pytrees,
+so persistence is a pure naming transform: flatten per-stage pytrees into a
+``{key: ndarray}`` dict with the same ``partitions.<stage>.<layer>...`` key
+shape, and load into an initialized template by exact key/shape match
+(construct → ``init`` → ``load_state_dict``, the torch flow).
+
+File format is ``.npz`` via :func:`save` / :func:`load` — host-portable,
+no framework pickle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+
+def _leaf_paths(tree: Pytree) -> List[Tuple[str, Any]]:
+    return [
+        (jax.tree_util.keystr(path), leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]
+
+
+def state_dict(
+    model,
+    params: Sequence[Sequence[Pytree]],
+    state: Sequence[Sequence[Pytree]],
+) -> Dict[str, np.ndarray]:
+    """Flat named mapping for a :class:`~torchgpipe_tpu.gpipe.GPipe` model.
+
+    Keys: ``partitions.<stage>.<layer_name>.params<path>`` and
+    ``...state<path>`` — stage and layer identity preserved, like the
+    reference's ``partitions.<j>.<name>`` keys
+    (reference: torchgpipe/gpipe.py:257-285 container protocol +
+    tests/test_gpipe.py:434).
+    """
+    out: Dict[str, np.ndarray] = {}
+
+    def put(key: str, leaf) -> None:
+        if key in out:
+            raise ValueError(
+                f"duplicate state-dict key {key!r}: layer names must be "
+                "unique within a stage (see layers.named) or the checkpoint "
+                "would silently drop parameters"
+            )
+        out[key] = np.asarray(leaf)
+
+    for j, part in enumerate(model.partitions):
+        for li, layer in enumerate(part):
+            base = f"partitions.{j}.{layer.name}"
+            for path, leaf in _leaf_paths(params[j][li]):
+                put(f"{base}.params{path}", leaf)
+            for path, leaf in _leaf_paths(state[j][li]):
+                put(f"{base}.state{path}", leaf)
+    return out
+
+
+def load_state_dict(
+    model,
+    params: Sequence[Sequence[Pytree]],
+    state: Sequence[Sequence[Pytree]],
+    d: Dict[str, np.ndarray],
+):
+    """Replace every leaf of an initialized ``(params, state)`` template with
+    the identically-keyed array from ``d``.
+
+    Strict: missing keys, unexpected keys, and shape mismatches all raise
+    (the ``load_state_dict(strict=True)`` contract).  Returns new
+    ``(params, state)`` placed on the model's stage devices.
+    """
+    remaining = dict(d)
+
+    def rebuild(kind: str, template):
+        rebuilt = []
+        for j, part in enumerate(model.partitions):
+            stage_items = []
+            for li, layer in enumerate(part):
+                base = f"partitions.{j}.{layer.name}.{kind}"
+                leaves, treedef = jax.tree_util.tree_flatten_with_path(
+                    template[j][li]
+                )
+                new_leaves = []
+                for path, leaf in leaves:
+                    key = f"{base}{jax.tree_util.keystr(path)}"
+                    if key not in remaining:
+                        raise KeyError(f"state dict is missing {key!r}")
+                    arr = remaining.pop(key)
+                    if tuple(arr.shape) != tuple(leaf.shape):
+                        raise ValueError(
+                            f"shape mismatch for {key!r}: saved {arr.shape}, "
+                            f"model expects {leaf.shape}"
+                        )
+                    new_leaves.append(np.asarray(arr).astype(leaf.dtype))
+                stage_items.append(
+                    jax.tree_util.tree_unflatten(
+                        jax.tree_util.tree_structure(template[j][li]),
+                        new_leaves,
+                    )
+                )
+            rebuilt.append(stage_items)
+        return tuple(rebuilt)
+
+    new_params = rebuild("params", params)
+    new_state = rebuild("state", state)
+    if remaining:
+        raise KeyError(
+            f"unexpected keys in state dict: {sorted(remaining)[:5]}"
+            + ("..." if len(remaining) > 5 else "")
+        )
+    return model.place(new_params), model.place(new_state)
+
+
+def save(path: str, d: Dict[str, np.ndarray]) -> None:
+    """Write a flat state dict to ``path`` (.npz)."""
+    np.savez(path, **d)
+
+
+def load(path: str) -> Dict[str, np.ndarray]:
+    """Read a flat state dict written by :func:`save`."""
+    with np.load(path) as f:
+        return {k: f[k] for k in f.files}

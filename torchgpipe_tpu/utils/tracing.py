@@ -1,0 +1,148 @@
+"""Pipeline timeline tracing.
+
+Counterpart of the reference's timeline/ablation tooling (SURVEY.md §5:
+benchmarks/unet-timeline samples GPU utilization from a side process;
+the balancer has its own profiler).  TPU-native redesign: the engine itself
+records per-cell (micro-batch, stage) dispatch/ready intervals — no side
+process, no `nvidia-smi` — plus a thin wrapper over the JAX device profiler
+for XLA-level traces viewable in TensorBoard/Perfetto.
+
+Usage::
+
+    tracer = Timeline()
+    model = GPipe(layers, balance, chunks=8, tracer=tracer)
+    model.value_and_grad(...)
+    print(tracer.summary())
+    tracer.events  # [(name, stage, mbatch, t_start, t_end), ...]
+
+``Timeline.sync=True`` turns the tracer into the *ablation* tool: every cell
+is forced to completion before the next is dispatched, serializing the
+pipeline — measuring how much of the throughput comes from cross-stage
+overlap (the question the reference's unet-timeline experiments answer by
+monkey-patching deps/streams, benchmarks/unet-timeline/main.py:22-75).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Any, Iterator, List, Optional, Tuple
+
+import jax
+
+
+@dataclasses.dataclass
+class TimelineEvent:
+    name: str  # "fwd" | "bwd" | "loss" | ...
+    stage: int
+    mbatch: int
+    t_start: float
+    t_end: float
+
+    @property
+    def duration(self) -> float:
+        return self.t_end - self.t_start
+
+
+class Timeline:
+    """Per-cell dispatch recorder for the MPMD engine.
+
+    With ``sync=False`` (default) the recorded interval is the *dispatch*
+    cost (JAX is async; device work overlaps).  With ``sync=True`` each cell
+    is blocked to completion — true per-cell device time, zero overlap: the
+    serialized-pipeline ablation baseline.
+    """
+
+    def __init__(self, sync: bool = False) -> None:
+        self.sync = sync
+        self.events: List[TimelineEvent] = []
+        self._t0 = time.perf_counter()
+
+    def reset(self) -> None:
+        self.events.clear()
+        self._t0 = time.perf_counter()
+
+    def record(self, name: str, stage: int, mbatch: int, out: Any = None):
+        """Record one cell; blocks on ``out`` when ``sync`` is set."""
+        t_start = time.perf_counter() - self._t0
+        if self.sync and out is not None:
+            jax.block_until_ready(out)
+        t_end = time.perf_counter() - self._t0
+        self.events.append(TimelineEvent(name, stage, mbatch, t_start, t_end))
+        return out
+
+    # ------------------------------------------------------------------ #
+
+    def by_stage(self) -> dict:
+        out: dict = {}
+        for ev in self.events:
+            out.setdefault(ev.stage, []).append(ev)
+        return out
+
+    def summary(self) -> str:
+        if not self.events:
+            return "timeline: no events"
+        total = max(ev.t_end for ev in self.events) - min(
+            ev.t_start for ev in self.events
+        )
+        lines = [
+            f"timeline: {len(self.events)} cells over {total * 1e3:.1f}ms "
+            f"({'sync/serialized' if self.sync else 'async dispatch'})"
+        ]
+        for stage, evs in sorted(self.by_stage().items()):
+            busy = sum(ev.duration for ev in evs)
+            lines.append(
+                f"  stage {stage}: {len(evs)} cells, "
+                f"busy {busy * 1e3:.1f}ms ({100 * busy / total:.0f}%)"
+            )
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str) -> Iterator[None]:
+    """XLA-level device profile (TensorBoard `logdir`), wrapping
+    :func:`jax.profiler.start_trace` — the TPU-native replacement for the
+    reference's `nvidia-smi` sampler."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def simulate_pipeline(
+    events: List[TimelineEvent], n_stages: int
+) -> Optional[Tuple[float, float, float]]:
+    """Project measured per-cell times onto the fill-drain schedule.
+
+    Takes a *sync* timeline (true per-cell device durations) and computes the
+    makespan the GPipe schedule would achieve with perfect overlap:
+    ``finish(i, j) = max(finish(i-1, j), finish(i, j-1)) + t(i, j)`` per
+    phase, forward and backward separated by the loss barrier.  Returns
+    ``(makespan_seconds, busy_fraction, bubble_fraction)``; the bubble can
+    be compared against the analytic GPipe bubble ``(n-1)/(m+n-1)`` — the
+    gap is stage imbalance (the analytic figure assumes uniform cells).
+    """
+    if not events:
+        return None
+    by_phase: dict = {}
+    for ev in events:
+        by_phase.setdefault(ev.name, {})[(ev.mbatch, ev.stage)] = ev.duration
+    makespan = 0.0
+    for cells in by_phase.values():
+        m = 1 + max(i for i, _ in cells)
+        n = 1 + max(j for _, j in cells)
+        finish = [[0.0] * n for _ in range(m)]
+        for i in range(m):
+            for j in range(n):
+                prev = max(
+                    finish[i - 1][j] if i else 0.0,
+                    finish[i][j - 1] if j else 0.0,
+                )
+                finish[i][j] = prev + cells.get((i, j), 0.0)
+        makespan += finish[m - 1][n - 1]
+    if makespan <= 0:
+        return None
+    busy = sum(ev.duration for ev in events) / (n_stages * makespan)
+    return makespan, busy, 1.0 - busy
