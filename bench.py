@@ -108,11 +108,17 @@ def main() -> None:
         )
         return loss, grads, state
 
-    # Warm-up (compile) then timed steps.
+    # Warm-up (compile) then timed steps; iteration count adapts so the
+    # timed phase stays ~30s regardless of hardware.
     loss, grads, state2 = step(params, state, rng)
     jax.block_until_ready((loss, grads))
 
-    n_iters = 10 if platform == "tpu" else 3
+    t_probe = time.perf_counter()
+    loss, grads, _ = step(params, state, jax.random.fold_in(rng, 999))
+    jax.block_until_ready((loss, grads))
+    step_time = time.perf_counter() - t_probe
+    n_iters = max(3, min(30, int(30.0 / max(step_time, 1e-3))))
+
     t0 = time.perf_counter()
     for i in range(n_iters):
         loss, grads, _ = step(params, state, jax.random.fold_in(rng, i))

@@ -131,13 +131,16 @@ class DistributedGPipe:
         propagation through earlier ranks' layers is abstract (no FLOPs, no
         memory).
         """
-        specs = sequential_specs(self.layers, in_spec)
-        params, state = [], []
-        for li, layer in enumerate(self.partition):
-            g = self.offset + li
-            p, s = layer.init(jax.random.fold_in(rng, g), specs[g])
-            params.append(p)
-            state.append(s)
+        from torchgpipe_tpu.utils import host_device
+
+        with host_device():
+            specs = sequential_specs(self.layers, in_spec)
+            params, state = [], []
+            for li, layer in enumerate(self.partition):
+                g = self.offset + li
+                p, s = layer.init(jax.random.fold_in(rng, g), specs[g])
+                params.append(p)
+                state.append(s)
         return (
             jax.device_put(params, self.device),
             jax.device_put(state, self.device),

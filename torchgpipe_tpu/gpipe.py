@@ -44,6 +44,9 @@ from torchgpipe_tpu.skip import inspect_skip_layout, verify_skippables
 Pytree = Any
 
 
+from torchgpipe_tpu.utils import host_device as _host_device  # noqa: E402
+
+
 class GPipe:
     """Pipeline parallelism over a sequential layer list.
 
@@ -135,10 +138,17 @@ class GPipe:
     ) -> Tuple[Tuple[List[Pytree], ...], Tuple[List[Pytree], ...]]:
         """Initialize parameters/state, grouped per stage and placed on the
         stage devices (the reference moves partitions in ``split_module``,
-        gpipe.py:117)."""
-        flat_params, flat_state, _ = sequential_init(
-            self.layers, rng, in_spec
-        )
+        gpipe.py:117).
+
+        Initialization itself runs on the host CPU backend and transfers
+        once per stage: init is hundreds of tiny ops (one per weight), and
+        dispatching each through an accelerator round-trip dominates start-up
+        time on remote-attached TPUs.
+        """
+        with _host_device():
+            flat_params, flat_state, _ = sequential_init(
+                self.layers, rng, in_spec
+            )
         params, state = [], []
         i = 0
         for part in self.partitions:

@@ -175,7 +175,16 @@ class SpmdGPipe:
 
     def init(self, rng: jax.Array, in_spec: Pytree) -> Pytree:
         """Initialize {'pre', 'blocks', 'post'} params; blocks stacked on a
-        leading stage axis and sharded over ``pp``."""
+        leading stage axis and sharded over ``pp``.  Init math runs on the
+        host CPU backend (see utils.host_device), then :meth:`place` commits
+        the stacked pytrees to the mesh."""
+        from torchgpipe_tpu.utils import host_device
+
+        with host_device():
+            params = self._init_host(rng, in_spec)
+        return self.place(params)
+
+    def _init_host(self, rng: jax.Array, in_spec: Pytree) -> dict:
         params: dict = {}
         spec = in_spec
         if self.pre is not None:
@@ -222,7 +231,7 @@ class SpmdGPipe:
             self._check_stateless(s, "post")
             params["post"] = p
 
-        return self.place(params)
+        return params
 
     def place(self, params: dict) -> dict:
         """Commit params to the mesh: blocks stage-sharded over ``pp``,

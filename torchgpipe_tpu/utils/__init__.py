@@ -25,3 +25,21 @@ def tree_allclose(a: Pytree, b: Pytree, *, rtol: float = 1e-5, atol: float = 1e-
 def param_count(tree: Pytree) -> int:
     """Total number of scalar parameters in a pytree."""
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
+
+
+def host_device():
+    """Context placing computation on the host CPU backend (no-op fallback
+    when unavailable).
+
+    Used by the engines' ``init``: initialization is hundreds of tiny ops
+    (one per weight); dispatching each through an accelerator round-trip
+    dominates start-up on remote-attached TPUs, so init on host, then
+    transfer placed pytrees once.
+    """
+    import contextlib
+
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except Exception:
+        return contextlib.nullcontext()
+    return jax.default_device(cpu)
