@@ -108,8 +108,8 @@ def main() -> None:
         )
         return loss, grads, state
 
-    # Warm-up (compile) then timed steps; iteration count adapts so the
-    # timed phase stays ~30s regardless of hardware.
+    # Warm-up (compile) then timed steps; iteration count adapts to keep the
+    # timed phase at most ~30s (and at least 3 steps) on any hardware.
     loss, grads, state2 = step(params, state, rng)
     jax.block_until_ready((loss, grads))
 

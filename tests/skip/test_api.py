@@ -1,0 +1,126 @@
+"""Skip subsystem unit tests: namespaces, static verification, layout.
+
+Reference test tree: tests/skip/{test_api,test_verify_skippables,
+test_namespace,test_inspect_skip_layout}.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.layers import stateless
+from torchgpipe_tpu.ops import dense
+from torchgpipe_tpu.partition import split_layers
+from torchgpipe_tpu.skip import (
+    Namespace,
+    inspect_skip_layout,
+    pop_add,
+    pop_cat,
+    skippable,
+    stash,
+    verify_skippables,
+)
+
+
+def test_namespace_identity_and_ordering():
+    a, b = Namespace(), Namespace()
+    assert a != b and a == a
+    assert len({a, b, a}) == 2  # hashable
+    assert (a < b) != (b < a)  # orderable either way, deterministically
+
+
+def test_verify_pop_before_stash():
+    layers = [pop_add("x", name="popper"), stash("x", name="stasher")]
+    with pytest.raises(TypeError, match="pops 'x' before it is stashed"):
+        verify_skippables(layers)
+
+
+def test_verify_unpopped_stash():
+    layers = [stash("x", name="stasher"), dense(4)]
+    with pytest.raises(TypeError, match="no layer pops 'x'"):
+        verify_skippables(layers)
+
+
+def test_verify_duplicate_stash_needs_namespace():
+    layers = [
+        stash("x", name="s1"), pop_add("x", name="p1"),
+        stash("x", name="s2"), pop_add("x", name="p2"),
+    ]
+    # Same (default) namespace: duplicates rejected with the namespace hint.
+    with pytest.raises(TypeError, match="different Namespace"):
+        verify_skippables(layers)
+    # Isolated namespaces: fine (reference: skippable.isolate(ns)).
+    ns1, ns2 = Namespace(), Namespace()
+    layers = [
+        stash("x", ns=ns1, name="s1"), pop_add("x", ns=ns1, name="p1"),
+        stash("x", ns=ns2, name="s2"), pop_add("x", ns=ns2, name="p2"),
+    ]
+    verify_skippables(layers)
+
+
+def test_layout_routing_table():
+    ns = Namespace()
+    layers = [
+        stash("a", ns=ns, name="s"),
+        stateless("mid", lambda x: x * 2),
+        dense(4, name="d"),
+        pop_add("a", ns=ns, name="p"),
+    ]
+    verify_skippables(layers)
+    parts = split_layers(layers, [1, 2, 1])
+    layout = inspect_skip_layout(parts)
+    (key,) = layout.by_key
+    assert layout.stash_stage(key) == 0
+    assert layout.pop_stage(key) == 3 - 1  # stage index 2
+    assert layout.requires_copy(key)
+    assert layout.external_stashes(0) == [key]
+    assert layout.external_pops(2) == [key]
+    # Intermediate stage never sees the skip.
+    assert layout.external_stashes(1) == [] and layout.external_pops(1) == []
+
+
+def test_layout_same_stage_skip_is_internal():
+    ns = Namespace()
+    layers = [stash("a", ns=ns), pop_add("a", ns=ns)]
+    layout = inspect_skip_layout(split_layers(layers, [2]))
+    (key,) = layout.by_key
+    assert not layout.requires_copy(key)
+    assert layout.external_stashes(0) == []
+
+
+def test_skippable_undeclared_stash_rejected():
+    def fn(x, pops):
+        return x, {"oops": x}
+
+    layer = skippable(fn, stash=[], name="bad")
+    with pytest.raises(RuntimeError, match="undeclared"):
+        layer.apply((), (), jnp.ones((2, 2)), pops={})
+
+
+def test_skippable_missing_stash_rejected():
+    def fn(x, pops):
+        return x, {}
+
+    layer = skippable(fn, stash=["need"], name="lazy")
+    with pytest.raises(RuntimeError, match="did not stash"):
+        layer.apply((), (), jnp.ones((2, 2)), pops={})
+
+
+def test_pop_cat_and_pop_add_semantics():
+    ns = Namespace()
+    x = jnp.arange(8.0).reshape(2, 4)
+    skips = {}
+    from torchgpipe_tpu.layers import apply_layer
+
+    s = stash("v", ns=ns)
+    y, _ = apply_layer(s, (), (), x, skips)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    cat = pop_cat("v", ns=ns)
+    y2, _ = apply_layer(cat, (), (), x, dict(skips))
+    assert y2.shape == (2, 8)
+
+    add = pop_add("v", ns=ns)
+    y3, _ = apply_layer(add, (), (), x, dict(skips))
+    np.testing.assert_array_equal(np.asarray(y3), np.asarray(2 * x))

@@ -136,3 +136,21 @@ def test_load_state_dict_strictness():
     bad[k] = np.zeros((1, 1, 1))
     with pytest.raises(ValueError, match="shape mismatch"):
         load_state_dict(model, params, state, bad)
+
+
+def test_simulate_pipeline_multistep_averaging():
+    # Repeated observations of the same cell (multi-step timeline) must
+    # average into one representative step — busy stays <= 1.
+    from torchgpipe_tpu.utils.tracing import TimelineEvent
+
+    m, n, t = 4, 2, 0.01
+    events = [
+        TimelineEvent("fwd", j, i, 0.0, t)
+        for _ in range(3)  # three identical steps
+        for i in range(m)
+        for j in range(n)
+    ]
+    makespan, busy, bubble = simulate_pipeline(events, n)
+    assert abs(makespan - (m + n - 1) * t) < 1e-12
+    assert 0.0 < busy <= 1.0
+    assert abs(bubble - (n - 1) / (m + n - 1)) < 1e-9

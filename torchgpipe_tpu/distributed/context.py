@@ -190,15 +190,21 @@ class TcpTransport:
             try:
                 sock = socket.create_connection((host, port), timeout=30)
                 break
-            except OSError:
+            except (ConnectionRefusedError, ConnectionResetError,
+                    ConnectionAbortedError) as err:
+                # Only genuinely transient rendezvous failures are retried;
+                # misconfiguration (bad hostname etc.) raises immediately.
                 if time.monotonic() >= deadline:
                     raise TimeoutError(
                         f"worker {self.name!r} could not reach {dst!r} at "
                         f"{host}:{port} within {self.connect_timeout}s — is "
                         "that rank running?"
-                    ) from None
+                    ) from err
                 time.sleep(0.5)
         with sock:
+            # The connect timeout must not govern the transfer itself: large
+            # activation blobs to a busy peer may legitimately take longer.
+            sock.settimeout(None)
             sock.sendall(struct.pack("!Q", len(blob)) + blob)
 
     def close(self) -> None:

@@ -126,9 +126,18 @@ def simulate_pipeline(
     """
     if not events:
         return None
-    by_phase: dict = {}
+    # A timeline spanning several training steps observes each (i, j) cell
+    # repeatedly; average the observations into one representative step so
+    # makespan and busy time describe the same single step.
+    sums: dict = {}
+    counts: dict = {}
     for ev in events:
-        by_phase.setdefault(ev.name, {})[(ev.mbatch, ev.stage)] = ev.duration
+        key = (ev.name, ev.mbatch, ev.stage)
+        sums[key] = sums.get(key, 0.0) + ev.duration
+        counts[key] = counts.get(key, 0) + 1
+    by_phase: dict = {}
+    for (name, i, j), total in sums.items():
+        by_phase.setdefault(name, {})[(i, j)] = total / counts[(name, i, j)]
     makespan = 0.0
     for cells in by_phase.values():
         m = 1 + max(i for i, _ in cells)
@@ -144,5 +153,7 @@ def simulate_pipeline(
         makespan += finish[m - 1][n - 1]
     if makespan <= 0:
         return None
-    busy = sum(ev.duration for ev in events) / (n_stages * makespan)
+    busy = sum(
+        cell for cells in by_phase.values() for cell in cells.values()
+    ) / (n_stages * makespan)
     return makespan, busy, 1.0 - busy
