@@ -1,0 +1,85 @@
+"""Mixed-precision policy: bf16 compute, f32 masters, f32 norm statistics
+(TPU-native feature; no reference counterpart — the reference trains float32
+only, e.g. benchmarks/resnet101-speed/main.py:235-265)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchgpipe_tpu.gpipe import GPipe
+from torchgpipe_tpu.layers import sequential_apply, sequential_init
+from torchgpipe_tpu.ops import nn
+from torchgpipe_tpu.precision import apply_policy
+
+
+def _model():
+    return [
+        nn.conv2d(8, (3, 3), name="c1"),
+        nn.batch_norm(name="bn1"),
+        nn.relu(),
+        nn.global_avg_pool(),
+        nn.dense(4, name="head"),
+    ]
+
+
+def _loss(out, tgt):
+    logits = out.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(logp[jnp.arange(logp.shape[0]), tgt])
+
+
+def test_policy_dtypes_and_masters():
+    layers = apply_policy(_model(), jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 8, 3))
+    params, state, _ = sequential_init(layers, jax.random.PRNGKey(1),
+                                       jax.ShapeDtypeStruct(x.shape, x.dtype))
+    # Masters stay float32.
+    assert all(
+        l.dtype == jnp.float32
+        for l in jax.tree_util.tree_leaves(params)
+        if jnp.issubdtype(l.dtype, jnp.floating)
+    )
+    out, new_state = sequential_apply(layers, params, state, x)
+    assert out.dtype == jnp.bfloat16
+    # Norm statistics stay float32.
+    assert all(
+        l.dtype == jnp.float32
+        for l in jax.tree_util.tree_leaves(new_state)
+        if jnp.issubdtype(l.dtype, jnp.floating)
+    )
+
+
+def test_gpipe_compute_dtype_grads_f32_and_close_to_f32_model():
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 8, 8, 3))
+    y = jnp.array([0, 1, 2, 3], jnp.int32)
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    lo = GPipe(_model(), balance=[3, 2], chunks=2, compute_dtype=jnp.bfloat16)
+    p_lo, s_lo = lo.init(jax.random.PRNGKey(3), spec)
+    loss_lo, g_lo, _, _ = lo.value_and_grad(p_lo, s_lo, x, y, _loss)
+    assert all(
+        g.dtype == jnp.float32
+        for g in jax.tree_util.tree_leaves(g_lo)
+        if jnp.issubdtype(g.dtype, jnp.floating)
+    )
+
+    hi = GPipe(_model(), balance=[3, 2], chunks=2)
+    p_hi, s_hi = hi.init(jax.random.PRNGKey(3), spec)
+    loss_hi, _, _, _ = hi.value_and_grad(p_hi, s_hi, x, y, _loss)
+    np.testing.assert_allclose(float(loss_lo), float(loss_hi), rtol=0.1, atol=0.05)
+
+
+def test_policy_with_deferred_batch_norm():
+    # compute_dtype composes with deferred_batch_norm: stats/accumulators f32.
+    x = jax.random.normal(jax.random.PRNGKey(4), (4, 8, 8, 3))
+    y = jnp.array([0, 1, 2, 3], jnp.int32)
+    m = GPipe(_model(), balance=[3, 2], chunks=2,
+              deferred_batch_norm=True, compute_dtype=jnp.bfloat16)
+    p, s = m.init(jax.random.PRNGKey(5), jax.ShapeDtypeStruct(x.shape, x.dtype))
+    loss, grads, new_state, _ = m.value_and_grad(p, s, x, y, _loss)
+    assert np.isfinite(float(loss))
+    assert all(
+        l.dtype == jnp.float32
+        for l in jax.tree_util.tree_leaves(new_state)
+        if jnp.issubdtype(l.dtype, jnp.floating)
+    )

@@ -23,7 +23,6 @@ produced by recompute, so the guarantee is structural.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, List, Sequence
 
 import jax
@@ -31,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from torchgpipe_tpu.checkpoint import is_recomputing
-from torchgpipe_tpu.layers import Layer
+from torchgpipe_tpu.layers import Layer, map_layer_tree
 
 
 def deferred_batch_norm(
@@ -123,41 +122,15 @@ def deferred_batch_norm(
     )
 
 
-def _convert_layer(layer: Layer, chunks: int) -> Layer:
+def _convert_leaf(layer: Layer, chunks: int) -> Layer:
     meta: Any = layer.meta
-    if not isinstance(meta, dict):
-        return layer
-    if meta.get("kind") == "batch_norm":
+    if isinstance(meta, dict) and meta.get("kind") == "batch_norm":
         return deferred_batch_norm(
             chunks,
             momentum=meta["momentum"],
             eps=meta["eps"],
             name=layer.name,
         )
-    if meta.get("kind") == "compound":
-        # Recurse into compound layers (chains, structured cells) so
-        # batch-norms buried inside e.g. an AmoebaNet cell are converted too —
-        # the reference converts recursively over child modules
-        # (reference: torchgpipe/batchnorm.py:123-155, ``module.children()``).
-        children = meta["children"]
-        if isinstance(children, dict):
-            new_children: Any = {
-                k: _convert_layer(v, chunks) for k, v in children.items()
-            }
-            if all(new_children[k] is children[k] for k in children):
-                return layer
-        else:
-            new_children = [_convert_layer(v, chunks) for v in children]
-            if all(n is o for n, o in zip(new_children, children)):
-                return layer
-        rebuilt = meta["rebuild"](new_children)
-        if rebuilt.name != layer.name:
-            # The rebuild closure carries the construction-time name; the
-            # layer may have been renamed since (e.g. by ``named()``
-            # disambiguation) — keep the current name so partition-time
-            # uniqueness checks still hold.
-            rebuilt = dataclasses.replace(rebuilt, name=layer.name)
-        return rebuilt
     return layer
 
 
@@ -170,6 +143,11 @@ def convert_deferred_batch_norm(
     (``DeferredBatchNorm.convert_deferred_batch_norm``), driven from
     GPipe.__init__ (gpipe.py:242).  Conversion happens *before* ``init`` so
     parameter shapes are unaffected; only the state pytree grows accumulators.
-    Recurses into compound layers via their ``meta`` rebuild protocol.
+    Recurses into compound layers via their ``meta`` rebuild protocol
+    (the reference converts recursively over child modules,
+    torchgpipe/batchnorm.py:123-155 ``module.children()``).
     """
-    return [_convert_layer(layer, chunks) for layer in layers]
+    return [
+        map_layer_tree(layer, lambda l: _convert_leaf(l, chunks))
+        for layer in layers
+    ]

@@ -63,6 +63,7 @@ class GPipe:
         chunks: int = 1,
         checkpoint: str = "except_last",
         deferred_batch_norm: bool = False,
+        compute_dtype: Optional[Any] = None,  # a jnp dtype, e.g. jnp.bfloat16
         tracer=None,
     ) -> None:
         if balance is None:
@@ -85,6 +86,16 @@ class GPipe:
         self._deferred_batch_norm = deferred_batch_norm
         if deferred_batch_norm:
             layers = convert_deferred_batch_norm(layers, chunks)
+        if compute_dtype is not None:
+            # Mixed precision (no reference counterpart — a TPU-native
+            # feature): float32 masters, compute_dtype math/activations,
+            # float32 normalization statistics.  Applied after deferred-BN
+            # conversion so the converted norm layers get the float32-stats
+            # wrapper too.
+            from torchgpipe_tpu.precision import apply_policy
+
+            layers = apply_policy(layers, compute_dtype)
+        self.compute_dtype = compute_dtype
 
         self.layers = layers
         self.balance = list(balance)

@@ -186,6 +186,37 @@ def structured(
     )
 
 
+def map_layer_tree(layer: Layer, leaf_fn: Callable[[Layer], Layer]) -> Layer:
+    """Structurally transform a layer, recursing into compound children.
+
+    ``leaf_fn`` is applied to every non-compound layer; compound layers are
+    rebuilt through their ``meta['rebuild']`` protocol with transformed
+    children (preserving a post-construction rename, e.g. by :func:`named`).
+    Shared by deferred-batch-norm conversion and the mixed-precision policy.
+    """
+    meta = layer.meta
+    if isinstance(meta, dict) and meta.get("kind") == "compound":
+        children = meta["children"]
+        if isinstance(children, dict):
+            new_children: Any = {
+                k: map_layer_tree(v, leaf_fn) for k, v in children.items()
+            }
+            unchanged = all(new_children[k] is children[k] for k in children)
+        else:
+            new_children = [map_layer_tree(v, leaf_fn) for v in children]
+            unchanged = all(n is o for n, o in zip(new_children, children))
+        if unchanged:
+            return layer
+        rebuilt = meta["rebuild"](new_children)
+        if rebuilt.name != layer.name:
+            # The rebuild closure carries the construction-time name; keep the
+            # current (possibly disambiguated) name so partition-time
+            # uniqueness checks still hold.
+            rebuilt = dataclasses.replace(rebuilt, name=layer.name)
+        return rebuilt
+    return leaf_fn(layer)
+
+
 def named(layers: Sequence[Layer]) -> List[Layer]:
     """Disambiguate duplicate layer names by suffixing an index.
 

@@ -79,7 +79,9 @@ def rms_norm(dim: int, *, eps: float = 1e-5, name: str = "rmsnorm") -> Layer:
         del rng, train
         return _rms(x, params["scale"], eps), state
 
-    return Layer(name=name, init=init, apply=apply)
+    return Layer(
+        name=name, init=init, apply=apply, meta={"kind": "rms_norm", "eps": eps}
+    )
 
 
 def _rope(x: jnp.ndarray, theta: float, pos_offset=0) -> jnp.ndarray:

@@ -11,6 +11,7 @@ its own thin layer set so models are plain JAX and lower cleanly onto the MXU:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -149,7 +150,9 @@ def layer_norm(*, eps: float = 1e-6, name: str = "ln") -> Layer:
         y = (x - mean) * lax.rsqrt(var + eps)
         return y * params["scale"] + params["bias"], state
 
-    return Layer(name=name, init=init, apply=apply)
+    return Layer(
+        name=name, init=init, apply=apply, meta={"kind": "layer_norm", "eps": eps}
+    )
 
 
 def dropout(rate: float, *, name: str = "dropout") -> Layer:
@@ -240,7 +243,8 @@ def instance_norm(*, eps: float = 1e-5, name: str = "in") -> Layer:
         var = jnp.var(x, axes, keepdims=True)
         return (x - mean) * lax.rsqrt(var + eps)
 
-    return stateless(name, fn)
+    layer = stateless(name, fn)
+    return dataclasses.replace(layer, meta={"kind": "instance_norm", "eps": eps})
 
 
 def leaky_relu(negative_slope: float = 0.01, *, name: str = "leaky_relu") -> Layer:

@@ -1,0 +1,129 @@
+"""Mixed-precision policy: bfloat16 compute with float32 master params.
+
+The reference has no precision machinery — CUDA-era torchgpipe trains float32
+end to end (its benchmarks never cast, e.g. benchmarks/resnet101-speed/
+main.py:235-265).  On TPU the MXU natively multiplies bfloat16 at twice the
+float32 rate and activation traffic halves, so a precision policy is a
+first-class framework feature here:
+
+* **master params stay float32** — ``init`` is untouched; the cast to the
+  compute dtype happens inside ``apply``, so the cotangent of the cast
+  delivers float32 gradients and optimizer math stays full precision,
+* **activations flow in the compute dtype** — including stage-to-stage
+  hand-off (half the ICI bytes) and saved/recomputed checkpoints,
+* **normalization statistics stay float32** — batch-norm (plain and
+  deferred), instance-norm and layer-norm run on a float32 upcast of their
+  input and cast the result back down, the standard numerically-safe policy.
+
+Apply the policy with :func:`apply_policy` (recursing into compound layers via
+their ``meta`` rebuild protocol, like
+:func:`torchgpipe_tpu.batchnorm.convert_deferred_batch_norm`), or pass
+``compute_dtype=jnp.bfloat16`` to :class:`torchgpipe_tpu.gpipe.GPipe`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu.layers import Layer, map_layer_tree
+
+# Layer meta kinds whose math must see float32 inputs (statistics layers).
+# Every norm constructor in the framework tags its meta with one of these
+# (ops.nn.batch_norm/layer_norm/instance_norm, batchnorm.deferred_batch_norm,
+# models.transformer.rms_norm).
+_NORM_KINDS = (
+    "batch_norm",
+    "deferred_batch_norm",
+    "layer_norm",
+    "instance_norm",
+    "rms_norm",
+)
+
+
+def _cast_floats(tree: Any, dtype) -> Any:
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        else a,
+        tree,
+    )
+
+
+def _wrap_compute(layer: Layer, dtype) -> Layer:
+    """Run ``layer`` in ``dtype``: float params and inputs are cast down."""
+    raw_apply = layer.apply
+
+    if layer.stash or layer.pop:
+
+        def apply(params, state, x, *, pops=None, rng=None, train=True):
+            y, stashed, s = raw_apply(
+                _cast_floats(params, dtype),
+                state,
+                _cast_floats(x, dtype),
+                pops=_cast_floats(pops, dtype),
+                rng=rng,
+                train=train,
+            )
+            return y, stashed, s
+
+    else:
+
+        def apply(params, state, x, *, rng=None, train=True):
+            return raw_apply(
+                _cast_floats(params, dtype),
+                state,
+                _cast_floats(x, dtype),
+                rng=rng,
+                train=train,
+            )
+
+    return dataclasses.replace(layer, apply=apply)
+
+
+def _wrap_norm(layer: Layer, dtype) -> Layer:
+    """Run a statistics layer in float32, returning the compute dtype."""
+    raw_apply = layer.apply
+
+    def apply(params, state, x, *, rng=None, train=True):
+        y, s = raw_apply(
+            params,
+            state,
+            _cast_floats(x, jnp.float32),
+            rng=rng,
+            train=train,
+        )
+        return _cast_floats(y, dtype), s
+
+    return dataclasses.replace(layer, apply=apply)
+
+
+def _is_norm(layer: Layer) -> bool:
+    meta = layer.meta
+    return isinstance(meta, dict) and meta.get("kind") in _NORM_KINDS
+
+
+def _convert_leaf(layer: Layer, dtype) -> Layer:
+    if _is_norm(layer):
+        return _wrap_norm(layer, dtype)
+    return _wrap_compute(layer, dtype)
+
+
+def apply_policy(
+    layers: Sequence[Layer], compute_dtype=jnp.bfloat16
+) -> List[Layer]:
+    """Return layers rewritten to compute in ``compute_dtype``.
+
+    Parameter pytrees (from ``init``) keep their original dtypes; only the
+    in-``apply`` math changes.  Passing ``float32`` returns the layers
+    unchanged.
+    """
+    if compute_dtype == jnp.float32:
+        return list(layers)
+    return [
+        map_layer_tree(layer, lambda l: _convert_leaf(l, compute_dtype))
+        for layer in layers
+    ]
