@@ -64,6 +64,7 @@ class GPipe:
         checkpoint: str = "except_last",
         deferred_batch_norm: bool = False,
         compute_dtype: Optional[Any] = None,  # a jnp dtype, e.g. jnp.bfloat16
+        fused: Optional[bool] = None,  # None = auto (fuse when single-device)
         tracer=None,
     ) -> None:
         if balance is None:
@@ -125,6 +126,21 @@ class GPipe:
         # dispatch (or, with sync=True, serialized per-cell device time —
         # the overlap-ablation tool, SURVEY.md §5 tracing).
         self.tracer = tracer
+        if fused:
+            if len({id(d) for d in self.devices}) > 1:
+                raise ValueError(
+                    "fused=True requires all stages on one device (the fused "
+                    "path compiles the whole step into a single program); "
+                    "pass devices=[one_device] or leave fused=None for the "
+                    "per-cell multi-device scheduler"
+                )
+            if tracer is not None:
+                raise ValueError(
+                    "fused=True compiles the step into one program, so a "
+                    "per-cell tracer would record nothing; drop the tracer "
+                    "or pass fused=False"
+                )
+        self.fused = fused
         self._pipeline = Pipeline(stages, self.skip_layout, tracer=tracer)
 
     # ------------------------------------------------------------------ #
@@ -195,9 +211,14 @@ class GPipe:
         """
         microbatch.check(x)
         mbatches = microbatch.scatter(x, self.chunks)
-        outs, new_states = self._pipeline.run_forward(
-            params, state, mbatches, rng, train
-        )
+        if self._use_fused():
+            outs, new_states = self._pipeline.run_forward_fused(
+                params, state, mbatches, rng, train
+            )
+        else:
+            outs, new_states = self._pipeline.run_forward(
+                params, state, mbatches, rng, train
+            )
         return microbatch.gather(outs), tuple(new_states)
 
     def value_and_grad(
@@ -233,7 +254,21 @@ class GPipe:
                 f"(batch size {microbatch.batch_size(x)})"
             )
         stop = checkpoint_stop(self.checkpoint, len(mbatches), train=True)
-        loss, grads, new_states, aux = self._pipeline.run_train(
-            params, state, mbatches, target, loss_fn, rng, stop
-        )
+        if self._use_fused():
+            loss, grads, new_states, aux = self._pipeline.run_train_fused(
+                params, state, mbatches, target, loss_fn, rng, stop
+            )
+        else:
+            loss, grads, new_states, aux = self._pipeline.run_train(
+                params, state, mbatches, target, loss_fn, rng, stop
+            )
         return loss, tuple(grads), tuple(new_states), aux
+
+    def _use_fused(self) -> bool:
+        """Fuse the whole step into one XLA program when every stage shares
+        one device (dispatch latency dominates there; see
+        Pipeline.run_train_fused).  The per-cell scheduler is kept when a
+        tracer wants per-cell events or the user forces it."""
+        if self.fused is not None:
+            return self.fused
+        return self.tracer is None and self._pipeline.single_device()

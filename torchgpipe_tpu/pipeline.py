@@ -86,6 +86,8 @@ class StageExec:
         self._layout = layout
 
         stage_apply = self._make_stage_apply()
+        # Raw (unjitted) variant for the fused single-device engine path.
+        self.stage_apply = stage_apply
 
         def diff_fwd(params, state, x, skips_in, rng):
             def g(p, xx, sk):
@@ -199,6 +201,7 @@ class Pipeline:
         self.layout = layout
         self.tracer = tracer  # torchgpipe_tpu.utils.tracing.Timeline or None
         self._loss_grad = LossGradRunner()
+        self._fused: Dict = {}  # fused single-device step cache
 
     # ------------------------------------------------------------------ #
     # forward-only (inference / no-grad)                                 #
@@ -337,6 +340,159 @@ class Pipeline:
                     gskips[(i, k)] = _transfer(g, dst)
 
         return loss, acc, cur_states, aux
+
+    # ------------------------------------------------------------------ #
+    # fused single-device path                                           #
+    # ------------------------------------------------------------------ #
+
+    def single_device(self) -> bool:
+        """True when every stage lives on the same physical device."""
+        return len({id(s.device) for s in self.stages}) == 1
+
+    def _fused_cell(self, stage: StageExec, checkpointed: bool):
+        """One (micro-batch, stage) cell for the fused trace; ``jax.checkpoint``
+        reproduces the engine's activation-memory profile per cell."""
+        fn = stage.stage_apply
+
+        if not checkpointed:
+            return lambda p, s, x, sk, key: fn(p, s, x, sk, key, True)
+
+        # static_argnums: none — train=True baked in; rng may be None, which
+        # jax.checkpoint tolerates as a pytree leaf-less input.
+        def cell(p, s, x, sk, key):
+            return fn(p, s, x, sk, key, True)
+
+        return jax.checkpoint(cell)
+
+    def _fused_forward_loop(self, cell_of, m, params, states, mbatches, rng):
+        """The micro-batch × stage loop shared by both fused traces.
+
+        ``cell_of(i, j)`` returns the cell callable for micro-batch ``i`` on
+        stage ``j`` with signature ``(params, state, x, skips_in, rng)``.
+        """
+        cur_states = list(states)
+        skip_vals: Dict = {}
+        outs = []
+        for i in range(m):
+            rng_i = jax.random.fold_in(rng, i) if rng is not None else None
+            x = mbatches[i]
+            for j, stage in enumerate(self.stages):
+                skips_in = {k: skip_vals.pop((i, k)) for k in stage.ext_pop_keys}
+                x, ext, new_state = cell_of(i, j)(
+                    params[j], cur_states[j], x, skips_in, rng_i
+                )
+                cur_states[j] = new_state
+                for k, v in ext.items():
+                    skip_vals[(i, k)] = v
+            outs.append(x)
+        return outs, cur_states
+
+    def _fused_jit(self, kind, mbatches, extra_key, build):
+        """Bounded cache of fused jitted programs, keyed by micro-batch
+        shapes/structure plus ``extra_key``."""
+        sizes = tuple(
+            tuple(l.shape for l in jax.tree_util.tree_leaves(mb))
+            for mb in mbatches
+        )
+        key = (
+            kind, sizes, jax.tree_util.tree_structure(mbatches[0]), extra_key
+        )
+        fn = self._fused.get(key)
+        if fn is None:
+            while len(self._fused) >= 8:
+                self._fused.pop(next(iter(self._fused)))
+            fn = jax.jit(build())
+            self._fused[key] = fn
+        return fn
+
+    def run_train_fused(
+        self,
+        params: Sequence[Pytree],
+        states: Sequence[Pytree],
+        mbatches: List[Pytree],
+        target: Pytree,
+        loss_fn,
+        rng: Optional[jax.Array],
+        checkpoint_stop: int,
+    ):
+        """Whole training step as ONE compiled XLA program.
+
+        Semantically identical to :meth:`run_train` (same cell math, same
+        checkpoint policy via ``jax.checkpoint`` per cell, same gathered
+        loss), but with a single device dispatch instead of one per cell —
+        the TPU-native answer to the reference's worker threads when all
+        stages share a chip: XLA schedules the whole step, so host/dispatch
+        latency (dominant on remote-attached TPUs) is paid once.  Used
+        automatically by :class:`torchgpipe_tpu.gpipe.GPipe` when every stage
+        maps to the same device; the per-cell scheduler remains the
+        multi-device path (its dispatch pipelining is what overlaps stages
+        across chips).
+        """
+        m = len(mbatches)
+        fn = self._fused_jit(
+            "train", mbatches, (loss_fn, checkpoint_stop, rng is None),
+            lambda: self._build_train_fused(m, loss_fn, checkpoint_stop),
+        )
+        if rng is None:
+            loss, grads, new_states, aux = fn(params, states, mbatches, target)
+        else:
+            loss, grads, new_states, aux = fn(params, states, mbatches, target, rng)
+        return loss, list(grads), list(new_states), aux
+
+    def run_forward_fused(
+        self,
+        params: Sequence[Pytree],
+        states: Sequence[Pytree],
+        mbatches: List[Pytree],
+        rng: Optional[jax.Array],
+        train: bool,
+    ) -> Tuple[List[Pytree], List[Pytree]]:
+        """Forward-only counterpart of :meth:`run_train_fused`."""
+        m = len(mbatches)
+
+        def build():
+            def cell_of(i, j):
+                fn = self.stages[j].stage_apply
+                return lambda p, s, x, sk, key: fn(p, s, x, sk, key, train)
+
+            def fwd(params, states, mbatches, rng=None):
+                outs, cur_states = self._fused_forward_loop(
+                    cell_of, m, params, states, mbatches, rng
+                )
+                return outs, tuple(cur_states)
+
+            return fwd
+
+        fn = self._fused_jit("fwd", mbatches, (train, rng is None), build)
+        if rng is None:
+            outs, new_states = fn(params, states, mbatches)
+        else:
+            outs, new_states = fn(params, states, mbatches, rng)
+        return list(outs), list(new_states)
+
+    def _build_train_fused(self, m: int, loss_fn, checkpoint_stop: int):
+        cells = [
+            [self._fused_cell(stage, i < checkpoint_stop) for stage in self.stages]
+            for i in range(m)
+        ]
+
+        def step(params, states, mbatches, target, rng=None):
+            def loss_of(params):
+                outs, cur_states = self._fused_forward_loop(
+                    lambda i, j: cells[i][j], m, params, states, mbatches, rng
+                )
+                out = microbatch.gather(outs)
+                res = loss_fn(out, target)
+                if isinstance(res, tuple):
+                    return res[0], (res[1], cur_states)
+                return res, (None, cur_states)
+
+            (loss, (aux, new_states)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(tuple(params))
+            return loss, grads, tuple(new_states), aux
+
+        return step
 
     # ------------------------------------------------------------------ #
 
