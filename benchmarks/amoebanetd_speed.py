@@ -13,7 +13,7 @@ import click
 import jax
 import jax.numpy as jnp
 
-from benchmarks.common import build_gpipe, run_speed, softmax_xent
+from benchmarks.common import bf16_option, build_gpipe, run_speed, softmax_xent
 from torchgpipe_tpu.models import amoebanetd
 
 # name -> (n_stages, batch, chunks, balance, checkpoint); layer count is
@@ -41,7 +41,8 @@ EXPERIMENTS = {
 @click.option("--num-filters", default=256)
 @click.option("--image", default=224, help="input image size")
 @click.option("--batch", default=None, type=int, help="override batch size")
-def main(experiment, epochs, steps, num_layers, num_filters, image, batch):
+@bf16_option
+def main(experiment, epochs, steps, num_layers, num_filters, image, batch, bf16):
     n, bsz, chunks, balance, ckpt = EXPERIMENTS[experiment]
     bsz = batch or bsz
     layers = amoebanetd(
@@ -49,7 +50,7 @@ def main(experiment, epochs, steps, num_layers, num_filters, image, batch):
     )
     if balance is not None and sum(balance) != len(layers):
         balance = None  # model size changed; fall back to even split
-    model = build_gpipe(layers, balance, n, chunks, ckpt)
+    model = build_gpipe(layers, balance, n, chunks, ckpt, bf16=bf16)
     x = jnp.zeros((bsz, image, image, 3), jnp.float32)
     y = jax.random.randint(jax.random.PRNGKey(0), (bsz,), 0, 1000)
     tput = run_speed(

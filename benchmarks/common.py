@@ -48,13 +48,27 @@ def build_gpipe(
     checkpoint: str,
     devices=None,
     tracer=None,
+    bf16: bool = False,
 ) -> GPipe:
     if balance is None:
         balance = even_balance(len(layers), n_stages)
     return GPipe(
         list(layers), balance, chunks=chunks, checkpoint=checkpoint,
         devices=devices, tracer=tracer,
+        compute_dtype=jnp.bfloat16 if bf16 else None,
     )
+
+
+def bf16_option(fn):
+    """Shared ``--bf16`` click option: bfloat16 compute with f32 masters
+    (torchgpipe_tpu.precision; no reference counterpart — the reference
+    trains float32 only)."""
+    import click
+
+    return click.option(
+        "--bf16/--no-bf16", default=False,
+        help="bfloat16 compute, float32 masters + norm statistics",
+    )(fn)
 
 
 def run_speed(

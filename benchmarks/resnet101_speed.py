@@ -12,7 +12,7 @@ import click
 import jax
 import jax.numpy as jnp
 
-from benchmarks.common import build_gpipe, run_speed, softmax_xent
+from benchmarks.common import bf16_option, build_gpipe, run_speed, softmax_xent
 from torchgpipe_tpu.models import resnet101
 
 # name -> (n_stages, batch, chunks)
@@ -32,11 +32,12 @@ EXPERIMENTS = {
 @click.option("--image", default=224)
 @click.option("--batch", default=None, type=int)
 @click.option("--base-width", default=64)
-def main(experiment, epochs, steps, image, batch, base_width):
+@bf16_option
+def main(experiment, epochs, steps, image, batch, base_width, bf16):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     layers = resnet101(num_classes=1000, base_width=base_width)
-    model = build_gpipe(layers, None, n, chunks, "except_last")
+    model = build_gpipe(layers, None, n, chunks, "except_last", bf16=bf16)
     x = jnp.zeros((bsz, image, image, 3), jnp.float32)
     y = jax.random.randint(jax.random.PRNGKey(0), (bsz,), 0, 1000)
     tput = run_speed(

@@ -10,7 +10,7 @@ import click
 import jax
 import jax.numpy as jnp
 
-from benchmarks.common import build_gpipe, mse, run_speed
+from benchmarks.common import bf16_option, build_gpipe, mse, run_speed
 from torchgpipe_tpu.models import unet
 
 EXPERIMENTS = {
@@ -31,14 +31,15 @@ EXPERIMENTS = {
 @click.option("--depth", default=5)
 @click.option("--num-convs", default=5)
 @click.option("--base-channels", default=64)
-def main(experiment, epochs, steps, image, batch, depth, num_convs, base_channels):
+@bf16_option
+def main(experiment, epochs, steps, image, batch, depth, num_convs, base_channels, bf16):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     layers = unet(
         depth=depth, num_convs=num_convs, base_channels=base_channels,
         output_channels=1,
     )
-    model = build_gpipe(layers, None, n, chunks, "except_last")
+    model = build_gpipe(layers, None, n, chunks, "except_last", bf16=bf16)
     x = jnp.zeros((bsz, image, image, 3), jnp.float32)
     y = jnp.zeros((bsz, image, image, 1), jnp.float32)
     tput = run_speed(
