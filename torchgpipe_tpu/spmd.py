@@ -137,7 +137,19 @@ class SpmdGPipe:
         if self.sp_axis is not None and self.sp_axis not in self.mesh.axis_names:
             raise ValueError(f"mesh has no {self.sp_axis!r} axis: {self.mesh}")
         if self.checkpoint not in ("always", "never"):
-            raise ValueError("SPMD engine supports checkpoint='always'|'never'")
+            # 'except_last' (reference gpipe.py:360-367) cannot be expressed
+            # inside one lax.scan: scan stacks per-tick residual buffers
+            # uniformly across ticks, so exempting the last micro-batch's
+            # cells from remat would force full residual buffers for EVERY
+            # tick, destroying the memory profile checkpointing exists for.
+            # Its benefit (skip one recompute of m) is ~1/m of block FLOPs —
+            # use the MPMD engine when exact except_last semantics matter.
+            raise ValueError(
+                "SPMD engine supports checkpoint='always'|'never'; "
+                "'except_last' needs non-uniform per-micro-batch remat, which "
+                "a scanned schedule cannot express without losing the remat "
+                "memory savings (use the MPMD GPipe engine for that mode)"
+            )
         if self.sp_axis is not None and self.loss_reduction is None:
             raise ValueError(
                 "sequence parallelism needs a batch/token-decomposable loss: "
