@@ -46,11 +46,11 @@ def _build_amoebanet(platform: str, n_stages: int):
 
     if platform != "cpu":
         # Measured sweet spot on a single v5e chip (16GB HBM): bf16 compute
-        # (f32 masters/BN stats), batch 64, 4 micro-batches, except_last —
-        # 360 samples/s vs 216.8 for the best f32 config (batch 64 f32 OOMs;
-        # chunk counts >4 lose to recompute + small-microbatch inefficiency).
+        # (f32 masters/BN stats), batch 128, 4 micro-batches, except_last —
+        # 442 samples/s in the sweep (f32 OOMs past batch 32; batch 256 and
+        # chunk counts >4 collapse to ~124/s under HBM pressure/recompute).
         num_layers, num_filters = 18, 256
-        batch, image, chunks = 64, 224, 4
+        batch, image, chunks = 128, 224, 4
         compute_dtype = jnp.bfloat16
     else:  # CPU smoke: same code path, toy size
         num_layers, num_filters = 3, 16

@@ -118,3 +118,14 @@ def test_unet_timeline_driver():
     ])
     assert "overlap speedup" in out
     assert "analytic GPipe bubble" in out
+
+
+def test_speed_driver_bf16_flag():
+    from benchmarks.amoebanetd_speed import main
+
+    out = _invoke(main, [
+        "n2m4", "--epochs", "1", "--steps", "1",
+        "--num-layers", "3", "--num-filters", "8",
+        "--image", "32", "--batch", "4", "--bf16",
+    ])
+    assert "FINAL | amoebanetd-speed n2m4" in out
