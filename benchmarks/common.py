@@ -71,6 +71,44 @@ def bf16_option(fn):
     )(fn)
 
 
+def run_epoch_loop(
+    step_fn: Callable,
+    batch: int,
+    *,
+    epochs: int,
+    steps_per_epoch: int,
+    skip_epochs: int = 1,
+    label: str = "experiment",
+) -> float:
+    """Timed training epochs over ``step_fn(global_step) -> (loss, block_on)``;
+    returns steady-state samples/sec.
+
+    Reference loop shape: benchmarks/amoebanetd-speed/main.py:235-265
+    (first epoch discarded as warm-up/compile).  With a single epoch nothing
+    can be discarded, so the warm-up epoch is measured rather than reporting
+    zero.
+    """
+    skip = skip_epochs if epochs > skip_epochs else 0
+    throughputs = []
+    t_start = time.time()
+    for epoch in range(epochs):
+        t0 = time.time()
+        for step in range(steps_per_epoch):
+            loss, block_on = step_fn(epoch * steps_per_epoch + step)
+        jax.block_until_ready(block_on)
+        dt = time.time() - t0
+        tput = batch * steps_per_epoch / dt
+        if epoch >= skip:
+            throughputs.append(tput)
+        print(
+            f"{hr_time(time.time() - t_start)} | {label} | epoch {epoch + 1}: "
+            f"{tput:.1f} samples/sec, loss {float(loss):.4f}"
+            + ("  (warm-up)" if epoch < skip else ""),
+            flush=True,
+        )
+    return sum(throughputs) / max(1, len(throughputs))
+
+
 def run_speed(
     model: GPipe,
     x,
@@ -82,41 +120,28 @@ def run_speed(
     skip_epochs: int = 1,
     label: str = "experiment",
 ) -> float:
-    """Timed training epochs; returns steady-state samples/sec.
-
-    Reference loop shape: benchmarks/amoebanetd-speed/main.py:235-265
-    (first epoch discarded as warm-up/compile).
-    """
+    """Timed SGD epochs through the GPipe engine; steady-state samples/sec."""
     in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
     params, state = model.init(jax.random.PRNGKey(0), in_spec)
     rng = jax.random.PRNGKey(1)
-    batch = x.shape[0]
+    carry = {"params": params, "state": state}
 
-    throughputs = []
-    t_start = time.time()
-    for epoch in range(epochs):
-        t0 = time.time()
-        for step in range(steps_per_epoch):
-            key = jax.random.fold_in(rng, epoch * steps_per_epoch + step)
-            loss, grads, state, _ = model.value_and_grad(
-                params, state, x, y, loss_fn, rng=key
-            )
-            params = tuple(
-                jax.tree_util.tree_map(lambda p, g: p - 1e-4 * g, ps, gs)
-                for ps, gs in zip(params, grads)
-            )
-        jax.block_until_ready(params)
-        dt = time.time() - t0
-        tput = batch * steps_per_epoch / dt
-        if epoch >= skip_epochs:
-            throughputs.append(tput)
-        print(
-            f"{hr_time(time.time() - t_start)} | {label} | epoch {epoch + 1}: "
-            f"{tput:.1f} samples/sec, loss {float(loss):.4f}"
-            + ("  (warm-up)" if epoch < skip_epochs else ""),
-            flush=True,
+    def step_fn(global_step):
+        key = jax.random.fold_in(rng, global_step)
+        loss, grads, new_state, _ = model.value_and_grad(
+            carry["params"], carry["state"], x, y, loss_fn, rng=key
         )
-    return sum(throughputs) / max(1, len(throughputs))
+        carry["params"] = tuple(
+            jax.tree_util.tree_map(lambda p, g: p - 1e-4 * g, ps, gs)
+            for ps, gs in zip(carry["params"], grads)
+        )
+        carry["state"] = new_state
+        return loss, carry["params"]
+
+    return run_epoch_loop(
+        step_fn, x.shape[0], epochs=epochs, steps_per_epoch=steps_per_epoch,
+        skip_epochs=skip_epochs, label=label,
+    )
 
 
 def run_memory(

@@ -1,0 +1,138 @@
+"""Llama pipeline speed benchmark — the BASELINE.json north-star config
+("Llama-3-8B as nn.Sequential of transformer blocks, 8-stage pipeline").
+
+Two engines over the same model family:
+
+* ``--engine mpmd`` (default): :class:`torchgpipe_tpu.gpipe.GPipe` over the
+  flat ``llama()`` layer list — heterogeneous embed/blocks/head stages, any
+  balance.
+* ``--engine spmd``: :class:`torchgpipe_tpu.spmd.SpmdGPipe` — the whole
+  schedule as one compiled program on a ``pp`` mesh axis (needs
+  ``n_stages`` devices and ``n_layers % n_stages == 0``).
+
+``--preset llama3-8b`` selects the real Llama-3-8B shape (dim 4096, 32
+blocks, 32 heads / 8 KV heads, vocab 128256); the default preset is a
+scaled-down shape so the grid runs on small hosts/chips.  The causal-LM
+objective shifts tokens by one position.
+"""
+
+from __future__ import annotations
+
+import click
+import jax
+import jax.numpy as jnp
+
+from benchmarks.common import even_balance, run_speed
+from torchgpipe_tpu.gpipe import GPipe
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    cross_entropy,
+    llama,
+)
+
+# name -> (n_stages, batch, chunks)
+EXPERIMENTS = {
+    "pipeline-1": (1, 8, 4),
+    "pipeline-2": (2, 16, 4),
+    "pipeline-4": (4, 32, 8),
+    "pipeline-8": (8, 64, 8),
+}
+
+PRESETS = {
+    # dim, n_layers, n_heads, n_kv_heads, vocab
+    "tiny": (256, 8, 8, 4, 1024),
+    "1b": (2048, 16, 32, 8, 128256),
+    "llama3-8b": (4096, 32, 32, 8, 128256),
+}
+
+
+def causal_lm_loss(out, tokens):
+    # Shifted causal objective: predict token t+1 from prefix <= t.
+    logits = out[:, :-1, :]
+    labels = tokens[:, 1:]
+    return cross_entropy(logits, labels)
+
+
+@click.command()
+@click.argument("experiment", type=click.Choice(sorted(EXPERIMENTS)))
+@click.option("--preset", type=click.Choice(sorted(PRESETS)), default="tiny")
+@click.option("--engine", type=click.Choice(["mpmd", "spmd"]), default="mpmd")
+@click.option("--seq", default=1024)
+@click.option("--batch", default=None, type=int)
+@click.option("--epochs", default=3)
+@click.option("--steps", default=10)
+@click.option("--bf16/--no-bf16", default=True,
+              help="bfloat16 block compute (TransformerConfig.dtype)")
+@click.option("--checkpoint", default="except_last",
+              type=click.Choice(["always", "except_last", "never"]))
+def main(experiment, preset, engine, seq, batch, epochs, steps, bf16, checkpoint):
+    n, bsz, chunks = EXPERIMENTS[experiment]
+    bsz = batch or bsz
+    dim, n_layers, n_heads, n_kv, vocab = PRESETS[preset]
+    cfg = TransformerConfig(
+        vocab=vocab, dim=dim, n_layers=n_layers, n_heads=n_heads,
+        n_kv_heads=n_kv, dtype=jnp.bfloat16 if bf16 else jnp.float32,
+    )
+    x = jnp.zeros((bsz, seq), jnp.int32)
+
+    if engine == "spmd":
+        tput = _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, experiment)
+    else:
+        layers = llama(cfg)
+        model = GPipe(
+            layers, even_balance(len(layers), n), chunks=chunks,
+            checkpoint=checkpoint,
+        )
+        tput = run_speed(
+            model, x, x, causal_lm_loss,
+            epochs=epochs, steps_per_epoch=steps, label=experiment,
+        )
+    print(
+        f"FINAL | llama-speed {experiment} [{preset}, {engine}]: "
+        f"{tput:.1f} samples/sec"
+    )
+
+
+def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label):
+    from benchmarks.common import run_epoch_loop
+    from torchgpipe_tpu.models.transformer import llama_spmd
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    if checkpoint == "except_last":
+        checkpoint = "always"  # scanned schedule supports always|never
+        print(
+            "note: spmd engine runs checkpoint='always' (except_last is not "
+            "expressible in the scanned schedule; see torchgpipe_tpu.spmd)",
+            flush=True,
+        )
+    block, pre, post = llama_spmd(cfg, n)
+    mesh = make_mesh(n)
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=chunks, loss_fn=cross_entropy,
+        pre=pre, post=post, checkpoint=checkpoint,
+    )
+    # SpmdGPipe shards data over the mesh; the causal shift happens on the
+    # host so inputs/targets ride the same sharding specs.
+    inputs, targets = x[:, :-1], x[:, 1:]
+    carry = {
+        "params": pipe.init(
+            jax.random.PRNGKey(0),
+            jax.ShapeDtypeStruct(inputs.shape, inputs.dtype),
+        )
+    }
+
+    def step_fn(global_step):
+        del global_step
+        loss, grads = pipe.train_step(carry["params"], inputs, targets)
+        carry["params"] = jax.tree_util.tree_map(
+            lambda p, g: p - 1e-4 * g, carry["params"], grads
+        )
+        return loss, carry["params"]
+
+    return run_epoch_loop(
+        step_fn, x.shape[0], epochs=epochs, steps_per_epoch=steps, label=label
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -129,3 +129,19 @@ def test_speed_driver_bf16_flag():
         "--image", "32", "--batch", "4", "--bf16",
     ])
     assert "FINAL | amoebanetd-speed n2m4" in out
+
+
+def test_llama_speed_driver_both_engines():
+    from benchmarks.llama_speed import main
+
+    out = _invoke(main, [
+        "pipeline-2", "--preset", "tiny", "--epochs", "1", "--steps", "1",
+        "--seq", "32", "--batch", "4", "--no-bf16",
+    ])
+    assert "FINAL | llama-speed pipeline-2 [tiny, mpmd]" in out
+
+    out = _invoke(main, [
+        "pipeline-2", "--preset", "tiny", "--engine", "spmd", "--epochs", "1",
+        "--steps", "1", "--seq", "33", "--batch", "4", "--no-bf16",
+    ])
+    assert "FINAL | llama-speed pipeline-2 [tiny, spmd]" in out
