@@ -1,0 +1,115 @@
+"""1F1B (PipeDream-flush) schedule: transparency with the GPipe fill-drain
+schedule, interleaving structure, and validation.  No reference counterpart —
+fill-drain is the reference's only schedule (torchgpipe/pipeline.py:49-65)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.gpipe import GPipe
+from torchgpipe_tpu.layers import named
+from torchgpipe_tpu.ops import nn
+from torchgpipe_tpu.skip import pop_add, stash
+from torchgpipe_tpu.utils.tracing import Timeline
+
+
+def _layers():
+    return named([
+        nn.conv2d(8, (3, 3), name="c1"),
+        stash("res"),
+        nn.batch_norm(name="bn1"),
+        nn.relu(),
+        nn.conv2d(8, (3, 3), name="c2"),
+        pop_add("res"),
+        nn.dropout(0.1),
+        nn.global_avg_pool(),
+        nn.dense(5, name="head"),
+    ])
+
+
+def _mean_loss(out, tgt):
+    logits = out.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(logp[jnp.arange(logp.shape[0]), tgt])
+
+
+@pytest.mark.parametrize("checkpoint", ["always", "except_last", "never"])
+@pytest.mark.parametrize("batch", [8, 7])  # 7 -> ragged micro-batches
+def test_1f1b_matches_gpipe_schedule(checkpoint, batch):
+    x = jax.random.normal(jax.random.PRNGKey(0), (batch, 8, 8, 3))
+    y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, 5)
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    kw = dict(balance=[4, 3, 2], chunks=4, checkpoint=checkpoint)
+
+    ref = GPipe(_layers(), **kw)
+    p, s = ref.init(jax.random.PRNGKey(2), spec)
+    key = jax.random.PRNGKey(3)
+    l_ref, g_ref, s_ref, _ = ref.value_and_grad(p, s, x, y, _mean_loss, rng=key)
+
+    ofo = GPipe(_layers(), schedule="1f1b", loss_reduction="mean", **kw)
+    l_1f, g_1f, s_1f, _ = ofo.value_and_grad(p, s, x, y, _mean_loss, rng=key)
+
+    np.testing.assert_allclose(float(l_1f), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g_1f), jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s_1f), jax.tree_util.tree_leaves(s_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_sum_reduction():
+    x = jax.random.normal(jax.random.PRNGKey(4), (6, 8, 8, 3))
+    y = jax.random.randint(jax.random.PRNGKey(5), (6,), 0, 5)
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    def sum_loss(out, tgt):
+        logits = out.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.sum(logp[jnp.arange(logp.shape[0]), tgt])
+
+    ref = GPipe(_layers(), balance=[4, 3, 2], chunks=3)
+    p, s = ref.init(jax.random.PRNGKey(6), spec)
+    l_ref, g_ref, _, _ = ref.value_and_grad(p, s, x, y, sum_loss, rng=jax.random.PRNGKey(7))
+    ofo = GPipe(_layers(), balance=[4, 3, 2], chunks=3,
+                schedule="1f1b", loss_reduction="sum")
+    l_1f, g_1f, _, _ = ofo.value_and_grad(p, s, x, y, sum_loss, rng=jax.random.PRNGKey(7))
+    np.testing.assert_allclose(float(l_1f), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g_1f), jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_1f1b_interleaves_backward_into_forward():
+    # Structural: on the last stage, micro-batch 0's backward is dispatched
+    # before the final micro-batch's forward (fill-drain would run ALL
+    # forwards first) — the defining 1F1B property.
+    tracer = Timeline()
+    m = GPipe(_layers(), balance=[4, 3, 2], chunks=4,
+              schedule="1f1b", loss_reduction="mean", tracer=tracer)
+    x = jax.random.normal(jax.random.PRNGKey(7), (8, 8, 8, 3))
+    y = jax.random.randint(jax.random.PRNGKey(8), (8,), 0, 5)
+    p, s = m.init(jax.random.PRNGKey(9), jax.ShapeDtypeStruct(x.shape, x.dtype))
+    m.value_and_grad(p, s, x, y, _mean_loss, rng=jax.random.PRNGKey(10))
+    last = max(e.stage for e in tracer.events)
+    seq = [(e.name, e.mbatch) for e in tracer.events if e.stage == last]
+    assert seq.index(("bwd", 0)) < seq.index(("fwd", 3)), seq
+
+
+def test_1f1b_requires_decomposable_loss():
+    with pytest.raises(ValueError, match="decompose"):
+        GPipe(_layers(), balance=[4, 3, 2], chunks=2, schedule="1f1b")
+    with pytest.raises(ValueError, match="schedule"):
+        GPipe(_layers(), balance=[4, 3, 2], chunks=2, schedule="zigzag")
+
+
+def test_1f1b_rejects_fused_and_nonbatched_target():
+    with pytest.raises(ValueError, match="1F1B|1f1b"):
+        GPipe(_layers(), balance=[4, 3, 2], chunks=2, schedule="1f1b",
+              loss_reduction="mean", fused=True,
+              devices=[jax.devices()[0]])
+    m = GPipe(_layers(), balance=[4, 3, 2], chunks=2,
+              schedule="1f1b", loss_reduction="mean")
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 8, 3))
+    p, s = m.init(jax.random.PRNGKey(1), jax.ShapeDtypeStruct(x.shape, x.dtype))
+    with pytest.raises(ValueError, match="per micro-batch"):
+        m.value_and_grad(p, s, x, None, lambda o, t: jnp.sum(o.astype(jnp.float32)),
+                         rng=jax.random.PRNGKey(2))

@@ -65,6 +65,8 @@ class GPipe:
         deferred_batch_norm: bool = False,
         compute_dtype: Optional[Any] = None,  # a jnp dtype, e.g. jnp.bfloat16
         fused: Optional[bool] = None,  # None = auto (fuse when single-device)
+        schedule: str = "gpipe",  # 'gpipe' (fill-drain) | '1f1b'
+        loss_reduction: Optional[str] = None,  # 'mean'|'sum'; required by 1f1b
         tracer=None,
     ) -> None:
         if balance is None:
@@ -98,6 +100,18 @@ class GPipe:
             layers = apply_policy(layers, compute_dtype)
         self.compute_dtype = compute_dtype
 
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError("schedule must be 'gpipe' or '1f1b'")
+        if schedule == "1f1b" and loss_reduction not in ("mean", "sum"):
+            raise ValueError(
+                "schedule='1f1b' seeds each micro-batch's backward before "
+                "the mini-batch output exists, so the loss must decompose "
+                "over micro-batches: pass loss_reduction='mean' (loss_fn is "
+                "a batch-mean) or 'sum' (a batch-sum)"
+            )
+        self.schedule = schedule
+        self.loss_reduction = loss_reduction
+
         self.layers = layers
         self.balance = list(balance)
         self.chunks = chunks
@@ -126,6 +140,13 @@ class GPipe:
         # dispatch (or, with sync=True, serialized per-cell device time —
         # the overlap-ablation tool, SURVEY.md §5 tracing).
         self.tracer = tracer
+        if fused and schedule == "1f1b":
+            raise ValueError(
+                "fused=True compiles the whole fill-drain step into one "
+                "program; it cannot express the 1F1B schedule. Drop "
+                "fused=True (1f1b runs on the per-cell scheduler) or use "
+                "schedule='gpipe'"
+            )
         if fused:
             if len({id(d) for d in self.devices}) > 1:
                 raise ValueError(
@@ -233,11 +254,16 @@ class GPipe:
     ):
         """Pipelined training step: forward, loss, backward.
 
-        ``loss_fn(output, target)`` sees the *gathered* mini-batch output, so
-        losses (and therefore gradients) are exactly those of the un-pipelined
-        model — the transparency contract the reference proves with its
-        accuracy benchmarks (SURVEY.md §6).  ``loss_fn`` may return
-        ``(loss, aux)``.
+        Under the default fill-drain schedule ``loss_fn(output, target)``
+        sees the *gathered* mini-batch output, so losses (and therefore
+        gradients) are exactly those of the un-pipelined model — the
+        transparency contract the reference proves with its accuracy
+        benchmarks (SURVEY.md §6).  ``loss_fn`` may return ``(loss, aux)``.
+
+        Under ``schedule='1f1b'`` the loss is computed per micro-batch
+        (weighted by ``loss_reduction``), so ``target`` must split along the
+        batch dimension like the input, and ``aux`` is returned as a LIST of
+        per-micro-batch values instead of one gathered value.
 
         Returns ``(loss, grads, new_state, aux)`` with ``grads`` shaped like
         ``params``.
@@ -254,6 +280,32 @@ class GPipe:
                 f"(batch size {microbatch.batch_size(x)})"
             )
         stop = checkpoint_stop(self.checkpoint, len(mbatches), train=True)
+        if self.schedule == "1f1b":
+            sizes = [microbatch.batch_size(mb) for mb in mbatches]
+            total = sum(sizes)
+            if self.loss_reduction == "mean":
+                weights = [b / total for b in sizes]
+            else:
+                weights = [1.0] * len(sizes)
+            try:
+                microbatch.check(target)
+                target_ok = microbatch.batch_size(target) == total
+            except (ValueError, TypeError, IndexError):
+                target_ok = False
+            if not target_ok:
+                raise ValueError(
+                    "schedule='1f1b' computes the loss per micro-batch, so "
+                    "target must be a pytree splitting along the batch "
+                    f"dimension like the input (batch size {total}); got "
+                    f"{type(target).__name__}. Use the default schedule for "
+                    "non-batched targets"
+                )
+            target_mbs = microbatch.scatter(target, self.chunks)
+            loss, grads, new_states, aux = self._pipeline.run_train_1f1b(
+                params, state, mbatches, target_mbs, loss_fn, rng, stop,
+                weights,
+            )
+            return loss, tuple(grads), tuple(new_states), aux
         if self._use_fused():
             loss, grads, new_states, aux = self._pipeline.run_train_fused(
                 params, state, mbatches, target, loss_fn, rng, stop

@@ -202,6 +202,7 @@ class Pipeline:
         self.tracer = tracer  # torchgpipe_tpu.utils.tracing.Timeline or None
         self._loss_grad = LossGradRunner()
         self._fused: Dict = {}  # fused single-device step cache
+        self._loss_jits: Dict = {}  # 1F1B per-micro-batch loss/sum cache
 
     # ------------------------------------------------------------------ #
     # forward-only (inference / no-grad)                                 #
@@ -340,6 +341,203 @@ class Pipeline:
                     gskips[(i, k)] = _transfer(g, dst)
 
         return loss, acc, cur_states, aux
+
+    # ------------------------------------------------------------------ #
+    # 1F1B (PipeDream-flush) schedule                                    #
+    # ------------------------------------------------------------------ #
+
+    def run_train_1f1b(
+        self,
+        params: Sequence[Pytree],
+        states: Sequence[Pytree],
+        mbatches: List[Pytree],
+        target_mbs: List[Pytree],
+        loss_fn,
+        rng: Optional[jax.Array],
+        checkpoint_stop: int,
+        loss_weights: Sequence[float],
+    ):
+        """One-forward-one-backward schedule (no reference counterpart —
+        GPipe fill-drain is the reference's only schedule, pipeline.py:49-65).
+
+        Each stage runs a bounded number of warm-up forwards then alternates
+        backward/forward, so at most ``n_stages - j`` micro-batches are
+        in-flight per stage instead of all ``m`` — the activation-memory
+        profile of PipeDream-flush.  Requires a per-micro-batch decomposable
+        loss: the engine computes ``loss_i = w_i * loss_fn(out_i, tgt_i)``
+        and seeds each micro-batch's backward as soon as its forward leaves
+        the last stage (``loss_weights`` carry the mean/sum decomposition).
+
+        Correctness does not depend on the dispatch order (data dependencies
+        order the device work); the order shapes per-device memory and
+        overlap.  Returns ``(loss, grads, new_states, aux_list)`` where
+        ``aux_list`` holds per-micro-batch aux values (or None).
+        """
+        n = len(self.stages)
+        m = len(mbatches)
+
+        # Per-stage 1F1B op order: stage j warms up with min(m, n - j)
+        # forwards, then strictly alternates bwd/fwd, then drains backwards.
+        orders: List[List[Tuple[str, int]]] = []
+        for j in range(n):
+            warm = min(m, n - j)
+            ops: List[Tuple[str, int]] = [("fwd", i) for i in range(warm)]
+            nf, nb = warm, 0
+            while nb < m:
+                ops.append(("bwd", nb))
+                nb += 1
+                if nf < m:
+                    ops.append(("fwd", nf))
+                    nf += 1
+            orders.append(ops)
+
+        acts: Dict[Tuple[int, int], Pytree] = {}  # activation produced by (i, j)
+        gys: Dict[Tuple[int, int], Pytree] = {}  # cotangent arriving at (i, j)
+        pulls: Dict[Tuple[int, int], Any] = {}
+        saved: Dict[Tuple[int, int], Any] = {}
+        skip_vals: Dict = {}
+        gskips: Dict = {}
+        cur_states = list(states)
+        acc: List[Optional[Pytree]] = [None] * n
+        losses: List[Optional[jax.Array]] = [None] * m
+        auxes: List[Any] = [None] * m
+
+        def fwd_ready(i: int, j: int) -> bool:
+            return j == 0 or (i, j - 1) in acts
+
+        def bwd_ready(i: int, j: int) -> bool:
+            return (i, j) in gys
+
+        def do_fwd(i: int, j: int) -> None:
+            stage = self.stages[j]
+            x = mbatches[i] if j == 0 else acts.pop((i, j - 1))
+            x = _transfer(x, stage.device)
+            skips_in = {k: skip_vals.pop((i, k)) for k in stage.ext_pop_keys}
+            rng_i = jax.random.fold_in(rng, i) if rng is not None else None
+            state_in = cur_states[j]
+            if i < checkpoint_stop:
+                y, ext, new_state = stage.fwd_ckpt(
+                    params[j], state_in, x, skips_in, rng_i
+                )
+                saved[(i, j)] = (x, skips_in, state_in, rng_i)
+            else:
+                y, ext, new_state, pull = stage.fwd_vjp(
+                    params[j], state_in, x, skips_in, rng_i
+                )
+                pulls[(i, j)] = pull
+            if self.tracer is not None:
+                self.tracer.record("fwd", j, i, y)
+            cur_states[j] = new_state
+            for k, v in ext.items():
+                dst = self.stages[self.layout.pop_stage(k)].device
+                skip_vals[(i, k)] = _transfer(v, dst)
+            if j == n - 1:
+                # Loss + this micro-batch's output cotangent, immediately.
+                loss_i, gy, aux = self._mb_loss(
+                    y, _transfer(target_mbs[i], stage.device),
+                    loss_weights[i], loss_fn,
+                )
+                losses[i] = loss_i
+                auxes[i] = aux
+                gys[(i, j)] = gy
+            else:
+                acts[(i, j)] = y
+
+        def do_bwd(i: int, j: int) -> None:
+            stage = self.stages[j]
+            if (i, j) in saved:
+                x, skips_in, state_in, rng_i = saved.pop((i, j))
+                _, _, _, pull = stage.fwd_recompute(
+                    params[j], state_in, x, skips_in, rng_i
+                )
+            else:
+                pull = pulls.pop((i, j))
+            gy = gys.pop((i, j))
+            gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
+            gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
+            if self.tracer is not None:
+                self.tracer.record("bwd", j, i, gx)
+            acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
+            if j > 0:
+                gys[(i, j - 1)] = _transfer(gx, self.stages[j - 1].device)
+            for k, g in gsk_in.items():
+                dst = self.stages[self.layout.stash_stage(k)].device
+                gskips[(i, k)] = _transfer(g, dst)
+
+        # Round-robin dispatch honouring each stage's 1F1B order; an op waits
+        # (without blocking other stages) until its Python inputs exist.
+        cursors = [0] * n
+        total = sum(len(o) for o in orders)
+        done = 0
+        while done < total:
+            progressed = False
+            for j in range(n):
+                while cursors[j] < len(orders[j]):
+                    kind, i = orders[j][cursors[j]]
+                    if kind == "fwd" and fwd_ready(i, j):
+                        do_fwd(i, j)
+                    elif kind == "bwd" and bwd_ready(i, j):
+                        do_bwd(i, j)
+                    else:
+                        break
+                    cursors[j] += 1
+                    done += 1
+                    progressed = True
+            if not progressed:
+                pending = [
+                    (j, orders[j][cursors[j]])
+                    for j in range(n)
+                    if cursors[j] < len(orders[j])
+                ]
+                raise RuntimeError(
+                    f"1F1B schedule deadlocked; pending {pending}"
+                )  # pragma: no cover — schedule generation guarantees progress
+
+        last_dev = self.stages[-1].device
+        loss = self._sum_losses([_transfer(l, last_dev) for l in losses])
+        return loss, acc, cur_states, auxes
+
+    def _loss_jit(self, key, build):
+        """Bounded cache for the cheap 1F1B loss helpers — separate from
+        ``self._fused`` so these never evict expensive whole-step programs."""
+        fn = self._loss_jits.get(key)
+        if fn is None:
+            while len(self._loss_jits) >= 16:
+                self._loss_jits.pop(next(iter(self._loss_jits)))
+            fn = jax.jit(build())
+            self._loss_jits[key] = fn
+        return fn
+
+    def _mb_loss(self, out, tgt, weight, loss_fn):
+        """Per-micro-batch weighted loss, cotangent and aux (cached jit)."""
+        key = (
+            "mb_loss",
+            tuple(l.shape for l in jax.tree_util.tree_leaves(out)),
+            jax.tree_util.tree_structure(out),
+            loss_fn,
+        )
+
+        def build():
+            def run(out, tgt, w):
+                def f(o):
+                    res = loss_fn(o, tgt)
+                    if isinstance(res, tuple):
+                        return w * res[0], res[1]
+                    return w * res, None
+
+                (wloss, aux), gy = jax.value_and_grad(f, has_aux=True)(out)
+                return wloss, gy, aux
+
+            return run
+
+        fn = self._loss_jit(key, build)
+        return fn(out, tgt, jnp.asarray(weight, jnp.float32))
+
+    def _sum_losses(self, losses):
+        fn = self._loss_jit(
+            ("sum_losses", len(losses)), lambda: lambda ls: sum(ls[1:], ls[0])
+        )
+        return fn(losses)
 
     # ------------------------------------------------------------------ #
     # fused single-device path                                           #
