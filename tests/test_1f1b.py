@@ -113,3 +113,8 @@ def test_1f1b_rejects_fused_and_nonbatched_target():
     with pytest.raises(ValueError, match="per micro-batch"):
         m.value_and_grad(p, s, x, None, lambda o, t: jnp.sum(o.astype(jnp.float32)),
                          rng=jax.random.PRNGKey(2))
+
+
+def test_loss_reduction_requires_1f1b():
+    with pytest.raises(ValueError, match="loss_reduction only applies"):
+        GPipe(_layers(), balance=[4, 3, 2], chunks=2, loss_reduction="mean")

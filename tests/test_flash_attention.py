@@ -1,0 +1,91 @@
+"""Flash-attention Pallas kernels vs the dense XLA oracle (interpret mode
+runs the same kernel code on the CPU backend)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.ops.flash_attention import flash_attention, supports
+from torchgpipe_tpu.parallel.ring_attention import full_attention
+
+
+def _rand(key, shape):
+    return jax.random.normal(key, shape, jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("gqa", [False, True])
+def test_forward_matches_dense(causal, gqa):
+    b, s, h, d = 2, 64, 4, 16
+    g = 2 if gqa else h
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = _rand(ks[0], (b, s, h, d))
+    k = _rand(ks[1], (b, s, g, d))
+    v = _rand(ks[2], (b, s, g, d))
+    ref = full_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_dense(causal):
+    b, s, h, d = 1, 32, 2, 8
+    g = 1  # GQA with 2 query heads per kv head
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    q = _rand(ks[0], (b, s, h, d))
+    k = _rand(ks[1], (b, s, g, d))
+    v = _rand(ks[2], (b, s, g, d))
+    cot = _rand(ks[3], (b, s, h, d))
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8,
+                            interpret=True)
+        return jnp.sum(o * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) * cot)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_uneven_blocks_and_long_kv():
+    # block_q != block_k and s_q != s_k (non-causal cross-attention shape).
+    b, sq, sk, h, d = 1, 32, 64, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = _rand(ks[0], (b, sq, h, d))
+    k = _rand(ks[1], (b, sk, h, d))
+    v = _rand(ks[2], (b, sk, h, d))
+    ref = full_attention(q, k, v, causal=False)
+    out = flash_attention(q, k, v, causal=False, block_q=16, block_k=32,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_supports_gate():
+    assert supports((2, 1024, 16, 128), (2, 1024, 8, 128))
+    assert not supports((2, 1024, 16, 64), (2, 1024, 8, 64))   # d % 128
+    assert not supports((2, 1000, 16, 128), (2, 1000, 8, 128))  # s % block
+
+
+def test_bf16_inputs():
+    b, s, h, d = 1, 32, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand(ks[0], (b, s, h, d)).astype(jnp.bfloat16)
+    k = _rand(ks[1], (b, s, h, d)).astype(jnp.bfloat16)
+    v = _rand(ks[2], (b, s, h, d)).astype(jnp.bfloat16)
+    ref = full_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=8, block_k=8,
+                          interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )

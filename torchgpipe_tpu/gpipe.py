@@ -109,6 +109,12 @@ class GPipe:
                 "over micro-batches: pass loss_reduction='mean' (loss_fn is "
                 "a batch-mean) or 'sum' (a batch-sum)"
             )
+        if schedule != "1f1b" and loss_reduction is not None:
+            raise ValueError(
+                "loss_reduction only applies to schedule='1f1b' (the "
+                "fill-drain schedule computes the loss on the gathered "
+                "mini-batch); drop it or set schedule='1f1b'"
+            )
         self.schedule = schedule
         self.loss_reduction = loss_reduction
 

@@ -1,0 +1,355 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+The framework's hot op: fused online-softmax attention that never
+materializes the ``[s, s]`` score matrix in HBM — scores live in VMEM one
+``[block_q, block_k]`` tile at a time, with f32 accumulation on the MXU.
+Backward follows the standard flash decomposition (Dao, FlashAttention-2;
+public algorithm, implemented here from the math against
+/opt/skills/guides/pallas_guide.md):
+
+* forward saves only ``O`` and the per-row logsumexp ``L``,
+* ``dQ`` kernel re-streams K/V tiles; ``dK/dV`` kernel re-streams Q tiles,
+* ``D = rowsum(dO * O)`` is precomputed outside the kernels (cheap
+  elementwise reduce that XLA fuses).
+
+Supports causal masking and grouped-query attention (K/V at ``g`` heads,
+queries at ``h = g*r``); the kernels are gridded over ``(batch*heads,
+sequence blocks)`` so each program works on MXU-aligned ``[block, d]``
+tiles.  ``torchgpipe_tpu.parallel.attention`` dispatches here on TPU when
+shapes meet the tiling constraints (``d`` and ``s`` multiples of 128),
+falling back to the XLA path otherwise; ``interpret=True`` runs the same
+kernels on CPU for the test oracle.
+
+The reference has no kernel of any kind — its attention story is absent
+entirely (SURVEY.md §2.2); this module is TPU-native new capability.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _kv_index(i, h: int, g: int):
+    """Row in the [b*g, s, d] K/V array for query row ``i`` of [b*h, s, d]."""
+    r = h // g
+    return (i // h) * g + (i % h) // r
+
+
+# --------------------------------------------------------------------- #
+# forward                                                               #
+# --------------------------------------------------------------------- #
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
+                block_q, block_k, seq_k):
+    j = pl.program_id(1)
+    qb = q_ref[0].astype(jnp.float32) * sm_scale  # [Bq, d]
+    nk = seq_k // block_k
+    if causal:
+        # Only KV blocks overlapping the causal triangle of this Q block.
+        nk = lax.min(nk, lax.div((j + 1) * block_q + block_k - 1, block_k))
+
+    def body(jb, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(jb * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(jb * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bq, Bk]
+        if causal:
+            qpos = j * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = jb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * corr + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)  # [Bq, 1]
+
+
+def _flash_fwd_call(q, k, v, h, g, causal, sm_scale, block_q, block_k,
+                    interpret):
+    bh, s, d = q.shape
+    grid = (bh, s // block_q)
+    kv_spec = pl.BlockSpec(
+        (1, k.shape[1], d), lambda i, j: (_kv_index(i, h, g), 0, 0)
+    )
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, seq_k=k.shape[1],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------- #
+# backward                                                              #
+# --------------------------------------------------------------------- #
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               causal, sm_scale, block_q, block_k, seq_k):
+    j = pl.program_id(1)
+    qb = q_ref[0].astype(jnp.float32)
+    dob = do_ref[0].astype(jnp.float32)
+    lse_b = lse_ref[0]      # [Bq, 1]
+    delta_b = delta_ref[0]  # [Bq, 1]
+    nk = seq_k // block_k
+    if causal:
+        nk = lax.min(nk, lax.div((j + 1) * block_q + block_k - 1, block_k))
+
+    def body(jb, dq):
+        kb = k_ref[0, pl.ds(jb * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(jb * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            qpos = j * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = jb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse_b)  # [Bq, Bk]
+        dp = lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_b)
+        return dq + lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = lax.fori_loop(
+        0, nk, body, jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    )
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, causal, sm_scale, block_q, block_k,
+                seq_q):
+    jk = pl.program_id(1)
+    kb = k_ref[0].astype(jnp.float32)  # [Bk, d]
+    vb = v_ref[0].astype(jnp.float32)
+    nq = seq_q // block_q
+    jq0 = lax.div(jk * block_k, block_q) if causal else 0
+
+    def body(jq, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(jq * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(jq * block_q, block_q), :].astype(jnp.float32)
+        lse_b = lse_ref[0, pl.ds(jq * block_q, block_q), :]      # [Bq, 1]
+        delta_b = delta_ref[0, pl.ds(jq * block_q, block_q), :]  # [Bq, 1]
+        s = lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            qpos = jq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = jk * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse_b)  # [Bq, Bk]
+        dv_new = dv + lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_b)
+        dk_new = dk + lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_new, dv_new
+
+    d = k_ref.shape[-1]
+    dk, dv = lax.fori_loop(
+        jq0, nq, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)),
+    )
+    dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------- #
+# custom_vjp wiring                                                     #
+# --------------------------------------------------------------------- #
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, h, g, causal, sm_scale, blocks, interpret):
+    o, _ = _flash_fwd_call(
+        q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret
+    )
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, h, g, causal, sm_scale, blocks, interpret):
+    o, lse = _flash_fwd_call(
+        q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(h, g, causal, sm_scale, blocks, interpret, res, do):
+    q, k, v, o, lse = res
+    block_q, block_k = blocks
+    bh, s, d = q.shape
+    bg = k.shape[0]
+    sk = k.shape[1]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )  # [bh, s, 1]
+
+    kernel_args = (q, k, v, do, lse, delta)
+    kv_spec = pl.BlockSpec(
+        (1, sk, d), lambda i, j: (_kv_index(i, h, g), 0, 0)
+    )
+    row_spec3 = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, seq_k=sk,
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bh, s // block_q),
+        in_specs=[row_spec3, kv_spec, kv_spec, row_spec3, row_spec2,
+                  row_spec2],
+        out_specs=row_spec3,
+        interpret=interpret,
+    )(*kernel_args)
+
+    # dK/dV per QUERY head (expanded), summed over the group afterwards.
+    full_row3 = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0))
+    full_row2 = pl.BlockSpec((1, s, 1), lambda i, j: (i, 0, 0))
+    kvb_spec = pl.BlockSpec(
+        (1, block_k, d), lambda i, j: (_kv_index(i, h, g), j, 0)
+    )
+    out_kvb = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))
+    dk_exp, dv_exp = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, seq_q=s,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+        ),
+        grid=(bh, sk // block_k),
+        in_specs=[full_row3, kvb_spec, kvb_spec, full_row3, full_row2,
+                  full_row2],
+        out_specs=(out_kvb, out_kvb),
+        interpret=interpret,
+    )(*kernel_args)
+
+    r = h // g
+    b = bh // h
+    dk = dk_exp.reshape(b, g, r, sk, d).sum(axis=2).reshape(bg, sk, d)
+    dv = dv_exp.reshape(b, g, r, sk, d).sum(axis=2).reshape(bg, sk, d)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# --------------------------------------------------------------------- #
+# public API                                                            #
+# --------------------------------------------------------------------- #
+
+
+def supports(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
+             block: int = 128) -> bool:
+    """Whether shapes meet the kernel's TPU tiling constraints."""
+    b, s, h, d = q_shape
+    g = k_shape[2]
+    return (
+        d % 128 == 0
+        and s % block == 0
+        and k_shape[1] % block == 0
+        and h % g == 0
+    )
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused flash attention.  ``q``: ``[b, s, h, d]``; ``k, v``:
+    ``[b, s_k, g, d]`` with ``g`` dividing ``h`` (GQA).  Returns
+    ``[b, s, h, d]`` in ``q.dtype``.  Requires ``d % 128 == 0`` and
+    sequence lengths divisible by the block sizes (see :func:`supports`);
+    ``interpret=True`` runs the kernels on any backend for testing.
+    """
+    b, s, h, d = q.shape
+    g = k.shape[2]
+    sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+    qr = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * h, s, d)
+    kr = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * g, k.shape[1], d)
+    vr = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * g, v.shape[1], d)
+    o = _flash(
+        qr, kr, vr, h, g, causal, sm_scale,
+        (min(block_q, s), min(block_k, k.shape[1])), interpret,
+    )
+    return jnp.transpose(o.reshape(b, h, s, d), (0, 2, 1, 3))

@@ -184,10 +184,33 @@ def attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
 ) -> jnp.ndarray:
-    """Dispatch: ring attention when a sequence-parallel axis is bound, dense
-    attention otherwise.  One call site serves both deployment shapes."""
+    """Dispatch: ring attention when a sequence-parallel axis is bound; on
+    TPU the Pallas flash-attention kernel when shapes meet its tiling
+    constraints (``TGPU_DISABLE_FLASH=1`` opts out); dense XLA attention
+    otherwise.  One call site serves every deployment shape."""
     if not axis_bound(axis_name):
-        return full_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        import os
+
+        from torchgpipe_tpu.ops import flash_attention as _fa
+
+        dense = lambda q, k, v: full_attention(  # noqa: E731
+            q, k, v, causal=causal, sm_scale=sm_scale
+        )
+        if (
+            not os.environ.get("TGPU_DISABLE_FLASH")
+            and _fa.supports(q.shape, k.shape)
+        ):
+            # Resolved at LOWERING time, so the kernel is only emitted when
+            # this computation actually lowers for TPU (a CPU oracle run on
+            # a TPU host gets the dense path, not a Mosaic error).
+            return lax.platform_dependent(
+                q, k, v,
+                tpu=lambda q, k, v: _fa.flash_attention(
+                    q, k, v, causal=causal, sm_scale=sm_scale
+                ),
+                default=dense,
+            )
+        return dense(q, k, v)
     return ring_attention(
         q, k, v, axis_name, causal=causal, sm_scale=sm_scale
     )
