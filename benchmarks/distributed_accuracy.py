@@ -22,7 +22,6 @@ import time
 import click
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from benchmarks.common import even_balance, hr_time, softmax_xent
 from torchgpipe_tpu.balance import balance_by_time

@@ -7,7 +7,6 @@ on a (5, 64) U-Net with 192x192 inputs, MSE-style segmentation loss.
 from __future__ import annotations
 
 import click
-import jax
 import jax.numpy as jnp
 
 from benchmarks.common import bf16_option, build_gpipe, mse, run_speed

@@ -4,7 +4,6 @@ Reference test tree: tests/skip/{test_api,test_verify_skippables,
 test_namespace,test_inspect_skip_layout}.py.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -4,7 +4,6 @@ Reference: tests in torchgpipe exercise scatter/gather via GPipe
 (tests/test_gpipe.py:107-126 "indivisible batches") and microbatch directly.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

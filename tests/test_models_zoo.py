@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from torchgpipe_tpu.gpipe import GPipe
-from torchgpipe_tpu.layers import sequential_apply, sequential_init
+from torchgpipe_tpu.layers import sequential_apply
 from torchgpipe_tpu.models import amoebanetd, build_resnet, unet
 
 

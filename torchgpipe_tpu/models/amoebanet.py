@@ -28,7 +28,7 @@ against their own un-pipelined execution, not against torch.
 
 from __future__ import annotations
 
-from typing import Callable, List, Tuple
+from typing import List, Tuple
 
 import jax.numpy as jnp
 

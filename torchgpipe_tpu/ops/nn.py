@@ -12,7 +12,7 @@ its own thin layer set so models are plain JAX and lower cleanly onto the MXU:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
