@@ -1,0 +1,82 @@
+"""Property-based tests (hypothesis) for the foundational pure algorithms:
+micro-batch scatter/gather, the clock-cycle schedule, and the block
+partitioner.  The reference proves these with hand-picked cases
+(tests/test_microbatch.py, tests/test_balance.py); properties cover the
+input space."""
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from torchgpipe_tpu import microbatch
+from torchgpipe_tpu.balance.blockpartition import solve
+from torchgpipe_tpu.pipeline import clock_cycles
+
+
+@settings(deadline=None, max_examples=50)
+@given(
+    batch=st.integers(1, 64),
+    chunks=st.integers(1, 16),
+    width=st.integers(1, 4),
+)
+def test_scatter_gather_roundtrip(batch, chunks, width):
+    x = np.arange(batch * width, dtype=np.float32).reshape(batch, width)
+    mbs = microbatch.scatter(x, chunks)
+    # Reference `tensor.chunk` semantics (microbatch.py:143-158): ceil-sized
+    # pieces (possibly fewer than `chunks`), only the last piece short,
+    # order preserved, exact roundtrip.
+    size = -(-batch // chunks)
+    sizes = [m.shape[0] for m in mbs]
+    assert len(mbs) == -(-batch // size)
+    assert sum(sizes) == batch
+    assert all(s == size for s in sizes[:-1])
+    assert 0 < sizes[-1] <= size
+    out = np.asarray(microbatch.gather(mbs))
+    np.testing.assert_array_equal(out, x)
+
+
+@settings(deadline=None, max_examples=50)
+@given(m=st.integers(1, 12), n=st.integers(1, 8))
+def test_clock_cycles_cover_all_cells_in_dependency_order(m, n):
+    seen = {}
+    for t, cycle in enumerate(clock_cycles(m, n)):
+        for i, j in cycle:
+            assert 0 <= i < m and 0 <= j < n
+            assert (i, j) not in seen
+            seen[(i, j)] = t
+    assert len(seen) == m * n
+    for (i, j), t in seen.items():
+        # Data dependency: cell (i, j) strictly after (i, j-1) and (i-1, j).
+        if j > 0:
+            assert seen[(i, j - 1)] < t
+        if i > 0:
+            assert seen[(i - 1, j)] < t
+    # Fill-drain finishes in exactly m + n - 1 cycles.
+    assert max(seen.values()) == m + n - 2
+
+
+@settings(deadline=None, max_examples=50)
+@given(
+    costs=st.lists(st.integers(1, 100), min_size=1, max_size=20),
+    data=st.data(),
+)
+def test_blockpartition_is_contiguous_cover(costs, data):
+    partitions = data.draw(st.integers(1, len(costs)))
+    parts = solve(costs, partitions)
+    # Every element appears exactly once, in order, nothing dropped
+    # (reference: balance/blockpartition.py:11 — contiguous block partition).
+    flat = [x for p in parts for x in p]
+    assert flat == list(costs)
+    assert len(parts) == partitions
+    assert all(p for p in parts)
+    # No single move of a boundary element improves the bottleneck: the
+    # returned partition is at least as good as every adjacent variant.
+    best = max(sum(p) for p in parts)
+    for k in range(len(parts) - 1):
+        left, right = list(parts[k]), list(parts[k + 1])
+        if len(left) > 1:
+            alt = parts[:k] + [left[:-1], [left[-1]] + right] + parts[k + 2:]
+            assert max(sum(p) for p in alt) >= best
+        if len(right) > 1:
+            alt = parts[:k] + [left + [right[0]], right[1:]] + parts[k + 2:]
+            assert max(sum(p) for p in alt) >= best
