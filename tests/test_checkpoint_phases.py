@@ -35,7 +35,10 @@ def _phase_probe(log):
 def test_checkpoint_then_recompute_phases():
     log = []
     layers = [dense(4, name="d"), _phase_probe(log)]
-    model = GPipe(layers, balance=[2], chunks=1, checkpoint="always")
+    # fused=False: the per-cell scheduler traces the checkpointed forward
+    # and the recompute as two separate compiled variants — the two-phase
+    # sequence is its contract.
+    model = GPipe(layers, balance=[2], chunks=1, checkpoint="always", fused=False)
     in_spec = jax.ShapeDtypeStruct((2, 4), jnp.float32)
     params, state = model.init(jax.random.PRNGKey(0), in_spec)
     log.clear()  # init-time shape inference traces don't count
@@ -46,6 +49,23 @@ def test_checkpoint_then_recompute_phases():
     # Checkpointed forward traced first, recompute second — exactly the
     # reference's asserted phase sequence.
     assert log == [(True, False), (False, True)], log
+
+
+def test_checkpoint_phase_in_fused_path():
+    """The fused single-device program traces each checkpointed cell exactly
+    once, under is_checkpointing(); rematerialization is a jaxpr replay, so
+    no recompute trace exists for is_recomputing() to observe."""
+    log = []
+    layers = [dense(4, name="d"), _phase_probe(log)]
+    model = GPipe(layers, balance=[2], chunks=1, checkpoint="always", fused=True)
+    in_spec = jax.ShapeDtypeStruct((2, 4), jnp.float32)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    log.clear()
+
+    x = jnp.ones((2, 4))
+    y = jnp.zeros((2, 4))
+    model.value_and_grad(params, state, x, y, lambda o, t: jnp.mean((o - t) ** 2))
+    assert log == [(True, False)], log
 
 
 def test_no_phases_outside_engine():

@@ -1,0 +1,254 @@
+"""Mixture-of-experts + expert parallelism (new TPU-native capability —
+SURVEY.md §2.2 lists EP/MoE as ABSENT in the reference).
+
+Oracle discipline: the dense-dispatch einsum formulation must equal a
+per-token loop over the selected experts; the ep-sharded pipeline run must
+equal the unsharded run and the sequential single-device model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.models.moe import (
+    MoEConfig,
+    llama_moe,
+    llama_moe_spmd,
+    moe_mlp,
+    router_stats,
+)
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    cross_entropy,
+)
+from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+
+def _cfg(**kw):
+    return TransformerConfig(
+        vocab=64, dim=16, n_layers=2, n_heads=2, n_kv_heads=2, **kw
+    )
+
+
+def _assert_trees_close(a, b, rtol=1e-4, atol=1e-5):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol
+        ),
+        a,
+        b,
+    )
+
+
+def test_moe_mlp_matches_per_token_loop():
+    """Dense dispatch einsums == explicit per-token top-k expert loop (no
+    capacity pressure)."""
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=8.0)  # no drops
+    layer = moe_mlp(cfg, moe)
+    b, s = 2, 4
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, s, cfg.dim))
+    params, _ = layer.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    y, _ = layer.apply(params, (), x)
+
+    def expert_ffn(e, v):
+        h = jax.nn.silu(v @ params["w_gate"][e]) * (v @ params["w_up"][e])
+        return h @ params["w_down"][e]
+
+    xf = np.asarray(x.reshape(-1, cfg.dim))
+    probs = np.asarray(
+        jax.nn.softmax(x.reshape(-1, cfg.dim) @ params["router"], -1)
+    )
+    want = np.zeros_like(xf)
+    for t in range(xf.shape[0]):
+        order = np.argsort(-probs[t])[: moe.top_k]
+        denom = probs[t][order].sum() + 1e-9
+        for e in order:
+            want[t] += (
+                probs[t][e] / denom
+            ) * np.asarray(expert_ffn(int(e), jnp.asarray(xf[t])))
+    np.testing.assert_allclose(
+        np.asarray(y).reshape(-1, cfg.dim), want, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_moe_capacity_drops_tokens():
+    """E=1, C=1: only the first token gets a slot; every later token falls
+    back to the residual (zero MLP output)."""
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=1, top_k=1, capacity_factor=1e-9)
+    layer = moe_mlp(cfg, moe)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 6, cfg.dim))
+    params, _ = layer.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    y, _ = layer.apply(params, (), x)
+    y = np.asarray(y)[0]
+    assert np.abs(y[0]).max() > 0
+    np.testing.assert_allclose(y[1:], 0.0, atol=1e-7)
+
+
+def test_router_stats_balance():
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=4, top_k=1)
+    layer = moe_mlp(cfg, moe)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, cfg.dim))
+    params, _ = layer.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    load, imp, balance = router_stats(params["router"], x, moe)
+    np.testing.assert_allclose(float(load.sum()), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(imp.sum()), 1.0, rtol=1e-6)
+    assert float(balance) >= 1.0 - 1e-6  # 1.0 iff perfectly balanced
+
+
+def _moe_seq_oracle(cfg, moe_cfg, pp, params, tokens, labels):
+    block, pre, post = llama_moe_spmd(cfg, moe_cfg, pp)
+    dev0 = jax.devices()[0]
+    params = jax.device_put(params, dev0)
+    tokens, labels = jax.device_put((tokens, labels), dev0)
+
+    def loss_of(p):
+        h, _ = pre.apply(p["pre"], (), tokens, rng=None, train=True)
+        for j in range(pp):
+            pj = jax.tree_util.tree_map(lambda a: a[j], p["blocks"])
+            h, _ = block.apply(pj, (), h, rng=None, train=True)
+        h, _ = post.apply(p["post"], (), h, rng=None, train=True)
+        return cross_entropy(h, labels)
+
+    return jax.value_and_grad(loss_of)(params)
+
+
+def test_spmd_moe_ep_transparency(cpu_devices):
+    """pp=2 x ep=2 run == unsharded pp=2 run == sequential oracle.
+
+    capacity_factor is set high enough that no token drops in either the
+    per-lane (t/ep tokens) or the full-batch capacity computation, so the
+    only difference between configs is where experts live.
+    """
+    pp, ep = 2, 2
+    cfg = _cfg()
+    moe_ep = MoEConfig(n_experts=4, top_k=2, capacity_factor=8.0, ep_axis="ep")
+    moe_ref = MoEConfig(n_experts=4, top_k=2, capacity_factor=8.0)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    tokens = jax.random.randint(k1, (8, 4), 0, cfg.vocab)
+    labels = jax.random.randint(k2, (8, 4), 0, cfg.vocab)
+    in_spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+
+    block, pre, post = llama_moe_spmd(cfg, moe_ep, pp)
+    mesh = make_mesh(pp, dp=1, ep=ep, devices=cpu_devices[: pp * ep])
+    pipe = SpmdGPipe(
+        block, pp, mesh, chunks=2, loss_fn=cross_entropy,
+        pre=pre, post=post, ep_axis="ep",
+    )
+    params = pipe.init(jax.random.PRNGKey(0), in_spec)
+    loss, grads = pipe.train_step(params, tokens, labels)
+
+    # Unsharded run, same params (ep_axis changes no init math).
+    block_r, pre_r, post_r = llama_moe_spmd(cfg, moe_ref, pp)
+    mesh_r = make_mesh(pp, dp=1, devices=cpu_devices[:pp])
+    pipe_r = SpmdGPipe(
+        block_r, pp, mesh_r, chunks=2, loss_fn=cross_entropy,
+        pre=pre_r, post=post_r,
+    )
+    params_r = pipe_r.init(jax.random.PRNGKey(0), in_spec)
+    _assert_trees_close(params, params_r)
+    loss_r, grads_r = pipe_r.train_step(params_r, tokens, labels)
+    np.testing.assert_allclose(float(loss), float(loss_r), rtol=1e-5)
+    _assert_trees_close(grads, grads_r)
+
+    # Sequential oracle.
+    ref_loss, ref_grads = _moe_seq_oracle(cfg, moe_ref, pp, params_r, tokens, labels)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    _assert_trees_close(grads, ref_grads)
+
+
+def test_spmd_moe_ep_with_dp(cpu_devices):
+    """ep composes with dp: pp=2 x dp=2 x ep=2 on 8 devices."""
+    pp, dp, ep = 2, 2, 2
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=4, top_k=1, capacity_factor=8.0, ep_axis="ep")
+    k1, k2 = jax.random.split(jax.random.PRNGKey(5))
+    tokens = jax.random.randint(k1, (8, 4), 0, cfg.vocab)
+    labels = jax.random.randint(k2, (8, 4), 0, cfg.vocab)
+    in_spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+
+    block, pre, post = llama_moe_spmd(cfg, moe, pp)
+    mesh = make_mesh(pp, dp=dp, ep=ep, devices=cpu_devices)
+    pipe = SpmdGPipe(
+        block, pp, mesh, chunks=2, loss_fn=cross_entropy,
+        pre=pre, post=post, dp_axis="dp", ep_axis="ep",
+    )
+    params = pipe.init(jax.random.PRNGKey(0), in_spec)
+    loss, grads = pipe.train_step(params, tokens, labels)
+
+    moe_ref = MoEConfig(n_experts=4, top_k=1, capacity_factor=8.0)
+    ref_loss, ref_grads = _moe_seq_oracle(cfg, moe_ref, pp, params, tokens, labels)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    _assert_trees_close(grads, ref_grads)
+
+
+def test_spmd_moe_rejects_indivisible_experts(cpu_devices):
+    pp, ep = 2, 4
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=6, top_k=1, ep_axis="ep")
+    block, pre, post = llama_moe_spmd(cfg, moe, pp)
+    mesh = make_mesh(pp, dp=1, ep=ep, devices=cpu_devices)
+    with pytest.raises(ValueError, match="n_experts.*not divisible"):
+        SpmdGPipe(
+            block, pp, mesh, chunks=2, loss_fn=cross_entropy,
+            pre=pre, post=post, ep_axis="ep",
+        )
+
+
+def test_spmd_moe_rejects_ep_axis_mismatch(cpu_devices):
+    """Model routed for ep but engine not told — fail loudly."""
+    pp = 2
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=4, top_k=1, ep_axis="ep")
+    block, pre, post = llama_moe_spmd(cfg, moe, pp)
+    mesh = make_mesh(pp, dp=1, ep=2, devices=cpu_devices[:4])
+    with pytest.raises(ValueError, match="declare ep_axis"):
+        SpmdGPipe(
+            block, pp, mesh, chunks=2, loss_fn=cross_entropy,
+            pre=pre, post=post,
+        )
+
+
+def test_mpmd_moe_transparency():
+    """The flat llama_moe list runs on the MPMD GPipe engine and matches the
+    sequential oracle (experts all local — ep axis unbound)."""
+    from torchgpipe_tpu import GPipe
+    from torchgpipe_tpu.layers import sequential_apply
+
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=8.0)
+    layers = llama_moe(cfg, moe)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(11))
+    tokens = jax.random.randint(k1, (4, 4), 0, cfg.vocab)
+    labels = jax.random.randint(k2, (4, 4), 0, cfg.vocab)
+    in_spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+
+    model = GPipe(layers, balance=[2, 2], chunks=2, checkpoint="except_last")
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    loss, grads, _, _ = model.value_and_grad(
+        params, state, tokens, labels, cross_entropy
+    )
+
+    dev0 = jax.devices()[0]
+    flat_p = jax.device_put([leaf for stage in params for leaf in stage], dev0)
+    flat_s = jax.device_put([leaf for stage in state for leaf in stage], dev0)
+    tokens0, labels0 = jax.device_put((tokens, labels), dev0)
+
+    def loss_of(p):
+        out, _ = sequential_apply(layers, p, flat_s, tokens0, rng=None, train=True)
+        return cross_entropy(out, labels0)
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_of)(flat_p)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    _assert_trees_close(
+        [leaf for stage in grads for leaf in stage], ref_grads
+    )

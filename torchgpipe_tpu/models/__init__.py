@@ -6,5 +6,12 @@ transformer/Llama family for the SPMD flagship path.
 """
 
 from torchgpipe_tpu.models.amoebanet import amoebanetd  # noqa: F401
+from torchgpipe_tpu.models.moe import (  # noqa: F401
+    MoEConfig,
+    llama_moe,
+    llama_moe_spmd,
+    moe_mlp,
+    moe_transformer_block,
+)
 from torchgpipe_tpu.models.resnet import build_resnet, resnet50, resnet101  # noqa: F401
 from torchgpipe_tpu.models.unet import unet  # noqa: F401

@@ -1,0 +1,253 @@
+"""Mixture-of-experts feed-forward with expert parallelism over an ``ep``
+mesh axis.
+
+New TPU-native capability — the reference has no expert parallelism at all
+(SURVEY.md §2.2: "Expert parallelism (EP / MoE): ABSENT").  Design is
+MXU/ICI-first, after the public Switch-Transformer / Mesh-TensorFlow token
+dispatch formulation (Fedus et al., arXiv:2101.03961; Lepikhin et al., GShard,
+arXiv:2006.16668 — implemented here from the math):
+
+* **Routing** is a dense softmax over experts with top-k selection and a
+  static per-expert *capacity*; dispatch/combine are one-hot einsums, so the
+  whole layer is batched matmuls (no gather/scatter, MXU-friendly, static
+  shapes).  Tokens overflowing an expert's capacity are dropped — the
+  residual connection around the MLP carries them through unchanged
+  (standard capacity-factor semantics).
+* **Expert parallelism**: expert weights ``[E, ...]`` are sharded over the
+  ``ep`` mesh axis (E/ep experts per lane) and the *batch* is sharded over
+  ``ep`` too (the engine treats ep as an extra data axis).  A tiled
+  ``lax.all_to_all`` carries each lane's dispatched token buffers to the
+  lanes owning their experts and a second one brings the results home —
+  on TPU both ride ICI.  Gradients transpose through the all_to_alls
+  automatically; the engine's grad reduction keeps expert-leaf grads
+  lane-local (see SpmdGPipe ep handling).
+* Outside a bound ep axis (single device, MPMD engine, init-time shape
+  inference) every expert is local and the all_to_alls vanish — one code
+  path serves both.
+
+No auxiliary load-balancing loss is computed inside the layer (the pipeline
+engines' loss is a pure function of the model output); `router_stats`
+returns the standard balance/importance metrics from a forward's hidden
+states for monitoring or for adding a balance term in a custom training
+loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from torchgpipe_tpu.layers import Layer, chain
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    _normal,
+    lm_head,
+    token_embedding,
+    transformer_block,
+)
+from torchgpipe_tpu.parallel.ring_attention import axis_bound
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Expert-layer hyperparameters.
+
+    ``capacity_factor`` scales the per-expert token budget:
+    ``capacity = ceil(capacity_factor * top_k * tokens / n_experts)`` per
+    lane.  1.0 is an exactly-balanced budget; >1 tolerates imbalance; a
+    large value (≥ n_experts/top_k) guarantees no token is ever dropped.
+    """
+
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    ep_axis: Optional[str] = None
+
+
+def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
+    """Dense dispatch/combine tensors from router probabilities.
+
+    probs: ``[t, E]`` f32.  Returns ``combine [t, E, C]`` (gate weights at
+    the token's buffer slot, zero where dropped) and ``dispatch`` (its
+    boolean support).  Slots are assigned first-come-first-served in token
+    order, k-th choices after all (k-1)-th choices (Switch/GShard order).
+    """
+    t, E = probs.shape
+    remaining = probs
+    masks: List[jnp.ndarray] = []
+    gates: List[jnp.ndarray] = []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(idx, E, dtype=probs.dtype)  # [t, E]
+        gates.append(jnp.sum(probs * mask, axis=-1))  # [t]
+        masks.append(mask)
+        remaining = remaining * (1.0 - mask)
+    denom = sum(gates) + 1e-9  # normalize over the k selections
+
+    combine = jnp.zeros((t, E, capacity), probs.dtype)
+    counts = jnp.zeros((E,), probs.dtype)
+    for kk in range(k):
+        mask = masks[kk]
+        pos_in_e = jnp.cumsum(mask, axis=0) - 1.0 + counts  # [t, E]
+        counts = counts + jnp.sum(mask, axis=0)
+        pos = jnp.sum(pos_in_e * mask, axis=-1).astype(jnp.int32)  # [t]
+        keep = (pos < capacity) & (jnp.sum(mask, axis=-1) > 0)
+        gate_k = jnp.where(keep, gates[kk] / denom, 0.0)
+        slot = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)  # [t, C]
+        combine = combine + (
+            mask[:, :, None] * slot[:, None, :] * gate_k[:, None, None]
+        )
+    dispatch = combine > 0.0
+    return combine, dispatch
+
+
+def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Layer:
+    """Top-k routed expert SwiGLU feed-forward on ``[b, s, dim]`` states.
+
+    Plug into :func:`~torchgpipe_tpu.models.transformer.transformer_block`
+    via its ``mlp=`` argument; params: f32 ``router [dim, E]`` plus expert
+    weights ``w_gate/w_up [E, dim, hidden]``, ``w_down [E, hidden, dim]``
+    (sharded over ``moe.ep_axis`` when set).
+    """
+    dim, hidden = cfg.dim, cfg.mlp_hidden
+    E, K = moe.n_experts, moe.top_k
+    dt = cfg.dtype
+    if K > E:
+        raise ValueError(f"top_k={K} exceeds n_experts={E}")
+
+    def init(rng, in_spec):
+        del in_spec
+        ks = jax.random.split(rng, 4)
+        std = dim ** -0.5
+        params = {
+            # f32 router: routing decisions are argmaxes over near-ties;
+            # keeping them out of bf16 avoids batch-dependent flips.
+            "router": _normal(ks[0], (dim, E), std, jnp.float32),
+            "w_gate": _normal(ks[1], (E, dim, hidden), std, dt),
+            "w_up": _normal(ks[2], (E, dim, hidden), std, dt),
+            "w_down": _normal(ks[3], (E, hidden, dim), hidden ** -0.5, dt),
+        }
+        return params, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        b, s, d = x.shape
+        t = b * s
+        xf = x.reshape(t, d)
+
+        ep_active = axis_bound(moe.ep_axis)
+        # Per-lane capacity from the *local* token count (static shape).
+        capacity = max(1, math.ceil(moe.capacity_factor * K * t / E))
+
+        logits = xf.astype(jnp.float32) @ params["router"]  # [t, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        combine, dispatch = _top_k_dispatch(probs, K, capacity)
+
+        # Dispatch: [t, E, C] one-hot x [t, d] -> per-expert buffers [E, C, d].
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(xf.dtype), xf
+        )
+        if ep_active:
+            # Route buffers to the lanes owning their experts: split the
+            # expert dim, concat received blocks along capacity.
+            # [E, C, d] -> [E/ep, ep*C, d]; one ICI all_to_all.
+            expert_in = lax.all_to_all(
+                expert_in, moe.ep_axis, split_axis=0, concat_axis=1, tiled=True
+            )
+        # Local expert compute: batched per-expert SwiGLU (MXU einsums).
+        h = jax.nn.silu(
+            jnp.einsum("ecd,edh->ech", expert_in, params["w_gate"])
+        ) * jnp.einsum("ecd,edh->ech", expert_in, params["w_up"])
+        out = jnp.einsum("ech,ehd->ecd", h, params["w_down"])
+        if ep_active:
+            # Bring results home: inverse all_to_all.
+            out = lax.all_to_all(
+                out, moe.ep_axis, split_axis=1, concat_axis=0, tiled=True
+            )
+        y = jnp.einsum("tec,ecd->td", combine.astype(out.dtype), out)
+        return y.reshape(b, s, d).astype(x.dtype), state
+
+    def validate_mesh(mesh):
+        ax = moe.ep_axis
+        if ax is None or ax not in mesh.axis_names:
+            return
+        size = mesh.shape[ax]
+        if E % size != 0:
+            raise ValueError(
+                f"n_experts={E} is not divisible by the ep mesh axis size "
+                f"{size}; expert parallelism places whole experts on lanes"
+            )
+
+    ep = moe.ep_axis
+    return Layer(
+        name=name,
+        init=init,
+        apply=apply,
+        meta={
+            "kind": "moe_mlp",
+            "ep_axis": ep,
+            "validate_mesh": validate_mesh,
+            "param_specs": None if ep is None else {
+                "router": P(),
+                "w_gate": P(ep),
+                "w_up": P(ep),
+                "w_down": P(ep),
+            },
+        },
+    )
+
+
+def router_stats(params_router: jnp.ndarray, x: jnp.ndarray, moe: MoEConfig):
+    """Standard router monitoring metrics from hidden states ``[b, s, dim]``:
+    ``(load, importance, balance_loss)`` — per-expert token fractions,
+    per-expert mean probabilities, and the Switch-style balance penalty
+    ``E * sum(load * importance)`` (1.0 = perfectly balanced)."""
+    t = x.shape[0] * x.shape[1]
+    logits = x.reshape(t, -1).astype(jnp.float32) @ params_router
+    probs = jax.nn.softmax(logits, axis=-1)
+    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), moe.n_experts, dtype=jnp.float32)
+    load = jnp.mean(top1, axis=0)
+    importance = jnp.mean(probs, axis=0)
+    balance = moe.n_experts * jnp.sum(load * importance)
+    return load, importance, balance
+
+
+def moe_transformer_block(
+    cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe_block"
+) -> Layer:
+    """Pre-norm block with routed-expert feed-forward (attention from
+    :func:`transformer_block`, MoE in the MLP slot)."""
+    return transformer_block(cfg, name=name, mlp=moe_mlp(cfg, moe))
+
+
+def llama_moe(cfg: TransformerConfig, moe: MoEConfig) -> List[Layer]:
+    """Flat sequential layer list (embed, MoE blocks, head) for the MPMD
+    GPipe engine — the Mixtral-style every-block-MoE shape."""
+    layers: List[Layer] = [token_embedding(cfg)]
+    for i in range(cfg.n_layers):
+        layers.append(moe_transformer_block(cfg, moe, name=f"moe_block{i}"))
+    layers.append(lm_head(cfg))
+    return layers
+
+
+def llama_moe_spmd(
+    cfg: TransformerConfig, moe: MoEConfig, n_stages: int
+) -> Tuple[Layer, Layer, Layer]:
+    """(block, pre, post) for the SPMD engine: each stage runs
+    ``n_layers // n_stages`` MoE blocks."""
+    if cfg.n_layers % n_stages != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} must divide evenly into {n_stages} stages"
+        )
+    per = cfg.n_layers // n_stages
+    block = chain(
+        [moe_transformer_block(cfg, moe, name=f"b{i}") for i in range(per)],
+        name="stage",
+    )
+    return block, token_embedding(cfg), lm_head(cfg)

@@ -23,9 +23,12 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from jax.sharding import PartitionSpec as P
+
 from torchgpipe_tpu.layers import Layer, chain
 from torchgpipe_tpu.parallel import attention
 from torchgpipe_tpu.parallel.ring_attention import axis_bound
+from torchgpipe_tpu.parallel.tensor import psum_grad, psum_value
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +46,12 @@ class TransformerConfig:
     # sharded over (ring attention + sp-offset rotary positions).  None =
     # single-shard sequences.  See torchgpipe_tpu.parallel.ring_attention.
     sp_axis: Optional[str] = None
+    # Tensor parallelism: name of the mesh axis attention heads and MLP
+    # hidden units are sharded over (Megatron-style; see
+    # torchgpipe_tpu.parallel.tensor).  None = no weight sharding.  The tp
+    # size must divide n_heads, kv_heads and mlp_hidden (the engine checks
+    # against the actual mesh at init).
+    tp_axis: Optional[str] = None
 
     @property
     def kv_heads(self) -> int:
@@ -106,11 +115,19 @@ def _rope(x: jnp.ndarray, theta: float, pos_offset=0) -> jnp.ndarray:
     return out.astype(x.dtype)
 
 
-def transformer_block(cfg: TransformerConfig, *, name: str = "block") -> Layer:
+def transformer_block(
+    cfg: TransformerConfig, *, name: str = "block", mlp: Optional[Layer] = None
+) -> Layer:
     """One pre-norm block: x + attn(norm(x)); x + mlp(norm(x)).
 
     Residuals are internal to the layer, so a pipeline can split the model at
     any block boundary without skip routing.
+
+    ``mlp`` swaps the dense SwiGLU feed-forward for a custom layer on the
+    normalized hidden states (e.g. :func:`torchgpipe_tpu.models.moe.moe_mlp`
+    for a mixture-of-experts block); its params live under the ``"mlp"`` key
+    and its ``meta`` (param_specs / validate_mesh / ep_axis) is composed into
+    the block's.
     """
     dim, hd = cfg.dim, cfg.head_dim
     nh, nkv = cfg.n_heads, cfg.kv_heads
@@ -118,8 +135,7 @@ def transformer_block(cfg: TransformerConfig, *, name: str = "block") -> Layer:
     dt = cfg.dtype
 
     def init(rng, in_spec):
-        del in_spec
-        ks = jax.random.split(rng, 7)
+        ks = jax.random.split(rng, 8)
         std = dim ** -0.5
         params = {
             "ln1": jnp.ones((dim,)),
@@ -128,14 +144,23 @@ def transformer_block(cfg: TransformerConfig, *, name: str = "block") -> Layer:
             "wv": _normal(ks[2], (dim, nkv * hd), std, dt),
             "wo": _normal(ks[3], (nh * hd, dim), std, dt),
             "ln2": jnp.ones((dim,)),
-            "w_gate": _normal(ks[4], (dim, hidden), std, dt),
-            "w_up": _normal(ks[5], (dim, hidden), std, dt),
-            "w_down": _normal(ks[6], (hidden, dim), hidden ** -0.5, dt),
         }
+        if mlp is None:
+            params.update(
+                w_gate=_normal(ks[4], (dim, hidden), std, dt),
+                w_up=_normal(ks[5], (dim, hidden), std, dt),
+                w_down=_normal(ks[6], (hidden, dim), hidden ** -0.5, dt),
+            )
+        else:
+            mp, ms = mlp.init(ks[7], in_spec)
+            if jax.tree_util.tree_leaves(ms):
+                raise ValueError(
+                    f"transformer_block mlp {mlp.name!r} must be stateless"
+                )
+            params["mlp"] = mp
         return params, ()
 
     def apply(params, state, x, *, rng=None, train=True):
-        del rng, train
         b, s, _ = x.shape
 
         # Sequence parallelism: when the sp axis is bound (inside the SPMD
@@ -146,35 +171,106 @@ def transformer_block(cfg: TransformerConfig, *, name: str = "block") -> Layer:
         pos_offset = (
             jax.lax.axis_index(cfg.sp_axis) * s if sp_active else 0
         )
+        # Tensor parallelism: inside the engine's shard_map the weight leaves
+        # arrive pre-sliced (wq holds this lane's heads, w_gate this lane's
+        # hidden units), so head counts come from the *local* weight shapes —
+        # the same code runs the full weights when tp is off or unbound.
+        tp_active = axis_bound(cfg.tp_axis)
+        nh_loc = params["wq"].shape[1] // hd
+        nkv_loc = params["wk"].shape[1] // hd
 
         h = _rms(x, params["ln1"], cfg.norm_eps)
-        q = (h @ params["wq"]).reshape(b, s, nh, hd)
-        k = (h @ params["wk"]).reshape(b, s, nkv, hd)
-        v = (h @ params["wv"]).reshape(b, s, nkv, hd)
+        if tp_active:
+            h = psum_grad(h, cfg.tp_axis)  # region entry: full grad upstream
+        q = (h @ params["wq"]).reshape(b, s, nh_loc, hd)
+        k = (h @ params["wk"]).reshape(b, s, nkv_loc, hd)
+        v = (h @ params["wv"]).reshape(b, s, nkv_loc, hd)
         q = _rope(q, cfg.rope_theta, pos_offset)
         k = _rope(k, cfg.rope_theta, pos_offset)
         # GQA: K/V stay at n_kv heads — the attention kernel groups queries
         # at the compute site, so the sp ring only moves n_kv-head blocks.
+        # Under tp, lanes hold contiguous head ranges, so the local q→kv
+        # pairing (h // r with r = nh_loc/nkv_loc = nh/nkv) matches global.
         attn = attention(
             q, k, v, axis_name=cfg.sp_axis if sp_active else None, causal=True
         )
-        x = x + attn.reshape(b, s, nh * hd) @ params["wo"]
+        attn_out = attn.reshape(b, s, nh_loc * hd) @ params["wo"]
+        if tp_active:
+            attn_out = psum_value(attn_out, cfg.tp_axis)  # region exit
+        x = x + attn_out
 
         h = _rms(x, params["ln2"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ params["w_gate"])
-        up = h @ params["w_up"]
-        x = x + (gate * up) @ params["w_down"]
+        if mlp is not None:
+            mlp_out, _ = mlp.apply(params["mlp"], (), h, rng=rng, train=train)
+        else:
+            if tp_active:
+                h = psum_grad(h, cfg.tp_axis)
+            gate = jax.nn.silu(h @ params["w_gate"])
+            up = h @ params["w_up"]
+            mlp_out = (gate * up) @ params["w_down"]
+            if tp_active:
+                mlp_out = psum_value(mlp_out, cfg.tp_axis)
+        x = x + mlp_out
         return x, state
 
-    return Layer(
-        name=name,
-        init=init,
-        apply=apply,
-        # Declares which sp axis (if any) the block's attention collects
+    tp = cfg.tp_axis
+    mlp_meta = mlp.meta if (mlp is not None and isinstance(mlp.meta, dict)) else {}
+
+    def validate_mesh(mesh):
+        if tp is not None and tp in mesh.axis_names:
+            size = mesh.shape[tp]
+            checks = [("n_heads", nh), ("kv_heads", nkv)]
+            if mlp is None:
+                checks.append(("mlp_hidden", hidden))
+            for what, count in checks:
+                if count % size != 0:
+                    raise ValueError(
+                        f"{what}={count} is not divisible by the tp mesh "
+                        f"axis size {size}; tensor parallelism shards whole "
+                        "heads / hidden units across lanes"
+                    )
+        if "validate_mesh" in mlp_meta:
+            mlp_meta["validate_mesh"](mesh)
+
+    # Per-stage param specs (pre-stacking): column-parallel projections shard
+    # their output dim over tp, row-parallel their input dim; a custom mlp
+    # contributes its own declared subtree (or stays replicated).  The dict
+    # must name every param key, so it is built only when something in the
+    # block is actually sharded.
+    mlp_specs = mlp_meta.get("param_specs")
+    if tp is not None or mlp_specs is not None:
+        param_specs: Optional[dict] = {
+            "ln1": P(),
+            "wq": P() if tp is None else P(None, tp),
+            "wk": P() if tp is None else P(None, tp),
+            "wv": P() if tp is None else P(None, tp),
+            "wo": P() if tp is None else P(tp, None),
+            "ln2": P(),
+        }
+        if mlp is None:
+            param_specs.update(
+                w_gate=P(None, tp),
+                w_up=P(None, tp),
+                w_down=P(tp, None),
+            )
+        else:
+            param_specs["mlp"] = mlp_specs if mlp_specs is not None else P()
+    else:
+        param_specs = None
+
+    meta = {
+        # Declares which sp/tp (and the mlp's ep) axes the block collects
         # over, so the SPMD engine can reject a cfg/engine mismatch instead
-        # of silently computing shard-local attention.
-        meta={"kind": "transformer_block", "sp_axis": cfg.sp_axis},
-    )
+        # of silently computing shard-local attention / partial sums.
+        "kind": "transformer_block",
+        "sp_axis": cfg.sp_axis,
+        "tp_axis": tp,
+        "validate_mesh": validate_mesh,
+        "param_specs": param_specs,
+    }
+    if "ep_axis" in mlp_meta:
+        meta["ep_axis"] = mlp_meta["ep_axis"]
+    return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
 def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:

@@ -1,0 +1,86 @@
+"""Tensor parallelism primitives (Megatron-style, shard_map-native).
+
+The reference has no tensor parallelism at all (SURVEY.md §2.2: "Tensor
+parallelism (TP): ABSENT") — this is new TPU-native capability.  Weights of
+a parallel region are sharded over a ``tp`` mesh axis — column-parallel for
+the region's input projections (attention heads / MLP hidden units split
+across lanes), row-parallel for the output projection — and the activations
+entering/leaving the region are replicated.  Two collectives make the math
+exact (Shoeybi et al., "Megatron-LM", arXiv:1909.08053 — public technique,
+implemented here from the math):
+
+* **region exit** — :func:`psum_value`: ``psum`` of the per-lane partial
+  outputs in forward, *identity* in backward.  The downstream computation is
+  replicated over tp, so each lane already holds the full output cotangent;
+  a raw ``lax.psum`` would transpose to another ``psum`` (shard_map's
+  conservative rule when replication checking is off) and over-count every
+  gradient upstream of the region by the tp size.
+* **region entry** — :func:`psum_grad`: identity in forward, ``psum`` over
+  the tp axis in backward.  Each lane back-propagates only its own heads' /
+  hidden-units' contribution to the region input; summing the cotangents
+  reassembles the full gradient before it reaches the (replicated) layers
+  upstream.
+
+With both in place, every activation *outside* a region — and therefore the
+gradient of every tp-replicated parameter (norm scales, embeddings, heads) —
+is bit-identical across tp lanes; no separate gradient synchronization pass
+is needed.  Parameters sharded over tp keep lane-local gradients, which is
+exactly the sharding their optimizer state wants.
+
+On TPU hardware the two psums per region ride the ICI mesh; tp should map to
+the innermost (fastest) mesh dimension (:func:`torchgpipe_tpu.spmd.make_mesh`
+lays it out that way).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_grad(x, axis_name: str):
+    """Identity forward; ``psum`` of the cotangent over ``axis_name`` backward.
+
+    Place at the *entry* of a tensor-parallel region (after the last
+    replicated computation, before the column-parallel matmuls).  The
+    Megatron "f" operator.
+    """
+    return x
+
+
+def _psum_grad_fwd(x, axis_name):
+    return x, None
+
+
+def _psum_grad_bwd(axis_name, _, g):
+    return (jax.tree_util.tree_map(lambda t: lax.psum(t, axis_name), g),)
+
+
+psum_grad.defvjp(_psum_grad_fwd, _psum_grad_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_value(x, axis_name: str):
+    """``psum`` over ``axis_name`` forward; identity backward.
+
+    Place at the *exit* of a tensor-parallel region (after the row-parallel
+    matmul) to sum the per-lane partial outputs.  The Megatron "g" operator:
+    since everything downstream is replicated over the axis, the local
+    cotangent already equals the full one — transposing to another psum
+    would multiply gradients by the lane count.
+    """
+    return jax.tree_util.tree_map(lambda t: lax.psum(t, axis_name), x)
+
+
+def _psum_value_fwd(x, axis_name):
+    return psum_value(x, axis_name), None
+
+
+def _psum_value_bwd(axis_name, _, g):
+    return (g,)
+
+
+psum_value.defvjp(_psum_value_fwd, _psum_value_bwd)

@@ -557,8 +557,14 @@ class Pipeline:
 
         # static_argnums: none — train=True baked in; rng may be None, which
         # jax.checkpoint tolerates as a pytree leaf-less input.
+        # The checkpointing phase flag is set for the (single) trace of the
+        # cell; rematerialization replays the jaxpr at the XLA level, so no
+        # separate recompute trace exists for is_recomputing() to observe —
+        # phase-sensitive layers (DeferredBatchNorm) are traced once, which
+        # is exactly the once-per-mini-batch stats behavior they want.
         def cell(p, s, x, sk, key):
-            return fn(p, s, x, sk, key, True)
+            with ckpt.phase(checkpointing=True):
+                return fn(p, s, x, sk, key, True)
 
         return jax.checkpoint(cell)
 

@@ -51,8 +51,8 @@ from torchgpipe_tpu.layers import Layer
 Pytree = Any
 
 
-def _declared_sp_axes(layer: Layer) -> list:
-    """Collect ``meta['sp_axis']`` declarations, recursing into compounds."""
+def _declared_axes(layer: Layer, key: str) -> list:
+    """Collect ``meta[key]`` declarations, recursing into compounds."""
     out = []
     meta = layer.meta
     if isinstance(meta, dict):
@@ -60,10 +60,73 @@ def _declared_sp_axes(layer: Layer) -> list:
             children = meta["children"]
             values = children.values() if isinstance(children, dict) else children
             for child in values:
-                out.extend(_declared_sp_axes(child))
-        elif "sp_axis" in meta:
-            out.append(meta["sp_axis"])
+                out.extend(_declared_axes(child, key))
+        elif key in meta:
+            out.append(meta[key])
     return out
+
+
+def layer_param_specs(layer: Layer, stage_axis: str) -> Pytree:
+    """``PartitionSpec`` pytree *prefix* for a layer's (stage-stacked) params.
+
+    Layers declare sharded leaves via ``meta['param_specs']`` — a dict naming
+    *every* param key with its per-stage spec (e.g. the tensor-parallel
+    transformer block shards head/hidden dims over the tp axis; the MoE
+    layer shards the expert dim over the ep axis).  A declared value may
+    itself be a dict (a sub-layer's specs) or a bare ``P`` prefix covering
+    that subtree.  Undeclared layers get a single ``P(stage_axis)`` prefix
+    covering their whole params subtree (stacked-stage dim sharded,
+    everything else replicated).  Compound layers (chain/structured)
+    recurse; fully-replicated subtrees collapse back to one prefix spec.
+    The result is valid as a shard_map in/out spec and broadcasts to
+    per-leaf form via :func:`broadcast_specs`.
+    """
+    repl = P(stage_axis)
+    meta = layer.meta
+    if isinstance(meta, dict) and meta.get("kind") == "compound":
+        children = meta["children"]
+        if isinstance(children, dict):
+            sub: Any = {
+                k: layer_param_specs(v, stage_axis) for k, v in children.items()
+            }
+            vals = list(sub.values())
+        else:
+            sub = tuple(layer_param_specs(c, stage_axis) for c in children)
+            vals = list(sub)
+        if all(isinstance(v, P) and v == repl for v in vals):
+            return repl
+        return sub
+    declared = meta.get("param_specs") if isinstance(meta, dict) else None
+    if declared:
+
+        def with_stage(s):
+            if isinstance(s, P):
+                return P(stage_axis, *tuple(s))
+            return {k: with_stage(v) for k, v in s.items()}
+
+        return {k: with_stage(s) for k, s in declared.items()}
+    return repl
+
+
+def spec_mentions(spec: P, axis: str) -> bool:
+    """True if a PartitionSpec shards any dim over ``axis``."""
+    for ax in spec:
+        if ax is None:
+            continue
+        if axis in (ax if isinstance(ax, tuple) else (ax,)):
+            return True
+    return False
+
+
+def broadcast_specs(prefix: Pytree, tree: Pytree) -> Pytree:
+    """Expand a spec pytree-prefix to one ``PartitionSpec`` per leaf of
+    ``tree`` (the same broadcasting shard_map applies to its in_specs)."""
+    return jax.tree_util.tree_map(
+        lambda spec, subtree: jax.tree_util.tree_map(lambda _: spec, subtree),
+        prefix,
+        tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -112,6 +175,8 @@ class SpmdGPipe:
     pp_axis: str = "pp"
     dp_axis: Optional[str] = None
     sp_axis: Optional[str] = None
+    tp_axis: Optional[str] = None
+    ep_axis: Optional[str] = None
     loss_reduction: Optional[str] = "mean"
 
     def __post_init__(self):
@@ -132,10 +197,9 @@ class SpmdGPipe:
                 f"pp mesh axis size {self.mesh.shape[self.pp_axis]} != "
                 f"n_stages {self.n_stages}"
             )
-        if self.dp_axis is not None and self.dp_axis not in self.mesh.axis_names:
-            raise ValueError(f"mesh has no {self.dp_axis!r} axis: {self.mesh}")
-        if self.sp_axis is not None and self.sp_axis not in self.mesh.axis_names:
-            raise ValueError(f"mesh has no {self.sp_axis!r} axis: {self.mesh}")
+        for ax in (self.dp_axis, self.sp_axis, self.tp_axis, self.ep_axis):
+            if ax is not None and ax not in self.mesh.axis_names:
+                raise ValueError(f"mesh has no {ax!r} axis: {self.mesh}")
         if self.checkpoint not in ("always", "never"):
             # 'except_last' (reference gpipe.py:360-367) cannot be expressed
             # inside one lax.scan: scan stacks per-tick residual buffers
@@ -155,21 +219,39 @@ class SpmdGPipe:
                 "sequence parallelism needs a batch/token-decomposable loss: "
                 "set loss_reduction='mean' or 'sum'"
             )
-        # Layers that collect over a sequence axis declare it in meta
-        # (e.g. TransformerConfig.sp_axis); a mismatch with the engine's
-        # sp_axis would silently compute shard-local attention / bogus
-        # rotary offsets, so fail loudly instead.
-        declared = set()
+        if self.ep_axis is not None and self.loss_reduction is None:
+            raise ValueError(
+                "expert parallelism shards the batch over the ep axis, so it "
+                "needs a batch-decomposable loss: set loss_reduction='mean' "
+                "or 'sum'"
+            )
+        # Layers may declare mesh-validation hooks (e.g. the tensor-parallel
+        # transformer block checks that the tp size divides its head counts —
+        # flat-dim divisibility alone would let a head split across lanes).
         for lyr in (self.block, self.pre, self.post):
             if lyr is not None:
-                declared.update(_declared_sp_axes(lyr))
-        if declared and declared != {self.sp_axis}:
-            raise ValueError(
-                f"model layers declare sp_axis {sorted(map(str, declared))} "
-                f"but the engine was given sp_axis={self.sp_axis!r}; set "
-                "both from the same value (e.g. TransformerConfig.sp_axis "
-                "and SpmdGPipe.sp_axis)"
-            )
+                for validate in _declared_axes(lyr, "validate_mesh"):
+                    validate(self.mesh)
+        # Layers that collect over a sequence or tensor axis declare it in
+        # meta (e.g. TransformerConfig.sp_axis / tp_axis); a mismatch with
+        # the engine's axes would silently compute shard-local attention /
+        # partial matmul sums, so fail loudly instead.
+        for key, mine in (
+            ("sp_axis", self.sp_axis),
+            ("tp_axis", self.tp_axis),
+            ("ep_axis", self.ep_axis),
+        ):
+            declared = set()
+            for lyr in (self.block, self.pre, self.post):
+                if lyr is not None:
+                    declared.update(_declared_axes(lyr, key))
+            if declared and declared != {mine}:
+                raise ValueError(
+                    f"model layers declare {key} {sorted(map(str, declared))} "
+                    f"but the engine was given {key}={mine!r}; set "
+                    f"both from the same value (e.g. TransformerConfig.{key} "
+                    f"and SpmdGPipe.{key})"
+                )
 
         raw_apply = self.block.apply
 
@@ -180,6 +262,10 @@ class SpmdGPipe:
         if self.checkpoint == "always":
             block_fn = jax.checkpoint(block_fn, static_argnums=(3,))
         self._block_fn = block_fn
+        # Spec prefix for the stacked block params: stage dim over pp, plus
+        # any per-leaf sharding the layers declare (tensor/expert-parallel
+        # weights) — see layer_param_specs.
+        self._blocks_spec = layer_param_specs(self.block, self.pp_axis)
         self._train_step_fns: dict = {}  # keyed by use_rng
         self._apply_fn = None
 
@@ -245,14 +331,28 @@ class SpmdGPipe:
 
         return params
 
+    def _blocks_leaf_specs(self, blocks: Pytree) -> Pytree:
+        try:
+            return broadcast_specs(self._blocks_spec, blocks)
+        except ValueError as e:
+            raise ValueError(
+                "block param structure does not match its declared "
+                "meta['param_specs'] (the dict must name every param key of "
+                f"the layer): {e}"
+            ) from None
+
     def place(self, params: dict) -> dict:
-        """Commit params to the mesh: blocks stage-sharded over ``pp``,
+        """Commit params to the mesh: blocks stage-sharded over ``pp`` (plus
+        any tensor/expert-parallel leaf sharding the layers declare),
         pre/post replicated."""
         repl = NamedSharding(self.mesh, P())
-        stage = NamedSharding(self.mesh, P(self.pp_axis))
+        specs = self._blocks_leaf_specs(params["blocks"])
+        self._check_spec_shapes(params["blocks"], specs)
         out = dict(params)
         out["blocks"] = jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, stage), params["blocks"]
+            lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
+            params["blocks"],
+            specs,
         )
         for k in ("pre", "post"):
             if k in params:
@@ -260,6 +360,26 @@ class SpmdGPipe:
                     lambda a: jax.device_put(a, repl), params[k]
                 )
         return out
+
+    def _check_spec_shapes(self, blocks: Pytree, specs: Pytree) -> None:
+        """Every sharded dim must divide by its mesh-axis size — checked
+        eagerly for a didactic error instead of a shard_map failure."""
+
+        def chk(a, spec):
+            for i, ax in enumerate(spec):
+                if ax is None:
+                    continue
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                size = int(np.prod([self.mesh.shape[a_] for a_ in axes]))
+                if a.shape[i] % size != 0:
+                    raise ValueError(
+                        f"param dim {i} of shape {a.shape} is sharded over "
+                        f"mesh axes {axes} (size {size}) but is not "
+                        "divisible by it; adjust the model dims (e.g. "
+                        "n_heads/kv_heads/mlp_hidden vs the tp size)"
+                    )
+
+        jax.tree_util.tree_map(chk, blocks, specs)
 
     @staticmethod
     def _check_stateless(state, what: str) -> None:
@@ -321,12 +441,16 @@ class SpmdGPipe:
 
     def _data_specs(self):
         # Stacked data is [m, batch, seq, ...]: micro-batch axis unsharded,
-        # batch over dp, sequence over sp (when enabled).
+        # batch over dp (and ep — expert parallelism shards tokens too, the
+        # all_to_all inside the MoE layer routes them to their experts),
+        # sequence over sp (when enabled).
+        batch_axes = tuple(
+            a for a in (self.dp_axis, self.ep_axis) if a is not None
+        )
+        batch = batch_axes if batch_axes else None
         if self.sp_axis:
-            return P(None, self.dp_axis, self.sp_axis)
-        if self.dp_axis:
-            return P(None, self.dp_axis)
-        return P(None)
+            return P(None, batch, self.sp_axis)
+        return P(None, batch)
 
     def _apply_pre(self, pre_params, x_mb, rng, train: bool):
         """Apply ``pre`` per micro-batch with independent keys (matching the
@@ -432,6 +556,31 @@ class SpmdGPipe:
             if self.dp_axis:
                 loss = lax.pmean(loss, self.dp_axis)
                 grads = lax.pmean(grads, self.dp_axis)
+            if self.ep_axis:
+                # ep shards the batch like an extra dp axis, but expert
+                # weights are *sharded* over it: their lane-local grads
+                # already sum contributions from every lane's tokens (the
+                # all_to_all transpose routed the cotangents home), so they
+                # take only the global-mean scaling (1/ep for 'mean';
+                # nothing for 'sum').  Replicated leaves reduce like dp.
+                ep_n = self.mesh.shape[self.ep_axis]
+                mean = self.loss_reduction == "mean"
+                red = lax.pmean if mean else lax.psum
+                loss = red(loss, self.ep_axis)
+                bspecs = self._blocks_leaf_specs(grads["blocks"])
+
+                def red_ep(g, s):
+                    if spec_mentions(s, self.ep_axis):
+                        return g / ep_n if mean else g
+                    return red(g, self.ep_axis)
+
+                grads = dict(grads)
+                grads["blocks"] = jax.tree_util.tree_map(
+                    red_ep, grads["blocks"], bspecs
+                )
+                for k in ("pre", "post"):
+                    if k in grads:
+                        grads[k] = red(grads[k], self.ep_axis)
             if self.sp_axis:
                 # Params are replicated over sp; each lane differentiated its
                 # own token shard's loss.  mean-reduction: global loss/grad is
@@ -441,7 +590,7 @@ class SpmdGPipe:
                 grads = red(grads, self.sp_axis)
             return loss, grads
 
-        param_specs = {"blocks": P(self.pp_axis)}
+        param_specs = {"blocks": self._blocks_spec}
         if self.pre is not None:
             param_specs["pre"] = P()
         if self.post is not None:
@@ -461,13 +610,14 @@ class SpmdGPipe:
 
     def _check_batch(self, x, target=None) -> None:
         dp = self.mesh.shape[self.dp_axis] if self.dp_axis else 1
+        ep = self.mesh.shape[self.ep_axis] if self.ep_axis else 1
         b = microbatch.batch_size(x)
-        if b % (self.chunks * dp) != 0:
+        if b % (self.chunks * dp * ep) != 0:
             raise ValueError(
-                f"batch size {b} must be divisible by chunks*dp = "
-                f"{self.chunks}*{dp} = {self.chunks * dp} for the SPMD engine "
-                "(pad the batch, or use the MPMD GPipe engine for ragged "
-                "micro-batches)"
+                f"batch size {b} must be divisible by chunks*dp*ep = "
+                f"{self.chunks}*{dp}*{ep} = {self.chunks * dp * ep} for the "
+                "SPMD engine (pad the batch, or use the MPMD GPipe engine "
+                "for ragged micro-batches)"
             )
         if self.sp_axis:
             sp = self.mesh.shape[self.sp_axis]
@@ -489,7 +639,7 @@ class SpmdGPipe:
         """One pipelined forward+backward; returns ``(loss, grads)``.
 
         ``x``/``target`` are full mini-batches ``[B, ...]`` with
-        ``B % (chunks * dp) == 0``.  Pass ``rng`` if any layer uses
+        ``B % (chunks * dp * ep) == 0``.  Pass ``rng`` if any layer uses
         randomness (dropout raises loudly without it, matching the MPMD
         engine); omit it for deterministic models.
         """
@@ -525,7 +675,7 @@ class SpmdGPipe:
                 lambda a: lax.psum(a, self.pp_axis), masked
             )
 
-        param_specs = {"blocks": P(self.pp_axis)}
+        param_specs = {"blocks": self._blocks_spec}
         if self.pre is not None:
             param_specs["pre"] = P()
         if self.post is not None:
@@ -554,16 +704,34 @@ def _zeros(spec):
 
 
 def make_mesh(
-    n_stages: int, dp: int = 1, sp: int = 1, *, devices: Optional[Sequence] = None
+    n_stages: int,
+    dp: int = 1,
+    sp: int = 1,
+    *,
+    tp: int = 1,
+    ep: int = 1,
+    devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build a ('pp', 'dp'[, 'sp']) mesh from the available devices."""
+    """Build a ('pp', 'dp'[, 'ep'][, 'sp'][, 'tp']) mesh from the devices.
+
+    Axis order is bandwidth-aware: ``tp`` innermost (its two psums per block
+    are the chattiest collective — they get the fastest ICI neighbors), then
+    ``sp`` (one K/V block per ring step), ``ep`` (one all_to_all pair per MoE
+    layer), then ``dp`` (one gradient reduction per step) and ``pp``
+    outermost (one activation hand-off per tick, smallest payloads —
+    cross-host DCN-tolerant).  Axes of size 1 are omitted except ``pp`` and
+    ``dp``, which existing callers rely on.
+    """
     if devices is None:
         devices = jax.devices()
-    need = n_stages * dp * sp
+    need = n_stages * dp * sp * tp * ep
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")
-    if sp > 1:
-        arr = np.array(devices[:need]).reshape(n_stages, dp, sp)
-        return Mesh(arr, ("pp", "dp", "sp"))
-    arr = np.array(devices[:need]).reshape(n_stages, dp)
-    return Mesh(arr, ("pp", "dp"))
+    dims = [("pp", n_stages), ("dp", dp), ("ep", ep), ("sp", sp), ("tp", tp)]
+    keep = [
+        (name, size)
+        for name, size in dims
+        if size > 1 or name in ("pp", "dp")
+    ]
+    arr = np.array(devices[:need]).reshape([s for _, s in keep])
+    return Mesh(arr, tuple(n for n, _ in keep))
