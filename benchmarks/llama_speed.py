@@ -65,7 +65,15 @@ def causal_lm_loss(out, tokens):
               help="bfloat16 block compute (TransformerConfig.dtype)")
 @click.option("--checkpoint", default="except_last",
               type=click.Choice(["always", "except_last", "never"]))
-def main(experiment, preset, engine, seq, batch, epochs, steps, bf16, checkpoint):
+@click.option("--moe-experts", default=0,
+              help="replace the dense MLP with a top-k routed MoE of this "
+                   "many experts (0 = dense)")
+@click.option("--moe-top-k", default=2)
+@click.option("--ep", default=1,
+              help="expert-parallel mesh axis size (spmd engine; needs "
+                   "n_stages*ep devices)")
+def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
+         checkpoint, moe_experts, moe_top_k, ep):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     dim, n_layers, n_heads, n_kv, vocab = PRESETS[preset]
@@ -73,12 +81,34 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16, checkpoint
         vocab=vocab, dim=dim, n_layers=n_layers, n_heads=n_heads,
         n_kv_heads=n_kv, dtype=jnp.bfloat16 if bf16 else jnp.float32,
     )
+    if ep > 1 and engine != "spmd":
+        raise click.UsageError(
+            "--ep needs the spmd engine (expert-parallel mesh axis); the "
+            "mpmd engine runs all experts locally"
+        )
+    if ep > 1 and not moe_experts:
+        raise click.UsageError("--ep without --moe-experts has no effect")
+    moe = None
+    if moe_experts:
+        from torchgpipe_tpu.models.moe import MoEConfig
+
+        moe = MoEConfig(
+            n_experts=moe_experts, top_k=moe_top_k,
+            ep_axis="ep" if ep > 1 else None,
+        )
     x = jnp.zeros((bsz, seq), jnp.int32)
 
     if engine == "spmd":
-        tput = _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, experiment)
+        tput = _run_spmd(
+            cfg, n, chunks, x, epochs, steps, checkpoint, experiment, moe, ep
+        )
     else:
-        layers = llama(cfg)
+        if moe is not None:
+            from torchgpipe_tpu.models.moe import llama_moe
+
+            layers = llama_moe(cfg, moe)
+        else:
+            layers = llama(cfg)
         model = GPipe(
             layers, even_balance(len(layers), n), chunks=chunks,
             checkpoint=checkpoint,
@@ -87,13 +117,14 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16, checkpoint
             model, x, x, causal_lm_loss,
             epochs=epochs, steps_per_epoch=steps, label=experiment,
         )
+    kind = f"moe{moe_experts}" if moe_experts else "dense"
     print(
-        f"FINAL | llama-speed {experiment} [{preset}, {engine}]: "
+        f"FINAL | llama-speed {experiment} [{preset}, {engine}, {kind}]: "
         f"{tput:.1f} samples/sec"
     )
 
 
-def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label):
+def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None, ep=1):
     from benchmarks.common import run_epoch_loop
     from torchgpipe_tpu.models.transformer import llama_spmd
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
@@ -105,11 +136,17 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label):
             "expressible in the scanned schedule; see torchgpipe_tpu.spmd)",
             flush=True,
         )
-    block, pre, post = llama_spmd(cfg, n)
-    mesh = make_mesh(n)
+    if moe is not None:
+        from torchgpipe_tpu.models.moe import llama_moe_spmd
+
+        block, pre, post = llama_moe_spmd(cfg, moe, n)
+    else:
+        block, pre, post = llama_spmd(cfg, n)
+    mesh = make_mesh(n, ep=ep)
     pipe = SpmdGPipe(
         block, n, mesh, chunks=chunks, loss_fn=cross_entropy,
         pre=pre, post=post, checkpoint=checkpoint,
+        ep_axis="ep" if ep > 1 else None,
     )
     # SpmdGPipe shards data over the mesh; the causal shift happens on the
     # host so inputs/targets ride the same sharding specs.

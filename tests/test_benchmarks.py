@@ -138,10 +138,27 @@ def test_llama_speed_driver_both_engines():
         "pipeline-2", "--preset", "tiny", "--epochs", "1", "--steps", "1",
         "--seq", "32", "--batch", "4", "--no-bf16",
     ])
-    assert "FINAL | llama-speed pipeline-2 [tiny, mpmd]" in out
+    assert "FINAL | llama-speed pipeline-2 [tiny, mpmd, dense]" in out
 
     out = _invoke(main, [
         "pipeline-2", "--preset", "tiny", "--engine", "spmd", "--epochs", "1",
         "--steps", "1", "--seq", "33", "--batch", "4", "--no-bf16",
     ])
-    assert "FINAL | llama-speed pipeline-2 [tiny, spmd]" in out
+    assert "FINAL | llama-speed pipeline-2 [tiny, spmd, dense]" in out
+
+
+def test_llama_speed_driver_moe():
+    from benchmarks.llama_speed import main
+
+    out = _invoke(main, [
+        "pipeline-2", "--preset", "tiny", "--epochs", "1", "--steps", "1",
+        "--seq", "32", "--batch", "4", "--no-bf16", "--moe-experts", "4",
+    ])
+    assert "FINAL | llama-speed pipeline-2 [tiny, mpmd, moe4]" in out
+
+    out = _invoke(main, [
+        "pipeline-2", "--preset", "tiny", "--engine", "spmd", "--epochs", "1",
+        "--steps", "1", "--seq", "33", "--batch", "8", "--no-bf16",
+        "--moe-experts", "4", "--ep", "2",
+    ])
+    assert "FINAL | llama-speed pipeline-2 [tiny, spmd, moe4]" in out

@@ -91,6 +91,26 @@ def test_moe_capacity_drops_tokens():
     np.testing.assert_allclose(y[1:], 0.0, atol=1e-7)
 
 
+def test_top1_router_receives_gradient():
+    """Switch-style k=1 keeps the raw softmax probability as the gate, so
+    router logits get real gradient (normalizing over one selection would
+    pin the gate to ~1.0 and freeze the router at init)."""
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=4, top_k=1, capacity_factor=8.0)
+    layer = moe_mlp(cfg, moe)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, cfg.dim))
+    params, _ = layer.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+
+    def loss(p):
+        y, _ = layer.apply(p, (), x)
+        return jnp.sum(y**2)
+
+    g = jax.grad(loss)(params)
+    assert float(jnp.abs(g["router"]).max()) > 1e-3
+
+
 def test_router_stats_balance():
     cfg = _cfg()
     moe = MoEConfig(n_experts=4, top_k=1)

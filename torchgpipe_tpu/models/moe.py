@@ -88,7 +88,10 @@ def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
         gates.append(jnp.sum(probs * mask, axis=-1))  # [t]
         masks.append(mask)
         remaining = remaining * (1.0 - mask)
-    denom = sum(gates) + 1e-9  # normalize over the k selections
+    # k>1: normalize combine weights over the k selections (GShard).  k=1
+    # keeps the raw softmax probability as the gate (Switch) — normalizing
+    # would pin it to ~1.0 and starve the router of gradient entirely.
+    denom = sum(gates) + 1e-9 if k > 1 else jnp.ones(())
 
     combine = jnp.zeros((t, E, capacity), probs.dtype)
     counts = jnp.zeros((E,), probs.dtype)
