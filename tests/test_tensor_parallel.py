@@ -18,6 +18,7 @@ from torchgpipe_tpu.models.transformer import (
     TransformerConfig,
     cross_entropy,
     llama_spmd,
+    vocab_parallel_cross_entropy,
 )
 from torchgpipe_tpu.parallel.tensor import psum_grad
 from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
@@ -153,6 +154,69 @@ def test_spmd_tp_with_dp(cpu_devices):
     ref_loss, ref_grads = _seq_oracle(_cfg(), pp, params, tokens, labels)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
     _assert_trees_close(grads, ref_grads)
+
+
+def test_spmd_tp_sharded_logits_loss(cpu_devices):
+    """gather_logits=False keeps logits vocab-sharded through the loss;
+    vocab_parallel_cross_entropy must reproduce the full-logits run exactly
+    (loss and all grads) — Megatron's parallel cross-entropy."""
+    pp, tp = 2, 2
+    tokens, labels = _data()
+    cfg = _cfg(tp_axis="tp")
+    mesh = make_mesh(pp, dp=1, tp=tp, devices=cpu_devices[: pp * tp])
+    in_spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+
+    runs = {}
+    for gather in (True, False):
+        block, pre, post = llama_spmd(cfg, pp, gather_logits=gather)
+        loss_fn = (
+            cross_entropy if gather else vocab_parallel_cross_entropy("tp")
+        )
+        pipe = SpmdGPipe(
+            block, pp, mesh, chunks=2, loss_fn=loss_fn,
+            pre=pre, post=post, tp_axis="tp",
+        )
+        params = pipe.init(jax.random.PRNGKey(0), in_spec)
+        runs[gather] = pipe.train_step(params, tokens, labels)
+
+    loss_g, grads_g = runs[True]
+    loss_s, grads_s = runs[False]
+    np.testing.assert_allclose(float(loss_s), float(loss_g), rtol=1e-5)
+    _assert_trees_close(grads_s, grads_g)
+
+
+def test_spmd_tp_sharded_head_inference_gathers(cpu_devices):
+    """apply() on a gather_logits=False model returns FULL logits (the
+    engine gathers the declared output sharding) — never one lane's shard."""
+    pp, tp = 2, 2
+    tokens, _ = _data()
+    cfg = _cfg(tp_axis="tp")
+    mesh = make_mesh(pp, dp=1, tp=tp, devices=cpu_devices[: pp * tp])
+    in_spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+
+    outs = {}
+    for gather in (True, False):
+        block, pre, post = llama_spmd(cfg, pp, gather_logits=gather)
+        pipe = SpmdGPipe(
+            block, pp, mesh, chunks=2,
+            loss_fn=cross_entropy if gather else vocab_parallel_cross_entropy("tp"),
+            pre=pre, post=post, tp_axis="tp",
+        )
+        params = pipe.init(jax.random.PRNGKey(0), in_spec)
+        outs[gather] = pipe.apply(params, tokens)
+
+    assert outs[False].shape == (*tokens.shape, cfg.vocab)
+    np.testing.assert_allclose(
+        np.asarray(outs[False]), np.asarray(outs[True]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_vocab_parallel_ce_outside_mesh_is_plain_ce():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 16))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 16)
+    a = vocab_parallel_cross_entropy("tp")(logits, labels)
+    b = cross_entropy(logits, labels)
+    np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
 
 
 def test_spmd_tp_with_sp(cpu_devices):

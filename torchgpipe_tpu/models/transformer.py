@@ -28,7 +28,12 @@ from jax.sharding import PartitionSpec as P
 from torchgpipe_tpu.layers import Layer, chain
 from torchgpipe_tpu.parallel import attention
 from torchgpipe_tpu.parallel.ring_attention import axis_bound
-from torchgpipe_tpu.parallel.tensor import psum_grad, psum_value
+from torchgpipe_tpu.parallel.tensor import (
+    all_gather_value,
+    pmax_stop,
+    psum_grad,
+    psum_value,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -273,20 +278,66 @@ def transformer_block(
     return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
+def _vocab_meta(cfg: TransformerConfig, table_spec):
+    """Shared meta for the vocab-parallel embedding/head: param sharding +
+    vocab divisibility validation."""
+    tp = cfg.tp_axis
+
+    def validate_mesh(mesh):
+        if tp is None or tp not in mesh.axis_names:
+            return
+        size = mesh.shape[tp]
+        if cfg.vocab % size != 0:
+            raise ValueError(
+                f"vocab={cfg.vocab} is not divisible by the tp mesh axis "
+                f"size {size}; the vocab-parallel embedding/head shard the "
+                "vocabulary dimension across tp lanes"
+            )
+
+    meta = {"tp_axis": tp, "validate_mesh": validate_mesh}
+    if tp is not None:
+        meta["param_specs"] = table_spec
+    return meta
+
+
 def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
+    """Token embedding; vocab-parallel over ``cfg.tp_axis`` when set (each
+    lane holds ``vocab/tp`` rows; out-of-shard tokens contribute zero and a
+    psum assembles the full embedding — Megatron's parallel embedding)."""
+
     def init(rng, in_spec):
         del in_spec
         return {"table": _normal(rng, (cfg.vocab, cfg.dim), 0.02, cfg.dtype)}, ()
 
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
-        return jnp.take(params["table"], x, axis=0), state
+        table = params["table"]
+        if axis_bound(cfg.tp_axis):
+            v_loc = table.shape[0]
+            lo = jax.lax.axis_index(cfg.tp_axis) * v_loc
+            local = x - lo
+            in_range = (local >= 0) & (local < v_loc)
+            rows = jnp.take(table, jnp.clip(local, 0, v_loc - 1), axis=0)
+            rows = jnp.where(in_range[..., None], rows, 0)
+            return psum_value(rows, cfg.tp_axis), state
+        return jnp.take(table, x, axis=0), state
 
-    return Layer(name=name, init=init, apply=apply)
+    tp = cfg.tp_axis
+    meta = _vocab_meta(cfg, {"table": P(tp)})
+    return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
-def lm_head(cfg: TransformerConfig, *, name: str = "head") -> Layer:
-    """Final RMSNorm + vocabulary projection."""
+def lm_head(
+    cfg: TransformerConfig, *, name: str = "head", gather_logits: bool = True
+) -> Layer:
+    """Final RMSNorm + vocabulary projection; vocab-parallel over
+    ``cfg.tp_axis`` when set (Megatron column-parallel output layer).
+
+    With ``gather_logits=True`` (default) the per-lane logit shards are
+    re-assembled into full ``[.., vocab]`` logits, so any loss works.  Pass
+    ``False`` to keep lane-local ``[.., vocab/tp]`` logits — 1/tp of the
+    logits memory — and pair with :func:`vocab_parallel_cross_entropy`.
+    """
 
     def init(rng, in_spec):
         del in_spec
@@ -298,9 +349,56 @@ def lm_head(cfg: TransformerConfig, *, name: str = "head") -> Layer:
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
         h = _rms(x, params["scale"], cfg.norm_eps)
+        if axis_bound(cfg.tp_axis):
+            h = psum_grad(h, cfg.tp_axis)  # region entry: full grad upstream
+            logits = h @ params["w"]  # local [.., vocab/tp]
+            if gather_logits:
+                logits = all_gather_value(logits, cfg.tp_axis, axis=-1)
+            return logits, state
         return h @ params["w"], state
 
-    return Layer(name=name, init=init, apply=apply)
+    tp = cfg.tp_axis
+    meta = _vocab_meta(cfg, {"scale": P(), "w": P(None, tp)})
+    if tp is not None and not gather_logits:
+        # Declares that this layer's output stays sharded over (axis, dim) —
+        # consumed by SpmdGPipe.apply, which gathers it so inference returns
+        # full logits instead of silently handing back one lane's shard.
+        meta["out_gather"] = (tp, -1)
+    return Layer(name=name, init=init, apply=apply, meta=meta)
+
+
+def vocab_parallel_cross_entropy(axis: Optional[str]):
+    """Cross-entropy over vocab-sharded logits (``lm_head(...,
+    gather_logits=False)``): full-vocabulary softmax without ever
+    materializing full logits — the log-sum-exp and target-logit terms are
+    assembled with tp collectives (Megatron's parallel cross-entropy).
+
+    Returns a ``loss_fn(local_logits, labels)`` for the engines.  Outside a
+    bound axis it degrades to the plain :func:`cross_entropy`.
+    """
+
+    def loss(logits, labels):
+        if not axis_bound(axis):
+            return cross_entropy(logits, labels)
+        v_loc = logits.shape[-1]
+        lo = jax.lax.axis_index(axis) * v_loc
+        logits = logits.astype(jnp.float32)
+        # Stable global log-sum-exp: lane max -> pmax (constant wrt grads —
+        # the max's gradient contribution cancels analytically).
+        m = pmax_stop(jnp.max(logits, axis=-1), axis)
+        se = psum_value(
+            jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), axis
+        )
+        z = jnp.log(se) + m
+        # Target logit lives on exactly one lane; zeros elsewhere, psum.
+        local = labels - lo
+        in_range = (local >= 0) & (local < v_loc)
+        idx = jnp.clip(local, 0, v_loc - 1)
+        tl = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
+        tl = psum_value(jnp.where(in_range, tl, 0.0), axis)
+        return jnp.mean(z - tl)
+
+    return loss
 
 
 def llama(cfg: TransformerConfig) -> List[Layer]:
@@ -314,10 +412,15 @@ def llama(cfg: TransformerConfig) -> List[Layer]:
 
 
 def llama_spmd(
-    cfg: TransformerConfig, n_stages: int
+    cfg: TransformerConfig, n_stages: int, *, gather_logits: bool = True
 ) -> Tuple[Layer, Layer, Layer]:
     """(block, pre, post) for the SPMD engine: each stage runs
-    ``n_layers // n_stages`` blocks."""
+    ``n_layers // n_stages`` blocks.
+
+    Under ``cfg.tp_axis`` the embedding and head are vocab-parallel; pass
+    ``gather_logits=False`` (with
+    ``loss_fn=vocab_parallel_cross_entropy(cfg.tp_axis)``) to keep logits
+    vocab-sharded through the loss — 1/tp of the logits memory."""
     if cfg.n_layers % n_stages != 0:
         raise ValueError(
             f"n_layers={cfg.n_layers} must divide evenly into {n_stages} stages"
@@ -326,7 +429,11 @@ def llama_spmd(
     block = chain(
         [transformer_block(cfg, name=f"b{i}") for i in range(per)], name="stage"
     )
-    return block, token_embedding(cfg), lm_head(cfg)
+    return (
+        block,
+        token_embedding(cfg),
+        lm_head(cfg, gather_logits=gather_logits),
+    )
 
 
 def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:

@@ -84,3 +84,58 @@ def _psum_value_bwd(axis_name, _, g):
 
 
 psum_value.defvjp(_psum_value_fwd, _psum_value_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def pmax_stop(x, axis_name: str):
+    """``pmax`` over ``axis_name`` with zero gradient.
+
+    For numerical-stability maxima (log-sum-exp shifts) whose analytic
+    gradient contribution cancels: ``lax.pmax`` has no differentiation rule,
+    and wrapping it in ``stop_gradient`` does not keep autodiff tracing from
+    reaching the primitive — this does.
+    """
+    return lax.pmax(x, axis_name)
+
+
+def _pmax_stop_fwd(x, axis_name):
+    return pmax_stop(x, axis_name), None
+
+
+def _pmax_stop_bwd(axis_name, _, g):
+    return (jax.tree_util.tree_map(lambda t: t * 0, g),)
+
+
+pmax_stop.defvjp(_pmax_stop_fwd, _pmax_stop_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def all_gather_value(x, axis_name: str, axis: int = -1):
+    """``all_gather`` shards along ``axis`` forward; *slice* backward.
+
+    Forward: every lane receives the full array (lane shards concatenated
+    along ``axis`` in lane order).  Backward: each lane keeps only its own
+    shard's slice of the (replicated) cotangent.  Like :func:`psum_value`
+    this pins the transpose for replicated-downstream use: JAX's default
+    all_gather transpose is a reduce-scatter, which sums the identical
+    per-lane cotangents and over-counts by the lane count.
+
+    Used by the vocab-parallel LM head to re-assemble full-vocabulary logits
+    (``lm_head(..., gather_logits=True)``).
+    """
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def _all_gather_value_fwd(x, axis_name, axis):
+    return all_gather_value(x, axis_name, axis), x.shape[axis % x.ndim]
+
+
+def _all_gather_value_bwd(axis_name, axis, local_size, g):
+    lane = lax.axis_index(axis_name)
+    ax = axis % g.ndim
+    return (
+        lax.dynamic_slice_in_dim(g, lane * local_size, local_size, ax),
+    )
+
+
+all_gather_value.defvjp(_all_gather_value_fwd, _all_gather_value_bwd)

@@ -47,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchgpipe_tpu import microbatch
 from torchgpipe_tpu.layers import Layer
+from torchgpipe_tpu.parallel.tensor import all_gather_value
 
 Pytree = Any
 
@@ -66,8 +67,12 @@ def _declared_axes(layer: Layer, key: str) -> list:
     return out
 
 
-def layer_param_specs(layer: Layer, stage_axis: str) -> Pytree:
-    """``PartitionSpec`` pytree *prefix* for a layer's (stage-stacked) params.
+def layer_param_specs(layer: Layer, stage_axis: Optional[str] = None) -> Pytree:
+    """``PartitionSpec`` pytree *prefix* for a layer's params.
+
+    ``stage_axis`` names the leading stacked-stage dim for pipeline blocks
+    (specs get it prepended); pass ``None`` for un-stacked layers (pre/post),
+    whose declared specs apply as-is.
 
     Layers declare sharded leaves via ``meta['param_specs']`` — a dict naming
     *every* param key with its per-stage spec (e.g. the tensor-parallel
@@ -81,7 +86,7 @@ def layer_param_specs(layer: Layer, stage_axis: str) -> Pytree:
     The result is valid as a shard_map in/out spec and broadcasts to
     per-leaf form via :func:`broadcast_specs`.
     """
-    repl = P(stage_axis)
+    repl = P(stage_axis) if stage_axis else P()
     meta = layer.meta
     if isinstance(meta, dict) and meta.get("kind") == "compound":
         children = meta["children"]
@@ -101,7 +106,7 @@ def layer_param_specs(layer: Layer, stage_axis: str) -> Pytree:
 
         def with_stage(s):
             if isinstance(s, P):
-                return P(stage_axis, *tuple(s))
+                return P(stage_axis, *tuple(s)) if stage_axis else s
             return {k: with_stage(v) for k, v in s.items()}
 
         return {k: with_stage(s) for k, s in declared.items()}
@@ -266,6 +271,14 @@ class SpmdGPipe:
         # any per-leaf sharding the layers declare (tensor/expert-parallel
         # weights) — see layer_param_specs.
         self._blocks_spec = layer_param_specs(self.block, self.pp_axis)
+        # Pre/post are replicated over pp but may declare their own leaf
+        # sharding (e.g. the vocab-parallel embedding/head under tp).
+        self._pre_spec = (
+            layer_param_specs(self.pre) if self.pre is not None else None
+        )
+        self._post_spec = (
+            layer_param_specs(self.post) if self.post is not None else None
+        )
         self._train_step_fns: dict = {}  # keyed by use_rng
         self._apply_fn = None
 
@@ -331,34 +344,38 @@ class SpmdGPipe:
 
         return params
 
-    def _blocks_leaf_specs(self, blocks: Pytree) -> Pytree:
+    def _leaf_specs(self, prefix: Pytree, tree: Pytree, what: str) -> Pytree:
         try:
-            return broadcast_specs(self._blocks_spec, blocks)
+            return broadcast_specs(prefix, tree)
         except ValueError as e:
             raise ValueError(
-                "block param structure does not match its declared "
+                f"{what} param structure does not match its declared "
                 "meta['param_specs'] (the dict must name every param key of "
                 f"the layer): {e}"
             ) from None
 
+    def _blocks_leaf_specs(self, blocks: Pytree) -> Pytree:
+        return self._leaf_specs(self._blocks_spec, blocks, "block")
+
     def place(self, params: dict) -> dict:
         """Commit params to the mesh: blocks stage-sharded over ``pp`` (plus
         any tensor/expert-parallel leaf sharding the layers declare),
-        pre/post replicated."""
-        repl = NamedSharding(self.mesh, P())
-        specs = self._blocks_leaf_specs(params["blocks"])
-        self._check_spec_shapes(params["blocks"], specs)
+        pre/post replicated over pp (with their own declared leaf sharding,
+        e.g. a vocab-parallel embedding table)."""
         out = dict(params)
-        out["blocks"] = jax.tree_util.tree_map(
-            lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
-            params["blocks"],
-            specs,
-        )
-        for k in ("pre", "post"):
-            if k in params:
-                out[k] = jax.tree_util.tree_map(
-                    lambda a: jax.device_put(a, repl), params[k]
-                )
+        trees = [("blocks", self._blocks_spec)]
+        if "pre" in params:
+            trees.append(("pre", self._pre_spec))
+        if "post" in params:
+            trees.append(("post", self._post_spec))
+        for k, prefix in trees:
+            specs = self._leaf_specs(prefix, params[k], k)
+            self._check_spec_shapes(params[k], specs)
+            out[k] = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
+                params[k],
+                specs,
+            )
         return out
 
     def _check_spec_shapes(self, blocks: Pytree, specs: Pytree) -> None:
@@ -592,9 +609,9 @@ class SpmdGPipe:
 
         param_specs = {"blocks": self._blocks_spec}
         if self.pre is not None:
-            param_specs["pre"] = P()
+            param_specs["pre"] = self._pre_spec
         if self.post is not None:
-            param_specs["post"] = P()
+            param_specs["post"] = self._post_spec
 
         if use_rng:
             in_specs = (param_specs, data_spec, data_spec, P())
@@ -657,6 +674,13 @@ class SpmdGPipe:
         n = self.n_stages
         data_spec = self._data_specs()
 
+        # A head built for sharded-logits training (lm_head with
+        # gather_logits=False) declares its output sharding; inference
+        # gathers it so apply() returns full logits, never one lane's shard.
+        out_gather = (
+            _declared_axes(self.post, "out_gather") if self.post else []
+        )
+
         def local(params, x_mb):
             stage = lax.axis_index(self.pp_axis)
             if self.pre is not None:
@@ -667,6 +691,8 @@ class SpmdGPipe:
                 outs = jax.vmap(
                     lambda mb: self.post.apply(params["post"], (), mb, rng=None, train=False)[0]
                 )(outs)
+                for axis, dim in out_gather:
+                    outs = all_gather_value(outs, axis, dim)
             # Only the last stage holds real outputs; broadcast over pp.
             masked = jax.tree_util.tree_map(
                 lambda a: jnp.where(stage == n - 1, a, jnp.zeros_like(a)), outs
@@ -677,9 +703,9 @@ class SpmdGPipe:
 
         param_specs = {"blocks": self._blocks_spec}
         if self.pre is not None:
-            param_specs["pre"] = P()
+            param_specs["pre"] = self._pre_spec
         if self.post is not None:
-            param_specs["post"] = P()
+            param_specs["post"] = self._post_spec
 
         mapped = _shard_map(
             local,
