@@ -37,7 +37,9 @@ def test_optax_adamw_preserves_shardings(cpu_devices):
     )
 
     opt = optax.adamw(3e-2)
-    opt_state = opt.init(params)
+    # place_tree: moments keep their param shardings; the step counter is
+    # committed replicated so the update jit sees one device set.
+    opt_state = pipe.place_tree(opt.init(params))
 
     # Adam moments must live where their params live (e.g. expert weights
     # stay ep-sharded, attention weights tp-sharded).

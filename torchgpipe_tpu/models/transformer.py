@@ -278,6 +278,16 @@ def transformer_block(
     return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
+def _local_vocab_ids(ids: jnp.ndarray, axis: str, v_loc: int):
+    """Map global token ids onto this lane's vocab shard: ``(idx, in_range)``
+    with ``idx`` clipped into ``[0, v_loc)`` and ``in_range`` marking ids the
+    lane actually owns.  Shared by the vocab-parallel embedding lookup and
+    cross-entropy target-logit gather so the masked arithmetic cannot drift."""
+    local = ids - jax.lax.axis_index(axis) * v_loc
+    in_range = (local >= 0) & (local < v_loc)
+    return jnp.clip(local, 0, v_loc - 1), in_range
+
+
 def _vocab_meta(cfg: TransformerConfig, table_spec):
     """Shared meta for the vocab-parallel embedding/head: param sharding +
     vocab divisibility validation."""
@@ -313,12 +323,10 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
         del rng, train
         table = params["table"]
         if axis_bound(cfg.tp_axis):
-            v_loc = table.shape[0]
-            lo = jax.lax.axis_index(cfg.tp_axis) * v_loc
-            local = x - lo
-            in_range = (local >= 0) & (local < v_loc)
-            rows = jnp.take(table, jnp.clip(local, 0, v_loc - 1), axis=0)
-            rows = jnp.where(in_range[..., None], rows, 0)
+            idx, in_range = _local_vocab_ids(x, cfg.tp_axis, table.shape[0])
+            rows = jnp.where(
+                in_range[..., None], jnp.take(table, idx, axis=0), 0
+            )
             return psum_value(rows, cfg.tp_axis), state
         return jnp.take(table, x, axis=0), state
 
@@ -380,8 +388,6 @@ def vocab_parallel_cross_entropy(axis: Optional[str]):
     def loss(logits, labels):
         if not axis_bound(axis):
             return cross_entropy(logits, labels)
-        v_loc = logits.shape[-1]
-        lo = jax.lax.axis_index(axis) * v_loc
         logits = logits.astype(jnp.float32)
         # Stable global log-sum-exp: lane max -> pmax (constant wrt grads —
         # the max's gradient contribution cancels analytically).
@@ -391,9 +397,7 @@ def vocab_parallel_cross_entropy(axis: Optional[str]):
         )
         z = jnp.log(se) + m
         # Target logit lives on exactly one lane; zeros elsewhere, psum.
-        local = labels - lo
-        in_range = (local >= 0) & (local < v_loc)
-        idx = jnp.clip(local, 0, v_loc - 1)
+        idx, in_range = _local_vocab_ids(labels, axis, logits.shape[-1])
         tl = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
         tl = psum_value(jnp.where(in_range, tl, 0.0), axis)
         return jnp.mean(z - tl)

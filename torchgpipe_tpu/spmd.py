@@ -378,6 +378,28 @@ class SpmdGPipe:
             )
         return out
 
+    def place_tree(self, tree: Pytree) -> Pytree:
+        """Commit an arbitrary training-state pytree to this engine's mesh.
+
+        Leaves already laid out on the mesh (params, optimizer moments
+        built by ``zeros_like``) keep their sharding; everything else —
+        optimizer step counters, EMA scalars, freshly created or
+        checkpoint-restored host arrays — is replicated.  Use this on
+        ``optimizer.init(params)`` output (and on
+        :func:`~torchgpipe_tpu.utils.serialization.restore_sharded`
+        templates) so one jitted update never mixes mesh-committed arrays
+        with single-device ones, which XLA rejects.
+        """
+        repl = NamedSharding(self.mesh, P())
+
+        def put(a):
+            sh = getattr(a, "sharding", None)
+            if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
+                return a
+            return jax.device_put(a, repl)
+
+        return jax.tree_util.tree_map(put, tree)
+
     def _check_spec_shapes(self, blocks: Pytree, specs: Pytree) -> None:
         """Every sharded dim must divide by its mesh-axis size — checked
         eagerly for a didactic error instead of a shard_map failure."""
