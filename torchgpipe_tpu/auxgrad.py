@@ -1,0 +1,47 @@
+"""Trace-time scale for injected auxiliary gradients.
+
+Layers that inject auxiliary-objective gradients via a custom-vjp identity
+(:func:`torchgpipe_tpu.models.moe.add_aux_grad`) run once *per micro-batch*,
+while the engines' task loss is reduced over the whole mini-batch — so a
+constant injection would multiply the auxiliary coefficient by the number of
+micro-batches.  The engines set this trace-time scale to ``1/m`` while
+tracing micro-batch cells; injection sites read it when captured into the
+trace (same trace-time discipline as the checkpoint phase flags,
+:mod:`torchgpipe_tpu.checkpoint`), making the optimized objective
+``task_loss + weight * mean_over_microbatches(aux)`` regardless of the
+chunk count.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator
+
+
+class _Scale(threading.local):
+    def __init__(self) -> None:
+        self.value = 1.0
+
+
+_scale = _Scale()
+
+
+def current_aux_scale():
+    """The scale an aux-gradient injection traced now should apply.
+
+    A python float, or a traced scalar when the weighting is data-dependent
+    (the SPMD engine zeroes fill/drain garbage cells at runtime).
+    """
+    return _scale.value
+
+
+@contextlib.contextmanager
+def aux_scale(value) -> Iterator[None]:
+    """Set the trace-time aux-gradient scale (used by the engines)."""
+    prev = _scale.value
+    _scale.value = value
+    try:
+        yield
+    finally:
+        _scale.value = prev

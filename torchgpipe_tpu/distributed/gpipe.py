@@ -204,17 +204,17 @@ class DistributedGPipe:
             rng_i = jax.random.fold_in(rng, i) if rng is not None else None
             if train and i < stop:
                 y, ext, new_state = stage.fwd_ckpt(
-                    params, cur_state, x, skips_in, rng_i
+                    params, cur_state, x, skips_in, rng_i, 1.0 / m
                 )
                 saved[i] = (x, skips_in, list(cur_state), rng_i)
             elif train:
                 y, ext, new_state, pull = stage.fwd_vjp(
-                    params, cur_state, x, skips_in, rng_i
+                    params, cur_state, x, skips_in, rng_i, 1.0 / m
                 )
                 pulls[i] = pull
             else:
                 y, ext, new_state = stage.fwd_eval(
-                    params, cur_state, x, skips_in, rng_i
+                    params, cur_state, x, skips_in, rng_i, 1.0 / m
                 )
             cur_state = list(new_state)
             for k, v in ext.items():
@@ -305,7 +305,8 @@ class DistributedGPipe:
                 x, skips_in, state_in, rng_i = ctx["saved"].pop(i)
                 # Recompute-ahead (reference: torchgpipe/checkpoint.py:1-19).
                 _, _, _, pull = stage.fwd_recompute(
-                    ctx["params"], state_in, x, skips_in, rng_i
+                    ctx["params"], state_in, x, skips_in, rng_i,
+                    1.0 / ctx["m"],
                 )
             else:
                 pull = ctx["pulls"].pop(i)

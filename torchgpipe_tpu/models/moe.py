@@ -62,12 +62,75 @@ class MoEConfig:
     ``capacity = ceil(capacity_factor * top_k * tokens / n_experts)`` per
     lane.  1.0 is an exactly-balanced budget; >1 tolerates imbalance; a
     large value (≥ n_experts/top_k) guarantees no token is ever dropped.
+
+    ``balance_weight`` > 0 trains the router against the Switch balance
+    penalty ``E * sum(load * importance)`` with that coefficient.  The
+    pipeline engines' loss is a pure function of the model output, so the
+    penalty's *gradient* is injected at the layer (:func:`add_aux_grad`):
+    optimization follows ``task_loss + balance_weight * aux`` exactly,
+    while the reported loss value stays the task loss (monitor the penalty
+    itself via :func:`router_stats`).
     """
 
     n_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
     ep_axis: Optional[str] = None
+    balance_weight: float = 0.0
+
+
+@jax.custom_vjp
+def _aux_inject(y, aux, scaled_weight):
+    del aux, scaled_weight
+    return y
+
+
+def _aux_inject_fwd(y, aux, scaled_weight):
+    # scaled_weight is a traced INPUT recorded at the primal call site, so
+    # the engine's aux scale is baked in no matter when the vjp rule is
+    # elaborated (custom_vjp traces fwd lazily, at linearization time —
+    # reading trace-time context here would see the default again).
+    return y, scaled_weight
+
+
+def _aux_inject_bwd(res, g):
+    return g, res, jnp.zeros_like(res)
+
+
+_aux_inject.defvjp(_aux_inject_fwd, _aux_inject_bwd)
+
+
+def add_aux_grad(y, aux, weight):
+    """Identity on ``y`` whose backward adds ``weight * aux_scale`` to
+    ``aux``'s cotangent (``aux_scale`` is the engines' trace-time
+    micro-batch weighting, :mod:`torchgpipe_tpu.auxgrad`, captured here at
+    the call site).
+
+    Differentiating a seed-1 loss ``L(y)`` through this yields the
+    gradients of ``L + weight * mean_over_microbatches(aux)`` without
+    threading an auxiliary scalar through the engine's loss plumbing.  The
+    mechanism behind ``MoEConfig.balance_weight``.  Note the injection is
+    relative to a unit cotangent seed (what the engines' ``value_and_grad``
+    uses); differentiating ``c * L`` scales task gradients by ``c`` but not
+    the injected term.
+    """
+    from torchgpipe_tpu.auxgrad import current_aux_scale
+
+    scaled = jnp.asarray(weight, jnp.float32) * current_aux_scale()
+    return _aux_inject(y, aux, scaled)
+
+
+def _balance_penalty(probs: jnp.ndarray, n_experts: int):
+    """Switch balance penalty from router probabilities ``[t, E]``:
+    ``(load, importance, E * sum(load * importance))`` — 1.0 iff perfectly
+    balanced.  Single source for both the training-time injection
+    (``balance_weight``) and the :func:`router_stats` monitoring metric."""
+    top1 = jax.nn.one_hot(
+        jnp.argmax(probs, axis=-1), n_experts, dtype=jnp.float32
+    )
+    load = jnp.mean(top1, axis=0)
+    importance = jnp.mean(probs, axis=0)
+    return load, importance, n_experts * jnp.sum(load * importance)
 
 
 def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
@@ -139,7 +202,7 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
         return params, ()
 
     def apply(params, state, x, *, rng=None, train=True):
-        del rng, train
+        del rng
         b, s, d = x.shape
         t = b * s
         xf = x.reshape(t, d)
@@ -174,7 +237,13 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
                 out, moe.ep_axis, split_axis=1, concat_axis=0, tiled=True
             )
         y = jnp.einsum("tec,ecd->td", combine.astype(out.dtype), out)
-        return y.reshape(b, s, d).astype(x.dtype), state
+        y = y.reshape(b, s, d).astype(x.dtype)
+        if moe.balance_weight > 0.0 and train:
+            # Switch balance penalty from this lane's tokens; gradient-only
+            # injection (see add_aux_grad / MoEConfig.balance_weight).
+            _, _, aux = _balance_penalty(probs, E)
+            y = add_aux_grad(y, aux, moe.balance_weight)
+        return y, state
 
     def validate_mesh(mesh):
         ax = moe.ep_axis
@@ -214,11 +283,7 @@ def router_stats(params_router: jnp.ndarray, x: jnp.ndarray, moe: MoEConfig):
     t = x.shape[0] * x.shape[1]
     logits = x.reshape(t, -1).astype(jnp.float32) @ params_router
     probs = jax.nn.softmax(logits, axis=-1)
-    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), moe.n_experts, dtype=jnp.float32)
-    load = jnp.mean(top1, axis=0)
-    importance = jnp.mean(probs, axis=0)
-    balance = moe.n_experts * jnp.sum(load * importance)
-    return load, importance, balance
+    return _balance_penalty(probs, moe.n_experts)
 
 
 def moe_transformer_block(

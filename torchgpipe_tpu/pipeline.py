@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from torchgpipe_tpu import checkpoint as ckpt
 from torchgpipe_tpu import microbatch
+from torchgpipe_tpu.auxgrad import aux_scale
 from torchgpipe_tpu.layers import Layer, apply_layer
 from torchgpipe_tpu.skip.layout import SkipLayout
 
@@ -115,9 +116,15 @@ class StageExec:
 
     @staticmethod
     def _jit_with_phase(fn, *, checkpointing: bool = False, recomputing: bool = False):
-        def wrapped(*args):
+        # aux_s: runtime weight for injected auxiliary gradients (MoE
+        # balance) in this cell — the engine passes the exact 1/m of the
+        # current run (micro-batch count may differ from `chunks` for
+        # ragged batches), so the injected penalty is always a true
+        # micro-batch mean (torchgpipe_tpu.auxgrad).
+        def wrapped(params, state, x, skips_in, rng, aux_s):
             with ckpt.phase(checkpointing=checkpointing, recomputing=recomputing):
-                return fn(*args)
+                with aux_scale(aux_s):
+                    return fn(params, state, x, skips_in, rng)
 
         return jax.jit(wrapped)
 
@@ -232,7 +239,9 @@ class Pipeline:
                 skips_in = {k: skip_vals.pop((i, k)) for k in stage.ext_pop_keys}
                 rng_i = jax.random.fold_in(rng, i) if rng is not None else None
                 fwd = stage.fwd_train if train else stage.fwd_eval
-                y, ext, new_state = fwd(params[j], cur_states[j], x, skips_in, rng_i)
+                y, ext, new_state = fwd(
+                    params[j], cur_states[j], x, skips_in, rng_i, 1.0 / m
+                )
                 if self.tracer is not None:
                     self.tracer.record("fwd", j, i, y)
                 cur_states[j] = new_state
@@ -286,12 +295,12 @@ class Pipeline:
                 state_in = cur_states[j]
                 if checkpointed:
                     y, ext, new_state = stage.fwd_ckpt(
-                        params[j], state_in, x, skips_in, rng_i
+                        params[j], state_in, x, skips_in, rng_i, 1.0 / m
                     )
                     saved[(i, j)] = (x, skips_in, state_in, rng_i)
                 else:
                     y, ext, new_state, pull = stage.fwd_vjp(
-                        params[j], state_in, x, skips_in, rng_i
+                        params[j], state_in, x, skips_in, rng_i, 1.0 / m
                     )
                     pulls[(i, j)] = pull
                 if self.tracer is not None:
@@ -324,7 +333,7 @@ class Pipeline:
                     # Recompute-ahead: rebuild the vjp before consuming the
                     # cotangent (reference checkpoint.py:1-19).
                     _, _, _, pull = stage.fwd_recompute(
-                        params[j], state_in, x, skips_in, rng_i
+                        params[j], state_in, x, skips_in, rng_i, 1.0 / m
                     )
                 else:
                     pull = pulls.pop((i, j))
@@ -417,12 +426,12 @@ class Pipeline:
             state_in = cur_states[j]
             if i < checkpoint_stop:
                 y, ext, new_state = stage.fwd_ckpt(
-                    params[j], state_in, x, skips_in, rng_i
+                    params[j], state_in, x, skips_in, rng_i, 1.0 / m
                 )
                 saved[(i, j)] = (x, skips_in, state_in, rng_i)
             else:
                 y, ext, new_state, pull = stage.fwd_vjp(
-                    params[j], state_in, x, skips_in, rng_i
+                    params[j], state_in, x, skips_in, rng_i, 1.0 / m
                 )
                 pulls[(i, j)] = pull
             if self.tracer is not None:
@@ -448,7 +457,7 @@ class Pipeline:
             if (i, j) in saved:
                 x, skips_in, state_in, rng_i = saved.pop((i, j))
                 _, _, _, pull = stage.fwd_recompute(
-                    params[j], state_in, x, skips_in, rng_i
+                    params[j], state_in, x, skips_in, rng_i, 1.0 / m
                 )
             else:
                 pull = pulls.pop((i, j))
@@ -660,9 +669,12 @@ class Pipeline:
                 return lambda p, s, x, sk, key: fn(p, s, x, sk, key, train)
 
             def fwd(params, states, mbatches, rng=None):
-                outs, cur_states = self._fused_forward_loop(
-                    cell_of, m, params, states, mbatches, rng
-                )
+                # Same per-cell aux weighting as every other forward path
+                # (a user may differentiate through this jit directly).
+                with aux_scale(1.0 / m):
+                    outs, cur_states = self._fused_forward_loop(
+                        cell_of, m, params, states, mbatches, rng
+                    )
                 return outs, tuple(cur_states)
 
             return fwd
@@ -682,9 +694,12 @@ class Pipeline:
 
         def step(params, states, mbatches, target, rng=None):
             def loss_of(params):
-                outs, cur_states = self._fused_forward_loop(
-                    lambda i, j: cells[i][j], m, params, states, mbatches, rng
-                )
+                # Exact per-trace micro-batch count (the fused jit cache is
+                # keyed by per-micro-batch shapes, so m is safe to bake).
+                with aux_scale(1.0 / m):
+                    outs, cur_states = self._fused_forward_loop(
+                        lambda i, j: cells[i][j], m, params, states, mbatches, rng
+                    )
                 out = microbatch.gather(outs)
                 res = loss_fn(out, target)
                 if isinstance(res, tuple):

@@ -46,6 +46,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchgpipe_tpu import microbatch
+from torchgpipe_tpu.auxgrad import aux_scale
 from torchgpipe_tpu.layers import Layer
 from torchgpipe_tpu.parallel.tensor import all_gather_value
 
@@ -463,7 +464,17 @@ class SpmdGPipe:
                 if rng is not None
                 else None
             )
-            y = self._block_fn(params_local, x_in, key, train)
+            # This lane's cell at tick t is micro-batch t - stage; fill and
+            # drain ticks compute masked-out garbage, so injected auxiliary
+            # gradients (MoE balance) get a runtime scale of 1/m on valid
+            # cells and 0 on garbage ones — the scanned schedule then
+            # injects exactly mean-over-microbatches like the MPMD engine.
+            mb = t - stage
+            valid_scale = jnp.where(
+                (mb >= 0) & (mb < m), 1.0 / m, 0.0
+            )
+            with aux_scale(valid_scale):
+                y = self._block_fn(params_local, x_in, key, train)
             return y, y
 
         _, ys = lax.scan(tick, act0, jnp.arange(T))
@@ -514,8 +525,17 @@ class SpmdGPipe:
             stage = lax.axis_index(self.pp_axis)
 
             def loss_of(params):
+                # pre runs once per (real) micro-batch on EVERY pp lane but
+                # only stage 0's output is consumed; the injection is
+                # seed-independent and pre grads are psum'd over pp, so the
+                # aux scale must be stage-masked (1/m on stage 0, 0
+                # elsewhere) to keep the injected coefficient exact.  The
+                # pipeline's own cells handle their tick-validity-aware
+                # scale inside _local_pipeline.
                 if self.pre is not None:
-                    x_in = self._apply_pre(params["pre"], x_mb, rng, True)
+                    pre_scale = jnp.where(stage == 0, 1.0 / self.chunks, 0.0)
+                    with aux_scale(pre_scale):
+                        x_in = self._apply_pre(params["pre"], x_mb, rng, True)
                 else:
                     x_in = x_mb
                 ys = self._local_pipeline(params["blocks"], x_in, rng, True)
@@ -563,9 +583,12 @@ class SpmdGPipe:
                         tgt,
                     )
                     if self.post is not None:
-                        my, _ = self.post.apply(
-                            params["post"], (), my, rng=post_rng, train=True
-                        )
+                        # Every stage runs the head on 1/n of the batch:
+                        # aux injections average over the n slices.
+                        with aux_scale(1.0 / n):
+                            my, _ = self.post.apply(
+                                params["post"], (), my, rng=post_rng, train=True
+                            )
                     l = self.loss_fn(my, tgt_my)
                     if self.loss_reduction == "mean":
                         l = l / n
@@ -573,9 +596,13 @@ class SpmdGPipe:
                     # reassembles the global loss for reporting.
                     return l
                 if self.post is not None:
-                    gathered, _ = self.post.apply(
-                        params["post"], (), gathered, rng=post_rng, train=True
-                    )
+                    # post runs on every pp lane but only the last stage's
+                    # activations are real (and its grads are psum'd over
+                    # pp): stage-mask the aux scale like pre.
+                    with aux_scale(jnp.where(stage == n - 1, 1.0, 0.0)):
+                        gathered, _ = self.post.apply(
+                            params["post"], (), gathered, rng=post_rng, train=True
+                        )
                 l = self.loss_fn(gathered, tgt)
                 # LOCAL loss, nonzero only on the last stage.  Do NOT psum
                 # here: differentiating a replicated (psum'd) output would

@@ -136,7 +136,7 @@ def load(path: str) -> Dict[str, np.ndarray]:
 # --------------------------------------------------------------------- #
 
 
-def save_sharded(path: str, tree: Pytree) -> None:
+def save_sharded(path: str, tree: Pytree, *, overwrite: bool = True) -> None:
     """Persist an arbitrary pytree of (possibly sharded) jax arrays with
     orbax — params, optimizer state, step counters, all in one tree.
 
@@ -145,11 +145,15 @@ def save_sharded(path: str, tree: Pytree) -> None:
     device shards; on multi-host deployments each host writes only the
     shards it owns.  The MPMD :func:`state_dict`/:func:`save` path remains
     for reference-style flat ``.npz`` persistence.
+
+    ``overwrite=True`` (default, matching :func:`save`'s npz semantics)
+    replaces an existing checkpoint at ``path`` — the periodic
+    save-to-fixed-path loop; pass ``False`` to refuse clobbering.
     """
     import orbax.checkpoint as ocp
 
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(_abs(path), tree)
+        ckptr.save(_abs(path), tree, force=overwrite)
 
 
 def restore_sharded(path: str, template: Pytree) -> Pytree:
