@@ -89,3 +89,59 @@ def test_bf16_inputs():
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         rtol=5e-2, atol=5e-2,
     )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("gqa", [False, True])
+def test_streaming_forward_matches_dense(causal, gqa):
+    """Third-grid-dimension variant (K/V tiles stream, scratch-carried
+    online softmax) must be exact too."""
+    b, s, h, d = 2, 64, 4, 16
+    g = 2 if gqa else h
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = _rand(ks[0], (b, s, h, d))
+    k = _rand(ks[1], (b, s, g, d))
+    v = _rand(ks[2], (b, s, g, d))
+    ref = full_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16,
+                          interpret=True, streaming=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_streaming_grads_match_dense(causal):
+    b, s, h, d = 1, 32, 2, 8
+    g = 1
+    ks = jax.random.split(jax.random.PRNGKey(6), 4)
+    q = _rand(ks[0], (b, s, h, d))
+    k = _rand(ks[1], (b, s, g, d))
+    v = _rand(ks[2], (b, s, g, d))
+    cot = _rand(ks[3], (b, s, h, d))
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8,
+                            interpret=True, streaming=True)
+        return jnp.sum(o * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) * cot)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_streaming_uneven_blocks_and_long_kv():
+    b, sq, sk, h, d = 1, 32, 64, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = _rand(ks[0], (b, sq, h, d))
+    k = _rand(ks[1], (b, sk, h, d))
+    v = _rand(ks[2], (b, sk, h, d))
+    ref = full_attention(q, k, v, causal=False)
+    out = flash_attention(q, k, v, causal=False, block_q=16, block_k=32,
+                          interpret=True, streaming=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from torchgpipe_tpu.auxgrad import current_aux_scale
 from torchgpipe_tpu.layers import Layer, chain
 from torchgpipe_tpu.models.transformer import (
     TransformerConfig,
@@ -114,8 +115,6 @@ def add_aux_grad(y, aux, weight):
     uses); differentiating ``c * L`` scales task gradients by ``c`` but not
     the injected term.
     """
-    from torchgpipe_tpu.auxgrad import current_aux_scale
-
     scaled = jnp.asarray(weight, jnp.float32) * current_aux_scale()
     return _aux_inject(y, aux, scaled)
 

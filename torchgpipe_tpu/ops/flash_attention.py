@@ -33,8 +33,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
+
+# K/V rows resident in VMEM per program beyond roughly this many bytes tip
+# the kernels into the streaming (third-grid-dimension) variants, which keep
+# only one [block, d] tile of K/V in VMEM at a time.
+_STREAM_BYTES = 4 * 1024 * 1024
 
 
 def _kv_index(i, h: int, g: int):
@@ -120,6 +126,193 @@ def _flash_fwd_call(q, k, v, h, g, causal, sm_scale, block_q, block_k,
         interpret=interpret,
     )(q, k, v)
     return o, lse
+
+
+# --------------------------------------------------------------------- #
+# streaming variants: K/V (or Q) tiles stream from HBM on a third grid  #
+# dimension, with the online-softmax state carried in VMEM scratch —    #
+# per-program VMEM is O(block·d) regardless of sequence length, which   #
+# is what very long single-chip sequences (≳32k) need.  The TPU grid    #
+# iterates its trailing dimension sequentially, so scratch accumulates  #
+# correctly across the K/V steps of one (row, q-block) cell.            #
+# --------------------------------------------------------------------- #
+
+
+def _causal_overlap(jq, jk, block_q, block_k):
+    """Whether q block jq has any unmasked position against k block jk."""
+    return (jq + 1) * block_q - 1 >= jk * block_k
+
+
+def _mask_causal(s, jq, jk, block_q, block_k):
+    qpos = jq * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = jk * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(qpos >= kpos, s, _NEG)
+
+
+def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
+                       acc_sc, *, causal, sm_scale, block_q, block_k, nk):
+    j = pl.program_id(1)
+    jk = pl.program_id(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    run = _causal_overlap(j, jk, block_q, block_k) if causal else jk >= 0
+
+    @pl.when(run)
+    def _body():
+        qb = q_ref[0].astype(jnp.float32) * sm_scale
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            s = _mask_causal(s, j, jk, block_q, block_k)
+        m_prev = m_sc[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_sc[...] = l_sc[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_sc[...] = acc_sc[...] * corr + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[...] = m_new
+
+    @pl.when(jk == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_sc[...] / l_sc[...]).astype(o_ref.dtype)
+        lse_ref[0] = m_sc[...] + jnp.log(l_sc[...])
+
+
+def _flash_fwd_call_stream(q, k, v, h, g, causal, sm_scale, block_q,
+                           block_k, interpret):
+    bh, s, d = q.shape
+    sk = k.shape[1]
+    nk = sk // block_k
+    grid = (bh, s // block_q, nk)
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_stream_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, nk=nk,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, jk: (i, j, 0)),
+            pl.BlockSpec(
+                (1, block_k, d), lambda i, j, jk: (_kv_index(i, h, g), jk, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda i, j, jk: (_kv_index(i, h, g), jk, 0)
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j, jk: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, jk: (i, j, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_sc, *, causal, sm_scale, block_q, block_k,
+                      nk):
+    j = pl.program_id(1)
+    jk = pl.program_id(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    run = _causal_overlap(j, jk, block_q, block_k) if causal else jk >= 0
+
+    @pl.when(run)
+    def _body():
+        qb = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        dob = do_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            s = _mask_causal(s, j, jk, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])
+        dp = lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        dq_sc[...] = dq_sc[...] + lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(jk == nk - 1)
+    def _finish():
+        dq_ref[0] = (dq_sc[...] * sm_scale).astype(dq_ref.dtype)
+
+
+def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_sc, dv_sc, *, causal, sm_scale,
+                       block_q, block_k, nq):
+    jk = pl.program_id(1)
+    jq = pl.program_id(2)
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    run = _causal_overlap(jq, jk, block_q, block_k) if causal else jq >= 0
+
+    @pl.when(run)
+    def _body():
+        qb = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        dob = do_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            s = _mask_causal(s, jq, jk, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])
+        dv_sc[...] = dv_sc[...] + lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        dk_sc[...] = dk_sc[...] + lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(jq == nq - 1)
+    def _finish():
+        dk_ref[0] = (dk_sc[...] * sm_scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
 # --------------------------------------------------------------------- #
@@ -228,22 +421,100 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # --------------------------------------------------------------------- #
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, h, g, causal, sm_scale, blocks, interpret):
-    o, _ = _flash_fwd_call(
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, h, g, causal, sm_scale, blocks, interpret, streaming):
+    fwd = _flash_fwd_call_stream if streaming else _flash_fwd_call
+    o, _ = fwd(
         q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret
     )
     return o
 
 
-def _flash_vjp_fwd(q, k, v, h, g, causal, sm_scale, blocks, interpret):
-    o, lse = _flash_fwd_call(
+def _flash_vjp_fwd(q, k, v, h, g, causal, sm_scale, blocks, interpret,
+                   streaming):
+    fwd = _flash_fwd_call_stream if streaming else _flash_fwd_call
+    o, lse = fwd(
         q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret
     )
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(h, g, causal, sm_scale, blocks, interpret, res, do):
+def _flash_vjp_bwd(h, g, causal, sm_scale, blocks, interpret, streaming,
+                   res, do):
+    if streaming:
+        return _flash_bwd_stream(
+            h, g, causal, sm_scale, blocks, interpret, res, do
+        )
+    return _flash_bwd_resident(
+        h, g, causal, sm_scale, blocks, interpret, res, do
+    )
+
+
+def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do):
+    q, k, v, o, lse = res
+    block_q, block_k = blocks
+    bh, s, d = q.shape
+    bg = k.shape[0]
+    sk = k.shape[1]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )
+
+    kernel_args = (q, k, v, do, lse, delta)
+    row3 = pl.BlockSpec((1, block_q, d), lambda i, j, jk: (i, j, 0))
+    row2 = pl.BlockSpec((1, block_q, 1), lambda i, j, jk: (i, j, 0))
+    kv3 = pl.BlockSpec(
+        (1, block_k, d), lambda i, j, jk: (_kv_index(i, h, g), jk, 0)
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_stream_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, nk=sk // block_k,
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bh, s // block_q, sk // block_k),
+        in_specs=[row3, kv3, kv3, row3, row2, row2],
+        out_specs=row3,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(*kernel_args)
+
+    # dK/dV per QUERY head (expanded), summed over the group afterwards;
+    # grid streams Q blocks on the trailing dimension.
+    qrow3 = pl.BlockSpec((1, block_q, d), lambda i, jk, jq: (i, jq, 0))
+    qrow2 = pl.BlockSpec((1, block_q, 1), lambda i, jk, jq: (i, jq, 0))
+    kvb = pl.BlockSpec(
+        (1, block_k, d), lambda i, jk, jq: (_kv_index(i, h, g), jk, 0)
+    )
+    out_kvb = pl.BlockSpec((1, block_k, d), lambda i, jk, jq: (i, jk, 0))
+    dk_exp, dv_exp = pl.pallas_call(
+        functools.partial(
+            _dkv_stream_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, nq=s // block_q,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+        ),
+        grid=(bh, sk // block_k, s // block_q),
+        in_specs=[qrow3, kvb, kvb, qrow3, qrow2, qrow2],
+        out_specs=(out_kvb, out_kvb),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*kernel_args)
+
+    r = h // g
+    b = bh // h
+    dk = dk_exp.reshape(b, g, r, sk, d).sum(axis=2).reshape(bg, sk, d)
+    dv = dv_exp.reshape(b, g, r, sk, d).sum(axis=2).reshape(bg, sk, d)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_bwd_resident(h, g, causal, sm_scale, blocks, interpret, res, do):
     q, k, v, o, lse = res
     block_q, block_k = blocks
     bh, s, d = q.shape
@@ -335,21 +606,36 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    streaming: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Fused flash attention.  ``q``: ``[b, s, h, d]``; ``k, v``:
     ``[b, s_k, g, d]`` with ``g`` dividing ``h`` (GQA).  Returns
     ``[b, s, h, d]`` in ``q.dtype``.  Requires ``d % 128 == 0`` and
     sequence lengths divisible by the block sizes (see :func:`supports`);
     ``interpret=True`` runs the kernels on any backend for testing.
+
+    ``streaming`` selects the third-grid-dimension kernel variants whose
+    per-program VMEM is O(block·d) — K/V (and, in the dK/dV kernel, Q/dO)
+    tiles stream from HBM instead of residing whole — enabling very long
+    single-chip sequences.  ``None`` picks automatically from the K/V row
+    footprint; the resident variants stay preferred at moderate lengths
+    (they skip fully-masked causal blocks instead of visiting the full
+    rectangular grid).
     """
     b, s, h, d = q.shape
     g = k.shape[2]
     sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+    if streaming is None:
+        # K+V rows of one head resident in the non-streaming kernels, in
+        # the input dtype (the per-block f32 cast is transient).
+        streaming = (
+            2 * k.shape[1] * d * jnp.dtype(k.dtype).itemsize > _STREAM_BYTES
+        )
     qr = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * h, s, d)
     kr = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * g, k.shape[1], d)
     vr = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * g, v.shape[1], d)
     o = _flash(
         qr, kr, vr, h, g, causal, sm_scale,
-        (min(block_q, s), min(block_k, k.shape[1])), interpret,
+        (min(block_q, s), min(block_k, k.shape[1])), interpret, streaming,
     )
     return jnp.transpose(o.reshape(b, h, s, d), (0, 2, 1, 3))

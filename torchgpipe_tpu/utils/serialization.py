@@ -148,12 +148,28 @@ def save_sharded(path: str, tree: Pytree, *, overwrite: bool = True) -> None:
 
     ``overwrite=True`` (default, matching :func:`save`'s npz semantics)
     replaces an existing checkpoint at ``path`` — the periodic
-    save-to-fixed-path loop; pass ``False`` to refuse clobbering.
+    save-to-fixed-path loop — by writing the new checkpoint to a sibling
+    temp directory FIRST and swapping afterwards, so a crash mid-save never
+    destroys the previous copy (at worst it leaves it under
+    ``<path>.old``).  Pass ``False`` to refuse clobbering.
     """
+    import shutil
+
     import orbax.checkpoint as ocp
 
+    final = _abs(path)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(_abs(path), tree, force=overwrite)
+        if overwrite and os.path.exists(final):
+            tmp, old = final + ".tmp", final + ".old"
+            shutil.rmtree(tmp, ignore_errors=True)
+            ckptr.save(tmp, tree)
+            ckptr.wait_until_finished()
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(final, old)
+            os.rename(tmp, final)
+            shutil.rmtree(old)
+        else:
+            ckptr.save(final, tree)
 
 
 def restore_sharded(path: str, template: Pytree) -> Pytree:
