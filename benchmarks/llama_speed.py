@@ -71,15 +71,19 @@ def causal_lm_loss(out, tokens):
 @click.option("--moe-top-k", default=2)
 @click.option("--ep", default=1,
               help="expert-parallel mesh axis size (spmd engine; needs "
-                   "n_stages*ep devices)")
+                   "n_stages*ep*tp devices)")
+@click.option("--tp", default=1,
+              help="tensor-parallel mesh axis size (spmd engine; needs "
+                   "n_stages*ep*tp devices)")
 def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
-         checkpoint, moe_experts, moe_top_k, ep):
+         checkpoint, moe_experts, moe_top_k, ep, tp):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     dim, n_layers, n_heads, n_kv, vocab = PRESETS[preset]
     cfg = TransformerConfig(
         vocab=vocab, dim=dim, n_layers=n_layers, n_heads=n_heads,
         n_kv_heads=n_kv, dtype=jnp.bfloat16 if bf16 else jnp.float32,
+        tp_axis="tp" if tp > 1 else None,
     )
     if ep > 1 and engine != "spmd":
         raise click.UsageError(
@@ -88,6 +92,10 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
         )
     if ep > 1 and not moe_experts:
         raise click.UsageError("--ep without --moe-experts has no effect")
+    if tp > 1 and engine != "spmd":
+        raise click.UsageError(
+            "--tp needs the spmd engine (tensor-parallel mesh axis)"
+        )
     moe = None
     if moe_experts:
         from torchgpipe_tpu.models.moe import MoEConfig
@@ -100,7 +108,8 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
 
     if engine == "spmd":
         tput = _run_spmd(
-            cfg, n, chunks, x, epochs, steps, checkpoint, experiment, moe, ep
+            cfg, n, chunks, x, epochs, steps, checkpoint, experiment, moe,
+            ep, tp,
         )
     else:
         if moe is not None:
@@ -124,7 +133,8 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
     )
 
 
-def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None, ep=1):
+def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
+              ep=1, tp=1):
     from benchmarks.common import run_epoch_loop
     from torchgpipe_tpu.models.transformer import llama_spmd
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
@@ -142,11 +152,12 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None, ep=
         block, pre, post = llama_moe_spmd(cfg, moe, n)
     else:
         block, pre, post = llama_spmd(cfg, n)
-    mesh = make_mesh(n, ep=ep)
+    mesh = make_mesh(n, ep=ep, tp=tp)
     pipe = SpmdGPipe(
         block, n, mesh, chunks=chunks, loss_fn=cross_entropy,
         pre=pre, post=post, checkpoint=checkpoint,
         ep_axis="ep" if ep > 1 else None,
+        tp_axis="tp" if tp > 1 else None,
     )
     # SpmdGPipe shards data over the mesh; the causal shift happens on the
     # host so inputs/targets ride the same sharding specs.

@@ -162,3 +162,14 @@ def test_llama_speed_driver_moe():
         "--moe-experts", "4", "--ep", "2",
     ])
     assert "FINAL | llama-speed pipeline-2 [tiny, spmd, moe4]" in out
+
+
+def test_llama_speed_driver_tp():
+    from benchmarks.llama_speed import main
+
+    out = _invoke(main, [
+        "pipeline-2", "--preset", "tiny", "--engine", "spmd", "--epochs", "1",
+        "--steps", "1", "--seq", "33", "--batch", "4", "--no-bf16",
+        "--tp", "2",
+    ])
+    assert "FINAL | llama-speed pipeline-2 [tiny, spmd, dense]" in out
