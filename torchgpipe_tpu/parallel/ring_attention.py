@@ -101,6 +101,7 @@ def ring_attention(
     *,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    kv_block_size: int = 2048,
 ) -> jnp.ndarray:
     """Exact attention over sequence shards on the ``axis_name`` ring.
 
@@ -108,6 +109,16 @@ def ring_attention(
     ``axis_name`` is bound; ``q, k, v`` are the local shards
     ``[b, s_local, h, d]`` of a global ``[b, s, h, d]``, all shards equal
     size.  Returns the local output shard.
+
+    Each ring step is itself *blockwise* (the "blockwise transformers" half
+    of Liu et al.): the arriving K/V shard is consumed in sub-blocks of at
+    most ``kv_block_size`` through the same online-softmax recurrence (each
+    sub-step ``jax.checkpoint``-ed, so the backward recomputes one
+    sub-block at a time too), keeping transient AND residual score buffers
+    at ``[b, h, s_local, sub]`` instead of ``[b, h, s_local, s_local]`` —
+    large per-device shards (tens of k tokens) stay memory-feasible.  The
+    sub count is the smallest divisor split of the shard with sub-blocks ≤
+    ``kv_block_size`` (exact for any shard length).
     """
     b, sq, h, d = q.shape
     sm_scale = d ** -0.5 if sm_scale is None else sm_scale
@@ -116,22 +127,50 @@ def ring_attention(
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
     qpos = rank * sq + jnp.arange(sq)
+    n_sub = 1
+    if sq > kv_block_size:
+        n_sub = -(-sq // kv_block_size)  # ceil
+        while sq % n_sub != 0:  # nearest even split (worst case n_sub=sq)
+            n_sub += 1
+    sub = sq // n_sub
 
-    def block_update(o, l, m, kc, vc, i):
-        """One streaming-softmax accumulation against the K/V block that
-        originated on rank - i (equal shard sizes give its positions)."""
-        src = (rank - i) % sp
-        s = _scores(q, kc, sm_scale)  # [b, h, sq, sk] f32
+    def sub_update(o, l, m, kc, vc, kpos0):
+        """Online-softmax accumulation of one K/V sub-block whose global
+        positions start at ``kpos0``."""
+        s = _scores(q, kc, sm_scale)  # [b, h, sq, sub] f32
         if causal:
-            kpos = src * sq + jnp.arange(sq)
+            kpos = kpos0 + jnp.arange(kc.shape[1])
             mask = qpos[:, None] >= kpos[None, :]
             s = jnp.where(mask[None, None], s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None])  # [b, h, sq, sk]
+        p = jnp.exp(s - m_new[..., None])
         corr = jnp.exp(m - m_new)  # [b, h, sq]
         l_new = l * corr + jnp.sum(p, axis=-1)
         o_new = o * corr[..., None] + _weighted_v(p.astype(vc.dtype), vc)
         return o_new, l_new, m_new
+
+    def block_update(o, l, m, kc, vc, i):
+        """One ring step: accumulate the K/V shard that originated on
+        rank - i (equal shard sizes give its positions), sub-block by
+        sub-block."""
+        src = (rank - i) % sp
+        if n_sub == 1:
+            return sub_update(o, l, m, kc, vc, src * sq)
+
+        def body(carry, jb):
+            o, l, m = carry
+            ks = lax.dynamic_slice_in_dim(kc, jb * sub, sub, 1)
+            vs = lax.dynamic_slice_in_dim(vc, jb * sub, sub, 1)
+            # checkpoint: without it the scan's backward would stack one
+            # [b, h, sq, sub] softmax residual per sub-step — re-assembling
+            # the full score matrix this sub-blocking exists to avoid.
+            o, l, m = jax.checkpoint(sub_update)(
+                o, l, m, ks, vs, src * sq + jb * sub
+            )
+            return (o, l, m), ()
+
+        (o, l, m), _ = lax.scan(body, (o, l, m), jnp.arange(n_sub))
+        return o, l, m
 
     def step(carry, i):
         o, l, m, kc, vc = carry
@@ -183,6 +222,7 @@ def attention(
     axis_name: Optional[str] = None,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    kv_block_size: int = 2048,
 ) -> jnp.ndarray:
     """Dispatch: ring attention when a sequence-parallel axis is bound; on
     TPU the Pallas flash-attention kernel when shapes meet its tiling
@@ -212,5 +252,6 @@ def attention(
             )
         return dense(q, k, v)
     return ring_attention(
-        q, k, v, axis_name, causal=causal, sm_scale=sm_scale
+        q, k, v, axis_name, causal=causal, sm_scale=sm_scale,
+        kv_block_size=kv_block_size,
     )
