@@ -304,10 +304,16 @@ def llama_moe(cfg: TransformerConfig, moe: MoEConfig) -> List[Layer]:
 
 
 def llama_moe_spmd(
-    cfg: TransformerConfig, moe: MoEConfig, n_stages: int
+    cfg: TransformerConfig, moe: MoEConfig, n_stages: int,
+    *, gather_logits: bool = True
 ) -> Tuple[Layer, Layer, Layer]:
     """(block, pre, post) for the SPMD engine: each stage runs
-    ``n_layers // n_stages`` MoE blocks."""
+    ``n_layers // n_stages`` MoE blocks.
+
+    ``gather_logits`` as in :func:`~torchgpipe_tpu.models.transformer.llama_spmd`:
+    pass ``False`` under ``cfg.tp_axis`` (with
+    ``loss_fn=vocab_parallel_cross_entropy(cfg.tp_axis)``) for 1/tp logits
+    memory."""
     if cfg.n_layers % n_stages != 0:
         raise ValueError(
             f"n_layers={cfg.n_layers} must divide evenly into {n_stages} stages"
@@ -317,4 +323,8 @@ def llama_moe_spmd(
         [moe_transformer_block(cfg, moe, name=f"b{i}") for i in range(per)],
         name="stage",
     )
-    return block, token_embedding(cfg), lm_head(cfg)
+    return (
+        block,
+        token_embedding(cfg),
+        lm_head(cfg, gather_logits=gather_logits),
+    )
