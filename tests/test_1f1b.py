@@ -118,3 +118,26 @@ def test_1f1b_rejects_fused_and_nonbatched_target():
 def test_loss_reduction_requires_1f1b():
     with pytest.raises(ValueError, match="loss_reduction only applies"):
         GPipe(_layers(), balance=[4, 3, 2], chunks=2, loss_reduction="mean")
+
+
+def test_1f1b_interleaved_virtual_stages():
+    """1F1B with more stages than devices (stage wrap-around placement):
+    transparency with fill-drain must hold on the looped topology too."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 8, 8, 3))
+    y = jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 5)
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    devices = jax.devices()[:2]
+    kw = dict(balance=[3, 2, 2, 2], chunks=4, devices=devices)
+
+    ref = GPipe(_layers(), **kw)
+    p, s = ref.init(jax.random.PRNGKey(2), spec)
+    key = jax.random.PRNGKey(3)
+    l_ref, g_ref, _, _ = ref.value_and_grad(p, s, x, y, _mean_loss, rng=key)
+
+    ofo = GPipe(_layers(), schedule="1f1b", loss_reduction="mean", **kw)
+    assert [d.id for d in ofo.devices] == [0, 1, 0, 1]
+    l_1f, g_1f, _, _ = ofo.value_and_grad(p, s, x, y, _mean_loss, rng=key)
+
+    np.testing.assert_allclose(float(l_1f), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g_1f), jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
