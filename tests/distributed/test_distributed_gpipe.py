@@ -266,3 +266,21 @@ def test_forward_backward_api_misuse():
         ranks[1].forward(ranks[1]._params, ranks[1]._state, x)
     with pytest.raises(RuntimeError, match="only meaningful on the last rank"):
         ranks[0].loss_grads([x], x, _loss)
+
+
+def test_recv_timeout_detects_dead_peer():
+    """A rank whose upstream never sends fails fast with a TimeoutError
+    naming the missing channel, instead of hanging forever (the reference's
+    RPC mode has no failure handling — SURVEY.md §5)."""
+    layers = _mlp()
+    transport = LocalTransport()
+    box = transport.register(WORKERS[1])
+    rank1 = DistributedGPipe(
+        layers, 1, WORKERS[:3], [2, 2, 1], chunks=2,
+        transport=transport, mailbox=box, recv_timeout=0.3,
+    )
+    params, state = rank1.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    )
+    with pytest.raises(TimeoutError, match="meta|forward"):
+        rank1.forward(params, state)  # rank 0 never starts

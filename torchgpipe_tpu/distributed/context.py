@@ -150,10 +150,12 @@ class TcpTransport:
         addresses: Dict[str, Tuple[str, int]],
         *,
         connect_timeout: float = 120.0,
+        send_timeout: Optional[float] = 600.0,
     ) -> None:
         self.name = name
         self.addresses = dict(addresses)
         self.connect_timeout = connect_timeout
+        self.send_timeout = send_timeout
         self.mailbox = Mailbox(name)
         host, port = self.addresses[name]
         self._server = socketserver.ThreadingTCPServer(
@@ -202,10 +204,21 @@ class TcpTransport:
                     ) from err
                 time.sleep(0.5)
         with sock:
-            # The connect timeout must not govern the transfer itself: large
-            # activation blobs to a busy peer may legitimately take longer.
-            sock.settimeout(None)
-            sock.sendall(struct.pack("!Q", len(blob)) + blob)
+            # The connect timeout must not govern the transfer itself (large
+            # activation blobs to a busy peer legitimately take longer), but
+            # the transfer still needs its own generous bound: a wedged peer
+            # whose listener stops READING would otherwise block sendall
+            # forever once the TCP buffer fills — the one hang recv_timeout
+            # cannot see.
+            sock.settimeout(self.send_timeout)
+            try:
+                sock.sendall(struct.pack("!Q", len(blob)) + blob)
+            except socket.timeout:
+                raise TimeoutError(
+                    f"worker {self.name!r}: send of {len(blob)} bytes to "
+                    f"{dst!r} stalled for {self.send_timeout}s — is that "
+                    "rank still consuming?"
+                ) from None
 
     def close(self) -> None:
         self._server.shutdown()

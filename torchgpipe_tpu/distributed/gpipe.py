@@ -62,7 +62,18 @@ class DistributedGPipe:
         device=None,
         checkpoint: str = "except_last",
         deferred_batch_norm: bool = False,
+        recv_timeout: Optional[float] = None,
     ) -> None:
+        # recv_timeout (opt-in) bounds every cross-rank receive: a dead or
+        # wedged peer surfaces as a TimeoutError naming the missing channel
+        # instead of hanging the pipeline forever (the reference's RPC mode
+        # has no failure handling at all — torchgpipe/distributed/
+        # context.py:37 TODO).  Leave None (default) when stage compile
+        # times are unknown — the FIRST receive also waits out the
+        # upstream rank's one-time jit compilation.  A TimeoutError is
+        # fatal for this rank's pipeline state: channels may hold stale
+        # messages and peers hold partial sends — recover by restarting
+        # the worker processes, not by retrying the step.
         layers = list(layers)
         verify_module(layers)
         verify_skippables(layers)
@@ -90,6 +101,7 @@ class DistributedGPipe:
         self.checkpoint = checkpoint
         self.transport = transport
         self.mailbox = mailbox
+        self.recv_timeout = recv_timeout
 
         partitions = split_layers(layers, balance)
         self.layout = inspect_skip_layout(partitions)
@@ -181,7 +193,7 @@ class DistributedGPipe:
             if batch is not None:
                 raise ValueError("only rank 0 feeds the input batch")
             mbatches = None
-            m = int(self.mailbox.get("meta", 0))
+            m = int(self.mailbox.get("meta", 0, timeout=self.recv_timeout))
 
         stop = checkpoint_stop(self.checkpoint, m, train=train)
         stage = self.stage
@@ -195,10 +207,14 @@ class DistributedGPipe:
                 x = mbatches[i]
             else:
                 x = jax.device_put(
-                    self.mailbox.get("forward", i), self.device
+                    self.mailbox.get("forward", i, timeout=self.recv_timeout),
+                    self.device
                 )
             skips_in = {
-                k: jax.device_put(self.mailbox.get(("skip", k), i), self.device)
+                k: jax.device_put(
+                    self.mailbox.get(("skip", k), i, timeout=self.recv_timeout),
+                    self.device,
+                )
                 for k in stage.ext_pop_keys
             }
             rng_i = jax.random.fold_in(rng, i) if rng is not None else None
@@ -294,10 +310,16 @@ class DistributedGPipe:
             if self.is_last:
                 gy = grad_outputs[i]
             else:
-                gy = jax.device_put(self.mailbox.get("backward", i), self.device)
+                gy = jax.device_put(
+                    self.mailbox.get("backward", i, timeout=self.recv_timeout),
+                    self.device,
+                )
             gext = {
                 k: jax.device_put(
-                    self.mailbox.get(("skip_grad", k), i), self.device
+                    self.mailbox.get(
+                        ("skip_grad", k), i, timeout=self.recv_timeout
+                    ),
+                    self.device
                 )
                 for k in stage.ext_stash_keys
             }
@@ -340,12 +362,14 @@ class DistributedGPipeDataLoader:
         transport,
         mailbox,
         num_batches: Optional[int] = None,
+        recv_timeout: Optional[float] = None,
     ) -> None:
         self.loader = loader
         self.rank = rank
         self.workers = list(workers)
         self.transport = transport
         self.mailbox = mailbox
+        self.recv_timeout = recv_timeout
         if loader is None and num_batches is None:
             raise ValueError("ranks without a loader need num_batches")
         self.num_batches = num_batches if num_batches is not None else len(loader)
@@ -368,7 +392,9 @@ class DistributedGPipeDataLoader:
                     yield data, target
         elif self.rank == last:
             for step in range(self.num_batches):
-                target = self.mailbox.get("target", step)
+                target = self.mailbox.get(
+                    "target", step, timeout=self.recv_timeout
+                )
                 yield None, target
         else:
             for _ in range(self.num_batches):
