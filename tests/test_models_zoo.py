@@ -187,3 +187,23 @@ def test_amoebanet_checkpoint_never_three_stages():
     layers = amoebanetd(num_classes=10, num_layers=3, num_filters=16)
     x = jax.random.normal(jax.random.PRNGKey(7), (4, 32, 32, 3))
     _check_transparency(layers, x, n_stages=3, chunks=2, checkpoint="never")
+
+
+def test_vgg_transparency():
+    from torchgpipe_tpu.models import vgg16
+
+    layers = vgg16(num_classes=10, base_width=4, head_width=32)
+    x = jax.random.normal(jax.random.PRNGKey(8), (4, 32, 32, 3))
+    # VGG has dropout in the head: rng-dependent, same folding as oracle.
+    _check_transparency(layers, x, n_stages=4, chunks=2)
+
+
+def test_vgg_depths_and_validation():
+    from torchgpipe_tpu.models import build_vgg
+
+    import pytest as _pytest
+    assert len(build_vgg(19, 10, 4, head_width=16)) > len(
+        build_vgg(16, 10, 4, head_width=16)
+    )
+    with _pytest.raises(ValueError, match="depth"):
+        build_vgg(13, 10, 4)

@@ -150,7 +150,7 @@ class TcpTransport:
         addresses: Dict[str, Tuple[str, int]],
         *,
         connect_timeout: float = 120.0,
-        send_timeout: Optional[float] = 600.0,
+        send_timeout: Optional[float] = None,
     ) -> None:
         self.name = name
         self.addresses = dict(addresses)
@@ -193,7 +193,10 @@ class TcpTransport:
                 sock = socket.create_connection((host, port), timeout=30)
                 break
             except (ConnectionRefusedError, ConnectionResetError,
-                    ConnectionAbortedError) as err:
+                    ConnectionAbortedError, socket.timeout) as err:
+                # socket.timeout (== TimeoutError) covers peers whose SYNs
+                # are dropped (host still booting, lossy link) rather than
+                # refused — equally transient during rendezvous.
                 # Only genuinely transient rendezvous failures are retried;
                 # misconfiguration (bad hostname etc.) raises immediately.
                 if time.monotonic() >= deadline:
@@ -204,12 +207,13 @@ class TcpTransport:
                     ) from err
                 time.sleep(0.5)
         with sock:
-            # The connect timeout must not govern the transfer itself (large
-            # activation blobs to a busy peer legitimately take longer), but
-            # the transfer still needs its own generous bound: a wedged peer
-            # whose listener stops READING would otherwise block sendall
-            # forever once the TCP buffer fills — the one hang recv_timeout
-            # cannot see.
+            # The connect timeout must not govern the transfer itself
+            # (large activation blobs to a busy peer legitimately take
+            # longer).  send_timeout (opt-in, like recv_timeout) bounds the
+            # whole transfer: a wedged peer whose listener stops READING
+            # would otherwise block sendall forever once the TCP buffer
+            # fills — the one hang recv_timeout cannot see.  Size it for
+            # your largest blob over your slowest link.
             sock.settimeout(self.send_timeout)
             try:
                 sock.sendall(struct.pack("!Q", len(blob)) + blob)

@@ -131,6 +131,13 @@ class DistributedGPipe:
     def is_last(self) -> bool:
         return self.rank == len(self.workers) - 1
 
+    def _recv(self, kind, index):
+        """Deadline-bounded mailbox receive placed on this rank's device."""
+        return jax.device_put(
+            self.mailbox.get(kind, index, timeout=self.recv_timeout),
+            self.device,
+        )
+
     def init(
         self, rng: jax.Array, in_spec: Pytree
     ) -> Tuple[List[Pytree], List[Pytree]]:
@@ -206,16 +213,9 @@ class DistributedGPipe:
             if self.is_first:
                 x = mbatches[i]
             else:
-                x = jax.device_put(
-                    self.mailbox.get("forward", i, timeout=self.recv_timeout),
-                    self.device
-                )
+                x = self._recv("forward", i)
             skips_in = {
-                k: jax.device_put(
-                    self.mailbox.get(("skip", k), i, timeout=self.recv_timeout),
-                    self.device,
-                )
-                for k in stage.ext_pop_keys
+                k: self._recv(("skip", k), i) for k in stage.ext_pop_keys
             }
             rng_i = jax.random.fold_in(rng, i) if rng is not None else None
             if train and i < stop:
@@ -310,17 +310,9 @@ class DistributedGPipe:
             if self.is_last:
                 gy = grad_outputs[i]
             else:
-                gy = jax.device_put(
-                    self.mailbox.get("backward", i, timeout=self.recv_timeout),
-                    self.device,
-                )
+                gy = self._recv("backward", i)
             gext = {
-                k: jax.device_put(
-                    self.mailbox.get(
-                        ("skip_grad", k), i, timeout=self.recv_timeout
-                    ),
-                    self.device
-                )
+                k: self._recv(("skip_grad", k), i)
                 for k in stage.ext_stash_keys
             }
             if i in ctx["saved"]:

@@ -1,0 +1,66 @@
+"""Sequential VGG (Simonyan & Zisserman) for the pipeline engines.
+
+Counterpart of the reference's distributed-accuracy VGG-16
+(reference: benchmarks/distributed/accuracy/vgg/__init__.py — the fork's
+second model next to sequential ResNet-101): a plain conv stack is already
+sequential, so unlike ResNet/U-Net no skip machinery is needed and the model
+partitions at any layer boundary.  NHWC layout, MXU-friendly 3x3 convs;
+``base_width`` scales the whole net down for tests/small chips.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from torchgpipe_tpu.layers import Layer, named
+from torchgpipe_tpu.ops import nn
+
+# Configuration D (VGG-16) / E (VGG-19): channel multiplier per conv, 'M' =
+# 2x2 max pool.
+_CFGS = {
+    16: [1, 1, "M", 2, 2, "M", 4, 4, 4, "M", 8, 8, 8, "M", 8, 8, 8, "M"],
+    19: [1, 1, "M", 2, 2, "M", 4, 4, 4, 4, "M", 8, 8, 8, 8, "M",
+         8, 8, 8, 8, "M"],
+}
+
+
+def build_vgg(
+    depth: int = 16,
+    num_classes: int = 1000,
+    base_width: int = 64,
+    *,
+    batch_norm: bool = True,
+    head_width: int = 4096,
+    dropout: float = 0.5,
+) -> List[Layer]:
+    """Flat sequential VGG-``depth`` layer list (depth 16 or 19)."""
+    if depth not in _CFGS:
+        raise ValueError(f"depth must be one of {sorted(_CFGS)}: {depth}")
+    layers: List[Layer] = []
+    for item in _CFGS[depth]:
+        if item == "M":
+            layers.append(nn.max_pool2d((2, 2), strides=(2, 2), name="pool"))
+            continue
+        layers.append(
+            nn.conv2d(base_width * item, (3, 3), padding="SAME", name="conv")
+        )
+        if batch_norm:
+            layers.append(nn.batch_norm(name="bn"))
+        layers.append(nn.relu())
+    layers.append(nn.flatten())
+    layers.append(nn.dense(head_width, name="fc1"))
+    layers.append(nn.relu())
+    layers.append(nn.dropout(dropout))
+    layers.append(nn.dense(head_width, name="fc2"))
+    layers.append(nn.relu())
+    layers.append(nn.dropout(dropout))
+    layers.append(nn.dense(num_classes, name="head"))
+    return named(layers)
+
+
+def vgg16(num_classes: int = 1000, **kwargs) -> List[Layer]:
+    return build_vgg(16, num_classes, **kwargs)
+
+
+def vgg19(num_classes: int = 1000, **kwargs) -> List[Layer]:
+    return build_vgg(19, num_classes, **kwargs)
