@@ -442,7 +442,6 @@ def test_moe_training_soak_stays_finite():
     import optax
 
     from torchgpipe_tpu import GPipe
-    from torchgpipe_tpu.models.moe import llama_moe
 
     cfg = _cfg()
     moe = MoEConfig(

@@ -66,6 +66,40 @@ def test_spmd_transparency(cpu_devices, checkpoint):
     )
 
 
+def test_spmd_remat_policy_transparency(cpu_devices):
+    """A custom remat policy changes what is saved, never the math."""
+    n, dim = 4, 8
+    mesh = make_mesh(n, 1, devices=cpu_devices)
+    block = make_block(dim)
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=4, loss_fn=mse, checkpoint="always",
+        remat_policy=jax.checkpoint_policies.dots_saveable,
+    )
+    params = pipe.init(jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, dim), jnp.float32))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, dim))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (16, dim))
+    loss, grads = pipe.train_step(params, x, tgt)
+    ref_loss, ref_grads = seq_oracle(block, params, x, tgt, n)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        grads["blocks"],
+        ref_grads,
+    )
+
+
+def test_spmd_remat_policy_requires_always(cpu_devices):
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    with pytest.raises(ValueError, match="remat_policy"):
+        SpmdGPipe(
+            make_block(8), 2, mesh, chunks=2, loss_fn=mse,
+            checkpoint="never",
+            remat_policy=jax.checkpoint_policies.dots_saveable,
+        )
+
+
 def test_spmd_with_dp(cpu_devices):
     n, dp, dim = 4, 2, 8
     mesh = make_mesh(n, dp, devices=cpu_devices)

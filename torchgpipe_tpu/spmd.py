@@ -163,6 +163,10 @@ class SpmdGPipe:
         ``pp``; their gradients are psum-shared.
       checkpoint: 'always' (remat the block per cell — GPipe memory profile)
         or 'never'.
+      remat_policy: optional ``jax.checkpoint`` policy refining
+        ``checkpoint='always'`` (e.g.
+        ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable`` keeps
+        matmul outputs and recomputes only cheap elementwise ops).
       loss_reduction: 'mean' (default) or 'sum' declares that ``post`` and
         ``loss_fn`` decompose over batch elements with that reduction,
         letting the engine shard the head + loss over the ``pp`` axis (1/n
@@ -178,6 +182,11 @@ class SpmdGPipe:
     pre: Optional[Layer] = None
     post: Optional[Layer] = None
     checkpoint: str = "always"
+    # Optional jax.checkpoint policy for checkpoint='always' (e.g.
+    # jax.checkpoint_policies.dots_with_no_batch_dims_saveable keeps matmul
+    # outputs and recomputes only cheap elementwise ops — less recompute for
+    # a bit more memory).  None = save nothing but the scan carries.
+    remat_policy: Optional[Callable] = None
     pp_axis: str = "pp"
     dp_axis: Optional[str] = None
     sp_axis: Optional[str] = None
@@ -266,7 +275,13 @@ class SpmdGPipe:
             return y
 
         if self.checkpoint == "always":
-            block_fn = jax.checkpoint(block_fn, static_argnums=(3,))
+            block_fn = jax.checkpoint(
+                block_fn, static_argnums=(3,), policy=self.remat_policy
+            )
+        elif self.remat_policy is not None:
+            raise ValueError(
+                "remat_policy only applies with checkpoint='always'"
+            )
         self._block_fn = block_fn
         # Spec prefix for the stacked block params: stage dim over pp, plus
         # any per-leaf sharding the layers declare (tensor/expert-parallel
