@@ -80,3 +80,37 @@ def test_blockpartition_is_contiguous_cover(costs, data):
         if len(right) > 1:
             alt = parts[:k] + [left + [right[0]], right[1:]] + parts[k + 2:]
             assert max(sum(p) for p in alt) >= best
+
+
+def test_moe_dispatch_invariants():
+    """Property sweep of the MoE dispatch tensors: combine weights are
+    nonnegative, per-token totals never exceed 1 (equal 1 when no slot
+    overflows), each (expert, slot) holds at most one token, and no expert
+    exceeds its capacity."""
+    import itertools
+
+    from torchgpipe_tpu.models.moe import _top_k_dispatch
+
+    rng = jax.random.PRNGKey(0)
+    for t, E, k, cap in itertools.product(
+        (4, 13), (2, 5), (1, 2), (1, 3, 64)
+    ):
+        if k > E:
+            continue
+        rng, sub = jax.random.split(rng)
+        probs = jax.nn.softmax(jax.random.normal(sub, (t, E)), -1)
+        combine, dispatch = _top_k_dispatch(probs, k, cap)
+        c = np.asarray(combine)
+        d = np.asarray(dispatch)
+        assert c.shape == (t, E, cap)
+        assert (c >= 0).all()
+        tot = c.sum(axis=(1, 2))
+        assert (tot <= 1 + 1e-5).all()
+        if cap >= t * k:  # no overflow possible
+            np.testing.assert_allclose(tot, 1.0, rtol=1e-5)
+        # One token per (expert, slot) at most.
+        assert (d.sum(axis=0) <= 1).all()
+        # Capacity respected per expert.
+        assert (d.sum(axis=(0, 2)) <= cap).all()
+        # dispatch is exactly the support of combine.
+        assert ((c > 0) == d).all()
