@@ -296,3 +296,33 @@ def test_spmd_tp_rejects_indivisible_heads(cpu_devices):
             block, pp, mesh, chunks=2, loss_fn=cross_entropy,
             pre=pre, post=post, tp_axis="tp",
         )
+
+
+def test_vocab_parallel_ce_extreme_logits_stable(cpu_devices):
+    """The tp-collective log-sum-exp must stay finite and shift-invariant
+    under large-magnitude logits (the pmax shift doing its job)."""
+    mesh = Mesh(np.array(cpu_devices[:4]), ("tp",))
+    V, v_loc = 32, 8
+    logits = jax.random.normal(jax.random.PRNGKey(0), (2, 4, V)) * 3.0
+    labels = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, V)
+    loss_fn = vocab_parallel_cross_entropy("tp")
+
+    def run(shift):
+        local = jax.shard_map(
+            lambda lg, lb: loss_fn(lg, lb),
+            mesh=mesh,
+            in_specs=(P(None, None, "tp"), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return float(jax.jit(local)(logits + shift, labels))
+
+    base = run(0.0)
+    big = run(5e4)
+    from torchgpipe_tpu.models.transformer import cross_entropy as ce
+    want = float(ce(logits, labels))
+    np.testing.assert_allclose(base, want, rtol=1e-5)
+    assert np.isfinite(big)
+    # f32 representation of (logits + 5e4) quantizes at ~3e-3 per entry —
+    # the comparison tolerance reflects the input encoding, not the CE.
+    np.testing.assert_allclose(big, want, rtol=1e-3)
