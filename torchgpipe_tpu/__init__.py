@@ -13,11 +13,14 @@ Public API (reference: torchgpipe/__init__.py:1-6 exports ``GPipe``,
 from torchgpipe_tpu.checkpoint import is_checkpointing, is_recomputing
 from torchgpipe_tpu.gpipe import GPipe
 from torchgpipe_tpu.layers import Layer, stateless
+from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
 
 __version__ = "0.1.0"
 
 __all__ = [
     "GPipe",
+    "SpmdGPipe",
+    "make_mesh",
     "Layer",
     "stateless",
     "is_checkpointing",
