@@ -173,3 +173,30 @@ def test_llama_speed_driver_tp():
         "--tp", "2",
     ])
     assert "FINAL | llama-speed pipeline-2 [tiny, spmd, dense]" in out
+
+
+def test_bench_entry_cpu_smoke():
+    """bench.py (the driver's metric entry point) runs end to end on CPU and
+    emits exactly one well-formed JSON line."""
+    import json
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env.update(
+        PYTHONPATH=str(repo),
+        JAX_PLATFORMS="cpu",
+        TGPU_SKIP_BACKEND_PROBE="1",
+        TF_CPP_MIN_LOG_LEVEL="3",
+    )
+    r = subprocess.run(
+        [sys.executable, str(repo / "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=str(repo),
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["unit"] == "samples/sec/chip"
+    assert rec["value"] > 0
+    assert "cpu" in rec["metric"]
+    assert rec["vs_baseline"] is None  # per-chip baseline is TPU-only
