@@ -33,6 +33,57 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 # Reference per-chip throughput: AmoebaNet-D (18,256), n=8 m=32, 8x P40.
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 132.413 / 8
 
+# Published bf16 peak FLOP/s per chip, keyed by device_kind substring
+# (checked in order, so the more specific names come first).
+_PEAK_BF16_FLOPS = (
+    ("v5 lite", 197e12),  # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6e", 918e12),  # Trillium
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def _chip_peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in _PEAK_BF16_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def _analytic_step_flops(model, params, state, x, y, loss_fn, rng):
+    """Model FLOPs per training step (fwd + loss + bwd, no recompute) from
+    XLA's HLO cost analysis of the equivalent UN-pipelined step.
+
+    MFU convention: the numerator is the model's analytic work, so activation
+    recomputation inside the pipeline counts against utilization rather than
+    inflating it.  ``lower()`` only traces — no compile."""
+    from torchgpipe_tpu.layers import sequential_apply
+
+    flat_p = [p for stage in params for p in stage]
+    flat_s = [s for stage in state for s in stage]
+
+    def step(fp, x, y):
+        def loss_of(fp):
+            out, _ = sequential_apply(
+                model.layers, fp, flat_s, x, rng=rng, train=True
+            )
+            return loss_fn(out, y)
+
+        return jax.value_and_grad(loss_of)(fp)
+
+    try:
+        cost = jax.jit(step).lower(flat_p, x, y).cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
 
 def _even_balance(n_layers: int, n_stages: int):
     base = n_layers // n_stages
@@ -58,9 +109,13 @@ def _build_amoebanet(platform: str, n_stages: int):
         compute_dtype = None
     layers = amoebanetd(num_classes=1000, num_layers=num_layers,
                         num_filters=num_filters)
+    # fused=False pinned: per-cell async dispatch measured 2x faster than
+    # whole-step fusion on the remote chip (65.9 vs 32.4 samples/s, and the
+    # monolithic program compiled 18 minutes — BENCH_NOTES.md finding #1).
+    # Without the pin _use_fused() would auto-select fused on a single chip.
     model = GPipe(layers, balance=_even_balance(len(layers), n_stages),
                   chunks=chunks, checkpoint="except_last",
-                  compute_dtype=compute_dtype)
+                  compute_dtype=compute_dtype, fused=False)
     x = jnp.zeros((batch, image, image, 3), jnp.float32)
     y = jnp.zeros((batch,), jnp.int32)
     name = f"amoebanetd-({num_layers},{num_filters})-pipeline{n_stages}"
@@ -80,8 +135,9 @@ def _build_transformer(platform: str, n_stages: int):
                                 n_heads=4, n_kv_heads=2)
         batch, seq, chunks = 4, 64, 2
     layers = llama(cfg)
+    # fused=False: same rationale as _build_amoebanet (BENCH_NOTES finding #1).
     model = GPipe(layers, balance=_even_balance(len(layers), n_stages),
-                  chunks=chunks, checkpoint="always")
+                  chunks=chunks, checkpoint="always", fused=False)
     x = jnp.zeros((batch, seq), jnp.int32)
     y = jnp.zeros((batch, seq), jnp.int32)
     name = f"llama-{cfg.dim}d{cfg.n_layers}L-pipeline{n_stages}"
@@ -182,11 +238,21 @@ def main() -> None:
         if platform != "cpu"
         else None
     )
+    # MFU: analytic model FLOPs per step / measured step time / chip peak.
+    mfu = None
+    peak = _chip_peak_flops(devices[0])
+    if peak is not None:
+        step_flops = _analytic_step_flops(
+            model, params, state, x, y, loss_fn, rng
+        )
+        if step_flops is not None:
+            mfu = round(step_flops * n_iters / dt / (n_chips * peak), 4)
     print(json.dumps({
         "metric": f"train samples/sec/chip [{tag}]",
         "value": round(samples_per_sec, 3),
         "unit": "samples/sec/chip",
         "vs_baseline": vs,
+        "mfu": mfu,
     }))
 
 

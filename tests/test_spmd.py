@@ -41,7 +41,7 @@ def seq_oracle(block, params, x, tgt, n_stages):
     return jax.value_and_grad(loss_of)(params["blocks"])
 
 
-@pytest.mark.parametrize("checkpoint", ["always", "never"])
+@pytest.mark.parametrize("checkpoint", ["always", "except_last", "never"])
 def test_spmd_transparency(cpu_devices, checkpoint):
     n, dim = 4, 8
     mesh = make_mesh(n, 1, devices=cpu_devices)

@@ -121,6 +121,45 @@ def test_spmd_program_structure():
     assert _count_eqns(jaxpr_nr.jaxpr, REMAT) == 0
 
 
+def test_spmd_except_last_program_structure(cpu_devices):
+    """'except_last' peels the schedule: a remat'd scan over the first m-1
+    ticks plus n unrolled stage-conditional ticks (one lax.cond each, whose
+    taken branch for the owning stage is the UN-remat'd block).  The program
+    must contain the conds and still carry remat regions for the non-last
+    cells — and 'always' must contain no cond at all."""
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+    from torchgpipe_tpu.layers import chain
+    from torchgpipe_tpu.ops import dense, gelu, layer_norm
+
+    n, m, dim = 4, 3, 8
+    mesh = make_mesh(n, 1, devices=cpu_devices[:n])
+    block = chain([layer_norm(name="ln"), dense(dim, name="fc"), gelu("act")],
+                  name="block")
+
+    def mse(out, tgt):
+        return jnp.mean((out - tgt) ** 2)
+
+    def jaxpr_of(mode):
+        pipe = SpmdGPipe(block, n, mesh, chunks=m, loss_fn=mse,
+                         checkpoint=mode, dp_axis="dp")
+        params = pipe.init(jax.random.PRNGKey(0),
+                           jax.ShapeDtypeStruct((2, dim), jnp.float32))
+        fn = pipe._build_train_step(use_rng=False)
+        x_mb = microbatch.scatter_stacked(jnp.zeros((2 * m, dim)), m)
+        return jax.make_jaxpr(lambda p, a, b: fn(p, a, b))(params, x_mb, x_mb)
+
+    jx_el = jaxpr_of("except_last")
+    jx_al = jaxpr_of("always")
+    n_cond_el = _count_eqns(jx_el.jaxpr, ("cond",))
+    n_cond_al = _count_eqns(jx_al.jaxpr, ("cond",))
+    # One stage-owned cond per unrolled drain tick (forward); the grad
+    # transpose adds more — require at least the forward n.
+    assert n_cond_el >= n, f"expected >= {n} conds, found {n_cond_el}"
+    assert n_cond_al == 0
+    assert _count_eqns(jx_el.jaxpr, REMAT) >= 1
+    assert _count_eqns(jx_el.jaxpr, ("scan",)) >= 1
+
+
 def test_spmd_tp_ep_program_structure(cpu_devices):
     """tp/ep program: the compiled step must contain psum collectives for
     the tensor-parallel regions (entry/exit pairs per block sub-phase) and

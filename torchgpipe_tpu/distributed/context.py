@@ -210,18 +210,21 @@ class TcpTransport:
             # The connect timeout must not govern the transfer itself
             # (large activation blobs to a busy peer legitimately take
             # longer).  send_timeout (opt-in, like recv_timeout) bounds the
-            # whole transfer: a wedged peer whose listener stops READING
-            # would otherwise block sendall forever once the TCP buffer
-            # fills — the one hang recv_timeout cannot see.  Size it for
-            # your largest blob over your slowest link.
+            # TOTAL duration of the transfer — since Python 3.5 a socket
+            # timeout on sendall() is the maximum total time to send all
+            # data, not a per-write budget — so a wedged peer whose listener
+            # stops READING (sendall blocked on a full TCP buffer, the one
+            # hang recv_timeout cannot see) and a peer draining at a trickle
+            # both trip it.  Size it for your largest blob over your
+            # slowest link.
             sock.settimeout(self.send_timeout)
             try:
                 sock.sendall(struct.pack("!Q", len(blob)) + blob)
             except socket.timeout:
                 raise TimeoutError(
                     f"worker {self.name!r}: send of {len(blob)} bytes to "
-                    f"{dst!r} stalled for {self.send_timeout}s — is that "
-                    "rank still consuming?"
+                    f"{dst!r} did not complete within {self.send_timeout}s "
+                    "— is that rank still consuming?"
                 ) from None
 
     def close(self) -> None:

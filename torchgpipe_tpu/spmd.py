@@ -161,8 +161,10 @@ class SpmdGPipe:
       pre / post: optional layers applied before stage 0 / after stage n-1
         (e.g. embedding / LM head).  Their parameters are replicated over
         ``pp``; their gradients are psum-shared.
-      checkpoint: 'always' (remat the block per cell — GPipe memory profile)
-        or 'never'.
+      checkpoint: 'always' (remat the block per cell — GPipe memory
+        profile), 'except_last' (the last micro-batch's cells skip remat —
+        their backward needs no recompute since it runs right after their
+        forward; reference gpipe.py:360-367) or 'never'.
       remat_policy: optional ``jax.checkpoint`` policy refining
         ``checkpoint='always'`` (e.g.
         ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable`` keeps
@@ -215,19 +217,10 @@ class SpmdGPipe:
         for ax in (self.dp_axis, self.sp_axis, self.tp_axis, self.ep_axis):
             if ax is not None and ax not in self.mesh.axis_names:
                 raise ValueError(f"mesh has no {ax!r} axis: {self.mesh}")
-        if self.checkpoint not in ("always", "never"):
-            # 'except_last' (reference gpipe.py:360-367) cannot be expressed
-            # inside one lax.scan: scan stacks per-tick residual buffers
-            # uniformly across ticks, so exempting the last micro-batch's
-            # cells from remat would force full residual buffers for EVERY
-            # tick, destroying the memory profile checkpointing exists for.
-            # Its benefit (skip one recompute of m) is ~1/m of block FLOPs —
-            # use the MPMD engine when exact except_last semantics matter.
+        if self.checkpoint not in ("always", "except_last", "never"):
             raise ValueError(
-                "SPMD engine supports checkpoint='always'|'never'; "
-                "'except_last' needs non-uniform per-micro-batch remat, which "
-                "a scanned schedule cannot express without losing the remat "
-                "memory savings (use the MPMD GPipe engine for that mode)"
+                "SPMD engine supports checkpoint="
+                "'always'|'except_last'|'never'"
             )
         if self.sp_axis is not None and self.loss_reduction is None:
             raise ValueError(
@@ -274,13 +267,17 @@ class SpmdGPipe:
             y, _ = raw_apply(params, (), x, rng=rng, train=train)
             return y
 
-        if self.checkpoint == "always":
+        # _block_fn_plain: the un-remat'd block — the 'never' path and the
+        # last micro-batch's cells under 'except_last'.
+        self._block_fn_plain = block_fn
+        if self.checkpoint in ("always", "except_last"):
             block_fn = jax.checkpoint(
                 block_fn, static_argnums=(3,), policy=self.remat_policy
             )
         elif self.remat_policy is not None:
             raise ValueError(
-                "remat_policy only applies with checkpoint='always'"
+                "remat_policy only applies with checkpoint='always' or "
+                "'except_last'"
             )
         self._block_fn = block_fn
         # Spec prefix for the stacked block params: stage dim over pp, plus
@@ -452,7 +449,17 @@ class SpmdGPipe:
     def _local_pipeline(self, blocks_local, x_mb, rng, train: bool):
         """Run the fill-drain schedule locally; returns stacked per-tick
         outputs ``[T, b, ...]`` (garbage except where tick >= n-1 on the last
-        stage)."""
+        stage).
+
+        ``checkpoint='except_last'`` (reference gpipe.py:360-367) peels the
+        schedule: ticks ``0..m-2`` — whose cells all belong to micro-batches
+        ``< m-1`` — stay inside a remat'd ``lax.scan``, and the final ``n``
+        ticks are unrolled.  At unrolled tick ``t`` exactly one stage
+        (``t - (m-1)``) computes the LAST micro-batch's cell; a ``lax.cond``
+        on the stage index runs that cell un-remat'd (its residuals are
+        saved, no recompute in backward) while the drain-phase cells of
+        earlier micro-batches on the other stages keep the remat policy.
+        """
         n, m = self.n_stages, self.chunks
         stage = lax.axis_index(self.pp_axis)
         params_local = jax.tree_util.tree_map(lambda a: a[0], blocks_local)
@@ -463,7 +470,7 @@ class SpmdGPipe:
             lambda a: jnp.zeros(a.shape[1:], a.dtype), x_mb
         )
 
-        def tick(act, t):
+        def cell_input(act, t):
             idx = jnp.clip(t, 0, m - 1)
             inp0 = jax.tree_util.tree_map(
                 lambda a: lax.dynamic_index_in_dim(a, idx, 0, keepdims=False), x_mb
@@ -485,12 +492,40 @@ class SpmdGPipe:
             # cells and 0 on garbage ones — the scanned schedule then
             # injects exactly mean-over-microbatches like the MPMD engine.
             mb = t - stage
-            valid_scale = jnp.where(
-                (mb >= 0) & (mb < m), 1.0 / m, 0.0
-            )
+            valid_scale = jnp.where((mb >= 0) & (mb < m), 1.0 / m, 0.0)
+            return x_in, key, valid_scale
+
+        def tick(act, t):
+            x_in, key, valid_scale = cell_input(act, t)
             with aux_scale(valid_scale):
                 y = self._block_fn(params_local, x_in, key, train)
             return y, y
+
+        if self.checkpoint == "except_last" and train:
+            # Remat'd prefix: every cell in ticks 0..m-2 is micro-batch
+            # < m-1 (or fill garbage).  Zero-length scan (m == 1) is fine.
+            act, ys_scan = lax.scan(tick, act0, jnp.arange(m - 1))
+            ys_tail = []
+            for t in range(m - 1, T):
+                x_in, key, valid_scale = cell_input(act, t)
+                own = t - (m - 1)  # the stage whose cell is micro-batch m-1
+
+                def plain_cell(x, key=key, valid_scale=valid_scale):
+                    with aux_scale(valid_scale):
+                        return self._block_fn_plain(params_local, x, key, train)
+
+                def remat_cell(x, key=key, valid_scale=valid_scale):
+                    with aux_scale(valid_scale):
+                        return self._block_fn(params_local, x, key, train)
+
+                act = lax.cond(stage == own, plain_cell, remat_cell, x_in)
+                ys_tail.append(act)
+            ys_tail = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *ys_tail
+            )
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.concatenate([a, b], axis=0), ys_scan, ys_tail
+            )
 
         _, ys = lax.scan(tick, act0, jnp.arange(T))
         return ys

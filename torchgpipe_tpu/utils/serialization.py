@@ -152,22 +152,46 @@ def save_sharded(path: str, tree: Pytree, *, overwrite: bool = True) -> None:
     temp directory FIRST and swapping afterwards, so a crash mid-save never
     destroys the previous copy (at worst it leaves it under
     ``<path>.old``).  Pass ``False`` to refuse clobbering.
+
+    Multi-host: every process calls this (orbax writes each host's shards),
+    but the directory swap is filesystem surgery on shared storage, so only
+    process 0 performs it, fenced by global barriers — before the save (so
+    no host writes into a half-deleted temp dir) and around the swap (so no
+    host proceeds, e.g. into a restore, while the rename is in flight).
     """
     import shutil
 
     import orbax.checkpoint as ocp
 
     final = _abs(path)
+
+    def _barrier(tag: str) -> None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"save_sharded:{tag}")
+
     with ocp.StandardCheckpointer() as ckptr:
-        if overwrite and os.path.exists(final):
+        # The branch depends ONLY on the (host-consistent) ``overwrite``
+        # argument — never on a per-host filesystem probe, which can
+        # disagree across hosts (stale NFS attribute caches) and would
+        # strand some processes at a collective barrier the others never
+        # reach.
+        if overwrite:
             tmp, old = final + ".tmp", final + ".old"
-            shutil.rmtree(tmp, ignore_errors=True)
+            if jax.process_index() == 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+            _barrier("pre-save")
             ckptr.save(tmp, tree)
             ckptr.wait_until_finished()
-            shutil.rmtree(old, ignore_errors=True)
-            os.rename(final, old)
-            os.rename(tmp, final)
-            shutil.rmtree(old)
+            _barrier("post-save")
+            if jax.process_index() == 0:
+                shutil.rmtree(old, ignore_errors=True)
+                if os.path.exists(final):
+                    os.rename(final, old)
+                os.rename(tmp, final)
+                shutil.rmtree(old, ignore_errors=True)
+            _barrier("post-swap")
         else:
             ckptr.save(final, tree)
 
