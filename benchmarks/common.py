@@ -49,6 +49,7 @@ def build_gpipe(
     devices=None,
     tracer=None,
     bf16: bool = False,
+    deferred_batch_norm: bool = False,
 ) -> GPipe:
     if balance is None:
         balance = even_balance(len(layers), n_stages)
@@ -56,6 +57,7 @@ def build_gpipe(
         list(layers), balance, chunks=chunks, checkpoint=checkpoint,
         devices=devices, tracer=tracer,
         compute_dtype=jnp.bfloat16 if bf16 else None,
+        deferred_batch_norm=deferred_batch_norm,
     )
 
 
@@ -119,8 +121,13 @@ def run_speed(
     steps_per_epoch: int = 10,
     skip_epochs: int = 1,
     label: str = "experiment",
+    after: Optional[Callable] = None,
 ) -> float:
-    """Timed SGD epochs through the GPipe engine; steady-state samples/sec."""
+    """Timed SGD epochs through the GPipe engine; steady-state samples/sec.
+
+    ``after(params, state)`` (optional) runs on the trained values once the
+    loop finishes — e.g. the MoE driver prints router balance stats.
+    """
     in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
     params, state = model.init(jax.random.PRNGKey(0), in_spec)
     rng = jax.random.PRNGKey(1)
@@ -138,10 +145,13 @@ def run_speed(
         carry["state"] = new_state
         return loss, carry["params"]
 
-    return run_epoch_loop(
+    tput = run_epoch_loop(
         step_fn, x.shape[0], epochs=epochs, steps_per_epoch=steps_per_epoch,
         skip_epochs=skip_epochs, label=label,
     )
+    if after is not None:
+        after(carry["params"], carry["state"])
+    return tput
 
 
 def run_memory(

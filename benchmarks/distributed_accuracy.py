@@ -76,14 +76,23 @@ MODELS = {
 @click.option("--steps", default=8)
 @click.option("--classes", default=10)
 @click.option("--image", default=32)
+@click.option("--recv-timeout", default=None, type=float,
+              help="bound every cross-rank receive; a dead peer surfaces as "
+                   "a TimeoutError naming the missing channel instead of a "
+                   "hang (leave unset when stage compile times are unknown)")
+@click.option("--connect-timeout", default=120.0, type=float,
+              help="rendezvous budget for dialing a peer's listener")
 def main(rank, world, master, port_base, model_name, balance, chunks,
-         batch_size, epochs, steps, classes, image):
+         batch_size, epochs, steps, classes, image, recv_timeout,
+         connect_timeout):
     layers = MODELS[model_name](classes)
     workers = [f"rank{r}" for r in range(world)]
     # Each rank listens on port_base + rank; peers dial the master host.
     addresses = {f"rank{r}": (master, port_base + r) for r in range(world)}
     addresses[f"rank{rank}"] = ("0.0.0.0", port_base + rank)
-    transport = TcpTransport(f"rank{rank}", addresses)
+    transport = TcpTransport(
+        f"rank{rank}", addresses, connect_timeout=connect_timeout
+    )
 
     if model_name == "llama-small":
         x0 = jnp.zeros((batch_size, 64), jnp.int32)
@@ -129,6 +138,7 @@ def main(rank, world, master, port_base, model_name, balance, chunks,
     pipe = DistributedGPipe(
         layers, rank, workers, balance, chunks=chunks,
         transport=transport, mailbox=transport.mailbox,
+        recv_timeout=recv_timeout,
     )
     params, state = pipe.init(jax.random.PRNGKey(0), in_spec)
 
@@ -141,6 +151,7 @@ def main(rank, world, master, port_base, model_name, balance, chunks,
     loader = DistributedGPipeDataLoader(
         data, rank, workers,
         transport=transport, mailbox=transport.mailbox, num_batches=steps,
+        recv_timeout=recv_timeout,
     )
 
     t0 = time.time()

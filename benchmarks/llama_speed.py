@@ -122,14 +122,43 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
             layers, even_balance(len(layers), n), chunks=chunks,
             checkpoint=checkpoint,
         )
+
+        def after(params, state):
+            if moe is None:
+                return
+            # Router balance of the first MoE block on the final batch's
+            # embeddings (layer 0 = token_embedding on stage 0).
+            del state
+            h, _ = layers[0].apply(params[0][0], (), x[:, :-1],
+                                   rng=None, train=False)
+            _print_router_stats(params, h, moe)
+
         tput = run_speed(
             model, x, x, causal_lm_loss,
             epochs=epochs, steps_per_epoch=steps, label=experiment,
+            after=after,
         )
     kind = f"moe{moe_experts}" if moe_experts else "dense"
     print(
         f"FINAL | llama-speed {experiment} [{preset}, {engine}, {kind}]: "
         f"{tput:.1f} samples/sec"
+    )
+
+
+def _print_router_stats(params, h, moe):
+    """Balance metrics of the first router found in ``params`` against
+    hidden states ``h`` (router_stats: load/importance/Switch penalty)."""
+    from torchgpipe_tpu.models.moe import find_routers, router_stats
+
+    routers = find_routers(params)
+    if not routers:
+        return
+    load, imp, bal = router_stats(routers[0], h, moe)
+    print(
+        f"router | balance={float(bal):.3f} (1.0=perfect) "
+        f"load[min/max]={float(load.min()):.3f}/{float(load.max()):.3f} "
+        f"importance[min/max]={float(imp.min()):.3f}/{float(imp.max()):.3f}",
+        flush=True,
     )
 
 
@@ -139,13 +168,6 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
     from torchgpipe_tpu.models.transformer import llama_spmd
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
 
-    if checkpoint == "except_last":
-        checkpoint = "always"  # scanned schedule supports always|never
-        print(
-            "note: spmd engine runs checkpoint='always' (except_last is not "
-            "expressible in the scanned schedule; see torchgpipe_tpu.spmd)",
-            flush=True,
-        )
     if moe is not None:
         from torchgpipe_tpu.models.moe import llama_moe_spmd
 
@@ -177,9 +199,18 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
         )
         return loss, carry["params"]
 
-    return run_epoch_loop(
+    tput = run_epoch_loop(
         step_fn, x.shape[0], epochs=epochs, steps_per_epoch=steps, label=label
     )
+    if moe is not None and pre is not None:
+        # Router balance of stage 0's first MoE block on the final batch.
+        stage0 = jax.tree_util.tree_map(
+            lambda a: a[0], carry["params"]["blocks"]
+        )
+        h, _ = pre.apply(carry["params"]["pre"], (), inputs,
+                         rng=None, train=False)
+        _print_router_stats(stage0, h, moe)
+    return tput
 
 
 if __name__ == "__main__":

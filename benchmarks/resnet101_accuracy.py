@@ -54,11 +54,17 @@ def _dataset(data_dir, n, image, classes, seed=0):
 @click.option("--lr", default=0.1)
 @click.option("--warmup-epochs", default=1, help="gradual LR warm-up epochs")
 @click.option("--base-width", default=64)
+@click.option("--deferred-bn/--no-deferred-bn", default=True,
+              help="DeferredBatchNorm: commit BN running stats once per "
+                   "mini-batch so eval-mode statistics match non-pipelined "
+                   "training (reference: torchgpipe/batchnorm.py:17-155; the "
+                   "transparency claim this benchmark exists to prove)")
 def main(experiment, epochs, data_dir, image, dataset_size, classes, lr,
-         warmup_epochs, base_width):
+         warmup_epochs, base_width, deferred_bn):
     n_stages, batch, chunks = EXPERIMENTS[experiment]
     layers = resnet101(num_classes=classes, base_width=base_width)
-    model = build_gpipe(layers, None, n_stages, chunks, "except_last")
+    model = build_gpipe(layers, None, n_stages, chunks, "except_last",
+                        deferred_batch_norm=deferred_bn)
 
     X, Y = _dataset(data_dir, dataset_size, image, classes)
     batch = min(batch, X.shape[0])

@@ -141,6 +141,7 @@ def test_distributed_training_converges():
     assert losses[-1] < losses[0] * 0.5, losses
 
 
+@pytest.mark.slow
 def test_distributed_cross_rank_skips():
     """U-Net long skips stash on one rank and pop on another: the skip tensor
     and its gradient must route point-to-point through the transport (a

@@ -120,6 +120,7 @@ def test_loss_reduction_requires_1f1b():
         GPipe(_layers(), balance=[4, 3, 2], chunks=2, loss_reduction="mean")
 
 
+@pytest.mark.slow
 def test_1f1b_interleaved_virtual_stages():
     """1F1B with more stages than devices (stage wrap-around placement):
     transparency with fill-drain must hold on the looped topology too."""

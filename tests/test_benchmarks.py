@@ -7,7 +7,12 @@ import socket
 import subprocess
 import sys
 
+import pytest
 from click.testing import CliRunner
+
+# Driver smokes are end-to-end subprocess/CLI runs - the slowest tests in
+# the suite; the fast core target (pytest -m "not slow") skips them.
+pytestmark = pytest.mark.slow
 
 
 def _invoke(cli, args):
@@ -65,8 +70,52 @@ def test_resnet_accuracy_driver():
     out = _invoke(main, [
         "pipeline-256", "--epochs", "1", "--image", "16",
         "--dataset-size", "4", "--classes", "4", "--base-width", "8",
+        "--no-deferred-bn",  # batch 4 cannot split into chunks=8
     ])
     assert "top-1" in out
+
+
+def test_accuracy_transparency_naive_vs_pipeline():
+    """Transparency at accuracy: naive (1 stage, no micro-batching) and
+    pipeline-4 (chunks=8) trained with IDENTICAL seeds/data must produce
+    near-identical loss curves and the same final train accuracy — the
+    statistical claim the reference proves with its 90-epoch ImageNet runs
+    (reference: benchmarks/resnet101-accuracy/main.py:22-125,
+    docs/benchmarks.rst:13-19), scaled to CI."""
+    import re
+
+    from benchmarks.resnet101_accuracy import main
+
+    epochs = 12
+    args = [
+        "--epochs", str(epochs), "--image", "32", "--dataset-size", "128",
+        "--classes", "10", "--base-width", "8", "--lr", "0.05",
+    ]
+
+    def curves(experiment):
+        out = _invoke(main, [experiment, *args])
+        losses = [float(v) for v in re.findall(r"loss (\d+\.\d+)", out)]
+        accs = [float(v) for v in re.findall(r"top-1 (\d+\.\d+)%", out)]
+        assert len(losses) == epochs and len(accs) == epochs, out
+        return losses, accs
+
+    naive_l, naive_a = curves("naive-256")
+    pipe_l, pipe_a = curves("pipeline-256")
+    # BatchNorm normalizes each micro-batch with its own statistics (exactly
+    # the reference's DeferredBatchNorm semantics, batchnorm.py:87-99), so
+    # with chunks=8 the agreement is STATISTICAL — like the reference's
+    # published 21.99/22.24/22.13 +-0.2 top-1 spread — not pointwise:
+    # compare where it is meaningful, at convergence.
+    tail = 3
+    naive_tail = sum(naive_l[-tail:]) / tail
+    pipe_tail = sum(pipe_l[-tail:]) / tail
+    assert abs(naive_tail - pipe_tail) <= 0.20 * max(1.0, naive_tail), (
+        naive_l, pipe_l
+    )
+    assert abs(naive_a[-1] - pipe_a[-1]) <= 10.0, (naive_a, pipe_a)
+    # Both runs must actually optimize (the curves being compared descend).
+    assert naive_tail < 0.75 * naive_l[0], naive_l
+    assert pipe_tail < 0.75 * pipe_l[0], pipe_l
 
 
 def test_distributed_driver_two_real_processes():

@@ -97,37 +97,13 @@ def test_fused_engine_checkpoint_shrinks_residual_bytes():
     assert always < never / 2, (always, never)
 
 
-def _aval_bytes(v) -> int:
-    aval = getattr(v, "aval", None)
-    if aval is None or not hasattr(aval, "shape"):
-        return 0
-    size = 1
-    for d in aval.shape:
-        size *= int(d)
-    return size * jnp.dtype(aval.dtype).itemsize
-
-
 def _fwd_to_bwd_residual_bytes(jaxpr) -> int:
     """Sum output bytes of scan/cond equations anywhere in the program —
     the stacked per-tick saves (scan ys) and the unrolled-tick saves (cond
     outputs) are exactly what the forward schedule hands the backward."""
-    total = 0
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name in ("scan", "cond"):
-            total += sum(_aval_bytes(v) for v in eqn.outvars)
-        for v in eqn.params.values():
-            total += _sub_jaxpr_bytes(v)
-    return total
+    from tests.jaxpr_utils import sum_eqn_output_bytes
 
-
-def _sub_jaxpr_bytes(v) -> int:
-    if hasattr(v, "jaxpr"):  # ClosedJaxpr
-        return _fwd_to_bwd_residual_bytes(v.jaxpr)
-    if hasattr(v, "eqns"):  # raw Jaxpr (e.g. shard_map body)
-        return _fwd_to_bwd_residual_bytes(v)
-    if isinstance(v, (tuple, list)):
-        return sum(_sub_jaxpr_bytes(x) for x in v)
-    return 0
+    return sum_eqn_output_bytes(jaxpr, ("scan", "cond"))
 
 
 def _spmd_residual_bytes(mode: str, cpu_devices) -> int:

@@ -3,6 +3,7 @@
 import os
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -19,6 +20,7 @@ from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
 CFG = TransformerConfig(vocab=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2)
 
 
+@pytest.mark.slow
 def test_llama_mpmd_transparency():
     layers = llama(CFG)
     model = GPipe(layers, balance=[2, 2, 2], chunks=2)
@@ -53,6 +55,7 @@ def test_llama_mpmd_transparency():
     )
 
 
+@pytest.mark.slow
 def test_llama_spmd_runs(cpu_devices):
     n = 4
     mesh = make_mesh(n, 2, devices=cpu_devices)
@@ -102,6 +105,7 @@ def test_graft_entry_single_chip():
     assert out.shape == (2, 64, 1024)
 
 
+@pytest.mark.slow
 def test_graft_dryrun(cpu_devices):
     import sys
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

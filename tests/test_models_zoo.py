@@ -4,6 +4,7 @@ applied to the benchmark models of SURVEY.md §2.4)."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 from torchgpipe_tpu.gpipe import GPipe
 from torchgpipe_tpu.layers import sequential_apply
@@ -74,6 +75,7 @@ def _check_transparency(layers, x, n_stages, chunks, checkpoint="except_last"):
     return model, params, state
 
 
+@pytest.mark.slow
 def test_amoebanet_transparency_and_grads():
     layers = amoebanetd(num_classes=10, num_layers=3, num_filters=16)
     x = jax.random.normal(jax.random.PRNGKey(7), (4, 32, 32, 3))
@@ -103,6 +105,7 @@ def test_amoebanet_transparency_and_grads():
         assert np.abs(a - b).max() / scale < 5e-3, (a.shape, np.abs(a - b).max(), scale)
 
 
+@pytest.mark.slow
 def test_amoebanet_deferred_batch_norm_converts_compound_cells():
     layers = amoebanetd(num_classes=10, num_layers=3, num_filters=16)
     x = jax.random.normal(jax.random.PRNGKey(3), (4, 32, 32, 3))
@@ -126,6 +129,7 @@ def test_amoebanet_deferred_batch_norm_converts_compound_cells():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_resnet_transparency():
     layers = build_resnet([1, 1, 1, 1], num_classes=10, base_width=8)
     x = jax.random.normal(jax.random.PRNGKey(5), (4, 32, 32, 3))
@@ -154,6 +158,7 @@ def test_resnet_cut_inside_block():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_unet_transparency():
     layers = unet(depth=2, num_convs=1, base_channels=4)
     x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 16, 3))
@@ -175,12 +180,14 @@ def test_unet_odd_input_padding():
     assert out.shape[0] == 2 and out.shape[-1] == 1
 
 
+@pytest.mark.slow
 def test_amoebanet_checkpoint_always():
     layers = amoebanetd(num_classes=10, num_layers=3, num_filters=16)
     x = jax.random.normal(jax.random.PRNGKey(7), (4, 32, 32, 3))
     _check_transparency(layers, x, n_stages=2, chunks=2, checkpoint="always")
 
 
+@pytest.mark.slow
 def test_amoebanet_checkpoint_never_three_stages():
     # 'never' keeps every cell's vjp residuals; 3 stages also covers the
     # deeper-pipeline cell wiring the 2-stage tests miss.

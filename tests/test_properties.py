@@ -5,6 +5,7 @@ partitioner.  The reference proves these with hand-picked cases
 input space."""
 
 import numpy as np
+import pytest
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
@@ -82,6 +83,7 @@ def test_blockpartition_is_contiguous_cover(costs, data):
             assert max(sum(p) for p in alt) >= best
 
 
+@pytest.mark.slow
 def test_moe_dispatch_invariants():
     """Property sweep of the MoE dispatch tensors: combine weights are
     nonnegative, per-token totals never exceed 1 (equal 1 when no slot

@@ -9,33 +9,12 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from tests.jaxpr_utils import count_eqns as _count_eqns
 from torchgpipe_tpu import microbatch
 from torchgpipe_tpu.checkpoint import checkpoint_stop
 from torchgpipe_tpu.gpipe import GPipe
 from torchgpipe_tpu.layers import named
 from torchgpipe_tpu.ops import nn
-
-
-def _count_eqns(jaxpr, names) -> int:
-    """Recursively count equations whose primitive name is in ``names``."""
-    total = 0
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name in names:
-            total += 1
-        # Recurse into any sub-jaxprs carried in params.
-        for v in eqn.params.values():
-            total += _count_in_param(v, names)
-    return total
-
-
-def _count_in_param(v, names) -> int:
-    if hasattr(v, "jaxpr"):  # ClosedJaxpr
-        return _count_eqns(v.jaxpr, names)
-    if hasattr(v, "eqns"):  # raw Jaxpr
-        return _count_eqns(v, names)
-    if isinstance(v, (tuple, list)):
-        return sum(_count_in_param(x, names) for x in v)
-    return 0
 
 
 REMAT = ("remat", "remat2", "checkpoint")

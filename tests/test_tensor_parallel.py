@@ -97,6 +97,7 @@ def test_psum_grad_sums_cotangent(cpu_devices):
     np.testing.assert_allclose(np.asarray(g), 10.0 * np.ones((4, 2)))
 
 
+@pytest.mark.slow
 def test_spmd_tp_transparency(cpu_devices):
     """pp=2 x tp=2 sharded run == unsharded pp=2 run == sequential oracle,
     for loss and every gradient leaf."""
@@ -136,6 +137,7 @@ def test_spmd_tp_transparency(cpu_devices):
     _assert_trees_close(grads, ref_grads)
 
 
+@pytest.mark.slow
 def test_spmd_tp_with_dp(cpu_devices):
     """tp composes with dp: pp=2 x dp=2 x tp=2 on 8 devices."""
     pp, dp, tp = 2, 2, 2

@@ -6,6 +6,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from torchgpipe_tpu import GPipe
 from torchgpipe_tpu.ops import batch_norm, dense, relu
@@ -156,6 +157,7 @@ def test_simulate_pipeline_multistep_averaging():
     assert abs(bubble - (n - 1) / (m + n - 1)) < 1e-9
 
 
+@pytest.mark.slow
 def test_sharded_checkpoint_roundtrip(cpu_devices, tmp_path):
     """SPMD training state (sharded params + optax state) survives an orbax
     save/restore with shardings intact — the resume story for the compiled

@@ -78,6 +78,13 @@ class MoEConfig:
     capacity_factor: float = 1.25
     ep_axis: Optional[str] = None
     balance_weight: float = 0.0
+    # Token-dispatch implementation: 'dense' builds the classic one-hot
+    # [t, E, C] combine/dispatch einsum tensors (all-matmul, best for small
+    # routing problems); 'sparse' assigns slots by a stable sort and moves
+    # tokens with scatter/gather — O(t*k + E*C*d) memory, the scalable path
+    # for large t*E*C (8k tokens x 64 experts would put the dense tensors
+    # in the hundreds of MB).  'auto' picks by the dense tensor's size.
+    dispatch: str = "auto"
 
 
 @jax.custom_vjp
@@ -119,17 +126,55 @@ def add_aux_grad(y, aux, weight):
     return _aux_inject(y, aux, scaled)
 
 
-def _balance_penalty(probs: jnp.ndarray, n_experts: int):
-    """Switch balance penalty from router probabilities ``[t, E]``:
+def _balance_penalty(probs: jnp.ndarray, n_experts: int, top_k: int = 1):
+    """Switch/GShard balance penalty from router probabilities ``[t, E]``:
     ``(load, importance, E * sum(load * importance))`` — 1.0 iff perfectly
     balanced.  Single source for both the training-time injection
-    (``balance_weight``) and the :func:`router_stats` monitoring metric."""
-    top1 = jax.nn.one_hot(
-        jnp.argmax(probs, axis=-1), n_experts, dtype=jnp.float32
-    )
-    load = jnp.mean(top1, axis=0)
+    (``balance_weight``) and the :func:`router_stats` monitoring metric.
+
+    ``load`` is the fraction of routing *assignments* per expert over ALL
+    ``top_k`` selection rounds (the same iterative-argmax selection the
+    dispatcher uses), so with k=2 a lopsided second choice is penalized
+    too, not just the top-1 (Switch's k=1 formulation is the special
+    case).  Selections are counted pre-capacity: capacity drops depend on
+    token order and would make the penalty discontinuous in it.
+    """
+    remaining = probs
+    sel = jnp.zeros((n_experts,), jnp.float32)
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)
+        sel = sel + jnp.mean(mask, axis=0)
+        remaining = remaining * (1.0 - mask)
+    load = sel / top_k
     importance = jnp.mean(probs, axis=0)
     return load, importance, n_experts * jnp.sum(load * importance)
+
+
+def _top_k_select(probs: jnp.ndarray, k: int):
+    """Iterative-argmax top-k routing selection shared by both dispatch
+    implementations: per round the highest remaining expert is chosen and
+    masked out.  Returns per-round expert indices ``[k, t]``, one-hot masks
+    (list of ``[t, E]``) and gate values ``[k, t]`` (raw softmax probs)."""
+    remaining = probs
+    idxs: List[jnp.ndarray] = []
+    masks: List[jnp.ndarray] = []
+    gates: List[jnp.ndarray] = []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(idx, probs.shape[-1], dtype=probs.dtype)
+        idxs.append(idx)
+        gates.append(jnp.sum(probs * mask, axis=-1))  # [t]
+        masks.append(mask)
+        remaining = remaining * (1.0 - mask)
+    return jnp.stack(idxs), masks, jnp.stack(gates)
+
+
+def _gate_denom(gates: jnp.ndarray, k: int):
+    # k>1: normalize combine weights over the k selections (GShard).  k=1
+    # keeps the raw softmax probability as the gate (Switch) — normalizing
+    # would pin it to ~1.0 and starve the router of gradient entirely.
+    return jnp.sum(gates, axis=0) + 1e-9 if k > 1 else jnp.ones(())
 
 
 def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
@@ -141,19 +186,9 @@ def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
     order, k-th choices after all (k-1)-th choices (Switch/GShard order).
     """
     t, E = probs.shape
-    remaining = probs
-    masks: List[jnp.ndarray] = []
-    gates: List[jnp.ndarray] = []
-    for _ in range(k):
-        idx = jnp.argmax(remaining, axis=-1)
-        mask = jax.nn.one_hot(idx, E, dtype=probs.dtype)  # [t, E]
-        gates.append(jnp.sum(probs * mask, axis=-1))  # [t]
-        masks.append(mask)
-        remaining = remaining * (1.0 - mask)
-    # k>1: normalize combine weights over the k selections (GShard).  k=1
-    # keeps the raw softmax probability as the gate (Switch) — normalizing
-    # would pin it to ~1.0 and starve the router of gradient entirely.
-    denom = sum(gates) + 1e-9 if k > 1 else jnp.ones(())
+    _, masks, gates_kt = _top_k_select(probs, k)
+    gates = [gates_kt[kk] for kk in range(k)]
+    denom = _gate_denom(gates_kt, k)
 
     combine = jnp.zeros((t, E, capacity), probs.dtype)
     counts = jnp.zeros((E,), probs.dtype)
@@ -172,6 +207,38 @@ def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
     return combine, dispatch
 
 
+def _sparse_assignment(probs: jnp.ndarray, k: int, capacity: int):
+    """Sort-based slot assignment — identical FCFS semantics to
+    :func:`_top_k_dispatch` (token order within a choice round, round kk
+    strictly after round kk-1) with O(t*k) bookkeeping instead of the dense
+    ``[t, E, C]`` tensors.
+
+    Returns flat per-assignment arrays of length ``k*t`` in k-major order
+    (assignment ``i`` = choice round ``i // t`` of token ``i % t``):
+    ``experts`` (int32 expert id), ``gates`` (normalized combine weight),
+    ``keep`` (bool, False where the expert's capacity overflowed) and
+    ``slot`` (int32 position in the expert buffer, 0 where dropped).
+    """
+    t, E = probs.shape
+    idxs, _, gates_kt = _top_k_select(probs, k)
+    denom = _gate_denom(gates_kt, k)
+    experts = idxs.reshape(-1).astype(jnp.int32)  # [kt], k-major
+    gates = (gates_kt / denom).reshape(-1)
+    kt = k * t
+    # Stable sort groups assignments by expert while preserving the k-major
+    # FCFS order inside each group — position within the group IS the
+    # dense path's slot number.
+    order = jnp.argsort(experts, stable=True)
+    sorted_e = experts[order]
+    counts = jnp.bincount(experts, length=E)
+    starts = jnp.cumsum(counts) - counts  # segment start per expert
+    pos_sorted = (jnp.arange(kt) - starts[sorted_e]).astype(jnp.int32)
+    pos = jnp.zeros((kt,), jnp.int32).at[order].set(pos_sorted)
+    keep = pos < capacity
+    slot = jnp.where(keep, pos, 0)
+    return experts, gates, keep, slot
+
+
 def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Layer:
     """Top-k routed expert SwiGLU feed-forward on ``[b, s, dim]`` states.
 
@@ -185,6 +252,8 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
     dt = cfg.dtype
     if K > E:
         raise ValueError(f"top_k={K} exceeds n_experts={E}")
+    if moe.dispatch not in ("auto", "dense", "sparse"):
+        raise ValueError("MoEConfig.dispatch must be 'auto'|'dense'|'sparse'")
 
     def init(rng, in_spec):
         del in_spec
@@ -212,12 +281,27 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
 
         logits = xf.astype(jnp.float32) @ params["router"]  # [t, E]
         probs = jax.nn.softmax(logits, axis=-1)
-        combine, dispatch = _top_k_dispatch(probs, K, capacity)
-
-        # Dispatch: [t, E, C] one-hot x [t, d] -> per-expert buffers [E, C, d].
-        expert_in = jnp.einsum(
-            "tec,td->ecd", dispatch.astype(xf.dtype), xf
+        # Dense one-hot einsum dispatch materializes [t, E, C] tensors; past
+        # ~16M elements (64MB f32) the sort-based scatter/gather path wins on
+        # memory by orders of magnitude (8k tokens x 64 experts: ~670MB vs
+        # ~O(t*k) indices).  Both produce bit-equal outputs.
+        use_sparse = moe.dispatch == "sparse" or (
+            moe.dispatch == "auto" and t * E * capacity > 1 << 24
         )
+        if use_sparse:
+            experts, gates, keep, slot = _sparse_assignment(probs, K, capacity)
+            tok = jnp.arange(K * t) % t
+            contrib = xf[tok] * keep[:, None].astype(xf.dtype)
+            expert_in = (
+                jnp.zeros((E, capacity, d), xf.dtype)
+                .at[experts, slot].add(contrib)
+            )
+        else:
+            combine, dispatch = _top_k_dispatch(probs, K, capacity)
+            # Dispatch: [t, E, C] one-hot x [t, d] -> expert buffers [E, C, d].
+            expert_in = jnp.einsum(
+                "tec,td->ecd", dispatch.astype(xf.dtype), xf
+            )
         if ep_active:
             # Route buffers to the lanes owning their experts: split the
             # expert dim, concat received blocks along capacity.
@@ -235,12 +319,20 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
             out = lax.all_to_all(
                 out, moe.ep_axis, split_axis=1, concat_axis=0, tiled=True
             )
-        y = jnp.einsum("tec,ecd->td", combine.astype(out.dtype), out)
+        if use_sparse:
+            # Gather each kept assignment's result row and fold the k
+            # choices back per token (k-major layout: reshape + sum).
+            picked = out[experts, slot] * (
+                gates * keep.astype(gates.dtype)
+            )[:, None].astype(out.dtype)
+            y = jnp.sum(picked.reshape(K, t, d), axis=0)
+        else:
+            y = jnp.einsum("tec,ecd->td", combine.astype(out.dtype), out)
         y = y.reshape(b, s, d).astype(x.dtype)
         if moe.balance_weight > 0.0 and train:
             # Switch balance penalty from this lane's tokens; gradient-only
             # injection (see add_aux_grad / MoEConfig.balance_weight).
-            _, _, aux = _balance_penalty(probs, E)
+            _, _, aux = _balance_penalty(probs, E, K)
             y = add_aux_grad(y, aux, moe.balance_weight)
         return y, state
 
@@ -276,13 +368,35 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
 
 def router_stats(params_router: jnp.ndarray, x: jnp.ndarray, moe: MoEConfig):
     """Standard router monitoring metrics from hidden states ``[b, s, dim]``:
-    ``(load, importance, balance_loss)`` — per-expert token fractions,
-    per-expert mean probabilities, and the Switch-style balance penalty
-    ``E * sum(load * importance)`` (1.0 = perfectly balanced)."""
+    ``(load, importance, balance_loss)`` — per-expert assignment fractions
+    over all ``top_k`` selection rounds, per-expert mean probabilities, and
+    the Switch-style balance penalty ``E * sum(load * importance)``
+    (1.0 = perfectly balanced)."""
     t = x.shape[0] * x.shape[1]
     logits = x.reshape(t, -1).astype(jnp.float32) @ params_router
     probs = jax.nn.softmax(logits, axis=-1)
-    return _balance_penalty(probs, moe.n_experts)
+    return _balance_penalty(probs, moe.n_experts, moe.top_k)
+
+
+def find_routers(params) -> List[jnp.ndarray]:
+    """All router matrices in a params pytree, depth-first — lets drivers
+    monitor :func:`router_stats` without knowing the nesting (e.g. the
+    first MoE block of a GPipe stage list or an SPMD stacked-blocks tree)."""
+    out: List[jnp.ndarray] = []
+
+    def walk(p):
+        if isinstance(p, dict):
+            r = p.get("router")
+            if r is not None and hasattr(r, "shape"):
+                out.append(r)
+            for v in p.values():
+                walk(v)
+        elif isinstance(p, (list, tuple)):
+            for v in p:
+                walk(v)
+
+    walk(params)
+    return out
 
 
 def moe_transformer_block(
