@@ -154,8 +154,12 @@ def _print_router_stats(params, h, moe):
     if not routers:
         return
     load, imp, bal = router_stats(routers[0], h, moe)
+    # The hidden states are the token EMBEDDINGS of the last batch — an
+    # input-distribution proxy for block 0's true router input (which sees
+    # normed post-attention states); say so in the output.
     print(
-        f"router | balance={float(bal):.3f} (1.0=perfect) "
+        f"router[block0, embedding-proxy] | balance={float(bal):.3f} "
+        f"(1.0=perfect) "
         f"load[min/max]={float(load.min()):.3f}/{float(load.max()):.3f} "
         f"importance[min/max]={float(imp.min()):.3f}/{float(imp.max()):.3f}",
         flush=True,

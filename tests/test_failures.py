@@ -1,0 +1,96 @@
+"""Single-process failure semantics.
+
+Reference: tests/test_gpipe.py:227-275 — (a) an exception raised inside a
+partition propagates to the caller with its type/traceback preserved, and
+(b) the schedule early-stops: once a cell fails, upstream partitions stop
+getting new micro-batches ASAP (the reference counts 2, not 3).  Here the
+engine additionally names the offending (stage, micro-batch) cell via an
+exception note (PEP 678).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchgpipe_tpu.gpipe import GPipe
+from torchgpipe_tpu.layers import Layer
+from torchgpipe_tpu.ops import dense
+from torchgpipe_tpu.utils.tracing import Timeline
+
+
+class ExpectedError(Exception):
+    pass
+
+
+def armable_bomb(armed, name="bomb"):
+    """Identity layer that raises once ``armed['on']`` is set — inert during
+    init-time shape inference, explosive in the real schedule."""
+
+    def init(rng, in_spec):
+        del rng, in_spec
+        return (), ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del params, rng, train
+        if armed["on"]:
+            raise ExpectedError("boom")
+        return x, state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def _mse(out, tgt):
+    return jnp.mean((out - tgt) ** 2)
+
+
+def _build(armed, schedule="gpipe", tracer=None):
+    layers = [dense(4, name="fc0"), armable_bomb(armed)]
+    kwargs = dict(loss_reduction="mean") if schedule == "1f1b" else {}
+    model = GPipe(layers, balance=[1, 1], chunks=3, fused=False,
+                  schedule=schedule, tracer=tracer, **kwargs)
+    x = jnp.ones((6, 4))
+    y = jnp.zeros((6, 4))
+    params, state = model.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    return model, params, state, x, y
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_exception_propagates_naming_the_stage(schedule):
+    armed = {"on": False}
+    model, params, state, x, y = _build(armed, schedule)
+    armed["on"] = True
+    with pytest.raises(ExpectedError) as excinfo:
+        model.value_and_grad(params, state, x, y, _mse)
+    notes = "".join(getattr(excinfo.value, "__notes__", []))
+    assert "stage 1" in notes, notes
+    assert "micro-batch 0" in notes, notes
+
+
+def test_early_stop_upstream_dispatch():
+    """Stage 1 fails on micro-batch 0 (clock cycle 1).  By then stage 0 has
+    dispatched micro-batches 0 and 1 — and must NOT go on to micro-batch 2
+    (the reference's counter asserts exactly this: 2, not 3)."""
+    armed = {"on": False}
+    tracer = Timeline()
+    model, params, state, x, y = _build(armed, tracer=tracer)
+    armed["on"] = True
+    with pytest.raises(ExpectedError):
+        model.value_and_grad(params, state, x, y, _mse)
+    stage0_fwd = [
+        ev for ev in tracer.events if ev.name == "fwd" and ev.stage == 0
+    ]
+    assert len(stage0_fwd) == 2, tracer.events
+    # And nothing ran after the failing cell anywhere.
+    assert not any(ev.name == "bwd" for ev in tracer.events)
+
+
+def test_forward_only_also_propagates():
+    armed = {"on": False}
+    model, params, state, x, _ = _build(armed)
+    armed["on"] = True
+    with pytest.raises(ExpectedError) as excinfo:
+        model.apply(params, state, x)
+    notes = "".join(getattr(excinfo.value, "__notes__", []))
+    assert "stage 1" in notes, notes

@@ -29,6 +29,7 @@ homogeneous stacked stages inside one jitted program, see
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -65,6 +66,28 @@ def clock_cycles(m: int, n: int):
 def _transfer(x: Pytree, device) -> Pytree:
     """Async device-to-device move (ICI on TPU); no-op if already there."""
     return jax.device_put(x, device)
+
+
+@contextlib.contextmanager
+def _cell_context(j: int, i: int, phase: str):
+    """Annotate any exception escaping a cell with the offending stage.
+
+    The reference propagates the first exception out of its worker threads
+    with the traceback preserved (reference: torchgpipe/pipeline.py:222-249,
+    worker.py:81-88) but leaves the user to guess which partition raised;
+    here the original exception type/traceback still propagate — the
+    schedule simply stops dispatching (early-stop) — plus a note naming the
+    cell.
+    """
+    try:
+        yield
+    except Exception as e:  # noqa: BLE001 — annotate and re-raise as-is
+        if hasattr(e, "add_note"):
+            e.add_note(
+                f"raised in pipeline stage {j}, micro-batch {i} "
+                f"({phase} schedule)"
+            )
+        raise
 
 
 class StageExec:
@@ -239,9 +262,10 @@ class Pipeline:
                 skips_in = {k: skip_vals.pop((i, k)) for k in stage.ext_pop_keys}
                 rng_i = jax.random.fold_in(rng, i) if rng is not None else None
                 fwd = stage.fwd_train if train else stage.fwd_eval
-                y, ext, new_state = fwd(
-                    params[j], cur_states[j], x, skips_in, rng_i, 1.0 / m
-                )
+                with _cell_context(j, i, "forward"):
+                    y, ext, new_state = fwd(
+                        params[j], cur_states[j], x, skips_in, rng_i, 1.0 / m
+                    )
                 if self.tracer is not None:
                     self.tracer.record("fwd", j, i, y)
                 cur_states[j] = new_state
@@ -293,16 +317,17 @@ class Pipeline:
                 rng_i = jax.random.fold_in(rng, i) if rng is not None else None
                 checkpointed = i < checkpoint_stop
                 state_in = cur_states[j]
-                if checkpointed:
-                    y, ext, new_state = stage.fwd_ckpt(
-                        params[j], state_in, x, skips_in, rng_i, 1.0 / m
-                    )
-                    saved[(i, j)] = (x, skips_in, state_in, rng_i)
-                else:
-                    y, ext, new_state, pull = stage.fwd_vjp(
-                        params[j], state_in, x, skips_in, rng_i, 1.0 / m
-                    )
-                    pulls[(i, j)] = pull
+                with _cell_context(j, i, "forward"):
+                    if checkpointed:
+                        y, ext, new_state = stage.fwd_ckpt(
+                            params[j], state_in, x, skips_in, rng_i, 1.0 / m
+                        )
+                        saved[(i, j)] = (x, skips_in, state_in, rng_i)
+                    else:
+                        y, ext, new_state, pull = stage.fwd_vjp(
+                            params[j], state_in, x, skips_in, rng_i, 1.0 / m
+                        )
+                        pulls[(i, j)] = pull
                 if self.tracer is not None:
                     self.tracer.record("fwd", j, i, y)
                 cur_states[j] = new_state
@@ -328,18 +353,19 @@ class Pipeline:
         for cycle in reversed(cycles):
             for i, j in reversed(cycle):
                 stage = self.stages[j]
-                if (i, j) in saved:
-                    x, skips_in, state_in, rng_i = saved.pop((i, j))
-                    # Recompute-ahead: rebuild the vjp before consuming the
-                    # cotangent (reference checkpoint.py:1-19).
-                    _, _, _, pull = stage.fwd_recompute(
-                        params[j], state_in, x, skips_in, rng_i, 1.0 / m
-                    )
-                else:
-                    pull = pulls.pop((i, j))
-                gy = gys.pop((i, j))
-                gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
-                gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
+                with _cell_context(j, i, "backward"):
+                    if (i, j) in saved:
+                        x, skips_in, state_in, rng_i = saved.pop((i, j))
+                        # Recompute-ahead: rebuild the vjp before consuming
+                        # the cotangent (reference checkpoint.py:1-19).
+                        _, _, _, pull = stage.fwd_recompute(
+                            params[j], state_in, x, skips_in, rng_i, 1.0 / m
+                        )
+                    else:
+                        pull = pulls.pop((i, j))
+                    gy = gys.pop((i, j))
+                    gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
+                    gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
                 if self.tracer is not None:
                     self.tracer.record("bwd", j, i, gx)
                 acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
@@ -424,16 +450,17 @@ class Pipeline:
             skips_in = {k: skip_vals.pop((i, k)) for k in stage.ext_pop_keys}
             rng_i = jax.random.fold_in(rng, i) if rng is not None else None
             state_in = cur_states[j]
-            if i < checkpoint_stop:
-                y, ext, new_state = stage.fwd_ckpt(
-                    params[j], state_in, x, skips_in, rng_i, 1.0 / m
-                )
-                saved[(i, j)] = (x, skips_in, state_in, rng_i)
-            else:
-                y, ext, new_state, pull = stage.fwd_vjp(
-                    params[j], state_in, x, skips_in, rng_i, 1.0 / m
-                )
-                pulls[(i, j)] = pull
+            with _cell_context(j, i, "1F1B forward"):
+                if i < checkpoint_stop:
+                    y, ext, new_state = stage.fwd_ckpt(
+                        params[j], state_in, x, skips_in, rng_i, 1.0 / m
+                    )
+                    saved[(i, j)] = (x, skips_in, state_in, rng_i)
+                else:
+                    y, ext, new_state, pull = stage.fwd_vjp(
+                        params[j], state_in, x, skips_in, rng_i, 1.0 / m
+                    )
+                    pulls[(i, j)] = pull
             if self.tracer is not None:
                 self.tracer.record("fwd", j, i, y)
             cur_states[j] = new_state
@@ -454,16 +481,17 @@ class Pipeline:
 
         def do_bwd(i: int, j: int) -> None:
             stage = self.stages[j]
-            if (i, j) in saved:
-                x, skips_in, state_in, rng_i = saved.pop((i, j))
-                _, _, _, pull = stage.fwd_recompute(
-                    params[j], state_in, x, skips_in, rng_i, 1.0 / m
-                )
-            else:
-                pull = pulls.pop((i, j))
-            gy = gys.pop((i, j))
-            gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
-            gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
+            with _cell_context(j, i, "1F1B backward"):
+                if (i, j) in saved:
+                    x, skips_in, state_in, rng_i = saved.pop((i, j))
+                    _, _, _, pull = stage.fwd_recompute(
+                        params[j], state_in, x, skips_in, rng_i, 1.0 / m
+                    )
+                else:
+                    pull = pulls.pop((i, j))
+                gy = gys.pop((i, j))
+                gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
+                gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
             if self.tracer is not None:
                 self.tracer.record("bwd", j, i, gx)
             acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
