@@ -75,8 +75,13 @@ def causal_lm_loss(out, tokens):
 @click.option("--tp", default=1,
               help="tensor-parallel mesh axis size (spmd engine; needs "
                    "n_stages*ep*tp devices)")
+@click.option("--dp", default=1,
+              help="data-parallel mesh axis size (spmd engine)")
+@click.option("--fsdp/--no-fsdp", default=False,
+              help="ZeRO-3-style parameter sharding over the dp axis "
+                   "(spmd engine; needs --dp > 1)")
 def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
-         checkpoint, moe_experts, moe_top_k, ep, tp):
+         checkpoint, moe_experts, moe_top_k, ep, tp, dp, fsdp):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     dim, n_layers, n_heads, n_kv, vocab = PRESETS[preset]
@@ -96,6 +101,10 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
         raise click.UsageError(
             "--tp needs the spmd engine (tensor-parallel mesh axis)"
         )
+    if (dp > 1 or fsdp) and engine != "spmd":
+        raise click.UsageError("--dp/--fsdp need the spmd engine")
+    if fsdp and dp <= 1:
+        raise click.UsageError("--fsdp shards over the dp lanes: pass --dp > 1")
     moe = None
     if moe_experts:
         from torchgpipe_tpu.models.moe import MoEConfig
@@ -109,7 +118,7 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
     if engine == "spmd":
         tput = _run_spmd(
             cfg, n, chunks, x, epochs, steps, checkpoint, experiment, moe,
-            ep, tp,
+            ep, tp, dp, fsdp,
         )
     else:
         if moe is not None:
@@ -167,7 +176,7 @@ def _print_router_stats(params, h, moe):
 
 
 def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
-              ep=1, tp=1):
+              ep=1, tp=1, dp=1, fsdp=False):
     from benchmarks.common import run_epoch_loop
     from torchgpipe_tpu.models.transformer import llama_spmd
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
@@ -178,12 +187,14 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
         block, pre, post = llama_moe_spmd(cfg, moe, n)
     else:
         block, pre, post = llama_spmd(cfg, n)
-    mesh = make_mesh(n, ep=ep, tp=tp)
+    mesh = make_mesh(n, dp=dp, ep=ep, tp=tp)
     pipe = SpmdGPipe(
         block, n, mesh, chunks=chunks, loss_fn=cross_entropy,
         pre=pre, post=post, checkpoint=checkpoint,
+        dp_axis="dp" if dp > 1 else None,
         ep_axis="ep" if ep > 1 else None,
         tp_axis="tp" if tp > 1 else None,
+        fsdp=fsdp,
     )
     # SpmdGPipe shards data over the mesh; the causal shift happens on the
     # host so inputs/targets ride the same sharding specs.

@@ -224,6 +224,17 @@ def test_llama_speed_driver_tp():
     assert "FINAL | llama-speed pipeline-2 [tiny, spmd, dense]" in out
 
 
+def test_llama_speed_driver_fsdp():
+    from benchmarks.llama_speed import main
+
+    out = _invoke(main, [
+        "pipeline-2", "--preset", "tiny", "--engine", "spmd", "--epochs", "1",
+        "--steps", "1", "--seq", "33", "--batch", "8", "--no-bf16",
+        "--dp", "2", "--fsdp",
+    ])
+    assert "FINAL | llama-speed pipeline-2 [tiny, spmd, dense]" in out
+
+
 def test_bench_entry_cpu_smoke():
     """bench.py (the driver's metric entry point) runs end to end on CPU and
     emits exactly one well-formed JSON line."""

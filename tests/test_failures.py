@@ -8,9 +8,17 @@ engine additionally names the offending (stage, micro-batch) cell via an
 exception note (PEP 678).
 """
 
+import sys
+
 import jax
 import jax.numpy as jnp
 import pytest
+
+# PEP 678 exception notes need Python >= 3.11; on 3.10 (supported per
+# pyproject) _cell_context degrades to propagation without the note.
+notes_supported = pytest.mark.skipif(
+    sys.version_info < (3, 11), reason="exception notes need Python 3.11+"
+)
 
 from torchgpipe_tpu.gpipe import GPipe
 from torchgpipe_tpu.layers import Layer
@@ -56,6 +64,7 @@ def _build(armed, schedule="gpipe", tracer=None):
     return model, params, state, x, y
 
 
+@notes_supported
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 def test_exception_propagates_naming_the_stage(schedule):
     armed = {"on": False}
@@ -86,6 +95,7 @@ def test_early_stop_upstream_dispatch():
     assert not any(ev.name == "bwd" for ev in tracer.events)
 
 
+@notes_supported
 def test_forward_only_also_propagates():
     armed = {"on": False}
     model, params, state, x, _ = _build(armed)

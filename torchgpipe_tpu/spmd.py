@@ -174,6 +174,15 @@ class SpmdGPipe:
         letting the engine shard the head + loss over the ``pp`` axis (1/n
         of the logits per device).  Pass ``None`` for a non-decomposable
         loss — the head/loss then run replicated on the full batch.
+      fsdp: ZeRO-3/FSDP-style parameter sharding (new capability — the
+        reference lists ZeRO/FSDP as absent, SURVEY.md §2.2): block
+        parameters are STORED sharded over the ``dp`` axis (each leaf's
+        first eligible dim), all-gathered once per step at use, and their
+        gradients come back as shards via the all_gather's transpose (a
+        reduce-scatter) — per-device parameter + gradient memory drops by
+        ~the dp size for one gather/scatter pair per step over ICI.
+        Requires ``dp_axis``; incompatible with ``ep_axis`` (expert leaves
+        are already dp-style sharded over ep).
     """
 
     block: Layer
@@ -195,6 +204,7 @@ class SpmdGPipe:
     tp_axis: Optional[str] = None
     ep_axis: Optional[str] = None
     loss_reduction: Optional[str] = "mean"
+    fsdp: bool = False
 
     def __post_init__(self):
         if self.pp_axis not in self.mesh.axis_names:
@@ -221,6 +231,16 @@ class SpmdGPipe:
             raise ValueError(
                 "SPMD engine supports checkpoint="
                 "'always'|'except_last'|'never'"
+            )
+        if self.fsdp and self.dp_axis is None:
+            raise ValueError(
+                "fsdp shards parameters over the data-parallel lanes: set "
+                "dp_axis (and give the mesh a dp axis of size > 1)"
+            )
+        if self.fsdp and self.ep_axis is not None:
+            raise ValueError(
+                "fsdp + ep is not supported: expert weights are already "
+                "sharded over ep; shard the rest with tp instead"
             )
         if self.sp_axis is not None and self.loss_reduction is None:
             raise ValueError(
@@ -294,6 +314,64 @@ class SpmdGPipe:
         )
         self._train_step_fns: dict = {}  # keyed by use_rng
         self._apply_fn = None
+        # FSDP bookkeeping, resolved lazily from the first params tree seen
+        # (leaf shapes are needed to pick shard dims): per block leaf, the
+        # dim sharded over dp (-1 = replicated) and the augmented specs.
+        self._fsdp_dims = None
+        self._fsdp_specs = None
+
+    # ------------------------------------------------------------------ #
+    # FSDP (ZeRO-3-style parameter sharding over dp)                     #
+    # ------------------------------------------------------------------ #
+
+    def _ensure_fsdp(self, blocks: Pytree) -> None:
+        if not self.fsdp or self._fsdp_dims is not None:
+            return
+        dp = self.mesh.shape[self.dp_axis]
+        base = self._blocks_leaf_specs(blocks)
+        is_p = lambda x: isinstance(x, P)  # noqa: E731
+
+        def choose(spec, leaf):
+            # First dim after the stacked-stage dim (0) that no other axis
+            # shards and that divides by dp; small/indivisible leaves (e.g.
+            # norm scales) stay replicated.
+            for i in range(1, len(leaf.shape)):
+                taken = spec[i] if i < len(spec) else None
+                if taken is None and leaf.shape[i] % dp == 0 and leaf.shape[i] >= dp:
+                    return i
+            return -1
+
+        self._fsdp_dims = jax.tree_util.tree_map(
+            choose, base, blocks, is_leaf=is_p
+        )
+
+        def augment(spec, dim):
+            if dim < 0:
+                return spec
+            parts = list(spec) + [None] * (dim + 1 - len(spec))
+            parts[dim] = self.dp_axis
+            return P(*parts)
+
+        self._fsdp_specs = jax.tree_util.tree_map(
+            augment, base, self._fsdp_dims, is_leaf=is_p
+        )
+
+    def _gather_fsdp(self, blocks_local: Pytree) -> Pytree:
+        """Reassemble full block params from dp shards (inside shard_map).
+
+        Differentiated: the all_gather's transpose is a psum_scatter, so
+        each lane's gradient comes back as its shard, already summed over
+        the dp lanes — the FSDP reduce-scatter for free.
+        """
+        return jax.tree_util.tree_map(
+            lambda leaf, dim: (
+                leaf
+                if dim < 0
+                else lax.all_gather(leaf, self.dp_axis, axis=dim, tiled=True)
+            ),
+            blocks_local,
+            self._fsdp_dims,
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -382,7 +460,11 @@ class SpmdGPipe:
         if "post" in params:
             trees.append(("post", self._post_spec))
         for k, prefix in trees:
-            specs = self._leaf_specs(prefix, params[k], k)
+            if k == "blocks" and self.fsdp:
+                self._ensure_fsdp(params[k])
+                specs = self._fsdp_specs
+            else:
+                specs = self._leaf_specs(prefix, params[k], k)
             self._check_spec_shapes(params[k], specs)
             out[k] = jax.tree_util.tree_map(
                 lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
@@ -588,7 +670,12 @@ class SpmdGPipe:
                         x_in = self._apply_pre(params["pre"], x_mb, rng, True)
                 else:
                     x_in = x_mb
-                ys = self._local_pipeline(params["blocks"], x_in, rng, True)
+                blocks_in = (
+                    self._gather_fsdp(params["blocks"])
+                    if self.fsdp
+                    else params["blocks"]
+                )
+                ys = self._local_pipeline(blocks_in, x_in, rng, True)
                 outs = self._outputs_from_ticks(ys)
                 gathered = microbatch.gather_stacked(outs)
                 tgt = microbatch.gather_stacked(tgt_mb)
@@ -671,7 +758,24 @@ class SpmdGPipe:
                 grads["post"] = lax.psum(grads["post"], self.pp_axis)
             if self.dp_axis:
                 loss = lax.pmean(loss, self.dp_axis)
-                grads = lax.pmean(grads, self.dp_axis)
+                if self.fsdp:
+                    # FSDP block leaves arrive as shards already SUMMED over
+                    # dp (the all_gather transpose); divide for the pmean
+                    # semantics every other leaf gets.
+                    dpn = self.mesh.shape[self.dp_axis]
+                    grads = dict(grads)
+                    grads["blocks"] = jax.tree_util.tree_map(
+                        lambda g, dim: (
+                            lax.pmean(g, self.dp_axis) if dim < 0 else g / dpn
+                        ),
+                        grads["blocks"],
+                        self._fsdp_dims,
+                    )
+                    for k in ("pre", "post"):
+                        if k in grads:
+                            grads[k] = lax.pmean(grads[k], self.dp_axis)
+                else:
+                    grads = lax.pmean(grads, self.dp_axis)
             if self.ep_axis:
                 # ep shards the batch like an extra dp axis, but expert
                 # weights are *sharded* over it: their lane-local grads
@@ -706,7 +810,9 @@ class SpmdGPipe:
                 grads = red(grads, self.sp_axis)
             return loss, grads
 
-        param_specs = {"blocks": self._blocks_spec}
+        param_specs = {
+            "blocks": self._fsdp_specs if self.fsdp else self._blocks_spec
+        }
         if self.pre is not None:
             param_specs["pre"] = self._pre_spec
         if self.post is not None:
@@ -760,6 +866,8 @@ class SpmdGPipe:
         engine); omit it for deterministic models.
         """
         self._check_batch(x, target)
+        if self.fsdp:
+            self._ensure_fsdp(params["blocks"])
         use_rng = rng is not None
         if use_rng not in self._train_step_fns:
             self._train_step_fns[use_rng] = self._build_train_step(use_rng)
@@ -784,7 +892,12 @@ class SpmdGPipe:
             stage = lax.axis_index(self.pp_axis)
             if self.pre is not None:
                 x_mb = self._apply_pre(params["pre"], x_mb, None, False)
-            ys = self._local_pipeline(params["blocks"], x_mb, None, False)
+            blocks_in = (
+                self._gather_fsdp(params["blocks"])
+                if self.fsdp
+                else params["blocks"]
+            )
+            ys = self._local_pipeline(blocks_in, x_mb, None, False)
             outs = self._outputs_from_ticks(ys)  # [m, b_local, ...]
             if self.post is not None:
                 outs = jax.vmap(
@@ -800,7 +913,9 @@ class SpmdGPipe:
                 lambda a: lax.psum(a, self.pp_axis), masked
             )
 
-        param_specs = {"blocks": self._blocks_spec}
+        param_specs = {
+            "blocks": self._fsdp_specs if self.fsdp else self._blocks_spec
+        }
         if self.pre is not None:
             param_specs["pre"] = self._pre_spec
         if self.post is not None:
@@ -817,6 +932,8 @@ class SpmdGPipe:
     def apply(self, params, x):
         """Pipelined inference forward; returns gathered outputs ``[B, ...]``."""
         self._check_batch(x)
+        if self.fsdp:
+            self._ensure_fsdp(params["blocks"])
         if self._apply_fn is None:
             self._apply_fn = self._build_apply()
         x_mb = microbatch.scatter_stacked(x, self.chunks)
