@@ -113,10 +113,10 @@ def _build_amoebanet(platform: str, n_stages: int):
         compute_dtype = None
     layers = amoebanetd(num_classes=1000, num_layers=num_layers,
                         num_filters=num_filters)
-    # fused=False pinned: per-cell async dispatch measured 2x faster than
-    # whole-step fusion on the remote chip (65.9 vs 32.4 samples/s, and the
-    # monolithic program compiled 18 minutes — BENCH_NOTES.md finding #1).
-    # Without the pin _use_fused() would auto-select fused on a single chip.
+    # fused=False pinned explicitly (also the library default): per-cell
+    # async dispatch measured 2x faster than whole-step fusion on the remote
+    # chip (65.9 vs 32.4 samples/s, 18-minute fused compile — BENCH_NOTES.md
+    # finding #1).
     model = GPipe(layers, balance=_even_balance(len(layers), n_stages),
                   chunks=chunks, checkpoint="except_last",
                   compute_dtype=compute_dtype, fused=False)

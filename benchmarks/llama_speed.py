@@ -71,10 +71,10 @@ def causal_lm_loss(out, tokens):
 @click.option("--moe-top-k", default=2)
 @click.option("--ep", default=1,
               help="expert-parallel mesh axis size (spmd engine; needs "
-                   "n_stages*ep*tp devices)")
+                   "n_stages*dp*ep*tp devices)")
 @click.option("--tp", default=1,
               help="tensor-parallel mesh axis size (spmd engine; needs "
-                   "n_stages*ep*tp devices)")
+                   "n_stages*dp*ep*tp devices)")
 @click.option("--dp", default=1,
               help="data-parallel mesh axis size (spmd engine)")
 @click.option("--fsdp/--no-fsdp", default=False,

@@ -134,6 +134,31 @@ def test_fsdp_llama_composition(cpu_devices):
     )
 
 
+def test_fsdp_optimizer_state_inherits_sharding(cpu_devices):
+    """adamw moments built with zeros_like inherit the dp-sharded layout, so
+    optimizer memory also drops by ~dp — and training still converges."""
+    import optax
+
+    pipe, params, _, _, _ = _run(True, cpu_devices)
+    opt = optax.adamw(1e-2)
+    opt_state = pipe.place_tree(opt.init(params))
+    w_spec = params["blocks"][1]["w"].sharding.spec
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (16, 8))
+    losses = []
+    for _ in range(5):
+        loss, grads = pipe.train_step(params, x, tgt)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    # Params AND adam moments stayed dp-sharded through real optax updates.
+    assert params["blocks"][1]["w"].sharding.spec == w_spec
+    mu = opt_state[0].mu["blocks"][1]["w"]
+    assert mu.sharding.spec == w_spec, mu.sharding
+
+
 def test_fsdp_requires_dp_axis(cpu_devices):
     mesh = make_mesh(2, 1, devices=cpu_devices[:2])
     with pytest.raises(ValueError, match="dp_axis"):

@@ -73,20 +73,26 @@ def test_fused_matches_per_cell_inference():
     np.testing.assert_allclose(np.asarray(of), np.asarray(op), rtol=1e-5, atol=1e-6)
 
 
-def test_auto_fuse_only_on_single_device():
+def test_fused_is_opt_in():
     # Multi-device placement keeps the per-cell scheduler (dispatch overlap
     # is what pipelines stages across chips); single-device auto-fuses.
     multi = GPipe(_layers(), balance=[4, 3, 2], chunks=2)
     single = GPipe(_layers(), balance=[4, 3, 2], chunks=2,
                    devices=[jax.devices()[0]])
+    # Fusing is OPT-IN: hardware measurement showed the per-cell scheduler
+    # 2x faster than the monolithic program even single-device
+    # (BENCH_NOTES.md finding #1), so nothing auto-fuses.
     assert not multi._use_fused()
-    assert single._use_fused()
+    assert not single._use_fused()
+    assert GPipe(_layers(), balance=[4, 3, 2], chunks=2,
+                 devices=[jax.devices()[0]], fused=True)._use_fused()
 
 
 def test_fused_with_deferred_bn_and_mixed_precision():
     dev = [jax.devices()[0]]
     m = GPipe(_layers(), balance=[4, 3, 2], chunks=3, devices=dev,
-              deferred_batch_norm=True, compute_dtype=jnp.bfloat16)
+              deferred_batch_norm=True, compute_dtype=jnp.bfloat16,
+              fused=True)
     assert m._use_fused()
     x = jax.random.normal(jax.random.PRNGKey(6), (6, 8, 8, 3))
     y = jax.random.randint(jax.random.PRNGKey(7), (6,), 0, 5)

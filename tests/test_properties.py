@@ -84,6 +84,47 @@ def test_blockpartition_is_contiguous_cover(costs, data):
 
 
 @pytest.mark.slow
+def test_sparse_assignment_invariants():
+    """Property sweep of the sort-based dispatch bookkeeping against the
+    dense tensors: for every (t, E, k, capacity) the sparse assignment's
+    (expert, slot, keep, gate) must reproduce the dense combine tensor
+    exactly — same slots, same FCFS drops, same gate weights."""
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    from torchgpipe_tpu.models.moe import _sparse_assignment, _top_k_dispatch
+
+    rng = jax.random.PRNGKey(1)
+    for t, E, k, cap in itertools.product((4, 13), (2, 5), (1, 2), (1, 3, 64)):
+        if k > E:
+            continue
+        rng, sub = jax.random.split(rng)
+        probs = jax.nn.softmax(jax.random.normal(sub, (t, E)), -1)
+        combine, _ = _top_k_dispatch(probs, k, cap)
+        experts, gates, keep, slot = _sparse_assignment(probs, k, cap)
+        # Rebuild the dense combine tensor from the sparse assignment.
+        rebuilt = jnp.zeros((t, E, cap))
+        tok = jnp.arange(k * t) % t
+        w = gates * keep.astype(gates.dtype)
+        rebuilt = rebuilt.at[tok, experts, slot].add(w)
+        np.testing.assert_allclose(
+            np.asarray(rebuilt), np.asarray(combine), rtol=1e-6, atol=1e-7
+        )
+        # Structural invariants of the assignment itself.
+        e_np = np.asarray(experts)
+        s_np = np.asarray(slot)
+        keep_np = np.asarray(keep)
+        assert (s_np[keep_np] < cap).all()
+        pairs = set()
+        for e, s_, kp in zip(e_np, s_np, keep_np):
+            if kp:
+                assert (e, s_) not in pairs, "slot assigned twice"
+                pairs.add((e, s_))
+
+
+@pytest.mark.slow
 def test_moe_dispatch_invariants():
     """Property sweep of the MoE dispatch tensors: combine weights are
     nonnegative, per-token totals never exceed 1 (equal 1 when no slot

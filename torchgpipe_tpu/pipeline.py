@@ -660,14 +660,13 @@ class Pipeline:
 
         Semantically identical to :meth:`run_train` (same cell math, same
         checkpoint policy via ``jax.checkpoint`` per cell, same gathered
-        loss), but with a single device dispatch instead of one per cell —
-        the TPU-native answer to the reference's worker threads when all
-        stages share a chip: XLA schedules the whole step, so host/dispatch
-        latency (dominant on remote-attached TPUs) is paid once.  Used
-        automatically by :class:`torchgpipe_tpu.gpipe.GPipe` when every stage
-        maps to the same device; the per-cell scheduler remains the
-        multi-device path (its dispatch pipelining is what overlaps stages
-        across chips).
+        loss), but with a single device dispatch instead of one per cell:
+        XLA schedules the whole step, so host/dispatch latency is paid
+        once.  OPT-IN via ``GPipe(fused=True)`` (single-device only) — on
+        hardware the per-cell path measured 2x faster even on a
+        remote-attached chip (BENCH_NOTES.md finding #1: JAX's async
+        dispatch already keeps the chip saturated, and the monolithic
+        program compiles far slower), so nothing auto-fuses.
         """
         m = len(mbatches)
         fn = self._fused_jit(
