@@ -257,6 +257,9 @@ def main() -> None:
         "unit": "samples/sec/chip",
         "vs_baseline": vs,
         "mfu": mfu,
+        # Machine-readable platform: 'tpu' marks a real hardware number;
+        # 'cpu' marks the smoke/fallback path (vs_baseline null there).
+        "platform": platform,
     }))
 
 

@@ -74,8 +74,6 @@ def test_fused_matches_per_cell_inference():
 
 
 def test_fused_is_opt_in():
-    # Multi-device placement keeps the per-cell scheduler (dispatch overlap
-    # is what pipelines stages across chips); single-device auto-fuses.
     multi = GPipe(_layers(), balance=[4, 3, 2], chunks=2)
     single = GPipe(_layers(), balance=[4, 3, 2], chunks=2,
                    devices=[jax.devices()[0]])

@@ -64,8 +64,8 @@ class GPipe:
         checkpoint: str = "except_last",
         deferred_batch_norm: bool = False,
         compute_dtype: Optional[Any] = None,  # a jnp dtype, e.g. jnp.bfloat16
-        fused: Optional[bool] = None,  # truthy = whole-step program (opt-in;
-        # per-cell scheduling measured faster on hardware, see _use_fused)
+        fused: bool = False,  # opt-in whole-step program (per-cell
+        # scheduling measured faster on hardware, see _use_fused)
         schedule: str = "gpipe",  # 'gpipe' (fill-drain) | '1f1b'
         loss_reduction: Optional[str] = None,  # 'mean'|'sum'; required by 1f1b
         tracer=None,
