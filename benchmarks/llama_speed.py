@@ -39,10 +39,14 @@ EXPERIMENTS = {
 }
 
 PRESETS = {
-    # dim, n_layers, n_heads, n_kv_heads, vocab
-    "tiny": (256, 8, 8, 4, 1024),
-    "1b": (2048, 16, 32, 8, 128256),
-    "llama3-8b": (4096, 32, 32, 8, 128256),
+    # dim, n_layers, n_heads, n_kv_heads, vocab, mlp_ratio.
+    # TransformerConfig.mlp_hidden applies the SwiGLU 2/3 factor, so
+    # hidden = 2*ratio*dim/3 (rounded to 128): the published Llama hidden
+    # sizes need ratio 5.25 (8B: 14336 = 2*5.25*4096/3) and 6.0
+    # (3.2-1B: 8192 = 2*6*2048/3).
+    "tiny": (256, 8, 8, 4, 1024, 4.0),
+    "1b": (2048, 16, 32, 8, 128256, 6.0),
+    "llama3-8b": (4096, 32, 32, 8, 128256, 5.25),
 }
 
 
@@ -84,10 +88,11 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
          checkpoint, moe_experts, moe_top_k, ep, tp, dp, fsdp):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
-    dim, n_layers, n_heads, n_kv, vocab = PRESETS[preset]
+    dim, n_layers, n_heads, n_kv, vocab, mlp_ratio = PRESETS[preset]
     cfg = TransformerConfig(
         vocab=vocab, dim=dim, n_layers=n_layers, n_heads=n_heads,
-        n_kv_heads=n_kv, dtype=jnp.bfloat16 if bf16 else jnp.float32,
+        n_kv_heads=n_kv, mlp_ratio=mlp_ratio,
+        dtype=jnp.bfloat16 if bf16 else jnp.float32,
         tp_axis="tp" if tp > 1 else None,
     )
     if ep > 1 and engine != "spmd":

@@ -260,3 +260,21 @@ def test_bench_entry_cpu_smoke():
     assert rec["value"] > 0
     assert "cpu" in rec["metric"]
     assert rec["vs_baseline"] is None  # per-chip baseline is TPU-only
+
+
+def test_llama_preset_mlp_hidden_fidelity():
+    """The llama3-8b / 1b presets must reproduce the published MLP hidden
+    sizes through TransformerConfig's SwiGLU 2/3 scaling."""
+    import jax.numpy as jnp
+
+    from benchmarks.llama_speed import PRESETS
+    from torchgpipe_tpu.models.transformer import TransformerConfig
+
+    want = {"llama3-8b": 14336, "1b": 8192}
+    for name, hidden in want.items():
+        dim, n_layers, n_heads, n_kv, vocab, ratio = PRESETS[name]
+        cfg = TransformerConfig(
+            vocab=vocab, dim=dim, n_layers=n_layers, n_heads=n_heads,
+            n_kv_heads=n_kv, mlp_ratio=ratio, dtype=jnp.bfloat16,
+        )
+        assert cfg.mlp_hidden == hidden, (name, cfg.mlp_hidden, hidden)

@@ -236,3 +236,32 @@ def test_interleaved_virtual_stages():
         layers, flat_p, flat_s, jax.device_put(x, dev0), train=False
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_prefetch_to_device_order_and_placement():
+    """prefetch_to_device yields every batch, in order, already committed
+    to the requested device, advancing the source at most `size` ahead."""
+    from torchgpipe_tpu.utils.data import prefetch_to_device
+
+    pulled = []
+
+    def source():
+        for i in range(6):
+            pulled.append(i)
+            yield {"x": jnp.full((2,), i), "y": jnp.full((1,), -i)}
+
+    dev = jax.devices()[-1]
+    out = []
+    it = prefetch_to_device(source(), size=2, device=dev)
+    first = next(it)
+    # After one yield the pipeline holds at most size items beyond it.
+    assert len(pulled) <= 3, pulled
+    out.append(first)
+    out.extend(it)
+    assert len(out) == 6
+    for i, batch in enumerate(out):
+        assert int(batch["x"][0]) == i
+        assert batch["x"].devices() == {dev}
+
+    with pytest.raises(ValueError):
+        list(prefetch_to_device(source(), size=0))

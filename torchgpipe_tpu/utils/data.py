@@ -1,0 +1,54 @@
+"""Input-pipeline utilities: device prefetching.
+
+The reference's data story is the rank-aware
+``DistributedGPipeDataLoader`` (reference: torchgpipe/distributed/
+gpipe.py:197-275, mirrored in :mod:`torchgpipe_tpu.distributed`); on TPU
+the other half of the story is keeping the host→device copy off the
+critical path.  ``jax.device_put`` is asynchronous, so holding a small
+queue of already-transferred batches overlaps the next batch's transfer
+(and any host-side preprocessing in the iterator) with the current step's
+compute — the standard double-buffering recipe.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+
+Pytree = Any
+
+
+def prefetch_to_device(
+    iterable: Iterable[Pytree],
+    size: int = 2,
+    device: Optional[Any] = None,
+) -> Iterator[Pytree]:
+    """Yield batches from ``iterable`` with ``size`` transfers in flight.
+
+    Each batch (any pytree of arrays) is committed to ``device`` (or a
+    ``NamedSharding`` — pass the sharding object itself) before the
+    consumer needs it.  ``size=2`` double-buffers: while the training step
+    runs on batch k, batch k+1's host→device copy is already underway.
+
+    The iterator is advanced at most ``size`` items ahead, so host-side
+    memory is bounded and generator-backed loaders see backpressure.
+    """
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    it = iter(iterable)
+    queue: collections.deque = collections.deque()
+
+    def enqueue(n: int) -> None:
+        for _ in range(n):
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            queue.append(jax.device_put(item, device))
+
+    enqueue(size)
+    while queue:
+        yield queue.popleft()
+        enqueue(1)
