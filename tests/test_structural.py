@@ -102,10 +102,11 @@ def test_spmd_program_structure():
 
 def test_spmd_except_last_program_structure(cpu_devices):
     """'except_last' peels the schedule: a remat'd scan over the first m-1
-    ticks plus n unrolled stage-conditional ticks (one lax.cond each, whose
-    taken branch for the owning stage is the UN-remat'd block).  The program
-    must contain the conds and still carry remat regions for the non-last
-    cells — and 'always' must contain no cond at all."""
+    ticks plus a second scan over the final n ticks whose body is a single
+    stage-conditional lax.cond (taken branch for the owning stage = the
+    UN-remat'd block; block traced twice total, not 2n times).  The program
+    must contain the cond, at least two scans, and still carry remat
+    regions for the non-last cells — and 'always' must contain no cond."""
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
     from torchgpipe_tpu.layers import chain
     from torchgpipe_tpu.ops import dense, gelu, layer_norm
@@ -131,12 +132,14 @@ def test_spmd_except_last_program_structure(cpu_devices):
     jx_al = jaxpr_of("always")
     n_cond_el = _count_eqns(jx_el.jaxpr, ("cond",))
     n_cond_al = _count_eqns(jx_al.jaxpr, ("cond",))
-    # One stage-owned cond per unrolled drain tick (forward); the grad
-    # transpose adds more — require at least the forward n.
-    assert n_cond_el >= n, f"expected >= {n} conds, found {n_cond_el}"
+    # ONE stage-owned cond inside the tail scan's body (forward); the grad
+    # transpose adds more.  The count must NOT scale with n — that would
+    # mean the tail went back to Python unrolling (n block-body copies).
+    assert 1 <= n_cond_el < n, f"expected 1..{n - 1} conds, found {n_cond_el}"
     assert n_cond_al == 0
     assert _count_eqns(jx_el.jaxpr, REMAT) >= 1
-    assert _count_eqns(jx_el.jaxpr, ("scan",)) >= 1
+    # Prefix scan + tail scan (+ backward scans from the transpose).
+    assert _count_eqns(jx_el.jaxpr, ("scan",)) >= 2
 
 
 def test_spmd_tp_ep_program_structure(cpu_devices):

@@ -536,11 +536,13 @@ class SpmdGPipe:
         ``checkpoint='except_last'`` (reference gpipe.py:360-367) peels the
         schedule: ticks ``0..m-2`` — whose cells all belong to micro-batches
         ``< m-1`` — stay inside a remat'd ``lax.scan``, and the final ``n``
-        ticks are unrolled.  At unrolled tick ``t`` exactly one stage
-        (``t - (m-1)``) computes the LAST micro-batch's cell; a ``lax.cond``
-        on the stage index runs that cell un-remat'd (its residuals are
-        saved, no recompute in backward) while the drain-phase cells of
-        earlier micro-batches on the other stages keep the remat policy.
+        ticks run in a second scan whose body is one ``lax.cond`` on the
+        stage index.  At tail tick ``t`` exactly one stage (``t - (m-1)``)
+        computes the LAST micro-batch's cell and takes the un-remat'd
+        branch (its residuals are saved, no recompute in backward) while
+        the drain-phase cells of earlier micro-batches on the other stages
+        keep the remat policy.  The scan keeps the block traced twice
+        total (once per branch) — compile time independent of ``n``.
         """
         n, m = self.n_stages, self.chunks
         stage = lax.axis_index(self.pp_axis)
@@ -587,24 +589,29 @@ class SpmdGPipe:
             # Remat'd prefix: every cell in ticks 0..m-2 is micro-batch
             # < m-1 (or fill garbage).  Zero-length scan (m == 1) is fine.
             act, ys_scan = lax.scan(tick, act0, jnp.arange(m - 1))
-            ys_tail = []
-            for t in range(m - 1, T):
+
+            # Peeled tail as a SECOND scan (not a Python unroll): the block
+            # body is traced twice total — once per cond branch — instead
+            # of 2n times, so compile time stays independent of the
+            # pipeline depth.  Residual behavior is identical: the scan
+            # stacks each tick's cond residuals, exactly what the unrolled
+            # form stored.
+            def tail_tick(act, t):
                 x_in, key, valid_scale = cell_input(act, t)
                 own = t - (m - 1)  # the stage whose cell is micro-batch m-1
 
-                def plain_cell(x, key=key, valid_scale=valid_scale):
+                def plain_cell(x):
                     with aux_scale(valid_scale):
                         return self._block_fn_plain(params_local, x, key, train)
 
-                def remat_cell(x, key=key, valid_scale=valid_scale):
+                def remat_cell(x):
                     with aux_scale(valid_scale):
                         return self._block_fn(params_local, x, key, train)
 
-                act = lax.cond(stage == own, plain_cell, remat_cell, x_in)
-                ys_tail.append(act)
-            ys_tail = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack(xs), *ys_tail
-            )
+                y = lax.cond(stage == own, plain_cell, remat_cell, x_in)
+                return y, y
+
+            _, ys_tail = lax.scan(tail_tick, act, jnp.arange(m - 1, T))
             return jax.tree_util.tree_map(
                 lambda a, b: jnp.concatenate([a, b], axis=0), ys_scan, ys_tail
             )
