@@ -102,7 +102,7 @@ def test_graft_entry_single_chip():
 
     fn, args = __graft_entry__.entry()
     out = jax.jit(fn)(*args)
-    assert out.shape == (2, 64, 1024)
+    assert out.shape == (2, 128, 1024)
 
 
 @pytest.mark.slow

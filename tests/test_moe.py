@@ -327,6 +327,40 @@ def test_aux_grad_scale_spmd_chunk_invariant(cpu_devices):
     np.testing.assert_allclose(grads_p[0], w, rtol=1e-6)
 
 
+def test_aux_grad_exact_under_except_last(cpu_devices):
+    """The injected aux coefficient must be identical across checkpoint
+    modes — in particular through except_last's peeled tail, where the
+    validity scale runs inside the stage-conditional cond branches."""
+    from torchgpipe_tpu.layers import chain
+    from torchgpipe_tpu.ops import dense
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    w = 0.25
+    grads_by_mode = {}
+    for mode in ("always", "except_last", "never"):
+        block = chain([dense(8, name="fc"), _aux_probe_layer(w)], name="blk")
+        mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+        pipe = SpmdGPipe(
+            block, 2, mesh, chunks=3,
+            loss_fn=lambda o, t: jnp.mean((o - t) ** 2),
+            checkpoint=mode,
+        )
+        params = pipe.init(
+            jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, 8), jnp.float32)
+        )
+        x = jax.random.normal(jax.random.PRNGKey(1), (12, 8))
+        tgt = jax.random.normal(jax.random.PRNGKey(2), (12, 8))
+        _, grads = pipe.train_step(params, x, tgt)
+        grads_by_mode[mode] = np.asarray(grads["blocks"][1]["p"])
+    np.testing.assert_allclose(
+        grads_by_mode["except_last"], grads_by_mode["always"], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        grads_by_mode["never"], grads_by_mode["always"], rtol=1e-6
+    )
+    np.testing.assert_allclose(grads_by_mode["always"], w, rtol=1e-6)
+
+
 def test_router_stats_balance():
     cfg = _cfg()
     moe = MoEConfig(n_experts=4, top_k=1)

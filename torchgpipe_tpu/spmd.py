@@ -283,8 +283,15 @@ class SpmdGPipe:
 
         raw_apply = self.block.apply
 
-        def block_fn(params, x, rng, train):
-            y, _ = raw_apply(params, (), x, rng=rng, train=train)
+        def block_fn(params, x, rng, aux_s, train):
+            # aux_s (the per-cell aux-gradient scale) is an explicit INPUT,
+            # not a thread-local capture: jax.checkpoint caches the traced
+            # jaxpr by avals, and a capture would freeze one schedule
+            # position's traced scale into the cache — a dead tracer when
+            # the except_last tail scan gets a cache hit on the jaxpr the
+            # prefix scan traced.
+            with aux_scale(aux_s):
+                y, _ = raw_apply(params, (), x, rng=rng, train=train)
             return y
 
         # _block_fn_plain: the un-remat'd block — the 'never' path and the
@@ -292,7 +299,7 @@ class SpmdGPipe:
         self._block_fn_plain = block_fn
         if self.checkpoint in ("always", "except_last"):
             block_fn = jax.checkpoint(
-                block_fn, static_argnums=(3,), policy=self.remat_policy
+                block_fn, static_argnums=(4,), policy=self.remat_policy
             )
         elif self.remat_policy is not None:
             raise ValueError(
@@ -581,8 +588,7 @@ class SpmdGPipe:
 
         def tick(act, t):
             x_in, key, valid_scale = cell_input(act, t)
-            with aux_scale(valid_scale):
-                y = self._block_fn(params_local, x_in, key, train)
+            y = self._block_fn(params_local, x_in, key, valid_scale, train)
             return y, y
 
         if self.checkpoint == "except_last" and train:
@@ -601,12 +607,14 @@ class SpmdGPipe:
                 own = t - (m - 1)  # the stage whose cell is micro-batch m-1
 
                 def plain_cell(x):
-                    with aux_scale(valid_scale):
-                        return self._block_fn_plain(params_local, x, key, train)
+                    return self._block_fn_plain(
+                        params_local, x, key, valid_scale, train
+                    )
 
                 def remat_cell(x):
-                    with aux_scale(valid_scale):
-                        return self._block_fn(params_local, x, key, train)
+                    return self._block_fn(
+                        params_local, x, key, valid_scale, train
+                    )
 
                 y = lax.cond(stage == own, plain_cell, remat_cell, x_in)
                 return y, y
