@@ -198,6 +198,45 @@ def test_fsdp_tp_composition(cpu_devices):
     )
 
 
+def test_fsdp_dim_chooser_invariants(cpu_devices):
+    """_ensure_fsdp's per-leaf shard-dim choice: never dim 0 (the stacked
+    stage dim), never a dim another axis already shards, always divisible
+    by dp, and -1 (replicated) when nothing qualifies."""
+    from torchgpipe_tpu.models.transformer import TransformerConfig, llama_spmd
+    from torchgpipe_tpu.spmd import broadcast_specs
+
+    pp, dp, tp = 2, 2, 2
+    cfg = TransformerConfig(vocab=64, dim=16, n_layers=pp, n_heads=4,
+                            n_kv_heads=2, tp_axis="tp")
+    block, pre, post = llama_spmd(cfg, pp)
+    mesh = make_mesh(pp, dp, tp=tp, devices=cpu_devices[: pp * dp * tp])
+    pipe = SpmdGPipe(block, pp, mesh, chunks=2, loss_fn=_mse,
+                     pre=pre, post=post, dp_axis="dp", tp_axis="tp",
+                     fsdp=True)
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, 8), jnp.int32)
+    )
+    base = broadcast_specs(pipe._blocks_spec, params["blocks"])
+    checked = sharded = 0
+
+    def chk(spec, dim, leaf):
+        nonlocal checked, sharded
+        checked += 1
+        if dim < 0:
+            return
+        sharded += 1
+        assert dim >= 1, (spec, dim, leaf.shape)
+        taken = spec[dim] if dim < len(spec) else None
+        assert taken is None, (spec, dim)
+        assert leaf.shape[dim] % dp == 0, (leaf.shape, dim)
+
+    jax.tree_util.tree_map(
+        chk, base, pipe._fsdp_dims, params["blocks"],
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+    )
+    assert checked > 0 and sharded > 0, (checked, sharded)
+
+
 def test_fsdp_requires_dp_axis(cpu_devices):
     mesh = make_mesh(2, 1, devices=cpu_devices[:2])
     with pytest.raises(ValueError, match="dp_axis"):

@@ -66,6 +66,31 @@ def test_spmd_transparency(cpu_devices, checkpoint):
     )
 
 
+def test_spmd_inference_under_except_last(cpu_devices):
+    """apply() under checkpoint='except_last' must equal the dense oracle —
+    eval bypasses checkpointing, so the peeled-tail machinery must not
+    perturb the uniform inference scan."""
+    n, dim = 4, 8
+    mesh = make_mesh(n, 1, devices=cpu_devices)
+    block = make_block(dim)
+    pipe = SpmdGPipe(block, n, mesh, chunks=4, loss_fn=mse,
+                     checkpoint="except_last", dp_axis="dp")
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, dim), jnp.float32)
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, dim))
+    out = pipe.apply(params, x)
+
+    h = jax.device_put(x, jax.devices()[0])
+    blocks = jax.device_put(params["blocks"], jax.devices()[0])
+    for j in range(n):
+        pj = jax.tree_util.tree_map(lambda a: a[j], blocks)
+        h, _ = block.apply(pj, (), h, rng=None, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(h), rtol=1e-5, atol=1e-6
+    )
+
+
 def test_spmd_remat_policy_transparency(cpu_devices):
     """A custom remat policy changes what is saved, never the math."""
     n, dim = 4, 8

@@ -265,3 +265,63 @@ def test_prefetch_to_device_order_and_placement():
 
     with pytest.raises(ValueError):
         list(prefetch_to_device(source(), size=0))
+
+
+def test_save_sharded_swap_is_process0_gated(tmp_path, monkeypatch):
+    """Multi-host overwrite protocol (unit test with a fake checkpointer):
+    every rank calls save between global barriers, but ONLY process 0
+    performs the tmp->final directory swap — a non-zero rank must neither
+    delete nor rename anything, and the branch must not depend on a
+    per-host filesystem probe."""
+    import torchgpipe_tpu.utils.serialization as ser
+
+    events = []
+
+    class _FakeCkptr:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def save(self, path, tree):
+            events.append(("save", path))
+
+        def wait_until_finished(self):
+            events.append(("wait",))
+
+    class _FakeMH:
+        @staticmethod
+        def sync_global_devices(tag):
+            events.append(("barrier", tag))
+
+    import jax.experimental as jexp
+    import orbax.checkpoint as ocp
+
+    monkeypatch.setattr(ocp, "StandardCheckpointer", lambda: _FakeCkptr())
+    monkeypatch.setattr(jexp, "multihost_utils", _FakeMH, raising=False)
+    monkeypatch.setattr(ser.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        ser.os, "rename", lambda *a: events.append(("rename", a))
+    )
+
+    path = str(tmp_path / "ckpt")
+
+    # Rank 1: saves + barriers, zero filesystem surgery.
+    monkeypatch.setattr(ser.jax, "process_index", lambda: 1)
+    events.clear()
+    ser.save_sharded(path, {"w": jnp.arange(4.0)})
+    kinds = [e[0] for e in events]
+    assert "save" in kinds and kinds.count("barrier") == 3, events
+    assert "rename" not in kinds, events
+
+    # Rank 0: the swap happens, after the post-save barrier.
+    monkeypatch.setattr(ser.jax, "process_index", lambda: 0)
+    events.clear()
+    ser.save_sharded(path, {"w": jnp.arange(4.0)})
+    kinds = [e[0] for e in events]
+    assert "rename" in kinds, events
+    # The swap must come strictly AFTER the post-save barrier (every host's
+    # shards durable) — not merely after this rank's own wait.
+    post_save_barrier = events.index(("barrier", "save_sharded:post-save"))
+    assert kinds.index("rename") > post_save_barrier, events
