@@ -17,6 +17,7 @@ Example (two shells)::
 
 from __future__ import annotations
 
+import os
 import time
 
 import click
@@ -82,9 +83,14 @@ MODELS = {
                    "hang (leave unset when stage compile times are unknown)")
 @click.option("--connect-timeout", default=120.0, type=float,
               help="rendezvous budget for dialing a peer's listener")
+@click.option("--checkpoint-dir", default=None, type=str,
+              help="crash recovery: each rank saves its partition params/"
+                   "state here after every epoch and resumes from the last "
+                   "completed epoch on restart (the reference's RPC mode "
+                   "has neither failure detection nor recovery)")
 def main(rank, world, master, port_base, model_name, balance, chunks,
          batch_size, epochs, steps, classes, image, recv_timeout,
-         connect_timeout):
+         connect_timeout, checkpoint_dir):
     layers = MODELS[model_name](classes)
     workers = [f"rank{r}" for r in range(world)]
     # Each rank listens on port_base + rank; peers dial the master host.
@@ -142,6 +148,59 @@ def main(rank, world, master, port_base, model_name, balance, chunks,
     )
     params, state = pipe.init(jax.random.PRNGKey(0), in_spec)
 
+    # Crash recovery: each rank persists ITS partition after every epoch;
+    # on restart, resume from the last epoch every rank completed.  The
+    # checkpoint records (model, world, balance, ...) and every leaf shape
+    # is validated against the fresh init, so a restart with a different
+    # partitioning fails loudly instead of loading the wrong weights.
+    ckpt_path = (
+        os.path.join(checkpoint_dir, f"rank{rank}.npz")
+        if checkpoint_dir
+        else None
+    )
+    ckpt_meta = (
+        f"{model_name}|world={world}|rank={rank}|balance={balance}|"
+        f"classes={classes}|image={image}|chunks={chunks}"
+    )
+    start_epoch = 0
+    if ckpt_path and os.path.exists(ckpt_path):
+        params, state, start_epoch = _load_rank_checkpoint(
+            ckpt_path, params, state, ckpt_meta, checkpoint_dir
+        )
+        print(f"[rank {rank}] resumed from epoch {start_epoch}", flush=True)
+    if checkpoint_dir:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        # Every rank reports its epoch to rank 0, which broadcasts either
+        # the agreed value or an abort sentinel — so a torn checkpoint set
+        # (crash between per-rank saves) makes EVERY rank exit with the
+        # same didactic message instead of some ranks hanging in the pipe
+        # waiting for a peer that aborted.
+        if rank == 0:
+            seen = {0: start_epoch}
+            for r in range(1, world):
+                seen[r] = int(
+                    transport.mailbox.get("epoch_report", r, timeout=600)
+                )
+            torn = len(set(seen.values())) != 1
+            agreed = -1 if torn else start_epoch
+            for r in range(1, world):
+                transport.send(f"rank{r}", "resume_epoch", 0, agreed)
+            if torn:
+                raise SystemExit(
+                    f"[rank 0] checkpoint epochs disagree across ranks "
+                    f"({seen}); delete {checkpoint_dir} and restart from "
+                    "scratch"
+                )
+        else:
+            transport.send("rank0", "epoch_report", rank, start_epoch)
+            agreed = int(transport.mailbox.get("resume_epoch", 0, timeout=600))
+            if agreed < 0:
+                raise SystemExit(
+                    f"[rank {rank}] checkpoint epochs disagree across "
+                    f"ranks; delete {checkpoint_dir} and restart from "
+                    "scratch"
+                )
+
     # Only rank 0 feeds data (the loader ships targets to the last rank).
     data = (
         [make_batch(jax.random.PRNGKey(100 + s)) for s in range(steps)]
@@ -155,7 +214,7 @@ def main(rank, world, master, port_base, model_name, balance, chunks,
     )
 
     t0 = time.time()
-    for epoch in range(epochs):
+    for epoch in range(start_epoch, epochs):
         for step, (xb, yb) in enumerate(loader):
             key = jax.random.fold_in(jax.random.PRNGKey(7), epoch * steps + step)
             outs = pipe.forward(params, state, xb, rng=key)
@@ -172,8 +231,70 @@ def main(rank, world, master, port_base, model_name, balance, chunks,
             params = jax.tree_util.tree_map(
                 lambda p, g: p - 0.05 * g, params, list(grads)
             )
+        if ckpt_path:
+            _save_rank_checkpoint(
+                ckpt_path, params, state, epoch + 1, ckpt_meta
+            )
     transport.close()
     print(f"[rank {rank}] done", flush=True)
+
+
+def _save_rank_checkpoint(path, params, state, epoch: int, meta: str) -> None:
+    """Atomically persist this rank's partition (write-then-rename), tagged
+    with the run configuration so a mismatched restart is caught on load."""
+    import numpy as np
+
+    from torchgpipe_tpu.utils.serialization import save
+
+    leaves_p = jax.tree_util.tree_leaves(params)
+    leaves_s = jax.tree_util.tree_leaves(state)
+    payload = {f"p{i}": np.asarray(l) for i, l in enumerate(leaves_p)}
+    payload.update({f"s{i}": np.asarray(l) for i, l in enumerate(leaves_s)})
+    payload["epoch"] = np.asarray(epoch)
+    payload["meta"] = np.asarray(meta)
+    tmp = path + ".tmp.npz"  # savez appends .npz unless already suffixed
+    save(tmp, payload)
+    os.replace(tmp, path)
+
+
+def _load_rank_checkpoint(path, params, state, meta: str, ckpt_dir: str):
+    """Restore params/state into the freshly-initialized tree structure,
+    validating run configuration and every leaf shape/dtype first."""
+    from torchgpipe_tpu.utils.serialization import load
+
+    d = load(path)
+    if str(d.get("meta")) != meta:
+        raise SystemExit(
+            f"checkpoint {path} was written by a different run "
+            f"configuration:\n  saved: {d.get('meta')}\n  now:   {meta}\n"
+            f"delete {ckpt_dir} and restart from scratch"
+        )
+    init_p = jax.tree_util.tree_leaves(params)
+    init_s = jax.tree_util.tree_leaves(state)
+    want = {f"p{i}" for i in range(len(init_p))}
+    want |= {f"s{i}" for i in range(len(init_s))}
+    have = set(d) - {"epoch", "meta"}
+    if have != want:
+        raise SystemExit(
+            f"checkpoint {path} leaf set mismatch (saved {len(have)}, "
+            f"expected {len(want)}); delete {ckpt_dir} and restart"
+        )
+    leaves_p = [d[f"p{i}"] for i in range(len(init_p))]
+    leaves_s = [d[f"s{i}"] for i in range(len(init_s))]
+    for got, ref in zip(leaves_p + leaves_s, init_p + init_s):
+        if got.shape != ref.shape or got.dtype != ref.dtype:
+            raise SystemExit(
+                f"checkpoint {path} leaf {got.shape}/{got.dtype} does not "
+                f"match the model's {ref.shape}/{ref.dtype}; delete "
+                f"{ckpt_dir} and restart"
+            )
+    params = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), leaves_p
+    )
+    state = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(state), leaves_s
+    )
+    return params, state, int(d["epoch"])
 
 
 if __name__ == "__main__":

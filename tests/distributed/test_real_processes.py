@@ -119,6 +119,79 @@ def test_three_rank_tcp_training_end_to_end(tmp_path):
     assert f"[rank {world - 1}] done" in last
 
 
+def test_checkpoint_resume_across_restarts(tmp_path):
+    """Crash-recovery workflow: run 2 epochs with --checkpoint-dir, restart
+    the whole world asking for 4 — every rank resumes from epoch 2 and only
+    trains the remaining two.  (The reference's RPC mode has neither
+    failure detection nor recovery; this is the capability pair's second
+    half.)"""
+    world = 3
+    logdir = str(tmp_path)
+    ckpt = os.path.join(logdir, "ckpt")
+
+    def launch(epochs, tag):
+        port_base = _free_port_base(world)
+        sub = os.path.join(logdir, tag)
+        os.makedirs(sub, exist_ok=True)
+        procs = [
+            _spawn(r, world, port_base, sub,
+                   ["--epochs", str(epochs), "--steps", "2",
+                    "--checkpoint-dir", ckpt])
+            for r in range(world)
+        ]
+        try:
+            for proc, _ in procs:
+                assert proc.wait(timeout=420) == 0
+        finally:
+            for proc, log in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                log.close()
+        return sub
+
+    first = launch(2, "first")
+    last1 = open(os.path.join(first, f"rank{world - 1}.log")).read()
+    assert len(re.findall(r"loss ", last1)) == 4, last1  # 2 epochs x 2 steps
+    assert "resumed" not in last1
+
+    import shutil
+
+    # Preserve a rank-1 checkpoint from epoch 2 to tear the set later.
+    stale = os.path.join(logdir, "stale_rank1.npz")
+    shutil.copy(os.path.join(ckpt, "rank1.npz"), stale)
+
+    second = launch(4, "second")
+    for r in range(world):
+        log = open(os.path.join(second, f"rank{r}.log")).read()
+        assert f"[rank {r}] resumed from epoch 2" in log, log
+    last2 = open(os.path.join(second, f"rank{world - 1}.log")).read()
+    assert len(re.findall(r"loss ", last2)) == 4, last2  # epochs 3..4 only
+
+    # Torn checkpoint set (rank 1 at epoch 2, others at 4): EVERY rank must
+    # exit with the same didactic message — nobody hangs in the pipe.
+    shutil.copy(stale, os.path.join(ckpt, "rank1.npz"))
+    port_base = _free_port_base(world)
+    sub = os.path.join(logdir, "torn")
+    os.makedirs(sub, exist_ok=True)
+    procs = [
+        _spawn(r, world, port_base, sub,
+               ["--epochs", "6", "--steps", "2",
+                "--checkpoint-dir", ckpt])
+        for r in range(world)
+    ]
+    try:
+        for proc, _ in procs:
+            assert proc.wait(timeout=300) != 0, "rank proceeded on torn set"
+    finally:
+        for proc, log in procs:
+            if proc.poll() is None:
+                proc.kill()
+            log.close()
+    for r in range(world):
+        log = open(os.path.join(sub, f"rank{r}.log")).read()
+        assert "disagree" in log, (r, log)
+
+
 def test_killed_rank_surfaces_named_timeout(tmp_path):
     """Kill rank 1 after the first step completes: its neighbours must fail
     within recv/connect timeouts with a TimeoutError pointing at the dead
