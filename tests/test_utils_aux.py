@@ -94,6 +94,9 @@ def test_state_dict_roundtrip(tmp_path):
     params, state = model.init(jax.random.PRNGKey(0), in_spec)
 
     d = state_dict(model, params, state)
+    # Method spelling delegates to the same function (reference API shape).
+    d2 = model.state_dict(params, state)
+    assert sorted(d) == sorted(d2)
     # Reference-style keys: partitions.<stage>.<layer_name>...
     assert any(k.startswith("partitions.0.d0.params") for k in d)
     assert any(k.startswith("partitions.1.d1.params") for k in d)
@@ -107,7 +110,7 @@ def test_state_dict_roundtrip(tmp_path):
     # Fresh model instance (same topology), different init -> load restores.
     model2 = GPipe(_layers(), balance=[2, 2], chunks=2)
     params2, state2 = model2.init(jax.random.PRNGKey(99), in_spec)
-    params3, state3 = load_state_dict(model2, params2, state2, loaded)
+    params3, state3 = model2.load_state_dict(params2, state2, loaded)
     x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
     out_orig, _ = model.apply(params, state, x)
     out_loaded, _ = model2.apply(params3, state3, x)

@@ -219,6 +219,22 @@ class GPipe:
             for j, stage_tree in enumerate(per_stage)
         )
 
+    def state_dict(self, params, state):
+        """Flat named mapping with reference-style
+        ``partitions.<stage>.<layer>`` keys (reference: gpipe.py:257-285
+        keeps wrapped layers discoverable via ``state_dict``; here params
+        are explicit, so they are arguments rather than attributes)."""
+        from torchgpipe_tpu.utils.serialization import state_dict
+
+        return state_dict(self, params, state)
+
+    def load_state_dict(self, params, state, d):
+        """Strict inverse of :meth:`state_dict` over an initialized
+        ``(params, state)`` template; returns new placed pytrees."""
+        from torchgpipe_tpu.utils.serialization import load_state_dict
+
+        return load_state_dict(self, params, state, d)
+
     # ------------------------------------------------------------------ #
     # execution                                                          #
     # ------------------------------------------------------------------ #
