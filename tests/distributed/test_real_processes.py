@@ -23,7 +23,7 @@ import time
 
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from tests.subproc_env import REPO, cpu_subproc_env
 
 pytestmark = pytest.mark.slow
 
@@ -57,12 +57,7 @@ def _spawn(rank: int, world: int, port_base: int, logdir: str, extra):
     sitecustomize hangs pre-main under JAX_PLATFORMS=cpu (see
     tests/conftest.py), so subprocesses must not inherit it.
     """
-    env = {
-        **os.environ,
-        "PYTHONPATH": REPO,
-        "JAX_PLATFORMS": "cpu",
-        "TF_CPP_MIN_LOG_LEVEL": "3",
-    }
+    env = cpu_subproc_env()
     log = open(os.path.join(logdir, f"rank{rank}.log"), "wb")
     proc = subprocess.Popen(
         [

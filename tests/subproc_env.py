@@ -1,0 +1,24 @@
+"""Shared environment for CPU-mode subprocess tests.
+
+The container registers a TPU-tunnel plugin via a sitecustomize on
+PYTHONPATH; with ``JAX_PLATFORMS=cpu`` that sitecustomize HANGS the
+interpreter pre-main (see tests/conftest.py).  Every subprocess test must
+therefore pin PYTHONPATH to the repo root — one helper so no copy of the
+env dict can silently drop the pin.
+"""
+
+import os
+import pathlib
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def cpu_subproc_env(**extra: str) -> dict:
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        TF_CPP_MIN_LOG_LEVEL="3",
+    )
+    env.update(extra)
+    return env

@@ -10,6 +10,8 @@ import sys
 import pytest
 from click.testing import CliRunner
 
+from tests.subproc_env import cpu_subproc_env
+
 # Driver smokes are end-to-end subprocess/CLI runs - the slowest tests in
 # the suite; the fast core target (pytest -m "not slow") skips them.
 pytestmark = pytest.mark.slow
@@ -129,11 +131,7 @@ def test_distributed_driver_two_real_processes():
 
     port = free_port()
     repo = str(pathlib.Path(__file__).resolve().parents[1])
-    env = dict(
-        os.environ,
-        PYTHONPATH=repo,
-        JAX_PLATFORMS="cpu",
-    )
+    env = cpu_subproc_env()
     cmd = [
         sys.executable, "-m", "benchmarks.distributed_accuracy",
         "--world", "2", "--master", "127.0.0.1",
@@ -241,13 +239,7 @@ def test_bench_entry_cpu_smoke():
     import json
 
     repo = pathlib.Path(__file__).resolve().parents[1]
-    env = dict(os.environ)
-    env.update(
-        PYTHONPATH=str(repo),
-        JAX_PLATFORMS="cpu",
-        TGPU_SKIP_BACKEND_PROBE="1",
-        TF_CPP_MIN_LOG_LEVEL="3",
-    )
+    env = cpu_subproc_env(TGPU_SKIP_BACKEND_PROBE="1")
     r = subprocess.run(
         [sys.executable, str(repo / "bench.py")],
         capture_output=True, text=True, timeout=900, env=env, cwd=str(repo),
@@ -278,3 +270,17 @@ def test_llama_preset_mlp_hidden_fidelity():
             n_kv_heads=n_kv, mlp_ratio=ratio, dtype=jnp.bfloat16,
         )
         assert cfg.mlp_hidden == hidden, (name, cfg.mlp_hidden, hidden)
+
+
+def test_examples_quickstart():
+    """The README-advertised quickstart runs end to end on the CPU mesh."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    env = cpu_subproc_env(XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, str(repo / "examples" / "quickstart.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(repo),
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "quickstart done" in r.stdout
+    assert "[mpmd] step 4" in r.stdout
+    assert "[spmd] step 2" in r.stdout, r.stdout
