@@ -1,7 +1,6 @@
 """Benchmark-driver smoke tests: every driver runs end-to-end at toy scale
 (the reference ships its drivers untested; here CI covers them)."""
 
-import os
 import pathlib
 import socket
 import subprocess
@@ -10,7 +9,7 @@ import sys
 import pytest
 from click.testing import CliRunner
 
-from tests.subproc_env import cpu_subproc_env
+from tests.subproc_env import REPO, cpu_subproc_env
 
 # Driver smokes are end-to-end subprocess/CLI runs - the slowest tests in
 # the suite; the fast core target (pytest -m "not slow") skips them.
@@ -130,7 +129,7 @@ def test_distributed_driver_two_real_processes():
             return s.getsockname()[1]
 
     port = free_port()
-    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    repo = REPO
     env = cpu_subproc_env()
     cmd = [
         sys.executable, "-m", "benchmarks.distributed_accuracy",
@@ -238,7 +237,7 @@ def test_bench_entry_cpu_smoke():
     emits exactly one well-formed JSON line."""
     import json
 
-    repo = pathlib.Path(__file__).resolve().parents[1]
+    repo = pathlib.Path(REPO)
     env = cpu_subproc_env(TGPU_SKIP_BACKEND_PROBE="1")
     r = subprocess.run(
         [sys.executable, str(repo / "bench.py")],
@@ -274,7 +273,7 @@ def test_llama_preset_mlp_hidden_fidelity():
 
 def test_examples_quickstart():
     """The README-advertised quickstart runs end to end on the CPU mesh."""
-    repo = pathlib.Path(__file__).resolve().parents[1]
+    repo = pathlib.Path(REPO)
     env = cpu_subproc_env(XLA_FLAGS="--xla_force_host_platform_device_count=8")
     r = subprocess.run(
         [sys.executable, str(repo / "examples" / "quickstart.py")],

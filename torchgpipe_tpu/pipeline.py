@@ -132,9 +132,19 @@ class StageExec:
         self.fwd_ckpt = self._jit_with_phase(plain_fwd_train, checkpointing=True)
         self.fwd_train = self._jit_with_phase(plain_fwd_train)
         self.fwd_eval = self._jit_with_phase(plain_fwd_eval)
-        self.bwd = jax.jit(lambda pull, cot: pull(cot))
+        # Buffer donation on accelerators: the vjp closure (arg 0 of bwd) is
+        # consumed exactly once — donating lets XLA free/reuse its residual
+        # HBM as the backward consumes it; likewise the old gradient
+        # accumulator, so accumulation never holds two full gradient
+        # buffers per stage.  XLA:CPU ignores donation (and warns), so
+        # CPU-placed stages skip it — gate on THIS stage's device, not the
+        # process default backend (stages are explicitly placeable).  A
+        # memory optimization only, never a semantic difference.
+        donate = (0,) if getattr(device, "platform", "cpu") != "cpu" else ()
+        self.bwd = jax.jit(lambda pull, cot: pull(cot), donate_argnums=donate)
         self.accum = jax.jit(
-            lambda a, b: jax.tree_util.tree_map(jnp.add, a, b)
+            lambda a, b: jax.tree_util.tree_map(jnp.add, a, b),
+            donate_argnums=donate,
         )
 
     @staticmethod
