@@ -24,7 +24,7 @@ import click
 import jax
 import jax.numpy as jnp
 
-from benchmarks.common import even_balance, hr_time, softmax_xent
+from benchmarks.common import hr_time, softmax_xent
 from torchgpipe_tpu.balance import balance_by_time
 from torchgpipe_tpu.distributed import (
     DistributedGPipe,

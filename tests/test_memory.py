@@ -22,7 +22,6 @@ the stages it flies over).  The XLA-native analogues asserted here:
 
 import jax
 import jax.numpy as jnp
-import pytest
 
 from torchgpipe_tpu import microbatch
 from torchgpipe_tpu.checkpoint import checkpoint_stop

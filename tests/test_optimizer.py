@@ -8,7 +8,6 @@ updates unchanged — optimizer state lives where its param lives.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 
