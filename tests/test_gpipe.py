@@ -170,3 +170,32 @@ def test_exception_propagates():
     # execution of the failing partition (tests/test_gpipe.py:227-239).
     with pytest.raises(RuntimeError, match="ouch"):
         model.init(jax.random.PRNGKey(0), in_spec)
+
+
+def test_backward_dispatch_is_reverse_schedule():
+    """The backward schedule is the exact reverse of the forward clock
+    cycles — the dispatch-order property the reference enforces with
+    fork/join autograd edges (reference: pipeline.py:128-132: micro-batch i
+    runs backward before i-1 on the same stage)."""
+    from torchgpipe_tpu.utils.tracing import Timeline
+
+    tracer = Timeline()
+    m, n = 4, 3
+    model = GPipe(
+        [dense(8, name=f"fc{j}") for j in range(n)],
+        balance=[1] * n, chunks=m, tracer=tracer, fused=False,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 8))
+    y = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+    params, state = model.init(
+        jax.random.PRNGKey(2), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    model.value_and_grad(params, state, x, y, lambda o, t: jnp.mean((o - t) ** 2))
+
+    fwd = [(e.mbatch, e.stage) for e in tracer.events if e.name == "fwd"]
+    bwd = [(e.mbatch, e.stage) for e in tracer.events if e.name == "bwd"]
+    assert bwd == list(reversed(fwd)), (fwd, bwd)
+    # Derived per-stage property: micro-batch i's backward precedes i-1's.
+    for j in range(n):
+        mbs = [i for i, jj in bwd if jj == j]
+        assert mbs == sorted(mbs, reverse=True), (j, mbs)
