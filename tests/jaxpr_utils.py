@@ -71,3 +71,14 @@ def max_eqn_output_bytes(jaxpr) -> int:
         ),
         default=0,
     )
+
+
+def scan_lengths(jaxpr):
+    """The trip counts (``length`` param) of every scan in the program, in
+    encounter order — lets structural tests pin schedule depths exactly."""
+    out = []
+    for jx in iter_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                out.append(eqn.params.get("length"))
+    return out

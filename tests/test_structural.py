@@ -128,8 +128,16 @@ def test_spmd_except_last_program_structure(cpu_devices):
         x_mb = microbatch.scatter_stacked(jnp.zeros((2 * m, dim)), m)
         return jax.make_jaxpr(lambda p, a, b: fn(p, a, b))(params, x_mb, x_mb)
 
+    from tests.jaxpr_utils import scan_lengths
+
     jx_el = jaxpr_of("except_last")
     jx_al = jaxpr_of("always")
+    # Schedule depths, exactly: 'always' scans all m+n-1 ticks in one loop;
+    # 'except_last' splits them m-1 (remat prefix) + n (cond tail).
+    T = m + n - 1
+    assert T in scan_lengths(jx_al.jaxpr), scan_lengths(jx_al.jaxpr)
+    el_lengths = scan_lengths(jx_el.jaxpr)
+    assert (m - 1) in el_lengths and n in el_lengths, el_lengths
     n_cond_el = _count_eqns(jx_el.jaxpr, ("cond",))
     n_cond_al = _count_eqns(jx_al.jaxpr, ("cond",))
     # ONE stage-owned cond inside the tail scan's body (forward); the grad
