@@ -142,3 +142,37 @@ def test_1f1b_interleaved_virtual_stages():
     np.testing.assert_allclose(float(l_1f), float(l_ref), rtol=1e-5)
     for a, b in zip(jax.tree_util.tree_leaves(g_1f), jax.tree_util.tree_leaves(g_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_per_stage_in_flight_bound():
+    """The defining 1F1B property, asserted from the engine's own dispatch
+    order: stage j never holds more than min(m, n - j) forwarded-but-not-
+    yet-backwarded micro-batches (fill-drain would hold all m)."""
+    from torchgpipe_tpu.utils.tracing import Timeline
+
+    m, n = 6, 3
+    tracer = Timeline()
+    model = GPipe(_layers(), balance=[3, 3, 3], chunks=m, schedule="1f1b",
+                  loss_reduction="mean", tracer=tracer, fused=False)
+    x = jax.random.normal(jax.random.PRNGKey(0), (12, 8, 8, 3))
+    y = jax.random.randint(jax.random.PRNGKey(1), (12,), 0, 5)
+    params, state = model.init(
+        jax.random.PRNGKey(2), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    model.value_and_grad(
+        params, state, x, y, _mean_loss, rng=jax.random.PRNGKey(3)
+    )
+
+    in_flight = {j: 0 for j in range(n)}
+    peak = {j: 0 for j in range(n)}
+    for ev in tracer.events:
+        if ev.name == "fwd":
+            in_flight[ev.stage] += 1
+            peak[ev.stage] = max(peak[ev.stage], in_flight[ev.stage])
+        elif ev.name == "bwd":
+            in_flight[ev.stage] -= 1
+    for j in range(n):
+        bound = min(m, n - j)
+        assert peak[j] <= bound, (j, peak[j], bound)
+    # And the bound is TIGHT for stage 0 (it actually reaches n).
+    assert peak[0] == min(m, n), peak
