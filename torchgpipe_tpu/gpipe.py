@@ -175,6 +175,17 @@ class GPipe:
     # container protocol (reference gpipe.py:257-285)                    #
     # ------------------------------------------------------------------ #
 
+    def __repr__(self) -> str:
+        devs = ", ".join(
+            f"{p}:{i}"
+            for p, i in sorted({(d.platform, d.id) for d in self.devices})
+        )
+        return (
+            f"GPipe(layers={len(self.layers)}, balance={self.balance}, "
+            f"chunks={self.chunks}, checkpoint={self.checkpoint!r}, "
+            f"schedule={self.schedule!r}, devices=[{devs}])"
+        )
+
     def __len__(self) -> int:
         return len(self.layers)
 

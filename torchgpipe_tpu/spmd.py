@@ -206,6 +206,24 @@ class SpmdGPipe:
     loss_reduction: Optional[str] = "mean"
     fsdp: bool = False
 
+    def __repr__(self) -> str:
+        axes = {
+            name: self.mesh.shape[name] for name in self.mesh.axis_names
+        }
+        extras = "".join(
+            f", {k}={v!r}"
+            for k, v, default in (
+                ("loss_reduction", self.loss_reduction, "mean"),
+                ("fsdp", self.fsdp, False),
+            )
+            if v != default
+        )
+        return (
+            f"SpmdGPipe(block={self.block.name!r}, n_stages={self.n_stages}, "
+            f"chunks={self.chunks}, checkpoint={self.checkpoint!r}, "
+            f"mesh={axes}{extras})"
+        )
+
     def __post_init__(self):
         if self.pp_axis not in self.mesh.axis_names:
             raise ValueError(f"mesh has no {self.pp_axis!r} axis: {self.mesh}")
