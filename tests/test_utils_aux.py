@@ -329,3 +329,43 @@ def test_save_sharded_swap_is_process0_gated(tmp_path, monkeypatch):
     # shards durable) — not merely after this rank's own wait.
     post_save_barrier = events.index(("barrier", "save_sharded:post-save"))
     assert kinds.index("rename") > post_save_barrier, events
+
+
+def test_timeline_chrome_trace_export(tmp_path):
+    """to_chrome_trace writes a valid trace-event JSON: one thread-name
+    metadata row per stage and one complete-event slice per recorded cell,
+    with microsecond timestamps."""
+    import json
+
+    tracer = Timeline()
+    model = GPipe(_layers(), balance=[2, 2], chunks=2, tracer=tracer,
+                  fused=False)
+    in_spec = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    y = jax.random.normal(jax.random.PRNGKey(2), (4, 4))
+    model.value_and_grad(params, state, x, y, _mse)
+
+    path = os.path.join(str(tmp_path), "trace.json")
+    tracer.to_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    slices = [e for e in events if e["ph"] == "X"]
+    assert {m["args"]["name"] for m in meta} == {"stage 0", "stage 1"}
+    # 2 chunks x 2 stages, fwd + bwd.
+    assert len(slices) == 2 * 2 * 2, slices
+    assert all(s["ts"] >= 0 for s in slices)
+    # Durations must faithfully reflect the recorded events (the 0.01us
+    # render floor only applies to genuinely sub-resolution intervals).
+    want = {
+        (e.name, e.stage, e.mbatch): max(e.duration * 1e6, 0.01)
+        for e in tracer.events
+    }
+    for s in slices:
+        a = s["args"]
+        key = (a["kind"], a["stage"], a["micro_batch"])
+        assert abs(s["dur"] - want[key]) < 1e-6, (s, want[key])
+    kinds = {s["args"]["kind"] for s in slices}
+    assert kinds == {"fwd", "bwd"}

@@ -74,6 +74,47 @@ class Timeline:
 
     # ------------------------------------------------------------------ #
 
+    def to_chrome_trace(self, path: str) -> None:
+        """Write the recorded cells as a Chrome trace-event JSON.
+
+        Open in ``chrome://tracing`` or https://ui.perfetto.dev: one row
+        (tid) per pipeline stage, one slice per (cell, phase) — the visual
+        the reference approximates with its nvidia-smi utilization sampler
+        (reference: benchmarks/unet-timeline/gpu_utils.py:8-69).  With
+        ``sync=True`` slices are true per-cell device durations; without,
+        they show the dispatch timeline (overlap visible as stacking).
+        """
+        import json
+
+        trace = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 0,
+                "tid": stage,
+                "args": {"name": f"stage {stage}"},
+            }
+            for stage in sorted({e.stage for e in self.events})
+        ]
+        trace += [
+            {
+                "name": f"{e.name} mb{e.mbatch}",
+                "ph": "X",
+                "pid": 0,
+                "tid": e.stage,
+                "ts": e.t_start * 1e6,   # microseconds
+                "dur": max(e.duration * 1e6, 0.01),
+                "args": {
+                    "stage": e.stage,
+                    "micro_batch": e.mbatch,
+                    "kind": e.name,
+                },
+            }
+            for e in self.events
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
+
     def by_stage(self) -> dict:
         out: dict = {}
         for ev in self.events:
