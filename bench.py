@@ -149,30 +149,9 @@ def _build_transformer(platform: str, n_stages: int):
 
 
 def _backend_reachable(timeout: float = 300.0) -> bool:
-    """Probe backend init in a SUBPROCESS: a dead remote-TPU tunnel makes
-    jax.devices() block forever inside the plugin, which no in-process
-    watchdog can interrupt — the probe hangs instead of us."""
-    import subprocess
-    import sys
+    from torchgpipe_tpu.utils.backend_probe import backend_reachable
 
-    # The probe costs one duplicate backend init on healthy runs (remote
-    # tunnels take a while); set TGPU_SKIP_BACKEND_PROBE=1 to skip it when
-    # the environment is known-good.
-    if os.environ.get("TGPU_SKIP_BACKEND_PROBE"):
-        return True
-    try:
-        # DEVNULL, not pipes: plugin helper processes inheriting a pipe fd
-        # would keep communicate() from ever seeing EOF after the kill —
-        # re-introducing the very hang this probe exists to prevent.
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    return backend_reachable(timeout)
 
 
 def main() -> None:

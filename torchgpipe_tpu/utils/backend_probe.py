@@ -1,0 +1,38 @@
+"""Dead-accelerator-tunnel defense shared by the driver entry points.
+
+On remote-attached TPUs a dead tunnel makes backend init either hang
+forever inside the plugin (no in-process watchdog can interrupt it) or
+raise UNAVAILABLE — both observed.  Probe init in a SUBPROCESS with a
+timeout; callers fall back to the host CPU platform when unreachable
+(bench.py labels its metric, __graft_entry__ prints a warning).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def backend_reachable(timeout: float = 300.0) -> bool:
+    """True if ``jax.devices()`` completes in a fresh interpreter.
+
+    The probe costs one duplicate backend init on healthy runs (remote
+    tunnels take a while); set ``TGPU_SKIP_BACKEND_PROBE=1`` to skip it
+    when the environment is known-good.
+    """
+    if os.environ.get("TGPU_SKIP_BACKEND_PROBE"):
+        return True
+    try:
+        # DEVNULL, not pipes: plugin helper processes inheriting a pipe fd
+        # would keep communicate() from ever seeing EOF after the kill —
+        # re-introducing the very hang this probe exists to prevent.
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
