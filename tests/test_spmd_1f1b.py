@@ -1,0 +1,293 @@
+"""SPMD 1F1B schedule: closed-form schedule invariants + transparency.
+
+The 1F1B program (spmd.py `_build_train_step_1f1b`) derives every cell's
+tick from closed forms; `test_schedule_closed_form_invariants` proves those
+forms give a legal PipeDream-flush schedule by checking them against a
+step-by-step dependency simulation.  The remaining tests are transparency
+oracles: the 1F1B step must produce the same loss/gradients as the
+fill-drain step (which itself is oracle-tested against the un-pipelined
+model in tests/test_spmd.py).  New capability vs the reference, which has
+fill-drain only (reference pipeline.py:49-65; SURVEY.md §2.2).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    cross_entropy,
+    llama_spmd,
+)
+from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+tmap = jax.tree_util.tree_map
+
+
+def maxdiff(a, b):
+    return max(
+        jax.tree_util.tree_leaves(
+            tmap(lambda x, y: float(jnp.max(jnp.abs(x - y))), a, b)
+        )
+    )
+
+
+# --------------------------------------------------------------------- #
+# schedule closed forms (mirrors the predicates in the scan body)       #
+# --------------------------------------------------------------------- #
+
+
+def fwd_tick(i, j, n):
+    return i + j if i <= n - 1 - j else 2 * i + j
+
+
+def bwd_tick(i, j, n):
+    return 2 * n - 1 + 2 * i - j
+
+
+@pytest.mark.parametrize("n,m", [(2, 2), (2, 5), (4, 1), (4, 3), (4, 8),
+                                 (8, 32), (3, 7)])
+def test_schedule_closed_form_invariants(n, m):
+    T = 2 * (m + n - 1)
+    # (t, j) -> list of ("F"|"B", i): at most one cell per stage per tick.
+    cells = {}
+    for j in range(n):
+        for i in range(m):
+            cells.setdefault((fwd_tick(i, j, n), j), []).append(("F", i))
+            cells.setdefault((bwd_tick(i, j, n), j), []).append(("B", i))
+    for (t, j), ops in cells.items():
+        assert len(ops) == 1, f"stage {j} does {ops} at tick {t}"
+        assert 0 <= t < T
+
+    for j in range(n):
+        for i in range(m):
+            # Forward dependency: stage j's fwd consumes stage j-1's output
+            # produced the previous tick or earlier...
+            if j > 0:
+                assert fwd_tick(i, j - 1, n) < fwd_tick(i, j, n)
+                # ...and the `act` carry must not be overwritten in between
+                # (stage j-1 runs no other forward inside the window).
+                lo, hi = fwd_tick(i, j - 1, n), fwd_tick(i, j, n) - 1
+                for i2 in range(m):
+                    if i2 != i:
+                        assert not (lo < fwd_tick(i2, j - 1, n) <= hi), (
+                            f"act carry hazard: stage {j-1} fwd {i2} "
+                            f"clobbers {i} before stage {j} consumes it"
+                        )
+            # Backward dependency: cotangent from stage j+1, lag exactly 1
+            # (so the gact carry is never stale or clobbered).
+            if j < n - 1:
+                assert bwd_tick(i, j, n) == bwd_tick(i, j + 1, n) + 1
+            else:
+                assert bwd_tick(i, j, n) > fwd_tick(i, j, n)
+            # Ring-buffer discipline (depth n, slot i % n): the backward
+            # read happens before slot reuse by micro-batch i + n.
+            if i + n < m:
+                assert fwd_tick(i + n, j, n) > bwd_tick(i, j, n)
+
+    # In-flight bound: micro-batches forwarded but not yet backwarded on
+    # stage j never exceed n - j (the 1F1B memory property).
+    for j in range(n):
+        for t in range(T):
+            in_flight = sum(
+                1
+                for i in range(m)
+                if fwd_tick(i, j, n) <= t < bwd_tick(i, j, n)
+            )
+            assert in_flight <= n - j
+
+    # Parity disjointness: the scan picks fwd on (t - j) even, bwd on odd.
+    for j in range(n):
+        for i in range(m):
+            if i > n - 1 - j:  # steady-state forwards
+                assert (fwd_tick(i, j, n) - j) % 2 == 0
+            assert (bwd_tick(i, j, n) - j) % 2 == 1
+
+
+# --------------------------------------------------------------------- #
+# transparency vs the fill-drain engine                                 #
+# --------------------------------------------------------------------- #
+
+
+def _engines(pp, mesh, m, *, with_pre_post=True, loss_fn=cross_entropy,
+             loss_reduction="mean", **kw):
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=pp, n_heads=4, n_kv_heads=2,
+        tp_axis=kw.get("tp_axis"),
+    )
+    block, pre, post = llama_spmd(cfg, pp)
+    if not with_pre_post:
+        pre = post = None
+    common = dict(
+        chunks=m, loss_fn=loss_fn, pre=pre, post=post,
+        loss_reduction=loss_reduction, checkpoint="always", **kw,
+    )
+    return (
+        SpmdGPipe(block, pp, mesh, **common),
+        SpmdGPipe(block, pp, mesh, schedule="1f1b", **common),
+    )
+
+
+def _tokens(b, s=16):
+    t = jnp.arange(b * s, dtype=jnp.int32).reshape(b, s) % 64
+    return t, (t + 1) % 64
+
+
+@pytest.mark.parametrize("m", [1, 2, 4, 6])
+def test_1f1b_matches_fill_drain(m):
+    pp = 4
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:4])
+    fd, ob = _engines(pp, mesh, m)
+    tokens, labels = _tokens(2 * m)
+    params = fd.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    )
+    l1, g1 = fd.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    l2, g2 = ob.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    assert abs(float(l1 - l2)) < 1e-5
+    assert maxdiff(g1, g2) < 1e-4
+
+
+def test_1f1b_matches_fill_drain_sum_loss():
+    pp = 4
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:4])
+
+    def ce_sum(out, tgt):
+        logits = out.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        oh = jax.nn.one_hot(tgt, logits.shape[-1], dtype=logp.dtype)
+        return -jnp.sum(oh * logp)
+
+    fd, ob = _engines(pp, mesh, 6, loss_fn=ce_sum, loss_reduction="sum")
+    tokens, labels = _tokens(12)
+    params = fd.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    )
+    l1, g1 = fd.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    l2, g2 = ob.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    # Sum-reduced losses are O(batch * seq); compare relatively.
+    assert abs(float(l1 - l2)) / abs(float(l1)) < 1e-5
+    assert maxdiff(g1, g2) / max(
+        1.0, maxdiff(g1, tmap(jnp.zeros_like, g1))
+    ) < 1e-4
+
+
+def test_1f1b_no_pre_post_no_rng():
+    pp = 4
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:4])
+    mse = lambda o, t: jnp.mean((o.astype(jnp.float32) - t) ** 2)  # noqa: E731
+    fd, ob = _engines(pp, mesh, 4, with_pre_post=False, loss_fn=mse)
+    x = jax.random.normal(jax.random.PRNGKey(5), (8, 16, 32))
+    y = jax.random.normal(jax.random.PRNGKey(6), (8, 16, 32))
+    params = fd.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    l1, g1 = fd.train_step(params, x, y)
+    l2, g2 = ob.train_step(params, x, y)
+    assert abs(float(l1 - l2)) < 1e-5
+    assert maxdiff(g1, g2) < 1e-5
+
+
+def test_1f1b_composes_with_dp():
+    mesh = make_mesh(2, 2, devices=jax.devices()[:4])
+    fd, ob = _engines(2, mesh, 2, dp_axis="dp")
+    tokens, labels = _tokens(8)
+    params = fd.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    )
+    l1, g1 = fd.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    l2, g2 = ob.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    assert abs(float(l1 - l2)) < 1e-5
+    assert maxdiff(g1, g2) < 1e-4
+
+
+def test_1f1b_composes_with_tp():
+    mesh = make_mesh(2, 1, tp=2, devices=jax.devices()[:4])
+    fd, ob = _engines(2, mesh, 2, tp_axis="tp")
+    tokens, labels = _tokens(8)
+    params = fd.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    )
+    l1, g1 = fd.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    l2, g2 = ob.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    assert abs(float(l1 - l2)) < 1e-5
+    assert maxdiff(g1, g2) < 1e-4
+
+
+def test_1f1b_validation_errors():
+    pp = 2
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:2])
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, pp)
+    ok = dict(chunks=2, loss_fn=cross_entropy, pre=pre, post=post)
+
+    with pytest.raises(ValueError, match="decompose over"):
+        SpmdGPipe(block, pp, mesh, schedule="1f1b", loss_reduction=None, **ok)
+    with pytest.raises(ValueError, match="checkpoint='always'"):
+        SpmdGPipe(block, pp, mesh, schedule="1f1b", checkpoint="never", **ok)
+    with pytest.raises(ValueError, match="remat_policy"):
+        SpmdGPipe(
+            block, pp, mesh, schedule="1f1b",
+            remat_policy=jax.checkpoint_policies.everything_saveable, **ok,
+        )
+    with pytest.raises(ValueError, match="fill_drain' or '1f1b"):
+        SpmdGPipe(block, pp, mesh, schedule="interleaved", **ok)
+    with pytest.raises(ValueError, match="sequence"):
+        mesh_sp = make_mesh(2, 1, 2, devices=jax.devices()[:4])
+        cfg_sp = TransformerConfig(vocab=64, dim=32, n_layers=pp, n_heads=4,
+                                   n_kv_heads=2, sp_axis="sp")
+        blk_sp, pre_sp, post_sp = llama_spmd(cfg_sp, pp)
+        SpmdGPipe(
+            blk_sp, pp, mesh_sp, schedule="1f1b", chunks=2,
+            loss_fn=cross_entropy, pre=pre_sp, post=post_sp, sp_axis="sp",
+        )
+
+
+def test_1f1b_memory_below_fill_drain():
+    """The schedule's point: peak temp bytes stay O(n) not O(m).
+
+    Same mini-batch, m=16 micro-batches on a 4-stage pipeline — the 1F1B
+    program's compiled peak must undercut fill-drain's (reference memory
+    evidence anchor: tests/skip/test_leak.py:28-104 proves the reference's
+    memory story; here XLA's own memory analysis proves this one).
+    """
+    import torchgpipe_tpu.microbatch as mb
+
+    pp, m = 4, 16
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:4])
+    cfg = TransformerConfig(vocab=256, dim=256, n_layers=pp, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, pp)
+    tokens = jnp.zeros((32, 128), jnp.int32)
+    labels = jnp.zeros((32, 128), jnp.int32)
+    temps = {}
+    for sched in ("fill_drain", "1f1b"):
+        eng = SpmdGPipe(
+            block, pp, mesh, chunks=m, loss_fn=cross_entropy, pre=pre,
+            post=post, checkpoint="always", schedule=sched,
+        )
+        params = eng.init(
+            jax.random.PRNGKey(0),
+            jax.ShapeDtypeStruct(tokens.shape, tokens.dtype),
+        )
+        fn = eng._build_train_step(use_rng=True)
+        x_mb = mb.scatter_stacked(tokens, m)
+        t_mb = mb.scatter_stacked(labels, m)
+        ma = fn.lower(
+            params, x_mb, t_mb, jax.random.PRNGKey(1)
+        ).compile().memory_analysis()
+        temps[sched] = ma.temp_size_in_bytes
+    assert temps["1f1b"] < 0.75 * temps["fill_drain"], temps
+
+
+def test_repr_shows_schedule():
+    pp = 2
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:2])
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, pp)
+    eng = SpmdGPipe(block, pp, mesh, schedule="1f1b", chunks=2,
+                    loss_fn=cross_entropy, pre=pre, post=post)
+    assert "schedule='1f1b'" in repr(eng)

@@ -183,6 +183,18 @@ class SpmdGPipe:
         ~the dp size for one gather/scatter pair per step over ICI.
         Requires ``dp_axis``; incompatible with ``ep_axis`` (expert leaves
         are already dp-style sharded over ep).
+      schedule: 'fill_drain' (default; the reference's GPipe schedule) or
+        '1f1b' (PipeDream-flush).  1F1B interleaves each micro-batch's
+        backward with later micro-batches' forwards inside the same
+        compiled scan, computing gradients explicitly (per-cell
+        ``jax.vjp`` with recompute), so in-flight activations per stage
+        are bounded by the pipeline depth ``n`` instead of the micro-batch
+        count ``m`` — same bubble fraction, O(n) instead of O(m)
+        activation memory.  Requires a micro-batch-decomposable loss
+        (``loss_reduction`` 'mean'/'sum') and ``checkpoint='always'``;
+        composes with dp and tp (not yet fsdp/ep/sp — see the
+        ``__post_init__`` errors for why).  New capability: the reference
+        has fill-drain only (SURVEY.md §2.2).
     """
 
     block: Layer
@@ -205,6 +217,15 @@ class SpmdGPipe:
     ep_axis: Optional[str] = None
     loss_reduction: Optional[str] = "mean"
     fsdp: bool = False
+    # 'fill_drain' (GPipe; reference pipeline.py:49-65) or '1f1b'
+    # (one-forward-one-backward, PipeDream-flush): same bubble, but the
+    # schedule interleaves each micro-batch's backward with later
+    # forwards, capping in-flight activations per stage at ~n instead of
+    # m.  The 1F1B program computes gradients EXPLICITLY inside the scan
+    # (per-cell jax.vjp with recompute — checkpoint='always' semantics),
+    # so it needs a micro-batch-decomposable loss (loss_reduction
+    # 'mean'/'sum').
+    schedule: str = "fill_drain"
 
     def __repr__(self) -> str:
         axes = {
@@ -215,6 +236,7 @@ class SpmdGPipe:
             for k, v, default in (
                 ("loss_reduction", self.loss_reduction, "mean"),
                 ("fsdp", self.fsdp, False),
+                ("schedule", self.schedule, "fill_drain"),
             )
             if v != default
         )
@@ -271,6 +293,50 @@ class SpmdGPipe:
                 "needs a batch-decomposable loss: set loss_reduction='mean' "
                 "or 'sum'"
             )
+        if self.schedule not in ("fill_drain", "1f1b"):
+            raise ValueError("schedule must be 'fill_drain' or '1f1b'")
+        if self.schedule == "1f1b":
+            if self.loss_reduction is None:
+                raise ValueError(
+                    "schedule='1f1b' computes per-micro-batch losses inside "
+                    "the schedule, so the loss must decompose over "
+                    "micro-batches: set loss_reduction='mean' or 'sum'"
+                )
+            if self.checkpoint != "always":
+                raise ValueError(
+                    "schedule='1f1b' recomputes each cell in its backward "
+                    "tick (checkpoint='always' semantics are built in); "
+                    "set checkpoint='always', or use schedule='fill_drain' "
+                    f"for checkpoint={self.checkpoint!r}"
+                )
+            if self.remat_policy is not None:
+                raise ValueError(
+                    "schedule='1f1b' hand-writes the per-cell recompute; "
+                    "remat_policy does not apply (use schedule='fill_drain')"
+                )
+            if self.fsdp:
+                raise ValueError(
+                    "schedule='1f1b' does not yet compose with fsdp "
+                    "(the explicit-gradient path would need its own "
+                    "reduce-scatter); use schedule='fill_drain' with fsdp"
+                )
+            if self.ep_axis is not None:
+                raise ValueError(
+                    "schedule='1f1b' does not yet compose with expert "
+                    "parallelism; use schedule='fill_drain' with ep_axis"
+                )
+            if self.sp_axis is not None:
+                raise ValueError(
+                    "schedule='1f1b' does not compose with sequence "
+                    "parallelism: ring attention's sp ppermutes would sit "
+                    "inside the schedule's fwd/bwd conditional, whose "
+                    "branches only some pipeline stages execute on a given "
+                    "tick — collective-permute participation is global, so "
+                    "lanes in the other branch would never join (verified "
+                    "failure on the host backend).  psum-based tensor "
+                    "parallelism is fine (group-local all-reduce); use "
+                    "schedule='fill_drain' for sp"
+                )
         # Layers may declare mesh-validation hooks (e.g. the tensor-parallel
         # transformer block checks that the tp size divides its head counts —
         # flat-dim divisibility alone would let a head split across lanes).
@@ -682,7 +748,291 @@ class SpmdGPipe:
             lambda mb: self.pre.apply(pre_params, (), mb, rng=None, train=train)[0]
         )(x_mb)
 
+    def _build_train_step_1f1b(self, use_rng: bool):
+        """Training step under the 1F1B (PipeDream-flush) schedule.
+
+        Unlike the fill-drain path — which differentiates the whole scanned
+        schedule and therefore keeps one saved carry per tick (``m + n - 1``
+        of them) — this program computes gradients EXPLICITLY inside a
+        single forward-only scan: each stage interleaves forward cells with
+        backward cells, so at most ``n - j`` micro-batch inputs are in
+        flight on stage ``j`` at any tick.  Activation memory is bounded by
+        the depth-``n`` input ring buffer instead of growing with ``m``.
+
+        Schedule closed form (one cell per stage per tick; ``2(m + n - 1)``
+        ticks total): stage ``j`` runs forward of micro-batch ``i`` at tick
+        ``i + j`` during warmup (``i <= n - 1 - j``) and ``2i + j`` in
+        steady state, and backward of ``i`` at tick ``2n - 1 + 2i - j``.
+        Forward activations hop ``j -> j+1`` and backward cotangents
+        ``j -> j-1`` through one ``ppermute`` each per tick (outside the
+        fwd/bwd/idle ``lax.switch``, so collectives stay unconditional);
+        the validity predicates are disjoint by parity (forward cells land
+        on ``t - j`` even, backward on odd), which a structural test checks
+        against a step-by-step simulation.
+
+        Backward cells recompute their forward from the saved input
+        (``jax.vjp`` per cell — the reference's checkpoint-'always'
+        semantics, checkpoint.py:1-19); the last stage's backward cell also
+        runs ``post`` + per-micro-batch loss, seeding the cotangent ring.
+        ``pre`` runs once outside the scan with its vjp kept; stage 0's
+        backward cells stack their input cotangents and one outer
+        ``vjp_pre`` call turns them into pre-parameter gradients.
+        """
+        n, m = self.n_stages, self.chunks
+        mean = self.loss_reduction == "mean"
+        data_spec = self._data_specs()
+        tmap = jax.tree_util.tree_map
+
+        def local(params, x_mb, tgt_mb, rng=None):
+            stage = lax.axis_index(self.pp_axis)
+            perm_f = [(i, (i + 1) % n) for i in range(n)]
+            perm_b = [(i, (i - 1) % n) for i in range(n)]
+
+            params_local = tmap(lambda a: a[0], params["blocks"])
+            pre_params = params["pre"] if self.pre is not None else ()
+            post_params = params["post"] if self.post is not None else ()
+            pre_base = (
+                jax.random.fold_in(rng, 0x7FFFFFFF) if rng is not None else None
+            )
+            post_base = (
+                jax.random.fold_in(rng, 0x7FFFFFFE) if rng is not None else None
+            )
+            # Valid cells always carry scale 1/m (invalid ticks take the
+            # idle branch, so no masking is needed as in _local_pipeline).
+            aux_s = 1.0 / m
+            # pre's aux-gradient scale is stage-masked like the fill-drain
+            # path: its parameters are differentiated on every lane (the
+            # splice in stage_input), but only stage 0's contribution is
+            # real.
+            pre_aux = jnp.where(stage == 0, 1.0 / m, 0.0)
+
+            def cell_key(i):
+                # Matches the fill-drain cell key fold_in(fold_in(rng, t),
+                # stage) at t = i + stage, so both schedules (and the
+                # backward recompute) produce identical per-cell randomness.
+                if rng is None:
+                    return None
+                return jax.random.fold_in(
+                    jax.random.fold_in(rng, i + stage), stage
+                )
+
+            def sub_key(base, i):
+                return None if base is None else jax.random.fold_in(base, i)
+
+            def raw_input(i):
+                return tmap(
+                    lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+                    x_mb,
+                )
+
+            def stage_input(p_pre, i, fallback):
+                """Stage 0's block input for micro-batch ``i`` spliced over
+                ``fallback`` (the ppermute hand-off, or the saved input in
+                backward cells).
+
+                ``pre`` (e.g. the embedding) runs per cell INSIDE the scan —
+                the raw inputs ``x_mb`` it reads are engine inputs (tokens),
+                so no O(m) stack of pre outputs ever materializes, keeping
+                the schedule's activation footprint at O(n).  In backward
+                cells the recompute doubles as the pre-gradient path: the
+                splice routes stage 0's input cotangent through ``pre`` to
+                its parameters, while every other stage's splice is dead and
+                contributes zeros (keys match the forward cell, so the
+                recomputed value is bit-identical).
+                """
+                if self.pre is None:
+                    return tmap(
+                        lambda inp, r: jnp.where(stage == 0, inp, r),
+                        raw_input(i),
+                        fallback,
+                    )
+                with aux_scale(pre_aux):
+                    x0, _ = self.pre.apply(
+                        p_pre, (), raw_input(i),
+                        rng=sub_key(pre_base, i), train=True,
+                    )
+                return tmap(
+                    lambda a, r: jnp.where(stage == 0, a, r), x0, fallback
+                )
+
+            def mb_loss(y, p_post, i):
+                if self.post is not None:
+                    # Per-micro-batch head application: aux scale 1/m (the
+                    # m cells average to one mini-batch, mirroring the
+                    # fill-drain head's 1/n over n batch slices).
+                    with aux_scale(aux_s):
+                        y, _ = self.post.apply(
+                            p_post, (), y,
+                            rng=sub_key(post_base, i), train=True,
+                        )
+                tgt_i = tmap(
+                    lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+                    tgt_mb,
+                )
+                loss_i = self.loss_fn(y, tgt_i).astype(jnp.float32)
+                return loss_i / m if mean else loss_i
+
+            act_spec = jax.eval_shape(
+                lambda p, x: self._block_fn_plain(p, x, None, aux_s, False),
+                params_local,
+                tmap(lambda a: jnp.zeros(a.shape[1:], a.dtype), x_mb)
+                if self.pre is None
+                else jax.eval_shape(
+                    lambda p, x: self.pre.apply(p, (), x, rng=None, train=False)[0],
+                    pre_params,
+                    tmap(lambda a: jnp.zeros(a.shape[1:], a.dtype), x_mb),
+                ),
+            )
+            act0 = tmap(lambda s: jnp.zeros(s.shape, s.dtype), act_spec)
+            carry0 = dict(
+                act=act0,
+                gact=act0,
+                # Depth-n input ring buffer (slot i % n): in-flight
+                # micro-batches per stage never exceed n, and slot i + n's
+                # write lands strictly after slot i's backward read.
+                buf=tmap(
+                    lambda s: jnp.zeros((n,) + s.shape, s.dtype), act_spec
+                ),
+                gblk=tmap(jnp.zeros_like, params_local),
+                gpre=tmap(jnp.zeros_like, pre_params),
+                gpost=tmap(jnp.zeros_like, post_params),
+                loss=jnp.float32(0.0),
+            )
+
+            def tick(carry, t):
+                recv_f = tmap(
+                    lambda a: lax.ppermute(a, self.pp_axis, perm_f),
+                    carry["act"],
+                )
+                recv_b = tmap(
+                    lambda a: lax.ppermute(a, self.pp_axis, perm_b),
+                    carry["gact"],
+                )
+                tj = t - stage
+                warm = (tj >= 0) & (tj <= n - 1 - stage) & (tj < m)
+                i_s = jnp.where(tj >= 0, tj // 2, 0)
+                steady = (
+                    (tj >= 0)
+                    & (tj % 2 == 0)
+                    & (i_s > n - 1 - stage)
+                    & (i_s < m)
+                )
+                i_f = jnp.clip(jnp.where(warm, tj, i_s), 0, m - 1)
+                do_f = warm | steady
+                num = t + stage - (2 * n - 1)
+                do_b = (num >= 0) & (num % 2 == 0) & (num // 2 < m)
+                i_b = jnp.clip(jnp.where(num >= 0, num // 2, 0), 0, m - 1)
+
+                def fwd_branch(c):
+                    x_f = stage_input(pre_params, i_f, recv_f)
+                    y = self._block_fn_plain(
+                        params_local, x_f, cell_key(i_f), aux_s, True
+                    )
+                    buf = tmap(
+                        lambda b, x: lax.dynamic_update_index_in_dim(
+                            b, x, i_f % n, 0
+                        ),
+                        c["buf"],
+                        x_f,
+                    )
+                    return dict(c, act=y, buf=buf)
+
+                def bwd_branch(c):
+                    x_saved = tmap(
+                        lambda b: lax.dynamic_index_in_dim(
+                            b, i_b % n, 0, keepdims=False
+                        ),
+                        c["buf"],
+                    )
+                    key = cell_key(i_b)
+
+                    def through_block(p_blk, p_pre, x):
+                        # Recompute-with-pre-splice: identical value to the
+                        # forward cell (same keys), but differentiable in
+                        # p_pre on stage 0.
+                        xin = stage_input(p_pre, i_b, x)
+                        return self._block_fn_plain(
+                            p_blk, xin, key, aux_s, True
+                        )
+
+                    def last_fn():
+                        def full(p_blk, p_pre, p_post, x):
+                            y = through_block(p_blk, p_pre, x)
+                            return mb_loss(y, p_post, i_b)
+
+                        loss_i, (d_blk, d_pre, d_post, dx) = jax.value_and_grad(
+                            full, argnums=(0, 1, 2, 3)
+                        )(params_local, pre_params, post_params, x_saved)
+                        return loss_i, d_blk, d_pre, d_post, dx
+
+                    def mid_fn():
+                        _, vjp_cell = jax.vjp(
+                            through_block, params_local, pre_params, x_saved
+                        )
+                        d_blk, d_pre, dx = vjp_cell(recv_b)
+                        return (
+                            jnp.float32(0.0),
+                            d_blk,
+                            d_pre,
+                            tmap(jnp.zeros_like, post_params),
+                            dx,
+                        )
+
+                    loss_i, d_blk, d_pre, d_post, dx = lax.cond(
+                        stage == n - 1, last_fn, mid_fn
+                    )
+                    return dict(
+                        c,
+                        gact=dx,
+                        gblk=tmap(jnp.add, c["gblk"], d_blk),
+                        gpre=tmap(jnp.add, c["gpre"], d_pre),
+                        gpost=tmap(jnp.add, c["gpost"], d_post),
+                        loss=c["loss"] + loss_i,
+                    )
+
+                idx = jnp.where(do_f, 0, jnp.where(do_b, 1, 2))
+                carry = lax.switch(
+                    idx, [fwd_branch, bwd_branch, lambda c: c], carry
+                )
+                return carry, ()
+
+            carry, _ = lax.scan(
+                tick, carry0, jnp.arange(2 * (m + n - 1))
+            )
+            loss = lax.psum(carry["loss"], self.pp_axis)
+            grads = {"blocks": tmap(lambda g: g[None], carry["gblk"])}
+            if self.pre is not None:
+                grads["pre"] = lax.psum(carry["gpre"], self.pp_axis)
+            if self.post is not None:
+                grads["post"] = lax.psum(carry["gpost"], self.pp_axis)
+            # Cross-axis reductions mirror the fill-drain path (no
+            # fsdp/ep/sp here — rejected in __post_init__).
+            if self.dp_axis:
+                loss = lax.pmean(loss, self.dp_axis)
+                grads = lax.pmean(grads, self.dp_axis)
+            return loss, grads
+
+        param_specs = {"blocks": self._blocks_spec}
+        if self.pre is not None:
+            param_specs["pre"] = self._pre_spec
+        if self.post is not None:
+            param_specs["post"] = self._post_spec
+
+        if use_rng:
+            in_specs = (param_specs, data_spec, data_spec, P())
+        else:
+            in_specs = (param_specs, data_spec, data_spec)
+        mapped = _shard_map(
+            local,
+            self.mesh,
+            in_specs=in_specs,
+            out_specs=(P(), param_specs),
+        )
+        return jax.jit(mapped)
+
     def _build_train_step(self, use_rng: bool):
+        if self.schedule == "1f1b":
+            return self._build_train_step_1f1b(use_rng)
         n = self.n_stages
         data_spec = self._data_specs()
 
