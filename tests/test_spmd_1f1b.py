@@ -215,6 +215,47 @@ def test_1f1b_composes_with_tp():
     assert maxdiff(g1, g2) < 1e-4
 
 
+def test_1f1b_composes_with_fsdp():
+    """FSDP under 1F1B: gather before the scan, explicit reduce-scatter
+    after — grads must match fill-drain's autodiff'd all_gather transpose."""
+    mesh = make_mesh(2, 2, devices=jax.devices()[:4])
+    fd, ob = _engines(2, mesh, 2, dp_axis="dp", fsdp=True)
+    tokens, labels = _tokens(8)
+    params = fd.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    )
+    l1, g1 = fd.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    l2, g2 = ob.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    assert abs(float(l1 - l2)) < 1e-5
+    assert maxdiff(g1, g2) < 1e-4
+
+
+def test_1f1b_composes_with_ep_moe():
+    """MoE expert parallelism under 1F1B: the all_to_all token dispatch
+    (group-local, so safe inside the schedule's switch) and the aux
+    balance-gradient injection both ride the per-cell vjp."""
+    from torchgpipe_tpu.models.moe import MoEConfig, llama_moe_spmd
+
+    pp = 2
+    mesh = make_mesh(pp, 1, ep=2, devices=jax.devices()[:4])
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp, n_heads=4,
+                            n_kv_heads=2)
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=2.0, ep_axis="ep")
+    block, pre, post = llama_moe_spmd(cfg, moe, pp)
+    tokens, labels = _tokens(8)
+    common = dict(chunks=2, loss_fn=cross_entropy, pre=pre, post=post,
+                  ep_axis="ep", checkpoint="always")
+    fd = SpmdGPipe(block, pp, mesh, **common)
+    ob = SpmdGPipe(block, pp, mesh, schedule="1f1b", **common)
+    params = fd.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    )
+    l1, g1 = fd.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    l2, g2 = ob.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    assert abs(float(l1 - l2)) < 1e-5
+    assert maxdiff(g1, g2) < 1e-4
+
+
 def test_1f1b_validation_errors():
     pp = 2
     mesh = make_mesh(pp, 1, devices=jax.devices()[:2])

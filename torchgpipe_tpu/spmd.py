@@ -192,9 +192,10 @@ class SpmdGPipe:
         count ``m`` — same bubble fraction, O(n) instead of O(m)
         activation memory.  Requires a micro-batch-decomposable loss
         (``loss_reduction`` 'mean'/'sum') and ``checkpoint='always'``;
-        composes with dp and tp (not yet fsdp/ep/sp — see the
-        ``__post_init__`` errors for why).  New capability: the reference
-        has fill-drain only (SURVEY.md §2.2).
+        composes with dp, tp, ep (MoE) and fsdp — but not sp, whose ring
+        attention would put collective-permutes inside the schedule
+        conditional (see the ``__post_init__`` error).  New capability:
+        the reference has fill-drain only (SURVEY.md §2.2).
     """
 
     block: Layer
@@ -313,17 +314,6 @@ class SpmdGPipe:
                 raise ValueError(
                     "schedule='1f1b' hand-writes the per-cell recompute; "
                     "remat_policy does not apply (use schedule='fill_drain')"
-                )
-            if self.fsdp:
-                raise ValueError(
-                    "schedule='1f1b' does not yet compose with fsdp "
-                    "(the explicit-gradient path would need its own "
-                    "reduce-scatter); use schedule='fill_drain' with fsdp"
-                )
-            if self.ep_axis is not None:
-                raise ValueError(
-                    "schedule='1f1b' does not yet compose with expert "
-                    "parallelism; use schedule='fill_drain' with ep_axis"
                 )
             if self.sp_axis is not None:
                 raise ValueError(
@@ -465,6 +455,71 @@ class SpmdGPipe:
         )
 
     # ------------------------------------------------------------------ #
+    # cross-axis gradient reductions (shared by both schedules)          #
+    # ------------------------------------------------------------------ #
+
+    def _reduce_dp(self, loss, grads, *, scatter_blocks: bool):
+        """dp-axis loss/grad reduction, fsdp-aware.
+
+        ``scatter_blocks=False`` (fill-drain): block grads arrived via the
+        all_gather's transpose, i.e. already reduce-scattered shards SUMMED
+        over dp — divide for the pmean semantics every other leaf gets.
+        ``scatter_blocks=True`` (1F1B): the explicit block grads are w.r.t.
+        the GATHERED params, so perform that reduce-scatter here.
+        """
+        if not self.dp_axis:
+            return loss, grads
+        loss = lax.pmean(loss, self.dp_axis)
+        if not self.fsdp:
+            return loss, lax.pmean(grads, self.dp_axis)
+        dpn = self.mesh.shape[self.dp_axis]
+
+        def red_leaf(g, dim):
+            if dim < 0:  # replicated leaf (norm scales etc.)
+                return lax.pmean(g, self.dp_axis)
+            if scatter_blocks:
+                g = lax.psum_scatter(
+                    g, self.dp_axis, scatter_dimension=dim, tiled=True
+                )
+            return g / dpn
+
+        grads = dict(grads)
+        grads["blocks"] = jax.tree_util.tree_map(
+            red_leaf, grads["blocks"], self._fsdp_dims
+        )
+        for k in ("pre", "post"):
+            if k in grads:
+                grads[k] = lax.pmean(grads[k], self.dp_axis)
+        return loss, grads
+
+    def _reduce_ep(self, loss, grads):
+        """ep-axis reduction: ep shards the batch like an extra dp axis,
+        but expert weights are *sharded* over it — their lane-local grads
+        already sum contributions from every lane's tokens (the all_to_all
+        transpose routed the cotangents home), so they take only the
+        global-mean scaling (1/ep for 'mean'; nothing for 'sum').
+        Replicated leaves reduce like dp."""
+        if not self.ep_axis:
+            return loss, grads
+        ep_n = self.mesh.shape[self.ep_axis]
+        mean = self.loss_reduction == "mean"
+        red = lax.pmean if mean else lax.psum
+        loss = red(loss, self.ep_axis)
+        bspecs = self._blocks_leaf_specs(grads["blocks"])
+
+        def red_ep(g, s):
+            if spec_mentions(s, self.ep_axis):
+                return g / ep_n if mean else g
+            return red(g, self.ep_axis)
+
+        grads = dict(grads)
+        grads["blocks"] = jax.tree_util.tree_map(
+            red_ep, grads["blocks"], bspecs
+        )
+        for k in ("pre", "post"):
+            if k in grads:
+                grads[k] = red(grads[k], self.ep_axis)
+        return loss, grads
 
     def init(self, rng: jax.Array, in_spec: Pytree) -> Pytree:
         """Initialize {'pre', 'blocks', 'post'} params; blocks stacked on a
@@ -788,7 +843,16 @@ class SpmdGPipe:
             perm_f = [(i, (i + 1) % n) for i in range(n)]
             perm_b = [(i, (i - 1) % n) for i in range(n)]
 
-            params_local = tmap(lambda a: a[0], params["blocks"])
+            # FSDP: all-gather the stored shards ONCE before the scan (an
+            # unconditional group-local collective — safe outside the
+            # schedule's switch); the explicit reduce-scatter of the block
+            # grads happens after the scan.
+            blocks_in = (
+                self._gather_fsdp(params["blocks"])
+                if self.fsdp
+                else params["blocks"]
+            )
+            params_local = tmap(lambda a: a[0], blocks_in)
             pre_params = params["pre"] if self.pre is not None else ()
             post_params = params["post"] if self.post is not None else ()
             pre_base = (
@@ -1005,14 +1069,18 @@ class SpmdGPipe:
                 grads["pre"] = lax.psum(carry["gpre"], self.pp_axis)
             if self.post is not None:
                 grads["post"] = lax.psum(carry["gpost"], self.pp_axis)
-            # Cross-axis reductions mirror the fill-drain path (no
-            # fsdp/ep/sp here — rejected in __post_init__).
-            if self.dp_axis:
-                loss = lax.pmean(loss, self.dp_axis)
-                grads = lax.pmean(grads, self.dp_axis)
+            # Cross-axis reductions shared with the fill-drain path (no sp
+            # here — rejected in __post_init__).  scatter_blocks: the
+            # explicit block grads are w.r.t. the GATHERED params and still
+            # need the reduce-scatter the fill-drain autodiff gets from the
+            # all_gather transpose.
+            loss, grads = self._reduce_dp(loss, grads, scatter_blocks=True)
+            loss, grads = self._reduce_ep(loss, grads)
             return loss, grads
 
-        param_specs = {"blocks": self._blocks_spec}
+        param_specs = {
+            "blocks": self._fsdp_specs if self.fsdp else self._blocks_spec
+        }
         if self.pre is not None:
             param_specs["pre"] = self._pre_spec
         if self.post is not None:
@@ -1139,51 +1207,8 @@ class SpmdGPipe:
                 grads["pre"] = lax.psum(grads["pre"], self.pp_axis)
             if self.post is not None:
                 grads["post"] = lax.psum(grads["post"], self.pp_axis)
-            if self.dp_axis:
-                loss = lax.pmean(loss, self.dp_axis)
-                if self.fsdp:
-                    # FSDP block leaves arrive as shards already SUMMED over
-                    # dp (the all_gather transpose); divide for the pmean
-                    # semantics every other leaf gets.
-                    dpn = self.mesh.shape[self.dp_axis]
-                    grads = dict(grads)
-                    grads["blocks"] = jax.tree_util.tree_map(
-                        lambda g, dim: (
-                            lax.pmean(g, self.dp_axis) if dim < 0 else g / dpn
-                        ),
-                        grads["blocks"],
-                        self._fsdp_dims,
-                    )
-                    for k in ("pre", "post"):
-                        if k in grads:
-                            grads[k] = lax.pmean(grads[k], self.dp_axis)
-                else:
-                    grads = lax.pmean(grads, self.dp_axis)
-            if self.ep_axis:
-                # ep shards the batch like an extra dp axis, but expert
-                # weights are *sharded* over it: their lane-local grads
-                # already sum contributions from every lane's tokens (the
-                # all_to_all transpose routed the cotangents home), so they
-                # take only the global-mean scaling (1/ep for 'mean';
-                # nothing for 'sum').  Replicated leaves reduce like dp.
-                ep_n = self.mesh.shape[self.ep_axis]
-                mean = self.loss_reduction == "mean"
-                red = lax.pmean if mean else lax.psum
-                loss = red(loss, self.ep_axis)
-                bspecs = self._blocks_leaf_specs(grads["blocks"])
-
-                def red_ep(g, s):
-                    if spec_mentions(s, self.ep_axis):
-                        return g / ep_n if mean else g
-                    return red(g, self.ep_axis)
-
-                grads = dict(grads)
-                grads["blocks"] = jax.tree_util.tree_map(
-                    red_ep, grads["blocks"], bspecs
-                )
-                for k in ("pre", "post"):
-                    if k in grads:
-                        grads[k] = red(grads[k], self.ep_axis)
+            loss, grads = self._reduce_dp(loss, grads, scatter_blocks=False)
+            loss, grads = self._reduce_ep(loss, grads)
             if self.sp_axis:
                 # Params are replicated over sp; each lane differentiated its
                 # own token shard's loss.  mean-reduction: global loss/grad is
