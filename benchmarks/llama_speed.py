@@ -81,11 +81,15 @@ def causal_lm_loss(out, tokens):
                    "n_stages*dp*ep*tp devices)")
 @click.option("--dp", default=1,
               help="data-parallel mesh axis size (spmd engine)")
+@click.option("--schedule", type=click.Choice(["fill_drain", "1f1b"]),
+              default="fill_drain",
+              help="spmd engine schedule: 1f1b runs PipeDream-flush with "
+                   "O(n) activation memory (needs checkpoint=always)")
 @click.option("--fsdp/--no-fsdp", default=False,
               help="ZeRO-3-style parameter sharding over the dp axis "
                    "(spmd engine; needs --dp > 1)")
 def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
-         checkpoint, moe_experts, moe_top_k, ep, tp, dp, fsdp):
+         checkpoint, moe_experts, moe_top_k, ep, tp, dp, schedule, fsdp):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     dim, n_layers, n_heads, n_kv, vocab, mlp_ratio = PRESETS[preset]
@@ -108,6 +112,11 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
         )
     if (dp > 1 or fsdp) and engine != "spmd":
         raise click.UsageError("--dp/--fsdp need the spmd engine")
+    if schedule != "fill_drain" and engine != "spmd":
+        raise click.UsageError(
+            "--schedule selects the spmd engine's schedule; the mpmd "
+            "engine takes GPipe(schedule=...) via its own driver path"
+        )
     if fsdp and dp <= 1:
         raise click.UsageError("--fsdp shards over the dp lanes: pass --dp > 1")
     moe = None
@@ -123,7 +132,7 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
     if engine == "spmd":
         tput = _run_spmd(
             cfg, n, chunks, x, epochs, steps, checkpoint, experiment, moe,
-            ep, tp, dp, fsdp,
+            ep, tp, dp, fsdp, schedule,
         )
     else:
         if moe is not None:
@@ -181,7 +190,7 @@ def _print_router_stats(params, h, moe):
 
 
 def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
-              ep=1, tp=1, dp=1, fsdp=False):
+              ep=1, tp=1, dp=1, fsdp=False, schedule="fill_drain"):
     from benchmarks.common import run_epoch_loop
     from torchgpipe_tpu.models.transformer import llama_spmd
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
@@ -200,6 +209,7 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
         ep_axis="ep" if ep > 1 else None,
         tp_axis="tp" if tp > 1 else None,
         fsdp=fsdp,
+        schedule=schedule,
     )
     # SpmdGPipe shards data over the mesh; the causal shift happens on the
     # host so inputs/targets ride the same sharding specs.
