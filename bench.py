@@ -162,6 +162,75 @@ def _build_transformer(platform: str, n_stages: int):
     return model, x, y, name
 
 
+def _rung_residual_bytes(model, x) -> int | None:
+    """Device bytes of the un-rematerialized micro-batch's vjp residuals.
+
+    Under ``checkpoint='except_last'`` the last micro-batch's cells keep
+    their full vjp residuals alive between the forward and backward
+    programs; in the per-cell engine those residuals are *program
+    arguments*, so a rung whose residuals exceed HBM capacity fails at AOT
+    compile time — after minutes of remote compilation.  ``eval_shape``
+    predicts the same number in milliseconds with no compile, letting the
+    ladder skip infeasible rungs outright."""
+    try:
+        from torchgpipe_tpu.layers import sequential_init
+
+        chunks = model.chunks
+        mb = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                (a.shape[0] // chunks,) + a.shape[1:], a.dtype
+            ),
+            x,
+        )
+        flat_p, flat_s, _ = jax.eval_shape(
+            lambda: sequential_init(model.layers, jax.random.PRNGKey(0), mb)
+        )
+        total = 0
+        i = 0
+        for j, part in enumerate(model.partitions):
+            stage = model._pipeline.stages[j]
+            p_j = flat_p[i : i + len(part)]
+            s_j = flat_s[i : i + len(part)]
+            i += len(part)
+            y, _, _, pull = jax.eval_shape(
+                lambda xx, p=p_j, s=s_j, st=stage: st.fwd_vjp(
+                    p, s, xx, {}, None, 1.0 / chunks
+                ),
+                mb,
+            )
+            per_stage = sum(
+                l.size * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(pull)
+            )
+            total = max(total, per_stage)  # stages sit on different chips
+            mb = y  # next stage's input spec
+        return total
+    except Exception:
+        return None
+
+
+# HBM headroom a rung needs beyond its stored residuals: program temp
+# (~1.4G measured at batch 128), reserved (258M), params/inputs/grads.
+_RUNG_OVERHEAD_BYTES = int(2.4 * 2**30)
+
+
+def _hbm_capacity_bytes(device) -> int | None:
+    """Per-chip HBM capacity by device kind (what the AOT compiler checks
+    programs against), or None for kinds we don't know — the predictor
+    then stands down and every rung is attempted."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, gib in (
+        ("v5 lite", 15.75), ("v5e", 15.75),  # observed AOT limit
+        ("v5p", 95.0),
+        ("v6 lite", 31.25), ("v6e", 31.25),
+        ("v4", 31.75),
+        ("v3", 15.75),
+    ):
+        if key in kind:
+            return int(gib * 2**30)
+    return None
+
+
 def _backend_reachable(timeout: float = 300.0) -> bool:
     from torchgpipe_tpu.utils.backend_probe import backend_reachable
 
@@ -192,15 +261,17 @@ def main() -> None:
     # batch-128 config has been observed to both run at 442 samples/s and
     # OOM on different days).  Walk a batch ladder so the driver always
     # gets a hardware number; the tag records the config that ran.
-    ladder = [(128, 4), (96, 4), (64, 4), (32, 4)] if platform != "cpu" \
-        else [(None, None)]
+    ladder = [(128, 4), (96, 4), (64, 4), (48, 4), (32, 4)] \
+        if platform != "cpu" else [(None, None)]
     last_oom = None
     used_fallback_model = False
     for batch_cfg, chunks_cfg in ladder:
-        # (Re)built each rung: the OOM cleanup below force-deletes every
-        # live device array, including a previous rung's key.
-        rng = jax.random.PRNGKey(1)
         try:
+            # (Re)built each rung INSIDE the try: after an OOM rung even an
+            # 8-byte PRNGKey allocation has been observed to raise
+            # RESOURCE_EXHAUSTED under co-tenant HBM pressure — give the
+            # chip a moment and let the ladder handle it.
+            rng = jax.random.PRNGKey(1)
             try:
                 model, x, y, name = _build_amoebanet(
                     platform, n_stages, batch=batch_cfg, chunks=chunks_cfg
@@ -211,6 +282,39 @@ def main() -> None:
                 # config — treat it as the only rung.
                 model, x, y, name = _build_transformer(platform, n_stages)
                 used_fallback_model = True
+
+            capacity = _hbm_capacity_bytes(devices[0])
+            if (
+                platform != "cpu"
+                and not used_fallback_model
+                and capacity is not None
+                # The last rung is always ATTEMPTED (mirroring the
+                # runtime-OOM path's re-raise-on-last-rung): a
+                # miscalibrated predictor must not leave the loop with no
+                # rung ever run.
+                and (batch_cfg, chunks_cfg) != ladder[-1]
+            ):
+                resid = _rung_residual_bytes(model, x)
+                if (
+                    resid is not None
+                    and resid + _RUNG_OVERHEAD_BYTES > capacity
+                ):
+                    import sys
+
+                    print(
+                        f"bench: batch {batch_cfg} residuals "
+                        f"{resid / 2**30:.1f} GiB cannot fit "
+                        f"{capacity / 2**30:.2f} GiB HBM; "
+                        "skipping rung without compiling",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    last_oom = batch_cfg
+                    # Release the skipped rung's device arrays (x/y were
+                    # materialized by the builder) before building the
+                    # next rung — mirroring the except-path cleanup.
+                    model = x = y = None
+                    continue
 
             in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
 
@@ -241,8 +345,25 @@ def main() -> None:
             dt = time.perf_counter() - t0
             break
         except Exception as e:  # noqa: BLE001 — retry only on OOM
+            # OOM wears two shapes here: runtime RESOURCE_EXHAUSTED from a
+            # local allocation, and INTERNAL/HTTP-500 from the remote AOT
+            # compiler whose message carries XLA's "Ran out of memory in
+            # memory space hbm" text (observed when a program's arguments
+            # exceed HBM at compile time on the shared chip).
+            msg = str(e)
+            is_oom = (
+                "RESOURCE_EXHAUSTED" in msg
+                or "Ran out of memory" in msg
+                or "Exceeded hbm capacity" in msg
+                # The remote AOT compiler reports HBM-overflow as a bare
+                # HTTP 500 (the "Ran out of memory in memory space hbm"
+                # text only reaches the log stream, not the exception).
+                # Treat it as retryable: a genuinely non-OOM compile error
+                # fails every rung and the last rung re-raises.
+                or ("remote_compile" in msg and "HTTP 500" in msg)
+            )
             if (
-                "RESOURCE_EXHAUSTED" not in str(e)
+                not is_oom
                 or (batch_cfg, chunks_cfg) == ladder[-1]
                 or used_fallback_model
             ):
@@ -275,6 +396,9 @@ def main() -> None:
                     arr.delete()
             except Exception:
                 pass
+            # Shared chip: transient co-tenant HBM spikes have caused the
+            # very next allocation to fail too — breathe before retrying.
+            time.sleep(10)
 
     batch = x.shape[0]
     # Per-chip normalization: the pipeline spans n_stages chips (stages wrap

@@ -1,0 +1,134 @@
+"""Hardware validation for the Pallas flash-attention kernels.
+
+Runs BOTH kernel families (resident and streaming) on the live backend —
+no ``interpret=True`` — checking numerics against the dense XLA oracle and
+timing fwd+bwd.  This is the on-device complement to
+``tests/test_flash_attention.py`` (which runs everything in interpret mode
+on CPU): a Mosaic lowering difference that interpret mode cannot catch
+shows up here as a numerics failure.
+
+Usage::
+
+    python benchmarks/flash_attention_hw.py [--seqs 2048,4096] [--iters 20]
+
+Prints one table row per (seq, variant) with max|err| vs dense for output
+and gradients, plus fwd+bwd wall time; exits non-zero on a tolerance
+failure so it can gate a hardware CI lane.
+
+Reference anchor: the reference has no fused-attention kernels (it is
+CNN-oriented, CUDA streams only) — this is new TPU-native capability; the
+oracle-comparison pattern mirrors its transparency tests
+(reference: tests/test_transparency.py:7-42).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu.ops.flash_attention import flash_attention
+
+
+def dense_attention(q, k, v, causal=True):
+    b, s, h, d = q.shape
+    g = k.shape[2]
+    kf = jnp.repeat(k, h // g, axis=2).astype(jnp.float32)
+    vf = jnp.repeat(v, h // g, axis=2).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * (d ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(q.dtype)
+
+
+def run_case(seq, streaming, b=4, h=16, g=8, d=128, dtype=jnp.bfloat16,
+             iters=20):
+    ks = jax.random.split(jax.random.PRNGKey(seq), 4)
+    q = jax.random.normal(ks[0], (b, seq, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, seq, g, d), dtype)
+    v = jax.random.normal(ks[2], (b, seq, g, d), dtype)
+    do = jax.random.normal(ks[3], (b, seq, h, d), dtype)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, streaming=streaming)
+            .astype(jnp.float32) * do.astype(jnp.float32))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            dense_attention(q, k, v).astype(jnp.float32)
+            * do.astype(jnp.float32))
+
+    flash_g = jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2)))
+    dense_g = jax.jit(jax.value_and_grad(loss_dense, argnums=(0, 1, 2)))
+
+    out_f = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                        streaming=streaming))(q, k, v)
+    out_d = jax.jit(lambda q, k, v: dense_attention(q, k, v))(q, k, v)
+    _, grads_f = flash_g(q, k, v)
+    _, grads_d = dense_g(q, k, v)
+    jax.block_until_ready((out_f, out_d, grads_f, grads_d))
+
+    def maxerr(a, bb):
+        return float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                     - bb.astype(jnp.float32))))
+
+    out_err = maxerr(out_f, out_d)
+    grad_err = max(maxerr(gf, gd) for gf, gd in zip(grads_f, grads_d))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        val, grads = flash_g(q, k, v)
+    jax.block_until_ready((val, grads))
+    t_flash = (time.perf_counter() - t0) / iters * 1e3
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        val, grads = dense_g(q, k, v)
+    jax.block_until_ready((val, grads))
+    t_dense = (time.perf_counter() - t0) / iters * 1e3
+
+    return out_err, grad_err, t_flash, t_dense
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="2048,4096")
+    ap.add_argument("--iters", type=int, default=20)
+    # bf16 inputs with f32 accumulation: output tolerance scales with the
+    # bf16 ulp at the magnitudes involved; gradients accumulate over seq.
+    ap.add_argument("--tol-out", type=float, default=0.08)
+    ap.add_argument("--tol-grad", type=float, default=0.5)
+    args = ap.parse_args()
+
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({getattr(dev, 'device_kind', '?')})")
+    failed = False
+    print(f"{'seq':>6} {'variant':>9} {'out err':>9} {'grad err':>9} "
+          f"{'flash ms':>9} {'dense ms':>9}")
+    for seq in [int(s) for s in args.seqs.split(",")]:
+        for streaming in (False, True):
+            name = "streaming" if streaming else "resident"
+            try:
+                oe, ge, tf, td = run_case(seq, streaming, iters=args.iters)
+            except Exception as e:  # noqa: BLE001 — report and continue
+                print(f"{seq:>6} {name:>9} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:120]}")
+                failed = True
+                continue
+            ok = oe <= args.tol_out and ge <= args.tol_grad
+            failed |= not ok
+            print(f"{seq:>6} {name:>9} {oe:>9.4f} {ge:>9.4f} "
+                  f"{tf:>9.2f} {td:>9.2f}  {'ok' if ok else 'TOLERANCE-FAIL'}")
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -81,15 +81,22 @@ def causal_lm_loss(out, tokens):
                    "n_stages*dp*ep*tp devices)")
 @click.option("--dp", default=1,
               help="data-parallel mesh axis size (spmd engine)")
-@click.option("--schedule", type=click.Choice(["fill_drain", "1f1b"]),
+@click.option("--schedule",
+              type=click.Choice(["fill_drain", "1f1b", "interleaved"]),
               default="fill_drain",
               help="spmd engine schedule: 1f1b runs PipeDream-flush with "
-                   "O(n) activation memory (needs checkpoint=always)")
+                   "O(n) activation memory (needs checkpoint=always); "
+                   "interleaved adds Megatron virtual pipeline stages "
+                   "(--virtual-stages chunks per device, ~v x smaller "
+                   "bubble)")
+@click.option("--virtual-stages", default=2,
+              help="model chunks per device for --schedule interleaved")
 @click.option("--fsdp/--no-fsdp", default=False,
               help="ZeRO-3-style parameter sharding over the dp axis "
                    "(spmd engine; needs --dp > 1)")
 def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
-         checkpoint, moe_experts, moe_top_k, ep, tp, dp, schedule, fsdp):
+         checkpoint, moe_experts, moe_top_k, ep, tp, dp, schedule,
+         virtual_stages, fsdp):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     dim, n_layers, n_heads, n_kv, vocab, mlp_ratio = PRESETS[preset]
@@ -133,6 +140,7 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
         tput = _run_spmd(
             cfg, n, chunks, x, epochs, steps, checkpoint, experiment, moe,
             ep, tp, dp, fsdp, schedule,
+            virtual_stages if schedule == "interleaved" else 1,
         )
     else:
         if moe is not None:
@@ -190,17 +198,21 @@ def _print_router_stats(params, h, moe):
 
 
 def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
-              ep=1, tp=1, dp=1, fsdp=False, schedule="fill_drain"):
+              ep=1, tp=1, dp=1, fsdp=False, schedule="fill_drain",
+              virtual_stages=1):
     from benchmarks.common import run_epoch_loop
     from torchgpipe_tpu.models.transformer import llama_spmd
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
 
+    # Interleaved: the model is cut into n*v thinner blocks (device j owns
+    # chunks c*n+j), so the block builder sees the virtual stage count.
+    n_blocks = n * virtual_stages
     if moe is not None:
         from torchgpipe_tpu.models.moe import llama_moe_spmd
 
-        block, pre, post = llama_moe_spmd(cfg, moe, n)
+        block, pre, post = llama_moe_spmd(cfg, moe, n_blocks)
     else:
-        block, pre, post = llama_spmd(cfg, n)
+        block, pre, post = llama_spmd(cfg, n_blocks)
     mesh = make_mesh(n, dp=dp, ep=ep, tp=tp)
     pipe = SpmdGPipe(
         block, n, mesh, chunks=chunks, loss_fn=cross_entropy,
@@ -210,6 +222,7 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
         tp_axis="tp" if tp > 1 else None,
         fsdp=fsdp,
         schedule=schedule,
+        virtual_stages=virtual_stages,
     )
     # SpmdGPipe shards data over the mesh; the causal shift happens on the
     # host so inputs/targets ride the same sharding specs.

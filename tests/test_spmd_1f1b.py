@@ -273,8 +273,8 @@ def test_1f1b_validation_errors():
             block, pp, mesh, schedule="1f1b",
             remat_policy=jax.checkpoint_policies.everything_saveable, **ok,
         )
-    with pytest.raises(ValueError, match="fill_drain' or '1f1b"):
-        SpmdGPipe(block, pp, mesh, schedule="interleaved", **ok)
+    with pytest.raises(ValueError, match="fill_drain', '1f1b' or"):
+        SpmdGPipe(block, pp, mesh, schedule="zigzag", **ok)
     with pytest.raises(ValueError, match="sequence"):
         mesh_sp = make_mesh(2, 1, 2, devices=jax.devices()[:4])
         cfg_sp = TransformerConfig(vocab=64, dim=32, n_layers=pp, n_heads=4,

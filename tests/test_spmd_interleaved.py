@@ -1,0 +1,258 @@
+"""Interleaved-1F1B (virtual pipeline stages) schedule: tables + engine.
+
+The schedule itself has no reference counterpart (the reference implements
+fill-drain only — reference: torchgpipe/pipeline.py:49-65); the oracle
+pattern mirrors the reference's transparency tests
+(reference: tests/test_transparency.py:7-42): the interleaved engine on an
+``n``-device mesh must produce the same loss/gradients as the fill-drain
+engine running the same ``n*v`` blocks on an ``n*v``-device mesh (both
+init block ``g`` with ``fold_in(rng, g)``, so the models are identical).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.parallel.interleaved import (
+    BWD,
+    FWD,
+    IDLE,
+    interleaved_forward_tables,
+    interleaved_tables,
+)
+from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+
+# ---------------------------------------------------------------------- #
+# schedule tables                                                        #
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize(
+    "n,m,v", [(1, 2, 2), (2, 2, 1), (2, 4, 3), (4, 4, 2), (4, 8, 4), (8, 8, 2)]
+)
+def test_tables_complete_and_dependency_ordered(n, m, v):
+    tb = interleaved_tables(n, m, v)  # _validate runs inside
+    # Every device executes exactly 2*m*v cells.
+    work = (np.asarray(tb.kind) != IDLE).sum(axis=0)
+    assert (work == 2 * m * v).all()
+
+
+def test_tables_match_classic_1f1b_tick_count():
+    # v=1 degenerates to PipeDream-flush: 2m + 2(n-1) ticks.
+    for n, m in [(2, 4), (4, 8), (8, 32)]:
+        tb = interleaved_tables(n, m, 1)
+        assert tb.ticks == 2 * m + 2 * (n - 1)
+
+
+def test_interleaving_cuts_bubble():
+    # At fixed (n, m), time-to-completion in units of WORK (each cell is
+    # 1/v of a device's layers) shrinks as v grows.
+    n, m = 4, 8
+    t1 = interleaved_tables(n, m, 1).ticks  # cell = full stage
+    t2 = interleaved_tables(n, m, 2).ticks / 2
+    t4 = interleaved_tables(n, m, 4).ticks / 4
+    assert t2 < t1
+    assert t4 < t2
+
+
+def test_tables_require_divisible_chunks():
+    with pytest.raises(ValueError, match="divisible"):
+        interleaved_tables(4, 6, 2)
+
+
+def test_forward_tables_are_fill_drain_over_virtual_stages():
+    # m*v cells per device; last output lands at tick (n*v - 1) + ... the
+    # total must be >= the virtual pipeline depth.
+    tb = interleaved_forward_tables(4, 8, 2)
+    work = (np.asarray(tb.kind) != IDLE).sum(axis=0)
+    assert (work == 8 * 2).all()
+    assert tb.ticks >= 4 * 2
+
+
+# ---------------------------------------------------------------------- #
+# engine                                                                 #
+# ---------------------------------------------------------------------- #
+
+
+def _llama(n_blocks, vocab=64, dim=32):
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        cross_entropy,
+        llama_spmd,
+    )
+
+    cfg = TransformerConfig(
+        vocab=vocab, dim=dim, n_layers=n_blocks, n_heads=4, n_kv_heads=2
+    )
+    block, pre, post = llama_spmd(cfg, n_blocks)
+    return block, pre, post, cross_entropy
+
+
+def _data(batch, seq=16, vocab=64):
+    tokens = jnp.mod(
+        jnp.arange(batch * seq).reshape(batch, seq), vocab
+    ).astype(jnp.int32)
+    return tokens, jnp.mod(tokens + 1, vocab)
+
+
+def _rel_err(a, b):
+    a, b = np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))
+    return float(np.max(np.abs(a - b))) / (float(np.max(np.abs(b))) + 1e-8)
+
+
+def _to_global(a):
+    """[n, v, ...] chunk layout -> [n*v, ...] global block order g = c*n+j."""
+    nn, vv = a.shape[0], a.shape[1]
+    return jnp.transpose(a, (1, 0) + tuple(range(2, a.ndim))).reshape(
+        (nn * vv,) + a.shape[2:]
+    )
+
+
+@pytest.mark.parametrize("n,v,m", [(2, 2, 4), (4, 2, 8), (2, 4, 4)])
+def test_interleaved_matches_fill_drain_oracle(n, v, m):
+    block, pre, post, loss_fn = _llama(n * v)
+    mesh = make_mesh(n, 1, devices=jax.devices()[:n])
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=m, loss_fn=loss_fn, pre=pre, post=post,
+        checkpoint="always", schedule="interleaved", virtual_stages=v,
+    )
+    tokens, labels = _data(m * 2)
+    in_spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    params = pipe.init(jax.random.PRNGKey(0), in_spec)
+    loss, grads = pipe.train_step(params, tokens, labels)
+
+    mesh_o = make_mesh(n * v, 1, devices=jax.devices()[: n * v])
+    oracle = SpmdGPipe(
+        block, n * v, mesh_o, chunks=m, loss_fn=loss_fn, pre=pre, post=post,
+        checkpoint="always",
+    )
+    params_o = oracle.init(jax.random.PRNGKey(0), in_spec)
+    loss_o, grads_o = oracle.train_step(params_o, tokens, labels)
+
+    assert abs(float(loss) - float(loss_o)) < 1e-4
+    gi = jax.tree_util.tree_map(_to_global, grads["blocks"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(gi),
+        jax.tree_util.tree_leaves(grads_o["blocks"]),
+    ):
+        assert _rel_err(a, b) < 1e-4
+    for k in ("pre", "post"):
+        for a, b in zip(
+            jax.tree_util.tree_leaves(grads[k]),
+            jax.tree_util.tree_leaves(grads_o[k]),
+        ):
+            assert _rel_err(a, b) < 1e-4
+
+    # Inference path: forward-only table scan.
+    out = pipe.apply(params, tokens)
+    out_o = oracle.apply(params_o, tokens)
+    assert _rel_err(out, out_o) < 1e-4
+
+
+def test_interleaved_composes_with_dp():
+    n, v, m, dp = 2, 2, 4, 2
+    block, pre, post, loss_fn = _llama(n * v)
+    mesh = make_mesh(n, dp, devices=jax.devices()[: n * dp])
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=m, loss_fn=loss_fn, pre=pre, post=post,
+        checkpoint="always", schedule="interleaved", virtual_stages=v,
+        dp_axis="dp",
+    )
+    tokens, labels = _data(m * dp * 2)
+    in_spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    params = pipe.init(jax.random.PRNGKey(0), in_spec)
+    loss, grads = pipe.train_step(params, tokens, labels)
+
+    mesh_o = make_mesh(n * v, 1, devices=jax.devices()[: n * v])
+    oracle = SpmdGPipe(
+        block, n * v, mesh_o, chunks=m, loss_fn=loss_fn, pre=pre, post=post,
+        checkpoint="always",
+    )
+    params_o = oracle.init(jax.random.PRNGKey(0), in_spec)
+    loss_o, grads_o = oracle.train_step(params_o, tokens, labels)
+    assert abs(float(loss) - float(loss_o)) < 1e-4
+    gi = jax.tree_util.tree_map(_to_global, grads["blocks"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(gi),
+        jax.tree_util.tree_leaves(grads_o["blocks"]),
+    ):
+        assert _rel_err(a, b) < 1e-4
+
+
+def test_interleaved_composes_with_fsdp():
+    n, v, m, dp = 2, 2, 4, 2
+    block, pre, post, loss_fn = _llama(n * v)
+    mesh = make_mesh(n, dp, devices=jax.devices()[: n * dp])
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=m, loss_fn=loss_fn, pre=pre, post=post,
+        checkpoint="always", schedule="interleaved", virtual_stages=v,
+        dp_axis="dp", fsdp=True,
+    )
+    tokens, labels = _data(m * dp * 2)
+    in_spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    params = pipe.init(jax.random.PRNGKey(0), in_spec)
+    loss, grads = pipe.train_step(params, tokens, labels)
+    assert np.isfinite(float(loss))
+
+    mesh_o = make_mesh(n * v, 1, devices=jax.devices()[: n * v])
+    oracle = SpmdGPipe(
+        block, n * v, mesh_o, chunks=m, loss_fn=loss_fn, pre=pre, post=post,
+        checkpoint="always",
+    )
+    params_o = oracle.init(jax.random.PRNGKey(0), in_spec)
+    loss_o, _ = oracle.train_step(params_o, tokens, labels)
+    assert abs(float(loss) - float(loss_o)) < 1e-4
+
+
+def test_interleaved_with_rng_dropout_runs():
+    from torchgpipe_tpu.layers import chain
+    from torchgpipe_tpu.ops import nn
+
+    n, v, m = 2, 2, 4
+    block = chain([nn.dense(32), nn.dropout(0.1), nn.gelu()], name="blk")
+    mesh = make_mesh(n, 1, devices=jax.devices()[:n])
+    mse = lambda o, t: jnp.mean((o.astype(jnp.float32) - t) ** 2)  # noqa: E731
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=m, loss_fn=mse,
+        checkpoint="always", schedule="interleaved", virtual_stages=v,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(5), (m * 2, 16, 32))
+    y = jax.random.normal(jax.random.PRNGKey(6), (m * 2, 16, 32))
+    in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    params = pipe.init(jax.random.PRNGKey(0), in_spec)
+    loss, grads = pipe.train_step(params, x, y, jax.random.PRNGKey(7))
+    assert np.isfinite(float(loss))
+    # Determinism: same rng -> identical loss.
+    loss2, _ = pipe.train_step(params, x, y, jax.random.PRNGKey(7))
+    assert float(loss) == float(loss2)
+    # Different rng -> different dropout masks -> different loss.
+    loss3, _ = pipe.train_step(params, x, y, jax.random.PRNGKey(8))
+    assert float(loss) != float(loss3)
+
+
+def test_interleaved_validation_errors():
+    n, v = 2, 2
+    block, pre, post, loss_fn = _llama(n * v)
+    mesh = make_mesh(n, 1, devices=jax.devices()[:n])
+    with pytest.raises(ValueError, match="virtual_stages >= 2"):
+        SpmdGPipe(
+            block, n, mesh, chunks=4, loss_fn=loss_fn,
+            schedule="interleaved", virtual_stages=1,
+        )
+    with pytest.raises(ValueError, match="divisible by n_stages"):
+        SpmdGPipe(
+            block, n, mesh, chunks=3, loss_fn=loss_fn,
+            schedule="interleaved", virtual_stages=v,
+        )
+    with pytest.raises(ValueError, match="only applies"):
+        SpmdGPipe(
+            block, n, mesh, chunks=4, loss_fn=loss_fn,
+            schedule="1f1b", virtual_stages=2,
+        )
+    with pytest.raises(ValueError, match="checkpoint='always'"):
+        SpmdGPipe(
+            block, n, mesh, chunks=4, loss_fn=loss_fn,
+            schedule="interleaved", virtual_stages=v, checkpoint="never",
+        )

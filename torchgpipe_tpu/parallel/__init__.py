@@ -7,6 +7,11 @@ mesh axis composes with the SPMD pipeline's ``pp`` and ``dp`` axes in one
 compiled program.
 """
 
+from torchgpipe_tpu.parallel.interleaved import (  # noqa: F401
+    InterleavedTables,
+    interleaved_forward_tables,
+    interleaved_tables,
+)
 from torchgpipe_tpu.parallel.ring_attention import (  # noqa: F401
     attention,
     full_attention,

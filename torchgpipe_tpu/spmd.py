@@ -135,6 +135,78 @@ def broadcast_specs(prefix: Pytree, tree: Pytree) -> Pytree:
     )
 
 
+def _interleaved_rows(tb):
+    """Schedule tables as scan xs: per-tick (kind, chunk, mb) rows plus the
+    previous tick's rows (tick -1 = all idle), for sender classification."""
+    from torchgpipe_tpu.parallel.interleaved import IDLE
+
+    n = tb.n
+    kind_t = jnp.asarray(tb.kind)
+    chunk_t = jnp.asarray(tb.chunk)
+    mb_t = jnp.asarray(tb.mb)
+    pad = jnp.full((1, n), IDLE, jnp.int32)
+    zrow = jnp.zeros((1, n), jnp.int32)
+    return (
+        kind_t,
+        chunk_t,
+        mb_t,
+        jnp.concatenate([pad, kind_t[:-1]], 0),
+        jnp.concatenate([zrow, chunk_t[:-1]], 0),
+        jnp.concatenate([zrow, mb_t[:-1]], 0),
+    )
+
+
+def _sub_key(base, i):
+    """Per-micro-batch sub-key, or None when running without rng."""
+    return None if base is None else jax.random.fold_in(base, i)
+
+
+def _slot_read(buf, idx):
+    """Read slot ``idx`` from a stacked ring-buffer pytree."""
+    return jax.tree_util.tree_map(
+        lambda b: lax.dynamic_index_in_dim(b, idx, 0, keepdims=False), buf
+    )
+
+
+def _slot_write(buf, idx, val, valid):
+    """Write ``val`` into slot ``idx`` where ``valid``, else keep."""
+    cur = _slot_read(buf, idx)
+    new = jax.tree_util.tree_map(
+        lambda c_, v_: jnp.where(valid, v_, c_), cur, val
+    )
+    return jax.tree_util.tree_map(
+        lambda b, nv: lax.dynamic_update_index_in_dim(b, nv, idx, 0),
+        buf,
+        new,
+    )
+
+
+def _classify_fwd_recv(stage, n, v, S, pkrow, pcrow, pirow):
+    """Forward-ring receive routing: the value arriving at this tick is
+    whatever the ring predecessor computed last tick.  Returns the inbox
+    slot index and a validity mask (the wrap n-1 -> 0 advances the chunk;
+    the final chunk's last-stage output has no forward consumer)."""
+    from torchgpipe_tpu.parallel.interleaved import FWD
+
+    src = jnp.mod(stage - 1, n)
+    pk, pc, pi = pkrow[src], pcrow[src], pirow[src]
+    valid = (pk == FWD) & jnp.logical_not((stage == 0) & (pc == v - 1))
+    tc = jnp.clip(jnp.where(stage == 0, pc + 1, pc), 0, v - 1)
+    return tc * S + pi % S, valid
+
+
+def _classify_bwd_recv(stage, n, v, S, pkrow, pcrow, pirow):
+    """Backward-ring receive routing (the wrap 0 -> n-1 retreats the chunk;
+    chunk 0's input cotangent leaves the model and is discarded)."""
+    from torchgpipe_tpu.parallel.interleaved import BWD
+
+    src = jnp.mod(stage + 1, n)
+    pk, pc, pi = pkrow[src], pcrow[src], pirow[src]
+    valid = (pk == BWD) & jnp.logical_not((stage == n - 1) & (pc == 0))
+    tc = jnp.clip(jnp.where(stage == n - 1, pc - 1, pc), 0, v - 1)
+    return tc * S + pi % S, valid
+
+
 def _shard_map(fn, mesh, in_specs, out_specs):
     try:
         return jax.shard_map(
@@ -218,15 +290,23 @@ class SpmdGPipe:
     ep_axis: Optional[str] = None
     loss_reduction: Optional[str] = "mean"
     fsdp: bool = False
-    # 'fill_drain' (GPipe; reference pipeline.py:49-65) or '1f1b'
-    # (one-forward-one-backward, PipeDream-flush): same bubble, but the
-    # schedule interleaves each micro-batch's backward with later
-    # forwards, capping in-flight activations per stage at ~n instead of
-    # m.  The 1F1B program computes gradients EXPLICITLY inside the scan
-    # (per-cell jax.vjp with recompute — checkpoint='always' semantics),
-    # so it needs a micro-batch-decomposable loss (loss_reduction
-    # 'mean'/'sum').
+    # 'fill_drain' (GPipe; reference pipeline.py:49-65), '1f1b'
+    # (one-forward-one-backward, PipeDream-flush) or 'interleaved'
+    # (Megatron virtual pipeline stages, arXiv:2104.04473 §2.2).  1F1B:
+    # same bubble as fill-drain, but the schedule interleaves each
+    # micro-batch's backward with later forwards, capping in-flight
+    # activations per stage at ~n instead of m.  Interleaved: each device
+    # additionally owns ``virtual_stages`` non-adjacent model chunks, so
+    # the fill/drain bubble shrinks by ~v on top of 1F1B's memory bound.
+    # Both compute gradients EXPLICITLY inside the scan (per-cell jax.vjp
+    # with recompute — checkpoint='always' semantics) and need a
+    # micro-batch-decomposable loss (loss_reduction 'mean'/'sum').
     schedule: str = "fill_drain"
+    # Model chunks per device for schedule='interleaved' (v >= 2; the
+    # model then has n_stages * virtual_stages blocks, device j holding
+    # global blocks c*n + j for c in range(v) — Megatron's round-robin
+    # assignment).  Must be 1 for the other schedules.
+    virtual_stages: int = 1
 
     def __repr__(self) -> str:
         axes = {
@@ -238,6 +318,7 @@ class SpmdGPipe:
                 ("loss_reduction", self.loss_reduction, "mean"),
                 ("fsdp", self.fsdp, False),
                 ("schedule", self.schedule, "fill_drain"),
+                ("virtual_stages", self.virtual_stages, 1),
             )
             if v != default
         )
@@ -294,30 +375,51 @@ class SpmdGPipe:
                 "needs a batch-decomposable loss: set loss_reduction='mean' "
                 "or 'sum'"
             )
-        if self.schedule not in ("fill_drain", "1f1b"):
-            raise ValueError("schedule must be 'fill_drain' or '1f1b'")
-        if self.schedule == "1f1b":
+        if self.schedule not in ("fill_drain", "1f1b", "interleaved"):
+            raise ValueError(
+                "schedule must be 'fill_drain', '1f1b' or 'interleaved'"
+            )
+        if self.schedule == "interleaved":
+            if self.virtual_stages < 2:
+                raise ValueError(
+                    "schedule='interleaved' needs virtual_stages >= 2 "
+                    "(with one chunk per device it degenerates to "
+                    "schedule='1f1b' — use that instead)"
+                )
+            if self.chunks % self.n_stages != 0:
+                raise ValueError(
+                    f"schedule='interleaved' needs chunks ({self.chunks}) "
+                    f"divisible by n_stages ({self.n_stages}): Megatron's "
+                    "micro-batch grouping (arXiv:2104.04473 §2.2) assumes "
+                    "full groups"
+                )
+        elif self.virtual_stages != 1:
+            raise ValueError(
+                "virtual_stages only applies to schedule='interleaved'"
+            )
+        if self.schedule in ("1f1b", "interleaved"):
+            sched = f"schedule={self.schedule!r}"
             if self.loss_reduction is None:
                 raise ValueError(
-                    "schedule='1f1b' computes per-micro-batch losses inside "
+                    f"{sched} computes per-micro-batch losses inside "
                     "the schedule, so the loss must decompose over "
                     "micro-batches: set loss_reduction='mean' or 'sum'"
                 )
             if self.checkpoint != "always":
                 raise ValueError(
-                    "schedule='1f1b' recomputes each cell in its backward "
+                    f"{sched} recomputes each cell in its backward "
                     "tick (checkpoint='always' semantics are built in); "
                     "set checkpoint='always', or use schedule='fill_drain' "
                     f"for checkpoint={self.checkpoint!r}"
                 )
             if self.remat_policy is not None:
                 raise ValueError(
-                    "schedule='1f1b' hand-writes the per-cell recompute; "
+                    f"{sched} hand-writes the per-cell recompute; "
                     "remat_policy does not apply (use schedule='fill_drain')"
                 )
             if self.sp_axis is not None:
                 raise ValueError(
-                    "schedule='1f1b' does not compose with sequence "
+                    f"{sched} does not compose with sequence "
                     "parallelism: ring attention's sp ppermutes would sit "
                     "inside the schedule's fwd/bwd conditional, whose "
                     "branches only some pipeline stages execute on a given "
@@ -385,6 +487,21 @@ class SpmdGPipe:
         # any per-leaf sharding the layers declare (tensor/expert-parallel
         # weights) — see layer_param_specs.
         self._blocks_spec = layer_param_specs(self.block, self.pp_axis)
+        if self.virtual_stages > 1:
+            # Blocks are stored ``[n, v, ...]`` (stage dim sharded over pp,
+            # chunk dim device-local): declared per-stage specs gain a
+            # replicated chunk dim at position 1.  Bare ``P(pp)`` prefixes
+            # already leave later dims replicated and stay as-is.
+            def _with_chunk_dim(spec):
+                if len(spec) <= 1:
+                    return spec
+                return P(spec[0], None, *tuple(spec)[1:])
+
+            self._blocks_spec = jax.tree_util.tree_map(
+                _with_chunk_dim,
+                self._blocks_spec,
+                is_leaf=lambda x: isinstance(x, P),
+            )
         # Pre/post are replicated over pp but may declare their own leaf
         # sharding (e.g. the vocab-parallel embedding/head under tp).
         self._pre_spec = (
@@ -453,6 +570,59 @@ class SpmdGPipe:
             blocks_local,
             self._fsdp_dims,
         )
+
+    # ------------------------------------------------------------------ #
+    # per-cell helpers shared by the explicit-gradient schedules         #
+    # (1F1B and interleaved)                                            #
+    # ------------------------------------------------------------------ #
+
+    def _cell_input_splice(self, p_pre, first, i, fallback, x_mb, pre_base):
+        """The model's first block input (``pre`` applied to the raw
+        micro-batch) where ``first`` holds for this cell; ``fallback`` (the
+        ring hand-off, or the saved input in backward cells) elsewhere.
+
+        ``pre`` (e.g. the embedding) runs per cell INSIDE the scan — the
+        raw inputs ``x_mb`` it reads are engine inputs (tokens), so no
+        O(m) stack of pre outputs ever materializes.  In backward cells
+        the recompute doubles as the pre-gradient path: the splice routes
+        the first cell's input cotangent through ``pre`` to its
+        parameters, while every other cell's splice is dead and
+        contributes zeros (keys match the forward cell, so the recomputed
+        value is bit-identical).  The aux-injection scale is masked by the
+        same predicate so only the real ``pre`` application counts.
+        """
+        tmap = jax.tree_util.tree_map
+        raw = tmap(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False), x_mb
+        )
+        if self.pre is None:
+            return tmap(
+                lambda inp, r: jnp.where(first, inp, r), raw, fallback
+            )
+        with aux_scale(jnp.where(first, 1.0 / self.chunks, 0.0)):
+            x0, _ = self.pre.apply(
+                p_pre, (), raw, rng=_sub_key(pre_base, i), train=True
+            )
+        return tmap(lambda a, r: jnp.where(first, a, r), x0, fallback)
+
+    def _cell_mb_loss(self, y, p_post, i, tgt_mb, post_base):
+        """Per-micro-batch head + loss for a final cell (aux scale 1/m:
+        the m cells average to one mini-batch, mirroring the fill-drain
+        head's 1/n over n batch slices)."""
+        tmap = jax.tree_util.tree_map
+        if self.post is not None:
+            with aux_scale(1.0 / self.chunks):
+                y, _ = self.post.apply(
+                    p_post, (), y, rng=_sub_key(post_base, i), train=True
+                )
+        tgt_i = tmap(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            tgt_mb,
+        )
+        loss_i = self.loss_fn(y, tgt_i).astype(jnp.float32)
+        if self.loss_reduction == "mean":
+            loss_i = loss_i / self.chunks
+        return loss_i
 
     # ------------------------------------------------------------------ #
     # cross-axis gradient reductions (shared by both schedules)          #
@@ -547,16 +717,40 @@ class SpmdGPipe:
                 _zeros(spec),
             )
 
-        block_params = []
-        for j in range(self.n_stages):
-            p, s = self.block.init(jax.random.fold_in(rng, j), spec)
-            self._check_stateless(s, "block")
-            block_params.append(p)
+        v = self.virtual_stages
+        if v > 1:
+            # [n, v, ...]: device j's chunk c is global block c*n + j
+            # (Megatron round-robin; the model executes blocks in global
+            # order 0..n*v-1, visiting each device v times).
+            block_params = []
+            for j in range(self.n_stages):
+                chunks_j = []
+                for c in range(v):
+                    g = c * self.n_stages + j
+                    p, s = self.block.init(jax.random.fold_in(rng, g), spec)
+                    self._check_stateless(s, "block")
+                    chunks_j.append(p)
+                block_params.append(
+                    jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(xs), *chunks_j
+                    )
+                )
+        else:
+            block_params = []
+            for j in range(self.n_stages):
+                p, s = self.block.init(jax.random.fold_in(rng, j), spec)
+                self._check_stateless(s, "block")
+                block_params.append(p)
+        probe = (
+            jax.tree_util.tree_map(lambda a: a[0], block_params[0])
+            if v > 1
+            else block_params[0]
+        )
         out_spec, _ = jax.eval_shape(
             lambda pp, x: self.block.apply(
                 pp, (), x, rng=jax.random.PRNGKey(0), train=True
             ),
-            block_params[0],
+            probe,
             _zeros(spec),
         )
         if jax.tree_util.tree_structure(out_spec) != jax.tree_util.tree_structure(spec) or any(
@@ -834,7 +1028,6 @@ class SpmdGPipe:
         ``vjp_pre`` call turns them into pre-parameter gradients.
         """
         n, m = self.n_stages, self.chunks
-        mean = self.loss_reduction == "mean"
         data_spec = self._data_specs()
         tmap = jax.tree_util.tree_map
 
@@ -864,12 +1057,6 @@ class SpmdGPipe:
             # Valid cells always carry scale 1/m (invalid ticks take the
             # idle branch, so no masking is needed as in _local_pipeline).
             aux_s = 1.0 / m
-            # pre's aux-gradient scale is stage-masked like the fill-drain
-            # path: its parameters are differentiated on every lane (the
-            # splice in stage_input), but only stage 0's contribution is
-            # real.
-            pre_aux = jnp.where(stage == 0, 1.0 / m, 0.0)
-
             def cell_key(i):
                 # Matches the fill-drain cell key fold_in(fold_in(rng, t),
                 # stage) at t = i + stage, so both schedules (and the
@@ -880,61 +1067,15 @@ class SpmdGPipe:
                     jax.random.fold_in(rng, i + stage), stage
                 )
 
-            def sub_key(base, i):
-                return None if base is None else jax.random.fold_in(base, i)
-
-            def raw_input(i):
-                return tmap(
-                    lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
-                    x_mb,
-                )
-
             def stage_input(p_pre, i, fallback):
-                """Stage 0's block input for micro-batch ``i`` spliced over
-                ``fallback`` (the ppermute hand-off, or the saved input in
-                backward cells).
-
-                ``pre`` (e.g. the embedding) runs per cell INSIDE the scan —
-                the raw inputs ``x_mb`` it reads are engine inputs (tokens),
-                so no O(m) stack of pre outputs ever materializes, keeping
-                the schedule's activation footprint at O(n).  In backward
-                cells the recompute doubles as the pre-gradient path: the
-                splice routes stage 0's input cotangent through ``pre`` to
-                its parameters, while every other stage's splice is dead and
-                contributes zeros (keys match the forward cell, so the
-                recomputed value is bit-identical).
-                """
-                if self.pre is None:
-                    return tmap(
-                        lambda inp, r: jnp.where(stage == 0, inp, r),
-                        raw_input(i),
-                        fallback,
-                    )
-                with aux_scale(pre_aux):
-                    x0, _ = self.pre.apply(
-                        p_pre, (), raw_input(i),
-                        rng=sub_key(pre_base, i), train=True,
-                    )
-                return tmap(
-                    lambda a, r: jnp.where(stage == 0, a, r), x0, fallback
+                # Shared splice helper (see _cell_input_splice): 1F1B's
+                # "first" cell is any stage-0 cell.
+                return self._cell_input_splice(
+                    p_pre, stage == 0, i, fallback, x_mb, pre_base
                 )
 
             def mb_loss(y, p_post, i):
-                if self.post is not None:
-                    # Per-micro-batch head application: aux scale 1/m (the
-                    # m cells average to one mini-batch, mirroring the
-                    # fill-drain head's 1/n over n batch slices).
-                    with aux_scale(aux_s):
-                        y, _ = self.post.apply(
-                            p_post, (), y,
-                            rng=sub_key(post_base, i), train=True,
-                        )
-                tgt_i = tmap(
-                    lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
-                    tgt_mb,
-                )
-                loss_i = self.loss_fn(y, tgt_i).astype(jnp.float32)
-                return loss_i / m if mean else loss_i
+                return self._cell_mb_loss(y, p_post, i, tgt_mb, post_base)
 
             act_spec = jax.eval_shape(
                 lambda p, x: self._block_fn_plain(p, x, None, aux_s, False),
@@ -1098,9 +1239,253 @@ class SpmdGPipe:
         )
         return jax.jit(mapped)
 
+    def _build_train_step_interleaved(self, use_rng: bool):
+        """Training step under the interleaved-1F1B (virtual pipeline
+        stages) schedule.
+
+        Megatron-style (arXiv:2104.04473 §2.2): each device owns ``v``
+        non-adjacent model chunks, so the fill/drain bubble shrinks by ~v
+        while activation memory stays bounded by the schedule's in-flight
+        window (O(n·v) cells, never O(m)).  The schedule is a *static
+        table* computed by lockstep list-scheduling in Python
+        (:mod:`torchgpipe_tpu.parallel.interleaved`) and scanned over: one
+        forward and one backward ``ppermute`` per tick move activations
+        j→j+1 (wrapping n-1→0 advances the chunk index) and cotangents
+        j→j-1 (wrapping 0→n-1 retreats it); a receiver classifies the
+        incoming value from the *sender's* table row for the previous tick
+        and files it into a per-(chunk, mb mod S) ring-buffer slot whose
+        depth S the table generator proves collision-free.
+
+        Backward cells recompute their forward from the saved (spliced)
+        input per cell — checkpoint='always' semantics, like the 1F1B
+        path.  No reference counterpart: the reference has fill-drain only
+        (reference: torchgpipe/pipeline.py:49-65).
+        """
+        from torchgpipe_tpu.parallel.interleaved import (
+            BWD,
+            FWD,
+            interleaved_tables,
+        )
+
+        n, m, v = self.n_stages, self.chunks, self.virtual_stages
+        tb = interleaved_tables(n, m, v)
+        S = tb.slots
+        data_spec = self._data_specs()
+        tmap = jax.tree_util.tree_map
+        rows_xs = _interleaved_rows(tb)
+
+        def local(params, x_mb, tgt_mb, rng=None):
+            stage = lax.axis_index(self.pp_axis)
+            perm_f = [(i, (i + 1) % n) for i in range(n)]
+            perm_b = [(i, (i - 1) % n) for i in range(n)]
+
+            blocks_in = (
+                self._gather_fsdp(params["blocks"])
+                if self.fsdp
+                else params["blocks"]
+            )
+            params_local = tmap(lambda a: a[0], blocks_in)  # [v, ...]
+            pre_params = params["pre"] if self.pre is not None else ()
+            post_params = params["post"] if self.post is not None else ()
+            pre_base = (
+                jax.random.fold_in(rng, 0x7FFFFFFF) if rng is not None else None
+            )
+            post_base = (
+                jax.random.fold_in(rng, 0x7FFFFFFE) if rng is not None else None
+            )
+            aux_s = 1.0 / m
+
+            def p_of(c):
+                return tmap(
+                    lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                    params_local,
+                )
+
+            def cell_key(c, i):
+                if rng is None:
+                    return None
+                g = c * n + stage
+                return jax.random.fold_in(jax.random.fold_in(rng, i + g), g)
+
+            def splice(p_pre, c, i, fallback):
+                # Shared splice helper: the interleaved schedule's "first"
+                # cell is (stage 0, chunk 0) — global block 0.
+                return self._cell_input_splice(
+                    p_pre, (stage == 0) & (c == 0), i, fallback, x_mb,
+                    pre_base,
+                )
+
+            def mb_loss(y, p_post, i):
+                return self._cell_mb_loss(y, p_post, i, tgt_mb, post_base)
+
+            act_spec = jax.eval_shape(
+                lambda p, x: self._block_fn_plain(p, x, None, aux_s, False),
+                p_of(0),
+                tmap(lambda a: jnp.zeros(a.shape[1:], a.dtype), x_mb)
+                if self.pre is None
+                else jax.eval_shape(
+                    lambda p, x: self.pre.apply(p, (), x, rng=None, train=False)[0],
+                    pre_params,
+                    tmap(lambda a: jnp.zeros(a.shape[1:], a.dtype), x_mb),
+                ),
+            )
+            act0 = tmap(lambda s: jnp.zeros(s.shape, s.dtype), act_spec)
+            box0 = tmap(
+                lambda s: jnp.zeros((v * S,) + s.shape, s.dtype), act_spec
+            )
+            carry0 = dict(
+                act=act0,
+                gact=act0,
+                inbox=box0,  # received/saved forward inputs, slot c*S + i%S
+                gbox=box0,   # received cotangents, same slot layout
+                gblk=tmap(jnp.zeros_like, params_local),
+                gpre=tmap(jnp.zeros_like, pre_params),
+                gpost=tmap(jnp.zeros_like, post_params),
+                loss=jnp.float32(0.0),
+            )
+
+            def tick(carry, rows):
+                krow, crow, irow, pkrow, pcrow, pirow = rows
+                recv_f = tmap(
+                    lambda a: lax.ppermute(a, self.pp_axis, perm_f),
+                    carry["act"],
+                )
+                recv_b = tmap(
+                    lambda a: lax.ppermute(a, self.pp_axis, perm_b),
+                    carry["gact"],
+                )
+                # File the incoming values by the SENDER's previous-tick
+                # action (the tables are the single source of truth for
+                # routing).
+                idx_f, valid_f = _classify_fwd_recv(
+                    stage, n, v, S, pkrow, pcrow, pirow
+                )
+                inbox = _slot_write(carry["inbox"], idx_f, recv_f, valid_f)
+                idx_b, valid_b = _classify_bwd_recv(
+                    stage, n, v, S, pkrow, pcrow, pirow
+                )
+                gbox = _slot_write(carry["gbox"], idx_b, recv_b, valid_b)
+                carry = dict(carry, inbox=inbox, gbox=gbox)
+
+                k = krow[stage]
+                c = crow[stage]
+                i = irow[stage]
+                idx = c * S + i % S
+
+                def fwd_branch(cr):
+                    x_f = splice(pre_params, c, i, _slot_read(cr["inbox"], idx))
+                    y = self._block_fn_plain(
+                        p_of(c), x_f, cell_key(c, i), aux_s, True
+                    )
+                    # Keep the spliced input for this cell's backward
+                    # recompute (same slot: the table generator's liveness
+                    # check covers receive -> backward-read).
+                    return dict(
+                        cr,
+                        act=y,
+                        inbox=_slot_write(cr["inbox"], idx, x_f, True),
+                    )
+
+                def bwd_branch(cr):
+                    x_saved = _slot_read(cr["inbox"], idx)
+                    key = cell_key(c, i)
+
+                    def through_block(p_blk, p_pre, x):
+                        xin = splice(p_pre, c, i, x)
+                        return self._block_fn_plain(
+                            p_blk, xin, key, aux_s, True
+                        )
+
+                    def last_fn():
+                        def full(p_blk, p_pre, p_post, x):
+                            y = through_block(p_blk, p_pre, x)
+                            return mb_loss(y, p_post, i)
+
+                        loss_i, (d_blk, d_pre, d_post, dx) = jax.value_and_grad(
+                            full, argnums=(0, 1, 2, 3)
+                        )(p_of(c), pre_params, post_params, x_saved)
+                        return loss_i, d_blk, d_pre, d_post, dx
+
+                    def mid_fn():
+                        _, vjp_cell = jax.vjp(
+                            through_block, p_of(c), pre_params, x_saved
+                        )
+                        d_blk, d_pre, dx = vjp_cell(_slot_read(cr["gbox"], idx))
+                        return (
+                            jnp.float32(0.0),
+                            d_blk,
+                            d_pre,
+                            tmap(jnp.zeros_like, post_params),
+                            dx,
+                        )
+
+                    loss_i, d_blk, d_pre, d_post, dx = lax.cond(
+                        (stage == n - 1) & (c == v - 1), last_fn, mid_fn
+                    )
+                    gblk = tmap(
+                        lambda G, d: lax.dynamic_update_index_in_dim(
+                            G,
+                            lax.dynamic_index_in_dim(
+                                G, c, 0, keepdims=False
+                            )
+                            + d,
+                            c,
+                            0,
+                        ),
+                        cr["gblk"],
+                        d_blk,
+                    )
+                    return dict(
+                        cr,
+                        gact=dx,
+                        gblk=gblk,
+                        gpre=tmap(jnp.add, cr["gpre"], d_pre),
+                        gpost=tmap(jnp.add, cr["gpost"], d_post),
+                        loss=cr["loss"] + loss_i,
+                    )
+
+                sel = jnp.where(k == FWD, 0, jnp.where(k == BWD, 1, 2))
+                carry = lax.switch(
+                    sel, [fwd_branch, bwd_branch, lambda cr: cr], carry
+                )
+                return carry, ()
+
+            carry, _ = lax.scan(tick, carry0, rows_xs)
+            loss = lax.psum(carry["loss"], self.pp_axis)
+            grads = {"blocks": tmap(lambda g: g[None], carry["gblk"])}
+            if self.pre is not None:
+                grads["pre"] = lax.psum(carry["gpre"], self.pp_axis)
+            if self.post is not None:
+                grads["post"] = lax.psum(carry["gpost"], self.pp_axis)
+            loss, grads = self._reduce_dp(loss, grads, scatter_blocks=True)
+            loss, grads = self._reduce_ep(loss, grads)
+            return loss, grads
+
+        param_specs = {
+            "blocks": self._fsdp_specs if self.fsdp else self._blocks_spec
+        }
+        if self.pre is not None:
+            param_specs["pre"] = self._pre_spec
+        if self.post is not None:
+            param_specs["post"] = self._post_spec
+
+        if use_rng:
+            in_specs = (param_specs, data_spec, data_spec, P())
+        else:
+            in_specs = (param_specs, data_spec, data_spec)
+        mapped = _shard_map(
+            local,
+            self.mesh,
+            in_specs=in_specs,
+            out_specs=(P(), param_specs),
+        )
+        return jax.jit(mapped)
+
     def _build_train_step(self, use_rng: bool):
         if self.schedule == "1f1b":
             return self._build_train_step_1f1b(use_rng)
+        if self.schedule == "interleaved":
+            return self._build_train_step_interleaved(use_rng)
         n = self.n_stages
         data_spec = self._data_specs()
 
@@ -1337,13 +1722,145 @@ class SpmdGPipe:
         )
         return jax.jit(mapped)
 
+    def _build_apply_interleaved(self):
+        """Forward-only interleaved pipeline (fill-drain over the n·v
+        virtual stages, round-robin device mapping) for inference."""
+        from torchgpipe_tpu.parallel.interleaved import (
+            FWD,
+            interleaved_forward_tables,
+        )
+
+        n, m, v = self.n_stages, self.chunks, self.virtual_stages
+        tb = interleaved_forward_tables(n, m, v)
+        S = tb.slots
+        data_spec = self._data_specs()
+        tmap = jax.tree_util.tree_map
+        out_gather = (
+            _declared_axes(self.post, "out_gather") if self.post else []
+        )
+        rows_xs = _interleaved_rows(tb)
+
+        def local(params, x_mb):
+            stage = lax.axis_index(self.pp_axis)
+            perm_f = [(i, (i + 1) % n) for i in range(n)]
+            if self.pre is not None:
+                x_mb = self._apply_pre(params["pre"], x_mb, None, False)
+            blocks_in = (
+                self._gather_fsdp(params["blocks"])
+                if self.fsdp
+                else params["blocks"]
+            )
+            params_local = tmap(lambda a: a[0], blocks_in)
+
+            def p_of(c):
+                return tmap(
+                    lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                    params_local,
+                )
+
+            act_spec = tmap(
+                lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), x_mb
+            )
+            act0 = tmap(lambda s: jnp.zeros(s.shape, s.dtype), act_spec)
+            carry0 = dict(
+                act=act0,
+                inbox=tmap(
+                    lambda s: jnp.zeros((v * S,) + s.shape, s.dtype), act_spec
+                ),
+                outs=tmap(
+                    lambda s: jnp.zeros((m,) + s.shape, s.dtype), act_spec
+                ),
+            )
+
+            def tick(carry, rows):
+                krow, crow, irow, pkrow, pcrow, pirow = rows
+                recv_f = tmap(
+                    lambda a: lax.ppermute(a, self.pp_axis, perm_f),
+                    carry["act"],
+                )
+                idx_f, valid_f = _classify_fwd_recv(
+                    stage, n, v, S, pkrow, pcrow, pirow
+                )
+                inbox = _slot_write(carry["inbox"], idx_f, recv_f, valid_f)
+                carry = dict(carry, inbox=inbox)
+                k, c, i = krow[stage], crow[stage], irow[stage]
+                idx = c * S + i % S
+
+                def fwd_branch(cr):
+                    first = (stage == 0) & (c == 0)
+                    x_f = tmap(
+                        lambda inp, r: jnp.where(first, inp, r),
+                        _slot_read(x_mb, i),
+                        _slot_read(cr["inbox"], idx),
+                    )
+                    y = self._block_fn_plain(p_of(c), x_f, None, 0.0, False)
+                    done = (stage == n - 1) & (c == v - 1)
+                    outs = tmap(
+                        lambda O, yy: lax.dynamic_update_index_in_dim(
+                            O,
+                            jnp.where(
+                                done,
+                                yy,
+                                lax.dynamic_index_in_dim(
+                                    O, i, 0, keepdims=False
+                                ),
+                            ),
+                            i,
+                            0,
+                        ),
+                        cr["outs"],
+                        y,
+                    )
+                    return dict(cr, act=y, outs=outs)
+
+                carry = lax.cond(
+                    k == FWD, fwd_branch, lambda cr: cr, carry
+                )
+                return carry, ()
+
+            carry, _ = lax.scan(tick, carry0, rows_xs)
+            outs = carry["outs"]
+            if self.post is not None:
+                outs = jax.vmap(
+                    lambda mb: self.post.apply(
+                        params["post"], (), mb, rng=None, train=False
+                    )[0]
+                )(outs)
+                for axis, dim in out_gather:
+                    outs = all_gather_value(outs, axis, dim)
+            masked = tmap(
+                lambda a: jnp.where(stage == n - 1, a, jnp.zeros_like(a)),
+                outs,
+            )
+            return tmap(lambda a: lax.psum(a, self.pp_axis), masked)
+
+        param_specs = {
+            "blocks": self._fsdp_specs if self.fsdp else self._blocks_spec
+        }
+        if self.pre is not None:
+            param_specs["pre"] = self._pre_spec
+        if self.post is not None:
+            param_specs["post"] = self._post_spec
+
+        mapped = _shard_map(
+            local,
+            self.mesh,
+            in_specs=(param_specs, data_spec),
+            out_specs=data_spec,
+        )
+        return jax.jit(mapped)
+
     def apply(self, params, x):
         """Pipelined inference forward; returns gathered outputs ``[B, ...]``."""
         self._check_batch(x)
         if self.fsdp:
             self._ensure_fsdp(params["blocks"])
         if self._apply_fn is None:
-            self._apply_fn = self._build_apply()
+            self._apply_fn = (
+                self._build_apply_interleaved()
+                if self.schedule == "interleaved"
+                else self._build_apply()
+            )
         x_mb = microbatch.scatter_stacked(x, self.chunks)
         out_mb = self._apply_fn(params, x_mb)
         return microbatch.gather_stacked(out_mb)
