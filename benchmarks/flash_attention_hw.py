@@ -31,20 +31,12 @@ import jax
 import jax.numpy as jnp
 
 from torchgpipe_tpu.ops.flash_attention import flash_attention
+from torchgpipe_tpu.parallel.ring_attention import full_attention
 
-
-def dense_attention(q, k, v, causal=True):
-    b, s, h, d = q.shape
-    g = k.shape[2]
-    kf = jnp.repeat(k, h // g, axis=2).astype(jnp.float32)
-    vf = jnp.repeat(v, h // g, axis=2).astype(jnp.float32)
-    qf = q.astype(jnp.float32)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * (d ** -0.5)
-    if causal:
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(q.dtype)
+# The dense oracle is the SAME full_attention the interpret-mode kernel
+# tests compare against (tests/test_flash_attention.py), so the hardware
+# numbers here and the CI oracle can never drift apart.
+dense_attention = full_attention
 
 
 def run_case(seq, streaming, b=4, h=16, g=8, d=128, dtype=jnp.bfloat16,

@@ -232,6 +232,62 @@ def test_interleaved_with_rng_dropout_runs():
     assert float(loss) != float(loss3)
 
 
+def test_interleaved_memory_independent_of_chunks():
+    """Activation memory is bounded by the schedule window (O(n*v) ring
+    slots), never O(m): quadrupling the micro-batch count at FIXED
+    per-micro-batch shape must leave the compiled program's temp bytes
+    essentially flat, while fill-drain's grows ~linearly (it saves one
+    scan carry per tick).  Reference memory-evidence anchor:
+    tests/skip/test_leak.py:28-104; here XLA's own memory analysis proves
+    the property, as for 1F1B."""
+    import torchgpipe_tpu.microbatch as mb
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        cross_entropy,
+        llama_spmd,
+    )
+
+    n, v = 2, 2
+    mesh = make_mesh(n, 1, devices=jax.devices()[:n])
+    cfg = TransformerConfig(
+        vocab=256, dim=256, n_layers=n * v, n_heads=4, n_kv_heads=2
+    )
+    block, pre, post = llama_spmd(cfg, n * v)
+
+    def temp_bytes(sched, m, **kw):
+        tokens = jnp.zeros((2 * m, 128), jnp.int32)  # fixed micro-batch of 2
+        labels = jnp.zeros((2 * m, 128), jnp.int32)
+        eng = SpmdGPipe(
+            block, n, mesh, chunks=m, loss_fn=cross_entropy,
+            pre=pre, post=post, checkpoint="always", schedule=sched, **kw,
+        )
+        params = eng.init(
+            jax.random.PRNGKey(0),
+            jax.ShapeDtypeStruct(tokens.shape, tokens.dtype),
+        )
+        fn = eng._build_train_step(use_rng=True)
+        x_mb = mb.scatter_stacked(tokens, m)
+        t_mb = mb.scatter_stacked(labels, m)
+        ma = fn.lower(
+            params, x_mb, t_mb, jax.random.PRNGKey(1)
+        ).compile().memory_analysis()
+        return ma.temp_size_in_bytes
+
+    i_small = temp_bytes("interleaved", 4, virtual_stages=v)
+    i_big = temp_bytes("interleaved", 16, virtual_stages=v)
+    f_small = temp_bytes("fill_drain", 4)
+    f_big = temp_bytes("fill_drain", 16)
+    # Interleaved: the ring buffers don't scale with m (at this config the
+    # slot depth stays 4 and measured temp bytes are IDENTICAL at m=4 and
+    # m=16); fill-drain saves one scan carry per tick, so its temp grows
+    # with m (~1.8x here; sub-linear only via fixed overheads).
+    assert i_big < 1.05 * i_small, (i_small, i_big)
+    assert f_big > 1.5 * f_small, (f_small, f_big)
+    growth_i = i_big / i_small
+    growth_f = f_big / f_small
+    assert growth_i < 0.75 * growth_f, (growth_i, growth_f)
+
+
 def test_interleaved_validation_errors():
     n, v = 2, 2
     block, pre, post, loss_fn = _llama(n * v)

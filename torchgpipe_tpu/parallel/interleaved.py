@@ -108,16 +108,7 @@ class InterleavedTables:
         return self.ticks - 2 * self.m * self.v
 
 
-def interleaved_tables(n: int, m: int, v: int) -> InterleavedTables:
-    """Lockstep-simulate the interleaved schedule into dense tables.
-
-    Each tick, every device attempts its next cell; a cell runs only if its
-    producer ran at a *strictly earlier* tick (hand-offs take one ppermute
-    tick; same-device dependencies also resolve tick-to-tick).  The
-    simulation terminates — each tick at least the globally-earliest
-    unsatisfied cell's producer chain makes progress — and the result is
-    checked for validity before returning.
-    """
+def _check_args(n: int, m: int, v: int) -> None:
     if n < 1 or v < 1 or m < 1:
         raise ValueError(f"need n, m, v >= 1, got n={n} m={m} v={v}")
     if v > 1 and m % n != 0:
@@ -126,18 +117,29 @@ def interleaved_tables(n: int, m: int, v: int) -> InterleavedTables:
             f"pipeline depth (n={n}) — Megatron's micro-batch grouping "
             "(arXiv:2104.04473 §2.2) assumes full groups"
         )
-    seqs = [_cell_sequence(n, m, v, j) for j in range(n)]
+
+
+def _lockstep_simulate(n: int, v: int, seqs: List[List[Tuple[int, int, int]]]):
+    """Lockstep list-scheduling of per-device cell sequences into rows.
+
+    Each tick, every device attempts its next cell; a cell runs only if
+    its producer ran at a *strictly earlier* tick (hand-offs take one
+    ppermute tick; same-device dependencies also resolve tick-to-tick).
+    The simulation terminates — each tick at least the globally-earliest
+    unsatisfied cell's producer chain makes progress.
+    """
     pos = [0] * n
     done: dict = {}  # (kind, c, i, j) -> tick
     rows_kind: List[List[int]] = []
     rows_chunk: List[List[int]] = []
     rows_mb: List[List[int]] = []
     t = 0
-    limit = 6 * (m * v + n * v) + 64  # far above any valid schedule length
+    total = sum(len(s) for s in seqs)
+    limit = 4 * total + 4 * n * v + 64  # far above any valid schedule
     while any(pos[j] < len(seqs[j]) for j in range(n)):
         if t > limit:
             raise RuntimeError(
-                f"interleaved schedule did not converge (n={n} m={m} v={v})"
+                f"schedule did not converge (n={n} v={v}, {total} cells)"
             )
         krow, crow, irow = [IDLE] * n, [0] * n, [0] * n
         fired = []
@@ -161,6 +163,15 @@ def interleaved_tables(n: int, m: int, v: int) -> InterleavedTables:
             done[cell] = t
         rows_kind.append(krow); rows_chunk.append(crow); rows_mb.append(irow)
         t += 1
+    return rows_kind, rows_chunk, rows_mb, t
+
+
+def interleaved_tables(n: int, m: int, v: int) -> InterleavedTables:
+    """Lockstep-simulate the interleaved training schedule into dense
+    tables; the result is checked for validity before returning."""
+    _check_args(n, m, v)
+    seqs = [_cell_sequence(n, m, v, j) for j in range(n)]
+    rows_kind, rows_chunk, rows_mb, t = _lockstep_simulate(n, v, seqs)
 
     tables = InterleavedTables(
         n=n, m=m, v=v, ticks=t,
@@ -180,55 +191,24 @@ def interleaved_forward_tables(n: int, m: int, v: int) -> InterleavedTables:
     ``m * v`` forward cells in Megatron order — a fill-drain schedule over
     the ``n * v`` virtual stages with round-robin device mapping.
     """
-    if v > 1 and m % n != 0:
-        raise ValueError(
-            f"interleaved schedule needs chunks (m={m}) divisible by the "
-            f"pipeline depth (n={n})"
-        )
-    total = m * v
-    seqs = []
-    for j in range(n):
-        seqs.append(
-            [
-                (FWD, (k // n) % v, (k // (n * v)) * n + k % n)
-                for k in range(total)
-            ]
-        )
-    pos = [0] * n
-    done: dict = {}
-    rows_kind: List[List[int]] = []
-    rows_chunk: List[List[int]] = []
-    rows_mb: List[List[int]] = []
-    t = 0
-    limit = 4 * (total + n * v) + 64
-    while any(pos[j] < total for j in range(n)):
-        if t > limit:
-            raise RuntimeError("forward schedule did not converge")
-        krow, crow, irow = [IDLE] * n, [0] * n, [0] * n
-        fired = []
-        for j in range(n):
-            if pos[j] >= total:
-                continue
-            kind, c, i = seqs[j][pos[j]]
-            dep = _producer(n, v, FWD, c, i, j)
-            if dep is None or done.get(dep, t) < t:
-                krow[j], crow[j], irow[j] = kind, c, i
-                fired.append((kind, c, i, j))
-                pos[j] += 1
-        for cell in fired:
-            done[cell] = t
-        rows_kind.append(krow); rows_chunk.append(crow); rows_mb.append(irow)
-        t += 1
+    _check_args(n, m, v)
+    seqs = [
+        [cell for cell in _cell_sequence(n, m, v, j) if cell[0] == FWD]
+        for j in range(n)
+    ]
+    rows_kind, rows_chunk, rows_mb, t = _lockstep_simulate(n, v, seqs)
     # Slot depth: activation liveness only (delivery tick -> consumption;
     # no backward cells, so each span ends at the cell's own tick).
     fwd_tick, bwd_tick = _cell_ticks(n, rows_kind, rows_chunk, rows_mb)
-    return InterleavedTables(
+    tables = InterleavedTables(
         n=n, m=m, v=v, ticks=t,
         kind=np.asarray(rows_kind, np.int32),
         chunk=np.asarray(rows_chunk, np.int32),
         mb=np.asarray(rows_mb, np.int32),
         slots=_min_slot_depth([_act_spans(n, v, fwd_tick, bwd_tick)]),
     )
+    _validate(tables, forward_only=True)
+    return tables
 
 
 def _min_slot_depth(span_families) -> int:
@@ -296,7 +276,7 @@ def _required_slots(n, v, rows_kind, rows_chunk, rows_mb) -> int:
     )
 
 
-def _validate(tb: InterleavedTables) -> None:
+def _validate(tb: InterleavedTables, forward_only: bool = False) -> None:
     """Every cell exactly once per device; dependencies strictly ordered."""
     n, m, v = tb.n, tb.m, tb.v
     done: dict = {}
@@ -305,6 +285,8 @@ def _validate(tb: InterleavedTables) -> None:
             k = int(tb.kind[t, j])
             if k == IDLE:
                 continue
+            if forward_only and k != FWD:
+                raise AssertionError(f"non-forward cell in forward tables")
             cell = (k, int(tb.chunk[t, j]), int(tb.mb[t, j]), j)
             if cell in done:
                 raise AssertionError(f"cell {cell} scheduled twice")
@@ -315,6 +297,6 @@ def _validate(tb: InterleavedTables) -> None:
                 if not done.get((FWD, cell[1], cell[2], j), t) < t:
                     raise AssertionError(f"loss cell {cell} before own fwd")
             done[cell] = t
-    expect = 2 * m * v * n
+    expect = (1 if forward_only else 2) * m * v * n
     if len(done) != expect:
         raise AssertionError(f"{len(done)} cells scheduled, want {expect}")
