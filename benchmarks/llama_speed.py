@@ -94,9 +94,16 @@ def causal_lm_loss(out, tokens):
 @click.option("--fsdp/--no-fsdp", default=False,
               help="ZeRO-3-style parameter sharding over the dp axis "
                    "(spmd engine; needs --dp > 1)")
+@click.option("--moe-dispatch",
+              type=click.Choice(["auto", "dense", "sparse", "dropless"]),
+              default="auto",
+              help="MoE token dispatch: capacity-based one-hot einsums "
+                   "(dense), sort-based scatter/gather (sparse), or "
+                   "capacity-free ragged grouped matmuls (dropless; needs "
+                   "local experts, i.e. --ep 1)")
 def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
          checkpoint, moe_experts, moe_top_k, ep, tp, dp, schedule,
-         virtual_stages, fsdp):
+         virtual_stages, fsdp, moe_dispatch):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     dim, n_layers, n_heads, n_kv, vocab, mlp_ratio = PRESETS[preset]
@@ -133,6 +140,7 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
         moe = MoEConfig(
             n_experts=moe_experts, top_k=moe_top_k,
             ep_axis="ep" if ep > 1 else None,
+            dispatch=moe_dispatch,
         )
     x = jnp.zeros((bsz, seq), jnp.int32)
 

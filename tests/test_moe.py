@@ -107,6 +107,76 @@ def test_sparse_dispatch_matches_dense(top_k):
     _assert_trees_close(sparse_grads, dense_grads, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_dropless_matches_capacity_paths_when_nothing_drops(top_k):
+    """dispatch='dropless' (ragged_dot grouped matmuls) must equal the
+    dense one-hot path in outputs AND gradients whenever capacity is
+    generous enough that the capacity paths drop nothing — identical
+    routing, identical gate normalization, different matmul plumbing."""
+    cfg = _cfg()
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 16, cfg.dim))
+
+    def run(dispatch, capacity_factor):
+        moe = MoEConfig(n_experts=4, top_k=top_k,
+                        capacity_factor=capacity_factor, dispatch=dispatch)
+        layer = moe_mlp(cfg, moe)
+        params, _ = layer.init(
+            jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+
+        def loss(p):
+            y, _ = layer.apply(p, (), x)
+            return jnp.sum(y**2)
+
+        return jax.value_and_grad(loss)(params)
+
+    dense_val, dense_grads = run("dense", 8.0)  # no drops at this factor
+    drop_val, drop_grads = run("dropless", 8.0)
+    np.testing.assert_allclose(float(dense_val), float(drop_val), rtol=1e-5)
+    _assert_trees_close(drop_grads, dense_grads, rtol=1e-4, atol=1e-5)
+
+
+def test_dropless_never_drops_under_imbalance():
+    """Where the capacity paths drop overflowing tokens, dropless must
+    process every assignment: with a router biased hard toward one expert
+    and a tight capacity factor, the two outputs must DIFFER, and the
+    dropless output must match a generous-capacity dense run (the
+    no-drop semantics)."""
+    cfg = _cfg()
+    moe_kw = dict(n_experts=4, top_k=1)
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 16, cfg.dim))
+
+    def run(dispatch, capacity_factor, params=None):
+        moe = MoEConfig(capacity_factor=capacity_factor, dispatch=dispatch,
+                        **moe_kw)
+        layer = moe_mlp(cfg, moe)
+        if params is None:
+            params, _ = layer.init(
+                jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+            )
+        # Bias the router so nearly all tokens pick expert 0 — guaranteed
+        # overflow at capacity_factor < 1.
+        params = dict(params)
+        params["router"] = params["router"].at[:, 0].add(10.0)
+        y, _ = layer.apply(params, (), x)
+        return y
+
+    y_dropless = run("dropless", 0.25)
+    y_tight = run("sparse", 0.25)
+    y_oracle = run("dense", 8.0)
+    np.testing.assert_allclose(
+        np.asarray(y_dropless), np.asarray(y_oracle), rtol=1e-4, atol=1e-5
+    )
+    assert np.max(np.abs(np.asarray(y_tight) - np.asarray(y_oracle))) > 1e-3
+
+
+def test_dropless_rejects_ep_axis():
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=4, top_k=2, dispatch="dropless", ep_axis="ep")
+    with pytest.raises(ValueError, match="local experts"):
+        moe_mlp(cfg, moe)
+
+
 def test_sparse_dispatch_matches_dense_under_ep(cpu_devices):
     """Sparse dispatch composed with expert parallelism: the scatter/gather
     buffers feed the same [E, C, d] all_to_all round trip as the dense

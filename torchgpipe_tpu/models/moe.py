@@ -83,7 +83,15 @@ class MoEConfig:
     # routing problems); 'sparse' assigns slots by a stable sort and moves
     # tokens with scatter/gather — O(t*k + E*C*d) memory, the scalable path
     # for large t*E*C (8k tokens x 64 experts would put the dense tensors
-    # in the hundreds of MB).  'auto' picks by the dense tensor's size.
+    # in the hundreds of MB).  'dropless' removes the capacity concept
+    # entirely (megablocks-style, Gale et al. arXiv:2211.15841): tokens are
+    # sorted by expert and the expert MLP runs as grouped matmuls over the
+    # ragged expert segments (``lax.ragged_dot``) — NO token is ever
+    # dropped, and per-step work is exactly ``k*t`` rows regardless of
+    # router balance.  Requires local experts (``ep_axis=None``); with an
+    # ep axis the all_to_all needs the static per-lane buffers only the
+    # capacity paths provide.  'auto' picks dense or sparse by the dense
+    # tensor's size.
     dispatch: str = "auto"
 
 
@@ -207,36 +215,63 @@ def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
     return combine, dispatch
 
 
+def _flat_assignment(probs: jnp.ndarray, k: int):
+    """Shared routing prologue for the sort-based dispatch paths.
+
+    Flattens the top-k routing into per-assignment arrays of length
+    ``k*t`` in k-major order (assignment ``i`` = choice round ``i // t``
+    of token ``i % t``) and expert-sorts them: returns ``experts`` (int32
+    expert id, unsorted), ``gates`` (normalized combine weight, unsorted),
+    ``order`` (the stable expert sort — token order preserved within an
+    expert, round kk strictly after round kk-1) and ``counts [E]`` (tokens
+    per expert).  Both the capacity ('sparse') and capacity-free
+    ('dropless') paths build on exactly this — their equivalence to the
+    dense one-hot path is load-bearing and oracle-tested.
+    """
+    idxs, _, gates_kt = _top_k_select(probs, k)
+    denom = _gate_denom(gates_kt, k)
+    experts = idxs.reshape(-1).astype(jnp.int32)  # [kt], k-major
+    gates = (gates_kt / denom).reshape(-1)
+    order = jnp.argsort(experts, stable=True)
+    counts = jnp.bincount(experts, length=probs.shape[1])
+    return experts, gates, order, counts
+
+
 def _sparse_assignment(probs: jnp.ndarray, k: int, capacity: int):
     """Sort-based slot assignment — identical FCFS semantics to
     :func:`_top_k_dispatch` (token order within a choice round, round kk
     strictly after round kk-1) with O(t*k) bookkeeping instead of the dense
     ``[t, E, C]`` tensors.
 
-    Returns flat per-assignment arrays of length ``k*t`` in k-major order
-    (assignment ``i`` = choice round ``i // t`` of token ``i % t``):
+    Returns flat per-assignment arrays of length ``k*t`` in k-major order:
     ``experts`` (int32 expert id), ``gates`` (normalized combine weight),
     ``keep`` (bool, False where the expert's capacity overflowed) and
     ``slot`` (int32 position in the expert buffer, 0 where dropped).
     """
-    t, E = probs.shape
-    idxs, _, gates_kt = _top_k_select(probs, k)
-    denom = _gate_denom(gates_kt, k)
-    experts = idxs.reshape(-1).astype(jnp.int32)  # [kt], k-major
-    gates = (gates_kt / denom).reshape(-1)
+    t = probs.shape[0]
     kt = k * t
-    # Stable sort groups assignments by expert while preserving the k-major
-    # FCFS order inside each group — position within the group IS the
-    # dense path's slot number.
-    order = jnp.argsort(experts, stable=True)
+    experts, gates, order, counts = _flat_assignment(probs, k)
     sorted_e = experts[order]
-    counts = jnp.bincount(experts, length=E)
     starts = jnp.cumsum(counts) - counts  # segment start per expert
+    # Position within the expert group IS the dense path's slot number.
     pos_sorted = (jnp.arange(kt) - starts[sorted_e]).astype(jnp.int32)
     pos = jnp.zeros((kt,), jnp.int32).at[order].set(pos_sorted)
     keep = pos < capacity
     slot = jnp.where(keep, pos, 0)
     return experts, gates, keep, slot
+
+
+def _dropless_assignment(probs: jnp.ndarray, k: int):
+    """Expert-sorted token assignment for the dropless path.
+
+    Returns ``(order, tok_sorted, group_sizes, gates)`` where
+    ``tok_sorted`` maps expert-sorted rows back to source tokens,
+    ``group_sizes [E]`` are the ragged segment lengths, and ``gates`` are
+    the normalized combine weights in *unsorted* k-major order."""
+    t = probs.shape[0]
+    _, gates, order, counts = _flat_assignment(probs, k)
+    tok = jnp.arange(k * t) % t
+    return order, tok[order], counts.astype(jnp.int32), gates
 
 
 def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Layer:
@@ -252,8 +287,18 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
     dt = cfg.dtype
     if K > E:
         raise ValueError(f"top_k={K} exceeds n_experts={E}")
-    if moe.dispatch not in ("auto", "dense", "sparse"):
-        raise ValueError("MoEConfig.dispatch must be 'auto'|'dense'|'sparse'")
+    if moe.dispatch not in ("auto", "dense", "sparse", "dropless"):
+        raise ValueError(
+            "MoEConfig.dispatch must be 'auto'|'dense'|'sparse'|'dropless'"
+        )
+    if moe.dispatch == "dropless" and moe.ep_axis is not None:
+        raise ValueError(
+            "dispatch='dropless' needs local experts (ep_axis=None): the "
+            "ragged expert segments have data-dependent sizes, but the ep "
+            "all_to_all exchanges static per-lane buffers — use the "
+            "capacity paths ('auto'/'dense'/'sparse') with ep, or shard "
+            "the expert weights over tp instead"
+        )
 
     def init(rng, in_spec):
         del in_spec
@@ -281,6 +326,38 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
 
         logits = xf.astype(jnp.float32) @ params["router"]  # [t, E]
         probs = jax.nn.softmax(logits, axis=-1)
+
+        def _finish(y):
+            """Shared epilogue: reshape + optional balance-penalty
+            gradient injection (see add_aux_grad /
+            MoEConfig.balance_weight)."""
+            y = y.reshape(b, s, d).astype(x.dtype)
+            if moe.balance_weight > 0.0 and train:
+                _, _, aux = _balance_penalty(probs, E, K)
+                y = add_aux_grad(y, aux, moe.balance_weight)
+            return y, state
+
+        if moe.dispatch == "dropless":
+            # Megablocks-style dropless experts: sort the k*t assignments
+            # by expert and run the SwiGLU as grouped matmuls over the
+            # ragged segments (lax.ragged_dot → TPU grouped-matmul
+            # lowering).  No capacity, no drops, no [E, C, d] buffers —
+            # work is exactly k*t rows however unbalanced the router is.
+            order, tok_sorted, group_sizes, gates = _dropless_assignment(
+                probs, K
+            )
+            xs = xf[tok_sorted]  # [kt, d] expert-sorted
+            h = jax.nn.silu(
+                lax.ragged_dot(xs, params["w_gate"], group_sizes)
+            ) * lax.ragged_dot(xs, params["w_up"], group_sizes)
+            ys = lax.ragged_dot(h, params["w_down"], group_sizes)  # [kt, d]
+            gate_sorted = gates[order].astype(ys.dtype)
+            y = (
+                jnp.zeros((t, d), ys.dtype)
+                .at[tok_sorted]
+                .add(ys * gate_sorted[:, None])
+            )
+            return _finish(y)
         # Dense one-hot einsum dispatch materializes [t, E, C] tensors; past
         # ~16M elements (64MB f32) the sort-based scatter/gather path wins on
         # memory by orders of magnitude (8k tokens x 64 experts: ~670MB vs
@@ -328,13 +405,7 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
             y = jnp.sum(picked.reshape(K, t, d), axis=0)
         else:
             y = jnp.einsum("tec,ecd->td", combine.astype(out.dtype), out)
-        y = y.reshape(b, s, d).astype(x.dtype)
-        if moe.balance_weight > 0.0 and train:
-            # Switch balance penalty from this lane's tokens; gradient-only
-            # injection (see add_aux_grad / MoEConfig.balance_weight).
-            _, _, aux = _balance_penalty(probs, E, K)
-            y = add_aux_grad(y, aux, moe.balance_weight)
-        return y, state
+        return _finish(y)
 
     def validate_mesh(mesh):
         ax = moe.ep_axis
