@@ -12,7 +12,6 @@ fill-drain only (reference pipeline.py:49-65; SURVEY.md §2.2).
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from torchgpipe_tpu.models.transformer import (

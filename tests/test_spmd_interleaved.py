@@ -15,8 +15,6 @@ import numpy as np
 import pytest
 
 from torchgpipe_tpu.parallel.interleaved import (
-    BWD,
-    FWD,
     IDLE,
     interleaved_forward_tables,
     interleaved_tables,
