@@ -101,9 +101,14 @@ def causal_lm_loss(out, tokens):
                    "(dense), sort-based scatter/gather (sparse), or "
                    "capacity-free ragged grouped matmuls (dropless; needs "
                    "local experts, i.e. --ep 1)")
+@click.option("--moe-router", type=click.Choice(["topk", "expert_choice"]),
+              default="topk",
+              help="routing direction: tokens pick experts (topk) or "
+                   "experts pick tokens (expert_choice — perfectly "
+                   "balanced by construction; needs --ep 1)")
 def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
          checkpoint, moe_experts, moe_top_k, ep, tp, dp, schedule,
-         virtual_stages, fsdp, moe_dispatch):
+         virtual_stages, fsdp, moe_dispatch, moe_router):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     dim, n_layers, n_heads, n_kv, vocab, mlp_ratio = PRESETS[preset]
@@ -141,6 +146,7 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
             n_experts=moe_experts, top_k=moe_top_k,
             ep_axis="ep" if ep > 1 else None,
             dispatch=moe_dispatch,
+            router=moe_router,
         )
     x = jnp.zeros((bsz, seq), jnp.int32)
 

@@ -170,6 +170,69 @@ def test_dropless_never_drops_under_imbalance():
     assert np.max(np.abs(np.asarray(y_tight) - np.asarray(y_oracle))) > 1e-3
 
 
+def test_expert_choice_matches_per_expert_loop():
+    """router='expert_choice' == an explicit numpy loop where each expert
+    gathers its top-capacity tokens by router score and scatter-adds its
+    gated FFN output back (Zhou et al. arXiv:2202.09368 formulation)."""
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=4, capacity_factor=2.0, router="expert_choice")
+    layer = moe_mlp(cfg, moe)
+    b, s = 2, 8
+    x = jax.random.normal(jax.random.PRNGKey(9), (b, s, cfg.dim))
+    params, _ = layer.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    y, _ = layer.apply(params, (), x)
+
+    t = b * s
+    E = moe.n_experts
+    capacity = int(np.ceil(moe.capacity_factor * t / E))
+    xf = np.asarray(x.reshape(t, cfg.dim))
+    probs = np.asarray(
+        jax.nn.softmax(x.reshape(t, cfg.dim) @ params["router"], -1)
+    )
+    want = np.zeros_like(xf)
+    for e in range(E):
+        picked = np.argsort(-probs[:, e], kind="stable")[:capacity]
+        for tok in picked:
+            v = jnp.asarray(xf[tok])
+            h = jax.nn.silu(v @ params["w_gate"][e]) * (v @ params["w_up"][e])
+            want[tok] += probs[tok, e] * np.asarray(h @ params["w_down"][e])
+    np.testing.assert_allclose(
+        np.asarray(y).reshape(t, cfg.dim), want, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_expert_choice_router_receives_gradient():
+    """The router weights must receive gradient through the EC gates."""
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=4, capacity_factor=2.0, router="expert_choice")
+    layer = moe_mlp(cfg, moe)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, cfg.dim))
+    params, _ = layer.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+
+    def loss(p):
+        y, _ = layer.apply(p, (), x)
+        return jnp.sum(y**2)
+
+    grads = jax.grad(loss)(params)
+    assert float(jnp.max(jnp.abs(grads["router"]))) > 0.0
+
+
+def test_expert_choice_validation():
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="local experts"):
+        moe_mlp(cfg, MoEConfig(n_experts=4, router="expert_choice",
+                               ep_axis="ep"))
+    with pytest.raises(ValueError, match="balanced by"):
+        moe_mlp(cfg, MoEConfig(n_experts=4, router="expert_choice",
+                               balance_weight=0.1))
+    with pytest.raises(ValueError, match="'topk' or 'expert_choice'"):
+        moe_mlp(cfg, MoEConfig(n_experts=4, router="soft"))
+
+
 def test_dropless_rejects_ep_axis():
     cfg = _cfg()
     moe = MoEConfig(n_experts=4, top_k=2, dispatch="dropless", ep_axis="ep")

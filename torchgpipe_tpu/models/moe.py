@@ -59,10 +59,14 @@ from torchgpipe_tpu.parallel.ring_attention import axis_bound
 class MoEConfig:
     """Expert-layer hyperparameters.
 
-    ``capacity_factor`` scales the per-expert token budget:
+    ``capacity_factor`` scales the per-expert token budget.  For the
+    default token-choice router:
     ``capacity = ceil(capacity_factor * top_k * tokens / n_experts)`` per
-    lane.  1.0 is an exactly-balanced budget; >1 tolerates imbalance; a
+    lane — 1.0 is an exactly-balanced budget; >1 tolerates imbalance; a
     large value (≥ n_experts/top_k) guarantees no token is ever dropped.
+    For ``router='expert_choice'`` the paper's formula applies instead
+    (``top_k`` plays no role):
+    ``capacity = min(tokens, ceil(capacity_factor * tokens / n_experts))``.
 
     ``balance_weight`` > 0 trains the router against the Switch balance
     penalty ``E * sum(load * importance)`` with that coefficient.  The
@@ -93,6 +97,19 @@ class MoEConfig:
     # capacity paths provide.  'auto' picks dense or sparse by the dense
     # tensor's size.
     dispatch: str = "auto"
+    # Routing direction: 'topk' (default — each token picks its top-k
+    # experts; Switch/GShard) or 'expert_choice' (each EXPERT picks its
+    # top-capacity tokens; Zhou et al. arXiv:2202.09368).  Expert choice
+    # is perfectly load-balanced BY CONSTRUCTION — every expert processes
+    # exactly ``capacity`` tokens — so no balance penalty is needed
+    # (``balance_weight`` must stay 0); tokens may be served by several
+    # experts or by none (the residual around the MLP carries unserved
+    # tokens).  Selection looks across the whole (local) batch, so use it
+    # for encoder/training workloads, not autoregressive decoding.
+    # Requires local experts (``ep_axis=None``); ``dispatch`` and
+    # ``top_k`` are ignored (the EC gather/scatter is its own path and
+    # ``capacity`` plays top_k's role).
+    router: str = "topk"
 
 
 @jax.custom_vjp
@@ -274,6 +291,17 @@ def _dropless_assignment(probs: jnp.ndarray, k: int):
     return order, tok[order], counts.astype(jnp.int32), gates
 
 
+def _expert_ffn(expert_in: jnp.ndarray, params) -> jnp.ndarray:
+    """Batched per-expert SwiGLU on ``[E, C, d]`` buffers (MXU einsums) —
+    the one expert-compute block shared by every dispatch path that uses
+    rectangular expert buffers (the dropless path's ragged twin lives
+    inline with its ``ragged_dot`` calls)."""
+    h = jax.nn.silu(
+        jnp.einsum("ecd,edh->ech", expert_in, params["w_gate"])
+    ) * jnp.einsum("ecd,edh->ech", expert_in, params["w_up"])
+    return jnp.einsum("ech,ehd->ecd", h, params["w_down"])
+
+
 def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Layer:
     """Top-k routed expert SwiGLU feed-forward on ``[b, s, dim]`` states.
 
@@ -299,6 +327,25 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
             "capacity paths ('auto'/'dense'/'sparse') with ep, or shard "
             "the expert weights over tp instead"
         )
+    if moe.router not in ("topk", "expert_choice"):
+        raise ValueError(
+            "MoEConfig.router must be 'topk' or 'expert_choice'"
+        )
+    if moe.router == "expert_choice":
+        if moe.ep_axis is not None:
+            raise ValueError(
+                "router='expert_choice' needs local experts "
+                "(ep_axis=None): each expert selects its top-capacity "
+                "tokens over the whole local batch, which with sharded "
+                "experts would need a cross-lane token gather the "
+                "capacity all_to_all does not provide"
+            )
+        if moe.balance_weight > 0.0:
+            raise ValueError(
+                "router='expert_choice' is perfectly balanced by "
+                "construction (every expert takes exactly `capacity` "
+                "tokens); set balance_weight=0"
+            )
 
     def init(rng, in_spec):
         del in_spec
@@ -322,7 +369,12 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
 
         ep_active = axis_bound(moe.ep_axis)
         # Per-lane capacity from the *local* token count (static shape).
-        capacity = max(1, math.ceil(moe.capacity_factor * K * t / E))
+        if moe.router == "expert_choice":
+            # EC paper formula: capacity = c * t / E (top_k plays no role);
+            # clamp to t — an expert cannot take more tokens than exist.
+            capacity = min(t, max(1, math.ceil(moe.capacity_factor * t / E)))
+        else:
+            capacity = max(1, math.ceil(moe.capacity_factor * K * t / E))
 
         logits = xf.astype(jnp.float32) @ params["router"]  # [t, E]
         probs = jax.nn.softmax(logits, axis=-1)
@@ -336,6 +388,23 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
                 _, _, aux = _balance_penalty(probs, E, K)
                 y = add_aux_grad(y, aux, moe.balance_weight)
             return y, state
+
+        if moe.router == "expert_choice":
+            # Expert-choice routing (Zhou et al. arXiv:2202.09368): each
+            # expert takes its top-`capacity` tokens by router score —
+            # perfect static load balance, no drops by overflow (a token
+            # simply may not be chosen; the block's residual carries it).
+            # score^T [E, t] -> per-expert top-C token ids + gates.
+            gates_ec, idx_ec = lax.top_k(probs.T, capacity)  # [E, C]
+            expert_in = xf[idx_ec]  # [E, C, d] gather
+            out = _expert_ffn(expert_in, params)
+            y = (
+                jnp.zeros((t, d), out.dtype)
+                .at[idx_ec.reshape(-1)]
+                .add((out * gates_ec[..., None].astype(out.dtype))
+                     .reshape(-1, d))
+            )
+            return _finish(y)
 
         if moe.dispatch == "dropless":
             # Megablocks-style dropless experts: sort the k*t assignments
@@ -387,10 +456,7 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
                 expert_in, moe.ep_axis, split_axis=0, concat_axis=1, tiled=True
             )
         # Local expert compute: batched per-expert SwiGLU (MXU einsums).
-        h = jax.nn.silu(
-            jnp.einsum("ecd,edh->ech", expert_in, params["w_gate"])
-        ) * jnp.einsum("ecd,edh->ech", expert_in, params["w_up"])
-        out = jnp.einsum("ech,ehd->ecd", h, params["w_down"])
+        out = _expert_ffn(expert_in, params)
         if ep_active:
             # Bring results home: inverse all_to_all.
             out = lax.all_to_all(
