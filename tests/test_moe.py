@@ -741,3 +741,22 @@ def test_moe_training_soak_stays_finite():
         losses.append(float(loss))
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_router_stats_expert_choice_reports_uniform_load():
+    """EC load is exactly capacity per expert by construction; the
+    token-choice selection metrics would mislead, so stats report the
+    uniform load and a unit penalty (importance stays informative)."""
+    cfg = _cfg()
+    moe = MoEConfig(n_experts=4, router="expert_choice")
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, cfg.dim))
+    layer = moe_mlp(cfg, moe)
+    params, _ = layer.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    from torchgpipe_tpu.models.moe import router_stats
+
+    load, importance, penalty = router_stats(params["router"], x, moe)
+    np.testing.assert_allclose(np.asarray(load), 0.25)
+    assert float(penalty) == 1.0
+    assert importance.shape == (4,)

@@ -259,3 +259,39 @@ def test_spmd_rejects_stateful_block(cpu_devices):
     pipe = SpmdGPipe(block, 4, mesh, chunks=2, loss_fn=mse)
     with pytest.raises(ValueError, match="stateless"):
         pipe.init(jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, 8), jnp.float32))
+
+
+def test_train_step_rejects_foreign_params(cpu_devices):
+    """Mismatched params fail eagerly with a didactic message instead of an
+    opaque shard_map shape error (reference ethos: gpipe.py:34-64)."""
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        cross_entropy,
+        llama_spmd,
+    )
+
+    def build(pp):
+        cfg = TransformerConfig(
+            vocab=64, dim=32, n_layers=pp, n_heads=4, n_kv_heads=2
+        )
+        block, pre, post = llama_spmd(cfg, pp)
+        mesh = make_mesh(pp, 1, devices=cpu_devices[:pp])
+        return SpmdGPipe(
+            block, pp, mesh, chunks=2, loss_fn=cross_entropy,
+            pre=pre, post=post,
+        )
+
+    eng2, eng4 = build(2), build(4)
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    params4 = eng4.init(jax.random.PRNGKey(0), spec)
+
+    with pytest.raises(ValueError, match="different pipeline configuration"):
+        eng2.train_step(params4, tokens, tokens)
+    with pytest.raises(ValueError, match="params must be the dict"):
+        eng2.train_step(params4["blocks"], tokens, tokens)
+    p_no_pre = {k: v for k, v in params4.items() if k != "pre"}
+    with pytest.raises(ValueError, match="pre"):
+        eng4.train_step(p_no_pre, tokens, tokens)
+    with pytest.raises(ValueError, match="different pipeline configuration"):
+        eng4.apply(eng2.init(jax.random.PRNGKey(0), spec), tokens)

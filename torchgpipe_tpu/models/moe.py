@@ -508,10 +508,21 @@ def router_stats(params_router: jnp.ndarray, x: jnp.ndarray, moe: MoEConfig):
     ``(load, importance, balance_loss)`` — per-expert assignment fractions
     over all ``top_k`` selection rounds, per-expert mean probabilities, and
     the Switch-style balance penalty ``E * sum(load * importance)``
-    (1.0 = perfectly balanced)."""
+    (1.0 = perfectly balanced).
+
+    Under ``router='expert_choice'`` the token-choice selection metrics do
+    not apply: every expert takes exactly ``capacity`` tokens by
+    construction, so ``load`` is reported uniform (1/E) and the penalty is
+    exactly 1.0; ``importance`` (mean router probability per expert) stays
+    the meaningful dispersion signal."""
     t = x.shape[0] * x.shape[1]
     logits = x.reshape(t, -1).astype(jnp.float32) @ params_router
     probs = jax.nn.softmax(logits, axis=-1)
+    if moe.router == "expert_choice":
+        E = moe.n_experts
+        load = jnp.full((E,), 1.0 / E, jnp.float32)
+        importance = jnp.mean(probs, axis=0)
+        return load, importance, jnp.float32(1.0)
     return _balance_penalty(probs, moe.n_experts, moe.top_k)
 
 

@@ -1650,6 +1650,40 @@ class SpmdGPipe:
                             f"{leaf.shape}"
                         )
 
+    def _check_params(self, params) -> None:
+        """Didactic validation of the params tree BEFORE it reaches
+        shard_map, whose own failures (spec/shape mismatches deep inside
+        one compiled program) are opaque.  Mirrors the reference's eager
+        constructor/input validation ethos (reference gpipe.py:34-64)."""
+        if not isinstance(params, dict) or "blocks" not in params:
+            raise ValueError(
+                "params must be the dict returned by SpmdGPipe.init "
+                "(keys 'blocks' and, when pre/post are set, 'pre'/'post'); "
+                f"got {type(params).__name__} with keys "
+                f"{sorted(params) if isinstance(params, dict) else 'n/a'}"
+            )
+        for key, layer in (("pre", self.pre), ("post", self.post)):
+            if (layer is not None) != (key in params):
+                raise ValueError(
+                    f"engine {'defines' if layer is not None else 'has no'} "
+                    f"{key!r} layer but params "
+                    f"{'lacks' if layer is not None else 'contains'} a "
+                    f"{key!r} entry — params must come from THIS engine's "
+                    "init (pre/post configuration must match)"
+                )
+        v = self.virtual_stages
+        want = (self.n_stages,) if v == 1 else (self.n_stages, v)
+        for leaf in jax.tree_util.tree_leaves(params["blocks"]):
+            got = tuple(leaf.shape[: len(want)])
+            if got != want:
+                raise ValueError(
+                    f"block param leaf has leading dims {got}, expected "
+                    f"{want} (= {'(n_stages,)' if v == 1 else '(n_stages, virtual_stages)'}); "
+                    "params were initialized for a different pipeline "
+                    "configuration"
+                )
+            break  # leading-dim layout is uniform; one leaf suffices
+
     def train_step(self, params, x, target, rng=None):
         """One pipelined forward+backward; returns ``(loss, grads)``.
 
@@ -1658,6 +1692,7 @@ class SpmdGPipe:
         randomness (dropout raises loudly without it, matching the MPMD
         engine); omit it for deterministic models.
         """
+        self._check_params(params)
         self._check_batch(x, target)
         if self.fsdp:
             self._ensure_fsdp(params["blocks"])
@@ -1852,6 +1887,7 @@ class SpmdGPipe:
 
     def apply(self, params, x):
         """Pipelined inference forward; returns gathered outputs ``[B, ...]``."""
+        self._check_params(params)
         self._check_batch(x)
         if self.fsdp:
             self._ensure_fsdp(params["blocks"])
