@@ -106,9 +106,14 @@ def causal_lm_loss(out, tokens):
               help="routing direction: tokens pick experts (topk) or "
                    "experts pick tokens (expert_choice — perfectly "
                    "balanced by construction; needs --ep 1)")
+@click.option("--fused-ce/--no-fused-ce", default=False,
+              help="fuse the LM head into a chunked-vocab cross-entropy "
+                   "loss layer (spmd engine): the [tokens, vocab] logits "
+                   "are never materialized — the big-vocab memory fix "
+                   "(needs --tp 1)")
 def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
          checkpoint, moe_experts, moe_top_k, ep, tp, dp, schedule,
-         virtual_stages, fsdp, moe_dispatch, moe_router):
+         virtual_stages, fsdp, moe_dispatch, moe_router, fused_ce):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     dim, n_layers, n_heads, n_kv, vocab, mlp_ratio = PRESETS[preset]
@@ -138,6 +143,12 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
         )
     if fsdp and dp <= 1:
         raise click.UsageError("--fsdp shards over the dp lanes: pass --dp > 1")
+    if fused_ce and engine != "spmd":
+        raise click.UsageError("--fused-ce needs the spmd engine "
+                               "(parametric loss layer)")
+    if fused_ce and tp > 1:
+        raise click.UsageError("--fused-ce uses local head weights; with "
+                               "--tp use the vocab-parallel CE path instead")
     moe = None
     if moe_experts:
         from torchgpipe_tpu.models.moe import MoEConfig
@@ -155,6 +166,7 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
             cfg, n, chunks, x, epochs, steps, checkpoint, experiment, moe,
             ep, tp, dp, fsdp, schedule,
             virtual_stages if schedule == "interleaved" else 1,
+            fused_ce,
         )
     else:
         if moe is not None:
@@ -213,7 +225,7 @@ def _print_router_stats(params, h, moe):
 
 def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
               ep=1, tp=1, dp=1, fsdp=False, schedule="fill_drain",
-              virtual_stages=1):
+              virtual_stages=1, fused_ce=False):
     from benchmarks.common import run_epoch_loop
     from torchgpipe_tpu.models.transformer import llama_spmd
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
@@ -228,8 +240,17 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
     else:
         block, pre, post = llama_spmd(cfg, n_blocks)
     mesh = make_mesh(n, dp=dp, ep=ep, tp=tp)
+    if fused_ce:
+        # Chunked-vocab CE loss layer replaces the lm_head post: the
+        # [tokens, vocab] logits are never materialized (the big-vocab
+        # memory fix; see models.transformer.chunked_lm_loss).
+        from torchgpipe_tpu.models.transformer import chunked_lm_loss
+
+        loss_fn, post = chunked_lm_loss(cfg), None
+    else:
+        loss_fn = cross_entropy
     pipe = SpmdGPipe(
-        block, n, mesh, chunks=chunks, loss_fn=cross_entropy,
+        block, n, mesh, chunks=chunks, loss_fn=loss_fn,
         pre=pre, post=post, checkpoint=checkpoint,
         dp_axis="dp" if dp > 1 else None,
         ep_axis="ep" if ep > 1 else None,

@@ -335,6 +335,21 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
     return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
+def _head_init(cfg: TransformerConfig):
+    """Final-norm scale + vocab projection params — the ONE schema shared
+    by :func:`lm_head` and :func:`chunked_lm_loss`, so the two head
+    configurations stay checkpoint-interchangeable."""
+
+    def init(rng, in_spec):
+        del in_spec
+        return {
+            "scale": jnp.ones((cfg.dim,)),
+            "w": _normal(rng, (cfg.dim, cfg.vocab), cfg.dim ** -0.5, cfg.dtype),
+        }, ()
+
+    return init
+
+
 def lm_head(
     cfg: TransformerConfig, *, name: str = "head", gather_logits: bool = True
 ) -> Layer:
@@ -347,12 +362,7 @@ def lm_head(
     logits memory — and pair with :func:`vocab_parallel_cross_entropy`.
     """
 
-    def init(rng, in_spec):
-        del in_spec
-        return {
-            "scale": jnp.ones((cfg.dim,)),
-            "w": _normal(rng, (cfg.dim, cfg.vocab), cfg.dim ** -0.5, cfg.dtype),
-        }, ()
+    init = _head_init(cfg)
 
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
@@ -403,6 +413,42 @@ def vocab_parallel_cross_entropy(axis: Optional[str]):
         return jnp.mean(z - tl)
 
     return loss
+
+
+def chunked_lm_loss(
+    cfg: TransformerConfig, *, chunk: int = 8192, name: str = "chunked_ce"
+) -> Layer:
+    """Fused final-norm + vocab projection + cross-entropy as a parametric
+    LOSS LAYER for ``SpmdGPipe(loss_fn=...)`` — the big-vocabulary memory
+    fix: the ``[tokens, vocab]`` logit matrix (2 GiB at 128k vocab x 4k
+    tokens in f32, the recorded single-chip OOM blocker for the 1B preset)
+    is never materialized.  The head matmul and the softmax-cross-entropy
+    run as one online log-sum-exp scan over vocabulary chunks
+    (:func:`torchgpipe_tpu.ops.losses.chunked_softmax_xent`); peak extra
+    memory is one ``[tokens, chunk]`` tile.
+
+    Use with ``post=None`` — this layer owns the final RMSNorm and the
+    head weights (params ``scale``/``w``, trained via the engine's
+    ``grads["loss"]``).  Decomposes over tokens (mean), so it composes
+    with every schedule and the pp-sharded loss phase
+    (``loss_reduction='mean'``).  Local head weights only (no
+    ``tp_axis`` vocab sharding — pair tp models with
+    ``vocab_parallel_cross_entropy`` instead)."""
+    from torchgpipe_tpu.ops.losses import chunked_softmax_xent
+
+    init = _head_init(cfg)
+
+    def apply(params, state, y_and_labels, *, rng=None, train=True):
+        del rng, train
+        y, labels = y_and_labels
+        h = _rms(y, params["scale"], cfg.norm_eps)
+        flat = h.reshape(-1, cfg.dim)
+        losses = chunked_softmax_xent(
+            flat, params["w"], labels.reshape(-1), chunk
+        )
+        return jnp.mean(losses), state
+
+    return Layer(name=name, init=init, apply=apply, meta={})
 
 
 def llama(cfg: TransformerConfig) -> List[Layer]:

@@ -1,0 +1,136 @@
+"""Memory-bounded loss kernels.
+
+:func:`chunked_softmax_xent` is the big-vocabulary cross-entropy: the
+``[T, V]`` logit matrix of a language-model head is the largest single
+tensor in small-pipeline training (e.g. a 128k vocabulary at 4k tokens is
+2 GiB in f32 — the recorded OOM blocker for the 1B-preset runs on a 16 GB
+chip, BENCH_NOTES.md).  Instead of materializing it, the head matmul and
+the softmax-cross-entropy are fused into one ``lax.scan`` over vocabulary
+chunks with online log-sum-exp state — peak extra memory is one
+``[T, chunk]`` tile, independent of V.  The backward pass recomputes each
+chunk's logits and emits the weight-gradient chunkwise (a second scan),
+so no ``[T, V]`` tensor exists in either direction.
+
+New TPU-native capability (the reference is CNN-oriented and has no loss
+kernels); the online-softmax structure mirrors the flash-attention
+forward (ops/flash_attention.py) applied to the classifier axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = jnp.float32(-1e30)
+
+
+def _chunks(w: jnp.ndarray, chunk: int):
+    """``[d, V] -> ([n, d, C], offsets [n])`` with zero padding on V."""
+    d, V = w.shape
+    n = -(-V // chunk)
+    pad = n * chunk - V
+    wp = jnp.pad(w, ((0, 0), (0, pad)))
+    return (
+        jnp.transpose(wp.reshape(d, n, chunk), (1, 0, 2)),
+        jnp.arange(n, dtype=jnp.int32) * chunk,
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_softmax_xent(
+    h: jnp.ndarray, w: jnp.ndarray, labels: jnp.ndarray, chunk: int = 8192
+) -> jnp.ndarray:
+    """Per-token cross-entropy ``-log softmax(h @ w)[label]`` without ever
+    materializing the ``[T, V]`` logits.
+
+    ``h``: ``[T, d]`` hidden states (any float dtype; logits accumulate in
+    f32), ``w``: ``[d, V]`` head weights, ``labels``: ``[T]`` int.  Returns
+    ``[T]`` f32 losses (reduce yourself — ``jnp.mean`` for the usual mean
+    objective).  ``chunk`` bounds the transient tile: peak extra memory is
+    ``T * chunk`` f32 instead of ``T * V``.
+    """
+    loss, _, _ = _xent_fwd_scan(h, w, labels, chunk)
+    return loss
+
+
+def _xent_fwd_scan(h, w, labels, chunk):
+    V = w.shape[1]
+    wc, offs = _chunks(w, chunk)
+
+    def body(carry, xs):
+        m, s, tl = carry
+        w_c, off = xs
+        logits = (h @ w_c).astype(jnp.float32)  # [T, C]
+        valid = off + jnp.arange(chunk) < V
+        logits = jnp.where(valid[None, :], logits, _NEG)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        in_r = (labels >= off) & (labels < off + chunk)
+        idx = jnp.clip(labels - off, 0, chunk - 1)
+        picked = jnp.take_along_axis(logits, idx[:, None], axis=-1)[:, 0]
+        tl = tl + jnp.where(in_r, picked, 0.0)
+        return (m_new, s, tl), None
+
+    T = h.shape[0]
+    init = (
+        jnp.full((T,), _NEG, jnp.float32),
+        jnp.zeros((T,), jnp.float32),
+        jnp.zeros((T,), jnp.float32),
+    )
+    (m, s, tl), _ = lax.scan(body, init, (wc, offs))
+    lse = jnp.log(s) + m
+    return lse - tl, m, s
+
+
+def _xent_vjp_fwd(h, w, labels, chunk):
+    loss, m, s = _xent_fwd_scan(h, w, labels, chunk)
+    return loss, (h, w, labels, m, s)
+
+
+def _xent_vjp_bwd(chunk, res, g):
+    """``g``: ``[T]`` cotangent of the per-token losses.
+
+    ``dlogits = softmax - onehot(label)`` per token; both gradients are
+    assembled chunkwise from recomputed logits:
+    ``dh = Σ_c (g ⊙ p_c) @ w_cᵀ`` and ``dw_c = hᵀ @ (g ⊙ p_c)``.
+    """
+    h, w, labels, m, s = res
+    V = w.shape[1]
+    wc, offs = _chunks(w, chunk)
+    lse = jnp.log(s) + m
+    # Loop-invariant casts hoisted out of the scan body; dh accumulates in
+    # f32 across the V/chunk iterations (a low-precision carry would
+    # compound one rounding per chunk — the dense oracle rounds once) and
+    # is cast back to h.dtype after the scan.
+    h32T = h.astype(jnp.float32).T  # [d, T]
+
+    def body(dh, xs):
+        w_c, off = xs
+        logits = (h @ w_c).astype(jnp.float32)
+        valid = off + jnp.arange(chunk) < V
+        logits = jnp.where(valid[None, :], logits, _NEG)
+        p = jnp.exp(logits - lse[:, None])  # softmax chunk [T, C]
+        in_r = (labels >= off) & (labels < off + chunk)
+        idx = jnp.clip(labels - off, 0, chunk - 1)
+        onehot = (
+            jax.nn.one_hot(idx, chunk, dtype=p.dtype)
+            * in_r[:, None].astype(p.dtype)
+        )
+        dl = (p - onehot) * g[:, None]  # [T, C] f32
+        dh = dh + dl @ w_c.astype(jnp.float32).T
+        dw_c = (h32T @ dl).astype(w.dtype)  # [d, C]
+        return dh, dw_c
+
+    dh0 = jnp.zeros(h.shape, jnp.float32)
+    dh, dw_chunks = lax.scan(body, dh0, (wc, offs))
+    dh = dh.astype(h.dtype)
+    # [n, d, C] -> [d, n*C] -> trim padding -> [d, V]
+    dw = jnp.transpose(dw_chunks, (1, 0, 2)).reshape(w.shape[0], -1)[:, :V]
+    return dh, dw, None
+
+
+chunked_softmax_xent.defvjp(_xent_vjp_fwd, _xent_vjp_bwd)

@@ -331,7 +331,13 @@ class SpmdGPipe:
     def __post_init__(self):
         if self.pp_axis not in self.mesh.axis_names:
             raise ValueError(f"mesh has no {self.pp_axis!r} axis: {self.mesh}")
-        for what, lyr in (("block", self.block), ("pre", self.pre), ("post", self.post)):
+        # loss_fn may be a parametric LOSS LAYER (init/apply with params;
+        # e.g. models.transformer.chunked_lm_loss) instead of a plain
+        # callable; its params live under params["loss"], replicated over
+        # pp, with grads psum-shared like pre/post.
+        self._loss_is_layer = isinstance(self.loss_fn, Layer)
+        loss_lyr = self.loss_fn if self._loss_is_layer else None
+        for what, lyr in (("block", self.block), ("pre", self.pre), ("post", self.post), ("loss", loss_lyr)):
             if lyr is not None and (lyr.stash or lyr.pop):
                 raise ValueError(
                     f"SPMD engine does not support cross-stage skip "
@@ -510,6 +516,9 @@ class SpmdGPipe:
         self._post_spec = (
             layer_param_specs(self.post) if self.post is not None else None
         )
+        self._loss_spec = (
+            layer_param_specs(self.loss_fn) if self._loss_is_layer else None
+        )
         self._train_step_fns: dict = {}  # keyed by use_rng
         self._apply_fn = None
         # FSDP bookkeeping, resolved lazily from the first params tree seen
@@ -605,7 +614,19 @@ class SpmdGPipe:
             )
         return tmap(lambda a, r: jnp.where(first, a, r), x0, fallback)
 
-    def _cell_mb_loss(self, y, p_post, i, tgt_mb, post_base):
+    def _loss_call(self, p_loss, y, tgt):
+        """The engine's one loss entry point: a plain ``loss_fn(y, tgt)``
+        callable, or a parametric loss layer applied to ``(y, tgt)`` with
+        its own params (e.g. the fused chunked-vocab cross-entropy,
+        models.transformer.chunked_lm_loss)."""
+        if self._loss_is_layer:
+            out, _ = self.loss_fn.apply(
+                p_loss, (), (y, tgt), rng=None, train=True
+            )
+            return out
+        return self.loss_fn(y, tgt)
+
+    def _cell_mb_loss(self, y, p_post, p_loss, i, tgt_mb, post_base):
         """Per-micro-batch head + loss for a final cell (aux scale 1/m:
         the m cells average to one mini-batch, mirroring the fill-drain
         head's 1/n over n batch slices)."""
@@ -619,7 +640,7 @@ class SpmdGPipe:
             lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
             tgt_mb,
         )
-        loss_i = self.loss_fn(y, tgt_i).astype(jnp.float32)
+        loss_i = self._loss_call(p_loss, y, tgt_i).astype(jnp.float32)
         if self.loss_reduction == "mean":
             loss_i = loss_i / self.chunks
         return loss_i
@@ -657,7 +678,7 @@ class SpmdGPipe:
         grads["blocks"] = jax.tree_util.tree_map(
             red_leaf, grads["blocks"], self._fsdp_dims
         )
-        for k in ("pre", "post"):
+        for k in ("pre", "post", "loss"):
             if k in grads:
                 grads[k] = lax.pmean(grads[k], self.dp_axis)
         return loss, grads
@@ -686,7 +707,7 @@ class SpmdGPipe:
         grads["blocks"] = jax.tree_util.tree_map(
             red_ep, grads["blocks"], bspecs
         )
-        for k in ("pre", "post"):
+        for k in ("pre", "post", "loss"):
             if k in grads:
                 grads[k] = red(grads[k], self.ep_axis)
         return loss, grads
@@ -773,6 +794,11 @@ class SpmdGPipe:
             self._check_stateless(s, "post")
             params["post"] = p
 
+        if self._loss_is_layer:
+            p, s = self.loss_fn.init(jax.random.fold_in(rng, 3000), spec)
+            self._check_stateless(s, "loss")
+            params["loss"] = p
+
         return params
 
     def _leaf_specs(self, prefix: Pytree, tree: Pytree, what: str) -> Pytree:
@@ -799,6 +825,8 @@ class SpmdGPipe:
             trees.append(("pre", self._pre_spec))
         if "post" in params:
             trees.append(("post", self._post_spec))
+        if "loss" in params:
+            trees.append(("loss", self._loss_spec))
         for k, prefix in trees:
             if k == "blocks" and self.fsdp:
                 self._ensure_fsdp(params[k])
@@ -1048,6 +1076,7 @@ class SpmdGPipe:
             params_local = tmap(lambda a: a[0], blocks_in)
             pre_params = params["pre"] if self.pre is not None else ()
             post_params = params["post"] if self.post is not None else ()
+            loss_params = params["loss"] if self._loss_is_layer else ()
             pre_base = (
                 jax.random.fold_in(rng, 0x7FFFFFFF) if rng is not None else None
             )
@@ -1074,8 +1103,10 @@ class SpmdGPipe:
                     p_pre, stage == 0, i, fallback, x_mb, pre_base
                 )
 
-            def mb_loss(y, p_post, i):
-                return self._cell_mb_loss(y, p_post, i, tgt_mb, post_base)
+            def mb_loss(y, p_post, p_loss, i):
+                return self._cell_mb_loss(
+                    y, p_post, p_loss, i, tgt_mb, post_base
+                )
 
             act_spec = jax.eval_shape(
                 lambda p, x: self._block_fn_plain(p, x, None, aux_s, False),
@@ -1101,6 +1132,7 @@ class SpmdGPipe:
                 gblk=tmap(jnp.zeros_like, params_local),
                 gpre=tmap(jnp.zeros_like, pre_params),
                 gpost=tmap(jnp.zeros_like, post_params),
+                gloss=tmap(jnp.zeros_like, loss_params),
                 loss=jnp.float32(0.0),
             )
 
@@ -1161,14 +1193,17 @@ class SpmdGPipe:
                         )
 
                     def last_fn():
-                        def full(p_blk, p_pre, p_post, x):
+                        def full(p_blk, p_pre, p_post, p_loss, x):
                             y = through_block(p_blk, p_pre, x)
-                            return mb_loss(y, p_post, i_b)
+                            return mb_loss(y, p_post, p_loss, i_b)
 
-                        loss_i, (d_blk, d_pre, d_post, dx) = jax.value_and_grad(
-                            full, argnums=(0, 1, 2, 3)
-                        )(params_local, pre_params, post_params, x_saved)
-                        return loss_i, d_blk, d_pre, d_post, dx
+                        loss_i, (d_blk, d_pre, d_post, d_loss, dx) = (
+                            jax.value_and_grad(full, argnums=(0, 1, 2, 3, 4))(
+                                params_local, pre_params, post_params,
+                                loss_params, x_saved,
+                            )
+                        )
+                        return loss_i, d_blk, d_pre, d_post, d_loss, dx
 
                     def mid_fn():
                         _, vjp_cell = jax.vjp(
@@ -1180,10 +1215,11 @@ class SpmdGPipe:
                             d_blk,
                             d_pre,
                             tmap(jnp.zeros_like, post_params),
+                            tmap(jnp.zeros_like, loss_params),
                             dx,
                         )
 
-                    loss_i, d_blk, d_pre, d_post, dx = lax.cond(
+                    loss_i, d_blk, d_pre, d_post, d_loss, dx = lax.cond(
                         stage == n - 1, last_fn, mid_fn
                     )
                     return dict(
@@ -1192,6 +1228,7 @@ class SpmdGPipe:
                         gblk=tmap(jnp.add, c["gblk"], d_blk),
                         gpre=tmap(jnp.add, c["gpre"], d_pre),
                         gpost=tmap(jnp.add, c["gpost"], d_post),
+                        gloss=tmap(jnp.add, c["gloss"], d_loss),
                         loss=c["loss"] + loss_i,
                     )
 
@@ -1210,6 +1247,8 @@ class SpmdGPipe:
                 grads["pre"] = lax.psum(carry["gpre"], self.pp_axis)
             if self.post is not None:
                 grads["post"] = lax.psum(carry["gpost"], self.pp_axis)
+            if self._loss_is_layer:
+                grads["loss"] = lax.psum(carry["gloss"], self.pp_axis)
             # Cross-axis reductions shared with the fill-drain path (no sp
             # here — rejected in __post_init__).  scatter_blocks: the
             # explicit block grads are w.r.t. the GATHERED params and still
@@ -1226,6 +1265,8 @@ class SpmdGPipe:
             param_specs["pre"] = self._pre_spec
         if self.post is not None:
             param_specs["post"] = self._post_spec
+        if self._loss_is_layer:
+            param_specs["loss"] = self._loss_spec
 
         if use_rng:
             in_specs = (param_specs, data_spec, data_spec, P())
@@ -1287,6 +1328,7 @@ class SpmdGPipe:
             params_local = tmap(lambda a: a[0], blocks_in)  # [v, ...]
             pre_params = params["pre"] if self.pre is not None else ()
             post_params = params["post"] if self.post is not None else ()
+            loss_params = params["loss"] if self._loss_is_layer else ()
             pre_base = (
                 jax.random.fold_in(rng, 0x7FFFFFFF) if rng is not None else None
             )
@@ -1315,8 +1357,10 @@ class SpmdGPipe:
                     pre_base,
                 )
 
-            def mb_loss(y, p_post, i):
-                return self._cell_mb_loss(y, p_post, i, tgt_mb, post_base)
+            def mb_loss(y, p_post, p_loss, i):
+                return self._cell_mb_loss(
+                    y, p_post, p_loss, i, tgt_mb, post_base
+                )
 
             act_spec = jax.eval_shape(
                 lambda p, x: self._block_fn_plain(p, x, None, aux_s, False),
@@ -1341,6 +1385,7 @@ class SpmdGPipe:
                 gblk=tmap(jnp.zeros_like, params_local),
                 gpre=tmap(jnp.zeros_like, pre_params),
                 gpost=tmap(jnp.zeros_like, post_params),
+                gloss=tmap(jnp.zeros_like, loss_params),
                 loss=jnp.float32(0.0),
             )
 
@@ -1397,14 +1442,17 @@ class SpmdGPipe:
                         )
 
                     def last_fn():
-                        def full(p_blk, p_pre, p_post, x):
+                        def full(p_blk, p_pre, p_post, p_loss, x):
                             y = through_block(p_blk, p_pre, x)
-                            return mb_loss(y, p_post, i)
+                            return mb_loss(y, p_post, p_loss, i)
 
-                        loss_i, (d_blk, d_pre, d_post, dx) = jax.value_and_grad(
-                            full, argnums=(0, 1, 2, 3)
-                        )(p_of(c), pre_params, post_params, x_saved)
-                        return loss_i, d_blk, d_pre, d_post, dx
+                        loss_i, (d_blk, d_pre, d_post, d_loss, dx) = (
+                            jax.value_and_grad(full, argnums=(0, 1, 2, 3, 4))(
+                                p_of(c), pre_params, post_params,
+                                loss_params, x_saved,
+                            )
+                        )
+                        return loss_i, d_blk, d_pre, d_post, d_loss, dx
 
                     def mid_fn():
                         _, vjp_cell = jax.vjp(
@@ -1416,10 +1464,11 @@ class SpmdGPipe:
                             d_blk,
                             d_pre,
                             tmap(jnp.zeros_like, post_params),
+                            tmap(jnp.zeros_like, loss_params),
                             dx,
                         )
 
-                    loss_i, d_blk, d_pre, d_post, dx = lax.cond(
+                    loss_i, d_blk, d_pre, d_post, d_loss, dx = lax.cond(
                         (stage == n - 1) & (c == v - 1), last_fn, mid_fn
                     )
                     gblk = tmap(
@@ -1441,6 +1490,7 @@ class SpmdGPipe:
                         gblk=gblk,
                         gpre=tmap(jnp.add, cr["gpre"], d_pre),
                         gpost=tmap(jnp.add, cr["gpost"], d_post),
+                        gloss=tmap(jnp.add, cr["gloss"], d_loss),
                         loss=cr["loss"] + loss_i,
                     )
 
@@ -1457,6 +1507,8 @@ class SpmdGPipe:
                 grads["pre"] = lax.psum(carry["gpre"], self.pp_axis)
             if self.post is not None:
                 grads["post"] = lax.psum(carry["gpost"], self.pp_axis)
+            if self._loss_is_layer:
+                grads["loss"] = lax.psum(carry["gloss"], self.pp_axis)
             loss, grads = self._reduce_dp(loss, grads, scatter_blocks=True)
             loss, grads = self._reduce_ep(loss, grads)
             return loss, grads
@@ -1468,6 +1520,8 @@ class SpmdGPipe:
             param_specs["pre"] = self._pre_spec
         if self.post is not None:
             param_specs["post"] = self._post_spec
+        if self._loss_is_layer:
+            param_specs["loss"] = self._loss_spec
 
         if use_rng:
             in_specs = (param_specs, data_spec, data_spec, P())
@@ -1562,7 +1616,9 @@ class SpmdGPipe:
                             my, _ = self.post.apply(
                                 params["post"], (), my, rng=post_rng, train=True
                             )
-                    l = self.loss_fn(my, tgt_my)
+                    l = self._loss_call(
+                        params.get("loss", ()), my, tgt_my
+                    )
                     if self.loss_reduction == "mean":
                         l = l / n
                     # LOCAL per-slice loss; the psum after value_and_grad
@@ -1576,7 +1632,7 @@ class SpmdGPipe:
                         gathered, _ = self.post.apply(
                             params["post"], (), gathered, rng=post_rng, train=True
                         )
-                l = self.loss_fn(gathered, tgt)
+                l = self._loss_call(params.get("loss", ()), gathered, tgt)
                 # LOCAL loss, nonzero only on the last stage.  Do NOT psum
                 # here: differentiating a replicated (psum'd) output would
                 # seed one cotangent per device and over-count gradients by
@@ -1586,12 +1642,15 @@ class SpmdGPipe:
 
             loss, grads = jax.value_and_grad(loss_of)(params)
             loss = lax.psum(loss, self.pp_axis)  # broadcast for reporting
-            # pre/post grads land on the consuming stage's lane only; share
-            # across pp.  Block grads are per-stage local by construction.
+            # pre/post/loss grads land on the consuming stage's lane only;
+            # share across pp.  Block grads are per-stage local by
+            # construction.
             if self.pre is not None:
                 grads["pre"] = lax.psum(grads["pre"], self.pp_axis)
             if self.post is not None:
                 grads["post"] = lax.psum(grads["post"], self.pp_axis)
+            if self._loss_is_layer:
+                grads["loss"] = lax.psum(grads["loss"], self.pp_axis)
             loss, grads = self._reduce_dp(loss, grads, scatter_blocks=False)
             loss, grads = self._reduce_ep(loss, grads)
             if self.sp_axis:
@@ -1610,6 +1669,8 @@ class SpmdGPipe:
             param_specs["pre"] = self._pre_spec
         if self.post is not None:
             param_specs["post"] = self._post_spec
+        if self._loss_is_layer:
+            param_specs["loss"] = self._loss_spec
 
         if use_rng:
             in_specs = (param_specs, data_spec, data_spec, P())
@@ -1662,7 +1723,10 @@ class SpmdGPipe:
                 f"got {type(params).__name__} with keys "
                 f"{sorted(params) if isinstance(params, dict) else 'n/a'}"
             )
-        for key, layer in (("pre", self.pre), ("post", self.post)):
+        checks = [("pre", self.pre), ("post", self.post)]
+        if self._loss_is_layer:
+            checks.append(("loss", self.loss_fn))
+        for key, layer in checks:
             if (layer is not None) != (key in params):
                 raise ValueError(
                     f"engine {'defines' if layer is not None else 'has no'} "
@@ -1748,6 +1812,8 @@ class SpmdGPipe:
             param_specs["pre"] = self._pre_spec
         if self.post is not None:
             param_specs["post"] = self._post_spec
+        if self._loss_is_layer:
+            param_specs["loss"] = self._loss_spec
 
         mapped = _shard_map(
             local,
@@ -1876,6 +1942,8 @@ class SpmdGPipe:
             param_specs["pre"] = self._pre_spec
         if self.post is not None:
             param_specs["post"] = self._post_spec
+        if self._loss_is_layer:
+            param_specs["loss"] = self._loss_spec
 
         mapped = _shard_map(
             local,
