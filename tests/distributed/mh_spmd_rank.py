@@ -1,0 +1,71 @@
+"""Rank entry point for the real multi-process SPMD test.
+
+Each OS process contributes 4 virtual host devices to one GLOBAL 8-device
+mesh via ``jax.distributed`` (gloo coordination over localhost), then runs
+the SAME SpmdGPipe training loop on a pp x dp mesh — the pipeline's
+``ppermute`` hand-offs and the dp gradient ``pmean`` cross the process
+boundary exactly as they would cross hosts over DCN on a TPU pod
+(docs/multihost.md).  Prints per-step losses for the parent test to
+compare across ranks and against the single-process oracle.
+
+Usage: ``python mh_spmd_rank.py <proc_id> <num_procs> <port>``
+"""
+
+import os
+import sys
+
+proc, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=nprocs,
+    process_id=proc,
+)
+
+import jax.numpy as jnp
+
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    cross_entropy,
+    llama_spmd,
+)
+from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+
+def main():
+    assert jax.device_count() == 4 * nprocs
+    pp, dp, m = 4, 2, 4
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=pp, n_heads=4, n_kv_heads=2
+    )
+    block, pre, post = llama_spmd(cfg, pp)
+    mesh = make_mesh(pp, dp, devices=jax.devices())
+    pipe = SpmdGPipe(
+        block, pp, mesh, chunks=m, loss_fn=cross_entropy,
+        pre=pre, post=post, dp_axis="dp",
+    )
+    # Identical data on every process: device_put to the global sharding
+    # slices out each process's addressable shard.
+    tokens = jnp.mod(
+        jnp.arange(m * dp * 2 * 16).reshape(m * dp * 2, 16), 64
+    ).astype(jnp.int32)
+    labels = jnp.mod(tokens + 1, 64)
+    spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    params = pipe.init(jax.random.PRNGKey(0), spec)
+    for step in range(3):
+        loss, grads = pipe.train_step(params, tokens, labels)
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - 0.1 * g, params, grads
+        )
+        print(f"RANK{proc} STEP{step} LOSS {float(loss):.6f}", flush=True)
+    print(f"RANK{proc} DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
