@@ -8,13 +8,23 @@ boundary exactly as they would cross hosts over DCN on a TPU pod
 (docs/multihost.md).  Prints per-step losses for the parent test to
 compare across ranks and against the single-process oracle.
 
-Usage: ``python mh_spmd_rank.py <proc_id> <num_procs> <port>``
+Usage: ``python mh_spmd_rank.py <proc_id> <num_procs> <port> [mode]``
+
+``mode``:
+
+* ``identical`` (default) — every process feeds the full batch
+  (``device_put`` slices out the addressable shards); pp-outermost mesh.
+* ``local-feed`` — dp-outermost mesh so each process OWNS one dp slice,
+  and each process materializes only its own rows of the global batch
+  (``utils.data.global_batch_from_local`` stitches them) — the real
+  multi-host input-pipeline recipe where no host holds the full batch.
 """
 
 import os
 import sys
 
 proc, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+mode = sys.argv[4] if len(sys.argv) > 4 else "identical"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
 ).strip()
@@ -39,25 +49,45 @@ from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
 
 
 def main():
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
     assert jax.device_count() == 4 * nprocs
     pp, dp, m = 4, 2, 4
     cfg = TransformerConfig(
         vocab=64, dim=32, n_layers=pp, n_heads=4, n_kv_heads=2
     )
     block, pre, post = llama_spmd(cfg, pp)
-    mesh = make_mesh(pp, dp, devices=jax.devices())
+    if mode == "local-feed":
+        # dp OUTERMOST: process r owns the whole dp=r slice, so it can
+        # feed just its own rows of the global batch.
+        mesh = Mesh(
+            np.array(jax.devices()).reshape(dp, pp), ("dp", "pp")
+        )
+    else:
+        mesh = make_mesh(pp, dp, devices=jax.devices())
     pipe = SpmdGPipe(
         block, pp, mesh, chunks=m, loss_fn=cross_entropy,
         pre=pre, post=post, dp_axis="dp",
     )
-    # Identical data on every process: device_put to the global sharding
-    # slices out each process's addressable shard.
-    tokens = jnp.mod(
-        jnp.arange(m * dp * 2 * 16).reshape(m * dp * 2, 16), 64
-    ).astype(jnp.int32)
+    B = m * dp * 2
+    tokens = jnp.mod(jnp.arange(B * 16).reshape(B, 16), 64).astype(jnp.int32)
     labels = jnp.mod(tokens + 1, 64)
     spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
     params = pipe.init(jax.random.PRNGKey(0), spec)
+    if mode == "local-feed":
+        from torchgpipe_tpu.utils.data import global_batch_from_local
+
+        # Each process materializes ONLY its dp slice of the global batch
+        # (this process's rows of the arrays above) and stitches a global
+        # jax.Array from the local shards.
+        rows = slice(proc * (B // nprocs), (proc + 1) * (B // nprocs))
+        tokens = global_batch_from_local(
+            mesh, P("dp"), np.asarray(tokens[rows])
+        )
+        labels = global_batch_from_local(
+            mesh, P("dp"), np.asarray(labels[rows])
+        )
     for step in range(3):
         loss, grads = pipe.train_step(params, tokens, labels)
         params = jax.tree_util.tree_map(

@@ -14,6 +14,7 @@ Asserts: both ranks report identical losses, and those losses equal the
 single-process oracle running the same config on 8 in-process devices.
 """
 
+import functools
 import os
 import socket
 import subprocess
@@ -38,6 +39,7 @@ def _free_port() -> int:
     return port
 
 
+@functools.lru_cache(maxsize=1)
 def _oracle_losses():
     """Same config as mh_spmd_rank.py on THIS process's 8 devices."""
     from torchgpipe_tpu.models.transformer import (
@@ -74,35 +76,51 @@ def _oracle_losses():
     return losses
 
 
-def test_two_process_global_mesh_matches_single_process(cpu_devices):
+@pytest.mark.parametrize("mode", ["identical", "local-feed"])
+def test_two_process_global_mesh_matches_single_process(cpu_devices, mode):
+    """``identical``: every process feeds the full batch.  ``local-feed``:
+    dp-outermost mesh, each process materializes ONLY its own dp slice and
+    ``utils.data.global_batch_from_local`` stitches the global array — the
+    real multi-host input recipe.  Both must equal the single-process
+    oracle exactly."""
     port = _free_port()
     env = cpu_subproc_env()
     # The rank script manages its own platform/device-count flags.
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _RANK, str(r), "2", str(port)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            env=env,
-            cwd=REPO,
-        )
-        for r in range(2)
-    ]
-    outs = []
+    # Output goes to per-rank log files, NOT pipes: a filling unread pipe
+    # would block the writing rank mid-collective and stall BOTH ranks
+    # until the timeout (pattern shared with test_real_processes.py).
+    import tempfile
+
+    logdir = tempfile.mkdtemp(prefix="mh_spmd_")
+    logs = [os.path.join(logdir, f"rank{r}.log") for r in range(2)]
+    procs = []
+    files = []
     try:
+        for r in range(2):
+            f = open(logs[r], "w")
+            files.append(f)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, _RANK, str(r), "2", str(port), mode],
+                    stdout=f,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                    cwd=REPO,
+                )
+            )
         for p in procs:
-            out, _ = p.communicate(timeout=540)
-            outs.append(out)
+            p.wait(timeout=540)
     finally:
         # A pre-rendezvous crash or coordinator deadlock must not leak
-        # live ranks into the rest of the CI job (pattern shared with
-        # test_real_processes.py).
+        # live ranks into the rest of the CI job.
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        for f in files:
+            f.close()
+    outs = [_read(path) for path in logs]
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
         assert f"RANK{r} DONE" in out, out[-2000:]
@@ -120,3 +138,8 @@ def test_two_process_global_mesh_matches_single_process(cpu_devices):
     oracle = _oracle_losses()
     for a, b in zip(l0, oracle):
         assert abs(a - b) < 1e-4, (l0, oracle)
+
+
+def _read(path):
+    with open(path) as f:
+        return f.read()

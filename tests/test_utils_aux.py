@@ -369,3 +369,21 @@ def test_timeline_chrome_trace_export(tmp_path):
         assert abs(s["dur"] - want[key]) < 1e-6, (s, want[key])
     kinds = {s["args"]["kind"] for s in slices}
     assert kinds == {"fwd", "bwd"}
+
+
+def test_global_batch_from_local_single_process(cpu_devices):
+    """Single-process (all devices addressable): degrades to device_put
+    with the requested sharding — same API everywhere."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from torchgpipe_tpu.utils.data import global_batch_from_local
+
+    mesh = Mesh(np.array(cpu_devices[:4]).reshape(4), ("dp",))
+    batch = {"x": np.arange(8, dtype=np.float32).reshape(8, 1)}
+    out = global_batch_from_local(mesh, P("dp"), batch)
+    assert out["x"].shape == (8, 1)
+    np.testing.assert_array_equal(
+        np.asarray(out["x"]), batch["x"]
+    )
+    assert out["x"].sharding.spec == P("dp")

@@ -52,3 +52,31 @@ def prefetch_to_device(
     while queue:
         yield queue.popleft()
         enqueue(1)
+
+
+def global_batch_from_local(mesh, spec, local_batch: Pytree) -> Pytree:
+    """Assemble a GLOBAL sharded batch from each process's LOCAL shard.
+
+    The multi-host data recipe (docs/multihost.md): every process loads
+    only its own slice of the global batch (e.g. its dp lanes' examples)
+    and this stitches them into one global ``jax.Array`` sharded by
+    ``spec`` over ``mesh`` — no host ever holds, or sends, the full batch.
+    Wraps ``jax.make_array_from_process_local_data``, which infers the
+    global shape from the local one and the sharding's process layout.
+
+    Single-process (all devices addressable) it degrades to a plain
+    ``device_put``, so the same input pipeline runs everywhere.
+
+    ``spec`` is a ``PartitionSpec`` applied to every leaf of the batch
+    pytree (the engines' data convention: batch dim sharded over the data
+    axes, e.g. ``P(("dp", "ep"))``).
+    """
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    if sharding.is_fully_addressable:
+        return jax.device_put(local_batch, sharding)
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.make_array_from_process_local_data(sharding, leaf),
+        local_batch,
+    )
