@@ -307,3 +307,94 @@ def test_interleaved_validation_errors():
             block, n, mesh, chunks=4, loss_fn=loss_fn,
             schedule="interleaved", virtual_stages=v, checkpoint="never",
         )
+
+
+def test_interleaved_composes_with_tp():
+    """Megatron tensor parallelism inside interleaved cells: the tp psums
+    are group-local (same stage, same branch), so they are safe inside the
+    schedule's switch — gradient parity vs the fill-drain engine running
+    the same n*v blocks on an (n*v) x tp mesh."""
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        cross_entropy,
+        llama_spmd,
+    )
+
+    n, v, m, tp = 2, 2, 4, 2
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=n * v, n_heads=4, n_kv_heads=2,
+        tp_axis="tp",
+    )
+    block, pre, post = llama_spmd(cfg, n * v)
+    mesh = make_mesh(n, 1, tp=tp, devices=jax.devices()[: n * tp])
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=m, loss_fn=cross_entropy, pre=pre, post=post,
+        checkpoint="always", schedule="interleaved", virtual_stages=v,
+        tp_axis="tp",
+    )
+    tokens, labels = _data(m * 2)
+    in_spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    params = pipe.init(jax.random.PRNGKey(0), in_spec)
+    loss, grads = pipe.train_step(params, tokens, labels)
+
+    mesh_o = make_mesh(n * v, 1, tp=tp, devices=jax.devices()[: n * v * tp])
+    oracle = SpmdGPipe(
+        block, n * v, mesh_o, chunks=m, loss_fn=cross_entropy,
+        pre=pre, post=post, checkpoint="always", tp_axis="tp",
+    )
+    params_o = oracle.init(jax.random.PRNGKey(0), in_spec)
+    loss_o, grads_o = oracle.train_step(params_o, tokens, labels)
+    assert abs(float(loss) - float(loss_o)) < 1e-4
+    gi = jax.tree_util.tree_map(_to_global, grads["blocks"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(gi),
+        jax.tree_util.tree_leaves(grads_o["blocks"]),
+    ):
+        assert _rel_err(a, b) < 1e-4
+
+
+def test_interleaved_composes_with_ep_moe():
+    """MoE expert parallelism under the interleaved schedule: the
+    all_to_all token dispatch is group-local (same stage, same branch) and
+    the aux balance-gradient injection rides the per-cell vjp."""
+    from torchgpipe_tpu.models.moe import MoEConfig, llama_moe_spmd
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        cross_entropy,
+    )
+
+    n, v, m, ep = 2, 2, 4, 2
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=n * v, n_heads=4, n_kv_heads=2
+    )
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=2.0, ep_axis="ep")
+    block, pre, post = llama_moe_spmd(cfg, moe, n * v)
+    mesh = make_mesh(n, 1, ep=ep, devices=jax.devices()[: n * ep])
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=m, loss_fn=cross_entropy, pre=pre, post=post,
+        checkpoint="always", schedule="interleaved", virtual_stages=v,
+        ep_axis="ep",
+    )
+    tokens, labels = _data(m * ep * 2)
+    in_spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    params = pipe.init(jax.random.PRNGKey(0), in_spec)
+    loss, grads = pipe.train_step(
+        params, tokens, labels, jax.random.PRNGKey(1)
+    )
+
+    mesh_o = make_mesh(n * v, 1, ep=ep, devices=jax.devices()[: n * v * ep])
+    oracle = SpmdGPipe(
+        block, n * v, mesh_o, chunks=m, loss_fn=cross_entropy,
+        pre=pre, post=post, checkpoint="always", ep_axis="ep",
+    )
+    params_o = oracle.init(jax.random.PRNGKey(0), in_spec)
+    loss_o, grads_o = oracle.train_step(
+        params_o, tokens, labels, jax.random.PRNGKey(1)
+    )
+    assert abs(float(loss) - float(loss_o)) < 1e-4
+    gi = jax.tree_util.tree_map(_to_global, grads["blocks"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(gi),
+        jax.tree_util.tree_leaves(grads_o["blocks"]),
+    ):
+        assert _rel_err(a, b) < 1e-4
