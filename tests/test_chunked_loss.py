@@ -189,3 +189,28 @@ def test_loss_layer_params_validated():
     bad = {k: v for k, v in p.items() if k != "loss"}
     with pytest.raises(ValueError, match="loss"):
         eng.train_step(bad, tokens, labels)
+
+
+def test_eval_loss_matches_train_loss_for_deterministic_model():
+    """eval_loss == train_step's loss for a dropout-free model (same data,
+    same params), for both a plain loss_fn and the parametric loss layer."""
+    pp, m = 2, 2
+    cfg, block, pre, post, mesh, tokens, labels = _setup(pp, pp, m)
+    spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+
+    plain = SpmdGPipe(
+        block, pp, mesh, chunks=m, loss_fn=cross_entropy, pre=pre, post=post
+    )
+    p = plain.init(jax.random.PRNGKey(0), spec)
+    l_train, _ = plain.train_step(p, tokens, labels)
+    l_eval = plain.eval_loss(p, tokens, labels)
+    assert abs(float(l_train) - float(l_eval)) < 1e-5
+
+    fused = SpmdGPipe(
+        block, pp, mesh, chunks=m, loss_fn=chunked_lm_loss(cfg, chunk=16),
+        pre=pre, post=None,
+    )
+    pf = fused.init(jax.random.PRNGKey(0), spec)
+    lf_train, _ = fused.train_step(pf, tokens, labels)
+    lf_eval = fused.eval_loss(pf, tokens, labels)
+    assert abs(float(lf_train) - float(lf_eval)) < 1e-5

@@ -614,14 +614,14 @@ class SpmdGPipe:
             )
         return tmap(lambda a, r: jnp.where(first, a, r), x0, fallback)
 
-    def _loss_call(self, p_loss, y, tgt):
+    def _loss_call(self, p_loss, y, tgt, train=True):
         """The engine's one loss entry point: a plain ``loss_fn(y, tgt)``
         callable, or a parametric loss layer applied to ``(y, tgt)`` with
         its own params (e.g. the fused chunked-vocab cross-entropy,
         models.transformer.chunked_lm_loss)."""
         if self._loss_is_layer:
             out, _ = self.loss_fn.apply(
-                p_loss, (), (y, tgt), rng=None, train=True
+                p_loss, (), (y, tgt), rng=None, train=train
             )
             return out
         return self.loss_fn(y, tgt)
@@ -1952,6 +1952,22 @@ class SpmdGPipe:
             out_specs=data_spec,
         )
         return jax.jit(mapped)
+
+    def eval_loss(self, params, x, target):
+        """Loss on a mini-batch WITHOUT gradients (eval semantics:
+        ``train=False`` through every layer — dropout off, checkpoint
+        bypassed — like the reference's eval-mode ``checkpoint_stop=0``,
+        reference gpipe.py:360-367).
+
+        Works with plain ``loss_fn`` callables and with parametric loss
+        layers (whose loss value cannot be recomputed from :meth:`apply`'s
+        outputs alone when ``post=None`` hides no logits — e.g. the
+        chunked-vocab CE never materializes them)."""
+        out = self.apply(params, x)
+        return self._loss_call(
+            params["loss"] if self._loss_is_layer else (), out, target,
+            train=False,
+        )
 
     def apply(self, params, x):
         """Pipelined inference forward; returns gathered outputs ``[B, ...]``."""
