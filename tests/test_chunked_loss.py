@@ -214,3 +214,46 @@ def test_eval_loss_matches_train_loss_for_deterministic_model():
     lf_train, _ = fused.train_step(pf, tokens, labels)
     lf_eval = fused.eval_loss(pf, tokens, labels)
     assert abs(float(lf_train) - float(lf_eval)) < 1e-5
+
+
+def test_eval_loss_interleaved_and_never_gathers_logits():
+    """eval_loss under the interleaved schedule matches its train loss,
+    and for decomposable losses the mapped eval program's temp memory
+    stays well below full-batch logits (the loss runs per-micro-batch
+    inside shard_map)."""
+    import torchgpipe_tpu.microbatch as mb
+
+    n, v, m = 2, 2, 4
+    # Vocab large enough that gathered full-batch logits would dominate
+    # the program's temp bytes — the thing the mapped eval must avoid.
+    cfg = TransformerConfig(
+        vocab=4096, dim=64, n_layers=n * v, n_heads=4, n_kv_heads=2
+    )
+    block, pre, post = llama_spmd(cfg, n * v)
+    mesh = make_mesh(n, 1, devices=jax.devices()[:n])
+    tokens = jnp.mod(jnp.arange(2 * m * 32).reshape(2 * m, 32), 4096).astype(
+        jnp.int32
+    )
+    labels = jnp.mod(tokens + 1, 4096)
+    eng = SpmdGPipe(
+        block, n, mesh, chunks=m, loss_fn=cross_entropy, pre=pre, post=post,
+        checkpoint="always", schedule="interleaved", virtual_stages=v,
+    )
+    p = eng.init(
+        jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct(tokens.shape, tokens.dtype),
+    )
+    l_train, _ = eng.train_step(p, tokens, labels)
+    l_eval = eng.eval_loss(p, tokens, labels)
+    assert abs(float(l_train) - float(l_eval)) < 1e-5
+
+    # Memory: the mapped eval program must NOT materialize a gathered
+    # [B, seq, vocab] logits tensor (per-micro-batch loss consumes 1/m).
+    fn = eng._eval_fn
+    x_mb = mb.scatter_stacked(tokens, m)
+    t_mb = mb.scatter_stacked(labels, m)
+    ma = fn.lower(p, x_mb, t_mb).compile().memory_analysis()
+    full_logits = tokens.shape[0] * tokens.shape[1] * cfg.vocab * 4
+    assert ma.temp_size_in_bytes < full_logits, (
+        ma.temp_size_in_bytes, full_logits
+    )

@@ -189,3 +189,49 @@ def test_spmd_tp_ep_program_structure(cpu_devices):
     # embedding) plus the engine's loss/grad reductions.
     assert n_psum >= 3, f"expected tp/engine psums, found {n_psum}"
     assert n_ppermute >= 1
+
+
+def test_spmd_interleaved_program_structure(cpu_devices):
+    """The interleaved program must be ONE table-driven scan of exactly
+    `ticks` iterations with the two ring ppermutes unconditional per tick
+    (outside the fwd/bwd/idle switch — collective participation is
+    global), and the inference program one forward-table scan."""
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig, cross_entropy, llama_spmd,
+    )
+    from torchgpipe_tpu.parallel.interleaved import (
+        interleaved_forward_tables,
+        interleaved_tables,
+    )
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    n, v, m = 2, 2, 4
+    mesh = make_mesh(n, 1, devices=cpu_devices[:n])
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=n * v, n_heads=2,
+                            n_kv_heads=1)
+    block, pre, post = llama_spmd(cfg, n * v)
+    pipe = SpmdGPipe(block, n, mesh, chunks=m, loss_fn=cross_entropy,
+                     pre=pre, post=post, checkpoint="always",
+                     schedule="interleaved", virtual_stages=v)
+    tokens = jnp.zeros((2 * m, 8), jnp.int32)
+    params = pipe.init(jax.random.PRNGKey(0),
+                       jax.ShapeDtypeStruct(tokens.shape, tokens.dtype))
+
+    fn = pipe._build_train_step(use_rng=False)
+    x_mb = microbatch.scatter_stacked(tokens, m)
+    jaxpr = jax.make_jaxpr(lambda p, a, b: fn(p, a, b))(params, x_mb, x_mb)
+
+    from tests.jaxpr_utils import scan_lengths
+
+    ticks = interleaved_tables(n, m, v).ticks
+    lengths = scan_lengths(jaxpr.jaxpr)
+    assert ticks in lengths, (ticks, lengths)
+    # Exactly 2 ppermutes per tick (forward + backward ring), both in the
+    # scan body, i.e. unconditional: the switch branches contain none.
+    assert _count_eqns(jaxpr.jaxpr, ("ppermute",)) == 2
+
+    fn_a = pipe._build_apply_interleaved()
+    jaxpr_a = jax.make_jaxpr(lambda p, a: fn_a(p, a))(params, x_mb)
+    fticks = interleaved_forward_tables(n, m, v).ticks
+    assert fticks in scan_lengths(jaxpr_a.jaxpr)
+    assert _count_eqns(jaxpr_a.jaxpr, ("ppermute",)) == 1

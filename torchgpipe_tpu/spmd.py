@@ -521,6 +521,7 @@ class SpmdGPipe:
         )
         self._train_step_fns: dict = {}  # keyed by use_rng
         self._apply_fn = None
+        self._eval_fn = None
         # FSDP bookkeeping, resolved lazily from the first params tree seen
         # (leaf shapes are needed to pick shard dims): per block leaf, the
         # dim sharded over dp (-1 = replicated) and the augmented specs.
@@ -1769,7 +1770,7 @@ class SpmdGPipe:
             return self._train_step_fns[use_rng](params, x_mb, tgt_mb, rng)
         return self._train_step_fns[use_rng](params, x_mb, tgt_mb)
 
-    def _build_apply(self):
+    def _build_apply(self, with_loss: bool = False):
         n = self.n_stages
         data_spec = self._data_specs()
 
@@ -1780,7 +1781,7 @@ class SpmdGPipe:
             _declared_axes(self.post, "out_gather") if self.post else []
         )
 
-        def local(params, x_mb):
+        def local(params, x_mb, tgt_mb=None):
             stage = lax.axis_index(self.pp_axis)
             if self.pre is not None:
                 x_mb = self._apply_pre(params["pre"], x_mb, None, False)
@@ -1791,6 +1792,10 @@ class SpmdGPipe:
             )
             ys = self._local_pipeline(blocks_in, x_mb, None, False)
             outs = self._outputs_from_ticks(ys)  # [m, b_local, ...]
+            if with_loss:
+                # post runs per micro-batch INSIDE the loss loop, so at
+                # most one micro-batch's logits are ever live.
+                return self._eval_loss_from_outs(params, outs, tgt_mb, stage)
             if self.post is not None:
                 outs = jax.vmap(
                     lambda mb: self.post.apply(params["post"], (), mb, rng=None, train=False)[0]
@@ -1815,15 +1820,23 @@ class SpmdGPipe:
         if self._loss_is_layer:
             param_specs["loss"] = self._loss_spec
 
-        mapped = _shard_map(
-            local,
-            self.mesh,
-            in_specs=(param_specs, data_spec),
-            out_specs=data_spec,
-        )
+        if with_loss:
+            mapped = _shard_map(
+                local,
+                self.mesh,
+                in_specs=(param_specs, data_spec, data_spec),
+                out_specs=P(),
+            )
+        else:
+            mapped = _shard_map(
+                local,
+                self.mesh,
+                in_specs=(param_specs, data_spec),
+                out_specs=data_spec,
+            )
         return jax.jit(mapped)
 
-    def _build_apply_interleaved(self):
+    def _build_apply_interleaved(self, with_loss: bool = False):
         """Forward-only interleaved pipeline (fill-drain over the n·v
         virtual stages, round-robin device mapping) for inference."""
         from torchgpipe_tpu.parallel.interleaved import (
@@ -1841,7 +1854,7 @@ class SpmdGPipe:
         )
         rows_xs = _interleaved_rows(tb)
 
-        def local(params, x_mb):
+        def local(params, x_mb, tgt_mb=None):
             stage = lax.axis_index(self.pp_axis)
             perm_f = [(i, (i + 1) % n) for i in range(n)]
             if self.pre is not None:
@@ -1921,6 +1934,11 @@ class SpmdGPipe:
 
             carry, _ = lax.scan(tick, carry0, rows_xs)
             outs = carry["outs"]
+            if with_loss:
+                # The final chunk's outputs land on stage n-1; the loss
+                # masks to that stage exactly like the fill-drain variant,
+                # and post runs per micro-batch inside the loss loop.
+                return self._eval_loss_from_outs(params, outs, tgt_mb, stage)
             if self.post is not None:
                 outs = jax.vmap(
                     lambda mb: self.post.apply(
@@ -1945,13 +1963,62 @@ class SpmdGPipe:
         if self._loss_is_layer:
             param_specs["loss"] = self._loss_spec
 
-        mapped = _shard_map(
-            local,
-            self.mesh,
-            in_specs=(param_specs, data_spec),
-            out_specs=data_spec,
-        )
+        if with_loss:
+            mapped = _shard_map(
+                local,
+                self.mesh,
+                in_specs=(param_specs, data_spec, data_spec),
+                out_specs=P(),
+            )
+        else:
+            mapped = _shard_map(
+                local,
+                self.mesh,
+                in_specs=(param_specs, data_spec),
+                out_specs=data_spec,
+            )
         return jax.jit(mapped)
+
+    def _eval_loss_from_outs(self, params, outs, tgt_mb, stage):
+        """Per-micro-batch eval loss INSIDE the mapped program: the loss
+        consumes each ``[b_local, ...]`` micro-batch output directly, so
+        full-batch logits are never gathered (the train path's memory
+        discipline carried over to eval; decomposability is declared by
+        ``loss_reduction``)."""
+        n = self.n_stages
+        m = self.chunks
+        tmap = jax.tree_util.tree_map
+        p_loss = params["loss"] if self._loss_is_layer else ()
+
+        def mb_loss(i, acc):
+            y_i = tmap(
+                lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+                outs,
+            )
+            if self.post is not None:
+                y_i, _ = self.post.apply(
+                    params["post"], (), y_i, rng=None, train=False
+                )
+            t_i = tmap(
+                lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+                tgt_mb,
+            )
+            l_i = self._loss_call(p_loss, y_i, t_i, train=False).astype(
+                jnp.float32
+            )
+            return acc + (l_i / m if self.loss_reduction == "mean" else l_i)
+
+        loss = lax.fori_loop(0, m, mb_loss, jnp.float32(0.0))
+        loss = jnp.where(stage == n - 1, loss, 0.0)
+        loss = lax.psum(loss, self.pp_axis)
+        # Data-parallel lanes each saw their own batch shard.
+        for ax in (self.dp_axis, self.ep_axis, self.sp_axis):
+            if ax:
+                red = (
+                    lax.pmean if self.loss_reduction == "mean" else lax.psum
+                )
+                loss = red(loss, ax)
+        return loss
 
     def eval_loss(self, params, x, target):
         """Loss on a mini-batch WITHOUT gradients (eval semantics:
@@ -1962,12 +2029,32 @@ class SpmdGPipe:
         Works with plain ``loss_fn`` callables and with parametric loss
         layers (whose loss value cannot be recomputed from :meth:`apply`'s
         outputs alone when ``post=None`` hides no logits — e.g. the
-        chunked-vocab CE never materializes them)."""
-        out = self.apply(params, x)
-        return self._loss_call(
-            params["loss"] if self._loss_is_layer else (), out, target,
-            train=False,
-        )
+        chunked-vocab CE never materializes them).
+
+        With a decomposable loss (``loss_reduction`` 'mean'/'sum') the
+        loss runs per-micro-batch INSIDE the mapped program, so full-batch
+        logits are never gathered (matching the train path's memory
+        discipline); ``loss_reduction=None`` falls back to the gathered
+        host-side computation."""
+        self._check_params(params)
+        self._check_batch(x, target)
+        if self.loss_reduction is None:
+            out = self.apply(params, x)
+            return self._loss_call(
+                params["loss"] if self._loss_is_layer else (), out, target,
+                train=False,
+            )
+        if self.fsdp:
+            self._ensure_fsdp(params["blocks"])
+        if self._eval_fn is None:
+            self._eval_fn = (
+                self._build_apply_interleaved(with_loss=True)
+                if self.schedule == "interleaved"
+                else self._build_apply(with_loss=True)
+            )
+        x_mb = microbatch.scatter_stacked(x, self.chunks)
+        tgt_mb = microbatch.scatter_stacked(target, self.chunks)
+        return self._eval_fn(params, x_mb, tgt_mb)
 
     def apply(self, params, x):
         """Pipelined inference forward; returns gathered outputs ``[B, ...]``."""
