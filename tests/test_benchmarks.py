@@ -232,6 +232,25 @@ def test_llama_speed_driver_fsdp():
     assert "FINAL | llama-speed pipeline-2 [tiny, spmd, dense]" in out
 
 
+def test_llama_speed_driver_interleaved_and_fused_ce():
+    from benchmarks.llama_speed import main
+
+    out = _invoke(main, [
+        "pipeline-2", "--preset", "tiny", "--engine", "spmd", "--epochs", "1",
+        "--steps", "1", "--seq", "33", "--batch", "4", "--no-bf16",
+        "--schedule", "interleaved", "--virtual-stages", "2",
+        "--checkpoint", "always",
+    ])
+    assert "FINAL | llama-speed pipeline-2 [tiny, spmd, dense]" in out
+
+    out = _invoke(main, [
+        "pipeline-2", "--preset", "tiny", "--engine", "spmd", "--epochs", "1",
+        "--steps", "1", "--seq", "33", "--batch", "4", "--no-bf16",
+        "--fused-ce",
+    ])
+    assert "FINAL | llama-speed pipeline-2 [tiny, spmd, dense]" in out
+
+
 def test_bench_entry_cpu_smoke():
     """bench.py (the driver's metric entry point) runs end to end on CPU and
     emits exactly one well-formed JSON line."""
