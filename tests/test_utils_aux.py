@@ -387,3 +387,35 @@ def test_global_batch_from_local_single_process(cpu_devices):
         np.asarray(out["x"]), batch["x"]
     )
     assert out["x"].sharding.spec == P("dp")
+
+
+def test_simulate_pipeline_1f1b_uniform_cells():
+    """Uniform cells: the 1F1B projection must reproduce the closed-form
+    makespan (2m + 2(n-1)) * t — the same tick count the SPMD 1F1B
+    schedule realizes — and beat neither phase-barriered fill-drain nor
+    the per-device work floor 2m*t."""
+    from torchgpipe_tpu.utils.tracing import TimelineEvent
+
+    n, m, t = 4, 8, 1.0
+    events = []
+    for j in range(n):
+        for i in range(m):
+            # TimelineEvent(name, stage, mbatch, t_start, t_end)
+            events.append(TimelineEvent("fwd", j, i, 0.0, t))
+            events.append(TimelineEvent("bwd", j, i, 0.0, t))
+    makespan, busy, bubble = simulate_pipeline(events, n, schedule="1f1b")
+    assert abs(makespan - (2 * m + 2 * (n - 1)) * t) < 1e-9, makespan
+    fd_makespan, _, _ = simulate_pipeline(events, n)
+    assert makespan <= fd_makespan
+    assert makespan >= 2 * m * t
+    assert 0.0 < busy <= 1.0 and abs(busy + bubble - 1.0) < 1e-9
+
+
+def test_simulate_pipeline_rejects_unknown_schedule():
+    from torchgpipe_tpu.utils.tracing import TimelineEvent
+
+    ev = [TimelineEvent("fwd", 0, 0, 0.0, 1.0)]
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="fill_drain"):
+        simulate_pipeline(ev, 1, schedule="zigzag")

@@ -44,6 +44,27 @@ from torchgpipe_tpu.skip.layout import SkipLayout
 Pytree = Any
 
 
+def one_f1b_orders(m: int, n: int) -> List[List[Tuple[str, int]]]:
+    """Per-stage 1F1B (PipeDream-flush) op order: stage ``j`` warms up with
+    ``min(m, n - j)`` forwards, then strictly alternates bwd/fwd, then
+    drains backwards.  The ONE source of the schedule order — dispatched by
+    :meth:`Pipeline.run_train_1f1b` and projected by
+    :func:`torchgpipe_tpu.utils.tracing.simulate_pipeline`."""
+    orders: List[List[Tuple[str, int]]] = []
+    for j in range(n):
+        warm = min(m, n - j)
+        ops: List[Tuple[str, int]] = [("fwd", i) for i in range(warm)]
+        nf, nb = warm, 0
+        while nb < m:
+            ops.append(("bwd", nb))
+            nb += 1
+            if nf < m:
+                ops.append(("fwd", nf))
+                nf += 1
+        orders.append(ops)
+    return orders
+
+
 def clock_cycles(m: int, n: int):
     """Generate the GPipe fill-drain schedule.
 
@@ -421,20 +442,7 @@ class Pipeline:
         n = len(self.stages)
         m = len(mbatches)
 
-        # Per-stage 1F1B op order: stage j warms up with min(m, n - j)
-        # forwards, then strictly alternates bwd/fwd, then drains backwards.
-        orders: List[List[Tuple[str, int]]] = []
-        for j in range(n):
-            warm = min(m, n - j)
-            ops: List[Tuple[str, int]] = [("fwd", i) for i in range(warm)]
-            nf, nb = warm, 0
-            while nb < m:
-                ops.append(("bwd", nb))
-                nb += 1
-                if nf < m:
-                    ops.append(("fwd", nf))
-                    nf += 1
-            orders.append(ops)
+        orders = one_f1b_orders(m, n)
 
         acts: Dict[Tuple[int, int], Pytree] = {}  # activation produced by (i, j)
         gys: Dict[Tuple[int, int], Pytree] = {}  # cotangent arriving at (i, j)

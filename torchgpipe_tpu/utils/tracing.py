@@ -153,18 +153,28 @@ def device_trace(logdir: str) -> Iterator[None]:
 
 
 def simulate_pipeline(
-    events: List[TimelineEvent], n_stages: int
+    events: List[TimelineEvent],
+    n_stages: int,
+    schedule: str = "fill_drain",
 ) -> Optional[Tuple[float, float, float]]:
-    """Project measured per-cell times onto the fill-drain schedule.
+    """Project measured per-cell times onto a pipeline schedule.
 
-    Takes a *sync* timeline (true per-cell device durations) and computes the
-    makespan the GPipe schedule would achieve with perfect overlap:
-    ``finish(i, j) = max(finish(i-1, j), finish(i, j-1)) + t(i, j)`` per
-    phase, forward and backward separated by the loss barrier.  Returns
-    ``(makespan_seconds, busy_fraction, bubble_fraction)``; the bubble can
-    be compared against the analytic GPipe bubble ``(n-1)/(m+n-1)`` — the
-    gap is stage imbalance (the analytic figure assumes uniform cells).
+    Takes a *sync* timeline (true per-cell device durations) and computes
+    the makespan the schedule would achieve with perfect overlap.  For
+    ``'fill_drain'``: ``finish(i, j) = max(finish(i-1, j), finish(i, j-1))
+    + t(i, j)`` per phase, forward and backward separated by the loss
+    barrier.  For ``'1f1b'``: each stage executes its PipeDream-flush op
+    order (warm-up ``min(m, n-j)`` forwards, then strict bwd/fwd
+    alternation — the same order the MPMD engine dispatches,
+    pipeline.py ``run_train_1f1b``) with no global barrier; an op starts
+    when its stage is free AND its producer finished (fwd needs the
+    upstream fwd; bwd needs the downstream bwd, or the same cell's fwd on
+    the last stage).  Returns ``(makespan_seconds, busy_fraction,
+    bubble_fraction)``; the bubble can be compared against the analytic
+    uniform-cell figure — the gap is stage imbalance.
     """
+    if schedule not in ("fill_drain", "1f1b"):
+        raise ValueError("schedule must be 'fill_drain' or '1f1b'")
     if not events:
         return None
     # A timeline spanning several training steps observes each (i, j) cell
@@ -179,22 +189,70 @@ def simulate_pipeline(
     by_phase: dict = {}
     for (name, i, j), total in sums.items():
         by_phase.setdefault(name, {})[(i, j)] = total / counts[(name, i, j)]
-    makespan = 0.0
-    for cells in by_phase.values():
-        m = 1 + max(i for i, _ in cells)
-        n = 1 + max(j for _, j in cells)
-        finish = [[0.0] * n for _ in range(m)]
-        for i in range(m):
-            for j in range(n):
-                prev = max(
-                    finish[i - 1][j] if i else 0.0,
-                    finish[i][j - 1] if j else 0.0,
-                )
-                finish[i][j] = prev + cells.get((i, j), 0.0)
-        makespan += finish[m - 1][n - 1]
-    if makespan <= 0:
+
+    if schedule == "1f1b":
+        makespan = _simulate_1f1b(by_phase, n_stages)
+    elif schedule == "fill_drain":
+        makespan = 0.0
+        for cells in by_phase.values():
+            m = 1 + max(i for i, _ in cells)
+            n = 1 + max(j for _, j in cells)
+            finish = [[0.0] * n for _ in range(m)]
+            for i in range(m):
+                for j in range(n):
+                    prev = max(
+                        finish[i - 1][j] if i else 0.0,
+                        finish[i][j - 1] if j else 0.0,
+                    )
+                    finish[i][j] = prev + cells.get((i, j), 0.0)
+            makespan += finish[m - 1][n - 1]
+    if makespan is None or makespan <= 0:
         return None
     busy = sum(
         cell for cells in by_phase.values() for cell in cells.values()
     ) / (n_stages * makespan)
     return makespan, busy, 1.0 - busy
+
+
+def _simulate_1f1b(by_phase: dict, n: int) -> Optional[float]:
+    """Dependency-driven completion times for the PipeDream-flush order."""
+    fwd = by_phase.get("fwd", {})
+    bwd = by_phase.get("bwd", {})
+    if not fwd:
+        return None
+    from torchgpipe_tpu.pipeline import one_f1b_orders
+
+    m = 1 + max(i for i, _ in fwd)
+    orders = one_f1b_orders(m, n)
+
+    done: dict = {}  # (kind, i, j) -> finish time
+    pos = [0] * n
+    stage_free = [0.0] * n
+    total = sum(len(o) for o in orders)
+    scheduled = 0
+    while scheduled < total:
+        progressed = False
+        for j in range(n):
+            while pos[j] < len(orders[j]):
+                kind, i = orders[j][pos[j]]
+                if kind == "fwd":
+                    dep = ("fwd", i, j - 1) if j > 0 else None
+                    t = fwd.get((i, j), 0.0)
+                else:
+                    dep = (
+                        ("bwd", i, j + 1) if j < n - 1 else ("fwd", i, j)
+                    )
+                    t = bwd.get((i, j), 0.0)
+                if dep is not None and dep not in done:
+                    break
+                start = max(
+                    stage_free[j], done[dep] if dep is not None else 0.0
+                )
+                done[(kind, i, j)] = start + t
+                stage_free[j] = start + t
+                pos[j] += 1
+                scheduled += 1
+                progressed = True
+        if not progressed:
+            return None  # cyclic/missing data — bail rather than loop
+    return max(stage_free)
