@@ -108,9 +108,9 @@ def causal_lm_loss(out, tokens):
                    "balanced by construction; needs --ep 1)")
 @click.option("--fused-ce/--no-fused-ce", default=False,
               help="fuse the LM head into a chunked-vocab cross-entropy "
-                   "loss layer (spmd engine): the [tokens, vocab] logits "
+                   "loss layer (both engines): the [tokens, vocab] logits "
                    "are never materialized — the big-vocab memory fix "
-                   "(needs --tp 1)")
+                   "(needs --tp 1; dense model only on mpmd)")
 def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
          checkpoint, moe_experts, moe_top_k, ep, tp, dp, schedule,
          virtual_stages, fsdp, moe_dispatch, moe_router, fused_ce):
@@ -143,9 +143,9 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
         )
     if fsdp and dp <= 1:
         raise click.UsageError("--fsdp shards over the dp lanes: pass --dp > 1")
-    if fused_ce and engine != "spmd":
-        raise click.UsageError("--fused-ce needs the spmd engine "
-                               "(parametric loss layer)")
+    if fused_ce and engine == "mpmd" and moe_experts:
+        raise click.UsageError("--fused-ce with the mpmd engine supports "
+                               "the dense model only")
     if fused_ce and tp > 1:
         raise click.UsageError("--fused-ce uses local head weights; with "
                                "--tp use the vocab-parallel CE path instead")
@@ -167,6 +167,49 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
             ep, tp, dp, fsdp, schedule,
             virtual_stages if schedule == "interleaved" else 1,
             fused_ce,
+        )
+    elif fused_ce:
+        # Headless model + parametric chunked-CE loss layer: the head
+        # matmul and cross-entropy fuse, [tokens, vocab] logits never
+        # materialize (GPipe.value_and_grad_with_loss_params).
+        from benchmarks.common import run_epoch_loop
+        from torchgpipe_tpu.models.transformer import chunked_lm_loss
+
+        layers = llama(cfg, head=False)
+        model = GPipe(
+            layers, even_balance(len(layers), n), chunks=chunks,
+            checkpoint=checkpoint,
+        )
+        loss_layer = chunked_lm_loss(cfg)
+        in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+        params, state = model.init(jax.random.PRNGKey(0), in_spec)
+        loss_params, _ = loss_layer.init(jax.random.PRNGKey(2), in_spec)
+        carry = {"params": params, "loss_params": loss_params,
+                 "state": state}
+        inputs, targets = x[:, :-1], x[:, 1:]
+        rng = jax.random.PRNGKey(1)
+
+        def step_fn(global_step):
+            key = jax.random.fold_in(rng, global_step)
+            loss, grads, lgrads, new_state, _ = (
+                model.value_and_grad_with_loss_params(
+                    carry["params"], carry["loss_params"], carry["state"],
+                    inputs, targets, loss_layer, rng=key,
+                )
+            )
+            carry["params"] = tuple(
+                jax.tree_util.tree_map(lambda p, g: p - 1e-4 * g, ps, gs)
+                for ps, gs in zip(carry["params"], grads)
+            )
+            carry["loss_params"] = jax.tree_util.tree_map(
+                lambda p, g: p - 1e-4 * g, carry["loss_params"], lgrads
+            )
+            carry["state"] = new_state
+            return loss, carry["params"]
+
+        tput = run_epoch_loop(
+            step_fn, x.shape[0], epochs=epochs, steps_per_epoch=steps,
+            label=experiment,
         )
     else:
         if moe is not None:

@@ -95,6 +95,12 @@ def test_chunked_xent_never_materializes_logits():
 # ---------------------------------------------------------------------- #
 
 
+def _rel_err(a, b):
+    a = np.asarray(jax.device_get(a))
+    b = np.asarray(jax.device_get(b))
+    return float(np.max(np.abs(a - b))) / (float(np.max(np.abs(b))) + 1e-8)
+
+
 def _setup(pp, n_blocks, m):
     cfg = TransformerConfig(
         vocab=64, dim=32, n_layers=n_blocks, n_heads=4, n_kv_heads=2
@@ -257,3 +263,73 @@ def test_eval_loss_interleaved_and_never_gathers_logits():
     assert ma.temp_size_in_bytes < full_logits, (
         ma.temp_size_in_bytes, full_logits
     )
+
+
+# ---------------------------------------------------------------------- #
+# MPMD engine: parametric loss layer                                     #
+# ---------------------------------------------------------------------- #
+
+
+def test_mpmd_loss_params_matches_headed_model():
+    """GPipe.value_and_grad_with_loss_params on a headless llama + chunked
+    CE loss layer == the headed llama + plain cross_entropy with the SAME
+    weights: equal loss, equal stage grads, and the loss grads equal the
+    head stage's grads."""
+    from torchgpipe_tpu.gpipe import GPipe
+    from torchgpipe_tpu.models.transformer import llama
+
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+    )
+    tokens = jnp.mod(jnp.arange(4 * 16).reshape(4, 16), 64).astype(jnp.int32)
+    labels = jnp.mod(tokens + 1, 64)
+    spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+
+    headed = llama(cfg)  # embed, 2 blocks, head
+    oracle = GPipe(headed, balance=[2, 2], chunks=2, checkpoint="always")
+    po, so = oracle.init(jax.random.PRNGKey(0), spec)
+    lo, go, _, _ = oracle.value_and_grad(po, so, tokens, labels, cross_entropy)
+
+    headless = llama(cfg, head=False)
+    model = GPipe(headless, balance=[2, 1], chunks=2, checkpoint="always")
+    p, st = model.init(jax.random.PRNGKey(0), spec)
+    loss_layer = chunked_lm_loss(cfg, chunk=16)
+    # Same init keys for embed/blocks (same layer order); splice the
+    # oracle's head weights into the loss params for exact equality.
+    lp = {"scale": po[1][1]["scale"], "w": po[1][1]["w"]}
+    loss, grads, loss_grads, _, _ = model.value_and_grad_with_loss_params(
+        p, lp, st, tokens, labels, loss_layer
+    )
+    assert abs(float(loss) - float(lo)) < 1e-4, (float(loss), float(lo))
+    # Stage grads for embed + blocks match (layouts: oracle has the head
+    # as the last layer of its stage 1).
+    flat = jax.tree_util.tree_leaves(
+        (grads[0], grads[1][0])
+    )
+    flat_o = jax.tree_util.tree_leaves((go[0], go[1][0]))
+    for a, b in zip(flat, flat_o):
+        assert _rel_err(a, b) < 1e-4
+    for k in ("scale", "w"):
+        assert _rel_err(loss_grads[k], go[1][1][k]) < 1e-4
+
+
+def test_mpmd_loss_params_validation():
+    from torchgpipe_tpu.gpipe import GPipe
+    from torchgpipe_tpu.models.transformer import llama
+
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+    )
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    loss_layer = chunked_lm_loss(cfg, chunk=16)
+    model = GPipe(
+        llama(cfg, head=False), balance=[2, 1], chunks=2,
+        schedule="1f1b", loss_reduction="mean",
+    )
+    p, st = model.init(jax.random.PRNGKey(0), spec)
+    lp, _ = loss_layer.init(jax.random.PRNGKey(9), spec)
+    with pytest.raises(ValueError, match="gpipe"):
+        model.value_and_grad_with_loss_params(
+            p, lp, st, tokens, tokens, loss_layer
+        )

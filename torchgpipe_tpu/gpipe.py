@@ -276,6 +276,25 @@ class GPipe:
             )
         return microbatch.gather(outs), tuple(new_states)
 
+    def _split_microbatches(self, x: Pytree):
+        """Shared training-entry prologue: validate, scatter into
+        micro-batches, resolve the checkpoint stop index.
+
+        Deferred BN commits running stats on the chunks-th micro-batch; a
+        short batch would never commit and would bleed accumulators into
+        the next mini-batch — hence the exact-split requirement."""
+        microbatch.check(x)
+        mbatches = microbatch.scatter(x, self.chunks)
+        if self._deferred_batch_norm and len(mbatches) != self.chunks:
+            raise ValueError(
+                f"deferred_batch_norm requires the batch to split into exactly "
+                f"chunks={self.chunks} micro-batches, got {len(mbatches)} "
+                f"(batch size {microbatch.batch_size(x)})"
+            )
+        return mbatches, checkpoint_stop(
+            self.checkpoint, len(mbatches), train=True
+        )
+
     def value_and_grad(
         self,
         params,
@@ -302,18 +321,7 @@ class GPipe:
         Returns ``(loss, grads, new_state, aux)`` with ``grads`` shaped like
         ``params``.
         """
-        microbatch.check(x)
-        mbatches = microbatch.scatter(x, self.chunks)
-        if self._deferred_batch_norm and len(mbatches) != self.chunks:
-            # Deferred BN commits running stats on the chunks-th micro-batch;
-            # a short batch would never commit and would bleed accumulators
-            # into the next mini-batch.
-            raise ValueError(
-                f"deferred_batch_norm requires the batch to split into exactly "
-                f"chunks={self.chunks} micro-batches, got {len(mbatches)} "
-                f"(batch size {microbatch.batch_size(x)})"
-            )
-        stop = checkpoint_stop(self.checkpoint, len(mbatches), train=True)
+        mbatches, stop = self._split_microbatches(x)
         if self.schedule == "1f1b":
             sizes = [microbatch.batch_size(mb) for mb in mbatches]
             total = sum(sizes)
@@ -349,6 +357,49 @@ class GPipe:
                 params, state, mbatches, target, loss_fn, rng, stop
             )
         return loss, tuple(grads), tuple(new_states), aux
+
+    def value_and_grad_with_loss_params(
+        self,
+        params,
+        loss_params,
+        state,
+        x: Pytree,
+        target: Pytree,
+        loss_layer,
+        *,
+        rng: Optional[jax.Array] = None,
+    ):
+        """Pipelined training step with a PARAMETRIC loss layer.
+
+        ``loss_layer`` is a :class:`~torchgpipe_tpu.layers.Layer` applied to
+        ``(gathered_output, target)`` whose own parameters train too — the
+        big-vocabulary fused head+cross-entropy
+        (:func:`torchgpipe_tpu.models.transformer.chunked_lm_loss`) being
+        the motivating case: build the model WITHOUT its lm_head (the
+        ``[tokens, vocab]`` logits then never materialize) and let the loss
+        layer own the head weights.
+
+        Returns ``(loss, grads, loss_grads, new_state, aux)``.  Fill-drain
+        schedule only (the 1F1B/fused paths compute losses inside their own
+        programs); initialize ``loss_params`` via ``loss_layer.init``.
+        """
+        if self.schedule != "gpipe":
+            raise ValueError(
+                "value_and_grad_with_loss_params supports the fill-drain "
+                f"('gpipe') schedule only (got schedule={self.schedule!r})"
+            )
+        if self._use_fused():
+            raise ValueError(
+                "value_and_grad_with_loss_params is not supported with "
+                "fused=True (the fused program computes its loss inline); "
+                "use the per-cell scheduler"
+            )
+        mbatches, stop = self._split_microbatches(x)
+        loss, grads, loss_grads, new_states, aux = self._pipeline.run_train(
+            params, state, mbatches, target, loss_layer, rng, stop,
+            loss_params=loss_params,
+        )
+        return loss, tuple(grads), loss_grads, tuple(new_states), aux
 
     def _use_fused(self) -> bool:
         """Per-cell scheduling is the default everywhere; ``fused=True``

@@ -419,7 +419,8 @@ def chunked_lm_loss(
     cfg: TransformerConfig, *, chunk: int = 8192, name: str = "chunked_ce"
 ) -> Layer:
     """Fused final-norm + vocab projection + cross-entropy as a parametric
-    LOSS LAYER for ``SpmdGPipe(loss_fn=...)`` — the big-vocabulary memory
+    LOSS LAYER for ``SpmdGPipe(loss_fn=...)`` or
+    ``GPipe.value_and_grad_with_loss_params`` — the big-vocabulary memory
     fix: the ``[tokens, vocab]`` logit matrix (2 GiB at 128k vocab x 4k
     tokens in f32, the recorded single-chip OOM blocker for the 1B preset)
     is never materialized.  The head matmul and the softmax-cross-entropy
@@ -451,13 +452,19 @@ def chunked_lm_loss(
     return Layer(name=name, init=init, apply=apply, meta={})
 
 
-def llama(cfg: TransformerConfig) -> List[Layer]:
+def llama(cfg: TransformerConfig, *, head: bool = True) -> List[Layer]:
     """Flat sequential layer list for the MPMD GPipe engine: embed, blocks,
-    head — the "nn.Sequential of transformer blocks" shape (BASELINE.json)."""
+    head — the "nn.Sequential of transformer blocks" shape (BASELINE.json).
+
+    ``head=False`` omits the lm_head: pair with
+    :func:`chunked_lm_loss` via
+    ``GPipe.value_and_grad_with_loss_params`` so the ``[tokens, vocab]``
+    logits never materialize (the big-vocab memory fix)."""
     layers: List[Layer] = [token_embedding(cfg)]
     for i in range(cfg.n_layers):
         layers.append(transformer_block(cfg, name=f"block{i}"))
-    layers.append(lm_head(cfg))
+    if head:
+        layers.append(lm_head(cfg))
     return layers
 
 

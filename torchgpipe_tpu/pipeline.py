@@ -216,31 +216,68 @@ class LossGradRunner:
         self._cache: Dict = {}
         self._maxsize = maxsize
 
-    def __call__(self, outs: List[Pytree], target: Pytree, loss_fn):
+    def __call__(
+        self, outs: List[Pytree], target: Pytree, loss_fn, loss_params=None
+    ):
         sizes = tuple(
             jax.tree_util.tree_leaves(o)[0].shape[0] for o in outs
         )
         treedef = jax.tree_util.tree_structure(outs[0])
-        key = (sizes, treedef, loss_fn)
+        # A parametric loss is a Layer (frozen dataclass whose meta dict is
+        # unhashable) — key by identity; plain callables key by value.
+        key = (
+            sizes,
+            treedef,
+            id(loss_fn) if loss_params is not None else loss_fn,
+            loss_params is not None,
+        )
         if key not in self._cache:
             while len(self._cache) >= self._maxsize:
                 self._cache.pop(next(iter(self._cache)))
 
-            def gathered_loss(outs_list, tgt):
-                out = microbatch.gather(outs_list)
-                res = loss_fn(out, tgt)
-                if isinstance(res, tuple):
-                    return res[0], res[1]
-                return res, None
+            if loss_params is not None:
+                # Parametric loss layer: loss_fn is a Layer whose params
+                # are differentiated alongside the outputs (the big-vocab
+                # fused head+CE path — see transformer.chunked_lm_loss).
 
-            def run(outs_list, tgt):
-                (loss, aux), gouts = jax.value_and_grad(
-                    gathered_loss, has_aux=True
-                )(outs_list, tgt)
-                return loss, gouts, aux
+                def gathered_loss_p(outs_list, lp, tgt):
+                    out = microbatch.gather(outs_list)
+                    val, st = loss_fn.apply(lp, (), (out, tgt), rng=None,
+                                            train=True)
+                    if jax.tree_util.tree_leaves(st):
+                        raise ValueError(
+                            f"parametric loss layer {loss_fn.name!r} must "
+                            "be stateless (its state updates would be "
+                            "silently dropped)"
+                        )
+                    return val, None
 
-            self._cache[key] = jax.jit(run)
+                def run_p(outs_list, lp, tgt):
+                    (loss, aux), (gouts, glp) = jax.value_and_grad(
+                        gathered_loss_p, argnums=(0, 1), has_aux=True
+                    )(outs_list, lp, tgt)
+                    return loss, gouts, glp, aux
 
+                self._cache[key] = jax.jit(run_p)
+            else:
+
+                def gathered_loss(outs_list, tgt):
+                    out = microbatch.gather(outs_list)
+                    res = loss_fn(out, tgt)
+                    if isinstance(res, tuple):
+                        return res[0], res[1]
+                    return res, None
+
+                def run(outs_list, tgt):
+                    (loss, aux), gouts = jax.value_and_grad(
+                        gathered_loss, has_aux=True
+                    )(outs_list, tgt)
+                    return loss, gouts, aux
+
+                self._cache[key] = jax.jit(run)
+
+        if loss_params is not None:
+            return self._cache[key](outs, loss_params, target)
         return self._cache[key](outs, target)
 
 
@@ -322,11 +359,14 @@ class Pipeline:
         loss_fn,
         rng: Optional[jax.Array],
         checkpoint_stop: int,
+        loss_params=None,
     ):
         """Full pipelined forward, loss, and backward.
 
         Returns ``(loss, grads_per_stage, new_states, aux)`` where ``aux`` is
-        whatever extra output ``loss_fn`` returns (or None).
+        whatever extra output ``loss_fn`` returns (or None); with
+        ``loss_params`` set (parametric loss layer),
+        ``(loss, grads_per_stage, loss_grads, new_states, aux)``.
         """
         n = len(self.stages)
         m = len(mbatches)
@@ -371,7 +411,12 @@ class Pipeline:
                     acts[i] = y
 
         # ---- loss + output cotangents ----------------------------------------
-        loss, gys_last, aux = self._loss_and_grads(outs, target, loss_fn)
+        if loss_params is not None:
+            loss, gys_last, loss_grads, aux = self._loss_and_grads(
+                outs, target, loss_fn, loss_params
+            )
+        else:
+            loss, gys_last, aux = self._loss_and_grads(outs, target, loss_fn)
 
         # ---- backward schedule (reverse clock cycles) ------------------------
         gys: Dict[Tuple[int, int], Pytree] = {
@@ -406,6 +451,8 @@ class Pipeline:
                     dst = self.stages[self.layout.stash_stage(k)].device
                     gskips[(i, k)] = _transfer(g, dst)
 
+        if loss_params is not None:
+            return loss, acc, loss_grads, cur_states, aux
         return loss, acc, cur_states, aux
 
     # ------------------------------------------------------------------ #
@@ -760,11 +807,13 @@ class Pipeline:
 
     # ------------------------------------------------------------------ #
 
-    def _loss_and_grads(self, outs: List[Pytree], target: Pytree, loss_fn):
+    def _loss_and_grads(
+        self, outs: List[Pytree], target: Pytree, loss_fn, loss_params=None
+    ):
         """Gather outputs on the last stage device, compute the loss on the
         full mini-batch (transparency with the un-pipelined model), and split
         the output cotangent back into micro-batch cotangents."""
         last_dev = self.stages[-1].device
         outs = [_transfer(o, last_dev) for o in outs]
         target = _transfer(target, last_dev)
-        return self._loss_grad(outs, target, loss_fn)
+        return self._loss_grad(outs, target, loss_fn, loss_params)
