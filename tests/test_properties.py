@@ -212,3 +212,44 @@ def test_interleaved_never_worse_than_plain_1f1b_in_work_time(n, groups, v):
     assert tv <= t1
     if interleaved_tables(n, m, 1).bubble_ticks > 0:
         assert tv < t1
+
+
+@pytest.mark.slow  # 25 examples x 2 fresh XLA compiles each
+@settings(deadline=None, max_examples=25)
+@given(
+    T=st.integers(1, 24),
+    d=st.integers(1, 24),
+    V=st.integers(2, 200),
+    chunk=st.integers(1, 64),
+)
+def test_chunked_xent_equals_dense_over_shape_space(T, d, V, chunk):
+    """chunked_softmax_xent == dense log-softmax CE (values and both
+    gradients) across random (T, d, V, chunk) — padding path, chunk > V,
+    chunk = 1, non-divisible V all land in this space."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchgpipe_tpu.ops.losses import chunked_softmax_xent
+
+    k = jax.random.split(jax.random.PRNGKey(T * 1000 + V), 3)
+    h = jax.random.normal(k[0], (T, d))
+    w = jax.random.normal(k[1], (d, V)) * 0.3
+    labels = jax.random.randint(k[2], (T,), 0, V)
+
+    def l_chunk(h, w):
+        return jnp.mean(chunked_softmax_xent(h, w, labels, chunk))
+
+    def l_dense(h, w):
+        logits = (h @ w).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        return jnp.mean(-jnp.take_along_axis(logp, labels[:, None], 1)[:, 0])
+
+    v1, (gh1, gw1) = jax.value_and_grad(l_chunk, argnums=(0, 1))(h, w)
+    v2, (gh2, gw2) = jax.value_and_grad(l_dense, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(gh1), np.asarray(gh2), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(gw1), np.asarray(gw2), rtol=1e-4, atol=1e-5
+    )
