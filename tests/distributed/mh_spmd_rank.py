@@ -18,6 +18,9 @@ Usage: ``python mh_spmd_rank.py <proc_id> <num_procs> <port> [mode]``
   and each process materializes only its own rows of the global batch
   (``utils.data.global_batch_from_local`` stitches them) — the real
   multi-host input-pipeline recipe where no host holds the full batch.
+* ``interleaved`` — the virtual-pipeline-stages schedule across the
+  process boundary: the forward ring's n-1 -> 0 wrap (which advances the
+  chunk index) crosses processes.
 """
 
 import os
@@ -54,10 +57,11 @@ def main():
 
     assert jax.device_count() == 4 * nprocs
     pp, dp, m = 4, 2, 4
+    v = 2 if mode == "interleaved" else 1
     cfg = TransformerConfig(
-        vocab=64, dim=32, n_layers=pp, n_heads=4, n_kv_heads=2
+        vocab=64, dim=32, n_layers=pp * v, n_heads=4, n_kv_heads=2
     )
-    block, pre, post = llama_spmd(cfg, pp)
+    block, pre, post = llama_spmd(cfg, pp * v)
     if mode == "local-feed":
         # dp OUTERMOST: process r owns the whole dp=r slice, so it can
         # feed just its own rows of the global batch.
@@ -66,9 +70,14 @@ def main():
         )
     else:
         mesh = make_mesh(pp, dp, devices=jax.devices())
+    sched_kw = (
+        dict(schedule="interleaved", virtual_stages=v, checkpoint="always")
+        if mode == "interleaved"
+        else {}
+    )
     pipe = SpmdGPipe(
         block, pp, mesh, chunks=m, loss_fn=cross_entropy,
-        pre=pre, post=post, dp_axis="dp",
+        pre=pre, post=post, dp_axis="dp", **sched_kw,
     )
     B = m * dp * 2
     tokens = jnp.mod(jnp.arange(B * 16).reshape(B, 16), 64).astype(jnp.int32)

@@ -39,8 +39,8 @@ def _free_port() -> int:
     return port
 
 
-@functools.lru_cache(maxsize=1)
-def _oracle_losses():
+@functools.lru_cache(maxsize=2)
+def _oracle_losses(mode="identical"):
     """Same config as mh_spmd_rank.py on THIS process's 8 devices."""
     from torchgpipe_tpu.models.transformer import (
         TransformerConfig,
@@ -50,14 +50,20 @@ def _oracle_losses():
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
 
     pp, dp, m = 4, 2, 4
+    v = 2 if mode == "interleaved" else 1
     cfg = TransformerConfig(
-        vocab=64, dim=32, n_layers=pp, n_heads=4, n_kv_heads=2
+        vocab=64, dim=32, n_layers=pp * v, n_heads=4, n_kv_heads=2
     )
-    block, pre, post = llama_spmd(cfg, pp)
+    block, pre, post = llama_spmd(cfg, pp * v)
     mesh = make_mesh(pp, dp, devices=jax.devices()[:8])
+    sched_kw = (
+        dict(schedule="interleaved", virtual_stages=v, checkpoint="always")
+        if mode == "interleaved"
+        else {}
+    )
     pipe = SpmdGPipe(
         block, pp, mesh, chunks=m, loss_fn=cross_entropy,
-        pre=pre, post=post, dp_axis="dp",
+        pre=pre, post=post, dp_axis="dp", **sched_kw,
     )
     tokens = jnp.mod(
         jnp.arange(m * dp * 2 * 16).reshape(m * dp * 2, 16), 64
@@ -76,7 +82,7 @@ def _oracle_losses():
     return losses
 
 
-@pytest.mark.parametrize("mode", ["identical", "local-feed"])
+@pytest.mark.parametrize("mode", ["identical", "local-feed", "interleaved"])
 def test_two_process_global_mesh_matches_single_process(cpu_devices, mode):
     """``identical``: every process feeds the full batch.  ``local-feed``:
     dp-outermost mesh, each process materializes ONLY its own dp slice and
@@ -135,7 +141,9 @@ def test_two_process_global_mesh_matches_single_process(cpu_devices, mode):
     l0, l1 = losses(outs[0], 0), losses(outs[1], 1)
     assert len(l0) == len(l1) == 3
     assert l0 == l1, (l0, l1)  # both ranks see the same replicated loss
-    oracle = _oracle_losses()
+    oracle = _oracle_losses(
+        "interleaved" if mode == "interleaved" else "identical"
+    )
     for a, b in zip(l0, oracle):
         assert abs(a - b) < 1e-4, (l0, oracle)
 
