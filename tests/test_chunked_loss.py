@@ -333,3 +333,54 @@ def test_mpmd_loss_params_validation():
         model.value_and_grad_with_loss_params(
             p, lp, st, tokens, tokens, loss_layer
         )
+
+
+def test_chunked_xent_extreme_logits_stable():
+    """Online log-sum-exp must survive logits near the f32 exp overflow
+    threshold (naive exp(90) overflows; the running max keeps every
+    exponent <= 0) and still match the dense log-softmax oracle."""
+    T, d, V, C = 6, 4, 24, 8
+    h = jnp.asarray(
+        np.concatenate([np.full((3, d), 30.0), np.full((3, d), -30.0)]),
+        jnp.float32,
+    )
+    w = jnp.asarray(
+        np.linspace(-3, 3, d * V, dtype=np.float32).reshape(d, V)
+    )
+    labels = jnp.arange(T) % V
+    got = chunked_softmax_xent(h, w, labels, C)
+    logits = (h @ w).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, -1)
+    want = -jnp.take_along_axis(logp, labels[:, None], 1)[:, 0]
+    assert bool(jnp.all(jnp.isfinite(got)))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_chunked_xent_bf16_inputs():
+    """bf16 h/w accumulate in f32: values and gradients stay at bf16-ulp
+    distance from the f32-upcast dense oracle (the hardware-bench dtype)."""
+    T, d, V, C = 8, 16, 40, 16
+    k = jax.random.split(jax.random.PRNGKey(3), 3)
+    h = jax.random.normal(k[0], (T, d), jnp.bfloat16)
+    w = (jax.random.normal(k[1], (d, V)) * 0.3).astype(jnp.bfloat16)
+    labels = jax.random.randint(k[2], (T,), 0, V)
+
+    def l_chunk(h, w):
+        return jnp.mean(chunked_softmax_xent(h, w, labels, C))
+
+    def l_dense(h, w):
+        logits = (h @ w).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        return jnp.mean(-jnp.take_along_axis(logp, labels[:, None], 1)[:, 0])
+
+    v1, (gh1, gw1) = jax.value_and_grad(l_chunk, argnums=(0, 1))(h, w)
+    v2, (gh2, gw2) = jax.value_and_grad(l_dense, argnums=(0, 1))(h, w)
+    assert abs(float(v1) - float(v2)) < 5e-3
+    assert float(jnp.max(jnp.abs(
+        gh1.astype(jnp.float32) - gh2.astype(jnp.float32)
+    ))) < 5e-3
+    assert float(jnp.max(jnp.abs(
+        gw1.astype(jnp.float32) - gw2.astype(jnp.float32)
+    ))) < 5e-3
