@@ -295,3 +295,32 @@ def test_train_step_rejects_foreign_params(cpu_devices):
         eng4.train_step(p_no_pre, tokens, tokens)
     with pytest.raises(ValueError, match="different pipeline configuration"):
         eng4.apply(eng2.init(jax.random.PRNGKey(0), spec), tokens)
+
+
+def test_eval_loss_with_sequence_parallelism(cpu_devices):
+    """eval_loss under sp: ring attention runs inside the mapped eval
+    forward and the per-lane token-shard losses pmean to the train loss."""
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        cross_entropy,
+        llama_spmd,
+    )
+
+    pp, sp, m = 2, 2, 2
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=pp, n_heads=4, n_kv_heads=2, sp_axis="sp"
+    )
+    block, pre, post = llama_spmd(cfg, pp)
+    mesh = make_mesh(pp, 1, sp, devices=cpu_devices[: pp * sp])
+    pipe = SpmdGPipe(
+        block, pp, mesh, chunks=m, loss_fn=cross_entropy,
+        pre=pre, post=post, sp_axis="sp",
+    )
+    tokens = jnp.mod(jnp.arange(4 * 16).reshape(4, 16), 64).astype(jnp.int32)
+    labels = jnp.mod(tokens + 1, 64)
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    )
+    l_train, _ = pipe.train_step(params, tokens, labels)
+    l_eval = pipe.eval_loss(params, tokens, labels)
+    assert abs(float(l_train) - float(l_eval)) < 1e-5

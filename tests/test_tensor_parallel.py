@@ -327,3 +327,36 @@ def test_vocab_parallel_ce_extreme_logits_stable(cpu_devices):
     # f32 representation of (logits + 5e4) quantizes at ~3e-3 per entry —
     # the comparison tolerance reflects the input encoding, not the CE.
     np.testing.assert_allclose(big, want, rtol=1e-3)
+
+
+def test_eval_loss_with_vocab_parallel_ce(cpu_devices):
+    """eval_loss's mapped per-micro-batch loss path under tp-sharded
+    logits: the head keeps lane-local vocab shards (gather_logits=False)
+    and vocab_parallel_cross_entropy assembles the full-vocab softmax with
+    tp collectives INSIDE the eval program — must equal the train loss."""
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        llama_spmd,
+        vocab_parallel_cross_entropy,
+    )
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    pp, tp, m = 2, 2, 2
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=pp, n_heads=4, n_kv_heads=2, tp_axis="tp"
+    )
+    block, pre, post = llama_spmd(cfg, pp, gather_logits=False)
+    mesh = make_mesh(pp, 1, tp=tp, devices=cpu_devices[: pp * tp])
+    pipe = SpmdGPipe(
+        block, pp, mesh, chunks=m,
+        loss_fn=vocab_parallel_cross_entropy("tp"),
+        pre=pre, post=post, tp_axis="tp",
+    )
+    tokens = jnp.mod(jnp.arange(4 * 8).reshape(4, 8), 64).astype(jnp.int32)
+    labels = jnp.mod(tokens + 1, 64)
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    )
+    l_train, _ = pipe.train_step(params, tokens, labels)
+    l_eval = pipe.eval_loss(params, tokens, labels)
+    assert abs(float(l_train) - float(l_eval)) < 1e-5
