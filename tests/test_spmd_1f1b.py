@@ -265,8 +265,10 @@ def test_1f1b_validation_errors():
 
     with pytest.raises(ValueError, match="decompose over"):
         SpmdGPipe(block, pp, mesh, schedule="1f1b", loss_reduction=None, **ok)
-    with pytest.raises(ValueError, match="checkpoint='always'"):
-        SpmdGPipe(block, pp, mesh, schedule="1f1b", checkpoint="never", **ok)
+    with pytest.raises(ValueError, match="supports checkpoint"):
+        SpmdGPipe(
+            block, pp, mesh, schedule="1f1b", checkpoint="except_last", **ok
+        )
     with pytest.raises(ValueError, match="remat_policy"):
         SpmdGPipe(
             block, pp, mesh, schedule="1f1b",
@@ -331,3 +333,95 @@ def test_repr_shows_schedule():
     eng = SpmdGPipe(block, pp, mesh, schedule="1f1b", chunks=2,
                     loss_fn=cross_entropy, pre=pre, post=post)
     assert "schedule='1f1b'" in repr(eng)
+
+
+def test_1f1b_checkpoint_never_matches_always():
+    """checkpoint='never' (stored vjp-residual ring buffers, zero
+    recompute) must produce bit-equal losses and gradients to the
+    recompute path, with rng-bearing pre/post in play."""
+    pp, m = 4, 6
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:4])
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, pp)
+    tokens, labels = _tokens(2 * m)
+    res = {}
+    for ck in ("always", "never"):
+        eng = SpmdGPipe(
+            block, pp, mesh, chunks=m, loss_fn=cross_entropy,
+            pre=pre, post=post, checkpoint=ck, schedule="1f1b",
+        )
+        params = eng.init(
+            jax.random.PRNGKey(0),
+            jax.ShapeDtypeStruct(tokens.shape, tokens.dtype),
+        )
+        res[ck] = eng.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    la, ga = res["always"]
+    ln, gn = res["never"]
+    assert abs(float(la) - float(ln)) < 1e-6
+    assert maxdiff(ga, gn) < 1e-5
+
+
+def test_1f1b_never_skips_recompute_structurally():
+    """The 'never' program must contain strictly fewer matmuls than the
+    recompute program (each backward cell re-runs its forward under
+    'always'; 'never' replays stored residuals instead)."""
+    from tests.jaxpr_utils import count_eqns
+    import torchgpipe_tpu.microbatch as mb
+
+    pp, m = 2, 4
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:2])
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, pp)
+    tokens, labels = _tokens(2 * m)
+    dots = {}
+    for ck in ("always", "never"):
+        eng = SpmdGPipe(
+            block, pp, mesh, chunks=m, loss_fn=cross_entropy,
+            pre=pre, post=post, checkpoint=ck, schedule="1f1b",
+        )
+        params = eng.init(
+            jax.random.PRNGKey(0),
+            jax.ShapeDtypeStruct(tokens.shape, tokens.dtype),
+        )
+        fn = eng._build_train_step(use_rng=False)
+        x_mb = mb.scatter_stacked(tokens, m)
+        t_mb = mb.scatter_stacked(labels, m)
+        jaxpr = jax.make_jaxpr(lambda p, a, b: fn(p, a, b))(
+            params, x_mb, t_mb
+        )
+        dots[ck] = count_eqns(jaxpr.jaxpr, ("dot_general",))
+    assert dots["never"] < dots["always"], dots
+
+
+def test_1f1b_never_composes_with_dp():
+    mesh = make_mesh(2, 2, devices=jax.devices()[:4])
+    fd, _ = _engines(2, mesh, 2, dp_axis="dp")
+    ob = SpmdGPipe(
+        fd.block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+        pre=fd.pre, post=fd.post, dp_axis="dp",
+        checkpoint="never", schedule="1f1b",
+    )
+    tokens, labels = _tokens(8)
+    params = fd.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    )
+    l1, g1 = fd.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    l2, g2 = ob.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    assert abs(float(l1 - l2)) < 1e-5
+    assert maxdiff(g1, g2) < 1e-4
+
+
+def test_interleaved_still_rejects_never():
+    pp = 2
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:2])
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp * 2, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, pp * 2)
+    with pytest.raises(ValueError, match="supports checkpoint"):
+        SpmdGPipe(
+            block, pp, mesh, chunks=2, loss_fn=cross_entropy,
+            pre=pre, post=post, checkpoint="never",
+            schedule="interleaved", virtual_stages=2,
+        )

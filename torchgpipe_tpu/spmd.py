@@ -411,12 +411,19 @@ class SpmdGPipe:
                     "the schedule, so the loss must decompose over "
                     "micro-batches: set loss_reduction='mean' or 'sum'"
                 )
-            if self.checkpoint != "always":
+            allowed = (
+                ("always", "never")
+                if self.schedule == "1f1b"
+                else ("always",)
+            )
+            if self.checkpoint not in allowed:
                 raise ValueError(
-                    f"{sched} recomputes each cell in its backward "
-                    "tick (checkpoint='always' semantics are built in); "
-                    "set checkpoint='always', or use schedule='fill_drain' "
-                    f"for checkpoint={self.checkpoint!r}"
+                    f"{sched} supports checkpoint in {allowed}: 'always' "
+                    "recomputes each cell in its backward tick; 'never' "
+                    "(1f1b only) stores each in-flight cell's vjp "
+                    "residuals in the depth-n ring buffer instead — more "
+                    "memory, no recompute.  Use schedule='fill_drain' for "
+                    f"checkpoint={self.checkpoint!r}"
                 )
             if self.remat_policy is not None:
                 raise ValueError(
@@ -1121,21 +1128,91 @@ class SpmdGPipe:
                 ),
             )
             act0 = tmap(lambda s: jnp.zeros(s.shape, s.dtype), act_spec)
+            store = self.checkpoint == "never"
+
+            def cell_fn(p_blk, p_pre, x, i):
+                """One forward cell as a function of everything its
+                backward differentiates — vjp'd directly in 'never' mode,
+                re-vjp'd from the saved input in 'always' mode."""
+                xin = stage_input(p_pre, i, x)
+                return self._block_fn_plain(
+                    p_blk, xin, cell_key(i), aux_s, True
+                )
+
             carry0 = dict(
                 act=act0,
                 gact=act0,
-                # Depth-n input ring buffer (slot i % n): in-flight
-                # micro-batches per stage never exceed n, and slot i + n's
-                # write lands strictly after slot i's backward read.
-                buf=tmap(
-                    lambda s: jnp.zeros((n,) + s.shape, s.dtype), act_spec
-                ),
                 gblk=tmap(jnp.zeros_like, params_local),
                 gpre=tmap(jnp.zeros_like, pre_params),
                 gpost=tmap(jnp.zeros_like, post_params),
                 gloss=tmap(jnp.zeros_like, loss_params),
                 loss=jnp.float32(0.0),
             )
+            if store:
+                # checkpoint='never': ring-buffer each in-flight cell's
+                # vjp RESIDUAL LEAVES (the closure's pytree leaves — its
+                # treedef is static and identical for every cell, so one
+                # canonical treedef from an abstract trace rebuilds the
+                # closure at backward time) plus the last forward output
+                # (the last stage's loss seed; its backward runs on the
+                # very next tick, so one slot suffices).  Residual leaves
+                # that are PASS-THROUGH PARAMETERS (vjp residuals of x@W
+                # include W itself) are detected in the canonical jaxpr
+                # (identity-forwarded invars) and re-injected live at
+                # backward time instead of being ring-buffered — buffering
+                # them would duplicate every stage's weights n times.
+                closed = jax.make_jaxpr(
+                    lambda p, pp_, x: jax.vjp(
+                        lambda a, b, c: cell_fn(a, b, c, jnp.int32(0)),
+                        p, pp_, x,
+                    )[1]
+                )(params_local, pre_params, act0)
+                vjp_abs = jax.eval_shape(
+                    lambda p, pp_, x: jax.vjp(
+                        lambda a, b, c: cell_fn(a, b, c, jnp.int32(0)),
+                        p, pp_, x,
+                    )[1],
+                    params_local, pre_params, act0,
+                )
+                vjp_tdef = jax.tree_util.tree_structure(vjp_abs)
+                vjp_leaf_specs = jax.tree_util.tree_leaves(vjp_abs)
+                param_flat = jax.tree_util.tree_leaves(
+                    (params_local, pre_params)
+                )
+                n_param_leaves = len(param_flat)
+                invar_pos = {
+                    v: k for k, v in enumerate(closed.jaxpr.invars)
+                }
+                # out leaf index -> param leaf index, for residuals that
+                # are identity-forwarded PARAM invars (x-invars vary per
+                # cell and stay buffered).
+                passthrough = {}
+                for oi, ov in enumerate(closed.jaxpr.outvars):
+                    if type(ov).__name__ == "Literal":  # constant-folded
+                        continue
+                    k = invar_pos.get(ov)
+                    if k is not None and k < n_param_leaves:
+                        passthrough[oi] = k
+                buffered_idx = [
+                    i
+                    for i in range(len(vjp_leaf_specs))
+                    if i not in passthrough
+                ]
+                carry0["rbuf"] = tuple(
+                    jnp.zeros(
+                        (n,) + vjp_leaf_specs[i].shape,
+                        vjp_leaf_specs[i].dtype,
+                    )
+                    for i in buffered_idx
+                )
+                carry0["ylast"] = act0
+            else:
+                # Depth-n input ring buffer (slot i % n): in-flight
+                # micro-batches per stage never exceed n, and slot i + n's
+                # write lands strictly after slot i's backward read.
+                carry0["buf"] = tmap(
+                    lambda s: jnp.zeros((n,) + s.shape, s.dtype), act_spec
+                )
 
             def tick(carry, t):
                 recv_f = tmap(
@@ -1162,6 +1239,31 @@ class SpmdGPipe:
                 i_b = jnp.clip(jnp.where(num >= 0, num // 2, 0), 0, m - 1)
 
                 def fwd_branch(c):
+                    if store:
+                        y, vjp_fn = jax.vjp(
+                            lambda a, b, xx: cell_fn(a, b, xx, i_f),
+                            params_local, pre_params, recv_f,
+                        )
+                        leaves = jax.tree_util.tree_leaves(vjp_fn)
+                        # Loud check: the live trace must match the
+                        # canonical abstract trace leaf-for-leaf, or the
+                        # rebuild below would silently misalign.
+                        if len(leaves) != len(vjp_leaf_specs) or any(
+                            l.shape != sp.shape or l.dtype != sp.dtype
+                            for l, sp in zip(leaves, vjp_leaf_specs)
+                        ):
+                            raise AssertionError(
+                                "1f1b checkpoint='never': live vjp residual "
+                                "structure diverged from the canonical "
+                                "trace — file a bug"
+                            )
+                        rbuf = tuple(
+                            lax.dynamic_update_index_in_dim(
+                                b, leaves[i], i_f % n, 0
+                            )
+                            for b, i in zip(c["rbuf"], buffered_idx)
+                        )
+                        return dict(c, act=y, rbuf=rbuf, ylast=y)
                     x_f = stage_input(pre_params, i_f, recv_f)
                     y = self._block_fn_plain(
                         params_local, x_f, cell_key(i_f), aux_s, True
@@ -1176,6 +1278,63 @@ class SpmdGPipe:
                     return dict(c, act=y, buf=buf)
 
                 def bwd_branch(c):
+                    if store:
+                        buffered = iter(
+                            lax.dynamic_index_in_dim(
+                                b, i_b % n, 0, keepdims=False
+                            )
+                            for b in c["rbuf"]
+                        )
+                        # Reassemble the full residual list: pass-through
+                        # param leaves come LIVE from the (loop-invariant)
+                        # params, everything else from the ring buffer.
+                        leaves = [
+                            param_flat[passthrough[i]]
+                            if i in passthrough
+                            else next(buffered)
+                            for i in range(len(vjp_leaf_specs))
+                        ]
+                        vjp_cell = jax.tree_util.tree_unflatten(
+                            vjp_tdef, leaves
+                        )
+
+                        def last_fn():
+                            y_saved = c["ylast"]
+
+                            def tail(p_post, p_loss, yy):
+                                return mb_loss(yy, p_post, p_loss, i_b)
+
+                            loss_i, (d_post, d_loss, dy) = (
+                                jax.value_and_grad(tail, argnums=(0, 1, 2))(
+                                    post_params, loss_params, y_saved
+                                )
+                            )
+                            d_blk, d_pre, dx = vjp_cell(dy)
+                            return loss_i, d_blk, d_pre, d_post, d_loss, dx
+
+                        def mid_fn():
+                            d_blk, d_pre, dx = vjp_cell(recv_b)
+                            return (
+                                jnp.float32(0.0),
+                                d_blk,
+                                d_pre,
+                                tmap(jnp.zeros_like, post_params),
+                                tmap(jnp.zeros_like, loss_params),
+                                dx,
+                            )
+
+                        loss_i, d_blk, d_pre, d_post, d_loss, dx = lax.cond(
+                            stage == n - 1, last_fn, mid_fn
+                        )
+                        return dict(
+                            c,
+                            gact=dx,
+                            gblk=tmap(jnp.add, c["gblk"], d_blk),
+                            gpre=tmap(jnp.add, c["gpre"], d_pre),
+                            gpost=tmap(jnp.add, c["gpost"], d_post),
+                            gloss=tmap(jnp.add, c["gloss"], d_loss),
+                            loss=c["loss"] + loss_i,
+                        )
                     x_saved = tmap(
                         lambda b: lax.dynamic_index_in_dim(
                             b, i_b % n, 0, keepdims=False
