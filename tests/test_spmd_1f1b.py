@@ -413,7 +413,7 @@ def test_1f1b_never_composes_with_dp():
     assert maxdiff(g1, g2) < 1e-4
 
 
-def test_interleaved_still_rejects_never():
+def test_explicit_schedules_reject_except_last():
     pp = 2
     mesh = make_mesh(pp, 1, devices=jax.devices()[:2])
     cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp * 2, n_heads=4,
@@ -422,6 +422,6 @@ def test_interleaved_still_rejects_never():
     with pytest.raises(ValueError, match="supports checkpoint"):
         SpmdGPipe(
             block, pp, mesh, chunks=2, loss_fn=cross_entropy,
-            pre=pre, post=post, checkpoint="never",
+            pre=pre, post=post, checkpoint="except_last",
             schedule="interleaved", virtual_stages=2,
         )

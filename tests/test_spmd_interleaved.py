@@ -305,7 +305,8 @@ def test_interleaved_validation_errors():
     with pytest.raises(ValueError, match="supports checkpoint"):
         SpmdGPipe(
             block, n, mesh, chunks=4, loss_fn=loss_fn,
-            schedule="interleaved", virtual_stages=v, checkpoint="never",
+            schedule="interleaved", virtual_stages=v,
+            checkpoint="except_last",
         )
 
 
@@ -398,3 +399,55 @@ def test_interleaved_composes_with_ep_moe():
         jax.tree_util.tree_leaves(grads_o["blocks"]),
     ):
         assert _rel_err(a, b) < 1e-4
+
+
+def test_interleaved_checkpoint_never_matches_always():
+    """checkpoint='never' under the interleaved schedule (stored vjp
+    residuals in the c*S + i%S ring slots, pass-through chunk params
+    re-injected live) must match the recompute path in loss and grads."""
+    from torchgpipe_tpu.models.transformer import cross_entropy
+
+    n, v, m = 2, 2, 4
+    block, pre, post, loss_fn = _llama(n * v)
+    mesh = make_mesh(n, 1, devices=jax.devices()[:n])
+    tokens, labels = _data(m * 2)
+    spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    res = {}
+    for ck in ("always", "never"):
+        eng = SpmdGPipe(
+            block, n, mesh, chunks=m, loss_fn=loss_fn, pre=pre, post=post,
+            checkpoint=ck, schedule="interleaved", virtual_stages=v,
+        )
+        params = eng.init(jax.random.PRNGKey(0), spec)
+        res[ck] = eng.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    la, ga = res["always"]
+    ln, gn = res["never"]
+    assert abs(float(la) - float(ln)) < 1e-6
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gn)
+    ):
+        assert _rel_err(a, b) < 1e-5
+
+
+def test_interleaved_never_fewer_matmuls():
+    from tests.jaxpr_utils import count_eqns
+    import torchgpipe_tpu.microbatch as mb
+
+    n, v, m = 2, 2, 4
+    block, pre, post, loss_fn = _llama(n * v)
+    mesh = make_mesh(n, 1, devices=jax.devices()[:n])
+    tokens, labels = _data(m * 2)
+    spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    dots = {}
+    for ck in ("always", "never"):
+        eng = SpmdGPipe(
+            block, n, mesh, chunks=m, loss_fn=loss_fn, pre=pre, post=post,
+            checkpoint=ck, schedule="interleaved", virtual_stages=v,
+        )
+        params = eng.init(jax.random.PRNGKey(0), spec)
+        fn = eng._build_train_step(use_rng=False)
+        x_mb = mb.scatter_stacked(tokens, m)
+        t_mb = mb.scatter_stacked(labels, m)
+        jaxpr = jax.make_jaxpr(lambda p, a, b: fn(p, a, b))(params, x_mb, t_mb)
+        dots[ck] = count_eqns(jaxpr.jaxpr, ("dot_general",))
+    assert dots["never"] < dots["always"], dots

@@ -161,6 +161,67 @@ def _sub_key(base, i):
     return None if base is None else jax.random.fold_in(base, i)
 
 
+try:  # Literal moved between jax.core and jax.extend.core across versions
+    from jax.extend.core import Literal as _JaxprLiteral
+except Exception:  # pragma: no cover - version fallback
+    from jax.core import Literal as _JaxprLiteral
+
+
+def _never_mode_spec(vjp_of, param_trees, x0):
+    """Canonical residual spec for the checkpoint='never' stored-vjp path.
+
+    One abstract trace of ``vjp_of(params..., x0)`` yields BOTH the jaxpr
+    (to detect identity-forwarded PARAM residuals — vjp residuals of x@W
+    include W itself, and buffering those would duplicate the weights once
+    per ring slot) and the residual pytree spec (treedef + leaf shapes)
+    used to rebuild the closure at backward time.  Returns
+    ``(tdef, leaf_specs, passthrough, buffered_idx)`` where ``passthrough``
+    maps residual-leaf index -> flat param-leaf index.
+    """
+    closed, shape = jax.make_jaxpr(vjp_of, return_shape=True)(
+        *param_trees, x0
+    )
+    tdef = jax.tree_util.tree_structure(shape)
+    leaf_specs = jax.tree_util.tree_leaves(shape)
+    n_param_leaves = len(jax.tree_util.tree_leaves(param_trees))
+    invar_pos = {v: k for k, v in enumerate(closed.jaxpr.invars)}
+    passthrough = {}
+    for oi, ov in enumerate(closed.jaxpr.outvars):
+        if isinstance(ov, _JaxprLiteral):  # constant-folded residual
+            continue
+        k = invar_pos.get(ov)
+        if k is not None and k < n_param_leaves:
+            passthrough[oi] = k
+    buffered_idx = [
+        i for i in range(len(leaf_specs)) if i not in passthrough
+    ]
+    return tdef, leaf_specs, passthrough, buffered_idx
+
+
+def _never_check_leaves(leaves, leaf_specs, what):
+    """Loud trace-time guard: the live vjp residual structure must match
+    the canonical trace leaf-for-leaf, or the rebuild would silently
+    misalign."""
+    if len(leaves) != len(leaf_specs) or any(
+        l.shape != sp.shape or l.dtype != sp.dtype
+        for l, sp in zip(leaves, leaf_specs)
+    ):
+        raise AssertionError(
+            f"{what} checkpoint='never': live vjp residual structure "
+            "diverged from the canonical trace — file a bug"
+        )
+
+
+def _never_rebuild(tdef, leaf_specs, passthrough, buffered_iter, live_flat):
+    """Reassemble the full residual list (pass-through param leaves LIVE,
+    the rest from the ring buffer) and rebuild the vjp closure."""
+    leaves = [
+        live_flat[passthrough[i]] if i in passthrough else next(buffered_iter)
+        for i in range(len(leaf_specs))
+    ]
+    return jax.tree_util.tree_unflatten(tdef, leaves)
+
+
 def _slot_read(buf, idx):
     """Read slot ``idx`` from a stacked ring-buffer pytree."""
     return jax.tree_util.tree_map(
@@ -411,18 +472,14 @@ class SpmdGPipe:
                     "the schedule, so the loss must decompose over "
                     "micro-batches: set loss_reduction='mean' or 'sum'"
                 )
-            allowed = (
-                ("always", "never")
-                if self.schedule == "1f1b"
-                else ("always",)
-            )
+            allowed = ("always", "never")
             if self.checkpoint not in allowed:
                 raise ValueError(
                     f"{sched} supports checkpoint in {allowed}: 'always' "
                     "recomputes each cell in its backward tick; 'never' "
-                    "(1f1b only) stores each in-flight cell's vjp "
-                    "residuals in the depth-n ring buffer instead — more "
-                    "memory, no recompute.  Use schedule='fill_drain' for "
+                    "stores each in-flight cell's vjp residuals in the "
+                    "schedule's ring buffers instead — more memory, no "
+                    "recompute.  Use schedule='fill_drain' for "
                     f"checkpoint={self.checkpoint!r}"
                 )
             if self.remat_policy is not None:
@@ -1161,43 +1218,19 @@ class SpmdGPipe:
                 # (identity-forwarded invars) and re-injected live at
                 # backward time instead of being ring-buffered — buffering
                 # them would duplicate every stage's weights n times.
-                closed = jax.make_jaxpr(
-                    lambda p, pp_, x: jax.vjp(
-                        lambda a, b, c: cell_fn(a, b, c, jnp.int32(0)),
-                        p, pp_, x,
-                    )[1]
-                )(params_local, pre_params, act0)
-                vjp_abs = jax.eval_shape(
-                    lambda p, pp_, x: jax.vjp(
-                        lambda a, b, c: cell_fn(a, b, c, jnp.int32(0)),
-                        p, pp_, x,
-                    )[1],
-                    params_local, pre_params, act0,
+                vjp_tdef, vjp_leaf_specs, passthrough, buffered_idx = (
+                    _never_mode_spec(
+                        lambda p, pp_, x: jax.vjp(
+                            lambda a, b, c: cell_fn(a, b, c, jnp.int32(0)),
+                            p, pp_, x,
+                        )[1],
+                        (params_local, pre_params),
+                        act0,
+                    )
                 )
-                vjp_tdef = jax.tree_util.tree_structure(vjp_abs)
-                vjp_leaf_specs = jax.tree_util.tree_leaves(vjp_abs)
                 param_flat = jax.tree_util.tree_leaves(
                     (params_local, pre_params)
                 )
-                n_param_leaves = len(param_flat)
-                invar_pos = {
-                    v: k for k, v in enumerate(closed.jaxpr.invars)
-                }
-                # out leaf index -> param leaf index, for residuals that
-                # are identity-forwarded PARAM invars (x-invars vary per
-                # cell and stay buffered).
-                passthrough = {}
-                for oi, ov in enumerate(closed.jaxpr.outvars):
-                    if type(ov).__name__ == "Literal":  # constant-folded
-                        continue
-                    k = invar_pos.get(ov)
-                    if k is not None and k < n_param_leaves:
-                        passthrough[oi] = k
-                buffered_idx = [
-                    i
-                    for i in range(len(vjp_leaf_specs))
-                    if i not in passthrough
-                ]
                 carry0["rbuf"] = tuple(
                     jnp.zeros(
                         (n,) + vjp_leaf_specs[i].shape,
@@ -1245,18 +1278,7 @@ class SpmdGPipe:
                             params_local, pre_params, recv_f,
                         )
                         leaves = jax.tree_util.tree_leaves(vjp_fn)
-                        # Loud check: the live trace must match the
-                        # canonical abstract trace leaf-for-leaf, or the
-                        # rebuild below would silently misalign.
-                        if len(leaves) != len(vjp_leaf_specs) or any(
-                            l.shape != sp.shape or l.dtype != sp.dtype
-                            for l, sp in zip(leaves, vjp_leaf_specs)
-                        ):
-                            raise AssertionError(
-                                "1f1b checkpoint='never': live vjp residual "
-                                "structure diverged from the canonical "
-                                "trace — file a bug"
-                            )
+                        _never_check_leaves(leaves, vjp_leaf_specs, "1f1b")
                         rbuf = tuple(
                             lax.dynamic_update_index_in_dim(
                                 b, leaves[i], i_f % n, 0
@@ -1279,23 +1301,17 @@ class SpmdGPipe:
 
                 def bwd_branch(c):
                     if store:
-                        buffered = iter(
-                            lax.dynamic_index_in_dim(
-                                b, i_b % n, 0, keepdims=False
-                            )
-                            for b in c["rbuf"]
-                        )
-                        # Reassemble the full residual list: pass-through
-                        # param leaves come LIVE from the (loop-invariant)
-                        # params, everything else from the ring buffer.
-                        leaves = [
-                            param_flat[passthrough[i]]
-                            if i in passthrough
-                            else next(buffered)
-                            for i in range(len(vjp_leaf_specs))
-                        ]
-                        vjp_cell = jax.tree_util.tree_unflatten(
-                            vjp_tdef, leaves
+                        vjp_cell = _never_rebuild(
+                            vjp_tdef,
+                            vjp_leaf_specs,
+                            passthrough,
+                            iter(
+                                lax.dynamic_index_in_dim(
+                                    b, i_b % n, 0, keepdims=False
+                                )
+                                for b in c["rbuf"]
+                            ),
+                            param_flat,
                         )
 
                         def last_fn():
@@ -1537,6 +1553,14 @@ class SpmdGPipe:
             box0 = tmap(
                 lambda s: jnp.zeros((v * S,) + s.shape, s.dtype), act_spec
             )
+            store = self.checkpoint == "never"
+
+            def cell_fn(p_blk, p_pre, x, c, i):
+                xin = splice(p_pre, c, i, x)
+                return self._block_fn_plain(
+                    p_blk, xin, cell_key(c, i), aux_s, True
+                )
+
             carry0 = dict(
                 act=act0,
                 gact=act0,
@@ -1548,6 +1572,40 @@ class SpmdGPipe:
                 gloss=tmap(jnp.zeros_like, loss_params),
                 loss=jnp.float32(0.0),
             )
+            if store:
+                # checkpoint='never' (same design as the 1F1B builder):
+                # buffer each in-flight cell's vjp residual leaves at slot
+                # c*S + i%S (liveness covered by the table generator's
+                # act-span proof — same fwd -> bwd window as the saved
+                # input), with identity-forwarded PARAM residuals detected
+                # in the canonical jaxpr and re-injected live (per-chunk
+                # params are dynamic slices, so the live value is p_of(c)'s
+                # leaf at backward time, not a buffered copy).
+                vjp_tdef, vjp_leaf_specs, passthrough, buffered_idx = (
+                    _never_mode_spec(
+                        lambda p, pp_, x: jax.vjp(
+                            lambda a, b, cc: cell_fn(
+                                a, b, cc, jnp.int32(0), jnp.int32(0)
+                            ),
+                            p, pp_, x,
+                        )[1],
+                        (p_of(0), pre_params),
+                        act0,
+                    )
+                )
+                carry0["rbuf"] = tuple(
+                    jnp.zeros(
+                        (v * S,) + vjp_leaf_specs[i2].shape,
+                        vjp_leaf_specs[i2].dtype,
+                    )
+                    for i2 in buffered_idx
+                )
+                # Last-CHUNK outputs for the loss seed only: keyed i % S
+                # (the fwd -> bwd window sits inside the act-span proof),
+                # written only by c == v-1 cells — 1/v of a full box.
+                carry0["ybox"] = tmap(
+                    lambda sp: jnp.zeros((S,) + sp.shape, sp.dtype), act_spec
+                )
 
             def tick(carry, rows):
                 krow, crow, irow, pkrow, pcrow, pirow = rows
@@ -1578,6 +1636,26 @@ class SpmdGPipe:
                 idx = c * S + i % S
 
                 def fwd_branch(cr):
+                    if store:
+                        y, vjp_fn = jax.vjp(
+                            lambda a, b, xx: cell_fn(a, b, xx, c, i),
+                            p_of(c), pre_params,
+                            _slot_read(cr["inbox"], idx),
+                        )
+                        leaves = jax.tree_util.tree_leaves(vjp_fn)
+                        _never_check_leaves(
+                            leaves, vjp_leaf_specs, "interleaved"
+                        )
+                        rbuf = tuple(
+                            lax.dynamic_update_index_in_dim(
+                                b, leaves[i2], idx, 0
+                            )
+                            for b, i2 in zip(cr["rbuf"], buffered_idx)
+                        )
+                        ybox = _slot_write(
+                            cr["ybox"], i % S, y, c == v - 1
+                        )
+                        return dict(cr, act=y, rbuf=rbuf, ybox=ybox)
                     x_f = splice(pre_params, c, i, _slot_read(cr["inbox"], idx))
                     y = self._block_fn_plain(
                         p_of(c), x_f, cell_key(c, i), aux_s, True
@@ -1592,6 +1670,76 @@ class SpmdGPipe:
                     )
 
                 def bwd_branch(cr):
+                    if store:
+                        vjp_cell = _never_rebuild(
+                            vjp_tdef,
+                            vjp_leaf_specs,
+                            passthrough,
+                            iter(
+                                lax.dynamic_index_in_dim(
+                                    b, idx, 0, keepdims=False
+                                )
+                                for b in cr["rbuf"]
+                            ),
+                            jax.tree_util.tree_leaves(
+                                (p_of(c), pre_params)
+                            ),
+                        )
+
+                        def last_fn_s():
+                            y_saved = _slot_read(cr["ybox"], i % S)
+
+                            def tail(p_post, p_loss, yy):
+                                return mb_loss(yy, p_post, p_loss, i)
+
+                            loss_i, (d_post, d_loss, dy) = (
+                                jax.value_and_grad(tail, argnums=(0, 1, 2))(
+                                    post_params, loss_params, y_saved
+                                )
+                            )
+                            d_blk, d_pre, dx = vjp_cell(dy)
+                            return loss_i, d_blk, d_pre, d_post, d_loss, dx
+
+                        def mid_fn_s():
+                            d_blk, d_pre, dx = vjp_cell(
+                                _slot_read(cr["gbox"], idx)
+                            )
+                            return (
+                                jnp.float32(0.0),
+                                d_blk,
+                                d_pre,
+                                tmap(jnp.zeros_like, post_params),
+                                tmap(jnp.zeros_like, loss_params),
+                                dx,
+                            )
+
+                        loss_i, d_blk, d_pre, d_post, d_loss, dx = lax.cond(
+                            (stage == n - 1) & (c == v - 1),
+                            last_fn_s,
+                            mid_fn_s,
+                        )
+                        gblk = tmap(
+                            lambda G, d: lax.dynamic_update_index_in_dim(
+                                G,
+                                lax.dynamic_index_in_dim(
+                                    G, c, 0, keepdims=False
+                                )
+                                + d,
+                                c,
+                                0,
+                            ),
+                            cr["gblk"],
+                            d_blk,
+                        )
+                        return dict(
+                            cr,
+                            gact=dx,
+                            gblk=gblk,
+                            gpre=tmap(jnp.add, cr["gpre"], d_pre),
+                            gpost=tmap(jnp.add, cr["gpost"], d_post),
+                            gloss=tmap(jnp.add, cr["gloss"], d_loss),
+                            loss=cr["loss"] + loss_i,
+                        )
                     x_saved = _slot_read(cr["inbox"], idx)
                     key = cell_key(c, i)
 
