@@ -316,19 +316,23 @@ class SpmdGPipe:
         ~the dp size for one gather/scatter pair per step over ICI.
         Requires ``dp_axis``; incompatible with ``ep_axis`` (expert leaves
         are already dp-style sharded over ep).
-      schedule: 'fill_drain' (default; the reference's GPipe schedule) or
-        '1f1b' (PipeDream-flush).  1F1B interleaves each micro-batch's
-        backward with later micro-batches' forwards inside the same
-        compiled scan, computing gradients explicitly (per-cell
-        ``jax.vjp`` with recompute), so in-flight activations per stage
-        are bounded by the pipeline depth ``n`` instead of the micro-batch
-        count ``m`` — same bubble fraction, O(n) instead of O(m)
-        activation memory.  Requires a micro-batch-decomposable loss
-        (``loss_reduction`` 'mean'/'sum') and ``checkpoint='always'``;
-        composes with dp, tp, ep (MoE) and fsdp — but not sp, whose ring
-        attention would put collective-permutes inside the schedule
-        conditional (see the ``__post_init__`` error).  New capability:
-        the reference has fill-drain only (SURVEY.md §2.2).
+      schedule: 'fill_drain' (default; the reference's GPipe schedule),
+        '1f1b' (PipeDream-flush) or 'interleaved' (Megatron virtual
+        pipeline stages; see ``virtual_stages``).  1F1B interleaves each
+        micro-batch's backward with later micro-batches' forwards inside
+        the same compiled scan, computing gradients explicitly per cell,
+        so in-flight activations per stage are bounded by the pipeline
+        depth ``n`` instead of the micro-batch count ``m`` — same bubble
+        fraction, O(n) instead of O(m) activation memory.  Both
+        explicit-gradient schedules require a micro-batch-decomposable
+        loss (``loss_reduction`` 'mean'/'sum') and take
+        ``checkpoint='always'`` (per-cell ``jax.vjp`` with recompute) or
+        ``'never'`` (stored vjp residuals in the schedule's ring buffers —
+        more memory, zero recompute); they compose with dp, tp, ep (MoE)
+        and fsdp — but not sp, whose ring attention would put
+        collective-permutes inside the schedule conditional (see the
+        ``__post_init__`` error).  New capability: the reference has
+        fill-drain only (SURVEY.md §2.2).
     """
 
     block: Layer
@@ -1114,7 +1118,9 @@ class SpmdGPipe:
 
         Backward cells recompute their forward from the saved input
         (``jax.vjp`` per cell — the reference's checkpoint-'always'
-        semantics, checkpoint.py:1-19); the last stage's backward cell also
+        semantics, checkpoint.py:1-19) or, under ``checkpoint='never'``,
+        replay stored vjp residuals from the same depth-n ring buffer
+        (zero recompute); the last stage's backward cell also
         runs ``post`` + per-micro-batch loss, seeding the cotangent ring.
         ``pre`` runs once outside the scan with its vjp kept; stage 0's
         backward cells stack their input cotangents and one outer
@@ -1474,9 +1480,10 @@ class SpmdGPipe:
         depth S the table generator proves collision-free.
 
         Backward cells recompute their forward from the saved (spliced)
-        input per cell — checkpoint='always' semantics, like the 1F1B
-        path.  No reference counterpart: the reference has fill-drain only
-        (reference: torchgpipe/pipeline.py:49-65).
+        input per cell (checkpoint='always') or replay stored vjp
+        residuals from the c*S + i%S ring slots (checkpoint='never'),
+        like the 1F1B path.  No reference counterpart: the reference has
+        fill-drain only (reference: torchgpipe/pipeline.py:49-65).
         """
         from torchgpipe_tpu.parallel.interleaved import (
             BWD,
