@@ -760,3 +760,32 @@ def test_router_stats_expert_choice_reports_uniform_load():
     np.testing.assert_allclose(np.asarray(load), 0.25)
     assert float(penalty) == 1.0
     assert importance.shape == (4,)
+
+
+def test_spmd_engine_with_dropless_moe(cpu_devices):
+    """The dropless (ragged_dot) dispatch composes with the SPMD engine's
+    compiled schedules: same loss/grads as the generous-capacity dense
+    dispatch with identical weights, under fill-drain AND 1F1B."""
+    pp, m = 2, 2
+    cfg = _cfg()  # n_layers=2 == pp
+    tokens = jnp.mod(jnp.arange(4 * 8).reshape(4, 8), 64).astype(jnp.int32)
+    labels = jnp.mod(tokens + 1, 64)
+    spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    mesh = make_mesh(pp, 1, devices=cpu_devices[:pp])
+
+    def run(dispatch, capacity_factor, schedule):
+        moe = MoEConfig(n_experts=4, top_k=2,
+                        capacity_factor=capacity_factor, dispatch=dispatch)
+        block, pre, post = llama_moe_spmd(cfg, moe, pp)
+        eng = SpmdGPipe(
+            block, pp, mesh, chunks=m, loss_fn=cross_entropy,
+            pre=pre, post=post, checkpoint="always", schedule=schedule,
+        )
+        params = eng.init(jax.random.PRNGKey(0), spec)
+        return eng.train_step(params, tokens, labels)
+
+    for schedule in ("fill_drain", "1f1b"):
+        l_dense, g_dense = run("dense", 8.0, schedule)
+        l_drop, g_drop = run("dropless", 8.0, schedule)
+        assert abs(float(l_dense) - float(l_drop)) < 1e-5, schedule
+        _assert_trees_close(g_drop, g_dense, rtol=1e-4, atol=1e-5)
