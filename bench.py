@@ -104,20 +104,25 @@ def _even_balance(n_layers: int, n_stages: int):
 
 
 def _build_amoebanet(platform: str, n_stages: int, batch: int | None = None,
-                     chunks: int | None = None):
+                     chunks: int | None = None, checkpoint: str = "except_last"):
     from torchgpipe_tpu.gpipe import GPipe
     from torchgpipe_tpu.models.amoebanet import amoebanetd
 
     if platform != "cpu":
-        # Measured sweet spot on a single v5e chip (16GB HBM): bf16 compute
-        # (f32 masters/BN stats), batch 128, 4 micro-batches, except_last —
-        # 442 samples/s in the sweep (f32 OOMs past batch 32; batch 256 and
-        # chunk counts >4 collapse to ~124/s under HBM pressure/recompute).
-        # The remote chip is shared, so free HBM varies run to run; main()
-        # retries down a batch ladder on RESOURCE_EXHAUSTED.
+        # Feasible sweet spot for the DEFAULT per-cell engine on a single
+        # v5e chip (15.75 GiB AOT limit): bf16 compute (f32 masters/BN
+        # stats), batch 64, 4 micro-batches, 'except_last' — measured 360
+        # samples/s in the round-1 sweep (BENCH_NOTES.md).  Batch 128's
+        # per-cell residuals (17.74 GiB measured by _rung_residual_bytes)
+        # can NEVER fit this chip on the per-cell path — the round-1 "442
+        # samples/s at batch 128" number was measured on the auto-fused
+        # whole-step engine, a path bench.py pins off (fused=False below),
+        # so the ladder starts at the honest per-cell top.  The remote chip
+        # is shared and free HBM varies run to run; main() retries down the
+        # ladder on RESOURCE_EXHAUSTED (memory-lighter modes further down).
         num_layers, num_filters = 18, 256
         image = 224
-        batch = 128 if batch is None else batch
+        batch = 64 if batch is None else batch
         chunks = 4 if chunks is None else chunks
         compute_dtype = jnp.bfloat16
     else:  # CPU smoke: same code path, toy size
@@ -131,12 +136,12 @@ def _build_amoebanet(platform: str, n_stages: int, batch: int | None = None,
     # chip (65.9 vs 32.4 samples/s, 18-minute fused compile — BENCH_NOTES.md
     # finding #1).
     model = GPipe(layers, balance=_even_balance(len(layers), n_stages),
-                  chunks=chunks, checkpoint="except_last",
+                  chunks=chunks, checkpoint=checkpoint,
                   compute_dtype=compute_dtype, fused=False)
     x = jnp.zeros((batch, image, image, 3), jnp.float32)
     y = jnp.zeros((batch,), jnp.int32)
     name = (f"amoebanetd-({num_layers},{num_filters})-pipeline{n_stages}"
-            f"-b{batch}m{chunks}")
+            f"-b{batch}m{chunks}-{checkpoint}")
     return model, x, y, name
 
 
@@ -257,15 +262,33 @@ def main() -> None:
         onehot = jax.nn.one_hot(tgt, logits.shape[-1], dtype=logp.dtype)
         return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
 
-    # The remote chip is shared: free HBM varies run to run (the tuned
-    # batch-128 config has been observed to both run at 442 samples/s and
-    # OOM on different days).  Walk a batch ladder so the driver always
-    # gets a hardware number; the tag records the config that ran.
-    ladder = [(128, 4), (96, 4), (64, 4), (48, 4), (32, 4)] \
-        if platform != "cpu" else [(None, None)]
+    # The remote chip is shared: free HBM varies run to run.  Walk a
+    # (batch, chunks, checkpoint) ladder so the driver always gets a
+    # hardware number; the tag records the config that ran.  The top rung
+    # is the largest config the PER-CELL engine can hold by measured
+    # residual arithmetic (eval_shape over this exact model): per-cell
+    # peeled-mb residuals are 17.74 GiB at 128/4, 13.37 at 96/4, 8.99 at
+    # 64/4, 6.80 at 48/4, 4.61 at 32/4, vs the 15.75 GiB AOT limit minus
+    # ~2.4 GiB overhead.  So 64/4 'except_last' tops the ladder; the
+    # batch-128/96 rungs of rounds 1-2 are gone — they can never fit and
+    # burned a predictor pass every run (the old "442 sweet spot" was an
+    # auto-fused-engine number; see BENCH_NOTES.md round-3 attribution).
+    # No 'never' rung: that mode holds ALL chunks' residuals (chunks ×
+    # per-cell ≥ 18.4 GiB even at batch 32) — per-cell-infeasible at any
+    # rung worth timing.
+    ladder = [
+        (64, 4, "except_last"),
+        (48, 4, "except_last"),
+        (32, 4, "except_last"),
+        (32, 4, "always"),
+    ] if platform != "cpu" else [(None, None, "except_last")]
     last_oom = None
     used_fallback_model = False
-    for batch_cfg, chunks_cfg in ladder:
+    prev_500_msg = None
+    skip_to_last = False
+    for batch_cfg, chunks_cfg, ckpt_cfg in ladder:
+        if skip_to_last and (batch_cfg, chunks_cfg, ckpt_cfg) != ladder[-1]:
+            continue
         try:
             # (Re)built each rung INSIDE the try: after an OOM rung even an
             # 8-byte PRNGKey allocation has been observed to raise
@@ -274,7 +297,8 @@ def main() -> None:
             rng = jax.random.PRNGKey(1)
             try:
                 model, x, y, name = _build_amoebanet(
-                    platform, n_stages, batch=batch_cfg, chunks=chunks_cfg
+                    platform, n_stages, batch=batch_cfg, chunks=chunks_cfg,
+                    checkpoint=ckpt_cfg,
                 )
             except ImportError:
                 # The fallback ignores the ladder's batch/chunks, so
@@ -292,9 +316,16 @@ def main() -> None:
                 # runtime-OOM path's re-raise-on-last-rung): a
                 # miscalibrated predictor must not leave the loop with no
                 # rung ever run.
-                and (batch_cfg, chunks_cfg) != ladder[-1]
+                and (batch_cfg, chunks_cfg, ckpt_cfg) != ladder[-1]
+                # 'always' holds no cell residuals between programs —
+                # nothing for this predictor to predict.
+                and ckpt_cfg != "always"
             ):
                 resid = _rung_residual_bytes(model, x)
+                # 'never' keeps EVERY micro-batch's residuals alive
+                # through the backward, not just the peeled last one.
+                if resid is not None and ckpt_cfg == "never":
+                    resid *= chunks_cfg
                 if (
                     resid is not None
                     and resid + _RUNG_OVERHEAD_BYTES > capacity
@@ -351,6 +382,7 @@ def main() -> None:
             # memory space hbm" text (observed when a program's arguments
             # exceed HBM at compile time on the shared chip).
             msg = str(e)
+            is_bare_500 = "remote_compile" in msg and "HTTP 500" in msg
             is_oom = (
                 "RESOURCE_EXHAUSTED" in msg
                 or "Ran out of memory" in msg
@@ -358,16 +390,26 @@ def main() -> None:
                 # The remote AOT compiler reports HBM-overflow as a bare
                 # HTTP 500 (the "Ran out of memory in memory space hbm"
                 # text only reaches the log stream, not the exception).
-                # Treat it as retryable: a genuinely non-OOM compile error
-                # fails every rung and the last rung re-raises.
-                or ("remote_compile" in msg and "HTTP 500" in msg)
+                # Treat it as retryable — but a bare 500 carries no
+                # OOM-discriminating text, so a deterministic non-OOM
+                # compile error would walk every rung through minutes-long
+                # remote compiles.  Compromise: after TWO identical bare
+                # 500s in a row, jump straight to the LAST (cheapest) rung
+                # — a genuine OOM pair still ends in a number from the
+                # config most likely to fit, while a deterministic error
+                # surfaces after three compiles instead of five.
+                or is_bare_500
             )
             if (
                 not is_oom
-                or (batch_cfg, chunks_cfg) == ladder[-1]
+                or (batch_cfg, chunks_cfg, ckpt_cfg) == ladder[-1]
                 or used_fallback_model
             ):
                 raise
+            if is_bare_500:
+                if msg == prev_500_msg:
+                    skip_to_last = True
+                prev_500_msg = msg
             import sys
 
             print(
@@ -408,6 +450,13 @@ def main() -> None:
     tag = f"{name}, {platform}"
     if tpu_unreachable:
         tag += ", TPU-UNREACHABLE-cpu-fallback"
+        # Mid-run deaths re-exec through _reexec_cpu_fallback, which stashes
+        # the original exception text — surface it so the driver can tell
+        # "tunnel died" from "program failed to compile" (the re-exec match
+        # is deliberately broad; the tag keeps it diagnosable).
+        err = os.environ.get("TGPU_TUNNEL_ERR", "")
+        if err:
+            tag += f" [{err}]"
     if last_oom is not None:
         tag += f", hbm-ladder (batch {last_oom} OOM on shared chip)"
     # The published baseline is per TPU/GPU chip; comparing the CPU smoke
@@ -439,14 +488,22 @@ def main() -> None:
     }))
 
 
-def _reexec_cpu_fallback() -> None:
+def _reexec_cpu_fallback(msg: str) -> None:
     """The tunnel died MID-RUN (backend already initialized, so the
     platform cannot be flipped in-process): re-exec the bench pinned to
     CPU so the driver still gets a labeled JSON line instead of a bare
-    traceback.  One attempt only (TGPU_TUNNEL_DIED guards recursion)."""
+    traceback.  One attempt only (TGPU_TUNNEL_DIED guards recursion).
+    The original exception text rides TGPU_TUNNEL_ERR into the fallback
+    line's tag — a deterministic compile error (TPU reachable, program
+    broken) would otherwise be indistinguishable from a dead tunnel."""
     import sys
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu", TGPU_TUNNEL_DIED="1")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        TGPU_TUNNEL_DIED="1",
+        TGPU_TUNNEL_ERR=" ".join(msg.split())[:300],
+    )
     print(
         "bench: TPU backend died mid-run; re-executing on CPU fallback",
         file=sys.stderr,
@@ -472,4 +529,4 @@ if __name__ == "__main__":
         )
         if not mid_run_death:
             raise
-        _reexec_cpu_fallback()
+        _reexec_cpu_fallback(msg)

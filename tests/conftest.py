@@ -56,3 +56,22 @@ def cpu_devices():
     if len(devs) < 8:
         pytest.skip("expected 8 virtual host devices")
     return devs
+
+
+def counting_layer(calls):
+    """A pass-through Layer whose apply fires a debug callback appending to
+    ``calls`` — counts actual block executions (only the taken lax.cond
+    branch fires at runtime).  Shared by the schedule checkpoint-mode
+    forward-count tests (test_spmd_1f1b.py, test_spmd_interleaved.py)."""
+    from torchgpipe_tpu.layers import Layer
+
+    def init(rng, in_spec):
+        del rng, in_spec
+        return (), ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del params, rng, train
+        jax.debug.callback(lambda: calls.append(1))
+        return x, state
+
+    return Layer(name="count", init=init, apply=apply)

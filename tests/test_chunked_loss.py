@@ -384,3 +384,32 @@ def test_chunked_xent_bf16_inputs():
     assert float(jnp.max(jnp.abs(
         gw1.astype(jnp.float32) - gw2.astype(jnp.float32)
     ))) < 5e-3
+
+
+def test_label_range_guard_checkify():
+    """assert_labels_in_range makes the silent out-of-range degradation
+    (loss = logsumexp, target term dropped — documented contract) loud
+    under checkify, and is a no-op for valid labels."""
+    from jax.experimental import checkify
+
+    from torchgpipe_tpu.ops.losses import assert_labels_in_range
+
+    T, d, V, C = 4, 8, 24, 8
+    k = jax.random.split(jax.random.PRNGKey(0), 3)
+    h = jax.random.normal(k[0], (T, d))
+    w = jax.random.normal(k[1], (d, V)) * 0.3
+
+    def loss(labels):
+        assert_labels_in_range(labels, V)
+        return jnp.mean(chunked_softmax_xent(h, w, labels, C))
+
+    checked = checkify.checkify(loss)
+    good = jax.random.randint(k[2], (T,), 0, V)
+    err, val = checked(good)
+    err.throw()  # no error
+    assert float(val) > 0
+
+    bad = good.at[1].set(V + 3)
+    err, _ = checked(bad)
+    with pytest.raises(Exception, match="labels must lie in"):
+        err.throw()

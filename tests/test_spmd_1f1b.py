@@ -413,15 +413,110 @@ def test_1f1b_never_composes_with_dp():
     assert maxdiff(g1, g2) < 1e-4
 
 
-def test_explicit_schedules_reject_except_last():
-    pp = 2
-    mesh = make_mesh(pp, 1, devices=jax.devices()[:2])
-    cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp * 2, n_heads=4,
+def test_1f1b_except_last_matches_always():
+    """checkpoint='except_last' (the reference's DEFAULT mode,
+    reference gpipe.py:360-367) on the 1F1B schedule: micro-batches < m-1
+    recompute, micro-batch m-1 replays a single stored-residual slot —
+    gradients must be bit-equal to the all-recompute path."""
+    pp, m = 4, 6
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:4])
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp, n_heads=4,
                             n_kv_heads=2)
-    block, pre, post = llama_spmd(cfg, pp * 2)
-    with pytest.raises(ValueError, match="supports checkpoint"):
-        SpmdGPipe(
-            block, pp, mesh, chunks=2, loss_fn=cross_entropy,
-            pre=pre, post=post, checkpoint="except_last",
-            schedule="interleaved", virtual_stages=2,
+    block, pre, post = llama_spmd(cfg, pp)
+    tokens, labels = _tokens(2 * m)
+    res = {}
+    for ck in ("always", "except_last"):
+        eng = SpmdGPipe(
+            block, pp, mesh, chunks=m, loss_fn=cross_entropy,
+            pre=pre, post=post, checkpoint=ck, schedule="1f1b",
         )
+        params = eng.init(
+            jax.random.PRNGKey(0),
+            jax.ShapeDtypeStruct(tokens.shape, tokens.dtype),
+        )
+        res[ck] = eng.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    la, ga = res["always"]
+    le, ge = res["except_last"]
+    assert abs(float(la) - float(le)) < 1e-6
+    assert maxdiff(ga, ge) < 1e-5
+
+
+def _schedule_scan_carry_bytes(eng, tokens, labels):
+    """Total bytes of the schedule scan's carry (the ring buffers live
+    there), located via the scan with the schedule's 2(m+n-1) trip count."""
+    from tests.jaxpr_utils import aval_bytes, iter_jaxprs
+    import torchgpipe_tpu.microbatch as mb
+
+    n, m = eng.n_stages, eng.chunks
+    params = eng.init(
+        jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct(tokens.shape, tokens.dtype),
+    )
+    fn = eng._build_train_step(use_rng=False)
+    jaxpr = jax.make_jaxpr(lambda p, a, b: fn(p, a, b))(
+        params, mb.scatter_stacked(tokens, m), mb.scatter_stacked(labels, m)
+    )
+    for jx in iter_jaxprs(jaxpr.jaxpr):
+        for eqn in jx.eqns:
+            if (
+                eqn.primitive.name == "scan"
+                and eqn.params.get("length") == 2 * (m + n - 1)
+            ):
+                nc, nk = eqn.params["num_consts"], eqn.params["num_carry"]
+                return sum(aval_bytes(v) for v in eqn.invars[nc:nc + nk])
+    raise AssertionError("schedule scan not found")
+
+
+def test_1f1b_except_last_buffers_fewer_bytes_than_never():
+    """The hybrid's residual store is ONE slot (vs 'never's depth-n ring):
+    its schedule-scan carry must be strictly smaller than 'never's, while
+    staying within one input-ring of 'always's."""
+    pp, m = 2, 4
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:2])
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, pp)
+    tokens, labels = _tokens(2 * m)
+    bytes_by = {}
+    for ck in ("always", "except_last", "never"):
+        eng = SpmdGPipe(
+            block, pp, mesh, chunks=m, loss_fn=cross_entropy,
+            pre=pre, post=post, checkpoint=ck, schedule="1f1b",
+        )
+        bytes_by[ck] = _schedule_scan_carry_bytes(eng, tokens, labels)
+    assert bytes_by["except_last"] < bytes_by["never"], bytes_by
+    assert bytes_by["always"] < bytes_by["except_last"], bytes_by
+
+
+def test_1f1b_checkpoint_modes_runtime_forward_counts():
+    """Count actual block-forward EXECUTIONS per mode with a debug
+    callback (fires only in the lax.cond branch the schedule takes):
+    'always' runs 2m per stage (m forwards + m backward recomputes),
+    'except_last' skips exactly the last micro-batch's recompute (2m-1),
+    'never' recomputes nothing (m).  This is the reference's
+    checkpoint-mode contract (gpipe.py:360-367) observed at runtime."""
+    from tests.conftest import counting_layer
+    from torchgpipe_tpu.layers import chain
+    from torchgpipe_tpu.ops import dense
+
+    calls = []
+    pp, m, dim = 2, 3, 8
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:2])
+    block = chain([counting_layer(calls), dense(dim, name="fc")], name="block")
+    mse = lambda o, t: jnp.mean((o - t) ** 2)  # noqa: E731
+    x = jax.random.normal(jax.random.PRNGKey(5), (2 * m, dim))
+    y = jax.random.normal(jax.random.PRNGKey(6), (2 * m, dim))
+    expected = {"always": 2 * m, "except_last": 2 * m - 1, "never": m}
+    for ck, per_stage in expected.items():
+        eng = SpmdGPipe(
+            block, pp, mesh, chunks=m, loss_fn=mse,
+            checkpoint=ck, loss_reduction="mean", schedule="1f1b",
+        )
+        params = eng.init(
+            jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+        calls.clear()
+        loss, _ = eng.train_step(params, x, y)
+        jax.block_until_ready(loss)
+        jax.effects_barrier()
+        assert len(calls) == pp * per_stage, (ck, len(calls))

@@ -429,6 +429,70 @@ def test_interleaved_checkpoint_never_matches_always():
         assert _rel_err(a, b) < 1e-5
 
 
+def test_interleaved_except_last_matches_always():
+    """checkpoint='except_last' (the reference's DEFAULT mode, reference
+    gpipe.py:360-367) under the interleaved schedule: micro-batch m-1's
+    cells replay one stored-residual slot per chunk, all others
+    recompute — loss and grads must match the all-recompute path."""
+    n, v, m = 2, 2, 4
+    block, pre, post, loss_fn = _llama(n * v)
+    mesh = make_mesh(n, 1, devices=jax.devices()[:n])
+    tokens, labels = _data(m * 2)
+    spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    res = {}
+    for ck in ("always", "except_last"):
+        eng = SpmdGPipe(
+            block, n, mesh, chunks=m, loss_fn=loss_fn, pre=pre, post=post,
+            checkpoint=ck, schedule="interleaved", virtual_stages=v,
+        )
+        params = eng.init(jax.random.PRNGKey(0), spec)
+        res[ck] = eng.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    la, ga = res["always"]
+    le, ge = res["except_last"]
+    assert abs(float(la) - float(le)) < 1e-6
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(ge)
+    ):
+        assert _rel_err(a, b) < 1e-5
+
+
+def test_interleaved_checkpoint_modes_runtime_forward_counts():
+    """Block-forward EXECUTION counts per mode via a debug callback (only
+    the taken lax.cond branch fires): per device lane, 'always' runs
+    2·v·m (v·m forwards + v·m recomputes), 'except_last' skips the v
+    last-micro-batch recomputes (2·v·m − v), 'never' recomputes nothing
+    (v·m)."""
+    from tests.conftest import counting_layer
+    from torchgpipe_tpu.layers import chain
+    from torchgpipe_tpu.ops import dense
+
+    calls = []
+    n, v, m, dim = 2, 2, 4, 8
+    mesh = make_mesh(n, 1, devices=jax.devices()[:n])
+    block = chain([counting_layer(calls), dense(dim, name="fc")], name="block")
+    mse = lambda o, t: jnp.mean((o - t) ** 2)  # noqa: E731
+    x = jax.random.normal(jax.random.PRNGKey(5), (2 * m, dim))
+    y = jax.random.normal(jax.random.PRNGKey(6), (2 * m, dim))
+    expected = {
+        "always": 2 * v * m,
+        "except_last": 2 * v * m - v,
+        "never": v * m,
+    }
+    for ck, per_lane in expected.items():
+        eng = SpmdGPipe(
+            block, n, mesh, chunks=m, loss_fn=mse, checkpoint=ck,
+            loss_reduction="mean", schedule="interleaved", virtual_stages=v,
+        )
+        params = eng.init(
+            jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+        calls.clear()
+        loss, _ = eng.train_step(params, x, y)
+        jax.block_until_ready(loss)
+        jax.effects_barrier()
+        assert len(calls) == n * per_lane, (ck, len(calls))
+
+
 def test_interleaved_never_fewer_matmuls():
     from tests.jaxpr_utils import count_eqns
     import torchgpipe_tpu.microbatch as mb

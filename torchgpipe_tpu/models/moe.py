@@ -25,11 +25,20 @@ arXiv:2006.16668 — implemented here from the math):
   inference) every expert is local and the all_to_alls vanish — one code
   path serves both.
 
-No auxiliary load-balancing loss is computed inside the layer (the pipeline
-engines' loss is a pure function of the model output); `router_stats`
-returns the standard balance/importance metrics from a forward's hidden
-states for monitoring or for adding a balance term in a custom training
-loop.
+Load balancing: with ``MoEConfig.balance_weight > 0`` the layer injects the
+Switch/GShard balance penalty's GRADIENT directly — `add_aux_grad` plants a
+custom-vjp identity on the layer output whose backward adds
+``balance_weight * aux_scale * d(penalty)`` to the parameter cotangents
+(``aux_scale`` is the engines' per-micro-batch weighting, see
+:mod:`torchgpipe_tpu.auxgrad`).  The engines' scalar *loss value* stays a
+pure function of the model output (no auxiliary term ever shows up in the
+reported loss); the optimizer still sees exactly the gradients of
+``task_loss + balance_weight * mean_over_microbatches(penalty)``
+(asserted by ``tests/test_moe.py::
+test_balance_weight_injects_exact_aux_gradient``).  With
+``balance_weight == 0`` (default) nothing is injected; `router_stats`
+returns the same balance/importance metrics from a forward's hidden states
+for monitoring or for a hand-rolled balance term in a custom loop.
 """
 
 from __future__ import annotations

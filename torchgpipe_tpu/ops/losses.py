@@ -50,6 +50,16 @@ def chunked_softmax_xent(
     ``[T]`` f32 losses (reduce yourself — ``jnp.mean`` for the usual mean
     objective).  ``chunk`` bounds the transient tile: peak extra memory is
     ``T * chunk`` f32 instead of ``T * V``.
+
+    Labels MUST lie in ``[0, V)``.  An out-of-range label (including a
+    negative "ignore-index" convention) matches no vocabulary chunk, so
+    its target term silently stays 0 and the returned value degrades to
+    ``logsumexp`` — a plausible-looking positive number, not an error,
+    where a dense ``take_along_axis`` oracle would have gathered garbage
+    loudly.  There is no ignore-index semantics here: mask such tokens'
+    losses to 0 yourself after the call (and scale your mean by the kept
+    count).  Use :func:`assert_labels_in_range` under
+    ``jax.experimental.checkify`` to make violations loud in debug runs.
     """
     loss, _, _ = _xent_fwd_scan(h, w, labels, chunk)
     return loss
@@ -134,3 +144,21 @@ def _xent_vjp_bwd(chunk, res, g):
 
 
 chunked_softmax_xent.defvjp(_xent_vjp_fwd, _xent_vjp_bwd)
+
+
+def assert_labels_in_range(labels: jnp.ndarray, vocab: int) -> None:
+    """Checkify-able guard for :func:`chunked_softmax_xent`'s label
+    contract (labels in ``[0, V)`` — out-of-range labels silently lose
+    their target term).  Call it right before the loss inside a function
+    wrapped with ``jax.experimental.checkify.checkify``; outside checkify
+    the ``debug=True`` check is dropped at staging (verified under plain
+    ``jit``), so production steps pay nothing.
+    """
+    from jax.experimental import checkify
+
+    checkify.check(
+        jnp.all((labels >= 0) & (labels < vocab)),
+        "chunked_softmax_xent: labels must lie in [0, vocab); out-of-range "
+        "labels would silently degrade the loss to logsumexp",
+        debug=True,
+    )

@@ -325,11 +325,15 @@ class SpmdGPipe:
         depth ``n`` instead of the micro-batch count ``m`` — same bubble
         fraction, O(n) instead of O(m) activation memory.  Both
         explicit-gradient schedules require a micro-batch-decomposable
-        loss (``loss_reduction`` 'mean'/'sum') and take
-        ``checkpoint='always'`` (per-cell ``jax.vjp`` with recompute) or
-        ``'never'`` (stored vjp residuals in the schedule's ring buffers —
-        more memory, zero recompute); they compose with dp, tp, ep (MoE)
-        and fsdp — but not sp, whose ring attention would put
+        loss (``loss_reduction`` 'mean'/'sum') and support every
+        checkpoint mode: ``'always'`` recomputes each cell in its backward
+        tick (per-cell ``jax.vjp``), ``'never'`` stores every in-flight
+        cell's vjp residuals in the schedule's ring buffers (more memory,
+        zero recompute), and ``'except_last'`` — the reference's default
+        (reference gpipe.py:360-367) — recomputes all micro-batches except
+        the last, whose residuals fit in a single slot because its
+        backward starts right after its forward.  They compose with dp,
+        tp, ep (MoE) and fsdp — but not sp, whose ring attention would put
         collective-permutes inside the schedule conditional (see the
         ``__post_init__`` error).  New capability: the reference has
         fill-drain only (SURVEY.md §2.2).
@@ -475,16 +479,6 @@ class SpmdGPipe:
                     f"{sched} computes per-micro-batch losses inside "
                     "the schedule, so the loss must decompose over "
                     "micro-batches: set loss_reduction='mean' or 'sum'"
-                )
-            allowed = ("always", "never")
-            if self.checkpoint not in allowed:
-                raise ValueError(
-                    f"{sched} supports checkpoint in {allowed}: 'always' "
-                    "recomputes each cell in its backward tick; 'never' "
-                    "stores each in-flight cell's vjp residuals in the "
-                    "schedule's ring buffers instead — more memory, no "
-                    "recompute.  Use schedule='fill_drain' for "
-                    f"checkpoint={self.checkpoint!r}"
                 )
             if self.remat_policy is not None:
                 raise ValueError(
@@ -1120,7 +1114,12 @@ class SpmdGPipe:
         (``jax.vjp`` per cell — the reference's checkpoint-'always'
         semantics, checkpoint.py:1-19) or, under ``checkpoint='never'``,
         replay stored vjp residuals from the same depth-n ring buffer
-        (zero recompute); the last stage's backward cell also
+        (zero recompute).  ``checkpoint='except_last'`` — the reference's
+        default mode (gpipe.py:360-367) — is the hybrid: micro-batches
+        ``< m-1`` take the recompute path while micro-batch ``m-1`` stores
+        its residuals in a single slot (its backward begins immediately,
+        so no ring is needed), dispatched by a ``lax.cond`` on the
+        micro-batch index.  The last stage's backward cell also
         runs ``post`` + per-micro-batch loss, seeding the cotangent ring.
         ``pre`` runs once outside the scan with its vjp kept; stage 0's
         backward cells stack their input cotangents and one outer
@@ -1192,6 +1191,12 @@ class SpmdGPipe:
             )
             act0 = tmap(lambda s: jnp.zeros(s.shape, s.dtype), act_spec)
             store = self.checkpoint == "never"
+            # 'except_last' is the hybrid: micro-batches < m-1 take the
+            # recompute ('always') path; the LAST micro-batch stores its
+            # vjp residuals instead (reference gpipe.py:360-367 — the last
+            # chunk's backward begins immediately after its forward, so
+            # skipping its recompute costs one residual slot, not a ring).
+            hybrid = self.checkpoint == "except_last"
 
             def cell_fn(p_blk, p_pre, x, i):
                 """One forward cell as a function of everything its
@@ -1211,9 +1216,9 @@ class SpmdGPipe:
                 gloss=tmap(jnp.zeros_like, loss_params),
                 loss=jnp.float32(0.0),
             )
-            if store:
-                # checkpoint='never': ring-buffer each in-flight cell's
-                # vjp RESIDUAL LEAVES (the closure's pytree leaves — its
+            if store or hybrid:
+                # Stored-vjp machinery: buffer each stored cell's vjp
+                # RESIDUAL LEAVES (the closure's pytree leaves — its
                 # treedef is static and identical for every cell, so one
                 # canonical treedef from an abstract trace rebuilds the
                 # closure at backward time) plus the last forward output
@@ -1237,15 +1242,18 @@ class SpmdGPipe:
                 param_flat = jax.tree_util.tree_leaves(
                     (params_local, pre_params)
                 )
+                # 'never' stores EVERY in-flight cell: depth-n ring.
+                # 'except_last' stores only micro-batch m-1: ONE slot.
+                resid_depth = n if store else 1
                 carry0["rbuf"] = tuple(
                     jnp.zeros(
-                        (n,) + vjp_leaf_specs[i].shape,
+                        (resid_depth,) + vjp_leaf_specs[i].shape,
                         vjp_leaf_specs[i].dtype,
                     )
                     for i in buffered_idx
                 )
                 carry0["ylast"] = act0
-            else:
+            if not store:
                 # Depth-n input ring buffer (slot i % n): in-flight
                 # micro-batches per stage never exceed n, and slot i + n's
                 # write lands strictly after slot i's backward read.
@@ -1277,21 +1285,28 @@ class SpmdGPipe:
                 do_b = (num >= 0) & (num % 2 == 0) & (num // 2 < m)
                 i_b = jnp.clip(jnp.where(num >= 0, num // 2, 0), 0, m - 1)
 
-                def fwd_branch(c):
-                    if store:
-                        y, vjp_fn = jax.vjp(
-                            lambda a, b, xx: cell_fn(a, b, xx, i_f),
-                            params_local, pre_params, recv_f,
+                def fwd_store(c):
+                    # Stored-vjp forward cell ('never', or 'except_last's
+                    # last micro-batch): vjp directly, buffer the residual
+                    # leaves (slot i%n for the ring, slot 0 for the single
+                    # 'except_last' slot) and the output (last-stage loss
+                    # seed — consumed on the very next tick).
+                    y, vjp_fn = jax.vjp(
+                        lambda a, b, xx: cell_fn(a, b, xx, i_f),
+                        params_local, pre_params, recv_f,
+                    )
+                    leaves = jax.tree_util.tree_leaves(vjp_fn)
+                    _never_check_leaves(leaves, vjp_leaf_specs, "1f1b")
+                    slot = i_f % n if store else 0
+                    rbuf = tuple(
+                        lax.dynamic_update_index_in_dim(
+                            b, leaves[i], slot, 0
                         )
-                        leaves = jax.tree_util.tree_leaves(vjp_fn)
-                        _never_check_leaves(leaves, vjp_leaf_specs, "1f1b")
-                        rbuf = tuple(
-                            lax.dynamic_update_index_in_dim(
-                                b, leaves[i], i_f % n, 0
-                            )
-                            for b, i in zip(c["rbuf"], buffered_idx)
-                        )
-                        return dict(c, act=y, rbuf=rbuf, ylast=y)
+                        for b, i in zip(c["rbuf"], buffered_idx)
+                    )
+                    return dict(c, act=y, rbuf=rbuf, ylast=y)
+
+                def fwd_plain(c):
                     x_f = stage_input(pre_params, i_f, recv_f)
                     y = self._block_fn_plain(
                         params_local, x_f, cell_key(i_f), aux_s, True
@@ -1305,58 +1320,67 @@ class SpmdGPipe:
                     )
                     return dict(c, act=y, buf=buf)
 
-                def bwd_branch(c):
+                def fwd_branch(c):
                     if store:
-                        vjp_cell = _never_rebuild(
-                            vjp_tdef,
-                            vjp_leaf_specs,
-                            passthrough,
-                            iter(
-                                lax.dynamic_index_in_dim(
-                                    b, i_b % n, 0, keepdims=False
-                                )
-                                for b in c["rbuf"]
-                            ),
-                            param_flat,
-                        )
+                        return fwd_store(c)
+                    if hybrid:
+                        return lax.cond(i_f == m - 1, fwd_store, fwd_plain, c)
+                    return fwd_plain(c)
 
-                        def last_fn():
-                            y_saved = c["ylast"]
-
-                            def tail(p_post, p_loss, yy):
-                                return mb_loss(yy, p_post, p_loss, i_b)
-
-                            loss_i, (d_post, d_loss, dy) = (
-                                jax.value_and_grad(tail, argnums=(0, 1, 2))(
-                                    post_params, loss_params, y_saved
-                                )
+                def bwd_store(c):
+                    slot = i_b % n if store else 0
+                    vjp_cell = _never_rebuild(
+                        vjp_tdef,
+                        vjp_leaf_specs,
+                        passthrough,
+                        iter(
+                            lax.dynamic_index_in_dim(
+                                b, slot, 0, keepdims=False
                             )
-                            d_blk, d_pre, dx = vjp_cell(dy)
-                            return loss_i, d_blk, d_pre, d_post, d_loss, dx
+                            for b in c["rbuf"]
+                        ),
+                        param_flat,
+                    )
 
-                        def mid_fn():
-                            d_blk, d_pre, dx = vjp_cell(recv_b)
-                            return (
-                                jnp.float32(0.0),
-                                d_blk,
-                                d_pre,
-                                tmap(jnp.zeros_like, post_params),
-                                tmap(jnp.zeros_like, loss_params),
-                                dx,
+                    def last_fn():
+                        y_saved = c["ylast"]
+
+                        def tail(p_post, p_loss, yy):
+                            return mb_loss(yy, p_post, p_loss, i_b)
+
+                        loss_i, (d_post, d_loss, dy) = (
+                            jax.value_and_grad(tail, argnums=(0, 1, 2))(
+                                post_params, loss_params, y_saved
                             )
+                        )
+                        d_blk, d_pre, dx = vjp_cell(dy)
+                        return loss_i, d_blk, d_pre, d_post, d_loss, dx
 
-                        loss_i, d_blk, d_pre, d_post, d_loss, dx = lax.cond(
-                            stage == n - 1, last_fn, mid_fn
+                    def mid_fn():
+                        d_blk, d_pre, dx = vjp_cell(recv_b)
+                        return (
+                            jnp.float32(0.0),
+                            d_blk,
+                            d_pre,
+                            tmap(jnp.zeros_like, post_params),
+                            tmap(jnp.zeros_like, loss_params),
+                            dx,
                         )
-                        return dict(
-                            c,
-                            gact=dx,
-                            gblk=tmap(jnp.add, c["gblk"], d_blk),
-                            gpre=tmap(jnp.add, c["gpre"], d_pre),
-                            gpost=tmap(jnp.add, c["gpost"], d_post),
-                            gloss=tmap(jnp.add, c["gloss"], d_loss),
-                            loss=c["loss"] + loss_i,
-                        )
+
+                    loss_i, d_blk, d_pre, d_post, d_loss, dx = lax.cond(
+                        stage == n - 1, last_fn, mid_fn
+                    )
+                    return dict(
+                        c,
+                        gact=dx,
+                        gblk=tmap(jnp.add, c["gblk"], d_blk),
+                        gpre=tmap(jnp.add, c["gpre"], d_pre),
+                        gpost=tmap(jnp.add, c["gpost"], d_post),
+                        gloss=tmap(jnp.add, c["gloss"], d_loss),
+                        loss=c["loss"] + loss_i,
+                    )
+
+                def bwd_plain(c):
                     x_saved = tmap(
                         lambda b: lax.dynamic_index_in_dim(
                             b, i_b % n, 0, keepdims=False
@@ -1413,6 +1437,13 @@ class SpmdGPipe:
                         gloss=tmap(jnp.add, c["gloss"], d_loss),
                         loss=c["loss"] + loss_i,
                     )
+
+                def bwd_branch(c):
+                    if store:
+                        return bwd_store(c)
+                    if hybrid:
+                        return lax.cond(i_b == m - 1, bwd_store, bwd_plain, c)
+                    return bwd_plain(c)
 
                 idx = jnp.where(do_f, 0, jnp.where(do_b, 1, 2))
                 carry = lax.switch(
@@ -1482,8 +1513,12 @@ class SpmdGPipe:
         Backward cells recompute their forward from the saved (spliced)
         input per cell (checkpoint='always') or replay stored vjp
         residuals from the c*S + i%S ring slots (checkpoint='never'),
-        like the 1F1B path.  No reference counterpart: the reference has
-        fill-drain only (reference: torchgpipe/pipeline.py:49-65).
+        like the 1F1B path.  checkpoint='except_last' (the reference's
+        default, gpipe.py:360-367) recomputes all micro-batches except
+        m-1, whose residuals live in one slot per chunk (each of the
+        device's v chunks runs exactly one cell of that micro-batch).
+        No reference counterpart for the schedule itself: the reference
+        has fill-drain only (reference: torchgpipe/pipeline.py:49-65).
         """
         from torchgpipe_tpu.parallel.interleaved import (
             BWD,
@@ -1561,6 +1596,11 @@ class SpmdGPipe:
                 lambda s: jnp.zeros((v * S,) + s.shape, s.dtype), act_spec
             )
             store = self.checkpoint == "never"
+            # 'except_last' hybrid (same design as the 1F1B builder): cells
+            # of micro-batch m-1 store their vjp residuals — one slot per
+            # CHUNK, since each of this device's v chunks runs exactly one
+            # cell of that micro-batch — while all other cells recompute.
+            hybrid = self.checkpoint == "except_last"
 
             def cell_fn(p_blk, p_pre, x, c, i):
                 xin = splice(p_pre, c, i, x)
@@ -1579,7 +1619,7 @@ class SpmdGPipe:
                 gloss=tmap(jnp.zeros_like, loss_params),
                 loss=jnp.float32(0.0),
             )
-            if store:
+            if store or hybrid:
                 # checkpoint='never' (same design as the 1F1B builder):
                 # buffer each in-flight cell's vjp residual leaves at slot
                 # c*S + i%S (liveness covered by the table generator's
@@ -1588,6 +1628,8 @@ class SpmdGPipe:
                 # in the canonical jaxpr and re-injected live (per-chunk
                 # params are dynamic slices, so the live value is p_of(c)'s
                 # leaf at backward time, not a buffered copy).
+                # checkpoint='except_last' buffers only micro-batch m-1:
+                # one slot per chunk (indexed by c), 1/S of the ring.
                 vjp_tdef, vjp_leaf_specs, passthrough, buffered_idx = (
                     _never_mode_spec(
                         lambda p, pp_, x: jax.vjp(
@@ -1600,19 +1642,27 @@ class SpmdGPipe:
                         act0,
                     )
                 )
+                resid_slots = v * S if store else v
                 carry0["rbuf"] = tuple(
                     jnp.zeros(
-                        (v * S,) + vjp_leaf_specs[i2].shape,
+                        (resid_slots,) + vjp_leaf_specs[i2].shape,
                         vjp_leaf_specs[i2].dtype,
                     )
                     for i2 in buffered_idx
                 )
-                # Last-CHUNK outputs for the loss seed only: keyed i % S
-                # (the fwd -> bwd window sits inside the act-span proof),
-                # written only by c == v-1 cells — 1/v of a full box.
-                carry0["ybox"] = tmap(
-                    lambda sp: jnp.zeros((S,) + sp.shape, sp.dtype), act_spec
-                )
+                if store:
+                    # Last-CHUNK outputs for the loss seed only: keyed
+                    # i % S (the fwd -> bwd window sits inside the
+                    # act-span proof), written only by c == v-1 cells —
+                    # 1/v of a full box.
+                    carry0["ybox"] = tmap(
+                        lambda sp: jnp.zeros((S,) + sp.shape, sp.dtype),
+                        act_spec,
+                    )
+                else:
+                    # Only cell (stage n-1, chunk v-1, micro-batch m-1)
+                    # writes the loss seed — a single slot.
+                    carry0["ylast"] = act0
 
             def tick(carry, rows):
                 krow, crow, irow, pkrow, pcrow, pirow = rows
@@ -1642,27 +1692,40 @@ class SpmdGPipe:
                 i = irow[stage]
                 idx = c * S + i % S
 
-                def fwd_branch(cr):
+                def fwd_store(cr):
+                    # Stored-vjp forward cell ('never', or 'except_last's
+                    # last micro-batch): slot c*S + i%S for the full ring,
+                    # slot c for the one-per-chunk 'except_last' store.
+                    y, vjp_fn = jax.vjp(
+                        lambda a, b, xx: cell_fn(a, b, xx, c, i),
+                        p_of(c), pre_params,
+                        _slot_read(cr["inbox"], idx),
+                    )
+                    leaves = jax.tree_util.tree_leaves(vjp_fn)
+                    _never_check_leaves(
+                        leaves, vjp_leaf_specs, "interleaved"
+                    )
+                    slot = idx if store else c
+                    rbuf = tuple(
+                        lax.dynamic_update_index_in_dim(
+                            b, leaves[i2], slot, 0
+                        )
+                        for b, i2 in zip(cr["rbuf"], buffered_idx)
+                    )
+                    out = dict(cr, act=y, rbuf=rbuf)
                     if store:
-                        y, vjp_fn = jax.vjp(
-                            lambda a, b, xx: cell_fn(a, b, xx, c, i),
-                            p_of(c), pre_params,
-                            _slot_read(cr["inbox"], idx),
-                        )
-                        leaves = jax.tree_util.tree_leaves(vjp_fn)
-                        _never_check_leaves(
-                            leaves, vjp_leaf_specs, "interleaved"
-                        )
-                        rbuf = tuple(
-                            lax.dynamic_update_index_in_dim(
-                                b, leaves[i2], idx, 0
-                            )
-                            for b, i2 in zip(cr["rbuf"], buffered_idx)
-                        )
-                        ybox = _slot_write(
+                        out["ybox"] = _slot_write(
                             cr["ybox"], i % S, y, c == v - 1
                         )
-                        return dict(cr, act=y, rbuf=rbuf, ybox=ybox)
+                    else:
+                        out["ylast"] = tmap(
+                            lambda cur, new: jnp.where(c == v - 1, new, cur),
+                            cr["ylast"],
+                            y,
+                        )
+                    return out
+
+                def fwd_plain(cr):
                     x_f = splice(pre_params, c, i, _slot_read(cr["inbox"], idx))
                     y = self._block_fn_plain(
                         p_of(c), x_f, cell_key(c, i), aux_s, True
@@ -1676,77 +1739,90 @@ class SpmdGPipe:
                         inbox=_slot_write(cr["inbox"], idx, x_f, True),
                     )
 
-                def bwd_branch(cr):
+                def fwd_branch(cr):
                     if store:
-                        vjp_cell = _never_rebuild(
-                            vjp_tdef,
-                            vjp_leaf_specs,
-                            passthrough,
-                            iter(
-                                lax.dynamic_index_in_dim(
-                                    b, idx, 0, keepdims=False
-                                )
-                                for b in cr["rbuf"]
-                            ),
-                            jax.tree_util.tree_leaves(
-                                (p_of(c), pre_params)
-                            ),
+                        return fwd_store(cr)
+                    if hybrid:
+                        return lax.cond(i == m - 1, fwd_store, fwd_plain, cr)
+                    return fwd_plain(cr)
+
+                def bwd_store(cr):
+                    slot = idx if store else c
+                    vjp_cell = _never_rebuild(
+                        vjp_tdef,
+                        vjp_leaf_specs,
+                        passthrough,
+                        iter(
+                            lax.dynamic_index_in_dim(
+                                b, slot, 0, keepdims=False
+                            )
+                            for b in cr["rbuf"]
+                        ),
+                        jax.tree_util.tree_leaves(
+                            (p_of(c), pre_params)
+                        ),
+                    )
+
+                    def last_fn_s():
+                        y_saved = (
+                            _slot_read(cr["ybox"], i % S)
+                            if store
+                            else cr["ylast"]
                         )
 
-                        def last_fn_s():
-                            y_saved = _slot_read(cr["ybox"], i % S)
+                        def tail(p_post, p_loss, yy):
+                            return mb_loss(yy, p_post, p_loss, i)
 
-                            def tail(p_post, p_loss, yy):
-                                return mb_loss(yy, p_post, p_loss, i)
-
-                            loss_i, (d_post, d_loss, dy) = (
-                                jax.value_and_grad(tail, argnums=(0, 1, 2))(
-                                    post_params, loss_params, y_saved
-                                )
+                        loss_i, (d_post, d_loss, dy) = (
+                            jax.value_and_grad(tail, argnums=(0, 1, 2))(
+                                post_params, loss_params, y_saved
                             )
-                            d_blk, d_pre, dx = vjp_cell(dy)
-                            return loss_i, d_blk, d_pre, d_post, d_loss, dx
-
-                        def mid_fn_s():
-                            d_blk, d_pre, dx = vjp_cell(
-                                _slot_read(cr["gbox"], idx)
-                            )
-                            return (
-                                jnp.float32(0.0),
-                                d_blk,
-                                d_pre,
-                                tmap(jnp.zeros_like, post_params),
-                                tmap(jnp.zeros_like, loss_params),
-                                dx,
-                            )
-
-                        loss_i, d_blk, d_pre, d_post, d_loss, dx = lax.cond(
-                            (stage == n - 1) & (c == v - 1),
-                            last_fn_s,
-                            mid_fn_s,
                         )
-                        gblk = tmap(
-                            lambda G, d: lax.dynamic_update_index_in_dim(
-                                G,
-                                lax.dynamic_index_in_dim(
-                                    G, c, 0, keepdims=False
-                                )
-                                + d,
-                                c,
-                                0,
-                            ),
-                            cr["gblk"],
+                        d_blk, d_pre, dx = vjp_cell(dy)
+                        return loss_i, d_blk, d_pre, d_post, d_loss, dx
+
+                    def mid_fn_s():
+                        d_blk, d_pre, dx = vjp_cell(
+                            _slot_read(cr["gbox"], idx)
+                        )
+                        return (
+                            jnp.float32(0.0),
                             d_blk,
+                            d_pre,
+                            tmap(jnp.zeros_like, post_params),
+                            tmap(jnp.zeros_like, loss_params),
+                            dx,
                         )
-                        return dict(
-                            cr,
-                            gact=dx,
-                            gblk=gblk,
-                            gpre=tmap(jnp.add, cr["gpre"], d_pre),
-                            gpost=tmap(jnp.add, cr["gpost"], d_post),
-                            gloss=tmap(jnp.add, cr["gloss"], d_loss),
-                            loss=cr["loss"] + loss_i,
-                        )
+
+                    loss_i, d_blk, d_pre, d_post, d_loss, dx = lax.cond(
+                        (stage == n - 1) & (c == v - 1),
+                        last_fn_s,
+                        mid_fn_s,
+                    )
+                    gblk = tmap(
+                        lambda G, d: lax.dynamic_update_index_in_dim(
+                            G,
+                            lax.dynamic_index_in_dim(
+                                G, c, 0, keepdims=False
+                            )
+                            + d,
+                            c,
+                            0,
+                        ),
+                        cr["gblk"],
+                        d_blk,
+                    )
+                    return dict(
+                        cr,
+                        gact=dx,
+                        gblk=gblk,
+                        gpre=tmap(jnp.add, cr["gpre"], d_pre),
+                        gpost=tmap(jnp.add, cr["gpost"], d_post),
+                        gloss=tmap(jnp.add, cr["gloss"], d_loss),
+                        loss=cr["loss"] + loss_i,
+                    )
+
+                def bwd_plain(cr):
                     x_saved = _slot_read(cr["inbox"], idx)
                     key = cell_key(c, i)
 
@@ -1808,6 +1884,13 @@ class SpmdGPipe:
                         gloss=tmap(jnp.add, cr["gloss"], d_loss),
                         loss=cr["loss"] + loss_i,
                     )
+
+                def bwd_branch(cr):
+                    if store:
+                        return bwd_store(cr)
+                    if hybrid:
+                        return lax.cond(i == m - 1, bwd_store, bwd_plain, cr)
+                    return bwd_plain(cr)
 
                 sel = jnp.where(k == FWD, 0, jnp.where(k == BWD, 1, 2))
                 carry = lax.switch(
