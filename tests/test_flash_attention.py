@@ -145,3 +145,86 @@ def test_streaming_uneven_blocks_and_long_kv():
                           interpret=True, streaming=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_streaming_causal_skips_masked_fetches():
+    """Causal block-skipping in the streaming grids: the clamped index
+    maps must re-request the SAME block for every fully-masked grid cell
+    (Pallas skips the HBM copy when the block index is unchanged), so the
+    number of distinct K/V (resp. Q) fetches per row equals the causal
+    triangle, not the full rectangle."""
+    from torchgpipe_tpu.ops.flash_attention import (
+        _causal_overlap,
+        _clamped_kv_block,
+        _clamped_q_block,
+        _first_valid_q,
+        _last_valid_kv,
+    )
+
+    bq = bk = 16
+    nq, nk = 8, 8
+    # Forward/dQ grids: trailing dim streams K/V for a fixed q block j.
+    kv_fetches = rect = tri = 0
+    for j in range(nq):
+        prev = None
+        for jk in range(nk):
+            idx = int(_clamped_kv_block(j, jk, bq, bk, True))
+            valid = _causal_overlap(j, jk, bq, bk)
+            tri += bool(valid)
+            rect += 1
+            if valid:
+                assert idx == jk  # real cells fetch their own block
+            else:
+                assert idx == int(_last_valid_kv(j, bq, bk))  # clamped
+            kv_fetches += idx != prev
+            prev = idx
+    assert kv_fetches == tri < rect
+
+    # dK/dV grid: trailing dim streams Q for a fixed kv block jk; the
+    # masked cells sit BEFORE the diagonal.
+    q_fetches = tri_q = 0
+    for jk in range(nk):
+        prev = None
+        for jq in range(nq):
+            idx = int(_clamped_q_block(jk, jq, bq, bk, True))
+            valid = _causal_overlap(jq, jk, bq, bk)
+            tri_q += bool(valid)
+            if valid:
+                assert idx == jq
+            else:
+                assert idx == int(_first_valid_q(jk, bq, bk))
+            q_fetches += idx != prev
+            prev = idx
+    assert q_fetches == tri_q
+
+    # Non-causal: no clamping, every cell fetches its own block.
+    assert int(_clamped_kv_block(0, 5, bq, bk, False)) == 5
+    assert int(_clamped_q_block(5, 0, bq, bk, False)) == 0
+
+
+def test_streaming_causal_grads_with_uneven_blocks():
+    """Clamped index maps with block_q != block_k and causal masking:
+    values and gradients must still match the dense oracle (the clamp
+    arithmetic must agree with the mask arithmetic at ragged diagonal
+    boundaries)."""
+    b, s, h, d = 1, 64, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(11), 4)
+    q = _rand(ks[0], (b, s, h, d))
+    k = _rand(ks[1], (b, s, h, d))
+    v = _rand(ks[2], (b, s, h, d))
+    cot = _rand(ks[3], (b, s, h, d))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=16, block_k=32,
+                            interpret=True, streaming=True) * cot
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) * cot)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)

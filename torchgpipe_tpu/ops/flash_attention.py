@@ -143,6 +143,43 @@ def _causal_overlap(jq, jk, block_q, block_k):
     return (jq + 1) * block_q - 1 >= jk * block_k
 
 
+def _last_valid_kv(jq, block_q, block_k):
+    """Largest K/V block index with any unmasked position for q block
+    ``jq`` under causal masking (== the diagonal block)."""
+    return ((jq + 1) * block_q - 1) // block_k
+
+
+def _first_valid_q(jk, block_q, block_k):
+    """Smallest q block index with any unmasked position against K/V
+    block ``jk`` under causal masking."""
+    return (jk * block_k) // block_q
+
+
+# Causal block-skipping for the streaming grids: the TPU grid is
+# rectangular, but clamping the BLOCK INDEX MAP to the last/first valid
+# block makes every fully-masked cell re-request the tile already in
+# VMEM — Pallas's pipelining skips the HBM copy when the block index is
+# unchanged between iterations, and ``pl.when`` skips the compute.  Net:
+# masked cells cost one grid bump, no bandwidth, no FLOPs (the reason
+# streaming used to lose to dense at moderate causal lengths —
+# BENCH_NOTES round-2 table, 87.1 vs 64.8 ms @4k).
+
+
+def _clamped_kv_block(j, jk, block_q, block_k, causal):
+    """K/V block to FETCH at streaming grid cell (q block j, step jk)."""
+    if not causal:
+        return jk
+    return jnp.minimum(jk, _last_valid_kv(j, block_q, block_k))
+
+
+def _clamped_q_block(jk, jq, block_q, block_k, causal):
+    """Q block to FETCH at streaming dK/dV grid cell (kv block jk, step
+    jq)."""
+    if not causal:
+        return jq
+    return jnp.maximum(jq, _first_valid_q(jk, block_q, block_k))
+
+
 def _mask_causal(s, jq, jk, block_q, block_k):
     qpos = jq * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
     kpos = jk * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -196,6 +233,11 @@ def _flash_fwd_call_stream(q, k, v, h, g, causal, sm_scale, block_q,
     sk = k.shape[1]
     nk = sk // block_k
     grid = (bh, s // block_q, nk)
+    kv_im = lambda i, j, jk: (  # noqa: E731
+        _kv_index(i, h, g),
+        _clamped_kv_block(j, jk, block_q, block_k, causal),
+        0,
+    )
     o, lse = pl.pallas_call(
         functools.partial(
             _fwd_stream_kernel, causal=causal, sm_scale=sm_scale,
@@ -208,12 +250,8 @@ def _flash_fwd_call_stream(q, k, v, h, g, causal, sm_scale, block_q,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, jk: (i, j, 0)),
-            pl.BlockSpec(
-                (1, block_k, d), lambda i, j, jk: (_kv_index(i, h, g), jk, 0)
-            ),
-            pl.BlockSpec(
-                (1, block_k, d), lambda i, j, jk: (_kv_index(i, h, g), jk, 0)
-            ),
+            pl.BlockSpec((1, block_k, d), kv_im),
+            pl.BlockSpec((1, block_k, d), kv_im),
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda i, j, jk: (i, j, 0)),
@@ -465,7 +503,12 @@ def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do):
     row3 = pl.BlockSpec((1, block_q, d), lambda i, j, jk: (i, j, 0))
     row2 = pl.BlockSpec((1, block_q, 1), lambda i, j, jk: (i, j, 0))
     kv3 = pl.BlockSpec(
-        (1, block_k, d), lambda i, j, jk: (_kv_index(i, h, g), jk, 0)
+        (1, block_k, d),
+        lambda i, j, jk: (
+            _kv_index(i, h, g),
+            _clamped_kv_block(j, jk, block_q, block_k, causal),
+            0,
+        ),
     )
     dq = pl.pallas_call(
         functools.partial(
@@ -481,9 +524,13 @@ def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do):
     )(*kernel_args)
 
     # dK/dV per QUERY head (expanded), summed over the group afterwards;
-    # grid streams Q blocks on the trailing dimension.
-    qrow3 = pl.BlockSpec((1, block_q, d), lambda i, jk, jq: (i, jq, 0))
-    qrow2 = pl.BlockSpec((1, block_q, 1), lambda i, jk, jq: (i, jq, 0))
+    # grid streams Q blocks on the trailing dimension (invalid steps sit
+    # BEFORE the first diagonal block here, so the clamp is a max).
+    q_im = lambda i, jk, jq: (  # noqa: E731
+        i, _clamped_q_block(jk, jq, block_q, block_k, causal), 0
+    )
+    qrow3 = pl.BlockSpec((1, block_q, d), q_im)
+    qrow2 = pl.BlockSpec((1, block_q, 1), q_im)
     kvb = pl.BlockSpec(
         (1, block_k, d), lambda i, jk, jq: (_kv_index(i, h, g), jk, 0)
     )
@@ -618,9 +665,12 @@ def flash_attention(
     per-program VMEM is O(block·d) — K/V (and, in the dK/dV kernel, Q/dO)
     tiles stream from HBM instead of residing whole — enabling very long
     single-chip sequences.  ``None`` picks automatically from the K/V row
-    footprint; the resident variants stay preferred at moderate lengths
-    (they skip fully-masked causal blocks instead of visiting the full
-    rectangular grid).
+    footprint.  Under causal masking the streaming grids skip
+    fully-masked cells' work: clamped block index maps re-request the
+    tile already resident (no HBM copy — Pallas elides same-index
+    refetches) and ``pl.when`` skips the compute, so masked cells cost
+    one grid bump (see ``_clamped_kv_block``; asserted in
+    tests/test_flash_attention.py::test_streaming_causal_skips_masked_fetches).
     """
     b, s, h, d = q.shape
     g = k.shape[2]

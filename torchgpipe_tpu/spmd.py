@@ -222,6 +222,23 @@ def _never_rebuild(tdef, leaf_specs, passthrough, buffered_iter, live_flat):
     return jax.tree_util.tree_unflatten(tdef, leaves)
 
 
+def _pad_batch(tree, pad):
+    """Pad dim 0 by ``pad`` rows, edge-replicating the last row — replicas
+    are valid inputs for any layer/loss (no NaN traps from zero tokens);
+    the ragged-batch mask zeroes their loss and gradient contribution.
+    Reference semantics anchor: the reference scatters indivisible batches
+    into ragged micro-batches (reference microbatch.py:143-158); a padded
+    uniform scatter + masked loss is the SPMD-compatible equivalent."""
+    if pad == 0:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda a: jnp.pad(
+            a, ((0, pad),) + ((0, 0),) * (a.ndim - 1), mode="edge"
+        ),
+        tree,
+    )
+
+
 def _slot_read(buf, idx):
     """Read slot ``idx`` from a stacked ring-buffer pytree."""
     return jax.tree_util.tree_map(
@@ -305,8 +322,13 @@ class SpmdGPipe:
       loss_reduction: 'mean' (default) or 'sum' declares that ``post`` and
         ``loss_fn`` decompose over batch elements with that reduction,
         letting the engine shard the head + loss over the ``pp`` axis (1/n
-        of the logits per device).  Pass ``None`` for a non-decomposable
-        loss — the head/loss then run replicated on the full batch.
+        of the logits per device) and accept RAGGED batches (B not
+        divisible by chunks·dp·ep: the batch is edge-padded and a mask
+        weights the padding out of loss and grads exactly — reference
+        parity with indivisible-batch scatter, reference
+        microbatch.py:143-158).  Pass ``None`` for a non-decomposable
+        loss — the head/loss then run replicated on the full batch, and
+        ragged batches are rejected with a didactic error.
       fsdp: ZeRO-3/FSDP-style parameter sharding (new capability — the
         reference lists ZeRO/FSDP as absent, SURVEY.md §2.2): block
         parameters are STORED sharded over the ``dp`` axis (each leaf's
@@ -689,10 +711,47 @@ class SpmdGPipe:
             return out
         return self.loss_fn(y, tgt)
 
-    def _cell_mb_loss(self, y, p_post, p_loss, i, tgt_mb, post_base):
+    def _masked_loss_sum(self, p_loss, y, tgt, mask, train=True):
+        """``Σ_rows mask · loss_fn(row)`` — the ragged-batch weighting
+        primitive.  Each row is presented to ``loss_fn`` as a batch-1
+        slice under ``vmap``, so the declared row decomposition
+        (``loss_reduction`` 'mean'/'sum') makes the masked sum exact:
+        padded rows contribute zero to both value and gradient."""
+        tmap = jax.tree_util.tree_map
+
+        def row(yy, tt):
+            return self._loss_call(
+                p_loss,
+                tmap(lambda a: a[None], yy),
+                tmap(lambda a: a[None], tt),
+                train=train,
+            ).astype(jnp.float32)
+
+        return jnp.sum(jax.vmap(row)(y, tgt) * mask)
+
+    def _mask_mean_scale(self, mask_local):
+        """Traced per-lane scale turning a lane-local masked row-loss SUM
+        into a value whose dp/ep ``pmean``s give the global masked mean:
+        dp·ep (the later pmeans divide it back) over the REAL row count.
+        The count comes from the mask itself (a psum over the
+        batch-sharding axes), so ONE compiled step serves every ragged
+        size that pads to the same bucket — no per-``B`` rebuild."""
+        n_real = jnp.sum(mask_local)
+        dpep = 1.0
+        for ax in (self.dp_axis, self.ep_axis):
+            if ax:
+                n_real = lax.psum(n_real, ax)
+                dpep *= self.mesh.shape[ax]
+        return dpep / n_real
+
+    def _cell_mb_loss(self, y, p_post, p_loss, i, tgt_mb, post_base,
+                      mask_mb=None, mean_scale=None):
         """Per-micro-batch head + loss for a final cell (aux scale 1/m:
         the m cells average to one mini-batch, mirroring the fill-drain
-        head's 1/n over n batch slices)."""
+        head's 1/n over n batch slices).  With ``mask_mb`` (ragged
+        batches) the loss is the masked per-row sum, scaled so the
+        engine's Σ over cells + dp/ep pmeans yield the exact loss over
+        the real rows."""
         tmap = jax.tree_util.tree_map
         if self.post is not None:
             with aux_scale(1.0 / self.chunks):
@@ -703,7 +762,16 @@ class SpmdGPipe:
             lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
             tgt_mb,
         )
-        loss_i = self._loss_call(p_loss, y, tgt_i).astype(jnp.float32)
+        if mask_mb is not None:
+            mask_i = lax.dynamic_index_in_dim(mask_mb, i, 0, keepdims=False)
+            s = self._masked_loss_sum(p_loss, y, tgt_i, mask_i)
+            if self.loss_reduction == "mean":
+                # ×chunks cancels the engine's /chunks below, leaving
+                # dp·ep/N_real per row — pmeans make it 1/N_real globally.
+                s = s * (self.chunks * mean_scale)
+            loss_i = s
+        else:
+            loss_i = self._loss_call(p_loss, y, tgt_i).astype(jnp.float32)
         if self.loss_reduction == "mean":
             loss_i = loss_i / self.chunks
         return loss_i
@@ -1088,7 +1156,7 @@ class SpmdGPipe:
             lambda mb: self.pre.apply(pre_params, (), mb, rng=None, train=train)[0]
         )(x_mb)
 
-    def _build_train_step_1f1b(self, use_rng: bool):
+    def _build_train_step_1f1b(self, use_rng: bool, masked: bool = False):
         """Training step under the 1F1B (PipeDream-flush) schedule.
 
         Unlike the fill-drain path — which differentiates the whole scanned
@@ -1129,7 +1197,15 @@ class SpmdGPipe:
         data_spec = self._data_specs()
         tmap = jax.tree_util.tree_map
 
-        def local(params, x_mb, tgt_mb, rng=None):
+        def local(params, x_mb, tgt_mb, *rest):
+            rest = list(rest)
+            mask_mb = rest.pop(0) if masked else None
+            rng = rest.pop(0) if use_rng else None
+            mean_scale = (
+                self._mask_mean_scale(mask_mb)
+                if masked and self.loss_reduction == "mean"
+                else None
+            )
             stage = lax.axis_index(self.pp_axis)
             perm_f = [(i, (i + 1) % n) for i in range(n)]
             perm_b = [(i, (i - 1) % n) for i in range(n)]
@@ -1175,7 +1251,8 @@ class SpmdGPipe:
 
             def mb_loss(y, p_post, p_loss, i):
                 return self._cell_mb_loss(
-                    y, p_post, p_loss, i, tgt_mb, post_base
+                    y, p_post, p_loss, i, tgt_mb, post_base,
+                    mask_mb=mask_mb, mean_scale=mean_scale,
                 )
 
             act_spec = jax.eval_shape(
@@ -1481,10 +1558,11 @@ class SpmdGPipe:
         if self._loss_is_layer:
             param_specs["loss"] = self._loss_spec
 
+        in_specs = (param_specs, data_spec, data_spec)
+        if masked:
+            in_specs += (self._mask_spec(),)
         if use_rng:
-            in_specs = (param_specs, data_spec, data_spec, P())
-        else:
-            in_specs = (param_specs, data_spec, data_spec)
+            in_specs += (P(),)
         mapped = _shard_map(
             local,
             self.mesh,
@@ -1493,7 +1571,9 @@ class SpmdGPipe:
         )
         return jax.jit(mapped)
 
-    def _build_train_step_interleaved(self, use_rng: bool):
+    def _build_train_step_interleaved(
+        self, use_rng: bool, masked: bool = False
+    ):
         """Training step under the interleaved-1F1B (virtual pipeline
         stages) schedule.
 
@@ -1533,7 +1613,15 @@ class SpmdGPipe:
         tmap = jax.tree_util.tree_map
         rows_xs = _interleaved_rows(tb)
 
-        def local(params, x_mb, tgt_mb, rng=None):
+        def local(params, x_mb, tgt_mb, *rest):
+            rest = list(rest)
+            mask_mb = rest.pop(0) if masked else None
+            rng = rest.pop(0) if use_rng else None
+            mean_scale = (
+                self._mask_mean_scale(mask_mb)
+                if masked and self.loss_reduction == "mean"
+                else None
+            )
             stage = lax.axis_index(self.pp_axis)
             perm_f = [(i, (i + 1) % n) for i in range(n)]
             perm_b = [(i, (i - 1) % n) for i in range(n)]
@@ -1577,7 +1665,8 @@ class SpmdGPipe:
 
             def mb_loss(y, p_post, p_loss, i):
                 return self._cell_mb_loss(
-                    y, p_post, p_loss, i, tgt_mb, post_base
+                    y, p_post, p_loss, i, tgt_mb, post_base,
+                    mask_mb=mask_mb, mean_scale=mean_scale,
                 )
 
             act_spec = jax.eval_shape(
@@ -1921,10 +2010,11 @@ class SpmdGPipe:
         if self._loss_is_layer:
             param_specs["loss"] = self._loss_spec
 
+        in_specs = (param_specs, data_spec, data_spec)
+        if masked:
+            in_specs += (self._mask_spec(),)
         if use_rng:
-            in_specs = (param_specs, data_spec, data_spec, P())
-        else:
-            in_specs = (param_specs, data_spec, data_spec)
+            in_specs += (P(),)
         mapped = _shard_map(
             local,
             self.mesh,
@@ -1933,15 +2023,31 @@ class SpmdGPipe:
         )
         return jax.jit(mapped)
 
-    def _build_train_step(self, use_rng: bool):
+    def _mask_spec(self):
+        """Spec for the [m, b] ragged-batch mask: batch dim over dp/ep
+        (like data), no sequence dim."""
+        batch_axes = tuple(
+            a for a in (self.dp_axis, self.ep_axis) if a is not None
+        )
+        return P(None, batch_axes if batch_axes else None)
+
+    def _build_train_step(self, use_rng: bool, masked: bool = False):
         if self.schedule == "1f1b":
-            return self._build_train_step_1f1b(use_rng)
+            return self._build_train_step_1f1b(use_rng, masked)
         if self.schedule == "interleaved":
-            return self._build_train_step_interleaved(use_rng)
+            return self._build_train_step_interleaved(use_rng, masked)
         n = self.n_stages
         data_spec = self._data_specs()
 
-        def local(params, x_mb, tgt_mb, rng=None):
+        def local(params, x_mb, tgt_mb, *rest):
+            rest = list(rest)
+            mask_mb = rest.pop(0) if masked else None
+            rng = rest.pop(0) if use_rng else None
+            mean_scale = (
+                self._mask_mean_scale(mask_mb)
+                if masked and self.loss_reduction == "mean"
+                else None
+            )
             stage = lax.axis_index(self.pp_axis)
 
             def loss_of(params):
@@ -1967,6 +2073,9 @@ class SpmdGPipe:
                 outs = self._outputs_from_ticks(ys)
                 gathered = microbatch.gather_stacked(outs)
                 tgt = microbatch.gather_stacked(tgt_mb)
+                mask_g = (
+                    microbatch.gather_stacked(mask_mb) if masked else None
+                )
                 B = jax.tree_util.tree_leaves(gathered)[0].shape[0]
                 post_rng = (
                     jax.random.fold_in(rng, 0x7FFFFFFE) if rng is not None else None
@@ -2014,6 +2123,20 @@ class SpmdGPipe:
                             my, _ = self.post.apply(
                                 params["post"], (), my, rng=post_rng, train=True
                             )
+                    if masked:
+                        # Masked per-row SUM over this stage's slice: the
+                        # n slices add to the lane total (no /n), and the
+                        # mean scale folds dp·ep/N_real in (pmeans divide
+                        # it back out to the exact global masked mean).
+                        mask_my = lax.dynamic_slice_in_dim(
+                            mask_g, stage * per, per, 0
+                        )
+                        l = self._masked_loss_sum(
+                            params.get("loss", ()), my, tgt_my, mask_my
+                        )
+                        if self.loss_reduction == "mean":
+                            l = l * mean_scale
+                        return l
                     l = self._loss_call(
                         params.get("loss", ()), my, tgt_my
                     )
@@ -2030,7 +2153,14 @@ class SpmdGPipe:
                         gathered, _ = self.post.apply(
                             params["post"], (), gathered, rng=post_rng, train=True
                         )
-                l = self._loss_call(params.get("loss", ()), gathered, tgt)
+                if masked:
+                    l = self._masked_loss_sum(
+                        params.get("loss", ()), gathered, tgt, mask_g
+                    )
+                    if self.loss_reduction == "mean":
+                        l = l * mean_scale
+                else:
+                    l = self._loss_call(params.get("loss", ()), gathered, tgt)
                 # LOCAL loss, nonzero only on the last stage.  Do NOT psum
                 # here: differentiating a replicated (psum'd) output would
                 # seed one cotangent per device and over-count gradients by
@@ -2070,10 +2200,11 @@ class SpmdGPipe:
         if self._loss_is_layer:
             param_specs["loss"] = self._loss_spec
 
+        in_specs = (param_specs, data_spec, data_spec)
+        if masked:
+            in_specs += (self._mask_spec(),)
         if use_rng:
-            in_specs = (param_specs, data_spec, data_spec, P())
-        else:
-            in_specs = (param_specs, data_spec, data_spec)
+            in_specs += (P(),)
         mapped = _shard_map(
             local,
             self.mesh,
@@ -2082,16 +2213,23 @@ class SpmdGPipe:
         )
         return jax.jit(mapped)
 
-    def _check_batch(self, x, target=None) -> None:
+    def _check_batch(self, x, target=None, *, ragged_ok=False) -> int:
+        """Validate batch/sequence divisibility; returns the number of
+        padding rows a ragged batch needs (0 when already divisible).
+        ``ragged_ok`` callers pad + mask instead of raising (reference
+        parity: indivisible batches, reference microbatch.py:143-158)."""
         dp = self.mesh.shape[self.dp_axis] if self.dp_axis else 1
         ep = self.mesh.shape[self.ep_axis] if self.ep_axis else 1
         b = microbatch.batch_size(x)
-        if b % (self.chunks * dp * ep) != 0:
+        pad = (-b) % (self.chunks * dp * ep)
+        if pad and not ragged_ok:
             raise ValueError(
                 f"batch size {b} must be divisible by chunks*dp*ep = "
-                f"{self.chunks}*{dp}*{ep} = {self.chunks * dp * ep} for the "
-                "SPMD engine (pad the batch, or use the MPMD GPipe engine "
-                "for ragged micro-batches)"
+                f"{self.chunks}*{dp}*{ep} = {self.chunks * dp * ep} here: "
+                "ragged batches need a row-decomposable loss to weight the "
+                "padding out — set loss_reduction='mean' or 'sum' (or use "
+                "the MPMD GPipe engine, whose scheduler runs ragged "
+                "micro-batches natively)"
             )
         if self.sp_axis:
             sp = self.mesh.shape[self.sp_axis]
@@ -2108,6 +2246,7 @@ class SpmdGPipe:
                             f"{self.sp_axis}={sp}; got {what} leaf shape "
                             f"{leaf.shape}"
                         )
+        return pad
 
     def _check_params(self, params) -> None:
         """Didactic validation of the params tree BEFORE it reaches
@@ -2149,23 +2288,52 @@ class SpmdGPipe:
     def train_step(self, params, x, target, rng=None):
         """One pipelined forward+backward; returns ``(loss, grads)``.
 
-        ``x``/``target`` are full mini-batches ``[B, ...]`` with
-        ``B % (chunks * dp * ep) == 0``.  Pass ``rng`` if any layer uses
+        ``x``/``target`` are full mini-batches ``[B, ...]``.  A ragged
+        ``B`` (not divisible by chunks·dp·ep) is accepted whenever the
+        loss is row-decomposable (``loss_reduction`` 'mean'/'sum'): the
+        batch is edge-padded to the next multiple and a mask weights the
+        padding out of the loss — and therefore out of every gradient
+        that flows from it — exactly (reference parity: indivisible
+        batches, reference microbatch.py:143-158 / reference
+        tests/test_gpipe.py:107-126).  Caveat: computation that couples
+        rows INSIDE the blocks still sees the duplicated padding rows —
+        a MoE balance injection (``MoEConfig.balance_weight > 0``) or
+        batch-normalization statistics average over the padded
+        micro-batch, so those auxiliary terms are mildly perturbed
+        (the task-loss gradients remain exact).  Pad to a divisible
+        batch yourself if the auxiliary terms must be padding-free.
+        Pass ``rng`` if any layer uses
         randomness (dropout raises loudly without it, matching the MPMD
         engine); omit it for deterministic models.
         """
         self._check_params(params)
-        self._check_batch(x, target)
+        pad = self._check_batch(
+            x, target, ragged_ok=self.loss_reduction is not None
+        )
         if self.fsdp:
             self._ensure_fsdp(params["blocks"])
         use_rng = rng is not None
-        if use_rng not in self._train_step_fns:
-            self._train_step_fns[use_rng] = self._build_train_step(use_rng)
+        key = (use_rng, bool(pad))
+        if key not in self._train_step_fns:
+            self._train_step_fns[key] = self._build_train_step(
+                use_rng, masked=bool(pad)
+            )
+        if pad:
+            b_real = microbatch.batch_size(x)
+            mask = jnp.concatenate(
+                [jnp.ones((b_real,), jnp.float32),
+                 jnp.zeros((pad,), jnp.float32)]
+            )
+            x = _pad_batch(x, pad)
+            target = _pad_batch(target, pad)
         x_mb = microbatch.scatter_stacked(x, self.chunks)
         tgt_mb = microbatch.scatter_stacked(target, self.chunks)
+        args = (params, x_mb, tgt_mb)
+        if pad:
+            args += (microbatch.scatter_stacked(mask, self.chunks),)
         if use_rng:
-            return self._train_step_fns[use_rng](params, x_mb, tgt_mb, rng)
-        return self._train_step_fns[use_rng](params, x_mb, tgt_mb)
+            args += (rng,)
+        return self._train_step_fns[key](*args)
 
     def _build_apply(self, with_loss: bool = False):
         n = self.n_stages
@@ -2432,10 +2600,12 @@ class SpmdGPipe:
         loss runs per-micro-batch INSIDE the mapped program, so full-batch
         logits are never gathered (matching the train path's memory
         discipline); ``loss_reduction=None`` falls back to the gathered
-        host-side computation."""
+        host-side computation.  Ragged batches take the gathered fallback
+        too (``apply`` pads/slices, then the loss sees exactly the real
+        rows) — exact, at full-batch-logit memory cost."""
         self._check_params(params)
-        self._check_batch(x, target)
-        if self.loss_reduction is None:
+        pad = self._check_batch(x, target, ragged_ok=True)
+        if self.loss_reduction is None or pad:
             out = self.apply(params, x)
             return self._loss_call(
                 params["loss"] if self._loss_is_layer else (), out, target,
@@ -2454,9 +2624,12 @@ class SpmdGPipe:
         return self._eval_fn(params, x_mb, tgt_mb)
 
     def apply(self, params, x):
-        """Pipelined inference forward; returns gathered outputs ``[B, ...]``."""
+        """Pipelined inference forward; returns gathered outputs
+        ``[B, ...]``.  Ragged batches are edge-padded through the pipeline
+        and the padding rows sliced off the gathered output — exact for
+        inference since no loss is involved."""
         self._check_params(params)
-        self._check_batch(x)
+        pad = self._check_batch(x, ragged_ok=True)
         if self.fsdp:
             self._ensure_fsdp(params["blocks"])
         if self._apply_fn is None:
@@ -2465,9 +2638,13 @@ class SpmdGPipe:
                 if self.schedule == "interleaved"
                 else self._build_apply()
             )
-        x_mb = microbatch.scatter_stacked(x, self.chunks)
+        b_real = microbatch.batch_size(x)
+        x_mb = microbatch.scatter_stacked(_pad_batch(x, pad), self.chunks)
         out_mb = self._apply_fn(params, x_mb)
-        return microbatch.gather_stacked(out_mb)
+        out = microbatch.gather_stacked(out_mb)
+        if pad:
+            out = jax.tree_util.tree_map(lambda a: a[:b_real], out)
+        return out
 
 
 def _zeros(spec):
