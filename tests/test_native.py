@@ -61,8 +61,39 @@ def test_blockpartition_errors():
         blockpartition.solve([1.0, 2.0], 3)
 
 
-def test_clock_cycles_native_matches_python():
+def test_clock_cycles_is_pure_python():
+    """The native clock_cycles enumerator was REMOVED in round 3: measured
+    slower than the Python comprehension at every grid size (ctypes
+    marshalling of the tuple list dominates — 45 ms native vs 6.5 ms
+    Python at m=4096, n=8).  The schedule itself is unchanged."""
+    assert not hasattr(_native, "clock_cycles_native")
     for m, n in [(1, 1), (4, 2), (2, 4), (8, 8), (32, 8)]:
-        native = _native.clock_cycles_native(m, n)
-        python = [list(c) for c in clock_cycles(m, n)]
-        assert native == python, (m, n)
+        cells = [c for cycle in clock_cycles(m, n) for c in cycle]
+        assert len(cells) == m * n
+        assert all(0 <= i < m and 0 <= j < n for i, j in cells)
+
+
+@pytest.mark.slow
+def test_blockpartition_native_is_faster_at_scale():
+    """The measured justification for keeping the native solver: at a
+    thousand-layer balance (the regime balance_by_time feeds it for deep
+    sequential models) the C++ DP is two orders of magnitude faster than
+    the Python DP (round-3 measurements: 867 ms vs 5.3 ms at n=1000, k=8;
+    93x already at the reference's 370-layer ResNet-101).  Asserted with a
+    5x margin to stay robust on loaded CI machines."""
+    import time
+
+    rs = np.random.RandomState(2)
+    costs = rs.rand(1000).tolist()
+    # Warm the library OUTSIDE the timed window: a cold run pays one-time
+    # g++ compilation + dlopen, which is not the solver's cost.
+    assert _native.get_lib() is not None
+    _native.blockpartition_sizes([1.0, 2.0], 2)
+    t0 = time.perf_counter()
+    native = _native.blockpartition_sizes(costs, 8)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    python = _python_solve_sizes(costs, 8)
+    t_python = time.perf_counter() - t0
+    assert native == python
+    assert t_native < t_python / 5, (t_native, t_python)

@@ -46,11 +46,6 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64),
     ]
-    lib.tgpu_clock_cycles.restype = ctypes.c_int64
-    lib.tgpu_clock_cycles.argtypes = [
-        ctypes.c_int64, ctypes.c_int64,
-        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-    ]
     return lib
 
 
@@ -92,23 +87,3 @@ def blockpartition_sizes(
         )
     return [int(v) for v in out]
 
-
-def clock_cycles_native(m: int, n: int) -> Optional[List[List[tuple]]]:
-    """Native fill-drain schedule enumeration; None if no native lib."""
-    lib = get_lib()
-    if lib is None:
-        return None
-    counts = (ctypes.c_int64 * (m + n - 1))()
-    cells = (ctypes.c_int64 * (2 * m * n))()
-    cycles = lib.tgpu_clock_cycles(m, n, counts, cells)
-    if cycles < 0:
-        raise ValueError("m and n must be positive")
-    out: List[List[tuple]] = []
-    w = 0
-    for t in range(cycles):
-        row = []
-        for _ in range(counts[t]):
-            row.append((int(cells[2 * w]), int(cells[2 * w + 1])))
-            w += 1
-        out.append(row)
-    return out

@@ -11,10 +11,6 @@
 //    torchgpipe_tpu/balance/blockpartition.py (first-best tie-breaking), so
 //    either implementation may serve a call.
 //
-//  * tgpu_clock_cycles — GPipe fill-drain schedule cell enumeration
-//    (reference: torchgpipe/pipeline.py:49-65), used by schedule-analysis
-//    tooling for large m*n grids.
-//
 // Build: g++ -O3 -shared -fPIC (driven by torchgpipe_tpu/_native/__init__.py,
 // cached next to the package; ctypes binding, no pybind11 dependency).
 
@@ -67,32 +63,6 @@ std::int64_t tgpu_blockpartition(const double* costs, std::int64_t n,
     j = i;
   }
   return 0;
-}
-
-// Enumerate the GPipe fill-drain schedule: for m micro-batches over n
-// stages there are m + n - 1 clock cycles; cycle t runs cells (i, j) with
-// i + j == t. Writes per-cycle cell counts into out_counts[m + n - 1] and
-// flattened (i, j) pairs into out_cells[2 * m * n]. Returns the number of
-// cycles, or -1 on invalid input.
-std::int64_t tgpu_clock_cycles(std::int64_t m, std::int64_t n,
-                               std::int64_t* out_counts,
-                               std::int64_t* out_cells) {
-  if (m < 1 || n < 1) return -1;
-  std::int64_t w = 0;
-  const std::int64_t cycles = m + n - 1;
-  for (std::int64_t t = 0; t < cycles; ++t) {
-    std::int64_t count = 0;
-    const std::int64_t j_lo = t - m + 1 > 0 ? t - m + 1 : 0;
-    const std::int64_t j_hi = t + 1 < n ? t + 1 : n;
-    for (std::int64_t j = j_lo; j < j_hi; ++j) {
-      out_cells[2 * w] = t - j;
-      out_cells[2 * w + 1] = j;
-      ++w;
-      ++count;
-    }
-    out_counts[t] = count;
-  }
-  return cycles;
 }
 
 }  // extern "C"

@@ -70,16 +70,11 @@ def clock_cycles(m: int, n: int):
 
     Reference: torchgpipe/pipeline.py:49-65.  Cycle ``k`` runs cells
     ``(i, j)`` with ``i + j == k``: micro-batch ``i`` on stage ``j``.
-    Large grids dispatch to the native enumerator
-    (:mod:`torchgpipe_tpu._native`); small ones stay in Python.
+    (A native enumerator existed through round 2 but measured SLOWER than
+    this comprehension at every m*n — ctypes marshalling of the tuple list
+    dominates — so it was removed; the native library keeps only the
+    block-partition solver, where the win is 90-175x measured.)
     """
-    if m * n >= 4096:
-        from torchgpipe_tpu import _native
-
-        native = _native.clock_cycles_native(m, n)
-        if native is not None:
-            yield from native
-            return
     for k in range(m + n - 1):
         yield [(k - j, j) for j in range(max(0, k - m + 1), min(k + 1, n))]
 
