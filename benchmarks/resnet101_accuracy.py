@@ -59,6 +59,14 @@ def _dataset(data_dir, n, image, classes, seed=0):
     return jnp.asarray(x), jnp.asarray(y)
 
 
+def _loss_with_logits(out, tgt):
+    """Loss with the training forward's logits on the aux channel, so
+    train-mode accuracy costs no extra forward pass.  Module-level (not a
+    per-step closure): the engine's jit cache keys on the loss_fn object,
+    and a fresh closure each step would force a re-trace every step."""
+    return softmax_xent(out, tgt), out
+
+
 @click.command()
 @click.argument("experiment", type=click.Choice(sorted(EXPERIMENTS)))
 @click.option("--epochs", default=3)
@@ -100,15 +108,8 @@ def main(experiment, epochs, data_dir, image, dataset_size, classes, lr,
             xb = jax.lax.dynamic_slice_in_dim(X, lo, batch, 0)
             yb = jax.lax.dynamic_slice_in_dim(Y, lo, batch, 0)
             key = jax.random.fold_in(rng, epoch * steps + step)
-
-            def loss_with_logits(out, tgt):
-                # aux channel: the training forward's logits ride back out
-                # of value_and_grad, so train-mode accuracy costs no extra
-                # forward pass.
-                return softmax_xent(out, tgt), out
-
             loss, grads, state, logits_tr = model.value_and_grad(
-                params, state, xb, yb, loss_with_logits, rng=key
+                params, state, xb, yb, _loss_with_logits, rng=key
             )
             params = tuple(
                 jax.tree_util.tree_map(

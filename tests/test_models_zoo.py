@@ -141,7 +141,7 @@ def test_resnet_cut_inside_block():
     # across stages through the skip layout (reference capability:
     # torchgpipe/skip/portal.py routing).
     layers = build_resnet([1, 1, 1, 1], num_classes=10, base_width=8)
-    x = jax.random.normal(jax.random.PRNGKey(5), (4, 32, 32, 3))
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 16, 16, 3))
     n = len(layers)
     # Deliberately odd split so stash/pop of some block straddle stages.
     balance = [7, n - 7]

@@ -273,6 +273,7 @@ def test_sparse_dispatch_matches_dense_under_ep(cpu_devices):
     _assert_trees_close(sparse_grads, dense_grads, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_sparse_dispatch_scales_to_realistic_shapes():
     """8k tokens x 64 experts (VERDICT: the dense [t, E, C] tensors would be
     ~670MB there).  The auto policy must pick the sparse path, the step must

@@ -68,9 +68,15 @@ def test_clock_cycles_is_pure_python():
     Python at m=4096, n=8).  The schedule itself is unchanged."""
     assert not hasattr(_native, "clock_cycles_native")
     for m, n in [(1, 1), (4, 2), (2, 4), (8, 8), (32, 8)]:
-        cells = [c for cycle in clock_cycles(m, n) for c in cycle]
-        assert len(cells) == m * n
+        cycles = [list(c) for c in clock_cycles(m, n)]
+        cells = [c for cycle in cycles for c in cycle]
+        assert len(cells) == m * n == len(set(cells))
         assert all(0 <= i < m and 0 <= j < n for i, j in cells)
+        # The fill-drain invariant itself: cycle t runs exactly the cells
+        # with i + j == t (micro-batch i enters stage j one tick after
+        # stage j-1 — the dependency order the schedule exists to encode).
+        for t, cycle in enumerate(cycles):
+            assert all(i + j == t for i, j in cycle), (m, n, t, cycle)
 
 
 @pytest.mark.slow

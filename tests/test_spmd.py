@@ -147,8 +147,8 @@ def test_spmd_with_dp(cpu_devices):
 
 
 def test_spmd_pre_post(cpu_devices):
-    n, dim = 4, 8
-    mesh = make_mesh(n, 2, devices=cpu_devices)
+    n, dim = 2, 8
+    mesh = make_mesh(n, 2, devices=cpu_devices[:4])
     block = make_block(dim)
     pre = dense(dim, name="embed")
     post = dense(3, name="head")
@@ -338,15 +338,15 @@ def test_eval_loss_with_sequence_parallelism(cpu_devices):
     [("fill_drain", {}), ("1f1b", {}), ("interleaved", {"virtual_stages": 2})],
 )
 def test_ragged_batch_matches_oracle(cpu_devices, schedule, kw):
-    """batch=10 with chunks=4: the engine edge-pads to 12 and masks the
+    """batch=9 with chunks=2: the engine edge-pads to 10 and masks the
     padding out; loss and grads must equal the un-pipelined model run on
-    exactly the 10 real rows — on every schedule."""
-    n, dim, B = 2, 8, 10
+    exactly the 9 real rows — on every schedule."""
+    n, dim, B = 2, 8, 9
     v = kw.get("virtual_stages", 1)
     mesh = make_mesh(n, 1, devices=cpu_devices[:2])
     block = make_block(dim)
     pipe = SpmdGPipe(
-        block, n, mesh, chunks=4, loss_fn=mse, loss_reduction="mean",
+        block, n, mesh, chunks=2, loss_fn=mse, loss_reduction="mean",
         checkpoint="except_last", schedule=schedule, **kw,
     )
     params = pipe.init(
@@ -391,18 +391,18 @@ def test_ragged_batch_matches_mpmd(cpu_devices):
 
     import dataclasses
 
-    n, dim, B = 2, 8, 10
+    n, dim, B = 2, 8, 9
     mesh = make_mesh(n, 1, devices=cpu_devices[:2])
     block = make_block(dim)
     pipe = SpmdGPipe(
-        block, n, mesh, chunks=4, loss_fn=mse, loss_reduction="mean",
+        block, n, mesh, chunks=2, loss_fn=mse, loss_reduction="mean",
     )
     x = jax.random.normal(jax.random.PRNGKey(1), (B, dim))
     tgt = jax.random.normal(jax.random.PRNGKey(2), (B, dim))
 
     mp = GPipe(
         [block, dataclasses.replace(block, name="block2")],
-        balance=[1, 1], chunks=4,
+        balance=[1, 1], chunks=2,
     )
     mp_params, mp_state = mp.init(
         jax.random.PRNGKey(0), jax.ShapeDtypeStruct((B, dim), jnp.float32)
@@ -493,16 +493,16 @@ def test_ragged_sizes_share_one_compiled_step(cpu_devices):
         jax.random.PRNGKey(0), jax.ShapeDtypeStruct((8, dim), jnp.float32)
     )
     losses = {}
-    for B in (9, 10, 11):
+    for B in (9, 11):
         x = jax.random.normal(jax.random.PRNGKey(1), (B, dim))
         t = jax.random.normal(jax.random.PRNGKey(2), (B, dim))
         losses[B], _ = pipe.train_step(params, x, t)
 
-    # One masked builder serves all three ragged sizes.
+    # One masked builder serves both ragged sizes (same padded bucket).
     assert len(pipe._train_step_fns) == 1
     # And each still matches its own oracle.
     block = make_block(dim)
-    for B in (9, 10, 11):
+    for B in (9, 11):
         x = jax.random.normal(jax.random.PRNGKey(1), (B, dim))
         t = jax.random.normal(jax.random.PRNGKey(2), (B, dim))
 

@@ -265,9 +265,11 @@ def test_1f1b_validation_errors():
 
     with pytest.raises(ValueError, match="decompose over"):
         SpmdGPipe(block, pp, mesh, schedule="1f1b", loss_reduction=None, **ok)
-    with pytest.raises(ValueError, match="supports checkpoint"):
+    # checkpoint='except_last' is ACCEPTED since round 3 (the reference's
+    # default mode); only a genuinely unknown mode rejects.
+    with pytest.raises(ValueError, match="'always'"):
         SpmdGPipe(
-            block, pp, mesh, schedule="1f1b", checkpoint="except_last", **ok
+            block, pp, mesh, schedule="1f1b", checkpoint="sometimes", **ok
         )
     with pytest.raises(ValueError, match="remat_policy"):
         SpmdGPipe(
