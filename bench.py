@@ -104,22 +104,20 @@ def _even_balance(n_layers: int, n_stages: int):
 
 
 def _build_amoebanet(platform: str, n_stages: int, batch: int | None = None,
-                     chunks: int | None = None, checkpoint: str = "except_last"):
+                     chunks: int | None = None, checkpoint: str = "except_last",
+                     fused: bool = False):
     from torchgpipe_tpu.gpipe import GPipe
     from torchgpipe_tpu.models.amoebanet import amoebanetd
 
     if platform != "cpu":
-        # Feasible sweet spot for the DEFAULT per-cell engine on a single
-        # v5e chip (15.75 GiB AOT limit): bf16 compute (f32 masters/BN
-        # stats), batch 64, 4 micro-batches, 'except_last' — measured 360
-        # samples/s in the round-1 sweep (BENCH_NOTES.md).  Batch 128's
-        # per-cell residuals (17.74 GiB measured by _rung_residual_bytes)
-        # can NEVER fit this chip on the per-cell path — the round-1 "442
-        # samples/s at batch 128" number was measured on the auto-fused
-        # whole-step engine, a path bench.py pins off (fused=False below),
-        # so the ladder starts at the honest per-cell top.  The remote chip
-        # is shared and free HBM varies run to run; main() retries down the
-        # ladder on RESOURCE_EXHAUSTED (memory-lighter modes further down).
+        # bf16 compute (f32 masters/BN stats).  Engine-path feasibility on
+        # a single v5e chip (15.75 GiB AOT limit): batch 128 fits only the
+        # whole-step FUSED engine (442 samples/s measured — no per-cell
+        # residual arguments); the per-cell default tops out at batch 64
+        # 'except_last' (8.99 GiB peeled-mb residuals by
+        # _rung_residual_bytes; 360 samples/s measured).  main()'s ladder
+        # encodes both, walking down on RESOURCE_EXHAUSTED — the remote
+        # chip is shared and free HBM varies run to run.
         num_layers, num_filters = 18, 256
         image = 224
         batch = 64 if batch is None else batch
@@ -131,17 +129,18 @@ def _build_amoebanet(platform: str, n_stages: int, batch: int | None = None,
         compute_dtype = None
     layers = amoebanetd(num_classes=1000, num_layers=num_layers,
                         num_filters=num_filters)
-    # fused=False pinned explicitly (also the library default): per-cell
-    # async dispatch measured 2x faster than whole-step fusion on the remote
-    # chip (65.9 vs 32.4 samples/s, 18-minute fused compile — BENCH_NOTES.md
-    # finding #1).
+    # Engine path per rung: the whole-step FUSED program loses at small
+    # batch (32.4 vs 65.9 samples/s, finding #1 in BENCH_NOTES.md) but is
+    # the only engine that can hold batch 128 on a 16 GB chip (no per-cell
+    # residual arguments) — where it measured 442 samples/s, the sweep's
+    # best overall.  The per-cell default serves the remaining rungs.
     model = GPipe(layers, balance=_even_balance(len(layers), n_stages),
                   chunks=chunks, checkpoint=checkpoint,
-                  compute_dtype=compute_dtype, fused=False)
+                  compute_dtype=compute_dtype, fused=fused)
     x = jnp.zeros((batch, image, image, 3), jnp.float32)
     y = jnp.zeros((batch,), jnp.int32)
     name = (f"amoebanetd-({num_layers},{num_filters})-pipeline{n_stages}"
-            f"-b{batch}m{chunks}-{checkpoint}")
+            f"-b{batch}m{chunks}-{checkpoint}-{'fused' if fused else 'percell'}")
     return model, x, y, name
 
 
@@ -263,31 +262,39 @@ def main() -> None:
         return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
 
     # The remote chip is shared: free HBM varies run to run.  Walk a
-    # (batch, chunks, checkpoint) ladder so the driver always gets a
-    # hardware number; the tag records the config that ran.  The top rung
-    # is the largest config the PER-CELL engine can hold by measured
-    # residual arithmetic (eval_shape over this exact model): per-cell
-    # peeled-mb residuals are 17.74 GiB at 128/4, 13.37 at 96/4, 8.99 at
-    # 64/4, 6.80 at 48/4, 4.61 at 32/4, vs the 15.75 GiB AOT limit minus
-    # ~2.4 GiB overhead.  So 64/4 'except_last' tops the ladder; the
-    # batch-128/96 rungs of rounds 1-2 are gone — they can never fit and
-    # burned a predictor pass every run (the old "442 sweet spot" was an
-    # auto-fused-engine number; see BENCH_NOTES.md round-3 attribution).
-    # No 'never' rung: that mode holds ALL chunks' residuals (chunks ×
-    # per-cell ≥ 18.4 GiB even at batch 32) — per-cell-infeasible at any
-    # rung worth timing.
+    # (batch, chunks, checkpoint, fused) ladder so the driver always gets
+    # a hardware number; the tag records the config that ran.  Rung 1 is
+    # the sweep's best overall: batch 128 on the whole-step FUSED engine
+    # (442 samples/s measured — the only engine that can hold 128, since
+    # it keeps no per-cell residual arguments; first-ever compile is slow
+    # through the remote tunnel but cached in .jax_cache afterwards).
+    # Rung 2 is the largest PER-CELL config by measured residual
+    # arithmetic (eval_shape over this exact model): peeled-mb residuals
+    # are 17.74 GiB at 128/4, 8.99 at 64/4, 6.80 at 48/4, 4.61 at 32/4,
+    # vs the 15.75 GiB AOT limit minus ~2.4 GiB overhead — so 64/4
+    # 'except_last' (360 samples/s measured).  No 'never' rung: that mode
+    # holds ALL chunks' residuals (≥ 18.4 GiB even at batch 32) —
+    # per-cell-infeasible at any rung worth timing.
     ladder = [
-        (64, 4, "except_last"),
-        (48, 4, "except_last"),
-        (32, 4, "except_last"),
-        (32, 4, "always"),
-    ] if platform != "cpu" else [(None, None, "except_last")]
+        (128, 4, "except_last", True),
+        (64, 4, "except_last", False),
+        (48, 4, "except_last", False),
+        (32, 4, "except_last", False),
+        (32, 4, "always", False),
+    ] if platform != "cpu" else [(None, None, "except_last", False)]
     last_oom = None
     used_fallback_model = False
     prev_500_msg = None
     skip_to_last = False
-    for batch_cfg, chunks_cfg, ckpt_cfg in ladder:
-        if skip_to_last and (batch_cfg, chunks_cfg, ckpt_cfg) != ladder[-1]:
+    for batch_cfg, chunks_cfg, ckpt_cfg, fused_cfg in ladder:
+        rung = (batch_cfg, chunks_cfg, ckpt_cfg, fused_cfg)
+        if skip_to_last and rung != ladder[-1]:
+            continue
+        if fused_cfg and n_stages > 1:
+            # The fused engine compiles the whole step into ONE program and
+            # requires all stages on one device (gpipe.py validation); on a
+            # multi-chip slice the per-cell rungs below pipeline across the
+            # chips instead.
             continue
         try:
             # (Re)built each rung INSIDE the try: after an OOM rung even an
@@ -298,7 +305,7 @@ def main() -> None:
             try:
                 model, x, y, name = _build_amoebanet(
                     platform, n_stages, batch=batch_cfg, chunks=chunks_cfg,
-                    checkpoint=ckpt_cfg,
+                    checkpoint=ckpt_cfg, fused=fused_cfg,
                 )
             except ImportError:
                 # The fallback ignores the ladder's batch/chunks, so
@@ -316,10 +323,13 @@ def main() -> None:
                 # runtime-OOM path's re-raise-on-last-rung): a
                 # miscalibrated predictor must not leave the loop with no
                 # rung ever run.
-                and (batch_cfg, chunks_cfg, ckpt_cfg) != ladder[-1]
-                # 'always' holds no cell residuals between programs —
-                # nothing for this predictor to predict.
+                and rung != ladder[-1]
+                # 'always' holds no cell residuals between programs, and
+                # the FUSED engine keeps residuals inside one program
+                # (XLA's scheduling, not program arguments) — nothing for
+                # this predictor to predict in either case.
                 and ckpt_cfg != "always"
+                and not fused_cfg
             ):
                 resid = _rung_residual_bytes(model, x)
                 # 'never' keeps EVERY micro-batch's residuals alive
@@ -402,7 +412,7 @@ def main() -> None:
             )
             if (
                 not is_oom
-                or (batch_cfg, chunks_cfg, ckpt_cfg) == ladder[-1]
+                or rung == ladder[-1]
                 or used_fallback_model
             ):
                 raise
