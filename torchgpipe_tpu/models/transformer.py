@@ -48,9 +48,15 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.float32  # bfloat16 for TPU benches
     # Sequence/context parallelism: name of the mesh axis the sequence is
-    # sharded over (ring attention + sp-offset rotary positions).  None =
-    # single-shard sequences.  See torchgpipe_tpu.parallel.ring_attention.
+    # sharded over (+ sp-offset rotary positions).  None = single-shard
+    # sequences.  See torchgpipe_tpu.parallel.ring_attention.
     sp_axis: Optional[str] = None
+    # How sp attention is computed: 'ring' (blockwise ring attention,
+    # O(s/sp) attention memory — extreme lengths) or 'ulysses' (all_to_all
+    # head swap, full-sequence local compute so the flash kernel applies —
+    # moderate lengths; needs head counts divisible by the sp size).  See
+    # torchgpipe_tpu.parallel.ulysses.
+    sp_impl: str = "ring"
     # Tensor parallelism: name of the mesh axis attention heads and MLP
     # hidden units are sharded over (Megatron-style; see
     # torchgpipe_tpu.parallel.tensor).  None = no weight sharding.  The tp
@@ -197,7 +203,8 @@ def transformer_block(
         # Under tp, lanes hold contiguous head ranges, so the local q→kv
         # pairing (h // r with r = nh_loc/nkv_loc = nh/nkv) matches global.
         attn = attention(
-            q, k, v, axis_name=cfg.sp_axis if sp_active else None, causal=True
+            q, k, v, axis_name=cfg.sp_axis if sp_active else None,
+            causal=True, impl=cfg.sp_impl,
         )
         attn_out = attn.reshape(b, s, nh_loc * hd) @ params["wo"]
         if tp_active:
@@ -233,6 +240,28 @@ def transformer_block(
                         f"{what}={count} is not divisible by the tp mesh "
                         f"axis size {size}; tensor parallelism shards whole "
                         "heads / hidden units across lanes"
+                    )
+        if (
+            cfg.sp_impl == "ulysses"
+            and cfg.sp_axis is not None
+            and cfg.sp_axis in mesh.axis_names
+        ):
+            # Ulysses shards HEADS during the attention compute; under tp
+            # the lanes already hold nh/tp heads, so the requirement is on
+            # the LOCAL head counts.
+            sp_size = mesh.shape[cfg.sp_axis]
+            tp_size = (
+                mesh.shape[tp] if tp is not None and tp in mesh.axis_names
+                else 1
+            )
+            for what, count in (("n_heads", nh), ("kv_heads", nkv)):
+                if (count // tp_size) % sp_size != 0:
+                    raise ValueError(
+                        f"sp_impl='ulysses' shards attention heads: local "
+                        f"{what} ({count}//tp={count // tp_size}) must be "
+                        f"divisible by the {cfg.sp_axis!r} axis size "
+                        f"({sp_size}); use sp_impl='ring' for this head "
+                        "count"
                     )
         if "validate_mesh" in mlp_meta:
             mlp_meta["validate_mesh"](mesh)

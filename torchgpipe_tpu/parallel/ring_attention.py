@@ -223,11 +223,17 @@ def attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     kv_block_size: int = 2048,
+    impl: str = "ring",
 ) -> jnp.ndarray:
-    """Dispatch: ring attention when a sequence-parallel axis is bound; on
-    TPU the Pallas flash-attention kernel when shapes meet its tiling
-    constraints (``TGPU_DISABLE_FLASH=1`` opts out); dense XLA attention
-    otherwise.  One call site serves every deployment shape."""
+    """Dispatch: sequence-parallel attention when an sp axis is bound —
+    ``impl='ring'`` (blockwise ring, O(s/sp) memory) or ``'ulysses'``
+    (all_to_all head swap, full-sequence local compute; see
+    :mod:`torchgpipe_tpu.parallel.ulysses`); on TPU the Pallas
+    flash-attention kernel when shapes meet its tiling constraints
+    (``TGPU_DISABLE_FLASH=1`` opts out); dense XLA attention otherwise.
+    One call site serves every deployment shape."""
+    if impl not in ("ring", "ulysses"):
+        raise ValueError("attention impl must be 'ring' or 'ulysses'")
     if not axis_bound(axis_name):
         import os
 
@@ -251,6 +257,12 @@ def attention(
                 default=dense,
             )
         return dense(q, k, v)
+    if impl == "ulysses":
+        from torchgpipe_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(
+            q, k, v, axis_name, causal=causal, sm_scale=sm_scale
+        )
     return ring_attention(
         q, k, v, axis_name, causal=causal, sm_scale=sm_scale,
         kv_block_size=kv_block_size,

@@ -1,0 +1,89 @@
+"""Ulysses-style sequence parallelism: all_to_all head/sequence swap.
+
+DeepSpeed-Ulysses (Jacobs et al., arXiv:2309.14509 — public technique,
+implemented here from the paper's math): with the sequence sharded over the
+``sp`` mesh axis, one ``all_to_all`` re-shards the attention inputs from
+sequence-split to HEAD-split, so every lane computes ordinary full-sequence
+attention for ``h/sp`` of the heads; a final ``all_to_all`` swaps the
+output back to sequence-split.  Communication is FOUR all_to_alls per
+attention call — q, k, v inbound (k/v at ``g`` kv heads, so
+O(b·s·(2h+2g)·d/sp) bytes total per lane) and the output back — riding
+ICI, independent of sequence length, vs the ring's ``sp - 1`` neighbor
+steps of K/V blocks — and the local compute is
+a plain dense/flash attention over the whole sequence, so the Pallas
+flash kernel applies as-is (the ring's blockwise online-softmax path
+cannot use it per-step).
+
+Trade-off vs ring attention (:mod:`torchgpipe_tpu.parallel.ring_attention`):
+Ulysses needs ``n_heads % sp == 0`` (it shards heads) and materializes the
+full-length sequence per lane during attention (memory O(s), not O(s/sp)),
+so the ring remains the choice for extreme lengths; Ulysses wins at
+moderate lengths where head count, not memory, is the binding constraint.
+Select per model with ``TransformerConfig(sp_impl="ulysses")``.
+
+The reference has no sequence parallelism of any kind (SURVEY.md §2.2
+lists ring/Ulysses as absent) — this module is TPU-native new capability.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _swap_to_heads(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """[b, s_loc, h, d] -> [b, s, h/sp, d]: shard heads, gather sequence."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _swap_to_seq(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """[b, s, h/sp, d] -> [b, s_loc, h, d]: gather heads, shard sequence."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Full-sequence attention over a sequence-sharded batch via two
+    all_to_alls.
+
+    ``q``: ``[b, s_loc, h, d]``; ``k``/``v``: ``[b, s_loc, g, d]`` with
+    ``g`` dividing ``h`` (GQA) — the same convention as
+    :func:`torchgpipe_tpu.parallel.ring_attention.ring_attention`.  Both
+    ``h`` and ``g`` must divide by the sp axis size: heads are what gets
+    sharded during the compute.  The head split is contiguous, so the
+    GQA pairing (query head ``i`` -> kv head ``i // (h/g)``) is preserved
+    lane-locally: lane ``l`` holds q heads ``[l·h/sp, (l+1)·h/sp)`` and kv
+    heads ``[l·g/sp, (l+1)·g/sp)``, and ``(l·h/sp + j) // (h/g)`` lands in
+    exactly that kv range.
+
+    Gradients flow through the all_to_alls' own transposes (an all_to_all
+    with split/concat swapped), so no custom vjp is needed.
+    """
+    sp = lax.psum(1, axis_name)
+    h, g = q.shape[2], k.shape[2]
+    if h % sp != 0 or g % sp != 0:
+        raise ValueError(
+            f"Ulysses sequence parallelism shards attention heads: n_heads "
+            f"({h}) and kv_heads ({g}) must both be divisible by the "
+            f"{axis_name!r} axis size ({sp}); use sp_impl='ring' (ring "
+            "attention shards the sequence, not heads) for this head count"
+        )
+    from torchgpipe_tpu.parallel.ring_attention import attention
+
+    qh = _swap_to_heads(q, axis_name)
+    kh = _swap_to_heads(k, axis_name)
+    vh = _swap_to_heads(v, axis_name)
+    # Local full-sequence attention on h/sp heads: the normal non-sp
+    # dispatch applies (Pallas flash kernel on TPU when shapes allow).
+    out = attention(qh, kh, vh, axis_name=None, causal=causal,
+                    sm_scale=sm_scale)
+    return _swap_to_seq(out, axis_name)
