@@ -186,7 +186,7 @@ def test_streaming_causal_skips_masked_fetches():
     for jk in range(nk):
         prev = None
         for jq in range(nq):
-            idx = int(_clamped_q_block(jk, jq, bq, bk, True))
+            idx = int(_clamped_q_block(jk, jq, bq, bk, True, nq))
             valid = _causal_overlap(jq, jk, bq, bk)
             tri_q += bool(valid)
             if valid:
@@ -199,7 +199,23 @@ def test_streaming_causal_skips_masked_fetches():
 
     # Non-causal: no clamping, every cell fetches its own block.
     assert int(_clamped_kv_block(0, 5, bq, bk, False)) == 5
-    assert int(_clamped_q_block(5, 0, bq, bk, False)) == 0
+    assert int(_clamped_q_block(5, 0, bq, bk, False, nq)) == 0
+
+    # Sliding window: the band clamps BOTH sides — per-row distinct
+    # fetches equal the band width in blocks, not the triangle.
+    w = 32  # 2 blocks
+    band = fetches_w = 0
+    for j in range(nq):
+        prev = None
+        for jk in range(nk):
+            idx = int(_clamped_kv_block(j, jk, bq, bk, True, w))
+            valid = bool(_causal_overlap(j, jk, bq, bk, w))
+            band += valid
+            if valid:
+                assert idx == jk
+            fetches_w += idx != prev
+            prev = idx
+    assert fetches_w == band < tri
 
 
 def test_streaming_causal_grads_with_uneven_blocks():
@@ -228,3 +244,63 @@ def test_streaming_causal_grads_with_uneven_blocks():
     for a, b_ in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("streaming", [False, True])
+@pytest.mark.parametrize("window", [16, 24, 64])
+def test_sliding_window_matches_dense(streaming, window):
+    """Sliding-window flash attention (both kernel families) vs the dense
+    masked oracle: values and gradients, including a window that is not a
+    block multiple (24) and one covering the whole sequence (64)."""
+    b, s, h, d = 1, 64, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(13), 4)
+    q = _rand(ks[0], (b, s, h, d))
+    k = _rand(ks[1], (b, s, h, d))
+    v = _rand(ks[2], (b, s, h, d))
+    cot = _rand(ks[3], (b, s, h, d))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, window=window,
+                            block_q=16, block_k=16, interpret=True,
+                            streaming=streaming) * cot
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            full_attention(q, k, v, causal=True, window=window) * cot
+        )
+
+    vf = loss_flash(q, k, v)
+    vr = loss_ref(q, k, v)
+    np.testing.assert_allclose(float(vf), float(vr), rtol=2e-4)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_sliding_window_gqa_uneven_blocks():
+    """window with GQA and block_q != block_k."""
+    b, s, h, g, d = 1, 64, 4, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(17), 3)
+    q = _rand(ks[0], (b, s, h, d))
+    k = _rand(ks[1], (b, s, g, d))
+    v = _rand(ks[2], (b, s, g, d))
+    ref = full_attention(q, k, v, causal=True, window=20)
+    out = flash_attention(q, k, v, causal=True, window=20, block_q=16,
+                          block_k=32, interpret=True, streaming=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sliding_window_validation():
+    b, s, h, d = 1, 32, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(19), 3)
+    q, k, v = (_rand(ks[i], (b, s, h, d)) for i in range(3))
+    with pytest.raises(ValueError, match="requires causal"):
+        flash_attention(q, k, v, causal=False, window=8, interpret=True)
+    from torchgpipe_tpu.parallel.ring_attention import attention
+    with pytest.raises(ValueError, match="requires causal"):
+        attention(q, k, v, causal=False, window=8)

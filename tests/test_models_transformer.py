@@ -112,3 +112,32 @@ def test_graft_dryrun(cpu_devices):
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_attn_window_changes_only_out_of_window_attention():
+    """TransformerConfig.attn_window: a window >= seq is exactly full
+    causal attention; a small window changes the output (sanity that the
+    flag reaches the attention call)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        transformer_block,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32))
+    outs = {}
+    for w in (None, 16, 4):
+        cfg = TransformerConfig(
+            vocab=64, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+            attn_window=w,
+        )
+        blk = transformer_block(cfg)
+        params, _ = blk.init(jax.random.PRNGKey(1), None)
+        outs[w], _ = blk.apply(params, (), x, rng=None, train=False)
+    np.testing.assert_allclose(
+        np.asarray(outs[None]), np.asarray(outs[16]), rtol=1e-6, atol=1e-6
+    )
+    assert float(jnp.max(jnp.abs(outs[None] - outs[4]))) > 1e-3

@@ -162,3 +162,71 @@ def test_ulysses_rejects_bad_impl():
     q, k, v = _qkv(jax.random.PRNGKey(4))
     with pytest.raises(ValueError, match="'ring' or 'ulysses'"):
         attention(q, k, v, impl="flash")
+
+
+def test_ulysses_sliding_window_matches_dense():
+    """window composes with Ulysses: the local full-sequence compute
+    windows exactly (the ring path rejects window — also asserted)."""
+    from torchgpipe_tpu.parallel.ring_attention import attention
+
+    q, k, v = _qkv(jax.random.PRNGKey(5))
+    ref = full_attention(q, k, v, causal=True, window=12)
+    mesh = _mesh()
+    shard = NamedSharding(mesh, P(None, "sp"))
+    fn = jax.jit(
+        jax.shard_map(
+            lambda a, b, c: ulysses_attention(
+                a, b, c, "sp", causal=True, window=12
+            ),
+            mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )
+    out = fn(jax.device_put(q, shard), jax.device_put(k, shard),
+             jax.device_put(v, shard))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+    # Ring + window is rejected with the didactic pointer to ulysses.
+    def ring_windowed(a, b, c):
+        return attention(a, b, c, axis_name="sp", causal=True, window=12)
+
+    with pytest.raises(ValueError, match="ulysses"):
+        jax.jit(
+            jax.shard_map(
+                ring_windowed, mesh=mesh,
+                in_specs=(P(None, "sp"),) * 3,
+                out_specs=P(None, "sp"), check_vma=False,
+            )
+        )(q, k, v)
+
+
+def test_window_ring_rejected_eagerly_at_engine_init(cpu_devices):
+    """attn_window + sp_impl='ring' + bound sp axis is statically invalid:
+    the engine's mesh validation rejects it at init (clean error), not
+    inside shard_map tracing."""
+    pp, sp = 2, 2
+    mesh = make_mesh(pp, 1, sp, devices=cpu_devices[:4])
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=pp, n_heads=4, n_kv_heads=2,
+        sp_axis="sp", sp_impl="ring", attn_window=8,
+    )
+    block, pre, post = llama_spmd(cfg, pp)
+    with pytest.raises(ValueError, match="attn_window does not compose"):
+        SpmdGPipe(
+            block, pp, mesh, chunks=2, loss_fn=cross_entropy,
+            pre=pre, post=post, sp_axis="sp",
+        )
+
+
+def test_window_zero_rejected_everywhere():
+    from torchgpipe_tpu.parallel.ring_attention import attention
+
+    q, k, v = _qkv(jax.random.PRNGKey(6))
+    with pytest.raises(ValueError, match=">= 1"):
+        full_attention(q, k, v, causal=True, window=0)
+    with pytest.raises(ValueError, match=">= 1"):
+        attention(q, k, v, causal=True, window=0)

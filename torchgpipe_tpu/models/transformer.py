@@ -57,6 +57,11 @@ class TransformerConfig:
     # moderate lengths; needs head counts divisible by the sp size).  See
     # torchgpipe_tpu.parallel.ulysses.
     sp_impl: str = "ring"
+    # Sliding-window (Mistral-style local) attention: attend iff
+    # 0 <= qpos - kpos < attn_window.  None = full causal attention.
+    # Composes with sp_impl='ulysses' (full-seq local compute windows
+    # exactly) but not the ring path.
+    attn_window: Optional[int] = None
     # Tensor parallelism: name of the mesh axis attention heads and MLP
     # hidden units are sharded over (Megatron-style; see
     # torchgpipe_tpu.parallel.tensor).  None = no weight sharding.  The tp
@@ -204,7 +209,7 @@ def transformer_block(
         # pairing (h // r with r = nh_loc/nkv_loc = nh/nkv) matches global.
         attn = attention(
             q, k, v, axis_name=cfg.sp_axis if sp_active else None,
-            causal=True, impl=cfg.sp_impl,
+            causal=True, impl=cfg.sp_impl, window=cfg.attn_window,
         )
         attn_out = attn.reshape(b, s, nh_loc * hd) @ params["wo"]
         if tp_active:
@@ -241,6 +246,21 @@ def transformer_block(
                         f"axis size {size}; tensor parallelism shards whole "
                         "heads / hidden units across lanes"
                     )
+        if (
+            cfg.attn_window is not None
+            and cfg.sp_impl == "ring"
+            and cfg.sp_axis is not None
+            and cfg.sp_axis in mesh.axis_names
+        ):
+            # Same statically-knowable class as the ulysses head check
+            # below: fail at engine init with the clean error, not inside
+            # shard_map tracing.
+            raise ValueError(
+                "attn_window does not compose with sp_impl='ring' (the "
+                "ring would need per-step band skipping); use "
+                "sp_impl='ulysses' — its local full-sequence attention "
+                "windows exactly — or drop the sp axis"
+            )
         if (
             cfg.sp_impl == "ulysses"
             and cfg.sp_axis is not None

@@ -55,13 +55,17 @@ def _kv_index(i, h: int, g: int):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
-                block_q, block_k, seq_k):
+                block_q, block_k, seq_k, window):
     j = pl.program_id(1)
     qb = q_ref[0].astype(jnp.float32) * sm_scale  # [Bq, d]
     nk = seq_k // block_k
+    jk0 = 0
     if causal:
-        # Only KV blocks overlapping the causal triangle of this Q block.
+        # Only KV blocks overlapping the causal triangle (banded by the
+        # sliding window when set) of this Q block.
         nk = lax.min(nk, lax.div((j + 1) * block_q + block_k - 1, block_k))
+        if window is not None:
+            jk0 = _first_valid_kv(j, block_q, block_k, window)
 
     def body(jb, carry):
         m, l, acc = carry
@@ -72,13 +76,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
             preferred_element_type=jnp.float32,
         )  # [Bq, Bk]
         if causal:
-            qpos = j * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = jb * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(qpos >= kpos, s, _NEG)
+            s = _mask_causal(s, j, jb, block_q, block_k, window)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
@@ -92,13 +90,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
     m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
-    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    m, l, acc = lax.fori_loop(jk0, nk, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l)  # [Bq, 1]
 
 
 def _flash_fwd_call(q, k, v, h, g, causal, sm_scale, block_q, block_k,
-                    interpret):
+                    interpret, window=None):
     bh, s, d = q.shape
     grid = (bh, s // block_q)
     kv_spec = pl.BlockSpec(
@@ -108,6 +106,7 @@ def _flash_fwd_call(q, k, v, h, g, causal, sm_scale, block_q, block_k,
         functools.partial(
             _fwd_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, seq_k=k.shape[1],
+            window=window,
         ),
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -138,9 +137,17 @@ def _flash_fwd_call(q, k, v, h, g, causal, sm_scale, block_q, block_k,
 # --------------------------------------------------------------------- #
 
 
-def _causal_overlap(jq, jk, block_q, block_k):
-    """Whether q block jq has any unmasked position against k block jk."""
-    return (jq + 1) * block_q - 1 >= jk * block_k
+def _causal_overlap(jq, jk, block_q, block_k, window=None):
+    """Whether q block jq has any unmasked position against k block jk
+    under causal masking, optionally banded by a sliding ``window``
+    (attend iff ``0 <= qpos - kpos < window``)."""
+    ok = (jq + 1) * block_q - 1 >= jk * block_k
+    if window is not None:
+        # Block-level band check: some (qpos, kpos) pair in the blocks has
+        # qpos - kpos < window, i.e. the SMALLEST difference in the pair of
+        # blocks (first q row vs last k col) is below the window.
+        ok = ok & (jq * block_q - ((jk + 1) * block_k - 1) < window)
+    return ok
 
 
 def _last_valid_kv(jq, block_q, block_k):
@@ -149,10 +156,28 @@ def _last_valid_kv(jq, block_q, block_k):
     return ((jq + 1) * block_q - 1) // block_k
 
 
+def _first_valid_kv(jq, block_q, block_k, window=None):
+    """Smallest K/V block index inside the sliding window for q block
+    ``jq`` (0 without a window)."""
+    if window is None:
+        return 0
+    lo = jq * block_q - (window - 1)  # kpos of the oldest visible key
+    return jnp.maximum(lo, 0) // block_k
+
+
 def _first_valid_q(jk, block_q, block_k):
     """Smallest q block index with any unmasked position against K/V
     block ``jk`` under causal masking."""
     return (jk * block_k) // block_q
+
+
+def _last_valid_q(jk, block_q, block_k, nq, window=None):
+    """Largest q block index inside the sliding window for K/V block
+    ``jk`` (``nq - 1`` without a window)."""
+    if window is None:
+        return nq - 1
+    hi = (jk + 1) * block_k - 1 + window - 1  # newest query seeing block jk
+    return jnp.minimum(hi // block_q, nq - 1)
 
 
 # Causal block-skipping for the streaming grids: the TPU grid is
@@ -165,29 +190,43 @@ def _first_valid_q(jk, block_q, block_k):
 # BENCH_NOTES round-2 table, 87.1 vs 64.8 ms @4k).
 
 
-def _clamped_kv_block(j, jk, block_q, block_k, causal):
-    """K/V block to FETCH at streaming grid cell (q block j, step jk)."""
+def _clamped_kv_block(j, jk, block_q, block_k, causal, window=None):
+    """K/V block to FETCH at streaming grid cell (q block j, step jk):
+    clipped into the valid causal/window band so masked cells re-request
+    a resident tile."""
     if not causal:
         return jk
-    return jnp.minimum(jk, _last_valid_kv(j, block_q, block_k))
+    return jnp.clip(
+        jk,
+        _first_valid_kv(j, block_q, block_k, window),
+        _last_valid_kv(j, block_q, block_k),
+    )
 
 
-def _clamped_q_block(jk, jq, block_q, block_k, causal):
+def _clamped_q_block(jk, jq, block_q, block_k, causal, nq, window=None):
     """Q block to FETCH at streaming dK/dV grid cell (kv block jk, step
-    jq)."""
+    jq), clipped into the valid causal/window band."""
     if not causal:
         return jq
-    return jnp.maximum(jq, _first_valid_q(jk, block_q, block_k))
+    return jnp.clip(
+        jq,
+        _first_valid_q(jk, block_q, block_k),
+        _last_valid_q(jk, block_q, block_k, nq, window),
+    )
 
 
-def _mask_causal(s, jq, jk, block_q, block_k):
+def _mask_causal(s, jq, jk, block_q, block_k, window=None):
     qpos = jq * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
     kpos = jk * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(qpos >= kpos, s, _NEG)
+    m = qpos >= kpos
+    if window is not None:
+        m = m & (qpos - kpos < window)
+    return jnp.where(m, s, _NEG)
 
 
 def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
-                       acc_sc, *, causal, sm_scale, block_q, block_k, nk):
+                       acc_sc, *, causal, sm_scale, block_q, block_k, nk,
+                       window):
     j = pl.program_id(1)
     jk = pl.program_id(2)
 
@@ -197,7 +236,10 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
         l_sc[...] = jnp.zeros_like(l_sc)
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    run = _causal_overlap(j, jk, block_q, block_k) if causal else jk >= 0
+    run = (
+        _causal_overlap(j, jk, block_q, block_k, window)
+        if causal else jk >= 0
+    )
 
     @pl.when(run)
     def _body():
@@ -209,7 +251,7 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
             preferred_element_type=jnp.float32,
         )
         if causal:
-            s = _mask_causal(s, j, jk, block_q, block_k)
+            s = _mask_causal(s, j, jk, block_q, block_k, window)
         m_prev = m_sc[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -228,20 +270,20 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
 
 
 def _flash_fwd_call_stream(q, k, v, h, g, causal, sm_scale, block_q,
-                           block_k, interpret):
+                           block_k, interpret, window=None):
     bh, s, d = q.shape
     sk = k.shape[1]
     nk = sk // block_k
     grid = (bh, s // block_q, nk)
     kv_im = lambda i, j, jk: (  # noqa: E731
         _kv_index(i, h, g),
-        _clamped_kv_block(j, jk, block_q, block_k, causal),
+        _clamped_kv_block(j, jk, block_q, block_k, causal, window),
         0,
     )
     o, lse = pl.pallas_call(
         functools.partial(
             _fwd_stream_kernel, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k, nk=nk,
+            block_q=block_q, block_k=block_k, nk=nk, window=window,
         ),
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -269,7 +311,7 @@ def _flash_fwd_call_stream(q, k, v, h, g, causal, sm_scale, block_q,
 
 def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, dq_sc, *, causal, sm_scale, block_q, block_k,
-                      nk):
+                      nk, window):
     j = pl.program_id(1)
     jk = pl.program_id(2)
 
@@ -277,7 +319,10 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    run = _causal_overlap(j, jk, block_q, block_k) if causal else jk >= 0
+    run = (
+        _causal_overlap(j, jk, block_q, block_k, window)
+        if causal else jk >= 0
+    )
 
     @pl.when(run)
     def _body():
@@ -290,7 +335,7 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal:
-            s = _mask_causal(s, j, jk, block_q, block_k)
+            s = _mask_causal(s, j, jk, block_q, block_k, window)
         p = jnp.exp(s - lse_ref[0])
         dp = lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
@@ -309,7 +354,7 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        dk_ref, dv_ref, dk_sc, dv_sc, *, causal, sm_scale,
-                       block_q, block_k, nq):
+                       block_q, block_k, nq, window):
     jk = pl.program_id(1)
     jq = pl.program_id(2)
 
@@ -318,7 +363,10 @@ def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    run = _causal_overlap(jq, jk, block_q, block_k) if causal else jq >= 0
+    run = (
+        _causal_overlap(jq, jk, block_q, block_k, window)
+        if causal else jq >= 0
+    )
 
     @pl.when(run)
     def _body():
@@ -331,7 +379,7 @@ def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal:
-            s = _mask_causal(s, jq, jk, block_q, block_k)
+            s = _mask_causal(s, jq, jk, block_q, block_k, window)
         p = jnp.exp(s - lse_ref[0])
         dv_sc[...] = dv_sc[...] + lax.dot_general(
             p, dob, (((0,), (0,)), ((), ())),
@@ -359,15 +407,18 @@ def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               causal, sm_scale, block_q, block_k, seq_k):
+               causal, sm_scale, block_q, block_k, seq_k, window):
     j = pl.program_id(1)
     qb = q_ref[0].astype(jnp.float32)
     dob = do_ref[0].astype(jnp.float32)
     lse_b = lse_ref[0]      # [Bq, 1]
     delta_b = delta_ref[0]  # [Bq, 1]
     nk = seq_k // block_k
+    jk0 = 0
     if causal:
         nk = lax.min(nk, lax.div((j + 1) * block_q + block_k - 1, block_k))
+        if window is not None:
+            jk0 = _first_valid_kv(j, block_q, block_k, window)
 
     def body(jb, dq):
         kb = k_ref[0, pl.ds(jb * block_k, block_k), :].astype(jnp.float32)
@@ -377,13 +428,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal:
-            qpos = j * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = jb * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(qpos >= kpos, s, _NEG)
+            s = _mask_causal(s, j, jb, block_q, block_k, window)
         p = jnp.exp(s - lse_b)  # [Bq, Bk]
         dp = lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
@@ -396,19 +441,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         )
 
     dq = lax.fori_loop(
-        0, nk, body, jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+        jk0, nk, body, jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
     )
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, causal, sm_scale, block_q, block_k,
-                seq_q):
+                seq_q, window):
     jk = pl.program_id(1)
     kb = k_ref[0].astype(jnp.float32)  # [Bk, d]
     vb = v_ref[0].astype(jnp.float32)
     nq = seq_q // block_q
     jq0 = lax.div(jk * block_k, block_q) if causal else 0
+    jq_hi = (
+        _last_valid_q(jk, block_q, block_k, nq, window) + 1
+        if causal else nq
+    )
 
     def body(jq, carry):
         dk, dv = carry
@@ -421,13 +470,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal:
-            qpos = jq * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = jk * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(qpos >= kpos, s, _NEG)
+            s = _mask_causal(s, jq, jk, block_q, block_k, window)
         p = jnp.exp(s - lse_b)  # [Bq, Bk]
         dv_new = dv + lax.dot_general(
             p, dob, (((0,), (0,)), ((), ())),
@@ -446,7 +489,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     d = k_ref.shape[-1]
     dk, dv = lax.fori_loop(
-        jq0, nq, body,
+        jq0, jq_hi, body,
         (jnp.zeros((block_k, d), jnp.float32),
          jnp.zeros((block_k, d), jnp.float32)),
     )
@@ -459,36 +502,42 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # --------------------------------------------------------------------- #
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, h, g, causal, sm_scale, blocks, interpret, streaming):
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
+)
+def _flash(q, k, v, h, g, causal, sm_scale, blocks, interpret, streaming,
+           window):
     fwd = _flash_fwd_call_stream if streaming else _flash_fwd_call
     o, _ = fwd(
-        q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret
+        q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret,
+        window,
     )
     return o
 
 
 def _flash_vjp_fwd(q, k, v, h, g, causal, sm_scale, blocks, interpret,
-                   streaming):
+                   streaming, window):
     fwd = _flash_fwd_call_stream if streaming else _flash_fwd_call
     o, lse = fwd(
-        q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret
+        q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret,
+        window,
     )
     return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(h, g, causal, sm_scale, blocks, interpret, streaming,
-                   res, do):
+                   window, res, do):
     if streaming:
         return _flash_bwd_stream(
-            h, g, causal, sm_scale, blocks, interpret, res, do
+            h, g, causal, sm_scale, blocks, interpret, res, do, window
         )
     return _flash_bwd_resident(
-        h, g, causal, sm_scale, blocks, interpret, res, do
+        h, g, causal, sm_scale, blocks, interpret, res, do, window
     )
 
 
-def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do):
+def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do,
+                      window=None):
     q, k, v, o, lse = res
     block_q, block_k = blocks
     bh, s, d = q.shape
@@ -506,7 +555,7 @@ def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do):
         (1, block_k, d),
         lambda i, j, jk: (
             _kv_index(i, h, g),
-            _clamped_kv_block(j, jk, block_q, block_k, causal),
+            _clamped_kv_block(j, jk, block_q, block_k, causal, window),
             0,
         ),
     )
@@ -514,6 +563,7 @@ def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do):
         functools.partial(
             _dq_stream_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, nk=sk // block_k,
+            window=window,
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=(bh, s // block_q, sk // block_k),
@@ -526,8 +576,9 @@ def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do):
     # dK/dV per QUERY head (expanded), summed over the group afterwards;
     # grid streams Q blocks on the trailing dimension (invalid steps sit
     # BEFORE the first diagonal block here, so the clamp is a max).
+    nq_s = s // block_q
     q_im = lambda i, jk, jq: (  # noqa: E731
-        i, _clamped_q_block(jk, jq, block_q, block_k, causal), 0
+        i, _clamped_q_block(jk, jq, block_q, block_k, causal, nq_s, window), 0
     )
     qrow3 = pl.BlockSpec((1, block_q, d), q_im)
     qrow2 = pl.BlockSpec((1, block_q, 1), q_im)
@@ -538,7 +589,7 @@ def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do):
     dk_exp, dv_exp = pl.pallas_call(
         functools.partial(
             _dkv_stream_kernel, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k, nq=s // block_q,
+            block_q=block_q, block_k=block_k, nq=nq_s, window=window,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
@@ -561,7 +612,8 @@ def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do):
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_bwd_resident(h, g, causal, sm_scale, blocks, interpret, res, do):
+def _flash_bwd_resident(h, g, causal, sm_scale, blocks, interpret, res, do,
+                        window=None):
     q, k, v, o, lse = res
     block_q, block_k = blocks
     bh, s, d = q.shape
@@ -582,7 +634,7 @@ def _flash_bwd_resident(h, g, causal, sm_scale, blocks, interpret, res, do):
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k, seq_k=sk,
+            block_q=block_q, block_k=block_k, seq_k=sk, window=window,
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=(bh, s // block_q),
@@ -602,7 +654,7 @@ def _flash_bwd_resident(h, g, causal, sm_scale, blocks, interpret, res, do):
     dk_exp, dv_exp = pl.pallas_call(
         functools.partial(
             _dkv_kernel, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k, seq_q=s,
+            block_q=block_q, block_k=block_k, seq_q=s, window=window,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
@@ -654,12 +706,20 @@ def flash_attention(
     block_k: int = 128,
     interpret: bool = False,
     streaming: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Fused flash attention.  ``q``: ``[b, s, h, d]``; ``k, v``:
     ``[b, s_k, g, d]`` with ``g`` dividing ``h`` (GQA).  Returns
     ``[b, s, h, d]`` in ``q.dtype``.  Requires ``d % 128 == 0`` and
     sequence lengths divisible by the block sizes (see :func:`supports`);
     ``interpret=True`` runs the kernels on any backend for testing.
+
+    ``window`` (requires ``causal``) is Mistral-style sliding-window
+    attention: attend iff ``0 <= qpos - kpos < window``.  Every kernel
+    variant skips blocks outside the band — the resident loops run
+    ``jk0..diagonal`` and the streaming grids clamp their index maps on
+    BOTH sides — so compute and HBM traffic scale with ``window``, not
+    sequence length.
 
     ``streaming`` selects the third-grid-dimension kernel variants whose
     per-program VMEM is O(block·d) — K/V (and, in the dK/dV kernel, Q/dO)
@@ -675,6 +735,13 @@ def flash_attention(
     b, s, h, d = q.shape
     g = k.shape[2]
     sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "window (sliding-window attention) requires causal=True"
+            )
+        if window < 1:
+            raise ValueError("window must be >= 1")
     if streaming is None:
         # K+V rows of one head resident in the non-streaming kernels, in
         # the input dtype (the per-block f32 cast is transient).
@@ -687,5 +754,6 @@ def flash_attention(
     o = _flash(
         qr, kr, vr, h, g, causal, sm_scale,
         (min(block_q, s), min(block_k, k.shape[1])), interpret, streaming,
+        window,
     )
     return jnp.transpose(o.reshape(b, h, s, d), (0, 2, 1, 3))

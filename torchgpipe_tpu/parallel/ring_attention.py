@@ -73,19 +73,32 @@ def full_attention(
     *,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Plain dense attention (single-device oracle / sp-disabled path).
 
     ``q``: ``[b, s, h, d]``; ``k, v``: ``[b, s, g, d]`` with ``g`` dividing
     ``h`` (grouped-query attention; ``g == h`` is plain MHA).  Returns
-    ``[b, s, h, d]``.
+    ``[b, s, h, d]``.  ``window`` (requires ``causal``) keeps only the
+    last ``window`` positions: attend iff ``0 <= qpos - kpos < window``
+    (Mistral-style sliding-window attention).
     """
     d = q.shape[-1]
     sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "window (sliding-window attention) requires causal=True"
+            )
+        if window < 1:
+            raise ValueError("window must be >= 1")
     s = _scores(q, k, sm_scale)
     if causal:
         sq, sk = q.shape[1], k.shape[1]
-        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        diff = jnp.arange(sq)[:, None] - jnp.arange(sk)[None, :]
+        mask = diff >= 0
+        if window is not None:
+            mask = mask & (diff < window)
         s = jnp.where(mask[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.transpose(
@@ -224,6 +237,7 @@ def attention(
     sm_scale: Optional[float] = None,
     kv_block_size: int = 2048,
     impl: str = "ring",
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Dispatch: sequence-parallel attention when an sp axis is bound —
     ``impl='ring'`` (blockwise ring, O(s/sp) memory) or ``'ulysses'``
@@ -234,13 +248,20 @@ def attention(
     One call site serves every deployment shape."""
     if impl not in ("ring", "ulysses"):
         raise ValueError("attention impl must be 'ring' or 'ulysses'")
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "window (sliding-window attention) requires causal=True"
+            )
+        if window < 1:
+            raise ValueError("window must be >= 1")
     if not axis_bound(axis_name):
         import os
 
         from torchgpipe_tpu.ops import flash_attention as _fa
 
         dense = lambda q, k, v: full_attention(  # noqa: E731
-            q, k, v, causal=causal, sm_scale=sm_scale
+            q, k, v, causal=causal, sm_scale=sm_scale, window=window
         )
         if (
             not os.environ.get("TGPU_DISABLE_FLASH")
@@ -252,7 +273,7 @@ def attention(
             return lax.platform_dependent(
                 q, k, v,
                 tpu=lambda q, k, v: _fa.flash_attention(
-                    q, k, v, causal=causal, sm_scale=sm_scale
+                    q, k, v, causal=causal, sm_scale=sm_scale, window=window
                 ),
                 default=dense,
             )
@@ -261,7 +282,15 @@ def attention(
         from torchgpipe_tpu.parallel.ulysses import ulysses_attention
 
         return ulysses_attention(
-            q, k, v, axis_name, causal=causal, sm_scale=sm_scale
+            q, k, v, axis_name, causal=causal, sm_scale=sm_scale,
+            window=window,
+        )
+    if window is not None:
+        raise ValueError(
+            "sliding-window attention does not compose with the ring sp "
+            "path yet (the ring would need per-step band skipping); use "
+            "sp_impl='ulysses' — its local full-sequence attention "
+            "windows exactly — or drop the sp axis"
         )
     return ring_attention(
         q, k, v, axis_name, causal=causal, sm_scale=sm_scale,

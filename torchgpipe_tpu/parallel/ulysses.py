@@ -51,6 +51,7 @@ def ulysses_attention(
     *,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Full-sequence attention over a sequence-sharded batch via two
     all_to_alls.
@@ -85,5 +86,5 @@ def ulysses_attention(
     # Local full-sequence attention on h/sp heads: the normal non-sp
     # dispatch applies (Pallas flash kernel on TPU when shapes allow).
     out = attention(qh, kh, vh, axis_name=None, causal=causal,
-                    sm_scale=sm_scale)
+                    sm_scale=sm_scale, window=window)
     return _swap_to_seq(out, axis_name)
