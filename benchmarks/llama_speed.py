@@ -111,9 +111,14 @@ def causal_lm_loss(out, tokens):
                    "loss layer (both engines): the [tokens, vocab] logits "
                    "are never materialized — the big-vocab memory fix "
                    "(needs --tp 1; dense model only on mpmd)")
+@click.option("--attn-window", default=None, type=int,
+              help="sliding-window attention: attend iff 0 <= qpos - kpos "
+                   "< N (Mistral-style); compute in the flash kernels "
+                   "scales with the window, not the sequence length")
 def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
          checkpoint, moe_experts, moe_top_k, ep, tp, dp, schedule,
-         virtual_stages, fsdp, moe_dispatch, moe_router, fused_ce):
+         virtual_stages, fsdp, moe_dispatch, moe_router, fused_ce,
+         attn_window):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     dim, n_layers, n_heads, n_kv, vocab, mlp_ratio = PRESETS[preset]
@@ -122,6 +127,7 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
         n_kv_heads=n_kv, mlp_ratio=mlp_ratio,
         dtype=jnp.bfloat16 if bf16 else jnp.float32,
         tp_axis="tp" if tp > 1 else None,
+        attn_window=attn_window,
     )
     if ep > 1 and engine != "spmd":
         raise click.UsageError(

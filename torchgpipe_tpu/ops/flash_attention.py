@@ -43,6 +43,18 @@ _NEG = -1e30
 _STREAM_BYTES = 4 * 1024 * 1024
 
 
+def _validate_window(causal: bool, window) -> None:
+    """Shared entry-point validation for sliding-window attention."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError(
+            "window (sliding-window attention) requires causal=True"
+        )
+    if window < 1:
+        raise ValueError("window must be >= 1")
+
+
 def _kv_index(i, h: int, g: int):
     """Row in the [b*g, s, d] K/V array for query row ``i`` of [b*h, s, d]."""
     r = h // g
@@ -574,8 +586,10 @@ def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do,
     )(*kernel_args)
 
     # dK/dV per QUERY head (expanded), summed over the group afterwards;
-    # grid streams Q blocks on the trailing dimension (invalid steps sit
-    # BEFORE the first diagonal block here, so the clamp is a max).
+    # grid streams Q blocks on the trailing dimension.  Invalid steps sit
+    # BEFORE the first diagonal block (plain causal) and, with a window,
+    # also AFTER the band's last q block — hence the two-sided clip in
+    # _clamped_q_block.
     nq_s = s // block_q
     q_im = lambda i, jk, jq: (  # noqa: E731
         i, _clamped_q_block(jk, jq, block_q, block_k, causal, nq_s, window), 0
@@ -716,10 +730,12 @@ def flash_attention(
 
     ``window`` (requires ``causal``) is Mistral-style sliding-window
     attention: attend iff ``0 <= qpos - kpos < window``.  Every kernel
-    variant skips blocks outside the band — the resident loops run
-    ``jk0..diagonal`` and the streaming grids clamp their index maps on
-    BOTH sides — so compute and HBM traffic scale with ``window``, not
-    sequence length.
+    variant skips COMPUTE for blocks outside the band (the resident
+    loops run ``jk0..diagonal``; the streaming grids clamp their index
+    maps on both sides).  HBM traffic scales with the window only in the
+    STREAMING variants — the resident kernels still stage the full K/V
+    row in VMEM per program — so prefer ``streaming=True`` for
+    long-sequence/small-window workloads.
 
     ``streaming`` selects the third-grid-dimension kernel variants whose
     per-program VMEM is O(block·d) — K/V (and, in the dK/dV kernel, Q/dO)
@@ -735,13 +751,7 @@ def flash_attention(
     b, s, h, d = q.shape
     g = k.shape[2]
     sm_scale = d ** -0.5 if sm_scale is None else sm_scale
-    if window is not None:
-        if not causal:
-            raise ValueError(
-                "window (sliding-window attention) requires causal=True"
-            )
-        if window < 1:
-            raise ValueError("window must be >= 1")
+    _validate_window(causal, window)
     if streaming is None:
         # K+V rows of one head resident in the non-streaming kernels, in
         # the input dtype (the per-block f32 cast is transient).

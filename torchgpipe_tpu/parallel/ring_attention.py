@@ -83,15 +83,11 @@ def full_attention(
     last ``window`` positions: attend iff ``0 <= qpos - kpos < window``
     (Mistral-style sliding-window attention).
     """
+    from torchgpipe_tpu.ops.flash_attention import _validate_window
+
     d = q.shape[-1]
     sm_scale = d ** -0.5 if sm_scale is None else sm_scale
-    if window is not None:
-        if not causal:
-            raise ValueError(
-                "window (sliding-window attention) requires causal=True"
-            )
-        if window < 1:
-            raise ValueError("window must be >= 1")
+    _validate_window(causal, window)
     s = _scores(q, k, sm_scale)
     if causal:
         sq, sk = q.shape[1], k.shape[1]
@@ -246,15 +242,11 @@ def attention(
     flash-attention kernel when shapes meet its tiling constraints
     (``TGPU_DISABLE_FLASH=1`` opts out); dense XLA attention otherwise.
     One call site serves every deployment shape."""
+    from torchgpipe_tpu.ops.flash_attention import _validate_window
+
     if impl not in ("ring", "ulysses"):
         raise ValueError("attention impl must be 'ring' or 'ulysses'")
-    if window is not None:
-        if not causal:
-            raise ValueError(
-                "window (sliding-window attention) requires causal=True"
-            )
-        if window < 1:
-            raise ValueError("window must be >= 1")
+    _validate_window(causal, window)
     if not axis_bound(axis_name):
         import os
 
