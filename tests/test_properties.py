@@ -253,3 +253,48 @@ def test_chunked_xent_equals_dense_over_shape_space(T, d, V, chunk):
     np.testing.assert_allclose(
         np.asarray(gw1), np.asarray(gw2), rtol=1e-4, atol=1e-5
     )
+
+
+@settings(deadline=None, max_examples=60)
+@given(
+    batch=st.integers(1, 40),
+    chunks=st.integers(1, 6),
+    dp=st.integers(1, 4),
+    data=st.data(),
+)
+def test_ragged_masked_mean_algebra(batch, chunks, dp, data):
+    """The SPMD engine's ragged-batch algebra as a pure function: edge-pad
+    to chunks*dp, scatter, per-(mb, lane) masked row-loss SUMS scaled by
+    dp/N_real, /chunks per mb, summed over mbs, pmean'd over lanes — must
+    equal the plain mean over the real rows, for every (B, chunks, dp).
+    Pins the bookkeeping in spmd._cell_mb_loss/_mask_mean_scale against
+    refactors without compiling an engine per example."""
+    q = chunks * dp
+    pad = (-batch) % q
+    rows = np.asarray(
+        data.draw(
+            st.lists(
+                st.floats(-100, 100, allow_nan=False),
+                min_size=batch, max_size=batch,
+            )
+        ),
+        np.float64,
+    )
+    padded = np.concatenate([rows, np.repeat(rows[-1:], pad)])  # edge pad
+    mask = np.concatenate([np.ones(batch), np.zeros(pad)])
+    b_mb = (batch + pad) // chunks
+    lane_w = b_mb // dp
+    n_real = mask.sum()
+    total = 0.0
+    for mb in range(chunks):
+        mb_rows = padded[mb * b_mb:(mb + 1) * b_mb]
+        mb_mask = mask[mb * b_mb:(mb + 1) * b_mb]
+        # per-lane masked sums with the engine's mean scale (dp*ep/N_real,
+        # ep=1 here), then the engine's /chunks, then the dp pmean.
+        lane_vals = []
+        for lane in range(dp):
+            sl = slice(lane * lane_w, (lane + 1) * lane_w)
+            s = float((mb_rows[sl] * mb_mask[sl]).sum())
+            lane_vals.append(s * (dp / n_real) * chunks / chunks)
+        total += float(np.mean(lane_vals))  # pmean over dp
+    np.testing.assert_allclose(total, rows.mean(), rtol=1e-12, atol=1e-9)

@@ -156,6 +156,7 @@ def simulate_pipeline(
     events: List[TimelineEvent],
     n_stages: int,
     schedule: str = "fill_drain",
+    virtual_stages: int = 1,
 ) -> Optional[Tuple[float, float, float]]:
     """Project measured per-cell times onto a pipeline schedule.
 
@@ -169,12 +170,30 @@ def simulate_pipeline(
     pipeline.py ``run_train_1f1b``) with no global barrier; an op starts
     when its stage is free AND its producer finished (fwd needs the
     upstream fwd; bwd needs the downstream bwd, or the same cell's fwd on
-    the last stage).  Returns ``(makespan_seconds, busy_fraction,
-    bubble_fraction)``; the bubble can be compared against the analytic
-    uniform-cell figure — the gap is stage imbalance.
+    the last stage).  For ``'interleaved'`` the measured stages are read
+    as the ``n_stages`` GLOBAL blocks of a virtual-stage layout: pass
+    ``virtual_stages=v`` and the projection lays block ``g`` on device
+    ``g % (n_stages//v)`` as chunk ``g // (n_stages//v)`` (the Megatron
+    wrap-around), answering "what would this measured run cost
+    interleaved on n/v devices?".  Returns ``(makespan_seconds,
+    busy_fraction, bubble_fraction)``; the bubble can be compared against
+    the analytic uniform-cell figure — the gap is stage imbalance.
     """
-    if schedule not in ("fill_drain", "1f1b"):
-        raise ValueError("schedule must be 'fill_drain' or '1f1b'")
+    if schedule not in ("fill_drain", "1f1b", "interleaved"):
+        raise ValueError(
+            "schedule must be 'fill_drain', '1f1b' or 'interleaved'"
+        )
+    if schedule == "interleaved":
+        if virtual_stages < 2:
+            raise ValueError("interleaved projection needs virtual_stages >= 2")
+        if n_stages % virtual_stages != 0:
+            raise ValueError(
+                f"n_stages ({n_stages}) must divide by virtual_stages "
+                f"({virtual_stages}): measured stages become the global "
+                "blocks of the virtual layout"
+            )
+    elif virtual_stages != 1:
+        raise ValueError("virtual_stages only applies to 'interleaved'")
     if not events:
         return None
     # A timeline spanning several training steps observes each (i, j) cell
@@ -192,6 +211,8 @@ def simulate_pipeline(
 
     if schedule == "1f1b":
         makespan = _simulate_1f1b(by_phase, n_stages)
+    elif schedule == "interleaved":
+        makespan = _simulate_interleaved(by_phase, n_stages, virtual_stages)
     elif schedule == "fill_drain":
         makespan = 0.0
         for cells in by_phase.values():
@@ -208,10 +229,87 @@ def simulate_pipeline(
             makespan += finish[m - 1][n - 1]
     if makespan is None or makespan <= 0:
         return None
+    # busy/bubble are per EXECUTION UNIT: devices (n/v of the measured
+    # global blocks) for the interleaved projection, stages otherwise.
+    units = (
+        n_stages // virtual_stages if schedule == "interleaved" else n_stages
+    )
     busy = sum(
         cell for cells in by_phase.values() for cell in cells.values()
-    ) / (n_stages * makespan)
+    ) / (units * makespan)
     return makespan, busy, 1.0 - busy
+
+
+def _simulate_interleaved(
+    by_phase: dict, n_blocks: int, v: int
+) -> Optional[float]:
+    """Dependency-driven completion times for the interleaved
+    (Megatron virtual pipeline stages) op order.
+
+    Measured cells ``(i, j)`` are read as micro-batch ``i`` on GLOBAL
+    block ``j``; the projection places block ``g = c·n + dev`` on device
+    ``dev`` as chunk ``c`` (n = n_blocks // v devices) and executes each
+    device's table order (:mod:`torchgpipe_tpu.parallel.interleaved`), an
+    op starting when its device is free AND its producer finished
+    (``_producer``: fwd g needs fwd g-1, bwd g needs bwd g+1, the last
+    block's bwd needs its own fwd)."""
+    from torchgpipe_tpu.parallel.interleaved import (
+        BWD,
+        FWD,
+        _cell_sequence,
+        _producer,
+    )
+
+    fwd = by_phase.get("fwd", {})
+    bwd = by_phase.get("bwd", {})
+    if not fwd:
+        return None
+    n = n_blocks // v
+    m = 1 + max(i for i, _ in fwd)
+    if m % n != 0:
+        # Same rule the engine enforces (interleaved._check_args /
+        # SpmdGPipe validation): Megatron's micro-batch grouping assumes
+        # full groups — raise the clear error rather than deadlocking on
+        # an inconsistent table into an indistinguishable None.
+        raise ValueError(
+            f"interleaved projection needs the measured micro-batch count "
+            f"({m}) divisible by the device count n_stages//virtual_stages "
+            f"({n})"
+        )
+    orders = [_cell_sequence(n, m, v, j) for j in range(n)]
+
+    def cell_time(kind, c, i, j):
+        g = c * n + j  # global block index = the measured stage index
+        return (fwd if kind == FWD else bwd).get((i, g), 0.0)
+
+    done: dict = {}  # (kind, c, i, j) -> finish time
+    pos = [0] * n
+    dev_free = [0.0] * n
+    total = sum(len(o) for o in orders)
+    scheduled = 0
+    while scheduled < total:
+        progressed = False
+        for j in range(n):
+            while pos[j] < len(orders[j]):
+                kind, c, i = orders[j][pos[j]]
+                dep = _producer(n, v, kind, c, i, j)
+                if dep is None and kind == BWD:
+                    # The last global block's backward consumes its own
+                    # forward (the loss seed).
+                    dep = (FWD, c, i, j)
+                if dep is not None and dep not in done:
+                    break
+                start = max(
+                    dev_free[j], done[dep] if dep is not None else 0.0
+                )
+                done[(kind, c, i, j)] = start + cell_time(kind, c, i, j)
+                dev_free[j] = done[(kind, c, i, j)]
+                pos[j] += 1
+                scheduled += 1
+                progressed = True
+        if not progressed:
+            return None  # deadlock — malformed input
+    return max(dev_free)
 
 
 def _simulate_1f1b(by_phase: dict, n: int) -> Optional[float]:
