@@ -790,3 +790,53 @@ def test_spmd_engine_with_dropless_moe(cpu_devices):
         l_drop, g_drop = run("dropless", 8.0, schedule)
         assert abs(float(l_dense) - float(l_drop)) < 1e-5, schedule
         _assert_trees_close(g_drop, g_dense, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_ragged_batch_composes_with_ep(cpu_devices):
+    """Ragged batch with ep=2 (the ep axis shards the batch like dp): the
+    masked-loss machinery's dp·ep scale and the expert all_to_alls must
+    still produce the exact loss over the real rows — compared against
+    the same engine on the padded-to-divisible batch restricted to real
+    rows via an ep=1 run."""
+    pp, ep, m = 2, 2, 2
+    cfg = _cfg(tp_axis=None)
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=8.0, ep_axis="ep")
+    block, pre, post = llama_moe_spmd(cfg, moe, pp)
+    B = 7  # q = chunks*ep = 4 -> pad 1
+    tokens = jnp.mod(jnp.arange(B * 8).reshape(B, 8), 64).astype(jnp.int32)
+    labels = jnp.mod(tokens + 1, 64)
+    spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+
+    mesh = make_mesh(pp, 1, ep=ep, devices=cpu_devices[: pp * ep])
+    eng = SpmdGPipe(
+        block, pp, mesh, chunks=m, loss_fn=cross_entropy,
+        pre=pre, post=post, ep_axis="ep",
+    )
+    params = eng.init(jax.random.PRNGKey(0), spec)
+    loss, grads = eng.train_step(params, tokens, labels)
+
+    # Oracle: the SAME model on a single-lane (no-ep) engine, which runs
+    # the ragged batch through the already-oracle-tested dp=1 masked path.
+    moe1 = MoEConfig(n_experts=4, top_k=2, capacity_factor=8.0)
+    block1, pre1, post1 = llama_moe_spmd(cfg, moe1, pp)
+    mesh1 = make_mesh(pp, 1, devices=cpu_devices[:pp])
+    eng1 = SpmdGPipe(
+        block1, pp, mesh1, chunks=m, loss_fn=cross_entropy,
+        pre=pre1, post=post1,
+    )
+    params1 = eng1.init(jax.random.PRNGKey(0), spec)
+    # The host-side init is layout-independent, so both engines hold the
+    # SAME weights (asserted via tree_map, which fails loudly on any
+    # structure mismatch) — the losses and gathered gradients must then
+    # agree exactly across ep=2 vs ep=1.
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        params,
+        params1,
+    )
+    loss1, grads1 = eng1.train_step(params1, tokens, labels)
+    assert abs(float(loss) - float(loss1)) < 1e-5
+    _assert_trees_close(grads, grads1, rtol=1e-4, atol=1e-5)
