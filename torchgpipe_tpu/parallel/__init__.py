@@ -17,3 +17,6 @@ from torchgpipe_tpu.parallel.ring_attention import (  # noqa: F401
     full_attention,
     ring_attention,
 )
+from torchgpipe_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+)
