@@ -282,6 +282,32 @@ def main() -> None:
         (32, 4, "except_last", False),
         (32, 4, "always", False),
     ] if platform != "cpu" else [(None, None, "except_last", False)]
+    # Manual hardware sessions: TGPU_BENCH_RUNG="batch,chunks,checkpoint,
+    # fused" pins the ladder to ONE config (e.g. "128,4,except_last,1" to
+    # time the fused headline rung directly, or "64,4,never,0" to probe a
+    # mode the ladder skips).  The driver never sets this.
+    rung_env = os.environ.get("TGPU_BENCH_RUNG")
+    if rung_env and platform != "cpu":
+        try:
+            b_s, c_s, k_s, f_s = [p.strip() for p in rung_env.split(",")]
+            pinned = (int(b_s), int(c_s), k_s, f_s in ("1", "true", "True"))
+        except ValueError as e:
+            raise SystemExit(
+                f"TGPU_BENCH_RUNG={rung_env!r} is malformed: expected "
+                "'batch,chunks,checkpoint,fused' e.g. '128,4,except_last,1'"
+            ) from e
+        if pinned[2] not in ("always", "except_last", "never"):
+            raise SystemExit(
+                f"TGPU_BENCH_RUNG checkpoint {pinned[2]!r} must be "
+                "always|except_last|never"
+            )
+        if pinned[3] and n_stages > 1:
+            raise SystemExit(
+                "TGPU_BENCH_RUNG pins a fused rung, but the fused engine "
+                f"requires all stages on one device (n_stages={n_stages}); "
+                "pin a per-cell rung or run single-chip"
+            )
+        ladder = [pinned]
     last_oom = None
     used_fallback_model = False
     prev_500_msg = None
