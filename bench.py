@@ -287,9 +287,20 @@ def main() -> None:
     # time the fused headline rung directly, or "64,4,never,0" to probe a
     # mode the ladder skips).  The driver never sets this.
     rung_env = os.environ.get("TGPU_BENCH_RUNG")
+    if rung_env and platform == "cpu":
+        import sys
+
+        print(
+            f"bench: TGPU_BENCH_RUNG={rung_env!r} ignored on the CPU "
+            "smoke/fallback path (the pin names a hardware config)",
+            file=sys.stderr,
+            flush=True,
+        )
     if rung_env and platform != "cpu":
         try:
             b_s, c_s, k_s, f_s = [p.strip() for p in rung_env.split(",")]
+            if f_s not in ("0", "1", "true", "false", "True", "False"):
+                raise ValueError(f"fused flag {f_s!r} must be 0|1|true|false")
             pinned = (int(b_s), int(c_s), k_s, f_s in ("1", "true", "True"))
         except ValueError as e:
             raise SystemExit(

@@ -322,6 +322,19 @@ def test_examples_quickstart():
     assert "[spmd] step 2" in r.stdout, r.stdout
 
 
+def test_examples_long_context():
+    """The long-context tour (ring / ulysses / ulysses+window on a pp x sp
+    mesh) runs end to end and its losses descend."""
+    repo = pathlib.Path(REPO)
+    env = cpu_subproc_env(XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, str(repo / "examples" / "long_context.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(repo),
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "long-context tour complete" in r.stdout, r.stdout
+
+
 def test_examples_multihost():
     """The multi-host example (two real processes, one global mesh,
     per-process data feeding, sharded checkpoint) runs end to end."""
