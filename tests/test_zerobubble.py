@@ -1,0 +1,112 @@
+"""Zero-bubble schedule tables: validity over the config space, the
+weighted-makespan win vs fused-backward 1F1B, and the memory bounds that
+make the split practical.  Engine-level oracles live in
+tests/test_spmd_zb.py.  No reference counterpart (fill-drain only,
+reference pipeline.py:49-65)."""
+
+import pytest
+
+from torchgpipe_tpu.parallel.zerobubble import (
+    B,
+    F,
+    IDLE,
+    W,
+    zero_bubble_tables,
+)
+
+
+def _fused_1f1b_weighted(n: int, m: int, t_f=1.0, t_bw=2.0) -> float:
+    """Exact lockstep cost of classic 1F1B with a FUSED backward (dx+dW
+    in one cell costing ``t_bw``), from the engine's closed-form tick
+    predicates (spmd.py _build_train_step_1f1b)."""
+    total = 0.0
+    for t in range(2 * (m + n - 1)):
+        c = 0.0
+        for j in range(n):
+            tj = t - j
+            warm = 0 <= tj <= n - 1 - j and tj < m
+            i_s = tj // 2 if tj >= 0 else 0
+            steady = tj >= 0 and tj % 2 == 0 and i_s > n - 1 - j and i_s < m
+            num = t + j - (2 * n - 1)
+            do_b = num >= 0 and num % 2 == 0 and num // 2 < m
+            if do_b:
+                c = max(c, t_bw)
+            elif warm or steady:
+                c = max(c, t_f)
+        total += c
+    return total
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 8])
+@pytest.mark.parametrize("m", [1, 2, 4, 7, 12])
+def test_tables_valid_over_config_space(n, m):
+    """The generator self-validates (each op exactly once, dependencies
+    strictly ordered, W after its own B, loss-seed ordering, collision-
+    free ring slots); survey the space and check basic shape."""
+    tb = zero_bubble_tables(n, m)
+    assert tb.kind.shape == (tb.ticks, n)
+    # Exactly m of each op kind per stage.
+    for j in range(n):
+        col = tb.kind[:, j]
+        assert (col == F).sum() == m
+        assert (col == B).sum() == m
+        assert (col == W).sum() == m
+
+
+@pytest.mark.parametrize("n,m", [(2, 4), (4, 8), (4, 12), (8, 16)])
+def test_weighted_makespan_beats_fused_1f1b(n, m):
+    """The schedule's reason to exist: with uniform per-op costs
+    (t_F = t_B = t_W = 1; the fused backward costs 2), the ZB lockstep
+    makespan is strictly below fused 1F1B's — the per-tick backward halves
+    and W work back-fills the drain bubble."""
+    tb = zero_bubble_tables(n, m)
+    zb = tb.weighted_makespan(1.0, 1.0, 1.0)
+    fused = _fused_1f1b_weighted(n, m)
+    # The documented band: >= 1.2x on every tested multi-stage config
+    # (measured 1.25-1.36 across this grid).
+    assert fused / zb >= 1.2, (n, m, zb, fused)
+
+
+def test_single_stage_parity():
+    tb = zero_bubble_tables(1, 3)
+    assert tb.weighted_makespan(1, 1, 1) == _fused_1f1b_weighted(1, 3)
+
+
+@pytest.mark.parametrize("n,m", [(2, 4), (4, 8), (8, 16), (4, 12)])
+def test_memory_bounds(n, m):
+    """The H1-style immediate-W placement keeps buffers in the 1F1B
+    window: residuals (live F -> W) within ~the pipeline depth, stored
+    cotangents (live B -> W) in ONE slot — NOT O(m)."""
+    tb = zero_bubble_tables(n, m)
+    pow2 = 1
+    while pow2 < n:
+        pow2 *= 2
+    assert tb.resid_slots <= 2 * pow2, (n, m, tb.resid_slots)
+    assert tb.dy_slots == 1, (n, m, tb.dy_slots)
+    assert tb.slots <= pow2, (n, m, tb.slots)
+
+
+def test_w_fills_drain_ticks():
+    """Early stages' drain tail must be W-filled: after stage 0's last B,
+    it still has W work — so the all-stages-idle tail is empty and stage
+    0's idle ticks do not grow with the drain."""
+    n, m = 4, 8
+    tb = zero_bubble_tables(n, m)
+    # After the last tick where ANY stage runs F or B, no tick should be
+    # fully idle (W's occupy the tail).
+    import numpy as np
+
+    last_fb = max(
+        t for t in range(tb.ticks)
+        if any(tb.kind[t, j] in (F, B) for j in range(n))
+    )
+    for t in range(last_fb):
+        assert any(tb.kind[t, j] != IDLE for j in range(n)), t
+    assert np.all(tb.kind[-1] != F)
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="need n, m >= 1"):
+        zero_bubble_tables(0, 4)
+    with pytest.raises(ValueError, match="need n, m >= 1"):
+        zero_bubble_tables(2, 0)

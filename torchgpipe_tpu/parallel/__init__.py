@@ -20,3 +20,7 @@ from torchgpipe_tpu.parallel.ring_attention import (  # noqa: F401
 from torchgpipe_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,
 )
+from torchgpipe_tpu.parallel.zerobubble import (  # noqa: F401
+    ZeroBubbleTables,
+    zero_bubble_tables,
+)

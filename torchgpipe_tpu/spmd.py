@@ -339,8 +339,13 @@ class SpmdGPipe:
         Requires ``dp_axis``; incompatible with ``ep_axis`` (expert leaves
         are already dp-style sharded over ep).
       schedule: 'fill_drain' (default; the reference's GPipe schedule),
-        '1f1b' (PipeDream-flush) or 'interleaved' (Megatron virtual
-        pipeline stages; see ``virtual_stages``).  1F1B interleaves each
+        '1f1b' (PipeDream-flush), 'interleaved' (Megatron virtual
+        pipeline stages; see ``virtual_stages``) or 'zb' (zero-bubble:
+        the backward splits into activation-gradient B cells and
+        weight-gradient W cells that back-fill bubble ticks — per-tick
+        backward work halves; requires ``checkpoint='never'``, whose
+        stored vjp residuals both halves replay; see
+        :mod:`torchgpipe_tpu.parallel.zerobubble`).  1F1B interleaves each
         micro-batch's backward with later micro-batches' forwards inside
         the same compiled scan, computing gradients explicitly per cell,
         so in-flight activations per stage are bounded by the pipeline
@@ -472,9 +477,10 @@ class SpmdGPipe:
                 "needs a batch-decomposable loss: set loss_reduction='mean' "
                 "or 'sum'"
             )
-        if self.schedule not in ("fill_drain", "1f1b", "interleaved"):
+        if self.schedule not in ("fill_drain", "1f1b", "interleaved", "zb"):
             raise ValueError(
-                "schedule must be 'fill_drain', '1f1b' or 'interleaved'"
+                "schedule must be 'fill_drain', '1f1b', 'interleaved' "
+                "or 'zb'"
             )
         if self.schedule == "interleaved":
             if self.virtual_stages < 2:
@@ -494,7 +500,16 @@ class SpmdGPipe:
             raise ValueError(
                 "virtual_stages only applies to schedule='interleaved'"
             )
-        if self.schedule in ("1f1b", "interleaved"):
+        if self.schedule == "zb" and self.checkpoint != "never":
+            raise ValueError(
+                "schedule='zb' requires checkpoint='never': the B/W "
+                "backward split replays stored vjp residuals twice (dx in "
+                "the B cell, weight grads in the W cell) — recompute modes "
+                "would re-run the forward in both halves.  Use "
+                "schedule='1f1b' for checkpoint="
+                f"{self.checkpoint!r}"
+            )
+        if self.schedule in ("1f1b", "interleaved", "zb"):
             sched = f"schedule={self.schedule!r}"
             if self.loss_reduction is None:
                 raise ValueError(
@@ -1571,6 +1586,309 @@ class SpmdGPipe:
         )
         return jax.jit(mapped)
 
+    def _build_train_step_zb(self, use_rng: bool, masked: bool = False):
+        """Training step under the zero-bubble (ZB-H1-style) schedule.
+
+        The backward splits into B cells (activation gradient dx only —
+        the critical path the downstream stage waits on) and W cells
+        (weight gradients d_blk/d_pre — consumed only at step end), per
+        the static tables of :mod:`torchgpipe_tpu.parallel.zerobubble`.
+        Both halves replay the SAME stored-vjp residuals the forward cell
+        banked (the checkpoint='never' machinery); each half uses only
+        its own outputs of the rebuilt vjp closure, so XLA dead-code-
+        eliminates the other half's matmuls — per-tick backward work
+        drops from dx+dW to max(dx, dW), and early stages' drain ticks
+        run W work instead of idling (weighted-makespan win proven at the
+        table level, tests/test_zerobubble.py).  Requires
+        ``checkpoint='never'``: the split exists BECAUSE residuals are
+        stored once and replayed twice.
+
+        No reference counterpart at any level (the reference has
+        fill-drain only; ZB is Qi et al. arXiv:2401.10241 — public
+        technique, scheduled here with our own lockstep generator).
+        """
+        from torchgpipe_tpu.parallel.zerobubble import (
+            B as ZB_B,
+            F as ZB_F,
+            W as ZB_W,
+            zero_bubble_tables,
+        )
+
+        n, m = self.n_stages, self.chunks
+        tb = zero_bubble_tables(n, m)
+        S, Sy, Dr, Dy = tb.slots, tb.y_slots, tb.resid_slots, tb.dy_slots
+        data_spec = self._data_specs()
+        tmap = jax.tree_util.tree_map
+        # Scan xs: this tick's (kind, mb) row plus the PREVIOUS tick's row
+        # (receive classification reads the sender's last action).
+        idle_row = jnp.full((1, n), 3, jnp.int32)  # IDLE
+        kind_rows = jnp.asarray(tb.kind)
+        mb_rows = jnp.asarray(tb.mb)
+        rows_xs = (
+            kind_rows,
+            mb_rows,
+            jnp.concatenate([idle_row, kind_rows[:-1]]),
+            jnp.concatenate([jnp.zeros((1, n), jnp.int32), mb_rows[:-1]]),
+        )
+
+        def local(params, x_mb, tgt_mb, *rest):
+            rest = list(rest)
+            mask_mb = rest.pop(0) if masked else None
+            rng = rest.pop(0) if use_rng else None
+            mean_scale = (
+                self._mask_mean_scale(mask_mb)
+                if masked and self.loss_reduction == "mean"
+                else None
+            )
+            stage = lax.axis_index(self.pp_axis)
+            perm_f = [(i, (i + 1) % n) for i in range(n)]
+            perm_b = [(i, (i - 1) % n) for i in range(n)]
+
+            blocks_in = (
+                self._gather_fsdp(params["blocks"])
+                if self.fsdp
+                else params["blocks"]
+            )
+            params_local = tmap(lambda a: a[0], blocks_in)
+            pre_params = params["pre"] if self.pre is not None else ()
+            post_params = params["post"] if self.post is not None else ()
+            loss_params = params["loss"] if self._loss_is_layer else ()
+            pre_base = (
+                jax.random.fold_in(rng, 0x7FFFFFFF) if rng is not None else None
+            )
+            post_base = (
+                jax.random.fold_in(rng, 0x7FFFFFFE) if rng is not None else None
+            )
+            aux_s = 1.0 / m
+
+            def cell_key(i):
+                if rng is None:
+                    return None
+                return jax.random.fold_in(
+                    jax.random.fold_in(rng, i + stage), stage
+                )
+
+            def stage_input(p_pre, i, fallback):
+                return self._cell_input_splice(
+                    p_pre, stage == 0, i, fallback, x_mb, pre_base
+                )
+
+            def mb_loss(y, p_post, p_loss, i):
+                return self._cell_mb_loss(
+                    y, p_post, p_loss, i, tgt_mb, post_base,
+                    mask_mb=mask_mb, mean_scale=mean_scale,
+                )
+
+            act_spec = jax.eval_shape(
+                lambda p, x: self._block_fn_plain(p, x, None, aux_s, False),
+                params_local,
+                tmap(lambda a: jnp.zeros(a.shape[1:], a.dtype), x_mb)
+                if self.pre is None
+                else jax.eval_shape(
+                    lambda p, x: self.pre.apply(p, (), x, rng=None, train=False)[0],
+                    pre_params,
+                    tmap(lambda a: jnp.zeros(a.shape[1:], a.dtype), x_mb),
+                ),
+            )
+            act0 = tmap(lambda s: jnp.zeros(s.shape, s.dtype), act_spec)
+
+            def cell_fn(p_blk, p_pre, x, i):
+                xin = stage_input(p_pre, i, x)
+                return self._block_fn_plain(
+                    p_blk, xin, cell_key(i), aux_s, True
+                )
+
+            vjp_tdef, vjp_leaf_specs, passthrough, buffered_idx = (
+                _never_mode_spec(
+                    lambda p, pp_, x: jax.vjp(
+                        lambda a, b, c: cell_fn(a, b, c, jnp.int32(0)),
+                        p, pp_, x,
+                    )[1],
+                    (params_local, pre_params),
+                    act0,
+                )
+            )
+            param_flat = jax.tree_util.tree_leaves(
+                (params_local, pre_params)
+            )
+
+            def ring(depth):
+                return tmap(
+                    lambda s: jnp.zeros((depth,) + s.shape, s.dtype), act_spec
+                )
+
+            carry0 = dict(
+                act=act0,
+                gact=act0,
+                inbox=ring(S),
+                gbox=ring(S),
+                ybox=ring(Sy),
+                dybuf=ring(Dy),
+                rbuf=tuple(
+                    jnp.zeros(
+                        (Dr,) + vjp_leaf_specs[i].shape,
+                        vjp_leaf_specs[i].dtype,
+                    )
+                    for i in buffered_idx
+                ),
+                gblk=tmap(jnp.zeros_like, params_local),
+                gpre=tmap(jnp.zeros_like, pre_params),
+                gpost=tmap(jnp.zeros_like, post_params),
+                gloss=tmap(jnp.zeros_like, loss_params),
+                loss=jnp.float32(0.0),
+            )
+
+            def rebuild(c, i):
+                return _never_rebuild(
+                    vjp_tdef,
+                    vjp_leaf_specs,
+                    passthrough,
+                    iter(
+                        lax.dynamic_index_in_dim(
+                            b, i % Dr, 0, keepdims=False
+                        )
+                        for b in c["rbuf"]
+                    ),
+                    param_flat,
+                )
+
+            def tick(carry, rows):
+                krow, irow, pkrow, pirow = rows
+                recv_f = tmap(
+                    lambda a: lax.ppermute(a, self.pp_axis, perm_f),
+                    carry["act"],
+                )
+                recv_b = tmap(
+                    lambda a: lax.ppermute(a, self.pp_axis, perm_b),
+                    carry["gact"],
+                )
+                # File incoming values by the SENDER's previous-tick row.
+                src_f = jnp.mod(stage - 1, n)
+                valid_f = (pkrow[src_f] == ZB_F) & (stage > 0)
+                inbox = _slot_write(
+                    carry["inbox"], pirow[src_f] % S, recv_f, valid_f
+                )
+                src_b = jnp.mod(stage + 1, n)
+                valid_b = (pkrow[src_b] == ZB_B) & (stage < n - 1)
+                gbox = _slot_write(
+                    carry["gbox"], pirow[src_b] % S, recv_b, valid_b
+                )
+                carry = dict(carry, inbox=inbox, gbox=gbox)
+
+                k = krow[stage]
+                i = irow[stage]
+
+                def f_branch(c):
+                    y, vjp_fn = jax.vjp(
+                        lambda a, b, xx: cell_fn(a, b, xx, i),
+                        params_local, pre_params,
+                        _slot_read(c["inbox"], i % S),
+                    )
+                    leaves = jax.tree_util.tree_leaves(vjp_fn)
+                    _never_check_leaves(leaves, vjp_leaf_specs, "zb")
+                    rbuf = tuple(
+                        lax.dynamic_update_index_in_dim(
+                            b, leaves[i2], i % Dr, 0
+                        )
+                        for b, i2 in zip(c["rbuf"], buffered_idx)
+                    )
+                    ybox = _slot_write(c["ybox"], i % Sy, y, stage == n - 1)
+                    return dict(c, act=y, rbuf=rbuf, ybox=ybox)
+
+                def b_branch(c):
+                    vjp_cell = rebuild(c, i)
+
+                    def last_fn():
+                        y_saved = _slot_read(c["ybox"], i % Sy)
+
+                        def tail(p_post, p_loss, yy):
+                            return mb_loss(yy, p_post, p_loss, i)
+
+                        loss_i, (d_post, d_loss, dy) = (
+                            jax.value_and_grad(tail, argnums=(0, 1, 2))(
+                                post_params, loss_params, y_saved
+                            )
+                        )
+                        return loss_i, d_post, d_loss, dy
+
+                    def mid_fn():
+                        return (
+                            jnp.float32(0.0),
+                            tmap(jnp.zeros_like, post_params),
+                            tmap(jnp.zeros_like, loss_params),
+                            _slot_read(c["gbox"], i % S),
+                        )
+
+                    loss_i, d_post, d_loss, dy = lax.cond(
+                        stage == n - 1, last_fn, mid_fn
+                    )
+                    # dx ONLY: the d_blk/d_pre outputs are unused in this
+                    # branch, so their matmuls are dead code here.
+                    _, _, dx = vjp_cell(dy)
+                    return dict(
+                        c,
+                        gact=dx,
+                        dybuf=_slot_write(c["dybuf"], i % Dy, dy, True),
+                        gpost=tmap(jnp.add, c["gpost"], d_post),
+                        gloss=tmap(jnp.add, c["gloss"], d_loss),
+                        loss=c["loss"] + loss_i,
+                    )
+
+                def w_branch(c):
+                    vjp_cell = rebuild(c, i)
+                    dy = _slot_read(c["dybuf"], i % Dy)
+                    # d_blk/d_pre ONLY: dx's matmul is dead code here.
+                    d_blk, d_pre, _ = vjp_cell(dy)
+                    return dict(
+                        c,
+                        gblk=tmap(jnp.add, c["gblk"], d_blk),
+                        gpre=tmap(jnp.add, c["gpre"], d_pre),
+                    )
+
+                sel = jnp.where(
+                    k == ZB_F, 0, jnp.where(k == ZB_B, 1, jnp.where(k == ZB_W, 2, 3))
+                )
+                carry = lax.switch(
+                    sel, [f_branch, b_branch, w_branch, lambda c: c], carry
+                )
+                return carry, ()
+
+            carry, _ = lax.scan(tick, carry0, rows_xs)
+            loss = lax.psum(carry["loss"], self.pp_axis)
+            grads = {"blocks": tmap(lambda g: g[None], carry["gblk"])}
+            if self.pre is not None:
+                grads["pre"] = lax.psum(carry["gpre"], self.pp_axis)
+            if self.post is not None:
+                grads["post"] = lax.psum(carry["gpost"], self.pp_axis)
+            if self._loss_is_layer:
+                grads["loss"] = lax.psum(carry["gloss"], self.pp_axis)
+            loss, grads = self._reduce_dp(loss, grads, scatter_blocks=True)
+            loss, grads = self._reduce_ep(loss, grads)
+            return loss, grads
+
+        param_specs = {
+            "blocks": self._fsdp_specs if self.fsdp else self._blocks_spec
+        }
+        if self.pre is not None:
+            param_specs["pre"] = self._pre_spec
+        if self.post is not None:
+            param_specs["post"] = self._post_spec
+        if self._loss_is_layer:
+            param_specs["loss"] = self._loss_spec
+
+        in_specs = (param_specs, data_spec, data_spec)
+        if masked:
+            in_specs += (self._mask_spec(),)
+        if use_rng:
+            in_specs += (P(),)
+        mapped = _shard_map(
+            local,
+            self.mesh,
+            in_specs=in_specs,
+            out_specs=(P(), param_specs),
+        )
+        return jax.jit(mapped)
+
     def _build_train_step_interleaved(
         self, use_rng: bool, masked: bool = False
     ):
@@ -2036,6 +2354,8 @@ class SpmdGPipe:
             return self._build_train_step_1f1b(use_rng, masked)
         if self.schedule == "interleaved":
             return self._build_train_step_interleaved(use_rng, masked)
+        if self.schedule == "zb":
+            return self._build_train_step_zb(use_rng, masked)
         n = self.n_stages
         data_spec = self._data_specs()
 
