@@ -539,3 +539,22 @@ def test_simulate_pipeline_interleaved_rejects_partial_groups():
     ]
     with pytest.raises(ValueError, match="divisible by the device count"):
         simulate_pipeline(events, 8, schedule="interleaved", virtual_stages=2)
+
+
+def test_simulate_pipeline_zb_uniform_cells():
+    """Uniform cells, zb projection (fused bwd split into two halves):
+    must beat the fused-backward 1F1B projection of the same timeline and
+    respect the per-stage work floor (m fwd + m bwd per stage)."""
+    from torchgpipe_tpu.utils.tracing import TimelineEvent
+
+    n, m, t = 4, 8, 1.0
+    events = []
+    for j in range(n):
+        for i in range(m):
+            events.append(TimelineEvent("fwd", j, i, 0.0, t))
+            events.append(TimelineEvent("bwd", j, i, 0.0, t))
+    zb_mk, zb_busy, _ = simulate_pipeline(events, n, schedule="zb")
+    f1_mk, _, _ = simulate_pipeline(events, n, schedule="1f1b")
+    assert zb_mk < f1_mk, (zb_mk, f1_mk)
+    assert zb_mk >= 2 * m * t - 1e-9  # work floor per stage
+    assert 0.0 < zb_busy <= 1.0

@@ -175,13 +175,17 @@ def simulate_pipeline(
     ``virtual_stages=v`` and the projection lays block ``g`` on device
     ``g % (n_stages//v)`` as chunk ``g // (n_stages//v)`` (the Megatron
     wrap-around), answering "what would this measured run cost
-    interleaved on n/v devices?".  Returns ``(makespan_seconds,
+    interleaved on n/v devices?".  For ``'zb'`` the measured fused
+    backward is split into equal B/W halves and scheduled by the
+    zero-bubble op order — "what would the split backward buy on this
+    measured run?" (the 50/50 split is the dense-layer FLOP model; state
+    it when quoting).  Returns ``(makespan_seconds,
     busy_fraction, bubble_fraction)``; the bubble can be compared against
     the analytic uniform-cell figure — the gap is stage imbalance.
     """
-    if schedule not in ("fill_drain", "1f1b", "interleaved"):
+    if schedule not in ("fill_drain", "1f1b", "interleaved", "zb"):
         raise ValueError(
-            "schedule must be 'fill_drain', '1f1b' or 'interleaved'"
+            "schedule must be 'fill_drain', '1f1b', 'interleaved' or 'zb'"
         )
     if schedule == "interleaved":
         if virtual_stages < 2:
@@ -213,6 +217,8 @@ def simulate_pipeline(
         makespan = _simulate_1f1b(by_phase, n_stages)
     elif schedule == "interleaved":
         makespan = _simulate_interleaved(by_phase, n_stages, virtual_stages)
+    elif schedule == "zb":
+        makespan = _simulate_zb(by_phase, n_stages)
     elif schedule == "fill_drain":
         makespan = 0.0
         for cells in by_phase.values():
@@ -238,6 +244,40 @@ def simulate_pipeline(
         cell for cells in by_phase.values() for cell in cells.values()
     ) / (units * makespan)
     return makespan, busy, 1.0 - busy
+
+
+def _list_schedule(orders, dep_fn, time_fn) -> Optional[float]:
+    """Shared dependency-driven list scheduler for the per-schedule
+    projections: each unit executes its ``orders`` row in order, an op
+    starting when its unit is free AND ``dep_fn(op, j)`` (or None) has
+    finished; ``time_fn(op, j)`` prices the op.  Returns the makespan, or
+    None on deadlock (cyclic/missing data)."""
+    n = len(orders)
+    done: dict = {}
+    pos = [0] * n
+    unit_free = [0.0] * n
+    total = sum(len(o) for o in orders)
+    scheduled = 0
+    while scheduled < total:
+        progressed = False
+        for j in range(n):
+            while pos[j] < len(orders[j]):
+                op = orders[j][pos[j]]
+                dep = dep_fn(op, j)
+                if dep is not None and dep not in done:
+                    break
+                start = max(
+                    unit_free[j], done[dep] if dep is not None else 0.0
+                )
+                finish = start + time_fn(op, j)
+                done[op + (j,)] = finish
+                unit_free[j] = finish
+                pos[j] += 1
+                scheduled += 1
+                progressed = True
+        if not progressed:
+            return None
+    return max(unit_free)
 
 
 def _simulate_interleaved(
@@ -276,40 +316,69 @@ def _simulate_interleaved(
             f"({m}) divisible by the device count n_stages//virtual_stages "
             f"({n})"
         )
-    orders = [_cell_sequence(n, m, v, j) for j in range(n)]
+    orders = [
+        [tuple(cell) for cell in _cell_sequence(n, m, v, j)] for j in range(n)
+    ]
 
-    def cell_time(kind, c, i, j):
+    def dep_fn(op, j):
+        kind, c, i = op
+        dep = _producer(n, v, kind, c, i, j)
+        if dep is None and kind == BWD:
+            # The last global block's backward consumes its own forward
+            # (the loss seed).  _producer's dep carries its own device in
+            # slot 3; normalize to op + (device,) keys.
+            return (FWD, c, i, j)
+        if dep is not None:
+            return (dep[0], dep[1], dep[2], dep[3])
+        return None
+
+    def time_fn(op, j):
+        kind, c, i = op
         g = c * n + j  # global block index = the measured stage index
         return (fwd if kind == FWD else bwd).get((i, g), 0.0)
 
-    done: dict = {}  # (kind, c, i, j) -> finish time
-    pos = [0] * n
-    dev_free = [0.0] * n
-    total = sum(len(o) for o in orders)
-    scheduled = 0
-    while scheduled < total:
-        progressed = False
-        for j in range(n):
-            while pos[j] < len(orders[j]):
-                kind, c, i = orders[j][pos[j]]
-                dep = _producer(n, v, kind, c, i, j)
-                if dep is None and kind == BWD:
-                    # The last global block's backward consumes its own
-                    # forward (the loss seed).
-                    dep = (FWD, c, i, j)
-                if dep is not None and dep not in done:
-                    break
-                start = max(
-                    dev_free[j], done[dep] if dep is not None else 0.0
-                )
-                done[(kind, c, i, j)] = start + cell_time(kind, c, i, j)
-                dev_free[j] = done[(kind, c, i, j)]
-                pos[j] += 1
-                scheduled += 1
-                progressed = True
-        if not progressed:
-            return None  # deadlock — malformed input
-    return max(dev_free)
+    return _list_schedule(orders, dep_fn, time_fn)
+
+
+def _simulate_zb(by_phase: dict, n: int) -> Optional[float]:
+    """Zero-bubble projection: the measured fused backward splits into a
+    B half (activation gradient) and a W half (weight gradient), each
+    HALF the measured bwd cell time — the dense-layer FLOP split, and the
+    modeling assumption to state when quoting the result.  Op order and
+    dependencies come from the zb tables
+    (:mod:`torchgpipe_tpu.parallel.zerobubble`)."""
+    from torchgpipe_tpu.parallel.zerobubble import (
+        B as ZB_B,
+        F as ZB_F,
+        _dep,
+        _zb_sequence,
+    )
+
+    fwd = by_phase.get("fwd", {})
+    bwd = by_phase.get("bwd", {})
+    if not fwd:
+        return None
+    m = 1 + max(i for i, _ in fwd)
+    orders = [_zb_sequence(n, m, j) for j in range(n)]
+
+    def dep_fn(op, j):
+        kind, i = op
+        dep = _dep(n, kind, i, j)
+        if dep is not None:
+            return dep  # (kind, i, dev) — already op + (device,) shaped
+        if kind == ZB_B and j == n - 1:
+            return (ZB_F, i, j)  # loss seed: own forward
+        if kind not in (ZB_F, ZB_B):
+            return (ZB_B, i, j)  # W after its own B
+        return None
+
+    def time_fn(op, j):
+        kind, i = op
+        if kind == ZB_F:
+            return fwd.get((i, j), 0.0)
+        return bwd.get((i, j), 0.0) / 2.0  # B and W halves
+
+    return _list_schedule(orders, dep_fn, time_fn)
 
 
 def _simulate_1f1b(by_phase: dict, n: int) -> Optional[float]:
@@ -321,36 +390,16 @@ def _simulate_1f1b(by_phase: dict, n: int) -> Optional[float]:
     from torchgpipe_tpu.pipeline import one_f1b_orders
 
     m = 1 + max(i for i, _ in fwd)
-    orders = one_f1b_orders(m, n)
+    orders = [[tuple(op) for op in row] for row in one_f1b_orders(m, n)]
 
-    done: dict = {}  # (kind, i, j) -> finish time
-    pos = [0] * n
-    stage_free = [0.0] * n
-    total = sum(len(o) for o in orders)
-    scheduled = 0
-    while scheduled < total:
-        progressed = False
-        for j in range(n):
-            while pos[j] < len(orders[j]):
-                kind, i = orders[j][pos[j]]
-                if kind == "fwd":
-                    dep = ("fwd", i, j - 1) if j > 0 else None
-                    t = fwd.get((i, j), 0.0)
-                else:
-                    dep = (
-                        ("bwd", i, j + 1) if j < n - 1 else ("fwd", i, j)
-                    )
-                    t = bwd.get((i, j), 0.0)
-                if dep is not None and dep not in done:
-                    break
-                start = max(
-                    stage_free[j], done[dep] if dep is not None else 0.0
-                )
-                done[(kind, i, j)] = start + t
-                stage_free[j] = start + t
-                pos[j] += 1
-                scheduled += 1
-                progressed = True
-        if not progressed:
-            return None  # cyclic/missing data — bail rather than loop
-    return max(stage_free)
+    def dep_fn(op, j):
+        kind, i = op
+        if kind == "fwd":
+            return ("fwd", i, j - 1) if j > 0 else None
+        return ("bwd", i, j + 1) if j < n - 1 else ("fwd", i, j)
+
+    def time_fn(op, j):
+        kind, i = op
+        return (fwd if kind == "fwd" else bwd).get((i, j), 0.0)
+
+    return _list_schedule(orders, dep_fn, time_fn)
