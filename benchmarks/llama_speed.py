@@ -82,13 +82,14 @@ def causal_lm_loss(out, tokens):
 @click.option("--dp", default=1,
               help="data-parallel mesh axis size (spmd engine)")
 @click.option("--schedule",
-              type=click.Choice(["fill_drain", "1f1b", "interleaved"]),
+              type=click.Choice(["fill_drain", "1f1b", "interleaved", "zb"]),
               default="fill_drain",
               help="spmd engine schedule: 1f1b runs PipeDream-flush with "
-                   "O(n) activation memory (needs checkpoint=always); "
-                   "interleaved adds Megatron virtual pipeline stages "
-                   "(--virtual-stages chunks per device, ~v x smaller "
-                   "bubble)")
+                   "O(n) activation memory; interleaved adds Megatron "
+                   "virtual pipeline stages (--virtual-stages chunks per "
+                   "device, ~v x smaller bubble); zb splits the backward "
+                   "into dx-only B cells + weight-grad W cells that "
+                   "back-fill bubbles (needs --checkpoint never)")
 @click.option("--virtual-stages", default=2,
               help="model chunks per device for --schedule interleaved")
 @click.option("--fsdp/--no-fsdp", default=False,

@@ -316,21 +316,16 @@ def _simulate_interleaved(
             f"({m}) divisible by the device count n_stages//virtual_stages "
             f"({n})"
         )
-    orders = [
-        [tuple(cell) for cell in _cell_sequence(n, m, v, j)] for j in range(n)
-    ]
+    orders = [_cell_sequence(n, m, v, j) for j in range(n)]
 
     def dep_fn(op, j):
         kind, c, i = op
         dep = _producer(n, v, kind, c, i, j)
         if dep is None and kind == BWD:
             # The last global block's backward consumes its own forward
-            # (the loss seed).  _producer's dep carries its own device in
-            # slot 3; normalize to op + (device,) keys.
+            # (the loss seed).
             return (FWD, c, i, j)
-        if dep is not None:
-            return (dep[0], dep[1], dep[2], dep[3])
-        return None
+        return dep
 
     def time_fn(op, j):
         kind, c, i = op
@@ -390,7 +385,7 @@ def _simulate_1f1b(by_phase: dict, n: int) -> Optional[float]:
     from torchgpipe_tpu.pipeline import one_f1b_orders
 
     m = 1 + max(i for i, _ in fwd)
-    orders = [[tuple(op) for op in row] for row in one_f1b_orders(m, n)]
+    orders = one_f1b_orders(m, n)
 
     def dep_fn(op, j):
         kind, i = op
