@@ -282,3 +282,34 @@ def test_zb_memory_matches_1f1b_never_class():
     assert ma32.temp_size_in_bytes <= 1.2 * temps["zb"], (
         ma32.temp_size_in_bytes, temps
     )
+
+
+def test_zb_composes_with_ep_moe():
+    """MoE expert parallelism under the split backward: the all_to_all
+    token dispatch is group-local (ep lanes share a stage, hence a
+    branch), so it is safe inside BOTH the B and W branches — B's dx path
+    rides the all_to_all transpose, W's expert-weight grads consume the
+    same stored residuals.  Must match fill-drain to float tolerance on
+    identical weights (not bitwise: fill-drain recomputes forwards under
+    'always' while zb replays stored residuals)."""
+    from torchgpipe_tpu.models.moe import MoEConfig, llama_moe_spmd
+
+    pp = 2
+    mesh = make_mesh(pp, 1, ep=2, devices=jax.devices()[:4])
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=pp, n_heads=4,
+                            n_kv_heads=2)
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=2.0, ep_axis="ep")
+    block, pre, post = llama_moe_spmd(cfg, moe, pp)
+    tokens, labels = _tokens(8)
+    common = dict(chunks=2, loss_fn=cross_entropy, pre=pre, post=post,
+                  ep_axis="ep")
+    fd = SpmdGPipe(block, pp, mesh, checkpoint="always", **common)
+    zb = SpmdGPipe(block, pp, mesh, checkpoint="never", schedule="zb",
+                   **common)
+    params = fd.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
+    )
+    l1, g1 = fd.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    l2, g2 = zb.train_step(params, tokens, labels, jax.random.PRNGKey(1))
+    assert abs(float(l1 - l2)) < 1e-5
+    assert maxdiff(g1, g2) < 1e-4
