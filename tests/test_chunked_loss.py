@@ -120,6 +120,7 @@ def _setup(pp, n_blocks, m):
         ("fill_drain", {}),
         ("1f1b", {}),
         ("interleaved", {"virtual_stages": 2}),
+        ("zb", {"checkpoint": "never"}),
     ],
 )
 def test_loss_layer_matches_post_head_oracle(schedule, kw):
@@ -128,13 +129,15 @@ def test_loss_layer_matches_post_head_oracle(schedule, kw):
     same loss, same block/pre grads, and the loss-layer head grads equal
     the oracle's post grads."""
     pp, m = 2, 4
+    kw = dict(kw)
+    ckpt = kw.pop("checkpoint", "always")
     v = kw.get("virtual_stages", 1)
     cfg, block, pre, post, mesh, tokens, labels = _setup(pp, pp * v, m)
     spec = jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
 
     oracle = SpmdGPipe(
         block, pp, mesh, chunks=m, loss_fn=cross_entropy,
-        pre=pre, post=post, checkpoint="always", schedule=schedule, **kw,
+        pre=pre, post=post, checkpoint=ckpt, schedule=schedule, **kw,
     )
     po = oracle.init(jax.random.PRNGKey(0), spec)
     lo, go = oracle.train_step(po, tokens, labels)
@@ -142,7 +145,7 @@ def test_loss_layer_matches_post_head_oracle(schedule, kw):
     fused = SpmdGPipe(
         block, pp, mesh, chunks=m,
         loss_fn=chunked_lm_loss(cfg, chunk=16),
-        pre=pre, post=None, checkpoint="always", schedule=schedule, **kw,
+        pre=pre, post=None, checkpoint=ckpt, schedule=schedule, **kw,
     )
     p = dict(fused.init(jax.random.PRNGKey(0), spec))
     # Same rng -> identical blocks/pre; splice the oracle's head weights
