@@ -74,3 +74,30 @@ def test_balance_by_size():
 
 def test_balance_cost_roundtrip():
     assert balance_cost([1, 1, 4, 1, 1], 2) in ([3, 2], [2, 3])
+
+
+def test_profile_sizes_warns_on_coarse_fallback(monkeypatch):
+    """When XLA memory_analysis is unavailable the per-layer sizes come
+    from coarse output-shape accounting — profile_sizes must say so
+    (naming the layers) instead of silently switching fidelity."""
+    import warnings
+
+    from torchgpipe_tpu.balance import profile as profile_mod
+
+    layers, params, states, sample = _model()
+
+    # Precise path available: no fidelity warning.
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        precise = profile_mod.profile_sizes(layers, params, states, sample)
+    assert not [w for w in rec if "coarse" in str(w.message)]
+
+    # Break compilation so every layer takes the shape-accounting fallback.
+    def no_jit(*a, **k):
+        raise RuntimeError("no compiler in this test")
+
+    monkeypatch.setattr(profile_mod.jax, "jit", no_jit)
+    with pytest.warns(UserWarning, match="coarse output-shape accounting"):
+        coarse = profile_mod.profile_sizes(layers, params, states, sample)
+    assert len(coarse) == len(precise) == len(layers)
+    assert all(s > 0 for s in coarse)

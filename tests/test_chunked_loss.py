@@ -416,3 +416,67 @@ def test_label_range_guard_checkify():
     err, _ = checked(bad)
     with pytest.raises(Exception, match="labels must lie in"):
         err.throw()
+
+
+# ---------------------------------------------------------------------- #
+# ragged batches: the meta['row_loss'] fast path                         #
+# ---------------------------------------------------------------------- #
+
+
+def test_row_loss_matches_batch1_apply():
+    """The meta['row_loss'] contract: ONE batched call whose rows each
+    equal the layer applied to that batch-1 slice — what the engine's
+    vmap fallback computes row by row."""
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=1, n_heads=4, n_kv_heads=2
+    )
+    layer = chunked_lm_loss(cfg, chunk=16)
+    B, S = 5, 12
+    k = jax.random.split(jax.random.PRNGKey(3), 2)
+    y = jax.random.normal(k[0], (B, S, cfg.dim))
+    labels = jax.random.randint(k[1], (B, S), 0, cfg.vocab)
+    p, _ = layer.init(
+        jax.random.PRNGKey(7), jax.ShapeDtypeStruct(y.shape, y.dtype)
+    )
+    rows = layer.meta["row_loss"](p, (), (y, labels))
+    assert rows.shape == (B,)
+    for i in range(B):
+        ref, _ = layer.apply(p, (), (y[i : i + 1], labels[i : i + 1]))
+        np.testing.assert_allclose(
+            float(rows[i]), float(ref), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_ragged_fast_path_matches_vmap_fallback(cpu_devices):
+    """Engine-level oracle: a ragged batch through the row_loss fast path
+    (one batched loss call) vs the SAME engine with the meta stripped
+    (B vmapped batch-1 calls) — loss and every gradient agree."""
+    import dataclasses
+
+    pp, m = 2, 2
+    cfg, block, pre, post, mesh, tokens, labels = _setup(pp, pp, m)
+    tokens, labels = tokens[:3], labels[:3]  # B=3, pads to 4
+    spec = jax.ShapeDtypeStruct((4, tokens.shape[1]), tokens.dtype)
+    layer = chunked_lm_loss(cfg, chunk=16)
+    assert "row_loss" in layer.meta
+
+    fast = SpmdGPipe(
+        block, pp, mesh, chunks=m, loss_fn=layer, pre=pre, post=None,
+        loss_reduction="mean",
+    )
+    slow = SpmdGPipe(
+        block, pp, mesh, chunks=m,
+        loss_fn=dataclasses.replace(layer, meta={}),  # force vmap fallback
+        pre=pre, post=None, loss_reduction="mean",
+    )
+    p = fast.place(fast.init(jax.random.PRNGKey(0), spec))
+    lf, gf = fast.train_step(p, tokens, labels)
+    ls, gs = slow.train_step(p, tokens, labels)
+    np.testing.assert_allclose(float(lf), float(ls), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        gf,
+        gs,
+    )

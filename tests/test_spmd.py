@@ -516,3 +516,84 @@ def test_ragged_sizes_share_one_compiled_step(cpu_devices):
         np.testing.assert_allclose(
             float(losses[B]), float(loss_of(params["blocks"])), rtol=1e-5
         )
+
+
+def test_ragged_warns_once_on_row_coupled_aux(cpu_devices):
+    """A ragged batch pads with duplicated edge rows; when the model holds
+    row-coupled auxiliary terms (batch-norm statistics, MoE balance
+    penalty) the engine must say so — once — because those terms silently
+    see the padding (the task loss stays exact)."""
+    import dataclasses
+    import warnings
+
+    n, dim = 2, 8
+    mesh = make_mesh(n, 1, devices=cpu_devices[:2])
+    # A stateless stand-in that *declares* batch-norm coupling: the
+    # warning keys off the meta contract, same as precision/batchnorm
+    # conversions do, so the test exercises exactly that plumbing.
+    bn_like = dataclasses.replace(
+        layer_norm(name="bn"),
+        meta={"kind": "batch_norm", "momentum": 0.9, "eps": 1e-5},
+    )
+    block = chain([bn_like, dense(dim, name="fc")], name="block")
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=2, loss_fn=mse, loss_reduction="mean"
+    )
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, dim), jnp.float32)
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, dim))
+    with pytest.warns(UserWarning, match="row-coupled"):
+        pipe.train_step(params, x, x)
+    # One-time: a second ragged step is quiet.
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        pipe.train_step(params, x, x)
+    assert not [w for w in rec if "row-coupled" in str(w.message)]
+
+
+def test_ragged_no_warning_without_coupled_aux(cpu_devices):
+    """Plain blocks (no BN, no MoE penalty): ragged padding is exact and
+    must stay silent."""
+    import warnings
+
+    n, dim = 2, 8
+    mesh = make_mesh(n, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(
+        make_block(dim), n, mesh, chunks=2, loss_fn=mse,
+        loss_reduction="mean",
+    )
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, dim), jnp.float32)
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, dim))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        pipe.train_step(params, x, x)
+    assert not [w for w in rec if "row-coupled" in str(w.message)]
+
+
+def test_row_coupled_sees_moe_balance_through_block_wrapper():
+    """_row_coupled must detect a balance_weight>0 MoE through the
+    transformer_block meta (the engine only sees the wrapped block)."""
+    from torchgpipe_tpu.models.moe import MoEConfig, moe_mlp
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        transformer_block,
+    )
+    from torchgpipe_tpu.spmd import _row_coupled
+
+    cfg = TransformerConfig(
+        vocab=32, dim=16, n_layers=1, n_heads=2, n_kv_heads=1
+    )
+    hot = transformer_block(
+        cfg, mlp=moe_mlp(cfg, MoEConfig(n_experts=2, balance_weight=0.1))
+    )
+    cold = transformer_block(
+        cfg, mlp=moe_mlp(cfg, MoEConfig(n_experts=2, balance_weight=0.0))
+    )
+    assert _row_coupled(hot) == ["MoE balance_weight penalty"]
+    assert _row_coupled(cold) == []
+    assert _row_coupled(chain([hot, cold], name="s")) == [
+        "MoE balance_weight penalty"
+    ]

@@ -129,6 +129,15 @@ def profile_sizes(
     torchgpipe/balance/__init__.py:100-108).  Activation/temp memory comes
     from XLA's compiled memory analysis when available, else from output
     shape accounting.  Reference: torchgpipe/balance/profile.py:84-118.
+
+    Fidelity caveat: the shape-accounting fallback estimates
+    ``2·bytes(output) + bytes(stashed residuals)`` and IGNORES intra-layer
+    temporaries (attention score tiles, im2col buffers), so it can
+    understate memory-hungry layers; a :class:`UserWarning` is emitted
+    once per call when any layer takes the fallback, naming which.  The
+    reference's equivalent honesty is its CUDA-only guard
+    (torchgpipe/balance/profile.py:84-118 — it refuses to size-profile
+    without a device at all).
     """
     if device is None:
         device = jax.devices()[0]
@@ -138,6 +147,7 @@ def profile_sizes(
 
     inputs = _thread_inputs(layers, params, states, sample)
     sizes: List[int] = []
+    fallback_layers: List[str] = []
     for i, layer in enumerate(layers):
         x, pops = inputs[i]
         param_bytes = _tree_bytes(params[i])
@@ -158,9 +168,23 @@ def profile_sizes(
         if act_bytes is None:
             # Fallback: bytes of the layer output (the activation the
             # pipeline must hold) plus its input cotangent.
+            fallback_layers.append(layer.name)
             y, stashed, grads = jax.eval_shape(
                 _layer_fwd_bwd(layer), params[i], states[i], x, pops
             )
             act_bytes = 2 * _tree_bytes(y) + _tree_bytes(stashed)
         sizes.append(int(param_scale * param_bytes) + act_bytes)
+    if fallback_layers:
+        import warnings
+
+        warnings.warn(
+            "XLA memory_analysis() unavailable for "
+            f"{len(fallback_layers)}/{len(layers)} layers "
+            f"({', '.join(fallback_layers[:5])}"
+            f"{', ...' if len(fallback_layers) > 5 else ''}): their sizes "
+            "use coarse output-shape accounting that ignores intra-layer "
+            "temporaries — balance_by_size partitions from these costs "
+            "may understate memory-hungry layers",
+            stacklevel=2,
+        )
     return sizes

@@ -500,6 +500,7 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
         apply=apply,
         meta={
             "kind": "moe_mlp",
+            "balance_weight": moe.balance_weight,
             "ep_axis": ep,
             "validate_mesh": validate_mesh,
             "param_specs": None if ep is None else {

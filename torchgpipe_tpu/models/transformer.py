@@ -324,6 +324,10 @@ def transformer_block(
     }
     if "ep_axis" in mlp_meta:
         meta["ep_axis"] = mlp_meta["ep_axis"]
+    if "balance_weight" in mlp_meta:
+        # Surfaced so the engine's ragged-batch warning can see a MoE
+        # balance penalty through the block wrapper (spmd._row_coupled).
+        meta["balance_weight"] = mlp_meta["balance_weight"]
     return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
@@ -488,17 +492,25 @@ def chunked_lm_loss(
 
     init = _head_init(cfg)
 
-    def apply(params, state, y_and_labels, *, rng=None, train=True):
-        del rng, train
+    def row_loss(params, state, y_and_labels):
+        # Engine fast path for ragged batches (SpmdGPipe._masked_loss_sum):
+        # per-row losses in ONE batched call, each row the token mean of
+        # that batch-1 slice.  ``apply`` is its mean (rows share one
+        # sequence length), so the two paths cannot drift.
+        del state
         y, labels = y_and_labels
         h = _rms(y, params["scale"], cfg.norm_eps)
-        flat = h.reshape(-1, cfg.dim)
         losses = chunked_softmax_xent(
-            flat, params["w"], labels.reshape(-1), chunk
+            h.reshape(-1, cfg.dim), params["w"], labels.reshape(-1), chunk
         )
-        return jnp.mean(losses), state
+        return jnp.mean(losses.reshape(labels.shape[0], -1), axis=1)
 
-    return Layer(name=name, init=init, apply=apply, meta={})
+    def apply(params, state, y_and_labels, *, rng=None, train=True):
+        del rng, train
+        return jnp.mean(row_loss(params, state, y_and_labels)), state
+
+    return Layer(name=name, init=init, apply=apply,
+                 meta={"row_loss": row_loss})
 
 
 def llama(cfg: TransformerConfig, *, head: bool = True) -> List[Layer]:

@@ -191,9 +191,13 @@ def zero_bubble_tables(n: int, m: int) -> ZeroBubbleTables:
         n=n, m=m, ticks=t,
         kind=np.asarray(rows_kind, np.int32),
         mb=np.asarray(rows_mb, np.int32),
-        slots=_min_depth({**act_spans, **{
-            (j + 1000, i): s for (j, i), s in cot_spans.items()
-        }}),
+        # The activation and cotangent spans share one slot array; tag the
+        # merged keys structurally so stage j's cotangents can never alias
+        # stage j's activations, whatever n is.
+        slots=_min_depth({
+            **{(("act", j), i): s for (j, i), s in act_spans.items()},
+            **{(("cot", j), i): s for (j, i), s in cot_spans.items()},
+        }),
         y_slots=_min_depth(y_spans) if y_spans else 1,
         resid_slots=_min_depth(resid_spans),
         dy_slots=_min_depth(dy_spans),

@@ -53,6 +53,31 @@ from torchgpipe_tpu.parallel.tensor import all_gather_value
 Pytree = Any
 
 
+def _row_coupled(layer: Layer) -> list:
+    """Row-coupled mechanisms in ``layer`` whose AUXILIARY terms see ragged
+    padding rows (batch-norm statistics average over the padded micro-batch;
+    a MoE balance penalty counts the duplicated tokens).  Task-loss
+    gradients stay exact either way — this feeds the one-time ragged-batch
+    warning in :meth:`SpmdGPipe.train_step`."""
+    out = []
+    meta = layer.meta
+    if isinstance(meta, dict):
+        if meta.get("kind") == "compound":
+            children = meta["children"]
+            values = (
+                children.values() if isinstance(children, dict) else children
+            )
+            for child in values:
+                out.extend(_row_coupled(child))
+        else:
+            kind = meta.get("kind")
+            if kind in ("batch_norm", "deferred_batch_norm"):
+                out.append(f"{kind} statistics")
+            if meta.get("balance_weight", 0.0) > 0.0:
+                out.append("MoE balance_weight penalty")
+    return out
+
+
 def _declared_axes(layer: Layer, key: str) -> list:
     """Collect ``meta[key]`` declarations, recursing into compounds."""
     out = []
@@ -619,6 +644,7 @@ class SpmdGPipe:
             layer_param_specs(self.loss_fn) if self._loss_is_layer else None
         )
         self._train_step_fns: dict = {}  # keyed by use_rng
+        self._warned_ragged_coupled = False  # one-time ragged+aux warning
         self._apply_fn = None
         self._eval_fn = None
         # FSDP bookkeeping, resolved lazily from the first params tree seen
@@ -728,11 +754,29 @@ class SpmdGPipe:
 
     def _masked_loss_sum(self, p_loss, y, tgt, mask, train=True):
         """``Σ_rows mask · loss_fn(row)`` — the ragged-batch weighting
-        primitive.  Each row is presented to ``loss_fn`` as a batch-1
-        slice under ``vmap``, so the declared row decomposition
-        (``loss_reduction`` 'mean'/'sum') makes the masked sum exact:
-        padded rows contribute zero to both value and gradient."""
+        primitive.
+
+        Fast path: a loss LAYER that declares ``meta={'row_loss': fn}``
+        (``fn(params, state, (y, tgt)) -> [B]`` per-row losses, each equal
+        to the layer applied to that batch-1 slice) is evaluated ONCE on
+        the whole micro-batch and masked — one batched call instead of B
+        vmapped batch-1 calls (the chunked vocab cross-entropy takes this
+        path; see :func:`models.transformer.chunked_lm_loss`).
+
+        Fallback for opaque scalar losses: each row is presented to
+        ``loss_fn`` as a batch-1 slice under ``vmap``.  Either way the
+        declared row decomposition (``loss_reduction`` 'mean'/'sum')
+        makes the masked sum exact: padded rows contribute zero to both
+        value and gradient."""
         tmap = jax.tree_util.tree_map
+        row_loss = (
+            self.loss_fn.meta.get("row_loss")
+            if self._loss_is_layer and isinstance(self.loss_fn.meta, dict)
+            else None
+        )
+        if row_loss is not None:
+            rows = row_loss(p_loss, (), (y, tgt)).astype(jnp.float32)
+            return jnp.sum(rows * mask)
 
         def row(yy, tt):
             return self._loss_call(
@@ -2638,6 +2682,26 @@ class SpmdGPipe:
             self._train_step_fns[key] = self._build_train_step(
                 use_rng, masked=bool(pad)
             )
+        if pad and not self._warned_ragged_coupled:
+            self._warned_ragged_coupled = True
+            coupled = list(dict.fromkeys(  # dedupe, keep first-seen order
+                c
+                for lyr in (self.block, self.pre, self.post)
+                if lyr is not None
+                for c in _row_coupled(lyr)
+            ))
+            if coupled:
+                import warnings
+
+                warnings.warn(
+                    "ragged batch padded with duplicated edge rows, and the "
+                    f"model has row-coupled auxiliary terms ({', '.join(coupled)}) "
+                    "that will see those padding rows; task-loss gradients "
+                    "remain exact, but pad to a divisible batch yourself if "
+                    "the auxiliary terms must be padding-free (see "
+                    "SpmdGPipe.train_step docstring)",
+                    stacklevel=2,
+                )
         if pad:
             b_real = microbatch.batch_size(x)
             mask = jnp.concatenate(
