@@ -11,30 +11,9 @@ from torchgpipe_tpu.parallel.zerobubble import (
     F,
     IDLE,
     W,
+    fused_1f1b_weighted_makespan as _fused_1f1b_weighted,
     zero_bubble_tables,
 )
-
-
-def _fused_1f1b_weighted(n: int, m: int, t_f=1.0, t_bw=2.0) -> float:
-    """Exact lockstep cost of classic 1F1B with a FUSED backward (dx+dW
-    in one cell costing ``t_bw``), from the engine's closed-form tick
-    predicates (spmd.py _build_train_step_1f1b)."""
-    total = 0.0
-    for t in range(2 * (m + n - 1)):
-        c = 0.0
-        for j in range(n):
-            tj = t - j
-            warm = 0 <= tj <= n - 1 - j and tj < m
-            i_s = tj // 2 if tj >= 0 else 0
-            steady = tj >= 0 and tj % 2 == 0 and i_s > n - 1 - j and i_s < m
-            num = t + j - (2 * n - 1)
-            do_b = num >= 0 and num % 2 == 0 and num // 2 < m
-            if do_b:
-                c = max(c, t_bw)
-            elif warm or steady:
-                c = max(c, t_f)
-        total += c
-    return total
 
 
 @pytest.mark.parametrize("n", [1, 2, 3, 4, 8])
