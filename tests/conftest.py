@@ -23,10 +23,15 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-).strip()
+# TGPU_TEST_ON_BACKEND=1 opts OUT of the CPU flip for hardware sessions
+# (tools/tpu_todo.sh runs the platform-agnostic tests, e.g.
+# tests/test_overlap.py, against the real TPU backend this way).
+if os.environ.get("TGPU_TEST_ON_BACKEND") != "1":
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 # Persistent compilation cache: the suite compiles hundreds of small XLA
 # programs (stage variants x models); caching them makes warm runs several

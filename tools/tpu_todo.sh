@@ -127,6 +127,14 @@ run_step attn-window-1024 2400 -t tools/tpu_attn_window_1024.txt \
     --attn-window 1024 --steps 3 \
   || bail_if_dead
 
+# (7) The per-cell dispatch-asynchrony invariant against the REAL TPU
+# backend (tests/test_overlap.py is platform-agnostic; CI runs it on the
+# CPU mesh — this is the on-hardware leg).
+run_step overlap-on-tpu 1800 -t tools/tpu_overlap_test.txt \
+  env TGPU_TEST_ON_BACKEND=1 \
+  python -m pytest tests/test_overlap.py -q --no-header \
+  || bail_if_dead
+
 # (zb-vs-1f1b wall clock needs a multi-stage mesh — impossible on the
 # single tunneled chip; the CPU-mesh measured-vs-predicted table in
 # BENCH_NOTES covers it.)
