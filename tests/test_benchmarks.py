@@ -322,6 +322,20 @@ def test_examples_quickstart():
     assert "[spmd] step 2" in r.stdout, r.stdout
 
 
+def test_examples_spmd_skips():
+    """The skips-on-SPMD workaround demo (promised by the engine's error
+    message) runs end to end and its oracle assertion holds."""
+    repo = pathlib.Path(REPO)
+    env = cpu_subproc_env(XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, str(repo / "examples" / "spmd_skips.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(repo),
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "pipelined == sequential oracle" in r.stdout, r.stdout
+    assert "spmd-skips demo complete" in r.stdout
+
+
 def test_examples_long_context():
     """The long-context tour (ring / ulysses / ulysses+window on a pp x sp
     mesh) runs end to end and its losses descend."""

@@ -1,0 +1,107 @@
+"""Skips on the SPMD engine via the chain()-stage workaround.
+
+The engine's validation error promises "Resolve the skips inside a
+chain() stage" (spmd.py __post_init__) — these tests make that promise
+runnable: a U-Net-style long skip (stash → bottleneck → pop_cat) resolved
+WITHIN each stage pipelines transparently on every schedule, while a skip
+crossing the stage boundary still gets the didactic rejection pointing at
+both the workaround and the MPMD engine (whose portals-equivalent routing
+is tested in tests/skip/).  Reference anchor: the portals this dissolves,
+reference torchgpipe/skip/portal.py:1-8."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.layers import chain
+from torchgpipe_tpu.ops import dense, gelu, layer_norm
+from torchgpipe_tpu.skip import Namespace, pop_cat, stash
+from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+DIM = 16
+
+
+def u_stage(dim=DIM):
+    """One stage = one mini-U (examples/spmd_skips.py shape): the long
+    skip jumps the bottleneck and concatenates channels."""
+    ns = Namespace()
+    return chain(
+        [
+            layer_norm(name="ln"),
+            dense(dim, name="enc"),
+            stash("feat", ns=ns),
+            dense(dim // 4, name="down"),
+            gelu("mid"),
+            dense(dim, name="up"),
+            pop_cat("feat", ns=ns),
+            dense(dim, name="proj"),
+        ],
+        name="u_stage",
+    )
+
+
+def mse(out, tgt):
+    return jnp.mean((out - tgt) ** 2)
+
+
+@pytest.mark.parametrize(
+    "schedule,kw",
+    [
+        ("fill_drain", {}),
+        ("1f1b", {}),
+        ("interleaved", {"virtual_stages": 2}),
+        ("zb", {"checkpoint": "never"}),
+    ],
+)
+def test_chain_resolved_skips_match_oracle(cpu_devices, schedule, kw):
+    """stash/pop_cat inside each chain() stage: pipelined loss AND grads
+    equal the stacked blocks applied sequentially on one device — the
+    skip is transparent on every schedule."""
+    n, m = 2, 4
+    kw = dict(kw)
+    ckpt = kw.pop("checkpoint", "except_last")
+    v = kw.get("virtual_stages", 1)
+    mesh = make_mesh(n, 1, devices=cpu_devices[:n])
+    block = u_stage()
+    pipe = SpmdGPipe(
+        block, n, mesh, chunks=m, loss_fn=mse, checkpoint=ckpt,
+        schedule=schedule, **kw,
+    )
+    spec = jax.ShapeDtypeStruct((2 * m, DIM), jnp.float32)
+    params = pipe.place(pipe.init(jax.random.PRNGKey(0), spec))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2 * m, DIM))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (2 * m, DIM))
+
+    def loss_of(blocks):
+        h = x
+        for g in range(n * v):
+            c, j = g // n, g % n
+            pj = jax.tree_util.tree_map(
+                lambda a: a[j, c] if v > 1 else a[j], blocks
+            )
+            h, _ = block.apply(pj, (), h, rng=None, train=True)
+        return mse(h, tgt)
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_of)(params["blocks"])
+    loss, grads = pipe.train_step(params, x, tgt)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        grads["blocks"],
+        ref_grads,
+    )
+
+
+def test_cross_stage_skip_rejected_with_workaround_pointer(cpu_devices):
+    """A stash whose pop is NOT in the same chain cannot even compose
+    (chain fails fast), and a block DECLARING stash/pop at the engine
+    boundary gets the didactic error naming the chain() workaround."""
+    with pytest.raises(ValueError, match="never popped inside the chain"):
+        chain([dense(DIM, name="enc"), stash("feat")], name="half_u")
+
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    with pytest.raises(ValueError, match="chain\\(\\) stage"):
+        SpmdGPipe(stash("feat"), 2, mesh, chunks=2, loss_fn=mse)

@@ -463,8 +463,9 @@ class SpmdGPipe:
                 raise ValueError(
                     f"SPMD engine does not support cross-stage skip "
                     f"connections, but {what} layer {lyr.name!r} declares "
-                    "stash/pop. Resolve the skips inside a chain() stage, or "
-                    "use the MPMD GPipe engine for cross-stage skip routing."
+                    "stash/pop. Resolve the skips inside a chain() stage "
+                    "(runnable demo: examples/spmd_skips.py), or use the "
+                    "MPMD GPipe engine for cross-stage skip routing."
                 )
         if self.loss_reduction not in ("mean", "sum", None):
             raise ValueError("loss_reduction must be 'mean', 'sum' or None")
