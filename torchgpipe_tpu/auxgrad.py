@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
-from typing import Iterator
+from typing import Any, Iterator
 
 
 class _Scale(threading.local):
@@ -27,7 +27,7 @@ class _Scale(threading.local):
 _scale = _Scale()
 
 
-def current_aux_scale():
+def current_aux_scale() -> Any:
     """The scale an aux-gradient injection traced now should apply.
 
     A python float, or a traced scalar when the weighting is data-dependent
@@ -37,7 +37,7 @@ def current_aux_scale():
 
 
 @contextlib.contextmanager
-def aux_scale(value) -> Iterator[None]:
+def aux_scale(value: Any) -> Iterator[None]:
     """Set the trace-time aux-gradient scale (used by the engines)."""
     prev = _scale.value
     _scale.value = value

@@ -38,7 +38,7 @@ def balance_by_time(
     sample: Pytree,
     *,
     timeout: float = 1.0,
-    device=None,
+    device: Any = None,
 ) -> List[int]:
     """Balance by profiled forward+backward time per layer.
 
@@ -58,7 +58,7 @@ def balance_by_size(
     sample: Pytree,
     *,
     param_scale: float = 2.0,
-    device=None,
+    device: Any = None,
 ) -> List[int]:
     """Balance by per-layer memory footprint (XLA memory analysis + scaled
     parameter bytes).

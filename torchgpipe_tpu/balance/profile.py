@@ -21,7 +21,7 @@ allocator deltas (profile.py:84-118).  TPU-native redesign:
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +31,7 @@ from torchgpipe_tpu.layers import Layer, apply_layer
 Pytree = Any
 
 
-def _layer_fwd_bwd(layer: Layer):
+def _layer_fwd_bwd(layer: Layer) -> Callable:
     """Build a jittable forward+backward for one layer (dispatch shared with
     the engines via :func:`~torchgpipe_tpu.layers.apply_layer`)."""
 
@@ -79,7 +79,7 @@ def profile_times(
     sample: Pytree,
     *,
     timeout: float = 1.0,
-    device=None,
+    device: Any = None,
 ) -> List[float]:
     """Per-layer forward+backward wall-clock cost (seconds, summed over
     sweeps).  Reference: torchgpipe/balance/profile.py:40-81."""
@@ -121,7 +121,7 @@ def profile_sizes(
     sample: Pytree,
     *,
     param_scale: float = 2.0,
-    device=None,
+    device: Any = None,
 ) -> List[int]:
     """Per-layer memory cost in bytes.
 

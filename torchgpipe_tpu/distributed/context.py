@@ -233,9 +233,7 @@ class TcpTransport:
 
 
 @contextlib.contextmanager
-def worker(
-    transport, name: str
-) -> Iterator[Mailbox]:
+def worker(transport: Any, name: str) -> Iterator[Mailbox]:
     """Register a worker mailbox for the duration of a training run.
 
     Reference: torchgpipe/distributed/context.py:41-64 (``worker`` context

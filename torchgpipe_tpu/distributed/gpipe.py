@@ -25,7 +25,9 @@ cross-rank channel blocking, not a scheduler").
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import (
+    Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple,
+)
 
 import jax
 
@@ -57,10 +59,10 @@ class DistributedGPipe:
         balance: Sequence[int],
         *,
         chunks: int,
-        transport,
-        mailbox,
-        device=None,
-        checkpoint: str = "except_last",
+        transport: Any,
+        mailbox: Any,
+        device: Any = None,
+        checkpoint: str = 'except_last',
         deferred_batch_norm: bool = False,
         recv_timeout: Optional[float] = None,
     ) -> None:
@@ -131,7 +133,7 @@ class DistributedGPipe:
     def is_last(self) -> bool:
         return self.rank == len(self.workers) - 1
 
-    def _recv(self, kind, index):
+    def _recv(self, kind: str, index: int) -> Pytree:
         """Deadline-bounded mailbox receive placed on this rank's device."""
         return jax.device_put(
             self.mailbox.get(kind, index, timeout=self.recv_timeout),
@@ -347,12 +349,12 @@ class DistributedGPipeDataLoader:
 
     def __init__(
         self,
-        loader,
+        loader: Any,
         rank: int,
         workers: Sequence[str],
         *,
-        transport,
-        mailbox,
+        transport: Any,
+        mailbox: Any,
         num_batches: Optional[int] = None,
         recv_timeout: Optional[float] = None,
     ) -> None:
@@ -369,7 +371,7 @@ class DistributedGPipeDataLoader:
     def __len__(self) -> int:
         return self.num_batches
 
-    def __iter__(self):
+    def __iter__(self) -> Iterator:
         last = len(self.workers) - 1
         if self.rank == 0:
             for step, (data, target) in enumerate(self.loader):

@@ -29,7 +29,7 @@ Example::
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 
@@ -61,14 +61,13 @@ class GPipe:
         *,
         devices: Optional[Sequence] = None,
         chunks: int = 1,
-        checkpoint: str = "except_last",
+        checkpoint: str = 'except_last',
         deferred_batch_norm: bool = False,
-        compute_dtype: Optional[Any] = None,  # a jnp dtype, e.g. jnp.bfloat16
-        fused: bool = False,  # opt-in whole-step program (per-cell
-        # scheduling measured faster on hardware, see _use_fused)
-        schedule: str = "gpipe",  # 'gpipe' (fill-drain) | '1f1b'
-        loss_reduction: Optional[str] = None,  # 'mean'|'sum'; required by 1f1b
-        tracer=None,
+        compute_dtype: Optional[Any] = None,
+        fused: bool = False,
+        schedule: str = 'gpipe',
+        loss_reduction: Optional[str] = None,
+        tracer: Any = None,
     ) -> None:
         if balance is None:
             raise ValueError(
@@ -192,7 +191,7 @@ class GPipe:
     def __getitem__(self, index: int) -> Layer:
         return self.layers[index]
 
-    def __iter__(self):
+    def __iter__(self) -> Iterator[Layer]:
         return iter(self.layers)
 
     # ------------------------------------------------------------------ #
@@ -230,7 +229,11 @@ class GPipe:
             for j, stage_tree in enumerate(per_stage)
         )
 
-    def state_dict(self, params, state):
+    def state_dict(
+        self,
+        params: Tuple[Pytree, ...],
+        state: Tuple[Pytree, ...],
+    ) -> Dict[str, Any]:
         """Flat named mapping with reference-style
         ``partitions.<stage>.<layer>`` keys (reference: gpipe.py:257-285
         keeps wrapped layers discoverable via ``state_dict``; here params
@@ -239,7 +242,12 @@ class GPipe:
 
         return state_dict(self, params, state)
 
-    def load_state_dict(self, params, state, d):
+    def load_state_dict(
+        self,
+        params: Tuple[Pytree, ...],
+        state: Tuple[Pytree, ...],
+        d: Dict,
+    ) -> Tuple[Tuple[Pytree, ...], Tuple[Pytree, ...]]:
         """Strict inverse of :meth:`state_dict` over an initialized
         ``(params, state)`` template; returns new placed pytrees."""
         from torchgpipe_tpu.utils.serialization import load_state_dict
@@ -252,8 +260,8 @@ class GPipe:
 
     def apply(
         self,
-        params,
-        state,
+        params: Tuple[Pytree, ...],
+        state: Tuple[Pytree, ...],
         x: Pytree,
         *,
         rng: Optional[jax.Array] = None,
@@ -276,7 +284,7 @@ class GPipe:
             )
         return microbatch.gather(outs), tuple(new_states)
 
-    def _split_microbatches(self, x: Pytree):
+    def _split_microbatches(self, x: Pytree) -> List[Pytree]:
         """Shared training-entry prologue: validate, scatter into
         micro-batches, resolve the checkpoint stop index.
 
@@ -297,14 +305,14 @@ class GPipe:
 
     def value_and_grad(
         self,
-        params,
-        state,
+        params: Tuple[Pytree, ...],
+        state: Tuple[Pytree, ...],
         x: Pytree,
         target: Pytree,
-        loss_fn,
+        loss_fn: Any,
         *,
         rng: Optional[jax.Array] = None,
-    ):
+    ) -> Tuple[jax.Array, Tuple[Pytree, ...], Tuple[Pytree, ...], Dict]:
         """Pipelined training step: forward, loss, backward.
 
         Under the default fill-drain schedule ``loss_fn(output, target)``
@@ -360,15 +368,15 @@ class GPipe:
 
     def value_and_grad_with_loss_params(
         self,
-        params,
-        loss_params,
-        state,
+        params: Tuple[Pytree, ...],
+        loss_params: Pytree,
+        state: Tuple[Pytree, ...],
         x: Pytree,
         target: Pytree,
-        loss_layer,
+        loss_layer: Layer,
         *,
         rng: Optional[jax.Array] = None,
-    ):
+    ) -> Tuple[jax.Array, Tuple[Pytree, ...], Pytree, Tuple[Pytree, ...], Dict]:
         """Pipelined training step with a PARAMETRIC loss layer.
 
         ``loss_layer`` is a :class:`~torchgpipe_tpu.layers.Layer` applied to

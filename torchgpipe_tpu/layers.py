@@ -298,7 +298,13 @@ def chain(sub: Sequence[Layer], name: str = "chain") -> Layer:
     )
 
 
-def _infer_layer(layer: Layer, params, state, in_spec: Spec, pops_spec):
+def _infer_layer(
+    layer: Layer,
+    params: Pytree,
+    state: Pytree,
+    in_spec: Spec,
+    pops_spec: Any,
+) -> Tuple[Spec, Spec]:
     """Shape-infer one layer application (skip-aware) via ``eval_shape``."""
 
     def run(p, s, x, pops):
@@ -313,7 +319,13 @@ def _infer_layer(layer: Layer, params, state, in_spec: Spec, pops_spec):
     return jax.eval_shape(run, params, state, x, pops_spec)
 
 
-def _spec_step(layer: Layer, params, state, spec: Spec, skip_specs: dict) -> Spec:
+def _spec_step(
+    layer: Layer,
+    params: Pytree,
+    state: Pytree,
+    spec: Spec,
+    skip_specs: dict,
+) -> Spec:
     """Thread one layer's shape inference (incl. skip-connection specs)."""
     pops_spec = {k: skip_specs.pop(k) for k in layer.pop}
     new_spec, stashed_spec = _infer_layer(layer, params, state, spec, pops_spec)

@@ -79,8 +79,8 @@ def _relu_conv_bn(
     out_ch: int,
     kernel: Tuple[int, int] = (1, 1),
     stride: Tuple[int, int] = (1, 1),
-    padding=((0, 0), (0, 0)),
-    name: str = "rcb",
+    padding: Tuple[Tuple[int, int], Tuple[int, int]] = ((0, 0), (0, 0)),
+    name: str = 'rcb',
 ) -> Layer:
     return chain(
         [

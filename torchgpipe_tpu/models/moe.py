@@ -45,12 +45,14 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+
+Pytree = Any
 
 from torchgpipe_tpu.auxgrad import current_aux_scale
 from torchgpipe_tpu.layers import Layer, chain
@@ -122,12 +124,20 @@ class MoEConfig:
 
 
 @jax.custom_vjp
-def _aux_inject(y, aux, scaled_weight):
+def _aux_inject(
+    y: jnp.ndarray,
+    aux: jnp.ndarray,
+    scaled_weight: jnp.ndarray,
+) -> jnp.ndarray:
     del aux, scaled_weight
     return y
 
 
-def _aux_inject_fwd(y, aux, scaled_weight):
+def _aux_inject_fwd(
+    y: jnp.ndarray,
+    aux: jnp.ndarray,
+    scaled_weight: jnp.ndarray,
+) -> Tuple[jnp.ndarray, Tuple]:
     # scaled_weight is a traced INPUT recorded at the primal call site, so
     # the engine's aux scale is baked in no matter when the vjp rule is
     # elaborated (custom_vjp traces fwd lazily, at linearization time —
@@ -135,14 +145,21 @@ def _aux_inject_fwd(y, aux, scaled_weight):
     return y, scaled_weight
 
 
-def _aux_inject_bwd(res, g):
+def _aux_inject_bwd(
+    res: Tuple,
+    g: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     return g, res, jnp.zeros_like(res)
 
 
 _aux_inject.defvjp(_aux_inject_fwd, _aux_inject_bwd)
 
 
-def add_aux_grad(y, aux, weight):
+def add_aux_grad(
+    y: jnp.ndarray,
+    aux: jnp.ndarray,
+    weight: float,
+) -> jnp.ndarray:
     """Identity on ``y`` whose backward adds ``weight * aux_scale`` to
     ``aux``'s cotangent (``aux_scale`` is the engines' trace-time
     micro-batch weighting, :mod:`torchgpipe_tpu.auxgrad`, captured here at
@@ -160,7 +177,11 @@ def add_aux_grad(y, aux, weight):
     return _aux_inject(y, aux, scaled)
 
 
-def _balance_penalty(probs: jnp.ndarray, n_experts: int, top_k: int = 1):
+def _balance_penalty(
+    probs: jnp.ndarray,
+    n_experts: int,
+    top_k: int = 1,
+) -> jnp.ndarray:
     """Switch/GShard balance penalty from router probabilities ``[t, E]``:
     ``(load, importance, E * sum(load * importance))`` — 1.0 iff perfectly
     balanced.  Single source for both the training-time injection
@@ -185,7 +206,10 @@ def _balance_penalty(probs: jnp.ndarray, n_experts: int, top_k: int = 1):
     return load, importance, n_experts * jnp.sum(load * importance)
 
 
-def _top_k_select(probs: jnp.ndarray, k: int):
+def _top_k_select(
+    probs: jnp.ndarray,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Iterative-argmax top-k routing selection shared by both dispatch
     implementations: per round the highest remaining expert is chosen and
     masked out.  Returns per-round expert indices ``[k, t]``, one-hot masks
@@ -204,14 +228,18 @@ def _top_k_select(probs: jnp.ndarray, k: int):
     return jnp.stack(idxs), masks, jnp.stack(gates)
 
 
-def _gate_denom(gates: jnp.ndarray, k: int):
+def _gate_denom(gates: jnp.ndarray, k: int) -> jnp.ndarray:
     # k>1: normalize combine weights over the k selections (GShard).  k=1
     # keeps the raw softmax probability as the gate (Switch) — normalizing
     # would pin it to ~1.0 and starve the router of gradient entirely.
     return jnp.sum(gates, axis=0) + 1e-9 if k > 1 else jnp.ones(())
 
 
-def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
+def _top_k_dispatch(
+    probs: jnp.ndarray,
+    k: int,
+    capacity: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Dense dispatch/combine tensors from router probabilities.
 
     probs: ``[t, E]`` f32.  Returns ``combine [t, E, C]`` (gate weights at
@@ -241,7 +269,10 @@ def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
     return combine, dispatch
 
 
-def _flat_assignment(probs: jnp.ndarray, k: int):
+def _flat_assignment(
+    probs: jnp.ndarray,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Shared routing prologue for the sort-based dispatch paths.
 
     Flattens the top-k routing into per-assignment arrays of length
@@ -263,7 +294,11 @@ def _flat_assignment(probs: jnp.ndarray, k: int):
     return experts, gates, order, counts
 
 
-def _sparse_assignment(probs: jnp.ndarray, k: int, capacity: int):
+def _sparse_assignment(
+    probs: jnp.ndarray,
+    k: int,
+    capacity: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Sort-based slot assignment — identical FCFS semantics to
     :func:`_top_k_dispatch` (token order within a choice round, round kk
     strictly after round kk-1) with O(t*k) bookkeeping instead of the dense
@@ -287,7 +322,10 @@ def _sparse_assignment(probs: jnp.ndarray, k: int, capacity: int):
     return experts, gates, keep, slot
 
 
-def _dropless_assignment(probs: jnp.ndarray, k: int):
+def _dropless_assignment(
+    probs: jnp.ndarray,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Expert-sorted token assignment for the dropless path.
 
     Returns ``(order, tok_sorted, group_sizes, gates)`` where
@@ -300,7 +338,7 @@ def _dropless_assignment(probs: jnp.ndarray, k: int):
     return order, tok[order], counts.astype(jnp.int32), gates
 
 
-def _expert_ffn(expert_in: jnp.ndarray, params) -> jnp.ndarray:
+def _expert_ffn(expert_in: jnp.ndarray, params: Pytree) -> jnp.ndarray:
     """Batched per-expert SwiGLU on ``[E, C, d]`` buffers (MXU einsums) —
     the one expert-compute block shared by every dispatch path that uses
     rectangular expert buffers (the dropless path's ragged twin lives
@@ -513,7 +551,11 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
     )
 
 
-def router_stats(params_router: jnp.ndarray, x: jnp.ndarray, moe: MoEConfig):
+def router_stats(
+    params_router: jnp.ndarray,
+    x: jnp.ndarray,
+    moe: MoEConfig,
+) -> Dict[str, jnp.ndarray]:
     """Standard router monitoring metrics from hidden states ``[b, s, dim]``:
     ``(load, importance, balance_loss)`` — per-expert assignment fractions
     over all ``top_k`` selection rounds, per-expert mean probabilities, and
@@ -536,7 +578,7 @@ def router_stats(params_router: jnp.ndarray, x: jnp.ndarray, moe: MoEConfig):
     return _balance_penalty(probs, moe.n_experts, moe.top_k)
 
 
-def find_routers(params) -> List[jnp.ndarray]:
+def find_routers(params: Pytree) -> List[jnp.ndarray]:
     """All router matrices in a params pytree, depth-first — lets drivers
     monitor :func:`router_stats` without knowing the nesting (e.g. the
     first MoE block of a GPipe stage list or an SPMD stacked-blocks tree)."""

@@ -14,7 +14,7 @@ pure-functional params/state.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Any, List, Optional
 
 import jax
 
@@ -153,10 +153,10 @@ def build_resnet(
     return named(layers)
 
 
-def resnet101(num_classes: int = 1000, **kwargs) -> List[Layer]:
+def resnet101(num_classes: int = 1000, **kwargs: Any) -> List[Layer]:
     """Sequential ResNet-101 (reference: benchmarks/models/resnet/__init__.py:96-98)."""
     return build_resnet([3, 4, 23, 3], num_classes, **kwargs)
 
 
-def resnet50(num_classes: int = 1000, **kwargs) -> List[Layer]:
+def resnet50(num_classes: int = 1000, **kwargs: Any) -> List[Layer]:
     return build_resnet([3, 4, 6, 3], num_classes, **kwargs)

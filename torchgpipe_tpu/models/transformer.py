@@ -18,7 +18,7 @@ Two consumption modes:
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -84,7 +84,12 @@ class TransformerConfig:
         return max(128, ((h + 127) // 128) * 128)
 
 
-def _normal(rng, shape, std, dtype):
+def _normal(
+    rng: jax.Array,
+    shape: Tuple[int, ...],
+    std: float,
+    dtype: Any,
+) -> jnp.ndarray:
     return (std * jax.random.normal(rng, shape)).astype(dtype)
 
 
@@ -109,7 +114,7 @@ def rms_norm(dim: int, *, eps: float = 1e-5, name: str = "rmsnorm") -> Layer:
     )
 
 
-def _rope(x: jnp.ndarray, theta: float, pos_offset=0) -> jnp.ndarray:
+def _rope(x: jnp.ndarray, theta: float, pos_offset: Any = 0) -> jnp.ndarray:
     """Rotary position embedding over the trailing head_dim, positions from
     shape plus ``pos_offset`` (x: [b, s, heads, head_dim]).  A non-zero
     offset gives sequence-parallel shards their *global* token positions."""
@@ -331,7 +336,11 @@ def transformer_block(
     return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
-def _local_vocab_ids(ids: jnp.ndarray, axis: str, v_loc: int):
+def _local_vocab_ids(
+    ids: jnp.ndarray,
+    axis: str,
+    v_loc: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Map global token ids onto this lane's vocab shard: ``(idx, in_range)``
     with ``idx`` clipped into ``[0, v_loc)`` and ``in_range`` marking ids the
     lane actually owns.  Shared by the vocab-parallel embedding lookup and
@@ -341,7 +350,7 @@ def _local_vocab_ids(ids: jnp.ndarray, axis: str, v_loc: int):
     return jnp.clip(local, 0, v_loc - 1), in_range
 
 
-def _vocab_meta(cfg: TransformerConfig, table_spec):
+def _vocab_meta(cfg: TransformerConfig, table_spec: Any) -> dict:
     """Shared meta for the vocab-parallel embedding/head: param sharding +
     vocab divisibility validation."""
     tp = cfg.tp_axis
@@ -388,7 +397,7 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
     return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
-def _head_init(cfg: TransformerConfig):
+def _head_init(cfg: TransformerConfig) -> Callable:
     """Final-norm scale + vocab projection params — the ONE schema shared
     by :func:`lm_head` and :func:`chunked_lm_loss`, so the two head
     configurations stay checkpoint-interchangeable."""
@@ -438,7 +447,7 @@ def lm_head(
     return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
-def vocab_parallel_cross_entropy(axis: Optional[str]):
+def vocab_parallel_cross_entropy(axis: Optional[str]) -> Callable:
     """Cross-entropy over vocab-sharded logits (``lm_head(...,
     gather_logits=False)``): full-vocabulary softmax without ever
     materializing full logits — the log-sum-exp and target-logit terms are

@@ -10,7 +10,7 @@ partitions at any layer boundary.  NHWC layout, MXU-friendly 3x3 convs;
 
 from __future__ import annotations
 
-from typing import List
+from typing import Any, List
 
 from torchgpipe_tpu.layers import Layer, named
 from torchgpipe_tpu.ops import nn
@@ -58,9 +58,9 @@ def build_vgg(
     return named(layers)
 
 
-def vgg16(num_classes: int = 1000, **kwargs) -> List[Layer]:
+def vgg16(num_classes: int = 1000, **kwargs: Any) -> List[Layer]:
     return build_vgg(16, num_classes, **kwargs)
 
 
-def vgg19(num_classes: int = 1000, **kwargs) -> List[Layer]:
+def vgg19(num_classes: int = 1000, **kwargs: Any) -> List[Layer]:
     return build_vgg(19, num_classes, **kwargs)

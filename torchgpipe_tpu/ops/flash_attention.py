@@ -27,7 +27,7 @@ entirely (SURVEY.md §2.2); this module is TPU-native new capability.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,7 +43,7 @@ _NEG = -1e30
 _STREAM_BYTES = 4 * 1024 * 1024
 
 
-def _validate_window(causal: bool, window) -> None:
+def _validate_window(causal: bool, window: Optional[int]) -> None:
     """Shared entry-point validation for sliding-window attention."""
     if window is None:
         return
@@ -55,7 +55,7 @@ def _validate_window(causal: bool, window) -> None:
         raise ValueError("window must be >= 1")
 
 
-def _kv_index(i, h: int, g: int):
+def _kv_index(i: jax.Array, h: int, g: int) -> jax.Array:
     """Row in the [b*g, s, d] K/V array for query row ``i`` of [b*h, s, d]."""
     r = h // g
     return (i // h) * g + (i % h) // r
@@ -66,8 +66,20 @@ def _kv_index(i, h: int, g: int):
 # --------------------------------------------------------------------- #
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
-                block_q, block_k, seq_k, window):
+def _fwd_kernel(
+    q_ref: Any,
+    k_ref: Any,
+    v_ref: Any,
+    o_ref: Any,
+    lse_ref: Any,
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    seq_k: int,
+    window: Optional[int],
+) -> None:
     j = pl.program_id(1)
     qb = q_ref[0].astype(jnp.float32) * sm_scale  # [Bq, d]
     nk = seq_k // block_k
@@ -107,8 +119,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
     lse_ref[0] = m + jnp.log(l)  # [Bq, 1]
 
 
-def _flash_fwd_call(q, k, v, h, g, causal, sm_scale, block_q, block_k,
-                    interpret, window=None):
+def _flash_fwd_call(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    h: int,
+    g: int,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+    window: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     bh, s, d = q.shape
     grid = (bh, s // block_q)
     kv_spec = pl.BlockSpec(
@@ -149,7 +172,13 @@ def _flash_fwd_call(q, k, v, h, g, causal, sm_scale, block_q, block_k,
 # --------------------------------------------------------------------- #
 
 
-def _causal_overlap(jq, jk, block_q, block_k, window=None):
+def _causal_overlap(
+    jq: jax.Array,
+    jk: jax.Array,
+    block_q: int,
+    block_k: int,
+    window: Optional[int] = None,
+) -> jax.Array:
     """Whether q block jq has any unmasked position against k block jk
     under causal masking, optionally banded by a sliding ``window``
     (attend iff ``0 <= qpos - kpos < window``)."""
@@ -162,13 +191,18 @@ def _causal_overlap(jq, jk, block_q, block_k, window=None):
     return ok
 
 
-def _last_valid_kv(jq, block_q, block_k):
+def _last_valid_kv(jq: jax.Array, block_q: int, block_k: int) -> jax.Array:
     """Largest K/V block index with any unmasked position for q block
     ``jq`` under causal masking (== the diagonal block)."""
     return ((jq + 1) * block_q - 1) // block_k
 
 
-def _first_valid_kv(jq, block_q, block_k, window=None):
+def _first_valid_kv(
+    jq: jax.Array,
+    block_q: int,
+    block_k: int,
+    window: Optional[int] = None,
+) -> jax.Array:
     """Smallest K/V block index inside the sliding window for q block
     ``jq`` (0 without a window)."""
     if window is None:
@@ -177,13 +211,19 @@ def _first_valid_kv(jq, block_q, block_k, window=None):
     return jnp.maximum(lo, 0) // block_k
 
 
-def _first_valid_q(jk, block_q, block_k):
+def _first_valid_q(jk: jax.Array, block_q: int, block_k: int) -> jax.Array:
     """Smallest q block index with any unmasked position against K/V
     block ``jk`` under causal masking."""
     return (jk * block_k) // block_q
 
 
-def _last_valid_q(jk, block_q, block_k, nq, window=None):
+def _last_valid_q(
+    jk: jax.Array,
+    block_q: int,
+    block_k: int,
+    nq: int,
+    window: Optional[int] = None,
+) -> jax.Array:
     """Largest q block index inside the sliding window for K/V block
     ``jk`` (``nq - 1`` without a window)."""
     if window is None:
@@ -202,7 +242,14 @@ def _last_valid_q(jk, block_q, block_k, nq, window=None):
 # BENCH_NOTES round-2 table, 87.1 vs 64.8 ms @4k).
 
 
-def _clamped_kv_block(j, jk, block_q, block_k, causal, window=None):
+def _clamped_kv_block(
+    j: jax.Array,
+    jk: jax.Array,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+    window: Optional[int] = None,
+) -> jax.Array:
     """K/V block to FETCH at streaming grid cell (q block j, step jk):
     clipped into the valid causal/window band so masked cells re-request
     a resident tile."""
@@ -215,7 +262,15 @@ def _clamped_kv_block(j, jk, block_q, block_k, causal, window=None):
     )
 
 
-def _clamped_q_block(jk, jq, block_q, block_k, causal, nq, window=None):
+def _clamped_q_block(
+    jk: jax.Array,
+    jq: jax.Array,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+    nq: int,
+    window: Optional[int] = None,
+) -> jax.Array:
     """Q block to FETCH at streaming dK/dV grid cell (kv block jk, step
     jq), clipped into the valid causal/window band."""
     if not causal:
@@ -227,7 +282,14 @@ def _clamped_q_block(jk, jq, block_q, block_k, causal, nq, window=None):
     )
 
 
-def _mask_causal(s, jq, jk, block_q, block_k, window=None):
+def _mask_causal(
+    s: jnp.ndarray,
+    jq: jax.Array,
+    jk: jax.Array,
+    block_q: int,
+    block_k: int,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
     qpos = jq * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
     kpos = jk * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
     m = qpos >= kpos
@@ -236,9 +298,23 @@ def _mask_causal(s, jq, jk, block_q, block_k, window=None):
     return jnp.where(m, s, _NEG)
 
 
-def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
-                       acc_sc, *, causal, sm_scale, block_q, block_k, nk,
-                       window):
+def _fwd_stream_kernel(
+    q_ref: Any,
+    k_ref: Any,
+    v_ref: Any,
+    o_ref: Any,
+    lse_ref: Any,
+    m_sc: Any,
+    l_sc: Any,
+    acc_sc: Any,
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    nk: int,
+    window: Optional[int],
+) -> None:
     j = pl.program_id(1)
     jk = pl.program_id(2)
 
@@ -281,8 +357,19 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
         lse_ref[0] = m_sc[...] + jnp.log(l_sc[...])
 
 
-def _flash_fwd_call_stream(q, k, v, h, g, causal, sm_scale, block_q,
-                           block_k, interpret, window=None):
+def _flash_fwd_call_stream(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    h: int,
+    g: int,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+    window: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     bh, s, d = q.shape
     sk = k.shape[1]
     nk = sk // block_k
@@ -321,9 +408,23 @@ def _flash_fwd_call_stream(q, k, v, h, g, causal, sm_scale, block_q,
     return o, lse
 
 
-def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dq_sc, *, causal, sm_scale, block_q, block_k,
-                      nk, window):
+def _dq_stream_kernel(
+    q_ref: Any,
+    k_ref: Any,
+    v_ref: Any,
+    do_ref: Any,
+    lse_ref: Any,
+    delta_ref: Any,
+    dq_ref: Any,
+    dq_sc: Any,
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    nk: int,
+    window: Optional[int],
+) -> None:
     j = pl.program_id(1)
     jk = pl.program_id(2)
 
@@ -364,9 +465,25 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = (dq_sc[...] * sm_scale).astype(dq_ref.dtype)
 
 
-def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, dk_sc, dv_sc, *, causal, sm_scale,
-                       block_q, block_k, nq, window):
+def _dkv_stream_kernel(
+    q_ref: Any,
+    k_ref: Any,
+    v_ref: Any,
+    do_ref: Any,
+    lse_ref: Any,
+    delta_ref: Any,
+    dk_ref: Any,
+    dv_ref: Any,
+    dk_sc: Any,
+    dv_sc: Any,
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    nq: int,
+    window: Optional[int],
+) -> None:
     jk = pl.program_id(1)
     jq = pl.program_id(2)
 
@@ -418,8 +535,22 @@ def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # --------------------------------------------------------------------- #
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               causal, sm_scale, block_q, block_k, seq_k, window):
+def _dq_kernel(
+    q_ref: Any,
+    k_ref: Any,
+    v_ref: Any,
+    do_ref: Any,
+    lse_ref: Any,
+    delta_ref: Any,
+    dq_ref: Any,
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    seq_k: int,
+    window: Optional[int],
+) -> None:
     j = pl.program_id(1)
     qb = q_ref[0].astype(jnp.float32)
     dob = do_ref[0].astype(jnp.float32)
@@ -458,9 +589,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, causal, sm_scale, block_q, block_k,
-                seq_q, window):
+def _dkv_kernel(
+    q_ref: Any,
+    k_ref: Any,
+    v_ref: Any,
+    do_ref: Any,
+    lse_ref: Any,
+    delta_ref: Any,
+    dk_ref: Any,
+    dv_ref: Any,
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    seq_q: int,
+    window: Optional[int],
+) -> None:
     jk = pl.program_id(1)
     kb = k_ref[0].astype(jnp.float32)  # [Bk, d]
     vb = v_ref[0].astype(jnp.float32)
@@ -517,8 +662,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
 )
-def _flash(q, k, v, h, g, causal, sm_scale, blocks, interpret, streaming,
-           window):
+def _flash(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    h: int,
+    g: int,
+    causal: bool,
+    sm_scale: float,
+    blocks: Optional[Tuple[int, int]],
+    interpret: bool,
+    streaming: bool,
+    window: Optional[int],
+) -> jnp.ndarray:
     fwd = _flash_fwd_call_stream if streaming else _flash_fwd_call
     o, _ = fwd(
         q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret,
@@ -527,8 +683,19 @@ def _flash(q, k, v, h, g, causal, sm_scale, blocks, interpret, streaming,
     return o
 
 
-def _flash_vjp_fwd(q, k, v, h, g, causal, sm_scale, blocks, interpret,
-                   streaming, window):
+def _flash_vjp_fwd(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    h: int,
+    g: int,
+    causal: bool,
+    sm_scale: float,
+    blocks: Optional[Tuple[int, int]],
+    interpret: bool,
+    streaming: bool,
+    window: Optional[int],
+) -> Tuple:
     fwd = _flash_fwd_call_stream if streaming else _flash_fwd_call
     o, lse = fwd(
         q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret,
@@ -537,8 +704,18 @@ def _flash_vjp_fwd(q, k, v, h, g, causal, sm_scale, blocks, interpret,
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(h, g, causal, sm_scale, blocks, interpret, streaming,
-                   window, res, do):
+def _flash_vjp_bwd(
+    h: int,
+    g: int,
+    causal: bool,
+    sm_scale: float,
+    blocks: Optional[Tuple[int, int]],
+    interpret: bool,
+    streaming: bool,
+    window: Optional[int],
+    res: Tuple,
+    do: jnp.ndarray,
+) -> Tuple:
     if streaming:
         return _flash_bwd_stream(
             h, g, causal, sm_scale, blocks, interpret, res, do, window
@@ -548,8 +725,17 @@ def _flash_vjp_bwd(h, g, causal, sm_scale, blocks, interpret, streaming,
     )
 
 
-def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do,
-                      window=None):
+def _flash_bwd_stream(
+    h: int,
+    g: int,
+    causal: bool,
+    sm_scale: float,
+    blocks: Optional[Tuple[int, int]],
+    interpret: bool,
+    res: Tuple,
+    do: jnp.ndarray,
+    window: Optional[int] = None,
+) -> Tuple:
     q, k, v, o, lse = res
     block_q, block_k = blocks
     bh, s, d = q.shape
@@ -626,8 +812,17 @@ def _flash_bwd_stream(h, g, causal, sm_scale, blocks, interpret, res, do,
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_bwd_resident(h, g, causal, sm_scale, blocks, interpret, res, do,
-                        window=None):
+def _flash_bwd_resident(
+    h: int,
+    g: int,
+    causal: bool,
+    sm_scale: float,
+    blocks: Optional[Tuple[int, int]],
+    interpret: bool,
+    res: Tuple,
+    do: jnp.ndarray,
+    window: Optional[int] = None,
+) -> Tuple:
     q, k, v, o, lse = res
     block_q, block_k = blocks
     bh, s, d = q.shape

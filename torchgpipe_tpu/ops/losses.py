@@ -18,6 +18,8 @@ forward (ops/flash_attention.py) applied to the classifier axis.
 
 from __future__ import annotations
 
+from typing import Tuple
+
 from functools import partial
 import jax
 import jax.numpy as jnp
@@ -26,7 +28,7 @@ from jax import lax
 _NEG = jnp.float32(-1e30)
 
 
-def _chunks(w: jnp.ndarray, chunk: int):
+def _chunks(w: jnp.ndarray, chunk: int) -> Tuple[int, int]:
     """``[d, V] -> ([n, d, C], offsets [n])`` with zero padding on V."""
     d, V = w.shape
     n = -(-V // chunk)
@@ -65,7 +67,12 @@ def chunked_softmax_xent(
     return loss
 
 
-def _xent_fwd_scan(h, w, labels, chunk):
+def _xent_fwd_scan(
+    h: jnp.ndarray,
+    w: jnp.ndarray,
+    labels: jnp.ndarray,
+    chunk: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     V = w.shape[1]
     wc, offs = _chunks(w, chunk)
 
@@ -96,12 +103,21 @@ def _xent_fwd_scan(h, w, labels, chunk):
     return lse - tl, m, s
 
 
-def _xent_vjp_fwd(h, w, labels, chunk):
+def _xent_vjp_fwd(
+    h: jnp.ndarray,
+    w: jnp.ndarray,
+    labels: jnp.ndarray,
+    chunk: int,
+) -> Tuple[jnp.ndarray, Tuple]:
     loss, m, s = _xent_fwd_scan(h, w, labels, chunk)
     return loss, (h, w, labels, m, s)
 
 
-def _xent_vjp_bwd(chunk, res, g):
+def _xent_vjp_bwd(
+    chunk: int,
+    res: Tuple,
+    g: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, None]:
     """``g``: ``[T]`` cotangent of the per-token losses.
 
     ``dlogits = softmax - onehot(label)`` per token; both gradients are

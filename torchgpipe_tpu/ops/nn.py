@@ -12,7 +12,7 @@ its own thin layer set so models are plain JAX and lower cleanly onto the MXU:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -21,7 +21,12 @@ from jax import lax
 from torchgpipe_tpu.layers import Layer, stateless
 
 
-def _kaiming(rng, shape, fan_in, dtype=jnp.float32):
+def _kaiming(
+    rng: jax.Array,
+    shape: Tuple[int, ...],
+    fan_in: int,
+    dtype: Any = jnp.float32,
+) -> jnp.ndarray:
     std = (2.0 / fan_in) ** 0.5
     return std * jax.random.normal(rng, shape, dtype)
 
@@ -52,10 +57,10 @@ def conv2d(
     kernel_size: Tuple[int, int] = (3, 3),
     *,
     strides: Tuple[int, int] = (1, 1),
-    padding="SAME",
+    padding: Any = 'SAME',
     use_bias: bool = False,
     feature_group_count: int = 1,
-    name: str = "conv",
+    name: str = 'conv',
 ) -> Layer:
     """2-D convolution, NHWC activations, HWIO kernel."""
 
@@ -183,7 +188,14 @@ def gelu(name: str = "gelu") -> Layer:
     return stateless(name, jax.nn.gelu)
 
 
-def _pool(x, window, strides, padding, reducer, init_val):
+def _pool(
+    x: jnp.ndarray,
+    window: Tuple[int, int],
+    strides: Tuple[int, int],
+    padding: Any,
+    reducer: Callable,
+    init_val: float,
+) -> jnp.ndarray:
     dims = (1, window[0], window[1], 1)
     strs = (1, strides[0], strides[1], 1)
     if not isinstance(padding, str):

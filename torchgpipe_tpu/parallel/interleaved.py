@@ -32,7 +32,7 @@ Conventions
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -72,7 +72,14 @@ def _cell_sequence(n: int, m: int, v: int, j: int) -> List[Tuple[int, int, int]]
     return seq
 
 
-def _producer(n: int, v: int, kind: int, c: int, i: int, j: int):
+def _producer(
+    n: int,
+    v: int,
+    kind: int,
+    c: int,
+    i: int,
+    j: int,
+) -> Optional[Tuple[int, int, int, int]]:
     """The cell whose output this cell consumes, or None for an external
     input (forward chunk 0 stage 0) / the local loss seed (backward chunk
     v-1 stage n-1, which also depends on its own forward — handled by the
@@ -119,7 +126,11 @@ def _check_args(n: int, m: int, v: int) -> None:
         )
 
 
-def _lockstep_simulate(n: int, v: int, seqs: List[List[Tuple[int, int, int]]]):
+def _lockstep_simulate(
+    n: int,
+    v: int,
+    seqs: List[List[Tuple[int, int, int]]],
+) -> Tuple[List[List[int]], List[List[int]], List[List[int]]]:
     """Lockstep list-scheduling of per-device cell sequences into rows.
 
     Each tick, every device attempts its next cell; a cell runs only if
@@ -211,7 +222,7 @@ def interleaved_forward_tables(n: int, m: int, v: int) -> InterleavedTables:
     return tables
 
 
-def _min_slot_depth(span_families) -> int:
+def _min_slot_depth(span_families: Dict) -> int:
     """Smallest power-of-two ring-buffer depth S such that, within every
     family, slot ``(device, chunk, mb % S)`` never holds two live values at
     once (liveness intervals keyed ``(j, c, i) -> (start_tick, end_tick)``,
@@ -234,7 +245,12 @@ def _min_slot_depth(span_families) -> int:
     raise RuntimeError("no feasible slot count found")
 
 
-def _cell_ticks(n, rows_kind, rows_chunk, rows_mb):
+def _cell_ticks(
+    n: int,
+    rows_kind: List[List[int]],
+    rows_chunk: List[List[int]],
+    rows_mb: List[List[int]],
+) -> Tuple[Dict, Dict]:
     """Per-cell fire ticks: ``({(j,c,i): fwd_tick}, {(j,c,i): bwd_tick})``."""
     fwd_tick: dict = {}
     bwd_tick: dict = {}
@@ -248,7 +264,7 @@ def _cell_ticks(n, rows_kind, rows_chunk, rows_mb):
     return fwd_tick, bwd_tick
 
 
-def _act_spans(n, v, fwd_tick, bwd_tick) -> dict:
+def _act_spans(n: int, v: int, fwd_tick: Dict, bwd_tick: Dict) -> dict:
     """Activation inbox / saved-input liveness: from the producer's forward
     tick + 1 (the ppermute delivery; the cell's own tick when there is no
     producer) until the matching backward cell reads it (its own forward
@@ -261,7 +277,13 @@ def _act_spans(n, v, fwd_tick, bwd_tick) -> dict:
     return spans
 
 
-def _required_slots(n, v, rows_kind, rows_chunk, rows_mb) -> int:
+def _required_slots(
+    n: int,
+    v: int,
+    rows_kind: List[List[int]],
+    rows_chunk: List[List[int]],
+    rows_mb: List[List[int]],
+) -> int:
     """Slot depth for the training schedule: activation spans plus the
     cotangent-inbox spans (producer's backward tick + 1 until the consuming
     backward cell's tick)."""

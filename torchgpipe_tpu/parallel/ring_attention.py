@@ -31,7 +31,7 @@ from jax import lax
 _NEG = -1e30  # large negative instead of -inf: keeps grads NaN-free
 
 
-def _group(q, n_kv: int):
+def _group(q: jnp.ndarray, n_kv: int) -> jnp.ndarray:
     """[b, s, h, d] -> [b, s, g, r, d] with h = g*r grouped onto kv heads.
 
     GQA support at the compute site: K/V stay at their n_kv heads (so the
@@ -43,7 +43,7 @@ def _group(q, n_kv: int):
     return q.reshape(b, s, n_kv, h // n_kv, d)
 
 
-def _scores(q, k, sm_scale):
+def _scores(q: jnp.ndarray, k: jnp.ndarray, sm_scale: float) -> jnp.ndarray:
     # q [b, sq, h, d] x k [b, sk, g, d] (g divides h) -> [b, h, sq, sk];
     # f32 accumulation on the MXU (inputs may be bf16).
     g = k.shape[2]
@@ -55,7 +55,7 @@ def _scores(q, k, sm_scale):
     return s.reshape(b, g * r, sq, sk)
 
 
-def _weighted_v(p, v):
+def _weighted_v(p: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
     # p [b, h, sq, sk] x v [b, sk, g, d] -> [b, h, sq, d]
     b, h, sq, sk = p.shape
     g = v.shape[2]

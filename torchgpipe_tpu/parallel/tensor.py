@@ -35,13 +35,17 @@ lays it out that way).
 from __future__ import annotations
 
 import functools
+from typing import Any, Tuple
 
 import jax
+import jax.numpy as jnp
 from jax import lax
+
+Pytree = Any
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def psum_grad(x, axis_name: str):
+def psum_grad(x: Pytree, axis_name: str) -> Pytree:
     """Identity forward; ``psum`` of the cotangent over ``axis_name`` backward.
 
     Place at the *entry* of a tensor-parallel region (after the last
@@ -51,11 +55,11 @@ def psum_grad(x, axis_name: str):
     return x
 
 
-def _psum_grad_fwd(x, axis_name):
+def _psum_grad_fwd(x: Pytree, axis_name: str) -> Tuple[Pytree, None]:
     return x, None
 
 
-def _psum_grad_bwd(axis_name, _, g):
+def _psum_grad_bwd(axis_name: str, _: None, g: Pytree) -> Tuple[Pytree]:
     return (jax.tree_util.tree_map(lambda t: lax.psum(t, axis_name), g),)
 
 
@@ -63,7 +67,7 @@ psum_grad.defvjp(_psum_grad_fwd, _psum_grad_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def psum_value(x, axis_name: str):
+def psum_value(x: Pytree, axis_name: str) -> Pytree:
     """``psum`` over ``axis_name`` forward; identity backward.
 
     Place at the *exit* of a tensor-parallel region (after the row-parallel
@@ -75,11 +79,11 @@ def psum_value(x, axis_name: str):
     return jax.tree_util.tree_map(lambda t: lax.psum(t, axis_name), x)
 
 
-def _psum_value_fwd(x, axis_name):
+def _psum_value_fwd(x: Pytree, axis_name: str) -> Tuple[Pytree, None]:
     return psum_value(x, axis_name), None
 
 
-def _psum_value_bwd(axis_name, _, g):
+def _psum_value_bwd(axis_name: str, _: None, g: Pytree) -> Tuple[Pytree]:
     return (g,)
 
 
@@ -87,7 +91,7 @@ psum_value.defvjp(_psum_value_fwd, _psum_value_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def pmax_stop(x, axis_name: str):
+def pmax_stop(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     """``pmax`` over ``axis_name`` with zero gradient.
 
     For numerical-stability maxima (log-sum-exp shifts) whose analytic
@@ -98,11 +102,13 @@ def pmax_stop(x, axis_name: str):
     return lax.pmax(x, axis_name)
 
 
-def _pmax_stop_fwd(x, axis_name):
+def _pmax_stop_fwd(
+    x: jnp.ndarray, axis_name: str
+) -> Tuple[jnp.ndarray, None]:
     return pmax_stop(x, axis_name), None
 
 
-def _pmax_stop_bwd(axis_name, _, g):
+def _pmax_stop_bwd(axis_name: str, _: None, g: Pytree) -> Tuple[jnp.ndarray]:
     return (jax.tree_util.tree_map(lambda t: t * 0, g),)
 
 
@@ -110,7 +116,9 @@ pmax_stop.defvjp(_pmax_stop_fwd, _pmax_stop_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def all_gather_value(x, axis_name: str, axis: int = -1):
+def all_gather_value(
+    x: jnp.ndarray, axis_name: str, axis: int = -1
+) -> jnp.ndarray:
     """``all_gather`` shards along ``axis`` forward; *slice* backward.
 
     Forward: every lane receives the full array (lane shards concatenated
@@ -126,11 +134,20 @@ def all_gather_value(x, axis_name: str, axis: int = -1):
     return lax.all_gather(x, axis_name, axis=axis, tiled=True)
 
 
-def _all_gather_value_fwd(x, axis_name, axis):
+def _all_gather_value_fwd(
+    x: Pytree,
+    axis_name: str,
+    axis: int,
+) -> Tuple[jnp.ndarray, int]:
     return all_gather_value(x, axis_name, axis), x.shape[axis % x.ndim]
 
 
-def _all_gather_value_bwd(axis_name, axis, local_size, g):
+def _all_gather_value_bwd(
+    axis_name: str,
+    axis: int,
+    local_size: int,
+    g: Pytree,
+) -> Tuple[jnp.ndarray]:
     lane = lax.axis_index(axis_name)
     ax = axis % g.ndim
     return (

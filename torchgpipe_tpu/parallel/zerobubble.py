@@ -35,7 +35,7 @@ No reference counterpart at any level: the reference has fill-drain only
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -62,7 +62,7 @@ def _zb_sequence(n: int, m: int, j: int) -> List[Tuple[int, int]]:
     return seq
 
 
-def _dep(n: int, kind: int, i: int, j: int):
+def _dep(n: int, kind: int, i: int, j: int) -> Optional[Tuple[int, int, int]]:
     """The remote cell this cell consumes, or None (external input /
     same-stage dependencies handled by the caller)."""
     if kind == F:

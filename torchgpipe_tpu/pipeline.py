@@ -30,7 +30,7 @@ homogeneous stacked stages inside one jitted program, see
 from __future__ import annotations
 
 import contextlib
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -65,7 +65,7 @@ def one_f1b_orders(m: int, n: int) -> List[List[Tuple[str, int]]]:
     return orders
 
 
-def clock_cycles(m: int, n: int):
+def clock_cycles(m: int, n: int) -> Iterator[List[Tuple[int, int]]]:
     """Generate the GPipe fill-drain schedule.
 
     Reference: torchgpipe/pipeline.py:49-65.  Cycle ``k`` runs cells
@@ -79,13 +79,13 @@ def clock_cycles(m: int, n: int):
         yield [(k - j, j) for j in range(max(0, k - m + 1), min(k + 1, n))]
 
 
-def _transfer(x: Pytree, device) -> Pytree:
+def _transfer(x: Pytree, device: Any) -> Pytree:
     """Async device-to-device move (ICI on TPU); no-op if already there."""
     return jax.device_put(x, device)
 
 
 @contextlib.contextmanager
-def _cell_context(j: int, i: int, phase: str):
+def _cell_context(j: int, i: int, phase: str) -> Iterator[None]:
     """Annotate any exception escaping a cell with the offending stage.
 
     The reference propagates the first exception out of its worker threads
@@ -114,7 +114,7 @@ class StageExec:
         index: int,
         layers: Sequence[Layer],
         layer_offset: int,
-        device,
+        device: Any,
         layout: SkipLayout,
     ) -> None:
         self.index = index
@@ -164,7 +164,12 @@ class StageExec:
         )
 
     @staticmethod
-    def _jit_with_phase(fn, *, checkpointing: bool = False, recomputing: bool = False):
+    def _jit_with_phase(
+        fn: Callable,
+        *,
+        checkpointing: bool = False,
+        recomputing: bool = False,
+    ) -> Callable:
         # aux_s: runtime weight for injected auxiliary gradients (MoE
         # balance) in this cell — the engine passes the exact 1/m of the
         # current run (micro-batch count may differ from `chunks` for
@@ -177,7 +182,7 @@ class StageExec:
 
         return jax.jit(wrapped)
 
-    def _make_stage_apply(self):
+    def _make_stage_apply(self) -> Callable:
         layers = self.layers
         offset = self.layer_offset
         ext_stash_keys = tuple(self.ext_stash_keys)
@@ -212,8 +217,12 @@ class LossGradRunner:
         self._maxsize = maxsize
 
     def __call__(
-        self, outs: List[Pytree], target: Pytree, loss_fn, loss_params=None
-    ):
+        self,
+        outs: List[Pytree],
+        target: Pytree,
+        loss_fn: Any,
+        loss_params: Optional[Pytree] = None,
+    ) -> Tuple[jax.Array, List[Pytree], Pytree]:
         sizes = tuple(
             jax.tree_util.tree_leaves(o)[0].shape[0] for o in outs
         )
@@ -288,7 +297,7 @@ class Pipeline:
         self,
         stages: Sequence[StageExec],
         layout: SkipLayout,
-        tracer=None,
+        tracer: Any = None,
     ) -> None:
         self.stages = list(stages)
         self.layout = layout
@@ -351,11 +360,11 @@ class Pipeline:
         states: Sequence[Pytree],
         mbatches: List[Pytree],
         target: Pytree,
-        loss_fn,
+        loss_fn: Any,
         rng: Optional[jax.Array],
         checkpoint_stop: int,
-        loss_params=None,
-    ):
+        loss_params: Optional[Pytree] = None,
+    ) -> Tuple[jax.Array, List[Pytree], List[Pytree], List[Pytree], Pytree]:
         """Full pipelined forward, loss, and backward.
 
         Returns ``(loss, grads_per_stage, new_states, aux)`` where ``aux`` is
@@ -460,11 +469,11 @@ class Pipeline:
         states: Sequence[Pytree],
         mbatches: List[Pytree],
         target_mbs: List[Pytree],
-        loss_fn,
+        loss_fn: Any,
         rng: Optional[jax.Array],
         checkpoint_stop: int,
         loss_weights: Sequence[float],
-    ):
+    ) -> Tuple[jax.Array, List[Pytree], List[Pytree], List[Pytree], Pytree]:
         """One-forward-one-backward schedule (no reference counterpart —
         GPipe fill-drain is the reference's only schedule, pipeline.py:49-65).
 
@@ -594,7 +603,7 @@ class Pipeline:
         loss = self._sum_losses([_transfer(l, last_dev) for l in losses])
         return loss, acc, cur_states, auxes
 
-    def _loss_jit(self, key, build):
+    def _loss_jit(self, key: Any, build: Callable) -> Callable:
         """Bounded cache for the cheap 1F1B loss helpers — separate from
         ``self._fused`` so these never evict expensive whole-step programs."""
         fn = self._loss_jits.get(key)
@@ -605,7 +614,13 @@ class Pipeline:
             self._loss_jits[key] = fn
         return fn
 
-    def _mb_loss(self, out, tgt, weight, loss_fn):
+    def _mb_loss(
+        self,
+        out: Pytree,
+        tgt: Pytree,
+        weight: float,
+        loss_fn: Any,
+    ) -> jax.Array:
         """Per-micro-batch weighted loss, cotangent and aux (cached jit)."""
         key = (
             "mb_loss",
@@ -630,7 +645,7 @@ class Pipeline:
         fn = self._loss_jit(key, build)
         return fn(out, tgt, jnp.asarray(weight, jnp.float32))
 
-    def _sum_losses(self, losses):
+    def _sum_losses(self, losses: Sequence[jax.Array]) -> jax.Array:
         fn = self._loss_jit(
             ("sum_losses", len(losses)), lambda: lambda ls: sum(ls[1:], ls[0])
         )
@@ -644,7 +659,7 @@ class Pipeline:
         """True when every stage lives on the same physical device."""
         return len({id(s.device) for s in self.stages}) == 1
 
-    def _fused_cell(self, stage: StageExec, checkpointed: bool):
+    def _fused_cell(self, stage: StageExec, checkpointed: bool) -> Callable:
         """One (micro-batch, stage) cell for the fused trace; ``jax.checkpoint``
         reproduces the engine's activation-memory profile per cell."""
         fn = stage.stage_apply
@@ -665,7 +680,15 @@ class Pipeline:
 
         return jax.checkpoint(cell)
 
-    def _fused_forward_loop(self, cell_of, m, params, states, mbatches, rng):
+    def _fused_forward_loop(
+        self,
+        cell_of: Callable,
+        m: int,
+        params: Sequence[Pytree],
+        states: Sequence[Pytree],
+        mbatches: List[Pytree],
+        rng: Optional[jax.Array],
+    ) -> Tuple[List[Pytree], List[Pytree], Dict, List[Pytree]]:
         """The micro-batch × stage loop shared by both fused traces.
 
         ``cell_of(i, j)`` returns the cell callable for micro-batch ``i`` on
@@ -688,7 +711,13 @@ class Pipeline:
             outs.append(x)
         return outs, cur_states
 
-    def _fused_jit(self, kind, mbatches, extra_key, build):
+    def _fused_jit(
+        self,
+        kind: str,
+        mbatches: List[Pytree],
+        extra_key: Any,
+        build: Callable,
+    ) -> Callable:
         """Bounded cache of fused jitted programs, keyed by micro-batch
         shapes/structure plus ``extra_key``."""
         sizes = tuple(
@@ -712,10 +741,10 @@ class Pipeline:
         states: Sequence[Pytree],
         mbatches: List[Pytree],
         target: Pytree,
-        loss_fn,
+        loss_fn: Any,
         rng: Optional[jax.Array],
         checkpoint_stop: int,
-    ):
+    ) -> Tuple[jax.Array, List[Pytree], List[Pytree], List[Pytree], Pytree]:
         """Whole training step as ONE compiled XLA program.
 
         Semantically identical to :meth:`run_train` (same cell math, same
@@ -773,7 +802,12 @@ class Pipeline:
             outs, new_states = fn(params, states, mbatches, rng)
         return list(outs), list(new_states)
 
-    def _build_train_fused(self, m: int, loss_fn, checkpoint_stop: int):
+    def _build_train_fused(
+        self,
+        m: int,
+        loss_fn: Any,
+        checkpoint_stop: int,
+    ) -> Callable:
         cells = [
             [self._fused_cell(stage, i < checkpoint_stop) for stage in self.stages]
             for i in range(m)
@@ -803,8 +837,12 @@ class Pipeline:
     # ------------------------------------------------------------------ #
 
     def _loss_and_grads(
-        self, outs: List[Pytree], target: Pytree, loss_fn, loss_params=None
-    ):
+        self,
+        outs: List[Pytree],
+        target: Pytree,
+        loss_fn: Any,
+        loss_params: Optional[Pytree] = None,
+    ) -> Tuple[jax.Array, List[Pytree], Pytree]:
         """Gather outputs on the last stage device, compute the loss on the
         full mini-batch (transparency with the un-pipelined model), and split
         the output cotangent back into micro-batch cotangents."""

@@ -44,7 +44,7 @@ _NORM_KINDS = (
 )
 
 
-def _cast_floats(tree: Any, dtype) -> Any:
+def _cast_floats(tree: Any, dtype: Any) -> Any:
     return jax.tree_util.tree_map(
         lambda a: a.astype(dtype)
         if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
@@ -53,7 +53,7 @@ def _cast_floats(tree: Any, dtype) -> Any:
     )
 
 
-def _wrap_compute(layer: Layer, dtype) -> Layer:
+def _wrap_compute(layer: Layer, dtype: Any) -> Layer:
     """Run ``layer`` in ``dtype``: float params and inputs are cast down."""
     raw_apply = layer.apply
 
@@ -84,7 +84,7 @@ def _wrap_compute(layer: Layer, dtype) -> Layer:
     return dataclasses.replace(layer, apply=apply)
 
 
-def _wrap_norm(layer: Layer, dtype) -> Layer:
+def _wrap_norm(layer: Layer, dtype: Any) -> Layer:
     """Run a statistics layer in float32, returning the compute dtype."""
     raw_apply = layer.apply
 
@@ -106,14 +106,15 @@ def _is_norm(layer: Layer) -> bool:
     return isinstance(meta, dict) and meta.get("kind") in _NORM_KINDS
 
 
-def _convert_leaf(layer: Layer, dtype) -> Layer:
+def _convert_leaf(layer: Layer, dtype: Any) -> Layer:
     if _is_norm(layer):
         return _wrap_norm(layer, dtype)
     return _wrap_compute(layer, dtype)
 
 
 def apply_policy(
-    layers: Sequence[Layer], compute_dtype=jnp.bfloat16
+    layers: Sequence[Layer],
+    compute_dtype: Any = jnp.bfloat16,
 ) -> List[Layer]:
     """Return layers rewritten to compute in ``compute_dtype``.
 

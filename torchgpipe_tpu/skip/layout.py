@@ -10,7 +10,7 @@ portals for (torchgpipe/skip/portal.py:1-8).
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Sequence, Tuple
 
 from torchgpipe_tpu.layers import Layer
 
@@ -24,7 +24,7 @@ class SkipLayout:
     def __init__(self, by_key: Dict[Tuple, Tuple[int, int]]) -> None:
         self.by_key = dict(by_key)
 
-    def requires_copy(self, key) -> bool:
+    def requires_copy(self, key: Any) -> bool:
         """True if the skip crosses a stage boundary.
 
         Reference: torchgpipe/skip/layout.py:53-58.
@@ -44,10 +44,10 @@ class SkipLayout:
             k for k, (src, dst) in self.by_key.items() if dst == stage and src != stage
         )
 
-    def pop_stage(self, key) -> int:
+    def pop_stage(self, key: Any) -> int:
         return self.by_key[key][1]
 
-    def stash_stage(self, key) -> int:
+    def stash_stage(self, key: Any) -> int:
         return self.by_key[key][0]
 
 

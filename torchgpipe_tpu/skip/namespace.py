@@ -8,6 +8,8 @@ dict keys during flattening.
 
 from __future__ import annotations
 
+from typing import Any, Tuple
+
 import uuid
 from functools import total_ordering
 
@@ -25,12 +27,12 @@ class Namespace:
     def __hash__(self) -> int:
         return hash(self._id)
 
-    def __eq__(self, other) -> bool:
+    def __eq__(self, other: object) -> bool:
         if isinstance(other, Namespace):
             return self._id == other._id
         return NotImplemented
 
-    def __lt__(self, other) -> bool:
+    def __lt__(self, other: object) -> bool:
         if isinstance(other, Namespace):
             return self._id < other._id
         if other is None:
@@ -38,7 +40,7 @@ class Namespace:
         return NotImplemented
 
 
-def skip_key(ns, name):
+def skip_key(ns: Any, name: str) -> Tuple:
     """Canonical (namespace, name) key; namespace may be None."""
     return (_NsKey(ns), name)
 
@@ -49,7 +51,7 @@ class _NsKey:
 
     __slots__ = ("ns",)
 
-    def __init__(self, ns) -> None:
+    def __init__(self, ns: Any) -> None:
         if not (ns is None or isinstance(ns, Namespace)):
             raise TypeError("namespace must be a Namespace or None")
         self.ns = ns
@@ -60,12 +62,12 @@ class _NsKey:
     def __hash__(self) -> int:
         return hash(self.ns)
 
-    def __eq__(self, other) -> bool:
+    def __eq__(self, other: object) -> bool:
         if isinstance(other, _NsKey):
             return self.ns == other.ns
         return NotImplemented
 
-    def __lt__(self, other) -> bool:
+    def __lt__(self, other: object) -> bool:
         if not isinstance(other, _NsKey):
             return NotImplemented
         if self.ns is None:

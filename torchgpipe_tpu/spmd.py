@@ -37,7 +37,7 @@ engine for BatchNorm-style stateful CNNs).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,7 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchgpipe_tpu import microbatch
 from torchgpipe_tpu.auxgrad import aux_scale
-from torchgpipe_tpu.layers import Layer
+from torchgpipe_tpu.layers import Layer, Spec
 from torchgpipe_tpu.parallel.tensor import all_gather_value
 
 Pytree = Any
@@ -160,7 +160,7 @@ def broadcast_specs(prefix: Pytree, tree: Pytree) -> Pytree:
     )
 
 
-def _interleaved_rows(tb):
+def _interleaved_rows(tb: Any) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Schedule tables as scan xs: per-tick (kind, chunk, mb) rows plus the
     previous tick's rows (tick -1 = all idle), for sender classification."""
     from torchgpipe_tpu.parallel.interleaved import IDLE
@@ -181,7 +181,7 @@ def _interleaved_rows(tb):
     )
 
 
-def _sub_key(base, i):
+def _sub_key(base: Optional[jax.Array], i: jax.Array) -> Optional[jax.Array]:
     """Per-micro-batch sub-key, or None when running without rng."""
     return None if base is None else jax.random.fold_in(base, i)
 
@@ -192,7 +192,9 @@ except Exception:  # pragma: no cover - version fallback
     from jax.core import Literal as _JaxprLiteral
 
 
-def _never_mode_spec(vjp_of, param_trees, x0):
+def _never_mode_spec(
+    vjp_of: Callable, param_trees: Sequence[Pytree], x0: Pytree
+) -> Tuple[Any, List[Any], List[bool]]:
     """Canonical residual spec for the checkpoint='never' stored-vjp path.
 
     One abstract trace of ``vjp_of(params..., x0)`` yields BOTH the jaxpr
@@ -223,7 +225,9 @@ def _never_mode_spec(vjp_of, param_trees, x0):
     return tdef, leaf_specs, passthrough, buffered_idx
 
 
-def _never_check_leaves(leaves, leaf_specs, what):
+def _never_check_leaves(
+    leaves: Sequence[Any], leaf_specs: Sequence[Any], what: str
+) -> None:
     """Loud trace-time guard: the live vjp residual structure must match
     the canonical trace leaf-for-leaf, or the rebuild would silently
     misalign."""
@@ -237,7 +241,13 @@ def _never_check_leaves(leaves, leaf_specs, what):
         )
 
 
-def _never_rebuild(tdef, leaf_specs, passthrough, buffered_iter, live_flat):
+def _never_rebuild(
+    tdef: Any,
+    leaf_specs: Sequence[Any],
+    passthrough: Sequence[bool],
+    buffered_iter: Any,
+    live_flat: Sequence[Any],
+) -> Any:
     """Reassemble the full residual list (pass-through param leaves LIVE,
     the rest from the ring buffer) and rebuild the vjp closure."""
     leaves = [
@@ -247,7 +257,7 @@ def _never_rebuild(tdef, leaf_specs, passthrough, buffered_iter, live_flat):
     return jax.tree_util.tree_unflatten(tdef, leaves)
 
 
-def _pad_batch(tree, pad):
+def _pad_batch(tree: Pytree, pad: int) -> Pytree:
     """Pad dim 0 by ``pad`` rows, edge-replicating the last row — replicas
     are valid inputs for any layer/loss (no NaN traps from zero tokens);
     the ragged-batch mask zeroes their loss and gradient contribution.
@@ -264,14 +274,16 @@ def _pad_batch(tree, pad):
     )
 
 
-def _slot_read(buf, idx):
+def _slot_read(buf: Pytree, idx: jax.Array) -> Pytree:
     """Read slot ``idx`` from a stacked ring-buffer pytree."""
     return jax.tree_util.tree_map(
         lambda b: lax.dynamic_index_in_dim(b, idx, 0, keepdims=False), buf
     )
 
 
-def _slot_write(buf, idx, val, valid):
+def _slot_write(
+    buf: Pytree, idx: jax.Array, val: Pytree, valid: jax.Array
+) -> Pytree:
     """Write ``val`` into slot ``idx`` where ``valid``, else keep."""
     cur = _slot_read(buf, idx)
     new = jax.tree_util.tree_map(
@@ -284,7 +296,15 @@ def _slot_write(buf, idx, val, valid):
     )
 
 
-def _classify_fwd_recv(stage, n, v, S, pkrow, pcrow, pirow):
+def _classify_fwd_recv(
+    stage: jax.Array,
+    n: int,
+    v: int,
+    S: int,
+    pkrow: np.ndarray,
+    pcrow: np.ndarray,
+    pirow: np.ndarray,
+) -> Tuple[jax.Array, jax.Array]:
     """Forward-ring receive routing: the value arriving at this tick is
     whatever the ring predecessor computed last tick.  Returns the inbox
     slot index and a validity mask (the wrap n-1 -> 0 advances the chunk;
@@ -298,7 +318,15 @@ def _classify_fwd_recv(stage, n, v, S, pkrow, pcrow, pirow):
     return tc * S + pi % S, valid
 
 
-def _classify_bwd_recv(stage, n, v, S, pkrow, pcrow, pirow):
+def _classify_bwd_recv(
+    stage: jax.Array,
+    n: int,
+    v: int,
+    S: int,
+    pkrow: np.ndarray,
+    pcrow: np.ndarray,
+    pirow: np.ndarray,
+) -> Tuple[jax.Array, jax.Array]:
     """Backward-ring receive routing (the wrap 0 -> n-1 retreats the chunk;
     chunk 0's input cotangent leaves the model and is discarded)."""
     from torchgpipe_tpu.parallel.interleaved import BWD
@@ -310,7 +338,7 @@ def _classify_bwd_recv(stage, n, v, S, pkrow, pcrow, pirow):
     return tc * S + pi % S, valid
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
+def _shard_map(fn: Callable, mesh: Mesh, in_specs: Any, out_specs: Any) -> Callable:
     try:
         return jax.shard_map(
             fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
@@ -449,7 +477,7 @@ class SpmdGPipe:
             f"mesh={axes}{extras})"
         )
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if self.pp_axis not in self.mesh.axis_names:
             raise ValueError(f"mesh has no {self.pp_axis!r} axis: {self.mesh}")
         # loss_fn may be a parametric LOSS LAYER (init/apply with params;
@@ -712,7 +740,15 @@ class SpmdGPipe:
     # (1F1B and interleaved)                                            #
     # ------------------------------------------------------------------ #
 
-    def _cell_input_splice(self, p_pre, first, i, fallback, x_mb, pre_base):
+    def _cell_input_splice(
+        self,
+        p_pre: Pytree,
+        first: jax.Array,
+        i: jax.Array,
+        fallback: Pytree,
+        x_mb: Pytree,
+        pre_base: Optional[jax.Array],
+    ) -> Pytree:
         """The model's first block input (``pre`` applied to the raw
         micro-batch) where ``first`` holds for this cell; ``fallback`` (the
         ring hand-off, or the saved input in backward cells) elsewhere.
@@ -741,7 +777,9 @@ class SpmdGPipe:
             )
         return tmap(lambda a, r: jnp.where(first, a, r), x0, fallback)
 
-    def _loss_call(self, p_loss, y, tgt, train=True):
+    def _loss_call(
+        self, p_loss: Pytree, y: Pytree, tgt: Pytree, train: bool = True
+    ) -> jax.Array:
         """The engine's one loss entry point: a plain ``loss_fn(y, tgt)``
         callable, or a parametric loss layer applied to ``(y, tgt)`` with
         its own params (e.g. the fused chunked-vocab cross-entropy,
@@ -753,7 +791,14 @@ class SpmdGPipe:
             return out
         return self.loss_fn(y, tgt)
 
-    def _masked_loss_sum(self, p_loss, y, tgt, mask, train=True):
+    def _masked_loss_sum(
+        self,
+        p_loss: Pytree,
+        y: Pytree,
+        tgt: Pytree,
+        mask: jax.Array,
+        train: bool = True,
+    ) -> jax.Array:
         """``Σ_rows mask · loss_fn(row)`` — the ragged-batch weighting
         primitive.
 
@@ -789,7 +834,7 @@ class SpmdGPipe:
 
         return jnp.sum(jax.vmap(row)(y, tgt) * mask)
 
-    def _mask_mean_scale(self, mask_local):
+    def _mask_mean_scale(self, mask_local: jax.Array) -> jax.Array:
         """Traced per-lane scale turning a lane-local masked row-loss SUM
         into a value whose dp/ep ``pmean``s give the global masked mean:
         dp·ep (the later pmeans divide it back) over the REAL row count.
@@ -804,8 +849,17 @@ class SpmdGPipe:
                 dpep *= self.mesh.shape[ax]
         return dpep / n_real
 
-    def _cell_mb_loss(self, y, p_post, p_loss, i, tgt_mb, post_base,
-                      mask_mb=None, mean_scale=None):
+    def _cell_mb_loss(
+        self,
+        y: Pytree,
+        p_post: Pytree,
+        p_loss: Pytree,
+        i: jax.Array,
+        tgt_mb: Pytree,
+        post_base: Optional[jax.Array],
+        mask_mb: Optional[jax.Array] = None,
+        mean_scale: Optional[jax.Array] = None,
+    ) -> jax.Array:
         """Per-micro-batch head + loss for a final cell (aux scale 1/m:
         the m cells average to one mini-batch, mirroring the fill-drain
         head's 1/n over n batch slices).  With ``mask_mb`` (ragged
@@ -840,7 +894,9 @@ class SpmdGPipe:
     # cross-axis gradient reductions (shared by both schedules)          #
     # ------------------------------------------------------------------ #
 
-    def _reduce_dp(self, loss, grads, *, scatter_blocks: bool):
+    def _reduce_dp(
+        self, loss: jax.Array, grads: Pytree, *, scatter_blocks: bool
+    ) -> Tuple[jax.Array, Pytree]:
         """dp-axis loss/grad reduction, fsdp-aware.
 
         ``scatter_blocks=False`` (fill-drain): block grads arrived via the
@@ -874,7 +930,7 @@ class SpmdGPipe:
                 grads[k] = lax.pmean(grads[k], self.dp_axis)
         return loss, grads
 
-    def _reduce_ep(self, loss, grads):
+    def _reduce_ep(self, loss: jax.Array, grads: Pytree) -> Tuple[jax.Array, Pytree]:
         """ep-axis reduction: ep shards the batch like an extra dp axis,
         but expert weights are *sharded* over it — their lane-local grads
         already sum contributions from every lane's tokens (the all_to_all
@@ -1075,7 +1131,7 @@ class SpmdGPipe:
         jax.tree_util.tree_map(chk, blocks, specs)
 
     @staticmethod
-    def _check_stateless(state, what: str) -> None:
+    def _check_stateless(state: Pytree, what: str) -> None:
         if jax.tree_util.tree_leaves(state):
             raise ValueError(
                 f"SPMD engine requires stateless layers, but {what} carries "
@@ -1087,7 +1143,10 @@ class SpmdGPipe:
     # the per-device program                                             #
     # ------------------------------------------------------------------ #
 
-    def _local_pipeline(self, blocks_local, x_mb, rng, train: bool):
+    def _local_pipeline(
+        self, blocks_local: Pytree, x_mb: Pytree, rng: Optional[jax.Array],
+        train: bool,
+    ) -> Pytree:
         """Run the fill-drain schedule locally; returns stacked per-tick
         outputs ``[T, b, ...]`` (garbage except where tick >= n-1 on the last
         stage).
@@ -1179,7 +1238,7 @@ class SpmdGPipe:
         _, ys = lax.scan(tick, act0, jnp.arange(T))
         return ys
 
-    def _outputs_from_ticks(self, ys):
+    def _outputs_from_ticks(self, ys: Pytree) -> Pytree:
         """Slice micro-batch outputs [m, b, ...] from the tick stack."""
         n = self.n_stages
         return jax.tree_util.tree_map(lambda a: a[n - 1 :], ys)
@@ -1188,7 +1247,7 @@ class SpmdGPipe:
     # public entry points                                                #
     # ------------------------------------------------------------------ #
 
-    def _data_specs(self):
+    def _data_specs(self) -> P:
         # Stacked data is [m, batch, seq, ...]: micro-batch axis unsharded,
         # batch over dp (and ep — expert parallelism shards tokens too, the
         # all_to_all inside the MoE layer routes them to their experts),
@@ -1201,7 +1260,10 @@ class SpmdGPipe:
             return P(None, batch, self.sp_axis)
         return P(None, batch)
 
-    def _apply_pre(self, pre_params, x_mb, rng, train: bool):
+    def _apply_pre(
+        self, pre_params: Pytree, x_mb: Pytree, rng: Optional[jax.Array],
+        train: bool,
+    ) -> Pytree:
         """Apply ``pre`` per micro-batch with independent keys (matching the
         MPMD engine's per-micro-batch ``fold_in``)."""
         if rng is not None:
@@ -1216,7 +1278,9 @@ class SpmdGPipe:
             lambda mb: self.pre.apply(pre_params, (), mb, rng=None, train=train)[0]
         )(x_mb)
 
-    def _build_train_step_1f1b(self, use_rng: bool, masked: bool = False):
+    def _build_train_step_1f1b(
+        self, use_rng: bool, masked: bool = False
+    ) -> Callable:
         """Training step under the 1F1B (PipeDream-flush) schedule.
 
         Unlike the fill-drain path — which differentiates the whole scanned
@@ -1631,7 +1695,9 @@ class SpmdGPipe:
         )
         return jax.jit(mapped)
 
-    def _build_train_step_zb(self, use_rng: bool, masked: bool = False):
+    def _build_train_step_zb(
+        self, use_rng: bool, masked: bool = False
+    ) -> Callable:
         """Training step under the zero-bubble (ZB-H1-style) schedule.
 
         The backward splits into B cells (activation gradient dx only —
@@ -1936,7 +2002,7 @@ class SpmdGPipe:
 
     def _build_train_step_interleaved(
         self, use_rng: bool, masked: bool = False
-    ):
+    ) -> Callable:
         """Training step under the interleaved-1F1B (virtual pipeline
         stages) schedule.
 
@@ -2386,7 +2452,7 @@ class SpmdGPipe:
         )
         return jax.jit(mapped)
 
-    def _mask_spec(self):
+    def _mask_spec(self) -> P:
         """Spec for the [m, b] ragged-batch mask: batch dim over dp/ep
         (like data), no sequence dim."""
         batch_axes = tuple(
@@ -2394,7 +2460,7 @@ class SpmdGPipe:
         )
         return P(None, batch_axes if batch_axes else None)
 
-    def _build_train_step(self, use_rng: bool, masked: bool = False):
+    def _build_train_step(self, use_rng: bool, masked: bool = False) -> Callable:
         if self.schedule == "1f1b":
             return self._build_train_step_1f1b(use_rng, masked)
         if self.schedule == "interleaved":
@@ -2578,7 +2644,10 @@ class SpmdGPipe:
         )
         return jax.jit(mapped)
 
-    def _check_batch(self, x, target=None, *, ragged_ok=False) -> int:
+    def _check_batch(
+        self, x: Pytree, target: Optional[Pytree] = None, *,
+        ragged_ok: bool = False,
+    ) -> int:
         """Validate batch/sequence divisibility; returns the number of
         padding rows a ragged batch needs (0 when already divisible).
         ``ragged_ok`` callers pad + mask instead of raising (reference
@@ -2613,7 +2682,7 @@ class SpmdGPipe:
                         )
         return pad
 
-    def _check_params(self, params) -> None:
+    def _check_params(self, params: Pytree) -> None:
         """Didactic validation of the params tree BEFORE it reaches
         shard_map, whose own failures (spec/shape mismatches deep inside
         one compiled program) are opaque.  Mirrors the reference's eager
@@ -2650,7 +2719,10 @@ class SpmdGPipe:
                 )
             break  # leading-dim layout is uniform; one leaf suffices
 
-    def train_step(self, params, x, target, rng=None):
+    def train_step(
+        self, params: Pytree, x: Pytree, target: Pytree,
+        rng: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, Pytree]:
         """One pipelined forward+backward; returns ``(loss, grads)``.
 
         ``x``/``target`` are full mini-batches ``[B, ...]``.  A ragged
@@ -2720,7 +2792,7 @@ class SpmdGPipe:
             args += (rng,)
         return self._train_step_fns[key](*args)
 
-    def _build_apply(self, with_loss: bool = False):
+    def _build_apply(self, with_loss: bool = False) -> Callable:
         n = self.n_stages
         data_spec = self._data_specs()
 
@@ -2786,7 +2858,7 @@ class SpmdGPipe:
             )
         return jax.jit(mapped)
 
-    def _build_apply_interleaved(self, with_loss: bool = False):
+    def _build_apply_interleaved(self, with_loss: bool = False) -> Callable:
         """Forward-only interleaved pipeline (fill-drain over the n·v
         virtual stages, round-robin device mapping) for inference."""
         from torchgpipe_tpu.parallel.interleaved import (
@@ -2929,7 +3001,9 @@ class SpmdGPipe:
             )
         return jax.jit(mapped)
 
-    def _eval_loss_from_outs(self, params, outs, tgt_mb, stage):
+    def _eval_loss_from_outs(
+        self, params: Pytree, outs: Pytree, tgt_mb: Pytree, stage: jax.Array
+    ) -> jax.Array:
         """Per-micro-batch eval loss INSIDE the mapped program: the loss
         consumes each ``[b_local, ...]`` micro-batch output directly, so
         full-batch logits are never gathered (the train path's memory
@@ -2970,7 +3044,7 @@ class SpmdGPipe:
                 loss = red(loss, ax)
         return loss
 
-    def eval_loss(self, params, x, target):
+    def eval_loss(self, params: Pytree, x: Pytree, target: Pytree) -> jax.Array:
         """Loss on a mini-batch WITHOUT gradients (eval semantics:
         ``train=False`` through every layer — dropout off, checkpoint
         bypassed — like the reference's eval-mode ``checkpoint_stop=0``,
@@ -3008,7 +3082,7 @@ class SpmdGPipe:
         tgt_mb = microbatch.scatter_stacked(target, self.chunks)
         return self._eval_fn(params, x_mb, tgt_mb)
 
-    def apply(self, params, x):
+    def apply(self, params: Pytree, x: Pytree) -> Pytree:
         """Pipelined inference forward; returns gathered outputs
         ``[B, ...]``.  Ragged batches are edge-padded through the pipeline
         and the padding rows sliced off the gathered output — exact for
@@ -3032,7 +3106,7 @@ class SpmdGPipe:
         return out
 
 
-def _zeros(spec):
+def _zeros(spec: Spec) -> Pytree:
     return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
 
 

@@ -27,7 +27,7 @@ def param_count(tree: Pytree) -> int:
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
 
 
-def host_device():
+def host_device() -> Any:
     """Context placing computation on the host CPU backend (no-op fallback
     when unavailable).
 

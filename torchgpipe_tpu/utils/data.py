@@ -54,7 +54,11 @@ def prefetch_to_device(
         enqueue(1)
 
 
-def global_batch_from_local(mesh, spec, local_batch: Pytree) -> Pytree:
+def global_batch_from_local(
+    mesh: Any,
+    spec: Any,
+    local_batch: Pytree,
+) -> Pytree:
     """Assemble a GLOBAL sharded batch from each process's LOCAL shard.
 
     The multi-host data recipe (docs/multihost.md): every process loads

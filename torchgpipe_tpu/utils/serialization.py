@@ -32,7 +32,7 @@ def _leaf_paths(tree: Pytree) -> List[Tuple[str, Any]]:
 
 
 def state_dict(
-    model,
+    model: Any,
     params: Sequence[Sequence[Pytree]],
     state: Sequence[Sequence[Pytree]],
 ) -> Dict[str, np.ndarray]:
@@ -66,11 +66,11 @@ def state_dict(
 
 
 def load_state_dict(
-    model,
+    model: Any,
     params: Sequence[Sequence[Pytree]],
     state: Sequence[Sequence[Pytree]],
     d: Dict[str, np.ndarray],
-):
+) -> Tuple[List[List[Pytree]], List[List[Pytree]]]:
     """Replace every leaf of an initialized ``(params, state)`` template with
     the identically-keyed array from ``d``.
 

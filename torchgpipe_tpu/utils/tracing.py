@@ -27,7 +27,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import time
-from typing import Any, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Iterator, List, Optional, Tuple
 
 import jax
 
@@ -63,7 +63,13 @@ class Timeline:
         self.events.clear()
         self._t0 = time.perf_counter()
 
-    def record(self, name: str, stage: int, mbatch: int, out: Any = None):
+    def record(
+        self,
+        name: str,
+        stage: int,
+        mbatch: int,
+        out: Any = None,
+    ) -> None:
         """Record one cell; blocks on ``out`` when ``sync`` is set."""
         t_start = time.perf_counter() - self._t0
         if self.sync and out is not None:
@@ -246,7 +252,11 @@ def simulate_pipeline(
     return makespan, busy, 1.0 - busy
 
 
-def _list_schedule(orders, dep_fn, time_fn) -> Optional[float]:
+def _list_schedule(
+    orders: Any,
+    dep_fn: Callable,
+    time_fn: Callable,
+) -> Optional[float]:
     """Shared dependency-driven list scheduler for the per-schedule
     projections: each unit executes its ``orders`` row in order, an op
     starting when its unit is free AND ``dep_fn(op, j)`` (or None) has
