@@ -109,11 +109,12 @@ def calibrate_cell(block, dim: int, mb: int, iters: int = 30):
 
 
 def time_schedule(schedule: str, n: int, m: int, dim: int, batch: int,
-                  steps: int, **kw) -> float:
+                  steps: int, unroll: int = 1, **kw) -> float:
     mesh = make_mesh(n, 1, devices=jax.devices()[:n])
     pipe = SpmdGPipe(
         make_block(dim), n, mesh, chunks=m, loss_fn=mse,
-        checkpoint="never", schedule=schedule, **kw,
+        checkpoint="never", schedule=schedule,
+        scan_unroll=True if unroll == 0 else unroll, **kw,
     )
     spec = jax.ShapeDtypeStruct((batch, dim), jnp.float32)
     params = pipe.place(pipe.init(jax.random.PRNGKey(0), spec))
@@ -136,6 +137,8 @@ def main() -> None:
     ap.add_argument("--dim", type=int, default=256)
     ap.add_argument("--mb", type=int, default=8, help="rows per micro-batch")
     ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--unroll", type=int, default=1,
+                    help="SpmdGPipe scan_unroll (0 = fully unroll)")
     args = ap.parse_args()
     n, m = args.stages, args.chunks
     batch = args.mb * m
@@ -162,7 +165,8 @@ def main() -> None:
           f"{'parallel':>11} {'meas/serial':>12} {'overhead':>10}")
     measured = {}
     for schedule in ("fill_drain", "1f1b", "zb"):
-        dt = time_schedule(schedule, n, m, args.dim, batch, args.steps)
+        dt = time_schedule(schedule, n, m, args.dim, batch, args.steps,
+                           unroll=args.unroll)
         measured[schedule] = dt
         over = dt - pred_serial
         print(f"{schedule:<12} {dt*1e3:>9.1f}ms {pred_serial*1e3:>9.1f}ms "

@@ -597,3 +597,53 @@ def test_row_coupled_sees_moe_balance_through_block_wrapper():
     assert _row_coupled(chain([hot, cold], name="s")) == [
         "MoE balance_weight penalty"
     ]
+
+
+@pytest.mark.parametrize("schedule,kw", [
+    ("fill_drain", {}),
+    ("1f1b", {}),
+    ("interleaved", {"virtual_stages": 2}),
+    ("zb", {"checkpoint": "never"}),
+])
+@pytest.mark.parametrize("unroll", [2, True])
+def test_scan_unroll_matches_default(cpu_devices, schedule, kw, unroll):
+    """scan_unroll only changes XLA's loop scheduling: loss and grads must
+    match the unroll=1 program (same per-tick ops) on every schedule —
+    including tick counts the unroll factor does not divide."""
+    n, dim, m = 2, 8, 4
+    kw = dict(kw)
+    ckpt = kw.pop("checkpoint", "except_last")
+    mesh = make_mesh(n, 1, devices=cpu_devices[:2])
+
+    def build(u):
+        return SpmdGPipe(
+            make_block(dim), n, mesh, chunks=m, loss_fn=mse,
+            checkpoint=ckpt, schedule=schedule, scan_unroll=u, **kw,
+        )
+
+    base = build(1)
+    fast = build(unroll)
+    spec = jax.ShapeDtypeStruct((2 * m, dim), jnp.float32)
+    params = base.place(base.init(jax.random.PRNGKey(0), spec))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2 * m, dim))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (2 * m, dim))
+    l0, g0 = base.train_step(params, x, tgt)
+    l1, g1 = fast.train_step(params, x, tgt)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        g1,
+        g0,
+    )
+
+
+def test_scan_unroll_validated(cpu_devices):
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    for bad in (0, -2, 1.5, "yes", False):
+        with pytest.raises(ValueError, match="scan_unroll"):
+            SpmdGPipe(
+                make_block(8), 2, mesh, chunks=2, loss_fn=mse,
+                scan_unroll=bad,
+            )

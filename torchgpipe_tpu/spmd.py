@@ -37,7 +37,7 @@ engine for BatchNorm-style stateful CNNs).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -456,6 +456,15 @@ class SpmdGPipe:
     # global blocks c*n + j for c in range(v) — Megatron's round-robin
     # assignment).  Must be 1 for the other schedules.
     virtual_stages: int = 1
+    # Unroll factor for the schedule's tick scan (``lax.scan(unroll=...)``;
+    # True = fully unroll).  Unrolling makes slot/ring indices static so
+    # XLA folds the buffer machinery and fuses across ticks — measured
+    # -26% (1f1b) / -29% (zb) step time at n=4 m=8 toy cells on the CPU
+    # mesh (BENCH_NOTES round 4) — at the cost of compile time roughly
+    # linear in the unroll factor (1.6s -> 8.7s fully unrolled there).
+    # Worth it when per-cell compute is small relative to tick overhead
+    # and the step runs many times; the default 1 keeps compile fastest.
+    scan_unroll: Union[int, bool] = 1
 
     def __repr__(self) -> str:
         axes = {
@@ -468,6 +477,7 @@ class SpmdGPipe:
                 ("fsdp", self.fsdp, False),
                 ("schedule", self.schedule, "fill_drain"),
                 ("virtual_stages", self.virtual_stages, 1),
+                ("scan_unroll", self.scan_unroll, 1),
             )
             if v != default
         )
@@ -497,6 +507,16 @@ class SpmdGPipe:
                 )
         if self.loss_reduction not in ("mean", "sum", None):
             raise ValueError("loss_reduction must be 'mean', 'sum' or None")
+        if not (
+            self.scan_unroll is True
+            or (isinstance(self.scan_unroll, int)
+                and not isinstance(self.scan_unroll, bool)
+                and self.scan_unroll >= 1)
+        ):
+            raise ValueError(
+                f"scan_unroll must be True or an int >= 1, got "
+                f"{self.scan_unroll!r}"
+            )
         if self.mesh.shape[self.pp_axis] != self.n_stages:
             raise ValueError(
                 f"pp mesh axis size {self.mesh.shape[self.pp_axis]} != "
@@ -1205,7 +1225,9 @@ class SpmdGPipe:
         if self.checkpoint == "except_last" and train:
             # Remat'd prefix: every cell in ticks 0..m-2 is micro-batch
             # < m-1 (or fill garbage).  Zero-length scan (m == 1) is fine.
-            act, ys_scan = lax.scan(tick, act0, jnp.arange(m - 1))
+            act, ys_scan = lax.scan(
+                tick, act0, jnp.arange(m - 1), unroll=self.scan_unroll
+            )
 
             # Peeled tail as a SECOND scan (not a Python unroll): the block
             # body is traced twice total — once per cond branch — instead
@@ -1230,12 +1252,14 @@ class SpmdGPipe:
                 y = lax.cond(stage == own, plain_cell, remat_cell, x_in)
                 return y, y
 
-            _, ys_tail = lax.scan(tail_tick, act, jnp.arange(m - 1, T))
+            _, ys_tail = lax.scan(
+                tail_tick, act, jnp.arange(m - 1, T), unroll=self.scan_unroll
+            )
             return jax.tree_util.tree_map(
                 lambda a, b: jnp.concatenate([a, b], axis=0), ys_scan, ys_tail
             )
 
-        _, ys = lax.scan(tick, act0, jnp.arange(T))
+        _, ys = lax.scan(tick, act0, jnp.arange(T), unroll=self.scan_unroll)
         return ys
 
     def _outputs_from_ticks(self, ys: Pytree) -> Pytree:
@@ -1653,7 +1677,8 @@ class SpmdGPipe:
                 return carry, ()
 
             carry, _ = lax.scan(
-                tick, carry0, jnp.arange(2 * (m + n - 1))
+                tick, carry0, jnp.arange(2 * (m + n - 1)),
+                unroll=self.scan_unroll,
             )
             loss = lax.psum(carry["loss"], self.pp_axis)
             grads = {"blocks": tmap(lambda g: g[None], carry["gblk"])}
@@ -1964,7 +1989,9 @@ class SpmdGPipe:
                 )
                 return carry, ()
 
-            carry, _ = lax.scan(tick, carry0, rows_xs)
+            carry, _ = lax.scan(
+                tick, carry0, rows_xs, unroll=self.scan_unroll
+            )
             loss = lax.psum(carry["loss"], self.pp_axis)
             grads = {"blocks": tmap(lambda g: g[None], carry["gblk"])}
             if self.pre is not None:
@@ -2416,7 +2443,9 @@ class SpmdGPipe:
                 )
                 return carry, ()
 
-            carry, _ = lax.scan(tick, carry0, rows_xs)
+            carry, _ = lax.scan(
+                tick, carry0, rows_xs, unroll=self.scan_unroll
+            )
             loss = lax.psum(carry["loss"], self.pp_axis)
             grads = {"blocks": tmap(lambda g: g[None], carry["gblk"])}
             if self.pre is not None:
@@ -2954,7 +2983,9 @@ class SpmdGPipe:
                 )
                 return carry, ()
 
-            carry, _ = lax.scan(tick, carry0, rows_xs)
+            carry, _ = lax.scan(
+                tick, carry0, rows_xs, unroll=self.scan_unroll
+            )
             outs = carry["outs"]
             if with_loss:
                 # The final chunk's outputs land on stage n-1; the loss
