@@ -6,6 +6,13 @@ transformer/Llama family for the SPMD flagship path.
 """
 
 from torchgpipe_tpu.models.amoebanet import amoebanetd  # noqa: F401
+from torchgpipe_tpu.models.generation import (  # noqa: F401
+    KVCache,
+    generate,
+    init_cache,
+    mpmd_params_for_generation,
+    prefill,
+)
 from torchgpipe_tpu.models.moe import (  # noqa: F401
     MoEConfig,
     llama_moe,

@@ -1,0 +1,289 @@
+"""KV-cache autoregressive generation for the llama family.
+
+New TPU-native capability (the reference is a training library with no
+inference engine at all — SURVEY.md §2 has no generation component): a
+user who trains a transformer with this framework can decode from it
+without leaving the framework.
+
+Design, TPU-first:
+
+* **Two paths, one parameter schema.**  Prefill runs the SAME
+  ``llama(cfg)`` layers the training engines run (one full forward over
+  the prompt filling the caches); decode runs a cache-specialized
+  single-token path (``_decode_step``) over the very same param pytrees
+  (``wq/wk/wv/wo``, ``w_gate/w_up/w_down``, embed ``table``, head
+  ``scale``/``w``), so there is no weight conversion step and the two
+  paths cannot diverge in schema.  Numerical agreement IS tested
+  (``tests/test_generation.py`` teacher-forces decode against the full
+  forward).
+* **Static shapes everywhere.**  The KV cache is a fixed
+  ``[b, max_len, kv_heads, head_dim]`` buffer written with
+  ``lax.dynamic_update_slice_in_dim`` at a traced position; the decode
+  loop is ONE ``lax.scan`` over ``max_new_tokens`` ticks compiled once
+  — no per-token retracing, no data-dependent shapes (XLA requirement).
+  Finished rows (EOS seen) keep scanning but freeze their output — the
+  compiler-friendly alternative to early exit.
+* **GQA native**: caches store ``n_kv_heads`` (the memory win is the
+  point of GQA); queries group at the compute site exactly like the
+  training path.
+* **Sliding-window ready**: with ``cfg.attn_window`` the decode mask
+  attends to at most ``window`` trailing positions — the same band the
+  training path computes — so a Mistral-style model decodes with its
+  training-time locality.  (The cache itself stays ``max_len`` long:
+  a ring cache would save memory but costs a gather per step; at the
+  single-host sizes this module targets, the mask is the better trade.)
+
+Sampling: greedy (``temperature=0``) or temperature softmax sampling
+with optional top-k truncation, driven by an explicit ``jax.random``
+key (deterministic, reproducible — the framework-wide RNG discipline).
+
+Scope: single-host decode over replicated weights.  Pipelined decode
+(pp-sharded stages serving one token stream) is latency-bound by design
+and out of scope here; for batch inference over a pipeline use
+``GPipe.apply``/``SpmdGPipe.apply`` on full sequences.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    _rms,
+    _rope,
+)
+
+Pytree = Any
+
+
+class KVCache(NamedTuple):
+    """Per-layer K/V buffers plus the current fill length."""
+
+    k: List[jnp.ndarray]  # each [b, max_len, n_kv, hd]
+    v: List[jnp.ndarray]
+    length: jnp.ndarray   # [] int32 — tokens already cached
+
+
+def init_cache(
+    cfg: TransformerConfig, batch: int, max_len: int,
+    dtype: Optional[jnp.dtype] = None,
+) -> KVCache:
+    """Zeroed KV cache for ``cfg.n_layers`` blocks."""
+    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
+    dt = dtype or cfg.dtype
+    return KVCache(
+        k=[jnp.zeros(shape, dt) for _ in range(cfg.n_layers)],
+        v=[jnp.zeros(shape, dt) for _ in range(cfg.n_layers)],
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _split_params(cfg: TransformerConfig, params: Pytree) -> Tuple:
+    """(embed, blocks, head) params from the flat ``llama(cfg)`` list —
+    the MPMD engine's per-layer pytree sequence, or any sequence whose
+    first element is the embedding, middle the blocks, last the head."""
+    params = list(params)
+    if len(params) != cfg.n_layers + 2:
+        raise ValueError(
+            f"expected {cfg.n_layers + 2} per-layer params (embed, "
+            f"{cfg.n_layers} blocks, head), got {len(params)}; build the "
+            "model with models.transformer.llama(cfg)"
+        )
+    return params[0], params[1 : 1 + cfg.n_layers], params[-1]
+
+
+def _attend_cached(
+    q: jnp.ndarray,          # [b, 1, nh, hd] — rope'd query for this step
+    ck: jnp.ndarray,         # [b, max_len, nkv, hd]
+    cv: jnp.ndarray,
+    pos: jnp.ndarray,        # [] int32 — this token's position
+    window: Optional[int],
+) -> jnp.ndarray:
+    b, _, nh, hd = q.shape
+    max_len = ck.shape[1]
+    nkv = ck.shape[2]
+    r = nh // nkv
+    # Group queries onto kv heads: [b, nkv, r, hd].
+    qg = q[:, 0].reshape(b, nkv, r, hd)
+    scores = jnp.einsum(
+        "bgrd,bsgd->bgrs", qg.astype(jnp.float32), ck.astype(jnp.float32)
+    ) * (hd ** -0.5)
+    idx = jnp.arange(max_len)
+    valid = idx <= pos                       # causal: cache rows 0..pos
+    if window is not None:
+        valid &= idx > pos - window          # band: 0 <= pos - s < window
+    scores = jnp.where(valid[None, None, None, :], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrs,bsgd->bgrd", p, cv.astype(jnp.float32))
+    return out.reshape(b, 1, nh * hd)
+
+
+def _decode_step(
+    cfg: TransformerConfig,
+    block_params: List[Pytree],
+    x: jnp.ndarray,              # [b, 1, dim] — embedded current token
+    cache: KVCache,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """One token through all blocks, reading+extending the cache.
+
+    Mirrors ``transformer_block.apply`` exactly (same RMS/rope/GQA/SwiGLU
+    math on the same param schema) minus the sp/tp collectives — decode
+    here is single-host over replicated weights."""
+    b = x.shape[0]
+    hd = cfg.head_dim
+    pos = cache.length
+    new_k, new_v = [], []
+    for p, ck, cv in zip(block_params, cache.k, cache.v):
+        nh_loc = p["wq"].shape[1] // hd
+        nkv_loc = p["wk"].shape[1] // hd
+        h = _rms(x, p["ln1"], cfg.norm_eps)
+        q = (h @ p["wq"]).reshape(b, 1, nh_loc, hd)
+        k = (h @ p["wk"]).reshape(b, 1, nkv_loc, hd)
+        v = (h @ p["wv"]).reshape(b, 1, nkv_loc, hd)
+        q = _rope(q, cfg.rope_theta, pos)
+        k = _rope(k, cfg.rope_theta, pos)
+        ck = lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, 1)
+        cv = lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, 1)
+        attn = _attend_cached(q, ck, cv, pos, cfg.attn_window)
+        x = x + (attn.astype(x.dtype) @ p["wo"])
+        h = _rms(x, p["ln2"], cfg.norm_eps)
+        if "mlp" in p:
+            raise NotImplementedError(
+                "decode through a custom/MoE mlp block is not supported; "
+                "generation covers the dense SwiGLU llama family"
+            )
+        gate = jax.nn.silu(h @ p["w_gate"])
+        up = h @ p["w_up"]
+        x = x + (gate * up) @ p["w_down"]
+        new_k.append(ck)
+        new_v.append(cv)
+    return x, KVCache(k=new_k, v=new_v, length=pos + 1)
+
+
+def _logits(cfg: TransformerConfig, head_params: Pytree,
+            x: jnp.ndarray) -> jnp.ndarray:
+    h = _rms(x, head_params["scale"], cfg.norm_eps)
+    return (h @ head_params["w"]).astype(jnp.float32)
+
+
+def _sample(
+    logits: jnp.ndarray,        # [b, vocab] f32
+    key: jnp.ndarray,
+    temperature: float,
+    top_k: Optional[int],
+) -> jnp.ndarray:
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
+def prefill(
+    cfg: TransformerConfig,
+    params: Pytree,
+    tokens: jnp.ndarray,          # [b, s] int32 prompt
+    max_len: int,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the prompt through the decode path token-group-wise to fill the
+    cache; returns (last-position logits [b, vocab], cache).
+
+    Implementation note: prefill loops the single-token decode step over
+    the prompt inside one ``lax.scan`` — O(s·max_len) attention reads.
+    For the short prompts this module targets that is compile-once and
+    simple; a blockwise flash prefill is the obvious upgrade path and
+    slots in behind this same signature."""
+    embed_p, block_p, head_p = _split_params(cfg, params)
+    cache = init_cache(cfg, tokens.shape[0], max_len)
+
+    def step(cache, tok):
+        x = jnp.take(embed_p["table"], tok[:, None], axis=0)
+        x, cache = _decode_step(cfg, block_p, x, cache)
+        return cache, _logits(cfg, head_p, x)[:, 0]
+
+    cache, all_logits = lax.scan(step, cache, tokens.T)  # scan over s
+    return all_logits[-1], cache
+
+
+def generate(
+    cfg: TransformerConfig,
+    params: Pytree,
+    prompt: jnp.ndarray,                 # [b, s] int32
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    eos_id: Optional[int] = None,
+    rng: Optional[jnp.ndarray] = None,
+    max_len: Optional[int] = None,
+) -> jnp.ndarray:
+    """Autoregressive decode: returns ``[b, max_new_tokens]`` completions.
+
+    ``temperature=0`` is greedy argmax (no rng needed); otherwise pass
+    ``rng`` for temperature/top-k sampling.  With ``eos_id`` set, rows
+    that have emitted it keep emitting ``eos_id`` (frozen — static
+    shapes; trim host-side).  Everything compiles to ONE program:
+    prefill scan + decode scan."""
+    b, s = prompt.shape
+    total = max_len or (s + max_new_tokens)
+    if total < s + max_new_tokens:
+        raise ValueError(
+            f"max_len={total} cannot hold prompt ({s}) + "
+            f"max_new_tokens ({max_new_tokens})"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs rng=jax.random.PRNGKey")
+    if temperature == 0.0:
+        rng = jax.random.PRNGKey(0)  # unused; keeps the scan carry uniform
+
+    embed_p, block_p, head_p = _split_params(cfg, params)
+    logits0, cache = prefill(cfg, params, prompt, total)
+
+    def step(carry, _):
+        cache, logits, key, alive = carry
+        key, sub = jax.random.split(key)
+        tok = _sample(logits, sub, temperature, top_k)
+        if eos_id is not None:
+            tok = jnp.where(alive, tok, eos_id)
+            alive = alive & (tok != eos_id)
+        x = jnp.take(embed_p["table"], tok[:, None], axis=0)
+        x, cache = _decode_step(cfg, block_p, x, cache)
+        return (cache, _logits(cfg, head_p, x)[:, 0], key, alive), tok
+
+    alive0 = jnp.ones((b,), bool)
+    _, toks = lax.scan(
+        step, (cache, logits0, rng, alive0), None, length=max_new_tokens
+    )
+    return toks.T  # [b, max_new_tokens]
+
+
+def mpmd_params_for_generation(
+    model: Any, params: Any, device: Any = None
+) -> List[Pytree]:
+    """Flatten a ``GPipe(llama(cfg))`` model's per-stage params back to the
+    per-layer list :func:`generate` consumes (train with the pipeline,
+    decode with the same weights — no conversion).  Stage params live on
+    their pipeline devices; decode is single-device, so everything is
+    gathered onto ``device`` (default: the first device)."""
+    if device is None:
+        device = jax.devices()[0]
+    out: List[Pytree] = []
+    for stage_params in params:
+        out.extend(jax.device_put(list(stage_params), device))
+    return out
+
+
+__all__ = [
+    "KVCache",
+    "init_cache",
+    "prefill",
+    "generate",
+    "mpmd_params_for_generation",
+]
