@@ -186,30 +186,79 @@ def _sample(
     ).astype(jnp.int32)
 
 
+def _attend_full(
+    q: jnp.ndarray,          # [b, s, nh, hd] — rope'd
+    k: jnp.ndarray,          # [b, s, nkv, hd]
+    v: jnp.ndarray,
+    window: Optional[int],
+) -> jnp.ndarray:
+    """Causal (optionally banded) full-sequence attention, GQA-grouped —
+    the batched twin of :func:`_attend_cached` (prefill's one big
+    MXU-friendly pass instead of s cache reads)."""
+    b, s, nh, hd = q.shape
+    nkv = k.shape[2]
+    r = nh // nkv
+    qg = q.reshape(b, s, nkv, r, hd)
+    scores = jnp.einsum(
+        "bqgrd,bsgd->bgrqs", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) * (hd ** -0.5)
+    qpos = jnp.arange(s)[:, None]
+    kpos = jnp.arange(s)[None, :]
+    valid = kpos <= qpos
+    if window is not None:
+        valid &= kpos > qpos - window
+    scores = jnp.where(valid[None, None, None, :, :], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrqs,bsgd->bqgrd", p, v.astype(jnp.float32))
+    return out.reshape(b, s, nh * hd)
+
+
 def prefill(
     cfg: TransformerConfig,
     params: Pytree,
     tokens: jnp.ndarray,          # [b, s] int32 prompt
     max_len: int,
 ) -> Tuple[jnp.ndarray, KVCache]:
-    """Run the prompt through the decode path token-group-wise to fill the
-    cache; returns (last-position logits [b, vocab], cache).
-
-    Implementation note: prefill loops the single-token decode step over
-    the prompt inside one ``lax.scan`` — O(s·max_len) attention reads.
-    For the short prompts this module targets that is compile-once and
-    simple; a blockwise flash prefill is the obvious upgrade path and
-    slots in behind this same signature."""
+    """ONE batched full-sequence pass over the prompt (MXU-friendly, no
+    per-token loop): computes each block's K/V for all prompt positions,
+    banks them in the cache, and returns (last-position logits
+    [b, vocab], cache ready for decode at position s)."""
     embed_p, block_p, head_p = _split_params(cfg, params)
-    cache = init_cache(cfg, tokens.shape[0], max_len)
-
-    def step(cache, tok):
-        x = jnp.take(embed_p["table"], tok[:, None], axis=0)
-        x, cache = _decode_step(cfg, block_p, x, cache)
-        return cache, _logits(cfg, head_p, x)[:, 0]
-
-    cache, all_logits = lax.scan(step, cache, tokens.T)  # scan over s
-    return all_logits[-1], cache
+    b, s = tokens.shape
+    if s > max_len:
+        raise ValueError(f"prompt length {s} exceeds max_len {max_len}")
+    cache = init_cache(cfg, b, max_len)
+    hd = cfg.head_dim
+    x = jnp.take(embed_p["table"], tokens, axis=0)
+    new_k, new_v = [], []
+    for p, ck, cv in zip(block_p, cache.k, cache.v):
+        nh_loc = p["wq"].shape[1] // hd
+        nkv_loc = p["wk"].shape[1] // hd
+        h = _rms(x, p["ln1"], cfg.norm_eps)
+        q = (h @ p["wq"]).reshape(b, s, nh_loc, hd)
+        k = (h @ p["wk"]).reshape(b, s, nkv_loc, hd)
+        v = (h @ p["wv"]).reshape(b, s, nkv_loc, hd)
+        q = _rope(q, cfg.rope_theta, 0)
+        k = _rope(k, cfg.rope_theta, 0)
+        attn = _attend_full(q, k, v, cfg.attn_window)
+        x = x + (attn.astype(x.dtype) @ p["wo"])
+        h = _rms(x, p["ln2"], cfg.norm_eps)
+        if "mlp" in p:
+            raise NotImplementedError(
+                "decode through a custom/MoE mlp block is not supported; "
+                "generation covers the dense SwiGLU llama family"
+            )
+        gate = jax.nn.silu(h @ p["w_gate"])
+        up = h @ p["w_up"]
+        x = x + (gate * up) @ p["w_down"]
+        new_k.append(
+            lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), 0, 1)
+        )
+        new_v.append(
+            lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), 0, 1)
+        )
+    cache = KVCache(k=new_k, v=new_v, length=jnp.asarray(s, jnp.int32))
+    return _logits(cfg, head_p, x)[:, -1], cache
 
 
 def generate(
