@@ -130,3 +130,45 @@ def test_generation_validation():
         generate(CFG, params, tokens, max_new_tokens=2, temperature=0.5)
     with pytest.raises(ValueError, match="per-layer params"):
         prefill(CFG, params[:-1], tokens, max_len=8)
+
+def test_moe_generate_teacher_forced():
+    """MoE blocks decode too: pass the training MoEConfig and every greedy
+    token equals argmax of the full llama_moe forward."""
+    from torchgpipe_tpu.models.moe import MoEConfig, llama_moe
+
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+    )
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0)
+    layers = llama_moe(cfg, moe)
+    b, s, new = 2, 5, 4
+    spec = jax.ShapeDtypeStruct((b, s), jnp.int32)
+    params, states, _ = sequential_init(layers, jax.random.PRNGKey(0), spec)
+    tokens = jnp.mod(5 * jnp.arange(b * s).reshape(b, s) + 1, cfg.vocab)
+
+    out = generate(cfg, params, tokens, max_new_tokens=new, moe=moe)
+    seq = np.asarray(tokens)
+    for t in range(new):
+        ref, _ = sequential_apply(
+            layers, params, states, jnp.asarray(seq), rng=None, train=False
+        )
+        expect = np.argmax(np.asarray(ref, np.float32)[:, -1], -1)
+        got = np.asarray(out[:, t])
+        assert (got == expect).all(), (t, got, expect)
+        seq = np.concatenate([seq, expect[:, None].astype(np.int32)], axis=1)
+
+
+def test_moe_params_without_config_rejected():
+    from torchgpipe_tpu.models.moe import MoEConfig, llama_moe
+
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+    )
+    layers = llama_moe(cfg, MoEConfig(n_experts=2))
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    params, _, _ = sequential_init(
+        layers, jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, 4), jnp.int32),
+    )
+    with pytest.raises(ValueError, match="MoEConfig"):
+        generate(cfg, params, tokens, max_new_tokens=2)

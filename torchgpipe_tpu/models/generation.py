@@ -41,6 +41,15 @@ Scope: single-host decode over replicated weights.  Pipelined decode
 (pp-sharded stages serving one token stream) is latency-bound by design
 and out of scope here; for batch inference over a pipeline use
 ``GPipe.apply``/``SpmdGPipe.apply`` on full sequences.
+
+MoE models (``llama_moe``): pass the training ``moe=MoEConfig(...)`` —
+the expert feed-forward runs its own apply on the decode hidden states.
+Capacity caveat: token-choice capacity is computed per forward call, so
+a decode step's pool is ``batch`` tokens while training pools
+``batch*seq`` — with a tight ``capacity_factor`` the dropped-token sets
+can differ between training and decode.  Decode==training equality (the
+teacher-forced test) holds when capacity admits every token
+(``capacity_factor >= n_experts/top_k``, or ``dispatch='dropless'``).
 """
 
 from __future__ import annotations
@@ -127,12 +136,16 @@ def _decode_step(
     block_params: List[Pytree],
     x: jnp.ndarray,              # [b, 1, dim] — embedded current token
     cache: KVCache,
+    mlp_layer: Optional[Any] = None,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """One token through all blocks, reading+extending the cache.
 
     Mirrors ``transformer_block.apply`` exactly (same RMS/rope/GQA/SwiGLU
     math on the same param schema) minus the sp/tp collectives — decode
-    here is single-host over replicated weights."""
+    here is single-host over replicated weights.  ``mlp_layer`` (built by
+    :func:`_mlp_layer_for`) serves blocks carrying an ``"mlp"`` params
+    key — the MoE feed-forward runs its own apply on the single-token
+    hidden states (capacity >= 1 even at one token)."""
     b = x.shape[0]
     hd = cfg.head_dim
     pos = cache.length
@@ -151,17 +164,36 @@ def _decode_step(
         attn = _attend_cached(q, ck, cv, pos, cfg.attn_window)
         x = x + (attn.astype(x.dtype) @ p["wo"])
         h = _rms(x, p["ln2"], cfg.norm_eps)
-        if "mlp" in p:
-            raise NotImplementedError(
-                "decode through a custom/MoE mlp block is not supported; "
-                "generation covers the dense SwiGLU llama family"
-            )
-        gate = jax.nn.silu(h @ p["w_gate"])
-        up = h @ p["w_up"]
-        x = x + (gate * up) @ p["w_down"]
+        x = x + _mlp_out(cfg, p, h, mlp_layer)
         new_k.append(ck)
         new_v.append(cv)
     return x, KVCache(k=new_k, v=new_v, length=pos + 1)
+
+
+def _mlp_layer_for(cfg: TransformerConfig, moe: Optional[Any]) -> Optional[Any]:
+    """The feed-forward Layer for blocks whose params carry an ``"mlp"``
+    key (the MoE family); None for the dense SwiGLU default."""
+    if moe is None:
+        return None
+    from torchgpipe_tpu.models.moe import moe_mlp
+
+    return moe_mlp(cfg, moe)
+
+
+def _mlp_out(cfg: TransformerConfig, p: Pytree, h: jnp.ndarray,
+             mlp_layer: Optional[Any]) -> jnp.ndarray:
+    if "mlp" in p:
+        if mlp_layer is None:
+            raise ValueError(
+                "these block params carry an 'mlp' feed-forward (MoE "
+                "family); pass moe=MoEConfig(...) matching the training "
+                "configuration to prefill()/generate()"
+            )
+        out, _ = mlp_layer.apply(p["mlp"], (), h, rng=None, train=False)
+        return out.astype(h.dtype)
+    gate = jax.nn.silu(h @ p["w_gate"])
+    up = h @ p["w_up"]
+    return (gate * up) @ p["w_down"]
 
 
 def _logits(cfg: TransformerConfig, head_params: Pytree,
@@ -218,6 +250,7 @@ def prefill(
     params: Pytree,
     tokens: jnp.ndarray,          # [b, s] int32 prompt
     max_len: int,
+    moe: Optional[Any] = None,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """ONE batched full-sequence pass over the prompt (MXU-friendly, no
     per-token loop): computes each block's K/V for all prompt positions,
@@ -229,6 +262,7 @@ def prefill(
         raise ValueError(f"prompt length {s} exceeds max_len {max_len}")
     cache = init_cache(cfg, b, max_len)
     hd = cfg.head_dim
+    mlp_layer = _mlp_layer_for(cfg, moe)
     x = jnp.take(embed_p["table"], tokens, axis=0)
     new_k, new_v = [], []
     for p, ck, cv in zip(block_p, cache.k, cache.v):
@@ -243,14 +277,7 @@ def prefill(
         attn = _attend_full(q, k, v, cfg.attn_window)
         x = x + (attn.astype(x.dtype) @ p["wo"])
         h = _rms(x, p["ln2"], cfg.norm_eps)
-        if "mlp" in p:
-            raise NotImplementedError(
-                "decode through a custom/MoE mlp block is not supported; "
-                "generation covers the dense SwiGLU llama family"
-            )
-        gate = jax.nn.silu(h @ p["w_gate"])
-        up = h @ p["w_up"]
-        x = x + (gate * up) @ p["w_down"]
+        x = x + _mlp_out(cfg, p, h, mlp_layer)
         new_k.append(
             lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), 0, 1)
         )
@@ -272,6 +299,7 @@ def generate(
     eos_id: Optional[int] = None,
     rng: Optional[jnp.ndarray] = None,
     max_len: Optional[int] = None,
+    moe: Optional[Any] = None,
 ) -> jnp.ndarray:
     """Autoregressive decode: returns ``[b, max_new_tokens]`` completions.
 
@@ -293,7 +321,8 @@ def generate(
         rng = jax.random.PRNGKey(0)  # unused; keeps the scan carry uniform
 
     embed_p, block_p, head_p = _split_params(cfg, params)
-    logits0, cache = prefill(cfg, params, prompt, total)
+    mlp_layer = _mlp_layer_for(cfg, moe)
+    logits0, cache = prefill(cfg, params, prompt, total, moe=moe)
 
     def step(carry, _):
         cache, logits, key, alive = carry
@@ -303,7 +332,7 @@ def generate(
             tok = jnp.where(alive, tok, eos_id)
             alive = alive & (tok != eos_id)
         x = jnp.take(embed_p["table"], tok[:, None], axis=0)
-        x, cache = _decode_step(cfg, block_p, x, cache)
+        x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer)
         return (cache, _logits(cfg, head_p, x)[:, 0], key, alive), tok
 
     alive0 = jnp.ones((b,), bool)
