@@ -1,0 +1,79 @@
+"""Decode throughput for the KV-cache generator (tokens/sec).
+
+No reference counterpart (the reference is training-only) — this is the
+measurement surface for :mod:`torchgpipe_tpu.models.generation`: one
+compiled prefill+decode program, steady-state timed.  On TPU the decode
+scan is HBM-bandwidth-bound (weights re-read per token); batch rows are
+the lever, exactly like production decode servers.
+
+Usage::
+
+    env JAX_PLATFORMS=cpu python -m benchmarks.llama_decode --preset tiny
+    python -m benchmarks.llama_decode --preset 1b --batch 8   # on TPU
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+from torchgpipe_tpu.layers import sequential_init
+from torchgpipe_tpu.models.generation import generate
+from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+
+PRESETS = {
+    # dim, n_layers, n_heads, n_kv_heads, vocab
+    "tiny": (128, 4, 4, 2, 512),
+    "small": (512, 8, 8, 4, 8192),
+    "1b": (2048, 16, 32, 8, 128256),
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=sorted(PRESETS), default="tiny")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--prompt-len", type=int, default=32)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--bf16", action="store_true")
+    args = ap.parse_args()
+
+    dim, n_layers, nh, nkv, vocab = PRESETS[args.preset]
+    cfg = TransformerConfig(
+        vocab=vocab, dim=dim, n_layers=n_layers, n_heads=nh, n_kv_heads=nkv,
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+    )
+    b, s, new = args.batch, args.prompt_len, args.new_tokens
+    spec = jax.ShapeDtypeStruct((b, s), jnp.int32)
+    params, _, _ = sequential_init(llama(cfg), jax.random.PRNGKey(0), spec)
+    prompt = jnp.mod(jnp.arange(b * s).reshape(b, s), vocab).astype(jnp.int32)
+
+    run = jax.jit(
+        lambda p, t: generate(cfg, p, t, max_new_tokens=new)
+    )
+    jax.block_until_ready(run(params, prompt))  # compile
+    best = float("inf")
+    for _ in range(args.steps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(params, prompt))
+        best = min(best, time.perf_counter() - t0)
+    toks = b * new
+    print(
+        f"{args.preset}: batch {b}, prompt {s}, {new} new tokens -> "
+        f"{toks / best:.1f} tokens/sec "
+        f"({best * 1e3 / new:.2f} ms/token/batch, "
+        f"platform {jax.devices()[0].platform})",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -354,6 +354,21 @@ def test_examples_spmd_skips():
     assert "spmd-skips demo complete" in r.stdout
 
 
+def test_llama_decode_smoke():
+    """The decode-throughput driver runs end to end on CPU and reports a
+    sane tokens/sec line."""
+    repo = pathlib.Path(REPO)
+    env = cpu_subproc_env()
+    r = subprocess.run(
+        [sys.executable, "-m", "benchmarks.llama_decode", "--preset", "tiny",
+         "--batch", "2", "--prompt-len", "8", "--new-tokens", "8",
+         "--steps", "1"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(repo),
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "tokens/sec" in r.stdout, r.stdout
+
+
 def test_examples_long_context():
     """The long-context tour (ring / ulysses / ulysses+window on a pp x sp
     mesh) runs end to end and its losses descend."""

@@ -135,6 +135,12 @@ run_step overlap-on-tpu 1800 -t tools/tpu_overlap_test.txt \
   python -m pytest tests/test_overlap.py -q --no-header \
   || bail_if_dead
 
+# (8) Decode throughput for the KV-cache generator (round-4 capability):
+# the 1b preset in bf16 — HBM-bandwidth-bound on the chip.
+run_step llama-decode 2400 -t tools/tpu_llama_decode.txt \
+  python -m benchmarks.llama_decode --preset 1b --batch 8 --bf16 \
+  || bail_if_dead
+
 # (zb-vs-1f1b wall clock needs a multi-stage mesh — impossible on the
 # single tunneled chip; the CPU-mesh measured-vs-predicted table in
 # BENCH_NOTES covers it.)
