@@ -1,0 +1,63 @@
+"""Train with the pipeline, decode with the same weights.
+
+A 60-second end-to-end tour of :mod:`torchgpipe_tpu.models.generation`:
+a tiny llama learns "next token = previous + 1 (mod vocab)" through the
+MPMD GPipe engine, then the KV-cache generator continues prompts from
+the SAME per-stage params (``mpmd_params_for_generation`` — no weight
+conversion) and we check it reproduces the learned sequence.
+
+CPU (8 virtual devices):
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/generate.py
+
+On TPU just run it.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu import GPipe
+from torchgpipe_tpu.models import generate, mpmd_params_for_generation
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    cross_entropy,
+    llama,
+)
+
+
+def main() -> None:
+    cfg = TransformerConfig(
+        vocab=32, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+    )
+    model = GPipe(llama(cfg), balance=[2, 2], chunks=2)
+    b, s = 4, 12
+    data = jnp.mod(jnp.arange(s + 1)[None, :] + jnp.arange(b)[:, None], 32)
+    x, y = data[:, :-1], data[:, 1:]
+    params, state = model.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    for step in range(60):
+        loss, grads, state, _ = model.value_and_grad(
+            params, state, x, y, cross_entropy
+        )
+        params = tuple(
+            jax.tree_util.tree_map(lambda p, g: p - 0.5 * g, ps, gs)
+            for ps, gs in zip(params, grads)
+        )
+        if step % 20 == 0:
+            print(f"[generate] step {step} loss {float(loss):.4f}", flush=True)
+
+    flat = mpmd_params_for_generation(model, params)
+    prompt = data[:, :6]
+    out = generate(cfg, flat, prompt, max_new_tokens=5)
+    expect = jnp.mod(prompt[:, -1:] + jnp.arange(1, 6)[None, :], 32)
+    acc = float(jnp.mean((out == expect).astype(jnp.float32)))
+    print(f"[generate] continuation {out[0].tolist()} "
+          f"(expected {expect[0].tolist()}), accuracy {acc:.2f}")
+    assert acc > 0.9, acc
+    print("generate demo complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -354,6 +354,19 @@ def test_examples_spmd_skips():
     assert "spmd-skips demo complete" in r.stdout
 
 
+def test_examples_generate():
+    """The train-then-decode demo runs end to end and its learned-sequence
+    assertion holds."""
+    repo = pathlib.Path(REPO)
+    env = cpu_subproc_env(XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, str(repo / "examples" / "generate.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(repo),
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "generate demo complete" in r.stdout, r.stdout
+
+
 def test_llama_decode_smoke():
     """The decode-throughput driver runs end to end on CPU and reports a
     sane tokens/sec line."""
