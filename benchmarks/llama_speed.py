@@ -89,7 +89,7 @@ def causal_lm_loss(out, tokens):
                    "virtual pipeline stages (--virtual-stages chunks per "
                    "device, ~v x smaller bubble); zb splits the backward "
                    "into dx-only B cells + weight-grad W cells that "
-                   "back-fill bubbles (needs --checkpoint never)")
+                   "back-fill bubbles (checkpoint never|always)")
 @click.option("--virtual-stages", default=2,
               help="model chunks per device for --schedule interleaved")
 @click.option("--fsdp/--no-fsdp", default=False,

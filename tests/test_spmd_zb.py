@@ -36,7 +36,7 @@ def _tokens(b, s=16):
     return t, (t + 1) % 64
 
 
-def _engines(pp, mesh, m, **kw):
+def _engines(pp, mesh, m, zb_checkpoint="never", **kw):
     cfg = TransformerConfig(
         vocab=64, dim=32, n_layers=pp, n_heads=4, n_kv_heads=2,
         tp_axis=kw.get("tp_axis"),
@@ -45,15 +45,19 @@ def _engines(pp, mesh, m, **kw):
     common = dict(chunks=m, loss_fn=cross_entropy, pre=pre, post=post, **kw)
     return (
         SpmdGPipe(block, pp, mesh, checkpoint="always", **common),
-        SpmdGPipe(block, pp, mesh, checkpoint="never", schedule="zb", **common),
+        SpmdGPipe(
+            block, pp, mesh, checkpoint=zb_checkpoint, schedule="zb",
+            **common,
+        ),
     )
 
 
 @pytest.mark.parametrize("m", [1, 2, 6])
-def test_zb_matches_fill_drain(m):
+@pytest.mark.parametrize("zb_ckpt", ["never", "always"])
+def test_zb_matches_fill_drain(m, zb_ckpt):
     pp = 4
     mesh = make_mesh(pp, 1, devices=jax.devices()[:4])
-    fd, zb = _engines(pp, mesh, m)
+    fd, zb = _engines(pp, mesh, m, zb_checkpoint=zb_ckpt)
     tokens, labels = _tokens(2 * m)
     params = fd.init(
         jax.random.PRNGKey(0), jax.ShapeDtypeStruct(tokens.shape, tokens.dtype)
@@ -161,6 +165,34 @@ def test_zb_runtime_forward_counts():
     assert len(calls) == pp * m, len(calls)
 
 
+def test_zb_always_runtime_forward_counts():
+    """checkpoint='always' zb: the B cell recomputes its forward from the
+    banked input — exactly 2m block-forwards per stage (F + recompute),
+    vs m for 'never'."""
+    from tests.conftest import counting_layer
+    from torchgpipe_tpu.layers import chain
+    from torchgpipe_tpu.ops import dense
+
+    calls = []
+    pp, m, dim = 2, 3, 8
+    mesh = make_mesh(pp, 1, devices=jax.devices()[:2])
+    block = chain([counting_layer(calls), dense(dim, name="fc")], name="block")
+    mse = lambda o, t: jnp.mean((o - t) ** 2)  # noqa: E731
+    x = jax.random.normal(jax.random.PRNGKey(5), (2 * m, dim))
+    y = jax.random.normal(jax.random.PRNGKey(6), (2 * m, dim))
+    eng = SpmdGPipe(
+        block, pp, mesh, chunks=m, loss_fn=mse, checkpoint="always",
+        loss_reduction="mean", schedule="zb",
+    )
+    params = eng.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    loss, _ = eng.train_step(params, x, y)
+    jax.block_until_ready(loss)
+    jax.effects_barrier()
+    assert len(calls) == 2 * pp * m, len(calls)
+
+
 def test_zb_scan_length_matches_tables():
     """The compiled program scans exactly the table's tick count (3m-ish,
     vs 1F1B's 2(m+n-1)) — the schedule is the program."""
@@ -198,8 +230,12 @@ def test_zb_validation():
                             n_kv_heads=2)
     block, pre, post = llama_spmd(cfg, pp)
     ok = dict(chunks=2, loss_fn=cross_entropy, pre=pre, post=post)
-    with pytest.raises(ValueError, match="requires checkpoint='never'"):
-        SpmdGPipe(block, pp, mesh, schedule="zb", **ok)
+    # checkpoint='always' is a SUPPORTED zb mode since round 4 (recompute
+    # in the B cell); only 'except_last' has no zb counterpart.
+    SpmdGPipe(block, pp, mesh, schedule="zb", **ok)
+    with pytest.raises(ValueError, match="no zb counterpart"):
+        SpmdGPipe(block, pp, mesh, schedule="zb",
+                  checkpoint="except_last", **ok)
     with pytest.raises(ValueError, match="decompose over"):
         SpmdGPipe(
             block, pp, mesh, schedule="zb", checkpoint="never",

@@ -85,6 +85,9 @@ class ZeroBubbleTables:
     y_slots: int           # last-stage loss-seed ring depth (F -> B span)
     resid_slots: int       # stored-vjp residual ring depth (F -> W span)
     dy_slots: int          # stored-cotangent ring depth (B -> W span)
+    x_slots: int           # stored cell-INPUT ring depth (F -> B span) —
+                           # the recompute variant (checkpoint='always')
+                           # stores inputs instead of F-time vjp residuals
 
     @property
     def bubble_ticks(self) -> int:
@@ -206,6 +209,7 @@ def zero_bubble_tables(n: int, m: int) -> ZeroBubbleTables:
     y_spans: dict = {}     # last-stage F output -> B loss seed
     resid_spans: dict = {}  # F stores vjp residuals -> W last read
     dy_spans: dict = {}    # B stores its cotangent -> W reads it
+    x_spans: dict = {}     # F stores its input -> B recomputes from it
     for (kind, i, j), tt in tick_of.items():
         if kind == F:
             if j > 0:
@@ -213,6 +217,7 @@ def zero_bubble_tables(n: int, m: int) -> ZeroBubbleTables:
             if j == n - 1:
                 y_spans[(j, i)] = (tt, tick_of[(B, i, j)])
             resid_spans[(j, i)] = (tt, tick_of[(W, i, j)])
+            x_spans[(j, i)] = (tt, tick_of[(B, i, j)])
         elif kind == B:
             if j < n - 1:
                 cot_spans[(j, i)] = (tick_of[(B, i, j + 1)] + 1, tt)
@@ -231,6 +236,7 @@ def zero_bubble_tables(n: int, m: int) -> ZeroBubbleTables:
         y_slots=_min_depth(y_spans) if y_spans else 1,
         resid_slots=_min_depth(resid_spans),
         dy_slots=_min_depth(dy_spans),
+        x_slots=_min_depth(x_spans),
     )
     _validate(tables)
     return tables

@@ -396,8 +396,10 @@ class SpmdGPipe:
         pipeline stages; see ``virtual_stages``) or 'zb' (zero-bubble:
         the backward splits into activation-gradient B cells and
         weight-gradient W cells that back-fill bubble ticks — per-tick
-        backward work halves; requires ``checkpoint='never'``, whose
-        stored vjp residuals both halves replay; see
+        backward work halves; ``checkpoint='never'`` replays F-stored
+        vjp residuals in both halves (zero recompute), and
+        ``checkpoint='always'`` recomputes once in the B cell with O(1)
+        residual slots; see
         :mod:`torchgpipe_tpu.parallel.zerobubble`).  1F1B interleaves each
         micro-batch's backward with later micro-batches' forwards inside
         the same compiled scan, computing gradients explicitly per cell,
@@ -574,14 +576,23 @@ class SpmdGPipe:
             raise ValueError(
                 "virtual_stages only applies to schedule='interleaved'"
             )
-        if self.schedule == "zb" and self.checkpoint != "never":
+        if self.schedule == "zb" and self.remat_policy is not None:
             raise ValueError(
-                "schedule='zb' requires checkpoint='never': the B/W "
-                "backward split replays stored vjp residuals twice (dx in "
-                "the B cell, weight grads in the W cell) — recompute modes "
-                "would re-run the forward in both halves.  Use "
-                "schedule='1f1b' for checkpoint="
-                f"{self.checkpoint!r}"
+                "remat_policy has no effect under schedule='zb': the "
+                "recompute split is explicit in the schedule (B cells "
+                "recompute whole cells under checkpoint='always'; "
+                "checkpoint='never' stores vjp residuals outright)"
+            )
+        if self.schedule == "zb" and self.checkpoint == "except_last":
+            raise ValueError(
+                "schedule='zb' supports checkpoint='never' (vjp residuals "
+                "stored at forward time, replayed by both backward halves "
+                "— zero recompute, O(pipeline window) residual memory) and "
+                "checkpoint='always' (the B cell recomputes the forward "
+                "once and banks its vjp for the immediately-following W "
+                "cell — O(1) residual slots for ~one extra forward per "
+                "micro-batch); 'except_last' has no zb counterpart.  Use "
+                "schedule='1f1b' for checkpoint='except_last'"
             )
         if self.schedule in ("1f1b", "interleaved", "zb"):
             sched = f"schedule={self.schedule!r}"
@@ -1729,15 +1740,23 @@ class SpmdGPipe:
         the critical path the downstream stage waits on) and W cells
         (weight gradients d_blk/d_pre — consumed only at step end), per
         the static tables of :mod:`torchgpipe_tpu.parallel.zerobubble`.
-        Both halves replay the SAME stored-vjp residuals the forward cell
-        banked (the checkpoint='never' machinery); each half uses only
-        its own outputs of the rebuilt vjp closure, so XLA dead-code-
-        eliminates the other half's matmuls — per-tick backward work
-        drops from dx+dW to max(dx, dW), and early stages' drain ticks
-        run W work instead of idling (weighted-makespan win proven at the
-        table level, tests/test_zerobubble.py).  Requires
-        ``checkpoint='never'``: the split exists BECAUSE residuals are
-        stored once and replayed twice.
+        Each half uses only its own outputs of a shared vjp closure, so
+        XLA dead-code-eliminates the other half's matmuls — per-tick
+        backward work drops from dx+dW to max(dx, dW), and early stages'
+        drain ticks run W work instead of idling (weighted-makespan win
+        proven at the table level, tests/test_zerobubble.py).  Two
+        residual policies:
+
+        * ``checkpoint='never'`` — the F cell banks its vjp residuals
+          (ring depth = the F->W spans) and both halves replay them:
+          zero recompute, O(pipeline window) residual memory.
+        * ``checkpoint='always'`` — the F cell banks only its INPUT
+          (F->B spans); the B cell recomputes the forward once, takes
+          dx, and banks the fresh vjp for the W cell (B->W spans — ONE
+          slot under the H1 immediate-W placement): O(1) residual
+          memory for ~one extra forward per micro-batch.  Any
+          ``remat_policy`` is ignored here — the recompute split is
+          explicit in the schedule.
 
         No reference counterpart at any level (the reference has
         fill-drain only; ZB is Qi et al. arXiv:2401.10241 — public
@@ -1753,6 +1772,15 @@ class SpmdGPipe:
         n, m = self.n_stages, self.chunks
         tb = zero_bubble_tables(n, m)
         S, Sy, Dr, Dy = tb.slots, tb.y_slots, tb.resid_slots, tb.dy_slots
+        Sx = tb.x_slots
+        # checkpoint='never': F banks the vjp residuals (depth Dr, F->W
+        # spans) and both halves replay them — zero recompute.
+        # checkpoint='always': F banks only its INPUT (depth Sx, F->B
+        # spans); B recomputes the cell once, takes dx, and banks the
+        # fresh vjp for the W cell (depth Dy, B->W spans — ONE slot under
+        # the H1 immediate-W placement).
+        store_at_f = self.checkpoint == "never"
+        Dres = Dr if store_at_f else Dy
         data_spec = self._data_specs()
         tmap = jax.tree_util.tree_map
         # Scan xs: this tick's (kind, mb) row plus the PREVIOUS tick's row
@@ -1858,15 +1886,16 @@ class SpmdGPipe:
                 gact=act0,
                 inbox=ring(S),
                 gbox=ring(S),
-                ybox=ring(Sy),
+                ybox=ring(Sy if store_at_f else 1),
                 dybuf=ring(Dy),
                 rbuf=tuple(
                     jnp.zeros(
-                        (Dr,) + vjp_leaf_specs[i].shape,
+                        (Dres,) + vjp_leaf_specs[i].shape,
                         vjp_leaf_specs[i].dtype,
                     )
                     for i in buffered_idx
                 ),
+                **({} if store_at_f else {"xbuf": ring(Sx)}),
                 gblk=tmap(jnp.zeros_like, params_local),
                 gpre=tmap(jnp.zeros_like, pre_params),
                 gpost=tmap(jnp.zeros_like, post_params),
@@ -1881,11 +1910,21 @@ class SpmdGPipe:
                     passthrough,
                     iter(
                         lax.dynamic_index_in_dim(
-                            b, i % Dr, 0, keepdims=False
+                            b, i % Dres, 0, keepdims=False
                         )
                         for b in c["rbuf"]
                     ),
                     param_flat,
+                )
+
+            def bank_vjp(rbuf, vjp_fn, i):
+                leaves = jax.tree_util.tree_leaves(vjp_fn)
+                _never_check_leaves(leaves, vjp_leaf_specs, "zb")
+                return tuple(
+                    lax.dynamic_update_index_in_dim(
+                        b, leaves[i2], i % Dres, 0
+                    )
+                    for b, i2 in zip(rbuf, buffered_idx)
                 )
 
             def tick(carry, rows):
@@ -1915,27 +1954,51 @@ class SpmdGPipe:
                 i = irow[stage]
 
                 def f_branch(c):
-                    y, vjp_fn = jax.vjp(
-                        lambda a, b, xx: cell_fn(a, b, xx, i),
-                        params_local, pre_params,
-                        _slot_read(c["inbox"], i % S),
-                    )
-                    leaves = jax.tree_util.tree_leaves(vjp_fn)
-                    _never_check_leaves(leaves, vjp_leaf_specs, "zb")
-                    rbuf = tuple(
-                        lax.dynamic_update_index_in_dim(
-                            b, leaves[i2], i % Dr, 0
+                    xin = _slot_read(c["inbox"], i % S)
+                    if store_at_f:
+                        y, vjp_fn = jax.vjp(
+                            lambda a, b, xx: cell_fn(a, b, xx, i),
+                            params_local, pre_params, xin,
                         )
-                        for b, i2 in zip(c["rbuf"], buffered_idx)
-                    )
-                    ybox = _slot_write(c["ybox"], i % Sy, y, stage == n - 1)
-                    return dict(c, act=y, rbuf=rbuf, ybox=ybox)
+                        extra = dict(rbuf=bank_vjp(c["rbuf"], vjp_fn, i))
+                        # The loss seed: only 'never' needs F's output
+                        # saved — the recompute mode re-produces it in the
+                        # B cell (its ybox stays a depth-1 dummy).
+                        extra["ybox"] = _slot_write(
+                            c["ybox"], i % Sy, y, stage == n - 1
+                        )
+                    else:
+                        # Recompute mode: forward only; bank the INPUT for
+                        # the B cell's recompute.
+                        y = cell_fn(params_local, pre_params, xin, i)
+                        extra = dict(
+                            xbuf=_slot_write(c["xbuf"], i % Sx, xin, True)
+                        )
+                    return dict(c, act=y, **extra)
 
                 def b_branch(c):
-                    vjp_cell = rebuild(c, i)
+                    if store_at_f:
+                        vjp_cell = rebuild(c, i)
+                        rbuf = c["rbuf"]
+                        y_re = None
+                    else:
+                        # Recompute the cell once; its vjp serves BOTH this
+                        # dx and the following W cell's weight grads — and
+                        # its primal output is the last stage's loss seed.
+                        y_re, vjp_fn = jax.vjp(
+                            lambda a, b, xx: cell_fn(a, b, xx, i),
+                            params_local, pre_params,
+                            _slot_read(c["xbuf"], i % Sx),
+                        )
+                        rbuf = bank_vjp(c["rbuf"], vjp_fn, i)
+                        vjp_cell = vjp_fn
 
                     def last_fn():
-                        y_saved = _slot_read(c["ybox"], i % Sy)
+                        y_saved = (
+                            _slot_read(c["ybox"], i % Sy)
+                            if store_at_f
+                            else y_re
+                        )
 
                         def tail(p_post, p_loss, yy):
                             return mb_loss(yy, p_post, p_loss, i)
@@ -1964,6 +2027,7 @@ class SpmdGPipe:
                     return dict(
                         c,
                         gact=dx,
+                        rbuf=rbuf,
                         dybuf=_slot_write(c["dybuf"], i % Dy, dy, True),
                         gpost=tmap(jnp.add, c["gpost"], d_post),
                         gloss=tmap(jnp.add, c["gloss"], d_loss),
