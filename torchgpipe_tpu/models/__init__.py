@@ -12,6 +12,7 @@ from torchgpipe_tpu.models.generation import (  # noqa: F401
     init_cache,
     mpmd_params_for_generation,
     prefill,
+    spmd_params_for_generation,
 )
 from torchgpipe_tpu.models.moe import (  # noqa: F401
     MoEConfig,

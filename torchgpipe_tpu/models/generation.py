@@ -358,10 +358,49 @@ def mpmd_params_for_generation(
     return out
 
 
+def spmd_params_for_generation(
+    pipe: Any, params: Any, device: Any = None
+) -> List[Pytree]:
+    """Per-layer list for :func:`generate` from an ``SpmdGPipe`` built via
+    ``llama_spmd(cfg, n_stages)`` (optionally with ``chunked_lm_loss``):
+    the stacked ``[n_stages, ...]`` block params unstack into the flat
+    (embed, blocks..., head) order, the head coming from ``post`` or —
+    under a parametric loss layer — from ``params['loss']`` (the shared
+    ``_head_init`` schema makes them interchangeable).  Everything lands
+    on ``device`` (default: the first device) — train sharded, decode
+    single-host with the same weights."""
+    if getattr(pipe, "virtual_stages", 1) != 1:
+        raise ValueError(
+            "interleaved (virtual_stages > 1) block layouts are not "
+            "supported for decode extraction; train the final weights "
+            "with v=1 or restack them first"
+        )
+    if device is None:
+        device = jax.devices()[0]
+    tmap = jax.tree_util.tree_map
+    out: List[Pytree] = [params["pre"]]
+    for j in range(pipe.n_stages):
+        stage = tmap(lambda a: a[j], params["blocks"])
+        if not isinstance(stage, (tuple, list)):
+            stage = (stage,)
+        out.extend(stage)
+    if pipe.post is not None:
+        out.append(params["post"])
+    elif "loss" in params:
+        out.append(params["loss"])
+    else:
+        raise ValueError(
+            "no head params: the engine has neither a post layer nor a "
+            "parametric loss layer holding the lm head"
+        )
+    return [jax.device_put(p, device) for p in out]
+
+
 __all__ = [
     "KVCache",
     "init_cache",
     "prefill",
     "generate",
     "mpmd_params_for_generation",
+    "spmd_params_for_generation",
 ]
