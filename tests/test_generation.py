@@ -234,3 +234,29 @@ def test_spmd_roundtrip_interleaved_rejected():
     )
     with pytest.raises(ValueError, match="virtual_stages"):
         spmd_params_for_generation(pipe, {})
+
+
+@pytest.mark.slow
+def test_prefill_flash_wiring_matches_dense():
+    """use_flash=True routes prefill attention through the Pallas kernel
+    (interpret mode off-TPU): logits and cache must match the dense path.
+    Needs kernel-block-aligned sequence lengths."""
+    cfg = TransformerConfig(
+        vocab=64, dim=256, n_layers=1, n_heads=4, n_kv_heads=2
+    )
+    b, s = 1, 128  # block_q/block_k = 128: one tile
+    layers = llama(cfg)
+    spec = jax.ShapeDtypeStruct((b, s), jnp.int32)
+    params, _, _ = sequential_init(layers, jax.random.PRNGKey(0), spec)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s), cfg.vocab)
+    l_dense, c_dense = prefill(cfg, params, tokens, max_len=s,
+                               use_flash=False)
+    l_flash, c_flash = prefill(cfg, params, tokens, max_len=s,
+                               use_flash=True)
+    np.testing.assert_allclose(
+        np.asarray(l_flash), np.asarray(l_dense), rtol=2e-3, atol=2e-3
+    )
+    for a, bb in zip(c_flash.k, c_dense.k):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=1e-5, atol=1e-6
+        )

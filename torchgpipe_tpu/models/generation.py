@@ -223,11 +223,27 @@ def _attend_full(
     k: jnp.ndarray,          # [b, s, nkv, hd]
     v: jnp.ndarray,
     window: Optional[int],
+    use_flash: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Causal (optionally banded) full-sequence attention, GQA-grouped —
     the batched twin of :func:`_attend_cached` (prefill's one big
-    MXU-friendly pass instead of s cache reads)."""
+    MXU-friendly pass instead of s cache reads).
+
+    ``use_flash=None`` auto-dispatches the Pallas flash kernel on TPU
+    (O(block²) score memory — the long-prompt prefill path) and the
+    dense einsum elsewhere; pass True/False to force (True off-TPU runs
+    the kernel in interpret mode — for tests)."""
     b, s, nh, hd = q.shape
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if use_flash is None:
+        use_flash = on_tpu
+    if use_flash:
+        from torchgpipe_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(
+            q, k, v, causal=True, window=window, interpret=not on_tpu
+        )
+        return out.reshape(b, s, nh * hd)
     nkv = k.shape[2]
     r = nh // nkv
     qg = q.reshape(b, s, nkv, r, hd)
@@ -251,11 +267,13 @@ def prefill(
     tokens: jnp.ndarray,          # [b, s] int32 prompt
     max_len: int,
     moe: Optional[Any] = None,
+    use_flash: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """ONE batched full-sequence pass over the prompt (MXU-friendly, no
     per-token loop): computes each block's K/V for all prompt positions,
     banks them in the cache, and returns (last-position logits
-    [b, vocab], cache ready for decode at position s)."""
+    [b, vocab], cache ready for decode at position s).  ``use_flash``
+    as in :func:`_attend_full` (auto: Pallas flash kernel on TPU)."""
     embed_p, block_p, head_p = _split_params(cfg, params)
     b, s = tokens.shape
     if s > max_len:
@@ -274,7 +292,7 @@ def prefill(
         v = (h @ p["wv"]).reshape(b, s, nkv_loc, hd)
         q = _rope(q, cfg.rope_theta, 0)
         k = _rope(k, cfg.rope_theta, 0)
-        attn = _attend_full(q, k, v, cfg.attn_window)
+        attn = _attend_full(q, k, v, cfg.attn_window, use_flash)
         x = x + (attn.astype(x.dtype) @ p["wo"])
         h = _rms(x, p["ln2"], cfg.norm_eps)
         x = x + _mlp_out(cfg, p, h, mlp_layer)
