@@ -218,7 +218,10 @@ def test_spmd_roundtrip():
     assert (np.asarray(out[:, 0]) == expect).all()
 
 
-def test_spmd_roundtrip_interleaved_rejected():
+def test_spmd_roundtrip_interleaved():
+    """Interleaved (virtual-stage) layouts restack by the Megatron
+    round-robin rule: decode first token == the engine's own pipelined
+    inference argmax."""
     from torchgpipe_tpu.models.generation import spmd_params_for_generation
     from torchgpipe_tpu.models.transformer import cross_entropy, llama_spmd
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
@@ -226,14 +229,24 @@ def test_spmd_roundtrip_interleaved_rejected():
     cfg = TransformerConfig(
         vocab=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2
     )
-    block, pre, post = llama_spmd(cfg, 4)
-    mesh = make_mesh(2, 1, devices=jax.devices()[:2])
+    n, v, m = 2, 2, 2
+    block, pre, post = llama_spmd(cfg, n * v)
+    mesh = make_mesh(n, 1, devices=jax.devices()[:n])
     pipe = SpmdGPipe(
-        block, 2, mesh, chunks=2, loss_fn=cross_entropy, pre=pre, post=post,
-        schedule="interleaved", virtual_stages=2,
+        block, n, mesh, chunks=m, loss_fn=cross_entropy, pre=pre, post=post,
+        schedule="interleaved", virtual_stages=v,
     )
-    with pytest.raises(ValueError, match="virtual_stages"):
-        spmd_params_for_generation(pipe, {})
+    b, s = 2, 8
+    spec = jax.ShapeDtypeStruct((b * m, s), jnp.int32)
+    params = pipe.place(pipe.init(jax.random.PRNGKey(0), spec))
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s) * 5 + 2, cfg.vocab)
+
+    flat = spmd_params_for_generation(pipe, params)
+    out = generate(cfg, flat, tokens, max_new_tokens=2)
+
+    logits = pipe.apply(params, jnp.tile(tokens, (m, 1)))[:b]
+    expect = np.argmax(np.asarray(logits, np.float32)[:, -1], -1)
+    assert (np.asarray(out[:, 0]) == expect).all()
 
 
 @pytest.mark.slow

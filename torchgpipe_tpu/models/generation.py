@@ -381,24 +381,27 @@ def spmd_params_for_generation(
 ) -> List[Pytree]:
     """Per-layer list for :func:`generate` from an ``SpmdGPipe`` built via
     ``llama_spmd(cfg, n_stages)`` (optionally with ``chunked_lm_loss``):
-    the stacked ``[n_stages, ...]`` block params unstack into the flat
-    (embed, blocks..., head) order, the head coming from ``post`` or —
-    under a parametric loss layer — from ``params['loss']`` (the shared
-    ``_head_init`` schema makes them interchangeable).  Everything lands
-    on ``device`` (default: the first device) — train sharded, decode
-    single-host with the same weights."""
-    if getattr(pipe, "virtual_stages", 1) != 1:
-        raise ValueError(
-            "interleaved (virtual_stages > 1) block layouts are not "
-            "supported for decode extraction; train the final weights "
-            "with v=1 or restack them first"
-        )
+    the stacked ``[n_stages, ...]`` block params (or the interleaved
+    ``[n_stages, virtual_stages, ...]`` layout, restacked by Megatron's
+    round-robin rule) unstack into the flat (embed, blocks..., head)
+    order, the head coming from ``post`` or — under a parametric loss
+    layer — from ``params['loss']`` (the shared ``_head_init`` schema
+    makes them interchangeable).  Everything lands on ``device``
+    (default: the first device) — train sharded, decode single-host with
+    the same weights."""
     if device is None:
         device = jax.devices()[0]
     tmap = jax.tree_util.tree_map
+    v = getattr(pipe, "virtual_stages", 1)
     out: List[Pytree] = [params["pre"]]
-    for j in range(pipe.n_stages):
-        stage = tmap(lambda a: a[j], params["blocks"])
+    n = pipe.n_stages
+    for g in range(n * v):
+        # Megatron round-robin: global block g lives on device g % n as
+        # its chunk g // n (v=1 degenerates to plain per-stage order).
+        stage = tmap(
+            lambda a: a[g % n, g // n] if v > 1 else a[g % n],
+            params["blocks"],
+        )
         if not isinstance(stage, (tuple, list)):
             stage = (stage,)
         out.extend(stage)
