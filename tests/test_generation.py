@@ -273,3 +273,25 @@ def test_prefill_flash_wiring_matches_dense():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(bb), rtol=1e-5, atol=1e-6
         )
+
+
+@pytest.mark.parametrize("window", [1, 3, 100])
+@pytest.mark.parametrize("nkv", [1, 2, 4])
+def test_window_and_gqa_edges_teacher_forced(window, nkv):
+    """Window extremes (1 = attend only to self; > seq = effectively full)
+    and GQA ratios from MQA (nkv=1) to MHA (nkv=nh) all keep decode ==
+    training forward."""
+    cfg = TransformerConfig(
+        vocab=32, dim=32, n_layers=1, n_heads=4, n_kv_heads=nkv,
+        attn_window=window,
+    )
+    b, s, new = 2, 4, 3
+    layers, params, states = _build(cfg, b, s)
+    tokens = jnp.mod(3 * jnp.arange(b * s).reshape(b, s) + 2, cfg.vocab)
+    out = generate(cfg, params, tokens, max_new_tokens=new)
+    seq = np.asarray(tokens)
+    for t in range(new):
+        ref = _full_logits(layers, params, states, jnp.asarray(seq))[:, -1]
+        expect = np.argmax(ref, -1)
+        assert (np.asarray(out[:, t]) == expect).all(), (window, nkv, t)
+        seq = np.concatenate([seq, expect[:, None].astype(np.int32)], axis=1)
