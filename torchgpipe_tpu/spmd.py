@@ -461,11 +461,12 @@ class SpmdGPipe:
     # Unroll factor for the schedule's tick scan (``lax.scan(unroll=...)``;
     # True = fully unroll).  Unrolling makes slot/ring indices static so
     # XLA folds the buffer machinery and fuses across ticks — measured
-    # -26% (1f1b) / -29% (zb) step time at n=4 m=8 toy cells on the CPU
-    # mesh (BENCH_NOTES round 4) — at the cost of compile time roughly
-    # linear in the unroll factor (1.6s -> 8.7s fully unrolled there).
-    # Worth it when per-cell compute is small relative to tick overhead
-    # and the step runs many times; the default 1 keeps compile fastest.
+    # -26%/-14% (1f1b) and -29%/-33% (zb) step time at toy/dim-1024
+    # cells on the CPU mesh (BENCH_NOTES round 4) — at compile time
+    # roughly linear in the factor (1.6s -> 8.7s fully unrolled there).
+    # SCHEDULE-DEPENDENT: it serves the slot-buffer schedules (1f1b, zb,
+    # interleaved); fill-drain's remat-structured scans measured SLOWER
+    # fully unrolled at large cells — leave fill_drain at the default.
     scan_unroll: Union[int, bool] = 1
 
     def __repr__(self) -> str:
