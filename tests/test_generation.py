@@ -295,3 +295,17 @@ def test_window_and_gqa_edges_teacher_forced(window, nkv):
         expect = np.argmax(ref, -1)
         assert (np.asarray(out[:, t]) == expect).all(), (window, nkv, t)
         seq = np.concatenate([seq, expect[:, None].astype(np.int32)], axis=1)
+
+
+def test_generate_compiles_to_single_decode_scan():
+    """The decode loop is ONE lax.scan over max_new_tokens ticks (no
+    per-token retracing) — the static-shape contract of the module."""
+    from tests.jaxpr_utils import scan_lengths
+
+    b, s, new = 1, 4, 7
+    _, params, _ = _build(CFG, b, s)
+    tokens = jnp.zeros((b, s), jnp.int32)
+    jaxpr = jax.make_jaxpr(
+        lambda p, t: generate(CFG, p, t, max_new_tokens=new)
+    )(params, tokens)
+    assert new in scan_lengths(jaxpr.jaxpr), scan_lengths(jaxpr.jaxpr)
