@@ -8,6 +8,7 @@ transformer/Llama family for the SPMD flagship path.
 from torchgpipe_tpu.models.amoebanet import amoebanetd  # noqa: F401
 from torchgpipe_tpu.models.generation import (  # noqa: F401
     KVCache,
+    beam_search,
     generate,
     init_cache,
     mpmd_params_for_generation,

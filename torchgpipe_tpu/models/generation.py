@@ -170,6 +170,16 @@ def _decode_step(
     return x, KVCache(k=new_k, v=new_v, length=pos + 1)
 
 
+def _total_len(s: int, max_new_tokens: int, max_len: Optional[int]) -> int:
+    total = (s + max_new_tokens) if max_len is None else max_len
+    if total < s + max_new_tokens:
+        raise ValueError(
+            f"max_len={total} cannot hold prompt ({s}) + "
+            f"max_new_tokens ({max_new_tokens})"
+        )
+    return total
+
+
 def _mlp_layer_for(cfg: TransformerConfig, moe: Optional[Any]) -> Optional[Any]:
     """The feed-forward Layer for blocks whose params carry an ``"mlp"``
     key (the MoE family); None for the dense SwiGLU default."""
@@ -327,12 +337,7 @@ def generate(
     shapes; trim host-side).  Everything compiles to ONE program:
     prefill scan + decode scan."""
     b, s = prompt.shape
-    total = max_len or (s + max_new_tokens)
-    if total < s + max_new_tokens:
-        raise ValueError(
-            f"max_len={total} cannot hold prompt ({s}) + "
-            f"max_new_tokens ({max_new_tokens})"
-        )
+    total = _total_len(s, max_new_tokens, max_len)
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs rng=jax.random.PRNGKey")
     if temperature == 0.0:
@@ -358,6 +363,140 @@ def generate(
         step, (cache, logits0, rng, alive0), None, length=max_new_tokens
     )
     return toks.T  # [b, max_new_tokens]
+
+
+def beam_search(
+    cfg: TransformerConfig,
+    params: Pytree,
+    prompt: jnp.ndarray,                 # [b, s] int32
+    max_new_tokens: int,
+    *,
+    num_beams: int = 4,
+    eos_id: Optional[int] = None,
+    max_len: Optional[int] = None,
+    moe: Optional[Any] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Deterministic beam decode: returns ``(tokens [b, max_new_tokens],
+    log-probs [b])`` of each prompt's best beam.
+
+    TPU-first shape discipline: beams flatten into the batch dim (the
+    ``b*k`` rows decode exactly like :func:`generate`'s batch), every
+    step re-orders the KV caches by parent beam with one ``jnp.take``,
+    and the whole search is ONE ``lax.scan``.  With ``eos_id``, finished
+    beams freeze (further steps append ``eos_id`` at zero additional
+    log-prob) AND every finished hypothesis is banked in a per-prompt
+    best-finished pool, so a completed sequence can never be lost by
+    later beam eviction — the returned beam is the best of (surviving
+    beams, banked finished hypotheses).  ``num_beams=1`` degenerates to
+    greedy :func:`generate` (tested)."""
+    b, s = prompt.shape
+    k = num_beams
+    if k < 1:
+        raise ValueError(f"num_beams must be >= 1, got {k}")
+    total = _total_len(s, max_new_tokens, max_len)
+    embed_p, block_p, head_p = _split_params(cfg, params)
+    mlp_layer = _mlp_layer_for(cfg, moe)
+    logits0, cache = prefill(cfg, params, prompt, total, moe=moe)
+    vocab = logits0.shape[-1]
+
+    # Seed: the top-k first tokens per prompt; replicate caches k-fold
+    # (beam-major rows: prompt i's beams occupy rows i*k .. i*k+k-1).
+    logp0 = jax.nn.log_softmax(logits0, axis=-1)          # [b, V]
+    seed_lp, seed_tok = lax.top_k(logp0, k)               # [b, k]
+    cache = KVCache(
+        k=[jnp.repeat(a, k, axis=0) for a in cache.k],
+        v=[jnp.repeat(a, k, axis=0) for a in cache.v],
+        length=cache.length,
+    )
+
+    def flat_decode(cache, tok):
+        x = jnp.take(embed_p["table"], tok.reshape(b * k, 1), axis=0)
+        x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer)
+        return cache, _logits(cfg, head_p, x)[:, 0]       # [b*k, V]
+
+    cache, logits = flat_decode(cache, seed_tok)
+    beam_lp = seed_lp                                      # [b, k]
+    alive0 = (
+        seed_tok != eos_id if eos_id is not None
+        else jnp.ones((b, k), bool)
+    )
+    T = max_new_tokens
+    hist0 = jnp.zeros((b, k, T), jnp.int32).at[..., 0].set(seed_tok)
+    # Finished-hypotheses pool: the best completed sequence per prompt,
+    # immune to later beam eviction.
+    fin_lp0 = jnp.full((b,), -jnp.inf)
+    fin_hist0 = jnp.zeros((b, T), jnp.int32)
+    if eos_id is not None:
+        seed_fin = jnp.where(seed_tok == eos_id, seed_lp, -jnp.inf)
+        j0 = jnp.argmax(seed_fin, axis=-1)
+        fin_lp0 = jnp.take_along_axis(seed_fin, j0[:, None], 1)[:, 0]
+        fin_hist0 = jnp.take_along_axis(
+            hist0, j0[:, None, None], axis=1
+        )[:, 0]
+
+    def step(carry, t):
+        cache, logits, beam_lp, alive, hist, fin_lp, fin_hist = carry
+        logp = jax.nn.log_softmax(logits, -1).reshape(b, k, vocab)
+        if eos_id is not None:
+            # Dead beams: only the eos continuation, at zero extra cost.
+            only_eos = jnp.full((vocab,), -jnp.inf).at[eos_id].set(0.0)
+            logp = jnp.where(alive[..., None], logp, only_eos)
+        cand = beam_lp[..., None] + logp                   # [b, k, V]
+        new_lp, flat_idx = lax.top_k(cand.reshape(b, k * vocab), k)
+        parent = flat_idx // vocab                         # [b, k]
+        tok = (flat_idx % vocab).astype(jnp.int32)
+        # Re-order histories, caches and liveness by parent beam, then
+        # record this step's choice at column t.
+        rows = (jnp.arange(b)[:, None] * k + parent).reshape(b * k)
+        hist = jnp.take(
+            hist.reshape(b * k, -1), rows, axis=0
+        ).reshape(b, k, -1)
+        hist = lax.dynamic_update_slice_in_dim(
+            hist, tok[..., None], t, axis=2
+        )
+        cache = KVCache(
+            k=[jnp.take(a, rows, axis=0) for a in cache.k],
+            v=[jnp.take(a, rows, axis=0) for a in cache.v],
+            length=cache.length,
+        )
+        if eos_id is not None:
+            alive = jnp.take(alive.reshape(b * k), rows).reshape(b, k)
+            newly = alive & (tok == eos_id)
+            alive = alive & (tok != eos_id)
+            # Bank newly-finished hypotheses into the per-prompt pool.
+            cand = jnp.where(newly, new_lp, -jnp.inf)      # [b, k]
+            j = jnp.argmax(cand, axis=-1)
+            cand_lp = jnp.take_along_axis(cand, j[:, None], 1)[:, 0]
+            cand_hist = jnp.take_along_axis(
+                hist, j[:, None, None], axis=1
+            )[:, 0]
+            better = cand_lp > fin_lp
+            fin_lp = jnp.where(better, cand_lp, fin_lp)
+            fin_hist = jnp.where(better[:, None], cand_hist, fin_hist)
+        cache, logits = flat_decode(cache, tok)
+        return (cache, logits, new_lp, alive, hist, fin_lp, fin_hist), ()
+
+    (cache, logits, beam_lp, alive, hist, fin_lp, fin_hist), _ = lax.scan(
+        step,
+        (cache, logits, beam_lp, alive0, hist0, fin_lp0, fin_hist0),
+        jnp.arange(1, T),
+    )
+    best = jnp.argmax(beam_lp, axis=-1)                    # [b]
+    best_lp = jnp.take_along_axis(beam_lp, best[:, None], axis=1)[:, 0]
+    out = jnp.take_along_axis(hist, best[:, None, None], axis=1)[:, 0]
+    # The pool wins when a banked finished hypothesis outscores every
+    # surviving beam.
+    use_fin = fin_lp > best_lp
+    out = jnp.where(use_fin[:, None], fin_hist, out)
+    if eos_id is not None:
+        # Everything after the first eos is eos (banked pool histories
+        # carry zeros there; in-set frozen beams already emit eos).
+        seen = jnp.cumsum((out == eos_id).astype(jnp.int32), axis=1) > 0
+        prev = jnp.concatenate(
+            [jnp.zeros((b, 1), bool), seen[:, :-1]], axis=1
+        )
+        out = jnp.where(prev, eos_id, out)
+    return out, jnp.where(use_fin, fin_lp, best_lp)
 
 
 def mpmd_params_for_generation(
@@ -419,6 +558,7 @@ def spmd_params_for_generation(
 
 __all__ = [
     "KVCache",
+    "beam_search",
     "init_cache",
     "prefill",
     "generate",
