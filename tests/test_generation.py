@@ -412,3 +412,45 @@ def test_beam_finished_pool_never_loses_completed_hypothesis():
     # the optimum.
     np.testing.assert_allclose(lp, got, rtol=1e-4, atol=1e-4)
     assert lp <= best + 1e-4
+
+
+@pytest.mark.parametrize("window,s,new", [(3, 5, 6), (4, 2, 5), (8, 6, 4)])
+def test_ring_cache_equals_full_cache(window, s, new):
+    """cache_mode='ring' (W-slot ring, O(window) memory/reads) must
+    reproduce the masked full-cache decode exactly — including prompts
+    shorter than the window and decode runs crossing the wrap-around."""
+    cfg = TransformerConfig(
+        vocab=32, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        attn_window=window,
+    )
+    b = 2
+    _, params, _ = _build(cfg, b, s)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s) * 3 + 1, cfg.vocab)
+    full = generate(cfg, params, tokens, max_new_tokens=new)
+    ringo = generate(
+        cfg, params, tokens, max_new_tokens=new, cache_mode="ring"
+    )
+    assert (np.asarray(full) == np.asarray(ringo)).all(), (full, ringo)
+
+
+def test_ring_cache_validation():
+    b, s = 1, 4
+    _, params, _ = _build(CFG, b, s)  # CFG has no attn_window
+    tokens = jnp.zeros((b, s), jnp.int32)
+    with pytest.raises(ValueError, match="attn_window"):
+        generate(CFG, params, tokens, max_new_tokens=2, cache_mode="ring")
+    with pytest.raises(ValueError, match="cache_mode"):
+        generate(CFG, params, tokens, max_new_tokens=2, cache_mode="rang")
+
+
+def test_ring_cache_is_window_sized():
+    from torchgpipe_tpu.models.generation import prefill
+
+    cfg = TransformerConfig(
+        vocab=32, dim=32, n_layers=2, n_heads=4, n_kv_heads=2, attn_window=4
+    )
+    b, s = 1, 6
+    _, params, _ = _build(cfg, b, s)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s), cfg.vocab)
+    _, cache = prefill(cfg, params, tokens, max_len=64, ring=True)
+    assert all(a.shape[1] == 4 for a in cache.k)  # W, not max_len

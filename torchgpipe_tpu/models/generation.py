@@ -29,9 +29,11 @@ Design, TPU-first:
 * **Sliding-window ready**: with ``cfg.attn_window`` the decode mask
   attends to at most ``window`` trailing positions — the same band the
   training path computes — so a Mistral-style model decodes with its
-  training-time locality.  (The cache itself stays ``max_len`` long:
-  a ring cache would save memory but costs a gather per step; at the
-  single-host sizes this module targets, the mask is the better trade.)
+  training-time locality.  ``cache_mode='ring'`` goes further: W-slot
+  ring caches (slot ``pos % W``) cut cache memory AND per-step
+  attention reads from O(max_len) to O(window), bit-equal to the
+  masked path (the in-band-by-construction property of the ring makes
+  ``p_j >= 0`` the only mask needed).
 
 Sampling: greedy (``temperature=0``) or temperature softmax sampling
 with optional top-k truncation, driven by an explicit ``jax.random``
@@ -131,14 +133,44 @@ def _attend_cached(
     return out.reshape(b, 1, nh * hd)
 
 
+def _attend_ring(
+    q: jnp.ndarray,          # [b, 1, nh, hd] — rope'd query for this step
+    ck: jnp.ndarray,         # [b, W, nkv, hd] ring cache (slot = pos % W)
+    cv: jnp.ndarray,
+    pos: jnp.ndarray,        # [] int32 — this token's position
+) -> jnp.ndarray:
+    """Windowed decode attention over a RING cache: slot ``j`` holds the
+    newest position ``<= pos`` congruent to ``j`` (mod W), which is
+    in-band by construction (``0 <= pos - p_j < W``) — so the only mask
+    is ``p_j >= 0`` (slots not yet written during the first W tokens).
+    O(W) reads instead of O(max_len)."""
+    b, _, nh, hd = q.shape
+    W = ck.shape[1]
+    nkv = ck.shape[2]
+    r = nh // nkv
+    qg = q[:, 0].reshape(b, nkv, r, hd)
+    scores = jnp.einsum(
+        "bgrd,bsgd->bgrs", qg.astype(jnp.float32), ck.astype(jnp.float32)
+    ) * (hd ** -0.5)
+    j = jnp.arange(W)
+    p_j = pos - jnp.mod(pos - j, W)
+    scores = jnp.where((p_j >= 0)[None, None, None, :], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrs,bsgd->bgrd", p, cv.astype(jnp.float32))
+    return out.reshape(b, 1, nh * hd)
+
+
 def _decode_step(
     cfg: TransformerConfig,
     block_params: List[Pytree],
     x: jnp.ndarray,              # [b, 1, dim] — embedded current token
     cache: KVCache,
     mlp_layer: Optional[Any] = None,
+    ring: bool = False,
 ) -> Tuple[jnp.ndarray, KVCache]:
-    """One token through all blocks, reading+extending the cache.
+    """One token through all blocks, reading+extending the cache
+    (``ring=True``: W-slot ring buffers, written at ``pos % W`` and read
+    by :func:`_attend_ring`).
 
     Mirrors ``transformer_block.apply`` exactly (same RMS/rope/GQA/SwiGLU
     math on the same param schema) minus the sp/tp collectives — decode
@@ -159,9 +191,14 @@ def _decode_step(
         v = (h @ p["wv"]).reshape(b, 1, nkv_loc, hd)
         q = _rope(q, cfg.rope_theta, pos)
         k = _rope(k, cfg.rope_theta, pos)
-        ck = lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, 1)
-        cv = lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, 1)
-        attn = _attend_cached(q, ck, cv, pos, cfg.attn_window)
+        slot = jnp.mod(pos, ck.shape[1]) if ring else pos
+        ck = lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), slot, 1)
+        cv = lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), slot, 1)
+        attn = (
+            _attend_ring(q, ck, cv, pos)
+            if ring
+            else _attend_cached(q, ck, cv, pos, cfg.attn_window)
+        )
         x = x + (attn.astype(x.dtype) @ p["wo"])
         h = _rms(x, p["ln2"], cfg.norm_eps)
         x = x + _mlp_out(cfg, p, h, mlp_layer)
@@ -278,17 +315,29 @@ def prefill(
     max_len: int,
     moe: Optional[Any] = None,
     use_flash: Optional[bool] = None,
+    ring: bool = False,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """ONE batched full-sequence pass over the prompt (MXU-friendly, no
     per-token loop): computes each block's K/V for all prompt positions,
     banks them in the cache, and returns (last-position logits
     [b, vocab], cache ready for decode at position s).  ``use_flash``
-    as in :func:`_attend_full` (auto: Pallas flash kernel on TPU)."""
+    as in :func:`_attend_full` (auto: Pallas flash kernel on TPU).
+
+    ``ring=True`` (requires ``cfg.attn_window``): the cache is a
+    ``[b, attn_window, ...]`` RING per block — only the last ``W``
+    prompt positions' K/V are banked (slot ``p % W``), everything a
+    windowed decode can ever attend to."""
     embed_p, block_p, head_p = _split_params(cfg, params)
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds max_len {max_len}")
-    cache = init_cache(cfg, b, max_len)
+    if ring and cfg.attn_window is None:
+        raise ValueError(
+            "ring caches hold exactly the attention window: set "
+            "cfg.attn_window to use ring=True"
+        )
+    W = cfg.attn_window if ring else None
+    cache = init_cache(cfg, b, W if ring else max_len)
     hd = cfg.head_dim
     mlp_layer = _mlp_layer_for(cfg, moe)
     x = jnp.take(embed_p["table"], tokens, axis=0)
@@ -306,12 +355,22 @@ def prefill(
         x = x + (attn.astype(x.dtype) @ p["wo"])
         h = _rms(x, p["ln2"], cfg.norm_eps)
         x = x + _mlp_out(cfg, p, h, mlp_layer)
-        new_k.append(
-            lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), 0, 1)
-        )
-        new_v.append(
-            lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), 0, 1)
-        )
+        if ring:
+            # Slot j gets the newest prompt position congruent to j
+            # (mod W); never-written slots (s < W) gather garbage that
+            # _attend_ring masks by p_j >= 0.
+            jslots = jnp.arange(W)
+            p_j = (s - 1) - jnp.mod((s - 1) - jslots, W)
+            idx = jnp.clip(p_j, 0, s - 1)
+            new_k.append(jnp.take(k, idx, axis=1).astype(ck.dtype))
+            new_v.append(jnp.take(v, idx, axis=1).astype(cv.dtype))
+        else:
+            new_k.append(
+                lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), 0, 1)
+            )
+            new_v.append(
+                lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), 0, 1)
+            )
     cache = KVCache(k=new_k, v=new_v, length=jnp.asarray(s, jnp.int32))
     return _logits(cfg, head_p, x)[:, -1], cache
 
@@ -328,6 +387,7 @@ def generate(
     rng: Optional[jnp.ndarray] = None,
     max_len: Optional[int] = None,
     moe: Optional[Any] = None,
+    cache_mode: str = "full",
 ) -> jnp.ndarray:
     """Autoregressive decode: returns ``[b, max_new_tokens]`` completions.
 
@@ -335,9 +395,25 @@ def generate(
     ``rng`` for temperature/top-k sampling.  With ``eos_id`` set, rows
     that have emitted it keep emitting ``eos_id`` (frozen — static
     shapes; trim host-side).  Everything compiles to ONE program:
-    prefill scan + decode scan."""
+    prefill scan + decode scan.
+
+    ``cache_mode='ring'`` (requires ``cfg.attn_window``): W-slot ring
+    caches instead of ``[.., total, ..]`` buffers — O(window) cache
+    memory and attention reads per step, bit-equal outputs to the
+    masked full-cache path (tested); the HBM-bandwidth win for long
+    windowed decode."""
     b, s = prompt.shape
     total = _total_len(s, max_new_tokens, max_len)
+    if cache_mode not in ("full", "ring"):
+        raise ValueError(
+            f"cache_mode must be 'full' or 'ring', got {cache_mode!r}"
+        )
+    ring = cache_mode == "ring"
+    if ring and cfg.attn_window is None:
+        raise ValueError(
+            "cache_mode='ring' holds exactly the attention window: set "
+            "cfg.attn_window"
+        )
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs rng=jax.random.PRNGKey")
     if temperature == 0.0:
@@ -345,7 +421,7 @@ def generate(
 
     embed_p, block_p, head_p = _split_params(cfg, params)
     mlp_layer = _mlp_layer_for(cfg, moe)
-    logits0, cache = prefill(cfg, params, prompt, total, moe=moe)
+    logits0, cache = prefill(cfg, params, prompt, total, moe=moe, ring=ring)
 
     def step(carry, _):
         cache, logits, key, alive = carry
@@ -355,7 +431,7 @@ def generate(
             tok = jnp.where(alive, tok, eos_id)
             alive = alive & (tok != eos_id)
         x = jnp.take(embed_p["table"], tok[:, None], axis=0)
-        x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer)
+        x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer, ring)
         return (cache, _logits(cfg, head_p, x)[:, 0], key, alive), tok
 
     alive0 = jnp.ones((b,), bool)
