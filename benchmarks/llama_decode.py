@@ -44,20 +44,29 @@ def main() -> None:
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding-window attention width")
+    ap.add_argument("--ring", action="store_true",
+                    help="ring KV caches (needs --window): O(window) "
+                         "cache memory and per-step reads")
     args = ap.parse_args()
 
     dim, n_layers, nh, nkv, vocab = PRESETS[args.preset]
     cfg = TransformerConfig(
         vocab=vocab, dim=dim, n_layers=n_layers, n_heads=nh, n_kv_heads=nkv,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        attn_window=args.window,
     )
     b, s, new = args.batch, args.prompt_len, args.new_tokens
     spec = jax.ShapeDtypeStruct((b, s), jnp.int32)
     params, _, _ = sequential_init(llama(cfg), jax.random.PRNGKey(0), spec)
     prompt = jnp.mod(jnp.arange(b * s).reshape(b, s), vocab).astype(jnp.int32)
 
+    mode = "ring" if args.ring else "full"
     run = jax.jit(
-        lambda p, t: generate(cfg, p, t, max_new_tokens=new)
+        lambda p, t: generate(
+            cfg, p, t, max_new_tokens=new, cache_mode=mode
+        )
     )
     jax.block_until_ready(run(params, prompt))  # compile
     best = float("inf")
@@ -66,8 +75,10 @@ def main() -> None:
         jax.block_until_ready(run(params, prompt))
         best = min(best, time.perf_counter() - t0)
     toks = b * new
+    wtag = (f", window {args.window} ({mode} cache)"
+            if args.window else "")
     print(
-        f"{args.preset}: batch {b}, prompt {s}, {new} new tokens -> "
+        f"{args.preset}{wtag}: batch {b}, prompt {s}, {new} new tokens -> "
         f"{toks / best:.1f} tokens/sec "
         f"({best * 1e3 / new:.2f} ms/token/batch, "
         f"platform {jax.devices()[0].platform})",
