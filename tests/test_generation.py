@@ -454,3 +454,23 @@ def test_ring_cache_is_window_sized():
     tokens = jnp.mod(jnp.arange(b * s).reshape(b, s), cfg.vocab)
     _, cache = prefill(cfg, params, tokens, max_len=64, ring=True)
     assert all(a.shape[1] == 4 for a in cache.k)  # W, not max_len
+
+
+def test_generate_under_data_parallel_sharding(cpu_devices):
+    """generate() is jit-shardable over the batch: a prompt sharded over
+    a dp mesh axis decodes to the same tokens as the replicated run (XLA
+    partitions the whole prefill+decode program batch-wise)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    b, s, new = 4, 5, 4
+    _, params, _ = _build(CFG, b, s)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s) * 3 + 1, CFG.vocab)
+    ref = np.asarray(generate(CFG, params, tokens, max_new_tokens=new))
+
+    mesh = Mesh(np.array(cpu_devices[:4]), ("dp",))
+    sharded = jax.device_put(tokens, NamedSharding(mesh, P("dp")))
+    params_r = jax.device_put(params, NamedSharding(mesh, P()))
+    out = jax.jit(
+        lambda p, t: generate(CFG, p, t, max_new_tokens=new)
+    )(params_r, sharded)
+    assert (np.asarray(out) == ref).all()
