@@ -8,9 +8,11 @@ transformer/Llama family for the SPMD flagship path.
 from torchgpipe_tpu.models.amoebanet import amoebanetd  # noqa: F401
 from torchgpipe_tpu.models.generation import (  # noqa: F401
     KVCache,
+    QuantKVCache,
     beam_search,
     generate,
     init_cache,
+    init_quant_cache,
     mpmd_params_for_generation,
     prefill,
     spmd_params_for_generation,
