@@ -516,3 +516,34 @@ def test_kv_quant_logits_close_and_trained_decode_exact(mode):
     np.testing.assert_allclose(np.asarray(lq), np.asarray(lf), rtol=1e-5)
     assert all(a.dtype == jnp.int8 for a in qc.k)
     assert all(a.dtype == jnp.int8 for a in qc.v)
+
+
+@pytest.mark.parametrize("mode,quant", [
+    ("full", False), ("ring", False), ("full", True),
+])
+def test_two_turn_continuation_equals_one_shot(mode, quant):
+    """Chat-style continuation: generate(return_state=True) then a second
+    call with cache= and the next turn's tokens must produce exactly what
+    a one-shot run over the concatenated history produces."""
+    cfg = TransformerConfig(
+        vocab=32, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        attn_window=4 if mode == "ring" else None,
+    )
+    b, s1, t1, s2, t2 = 2, 4, 3, 3, 4
+    _, params, _ = _build(cfg, b, s1)
+    p1 = jnp.mod(jnp.arange(b * s1).reshape(b, s1) * 3 + 1, cfg.vocab)
+    p2 = jnp.mod(jnp.arange(b * s2).reshape(b, s2) * 7 + 2, cfg.vocab)
+    kw = dict(cache_mode=mode, kv_quant=quant)
+
+    out1, state = generate(
+        cfg, params, p1, max_new_tokens=t1, return_state=True,
+        max_len=s1 + t1 + s2 + t2, **kw,
+    )
+    out2 = generate(cfg, params, p2, max_new_tokens=t2, cache=state, **kw)
+
+    history = jnp.concatenate([p1, out1, p2], axis=1)
+    ref = generate(
+        cfg, params, history, max_new_tokens=t2,
+        max_len=s1 + t1 + s2 + t2, **kw,
+    )
+    assert (np.asarray(out2) == np.asarray(ref)).all(), (out2, ref)

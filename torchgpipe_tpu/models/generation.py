@@ -498,7 +498,9 @@ def generate(
     moe: Optional[Any] = None,
     cache_mode: str = "full",
     kv_quant: bool = False,
-) -> jnp.ndarray:
+    cache: Optional[Any] = None,
+    return_state: bool = False,
+) -> Any:
     """Autoregressive decode: returns ``[b, max_new_tokens]`` completions.
 
     ``temperature=0`` is greedy argmax (no rng needed); otherwise pass
@@ -518,7 +520,16 @@ def generate(
     cache footprint/traffic of bf16 (a quarter of f32).  Lossy but
     tight (head_dim-wise scales); logits stay close to the fp path and
     greedy decode on well-separated models is unchanged (tested).
-    Composes with both cache modes."""
+    Composes with both cache modes.
+
+    Multi-turn use: ``return_state=True`` returns ``(tokens, cache)``;
+    pass that cache (plus the next turn's tokens as ``prompt``) back in
+    via ``cache=`` to continue the conversation — the new prompt is
+    absorbed through the decode path (teacher-forced), so every cache
+    mode composes.  Two-turn decode equals the one-shot run on the
+    concatenated prompt (tested).  With ``cache_mode='full'`` the FIRST
+    call's ``max_len`` must budget all future turns (fixed buffers;
+    ring caches wrap and never run out)."""
     b, s = prompt.shape
     total = _total_len(s, max_new_tokens, max_len)
     if cache_mode not in ("full", "ring"):
@@ -538,9 +549,21 @@ def generate(
 
     embed_p, block_p, head_p = _split_params(cfg, params)
     mlp_layer = _mlp_layer_for(cfg, moe)
-    logits0, cache = prefill(
-        cfg, params, prompt, total, moe=moe, ring=ring, kv_quant=kv_quant
-    )
+    if cache is None:
+        logits0, cache = prefill(
+            cfg, params, prompt, total, moe=moe, ring=ring,
+            kv_quant=kv_quant,
+        )
+    else:
+        # Continuation: absorb this turn's tokens through the decode
+        # path (teacher-forced) — exact for every cache layout.
+        def absorb(cache, tok):
+            x = jnp.take(embed_p["table"], tok[:, None], axis=0)
+            x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer, ring)
+            return cache, _logits(cfg, head_p, x)[:, 0]
+
+        cache, turn_logits = lax.scan(absorb, cache, prompt.T)
+        logits0 = turn_logits[-1]
 
     def step(carry, _):
         cache, logits, key, alive = carry
@@ -554,10 +577,11 @@ def generate(
         return (cache, _logits(cfg, head_p, x)[:, 0], key, alive), tok
 
     alive0 = jnp.ones((b,), bool)
-    _, toks = lax.scan(
+    (cache, logits, rng, alive), toks = lax.scan(
         step, (cache, logits0, rng, alive0), None, length=max_new_tokens
     )
-    return toks.T  # [b, max_new_tokens]
+    out = toks.T  # [b, max_new_tokens]
+    return (out, cache) if return_state else out
 
 
 def beam_search(
