@@ -49,6 +49,8 @@ def main() -> None:
     ap.add_argument("--ring", action="store_true",
                     help="ring KV caches (needs --window): O(window) "
                          "cache memory and per-step reads")
+    ap.add_argument("--kv-quant", action="store_true",
+                    help="int8 KV caches (half the bf16 footprint)")
     args = ap.parse_args()
 
     dim, n_layers, nh, nkv, vocab = PRESETS[args.preset]
@@ -65,7 +67,8 @@ def main() -> None:
     mode = "ring" if args.ring else "full"
     run = jax.jit(
         lambda p, t: generate(
-            cfg, p, t, max_new_tokens=new, cache_mode=mode
+            cfg, p, t, max_new_tokens=new, cache_mode=mode,
+            kv_quant=args.kv_quant,
         )
     )
     jax.block_until_ready(run(params, prompt))  # compile
@@ -77,6 +80,7 @@ def main() -> None:
     toks = b * new
     wtag = (f", window {args.window} ({mode} cache)"
             if args.window else "")
+    wtag += ", int8-kv" if args.kv_quant else ""
     print(
         f"{args.preset}{wtag}: batch {b}, prompt {s}, {new} new tokens -> "
         f"{toks / best:.1f} tokens/sec "
