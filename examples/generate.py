@@ -53,9 +53,25 @@ def main() -> None:
     out = generate(cfg, flat, prompt, max_new_tokens=5)
     expect = jnp.mod(prompt[:, -1:] + jnp.arange(1, 6)[None, :], 32)
     acc = float(jnp.mean((out == expect).astype(jnp.float32)))
-    print(f"[generate] continuation {out[0].tolist()} "
+    print(f"[generate] greedy {out[0].tolist()} "
           f"(expected {expect[0].tolist()}), accuracy {acc:.2f}")
     assert acc > 0.9, acc
+
+    # Beam search scores the same completion (deterministic data).
+    from torchgpipe_tpu.models import beam_search
+
+    beams, lp = beam_search(cfg, flat, prompt, 5, num_beams=3)
+    print(f"[generate] beam-3 {beams[0].tolist()} "
+          f"(log-prob {float(lp[0]):.3f})")
+    assert (beams == out).all()
+
+    # Multi-turn continuation: keep the cache, feed the next chunk.
+    out1, state = generate(
+        cfg, flat, prompt, max_new_tokens=3, return_state=True, max_len=24
+    )
+    out2 = generate(cfg, flat, out1[:, -1:] * 0 + expect[:, 3:4],
+                    max_new_tokens=3, cache=state)
+    print(f"[generate] turn-2 continuation {out2[0].tolist()}")
     print("generate demo complete")
 
 
