@@ -63,6 +63,10 @@ def test_memory_bounds(n, m):
     assert tb.resid_slots <= 2 * pow2, (n, m, tb.resid_slots)
     assert tb.dy_slots == 1, (n, m, tb.dy_slots)
     assert tb.slots <= pow2, (n, m, tb.slots)
+    # Round 4: the recompute variant's banked INPUTS (live F -> B) stay
+    # within the 1F1B window too — the O(1)-residual-memory claim of
+    # checkpoint='always' rests on this plus dy_slots == 1.
+    assert tb.x_slots <= 2 * pow2, (n, m, tb.x_slots)
 
 
 def test_w_fills_drain_ticks():
