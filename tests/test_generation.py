@@ -588,3 +588,29 @@ def test_train_save_load_generate_roundtrip(tmp_path):
     after = generate(CFG, mpmd_params_for_generation(model2, params2),
                      prompt, max_new_tokens=4)
     assert (np.asarray(before) == np.asarray(after)).all()
+
+
+def test_moe_dropless_generate_teacher_forced():
+    """Dropless dispatch (no capacity concept — the per-call pool caveat
+    vanishes) decodes teacher-forced equal to the full forward."""
+    from torchgpipe_tpu.models.moe import MoEConfig, llama_moe
+
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+    )
+    moe = MoEConfig(n_experts=4, top_k=2, dispatch="dropless")
+    layers = llama_moe(cfg, moe)
+    b, s, new = 2, 5, 3
+    spec = jax.ShapeDtypeStruct((b, s), jnp.int32)
+    params, states, _ = sequential_init(layers, jax.random.PRNGKey(0), spec)
+    tokens = jnp.mod(9 * jnp.arange(b * s).reshape(b, s) + 4, cfg.vocab)
+
+    out = generate(cfg, params, tokens, max_new_tokens=new, moe=moe)
+    seq = np.asarray(tokens)
+    for t in range(new):
+        ref, _ = sequential_apply(
+            layers, params, states, jnp.asarray(seq), rng=None, train=False
+        )
+        expect = np.argmax(np.asarray(ref, np.float32)[:, -1], -1)
+        assert (np.asarray(out[:, t]) == expect).all(), (t,)
+        seq = np.concatenate([seq, expect[:, None].astype(np.int32)], axis=1)
