@@ -10,8 +10,9 @@ The zero-bubble claim in this repo has two layers:
   static model does not see.
 
 This driver times real ``SpmdGPipe.train_step`` steady-state steps for all
-three schedules at ``checkpoint='never'`` (the only mode zb supports, and
-the apples-to-apples work profile: no recompute anywhere) and prints them
+three schedules at ``checkpoint='never'`` (the zero-recompute zb mode —
+``checkpoint='always'`` exists too since round 4 — and the
+apples-to-apples work profile: no recompute anywhere) and prints them
 next to TWO predictions built from per-cell costs calibrated on one
 device:
 
