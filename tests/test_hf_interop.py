@@ -1,0 +1,108 @@
+"""HF Llama import: converted weights must reproduce the live HF model's
+logits and greedy decode — the numerical proof of every convention the
+importer claims (transposes, rotary layout, GQA pairing, RMSNorm math).
+
+transformers runs torch on CPU in this container; the models are tiny
+random-init (no network)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from torchgpipe_tpu.layers import sequential_apply  # noqa: E402
+from torchgpipe_tpu.models.generation import generate  # noqa: E402
+from torchgpipe_tpu.models.hf_interop import (  # noqa: E402
+    config_from_hf,
+    from_hf_llama,
+)
+from torchgpipe_tpu.models.transformer import llama  # noqa: E402
+
+
+def _hf_model(nkv=2):
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=nkv, rope_theta=10000.0, rms_norm_eps=1e-5,
+    )
+    torch.manual_seed(0)
+    m = transformers.LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.mark.parametrize("nkv", [2, 4])
+def test_logits_match_hf(nkv):
+    m = _hf_model(nkv)
+    cfg, params = from_hf_llama(m)
+    b, s = 2, 7
+    tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
+
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+
+    out, _ = sequential_apply(
+        llama(cfg), params, [() for _ in range(cfg.n_layers + 2)],
+        jnp.asarray(tokens, jnp.int32), rng=None, train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_greedy_decode_matches_hf():
+    m = _hf_model()
+    cfg, params = from_hf_llama(m)
+    b, s, new = 2, 5, 4
+    tokens = (np.arange(b * s).reshape(b, s) * 3 + 1) % cfg.vocab
+
+    ours = np.asarray(
+        generate(cfg, params, jnp.asarray(tokens, jnp.int32),
+                 max_new_tokens=new)
+    )
+    with torch.no_grad():
+        hf = m.generate(
+            torch.tensor(tokens), max_new_tokens=new, do_sample=False,
+        ).numpy()[:, s:]
+    assert (ours == hf).all(), (ours, hf)
+
+
+def test_converted_weights_pipeline_trainable():
+    """Imported weights splice into GPipe(llama(cfg)) and train."""
+    from torchgpipe_tpu.gpipe import GPipe
+    from torchgpipe_tpu.models.transformer import cross_entropy
+
+    m = _hf_model()
+    cfg, flat = from_hf_llama(m)
+    model = GPipe(llama(cfg), balance=[2, 2], chunks=2)
+    b, s = 2, 6
+    spec = jax.ShapeDtypeStruct((b, s), jnp.int32)
+    params, state = model.init(jax.random.PRNGKey(0), spec)
+    # Splice the imported per-layer params into the per-stage layout.
+    it = iter(flat)
+    params = tuple(tuple(next(it) for _ in stage) for stage in params)
+    x = jnp.asarray(np.arange(b * s).reshape(b, s) % cfg.vocab, jnp.int32)
+    loss, grads, state, _ = model.value_and_grad(
+        model.place(params), state, x, x, cross_entropy
+    )
+    assert np.isfinite(float(loss))
+
+
+def test_unsupported_layouts_rejected():
+    from torchgpipe_tpu.models.hf_interop import params_from_hf
+
+    m = _hf_model()
+    cfg = config_from_hf(m.config)
+    sd = {"model.layers.0.block_sparse_moe.experts.0.w1.weight": None}
+    with pytest.raises(ValueError, match="MoE"):
+        params_from_hf(sd, cfg)
+
+    bad = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=100,  # not 128-aligned
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+    )
+    with pytest.raises(ValueError, match="intermediate_size"):
+        config_from_hf(bad)

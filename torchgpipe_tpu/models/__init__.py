@@ -6,6 +6,11 @@ transformer/Llama family for the SPMD flagship path.
 """
 
 from torchgpipe_tpu.models.amoebanet import amoebanetd  # noqa: F401
+from torchgpipe_tpu.models.hf_interop import (  # noqa: F401
+    config_from_hf,
+    from_hf_llama,
+    params_from_hf,
+)
 from torchgpipe_tpu.models.generation import (  # noqa: F401
     KVCache,
     QuantKVCache,

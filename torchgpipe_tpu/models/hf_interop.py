@@ -1,0 +1,123 @@
+"""HuggingFace Llama checkpoint import.
+
+The practical on-ramp for "switch to this framework": weights trained or
+published in the HF ``LlamaForCausalLM`` layout load straight into the
+``models.transformer.llama`` schema — pipeline-train them with either
+engine or decode with :mod:`torchgpipe_tpu.models.generation`.  (The
+reference has no interop story at all; this is surplus capability.)
+
+Conventions verified against ``transformers`` (tested numerically in
+``tests/test_hf_interop.py`` — logits match a live HF model):
+
+* torch ``Linear`` stores ``[out, in]`` → every projection transposes;
+* HF ``rotate_half`` rotary == this repo's half-split ``_rope`` (same
+  frequency layout ``cat(freqs, freqs)``);
+* GQA query→kv pairing ``h // (nh/nkv)`` matches;
+* ``RMSNorm`` math (f32 accumulation, eps inside rsqrt) matches.
+
+Only f32/bf16 dense Llama-family checkpoints are covered (no fused/
+quantized HF layouts); MoE (Mixtral) layouts are rejected loudly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax.numpy as jnp
+
+from torchgpipe_tpu.models.transformer import TransformerConfig
+
+Pytree = Any
+
+
+def config_from_hf(hf_config: Any) -> TransformerConfig:
+    """A :class:`TransformerConfig` equivalent to an HF ``LlamaConfig``.
+
+    ``mlp_hidden`` is derived from ``mlp_ratio`` here, so the HF
+    ``intermediate_size`` must round-trip through the SwiGLU 2/3 formula
+    (every published Llama size does — they are multiples of 128); a
+    size that cannot be expressed raises instead of silently reshaping.
+    """
+    dim = hf_config.hidden_size
+    inter = hf_config.intermediate_size
+    ratio = 3.0 * inter / (2.0 * dim)
+    cfg = TransformerConfig(
+        vocab=hf_config.vocab_size,
+        dim=dim,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+        mlp_ratio=ratio,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(hf_config.rms_norm_eps),
+    )
+    if cfg.mlp_hidden != inter:
+        raise ValueError(
+            f"intermediate_size={inter} cannot be expressed by this "
+            f"config's 128-aligned SwiGLU formula (got {cfg.mlp_hidden}); "
+            "published Llama sizes are 128-aligned — is this a custom "
+            "checkpoint?"
+        )
+    return cfg
+
+
+def _t(w: Any) -> jnp.ndarray:
+    """torch [out, in] -> jnp [in, out]."""
+    import numpy as np
+
+    arr = w.detach().cpu().numpy() if hasattr(w, "detach") else np.asarray(w)
+    return jnp.asarray(arr).T
+
+
+def _v(w: Any) -> jnp.ndarray:
+    import numpy as np
+
+    arr = w.detach().cpu().numpy() if hasattr(w, "detach") else np.asarray(w)
+    return jnp.asarray(arr)
+
+
+def params_from_hf(
+    state_dict: Dict[str, Any], cfg: TransformerConfig
+) -> List[Pytree]:
+    """Per-layer params in ``llama(cfg)`` order (embed, blocks, head) from
+    an HF ``LlamaForCausalLM`` state dict."""
+    if any(".block_sparse_moe." in k or ".experts." in k for k in state_dict):
+        raise ValueError(
+            "MoE (Mixtral-style) HF layouts are not supported; this "
+            "importer covers the dense Llama family"
+        )
+    sd = state_dict
+    out: List[Pytree] = [{"table": _v(sd["model.embed_tokens.weight"])}]
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        out.append({
+            "ln1": _v(sd[p + "input_layernorm.weight"]),
+            "wq": _t(sd[p + "self_attn.q_proj.weight"]),
+            "wk": _t(sd[p + "self_attn.k_proj.weight"]),
+            "wv": _t(sd[p + "self_attn.v_proj.weight"]),
+            "wo": _t(sd[p + "self_attn.o_proj.weight"]),
+            "ln2": _v(sd[p + "post_attention_layernorm.weight"]),
+            "w_gate": _t(sd[p + "mlp.gate_proj.weight"]),
+            "w_up": _t(sd[p + "mlp.up_proj.weight"]),
+            "w_down": _t(sd[p + "mlp.down_proj.weight"]),
+        })
+    head_w = (
+        sd["lm_head.weight"]
+        if "lm_head.weight" in sd
+        else sd["model.embed_tokens.weight"]  # tied embeddings
+    )
+    out.append({
+        "scale": _v(sd["model.norm.weight"]),
+        "w": _t(head_w),
+    })
+    return out
+
+
+def from_hf_llama(model: Any) -> tuple:
+    """(cfg, per-layer params) from a live HF ``LlamaForCausalLM`` — ready
+    for ``GPipe(llama(cfg))`` init-splicing or ``generation.generate``."""
+    cfg = config_from_hf(model.config)
+    return cfg, params_from_hf(model.state_dict(), cfg)
+
+
+__all__ = ["config_from_hf", "params_from_hf", "from_hf_llama"]
