@@ -106,3 +106,22 @@ def test_unsupported_layouts_rejected():
     )
     with pytest.raises(ValueError, match="intermediate_size"):
         config_from_hf(bad)
+
+
+def test_roundtrip_to_hf():
+    """from_hf -> to_hf loads back into a live HF model bit-compatibly
+    (logits unchanged)."""
+    from torchgpipe_tpu.models.hf_interop import state_dict_to_hf
+
+    m = _hf_model()
+    cfg, params = from_hf_llama(m)
+    sd = state_dict_to_hf(params, cfg)
+    m2 = _hf_model()
+    missing, unexpected = m2.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    b, s = 2, 6
+    tokens = torch.tensor(np.arange(b * s).reshape(b, s) % cfg.vocab)
+    with torch.no_grad():
+        ref = m(tokens).logits.numpy()
+        got = m2(tokens).logits.numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

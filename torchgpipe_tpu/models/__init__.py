@@ -10,6 +10,7 @@ from torchgpipe_tpu.models.hf_interop import (  # noqa: F401
     config_from_hf,
     from_hf_llama,
     params_from_hf,
+    state_dict_to_hf,
 )
 from torchgpipe_tpu.models.generation import (  # noqa: F401
     KVCache,

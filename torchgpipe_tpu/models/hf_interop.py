@@ -120,4 +120,49 @@ def from_hf_llama(model: Any) -> tuple:
     return cfg, params_from_hf(model.state_dict(), cfg)
 
 
-__all__ = ["config_from_hf", "params_from_hf", "from_hf_llama"]
+def state_dict_to_hf(
+    params: List[Pytree], cfg: TransformerConfig
+) -> Dict[str, Any]:
+    """The inverse map: ``llama(cfg)`` per-layer params -> an HF
+    ``LlamaForCausalLM`` state dict (torch tensors) — train here,
+    publish to the HF ecosystem.  Exact inverse of
+    :func:`params_from_hf` (round-trip tested)."""
+    import numpy as np
+    import torch
+
+    def t(a: jnp.ndarray) -> Any:  # jnp [in, out] -> torch [out, in]
+        return torch.from_numpy(np.asarray(a, np.float32).T.copy())
+
+    def v(a: jnp.ndarray) -> Any:
+        return torch.from_numpy(np.asarray(a, np.float32).copy())
+
+    embed, blocks, head = params[0], params[1:-1], params[-1]
+    if len(blocks) != cfg.n_layers:
+        raise ValueError(
+            f"expected {cfg.n_layers} block params, got {len(blocks)}"
+        )
+    sd: Dict[str, Any] = {
+        "model.embed_tokens.weight": v(embed["table"]),
+        "model.norm.weight": v(head["scale"]),
+        "lm_head.weight": t(head["w"]),
+    }
+    for i, bp in enumerate(blocks):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = v(bp["ln1"])
+        sd[p + "self_attn.q_proj.weight"] = t(bp["wq"])
+        sd[p + "self_attn.k_proj.weight"] = t(bp["wk"])
+        sd[p + "self_attn.v_proj.weight"] = t(bp["wv"])
+        sd[p + "self_attn.o_proj.weight"] = t(bp["wo"])
+        sd[p + "post_attention_layernorm.weight"] = v(bp["ln2"])
+        sd[p + "mlp.gate_proj.weight"] = t(bp["w_gate"])
+        sd[p + "mlp.up_proj.weight"] = t(bp["w_up"])
+        sd[p + "mlp.down_proj.weight"] = t(bp["w_down"])
+    return sd
+
+
+__all__ = [
+    "config_from_hf",
+    "params_from_hf",
+    "from_hf_llama",
+    "state_dict_to_hf",
+]
