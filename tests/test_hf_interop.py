@@ -125,3 +125,31 @@ def test_roundtrip_to_hf():
         ref = m(tokens).logits.numpy()
         got = m2(tokens).logits.numpy()
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_to_hf_preserves_dtype():
+    """A bf16 checkpoint exports back as bf16 torch tensors with exactly
+    the original values — not silently widened to f32 (doubling the
+    published state dict)."""
+    from torchgpipe_tpu.models.hf_interop import state_dict_to_hf
+
+    m = _hf_model()
+    cfg, params = from_hf_llama(m)
+    bf16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating)
+        else a,
+        params,
+    )
+    sd = state_dict_to_hf(bf16, cfg)
+    assert all(t.dtype == torch.bfloat16 for t in sd.values()), {
+        k: t.dtype for k, t in sd.items() if t.dtype != torch.bfloat16
+    }
+    # Value-exact: the f32 numpy bridge is lossless for bf16.
+    sd32 = state_dict_to_hf(params, cfg)
+    for k, t in sd.items():
+        np.testing.assert_array_equal(
+            t.to(torch.float32).numpy(),
+            sd32[k].numpy().astype(jnp.bfloat16).astype(np.float32),
+            err_msg=k,
+        )

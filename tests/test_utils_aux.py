@@ -411,6 +411,98 @@ def test_simulate_pipeline_1f1b_uniform_cells():
     assert 0.0 < busy <= 1.0 and abs(busy + bubble - 1.0) < 1e-9
 
 
+def test_recommend_schedule_ranks_uniform_cells():
+    """Uniform cells: same-device rows come first sorted by makespan with
+    1f1b/zb beating the phase-barriered fill-drain; interleaved rows are
+    ranked apart and labeled with their reduced device count."""
+    from torchgpipe_tpu.utils.tracing import TimelineEvent, recommend_schedule
+
+    n, m, t = 4, 8, 1.0
+    events = []
+    for j in range(n):
+        for i in range(m):
+            events.append(TimelineEvent("fwd", j, i, 0.0, t))
+            events.append(TimelineEvent("bwd", j, i, 0.0, t))
+    rows = recommend_schedule(events, n, virtual_stages=(2, 3))
+    same = [r for r in rows if r.devices == n]
+    assert [r.schedule for r in same[:1]][0] in ("1f1b", "zb")
+    assert {r.schedule for r in same} == {"fill_drain", "1f1b", "zb"}
+    # Ranked: monotone makespans within the same-device block, and the
+    # block precedes every interleaved row.
+    assert all(
+        a.makespan <= b.makespan for a, b in zip(same, same[1:])
+    )
+    fd = next(r for r in same if r.schedule == "fill_drain")
+    assert same[0].makespan <= fd.makespan
+    inter = [r for r in rows if r.schedule == "interleaved"]
+    # v=3 does not divide n=4 — only the v=2 projection appears.
+    assert [r.virtual_stages for r in inter] == [2]
+    assert inter[0].devices == n // 2
+    assert rows.index(inter[0]) > rows.index(same[-1])
+    assert "devices" in inter[0].note
+    for r in rows:
+        assert 0.0 < r.busy <= 1.0 and abs(r.busy + r.bubble - 1.0) < 1e-9
+
+
+def test_recommend_schedule_forward_only_timeline():
+    """Without bwd events the 1f1b/zb/interleaved projections are
+    undefined and must be omitted rather than ranked at a fake
+    zero-backward makespan.  n=4 so the v=2 interleaved config would
+    otherwise be applicable — the omission is the phase check, not a
+    divisibility accident."""
+    from torchgpipe_tpu.utils.tracing import TimelineEvent, recommend_schedule
+
+    n, m = 4, 8
+    events = [
+        TimelineEvent("fwd", j, i, 0.0, 0.5)
+        for j in range(n)
+        for i in range(m)
+    ]
+    rows = recommend_schedule(events, n, virtual_stages=(2,))
+    assert [r.schedule for r in rows] == ["fill_drain"]
+
+
+def test_recommend_schedule_skips_inapplicable_interleaved():
+    """An interleaved projection whose micro-batch count the measurement
+    cannot support (m=7 not divisible by n//v=2 devices) is skipped, not
+    allowed to abort the same-device ranking."""
+    from torchgpipe_tpu.utils.tracing import TimelineEvent, recommend_schedule
+
+    n, m = 4, 7
+    events = []
+    for j in range(n):
+        for i in range(m):
+            events.append(TimelineEvent("fwd", j, i, 0.0, 1.0))
+            events.append(TimelineEvent("bwd", j, i, 0.0, 1.0))
+    rows = recommend_schedule(events, n, virtual_stages=(2,))
+    assert {r.schedule for r in rows} == {"fill_drain", "1f1b", "zb"}
+
+
+def test_recommend_schedule_ignores_non_cell_phases():
+    """'loss' events (recorded by the engine on the last stage) must not
+    skew the ranking: only fill-drain's simulate_pipeline path counts
+    them, so a fair comparison drops them — makespans match the
+    loss-free timeline and busy stays a valid fraction."""
+    from torchgpipe_tpu.utils.tracing import TimelineEvent, recommend_schedule
+
+    n, m, t = 4, 8, 1.0
+    cells = []
+    for j in range(n):
+        for i in range(m):
+            cells.append(TimelineEvent("fwd", j, i, 0.0, t))
+            cells.append(TimelineEvent("bwd", j, i, 0.0, t))
+    noisy = cells + [
+        TimelineEvent("loss", n - 1, i, 0.0, 10 * t) for i in range(m)
+    ]
+    clean_rows = recommend_schedule(cells, n)
+    noisy_rows = recommend_schedule(noisy, n)
+    assert [(r.schedule, r.makespan) for r in noisy_rows] == [
+        (r.schedule, r.makespan) for r in clean_rows
+    ]
+    for r in noisy_rows:
+        assert 0.0 < r.busy <= 1.0 and abs(r.busy + r.bubble - 1.0) < 1e-9
+
+
 def test_simulate_pipeline_rejects_unknown_schedule():
     from torchgpipe_tpu.utils.tracing import TimelineEvent
 

@@ -130,11 +130,25 @@ def state_dict_to_hf(
     import numpy as np
     import torch
 
+    def cast(a: jnp.ndarray) -> Any:
+        # Dtype-faithful: numpy-native dtypes (f16/f32/f64) convert
+        # directly; only bfloat16 — which numpy lacks — bridges through
+        # f32 (lossless: every bf16 value is exactly representable) and
+        # is cast back on the torch side.  The export is the same width
+        # and values as the import, never silently widened to f32.
+        if jnp.dtype(a.dtype).name == "bfloat16":
+            return torch.from_numpy(np.asarray(a, np.float32)).to(
+                torch.bfloat16
+            )
+        # .copy(): np.asarray of a jax array can be a read-only view;
+        # torch.from_numpy shares memory and warns on non-writable input.
+        return torch.from_numpy(np.asarray(a).copy())
+
     def t(a: jnp.ndarray) -> Any:  # jnp [in, out] -> torch [out, in]
-        return torch.from_numpy(np.asarray(a, np.float32).T.copy())
+        return cast(a.T)
 
     def v(a: jnp.ndarray) -> Any:
-        return torch.from_numpy(np.asarray(a, np.float32).copy())
+        return cast(a)
 
     embed, blocks, head = params[0], params[1:-1], params[-1]
     if len(blocks) != cfg.n_layers:

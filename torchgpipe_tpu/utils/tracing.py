@@ -252,6 +252,110 @@ def simulate_pipeline(
     return makespan, busy, 1.0 - busy
 
 
+@dataclasses.dataclass
+class ScheduleProjection:
+    """One row of :func:`recommend_schedule`'s ranking."""
+
+    schedule: str  # 'fill_drain' | '1f1b' | 'zb' | 'interleaved'
+    devices: int  # device count the projection assumes
+    virtual_stages: int  # 1 except for 'interleaved'
+    makespan: float
+    busy: float
+    bubble: float
+    note: str  # memory character / projection caveat
+
+
+def recommend_schedule(
+    events: List[TimelineEvent],
+    n_stages: int,
+    virtual_stages: Tuple[int, ...] = (2,),
+) -> List[ScheduleProjection]:
+    """Rank the engine's schedules on one measured timeline.
+
+    The reference auto-tunes *balance* from a profile
+    (``torchgpipe/balance/__init__.py:38-80``) but offers a
+    single schedule; this framework has four, and the right one depends on
+    the measured cell times — so the schedule choice gets the same
+    profile-then-decide treatment.  Feed the ``sync=True`` timeline of one
+    training step (true per-cell device durations) and every applicable
+    schedule is projected through :func:`simulate_pipeline`:
+
+    * rows with ``devices == n_stages`` come first, sorted by projected
+      makespan — ``rows[0]`` is the recommendation at the measured device
+      count;
+    * ``'interleaved'`` rows (one per ``v`` in ``virtual_stages`` that
+      divides ``n_stages``) follow, also makespan-sorted: they answer
+      "what if these measured stages were the global blocks of a
+      virtual-stage layout on ``n_stages // v`` devices?" — fewer chips,
+      not a same-budget alternative, hence ranked apart;
+    * schedules whose projection needs phases the timeline lacks (no
+      ``bwd`` events → no 1f1b/zb/interleaved projection: their op
+      tables interleave backward cells) and interleaved configs the
+      measurement cannot support (micro-batch count not divisible by the
+      projected device count) are silently omitted.
+
+    Each row's ``note`` carries the schedule's memory character and any
+    projection caveat (zb's 50/50 B/W split model), so the ranking is
+    never quoted without its assumptions.
+
+    Only ``fwd``/``bwd`` cells enter the comparison: the 1f1b/zb/
+    interleaved op tables schedule exactly those phases, so extra phases
+    (e.g. ``loss``) would inflate only fill-drain's makespan — and the
+    busy denominators — unevenly.  The rows rank schedule quality on the
+    common cell set; quote absolute makespans from
+    :func:`simulate_pipeline` if other phases matter.
+    """
+    events = [ev for ev in events if ev.name in ("fwd", "bwd")]
+    rows: List[ScheduleProjection] = []
+    same_device = (
+        ("fill_drain", "peak in-flight activations grow with chunks m per "
+                       "stage; all checkpoint modes"),
+        ("1f1b", "peak in-flight <= min(m, n-j) per stage (flat in m); all "
+                 "checkpoint modes"),
+        ("zb", "split backward fills drain bubbles; projection models B/W "
+               "as a 50/50 split of the measured fused backward; engine "
+               "modes 'never' (stored residuals) or 'always' "
+               "(recompute-in-B)"),
+    )
+    has_bwd = any(ev.name == "bwd" for ev in events)
+    for sched, note in same_device:
+        if sched in ("1f1b", "zb") and not has_bwd:
+            # Their op orders interleave bwd cells; with no measured bwd
+            # the projection would rank a fake (zero-backward) makespan.
+            continue
+        res = simulate_pipeline(events, n_stages, schedule=sched)
+        if res is not None:
+            rows.append(
+                ScheduleProjection(sched, n_stages, 1, *res, note=note)
+            )
+    rows.sort(key=lambda r: r.makespan)
+    inter: List[ScheduleProjection] = []
+    for v in virtual_stages:
+        if v < 2 or n_stages % v != 0 or n_stages // v < 2 or not has_bwd:
+            continue
+        try:
+            res = simulate_pipeline(
+                events, n_stages, schedule="interleaved", virtual_stages=v
+            )
+        except ValueError:
+            # e.g. the measured micro-batch count not divisible by the
+            # projected device count — inapplicable, same as a v that
+            # doesn't divide n_stages.
+            continue
+        if res is not None:
+            inter.append(
+                ScheduleProjection(
+                    "interleaved", n_stages // v, v, *res,
+                    note=f"measured stages laid out as {n_stages} global "
+                         f"blocks on {n_stages // v} devices (v={v}) — a "
+                         "fewer-chips projection, not a same-budget "
+                         "alternative",
+                )
+            )
+    inter.sort(key=lambda r: r.makespan)
+    return rows + inter
+
+
 def _list_schedule(
     orders: Any,
     dep_fn: Callable,
