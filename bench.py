@@ -87,12 +87,33 @@ def _analytic_step_flops(model, params, state, x, y, loss_fn, rng):
 
         return jax.value_and_grad(loss_of)(fp)
 
-    try:
-        cost = jax.jit(step).lower(flat_p, x, y).cost_analysis()
+    def flops_of(lowered) -> float | None:
+        cost = lowered.cost_analysis()
         if isinstance(cost, (list, tuple)):
-            cost = cost[0]
+            cost = cost[0] if cost else None
+        if cost is None:  # the axon TPU-tunnel client returns None
+            return None
         flops = float(cost.get("flops", 0.0))
         return flops if flops > 0 else None
+
+    # Lower from abstract avals: committed device arrays would pin the
+    # lowering to their own client no matter the default_device below.
+    specs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), (flat_p, x, y)
+    )
+    try:
+        got = flops_of(jax.jit(step).lower(*specs))
+        if got is not None:
+            return got
+    except Exception:
+        pass
+    # Analytic model FLOPs are platform-independent: when the accelerator
+    # client doesn't implement cost_analysis (observed: the axon tunnel
+    # returns None), lower the same step for the host CPU client instead.
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            return flops_of(jax.jit(step).lower(*specs))
     except Exception:
         return None
 

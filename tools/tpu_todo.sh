@@ -112,7 +112,7 @@ run_step llama-1b-fused-ce 3600 -t tools/tpu_llama1b_fused_ce.txt \
 # (5) Streaming-flash re-time at 2k/4k causal, post block-skipping
 # (healthy TODO #3; target: streaming <= dense 64.8 ms at 4k).
 run_step flash-retime 3600 -t tools/tpu_flash_retime.txt \
-  python benchmarks/flash_attention_hw.py --seqs 2048,4096 --iters 20 \
+  python -m benchmarks.flash_attention_hw --seqs 2048,4096 --iters 20 \
   || bail_if_dead
 
 # (6) Sliding-window point: window 1024 at seq 4096 vs full attention
