@@ -2886,6 +2886,64 @@ class SpmdGPipe:
             args += (rng,)
         return self._train_step_fns[key](*args)
 
+    def make_train_step(
+        self, optimizer: Any, *, donate: bool = True
+    ) -> Callable[..., Tuple[jax.Array, Pytree, Pytree]]:
+        """The whole update as ONE compiled program: pipelined
+        forward+backward plus the optimizer, fused by XLA.
+
+        ``optimizer`` is any optax-style gradient transformation (pytree
+        state, ``update(grads, state, params) -> (updates, state)``).
+        Returns ``step(params, opt_state, x, target, rng=None) ->
+        (loss, new_params, new_opt_state)``; initialize ``opt_state``
+        with ``place_tree(optimizer.init(params))``.
+
+        Two wins over calling :meth:`train_step` and applying the
+        optimizer in a second jitted program (the reference's shape:
+        ``loss.backward()`` then ``optimizer.step()`` as separate host
+        calls, reference ``benchmarks/resnet101-speed/main.py``):
+
+        * one host dispatch per step instead of two, and no gradient
+          pytree materialized at the program boundary;
+        * with ``donate=True`` the incoming ``params``/``opt_state``
+          buffers are donated to XLA, so the update happens in place in
+          HBM — no transient 2x params+moments footprint.  The caller
+          must treat the passed-in arrays as consumed and use the
+          returned ones (standard JAX donation contract; XLA ignores
+          donation on backends that don't support it, e.g. host CPU).
+
+        The returned callable re-traces per distinct input shape
+        signature (ragged batch buckets, rng presence), exactly like
+        :meth:`train_step`.
+        """
+
+        def whole(
+            params: Pytree,
+            opt_state: Pytree,
+            x: Pytree,
+            target: Pytree,
+            rng: Optional[jax.Array],
+        ) -> Tuple[jax.Array, Pytree, Pytree]:
+            loss, grads = self.train_step(params, x, target, rng)
+            updates, new_state = optimizer.update(grads, opt_state, params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: (p + u).astype(p.dtype), params, updates
+            )
+            return loss, new_params, new_state
+
+        compiled = jax.jit(whole, donate_argnums=(0, 1) if donate else ())
+
+        def step(
+            params: Pytree,
+            opt_state: Pytree,
+            x: Pytree,
+            target: Pytree,
+            rng: Optional[jax.Array] = None,
+        ) -> Tuple[jax.Array, Pytree, Pytree]:
+            return compiled(params, opt_state, x, target, rng)
+
+        return step
+
     def _build_apply(self, with_loss: bool = False) -> Callable:
         n = self.n_stages
         data_spec = self._data_specs()
