@@ -72,6 +72,18 @@ if len(jax.devices()) >= pp * dp:
         loss, grads = pipe.train_step(p, tokens, labels)
         p = jax.tree_util.tree_map(lambda a, g: a - 0.1 * g, p, grads)
         print(f"[spmd] step {step}: loss {float(loss):.4f}", flush=True)
+
+    # Production shape: the whole update (pipeline + optimizer) as ONE
+    # compiled program with donated buffers — no 2x params+moments HBM.
+    import optax
+
+    opt = optax.adamw(1e-2)
+    fused = pipe.make_train_step(opt)
+    opt_state = pipe.place_tree(opt.init(p))
+    for step in range(3, 6):
+        loss, p, opt_state = fused(p, opt_state, tokens, labels)
+        print(f"[spmd/fused-opt] step {step}: loss {float(loss):.4f}",
+              flush=True)
 else:
     print(f"[spmd] skipped: needs {pp * dp} devices, have {len(jax.devices())}")
 

@@ -102,6 +102,14 @@ else
     || bail_if_dead
 fi
 
+# (3b) MFU recapture: the first-window judge artifact landed with
+# mfu=null (the axon client returns None from cost_analysis; bench.py
+# since gained a CPU-client fallback).  Re-run the ladder into a fresh
+# artifact so a non-null-mfu TPU line exists; README cites it once
+# captured.  Cache-warm, so this is minutes not tens of minutes.
+run_step bench-mfu 5400 -o tools/bench_tpu_mfu.json python bench.py \
+  || bail_if_dead
+
 # (4) Llama-1B chunked-vocab-CE rescue: the previously-OOM big-vocab
 # config, expected to fit via ops/losses.py chunked CE (healthy TODO #2).
 run_step llama-1b-fused-ce 3600 -t tools/tpu_llama1b_fused_ce.txt \
