@@ -110,6 +110,14 @@ fi
 run_step bench-mfu 5400 -o tools/bench_tpu_mfu.json python bench.py \
   || bail_if_dead
 
+# (3c) Opportunistic headline push: batch 160 fused measured 479.8/s in
+# the round-1 sweep when 128 measured 442 — with 128/4 re-measured at
+# 513.8 this rung may beat the headline.  Not in the watcher's required
+# set; promoted only if it actually runs to a number.
+run_step bench-160 5400 -o tools/bench_tpu_160.json \
+  env TGPU_BENCH_RUNG="160,4,except_last,1" python bench.py \
+  || bail_if_dead
+
 # (4) Llama-1B chunked-vocab-CE rescue: the previously-OOM big-vocab
 # config, expected to fit via ops/losses.py chunked CE (healthy TODO #2).
 run_step llama-1b-fused-ce 3600 -t tools/tpu_llama1b_fused_ce.txt \
