@@ -64,6 +64,7 @@ from jax import lax
 
 from torchgpipe_tpu.models.transformer import (
     TransformerConfig,
+    _head_w,
     _rms,
     _rope,
 )
@@ -319,7 +320,9 @@ def _mlp_out(cfg: TransformerConfig, p: Pytree, h: jnp.ndarray,
 def _logits(cfg: TransformerConfig, head_params: Pytree,
             x: jnp.ndarray) -> jnp.ndarray:
     h = _rms(x, head_params["scale"], cfg.norm_eps)
-    return (h @ head_params["w"]).astype(jnp.float32)
+    # _head_w: own 'w', or the tied embedding table transposed (with the
+    # didactic error when neither is present).
+    return (h @ _head_w(cfg, head_params)).astype(jnp.float32)
 
 
 def _sample(
@@ -764,14 +767,24 @@ def spmd_params_for_generation(
             stage = (stage,)
         out.extend(stage)
     if pipe.post is not None:
-        out.append(params["post"])
+        head = params["post"]
     elif "loss" in params:
-        out.append(params["loss"])
+        head = params["loss"]
     else:
         raise ValueError(
             "no head params: the engine has neither a post layer nor a "
             "parametric loss layer holding the lm head"
         )
+    # Tied head (meta['tie_pre'] / TransformerConfig.tie_embeddings): hand
+    # decode the same pre-param entries the engine splices at train time,
+    # read from the engine's own computed key tuples so the protocol has
+    # one source of truth.
+    tie_keys = (
+        pipe._tie_post if pipe.post is not None else pipe._tie_loss
+    )
+    if tie_keys:
+        head = dict(head, **{k: params["pre"][k] for k in tie_keys})
+    out.append(head)
     return [jax.device_put(p, device) for p in out]
 
 

@@ -68,6 +68,17 @@ class TransformerConfig:
     # size must divide n_heads, kv_heads and mlp_hidden (the engine checks
     # against the actual mesh at init).
     tp_axis: Optional[str] = None
+    # GPT-2/Gemma-style weight tying: the lm head reuses the embedding
+    # table (logits = h @ table.T) instead of owning a separate ``w``.
+    # The classic pipeline-parallel pain point — the two uses live on
+    # opposite pipeline ends, so MPMD frameworks need a cross-stage grad
+    # reduction — dissolves in the SPMD engine: pre params are replicated
+    # across pp lanes and the head reads the SAME traced array, so
+    # autodiff sums both gradient paths and the engine's existing
+    # pre-grad psum over pp collects them.  Supported by ``llama_spmd``
+    # + ``SpmdGPipe`` (fill-drain schedule) and by decode; the flat
+    # ``llama()`` MPMD list rejects it with a pointer.
+    tie_embeddings: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -404,12 +415,30 @@ def _head_init(cfg: TransformerConfig) -> Callable:
 
     def init(rng, in_spec):
         del in_spec
-        return {
-            "scale": jnp.ones((cfg.dim,)),
-            "w": _normal(rng, (cfg.dim, cfg.vocab), cfg.dim ** -0.5, cfg.dtype),
-        }, ()
+        p = {"scale": jnp.ones((cfg.dim,))}
+        if not cfg.tie_embeddings:
+            p["w"] = _normal(
+                rng, (cfg.dim, cfg.vocab), cfg.dim ** -0.5, cfg.dtype
+            )
+        return p, ()
 
     return init
+
+
+def _head_w(cfg: TransformerConfig, params: Any) -> jnp.ndarray:
+    """The head projection ``[dim, vocab]``: the layer's own ``w``, or —
+    under ``cfg.tie_embeddings`` — the embedding table (spliced into the
+    param dict by the engine / the generation extractor), transposed."""
+    if "w" in params:
+        return params["w"]
+    if "table" not in params:
+        raise ValueError(
+            "tie_embeddings=True but the head received neither 'w' nor "
+            "the spliced embedding 'table' — pair the tied head with "
+            "SpmdGPipe (which splices pre params per meta['tie_pre']) or "
+            "models.generation.spmd_params_for_generation"
+        )
+    return params["table"].T
 
 
 def lm_head(
@@ -429,16 +458,21 @@ def lm_head(
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
         h = _rms(x, params["scale"], cfg.norm_eps)
+        w = _head_w(cfg, params)
         if axis_bound(cfg.tp_axis):
             h = psum_grad(h, cfg.tp_axis)  # region entry: full grad upstream
-            logits = h @ params["w"]  # local [.., vocab/tp]
+            logits = h @ w  # local [.., vocab/tp]
             if gather_logits:
                 logits = all_gather_value(logits, cfg.tp_axis, axis=-1)
             return logits, state
-        return h @ params["w"], state
+        return h @ w, state
 
     tp = cfg.tp_axis
-    meta = _vocab_meta(cfg, {"scale": P(), "w": P(None, tp)})
+    if cfg.tie_embeddings:
+        meta = _vocab_meta(cfg, {"scale": P()})
+        meta["tie_pre"] = ("table",)
+    else:
+        meta = _vocab_meta(cfg, {"scale": P(), "w": P(None, tp)})
     if tp is not None and not gather_logits:
         # Declares that this layer's output stays sharded over (axis, dim) —
         # consumed by SpmdGPipe.apply, which gathers it so inference returns
@@ -499,6 +533,15 @@ def chunked_lm_loss(
     ``vocab_parallel_cross_entropy`` instead)."""
     from torchgpipe_tpu.ops.losses import chunked_softmax_xent
 
+    if cfg.tie_embeddings and cfg.tp_axis is not None:
+        raise ValueError(
+            "chunked_lm_loss cannot tie to a vocab-parallel embedding: "
+            "the tp-sharded table would hand this loss a [vocab/tp, dim] "
+            "local shard while the labels index the GLOBAL vocabulary — "
+            "the loss would silently normalize over 1/tp of the "
+            "vocabulary.  Use vocab_parallel_cross_entropy with "
+            "lm_head(gather_logits=False) for tp models, or untie"
+        )
     init = _head_init(cfg)
 
     def row_loss(params, state, y_and_labels):
@@ -510,7 +553,8 @@ def chunked_lm_loss(
         y, labels = y_and_labels
         h = _rms(y, params["scale"], cfg.norm_eps)
         losses = chunked_softmax_xent(
-            h.reshape(-1, cfg.dim), params["w"], labels.reshape(-1), chunk
+            h.reshape(-1, cfg.dim), _head_w(cfg, params),
+            labels.reshape(-1), chunk,
         )
         return jnp.mean(losses.reshape(labels.shape[0], -1), axis=1)
 
@@ -518,8 +562,10 @@ def chunked_lm_loss(
         del rng, train
         return jnp.mean(row_loss(params, state, y_and_labels)), state
 
-    return Layer(name=name, init=init, apply=apply,
-                 meta={"row_loss": row_loss})
+    meta: dict = {"row_loss": row_loss}
+    if cfg.tie_embeddings:
+        meta["tie_pre"] = ("table",)
+    return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
 def llama(cfg: TransformerConfig, *, head: bool = True) -> List[Layer]:
@@ -530,6 +576,16 @@ def llama(cfg: TransformerConfig, *, head: bool = True) -> List[Layer]:
     :func:`chunked_lm_loss` via
     ``GPipe.value_and_grad_with_loss_params`` so the ``[tokens, vocab]``
     logits never materialize (the big-vocab memory fix)."""
+    if cfg.tie_embeddings:
+        raise ValueError(
+            "tie_embeddings is an SPMD-engine feature: the MPMD layer "
+            "list places the embedding and the head on different stage "
+            "devices with independent param trees, so the tied gradient "
+            "would need a manual cross-stage reduction.  Use "
+            "llama_spmd(cfg, n) + SpmdGPipe (pre params are replicated "
+            "across pp lanes; the tie is spliced and gradients sum "
+            "automatically), or set tie_embeddings=False here"
+        )
     layers: List[Layer] = [token_embedding(cfg)]
     for i in range(cfg.n_layers):
         layers.append(transformer_block(cfg, name=f"block{i}"))

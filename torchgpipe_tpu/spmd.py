@@ -510,6 +510,35 @@ class SpmdGPipe:
                 )
         if self.loss_reduction not in ("mean", "sum", None):
             raise ValueError("loss_reduction must be 'mean', 'sum' or None")
+        # Weight tying (meta['tie_pre']): the post/loss layer asks for
+        # these pre-param entries to be spliced into its param dict at
+        # apply time (e.g. a tied lm head reading the embedding table,
+        # models.transformer TransformerConfig.tie_embeddings).  Pre
+        # params are replicated across pp lanes, so the splice reuses the
+        # SAME traced array and autodiff sums both gradient paths into
+        # grads['pre'] — no extra reduction machinery.
+        def _tie_keys(lyr: Optional[Layer]) -> Tuple[str, ...]:
+            if lyr is None or not isinstance(lyr.meta, dict):
+                return ()
+            return tuple(lyr.meta.get("tie_pre", ()))
+
+        self._tie_post = _tie_keys(self.post)
+        self._tie_loss = _tie_keys(loss_lyr)
+        if self._tie_post or self._tie_loss:
+            if self.pre is None:
+                raise ValueError(
+                    "meta['tie_pre'] asks for pre-param splicing, but the "
+                    "engine has no pre layer to take them from"
+                )
+            if self.schedule != "fill_drain":
+                raise ValueError(
+                    f"weight tying (meta['tie_pre']) is supported on the "
+                    f"fill_drain schedule, not {self.schedule!r}: the "
+                    "explicit-gradient schedules hand-accumulate per-cell "
+                    "cotangents and do not yet route the tied "
+                    "contribution into grads['pre'].  Use "
+                    "schedule='fill_drain', or untie"
+                )
         if not (
             self.scan_unroll is True
             or (isinstance(self.scan_unroll, int)
@@ -808,6 +837,17 @@ class SpmdGPipe:
                 p_pre, (), raw, rng=_sub_key(pre_base, i), train=True
             )
         return tmap(lambda a, r: jnp.where(first, a, r), x0, fallback)
+
+    def _tied(
+        self, own: Pytree, p_pre: Pytree, keys: Tuple[str, ...]
+    ) -> Pytree:
+        """Splice tied pre-param entries (meta['tie_pre']) into a post/
+        loss layer's param dict.  Reusing the same traced array is the
+        whole mechanism: autodiff sums the tied gradient paths into
+        grads['pre'] with no further plumbing."""
+        if not keys:
+            return own
+        return dict(own, **{k: p_pre[k] for k in keys})
 
     def _loss_call(
         self, p_loss: Pytree, y: Pytree, tgt: Pytree, train: bool = True
@@ -2646,8 +2686,16 @@ class SpmdGPipe:
                         # aux injections average over the n slices.
                         with aux_scale(1.0 / n):
                             my, _ = self.post.apply(
-                                params["post"], (), my, rng=post_rng, train=True
+                                self._tied(
+                                    params["post"], params.get("pre", ()),
+                                    self._tie_post,
+                                ),
+                                (), my, rng=post_rng, train=True,
                             )
+                    p_loss_t = self._tied(
+                        params.get("loss", ()), params.get("pre", ()),
+                        self._tie_loss,
+                    )
                     if masked:
                         # Masked per-row SUM over this stage's slice: the
                         # n slices add to the lane total (no /n), and the
@@ -2657,14 +2705,12 @@ class SpmdGPipe:
                             mask_g, stage * per, per, 0
                         )
                         l = self._masked_loss_sum(
-                            params.get("loss", ()), my, tgt_my, mask_my
+                            p_loss_t, my, tgt_my, mask_my
                         )
                         if self.loss_reduction == "mean":
                             l = l * mean_scale
                         return l
-                    l = self._loss_call(
-                        params.get("loss", ()), my, tgt_my
-                    )
+                    l = self._loss_call(p_loss_t, my, tgt_my)
                     if self.loss_reduction == "mean":
                         l = l / n
                     # LOCAL per-slice loss; the psum after value_and_grad
@@ -2676,16 +2722,24 @@ class SpmdGPipe:
                     # pp): stage-mask the aux scale like pre.
                     with aux_scale(jnp.where(stage == n - 1, 1.0, 0.0)):
                         gathered, _ = self.post.apply(
-                            params["post"], (), gathered, rng=post_rng, train=True
+                            self._tied(
+                                params["post"], params.get("pre", ()),
+                                self._tie_post,
+                            ),
+                            (), gathered, rng=post_rng, train=True,
                         )
+                p_loss_t = self._tied(
+                    params.get("loss", ()), params.get("pre", ()),
+                    self._tie_loss,
+                )
                 if masked:
                     l = self._masked_loss_sum(
-                        params.get("loss", ()), gathered, tgt, mask_g
+                        p_loss_t, gathered, tgt, mask_g
                     )
                     if self.loss_reduction == "mean":
                         l = l * mean_scale
                 else:
-                    l = self._loss_call(params.get("loss", ()), gathered, tgt)
+                    l = self._loss_call(p_loss_t, gathered, tgt)
                 # LOCAL loss, nonzero only on the last stage.  Do NOT psum
                 # here: differentiating a replicated (psum'd) output would
                 # seed one cotangent per device and over-count gradients by
@@ -2971,8 +3025,11 @@ class SpmdGPipe:
                 # most one micro-batch's logits are ever live.
                 return self._eval_loss_from_outs(params, outs, tgt_mb, stage)
             if self.post is not None:
+                p_post_t = self._tied(
+                    params["post"], params.get("pre", ()), self._tie_post
+                )
                 outs = jax.vmap(
-                    lambda mb: self.post.apply(params["post"], (), mb, rng=None, train=False)[0]
+                    lambda mb: self.post.apply(p_post_t, (), mb, rng=None, train=False)[0]
                 )(outs)
                 for axis, dim in out_gather:
                     outs = all_gather_value(outs, axis, dim)
@@ -3116,9 +3173,12 @@ class SpmdGPipe:
                 # and post runs per micro-batch inside the loss loop.
                 return self._eval_loss_from_outs(params, outs, tgt_mb, stage)
             if self.post is not None:
+                p_post_t = self._tied(
+                    params["post"], params.get("pre", ()), self._tie_post
+                )
                 outs = jax.vmap(
                     lambda mb: self.post.apply(
-                        params["post"], (), mb, rng=None, train=False
+                        p_post_t, (), mb, rng=None, train=False
                     )[0]
                 )(outs)
                 for axis, dim in out_gather:
@@ -3166,7 +3226,16 @@ class SpmdGPipe:
         n = self.n_stages
         m = self.chunks
         tmap = jax.tree_util.tree_map
-        p_loss = params["loss"] if self._loss_is_layer else ()
+        p_loss = self._tied(
+            params["loss"] if self._loss_is_layer else (),
+            params.get("pre", ()),
+            self._tie_loss,
+        )
+        p_post_t = (
+            self._tied(params["post"], params.get("pre", ()), self._tie_post)
+            if self.post is not None
+            else ()
+        )
 
         def mb_loss(i, acc):
             y_i = tmap(
@@ -3175,7 +3244,7 @@ class SpmdGPipe:
             )
             if self.post is not None:
                 y_i, _ = self.post.apply(
-                    params["post"], (), y_i, rng=None, train=False
+                    p_post_t, (), y_i, rng=None, train=False
                 )
             t_i = tmap(
                 lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
@@ -3221,8 +3290,12 @@ class SpmdGPipe:
         if self.loss_reduction is None or pad:
             out = self.apply(params, x)
             return self._loss_call(
-                params["loss"] if self._loss_is_layer else (), out, target,
-                train=False,
+                self._tied(
+                    params["loss"] if self._loss_is_layer else (),
+                    params.get("pre", ()),
+                    self._tie_loss,
+                ),
+                out, target, train=False,
             )
         if self.fsdp:
             self._ensure_fsdp(params["blocks"])
