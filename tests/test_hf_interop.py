@@ -153,3 +153,68 @@ def test_to_hf_preserves_dtype():
             sd32[k].numpy().astype(jnp.bfloat16).astype(np.float32),
             err_msg=k,
         )
+
+
+def test_tied_hf_checkpoint_native_tie():
+    """A tie_word_embeddings HF checkpoint imports as the framework's
+    native tie (one shared table, no 'w'), decodes teacher-forced equal
+    to the HF model, and exports back WITHOUT an lm_head.weight entry."""
+    from torchgpipe_tpu.models.generation import generate
+    from torchgpipe_tpu.models.hf_interop import state_dict_to_hf
+
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    m = transformers.LlamaForCausalLM(cfg_hf).eval()
+    cfg, params = from_hf_llama(m)
+    assert cfg.tie_embeddings
+    head = params[-1]
+    assert "w" not in head and head["table"] is params[0]["table"]
+
+    b, s = 2, 6
+    tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+    # Greedy decode's first token == HF argmax at the last position.
+    got = generate(cfg, params, jnp.asarray(tokens), max_new_tokens=1)
+    np.testing.assert_array_equal(
+        np.asarray(got[:, 0]), ref[:, -1].argmax(-1)
+    )
+
+    sd = state_dict_to_hf(params, cfg)
+    assert "lm_head.weight" not in sd
+    m2 = transformers.LlamaForCausalLM(cfg_hf)
+    missing, unexpected = m2.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    m2.tie_weights()
+    with torch.no_grad():
+        got2 = m2(torch.tensor(tokens)).logits.numpy()
+    np.testing.assert_allclose(got2, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_tied_checkpoint_untie_for_mpmd():
+    """untie=True imports a tied checkpoint as an untied copy that the
+    MPMD GPipe(llama(cfg)) path accepts, logits unchanged."""
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    m = transformers.LlamaForCausalLM(cfg_hf).eval()
+    cfg, params = from_hf_llama(m, untie=True)
+    assert not cfg.tie_embeddings and "w" in params[-1]
+    b, s = 2, 6
+    tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+    out, _ = sequential_apply(
+        llama(cfg), params, [() for _ in range(cfg.n_layers + 2)],
+        jnp.asarray(tokens, jnp.int32), rng=None, train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )

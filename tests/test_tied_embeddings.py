@@ -80,12 +80,10 @@ def test_tied_grads_equal_untied_sum(cpu_devices, loss_layer):
     p_tied = pipes[True].place(_tied_params_from(p_untied, head_key=head_key))
     assert "w" not in p_tied[head_key]
 
-    loss_u, g_u = pipes[False].train_step(p_untied, tokens, tokens)
     loss_t, g_t = pipes[True].train_step(p_tied, tokens, tokens)
 
-    # Same computation, since untied ran with an independent w == table.T.
-    # The untied embedding path used its own table — make the comparison
-    # fair by re-running untied with table := w.T as well.
+    # Untied oracle with the SAME computation: embedding table := w.T, so
+    # both ends of the untied model match what the tie shares.
     p_u2 = jax.tree_util.tree_map(lambda a: a, p_untied)
     p_u2["pre"] = dict(p_u2["pre"], table=p_untied[head_key]["w"].T)
     p_u2 = pipes[False].place(p_u2)

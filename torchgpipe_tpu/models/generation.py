@@ -775,17 +775,21 @@ def spmd_params_for_generation(
             "no head params: the engine has neither a post layer nor a "
             "parametric loss layer holding the lm head"
         )
+    out.append(head)
+    placed = [jax.device_put(p, device) for p in out]
     # Tied head (meta['tie_pre'] / TransformerConfig.tie_embeddings): hand
     # decode the same pre-param entries the engine splices at train time,
     # read from the engine's own computed key tuples so the protocol has
-    # one source of truth.
+    # one source of truth.  Splice AFTER placement, from the placed
+    # embedding dict, so the decode device holds ONE copy of the table.
     tie_keys = (
         pipe._tie_post if pipe.post is not None else pipe._tie_loss
     )
     if tie_keys:
-        head = dict(head, **{k: params["pre"][k] for k in tie_keys})
-    out.append(head)
-    return [jax.device_put(p, device) for p in out]
+        placed[-1] = dict(
+            placed[-1], **{k: placed[0][k] for k in tie_keys}
+        )
+    return placed
 
 
 __all__ = [

@@ -50,6 +50,12 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
         mlp_ratio=ratio,
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         norm_eps=float(hf_config.rms_norm_eps),
+        # Llama-3.2-class checkpoints tie the lm head to the embedding;
+        # imported as this framework's native tie (one table, shared),
+        # not an untied copy.
+        tie_embeddings=bool(
+            getattr(hf_config, "tie_word_embeddings", False)
+        ),
     )
     if cfg.mlp_hidden != inter:
         raise ValueError(
@@ -101,22 +107,42 @@ def params_from_hf(
             "w_up": _t(sd[p + "mlp.up_proj.weight"]),
             "w_down": _t(sd[p + "mlp.down_proj.weight"]),
         })
-    head_w = (
-        sd["lm_head.weight"]
-        if "lm_head.weight" in sd
-        else sd["model.embed_tokens.weight"]  # tied embeddings
-    )
-    out.append({
-        "scale": _v(sd["model.norm.weight"]),
-        "w": _t(head_w),
-    })
+    if cfg.tie_embeddings:
+        # Native tie: the head carries the SAME array as the embedding
+        # (decode reads it via _head_w; the SPMD engine splices it via
+        # meta['tie_pre'] — no duplicated [vocab, dim] table).
+        out.append({
+            "scale": _v(sd["model.norm.weight"]),
+            "table": out[0]["table"],
+        })
+    else:
+        head_w = (
+            sd["lm_head.weight"]
+            if "lm_head.weight" in sd
+            else sd["model.embed_tokens.weight"]  # tied ckpt, untied cfg
+        )
+        out.append({
+            "scale": _v(sd["model.norm.weight"]),
+            "w": _t(head_w),
+        })
     return out
 
 
-def from_hf_llama(model: Any) -> tuple:
+def from_hf_llama(model: Any, *, untie: bool = False) -> tuple:
     """(cfg, per-layer params) from a live HF ``LlamaForCausalLM`` — ready
-    for ``GPipe(llama(cfg))`` init-splicing or ``generation.generate``."""
+    for ``GPipe(llama(cfg))`` init-splicing or ``generation.generate``.
+
+    ``tie_word_embeddings`` checkpoints (the Llama-3.2 class) import as
+    the framework's NATIVE tie by default (one shared table; SPMD-engine
+    training + decode).  The MPMD ``GPipe(llama(cfg))`` path cannot
+    express the tie — pass ``untie=True`` to import such a checkpoint as
+    an untied COPY (head ``w = table.T``, independently trainable), the
+    layout every engine accepts."""
+    import dataclasses
+
     cfg = config_from_hf(model.config)
+    if untie and cfg.tie_embeddings:
+        cfg = dataclasses.replace(cfg, tie_embeddings=False)
     return cfg, params_from_hf(model.state_dict(), cfg)
 
 
@@ -158,8 +184,11 @@ def state_dict_to_hf(
     sd: Dict[str, Any] = {
         "model.embed_tokens.weight": v(embed["table"]),
         "model.norm.weight": v(head["scale"]),
-        "lm_head.weight": t(head["w"]),
     }
+    if "w" in head:
+        sd["lm_head.weight"] = t(head["w"])
+    # Tied head (no 'w'): HF tied checkpoints omit lm_head.weight — the
+    # loading model shares the embedding tensor itself.
     for i, bp in enumerate(blocks):
         p = f"model.layers.{i}."
         sd[p + "input_layernorm.weight"] = v(bp["ln1"])

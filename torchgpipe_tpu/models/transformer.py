@@ -431,14 +431,19 @@ def _head_w(cfg: TransformerConfig, params: Any) -> jnp.ndarray:
     param dict by the engine / the generation extractor), transposed."""
     if "w" in params:
         return params["w"]
-    if "table" not in params:
+    if cfg.tie_embeddings and "table" in params:
+        return params["table"].T
+    if cfg.tie_embeddings:
         raise ValueError(
             "tie_embeddings=True but the head received neither 'w' nor "
             "the spliced embedding 'table' — pair the tied head with "
             "SpmdGPipe (which splices pre params per meta['tie_pre']) or "
             "models.generation.spmd_params_for_generation"
         )
-    return params["table"].T
+    raise ValueError(
+        f"head params are missing 'w' (got keys {sorted(params)}) — was "
+        "the checkpoint built for a different head configuration?"
+    )
 
 
 def lm_head(
