@@ -218,3 +218,125 @@ def test_tied_checkpoint_untie_for_mpmd():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
     )
+
+
+def test_mixtral_logits_match_hf():
+    """MoE import: a live MixtralForCausalLM's logits must be reproduced
+    by llama_moe(cfg, moe) under the dropless dispatch (Mixtral drops no
+    tokens; HF's renormalized top-k == the GShard gate normalization)."""
+    from torchgpipe_tpu.models.hf_interop import from_hf_mixtral
+    from torchgpipe_tpu.models.moe import llama_moe
+
+    cfg_hf = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        rope_theta=10000.0, rms_norm_eps=1e-5,
+    )
+    torch.manual_seed(0)
+    m = transformers.MixtralForCausalLM(cfg_hf).eval()
+    cfg, moe, params = from_hf_mixtral(m)
+    assert moe.n_experts == 4 and moe.top_k == 2
+    assert moe.dispatch == "dropless"
+
+    b, s = 2, 7
+    tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+    out, _ = sequential_apply(
+        llama_moe(cfg, moe), params,
+        [() for _ in range(cfg.n_layers + 2)],
+        jnp.asarray(tokens, jnp.int32), rng=None, train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_mixtral_decode_and_k1_rejection():
+    from torchgpipe_tpu.models.generation import generate
+    from torchgpipe_tpu.models.hf_interop import (
+        config_from_hf_mixtral,
+        from_hf_mixtral,
+    )
+
+    cfg_hf = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+    )
+    torch.manual_seed(0)
+    m = transformers.MixtralForCausalLM(cfg_hf).eval()
+    cfg, moe, params = from_hf_mixtral(m)
+    b, s, new = 2, 5, 3
+    tokens = (np.arange(b * s).reshape(b, s) * 3 + 1) % cfg.vocab
+    ours = np.asarray(generate(
+        cfg, params, jnp.asarray(tokens, jnp.int32),
+        max_new_tokens=new, moe=moe,
+    ))
+    with torch.no_grad():
+        hf = m.generate(
+            torch.tensor(tokens), max_new_tokens=new, do_sample=False,
+        ).numpy()[:, s:]
+    assert (ours == hf).all(), (ours, hf)
+
+    bad = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=1,
+    )
+    with pytest.raises(ValueError, match="k=1"):
+        config_from_hf_mixtral(bad)
+
+
+def test_mixtral_sliding_window_maps_to_attn_window():
+    """Mixtral's sliding_window imports as cfg.attn_window; logits match
+    the HF model at a sequence LONGER than the window (the config where
+    full-causal attention would silently diverge)."""
+    from torchgpipe_tpu.models.hf_interop import from_hf_mixtral
+    from torchgpipe_tpu.models.moe import llama_moe
+
+    cfg_hf = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2, sliding_window=3,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    m = transformers.MixtralForCausalLM(cfg_hf).eval()
+    cfg, moe, params = from_hf_mixtral(m)
+    assert cfg.attn_window == 3
+    b, s = 2, 7  # s > window: the band actually bites
+    tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+    out, _ = sequential_apply(
+        llama_moe(cfg, moe), params,
+        [() for _ in range(cfg.n_layers + 2)],
+        jnp.asarray(tokens, jnp.int32), rng=None, train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_tied_mixtral_imports_consistently():
+    """A tie_word_embeddings Mixtral imports with the tie honored: head
+    carries the shared table (no stale untied 'w'), and the MPMD list
+    rejects the config at construction with a pointer."""
+    from torchgpipe_tpu.models.hf_interop import from_hf_mixtral
+    from torchgpipe_tpu.models.moe import llama_moe
+
+    cfg_hf = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    m = transformers.MixtralForCausalLM(cfg_hf).eval()
+    cfg, moe, params = from_hf_mixtral(m)
+    assert cfg.tie_embeddings
+    assert "w" not in params[-1] and params[-1]["table"] is params[0]["table"]
+    with pytest.raises(ValueError, match="llama_moe_spmd"):
+        llama_moe(cfg, moe)

@@ -15,8 +15,11 @@ Conventions verified against ``transformers`` (tested numerically in
 * GQA query→kv pairing ``h // (nh/nkv)`` matches;
 * ``RMSNorm`` math (f32 accumulation, eps inside rsqrt) matches.
 
-Only f32/bf16 dense Llama-family checkpoints are covered (no fused/
-quantized HF layouts); MoE (Mixtral) layouts are rejected loudly.
+f32/bf16 Llama-family checkpoints are covered (no fused/quantized HF
+layouts).  MoE: ``from_hf_mixtral`` imports ``MixtralForCausalLM`` into
+the ``llama_moe`` family (dropless dispatch; HF's renormalized top-k is
+exactly the GShard gate normalization for k >= 2 — logits and greedy
+decode match the live HF model in CI).
 """
 
 from __future__ import annotations
@@ -82,6 +85,39 @@ def _v(w: Any) -> jnp.ndarray:
     return jnp.asarray(arr)
 
 
+def _attn_entries(sd: Dict[str, Any], p: str) -> Dict[str, jnp.ndarray]:
+    """The per-block attention + norm mapping shared by the Llama and
+    Mixtral importers (identical layouts; only the MLP differs)."""
+    return {
+        "ln1": _v(sd[p + "input_layernorm.weight"]),
+        "wq": _t(sd[p + "self_attn.q_proj.weight"]),
+        "wk": _t(sd[p + "self_attn.k_proj.weight"]),
+        "wv": _t(sd[p + "self_attn.v_proj.weight"]),
+        "wo": _t(sd[p + "self_attn.o_proj.weight"]),
+        "ln2": _v(sd[p + "post_attention_layernorm.weight"]),
+    }
+
+
+def _head_entry(
+    sd: Dict[str, Any], cfg: TransformerConfig, embed: Pytree
+) -> Pytree:
+    """Final-norm + head mapping shared by both importers, honoring the
+    tie: a tied cfg's head carries the SAME array as the embedding
+    (decode reads it via ``_head_w``; the SPMD engine splices it via
+    ``meta['tie_pre']`` — no duplicated ``[vocab, dim]`` table)."""
+    if cfg.tie_embeddings:
+        return {
+            "scale": _v(sd["model.norm.weight"]),
+            "table": embed["table"],
+        }
+    head_w = (
+        sd["lm_head.weight"]
+        if "lm_head.weight" in sd
+        else sd["model.embed_tokens.weight"]  # tied ckpt, untied cfg
+    )
+    return {"scale": _v(sd["model.norm.weight"]), "w": _t(head_w)}
+
+
 def params_from_hf(
     state_dict: Dict[str, Any], cfg: TransformerConfig
 ) -> List[Pytree]:
@@ -89,42 +125,21 @@ def params_from_hf(
     an HF ``LlamaForCausalLM`` state dict."""
     if any(".block_sparse_moe." in k or ".experts." in k for k in state_dict):
         raise ValueError(
-            "MoE (Mixtral-style) HF layouts are not supported; this "
-            "importer covers the dense Llama family"
+            "MoE (Mixtral-style) HF layout: use from_hf_mixtral / "
+            "params_from_hf_mixtral (imports into the llama_moe family); "
+            "this importer covers the dense Llama family"
         )
     sd = state_dict
     out: List[Pytree] = [{"table": _v(sd["model.embed_tokens.weight"])}]
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
         out.append({
-            "ln1": _v(sd[p + "input_layernorm.weight"]),
-            "wq": _t(sd[p + "self_attn.q_proj.weight"]),
-            "wk": _t(sd[p + "self_attn.k_proj.weight"]),
-            "wv": _t(sd[p + "self_attn.v_proj.weight"]),
-            "wo": _t(sd[p + "self_attn.o_proj.weight"]),
-            "ln2": _v(sd[p + "post_attention_layernorm.weight"]),
+            **_attn_entries(sd, p),
             "w_gate": _t(sd[p + "mlp.gate_proj.weight"]),
             "w_up": _t(sd[p + "mlp.up_proj.weight"]),
             "w_down": _t(sd[p + "mlp.down_proj.weight"]),
         })
-    if cfg.tie_embeddings:
-        # Native tie: the head carries the SAME array as the embedding
-        # (decode reads it via _head_w; the SPMD engine splices it via
-        # meta['tie_pre'] — no duplicated [vocab, dim] table).
-        out.append({
-            "scale": _v(sd["model.norm.weight"]),
-            "table": out[0]["table"],
-        })
-    else:
-        head_w = (
-            sd["lm_head.weight"]
-            if "lm_head.weight" in sd
-            else sd["model.embed_tokens.weight"]  # tied ckpt, untied cfg
-        )
-        out.append({
-            "scale": _v(sd["model.norm.weight"]),
-            "w": _t(head_w),
-        })
+    out.append(_head_entry(sd, cfg, out[0]))
     return out
 
 
@@ -205,7 +220,92 @@ def state_dict_to_hf(
 
 __all__ = [
     "config_from_hf",
+    "config_from_hf_mixtral",
     "params_from_hf",
+    "params_from_hf_mixtral",
     "from_hf_llama",
+    "from_hf_mixtral",
     "state_dict_to_hf",
 ]
+
+
+def config_from_hf_mixtral(hf_config: Any) -> tuple:
+    """(TransformerConfig, MoEConfig) equivalent to an HF
+    ``MixtralConfig``.
+
+    Router-semantics note (verified against ``transformers``' Mixtral
+    forward): HF computes ``softmax(router_logits)``, takes top-k, and
+    renormalizes the selected weights — exactly this framework's GShard
+    normalization for ``top_k >= 2`` (``moe._gate_denom``).  ``top_k=1``
+    differs (we keep the raw Switch-style probability; HF would pin the
+    gate to 1.0) and is rejected rather than silently mismatched.
+    """
+    from torchgpipe_tpu.models.moe import MoEConfig
+
+    k = int(hf_config.num_experts_per_tok)
+    if k < 2:
+        raise ValueError(
+            "Mixtral import requires num_experts_per_tok >= 2: at k=1 "
+            "HF renormalizes the single gate to 1.0 while this "
+            "framework keeps the Switch-style raw probability — the "
+            "models would silently disagree"
+        )
+    cfg = config_from_hf(hf_config)
+    sw = getattr(hf_config, "sliding_window", None)
+    if sw:
+        # Mistral-style local attention: HF masks keys with
+        # q - k >= sliding_window, exactly this framework's
+        # ``attn_window`` band (attend iff 0 <= q - k < window).
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, attn_window=int(sw))
+    moe = MoEConfig(
+        n_experts=int(hf_config.num_local_experts),
+        top_k=k,
+        dispatch="dropless",  # Mixtral drops no tokens; exact parity
+    )
+    return cfg, moe
+
+
+def params_from_hf_mixtral(
+    state_dict: Dict[str, Any], cfg: TransformerConfig, moe: Any
+) -> List[Pytree]:
+    """Per-layer params in ``llama_moe(cfg, moe)`` order (embed, MoE
+    blocks, head) from an HF ``MixtralForCausalLM`` state dict.
+
+    Layout mapping (torch ``Linear`` stores ``[out, in]`` → transpose):
+    ``block_sparse_moe.gate.weight [E, dim]`` → ``router [dim, E]``
+    (f32, matching the framework's f32 routing); per-expert ``w1/w3/w2``
+    → stacked ``w_gate/w_up [E, dim, hidden]`` / ``w_down [E, hidden,
+    dim]`` (same SwiGLU: ``silu(x@w_gate) * (x@w_up) @ w_down``)."""
+    sd = state_dict
+    out: List[Pytree] = [{"table": _v(sd["model.embed_tokens.weight"])}]
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        e = p + "block_sparse_moe."
+        mlp = {
+            "router": _t(sd[e + "gate.weight"]).astype(jnp.float32),
+            "w_gate": jnp.stack([
+                _t(sd[f"{e}experts.{x}.w1.weight"])
+                for x in range(moe.n_experts)
+            ]),
+            "w_up": jnp.stack([
+                _t(sd[f"{e}experts.{x}.w3.weight"])
+                for x in range(moe.n_experts)
+            ]),
+            "w_down": jnp.stack([
+                _t(sd[f"{e}experts.{x}.w2.weight"])
+                for x in range(moe.n_experts)
+            ]),
+        }
+        out.append({**_attn_entries(sd, p), "mlp": mlp})
+    out.append(_head_entry(sd, cfg, out[0]))
+    return out
+
+
+def from_hf_mixtral(model: Any) -> tuple:
+    """(cfg, moe, per-layer params) from a live HF
+    ``MixtralForCausalLM`` — ready for ``GPipe(llama_moe(cfg, moe))``
+    init-splicing or ``generation.generate(..., moe=moe)``."""
+    cfg, moe = config_from_hf_mixtral(model.config)
+    return cfg, moe, params_from_hf_mixtral(model.state_dict(), cfg, moe)

@@ -610,6 +610,14 @@ def moe_transformer_block(
 def llama_moe(cfg: TransformerConfig, moe: MoEConfig) -> List[Layer]:
     """Flat sequential layer list (embed, MoE blocks, head) for the MPMD
     GPipe engine — the Mixtral-style every-block-MoE shape."""
+    if cfg.tie_embeddings:
+        raise ValueError(
+            "tie_embeddings is an SPMD-engine feature (same constraint "
+            "as models.transformer.llama): the MPMD layer list places "
+            "the embedding and the head on different stage devices.  Use "
+            "llama_moe_spmd(cfg, moe, n) + SpmdGPipe, or set "
+            "tie_embeddings=False"
+        )
     layers: List[Layer] = [token_embedding(cfg)]
     for i in range(cfg.n_layers):
         layers.append(moe_transformer_block(cfg, moe, name=f"moe_block{i}"))
