@@ -340,3 +340,59 @@ def test_tied_mixtral_imports_consistently():
     assert "w" not in params[-1] and params[-1]["table"] is params[0]["table"]
     with pytest.raises(ValueError, match="llama_moe_spmd"):
         llama_moe(cfg, moe)
+
+
+def test_mixtral_roundtrip_to_hf():
+    """from_hf_mixtral -> state_dict_to_hf_mixtral loads back into a live
+    Mixtral bit-compatibly (logits unchanged)."""
+    from torchgpipe_tpu.models.hf_interop import (
+        from_hf_mixtral,
+        state_dict_to_hf_mixtral,
+    )
+
+    cfg_hf = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+    )
+    torch.manual_seed(0)
+    m = transformers.MixtralForCausalLM(cfg_hf).eval()
+    cfg, moe, params = from_hf_mixtral(m)
+    sd = state_dict_to_hf_mixtral(params, cfg, moe)
+    m2 = transformers.MixtralForCausalLM(cfg_hf)
+    missing, unexpected = m2.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    b, s = 2, 6
+    tokens = torch.tensor(np.arange(b * s).reshape(b, s) % cfg.vocab)
+    with torch.no_grad():
+        ref = m(tokens).logits.numpy()
+        got = m2(tokens).logits.numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_mixtral_bf16_roundtrip_uniform_dtype():
+    """A bf16 Mixtral param tree exports with EVERY tensor bf16 —
+    including the router, which the importer keeps f32 in-framework."""
+    from torchgpipe_tpu.models.hf_interop import (
+        from_hf_mixtral,
+        state_dict_to_hf_mixtral,
+    )
+
+    cfg_hf = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+    )
+    torch.manual_seed(0)
+    m = transformers.MixtralForCausalLM(cfg_hf).eval()
+    cfg, moe, params = from_hf_mixtral(m)
+    bf16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating)
+        else a,
+        params,
+    )
+    sd = state_dict_to_hf_mixtral(bf16, cfg, moe)
+    assert all(t.dtype == torch.bfloat16 for t in sd.values()), {
+        k: t.dtype for k, t in sd.items() if t.dtype != torch.bfloat16
+    }

@@ -24,7 +24,7 @@ decode match the live HF model in CI).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 import jax.numpy as jnp
 
@@ -83,6 +83,30 @@ def _v(w: Any) -> jnp.ndarray:
 
     arr = w.detach().cpu().numpy() if hasattr(w, "detach") else np.asarray(w)
     return jnp.asarray(arr)
+
+
+def _torch_cast(a: jnp.ndarray) -> Any:
+    """Dtype-faithful jnp -> torch: numpy-native dtypes (f16/f32/f64)
+    convert directly; only bfloat16 — which numpy lacks — bridges through
+    f32 (lossless: every bf16 value is exactly representable) and is cast
+    back on the torch side.  Exports are the same width and values as the
+    import, never silently widened to f32."""
+    import numpy as np
+    import torch
+
+    if jnp.dtype(a.dtype).name == "bfloat16":
+        return torch.from_numpy(np.asarray(a, np.float32)).to(torch.bfloat16)
+    # .copy(): np.asarray of a jax array can be a read-only view;
+    # torch.from_numpy shares memory and warns on non-writable input.
+    return torch.from_numpy(np.asarray(a).copy())
+
+
+def _torch_t(a: jnp.ndarray) -> Any:  # jnp [in, out] -> torch [out, in]
+    return _torch_cast(a.T)
+
+
+def _torch_v(a: jnp.ndarray) -> Any:
+    return _torch_cast(a)
 
 
 def _attn_entries(sd: Dict[str, Any], p: str) -> Dict[str, jnp.ndarray]:
@@ -161,36 +185,16 @@ def from_hf_llama(model: Any, *, untie: bool = False) -> tuple:
     return cfg, params_from_hf(model.state_dict(), cfg)
 
 
-def state_dict_to_hf(
+def _export_common(
     params: List[Pytree], cfg: TransformerConfig
-) -> Dict[str, Any]:
-    """The inverse map: ``llama(cfg)`` per-layer params -> an HF
-    ``LlamaForCausalLM`` state dict (torch tensors) — train here,
-    publish to the HF ecosystem.  Exact inverse of
-    :func:`params_from_hf` (round-trip tested)."""
-    import numpy as np
-    import torch
-
-    def cast(a: jnp.ndarray) -> Any:
-        # Dtype-faithful: numpy-native dtypes (f16/f32/f64) convert
-        # directly; only bfloat16 — which numpy lacks — bridges through
-        # f32 (lossless: every bf16 value is exactly representable) and
-        # is cast back on the torch side.  The export is the same width
-        # and values as the import, never silently widened to f32.
-        if jnp.dtype(a.dtype).name == "bfloat16":
-            return torch.from_numpy(np.asarray(a, np.float32)).to(
-                torch.bfloat16
-            )
-        # .copy(): np.asarray of a jax array can be a read-only view;
-        # torch.from_numpy shares memory and warns on non-writable input.
-        return torch.from_numpy(np.asarray(a).copy())
-
-    def t(a: jnp.ndarray) -> Any:  # jnp [in, out] -> torch [out, in]
-        return cast(a.T)
-
-    def v(a: jnp.ndarray) -> Any:
-        return cast(a)
-
+) -> Tuple[Dict[str, Any], List[Pytree]]:
+    """Embed/norm/head export + per-block attention keys shared by the
+    Llama and Mixtral exporters (mirror of ``_attn_entries``/
+    ``_head_entry`` on the import side).  Returns the partially-filled
+    state dict and the block param list; tied heads (no ``'w'``) omit
+    ``lm_head.weight`` — HF tied checkpoints share the embedding tensor
+    itself."""
+    t, v = _torch_t, _torch_v
     embed, blocks, head = params[0], params[1:-1], params[-1]
     if len(blocks) != cfg.n_layers:
         raise ValueError(
@@ -202,8 +206,6 @@ def state_dict_to_hf(
     }
     if "w" in head:
         sd["lm_head.weight"] = t(head["w"])
-    # Tied head (no 'w'): HF tied checkpoints omit lm_head.weight — the
-    # loading model shares the embedding tensor itself.
     for i, bp in enumerate(blocks):
         p = f"model.layers.{i}."
         sd[p + "input_layernorm.weight"] = v(bp["ln1"])
@@ -212,6 +214,20 @@ def state_dict_to_hf(
         sd[p + "self_attn.v_proj.weight"] = t(bp["wv"])
         sd[p + "self_attn.o_proj.weight"] = t(bp["wo"])
         sd[p + "post_attention_layernorm.weight"] = v(bp["ln2"])
+    return sd, blocks
+
+
+def state_dict_to_hf(
+    params: List[Pytree], cfg: TransformerConfig
+) -> Dict[str, Any]:
+    """The inverse map: ``llama(cfg)`` per-layer params -> an HF
+    ``LlamaForCausalLM`` state dict (torch tensors) — train here,
+    publish to the HF ecosystem.  Exact inverse of
+    :func:`params_from_hf` (round-trip tested)."""
+    t = _torch_t
+    sd, blocks = _export_common(params, cfg)
+    for i, bp in enumerate(blocks):
+        p = f"model.layers.{i}."
         sd[p + "mlp.gate_proj.weight"] = t(bp["w_gate"])
         sd[p + "mlp.up_proj.weight"] = t(bp["w_up"])
         sd[p + "mlp.down_proj.weight"] = t(bp["w_down"])
@@ -226,6 +242,7 @@ __all__ = [
     "from_hf_llama",
     "from_hf_mixtral",
     "state_dict_to_hf",
+    "state_dict_to_hf_mixtral",
 ]
 
 
@@ -309,3 +326,27 @@ def from_hf_mixtral(model: Any) -> tuple:
     init-splicing or ``generation.generate(..., moe=moe)``."""
     cfg, moe = config_from_hf_mixtral(model.config)
     return cfg, moe, params_from_hf_mixtral(model.state_dict(), cfg, moe)
+
+
+def state_dict_to_hf_mixtral(
+    params: List[Pytree], cfg: TransformerConfig, moe: Any
+) -> Dict[str, Any]:
+    """The inverse map: ``llama_moe(cfg, moe)`` per-layer params -> an HF
+    ``MixtralForCausalLM`` state dict.  Exact inverse of
+    :func:`params_from_hf_mixtral` (round-trip tested); tied heads omit
+    ``lm_head.weight`` like the dense export."""
+    t = _torch_t
+    sd, blocks = _export_common(params, cfg)
+    table_dtype = params[0]["table"].dtype
+    for i, bp in enumerate(blocks):
+        e = f"model.layers.{i}.block_sparse_moe."
+        mlp = bp["mlp"]
+        # The router was cast to f32 on import (f32 routing is the
+        # framework's convention); export it back at the checkpoint's
+        # uniform dtype so a bf16 checkpoint round-trips bf16 throughout.
+        sd[e + "gate.weight"] = t(mlp["router"].astype(table_dtype))
+        for x in range(moe.n_experts):
+            sd[f"{e}experts.{x}.w1.weight"] = t(mlp["w_gate"][x])
+            sd[f"{e}experts.{x}.w3.weight"] = t(mlp["w_up"][x])
+            sd[f"{e}experts.{x}.w2.weight"] = t(mlp["w_down"][x])
+    return sd
