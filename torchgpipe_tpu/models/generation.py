@@ -239,9 +239,12 @@ def _decode_step(
         nh_loc = p["wq"].shape[1] // hd
         nkv_loc = p["wk"].shape[1] // hd
         h = _rms(x, p["ln1"], cfg.norm_eps)
-        q = (h @ p["wq"]).reshape(b, 1, nh_loc, hd)
-        k = (h @ p["wk"]).reshape(b, 1, nkv_loc, hd)
-        v = (h @ p["wv"]).reshape(b, 1, nkv_loc, hd)
+        q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
+        if "bq" in p:  # Qwen2-style projection biases
+            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+        q = q.reshape(b, 1, nh_loc, hd)
+        k = k.reshape(b, 1, nkv_loc, hd)
+        v = v.reshape(b, 1, nkv_loc, hd)
         q = _rope(q, cfg.rope_theta, pos)
         k = _rope(k, cfg.rope_theta, pos)
         slot = jnp.mod(pos, ck.shape[1]) if ring else pos
@@ -451,9 +454,12 @@ def prefill(
         nh_loc = p["wq"].shape[1] // hd
         nkv_loc = p["wk"].shape[1] // hd
         h = _rms(x, p["ln1"], cfg.norm_eps)
-        q = (h @ p["wq"]).reshape(b, s, nh_loc, hd)
-        k = (h @ p["wk"]).reshape(b, s, nkv_loc, hd)
-        v = (h @ p["wv"]).reshape(b, s, nkv_loc, hd)
+        q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
+        if "bq" in p:  # Qwen2-style projection biases
+            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+        q = q.reshape(b, s, nh_loc, hd)
+        k = k.reshape(b, s, nkv_loc, hd)
+        v = v.reshape(b, s, nkv_loc, hd)
         q = _rope(q, cfg.rope_theta, 0)
         k = _rope(k, cfg.rope_theta, 0)
         attn = _attend_full(q, k, v, cfg.attn_window, use_flash)

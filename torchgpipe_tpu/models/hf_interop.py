@@ -59,6 +59,10 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
         tie_embeddings=bool(
             getattr(hf_config, "tie_word_embeddings", False)
         ),
+        # LlamaConfig.attention_bias; Qwen2 hardcodes q/k/v biases with
+        # no config attribute — from_hf_qwen2 flips this from the state
+        # dict instead.
+        attn_bias=bool(getattr(hf_config, "attention_bias", False)),
     )
     if cfg.mlp_hidden != inter:
         raise ValueError(
@@ -109,10 +113,37 @@ def _torch_v(a: jnp.ndarray) -> Any:
     return _torch_cast(a)
 
 
-def _attn_entries(sd: Dict[str, Any], p: str) -> Dict[str, jnp.ndarray]:
+def _check_bias_consistency(
+    sd: Dict[str, Any], cfg: TransformerConfig
+) -> None:
+    """``cfg.attn_bias`` must agree with the checkpoint: a silent
+    mismatch would either drop trained biases or leave a params tree the
+    engines' specs (gated on the cfg) don't cover."""
+    has = "model.layers.0.self_attn.q_proj.bias" in sd
+    if has and not cfg.attn_bias:
+        raise ValueError(
+            "this checkpoint carries q/k/v projection biases but "
+            "cfg.attn_bias is False — import Qwen2-family models with "
+            "from_hf_qwen2 (which detects them), or set "
+            "TransformerConfig(attn_bias=True)"
+        )
+    if cfg.attn_bias and not has:
+        raise ValueError(
+            "cfg.attn_bias=True but the checkpoint has no q/k/v "
+            "projection biases"
+        )
+
+
+def _attn_entries(
+    sd: Dict[str, Any], p: str, cfg: TransformerConfig
+) -> Dict[str, jnp.ndarray]:
     """The per-block attention + norm mapping shared by the Llama and
-    Mixtral importers (identical layouts; only the MLP differs)."""
-    return {
+    Mixtral importers (identical layouts; only the MLP differs).
+    Q/K/V biases (Llama ``attention_bias`` / the always-biased Qwen2
+    family) map to ``bq/bk/bv`` under ``cfg.attn_bias`` — the same gate
+    ``transformer_block`` inits and shards by, kept consistent with the
+    checkpoint by ``_check_bias_consistency``."""
+    out = {
         "ln1": _v(sd[p + "input_layernorm.weight"]),
         "wq": _t(sd[p + "self_attn.q_proj.weight"]),
         "wk": _t(sd[p + "self_attn.k_proj.weight"]),
@@ -120,6 +151,11 @@ def _attn_entries(sd: Dict[str, Any], p: str) -> Dict[str, jnp.ndarray]:
         "wo": _t(sd[p + "self_attn.o_proj.weight"]),
         "ln2": _v(sd[p + "post_attention_layernorm.weight"]),
     }
+    if cfg.attn_bias:
+        out["bq"] = _v(sd[p + "self_attn.q_proj.bias"])
+        out["bk"] = _v(sd[p + "self_attn.k_proj.bias"])
+        out["bv"] = _v(sd[p + "self_attn.v_proj.bias"])
+    return out
 
 
 def _head_entry(
@@ -153,12 +189,13 @@ def params_from_hf(
             "params_from_hf_mixtral (imports into the llama_moe family); "
             "this importer covers the dense Llama family"
         )
+    _check_bias_consistency(state_dict, cfg)
     sd = state_dict
     out: List[Pytree] = [{"table": _v(sd["model.embed_tokens.weight"])}]
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
         out.append({
-            **_attn_entries(sd, p),
+            **_attn_entries(sd, p, cfg),
             "w_gate": _t(sd[p + "mlp.gate_proj.weight"]),
             "w_up": _t(sd[p + "mlp.up_proj.weight"]),
             "w_down": _t(sd[p + "mlp.down_proj.weight"]),
@@ -214,7 +251,58 @@ def _export_common(
         sd[p + "self_attn.v_proj.weight"] = t(bp["wv"])
         sd[p + "self_attn.o_proj.weight"] = t(bp["wo"])
         sd[p + "post_attention_layernorm.weight"] = v(bp["ln2"])
+        if "bq" in bp:
+            sd[p + "self_attn.q_proj.bias"] = v(bp["bq"])
+            sd[p + "self_attn.k_proj.bias"] = v(bp["bk"])
+            sd[p + "self_attn.v_proj.bias"] = v(bp["bv"])
     return sd, blocks
+
+
+def from_hf_qwen2(model: Any, *, untie: bool = False) -> tuple:
+    """(cfg, per-layer params) from a live HF ``Qwen2ForCausalLM``.
+
+    The Qwen2 family is the Llama layout plus always-on q/k/v projection
+    biases (hardcoded in the HF implementation, no config attribute) and
+    an optional sliding window — both detected here and mapped onto
+    ``attn_bias`` / ``attn_window``.  Everything else (RMSNorm, SwiGLU,
+    rotary, GQA, tying) flows through the Llama importer unchanged.
+
+    Window caveat: HF Qwen2 windows only the layers past
+    ``max_window_layers`` (``config.layer_types``); this framework's
+    ``attn_window`` is model-global, so the mapping is applied only when
+    EVERY layer is windowed and a mixed layout is rejected rather than
+    silently diverging at long sequences."""
+    import dataclasses
+
+    hfc = model.config
+    cfg = config_from_hf(hfc)
+    sd = model.state_dict()
+    if "model.layers.0.self_attn.q_proj.bias" in sd and not cfg.attn_bias:
+        cfg = dataclasses.replace(cfg, attn_bias=True)
+    if getattr(hfc, "use_sliding_window", False) and getattr(
+        hfc, "sliding_window", None
+    ):
+        types = list(
+            getattr(hfc, "layer_types", None)
+            or ["sliding_attention"] * cfg.n_layers
+        )
+        if all(t == "sliding_attention" for t in types):
+            cfg = dataclasses.replace(
+                cfg, attn_window=int(hfc.sliding_window)
+            )
+        elif any(t == "sliding_attention" for t in types):
+            raise ValueError(
+                "this Qwen2 checkpoint mixes full-attention and "
+                f"sliding-window layers (max_window_layers="
+                f"{getattr(hfc, 'max_window_layers', '?')}); "
+                "attn_window is model-global here, so importing it "
+                "would silently diverge from HF at sequences past the "
+                "window — per-layer windows are not supported"
+            )
+        # else: every layer is full attention — nothing to map.
+    if untie and cfg.tie_embeddings:
+        cfg = dataclasses.replace(cfg, tie_embeddings=False)
+    return cfg, params_from_hf(sd, cfg)
 
 
 def state_dict_to_hf(
@@ -241,6 +329,7 @@ __all__ = [
     "params_from_hf_mixtral",
     "from_hf_llama",
     "from_hf_mixtral",
+    "from_hf_qwen2",
     "state_dict_to_hf",
     "state_dict_to_hf_mixtral",
 ]
@@ -295,6 +384,7 @@ def params_from_hf_mixtral(
     (f32, matching the framework's f32 routing); per-expert ``w1/w3/w2``
     → stacked ``w_gate/w_up [E, dim, hidden]`` / ``w_down [E, hidden,
     dim]`` (same SwiGLU: ``silu(x@w_gate) * (x@w_up) @ w_down``)."""
+    _check_bias_consistency(state_dict, cfg)
     sd = state_dict
     out: List[Pytree] = [{"table": _v(sd["model.embed_tokens.weight"])}]
     for i in range(cfg.n_layers):
@@ -315,7 +405,7 @@ def params_from_hf_mixtral(
                 for x in range(moe.n_experts)
             ]),
         }
-        out.append({**_attn_entries(sd, p), "mlp": mlp})
+        out.append({**_attn_entries(sd, p, cfg), "mlp": mlp})
     out.append(_head_entry(sd, cfg, out[0]))
     return out
 

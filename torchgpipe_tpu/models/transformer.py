@@ -68,6 +68,10 @@ class TransformerConfig:
     # size must divide n_heads, kv_heads and mlp_hidden (the engine checks
     # against the actual mesh at init).
     tp_axis: Optional[str] = None
+    # Qwen2-style additive biases on the q/k/v projections (params
+    # bq/bk/bv; wo stays bias-free, matching that family).  Composes with
+    # tp (biases shard with their head dim).
+    attn_bias: bool = False
     # GPT-2/Gemma-style weight tying: the lm head reuses the embedding
     # table (logits = h @ table.T) instead of owning a separate ``w``.
     # The classic pipeline-parallel pain point — the two uses live on
@@ -177,6 +181,12 @@ def transformer_block(
             "wo": _normal(ks[3], (nh * hd, dim), std, dt),
             "ln2": jnp.ones((dim,)),
         }
+        if cfg.attn_bias:
+            params.update(
+                bq=jnp.zeros((nh * hd,), dt),
+                bk=jnp.zeros((nkv * hd,), dt),
+                bv=jnp.zeros((nkv * hd,), dt),
+            )
         if mlp is None:
             params.update(
                 w_gate=_normal(ks[4], (dim, hidden), std, dt),
@@ -214,9 +224,12 @@ def transformer_block(
         h = _rms(x, params["ln1"], cfg.norm_eps)
         if tp_active:
             h = psum_grad(h, cfg.tp_axis)  # region entry: full grad upstream
-        q = (h @ params["wq"]).reshape(b, s, nh_loc, hd)
-        k = (h @ params["wk"]).reshape(b, s, nkv_loc, hd)
-        v = (h @ params["wv"]).reshape(b, s, nkv_loc, hd)
+        q, k, v = h @ params["wq"], h @ params["wk"], h @ params["wv"]
+        if "bq" in params:  # Qwen2-style projection biases
+            q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
+        q = q.reshape(b, s, nh_loc, hd)
+        k = k.reshape(b, s, nkv_loc, hd)
+        v = v.reshape(b, s, nkv_loc, hd)
         q = _rope(q, cfg.rope_theta, pos_offset)
         k = _rope(k, cfg.rope_theta, pos_offset)
         # GQA: K/V stay at n_kv heads — the attention kernel groups queries
@@ -317,6 +330,10 @@ def transformer_block(
             "wo": P() if tp is None else P(tp, None),
             "ln2": P(),
         }
+        if cfg.attn_bias:
+            # Biases shard with their projection's output (head) dim.
+            bias_spec = P() if tp is None else P(tp)
+            param_specs.update(bq=bias_spec, bk=bias_spec, bv=bias_spec)
         if mlp is None:
             param_specs.update(
                 w_gate=P(None, tp),
