@@ -510,3 +510,29 @@ def test_bias_mismatch_and_mixed_window_rejected():
         # transformers version without mixed layer_types: import works
         # and maps (or ignores) the window uniformly.
         from_hf_qwen2(m2)
+
+
+def test_mistral_sliding_window_imported():
+    """MistralForCausalLM (Llama layout + always-on sliding window, no
+    max_window_layers gate): from_hf_llama maps the window and logits
+    match HF at a sequence longer than it."""
+    cfg_hf = transformers.MistralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=3, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    m = transformers.MistralForCausalLM(cfg_hf).eval()
+    cfg, params = from_hf_llama(m)
+    assert cfg.attn_window == 3
+    b, s = 2, 7  # s > window
+    tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+    out, _ = sequential_apply(
+        llama(cfg), params, [() for _ in range(cfg.n_layers + 2)],
+        jnp.asarray(tokens, jnp.int32), rng=None, train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )

@@ -16,7 +16,8 @@ Conventions verified against ``transformers`` (tested numerically in
 * ``RMSNorm`` math (f32 accumulation, eps inside rsqrt) matches.
 
 f32/bf16 Llama-family checkpoints are covered (no fused/quantized HF
-layouts).  MoE: ``from_hf_mixtral`` imports ``MixtralForCausalLM`` into
+layouts), including Mistral (always-on sliding window -> ``attn_window``)
+and — via :func:`from_hf_qwen2` — the Qwen2 family (q/k/v biases).  MoE: ``from_hf_mixtral`` imports ``MixtralForCausalLM`` into
 the ``llama_moe`` family (dropless dispatch; HF's renormalized top-k is
 exactly the GShard gate normalization for k >= 2 — logits and greedy
 decode match the live HF model in CI).
@@ -63,6 +64,18 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
         # no config attribute — from_hf_qwen2 flips this from the state
         # dict instead.
         attn_bias=bool(getattr(hf_config, "attention_bias", False)),
+        # Mistral-class configs carry sliding_window (default 4096, every
+        # layer windowed, no max_window_layers) — ignoring it would
+        # silently diverge from HF past the window.  HF masks keys with
+        # q - k >= sliding_window, exactly this attn_window band (attend
+        # iff 0 <= q - k < window).  Qwen2's gated per-layer variant is
+        # handled by from_hf_qwen2 instead.
+        attn_window=(
+            int(hf_config.sliding_window)
+            if getattr(hf_config, "sliding_window", None)
+            and not hasattr(hf_config, "max_window_layers")
+            else None
+        ),
     )
     if cfg.mlp_hidden != inter:
         raise ValueError(
@@ -356,15 +369,10 @@ def config_from_hf_mixtral(hf_config: Any) -> tuple:
             "framework keeps the Switch-style raw probability — the "
             "models would silently disagree"
         )
+    # config_from_hf maps sliding_window -> attn_window for
+    # Mistral-class configs (MixtralConfig included: sliding window on
+    # every layer, no max_window_layers gate).
     cfg = config_from_hf(hf_config)
-    sw = getattr(hf_config, "sliding_window", None)
-    if sw:
-        # Mistral-style local attention: HF masks keys with
-        # q - k >= sliding_window, exactly this framework's
-        # ``attn_window`` band (attend iff 0 <= q - k < window).
-        import dataclasses
-
-        cfg = dataclasses.replace(cfg, attn_window=int(sw))
     moe = MoEConfig(
         n_experts=int(hf_config.num_local_experts),
         top_k=k,
