@@ -650,3 +650,30 @@ def test_simulate_pipeline_zb_uniform_cells():
     assert zb_mk < f1_mk, (zb_mk, f1_mk)
     assert zb_mk >= 2 * m * t - 1e-9  # work floor per stage
     assert 0.0 < zb_busy <= 1.0
+
+
+def test_recommend_schedule_on_real_engine_timeline():
+    """End-to-end: a sync Timeline traced from a real pipelined training
+    step feeds recommend_schedule — all three same-device schedules rank
+    with finite makespans and valid busy fractions."""
+    from torchgpipe_tpu.ops.nn import dense, relu
+    from torchgpipe_tpu.layers import named
+    from torchgpipe_tpu.utils.tracing import recommend_schedule
+
+    layers = named([dense(16), relu(), dense(16), relu()])
+    tracer = Timeline(sync=True)
+    model = GPipe(layers, balance=[2, 2], chunks=4, tracer=tracer)
+    spec = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    params, state = model.init(jax.random.PRNGKey(0), spec)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    y = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+    model.value_and_grad(
+        params, state, x, y, lambda o, t: jnp.mean((o - t) ** 2)
+    )
+    rows = recommend_schedule(tracer.events, n_stages=2)
+    assert {r.schedule for r in rows if r.devices == 2} == {
+        "fill_drain", "1f1b", "zb"
+    }
+    for r in rows:
+        assert np.isfinite(r.makespan) and r.makespan > 0
+        assert 0.0 < r.busy <= 1.0
