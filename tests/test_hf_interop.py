@@ -19,7 +19,10 @@ from torchgpipe_tpu.models.hf_interop import (  # noqa: E402
     config_from_hf,
     from_hf_llama,
 )
-from torchgpipe_tpu.models.transformer import llama  # noqa: E402
+from torchgpipe_tpu.models.transformer import (  # noqa: E402
+    cross_entropy as cross_entropy_,
+    llama,
+)
 
 
 def _hf_model(nkv=2):
@@ -526,6 +529,182 @@ def test_mistral_sliding_window_imported():
     cfg, params = from_hf_llama(m)
     assert cfg.attn_window == 3
     b, s = 2, 7  # s > window
+    tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+    out, _ = sequential_apply(
+        llama(cfg), params, [() for _ in range(cfg.n_layers + 2)],
+        jnp.asarray(tokens, jnp.int32), rng=None, train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )
+
+
+def _gemma_model():
+    cfg_hf = transformers.GemmaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    return transformers.GemmaForCausalLM(cfg_hf).eval(), cfg_hf
+
+
+def test_gemma_decode_and_spmd_logits_match_hf(cpu_devices):
+    """Gemma-1 import (explicit head_dim, GeGLU, sqrt(dim) embedding
+    scale, (1+w) norms folded into scales, always-tied head): greedy
+    decode matches the live GemmaForCausalLM, and the SPMD engine's
+    apply (the tie-capable training path) reproduces its logits."""
+    from torchgpipe_tpu.models.hf_interop import from_hf_gemma
+    from torchgpipe_tpu.models.transformer import llama_spmd
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    m, cfg_hf = _gemma_model()
+    cfg, params = from_hf_gemma(m)
+    assert cfg.n_head_dim == 16 and cfg.act == "gelu_tanh"
+    assert cfg.tie_embeddings and "w" not in params[-1]
+
+    b, s = 2, 7
+    tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+
+    ours = np.asarray(generate(
+        cfg, params, jnp.asarray(tokens, jnp.int32), max_new_tokens=3,
+    ))
+    with torch.no_grad():
+        hf = m.generate(
+            torch.tensor(tokens), max_new_tokens=3, do_sample=False,
+        ).numpy()[:, s:]
+    assert (ours == hf).all(), (ours, hf)
+
+    # SPMD engine logits (pipe the two blocks over pp=2).
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy_,
+                     pre=pre, post=post)
+    placed = pipe.place({
+        "pre": params[0],
+        "blocks": jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[(bp,) for bp in params[1:-1]]
+        ),
+        "post": params[-1],
+    })
+    out = pipe.apply(placed, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_gemma_roundtrip_and_rejections():
+    """Export shifts the norm scales back to HF's (1+w) convention and
+    strict-loads into a live Gemma with logits unchanged; Gemma-2 class
+    configs are rejected."""
+    from torchgpipe_tpu.models.hf_interop import (
+        from_hf_gemma,
+        state_dict_to_hf,
+    )
+
+    m, cfg_hf = _gemma_model()
+    cfg, params = from_hf_gemma(m)
+    sd = state_dict_to_hf(params, cfg)
+    assert "lm_head.weight" not in sd  # tied
+    m2 = transformers.GemmaForCausalLM(cfg_hf)
+    missing, unexpected = m2.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    m2.tie_weights()
+    b, s = 2, 6
+    tokens = torch.tensor(np.arange(b * s).reshape(b, s) % cfg.vocab)
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            m2(tokens).logits.numpy(), m(tokens).logits.numpy(),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    if hasattr(transformers, "Gemma2ForCausalLM"):
+        g2 = transformers.Gemma2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=128,
+            num_hidden_layers=1, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16,
+        )
+        torch.manual_seed(0)
+        with pytest.raises(ValueError, match="Gemma-1"):
+            from_hf_gemma(transformers.Gemma2ForCausalLM(g2))
+
+
+def test_gemma_untie_and_exact_gelu_rejection():
+    from torchgpipe_tpu.models.hf_interop import from_hf_gemma
+
+    m, _ = _gemma_model()
+    cfg, params = from_hf_gemma(m, untie=True)
+    assert not cfg.tie_embeddings and "w" in params[-1]
+    # Untied import runs the MPMD flat path end-to-end.
+    b, s = 2, 6
+    tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+    out, _ = sequential_apply(
+        llama(cfg), params, [() for _ in range(cfg.n_layers + 2)],
+        jnp.asarray(tokens, jnp.int32), rng=None, train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )
+
+    bad = transformers.GemmaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, hidden_activation="gelu",
+    )
+    torch.manual_seed(0)
+    with pytest.raises(ValueError, match="tanh-approximate"):
+        from_hf_gemma(transformers.GemmaForCausalLM(bad))
+
+
+def test_gemma_bf16_norm_fold_keeps_precision():
+    """bf16 Gemma checkpoints fold (1+w) in f32: tiny w must survive the
+    import (bf16 near 1.0 would quantize |w| < ~2^-8 away) and export
+    back exactly."""
+    from torchgpipe_tpu.models.hf_interop import (
+        from_hf_gemma,
+        state_dict_to_hf,
+    )
+
+    m, _ = _gemma_model()
+    m = m.to(torch.bfloat16)
+    with torch.no_grad():
+        # Gemma stores w (scale = 1 + w); make one entry tiny but nonzero.
+        m.model.layers[0].input_layernorm.weight.fill_(0.001)
+    cfg, params = from_hf_gemma(m)
+    assert params[1]["ln1"].dtype == jnp.float32
+    # f32 fold keeps the tiny shift (1.001 != 1.0 in f32; bf16 would
+    # collapse it).
+    assert float(jnp.max(jnp.abs(params[1]["ln1"] - 1.0))) > 5e-4
+    sd = state_dict_to_hf(params, cfg)
+    w = sd["model.layers.0.input_layernorm.weight"]
+    assert w.dtype == torch.bfloat16
+    np.testing.assert_allclose(
+        w.to(torch.float32).numpy(),
+        np.full((cfg.dim,), 0.001, np.float32),
+        rtol=1e-2,
+    )
+
+
+def test_llama_explicit_head_dim_imported():
+    """A LlamaConfig pinning head_dim != dim//n_heads imports via
+    n_head_dim with logits matching the live model (modern HF attention
+    honors the explicit head_dim)."""
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    m = transformers.LlamaForCausalLM(cfg_hf).eval()
+    cfg, params = from_hf_llama(m)
+    assert cfg.n_head_dim == 16 and cfg.head_dim == 16
+    b, s = 2, 7
     tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
     with torch.no_grad():
         ref = m(torch.tensor(tokens)).logits.numpy()

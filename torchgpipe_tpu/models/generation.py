@@ -64,6 +64,7 @@ from jax import lax
 
 from torchgpipe_tpu.models.transformer import (
     TransformerConfig,
+    _act_fn,
     _head_w,
     _rms,
     _rope,
@@ -133,6 +134,17 @@ def init_quant_cache(
         v_scale=[jnp.zeros(sshape, jnp.float32) for _ in range(cfg.n_layers)],
         length=jnp.zeros((), jnp.int32),
     )
+
+
+def _embed(cfg: TransformerConfig, embed_p: Pytree,
+           tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding with the optional Gemma-style output scaling (the
+    tied head reads the UNSCALED table, so the scale lives here, not in
+    the table) — mirrors token_embedding.apply."""
+    x = jnp.take(embed_p["table"], tokens, axis=0)
+    if cfg.embed_scale is not None:
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+    return x
 
 
 def _split_params(cfg: TransformerConfig, params: Pytree) -> Tuple:
@@ -315,7 +327,7 @@ def _mlp_out(cfg: TransformerConfig, p: Pytree, h: jnp.ndarray,
             )
         out, _ = mlp_layer.apply(p["mlp"], (), h, rng=None, train=False)
         return out.astype(h.dtype)
-    gate = jax.nn.silu(h @ p["w_gate"])
+    gate = _act_fn(cfg.act)(h @ p["w_gate"])
     up = h @ p["w_up"]
     return (gate * up) @ p["w_down"]
 
@@ -423,7 +435,7 @@ def prefill(
     )
     hd = cfg.head_dim
     mlp_layer = _mlp_layer_for(cfg, moe)
-    x = jnp.take(embed_p["table"], tokens, axis=0)
+    x = _embed(cfg, embed_p, tokens)
     new_k, new_v = [], []
     new_ks, new_vs = [], []
 
@@ -567,7 +579,7 @@ def generate(
         # Continuation: absorb this turn's tokens through the decode
         # path (teacher-forced) — exact for every cache layout.
         def absorb(cache, tok):
-            x = jnp.take(embed_p["table"], tok[:, None], axis=0)
+            x = _embed(cfg, embed_p, tok[:, None])
             x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer, ring)
             return cache, _logits(cfg, head_p, x)[:, 0]
 
@@ -581,7 +593,7 @@ def generate(
         if eos_id is not None:
             tok = jnp.where(alive, tok, eos_id)
             alive = alive & (tok != eos_id)
-        x = jnp.take(embed_p["table"], tok[:, None], axis=0)
+        x = _embed(cfg, embed_p, tok[:, None])
         x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer, ring)
         return (cache, _logits(cfg, head_p, x)[:, 0], key, alive), tok
 
@@ -638,7 +650,7 @@ def beam_search(
     )
 
     def flat_decode(cache, tok):
-        x = jnp.take(embed_p["table"], tok.reshape(b * k, 1), axis=0)
+        x = _embed(cfg, embed_p, tok.reshape(b * k, 1))
         x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer)
         return cache, _logits(cfg, head_p, x)[:, 0]       # [b*k, V]
 

@@ -17,7 +17,9 @@ Conventions verified against ``transformers`` (tested numerically in
 
 f32/bf16 Llama-family checkpoints are covered (no fused/quantized HF
 layouts), including Mistral (always-on sliding window -> ``attn_window``)
-and — via :func:`from_hf_qwen2` — the Qwen2 family (q/k/v biases).  MoE: ``from_hf_mixtral`` imports ``MixtralForCausalLM`` into
+and — via :func:`from_hf_qwen2` / :func:`from_hf_gemma` — the Qwen2
+family (q/k/v biases) and Gemma 1 (explicit head_dim, GeGLU, scaled
+embeddings, (1+w) norms folded into scales, always-tied head).  MoE: ``from_hf_mixtral`` imports ``MixtralForCausalLM`` into
 the ``llama_moe`` family (dropless dispatch; HF's renormalized top-k is
 exactly the GShard gate normalization for k >= 2 — logits and greedy
 decode match the live HF model in CI).
@@ -76,6 +78,16 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
             and not hasattr(hf_config, "max_window_layers")
             else None
         ),
+        # Modern HF configs may pin head_dim explicitly (and the HF
+        # attention honors it); silently deriving dim//n_heads would
+        # mis-shape the heads with no error when the sizes still divide.
+        n_head_dim=(
+            int(hf_config.head_dim)
+            if getattr(hf_config, "head_dim", None)
+            and int(hf_config.head_dim)
+            != dim // hf_config.num_attention_heads
+            else None
+        ),
     )
     if cfg.mlp_hidden != inter:
         raise ValueError(
@@ -87,19 +99,30 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
     return cfg
 
 
-def _t(w: Any) -> jnp.ndarray:
-    """torch [out, in] -> jnp [in, out]."""
+def _from_torch(w: Any) -> jnp.ndarray:
+    """torch/array-like -> jnp, dtype-faithful.
+
+    torch cannot hand numpy a bf16 array, so bf16 tensors bridge through
+    f32 (lossless) and land as jnp.bfloat16 — published bf16 checkpoints
+    import at their own width, matching the export side's
+    ``_torch_cast``."""
     import numpy as np
 
-    arr = w.detach().cpu().numpy() if hasattr(w, "detach") else np.asarray(w)
-    return jnp.asarray(arr).T
+    if hasattr(w, "detach"):
+        w = w.detach().cpu()
+        if str(w.dtype) == "torch.bfloat16":
+            return jnp.asarray(w.float().numpy(), jnp.bfloat16)
+        return jnp.asarray(w.numpy())
+    return jnp.asarray(np.asarray(w))
+
+
+def _t(w: Any) -> jnp.ndarray:
+    """torch [out, in] -> jnp [in, out]."""
+    return _from_torch(w).T
 
 
 def _v(w: Any) -> jnp.ndarray:
-    import numpy as np
-
-    arr = w.detach().cpu().numpy() if hasattr(w, "detach") else np.asarray(w)
-    return jnp.asarray(arr)
+    return _from_torch(w)
 
 
 def _torch_cast(a: jnp.ndarray) -> Any:
@@ -318,13 +341,103 @@ def from_hf_qwen2(model: Any, *, untie: bool = False) -> tuple:
     return cfg, params_from_hf(sd, cfg)
 
 
+def from_hf_gemma(model: Any, *, untie: bool = False) -> tuple:
+    """(cfg, per-layer params) from a live HF ``GemmaForCausalLM``
+    (Gemma 1).
+
+    Gemma differences, each mapped onto an existing config knob:
+
+    * explicit ``head_dim`` (n_heads*head_dim != dim on the 7B) ->
+      ``n_head_dim``;
+    * GeGLU feed-forward -> ``act='gelu_tanh'``;
+    * embeddings scaled by sqrt(dim) -> ``embed_scale`` (the tied head
+      reads the unscaled table, as HF does);
+    * RMSNorm computes ``x_norm * (1 + w)`` -> folded into the stored
+      scales at import (``scale = 1 + w``; fresh-init equivalence holds:
+      this framework inits scales to 1, Gemma inits w to 0) and
+      subtracted back by :func:`state_dict_to_hf` under
+      ``cfg.act == 'gelu_tanh'``;
+    * always-tied head -> the native tie.
+
+    Gemma-2/3 (attention softcapping, pre+post block norms, alternating
+    windows) are NOT this layout and are rejected, as are checkpoints
+    configured with EXACT gelu (``hidden_activation='gelu'``) — this
+    family computes the tanh approximation only, and a silent substitute
+    would drift.  ``untie=True`` imports an untied copy (head
+    ``w = table.T``) for the MPMD ``GPipe(llama(cfg))`` path, like the
+    sibling importers."""
+    import dataclasses
+    import math
+
+    hfc = model.config
+    if type(hfc).__name__ not in ("GemmaConfig",):
+        raise ValueError(
+            f"from_hf_gemma supports the Gemma-1 layout (GemmaConfig); "
+            f"got {type(hfc).__name__} — Gemma-2/3 add softcapping and "
+            "post-block norms this model family does not compute"
+        )
+    act_attr = getattr(hfc, "hidden_activation", None) or getattr(
+        hfc, "hidden_act", None
+    )
+    if act_attr not in (None, "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"this Gemma checkpoint is configured with "
+            f"hidden_activation={act_attr!r}; only the tanh-approximate "
+            "gelu ('gelu_pytorch_tanh', the published Gemma convention) "
+            "is computed here — a silent substitute would drift"
+        )
+    cfg = config_from_hf(hfc)
+    cfg = dataclasses.replace(
+        cfg,
+        n_head_dim=int(hfc.head_dim),
+        act="gelu_tanh",
+        embed_scale=math.sqrt(hfc.hidden_size),
+        tie_embeddings=not untie,  # Gemma always ties; untie for MPMD
+    )
+    params = params_from_hf(model.state_dict(), cfg)
+    return cfg, _fold_gemma_norms(params, 1.0)
+
+
+def _fold_gemma_norms(
+    params: List[Pytree], sign: float, dtype: Any = jnp.float32
+) -> List[Pytree]:
+    """Shift every RMSNorm scale by ``sign`` (+1 on import: Gemma stores
+    ``w`` with ``x_norm * (1 + w)``; -1 on export).
+
+    Always computed and (by default) STORED in f32: HF's GemmaRMSNorm
+    evaluates ``1 + w.float()`` in f32 at runtime, so folding a bf16
+    ``w`` into a bf16 scale would quantize away any ``|w| < ~2^-8``
+    (bf16's resolution near 1.0).  f32 norm scales are also this
+    framework's own precision-policy convention.  The export path passes
+    the checkpoint's dtype so ``w = scale - 1`` goes back at the
+    original width."""
+    shift = lambda a: (  # noqa: E731
+        a.astype(jnp.float32) + jnp.float32(sign)
+    ).astype(dtype)
+    out = [params[0]]
+    for bp in params[1:-1]:
+        bp = dict(bp, ln1=shift(bp["ln1"]), ln2=shift(bp["ln2"]))
+        out.append(bp)
+    head = dict(params[-1])
+    head["scale"] = shift(head["scale"])
+    out.append(head)
+    return out
+
+
 def state_dict_to_hf(
     params: List[Pytree], cfg: TransformerConfig
 ) -> Dict[str, Any]:
     """The inverse map: ``llama(cfg)`` per-layer params -> an HF
     ``LlamaForCausalLM`` state dict (torch tensors) — train here,
     publish to the HF ecosystem.  Exact inverse of
-    :func:`params_from_hf` (round-trip tested)."""
+    :func:`params_from_hf` (round-trip tested; Gemma-family params —
+    ``cfg.act == 'gelu_tanh'`` — get their norm scales shifted back to
+    HF's ``1 + w`` convention)."""
+    if cfg.act == "gelu_tanh":
+        # w = scale - 1 back at the checkpoint's uniform dtype.
+        params = _fold_gemma_norms(
+            params, -1.0, dtype=params[0]["table"].dtype
+        )
     t = _torch_t
     sd, blocks = _export_common(params, cfg)
     for i, bp in enumerate(blocks):
@@ -340,6 +453,7 @@ __all__ = [
     "config_from_hf_mixtral",
     "params_from_hf",
     "params_from_hf_mixtral",
+    "from_hf_gemma",
     "from_hf_llama",
     "from_hf_mixtral",
     "from_hf_qwen2",

@@ -72,6 +72,17 @@ class TransformerConfig:
     # bq/bk/bv; wo stays bias-free, matching that family).  Composes with
     # tp (biases shard with their head dim).
     attn_bias: bool = False
+    # Explicit per-head dimension (Gemma/Qwen3-class checkpoints where
+    # n_heads * head_dim != dim; the attention output projection maps
+    # n_heads*head_dim back to dim).  None -> dim // n_heads.
+    n_head_dim: Optional[int] = None
+    # Feed-forward gate activation: 'silu' (Llama-family SwiGLU) or
+    # 'gelu_tanh' (Gemma-family GeGLU).
+    act: str = "silu"
+    # Multiply embedding outputs by this factor (Gemma scales by
+    # sqrt(dim); the TIED head still reads the unscaled table, matching
+    # that family).  None -> no scaling.
+    embed_scale: Optional[float] = None
     # GPT-2/Gemma-style weight tying: the lm head reuses the embedding
     # table (logits = h @ table.T) instead of owning a separate ``w``.
     # The classic pipeline-parallel pain point — the two uses live on
@@ -90,7 +101,7 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.n_head_dim or self.dim // self.n_heads
 
     @property
     def mlp_hidden(self) -> int:
@@ -113,6 +124,15 @@ def _rms(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
     y = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
     return y * scale.astype(x.dtype)
+
+
+def _act_fn(act: str) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Feed-forward gate activation by config name."""
+    if act == "silu":
+        return jax.nn.silu
+    if act == "gelu_tanh":
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown act {act!r}: expected 'silu' or 'gelu_tanh'")
 
 
 def rms_norm(dim: int, *, eps: float = 1e-5, name: str = "rmsnorm") -> Layer:
@@ -251,7 +271,7 @@ def transformer_block(
         else:
             if tp_active:
                 h = psum_grad(h, cfg.tp_axis)
-            gate = jax.nn.silu(h @ params["w_gate"])
+            gate = _act_fn(cfg.act)(h @ params["w_gate"])
             up = h @ params["w_up"]
             mlp_out = (gate * up) @ params["w_down"]
             if tp_active:
@@ -417,8 +437,14 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
             rows = jnp.where(
                 in_range[..., None], jnp.take(table, idx, axis=0), 0
             )
-            return psum_value(rows, cfg.tp_axis), state
-        return jnp.take(table, x, axis=0), state
+            out = psum_value(rows, cfg.tp_axis)
+        else:
+            out = jnp.take(table, x, axis=0)
+        if cfg.embed_scale is not None:
+            # Gemma-style sqrt(dim) scaling; a TIED head still reads the
+            # UNSCALED table (matching that family).
+            out = out * jnp.asarray(cfg.embed_scale, out.dtype)
+        return out, state
 
     tp = cfg.tp_axis
     meta = _vocab_meta(cfg, {"table": P(tp)})
