@@ -715,3 +715,104 @@ def test_llama_explicit_head_dim_imported():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
     )
+
+
+def test_qwen3_logits_decode_roundtrip():
+    """Qwen3 import (per-head q/k RMSNorm + explicit head_dim + tie):
+    logits and greedy decode match the live Qwen3ForCausalLM; the export
+    round-trips the q/k norm weights."""
+    from torchgpipe_tpu.models.hf_interop import (
+        from_hf_qwen3,
+        state_dict_to_hf,
+    )
+
+    if not hasattr(transformers, "Qwen3ForCausalLM"):
+        pytest.skip("transformers too old for Qwen3")
+    cfg_hf = transformers.Qwen3Config(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, tie_word_embeddings=True, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    m = transformers.Qwen3ForCausalLM(cfg_hf).eval()
+    cfg, params = from_hf_qwen3(m)
+    assert cfg.qk_norm and cfg.n_head_dim == 16 and cfg.tie_embeddings
+    assert "qn" in params[1] and "w" not in params[-1]
+
+    b, s = 2, 7
+    tokens = np.arange(b * s).reshape(b, s) % cfg.vocab
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(generate(
+        cfg, params, jnp.asarray(tokens, jnp.int32), max_new_tokens=3,
+    ))
+    with torch.no_grad():
+        hf = m.generate(
+            torch.tensor(tokens), max_new_tokens=3, do_sample=False,
+        ).numpy()[:, s:]
+    assert (ours == hf).all(), (ours, hf)
+    # First-token parity doubles as a logits check through the tied head.
+    np.testing.assert_array_equal(ours[:, 0], ref[:, -1].argmax(-1))
+
+    sd = state_dict_to_hf(params, cfg)
+    assert "model.layers.0.self_attn.q_norm.weight" in sd
+    m2 = transformers.Qwen3ForCausalLM(cfg_hf)
+    missing, unexpected = m2.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    m2.tie_weights()
+    with torch.no_grad():
+        got = m2(torch.tensor(tokens)).logits.numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_qwen3_untied_trains_mpmd():
+    from torchgpipe_tpu.models.hf_interop import from_hf_qwen3
+    from torchgpipe_tpu.gpipe import GPipe
+    from torchgpipe_tpu.models.transformer import cross_entropy
+
+    if not hasattr(transformers, "Qwen3ForCausalLM"):
+        pytest.skip("transformers too old for Qwen3")
+    cfg_hf = transformers.Qwen3Config(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    m = transformers.Qwen3ForCausalLM(cfg_hf).eval()
+    cfg, flat = from_hf_qwen3(m, untie=True)
+    model = GPipe(llama(cfg), balance=[2, 2], chunks=2)
+    spec = jax.ShapeDtypeStruct((4, 8), jnp.int32)
+    params, state = model.init(jax.random.PRNGKey(0), spec)
+    it = iter(flat)
+    params = model.place(
+        tuple(tuple(next(it) for _ in stage) for stage in params)
+    )
+    x = jnp.asarray(np.arange(32).reshape(4, 8) % cfg.vocab, jnp.int32)
+    loss, grads, state, _ = model.value_and_grad(
+        params, state, x, x, cross_entropy
+    )
+    assert np.isfinite(float(loss))
+    # qk-norm weights receive gradients.
+    qn_grads = [
+        g["qn"] for st in grads for g in st
+        if isinstance(g, dict) and "qn" in g
+    ]
+    assert qn_grads and sum(
+        float(jnp.abs(g).sum()) for g in qn_grads
+    ) > 0
+
+
+def test_qwen3_through_wrong_importer_rejected():
+    if not hasattr(transformers, "Qwen3ForCausalLM"):
+        pytest.skip("transformers too old for Qwen3")
+    from torchgpipe_tpu.models.hf_interop import from_hf_qwen2
+
+    cfg_hf = transformers.Qwen3Config(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16,
+    )
+    torch.manual_seed(0)
+    m = transformers.Qwen3ForCausalLM(cfg_hf).eval()
+    with pytest.raises(ValueError, match="from_hf_qwen3"):
+        from_hf_qwen2(m)

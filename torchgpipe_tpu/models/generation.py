@@ -257,6 +257,9 @@ def _decode_step(
         q = q.reshape(b, 1, nh_loc, hd)
         k = k.reshape(b, 1, nkv_loc, hd)
         v = v.reshape(b, 1, nkv_loc, hd)
+        if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
+            q = _rms(q, p["qn"], cfg.norm_eps)
+            k = _rms(k, p["kn"], cfg.norm_eps)
         q = _rope(q, cfg.rope_theta, pos)
         k = _rope(k, cfg.rope_theta, pos)
         slot = jnp.mod(pos, ck.shape[1]) if ring else pos
@@ -472,6 +475,9 @@ def prefill(
         q = q.reshape(b, s, nh_loc, hd)
         k = k.reshape(b, s, nkv_loc, hd)
         v = v.reshape(b, s, nkv_loc, hd)
+        if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
+            q = _rms(q, p["qn"], cfg.norm_eps)
+            k = _rms(k, p["kn"], cfg.norm_eps)
         q = _rope(q, cfg.rope_theta, 0)
         k = _rope(k, cfg.rope_theta, 0)
         attn = _attend_full(q, k, v, cfg.attn_window, use_flash)

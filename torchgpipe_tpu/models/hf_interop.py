@@ -149,12 +149,13 @@ def _torch_v(a: jnp.ndarray) -> Any:
     return _torch_cast(a)
 
 
-def _check_bias_consistency(
+def _check_attn_param_consistency(
     sd: Dict[str, Any], cfg: TransformerConfig
 ) -> None:
-    """``cfg.attn_bias`` must agree with the checkpoint: a silent
-    mismatch would either drop trained biases or leave a params tree the
-    engines' specs (gated on the cfg) don't cover."""
+    """``cfg.attn_bias`` / ``cfg.qk_norm`` must agree with the
+    checkpoint: a silent mismatch would either drop trained weights or
+    leave a params tree the engines' specs (gated on the cfg) don't
+    cover."""
     has = "model.layers.0.self_attn.q_proj.bias" in sd
     if has and not cfg.attn_bias:
         raise ValueError(
@@ -168,6 +169,19 @@ def _check_bias_consistency(
             "cfg.attn_bias=True but the checkpoint has no q/k/v "
             "projection biases"
         )
+    has_qk = "model.layers.0.self_attn.q_norm.weight" in sd
+    if has_qk and not cfg.qk_norm:
+        raise ValueError(
+            "this checkpoint carries per-head q/k norms but "
+            "cfg.qk_norm is False — import Qwen3-family models with "
+            "from_hf_qwen3 (which sets it), or set "
+            "TransformerConfig(qk_norm=True); importing without them "
+            "would silently drop trained weights"
+        )
+    if cfg.qk_norm and not has_qk:
+        raise ValueError(
+            "cfg.qk_norm=True but the checkpoint has no q/k norm weights"
+        )
 
 
 def _attn_entries(
@@ -178,7 +192,7 @@ def _attn_entries(
     Q/K/V biases (Llama ``attention_bias`` / the always-biased Qwen2
     family) map to ``bq/bk/bv`` under ``cfg.attn_bias`` — the same gate
     ``transformer_block`` inits and shards by, kept consistent with the
-    checkpoint by ``_check_bias_consistency``."""
+    checkpoint by ``_check_attn_param_consistency``."""
     out = {
         "ln1": _v(sd[p + "input_layernorm.weight"]),
         "wq": _t(sd[p + "self_attn.q_proj.weight"]),
@@ -191,6 +205,9 @@ def _attn_entries(
         out["bq"] = _v(sd[p + "self_attn.q_proj.bias"])
         out["bk"] = _v(sd[p + "self_attn.k_proj.bias"])
         out["bv"] = _v(sd[p + "self_attn.v_proj.bias"])
+    if cfg.qk_norm:
+        out["qn"] = _v(sd[p + "self_attn.q_norm.weight"])
+        out["kn"] = _v(sd[p + "self_attn.k_norm.weight"])
     return out
 
 
@@ -225,7 +242,7 @@ def params_from_hf(
             "params_from_hf_mixtral (imports into the llama_moe family); "
             "this importer covers the dense Llama family"
         )
-    _check_bias_consistency(state_dict, cfg)
+    _check_attn_param_consistency(state_dict, cfg)
     sd = state_dict
     out: List[Pytree] = [{"table": _v(sd["model.embed_tokens.weight"])}]
     for i in range(cfg.n_layers):
@@ -291,6 +308,9 @@ def _export_common(
             sd[p + "self_attn.q_proj.bias"] = v(bp["bq"])
             sd[p + "self_attn.k_proj.bias"] = v(bp["bk"])
             sd[p + "self_attn.v_proj.bias"] = v(bp["bv"])
+        if "qn" in bp:
+            sd[p + "self_attn.q_norm.weight"] = v(bp["qn"])
+            sd[p + "self_attn.k_norm.weight"] = v(bp["kn"])
     return sd, blocks
 
 
@@ -315,30 +335,62 @@ def from_hf_qwen2(model: Any, *, untie: bool = False) -> tuple:
     sd = model.state_dict()
     if "model.layers.0.self_attn.q_proj.bias" in sd and not cfg.attn_bias:
         cfg = dataclasses.replace(cfg, attn_bias=True)
-    if getattr(hfc, "use_sliding_window", False) and getattr(
-        hfc, "sliding_window", None
-    ):
-        types = list(
-            getattr(hfc, "layer_types", None)
-            or ["sliding_attention"] * cfg.n_layers
-        )
-        if all(t == "sliding_attention" for t in types):
-            cfg = dataclasses.replace(
-                cfg, attn_window=int(hfc.sliding_window)
-            )
-        elif any(t == "sliding_attention" for t in types):
-            raise ValueError(
-                "this Qwen2 checkpoint mixes full-attention and "
-                f"sliding-window layers (max_window_layers="
-                f"{getattr(hfc, 'max_window_layers', '?')}); "
-                "attn_window is model-global here, so importing it "
-                "would silently diverge from HF at sequences past the "
-                "window — per-layer windows are not supported"
-            )
-        # else: every layer is full attention — nothing to map.
+    cfg = _apply_qwen_window(cfg, hfc)
     if untie and cfg.tie_embeddings:
         cfg = dataclasses.replace(cfg, tie_embeddings=False)
     return cfg, params_from_hf(sd, cfg)
+
+
+def _apply_qwen_window(
+    cfg: TransformerConfig, hfc: Any
+) -> TransformerConfig:
+    """Qwen-family sliding windows: map to the model-global
+    ``attn_window`` only when EVERY layer is windowed; reject mixed
+    ``max_window_layers`` layouts rather than silently diverging at
+    sequences past the window."""
+    import dataclasses
+
+    if not (
+        getattr(hfc, "use_sliding_window", False)
+        and getattr(hfc, "sliding_window", None)
+    ):
+        return cfg
+    types = list(
+        getattr(hfc, "layer_types", None)
+        or ["sliding_attention"] * cfg.n_layers
+    )
+    if all(t == "sliding_attention" for t in types):
+        return dataclasses.replace(cfg, attn_window=int(hfc.sliding_window))
+    if any(t == "sliding_attention" for t in types):
+        raise ValueError(
+            "this checkpoint mixes full-attention and sliding-window "
+            f"layers (max_window_layers="
+            f"{getattr(hfc, 'max_window_layers', '?')}); attn_window is "
+            "model-global here, so importing it would silently diverge "
+            "from HF at sequences past the window — per-layer windows "
+            "are not supported"
+        )
+    return cfg  # every layer full attention — nothing to map
+
+
+def from_hf_qwen3(model: Any, *, untie: bool = False) -> tuple:
+    """(cfg, per-layer params) from a live HF ``Qwen3ForCausalLM``.
+
+    Qwen3 is the Llama layout plus per-head q/k RMSNorm before rotary
+    (``qk_norm`` -> params ``qn``/``kn``), an explicit ``head_dim``
+    (auto-wired by :func:`config_from_hf`), no projection biases, and
+    tied embeddings on the small sizes.  Sliding windows follow the
+    Qwen2 rule (``max_window_layers``-gated; mixed layouts rejected by
+    the shared helper)."""
+    import dataclasses
+
+    hfc = model.config
+    cfg = config_from_hf(hfc)
+    cfg = dataclasses.replace(cfg, qk_norm=True)
+    cfg = _apply_qwen_window(cfg, hfc)
+    if untie and cfg.tie_embeddings:
+        cfg = dataclasses.replace(cfg, tie_embeddings=False)
+    return cfg, params_from_hf(model.state_dict(), cfg)
 
 
 def from_hf_gemma(model: Any, *, untie: bool = False) -> tuple:
@@ -457,6 +509,7 @@ __all__ = [
     "from_hf_llama",
     "from_hf_mixtral",
     "from_hf_qwen2",
+    "from_hf_qwen3",
     "state_dict_to_hf",
     "state_dict_to_hf_mixtral",
 ]
@@ -506,7 +559,7 @@ def params_from_hf_mixtral(
     (f32, matching the framework's f32 routing); per-expert ``w1/w3/w2``
     → stacked ``w_gate/w_up [E, dim, hidden]`` / ``w_down [E, hidden,
     dim]`` (same SwiGLU: ``silu(x@w_gate) * (x@w_up) @ w_down``)."""
-    _check_bias_consistency(state_dict, cfg)
+    _check_attn_param_consistency(state_dict, cfg)
     sd = state_dict
     out: List[Pytree] = [{"table": _v(sd["model.embed_tokens.weight"])}]
     for i in range(cfg.n_layers):

@@ -72,6 +72,9 @@ class TransformerConfig:
     # bq/bk/bv; wo stays bias-free, matching that family).  Composes with
     # tp (biases shard with their head dim).
     attn_bias: bool = False
+    # Qwen3-style per-head RMSNorm on q and k (params ``qn``/``kn``,
+    # [head_dim], applied before rotary).
+    qk_norm: bool = False
     # Explicit per-head dimension (Gemma/Qwen3-class checkpoints where
     # n_heads * head_dim != dim; the attention output projection maps
     # n_heads*head_dim back to dim).  None -> dim // n_heads.
@@ -207,6 +210,8 @@ def transformer_block(
                 bk=jnp.zeros((nkv * hd,), dt),
                 bv=jnp.zeros((nkv * hd,), dt),
             )
+        if cfg.qk_norm:
+            params.update(qn=jnp.ones((hd,)), kn=jnp.ones((hd,)))
         if mlp is None:
             params.update(
                 w_gate=_normal(ks[4], (dim, hidden), std, dt),
@@ -250,6 +255,9 @@ def transformer_block(
         q = q.reshape(b, s, nh_loc, hd)
         k = k.reshape(b, s, nkv_loc, hd)
         v = v.reshape(b, s, nkv_loc, hd)
+        if "qn" in params:  # Qwen3-style per-head q/k RMSNorm, pre-rope
+            q = _rms(q, params["qn"], cfg.norm_eps)
+            k = _rms(k, params["kn"], cfg.norm_eps)
         q = _rope(q, cfg.rope_theta, pos_offset)
         k = _rope(k, cfg.rope_theta, pos_offset)
         # GQA: K/V stay at n_kv heads — the attention kernel groups queries
@@ -354,6 +362,9 @@ def transformer_block(
             # Biases shard with their projection's output (head) dim.
             bias_spec = P() if tp is None else P(tp)
             param_specs.update(bq=bias_spec, bk=bias_spec, bv=bias_spec)
+        if cfg.qk_norm:
+            # Per-head-dim vectors shared by every head: replicated.
+            param_specs.update(qn=P(), kn=P())
         if mlp is None:
             param_specs.update(
                 w_gate=P(None, tp),
