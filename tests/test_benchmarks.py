@@ -415,3 +415,18 @@ def test_examples_multihost():
     assert r.returncode == 0, (r.stdout[-500:], r.stderr[-800:])
     assert "both ranks OK" in r.stdout
     assert "step 4: loss" in r.stdout
+
+
+@pytest.mark.slow
+def test_examples_hf_finetune():
+    """The HF fine-tune example (import -> fused-optimizer pipeline
+    training with donation -> decode -> export) runs end to end."""
+    repo = pathlib.Path(REPO)
+    env = cpu_subproc_env(XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, str(repo / "examples" / "hf_finetune.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=str(repo),
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "exported 20 tensors back into the HF model" in r.stdout, r.stdout
+    assert "step 5" in r.stdout

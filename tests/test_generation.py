@@ -614,3 +614,63 @@ def test_moe_dropless_generate_teacher_forced():
         expect = np.argmax(np.asarray(ref, np.float32)[:, -1], -1)
         assert (np.asarray(out[:, t]) == expect).all(), (t,)
         seq = np.concatenate([seq, expect[:, None].astype(np.int32)], axis=1)
+
+
+def test_spmd_params_from_flat_roundtrip(cpu_devices):
+    """spmd_params_from_flat is the exact inverse of
+    spmd_params_for_generation, for plain AND interleaved layouts, and
+    strips tied head entries (the engine splices those; a duplicated
+    reference would break donation)."""
+    from torchgpipe_tpu.models.generation import (
+        spmd_params_for_generation,
+        spmd_params_from_flat,
+    )
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig, cross_entropy, llama_spmd,
+    )
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    for schedule, v, pp, layers in (
+        ("fill_drain", 1, 2, 4),
+        ("interleaved", 2, 2, 4),
+    ):
+        cfg = TransformerConfig(
+            vocab=64, dim=32, n_layers=layers, n_heads=4, n_kv_heads=2,
+            tie_embeddings=(schedule == "fill_drain"),
+        )
+        block, pre, post = llama_spmd(cfg, pp * v)
+        kw = {"schedule": schedule, "virtual_stages": v} if v > 1 else {}
+        if v > 1:
+            kw["loss_reduction"] = "mean"
+        mesh = make_mesh(pp, 1, devices=cpu_devices[:pp])
+        pipe = SpmdGPipe(
+            block, pp, mesh, chunks=2, loss_fn=cross_entropy,
+            pre=pre, post=post, **kw,
+        )
+        spec = jax.ShapeDtypeStruct((4, 8), jnp.int32)
+        params = pipe.init(jax.random.PRNGKey(0), spec)
+        flat = spmd_params_for_generation(pipe, params)
+        back = spmd_params_from_flat(pipe, flat)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            params,
+            back,
+        )
+
+    # Tied duplicate in post rejected didactically by the engine.
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        tie_embeddings=True,
+    )
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2,
+                     loss_fn=cross_entropy, pre=pre, post=post)
+    spec = jax.ShapeDtypeStruct((4, 8), jnp.int32)
+    params = pipe.init(jax.random.PRNGKey(0), spec)
+    bad = dict(params, post=dict(params["post"], table=params["pre"]["table"]))
+    with pytest.raises(ValueError, match="spmd_params_from_flat"):
+        pipe.train_step(bad, jnp.zeros((4, 8), jnp.int32),
+                        jnp.zeros((4, 8), jnp.int32))

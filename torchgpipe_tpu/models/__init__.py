@@ -22,6 +22,7 @@ from torchgpipe_tpu.models.generation import (  # noqa: F401
     mpmd_params_for_generation,
     prefill,
     spmd_params_for_generation,
+    spmd_params_from_flat,
 )
 from torchgpipe_tpu.models.moe import (  # noqa: F401
     MoEConfig,

@@ -761,6 +761,72 @@ def mpmd_params_for_generation(
     return out
 
 
+def spmd_params_from_flat(pipe: Any, flat: Any) -> Pytree:
+    """The inverse of :func:`spmd_params_for_generation`: assemble an
+    ``SpmdGPipe`` params dict from a flat per-layer list (embed,
+    blocks..., head) — e.g. an HF import
+    (:mod:`torchgpipe_tpu.models.hf_interop`).
+
+    Blocks are grouped into per-stage chain tuples and stacked into the
+    engine's ``[n_stages, ...]`` layout (or the interleaved
+    ``[n_stages, v, ...]`` round-robin layout).  The head entry lands
+    under ``post`` (or ``loss`` for a parametric loss layer) with any
+    tied pre-param entries STRIPPED — the engine splices those from
+    ``pre`` at apply time, and a duplicated array reference would
+    double-count the buffer under ``make_train_step``'s donation (XLA
+    rejects donating the same buffer twice).  Returns the placed params
+    (``pipe.place``)."""
+    flat = list(flat)
+    n, v = pipe.n_stages, getattr(pipe, "virtual_stages", 1)
+    blocks = flat[1:-1]
+    if len(blocks) % (n * v) != 0:
+        raise ValueError(
+            f"{len(blocks)} block params do not divide into "
+            f"n_stages={n} x virtual_stages={v} stage chains"
+        )
+    per = len(blocks) // (n * v)
+    tmap = jax.tree_util.tree_map
+    # Global group g (path order) lives at [g % n, g // n] — the inverse
+    # of spmd_params_for_generation's unstack rule.  A chain() block
+    # (meta kind 'compound') stores per-stage params as a TUPLE of
+    # sub-layer dicts; a bare block layer stores the dict itself —
+    # mirror whichever this engine was built with.
+    is_chain = (
+        isinstance(pipe.block.meta, dict)
+        and pipe.block.meta.get("kind") == "compound"
+    )
+    if not is_chain and per != 1:
+        raise ValueError(
+            f"engine block {pipe.block.name!r} is a single (non-chain) "
+            f"layer but the flat list carries {per} blocks per stage"
+        )
+    groups = [
+        tuple(blocks[g * per : (g + 1) * per]) if is_chain else blocks[g]
+        for g in range(n * v)
+    ]
+    if v == 1:
+        stacked = tmap(lambda *xs: jnp.stack(xs), *groups)
+    else:
+        per_stage = [
+            tmap(
+                lambda *xs: jnp.stack(xs),
+                *[groups[c * n + j] for c in range(v)],
+            )
+            for j in range(n)
+        ]
+        stacked = tmap(lambda *xs: jnp.stack(xs), *per_stage)
+    params: dict = {"pre": flat[0], "blocks": stacked}
+    head = dict(flat[-1])
+    tie_keys = pipe._tie_post if pipe.post is not None else pipe._tie_loss
+    for k in tie_keys:
+        head.pop(k, None)
+    if pipe.post is not None:
+        params["post"] = head
+    else:
+        params["loss"] = head
+    return pipe.place(params)
+
+
 def spmd_params_for_generation(
     pipe: Any, params: Any, device: Any = None
 ) -> List[Pytree]:
@@ -826,4 +892,5 @@ __all__ = [
     "generate",
     "mpmd_params_for_generation",
     "spmd_params_for_generation",
+    "spmd_params_from_flat",
 ]

@@ -2854,6 +2854,22 @@ class SpmdGPipe:
                     f"{key!r} entry — params must come from THIS engine's "
                     "init (pre/post configuration must match)"
                 )
+        for key, keys in (("post", self._tie_post), ("loss", self._tie_loss)):
+            entry = params.get(key)
+            if not (keys and isinstance(entry, dict)):
+                continue
+            dup = [k for k in keys if k in entry]
+            if dup:
+                raise ValueError(
+                    f"params[{key!r}] contains tied pre-param entr"
+                    f"{'ies' if len(dup) > 1 else 'y'} {dup}: the engine "
+                    "splices these from params['pre'] at apply time "
+                    "(meta['tie_pre']), and a duplicated array reference "
+                    "would be donated twice under make_train_step and "
+                    "double the memory.  Drop them — e.g. assemble "
+                    "imported weights with "
+                    "models.generation.spmd_params_from_flat"
+                )
         v = self.virtual_stages
         want = (self.n_stages,) if v == 1 else (self.n_stages, v)
         for leaf in jax.tree_util.tree_leaves(params["blocks"]):
