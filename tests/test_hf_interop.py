@@ -580,17 +580,13 @@ def test_gemma_decode_and_spmd_logits_match_hf(cpu_devices):
     assert (ours == hf).all(), (ours, hf)
 
     # SPMD engine logits (pipe the two blocks over pp=2).
+    from torchgpipe_tpu.models.generation import spmd_params_from_flat
+
     block, pre, post = llama_spmd(cfg, 2)
     mesh = make_mesh(2, 1, devices=cpu_devices[:2])
     pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy_,
                      pre=pre, post=post)
-    placed = pipe.place({
-        "pre": params[0],
-        "blocks": jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *[(bp,) for bp in params[1:-1]]
-        ),
-        "post": params[-1],
-    })
+    placed = spmd_params_from_flat(pipe, params)
     out = pipe.apply(placed, jnp.asarray(tokens, jnp.int32))
     np.testing.assert_allclose(
         np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4

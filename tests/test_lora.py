@@ -1,0 +1,157 @@
+"""LoRA adapters: zero-delta init, adapter-only training, exact merge.
+
+The contract chain: a freshly-adapted model computes EXACTLY the base
+model (B factors are zero-init); ``optax.masked`` + ``lora_mask`` trains
+only the adapters; ``merge_lora`` folds them into the base weights with
+the merged model computing exactly what the adapted model computed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchgpipe_tpu.layers import sequential_apply, sequential_init
+from torchgpipe_tpu.models.lora import lora_mask, lora_optimizer, merge_lora
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    cross_entropy,
+    llama,
+    llama_spmd,
+)
+from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+
+def _cfgs(rank=4):
+    base = dict(vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2)
+    return (
+        TransformerConfig(**base),
+        TransformerConfig(**base, lora_rank=rank, lora_alpha=8.0),
+    )
+
+
+def _flat_init(cfg, rng=0):
+    layers = llama(cfg)
+    spec = jax.ShapeDtypeStruct((2, 8), jnp.int32)
+    params, states, _ = sequential_init(
+        layers, jax.random.PRNGKey(rng), spec
+    )
+    return layers, list(params), list(states)
+
+
+def test_fresh_adapters_compute_the_base_model():
+    cfg0, cfg1 = _cfgs()
+    _, p1, s1 = _flat_init(cfg1)
+    # Base params = adapted params minus the lora dicts.
+    p0 = [p1[0]] + [
+        {k: v for k, v in bp.items() if k != "lora"} for bp in p1[1:-1]
+    ] + [p1[-1]]
+    tokens = jnp.asarray(np.arange(16).reshape(2, 8) % cfg0.vocab)
+    out1, _ = sequential_apply(
+        llama(cfg1), p1, s1, tokens, rng=None, train=False
+    )
+    out0, _ = sequential_apply(
+        llama(cfg0), p0, s1, tokens, rng=None, train=False
+    )
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out0))
+
+
+def test_adapter_only_training_moves_only_adapters(cpu_devices):
+    """SPMD pipeline + lora_optimizer: the loss decreases while every
+    non-lora leaf stays bit-identical."""
+    _, cfg = _cfgs()
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+                     pre=pre, post=post)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 9), 0, cfg.vocab)
+    x, y = tokens[:, :-1], tokens[:, 1:]
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    mask = lora_mask(params)
+    assert any(jax.tree_util.tree_leaves(mask))
+    opt = lora_optimizer(optax.adamw(5e-2), params)
+    step = pipe.make_train_step(opt, donate=False)
+    opt_state = pipe.place_tree(opt.init(params))
+
+    p0 = jax.tree_util.tree_map(lambda a: np.asarray(a), params)
+    losses = []
+    p = params
+    for _ in range(8):
+        loss, p, opt_state = step(p, opt_state, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    def check(path, a, b):
+        in_lora = any(
+            getattr(k, "key", None) == "lora" for k in path
+        )
+        if in_lora:
+            return  # adapters may (and do) move
+        np.testing.assert_array_equal(np.asarray(a), b, err_msg=str(path))
+
+    moved = [False]
+
+    def check_lora_moved(path, a, b):
+        if any(getattr(k, "key", None) == "lora" for k in path):
+            if not np.array_equal(np.asarray(a), b):
+                moved[0] = True
+
+    jax.tree_util.tree_map_with_path(check, p, p0)
+    jax.tree_util.tree_map_with_path(check_lora_moved, p, p0)
+    assert moved[0], "no adapter weight moved"
+
+
+def test_merge_lora_exact(cpu_devices):
+    """merge_lora(adapted) computes exactly the adapted model, with the
+    lora dicts gone — and decodes identically."""
+    from torchgpipe_tpu.models.generation import generate
+
+    cfg0, cfg1 = _cfgs()
+    layers1 = llama(cfg1)
+    _, p1, s1 = _flat_init(cfg1)
+    # Give the adapters real (nonzero) values so the merge is exercised.
+    k = jax.random.PRNGKey(7)
+    p1 = [p1[0]] + [
+        dict(bp, lora=jax.tree_util.tree_map(
+            lambda a: a + 0.01 * jax.random.normal(k, a.shape, a.dtype),
+            bp["lora"],
+        ))
+        for bp in p1[1:-1]
+    ] + [p1[-1]]
+    tokens = jnp.asarray(np.arange(16).reshape(2, 8) % cfg1.vocab)
+    out1, _ = sequential_apply(layers1, p1, s1, tokens, rng=None, train=False)
+
+    mcfg, mp = merge_lora(cfg1, p1)
+    assert mcfg.lora_rank is None
+    assert all("lora" not in bp for bp in mp[1:-1])
+    out_m, _ = sequential_apply(
+        llama(mcfg), mp, s1, tokens, rng=None, train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_m), np.asarray(out1), rtol=1e-5, atol=1e-5
+    )
+
+    d1 = np.asarray(generate(cfg1, p1, tokens[:, :4], max_new_tokens=3))
+    dm = np.asarray(generate(mcfg, mp, tokens[:, :4], max_new_tokens=3))
+    np.testing.assert_array_equal(d1, dm)
+
+    with pytest.raises(ValueError, match="nothing to merge"):
+        merge_lora(mcfg, mp)
+
+
+def test_lora_guards():
+    """lora_optimizer refuses adapter-free params; state_dict_to_hf
+    refuses unmerged adapters."""
+    from torchgpipe_tpu.models.hf_interop import state_dict_to_hf
+
+    cfg0, cfg1 = _cfgs()
+    _, p0, _ = _flat_init(cfg0)
+    with pytest.raises(ValueError, match="no 'lora'"):
+        lora_optimizer(optax.adamw(1e-3), p0)
+
+    _, p1, _ = _flat_init(cfg1)
+    with pytest.raises(ValueError, match="merge_lora"):
+        state_dict_to_hf(p1, cfg1)

@@ -66,6 +66,7 @@ from torchgpipe_tpu.models.transformer import (
     TransformerConfig,
     _act_fn,
     _head_w,
+    _lora_delta,
     _rms,
     _rope,
 )
@@ -252,6 +253,11 @@ def _decode_step(
         nkv_loc = p["wk"].shape[1] // hd
         h = _rms(x, p["ln1"], cfg.norm_eps)
         q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
+        if "lora" in p:
+            lo = p["lora"]
+            q = q + _lora_delta(cfg, lo, h, "qa", "qb")
+            k = k + _lora_delta(cfg, lo, h, "ka", "kb")
+            v = v + _lora_delta(cfg, lo, h, "va", "vb")
         if "bq" in p:  # Qwen2-style projection biases
             q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
         q = q.reshape(b, 1, nh_loc, hd)
@@ -286,7 +292,11 @@ def _decode_step(
             if ring
             else _attend_cached(q, rk, rv, pos, cfg.attn_window)
         )
-        x = x + (attn.astype(x.dtype) @ p["wo"])
+        attn = attn.astype(x.dtype)
+        o = attn @ p["wo"]
+        if "lora" in p:
+            o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
+        x = x + o
         h = _rms(x, p["ln2"], cfg.norm_eps)
         x = x + _mlp_out(cfg, p, h, mlp_layer)
         new_k.append(ck)
@@ -470,6 +480,11 @@ def prefill(
         nkv_loc = p["wk"].shape[1] // hd
         h = _rms(x, p["ln1"], cfg.norm_eps)
         q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
+        if "lora" in p:
+            lo = p["lora"]
+            q = q + _lora_delta(cfg, lo, h, "qa", "qb")
+            k = k + _lora_delta(cfg, lo, h, "ka", "kb")
+            v = v + _lora_delta(cfg, lo, h, "va", "vb")
         if "bq" in p:  # Qwen2-style projection biases
             q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
         q = q.reshape(b, s, nh_loc, hd)
@@ -481,7 +496,11 @@ def prefill(
         q = _rope(q, cfg.rope_theta, 0)
         k = _rope(k, cfg.rope_theta, 0)
         attn = _attend_full(q, k, v, cfg.attn_window, use_flash)
-        x = x + (attn.astype(x.dtype) @ p["wo"])
+        attn = attn.astype(x.dtype)
+        o = attn @ p["wo"]
+        if "lora" in p:
+            o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
+        x = x + o
         h = _rms(x, p["ln2"], cfg.norm_eps)
         x = x + _mlp_out(cfg, p, h, mlp_layer)
         if ring:

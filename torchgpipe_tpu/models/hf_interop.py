@@ -290,6 +290,12 @@ def _export_common(
         raise ValueError(
             f"expected {cfg.n_layers} block params, got {len(blocks)}"
         )
+    if any(isinstance(bp, dict) and "lora" in bp for bp in blocks):
+        raise ValueError(
+            "block params carry unmerged 'lora' adapters; exporting "
+            "would silently publish the BASE model without the "
+            "fine-tune — fold them first with models.lora.merge_lora"
+        )
     sd: Dict[str, Any] = {
         "model.embed_tokens.weight": v(embed["table"]),
         "model.norm.weight": v(head["scale"]),

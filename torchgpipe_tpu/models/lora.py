@@ -1,0 +1,129 @@
+"""LoRA fine-tuning helpers (Hu et al., arXiv:2106.09685 — public
+technique).
+
+The adapters themselves are a model knob
+(``TransformerConfig(lora_rank=r)``: low-rank ``A``/``B`` factors on the
+q/k/v/o projections, living under each block's ``"lora"`` params
+subdict, zero-initialized delta).  This module supplies the two pieces
+around them:
+
+* :func:`lora_optimizer` — wrap any optax transformation so it updates
+  ONLY adapter weights and zeroes every other update (the standard
+  parameter-efficient fine-tuning discipline; base weights stay frozen
+  without any engine support — the engines just see params).  Built on
+  ``optax.multi_transform`` + ``set_to_zero`` — NOT ``optax.masked``,
+  which passes raw gradients through for unmasked leaves;
+* :func:`lora_mask` — the underlying boolean pytree, for custom
+  compositions;
+* :func:`merge_lora` — fold trained adapters into the base projections
+  (``w + A @ B * alpha/rank``) and drop them, yielding a plain
+  checkpoint that decodes at full speed and exports to HF
+  (:func:`torchgpipe_tpu.models.hf_interop.state_dict_to_hf`).
+
+No reference counterpart (the reference is full-parameter training
+only).  Runnable end to end in ``examples/hf_finetune.py``-style flows;
+oracle tests in ``tests/test_lora.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu.models.transformer import TransformerConfig
+
+Pytree = Any
+
+
+def lora_mask(params: Pytree) -> Pytree:
+    """Boolean pytree: True exactly on leaves under a ``"lora"`` dict key.
+
+    Works on any params layout (flat per-layer lists, the SPMD engine's
+    stacked dict, per-stage tuples) because it walks the structure, not
+    a schema.  To freeze the base weights use :func:`lora_optimizer` —
+    NOT ``optax.masked(inner, mask)``, whose unmasked leaves receive the
+    RAW gradients as updates (it composes transforms; it does not
+    freeze)."""
+
+    def walk(node: Any, in_lora: bool) -> Any:
+        if isinstance(node, dict):
+            return {
+                k: walk(v, in_lora or k == "lora") for k, v in node.items()
+            }
+        if isinstance(node, (list, tuple)):
+            out = [walk(v, in_lora) for v in node]
+            return type(node)(out) if isinstance(node, tuple) else out
+        return in_lora
+
+    return walk(params, False)
+
+
+def lora_optimizer(inner: Any, params: Pytree) -> Any:
+    """An optax transformation updating ONLY the LoRA adapter leaves.
+
+    ``inner`` (e.g. ``optax.adamw(lr)``) drives the adapters; every
+    other leaf's update is zeroed (``optax.set_to_zero``), so base
+    weights stay bit-identical through training — asserted in
+    ``tests/test_lora.py``.  Works with ``SpmdGPipe.make_train_step``
+    unchanged."""
+    import optax
+
+    mask = lora_mask(params)
+    if not any(jax.tree_util.tree_leaves(mask)):
+        raise ValueError(
+            "params contain no 'lora' adapter leaves — every update "
+            "would be zeroed and training would silently be a no-op.  "
+            "Build the model with TransformerConfig(lora_rank=...) (and "
+            "init, or splice fresh adapters next to imported weights)"
+        )
+    labels = jax.tree_util.tree_map(
+        lambda m: "lora" if m else "frozen", mask
+    )
+    return optax.multi_transform(
+        {"lora": inner, "frozen": optax.set_to_zero()}, labels
+    )
+
+
+def merge_lora(
+    cfg: TransformerConfig, flat: List[Pytree]
+) -> tuple:
+    """(cfg', flat') with every block's adapters folded into the base
+    projections and removed: ``w <- w + A @ B * (alpha / rank)``.
+
+    Input is the flat per-layer list (embed, blocks..., head) —
+    the decode/export layout; pull one out of an SPMD engine with
+    :func:`torchgpipe_tpu.models.generation.spmd_params_for_generation`.
+    The merged model computes EXACTLY what the adapted model computed
+    (oracle-tested) at the base model's cost, and ``cfg'`` has
+    ``lora_rank=None`` so fresh inits and importers agree with the
+    merged layout."""
+    if not cfg.lora_rank:
+        raise ValueError("cfg.lora_rank is not set — nothing to merge")
+    ls = cfg.lora_alpha / cfg.lora_rank
+    out: List[Pytree] = [flat[0]]
+    for bp in flat[1:-1]:
+        if "lora" not in bp:
+            raise ValueError(
+                "block params carry no 'lora' subdict — already merged, "
+                "or built with a different config?"
+            )
+        bp = dict(bp)
+        lo = bp.pop("lora")
+        for w, a, b in (
+            ("wq", "qa", "qb"),
+            ("wk", "ka", "kb"),
+            ("wv", "va", "vb"),
+            ("wo", "oa", "ob"),
+        ):
+            delta = (lo[a] @ lo[b]) * ls
+            bp[w] = (bp[w] + delta.astype(bp[w].dtype))
+        out.append(bp)
+    out.append(flat[-1])
+    merged_cfg = dataclasses.replace(cfg, lora_rank=None)
+    return merged_cfg, out
+
+
+__all__ = ["lora_mask", "lora_optimizer", "merge_lora"]

@@ -86,6 +86,16 @@ class TransformerConfig:
     # sqrt(dim); the TIED head still reads the unscaled table, matching
     # that family).  None -> no scaling.
     embed_scale: Optional[float] = None
+    # LoRA (Hu et al., arXiv:2106.09685) low-rank adapters on the
+    # attention projections (q/k/v/o): rank of the adapters, or None for
+    # no adapters.  Params live under the block's ``"lora"`` subdict
+    # (A ~ N(0, 1/sqrt(dim)), B zero-init — the delta starts at 0, so a
+    # freshly-adapted model computes exactly the base model).  Train
+    # adapters only via ``models.lora.lora_optimizer`` (NOT
+    # ``optax.masked``, which leaks raw gradients into the base); fold
+    # them into the base weights with ``models.lora.merge_lora``.
+    lora_rank: Optional[int] = None
+    lora_alpha: float = 16.0
     # GPT-2/Gemma-style weight tying: the lm head reuses the embedding
     # table (logits = h @ table.T) instead of owning a separate ``w``.
     # The classic pipeline-parallel pain point — the two uses live on
@@ -127,6 +137,19 @@ def _rms(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
     y = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
     return y * scale.astype(x.dtype)
+
+
+def _lora_delta(
+    cfg: TransformerConfig,
+    lo: Any,
+    x: jnp.ndarray,
+    a: str,
+    b: str,
+) -> jnp.ndarray:
+    """One adapter's contribution ``(x @ A) @ B * alpha/rank`` — the
+    single definition of the LoRA math shared by the training block and
+    the generation prefill/decode paths."""
+    return ((x @ lo[a]) @ lo[b]) * (cfg.lora_alpha / cfg.lora_rank)
 
 
 def _act_fn(act: str) -> Callable[[jnp.ndarray], jnp.ndarray]:
@@ -194,7 +217,7 @@ def transformer_block(
     dt = cfg.dtype
 
     def init(rng, in_spec):
-        ks = jax.random.split(rng, 8)
+        ks = jax.random.split(rng, 9)
         std = dim ** -0.5
         params = {
             "ln1": jnp.ones((dim,)),
@@ -212,6 +235,20 @@ def transformer_block(
             )
         if cfg.qk_norm:
             params.update(qn=jnp.ones((hd,)), kn=jnp.ones((hd,)))
+        if cfg.lora_rank:
+            r = cfg.lora_rank
+            lk = jax.random.split(ks[7], 4)
+            std = dim ** -0.5
+            params["lora"] = {
+                "qa": _normal(lk[0], (dim, r), std, dt),
+                "qb": jnp.zeros((r, nh * hd), dt),
+                "ka": _normal(lk[1], (dim, r), std, dt),
+                "kb": jnp.zeros((r, nkv * hd), dt),
+                "va": _normal(lk[2], (dim, r), std, dt),
+                "vb": jnp.zeros((r, nkv * hd), dt),
+                "oa": _normal(lk[3], (nh * hd, r), std, dt),
+                "ob": jnp.zeros((r, dim), dt),
+            }
         if mlp is None:
             params.update(
                 w_gate=_normal(ks[4], (dim, hidden), std, dt),
@@ -219,7 +256,7 @@ def transformer_block(
                 w_down=_normal(ks[6], (hidden, dim), hidden ** -0.5, dt),
             )
         else:
-            mp, ms = mlp.init(ks[7], in_spec)
+            mp, ms = mlp.init(ks[8], in_spec)
             if jax.tree_util.tree_leaves(ms):
                 raise ValueError(
                     f"transformer_block mlp {mlp.name!r} must be stateless"
@@ -250,6 +287,11 @@ def transformer_block(
         if tp_active:
             h = psum_grad(h, cfg.tp_axis)  # region entry: full grad upstream
         q, k, v = h @ params["wq"], h @ params["wk"], h @ params["wv"]
+        if "lora" in params:
+            lo = params["lora"]
+            q = q + _lora_delta(cfg, lo, h, "qa", "qb")
+            k = k + _lora_delta(cfg, lo, h, "ka", "kb")
+            v = v + _lora_delta(cfg, lo, h, "va", "vb")
         if "bq" in params:  # Qwen2-style projection biases
             q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
         q = q.reshape(b, s, nh_loc, hd)
@@ -268,7 +310,12 @@ def transformer_block(
             q, k, v, axis_name=cfg.sp_axis if sp_active else None,
             causal=True, impl=cfg.sp_impl, window=cfg.attn_window,
         )
-        attn_out = attn.reshape(b, s, nh_loc * hd) @ params["wo"]
+        attn_flat = attn.reshape(b, s, nh_loc * hd)
+        attn_out = attn_flat @ params["wo"]
+        if "lora" in params:
+            attn_out = attn_out + _lora_delta(
+                cfg, params["lora"], attn_flat, "oa", "ob"
+            )
         if tp_active:
             attn_out = psum_value(attn_out, cfg.tp_axis)  # region exit
         x = x + attn_out
@@ -365,6 +412,15 @@ def transformer_block(
         if cfg.qk_norm:
             # Per-head-dim vectors shared by every head: replicated.
             param_specs.update(qn=P(), kn=P())
+        if cfg.lora_rank:
+            # A factors replicate (or row-shard with wo); B factors shard
+            # like their projection's output dim.
+            param_specs["lora"] = {
+                "qa": P(), "qb": P(None, tp),
+                "ka": P(), "kb": P(None, tp),
+                "va": P(), "vb": P(None, tp),
+                "oa": P(tp, None) if tp is not None else P(), "ob": P(),
+            }
         if mlp is None:
             param_specs.update(
                 w_gate=P(None, tp),
