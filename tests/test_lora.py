@@ -155,3 +155,54 @@ def test_lora_guards():
     _, p1, _ = _flat_init(cfg1)
     with pytest.raises(ValueError, match="merge_lora"):
         state_dict_to_hf(p1, cfg1)
+
+
+def test_lora_composes_with_tp(cpu_devices):
+    """Adapters under a tp mesh: B factors shard with their projection's
+    output dim (specs declared in transformer_block), training runs, and
+    fresh adapters still compute the base model exactly."""
+    base = dict(vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2)
+    cfg = TransformerConfig(**base, lora_rank=4, lora_alpha=8.0,
+                            tp_axis="tp")
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 1, tp=2, devices=cpu_devices[:4])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+                     pre=pre, post=post, tp_axis="tp")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 9), 0, cfg.vocab)
+    x, y = tokens[:, :-1], tokens[:, 1:]
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    # B factors are tp-sharded over their output dim.
+    qb = params["blocks"][0]["lora"]["qb"]
+    assert "tp" in str(qb.sharding.spec), qb.sharding
+
+    # Fresh adapters == the same model without them (tp apply parity).
+    cfg0 = TransformerConfig(**base, tp_axis="tp")
+    block0, pre0, post0 = llama_spmd(cfg0, 2)
+    pipe0 = SpmdGPipe(block0, 2, mesh, chunks=2, loss_fn=cross_entropy,
+                      pre=pre0, post=post0, tp_axis="tp")
+    p0 = {
+        "pre": params["pre"],
+        "blocks": tuple(
+            {k: v for k, v in bp.items() if k != "lora"}
+            for bp in params["blocks"]
+        ),
+        "post": params["post"],
+    }
+    p0 = pipe0.place(p0)
+    out1 = pipe.apply(params, x)
+    out0 = pipe0.apply(p0, x)
+    np.testing.assert_allclose(
+        np.asarray(out1), np.asarray(out0), rtol=1e-6, atol=1e-6
+    )
+
+    opt = lora_optimizer(optax.adamw(5e-2), params)
+    step = pipe.make_train_step(opt, donate=False)
+    s = pipe.place_tree(opt.init(params))
+    losses = []
+    p = params
+    for _ in range(4):
+        loss, p, s = step(p, s, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
