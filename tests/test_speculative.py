@@ -1,0 +1,284 @@
+"""Speculative decoding + nucleus sampling.
+
+No reference counterpart (the reference is training-only); the oracle
+discipline is this repo's usual — the specialized path is checked
+against the general one:
+
+* ``_decode_chunk`` (the one-pass verify primitive) against sequential
+  ``_decode_step`` calls, bit-tight, plain and quantized caches;
+* greedy ``speculative_generate`` against greedy ``generate``
+  token-for-token, for an ARBITRARY draft model (the exactness theorem's
+  deterministic case) — acceptance rate may be anything, output may not
+  differ;
+* the self-draft degenerate case (draft == target), where every
+  proposal must be accepted and the round count is exactly
+  ``ceil((T-1)/(gamma+1))``;
+* temperature sampling's output DISTRIBUTION against target-only
+  sampling (empirical marginals over many rows/keys).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu.layers import sequential_init
+from torchgpipe_tpu.models.generation import (
+    KVCache,
+    _decode_chunk,
+    _decode_step,
+    _embed,
+    _filter_logits,
+    _logits,
+    _split_params,
+    generate,
+    init_cache,
+    init_quant_cache,
+    prefill,
+    speculative_generate,
+)
+from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+
+CFG = TransformerConfig(vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2)
+DRAFT = TransformerConfig(vocab=64, dim=16, n_layers=1, n_heads=2, n_kv_heads=1)
+
+
+def _params(cfg, seed, batch=2, seq=8):
+    layers = llama(cfg)
+    spec = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    params, _, _ = sequential_init(layers, jax.random.PRNGKey(seed), spec)
+    return params
+
+
+def _prompt(b, s, vocab=64, mult=7, add=3):
+    return jnp.mod(mult * jnp.arange(b * s).reshape(b, s) + add, vocab)
+
+
+# --------------------------------------------------------------------- #
+# _decode_chunk: the verify primitive                                   #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_decode_chunk_matches_sequential_steps(quant):
+    """g tokens through ONE chunk == g sequential single-token steps:
+    same hidden states, same cache contents, same length."""
+    b, s, g = 2, 5, 3
+    params = _params(CFG, 0)
+    embed_p, block_p, _ = _split_params(CFG, params)
+    prompt = _prompt(b, s)
+    _, cache = prefill(CFG, params, prompt, max_len=16, kv_quant=quant)
+    toks = _prompt(b, g, mult=11, add=1)
+
+    x = _embed(CFG, embed_p, toks)
+    x_chunk, c_chunk = _decode_chunk(CFG, block_p, x, cache)
+
+    c_seq = cache
+    xs = []
+    for i in range(g):
+        xi = _embed(CFG, embed_p, toks[:, i : i + 1])
+        xi, c_seq = _decode_step(CFG, block_p, xi, c_seq)
+        xs.append(xi)
+    x_seq = jnp.concatenate(xs, axis=1)
+
+    np.testing.assert_allclose(
+        np.asarray(x_chunk), np.asarray(x_seq), rtol=2e-4, atol=2e-4
+    )
+    assert int(c_chunk.length) == int(c_seq.length) == s + g
+    for a, bb in zip(jax.tree.leaves(c_chunk), jax.tree.leaves(c_seq)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(bb, np.float32),
+            rtol=2e-4, atol=2e-4,
+        )
+
+
+def test_chunk_rollback_then_overwrite_is_clean():
+    """Writing a chunk, rolling length back, and decoding fresh tokens
+    over the stale rows gives bit-identical results to never having
+    written the rejected rows — the masking+overwrite property the
+    speculative rollback relies on."""
+    b, s, g = 1, 4, 3
+    params = _params(CFG, 0)
+    embed_p, block_p, head_p = _split_params(CFG, params)
+    prompt = _prompt(b, s)
+    _, cache = prefill(CFG, params, prompt, max_len=16)
+
+    junk = _prompt(b, g, mult=13, add=5)
+    _, polluted = _decode_chunk(CFG, block_p, _embed(CFG, embed_p, junk), cache)
+    rolled = polluted._replace(length=cache.length)
+
+    tok = _prompt(b, 1, mult=3, add=2)
+    x_clean, c_clean = _decode_step(
+        CFG, block_p, _embed(CFG, embed_p, tok), cache
+    )
+    x_roll, c_roll = _decode_step(
+        CFG, block_p, _embed(CFG, embed_p, tok), rolled
+    )
+    np.testing.assert_array_equal(np.asarray(x_clean), np.asarray(x_roll))
+    assert int(c_clean.length) == int(c_roll.length)
+
+
+# --------------------------------------------------------------------- #
+# speculative_generate: greedy exactness                                #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 8])
+def test_greedy_speculative_equals_generate(gamma):
+    """With temperature=0 the speculative output must equal target-only
+    greedy decode TOKEN-FOR-TOKEN, whatever the draft proposes (here an
+    unrelated, differently-shaped model) — gamma=8 overshoots T inside
+    a round, exercising the drop-past-the-buffer path."""
+    b, s, T = 2, 5, 9
+    params = _params(CFG, 0)
+    draft_params = _params(DRAFT, 123)
+    prompt = _prompt(b, s)
+    want = generate(CFG, params, prompt, max_new_tokens=T)
+    got, stats = speculative_generate(
+        CFG, params, DRAFT, draft_params, prompt, T,
+        gamma=gamma, return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # Each round emits accepted+1 tokens on top of the prefill token.
+    n_emitted = np.asarray(stats.rounds) + np.asarray(stats.accepted) + 1
+    assert (n_emitted >= T).all()
+
+
+def test_self_draft_accepts_everything():
+    """draft == target: every proposal matches the target argmax, so
+    acceptance is total and the round count is exactly
+    ceil((T-1)/(gamma+1))."""
+    b, s, T, g = 2, 4, 10, 3
+    params = _params(CFG, 0)
+    prompt = _prompt(b, s)
+    want = generate(CFG, params, prompt, max_new_tokens=T)
+    got, stats = speculative_generate(
+        CFG, params, CFG, params, prompt, T, gamma=g, return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    rounds = np.asarray(stats.rounds)
+    assert (rounds == math.ceil((T - 1) / (g + 1))).all()
+    assert (np.asarray(stats.accepted) == rounds * g).all()
+
+
+def test_speculative_eos_freezes_like_generate():
+    """EOS semantics are generate()'s: after the first eos_id a row
+    emits eos_id forever.  Pick the token greedy decode actually emits
+    mid-sequence as the eos so the freeze really triggers."""
+    b, s, T = 2, 5, 8
+    params = _params(CFG, 0)
+    draft_params = _params(DRAFT, 123)
+    prompt = _prompt(b, s)
+    free = generate(CFG, params, prompt, max_new_tokens=T)
+    eos = int(free[0, 2])  # row 0 hits it at step 2 -> steps 3+ freeze
+    want = generate(CFG, params, prompt, max_new_tokens=T, eos_id=eos)
+    got = speculative_generate(
+        CFG, params, DRAFT, draft_params, prompt, T, gamma=3, eos_id=eos,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    row0 = np.asarray(got[0])
+    first = int(np.argmax(row0 == eos))
+    assert (row0[first:] == eos).all()
+
+
+# --------------------------------------------------------------------- #
+# speculative_generate: sampling exactness (distributional)             #
+# --------------------------------------------------------------------- #
+
+
+def test_speculative_sampling_matches_target_distribution():
+    """Temperature sampling through the accept/resample machinery must
+    leave the output distributed exactly as target-only sampling
+    (Leviathan et al. thm. 1).  Empirical check: N independent rows
+    (same prompt, independent keys), compare the marginal over the
+    SECOND new token — the first one routed through a full draft-verify
+    round — between speculative and plain generate."""
+    tcfg = TransformerConfig(
+        vocab=8, dim=16, n_layers=1, n_heads=2, n_kv_heads=1
+    )
+    dcfg = TransformerConfig(
+        vocab=8, dim=8, n_layers=1, n_heads=1, n_kv_heads=1
+    )
+    tparams = _params(tcfg, 7, seq=4)
+    dparams = _params(dcfg, 99, seq=4)
+    N, s, T = 768, 3, 2
+    prompt = jnp.tile(_prompt(1, s, vocab=8), (N, 1))
+
+    spec = speculative_generate(
+        tcfg, tparams, dcfg, dparams, prompt, T,
+        gamma=1, temperature=1.0, rng=jax.random.PRNGKey(5),
+    )
+    plain = generate(
+        tcfg, tparams, prompt, T,
+        temperature=1.0, rng=jax.random.PRNGKey(11),
+    )
+    for col in range(T):
+        f_spec = np.bincount(np.asarray(spec[:, col]), minlength=8) / N
+        f_plain = np.bincount(np.asarray(plain[:, col]), minlength=8) / N
+        # SE of a frequency at N=768 is <= 0.018; 0.08 is > 4 sigma.
+        assert np.abs(f_spec - f_plain).max() < 0.08, (
+            col, f_spec, f_plain
+        )
+
+
+# --------------------------------------------------------------------- #
+# top-p (nucleus) sampling                                              #
+# --------------------------------------------------------------------- #
+
+
+def test_filter_logits_top_p_mask():
+    """Nucleus rule on a known distribution: keep the smallest sorted
+    prefix whose cumulative mass reaches top_p (most-probable token
+    always survives)."""
+    probs = jnp.asarray([[0.5, 0.3, 0.15, 0.05]])
+    logits = jnp.log(probs)
+    out = _filter_logits(logits, 1.0, None, 0.7)
+    kept = np.isfinite(np.asarray(out))[0]
+    np.testing.assert_array_equal(kept, [True, True, False, False])
+    out = _filter_logits(logits, 1.0, None, 0.95)
+    kept = np.isfinite(np.asarray(out))[0]
+    np.testing.assert_array_equal(kept, [True, True, True, False])
+    # top_p so small only the argmax survives.
+    out = _filter_logits(logits, 1.0, None, 1e-6)
+    kept = np.isfinite(np.asarray(out))[0]
+    np.testing.assert_array_equal(kept, [True, False, False, False])
+
+
+def test_generate_top_p_tiny_equals_greedy():
+    """top_p -> 0 keeps only the argmax, so sampling at any temperature
+    must reproduce the greedy sequence."""
+    b, s, T = 2, 4, 6
+    params = _params(CFG, 0)
+    prompt = _prompt(b, s)
+    want = generate(CFG, params, prompt, max_new_tokens=T)
+    got = generate(
+        CFG, params, prompt, max_new_tokens=T,
+        temperature=0.9, top_p=1e-6, rng=jax.random.PRNGKey(3),
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_generate_top_p_restricts_support():
+    """Sampled tokens always lie in the nucleus of the step's
+    distribution: re-derive each step's filtered support by teacher
+    forcing and assert membership."""
+    b, s, T, p = 1, 4, 5, 0.6
+    params = _params(CFG, 0)
+    embed_p, block_p, head_p = _split_params(CFG, params)
+    prompt = _prompt(b, s)
+    out = generate(
+        CFG, params, prompt, max_new_tokens=T,
+        temperature=1.0, top_p=p, rng=jax.random.PRNGKey(9),
+    )
+    logits, cache = prefill(CFG, params, prompt, max_len=s + T)
+    for t in range(T):
+        allowed = np.isfinite(
+            np.asarray(_filter_logits(logits, 1.0, None, p))
+        )[0]
+        tok = int(out[0, t])
+        assert allowed[tok], (t, tok)
+        x = _embed(CFG, embed_p, out[:, t : t + 1])
+        x, cache = _decode_step(CFG, block_p, x, cache)
+        logits = _logits(CFG, head_p, x)[:, 0]

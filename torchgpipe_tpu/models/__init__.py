@@ -21,6 +21,8 @@ from torchgpipe_tpu.models.generation import (  # noqa: F401
     init_quant_cache,
     mpmd_params_for_generation,
     prefill,
+    SpecStats,
+    speculative_generate,
     spmd_params_for_generation,
     spmd_params_from_flat,
 )

@@ -162,32 +162,6 @@ def _split_params(cfg: TransformerConfig, params: Pytree) -> Tuple:
     return params[0], params[1 : 1 + cfg.n_layers], params[-1]
 
 
-def _attend_cached(
-    q: jnp.ndarray,          # [b, 1, nh, hd] — rope'd query for this step
-    ck: jnp.ndarray,         # [b, max_len, nkv, hd]
-    cv: jnp.ndarray,
-    pos: jnp.ndarray,        # [] int32 — this token's position
-    window: Optional[int],
-) -> jnp.ndarray:
-    b, _, nh, hd = q.shape
-    max_len = ck.shape[1]
-    nkv = ck.shape[2]
-    r = nh // nkv
-    # Group queries onto kv heads: [b, nkv, r, hd].
-    qg = q[:, 0].reshape(b, nkv, r, hd)
-    scores = jnp.einsum(
-        "bgrd,bsgd->bgrs", qg.astype(jnp.float32), ck.astype(jnp.float32)
-    ) * (hd ** -0.5)
-    idx = jnp.arange(max_len)
-    valid = idx <= pos                       # causal: cache rows 0..pos
-    if window is not None:
-        valid &= idx > pos - window          # band: 0 <= pos - s < window
-    scores = jnp.where(valid[None, None, None, :], scores, -jnp.inf)
-    p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bgrs,bsgd->bgrd", p, cv.astype(jnp.float32))
-    return out.reshape(b, 1, nh * hd)
-
-
 def _attend_ring(
     q: jnp.ndarray,          # [b, 1, nh, hd] — rope'd query for this step
     ck: jnp.ndarray,         # [b, W, nkv, hd] ring cache (slot = pos % W)
@@ -234,7 +208,14 @@ def _decode_step(
     here is single-host over replicated weights.  ``mlp_layer`` (built by
     :func:`_mlp_layer_for`) serves blocks carrying an ``"mlp"`` params
     key — the MoE feed-forward runs its own apply on the single-token
-    hidden states (capacity >= 1 even at one token)."""
+    hidden states (capacity >= 1 even at one token).
+
+    The non-ring path IS :func:`_decode_chunk` at ``g=1`` (one shared
+    per-block body, so a model-family quirk added there serves decode
+    and speculative verification alike); only the ring slot/attend
+    specialization lives here."""
+    if not ring:
+        return _decode_chunk(cfg, block_params, x, cache, mlp_layer)
     b = x.shape[0]
     hd = cfg.head_dim
     pos = cache.length
@@ -268,7 +249,7 @@ def _decode_step(
             k = _rms(k, p["kn"], cfg.norm_eps)
         q = _rope(q, cfg.rope_theta, pos)
         k = _rope(k, cfg.rope_theta, pos)
-        slot = jnp.mod(pos, ck.shape[1]) if ring else pos
+        slot = jnp.mod(pos, ck.shape[1])
         if quant:
             kq, ks = _quant_rows(k)
             vq, vs = _quant_rows(v)
@@ -287,12 +268,7 @@ def _decode_step(
                 cv, v.astype(cv.dtype), slot, 1
             )
             rk, rv = ck, cv
-        attn = (
-            _attend_ring(q, rk, rv, pos)
-            if ring
-            else _attend_cached(q, rk, rv, pos, cfg.attn_window)
-        )
-        attn = attn.astype(x.dtype)
+        attn = _attend_ring(q, rk, rv, pos).astype(x.dtype)
         o = attn @ p["wo"]
         if "lora" in p:
             o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
@@ -307,6 +283,120 @@ def _decode_step(
             length=pos + 1,
         )
     return x, KVCache(k=new_k, v=new_v, length=pos + 1)
+
+
+def _attend_chunk(
+    q: jnp.ndarray,          # [b, g, nh, hd] — rope'd queries, positions pos0..pos0+g-1
+    ck: jnp.ndarray,         # [b, max_len, nkv, hd]
+    cv: jnp.ndarray,
+    pos0: jnp.ndarray,       # [] int32 — first query's position
+    window: Optional[int],
+) -> jnp.ndarray:
+    """Causal attention of ``g`` consecutive queries against the cache —
+    one MXU-friendly einsum instead of g masked cache reads.  Query i
+    (position ``pos0+i``) sees cache rows ``<= pos0+i`` (optionally
+    banded); ``g=1`` is the plain single-token decode read."""
+    b, g, nh, hd = q.shape
+    max_len = ck.shape[1]
+    nkv = ck.shape[2]
+    r = nh // nkv
+    qg = q.reshape(b, g, nkv, r, hd)
+    scores = jnp.einsum(
+        "bqgrd,bsgd->bgrqs", qg.astype(jnp.float32), ck.astype(jnp.float32)
+    ) * (hd ** -0.5)
+    qpos = pos0 + jnp.arange(g)[:, None]          # [g, 1]
+    idx = jnp.arange(max_len)[None, :]            # [1, max_len]
+    valid = idx <= qpos
+    if window is not None:
+        valid &= idx > qpos - window
+    scores = jnp.where(valid[None, None, None, :, :], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrqs,bsgd->bqgrd", p, cv.astype(jnp.float32))
+    return out.reshape(b, g, nh * hd)
+
+
+def _decode_chunk(
+    cfg: TransformerConfig,
+    block_params: List[Pytree],
+    x: jnp.ndarray,              # [b, g, dim] — embedded token chunk
+    cache: Any,
+    mlp_layer: Optional[Any] = None,
+) -> Tuple[jnp.ndarray, Any]:
+    """``g`` consecutive tokens through all blocks in ONE pass,
+    reading+extending the cache — the batched generalization of
+    :func:`_decode_step` (same math per position; ``g=1`` agrees with it
+    exactly, tested).  This is what makes speculative verification a
+    single MXU matmul per block instead of γ sequential cache reads.
+    Plain and quantized caches; ring caches are not supported (the
+    speculative path that needs chunks rolls positions back, which a
+    ring's slot reuse cannot undo)."""
+    b, g, _ = x.shape
+    hd = cfg.head_dim
+    pos0 = cache.length
+    quant = isinstance(cache, QuantKVCache)
+    new_k, new_v = [], []
+    new_ks, new_vs = [], []
+    scales = (
+        zip(cache.k_scale, cache.v_scale)
+        if quant
+        else ((None, None) for _ in cache.k)
+    )
+    for p, ck, cv, (cks, cvs) in zip(
+        block_params, cache.k, cache.v, scales
+    ):
+        nh_loc = p["wq"].shape[1] // hd
+        nkv_loc = p["wk"].shape[1] // hd
+        h = _rms(x, p["ln1"], cfg.norm_eps)
+        q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
+        if "lora" in p:
+            lo = p["lora"]
+            q = q + _lora_delta(cfg, lo, h, "qa", "qb")
+            k = k + _lora_delta(cfg, lo, h, "ka", "kb")
+            v = v + _lora_delta(cfg, lo, h, "va", "vb")
+        if "bq" in p:  # Qwen2-style projection biases
+            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+        q = q.reshape(b, g, nh_loc, hd)
+        k = k.reshape(b, g, nkv_loc, hd)
+        v = v.reshape(b, g, nkv_loc, hd)
+        if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
+            q = _rms(q, p["qn"], cfg.norm_eps)
+            k = _rms(k, p["kn"], cfg.norm_eps)
+        q = _rope(q, cfg.rope_theta, pos0)
+        k = _rope(k, cfg.rope_theta, pos0)
+        if quant:
+            kq, ks = _quant_rows(k)
+            vq, vs = _quant_rows(v)
+            ck = lax.dynamic_update_slice_in_dim(ck, kq, pos0, 1)
+            cv = lax.dynamic_update_slice_in_dim(cv, vq, pos0, 1)
+            cks = lax.dynamic_update_slice_in_dim(cks, ks, pos0, 1)
+            cvs = lax.dynamic_update_slice_in_dim(cvs, vs, pos0, 1)
+            rk, rv = _dequant_rows(ck, cks), _dequant_rows(cv, cvs)
+            new_ks.append(cks)
+            new_vs.append(cvs)
+        else:
+            ck = lax.dynamic_update_slice_in_dim(
+                ck, k.astype(ck.dtype), pos0, 1
+            )
+            cv = lax.dynamic_update_slice_in_dim(
+                cv, v.astype(cv.dtype), pos0, 1
+            )
+            rk, rv = ck, cv
+        attn = _attend_chunk(q, rk, rv, pos0, cfg.attn_window)
+        attn = attn.astype(x.dtype)
+        o = attn @ p["wo"]
+        if "lora" in p:
+            o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
+        x = x + o
+        h = _rms(x, p["ln2"], cfg.norm_eps)
+        x = x + _mlp_out(cfg, p, h, mlp_layer)
+        new_k.append(ck)
+        new_v.append(cv)
+    if quant:
+        return x, QuantKVCache(
+            k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs,
+            length=pos0 + g,
+        )
+    return x, KVCache(k=new_k, v=new_v, length=pos0 + g)
 
 
 def _total_len(s: int, max_new_tokens: int, max_len: Optional[int]) -> int:
@@ -353,19 +443,49 @@ def _logits(cfg: TransformerConfig, head_params: Pytree,
     return (h @ _head_w(cfg, head_params)).astype(jnp.float32)
 
 
+def _filter_logits(
+    logits: jnp.ndarray,        # [..., vocab] f32
+    temperature: float,
+    top_k: Optional[int],
+    top_p: Optional[float],
+) -> jnp.ndarray:
+    """Temperature-scaled logits with top-k / nucleus (top-p) masking
+    applied — the distribution ``categorical`` (and the speculative
+    accept test) actually samples from.  Filters compose in the usual
+    order: scale by temperature, keep the top-k, then keep the smallest
+    prefix of the sorted distribution whose cumulative probability
+    covers ``top_p`` (the most-probable token always survives)."""
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        srt = jnp.sort(logits, axis=-1)[..., ::-1]          # desc
+        probs = jax.nn.softmax(srt, axis=-1)
+        # Exclusive cumulative mass before each sorted slot: slot i stays
+        # iff the mass of strictly-better slots is still < top_p.
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        keep_sorted = cum < top_p
+        # Cutoff logit = the smallest kept sorted value; everything below
+        # it is outside the nucleus.  Ties at the cutoff are kept (they
+        # were interchangeable under the sort).
+        n_keep = jnp.sum(keep_sorted, axis=-1, keepdims=True)  # >= 1
+        cutoff = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def _sample(
     logits: jnp.ndarray,        # [b, vocab] f32
     key: jnp.ndarray,
     temperature: float,
     top_k: Optional[int],
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(
-        key, logits / temperature, axis=-1
+        key, _filter_logits(logits, temperature, top_k, top_p), axis=-1
     ).astype(jnp.int32)
 
 
@@ -377,7 +497,7 @@ def _attend_full(
     use_flash: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Causal (optionally banded) full-sequence attention, GQA-grouped —
-    the batched twin of :func:`_attend_cached` (prefill's one big
+    the batched twin of :func:`_attend_chunk` (prefill's one big
     MXU-friendly pass instead of s cache reads).
 
     ``use_flash=None`` auto-dispatches the Pallas flash kernel on TPU
@@ -538,6 +658,7 @@ def generate(
     *,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     rng: Optional[jnp.ndarray] = None,
     max_len: Optional[int] = None,
@@ -550,7 +671,7 @@ def generate(
     """Autoregressive decode: returns ``[b, max_new_tokens]`` completions.
 
     ``temperature=0`` is greedy argmax (no rng needed); otherwise pass
-    ``rng`` for temperature/top-k sampling.  With ``eos_id`` set, rows
+    ``rng`` for temperature/top-k/top-p (nucleus) sampling.  With ``eos_id`` set, rows
     that have emitted it keep emitting ``eos_id`` (frozen — static
     shapes; trim host-side).  Everything compiles to ONE program:
     prefill scan + decode scan.
@@ -614,7 +735,7 @@ def generate(
     def step(carry, _):
         cache, logits, key, alive = carry
         key, sub = jax.random.split(key)
-        tok = _sample(logits, sub, temperature, top_k)
+        tok = _sample(logits, sub, temperature, top_k, top_p)
         if eos_id is not None:
             tok = jnp.where(alive, tok, eos_id)
             alive = alive & (tok != eos_id)
@@ -764,6 +885,257 @@ def beam_search(
     return out, jnp.where(use_fin, fin_lp, best_lp)
 
 
+class SpecStats(NamedTuple):
+    """Per-row speculative-decoding accounting (see
+    :func:`speculative_generate`): ``rounds`` draft-verify cycles ran,
+    ``drafted`` tokens were proposed in them, ``accepted`` passed the
+    target's test.  Emitted tokens = ``rounds + accepted`` (each round
+    lands its accepted prefix plus one target-sampled token), so the
+    per-target-pass speedup of the round trip is
+    ``(rounds + accepted) / rounds``."""
+
+    rounds: jnp.ndarray    # [b] int32
+    drafted: jnp.ndarray   # [b] int32
+    accepted: jnp.ndarray  # [b] int32
+
+
+def speculative_generate(
+    cfg: TransformerConfig,
+    params: Pytree,
+    draft_cfg: TransformerConfig,
+    draft_params: Pytree,
+    prompt: jnp.ndarray,                 # [b, s] int32
+    max_new_tokens: int,
+    *,
+    gamma: int = 4,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
+    rng: Optional[jnp.ndarray] = None,
+    max_len: Optional[int] = None,
+    moe: Optional[Any] = None,
+    draft_moe: Optional[Any] = None,
+    return_stats: bool = False,
+) -> Any:
+    """Speculative decoding: a cheap ``draft`` model proposes ``gamma``
+    tokens per round, the target model judges them all in ONE chunked
+    forward (:func:`_decode_chunk` — a single MXU matmul per block
+    instead of gamma sequential cache reads), and the accepted prefix
+    plus one target-sampled token land at once.  Decode on TPU is
+    HBM-bandwidth-bound (every step re-reads the weights), so replacing
+    gamma target steps with one chunk pass is a direct bandwidth win at
+    typical acceptance rates.
+
+    Output distribution is EXACT (Leviathan et al., arXiv:2211.17192):
+    drafts are accepted with probability ``min(1, p/q)`` and rejections
+    resample from the normalized residual ``(p-q)+``, so emitted tokens
+    are distributed exactly as target-only sampling; with
+    ``temperature=0`` both models are deterministic and the output
+    equals target-only greedy decode token-for-token (tested against
+    :func:`generate` with an arbitrary draft) — up to float ties: the
+    chunked verify pass reassociates the same f32 sums the per-token
+    path computes, so a position whose top-2 target logits differ by
+    less than that reassociation error (~1e-4 relative) may resolve the
+    argmax either way.  ``temperature``/
+    ``top_k``/``top_p`` apply to BOTH distributions before the accept
+    test, matching the filtered target distribution :func:`generate`
+    samples from.
+
+    The models may differ in every dimension but must share the
+    tokenizer (``vocab``).  Full (non-ring, non-quantized) caches only:
+    a rejection rolls ``cache.length`` back to the accepted frontier,
+    which slot-reusing ring buffers cannot undo.  Rows are independent
+    (per-row acceptance, per-row cache frontiers) via ``vmap`` over a
+    batched ``lax.while_loop``.
+
+    Returns ``[b, max_new_tokens]`` tokens, or ``(tokens, stats)`` with
+    ``return_stats=True`` (:class:`SpecStats`: per-row rounds / drafted
+    / accepted — ``accepted/drafted`` is the acceptance rate that
+    decides whether the draft pays for itself)."""
+    b, s = prompt.shape
+    T = int(max_new_tokens)
+    g = int(gamma)
+    if g < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if cfg.vocab != draft_cfg.vocab:
+        raise ValueError(
+            "speculative decoding needs a shared tokenizer: target "
+            f"vocab {cfg.vocab} != draft vocab {draft_cfg.vocab}"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs rng=jax.random.PRNGKey")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # deterministic path; keys unused
+    total = _total_len(s, T, max_len)
+    # Chunk writes run up to gamma+1 past the accepted frontier before
+    # rolling back; pad the buffers so dynamic_update_slice never clamps.
+    L = total + g + 1
+
+    embed_p, block_p, head_p = _split_params(cfg, params)
+    d_embed_p, d_block_p, d_head_p = _split_params(draft_cfg, draft_params)
+    mlp_layer = _mlp_layer_for(cfg, moe)
+    d_mlp_layer = _mlp_layer_for(draft_cfg, draft_moe)
+    greedy = temperature == 0.0
+
+    # Prefill BOTH models batched, outside the per-row loop: the prompt
+    # pass stays one MXU-friendly (optionally flash) forward; only the
+    # draft-verify rounds need per-row independence.
+    t_logits0, tcache0 = prefill(cfg, params, prompt, L, moe=moe)
+    _, dcache0 = prefill(draft_cfg, draft_params, prompt, L, moe=draft_moe)
+    rng, sub = jax.random.split(rng)
+    tok0_b = _sample(t_logits0, sub, temperature, top_k, top_p)    # [b]
+    alive0_b = (
+        jnp.ones((b,), bool) if eos_id is None else tok0_b != eos_id
+    )
+    out0_b = jnp.zeros((b, T), jnp.int32).at[:, 0].set(tok0_b)
+    keys = jax.random.split(rng, b)
+
+    def row(
+        tok0: jnp.ndarray,       # [] int32 — this row's first token
+        out: jnp.ndarray,        # [T] int32 — buffer with out[0] set
+        alive: jnp.ndarray,      # [] bool
+        key: jnp.ndarray,
+        tc: Any,                 # this row's cache slices, batch axis stripped
+        dc: Any,
+    ):
+        tcache = KVCache(
+            k=[a[None] for a in tc.k], v=[a[None] for a in tc.v],
+            length=tc.length,
+        )
+        dcache = KVCache(
+            k=[a[None] for a in dc.k], v=[a[None] for a in dc.v],
+            length=dc.length,
+        )
+
+        def cond(carry):
+            return carry[0] < T
+
+        def body(carry):
+            n, tok, tcache, dcache, out, alive, key, stats = carry
+            rounds, drafted, accepted = stats
+
+            # --- draft phase: g proposals + 1 banking step ------------- #
+            def dstep(c, _):
+                dc, cur, k = c
+                x = _embed(draft_cfg, d_embed_p, cur[None, None])
+                x, dc = _decode_step(
+                    draft_cfg, d_block_p, x, dc, d_mlp_layer
+                )
+                ql = _logits(draft_cfg, d_head_p, x)[0, 0]    # [V]
+                k, sub = jax.random.split(k)
+                if greedy:
+                    nxt = jnp.argmax(ql).astype(jnp.int32)
+                    qf = ql
+                else:
+                    qf = _filter_logits(ql, temperature, top_k, top_p)
+                    nxt = jax.random.categorical(sub, qf).astype(jnp.int32)
+                return (dc, nxt, k), (nxt, qf)
+
+            (dcache2, _, key), (drafts, q_logits) = lax.scan(
+                dstep, (dcache, tok, key), None, length=g + 1
+            )
+            # drafts[0:g] are the proposals; the g+1-th feed only banked
+            # drafts[g-1]'s kv (its sample/dist are never used).
+
+            # --- target phase: ONE chunk over [tok, d_1..d_g] ---------- #
+            chunk = jnp.concatenate([tok[None], drafts[:g]])   # [g+1]
+            x = _embed(cfg, embed_p, chunk[None, :])
+            x, tcache2 = _decode_chunk(cfg, block_p, x, tcache, mlp_layer)
+            p_logits = _logits(cfg, head_p, x)[0]              # [g+1, V]
+
+            # --- accept / correct -------------------------------------- #
+            if greedy:
+                t_argmax = jnp.argmax(p_logits, axis=-1).astype(jnp.int32)
+                accs = drafts[:g] == t_argmax[:g]
+            else:
+                pf = _filter_logits(p_logits, temperature, top_k, top_p)
+                p_probs = jax.nn.softmax(pf, axis=-1)          # [g+1, V]
+                q_probs = jax.nn.softmax(q_logits, axis=-1)    # [g+1, V]
+                key, sub = jax.random.split(key)
+                u = jax.random.uniform(sub, (g,))
+                d_idx = drafts[:g]
+                p_at = jnp.take_along_axis(
+                    p_probs[:g], d_idx[:, None], axis=-1
+                )[:, 0]
+                q_at = jnp.take_along_axis(
+                    q_probs[:g], d_idx[:, None], axis=-1
+                )[:, 0]
+                accs = u * q_at < p_at
+            n_acc = jnp.sum(jnp.cumprod(accs.astype(jnp.int32)))
+
+            if greedy:
+                last_tok = t_argmax[n_acc]
+            else:
+                # Bonus (all accepted): sample p[g].  Correction
+                # (rejected at n_acc): sample the normalized residual
+                # (p-q)+ at n_acc; if the residual vanishes numerically
+                # (p≈q — a rejection there is measure-zero but floats),
+                # fall back to p itself.
+                p_row = p_probs[n_acc]
+                q_row = q_probs[jnp.minimum(n_acc, g - 1)]
+                resid = jnp.maximum(p_row - q_row, 0.0)
+                rsum = jnp.sum(resid)
+                corr_row = jnp.where(rsum > 1e-9, resid / rsum, p_row)
+                final_row = jnp.where(n_acc == g, p_row, corr_row)
+                key, sub = jax.random.split(key)
+                last_tok = jax.random.categorical(
+                    sub, jnp.log(jnp.maximum(final_row, 1e-38))
+                ).astype(jnp.int32)
+
+            rt = (
+                jnp.concatenate([drafts[:g], jnp.zeros((1,), jnp.int32)])
+                .at[n_acc].set(last_tok)
+            )                                                  # [g+1]
+
+            # --- EOS freeze inside the round --------------------------- #
+            if eos_id is None:
+                rt_eff, alive2 = rt, alive
+            else:
+                def estep(a, ti):
+                    t, i = ti
+                    t_eff = jnp.where(a, t, eos_id)
+                    a = jnp.where(
+                        i <= n_acc, a & (t_eff != eos_id), a
+                    )
+                    return a, t_eff
+
+                alive2, rt_eff = lax.scan(
+                    estep, alive, (rt, jnp.arange(g + 1))
+                )
+
+            # --- emit + roll both caches back to the frontier ---------- #
+            ii = jnp.arange(g + 1)
+            wi = jnp.where(ii <= n_acc, n + ii, T)  # T = dropped
+            out = out.at[wi].set(rt_eff, mode="drop")
+            frontier = tcache.length + 1 + n_acc
+            tcache2 = tcache2._replace(length=frontier)
+            dcache2 = dcache2._replace(length=frontier)
+            stats = (rounds + 1, drafted + g, accepted + n_acc)
+            return (
+                n + 1 + n_acc, rt_eff[n_acc], tcache2, dcache2, out,
+                alive2, key, stats,
+            )
+
+        z = jnp.zeros((), jnp.int32)
+        carry = (
+            jnp.ones((), jnp.int32), tok0, tcache, dcache, out, alive,
+            key, (z, z, z),
+        )
+        n, _, _, _, out, _, _, stats = lax.while_loop(cond, body, carry)
+        return out, stats
+
+    cache_axes = KVCache(k=0, v=0, length=None)
+    outs, (rounds, drafted, accepted) = jax.vmap(
+        row, in_axes=(0, 0, 0, 0, cache_axes, cache_axes)
+    )(tok0_b, out0_b, alive0_b, keys, tcache0, dcache0)
+    if return_stats:
+        return outs, SpecStats(
+            rounds=rounds, drafted=drafted, accepted=accepted
+        )
+    return outs
+
+
 def mpmd_params_for_generation(
     model: Any, params: Any, device: Any = None
 ) -> List[Pytree]:
@@ -904,12 +1276,14 @@ def spmd_params_for_generation(
 __all__ = [
     "KVCache",
     "QuantKVCache",
+    "SpecStats",
     "beam_search",
     "init_cache",
     "init_quant_cache",
     "prefill",
     "generate",
     "mpmd_params_for_generation",
+    "speculative_generate",
     "spmd_params_for_generation",
     "spmd_params_from_flat",
 ]
