@@ -51,6 +51,17 @@ def main() -> None:
                          "cache memory and per-step reads")
     ap.add_argument("--kv-quant", action="store_true",
                     help="int8 KV caches (half the bf16 footprint)")
+    ap.add_argument("--draft", choices=sorted(PRESETS), default=None,
+                    help="speculative decoding: preset of the DRAFT model "
+                         "(untrained weights — greedy acceptance then "
+                         "reflects draft/target agreement by luck only, so "
+                         "the interesting column is ms/token at a GIVEN "
+                         "acceptance; --self-draft shows the ceiling)")
+    ap.add_argument("--self-draft", action="store_true",
+                    help="speculative decoding with draft == target: 100%% "
+                         "acceptance, the per-round overhead ceiling")
+    ap.add_argument("--gamma", type=int, default=4,
+                    help="drafts per speculative round")
     args = ap.parse_args()
 
     dim, n_layers, nh, nkv, vocab = PRESETS[args.preset]
@@ -65,22 +76,74 @@ def main() -> None:
     prompt = jnp.mod(jnp.arange(b * s).reshape(b, s), vocab).astype(jnp.int32)
 
     mode = "ring" if args.ring else "full"
-    run = jax.jit(
-        lambda p, t: generate(
-            cfg, p, t, max_new_tokens=new, cache_mode=mode,
-            kv_quant=args.kv_quant,
+    spec_tag = ""
+    acc_line = ""
+    if args.self_draft or args.draft:
+        if args.ring or args.kv_quant:
+            raise SystemExit(
+                "--draft/--self-draft use full fp caches: speculative "
+                "rollback resets the cache frontier, which ring slot "
+                "reuse cannot undo and int8 rows would re-quantize; "
+                "drop --ring/--kv-quant"
+            )
+        from torchgpipe_tpu.models.generation import speculative_generate
+
+        if args.self_draft:
+            dcfg, dparams = cfg, params
+            spec_tag = f", speculative self-draft g{args.gamma}"
+        else:
+            ddim, dnl, dnh, dnkv, dvocab = PRESETS[args.draft]
+            dcfg = TransformerConfig(
+                vocab=vocab, dim=ddim, n_layers=dnl, n_heads=dnh,
+                n_kv_heads=dnkv, dtype=cfg.dtype, attn_window=args.window,
+            )
+            dparams, _, _ = sequential_init(
+                llama(dcfg), jax.random.PRNGKey(1), spec
+            )
+            spec_tag = f", speculative draft={args.draft} g{args.gamma}"
+        run = jax.jit(
+            lambda p, dp, t: speculative_generate(
+                cfg, p, dcfg, dp, t, new, gamma=args.gamma,
+                return_stats=True,
+            )
         )
-    )
-    jax.block_until_ready(run(params, prompt))  # compile
-    best = float("inf")
-    for _ in range(args.steps):
-        t0 = time.perf_counter()
-        jax.block_until_ready(run(params, prompt))
-        best = min(best, time.perf_counter() - t0)
+        out, stats = run(params, dparams, prompt)
+        jax.block_until_ready(out)  # compile
+        best = float("inf")
+        for _ in range(args.steps):
+            t0 = time.perf_counter()
+            out, stats = run(params, dparams, prompt)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        import numpy as np
+
+        drafted = int(np.sum(np.asarray(stats.drafted)))
+        accepted = int(np.sum(np.asarray(stats.accepted)))
+        rounds = int(np.sum(np.asarray(stats.rounds)))
+        acc_line = (
+            f"  acceptance {accepted}/{drafted} "
+            f"({100 * accepted / max(drafted, 1):.0f}%), "
+            f"{rounds} target passes for {b * new} tokens "
+            f"({b * new / max(rounds, 1):.2f} tokens/pass)"
+        )
+    else:
+        run = jax.jit(
+            lambda p, t: generate(
+                cfg, p, t, max_new_tokens=new, cache_mode=mode,
+                kv_quant=args.kv_quant,
+            )
+        )
+        jax.block_until_ready(run(params, prompt))  # compile
+        best = float("inf")
+        for _ in range(args.steps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run(params, prompt))
+            best = min(best, time.perf_counter() - t0)
     toks = b * new
     wtag = (f", window {args.window} ({mode} cache)"
             if args.window else "")
     wtag += ", int8-kv" if args.kv_quant else ""
+    wtag += spec_tag
     print(
         f"{args.preset}{wtag}: batch {b}, prompt {s}, {new} new tokens -> "
         f"{toks / best:.1f} tokens/sec "
@@ -88,6 +151,8 @@ def main() -> None:
         f"platform {jax.devices()[0].platform})",
         flush=True,
     )
+    if acc_line:
+        print(acc_line, flush=True)
 
 
 if __name__ == "__main__":

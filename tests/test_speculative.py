@@ -26,7 +26,6 @@ import pytest
 
 from torchgpipe_tpu.layers import sequential_init
 from torchgpipe_tpu.models.generation import (
-    KVCache,
     _decode_chunk,
     _decode_step,
     _embed,
@@ -34,8 +33,6 @@ from torchgpipe_tpu.models.generation import (
     _logits,
     _split_params,
     generate,
-    init_cache,
-    init_quant_cache,
     prefill,
     speculative_generate,
 )
@@ -130,7 +127,13 @@ def test_greedy_speculative_equals_generate(gamma):
     """With temperature=0 the speculative output must equal target-only
     greedy decode TOKEN-FOR-TOKEN, whatever the draft proposes (here an
     unrelated, differently-shaped model) — gamma=8 overshoots T inside
-    a round, exercising the drop-past-the-buffer path."""
+    a round, exercising the drop-past-the-buffer path.
+
+    Exact equality is safe here because the suite pins the CPU backend
+    (conftest): the chunked verify pass reassociates f32 sums, so a
+    spurious mismatch on some future jax build means a float argmax tie
+    (top-2 logits within ~1e-4 relative) — loosen to a tie-aware compare
+    then, per the speculative_generate docstring."""
     b, s, T = 2, 5, 9
     params = _params(CFG, 0)
     draft_params = _params(DRAFT, 123)

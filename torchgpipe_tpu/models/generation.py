@@ -36,8 +36,11 @@ Design, TPU-first:
   ``p_j >= 0`` the only mask needed).
 
 Sampling: greedy (``temperature=0``) or temperature softmax sampling
-with optional top-k truncation, driven by an explicit ``jax.random``
-key (deterministic, reproducible — the framework-wide RNG discipline).
+with optional top-k truncation and top-p (nucleus) filtering, driven by
+an explicit ``jax.random`` key (deterministic, reproducible — the
+framework-wide RNG discipline).  :func:`speculative_generate` wraps the
+same machinery in a draft-propose / chunk-verify loop with the exact
+output distribution (accept ``min(1, p/q)``, resample the residual).
 
 Scope: single-host decode over replicated weights.  Pipelined decode
 (pp-sharded stages serving one token stream) is latency-bound by design
