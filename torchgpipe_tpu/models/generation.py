@@ -68,6 +68,7 @@ from jax import lax
 from torchgpipe_tpu.models.transformer import (
     TransformerConfig,
     _act_fn,
+    _block_norm,
     _head_w,
     _lora_delta,
     _rms,
@@ -141,13 +142,20 @@ def init_quant_cache(
 
 
 def _embed(cfg: TransformerConfig, embed_p: Pytree,
-           tokens: jnp.ndarray) -> jnp.ndarray:
+           tokens: jnp.ndarray, pos0: Any = 0) -> jnp.ndarray:
     """Token embedding with the optional Gemma-style output scaling (the
     tied head reads the UNSCALED table, so the scale lives here, not in
-    the table) — mirrors token_embedding.apply."""
+    the table) — mirrors token_embedding.apply.  A learned position
+    table (GPT-2 class, ``embed_p['pos']``) adds rows at ``pos0 +
+    arange(s)`` — decode callers pass ``cache.length``."""
     x = jnp.take(embed_p["table"], tokens, axis=0)
     if cfg.embed_scale is not None:
         x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+    if "pos" in embed_p:
+        s = tokens.shape[-1]
+        x = x + jnp.take(
+            embed_p["pos"], pos0 + jnp.arange(s), axis=0
+        ).astype(x.dtype)
     return x
 
 
@@ -235,7 +243,7 @@ def _decode_step(
     ):
         nh_loc = p["wq"].shape[1] // hd
         nkv_loc = p["wk"].shape[1] // hd
-        h = _rms(x, p["ln1"], cfg.norm_eps)
+        h = _block_norm(cfg, p, "ln1", x)
         q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
         if "lora" in p:
             lo = p["lora"]
@@ -250,8 +258,9 @@ def _decode_step(
         if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
             q = _rms(q, p["qn"], cfg.norm_eps)
             k = _rms(k, p["kn"], cfg.norm_eps)
-        q = _rope(q, cfg.rope_theta, pos)
-        k = _rope(k, cfg.rope_theta, pos)
+        if cfg.pos_emb == "rope":
+            q = _rope(q, cfg.rope_theta, pos)
+            k = _rope(k, cfg.rope_theta, pos)
         slot = jnp.mod(pos, ck.shape[1])
         if quant:
             kq, ks = _quant_rows(k)
@@ -275,8 +284,10 @@ def _decode_step(
         o = attn @ p["wo"]
         if "lora" in p:
             o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
+        if "bo" in p:
+            o = o + p["bo"]
         x = x + o
-        h = _rms(x, p["ln2"], cfg.norm_eps)
+        h = _block_norm(cfg, p, "ln2", x)
         x = x + _mlp_out(cfg, p, h, mlp_layer)
         new_k.append(ck)
         new_v.append(cv)
@@ -349,7 +360,7 @@ def _decode_chunk(
     ):
         nh_loc = p["wq"].shape[1] // hd
         nkv_loc = p["wk"].shape[1] // hd
-        h = _rms(x, p["ln1"], cfg.norm_eps)
+        h = _block_norm(cfg, p, "ln1", x)
         q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
         if "lora" in p:
             lo = p["lora"]
@@ -364,8 +375,9 @@ def _decode_chunk(
         if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
             q = _rms(q, p["qn"], cfg.norm_eps)
             k = _rms(k, p["kn"], cfg.norm_eps)
-        q = _rope(q, cfg.rope_theta, pos0)
-        k = _rope(k, cfg.rope_theta, pos0)
+        if cfg.pos_emb == "rope":
+            q = _rope(q, cfg.rope_theta, pos0)
+            k = _rope(k, cfg.rope_theta, pos0)
         if quant:
             kq, ks = _quant_rows(k)
             vq, vs = _quant_rows(v)
@@ -389,8 +401,10 @@ def _decode_chunk(
         o = attn @ p["wo"]
         if "lora" in p:
             o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
+        if "bo" in p:
+            o = o + p["bo"]
         x = x + o
-        h = _rms(x, p["ln2"], cfg.norm_eps)
+        h = _block_norm(cfg, p, "ln2", x)
         x = x + _mlp_out(cfg, p, h, mlp_layer)
         new_k.append(ck)
         new_v.append(cv)
@@ -410,6 +424,21 @@ def _total_len(s: int, max_new_tokens: int, max_len: Optional[int]) -> int:
             f"max_new_tokens ({max_new_tokens})"
         )
     return total
+
+
+def _check_max_pos(cfg: TransformerConfig, positions: int) -> None:
+    """Fail fast when a decode would run past a learned position table:
+    ``jnp.take`` CLAMPS out-of-range indices under jit, so position
+    ``max_pos`` would silently reuse the last row — degraded output with
+    no error.  All lengths here are static, so the check is free."""
+    if cfg.pos_emb == "learned" and positions > cfg.max_pos:
+        raise ValueError(
+            f"this decode reaches position {positions - 1} but the "
+            f"learned position table has max_pos={cfg.max_pos} rows "
+            "(GPT-2-class models cannot extend context by decoding "
+            "further; shorten prompt + max_new_tokens or retrain with a "
+            "larger max_pos)"
+        )
 
 
 def _mlp_layer_for(cfg: TransformerConfig, moe: Optional[Any]) -> Optional[Any]:
@@ -433,6 +462,9 @@ def _mlp_out(cfg: TransformerConfig, p: Pytree, h: jnp.ndarray,
             )
         out, _ = mlp_layer.apply(p["mlp"], (), h, rng=None, train=False)
         return out.astype(h.dtype)
+    if "w_fc" in p:  # classic (GPT-2-style) fc -> act -> proj
+        hid = _act_fn(cfg.act)(h @ p["w_fc"] + p["b_fc"])
+        return hid @ p["w_proj"] + p["b_proj"]
     gate = _act_fn(cfg.act)(h @ p["w_gate"])
     up = h @ p["w_up"]
     return (gate * up) @ p["w_down"]
@@ -440,7 +472,7 @@ def _mlp_out(cfg: TransformerConfig, p: Pytree, h: jnp.ndarray,
 
 def _logits(cfg: TransformerConfig, head_params: Pytree,
             x: jnp.ndarray) -> jnp.ndarray:
-    h = _rms(x, head_params["scale"], cfg.norm_eps)
+    h = _block_norm(cfg, head_params, "scale", x)
     # _head_w: own 'w', or the tied embedding table transposed (with the
     # didactic error when neither is present).
     return (h @ _head_w(cfg, head_params)).astype(jnp.float32)
@@ -559,6 +591,7 @@ def prefill(
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds max_len {max_len}")
+    _check_max_pos(cfg, s)
     if ring and cfg.attn_window is None:
         raise ValueError(
             "ring caches hold exactly the attention window: set "
@@ -601,7 +634,7 @@ def prefill(
     ):
         nh_loc = p["wq"].shape[1] // hd
         nkv_loc = p["wk"].shape[1] // hd
-        h = _rms(x, p["ln1"], cfg.norm_eps)
+        h = _block_norm(cfg, p, "ln1", x)
         q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
         if "lora" in p:
             lo = p["lora"]
@@ -616,15 +649,18 @@ def prefill(
         if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
             q = _rms(q, p["qn"], cfg.norm_eps)
             k = _rms(k, p["kn"], cfg.norm_eps)
-        q = _rope(q, cfg.rope_theta, 0)
-        k = _rope(k, cfg.rope_theta, 0)
+        if cfg.pos_emb == "rope":
+            q = _rope(q, cfg.rope_theta, 0)
+            k = _rope(k, cfg.rope_theta, 0)
         attn = _attend_full(q, k, v, cfg.attn_window, use_flash)
         attn = attn.astype(x.dtype)
         o = attn @ p["wo"]
         if "lora" in p:
             o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
+        if "bo" in p:
+            o = o + p["bo"]
         x = x + o
-        h = _rms(x, p["ln2"], cfg.norm_eps)
+        h = _block_norm(cfg, p, "ln2", x)
         x = x + _mlp_out(cfg, p, h, mlp_layer)
         if ring:
             # Slot j gets the newest prompt position congruent to j
@@ -702,6 +738,7 @@ def generate(
     ring caches wrap and never run out)."""
     b, s = prompt.shape
     total = _total_len(s, max_new_tokens, max_len)
+    _check_max_pos(cfg, total)
     if cache_mode not in ("full", "ring"):
         raise ValueError(
             f"cache_mode must be 'full' or 'ring', got {cache_mode!r}"
@@ -728,7 +765,7 @@ def generate(
         # Continuation: absorb this turn's tokens through the decode
         # path (teacher-forced) — exact for every cache layout.
         def absorb(cache, tok):
-            x = _embed(cfg, embed_p, tok[:, None])
+            x = _embed(cfg, embed_p, tok[:, None], cache.length)
             x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer, ring)
             return cache, _logits(cfg, head_p, x)[:, 0]
 
@@ -742,7 +779,7 @@ def generate(
         if eos_id is not None:
             tok = jnp.where(alive, tok, eos_id)
             alive = alive & (tok != eos_id)
-        x = _embed(cfg, embed_p, tok[:, None])
+        x = _embed(cfg, embed_p, tok[:, None], cache.length)
         x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer, ring)
         return (cache, _logits(cfg, head_p, x)[:, 0], key, alive), tok
 
@@ -783,6 +820,7 @@ def beam_search(
     if k < 1:
         raise ValueError(f"num_beams must be >= 1, got {k}")
     total = _total_len(s, max_new_tokens, max_len)
+    _check_max_pos(cfg, total)
     embed_p, block_p, head_p = _split_params(cfg, params)
     mlp_layer = _mlp_layer_for(cfg, moe)
     logits0, cache = prefill(cfg, params, prompt, total, moe=moe)
@@ -799,7 +837,7 @@ def beam_search(
     )
 
     def flat_decode(cache, tok):
-        x = _embed(cfg, embed_p, tok.reshape(b * k, 1))
+        x = _embed(cfg, embed_p, tok.reshape(b * k, 1), cache.length)
         x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer)
         return cache, _logits(cfg, head_p, x)[:, 0]       # [b*k, V]
 
@@ -971,6 +1009,7 @@ def speculative_generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)  # deterministic path; keys unused
     total = _total_len(s, T, max_len)
+    _check_max_pos(cfg, total)
     # Chunk writes run up to gamma+1 past the accepted frontier before
     # rolling back; pad the buffers so dynamic_update_slice never clamps.
     L = total + g + 1
@@ -1021,7 +1060,7 @@ def speculative_generate(
             # --- draft phase: g proposals + 1 banking step ------------- #
             def dstep(c, _):
                 dc, cur, k = c
-                x = _embed(draft_cfg, d_embed_p, cur[None, None])
+                x = _embed(draft_cfg, d_embed_p, cur[None, None], dc.length)
                 x, dc = _decode_step(
                     draft_cfg, d_block_p, x, dc, d_mlp_layer
                 )
@@ -1043,7 +1082,7 @@ def speculative_generate(
 
             # --- target phase: ONE chunk over [tok, d_1..d_g] ---------- #
             chunk = jnp.concatenate([tok[None], drafts[:g]])   # [g+1]
-            x = _embed(cfg, embed_p, chunk[None, :])
+            x = _embed(cfg, embed_p, chunk[None, :], tcache.length)
             x, tcache2 = _decode_chunk(cfg, block_p, x, tcache, mlp_layer)
             p_logits = _logits(cfg, head_p, x)[0]              # [g+1, V]
 

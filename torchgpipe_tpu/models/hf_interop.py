@@ -506,17 +506,186 @@ def state_dict_to_hf(
     return sd
 
 
+def config_from_hf_gpt2(hf_config: Any) -> TransformerConfig:
+    """A :class:`TransformerConfig` equivalent to an HF ``GPT2Config`` —
+    the classic architecture: LayerNorm (centered, biased), learned
+    absolute positions, biased projections, a non-gated 4x gelu MLP, and
+    an always-tied head."""
+    dim = hf_config.n_embd
+    inner = getattr(hf_config, "n_inner", None) or 4 * dim
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    act_map = {"gelu_new": "gelu_tanh", "gelu_pytorch_tanh": "gelu_tanh",
+               "gelu": "gelu"}
+    if act not in act_map:
+        raise ValueError(
+            f"GPT-2 activation_function={act!r} is not computed here "
+            "(gelu_new / gelu_pytorch_tanh / gelu are)"
+        )
+    # Published attention variants this framework does not compute — a
+    # silent import would make every logit wrong with no error (the
+    # sibling importers' didactic-rejection discipline).
+    for knob in ("scale_attn_by_inverse_layer_idx", "reorder_and_upcast_attn"):
+        if getattr(hf_config, knob, False):
+            raise ValueError(
+                f"this GPT-2 checkpoint sets {knob}=True; that attention "
+                "variant (per-layer score scaling / upcast-reordered "
+                "matmul) is not computed here — importing would silently "
+                "diverge from HF"
+            )
+    cfg = TransformerConfig(
+        vocab=hf_config.vocab_size,
+        dim=dim,
+        n_layers=hf_config.n_layer,
+        n_heads=hf_config.n_head,
+        n_kv_heads=None,                       # MHA
+        mlp_ratio=inner / dim,
+        norm_eps=float(hf_config.layer_norm_epsilon),
+        norm="layernorm",
+        pos_emb="learned",
+        max_pos=int(hf_config.n_positions),
+        mlp_impl="classic",
+        act=act_map[act],
+        attn_bias=True,
+        attn_out_bias=True,
+        tie_embeddings=True,                   # GPT-2 always ties
+    )
+    if cfg.mlp_hidden != inner:
+        raise ValueError(
+            f"n_inner={inner} did not survive the mlp_ratio round-trip "
+            f"(got {cfg.mlp_hidden}) — custom checkpoint?"
+        )
+    return cfg
+
+
+def params_from_hf_gpt2(
+    state_dict: Dict[str, Any], cfg: TransformerConfig
+) -> List[Pytree]:
+    """Per-layer params in ``llama(cfg)`` order from a
+    ``GPT2LMHeadModel`` state dict.
+
+    Layout notes (verified numerically in ``tests/test_gpt2_interop.py``):
+    HF GPT-2 uses ``Conv1D`` modules whose weights are ALREADY
+    ``[in, out]`` (unlike ``Linear``'s ``[out, in]``), so projections map
+    without transposing; ``c_attn`` is the fused ``[dim, 3*dim]`` q/k/v
+    projection, split here; the per-head layout of each third matches
+    this framework's ``[..., n_heads, head_dim]`` reshape.  The
+    ``attn.bias`` causal-mask buffers in the state dict are masks, not
+    parameters, and are ignored."""
+    sd = state_dict
+    dim = cfg.dim
+    embed: Dict[str, Any] = {
+        "table": _v(sd["transformer.wte.weight"]),
+        "pos": _v(sd["transformer.wpe.weight"]),
+    }
+    out: List[Pytree] = [embed]
+    for i in range(cfg.n_layers):
+        p = f"transformer.h.{i}."
+        ca_w = _v(sd[p + "attn.c_attn.weight"])   # [dim, 3*dim]
+        ca_b = _v(sd[p + "attn.c_attn.bias"])     # [3*dim]
+        out.append({
+            "ln1": _v(sd[p + "ln_1.weight"]),
+            "ln1b": _v(sd[p + "ln_1.bias"]),
+            "wq": ca_w[:, :dim],
+            "wk": ca_w[:, dim : 2 * dim],
+            "wv": ca_w[:, 2 * dim :],
+            "bq": ca_b[:dim],
+            "bk": ca_b[dim : 2 * dim],
+            "bv": ca_b[2 * dim :],
+            "wo": _v(sd[p + "attn.c_proj.weight"]),
+            "bo": _v(sd[p + "attn.c_proj.bias"]),
+            "ln2": _v(sd[p + "ln_2.weight"]),
+            "ln2b": _v(sd[p + "ln_2.bias"]),
+            "w_fc": _v(sd[p + "mlp.c_fc.weight"]),
+            "b_fc": _v(sd[p + "mlp.c_fc.bias"]),
+            "w_proj": _v(sd[p + "mlp.c_proj.weight"]),
+            "b_proj": _v(sd[p + "mlp.c_proj.bias"]),
+        })
+    head: Dict[str, Any] = {
+        "scale": _v(sd["transformer.ln_f.weight"]),
+        "bias": _v(sd["transformer.ln_f.bias"]),
+    }
+    if cfg.tie_embeddings:
+        head["table"] = embed["table"]
+    else:
+        head["w"] = embed["table"].T  # untied copy for the MPMD path
+    out.append(head)
+    return out
+
+
+def from_hf_gpt2(model: Any, *, untie: bool = False) -> tuple:
+    """(cfg, per-layer params) from a live HF ``GPT2LMHeadModel`` —
+    the classic-architecture on-ramp (GPT-2 and its layout family).
+    ``untie=True`` imports the always-tied head as an untied copy for
+    the MPMD ``GPipe(llama(cfg))`` path, like the sibling importers."""
+    import dataclasses
+
+    cfg = config_from_hf_gpt2(model.config)
+    if untie:
+        cfg = dataclasses.replace(cfg, tie_embeddings=False)
+    return cfg, params_from_hf_gpt2(model.state_dict(), cfg)
+
+
+def state_dict_to_hf_gpt2(
+    params: List[Pytree], cfg: TransformerConfig
+) -> Dict[str, Any]:
+    """Export back to the ``GPT2LMHeadModel`` layout (mirror of
+    :func:`params_from_hf_gpt2`; Conv1D weights stay ``[in, out]``, the
+    fused ``c_attn`` is re-concatenated).  Tied heads omit
+    ``lm_head.weight`` — HF shares the embedding tensor itself.  An
+    UNTIED export (head ``w`` trained away from the table, e.g. after
+    ``untie=True`` fine-tuning) carries ``lm_head.weight``; load it into
+    a ``GPT2Config(tie_word_embeddings=False)`` model — the default tied
+    config would re-tie on load and silently discard the trained head."""
+    v = _torch_v
+    embed, blocks, head = params[0], params[1:-1], params[-1]
+    if len(blocks) != cfg.n_layers:
+        raise ValueError(
+            f"expected {cfg.n_layers} block params, got {len(blocks)}"
+        )
+    sd: Dict[str, Any] = {
+        "transformer.wte.weight": v(embed["table"]),
+        "transformer.wpe.weight": v(embed["pos"]),
+        "transformer.ln_f.weight": v(head["scale"]),
+        "transformer.ln_f.bias": v(head["bias"]),
+    }
+    if "w" in head:
+        sd["lm_head.weight"] = _torch_t(head["w"])
+    for i, bp in enumerate(blocks):
+        p = f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = v(bp["ln1"])
+        sd[p + "ln_1.bias"] = v(bp["ln1b"])
+        sd[p + "attn.c_attn.weight"] = v(
+            jnp.concatenate([bp["wq"], bp["wk"], bp["wv"]], axis=1)
+        )
+        sd[p + "attn.c_attn.bias"] = v(
+            jnp.concatenate([bp["bq"], bp["bk"], bp["bv"]])
+        )
+        sd[p + "attn.c_proj.weight"] = v(bp["wo"])
+        sd[p + "attn.c_proj.bias"] = v(bp["bo"])
+        sd[p + "ln_2.weight"] = v(bp["ln2"])
+        sd[p + "ln_2.bias"] = v(bp["ln2b"])
+        sd[p + "mlp.c_fc.weight"] = v(bp["w_fc"])
+        sd[p + "mlp.c_fc.bias"] = v(bp["b_fc"])
+        sd[p + "mlp.c_proj.weight"] = v(bp["w_proj"])
+        sd[p + "mlp.c_proj.bias"] = v(bp["b_proj"])
+    return sd
+
+
 __all__ = [
     "config_from_hf",
+    "config_from_hf_gpt2",
     "config_from_hf_mixtral",
     "params_from_hf",
+    "params_from_hf_gpt2",
     "params_from_hf_mixtral",
     "from_hf_gemma",
+    "from_hf_gpt2",
     "from_hf_llama",
     "from_hf_mixtral",
     "from_hf_qwen2",
     "from_hf_qwen3",
     "state_dict_to_hf",
+    "state_dict_to_hf_gpt2",
     "state_dict_to_hf_mixtral",
 ]
 

@@ -80,8 +80,25 @@ class TransformerConfig:
     # n_heads*head_dim back to dim).  None -> dim // n_heads.
     n_head_dim: Optional[int] = None
     # Feed-forward gate activation: 'silu' (Llama-family SwiGLU) or
-    # 'gelu_tanh' (Gemma-family GeGLU).
+    # 'gelu_tanh' (Gemma-family GeGLU; also GPT-2's gelu_new).
     act: str = "silu"
+    # ---- classic (GPT-2/Pythia-class) architecture knobs ------------- #
+    # Normalization: 'rms' (Llama family) or 'layernorm' (mean-centered,
+    # with bias params ``ln1b``/``ln2b`` per block and ``bias`` on the
+    # final norm — the GPT-2/OPT/Pythia class).
+    norm: str = "rms"
+    # Positions: 'rope' (rotary, the default) or 'learned' (absolute
+    # position embedding table ``pos`` [max_pos, dim] added at the
+    # embedding — GPT-2 class; requires ``max_pos``).
+    pos_emb: str = "rope"
+    max_pos: Optional[int] = None
+    # Feed-forward shape: 'gated' (SwiGLU/GeGLU two-matrix gate) or
+    # 'classic' (fc -> act -> proj with biases ``b_fc``/``b_proj``;
+    # hidden = mlp_ratio * dim exactly — GPT-2's 4x).
+    mlp_impl: str = "gated"
+    # Bias on the attention output projection (param ``bo`` — GPT-2 has
+    # biases on every projection; pair with attn_bias for q/k/v).
+    attn_out_bias: bool = False
     # Multiply embedding outputs by this factor (Gemma scales by
     # sqrt(dim); the TIED head still reads the unscaled table, matching
     # that family).  None -> no scaling.
@@ -118,9 +135,38 @@ class TransformerConfig:
 
     @property
     def mlp_hidden(self) -> int:
+        if self.mlp_impl == "classic":
+            # GPT-2-style: exactly ratio * dim (published sizes are
+            # MXU-friendly already: 4 * 768 = 3072, ...).  round() — not
+            # int() — so a ratio stored as n_inner/dim survives float
+            # round-trip (int() truncates 472.9999... to 472).
+            return int(round(self.mlp_ratio * self.dim))
         # Llama-style 2/3 * 4 * dim, rounded to a multiple of 128 (MXU tile).
         h = int(2 * self.mlp_ratio * self.dim / 3)
         return max(128, ((h + 127) // 128) * 128)
+
+    def validate_arch(self) -> None:
+        """Fail fast on unknown/inconsistent architecture knobs — called
+        by the layer builders so a typo'd config errors at model build,
+        not deep inside a trace."""
+        if self.norm not in ("rms", "layernorm"):
+            raise ValueError(
+                f"norm={self.norm!r}: expected 'rms' or 'layernorm'"
+            )
+        if self.pos_emb not in ("rope", "learned"):
+            raise ValueError(
+                f"pos_emb={self.pos_emb!r}: expected 'rope' or 'learned'"
+            )
+        if self.mlp_impl not in ("gated", "classic"):
+            raise ValueError(
+                f"mlp_impl={self.mlp_impl!r}: expected 'gated' or 'classic'"
+            )
+        if self.pos_emb == "learned" and not self.max_pos:
+            raise ValueError(
+                "pos_emb='learned' needs max_pos (the position table "
+                "size — HF GPT2Config.n_positions)"
+            )
+        _act_fn(self.act)  # raises on unknown activation names
 
 
 def _normal(
@@ -132,11 +178,47 @@ def _normal(
     return (std * jax.random.normal(rng, shape)).astype(dtype)
 
 
+def _norm(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    eps: float,
+    bias: Optional[jnp.ndarray] = None,
+    centered: bool = False,
+) -> jnp.ndarray:
+    """Trailing-dim normalization, f32 accumulation: RMS by default;
+    ``centered=True`` subtracts the mean first (LayerNorm), ``bias`` adds
+    the affine offset.  The un-centered bias-free path is bit-identical
+    to the historical ``_rms``."""
+    xf = x.astype(jnp.float32)
+    if centered:
+        xf = xf - jnp.mean(xf, -1, keepdims=True)
+    var = jnp.mean(jnp.square(xf), -1, keepdims=True)
+    y = (xf.astype(x.dtype) if centered else x)
+    y = y * jax.lax.rsqrt(var + eps).astype(x.dtype)
+    y = y * scale.astype(x.dtype)
+    if bias is not None:
+        y = y + bias.astype(x.dtype)
+    return y
+
+
 def _rms(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
     """RMS normalization over the trailing dim (f32 accumulation)."""
-    var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
-    y = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
-    return y * scale.astype(x.dtype)
+    return _norm(x, scale, eps)
+
+
+def _block_norm(
+    cfg: TransformerConfig, p: Any, key: str, x: jnp.ndarray
+) -> jnp.ndarray:
+    """The block's configured normalization at param ``key`` (``ln1``/
+    ``ln2``/head ``scale``): RMS, or LayerNorm when ``cfg.norm ==
+    'layernorm'`` (bias param ``key + 'b'`` if present, ``'bias'`` for
+    the head's ``scale``).  ONE definition shared by the training block
+    and every generation path."""
+    bkey = "bias" if key == "scale" else key + "b"
+    return _norm(
+        x, p[key], cfg.norm_eps,
+        bias=p.get(bkey), centered=cfg.norm == "layernorm",
+    )
 
 
 def _lora_delta(
@@ -158,7 +240,11 @@ def _act_fn(act: str) -> Callable[[jnp.ndarray], jnp.ndarray]:
         return jax.nn.silu
     if act == "gelu_tanh":
         return lambda x: jax.nn.gelu(x, approximate=True)
-    raise ValueError(f"unknown act {act!r}: expected 'silu' or 'gelu_tanh'")
+    if act == "gelu":  # exact (erf) variant — Pythia/GPT-NeoX class
+        return lambda x: jax.nn.gelu(x, approximate=False)
+    raise ValueError(
+        f"unknown act {act!r}: expected 'silu', 'gelu_tanh', or 'gelu'"
+    )
 
 
 def rms_norm(dim: int, *, eps: float = 1e-5, name: str = "rmsnorm") -> Layer:
@@ -211,6 +297,7 @@ def transformer_block(
     and its ``meta`` (param_specs / validate_mesh / ep_axis) is composed into
     the block's.
     """
+    cfg.validate_arch()
     dim, hd = cfg.dim, cfg.head_dim
     nh, nkv = cfg.n_heads, cfg.kv_heads
     hidden = cfg.mlp_hidden
@@ -227,6 +314,12 @@ def transformer_block(
             "wo": _normal(ks[3], (nh * hd, dim), std, dt),
             "ln2": jnp.ones((dim,)),
         }
+        if cfg.norm == "layernorm":
+            params.update(
+                ln1b=jnp.zeros((dim,)), ln2b=jnp.zeros((dim,))
+            )
+        if cfg.attn_out_bias:
+            params["bo"] = jnp.zeros((dim,), dt)
         if cfg.attn_bias:
             params.update(
                 bq=jnp.zeros((nh * hd,), dt),
@@ -249,7 +342,14 @@ def transformer_block(
                 "oa": _normal(lk[3], (nh * hd, r), std, dt),
                 "ob": jnp.zeros((r, dim), dt),
             }
-        if mlp is None:
+        if mlp is None and cfg.mlp_impl == "classic":
+            params.update(
+                w_fc=_normal(ks[4], (dim, hidden), std, dt),
+                b_fc=jnp.zeros((hidden,), dt),
+                w_proj=_normal(ks[6], (hidden, dim), hidden ** -0.5, dt),
+                b_proj=jnp.zeros((dim,), dt),
+            )
+        elif mlp is None:
             params.update(
                 w_gate=_normal(ks[4], (dim, hidden), std, dt),
                 w_up=_normal(ks[5], (dim, hidden), std, dt),
@@ -283,7 +383,7 @@ def transformer_block(
         nh_loc = params["wq"].shape[1] // hd
         nkv_loc = params["wk"].shape[1] // hd
 
-        h = _rms(x, params["ln1"], cfg.norm_eps)
+        h = _block_norm(cfg, params, "ln1", x)
         if tp_active:
             h = psum_grad(h, cfg.tp_axis)  # region entry: full grad upstream
         q, k, v = h @ params["wq"], h @ params["wk"], h @ params["wv"]
@@ -300,8 +400,9 @@ def transformer_block(
         if "qn" in params:  # Qwen3-style per-head q/k RMSNorm, pre-rope
             q = _rms(q, params["qn"], cfg.norm_eps)
             k = _rms(k, params["kn"], cfg.norm_eps)
-        q = _rope(q, cfg.rope_theta, pos_offset)
-        k = _rope(k, cfg.rope_theta, pos_offset)
+        if cfg.pos_emb == "rope":
+            q = _rope(q, cfg.rope_theta, pos_offset)
+            k = _rope(k, cfg.rope_theta, pos_offset)
         # GQA: K/V stay at n_kv heads — the attention kernel groups queries
         # at the compute site, so the sp ring only moves n_kv-head blocks.
         # Under tp, lanes hold contiguous head ranges, so the local q→kv
@@ -318,11 +419,24 @@ def transformer_block(
             )
         if tp_active:
             attn_out = psum_value(attn_out, cfg.tp_axis)  # region exit
+        if "bo" in params:
+            # After the tp psum: the bias is per-output-feature, added
+            # once — inside the region each lane would contribute a copy.
+            attn_out = attn_out + params["bo"]
         x = x + attn_out
 
-        h = _rms(x, params["ln2"], cfg.norm_eps)
+        h = _block_norm(cfg, params, "ln2", x)
         if mlp is not None:
             mlp_out, _ = mlp.apply(params["mlp"], (), h, rng=rng, train=train)
+        elif "w_fc" in params:
+            # Classic (GPT-2-style) feed-forward: fc -> act -> proj.
+            if tp_active:
+                h = psum_grad(h, cfg.tp_axis)
+            hid = _act_fn(cfg.act)(h @ params["w_fc"] + params["b_fc"])
+            mlp_out = hid @ params["w_proj"]
+            if tp_active:
+                mlp_out = psum_value(mlp_out, cfg.tp_axis)
+            mlp_out = mlp_out + params["b_proj"]  # once, post-psum
         else:
             if tp_active:
                 h = psum_grad(h, cfg.tp_axis)
@@ -405,6 +519,10 @@ def transformer_block(
             "wo": P() if tp is None else P(tp, None),
             "ln2": P(),
         }
+        if cfg.norm == "layernorm":
+            param_specs.update(ln1b=P(), ln2b=P())
+        if cfg.attn_out_bias:
+            param_specs["bo"] = P()  # per-dim, added post-psum: replicated
         if cfg.attn_bias:
             # Biases shard with their projection's output (head) dim.
             bias_spec = P() if tp is None else P(tp)
@@ -421,7 +539,14 @@ def transformer_block(
                 "va": P(), "vb": P(None, tp),
                 "oa": P(tp, None) if tp is not None else P(), "ob": P(),
             }
-        if mlp is None:
+        if mlp is None and cfg.mlp_impl == "classic":
+            param_specs.update(
+                w_fc=P(None, tp),
+                b_fc=P() if tp is None else P(tp),  # shards with hidden
+                w_proj=P(tp, None),
+                b_proj=P(),                         # added post-psum
+            )
+        elif mlp is None:
             param_specs.update(
                 w_gate=P(None, tp),
                 w_up=P(None, tp),
@@ -490,11 +615,21 @@ def _vocab_meta(cfg: TransformerConfig, table_spec: Any) -> dict:
 def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
     """Token embedding; vocab-parallel over ``cfg.tp_axis`` when set (each
     lane holds ``vocab/tp`` rows; out-of-shard tokens contribute zero and a
-    psum assembles the full embedding — Megatron's parallel embedding)."""
+    psum assembles the full embedding — Megatron's parallel embedding).
+
+    ``cfg.pos_emb='learned'`` adds an absolute position table ``pos``
+    (``[max_pos, dim]``, replicated — GPT-2 class); under a bound sp
+    axis each shard reads its GLOBAL position rows, mirroring the rope
+    offset."""
+    cfg.validate_arch()
 
     def init(rng, in_spec):
         del in_spec
-        return {"table": _normal(rng, (cfg.vocab, cfg.dim), 0.02, cfg.dtype)}, ()
+        p = {"table": _normal(rng, (cfg.vocab, cfg.dim), 0.02, cfg.dtype)}
+        if cfg.pos_emb == "learned":
+            k2 = jax.random.fold_in(rng, 1)
+            p["pos"] = _normal(k2, (cfg.max_pos, cfg.dim), 0.02, cfg.dtype)
+        return p, ()
 
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
@@ -511,10 +646,23 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
             # Gemma-style sqrt(dim) scaling; a TIED head still reads the
             # UNSCALED table (matching that family).
             out = out * jnp.asarray(cfg.embed_scale, out.dtype)
+        if "pos" in params:
+            s = x.shape[-1]
+            off = (
+                jax.lax.axis_index(cfg.sp_axis) * s
+                if axis_bound(cfg.sp_axis)
+                else 0
+            )
+            out = out + jnp.take(
+                params["pos"], off + jnp.arange(s), axis=0
+            ).astype(out.dtype)
         return out, state
 
     tp = cfg.tp_axis
-    meta = _vocab_meta(cfg, {"table": P(tp)})
+    table_spec = {"table": P(tp)}
+    if cfg.pos_emb == "learned":
+        table_spec["pos"] = P()
+    meta = _vocab_meta(cfg, table_spec)
     return Layer(name=name, init=init, apply=apply, meta=meta)
 
 
@@ -526,6 +674,8 @@ def _head_init(cfg: TransformerConfig) -> Callable:
     def init(rng, in_spec):
         del in_spec
         p = {"scale": jnp.ones((cfg.dim,))}
+        if cfg.norm == "layernorm":
+            p["bias"] = jnp.zeros((cfg.dim,))
         if not cfg.tie_embeddings:
             p["w"] = _normal(
                 rng, (cfg.dim, cfg.vocab), cfg.dim ** -0.5, cfg.dtype
@@ -572,7 +722,7 @@ def lm_head(
 
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
-        h = _rms(x, params["scale"], cfg.norm_eps)
+        h = _block_norm(cfg, params, "scale", x)
         w = _head_w(cfg, params)
         if axis_bound(cfg.tp_axis):
             h = psum_grad(h, cfg.tp_axis)  # region entry: full grad upstream
@@ -583,11 +733,16 @@ def lm_head(
         return h @ w, state
 
     tp = cfg.tp_axis
+    norm_spec = (
+        {"scale": P(), "bias": P()}
+        if cfg.norm == "layernorm"
+        else {"scale": P()}
+    )
     if cfg.tie_embeddings:
-        meta = _vocab_meta(cfg, {"scale": P()})
+        meta = _vocab_meta(cfg, dict(norm_spec))
         meta["tie_pre"] = ("table",)
     else:
-        meta = _vocab_meta(cfg, {"scale": P(), "w": P(None, tp)})
+        meta = _vocab_meta(cfg, {**norm_spec, "w": P(None, tp)})
     if tp is not None and not gather_logits:
         # Declares that this layer's output stays sharded over (axis, dim) —
         # consumed by SpmdGPipe.apply, which gathers it so inference returns
@@ -666,7 +821,7 @@ def chunked_lm_loss(
         # sequence length), so the two paths cannot drift.
         del state
         y, labels = y_and_labels
-        h = _rms(y, params["scale"], cfg.norm_eps)
+        h = _block_norm(cfg, params, "scale", y)
         losses = chunked_softmax_xent(
             h.reshape(-1, cfg.dim), _head_w(cfg, params),
             labels.reshape(-1), chunk,
