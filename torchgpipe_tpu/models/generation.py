@@ -71,8 +71,8 @@ from torchgpipe_tpu.models.transformer import (
     _block_norm,
     _head_w,
     _lora_delta,
+    _maybe_rope,
     _rms,
-    _rope,
 )
 
 Pytree = Any
@@ -258,9 +258,8 @@ def _decode_step(
         if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
             q = _rms(q, p["qn"], cfg.norm_eps)
             k = _rms(k, p["kn"], cfg.norm_eps)
-        if cfg.pos_emb == "rope":
-            q = _rope(q, cfg.rope_theta, pos)
-            k = _rope(k, cfg.rope_theta, pos)
+        q = _maybe_rope(cfg, q, pos)
+        k = _maybe_rope(cfg, k, pos)
         slot = jnp.mod(pos, ck.shape[1])
         if quant:
             kq, ks = _quant_rows(k)
@@ -286,8 +285,11 @@ def _decode_step(
             o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
         if "bo" in p:
             o = o + p["bo"]
+        x_in = x
         x = x + o
-        h = _block_norm(cfg, p, "ln2", x)
+        h = _block_norm(
+            cfg, p, "ln2", x_in if cfg.parallel_residual else x
+        )
         x = x + _mlp_out(cfg, p, h, mlp_layer)
         new_k.append(ck)
         new_v.append(cv)
@@ -375,9 +377,8 @@ def _decode_chunk(
         if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
             q = _rms(q, p["qn"], cfg.norm_eps)
             k = _rms(k, p["kn"], cfg.norm_eps)
-        if cfg.pos_emb == "rope":
-            q = _rope(q, cfg.rope_theta, pos0)
-            k = _rope(k, cfg.rope_theta, pos0)
+        q = _maybe_rope(cfg, q, pos0)
+        k = _maybe_rope(cfg, k, pos0)
         if quant:
             kq, ks = _quant_rows(k)
             vq, vs = _quant_rows(v)
@@ -403,8 +404,11 @@ def _decode_chunk(
             o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
         if "bo" in p:
             o = o + p["bo"]
+        x_in = x
         x = x + o
-        h = _block_norm(cfg, p, "ln2", x)
+        h = _block_norm(
+            cfg, p, "ln2", x_in if cfg.parallel_residual else x
+        )
         x = x + _mlp_out(cfg, p, h, mlp_layer)
         new_k.append(ck)
         new_v.append(cv)
@@ -649,9 +653,8 @@ def prefill(
         if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
             q = _rms(q, p["qn"], cfg.norm_eps)
             k = _rms(k, p["kn"], cfg.norm_eps)
-        if cfg.pos_emb == "rope":
-            q = _rope(q, cfg.rope_theta, 0)
-            k = _rope(k, cfg.rope_theta, 0)
+        q = _maybe_rope(cfg, q, 0)
+        k = _maybe_rope(cfg, k, 0)
         attn = _attend_full(q, k, v, cfg.attn_window, use_flash)
         attn = attn.astype(x.dtype)
         o = attn @ p["wo"]
@@ -659,8 +662,11 @@ def prefill(
             o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
         if "bo" in p:
             o = o + p["bo"]
+        x_in = x
         x = x + o
-        h = _block_norm(cfg, p, "ln2", x)
+        h = _block_norm(
+            cfg, p, "ln2", x_in if cfg.parallel_residual else x
+        )
         x = x + _mlp_out(cfg, p, h, mlp_layer)
         if ring:
             # Slot j gets the newest prompt position congruent to j

@@ -671,22 +671,196 @@ def state_dict_to_hf_gpt2(
     return sd
 
 
+def config_from_hf_neox(hf_config: Any) -> TransformerConfig:
+    """A :class:`TransformerConfig` equivalent to an HF ``GPTNeoXConfig``
+    (the Pythia family): LayerNorm + biased projections + classic MLP
+    like GPT-2, but ROTARY positions — usually PARTIAL
+    (``rotary_pct=0.25`` on every published Pythia) — and the
+    ``use_parallel_residual`` block shape ``x + attn(ln1 x) +
+    mlp(ln2 x)``."""
+    dim = hf_config.hidden_size
+    act = getattr(hf_config, "hidden_act", "gelu")
+    act_map = {"gelu": "gelu", "gelu_new": "gelu_tanh",
+               "gelu_pytorch_tanh": "gelu_tanh"}
+    if act not in act_map:
+        raise ValueError(
+            f"GPT-NeoX hidden_act={act!r} is not computed here "
+            "(gelu / gelu_new / gelu_pytorch_tanh are)"
+        )
+    if getattr(hf_config, "attention_bias", True) is False:
+        raise ValueError(
+            "this GPT-NeoX checkpoint disables attention biases; the "
+            "importer maps the standard always-biased Pythia layout"
+        )
+    return TransformerConfig(
+        vocab=hf_config.vocab_size,
+        dim=dim,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=None,                         # MHA
+        mlp_ratio=hf_config.intermediate_size / dim,
+        rope_theta=float(getattr(hf_config, "rotary_emb_base", 10000)),
+        rope_pct=float(getattr(hf_config, "rotary_pct", 1.0)),
+        norm_eps=float(hf_config.layer_norm_eps),
+        norm="layernorm",
+        mlp_impl="classic",
+        act=act_map[act],
+        attn_bias=True,
+        attn_out_bias=True,
+        parallel_residual=bool(
+            getattr(hf_config, "use_parallel_residual", True)
+        ),
+        tie_embeddings=bool(
+            getattr(hf_config, "tie_word_embeddings", False)
+        ),
+    )
+
+
+def _neox_split_qkv(
+    w: jnp.ndarray, b: jnp.ndarray, nh: int, hd: int
+) -> Tuple[jnp.ndarray, ...]:
+    """De-interleave GPT-NeoX's fused ``query_key_value``: the torch
+    Linear weight is ``[3*dim, dim]`` with the OUTPUT organized per head
+    as ``[nh, 3, hd]`` (q/k/v interleaved WITHIN each head — the classic
+    NeoX gotcha; a flat ``[:dim]`` slice would shuffle heads)."""
+    dim = nh * hd
+    wq, wk, wv = (
+        w.reshape(nh, 3, hd, dim)[:, i].reshape(dim, dim).T
+        for i in range(3)
+    )
+    bq, bk, bv = (
+        b.reshape(nh, 3, hd)[:, i].reshape(dim) for i in range(3)
+    )
+    return wq, wk, wv, bq, bk, bv
+
+
+def params_from_hf_neox(
+    state_dict: Dict[str, Any], cfg: TransformerConfig
+) -> List[Pytree]:
+    """Per-layer params in ``llama(cfg)`` order from a
+    ``GPTNeoXForCausalLM`` state dict (verified numerically in
+    ``tests/test_neox_interop.py``)."""
+    sd = state_dict
+    nh, hd = cfg.n_heads, cfg.head_dim
+    embed = {"table": _v(sd["gpt_neox.embed_in.weight"])}
+    out: List[Pytree] = [embed]
+    for i in range(cfg.n_layers):
+        p = f"gpt_neox.layers.{i}."
+        wq, wk, wv, bq, bk, bv = _neox_split_qkv(
+            _v(sd[p + "attention.query_key_value.weight"]),
+            _v(sd[p + "attention.query_key_value.bias"]),
+            nh, hd,
+        )
+        out.append({
+            "ln1": _v(sd[p + "input_layernorm.weight"]),
+            "ln1b": _v(sd[p + "input_layernorm.bias"]),
+            "wq": wq, "wk": wk, "wv": wv,
+            "bq": bq, "bk": bk, "bv": bv,
+            "wo": _t(sd[p + "attention.dense.weight"]),
+            "bo": _v(sd[p + "attention.dense.bias"]),
+            "ln2": _v(sd[p + "post_attention_layernorm.weight"]),
+            "ln2b": _v(sd[p + "post_attention_layernorm.bias"]),
+            "w_fc": _t(sd[p + "mlp.dense_h_to_4h.weight"]),
+            "b_fc": _v(sd[p + "mlp.dense_h_to_4h.bias"]),
+            "w_proj": _t(sd[p + "mlp.dense_4h_to_h.weight"]),
+            "b_proj": _v(sd[p + "mlp.dense_4h_to_h.bias"]),
+        })
+    head: Dict[str, Any] = {
+        "scale": _v(sd["gpt_neox.final_layer_norm.weight"]),
+        "bias": _v(sd["gpt_neox.final_layer_norm.bias"]),
+    }
+    if cfg.tie_embeddings:
+        head["table"] = embed["table"]
+    else:
+        head["w"] = _t(sd["embed_out.weight"])
+    out.append(head)
+    return out
+
+
+def from_hf_neox(model: Any, *, untie: bool = False) -> tuple:
+    """(cfg, per-layer params) from a live HF ``GPTNeoXForCausalLM`` —
+    the Pythia family on-ramp (partial rotary + parallel residual).
+    ``untie=True`` forces an untied import of a tied checkpoint, like
+    the sibling importers (most Pythia checkpoints are untied
+    already)."""
+    import dataclasses
+
+    cfg = config_from_hf_neox(model.config)
+    if untie and cfg.tie_embeddings:
+        cfg = dataclasses.replace(cfg, tie_embeddings=False)
+    return cfg, params_from_hf_neox(model.state_dict(), cfg)
+
+
+def state_dict_to_hf_neox(
+    params: List[Pytree], cfg: TransformerConfig
+) -> Dict[str, Any]:
+    """Export back to the ``GPTNeoXForCausalLM`` layout (mirror of
+    :func:`params_from_hf_neox`; the fused per-head-interleaved
+    ``query_key_value`` is re-assembled)."""
+    t, v = _torch_t, _torch_v
+    embed, blocks, head = params[0], params[1:-1], params[-1]
+    if len(blocks) != cfg.n_layers:
+        raise ValueError(
+            f"expected {cfg.n_layers} block params, got {len(blocks)}"
+        )
+    nh, hd = cfg.n_heads, cfg.head_dim
+    dim = cfg.dim
+    sd: Dict[str, Any] = {
+        "gpt_neox.embed_in.weight": v(embed["table"]),
+        "gpt_neox.final_layer_norm.weight": v(head["scale"]),
+        "gpt_neox.final_layer_norm.bias": v(head["bias"]),
+    }
+    if "w" in head:
+        sd["embed_out.weight"] = t(head["w"])
+    for i, bp in enumerate(blocks):
+        p = f"gpt_neox.layers.{i}."
+        # [dim, dim] jnp columns -> torch [nh, 3, hd, dim] rows.
+        qkv = jnp.stack(
+            [bp["wq"].T.reshape(nh, hd, dim),
+             bp["wk"].T.reshape(nh, hd, dim),
+             bp["wv"].T.reshape(nh, hd, dim)],
+            axis=1,
+        ).reshape(3 * dim, dim)
+        qkv_b = jnp.stack(
+            [bp["bq"].reshape(nh, hd), bp["bk"].reshape(nh, hd),
+             bp["bv"].reshape(nh, hd)],
+            axis=1,
+        ).reshape(3 * dim)
+        sd[p + "attention.query_key_value.weight"] = v(qkv)
+        sd[p + "attention.query_key_value.bias"] = v(qkv_b)
+        sd[p + "input_layernorm.weight"] = v(bp["ln1"])
+        sd[p + "input_layernorm.bias"] = v(bp["ln1b"])
+        sd[p + "attention.dense.weight"] = t(bp["wo"])
+        sd[p + "attention.dense.bias"] = v(bp["bo"])
+        sd[p + "post_attention_layernorm.weight"] = v(bp["ln2"])
+        sd[p + "post_attention_layernorm.bias"] = v(bp["ln2b"])
+        sd[p + "mlp.dense_h_to_4h.weight"] = t(bp["w_fc"])
+        sd[p + "mlp.dense_h_to_4h.bias"] = v(bp["b_fc"])
+        sd[p + "mlp.dense_4h_to_h.weight"] = t(bp["w_proj"])
+        sd[p + "mlp.dense_4h_to_h.bias"] = v(bp["b_proj"])
+    return sd
+
+
 __all__ = [
     "config_from_hf",
     "config_from_hf_gpt2",
     "config_from_hf_mixtral",
+    "config_from_hf_neox",
     "params_from_hf",
     "params_from_hf_gpt2",
     "params_from_hf_mixtral",
+    "params_from_hf_neox",
     "from_hf_gemma",
     "from_hf_gpt2",
     "from_hf_llama",
     "from_hf_mixtral",
+    "from_hf_neox",
     "from_hf_qwen2",
     "from_hf_qwen3",
     "state_dict_to_hf",
     "state_dict_to_hf_gpt2",
     "state_dict_to_hf_mixtral",
+    "state_dict_to_hf_neox",
 ]
 
 

@@ -99,6 +99,14 @@ class TransformerConfig:
     # Bias on the attention output projection (param ``bo`` — GPT-2 has
     # biases on every projection; pair with attn_bias for q/k/v).
     attn_out_bias: bool = False
+    # GPT-NeoX/Pythia-style PARALLEL residual: x + attn(ln1(x)) +
+    # mlp(ln2(x)) — both branches read the SAME input instead of
+    # chaining (one residual add, better overlap).
+    parallel_residual: bool = False
+    # Partial rotary (GPT-NeoX rotary_pct): only the first
+    # ``int(head_dim * rope_pct)`` dims of each head rotate; the rest
+    # pass through position-free.  1.0 = full rotary (Llama).
+    rope_pct: float = 1.0
     # Multiply embedding outputs by this factor (Gemma scales by
     # sqrt(dim); the TIED head still reads the unscaled table, matching
     # that family).  None -> no scaling.
@@ -165,6 +173,15 @@ class TransformerConfig:
             raise ValueError(
                 "pos_emb='learned' needs max_pos (the position table "
                 "size — HF GPT2Config.n_positions)"
+            )
+        if not 0.0 < self.rope_pct <= 1.0:
+            raise ValueError(f"rope_pct={self.rope_pct} must be in (0, 1]")
+        if self.rope_pct < 1.0 and int(self.head_dim * self.rope_pct) % 2:
+            raise ValueError(
+                f"rope_pct={self.rope_pct} rotates "
+                f"{int(self.head_dim * self.rope_pct)} of {self.head_dim} "
+                "head dims — the rotated count must be even (half-split "
+                "rotary)"
             )
         _act_fn(self.act)  # raises on unknown activation names
 
@@ -281,6 +298,26 @@ def _rope(x: jnp.ndarray, theta: float, pos_offset: Any = 0) -> jnp.ndarray:
         axis=-1,
     )
     return out.astype(x.dtype)
+
+
+def _maybe_rope(
+    cfg: TransformerConfig, x: jnp.ndarray, pos_offset: Any
+) -> jnp.ndarray:
+    """The config's position treatment for a ``[b, s, heads, head_dim]``
+    projection: full rotary, PARTIAL rotary (``rope_pct < 1`` — GPT-NeoX
+    rotates only the leading ``int(head_dim * rope_pct)`` dims), or
+    nothing (``pos_emb='learned'`` models position at the embedding).
+    ONE definition shared by the training block and every generation
+    path."""
+    if cfg.pos_emb != "rope":
+        return x
+    if cfg.rope_pct >= 1.0:
+        return _rope(x, cfg.rope_theta, pos_offset)
+    rot = int(x.shape[-1] * cfg.rope_pct)
+    return jnp.concatenate(
+        [_rope(x[..., :rot], cfg.rope_theta, pos_offset), x[..., rot:]],
+        axis=-1,
+    )
 
 
 def transformer_block(
@@ -400,9 +437,8 @@ def transformer_block(
         if "qn" in params:  # Qwen3-style per-head q/k RMSNorm, pre-rope
             q = _rms(q, params["qn"], cfg.norm_eps)
             k = _rms(k, params["kn"], cfg.norm_eps)
-        if cfg.pos_emb == "rope":
-            q = _rope(q, cfg.rope_theta, pos_offset)
-            k = _rope(k, cfg.rope_theta, pos_offset)
+        q = _maybe_rope(cfg, q, pos_offset)
+        k = _maybe_rope(cfg, k, pos_offset)
         # GQA: K/V stay at n_kv heads — the attention kernel groups queries
         # at the compute site, so the sp ring only moves n_kv-head blocks.
         # Under tp, lanes hold contiguous head ranges, so the local q→kv
@@ -423,9 +459,15 @@ def transformer_block(
             # After the tp psum: the bias is per-output-feature, added
             # once — inside the region each lane would contribute a copy.
             attn_out = attn_out + params["bo"]
+        # GPT-NeoX-style parallel residual: the MLP branch reads the
+        # BLOCK INPUT (ln2 of x, not of x + attn_out) and both branch
+        # outputs land in one residual add at the end.
+        x_in = x
         x = x + attn_out
 
-        h = _block_norm(cfg, params, "ln2", x)
+        h = _block_norm(
+            cfg, params, "ln2", x_in if cfg.parallel_residual else x
+        )
         if mlp is not None:
             mlp_out, _ = mlp.apply(params["mlp"], (), h, rng=rng, train=train)
         elif "w_fc" in params:
