@@ -1,0 +1,122 @@
+"""OPT HF interop.
+
+OPT exercises the learned-table OFFSET (HF's
+``OPTLearnedPositionalEmbedding`` reserves 2 rows: position p reads row
+p+2 — ``cfg.pos_emb_offset``) and the relu classic MLP; everything else
+is the GPT-2-class layout with SEPARATE q/k/v projections.  The 350m
+post-norm / factorized-embedding variants are rejected didactically."""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from torchgpipe_tpu.layers import sequential_apply  # noqa: E402
+from torchgpipe_tpu.models.generation import generate  # noqa: E402
+from torchgpipe_tpu.models.hf_interop import (  # noqa: E402
+    from_hf_opt,
+    state_dict_to_hf_opt,
+)
+from torchgpipe_tpu.models.transformer import llama  # noqa: E402
+
+
+def _hf_model(n_layer=2, **kw):
+    cfg = transformers.OPTConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=n_layer,
+        num_attention_heads=4, ffn_dim=128, max_position_embeddings=64,
+        word_embed_proj_dim=32, **kw,
+    )
+    torch.manual_seed(0)
+    m = transformers.OPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _tokens(b, s, mult=5, add=2):
+    return (np.arange(b * s).reshape(b, s) * mult + add) % 96
+
+
+def test_logits_match_hf():
+    """Training-forward parity: the 2-row position offset, relu MLP,
+    and separate biased projections reproduce the HF logits."""
+    m = _hf_model()
+    cfg, params = from_hf_opt(m, untie=True)
+    assert cfg.pos_emb_offset == 2 and cfg.max_pos == 66
+    b, s = 2, 7
+    tokens = _tokens(b, s)
+
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+
+    out, _ = sequential_apply(
+        llama(cfg), params, [() for _ in range(cfg.n_layers + 2)],
+        jnp.asarray(tokens, jnp.int32), rng=None, train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_greedy_decode_matches_hf_teacher_forced():
+    """Decode positions (cache.length + offset) track HF stepwise
+    argmax exactly."""
+    m = _hf_model()
+    cfg, params = from_hf_opt(m)
+    b, s, new = 2, 5, 6
+    tokens = _tokens(b, s, mult=3, add=1)
+
+    ours = np.asarray(
+        generate(cfg, params, jnp.asarray(tokens, jnp.int32),
+                 max_new_tokens=new)
+    )
+    seq = torch.tensor(tokens)
+    for t in range(new):
+        with torch.no_grad():
+            step = m(seq).logits[:, -1].argmax(-1)
+        assert (ours[:, t] == step.numpy()).all(), (t, ours[:, t], step)
+        seq = torch.cat([seq, step[:, None]], dim=1)
+
+
+def test_export_round_trip():
+    m = _hf_model()
+    cfg, params = from_hf_opt(m)
+    sd = state_dict_to_hf_opt(params, cfg)
+    m2 = transformers.OPTForCausalLM(m.config)
+    missing, unexpected = m2.load_state_dict(sd, strict=False)
+    assert not unexpected
+    assert all(k == "lm_head.weight" for k in missing), missing
+    m2.tie_weights()
+    m2.eval()
+    tokens = _tokens(2, 6)
+    with torch.no_grad():
+        a = m(torch.tensor(tokens)).logits.numpy()
+        bb = m2(torch.tensor(tokens)).logits.numpy()
+    np.testing.assert_array_equal(a, bb)
+
+
+def test_rejects_post_norm_and_factorized():
+    with pytest.raises(ValueError, match="POST-norm"):
+        from_hf_opt(_hf_model(do_layer_norm_before=False))
+    with pytest.raises(ValueError, match="factoriz"):
+        cfg = transformers.OPTConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=1,
+            num_attention_heads=4, ffn_dim=128,
+            max_position_embeddings=64, word_embed_proj_dim=16,
+        )
+        torch.manual_seed(0)
+        from_hf_opt(transformers.OPTForCausalLM(cfg))
+
+
+def test_max_pos_guard_accounts_for_offset():
+    """The learned-table bound check uses table rows MINUS the offset:
+    prompt+new = 64 fits (table 66, offset 2); 65 does not."""
+    m = _hf_model()
+    cfg, params = from_hf_opt(m)
+    tokens = jnp.asarray(_tokens(1, 32), jnp.int32)
+    generate(cfg, params, tokens, max_new_tokens=32)  # 64 positions: ok
+    with pytest.raises(ValueError, match="max_pos"):
+        generate(cfg, params, tokens, max_new_tokens=33)

@@ -154,7 +154,9 @@ def _embed(cfg: TransformerConfig, embed_p: Pytree,
     if "pos" in embed_p:
         s = tokens.shape[-1]
         x = x + jnp.take(
-            embed_p["pos"], pos0 + jnp.arange(s), axis=0
+            embed_p["pos"],
+            cfg.pos_emb_offset + pos0 + jnp.arange(s),
+            axis=0,
         ).astype(x.dtype)
     return x
 
@@ -435,13 +437,21 @@ def _check_max_pos(cfg: TransformerConfig, positions: int) -> None:
     ``jnp.take`` CLAMPS out-of-range indices under jit, so position
     ``max_pos`` would silently reuse the last row — degraded output with
     no error.  All lengths here are static, so the check is free."""
-    if cfg.pos_emb == "learned" and positions > cfg.max_pos:
+    if (
+        cfg.pos_emb == "learned"
+        and positions + cfg.pos_emb_offset > cfg.max_pos
+    ):
+        off = (
+            f" minus {cfg.pos_emb_offset} reserved rows"
+            if cfg.pos_emb_offset
+            else ""
+        )
         raise ValueError(
             f"this decode reaches position {positions - 1} but the "
-            f"learned position table has max_pos={cfg.max_pos} rows "
-            "(GPT-2-class models cannot extend context by decoding "
-            "further; shorten prompt + max_new_tokens or retrain with a "
-            "larger max_pos)"
+            f"learned position table has max_pos={cfg.max_pos} rows"
+            f"{off} (GPT-2-class models cannot extend context by "
+            "decoding further; shorten prompt + max_new_tokens or "
+            "retrain with a larger max_pos)"
         )
 
 

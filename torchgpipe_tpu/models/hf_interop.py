@@ -841,26 +841,191 @@ def state_dict_to_hf_neox(
     return sd
 
 
+def config_from_hf_opt(hf_config: Any) -> TransformerConfig:
+    """A :class:`TransformerConfig` equivalent to an HF ``OPTConfig``:
+    pre-norm LayerNorm blocks, learned positions with OPT's 2-row table
+    offset, separate biased q/k/v/out projections, relu classic MLP,
+    tied head.  The 350m-style variants (``do_layer_norm_before=False``
+    post-norm, ``word_embed_proj_dim != hidden_size`` factorized
+    embeddings) are different computations and are rejected."""
+    dim = hf_config.hidden_size
+    if not getattr(hf_config, "do_layer_norm_before", True):
+        raise ValueError(
+            "this OPT checkpoint is POST-norm (do_layer_norm_before="
+            "False, the 350m layout); only the pre-norm OPT family is "
+            "computed here"
+        )
+    proj = getattr(hf_config, "word_embed_proj_dim", dim)
+    if proj != dim:
+        raise ValueError(
+            f"this OPT checkpoint factorizes its embeddings "
+            f"(word_embed_proj_dim={proj} != hidden_size={dim}); that "
+            "projection pair is not computed here"
+        )
+    act = getattr(hf_config, "activation_function", "relu")
+    if act != "relu":
+        raise ValueError(
+            f"OPT activation_function={act!r}; only relu (the published "
+            "OPT convention) is mapped here"
+        )
+    if not getattr(hf_config, "enable_bias", True):
+        raise ValueError(
+            "this OPT-layout checkpoint disables projection biases "
+            "(enable_bias=False, the Galactica variant); the importer "
+            "maps the standard always-biased OPT layout"
+        )
+    if not getattr(hf_config, "layer_norm_elementwise_affine", True):
+        raise ValueError(
+            "this OPT-layout checkpoint disables LayerNorm affine "
+            "params (layer_norm_elementwise_affine=False); the importer "
+            "maps the standard affine-LayerNorm OPT layout"
+        )
+    return TransformerConfig(
+        vocab=hf_config.vocab_size,
+        dim=dim,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=None,
+        mlp_ratio=hf_config.ffn_dim / dim,
+        norm_eps=1e-5,
+        norm="layernorm",
+        pos_emb="learned",
+        # OPT's table carries max_position_embeddings + 2 rows; every
+        # lookup shifts by 2 (HF OPTLearnedPositionalEmbedding.offset).
+        max_pos=int(hf_config.max_position_embeddings) + 2,
+        pos_emb_offset=2,
+        mlp_impl="classic",
+        act="relu",
+        attn_bias=True,
+        attn_out_bias=True,
+        tie_embeddings=bool(
+            getattr(hf_config, "tie_word_embeddings", True)
+        ),
+    )
+
+
+def params_from_hf_opt(
+    state_dict: Dict[str, Any], cfg: TransformerConfig
+) -> List[Pytree]:
+    """Per-layer params in ``llama(cfg)`` order from an
+    ``OPTForCausalLM`` state dict (verified numerically in
+    ``tests/test_opt_interop.py``)."""
+    sd = state_dict
+    embed = {
+        "table": _v(sd["model.decoder.embed_tokens.weight"]),
+        "pos": _v(sd["model.decoder.embed_positions.weight"]),
+    }
+    out: List[Pytree] = [embed]
+    for i in range(cfg.n_layers):
+        p = f"model.decoder.layers.{i}."
+        out.append({
+            "ln1": _v(sd[p + "self_attn_layer_norm.weight"]),
+            "ln1b": _v(sd[p + "self_attn_layer_norm.bias"]),
+            "wq": _t(sd[p + "self_attn.q_proj.weight"]),
+            "wk": _t(sd[p + "self_attn.k_proj.weight"]),
+            "wv": _t(sd[p + "self_attn.v_proj.weight"]),
+            "bq": _v(sd[p + "self_attn.q_proj.bias"]),
+            "bk": _v(sd[p + "self_attn.k_proj.bias"]),
+            "bv": _v(sd[p + "self_attn.v_proj.bias"]),
+            "wo": _t(sd[p + "self_attn.out_proj.weight"]),
+            "bo": _v(sd[p + "self_attn.out_proj.bias"]),
+            "ln2": _v(sd[p + "final_layer_norm.weight"]),
+            "ln2b": _v(sd[p + "final_layer_norm.bias"]),
+            "w_fc": _t(sd[p + "fc1.weight"]),
+            "b_fc": _v(sd[p + "fc1.bias"]),
+            "w_proj": _t(sd[p + "fc2.weight"]),
+            "b_proj": _v(sd[p + "fc2.bias"]),
+        })
+    head: Dict[str, Any] = {
+        "scale": _v(sd["model.decoder.final_layer_norm.weight"]),
+        "bias": _v(sd["model.decoder.final_layer_norm.bias"]),
+    }
+    if cfg.tie_embeddings:
+        head["table"] = embed["table"]
+    else:
+        head["w"] = _t(sd["lm_head.weight"])
+    out.append(head)
+    return out
+
+
+def from_hf_opt(model: Any, *, untie: bool = False) -> tuple:
+    """(cfg, per-layer params) from a live HF ``OPTForCausalLM``.
+    ``untie=True`` imports the (always-tied) head as an untied copy for
+    the MPMD ``GPipe(llama(cfg))`` path, like the sibling importers."""
+    import dataclasses
+
+    cfg = config_from_hf_opt(model.config)
+    if untie and cfg.tie_embeddings:
+        cfg = dataclasses.replace(cfg, tie_embeddings=False)
+    return cfg, params_from_hf_opt(model.state_dict(), cfg)
+
+
+def state_dict_to_hf_opt(
+    params: List[Pytree], cfg: TransformerConfig
+) -> Dict[str, Any]:
+    """Export back to the ``OPTForCausalLM`` layout (mirror of
+    :func:`params_from_hf_opt`).  Tied heads omit ``lm_head.weight``;
+    load untied exports into an untied-config model, as with the GPT-2
+    exporter."""
+    t, v = _torch_t, _torch_v
+    embed, blocks, head = params[0], params[1:-1], params[-1]
+    if len(blocks) != cfg.n_layers:
+        raise ValueError(
+            f"expected {cfg.n_layers} block params, got {len(blocks)}"
+        )
+    sd: Dict[str, Any] = {
+        "model.decoder.embed_tokens.weight": v(embed["table"]),
+        "model.decoder.embed_positions.weight": v(embed["pos"]),
+        "model.decoder.final_layer_norm.weight": v(head["scale"]),
+        "model.decoder.final_layer_norm.bias": v(head["bias"]),
+    }
+    if "w" in head:
+        sd["lm_head.weight"] = t(head["w"])
+    for i, bp in enumerate(blocks):
+        p = f"model.decoder.layers.{i}."
+        sd[p + "self_attn_layer_norm.weight"] = v(bp["ln1"])
+        sd[p + "self_attn_layer_norm.bias"] = v(bp["ln1b"])
+        sd[p + "self_attn.q_proj.weight"] = t(bp["wq"])
+        sd[p + "self_attn.q_proj.bias"] = v(bp["bq"])
+        sd[p + "self_attn.k_proj.weight"] = t(bp["wk"])
+        sd[p + "self_attn.k_proj.bias"] = v(bp["bk"])
+        sd[p + "self_attn.v_proj.weight"] = t(bp["wv"])
+        sd[p + "self_attn.v_proj.bias"] = v(bp["bv"])
+        sd[p + "self_attn.out_proj.weight"] = t(bp["wo"])
+        sd[p + "self_attn.out_proj.bias"] = v(bp["bo"])
+        sd[p + "final_layer_norm.weight"] = v(bp["ln2"])
+        sd[p + "final_layer_norm.bias"] = v(bp["ln2b"])
+        sd[p + "fc1.weight"] = t(bp["w_fc"])
+        sd[p + "fc1.bias"] = v(bp["b_fc"])
+        sd[p + "fc2.weight"] = t(bp["w_proj"])
+        sd[p + "fc2.bias"] = v(bp["b_proj"])
+    return sd
+
+
 __all__ = [
     "config_from_hf",
     "config_from_hf_gpt2",
     "config_from_hf_mixtral",
     "config_from_hf_neox",
+    "config_from_hf_opt",
     "params_from_hf",
     "params_from_hf_gpt2",
     "params_from_hf_mixtral",
     "params_from_hf_neox",
+    "params_from_hf_opt",
     "from_hf_gemma",
     "from_hf_gpt2",
     "from_hf_llama",
     "from_hf_mixtral",
     "from_hf_neox",
+    "from_hf_opt",
     "from_hf_qwen2",
     "from_hf_qwen3",
     "state_dict_to_hf",
     "state_dict_to_hf_gpt2",
     "state_dict_to_hf_mixtral",
     "state_dict_to_hf_neox",
+    "state_dict_to_hf_opt",
 ]
 
 

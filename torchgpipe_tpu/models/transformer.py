@@ -89,9 +89,13 @@ class TransformerConfig:
     norm: str = "rms"
     # Positions: 'rope' (rotary, the default) or 'learned' (absolute
     # position embedding table ``pos`` [max_pos, dim] added at the
-    # embedding — GPT-2 class; requires ``max_pos``).
+    # embedding — GPT-2 class; requires ``max_pos``, the TABLE size).
     pos_emb: str = "rope"
     max_pos: Optional[int] = None
+    # Learned-table row offset: position p reads row p + offset (OPT
+    # reserves the first 2 rows, so its table has max_positions + 2 rows
+    # and every lookup shifts by 2).
+    pos_emb_offset: int = 0
     # Feed-forward shape: 'gated' (SwiGLU/GeGLU two-matrix gate) or
     # 'classic' (fc -> act -> proj with biases ``b_fc``/``b_proj``;
     # hidden = mlp_ratio * dim exactly — GPT-2's 4x).
@@ -259,8 +263,11 @@ def _act_fn(act: str) -> Callable[[jnp.ndarray], jnp.ndarray]:
         return lambda x: jax.nn.gelu(x, approximate=True)
     if act == "gelu":  # exact (erf) variant — Pythia/GPT-NeoX class
         return lambda x: jax.nn.gelu(x, approximate=False)
+    if act == "relu":  # OPT class
+        return jax.nn.relu
     raise ValueError(
-        f"unknown act {act!r}: expected 'silu', 'gelu_tanh', or 'gelu'"
+        f"unknown act {act!r}: expected 'silu', 'gelu_tanh', 'gelu', "
+        "or 'relu'"
     )
 
 
@@ -696,7 +703,9 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
                 else 0
             )
             out = out + jnp.take(
-                params["pos"], off + jnp.arange(s), axis=0
+                params["pos"],
+                cfg.pos_emb_offset + off + jnp.arange(s),
+                axis=0,
             ).astype(out.dtype)
         return out, state
 
