@@ -62,6 +62,9 @@ def main() -> None:
                          "acceptance, the per-round overhead ceiling")
     ap.add_argument("--gamma", type=int, default=4,
                     help="drafts per speculative round")
+    ap.add_argument("--w8", action="store_true",
+                    help="weight-only int8 (models.quant): halve the "
+                         "bf16 weight read traffic decode is bound by")
     args = ap.parse_args()
 
     dim, n_layers, nh, nkv, vocab = PRESETS[args.preset]
@@ -73,6 +76,16 @@ def main() -> None:
     b, s, new = args.batch, args.prompt_len, args.new_tokens
     spec = jax.ShapeDtypeStruct((b, s), jnp.int32)
     params, _, _ = sequential_init(llama(cfg), jax.random.PRNGKey(0), spec)
+    if args.w8:
+        from torchgpipe_tpu.models.quant import (
+            quantize_params_int8, quantized_bytes,
+        )
+
+        params = quantize_params_int8(cfg, params)
+        qb, fb = quantized_bytes(params, cfg.dtype)
+        print(f"w8: projection weights {qb / 2**20:.1f} MiB int8 "
+              f"(vs {fb / 2**20:.1f} MiB {jnp.dtype(cfg.dtype).name})",
+              flush=True)
     prompt = jnp.mod(jnp.arange(b * s).reshape(b, s), vocab).astype(jnp.int32)
 
     mode = "ring" if args.ring else "full"
@@ -143,6 +156,7 @@ def main() -> None:
     wtag = (f", window {args.window} ({mode} cache)"
             if args.window else "")
     wtag += ", int8-kv" if args.kv_quant else ""
+    wtag += ", int8-weights" if args.w8 else ""
     wtag += spec_tag
     print(
         f"{args.preset}{wtag}: batch {b}, prompt {s}, {new} new tokens -> "

@@ -168,6 +168,13 @@ run_step llama-decode 2400 -t tools/tpu_llama_decode.txt \
   python -m benchmarks.llama_decode --preset 1b --batch 8 --bf16 \
   || bail_if_dead
 
+# (8b) Weight-only int8 decode (round-4 capability): same config with
+# the projection weights stored int8 — the direct test of the
+# bandwidth-bound model (expect up to ~2x tokens/sec at this batch).
+run_step llama-decode-w8 2400 -t tools/tpu_llama_decode_w8.txt \
+  python -m benchmarks.llama_decode --preset 1b --batch 8 --bf16 --w8 \
+  || bail_if_dead
+
 # (zb-vs-1f1b wall clock needs a multi-stage mesh — impossible on the
 # single tunneled chip; the CPU-mesh measured-vs-predicted table in
 # BENCH_NOTES covers it.)

@@ -26,6 +26,11 @@ from torchgpipe_tpu.models.generation import (  # noqa: F401
     spmd_params_for_generation,
     spmd_params_from_flat,
 )
+from torchgpipe_tpu.models.quant import (  # noqa: F401
+    dequantize_weight,
+    quantize_params_int8,
+    quantized_bytes,
+)
 from torchgpipe_tpu.models.moe import (  # noqa: F401
     MoEConfig,
     llama_moe,

@@ -161,6 +161,15 @@ def _embed(cfg: TransformerConfig, embed_p: Pytree,
     return x
 
 
+def _w(cfg: TransformerConfig, p: Pytree, key: str) -> jnp.ndarray:
+    """Weight read-site accessor: plain arrays pass through; weight-only
+    int8 leaves (``models.quant``) dequantize here, so every decode path
+    supports quantized params via this single definition."""
+    from torchgpipe_tpu.models.quant import dequantize_weight
+
+    return dequantize_weight(p[key], cfg.dtype)
+
+
 def _split_params(cfg: TransformerConfig, params: Pytree) -> Tuple:
     """(embed, blocks, head) params from the flat ``llama(cfg)`` list —
     the MPMD engine's per-layer pytree sequence, or any sequence whose
@@ -243,10 +252,11 @@ def _decode_step(
     for p, ck, cv, (cks, cvs) in zip(
         block_params, cache.k, cache.v, scales
     ):
-        nh_loc = p["wq"].shape[1] // hd
-        nkv_loc = p["wk"].shape[1] // hd
+        wq, wk, wv = _w(cfg, p, "wq"), _w(cfg, p, "wk"), _w(cfg, p, "wv")
+        nh_loc = wq.shape[1] // hd
+        nkv_loc = wk.shape[1] // hd
         h = _block_norm(cfg, p, "ln1", x)
-        q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
+        q, k, v = h @ wq, h @ wk, h @ wv
         if "lora" in p:
             lo = p["lora"]
             q = q + _lora_delta(cfg, lo, h, "qa", "qb")
@@ -282,7 +292,7 @@ def _decode_step(
             )
             rk, rv = ck, cv
         attn = _attend_ring(q, rk, rv, pos).astype(x.dtype)
-        o = attn @ p["wo"]
+        o = attn @ _w(cfg, p, "wo")
         if "lora" in p:
             o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
         if "bo" in p:
@@ -362,10 +372,11 @@ def _decode_chunk(
     for p, ck, cv, (cks, cvs) in zip(
         block_params, cache.k, cache.v, scales
     ):
-        nh_loc = p["wq"].shape[1] // hd
-        nkv_loc = p["wk"].shape[1] // hd
+        wq, wk, wv = _w(cfg, p, "wq"), _w(cfg, p, "wk"), _w(cfg, p, "wv")
+        nh_loc = wq.shape[1] // hd
+        nkv_loc = wk.shape[1] // hd
         h = _block_norm(cfg, p, "ln1", x)
-        q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
+        q, k, v = h @ wq, h @ wk, h @ wv
         if "lora" in p:
             lo = p["lora"]
             q = q + _lora_delta(cfg, lo, h, "qa", "qb")
@@ -401,7 +412,7 @@ def _decode_chunk(
             rk, rv = ck, cv
         attn = _attend_chunk(q, rk, rv, pos0, cfg.attn_window)
         attn = attn.astype(x.dtype)
-        o = attn @ p["wo"]
+        o = attn @ _w(cfg, p, "wo")
         if "lora" in p:
             o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
         if "bo" in p:
@@ -477,11 +488,11 @@ def _mlp_out(cfg: TransformerConfig, p: Pytree, h: jnp.ndarray,
         out, _ = mlp_layer.apply(p["mlp"], (), h, rng=None, train=False)
         return out.astype(h.dtype)
     if "w_fc" in p:  # classic (GPT-2-style) fc -> act -> proj
-        hid = _act_fn(cfg.act)(h @ p["w_fc"] + p["b_fc"])
-        return hid @ p["w_proj"] + p["b_proj"]
-    gate = _act_fn(cfg.act)(h @ p["w_gate"])
-    up = h @ p["w_up"]
-    return (gate * up) @ p["w_down"]
+        hid = _act_fn(cfg.act)(h @ _w(cfg, p, "w_fc") + p["b_fc"])
+        return hid @ _w(cfg, p, "w_proj") + p["b_proj"]
+    gate = _act_fn(cfg.act)(h @ _w(cfg, p, "w_gate"))
+    up = h @ _w(cfg, p, "w_up")
+    return (gate * up) @ _w(cfg, p, "w_down")
 
 
 def _logits(cfg: TransformerConfig, head_params: Pytree,
@@ -646,10 +657,11 @@ def prefill(
     for p, ck, cv, (sk, sv) in zip(
         block_p, cache.k, cache.v, scale_bufs
     ):
-        nh_loc = p["wq"].shape[1] // hd
-        nkv_loc = p["wk"].shape[1] // hd
+        wq, wk, wv = _w(cfg, p, "wq"), _w(cfg, p, "wk"), _w(cfg, p, "wv")
+        nh_loc = wq.shape[1] // hd
+        nkv_loc = wk.shape[1] // hd
         h = _block_norm(cfg, p, "ln1", x)
-        q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
+        q, k, v = h @ wq, h @ wk, h @ wv
         if "lora" in p:
             lo = p["lora"]
             q = q + _lora_delta(cfg, lo, h, "qa", "qb")
@@ -667,7 +679,7 @@ def prefill(
         k = _maybe_rope(cfg, k, 0)
         attn = _attend_full(q, k, v, cfg.attn_window, use_flash)
         attn = attn.astype(x.dtype)
-        o = attn @ p["wo"]
+        o = attn @ _w(cfg, p, "wo")
         if "lora" in p:
             o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
         if "bo" in p:

@@ -1,0 +1,141 @@
+"""Weight-only int8 quantization for decode.
+
+TPU decode is HBM-bandwidth-bound: every generated token re-reads every
+weight matrix, so the byte width of the weights IS the throughput at
+small batch.  Storing the projection matrices as int8 with per-output-
+channel symmetric scales halves the bf16 read traffic (quarter of f32)
+while the matmuls still run in the compute dtype — the dequantize
+(``int8 -> dtype, * scale``) fuses into the operand read, so HBM sees
+int8 and the MXU sees the usual bf16/f32 operands.
+
+Scope and composition:
+
+* Decode-path only: :func:`quantize_params_int8` produces a params list
+  :mod:`torchgpipe_tpu.models.generation` consumes (prefill, decode,
+  beam, speculative — every path reads weights through one accessor).
+  Training keeps full-precision masters; quantize AFTER training or
+  import, like the export step.
+* Quantized leaves: the 2-D projection matrices (``wq/wk/wv/wo``,
+  gated ``w_gate/w_up/w_down`` or classic ``w_fc/w_proj``, and the
+  untied head ``w``).  The embedding ``table`` and learned ``pos`` stay
+  full precision — a gather reads s rows, not the matrix — as do
+  biases, norm scales, and LoRA factors (tiny).  A TIED head reads the
+  (unquantized) embedding table, matching the fp path.
+* Composes with int8 KV caches (``generate(kv_quant=True)``) — weights
+  and cache are independent axes of the bandwidth budget.
+
+Error model: symmetric per-output-channel scales bound the per-weight
+error by half a quantization step of the channel's max magnitude
+(:func:`dequantize_weight` round-trips within that bound, tested);
+greedy decode on a trained model matches the fp path (tested, same
+discipline as the KV-cache quantization).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax.numpy as jnp
+
+from torchgpipe_tpu.models.transformer import TransformerConfig
+
+Pytree = Any
+
+#: 2-D weight keys eligible for int8 storage, by param schema.
+QUANT_KEYS = (
+    "wq", "wk", "wv", "wo",
+    "w_gate", "w_up", "w_down",
+    "w_fc", "w_proj",
+    "w",                      # untied lm head
+)
+
+
+def _quant_matrix(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Symmetric per-output-channel (trailing dim) int8 quantization:
+    ``w[:, j] ≈ q8[:, j] * sc[j]``."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    sc = jnp.maximum(amax, 1e-12) / 127.0
+    q8 = jnp.clip(
+        jnp.round(w.astype(jnp.float32) / sc[None, :]), -127, 127
+    ).astype(jnp.int8)
+    return {"q8": q8, "sc": sc}
+
+
+def is_quantized(v: Any) -> bool:
+    """True for a ``{"q8", "sc"}`` weight-only leaf."""
+    return isinstance(v, dict) and set(v) == {"q8", "sc"}
+
+
+def dequantize_weight(v: Any, dtype: Any) -> jnp.ndarray:
+    """``{"q8","sc"} -> dtype`` matrix (or the value unchanged when it
+    is already a plain array) — the single read-site accessor the
+    generation paths use."""
+    if is_quantized(v):
+        return (
+            v["q8"].astype(jnp.float32) * v["sc"][None, :]
+        ).astype(dtype)
+    return v
+
+
+def quantize_params_int8(
+    cfg: TransformerConfig, params: List[Pytree]
+) -> List[Pytree]:
+    """Per-layer ``llama(cfg)`` params with every eligible projection
+    stored int8 (see module docstring for what stays full precision).
+    The result feeds the generation API directly.
+
+    Only the FLAT per-layer layout is supported (the one the generation
+    API consumes); spmd-stacked 3-D leaves must be unstacked first via
+    ``spmd_params_for_generation`` — a list where nothing was eligible
+    raises instead of silently returning fp params labeled quantized."""
+    del cfg  # the schema is discovered from the leaves themselves
+    out: List[Pytree] = []
+    n_quantized = 0
+    for layer in params:
+        if not isinstance(layer, dict):
+            out.append(layer)
+            continue
+        q: Dict[str, Any] = {}
+        for k, v in layer.items():
+            if k in QUANT_KEYS and hasattr(v, "ndim") and v.ndim == 2:
+                q[k] = _quant_matrix(v)
+                n_quantized += 1
+            else:
+                q[k] = v
+        out.append(q)
+    if n_quantized == 0:
+        raise ValueError(
+            "no eligible 2-D projection weights found — "
+            "quantize_params_int8 takes the FLAT per-layer list the "
+            "generation API consumes (embed, blocks, head); for "
+            "SpmdGPipe's stacked params, unstack first with "
+            "models.generation.spmd_params_for_generation"
+        )
+    return out
+
+
+def quantized_bytes(
+    params: List[Pytree], dtype: Any = jnp.float32
+) -> Tuple[int, int]:
+    """(bytes of quantized leaves incl. scales, bytes those leaves
+    would occupy in ``dtype`` — pass the model's compute dtype so the
+    reported saving matches the run it accompanies)."""
+    width = jnp.dtype(dtype).itemsize
+    qb = fb = 0
+    for layer in params:
+        if not isinstance(layer, dict):
+            continue
+        for v in layer.values():
+            if is_quantized(v):
+                qb += v["q8"].size + v["sc"].size * 4
+                fb += v["q8"].size * width
+    return qb, fb
+
+
+__all__ = [
+    "QUANT_KEYS",
+    "dequantize_weight",
+    "is_quantized",
+    "quantize_params_int8",
+    "quantized_bytes",
+]

@@ -739,9 +739,13 @@ def _head_init(cfg: TransformerConfig) -> Callable:
 def _head_w(cfg: TransformerConfig, params: Any) -> jnp.ndarray:
     """The head projection ``[dim, vocab]``: the layer's own ``w``, or —
     under ``cfg.tie_embeddings`` — the embedding table (spliced into the
-    param dict by the engine / the generation extractor), transposed."""
+    param dict by the engine / the generation extractor), transposed.
+    A weight-only-int8 ``w`` (``models.quant``) dequantizes at the
+    read."""
     if "w" in params:
-        return params["w"]
+        from torchgpipe_tpu.models.quant import dequantize_weight
+
+        return dequantize_weight(params["w"], cfg.dtype)
     if cfg.tie_embeddings and "table" in params:
         return params["table"].T
     if cfg.tie_embeddings:
