@@ -173,3 +173,10 @@ def test_rejects_layout_with_nothing_to_quantize():
     stacked = [{"wq": jnp.zeros((2, 8, 8))}]  # [n, dim, out]
     with pytest.raises(ValueError, match="FLAT per-layer"):
         quantize_params_int8(CFG, stacked)
+
+
+def test_double_quantization_named():
+    params, _ = _train_tiny(CFG, steps=1)
+    qp = quantize_params_int8(CFG, params)
+    with pytest.raises(ValueError, match="already weight-only int8"):
+        quantize_params_int8(CFG, qp)

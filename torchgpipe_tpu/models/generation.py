@@ -443,6 +443,21 @@ def _total_len(s: int, max_new_tokens: int, max_len: Optional[int]) -> int:
     return total
 
 
+def _check_decodable(cfg: TransformerConfig, positions: int) -> None:
+    """Every generation entry point's static validity checks: causal
+    config (bidirectional/ViT-style models have no autoregressive
+    decode) and the learned-position-table bound.  Lives at the TOP
+    level (not just prefill) so the ``cache=`` continuation path — which
+    skips prefill — is covered too."""
+    if not cfg.causal:
+        raise ValueError(
+            "the KV-cache generation API is causal by construction; "
+            "cfg.causal=False (encoder/ViT-style bidirectional "
+            "attention) has no autoregressive decode"
+        )
+    _check_max_pos(cfg, positions)
+
+
 def _check_max_pos(cfg: TransformerConfig, positions: int) -> None:
     """Fail fast when a decode would run past a learned position table:
     ``jnp.take`` CLAMPS out-of-range indices under jit, so position
@@ -616,7 +631,7 @@ def prefill(
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds max_len {max_len}")
-    _check_max_pos(cfg, s)
+    _check_decodable(cfg, s)
     if ring and cfg.attn_window is None:
         raise ValueError(
             "ring caches hold exactly the attention window: set "
@@ -766,7 +781,7 @@ def generate(
     ring caches wrap and never run out)."""
     b, s = prompt.shape
     total = _total_len(s, max_new_tokens, max_len)
-    _check_max_pos(cfg, total)
+    _check_decodable(cfg, total)
     if cache_mode not in ("full", "ring"):
         raise ValueError(
             f"cache_mode must be 'full' or 'ring', got {cache_mode!r}"
@@ -848,7 +863,7 @@ def beam_search(
     if k < 1:
         raise ValueError(f"num_beams must be >= 1, got {k}")
     total = _total_len(s, max_new_tokens, max_len)
-    _check_max_pos(cfg, total)
+    _check_decodable(cfg, total)
     embed_p, block_p, head_p = _split_params(cfg, params)
     mlp_layer = _mlp_layer_for(cfg, moe)
     logits0, cache = prefill(cfg, params, prompt, total, moe=moe)
@@ -1037,7 +1052,7 @@ def speculative_generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)  # deterministic path; keys unused
     total = _total_len(s, T, max_len)
-    _check_max_pos(cfg, total)
+    _check_decodable(cfg, total)
     # Chunk writes run up to gamma+1 past the accepted frontier before
     # rolling back; pad the buffers so dynamic_update_slice never clamps.
     L = total + g + 1

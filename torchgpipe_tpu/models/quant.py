@@ -5,8 +5,17 @@ weight matrix, so the byte width of the weights IS the throughput at
 small batch.  Storing the projection matrices as int8 with per-output-
 channel symmetric scales halves the bf16 read traffic (quarter of f32)
 while the matmuls still run in the compute dtype — the dequantize
-(``int8 -> dtype, * scale``) fuses into the operand read, so HBM sees
-int8 and the MXU sees the usual bf16/f32 operands.
+(``int8 -> dtype, * scale``) is intended to fuse into the operand read,
+so HBM sees int8 and the MXU sees the usual bf16/f32 operands.
+
+Compiler caveat (hardware verification pending — the
+``llama-decode-w8`` checklist step measures it): the dequantize is
+loop-invariant across decode ticks, so XLA *could* hoist it out of the
+scan and materialize full-width copies, erasing the traffic saving.
+The footprint saving (checkpoint size, host->device transfer) holds
+regardless; if the measured step shows no throughput win, pinning the
+in-loop dequantize (e.g. ``optimization_barrier`` on the q8 leaves) is
+the follow-up.
 
 Scope and composition:
 
@@ -104,6 +113,16 @@ def quantize_params_int8(
                 q[k] = v
         out.append(q)
     if n_quantized == 0:
+        if any(
+            is_quantized(v)
+            for layer in params
+            if isinstance(layer, dict)
+            for v in layer.values()
+        ):
+            raise ValueError(
+                "these params are already weight-only int8 "
+                "(quantize_params_int8 applied twice?)"
+            )
         raise ValueError(
             "no eligible 2-D projection weights found — "
             "quantize_params_int8 takes the FLAT per-layer list the "

@@ -107,6 +107,10 @@ class TransformerConfig:
     # mlp(ln2(x)) — both branches read the SAME input instead of
     # chaining (one residual add, better overlap).
     parallel_residual: bool = False
+    # Causal masking.  False = bidirectional (encoder-style) attention —
+    # the ViT family; the KV-cache generation API is causal by
+    # construction and rejects non-causal configs.
+    causal: bool = True
     # Partial rotary (GPT-NeoX rotary_pct): only the first
     # ``int(head_dim * rope_pct)`` dims of each head rotate; the rest
     # pass through position-free.  1.0 = full rotary (Llama).
@@ -452,7 +456,7 @@ def transformer_block(
         # pairing (h // r with r = nh_loc/nkv_loc = nh/nkv) matches global.
         attn = attention(
             q, k, v, axis_name=cfg.sp_axis if sp_active else None,
-            causal=True, impl=cfg.sp_impl, window=cfg.attn_window,
+            causal=cfg.causal, impl=cfg.sp_impl, window=cfg.attn_window,
         )
         attn_flat = attn.reshape(b, s, nh_loc * hd)
         attn_out = attn_flat @ params["wo"]

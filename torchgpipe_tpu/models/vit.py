@@ -1,0 +1,167 @@
+"""Sequential Vision Transformer (ViT) for the pipeline engines.
+
+Extends the model zoo beyond the reference's three conv nets (SURVEY.md
+§2.4) with the modern vision architecture — built ENTIRELY from the
+framework's existing transformer machinery, exercising the classic
+knobs in a second modality: ``norm='layernorm'``, bidirectional
+attention (``causal=False``), learned patch positions, and the
+pre-norm :func:`~torchgpipe_tpu.models.transformer.transformer_block`
+unchanged.
+
+Design, pipeline-first (Dosovitskiy et al., arXiv:2010.11929):
+
+* **Flat sequential layer list** — ``[patch_embed, block × depth,
+  head]`` — so ``GPipe(vit(...), balance=...)`` splits it at any block
+  boundary, exactly like the text models.  No CLS token: the head
+  mean-pools patch tokens (the paper's GAP variant; same accuracy
+  class, and it keeps every stage's activation a uniform
+  ``[b, N, dim]`` — friendlier to the SPMD engine's stacked stages
+  than a ragged +1 token).
+* **Patchify = one reshape + matmul** (the conv-free formulation): the
+  ``P×P×3 -> dim`` projection is a single MXU-shaped ``[N, P²·3] @
+  [P²·3, dim]`` per image, with a learned position table added —
+  XLA-friendlier than a strided conv and numerically identical.
+* The blocks are the SAME :func:`transformer_block` the llama family
+  trains — MHA (``n_kv_heads = n_heads``), GeLU MLP, tp/sp composition
+  and flash attention (bidirectional) included for free.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu.layers import Layer
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    _block_norm,
+    _normal,
+    transformer_block,
+)
+
+
+def vit_config(
+    *,
+    image_size: int = 224,
+    patch_size: int = 16,
+    dim: int = 384,
+    depth: int = 12,
+    n_heads: int = 6,
+    mlp_ratio: float = 4.0,
+    dtype: jnp.dtype = jnp.float32,
+) -> TransformerConfig:
+    """The ViT block configuration: LayerNorm, bidirectional attention,
+    classic (non-gated) GeLU MLP, learned positions over the patch
+    grid.  ``vocab`` is unused (images, not tokens) and set to 1."""
+    if image_size % patch_size:
+        raise ValueError(
+            f"image_size={image_size} is not divisible by "
+            f"patch_size={patch_size}"
+        )
+    n_patches = (image_size // patch_size) ** 2
+    return TransformerConfig(
+        vocab=1,
+        dim=dim,
+        n_layers=depth,
+        n_heads=n_heads,
+        n_kv_heads=n_heads,
+        mlp_ratio=mlp_ratio,
+        norm="layernorm",
+        pos_emb="learned",
+        max_pos=n_patches,
+        mlp_impl="classic",
+        act="gelu_tanh",
+        attn_bias=True,
+        attn_out_bias=True,
+        causal=False,
+        dtype=dtype,
+    )
+
+
+def patch_embed(
+    cfg: TransformerConfig, patch_size: int, *, name: str = "patchify"
+) -> Layer:
+    """``[b, H, W, 3] -> [b, N, dim]``: non-overlapping P×P patches
+    flattened and projected by one matmul, plus the learned position
+    table (rows = patch index in raster order)."""
+    p = patch_size
+
+    def init(rng, in_spec):
+        _, h, w, c = in_spec.shape
+        k1, k2, k3 = jax.random.split(rng, 3)
+        return {
+            "w": _normal(k1, (p * p * c, cfg.dim), (p * p * c) ** -0.5,
+                         cfg.dtype),
+            "b": jnp.zeros((cfg.dim,), cfg.dtype),
+            "pos": _normal(k2, (cfg.max_pos, cfg.dim), 0.02, cfg.dtype),
+        }, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        b, h, w, c = x.shape
+        gh, gw = h // p, w // p
+        patches = (
+            x.reshape(b, gh, p, gw, p, c)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(b, gh * gw, p * p * c)
+        )
+        out = patches.astype(cfg.dtype) @ params["w"] + params["b"]
+        return out + params["pos"][None, : gh * gw], state
+
+    return Layer(name=name, init=init, apply=apply, meta={})
+
+
+def vit_head(
+    cfg: TransformerConfig, num_classes: int, *, name: str = "head"
+) -> Layer:
+    """Final LayerNorm -> mean-pool over patches -> linear classifier
+    (the GAP head)."""
+
+    def init(rng, in_spec):
+        del in_spec
+        return {
+            "scale": jnp.ones((cfg.dim,)),
+            "bias": jnp.zeros((cfg.dim,)),
+            "w": _normal(rng, (cfg.dim, num_classes), cfg.dim ** -0.5,
+                         cfg.dtype),
+            "b": jnp.zeros((num_classes,), cfg.dtype),
+        }, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        h = _block_norm(cfg, params, "scale", x)
+        pooled = jnp.mean(h, axis=1)
+        return pooled @ params["w"] + params["b"], state
+
+    return Layer(name=name, init=init, apply=apply, meta={})
+
+
+def vit(
+    *,
+    image_size: int = 224,
+    patch_size: int = 16,
+    dim: int = 384,
+    depth: int = 12,
+    n_heads: int = 6,
+    num_classes: int = 1000,
+    mlp_ratio: float = 4.0,
+    dtype: jnp.dtype = jnp.float32,
+) -> List[Layer]:
+    """Flat sequential ViT: ``[patchify, block × depth, head]`` — feed
+    to ``GPipe(vit(...), balance=...)`` like any zoo model.  Defaults
+    are ViT-S/16."""
+    cfg = vit_config(
+        image_size=image_size, patch_size=patch_size, dim=dim,
+        depth=depth, n_heads=n_heads, mlp_ratio=mlp_ratio, dtype=dtype,
+    )
+    layers: List[Layer] = [patch_embed(cfg, patch_size)]
+    layers += [
+        transformer_block(cfg, name=f"block{i}") for i in range(depth)
+    ]
+    layers.append(vit_head(cfg, num_classes))
+    return layers
+
+
+__all__ = ["patch_embed", "vit", "vit_config", "vit_head"]
