@@ -366,6 +366,75 @@ class GPipe:
             )
         return loss, tuple(grads), tuple(new_states), aux
 
+    def init_opt_state(
+        self, optimizer: Any, params: Tuple[Pytree, ...]
+    ) -> Tuple[Pytree, ...]:
+        """Per-stage optimizer states, each committed to its stage's
+        device (pair with :meth:`make_train_step`)."""
+        return tuple(
+            jax.device_put(optimizer.init(p_j), self.devices[j])
+            for j, p_j in enumerate(params)
+        )
+
+    def make_train_step(
+        self, optimizer: Any, loss_fn: Any
+    ) -> Any:
+        """Training step with the optimizer applied PER STAGE.
+
+        ``optimizer`` is any optax-style gradient transformation.
+        Returns ``step(params, opt_state, state, x, target, rng=None)
+        -> (loss, new_params, new_opt_state, new_state, aux)``;
+        initialize ``opt_state`` with :meth:`init_opt_state`.
+
+        Why this exists: GPipe's per-stage params live on DIFFERENT
+        devices, so jitting one optax update over the whole tuple
+        (e.g. plain ``optimizer.update(grads, opt_state, params)``)
+        fails with "incompatible devices for jitted computation" — a
+        sharp edge every first MPMD training loop hits.  Here each
+        stage's update compiles as its own program and runs on that
+        stage's device, dispatched asynchronously like the engine's
+        cells; gradients never leave their stage.
+
+        The SPMD twin (:meth:`SpmdGPipe.make_train_step
+        <torchgpipe_tpu.spmd.SpmdGPipe.make_train_step>`) fuses the
+        whole update into ONE program instead — possible there because
+        all params live in one mesh computation."""
+
+        def _upd(g: Pytree, os: Pytree, p: Pytree) -> Tuple[Pytree, Pytree]:
+            u, nos = optimizer.update(g, os, p)
+            newp = jax.tree_util.tree_map(
+                lambda a, b: (a + b).astype(a.dtype), p, u
+            )
+            return newp, nos
+
+        # Donate the optimizer state and old params: the update happens
+        # in place in each stage's HBM (no transient 2x params+moments),
+        # matching the SPMD twin's donate=True.  Callers must treat the
+        # passed-in params/opt_state as consumed (standard donation
+        # contract; XLA ignores donation where unsupported, e.g. CPU).
+        upd = jax.jit(_upd, donate_argnums=(1, 2))
+
+        def step(
+            params: Tuple[Pytree, ...],
+            opt_state: Tuple[Pytree, ...],
+            state: Tuple[Pytree, ...],
+            x: Pytree,
+            target: Pytree,
+            rng: Optional[jax.Array] = None,
+        ) -> Tuple[jax.Array, Tuple, Tuple, Tuple, Dict]:
+            loss, grads, new_state, aux = self.value_and_grad(
+                params, state, x, target, loss_fn, rng=rng
+            )
+            new_p = []
+            new_os = []
+            for p_j, g_j, os_j in zip(params, grads, opt_state):
+                np_j, nos_j = upd(g_j, os_j, p_j)
+                new_p.append(np_j)
+                new_os.append(nos_j)
+            return loss, tuple(new_p), tuple(new_os), new_state, aux
+
+        return step
+
     def value_and_grad_with_loss_params(
         self,
         params: Tuple[Pytree, ...],

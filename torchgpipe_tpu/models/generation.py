@@ -1053,6 +1053,10 @@ def speculative_generate(
         rng = jax.random.PRNGKey(0)  # deterministic path; keys unused
     total = _total_len(s, T, max_len)
     _check_decodable(cfg, total)
+    # The draft decodes to the same frontier (its table clamps just as
+    # silently — garbage proposals would only collapse the acceptance
+    # rate, with no error).
+    _check_decodable(draft_cfg, total)
     # Chunk writes run up to gamma+1 past the accepted frontier before
     # rolling back; pad the buffers so dynamic_update_slice never clamps.
     L = total + g + 1

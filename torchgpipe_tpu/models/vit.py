@@ -90,7 +90,7 @@ def patch_embed(
 
     def init(rng, in_spec):
         _, h, w, c = in_spec.shape
-        k1, k2, k3 = jax.random.split(rng, 3)
+        k1, k2 = jax.random.split(rng)
         return {
             "w": _normal(k1, (p * p * c, cfg.dim), (p * p * c) ** -0.5,
                          cfg.dtype),
