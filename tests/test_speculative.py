@@ -285,3 +285,35 @@ def test_generate_top_p_restricts_support():
         x = _embed(CFG, embed_p, out[:, t : t + 1])
         x, cache = _decode_step(CFG, block_p, x, cache)
         logits = _logits(CFG, head_p, x)[:, 0]
+
+
+def test_speculative_sampling_with_top_p_matches_target_distribution():
+    """The exactness scheme must hold against the FILTERED target
+    distribution when nucleus filtering is on — the draft and target are
+    filtered identically before the accept test, so the marginal over
+    emitted tokens still matches target-only top-p sampling."""
+    tcfg = TransformerConfig(
+        vocab=8, dim=16, n_layers=1, n_heads=2, n_kv_heads=1
+    )
+    dcfg = TransformerConfig(
+        vocab=8, dim=8, n_layers=1, n_heads=1, n_kv_heads=1
+    )
+    tparams = _params(tcfg, 7, seq=4)
+    dparams = _params(dcfg, 99, seq=4)
+    N, s, T = 768, 3, 2
+    prompt = jnp.tile(_prompt(1, s, vocab=8), (N, 1))
+
+    kw = dict(temperature=1.0, top_p=0.7)
+    spec = speculative_generate(
+        tcfg, tparams, dcfg, dparams, prompt, T,
+        gamma=1, rng=jax.random.PRNGKey(5), **kw,
+    )
+    plain = generate(
+        tcfg, tparams, prompt, T, rng=jax.random.PRNGKey(11), **kw,
+    )
+    for col in range(T):
+        f_spec = np.bincount(np.asarray(spec[:, col]), minlength=8) / N
+        f_plain = np.bincount(np.asarray(plain[:, col]), minlength=8) / N
+        assert np.abs(f_spec - f_plain).max() < 0.08, (
+            col, f_spec, f_plain
+        )
