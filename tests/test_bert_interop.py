@@ -1,0 +1,158 @@
+"""BERT (encoder) HF interop.
+
+The encoder class exercises the last two structural knobs: POST-norm
+blocks (``LN(x + branch(x))`` — `norm_position='post'`) and the
+post-embedding LayerNorm (`embed_layernorm`), on top of bidirectional
+attention.  Oracle: per-token hidden states against a live
+``transformers.BertModel`` (single-segment convention — the token-type
+row 0 folds into the position table at import)."""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from torchgpipe_tpu.gpipe import GPipe  # noqa: E402
+from torchgpipe_tpu.layers import sequential_apply  # noqa: E402
+from torchgpipe_tpu.models.hf_interop import from_hf_bert  # noqa: E402
+from torchgpipe_tpu.models.transformer import llama  # noqa: E402
+
+
+def _hf_model(n_layer=2):
+    cfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=n_layer,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    m = transformers.BertModel(cfg)
+    m.eval()
+    return m
+
+
+def _tokens(b, s, mult=5, add=2):
+    return (np.arange(b * s).reshape(b, s) * mult + add) % 96
+
+
+def test_hidden_states_match_hf():
+    """Encoder parity: post-norm blocks, embedding LayerNorm, folded
+    token-type row, bidirectional attention — per-token hidden states
+    equal BertModel.last_hidden_state."""
+    m = _hf_model()
+    cfg, params = from_hf_bert(m)
+    assert cfg.norm_position == "post" and not cfg.causal
+    assert cfg.embed_layernorm
+    b, s = 2, 7
+    tokens = _tokens(b, s)
+
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).last_hidden_state.numpy()
+
+    layers = llama(cfg, head=False)
+    out, _ = sequential_apply(
+        layers, params, [() for _ in range(len(layers))],
+        jnp.asarray(tokens, jnp.int32), rng=None, train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_bert_fine_tunes_through_pipeline():
+    """The imported encoder + a user task head trains through GPipe:
+    mean-pool classification on a separable token task."""
+    from torchgpipe_tpu.layers import Layer
+
+    m = _hf_model()
+    cfg, params = from_hf_bert(m)
+    enc_layers = llama(cfg, head=False)
+
+    def head_init(rng, in_spec):
+        del in_spec
+        return {
+            "w": 0.02 * jax.random.normal(rng, (cfg.dim, 2)),
+            "b": jnp.zeros((2,)),
+        }, ()
+
+    def head_apply(p, st, x, *, rng=None, train=True):
+        del rng, train
+        return jnp.mean(x, axis=1) @ p["w"] + p["b"], st
+
+    layers = enc_layers + [Layer(name="cls", init=head_init,
+                                 apply=head_apply, meta={})]
+    model = GPipe(layers, balance=[2, 2], chunks=2)
+    b, s = 4, 8
+    x = jnp.asarray(_tokens(b, s), jnp.int32)
+    # Labels: whether the FIRST token is < 48 — requires reading content.
+    y = (x[:, 0] < 48).astype(jnp.int32)
+    p0, state = model.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    it = iter(params)
+    spliced = tuple(
+        tuple(next(it, p) for p in stage) for stage in p0
+    )
+    spliced = model.place(spliced)
+
+    def loss_fn(out, tgt):
+        lp = jax.nn.log_softmax(out.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(lp, tgt[:, None], 1))
+
+    losses = []
+    ps = spliced
+    for _ in range(30):
+        loss, grads, state, _ = model.value_and_grad(
+            ps, state, x, y, loss_fn
+        )
+        ps = jax.tree_util.tree_map(lambda a, g: a - 0.05 * g, ps, grads)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_generation_rejects_post_norm():
+    m = _hf_model(n_layer=1)
+    cfg, params = from_hf_bert(m)
+    from torchgpipe_tpu.models.generation import generate
+
+    with pytest.raises(ValueError, match="causal|post-norm"):
+        generate(cfg, params, jnp.zeros((1, 4), jnp.int32),
+                 max_new_tokens=2)
+
+
+def test_rejects_relative_positions():
+    cfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64,
+        position_embedding_type="relative_key",
+    )
+    torch.manual_seed(0)
+    with pytest.raises(ValueError, match="absolute"):
+        from_hf_bert(transformers.BertModel(cfg))
+
+
+def test_rejects_roberta_and_decoder_configs():
+    """Didactic-rejection discipline: RoBERTa's layout shares every key
+    name but reserves position rows (silent misalignment), and a
+    decoder-configured BertModel is causally masked in HF."""
+    rcfg = transformers.RobertaConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=66,
+    )
+    torch.manual_seed(0)
+    with pytest.raises(ValueError, match="RoBERTa"):
+        from_hf_bert(transformers.RobertaModel(rcfg))
+
+    dcfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, is_decoder=True,
+    )
+    with pytest.raises(ValueError, match="DECODER"):
+        from_hf_bert(transformers.BertModel(dcfg))

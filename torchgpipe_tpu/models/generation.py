@@ -455,6 +455,12 @@ def _check_decodable(cfg: TransformerConfig, positions: int) -> None:
             "cfg.causal=False (encoder/ViT-style bidirectional "
             "attention) has no autoregressive decode"
         )
+    if cfg.norm_position != "pre":
+        raise ValueError(
+            "the decode paths compute pre-norm blocks; "
+            f"norm_position={cfg.norm_position!r} (BERT-class post-norm) "
+            "models are encoders — use the training/apply path"
+        )
     _check_max_pos(cfg, positions)
 
 

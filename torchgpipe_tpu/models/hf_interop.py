@@ -1002,17 +1002,135 @@ def state_dict_to_hf_opt(
     return sd
 
 
+def config_from_hf_bert(hf_config: Any) -> TransformerConfig:
+    """A :class:`TransformerConfig` equivalent to an HF ``BertConfig``:
+    the ENCODER class — bidirectional attention (``causal=False``),
+    POST-norm blocks (``LN(x + branch(x))``), a LayerNorm on the summed
+    embeddings, learned positions, separate biased projections, exact
+    gelu classic MLP.  Only absolute positions are computed here."""
+    mt = getattr(hf_config, "model_type", "bert")
+    if mt != "bert":
+        raise ValueError(
+            f"from_hf_bert maps the BertModel layout; got model_type="
+            f"{mt!r} — RoBERTa-class checkpoints share the key names but "
+            "reserve the first padding_idx+1 position rows (they would "
+            "need a pos_emb_offset import this function does not apply), "
+            "so importing them here would be silently misaligned"
+        )
+    if getattr(hf_config, "is_decoder", False) or getattr(
+        hf_config, "add_cross_attention", False
+    ):
+        raise ValueError(
+            "this BERT config is a DECODER (is_decoder/"
+            "add_cross_attention set): HF applies a causal mask and may "
+            "carry cross-attention weights — neither matches this "
+            "bidirectional encoder import"
+        )
+    if getattr(hf_config, "position_embedding_type", "absolute") != "absolute":
+        raise ValueError(
+            "this BERT checkpoint uses "
+            f"position_embedding_type={hf_config.position_embedding_type!r};"
+            " only the absolute learned-table variant is computed here"
+        )
+    act = getattr(hf_config, "hidden_act", "gelu")
+    act_map = {"gelu": "gelu", "gelu_new": "gelu_tanh",
+               "gelu_pytorch_tanh": "gelu_tanh", "relu": "relu"}
+    if act not in act_map:
+        raise ValueError(f"BERT hidden_act={act!r} is not computed here")
+    dim = hf_config.hidden_size
+    return TransformerConfig(
+        vocab=hf_config.vocab_size,
+        dim=dim,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=None,
+        mlp_ratio=hf_config.intermediate_size / dim,
+        norm_eps=float(hf_config.layer_norm_eps),
+        norm="layernorm",
+        norm_position="post",
+        causal=False,
+        pos_emb="learned",
+        max_pos=int(hf_config.max_position_embeddings),
+        embed_layernorm=True,
+        mlp_impl="classic",
+        act=act_map[act],
+        attn_bias=True,
+        attn_out_bias=True,
+    )
+
+
+def params_from_hf_bert(
+    state_dict: Dict[str, Any], cfg: TransformerConfig
+) -> List[Pytree]:
+    """Per-layer params in ``llama(cfg, head=False)`` order (embed,
+    blocks — BERT is an encoder; pair with your own task head) from a
+    ``BertModel`` state dict.
+
+    Single-segment convention: the token-type (segment) table's ROW 0 is
+    added to every position in single-sentence use, so it FOLDS into the
+    position table (``pos[i] += token_type[0]``) — no segment input is
+    needed at run time.  Two-segment inputs are out of scope.  The
+    pooler (a CLS-position head for NSP) is not imported; the encoder
+    output is the per-token hidden states."""
+    sd = state_dict
+    pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    e = pre + "embeddings."
+    pos = _v(sd[e + "position_embeddings.weight"])
+    tt0 = _v(sd[e + "token_type_embeddings.weight"])[0]
+    embed: Dict[str, Any] = {
+        "table": _v(sd[e + "word_embeddings.weight"]),
+        "pos": pos + tt0[None, :],
+        "eln": _v(sd[e + "LayerNorm.weight"]),
+        "elnb": _v(sd[e + "LayerNorm.bias"]),
+    }
+    out: List[Pytree] = [embed]
+    for i in range(cfg.n_layers):
+        p = f"{pre}encoder.layer.{i}."
+        out.append({
+            "wq": _t(sd[p + "attention.self.query.weight"]),
+            "bq": _v(sd[p + "attention.self.query.bias"]),
+            "wk": _t(sd[p + "attention.self.key.weight"]),
+            "bk": _v(sd[p + "attention.self.key.bias"]),
+            "wv": _t(sd[p + "attention.self.value.weight"]),
+            "bv": _v(sd[p + "attention.self.value.bias"]),
+            "wo": _t(sd[p + "attention.output.dense.weight"]),
+            "bo": _v(sd[p + "attention.output.dense.bias"]),
+            "ln1": _v(sd[p + "attention.output.LayerNorm.weight"]),
+            "ln1b": _v(sd[p + "attention.output.LayerNorm.bias"]),
+            "w_fc": _t(sd[p + "intermediate.dense.weight"]),
+            "b_fc": _v(sd[p + "intermediate.dense.bias"]),
+            "w_proj": _t(sd[p + "output.dense.weight"]),
+            "b_proj": _v(sd[p + "output.dense.bias"]),
+            "ln2": _v(sd[p + "output.LayerNorm.weight"]),
+            "ln2b": _v(sd[p + "output.LayerNorm.bias"]),
+        })
+    return out
+
+
+def from_hf_bert(model: Any) -> tuple:
+    """(cfg, per-layer params) from a live HF ``BertModel`` (or a
+    ``Bert*`` task model whose state dict prefixes ``bert.``) — the
+    encoder family: train/fine-tune through the pipelines with your own
+    task head appended; there is no decode path (the generation API
+    rejects ``causal=False`` and post-norm didactically)."""
+    cfg = config_from_hf_bert(model.config)
+    return cfg, params_from_hf_bert(model.state_dict(), cfg)
+
+
 __all__ = [
     "config_from_hf",
+    "config_from_hf_bert",
     "config_from_hf_gpt2",
     "config_from_hf_mixtral",
     "config_from_hf_neox",
     "config_from_hf_opt",
     "params_from_hf",
+    "params_from_hf_bert",
     "params_from_hf_gpt2",
     "params_from_hf_mixtral",
     "params_from_hf_neox",
     "params_from_hf_opt",
+    "from_hf_bert",
     "from_hf_gemma",
     "from_hf_gpt2",
     "from_hf_llama",

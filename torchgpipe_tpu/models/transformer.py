@@ -111,6 +111,13 @@ class TransformerConfig:
     # the ViT family; the KV-cache generation API is causal by
     # construction and rejects non-causal configs.
     causal: bool = True
+    # Residual-norm placement: 'pre' (norm the branch INPUT — every
+    # decoder family here) or 'post' (norm the residual SUM,
+    # ``LN(x + branch(x))`` — the BERT/original-transformer class).
+    norm_position: str = "pre"
+    # BERT-style LayerNorm applied to the summed embeddings (token +
+    # position) before the first block (embed params ``eln``/``elnb``).
+    embed_layernorm: bool = False
     # Partial rotary (GPT-NeoX rotary_pct): only the first
     # ``int(head_dim * rope_pct)`` dims of each head rotate; the rest
     # pass through position-free.  1.0 = full rotary (Llama).
@@ -181,6 +188,17 @@ class TransformerConfig:
             raise ValueError(
                 "pos_emb='learned' needs max_pos (the position table "
                 "size — HF GPT2Config.n_positions)"
+            )
+        if self.norm_position not in ("pre", "post"):
+            raise ValueError(
+                f"norm_position={self.norm_position!r}: expected 'pre' "
+                "or 'post'"
+            )
+        if self.norm_position == "post" and self.parallel_residual:
+            raise ValueError(
+                "norm_position='post' and parallel_residual do not "
+                "compose (no published family; the parallel form is "
+                "defined on pre-norm branches)"
             )
         if not 0.0 < self.rope_pct <= 1.0:
             raise ValueError(f"rope_pct={self.rope_pct} must be in (0, 1]")
@@ -431,7 +449,10 @@ def transformer_block(
         nh_loc = params["wq"].shape[1] // hd
         nkv_loc = params["wk"].shape[1] // hd
 
-        h = _block_norm(cfg, params, "ln1", x)
+        post = cfg.norm_position == "post"
+        # Post-norm (BERT class): the attention branch reads RAW x; ln1
+        # normalizes the residual SUM below instead.
+        h = x if post else _block_norm(cfg, params, "ln1", x)
         if tp_active:
             h = psum_grad(h, cfg.tp_axis)  # region entry: full grad upstream
         q, k, v = h @ params["wq"], h @ params["wk"], h @ params["wv"]
@@ -474,11 +495,14 @@ def transformer_block(
         # BLOCK INPUT (ln2 of x, not of x + attn_out) and both branch
         # outputs land in one residual add at the end.
         x_in = x
-        x = x + attn_out
-
-        h = _block_norm(
-            cfg, params, "ln2", x_in if cfg.parallel_residual else x
-        )
+        if post:
+            x = _block_norm(cfg, params, "ln1", x + attn_out)
+            h = x  # post-norm MLP branch reads the normalized sum raw
+        else:
+            x = x + attn_out
+            h = _block_norm(
+                cfg, params, "ln2", x_in if cfg.parallel_residual else x
+            )
         if mlp is not None:
             mlp_out, _ = mlp.apply(params["mlp"], (), h, rng=rng, train=train)
         elif "w_fc" in params:
@@ -498,7 +522,10 @@ def transformer_block(
             mlp_out = (gate * up) @ params["w_down"]
             if tp_active:
                 mlp_out = psum_value(mlp_out, cfg.tp_axis)
-        x = x + mlp_out
+        if post:
+            x = _block_norm(cfg, params, "ln2", x + mlp_out)
+        else:
+            x = x + mlp_out
         return x, state
 
     tp = cfg.tp_axis
@@ -682,6 +709,9 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
         if cfg.pos_emb == "learned":
             k2 = jax.random.fold_in(rng, 1)
             p["pos"] = _normal(k2, (cfg.max_pos, cfg.dim), 0.02, cfg.dtype)
+        if cfg.embed_layernorm:
+            p["eln"] = jnp.ones((cfg.dim,))
+            p["elnb"] = jnp.zeros((cfg.dim,))
         return p, ()
 
     def apply(params, state, x, *, rng=None, train=True):
@@ -711,12 +741,19 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
                 cfg.pos_emb_offset + off + jnp.arange(s),
                 axis=0,
             ).astype(out.dtype)
+        if "eln" in params:  # BERT-style post-embedding LayerNorm
+            out = _norm(
+                out, params["eln"], cfg.norm_eps,
+                bias=params["elnb"], centered=True,
+            )
         return out, state
 
     tp = cfg.tp_axis
     table_spec = {"table": P(tp)}
     if cfg.pos_emb == "learned":
         table_spec["pos"] = P()
+    if cfg.embed_layernorm:
+        table_spec.update(eln=P(), elnb=P())
     meta = _vocab_meta(cfg, table_spec)
     return Layer(name=name, init=init, apply=apply, meta=meta)
 
