@@ -156,3 +156,53 @@ def test_rejects_roberta_and_decoder_configs():
     )
     with pytest.raises(ValueError, match="DECODER"):
         from_hf_bert(transformers.BertModel(dcfg))
+
+
+def test_roberta_hidden_states_match_hf():
+    """RoBERTa = the BERT layout + reserved position rows: imported via
+    pos_emb_offset (padding_idx+1), per-token hidden states match the
+    live RobertaModel."""
+    rcfg = transformers.RobertaConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=66,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    m = transformers.RobertaModel(rcfg)
+    m.eval()
+
+    from torchgpipe_tpu.models.hf_interop import from_hf_roberta
+
+    cfg, params = from_hf_roberta(m)
+    assert cfg.pos_emb_offset == 2 and cfg.max_pos == 66
+    # Avoid token id 1 (RoBERTa's pad id — HF would zero its position).
+    tokens = (np.arange(14).reshape(2, 7) * 5 + 2) % 94 + 2
+
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).last_hidden_state.numpy()
+
+    layers = llama(cfg, head=False)
+    out, _ = sequential_apply(
+        layers, params, [() for _ in layers],
+        jnp.asarray(tokens, jnp.int32), rng=None, train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_position_table_guard_on_encoder_path():
+    """The training/encoder path fails fast past the position table
+    (jnp.take would clamp silently): BERT max_pos=64 accepts seq 64 and
+    rejects 65; the RoBERTa offset shrinks the usable length."""
+    m = _hf_model(n_layer=1)
+    cfg, params = from_hf_bert(m)
+    layers = llama(cfg, head=False)
+    ok = jnp.zeros((1, 64), jnp.int32)
+    sequential_apply(layers, params, [() for _ in layers], ok,
+                     rng=None, train=False)
+    with pytest.raises(ValueError, match="position table"):
+        sequential_apply(layers, params, [() for _ in layers],
+                         jnp.zeros((1, 65), jnp.int32), rng=None,
+                         train=False)

@@ -1013,22 +1013,28 @@ def config_from_hf_bert(hf_config: Any) -> TransformerConfig:
         raise ValueError(
             f"from_hf_bert maps the BertModel layout; got model_type="
             f"{mt!r} — RoBERTa-class checkpoints share the key names but "
-            "reserve the first padding_idx+1 position rows (they would "
-            "need a pos_emb_offset import this function does not apply), "
-            "so importing them here would be silently misaligned"
+            "reserve the first padding_idx+1 position rows, so importing "
+            "them here would be silently misaligned; use from_hf_roberta "
+            "(which applies the pos_emb_offset)"
         )
+    return _bert_like_config(hf_config)
+
+
+def _bert_like_config(hf_config: Any) -> TransformerConfig:
+    """The BERT-layout field mapping + shared didactic guards (BERT and
+    RoBERTa call this after their own model_type checks)."""
     if getattr(hf_config, "is_decoder", False) or getattr(
         hf_config, "add_cross_attention", False
     ):
         raise ValueError(
-            "this BERT config is a DECODER (is_decoder/"
+            "this BERT-layout config is a DECODER (is_decoder/"
             "add_cross_attention set): HF applies a causal mask and may "
             "carry cross-attention weights — neither matches this "
             "bidirectional encoder import"
         )
     if getattr(hf_config, "position_embedding_type", "absolute") != "absolute":
         raise ValueError(
-            "this BERT checkpoint uses "
+            "this BERT-layout checkpoint uses "
             f"position_embedding_type={hf_config.position_embedding_type!r};"
             " only the absolute learned-table variant is computed here"
         )
@@ -1117,6 +1123,42 @@ def from_hf_bert(model: Any) -> tuple:
     return cfg, params_from_hf_bert(model.state_dict(), cfg)
 
 
+def from_hf_roberta(model: Any) -> tuple:
+    """(cfg, per-layer params) from a live HF ``RobertaModel`` — the
+    BERT layout with RoBERTa's position convention: position ids start
+    at ``padding_idx + 1`` (= 2), so the table reserves its first two
+    rows and every lookup shifts — exactly OPT's ``pos_emb_offset``
+    mechanism, applied here so the import is aligned (the plain
+    :func:`from_hf_bert` rejects RoBERTa for this reason).
+
+    PAD-FREE inputs only: HF RoBERTa computes positions as a cumsum
+    over non-pad tokens, so a sequence CONTAINING the pad id (1) gets
+    shifted positions there while this import assigns sequential ones —
+    feed unpadded batches (or uniform-length ones with no pad tokens),
+    the convention the parity test pins."""
+    import dataclasses
+
+    hfc = model.config
+    if getattr(hfc, "model_type", "") != "roberta":
+        raise ValueError(
+            f"from_hf_roberta maps RobertaModel; got model_type="
+            f"{getattr(hfc, 'model_type', None)!r} — plain BERT imports "
+            "via from_hf_bert"
+        )
+    offset = int(getattr(hfc, "pad_token_id", 1)) + 1
+    cfg = dataclasses.replace(
+        _bert_like_config(hfc), pos_emb_offset=offset
+    )
+    sd = model.state_dict()
+    if any(k.startswith("roberta.") for k in sd):
+        sd = {
+            k[len("roberta."):]: v
+            for k, v in sd.items()
+            if k.startswith("roberta.")
+        }
+    return cfg, params_from_hf_bert(sd, cfg)
+
+
 __all__ = [
     "config_from_hf",
     "config_from_hf_bert",
@@ -1137,6 +1179,7 @@ __all__ = [
     "from_hf_mixtral",
     "from_hf_neox",
     "from_hf_opt",
+    "from_hf_roberta",
     "from_hf_qwen2",
     "from_hf_qwen3",
     "state_dict_to_hf",

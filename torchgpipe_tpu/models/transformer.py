@@ -731,10 +731,21 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
             out = out * jnp.asarray(cfg.embed_scale, out.dtype)
         if "pos" in params:
             s = x.shape[-1]
+            sp_active = axis_bound(cfg.sp_axis)
+            if not sp_active and s + cfg.pos_emb_offset > cfg.max_pos:
+                # jnp.take CLAMPS out-of-range rows under jit — the last
+                # tokens would silently reuse row max_pos-1.  Decode has
+                # its own guard (generation._check_max_pos); this covers
+                # the encoder/training path.  Under a bound sp axis the
+                # global offset is traced, so shards rely on the caller
+                # sizing seq*sp against the table.
+                raise ValueError(
+                    f"sequence length {s} + pos_emb_offset "
+                    f"{cfg.pos_emb_offset} exceeds the learned position "
+                    f"table (max_pos={cfg.max_pos} rows)"
+                )
             off = (
-                jax.lax.axis_index(cfg.sp_axis) * s
-                if axis_bound(cfg.sp_axis)
-                else 0
+                jax.lax.axis_index(cfg.sp_axis) * s if sp_active else 0
             )
             out = out + jnp.take(
                 params["pos"],
