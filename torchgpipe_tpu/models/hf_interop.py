@@ -15,14 +15,28 @@ Conventions verified against ``transformers`` (tested numerically in
 * GQA query→kv pairing ``h // (nh/nkv)`` matches;
 * ``RMSNorm`` math (f32 accumulation, eps inside rsqrt) matches.
 
-f32/bf16 Llama-family checkpoints are covered (no fused/quantized HF
-layouts), including Mistral (always-on sliding window -> ``attn_window``)
-and — via :func:`from_hf_qwen2` / :func:`from_hf_gemma` — the Qwen2
-family (q/k/v biases) and Gemma 1 (explicit head_dim, GeGLU, scaled
-embeddings, (1+w) norms folded into scales, always-tied head).  MoE: ``from_hf_mixtral`` imports ``MixtralForCausalLM`` into
-the ``llama_moe`` family (dropless dispatch; HF's renormalized top-k is
-exactly the GShard gate normalization for k >= 2 — logits and greedy
-decode match the live HF model in CI).
+Eleven families, one importer each (see docs/migration.md for the
+matrix; every mapping is verified numerically against the live
+``transformers`` model in CI):
+
+* decoder / RMSNorm+rotary class: Llama 1-3 + Mistral (sliding window)
+  via :func:`from_hf_llama`; Qwen2 (:func:`from_hf_qwen2`, q/k/v
+  biases); Qwen3 (:func:`from_hf_qwen3`, per-head q/k norms); Gemma 1
+  (:func:`from_hf_gemma`, GeGLU/scaled embeddings/folded norms);
+  Mixtral MoE (:func:`from_hf_mixtral`, dropless dispatch — HF's
+  renormalized top-k IS the GShard gate normalization for k >= 2);
+* decoder / classic class: GPT-2 (:func:`from_hf_gpt2` — LayerNorm,
+  learned positions, fused ``c_attn``, Conv1D orientation), GPT-NeoX/
+  Pythia (:func:`from_hf_neox` — partial rotary, parallel residual,
+  per-head-interleaved qkv), OPT (:func:`from_hf_opt` — offset position
+  table, relu);
+* encoder class: BERT (:func:`from_hf_bert` — post-norm blocks,
+  embedding LayerNorm, bidirectional) and RoBERTa
+  (:func:`from_hf_roberta` — + reserved position rows).
+
+f32/bf16 checkpoints import at their own width (no fused/quantized HF
+layouts); decoder families also EXPORT back via their
+``state_dict_to_hf*`` mirrors.
 """
 
 from __future__ import annotations
