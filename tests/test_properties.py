@@ -298,3 +298,46 @@ def test_ragged_masked_mean_algebra(batch, chunks, dp, data):
             lane_vals.append(s * (dp / n_real) * chunks / chunks)
         total += float(np.mean(lane_vals))  # pmean over dp
     np.testing.assert_allclose(total, rows.mean(), rtol=1e-12, atol=1e-9)
+
+
+@settings(deadline=None, max_examples=50)
+@given(
+    vocab=st.integers(4, 24),
+    temp=st.floats(0.2, 2.0),
+    k=st.integers(1, 24),
+    p=st.floats(0.05, 1.0),
+    seed=st.integers(0, 1000),
+)
+def test_filter_logits_properties(vocab, temp, k, p, seed):
+    """Sampling-filter invariants over the input space: the argmax always
+    survives; top_k=V and top_p=1.0 are no-ops; the kept set shrinks
+    monotonically in both knobs; composition keeps a subset of each
+    filter alone."""
+    import jax
+
+    from torchgpipe_tpu.models.generation import _filter_logits
+
+    k = min(k, vocab)
+    logits = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(seed), (1, vocab)) * 3.0
+    )
+
+    def kept(tk, tp):
+        out = np.asarray(_filter_logits(logits, temp, tk, tp))
+        return np.isfinite(out)[0]
+
+    both = kept(k, p)
+    assert both[int(np.argmax(logits))]          # argmax survives
+    assert both.any()
+
+    noop = np.asarray(_filter_logits(logits, temp, vocab, 1.0))
+    np.testing.assert_allclose(noop, logits / temp, rtol=1e-6)
+
+    # Monotone in k and in p; composition is an intersection-like subset.
+    k_only, p_only = kept(k, None), kept(None, p)
+    assert not (both & ~k_only).any()
+    assert not (both & ~p_only).any()
+    if k < vocab:
+        assert not (k_only & ~kept(k + 1, None)).any()
+    bigger_p = kept(None, min(1.0, p + 0.2))
+    assert not (p_only & ~bigger_p).any()

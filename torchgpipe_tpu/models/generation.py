@@ -540,7 +540,11 @@ def _filter_logits(
     if top_k is not None:
         kth = jnp.sort(logits, axis=-1)[..., -top_k, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p is not None:
+    # top_p >= 1.0 is a NO-OP by definition; the cumulative-mass test
+    # below would still drop tokens whose probability sits below f32
+    # resolution (the exclusive cumsum rounds to exactly 1.0 there) —
+    # caught by the property suite.
+    if top_p is not None and top_p < 1.0:
         srt = jnp.sort(logits, axis=-1)[..., ::-1]          # desc
         probs = jax.nn.softmax(srt, axis=-1)
         # Exclusive cumulative mass before each sorted slot: slot i stays
