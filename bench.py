@@ -41,29 +41,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 # Reference per-chip throughput: AmoebaNet-D (18,256), n=8 m=32, 8x P40.
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 132.413 / 8
 
-# Published bf16 peak FLOP/s per chip, keyed by device_kind substring
-# (checked in order, so the more specific names come first — e.g. 'v4 lite'
-# must hit the v4i row before the plain 'v4' row halves-understates it).
-_PEAK_BF16_FLOPS = (
-    ("v6 lite", 918e12),  # Trillium device_kind is 'TPU v6 lite'
-    ("v6e", 918e12),
-    ("v5 lite", 197e12),  # v5e device_kind is 'TPU v5 lite'
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v4 lite", 138e12),  # v4i
-    ("v4i", 138e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
-
-
-def _chip_peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, peak in _PEAK_BF16_FLOPS:
-        if key in kind:
-            return peak
-    return None
+from torchgpipe_tpu.utils.hw import chip_peak_bf16_flops as _chip_peak_flops  # noqa: E402
 
 
 def _analytic_step_flops(model, params, state, x, y, loss_fn, rng):

@@ -39,8 +39,24 @@ from torchgpipe_tpu.parallel.ring_attention import full_attention
 dense_attention = full_attention
 
 
+def _is_oom(e: Exception) -> bool:
+    msg = str(e)
+    return ("RESOURCE_EXHAUSTED" in msg or "Ran out of memory" in msg
+            or "Exceeded hbm capacity" in msg)
+
+
 def run_case(seq, streaming, b=4, h=16, g=8, d=128, dtype=jnp.bfloat16,
              iters=20):
+    """Returns (out_err, grad_err, t_flash_ms, t_dense_ms).
+
+    The dense oracle's score matrix is O(b·h·seq²) — at the long
+    sequence lengths the STREAMING kernel exists for (seq > 8k, where
+    resident K/V tips past ``_STREAM_BYTES`` of VMEM) it cannot fit HBM.
+    A dense-side failure therefore reports ``(nan, nan, t_flash, nan)``
+    rather than failing the case: the flash row still proves the kernel
+    runs (and how fast) in the regime the oracle cannot enter; numeric
+    equivalence in that regime is covered by the interpret-mode CI tests
+    (tests/test_flash_attention.py) and by the 2k/4k oracle rows here."""
     ks = jax.random.split(jax.random.PRNGKey(seq), 4)
     q = jax.random.normal(ks[0], (b, seq, h, d), dtype)
     k = jax.random.normal(ks[1], (b, seq, g, d), dtype)
@@ -57,23 +73,16 @@ def run_case(seq, streaming, b=4, h=16, g=8, d=128, dtype=jnp.bfloat16,
             dense_attention(q, k, v).astype(jnp.float32)
             * do.astype(jnp.float32))
 
-    flash_g = jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2)))
-    dense_g = jax.jit(jax.value_and_grad(loss_dense, argnums=(0, 1, 2)))
-
-    out_f = jax.jit(
-        lambda q, k, v: flash_attention(q, k, v, causal=True,
-                                        streaming=streaming))(q, k, v)
-    out_d = jax.jit(lambda q, k, v: dense_attention(q, k, v))(q, k, v)
-    _, grads_f = flash_g(q, k, v)
-    _, grads_d = dense_g(q, k, v)
-    jax.block_until_ready((out_f, out_d, grads_f, grads_d))
-
     def maxerr(a, bb):
         return float(jnp.max(jnp.abs(a.astype(jnp.float32)
                                      - bb.astype(jnp.float32))))
 
-    out_err = maxerr(out_f, out_d)
-    grad_err = max(maxerr(gf, gd) for gf, gd in zip(grads_f, grads_d))
+    flash_g = jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2)))
+    out_f = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                        streaming=streaming))(q, k, v)
+    _, grads_f = flash_g(q, k, v)
+    jax.block_until_ready((out_f, grads_f))
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -81,11 +90,32 @@ def run_case(seq, streaming, b=4, h=16, g=8, d=128, dtype=jnp.bfloat16,
     jax.block_until_ready((val, grads))
     t_flash = (time.perf_counter() - t0) / iters * 1e3
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        val, grads = dense_g(q, k, v)
-    jax.block_until_ready((val, grads))
-    t_dense = (time.perf_counter() - t0) / iters * 1e3
+    try:
+        dense_g = jax.jit(jax.value_and_grad(loss_dense, argnums=(0, 1, 2)))
+        out_d = jax.jit(lambda q, k, v: dense_attention(q, k, v))(q, k, v)
+        _, grads_d = dense_g(q, k, v)
+        jax.block_until_ready((out_d, grads_d))
+    except Exception as e:  # noqa: BLE001 — only OOM may stand down
+        # Only a resource failure excuses the oracle — any other error
+        # (lowering regression, shape bug) must still fail the case, or
+        # this script's numerics gate silently stops gating.
+        if not _is_oom(e):
+            raise
+        return float("nan"), float("nan"), t_flash, float("nan")
+
+    out_err = maxerr(out_f, out_d)
+    grad_err = max(maxerr(gf, gd) for gf, gd in zip(grads_f, grads_d))
+
+    try:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            val, grads = dense_g(q, k, v)
+        jax.block_until_ready((val, grads))
+        t_dense = (time.perf_counter() - t0) / iters * 1e3
+    except Exception as e:  # noqa: BLE001 — same OOM excuse as above
+        if not _is_oom(e):
+            raise
+        t_dense = float("nan")  # numerics landed; only the timing OOM'd
 
     return out_err, grad_err, t_flash, t_dense
 
@@ -94,6 +124,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", default="2048,4096")
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=4,
+                    help="batch size (drop to 1 for long-seq cases so the "
+                         "dense oracle's O(seq^2) scores have a chance)")
     # bf16 inputs with f32 accumulation: output tolerance scales with the
     # bf16 ulp at the magnitudes involved; gradients accumulate over seq.
     ap.add_argument("--tol-out", type=float, default=0.08)
@@ -109,11 +142,16 @@ def main():
         for streaming in (False, True):
             name = "streaming" if streaming else "resident"
             try:
-                oe, ge, tf, td = run_case(seq, streaming, iters=args.iters)
+                oe, ge, tf, td = run_case(seq, streaming, b=args.batch,
+                                          iters=args.iters)
             except Exception as e:  # noqa: BLE001 — report and continue
                 print(f"{seq:>6} {name:>9} FAILED: {type(e).__name__}: "
                       f"{str(e)[:120]}")
                 failed = True
+                continue
+            if td != td:  # dense oracle OOM'd: flash-only row, not a failure
+                print(f"{seq:>6} {name:>9} {'n/a':>9} {'n/a':>9} "
+                      f"{tf:>9.2f} {'OOM':>9}  ok (oracle infeasible)")
                 continue
             ok = oe <= args.tol_out and ge <= args.tol_grad
             failed |= not ok
