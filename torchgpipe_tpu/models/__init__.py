@@ -39,6 +39,13 @@ from torchgpipe_tpu.models.moe import (  # noqa: F401
     moe_transformer_block,
 )
 from torchgpipe_tpu.models.resnet import build_resnet, resnet50, resnet101  # noqa: F401
+from torchgpipe_tpu.models.t5 import (  # noqa: F401
+    T5Config,
+    t5_encode,
+    t5_generate,
+    t5_layers,
+    t5_shift_right,
+)
 from torchgpipe_tpu.models.unet import unet  # noqa: F401
 from torchgpipe_tpu.models.vgg import build_vgg, vgg16, vgg19  # noqa: F401
 from torchgpipe_tpu.models.vit import vit, vit_config  # noqa: F401

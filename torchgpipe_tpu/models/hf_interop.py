@@ -1304,3 +1304,130 @@ def state_dict_to_hf_mixtral(
             sd[f"{e}experts.{x}.w3.weight"] = t(mlp["w_up"][x])
             sd[f"{e}experts.{x}.w2.weight"] = t(mlp["w_down"][x])
     return sd
+
+
+# --------------------------------------------------------------------- #
+# T5 (encoder-decoder family — models/t5.py)                             #
+# --------------------------------------------------------------------- #
+
+
+def config_from_hf_t5(hf_config: Any) -> Any:
+    """``T5Config`` equivalent to an HF ``T5Config``.
+
+    Covers both the v1.0 class (relu DenseReluDense, tied embeddings —
+    t5-small/base/...) and the v1.1 class (gated GeLU, untied —
+    google/t5-v1_1-*, FLAN-T5) via HF's parsed ``is_gated_act`` /
+    ``dense_act_fn``."""
+    from .t5 import T5Config
+
+    acts = {
+        "relu": "relu", "gelu_new": "gelu_tanh", "gelu": "gelu",
+        "silu": "silu",
+    }
+    if hf_config.dense_act_fn not in acts:
+        raise ValueError(
+            f"T5 dense_act_fn {hf_config.dense_act_fn!r} is not supported "
+            f"(expected one of {sorted(acts)})"
+        )
+    act = acts[hf_config.dense_act_fn]
+    return T5Config(
+        vocab=hf_config.vocab_size,
+        dim=hf_config.d_model,
+        n_enc_layers=hf_config.num_layers,
+        n_dec_layers=hf_config.num_decoder_layers,
+        n_heads=hf_config.num_heads,
+        head_dim=hf_config.d_kv,
+        mlp_hidden=hf_config.d_ff,
+        act=act,
+        gated_mlp=bool(hf_config.is_gated_act),
+        rel_buckets=hf_config.relative_attention_num_buckets,
+        rel_max_distance=hf_config.relative_attention_max_distance,
+        norm_eps=hf_config.layer_norm_epsilon,
+        tie_word_embeddings=bool(hf_config.tie_word_embeddings),
+        decoder_start_id=hf_config.decoder_start_token_id,
+    )
+
+
+def _t5_ff_entry(sd: Dict[str, Any], prefix: str, gated: bool) -> Dict:
+    if gated:
+        return {
+            "wi0": _t(sd[prefix + "DenseReluDense.wi_0.weight"]),
+            "wi1": _t(sd[prefix + "DenseReluDense.wi_1.weight"]),
+            "wo": _t(sd[prefix + "DenseReluDense.wo.weight"]),
+        }
+    return {
+        "wi": _t(sd[prefix + "DenseReluDense.wi.weight"]),
+        "wo": _t(sd[prefix + "DenseReluDense.wo.weight"]),
+    }
+
+
+def _t5_attn_entry(sd: Dict[str, Any], prefix: str) -> Dict:
+    return {
+        "wq": _t(sd[prefix + "q.weight"]),
+        "wk": _t(sd[prefix + "k.weight"]),
+        "wv": _t(sd[prefix + "v.weight"]),
+        "wo": _t(sd[prefix + "o.weight"]),
+    }
+
+
+def params_from_hf_t5(state_dict: Dict[str, Any], cfg: Any) -> List[Pytree]:
+    """Per-layer params in ``t5_layers(cfg)`` order (embed, enc blocks,
+    enc final, dec blocks, final) from a ``T5ForConditionalGeneration``
+    state dict.
+
+    Tied checkpoints (v1.0): the shared table is COPIED into the head's
+    ``w`` (transposed), and ``cfg.logit_scale`` preserves HF's tied-head
+    ``d_model**-0.5`` rescale — forward and decode are exactly the HF
+    model; under pipeline fine-tuning the two copies train independently
+    (see models/t5.py docstring)."""
+    sd = state_dict
+    out: List[Pytree] = [{"table": _v(sd["shared.weight"])}]
+    for i in range(cfg.n_enc_layers):
+        p = f"encoder.block.{i}."
+        entry = {
+            "ln1": _v(sd[p + "layer.0.layer_norm.weight"]),
+            "attn": _t5_attn_entry(sd, p + "layer.0.SelfAttention."),
+            "ln2": _v(sd[p + "layer.1.layer_norm.weight"]),
+            "ff": _t5_ff_entry(sd, p + "layer.1.", cfg.gated_mlp),
+        }
+        if i == 0:
+            entry["rel"] = _v(sd[
+                p + "layer.0.SelfAttention.relative_attention_bias.weight"
+            ])
+        out.append(entry)
+    out.append({"ln": _v(sd["encoder.final_layer_norm.weight"])})
+    for i in range(cfg.n_dec_layers):
+        p = f"decoder.block.{i}."
+        entry = {
+            "ln1": _v(sd[p + "layer.0.layer_norm.weight"]),
+            "attn": _t5_attn_entry(sd, p + "layer.0.SelfAttention."),
+            "ln2": _v(sd[p + "layer.1.layer_norm.weight"]),
+            "xattn": _t5_attn_entry(sd, p + "layer.1.EncDecAttention."),
+            "ln3": _v(sd[p + "layer.2.layer_norm.weight"]),
+            "ff": _t5_ff_entry(sd, p + "layer.2.", cfg.gated_mlp),
+        }
+        if i == 0:
+            entry["rel"] = _v(sd[
+                p + "layer.0.SelfAttention.relative_attention_bias.weight"
+            ])
+        out.append(entry)
+    head = _t(sd[
+        "shared.weight" if cfg.tie_word_embeddings else "lm_head.weight"
+    ])
+    out.append({
+        "ln": _v(sd["decoder.final_layer_norm.weight"]),
+        "w": head,
+    })
+    return out
+
+
+def from_hf_t5(model: Any) -> tuple:
+    """(cfg, per-layer params) from a live HF
+    ``T5ForConditionalGeneration`` — the encoder-decoder family: logits
+    and greedy decode verified against the HF model in
+    tests/test_t5.py."""
+    cfg = config_from_hf_t5(model.config)
+    return cfg, params_from_hf_t5(model.state_dict(), cfg)
+
+
+__all__ += ["config_from_hf_t5", "params_from_hf_t5", "from_hf_t5"]

@@ -1,0 +1,542 @@
+"""T5 encoder-decoder family — the zoo's first seq2seq architecture.
+
+The reference framework is model-agnostic sequential pipelining (its zoo is
+CNNs + one decoder-only config, reference: benchmarks/models/*); an
+encoder-decoder is NEW capability, built the same way as every other family
+here: a flat :class:`~torchgpipe_tpu.layers.Layer` list the pipeline can cut
+at any boundary.
+
+Design — the whole seq2seq model as ONE sequential list:
+
+    [embed, enc_block x Ne, enc_final, dec_block x Nd, final]
+
+The activation flowing between layers is a TUPLE carrier:
+
+    (enc_ids, dec_ids)                      model input
+    (h_enc, h_dec)                          after ``embed`` (BOTH streams
+                                            embedded up front, so the shared
+                                            table has exactly one owner)
+    (h_enc, h_dec, ebias)                   through the encoder blocks
+    (h_enc, h_dec)                          after ``enc_final``
+    (h_enc, h_dec, dbias)                   through the decoder blocks
+    logits [b, sd, vocab]                   after ``final``
+
+Decoder blocks read ``h_enc`` for cross-attention and pass it through —
+the same tuple-style skip the AmoebaNet cells use (no stash/pop routing
+needed; every layer's input is its predecessor's output, so the list cuts
+anywhere).  Only the model INPUT is scattered into micro-batches, so the
+batch-1 relative-bias carriers (``ebias``/``dbias``, computed once by the
+block that owns the bucket table) ride between stages untouched.
+
+T5 architecture specifics implemented exactly (verified numerically against
+live HF models in tests/test_t5.py):
+
+* relative-position-bucket attention bias (Raffel et al., arXiv:1910.10683
+  §2.1): a learned ``[buckets, heads]`` table in the FIRST block of each
+  stack (HF layout), log-spaced buckets, bidirectional for the encoder and
+  causal for the decoder — no rotary, no absolute positions;
+* NO attention-score scaling (T5 folds the 1/sqrt(d) into init);
+* T5LayerNorm == RMSNorm (no mean subtraction, no bias), pre-norm blocks,
+  a final norm per stack, biases nowhere;
+* feed-forward: ``relu`` DenseReluDense (v1.0) or gated-GeLU (v1.1,
+  ``gated_mlp=True``);
+* v1.0 weight tying: the checkpoint's shared table is IMPORTED into both
+  the embedding and the head (``final`` owns its own ``w``), with the
+  tied-head ``dim**-0.5`` logit rescale preserved — forward/decode are
+  exactly the HF model.  Under pipeline FINE-TUNING the two copies train
+  independently (their gradients are not summed across stages); decoder-only
+  models wanting the exact tie train through ``llama_spmd`` +
+  ``tie_embeddings`` (see models/transformer.py).  v1.1-class checkpoints
+  are untied to begin with and carry no caveat.
+
+``t5_generate`` decodes with a self-attention KV cache plus per-layer
+cross K/V computed ONCE from the encoder output — prefill + decode compile
+to one program, same shape discipline as models/generation.py.
+
+Pad-free inputs: like the BERT/RoBERTa encoders (see docs/migration.md),
+there is no per-row attention/padding mask — every encoder position is
+attended, so batches must be full-length (or padded identically enough
+that you accept pad positions participating).  HF parity in CI is on
+pad-free batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..layers import Layer
+from .transformer import _act_fn, _normal, _rms
+
+Pytree = Any
+
+_NEG = -1e9  # additive mask value; softmax runs in f32 so this is "never"
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    """Architecture of a T5-family encoder-decoder.
+
+    Defaults are t5-small (v1.0).  ``gated_mlp=True`` + ``act='gelu_tanh'``
+    + ``tie_word_embeddings=False`` is the v1.1 class (google/t5-v1_1-*,
+    FLAN-T5)."""
+
+    vocab: int = 32128
+    dim: int = 512                      # d_model
+    n_enc_layers: int = 6
+    n_dec_layers: int = 6
+    n_heads: int = 8
+    head_dim: Optional[int] = None      # d_kv; None -> dim // n_heads
+    mlp_hidden: int = 2048              # d_ff
+    act: str = "relu"                   # ff activation
+    gated_mlp: bool = False             # v1.1 gated-act variant
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.float32
+    tie_word_embeddings: bool = True    # v1.0 ties + rescales logits
+    decoder_start_id: int = 0           # == pad for every published T5
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim or self.dim // self.n_heads
+
+    @property
+    def inner(self) -> int:
+        return self.n_heads * self.hd
+
+    @property
+    def logit_scale(self) -> Optional[float]:
+        # HF scales decoder hidden states by d_model**-0.5 before a TIED
+        # lm head (modeling_t5: `sequence_output * (model_dim**-0.5)`).
+        return self.dim ** -0.5 if self.tie_word_embeddings else None
+
+
+def _rel_bucket(
+    rel: jnp.ndarray, *, bidirectional: bool, buckets: int, max_dist: int
+) -> jnp.ndarray:
+    """T5's relative-position -> bucket map (log-spaced far bins).
+
+    ``rel = key_pos - query_pos``; semantics match HF
+    ``T5Attention._relative_position_bucket`` exactly (asserted against it
+    in tests/test_t5.py)."""
+    out = jnp.zeros_like(rel)
+    if bidirectional:
+        buckets //= 2
+        out = out + (rel > 0).astype(rel.dtype) * buckets
+        rel = jnp.abs(rel)
+    else:
+        rel = -jnp.minimum(rel, 0)
+    max_exact = buckets // 2
+    is_small = rel < max_exact
+    # log-spaced: positions in [max_exact, max_dist) map onto the
+    # remaining buckets; clamp keeps log() off zero for the small branch.
+    rel_f = jnp.maximum(rel, 1).astype(jnp.float32)
+    large = max_exact + (
+        jnp.log(rel_f / max_exact)
+        / jnp.log(max_dist / max_exact)
+        * (buckets - max_exact)
+    ).astype(rel.dtype)
+    large = jnp.minimum(large, buckets - 1)
+    return out + jnp.where(is_small, rel, large)
+
+
+def _rel_bias(
+    cfg: T5Config, table: jnp.ndarray, qlen: int, klen: int,
+    *, bidirectional: bool, causal_mask: bool,
+) -> jnp.ndarray:
+    """``[1, heads, qlen, klen]`` additive score bias (+ causal mask)."""
+    q_pos = jnp.arange(qlen)[:, None]
+    k_pos = jnp.arange(klen)[None, :]
+    bucket = _rel_bucket(
+        k_pos - q_pos, bidirectional=bidirectional,
+        buckets=cfg.rel_buckets, max_dist=cfg.rel_max_distance,
+    )
+    bias = table[bucket]  # [q, k, heads]
+    bias = jnp.transpose(bias, (2, 0, 1))[None]
+    if causal_mask:
+        bias = bias + jnp.where(k_pos - q_pos > 0, _NEG, 0.0)[None, None]
+    return bias.astype(jnp.float32)
+
+
+def _attend(
+    q: jnp.ndarray,        # [b, sq, inner]
+    k: jnp.ndarray,        # [b, sk, inner]
+    v: jnp.ndarray,        # [b, sk, inner]
+    bias: Optional[jnp.ndarray],  # [1|b, heads, sq, sk] or None
+    cfg: T5Config,
+) -> jnp.ndarray:
+    """UNSCALED dot-product attention (T5 has no 1/sqrt(d))."""
+    b, sq, _ = q.shape
+    sk = k.shape[1]
+    nh, hd = cfg.n_heads, cfg.hd
+    q = q.reshape(b, sq, nh, hd)
+    k = k.reshape(b, sk, nh, hd)
+    v = v.reshape(b, sk, nh, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out.reshape(b, sq, nh * hd)
+
+
+def _attn_params(rng: jnp.ndarray, cfg: T5Config) -> Pytree:
+    ks = jax.random.split(rng, 4)
+    d, inner, dt = cfg.dim, cfg.inner, cfg.dtype
+    # T5's init folds the missing score scale into wq (factor (d*hd)^-0.5).
+    return {
+        "wq": _normal(ks[0], (d, inner), (d * cfg.hd) ** -0.5, dt),
+        "wk": _normal(ks[1], (d, inner), d ** -0.5, dt),
+        "wv": _normal(ks[2], (d, inner), d ** -0.5, dt),
+        "wo": _normal(ks[3], (inner, d), inner ** -0.5, dt),
+    }
+
+
+def _ff_params(rng: jnp.ndarray, cfg: T5Config) -> Pytree:
+    ks = jax.random.split(rng, 3)
+    d, dff, dt = cfg.dim, cfg.mlp_hidden, cfg.dtype
+    if cfg.gated_mlp:
+        return {
+            "wi0": _normal(ks[0], (d, dff), d ** -0.5, dt),
+            "wi1": _normal(ks[1], (d, dff), d ** -0.5, dt),
+            "wo": _normal(ks[2], (dff, d), dff ** -0.5, dt),
+        }
+    return {
+        "wi": _normal(ks[0], (d, dff), d ** -0.5, dt),
+        "wo": _normal(ks[1], (dff, d), dff ** -0.5, dt),
+    }
+
+
+def _ff(cfg: T5Config, p: Pytree, h: jnp.ndarray) -> jnp.ndarray:
+    act = _act_fn(cfg.act)
+    if cfg.gated_mlp:
+        return (act(h @ p["wi0"]) * (h @ p["wi1"])) @ p["wo"]
+    return act(h @ p["wi"]) @ p["wo"]
+
+
+def _self_attn(
+    cfg: T5Config, p: Pytree, x: jnp.ndarray, bias: Optional[jnp.ndarray]
+) -> jnp.ndarray:
+    h = _rms(x, p["ln1"], cfg.norm_eps)
+    a = _attend(h @ p["attn"]["wq"], h @ p["attn"]["wk"],
+                h @ p["attn"]["wv"], bias, cfg)
+    return x + a @ p["attn"]["wo"]
+
+
+def t5_embed(cfg: T5Config, *, name: str = "embed") -> Layer:
+    """Embeds BOTH token streams with the one shared table.
+
+    ``(enc_ids, dec_ids) -> (h_enc, h_dec)``.  T5 does NOT scale
+    embedding outputs."""
+
+    def init(rng: jnp.ndarray, in_spec: Any) -> Tuple[Pytree, Pytree]:
+        del in_spec
+        # T5 init: embeddings ~ N(0, 1).
+        table = _normal(rng, (cfg.vocab, cfg.dim), 1.0, cfg.dtype)
+        return {"table": table}, ()
+
+    def apply(params: Pytree, state: Pytree, x: Any, *, rng: Any = None,
+              train: bool = True) -> Tuple[Any, Pytree]:
+        del rng, train
+        enc_ids, dec_ids = x
+        t = params["table"]
+        return (t[enc_ids], t[dec_ids]), state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def t5_enc_block(
+    cfg: T5Config, *, first: bool, name: str = "enc_block"
+) -> Layer:
+    """Encoder block: pre-norm self-attention (+bucket bias) then ff.
+
+    The FIRST block owns the encoder's relative-bias table (HF layout),
+    computes ``ebias`` once and appends it to the carrier."""
+
+    def init(rng: jnp.ndarray, in_spec: Any) -> Tuple[Pytree, Pytree]:
+        del in_spec
+        ks = jax.random.split(rng, 3)
+        p = {
+            "ln1": jnp.ones((cfg.dim,)),
+            "attn": _attn_params(ks[0], cfg),
+            "ln2": jnp.ones((cfg.dim,)),
+            "ff": _ff_params(ks[1], cfg),
+        }
+        if first:
+            p["rel"] = _normal(
+                ks[2], (cfg.rel_buckets, cfg.n_heads), 1.0, cfg.dtype
+            )
+        return p, ()
+
+    def apply(params: Pytree, state: Pytree, x: Any, *, rng: Any = None,
+              train: bool = True) -> Tuple[Any, Pytree]:
+        del rng, train
+        if first:
+            h_enc, h_dec = x
+            se = h_enc.shape[1]
+            ebias = _rel_bias(cfg, params["rel"], se, se,
+                              bidirectional=True, causal_mask=False)
+        else:
+            h_enc, h_dec, ebias = x
+        h_enc = _self_attn(cfg, params, h_enc, ebias)
+        h = _rms(h_enc, params["ln2"], cfg.norm_eps)
+        h_enc = h_enc + _ff(cfg, params["ff"], h)
+        return (h_enc, h_dec, ebias), state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def t5_enc_final(cfg: T5Config, *, name: str = "enc_final") -> Layer:
+    """Encoder final norm; drops the encoder bias from the carrier."""
+
+    def init(rng: jnp.ndarray, in_spec: Any) -> Tuple[Pytree, Pytree]:
+        del rng, in_spec
+        return {"ln": jnp.ones((cfg.dim,))}, ()
+
+    def apply(params: Pytree, state: Pytree, x: Any, *, rng: Any = None,
+              train: bool = True) -> Tuple[Any, Pytree]:
+        del rng, train
+        h_enc, h_dec, _ = x
+        return (_rms(h_enc, params["ln"], cfg.norm_eps), h_dec), state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def t5_dec_block(
+    cfg: T5Config, *, first: bool, name: str = "dec_block"
+) -> Layer:
+    """Decoder block: causal self-attention (+bucket bias), cross-attention
+    over the encoder output (no bias — HF semantics), then ff."""
+
+    def init(rng: jnp.ndarray, in_spec: Any) -> Tuple[Pytree, Pytree]:
+        del in_spec
+        ks = jax.random.split(rng, 4)
+        p = {
+            "ln1": jnp.ones((cfg.dim,)),
+            "attn": _attn_params(ks[0], cfg),
+            "ln2": jnp.ones((cfg.dim,)),
+            "xattn": _attn_params(ks[1], cfg),
+            "ln3": jnp.ones((cfg.dim,)),
+            "ff": _ff_params(ks[2], cfg),
+        }
+        if first:
+            p["rel"] = _normal(
+                ks[3], (cfg.rel_buckets, cfg.n_heads), 1.0, cfg.dtype
+            )
+        return p, ()
+
+    def apply(params: Pytree, state: Pytree, x: Any, *, rng: Any = None,
+              train: bool = True) -> Tuple[Any, Pytree]:
+        del rng, train
+        if first:
+            h_enc, h_dec = x
+            sd = h_dec.shape[1]
+            dbias = _rel_bias(cfg, params["rel"], sd, sd,
+                              bidirectional=False, causal_mask=True)
+        else:
+            h_enc, h_dec, dbias = x
+        h_dec = _self_attn(cfg, params, h_dec, dbias)
+        h = _rms(h_dec, params["ln2"], cfg.norm_eps)
+        a = _attend(h @ params["xattn"]["wq"], h_enc @ params["xattn"]["wk"],
+                    h_enc @ params["xattn"]["wv"], None, cfg)
+        h_dec = h_dec + a @ params["xattn"]["wo"]
+        h = _rms(h_dec, params["ln3"], cfg.norm_eps)
+        h_dec = h_dec + _ff(cfg, params["ff"], h)
+        return (h_enc, h_dec, dbias), state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def t5_final(cfg: T5Config, *, name: str = "final") -> Layer:
+    """Decoder final norm + LM head -> ``[b, sd, vocab]`` logits.
+
+    Owns its own head ``w`` (for tied checkpoints the importer copies the
+    shared table in and the ``dim**-0.5`` rescale applies — see the module
+    docstring's fine-tuning caveat)."""
+
+    def init(rng: jnp.ndarray, in_spec: Any) -> Tuple[Pytree, Pytree]:
+        del in_spec
+        return {
+            "ln": jnp.ones((cfg.dim,)),
+            "w": _normal(rng, (cfg.dim, cfg.vocab), cfg.dim ** -0.5,
+                         cfg.dtype),
+        }, ()
+
+    def apply(params: Pytree, state: Pytree, x: Any, *, rng: Any = None,
+              train: bool = True) -> Tuple[Any, Pytree]:
+        del rng, train
+        _, h_dec, _ = x
+        h = _rms(h_dec, params["ln"], cfg.norm_eps)
+        if cfg.logit_scale is not None:
+            h = h * cfg.logit_scale
+        return h @ params["w"], state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def t5_layers(cfg: T5Config) -> List[Layer]:
+    """The full encoder-decoder as a flat sequential list
+    (``n_enc_layers + n_dec_layers + 3`` layers, cuttable anywhere).
+
+    Input ``(enc_ids [b, se] int32, dec_ids [b, sd] int32)``; output
+    ``[b, sd, vocab]`` logits.  ``dec_ids`` is the teacher-forced decoder
+    input (``decoder_start_id`` + target shifted right, T5 convention)."""
+    layers = [t5_embed(cfg)]
+    for i in range(cfg.n_enc_layers):
+        layers.append(t5_enc_block(cfg, first=i == 0, name=f"enc_block{i}"))
+    layers.append(t5_enc_final(cfg))
+    for i in range(cfg.n_dec_layers):
+        layers.append(t5_dec_block(cfg, first=i == 0, name=f"dec_block{i}"))
+    layers.append(t5_final(cfg))
+    return layers
+
+
+# --------------------------------------------------------------------- #
+# Inference: encoder once + KV-cached decoder scan                        #
+# --------------------------------------------------------------------- #
+
+
+def _split_params(cfg: T5Config, params: List[Pytree]) -> Tuple:
+    ne = cfg.n_enc_layers
+    embed = params[0]
+    enc = params[1:1 + ne]
+    enc_final = params[1 + ne]
+    dec = params[2 + ne:2 + ne + cfg.n_dec_layers]
+    final = params[2 + ne + cfg.n_dec_layers]
+    return embed, enc, enc_final, dec, final
+
+
+def t5_encode(
+    cfg: T5Config, params: List[Pytree], enc_ids: jnp.ndarray
+) -> jnp.ndarray:
+    """Encoder-only forward: ``[b, se]`` ids -> ``[b, se, dim]``."""
+    embed, enc, enc_final, _, _ = _split_params(cfg, params)
+    h = embed["table"][enc_ids]
+    se = h.shape[1]
+    ebias = _rel_bias(cfg, enc[0]["rel"], se, se,
+                      bidirectional=True, causal_mask=False)
+    for p in enc:
+        h = _self_attn(cfg, p, h, ebias)
+        h = h + _ff(cfg, p["ff"], _rms(h, p["ln2"], cfg.norm_eps))
+    return _rms(h, enc_final["ln"], cfg.norm_eps)
+
+
+def t5_generate(
+    cfg: T5Config,
+    params: List[Pytree],
+    enc_ids: jnp.ndarray,              # [b, se] int32
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
+    rng: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Seq2seq decode: encoder once, then a KV-cached decoder scan.
+
+    Returns ``[b, max_new_tokens]`` generated ids (static shapes; with
+    ``eos_id`` set, finished rows keep emitting ``eos_id`` — trim
+    host-side).  ``temperature=0`` is greedy; otherwise pass ``rng`` for
+    temperature / top-k / top-p sampling (the same filters as
+    models/generation.py — shared code).  Per-layer cross-attention K/V
+    are computed ONCE from the encoder output; the self-attention cache
+    grows along the scan like the decoder-only path."""
+    from .generation import _sample  # shared sampling filters
+
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 sampling needs rng=")
+    embed, _, _, dec, final = _split_params(cfg, params)
+    h_enc = t5_encode(cfg, params, enc_ids)
+    b = enc_ids.shape[0]
+    total = max_new_tokens  # decoder positions 0..total-1
+    nh, hd = cfg.n_heads, cfg.hd
+
+    # Cross K/V once per layer: [b, se, inner].
+    cross = [
+        (h_enc @ p["xattn"]["wk"], h_enc @ p["xattn"]["wv"]) for p in dec
+    ]
+    # Decoder self-attention rel-bias for single-query steps is computed
+    # per step from the block-0 table (causal buckets over j - i <= 0).
+    rel_table = dec[0]["rel"]
+
+    def step_bias(i: jnp.ndarray) -> jnp.ndarray:
+        # [1, heads, 1, total]: bias for query position i over keys 0..total-1
+        j = jnp.arange(total)
+        bucket = _rel_bucket(
+            j - i, bidirectional=False,
+            buckets=cfg.rel_buckets, max_dist=cfg.rel_max_distance,
+        )
+        bias = rel_table[bucket]                      # [total, heads]
+        bias = jnp.transpose(bias, (1, 0))[None, :, None, :]
+        return bias.astype(jnp.float32) + jnp.where(
+            j > i, _NEG, 0.0
+        )[None, None, None, :]
+
+    # Cache dtype follows the actual imported params (a dtype-faithful
+    # bf16 checkpoint decodes in bf16 regardless of cfg.dtype).
+    cdt = embed["table"].dtype
+    k0 = jnp.zeros((len(dec), b, total, nh * hd), cdt)
+    v0 = jnp.zeros_like(k0)
+    start = jnp.full((b,), cfg.decoder_start_id, jnp.int32)
+    done0 = jnp.zeros((b,), bool)
+
+    def step(carry: Tuple, i: jnp.ndarray) -> Tuple[Tuple, jnp.ndarray]:
+        tok, ks, vs, done, key = carry
+        x = embed["table"][tok][:, None, :]           # [b, 1, dim]
+        bias = step_bias(i)
+        new_ks, new_vs = [], []
+        for li, p in enumerate(dec):
+            h = _rms(x, p["ln1"], cfg.norm_eps)
+            q = h @ p["attn"]["wq"]
+            k_new = h @ p["attn"]["wk"]
+            v_new = h @ p["attn"]["wv"]
+            k_cache = lax.dynamic_update_slice(
+                ks[li], k_new.astype(ks[li].dtype), (0, i, 0)
+            )
+            v_cache = lax.dynamic_update_slice(
+                vs[li], v_new.astype(vs[li].dtype), (0, i, 0)
+            )
+            new_ks.append(k_cache)
+            new_vs.append(v_cache)
+            a = _attend(q, k_cache, v_cache, bias, cfg)
+            x = x + a @ p["attn"]["wo"]
+            h = _rms(x, p["ln2"], cfg.norm_eps)
+            ck, cv = cross[li]
+            a = _attend(h @ p["xattn"]["wq"], ck, cv, None, cfg)
+            x = x + a @ p["xattn"]["wo"]
+            h = _rms(x, p["ln3"], cfg.norm_eps)
+            x = x + _ff(cfg, p["ff"], h)
+        h = _rms(x, final["ln"], cfg.norm_eps)
+        if cfg.logit_scale is not None:
+            h = h * cfg.logit_scale
+        logits = (h @ final["w"])[:, 0]               # [b, vocab]
+        if temperature > 0.0:
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits, sub, temperature, top_k, top_p)
+        else:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, jnp.stack(new_ks), jnp.stack(new_vs), done, key), nxt
+
+    key0 = rng if rng is not None else jax.random.PRNGKey(0)
+    (_, _, _, _, _), toks = lax.scan(
+        step, (start, k0, v0, done0, key0), jnp.arange(total)
+    )
+    return jnp.transpose(toks, (1, 0))                # [b, total]
+
+
+def t5_shift_right(cfg: T5Config, labels: jnp.ndarray) -> jnp.ndarray:
+    """Teacher-forcing helper: labels -> decoder input ids
+    (``decoder_start_id`` prepended, last label dropped — HF
+    ``T5ForConditionalGeneration._shift_right``)."""
+    b = labels.shape[0]
+    start = jnp.full((b, 1), cfg.decoder_start_id, labels.dtype)
+    return jnp.concatenate([start, labels[:, :-1]], axis=1)
