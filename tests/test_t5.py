@@ -204,7 +204,18 @@ def test_shift_right_matches_hf():
     np.testing.assert_array_equal(np.asarray(got), ref)
 
 
-def test_pipeline_matches_unpartitioned():
+@pytest.mark.parametrize(
+    "balance",
+    [
+        # Cuts after enc_block0 and after dec_block0: the 3-tuple
+        # carriers (with the bias element) cross stage boundaries.
+        [2, 3, 2],
+        # Cut exactly at the encoder/decoder boundary: the arity-changing
+        # 2-tuple carrier enc_final emits is what ships between stages.
+        [4, 3],
+    ],
+)
+def test_pipeline_matches_unpartitioned(balance):
     """GPipe over the flat T5 list (cuts inside the encoder, at the
     boundary, and inside the decoder) reproduces the un-pipelined loss and
     gradients — the transparency oracle over the tuple carrier."""
@@ -244,7 +255,7 @@ def test_pipeline_matches_unpartitioned():
 
     ref_loss, ref_grads = jax.value_and_grad(oracle)(flat)
 
-    model = GPipe(layers, balance=[2, 3, 2], chunks=2)
+    model = GPipe(layers, balance=balance, chunks=2)
     params, state = model.init(jax.random.PRNGKey(0), in_spec)
     it = iter(flat)
     params = tuple(tuple(next(it) for _ in stage) for stage in params)

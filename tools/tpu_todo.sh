@@ -126,12 +126,14 @@ run_step bench-160 5400 -o tools/bench_tpu_160.json \
 
 # (4) Llama-1B chunked-vocab-CE rescue: the previously-OOM big-vocab
 # config, expected to fit via ops/losses.py chunked CE (healthy TODO #2).
-# batch 8 -> 4 walk-down: co-tenant HBM pressure killed batch 8 twice on
-# 2026-08-01; a smaller point still proves the chunked-CE rescue.
-for l1b in 8 4; do
+# Batch walk-down 8 -> 4 -> 2 (all at the driver's default bf16 compute,
+# which every prior attempt already used): co-tenant HBM pressure killed
+# batch 8 twice on 2026-08-01 and 8/4 again on 2026-08-02; any captured
+# point proves the chunked-CE rescue.
+for l1b in 8 4 2; do
   run_step "llama-1b-fused-ce-b$l1b" 3600 -t tools/tpu_llama1b_fused_ce.txt \
     python -m benchmarks.llama_speed pipeline-1 --preset 1b --engine mpmd \
-      --fused-ce --checkpoint except_last --batch "$l1b" --steps 3 \
+      --fused-ce --checkpoint except_last --steps 3 --batch "$l1b" \
     && break
   bail_if_dead
 done
@@ -153,6 +155,24 @@ run_step attn-window-1024 2400 -t tools/tpu_attn_window_1024.txt \
     --fused-ce --checkpoint except_last --batch 2 --seq 4096 \
     --attn-window 1024 --steps 3 \
   || bail_if_dead
+# Fallback pair at the small preset (the 1b/4096 program 500'd the
+# remote compile helper on 2026-08-02): attention cost is seq-dominated,
+# so the window-vs-full comparison is still meaningful.  Gated on BOTH
+# 1b artifacts being absent — the pair must stay comparable (same
+# preset, same batch), so a partial 1b capture must not be completed
+# with a small-preset half.
+if [ ! -s tools/tpu_attn_window_full.txt ] \
+   && [ ! -s tools/tpu_attn_window_1024.txt ]; then
+  run_step attn-window-full-small 2400 -t tools/tpu_attn_window_full.txt \
+    python -m benchmarks.llama_speed pipeline-1 --preset small --engine mpmd \
+      --fused-ce --checkpoint except_last --batch 4 --seq 4096 --steps 3 \
+    || bail_if_dead
+  run_step attn-window-1024-small 2400 -t tools/tpu_attn_window_1024.txt \
+    python -m benchmarks.llama_speed pipeline-1 --preset small --engine mpmd \
+      --fused-ce --checkpoint except_last --batch 4 --seq 4096 \
+      --attn-window 1024 --steps 3 \
+    || bail_if_dead
+fi
 
 # (7) The per-cell dispatch-asynchrony invariant against the REAL TPU
 # backend (tests/test_overlap.py is platform-agnostic; CI runs it on the
