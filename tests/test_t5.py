@@ -195,6 +195,67 @@ def test_generate_bf16_params():
     assert toks.shape == (2, 3)
 
 
+@pytest.mark.parametrize("gated", [False, True])
+def test_export_round_trip(gated):
+    """import -> export -> load into a FRESH HF model reproduces the
+    original logits (both the tied v1.0 and untied v1.1 classes)."""
+    from torchgpipe_tpu.models.hf_interop import state_dict_to_hf_t5
+
+    m = _hf_t5(gated)
+    cfg, params = from_hf_t5(m)
+    sd = state_dict_to_hf_t5(params, cfg)
+
+    torch.manual_seed(123)  # different init than _hf_t5's seed 0
+    fresh = transformers.T5ForConditionalGeneration(m.config)
+    fresh.load_state_dict(sd)
+    fresh.eval()
+    enc = np.arange(2 * 6).reshape(2, 6) % cfg.vocab
+    dec = np.arange(2 * 4).reshape(2, 4) % cfg.vocab
+    with torch.no_grad():
+        ref = m(
+            input_ids=torch.tensor(enc), decoder_input_ids=torch.tensor(dec)
+        ).logits.numpy()
+        got = fresh(
+            input_ids=torch.tensor(enc), decoder_input_ids=torch.tensor(dec)
+        ).logits.numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_export_rejects_drifted_tie():
+    """Fine-tuning drifts the head copy away from the shared table: a
+    tied export would silently discard it and is rejected didactically;
+    untie=True exports an untied checkpoint whose LOGITS (not just
+    argmax — the tied-head d_model**-0.5 rescale is baked into the
+    emitted head) match the framework model."""
+    from torchgpipe_tpu.models.hf_interop import state_dict_to_hf_t5
+
+    m = _hf_t5()
+    cfg, params = from_hf_t5(m)
+    assert cfg.tie_word_embeddings
+    params[-1] = dict(params[-1], w=params[-1]["w"] + 0.5)
+    with pytest.raises(ValueError, match="drifted"):
+        state_dict_to_hf_t5(params, cfg)
+
+    sd = state_dict_to_hf_t5(params, cfg, untie=True)
+    hf_cfg = transformers.T5Config.from_dict(
+        dict(m.config.to_dict(), tie_word_embeddings=False)
+    )
+    torch.manual_seed(99)
+    fresh = transformers.T5ForConditionalGeneration(hf_cfg)
+    fresh.load_state_dict(sd)
+    fresh.eval()
+    enc = np.arange(2 * 6).reshape(2, 6) % cfg.vocab
+    dec = np.arange(2 * 4).reshape(2, 4) % cfg.vocab
+    with torch.no_grad():
+        hf_logits = fresh(
+            input_ids=torch.tensor(enc), decoder_input_ids=torch.tensor(dec)
+        ).logits.numpy()
+    ours = _apply(cfg, params, enc, dec)
+    np.testing.assert_allclose(
+        np.asarray(ours, np.float32), hf_logits, rtol=2e-4, atol=2e-4
+    )
+
+
 def test_shift_right_matches_hf():
     m = _hf_t5()
     cfg, _ = from_hf_t5(m)

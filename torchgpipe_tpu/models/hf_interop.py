@@ -1431,3 +1431,109 @@ def from_hf_t5(model: Any) -> tuple:
 
 
 __all__ += ["config_from_hf_t5", "params_from_hf_t5", "from_hf_t5"]
+
+
+def state_dict_to_hf_t5(
+    params: List[Pytree], cfg: Any, *, untie: bool = False
+) -> Dict[str, Any]:
+    """The inverse map: ``t5_layers(cfg)`` per-layer params -> an HF
+    ``T5ForConditionalGeneration`` state dict (torch tensors) — exact
+    inverse of :func:`params_from_hf_t5` (round-trip tested).
+
+    Tied configs (v1.0): the head was imported as a COPY of the shared
+    table; if pipeline fine-tuning has made the copies drift (their
+    gradients are not summed — see models/t5.py), a tied export would
+    silently discard the trained head, so drift is rejected.  Pass
+    ``untie=True`` to export the drifted pair as an UNTIED checkpoint
+    instead: the training-time tied-head ``d_model**-0.5`` logit rescale
+    is baked into the emitted ``lm_head.weight`` (an untied HF T5 applies
+    no rescale), so the exported model's logits — not just its argmax —
+    match the framework model; load it with an HF config whose
+    ``tie_word_embeddings=False``."""
+    import numpy as np
+
+    t, v = _torch_t, _torch_v
+    ne, nd = cfg.n_enc_layers, cfg.n_dec_layers
+    if len(params) != ne + nd + 3:
+        raise ValueError(
+            f"expected {ne + nd + 3} per-layer params "
+            f"(embed, {ne} enc blocks, enc final, {nd} dec blocks, "
+            f"final), got {len(params)}"
+        )
+    embed = params[0]
+    enc, enc_final = params[1:1 + ne], params[1 + ne]
+    dec, final = params[2 + ne:2 + ne + nd], params[2 + ne + nd]
+    table = embed["table"]
+    head_w = final["w"]
+    if cfg.tie_word_embeddings and untie and cfg.logit_scale is not None:
+        # The tied framework model scales hidden states by d_model**-0.5
+        # before the head; an untied HF T5 applies no such rescale, so
+        # bake it into the exported head weights (logits, not just
+        # argmax, must match).
+        head_w = head_w * cfg.logit_scale
+    if cfg.tie_word_embeddings and not untie:
+        if not np.array_equal(
+            np.asarray(table, np.float32),
+            np.asarray(final["w"].T, np.float32),
+        ):
+            raise ValueError(
+                "cfg.tie_word_embeddings=True but the head 'w' has "
+                "drifted from the shared table (pipeline fine-tuning "
+                "trains the two copies independently); a tied export "
+                "would discard the trained head — pass untie=True to "
+                "export an untied checkpoint (bakes the tied-head "
+                "logit rescale into lm_head.weight) or re-tie the "
+                "weights first"
+            )
+    sd: Dict[str, Any] = {
+        "shared.weight": v(table),
+        "encoder.embed_tokens.weight": v(table),
+        "decoder.embed_tokens.weight": v(table),
+        "encoder.final_layer_norm.weight": v(enc_final["ln"]),
+        "decoder.final_layer_norm.weight": v(final["ln"]),
+    }
+    # HF state dicts materialize the head tensor even when tied (it
+    # aliases shared.weight); for tied configs the no-drift check above
+    # guarantees final['w'] IS the shared table.
+    sd["lm_head.weight"] = t(head_w)
+
+    def put_attn(prefix: str, ap: Dict[str, Any]) -> None:
+        sd[prefix + "q.weight"] = t(ap["wq"])
+        sd[prefix + "k.weight"] = t(ap["wk"])
+        sd[prefix + "v.weight"] = t(ap["wv"])
+        sd[prefix + "o.weight"] = t(ap["wo"])
+
+    def put_ff(prefix: str, fp: Dict[str, Any]) -> None:
+        if cfg.gated_mlp:
+            sd[prefix + "DenseReluDense.wi_0.weight"] = t(fp["wi0"])
+            sd[prefix + "DenseReluDense.wi_1.weight"] = t(fp["wi1"])
+        else:
+            sd[prefix + "DenseReluDense.wi.weight"] = t(fp["wi"])
+        sd[prefix + "DenseReluDense.wo.weight"] = t(fp["wo"])
+
+    for i, bp in enumerate(enc):
+        p = f"encoder.block.{i}."
+        sd[p + "layer.0.layer_norm.weight"] = v(bp["ln1"])
+        put_attn(p + "layer.0.SelfAttention.", bp["attn"])
+        if i == 0:
+            sd[
+                p + "layer.0.SelfAttention.relative_attention_bias.weight"
+            ] = v(bp["rel"])
+        sd[p + "layer.1.layer_norm.weight"] = v(bp["ln2"])
+        put_ff(p + "layer.1.", bp["ff"])
+    for i, bp in enumerate(dec):
+        p = f"decoder.block.{i}."
+        sd[p + "layer.0.layer_norm.weight"] = v(bp["ln1"])
+        put_attn(p + "layer.0.SelfAttention.", bp["attn"])
+        if i == 0:
+            sd[
+                p + "layer.0.SelfAttention.relative_attention_bias.weight"
+            ] = v(bp["rel"])
+        sd[p + "layer.1.layer_norm.weight"] = v(bp["ln2"])
+        put_attn(p + "layer.1.EncDecAttention.", bp["xattn"])
+        sd[p + "layer.2.layer_norm.weight"] = v(bp["ln3"])
+        put_ff(p + "layer.2.", bp["ff"])
+    return sd
+
+
+__all__ += ["state_dict_to_hf_t5"]
