@@ -120,10 +120,59 @@ def run_case(seq, streaming, b=4, h=16, g=8, d=128, dtype=jnp.bfloat16,
     return out_err, grad_err, t_flash, t_dense
 
 
+def run_decode_case(S, pos0, window, b=8, h=16, g=8, d=128,
+                    dtype=jnp.bfloat16, iters=50, interpret=False):
+    """Decode-kernel row: numerics vs the dense cache read + per-step
+    latency at live length ``pos0`` (flash cost should FOLLOW pos0 —
+    its K-block loop is length-bounded — while dense streams all S rows
+    regardless).
+
+    Timing fetches the result to the HOST each iteration: on the
+    remote-tunnel backend ``block_until_ready`` alone has been observed
+    to return before execution (see benchmarks/llama_decode.py); a
+    device->host copy cannot complete early.  Inputs vary per iteration."""
+    import numpy as np
+
+    from torchgpipe_tpu.models.generation import _attend_chunk
+    from torchgpipe_tpu.ops.flash_attention import flash_decode_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(S + pos0), 3)
+    q = jax.random.normal(ks[0], (b, 1, h, d), dtype)
+    ck = jax.random.normal(ks[1], (b, S, g, d), dtype)
+    cv = jax.random.normal(ks[2], (b, S, g, d), dtype)
+
+    flash = jax.jit(lambda qq, p: flash_decode_attention(
+        qq, ck, cv, p, window=window, interpret=interpret))
+    dense = jax.jit(lambda qq, p: _attend_chunk(
+        qq, ck, cv, p, window, use_flash=False))
+
+    p0 = jnp.int32(pos0)
+    out_f = flash(q, p0)
+    out_d = dense(q, p0)
+    err = float(jnp.max(jnp.abs(out_f - out_d)))
+
+    def clock(fn):
+        best = float("inf")
+        for i in range(iters):
+            q_i = q * (1.0 + 1e-3 * i)
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(fn(q_i, p0)))
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+
+    np.asarray(jax.device_get(flash(q, p0)))  # compile
+    np.asarray(jax.device_get(dense(q, p0)))
+    return err, clock(flash), clock(dense)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", default="2048,4096")
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--decode", action="store_true",
+                    help="run the DECODE kernel rows instead (single-query "
+                         "cache attention: numerics + per-step latency at "
+                         "1/4, 1/2 and full live length)")
     ap.add_argument("--batch", type=int, default=4,
                     help="batch size (drop to 1 for long-seq cases so the "
                          "dense oracle's O(seq^2) scores have a chance)")
@@ -136,6 +185,28 @@ def main():
     dev = jax.devices()[0]
     print(f"backend: {dev.platform} ({getattr(dev, 'device_kind', '?')})")
     failed = False
+    if args.decode:
+        print(f"{'S':>6} {'pos0':>6} {'window':>7} {'out err':>9} "
+              f"{'flash ms':>9} {'dense ms':>9}")
+        for seq in [int(s) for s in args.seqs.split(",")]:
+            for pos0 in (seq // 4, seq // 2, seq - 1):
+                for window in (None, 1024):
+                    try:
+                        err, tf, td = run_decode_case(
+                            seq, pos0, window, b=args.batch,
+                            iters=args.iters,
+                            interpret=dev.platform != "tpu")
+                    except Exception as e:  # noqa: BLE001 — report, continue
+                        print(f"{seq:>6} {pos0:>6} {str(window):>7} "
+                              f"FAILED: {type(e).__name__}: {str(e)[:100]}")
+                        failed = True
+                        continue
+                    ok = err <= args.tol_out
+                    failed |= not ok
+                    print(f"{seq:>6} {pos0:>6} {str(window):>7} "
+                          f"{err:>9.4f} {tf:>9.3f} {td:>9.3f}  "
+                          f"{'ok' if ok else 'TOLERANCE-FAIL'}")
+        sys.exit(1 if failed else 0)
     print(f"{'seq':>6} {'variant':>9} {'out err':>9} {'grad err':>9} "
           f"{'flash ms':>9} {'dense ms':>9}")
     for seq in [int(s) for s in args.seqs.split(",")]:

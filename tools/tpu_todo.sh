@@ -195,6 +195,14 @@ run_step llama-decode-w8 2400 -t tools/tpu_llama_decode_w8.txt \
   python -m benchmarks.llama_decode --preset 1b --batch 8 --bf16 --w8 \
   || bail_if_dead
 
+# (8c) Flash DECODE kernel rows (single-query cache attention): per-step
+# latency at 1/4, 1/2 and full live length vs the dense cache read —
+# the length-bounded block loop should make flash cost FOLLOW the live
+# prefix while dense stays flat.  Host-fetch timed (lazy-backend-proof).
+run_step flash-decode 2400 -t tools/tpu_flash_decode.txt \
+  python -m benchmarks.flash_attention_hw --decode --seqs 4096 --iters 50 \
+  || bail_if_dead
+
 # (zb-vs-1f1b wall clock needs a multi-stage mesh — impossible on the
 # single tunneled chip; the CPU-mesh measured-vs-predicted table in
 # BENCH_NOTES covers it.)

@@ -319,11 +319,32 @@ def _attend_chunk(
     cv: jnp.ndarray,
     pos0: jnp.ndarray,       # [] int32 — first query's position
     window: Optional[int],
+    use_flash: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Causal attention of ``g`` consecutive queries against the cache —
     one MXU-friendly einsum instead of g masked cache reads.  Query i
     (position ``pos0+i``) sees cache rows ``<= pos0+i`` (optionally
-    banded); ``g=1`` is the plain single-token decode read."""
+    banded); ``g=1`` is the plain single-token decode read.
+
+    ``use_flash=None`` auto-dispatches the Pallas decode kernel on TPU
+    when the shapes are eligible (``ops.flash_attention.supports_decode``)
+    — its K-block loop is bounded by the RUNTIME length, so per-step cost
+    follows the generated prefix instead of streaming all ``max_len``
+    rows the way this dense einsum does; the dense path masks instead.
+    Pass True/False to force (True off-TPU runs interpret mode — tests)."""
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if use_flash is None:
+        from torchgpipe_tpu.ops.flash_attention import supports_decode
+
+        use_flash = on_tpu and supports_decode(q.shape, ck.shape, window)
+    if use_flash:
+        from torchgpipe_tpu.ops.flash_attention import (
+            flash_decode_attention,
+        )
+
+        return flash_decode_attention(
+            q, ck, cv, pos0, window=window, interpret=not on_tpu
+        )
     b, g, nh, hd = q.shape
     max_len = ck.shape[1]
     nkv = ck.shape[2]

@@ -962,3 +962,210 @@ def flash_attention(
         window,
     )
     return jnp.transpose(o.reshape(b, h, s, d), (0, 2, 1, 3))
+
+
+# --------------------------------------------------------------------- #
+# decode: few-query attention against a KV cache                        #
+# --------------------------------------------------------------------- #
+
+
+def _decode_block_k(s: int) -> Optional[int]:
+    """Largest standard block size dividing cache length ``s``."""
+    return next((c for c in (512, 256, 128) if s % c == 0), None)
+
+
+def _decode_kernel(
+    len_ref: Any,
+    q_ref: Any,
+    k_ref: Any,
+    v_ref: Any,
+    o_ref: Any,
+    m_sc: Any,
+    l_sc: Any,
+    acc_sc: Any,
+    *,
+    g: int,
+    r: int,
+    sm_scale: float,
+    block_k: int,
+    window: Optional[int],
+) -> None:
+    """One (batch, kv-head, K-block) grid cell: ``g*r`` query rows
+    against one streamed K/V block, online softmax carried in VMEM
+    scratch across the (sequential, innermost) block dimension.
+
+    The live region depends on the RUNTIME cache length (scalar-prefetch
+    ``len_ref``): blocks outside it are skipped — ``pl.when`` elides the
+    compute and the clamped index maps re-request the resident tile so
+    no HBM fetch is issued (the same machinery as the streaming causal
+    kernels).  Per-step cost — bandwidth AND compute — follows the
+    generated prefix, not the cache allocation.  Forward only (decode
+    has no backward)."""
+    jb = pl.program_id(2)
+    nkb = pl.num_programs(2)
+    length = len_ref[0]
+    pos0 = length - g
+    rows = g * r
+    hd = q_ref.shape[-1]
+    last = lax.div(length - 1, block_k)
+    if window is None:
+        first = jnp.int32(0)
+    else:
+        first = lax.div(
+            lax.max(pos0 - window + 1, jnp.int32(0)), block_k
+        )
+
+    @pl.when(jb == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    @pl.when((jb >= first) & (jb <= last))
+    def _body():
+        qb = (
+            q_ref[0, :, 0].reshape(rows, hd).astype(jnp.float32) * sm_scale
+        )
+        kb = k_ref[0, :, 0].astype(jnp.float32)   # [Bk, hd]
+        vb = v_ref[0, :, 0].astype(jnp.float32)
+        s = lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rows, Bk]
+        # Row i is query position pos0 + i // r (r grouped query heads
+        # per kv head, consecutive).
+        qpos = pos0 + lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // r
+        col = jb * block_k + lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), 1
+        )
+        valid = col <= qpos
+        if window is not None:
+            valid &= col > qpos - window
+        s = jnp.where(valid, s, _NEG)
+        m_prev = m_sc[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_sc[...] = l_sc[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_sc[...] = acc_sc[...] * corr + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[...] = m_new
+
+    @pl.when(jb == nkb - 1)
+    def _finish():
+        o_ref[0, :, 0] = (acc_sc[...] / l_sc[...]).reshape(g, r, hd)
+
+
+def supports_decode(
+    q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
+    window: Optional[int],
+) -> bool:
+    """Static eligibility for :func:`flash_decode_attention` (the
+    auto-dispatch gate in ``models.generation._attend_chunk``): the same
+    conditions the kernel entry validates, answered as a bool.  K/V
+    stream one block at a time, so there is NO cache-length VMEM cap —
+    only tiling/grouping constraints and a floor under which the dense
+    read is not worth a kernel dispatch."""
+    b, g, nh, hd = q_shape
+    s, nkv = k_shape[1], k_shape[2]
+    if hd % 128 != 0 or nkv == 0 or nh % nkv != 0:
+        return False
+    if s < 256 or _decode_block_k(s) is None:
+        return False
+    return window is None or window >= 1
+
+
+def flash_decode_attention(
+    q: jnp.ndarray,              # [b, g, nh, hd] — rope'd queries at
+                                 # consecutive positions pos0..pos0+g-1
+    ck: jnp.ndarray,             # [b, max_len, nkv, hd] KV cache
+    cv: jnp.ndarray,
+    pos0: jnp.ndarray,           # [] int32 — first query's position
+    *,
+    window: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Decode-side flash attention: ``g`` consecutive queries against the
+    LIVE PREFIX of a KV cache — the Pallas twin of the dense
+    ``models.generation._attend_chunk`` (g=1 is the plain per-token
+    decode read; g=γ+1 is speculative verification).
+
+    Unlike the prefill kernels (static causal geometry), the masked
+    region here depends on a RUNTIME scalar: the cache is ``max_len``
+    rows but only ``pos0+g`` are live.  The length rides in as a
+    scalar-prefetch operand, visible to BOTH the block index maps
+    (clamped — tiles outside the live/banded region re-request the
+    resident tile, so no HBM fetch is issued) and the kernel
+    (``pl.when`` skips their compute): per-step bandwidth and FLOPs
+    follow the generated length, not the cache allocation.  K/V stream
+    one ``[block_k, hd]`` tile at a time, so any ``max_len`` tiles the
+    grid can express is supported.  Output is f32 ``[b, g, nh*hd]``,
+    numerically the dense path\'s (same f32 accumulation; oracle-tested
+    in tests/test_flash_attention.py)."""
+    b, g, nh, hd = q.shape
+    s, nkv = ck.shape[1], ck.shape[2]
+    if nh % nkv != 0:
+        raise ValueError(f"nh={nh} not divisible by nkv={nkv}")
+    r = nh // nkv
+    if block_k is None:
+        block_k = _decode_block_k(s)
+        if block_k is None:
+            raise ValueError(
+                f"cache length {s} has no 128/256/512 block divisor; "
+                "pass block_k or use the dense path"
+            )
+    elif s % block_k != 0:
+        raise ValueError(f"cache length {s} not divisible by {block_k}")
+    if window is not None and window < 1:
+        raise ValueError("window must be >= 1")
+    qg = q.reshape(b, g, nkv, r, hd)
+    length = jnp.reshape(pos0 + g, (1,)).astype(jnp.int32)
+    nkb = s // block_k
+
+    def kv_im(i: Any, h: Any, jb: Any, len_ref: Any) -> Tuple:
+        # Clamp into the live (and, with a window, banded) block range:
+        # out-of-range grid steps re-request whatever tile the clamp
+        # lands on — already resident, so Pallas elides the fetch.
+        length = len_ref[0]
+        last = lax.div(length - 1, block_k)
+        if window is None:
+            first = jnp.int32(0)
+        else:
+            first = lax.div(
+                lax.max(length - g - window + 1, jnp.int32(0)), block_k
+            )
+        return (i, lax.clamp(first, jb, last), h, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, g=g, r=r, sm_scale=hd ** -0.5,
+            block_k=block_k, window=window,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, g, nkv, r, hd), jnp.float32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, nkv, nkb),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, g, 1, r, hd),
+                    lambda i, h, jb, len_ref: (i, 0, h, 0, 0),
+                ),
+                pl.BlockSpec((1, block_k, 1, hd), kv_im),
+                pl.BlockSpec((1, block_k, 1, hd), kv_im),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, g, 1, r, hd),
+                lambda i, h, jb, len_ref: (i, 0, h, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((g * r, 1), jnp.float32),
+                pltpu.VMEM((g * r, 1), jnp.float32),
+                pltpu.VMEM((g * r, hd), jnp.float32),
+            ],
+        ),
+        interpret=interpret,
+    )(length, qg, ck, cv)
+    return out.reshape(b, g, nh * hd)
