@@ -412,3 +412,71 @@ def test_supports_decode_gate():
     assert supports_decode(
         (2, 1, 4, 128), (2, 65536, 2, 128), None
     )  # K/V stream block-wise: no cache-length VMEM cap
+
+
+@pytest.mark.parametrize("g,pos0,window", [
+    (1, 100, None), (4, 200, 64), (1, 511, None),
+])
+def test_decode_kernel_quant_matches_dense_dequant(g, pos0, window):
+    """int8 cache + scales through the kernel (block-wise VMEM dequant)
+    == dequantize-then-dense — the QuantKVCache attend contract."""
+    from torchgpipe_tpu.models.generation import (
+        _attend_chunk, _quant_rows,
+    )
+    from torchgpipe_tpu.ops.flash_attention import flash_decode_attention
+
+    b, S, nkv, r, hd = 2, 512, 2, 2, 128
+    nh = nkv * r
+    ks = jax.random.split(jax.random.PRNGKey(pos0 + g), 3)
+    q = jax.random.normal(ks[0], (b, g, nh, hd), jnp.float32)
+    kf = jax.random.normal(ks[1], (b, S, nkv, hd), jnp.float32)
+    vf = jax.random.normal(ks[2], (b, S, nkv, hd), jnp.float32)
+    ck, cks = _quant_rows(kf)
+    cv, cvs = _quant_rows(vf)
+    # QuantKVCache stores scales positions-last ([b, nkv, L]).
+    cks = jnp.transpose(cks, (0, 2, 1))
+    cvs = jnp.transpose(cvs, (0, 2, 1))
+    ref = _attend_chunk(
+        q, ck, cv, jnp.int32(pos0), window,
+        use_flash=False, k_scale=cks, v_scale=cvs,
+    )
+    got = flash_decode_attention(
+        q, ck, cv, jnp.int32(pos0), window=window,
+        k_scale=cks, v_scale=cvs, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_decode_flash_quant_wiring_through_generate(monkeypatch):
+    """kv_quant decode through generate() with the kernel forced equals
+    the dense quant path token-for-token."""
+    import functools
+
+    from torchgpipe_tpu.layers import sequential_init
+    from torchgpipe_tpu.models import generation
+    from torchgpipe_tpu.models.generation import generate
+    from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+
+    cfg = TransformerConfig(
+        vocab=64, dim=256, n_layers=2, n_heads=2, n_kv_heads=1
+    )
+    layers = llama(cfg)
+    b, s = 2, 4
+    spec = jax.ShapeDtypeStruct((b, s), jnp.int32)
+    params, _, _ = sequential_init(layers, jax.random.PRNGKey(0), spec)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s), cfg.vocab)
+
+    dense = generate(
+        cfg, params, tokens, max_new_tokens=6, max_len=256, kv_quant=True
+    )
+    orig = generation._attend_chunk
+    monkeypatch.setattr(
+        generation, "_attend_chunk",
+        functools.partial(orig, use_flash=True),
+    )
+    flash = generate(
+        cfg, params, tokens, max_new_tokens=6, max_len=256, kv_quant=True
+    )
+    np.testing.assert_array_equal(np.asarray(flash), np.asarray(dense))

@@ -107,7 +107,9 @@ class QuantKVCache(NamedTuple):
 
     k: List[jnp.ndarray]        # int8 [b, L, n_kv, hd]
     v: List[jnp.ndarray]
-    k_scale: List[jnp.ndarray]  # f32 [b, L, n_kv]
+    k_scale: List[jnp.ndarray]  # f32 [b, n_kv, L] (kernel lane layout:
+    # the flash decode kernel tiles scales along L, so storing L last
+    # avoids a per-step transpose of the whole buffer)
     v_scale: List[jnp.ndarray]
     length: jnp.ndarray
 
@@ -123,7 +125,8 @@ def _quant_rows(rows: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def _dequant_rows(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
-    return q.astype(jnp.float32) * scale[..., None]
+    # scale is [b, n_kv, L] (see QuantKVCache); rows are [b, L, n_kv, hd].
+    return q.astype(jnp.float32) * jnp.transpose(scale, (0, 2, 1))[..., None]
 
 
 def init_quant_cache(
@@ -131,7 +134,7 @@ def init_quant_cache(
 ) -> QuantKVCache:
     """Zeroed int8 KV cache for ``cfg.n_layers`` blocks."""
     shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
-    sshape = (batch, max_len, cfg.kv_heads)
+    sshape = (batch, cfg.kv_heads, max_len)
     return QuantKVCache(
         k=[jnp.zeros(shape, jnp.int8) for _ in range(cfg.n_layers)],
         v=[jnp.zeros(shape, jnp.int8) for _ in range(cfg.n_layers)],
@@ -278,8 +281,12 @@ def _decode_step(
             vq, vs = _quant_rows(v)
             ck = lax.dynamic_update_slice_in_dim(ck, kq, slot, 1)
             cv = lax.dynamic_update_slice_in_dim(cv, vq, slot, 1)
-            cks = lax.dynamic_update_slice_in_dim(cks, ks, slot, 1)
-            cvs = lax.dynamic_update_slice_in_dim(cvs, vs, slot, 1)
+            cks = lax.dynamic_update_slice_in_dim(
+                cks, jnp.transpose(ks, (0, 2, 1)), slot, 2
+            )
+            cvs = lax.dynamic_update_slice_in_dim(
+                cvs, jnp.transpose(vs, (0, 2, 1)), slot, 2
+            )
             rk, rv = _dequant_rows(ck, cks), _dequant_rows(cv, cvs)
             new_ks.append(cks)
             new_vs.append(cvs)
@@ -320,6 +327,8 @@ def _attend_chunk(
     pos0: jnp.ndarray,       # [] int32 — first query's position
     window: Optional[int],
     use_flash: Optional[bool] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # int8 cache: f32 [b, nkv, L]
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Causal attention of ``g`` consecutive queries against the cache —
     one MXU-friendly einsum instead of g masked cache reads.  Query i
@@ -331,7 +340,12 @@ def _attend_chunk(
     — its K-block loop is bounded by the RUNTIME length, so per-step cost
     follows the generated prefix instead of streaming all ``max_len``
     rows the way this dense einsum does; the dense path masks instead.
-    Pass True/False to force (True off-TPU runs interpret mode — tests)."""
+    Pass True/False to force (True off-TPU runs interpret mode — tests).
+
+    ``k_scale``/``v_scale``: ``ck``/``cv`` are int8 QuantKVCache buffers
+    with per-(position, head) scales.  The kernel path dequantizes
+    block-wise in VMEM — HBM moves int8 bytes, the actual int8-KV
+    bandwidth win; the dense path dequantizes up front."""
     on_tpu = jax.devices()[0].platform == "tpu"
     if use_flash is None:
         from torchgpipe_tpu.ops.flash_attention import supports_decode
@@ -343,8 +357,11 @@ def _attend_chunk(
         )
 
         return flash_decode_attention(
-            q, ck, cv, pos0, window=window, interpret=not on_tpu
+            q, ck, cv, pos0, window=window, k_scale=k_scale,
+            v_scale=v_scale, interpret=not on_tpu,
         )
+    if k_scale is not None:
+        ck, cv = _dequant_rows(ck, k_scale), _dequant_rows(cv, v_scale)
     b, g, nh, hd = q.shape
     max_len = ck.shape[1]
     nkv = ck.shape[2]
@@ -418,9 +435,12 @@ def _decode_chunk(
             vq, vs = _quant_rows(v)
             ck = lax.dynamic_update_slice_in_dim(ck, kq, pos0, 1)
             cv = lax.dynamic_update_slice_in_dim(cv, vq, pos0, 1)
-            cks = lax.dynamic_update_slice_in_dim(cks, ks, pos0, 1)
-            cvs = lax.dynamic_update_slice_in_dim(cvs, vs, pos0, 1)
-            rk, rv = _dequant_rows(ck, cks), _dequant_rows(cv, cvs)
+            cks = lax.dynamic_update_slice_in_dim(
+                cks, jnp.transpose(ks, (0, 2, 1)), pos0, 2
+            )
+            cvs = lax.dynamic_update_slice_in_dim(
+                cvs, jnp.transpose(vs, (0, 2, 1)), pos0, 2
+            )
             new_ks.append(cks)
             new_vs.append(cvs)
         else:
@@ -430,8 +450,12 @@ def _decode_chunk(
             cv = lax.dynamic_update_slice_in_dim(
                 cv, v.astype(cv.dtype), pos0, 1
             )
-            rk, rv = ck, cv
-        attn = _attend_chunk(q, rk, rv, pos0, cfg.attn_window)
+        # Quant caches (cks/cvs non-None) go to the attend AS-IS: the
+        # flash decode kernel dequantizes block-wise in VMEM (int8 HBM
+        # traffic); the dense path dequantizes at the attend instead.
+        attn = _attend_chunk(
+            q, ck, cv, pos0, cfg.attn_window, k_scale=cks, v_scale=cvs
+        )
         attn = attn.astype(x.dtype)
         o = attn @ _w(cfg, p, "wo")
         if "lora" in p:
@@ -687,7 +711,9 @@ def prefill(
             q, sc = _quant_rows(rows)
             return (
                 lax.dynamic_update_slice_in_dim(buf, q, 0, 1),
-                lax.dynamic_update_slice_in_dim(sbuf, sc, 0, 1),
+                lax.dynamic_update_slice_in_dim(
+                    sbuf, jnp.transpose(sc, (0, 2, 1)), 0, 2
+                ),
             )
         return (
             lax.dynamic_update_slice_in_dim(

@@ -979,16 +979,13 @@ def _decode_kernel(
     q_ref: Any,
     k_ref: Any,
     v_ref: Any,
-    o_ref: Any,
-    m_sc: Any,
-    l_sc: Any,
-    acc_sc: Any,
-    *,
+    *rest: Any,
     g: int,
     r: int,
     sm_scale: float,
     block_k: int,
     window: Optional[int],
+    quant: bool,
 ) -> None:
     """One (batch, kv-head, K-block) grid cell: ``g*r`` query rows
     against one streamed K/V block, online softmax carried in VMEM
@@ -1000,7 +997,17 @@ def _decode_kernel(
     no HBM fetch is issued (the same machinery as the streaming causal
     kernels).  Per-step cost — bandwidth AND compute — follows the
     generated prefix, not the cache allocation.  Forward only (decode
-    has no backward)."""
+    has no backward).
+
+    ``quant=True``: K/V refs are int8 with f32 per-(position, head)
+    scale refs (``ks_ref``/``vs_ref``) — dequantized ONE BLOCK AT A
+    TIME in VMEM, so HBM moves half the bytes of a bf16 cache (the
+    actual int8-KV bandwidth win; the dense path dequantizes the whole
+    cache in HBM first and forfeits it)."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc = rest
+    else:
+        o_ref, m_sc, l_sc, acc_sc = rest
     jb = pl.program_id(2)
     nkb = pl.num_programs(2)
     length = len_ref[0]
@@ -1028,6 +1035,12 @@ def _decode_kernel(
         )
         kb = k_ref[0, :, 0].astype(jnp.float32)   # [Bk, hd]
         vb = v_ref[0, :, 0].astype(jnp.float32)
+        if quant:
+            # Scale blocks are [1, 1, Bk]: positions-last storage keeps
+            # the lane dim a full block (not a width-1 axis Mosaic
+            # cannot tile) with no transpose anywhere.
+            kb = kb * ks_ref[0, 0, :].reshape(block_k, 1)
+            vb = vb * vs_ref[0, 0, :].reshape(block_k, 1)
         s = lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -1086,6 +1099,8 @@ def flash_decode_attention(
     *,
     window: Optional[int] = None,
     block_k: Optional[int] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # f32 [b, nkv, max_len]
+    v_scale: Optional[jnp.ndarray] = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Decode-side flash attention: ``g`` consecutive queries against the
@@ -1104,7 +1119,13 @@ def flash_decode_attention(
     one ``[block_k, hd]`` tile at a time, so any ``max_len`` tiles the
     grid can express is supported.  Output is f32 ``[b, g, nh*hd]``,
     numerically the dense path\'s (same f32 accumulation; oracle-tested
-    in tests/test_flash_attention.py)."""
+    in tests/test_flash_attention.py).
+
+    ``k_scale``/``v_scale`` (both or neither): the cache is int8 with
+    per-(position, head) symmetric scales in the QuantKVCache
+    ``[b, nkv, max_len]`` layout (positions last = the kernel's lane
+    dim, no transpose needed) — dequantized block-wise in VMEM, so the
+    HBM side moves int8 bytes."""
     b, g, nh, hd = q.shape
     s, nkv = ck.shape[1], ck.shape[2]
     if nh % nkv != 0:
@@ -1121,6 +1142,9 @@ def flash_decode_attention(
         raise ValueError(f"cache length {s} not divisible by {block_k}")
     if window is not None and window < 1:
         raise ValueError("window must be >= 1")
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
     qg = q.reshape(b, g, nkv, r, hd)
     length = jnp.reshape(pos0 + g, (1,)).astype(jnp.int32)
     nkb = s // block_k
@@ -1139,23 +1163,35 @@ def flash_decode_attention(
             )
         return (i, lax.clamp(first, jb, last), h, 0)
 
+    in_specs = [
+        pl.BlockSpec(
+            (1, g, 1, r, hd),
+            lambda i, h, jb, len_ref: (i, 0, h, 0, 0),
+        ),
+        pl.BlockSpec((1, block_k, 1, hd), kv_im),
+        pl.BlockSpec((1, block_k, 1, hd), kv_im),
+    ]
+    operands = [length, qg, ck, cv]
+    if quant:
+        def scale_im(i: Any, h: Any, jb: Any, len_ref: Any) -> Tuple:
+            bi, jbe, hi, _ = kv_im(i, h, jb, len_ref)
+            return (bi, hi, jbe)
+
+        in_specs += [
+            pl.BlockSpec((1, 1, block_k), scale_im),
+            pl.BlockSpec((1, 1, block_k), scale_im),
+        ]
+        operands += [k_scale, v_scale]
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel, g=g, r=r, sm_scale=hd ** -0.5,
-            block_k=block_k, window=window,
+            block_k=block_k, window=window, quant=quant,
         ),
         out_shape=jax.ShapeDtypeStruct((b, g, nkv, r, hd), jnp.float32),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, nkv, nkb),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, g, 1, r, hd),
-                    lambda i, h, jb, len_ref: (i, 0, h, 0, 0),
-                ),
-                pl.BlockSpec((1, block_k, 1, hd), kv_im),
-                pl.BlockSpec((1, block_k, 1, hd), kv_im),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, g, 1, r, hd),
                 lambda i, h, jb, len_ref: (i, 0, h, 0, 0),
@@ -1167,5 +1203,5 @@ def flash_decode_attention(
             ],
         ),
         interpret=interpret,
-    )(length, qg, ck, cv)
+    )(*operands)
     return out.reshape(b, g, nh * hd)
