@@ -50,50 +50,17 @@ def _analytic_step_flops(model, params, state, x, y, loss_fn, rng):
 
     MFU convention: the numerator is the model's analytic work, so activation
     recomputation inside the pipeline counts against utilization rather than
-    inflating it.  ``lower()`` only traces — no compile."""
-    from torchgpipe_tpu.layers import sequential_apply
+    inflating it.  ``lower()`` only traces — no compile.
 
-    flat_p = [p for stage in params for p in stage]
-    flat_s = [s for stage in state for s in stage]
-
-    def step(fp, x, y):
-        def loss_of(fp):
-            out, _ = sequential_apply(
-                model.layers, fp, flat_s, x, rng=rng, train=True
-            )
-            return loss_fn(out, y)
-
-        return jax.value_and_grad(loss_of)(fp)
-
-    def flops_of(lowered) -> float | None:
-        cost = lowered.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else None
-        if cost is None:  # the axon TPU-tunnel client returns None
-            return None
-        flops = float(cost.get("flops", 0.0))
-        return flops if flops > 0 else None
-
-    # Lower from abstract avals: committed device arrays would pin the
-    # lowering to their own client no matter the default_device below.
-    specs = jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), (flat_p, x, y)
-    )
+    Shared implementation: ``benchmarks.common.sequential_step_flops``
+    (the same reporter every speed driver's ``MFU |`` line uses), kept
+    behind a guard so a broken benchmarks package can only cost this
+    driver its ``mfu`` field, never the throughput number."""
     try:
-        got = flops_of(jax.jit(step).lower(*specs))
-        if got is not None:
-            return got
-    except Exception:
-        pass
-    # Analytic model FLOPs are platform-independent: when the accelerator
-    # client doesn't implement cost_analysis (observed: the axon tunnel
-    # returns None), lower the same step for the host CPU client instead.
-    try:
-        cpu = jax.local_devices(backend="cpu")[0]
-        with jax.default_device(cpu):
-            return flops_of(jax.jit(step).lower(*specs))
+        from benchmarks.common import sequential_step_flops
     except Exception:
         return None
+    return sequential_step_flops(model, params, state, x, y, loss_fn, rng)
 
 
 def _even_balance(n_layers: int, n_stages: int):

@@ -111,6 +111,130 @@ def run_epoch_loop(
     return sum(throughputs) / max(1, len(throughputs))
 
 
+def analytic_flops(step: Callable, *args) -> Optional[float]:
+    """Model FLOPs of one ``step(*args)`` call from XLA's HLO cost
+    analysis.  ``args`` may be arrays or ``ShapeDtypeStruct``s — lowering
+    happens from abstract avals (``lower()`` only traces, no compile, and
+    nothing executes).  Falls back to lowering for the host CPU client
+    when the accelerator client doesn't implement ``cost_analysis`` (the
+    axon TPU tunnel returns ``None``; analytic model FLOPs are
+    platform-independent).  Returns ``None`` when neither client can
+    cost the program."""
+    specs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args
+    )
+
+    def flops_of(lowered) -> Optional[float]:
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if cost is None:
+            return None
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+
+    try:
+        got = flops_of(jax.jit(step).lower(*specs))
+        if got is not None:
+            return got
+    except Exception:
+        pass
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            return flops_of(jax.jit(step).lower(*specs))
+    except Exception:
+        return None
+
+
+def print_mfu(
+    step_flops, tput: float, batch: int, label: str, n_chips: int = 1,
+    device=None,
+) -> None:
+    """One ``label | mfu …`` line when the default device has a published
+    bf16 peak (``torchgpipe_tpu.utils.hw``); silent on host-CPU runs.
+
+    ``step_flops`` is the per-step model FLOPs, or a zero-arg callable
+    producing them — the callable is only invoked on a known chip, so
+    host-CPU runs never pay the lowering.  ``tput`` is AGGREGATE
+    samples/sec; ``n_chips`` divides the peak so a pipeline spanning n
+    chips is graded against n chips' worth of FLOP/s (matching
+    ``bench.py``'s ``n_chips * peak`` denominator).
+
+    MFU convention matches ``bench.py``: the numerator is the
+    UN-pipelined model's analytic work (fwd + loss + bwd, no recompute),
+    so activation rematerialization counts *against* utilization rather
+    than inflating it.  An MFU above 1.0 is physically impossible —
+    the backend cannot have executed every dispatched program before
+    ``block_until_ready`` returned (observed once on the axon tunnel's
+    warm executable cache) — so it is flagged as invalid rather than
+    printed as a result, mirroring ``bench.py``'s refusal to publish
+    impossible numbers.
+
+    ``device`` is the device the timed programs actually ran on (a model
+    placed on explicit devices — e.g. a host-CPU debug run on a
+    TPU-attached machine — must not be graded against the default
+    device's peak); defaults to ``jax.devices()[0]``."""
+    from torchgpipe_tpu.utils.hw import chip_peak_bf16_flops
+
+    peak = chip_peak_bf16_flops(
+        jax.devices()[0] if device is None else device
+    )
+    if peak is None or tput <= 0:
+        return
+    if callable(step_flops):
+        step_flops = step_flops()
+    if step_flops is None:
+        return
+    mfu = step_flops * tput / batch / (max(1, n_chips) * peak)
+    if mfu > 1.0:
+        print(
+            f"MFU   | {label}: INVALID ({100 * mfu:.1f}% > 100% is "
+            "physically impossible — the timed loop's programs cannot "
+            "all have executed; do not publish this run)",
+            flush=True,
+        )
+        return
+    print(
+        f"MFU   | {label}: {100 * mfu:.2f}% "
+        f"(analytic model FLOPs {step_flops:.3e}/step over "
+        f"{max(1, n_chips)}x {peak:.3g} peak bf16 FLOP/s)",
+        flush=True,
+    )
+
+
+def distinct_chips(model: GPipe) -> int:
+    """Number of distinct devices the model's stages are placed on."""
+    return len({(d.platform, d.id) for d in model.devices})
+
+
+def sequential_step_flops(model: GPipe, params, state, x, y,
+                          loss_fn: Callable, rng) -> Optional[float]:
+    """Analytic FLOPs of the equivalent un-pipelined training step of a
+    :class:`GPipe` model (the MFU numerator — see :func:`print_mfu`).
+    Losses returning ``(loss, aux)`` are reduced to the scalar.  Returns
+    ``None`` (never raises) when the step cannot be costed."""
+    from torchgpipe_tpu.layers import sequential_apply
+
+    flat_p = [p for stage in params for p in stage]
+    flat_s = [s for stage in state for s in stage]
+
+    def step(fp, xx, yy):
+        def loss_of(fp):
+            out, _ = sequential_apply(
+                model.layers, fp, flat_s, xx, rng=rng, train=True
+            )
+            loss = loss_fn(out, yy)
+            return loss[0] if isinstance(loss, tuple) else loss
+
+        return jax.value_and_grad(loss_of)(fp)
+
+    try:
+        return analytic_flops(step, flat_p, x, y)
+    except Exception:
+        return None
+
+
 def run_speed(
     model: GPipe,
     x,
@@ -126,7 +250,9 @@ def run_speed(
     """Timed SGD epochs through the GPipe engine; steady-state samples/sec.
 
     ``after(params, state)`` (optional) runs on the trained values once the
-    loop finishes — e.g. the MoE driver prints router balance stats.
+    loop finishes — e.g. the MoE driver prints router balance stats.  On a
+    chip with a known bf16 peak an ``MFU`` line follows the epoch lines
+    (:func:`print_mfu`).
     """
     in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
     params, state = model.init(jax.random.PRNGKey(0), in_spec)
@@ -148,6 +274,13 @@ def run_speed(
     tput = run_epoch_loop(
         step_fn, x.shape[0], epochs=epochs, steps_per_epoch=steps_per_epoch,
         skip_epochs=skip_epochs, label=label,
+    )
+    print_mfu(
+        lambda: sequential_step_flops(
+            model, params, state, x, y, loss_fn, rng
+        ),
+        tput, x.shape[0], label, n_chips=distinct_chips(model),
+        device=model.devices[0],
     )
     if after is not None:
         after(carry["params"], carry["state"])

@@ -221,6 +221,33 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
             step_fn, x.shape[0], epochs=epochs, steps_per_epoch=steps,
             label=experiment,
         )
+
+        from benchmarks.common import (
+            analytic_flops, distinct_chips, print_mfu,
+        )
+        from torchgpipe_tpu.layers import sequential_apply
+
+        flat_p = [p for stage in params for p in stage]
+        flat_s = [s for stage in state for s in stage]
+
+        def _plain_step(fp, lp, xx, yy):
+            def loss_of(ps):
+                fp2, lp2 = ps
+                out, _ = sequential_apply(
+                    layers, fp2, flat_s, xx, rng=rng, train=True
+                )
+                l, _ = loss_layer.apply(lp2, (), (out, yy), rng=None,
+                                        train=True)
+                return l
+
+            return jax.value_and_grad(loss_of)((fp, lp))
+
+        print_mfu(
+            lambda: analytic_flops(_plain_step, flat_p, loss_params,
+                                   inputs, targets),
+            tput, x.shape[0], experiment, n_chips=distinct_chips(model),
+            device=model.devices[0],
+        )
     else:
         if moe is not None:
             from torchgpipe_tpu.models.moe import llama_moe
