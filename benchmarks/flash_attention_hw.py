@@ -121,16 +121,23 @@ def run_case(seq, streaming, b=4, h=16, g=8, d=128, dtype=jnp.bfloat16,
 
 
 def run_decode_case(S, pos0, window, b=8, h=16, g=8, d=128,
-                    dtype=jnp.bfloat16, iters=50, interpret=False):
+                    dtype=jnp.bfloat16, iters=50, chain=256,
+                    interpret=False):
     """Decode-kernel row: numerics vs the dense cache read + per-step
     latency at live length ``pos0`` (flash cost should FOLLOW pos0 —
     its K-block loop is length-bounded — while dense streams all S rows
     regardless).
 
-    Timing fetches the result to the HOST each iteration: on the
+    Timing fetches the result to the HOST each measurement: on the
     remote-tunnel backend ``block_until_ready`` alone has been observed
     to return before execution (see benchmarks/llama_decode.py); a
-    device->host copy cannot complete early.  Inputs vary per iteration."""
+    device->host copy cannot complete early.  A single decode step is
+    far cheaper than one tunnel round trip (~tens of ms), so each
+    measured program CHAINS ``chain`` data-dependent steps in one
+    ``lax.scan`` — per-step cost is the host-fetched total over
+    ``chain``, amortizing the RTT floor to total/chain instead of
+    swamping the kernel entirely (observed: un-chained rows read ~68 ms
+    for BOTH variants at every length — pure RTT)."""
     import numpy as np
 
     from torchgpipe_tpu.models.generation import _attend_chunk
@@ -151,18 +158,38 @@ def run_decode_case(S, pos0, window, b=8, h=16, g=8, d=128,
     out_d = dense(q, p0)
     err = float(jnp.max(jnp.abs(out_f - out_d)))
 
+    def chained(attend):
+        # The next step's queries depend on this step's output, so no
+        # backend can overlap or elide steps; same shapes throughout.
+        def body(c, _):
+            o = attend(c, p0)
+            c2 = (c + 1e-6 * o.reshape(c.shape)).astype(c.dtype)
+            return c2, ()
+
+        def many(qq):
+            c, _ = jax.lax.scan(body, qq, None, length=chain)
+            return c
+
+        return jax.jit(many)
+
     def clock(fn):
         best = float("inf")
         for i in range(iters):
             q_i = q * (1.0 + 1e-3 * i)
             t0 = time.perf_counter()
-            np.asarray(jax.device_get(fn(q_i, p0)))
+            np.asarray(jax.device_get(fn(q_i)))
             best = min(best, time.perf_counter() - t0)
-        return best * 1e3
+        return best * 1e3 / chain
 
-    np.asarray(jax.device_get(flash(q, p0)))  # compile
-    np.asarray(jax.device_get(dense(q, p0)))
-    return err, clock(flash), clock(dense)
+    flash_n, dense_n = chained(
+        lambda qq, p: flash_decode_attention(
+            qq, ck, cv, p, window=window, interpret=interpret)
+    ), chained(
+        lambda qq, p: _attend_chunk(qq, ck, cv, p, window, use_flash=False)
+    )
+    np.asarray(jax.device_get(flash_n(q)))  # compile
+    np.asarray(jax.device_get(dense_n(q)))
+    return err, clock(flash_n), clock(dense_n)
 
 
 def main():
@@ -173,6 +200,13 @@ def main():
                     help="run the DECODE kernel rows instead (single-query "
                          "cache attention: numerics + per-step latency at "
                          "1/4, 1/2 and full live length)")
+    ap.add_argument("--chain", type=int, default=256,
+                    help="decode steps chained per timed program: the "
+                         "remote tunnel's ~70 ms host-fetch RTT adds "
+                         "RTT/chain to every per-step number, so the chain "
+                         "must be deep enough that the kernel's own "
+                         "sub-ms cost shows through (256 -> ~0.27 ms of "
+                         "RTT per step)")
     ap.add_argument("--batch", type=int, default=4,
                     help="batch size (drop to 1 for long-seq cases so the "
                          "dense oracle's O(seq^2) scores have a chance)")
@@ -194,7 +228,7 @@ def main():
                     try:
                         err, tf, td = run_decode_case(
                             seq, pos0, window, b=args.batch,
-                            iters=args.iters,
+                            iters=args.iters, chain=args.chain,
                             interpret=dev.platform != "tpu")
                     except Exception as e:  # noqa: BLE001 — report, continue
                         print(f"{seq:>6} {pos0:>6} {str(window):>7} "

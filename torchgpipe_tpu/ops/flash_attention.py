@@ -982,6 +982,7 @@ def _decode_kernel(
     *rest: Any,
     g: int,
     r: int,
+    hd: int,
     sm_scale: float,
     block_k: int,
     window: Optional[int],
@@ -999,11 +1000,22 @@ def _decode_kernel(
     generated prefix, not the cache allocation.  Forward only (decode
     has no backward).
 
+    Operand layouts are HEAD-FOLDED: Mosaic requires a block's last two
+    dims to be (8k, 128k)-tileable or full axes, so a width-1 block over
+    a ``nkv`` axis cannot lower (caught on real TPU; interpret mode
+    does not enforce tiling).  K/V arrive as ``[1, Bk, hd]`` tiles of a
+    ``[b, s, nkv*hd]`` view — the kv head is picked by the index map as
+    a lane-axis block offset, so the fetch stays one head's tile.
+
     ``quant=True``: K/V refs are int8 with f32 per-(position, head)
-    scale refs (``ks_ref``/``vs_ref``) — dequantized ONE BLOCK AT A
-    TIME in VMEM, so HBM moves half the bytes of a bf16 cache (the
-    actual int8-KV bandwidth win; the dense path dequantizes the whole
-    cache in HBM first and forfeits it)."""
+    scales — dequantized ONE BLOCK AT A TIME in VMEM, so HBM moves half
+    the bytes of a bf16 cache (the actual int8-KV bandwidth win; the
+    dense path dequantizes the whole cache in HBM first and forfeits
+    it).  ``ks_ref``/``vs_ref`` are the head's whole scale row viewed
+    ``[1, 1, nkb, Bk]`` (s floats — fetched once per (batch, head), ~s·4
+    bytes, negligible next to the K tiles); the current block's row is
+    selected with an iota/where reduction because the row index ``jb``
+    is a runtime value and Mosaic has no dynamic sublane indexing."""
     if quant:
         ks_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc = rest
     else:
@@ -1013,7 +1025,6 @@ def _decode_kernel(
     length = len_ref[0]
     pos0 = length - g
     rows = g * r
-    hd = q_ref.shape[-1]
     last = lax.div(length - 1, block_k)
     if window is None:
         first = jnp.int32(0)
@@ -1031,16 +1042,24 @@ def _decode_kernel(
     @pl.when((jb >= first) & (jb <= last))
     def _body():
         qb = (
-            q_ref[0, :, 0].reshape(rows, hd).astype(jnp.float32) * sm_scale
+            q_ref[0].reshape(rows, hd).astype(jnp.float32) * sm_scale
         )
-        kb = k_ref[0, :, 0].astype(jnp.float32)   # [Bk, hd]
-        vb = v_ref[0, :, 0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)   # [Bk, hd]
+        vb = v_ref[0].astype(jnp.float32)
         if quant:
-            # Scale blocks are [1, 1, Bk]: positions-last storage keeps
-            # the lane dim a full block (not a width-1 axis Mosaic
-            # cannot tile) with no transpose anywhere.
-            kb = kb * ks_ref[0, 0, :].reshape(block_k, 1)
-            vb = vb * vs_ref[0, 0, :].reshape(block_k, 1)
+            def row_of(sref):
+                # [nkb, Bk] → row jb (the fetched K tile's block).
+                all_rows = sref[0, 0]
+                sel = (
+                    lax.broadcasted_iota(jnp.int32, all_rows.shape, 0)
+                    == jb
+                )
+                return jnp.sum(
+                    jnp.where(sel, all_rows, 0.0), axis=0
+                )
+
+            kb = kb * row_of(ks_ref).reshape(block_k, 1)
+            vb = vb * row_of(vs_ref).reshape(block_k, 1)
         s = lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -1068,7 +1087,7 @@ def _decode_kernel(
 
     @pl.when(jb == nkb - 1)
     def _finish():
-        o_ref[0, :, 0] = (acc_sc[...] / l_sc[...]).reshape(g, r, hd)
+        o_ref[0] = (acc_sc[...] / l_sc[...]).reshape(g, r * hd)
 
 
 def supports_decode(
@@ -1145,7 +1164,13 @@ def flash_decode_attention(
     quant = k_scale is not None
     if quant != (v_scale is not None):
         raise ValueError("pass both k_scale and v_scale, or neither")
-    qg = q.reshape(b, g, nkv, r, hd)
+    # Head-folded views (pure reshapes — the head axis is contiguous with
+    # hd, so no copy): Mosaic requires a block's last two dims to be
+    # (8k, 128k)-tileable or full axes, which a width-1 nkv-axis block is
+    # not.  The kv head becomes a lane-axis block offset instead.
+    qf = q.reshape(b, g, nh * hd)
+    ckf = ck.reshape(b, s, nkv * hd)
+    cvf = cv.reshape(b, s, nkv * hd)
     length = jnp.reshape(pos0 + g, (1,)).astype(jnp.int32)
     nkb = s // block_k
 
@@ -1161,41 +1186,41 @@ def flash_decode_attention(
             first = lax.div(
                 lax.max(length - g - window + 1, jnp.int32(0)), block_k
             )
-        return (i, lax.clamp(first, jb, last), h, 0)
+        return (i, lax.clamp(first, jb, last), h)
 
+    q_im = lambda i, h, jb, len_ref: (i, 0, h)  # noqa: E731
     in_specs = [
-        pl.BlockSpec(
-            (1, g, 1, r, hd),
-            lambda i, h, jb, len_ref: (i, 0, h, 0, 0),
-        ),
-        pl.BlockSpec((1, block_k, 1, hd), kv_im),
-        pl.BlockSpec((1, block_k, 1, hd), kv_im),
+        pl.BlockSpec((1, g, r * hd), q_im),
+        pl.BlockSpec((1, block_k, hd), kv_im),
+        pl.BlockSpec((1, block_k, hd), kv_im),
     ]
-    operands = [length, qg, ck, cv]
+    operands = [length, qf, ckf, cvf]
     if quant:
-        def scale_im(i: Any, h: Any, jb: Any, len_ref: Any) -> Tuple:
-            bi, jbe, hi, _ = kv_im(i, h, jb, len_ref)
-            return (bi, hi, jbe)
-
+        # One head's whole scale row [nkb, Bk] per (batch, head) cell —
+        # s floats, fetched once per (i, h) (the index map is constant
+        # over jb, so Pallas elides per-block refetches); full-axis
+        # last-two dims keep it tileable for any nkb.
         in_specs += [
-            pl.BlockSpec((1, 1, block_k), scale_im),
-            pl.BlockSpec((1, 1, block_k), scale_im),
+            pl.BlockSpec(
+                (1, 1, nkb, block_k),
+                lambda i, h, jb, len_ref: (i, h, 0, 0),
+            ),
+        ] * 2
+        operands += [
+            k_scale.reshape(b, nkv, nkb, block_k),
+            v_scale.reshape(b, nkv, nkb, block_k),
         ]
-        operands += [k_scale, v_scale]
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, g=g, r=r, sm_scale=hd ** -0.5,
+            _decode_kernel, g=g, r=r, hd=hd, sm_scale=hd ** -0.5,
             block_k=block_k, window=window, quant=quant,
         ),
-        out_shape=jax.ShapeDtypeStruct((b, g, nkv, r, hd), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, g, nh * hd), jnp.float32),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, nkv, nkb),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec(
-                (1, g, 1, r, hd),
-                lambda i, h, jb, len_ref: (i, 0, h, 0, 0),
-            ),
+            out_specs=pl.BlockSpec((1, g, r * hd), q_im),
             scratch_shapes=[
                 pltpu.VMEM((g * r, 1), jnp.float32),
                 pltpu.VMEM((g * r, 1), jnp.float32),
@@ -1204,4 +1229,4 @@ def flash_decode_attention(
         ),
         interpret=interpret,
     )(*operands)
-    return out.reshape(b, g, nh * hd)
+    return out
