@@ -183,7 +183,12 @@ def print_mfu(
     if peak is None or tput <= 0:
         return
     if callable(step_flops):
-        step_flops = step_flops()
+        # A broken FLOPs-costing path may only cost the mfu line, never
+        # the already-printed throughput result.
+        try:
+            step_flops = step_flops()
+        except Exception:
+            return
     if step_flops is None:
         return
     mfu = step_flops * tput / batch / (max(1, n_chips) * peak)

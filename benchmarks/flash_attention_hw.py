@@ -200,13 +200,15 @@ def main():
                     help="run the DECODE kernel rows instead (single-query "
                          "cache attention: numerics + per-step latency at "
                          "1/4, 1/2 and full live length)")
-    ap.add_argument("--chain", type=int, default=256,
+    ap.add_argument("--chain", type=int, default=None,
                     help="decode steps chained per timed program: the "
                          "remote tunnel's ~70 ms host-fetch RTT adds "
                          "RTT/chain to every per-step number, so the chain "
                          "must be deep enough that the kernel's own "
-                         "sub-ms cost shows through (256 -> ~0.27 ms of "
-                         "RTT per step)")
+                         "sub-ms cost shows through (default 256 on TPU -> "
+                         "~0.27 ms of RTT per step; default 1 off-TPU, "
+                         "where the kernel runs in interpret mode and a "
+                         "256-step scan of it would take minutes)")
     ap.add_argument("--batch", type=int, default=4,
                     help="batch size (drop to 1 for long-seq cases so the "
                          "dense oracle's O(seq^2) scores have a chance)")
@@ -218,6 +220,11 @@ def main():
 
     dev = jax.devices()[0]
     print(f"backend: {dev.platform} ({getattr(dev, 'device_kind', '?')})")
+    if args.chain is None:
+        # Off-TPU the kernel runs in interpret mode: chaining 256
+        # interpreted steps per timed program would take minutes, and the
+        # tunnel-RTT rationale for chaining doesn't apply there.
+        args.chain = 256 if dev.platform == "tpu" else 1
     failed = False
     if args.decode:
         print(f"{'S':>6} {'pos0':>6} {'window':>7} {'out err':>9} "

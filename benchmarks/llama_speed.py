@@ -360,6 +360,44 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
     tput = run_epoch_loop(
         step_fn, x.shape[0], epochs=epochs, steps_per_epoch=steps, label=label
     )
+
+    # MFU for the spmd engine too (same convention as the mpmd branches:
+    # the numerator is the UN-pipELINED model's fwd+loss+bwd, costed from
+    # a plain sequential step over the stacked block params).  Configs
+    # whose block graph needs mesh collectives at trace time (tp/sp/ep)
+    # fail the plain lowering — analytic_flops returns None there and
+    # print_mfu stays silent rather than publishing a wrong denominator.
+    from benchmarks.common import analytic_flops, print_mfu
+
+    def _plain_step(ps):
+        def loss_of(ps):
+            h = inputs
+            if pre is not None:
+                h, _ = pre.apply(ps["pre"], (), h, rng=None, train=True)
+
+            def body(hh, bp):
+                out, _ = block.apply(bp, (), hh, rng=None, train=True)
+                return out, None
+
+            h, _ = jax.lax.scan(body, h, ps["blocks"])
+            if post is not None:
+                h, _ = post.apply(ps["post"], (), h, rng=None, train=True)
+            if "loss" in ps:
+                l, _ = loss_fn.apply(
+                    ps["loss"], (), (h, targets), rng=None, train=True
+                )
+            else:
+                l = loss_fn(h, targets)
+            return l
+
+        return jax.value_and_grad(loss_of)(ps)
+
+    print_mfu(
+        lambda: analytic_flops(_plain_step, carry["params"]),
+        tput, x.shape[0], label,
+        n_chips=int(mesh.devices.size),
+        device=mesh.devices.flat[0],
+    )
     if moe is not None and pre is not None:
         # Router balance of stage 0's first MoE block on the final batch.
         stage0 = jax.tree_util.tree_map(

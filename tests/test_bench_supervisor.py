@@ -1,0 +1,182 @@
+"""The bench.py deadline supervisor: one JSON line, no matter what.
+
+Round 4's graded bench run was killed by the driver's timeout (rc=124)
+with NO output — the old single-process bench had no wall-clock budget,
+so a slow-but-alive tunnel hung it past the driver's patience.  The
+supervisor rewrite guarantees exactly one parseable JSON line on stdout
+under every child behavior.  These tests drive the supervisor against
+stand-in child scripts (via the ``TGPU_BENCH_CHILD_SCRIPT`` test hook) so
+every failure shape — hang before any result, hang after a partial
+result, clean success, fallback-stage success — is exercised in seconds
+without jax or a real tunnel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+BENCH = Path(__file__).resolve().parent.parent / "bench.py"
+
+FINAL_LINE = json.dumps(
+    {
+        "metric": "train samples/sec/chip [stand-in, cpu]",
+        "value": 123.0,
+        "unit": "samples/sec/chip",
+        "vs_baseline": None,
+        "mfu": None,
+        "platform": "cpu",
+    }
+)
+
+PARTIAL_LINE = "BENCH_PARTIAL " + json.dumps(
+    {
+        "metric": "train samples/sec/chip [stand-in-partial, tpu]",
+        "value": 456.0,
+        "unit": "samples/sec/chip",
+        "vs_baseline": 27.5,
+        "mfu": None,
+        "platform": "tpu",
+    }
+)
+
+
+def _write_child(tmp_path: Path, body: str) -> str:
+    script = tmp_path / "fake_child.py"
+    script.write_text("import os, sys, time\n" + body)
+    return str(script)
+
+
+def _run_supervisor(
+    child: str, deadline: str, reserve: str, cpu_pinned: bool
+) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["TGPU_BENCH_CHILD_SCRIPT"] = child
+    env["TGPU_BENCH_DEADLINE_S"] = deadline
+    env["TGPU_BENCH_FALLBACK_RESERVE_S"] = reserve
+    env.pop("TGPU_DEADLINE_FALLBACK", None)
+    if cpu_pinned:
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    return subprocess.run(
+        [sys.executable, str(BENCH)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+
+
+def _the_one_json_line(r: subprocess.CompletedProcess) -> dict:
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must carry exactly one line: {lines!r}"
+    obj = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "mfu", "platform"):
+        assert key in obj
+    return obj
+
+
+def test_clean_child_final_line_passes_through(tmp_path):
+    child = _write_child(tmp_path, f"print({FINAL_LINE!r})\n")
+    obj = _the_one_json_line(_run_supervisor(child, "30", "5", cpu_pinned=True))
+    assert obj["value"] == 123.0
+    assert obj["platform"] == "cpu"
+
+
+def test_hang_with_cpu_pin_yields_static_line(tmp_path):
+    # CPU-pinned: no fallback stage exists, so a hung child must still end
+    # in the static zero-value line within the deadline.
+    child = _write_child(tmp_path, "time.sleep(60)\n")
+    obj = _the_one_json_line(_run_supervisor(child, "3", "1", cpu_pinned=True))
+    assert obj["value"] == 0.0
+    assert obj["platform"] == "none"
+    assert "no rung completed" in obj["metric"]
+
+
+def test_hang_then_hanging_fallback_yields_static_line(tmp_path):
+    # Worst case: the TPU child hangs AND the CPU fallback child hangs.
+    child = _write_child(tmp_path, "time.sleep(60)\n")
+    obj = _the_one_json_line(_run_supervisor(child, "4", "2", cpu_pinned=False))
+    assert obj["value"] == 0.0
+    assert obj["platform"] == "none"
+
+
+def test_partial_promoted_when_child_hangs_after_measurement(tmp_path):
+    # The child measured throughput, streamed it, then stalled in the MFU
+    # pass: the supervisor must promote the partial, marked as such.
+    child = _write_child(
+        tmp_path, f"print({PARTIAL_LINE!r}, flush=True)\ntime.sleep(60)\n"
+    )
+    obj = _the_one_json_line(_run_supervisor(child, "3", "1", cpu_pinned=True))
+    assert obj["value"] == 456.0
+    assert obj["platform"] == "tpu"
+    assert obj["vs_baseline"] == 27.5
+    assert "supervisor-deadline-partial" in obj["metric"]
+
+
+def test_fallback_stage_runs_cpu_pinned_child(tmp_path):
+    # Main child hangs; the fallback stage must re-run the child with
+    # JAX_PLATFORMS=cpu and TGPU_DEADLINE_FALLBACK=1 set.
+    child = _write_child(
+        tmp_path,
+        "if os.environ.get('JAX_PLATFORMS') == 'cpu':\n"
+        "    tag = 'fb=' + os.environ.get('TGPU_DEADLINE_FALLBACK', '?')\n"
+        "    print('{\"metric\": \"m [' + tag + ']\", \"value\": 1.5, "
+        '"unit": "u", "vs_baseline": null, "mfu": null, '
+        '"platform": "cpu"}\')\n'
+        "else:\n"
+        "    time.sleep(60)\n",
+    )
+    obj = _the_one_json_line(_run_supervisor(child, "8", "4", cpu_pinned=False))
+    assert obj["value"] == 1.5
+    assert "fb=1" in obj["metric"]
+
+
+def test_noisy_stdout_is_filtered_to_stderr(tmp_path):
+    # XLA/absl noise on the child's stdout must never corrupt the one
+    # JSON line the driver parses.
+    child = _write_child(
+        tmp_path,
+        "print('WARNING: Platform axon is experimental')\n"
+        "print('some { not json } noise')\n"
+        f"print({FINAL_LINE!r})\n",
+    )
+    r = _run_supervisor(child, "30", "5", cpu_pinned=True)
+    obj = _the_one_json_line(r)
+    assert obj["value"] == 123.0
+    assert "experimental" in r.stderr
+
+
+def test_crashing_child_falls_back(tmp_path):
+    # A child that dies instantly (nonzero exit, no output) must not
+    # produce a bare traceback as the driver's parse target.
+    child = _write_child(
+        tmp_path,
+        "if os.environ.get('JAX_PLATFORMS') == 'cpu':\n"
+        f"    print({FINAL_LINE!r})\n"
+        "else:\n"
+        "    sys.exit(3)\n",
+    )
+    obj = _the_one_json_line(_run_supervisor(child, "20", "10", cpu_pinned=False))
+    assert obj["value"] == 123.0
+
+
+@pytest.mark.parametrize("cpu_pinned", [True, False])
+def test_supervisor_respects_total_deadline(tmp_path, cpu_pinned):
+    import time as _time
+
+    child = _write_child(tmp_path, "time.sleep(60)\n")
+    t0 = _time.monotonic()
+    r = _run_supervisor(child, "4", "2", cpu_pinned=cpu_pinned)
+    elapsed = _time.monotonic() - t0
+    _the_one_json_line(r)
+    # Deadline 4 s + process startup/kill slack; the old bench would have
+    # sat for the full 60 s sleep (and the driver's rc=124 after that).
+    assert elapsed < 20.0
