@@ -26,11 +26,22 @@ from torchgpipe_tpu.models.transformer import (
 )
 
 
-def main() -> None:
+def build_model():
     cfg = TransformerConfig(
         vocab=32, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
     )
-    model = GPipe(llama(cfg), balance=[2, 2], chunks=2)
+    return cfg, GPipe(llama(cfg), balance=[2, 2], chunks=2)
+
+
+def build_for_lint():
+    """Static-analysis entrypoint (tools/pipeline_lint.py)."""
+    _, model = build_model()
+    x = jax.ShapeDtypeStruct((4, 12), jnp.int32)
+    return model, x, x, cross_entropy
+
+
+def main() -> None:
+    cfg, model = build_model()
     b, s = 4, 12
     data = jnp.mod(jnp.arange(s + 1)[None, :] + jnp.arange(b)[:, None], 32)
     x, y = data[:, :-1], data[:, 1:]

@@ -29,6 +29,34 @@ import optax
 PP, DP = 2, 2
 
 
+def build_for_lint():
+    """Static-analysis entrypoint (tools/pipeline_lint.py): the same
+    HF-imported pipeline main() trains, built but not run — the linter
+    traces it abstractly (tied head, pp x dp mesh, except_last remat)."""
+    import torch
+    import transformers
+
+    from torchgpipe_tpu.models.hf_interop import from_hf_llama
+    from torchgpipe_tpu.models.transformer import cross_entropy, llama_spmd
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=PP, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    cfg, _ = from_hf_llama(transformers.LlamaForCausalLM(hf_cfg).eval())
+    block, pre, post = llama_spmd(cfg, PP)
+    mesh = make_mesh(PP, DP, devices=jax.devices()[: PP * DP])
+    pipe = SpmdGPipe(
+        block, PP, mesh, chunks=2, loss_fn=cross_entropy,
+        pre=pre, post=post, dp_axis="dp", checkpoint="except_last",
+    )
+    x = jax.ShapeDtypeStruct((8, 15), jnp.int32)
+    return pipe, x
+
+
 def main() -> None:
     import torch
     import transformers

@@ -63,6 +63,30 @@ def train_3_steps(tag: str, cfg: TransformerConfig, mesh, **engine_kw):
     assert losses[-1] < losses[0]
 
 
+def build_for_lint():
+    """Static-analysis entrypoint (tools/pipeline_lint.py): one case per
+    sequence-scaling tool, traced abstractly."""
+    pp, sp = 2, 2
+    mesh = make_mesh(pp, 1, sp, devices=jax.devices()[: pp * sp])
+    base = dict(vocab=128, dim=64, n_layers=pp, n_heads=4, n_kv_heads=2)
+    x = jax.ShapeDtypeStruct((4, 64), jnp.int32)
+    cases = []
+    for name, cfg in (
+        ("ring", TransformerConfig(**base, sp_axis="sp", sp_impl="ring")),
+        ("ulysses", TransformerConfig(**base, sp_axis="sp",
+                                      sp_impl="ulysses")),
+        ("ulysses-window", TransformerConfig(
+            **base, sp_axis="sp", sp_impl="ulysses", attn_window=16)),
+    ):
+        block, pre, post = llama_spmd(cfg, cfg.n_layers)
+        pipe = SpmdGPipe(
+            block, cfg.n_layers, mesh, chunks=2, loss_fn=cross_entropy,
+            pre=pre, post=post, sp_axis="sp",
+        )
+        cases.append({"name": name, "pipe": pipe, "x": x})
+    return cases
+
+
 def main() -> None:
     pp, sp = 2, 2
     mesh = make_mesh(pp, 1, sp, devices=jax.devices()[: pp * sp])

@@ -48,6 +48,38 @@ def launch_both() -> None:
     print("multihost example: both ranks OK")
 
 
+def build_for_lint():
+    """Static-analysis entrypoint (tools/pipeline_lint.py): the same
+    (dp, pp) topology run_rank() builds across two processes, constructed
+    on this process's 8 virtual devices — the linter only needs the traced
+    program, which is identical either way."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        cross_entropy,
+        llama_spmd,
+    )
+    from torchgpipe_tpu.spmd import SpmdGPipe
+
+    pp, dp, m = 4, 2, 4
+    cfg = TransformerConfig(
+        vocab=256, dim=64, n_layers=pp, n_heads=4, n_kv_heads=2
+    )
+    block, pre, post = llama_spmd(cfg, pp)
+    mesh = Mesh(np.array(jax.devices()[: dp * pp]).reshape(dp, pp),
+                ("dp", "pp"))
+    pipe = SpmdGPipe(
+        block, pp, mesh, chunks=m, loss_fn=cross_entropy,
+        pre=pre, post=post, dp_axis="dp",
+    )
+    x = jax.ShapeDtypeStruct((m * dp * 2, 16), jnp.int32)
+    return pipe, x
+
+
 def run_rank(rank: int) -> None:
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")

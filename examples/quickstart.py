@@ -6,6 +6,11 @@ CPU (8 virtual devices):
         python examples/quickstart.py
 
 On TPU just run it — the same code pipelines across the chips present.
+
+Lint the pipelines without running them (tools/pipeline_lint.py imports
+:func:`build_for_lint` below):
+
+    python tools/pipeline_lint.py examples/quickstart.py
 """
 
 import jax
@@ -18,32 +23,40 @@ from torchgpipe_tpu import GPipe
 from torchgpipe_tpu.layers import named
 from torchgpipe_tpu.ops import dense, gelu
 
+PP, DP = 2, 2
+
 
 def mse(out, tgt):
     return jnp.mean((out - tgt) ** 2)
 
 
-layers = named([
-    dense(64, name="fc1"), gelu("a1"),
-    dense(64, name="fc2"), gelu("a2"),
-    dense(8, name="head"),
-])
-model = GPipe(layers, balance=[3, 2], chunks=4)  # 2 stages, 4 micro-batches
+def build_mpmd():
+    """The MPMD pipeline: 2 stages, 4 micro-batches."""
+    layers = named([
+        dense(64, name="fc1"), gelu("a1"),
+        dense(64, name="fc2"), gelu("a2"),
+        dense(8, name="head"),
+    ])
+    return GPipe(layers, balance=[3, 2], chunks=4)
 
-x = jax.random.normal(jax.random.PRNGKey(0), (16, 64))
-y = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
-params, state = model.init(
-    jax.random.PRNGKey(2), jax.ShapeDtypeStruct(x.shape, x.dtype)
-)
-for step in range(5):
-    loss, grads, state, _ = model.value_and_grad(params, state, x, y, mse)
-    params = tuple(
-        jax.tree_util.tree_map(lambda p, g: p - 0.05 * g, ps, gs)
-        for ps, gs in zip(params, grads)
+
+def run_mpmd():
+    model = build_mpmd()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 64))
+    y = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    params, state = model.init(
+        jax.random.PRNGKey(2), jax.ShapeDtypeStruct(x.shape, x.dtype)
     )
-    print(f"[mpmd] step {step}: loss {float(loss):.4f}", flush=True)
-out, _ = model.apply(params, state, x)
-print("[mpmd] inference:", out.shape, flush=True)
+    for step in range(5):
+        loss, grads, state, _ = model.value_and_grad(params, state, x, y, mse)
+        params = tuple(
+            jax.tree_util.tree_map(lambda p, g: p - 0.05 * g, ps, gs)
+            for ps, gs in zip(params, grads)
+        )
+        print(f"[mpmd] step {step}: loss {float(loss):.4f}", flush=True)
+    out, _ = model.apply(params, state, x)
+    print("[mpmd] inference:", out.shape, flush=True)
+
 
 # ----------------------------------------------------------------------- #
 # 2. SPMD engine: a Llama-style pipeline compiled as ONE program on a     #
@@ -54,15 +67,21 @@ from torchgpipe_tpu.models.transformer import (
     TransformerConfig, cross_entropy, llama_spmd,
 )
 
-pp, dp = 2, 2
-if len(jax.devices()) >= pp * dp:
-    cfg = TransformerConfig(vocab=256, dim=64, n_layers=pp, n_heads=4,
+
+def build_spmd():
+    """The SPMD pipeline: Llama-style blocks on a pp x dp mesh + FSDP."""
+    cfg = TransformerConfig(vocab=256, dim=64, n_layers=PP, n_heads=4,
                             n_kv_heads=2)
-    block, pre, post = llama_spmd(cfg, pp)
-    mesh = make_mesh(pp, dp)
-    pipe = SpmdGPipe(block, pp, mesh, chunks=2, loss_fn=cross_entropy,
+    block, pre, post = llama_spmd(cfg, PP)
+    mesh = make_mesh(PP, DP)
+    pipe = SpmdGPipe(block, PP, mesh, chunks=2, loss_fn=cross_entropy,
                      pre=pre, post=post, checkpoint="except_last",
                      dp_axis="dp", fsdp=True)
+    return cfg, pipe
+
+
+def run_spmd():
+    cfg, pipe = build_spmd()
     tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 32), 0, cfg.vocab)
     labels = jnp.roll(tokens, -1, axis=1)
     p = pipe.init(
@@ -84,7 +103,33 @@ if len(jax.devices()) >= pp * dp:
         loss, p, opt_state = fused(p, opt_state, tokens, labels)
         print(f"[spmd/fused-opt] step {step}: loss {float(loss):.4f}",
               flush=True)
-else:
-    print(f"[spmd] skipped: needs {pp * dp} devices, have {len(jax.devices())}")
 
-print("quickstart done", flush=True)
+
+def build_for_lint():
+    """Static-analysis entrypoint (tools/pipeline_lint.py): both engines,
+    traced abstractly — shapes only, no training."""
+    x = jax.ShapeDtypeStruct((16, 64), jnp.float32)
+    y = jax.ShapeDtypeStruct((16, 8), jnp.float32)
+    cases = [{"name": "mpmd", "pipe": build_mpmd(), "x": x,
+              "target": y, "loss_fn": mse}]
+    if len(jax.devices()) >= PP * DP:
+        cfg, pipe = build_spmd()
+        tokens = jax.ShapeDtypeStruct((8, 32), jnp.int32)
+        cases.append({"name": "spmd", "pipe": pipe, "x": tokens})
+    return cases
+
+
+def main():
+    run_mpmd()
+    if len(jax.devices()) >= PP * DP:
+        run_spmd()
+    else:
+        print(
+            f"[spmd] skipped: needs {PP * DP} devices, "
+            f"have {len(jax.devices())}"
+        )
+    print("quickstart done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

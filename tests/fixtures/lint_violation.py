@@ -1,0 +1,55 @@
+"""Seeded-violation fixture for tools/pipeline_lint.py.
+
+A pipeline that is deliberately wrong in two linter-visible ways — a host
+callback in a stage program (host-sync-in-loop) and a matmul whose output
+nothing consumes (dead-code) — so the CLI's nonzero-exit path stays
+covered: ``python tools/pipeline_lint.py tests/fixtures/lint_violation.py``
+must exit 1.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu import GPipe
+from torchgpipe_tpu.layers import Layer, named
+from torchgpipe_tpu.ops import dense
+
+
+def mse(out, tgt):
+    return jnp.mean((out - tgt) ** 2)
+
+
+def _chatty(name):
+    def init(rng, in_spec):
+        del rng, in_spec
+        return (), ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del params, rng, train
+        jax.debug.print("mean {m}", m=jnp.mean(x))  # host sync per cell
+        return x, state
+
+    return Layer(name=name, init=init, apply=apply)
+
+
+def _wasteful_dense(dim, name):
+    inner = dense(dim, name=name)
+
+    def apply(params, state, x, *, rng=None, train=True):
+        y, s = inner.apply(params, state, x, rng=rng, train=train)
+        _ = x @ jnp.ones((x.shape[-1], 4), x.dtype)  # dead matmul
+        return y, s
+
+    return dataclasses.replace(inner, apply=apply)
+
+
+def build_for_lint():
+    layers = named([
+        _wasteful_dense(16, "waste"), _chatty("dbg"), dense(8, name="head"),
+    ])
+    model = GPipe(layers, balance=[2, 1], chunks=2)
+    x = jax.ShapeDtypeStruct((4, 16), jnp.float32)
+    y = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    return model, x, y, mse

@@ -1,84 +1,26 @@
-"""Shared jaxpr-walking helpers for structural/memory test assertions.
+"""Re-export shim: the jaxpr walker now lives in the analysis package.
 
-One walker serves every structural test (remat/collective counts in
-test_structural.py, residual-byte accounting in test_memory.py, the
-biggest-intermediate bound in test_moe.py) so container handling —
-ClosedJaxpr wrappers, raw Jaxpr bodies (e.g. shard_map), tuple/list params
-— lives in exactly one place.
+The traversal core these tests share (container handling for ClosedJaxpr
+wrappers, raw shard_map bodies, tuple/list params) was promoted to
+:mod:`torchgpipe_tpu.analysis.jaxpr` so the lint rule engine and the
+structural tests walk programs with exactly the same code.  Import from the
+package in new code; this shim keeps existing test imports working.
 """
 
-import jax.numpy as jnp
+from torchgpipe_tpu.analysis.jaxpr import (  # noqa: F401
+    aval_bytes,
+    count_eqns,
+    iter_jaxprs,
+    max_eqn_output_bytes,
+    scan_lengths,
+    sum_eqn_output_bytes,
+)
 
-
-def iter_jaxprs(jaxpr):
-    """Yield ``jaxpr`` and every sub-jaxpr reachable through eqn params."""
-    yield jaxpr
-    for eqn in jaxpr.eqns:
-        for v in eqn.params.values():
-            yield from _iter_param(v)
-
-
-def _iter_param(v):
-    if hasattr(v, "jaxpr"):  # ClosedJaxpr
-        yield from iter_jaxprs(v.jaxpr)
-    elif hasattr(v, "eqns"):  # raw Jaxpr (e.g. shard_map body)
-        yield from iter_jaxprs(v)
-    elif isinstance(v, (tuple, list)):
-        for x in v:
-            yield from _iter_param(x)
-
-
-def count_eqns(jaxpr, names) -> int:
-    """Number of equations (recursively) whose primitive name is in
-    ``names``."""
-    return sum(
-        1
-        for jx in iter_jaxprs(jaxpr)
-        for eqn in jx.eqns
-        if eqn.primitive.name in names
-    )
-
-
-def aval_bytes(v) -> int:
-    aval = getattr(v, "aval", None)
-    if aval is None or not hasattr(aval, "shape"):
-        return 0
-    n = 1
-    for d in aval.shape:
-        n *= int(d)
-    return n * jnp.dtype(aval.dtype).itemsize
-
-
-def sum_eqn_output_bytes(jaxpr, names) -> int:
-    """Total output bytes of all equations whose primitive is in ``names``."""
-    return sum(
-        aval_bytes(v)
-        for jx in iter_jaxprs(jaxpr)
-        for eqn in jx.eqns
-        if eqn.primitive.name in names
-        for v in eqn.outvars
-    )
-
-
-def max_eqn_output_bytes(jaxpr) -> int:
-    """Largest single intermediate array (bytes) anywhere in the program."""
-    return max(
-        (
-            aval_bytes(v)
-            for jx in iter_jaxprs(jaxpr)
-            for eqn in jx.eqns
-            for v in eqn.outvars
-        ),
-        default=0,
-    )
-
-
-def scan_lengths(jaxpr):
-    """The trip counts (``length`` param) of every scan in the program, in
-    encounter order — lets structural tests pin schedule depths exactly."""
-    out = []
-    for jx in iter_jaxprs(jaxpr):
-        for eqn in jx.eqns:
-            if eqn.primitive.name == "scan":
-                out.append(eqn.params.get("length"))
-    return out
+__all__ = [
+    "aval_bytes",
+    "count_eqns",
+    "iter_jaxprs",
+    "max_eqn_output_bytes",
+    "scan_lengths",
+    "sum_eqn_output_bytes",
+]

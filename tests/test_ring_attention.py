@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from torchgpipe_tpu.spmd import shard_map_compat as shard_map
 from torchgpipe_tpu.parallel import full_attention, ring_attention
 from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
 from torchgpipe_tpu.models.transformer import (
@@ -39,12 +40,11 @@ def _run_ring(q, k, v, causal):
         return ring_attention(q, k, v, "sp", causal=causal)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"),
-            check_vma=False,
         )
     )
     return fn(
@@ -69,12 +69,11 @@ def test_ring_attention_grads_match_dense():
         return jnp.sum(full_attention(q, k, v, causal=True) * cot)
 
     def ring_loss(q, k, v):
-        local = jax.shard_map(
+        local = shard_map(
             lambda a, b, c: ring_attention(a, b, c, "sp", causal=True),
             mesh=mesh,
             in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"),
-            check_vma=False,
         )
         return jnp.sum(local(q, k, v) * cot)
 
@@ -214,14 +213,13 @@ def test_ring_attention_blockwise_substeps_exact(causal, kv_block):
     cot = jax.random.normal(jax.random.PRNGKey(22), q.shape)
 
     def ring_loss(q, k, v):
-        local = jax.shard_map(
+        local = shard_map(
             lambda a, b, c: ring_attention(
                 a, b, c, "sp", causal=causal, kv_block_size=kv_block
             ),
             mesh=mesh,
             in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"),
-            check_vma=False,
         )
         return jnp.sum(local(q, k, v) * cot)
 

@@ -14,6 +14,7 @@ import pytest
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from torchgpipe_tpu.spmd import shard_map_compat as shard_map
 from torchgpipe_tpu.models.transformer import (
     TransformerConfig,
     cross_entropy,
@@ -89,8 +90,8 @@ def test_psum_grad_sums_cotangent(cpu_devices):
 
     x = jnp.ones((4, 2))
     fn = jax.jit(
-        jax.shard_map(
-            local, mesh=mesh, in_specs=P(), out_specs=(P(), P()), check_vma=False
+        shard_map(
+            local, mesh=mesh, in_specs=P(), out_specs=(P(), P())
         )
     )
     _, g = fn(x)
@@ -310,12 +311,11 @@ def test_vocab_parallel_ce_extreme_logits_stable(cpu_devices):
     loss_fn = vocab_parallel_cross_entropy("tp")
 
     def run(shift):
-        local = jax.shard_map(
+        local = shard_map(
             lambda lg, lb: loss_fn(lg, lb),
             mesh=mesh,
             in_specs=(P(None, None, "tp"), P()),
             out_specs=P(),
-            check_vma=False,
         )
         return float(jax.jit(local)(logits + shift, labels))
 

@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from torchgpipe_tpu.spmd import shard_map_compat as shard_map
 from torchgpipe_tpu.models.transformer import (
     TransformerConfig,
     cross_entropy,
@@ -38,12 +39,11 @@ def _run_ulysses(q, k, v, causal):
     mesh = _mesh()
     shard = NamedSharding(mesh, P(None, "sp"))
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda a, b, c: ulysses_attention(a, b, c, "sp", causal=causal),
             mesh=mesh,
             in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"),
-            check_vma=False,
         )
     )
     return fn(
@@ -84,12 +84,11 @@ def test_ulysses_grads_match_dense():
         return jnp.sum(full_attention(q, k, v, causal=True) * cot)
 
     def uly_loss(q, k, v):
-        local = jax.shard_map(
+        local = shard_map(
             lambda a, b, c: ulysses_attention(a, b, c, "sp", causal=True),
             mesh=mesh,
             in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"),
-            check_vma=False,
         )
         return jnp.sum(local(q, k, v) * cot)
 
@@ -174,14 +173,13 @@ def test_ulysses_sliding_window_matches_dense():
     mesh = _mesh()
     shard = NamedSharding(mesh, P(None, "sp"))
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda a, b, c: ulysses_attention(
                 a, b, c, "sp", causal=True, window=12
             ),
             mesh=mesh,
             in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"),
-            check_vma=False,
         )
     )
     out = fn(jax.device_put(q, shard), jax.device_put(k, shard),
@@ -196,10 +194,10 @@ def test_ulysses_sliding_window_matches_dense():
 
     with pytest.raises(ValueError, match="ulysses"):
         jax.jit(
-            jax.shard_map(
+            shard_map(
                 ring_windowed, mesh=mesh,
                 in_specs=(P(None, "sp"),) * 3,
-                out_specs=P(None, "sp"), check_vma=False,
+                out_specs=P(None, "sp"),
             )
         )(q, k, v)
 

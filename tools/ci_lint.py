@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Unified lint gate: typegate + pipeline_lint over every example.
+
+ONE command for CI and pre-commit::
+
+    python tools/ci_lint.py            # exit 0 iff everything is clean
+
+Runs, each in its own interpreter (they configure the jax platform
+differently and must not share backend state):
+
+1. ``tools/typegate.py`` — the strict annotation gate over
+   ``torchgpipe_tpu/`` and ``tools/``;
+2. ``tools/pipeline_lint.py examples/*.py`` — every example's
+   ``build_for_lint`` pipeline must trace and lint clean (the structural
+   invariants of docs/analysis.md).
+
+Options: ``--skip-typegate`` / ``--skip-pipeline`` to run one half,
+``-v`` for per-target lint reports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import subprocess
+import sys
+from typing import List, Sequence
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run(tag: str, cmd: List[str]) -> int:
+    print(f"[ci_lint] {tag}: {' '.join(cmd)}", flush=True)
+    rc = subprocess.call(cmd, cwd=REPO)
+    print(f"[ci_lint] {tag}: {'OK' if rc == 0 else f'FAILED (rc={rc})'}",
+          flush=True)
+    return rc
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="typegate + pipeline lint gate")
+    ap.add_argument("--skip-typegate", action="store_true")
+    ap.add_argument("--skip-pipeline", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="verbose pipeline_lint output")
+    args = ap.parse_args(argv)
+
+    failures = 0
+    if not args.skip_typegate:
+        failures += _run(
+            "typegate", [sys.executable, str(REPO / "tools" / "typegate.py")]
+        ) != 0
+    if not args.skip_pipeline:
+        examples = sorted(
+            str(p.relative_to(REPO)) for p in (REPO / "examples").glob("*.py")
+        )
+        if not examples:
+            print("[ci_lint] no examples found", file=sys.stderr)
+            return 2
+        cmd = [
+            sys.executable, str(REPO / "tools" / "pipeline_lint.py"),
+            *examples,
+        ]
+        if args.verbose:
+            cmd.append("-v")
+        failures += _run("pipeline_lint", cmd) != 0
+    print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,6 +34,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 PACKAGE = pathlib.Path(__file__).resolve().parent.parent / "torchgpipe_tpu"
+TOOLS = pathlib.Path(__file__).resolve().parent
 
 
 def _violations_in(path: pathlib.Path) -> list[str]:
@@ -135,10 +136,17 @@ def main() -> int:
     for f in files:
         bad.extend(_violations_in(f))
         bad.extend(_unresolved_annotation_names(f))
+    # tools/ scripts get the annotation rule too (no import-resolution
+    # pass: scripts are entrypoints, not package modules — importing them
+    # here would run their CLI setup twice).
+    tool_files = sorted(TOOLS.glob("*.py"))
+    for f in tool_files:
+        bad.extend(_violations_in(f))
     for msg in bad:
         print(msg)
     print(
-        f"typegate: {len(files)} files, {len(bad)} violation(s)",
+        f"typegate: {len(files)} package + {len(tool_files)} tool files, "
+        f"{len(bad)} violation(s)",
         file=sys.stderr,
     )
     return 1 if bad else 0

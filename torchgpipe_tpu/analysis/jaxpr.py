@@ -1,0 +1,217 @@
+"""Shared jaxpr-traversal core for static analysis and structural tests.
+
+Grown out of ``tests/jaxpr_utils.py`` (which now re-exports from here): one
+walker serves every structural assertion in the test suite (remat/collective
+counts, residual-byte accounting, biggest-intermediate bounds) AND the lint
+rule engine (:mod:`torchgpipe_tpu.analysis.rules`), so container handling —
+ClosedJaxpr wrappers, raw Jaxpr bodies (e.g. shard_map), tuple/list params —
+lives in exactly one place.
+
+Two traversal styles:
+
+* :func:`iter_jaxprs` — flat recursive iteration over every (sub-)jaxpr;
+  the counting/byte helpers build on it.
+* :func:`walk_eqns` — path-aware iteration yielding :class:`EqnSite`
+  records that remember *where* an equation sits (the chain of enclosing
+  primitives, e.g. ``shard_map/scan/remat2``) — what the lint rules need to
+  distinguish "collective inside the pipelined loop body" from "collective
+  in the epilogue".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+# Primitive names by role (jax spells some of these differently across
+# versions — e.g. remat vs remat2 — so rules match against the set).
+REMAT_PRIMS = ("remat", "remat2", "checkpoint")
+LOOP_PRIMS = ("scan", "while")
+COLLECTIVE_PRIMS = (
+    "psum",
+    "psum2",
+    "psum_invariant",
+    "pmean",
+    "pmax",
+    "pmin",
+    "ppermute",
+    "pgather",
+    "all_gather",
+    "all_to_all",
+    "psum_scatter",
+    "reduce_scatter",
+)
+# Collectives that REDUCE over an axis (the result mixes every lane's
+# value) as opposed to permutations/layout changes (ppermute, all_to_all).
+REDUCING_COLLECTIVE_PRIMS = tuple(
+    p
+    for p in COLLECTIVE_PRIMS
+    if p not in ("ppermute", "pgather", "all_to_all")
+)
+# Host-synchronizing primitives: each runtime occurrence round-trips to the
+# Python host, serializing the device stream.
+HOST_CALLBACK_PRIMS = (
+    "debug_callback",
+    "pure_callback",
+    "io_callback",
+    "host_callback",
+    "outside_call",
+    "infeed",
+    "outfeed",
+)
+# Compute-heavy primitives (the ones worth flagging when dead and worth
+# dtype-checking under a mixed-precision policy).
+MATMUL_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+def iter_jaxprs(jaxpr: Any) -> Iterator[Any]:
+    """Yield ``jaxpr`` and every sub-jaxpr reachable through eqn params."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            yield from _iter_param(v)
+
+
+def _iter_param(v: Any) -> Iterator[Any]:
+    if hasattr(v, "jaxpr"):  # ClosedJaxpr
+        yield from iter_jaxprs(v.jaxpr)
+    elif hasattr(v, "eqns"):  # raw Jaxpr (e.g. shard_map body)
+        yield from iter_jaxprs(v)
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            yield from _iter_param(x)
+
+
+def subjaxprs(eqn: Any) -> List[Any]:
+    """The immediate sub-jaxprs of one equation (not recursive)."""
+    out: List[Any] = []
+
+    def collect(v: Any) -> None:
+        if hasattr(v, "jaxpr"):
+            out.append(v.jaxpr)
+        elif hasattr(v, "eqns"):
+            out.append(v)
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                collect(x)
+
+    for v in eqn.params.values():
+        collect(v)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class EqnSite:
+    """One equation plus where it sits in the traced program.
+
+    ``path`` is the chain of enclosing primitive names from the program
+    root (e.g. ``("shard_map", "scan", "remat2")``); ``index`` is the
+    equation's position in its immediately-enclosing jaxpr — together with
+    the program name they form the ``path/stage:eqn`` diagnostic anchor.
+    """
+
+    jaxpr: Any
+    eqn: Any
+    index: int
+    path: Tuple[str, ...]
+
+    def within(self, prim_name: str) -> bool:
+        """True if any enclosing primitive is ``prim_name``."""
+        return prim_name in self.path
+
+    def within_any(self, prim_names: Sequence[str]) -> bool:
+        """True if any enclosing primitive is one of ``prim_names``."""
+        return any(p in self.path for p in prim_names)
+
+
+def walk_eqns(jaxpr: Any, _path: Tuple[str, ...] = ()) -> Iterator[EqnSite]:
+    """Yield an :class:`EqnSite` for every equation, depth-first, with the
+    enclosing-primitive path tracked."""
+    for i, eqn in enumerate(jaxpr.eqns):
+        yield EqnSite(jaxpr=jaxpr, eqn=eqn, index=i, path=_path)
+        sub_path = _path + (eqn.primitive.name,)
+        for sub in subjaxprs(eqn):
+            yield from walk_eqns(sub, sub_path)
+
+
+def count_eqns(jaxpr: Any, names: Sequence[str]) -> int:
+    """Number of equations (recursively) whose primitive name is in
+    ``names``."""
+    return sum(
+        1
+        for jx in iter_jaxprs(jaxpr)
+        for eqn in jx.eqns
+        if eqn.primitive.name in names
+    )
+
+
+def aval_bytes(v: Any) -> int:
+    aval = getattr(v, "aval", None)
+    if aval is None or not hasattr(aval, "shape"):
+        return 0
+    n = 1
+    for d in aval.shape:
+        n *= int(d)
+    return n * jnp.dtype(aval.dtype).itemsize
+
+
+def sum_eqn_output_bytes(jaxpr: Any, names: Sequence[str]) -> int:
+    """Total output bytes of all equations whose primitive is in ``names``."""
+    return sum(
+        aval_bytes(v)
+        for jx in iter_jaxprs(jaxpr)
+        for eqn in jx.eqns
+        if eqn.primitive.name in names
+        for v in eqn.outvars
+    )
+
+
+def max_eqn_output_bytes(jaxpr: Any) -> int:
+    """Largest single intermediate array (bytes) anywhere in the program."""
+    return max(
+        (
+            aval_bytes(v)
+            for jx in iter_jaxprs(jaxpr)
+            for eqn in jx.eqns
+            for v in eqn.outvars
+        ),
+        default=0,
+    )
+
+
+def scan_lengths(jaxpr: Any) -> List[Optional[int]]:
+    """The trip counts (``length`` param) of every scan in the program, in
+    encounter order — lets structural tests pin schedule depths exactly."""
+    out: List[Optional[int]] = []
+    for jx in iter_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                out.append(eqn.params.get("length"))
+    return out
+
+
+def collective_axes(eqn: Any) -> Tuple[str, ...]:
+    """The mesh-axis names a collective equation operates over.
+
+    Normalizes the parameter spellings jax uses across collectives:
+    ``axes`` (psum family), ``axis_name`` (ppermute/all_gather/all_to_all).
+    Non-collective equations return ``()``.
+    """
+    raw = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if raw is None:
+        return ()
+    if isinstance(raw, (str, int)):
+        raw = (raw,)
+    return tuple(str(a) for a in raw if isinstance(a, str))
+
+
+def prim_counts(jaxpr: Any, names: Sequence[str]) -> "dict[str, int]":
+    """Per-primitive occurrence counts (recursive) for the given names."""
+    out = {n: 0 for n in names}
+    for jx in iter_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in out:
+                out[eqn.primitive.name] += 1
+    return out

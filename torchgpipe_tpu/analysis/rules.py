@@ -1,0 +1,520 @@
+"""The lint rule engine: structural invariants checked over traced jaxprs.
+
+Each rule is a pure function ``(PipelineTrace) -> List[Finding]`` registered
+in :data:`RULES`.  The invariants are the ones the paper's correctness story
+rests on (Kim et al., arXiv:2004.09910; Huang et al., arXiv:1811.06965):
+checkpointing recomputes exactly the forward graph, micro-batches share one
+compiled program, collectives run over axes that exist, and the pipelined
+loop body never blocks on the host.  The test suite asserts these on its own
+models (tests/test_structural.py etc.); the rule engine enforces them on
+*any* user model before a long TPU compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from torchgpipe_tpu.analysis import jaxpr as jx
+from torchgpipe_tpu.analysis.diagnostics import Finding, Severity
+from torchgpipe_tpu.analysis.trace import (
+    FUSED_TRAIN,
+    SPMD_TRAIN,
+    STAGE_CKPT,
+    STAGE_FORWARD,
+    STAGE_RECOMPUTE,
+    PipelineTrace,
+    TracedProgram,
+)
+from torchgpipe_tpu.checkpoint import checkpoint_stop
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One named invariant check."""
+
+    name: str
+    description: str
+    check: Callable[[PipelineTrace], List[Finding]]
+
+
+# --------------------------------------------------------------------- #
+# remat-coverage                                                        #
+# --------------------------------------------------------------------- #
+
+
+def _check_remat_coverage(trace: PipelineTrace) -> List[Finding]:
+    out: List[Finding] = []
+    if trace.engine == "spmd":
+        for prog in trace.by_kind(SPMD_TRAIN):
+            n_remat = jx.count_eqns(prog.jaxpr.jaxpr, jx.REMAT_PRIMS)
+            if trace.checkpoint in ("always", "except_last") and n_remat == 0:
+                out.append(Finding(
+                    rule="remat-coverage",
+                    severity=Severity.ERROR,
+                    path=prog.path,
+                    message=(
+                        f"checkpoint={trace.checkpoint!r} is configured but "
+                        "the compiled step contains no remat region — "
+                        "activations will be saved for every cell (GPipe "
+                        "memory profile lost; O(m) instead of O(1) "
+                        "activation memory per stage)"
+                    ),
+                ))
+        return out
+
+    # MPMD: the fused whole-step program is the remat-count oracle —
+    # checkpoint mode X over m micro-batches and n stages must produce
+    # exactly stop(X, m) * n remat'd cells (reference gpipe.py:360-367).
+    m = len(trace.mb_signatures) or trace.chunks
+    stop = checkpoint_stop(trace.checkpoint, m, train=True)
+    for prog in trace.by_kind(FUSED_TRAIN):
+        n_remat = jx.count_eqns(prog.jaxpr.jaxpr, jx.REMAT_PRIMS)
+        expected = stop * trace.n_stages
+        if stop > 0 and n_remat != expected:
+            out.append(Finding(
+                rule="remat-coverage",
+                severity=Severity.ERROR,
+                path=prog.path,
+                message=(
+                    f"checkpoint={trace.checkpoint!r} over {m} micro-"
+                    f"batches x {trace.n_stages} stages must remat exactly "
+                    f"{expected} cells, found {n_remat} remat regions"
+                ),
+            ))
+        if stop == 0 and n_remat != 0:
+            out.append(Finding(
+                rule="remat-coverage",
+                severity=Severity.WARNING,
+                path=prog.path,
+                message=(
+                    f"checkpoint='never' but {n_remat} remat region(s) "
+                    "present — a layer applies jax.checkpoint on its own; "
+                    "recompute will run even though the engine stores "
+                    "residuals"
+                ),
+            ))
+
+    # Divergence: the checkpointed forward and the recompute must contain
+    # the forward's compute graph.  A layer branching on is_checkpointing /
+    # is_recomputing that skips real compute breaks gradient correctness
+    # (the reference's Checkpoint/Recompute pair recomputes the exact
+    # forward, reference checkpoint.py:1-19).
+    for j in range(trace.n_stages):
+        fwd = trace.stage_program(STAGE_FORWARD, j)
+        if fwd is None:
+            continue
+        fwd_counts = jx.prim_counts(fwd.jaxpr.jaxpr, jx.MATMUL_PRIMS)
+        ck = trace.stage_program(STAGE_CKPT, j)
+        if ck is not None:
+            ck_counts = jx.prim_counts(ck.jaxpr.jaxpr, jx.MATMUL_PRIMS)
+            if ck_counts != fwd_counts:
+                out.append(Finding(
+                    rule="remat-coverage",
+                    severity=Severity.ERROR,
+                    path=ck.path,
+                    message=(
+                        "checkpointed forward diverges from the plain "
+                        f"forward (matmul/conv counts {ck_counts} vs "
+                        f"{fwd_counts}) — a layer branches on "
+                        "is_checkpointing(); the recompute will not "
+                        "reproduce the forward graph"
+                    ),
+                ))
+        rc = trace.stage_program(STAGE_RECOMPUTE, j)
+        if rc is not None:
+            rc_counts = jx.prim_counts(rc.jaxpr.jaxpr, jx.MATMUL_PRIMS)
+            if any(rc_counts[k] < fwd_counts[k] for k in fwd_counts):
+                out.append(Finding(
+                    rule="remat-coverage",
+                    severity=Severity.ERROR,
+                    path=rc.path,
+                    message=(
+                        "recompute body is missing forward compute "
+                        f"(matmul/conv counts {rc_counts} vs forward "
+                        f"{fwd_counts}) — a layer branches on "
+                        "is_recomputing() and skips real work; its "
+                        "gradients will be wrong"
+                    ),
+                ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# precision-drift                                                       #
+# --------------------------------------------------------------------- #
+
+_LOW_PRECISION = ("bfloat16", "float16")
+
+
+def _check_precision_drift(trace: PipelineTrace) -> List[Finding]:
+    dtype = trace.compute_dtype
+    if dtype is None or jnp.dtype(dtype).name not in _LOW_PRECISION:
+        return []
+    dtype_name = jnp.dtype(dtype).name
+    out: List[Finding] = []
+    for prog in trace.by_kind(STAGE_FORWARD):
+        for site in jx.walk_eqns(prog.jaxpr.jaxpr):
+            name = site.eqn.primitive.name
+            if name in jx.MATMUL_PRIMS:
+                in_dtypes = {
+                    str(getattr(v, "aval", None) and v.aval.dtype)
+                    for v in site.eqn.invars
+                    if getattr(v, "aval", None) is not None
+                }
+                if "float32" in in_dtypes:
+                    out.append(Finding(
+                        rule="precision-drift",
+                        severity=Severity.WARNING,
+                        path=prog.path,
+                        eqn=site.index,
+                        primitive=name,
+                        message=(
+                            f"float32 {name} inside a {dtype_name} compute "
+                            "region — the precision policy (precision.py) "
+                            "casts layer inputs/params down, so a float32 "
+                            "matmul means a layer upcasts internally: 2x "
+                            "MXU time and activation bytes for this op"
+                        ),
+                    ))
+            elif name in ("rsqrt", "sqrt"):
+                v = site.eqn.invars[0]
+                aval = getattr(v, "aval", None)
+                if aval is not None and str(aval.dtype) in _LOW_PRECISION:
+                    out.append(Finding(
+                        rule="precision-drift",
+                        severity=Severity.WARNING,
+                        path=prog.path,
+                        eqn=site.index,
+                        primitive=name,
+                        message=(
+                            f"normalization statistics computed in "
+                            f"{aval.dtype} — the policy keeps norm "
+                            "statistics float32 (variance of a "
+                            f"{dtype_name} sum underflows); upcast before "
+                            "the mean/variance like precision._wrap_norm"
+                        ),
+                    ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# collective-mismatch                                                   #
+# --------------------------------------------------------------------- #
+
+
+def _check_collective_mismatch(trace: PipelineTrace) -> List[Finding]:
+    out: List[Finding] = []
+    if trace.engine != "spmd":
+        # MPMD stage programs run on single devices; any collective traces
+        # to an unbound axis name, which the tracer already converted into
+        # a collective-mismatch finding in trace.errors.
+        return out
+    mesh_axes = set(trace.mesh_axes)
+    for prog in trace.by_kind(SPMD_TRAIN):
+        for site in jx.walk_eqns(prog.jaxpr.jaxpr):
+            name = site.eqn.primitive.name
+            if name not in jx.COLLECTIVE_PRIMS:
+                continue
+            axes = jx.collective_axes(site.eqn)
+            unknown = [a for a in axes if a not in mesh_axes]
+            if unknown:
+                out.append(Finding(
+                    rule="collective-mismatch",
+                    severity=Severity.ERROR,
+                    path=prog.path,
+                    eqn=site.index,
+                    primitive=name,
+                    message=(
+                        f"{name} over axis {unknown} but the SpmdGPipe "
+                        f"mesh has axes {sorted(mesh_axes)}"
+                    ),
+                ))
+            if (
+                name in jx.REDUCING_COLLECTIVE_PRIMS
+                and trace.pp_axis in axes
+                and site.within("scan")
+            ):
+                out.append(Finding(
+                    rule="collective-mismatch",
+                    severity=Severity.ERROR,
+                    path=prog.path,
+                    eqn=site.index,
+                    primitive=name,
+                    message=(
+                        f"{name} reduces over the pipeline axis "
+                        f"{trace.pp_axis!r} inside the schedule loop — at "
+                        "any tick the pp lanes hold DIFFERENT micro-"
+                        "batches, so a mid-schedule reduction mixes "
+                        "unrelated cells; reduce over dp/tp/ep instead, "
+                        "or after the schedule drains"
+                    ),
+                ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# recompilation-hazard                                                  #
+# --------------------------------------------------------------------- #
+
+
+def _check_recompilation(trace: PipelineTrace) -> List[Finding]:
+    sigs = trace.mb_signatures
+    distinct = sorted({s for s in sigs}, key=str)
+    if len(distinct) <= 1:
+        return []
+    shapes = [
+        " x ".join(f"{list(sh)}:{dt}" for _, sh, dt in sig)
+        for sig in distinct
+    ]
+    return [Finding(
+        rule="recompilation-hazard",
+        severity=Severity.WARNING,
+        path="scatter",
+        message=(
+            f"{len(sigs)} micro-batches carry {len(distinct)} distinct "
+            f"shape signatures ({'; '.join(shapes)}): every stage compiles "
+            f"{len(distinct)} programs instead of 1, and each new batch "
+            "size recompiles again — pad the batch to a multiple of "
+            f"chunks={trace.chunks} (the SPMD engine's masked path does "
+            "this automatically)"
+        ),
+    )]
+
+
+# --------------------------------------------------------------------- #
+# host-sync-in-loop                                                     #
+# --------------------------------------------------------------------- #
+
+
+def _check_host_sync(trace: PipelineTrace) -> List[Finding]:
+    out: List[Finding] = []
+    for prog in trace.programs:
+        for site in jx.walk_eqns(prog.jaxpr.jaxpr):
+            name = site.eqn.primitive.name
+            if name not in jx.HOST_CALLBACK_PRIMS:
+                continue
+            if prog.kind in (SPMD_TRAIN, FUSED_TRAIN):
+                in_loop = site.within_any(jx.LOOP_PRIMS)
+                out.append(Finding(
+                    rule="host-sync-in-loop",
+                    severity=Severity.ERROR if in_loop else Severity.WARNING,
+                    path=prog.path,
+                    eqn=site.index,
+                    primitive=name,
+                    message=(
+                        f"{name} inside the pipelined loop body — every "
+                        "tick round-trips to the Python host, serializing "
+                        "the device stream (the schedule's overlap is lost)"
+                        if in_loop
+                        else f"{name} in the compiled step — each call "
+                        "synchronizes with the Python host once per step"
+                    ),
+                ))
+            elif prog.kind == STAGE_FORWARD:
+                out.append(Finding(
+                    rule="host-sync-in-loop",
+                    severity=Severity.WARNING,
+                    path=prog.path,
+                    eqn=site.index,
+                    primitive=name,
+                    message=(
+                        f"{name} in a stage program — it fires once per "
+                        "CELL (m micro-batches x this stage, every step), "
+                        "and each firing blocks JAX's async dispatch, "
+                        "which is what hides the MPMD schedule's latency"
+                    ),
+                ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# dead-code (dead outputs / unused params)                              #
+# --------------------------------------------------------------------- #
+
+
+def _dce(closed: Any) -> Optional[Tuple[Any, List[bool]]]:
+    """jax's own recursive DCE: (pruned jaxpr, per-invar used mask)."""
+    try:
+        from jax._src.interpreters import partial_eval as pe
+    except Exception:  # pragma: no cover - version fallback
+        try:
+            from jax.interpreters import partial_eval as pe
+        except Exception:
+            return None
+    try:
+        return pe.dce_jaxpr(
+            closed.jaxpr, [True] * len(closed.jaxpr.outvars)
+        )
+    except Exception:  # pragma: no cover - DCE is best-effort
+        return None
+
+
+def _first_dead_matmul(jaxpr: Any) -> Optional[Tuple[int, str, Tuple[str, ...]]]:
+    """Local liveness walk for an anchor: the first equation (any depth)
+    whose outputs are never consumed and whose primitive is compute-heavy."""
+    best: Optional[Tuple[int, str, Tuple[str, ...]]] = None
+    for sub in jx.iter_jaxprs(jaxpr):
+        live = {v for v in sub.outvars if type(v).__name__ != "Literal"}
+        dead_sites: List[Tuple[int, Any]] = []
+        for i in range(len(sub.eqns) - 1, -1, -1):
+            eqn = sub.eqns[i]
+            outs = [o for o in eqn.outvars if type(o).__name__ == "Var"]
+            if getattr(eqn, "effects", None) or any(o in live for o in outs):
+                for v in eqn.invars:
+                    if type(v).__name__ == "Var":
+                        live.add(v)
+            else:
+                dead_sites.append((i, eqn))
+        for i, eqn in dead_sites:
+            if eqn.primitive.name in jx.MATMUL_PRIMS:
+                cand = (i, eqn.primitive.name, ())
+                if best is None:
+                    best = cand
+    return best
+
+
+def _check_dead_code(trace: PipelineTrace) -> List[Finding]:
+    out: List[Finding] = []
+    kinds = (STAGE_FORWARD, SPMD_TRAIN)
+    for prog in trace.programs:
+        if prog.kind not in kinds:
+            continue
+        res = _dce(prog.jaxpr)
+        if res is None:
+            continue
+        pruned, used = res
+        # Unused parameter leaves: the first len(param_leaf_names) invars
+        # are the flattened params (trace.py keeps them first).
+        names = prog.param_leaf_names or ()
+        for i, name in enumerate(names):
+            if i < len(used) and not used[i]:
+                out.append(Finding(
+                    rule="dead-code",
+                    severity=Severity.WARNING,
+                    path=prog.path,
+                    message=(
+                        f"parameter leaf {name} is never read by the "
+                        "program — it still occupies device memory and "
+                        "optimizer state (and under FSDP, gather "
+                        "bandwidth) every step"
+                    ),
+                ))
+        # Dead compute: compare compute-heavy primitive counts before and
+        # after jax's recursive DCE.
+        before = jx.prim_counts(prog.jaxpr.jaxpr, jx.MATMUL_PRIMS)
+        after = jx.prim_counts(pruned, jx.MATMUL_PRIMS)
+        for prim in jx.MATMUL_PRIMS:
+            n_dead = before[prim] - after[prim]
+            if n_dead > 0:
+                anchor = _first_dead_matmul(prog.jaxpr.jaxpr)
+                out.append(Finding(
+                    rule="dead-code",
+                    severity=Severity.WARNING,
+                    path=prog.path,
+                    eqn=anchor[0] if anchor else None,
+                    primitive=prim,
+                    message=(
+                        f"{n_dead} {prim} equation(s) compute outputs "
+                        "nothing consumes (dead-code elimination removes "
+                        "them, but on the per-cell MPMD path each stage "
+                        "still traces, compiles and schedules them; "
+                        "drop the dead branch from the layer)"
+                    ),
+                ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# registry + runner                                                     #
+# --------------------------------------------------------------------- #
+
+RULES: List[Rule] = [
+    Rule(
+        "remat-coverage",
+        "checkpoint-configured stages must contain remat regions whose "
+        "recompute body matches the forward body",
+        _check_remat_coverage,
+    ),
+    Rule(
+        "precision-drift",
+        "under a low-precision compute policy, no float32 matmuls in "
+        "compute regions and no low-precision norm statistics",
+        _check_precision_drift,
+    ),
+    Rule(
+        "collective-mismatch",
+        "collective axis names must exist in the mesh; no reductions over "
+        "the pipeline axis inside the schedule loop",
+        _check_collective_mismatch,
+    ),
+    Rule(
+        "recompilation-hazard",
+        "micro-batches must share one shape signature (one compiled "
+        "program per stage)",
+        _check_recompilation,
+    ),
+    Rule(
+        "host-sync-in-loop",
+        "no host callbacks inside the pipelined body",
+        _check_host_sync,
+    ),
+    Rule(
+        "dead-code",
+        "no unused parameter leaves, no dead compute-heavy equations",
+        _check_dead_code,
+    ),
+]
+
+RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULES}
+
+
+def register_rule(rule: Rule) -> Rule:
+    """Add a custom rule to the registry (it then runs by default and is
+    selectable by name in ``lint(rules=...)`` and the CLI's ``--rules``)."""
+    if rule.name in RULES_BY_NAME:
+        raise ValueError(f"rule {rule.name!r} is already registered")
+    RULES.append(rule)
+    RULES_BY_NAME[rule.name] = rule
+    return rule
+
+
+def validate_rule_names(rules: Optional[Sequence[str]]) -> None:
+    """Raise a didactic error for unknown rule names (shared by the API —
+    BEFORE the expensive trace — and the CLI)."""
+    if rules is None:
+        return
+    unknown = [r for r in rules if r not in RULES_BY_NAME]
+    if unknown:
+        raise ValueError(
+            f"unknown lint rule(s) {unknown}; known rules: "
+            f"{', '.join(sorted(RULES_BY_NAME))}"
+        )
+
+
+def run_rules(
+    trace: PipelineTrace, rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Run the selected rules (default: all) over a trace.
+
+    Trace-time failures (``trace.errors``) are included — filtered to the
+    selected rules, except ``trace-error`` findings which always surface
+    (a program that cannot trace cannot be linted).
+    """
+    validate_rule_names(rules)
+    selected = (
+        list(RULES)
+        if rules is None
+        else [RULES_BY_NAME[name] for name in rules]
+    )
+    names = {r.name for r in selected}
+    out = [
+        f
+        for f in trace.errors
+        if f.rule == "trace-error" or f.rule in names
+    ]
+    for rule in selected:
+        out.extend(rule.check(trace))
+    return out

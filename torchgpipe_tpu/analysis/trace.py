@@ -1,0 +1,350 @@
+"""Abstract tracing of pipeline engines to jaxprs — no execution.
+
+Everything here runs under ``jax.eval_shape`` / ``jax.make_jaxpr``: a full
+production model traces in seconds on any host, with no device compute and
+no XLA compile — the point of linting *before* a 30-minute TPU session.
+
+Produces a :class:`PipelineTrace`: the traced programs (each anchored by a
+``path`` like ``stage1/forward`` or ``spmd/train``), the engine
+configuration the rules cross-check against (checkpoint mode, compute
+dtype, mesh axes), and per-micro-batch input signatures.  Trace *failures*
+are not exceptions but findings (e.g. an unbound collective axis name
+surfaces as a ``collective-mismatch`` error with the axis parsed out of
+jax's message).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from torchgpipe_tpu import microbatch
+from torchgpipe_tpu.analysis.diagnostics import Finding, Severity
+from torchgpipe_tpu.checkpoint import checkpoint_stop
+
+Pytree = Any
+
+# Traced-program kinds (rules dispatch on these).
+STAGE_FORWARD = "stage_forward"  # plain per-stage forward (MPMD)
+STAGE_CKPT = "stage_ckpt"  # checkpointed (no-residual) forward (MPMD)
+STAGE_RECOMPUTE = "stage_recompute"  # vjp-rebuilding recompute (MPMD)
+FUSED_TRAIN = "fused_train"  # whole fill-drain step as one program (MPMD)
+SPMD_TRAIN = "spmd_train"  # the SPMD engine's compiled train step
+
+
+@dataclasses.dataclass(frozen=True)
+class TracedProgram:
+    """One jaxpr plus its diagnostic anchor and rule-relevant context."""
+
+    path: str  # anchor, e.g. "stage0/forward", "spmd/train"
+    kind: str
+    jaxpr: Any  # ClosedJaxpr
+    stage: Optional[int] = None
+    # For the unused-param rule: the first ``len(param_leaf_names)`` invars
+    # of ``jaxpr`` correspond 1:1 to these flattened parameter leaves.
+    param_leaf_names: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass
+class PipelineTrace:
+    """Everything the rule engine sees about one pipeline."""
+
+    engine: str  # "mpmd" | "spmd"
+    pipe: Any  # the GPipe / SpmdGPipe instance
+    programs: List[TracedProgram]
+    chunks: int
+    checkpoint: str
+    n_stages: int
+    compute_dtype: Optional[Any] = None  # GPipe mixed-precision policy
+    mesh_axes: Tuple[str, ...] = ()  # SPMD mesh axis names
+    pp_axis: Optional[str] = None
+    # Per-micro-batch input signatures: one tuple of (leaf-path, shape,
+    # dtype-name) triples per micro-batch, in schedule order.
+    mb_signatures: List[Tuple] = dataclasses.field(default_factory=list)
+    # Trace-time failures, already converted to findings.
+    errors: List[Finding] = dataclasses.field(default_factory=list)
+
+    def by_kind(self, kind: str) -> List[TracedProgram]:
+        return [p for p in self.programs if p.kind == kind]
+
+    def stage_program(self, kind: str, stage: int) -> Optional[TracedProgram]:
+        for p in self.programs:
+            if p.kind == kind and p.stage == stage:
+                return p
+        return None
+
+
+def _avalify(tree: Pytree) -> Pytree:
+    """Arrays (or anything shaped) -> ShapeDtypeStruct; avals pass through."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+        if hasattr(a, "shape") and hasattr(a, "dtype")
+        else a,
+        tree,
+    )
+
+
+def _leaf_names(tree: Pytree, prefix: str = "") -> Tuple[str, ...]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return tuple(prefix + jax.tree_util.keystr(path) for path, _ in flat)
+
+
+def _signature(tree: Pytree) -> Tuple:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return tuple(
+        (jax.tree_util.keystr(path), tuple(leaf.shape), str(leaf.dtype))
+        for path, leaf in flat
+    )
+
+
+_UNBOUND_AXIS_RE = re.compile(r"unbound axis name:?\s*([\w./-]+)")
+
+
+def _trace_failure_finding(path: str, exc: Exception) -> Finding:
+    """Convert a trace-time exception into a diagnostic finding."""
+    m = _UNBOUND_AXIS_RE.search(str(exc))
+    if m is not None:
+        return Finding(
+            rule="collective-mismatch",
+            severity=Severity.ERROR,
+            path=path,
+            message=(
+                f"collective over axis {m.group(1)!r} which is bound by no "
+                "enclosing mesh — a psum/ppermute/all_gather axis name must "
+                "name a mesh axis of the engine it runs under"
+            ),
+        )
+    return Finding(
+        rule="trace-error",
+        severity=Severity.ERROR,
+        path=path,
+        message=f"abstract trace failed: {type(exc).__name__}: {exc}",
+    )
+
+
+def _try_trace(
+    trace: "PipelineTrace",
+    path: str,
+    kind: str,
+    fn: Callable,
+    args: Tuple,
+    stage: Optional[int] = None,
+    param_leaf_names: Optional[Tuple[str, ...]] = None,
+) -> Optional[TracedProgram]:
+    try:
+        jaxpr = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 — converted to a finding
+        trace.errors.append(_trace_failure_finding(path, e))
+        return None
+    prog = TracedProgram(
+        path=path,
+        kind=kind,
+        jaxpr=jaxpr,
+        stage=stage,
+        param_leaf_names=param_leaf_names,
+    )
+    trace.programs.append(prog)
+    return prog
+
+
+# --------------------------------------------------------------------- #
+# MPMD (GPipe) tracing                                                  #
+# --------------------------------------------------------------------- #
+
+
+def _stage_param_names(stage: Any, params_j: Pytree) -> Tuple[str, ...]:
+    """Flattened param-leaf names for one stage, prefixed by layer name."""
+    names: List[str] = []
+    for li, layer in enumerate(stage.layers):
+        names.extend(_leaf_names(params_j[li], prefix=layer.name))
+    return tuple(names)
+
+
+def trace_gpipe(
+    model: Any,
+    sample_input: Pytree,
+    target: Optional[Pytree] = None,
+    loss_fn: Optional[Callable] = None,
+) -> PipelineTrace:
+    """Abstractly trace a :class:`~torchgpipe_tpu.gpipe.GPipe` pipeline.
+
+    Per stage: the plain forward, and (when the checkpoint mode covers any
+    micro-batch) the checkpointed forward and the recompute — the three
+    programs the scheduler actually dispatches.  With ``target`` and a
+    plain-callable ``loss_fn`` also the whole fill-drain step as ONE fused
+    program (the per-cell remat structure the fused engine compiles, and
+    the per-mode remat-count oracle).
+    """
+    x_spec = _avalify(sample_input)
+    trace = PipelineTrace(
+        engine="mpmd",
+        pipe=model,
+        programs=[],
+        chunks=model.chunks,
+        checkpoint=model.checkpoint,
+        n_stages=len(model.partitions),
+        compute_dtype=model.compute_dtype,
+    )
+    try:
+        params_spec, state_spec = jax.eval_shape(
+            lambda r: model.init(r, x_spec), jax.random.PRNGKey(0)
+        )
+    except Exception as e:  # noqa: BLE001 — converted to a finding
+        trace.errors.append(_trace_failure_finding("init", e))
+        return trace
+
+    try:
+        mb_specs = jax.eval_shape(
+            lambda x: microbatch.scatter(x, model.chunks), x_spec
+        )
+    except Exception as e:  # noqa: BLE001 — converted to a finding
+        trace.errors.append(_trace_failure_finding("scatter", e))
+        return trace
+    trace.mb_signatures = [_signature(mb) for mb in mb_specs]
+
+    m = len(mb_specs)
+    stop = checkpoint_stop(model.checkpoint, m, train=True)
+    stages = model._pipeline.stages
+
+    # Chain stage input specs through the forward schedule (micro-batch 0),
+    # tracking cross-stage skip specs like the scheduler routes values.
+    act = mb_specs[0]
+    skip_specs: Dict = {}
+    for j, stage in enumerate(stages):
+        skips_in = {k: skip_specs.pop(k) for k in stage.ext_pop_keys}
+        pnames = _stage_param_names(stage, params_spec[j])
+        args = (params_spec[j], state_spec[j], act, skips_in, None, 1.0)
+        _try_trace(
+            trace,
+            f"stage{j}/forward",
+            STAGE_FORWARD,
+            stage.fwd_train,
+            args,
+            stage=j,
+            param_leaf_names=pnames,
+        )
+        if stop > 0:
+            _try_trace(
+                trace, f"stage{j}/checkpoint", STAGE_CKPT,
+                stage.fwd_ckpt, args, stage=j,
+            )
+            _try_trace(
+                trace, f"stage{j}/recompute", STAGE_RECOMPUTE,
+                stage.fwd_recompute, args, stage=j,
+            )
+        try:
+            y, ext, _ = jax.eval_shape(stage.fwd_train, *args)
+        except Exception as e:  # noqa: BLE001 — converted to a finding
+            trace.errors.append(_trace_failure_finding(f"stage{j}", e))
+            return trace
+        for k, v in ext.items():
+            skip_specs[k] = v
+        act = y
+
+    # Whole-step fused program (remat-count oracle for the fill-drain
+    # schedule; skipped for 1F1B and parametric loss layers, which the
+    # fused builder cannot express).
+    from torchgpipe_tpu.layers import Layer
+
+    if (
+        target is not None
+        and loss_fn is not None
+        and not isinstance(loss_fn, Layer)
+        and model.schedule == "gpipe"
+    ):
+        step = model._pipeline._build_train_fused(m, loss_fn, stop)
+        _try_trace(
+            trace,
+            "pipeline/train",
+            FUSED_TRAIN,
+            step,
+            (params_spec, state_spec, mb_specs, _avalify(target)),
+        )
+    return trace
+
+
+# --------------------------------------------------------------------- #
+# SPMD tracing                                                          #
+# --------------------------------------------------------------------- #
+
+
+def trace_spmd(
+    pipe: Any,
+    sample_input: Pytree,
+    target: Optional[Pytree] = None,
+) -> PipelineTrace:
+    """Abstractly trace a :class:`~torchgpipe_tpu.spmd.SpmdGPipe` program.
+
+    One program: the full compiled training step (``spmd/train``) — the
+    schedule scan, ring ppermutes, remat regions, collectives and the
+    head/loss epilogue all live in its jaxpr.  ``target`` defaults to the
+    sample input (the LM convention: next-token labels shaped like the
+    tokens).
+    """
+    x_spec = _avalify(sample_input)
+    tgt_spec = _avalify(target) if target is not None else x_spec
+    trace = PipelineTrace(
+        engine="spmd",
+        pipe=pipe,
+        programs=[],
+        chunks=pipe.chunks,
+        checkpoint=pipe.checkpoint,
+        n_stages=pipe.n_stages,
+        mesh_axes=tuple(str(a) for a in pipe.mesh.axis_names),
+        pp_axis=pipe.pp_axis,
+    )
+    try:
+        params_spec = jax.eval_shape(
+            lambda r: pipe._init_host(r, x_spec), jax.random.PRNGKey(0)
+        )
+    except Exception as e:  # noqa: BLE001 — converted to a finding
+        trace.errors.append(_trace_failure_finding("spmd/init", e))
+        return trace
+    if pipe.fsdp:
+        # Normally resolved by place(); the abstract trace never places,
+        # and leaf shard dims only need shapes, which the specs carry.
+        pipe._ensure_fsdp(params_spec["blocks"])
+    try:
+        x_mb = jax.eval_shape(
+            lambda x: microbatch.scatter_stacked(x, pipe.chunks), x_spec
+        )
+        tgt_mb = jax.eval_shape(
+            lambda x: microbatch.scatter_stacked(x, pipe.chunks), tgt_spec
+        )
+    except Exception as e:  # noqa: BLE001 — converted to a finding
+        trace.errors.append(_trace_failure_finding("spmd/scatter", e))
+        return trace
+    trace.mb_signatures = [_signature(x_mb)]
+
+    fn = pipe._build_train_step(use_rng=False)
+    _try_trace(
+        trace,
+        "spmd/train",
+        SPMD_TRAIN,
+        lambda p, a, b: fn(p, a, b),
+        (params_spec, x_mb, tgt_mb),
+        param_leaf_names=_leaf_names(params_spec),
+    )
+    return trace
+
+
+def trace_pipeline(
+    pipe: Any,
+    sample_input: Pytree,
+    target: Optional[Pytree] = None,
+    loss_fn: Optional[Callable] = None,
+) -> PipelineTrace:
+    """Dispatch on the engine type (GPipe -> MPMD, SpmdGPipe -> SPMD)."""
+    from torchgpipe_tpu.gpipe import GPipe
+    from torchgpipe_tpu.spmd import SpmdGPipe
+
+    if isinstance(pipe, SpmdGPipe):
+        return trace_spmd(pipe, sample_input, target)
+    if isinstance(pipe, GPipe):
+        return trace_gpipe(pipe, sample_input, target, loss_fn)
+    raise TypeError(
+        f"lint target must be a GPipe or SpmdGPipe, got {type(pipe).__name__}"
+    )

@@ -338,15 +338,28 @@ def _classify_bwd_recv(
     return tc * S + pi % S, valid
 
 
-def _shard_map(fn: Callable, mesh: Mesh, in_specs: Any, out_specs: Any) -> Callable:
+def shard_map_compat(
+    fn: Callable, mesh: Mesh, in_specs: Any, out_specs: Any
+) -> Callable:
+    """``jax.shard_map`` across jax versions: the top-level spelling with
+    ``check_vma`` (0.5+), falling back to ``jax.experimental.shard_map``
+    with ``check_rep`` (0.4.x).  Replication checking is disabled either
+    way — the engines' ring programs are intentionally lane-varying."""
     try:
-        return jax.shard_map(
+        sm = jax.shard_map
+    except AttributeError:  # pre-0.5 jax: experimental spelling only
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(
             fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
         )
     except TypeError:  # older jax spelling
-        return jax.shard_map(
+        return sm(
             fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
         )
+
+
+_shard_map = shard_map_compat
 
 
 @dataclasses.dataclass
