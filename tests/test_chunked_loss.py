@@ -258,7 +258,7 @@ def test_eval_loss_interleaved_and_never_gathers_logits():
 
     # Memory: the mapped eval program must NOT materialize a gathered
     # [B, seq, vocab] logits tensor (per-micro-batch loss consumes 1/m).
-    fn = eng._eval_fn
+    fn = eng._eval_fns[None]  # no fault plan active
     x_mb = mb.scatter_stacked(tokens, m)
     t_mb = mb.scatter_stacked(labels, m)
     ma = fn.lower(p, x_mb, t_mb).compile().memory_analysis()

@@ -104,3 +104,68 @@ def test_forward_only_also_propagates():
         model.apply(params, state, x)
     notes = "".join(getattr(excinfo.value, "__notes__", []))
     assert "stage 1" in notes, notes
+
+
+# --------------------------------------------------------------------- #
+# SPMD engine: same semantics, mirrored parametrization                 #
+# --------------------------------------------------------------------- #
+
+
+def _build_spmd(armed, schedule="fill_drain"):
+    from torchgpipe_tpu.layers import chain
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    block = chain([dense(8, name="fc"), armable_bomb(armed)], name="blk")
+    kwargs = {}
+    if schedule != "fill_drain":
+        kwargs["loss_reduction"] = "mean"
+    pipe = SpmdGPipe(
+        block, 2, make_mesh(2, 2), chunks=2, loss_fn=_mse, dp_axis="dp",
+        schedule=schedule, **kwargs,
+    )
+    x = jnp.ones((8, 8))
+    y = jnp.zeros((8, 8))
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    return pipe, params, x, y
+
+
+@notes_supported
+@pytest.mark.parametrize("schedule", ["fill_drain", "1f1b"])
+def test_spmd_exception_propagates_naming_the_cell(schedule):
+    """A partition exception under SpmdGPipe propagates with its type
+    preserved plus a (stage, micro-batch) note.  The SPMD schedule traces
+    each cell once, so a Python exception is necessarily cell-uniform;
+    the engine localizes it by abstract re-evaluation and names the FIRST
+    failing cell — stage 0, micro-batch 0 (see
+    SpmdGPipe._annotate_cell_failure)."""
+    armed = {"on": False}
+    pipe, params, x, y = _build_spmd(armed, schedule)
+    armed["on"] = True
+    with pytest.raises(ExpectedError) as excinfo:
+        pipe.train_step(params, x, y)
+    notes = "".join(getattr(excinfo.value, "__notes__", []))
+    assert "stage 0" in notes, notes
+    assert "micro-batch 0" in notes, notes
+
+
+@notes_supported
+def test_spmd_forward_only_also_propagates():
+    armed = {"on": False}
+    pipe, params, x, _ = _build_spmd(armed)
+    armed["on"] = True
+    with pytest.raises(ExpectedError) as excinfo:
+        pipe.apply(params, x)
+    notes = "".join(getattr(excinfo.value, "__notes__", []))
+    assert "stage 0" in notes, notes
+
+
+def test_spmd_exception_type_preserved_without_notes():
+    """On every Python version (3.10 lacks PEP 678 notes) the original
+    exception type still propagates from the traced SPMD program."""
+    armed = {"on": False}
+    pipe, params, x, y = _build_spmd(armed)
+    armed["on"] = True
+    with pytest.raises(ExpectedError):
+        pipe.train_step(params, x, y)

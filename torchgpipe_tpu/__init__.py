@@ -7,7 +7,9 @@ hand-off rides ICI, recomputation uses counter-based RNG, and the SPMD engine
 expresses the whole schedule as one compiled ``shard_map`` program.
 
 Public API (reference: torchgpipe/__init__.py:1-6 exports ``GPipe``,
-``is_checkpointing``, ``is_recomputing``).
+``is_checkpointing``, ``is_recomputing``).  Long-run production concerns
+(crash-safe checkpointing, guarded steps, preemption, fault injection)
+live in :mod:`torchgpipe_tpu.resilience`.
 """
 
 from torchgpipe_tpu.checkpoint import is_checkpointing, is_recomputing

@@ -44,6 +44,31 @@ Payload = Any
 ChannelKey = Tuple[Any, int]
 
 
+class PeerDiedError(TimeoutError):
+    """A peer rank is confirmed dead (not merely slow).
+
+    Refines the bare receive ``TimeoutError`` when the expected sender
+    fails a liveness probe (``transport.is_alive``): unregistered from a
+    :class:`LocalTransport`, or its :class:`TcpTransport` listener
+    refusing connections.  Names the dead rank so the operator (or an
+    external supervisor) knows WHICH worker to restart.  Subclasses
+    ``TimeoutError`` so existing dead-peers-surface-as-named-timeouts
+    handling keeps working — but :func:`torchgpipe_tpu.resilience.guard.
+    classify_error` special-cases it FIRST as fatal (plain timeouts are
+    transient): channels may hold stale messages and peers partial sends,
+    so recovery is restart-and-resume from a checkpoint, not an
+    in-process retry.
+    """
+
+    def __init__(self, rank: int, worker: str, detail: str = "") -> None:
+        self.rank = rank
+        self.worker = worker
+        super().__init__(
+            f"peer rank {rank} ({worker!r}) is dead"
+            + (f": {detail}" if detail else "")
+        )
+
+
 class Mailbox:
     """Blocking channels keyed by ``(kind, micro-batch index)``.
 
@@ -106,6 +131,11 @@ class LocalTransport:
                 f"unknown worker {dst!r}; registered: {sorted(self._mailboxes)}"
             ) from None
         box.put(kind, index, payload)
+
+    def is_alive(self, name: str) -> bool:
+        """Liveness = still registered (a dead in-process rank unregisters
+        via the :func:`worker` context manager's finally block)."""
+        return name in self._mailboxes
 
 
 def _to_host(tree: Payload) -> Payload:
@@ -189,8 +219,16 @@ class TcpTransport:
         # connect_timeout instead of crashing the first sender.
         deadline = time.monotonic() + self.connect_timeout
         while True:
+            # Clamp each attempt to the REMAINING deadline budget: a bare
+            # 30s per-attempt timeout could overshoot connect_timeout by up
+            # to 30s when the last attempt starts just before the deadline
+            # (SYNs silently dropped, not refused).
+            remaining = deadline - time.monotonic()
+            per_attempt = min(30.0, max(remaining, 0.01))
             try:
-                sock = socket.create_connection((host, port), timeout=30)
+                sock = socket.create_connection(
+                    (host, port), timeout=per_attempt
+                )
                 break
             except (ConnectionRefusedError, ConnectionResetError,
                     ConnectionAbortedError, socket.timeout) as err:
@@ -226,6 +264,25 @@ class TcpTransport:
                     f"{dst!r} did not complete within {self.send_timeout}s "
                     "— is that rank still consuming?"
                 ) from None
+
+    def is_alive(self, name: str, *, probe_timeout: float = 2.0) -> bool:
+        """Liveness probe: can ``name``'s listener accept a connection?
+
+        Used by :class:`~torchgpipe_tpu.distributed.gpipe.DistributedGPipe`
+        to turn a receive timeout into a :class:`PeerDiedError` naming the
+        rank when the peer is confirmed gone (connection refused/ignored),
+        rather than merely busy.  A connected-then-closed probe is
+        harmless to the peer: its handler reads a length header, sees EOF,
+        and returns (see ``_MsgHandler.handle``).
+        """
+        if name == self.name:
+            return True
+        host, port = self.addresses[name]
+        try:
+            with socket.create_connection((host, port), timeout=probe_timeout):
+                return True
+        except OSError:
+            return False
 
     def close(self) -> None:
         self._server.shutdown()

@@ -36,10 +36,51 @@ from torchgpipe_tpu.batchnorm import convert_deferred_batch_norm
 from torchgpipe_tpu.checkpoint import CHECKPOINT_MODES, checkpoint_stop
 from torchgpipe_tpu.layers import Layer, sequential_specs
 from torchgpipe_tpu.partition import split_layers, verify_module
+from torchgpipe_tpu.distributed.context import PeerDiedError
 from torchgpipe_tpu.pipeline import LossGradRunner, StageExec
+from torchgpipe_tpu.resilience import faults as _faults
 from torchgpipe_tpu.skip import inspect_skip_layout, verify_skippables
 
 Pytree = Any
+
+
+def _recv_probing_peer(
+    mailbox: Any,
+    transport: Any,
+    kind: Any,
+    index: int,
+    timeout: Optional[float],
+    src_rank: int,
+    workers: Sequence[str],
+) -> Pytree:
+    """Mailbox receive that converts a timeout into a
+    :class:`~torchgpipe_tpu.distributed.context.PeerDiedError` when the
+    expected sender fails the transport's liveness probe.
+
+    A bare ``TimeoutError`` cannot distinguish "rank 2 is compiling its
+    stage" from "rank 2 was OOM-killed an hour ago"; probing on timeout
+    (and only then — zero steady-state cost) names the dead rank so the
+    supervisor restarts the right process.  A slow-but-alive peer still
+    surfaces as the original ``TimeoutError``.
+    """
+    try:
+        return mailbox.get(kind, index, timeout=timeout)
+    except TimeoutError as err:
+        name = workers[src_rank]
+        probe = getattr(transport, "is_alive", None)
+        if probe is not None:
+            try:
+                alive = bool(probe(name))
+            except Exception:  # noqa: BLE001 — a broken probe must not
+                alive = True   # mask the original timeout
+            if not alive:
+                raise PeerDiedError(
+                    src_rank,
+                    name,
+                    f"no message on channel {(kind, index)!r} within "
+                    f"{timeout}s and its transport endpoint is gone",
+                ) from err
+        raise
 
 
 class DistributedGPipe:
@@ -133,10 +174,18 @@ class DistributedGPipe:
     def is_last(self) -> bool:
         return self.rank == len(self.workers) - 1
 
-    def _recv(self, kind: str, index: int) -> Pytree:
-        """Deadline-bounded mailbox receive placed on this rank's device."""
+    def _recv(self, kind: Any, index: int, src_rank: int) -> Pytree:
+        """Deadline-bounded mailbox receive placed on this rank's device.
+
+        ``src_rank`` names the expected sender; on timeout it is probed
+        for liveness so a dead peer raises a clean
+        :class:`~torchgpipe_tpu.distributed.context.PeerDiedError` naming
+        the rank instead of an anonymous timeout."""
         return jax.device_put(
-            self.mailbox.get(kind, index, timeout=self.recv_timeout),
+            _recv_probing_peer(
+                self.mailbox, self.transport, kind, index,
+                self.recv_timeout, src_rank, self.workers,
+            ),
             self.device,
         )
 
@@ -202,7 +251,12 @@ class DistributedGPipe:
             if batch is not None:
                 raise ValueError("only rank 0 feeds the input batch")
             mbatches = None
-            m = int(self.mailbox.get("meta", 0, timeout=self.recv_timeout))
+            m = int(
+                _recv_probing_peer(
+                    self.mailbox, self.transport, "meta", 0,
+                    self.recv_timeout, 0, self.workers,
+                )
+            )
 
         stop = checkpoint_stop(self.checkpoint, m, train=train)
         stage = self.stage
@@ -215,9 +269,11 @@ class DistributedGPipe:
             if self.is_first:
                 x = mbatches[i]
             else:
-                x = self._recv("forward", i)
+                x = self._recv("forward", i, self.rank - 1)
+            x = _faults.corrupt_cell_input(self.rank, i, x)
             skips_in = {
-                k: self._recv(("skip", k), i) for k in stage.ext_pop_keys
+                k: self._recv(("skip", k), i, self._skip_stash_rank[k])
+                for k in stage.ext_pop_keys
             }
             rng_i = jax.random.fold_in(rng, i) if rng is not None else None
             if train and i < stop:
@@ -312,9 +368,9 @@ class DistributedGPipe:
             if self.is_last:
                 gy = grad_outputs[i]
             else:
-                gy = self._recv("backward", i)
+                gy = self._recv("backward", i, self.rank + 1)
             gext = {
-                k: self._recv(("skip_grad", k), i)
+                k: self._recv(("skip_grad", k), i, self._skip_pop_rank[k])
                 for k in stage.ext_stash_keys
             }
             if i in ctx["saved"]:
@@ -386,8 +442,9 @@ class DistributedGPipeDataLoader:
                     yield data, target
         elif self.rank == last:
             for step in range(self.num_batches):
-                target = self.mailbox.get(
-                    "target", step, timeout=self.recv_timeout
+                target = _recv_probing_peer(
+                    self.mailbox, self.transport, "target", step,
+                    self.recv_timeout, 0, self.workers,
                 )
                 yield None, target
         else:

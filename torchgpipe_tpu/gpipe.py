@@ -377,7 +377,7 @@ class GPipe:
         )
 
     def make_train_step(
-        self, optimizer: Any, loss_fn: Any
+        self, optimizer: Any, loss_fn: Any, *, donate: bool = True
     ) -> Any:
         """Training step with the optimizer applied PER STAGE.
 
@@ -412,7 +412,10 @@ class GPipe:
         # matching the SPMD twin's donate=True.  Callers must treat the
         # passed-in params/opt_state as consumed (standard donation
         # contract; XLA ignores donation where unsupported, e.g. CPU).
-        upd = jax.jit(_upd, donate_argnums=(1, 2))
+        # Pass donate=False when the OLD params must survive the call —
+        # the resilience.StepGuard skip-step contract restores them after
+        # a non-finite update.
+        upd = jax.jit(_upd, donate_argnums=(1, 2) if donate else ())
 
         def step(
             params: Tuple[Pytree, ...],

@@ -39,6 +39,7 @@ from torchgpipe_tpu import checkpoint as ckpt
 from torchgpipe_tpu import microbatch
 from torchgpipe_tpu.auxgrad import aux_scale
 from torchgpipe_tpu.layers import Layer, apply_layer
+from torchgpipe_tpu.resilience import faults as _faults
 from torchgpipe_tpu.skip.layout import SkipLayout
 
 Pytree = Any
@@ -82,6 +83,19 @@ def clock_cycles(m: int, n: int) -> Iterator[List[Tuple[int, int]]]:
 def _transfer(x: Pytree, device: Any) -> Pytree:
     """Async device-to-device move (ICI on TPU); no-op if already there."""
     return jax.device_put(x, device)
+
+
+def _reject_nan_plan(where: str) -> None:
+    """Fault-injection coverage guard: paths WITHOUT a per-cell poisoning
+    hook must refuse an active ``nan_at`` plan loudly — a chaos test that
+    silently injects nothing would certify recovery code that never ran."""
+    plan = _faults.active_plan()
+    if plan is not None and plan.nan_at is not None:
+        raise NotImplementedError(
+            f"faults.inject(nan_at=...) is not supported under {where}; "
+            "use the per-cell scheduler (fused=False) or the SPMD "
+            "fill_drain schedule"
+        )
 
 
 @contextlib.contextmanager
@@ -331,6 +345,7 @@ class Pipeline:
                 stage = self.stages[j]
                 x = mbatches[i] if j == 0 else acts.pop(i)
                 x = _transfer(x, stage.device)
+                x = _faults.corrupt_cell_input(j, i, x)
                 skips_in = {k: skip_vals.pop((i, k)) for k in stage.ext_pop_keys}
                 rng_i = jax.random.fold_in(rng, i) if rng is not None else None
                 fwd = stage.fwd_train if train else stage.fwd_eval
@@ -388,6 +403,10 @@ class Pipeline:
                 stage = self.stages[j]
                 x = mbatches[i] if j == 0 else acts.pop(i)
                 x = _transfer(x, stage.device)
+                # Deterministic chaos hook (torchgpipe_tpu.resilience.faults):
+                # poisons exactly the planned (stage, micro-batch) cell's
+                # input; no-op unless a plan is active.
+                x = _faults.corrupt_cell_input(j, i, x)
                 skips_in = {k: skip_vals.pop((i, k)) for k in stage.ext_pop_keys}
                 rng_i = jax.random.fold_in(rng, i) if rng is not None else None
                 checkpointed = i < checkpoint_stop
@@ -516,6 +535,7 @@ class Pipeline:
             stage = self.stages[j]
             x = mbatches[i] if j == 0 else acts.pop((i, j - 1))
             x = _transfer(x, stage.device)
+            x = _faults.corrupt_cell_input(j, i, x)
             skips_in = {k: skip_vals.pop((i, k)) for k in stage.ext_pop_keys}
             rng_i = jax.random.fold_in(rng, i) if rng is not None else None
             state_in = cur_states[j]
@@ -757,6 +777,7 @@ class Pipeline:
         dispatch already keeps the chip saturated, and the monolithic
         program compiles far slower), so nothing auto-fuses.
         """
+        _reject_nan_plan("GPipe(fused=True)")
         m = len(mbatches)
         fn = self._fused_jit(
             "train", mbatches, (loss_fn, checkpoint_stop, rng is None),
@@ -777,6 +798,7 @@ class Pipeline:
         train: bool,
     ) -> Tuple[List[Pytree], List[Pytree]]:
         """Forward-only counterpart of :meth:`run_train_fused`."""
+        _reject_nan_plan("GPipe(fused=True)")
         m = len(mbatches)
 
         def build():

@@ -112,6 +112,97 @@ def _convert_leaf(layer: Layer, dtype: Any) -> Layer:
     return _wrap_compute(layer, dtype)
 
 
+# --------------------------------------------------------------------- #
+# dynamic loss scaling                                                  #
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicLossScale:
+    """The standard mixed-precision overflow protocol, as immutable state.
+
+    bfloat16 compute (the policy above) rarely overflows, but float16 or
+    aggressive models can: scale the loss UP before the backward so small
+    gradients survive the low-precision mantissa, divide the gradients
+    back DOWN before the optimizer, and adapt the scale from observed
+    overflows — halve on a non-finite step (which is *skipped*), double
+    after ``growth_interval`` consecutive good steps.
+
+    Two halves, explicitly split:
+
+    * **Scaling** is the CALLER's wiring — this object only provides the
+      helpers.  At the ``value_and_grad`` level::
+
+          ls = guard.loss_scale
+          loss_fn_s = lambda o, t: ls.scale_loss(loss_fn(o, t))
+          loss, grads, state, _ = model.value_and_grad(
+              params, state, x, y, loss_fn_s)
+          grads = ls.unscale(grads)   # BEFORE the optimizer
+
+      (The scale enters the traced program as a Python constant, so the
+      tiny loss program re-traces when the scale changes — rare by
+      construction: on overflow and every ``growth_interval`` steps.)
+      The fused ``make_train_step`` programs take no scale input;
+      wiring a scale there means rebuilding the step on change.
+    * **Adaptation** (``ok()``/``bad()``) is driven by
+      :class:`torchgpipe_tpu.resilience.guard.StepGuard`, whose
+      one-sync ``isfinite`` check per step is exactly the overflow
+      detector this protocol needs.  Passing ``loss_scale=`` to a guard
+      WITHOUT wiring ``scale_loss``/``unscale`` into the loss gives
+      skip-step protection and bookkeeping only — no underflow rescue.
+
+    The state is JSON-serializable via :meth:`state_dict` so checkpoints
+    resume mid-protocol.
+    """
+
+    scale: float = 2.0 ** 15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    min_scale: float = 1.0
+    max_scale: float = 2.0 ** 24
+    good_steps: int = 0
+
+    def scale_loss(self, loss: jax.Array) -> jax.Array:
+        return loss * jnp.asarray(self.scale, dtype=jnp.result_type(loss))
+
+    def unscale(self, grads: Any) -> Any:
+        inv = 1.0 / self.scale
+        return jax.tree_util.tree_map(
+            lambda g: (g * inv).astype(g.dtype)
+            if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.inexact)
+            else g,
+            grads,
+        )
+
+    def ok(self) -> "DynamicLossScale":
+        """One finite step observed: count it, grow on the interval."""
+        good = self.good_steps + 1
+        if good >= self.growth_interval:
+            return dataclasses.replace(
+                self,
+                scale=min(self.scale * self.growth_factor, self.max_scale),
+                good_steps=0,
+            )
+        return dataclasses.replace(self, good_steps=good)
+
+    def bad(self) -> "DynamicLossScale":
+        """One overflowed (skipped) step observed: back off, reset streak."""
+        return dataclasses.replace(
+            self,
+            scale=max(self.scale * self.backoff_factor, self.min_scale),
+            good_steps=0,
+        )
+
+    def state_dict(self) -> dict:
+        """JSON-serializable state (checkpoint metadata)."""
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_state_dict(cls, d: dict) -> "DynamicLossScale":
+        return cls(**d)
+
+
 def apply_policy(
     layers: Sequence[Layer],
     compute_dtype: Any = jnp.bfloat16,

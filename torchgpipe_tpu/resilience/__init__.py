@@ -1,0 +1,66 @@
+"""Resilience: crash-safe checkpoints, guarded steps, preemption, chaos.
+
+The paper-scale promise of pipeline parallelism ("training giant models",
+GPipe arXiv:1811.06965; torchgpipe arXiv:2004.09910) is hours-to-weeks
+jobs on preemptible accelerator fleets — which only pays off if the run
+*survives*: a run must be restartable (atomic versioned checkpoints),
+self-healing (skip NaN steps, retry transient infrastructure errors),
+preemption-aware (SIGTERM -> checkpoint-and-exit), and all of it testable
+(deterministic fault injection).  Each concern is one module:
+
+* :mod:`~torchgpipe_tpu.resilience.checkpoint` —
+  :class:`CheckpointManager`: write-to-temp + fsync + rename snapshots
+  with a checksummed JSON manifest, keep-last-k GC, and
+  ``restore_latest()`` that skips corrupt/partial snapshots.  One format
+  over both engines (flat npz like ``utils.serialization.save``, or
+  orbax-sharded like ``save_sharded``).
+* :mod:`~torchgpipe_tpu.resilience.guard` — :class:`StepGuard`:
+  one-scalar-sync non-finite detection with skip-step +
+  :class:`~torchgpipe_tpu.precision.DynamicLossScale` backoff, and
+  bounded-exponential retry of errors :func:`classify_error` deems
+  transient (model bugs re-raise immediately).
+* :mod:`~torchgpipe_tpu.resilience.preemption` —
+  :class:`PreemptionHandler`: SIGTERM/SIGINT latched into a
+  between-steps flag for cooperative checkpoint-and-exit.
+* :mod:`~torchgpipe_tpu.resilience.faults` — :func:`inject` (NaN at a
+  chosen (stage, micro-batch) in either engine, simulated preemption at
+  step k) and :class:`FaultyTransport` (drop/lose/delay/duplicate sends)
+  — the test harness for the three modules above, and a user-facing
+  chaos tool.
+
+See docs/robustness.md for the end-to-end recovery story.
+"""
+
+from torchgpipe_tpu.resilience.checkpoint import (
+    CheckpointError,
+    CheckpointManager,
+    Snapshot,
+)
+from torchgpipe_tpu.resilience.faults import (
+    FaultPlan,
+    FaultyTransport,
+    SendFault,
+    inject,
+)
+from torchgpipe_tpu.resilience.guard import (
+    GuardPolicy,
+    GuardStats,
+    StepGuard,
+    classify_error,
+)
+from torchgpipe_tpu.resilience.preemption import PreemptionHandler
+
+__all__ = [
+    "CheckpointError",
+    "CheckpointManager",
+    "Snapshot",
+    "FaultPlan",
+    "FaultyTransport",
+    "SendFault",
+    "inject",
+    "GuardPolicy",
+    "GuardStats",
+    "StepGuard",
+    "classify_error",
+    "PreemptionHandler",
+]

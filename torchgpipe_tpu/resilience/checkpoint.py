@@ -1,0 +1,466 @@
+"""Crash-safe, versioned training checkpoints.
+
+The persistence primitives in :mod:`torchgpipe_tpu.utils.serialization`
+write ONE artifact (a flat ``.npz`` or an orbax tree); a long run needs
+more: snapshots that an interrupted write can never corrupt, a history so
+a bad snapshot can be skipped, and garbage collection so the history does
+not eat the disk.  :class:`CheckpointManager` supplies that layer:
+
+* **Atomic**: each snapshot is staged in a hidden temp directory in the
+  SAME filesystem, every file fsync'd, the JSON manifest written LAST,
+  and the directory renamed into place — a crash at any point leaves
+  either the previous complete snapshot set or one invisible temp dir,
+  never a half-written ``step_*`` that :func:`restore_latest` could trust.
+* **Verified**: the manifest records a CRC-32 checksum, shape and dtype
+  per array (npz backend) and per file (sharded backend); restore
+  re-hashes and silently skips any snapshot that fails — including
+  truncation *after* a successful write (disk corruption, partial copy).
+* **Versioned + GC'd**: snapshots live under ``step_<n>``;
+  ``keep_last_k`` complete snapshots are retained, older ones deleted
+  only after a NEWER complete snapshot exists.
+* **One format, both engines**: the payload is any pytree of arrays —
+  a ``GPipe.state_dict`` flat dict, an ``SpmdGPipe`` params tree,
+  optimizer state, rng keys — flattened to the same
+  ``jax.tree_util.keystr`` naming :mod:`utils.serialization` uses.
+  ``sharded=True`` stores the tree through orbax instead (each host
+  writes its own shards; see :func:`utils.serialization.save_sharded`),
+  under the same manifest/GC/restore protocol.
+
+Typical loop (see docs/robustness.md)::
+
+    mgr = CheckpointManager("ckpts", keep_last_k=3)
+    snap = mgr.restore_latest(template={"params": params, "opt": opt_state,
+                                        "step": jnp.zeros((), jnp.int32)})
+    start = int(snap.tree["step"]) + 1 if snap else 0
+    ...
+    mgr.save(step, {"params": params, "opt": opt_state,
+                    "step": jnp.asarray(step)},
+             metadata={"loss_scale": guard.loss_scale.state_dict()})
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+_FORMAT_VERSION = 1
+_MANIFEST = "manifest.json"
+_NPZ = "state.npz"
+_SHARDED = "sharded"
+_STEP_PREFIX = "step_"
+_TMP_PREFIX = ".tmp-"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint operation failed (bad arguments, no usable snapshot
+    when one was required, ...)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Snapshot:
+    """A restored checkpoint: the payload tree, its step and metadata."""
+
+    step: int
+    tree: Pytree
+    metadata: Dict[str, Any]
+    path: str
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def _flatten(tree: Pytree) -> Dict[str, np.ndarray]:
+    """Pytree -> flat ``{keystr: host ndarray}`` (the serialization naming)."""
+    out: Dict[str, np.ndarray] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path) or "."
+        if key in out:
+            raise CheckpointError(f"duplicate tree key {key!r}")
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def _unflatten_like(template: Pytree, flat: Dict[str, np.ndarray]) -> Pytree:
+    """Rebuild ``template``'s structure with leaves from ``flat``; strict
+    (missing/extra keys and shape mismatches raise, the
+    ``load_state_dict(strict=True)`` contract)."""
+    remaining = dict(flat)
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = jax.tree_util.keystr(path) or "."
+        if key not in remaining:
+            raise CheckpointError(f"checkpoint is missing key {key!r}")
+        arr = remaining.pop(key)
+        want = tuple(np.shape(leaf))
+        if tuple(arr.shape) != want:
+            raise CheckpointError(
+                f"shape mismatch for {key!r}: saved {tuple(arr.shape)}, "
+                f"template expects {want}"
+            )
+        leaves.append(arr.astype(np.asarray(leaf).dtype))
+    if remaining:
+        raise CheckpointError(
+            f"unexpected keys in checkpoint: {sorted(remaining)[:5]}"
+            + ("..." if len(remaining) > 5 else "")
+        )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms without O_RDONLY dirs; durability best-effort
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"resilience.checkpoint:{tag}")
+
+
+class CheckpointManager:
+    """Atomic, versioned, checksummed snapshots under one directory.
+
+    Multi-host: every process calls :meth:`save`/:meth:`restore_latest`
+    with the same arguments.  For ``sharded=True`` each process writes its
+    own orbax shards; all filesystem surgery (rename, manifest, GC) is
+    done by process 0 only, fenced by global barriers — the same protocol
+    as :func:`utils.serialization.save_sharded`.  The npz backend
+    host-gathers through ``np.asarray`` and is meant for single-process
+    runs (every process would write the same bytes; harmless but wasteful
+    on shared storage).
+    """
+
+    def __init__(self, directory: str, *, keep_last_k: int = 3) -> None:
+        if keep_last_k < 1:
+            raise ValueError("keep_last_k must be >= 1")
+        self.directory = os.path.abspath(os.fspath(directory))
+        self.keep_last_k = keep_last_k
+        if jax.process_index() == 0:
+            os.makedirs(self.directory, exist_ok=True)
+        _barrier("init")
+
+    # ------------------------------------------------------------------ #
+    # save                                                               #
+    # ------------------------------------------------------------------ #
+
+    def save(
+        self,
+        step: int,
+        tree: Pytree,
+        *,
+        metadata: Optional[Dict[str, Any]] = None,
+        sharded: bool = False,
+    ) -> str:
+        """Write snapshot ``step_<step>`` atomically; returns its path.
+
+        ``metadata`` must be JSON-serializable (step counters, rng seeds,
+        loss-scale state, ...); arrays belong in ``tree``.
+        """
+        if step < 0:
+            raise ValueError(f"step must be >= 0, got {step}")
+        final = self._step_dir(step)
+        tmp = os.path.join(
+            self.directory, f"{_TMP_PREFIX}{_STEP_PREFIX}{step:010d}"
+        )
+        manifest: Dict[str, Any] = {
+            "format": _FORMAT_VERSION,
+            "step": int(step),
+            "backend": _SHARDED if sharded else "npz",
+            "metadata": dict(metadata or {}),
+        }
+        if jax.process_index() == 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+        _barrier("pre-save")
+
+        if sharded:
+            import orbax.checkpoint as ocp
+
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(os.path.join(tmp, _SHARDED), tree)
+                ckptr.wait_until_finished()
+            _barrier("post-write")
+            if jax.process_index() == 0:
+                manifest["files"] = self._hash_dir(tmp, fsync=True)
+        else:
+            flat = _flatten(tree)
+            manifest["arrays"] = {
+                k: {
+                    "crc32": _crc(a),
+                    "shape": list(a.shape),
+                    "dtype": str(a.dtype),
+                }
+                for k, a in flat.items()
+            }
+            if jax.process_index() == 0:
+                npz_path = os.path.join(tmp, _NPZ)
+                with open(npz_path, "wb") as f:
+                    np.savez(f, **flat)
+                    f.flush()
+                    os.fsync(f.fileno())
+
+        if jax.process_index() == 0:
+            # Manifest LAST, then the tmp dir itself, then the swap: its
+            # presence inside a step_* dir certifies a complete write.
+            man_path = os.path.join(tmp, _MANIFEST)
+            with open(man_path, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp)
+            if os.path.exists(final):
+                old = final + ".old"
+                shutil.rmtree(old, ignore_errors=True)
+                os.rename(final, old)
+                os.rename(tmp, final)
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                os.rename(tmp, final)
+            _fsync_dir(self.directory)
+            self._gc()
+        _barrier("post-swap")
+        return final
+
+    # ------------------------------------------------------------------ #
+    # restore                                                            #
+    # ------------------------------------------------------------------ #
+
+    def restore_latest(
+        self, template: Optional[Pytree] = None
+    ) -> Optional[Snapshot]:
+        """The newest snapshot that passes verification, or ``None``.
+
+        Corrupt or partial snapshots (missing/unparseable manifest,
+        checksum/shape/dtype mismatch, truncated files) are SKIPPED in
+        favor of the next older one — the property that makes
+        save-every-k-steps a durable strategy rather than a single point
+        of failure.
+
+        With ``template`` the payload is rebuilt into its structure
+        (required for ``sharded`` snapshots, where it also supplies the
+        shardings — pass the live initialized tree); without it the flat
+        ``{keystr: ndarray}`` dict is returned.
+        """
+        for step in sorted(self.steps(), reverse=True):
+            snap = self._try_restore(step, template)
+            if snap is not None:
+                return snap
+        return None
+
+    def restore_step(
+        self, step: int, template: Optional[Pytree] = None
+    ) -> Snapshot:
+        """Restore one specific snapshot; raises if it fails verification."""
+        snap = self._try_restore(step, template)
+        if snap is None:
+            raise CheckpointError(
+                f"snapshot step_{step} at {self._step_dir(step)} is missing "
+                "or fails verification"
+            )
+        return snap
+
+    def steps(self) -> List[int]:
+        """Steps with a snapshot directory present (verified or not).
+
+        ``step_<n>.old`` counts too: a crash between the two renames of a
+        same-step re-save leaves only the ``.old`` copy, and restore must
+        still find it (see :meth:`_try_restore`'s fallback)."""
+        return _scan_steps(self.directory)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    # ------------------------------------------------------------------ #
+    # internals                                                          #
+    # ------------------------------------------------------------------ #
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step:010d}")
+
+    def _hash_dir(
+        self, root: str, *, fsync: bool = False
+    ) -> Dict[str, Dict[str, int]]:
+        """CRC-32 + size per file under ``root`` (manifest excluded),
+        relative paths — the sharded backend's integrity record.
+        ``fsync=True`` on the save path only (durability belongs to the
+        writer; restore-side verification must not pay one fsync per
+        shard per probed snapshot)."""
+        out: Dict[str, Dict[str, int]] = {}
+        for dirpath, _, filenames in os.walk(root):
+            for fn in sorted(filenames):
+                if fn == _MANIFEST:
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root)
+                crc = 0
+                size = 0
+                with open(full, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        crc = zlib.crc32(chunk, crc)
+                        size += len(chunk)
+                if fsync:
+                    _fsync_file(full)
+                out[rel] = {"crc32": crc, "size": size}
+        return out
+
+    def _read_manifest(self, path: str) -> Optional[Dict[str, Any]]:
+        man_path = os.path.join(path, _MANIFEST)
+        try:
+            with open(man_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(manifest, dict) or manifest.get("format") != _FORMAT_VERSION:
+            return None
+        return manifest
+
+    def _try_restore(
+        self, step: int, template: Optional[Pytree]
+    ) -> Optional[Snapshot]:
+        """Verify-and-load ``step``; falls back to ``step_<n>.old`` (the
+        displaced copy of a same-step re-save) when the primary is
+        missing or fails verification, so a crash ANYWHERE in the
+        re-save's rename sequence still leaves this step restorable."""
+        primary = self._step_dir(step)
+        snap = self._restore_dir(primary, step, template)
+        if snap is not None:
+            return snap
+        return self._restore_dir(primary + ".old", step, template)
+
+    def _restore_dir(
+        self, path: str, step: int, template: Optional[Pytree]
+    ) -> Optional[Snapshot]:
+        manifest = self._read_manifest(path)
+        if manifest is None:
+            return None
+        metadata = manifest.get("metadata", {})
+        if manifest.get("backend") == _SHARDED:
+            if template is None:
+                raise CheckpointError(
+                    f"snapshot step_{step} is sharded (orbax): "
+                    "restore_latest needs the template tree to supply "
+                    "structure and shardings"
+                )
+            want = manifest.get("files")
+            if not isinstance(want, dict) or self._hash_dir(path) != want:
+                return None
+            from torchgpipe_tpu.utils.serialization import restore_sharded
+
+            try:
+                tree = restore_sharded(os.path.join(path, _SHARDED), template)
+            except Exception:
+                return None
+            return Snapshot(step=step, tree=tree, metadata=metadata, path=path)
+
+        want_arrays = manifest.get("arrays")
+        if not isinstance(want_arrays, dict):
+            return None
+        try:
+            with np.load(os.path.join(path, _NPZ)) as f:
+                flat = {k: f[k] for k in f.files}
+        except Exception:
+            return None  # truncated/corrupt zip, missing file, bad member
+        if set(flat) != set(want_arrays):
+            return None
+        for k, rec in want_arrays.items():
+            a = flat[k]
+            if (
+                list(a.shape) != rec.get("shape")
+                or str(a.dtype) != rec.get("dtype")
+                or _crc(a) != rec.get("crc32")
+            ):
+                return None
+        tree = _unflatten_like(template, flat) if template is not None else flat
+        return Snapshot(step=step, tree=tree, metadata=metadata, path=path)
+
+    def _gc(self) -> None:
+        """Keep the last ``keep_last_k`` COMPLETE snapshots; also sweep
+        the two kinds of crash litter: ``step_<n>.old`` copies whose
+        primary is complete again (the re-save finished — the fallback
+        copy is redundant), and incomplete snapshot dirs older than a
+        newer complete one."""
+        complete = [
+            s for s in self.steps()
+            if self._read_manifest(self._step_dir(s)) is not None
+        ]
+        for s in complete[: -self.keep_last_k]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            shutil.rmtree(self._step_dir(s) + ".old", ignore_errors=True)
+        for s in complete:
+            shutil.rmtree(self._step_dir(s) + ".old", ignore_errors=True)
+        # A snapshot dir WITHOUT a manifest is junk only if a newer
+        # complete snapshot exists (otherwise it may be an in-flight
+        # concurrent writer's — leave it).  Its .old fallback survives
+        # while the step is inside the keep-last-k window (it may be the
+        # only good copy); once keep_last_k NEWER complete snapshots
+        # exist it is retired like any other old snapshot — otherwise
+        # every mid-swap crash would leak a full snapshot forever.
+        newest = complete[-1] if complete else None
+        cutoff = (
+            complete[-self.keep_last_k]
+            if len(complete) >= self.keep_last_k
+            else None
+        )
+        for s in self.steps():
+            if newest is not None and s < newest and s not in complete:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            if cutoff is not None and s < cutoff:
+                shutil.rmtree(self._step_dir(s) + ".old", ignore_errors=True)
+
+
+def _scan_steps(directory: str) -> List[int]:
+    """Step numbers present under ``directory`` (``step_<n>`` and
+    ``step_<n>.old``), verified or not.  Pure directory listing — safe
+    from any single process of a multi-host job (no barriers)."""
+    if not os.path.isdir(directory):
+        return []
+    out = set()
+    for name in os.listdir(directory):
+        if not name.startswith(_STEP_PREFIX):
+            continue
+        base = name[: -len(".old")] if name.endswith(".old") else name
+        try:
+            out.add(int(base[len(_STEP_PREFIX):]))
+        except ValueError:
+            continue
+    return sorted(out)
+
+
+def latest_step_or_none(directory: str) -> Optional[int]:
+    """Peek at a checkpoint directory without constructing a manager —
+    and therefore without :class:`CheckpointManager`'s collective init
+    barrier, so a single rank of a multi-host job may call it freely."""
+    steps = _scan_steps(os.path.abspath(os.fspath(directory)))
+    return steps[-1] if steps else None

@@ -1,0 +1,238 @@
+"""Deterministic fault injection — the chaos harness for the resilience
+stack, and the test oracle for all of it.
+
+A GPipe-class pipeline fails in a handful of characteristic ways: a cell
+produces non-finite values (overflowed bfloat16 matmul, bad batch), a
+transport send is lost or slow (flaky DCN link, dying peer), the VM is
+preempted mid-run.  This module reproduces each of them *on demand and
+deterministically*, so recovery paths can be tested in CI instead of
+discovered at 3am on a pod:
+
+* :func:`inject` — a context manager activating a :class:`FaultPlan` for
+  the enclosed steps.  ``nan_at=(stage, micro_batch)`` poisons that exact
+  cell's input in both engines (the MPMD per-cell scheduler hooks it
+  eagerly; the SPMD fill-drain schedule compiles a masked ``jnp.where``
+  keyed on the traced ``(stage, tick - stage)`` indices).
+  ``preempt_at_step=k`` makes
+  :meth:`~torchgpipe_tpu.resilience.preemption.PreemptionHandler.check`
+  report a preemption at step ``k`` — a SIGTERM without the SIGTERM.
+* :class:`FaultyTransport` — wraps a
+  :class:`~torchgpipe_tpu.distributed.context.LocalTransport` /
+  ``TcpTransport`` and applies :class:`SendFault` rules on ``send``:
+  ``drop`` (raise ``ConnectionError`` at the sender — the retryable
+  transient), ``lose`` (silently discard — the receiver-side hang that
+  ``recv_timeout`` must catch), ``delay`` and ``duplicate``.
+
+Injection is engine-level, not layer-level: user models need no
+instrumentation, and the injected fault is exactly placed — the same
+(stage, micro-batch) every run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """What to break while the plan is active (see :func:`inject`)."""
+
+    # Poison the input of pipeline cell (stage, micro-batch) with NaNs.
+    nan_at: Optional[Tuple[int, int]] = None
+    # PreemptionHandler.check(step) reports True for step >= this.
+    preempt_at_step: Optional[int] = None
+
+
+_lock = threading.Lock()
+_active: Optional[FaultPlan] = None
+# Monotonic epoch, bumped on every activation/deactivation: engines that
+# CACHE compiled programs key them by plan_token() so a program traced with
+# an injection is never reused once the plan is gone (and vice versa).
+_epoch: int = 0
+
+
+@contextlib.contextmanager
+def inject(
+    *,
+    nan_at: Optional[Tuple[int, int]] = None,
+    preempt_at_step: Optional[int] = None,
+) -> Iterator[FaultPlan]:
+    """Activate a :class:`FaultPlan` for the enclosed block.
+
+    Plans do not nest (the inner activation wins would be ambiguous); a
+    second concurrent ``inject`` raises.
+    """
+    global _active, _epoch
+    plan = FaultPlan(nan_at=nan_at, preempt_at_step=preempt_at_step)
+    with _lock:
+        if _active is not None:
+            raise RuntimeError(
+                "a fault plan is already active; fault injections do not "
+                "nest"
+            )
+        _active = plan
+        _epoch += 1
+    try:
+        yield plan
+    finally:
+        with _lock:
+            _active = None
+            _epoch += 1
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The currently injected plan, or None."""
+    return _active
+
+
+def plan_token() -> Optional[int]:
+    """Cache key for compiled programs: an epoch unique to this activation
+    when the active plan can alter a TRACED program (``nan_at``), else
+    None.  Inert-for-tracing plans (``preempt_at_step`` only) must not
+    token — they would force two full recompiles of the pipelined step
+    (entering and leaving the context) for a fault the trace never sees."""
+    plan = _active
+    return _epoch if plan is not None and plan.nan_at is not None else None
+
+
+def poison(tree: Pytree) -> Pytree:
+    """Every floating leaf replaced by NaNs (shape/dtype preserved)."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.full_like(a, jnp.nan)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+        else a,
+        tree,
+    )
+
+
+def corrupt_cell_input(stage: int, microbatch: int, tree: Pytree) -> Pytree:
+    """MPMD engine hook: called with CONCRETE cell indices by the per-cell
+    schedulers; poisons the input iff the active plan names this cell."""
+    plan = _active
+    if plan is None or plan.nan_at != (stage, microbatch):
+        return tree
+    return poison(tree)
+
+
+def spmd_corrupt_cell_input(
+    stage: jax.Array, microbatch: jax.Array, tree: Pytree
+) -> Pytree:
+    """SPMD engine hook: ``stage``/``microbatch`` are TRACED lane/tick
+    indices, so the poisoning compiles to a ``jnp.where`` mask.  Call only
+    when a plan with ``nan_at`` is active (the caller checks at trace
+    time and keys its program cache on :func:`plan_token`)."""
+    plan = _active
+    if plan is None or plan.nan_at is None:
+        return tree
+    s, i = plan.nan_at
+    hit = jnp.logical_and(stage == s, microbatch == i)
+    return jax.tree_util.tree_map(
+        lambda a: jnp.where(hit, jnp.full_like(a, jnp.nan), a)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+        else a,
+        tree,
+    )
+
+
+def should_preempt(step: int) -> bool:
+    """True iff the active plan simulates a preemption at/before ``step``."""
+    plan = _active
+    return (
+        plan is not None
+        and plan.preempt_at_step is not None
+        and step >= plan.preempt_at_step
+    )
+
+
+# --------------------------------------------------------------------- #
+# transport faults                                                      #
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class SendFault:
+    """One matching rule applied by :class:`FaultyTransport` on ``send``.
+
+    ``None`` fields are wildcards.  ``times`` bounds how often the rule
+    fires (-1 = every match); after that the send passes through clean —
+    which is what makes drop-then-retry deterministic.
+    """
+
+    action: str  # 'drop' | 'lose' | 'delay' | 'duplicate'
+    dst: Optional[str] = None
+    kind: Any = None
+    index: Optional[int] = None
+    times: int = 1
+    delay_s: float = 0.05
+    fired: int = 0
+
+    _ACTIONS = ("drop", "lose", "delay", "duplicate")
+
+    def __post_init__(self) -> None:
+        if self.action not in self._ACTIONS:
+            raise ValueError(
+                f"action must be one of {self._ACTIONS}, got {self.action!r}"
+            )
+
+    def matches(self, dst: str, kind: Any, index: int) -> bool:
+        if self.times >= 0 and self.fired >= self.times:
+            return False
+        return (
+            (self.dst is None or self.dst == dst)
+            and (self.kind is None or self.kind == kind)
+            and (self.index is None or self.index == index)
+        )
+
+
+class FaultyTransport:
+    """Wrap any transport (Local/Tcp) with deterministic send-side faults.
+
+    ``drop`` raises ``ConnectionError`` at the sender — the transient
+    class :func:`torchgpipe_tpu.resilience.guard.classify_error` retries.
+    ``lose`` swallows the message silently (the receiver must catch it via
+    ``recv_timeout``).  ``delay`` sleeps before delivering; ``duplicate``
+    delivers twice.  Everything else (register/unregister/close/is_alive)
+    delegates to the wrapped transport.
+    """
+
+    def __init__(self, inner: Any, faults: Sequence[SendFault] = ()) -> None:
+        self.inner = inner
+        self.faults: List[SendFault] = list(faults)
+        self.log: List[Tuple[str, str, Any, int]] = []  # (action, dst, kind, i)
+
+    def add(self, fault: SendFault) -> "FaultyTransport":
+        self.faults.append(fault)
+        return self
+
+    def send(self, dst: str, kind: Any, index: int, payload: Any) -> None:
+        sends = 1
+        for f in self.faults:
+            if not f.matches(dst, kind, index):
+                continue
+            f.fired += 1
+            self.log.append((f.action, dst, kind, index))
+            if f.action == "drop":
+                raise ConnectionError(
+                    f"fault injection: dropped send of {kind!r}[{index}] "
+                    f"to {dst!r}"
+                )
+            if f.action == "lose":
+                return  # silently discarded
+            if f.action == "delay":
+                time.sleep(f.delay_s)
+            elif f.action == "duplicate":
+                sends += 1
+        for _ in range(sends):
+            self.inner.send(dst, kind, index, payload)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.inner, name)

@@ -36,6 +36,7 @@ engine for BatchNorm-style stateful CNNs).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
@@ -49,6 +50,7 @@ from torchgpipe_tpu import microbatch
 from torchgpipe_tpu.auxgrad import aux_scale
 from torchgpipe_tpu.layers import Layer, Spec
 from torchgpipe_tpu.parallel.tensor import all_gather_value
+from torchgpipe_tpu.resilience import faults as _faults
 
 Pytree = Any
 
@@ -746,10 +748,13 @@ class SpmdGPipe:
         self._loss_spec = (
             layer_param_specs(self.loss_fn) if self._loss_is_layer else None
         )
-        self._train_step_fns: dict = {}  # keyed by use_rng
+        # Program caches, keyed by (use_rng, masked, fault-plan token) /
+        # fault-plan token: an active resilience.faults plan is baked into
+        # the traced program, so (de)activation must miss the cache.
+        self._train_step_fns: dict = {}
         self._warned_ragged_coupled = False  # one-time ragged+aux warning
-        self._apply_fn = None
-        self._eval_fn = None
+        self._apply_fns: dict = {}
+        self._eval_fns: dict = {}
         # FSDP bookkeeping, resolved lazily from the first params tree seen
         # (leaf shapes are needed to pick shard dims): per block leaf, the
         # dim sharded over dp (-1 = replicated) and the augmented specs.
@@ -1280,6 +1285,14 @@ class SpmdGPipe:
             # injects exactly mean-over-microbatches like the MPMD engine.
             mb = t - stage
             valid_scale = jnp.where((mb >= 0) & (mb < m), 1.0 / m, 0.0)
+            plan = _faults.active_plan()
+            if plan is not None and plan.nan_at is not None:
+                # Deterministic chaos (resilience.faults): the plan is
+                # STATIC at trace time, so the poisoning compiles to a
+                # jnp.where mask on the traced (lane, tick - lane) cell
+                # indices; entry points key their program caches on
+                # faults.plan_token() so plan (de)activation re-traces.
+                x_in = _faults.spmd_corrupt_cell_input(stage, mb, x_in)
             return x_in, key, valid_scale
 
         def tick(act, t):
@@ -2896,6 +2909,123 @@ class SpmdGPipe:
                 )
             break  # leading-dim layout is uniform; one leaf suffices
 
+    def _fault_token_checked(self, *, for_train: bool = False) -> Optional[int]:
+        """Fault-plan cache token for the compiled programs, refusing
+        plans the requested builder cannot inject: only the fill-drain
+        tick loop (``_local_pipeline`` — every non-interleaved forward,
+        but only the fill_drain training step) carries the per-cell
+        poisoning hook.  A chaos run that silently injects nothing would
+        certify recovery code that never executed.  Also evicts cache
+        entries from expired plans — each activation's token is unique,
+        so poisoned programs would otherwise accumulate forever."""
+        plan = _faults.active_plan()
+        bad_schedule = (
+            self.schedule != "fill_drain"
+            if for_train
+            else self.schedule == "interleaved"
+        )
+        if plan is not None and plan.nan_at is not None and bad_schedule:
+            raise NotImplementedError(
+                "faults.inject(nan_at=...) is supported by the SPMD "
+                "fill_drain training step and the non-interleaved "
+                "apply/eval programs only (got "
+                f"schedule={self.schedule!r}); these are the paths with a "
+                "per-cell injection hook"
+            )
+        token = _faults.plan_token()
+        for cache, key_token in (
+            (self._train_step_fns, lambda k: k[2]),
+            (self._apply_fns, lambda k: k),
+            (self._eval_fns, lambda k: k),
+        ):
+            for k in [
+                k for k in cache
+                if key_token(k) is not None and key_token(k) != token
+            ]:
+                del cache[k]
+        return token
+
+    @contextlib.contextmanager
+    def _annotate_cell_failure(
+        self, params: Pytree, x_mb: Pytree
+    ) -> Any:
+        """Give trace-time partition exceptions the MPMD engine's
+        (stage, micro-batch) note (tests/test_failures.py semantics).
+
+        The SPMD schedule traces each cell ONCE inside ``lax.scan``, so a
+        Python exception escaping a layer carries no concrete cell
+        identity.  On failure, re-localize by abstract-evaluating the
+        pre layer and then the block per stage (no FLOPs, no compile):
+        the first cell whose probe reproduces the same exception type is
+        named.  Cells are shape-uniform across stages and micro-batches,
+        so the first failing cell is the earliest the schedule executes —
+        micro-batch 0 of the named stage.  Best-effort: if the probe
+        cannot reproduce the failure (e.g. collectives needing mesh axes
+        raise differently outside shard_map), the original exception
+        propagates un-noted, never masked.
+        """
+        try:
+            yield
+        except Exception as e:  # noqa: BLE001 — annotate and re-raise as-is
+            notes = getattr(e, "__notes__", None) or []
+            if hasattr(e, "add_note") and not any(
+                "pipeline stage" in n for n in notes
+            ):
+                cell = self._locate_failing_cell(type(e), params, x_mb)
+                if cell is not None:
+                    stage, mb, where = cell
+                    e.add_note(
+                        f"raised in pipeline stage {stage}, micro-batch "
+                        f"{mb} ({where}; SPMD {self.schedule} schedule — "
+                        "first failing cell of the traced program)"
+                    )
+            raise
+
+    def _locate_failing_cell(
+        self, exc_type: type, params: Pytree, x_mb: Pytree
+    ) -> Optional[Tuple[int, int, str]]:
+        """Abstract-eval probe behind :meth:`_annotate_cell_failure`;
+        returns ``(stage, micro_batch, component)`` or None."""
+
+        def absify(tree, drop=0):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    tuple(np.shape(a))[drop:], jnp.asarray(a).dtype
+                ),
+                tree,
+            )
+
+        x = absify(x_mb, drop=1)  # one micro-batch's input spec
+        try:
+            if self.pre is not None:
+                try:
+                    x = jax.eval_shape(
+                        lambda p, xx: self.pre.apply(
+                            p, (), xx, rng=None, train=True
+                        )[0],
+                        absify(params["pre"]),
+                        x,
+                    )
+                except exc_type:
+                    return (0, 0, f"pre layer {self.pre.name!r}")
+            drop = 2 if self.virtual_stages > 1 else 1
+            blk = absify(params["blocks"], drop=drop)
+            for s in range(self.n_stages):
+                try:
+                    with aux_scale(0.0):
+                        x = jax.eval_shape(
+                            lambda p, xx: self.block.apply(
+                                p, (), xx, rng=None, train=True
+                            )[0],
+                            blk,
+                            x,
+                        )
+                except exc_type:
+                    return (s, 0, f"block {self.block.name!r}")
+        except Exception:  # noqa: BLE001 — probe must never mask the error
+            return None
+        return None
+
     def train_step(
         self, params: Pytree, x: Pytree, target: Pytree,
         rng: Optional[jax.Array] = None,
@@ -2921,13 +3051,14 @@ class SpmdGPipe:
         engine); omit it for deterministic models.
         """
         self._check_params(params)
+        token = self._fault_token_checked(for_train=True)
         pad = self._check_batch(
             x, target, ragged_ok=self.loss_reduction is not None
         )
         if self.fsdp:
             self._ensure_fsdp(params["blocks"])
         use_rng = rng is not None
-        key = (use_rng, bool(pad))
+        key = (use_rng, bool(pad), token)
         if key not in self._train_step_fns:
             self._train_step_fns[key] = self._build_train_step(
                 use_rng, masked=bool(pad)
@@ -2967,7 +3098,8 @@ class SpmdGPipe:
             args += (microbatch.scatter_stacked(mask, self.chunks),)
         if use_rng:
             args += (rng,)
-        return self._train_step_fns[key](*args)
+        with self._annotate_cell_failure(params, x_mb):
+            return self._train_step_fns[key](*args)
 
     def make_train_step(
         self, optimizer: Any, *, donate: bool = True
@@ -3006,7 +3138,13 @@ class SpmdGPipe:
             x: Pytree,
             target: Pytree,
             rng: Optional[jax.Array],
+            plan_token: Optional[int],
         ) -> Tuple[jax.Array, Pytree, Pytree]:
+            # plan_token is STATIC and unused in the math: it keys the jit
+            # cache so a trace with an active resilience.faults injection
+            # (baked into the traced train_step) is never reused after the
+            # plan ends, or vice versa.
+            del plan_token
             loss, grads = self.train_step(params, x, target, rng)
             updates, new_state = optimizer.update(grads, opt_state, params)
             new_params = jax.tree_util.tree_map(
@@ -3014,7 +3152,11 @@ class SpmdGPipe:
             )
             return loss, new_params, new_state
 
-        compiled = jax.jit(whole, donate_argnums=(0, 1) if donate else ())
+        compiled = jax.jit(
+            whole,
+            static_argnums=(5,),
+            donate_argnums=(0, 1) if donate else (),
+        )
 
         def step(
             params: Pytree,
@@ -3023,7 +3165,9 @@ class SpmdGPipe:
             target: Pytree,
             rng: Optional[jax.Array] = None,
         ) -> Tuple[jax.Array, Pytree, Pytree]:
-            return compiled(params, opt_state, x, target, rng)
+            return compiled(
+                params, opt_state, x, target, rng, _faults.plan_token()
+            )
 
         return step
 
@@ -3328,15 +3472,17 @@ class SpmdGPipe:
             )
         if self.fsdp:
             self._ensure_fsdp(params["blocks"])
-        if self._eval_fn is None:
-            self._eval_fn = (
+        token = self._fault_token_checked()
+        if token not in self._eval_fns:
+            self._eval_fns[token] = (
                 self._build_apply_interleaved(with_loss=True)
                 if self.schedule == "interleaved"
                 else self._build_apply(with_loss=True)
             )
         x_mb = microbatch.scatter_stacked(x, self.chunks)
         tgt_mb = microbatch.scatter_stacked(target, self.chunks)
-        return self._eval_fn(params, x_mb, tgt_mb)
+        with self._annotate_cell_failure(params, x_mb):
+            return self._eval_fns[token](params, x_mb, tgt_mb)
 
     def apply(self, params: Pytree, x: Pytree) -> Pytree:
         """Pipelined inference forward; returns gathered outputs
@@ -3347,15 +3493,17 @@ class SpmdGPipe:
         pad = self._check_batch(x, ragged_ok=True)
         if self.fsdp:
             self._ensure_fsdp(params["blocks"])
-        if self._apply_fn is None:
-            self._apply_fn = (
+        token = self._fault_token_checked()
+        if token not in self._apply_fns:
+            self._apply_fns[token] = (
                 self._build_apply_interleaved()
                 if self.schedule == "interleaved"
                 else self._build_apply()
             )
         b_real = microbatch.batch_size(x)
         x_mb = microbatch.scatter_stacked(_pad_batch(x, pad), self.chunks)
-        out_mb = self._apply_fn(params, x_mb)
+        with self._annotate_cell_failure(params, x_mb):
+            out_mb = self._apply_fns[token](params, x_mb)
         out = microbatch.gather_stacked(out_mb)
         if pad:
             out = jax.tree_util.tree_map(lambda a: a[:b_real], out)

@@ -121,8 +121,27 @@ def load_state_dict(
 
 
 def save(path: str, d: Dict[str, np.ndarray]) -> None:
-    """Write a flat state dict to ``path`` (.npz)."""
-    np.savez(path, **d)
+    """Write a flat state dict to ``path`` (.npz) — atomically.
+
+    The bytes are staged in a temp file in the SAME directory, flushed and
+    fsync'd, then renamed over ``path``: a crash (or preemption) mid-save
+    can never truncate a previously-good checkpoint — the reader sees
+    either the old complete file or the new complete file.  Matches
+    ``np.savez``'s naming: ``.npz`` is appended when missing.
+    """
+    final = _abs(path)
+    if not final.endswith(".npz"):
+        final += ".npz"
+    tmp = f"{final}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **d)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load(path: str) -> Dict[str, np.ndarray]:
