@@ -27,11 +27,20 @@ can hang backend init when the tunnel is down OR slow), enforces a hard
 wall-clock budget (``TGPU_BENCH_DEADLINE_S``, default 720 s — comfortably
 inside the driver's timeout; round 4's driver run was killed at rc=124
 with NO output because the old single-process bench had no deadline), and
-prints, in order of preference: the child's final JSON line; the child's
-last streamed partial result (a real measurement whose MFU pass didn't
-finish); a labeled CPU-fallback line from a fresh CPU-pinned child; or a
-static zero-value line.  Under EVERY tunnel condition the driver parses a
-JSON object.
+prints, in order of preference: the child's final result (sentineled
+``BENCH_FINAL`` line — nothing is sniffed out of stdout noise); the
+child's last streamed ``BENCH_PARTIAL`` result (a real measurement whose
+MFU pass didn't finish); a labeled CPU-fallback line from a fresh
+CPU-pinned child; or a static zero-value line.  Under EVERY tunnel
+condition the driver parses a JSON object.
+
+Output JSON contract (advisor round 5): ``platform`` is machine-readable
+``"tpu" | "cpu" | "none"`` — ``"none"`` appears ONLY on the static
+zero-value line, where nothing ran anywhere (value 0.0, vs_baseline
+null).  ``validated`` is ``true`` iff the async-dispatch sanity gate ran
+(mfu computed and <= 1, or the per-step-blocked re-time replaced the
+number); streamed partials carry ``"validated": false`` so a partial
+promoted by the supervisor's deadline is machine-discountable.
 """
 
 from __future__ import annotations
@@ -45,6 +54,7 @@ import time
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 132.413 / 8
 
 _PARTIAL_PREFIX = "BENCH_PARTIAL "
+_FINAL_PREFIX = "BENCH_FINAL "
 
 
 # --------------------------------------------------------------------------
@@ -127,19 +137,24 @@ def _run_child(argv: list[str], env: dict, budget: float):
         except queue.Empty:
             continue
         if line is None:
+            # stdout EOF: no writers remain, so no further result can
+            # arrive — stop reading NOW even if the process (or a
+            # grandchild holding only stderr) is still alive, instead of
+            # polling out the rest of the budget (advisor r5).
             saw_eof = True
-            if proc.poll() is not None:
-                break
-            continue
+            break
         line = line.rstrip("\n")
         if line.startswith(_PARTIAL_PREFIX):
             try:
                 partial = json.loads(line[len(_PARTIAL_PREFIX):])
             except ValueError:
                 pass
-        elif line.lstrip().startswith("{") and '"metric"' in line:
+        elif line.startswith(_FINAL_PREFIX):
+            # Explicit sentinel — a structured-log noise line carrying a
+            # '"metric"' key can no longer impersonate the result
+            # (advisor r5).
             try:
-                final = json.loads(line)
+                final = json.loads(line[len(_FINAL_PREFIX):])
             except ValueError:
                 print(line, file=sys.stderr, flush=True)
         elif line:
@@ -207,7 +222,10 @@ def _supervise() -> None:
                 "unit": "samples/sec/chip",
                 "vs_baseline": None,
                 "mfu": None,
+                # "none" = nothing ran anywhere (the documented third
+                # value of the platform enum — see the module docstring).
                 "platform": "none",
+                "validated": False,
             }
         ),
         flush=True,
@@ -267,7 +285,8 @@ def _even_balance(n_layers: int, n_stages: int):
 
 def _build_amoebanet(platform: str, n_stages: int, batch: int | None = None,
                      chunks: int | None = None, checkpoint: str = "except_last",
-                     fused: bool = False):
+                     fused: bool = False, abstract: bool = False):
+    import jax
     import jax.numpy as jnp
 
     from torchgpipe_tpu.gpipe import GPipe
@@ -301,8 +320,14 @@ def _build_amoebanet(platform: str, n_stages: int, batch: int | None = None,
     model = GPipe(layers, balance=_even_balance(len(layers), n_stages),
                   chunks=chunks, checkpoint=checkpoint,
                   compute_dtype=compute_dtype, fused=fused)
-    x = jnp.zeros((batch, image, image, 3), jnp.float32)
-    y = jnp.zeros((batch,), jnp.int32)
+    if abstract:
+        # Rung-ranking path: shapes only, no device allocation (the
+        # shared chip's free HBM must not be touched while scoring).
+        x = jax.ShapeDtypeStruct((batch, image, image, 3), jnp.float32)
+        y = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    else:
+        x = jnp.zeros((batch, image, image, 3), jnp.float32)
+        y = jnp.zeros((batch,), jnp.int32)
     name = (f"amoebanetd-({num_layers},{num_filters})-pipeline{n_stages}"
             f"-b{batch}m{chunks}-{checkpoint}-{'fused' if fused else 'percell'}")
     return model, x, y, name
@@ -335,48 +360,13 @@ def _build_transformer(platform: str, n_stages: int):
 def _rung_residual_bytes(model, x) -> int | None:
     """Device bytes of the un-rematerialized micro-batch's vjp residuals.
 
-    Under ``checkpoint='except_last'`` the last micro-batch's cells keep
-    their full vjp residuals alive between the forward and backward
-    programs; in the per-cell engine those residuals are *program
-    arguments*, so a rung whose residuals exceed HBM capacity fails at AOT
-    compile time — after minutes of remote compilation.  ``eval_shape``
-    predicts the same number in milliseconds with no compile, letting the
-    ladder skip infeasible rungs outright."""
+    The probe lives in :func:`torchgpipe_tpu.tune.mpmd_stage_residual_bytes`
+    (the autotuner's shared rung-feasibility predictor); a broken tune
+    module only costs this driver its predictor, never the ladder walk."""
     try:
-        import jax
+        from torchgpipe_tpu.tune import mpmd_stage_residual_bytes
 
-        from torchgpipe_tpu.layers import sequential_init
-
-        chunks = model.chunks
-        mb = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(
-                (a.shape[0] // chunks,) + a.shape[1:], a.dtype
-            ),
-            x,
-        )
-        flat_p, flat_s, _ = jax.eval_shape(
-            lambda: sequential_init(model.layers, jax.random.PRNGKey(0), mb)
-        )
-        total = 0
-        i = 0
-        for j, part in enumerate(model.partitions):
-            stage = model._pipeline.stages[j]
-            p_j = flat_p[i : i + len(part)]
-            s_j = flat_s[i : i + len(part)]
-            i += len(part)
-            y, _, _, pull = jax.eval_shape(
-                lambda xx, p=p_j, s=s_j, st=stage: st.fwd_vjp(
-                    p, s, xx, {}, None, 1.0 / chunks
-                ),
-                mb,
-            )
-            per_stage = sum(
-                l.size * l.dtype.itemsize
-                for l in jax.tree_util.tree_leaves(pull)
-            )
-            total = max(total, per_stage)  # stages sit on different chips
-            mb = y  # next stage's input spec
-        return total
+        return mpmd_stage_residual_bytes(model, x)
     except Exception:
         return None
 
@@ -439,31 +429,82 @@ def main() -> None:
         return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
 
     # The remote chip is shared: free HBM varies run to run.  Walk a
-    # (batch, chunks, checkpoint, fused) ladder so the driver always gets
-    # a hardware number; the tag records the config that ran.  Rung 1 is
-    # the sweep's best overall: batch 128 on the whole-step FUSED engine
-    # (516 samples/s measured — the only engine that can hold 128, since
-    # it keeps no per-cell residual arguments; first-ever compile is slow
-    # through the remote tunnel but cached in .jax_cache afterwards).
-    # Rung 2 is the largest PER-CELL config by measured residual
-    # arithmetic (eval_shape over this exact model): peeled-mb residuals
-    # are 17.74 GiB at 128/4, 8.99 at 64/4, 6.80 at 48/4, 4.61 at 32/4,
-    # vs the 15.75 GiB AOT limit minus ~2.4 GiB overhead — so 64/4
-    # 'except_last' (360 samples/s measured).  No 'never' rung: that mode
-    # holds ALL chunks' residuals (≥ 18.4 GiB even at batch 32) —
-    # per-cell-infeasible at any rung worth timing.
+    # (batch, chunks, checkpoint, fused) RUNG SPACE so the driver always
+    # gets a hardware number; the tag records the config that ran.  The
+    # space holds every config worth timing — the fused batch-128
+    # headline (516 samples/s measured; the only engine that can hold
+    # 128 with device-resident residuals), the per-cell 'offload' rungs
+    # (vjp residuals live in HOST memory between the schedules, so even
+    # batch 128's 17.74 GiB residual wall doesn't bind — new this round,
+    # to be hardware-validated), and the measured per-cell
+    # except_last/always rungs.  The WALK ORDER comes from the static
+    # autotuner (torchgpipe_tpu.tune.rank_mpmd_rungs: eval_shape
+    # feasibility + analytic recompute/bubble rank — no device compute),
+    # replacing round 4's hand-walked 128→96→64→48→32 ladder; a broken
+    # tune module falls back to this literal order.  No 'never' rung:
+    # that mode holds ALL chunks' residuals on device (≥ 18.4 GiB even
+    # at batch 32) — per-cell-infeasible at any rung worth timing.
     ladder = [
         (128, 4, "except_last", True),
+        (128, 4, "offload", False),
+        (64, 4, "offload", False),
         (64, 4, "except_last", False),
         (48, 4, "except_last", False),
         (32, 4, "except_last", False),
         (32, 4, "always", False),
     ] if platform != "cpu" else [(None, None, "except_last", False)]
     # Manual hardware sessions: TGPU_BENCH_RUNG="batch,chunks,checkpoint,
-    # fused" pins the ladder to ONE config (e.g. "128,4,except_last,1" to
-    # time the fused headline rung directly, or "64,4,never,0" to probe a
-    # mode the ladder skips).  The driver never sets this.
+    # fused" pins the ladder to ONE config (parsed below) — read it BEFORE
+    # ranking so a pinned session never builds and ranks rungs it will
+    # discard.
     rung_env = os.environ.get("TGPU_BENCH_RUNG")
+    if platform != "cpu" and not rung_env:
+        try:
+            from torchgpipe_tpu.tune import rank_mpmd_rungs
+
+            def _rank_build(b, c, k, f):
+                model, x, _, _ = _build_amoebanet(
+                    platform, n_stages, batch=b, chunks=c, checkpoint=k,
+                    fused=f, abstract=True,
+                )
+                return model, x
+
+            # capacity=None: rank analytically WITHOUT the per-rung
+            # residual probe (it eval_shape-traces every stage — a
+            # minute-class cost this wall-clock budget can't pay 5x up
+            # front); the walk below still probes each rung it actually
+            # attempts before compiling.
+            ranked = rank_mpmd_rungs(
+                _rank_build, ladder, None,
+                overhead_bytes=_RUNG_OVERHEAD_BYTES,
+            )
+            ladder = [rung for rung, _ in ranked]
+            # The always-attempted LAST rung must stay the cheapest
+            # config (the OOM walk-down and bare-500 skip both jump to
+            # it); ranking orders by predicted throughput, so re-anchor.
+            safest = (32, 4, "always", False)
+            if safest in ladder:
+                ladder.remove(safest)
+            ladder.append(safest)
+            print(
+                "bench: tune-ranked ladder: "
+                + " > ".join(
+                    f"b{b}/m{c}/{k}{'/fused' if f else ''}"
+                    for b, c, k, f in ladder
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001 — ranking is best-effort
+            print(
+                f"bench: rung ranking unavailable ({e}); walking the "
+                "static ladder order",
+                file=sys.stderr,
+                flush=True,
+            )
+    # Pin handling (e.g. TGPU_BENCH_RUNG="128,4,except_last,1" times the
+    # fused headline rung directly; "64,4,never,0" probes a mode the
+    # ladder skips).  The driver never sets this.
     if rung_env and platform == "cpu":
         print(
             f"bench: TGPU_BENCH_RUNG={rung_env!r} ignored on the CPU "
@@ -482,10 +523,10 @@ def main() -> None:
                 f"TGPU_BENCH_RUNG={rung_env!r} is malformed: expected "
                 "'batch,chunks,checkpoint,fused' e.g. '128,4,except_last,1'"
             ) from e
-        if pinned[2] not in ("always", "except_last", "never"):
+        if pinned[2] not in ("always", "except_last", "never", "offload"):
             raise SystemExit(
                 f"TGPU_BENCH_RUNG checkpoint {pinned[2]!r} must be "
-                "always|except_last|never"
+                "always|except_last|never|offload"
             )
         if pinned[3] and n_stages > 1:
             raise SystemExit(
@@ -536,11 +577,12 @@ def main() -> None:
                 # miscalibrated predictor must not leave the loop with no
                 # rung ever run.
                 and rung != ladder[-1]
-                # 'always' holds no cell residuals between programs, and
-                # the FUSED engine keeps residuals inside one program
-                # (XLA's scheduling, not program arguments) — nothing for
-                # this predictor to predict in either case.
-                and ckpt_cfg != "always"
+                # 'always' holds no cell residuals between programs,
+                # 'offload' holds them in HOST memory, and the FUSED
+                # engine keeps residuals inside one program (XLA's
+                # scheduling, not program arguments) — nothing for this
+                # predictor to predict in any of those cases.
+                and ckpt_cfg in ("except_last", "never")
                 and not fused_cfg
             ):
                 resid = _rung_residual_bytes(model, x)
@@ -701,6 +743,10 @@ def main() -> None:
         "vs_baseline": vs,
         "mfu": None,
         "platform": platform,
+        # The async-dispatch sanity gate (mfu <= 1 check / blocked
+        # re-time) hasn't run yet: a partial promoted by the supervisor's
+        # deadline is machine-discountable (advisor r5).
+        "validated": False,
     }
     print(_PARTIAL_PREFIX + json.dumps(result), flush=True)
     # MFU: analytic model FLOPs per step / measured step time / chip peak.
@@ -749,8 +795,11 @@ def main() -> None:
         result["metric"] = f"train samples/sec/chip [{tag}]"
         result["value"] = round(samples_per_sec, 3)
         result["vs_baseline"] = vs
+        result["validated"] = True  # the blocked loop cannot over-report
     result["mfu"] = mfu
-    print(json.dumps(result), flush=True)
+    if mfu is not None and mfu <= 1.0:
+        result["validated"] = True  # async number passed the sanity gate
+    print(_FINAL_PREFIX + json.dumps(result), flush=True)
 
 
 def _reexec_cpu_fallback(msg: str) -> None:

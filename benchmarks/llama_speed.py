@@ -119,10 +119,19 @@ def causal_lm_loss(out, tokens):
               help="sliding-window attention: attend iff 0 <= qpos - kpos "
                    "< N (Mistral-style); compute in the flash kernels "
                    "scales with the window, not the sequence length")
+@click.option("--autotune/--no-autotune", default=False,
+              help="run the static step autotuner (torchgpipe_tpu.tune) "
+                   "before timing: sweeps remat policy x micro-batch "
+                   "count x CE chunk, prints the frontier, and times the "
+                   "best HBM-feasible candidate instead of the CLI flags' "
+                   "checkpoint/chunks (spmd engine, fill_drain)")
+@click.option("--hbm-budget-gib", default=15.75,
+              help="per-chip HBM budget for --autotune feasibility "
+                   "(default: the v5e AOT limit)")
 def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
          checkpoint, moe_experts, moe_top_k, ep, tp, dp, schedule,
          virtual_stages, fsdp, moe_dispatch, moe_router, fused_ce,
-         attn_window):
+         attn_window, autotune, hbm_budget_gib):
     n, bsz, chunks = EXPERIMENTS[experiment]
     bsz = batch or bsz
     dim, n_layers, n_heads, n_kv, vocab, mlp_ratio = PRESETS[preset]
@@ -171,12 +180,17 @@ def main(experiment, preset, engine, seq, batch, epochs, steps, bf16,
         )
     x = jnp.zeros((bsz, seq), jnp.int32)
 
+    if autotune and (engine != "spmd" or schedule != "fill_drain"):
+        raise click.UsageError(
+            "--autotune models the spmd engine's fill_drain schedule "
+            "(tune_step); pass --engine spmd without --schedule"
+        )
     if engine == "spmd":
         tput = _run_spmd(
             cfg, n, chunks, x, epochs, steps, checkpoint, experiment, moe,
             ep, tp, dp, fsdp, schedule,
             virtual_stages if schedule == "interleaved" else 1,
-            fused_ce,
+            fused_ce, autotune=autotune, hbm_budget_gib=hbm_budget_gib,
         )
     elif fused_ce:
         # Headless model + parametric chunked-CE loss layer: the head
@@ -305,7 +319,8 @@ def _print_router_stats(params, h, moe):
 
 def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
               ep=1, tp=1, dp=1, fsdp=False, schedule="fill_drain",
-              virtual_stages=1, fused_ce=False):
+              virtual_stages=1, fused_ce=False, autotune=False,
+              hbm_budget_gib=15.75):
     from benchmarks.common import run_epoch_loop
     from torchgpipe_tpu.models.transformer import llama_spmd
     from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
@@ -342,6 +357,30 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
     # SpmdGPipe shards data over the mesh; the causal shift happens on the
     # host so inputs/targets ride the same sharding specs.
     inputs, targets = x[:, :-1], x[:, 1:]
+    if autotune:
+        # Static sweep BEFORE any compile: pick the point on the
+        # recompute/memory curve instead of the CLI's checkpoint/chunks
+        # (the hand-walked rung replacement; docs/tuning.md).
+        from torchgpipe_tpu import tune
+
+        report = tune.tune_step(
+            pipe, jax.ShapeDtypeStruct(inputs.shape, inputs.dtype),
+            hbm_budget_bytes=int(hbm_budget_gib * 2 ** 30),
+        )
+        print(report.table(), flush=True)
+        best = report.best
+        if best is None:
+            raise SystemExit(
+                "autotune: no candidate fits the "
+                f"{hbm_budget_gib} GiB budget (see the table above)"
+            )
+        print(
+            f"autotune | timing checkpoint={best.checkpoint!r} "
+            f"policy={best.policy or '-'} chunks={best.chunks}"
+            + (f" ce_chunk={best.ce_chunk}" if best.ce_chunk else ""),
+            flush=True,
+        )
+        pipe = tune.apply_candidate(pipe, best)
     carry = {
         "params": pipe.init(
             jax.random.PRNGKey(0),

@@ -75,6 +75,7 @@ def _run_step(ranks, batch, target, rng, loss_fn=_loss):
 
 
 @pytest.mark.parametrize("checkpoint", ["never", "except_last", "always"])
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_distributed_matches_sequential(checkpoint):
     layers = _mlp()
     transport = LocalTransport()

@@ -36,6 +36,7 @@ def _mean_loss(out, tgt):
 
 @pytest.mark.parametrize("checkpoint", ["always", "except_last", "never"])
 @pytest.mark.parametrize("batch", [8, 7])  # 7 -> ragged micro-batches
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_1f1b_matches_gpipe_schedule(checkpoint, batch):
     x = jax.random.normal(jax.random.PRNGKey(0), (batch, 8, 8, 3))
     y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, 5)

@@ -332,6 +332,83 @@ def test_dead_code_clean():
 
 
 # --------------------------------------------------------------------- #
+# remat-policy-names                                                    #
+# --------------------------------------------------------------------- #
+
+
+def _named_dense(dim, name, tag="attn_out"):
+    """A dense layer whose output is a checkpoint-named save point."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    inner = dense(dim, name=name)
+
+    def apply(params, state, x, *, rng=None, train=True):
+        y, s = inner.apply(params, state, x, rng=rng, train=train)
+        return checkpoint_name(y, tag), s
+
+    return dataclasses.replace(inner, apply=apply)
+
+
+def test_remat_policy_names_fires_on_silent_noop(cpu_devices):
+    from torchgpipe_tpu.checkpoint import policies
+
+    # The seeded bug: a named-save policy over a model that emits NO
+    # checkpoint_name tags — the policy saves nothing and the engine
+    # silently recomputes everything ('always' cost at 'policy' spelling).
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")], name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="always", dp_axis="dp",
+                     remat_policy=policies.save_attn_out)
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    found = _by_rule(analysis.lint(pipe, x), "remat-policy-names")
+    assert found and found[0].severity == Severity.ERROR
+    assert "silent no-op" in found[0].message
+    assert "attn_out" in found[0].message
+
+
+def test_remat_policy_names_clean_when_tags_exist(cpu_devices):
+    from torchgpipe_tpu.checkpoint import policies
+
+    block = chain([layer_norm(name="ln"), _named_dense(16, "fc")],
+                  name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="always", dp_axis="dp",
+                     remat_policy=policies.save_attn_out)
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    assert analysis.lint(pipe, x) == []
+
+
+def test_remat_policy_names_warns_on_partially_missing(cpu_devices):
+    from torchgpipe_tpu.checkpoint import policies
+
+    block = chain([layer_norm(name="ln"), _named_dense(16, "fc")],
+                  name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="always", dp_axis="dp",
+                     remat_policy=policies.save_names("attn_out", "nope"))
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    found = _by_rule(analysis.lint(pipe, x), "remat-policy-names")
+    assert found and found[0].severity == Severity.WARNING
+    assert "'nope'" in found[0].message
+
+
+def test_remat_policy_names_default_offload_is_quiet(cpu_devices):
+    # checkpoint='offload' installs the catch-all default preset: models
+    # that emit SOME canonical tag must not warn about the tags they
+    # don't (e.g. no flash kernel in the path).
+    block = chain([layer_norm(name="ln"), _named_dense(16, "fc")],
+                  name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="offload", dp_axis="dp")
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    assert _by_rule(analysis.lint(pipe, x), "remat-policy-names") == []
+
+
+# --------------------------------------------------------------------- #
 # suppression + API surface                                             #
 # --------------------------------------------------------------------- #
 

@@ -23,7 +23,7 @@ import pytest
 
 BENCH = Path(__file__).resolve().parent.parent / "bench.py"
 
-FINAL_LINE = json.dumps(
+FINAL_LINE = "BENCH_FINAL " + json.dumps(
     {
         "metric": "train samples/sec/chip [stand-in, cpu]",
         "value": 123.0,
@@ -31,6 +31,7 @@ FINAL_LINE = json.dumps(
         "vs_baseline": None,
         "mfu": None,
         "platform": "cpu",
+        "validated": True,
     }
 )
 
@@ -128,7 +129,8 @@ def test_fallback_stage_runs_cpu_pinned_child(tmp_path):
         tmp_path,
         "if os.environ.get('JAX_PLATFORMS') == 'cpu':\n"
         "    tag = 'fb=' + os.environ.get('TGPU_DEADLINE_FALLBACK', '?')\n"
-        "    print('{\"metric\": \"m [' + tag + ']\", \"value\": 1.5, "
+        "    print('BENCH_FINAL {\"metric\": \"m [' + tag + ']\", "
+        '"value": 1.5, '
         '"unit": "u", "vs_baseline": null, "mfu": null, '
         '"platform": "cpu"}\')\n'
         "else:\n"
@@ -166,6 +168,39 @@ def test_crashing_child_falls_back(tmp_path):
     )
     obj = _the_one_json_line(_run_supervisor(child, "20", "10", cpu_pinned=False))
     assert obj["value"] == 123.0
+
+
+def test_metric_noise_line_is_not_a_result(tmp_path):
+    # Advisor r5: final-result detection used to sniff any '{'-led stdout
+    # line carrying a '"metric"' key — a structured-log noise line could
+    # silently replace the genuine result.  Only the BENCH_FINAL sentinel
+    # counts now; bare metric-shaped noise must fall through to the
+    # static zero-value line.
+    noise = json.dumps({"metric": "absl structured log", "value": 9.9})
+    child = _write_child(tmp_path, f"print({noise!r})\n")
+    obj = _the_one_json_line(_run_supervisor(child, "4", "1", cpu_pinned=True))
+    assert obj["value"] == 0.0
+    assert obj["platform"] == "none"
+    assert obj["validated"] is False
+
+
+def test_stdout_eof_returns_without_burning_the_budget(tmp_path):
+    # Advisor r5: when the child closes stdout but stays alive (plugin
+    # helper hang), no further output can arrive — the supervisor must
+    # return the captured result immediately instead of polling out the
+    # whole deadline.
+    import time as _time
+
+    child = _write_child(
+        tmp_path,
+        f"print({FINAL_LINE!r}, flush=True)\n"
+        "os.close(1)\n"
+        "time.sleep(60)\n",
+    )
+    t0 = _time.monotonic()
+    obj = _the_one_json_line(_run_supervisor(child, "30", "5", cpu_pinned=True))
+    assert obj["value"] == 123.0
+    assert _time.monotonic() - t0 < 15.0
 
 
 @pytest.mark.parametrize("cpu_pinned", [True, False])

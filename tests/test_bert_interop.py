@@ -39,6 +39,7 @@ def _tokens(b, s, mult=5, add=2):
     return (np.arange(b * s).reshape(b, s) * mult + add) % 96
 
 
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_hidden_states_match_hf():
     """Encoder parity: post-norm blocks, embedding LayerNorm, folded
     token-type row, bidirectional attention — per-token hidden states
@@ -63,6 +64,7 @@ def test_hidden_states_match_hf():
     )
 
 
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_bert_fine_tunes_through_pipeline():
     """The imported encoder + a user task head trains through GPipe:
     mean-pool classification on a separable token task."""

@@ -118,3 +118,230 @@ def test_dispatch_follows_clock_cycles():
     # (torchgpipe/pipeline.py:128-132).
     bwd = [(e.mbatch, e.stage) for e in tracer.events if e.name == "bwd"]
     assert bwd == list(reversed(expected)), bwd
+
+
+# --------------------------------------------------------------------- #
+# checkpoint='offload' + named-save policies (docs/tuning.md)           #
+# --------------------------------------------------------------------- #
+
+
+def test_checkpoint_stop_offload_stores_like_never():
+    assert checkpoint_stop("offload", 4, train=True) == 0
+    assert checkpoint_stop("offload", 4, train=False) == 0
+
+
+def _tiny_llama():
+    import numpy as np
+
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        cross_entropy,
+        llama,
+    )
+
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=2, n_heads=2)
+    x = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (4, 8)), jnp.int32
+    )
+
+    def loss(out, tok):
+        return cross_entropy(out[:, :-1, :], tok[:, 1:])
+
+    return llama(cfg), x, loss
+
+
+def test_gpipe_offload_matches_never_bitwise():
+    # Per-cell 'offload' is the 'never' schedule with the vjp closures
+    # parked in host memory between the schedules: on any backend the
+    # loss AND gradients must be bit-identical to 'never'.
+    layers, x, loss = _tiny_llama()
+    results = {}
+    for mode in ("never", "offload"):
+        m = GPipe(layers, balance=[2, 2], chunks=2, checkpoint=mode)
+        p, s = m.init(
+            jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+        results[mode] = m.value_and_grad(p, s, x, x, loss)
+    l0, g0 = results["never"][0], results["never"][1]
+    l1, g1 = results["offload"][0], results["offload"][1]
+    assert float(l0) == float(l1)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+    ):
+        assert (jnp.asarray(a) == jnp.asarray(b)).all()
+
+
+def test_gpipe_offload_and_remat_policy_validation():
+    import pytest
+
+    from torchgpipe_tpu.checkpoint import policies
+
+    layers, _, _ = _tiny_llama()
+    one = [jax.devices()[0]]
+    with pytest.raises(ValueError, match="per-cell scheduler feature"):
+        GPipe(layers, balance=[2, 2], chunks=2, checkpoint="offload",
+              fused=True, devices=one)
+    with pytest.raises(ValueError, match="fill-drain"):
+        GPipe(layers, balance=[2, 2], chunks=2, checkpoint="offload",
+              schedule="1f1b", loss_reduction="mean")
+    with pytest.raises(ValueError, match="FUSED path"):
+        GPipe(layers, balance=[2, 2], chunks=2,
+              remat_policy=policies.save_attn_out)
+    # The supported spelling: fused + a named-save policy.
+    GPipe(layers, balance=[2, 2], chunks=2, fused=True, devices=one,
+          remat_policy=policies.save_attn_out)
+
+
+def test_fused_remat_policy_matches_default_loss(cpu_devices):
+    # A named-save policy changes WHAT the fused cells keep, never what
+    # they compute: loss and grads must match the policy-free fused run.
+    from torchgpipe_tpu.checkpoint import policies
+
+    layers, x, loss = _tiny_llama()
+    outs = []
+    for pol in (None, policies.save_attn_out):
+        m = GPipe(layers, balance=[2, 2], chunks=2, fused=True,
+                  devices=[cpu_devices[0]], remat_policy=pol)
+        p, s = m.init(
+            jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+        outs.append(m.value_and_grad(p, s, x, x, loss))
+    import numpy as np
+
+    np.testing.assert_allclose(
+        float(outs[0][0]), float(outs[1][0]), rtol=1e-6
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(outs[0][1]),
+        jax.tree_util.tree_leaves(outs[1][1]),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_spmd_offload_matches_always(cpu_devices):
+    import numpy as np
+
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        cross_entropy,
+        llama_spmd,
+    )
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=2, n_heads=2)
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    x = jnp.asarray(
+        np.random.RandomState(1).randint(0, 64, (4, 8)), jnp.int32
+    )
+    outs = []
+    for mode in ("always", "offload"):
+        pipe = SpmdGPipe(block, 2, mesh, chunks=2,
+                         loss_fn=cross_entropy, pre=pre, post=post,
+                         checkpoint=mode)
+        params = pipe.init(
+            jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+        outs.append(pipe.train_step(params, x, x))
+    np.testing.assert_allclose(
+        float(outs[0][0]), float(outs[1][0]), rtol=1e-6
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(outs[0][1]),
+        jax.tree_util.tree_leaves(outs[1][1]),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_distributed_engine_rejects_offload():
+    import pytest
+
+    from torchgpipe_tpu.distributed.gpipe import DistributedGPipe
+
+    layers, _, _ = _tiny_llama()
+    with pytest.raises(ValueError, match="not supported by the distributed"):
+        DistributedGPipe(
+            layers, 0, ["w0", "w1"], [2, 2], chunks=2, transport=None,
+            mailbox=None, checkpoint="offload",
+        )
+
+
+def test_spmd_offload_rejects_explicit_gradient_schedules(cpu_devices):
+    import pytest
+
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        cross_entropy,
+        llama_spmd,
+    )
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=2, n_heads=2)
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    with pytest.raises(ValueError, match="fill_drain feature"):
+        SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+                  pre=pre, post=post, checkpoint="offload",
+                  schedule="1f1b")
+
+
+def test_offload_memory_relocation_machinery():
+    # The host relocation itself (pipeline._host_memory_kind/_to_memory):
+    # CPU's only memory kind IS host memory, so the engine SKIPS the move
+    # there (the skip contract), but _to_memory must still handle a real
+    # vjp closure pytree — leaf arrays device_put with an explicit
+    # memory kind, non-array closure cells passed through — because on
+    # TPU that is exactly what runs between the schedules.
+    import numpy as np
+
+    from torchgpipe_tpu.pipeline import _host_memory_kind, _to_memory
+
+    dev = jax.devices()[0]
+    # Skip contract: the CPU device's default memory IS its host memory.
+    assert _host_memory_kind(dev) is None
+
+    class _FakeMemory:
+        def __init__(self, kind):
+            self.kind = kind
+
+    class _FakeTpu:
+        def default_memory(self):
+            return _FakeMemory("device")
+
+        def addressable_memories(self):
+            return [_FakeMemory("device"), _FakeMemory("pinned_host")]
+
+    assert _host_memory_kind(_FakeTpu()) == "pinned_host"
+
+    # A real vjp closure round-trips through _to_memory with an explicit
+    # memory kind (CPU exposes 'unpinned_host'; on TPU the same call
+    # runs with 'pinned_host').
+    def f(w, x):
+        return jnp.tanh(x @ w)
+
+    w = jnp.ones((4, 4))
+    x = jnp.ones((2, 4))
+    y, pull = jax.vjp(f, w, x)
+    moved = _to_memory(pull, dev, "unpinned_host")
+    back = _to_memory(moved, dev, None)
+    gw, gx = back(jnp.ones_like(y))
+    gw_ref, gx_ref = pull(jnp.ones_like(y))
+    np.testing.assert_array_equal(np.asarray(gw), np.asarray(gw_ref))
+    np.testing.assert_array_equal(np.asarray(gx), np.asarray(gx_ref))
+
+
+def test_named_save_policy_introspection():
+    from torchgpipe_tpu.checkpoint import NAMED_SAVE_POINTS, policies
+
+    p = policies.save_attn_out
+    assert p.names == ("attn_out",) and not p.offload
+    off = policies.offload_default()
+    assert set(off.names) == set(NAMED_SAVE_POINTS)
+    assert off.default_preset
+    custom = policies.offload_names("mlp_hidden")
+    assert custom.names == ("mlp_hidden",)
+    assert "NamedSavePolicy" in repr(custom)

@@ -71,8 +71,60 @@ def test_uneven_blocks_and_long_kv():
 
 def test_supports_gate():
     assert supports((2, 1024, 16, 128), (2, 1024, 8, 128))
-    assert not supports((2, 1024, 16, 64), (2, 1024, 8, 64))   # d % 128
+    # head_dim < 128 is supported via zero-padding to one lane tile (the
+    # Llama-1B-class d=64 — what puts the kernel in the training path).
+    assert supports((2, 1024, 16, 64), (2, 1024, 8, 64))
+    assert not supports((2, 1024, 16, 192), (2, 1024, 8, 192))  # d % 128
     assert not supports((2, 1000, 16, 128), (2, 1000, 8, 128))  # s % block
+
+
+def test_padded_head_dim_matches_dense():
+    # d=64 rides the kernel with the head dim zero-padded to 128: scores
+    # and outputs must be EXACT vs the unpadded dense oracle (q/k padding
+    # adds zero to every score; v padding zeros the sliced-off dims), and
+    # gradients must flow back through the pad/slice unchanged.
+    b, s, h, g, d = 1, 128, 4, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = _rand(ks[0], (b, s, h, d))
+    k = _rand(ks[1], (b, s, g, d))
+    v = _rand(ks[2], (b, s, g, d))
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(
+            flash_attention(q, k, v, causal=True, interpret=True)
+        ))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(full_attention(q, k, v, causal=True)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_auto_picker_padded_head_seq_gate():
+    # The auto-picker puts the kernel in the jaxpr for padded heads only
+    # at seq >= PADDED_HEAD_MIN_SEQ (where flash is measured to win);
+    # exact-tile heads keep the kernel at any supported length.
+    from torchgpipe_tpu.parallel.ring_attention import attention
+
+    def has_pallas(d, s):
+        q = jax.ShapeDtypeStruct((1, s, 4, d), jnp.float32)
+        k = jax.ShapeDtypeStruct((1, s, 2, d), jnp.float32)
+        jaxpr = jax.make_jaxpr(
+            lambda q, k, v: attention(q, k, v, causal=True)
+        )(q, k, k)
+        return "pallas_call" in str(jaxpr)
+
+    assert has_pallas(64, 2048)       # padded head at the gate
+    assert not has_pallas(64, 1024)   # padded head below the gate: dense
+    assert has_pallas(128, 256)       # exact tile: any supported length
 
 
 def test_bf16_inputs():
@@ -218,6 +270,7 @@ def test_streaming_causal_skips_masked_fetches():
     assert fetches_w == band < tri
 
 
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_streaming_causal_grads_with_uneven_blocks():
     """Clamped index maps with block_q != block_k and causal masking:
     values and gradients must still match the dense oracle (the clamp
@@ -248,6 +301,7 @@ def test_streaming_causal_grads_with_uneven_blocks():
 
 @pytest.mark.parametrize("streaming", [False, True])
 @pytest.mark.parametrize("window", [16, 24, 64])
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_sliding_window_matches_dense(streaming, window):
     """Sliding-window flash attention (both kernel families) vs the dense
     masked oracle: values and gradients, including a window that is not a
@@ -368,6 +422,7 @@ def test_decode_kernel_under_jit_with_traced_length():
         )
 
 
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_decode_flash_wiring_through_generate(monkeypatch):
     """Forcing the decode kernel through the full generate() scan (greedy,
     trained-free tiny model) reproduces the dense decode token-for-token."""

@@ -43,6 +43,7 @@ def _models(**kw):
 
 
 @pytest.mark.parametrize("checkpoint", ["always", "except_last", "never"])
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_fused_matches_per_cell_train(checkpoint):
     # Ragged micro-batches (7 = 3+2+2) cross a skip boundary, with dropout
     # rng and BatchNorm state threading.

@@ -96,6 +96,7 @@ def _step_times(model, params, state, x):
     return t_dispatch, t_total
 
 
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_per_cell_dispatch_is_asynchronous():
     """Walking the whole fwd+bwd schedule (enqueue only) must cost well
     under half the executed step: the engine never syncs per cell."""

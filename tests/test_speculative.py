@@ -91,6 +91,7 @@ def test_decode_chunk_matches_sequential_steps(quant):
         )
 
 
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_chunk_rollback_then_overwrite_is_clean():
     """Writing a chunk, rolling length back, and decoding fresh tokens
     over the stale rows gives bit-identical results to never having

@@ -54,6 +54,7 @@ def mse(out, tgt):
         ("zb", {"checkpoint": "never"}),
     ],
 )
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_chain_resolved_skips_match_oracle(cpu_devices, schedule, kw):
     """stash/pop_cat inside each chain() stage: pipelined loss AND grads
     equal the stacked blocks applied sequentially on one device — the

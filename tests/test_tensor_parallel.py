@@ -222,6 +222,7 @@ def test_vocab_parallel_ce_outside_mesh_is_plain_ce():
     np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
 
 
+@pytest.mark.slow  # fast-gate budget (VERDICT r5 #6): covered by the CI full job
 def test_spmd_tp_with_sp(cpu_devices):
     """tp composes with sequence parallelism: pp=2 x sp=2 x tp=2 — ring
     attention runs over sp with tp-local head shards."""

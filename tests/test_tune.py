@@ -1,0 +1,353 @@
+"""The static step autotuner (torchgpipe_tpu.tune).
+
+The three load-bearing claims, asserted on CPU with no device compute:
+
+* the memory model's `eval_shape` residual accounting agrees with XLA's
+  own compiled memory analysis (guards the scoring against jax upgrades);
+* on the llama-1B preset at seq 4096 the sweep rejects the residual-wall
+  configs ('never'/'except_last') and returns a candidate with strictly
+  higher predicted MFU than the current default ('always', chunks=4) —
+  and the traced training jaxpr contains the Pallas flash-attention
+  kernel under the auto-picker;
+* on the amoebanet HEADLINE shape (batch 128, chunks 4 — the measured
+  17.7 GiB residual wall), XLA memory analysis proves
+  `checkpoint='offload'` brings per-stage device residents under the
+  16 GiB v5e budget where 'except_last' exceeds it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchgpipe_tpu import tune
+from torchgpipe_tpu.gpipe import GPipe
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    cross_entropy,
+    llama_spmd,
+)
+from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+V5E_BUDGET = int(15.75 * 2 ** 30)
+
+
+def lm_loss(out, tok):
+    return cross_entropy(out, tok)
+
+
+# --------------------------------------------------------------------- #
+# the flops walker vs XLA's cost analysis                               #
+# --------------------------------------------------------------------- #
+
+
+def test_flops_walker_matches_hlo_cost_analysis():
+    # On a loop-free, branch-free program the structure-aware walker and
+    # XLA's HLO cost analysis are counting the same matmuls — they must
+    # agree (the walker exists because XLA counts scan bodies once and
+    # sums cond branches).
+    from torchgpipe_tpu.analysis import jaxpr as jx
+
+    def f(w1, w2, x):
+        return jnp.sum((x @ w1) @ w2)
+
+    w = jax.ShapeDtypeStruct((128, 128), jnp.float32)
+    x = jax.ShapeDtypeStruct((32, 128), jnp.float32)
+    walker = jx.flops_estimate(jax.make_jaxpr(f)(w, w, x))
+    hlo = tune.hlo_flops(f, w, w, x)
+    assert hlo is not None
+    assert walker == pytest.approx(hlo, rel=0.15)
+
+
+def test_flops_walker_multiplies_scan_lengths():
+    from torchgpipe_tpu.analysis import jaxpr as jx
+
+    def body(h, w):
+        return h @ w, None
+
+    def scanned(ws, x):
+        y, _ = jax.lax.scan(body, x, ws)
+        return y
+
+    ws = jax.ShapeDtypeStruct((6, 64, 64), jnp.float32)
+    x = jax.ShapeDtypeStruct((8, 64), jnp.float32)
+    one = jx.flops_estimate(
+        jax.make_jaxpr(lambda w, x: x @ w)(
+            jax.ShapeDtypeStruct((64, 64), jnp.float32), x
+        )
+    )
+    assert jx.flops_estimate(jax.make_jaxpr(scanned)(ws, x)) == 6 * one
+
+
+# --------------------------------------------------------------------- #
+# eval_shape memory accounting vs XLA memory analysis                   #
+# --------------------------------------------------------------------- #
+
+
+def test_eval_shape_residuals_match_xla_memory_analysis():
+    # The autotuner's feasibility math rides eval_shape byte accounting;
+    # XLA's compiled memory analysis of the same per-stage program is the
+    # ground truth (output_size covers y + skips + state + the residual
+    # closure).  Tolerance absorbs layout padding/aliasing.
+    from torchgpipe_tpu.models.transformer import llama
+
+    cfg = TransformerConfig(vocab=256, dim=64, n_layers=4, n_heads=4,
+                            n_kv_heads=2)
+    model = GPipe(llama(cfg), balance=[2, 2, 2], chunks=2,
+                  checkpoint="except_last")
+    x = jax.ShapeDtypeStruct((8, 32), jnp.int32)
+    predicted = tune.mpmd_stage_residual_bytes(model, x)
+    assert predicted is not None and predicted > 0
+
+    from torchgpipe_tpu.layers import sequential_init
+
+    mb = jax.ShapeDtypeStruct((4, 32), jnp.int32)
+    flat_p, flat_s, _ = jax.eval_shape(
+        lambda: sequential_init(model.layers, jax.random.PRNGKey(0), mb)
+    )
+    # Find the stage whose residuals ARE the max (the number `predicted`
+    # reports), then compare against the compiled program's accounting.
+    best_j, best_bytes, cursor = 0, -1, mb
+    i = 0
+    per_stage = []
+    for j, part in enumerate(model.partitions):
+        stage = model._pipeline.stages[j]
+        p_j = flat_p[i: i + len(part)]
+        s_j = flat_s[i: i + len(part)]
+        i += len(part)
+        y, ext, st, pull = jax.eval_shape(
+            lambda xx, p=p_j, s=s_j, stg=stage: stg.fwd_vjp(
+                p, s, xx, {}, None, 0.5
+            ),
+            cursor,
+        )
+        nbytes = tune.tree_bytes(pull)
+        per_stage.append((j, nbytes, (y, ext, st, pull)))
+        if nbytes > best_bytes:
+            best_j, best_bytes = j, nbytes
+        cursor = y
+    assert best_bytes == predicted
+    ma = tune.mpmd_stage_memory_analysis(model, x, best_j)
+    assert ma is not None
+    predicted_out = tune.tree_bytes(per_stage[best_j][2])
+    assert ma.output_size_in_bytes == pytest.approx(predicted_out, rel=0.10)
+
+
+# --------------------------------------------------------------------- #
+# the sweep: ranking, application, llama-1B acceptance                  #
+# --------------------------------------------------------------------- #
+
+
+def _small_pipe(cpu_devices, **kw):
+    cfg = TransformerConfig(vocab=256, dim=64, n_layers=4, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    return SpmdGPipe(block, 2, mesh, chunks=4, loss_fn=lm_loss,
+                     pre=pre, post=post, checkpoint="always", **kw)
+
+
+def test_tune_step_ranks_and_candidate_applies(cpu_devices):
+    pipe = _small_pipe(cpu_devices)
+    x = jax.ShapeDtypeStruct((8, 32), jnp.int32)
+    report = tune.tune_step(pipe, x, hbm_budget_bytes=8 * 2 ** 30,
+                            chunks_options=(4,))
+    assert report.best is not None
+    # Feasible candidates come first, ranked by predicted MFU descending.
+    feas = [c for c in report.candidates if c.feasible]
+    mfus = [c.predicted_mfu for c in feas if c.predicted_mfu is not None]
+    assert mfus == sorted(mfus, reverse=True)
+    # Zero-recompute 'never' must out-rank full-recompute 'always'.
+    by_key = {(c.checkpoint, c.policy): c for c in feas}
+    assert (
+        by_key[("never", None)].predicted_mfu
+        > by_key[("always", None)].predicted_mfu
+    )
+    # The table renders every candidate.
+    assert report.table().count("\n") >= len(report.candidates)
+    # apply_candidate rebuilds a runnable engine.
+    tuned = tune.apply_candidate(pipe, report.best)
+    assert tuned.checkpoint == report.best.checkpoint
+    xs = jnp.asarray(
+        np.random.RandomState(0).randint(0, 256, (8, 32)), jnp.int32
+    )
+    params = tuned.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((8, 32), jnp.int32)
+    )
+    loss, grads = tuned.train_step(params, xs, xs)
+    assert np.isfinite(float(loss))
+
+
+def test_tune_llama1b_policy_beats_default_and_flash_in_jaxpr(cpu_devices):
+    # The acceptance pair for the MFU stack, on the REAL 1b preset shape
+    # (dim 2048, 16 blocks, 32/8 heads -> head_dim 64, vocab 128256) at
+    # seq 4096 under the v5e budget:
+    #   * tune_step returns a candidate with STRICTLY higher predicted
+    #     MFU than the current default config ('always', chunks=4), and
+    #     rejects the residual-wall modes outright;
+    #   * the traced training jaxpr contains the Pallas flash-attention
+    #     kernel under the auto-picker (head_dim 64 rides the padded
+    #     kernel at seq >= 2048).
+    cfg = TransformerConfig(vocab=128256, dim=2048, n_layers=16,
+                            n_heads=32, n_kv_heads=8, mlp_ratio=6.0,
+                            dtype=jnp.bfloat16)
+    block, pre, post = llama_spmd(cfg, 4)
+    mesh = make_mesh(4, 1, devices=cpu_devices[:4])
+    pipe = SpmdGPipe(block, 4, mesh, chunks=4, loss_fn=lm_loss,
+                     pre=pre, post=post, checkpoint="always")
+    x = jax.ShapeDtypeStruct((8, 4096), jnp.int32)
+
+    report = tune.tune_step(pipe, x, hbm_budget_bytes=V5E_BUDGET,
+                            chunks_options=(4,))
+    by_key = {(c.checkpoint, c.policy): c for c in report.candidates}
+    baseline = by_key[("always", None)]
+    assert baseline.feasible
+    best = report.best
+    assert best is not None
+    assert best.predicted_mfu > baseline.predicted_mfu
+    # The measured 1B residual wall, reproduced statically: storing
+    # residuals on-device cannot fit the chip.
+    assert not by_key[("never", None)].feasible
+    assert not by_key[("except_last", None)].feasible
+    # Host offload is feasible and moves real bytes off-device.
+    offload = by_key[("offload", "offload_default")]
+    assert offload.feasible and offload.host_bytes > 2 ** 30
+
+    from torchgpipe_tpu import microbatch
+    from torchgpipe_tpu.analysis import jaxpr as jx
+
+    params_spec = jax.eval_shape(
+        lambda r: pipe._init_host(r, x), jax.random.PRNGKey(0)
+    )
+    x_mb = jax.eval_shape(
+        lambda xx: microbatch.scatter_stacked(xx, 4), x
+    )
+    fn = pipe._build_train_step(use_rng=False)
+    jaxpr = jax.make_jaxpr(lambda p, a, b: fn(p, a, b))(
+        params_spec, x_mb, x_mb
+    )
+    assert any(
+        site.eqn.primitive.name == "pallas_call"
+        for site in jx.walk_eqns(jaxpr.jaxpr)
+    ), "flash kernel missing from the seq-4096 training jaxpr"
+
+
+# --------------------------------------------------------------------- #
+# the amoebanet headline shape: offload vs the 17.7 GiB residual wall   #
+# --------------------------------------------------------------------- #
+
+
+def _headline_amoebanet(checkpoint):
+    from torchgpipe_tpu.models.amoebanet import amoebanetd
+
+    layers = amoebanetd(num_classes=1000, num_layers=18, num_filters=256)
+    n = len(layers)
+    base, rem = n // 8, n % 8
+    balance = [base + (1 if j >= 8 - rem else 0) for j in range(8)]
+    model = GPipe(layers, balance=balance, chunks=4, checkpoint=checkpoint,
+                  compute_dtype=jnp.bfloat16)
+    x = jax.ShapeDtypeStruct((128, 224, 224, 3), jnp.float32)
+    return model, x
+
+
+def _per_stage_residual_bytes(model, x):
+    """eval_shape residual bytes of EVERY stage (not just the max) —
+    on the single-chip headline deployment the stages wrap around one
+    device, so the chip's residents are the SUM."""
+    from torchgpipe_tpu.layers import sequential_init
+
+    chunks = model.chunks
+    mb = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(
+            (a.shape[0] // chunks,) + a.shape[1:], a.dtype
+        ),
+        x,
+    )
+    flat_p, flat_s, _ = jax.eval_shape(
+        lambda: sequential_init(model.layers, jax.random.PRNGKey(0), mb)
+    )
+    out, cursor, i = [], mb, 0
+    for j, part in enumerate(model.partitions):
+        stage = model._pipeline.stages[j]
+        p_j = flat_p[i: i + len(part)]
+        s_j = flat_s[i: i + len(part)]
+        i += len(part)
+        y, _, _, pull = jax.eval_shape(
+            lambda xx, p=p_j, s=s_j, stg=stage: stg.fwd_vjp(
+                p, s, xx, {}, None, 1.0 / chunks
+            ),
+            cursor,
+        )
+        out.append(tune.tree_bytes(pull))
+        cursor = y
+    return out
+
+
+@pytest.mark.slow  # eval_shape-traces 8 full-size amoebanet stage vjps
+def test_headline_residual_wall_and_offload_eval_shape():
+    # The acceptance claim at the measured deployment: bench's headline
+    # rung runs all stages on ONE v5e chip (stages wrap around the
+    # devices present), so the chip's residents under 'except_last' are
+    # the SUM of the per-stage residual closures — the recorded
+    # 17.74 GiB wall (BENCH_NOTES round 2), over the 15.75 GiB budget.
+    # Under 'offload' the per-cell engine moves every one of those
+    # closures to HOST memory between the schedules, so the device-side
+    # residents drop to the transient working set.
+    model, x = _headline_amoebanet("except_last")
+    per_stage = _per_stage_residual_bytes(model, x)
+    single_chip_resid = sum(per_stage)
+    assert single_chip_resid == pytest.approx(17.74 * 2 ** 30, rel=0.05)
+    assert (
+        single_chip_resid + tune.DEFAULT_OVERHEAD_BYTES > V5E_BUDGET
+    ), "the residual wall should exceed the v5e budget"
+    # Multi-chip (one stage per chip) the same shape fits — the max
+    # stage alone is well under budget, which is what score_mpmd's
+    # per-stage accounting reports.
+    cand = tune.score_mpmd(model, x, V5E_BUDGET)
+    assert cand.resident_bytes == max(per_stage) + tune.DEFAULT_OVERHEAD_BYTES
+    # offload: the engine relocates ALL of it per micro-batch to host;
+    # nothing of the wall stays device-resident.
+    off_model, _ = _headline_amoebanet("offload")
+    off = tune.score_mpmd(off_model, x, V5E_BUDGET)
+    assert off.feasible
+    assert off.host_bytes >= model.chunks * max(per_stage) * 0.99
+    assert off.resident_bytes == tune.DEFAULT_OVERHEAD_BYTES
+
+
+@pytest.mark.slow  # compiles one full-size amoebanet stage on CPU (~15 min)
+def test_headline_offload_under_budget_by_xla_memory_analysis():
+    # The compiler's own accounting of the same wall.  Compiling ALL the
+    # stage programs on CPU would take hours, so the proof is in two
+    # steps: (1) XLA memory analysis of one representative stage must
+    # agree with the eval_shape accounting (validating the probe the sum
+    # is built from); (2) the XLA-validated per-stage numbers then prove
+    # the single-chip claim — 'except_last' keeps the residual closures
+    # device-resident (their sum exceeds the budget), while under
+    # 'offload' the device keeps only each program's arguments +
+    # transient temps, which fit comfortably even summed across every
+    # stage plus the bench overhead allowance.
+    model, x = _headline_amoebanet("except_last")
+    per_stage = _per_stage_residual_bytes(model, x)
+    probe_j = 1  # a mid-weight stage: ~3.3 GiB residuals, tractable compile
+    ma = tune.mpmd_stage_memory_analysis(model, x, probe_j)
+    assert ma is not None
+    # (1) The compiled program's outputs are y + skips + state + the
+    # residual closure; the closure dominates — XLA's number must match
+    # the eval_shape prediction the residual wall is summed from.
+    assert ma.output_size_in_bytes == pytest.approx(
+        per_stage[probe_j], rel=0.10
+    )
+    # (2a) except_last on the single-chip headline: residual closures
+    # from every stage are co-resident — over budget.
+    assert (
+        sum(per_stage) + tune.DEFAULT_OVERHEAD_BYTES > V5E_BUDGET
+    )
+    # (2b) offload: residual closures live on host; the device keeps the
+    # per-program working set.  Bound it by the measured stage's
+    # args + temps scaled to ALL stages (conservative: temps are
+    # transient and never all live at once) plus the overhead allowance.
+    working = ma.argument_size_in_bytes + ma.temp_size_in_bytes
+    n_stages = len(model.partitions)
+    assert (
+        working * n_stages + tune.DEFAULT_OVERHEAD_BYTES < V5E_BUDGET
+    )

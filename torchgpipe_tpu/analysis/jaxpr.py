@@ -23,7 +23,21 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
+
+
+def avalify(tree: Any) -> Any:
+    """Shaped leaves (arrays or anything with shape/dtype) ->
+    ``ShapeDtypeStruct``; everything else passes through.  The ONE
+    definition shared by the abstract tracer (analysis.trace) and the
+    autotuner (torchgpipe_tpu.tune)."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+        if hasattr(a, "shape") and hasattr(a, "dtype")
+        else a,
+        tree,
+    )
 
 # Primitive names by role (jax spells some of these differently across
 # versions — e.g. remat vs remat2 — so rules match against the set).
@@ -179,6 +193,86 @@ def max_eqn_output_bytes(jaxpr: Any) -> int:
         ),
         default=0,
     )
+
+
+def _shape_prod(shape: Any, dims: Any) -> int:
+    n = 1
+    for i in dims:
+        n *= int(shape[i])
+    return n
+
+
+def eqn_flops(eqn: Any) -> float:
+    """Analytic FLOPs of one compute-heavy equation (matmul/conv MACs × 2);
+    everything else counts 0 — elementwise work is noise next to the MXU
+    ops this estimator exists to weigh."""
+    name = eqn.primitive.name
+    if name == "dot_general":
+        lhs = eqn.invars[0].aval
+        rhs = eqn.invars[1].aval
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        batch = _shape_prod(lhs.shape, lb)
+        k = _shape_prod(lhs.shape, lc)
+        m = _shape_prod(
+            lhs.shape,
+            [i for i in range(len(lhs.shape)) if i not in lc and i not in lb],
+        )
+        n = _shape_prod(
+            rhs.shape,
+            [i for i in range(len(rhs.shape)) if i not in rc and i not in rb],
+        )
+        return 2.0 * batch * m * n * k
+    if name == "conv_general_dilated":
+        out = eqn.outvars[0].aval
+        rhs = eqn.invars[1].aval
+        dn = eqn.params["dimension_numbers"]
+        out_ch = int(rhs.shape[dn.rhs_spec[0]])
+        kernel_elems = 1
+        for d in rhs.shape:
+            kernel_elems *= int(d)
+        out_elems = 1
+        for d in out.shape:
+            out_elems *= int(d)
+        # MACs per output element = kernel elements feeding one output
+        # channel; feature_group_count is already reflected in the
+        # kernel's in-channel dim.
+        return 2.0 * out_elems * (kernel_elems / max(out_ch, 1))
+    return 0.0
+
+
+def flops_estimate(jaxpr: Any) -> float:
+    """Analytic matmul/conv FLOPs of a (possibly Closed) jaxpr with LOOP
+    STRUCTURE respected: ``scan`` bodies multiply by their static
+    ``length``, ``cond`` takes the max over branches (at runtime one
+    branch executes), ``while`` bodies count once (trip count unknown —
+    same convention as XLA's cost analysis, which counts EVERY loop body
+    once and SUMS cond branches; that convention undercounts pipelined
+    schedules and overcounts peeled tails, which is why the autotuner
+    uses this walker for scan-structured programs).
+    """
+    body = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    total = 0.0
+    for eqn in body.eqns:
+        name = eqn.primitive.name
+        subs = subjaxprs(eqn)
+        if name == "scan":
+            length = eqn.params.get("length") or 1
+            total += length * sum(flops_estimate(s) for s in subs)
+        elif name == "cond":
+            total += max((flops_estimate(s) for s in subs), default=0.0)
+        elif name == "pallas_call":
+            # Kernel body runs once per grid cell.
+            grid = getattr(eqn.params.get("grid_mapping"), "grid", ()) or ()
+            cells = 1
+            for g in grid:
+                if isinstance(g, int):
+                    cells *= g
+            total += cells * sum(flops_estimate(s) for s in subs)
+        elif subs:
+            total += sum(flops_estimate(s) for s in subs)
+        else:
+            total += eqn_flops(eqn)
+    return total
 
 
 def scan_lengths(jaxpr: Any) -> List[Optional[int]]:

@@ -50,7 +50,10 @@ def _check_remat_coverage(trace: PipelineTrace) -> List[Finding]:
     if trace.engine == "spmd":
         for prog in trace.by_kind(SPMD_TRAIN):
             n_remat = jx.count_eqns(prog.jaxpr.jaxpr, jx.REMAT_PRIMS)
-            if trace.checkpoint in ("always", "except_last") and n_remat == 0:
+            if (
+                trace.checkpoint in ("always", "except_last", "offload")
+                and n_remat == 0
+            ):
                 out.append(Finding(
                     rule="remat-coverage",
                     severity=Severity.ERROR,
@@ -428,6 +431,72 @@ def _check_dead_code(trace: PipelineTrace) -> List[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# remat-policy-names (silent no-op named-save policies)                 #
+# --------------------------------------------------------------------- #
+
+
+def _named_save_points(trace: PipelineTrace) -> set:
+    """Every ``checkpoint_name`` tag occurring in any traced program."""
+    names = set()
+    for prog in trace.programs:
+        for site in jx.walk_eqns(prog.jaxpr.jaxpr):
+            if site.eqn.primitive.name == "name":
+                names.add(site.eqn.params.get("name"))
+    return names
+
+
+def _check_remat_policy_names(trace: PipelineTrace) -> List[Finding]:
+    """A named-save remat policy whose name set never occurs in the
+    traced program saves NOTHING: the engine silently degrades to full
+    recompute ('always' cost) — or, under ``checkpoint='offload'``,
+    offloads nothing while claiming to.  Policies declare their names via
+    :class:`torchgpipe_tpu.checkpoint.NamedSavePolicy` (the presets in
+    ``checkpoint.policies``); opaque callables are not inspectable and
+    are skipped."""
+    policy = getattr(trace.pipe, "remat_policy", None)
+    declared = getattr(policy, "names", None)
+    if not declared or not trace.programs:
+        return []
+    present = _named_save_points(trace)
+    missing = [n for n in declared if n not in present]
+    if not missing:
+        return []
+    if len(missing) == len(declared):
+        return [Finding(
+            rule="remat-policy-names",
+            severity=Severity.ERROR,
+            path="remat_policy",
+            message=(
+                f"remat policy {getattr(policy, 'label', policy)!r} saves "
+                f"only the checkpoint-named values {list(declared)}, but "
+                "NONE of those names occur in the traced program — the "
+                "policy is a silent no-op (every intermediate is "
+                "recomputed; under 'offload', nothing reaches host "
+                "memory).  Tag the model's intermediates with "
+                "jax.ad_checkpoint.checkpoint_name (the framework "
+                "transformer block tags attn_out/mlp_hidden/ce_logits), "
+                "or pick a structural policy like "
+                "checkpoint.policies.dots_no_batch"
+            ),
+        )]
+    if getattr(policy, "default_preset", False):
+        # Engine-installed catch-all (e.g. the 'offload' default covers
+        # every canonical tag): absent individual names are expected.
+        return []
+    return [Finding(
+        rule="remat-policy-names",
+        severity=Severity.WARNING,
+        path="remat_policy",
+        message=(
+            f"remat policy {getattr(policy, 'label', policy)!r} names "
+            f"{missing} which never occur in the traced program (present "
+            f"named save points: {sorted(present) or 'none'}); those "
+            "entries save nothing"
+        ),
+    )]
+
+
+# --------------------------------------------------------------------- #
 # registry + runner                                                     #
 # --------------------------------------------------------------------- #
 
@@ -465,6 +534,12 @@ RULES: List[Rule] = [
         "dead-code",
         "no unused parameter leaves, no dead compute-heavy equations",
         _check_dead_code,
+    ),
+    Rule(
+        "remat-policy-names",
+        "a named-save remat policy must reference checkpoint names that "
+        "occur in the traced program (no silent no-op policies)",
+        _check_remat_policy_names,
     ),
 ]
 

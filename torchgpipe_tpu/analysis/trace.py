@@ -77,14 +77,7 @@ class PipelineTrace:
         return None
 
 
-def _avalify(tree: Pytree) -> Pytree:
-    """Arrays (or anything shaped) -> ShapeDtypeStruct; avals pass through."""
-    return jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
-        if hasattr(a, "shape") and hasattr(a, "dtype")
-        else a,
-        tree,
-    )
+from torchgpipe_tpu.analysis.jaxpr import avalify as _avalify  # noqa: E402
 
 
 def _leaf_names(tree: Pytree, prefix: str = "") -> Tuple[str, ...]:

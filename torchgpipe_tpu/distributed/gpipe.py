@@ -133,6 +133,17 @@ class DistributedGPipe:
             raise ValueError(
                 f"checkpoint is not one of {'|'.join(CHECKPOINT_MODES)}"
             )
+        if checkpoint == 'offload':
+            # Accepting it would silently run the 'never' schedule with
+            # every rank's residuals DEVICE-resident — the opposite of
+            # what the mode promises.  Host-relocating the per-rank vjp
+            # closures needs scheduler support this engine doesn't have.
+            raise ValueError(
+                "checkpoint='offload' is not supported by the distributed "
+                "MPMD engine (per-rank residual relocation is not wired "
+                "into its scheduler); use the single-process GPipe or the "
+                "SPMD engine for host-offloaded residuals"
+            )
 
         if deferred_batch_norm:
             layers = convert_deferred_batch_norm(layers, chunks)

@@ -67,6 +67,7 @@ class GPipe:
         fused: bool = False,
         schedule: str = 'gpipe',
         loss_reduction: Optional[str] = None,
+        remat_policy: Any = None,
         tracer: Any = None,
     ) -> None:
         if balance is None:
@@ -167,8 +168,49 @@ class GPipe:
                     "per-cell tracer would record nothing; drop the tracer "
                     "or pass fused=False"
                 )
+        if checkpoint == 'offload':
+            # Per-cell 'offload' = the 'never' schedule (every cell keeps
+            # its vjp residuals, zero recompute) with the residual
+            # closures moved to HOST memory between the forward and
+            # backward schedules — the per-cell engine's residuals are
+            # explicit program outputs, so the engine itself relocates
+            # them (no save-policy machinery needed).  The fused path
+            # keeps its residuals INSIDE one program where only a remat
+            # save policy can place them — use fused=False here, or
+            # fused=True with remat_policy=policies.offload_names(...).
+            if fused:
+                raise ValueError(
+                    "checkpoint='offload' is a per-cell scheduler feature "
+                    "(residuals are program outputs the engine moves to "
+                    "host memory); with fused=True pass a "
+                    "remat_policy=checkpoint.policies.offload_names(...) "
+                    "instead, or drop fused=True"
+                )
+            if schedule != 'gpipe':
+                raise ValueError(
+                    "checkpoint='offload' supports the fill-drain "
+                    "('gpipe') schedule only — 1F1B already bounds "
+                    "in-flight residuals at the pipeline depth"
+                )
+        if remat_policy is not None and not fused:
+            raise ValueError(
+                "remat_policy refines the FUSED path's per-cell "
+                "jax.checkpoint (GPipe(fused=True, remat_policy=...)); "
+                "the per-cell scheduler's checkpointed cells keep no "
+                "residuals at all (recompute-ahead), so a save policy "
+                "cannot apply — drop remat_policy, or use fused=True / "
+                "the SPMD engine's SpmdGPipe.remat_policy"
+            )
+        if remat_policy is not None and checkpoint == 'never':
+            raise ValueError(
+                "remat_policy has no effect under checkpoint='never' "
+                "(no cell is rematerialized)"
+            )
         self.fused = fused
-        self._pipeline = Pipeline(stages, self.skip_layout, tracer=tracer)
+        self.remat_policy = remat_policy
+        self._pipeline = Pipeline(
+            stages, self.skip_layout, tracer=tracer, remat_policy=remat_policy
+        )
 
     # ------------------------------------------------------------------ #
     # container protocol (reference gpipe.py:257-285)                    #
@@ -362,7 +404,8 @@ class GPipe:
             )
         else:
             loss, grads, new_states, aux = self._pipeline.run_train(
-                params, state, mbatches, target, loss_fn, rng, stop
+                params, state, mbatches, target, loss_fn, rng, stop,
+                offload=self.checkpoint == 'offload',
             )
         return loss, tuple(grads), tuple(new_states), aux
 
@@ -478,6 +521,7 @@ class GPipe:
         loss, grads, loss_grads, new_states, aux = self._pipeline.run_train(
             params, state, mbatches, target, loss_layer, rng, stop,
             loss_params=loss_params,
+            offload=self.checkpoint == 'offload',
         )
         return loss, tuple(grads), loss_grads, tuple(new_states), aux
 

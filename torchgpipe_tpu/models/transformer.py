@@ -23,6 +23,7 @@ from typing import Any, Callable, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from torchgpipe_tpu.layers import Layer, chain
@@ -491,6 +492,10 @@ def transformer_block(
             # After the tp psum: the bias is per-output-feature, added
             # once — inside the region each lane would contribute a copy.
             attn_out = attn_out + params["bo"]
+        # Named save point (checkpoint.NAMED_SAVE_POINTS): a remat policy
+        # like checkpoint.policies.save_attn_out keeps (or offloads) this
+        # one [b, s, dim] tensor per block and recomputes everything else.
+        attn_out = checkpoint_name(attn_out, "attn_out")
         # GPT-NeoX-style parallel residual: the MLP branch reads the
         # BLOCK INPUT (ln2 of x, not of x + attn_out) and both branch
         # outputs land in one residual add at the end.
@@ -510,6 +515,9 @@ def transformer_block(
             if tp_active:
                 h = psum_grad(h, cfg.tp_axis)
             hid = _act_fn(cfg.act)(h @ params["w_fc"] + params["b_fc"])
+            # Named save point: keeping the [b, s, hidden] activation lets
+            # the backward recompute only the down-projection.
+            hid = checkpoint_name(hid, "mlp_hidden")
             mlp_out = hid @ params["w_proj"]
             if tp_active:
                 mlp_out = psum_value(mlp_out, cfg.tp_axis)
@@ -519,7 +527,8 @@ def transformer_block(
                 h = psum_grad(h, cfg.tp_axis)
             gate = _act_fn(cfg.act)(h @ params["w_gate"])
             up = h @ params["w_up"]
-            mlp_out = (gate * up) @ params["w_down"]
+            hid = checkpoint_name(gate * up, "mlp_hidden")
+            mlp_out = hid @ params["w_down"]
             if tp_active:
                 mlp_out = psum_value(mlp_out, cfg.tp_axis)
         if post:
@@ -836,8 +845,11 @@ def lm_head(
             logits = h @ w  # local [.., vocab/tp]
             if gather_logits:
                 logits = all_gather_value(logits, cfg.tp_axis, axis=-1)
-            return logits, state
-        return h @ w, state
+            return checkpoint_name(logits, "ce_logits"), state
+        # Named save point: under remat, dropping "ce_logits" from the
+        # save set recomputes the [tokens, vocab] matrix instead of
+        # holding it across the backward.
+        return checkpoint_name(h @ w, "ce_logits"), state
 
     tp = cfg.tp_axis
     norm_spec = (
@@ -939,7 +951,14 @@ def chunked_lm_loss(
         del rng, train
         return jnp.mean(row_loss(params, state, y_and_labels)), state
 
-    meta: dict = {"row_loss": row_loss}
+    meta: dict = {
+        "row_loss": row_loss,
+        # Declared so the static autotuner (torchgpipe_tpu.tune) can sweep
+        # the vocab-chunk size: the live softmax tile is [tokens, chunk],
+        # so the chunk trades loss-phase memory against launch overhead.
+        "ce_chunk": chunk,
+        "with_ce_chunk": lambda c: chunked_lm_loss(cfg, chunk=c, name=name),
+    }
     if cfg.tie_embeddings:
         meta["tie_pre"] = ("table",)
     return Layer(name=name, init=init, apply=apply, meta=meta)

@@ -696,11 +696,20 @@ def _flash_vjp_fwd(
     streaming: bool,
     window: Optional[int],
 ) -> Tuple:
+    from jax.ad_checkpoint import checkpoint_name
+
     fwd = _flash_fwd_call_stream if streaming else _flash_fwd_call
     o, lse = fwd(
         q, k, v, h, g, causal, sm_scale, blocks[0], blocks[1], interpret,
         window,
     )
+    # Checkpoint-named so remat policies compose with the kernel: a policy
+    # saving "flash_out"/"flash_stats" keeps (or host-offloads) the vjp
+    # residuals and the backward never replays the forward kernel; a
+    # policy dropping them recomputes the kernel once in the backward
+    # (checkpoint.NAMED_SAVE_POINTS; docs/tuning.md).
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_stats")
     return o, (q, k, v, o, lse)
 
 
@@ -891,13 +900,30 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # --------------------------------------------------------------------- #
 
 
+# Minimum sequence length at which the PADDED-head kernel (head_dim < 128
+# zero-padded to the 128-lane tile) is preferred over dense XLA attention
+# by the auto-picker: the MXU pads the lane dim to 128 either way, but the
+# kernel's fixed overheads only amortize at the lengths where flash was
+# measured faster (resident kernels: 14.5 vs 18.9 ms at seq 2048, 43.8 vs
+# 64.7 ms at 4096 fwd+bwd on v5e — BENCH_NOTES.md flash table).  Exact
+# 128-multiple heads keep using the kernel at any supported length.
+PADDED_HEAD_MIN_SEQ = 2048
+
+
 def supports(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
              block: int = 128) -> bool:
-    """Whether shapes meet the kernel's TPU tiling constraints."""
+    """Whether shapes meet the kernel's TPU tiling constraints.
+
+    Head dims that are not a 128 multiple are supported up to 128 by
+    zero-padding the head dimension to one lane tile (the Llama-1B-class
+    ``head_dim=64``): q/k padding adds zero to every score and v padding
+    zeros the padded output dims, so the math is exact, and the MXU pads
+    the lane dimension to 128 regardless — see :func:`flash_attention`.
+    """
     b, s, h, d = q_shape
     g = k_shape[2]
     return (
-        d % 128 == 0
+        (d % 128 == 0 or d < 128)
         and s % block == 0
         and k_shape[1] % block == 0
         and h % g == 0
@@ -919,9 +945,10 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Fused flash attention.  ``q``: ``[b, s, h, d]``; ``k, v``:
     ``[b, s_k, g, d]`` with ``g`` dividing ``h`` (GQA).  Returns
-    ``[b, s, h, d]`` in ``q.dtype``.  Requires ``d % 128 == 0`` and
-    sequence lengths divisible by the block sizes (see :func:`supports`);
-    ``interpret=True`` runs the kernels on any backend for testing.
+    ``[b, s, h, d]`` in ``q.dtype``.  Requires ``d % 128 == 0`` or
+    ``d < 128`` (the head dim is zero-padded to one 128-lane tile — exact,
+    see :func:`supports`) and sequence lengths divisible by the block
+    sizes; ``interpret=True`` runs the kernels on any backend for testing.
 
     ``window`` (requires ``causal``) is Mistral-style sliding-window
     attention: attend iff ``0 <= qpos - kpos < window``.  Every kernel
@@ -947,6 +974,25 @@ def flash_attention(
     g = k.shape[2]
     sm_scale = d ** -0.5 if sm_scale is None else sm_scale
     _validate_window(causal, window)
+    d_pad = (-d) % 128
+    if d_pad:
+        if d > 128:
+            raise ValueError(
+                f"flash_attention requires head_dim % 128 == 0 or "
+                f"head_dim < 128 (got {d}); see supports()"
+            )
+        # Zero-pad head_dim to the 128-lane tile (Mosaic's last-dim tile
+        # is always 128; the MXU pads the lane dim to 128 regardless, so
+        # the extra MACs are largely free).  Exactness: sm_scale above is
+        # computed from the ORIGINAL d; padded q/k dims contribute zero
+        # to every score; padded v dims make the extra output dims
+        # exactly zero and are sliced off below.  Autodiff through the
+        # pad/slice routes gradients back to the unpadded operands.
+        widths = ((0, 0), (0, 0), (0, 0), (0, d_pad))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+        d = d + d_pad
     if streaming is None:
         # K+V rows of one head resident in the non-streaming kernels, in
         # the input dtype (the per-block f32 cast is transient).
@@ -961,7 +1007,8 @@ def flash_attention(
         (min(block_q, s), min(block_k, k.shape[1])), interpret, streaming,
         window,
     )
-    return jnp.transpose(o.reshape(b, h, s, d), (0, 2, 1, 3))
+    o = jnp.transpose(o.reshape(b, h, s, d), (0, 2, 1, 3))
+    return o[..., : d - d_pad] if d_pad else o
 
 
 # --------------------------------------------------------------------- #

@@ -255,17 +255,40 @@ def attention(
         dense = lambda q, k, v: full_attention(  # noqa: E731
             q, k, v, causal=causal, sm_scale=sm_scale, window=window
         )
+        # Exact-tile heads (d % 128 == 0) take the kernel at any supported
+        # length; padded heads (d < 128, e.g. the Llama-1B-class head_dim
+        # 64) only where flash is measured to win over dense XLA
+        # (seq >= PADDED_HEAD_MIN_SEQ) — this is what puts the kernel in
+        # the TRAINING path at seq >= 2048 for the 1B preset.
         if (
             not os.environ.get("TGPU_DISABLE_FLASH")
             and _fa.supports(q.shape, k.shape)
+            and (
+                q.shape[3] % 128 == 0
+                or q.shape[1] >= _fa.PADDED_HEAD_MIN_SEQ
+            )
         ):
-            # Resolved at LOWERING time, so the kernel is only emitted when
-            # this computation actually lowers for TPU (a CPU oracle run on
-            # a TPU host gets the dense path, not a Mosaic error).
+            # Resolved at RUN time by platform_index: TPU executes the
+            # kernel branch, everything else the dense branch.  The
+            # kernel is traced with interpret=True on non-TPU hosts —
+            # this jax lowers EVERY platform_dependent branch for the
+            # current platform, and Mosaic has no CPU lowering, so the
+            # compiled-kernel spelling would break CPU lowering outright
+            # (the interpret spelling lowers everywhere and is dead code
+            # at runtime off-TPU).  Net effect: the training jaxpr
+            # carries the real pallas_call on every host — statically
+            # checkable on CPU — while only TPU lowering emits Mosaic.
+            # Known hole (pre-existing on this jax, either spelling): a
+            # CPU-TARGETED lowering on a TPU-backend host (CPU oracle
+            # under jax.default_device(cpu)) still lowers the Mosaic
+            # branch for CPU and fails — run such oracles under
+            # TGPU_DISABLE_FLASH=1.
+            interpret = jax.default_backend() != "tpu"
             return lax.platform_dependent(
                 q, k, v,
                 tpu=lambda q, k, v: _fa.flash_attention(
-                    q, k, v, causal=causal, sm_scale=sm_scale, window=window
+                    q, k, v, causal=causal, sm_scale=sm_scale, window=window,
+                    interpret=interpret,
                 ),
                 default=dense,
             )

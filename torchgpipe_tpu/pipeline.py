@@ -80,6 +80,31 @@ def clock_cycles(m: int, n: int) -> Iterator[List[Tuple[int, int]]]:
         yield [(k - j, j) for j in range(max(0, k - m + 1), min(k + 1, n))]
 
 
+def _host_memory_kind(device: Any) -> Optional[str]:
+    """The host-side memory kind addressable by ``device`` (``pinned_host``
+    on TPU; ``None`` when the device's default memory IS host memory, e.g.
+    the CPU backend, where offloading would be a no-op copy)."""
+    try:
+        default = device.default_memory().kind
+        kinds = [m.kind for m in device.addressable_memories()]
+    except Exception:  # pragma: no cover - backends without memories API
+        return None
+    for kind in ("pinned_host", "unpinned_host"):
+        if kind in kinds and kind != default:
+            return kind
+    return None
+
+
+def _to_memory(tree: Pytree, device: Any, kind: Optional[str]) -> Pytree:
+    """device_put every array leaf of ``tree`` (vjp closures included) to
+    ``device`` in memory ``kind`` (``None`` = the device's default HBM)."""
+    sharding = jax.sharding.SingleDeviceSharding(device, memory_kind=kind)
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sharding) if hasattr(a, "dtype") else a,
+        tree,
+    )
+
+
 def _transfer(x: Pytree, device: Any) -> Pytree:
     """Async device-to-device move (ICI on TPU); no-op if already there."""
     return jax.device_put(x, device)
@@ -312,10 +337,15 @@ class Pipeline:
         stages: Sequence[StageExec],
         layout: SkipLayout,
         tracer: Any = None,
+        remat_policy: Any = None,
     ) -> None:
         self.stages = list(stages)
         self.layout = layout
         self.tracer = tracer  # torchgpipe_tpu.utils.tracing.Timeline or None
+        # Optional jax.checkpoint policy for the FUSED path's per-cell
+        # remat (GPipe(fused=True, remat_policy=...)); the per-cell
+        # scheduler's checkpointed cells keep no residuals at all.
+        self.remat_policy = remat_policy
         self._loss_grad = LossGradRunner()
         self._fused: Dict = {}  # fused single-device step cache
         self._loss_jits: Dict = {}  # 1F1B per-micro-batch loss/sum cache
@@ -379,6 +409,7 @@ class Pipeline:
         rng: Optional[jax.Array],
         checkpoint_stop: int,
         loss_params: Optional[Pytree] = None,
+        offload: bool = False,
     ) -> Tuple[jax.Array, List[Pytree], List[Pytree], List[Pytree], Pytree]:
         """Full pipelined forward, loss, and backward.
 
@@ -386,9 +417,45 @@ class Pipeline:
         whatever extra output ``loss_fn`` returns (or None); with
         ``loss_params`` set (parametric loss layer),
         ``(loss, grads_per_stage, loss_grads, new_states, aux)``.
+
+        ``offload`` (``GPipe(checkpoint='offload')``): each cell's vjp
+        residual closure — an explicit program output in this engine — is
+        moved to HOST memory (``pinned_host``) right after its forward and
+        brought back just before its backward, so between the two
+        schedules the device holds no residuals at all: zero recompute
+        (the 'never' schedule) at 'always'-like device memory.  The
+        device_puts are async like every stage hand-off; on a host-backed
+        device (CPU tests) the move is skipped — residuals already live
+        in host memory.
         """
         n = len(self.stages)
         m = len(mbatches)
+        host_kinds = (
+            {j: _host_memory_kind(s.device) for j, s in enumerate(self.stages)}
+            if offload
+            else {}
+        )
+        if offload:
+            for j, kind in host_kinds.items():
+                dev = self.stages[j].device
+                if kind is None and getattr(dev, "platform", "cpu") != "cpu":
+                    # Degrading SILENTLY to 'never' (all residuals
+                    # device-resident) on an accelerator would reproduce
+                    # the exact OOM this mode exists to dodge — say so
+                    # loudly.  (CPU stages skip the move by design: their
+                    # default memory IS host memory.)
+                    import warnings
+
+                    warnings.warn(
+                        f"checkpoint='offload': stage {j}'s device "
+                        f"({dev.platform}) exposes no host memory kind — "
+                        "residuals will stay DEVICE-resident ('never'-"
+                        "class HBM use, zero offloading).  This jax/"
+                        "plugin lacks the memories API the offload mode "
+                        "needs",
+                        stacklevel=3,
+                    )
+                    break
 
         acts: Dict[int, Pytree] = {}
         outs: List[Pytree] = [None] * m
@@ -421,6 +488,8 @@ class Pipeline:
                         y, ext, new_state, pull = stage.fwd_vjp(
                             params[j], state_in, x, skips_in, rng_i, 1.0 / m
                         )
+                        if offload and host_kinds[j] is not None:
+                            pull = _to_memory(pull, stage.device, host_kinds[j])
                         pulls[(i, j)] = pull
                 if self.tracer is not None:
                     self.tracer.record("fwd", j, i, y)
@@ -448,31 +517,58 @@ class Pipeline:
         gskips: Dict = {}
         acc: List[Optional[Pytree]] = [None] * n
 
-        cycles = list(clock_cycles(m, n))
-        for cycle in reversed(cycles):
-            for i, j in reversed(cycle):
-                stage = self.stages[j]
-                with _cell_context(j, i, "backward"):
-                    if (i, j) in saved:
-                        x, skips_in, state_in, rng_i = saved.pop((i, j))
-                        # Recompute-ahead: rebuild the vjp before consuming
-                        # the cotangent (reference checkpoint.py:1-19).
-                        _, _, _, pull = stage.fwd_recompute(
-                            params[j], state_in, x, skips_in, rng_i, 1.0 / m
-                        )
-                    else:
-                        pull = pulls.pop((i, j))
-                    gy = gys.pop((i, j))
-                    gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
-                    gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
-                if self.tracer is not None:
-                    self.tracer.record("bwd", j, i, gx)
-                acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
-                if j > 0:
-                    gys[(i, j - 1)] = _transfer(gx, self.stages[j - 1].device)
-                for k, g in gsk_in.items():
-                    dst = self.stages[self.layout.stash_stage(k)].device
-                    gskips[(i, k)] = _transfer(g, dst)
+        order = [
+            (i, j)
+            for cycle in reversed(list(clock_cycles(m, n)))
+            for i, j in reversed(cycle)
+        ]
+
+        def _fetch_pull(cell: Tuple[int, int]) -> Any:
+            """Pop a cell's stored vjp closure, bringing host-offloaded
+            residuals back to the stage device (async device_put)."""
+            i_, j_ = cell
+            pull = pulls.pop(cell)
+            if offload and host_kinds[j_] is not None:
+                pull = _to_memory(pull, self.stages[j_].device, None)
+            return pull
+
+        prefetched: Dict[Tuple[int, int], Any] = {}
+        for idx, (i, j) in enumerate(order):
+            stage = self.stages[j]
+            with _cell_context(j, i, "backward"):
+                if (i, j) in saved:
+                    x, skips_in, state_in, rng_i = saved.pop((i, j))
+                    # Recompute-ahead: rebuild the vjp before consuming
+                    # the cotangent (reference checkpoint.py:1-19).
+                    _, _, _, pull = stage.fwd_recompute(
+                        params[j], state_in, x, skips_in, rng_i, 1.0 / m
+                    )
+                else:
+                    pull = prefetched.pop((i, j), None)
+                    if pull is None:
+                        pull = _fetch_pull((i, j))
+                if offload and idx + 1 < len(order):
+                    # ONE-cell prefetch: issue the next cell's
+                    # host-to-device residual copy now, so it overlaps
+                    # this cell's backward compute instead of stalling
+                    # the schedule (mirrors the forward's async
+                    # stage-to-stage _transfer hand-offs).  Exactly one
+                    # cell deep on purpose — each extra cell of depth
+                    # costs a full cell's residuals in peak HBM.
+                    nxt = order[idx + 1]
+                    if nxt in pulls and nxt not in prefetched:
+                        prefetched[nxt] = _fetch_pull(nxt)
+                gy = gys.pop((i, j))
+                gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
+                gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
+            if self.tracer is not None:
+                self.tracer.record("bwd", j, i, gx)
+            acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
+            if j > 0:
+                gys[(i, j - 1)] = _transfer(gx, self.stages[j - 1].device)
+            for k, g in gsk_in.items():
+                dst = self.stages[self.layout.stash_stage(k)].device
+                gskips[(i, k)] = _transfer(g, dst)
 
         if loss_params is not None:
             return loss, acc, loss_grads, cur_states, aux
@@ -698,7 +794,11 @@ class Pipeline:
             with ckpt.phase(checkpointing=True):
                 return fn(p, s, x, sk, key, True)
 
-        return jax.checkpoint(cell)
+        # remat_policy (e.g. checkpoint.policies.save_attn_out) picks which
+        # checkpoint-named intermediates each remat'd cell keeps/offloads
+        # instead of recomputing — the fused path's point on the
+        # recompute/memory curve (docs/tuning.md).
+        return jax.checkpoint(cell, policy=self.remat_policy)
 
     def _fused_forward_loop(
         self,

@@ -382,11 +382,23 @@ class SpmdGPipe:
       checkpoint: 'always' (remat the block per cell — GPipe memory
         profile), 'except_last' (the last micro-batch's cells skip remat —
         their backward needs no recompute since it runs right after their
-        forward; reference gpipe.py:360-367) or 'never'.
+        forward; reference gpipe.py:360-367), 'never', or 'offload'
+        (fill-drain only): remat the block with an offload-to-host save
+        policy — the checkpoint-named intermediates
+        (:data:`torchgpipe_tpu.checkpoint.NAMED_SAVE_POINTS`) are copied
+        to ``pinned_host`` memory at forward time and read back in the
+        backward, so they are neither recomputed nor device-resident —
+        the measured 17.7 GiB residual wall's direct fix (docs/tuning.md).
       remat_policy: optional ``jax.checkpoint`` policy refining
-        ``checkpoint='always'`` (e.g.
+        ``checkpoint='always'``/``'except_last'``/``'offload'`` (e.g.
         ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable`` keeps
-        matmul outputs and recomputes only cheap elementwise ops).
+        matmul outputs and recomputes only cheap elementwise ops, or the
+        named-save presets in
+        :data:`torchgpipe_tpu.checkpoint.policies` — blocks tag their
+        expensive intermediates with ``checkpoint_name``, so e.g.
+        ``policies.save_attn_out`` keeps one [b, s, dim] tensor per block
+        and recomputes the rest).  Under 'offload' the default is
+        ``policies.offload_default()``.
       loss_reduction: 'mean' (default) or 'sum' declares that ``post`` and
         ``loss_fn`` decompose over batch elements with that reduction,
         letting the engine shard the head + loss over the ``pp`` axis (1/n
@@ -572,10 +584,18 @@ class SpmdGPipe:
         for ax in (self.dp_axis, self.sp_axis, self.tp_axis, self.ep_axis):
             if ax is not None and ax not in self.mesh.axis_names:
                 raise ValueError(f"mesh has no {ax!r} axis: {self.mesh}")
-        if self.checkpoint not in ("always", "except_last", "never"):
+        if self.checkpoint not in ("always", "except_last", "never", "offload"):
             raise ValueError(
                 "SPMD engine supports checkpoint="
-                "'always'|'except_last'|'never'"
+                "'always'|'except_last'|'never'|'offload'"
+            )
+        if self.checkpoint == "offload" and self.schedule != "fill_drain":
+            raise ValueError(
+                f"checkpoint='offload' is a fill_drain feature: the "
+                f"{self.schedule!r} schedule hand-writes its per-cell "
+                "recompute/residual machinery (no jax.checkpoint region "
+                "to attach the offload save policy to).  Use "
+                "schedule='fill_drain', or checkpoint='never'/'always'"
             )
         if self.fsdp and self.dp_axis is None:
             raise ValueError(
@@ -708,14 +728,22 @@ class SpmdGPipe:
         # _block_fn_plain: the un-remat'd block — the 'never' path and the
         # last micro-batch's cells under 'except_last'.
         self._block_fn_plain = block_fn
-        if self.checkpoint in ("always", "except_last"):
+        if self.checkpoint == "offload":
+            from torchgpipe_tpu.checkpoint import policies as ckpt_policies
+
+            if self.remat_policy is None:
+                self.remat_policy = ckpt_policies.offload_default()
+            block_fn = jax.checkpoint(
+                block_fn, static_argnums=(4,), policy=self.remat_policy
+            )
+        elif self.checkpoint in ("always", "except_last"):
             block_fn = jax.checkpoint(
                 block_fn, static_argnums=(4,), policy=self.remat_policy
             )
         elif self.remat_policy is not None:
             raise ValueError(
-                "remat_policy only applies with checkpoint='always' or "
-                "'except_last'"
+                "remat_policy only applies with checkpoint='always', "
+                "'except_last' or 'offload'"
             )
         self._block_fn = block_fn
         # Spec prefix for the stacked block params: stage dim over pp, plus
