@@ -6,6 +6,11 @@ input space."""
 
 import numpy as np
 import pytest
+
+# Environments without hypothesis skip cleanly instead of erroring at
+# collection (which would force --continue-on-collection-errors on every
+# pytest invocation just to mask it).
+hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Unified lint gate: typegate + pipeline_lint over every example.
+"""Unified lint gate: typegate + schedule verifier + pipeline_lint.
 
 ONE command for CI and pre-commit::
 
@@ -10,12 +10,22 @@ differently and must not share backend state):
 
 1. ``tools/typegate.py`` — the strict annotation gate over
    ``torchgpipe_tpu/`` and ``tools/``;
-2. ``tools/pipeline_lint.py examples/*.py`` — every example's
-   ``build_for_lint`` pipeline must trace and lint clean (the structural
-   invariants of docs/analysis.md).
+2. ``python -m torchgpipe_tpu.analysis.schedule`` — the static schedule
+   verifier's self-check over every shipped scheduler of BOTH engines
+   (MPMD fill-drain/1F1B, the distributed RPC engine, SPMD
+   fill-drain/1F1B/interleaved/zero-bubble) across a parameter grid:
+   deadlock/ordering, donation safety and engine equivalence must hold
+   with zero findings (pure Python over schedule tables — seconds);
+3. ``tools/pipeline_lint.py examples/*.py`` — every example's
+   ``build_for_lint`` pipeline must trace and lint clean; the rule set
+   includes the schedule verifier rules (``schedule-deadlock``,
+   ``donation-safety``, ``memory-certification``,
+   ``engine-equivalence``), so each example's configured scheduler is
+   verified per model too (the structural invariants of
+   docs/analysis.md; any ERROR fails the gate).
 
-Options: ``--skip-typegate`` / ``--skip-pipeline`` to run one half,
-``-v`` for per-target lint reports.
+Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
+to run a subset, ``-v`` for per-target lint reports.
 """
 
 from __future__ import annotations
@@ -38,8 +48,11 @@ def _run(tag: str, cmd: List[str]) -> int:
 
 
 def main(argv: Sequence[str] | None = None) -> int:
-    ap = argparse.ArgumentParser(description="typegate + pipeline lint gate")
+    ap = argparse.ArgumentParser(
+        description="typegate + schedule verifier + pipeline lint gate"
+    )
     ap.add_argument("--skip-typegate", action="store_true")
+    ap.add_argument("--skip-schedule", action="store_true")
     ap.add_argument("--skip-pipeline", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
@@ -50,6 +63,17 @@ def main(argv: Sequence[str] | None = None) -> int:
         failures += _run(
             "typegate", [sys.executable, str(REPO / "tools" / "typegate.py")]
         ) != 0
+    if not args.skip_schedule:
+        # -c instead of -m: runpy would re-execute a module the analysis
+        # package already imported (a RuntimeWarning on every CI run).
+        cmd = [
+            sys.executable, "-c",
+            "import sys; from torchgpipe_tpu.analysis import schedule; "
+            "sys.exit(schedule.main(sys.argv[1:]))",
+        ]
+        if args.verbose:
+            cmd.append("-v")
+        failures += _run("schedule-verify", cmd) != 0
     if not args.skip_pipeline:
         examples = sorted(
             str(p.relative_to(REPO)) for p in (REPO / "examples").glob("*.py")

@@ -16,6 +16,7 @@
 # artifact says platform=tpu.
 cd /root/repo
 LOG=tools/tpu_todo.log
+mkdir -p tools/artifacts  # secondary captures live here (tools/artifacts/README.md)
 say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
 
 captured() {  # captured <artifact> — true if a TPU number is already in place
@@ -86,22 +87,22 @@ run_step bench-ladder 5400 -o tools/bench_tpu_attempt.json python bench.py \
 if captured tools/bench_tpu_attempt.json \
    && grep -q -- '-b128m4-except_last-fused' tools/bench_tpu_attempt.json; then
   say "=== step bench-fused: SKIP (ladder settled on the fused 128/4 rung)"
-  cp tools/bench_tpu_attempt.json tools/bench_tpu_fused.json
+  cp tools/bench_tpu_attempt.json tools/artifacts/bench_tpu_fused.json
 else
-  run_step bench-fused 5400 -o tools/bench_tpu_fused.json \
+  run_step bench-fused 5400 -o tools/artifacts/bench_tpu_fused.json \
     env TGPU_BENCH_RUNG="128,4,except_last,1" python bench.py \
     || bail_if_dead
 fi
 if captured tools/bench_tpu_attempt.json \
    && grep -q -- '-b64m4-except_last-percell' tools/bench_tpu_attempt.json; then
   say "=== step bench-percell: SKIP (ladder settled on the per-cell 64/4 rung)"
-  cp tools/bench_tpu_attempt.json tools/bench_tpu_percell.json
+  cp tools/bench_tpu_attempt.json tools/artifacts/bench_tpu_percell.json
 else
   # Walk down 64 -> 48 -> 32 so co-tenant HBM pressure (which OOM'd the
   # 64/4 pin twice on 2026-08-01) still yields SOME re-measured per-cell
   # point; run_step skips the whole ladder once any batch captures.
   for pcb in 64 48 32; do
-    run_step "bench-percell-b$pcb" 3600 -o tools/bench_tpu_percell.json \
+    run_step "bench-percell-b$pcb" 3600 -o tools/artifacts/bench_tpu_percell.json \
       env TGPU_BENCH_RUNG="$pcb,4,except_last,0" python bench.py \
       && break
     bail_if_dead
@@ -113,7 +114,7 @@ fi
 # since gained a CPU-client fallback).  Re-run the ladder into a fresh
 # artifact so a non-null-mfu TPU line exists; README cites it once
 # captured.  Cache-warm, so this is minutes not tens of minutes.
-run_step bench-mfu 5400 -o tools/bench_tpu_mfu.json python bench.py \
+run_step bench-mfu 5400 -o tools/artifacts/bench_tpu_mfu.json python bench.py \
   || bail_if_dead
 
 # (3c) Opportunistic headline push: batch 160 fused measured 479.8/s in
@@ -131,7 +132,7 @@ run_step bench-160 5400 -o tools/bench_tpu_160.json \
 # batch 8 twice on 2026-08-01 and 8/4 again on 2026-08-02; any captured
 # point proves the chunked-CE rescue.
 for l1b in 8 4 2; do
-  run_step "llama-1b-fused-ce-b$l1b" 3600 -t tools/tpu_llama1b_fused_ce.txt \
+  run_step "llama-1b-fused-ce-b$l1b" 3600 -t tools/artifacts/tpu_llama1b_fused_ce.txt \
     python -m benchmarks.llama_speed pipeline-1 --preset 1b --engine mpmd \
       --fused-ce --checkpoint except_last --steps 3 --batch "$l1b" \
     && break
@@ -140,17 +141,17 @@ done
 
 # (5) Streaming-flash re-time at 2k/4k causal, post block-skipping
 # (healthy TODO #3; target: streaming <= dense 64.8 ms at 4k).
-run_step flash-retime 3600 -t tools/tpu_flash_retime.txt \
+run_step flash-retime 3600 -t tools/artifacts/tpu_flash_retime.txt \
   python -m benchmarks.flash_attention_hw --seqs 2048,4096 --iters 20 \
   || bail_if_dead
 
 # (6) Sliding-window point: window 1024 at seq 4096 vs full attention
 # (healthy TODO #4).  batch kept small so the 1b preset fits one chip.
-run_step attn-window-full 2400 -t tools/tpu_attn_window_full.txt \
+run_step attn-window-full 2400 -t tools/artifacts/tpu_attn_window_full.txt \
   python -m benchmarks.llama_speed pipeline-1 --preset 1b --engine mpmd \
     --fused-ce --checkpoint except_last --batch 2 --seq 4096 --steps 3 \
   || bail_if_dead
-run_step attn-window-1024 2400 -t tools/tpu_attn_window_1024.txt \
+run_step attn-window-1024 2400 -t tools/artifacts/tpu_attn_window_1024.txt \
   python -m benchmarks.llama_speed pipeline-1 --preset 1b --engine mpmd \
     --fused-ce --checkpoint except_last --batch 2 --seq 4096 \
     --attn-window 1024 --steps 3 \
@@ -161,13 +162,13 @@ run_step attn-window-1024 2400 -t tools/tpu_attn_window_1024.txt \
 # 1b artifacts being absent — the pair must stay comparable (same
 # preset, same batch), so a partial 1b capture must not be completed
 # with a small-preset half.
-if [ ! -s tools/tpu_attn_window_full.txt ] \
-   && [ ! -s tools/tpu_attn_window_1024.txt ]; then
-  run_step attn-window-full-small 2400 -t tools/tpu_attn_window_full.txt \
+if [ ! -s tools/artifacts/tpu_attn_window_full.txt ] \
+   && [ ! -s tools/artifacts/tpu_attn_window_1024.txt ]; then
+  run_step attn-window-full-small 2400 -t tools/artifacts/tpu_attn_window_full.txt \
     python -m benchmarks.llama_speed pipeline-1 --preset small --engine mpmd \
       --fused-ce --checkpoint except_last --batch 4 --seq 4096 --steps 3 \
     || bail_if_dead
-  run_step attn-window-1024-small 2400 -t tools/tpu_attn_window_1024.txt \
+  run_step attn-window-1024-small 2400 -t tools/artifacts/tpu_attn_window_1024.txt \
     python -m benchmarks.llama_speed pipeline-1 --preset small --engine mpmd \
       --fused-ce --checkpoint except_last --batch 4 --seq 4096 \
       --attn-window 1024 --steps 3 \
@@ -177,21 +178,21 @@ fi
 # (7) The per-cell dispatch-asynchrony invariant against the REAL TPU
 # backend (tests/test_overlap.py is platform-agnostic; CI runs it on the
 # CPU mesh — this is the on-hardware leg).
-run_step overlap-on-tpu 1800 -t tools/tpu_overlap_test.txt \
+run_step overlap-on-tpu 1800 -t tools/artifacts/tpu_overlap_test.txt \
   env TGPU_TEST_ON_BACKEND=1 \
   python -m pytest tests/test_overlap.py -q --no-header \
   || bail_if_dead
 
 # (8) Decode throughput for the KV-cache generator (round-4 capability):
 # the 1b preset in bf16 — HBM-bandwidth-bound on the chip.
-run_step llama-decode 2400 -t tools/tpu_llama_decode.txt \
+run_step llama-decode 2400 -t tools/artifacts/tpu_llama_decode.txt \
   python -m benchmarks.llama_decode --preset 1b --batch 8 --bf16 \
   || bail_if_dead
 
 # (8b) Weight-only int8 decode (round-4 capability): same config with
 # the projection weights stored int8 — the direct test of the
 # bandwidth-bound model (expect up to ~2x tokens/sec at this batch).
-run_step llama-decode-w8 2400 -t tools/tpu_llama_decode_w8.txt \
+run_step llama-decode-w8 2400 -t tools/artifacts/tpu_llama_decode_w8.txt \
   python -m benchmarks.llama_decode --preset 1b --batch 8 --bf16 --w8 \
   || bail_if_dead
 
@@ -199,7 +200,7 @@ run_step llama-decode-w8 2400 -t tools/tpu_llama_decode_w8.txt \
 # latency at 1/4, 1/2 and full live length vs the dense cache read —
 # the length-bounded block loop should make flash cost FOLLOW the live
 # prefix while dense stays flat.  Host-fetch timed (lazy-backend-proof).
-run_step flash-decode 2400 -t tools/tpu_flash_decode.txt \
+run_step flash-decode 2400 -t tools/artifacts/tpu_flash_decode.txt \
   python -m benchmarks.flash_attention_hw --decode --seqs 4096 --iters 50 \
   || bail_if_dead
 

@@ -18,13 +18,13 @@ for i in $(seq 1 160); do
     # steps, and those deserve the remaining probe budget (tpu_todo.sh
     # skips already-captured steps on rerun).
     all_done=1
-    for f in tools/bench_tpu_attempt.json tools/bench_tpu_fused.json \
-             tools/bench_tpu_percell.json tools/bench_tpu_mfu.json; do
+    for f in tools/bench_tpu_attempt.json tools/artifacts/bench_tpu_fused.json \
+             tools/artifacts/bench_tpu_percell.json tools/artifacts/bench_tpu_mfu.json; do
       grep -q '"platform": "tpu"' "$f" 2>/dev/null || all_done=0
     done
-    for f in tools/tpu_llama1b_fused_ce.txt tools/tpu_flash_retime.txt \
-             tools/tpu_attn_window_full.txt tools/tpu_attn_window_1024.txt \
-             tools/tpu_overlap_test.txt tools/tpu_llama_decode.txt; do
+    for f in tools/artifacts/tpu_llama1b_fused_ce.txt tools/artifacts/tpu_flash_retime.txt \
+             tools/artifacts/tpu_attn_window_full.txt tools/artifacts/tpu_attn_window_1024.txt \
+             tools/artifacts/tpu_overlap_test.txt tools/artifacts/tpu_llama_decode.txt; do
       [ -s "$f" ] || all_done=0
     done
     if [ "$all_done" = 1 ]; then

@@ -50,6 +50,14 @@ from torchgpipe_tpu.analysis.trace import (
     trace_pipeline,
     trace_spmd,
 )
+from torchgpipe_tpu.analysis import events, schedule
+from torchgpipe_tpu.analysis.events import EventGraph, events_for
+from torchgpipe_tpu.analysis.schedule import (
+    certify_memory,
+    verify_buffers,
+    verify_equivalence,
+    verify_ordering,
+)
 
 __all__ = [
     "Finding",
@@ -59,6 +67,14 @@ __all__ = [
     "RULES_BY_NAME",
     "PipelineTrace",
     "TracedProgram",
+    "EventGraph",
+    "events",
+    "events_for",
+    "schedule",
+    "certify_memory",
+    "verify_buffers",
+    "verify_equivalence",
+    "verify_ordering",
     "apply_suppressions",
     "format_findings",
     "lint",

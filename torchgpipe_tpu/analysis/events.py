@@ -1,0 +1,849 @@
+"""Event-graph IR of pipeline schedules — the schedule-level twin of the
+jaxpr-level trace.
+
+PR 1's linter checks *per-program* invariants; the bug class it cannot see
+is cross-stage ordering: a deadlocked 1F1B variant, an unmatched send/recv
+pair in the multi-process engine, a use-after-donate through
+``make_train_step(donate=)`` — all of which pass per-program lint and only
+surface as a hang or garbage gradients on real TPUs (the class MPMD
+pipeline work calls out as hardest to debug, arXiv:2412.14374).
+
+This module extracts an :class:`EventGraph` from every scheduler the repo
+ships, rebuilding each schedule from the SAME generator the engine runs
+(``pipeline.clock_cycles`` / ``pipeline.one_f1b_orders``, the SPMD tick
+predicates, ``parallel.interleaved.interleaved_tables``,
+``parallel.zerobubble.zero_bubble_tables``, and the per-rank loops of
+``distributed.gpipe``).  Nodes are ``(stage, micro_batch, phase)`` compute
+events placed in per-rank program order; edges are
+
+* **dependency** edges — same-schedule data dependencies that ride no
+  transport (the loss seed, zero-bubble's W-after-B split, the gathered
+  loss's all-outputs fan-in);
+* **transport** edges — one send matched to one recv over a named channel
+  (``("act", i)`` hand-offs, the distributed engine's ``("forward", i)`` /
+  ``("skip", k, i)`` mailbox keys);
+* **collective** tags — SPMD tick ``ppermute``s grouping each tick's
+  transfers into one ring permutation that every lane must agree on.
+
+:mod:`torchgpipe_tpu.resilience.faults` plans (drop / lose / duplicate /
+delay) are expressible as IR *mutations* (:func:`apply_send_faults`), so
+every ERROR the verifier (:mod:`torchgpipe_tpu.analysis.schedule`) can
+raise has a constructive "this fault plan triggers it" witness.
+
+Everything here is pure Python over schedule tables — no tracing, no jax
+arrays; a production-size schedule builds in milliseconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import (
+    Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple,
+)
+
+# Phases.  "wgt" is zero-bubble's weight-gradient half of the split
+# backward; "upd" is the optimizer update appended by with_update();
+# "meta" is the distributed engine's micro-batch-count broadcast.
+FWD, BWD, WGT, UPD, META = "fwd", "bwd", "wgt", "upd", "meta"
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One schedule cell: micro-batch ``mb`` in phase ``phase`` of (global,
+    virtual-stage-resolved) ``stage``, executed by ``rank``."""
+
+    rank: int
+    stage: int
+    mb: int
+    phase: str
+
+    def __repr__(self) -> str:
+        return f"{self.phase}(s{self.stage},mb{self.mb})@r{self.rank}"
+
+    @property
+    def cell(self) -> Tuple[int, int, str]:
+        """Rank-independent identity — what engine equivalence compares."""
+        return (self.stage, self.mb, self.phase)
+
+
+@dataclasses.dataclass(frozen=True)
+class Channel:
+    """A named point-to-point message key, matching the transport layer's
+    mailbox keys (``(kind, index)``) where a real transport exists."""
+
+    kind: Any  # "act" | "grad" | "forward" | "backward" | ("skip", k) | ...
+    index: int  # micro-batch (or step) index — the mailbox FIFO key
+    src: int  # sender rank
+    dst: int  # receiver rank
+
+
+@dataclasses.dataclass
+class Transfer:
+    """One send matched to one recv; mutations flip the fault fields.
+
+    ``collective`` tags SPMD tick permutes: every transfer sharing a tag is
+    one lane's leg of a single ``ppermute``, so the tagged set must form a
+    consistent ring permutation (the verifier checks this).
+    """
+
+    src: Event
+    dst: Event
+    channel: Channel
+    collective: Optional[Tuple[str, int]] = None  # e.g. ("fwd_ring", tick)
+    lost: bool = False  # send never arrives (drop/lose faults)
+    duplicated: bool = False  # message delivered twice
+    delay: int = 0  # ticks late (lockstep schedules read garbage)
+
+
+@dataclasses.dataclass(frozen=True)
+class Buffer:
+    """A schedule-managed buffer resident on ``rank`` (vjp residuals, saved
+    recompute inputs, pipeline outputs, donated params) — the liveness
+    units of the memory certification and donation analyses."""
+
+    kind: str  # "resid" | "saved" | "out" | "params"
+    stage: int
+    mb: int  # -1 for per-stage buffers (params)
+    rank: int
+
+
+@dataclasses.dataclass
+class EventGraph:
+    """Per-rank program orders plus the dependency/transport/buffer edges.
+
+    ``order[r]`` is rank ``r``'s dispatch order — for lockstep (SPMD)
+    schedules the positions are tick-aligned across ranks
+    (``lockstep=True``); the MPMD/distributed engines run free and only
+    the channel blocking orders them.
+    """
+
+    engine: str  # "mpmd" | "spmd" | "distributed"
+    schedule: str
+    n_stages: int  # GLOBAL stages (interleaved: n_ranks * virtual)
+    chunks: int  # micro-batches m
+    order: List[List[Event]]
+    transfers: List[Transfer] = dataclasses.field(default_factory=list)
+    deps: List[Tuple[Event, Event]] = dataclasses.field(default_factory=list)
+    lockstep: bool = False
+    gathered_loss: bool = True
+    # Buffer annotations (memory + donation analyses).
+    writes: Dict[Event, Tuple[Buffer, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+    reads: Dict[Event, Tuple[Buffer, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+    consumes: Dict[Event, Tuple[Buffer, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+    workers: Tuple[str, ...] = ()  # transport names (distributed graphs)
+
+    @property
+    def n_ranks(self) -> int:
+        return len(self.order)
+
+    def events(self) -> List[Event]:
+        return [ev for rank_order in self.order for ev in rank_order]
+
+    def copy(self) -> "EventGraph":
+        """Deep-enough copy for mutations: fresh order lists and Transfer
+        objects (Events/Channels are immutable and shared)."""
+        return dataclasses.replace(
+            self,
+            order=[list(o) for o in self.order],
+            transfers=[dataclasses.replace(t) for t in self.transfers],
+            deps=list(self.deps),
+            writes=dict(self.writes),
+            reads=dict(self.reads),
+            consumes=dict(self.consumes),
+        )
+
+    def _annotate(self, table: Dict, ev: Event, buf: Buffer) -> None:
+        table[ev] = table.get(ev, ()) + (buf,)
+
+    def add_write(self, ev: Event, buf: Buffer) -> None:
+        self._annotate(self.writes, ev, buf)
+
+    def add_read(self, ev: Event, buf: Buffer) -> None:
+        self._annotate(self.reads, ev, buf)
+
+    def add_consume(self, ev: Event, buf: Buffer) -> None:
+        self._annotate(self.consumes, ev, buf)
+
+    def transfer_into(self, ev: Event) -> List[Transfer]:
+        return [t for t in self.transfers if t.dst == ev]
+
+    def dataflow(self) -> Set[Tuple[Tuple, Tuple]]:
+        """The rank/tick-free data-dependency relation over cells.
+
+        Zero-bubble's W cells are folded into their B (the split backward
+        is one reference backward), so schedules are comparable across
+        engines — this is the "bisimilar up to schedule" projection.
+        """
+
+        def fold(cell: Tuple[int, int, str]) -> Tuple[int, int, str]:
+            s, i, ph = cell
+            return (s, i, BWD) if ph == WGT else (s, i, ph)
+
+        out: Set[Tuple[Tuple, Tuple]] = set()
+        for t in self.transfers:
+            if t.src.phase == META:
+                continue
+            a, b = fold(t.src.cell), fold(t.dst.cell)
+            if a != b:
+                out.add((a, b))
+        for src, dst in self.deps:
+            a, b = fold(src.cell), fold(dst.cell)
+            if a != b:
+                out.add((a, b))
+        return out
+
+    def compute_cells(self) -> Set[Tuple[int, int, str]]:
+        """The fwd/bwd cell set (W folded, meta/upd dropped)."""
+        cells: Set[Tuple[int, int, str]] = set()
+        for ev in self.events():
+            if ev.phase in (META, UPD):
+                continue
+            s, i, ph = ev.cell
+            cells.add((s, i, BWD if ph == WGT else ph))
+        return cells
+
+
+# --------------------------------------------------------------------- #
+# canonical dataflow + bisimilarity                                     #
+# --------------------------------------------------------------------- #
+
+
+def canonical_dataflow(
+    n_stages: int, m: int, gathered_loss: bool
+) -> Set[Tuple[Tuple, Tuple]]:
+    """The one data-dependency relation every correct training schedule
+    over ``n_stages`` stages and ``m`` micro-batches realizes: forward
+    chains, loss seeding (gathered: every last-stage forward feeds every
+    last-stage backward; per-micro-batch: only its own), backward chains.
+    """
+    n = n_stages
+    out: Set[Tuple[Tuple, Tuple]] = set()
+    for i in range(m):
+        for j in range(1, n):
+            out.add(((j - 1, i, FWD), (j, i, FWD)))
+        for j in range(n - 1, 0, -1):
+            out.add(((j, i, BWD), (j - 1, i, BWD)))
+    if gathered_loss:
+        for i in range(m):
+            for k in range(m):
+                out.add(((n - 1, i, FWD), (n - 1, k, BWD)))
+    else:
+        for i in range(m):
+            out.add(((n - 1, i, FWD), (n - 1, i, BWD)))
+    return out
+
+
+def bisimilar(a: EventGraph, b: EventGraph) -> Tuple[bool, str]:
+    """Schedule-free equivalence: same compute cells, same data-dependency
+    relation.  Two engines whose graphs are bisimilar compute the same
+    mathematical step however differently they order it."""
+    if a.compute_cells() != b.compute_cells():
+        only_a = sorted(a.compute_cells() - b.compute_cells())[:4]
+        only_b = sorted(b.compute_cells() - a.compute_cells())[:4]
+        return False, (
+            f"compute cells differ: only in {a.engine}/{a.schedule}: "
+            f"{only_a}; only in {b.engine}/{b.schedule}: {only_b}"
+        )
+    if a.dataflow() != b.dataflow():
+        only_a = sorted(a.dataflow() - b.dataflow())[:4]
+        only_b = sorted(b.dataflow() - a.dataflow())[:4]
+        return False, (
+            f"data dependencies differ: only in {a.engine}/{a.schedule}: "
+            f"{only_a}; only in {b.engine}/{b.schedule}: {only_b}"
+        )
+    return True, ""
+
+
+# --------------------------------------------------------------------- #
+# shared buffer annotation                                              #
+# --------------------------------------------------------------------- #
+
+
+def _annotate_mpmd_buffers(
+    g: EventGraph,
+    fwd_of: Dict[Tuple[int, int], Event],
+    bwd_of: Dict[Tuple[int, int], Event],
+    stop: int,
+    n: int,
+    m: int,
+) -> None:
+    """Residual/saved-input/output buffers of the per-cell MPMD engines:
+    non-checkpointed cells keep a vjp residual closure from forward to
+    backward; checkpointed cells keep their INPUT for recompute-ahead;
+    last-stage outputs live until the loss consumes them."""
+    for i in range(m):
+        for j in range(n):
+            f, b = fwd_of[(i, j)], bwd_of[(i, j)]
+            kind = "saved" if i < stop else "resid"
+            buf = Buffer(kind, j, i, f.rank)
+            g.add_write(f, buf)
+            g.add_consume(b, buf)
+            if j == n - 1:
+                out = Buffer("out", j, i, f.rank)
+                g.add_write(f, out)
+                # The loss consumes outputs where the first backward
+                # reads them (gathered) or per micro-batch.
+                sink = bwd_of[(0, n - 1)] if g.gathered_loss else b
+                g.add_consume(sink, out)
+
+
+def _annotate_params(g: EventGraph) -> None:
+    """Every compute event reads its executing stage's parameters (the
+    donation analysis tracks reads-after-consume over these)."""
+    for ev in g.events():
+        if ev.phase in (FWD, BWD, WGT):
+            g.add_read(ev, Buffer("params", ev.stage, -1, ev.rank))
+
+
+# --------------------------------------------------------------------- #
+# MPMD (single-process GPipe) builders                                  #
+# --------------------------------------------------------------------- #
+
+
+def mpmd_fill_drain_events(n: int, m: int, stop: int = 0) -> EventGraph:
+    """The per-cell fill-drain engine (``Pipeline.run_train``): forward
+    clock cycles, gathered loss, backward as the exact reverse."""
+    from torchgpipe_tpu.pipeline import clock_cycles
+
+    g = EventGraph("mpmd", "gpipe", n, m, [[] for _ in range(n)],
+                   gathered_loss=True)
+    fwd_of: Dict[Tuple[int, int], Event] = {}
+    bwd_of: Dict[Tuple[int, int], Event] = {}
+    fwd_cells = [(i, j) for cyc in clock_cycles(m, n) for i, j in cyc]
+    for i, j in fwd_cells:
+        ev = Event(j, j, i, FWD)
+        fwd_of[(i, j)] = ev
+        g.order[j].append(ev)
+    for i, j in reversed(fwd_cells):
+        ev = Event(j, j, i, BWD)
+        bwd_of[(i, j)] = ev
+        g.order[j].append(ev)
+    for i in range(m):
+        for j in range(n - 1):
+            g.transfers.append(Transfer(
+                fwd_of[(i, j)], fwd_of[(i, j + 1)],
+                Channel("act", i, j, j + 1),
+            ))
+            g.transfers.append(Transfer(
+                bwd_of[(i, j + 1)], bwd_of[(i, j)],
+                Channel("grad", i, j + 1, j),
+            ))
+    # Gathered loss: every last-stage output feeds every output cotangent.
+    for i in range(m):
+        for k in range(m):
+            g.deps.append((fwd_of[(i, n - 1)], bwd_of[(k, n - 1)]))
+    _annotate_mpmd_buffers(g, fwd_of, bwd_of, stop, n, m)
+    _annotate_params(g)
+    return g
+
+
+def mpmd_1f1b_events(n: int, m: int, stop: int = 0) -> EventGraph:
+    """The 1F1B (PipeDream-flush) engine (``Pipeline.run_train_1f1b``),
+    straight from its schedule source ``one_f1b_orders``."""
+    from torchgpipe_tpu.pipeline import one_f1b_orders
+
+    g = EventGraph("mpmd", "1f1b", n, m, [[] for _ in range(n)],
+                   gathered_loss=False)
+    fwd_of: Dict[Tuple[int, int], Event] = {}
+    bwd_of: Dict[Tuple[int, int], Event] = {}
+    for j, ops in enumerate(one_f1b_orders(m, n)):
+        for kind, i in ops:
+            ev = Event(j, j, i, FWD if kind == "fwd" else BWD)
+            (fwd_of if kind == "fwd" else bwd_of)[(i, j)] = ev
+            g.order[j].append(ev)
+    for i in range(m):
+        for j in range(n - 1):
+            g.transfers.append(Transfer(
+                fwd_of[(i, j)], fwd_of[(i, j + 1)],
+                Channel("act", i, j, j + 1),
+            ))
+            g.transfers.append(Transfer(
+                bwd_of[(i, j + 1)], bwd_of[(i, j)],
+                Channel("grad", i, j + 1, j),
+            ))
+        # Per-micro-batch loss seed: same-rank forward before backward.
+        g.deps.append((fwd_of[(i, n - 1)], bwd_of[(i, n - 1)]))
+    _annotate_mpmd_buffers(g, fwd_of, bwd_of, stop, n, m)
+    _annotate_params(g)
+    return g
+
+
+def distributed_events(
+    n: int,
+    m: int,
+    stop: int = 0,
+    skips: Sequence[Tuple[str, int, int]] = (),
+    workers: Optional[Sequence[str]] = None,
+) -> EventGraph:
+    """The multi-process RPC engine (``distributed/gpipe.py``): each rank
+    runs all forwards 0..m-1 then all backwards m-1..0; fill-drain emerges
+    from mailbox channel blocking.  Channels carry the engine's REAL
+    mailbox keys (``"meta"``, ``"forward"``, ``"backward"``,
+    ``("skip", k)`` / ``("skip_grad", k)``), so
+    :class:`~torchgpipe_tpu.resilience.faults.SendFault` rules map onto
+    transfers 1:1.  ``skips`` lists ``(key, stash_rank, pop_rank)``."""
+    g = EventGraph("distributed", "gpipe", n, m, [[] for _ in range(n)],
+                   gathered_loss=True,
+                   workers=tuple(workers or (f"rank{r}" for r in range(n))))
+    fwd_of: Dict[Tuple[int, int], Event] = {}
+    bwd_of: Dict[Tuple[int, int], Event] = {}
+    meta = Event(0, 0, -1, META)
+    if n > 1:
+        g.order[0].append(meta)
+    for j in range(n):
+        for i in range(m):
+            ev = Event(j, j, i, FWD)
+            fwd_of[(i, j)] = ev
+            g.order[j].append(ev)
+        for i in reversed(range(m)):
+            ev = Event(j, j, i, BWD)
+            bwd_of[(i, j)] = ev
+            g.order[j].append(ev)
+    # Rank 0 broadcasts the micro-batch count before any stage computes.
+    for r in range(1, n):
+        g.transfers.append(Transfer(
+            meta, fwd_of[(0, r)], Channel("meta", 0, 0, r)
+        ))
+    for i in range(m):
+        for j in range(n - 1):
+            g.transfers.append(Transfer(
+                fwd_of[(i, j)], fwd_of[(i, j + 1)],
+                Channel("forward", i, j, j + 1),
+            ))
+            g.transfers.append(Transfer(
+                bwd_of[(i, j + 1)], bwd_of[(i, j)],
+                Channel("backward", i, j + 1, j),
+            ))
+        for k in range(m):
+            g.deps.append((fwd_of[(i, n - 1)], bwd_of[(k, n - 1)]))
+        for key, src_r, dst_r in skips:
+            if src_r != dst_r:
+                g.transfers.append(Transfer(
+                    fwd_of[(i, src_r)], fwd_of[(i, dst_r)],
+                    Channel(("skip", key), i, src_r, dst_r),
+                ))
+                g.transfers.append(Transfer(
+                    bwd_of[(i, dst_r)], bwd_of[(i, src_r)],
+                    Channel(("skip_grad", key), i, dst_r, src_r),
+                ))
+    _annotate_mpmd_buffers(g, fwd_of, bwd_of, stop, n, m)
+    _annotate_params(g)
+    return g
+
+
+# --------------------------------------------------------------------- #
+# SPMD builders                                                         #
+# --------------------------------------------------------------------- #
+
+
+def _ring_transfer(
+    src: Event, dst: Event, kind: str, tick: int
+) -> Transfer:
+    return Transfer(
+        src, dst, Channel(kind, src.mb, src.rank, dst.rank),
+        collective=(kind, tick),
+    )
+
+
+def spmd_fill_drain_events(n: int, m: int, stop: int = 0) -> EventGraph:
+    """The compiled fill-drain scan (``spmd.SpmdGPipe``): lane ``j`` runs
+    micro-batch ``t - j`` at tick ``t``; hand-offs ride one forward-ring
+    ``ppermute`` per tick; backward is ``jax.grad`` through the scan, so
+    its events mirror the forward in exact reverse."""
+    g = EventGraph("spmd", "fill_drain", n, m, [[] for _ in range(n)],
+                   lockstep=True, gathered_loss=True)
+    fwd_of: Dict[Tuple[int, int], Event] = {}
+    bwd_of: Dict[Tuple[int, int], Event] = {}
+    ticks = m + n - 1
+    fwd_ticks: List[List[Event]] = []
+    for t in range(ticks):
+        row = []
+        for j in range(n):
+            i = t - j
+            if 0 <= i < m:
+                ev = Event(j, j, i, FWD)
+                fwd_of[(i, j)] = ev
+                g.order[j].append(ev)
+                row.append(ev)
+        fwd_ticks.append(row)
+    # Backward: XLA reverses the scan — same cells, reverse tick order.
+    for t in range(ticks - 1, -1, -1):
+        for ev in reversed(fwd_ticks[t]):
+            b = Event(ev.rank, ev.stage, ev.mb, BWD)
+            bwd_of[(ev.mb, ev.stage)] = b
+            g.order[ev.rank].append(b)
+    for t, row in enumerate(fwd_ticks):
+        for ev in row:
+            if ev.stage < n - 1:
+                g.transfers.append(_ring_transfer(
+                    ev, fwd_of[(ev.mb, ev.stage + 1)], "fwd_ring", t
+                ))
+    for t in range(ticks):
+        for ev in fwd_ticks[t]:
+            if ev.stage > 0:
+                # Cotangent ring: the reversed tick index for symmetry.
+                g.transfers.append(_ring_transfer(
+                    bwd_of[(ev.mb, ev.stage)],
+                    bwd_of[(ev.mb, ev.stage - 1)],
+                    "bwd_ring", 2 * ticks - 1 - t,
+                ))
+    for i in range(m):
+        for k in range(m):
+            g.deps.append((fwd_of[(i, n - 1)], bwd_of[(k, n - 1)]))
+    _annotate_mpmd_buffers(g, fwd_of, bwd_of, stop, n, m)
+    _annotate_params(g)
+    return g
+
+
+def spmd_1f1b_events(n: int, m: int, stop: int = 0) -> EventGraph:
+    """The compiled 1F1B scan, from the engine's closed-form tick
+    predicates (``spmd._build_train_step_1f1b`` — the same predicates
+    ``parallel.zerobubble.fused_1f1b_weighted_makespan`` evaluates)."""
+    g = EventGraph("spmd", "1f1b", n, m, [[] for _ in range(n)],
+                   lockstep=True, gathered_loss=False)
+    fwd_of: Dict[Tuple[int, int], Event] = {}
+    bwd_of: Dict[Tuple[int, int], Event] = {}
+    fwd_tick: Dict[Tuple[int, int], int] = {}
+    bwd_tick: Dict[Tuple[int, int], int] = {}
+    for t in range(2 * (m + n - 1)):
+        for j in range(n):
+            tj = t - j
+            warm = 0 <= tj <= n - 1 - j and tj < m
+            i_s = tj // 2 if tj >= 0 else 0
+            steady = tj >= 0 and tj % 2 == 0 and i_s > n - 1 - j and i_s < m
+            num = t + j - (2 * n - 1)
+            do_b = num >= 0 and num % 2 == 0 and num // 2 < m
+            if do_b:
+                i = num // 2
+                ev = Event(j, j, i, BWD)
+                bwd_of[(i, j)] = ev
+                bwd_tick[(i, j)] = t
+                g.order[j].append(ev)
+            elif warm or steady:
+                i = tj if warm else i_s
+                ev = Event(j, j, i, FWD)
+                fwd_of[(i, j)] = ev
+                fwd_tick[(i, j)] = t
+                g.order[j].append(ev)
+    for i in range(m):
+        for j in range(n - 1):
+            g.transfers.append(_ring_transfer(
+                fwd_of[(i, j)], fwd_of[(i, j + 1)],
+                "fwd_ring", fwd_tick[(i, j)],
+            ))
+            g.transfers.append(_ring_transfer(
+                bwd_of[(i, j + 1)], bwd_of[(i, j)],
+                "bwd_ring", bwd_tick[(i, j + 1)],
+            ))
+        g.deps.append((fwd_of[(i, n - 1)], bwd_of[(i, n - 1)]))
+    _annotate_mpmd_buffers(g, fwd_of, bwd_of, stop, n, m)
+    _annotate_params(g)
+    return g
+
+
+def spmd_interleaved_events(n: int, m: int, v: int) -> EventGraph:
+    """The interleaved (virtual stages) scan, straight from the static
+    tables the engine scans over (``parallel.interleaved``).  Global stage
+    of device ``j`` chunk ``c`` is ``c*n + j`` (Megatron round-robin)."""
+    from torchgpipe_tpu.parallel.interleaved import (
+        BWD as I_BWD, FWD as I_FWD, IDLE, _producer, interleaved_tables,
+    )
+
+    tb = interleaved_tables(n, m, v)
+    g = EventGraph("spmd", "interleaved", n * v, m,
+                   [[] for _ in range(n)], lockstep=True,
+                   gathered_loss=False)
+    ev_of: Dict[Tuple[int, int, int, int], Event] = {}
+    tick_of: Dict[Tuple[int, int, int, int], int] = {}
+    for t in range(tb.ticks):
+        for j in range(n):
+            k = int(tb.kind[t, j])
+            if k == IDLE:
+                continue
+            c, i = int(tb.chunk[t, j]), int(tb.mb[t, j])
+            ph = FWD if k == I_FWD else BWD
+            ev = Event(j, c * n + j, i, ph)
+            ev_of[(k, c, i, j)] = ev
+            tick_of[(k, c, i, j)] = t
+            g.order[j].append(ev)
+    for (k, c, i, j), ev in ev_of.items():
+        dep = _producer(n, v, k, c, i, j)
+        if dep is not None:
+            src = ev_of[dep[0], dep[1], dep[2], dep[3]]
+            if src.rank == ev.rank:
+                g.deps.append((src, ev))
+            else:
+                ring = "fwd_ring" if k == I_FWD else "bwd_ring"
+                g.transfers.append(_ring_transfer(
+                    src, ev, ring,
+                    tick_of[dep[0], dep[1], dep[2], dep[3]],
+                ))
+        if k == I_BWD and c == v - 1 and j == n - 1:
+            g.deps.append((ev_of[(I_FWD, c, i, j)], ev))
+    # Buffers: every forward keeps its saved input / residual for its own
+    # backward within the schedule window.
+    for (k, c, i, j), ev in ev_of.items():
+        if k == I_FWD:
+            buf = Buffer("resid", c * n + j, i, j)
+            g.add_write(ev, buf)
+            g.add_consume(ev_of[(I_BWD, c, i, j)], buf)
+    _annotate_params(g)
+    return g
+
+
+def spmd_zb_events(n: int, m: int) -> EventGraph:
+    """The zero-bubble (ZB-H1) scan, from its validated static tables
+    (``parallel.zerobubble``).  ``B`` cells are phase ``bwd`` (activation
+    gradient, on the critical path); ``W`` cells are phase ``wgt`` and
+    depend on their same-stage ``B``."""
+    from torchgpipe_tpu.parallel.zerobubble import (
+        B as Z_B, F as Z_F, IDLE, W as Z_W, zero_bubble_tables,
+    )
+
+    tb = zero_bubble_tables(n, m)
+    g = EventGraph("spmd", "zb", n, m, [[] for _ in range(n)],
+                   lockstep=True, gathered_loss=False)
+    ev_of: Dict[Tuple[int, int, int], Event] = {}
+    tick_of: Dict[Tuple[int, int, int], int] = {}
+    phase_of = {Z_F: FWD, Z_B: BWD, Z_W: WGT}
+    for t in range(tb.ticks):
+        for j in range(n):
+            k = int(tb.kind[t, j])
+            if k == IDLE:
+                continue
+            i = int(tb.mb[t, j])
+            ev = Event(j, j, i, phase_of[k])
+            ev_of[(k, i, j)] = ev
+            tick_of[(k, i, j)] = t
+            g.order[j].append(ev)
+    for i in range(m):
+        for j in range(n):
+            f, b, w = ev_of[(Z_F, i, j)], ev_of[(Z_B, i, j)], ev_of[(Z_W, i, j)]
+            if j < n - 1:
+                g.transfers.append(_ring_transfer(
+                    f, ev_of[(Z_F, i, j + 1)], "fwd_ring",
+                    tick_of[(Z_F, i, j)],
+                ))
+                g.transfers.append(_ring_transfer(
+                    ev_of[(Z_B, i, j + 1)], b, "bwd_ring",
+                    tick_of[(Z_B, i, j + 1)],
+                ))
+            else:
+                g.deps.append((f, b))
+            # The split backward: W replays B's residuals and cotangent.
+            g.deps.append((b, w))
+            # Residuals live F -> W (the proven resid_slots geometry).
+            buf = Buffer("resid", j, i, j)
+            g.add_write(f, buf)
+            g.add_read(b, buf)
+            g.add_consume(w, buf)
+    _annotate_params(g)
+    return g
+
+
+# --------------------------------------------------------------------- #
+# dispatch + optimizer-update extension                                 #
+# --------------------------------------------------------------------- #
+
+
+def events_for(pipe: Any, chunks: Optional[int] = None) -> EventGraph:
+    """Build the event graph of ``pipe``'s configured scheduler.
+
+    ``pipe`` is a :class:`~torchgpipe_tpu.gpipe.GPipe`,
+    :class:`~torchgpipe_tpu.spmd.SpmdGPipe` or
+    :class:`~torchgpipe_tpu.distributed.gpipe.DistributedGPipe`;
+    ``chunks`` overrides the micro-batch count (ragged batches scatter
+    fewer than ``pipe.chunks``).
+    """
+    from torchgpipe_tpu.checkpoint import checkpoint_stop
+    from torchgpipe_tpu.distributed.gpipe import DistributedGPipe
+    from torchgpipe_tpu.gpipe import GPipe
+    from torchgpipe_tpu.spmd import SpmdGPipe
+
+    if isinstance(pipe, SpmdGPipe):
+        m = chunks or pipe.chunks
+        stop = checkpoint_stop(pipe.checkpoint, m, train=True)
+        if pipe.schedule == "fill_drain":
+            return spmd_fill_drain_events(pipe.n_stages, m, stop)
+        if pipe.schedule == "1f1b":
+            return spmd_1f1b_events(pipe.n_stages, m, stop)
+        if pipe.schedule == "interleaved":
+            return spmd_interleaved_events(
+                pipe.n_stages, m, pipe.virtual_stages
+            )
+        if pipe.schedule == "zb":
+            return spmd_zb_events(pipe.n_stages, m)
+        raise ValueError(f"unknown SPMD schedule {pipe.schedule!r}")
+    if isinstance(pipe, DistributedGPipe):
+        m = chunks or pipe.chunks
+        n = len(pipe.workers)
+        stop = checkpoint_stop(pipe.checkpoint, m, train=True)
+        layout = pipe.layout
+        skips = [
+            (str(key), src, dst)
+            for key, (src, dst) in sorted(
+                layout.by_key.items(), key=lambda kv: str(kv[0])
+            )
+            if src != dst
+        ]
+        return distributed_events(
+            n, m, stop, skips=skips, workers=pipe.workers
+        )
+    if isinstance(pipe, GPipe):
+        m = chunks or pipe.chunks
+        n = len(pipe.partitions)
+        stop = checkpoint_stop(pipe.checkpoint, m, train=True)
+        if pipe.schedule == "1f1b":
+            return mpmd_1f1b_events(n, m, stop)
+        return mpmd_fill_drain_events(n, m, stop)
+    raise TypeError(
+        "events_for needs a GPipe, SpmdGPipe or DistributedGPipe, got "
+        f"{type(pipe).__name__}"
+    )
+
+
+def with_update(graph: EventGraph, donate: bool = True) -> EventGraph:
+    """Append the per-rank optimizer-update events of
+    ``make_train_step(donate=)``: each update reads the rank's gradients
+    (ordered after every backward of that rank by program order) and, with
+    ``donate=True``, CONSUMES the rank's parameter buffers — any
+    parameter read not strictly ordered before the update is then a
+    use-after-donate the verifier flags."""
+    g = graph.copy()
+    for r in range(g.n_ranks):
+        stages = sorted({ev.stage for ev in g.order[r]
+                         if ev.phase in (FWD, BWD, WGT)})
+        upd = Event(r, stages[0] if stages else r, -1, UPD)
+        g.order[r].append(upd)
+        if donate:
+            for s in stages:
+                g.add_consume(upd, Buffer("params", s, -1, r))
+    return g
+
+
+# --------------------------------------------------------------------- #
+# fault-plan IR mutations                                               #
+# --------------------------------------------------------------------- #
+
+
+def _channel_matches(
+    t: Transfer, kind: Any, index: Optional[int], dst: Optional[int]
+) -> bool:
+    return (
+        (kind is None or t.channel.kind == kind)
+        and (index is None or t.channel.index == index)
+        and (dst is None or t.channel.dst == dst)
+    )
+
+
+def _mutate_matching(
+    graph: EventGraph,
+    kind: Any,
+    index: Optional[int],
+    dst: Optional[int],
+    times: int,
+    field: str,
+    value: Any,
+) -> EventGraph:
+    g = graph.copy()
+    fired = 0
+    for t in g.transfers:
+        if times >= 0 and fired >= times:
+            break
+        if _channel_matches(t, kind, index, dst):
+            setattr(t, field, value)
+            fired += 1
+    if fired == 0:
+        raise ValueError(
+            f"no transfer matches channel kind={kind!r} index={index!r} "
+            f"dst={dst!r} — the mutation would be a silent no-op"
+        )
+    return g
+
+
+def drop_transfer(
+    graph: EventGraph,
+    kind: Any,
+    index: Optional[int] = None,
+    dst: Optional[int] = None,
+    times: int = 1,
+) -> EventGraph:
+    """Lose the matching send(s): the message never arrives, the receiver
+    blocks forever (the ``drop``/``lose`` fault actions)."""
+    return _mutate_matching(graph, kind, index, dst, times, "lost", True)
+
+
+def duplicate_transfer(
+    graph: EventGraph,
+    kind: Any,
+    index: Optional[int] = None,
+    dst: Optional[int] = None,
+    times: int = 1,
+) -> EventGraph:
+    """Deliver the matching send(s) twice: the extra copy goes stale in
+    the FIFO channel and aliases the next same-key receive."""
+    return _mutate_matching(
+        graph, kind, index, dst, times, "duplicated", True
+    )
+
+
+def delay_transfer(
+    graph: EventGraph,
+    kind: Any,
+    index: Optional[int] = None,
+    dst: Optional[int] = None,
+    ticks: int = 1,
+    times: int = 1,
+) -> EventGraph:
+    """Deliver the matching send(s) ``ticks`` late — harmless on blocking
+    transports, fatal on lockstep (SPMD) schedules whose receive tick is
+    compiled in."""
+    return _mutate_matching(graph, kind, index, dst, times, "delay", ticks)
+
+
+def swap_channels(graph: EventGraph, kind: Any, i: int, k: int) -> EventGraph:
+    """Swap the payloads of channels ``(kind, i)`` and ``(kind, k)`` — the
+    classic reordered send/recv pair: both receivers unblock, both read
+    the WRONG micro-batch."""
+    g = graph.copy()
+    a = [t for t in g.transfers if _channel_matches(t, kind, i, None)]
+    b = [t for t in g.transfers if _channel_matches(t, kind, k, None)]
+    if not a or not b:
+        raise ValueError(f"channels ({kind!r},{i}) / ({kind!r},{k}) not found")
+    a[0].channel, b[0].channel = b[0].channel, a[0].channel
+    return g
+
+
+def apply_send_faults(graph: EventGraph, faults: Iterable[Any]) -> EventGraph:
+    """Express :class:`torchgpipe_tpu.resilience.faults.SendFault` rules as
+    IR mutations, so a chaos plan and its static verdict share one spec.
+
+    ``drop`` and ``lose`` both leave the receiver without its message
+    (drop raises at the sender, lose discards silently — statically the
+    same unmatched receive); ``duplicate`` leaves a stale copy;
+    ``delay`` marks the transfer late by one tick.  ``dst`` names match
+    ``graph.workers``.
+    """
+    g = graph
+    for f in faults:
+        dst_rank = (
+            list(g.workers).index(f.dst)
+            if f.dst is not None and f.dst in g.workers
+            else None
+        )
+        times = f.times if f.times is not None else 1
+        if f.action in ("drop", "lose"):
+            g = drop_transfer(g, f.kind, f.index, dst_rank, times)
+        elif f.action == "duplicate":
+            g = duplicate_transfer(g, f.kind, f.index, dst_rank, times)
+        elif f.action == "delay":
+            g = delay_transfer(g, f.kind, f.index, dst_rank, 1, times)
+        else:
+            raise ValueError(f"unknown fault action {f.action!r}")
+    return g

@@ -256,7 +256,9 @@ def flops_estimate(jaxpr: Any) -> float:
         name = eqn.primitive.name
         subs = subjaxprs(eqn)
         if name == "scan":
-            length = eqn.params.get("length") or 1
+            length = eqn.params.get("length")
+            if length is None:  # a length-0 scan really runs 0 bodies
+                length = 1
             total += length * sum(flops_estimate(s) for s in subs)
         elif name == "cond":
             total += max((flops_estimate(s) for s in subs), default=0.0)

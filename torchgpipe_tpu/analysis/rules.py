@@ -543,6 +543,45 @@ RULES: List[Rule] = [
     ),
 ]
 
+
+def _register_schedule_rules() -> None:
+    """The schedule-level rule family (event-graph IR analyses) lives in
+    :mod:`torchgpipe_tpu.analysis.schedule`; registering here keeps ONE
+    rule registry for the API, the CLI and CI."""
+    from torchgpipe_tpu.analysis import schedule as sched
+
+    RULES.extend([
+        Rule(
+            "schedule-deadlock",
+            "the configured scheduler's event graph must be cycle-free, "
+            "every receive matched by its send (FIFO order, channel keys "
+            "and collective permutations consistent)",
+            sched.check_schedule_order,
+        ),
+        Rule(
+            "donation-safety",
+            "buffers donated through make_train_step(donate=) or freed by "
+            "the schedule (vjp residuals, offload relocation) must have "
+            "no read reachable after the consuming event",
+            sched.check_donation,
+        ),
+        Rule(
+            "memory-certification",
+            "the event-graph certified per-stage high-water mark must "
+            "agree with tune.py's eval_shape residual accounting and fit "
+            "a declared HBM budget",
+            sched.check_memory,
+        ),
+        Rule(
+            "engine-equivalence",
+            "MPMD and SPMD event graphs for the same model/chunks must be "
+            "bisimilar up to schedule (same cells, same data dependencies)",
+            sched.check_engine_equivalence,
+        ),
+    ])
+
+_register_schedule_rules()
+
 RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULES}
 
 

@@ -1,0 +1,835 @@
+"""Static schedule verifier: deadlock, communication, donation and memory
+safety over the event-graph IR.
+
+Four analyses run over :class:`torchgpipe_tpu.analysis.events.EventGraph`
+(see that module for how graphs are extracted from every shipped
+scheduler):
+
+1. **deadlock / ordering** (:func:`verify_ordering`) — cycle detection
+   over the happens-before relation plus an exact per-rank program-order
+   simulation with blocking FIFO channels: every receive must be preceded
+   by its matching send on some concurrently-runnable rank.  Unmatched
+   channels, reordered send/recv pairs, stale (duplicated) messages and
+   collective-permutation mismatches across SPMD stage programs are
+   ERRORs; on lockstep (compiled-scan) schedules a transfer arriving
+   after its consumer's tick is an ERROR too (the consumer reads
+   garbage, it cannot block).
+2. **donation / aliasing safety** (:func:`verify_buffers`) — buffers
+   donated through ``make_train_step(donate=)`` (and every
+   schedule-managed residual) are consumed exactly once, with no read
+   reachable at-or-after the consuming event in happens-before order.
+3. **memory certification** (:func:`certify_memory`) — per-rank live
+   -interval analysis over the event graph yields a certified high-water
+   mark of schedule-managed bytes; the rule cross-checks it against
+   ``tune.py``'s closed-form ``eval_shape`` residual accounting
+   (disagreement beyond tolerance is itself a WARNING — one of the two
+   models is wrong) and against an optional HBM budget (ERROR).
+4. **engine equivalence** (:func:`verify_equivalence`) — the MPMD and
+   SPMD event graphs for the same model/chunks must be bisimilar up to
+   schedule (same compute cells, same data-dependency relation), and
+   both must equal the canonical GPipe dataflow — a new scheduler cannot
+   silently change semantics.
+
+All four are wired into :data:`torchgpipe_tpu.analysis.rules.RULES`, so
+``analysis.lint``, ``tools/pipeline_lint.py`` and ``tools/ci_lint.py``
+pick them up on every model.  ``python -m torchgpipe_tpu.analysis.schedule``
+self-checks every shipped scheduler over a parameter grid (the CI fast
+gate's engine-level half — no tracing, pure Python, seconds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import (
+    Any, Callable, Dict, List, Optional, Sequence, Set, Tuple,
+)
+
+from torchgpipe_tpu.analysis import events as ev
+from torchgpipe_tpu.analysis.diagnostics import Finding, Severity
+from torchgpipe_tpu.analysis.events import (
+    Buffer, Event, EventGraph, Transfer,
+)
+
+# --------------------------------------------------------------------- #
+# happens-before                                                        #
+# --------------------------------------------------------------------- #
+
+
+def _happens_before_edges(g: EventGraph) -> List[Tuple[Event, Event]]:
+    edges: List[Tuple[Event, Event]] = []
+    for rank_order in g.order:
+        edges.extend(zip(rank_order, rank_order[1:]))
+    edges.extend(g.deps)
+    edges.extend((t.src, t.dst) for t in g.transfers if not t.lost)
+    return edges
+
+
+def _find_cycle(g: EventGraph) -> Optional[List[Event]]:
+    """First cycle in the happens-before relation, or None (iterative DFS
+    with an explicit stack — schedules can be thousands of events)."""
+    succ: Dict[Event, List[Event]] = {}
+    for a, b in _happens_before_edges(g):
+        succ.setdefault(a, []).append(b)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[Event, int] = {}
+    parent: Dict[Event, Event] = {}
+    for root in g.events():
+        if color.get(root, WHITE) != WHITE:
+            continue
+        stack: List[Tuple[Event, int]] = [(root, 0)]
+        color[root] = GREY
+        while stack:
+            node, idx = stack[-1]
+            nxt = succ.get(node, [])
+            if idx < len(nxt):
+                stack[-1] = (node, idx + 1)
+                child = nxt[idx]
+                c = color.get(child, WHITE)
+                if c == GREY:
+                    cyc = [child, node]
+                    cur = node
+                    while cur != child and cur in parent:
+                        cur = parent[cur]
+                        cyc.append(cur)
+                    return list(reversed(cyc))
+                if c == WHITE:
+                    color[child] = GREY
+                    parent[child] = node
+                    stack.append((child, 0))
+            else:
+                color[node] = BLACK
+                stack.pop()
+    return None
+
+
+def _ancestor_sets(g: EventGraph) -> Optional[Dict[Event, Set[int]]]:
+    """Per-event strict-ancestor sets (as event indices) in topological
+    order; None when the graph is cyclic (cycle findings cover that)."""
+    index = {e: k for k, e in enumerate(g.events())}
+    succ: Dict[Event, List[Event]] = {}
+    indeg: Dict[Event, int] = {e: 0 for e in index}
+    for a, b in _happens_before_edges(g):
+        succ.setdefault(a, []).append(b)
+        indeg[b] = indeg.get(b, 0) + 1
+    ready = [e for e, d in indeg.items() if d == 0]
+    anc: Dict[Event, Set[int]] = {e: set() for e in index}
+    done = 0
+    while ready:
+        node = ready.pop()
+        done += 1
+        for child in succ.get(node, []):
+            anc[child] |= anc[node]
+            anc[child].add(index[node])
+            indeg[child] -= 1
+            if indeg[child] == 0:
+                ready.append(child)
+    if done != len(index):
+        return None
+    return anc
+
+
+# --------------------------------------------------------------------- #
+# 1. deadlock / ordering / communication                                #
+# --------------------------------------------------------------------- #
+
+
+def _anchor(g: EventGraph) -> str:
+    return f"schedule/{g.engine}/{g.schedule}"
+
+
+def _check_channel_labels(g: EventGraph) -> List[Finding]:
+    """A data channel's label must agree with the payload it carries: the
+    mailbox key's micro-batch index is how the receiver identifies the
+    message, so label != payload means the receiver computes with the
+    WRONG micro-batch (the swapped/reordered send-recv pair)."""
+    out: List[Finding] = []
+    for t in g.transfers:
+        if t.src.phase == ev.META:
+            continue
+        if t.channel.index != t.src.mb or t.channel.index != t.dst.mb:
+            out.append(Finding(
+                rule="schedule-deadlock",
+                severity=Severity.ERROR,
+                path=_anchor(g),
+                message=(
+                    f"send/recv pair mismatched on channel "
+                    f"({t.channel.kind!r}, {t.channel.index}): the sender "
+                    f"is {t.src!r} and the receiver {t.dst!r} — the "
+                    "receiver consumes the wrong micro-batch's payload "
+                    "(reordered or swapped channel keys); gradients are "
+                    "garbage with no crash"
+                ),
+            ))
+        if t.channel.src != t.src.rank or t.channel.dst != t.dst.rank:
+            out.append(Finding(
+                rule="schedule-deadlock",
+                severity=Severity.ERROR,
+                path=_anchor(g),
+                message=(
+                    f"channel ({t.channel.kind!r}, {t.channel.index}) "
+                    f"routes {t.channel.src}->{t.channel.dst} but its "
+                    f"events run on ranks {t.src.rank}->{t.dst.rank} — "
+                    "the message lands in the wrong mailbox"
+                ),
+            ))
+    return out
+
+
+def _check_collectives(g: EventGraph) -> List[Finding]:
+    """Every collective tag groups one tick's ring ``ppermute``: each lane
+    sends at most once, receives at most once, and all legs step the SAME
+    ring direction.  SPMD stage programs are one compiled SPMD program —
+    a lane whose permutation row disagrees (a swapped pair, a dropped or
+    doubled leg) deadlocks the collective on hardware or silently
+    misroutes on CPU."""
+    out: List[Finding] = []
+    by_tag: Dict[Tuple[str, int], List[Transfer]] = {}
+    for t in g.transfers:
+        if t.collective is not None:
+            by_tag.setdefault(t.collective, []).append(t)
+    n = g.n_ranks
+    for tag, legs in sorted(by_tag.items(), key=lambda kv: str(kv[0])):
+        srcs = [t.src.rank for t in legs if not t.lost]
+        dsts = [t.dst.rank for t in legs if not t.lost]
+        step = +1 if tag[0] == "fwd_ring" else -1
+        bad_step = [
+            t for t in legs
+            if not t.lost and (t.src.rank + step) % n != t.dst.rank % n
+        ]
+        dup = len(srcs) != len(set(srcs)) or len(dsts) != len(set(dsts))
+        lost = [t for t in legs if t.lost]
+        if bad_step or dup or lost:
+            what = (
+                "a leg permutes against the ring direction" if bad_step
+                else "a lane participates twice" if dup
+                else "a lane's leg is missing"
+            )
+            out.append(Finding(
+                rule="schedule-deadlock",
+                severity=Severity.ERROR,
+                path=_anchor(g),
+                message=(
+                    f"collective-permutation mismatch at {tag[0]} tick "
+                    f"{tag[1]}: {what} — the SPMD stage programs no "
+                    "longer agree on one permutation; on TPU the "
+                    "ppermute deadlocks the step, on CPU it misroutes "
+                    "silently"
+                ),
+            ))
+    return out
+
+
+def _check_lockstep(g: EventGraph) -> List[Finding]:
+    """Compiled-scan schedules cannot block: a value must be PRESENT at
+    its consumer's tick, so a delivery delayed past that tick is not a
+    slowdown but garbage data (the consumer reads a stale ring slot)."""
+    out: List[Finding] = []
+    for t in g.transfers:
+        if t.delay > 0:
+            out.append(Finding(
+                rule="schedule-deadlock",
+                severity=Severity.ERROR,
+                path=_anchor(g),
+                message=(
+                    f"transfer {t.src!r} -> {t.dst!r} on channel "
+                    f"({t.channel.kind!r}, {t.channel.index}) is delayed "
+                    f"{t.delay} tick(s) past its compiled consumer tick — "
+                    "a lockstep schedule cannot wait; the consumer "
+                    "computes with a stale ring slot"
+                ),
+            ))
+    return out
+
+
+def _simulate(g: EventGraph) -> List[Finding]:
+    """Execute the per-rank program orders against blocking FIFO channels.
+
+    This is the operational meaning of the schedule: a rank's next event
+    runs iff its same-graph dependencies have executed and every inbound
+    channel holds its message.  No progress with events pending is a
+    deadlock; leftover messages are unmatched sends."""
+    out: List[Finding] = []
+    chan: Dict[Tuple, List[Event]] = {}
+    inbound: Dict[Event, List[Transfer]] = {}
+    outbound: Dict[Event, List[Transfer]] = {}
+    for t in g.transfers:
+        inbound.setdefault(t.dst, []).append(t)
+        outbound.setdefault(t.src, []).append(t)
+    dep_of: Dict[Event, List[Event]] = {}
+    for a, b in g.deps:
+        dep_of.setdefault(b, []).append(a)
+
+    def ckey(t: Transfer) -> Tuple:
+        return (t.channel.kind, t.channel.index, t.channel.src,
+                t.channel.dst)
+
+    executed: Set[Event] = set()
+    cursors = [0] * g.n_ranks
+    total = sum(len(o) for o in g.order)
+    wrong_payload: List[Tuple[Transfer, Event]] = []
+    while len(executed) < total:
+        progressed = False
+        for r in range(g.n_ranks):
+            while cursors[r] < len(g.order[r]):
+                e = g.order[r][cursors[r]]
+                if any(d not in executed for d in dep_of.get(e, [])):
+                    break
+                if any(not chan.get(ckey(t)) for t in inbound.get(e, [])):
+                    break
+                for t in inbound.get(e, []):
+                    got = chan[ckey(t)].pop(0)
+                    if got != t.src:
+                        wrong_payload.append((t, got))
+                for t in outbound.get(e, []):
+                    if not t.lost:
+                        chan.setdefault(ckey(t), []).append(t.src)
+                        if t.duplicated:
+                            chan[ckey(t)].append(t.src)
+                executed.add(e)
+                cursors[r] += 1
+                progressed = True
+        if not progressed:
+            break
+
+    if len(executed) < total:
+        blocked = []
+        for r in range(g.n_ranks):
+            if cursors[r] >= len(g.order[r]):
+                continue
+            e = g.order[r][cursors[r]]
+            waiting = [
+                f"({t.channel.kind!r}, {t.channel.index}) from rank "
+                f"{t.channel.src}"
+                + (" [send was LOST]" if t.lost else "")
+                for t in inbound.get(e, [])
+                if not chan.get(ckey(t))
+            ]
+            missing_deps = [
+                repr(d) for d in dep_of.get(e, []) if d not in executed
+            ]
+            blocked.append(
+                f"rank {r} blocked at {e!r} awaiting "
+                + (", ".join(waiting + missing_deps) or "nothing (?)")
+            )
+        never_sent = any(
+            t.lost
+            for r in range(g.n_ranks)
+            if cursors[r] < len(g.order[r])
+            for t in inbound.get(g.order[r][cursors[r]], [])
+        )
+        kind = (
+            "unmatched receive (its send never happens)"
+            if never_sent else "circular wait across ranks"
+        )
+        out.append(Finding(
+            rule="schedule-deadlock",
+            severity=Severity.ERROR,
+            path=_anchor(g),
+            message=(
+                f"schedule deadlocks after {len(executed)}/{total} "
+                f"events — {kind}: " + "; ".join(blocked)
+            ),
+        ))
+    for (kind, index, src, dst), msgs in sorted(
+        chan.items(), key=lambda kv: str(kv[0])
+    ):
+        if msgs:
+            out.append(Finding(
+                rule="schedule-deadlock",
+                severity=Severity.ERROR,
+                path=_anchor(g),
+                message=(
+                    f"unmatched send: {len(msgs)} message(s) left on "
+                    f"channel ({kind!r}, {index}) {src}->{dst} after the "
+                    "step — mailbox keys are reused every step, so the "
+                    "stale payload aliases the NEXT step's receive on "
+                    "this channel (silent off-by-one-step data)"
+                ),
+            ))
+    for t, got in wrong_payload:
+        out.append(Finding(
+            rule="schedule-deadlock",
+            severity=Severity.ERROR,
+            path=_anchor(g),
+            message=(
+                f"FIFO order violated on channel ({t.channel.kind!r}, "
+                f"{t.channel.index}): receiver {t.dst!r} expected the "
+                f"payload of {t.src!r} but got {got!r}"
+            ),
+        ))
+    return out
+
+
+def verify_ordering(g: EventGraph) -> List[Finding]:
+    """Analysis 1: deadlock, channel matching, FIFO order, collectives."""
+    out = _check_channel_labels(g)
+    out.extend(_check_collectives(g))
+    cyc = _find_cycle(g)
+    if cyc is not None:
+        out.append(Finding(
+            rule="schedule-deadlock",
+            severity=Severity.ERROR,
+            path=_anchor(g),
+            message=(
+                "happens-before cycle: "
+                + " -> ".join(repr(e) for e in cyc[:8])
+                + (" -> ..." if len(cyc) > 8 else f" -> {cyc[0]!r}")
+                + " — no execution order satisfies the schedule; every "
+                "rank waits on the next (the hang shows up on hardware "
+                "as all stages idle at 0% with no error)"
+            ),
+        ))
+        return out
+    if g.lockstep:
+        out.extend(_check_lockstep(g))
+    out.extend(_simulate(g))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# 2. donation / aliasing safety                                         #
+# --------------------------------------------------------------------- #
+
+
+def verify_buffers(g: EventGraph) -> List[Finding]:
+    """Analysis 2: every buffer consumed at most once, and no read
+    reachable at-or-after its consuming event.
+
+    The consuming event models XLA buffer donation (the optimizer update
+    of ``make_train_step(donate=True)``, a backward popping its vjp
+    residual, offload relocation freeing the device copy): after it, the
+    memory is XLA's to reuse, and any surviving read returns whatever
+    now lives there — garbage gradients with no crash."""
+    out: List[Finding] = []
+    anc = _ancestor_sets(g)
+    if anc is None:
+        return out  # cyclic graph: verify_ordering already errored
+    index = {e: k for k, e in enumerate(g.events())}
+    consumers: Dict[Buffer, List[Event]] = {}
+    readers: Dict[Buffer, List[Event]] = {}
+    for e, bufs in g.consumes.items():
+        for b in bufs:
+            consumers.setdefault(b, []).append(e)
+    for e, bufs in g.reads.items():
+        for b in bufs:
+            readers.setdefault(b, []).append(e)
+    for buf, cons in sorted(
+        consumers.items(), key=lambda kv: (kv[0].kind, kv[0].stage, kv[0].mb)
+    ):
+        if len(cons) > 1:
+            out.append(Finding(
+                rule="donation-safety",
+                severity=Severity.ERROR,
+                path=_anchor(g),
+                message=(
+                    f"buffer {buf.kind}[stage {buf.stage}"
+                    + (f", mb {buf.mb}" if buf.mb >= 0 else "")
+                    + f"] is consumed {len(cons)} times "
+                    f"({', '.join(repr(c) for c in cons)}) — donated or "
+                    "freed twice; the second consumer reads reused memory"
+                ),
+            ))
+            continue
+        c = cons[0]
+        for r in readers.get(buf, []):
+            if r == c:
+                continue
+            if index[r] not in anc[c]:
+                out.append(Finding(
+                    rule="donation-safety",
+                    severity=Severity.ERROR,
+                    path=_anchor(g),
+                    message=(
+                        f"use-after-donate: {r!r} reads buffer "
+                        f"{buf.kind}[stage {buf.stage}"
+                        + (f", mb {buf.mb}" if buf.mb >= 0 else "")
+                        + f"] which {c!r} donates/frees, and the read is "
+                        "NOT ordered before the donation — XLA may have "
+                        "already reused the memory (the "
+                        "make_train_step(donate=) contract: treat "
+                        "passed-in buffers as consumed)"
+                    ),
+                ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# 3. memory certification                                               #
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class MemoryCertificate:
+    """Certified per-rank schedule-managed memory high-water marks."""
+
+    per_rank: List[int]  # bytes at the high-water tick, device-resident
+    host_per_rank: List[int]  # host-offloaded bytes (checkpoint='offload')
+    # Peak simultaneously-live count of each buffer kind per rank — the
+    # schedule-shape numbers the tune.py cross-check compares against.
+    peak_live: List[Dict[str, int]]
+
+    @property
+    def high_water(self) -> int:
+        return max(self.per_rank, default=0)
+
+
+def certify_memory(
+    g: EventGraph,
+    buffer_bytes: Callable[[Buffer], int],
+    host_kinds: Sequence[str] = (),
+) -> MemoryCertificate:
+    """Analysis 3: live-interval analysis over the event graph.
+
+    A buffer is live on ``buf.rank`` from its writer's position in the
+    schedule's execution order until its last reader/consumer; the
+    certificate is each rank's maximum of the byte-weighted live set.
+    ``host_kinds`` names buffer kinds resident in HOST memory (the
+    offload modes) — accounted separately, not against device HBM.
+    """
+    pos: Dict[Event, int] = {}
+    for k, e in enumerate(_execution_order(g)):
+        pos[e] = k
+    spans: List[Tuple[Buffer, int, int]] = []
+    writers: Dict[Buffer, Event] = {}
+    for e, bufs in g.writes.items():
+        for b in bufs:
+            writers[b] = e
+    last_use: Dict[Buffer, int] = {}
+    for table in (g.reads, g.consumes):
+        for e, bufs in table.items():
+            for b in bufs:
+                if b in writers:
+                    last_use[b] = max(last_use.get(b, -1), pos[e])
+    for b, w in writers.items():
+        spans.append((b, pos[w], last_use.get(b, pos[w])))
+
+    n = g.n_ranks
+    peak_live: List[Dict[str, int]] = [dict() for _ in range(n)]
+    events_per_rank: List[List[Tuple[int, int, Buffer]]] = [
+        [] for _ in range(n)
+    ]
+    for b, start, end in spans:
+        events_per_rank[b.rank].append((start, end, b))
+    per_rank: List[int] = []
+    host_per_rank: List[int] = []
+    for r in range(n):
+        ticks = sorted({t for s, e_, _ in events_per_rank[r]
+                        for t in (s, e_)})
+        best = best_host = 0
+        for t in ticks:
+            live = [b for s, e_, b in events_per_rank[r] if s <= t <= e_]
+            dev = sum(
+                buffer_bytes(b) for b in live if b.kind not in host_kinds
+            )
+            host = sum(
+                buffer_bytes(b) for b in live if b.kind in host_kinds
+            )
+            best, best_host = max(best, dev), max(best_host, host)
+            counts: Dict[str, int] = {}
+            for b in live:
+                counts[b.kind] = counts.get(b.kind, 0) + 1
+            for kind, cnt in counts.items():
+                peak_live[r][kind] = max(peak_live[r].get(kind, 0), cnt)
+        per_rank.append(best)
+        host_per_rank.append(best_host)
+    return MemoryCertificate(per_rank, host_per_rank, peak_live)
+
+
+def _execution_order(g: EventGraph) -> List[Event]:
+    """A feasible execution interleaving (the simulation's dispatch
+    order); falls back to per-rank concatenation for cyclic graphs."""
+    chanq: Dict[Tuple, int] = {}
+    inbound: Dict[Event, List[Transfer]] = {}
+    outbound: Dict[Event, List[Transfer]] = {}
+    for t in g.transfers:
+        inbound.setdefault(t.dst, []).append(t)
+        outbound.setdefault(t.src, []).append(t)
+    dep_of: Dict[Event, List[Event]] = {}
+    for a, b in g.deps:
+        dep_of.setdefault(b, []).append(a)
+
+    def ckey(t: Transfer) -> Tuple:
+        return (t.channel.kind, t.channel.index, t.channel.src,
+                t.channel.dst)
+
+    out: List[Event] = []
+    executed: Set[Event] = set()
+    cursors = [0] * g.n_ranks
+    total = sum(len(o) for o in g.order)
+    while len(executed) < total:
+        progressed = False
+        for r in range(g.n_ranks):
+            while cursors[r] < len(g.order[r]):
+                e = g.order[r][cursors[r]]
+                if any(d not in executed for d in dep_of.get(e, [])):
+                    break
+                if any(
+                    chanq.get(ckey(t), 0) <= 0 for t in inbound.get(e, [])
+                ):
+                    break
+                for t in inbound.get(e, []):
+                    chanq[ckey(t)] -= 1
+                for t in outbound.get(e, []):
+                    if not t.lost:
+                        chanq[ckey(t)] = chanq.get(ckey(t), 0) + 1
+                out.append(e)
+                executed.add(e)
+                cursors[r] += 1
+                progressed = True
+        if not progressed:
+            for r in range(g.n_ranks):
+                out.extend(
+                    e for e in g.order[r][cursors[r]:] if e not in executed
+                )
+            break
+    return out
+
+
+# --------------------------------------------------------------------- #
+# 4. engine equivalence                                                 #
+# --------------------------------------------------------------------- #
+
+def _counterpart_builders() -> Dict[Tuple[str, str], Callable]:
+    """(engine, schedule) -> the OTHER engine's builder for the same
+    step; fill-drain/gpipe pairs share the gathered loss, the 1F1B
+    family (zb included) the per-micro-batch loss."""
+    return {
+        ("mpmd", "gpipe"): lambda n, m: ev.spmd_fill_drain_events(n, m),
+        ("spmd", "fill_drain"): lambda n, m: ev.mpmd_fill_drain_events(n, m),
+        ("distributed", "gpipe"): lambda n, m: ev.mpmd_fill_drain_events(n, m),
+        ("mpmd", "1f1b"): lambda n, m: ev.spmd_1f1b_events(n, m),
+        ("spmd", "1f1b"): lambda n, m: ev.mpmd_1f1b_events(n, m),
+        ("spmd", "zb"): lambda n, m: ev.mpmd_1f1b_events(n, m),
+    }
+
+
+def verify_equivalence(g: EventGraph) -> List[Finding]:
+    """Analysis 4: the graph's data-dependency relation must equal the
+    canonical GPipe dataflow for its stage/micro-batch counts, and must
+    be bisimilar to the other engine's graph for the same model shape
+    (where a counterpart schedule exists — interleaved has none at v>1;
+    its canonical check still runs over the n·v virtual stages)."""
+    out: List[Finding] = []
+    want = ev.canonical_dataflow(g.n_stages, g.chunks, g.gathered_loss)
+    got = g.dataflow()
+    if got != want:
+        missing = sorted(want - got)[:4]
+        extra = sorted(got - want)[:4]
+        out.append(Finding(
+            rule="engine-equivalence",
+            severity=Severity.ERROR,
+            path=_anchor(g),
+            message=(
+                f"schedule dataflow diverges from the canonical GPipe "
+                f"dependency relation over {g.n_stages} stages x "
+                f"{g.chunks} micro-batches: missing {missing}, extra "
+                f"{extra} — the scheduler changes WHAT is computed, not "
+                "just when"
+            ),
+        ))
+    builder = _counterpart_builders().get((g.engine, g.schedule))
+    if builder is not None:
+        other = builder(g.n_stages, g.chunks)
+        ok, why = ev.bisimilar(g, other)
+        if not ok:
+            out.append(Finding(
+                rule="engine-equivalence",
+                severity=Severity.ERROR,
+                path=_anchor(g),
+                message=(
+                    f"not bisimilar to its {other.engine}/"
+                    f"{other.schedule} counterpart: {why} — the two "
+                    "engines would train different models"
+                ),
+            ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# rule adapters (PipelineTrace -> findings); registered in rules.py     #
+# --------------------------------------------------------------------- #
+
+
+def _graph_for_trace(trace: Any) -> Optional[EventGraph]:
+    m = (
+        len(trace.mb_signatures)
+        if trace.engine == "mpmd" and trace.mb_signatures
+        else trace.chunks
+    )
+    try:
+        return ev.events_for(trace.pipe, chunks=m)
+    except (TypeError, ValueError):
+        # Unknown engine/schedule: the constructor validations already
+        # reject these loudly at build time; the lint stands down.
+        return None
+
+
+def check_schedule_order(trace: Any) -> List[Finding]:
+    g = _graph_for_trace(trace)
+    return verify_ordering(g) if g is not None else []
+
+
+def check_donation(trace: Any) -> List[Finding]:
+    g = _graph_for_trace(trace)
+    if g is None:
+        return []
+    donate = getattr(trace.pipe, "_train_step_donate", None)
+    if donate:
+        g = ev.with_update(g, donate=True)
+    return verify_buffers(g)
+
+
+def check_engine_equivalence(trace: Any) -> List[Finding]:
+    g = _graph_for_trace(trace)
+    return verify_equivalence(g) if g is not None else []
+
+
+# The closed-form per-stage multipliers tune.py's accounting implies for
+# the fill-drain schedule: how many residual closures and saved inputs
+# one stage holds between the forward and backward schedules.
+_TUNE_MULTIPLIERS: Dict[str, Callable[[int], Tuple[int, int]]] = {
+    "always": lambda m: (0, m),
+    "except_last": lambda m: (1, m - 1),
+    "never": lambda m: (m, 0),
+    "offload": lambda m: (m, 0),  # device ~0: resid bytes live on HOST
+}
+
+_MEMORY_TOLERANCE = 0.10
+
+
+def check_memory(trace: Any) -> List[Finding]:
+    """Certify per-stage high-water marks and cross-check tune.py.
+
+    MPMD fill-drain only: that is the schedule ``tune.py``'s closed-form
+    ``eval_shape`` accounting models (``mpmd_stage_residual_bytes``); the
+    1F1B and SPMD schedules bound their windows by construction (the
+    in-flight bound and the proven ring-slot geometry respectively)."""
+    if trace.engine != "mpmd" or getattr(trace.pipe, "schedule", "") != "gpipe":
+        return []
+    g = _graph_for_trace(trace)
+    if g is None:
+        return []
+    from torchgpipe_tpu import tune
+
+    profile = tune.mpmd_stage_memory_profile(trace.pipe, trace.x_spec)
+    if profile is None:
+        return []
+    resid_b, saved_b, out_b = profile
+
+    def bytes_of(buf: Buffer) -> int:
+        if buf.kind == "resid":
+            return resid_b[buf.stage]
+        if buf.kind == "saved":
+            return saved_b[buf.stage]
+        if buf.kind == "out":
+            return out_b
+        return 0
+
+    offload = trace.checkpoint == "offload"
+    cert = certify_memory(
+        g, bytes_of, host_kinds=("resid",) if offload else ()
+    )
+    out: List[Finding] = []
+    m = g.chunks
+    mult = _TUNE_MULTIPLIERS.get(trace.checkpoint)
+    if mult is not None:
+        n_resid, n_saved = mult(m)
+        for j in range(g.n_stages):
+            tune_bytes = n_resid * resid_b[j] + n_saved * saved_b[j]
+            certified = (
+                cert.host_per_rank[j] + cert.per_rank[j]
+                if offload
+                else cert.per_rank[j]
+            )
+            # The certificate also carries the last stage's gathered
+            # outputs; exclude them from the comparison (tune.py's
+            # accounting is residuals+saved inputs only).
+            certified -= cert.peak_live[j].get("out", 0) * out_b
+            ref = max(tune_bytes, 1)
+            if abs(certified - tune_bytes) / ref > _MEMORY_TOLERANCE:
+                out.append(Finding(
+                    rule="memory-certification",
+                    severity=Severity.WARNING,
+                    path=_anchor(g),
+                    message=(
+                        f"stage {j}: event-graph certified high-water "
+                        f"mark {certified} bytes disagrees with tune.py's "
+                        f"eval_shape accounting {tune_bytes} bytes "
+                        f"(checkpoint={trace.checkpoint!r}, m={m}) beyond "
+                        f"{_MEMORY_TOLERANCE:.0%} — one of the two memory "
+                        "models is wrong; trust neither until they agree"
+                    ),
+                ))
+    budget = getattr(trace.pipe, "hbm_budget_bytes", None)
+    if budget is not None and cert.high_water > budget:
+        out.append(Finding(
+            rule="memory-certification",
+            severity=Severity.ERROR,
+            path=_anchor(g),
+            message=(
+                f"certified schedule high-water mark "
+                f"{cert.high_water} bytes exceeds the declared HBM "
+                f"budget {budget} bytes (worst rank holds "
+                f"{max(cert.peak_live, key=lambda d: sum(d.values()), default={})} "
+                "live buffers at the peak) — the step OOMs after the "
+                "full compile; lower chunks-in-flight (1F1B), checkpoint "
+                "more, or offload"
+            ),
+        ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# grid self-check (ci_lint's engine-level fast gate)                    #
+# --------------------------------------------------------------------- #
+
+
+def selfcheck(verbose: bool = False) -> List[Finding]:
+    """Verify every shipped scheduler over a parameter grid: ordering,
+    buffers, and equivalence must all hold with zero findings.  Pure
+    Python over schedule tables — no tracing, no jax arrays."""
+    grid = [(2, 2), (2, 4), (3, 4), (4, 4), (4, 8), (1, 3)]
+    findings: List[Finding] = []
+    for n, m in grid:
+        graphs = [
+            ev.mpmd_fill_drain_events(n, m, stop=m - 1),
+            ev.mpmd_1f1b_events(n, m),
+            ev.distributed_events(n, m, stop=m - 1),
+            ev.spmd_fill_drain_events(n, m),
+            ev.spmd_1f1b_events(n, m),
+            ev.spmd_zb_events(n, m),
+        ]
+        if m % n == 0:
+            graphs.append(ev.spmd_interleaved_events(n, m, 2))
+        for g in graphs:
+            got = (
+                verify_ordering(g)
+                + verify_buffers(ev.with_update(g, donate=True))
+                + verify_equivalence(g)
+            )
+            if verbose or got:
+                tag = f"{g.engine}/{g.schedule} n={n} m={m}"
+                status = f"{len(got)} finding(s)" if got else "ok"
+                print(f"[schedule-verify] {tag}: {status}")
+            findings.extend(got)
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    from torchgpipe_tpu.analysis.diagnostics import format_findings
+
+    ap = argparse.ArgumentParser(
+        description="Self-check every shipped pipeline scheduler's event "
+        "graph (deadlock/donation/equivalence) over a parameter grid."
+    )
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    findings = selfcheck(verbose=args.verbose)
+    print(format_findings(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

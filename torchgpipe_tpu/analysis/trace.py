@@ -64,6 +64,9 @@ class PipelineTrace:
     # Per-micro-batch input signatures: one tuple of (leaf-path, shape,
     # dtype-name) triples per micro-batch, in schedule order.
     mb_signatures: List[Tuple] = dataclasses.field(default_factory=list)
+    # The avalified sample input (schedule rules re-derive per-stage byte
+    # accounting from it without re-asking the caller).
+    x_spec: Any = None
     # Trace-time failures, already converted to findings.
     errors: List[Finding] = dataclasses.field(default_factory=list)
 
@@ -180,6 +183,7 @@ def trace_gpipe(
         checkpoint=model.checkpoint,
         n_stages=len(model.partitions),
         compute_dtype=model.compute_dtype,
+        x_spec=x_spec,
     )
     try:
         params_spec, state_spec = jax.eval_shape(
@@ -288,6 +292,7 @@ def trace_spmd(
         n_stages=pipe.n_stages,
         mesh_axes=tuple(str(a) for a in pipe.mesh.axis_names),
         pp_axis=pipe.pp_axis,
+        x_spec=x_spec,
     )
     try:
         params_spec = jax.eval_shape(

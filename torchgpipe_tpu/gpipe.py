@@ -459,6 +459,9 @@ class GPipe:
         # the resilience.StepGuard skip-step contract restores them after
         # a non-finite update.
         upd = jax.jit(_upd, donate_argnums=(1, 2) if donate else ())
+        # The schedule verifier's donation-safety rule reads this to place
+        # the donating update event in the step's event graph.
+        self._train_step_donate = donate
 
         def step(
             params: Tuple[Pytree, ...],

@@ -3185,6 +3185,9 @@ class SpmdGPipe:
             static_argnums=(5,),
             donate_argnums=(0, 1) if donate else (),
         )
+        # The schedule verifier's donation-safety rule reads this to place
+        # the donating update event in the step's event graph.
+        self._train_step_donate = donate
 
         def step(
             params: Pytree,
