@@ -850,8 +850,30 @@ def _child_entry() -> None:
         _reexec_cpu_fallback(msg)
 
 
+def _decode_serving_entry() -> None:
+    """The ``decode-serving`` rung: tokens/sec through the continuous-
+    batching engine vs the static run-to-longest baseline, at fixed slot
+    counts (benchmarks/llama_serving.py — which owns the BENCH_NOTES.md
+    measurement-integrity contract: tokens host-fetched INSIDE the timed
+    region by construction, physical-floor refusal gate).  Dispatched
+    BEFORE the supervisor so the driver's one-JSON-line training-bench
+    contract is untouched; emits its own one JSON line.
+
+        python bench.py --decode-serving --preset 1b --slots 8   # TPU
+        env JAX_PLATFORMS=cpu python bench.py --decode-serving   # CPU ref
+    """
+    sys.argv = [sys.argv[0]] + [
+        a for a in sys.argv[1:] if a != "--decode-serving"
+    ] + ["--json"]
+    from benchmarks.llama_serving import main as serving_main
+
+    serving_main()
+
+
 if __name__ == "__main__":
-    if "--child" in sys.argv:
+    if "--decode-serving" in sys.argv:
+        _decode_serving_entry()
+    elif "--child" in sys.argv:
         _child_entry()
     else:
         _supervise()

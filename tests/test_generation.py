@@ -15,6 +15,7 @@ from torchgpipe_tpu.models.generation import (
     generate,
     mpmd_params_for_generation,
     prefill,
+    row_frontiers,
 )
 from torchgpipe_tpu.models.transformer import TransformerConfig, llama
 
@@ -681,3 +682,154 @@ def test_spmd_params_from_flat_roundtrip(cpu_devices):
     with pytest.raises(ValueError, match="spmd_params_from_flat"):
         pipe.train_step(bad, jnp.zeros((4, 8), jnp.int32),
                         jnp.zeros((4, 8), jnp.int32))
+
+
+# --------------------------------------------------------------------- #
+# per-row early exit (the batched-serving stop-handling fix)            #
+# --------------------------------------------------------------------- #
+
+
+def test_early_exit_equals_scan_path():
+    """early_exit's bounded while_loop emits EXACTLY the fixed-length
+    scan's tokens (frozen eos rows included)."""
+    b, s, new = 3, 5, 8
+    _, params, _ = _build(CFG, b, s)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s) * 5 + 2, CFG.vocab)
+    # Pick an eos some rows actually emit so the loop exits early.
+    ref = generate(CFG, params, tokens, new)
+    eos = int(np.asarray(ref)[0, 2])
+    a = generate(CFG, params, tokens, new, eos_id=eos)
+    b_ = generate(CFG, params, tokens, new, eos_id=eos, early_exit=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_early_exit_stops_at_longest_row():
+    """The decode loop terminates once EVERY row has finished — not at
+    max_new_tokens: with return_state the cache length shows the actual
+    step count (prompt + steps run)."""
+    b, s, new = 2, 4, 16
+    _, params, _ = _build(CFG, b, s)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s) * 3 + 1, CFG.vocab)
+    ref = np.asarray(generate(CFG, params, tokens, new))
+    # eos = a token every row emits before the last step, picked so the
+    # slowest row still finishes early
+    for eos in sorted(set(ref.flatten().tolist())):
+        firsts = [
+            np.where(ref[r] == eos)[0] for r in range(b)
+        ]
+        if all(len(f) for f in firsts):
+            longest = max(int(f[0]) for f in firsts)
+            if longest < new - 1:
+                break
+    else:
+        pytest.skip("no shared early token in this tiny model's outputs")
+    out, cache = generate(
+        CFG, params, tokens, new, eos_id=int(eos), early_exit=True,
+        return_state=True,
+    )
+    steps_run = int(cache.length) - s
+    assert steps_run == longest + 1, (steps_run, longest)
+    assert steps_run < new
+
+
+def test_early_exit_rows_independent():
+    """A row finishing early is a masked no-op: every batched row's
+    output equals that row decoded ALONE (per-row termination cannot
+    leak across rows)."""
+    b, s, new = 3, 5, 6
+    _, params, _ = _build(CFG, b, s)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s) * 7 + 4, CFG.vocab)
+    ref = np.asarray(generate(CFG, params, tokens, new))
+    eos = int(ref[1, 1])   # row 1 finishes at step 2; others likely later
+    batched = np.asarray(
+        generate(CFG, params, tokens, new, eos_id=eos, early_exit=True)
+    )
+    for r in range(b):
+        solo = np.asarray(
+            generate(CFG, params, tokens[r:r + 1], new, eos_id=eos)
+        )[0]
+        np.testing.assert_array_equal(batched[r], solo, err_msg=f"row {r}")
+
+
+def test_finished_rows_stop_writing_cache():
+    """With eos set, a finished row's K/V rows beyond its frontier stay
+    UNWRITTEN (zeros) — eos padding never enters the cache (the
+    serving/continuation fix)."""
+    b, s, new = 2, 4, 6
+    _, params, _ = _build(CFG, b, s)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s) * 3 + 1, CFG.vocab)
+    ref = np.asarray(generate(CFG, params, tokens, new, max_len=16))
+    eos = int(ref[0, 1])           # row 0 finishes at step 2
+    if eos in ref[1].tolist()[:3]:
+        pytest.skip("both rows finish immediately in this configuration")
+    out, cache = generate(
+        CFG, params, tokens, new, eos_id=eos, max_len=16,
+        return_state=True,
+    )
+    out = np.asarray(out)
+    # row 0: frontier = prompt + tokens up to/including its eos feed
+    n0 = int(np.where(out[0] == eos)[0][0]) + 1
+    k0 = np.asarray(cache.k[0][0], np.float32)    # layer 0, row 0
+    frontier = s + n0
+    assert np.all(k0[frontier:] == 0.0), "eos padding entered the cache"
+    assert np.any(k0[:frontier] != 0.0)
+
+
+def test_row_lengths_continuation_matches_solo():
+    """Multi-turn continuation with per-row frontiers (row_frontiers +
+    generate(row_lengths=...)): after an eos-ragged first turn, a second
+    turn continues every row at its OWN frontier and matches that row
+    decoded from scratch over its true token history — no row ever
+    attends over its unwritten [frontier, length) gap (the shared-scalar
+    default path's failure mode)."""
+    b, s, new1, L = 3, 4, 6, 32
+    _, params, _ = _build(CFG, b, s)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s) * 7 + 4, CFG.vocab)
+    ref1 = np.asarray(generate(CFG, params, tokens, new1, max_len=L))
+    eos = int(ref1[1, 1])      # row 1 finishes at step 2: a ragged turn
+    out1, cache = generate(
+        CFG, params, tokens, new1, eos_id=eos, max_len=L,
+        return_state=True,
+    )
+    out1 = np.asarray(out1)
+    rl = row_frontiers(s, jnp.asarray(out1), eos_id=eos)
+    assert int(np.asarray(rl)[1]) < s + new1   # row 1 finished early
+
+    s2, new2 = 2, 3
+    prompt2 = jnp.mod(jnp.arange(b * s2).reshape(b, s2) * 5 + 1, CFG.vocab)
+    out2, _, rl2 = generate(
+        CFG, params, prompt2, new2, cache=cache, row_lengths=rl,
+        return_state=True,
+    )
+    out2 = np.asarray(out2)
+    # no eos this turn: every frontier advances by the full turn
+    np.testing.assert_array_equal(
+        np.asarray(rl2), np.asarray(rl) + s2 + new2
+    )
+    for r in range(b):
+        wrote = int(np.asarray(rl)[r]) - s   # turn-1 tokens row r wrote
+        hist = np.concatenate([
+            np.asarray(tokens[r]), out1[r, :wrote], np.asarray(prompt2[r]),
+        ]).astype(np.int32)
+        solo = np.asarray(
+            generate(CFG, params, jnp.asarray(hist)[None], new2)
+        )[0]
+        np.testing.assert_array_equal(out2[r], solo, err_msg=f"row {r}")
+
+
+def test_row_lengths_capacity_and_shape_validation():
+    """The row-mode entry rejects a frontier vector of the wrong shape
+    and a turn the deepest row cannot fit in the first call's buffers."""
+    b, s = 2, 4
+    _, params, _ = _build(CFG, b, s)
+    tokens = jnp.mod(jnp.arange(b * s).reshape(b, s), CFG.vocab)
+    _, cache = generate(
+        CFG, params, tokens, 2, max_len=12, return_state=True
+    )
+    rl = jnp.full((b,), s + 2, jnp.int32)
+    with pytest.raises(ValueError, match="one frontier per prompt row"):
+        generate(CFG, params, tokens[:, :2], 2, cache=cache,
+                 row_lengths=jnp.zeros((b + 1,), jnp.int32))
+    with pytest.raises(ValueError, match="deepest row"):
+        generate(CFG, params, tokens[:, :2], 8, cache=cache,
+                 row_lengths=rl)

@@ -653,6 +653,61 @@ def test_preemption_handler_latches_sigterm():
     assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
 
 
+def test_preemption_callbacks_fire_once_even_late():
+    """Drain hooks fire exactly once each — including hooks registered
+    AFTER preemption latched (the serving engine may be built mid-grace-
+    window), and a failing hook never blocks the others."""
+    h = PreemptionHandler()
+    early, late = [], []
+    h.add_callback(lambda: early.append(1))
+    h.add_callback(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    h.simulate()
+    assert early == [1]
+    h.simulate()                      # re-latch: no double delivery
+    assert early == [1]
+    h.add_callback(lambda: late.append(1))   # registered after the latch
+    assert late == [1]
+
+
+def test_preemption_callbacks_do_not_pin_bound_engines():
+    """A bound-method hook is held weakly: discarding the object that
+    registered it (a dead serving engine and its KV pool) leaves it
+    collectable, and the latch skips the dead hook."""
+    import gc
+    import weakref
+
+    calls = []
+
+    class Owner:
+        def hook(self):
+            calls.append(id(self))
+
+    h = PreemptionHandler()
+    dead, kept = Owner(), Owner()
+    h.add_callback(dead.hook)
+    h.add_callback(kept.hook)
+    wr = weakref.ref(dead)
+    del dead
+    gc.collect()
+    assert wr() is None               # the handler does not pin it
+    h.simulate()
+    assert calls == [id(kept)]        # dead hook skipped, live one fired
+
+
+def test_preemption_callbacks_accept_c_bound_methods():
+    """Bound methods WeakMethod cannot hold (C-implemented methods like
+    Lock.release) fall back to a strong reference instead of raising at
+    registration."""
+    import threading
+
+    h = PreemptionHandler()
+    lock = threading.Lock()
+    lock.acquire()
+    h.add_callback(lock.release)      # builtin bound method
+    h.simulate()
+    assert not lock.locked()          # it fired
+
+
 def test_preemption_check_honors_fault_plan():
     with PreemptionHandler() as h:
         with faults.inject(preempt_at_step=2):

@@ -1,0 +1,445 @@
+"""The serving engine's contracts, pinned.
+
+1. **Steady-state compile contract** — >= 16 ragged, staggered,
+   partially-cancelled requests through the engine compile EXACTLY two
+   programs (prefill, decode): zero retraces, on both MPMD- and
+   SPMD-derived params.
+2. **Exactness** — greedy tokens streamed through the pooled engine
+   equal :func:`generation.generate` run per-request on the same
+   params, including requests that were queued, drained to a resilience
+   checkpoint, and resumed in a fresh engine.
+3. **Continuous batching wins** — on a ragged workload the
+   iteration-level scheduler beats the static run-to-longest baseline
+   (same compiled programs, ``wave_admission=True``) in tokens/step and
+   occupancy, and the metrics snapshot is consistent with the request
+   log.
+4. **Slot recycling is clean** — int8 (QuantKVCache) pools: alloc ->
+   decode -> free -> realloc the same slot produces BITWISE the output
+   a fresh pool produces (stale rows/scales are dead by masking).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu.layers import sequential_init
+from torchgpipe_tpu.models.generation import generate
+from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+from torchgpipe_tpu.serving import Engine
+
+CFG = TransformerConfig(
+    vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+)
+
+
+@pytest.fixture(scope="module")
+def flat_params():
+    params, _, _ = sequential_init(
+        llama(CFG), jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((2, 8), jnp.int32),
+    )
+    return params
+
+
+def _ref(params, prompt, new, max_len=32, **kw):
+    return np.asarray(
+        generate(CFG, params, jnp.asarray(prompt)[None, :], new,
+                 max_len=max_len, **kw)
+    )[0]
+
+
+def _workload(seed, n, vocab=64, plen_hi=10, new_hi=8):
+    rng = np.random.RandomState(seed)
+    return [
+        (rng.randint(0, vocab, (int(rng.randint(2, plen_hi)),))
+         .astype(np.int32),
+         int(rng.randint(2, new_hi)))
+        for _ in range(n)
+    ]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+# --------------------------------------------------------------------- #
+# 1. steady-state compile contract                                      #
+# --------------------------------------------------------------------- #
+
+
+def _mpmd_flat():
+    from torchgpipe_tpu import GPipe
+    from torchgpipe_tpu.models.generation import mpmd_params_for_generation
+
+    model = GPipe(llama(CFG), balance=[2, 2], chunks=2)
+    params, _ = model.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((2, 8), jnp.int32)
+    )
+    return mpmd_params_for_generation(model, params)
+
+
+def _spmd_flat():
+    from torchgpipe_tpu.models.generation import spmd_params_for_generation
+    from torchgpipe_tpu.models.transformer import cross_entropy, llama_spmd
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    block, pre, post = llama_spmd(CFG, 2)
+    mesh = make_mesh(2, 1, devices=jax.devices()[:2])
+    pipe = SpmdGPipe(
+        block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+        pre=pre, post=post,
+    )
+    params = pipe.place(
+        pipe.init(jax.random.PRNGKey(0),
+                  jax.ShapeDtypeStruct((4, 8), jnp.int32))
+    )
+    return spmd_params_for_generation(pipe, params)
+
+
+@pytest.mark.parametrize("derive", ["mpmd", "spmd"])
+def test_two_compiled_programs_zero_retraces(derive):
+    """16+ ragged, staggered requests with mid-flight cancellations:
+    exactly one trace per program, outputs exact vs generate — the SAME
+    trained pipeline params serve both engines."""
+    params = _mpmd_flat() if derive == "mpmd" else _spmd_flat()
+    reqs = _workload(seed=0, n=16)
+    eng = Engine(CFG, params, num_slots=4, max_len=32, prefill_chunk=4)
+    rids = []
+    cancelled = set()
+    for i, (prompt, new) in enumerate(reqs):
+        rid = eng.submit(prompt, new)
+        rids.append(rid)
+        if i in (5, 11):  # cancel while queued/just admitted
+            assert eng.cancel(rid)
+            cancelled.add(rid)
+            continue
+        eng.step()        # staggered arrivals: serve between submits
+        eng.step()
+    eng.run()
+
+    assert eng.compile_stats == {"prefill": 1, "decode": 1}, (
+        eng.compile_stats
+    )
+    for rid, (prompt, new) in zip(rids, reqs):
+        if rid in cancelled:
+            assert eng.status(rid) == "cancelled"
+            continue
+        got = eng.result(rid)
+        assert len(got) == new
+        assert got.tolist() == _ref(params, prompt, new).tolist()[:new], rid
+
+
+# --------------------------------------------------------------------- #
+# 2. continuous vs static + metrics consistency                         #
+# --------------------------------------------------------------------- #
+
+
+def test_continuous_beats_static_and_metrics_consistent(flat_params):
+    """Ragged/staggered mix: iteration-level recycling finishes the same
+    workload in fewer engine steps at higher occupancy than the static
+    run-to-longest baseline; the snapshot agrees with the request log."""
+    rng = np.random.RandomState(3)
+    reqs = [
+        (rng.randint(0, 64, (int(rng.randint(3, 7)),)).astype(np.int32),
+         [24, 2, 3, 20, 2, 4, 18, 3, 2, 16, 3, 2][i])
+        for i in range(12)
+    ]
+
+    def run(wave):
+        clock = FakeClock()
+        eng = Engine(
+            CFG, flat_params, num_slots=4, max_len=32, prefill_chunk=4,
+            wave_admission=wave, clock=clock,
+        )
+        rids = [eng.submit(p, n) for p, n in reqs]
+        eng.run()
+        return eng, rids
+
+    cont, rids = run(False)
+    stat, _ = run(True)
+    cs, ss = cont.metrics.snapshot(), stat.metrics.snapshot()
+    assert cs["tokens_out"] == ss["tokens_out"] == sum(n for _, n in reqs)
+    assert cs["engine_steps"] < ss["engine_steps"], (cs, ss)
+    assert cs["tokens_per_step"] > ss["tokens_per_step"], (cs, ss)
+    assert cs["occupancy"] > ss["occupancy"], (cs, ss)
+
+    # snapshot <-> request log consistency
+    by_rid = {r["rid"]: r for r in cs["requests"]}
+    for rid, (prompt, new) in zip(rids, reqs):
+        row = by_rid[rid]
+        assert row["status"] == "finished"
+        assert row["tokens"] == len(cont.result(rid)) == new
+        assert row["queue_wait"] is not None and row["queue_wait"] >= 0
+        assert row["ttft"] is not None and row["ttft"] >= row["queue_wait"]
+        if new > 1:
+            assert row["tpot"] is not None and row["tpot"] > 0
+    assert cs["engine_steps"] == cs["prefill_steps"] + cs["decode_steps"]
+    assert 0.0 < cs["occupancy"] <= 1.0
+
+
+# --------------------------------------------------------------------- #
+# 3. drain / resume through a resilience checkpoint                     #
+# --------------------------------------------------------------------- #
+
+
+def test_drain_resume_exact(flat_params, tmp_path):
+    """Preemption mid-burst: the engine drains through the resilience
+    hook, unfinished requests checkpoint, and a fresh engine resumes
+    each stream to EXACTLY the never-preempted output."""
+    from torchgpipe_tpu.resilience.checkpoint import CheckpointManager
+    from torchgpipe_tpu.resilience.preemption import PreemptionHandler
+
+    mgr = CheckpointManager(str(tmp_path))
+    handler = PreemptionHandler()         # not installed: simulate() only
+    reqs = _workload(seed=1, n=6, new_hi=9)
+    eng = Engine(
+        CFG, flat_params, num_slots=2, max_len=48, prefill_chunk=4,
+        preemption=handler, checkpoint_manager=mgr,
+    )
+    rids = [eng.submit(p, n) for p, n in reqs]
+    for _ in range(7):
+        eng.step()
+    handler.simulate()        # SIGTERM stand-in -> add_callback drain hook
+    assert eng.run() == "preempted"
+    snap = eng.metrics.snapshot()
+    assert snap["drains"] == 1 and snap["preempted_requests"] > 0
+
+    eng2 = Engine(CFG, flat_params, num_slots=2, max_len=48,
+                  prefill_chunk=4)
+    restored = Engine.restore_requests(mgr)
+    assert restored, "drain checkpointed nothing"
+    for kw in restored:
+        eng2.submit(kw.pop("prompt"), kw.pop("max_new_tokens"), **kw)
+    eng2.run()
+    for rid, (prompt, new) in zip(rids, reqs):
+        got = (
+            eng2.result(rid) if rid in eng2._requests else eng.result(rid)
+        )
+        assert got.tolist() == _ref(
+            flat_params, prompt, new, max_len=48
+        ).tolist(), rid
+
+
+# --------------------------------------------------------------------- #
+# 4. slot recycling: int8 pools stay bitwise clean                      #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_slot_reuse_bitwise_clean(flat_params, kv_quant):
+    """alloc -> decode -> free -> realloc THE SAME slots: outputs equal a
+    fresh pool bitwise (stale int8 rows AND stale scales are dead by
+    masking), with ragged prompts prefilled into non-contiguous slots."""
+    first = _workload(seed=2, n=4)
+    second = _workload(seed=7, n=4)
+
+    def serve(eng, reqs):
+        rids = [eng.submit(p, n) for p, n in reqs]
+        eng.run()
+        return [eng.result(r).tolist() for r in rids]
+
+    # dirty pool: serve a first burst (every slot written), then reuse
+    dirty = Engine(CFG, flat_params, num_slots=4, max_len=32,
+                   prefill_chunk=4, kv_quant=kv_quant)
+    serve(dirty, first)
+    assert dirty.pool.num_free == 4          # all slots recycled
+    # non-contiguous occupancy: park a long request in one slot so the
+    # second burst prefills around it
+    hold_prompt = first[0][0][:3]
+    hold = dirty.submit(hold_prompt, 20)
+    for _ in range(4):
+        dirty.step()                          # it grabs one slot
+    got_dirty = serve(dirty, second)
+    dirty.cancel(hold)
+
+    fresh = Engine(CFG, flat_params, num_slots=4, max_len=32,
+                   prefill_chunk=4, kv_quant=kv_quant)
+    fresh.submit(hold_prompt, 20)
+    for _ in range(4):
+        fresh.step()
+    got_fresh = serve(fresh, second)
+
+    assert got_dirty == got_fresh            # bitwise: same ints out
+    for (p, n), toks in zip(second, got_dirty):
+        assert toks == _ref(
+            flat_params, p, n, kv_quant=kv_quant
+        ).tolist()[:len(toks)]
+
+
+# --------------------------------------------------------------------- #
+# admission control / accounting                                        #
+# --------------------------------------------------------------------- #
+
+
+def test_admission_budget_caps_active_slots(flat_params):
+    """The eval_shape pool accounting caps slots under an HBM budget:
+    bytes are linear in slots, non-donated steps account the pool TWICE
+    (input + output buffers live across a step), and the engine clamps
+    the ALLOCATED pool — not just active requests — to the cap."""
+    from torchgpipe_tpu.tune import (
+        serving_cache_bytes, serving_max_slots, tree_bytes,
+    )
+
+    one = serving_cache_bytes(CFG, 1, 32)
+    per_slot = serving_cache_bytes(CFG, 2, 32) - one
+    # strictly linear in slots (the shared length scalar aside)
+    assert serving_cache_bytes(CFG, 4, 32) - serving_cache_bytes(
+        CFG, 3, 32
+    ) == per_slot
+    pbytes = tree_bytes(flat_params)
+    # exactly 2 slots double-buffered: 2*(fixed + 2*per_slot) + change
+    budget = pbytes + 2 * (one + per_slot) + per_slot  # 2.5 slots' worth
+    assert serving_max_slots(
+        CFG, 32, budget, param_bytes=pbytes
+    ) == 2
+    # donated steps alias in place: the same budget fits ~2x the slots
+    assert serving_max_slots(
+        CFG, 32, budget, param_bytes=pbytes, donated=True
+    ) >= 4
+
+    eng = Engine(CFG, flat_params, num_slots=4, max_len=32,
+                 prefill_chunk=4, hbm_budget_bytes=budget)
+    assert eng.scheduler.max_active == 2
+    assert eng.pool.num_slots == 2    # allocation clamped, not just use
+    for p, n in _workload(seed=4, n=6):
+        eng.submit(p, n)
+    peak = 0
+    while not eng.scheduler.idle:
+        if not eng.step():
+            break
+        peak = max(peak, eng.pool.num_active)
+    assert peak == 2                  # capped below requested num_slots=4
+
+    with pytest.raises(ValueError, match="admission cap is 0"):
+        Engine(CFG, flat_params, num_slots=4, max_len=32,
+               hbm_budget_bytes=1)
+
+
+def test_dispatch_retries_transient_errors(flat_params):
+    """A transient failure in a compiled step is retried INSIDE the
+    engine (bounded backoff, counted in metrics) and the request still
+    decodes exactly; the step's results are materialized under the
+    retry guard, so an async execution failure cannot escape to the
+    host fetch after the cache was committed."""
+    sleeps = []
+    eng = Engine(CFG, flat_params, num_slots=2, max_len=32,
+                 prefill_chunk=4, sleep=sleeps.append)
+    real = eng._decode_fn
+    state = {"raised": False}
+
+    def flaky(*args):
+        if not state["raised"]:
+            state["raised"] = True
+            raise ConnectionError("transient blip")
+        return real(*args)
+
+    eng._decode_fn = flaky
+    p, n = _workload(seed=9, n=1)[0]
+    rid = eng.submit(p, n)
+    eng.run()
+    assert state["raised"] and sleeps
+    assert eng.metrics.snapshot()["retries"] == 1
+    assert eng.result(rid).tolist() == _ref(flat_params, p, n).tolist()
+
+
+def test_submit_rejects_oversized_request(flat_params):
+    eng = Engine(CFG, flat_params, num_slots=2, max_len=16)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(np.arange(10, dtype=np.int32), 10)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit(np.zeros((0,), np.int32), 4)
+
+
+# --------------------------------------------------------------------- #
+# static lint                                                           #
+# --------------------------------------------------------------------- #
+
+
+def test_lint_serving_clean(flat_params):
+    """The serve-verify gate's API: both step programs trace, no host
+    callbacks, one signature each over the churn grid; an inadmissible
+    request is an INFO rejection, not a hazard."""
+    from torchgpipe_tpu.analysis import lint_serving
+    from torchgpipe_tpu.analysis.diagnostics import Severity
+
+    eng = Engine(CFG, flat_params, num_slots=3, max_len=24,
+                 prefill_chunk=4)
+    findings = lint_serving(eng, grid=[(2, 4), (9, 8), (1, 1), (30, 30)])
+    worst = [f for f in findings if f.severity >= Severity.WARNING]
+    assert not worst, [f.format() for f in findings]
+    infos = [f for f in findings if f.rule == "serving-admission"]
+    assert len(infos) == 1                    # (30, 30) > max_len=24
+
+
+def test_lint_serving_catches_request_sized_buffer(flat_params):
+    """Non-vacuity: the churn check drives the REAL buffer-construction
+    path, so an engine that sizes its prefill buffer from the request
+    (the recompile-per-request bug class) is an ERROR, and a busy engine
+    refuses to lint."""
+    import numpy as np
+
+    from torchgpipe_tpu.analysis import lint_serving
+    from torchgpipe_tpu.analysis.diagnostics import Severity
+
+    eng = Engine(CFG, flat_params, num_slots=3, max_len=24,
+                 prefill_chunk=4)
+    orig = eng._token_buffer
+
+    def request_sized(kind):
+        if kind == "prefill":   # the bug: width = this batch's max take
+            take = max(
+                min(eng.prefill_chunk, r.prompt_len - r.prefilled)
+                for r in eng.scheduler.prefill_pending()
+            )
+            return np.zeros((eng.pool.num_slots, take), np.int32)
+        return orig(kind)
+
+    eng._token_buffer = request_sized
+    findings = lint_serving(eng, grid=[(2, 4), (9, 8)])
+    errors = [f for f in findings if f.rule == "recompilation-hazard"]
+    assert errors and all(f.severity == Severity.ERROR for f in errors)
+
+    busy = Engine(CFG, flat_params, num_slots=2, max_len=24)
+    busy.submit(np.arange(4, dtype=np.int32), 4)
+    with pytest.raises(ValueError, match="idle"):
+        lint_serving(busy)
+
+
+# --------------------------------------------------------------------- #
+# soak (slow tier)                                                      #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+def test_serving_soak_churn(flat_params):
+    """Long random churn — submits, cancels, staggered steps — stays at
+    two programs and exact outputs throughout."""
+    rng = np.random.RandomState(11)
+    eng = Engine(CFG, flat_params, num_slots=4, max_len=32,
+                 prefill_chunk=4)
+    live, done = {}, {}
+    for i in range(40):
+        prompt = rng.randint(0, 64, (int(rng.randint(2, 12)),)).astype(
+            np.int32
+        )
+        new = int(rng.randint(1, 9))
+        rid = eng.submit(prompt, new)
+        live[rid] = (prompt, new)
+        if rng.rand() < 0.15 and live:
+            victim = list(live)[int(rng.randint(len(live)))]
+            if eng.cancel(victim):
+                live.pop(victim)
+        for _ in range(int(rng.randint(0, 4))):
+            eng.step()
+    eng.run()
+    assert eng.compile_stats == {"prefill": 1, "decode": 1}
+    for rid, (prompt, new) in live.items():
+        got = eng.result(rid)
+        assert got.tolist() == _ref(flat_params, prompt, new).tolist(), rid

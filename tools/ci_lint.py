@@ -22,10 +22,15 @@ differently and must not share backend state):
    ``donation-safety``, ``memory-certification``,
    ``engine-equivalence``), so each example's configured scheduler is
    verified per model too (the structural invariants of
-   docs/analysis.md; any ERROR fails the gate).
+   docs/analysis.md; any ERROR fails the gate);
+4. ``torchgpipe_tpu.analysis.serving`` (serve-verify) — the serving
+   engine's steady-state compile contract: both compiled step programs
+   (fp and int8-kv pools) trace abstractly, carry no host callbacks,
+   and stay at ONE signature each over a shape-churn request grid
+   (``recompilation-hazard`` must be clean; docs/serving.md).
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
-to run a subset, ``-v`` for per-target lint reports.
+/ ``--skip-serving`` to run a subset, ``-v`` for per-target reports.
 """
 
 from __future__ import annotations
@@ -54,6 +59,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-typegate", action="store_true")
     ap.add_argument("--skip-schedule", action="store_true")
     ap.add_argument("--skip-pipeline", action="store_true")
+    ap.add_argument("--skip-serving", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -88,6 +94,16 @@ def main(argv: Sequence[str] | None = None) -> int:
         if args.verbose:
             cmd.append("-v")
         failures += _run("pipeline_lint", cmd) != 0
+    if not args.skip_serving:
+        # -c instead of -m for the same runpy-reimport reason as above.
+        cmd = [
+            sys.executable, "-c",
+            "import sys; from torchgpipe_tpu.analysis import serving; "
+            "sys.exit(serving.main(sys.argv[1:]))",
+        ]
+        if args.verbose:
+            cmd.append("-v")
+        failures += _run("serve-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

@@ -51,7 +51,9 @@ from torchgpipe_tpu.analysis.trace import (
     trace_spmd,
 )
 from torchgpipe_tpu.analysis import events, schedule
+from torchgpipe_tpu.analysis import serving as serving_lint
 from torchgpipe_tpu.analysis.events import EventGraph, events_for
+from torchgpipe_tpu.analysis.serving import lint_serving
 from torchgpipe_tpu.analysis.schedule import (
     certify_memory,
     verify_buffers,
@@ -78,6 +80,8 @@ __all__ = [
     "apply_suppressions",
     "format_findings",
     "lint",
+    "lint_serving",
+    "serving_lint",
     "max_severity",
     "register_rule",
     "run_rules",

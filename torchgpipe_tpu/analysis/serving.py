@@ -1,0 +1,259 @@
+"""Static verification of the serving engine's steady-state contract.
+
+The serving engine promises exactly TWO compiled programs under
+arbitrary request churn (``docs/serving.md``).  The dynamic half of the
+proof is the compile-counter test in ``tests/test_serving.py``; this
+module is the STATIC half, the serving twin of ``tools/pipeline_lint``:
+
+* **recompilation-hazard** — drive a request-churn grid (ragged prompt
+  lengths, token budgets, arrival patterns) through the engine's OWN
+  input-spec helper (:meth:`~torchgpipe_tpu.serving.engine.Engine.
+  step_input_specs` — the same shapes the real step buffers are built
+  from) and certify every admissible request maps onto ONE prefill and
+  ONE decode signature.  A request the pool cannot hold must be
+  statically REJECTED at submit (a shape-growing admission is exactly
+  how a serving engine starts recompiling per request).
+* **trace check** — abstractly trace both step programs
+  (``jax.make_jaxpr`` over the specs; no device compute, no XLA
+  compile) so a model/config combination that cannot build its serving
+  programs fails the gate in seconds, not at first request.
+* **host-sync-in-step** — walk the traced jaxprs for host-callback
+  primitives: a callback inside a compiled serving step would serialize
+  every iteration on the host (the serving twin of the pipeline
+  linter's ``host-sync-in-loop`` rule).
+
+CLI (the ``serve-verify`` step of ``tools/ci_lint.py``)::
+
+    python -m torchgpipe_tpu.analysis.serving      # builds a tiny CPU
+                                                   # engine, lints it
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchgpipe_tpu.analysis import jaxpr as jx
+from torchgpipe_tpu.analysis.diagnostics import Finding, Severity
+
+# (prompt_len, max_new_tokens) churn grid the default lint drives — the
+# ragged/staggered mix the dynamic compile-counter test uses, plus the
+# boundary cases (1-token prompt, budget-filling request).
+DEFAULT_GRID: Tuple[Tuple[int, int], ...] = (
+    (1, 1), (1, 8), (3, 5), (4, 2), (5, 16), (7, 3), (8, 8), (9, 1),
+    (2, 30), (16, 16), (31, 1), (40, 40),
+)
+
+
+def _signature(tree: Any) -> Tuple:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return tuple((tuple(a.shape), str(a.dtype)) for a in leaves)
+
+
+def _drive_signatures(
+    engine: Any, plen: int, mnew: int, tag: str,
+) -> Dict[str, Set[Tuple]]:
+    """Serve ONE request through the engine's real submit/schedule/
+    buffer-construction machinery with the compiled programs stubbed
+    out (zero device compute), capturing the argument signature of
+    every would-be dispatch.  This is what makes the churn check
+    non-vacuous: an engine that sized a step buffer from the request
+    shows up here, not in production."""
+    sigs: Dict[str, Set[Tuple]] = {"prefill": set(), "decode": set()}
+    S = engine.pool.num_slots
+
+    def stub(kind):
+        def fn(params, cache, lengths, tokens, n_valid, key):
+            sigs[kind].add(_signature({
+                "cache": cache, "lengths": lengths, "tokens": tokens,
+                "n_valid": n_valid, "key": key,
+            }))
+            # Token 0 for every slot: requests terminate by budget.
+            return jnp.zeros((S,), jnp.int32), cache, key
+        return fn
+
+    real = engine._prefill_fn, engine._decode_fn
+    engine._prefill_fn, engine._decode_fn = stub("prefill"), stub("decode")
+    try:
+        engine.submit(np.zeros((plen,), np.int32), mnew, rid=tag)
+        engine.run()
+    finally:
+        engine._prefill_fn, engine._decode_fn = real
+    return sigs
+
+
+def lint_serving(
+    engine: Any,
+    grid: Optional[Sequence[Tuple[int, int]]] = None,
+) -> List[Finding]:
+    """Lint a built :class:`~torchgpipe_tpu.serving.engine.Engine`.
+
+    Returns findings sorted most-severe-first; empty means the engine's
+    steady-state compile contract holds statically over ``grid`` (a
+    sequence of ``(prompt_len, max_new_tokens)`` request shapes;
+    default: :data:`DEFAULT_GRID`).  Requests the engine statically
+    rejects (they cannot fit a slot) are fine — INFO findings record
+    them; a request that would be ADMITTED with a signature outside the
+    two steady-state programs is the ERROR this lint exists to catch.
+
+    Lint an IDLE, dedicated engine: admissible grid requests are served
+    through the engine's real scheduling/buffer machinery with the
+    compiled programs stubbed out (no device compute, but the probe
+    requests do land in the engine's request log and metrics, under
+    ``lint-*`` rids).
+    """
+    findings: List[Finding] = []
+    grid = list(grid if grid is not None else DEFAULT_GRID)
+    if not engine.scheduler.idle or getattr(engine, "_draining", False):
+        raise ValueError(
+            "lint_serving drives the engine with stubbed programs — "
+            "lint an idle (and undrained) engine, not one serving "
+            "real requests"
+        )
+
+    # 1. the two steady-state signatures, from the engine's own helper
+    base = engine.step_input_specs()
+    base_sig = {kind: _signature(spec) for kind, spec in base.items()}
+    if base_sig["prefill"] == base_sig["decode"]:
+        findings.append(Finding(
+            rule="serving-program-split",
+            severity=Severity.WARNING,
+            path="serving/engine",
+            message=(
+                "prefill and decode steps share one signature "
+                f"(prefill_chunk={engine.prefill_chunk} == 1?) — legal "
+                "but prompts then absorb one token per iteration"
+            ),
+        ))
+
+    # 2. churn grid: serve every admissible request through the real
+    # submit/schedule/buffer path (programs stubbed, no device compute)
+    # and require every captured dispatch to hit the two signatures.
+    max_len = engine.pool.max_len
+    for i, (plen, mnew) in enumerate(grid):
+        if plen < 1 or mnew < 1 or plen + mnew > max_len:
+            findings.append(Finding(
+                rule="serving-admission",
+                severity=Severity.INFO,
+                path="serving/scheduler",
+                message=(
+                    f"request (prompt={plen}, new={mnew}) is statically "
+                    f"rejected (pool max_len={max_len}) — shapes stay "
+                    "fixed because admission refuses what cannot fit"
+                ),
+            ))
+            continue
+        churn = _drive_signatures(
+            engine, plen, mnew,
+            # request-log length makes the rid unique across repeated
+            # lint calls on one engine
+            tag=f"lint-{len(engine._requests)}-{plen}-{mnew}",
+        )
+        for kind in ("prefill", "decode"):
+            for sig in churn[kind]:
+                if sig != base_sig[kind]:
+                    findings.append(Finding(
+                        rule="recompilation-hazard",
+                        severity=Severity.ERROR,
+                        path=f"serving/{kind}",
+                        message=(
+                            f"request (prompt={plen}, new={mnew}) "
+                            f"dispatches the {kind} step with a "
+                            "signature outside the steady-state pair — "
+                            "every such request compiles a new program; "
+                            "the engine must pad into its fixed "
+                            "(num_slots, prefill_chunk) buffers instead"
+                        ),
+                    ))
+
+    # 3. abstract-trace both programs; walk for host callbacks
+    for kind, fn in (("prefill", engine._prefill_fn),
+                     ("decode", engine._decode_fn)):
+        spec = base[kind]
+        try:
+            traced = jax.make_jaxpr(
+                lambda c, l, t, n, k, _fn=fn: _fn(
+                    engine.params, c, l, t, n, k
+                )
+            )(spec["cache"], spec["lengths"], spec["tokens"],
+              spec["n_valid"], spec["key"])
+        except Exception as exc:  # noqa: BLE001 — converted to a finding
+            findings.append(Finding(
+                rule="serving-trace",
+                severity=Severity.ERROR,
+                path=f"serving/{kind}",
+                message=f"step does not trace abstractly: {exc}",
+            ))
+            continue
+        for site in jx.walk_eqns(traced.jaxpr):
+            name = site.eqn.primitive.name
+            if name in jx.HOST_CALLBACK_PRIMS:
+                findings.append(Finding(
+                    rule="host-sync-in-step",
+                    severity=Severity.ERROR,
+                    path=f"serving/{kind}",
+                    eqn=site.index,
+                    primitive=name,
+                    message=(
+                        "host callback inside a compiled serving step — "
+                        "every iteration would synchronize with the "
+                        "host; move the side effect to the engine loop"
+                    ),
+                ))
+    findings.sort(key=lambda f: (-int(f.severity), f.path, f.rule))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI self-check: build a tiny CPU engine over both param layouts'
+    flat schema and lint it over the default churn grid plus a
+    shape-churny stress grid.  Exit 0 iff no finding reaches WARNING."""
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if os.environ.get("TGPU_LINT_ON_BACKEND") != "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from torchgpipe_tpu.layers import sequential_init
+    from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+    from torchgpipe_tpu.serving import Engine
+
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+    )
+    params, _, _ = sequential_init(
+        llama(cfg), jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, 8), jnp.int32),
+    )
+    worst = 0
+    for kv_quant in (False, True):
+        eng = Engine(
+            cfg, params, num_slots=4, max_len=48, prefill_chunk=4,
+            kv_quant=kv_quant,
+        )
+        findings = lint_serving(eng)
+        tag = "int8-kv" if kv_quant else "fp"
+        errors = [f for f in findings if f.severity >= Severity.WARNING]
+        worst = max(worst, len(errors))
+        if args.verbose or errors:
+            for f in findings:
+                print(f.format())
+        print(f"[serving-lint] {tag}: {len(findings)} finding(s), "
+              f"{len(errors)} at warning+")
+    return 1 if worst else 0
+
+
+__all__ = ["DEFAULT_GRID", "lint_serving", "main"]
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,6 +21,7 @@ from torchgpipe_tpu.models.generation import (  # noqa: F401
     init_quant_cache,
     mpmd_params_for_generation,
     prefill,
+    row_frontiers,
     SpecStats,
     speculative_generate,
     spmd_params_for_generation,

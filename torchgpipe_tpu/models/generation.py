@@ -150,17 +150,21 @@ def _embed(cfg: TransformerConfig, embed_p: Pytree,
     tied head reads the UNSCALED table, so the scale lives here, not in
     the table) — mirrors token_embedding.apply.  A learned position
     table (GPT-2 class, ``embed_p['pos']``) adds rows at ``pos0 +
-    arange(s)`` — decode callers pass ``cache.length``."""
+    arange(s)`` — decode callers pass ``cache.length``; a ``[b]``-shaped
+    ``pos0`` gives every row its own base position (the slot-pooled
+    serving decode)."""
     x = jnp.take(embed_p["table"], tokens, axis=0)
     if cfg.embed_scale is not None:
         x = x * jnp.asarray(cfg.embed_scale, x.dtype)
     if "pos" in embed_p:
         s = tokens.shape[-1]
-        x = x + jnp.take(
-            embed_p["pos"],
-            cfg.pos_emb_offset + pos0 + jnp.arange(s),
-            axis=0,
-        ).astype(x.dtype)
+        p0 = jnp.asarray(pos0)
+        idx = (
+            cfg.pos_emb_offset + p0[:, None] + jnp.arange(s)[None, :]
+            if p0.ndim == 1
+            else cfg.pos_emb_offset + p0 + jnp.arange(s)
+        )
+        x = x + jnp.take(embed_p["pos"], idx, axis=0).astype(x.dtype)
     return x
 
 
@@ -214,6 +218,67 @@ def _attend_ring(
     return out.reshape(b, 1, nh * hd)
 
 
+def _block_qkv(
+    cfg: TransformerConfig,
+    p: Pytree,
+    x: jnp.ndarray,              # [b, g, dim]
+    pos: jnp.ndarray,            # [] int32 first-query position, or [b] per row
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared per-block decode prologue: ln1, q/k/v projections (+LoRA
+    deltas, +Qwen2 biases), head reshape, Qwen3 per-head q/k RMSNorm,
+    rope at ``pos``.  ONE body for the single-token, chunked, and
+    slot-masked decode paths — a model-family quirk added here reaches
+    all three at once; only cache-write indexing and the attend stay
+    with each caller."""
+    b, g, _ = x.shape
+    hd = cfg.head_dim
+    wq, wk, wv = _w(cfg, p, "wq"), _w(cfg, p, "wk"), _w(cfg, p, "wv")
+    nh_loc = wq.shape[1] // hd
+    nkv_loc = wk.shape[1] // hd
+    h = _block_norm(cfg, p, "ln1", x)
+    q, k, v = h @ wq, h @ wk, h @ wv
+    if "lora" in p:
+        lo = p["lora"]
+        q = q + _lora_delta(cfg, lo, h, "qa", "qb")
+        k = k + _lora_delta(cfg, lo, h, "ka", "kb")
+        v = v + _lora_delta(cfg, lo, h, "va", "vb")
+    if "bq" in p:  # Qwen2-style projection biases
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    q = q.reshape(b, g, nh_loc, hd)
+    k = k.reshape(b, g, nkv_loc, hd)
+    v = v.reshape(b, g, nkv_loc, hd)
+    if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
+        q = _rms(q, p["qn"], cfg.norm_eps)
+        k = _rms(k, p["kn"], cfg.norm_eps)
+    q = _maybe_rope(cfg, q, pos)
+    k = _maybe_rope(cfg, k, pos)
+    return q, k, v
+
+
+def _block_attn_out(
+    cfg: TransformerConfig,
+    p: Pytree,
+    x: jnp.ndarray,              # [b, g, dim] — block input (residual stream)
+    attn: jnp.ndarray,           # [b, g, nh*hd] — attention output
+    mlp_layer: Optional[Any],
+) -> jnp.ndarray:
+    """Shared per-block decode epilogue: wo projection (+LoRA, +bias),
+    attention residual, ln2 (parallel or sequential residual), MLP
+    residual.  Counterpart of :func:`_block_qkv`."""
+    attn = attn.astype(x.dtype)
+    o = attn @ _w(cfg, p, "wo")
+    if "lora" in p:
+        o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
+    if "bo" in p:
+        o = o + p["bo"]
+    x_in = x
+    x = x + o
+    h = _block_norm(
+        cfg, p, "ln2", x_in if cfg.parallel_residual else x
+    )
+    return x + _mlp_out(cfg, p, h, mlp_layer)
+
+
 def _decode_step(
     cfg: TransformerConfig,
     block_params: List[Pytree],
@@ -241,8 +306,6 @@ def _decode_step(
     specialization lives here."""
     if not ring:
         return _decode_chunk(cfg, block_params, x, cache, mlp_layer)
-    b = x.shape[0]
-    hd = cfg.head_dim
     pos = cache.length
     quant = isinstance(cache, QuantKVCache)
     new_k, new_v = [], []
@@ -255,26 +318,7 @@ def _decode_step(
     for p, ck, cv, (cks, cvs) in zip(
         block_params, cache.k, cache.v, scales
     ):
-        wq, wk, wv = _w(cfg, p, "wq"), _w(cfg, p, "wk"), _w(cfg, p, "wv")
-        nh_loc = wq.shape[1] // hd
-        nkv_loc = wk.shape[1] // hd
-        h = _block_norm(cfg, p, "ln1", x)
-        q, k, v = h @ wq, h @ wk, h @ wv
-        if "lora" in p:
-            lo = p["lora"]
-            q = q + _lora_delta(cfg, lo, h, "qa", "qb")
-            k = k + _lora_delta(cfg, lo, h, "ka", "kb")
-            v = v + _lora_delta(cfg, lo, h, "va", "vb")
-        if "bq" in p:  # Qwen2-style projection biases
-            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
-        q = q.reshape(b, 1, nh_loc, hd)
-        k = k.reshape(b, 1, nkv_loc, hd)
-        v = v.reshape(b, 1, nkv_loc, hd)
-        if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
-            q = _rms(q, p["qn"], cfg.norm_eps)
-            k = _rms(k, p["kn"], cfg.norm_eps)
-        q = _maybe_rope(cfg, q, pos)
-        k = _maybe_rope(cfg, k, pos)
+        q, k, v = _block_qkv(cfg, p, x, pos)
         slot = jnp.mod(pos, ck.shape[1])
         if quant:
             kq, ks = _quant_rows(k)
@@ -298,18 +342,8 @@ def _decode_step(
                 cv, v.astype(cv.dtype), slot, 1
             )
             rk, rv = ck, cv
-        attn = _attend_ring(q, rk, rv, pos).astype(x.dtype)
-        o = attn @ _w(cfg, p, "wo")
-        if "lora" in p:
-            o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
-        if "bo" in p:
-            o = o + p["bo"]
-        x_in = x
-        x = x + o
-        h = _block_norm(
-            cfg, p, "ln2", x_in if cfg.parallel_residual else x
-        )
-        x = x + _mlp_out(cfg, p, h, mlp_layer)
+        attn = _attend_ring(q, rk, rv, pos)
+        x = _block_attn_out(cfg, p, x, attn, mlp_layer)
         new_k.append(ck)
         new_v.append(cv)
     if quant:
@@ -324,7 +358,7 @@ def _attend_chunk(
     q: jnp.ndarray,          # [b, g, nh, hd] — rope'd queries, positions pos0..pos0+g-1
     ck: jnp.ndarray,         # [b, max_len, nkv, hd]
     cv: jnp.ndarray,
-    pos0: jnp.ndarray,       # [] int32 — first query's position
+    pos0: jnp.ndarray,       # [] int32 — first query's position ([b]: per row)
     window: Optional[int],
     use_flash: Optional[bool] = None,
     k_scale: Optional[jnp.ndarray] = None,  # int8 cache: f32 [b, nkv, L]
@@ -333,7 +367,11 @@ def _attend_chunk(
     """Causal attention of ``g`` consecutive queries against the cache —
     one MXU-friendly einsum instead of g masked cache reads.  Query i
     (position ``pos0+i``) sees cache rows ``<= pos0+i`` (optionally
-    banded); ``g=1`` is the plain single-token decode read.
+    banded); ``g=1`` is the plain single-token decode read.  A
+    ``[b]``-shaped ``pos0`` gives every row its OWN first-query position
+    — the serving pool's attention, where each slot sits at its own
+    sequence frontier (dense path only: the flash decode kernel takes
+    one scalar ``pos0``, so auto-dispatch stays dense per-row).
 
     ``use_flash=None`` auto-dispatches the Pallas decode kernel on TPU
     when the shapes are eligible (``ops.flash_attention.supports_decode``)
@@ -347,10 +385,15 @@ def _attend_chunk(
     block-wise in VMEM — HBM moves int8 bytes, the actual int8-KV
     bandwidth win; the dense path dequantizes up front."""
     on_tpu = jax.devices()[0].platform == "tpu"
+    per_row = jnp.asarray(pos0).ndim == 1
     if use_flash is None:
         from torchgpipe_tpu.ops.flash_attention import supports_decode
 
-        use_flash = on_tpu and supports_decode(q.shape, ck.shape, window)
+        use_flash = (
+            not per_row
+            and on_tpu
+            and supports_decode(q.shape, ck.shape, window)
+        )
     if use_flash:
         from torchgpipe_tpu.ops.flash_attention import (
             flash_decode_attention,
@@ -370,12 +413,18 @@ def _attend_chunk(
     scores = jnp.einsum(
         "bqgrd,bsgd->bgrqs", qg.astype(jnp.float32), ck.astype(jnp.float32)
     ) * (hd ** -0.5)
-    qpos = pos0 + jnp.arange(g)[:, None]          # [g, 1]
-    idx = jnp.arange(max_len)[None, :]            # [1, max_len]
-    valid = idx <= qpos
+    # [B', g, 1] query positions with B' = b (per-row pos0) or 1
+    # (shared scalar) — one mask either way; B'=1 broadcasts exactly as
+    # the scalar-only [1, 1, 1, g, L] mask did.
+    qpos = (
+        jnp.asarray(pos0).reshape(-1, 1, 1)
+        + jnp.arange(g)[None, :, None]
+    )
+    idx = jnp.arange(max_len)[None, None, :]      # [1, 1, max_len]
+    valid = idx <= qpos                           # [B', g, max_len]
     if window is not None:
         valid &= idx > qpos - window
-    scores = jnp.where(valid[None, None, None, :, :], scores, -jnp.inf)
+    scores = jnp.where(valid[:, None, None, :, :], scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bgrqs,bsgd->bqgrd", p, cv.astype(jnp.float32))
     return out.reshape(b, g, nh * hd)
@@ -396,8 +445,7 @@ def _decode_chunk(
     Plain and quantized caches; ring caches are not supported (the
     speculative path that needs chunks rolls positions back, which a
     ring's slot reuse cannot undo)."""
-    b, g, _ = x.shape
-    hd = cfg.head_dim
+    g = x.shape[1]
     pos0 = cache.length
     quant = isinstance(cache, QuantKVCache)
     new_k, new_v = [], []
@@ -410,26 +458,7 @@ def _decode_chunk(
     for p, ck, cv, (cks, cvs) in zip(
         block_params, cache.k, cache.v, scales
     ):
-        wq, wk, wv = _w(cfg, p, "wq"), _w(cfg, p, "wk"), _w(cfg, p, "wv")
-        nh_loc = wq.shape[1] // hd
-        nkv_loc = wk.shape[1] // hd
-        h = _block_norm(cfg, p, "ln1", x)
-        q, k, v = h @ wq, h @ wk, h @ wv
-        if "lora" in p:
-            lo = p["lora"]
-            q = q + _lora_delta(cfg, lo, h, "qa", "qb")
-            k = k + _lora_delta(cfg, lo, h, "ka", "kb")
-            v = v + _lora_delta(cfg, lo, h, "va", "vb")
-        if "bq" in p:  # Qwen2-style projection biases
-            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
-        q = q.reshape(b, g, nh_loc, hd)
-        k = k.reshape(b, g, nkv_loc, hd)
-        v = v.reshape(b, g, nkv_loc, hd)
-        if "qn" in p:  # Qwen3-style per-head q/k RMSNorm, pre-rope
-            q = _rms(q, p["qn"], cfg.norm_eps)
-            k = _rms(k, p["kn"], cfg.norm_eps)
-        q = _maybe_rope(cfg, q, pos0)
-        k = _maybe_rope(cfg, k, pos0)
+        q, k, v = _block_qkv(cfg, p, x, pos0)
         if quant:
             kq, ks = _quant_rows(k)
             vq, vs = _quant_rows(v)
@@ -456,18 +485,7 @@ def _decode_chunk(
         attn = _attend_chunk(
             q, ck, cv, pos0, cfg.attn_window, k_scale=cks, v_scale=cvs
         )
-        attn = attn.astype(x.dtype)
-        o = attn @ _w(cfg, p, "wo")
-        if "lora" in p:
-            o = o + _lora_delta(cfg, p["lora"], attn, "oa", "ob")
-        if "bo" in p:
-            o = o + p["bo"]
-        x_in = x
-        x = x + o
-        h = _block_norm(
-            cfg, p, "ln2", x_in if cfg.parallel_residual else x
-        )
-        x = x + _mlp_out(cfg, p, h, mlp_layer)
+        x = _block_attn_out(cfg, p, x, attn, mlp_layer)
         new_k.append(ck)
         new_v.append(cv)
     if quant:
@@ -476,6 +494,164 @@ def _decode_chunk(
             length=pos0 + g,
         )
     return x, KVCache(k=new_k, v=new_v, length=pos0 + g)
+
+
+def decode_slots(
+    cfg: TransformerConfig,
+    params: Pytree,
+    tokens: jnp.ndarray,         # [S, g] int32 — per-slot token chunks
+    cache: Any,                  # KVCache/QuantKVCache over S slots
+    lengths: jnp.ndarray,        # [S] int32 — per-slot sequence frontiers
+    n_valid: jnp.ndarray,        # [S] int32 — valid tokens this call (0 = no-op row)
+    moe: Optional[Any] = None,
+) -> Tuple[jnp.ndarray, Any, jnp.ndarray]:
+    """The SLOT-MASKED decode step: ``g`` tokens per slot through all
+    blocks, each slot at its OWN position ``lengths[i]``, with row
+    ``i``'s tokens ``j >= n_valid[i]`` masked no-ops (their K/V writes
+    are dropped, their outputs garbage that the caller never reads).
+    Returns ``(logits [S, g, vocab] f32, new cache, lengths + n_valid)``.
+
+    This is the one compiled body the serving engine's two programs
+    share (``torchgpipe_tpu.serving.engine``): chunked prefill IS this
+    step teacher-forcing prompt chunks (``g = prefill_chunk``), decode
+    IS this step at ``g = 1`` — request churn changes only the VALUES of
+    ``tokens``/``lengths``/``n_valid``, never a shape, so arbitrary
+    admission/eviction traffic reuses one program per entry point.
+
+    Mechanics (vs :func:`_decode_chunk`, which this generalizes):
+
+    * positions are a ``[S]`` vector — rope, the causal mask, and the
+      learned-position gather all take per-row offsets;
+    * cache writes are scatters at ``lengths[i] + j`` with out-of-range
+      indices for masked tokens (``mode='drop'``): a no-op row's cache
+      is bit-untouched, the property the slot-recycling tests pin;
+    * ``cache.length`` is IGNORED (per-slot frontiers live in
+      ``lengths``); the returned cache carries ``lengths + n_valid``
+      summed into its scalar only for schema compatibility.
+
+    Plain and quantized caches; ring caches are not supported (slots
+    recycle by masking, which a ring's position-aliased layout defeats).
+    """
+    embed_p, block_p, head_p = _split_params(cfg, params)
+    mlp_layer = _mlp_layer_for(cfg, moe)
+    S, g = tokens.shape
+    L = cache.k[0].shape[1]
+    quant = isinstance(cache, QuantKVCache)
+    x = _embed(cfg, embed_p, tokens, lengths)
+    j = jnp.arange(g)[None, :]                          # [1, g]
+    # Write positions: row i token j lands at lengths[i]+j when valid,
+    # at L (out of range -> dropped) when masked.
+    wpos = jnp.where(j < n_valid[:, None], lengths[:, None] + j, L)
+    rows = jnp.arange(S)[:, None]                       # [S, 1]
+    i0 = jnp.arange(S)[:, None, None]                   # [S, 1, 1]
+    new_k, new_v = [], []
+    new_ks, new_vs = [], []
+    scales = (
+        zip(cache.k_scale, cache.v_scale)
+        if quant
+        else ((None, None) for _ in cache.k)
+    )
+    for p, ck, cv, (cks, cvs) in zip(
+        block_p, cache.k, cache.v, scales
+    ):
+        q, k, v = _block_qkv(cfg, p, x, lengths)
+        if quant:
+            kq, ks = _quant_rows(k)
+            vq, vs = _quant_rows(v)
+            ck = ck.at[rows, wpos].set(kq, mode="drop")
+            cv = cv.at[rows, wpos].set(vq, mode="drop")
+            i1 = jnp.arange(ck.shape[2])[None, None, :]
+            i2 = wpos[:, :, None]
+            cks = cks.at[i0, i1, i2].set(ks, mode="drop")
+            cvs = cvs.at[i0, i1, i2].set(vs, mode="drop")
+            new_ks.append(cks)
+            new_vs.append(cvs)
+        else:
+            ck = ck.at[rows, wpos].set(k.astype(ck.dtype), mode="drop")
+            cv = cv.at[rows, wpos].set(v.astype(cv.dtype), mode="drop")
+        # Per-row pos0 forces the dense path (the flash decode kernel
+        # takes one scalar pos0), so a slot's read is the same f32
+        # einsum math as the single-request dense path.
+        attn = _attend_chunk(
+            q, ck, cv, lengths, cfg.attn_window, use_flash=False,
+            k_scale=cks if quant else None,
+            v_scale=cvs if quant else None,
+        )
+        x = _block_attn_out(cfg, p, x, attn, mlp_layer)
+        new_k.append(ck)
+        new_v.append(cv)
+    new_lengths = lengths + n_valid
+    length = jnp.sum(new_lengths).astype(jnp.int32)  # schema slot only
+    if quant:
+        out_cache: Any = QuantKVCache(
+            k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs, length=length
+        )
+    else:
+        out_cache = KVCache(k=new_k, v=new_v, length=length)
+    return _logits(cfg, head_p, x), out_cache, new_lengths
+
+
+def _mask_finished_rows(
+    new: Any, old: Any, alive: jnp.ndarray, pos: jnp.ndarray
+) -> Any:
+    """Per-row masked no-op: rows finished (``alive[i]=False``) keep their
+    OLD cache content — eos padding never enters a finished row's K/V, so
+    its cache stays bit-exact at the row's true frontier (the property
+    batched serving and multi-turn continuation rely on).  The decode
+    step wrote exactly ONE position (``pos``; ring buffers wrap it to
+    their window), so only that column is merged back — O(b·heads·dim)
+    per layer, not a full-cache copy.  The shared scalar ``length`` still
+    advances (static shapes)."""
+
+    def merge(n: jnp.ndarray, o: jnp.ndarray, a: jnp.ndarray, axis: int):
+        at = jnp.mod(pos, n.shape[axis])
+        col = jnp.where(
+            a,
+            lax.dynamic_slice_in_dim(n, at, 1, axis),
+            lax.dynamic_slice_in_dim(o, at, 1, axis),
+        )
+        return lax.dynamic_update_slice_in_dim(n, col, at, axis)
+
+    a4 = alive[:, None, None, None]
+    k = [merge(n, o, a4, 1) for n, o in zip(new.k, old.k)]
+    v = [merge(n, o, a4, 1) for n, o in zip(new.v, old.v)]
+    if isinstance(new, QuantKVCache):
+        a3 = alive[:, None, None]
+        return QuantKVCache(
+            k=k, v=v,
+            k_scale=[
+                merge(n, o, a3, 2)
+                for n, o in zip(new.k_scale, old.k_scale)
+            ],
+            v_scale=[
+                merge(n, o, a3, 2)
+                for n, o in zip(new.v_scale, old.v_scale)
+            ],
+            length=new.length,
+        )
+    return KVCache(k=k, v=v, length=new.length)
+
+
+def row_frontiers(
+    prompt_len: int,
+    out: jnp.ndarray,            # [b, T] int32 — tokens from generate()
+    eos_id: Optional[int] = None,
+) -> jnp.ndarray:
+    """Per-row TRUE cache frontiers after a first-turn :func:`generate`
+    call with ``return_state=True``: ``prompt_len`` plus the tokens the
+    row actually wrote — everything up to and INCLUDING its first
+    ``eos_id`` (the finishing step writes its eos K/V; the frozen eos
+    padding after it is a masked no-op that never lands in the cache).
+    Feed the result to ``generate(..., cache=..., row_lengths=...)`` to
+    continue each row at its own frontier; LATER turns return updated
+    frontiers directly (the row-mode ``return_state`` 3-tuple), so this
+    helper is only needed once, after the shared-scalar first turn."""
+    b, T = out.shape
+    if eos_id is None:
+        return jnp.full((b,), prompt_len + T, jnp.int32)
+    is_eos = out == eos_id
+    n = jnp.where(is_eos.any(axis=1), jnp.argmax(is_eos, axis=1) + 1, T)
+    return (prompt_len + n).astype(jnp.int32)
 
 
 def _total_len(s: int, max_new_tokens: int, max_len: Optional[int]) -> int:
@@ -789,6 +965,87 @@ def prefill(
     return _logits(cfg, head_p, x)[:, -1], cache
 
 
+def _generate_rows(
+    cfg: TransformerConfig,
+    params: Pytree,
+    prompt: jnp.ndarray,                 # [b, s] int32 — this turn's tokens
+    max_new_tokens: int,
+    *,
+    temperature: float,
+    top_k: Optional[int],
+    top_p: Optional[float],
+    eos_id: Optional[int],
+    rng: jnp.ndarray,
+    moe: Optional[Any],
+    cache: Any,
+    row_lengths: jnp.ndarray,            # [b] int32 — per-row frontiers
+    return_state: bool,
+) -> Any:
+    """``generate(row_lengths=...)``: multi-turn continuation with every
+    row at its OWN cache frontier.  The turn's prompt is absorbed and
+    each new token decoded through :func:`decode_slots` — rope, the
+    causal mask, and the K/V scatter all take the per-row positions, so
+    a row that finished the last turn early never attends over its
+    unwritten ``[frontier, length)`` gap (the shared-scalar default
+    path's failure mode, see the caveat in :func:`generate`).  Finished
+    rows are TRUE no-ops (``n_valid=0`` drops their writes and freezes
+    their frontiers).  Returns ``out`` or, with ``return_state``, the
+    ``(out, cache, new_row_lengths)`` 3-tuple the next turn feeds back
+    in."""
+    b, s = prompt.shape
+    rl = jnp.asarray(row_lengths, jnp.int32)
+    if rl.shape != (b,):
+        raise ValueError(
+            f"row_lengths must hold one frontier per prompt row "
+            f"([{b}]), got shape {tuple(rl.shape)}"
+        )
+    L = cache.k[0].shape[1]
+    _check_decodable(cfg, L)
+    if not isinstance(rl, jax.core.Tracer):
+        deepest = int(jax.device_get(rl).max())
+        if deepest + s + max_new_tokens > L:
+            raise ValueError(
+                f"cache buffers hold {L} positions but the deepest row "
+                f"(frontier {deepest}) + this turn ({s} prompt + "
+                f"{max_new_tokens} new) reaches "
+                f"{deepest + s + max_new_tokens}; budget the first "
+                "call's max_len for all turns"
+            )
+
+    # Absorb this turn's prompt (teacher-forced) at each row's frontier.
+    logits_g, cache, rl = decode_slots(
+        cfg, params, prompt, cache, rl, jnp.full((b,), s, jnp.int32),
+        moe=moe,
+    )
+    logits0 = logits_g[:, -1]
+
+    def step(carry, _):
+        cache, lengths, logits, key, alive = carry
+        key, sub = jax.random.split(key)
+        tok = _sample(logits, sub, temperature, top_k, top_p)
+        if eos_id is not None:
+            tok = jnp.where(alive, tok, eos_id)
+            # The finishing step's eos IS written (n_valid=1) — the
+            # frontier convention row_frontiers pins; rows dead BEFORE
+            # this step write nothing and their frontiers freeze.
+            n_valid = alive.astype(jnp.int32)
+            alive = alive & (tok != eos_id)
+        else:
+            n_valid = jnp.ones((b,), jnp.int32)
+        logits_g, cache, lengths = decode_slots(
+            cfg, params, tok[:, None], cache, lengths, n_valid, moe=moe
+        )
+        return (cache, lengths, logits_g[:, 0], key, alive), tok
+
+    alive0 = jnp.ones((b,), bool)
+    (cache, rl, _, rng, alive), toks = lax.scan(
+        step, (cache, rl, logits0, rng, alive0), None,
+        length=max_new_tokens,
+    )
+    out = toks.T  # [b, max_new_tokens]
+    return (out, cache, rl) if return_state else out
+
+
 def generate(
     cfg: TransformerConfig,
     params: Pytree,
@@ -806,14 +1063,27 @@ def generate(
     kv_quant: bool = False,
     cache: Optional[Any] = None,
     return_state: bool = False,
+    early_exit: bool = False,
+    row_lengths: Optional[jnp.ndarray] = None,
 ) -> Any:
     """Autoregressive decode: returns ``[b, max_new_tokens]`` completions.
 
     ``temperature=0`` is greedy argmax (no rng needed); otherwise pass
     ``rng`` for temperature/top-k/top-p (nucleus) sampling.  With ``eos_id`` set, rows
     that have emitted it keep emitting ``eos_id`` (frozen — static
-    shapes; trim host-side).  Everything compiles to ONE program:
+    shapes; trim host-side) AND become masked no-ops: a finished row's
+    K/V cache stops being written, so its state stays bit-exact at the
+    row's true frontier instead of accreting eos padding (the batched-
+    serving/continuation fix).  Everything compiles to ONE program:
     prefill scan + decode scan.
+
+    ``early_exit=True`` (needs ``eos_id``) swaps the fixed-length decode
+    scan for a bounded ``lax.while_loop`` that STOPS once every row has
+    finished — the batch runs to its longest request, not to
+    ``max_new_tokens`` (with ``return_state=True`` the returned
+    ``cache.length`` shows the actual step count).  Output is identical
+    to the scan path (tested); the default stays the scan so the
+    single-program jaxpr contract is unchanged.
 
     ``cache_mode='ring'`` (requires ``cfg.attn_window``): W-slot ring
     caches instead of ``[.., total, ..]`` buffers — O(window) cache
@@ -835,10 +1105,18 @@ def generate(
     mode composes.  Two-turn decode equals the one-shot run on the
     concatenated prompt (tested).  With ``cache_mode='full'`` the FIRST
     call's ``max_len`` must budget all future turns (fixed buffers;
-    ring caches wrap and never run out)."""
+    ring caches wrap and never run out).
+
+    CAVEAT — continuing after ``eos_id`` finished SOME rows: a finished
+    row's K/V stops at its true frontier (masked no-ops), but the
+    default continuation appends at the shared scalar ``cache.length``,
+    so the dense mask would attend over that row's unwritten gap
+    ``[frontier, length)``.  Pass ``row_lengths=`` (per-row frontiers
+    from :func:`row_frontiers`) to continue every row at its OWN
+    frontier instead — the turn runs through :func:`decode_slots`
+    (full caches only) and ``return_state=True`` returns ``(tokens,
+    cache, new_row_lengths)``, the 3-tuple later turns feed back in."""
     b, s = prompt.shape
-    total = _total_len(s, max_new_tokens, max_len)
-    _check_decodable(cfg, total)
     if cache_mode not in ("full", "ring"):
         raise ValueError(
             f"cache_mode must be 'full' or 'ring', got {cache_mode!r}"
@@ -853,6 +1131,41 @@ def generate(
         raise ValueError("temperature sampling needs rng=jax.random.PRNGKey")
     if temperature == 0.0:
         rng = jax.random.PRNGKey(0)  # unused; keeps the scan carry uniform
+
+    if row_lengths is not None:
+        if cache is None:
+            raise ValueError(
+                "row_lengths continues PER-ROW frontiers of an existing "
+                "cache: pass cache= from the previous turn's "
+                "return_state=True (a first turn has one shared frontier "
+                "— no row_lengths needed)"
+            )
+        if ring:
+            raise ValueError(
+                "row_lengths continuation runs through decode_slots, "
+                "which ring caches defeat (slot = pos % W aliases the "
+                "per-row frontiers); use cache_mode='full'"
+            )
+        if early_exit:
+            raise ValueError(
+                "early_exit is not supported with row_lengths; the "
+                "fixed-length scan already masks finished rows to no-ops"
+            )
+        if max_len is not None:
+            raise ValueError(
+                "max_len sizes a NEW cache; row_lengths continuation "
+                "runs inside the existing cache buffers (budget the "
+                "first call's max_len for all turns)"
+            )
+        return _generate_rows(
+            cfg, params, prompt, max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id, rng=rng, moe=moe, cache=cache,
+            row_lengths=row_lengths, return_state=return_state,
+        )
+
+    total = _total_len(s, max_new_tokens, max_len)
+    _check_decodable(cfg, total)
 
     embed_p, block_p, head_p = _split_params(cfg, params)
     mlp_layer = _mlp_layer_for(cfg, moe)
@@ -872,18 +1185,56 @@ def generate(
         cache, turn_logits = lax.scan(absorb, cache, prompt.T)
         logits0 = turn_logits[-1]
 
+    if early_exit and eos_id is None:
+        raise ValueError(
+            "early_exit terminates when every row has emitted eos_id; "
+            "set eos_id (without it no row ever finishes early)"
+        )
+
     def step(carry, _):
         cache, logits, key, alive = carry
         key, sub = jax.random.split(key)
         tok = _sample(logits, sub, temperature, top_k, top_p)
         if eos_id is not None:
             tok = jnp.where(alive, tok, eos_id)
+            was_alive = alive
             alive = alive & (tok != eos_id)
         x = _embed(cfg, embed_p, tok[:, None], cache.length)
-        x, cache = _decode_step(cfg, block_p, x, cache, mlp_layer, ring)
-        return (cache, _logits(cfg, head_p, x)[:, 0], key, alive), tok
+        x, new_cache = _decode_step(cfg, block_p, x, cache, mlp_layer, ring)
+        if eos_id is not None:
+            # Rows already finished BEFORE this step are masked no-ops:
+            # their eos feed's K/V write is dropped.
+            new_cache = _mask_finished_rows(
+                new_cache, cache, was_alive, cache.length
+            )
+        return (new_cache, _logits(cfg, head_p, x)[:, 0], key, alive), tok
 
     alive0 = jnp.ones((b,), bool)
+    if early_exit:
+        T = max_new_tokens
+        out0 = jnp.full((b, T), eos_id, jnp.int32)
+
+        def w_cond(carry):
+            n = carry[0]
+            alive = carry[4]
+            return (n < T) & jnp.any(alive)
+
+        def w_body(carry):
+            n, cache, logits, key, alive, out = carry
+            (cache, logits, key, alive), tok = step(
+                (cache, logits, key, alive), None
+            )
+            out = lax.dynamic_update_slice_in_dim(
+                out, tok[:, None], n, axis=1
+            )
+            return (n + 1, cache, logits, key, alive, out)
+
+        n, cache, logits, rng, alive, out = lax.while_loop(
+            w_cond, w_body,
+            (jnp.zeros((), jnp.int32), cache, logits0, rng, alive0, out0),
+        )
+        return (out, cache) if return_state else out
+
     (cache, logits, rng, alive), toks = lax.scan(
         step, (cache, logits0, rng, alive0), None, length=max_new_tokens
     )
@@ -1424,11 +1775,13 @@ __all__ = [
     "QuantKVCache",
     "SpecStats",
     "beam_search",
+    "decode_slots",
     "init_cache",
     "init_quant_cache",
     "prefill",
     "generate",
     "mpmd_params_for_generation",
+    "row_frontiers",
     "speculative_generate",
     "spmd_params_for_generation",
     "spmd_params_from_flat",

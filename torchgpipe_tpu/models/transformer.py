@@ -311,14 +311,21 @@ def rms_norm(dim: int, *, eps: float = 1e-5, name: str = "rmsnorm") -> Layer:
 def _rope(x: jnp.ndarray, theta: float, pos_offset: Any = 0) -> jnp.ndarray:
     """Rotary position embedding over the trailing head_dim, positions from
     shape plus ``pos_offset`` (x: [b, s, heads, head_dim]).  A non-zero
-    offset gives sequence-parallel shards their *global* token positions."""
+    offset gives sequence-parallel shards their *global* token positions;
+    a ``[b]``-shaped offset gives every batch row its OWN base position —
+    the slot-pooled serving decode, where each slot sits at a different
+    sequence frontier."""
     b, s, h, d = x.shape
     half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    positions = pos_offset + jnp.arange(s, dtype=jnp.float32)
-    ang = positions[:, None] * freqs[None, :]  # [s, half]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    # [B', s] positions with B' = b (per-row offset) or 1 (shared) — one
+    # rotation body either way; the B'=1 case broadcasts exactly as the
+    # pre-per-row [1, s, 1, half] cos/sin did.
+    off = jnp.asarray(pos_offset, jnp.float32)
+    positions = off.reshape(-1, 1) + jnp.arange(s, dtype=jnp.float32)
+    ang = positions[..., None] * freqs  # [B', s, half]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     out = jnp.concatenate(
         [

@@ -37,10 +37,12 @@ Notes:
 
 from __future__ import annotations
 
+import collections
 import signal
 import threading
+import weakref
 from types import FrameType
-from typing import Any, Dict, Iterable, Optional
+from typing import Any, Callable, Deque, Dict, Iterable, Optional
 
 from torchgpipe_tpu.resilience import faults
 
@@ -58,6 +60,13 @@ class PreemptionHandler:
         self._seen: Dict[int, int] = {}
         self._previous: Dict[int, Any] = {}
         self._installed = False
+        # Hooks not yet delivered.  A deque because popleft() is one
+        # atomic C call: signal handlers run between bytecodes on this
+        # same thread, so claim-then-invoke with plain ints could
+        # double-fire a hook when a signal lands mid-claim — popping
+        # hands each hook to exactly one _fire frame.
+        self._pending: Deque[Callable[[], Optional[Callable[[], None]]]] \
+            = collections.deque()
 
     # ------------------------------------------------------------------ #
     # lifecycle                                                          #
@@ -97,12 +106,61 @@ class PreemptionHandler:
         self._seen[signum] = self._seen.get(signum, 0) + 1
         self.signum = signum
         self._flag.set()
+        self._fire()
         if signum == signal.SIGINT and self._seen[signum] > 1:
             raise KeyboardInterrupt  # second ctrl-C: stop waiting politely
 
     def simulate(self) -> None:
         """Set the flag programmatically (tests, custom watchdogs)."""
         self._flag.set()
+        self._fire()
+
+    # ------------------------------------------------------------------ #
+    # drain hooks                                                        #
+    # ------------------------------------------------------------------ #
+
+    def add_callback(self, fn: Callable[[], None]) -> None:
+        """Register a drain hook fired at most ONCE — when preemption
+        first latches (signal, :meth:`simulate`, or a fault-injected
+        step), or immediately if it already has.  Hooks may run in
+        signal context — they must only flip flags / enqueue work (the
+        serving engine's ``request_drain`` contract), never block or
+        touch device state; exceptions are swallowed (a broken observer
+        must not lose the preemption grace window).
+
+        Bound methods are held by ``weakref.WeakMethod``: a
+        process-lifetime handler must not pin every engine ever wired
+        to it (a dead serving engine's hook is skipped, and the engine
+        — KV pool included — stays collectable).  Plain functions,
+        closures, and bound methods WeakMethod cannot hold (C-level
+        methods, ``__slots__`` receivers without ``__weakref__``) are
+        held strongly."""
+        ref: Callable[[], Optional[Callable[[], None]]]
+        try:
+            ref = weakref.WeakMethod(fn)  # type: ignore[arg-type]
+        except TypeError:
+            ref = lambda fn=fn: fn  # noqa: E731 — uniform resolve shape
+        self._pending.append(ref)
+        if self._flag.is_set():
+            self._fire()
+
+    def _fire(self) -> None:
+        # At-most-once per CALLBACK, not per handler: a hook registered
+        # after the flag latched still gets its delivery, and each
+        # popleft() hands its hook to exactly one frame even when a
+        # signal re-enters this loop mid-iteration.
+        while True:
+            try:
+                ref = self._pending.popleft()
+            except IndexError:
+                return
+            fn = ref()
+            if fn is None:          # referent collected: skip
+                continue
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — see add_callback
+                pass
 
     # ------------------------------------------------------------------ #
     # polling                                                            #
@@ -118,4 +176,5 @@ class PreemptionHandler:
         fault-injected preemption for ``step`` as well as real signals."""
         if step is not None and faults.should_preempt(step):
             self._flag.set()
+            self._fire()
         return self._flag.is_set()

@@ -1,0 +1,40 @@
+"""Continuous-batching inference serving over the pipelined decode path.
+
+The training side of this framework ends at trained per-stage params;
+this package turns them into a server: Orca-style iteration-level
+batching (arXiv: OSDI '22) over a slot-pooled KV cache (the
+PagedAttention idea, arXiv:2309.06180, specialised to one fixed-size
+page per request — the shape-static variant TPU serving requires), with
+exactly TWO compiled programs in steady state regardless of request
+churn.
+
+    from torchgpipe_tpu import serving
+    eng = serving.Engine(cfg, flat_params, num_slots=8, max_len=256)
+    rid = eng.submit(prompt, max_new_tokens=64, eos_id=2,
+                     on_token=lambda rid, t: print(t))
+    eng.run()
+    tokens = eng.result(rid)
+
+Modules: :mod:`~torchgpipe_tpu.serving.cache_pool` (slot banks +
+free-list), :mod:`~torchgpipe_tpu.serving.scheduler` (admission /
+chunked-prefill interleave / eviction),
+:mod:`~torchgpipe_tpu.serving.engine` (the two-program loop, streaming,
+drain/resume), :mod:`~torchgpipe_tpu.serving.metrics` (TTFT / TPOT /
+occupancy / throughput).  Full story: ``docs/serving.md``.
+"""
+
+from __future__ import annotations
+
+from torchgpipe_tpu.serving.cache_pool import CachePool
+from torchgpipe_tpu.serving.engine import Engine
+from torchgpipe_tpu.serving.metrics import RequestTimes, ServingMetrics
+from torchgpipe_tpu.serving.scheduler import Request, Scheduler
+
+__all__ = [
+    "CachePool",
+    "Engine",
+    "Request",
+    "RequestTimes",
+    "Scheduler",
+    "ServingMetrics",
+]

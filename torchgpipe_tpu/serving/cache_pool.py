@@ -1,0 +1,147 @@
+"""Slot-pooled KV caches: fixed banks, free-list allocation, recycling.
+
+The TPU serving problem in one sentence: request churn must never change
+an array shape (XLA recompiles per shape — the ``recompilation-hazard``
+lint rule), yet requests arrive, finish and cancel at arbitrary times.
+The pool squares that circle the PagedAttention/Orca way, specialised to
+one page per request: a fixed ``[num_slots, max_len, kv_heads, head_dim]``
+K/V bank per layer (a :class:`~torchgpipe_tpu.models.generation.KVCache`
+or int8 :class:`~torchgpipe_tpu.models.generation.QuantKVCache` whose
+batch dim IS the slot dim), a host-side free list handing slots to
+requests and taking them back, and a per-slot ``lengths`` vector (host
+mirror, passed into every compiled step) giving each slot its own
+sequence frontier.
+
+Recycling needs NO device work: a freed slot's stale rows are dead by
+masking — every attention read masks cache rows ``> length``, and decode
+writes land exactly at ``length``, so a recycled slot can never see its
+previous tenant's K/V, scales included (the bitwise slot-reuse test in
+``tests/test_serving.py`` pins this for the int8 cache, where a stale
+*scale* would corrupt every row it spans).
+
+Sizing: :func:`torchgpipe_tpu.tune.serving_cache_bytes` accounts the
+pool via ``eval_shape`` (no allocation);
+:func:`torchgpipe_tpu.tune.serving_max_slots` inverts it against an HBM
+budget — the scheduler's admission control reads that number.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchgpipe_tpu.models.generation import init_cache, init_quant_cache
+from torchgpipe_tpu.models.transformer import TransformerConfig
+
+
+class CachePool:
+    """A fixed-shape KV bank + free-list slot allocator.
+
+    The device state (``cache``) is intentionally PUBLIC and replaced
+    wholesale by the engine after every compiled step — the pool object
+    owns allocation bookkeeping (host-side, O(1) per event), not the
+    arrays' life cycle.  ``lengths`` is the host mirror of per-slot
+    frontiers: the engine advances it deterministically (it knows
+    exactly how many tokens each step absorbed), so steady-state serving
+    never fetches it back from the device.
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        num_slots: int,
+        max_len: int,
+        *,
+        kv_quant: bool = False,
+        dtype: Optional[Any] = None,
+    ) -> None:
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 2:
+            raise ValueError(
+                f"max_len must hold a prompt plus one generated token, "
+                f"got {max_len}"
+            )
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.kv_quant = kv_quant
+        self.dtype = dtype
+        self.cache: Any = (
+            init_quant_cache(cfg, num_slots, max_len)
+            if kv_quant
+            else init_cache(cfg, num_slots, max_len, dtype=dtype)
+        )
+        self.lengths = np.zeros((num_slots,), np.int32)
+        # LIFO free list: the most-recently-freed slot is reused first,
+        # maximising the chance its rows are still warm in cache AND
+        # exercising the stale-row masking continuously.
+        self._free: List[int] = list(range(num_slots - 1, -1, -1))
+        self._owner: Dict[int, str] = {}
+
+    # ------------------------------------------------------------------ #
+    # allocation                                                         #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_active(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def alloc(self, owner: str) -> Optional[int]:
+        """Hand a free slot to ``owner`` (its frontier reset to 0), or
+        ``None`` when the pool is exhausted."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._owner[slot] = owner
+        self.lengths[slot] = 0
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Recycle a slot.  No device work: stale rows are dead by
+        masking (see the module docstring)."""
+        if slot not in self._owner:
+            raise KeyError(f"slot {slot} is not allocated")
+        del self._owner[slot]
+        self.lengths[slot] = 0
+        self._free.append(slot)
+
+    def owner_of(self, slot: int) -> Optional[str]:
+        return self._owner.get(slot)
+
+    def active_slots(self) -> List[int]:
+        return sorted(self._owner)
+
+    # ------------------------------------------------------------------ #
+    # accounting                                                         #
+    # ------------------------------------------------------------------ #
+
+    def bytes(self) -> int:
+        """Bytes this pool's device arrays pin (eval_shape accounting —
+        equals the allocated size)."""
+        from torchgpipe_tpu.tune import serving_cache_bytes
+
+        return serving_cache_bytes(
+            self.cfg, self.num_slots, self.max_len,
+            kv_quant=self.kv_quant, dtype=self.dtype,
+        )
+
+    def lengths_device(self) -> jnp.ndarray:
+        """The per-slot frontier vector as an int32 array for a step.
+
+        SNAPSHOT semantics, deliberately: ``jnp.asarray`` on CPU may
+        alias the numpy buffer zero-copy, and the engine mutates
+        ``self.lengths`` in place right after dispatching the
+        (asynchronously executing) step that reads it — without the copy
+        the program races the host update (observed as nondeterministic
+        outputs on the CPU backend)."""
+        return jnp.asarray(self.lengths.copy())
+
+
+__all__ = ["CachePool"]

@@ -1,0 +1,531 @@
+"""The serving loop: two compiled programs, arbitrary request churn.
+
+Steady-state contract (the whole point, and what the compile-counter
+test in ``tests/test_serving.py`` pins): after warmup the engine
+executes exactly TWO compiled programs —
+
+* **prefill** — ``decode_slots`` at ``g = prefill_chunk``: every slot's
+  pending prompt chunk teacher-forced at its own frontier, masked rows
+  no-ops; rows finishing their prompt sample their FIRST token from the
+  chunk's last-valid-position logits (so prefill and decode share one
+  sampling site semantics-wise);
+* **decode** — ``decode_slots`` at ``g = 1``: one token per occupied
+  slot, each at its own position.
+
+Request arrival, completion, cancellation, drain — all of it changes
+only the VALUES of ``tokens`` / ``lengths`` / ``n_valid`` / the cache
+arrays, never a shape, so XLA never retraces.  The engine works from
+the SAME trained pipeline params the training engines produce
+(``mpmd_params_for_generation`` / ``spmd_params_for_generation`` — the
+flat per-layer list), with no conversion step.
+
+Resilience: every compiled-step dispatch retries transient failures
+under :func:`torchgpipe_tpu.resilience.guard.classify_error` (bounded
+backoff, :class:`~torchgpipe_tpu.resilience.guard.GuardPolicy`); a
+:class:`~torchgpipe_tpu.resilience.preemption.PreemptionHandler` wired
+in at build time triggers a cooperative drain between iterations —
+unfinished requests snapshot (prompt + tokens emitted so far) through
+:class:`~torchgpipe_tpu.resilience.checkpoint.CheckpointManager`, and
+:meth:`Engine.restore_requests` resubmits them to the next incarnation,
+which continues each stream exactly where it stopped (greedy decode is
+prefix-deterministic, so resumed outputs equal never-preempted ones —
+tested).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchgpipe_tpu.models.generation import (
+    _check_decodable,
+    _sample,
+    _split_params,
+    decode_slots,
+)
+from torchgpipe_tpu.models.transformer import TransformerConfig
+from torchgpipe_tpu.resilience.guard import GuardPolicy, classify_error
+from torchgpipe_tpu.serving.cache_pool import CachePool
+from torchgpipe_tpu.serving.metrics import ServingMetrics
+from torchgpipe_tpu.serving.scheduler import Request, Scheduler
+
+Pytree = Any
+
+
+class Engine:
+    """Continuous-batching inference engine over a slot-pooled KV cache.
+
+    Example::
+
+        flat = mpmd_params_for_generation(model, params)   # or spmd_...
+        eng = Engine(cfg, flat, num_slots=4, max_len=64)
+        rid = eng.submit(prompt_tokens, max_new_tokens=16, eos_id=2)
+        eng.run()                       # or step() under your own loop
+        tokens = eng.result(rid)        # np.int32 [n]
+
+    ``hbm_budget_bytes`` turns on admission control: the slot cap comes
+    from :func:`torchgpipe_tpu.tune.serving_max_slots`'s ``eval_shape``
+    accounting of the pool (+ resident param bytes, double-buffered
+    unless ``donate=True``), and the POOL ITSELF is clamped to it before
+    allocation — a pool that fits is guaranteed to KEEP fitting under
+    any churn, because churn only changes values.
+
+    ``temperature=0`` (default) is greedy — the mode whose outputs are
+    bit-matched against :func:`~torchgpipe_tpu.models.generation.
+    generate` per-request; sampling takes ``rng`` and applies the same
+    temperature/top-k/top-p filter chain ``generate`` uses, batched over
+    slots.
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params: Sequence[Pytree],
+        *,
+        num_slots: int,
+        max_len: int,
+        prefill_chunk: int = 8,
+        kv_quant: bool = False,
+        cache_dtype: Optional[Any] = None,
+        moe: Optional[Any] = None,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        rng: Optional[jnp.ndarray] = None,
+        hbm_budget_bytes: Optional[int] = None,
+        overhead_bytes: int = 0,
+        wave_admission: bool = False,
+        metrics: Optional[ServingMetrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+        preemption: Optional[Any] = None,
+        checkpoint_manager: Optional[Any] = None,
+        guard_policy: Optional[GuardPolicy] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        donate: bool = False,
+    ) -> None:
+        self.cfg = cfg
+        self.params = list(params)
+        _split_params(cfg, self.params)  # validates the per-layer list
+        _check_decodable(cfg, max_len)
+        self.moe = moe
+        self.prefill_chunk = int(prefill_chunk)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+        if self.temperature > 0.0 and rng is None:
+            raise ValueError(
+                "temperature sampling needs rng=jax.random.PRNGKey"
+            )
+        self._key = rng if rng is not None else jax.random.PRNGKey(0)
+        self.donate = donate
+        max_active: Optional[int] = None
+        if hbm_budget_bytes is not None:
+            from torchgpipe_tpu.tune import serving_max_slots, tree_bytes
+
+            max_active = serving_max_slots(
+                cfg, max_len, hbm_budget_bytes,
+                kv_quant=kv_quant, dtype=cache_dtype,
+                param_bytes=tree_bytes(self.params),
+                overhead_bytes=overhead_bytes,
+                donated=donate,
+            )
+            if max_active < 1:
+                raise ValueError(
+                    "admission cap is 0 slots: the cache pool does not "
+                    "fit the HBM budget — shrink max_len/num_slots or "
+                    "raise the budget (tune.serving_max_slots accounting)"
+                )
+            # The cap must bound ALLOCATED memory, not just active rows:
+            # the pool's banks pin HBM at build time (BEFORE any request
+            # arrives), so the pool itself is clamped to the cap here.
+            num_slots = min(num_slots, max_active)
+        self.pool = CachePool(
+            cfg, num_slots, max_len, kv_quant=kv_quant, dtype=cache_dtype
+        )
+        self.scheduler = Scheduler(
+            self.pool, prefill_chunk=self.prefill_chunk,
+            max_active=max_active, wave_admission=wave_admission,
+        )
+        self.metrics = metrics or ServingMetrics(clock=clock)
+        self.guard_policy = guard_policy or GuardPolicy()
+        self._sleep = sleep
+        self._preemption = preemption
+        self._checkpoint_manager = checkpoint_manager
+        self._drain_requested = False
+        self._draining = False
+        self._last_drain_sid: Optional[int] = None
+        if preemption is not None and hasattr(preemption, "add_callback"):
+            preemption.add_callback(self.request_drain)
+        self._requests: Dict[str, Request] = {}
+        self._cur_tok = np.zeros((num_slots,), np.int32)
+        self._rid_counter = 0
+        self.trace_counts = {"prefill": 0, "decode": 0}
+        # ONE source of truth for the token-buffer shapes: the real steps
+        # and the lint's step_input_specs() both read this, so a shape
+        # that churned with the request mix could not hide.
+        self._token_shapes = {
+            "prefill": (num_slots, self.prefill_chunk),
+            "decode": (num_slots, 1),
+        }
+        self._build_programs()
+
+    # ------------------------------------------------------------------ #
+    # compiled programs                                                  #
+    # ------------------------------------------------------------------ #
+
+    def _build_programs(self) -> None:
+        cfg, moe = self.cfg, self.moe
+        P = self.prefill_chunk
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        counts = self.trace_counts
+
+        def sample_row(logits, key):
+            # [S, vocab] f32 -> [S] int32, generate's exact filter chain.
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+            key, sub = jax.random.split(key)
+            return _sample(logits, sub, temperature, top_k, top_p), key
+
+        def prefill_body(params, cache, lengths, tokens, n_valid, key):
+            counts["prefill"] += 1
+            logits, cache, _ = decode_slots(
+                cfg, params, tokens, cache, lengths, n_valid, moe=moe
+            )
+            last = jnp.clip(n_valid - 1, 0, P - 1)
+            row_logits = jnp.take_along_axis(
+                logits, last[:, None, None], axis=1
+            )[:, 0]
+            tok, key = sample_row(row_logits, key)
+            return tok, cache, key
+
+        def decode_body(params, cache, lengths, tokens, n_valid, key):
+            counts["decode"] += 1
+            logits, cache, _ = decode_slots(
+                cfg, params, tokens, cache, lengths, n_valid, moe=moe
+            )
+            tok, key = sample_row(logits[:, 0], key)
+            return tok, cache, key
+
+        donate = (1,) if self.donate else ()
+        self._prefill_fn = jax.jit(prefill_body, donate_argnums=donate)
+        self._decode_fn = jax.jit(decode_body, donate_argnums=donate)
+
+    def step_input_specs(self) -> Dict[str, Any]:
+        """The (shape, dtype) signature of each compiled program's
+        inputs — request-independent BY CONSTRUCTION (the real step
+        builds its buffers from these same shapes), which is what
+        :func:`torchgpipe_tpu.analysis.serving.lint_serving` certifies
+        over a request-churn grid."""
+        S = self.pool.num_slots
+        sds = jax.ShapeDtypeStruct
+        cache_spec = jax.tree_util.tree_map(
+            lambda a: sds(a.shape, a.dtype), self.pool.cache
+        )
+        common = {
+            "cache": cache_spec,
+            "lengths": sds((S,), np.int32),
+            "n_valid": sds((S,), np.int32),
+            "key": sds(self._key.shape, self._key.dtype),
+        }
+        return {
+            kind: dict(common, tokens=sds(shape, np.int32))
+            for kind, shape in self._token_shapes.items()
+        }
+
+    def _token_buffer(self, kind: str) -> np.ndarray:
+        return np.zeros(self._token_shapes[kind], np.int32)
+
+    def _dispatch(self, fn: Callable[..., Tuple], *args: Any) -> Tuple:
+        """Run a compiled step under the transient-retry policy (the
+        serving twin of StepGuard's retry half; inputs are not donated
+        unless ``donate=True``, in which case retry is impossible and
+        transient errors re-raise immediately)."""
+        attempt = 0
+        while True:
+            try:
+                # jit dispatch is ASYNC: a device-execution failure
+                # surfaces on materialization, so block here — letting
+                # it escape to the caller's host fetch would skip the
+                # retry AND commit the failed step's arrays to the pool
+                # first.  Free in practice: the engine host-fetches the
+                # step's tokens immediately anyway.
+                return jax.block_until_ready(fn(*args))
+            except Exception as err:  # noqa: BLE001 — classified below
+                if (
+                    self.donate
+                    or classify_error(err) != "transient"
+                    or attempt >= self.guard_policy.max_retries
+                ):
+                    raise
+                delay = self.guard_policy.backoff(attempt)
+                attempt += 1
+                self.metrics.retries += 1
+                self._sleep(delay)
+
+    @property
+    def compile_stats(self) -> Dict[str, int]:
+        """Times each program's python body was TRACED — the zero-retrace
+        contract is ``{'prefill': 1, 'decode': 1}`` after warmup."""
+        return dict(self.trace_counts)
+
+    # ------------------------------------------------------------------ #
+    # request API                                                        #
+    # ------------------------------------------------------------------ #
+
+    def submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int,
+        *,
+        rid: Optional[str] = None,
+        eos_id: Optional[int] = None,
+        on_token: Optional[Callable[[str, int], None]] = None,
+        emitted_prefix: Sequence[int] = (),
+    ) -> str:
+        """Queue a request; returns its id.  Admission happens between
+        engine iterations (a free slot + the admission cap permitting).
+        """
+        if rid is None:
+            self._rid_counter += 1
+            rid = f"r{self._rid_counter}"
+        if rid in self._requests:
+            raise ValueError(f"duplicate request id {rid!r}")
+        req = Request(
+            rid=rid,
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=int(max_new_tokens),
+            eos_id=eos_id,
+            on_token=on_token,
+            emitted_prefix=list(emitted_prefix),
+        )
+        self.scheduler.submit(req)   # validates before registration
+        self._requests[rid] = req
+        self.metrics.arrived(rid)
+        return rid
+
+    def cancel(self, rid: str) -> bool:
+        ok = self.scheduler.cancel(rid)
+        if ok:
+            self.metrics.finished(rid, status="cancelled")
+        return ok
+
+    def result(self, rid: str) -> np.ndarray:
+        """All tokens request ``rid`` has produced so far (across a
+        drain/resume), as ``np.int32 [n]``."""
+        return np.asarray(self._requests[rid].tokens(), np.int32)
+
+    def status(self, rid: str) -> str:
+        return self._requests[rid].status
+
+    # ------------------------------------------------------------------ #
+    # the loop                                                           #
+    # ------------------------------------------------------------------ #
+
+    def step(self) -> bool:
+        """ONE engine iteration: admit, pick a phase, run its compiled
+        program, emit/evict.  Returns False when idle (nothing ran)."""
+        if not self._draining:
+            for req in self.scheduler.admit():
+                self.metrics.admitted(req.rid)
+        action = self.scheduler.next_action()
+        if action is None:
+            return False
+        if action == "prefill":
+            self._run_prefill()
+        else:
+            self._run_decode()
+        return True
+
+    def _run_prefill(self) -> None:
+        reqs = self.scheduler.prefill_pending()
+        tokens = self._token_buffer("prefill")
+        n_valid = np.zeros((self.pool.num_slots,), np.int32)
+        takes: List[Tuple[Request, int]] = []
+        for r in reqs:
+            take = min(self.prefill_chunk, r.prompt_len - r.prefilled)
+            tokens[r.slot, :take] = r.prompt[r.prefilled:r.prefilled + take]
+            n_valid[r.slot] = take
+            takes.append((r, take))
+        tok, cache, key = self._dispatch(
+            self._prefill_fn, self.params, self.pool.cache,
+            self.pool.lengths_device(), jnp.asarray(tokens),
+            jnp.asarray(n_valid), self._key,
+        )
+        self.pool.cache = cache
+        self._key = key
+        self.metrics.step("prefill", len(reqs), self.pool.num_slots)
+        tok_host: Optional[np.ndarray] = None
+        for r, take in takes:
+            self.pool.lengths[r.slot] += take
+            r.prefilled += take
+            if r.prefill_done:
+                if tok_host is None:
+                    tok_host = np.asarray(tok)  # ONE host fetch per step
+                self._emit(r, int(tok_host[r.slot]))
+
+    def _run_decode(self) -> None:
+        reqs = self.scheduler.decode_ready()
+        tokens = self._token_buffer("decode")
+        n_valid = np.zeros((self.pool.num_slots,), np.int32)
+        for r in reqs:
+            tokens[r.slot, 0] = self._cur_tok[r.slot]
+            n_valid[r.slot] = 1
+        tok, cache, key = self._dispatch(
+            self._decode_fn, self.params, self.pool.cache,
+            self.pool.lengths_device(), jnp.asarray(tokens),
+            jnp.asarray(n_valid), self._key,
+        )
+        self.pool.cache = cache
+        self._key = key
+        self.metrics.step("decode", len(reqs), self.pool.num_slots)
+        tok_host = np.asarray(tok)      # the ONE host fetch per step
+        for r in reqs:
+            self.pool.lengths[r.slot] += 1
+            self._emit(r, int(tok_host[r.slot]))
+
+    def _emit(self, req: Request, token: int) -> None:
+        """Stream one token; per-row termination FREES THE SLOT NOW —
+        the iteration-level eviction continuous batching is made of."""
+        req.generated.append(token)
+        self.metrics.token(req.rid)
+        if req.on_token is not None:
+            req.on_token(req.rid, token)
+        done = (
+            (req.eos_id is not None and token == req.eos_id)
+            or req.remaining_new <= 0
+        )
+        if done:
+            req.status = "finished"
+            self.scheduler.release(req)
+            self.metrics.finished(req.rid)
+        else:
+            self._cur_tok[req.slot] = token
+
+    def run(self, max_steps: Optional[int] = None) -> str:
+        """Iterate until idle, preempted, or ``max_steps``.  Returns
+        ``'idle'`` | ``'preempted'`` | ``'budget'``."""
+        steps = 0
+        while not self.scheduler.idle:
+            if self._preempted():
+                self.drain()
+                return "preempted"
+            if not self.step():
+                break
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                return "budget"
+        return "idle"
+
+    # ------------------------------------------------------------------ #
+    # drain / resume (resilience)                                        #
+    # ------------------------------------------------------------------ #
+
+    def request_drain(self) -> None:
+        """Ask the engine to drain at the next iteration boundary (safe
+        from a PreemptionHandler callback or another thread)."""
+        self._drain_requested = True
+
+    def _preempted(self) -> bool:
+        if self._drain_requested:
+            return True
+        h = self._preemption
+        return bool(h is not None and getattr(h, "preempted", False))
+
+    def drain(self, step_id: Optional[int] = None) -> Dict[str, Any]:
+        """Cooperative drain: stop admitting, snapshot every unfinished
+        request (original prompt + tokens emitted so far), release all
+        slots, and — when a CheckpointManager is wired — persist the
+        snapshot.  Returns the snapshot dict."""
+        self._draining = True
+        unfinished = list(self.scheduler.queue) + list(
+            self.scheduler.active.values()
+        )
+        tree: Dict[str, Dict[str, np.ndarray]] = {}
+        meta: Dict[str, Dict[str, Any]] = {}
+        for r in unfinished:
+            tree[r.rid] = {
+                "prompt": np.asarray(r.prompt, np.int32),
+                "generated": np.asarray(r.generated, np.int32),
+            }
+            meta[r.rid] = {
+                "max_new_tokens": r.max_new_tokens,
+                "eos_id": r.eos_id,
+                "emitted_prefix": list(r.emitted_prefix),
+                "prompt_len": r.prompt_len,
+                "generated_len": len(r.generated),
+            }
+        for r in list(self.scheduler.active.values()):
+            r.status = "preempted"
+            self.scheduler.release(r)
+        for r in list(self.scheduler.queue):
+            r.status = "preempted"
+        self.scheduler.queue.clear()
+        self.metrics.drained(len(unfinished))
+        for rid in meta:
+            self.metrics.finished(rid, status="preempted")
+        # Persist only when there is something to restore, and never at a
+        # step id already used by an earlier drain: CheckpointManager.save
+        # REPLACES an existing step_<n> snapshot, so an empty (or repeated)
+        # drain at the same id would silently destroy the one that holds
+        # the in-flight requests.
+        if self._checkpoint_manager is not None and meta:
+            sid = (
+                step_id if step_id is not None
+                else self.metrics.engine_steps
+            )
+            if self._last_drain_sid is not None:
+                sid = max(sid, self._last_drain_sid + 1)
+            self._checkpoint_manager.save(
+                sid, tree, metadata={"requests": meta}
+            )
+            self._last_drain_sid = sid
+        self._drain_requested = False
+        return {"tree": tree, "requests": meta}
+
+    @staticmethod
+    def restore_requests(source: Any) -> List[Dict[str, Any]]:
+        """Rebuild submit() kwargs for every request a drain snapshot
+        holds — from a CheckpointManager or a :meth:`drain` return.
+
+        Each entry resubmits with the prompt EXTENDED by the tokens
+        already emitted (teacher-forced on resume) and the budget shrunk
+        accordingly; greedy decode being prefix-deterministic, the
+        resumed stream continues exactly where the drained one stopped.
+        """
+        if isinstance(source, dict):
+            meta = source["requests"]
+            tree = source["tree"]
+        else:
+            snap = source.restore_latest()
+            if snap is None:
+                return []
+            meta = snap.metadata["requests"]
+            template = {
+                rid: {
+                    "prompt": np.zeros((m["prompt_len"],), np.int32),
+                    "generated": np.zeros((m["generated_len"],), np.int32),
+                }
+                for rid, m in meta.items()
+            }
+            tree = source.restore_step(snap.step, template).tree
+        out: List[Dict[str, Any]] = []
+        for rid, m in meta.items():
+            prompt = np.asarray(tree[rid]["prompt"], np.int32)
+            generated = np.asarray(tree[rid]["generated"], np.int32)
+            out.append({
+                "rid": rid,
+                "prompt": np.concatenate([prompt, generated]),
+                "max_new_tokens": int(m["max_new_tokens"]) - generated.size,
+                "eos_id": m["eos_id"],
+                "emitted_prefix": (
+                    list(m["emitted_prefix"]) + generated.tolist()
+                ),
+            })
+        return out
+
+
+__all__ = ["Engine"]

@@ -1,0 +1,170 @@
+"""Serving observability: per-request latency and engine-level counters.
+
+The two layers a decode server is judged by (Orca, OSDI '22; vLLM,
+arXiv:2309.06180):
+
+* **Per-request latency** — :class:`RequestTimes` tracks arrival →
+  admission → first token → finish, from which the standard quantities
+  derive: queue wait (admitted − arrival), TTFT (first token − arrival),
+  TPOT (decode time per subsequent token).
+* **Engine throughput** — per-iteration counters: how many compiled
+  steps of each kind ran, how many slot-steps were occupied vs idle
+  (occupancy is THE continuous-batching win: recycled slots keep the
+  batch dim full), tokens emitted, transient retries, drains and the
+  requests they preempted.
+
+Everything is host-side bookkeeping around the engine loop — no device
+work, no effect on the two compiled programs.  ``snapshot()`` returns a
+plain-dict view the tests and ``bench.py --decode-serving`` read; the
+``clock`` is injectable so tests can drive deterministic time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class RequestTimes:
+    """Wall-clock milestones of one request (``None`` = not reached)."""
+
+    rid: str
+    arrival: float
+    admitted: Optional[float] = None
+    first_token: Optional[float] = None
+    finished: Optional[float] = None
+    tokens: int = 0
+    status: str = "queued"   # queued|active|finished|cancelled|preempted
+
+    @property
+    def queue_wait(self) -> Optional[float]:
+        return None if self.admitted is None else self.admitted - self.arrival
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time to first token, from arrival (includes queue wait)."""
+        if self.first_token is None:
+            return None
+        return self.first_token - self.arrival
+
+    @property
+    def tpot(self) -> Optional[float]:
+        """Time per output token over the decode phase (tokens after the
+        first); ``None`` until finished or for single-token outputs."""
+        if self.finished is None or self.first_token is None:
+            return None
+        if self.tokens <= 1:
+            return None
+        return (self.finished - self.first_token) / (self.tokens - 1)
+
+
+class ServingMetrics:
+    """Counters the serving engine maintains; see the module docstring."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self.requests: Dict[str, RequestTimes] = {}
+        self.prefill_steps = 0
+        self.decode_steps = 0
+        self.occupied_slot_steps = 0   # slot-steps doing useful work
+        self.total_slot_steps = 0      # slot-steps available (steps * slots)
+        self.tokens_out = 0
+        self.retries = 0
+        self.drains = 0
+        self.preempted_requests = 0    # unfinished requests at drain time
+        self.started = clock()
+
+    # ------------------------------------------------------------------ #
+    # request lifecycle                                                  #
+    # ------------------------------------------------------------------ #
+
+    def now(self) -> float:
+        return self._clock()
+
+    def arrived(self, rid: str) -> None:
+        self.requests[rid] = RequestTimes(rid=rid, arrival=self._clock())
+
+    def admitted(self, rid: str) -> None:
+        r = self.requests[rid]
+        r.admitted = self._clock()
+        r.status = "active"
+
+    def token(self, rid: str) -> None:
+        r = self.requests[rid]
+        t = self._clock()
+        if r.first_token is None:
+            r.first_token = t
+        r.tokens += 1
+        self.tokens_out += 1
+
+    def finished(self, rid: str, status: str = "finished") -> None:
+        r = self.requests[rid]
+        r.finished = self._clock()
+        r.status = status
+
+    # ------------------------------------------------------------------ #
+    # engine iterations                                                  #
+    # ------------------------------------------------------------------ #
+
+    def step(self, kind: str, active_slots: int, num_slots: int) -> None:
+        if kind == "prefill":
+            self.prefill_steps += 1
+        else:
+            self.decode_steps += 1
+        self.occupied_slot_steps += active_slots
+        self.total_slot_steps += num_slots
+
+    def drained(self, unfinished: int) -> None:
+        self.drains += 1
+        self.preempted_requests += unfinished
+
+    # ------------------------------------------------------------------ #
+    # snapshot                                                           #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def engine_steps(self) -> int:
+        return self.prefill_steps + self.decode_steps
+
+    @property
+    def occupancy(self) -> float:
+        """Mean fraction of slot-steps doing useful work."""
+        if self.total_slot_steps == 0:
+            return 0.0
+        return self.occupied_slot_steps / self.total_slot_steps
+
+    def snapshot(self) -> Dict[str, Any]:
+        """A plain-dict view: engine aggregates + per-request rows."""
+        now = self._clock()
+        elapsed = max(now - self.started, 1e-9)
+        per_request: List[Dict[str, Any]] = []
+        for r in self.requests.values():
+            per_request.append({
+                "rid": r.rid,
+                "status": r.status,
+                "tokens": r.tokens,
+                "queue_wait": r.queue_wait,
+                "ttft": r.ttft,
+                "tpot": r.tpot,
+            })
+        return {
+            "engine_steps": self.engine_steps,
+            "prefill_steps": self.prefill_steps,
+            "decode_steps": self.decode_steps,
+            "tokens_out": self.tokens_out,
+            "tokens_per_sec": self.tokens_out / elapsed,
+            "tokens_per_step": (
+                self.tokens_out / self.engine_steps
+                if self.engine_steps else 0.0
+            ),
+            "occupancy": self.occupancy,
+            "retries": self.retries,
+            "drains": self.drains,
+            "preempted_requests": self.preempted_requests,
+            "requests": per_request,
+        }
+
+
+__all__ = ["RequestTimes", "ServingMetrics"]

@@ -945,3 +945,73 @@ def _ranked(candidates: List[Candidate], budget: int) -> TuneReport:
         )
     )
     return TuneReport(candidates=candidates, hbm_budget_bytes=budget)
+
+
+# --------------------------------------------------------------------- #
+# serving: KV-cache pool accounting                                     #
+# --------------------------------------------------------------------- #
+
+
+def serving_cache_bytes(
+    cfg: Any,
+    num_slots: int,
+    max_len: int,
+    *,
+    kv_quant: bool = False,
+    dtype: Optional[Any] = None,
+) -> int:
+    """Bytes of a ``(num_slots, max_len)`` serving KV-cache pool — the
+    same ``eval_shape``-only accounting the training-side probes use (no
+    allocation, no compile): the pool is laid out by
+    ``models.generation.init_cache`` / ``init_quant_cache``, so this IS
+    the HBM the pool will pin, not an estimate."""
+    from torchgpipe_tpu.models.generation import init_cache, init_quant_cache
+
+    if kv_quant:
+        spec = jax.eval_shape(
+            lambda: init_quant_cache(cfg, num_slots, max_len)
+        )
+    else:
+        spec = jax.eval_shape(
+            lambda: init_cache(cfg, num_slots, max_len, dtype=dtype)
+        )
+    return tree_bytes(spec)
+
+
+def serving_max_slots(
+    cfg: Any,
+    max_len: int,
+    hbm_budget_bytes: int,
+    *,
+    kv_quant: bool = False,
+    dtype: Optional[Any] = None,
+    param_bytes: int = 0,
+    overhead_bytes: int = 0,
+    donated: bool = False,
+) -> int:
+    """Largest slot count whose KV pool fits ``hbm_budget_bytes`` after
+    ``param_bytes`` (the resident weights — ``tree_bytes(params)``) and
+    ``overhead_bytes`` (allocator reserve / program temps) are set aside.
+    The serving engine sizes its pool AND caps active slots at this
+    value: admitting a request can never grow an array, so a pool built
+    to this count is the entire memory-safety story.  Without donation
+    (``donated=False``, the engine default — donated buffers cannot be
+    retried on transient failures) a compiled step holds the input and
+    output cache buffers simultaneously, so the pool is accounted TWICE;
+    ``donated=True`` accounts the single aliased copy.  Returns 0 when
+    even one slot does not fit (the caller should refuse to build)."""
+    one = serving_cache_bytes(
+        cfg, 1, max_len, kv_quant=kv_quant, dtype=dtype
+    )
+    two = serving_cache_bytes(
+        cfg, 2, max_len, kv_quant=kv_quant, dtype=dtype
+    )
+    per_slot = two - one          # bytes strictly linear in slots
+    fixed = one - per_slot        # the shared scalar bookkeeping
+    copies = 1 if donated else 2  # non-donated steps double-buffer
+    avail = (
+        hbm_budget_bytes - param_bytes - overhead_bytes - copies * fixed
+    )
+    if per_slot <= 0 or avail <= 0:
+        return 0
+    return int(avail // (copies * per_slot))
