@@ -870,8 +870,25 @@ def _decode_serving_entry() -> None:
     serving_main()
 
 
+def _plan_validate_entry() -> None:
+    """The ``plan-validate`` rung: predicted-vs-measured rank-order check
+    of the static planner on the CPU tiny-llama preset
+    (benchmarks/plan_validate.py — the recompute axis, whose work
+    differences a serialized CPU host CAN measure).  Emits one JSON line
+    and exits non-zero when the planner's predicted best-to-worst order
+    disagrees with the measured fastest-to-slowest order::
+
+        env JAX_PLATFORMS=cpu python bench.py --plan-validate
+    """
+    from benchmarks.plan_validate import main as plan_validate_main
+
+    raise SystemExit(plan_validate_main())
+
+
 if __name__ == "__main__":
-    if "--decode-serving" in sys.argv:
+    if "--plan-validate" in sys.argv:
+        _plan_validate_entry()
+    elif "--decode-serving" in sys.argv:
         _decode_serving_entry()
     elif "--child" in sys.argv:
         _child_entry()

@@ -1,0 +1,148 @@
+"""Predicted-vs-measured rank-order validation of the static planner.
+
+The planner (:mod:`torchgpipe_tpu.analysis.planner`) promises its
+predicted-MFU RANKING is trustworthy without ever timing a device.  This
+rung closes the loop on hardware anyone has: on the CPU tiny-llama
+preset it builds the three checkpoint-mode candidates whose measured
+step time differs by REAL work (recompute — ``never`` replays nothing,
+``except_last`` replays ``m-1`` of ``m`` micro-batches, ``always`` all
+of them; at ``chunks=2`` the expected time ratios are 1 : 1.17 : 1.33,
+far above CPU timing noise), measures each with blocking steps, and
+checks that the measured fastest-to-slowest order matches the planner's
+predicted best-to-worst order.
+
+Schedule-bubble predictions are deliberately NOT validated here: a
+single CPU host serializes the per-cell schedule, so bubble structure
+never reaches the wall clock — only total executed work does.  The
+recompute axis is exactly that.
+
+Emits one JSON line (the bench contract) and exits non-zero on a rank
+mismatch::
+
+    env JAX_PLATFORMS=cpu python bench.py --plan-validate
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Tuple
+
+# The validated axis: checkpoint modes at chunks=2 (work ratios
+# 1 : 7/6 : 4/3 — every adjacent gap is >= 14%).
+MODES = ("never", "except_last", "always")
+CHUNKS = 2
+
+
+def _build(mode: str) -> Tuple[Any, Any, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.llama_speed import PRESETS
+    from torchgpipe_tpu.gpipe import GPipe
+    from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+
+    dim, n_layers, n_heads, n_kv, vocab, mlp_ratio = PRESETS["tiny"]
+    cfg = TransformerConfig(
+        vocab=vocab, dim=dim, n_layers=n_layers, n_heads=n_heads,
+        n_kv_heads=n_kv, mlp_ratio=mlp_ratio,
+    )
+    layers = llama(cfg)
+    n_stages = 2
+    base, rem = len(layers) // n_stages, len(layers) % n_stages
+    balance = [
+        base + (1 if j >= n_stages - rem else 0) for j in range(n_stages)
+    ]
+    model = GPipe(layers, balance=balance, chunks=CHUNKS, checkpoint=mode)
+    x = jnp.zeros((8, 128), jnp.int32)
+    return model, x, cfg
+
+
+def _measure(model: Any, x: Any, steps: int = 5) -> float:
+    """Median per-step seconds with per-step blocking (no async loop can
+    over-report) after one compile warmup."""
+    import jax
+
+    from torchgpipe_tpu.models.transformer import cross_entropy
+
+    def loss_fn(out: Any, tok: Any) -> Any:
+        return cross_entropy(out[:, :-1, :], tok[:, 1:])
+
+    in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    rng = jax.random.PRNGKey(1)
+    loss, grads, state, _ = model.value_and_grad(
+        params, state, x, x, loss_fn, rng=rng
+    )
+    jax.block_until_ready((loss, grads))
+    times: List[float] = []
+    for i in range(steps):
+        t0 = time.perf_counter()
+        loss, grads, _, _ = model.value_and_grad(
+            params, state, x, x, loss_fn, rng=jax.random.fold_in(rng, i)
+        )
+        jax.block_until_ready((loss, grads))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def run(steps: int = 5) -> Dict[str, Any]:
+    """Plan, measure, compare.  Returns the result record (bench JSON)."""
+    import jax
+
+    from torchgpipe_tpu.analysis import planner
+
+    model0, x, _ = _build(MODES[0])
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    report = planner.plan(
+        model0, spec, hbm_budget_bytes=64 * 2 ** 30,
+        chunks_options=(CHUNKS,),
+        balance_options=[model0.balance],
+    )
+    scored = {
+        p.checkpoint: p for p in report.candidates
+        if p.schedule == "gpipe" and p.checkpoint in MODES
+        and p.predicted_mfu is not None
+    }
+    missing = [m for m in MODES if m not in scored]
+    if missing:
+        raise RuntimeError(f"planner scored no candidate for {missing}")
+    predicted = sorted(
+        MODES, key=lambda m: -(scored[m].predicted_mfu or 0.0)
+    )
+    measured_times = {}
+    for mode in MODES:
+        model, x, _ = _build(mode)
+        measured_times[mode] = _measure(model, x, steps=steps)
+    measured = sorted(MODES, key=lambda m: measured_times[m])
+    match = predicted == measured
+    return {
+        "metric": "plan-validate rank-order [tiny llama, cpu]",
+        "value": 1.0 if match else 0.0,
+        "unit": "match",
+        "platform": "cpu",
+        "validated": True,  # per-step blocking cannot over-report
+        "match": match,
+        "predicted_order": predicted,
+        "measured_order": measured,
+        "predicted_mfu": {
+            m: round(scored[m].predicted_mfu or 0.0, 4) for m in MODES
+        },
+        "measured_step_s": {
+            m: round(measured_times[m], 4) for m in MODES
+        },
+    }
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    result = run()
+    print(json.dumps(result), flush=True)
+    return 0 if result["match"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -564,3 +564,49 @@ def test_jaxpr_utils_is_a_pure_shim():
 
     for name in shim.__all__:
         assert getattr(shim, name) is getattr(core, name)
+
+
+# --------------------------------------------------------------------- #
+# plan-drift (the planner's lint rule; see tests/test_planner.py for    #
+# the planner itself)                                                   #
+# --------------------------------------------------------------------- #
+
+
+def _driftable_model(**kw):
+    layers = named([dense(16, name="fc1"), gelu("a1"),
+                    dense(16, name="fc2"), dense(8, name="head")])
+    return GPipe(layers, balance=[2, 2], chunks=2, **kw)
+
+
+def test_plan_drift_fires_on_stale_config():
+    # The seeded drift: full recompute at 2 chunks when the certified
+    # top plan under this budget is no-recompute at more chunks — well
+    # past the 10% MFU threshold.
+    model = _driftable_model(checkpoint="always",
+                             hbm_budget_bytes=64 * 2 ** 30)
+    found = _by_rule(
+        analysis.lint(model, X, target=Y, loss_fn=mse,
+                      rules=["plan-drift"]),
+        "plan-drift",
+    )
+    assert found and found[0].severity == Severity.WARNING
+    assert "certified top plan" in found[0].message
+    assert "apply_plan" in found[0].message  # the fix is named in the message
+
+
+def test_plan_drift_clean_after_apply_plan():
+    from torchgpipe_tpu.analysis import planner
+
+    model = _driftable_model(checkpoint="always",
+                             hbm_budget_bytes=64 * 2 ** 30)
+    report = planner.plan(model, X, hbm_budget_bytes=64 * 2 ** 30)
+    fixed = planner.apply_plan(model, report.best)
+    assert fixed.hbm_budget_bytes == 64 * 2 ** 30
+    assert analysis.lint(fixed, X, target=Y, loss_fn=mse,
+                         rules=["plan-drift"]) == []
+
+
+def test_plan_drift_stands_down_without_declared_budget():
+    model = _driftable_model(checkpoint="always")  # no hbm_budget_bytes
+    assert analysis.lint(model, X, target=Y, loss_fn=mse,
+                         rules=["plan-drift"]) == []

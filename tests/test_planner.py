@@ -1,0 +1,540 @@
+"""Joint static planner tests (analysis.planner).
+
+Covers the PR-6 contract end to end: the cost-model extensions to the
+jaxpr walker (bounded ``while`` loops, ``custom_vjp`` call primitives —
+each with its broken twin showing what the old convention read), the
+event-graph makespan/bubble scoring, the analytic ``balance_by_flops``
+cut, the certified frontier itself (every emitted plan passed the
+ordering rules AND the memory certification, whose numbers must match
+``tune.mpmd_stage_memory_profile`` exactly), the one-call
+``apply_plan`` handoff, and the CLI exit codes of
+``tools/plan_report.py`` / the ``plan-verify`` step in
+``tools/ci_lint.py``.  The predicted-vs-measured rank-order rung
+(``bench.py --plan-validate``) runs slow-marked via
+``benchmarks.plan_validate.run``.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchgpipe_tpu import GPipe, SpmdGPipe, make_mesh
+from torchgpipe_tpu.analysis import events as ev
+from torchgpipe_tpu.analysis import planner
+from torchgpipe_tpu.analysis import schedule as sched
+from torchgpipe_tpu.analysis.jaxpr import (
+    CUSTOM_CALL_PRIMS,
+    flops_estimate,
+    while_trip_bound,
+)
+from torchgpipe_tpu.balance import balance_by_flops, balance_cost, layer_flops
+from torchgpipe_tpu.layers import chain, named
+from torchgpipe_tpu.ops import dense, gelu, layer_norm
+
+
+def mse(out, tgt):
+    return jnp.mean((out - tgt) ** 2)
+
+
+X = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+Y = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+
+
+def _mpmd_model(checkpoint="always", chunks=2, balance=(2, 2), **kw):
+    layers = named([dense(16, name="fc1"), gelu("a1"),
+                    dense(16, name="fc2"), dense(8, name="head")])
+    return GPipe(layers, balance=list(balance), chunks=chunks,
+                 checkpoint=checkpoint, **kw)
+
+
+# --------------------------------------------------------------------- #
+# cost-model extensions: while trip bounds + custom_vjp call primitives #
+# --------------------------------------------------------------------- #
+
+
+def test_flops_while_bounded_multiplies_by_trip_bound():
+    """Broken twin: the old convention counted EVERY while body once, so
+    a 7-iteration bounded-decode loop read 1/7 of its real work.  Fixed:
+    the bound is recovered from the cond's literal comparison."""
+
+    def f(x):
+        def cond(c):
+            i, _ = c
+            return i < 7
+
+        def body(c):
+            i, v = c
+            return i + 1, v @ v
+
+        return jax.lax.while_loop(cond, body, (0, x))
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((4, 4)))
+    (while_eqn,) = [e for e in jaxpr.jaxpr.eqns
+                    if e.primitive.name == "while"]
+    assert while_trip_bound(while_eqn) == 7
+    body_flops = 2 * 4 * 4 * 4  # one 4x4 @ 4x4 matmul
+    assert flops_estimate(jaxpr) == 7 * body_flops  # not 1 * body_flops
+
+
+def test_flops_while_unbounded_counts_body_once():
+    """No literal bound in the cond (the limit is a traced value): the
+    walker falls back to XLA's count-once convention, never zero."""
+
+    def f(x, limit):
+        def cond(c):
+            i, _ = c
+            return i < limit
+
+        def body(c):
+            i, v = c
+            return i + 1, v @ v
+
+        return jax.lax.while_loop(cond, body, (0, x))
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((4, 4)), 100)
+    assert flops_estimate(jaxpr) == 2 * 4 * 4 * 4
+
+
+def test_flops_custom_vjp_counts_one_executed_body():
+    """Broken twin: custom_vjp call primitives were unhandled, so their
+    matmuls read 0 — planner costs on flash-attention graphs silently
+    vanished.  Fixed: the ONE executed body is counted (max over the
+    param sub-jaxprs, never the sum — fwd carries a residual-saving
+    variant of the same body)."""
+
+    @jax.custom_vjp
+    def g(x):
+        return x @ x
+
+    def g_fwd(x):
+        return x @ x, x
+
+    def g_bwd(x, ct):
+        return (ct @ x.T + x.T @ ct,)
+
+    g.defvjp(g_fwd, g_bwd)
+
+    one_matmul = 2 * 4 * 4 * 4
+    jaxpr = jax.make_jaxpr(g)(jnp.ones((4, 4)))
+    prims = {e.primitive.name for e in jaxpr.jaxpr.eqns}
+    assert prims & set(CUSTOM_CALL_PRIMS), prims
+    assert flops_estimate(jaxpr) == one_matmul  # was 0
+
+    grad_jaxpr = jax.make_jaxpr(jax.grad(lambda x: jnp.sum(g(x))))(
+        jnp.ones((4, 4))
+    )
+    # fwd body + the two backward matmuls — nothing double-counted.
+    assert flops_estimate(grad_jaxpr) == 3 * one_matmul
+
+
+# --------------------------------------------------------------------- #
+# event-graph scoring: makespan + bubble fraction                       #
+# --------------------------------------------------------------------- #
+
+
+def test_bubble_fraction_fill_drain_closed_form():
+    n, m = 4, 8
+    g = ev.spmd_fill_drain_events(n, m, 0)
+    cost = lambda e: 1.0 if e.phase in (ev.FWD, ev.BWD) else 0.0  # noqa: E731
+    span, busy = ev.makespan(g, cost)
+    assert span == 2 * (m + n - 1)
+    assert busy == [2.0 * m] * n
+    assert ev.bubble_fraction(g, cost) == pytest.approx((n - 1) / (m + n - 1))
+
+
+def test_makespan_rejects_cyclic_schedule():
+    g = ev.spmd_fill_drain_events(2, 2, 0)
+    a, b = g.order[0][0], g.order[0][1]
+    g.deps.append((b, a))  # back-edge against the rank order: a cycle
+    with pytest.raises(ValueError, match="cycle"):
+        ev.makespan(g, lambda e: 1.0)
+
+
+# --------------------------------------------------------------------- #
+# analytic balancing: layer_flops / balance_by_flops                    #
+# --------------------------------------------------------------------- #
+
+
+def test_balance_by_flops_splits_fat_layers(monkeypatch):
+    import torchgpipe_tpu.balance as bal
+    import torchgpipe_tpu.balance.profile as prof
+
+    def _no_probe(*a, **k):  # pragma: no cover - must never run
+        raise AssertionError("balance_by_flops must not touch a device")
+
+    monkeypatch.setattr(prof, "profile_times", _no_probe)
+    monkeypatch.setattr(prof, "profile_sizes", _no_probe)
+    monkeypatch.setattr(bal, "profile_times", _no_probe)
+    monkeypatch.setattr(bal, "profile_sizes", _no_probe)
+
+    from torchgpipe_tpu.ops import relu
+
+    layers = [dense(512, name="fat0"), relu("r0"), dense(8, name="thin"),
+              dense(512, name="fat1"), relu("r1"), dense(8, name="out")]
+    sample = jax.ShapeDtypeStruct((16, 512), jnp.float32)
+    costs = layer_flops(layers, sample)
+    assert len(costs) == 6
+    assert costs[1] == 0.0 and costs[4] == 0.0  # elementwise glue is free
+    assert costs[0] > 10 * costs[2]  # the fat matmuls dominate
+    balance = balance_by_flops(2, layers, sample)
+    assert balance == balance_cost(costs, 2)
+    # The two fat layers must land on different stages.
+    assert balance[0] <= 3  # [fat0, ...] | [..., fat1, ...]
+
+
+# --------------------------------------------------------------------- #
+# MPMD planning: certified frontier, exact memory match, apply_plan     #
+# --------------------------------------------------------------------- #
+
+
+def test_mpmd_frontier_certified_and_ranked():
+    model = _mpmd_model(checkpoint="always", chunks=2)
+    report = planner.plan(model, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2, 4),
+                          balance_options=[model.balance])
+    assert report.candidates
+    best = report.best
+    assert best is not None and best.feasible and best.certified
+    # Ranking: feasible-and-certified first, best predicted MFU first.
+    ok = [p for p in report.candidates if p.feasible and p.certified]
+    assert report.candidates[: len(ok)] == ok
+    mfus = [p.predicted_mfu for p in ok if p.predicted_mfu is not None]
+    assert mfus == sorted(mfus, reverse=True)
+
+    def pick(mode, chunks):
+        return next(p for p in report.candidates
+                    if p.checkpoint == mode and p.chunks == chunks
+                    and p.schedule == "gpipe")
+
+    # Physics of the ranking: recompute costs MFU, more chunks less
+    # bubble, and 'always' stores less than 'never'.
+    assert pick("never", 2).predicted_mfu > pick("always", 2).predicted_mfu
+    assert pick("never", 4).predicted_mfu > pick("never", 2).predicted_mfu
+    assert pick("always", 2).hwm_bytes < pick("never", 2).hwm_bytes
+    assert pick("never", 2).bubble_fraction > pick("never", 4).bubble_fraction
+    # The report renders every candidate.
+    table = report.table()
+    assert "pred-mfu" in table and "never" in table and "offload" in table
+
+
+@pytest.mark.parametrize("ckpt", ["always", "except_last", "never"])
+def test_mpmd_plan_memory_matches_tune_profile_exactly(ckpt):
+    """The planner's certified HWM is the event-graph liveness analysis
+    weighted with tune.mpmd_stage_memory_profile's eval_shape bytes —
+    assert the STRONG form: bit-for-bit equality with an independent
+    reconstruction, not a tolerance."""
+    from torchgpipe_tpu import tune
+    from torchgpipe_tpu.checkpoint import checkpoint_stop
+
+    model = _mpmd_model(checkpoint="always", chunks=2)
+    report = planner.plan(model, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2,),
+                          balance_options=[model.balance])
+    p = next(c for c in report.candidates
+             if c.schedule == "gpipe" and c.checkpoint == ckpt)
+    assert p.certified
+
+    variant = _mpmd_model(checkpoint=ckpt, chunks=2)
+    resid_b, saved_b, out_b = tune.mpmd_stage_memory_profile(variant, X)
+    g = ev.mpmd_fill_drain_events(
+        len(model.balance), 2, checkpoint_stop(ckpt, 2, train=True)
+    )
+
+    def bytes_of(buf):
+        if buf.kind == "resid":
+            return resid_b[buf.stage]
+        if buf.kind == "saved":
+            return saved_b[buf.stage]
+        if buf.kind == "out":
+            return out_b
+        return 0
+
+    cert = sched.certify_memory(g, bytes_of)
+    assert p.hwm_bytes == cert.high_water + tune.DEFAULT_OVERHEAD_BYTES
+
+
+def test_mpmd_plan_includes_analytic_balance_cut():
+    """A deliberately lopsided pipe: the planner must also score the
+    balance_by_flops cut and rank it above the bad one."""
+    layers = named([dense(16, name="fc1"), gelu("a1"),
+                    dense(16, name="fc2"), dense(16, name="fc3"),
+                    dense(8, name="head")])
+    model = GPipe(layers, balance=[1, 4], chunks=2, checkpoint="always")
+    report = planner.plan(model, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2,))
+    balances = {p.balance for p in report.candidates}
+    assert (1, 4) in balances and len(balances) >= 2
+    analytic = next(b for b in balances if b != (1, 4))
+    assert analytic == (3, 2)  # fc1+gelu+fc2 | fc3+head balances the flops
+    best_of = {
+        b: max(p.predicted_mfu for p in report.candidates
+               if p.balance == b and p.predicted_mfu is not None)
+        for b in ((1, 4), analytic)
+    }
+    assert best_of[analytic] > best_of[(1, 4)]
+    assert report.best.balance == analytic
+
+
+def test_plan_is_probe_free(monkeypatch):
+    """Acceptance criterion: zero device-time probes — the profiling
+    lineage must be unreachable from plan()."""
+    import torchgpipe_tpu.balance as bal
+    import torchgpipe_tpu.balance.profile as prof
+
+    def _no_probe(*a, **k):  # pragma: no cover - must never run
+        raise AssertionError("plan() must never run a device probe")
+
+    for mod in (prof, bal):
+        monkeypatch.setattr(mod, "profile_times", _no_probe)
+        monkeypatch.setattr(mod, "profile_sizes", _no_probe)
+
+    model = _mpmd_model(chunks=2)
+    report = planner.plan(model, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2,),
+                          balance_options=[model.balance])
+    assert report.best is not None
+
+
+def test_apply_plan_mpmd_round_trip():
+    model = _mpmd_model(checkpoint="always", chunks=2,
+                        hbm_budget_bytes=64 << 30)
+    report = planner.plan(model, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2, 4),
+                          balance_options=[model.balance])
+    best = report.best
+    applied = planner.apply_plan(model, best)
+    assert isinstance(applied, GPipe)
+    assert applied.schedule == best.schedule
+    assert applied.checkpoint == best.checkpoint
+    assert applied.chunks == best.chunks
+    assert tuple(applied.balance) == best.balance
+    assert applied.hbm_budget_bytes == 64 << 30  # budget rides along
+    # verify_plan: the applied engine's OWN event graph passes the same
+    # ordering/donation/equivalence rules analysis.lint enforces.
+    assert planner.verify_plan(model, best) == []
+
+
+def test_apply_plan_engine_mismatch_raises(cpu_devices):
+    model = _mpmd_model(chunks=2)
+    report = planner.plan(model, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2,),
+                          balance_options=[model.balance])
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")], name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    spmd = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse)
+    with pytest.raises(TypeError, match="mpmd plan"):
+        planner.apply_plan(spmd, report.best)
+
+
+def test_mpmd_1f1b_pipe_can_replan_onto_gpipe():
+    """Regression: re-planning a 1f1b pipe onto gpipe must not leak
+    loss_reduction into the fill-drain constructor (which rejects it)."""
+    model = _mpmd_model(checkpoint="always", chunks=2, schedule="1f1b",
+                        loss_reduction="mean")
+    report = planner.plan(model, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2,),
+                          balance_options=[model.balance])
+    by_sched = {p.schedule for p in report.candidates if p.certified}
+    assert {"gpipe", "1f1b"} <= by_sched
+    gpipe_best = next(p for p in report.candidates
+                      if p.schedule == "gpipe" and p.certified)
+    applied = planner.apply_plan(model, gpipe_best)
+    assert applied.schedule == "gpipe" and applied.loss_reduction is None
+
+
+# --------------------------------------------------------------------- #
+# SPMD planning                                                         #
+# --------------------------------------------------------------------- #
+
+
+def test_spmd_frontier_and_apply(cpu_devices):
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")], name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="always")
+    report = planner.plan(pipe, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2, 4))
+    best = report.best
+    assert best is not None and best.feasible and best.certified
+    # All three re-plannable schedules were scored.
+    assert {"fill_drain", "1f1b", "zb"} <= {
+        p.schedule for p in report.candidates
+    }
+    # Named-save presets rode along on the remat'd mode.
+    assert any(p.policy == "save_attn_out" for p in report.candidates)
+    applied = planner.apply_plan(pipe, best)
+    assert isinstance(applied, SpmdGPipe)
+    assert applied.schedule == best.schedule
+    assert applied.checkpoint == best.checkpoint
+    assert applied.chunks == best.chunks
+    assert planner.verify_plan(pipe, best) == []
+
+
+def test_spmd_over_budget_candidates_are_rejected_not_dropped(cpu_devices):
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")], name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse)
+    report = planner.plan(pipe, X, hbm_budget_bytes=1, chunks_options=(2,))
+    assert report.best is None
+    assert report.candidates  # scored and visible, just infeasible
+    assert all(not p.feasible for p in report.candidates)
+    assert any("budget" in p.reason for p in report.candidates)
+
+
+# --------------------------------------------------------------------- #
+# CLI exit codes: tools/plan_report.py + the plan-verify ci_lint step   #
+# --------------------------------------------------------------------- #
+
+
+def test_plan_report_cli_rejects_unknown_preset(capsys):
+    from tools.plan_report import main
+
+    assert main(["--preset", "nope", "--chunks", "2"]) == 2
+    assert "unknown preset" in capsys.readouterr().err
+
+
+@pytest.mark.slow  # full tiny-llama searches (traced jaxprs, no device)
+def test_plan_report_cli_exit_codes(capsys):
+    from tools.plan_report import main
+
+    argv = ["--preset", "tiny", "--seq", "64", "--batch", "4",
+            "--stages", "4", "--chunks", "2"]
+    assert main(argv + ["--budget-gib", "64", "--verify"]) == 0
+    out = capsys.readouterr().out
+    assert "best:" in out and "plan-verify: top plan clean" in out
+    # The contract the CI gate relies on: NO candidate fits -> non-zero.
+    assert main(argv + ["--budget-gib", "0.0001"]) == 1
+    assert "NO certified candidate" in capsys.readouterr().err
+
+
+def test_ci_lint_wires_the_plan_gate():
+    """--skip-plan exists and skipping every gate is clean (wiring)."""
+    from tools.ci_lint import main
+
+    assert main(["--skip-typegate", "--skip-schedule", "--skip-pipeline",
+                 "--skip-serving", "--skip-plan"]) == 0
+
+
+@pytest.mark.slow  # subprocess: the real plan-verify gate on 2 presets
+def test_ci_lint_plan_verify_gate_passes():
+    from tools.ci_lint import main
+
+    assert main(["--skip-typegate", "--skip-schedule", "--skip-pipeline",
+                 "--skip-serving"]) == 0
+
+
+# --------------------------------------------------------------------- #
+# predicted-vs-measured rank order (the bench.py --plan-validate rung)  #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow  # compiles + times 3 tiny-llama training variants
+def test_predicted_rank_order_matches_measured():
+    """The acceptance rung, run exactly as the bench contract ships it:
+    a clean single-device subprocess.  (In-process under the test
+    harness the 8-virtual-device CPU split overlaps the per-cell MPMD
+    dispatch and compresses the recompute gaps below timing noise —
+    the rung's contract is the one-device serialized measurement, where
+    the never : except_last : always work ratios 1 : 7/6 : 4/3 dominate
+    the clock.)"""
+    import json
+    import pathlib
+    import subprocess
+    import sys
+
+    from benchmarks.plan_validate import MODES
+
+    from tests.subproc_env import REPO, cpu_subproc_env
+
+    assert len(MODES) >= 3  # the >=3-candidate contract
+    proc = subprocess.run(
+        [sys.executable, str(pathlib.Path(REPO) / "bench.py"),
+         "--plan-validate"],
+        env=cpu_subproc_env(), capture_output=True, text=True,
+        timeout=540, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["match"], (
+        f"planner predicted {result['predicted_order']} but measured "
+        f"{result['measured_order']} ({result['measured_step_s']})"
+    )
+    assert result["predicted_order"] == result["measured_order"]
+    assert result["predicted_order"] == list(MODES)  # never wins on work
+
+
+# --------------------------------------------------------------------- #
+# review regressions: policy-label resolution + indivisible batches     #
+# --------------------------------------------------------------------- #
+
+
+def test_spmd_policy_resolves_to_preset_names(cpu_devices):
+    """NamedSavePolicy.label is a display string ("save:attn_out"), not
+    the planner's preset vocabulary ("save_attn_out") — the drift rule's
+    config key must resolve through the canonical candidate space, and
+    custom policies must map to a sentinel no candidate carries (rule
+    stands down instead of mis-keying onto the plain-'always' plan)."""
+    from torchgpipe_tpu.checkpoint import policies
+
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")], name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+
+    def build(**kw):
+        return SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse, **kw)
+
+    cases = [
+        (build(checkpoint="always"), None),
+        (build(checkpoint="always", remat_policy=policies.save_attn_out),
+         "save_attn_out"),
+        (build(checkpoint="always", remat_policy=policies.dots_no_batch),
+         "dots_no_batch"),
+        (build(checkpoint="offload"), "offload_default"),
+    ]
+    for pipe, expect in cases:
+        assert planner._spmd_policy_label(pipe) == expect, (
+            pipe.checkpoint, pipe.remat_policy, expect,
+        )
+    custom = build(checkpoint="always",
+                   remat_policy=policies.save_names("attn_out", "ce_logits"))
+    label = planner._spmd_policy_label(custom)
+    assert label.startswith("<custom:")
+    assert label not in {lbl for _, lbl, _ in planner.spmd_remat_space(custom)}
+
+
+def test_spmd_applied_plan_with_policy_is_drift_clean(cpu_devices):
+    """End to end: apply a plan that CARRIES a named-save policy; the
+    drift rule must recognize the applied pipe as its own top plan
+    (before the label fix it mis-keyed the policy and warned the user to
+    apply the plan they had already applied)."""
+    from torchgpipe_tpu import analysis
+
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")], name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="always", hbm_budget_bytes=64 << 30)
+    report = planner.plan(pipe, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2, 4))
+    with_policy = next(
+        (p for p in report.candidates
+         if p.feasible and p.certified and p.policy is not None), None)
+    assert with_policy is not None
+    applied = planner.apply_plan(pipe, with_policy)
+    assert planner._config_of(applied) == (
+        with_policy.schedule, with_policy.checkpoint, with_policy.policy,
+        with_policy.chunks, None,
+    )
+    top = planner.apply_plan(pipe, report.best)
+    assert analysis.lint(top, X, rules=["plan-drift"]) == []
+
+
+def test_mpmd_indivisible_batch_yields_no_candidates():
+    """B=7 has no divisor in the sweep set: the old fallback scored
+    chunks=pipe.chunks on micro-batch shapes the engine never runs;
+    the honest answer is an empty frontier."""
+    assert planner.mpmd_chunk_options(7, None, 4) == []
+    model = _mpmd_model(chunks=4)
+    x7 = jax.ShapeDtypeStruct((7, 16), jnp.float32)
+    report = planner.plan(model, x7, hbm_budget_bytes=64 << 30)
+    assert report.best is None and report.candidates == []
+    # An explicit user override is honored as-given.
+    assert planner.mpmd_chunk_options(7, (7,), 4) == [7]

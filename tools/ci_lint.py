@@ -27,10 +27,17 @@ differently and must not share backend state):
    engine's steady-state compile contract: both compiled step programs
    (fp and int8-kv pools) trace abstractly, carry no host callbacks,
    and stay at ONE signature each over a shape-churn request grid
-   (``recompilation-hazard`` must be clean; docs/serving.md).
+   (``recompilation-hazard`` must be clean; docs/serving.md);
+5. ``tools/plan_report.py --ci`` (plan-verify) — the joint static
+   planner (``analysis.planner``) searches balance × schedule × chunks
+   × remat for the fast llama presets and re-runs the event-graph
+   verifier (ordering + donation + engine equivalence) on each preset's
+   TOP plan: the plan the planner would hand a user must itself verify
+   clean (docs/analysis.md, planner section).
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
-/ ``--skip-serving`` to run a subset, ``-v`` for per-target reports.
+/ ``--skip-serving`` / ``--skip-plan`` to run a subset, ``-v`` for
+per-target reports.
 """
 
 from __future__ import annotations
@@ -60,6 +67,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-schedule", action="store_true")
     ap.add_argument("--skip-pipeline", action="store_true")
     ap.add_argument("--skip-serving", action="store_true")
+    ap.add_argument("--skip-plan", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -104,6 +112,11 @@ def main(argv: Sequence[str] | None = None) -> int:
         if args.verbose:
             cmd.append("-v")
         failures += _run("serve-verify", cmd) != 0
+    if not args.skip_plan:
+        cmd = [
+            sys.executable, str(REPO / "tools" / "plan_report.py"), "--ci",
+        ]
+        failures += _run("plan-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

@@ -50,9 +50,15 @@ from torchgpipe_tpu.analysis.trace import (
     trace_pipeline,
     trace_spmd,
 )
-from torchgpipe_tpu.analysis import events, schedule
+from torchgpipe_tpu.analysis import events, planner, schedule
 from torchgpipe_tpu.analysis import serving as serving_lint
-from torchgpipe_tpu.analysis.events import EventGraph, events_for
+from torchgpipe_tpu.analysis.events import (
+    EventGraph,
+    bubble_fraction,
+    events_for,
+    makespan,
+)
+from torchgpipe_tpu.analysis.planner import Plan, PlanReport, apply_plan
 from torchgpipe_tpu.analysis.serving import lint_serving
 from torchgpipe_tpu.analysis.schedule import (
     certify_memory,
@@ -70,8 +76,14 @@ __all__ = [
     "PipelineTrace",
     "TracedProgram",
     "EventGraph",
+    "Plan",
+    "PlanReport",
+    "apply_plan",
+    "bubble_fraction",
     "events",
     "events_for",
+    "makespan",
+    "planner",
     "schedule",
     "certify_memory",
     "verify_buffers",

@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 from typing import (
-    Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple,
+    Any, Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple,
 )
 
 # Phases.  "wgt" is zero-bubble's weight-gradient half of the split
@@ -645,6 +645,81 @@ def spmd_zb_events(n: int, m: int) -> EventGraph:
             g.add_consume(w, buf)
     _annotate_params(g)
     return g
+
+
+# --------------------------------------------------------------------- #
+# cost model: critical-path makespan + bubble fraction                  #
+# --------------------------------------------------------------------- #
+
+
+def makespan(
+    g: EventGraph, cost_of: Callable[[Event], float]
+) -> Tuple[float, List[float]]:
+    """Critical-path makespan of the schedule under per-event costs.
+
+    ``cost_of(event)`` is the event's duration in any consistent unit
+    (the planner passes analytic FLOPs, so the makespan is "flops of the
+    longest dependency chain" — divide by a chip's peak for seconds).
+    An event starts when its rank's previous event AND every dependency
+    / transport predecessor have finished; the makespan is the latest
+    finish.  Returns ``(makespan, per_rank_busy)`` where ``per_rank_busy``
+    sums each rank's own event costs — the schedule's bubble fraction is
+    ``1 - sum(busy) / (n_ranks * makespan)``.
+
+    Raises ``ValueError`` on a cyclic graph (run
+    :func:`torchgpipe_tpu.analysis.schedule.verify_ordering` first — a
+    deadlocked schedule has no makespan).
+    """
+    events = g.events()
+    succ: Dict[Event, List[Event]] = {}
+    indeg: Dict[Event, int] = {e: 0 for e in events}
+    edges: List[Tuple[Event, Event]] = []
+    for rank_order in g.order:
+        edges.extend(zip(rank_order, rank_order[1:]))
+    edges.extend(g.deps)
+    edges.extend((t.src, t.dst) for t in g.transfers if not t.lost)
+    for a, b in edges:
+        succ.setdefault(a, []).append(b)
+        indeg[b] = indeg.get(b, 0) + 1
+    finish: Dict[Event, float] = {}
+    ready = [e for e, d in indeg.items() if d == 0]
+    start: Dict[Event, float] = {e: 0.0 for e in ready}
+    done = 0
+    total = 0.0
+    while ready:
+        e = ready.pop()
+        done += 1
+        f = start.get(e, 0.0) + float(cost_of(e))
+        finish[e] = f
+        total = max(total, f)
+        for child in succ.get(e, []):
+            start[child] = max(start.get(child, 0.0), f)
+            indeg[child] -= 1
+            if indeg[child] == 0:
+                ready.append(child)
+    if done != len(events):
+        raise ValueError(
+            "makespan needs an acyclic schedule — the happens-before "
+            "relation has a cycle (verify_ordering reports it)"
+        )
+    busy = [
+        sum(float(cost_of(e)) for e in rank_order)
+        for rank_order in g.order
+    ]
+    return total, busy
+
+
+def bubble_fraction(
+    g: EventGraph, cost_of: Callable[[Event], float]
+) -> float:
+    """Idle fraction of the schedule under per-event costs: the share of
+    ``n_ranks × makespan`` no rank spends computing.  Fill-drain with
+    uniform cells gives the classic ``(n-1)/(m+n-1)``."""
+    span, busy = makespan(g, cost_of)
+    denom = g.n_ranks * span
+    if denom <= 0:
+        return 0.0
+    return max(0.0, 1.0 - sum(busy) / denom)
 
 
 # --------------------------------------------------------------------- #

@@ -240,15 +240,65 @@ def eqn_flops(eqn: Any) -> float:
     return 0.0
 
 
+def while_trip_bound(eqn: Any) -> Optional[int]:
+    """Static trip-count bound of a ``while`` equation, or None.
+
+    Bounded loops in this codebase follow one shape — a scalar integer
+    counter compared against a STATIC bound in the cond (the
+    bounded-decode loop of ``models.generation.generate(early_exit=True)``
+    conds on ``(n < max_new_tokens) & any(alive)``) — so the bound is
+    recoverable from the cond jaxpr: the largest integer Literal operand
+    of a scalar comparison.  Loops whose bound is a traced value (no
+    literal comparison) return None; callers fall back to counting the
+    body once (XLA's convention).
+    """
+    cond = eqn.params.get("cond_jaxpr")
+    if cond is None:
+        return None
+    body = cond.jaxpr if hasattr(cond, "jaxpr") else cond
+    bounds: List[int] = []
+    for ceqn in body.eqns:
+        if ceqn.primitive.name not in ("lt", "le", "gt", "ge"):
+            continue
+        for v in ceqn.invars:
+            val = getattr(v, "val", None)  # Literal operands carry .val
+            aval = getattr(v, "aval", None)
+            if (
+                val is not None
+                and aval is not None
+                and not getattr(aval, "shape", (1,))
+                and jnp.issubdtype(aval.dtype, jnp.integer)
+            ):
+                bounds.append(int(val))
+    return max(bounds) if bounds else None
+
+
+# The custom-call primitives whose params hold SEVERAL views of one
+# computation (fun_jaxpr + fwd/bwd thunks): summing every sub-jaxpr would
+# double-count the one body that actually executes.
+CUSTOM_CALL_PRIMS = (
+    "custom_vjp_call",
+    "custom_vjp_call_jaxpr",
+    "custom_jvp_call",
+    "custom_jvp_call_jaxpr",
+    "custom_lin",
+)
+
+
 def flops_estimate(jaxpr: Any) -> float:
     """Analytic matmul/conv FLOPs of a (possibly Closed) jaxpr with LOOP
     STRUCTURE respected: ``scan`` bodies multiply by their static
     ``length``, ``cond`` takes the max over branches (at runtime one
-    branch executes), ``while`` bodies count once (trip count unknown —
-    same convention as XLA's cost analysis, which counts EVERY loop body
-    once and SUMS cond branches; that convention undercounts pipelined
-    schedules and overcounts peeled tails, which is why the autotuner
-    uses this walker for scan-structured programs).
+    branch executes), ``while`` bodies multiply by the static trip bound
+    recovered from the cond's literal comparison
+    (:func:`while_trip_bound` — the bounded-decode loop convention) and
+    count once when no bound is recoverable, and ``custom_vjp``/
+    ``custom_jvp`` call primitives count their ONE executed body (the
+    max over the jaxpr views their params carry, never the sum).  XLA's
+    own cost analysis counts EVERY loop body once and SUMS cond
+    branches; that convention undercounts pipelined schedules and
+    bounded decode loops and overcounts peeled tails, which is why the
+    planner/autotuner use this walker for structured programs.
     """
     body = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
     total = 0.0
@@ -261,6 +311,11 @@ def flops_estimate(jaxpr: Any) -> float:
                 length = 1
             total += length * sum(flops_estimate(s) for s in subs)
         elif name == "cond":
+            total += max((flops_estimate(s) for s in subs), default=0.0)
+        elif name == "while":
+            bound = while_trip_bound(eqn)
+            total += (bound or 1) * sum(flops_estimate(s) for s in subs)
+        elif name in CUSTOM_CALL_PRIMS:
             total += max((flops_estimate(s) for s in subs), default=0.0)
         elif name == "pallas_call":
             # Kernel body runs once per grid cell.

@@ -1,0 +1,1003 @@
+"""Probe-free joint partition × schedule × remat planner, certified by
+the event-graph verifier.
+
+torchgpipe's balancing story is runtime profiling
+(``balance/profile.py``, the ``balance_by_time`` lineage of the paper):
+it costs real device time, its numbers vary with co-tenants, and it can
+only measure the ONE configuration it runs — the schedule × remat
+cross-product stays unexplored.  Everything a planner needs is
+statically knowable (BaPipe, arXiv:2012.12544; schedule scoring by
+bubble structure rather than measurement, arXiv:2412.14374), and this
+repo already holds both halves: analytic FLOPs
+(:func:`torchgpipe_tpu.analysis.jaxpr.flops_estimate` + ``tune.py``'s
+static step accounting) and the event-graph IR every shipped scheduler
+is rebuilt into (:mod:`torchgpipe_tpu.analysis.events` /
+:mod:`torchgpipe_tpu.analysis.schedule`).  :func:`plan` closes the loop:
+
+* **candidates** — balance cut (MPMD: the current cut plus the analytic
+  :func:`torchgpipe_tpu.balance.balance_by_flops` cut — per-layer costs
+  by abstract eval, ZERO device probes) × schedule (MPMD gpipe/1F1B;
+  SPMD fill-drain/1F1B/ZB, interleaved for pipes built interleaved) ×
+  micro-batch count × remat mode/policy (``offload`` included);
+* **scoring** — each candidate's schedule is rebuilt as an event graph
+  and scored by (a) predicted MFU from the static flop accounting
+  (cell-level fwd/bwd/recompute FLOPs from traced jaxprs, numerator from
+  the un-pipelined step — ``tune.py``'s conventions) over the graph's
+  critical-path makespan (:func:`torchgpipe_tpu.analysis.events.makespan`),
+  and (b) the bubble fraction read off the same graph;
+* **certification** — the memory-certification pass
+  (:func:`torchgpipe_tpu.analysis.schedule.certify_memory`) computes each
+  candidate's per-rank high-water mark from the graph's live intervals
+  (byte weights from ``eval_shape``, the same accounting
+  ``tune.mpmd_stage_memory_profile`` cross-checks), rejecting over-budget
+  candidates, and the deadlock/ordering rules
+  (:func:`torchgpipe_tpu.analysis.schedule.verify_ordering`) must pass —
+  every emitted plan is *certified*, not just estimated.
+
+One call applies the winner::
+
+    from torchgpipe_tpu.analysis import planner
+
+    report = planner.plan(pipe, batch, hbm_budget_bytes=15 << 30)
+    print(report.table())
+    pipe = planner.apply_plan(pipe, report.best)
+
+``tools/plan_report.py`` prints the frontier for the llama presets (and
+is the ``plan-verify`` CI gate); the ``plan-drift`` lint rule warns when
+a pipe declaring ``hbm_budget_bytes`` runs a configuration more than
+:data:`PLAN_DRIFT_THRESHOLD` below its certified top plan.
+
+Prediction model (auditable):
+
+* per-cell atoms ``fwd`` / ``bwd`` / ``bwd_remat`` are walker FLOPs of
+  the plain block forward, its vjp pullback, and the remat'd (policy-
+  wrapped) vjp — so each policy's recompute replay is measured from its
+  own traced jaxpr, not guessed;
+* a candidate's lane time is the event graph's critical-path makespan
+  under those per-event costs (fwd cells cost ``fwd``; backward cells
+  ``bwd`` plus the replay when their micro-batch is checkpointed;
+  zero-bubble's B/W split the backward) plus the per-lane epilogue
+  share;
+* ``predicted_mfu = model_flops / (n_chips × lane_time)`` — chip peak
+  cancels, the RANKING is hardware-independent; the MPMD ``offload``
+  mode carries ``tune.OFFLOAD_RANK_TAX`` until hardware numbers exist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from torchgpipe_tpu.analysis import events as ev
+from torchgpipe_tpu.analysis import schedule as sched
+from torchgpipe_tpu.analysis.diagnostics import Finding, Severity
+from torchgpipe_tpu.analysis.jaxpr import avalify, flops_estimate
+
+Pytree = Any
+
+GiB = 2 ** 30
+
+# A configured pipe whose predicted MFU trails its certified top plan by
+# more than this fraction triggers the plan-drift WARNING.
+PLAN_DRIFT_THRESHOLD = 0.10
+
+
+# --------------------------------------------------------------------- #
+# candidate enumeration — the canonical space (tune.py sweeps this too) #
+# --------------------------------------------------------------------- #
+
+# MPMD checkpoint modes, in tune.py's sweep order.
+MPMD_CHECKPOINT_SPACE: Tuple[str, ...] = (
+    "except_last", "offload", "never", "always",
+)
+
+
+def spmd_remat_space(pipe: Any) -> List[Tuple[str, Optional[str], Any]]:
+    """(checkpoint, policy-label, policy) candidates for an SPMD pipe:
+    the engine's four modes plus the named-save presets on the remat'd
+    mode — THE candidate axis ``tune.tune_step`` and :func:`plan` share.
+    """
+    del pipe  # the space is engine-wide today; kept for future narrowing
+    from torchgpipe_tpu.checkpoint import policies
+
+    return [
+        ("never", None, None),
+        ("except_last", None, None),
+        ("always", None, None),
+        ("always", "save_attn_out", policies.save_attn_out),
+        ("always", "save_block_outputs", policies.save_block_outputs),
+        ("always", "dots_no_batch", policies.dots_no_batch),
+        ("offload", "offload_default", None),
+    ]
+
+
+def spmd_chunk_options(
+    pipe: Any, batch_size: int, requested: Optional[Sequence[int]]
+) -> List[int]:
+    """Micro-batch counts to sweep: divisors of the per-(dp, ep) batch
+    drawn from {2, 4, 8, 16, 32, pipe.chunks}."""
+    if requested is not None:
+        return list(requested)
+    dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
+    ep = pipe.mesh.shape[pipe.ep_axis] if pipe.ep_axis else 1
+    per = batch_size // (dp * ep)
+    opts = sorted({
+        c for c in (2, 4, 8, 16, 32, pipe.chunks)
+        if c >= 1 and per % c == 0
+    })
+    return opts or [pipe.chunks]
+
+
+def mpmd_chunk_options(
+    batch_size: int, requested: Optional[Sequence[int]], default: int
+) -> List[int]:
+    """MPMD chunk candidates: divisors of the batch from
+    {2, 4, 8, 16, default}.  May be EMPTY (a batch with no divisor in
+    the set) — the scoring model sizes micro-batches as ``B // chunks``,
+    so a non-dividing fallback would certify shapes the engine never
+    runs; no candidates is the honest answer."""
+    if requested is not None:
+        return list(requested)
+    return sorted({
+        c for c in (2, 4, 8, 16, default)
+        if c >= 1 and batch_size % c == 0
+    })
+
+
+def spmd_schedule_space(pipe: Any) -> List[str]:
+    """Schedules an existing SPMD pipe can be re-planned onto WITHOUT
+    changing the model: a pipe built interleaved keeps its block
+    granularity (the v > 1 cut changes the model, so interleaved is
+    planned only where it already holds); the explicit-gradient
+    schedules need a micro-batch-decomposable loss."""
+    if pipe.virtual_stages != 1:
+        return ["interleaved"]
+    out = ["fill_drain"]
+    if pipe.loss_reduction in ("mean", "sum"):
+        out.extend(["1f1b", "zb"])
+    return out
+
+
+def remat_space_for(
+    pipe: Any, schedule: str
+) -> List[Tuple[str, Optional[str], Any]]:
+    """The remat axis restricted to what ``schedule`` supports: the
+    explicit-gradient schedules hand-write their recompute (no offload,
+    no named-save policies), and zero-bubble's split backward supports
+    only 'never'/'always'."""
+    space = spmd_remat_space(pipe)
+    if schedule == "fill_drain":
+        return space
+    modes = (
+        ("never", "always") if schedule == "zb"
+        else ("never", "except_last", "always")
+    )
+    return [
+        (mode, label, pol) for mode, label, pol in space
+        if mode in modes and label is None
+    ]
+
+
+# --------------------------------------------------------------------- #
+# plan + report                                                         #
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One scored-and-certified point of the joint search space."""
+
+    engine: str  # "spmd" | "mpmd"
+    schedule: str  # fill_drain|1f1b|zb|interleaved (spmd); gpipe|1f1b (mpmd)
+    balance: Optional[Tuple[int, ...]]  # MPMD layer cut; None for stacked SPMD
+    chunks: int
+    checkpoint: str
+    policy: Optional[str]  # preset label, None = engine default
+    virtual_stages: int
+    predicted_mfu: Optional[float]
+    bubble_fraction: Optional[float]
+    hwm_bytes: int  # certified per-rank device high-water mark (worst rank)
+    host_bytes: int  # host-offloaded bytes at the peak (checkpoint='offload')
+    feasible: bool
+    certified: bool  # ordering + memory certification both ran clean
+    reason: str = ""
+
+    def describe(self) -> str:
+        mfu = (
+            f"{self.predicted_mfu:.4f}"
+            if self.predicted_mfu is not None else "n/a"
+        )
+        bub = (
+            f"{self.bubble_fraction:.3f}"
+            if self.bubble_fraction is not None else "n/a"
+        )
+        bal = "x".join(str(b) for b in self.balance) if self.balance else "-"
+        status = (
+            ("ok" if self.certified else "UNCERTIFIED")
+            if self.feasible else f"REJECT ({self.reason})"
+        )
+        host = (
+            f" +{self.host_bytes / GiB:.2f} host" if self.host_bytes else ""
+        )
+        return (
+            f"{self.schedule:<11} {self.checkpoint:<12} "
+            f"{self.policy or '-':<20} m={self.chunks:<3} bal={bal:<9} "
+            f"mfu~{mfu:<8} bubble={bub:<6} "
+            f"hwm={self.hwm_bytes / GiB:6.2f} GiB{host}  {status}"
+        )
+
+
+@dataclasses.dataclass
+class PlanReport:
+    """Ranked plans, feasible-and-certified first, best MFU first."""
+
+    candidates: List[Plan]
+    hbm_budget_bytes: int
+
+    @property
+    def best(self) -> Optional[Plan]:
+        for p in self.candidates:
+            if p.feasible and p.certified:
+                return p
+        return None
+
+    def table(self) -> str:
+        head = (
+            f"{'schedule':<11} {'checkpoint':<12} {'policy':<20} "
+            f"{'m':<5} {'balance':<13} {'pred-mfu':<13} {'bubble':<13} "
+            f"per-rank HWM (budget {self.hbm_budget_bytes / GiB:.2f} GiB)"
+        )
+        return "\n".join([head] + [p.describe() for p in self.candidates])
+
+
+def _ranked(candidates: List[Plan], budget: int) -> PlanReport:
+    candidates.sort(
+        key=lambda p: (
+            not (p.feasible and p.certified),
+            -(p.predicted_mfu or 0.0),
+        )
+    )
+    return PlanReport(candidates=candidates, hbm_budget_bytes=budget)
+
+
+# --------------------------------------------------------------------- #
+# shared cost/certification machinery                                   #
+# --------------------------------------------------------------------- #
+
+
+def _spmd_graph(
+    schedule: str, n: int, m: int, stop: int, v: int
+) -> ev.EventGraph:
+    if schedule == "fill_drain":
+        return ev.spmd_fill_drain_events(n, m, stop)
+    if schedule == "1f1b":
+        return ev.spmd_1f1b_events(n, m, stop)
+    if schedule == "zb":
+        return ev.spmd_zb_events(n, m)
+    if schedule == "interleaved":
+        return ev.spmd_interleaved_events(n, m, v)
+    raise ValueError(f"unknown SPMD schedule {schedule!r}")
+
+
+def _certify(
+    g: ev.EventGraph,
+    bytes_of: Callable[[ev.Buffer], int],
+) -> Tuple[Optional[sched.MemoryCertificate], List[Finding]]:
+    """Ordering rules + memory certification for one candidate graph.
+
+    Returns ``(certificate, findings)``; a non-empty findings list means
+    the candidate must not be emitted as certified."""
+    findings = sched.verify_ordering(g)
+    if findings:
+        return None, findings
+    return sched.certify_memory(g, bytes_of), []
+
+
+def _graph_score(
+    g: ev.EventGraph,
+    cost_of: Callable[[ev.Event], float],
+    model_flops: Optional[float],
+    n_chips: int,
+    epilogue_per_lane: float,
+    lane_tax: float = 0.0,
+) -> Tuple[Optional[float], Optional[float]]:
+    """(predicted MFU, bubble fraction) of one candidate graph."""
+    try:
+        span, busy = ev.makespan(g, cost_of)
+    except ValueError:
+        return None, None
+    denom = g.n_ranks * span
+    bubble = (
+        max(0.0, 1.0 - sum(busy) / denom) if denom > 0 else None
+    )
+    mfu = None
+    lane = span * (1.0 + lane_tax) + epilogue_per_lane
+    if model_flops is not None and lane > 0:
+        mfu = model_flops / (n_chips * lane)
+    return mfu, bubble
+
+
+# --------------------------------------------------------------------- #
+# SPMD planning                                                         #
+# --------------------------------------------------------------------- #
+
+
+def _spmd_cell_atoms(
+    pipe_variant: Any,
+    stage_params_spec: Pytree,
+    mb_spec: Pytree,
+    plain: bool,
+) -> Optional[Tuple[float, float]]:
+    """(fwd, bwd) walker FLOPs of one micro-batch cell.
+
+    ``plain=False`` traces the variant's REMAT'D block (``_block_fn``),
+    so the backward number includes that policy's actual recompute
+    replay — the per-policy refinement is measured, never modeled."""
+    fn = (
+        pipe_variant._block_fn_plain if plain else pipe_variant._block_fn
+    )
+
+    def f(p: Pytree, x: Pytree) -> Pytree:
+        return fn(p, x, None, 1.0, True)
+
+    def fb(p: Pytree, x: Pytree, ct: Pytree) -> Pytree:
+        _, pull = jax.vjp(f, p, x)
+        return pull(ct)
+
+    try:
+        fwd = flops_estimate(
+            jax.make_jaxpr(f)(stage_params_spec, mb_spec)
+        )
+        ct_spec = avalify(jax.eval_shape(f, stage_params_spec, mb_spec))
+        both = flops_estimate(
+            jax.make_jaxpr(fb)(stage_params_spec, mb_spec, ct_spec)
+        )
+    except Exception:  # noqa: BLE001 - scoring stands down
+        return None
+    return fwd, max(both - fwd, 0.0)
+
+
+def _spmd_cost_fn(
+    schedule: str,
+    stop: int,
+    fwd: float,
+    bwd: float,
+    bwd_remat: float,
+) -> Callable[[ev.Event], float]:
+    """Per-event durations: checkpointed micro-batches (mb < stop) pay
+    the remat'd backward (replay included); zero-bubble splits the
+    backward into B (dx half, plus the replay when checkpointed) and W
+    (dw half)."""
+
+    def cost(e: ev.Event) -> float:
+        if e.phase == ev.FWD:
+            return fwd
+        back = bwd_remat if e.mb < stop else bwd
+        if e.phase == ev.BWD:
+            if schedule == "zb":
+                return 0.5 * bwd + (back - bwd if e.mb < stop else 0.0)
+            return back
+        if e.phase == ev.WGT:
+            return 0.5 * bwd
+        return 0.0
+
+    return cost
+
+
+def _plan_spmd(
+    pipe: Any,
+    batch: Pytree,
+    hbm_budget_bytes: int,
+    *,
+    target: Optional[Pytree],
+    schedules: Optional[Sequence[str]],
+    chunks_options: Optional[Sequence[int]],
+    overhead_bytes: int,
+    param_scale: float,
+) -> PlanReport:
+    from torchgpipe_tpu import tune
+    from torchgpipe_tpu.checkpoint import checkpoint_stop
+
+    x_spec = avalify(batch)
+    tgt_spec = avalify(target) if target is not None else x_spec
+    n = pipe.n_stages
+    v = pipe.virtual_stages
+    dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
+    ep = pipe.mesh.shape[pipe.ep_axis] if pipe.ep_axis else 1
+    n_chips = int(pipe.mesh.devices.size)
+    B = jax.tree_util.tree_leaves(x_spec)[0].shape[0]
+
+    plain_step, params_spec = tune._spmd_plain_step(pipe, x_spec, tgt_spec)
+    model_flops = (
+        tune._model_flops(plain_step, params_spec, x_spec, tgt_spec)
+        if plain_step is not None else None
+    )
+    stage_params_spec = (
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+            params_spec["blocks"],
+        )
+        if params_spec is not None else None
+    )
+    param_bytes = 0
+    if params_spec is not None:
+        param_bytes = tune.tree_bytes(stage_params_spec) + sum(
+            tune.tree_bytes(params_spec[k])
+            for k in ("pre", "post", "loss")
+            if k in params_spec
+        )
+    block_in_spec = x_spec
+    if pipe.pre is not None and params_spec is not None:
+        try:
+            block_in_spec, _ = jax.eval_shape(
+                lambda p, xx: pipe.pre.apply(p, (), xx, rng=None, train=True),
+                params_spec["pre"], x_spec,
+            )
+        except Exception:  # noqa: BLE001 - probes below stand down
+            block_in_spec = None
+
+    sched_space = list(schedules or spmd_schedule_space(pipe))
+    lane_flops = (
+        model_flops / (dp * ep) if model_flops is not None else None
+    )
+    plans: List[Plan] = []
+    for chunks in spmd_chunk_options(pipe, B, chunks_options):
+        mb_spec = (
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    (a.shape[0] // (chunks * dp * ep),) + a.shape[1:],
+                    a.dtype,
+                ),
+                block_in_spec,
+            )
+            if block_in_spec is not None else None
+        )
+        mb_bytes = tune.tree_bytes(mb_spec) if mb_spec is not None else 0
+        atom_cache: Dict[Any, Optional[Tuple[float, float]]] = {}
+        resid_cache: Dict[Any, Optional[int]] = {}
+
+        def atoms(variant: Any, plain: bool, key: Any) -> Optional[Tuple[float, float]]:
+            if key not in atom_cache:
+                atom_cache[key] = _spmd_cell_atoms(
+                    variant, stage_params_spec, mb_spec, plain=plain
+                )
+            return atom_cache[key]
+
+        def resid(variant: Any, plain: bool, key: Any) -> Optional[int]:
+            if key not in resid_cache:
+                resid_cache[key] = tune._spmd_cell_residual_bytes(
+                    variant, stage_params_spec, mb_spec, plain=plain
+                )
+            return resid_cache[key]
+
+        for schedule in sched_space:
+            for mode, label, policy in remat_space_for(pipe, schedule):
+                try:
+                    variant = dataclasses.replace(
+                        pipe, schedule=schedule, checkpoint=mode,
+                        remat_policy=policy, chunks=chunks,
+                    )
+                except Exception as e:  # noqa: BLE001 - invalid combo
+                    plans.append(Plan(
+                        engine="spmd", schedule=schedule, balance=None,
+                        chunks=chunks, checkpoint=mode, policy=label,
+                        virtual_stages=v, predicted_mfu=None,
+                        bubble_fraction=None, hwm_bytes=0, host_bytes=0,
+                        feasible=False, certified=False,
+                        reason=f"build: {e}",
+                    ))
+                    continue
+                stop = checkpoint_stop(mode, chunks, train=True)
+                try:
+                    g = _spmd_graph(schedule, n, chunks, stop, v)
+                except Exception as e:  # noqa: BLE001 - e.g. m % n != 0
+                    plans.append(Plan(
+                        engine="spmd", schedule=schedule, balance=None,
+                        chunks=chunks, checkpoint=mode, policy=label,
+                        virtual_stages=v, predicted_mfu=None,
+                        bubble_fraction=None, hwm_bytes=0, host_bytes=0,
+                        feasible=False, certified=False,
+                        reason=f"schedule: {e}",
+                    ))
+                    continue
+                remat = mode in ("always", "offload", "except_last")
+                plain_atoms = atoms(variant, True, "plain")
+                remat_atoms = (
+                    atoms(variant, False, ("remat", label))
+                    if remat else plain_atoms
+                )
+                resid_full = resid(variant, True, "plain")
+                resid_cell = (
+                    resid(variant, False, ("remat", label))
+                    if remat else resid_full
+                )
+                if (
+                    plain_atoms is None or remat_atoms is None
+                    or resid_full is None or resid_cell is None
+                ):
+                    plans.append(Plan(
+                        engine="spmd", schedule=schedule, balance=None,
+                        chunks=chunks, checkpoint=mode, policy=label,
+                        virtual_stages=v, predicted_mfu=None,
+                        bubble_fraction=None, hwm_bytes=0, host_bytes=0,
+                        feasible=False, certified=False,
+                        reason="cell probe failed",
+                    ))
+                    continue
+                fwd, bwd = plain_atoms
+                bwd_remat = remat_atoms[1]
+                # Offload: named points ride to host; the device keeps
+                # what a nothing-saveable remat would (tune's law).
+                host_cell = 0
+                if mode == "offload" and getattr(
+                    variant.remat_policy, "offload", False
+                ):
+                    nothing = dataclasses.replace(
+                        pipe, schedule=schedule, checkpoint="always",
+                        remat_policy=None, chunks=chunks,
+                    )
+                    device_cell = resid(nothing, False, ("remat", None))
+                    if device_cell is not None:
+                        host_cell = max(resid_cell - device_cell, 0)
+                        resid_cell = device_cell
+
+                def bytes_of(
+                    buf: ev.Buffer,
+                    _rf: int = resid_full,
+                    _rc: int = resid_cell,
+                    _mode: str = mode,
+                    _mb: int = mb_bytes,
+                ) -> int:
+                    if buf.kind == "resid":
+                        # Interleaved annotates every cell "resid".
+                        return _rc if _mode != "never" else _rf
+                    if buf.kind == "saved":
+                        return _rc
+                    if buf.kind == "out":
+                        return _mb
+                    return 0
+
+                cert, findings = _certify(g, bytes_of)
+                if cert is None:
+                    plans.append(Plan(
+                        engine="spmd", schedule=schedule, balance=None,
+                        chunks=chunks, checkpoint=mode, policy=label,
+                        virtual_stages=v, predicted_mfu=None,
+                        bubble_fraction=None, hwm_bytes=0, host_bytes=0,
+                        feasible=False, certified=False,
+                        reason=f"verifier: {findings[0].message[:80]}",
+                    ))
+                    continue
+                # Fixed per-lane residents beyond the schedule-managed
+                # buffers: params (× optimizer head-room), the stacked
+                # per-tick scan outputs (fill-drain's ys; the explicit-
+                # gradient schedules keep an O(n) ring instead), and the
+                # allocator/temp overhead allowance.
+                ticks = (
+                    chunks + n - 1 if schedule == "fill_drain" else n
+                )
+                fixed = int(
+                    param_bytes * param_scale
+                    + ticks * mb_bytes
+                    + overhead_bytes
+                )
+                hwm = cert.high_water + fixed
+                host_peak = max(
+                    (
+                        pl.get("saved", 0) + pl.get("resid", 0)
+                        for pl in cert.peak_live
+                    ),
+                    default=0,
+                ) * host_cell
+                feasible = hwm <= hbm_budget_bytes
+                # SPMD 'offload' remats EVERY cell (offload save policy):
+                # the replay is charged for all micro-batches even though
+                # the buffer annotation's stop is 0 (residuals stored,
+                # host-side).
+                cost_stop = chunks if mode == "offload" else stop
+                cost_of = _spmd_cost_fn(
+                    schedule, cost_stop, fwd, bwd, bwd_remat
+                )
+                epilogue = 0.0
+                if lane_flops is not None:
+                    useful_cells = n * chunks * (fwd + bwd)
+                    epilogue = max(lane_flops - useful_cells, 0.0) / n
+                mfu, bubble = _graph_score(
+                    g, cost_of, model_flops, n_chips, epilogue
+                )
+                plans.append(Plan(
+                    engine="spmd", schedule=schedule, balance=None,
+                    chunks=chunks, checkpoint=mode, policy=label,
+                    virtual_stages=v, predicted_mfu=mfu,
+                    bubble_fraction=bubble, hwm_bytes=hwm,
+                    host_bytes=host_peak, feasible=feasible,
+                    certified=True,
+                    reason="" if feasible else "over HBM budget",
+                ))
+    return _ranked(plans, hbm_budget_bytes)
+
+
+# --------------------------------------------------------------------- #
+# MPMD planning                                                         #
+# --------------------------------------------------------------------- #
+
+
+def _mpmd_balance_options(
+    pipe: Any,
+    requested: Optional[Sequence[Sequence[int]]],
+    layer_fb: Optional[List[float]],
+) -> List[Tuple[int, ...]]:
+    """Balance cuts to score: the pipe's current cut plus the analytic
+    FLOPs-balanced cut (``balance_by_flops``' exact block partition of
+    the same per-layer costs), deduplicated."""
+    from torchgpipe_tpu.balance import balance_cost
+
+    opts: List[Tuple[int, ...]] = []
+    if requested is not None:
+        opts.extend(tuple(b) for b in requested)
+    else:
+        opts.append(tuple(pipe.balance))
+        if layer_fb is not None and any(f > 0 for f in layer_fb):
+            try:
+                opts.append(tuple(
+                    balance_cost(layer_fb, len(pipe.balance))
+                ))
+            except Exception:  # noqa: BLE001 - infeasible cut request
+                pass
+    return list(dict.fromkeys(opts))
+
+
+def _plan_mpmd(
+    pipe: Any,
+    batch: Pytree,
+    hbm_budget_bytes: int,
+    *,
+    chunks_options: Optional[Sequence[int]],
+    balance_options: Optional[Sequence[Sequence[int]]],
+    overhead_bytes: int,
+    param_scale: float,
+) -> PlanReport:
+    from torchgpipe_tpu import tune
+    from torchgpipe_tpu.balance import layer_flops
+    from torchgpipe_tpu.checkpoint import checkpoint_stop
+    from torchgpipe_tpu.gpipe import GPipe
+
+    del param_scale  # per-stage params are not modeled on MPMD (multi-chip)
+    x_spec = avalify(batch)
+    B = jax.tree_util.tree_leaves(x_spec)[0].shape[0]
+    try:
+        layer_fb: Optional[List[float]] = layer_flops(pipe.layers, x_spec)
+    except Exception:  # noqa: BLE001 - scoring degrades, memory still runs
+        layer_fb = None
+    model_flops = sum(layer_fb) if layer_fb else None
+    balances = _mpmd_balance_options(pipe, balance_options, layer_fb)
+    schedules = ["gpipe"]
+    if pipe.schedule == "1f1b" or pipe.loss_reduction in ("mean", "sum"):
+        schedules.append("1f1b")
+
+    plans: List[Plan] = []
+    for balance in balances:
+        stage_fwd: Optional[List[float]] = None
+        if layer_fb is not None:
+            stage_fwd, i = [], 0
+            for size in balance:
+                stage_fwd.append(sum(layer_fb[i:i + size]) / 3.0)
+                i += size
+        for chunks in mpmd_chunk_options(B, chunks_options, pipe.chunks):
+            profile_cache: Dict[Tuple[int, ...], Optional[Tuple]] = {}
+            for schedule in schedules:
+                for mode in MPMD_CHECKPOINT_SPACE:
+                    plans.append(_score_mpmd_candidate(
+                        pipe, x_spec, balance, chunks, schedule, mode,
+                        stage_fwd, model_flops, hbm_budget_bytes,
+                        overhead_bytes, profile_cache,
+                        GPipe, checkpoint_stop, tune,
+                    ))
+    return _ranked(plans, hbm_budget_bytes)
+
+
+def _score_mpmd_candidate(
+    pipe: Any,
+    x_spec: Pytree,
+    balance: Tuple[int, ...],
+    chunks: int,
+    schedule: str,
+    mode: str,
+    stage_fwd: Optional[List[float]],
+    model_flops: Optional[float],
+    hbm_budget_bytes: int,
+    overhead_bytes: int,
+    profile_cache: Dict,
+    GPipe: Any,
+    checkpoint_stop: Callable,
+    tune: Any,
+) -> Plan:
+    def rejected(reason: str) -> Plan:
+        return Plan(
+            engine="mpmd", schedule=schedule, balance=balance,
+            chunks=chunks, checkpoint=mode, policy=None,
+            virtual_stages=1, predicted_mfu=None, bubble_fraction=None,
+            hwm_bytes=0, host_bytes=0, feasible=False, certified=False,
+            reason=reason,
+        )
+
+    try:
+        variant = GPipe(
+            pipe.layers, balance=list(balance), chunks=chunks,
+            checkpoint=mode, schedule=schedule,
+            # GPipe rejects loss_reduction outside 1f1b (fill-drain
+            # computes the loss on the gathered mini-batch).
+            loss_reduction=(
+                pipe.loss_reduction if schedule == "1f1b" else None
+            ),
+        )
+    except Exception as e:  # noqa: BLE001 - invalid combo
+        return rejected(f"build: {e}")
+    n = len(balance)
+    m = chunks
+    stop = checkpoint_stop(mode, m, train=True)
+    g = (
+        ev.mpmd_1f1b_events(n, m, stop) if schedule == "1f1b"
+        else ev.mpmd_fill_drain_events(n, m, stop)
+    )
+    key = tuple(balance) + (chunks,)
+    if key not in profile_cache:
+        profile_cache[key] = tune.mpmd_stage_memory_profile(variant, x_spec)
+    profile = profile_cache[key]
+    if profile is None:
+        return rejected("memory profile failed")
+    resid_b, saved_b, out_b = profile
+
+    def bytes_of(buf: ev.Buffer) -> int:
+        if buf.kind == "resid":
+            return resid_b[buf.stage]
+        if buf.kind == "saved":
+            return saved_b[buf.stage]
+        if buf.kind == "out":
+            return out_b
+        return 0
+
+    offload = mode == "offload"
+    host_kinds: Tuple[str, ...] = ("resid",) if offload else ()
+    findings = sched.verify_ordering(g)
+    if findings:
+        return rejected(f"verifier: {findings[0].message[:80]}")
+    cert = sched.certify_memory(g, bytes_of, host_kinds=host_kinds)
+    hwm = cert.high_water + overhead_bytes
+    host = max(cert.host_per_rank, default=0)
+    feasible = hwm <= hbm_budget_bytes
+    mfu = bubble = None
+    if stage_fwd is not None:
+        # stage_fwd is the FULL-batch forward cost; one schedule cell
+        # computes a single micro-batch (1/m of the rows).
+        cell_fwd = [f / m for f in stage_fwd]
+
+        def cost_of(e: ev.Event) -> float:
+            f = cell_fwd[e.stage]
+            if e.phase == ev.FWD:
+                return f
+            if e.phase == ev.BWD:
+                return 2.0 * f + (f if e.mb < stop else 0.0)
+            return 0.0
+
+        tax = tune.OFFLOAD_RANK_TAX if offload else 0.0
+        mfu, bubble = _graph_score(
+            g, cost_of, model_flops, n, 0.0, lane_tax=tax
+        )
+    return Plan(
+        engine="mpmd", schedule=schedule, balance=balance, chunks=chunks,
+        checkpoint=mode, policy=None, virtual_stages=1,
+        predicted_mfu=mfu, bubble_fraction=bubble, hwm_bytes=hwm,
+        host_bytes=host, feasible=feasible, certified=True,
+        reason="" if feasible else "over HBM budget",
+    )
+
+
+# --------------------------------------------------------------------- #
+# entry points: plan / apply_plan / verify_plan                         #
+# --------------------------------------------------------------------- #
+
+
+def plan(
+    pipe: Any,
+    batch: Pytree,
+    hbm_budget_bytes: int,
+    *,
+    target: Optional[Pytree] = None,
+    schedules: Optional[Sequence[str]] = None,
+    chunks_options: Optional[Sequence[int]] = None,
+    balance_options: Optional[Sequence[Sequence[int]]] = None,
+    overhead_bytes: Optional[int] = None,
+    param_scale: Optional[float] = None,
+) -> PlanReport:
+    """Search balance × schedule × chunks × remat statically and return
+    the certified frontier.
+
+    ``pipe`` is a :class:`~torchgpipe_tpu.spmd.SpmdGPipe` or
+    :class:`~torchgpipe_tpu.gpipe.GPipe`; ``batch`` a representative
+    batch (arrays or ``ShapeDtypeStruct`` — only shapes/dtypes are
+    read).  No device is timed, nothing compiles for an accelerator:
+    the whole search is traced jaxprs + ``eval_shape`` + pure-Python
+    event graphs.  Every emitted feasible plan passed the schedule
+    verifier's ordering rules and the memory-certification pass against
+    ``hbm_budget_bytes``.
+    """
+    from torchgpipe_tpu import tune
+    from torchgpipe_tpu.gpipe import GPipe
+
+    overhead = (
+        tune.DEFAULT_OVERHEAD_BYTES if overhead_bytes is None
+        else overhead_bytes
+    )
+    scale = (
+        tune.DEFAULT_PARAM_SCALE if param_scale is None else param_scale
+    )
+    if isinstance(pipe, GPipe):
+        return _plan_mpmd(
+            pipe, batch, hbm_budget_bytes,
+            chunks_options=chunks_options,
+            balance_options=balance_options,
+            overhead_bytes=overhead, param_scale=scale,
+        )
+    return _plan_spmd(
+        pipe, batch, hbm_budget_bytes, target=target,
+        schedules=schedules, chunks_options=chunks_options,
+        overhead_bytes=overhead, param_scale=scale,
+    )
+
+
+def apply_plan(pipe: Any, chosen: Plan) -> Any:
+    """Rebuild ``pipe`` with a plan applied — the one-call handoff from
+    the frontier table to a runnable engine."""
+    from torchgpipe_tpu import tune
+    from torchgpipe_tpu.gpipe import GPipe
+
+    if chosen.engine == "mpmd":
+        if not isinstance(pipe, GPipe):
+            raise TypeError("an mpmd plan applies to a GPipe pipeline")
+        return GPipe(
+            pipe.layers,
+            balance=list(chosen.balance or pipe.balance),
+            chunks=chosen.chunks,
+            checkpoint=chosen.checkpoint,
+            schedule=chosen.schedule,
+            loss_reduction=(
+                pipe.loss_reduction if chosen.schedule == "1f1b" else None
+            ),
+            hbm_budget_bytes=getattr(pipe, "hbm_budget_bytes", None),
+        )
+    return dataclasses.replace(
+        pipe,
+        schedule=chosen.schedule,
+        checkpoint=chosen.checkpoint,
+        remat_policy=tune.resolve_policy(chosen.policy),
+        chunks=chosen.chunks,
+    )
+
+
+def verify_plan(pipe: Any, chosen: Plan) -> List[Finding]:
+    """Re-run the event-graph verifier on a chosen plan: build the
+    plan's engine, extract its event graph, and return the ordering +
+    donation + equivalence findings (empty = the plan is certified by
+    the SAME rules ``analysis.lint`` enforces).  The ``plan-verify`` CI
+    step calls this on the top plan of each llama preset."""
+    applied = apply_plan(pipe, chosen)
+    m = chosen.chunks
+    g = ev.events_for(applied, chunks=m)
+    findings = sched.verify_ordering(g)
+    findings.extend(sched.verify_buffers(ev.with_update(g, donate=True)))
+    findings.extend(sched.verify_equivalence(g))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# plan-drift lint rule (registered in analysis.rules)                   #
+# --------------------------------------------------------------------- #
+
+
+def _policy_identity(policy: Any) -> Any:
+    """What makes two remat policies THE SAME policy: named-save
+    policies by their (names, offload) declaration — the presets are
+    properties returning a fresh instance per access, so object identity
+    never holds — and raw jax policy functions by identity (jax's
+    module-level functions ARE stable objects)."""
+    names = getattr(policy, "names", None)
+    if names is not None:
+        return ("named", tuple(names), bool(getattr(policy, "offload", False)))
+    return ("fn", policy)
+
+
+def _spmd_policy_label(pipe: Any) -> Optional[str]:
+    """The pipe's remat policy resolved to the PLANNER'S preset name
+    (the ``Plan.policy`` vocabulary), or None for the engine default.
+    A ``NamedSavePolicy.label`` ("save:attn_out") is a display string,
+    not the preset name ("save_attn_out") — resolve through the
+    canonical candidate space instead.  Unknown/custom policies return
+    a sentinel no candidate carries, so the drift rule stands down
+    rather than mis-keying onto the wrong candidate."""
+    policy = getattr(pipe, "remat_policy", None)
+    if policy is None or getattr(policy, "default_preset", False):
+        # The 'offload' mode installs its catch-all default in
+        # __post_init__; both spellings are the offload_default plan.
+        return "offload_default" if pipe.checkpoint == "offload" else None
+    key = _policy_identity(policy)
+    for _mode, label, candidate in spmd_remat_space(pipe):
+        if candidate is not None and _policy_identity(candidate) == key:
+            return label
+    return f"<custom:{getattr(policy, 'label', policy)!r}>"
+
+
+def _config_of(pipe: Any) -> Tuple:
+    """The (schedule, checkpoint, policy-label, chunks, balance) key a
+    pipe actually runs — matched against the planner's candidates."""
+    from torchgpipe_tpu.gpipe import GPipe
+
+    if isinstance(pipe, GPipe):
+        return (pipe.schedule, pipe.checkpoint, None, pipe.chunks,
+                tuple(pipe.balance))
+    return (pipe.schedule, pipe.checkpoint, _spmd_policy_label(pipe),
+            pipe.chunks, None)
+
+
+def check_plan_drift(trace: Any) -> List[Finding]:
+    """WARNING when a pipe that declares ``hbm_budget_bytes`` runs a
+    configuration whose predicted MFU trails the planner's certified top
+    plan by more than :data:`PLAN_DRIFT_THRESHOLD` (10%).
+
+    Opt-in by construction: without a declared budget the planner cannot
+    certify feasibility, so the rule stands down (the same gate the
+    memory-certification budget check uses)."""
+    budget = getattr(trace.pipe, "hbm_budget_bytes", None)
+    if budget is None:
+        return []
+    try:
+        report = plan(trace.pipe, trace.x_spec, budget)
+    except Exception:  # noqa: BLE001 - the planner stands down, not lint
+        return []
+    top = report.best
+    if top is None or top.predicted_mfu is None:
+        return []
+    actual_key = _config_of(trace.pipe)
+    actual = next(
+        (
+            p for p in report.candidates
+            if (p.schedule, p.checkpoint, p.policy, p.chunks,
+                p.balance) == actual_key
+        ),
+        None,
+    )
+    if actual is None or actual.predicted_mfu is None:
+        return []
+    top_key = (top.schedule, top.checkpoint, top.policy, top.chunks,
+               top.balance)
+    if top_key == actual_key:
+        return []
+    drift = 1.0 - actual.predicted_mfu / top.predicted_mfu
+    if drift <= PLAN_DRIFT_THRESHOLD and actual.feasible:
+        return []
+    what = (
+        "is over the declared HBM budget"
+        if not actual.feasible
+        else f"predicts {drift:.0%} lower MFU"
+    )
+    return [Finding(
+        rule="plan-drift",
+        severity=Severity.WARNING,
+        path=f"plan/{trace.engine}",
+        message=(
+            f"the configured plan (schedule={actual.schedule!r}, "
+            f"checkpoint={actual.checkpoint!r}, "
+            f"policy={actual.policy or '-'}, chunks={actual.chunks}"
+            + (f", balance={list(actual.balance)}" if actual.balance else "")
+            + f") {what} than the certified top plan "
+            f"(schedule={top.schedule!r}, checkpoint={top.checkpoint!r}, "
+            f"policy={top.policy or '-'}, chunks={top.chunks}"
+            + (f", balance={list(top.balance)}" if top.balance else "")
+            + f", predicted MFU {top.predicted_mfu:.4f}, certified "
+            f"HWM {top.hwm_bytes / GiB:.2f} GiB) — the drift threshold "
+            f"is {PLAN_DRIFT_THRESHOLD:.0%}; apply it with "
+            "analysis.planner.apply_plan(pipe, report.best)"
+        ),
+    )]

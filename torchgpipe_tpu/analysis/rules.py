@@ -582,6 +582,23 @@ def _register_schedule_rules() -> None:
 
 _register_schedule_rules()
 
+
+def _register_planner_rules() -> None:
+    """The planner's drift rule (analysis.planner) — same single-registry
+    treatment as the schedule family."""
+    from torchgpipe_tpu.analysis import planner
+
+    RULES.append(Rule(
+        "plan-drift",
+        "a pipe declaring hbm_budget_bytes must not run a configuration "
+        "more than 10% below the planner's certified top plan "
+        "(balance x schedule x chunks x remat)",
+        planner.check_plan_drift,
+    ))
+
+
+_register_planner_rules()
+
 RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULES}
 
 

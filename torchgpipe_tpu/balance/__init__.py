@@ -1,23 +1,50 @@
-"""Automatic stage balancing: profile per-layer costs, then block-partition.
+"""Automatic stage balancing: per-layer costs -> exact block partition.
 
 Reference: torchgpipe/balance/__init__.py:38-156 (``balance_by_time`` /
 ``balance_by_size``).  Usage::
 
-    from torchgpipe_tpu.balance import balance_by_time
+    from torchgpipe_tpu.balance import balance_by_flops
 
-    balance = balance_by_time(4, layers, params, states, sample)
+    balance = balance_by_flops(4, layers, sample=sample)
     model = GPipe(layers, balance, chunks=8)
+
+Two cost sources:
+
+* **analytic** (:func:`balance_by_flops`, preferred) — per-layer
+  forward+backward FLOPs from the structure-aware jaxpr walker
+  (:func:`torchgpipe_tpu.analysis.jaxpr.flops_estimate`) over an
+  abstract trace: no device compute, no compile, deterministic on any
+  host.  This is the cost model the static planner
+  (:mod:`torchgpipe_tpu.analysis.planner`) searches balance cuts with.
+* **probe-based** (:func:`balance_by_time` / :func:`balance_by_size`,
+  the reference lineage) — runtime timing / XLA memory analysis on a
+  real device.  These remain fully supported (no warning is emitted;
+  time-profiling is still the only way to capture effects the analytic
+  model cannot see, e.g. a layer bottlenecked on memory bandwidth
+  rather than FLOPs), but they cost real device time per call and their
+  numbers vary run to run — new code should start from
+  ``balance_by_flops`` and only reach for the probes when measurements
+  disagree with the analytic cut.  The planner never calls them.
+
+Either way the costs feed :func:`blockpartition.solve` — the exact
+contiguous block-partition solver (minimize the bottleneck stage sum).
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence
+from typing import Any, List, Optional, Sequence
 
 from torchgpipe_tpu.balance import blockpartition
 from torchgpipe_tpu.balance.profile import profile_sizes, profile_times
 from torchgpipe_tpu.layers import Layer
 
-__all__ = ["balance_by_time", "balance_by_size", "balance_cost"]
+__all__ = [
+    "balance_by_flops",
+    "balance_by_time",
+    "balance_by_size",
+    "balance_cost",
+    "layer_flops",
+]
 
 Pytree = Any
 
@@ -28,6 +55,75 @@ def balance_cost(costs: Sequence[float], partitions: int) -> List[int]:
     Reference: torchgpipe/balance/__init__.py:33-35.
     """
     return blockpartition.solve_sizes(costs, partitions)
+
+
+def layer_flops(
+    layers: Sequence[Layer],
+    sample: Pytree,
+    *,
+    params: Optional[Sequence[Pytree]] = None,
+    states: Optional[Sequence[Pytree]] = None,
+) -> List[float]:
+    """Per-layer forward+backward FLOPs by abstract evaluation only.
+
+    Each layer's fwd+bwd is traced to a jaxpr at its in-chain input spec
+    (specs threaded through the layer sequence with ``jax.eval_shape``,
+    skip stashes included) and costed by
+    :func:`torchgpipe_tpu.analysis.jaxpr.flops_estimate`.  ``params`` /
+    ``states`` default to an ``eval_shape`` init — arrays are never
+    materialized and no device is touched.  Layers with zero matmul/conv
+    work cost 0 (the walker weighs MXU ops; elementwise glue is noise at
+    partition granularity).
+    """
+    import jax
+
+    from torchgpipe_tpu.analysis.jaxpr import avalify, flops_estimate
+    from torchgpipe_tpu.balance.profile import _layer_fwd_bwd
+    from torchgpipe_tpu.layers import sequential_init
+
+    sample = avalify(sample)
+    if params is None or states is None:
+        params, states, _ = jax.eval_shape(
+            lambda: sequential_init(
+                list(layers), jax.random.PRNGKey(0), sample
+            )
+        )
+    params = [avalify(p) for p in params]
+    states = [avalify(s) for s in states]
+
+    flops: List[float] = []
+    skips: dict = {}
+    x = sample
+    for i, layer in enumerate(layers):
+        pops = {k: skips[k] for k in layer.pop}
+        fn = _layer_fwd_bwd(layer)
+        jaxpr = jax.make_jaxpr(fn)(params[i], states[i], x, pops)
+        flops.append(flops_estimate(jaxpr))
+        x, stashed, _ = jax.eval_shape(fn, params[i], states[i], x, pops)
+        for k in layer.pop:
+            skips.pop(k, None)
+        skips.update(stashed)
+    return flops
+
+
+def balance_by_flops(
+    partitions: int,
+    layers: Sequence[Layer],
+    sample: Pytree,
+    *,
+    params: Optional[Sequence[Pytree]] = None,
+    states: Optional[Sequence[Pytree]] = None,
+) -> List[int]:
+    """Balance by ANALYTIC per-layer fwd+bwd FLOPs — the probe-free
+    replacement for :func:`balance_by_time`: same contract, but the
+    costs come from :func:`layer_flops` (abstract eval, deterministic,
+    zero device time) instead of wall-clock sweeps on a device.  This is
+    the balance source of :func:`torchgpipe_tpu.analysis.planner.plan`.
+    """
+    return balance_cost(
+        layer_flops(layers, sample, params=params, states=states),
+        partitions,
+    )
 
 
 def balance_by_time(
@@ -42,7 +138,10 @@ def balance_by_time(
 ) -> List[int]:
     """Balance by profiled forward+backward time per layer.
 
-    Reference: torchgpipe/balance/__init__.py:38-77.
+    Reference: torchgpipe/balance/__init__.py:38-77.  Probe-based: each
+    call costs ``timeout`` seconds of REAL device time and its numbers
+    vary with co-tenants — prefer :func:`balance_by_flops` unless you
+    specifically need measured (bandwidth-bound) costs.
     """
     times = profile_times(
         layers, params, states, sample, timeout=timeout, device=device
@@ -63,7 +162,10 @@ def balance_by_size(
     """Balance by per-layer memory footprint (XLA memory analysis + scaled
     parameter bytes).
 
-    Reference: torchgpipe/balance/__init__.py:80-156.
+    Reference: torchgpipe/balance/__init__.py:80-156.  Compiles each
+    layer on the target backend; for a probe-free cut use
+    :func:`balance_by_flops` and let the planner's memory certification
+    (:mod:`torchgpipe_tpu.analysis.planner`) check the footprint.
     """
     sizes = profile_sizes(
         layers, params, states, sample, param_scale=param_scale, device=device

@@ -69,6 +69,7 @@ class GPipe:
         loss_reduction: Optional[str] = None,
         remat_policy: Any = None,
         tracer: Any = None,
+        hbm_budget_bytes: Optional[int] = None,
     ) -> None:
         if balance is None:
             raise ValueError(
@@ -118,6 +119,11 @@ class GPipe:
             )
         self.schedule = schedule
         self.loss_reduction = loss_reduction
+        # Declared per-chip HBM budget (bytes).  Opt-in: the schedule
+        # verifier's memory certification ERRORs on overrun, and the
+        # plan-drift lint rule compares the running configuration
+        # against analysis.planner's certified top plan under it.
+        self.hbm_budget_bytes = hbm_budget_bytes
 
         self.layers = layers
         self.balance = list(balance)

@@ -495,6 +495,11 @@ class SpmdGPipe:
     # interleaved); fill-drain's remat-structured scans measured SLOWER
     # fully unrolled at large cells — leave fill_drain at the default.
     scan_unroll: Union[int, bool] = 1
+    # Declared per-chip HBM budget (bytes).  Opt-in: the schedule
+    # verifier's memory certification ERRORs on overrun, and the
+    # plan-drift lint rule compares the running configuration against
+    # analysis.planner's certified top plan under it.
+    hbm_budget_bytes: Optional[int] = None
 
     def __repr__(self) -> str:
         axes = {

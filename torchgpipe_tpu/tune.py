@@ -436,32 +436,19 @@ def _spmd_variant(pipe: Any, checkpoint: str, policy: Any, chunks: int,
 
 
 def _default_spmd_space(pipe: Any) -> List[Tuple[str, Optional[str], Any]]:
-    """(checkpoint, policy-label, policy) candidates: the engine's four
-    modes plus the named-save presets on the remat'd mode."""
-    from torchgpipe_tpu.checkpoint import policies
+    """(checkpoint, policy-label, policy) candidates — the CANONICAL
+    enumeration lives in :mod:`torchgpipe_tpu.analysis.planner`
+    (``spmd_remat_space``), which the joint planner and this sweep
+    share so tune and plan never disagree on the searchable space."""
+    from torchgpipe_tpu.analysis.planner import spmd_remat_space
 
-    return [
-        ("never", None, None),
-        ("except_last", None, None),
-        ("always", None, None),
-        ("always", "save_attn_out", policies.save_attn_out),
-        ("always", "save_block_outputs", policies.save_block_outputs),
-        ("always", "dots_no_batch", policies.dots_no_batch),
-        ("offload", "offload_default", None),
-    ]
+    return spmd_remat_space(pipe)
 
 
 def _chunk_options(pipe: Any, batch: int, requested: Optional[Sequence[int]]) -> List[int]:
-    if requested is not None:
-        return list(requested)
-    dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
-    ep = pipe.mesh.shape[pipe.ep_axis] if pipe.ep_axis else 1
-    per = batch // (dp * ep)
-    opts = sorted({
-        c for c in (2, 4, 8, 16, 32, pipe.chunks)
-        if c >= 1 and per % c == 0
-    })
-    return opts or [pipe.chunks]
+    from torchgpipe_tpu.analysis.planner import spmd_chunk_options
+
+    return spmd_chunk_options(pipe, batch, requested)
 
 
 def tune_step(
@@ -871,13 +858,15 @@ def _tune_mpmd(
     from torchgpipe_tpu.gpipe import GPipe
 
     del param_scale  # per-stage params are not modeled on MPMD (multi-chip)
+    from torchgpipe_tpu.analysis.planner import (
+        MPMD_CHECKPOINT_SPACE, mpmd_chunk_options,
+    )
+
     B = jax.tree_util.tree_leaves(_avalify(batch))[0].shape[0]
-    opts = chunks_options or sorted({
-        c for c in (2, 4, 8, 16, pipe.chunks) if c >= 1 and B % c == 0
-    })
+    opts = mpmd_chunk_options(B, chunks_options, pipe.chunks)
     candidates = []
     for chunks in opts:
-        for mode in ("except_last", "offload", "never", "always"):
+        for mode in MPMD_CHECKPOINT_SPACE:
             try:
                 model = GPipe(
                     pipe.layers, balance=pipe.balance, chunks=chunks,
