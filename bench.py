@@ -870,6 +870,25 @@ def _decode_serving_entry() -> None:
     serving_main()
 
 
+def _megastep_entry() -> None:
+    """The ``megastep`` rung: ms per optimizer step at megastep K over
+    the canonical ladder (tune.megastep_options — K in {1, 4, 16}) on
+    the CPU tiny llama preset (benchmarks/llama_megastep.py, which owns
+    the measurement contract: warmup per K, block_until_ready-bounded
+    windows, cross-K loss agreement asserted).  Emits one JSON line::
+
+        env JAX_PLATFORMS=cpu python bench.py --megastep
+    """
+    import sys as _sys
+
+    _sys.argv = [_sys.argv[0]] + [
+        a for a in _sys.argv[1:] if a != "--megastep"
+    ] + ["--json"]
+    from benchmarks.llama_megastep import main as megastep_main
+
+    raise SystemExit(megastep_main())
+
+
 def _plan_validate_entry() -> None:
     """The ``plan-validate`` rung: predicted-vs-measured rank-order check
     of the static planner on the CPU tiny-llama preset
@@ -888,6 +907,8 @@ def _plan_validate_entry() -> None:
 if __name__ == "__main__":
     if "--plan-validate" in sys.argv:
         _plan_validate_entry()
+    elif "--megastep" in sys.argv:
+        _megastep_entry()
     elif "--decode-serving" in sys.argv:
         _decode_serving_entry()
     elif "--child" in sys.argv:

@@ -264,10 +264,21 @@ def run_speed(
     rng = jax.random.PRNGKey(1)
     carry = {"params": params, "state": state}
 
+    # The input pipeline the drivers measure WITH, not around: batches
+    # stream through the double-buffered prefetcher (utils.data), so the
+    # host→device copy of batch k+1 overlaps step k's compute — the
+    # hot-path wiring docs/tuning.md's input-pipeline section describes.
+    from itertools import repeat
+
+    from torchgpipe_tpu.utils.data import prefetch_to_pipe
+
+    batches = prefetch_to_pipe(repeat((x, y)), model, size=2)
+
     def step_fn(global_step):
         key = jax.random.fold_in(rng, global_step)
+        xb, yb = next(batches)
         loss, grads, new_state, _ = model.value_and_grad(
-            carry["params"], carry["state"], x, y, loss_fn, rng=key
+            carry["params"], carry["state"], xb, yb, loss_fn, rng=key
         )
         carry["params"] = tuple(
             jax.tree_util.tree_map(lambda p, g: p - 1e-4 * g, ps, gs)

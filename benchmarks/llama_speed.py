@@ -388,9 +388,20 @@ def _run_spmd(cfg, n, chunks, x, epochs, steps, checkpoint, label, moe=None,
         )
     }
 
+    # Batches stream through the double-buffered sharding-aware
+    # prefetcher: batch k+1's host→device copy (committed to the mesh's
+    # data sharding) overlaps step k's compute — the hot path consumes
+    # utils.data.prefetch_to_pipe instead of re-uploading per step.
+    from itertools import repeat
+
+    from torchgpipe_tpu.utils.data import prefetch_to_pipe
+
+    batches = prefetch_to_pipe(repeat((inputs, targets)), pipe, size=2)
+
     def step_fn(global_step):
         del global_step
-        loss, grads = pipe.train_step(carry["params"], inputs, targets)
+        xb, yb = next(batches)
+        loss, grads = pipe.train_step(carry["params"], xb, yb)
         carry["params"] = jax.tree_util.tree_map(
             lambda p, g: p - 1e-4 * g, carry["params"], grads
         )

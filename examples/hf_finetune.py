@@ -131,12 +131,27 @@ def main() -> None:
         return {"params": params, "opt": opt_state,
                 "step": jnp.asarray(i, jnp.int32)}
 
+    # The input pipeline: batches stream through the double-buffered
+    # sharding-aware prefetcher (utils.data.prefetch_to_pipe) — batch
+    # k+1's host→device copy, committed to the pp x dp mesh's data
+    # sharding, overlaps step k's compute.  The loader is deterministic
+    # per step index so a resumed incarnation replays the same stream.
+    from torchgpipe_tpu.utils.data import prefetch_to_pipe
+
+    def loader(start):
+        step_i = start
+        while True:
+            yield inputs, labels  # a real loader would key on step_i
+            step_i += 1
+
     total = 6
+    batches = prefetch_to_pipe(loader(0), pipe, size=2)
     with PreemptionHandler() as stop:
         with faults.inject(preempt_at_step=3):
             for i in range(total):
+                x_i, y_i = next(batches)
                 loss, params, opt_state = guard(
-                    params, opt_state, inputs, labels
+                    params, opt_state, x_i, y_i
                 )
                 mgr.save(i, pack(params, opt_state, i))
                 print(f"step {i}: loss {float(loss):.4f}", flush=True)
@@ -150,8 +165,10 @@ def main() -> None:
     snap = mgr.restore_latest(template=pack(params, opt_state, 0))
     params = pipe.place_tree(snap.tree["params"])
     opt_state = pipe.place_tree(snap.tree["opt"])
-    for i in range(int(snap.tree["step"]) + 1, total):
-        loss, params, opt_state = guard(params, opt_state, inputs, labels)
+    start = int(snap.tree["step"]) + 1
+    batches = prefetch_to_pipe(loader(start), pipe, size=2)
+    for i, (x_i, y_i) in zip(range(start, total), batches):
+        loss, params, opt_state = guard(params, opt_state, x_i, y_i)
         mgr.save(i, pack(params, opt_state, i))
         print(f"step {i} (resumed): loss {float(loss):.4f}", flush=True)
     print(f"guard stats: {guard.stats}", flush=True)

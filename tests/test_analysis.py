@@ -610,3 +610,89 @@ def test_plan_drift_stands_down_without_declared_budget():
     model = _driftable_model(checkpoint="always")  # no hbm_budget_bytes
     assert analysis.lint(model, X, target=Y, loss_fn=mse,
                          rules=["plan-drift"]) == []
+
+
+# --------------------------------------------------------------------- #
+# dispatch-per-step (megastep availability)                             #
+# --------------------------------------------------------------------- #
+
+
+def _dispatchy_spmd(cpu_devices, **kw):
+    import optax
+
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")], name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="always", **kw)
+    return pipe, optax.sgd(1e-2)
+
+
+def test_dispatch_per_step_fires_on_donated_k1_step(cpu_devices):
+    # The seeded inefficiency: a DONATED train step (per-step StepGuard
+    # retry already impossible) dispatched once per optimizer step.
+    pipe, opt = _dispatchy_spmd(cpu_devices)
+    pipe.make_train_step(opt, donate=True)
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    found = _by_rule(analysis.lint(pipe, x, rules=["dispatch-per-step"]),
+                     "dispatch-per-step")
+    assert found and found[0].severity == Severity.WARNING
+    assert "megastep" in found[0].message
+    assert "donate=False" in found[0].message  # the stand-down is named
+
+
+def test_dispatch_per_step_clean_with_megastep(cpu_devices):
+    pipe, opt = _dispatchy_spmd(cpu_devices, megastep=4)
+    pipe.make_train_step(opt, donate=True)
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    assert analysis.lint(pipe, x, rules=["dispatch-per-step"]) == []
+
+
+def test_dispatch_per_step_stands_down_for_guard_semantics(cpu_devices):
+    # donate=False means the user wants StepGuard's per-step retry —
+    # which NEEDS the Python boundary; the rule must not fight it.
+    pipe, opt = _dispatchy_spmd(cpu_devices)
+    pipe.make_train_step(opt, donate=False)
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    assert analysis.lint(pipe, x, rules=["dispatch-per-step"]) == []
+
+
+def test_dispatch_per_step_stands_down_without_train_step(cpu_devices):
+    # No train step built: nothing to judge.
+    pipe, _ = _dispatchy_spmd(cpu_devices)
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    assert analysis.lint(pipe, x, rules=["dispatch-per-step"]) == []
+
+
+def test_plan_drift_respects_per_step_guard_choice(cpu_devices):
+    """Dispatch-granularity coherence between plan-drift and
+    dispatch-per-step: WITHOUT a donated train step the drift rule
+    compares only candidates at the pipe's own megastep/scan_unroll
+    (per-step StepGuard semantics may be deliberate), so a tiny pipe is
+    not flagged merely for running K=1; WITH a donated step the full
+    K x unroll space applies and the K=1 config drifts."""
+    import optax
+
+    pipe, opt = _dispatchy_spmd(cpu_devices,
+                                hbm_budget_bytes=64 * 2 ** 30)
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    # donate=False (or no step at all): the K axis is filtered out.
+    pipe.make_train_step(opt, donate=False)
+    assert _by_rule(analysis.lint(pipe, x, rules=["plan-drift"]),
+                    "plan-drift") == []
+    # A donated step opens the megastep axis: on this tiny model the
+    # dispatch term dominates, so K=1 drifts far past the threshold.
+    pipe2, opt2 = _dispatchy_spmd(cpu_devices,
+                                  hbm_budget_bytes=64 * 2 ** 30)
+    pipe2.make_train_step(opt2, donate=True)
+    found = _by_rule(analysis.lint(pipe2, x, rules=["plan-drift"]),
+                     "plan-drift")
+    assert found and "megastep" in found[0].message
+
+
+def test_dispatch_per_step_stands_down_on_per_cell_mpmd():
+    import optax
+
+    model = GPipe(_mpmd_layers(), balance=[2, 1], chunks=2)
+    model.make_train_step(optax.sgd(1e-2), mse, donate=True)
+    assert analysis.lint(model, X, target=Y, loss_fn=mse,
+                         rules=["dispatch-per-step"]) == []

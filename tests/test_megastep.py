@@ -1,0 +1,415 @@
+"""Megastep (K optimizer steps in one compiled program) and send-ahead
+comm/compute overlap: the dispatch-killing pair.
+
+The oracle contract megastep lives or dies by: ``make_train_step(
+megastep=K)`` over a ``[K, ...]``-stacked batch must equal K
+StepGuard-wrapped single steps — BITWISE on the SPMD engine (params,
+opt state, losses, the skip mask), and bitwise on params/state/losses
+for the MPMD fused engine (its Adam second moments reassociate ``g*g``
+under XLA's in-scan FMA fusion, bounded at ~1e-8 — asserted, not
+hand-waved).  Send-ahead: the software-pipelined ``ppermute``-at-tail
+carry must reproduce the head-of-tick schedule exactly — bitwise for
+every schedule x checkpoint mode EXCEPT fill_drain+except_last, whose
+peeled two-scan autodiff reassociates float32 accumulation (~6e-7
+measured; the test_moe rtol precedent) and is pinned at a tight
+tolerance instead.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchgpipe_tpu.gpipe import GPipe
+from torchgpipe_tpu.layers import chain, named
+from torchgpipe_tpu.ops import gelu
+from torchgpipe_tpu.ops.nn import dense
+from torchgpipe_tpu.resilience import CheckpointManager, StepGuard
+from torchgpipe_tpu.resilience.guard import GuardPolicy
+from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+
+def _mse(out, tgt):
+    return jnp.mean((out.astype(jnp.float32) - tgt) ** 2)
+
+
+def _leaves_equal(a, b, **kw):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y), **kw)
+
+
+@pytest.fixture(scope="module")
+def cpu_devices():
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 (virtual) devices")
+    return devs
+
+
+def _spmd_pipe(cpu_devices, **kw):
+    block = chain([dense(12, name="fc"), gelu("act")], name="blk")
+    mesh = make_mesh(2, devices=cpu_devices[:2])
+    return SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=_mse, **kw)
+
+
+def _spmd_batches(K, nan_at=None):
+    xs = jax.random.normal(jax.random.PRNGKey(7), (K, 8, 12))
+    ys = jax.random.normal(jax.random.PRNGKey(8), (K, 8, 12))
+    if nan_at is not None:
+        xs = xs.at[nan_at, 0, 0].set(jnp.nan)
+    return xs, ys
+
+
+# --------------------------------------------------------------------- #
+# send-ahead overlap: bitwise vs the head-of-tick schedule              #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("schedule,checkpoint", [
+    ("fill_drain", "always"),
+    ("fill_drain", "never"),
+    ("1f1b", "always"),
+    ("1f1b", "never"),
+    ("1f1b", "except_last"),
+])
+def test_send_ahead_bitwise(cpu_devices, schedule, checkpoint):
+    pipe = _spmd_pipe(cpu_devices, schedule=schedule, checkpoint=checkpoint)
+    legacy = dataclasses.replace(pipe, send_ahead=False)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 12))
+    y = jax.random.normal(jax.random.PRNGKey(2), (8, 12))
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    l1, g1 = pipe.train_step(params, x, y)
+    l2, g2 = legacy.train_step(params, x, y)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    _leaves_equal(g1, g2)
+
+
+def test_send_ahead_except_last_accumulation_tolerance(cpu_devices):
+    """fill_drain + except_last is the ONE combination autodiffed
+    through the peeled two-scan structure: moving the boundary permute
+    across the scan boundary re-fuses the transpose and reassociates
+    float32 accumulation (measured maxabs ~6e-7 on this fixture — same
+    class as the test_moe balance_weight drift).  Loss stays bitwise;
+    grads are pinned at a tolerance an order above the measurement."""
+    pipe = _spmd_pipe(
+        cpu_devices, schedule="fill_drain", checkpoint="except_last"
+    )
+    legacy = dataclasses.replace(pipe, send_ahead=False)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 12))
+    y = jax.random.normal(jax.random.PRNGKey(2), (8, 12))
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    l1, g1 = pipe.train_step(params, x, y)
+    l2, g2 = legacy.train_step(params, x, y)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        )
+
+
+def test_send_ahead_apply_bitwise(cpu_devices):
+    pipe = _spmd_pipe(cpu_devices, checkpoint="except_last")
+    legacy = dataclasses.replace(pipe, send_ahead=False)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 12))
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pipe.apply(params, x)),
+        np.asarray(legacy.apply(params, x)),
+    )
+
+
+# --------------------------------------------------------------------- #
+# SPMD megastep: bitwise K-step oracle, NaN skip inside the scan        #
+# --------------------------------------------------------------------- #
+
+
+def test_spmd_megastep_bitwise_vs_k_guarded_steps(cpu_devices):
+    K = 3
+    pipe = _spmd_pipe(cpu_devices)
+    opt = optax.adamw(1e-3)
+    xs, ys = _spmd_batches(K)
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((8, 12), jnp.float32)
+    )
+    opt_state = pipe.place_tree(opt.init(params))
+    step1 = pipe.make_train_step(opt, donate=False)
+    stepK = pipe.make_train_step(opt, donate=False, megastep=K)
+    assert step1.megastep == 1 and stepK.megastep == K
+
+    guard = StepGuard(step1)
+    p, o = params, opt_state
+    losses = []
+    for k in range(K):
+        l, p, o = guard(p, o, xs[k], ys[k])
+        losses.append(np.asarray(l))
+    lK, pK, oK, finite = stepK(params, opt_state, xs, ys)
+    np.testing.assert_array_equal(np.asarray(lK), np.stack(losses))
+    _leaves_equal(pK, p)
+    _leaves_equal(oK, o)
+    assert np.asarray(finite).all()
+
+
+def test_spmd_megastep_nan_skips_exactly_that_step(cpu_devices):
+    """NaN in inner step k=1's batch: the scan's finite mask must skip
+    EXACTLY that step's update — steps 0 and 2 apply, and the result is
+    bitwise what K guarded single steps (skip included) produce."""
+    K = 3
+    pipe = _spmd_pipe(cpu_devices)
+    opt = optax.adamw(1e-3)
+    xs, ys = _spmd_batches(K, nan_at=1)
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((8, 12), jnp.float32)
+    )
+    opt_state = pipe.place_tree(opt.init(params))
+    step1 = pipe.make_train_step(opt, donate=False)
+    stepK = pipe.make_train_step(opt, donate=False, megastep=K)
+
+    guard = StepGuard(step1)
+    p, o = params, opt_state
+    for k in range(K):
+        _, p, o = guard(p, o, xs[k], ys[k])
+    assert guard.stats.skipped == 1 and guard.stats.steps == 2
+
+    lK, pK, oK, finite = stepK(params, opt_state, xs, ys)
+    assert list(np.asarray(finite)) == [True, False, True]
+    assert not np.isfinite(np.asarray(lK)[1])
+    _leaves_equal(pK, p)
+    _leaves_equal(oK, o)
+
+    # A guard WRAPPING the megastep folds the in-scan mask into its
+    # stats (scan-boundary granularity) instead of re-checking outputs.
+    guardK = StepGuard(stepK)
+    out = guardK(params, opt_state, xs, ys)
+    assert len(out) == 4
+    assert guardK.stats.skipped == 1 and guardK.stats.steps == 2
+
+
+def test_spmd_megastep_rng_fold_in_matches_single_steps(cpu_devices):
+    """With rng, inner step k runs under fold_in(rng, k) — the documented
+    derivation, pinned by replaying single steps with those keys."""
+    K = 2
+    pipe = _spmd_pipe(cpu_devices)
+    opt = optax.sgd(1e-2)
+    xs, ys = _spmd_batches(K)
+    rng = jax.random.PRNGKey(42)
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((8, 12), jnp.float32)
+    )
+    opt_state = pipe.place_tree(opt.init(params))
+    step1 = pipe.make_train_step(opt, donate=False)
+    stepK = pipe.make_train_step(opt, donate=False, megastep=K)
+    p, o = params, opt_state
+    for k in range(K):
+        _, p, o = step1(p, o, xs[k], ys[k], jax.random.fold_in(rng, k))
+    _, pK, oK, _ = stepK(params, opt_state, xs, ys, rng)
+    _leaves_equal(pK, p)
+    _leaves_equal(oK, o)
+
+
+def test_spmd_megastep_donated_carry_runs(cpu_devices):
+    """donate=True (the production shape): the scan carry is donated —
+    the call works and the inputs must be treated as consumed."""
+    K = 2
+    pipe = _spmd_pipe(cpu_devices)
+    opt = optax.sgd(1e-2)
+    xs, ys = _spmd_batches(K)
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((8, 12), jnp.float32)
+    )
+    opt_state = pipe.place_tree(opt.init(params))
+    stepK = pipe.make_train_step(opt, donate=True, megastep=K)
+    lK, pK, oK, finite = stepK(params, opt_state, xs, ys)
+    assert np.asarray(lK).shape == (K,)
+    assert np.asarray(finite).all()
+
+
+def test_megastep_kill_and_resume_at_boundary_bitwise(cpu_devices, tmp_path):
+    """Checkpoint hooks move to megastep boundaries: save after each
+    megastep, kill between megasteps, restore in a fresh incarnation —
+    the finish must be bitwise the uninterrupted run."""
+    K, MEGASTEPS = 2, 3
+    opt = optax.adam(1e-2)
+
+    def setup():
+        pipe = _spmd_pipe(cpu_devices)
+        params = pipe.init(
+            jax.random.PRNGKey(0), jax.ShapeDtypeStruct((8, 12), jnp.float32)
+        )
+        return pipe, params, pipe.place_tree(opt.init(params)), \
+            pipe.make_train_step(opt, donate=False, megastep=K)
+
+    def data(ms):
+        kx = jax.random.fold_in(jax.random.PRNGKey(100), ms)
+        ky = jax.random.fold_in(jax.random.PRNGKey(200), ms)
+        return (
+            jax.random.normal(kx, (K, 8, 12)),
+            jax.random.normal(ky, (K, 8, 12)),
+        )
+
+    # Uninterrupted oracle.
+    _, p, o, stepK = setup()
+    for ms in range(MEGASTEPS):
+        xs, ys = data(ms)
+        _, p, o, _ = stepK(p, o, xs, ys)
+    oracle = (p, o)
+
+    # Incarnation 1: save at each megastep boundary, die after #1.
+    mgr = CheckpointManager(tmp_path / "ck", keep_last_k=2)
+    _, p, o, stepK = setup()
+    for ms in range(2):
+        xs, ys = data(ms)
+        _, p, o, _ = stepK(p, o, xs, ys)
+        mgr.save(ms, {"params": p, "opt": o,
+                      "step": jnp.asarray(ms, jnp.int32)})
+
+    # Incarnation 2: fresh pipe + step, resume from the boundary.
+    pipe, p0, o0, stepK = setup()
+    snap = mgr.restore_latest(
+        template={"params": p0, "opt": o0, "step": jnp.asarray(0, jnp.int32)}
+    )
+    assert int(snap.tree["step"]) == 1
+    p = pipe.place_tree(snap.tree["params"])
+    o = pipe.place_tree(snap.tree["opt"])
+    for ms in range(int(snap.tree["step"]) + 1, MEGASTEPS):
+        xs, ys = data(ms)
+        _, p, o, _ = stepK(p, o, xs, ys)
+    _leaves_equal(oracle[0], p)
+    _leaves_equal(oracle[1], o)
+
+
+# --------------------------------------------------------------------- #
+# MPMD (fused) megastep                                                 #
+# --------------------------------------------------------------------- #
+
+
+def _gpipe_fused():
+    layers = named([dense(12, name="fc1"), gelu("a1"),
+                    dense(12, name="fc2"), dense(6, name="head")])
+    dev = [jax.devices()[0]]
+    return GPipe(layers, balance=[2, 2], chunks=2, devices=dev, fused=True)
+
+
+def test_gpipe_fused_megastep_matches_guarded_single_steps():
+    """MPMD fused oracle: losses/params/model-state BITWISE; the Adam
+    second moments (nu) reassociate g*g under XLA's in-scan FMA fusion
+    — pinned at atol 2e-8 (the measured 1.5e-8 plus headroom), exactly
+    zero drift everywhere else."""
+    K = 3
+    model = _gpipe_fused()
+    opt = optax.adamw(1e-3)
+    params, state = model.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((8, 12), jnp.float32)
+    )
+    opt_state = model.init_opt_state(opt, params)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (K, 8, 12))
+    ys = jax.random.normal(jax.random.PRNGKey(2), (K, 8, 6))
+    xs = xs.at[1, 0, 0].set(jnp.nan)  # NaN at inner step 1
+
+    step1 = model.make_train_step(opt, _mse, donate=False)
+    stepK = model.make_train_step(opt, _mse, donate=False, megastep=K)
+    guard = StepGuard(step1, extra_state_argnums=(2,))
+    p, o, s = params, opt_state, state
+    losses = []
+    for k in range(K):
+        l, p, o, s, _ = guard(p, o, s, xs[k], ys[k])
+        losses.append(np.asarray(l))
+    assert guard.stats.skipped == 1 and guard.stats.steps == 2
+
+    lK, pK, oK, sK, auxK, finite = stepK(params, opt_state, state, xs, ys)
+    assert list(np.asarray(finite)) == [True, False, True]
+    np.testing.assert_array_equal(np.asarray(lK), np.stack(losses))
+    _leaves_equal(pK, p)
+    _leaves_equal(sK, s)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(oK), jax.tree_util.tree_leaves(o)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=2e-8
+        )
+
+
+# --------------------------------------------------------------------- #
+# didactic refusals                                                     #
+# --------------------------------------------------------------------- #
+
+
+def test_megastep_refusals(cpu_devices):
+    layers = named([dense(12, name="fc1"), dense(6, name="head")])
+    # Per-cell MPMD: megastep needs one program — refused at the ctor...
+    with pytest.raises(ValueError, match="fused=True"):
+        GPipe(layers, balance=[1, 1], chunks=2, megastep=4)
+    # ...and at make_train_step.
+    model = GPipe(layers, balance=[1, 1], chunks=2,
+                  devices=[jax.devices()[0]])
+    with pytest.raises(ValueError, match="fused=True"):
+        model.make_train_step(optax.sgd(1e-2), _mse, megastep=4)
+    with pytest.raises(ValueError, match="megastep must be"):
+        model.make_train_step(optax.sgd(1e-2), _mse, megastep=0)
+    # SPMD: K >= 1 validated at the dataclass and the call site.
+    with pytest.raises(ValueError, match="megastep must be"):
+        _spmd_pipe(cpu_devices, megastep=0)
+    pipe = _spmd_pipe(cpu_devices)
+    with pytest.raises(ValueError, match="megastep must be"):
+        pipe.make_train_step(optax.sgd(1e-2), megastep=-1)
+    # A non-stacked batch is refused with the stacking recipe.
+    stepK = pipe.make_train_step(optax.sgd(1e-2), donate=False, megastep=4)
+    params = pipe.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((8, 12), jnp.float32)
+    )
+    o = pipe.place_tree(optax.sgd(1e-2).init(params))
+    with pytest.raises(ValueError, match=r"\[K, \.\.\.\]-stacked"):
+        stepK(params, o, jnp.zeros((8, 12)), jnp.zeros((8, 12)))
+
+
+def test_megastep_donated_retry_refusal_is_didactic():
+    """Transient retry of a megastep whose donated carry was consumed:
+    the guard refuses with the donate=False recipe instead of crashing
+    on deleted arrays (granularity: the WHOLE megastep is the retry
+    unit)."""
+
+    class _Deleted:
+        def is_deleted(self):
+            return True
+
+    calls = {"n": 0}
+
+    def flaky_megastep(params, opt_state, xs, ys):
+        calls["n"] += 1
+        raise ConnectionError("transient blip")
+
+    flaky_megastep.megastep = 4
+    guard = StepGuard(
+        flaky_megastep,
+        policy=GuardPolicy(max_retries=3, backoff_base=0.0),
+        sleep=lambda s: None,
+    )
+    with pytest.raises(ConnectionError) as ei:
+        guard(jax.tree_util.tree_map(lambda x: x, {"w": _Deleted()}),
+              {"nu": _Deleted()}, None, None)
+    assert calls["n"] == 1  # refused BEFORE any re-dispatch
+    if hasattr(ei.value, "add_note"):  # notes exist on Python >= 3.11
+        notes = "".join(getattr(ei.value, "__notes__", []))
+        assert "donate=False" in notes
+
+
+def test_spmd_megastep_defaults_from_pipe_field(cpu_devices):
+    """SpmdGPipe(megastep=K) is the declared default make_train_step
+    compiles — the knob static analysis (dispatch-per-step, planner)
+    reads."""
+    pipe = _spmd_pipe(cpu_devices, megastep=2)
+    assert "megastep=2" in repr(pipe)
+    step = pipe.make_train_step(optax.sgd(1e-2), donate=False)
+    assert step.megastep == 2

@@ -370,6 +370,90 @@ def test_spmd_frontier_and_apply(cpu_devices):
     assert planner.verify_plan(pipe, best) == []
 
 
+def test_megastep_options_canonical_space():
+    """The shared dispatch axis: defaults, steps-filtering, and the
+    honest EMPTY frontier on an indivisible K request."""
+    from torchgpipe_tpu import tune
+
+    assert planner.megastep_options() == [1, 4, 16]
+    # K must divide the checkpoint/preemption hook cadence.
+    assert planner.megastep_options(steps=8) == [1, 4]
+    assert planner.megastep_options(steps=48) == [1, 4, 16]
+    # A requested K that doesn't divide it is dropped — empty is honest.
+    assert planner.megastep_options([3], steps=16) == []
+    assert planner.megastep_options([0, -2]) == []
+    # tune re-exports the SAME definition.
+    assert tune.megastep_options(steps=8) == [1, 4]
+    assert tune.scan_unroll_options("fill_drain") == [1]
+    assert tune.scan_unroll_options("1f1b") == [1, True]
+
+
+def test_spmd_plan_sweeps_megastep_and_scan_unroll(cpu_devices):
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")], name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="always", loss_reduction="mean")
+    report = planner.plan(pipe, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2,))
+    ks = {p.megastep for p in report.candidates}
+    assert ks == {1, 4, 16}
+    # scan_unroll=True only rides the slot-buffer schedules.
+    unrolled = {p.schedule for p in report.candidates
+                if p.scan_unroll is True}
+    assert "fill_drain" not in unrolled and "1f1b" in unrolled
+    # Megastep amortizes dispatch: for a fixed base config, bigger K
+    # never predicts lower MFU.
+    def mfu(schedule, mode, K, u=1):
+        return next(p.predicted_mfu for p in report.candidates
+                    if (p.schedule, p.checkpoint, p.megastep,
+                        p.scan_unroll) == (schedule, mode, K, u))
+    assert mfu("fill_drain", "always", 16) > mfu("fill_drain", "always", 4)
+    assert mfu("fill_drain", "always", 4) > mfu("fill_drain", "always", 1)
+    # The K/u table columns render.
+    assert "K=" in report.table().splitlines()[1]
+    # apply_plan carries the dispatch axes onto the pipe.
+    applied = planner.apply_plan(pipe, report.best)
+    assert applied.megastep == report.best.megastep
+    assert applied.scan_unroll == report.best.scan_unroll
+
+
+def test_spmd_indivisible_megastep_yields_empty_frontier(cpu_devices):
+    """A requested megastep that doesn't divide the hook cadence leaves
+    NO candidates (no silent fallback) — plan_report's exit-1 contract."""
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")], name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse)
+    report = planner.plan(pipe, X, hbm_budget_bytes=64 << 30,
+                          chunks_options=(2,),
+                          megastep_options=[3], steps=16)
+    assert report.candidates == [] and report.best is None
+
+
+def test_makespan_comm_cost_hidden_vs_serial():
+    """The overlapped-edge cost model: with per-transfer comm cost, the
+    send-ahead graph's critical path is strictly shorter than the
+    serial head-of-tick graph's (the transfer rides under the next
+    tick's compute instead of gating it), and with zero comm cost both
+    collapse to the historical model."""
+    n, m = 4, 8
+    serial = ev.spmd_fill_drain_events(n, m)
+    ahead = ev.spmd_fill_drain_events(n, m, send_ahead=True)
+    assert all(t.overlapped for t in ahead.transfers)
+    assert not any(t.overlapped for t in serial.transfers)
+    cost = lambda e: 1.0  # noqa: E731
+    comm = lambda t: 0.25  # noqa: E731
+    span_serial, _ = ev.makespan(serial, cost, comm)
+    span_ahead, _ = ev.makespan(ahead, cost, comm)
+    assert span_ahead < span_serial
+    # Zero comm cost: identical, and equal to the comm-free model.
+    s0, _ = ev.makespan(serial, cost)
+    a0, _ = ev.makespan(ahead, cost, lambda t: 0.0)
+    assert s0 == a0
+    # The receiver still pays the wire even when overlapped: latency is
+    # hidden, not deleted.
+    assert span_ahead > s0
+
+
 def test_spmd_over_budget_candidates_are_rejected_not_dropped(cpu_devices):
     block = chain([layer_norm(name="ln"), dense(16, name="fc")], name="blk")
     mesh = make_mesh(2, 1, devices=cpu_devices[:2])
@@ -521,8 +605,12 @@ def test_spmd_applied_plan_with_policy_is_drift_clean(cpu_devices):
     applied = planner.apply_plan(pipe, with_policy)
     assert planner._config_of(applied) == (
         with_policy.schedule, with_policy.checkpoint, with_policy.policy,
-        with_policy.chunks, None,
+        with_policy.chunks, None, with_policy.megastep,
+        planner._unroll_key(with_policy.scan_unroll),
     )
+    # True == 1 in Python: the key must NOT conflate full unroll with
+    # the default, or drift matching resolves onto the wrong candidate.
+    assert planner._unroll_key(True) != planner._unroll_key(1)
     top = planner.apply_plan(pipe, report.best)
     assert analysis.lint(top, X, rules=["plan-drift"]) == []
 

@@ -322,6 +322,40 @@ def test_admission_budget_caps_active_slots(flat_params):
                hbm_budget_bytes=1)
 
 
+def test_steady_decode_reuses_device_lengths(flat_params, monkeypatch):
+    """The decode hot path must NOT re-upload the slot frontiers every
+    step: the compiled step returns the advanced lengths vector and the
+    engine re-feeds it; ``pool.lengths_device()`` (the host→device
+    snapshot copy) runs only when something OTHER than a step mutated
+    the host mirror — admission and eviction — and the outputs stay
+    exactly the per-request ``generate`` reference."""
+    from torchgpipe_tpu.serving import cache_pool
+
+    uploads = {"n": 0}
+    real = cache_pool.CachePool.lengths_device
+
+    def counting(self):
+        uploads["n"] += 1
+        return real(self)
+
+    monkeypatch.setattr(cache_pool.CachePool, "lengths_device", counting)
+    eng = Engine(CFG, flat_params, num_slots=2, max_len=64,
+                 prefill_chunk=4)
+    p = np.arange(4, dtype=np.int32) % CFG.vocab
+    rid = eng.submit(p, 24)   # long generation: many steady decode steps
+    eng.run()
+    snap = eng.metrics.snapshot()
+    steps = snap["engine_steps"]
+    assert steps > 10
+    # One upload at admission (the alloc zeroed the slot's frontier) and
+    # one when the finished request released it mid-"idle"; every steady
+    # decode step reused the device-resident vector.
+    assert uploads["n"] <= 2, (uploads, steps)
+    assert eng.result(rid).tolist() == _ref(
+        flat_params, p, 24, max_len=64
+    ).tolist()
+
+
 def test_dispatch_retries_transient_errors(flat_params):
     """A transient failure in a compiled step is retried INSIDE the
     engine (bounded backoff, counted in metrics) and the request still

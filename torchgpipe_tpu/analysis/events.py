@@ -93,6 +93,15 @@ class Transfer:
     lost: bool = False  # send never arrives (drop/lose faults)
     duplicated: bool = False  # message delivered twice
     delay: int = 0  # ticks late (lockstep schedules read garbage)
+    # Send-ahead overlap (SpmdGPipe.send_ahead): the transfer is issued
+    # right after ``src`` computes, at the producing tick's TAIL, so it
+    # rides UNDER the sender rank's next compute instead of blocking it.
+    # The cost model (:func:`makespan` with ``comm_cost_of``) charges a
+    # serial transfer against both the receiver AND the sender's next
+    # event (the head-of-tick permute gates the whole lockstep tick);
+    # an overlapped one only delays the receiver — the hidden-transfer
+    # shape, never double-counted.
+    overlapped: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -443,19 +452,30 @@ def distributed_events(
 
 
 def _ring_transfer(
-    src: Event, dst: Event, kind: str, tick: int
+    src: Event, dst: Event, kind: str, tick: int,
+    overlapped: bool = False,
 ) -> Transfer:
     return Transfer(
         src, dst, Channel(kind, src.mb, src.rank, dst.rank),
-        collective=(kind, tick),
+        collective=(kind, tick), overlapped=overlapped,
     )
 
 
-def spmd_fill_drain_events(n: int, m: int, stop: int = 0) -> EventGraph:
+def spmd_fill_drain_events(
+    n: int, m: int, stop: int = 0, send_ahead: bool = False
+) -> EventGraph:
     """The compiled fill-drain scan (``spmd.SpmdGPipe``): lane ``j`` runs
     micro-batch ``t - j`` at tick ``t``; hand-offs ride one forward-ring
     ``ppermute`` per tick; backward is ``jax.grad`` through the scan, so
-    its events mirror the forward in exact reverse."""
+    its events mirror the forward in exact reverse.
+
+    ``send_ahead=True`` marks every ring transfer OVERLAPPED — the
+    engine's software-pipelined carry issues tick t's permute at tick
+    t's tail, so the cost model hides it under the next tick's compute
+    instead of charging the sender's chain.  Same nodes, same edges,
+    same ORDERING verdicts — only the makespan weighting changes here;
+    the 1f1b engine's extra recv_f/recv_b carry buffers are charged by
+    the planner's fixed-resident term, not by this graph."""
     g = EventGraph("spmd", "fill_drain", n, m, [[] for _ in range(n)],
                    lockstep=True, gathered_loss=True)
     fwd_of: Dict[Tuple[int, int], Event] = {}
@@ -482,7 +502,8 @@ def spmd_fill_drain_events(n: int, m: int, stop: int = 0) -> EventGraph:
         for ev in row:
             if ev.stage < n - 1:
                 g.transfers.append(_ring_transfer(
-                    ev, fwd_of[(ev.mb, ev.stage + 1)], "fwd_ring", t
+                    ev, fwd_of[(ev.mb, ev.stage + 1)], "fwd_ring", t,
+                    overlapped=send_ahead,
                 ))
     for t in range(ticks):
         for ev in fwd_ticks[t]:
@@ -492,6 +513,7 @@ def spmd_fill_drain_events(n: int, m: int, stop: int = 0) -> EventGraph:
                     bwd_of[(ev.mb, ev.stage)],
                     bwd_of[(ev.mb, ev.stage - 1)],
                     "bwd_ring", 2 * ticks - 1 - t,
+                    overlapped=send_ahead,
                 ))
     for i in range(m):
         for k in range(m):
@@ -501,10 +523,14 @@ def spmd_fill_drain_events(n: int, m: int, stop: int = 0) -> EventGraph:
     return g
 
 
-def spmd_1f1b_events(n: int, m: int, stop: int = 0) -> EventGraph:
+def spmd_1f1b_events(
+    n: int, m: int, stop: int = 0, send_ahead: bool = False
+) -> EventGraph:
     """The compiled 1F1B scan, from the engine's closed-form tick
     predicates (``spmd._build_train_step_1f1b`` — the same predicates
-    ``parallel.zerobubble.fused_1f1b_weighted_makespan`` evaluates)."""
+    ``parallel.zerobubble.fused_1f1b_weighted_makespan`` evaluates).
+    ``send_ahead`` marks the ring transfers overlapped, as in
+    :func:`spmd_fill_drain_events`."""
     g = EventGraph("spmd", "1f1b", n, m, [[] for _ in range(n)],
                    lockstep=True, gathered_loss=False)
     fwd_of: Dict[Tuple[int, int], Event] = {}
@@ -536,10 +562,12 @@ def spmd_1f1b_events(n: int, m: int, stop: int = 0) -> EventGraph:
             g.transfers.append(_ring_transfer(
                 fwd_of[(i, j)], fwd_of[(i, j + 1)],
                 "fwd_ring", fwd_tick[(i, j)],
+                overlapped=send_ahead,
             ))
             g.transfers.append(_ring_transfer(
                 bwd_of[(i, j + 1)], bwd_of[(i, j)],
                 "bwd_ring", bwd_tick[(i, j + 1)],
+                overlapped=send_ahead,
             ))
         g.deps.append((fwd_of[(i, n - 1)], bwd_of[(i, n - 1)]))
     _annotate_mpmd_buffers(g, fwd_of, bwd_of, stop, n, m)
@@ -653,7 +681,9 @@ def spmd_zb_events(n: int, m: int) -> EventGraph:
 
 
 def makespan(
-    g: EventGraph, cost_of: Callable[[Event], float]
+    g: EventGraph,
+    cost_of: Callable[[Event], float],
+    comm_cost_of: Optional[Callable[[Transfer], float]] = None,
 ) -> Tuple[float, List[float]]:
     """Critical-path makespan of the schedule under per-event costs.
 
@@ -666,20 +696,42 @@ def makespan(
     sums each rank's own event costs — the schedule's bubble fraction is
     ``1 - sum(busy) / (n_ranks * makespan)``.
 
+    ``comm_cost_of(transfer)`` (optional) charges transfer latency in
+    the same unit.  A SERIAL transfer (the head-of-tick ``ppermute``
+    shape) delays BOTH its receiver and the sender rank's next event —
+    the whole lockstep tick gates on the hand-off, which is exactly the
+    double-counting the send-ahead restructure removes; an OVERLAPPED
+    transfer (``Transfer.overlapped``, the send-ahead shape) delays only
+    its receiver, hiding under the sender's next compute.  Omitting
+    ``comm_cost_of`` reproduces the historical zero-cost-comm model.
+
     Raises ``ValueError`` on a cyclic graph (run
     :func:`torchgpipe_tpu.analysis.schedule.verify_ordering` first — a
     deadlocked schedule has no makespan).
     """
     events = g.events()
-    succ: Dict[Event, List[Event]] = {}
+    succ: Dict[Event, List[Tuple[Event, float]]] = {}
     indeg: Dict[Event, int] = {e: 0 for e in events}
-    edges: List[Tuple[Event, Event]] = []
+    edges: List[Tuple[Event, Event, float]] = []
+    next_on_rank: Dict[Event, Optional[Event]] = {}
     for rank_order in g.order:
-        edges.extend(zip(rank_order, rank_order[1:]))
-    edges.extend(g.deps)
-    edges.extend((t.src, t.dst) for t in g.transfers if not t.lost)
-    for a, b in edges:
-        succ.setdefault(a, []).append(b)
+        edges.extend((a, b, 0.0) for a, b in zip(rank_order, rank_order[1:]))
+        for a, b in zip(rank_order, rank_order[1:]):
+            next_on_rank[a] = b
+    edges.extend((a, b, 0.0) for a, b in g.deps)
+    for t in g.transfers:
+        if t.lost:
+            continue
+        w = float(comm_cost_of(t)) if comm_cost_of is not None else 0.0
+        edges.append((t.src, t.dst, w))
+        if w > 0.0 and not t.overlapped:
+            # Serial hand-off: the sender's own pipeline also waits for
+            # the wire (the permute sits at the next tick's head).
+            nxt = next_on_rank.get(t.src)
+            if nxt is not None:
+                edges.append((t.src, nxt, w))
+    for a, b, _w in edges:
+        succ.setdefault(a, []).append((b, _w))
         indeg[b] = indeg.get(b, 0) + 1
     finish: Dict[Event, float] = {}
     ready = [e for e, d in indeg.items() if d == 0]
@@ -692,8 +744,8 @@ def makespan(
         f = start.get(e, 0.0) + float(cost_of(e))
         finish[e] = f
         total = max(total, f)
-        for child in succ.get(e, []):
-            start[child] = max(start.get(child, 0.0), f)
+        for child, w in succ.get(e, []):
+            start[child] = max(start.get(child, 0.0), f + w)
             indeg[child] -= 1
             if indeg[child] == 0:
                 ready.append(child)
@@ -710,12 +762,14 @@ def makespan(
 
 
 def bubble_fraction(
-    g: EventGraph, cost_of: Callable[[Event], float]
+    g: EventGraph,
+    cost_of: Callable[[Event], float],
+    comm_cost_of: Optional[Callable[[Transfer], float]] = None,
 ) -> float:
     """Idle fraction of the schedule under per-event costs: the share of
     ``n_ranks × makespan`` no rank spends computing.  Fill-drain with
     uniform cells gives the classic ``(n-1)/(m+n-1)``."""
-    span, busy = makespan(g, cost_of)
+    span, busy = makespan(g, cost_of, comm_cost_of)
     denom = g.n_ranks * span
     if denom <= 0:
         return 0.0
@@ -744,10 +798,15 @@ def events_for(pipe: Any, chunks: Optional[int] = None) -> EventGraph:
     if isinstance(pipe, SpmdGPipe):
         m = chunks or pipe.chunks
         stop = checkpoint_stop(pipe.checkpoint, m, train=True)
+        send_ahead = bool(getattr(pipe, "send_ahead", False))
         if pipe.schedule == "fill_drain":
-            return spmd_fill_drain_events(pipe.n_stages, m, stop)
+            return spmd_fill_drain_events(
+                pipe.n_stages, m, stop, send_ahead=send_ahead
+            )
         if pipe.schedule == "1f1b":
-            return spmd_1f1b_events(pipe.n_stages, m, stop)
+            return spmd_1f1b_events(
+                pipe.n_stages, m, stop, send_ahead=send_ahead
+            )
         if pipe.schedule == "interleaved":
             return spmd_interleaved_events(
                 pipe.n_stages, m, pipe.virtual_stages

@@ -146,6 +146,40 @@ def mpmd_chunk_options(
     })
 
 
+# Megastep candidates: K optimizer steps per compiled program
+# (make_train_step(megastep=K)).  The canonical rungs bench.py's
+# --megastep ladder times.
+MEGASTEP_SPACE: Tuple[int, ...] = (1, 4, 16)
+
+
+def megastep_options(
+    requested: Optional[Sequence[int]] = None,
+    steps: Optional[int] = None,
+) -> List[int]:
+    """Megastep K candidates — THE axis :func:`plan`, ``tune`` and the
+    bench ladder share.  ``steps`` (the caller's checkpoint/preemption
+    cadence — hooks move to megastep boundaries, so K must divide it)
+    filters the space; a requested K that doesn't divide it is DROPPED,
+    and an all-indivisible request returns the honest EMPTY list (no
+    candidates — the ``mpmd_chunk_options`` precedent), which
+    ``plan``/``plan_report`` surface as an empty frontier."""
+    opts = list(requested) if requested is not None else list(MEGASTEP_SPACE)
+    opts = [int(k) for k in opts if int(k) >= 1]
+    if steps is not None:
+        opts = [k for k in opts if steps % k == 0]
+    return sorted(dict.fromkeys(opts))
+
+
+def scan_unroll_options(schedule: str) -> List[Any]:
+    """scan_unroll candidates per schedule: the slot-buffer schedules
+    measured faster fully unrolled (BENCH_NOTES round 4 —
+    ``tune.UNROLL_LANE_DISCOUNT``), fill_drain measured slower, so its
+    axis stays at the default."""
+    if schedule == "fill_drain":
+        return [1]
+    return [1, True]
+
+
 def spmd_schedule_space(pipe: Any) -> List[str]:
     """Schedules an existing SPMD pipe can be re-planned onto WITHOUT
     changing the model: a pipe built interleaved keeps its block
@@ -202,6 +236,12 @@ class Plan:
     host_bytes: int  # host-offloaded bytes at the peak (checkpoint='offload')
     feasible: bool
     certified: bool  # ordering + memory certification both ran clean
+    # Dispatch-granularity axes (SPMD engine): K optimizer steps per
+    # compiled program and the tick scan's unroll factor.  MPMD plans
+    # keep the defaults (megastep needs the fused single-device path,
+    # which the planner's per-cell candidates don't build).
+    megastep: int = 1
+    scan_unroll: Any = 1
     reason: str = ""
 
     def describe(self) -> str:
@@ -221,9 +261,11 @@ class Plan:
         host = (
             f" +{self.host_bytes / GiB:.2f} host" if self.host_bytes else ""
         )
+        unroll = "full" if self.scan_unroll is True else self.scan_unroll
         return (
             f"{self.schedule:<11} {self.checkpoint:<12} "
-            f"{self.policy or '-':<20} m={self.chunks:<3} bal={bal:<9} "
+            f"{self.policy or '-':<20} m={self.chunks:<3} "
+            f"K={self.megastep:<3} u={unroll:<4} bal={bal:<9} "
             f"mfu~{mfu:<8} bubble={bub:<6} "
             f"hwm={self.hwm_bytes / GiB:6.2f} GiB{host}  {status}"
         )
@@ -246,7 +288,8 @@ class PlanReport:
     def table(self) -> str:
         head = (
             f"{'schedule':<11} {'checkpoint':<12} {'policy':<20} "
-            f"{'m':<5} {'balance':<13} {'pred-mfu':<13} {'bubble':<13} "
+            f"{'m':<5} {'K':<5} {'u':<6} {'balance':<13} "
+            f"{'pred-mfu':<13} {'bubble':<13} "
             f"per-rank HWM (budget {self.hbm_budget_bytes / GiB:.2f} GiB)"
         )
         return "\n".join([head] + [p.describe() for p in self.candidates])
@@ -394,6 +437,8 @@ def _plan_spmd(
     target: Optional[Pytree],
     schedules: Optional[Sequence[str]],
     chunks_options: Optional[Sequence[int]],
+    megastep_opts: Optional[Sequence[int]],
+    steps: Optional[int],
     overhead_bytes: int,
     param_scale: float,
 ) -> PlanReport:
@@ -442,6 +487,9 @@ def _plan_spmd(
     lane_flops = (
         model_flops / (dp * ep) if model_flops is not None else None
     )
+    # The dispatch-granularity axis: an all-indivisible megastep request
+    # (K not dividing the hook cadence) yields the honest EMPTY frontier.
+    mega_space = megastep_options(megastep_opts, steps)
     plans: List[Plan] = []
     for chunks in spmd_chunk_options(pipe, B, chunks_options):
         mb_spec = (
@@ -578,9 +626,20 @@ def _plan_spmd(
                 ticks = (
                     chunks + n - 1 if schedule == "fill_drain" else n
                 )
+                # Send-ahead on the slot-buffer 1f1b schedule carries
+                # the permuted act/gact BESIDE the raw ones (two extra
+                # activation-sized pytrees per lane; fill_drain's
+                # send-ahead carry REPLACES the raw one — no growth).
+                send_ahead_carry = (
+                    2 * mb_bytes
+                    if schedule == "1f1b"
+                    and bool(getattr(pipe, "send_ahead", False))
+                    else 0
+                )
                 fixed = int(
                     param_bytes * param_scale
                     + ticks * mb_bytes
+                    + send_ahead_carry
                     + overhead_bytes
                 )
                 hwm = cert.high_water + fixed
@@ -604,18 +663,42 @@ def _plan_spmd(
                 if lane_flops is not None:
                     useful_cells = n * chunks * (fwd + bwd)
                     epilogue = max(lane_flops - useful_cells, 0.0) / n
-                mfu, bubble = _graph_score(
-                    g, cost_of, model_flops, n_chips, epilogue
-                )
-                plans.append(Plan(
-                    engine="spmd", schedule=schedule, balance=None,
-                    chunks=chunks, checkpoint=mode, policy=label,
-                    virtual_stages=v, predicted_mfu=mfu,
-                    bubble_fraction=bubble, hwm_bytes=hwm,
-                    host_bytes=host_peak, feasible=feasible,
-                    certified=True,
-                    reason="" if feasible else "over HBM budget",
-                ))
+                # One graph walk per base candidate; the megastep ×
+                # scan_unroll refinements are arithmetic over the same
+                # span (the graph/cert/atoms do not depend on K or the
+                # unroll factor — only the lane-time model does).
+                try:
+                    span, busy = ev.makespan(g, cost_of)
+                except ValueError:
+                    span = None
+                bubble = None
+                if span is not None and g.n_ranks * span > 0:
+                    bubble = max(
+                        0.0, 1.0 - sum(busy) / (g.n_ranks * span)
+                    )
+                for K in mega_space:
+                    for u in scan_unroll_options(schedule):
+                        mfu = None
+                        if span is not None and model_flops is not None:
+                            disc = (
+                                tune.UNROLL_LANE_DISCOUNT
+                                if u is True else 1.0
+                            )
+                            lane = (
+                                span * disc + epilogue
+                                + tune.DISPATCH_OVERHEAD_FLOPS / K
+                            )
+                            if lane > 0:
+                                mfu = model_flops / (n_chips * lane)
+                        plans.append(Plan(
+                            engine="spmd", schedule=schedule, balance=None,
+                            chunks=chunks, checkpoint=mode, policy=label,
+                            virtual_stages=v, predicted_mfu=mfu,
+                            bubble_fraction=bubble, hwm_bytes=hwm,
+                            host_bytes=host_peak, feasible=feasible,
+                            certified=True, megastep=K, scan_unroll=u,
+                            reason="" if feasible else "over HBM budget",
+                        ))
     return _ranked(plans, hbm_budget_bytes)
 
 
@@ -809,11 +892,20 @@ def plan(
     schedules: Optional[Sequence[str]] = None,
     chunks_options: Optional[Sequence[int]] = None,
     balance_options: Optional[Sequence[Sequence[int]]] = None,
+    megastep_options: Optional[Sequence[int]] = None,
+    steps: Optional[int] = None,
     overhead_bytes: Optional[int] = None,
     param_scale: Optional[float] = None,
 ) -> PlanReport:
-    """Search balance × schedule × chunks × remat statically and return
-    the certified frontier.
+    """Search balance × schedule × chunks × remat × dispatch granularity
+    statically and return the certified frontier.
+
+    ``megastep_options`` / ``steps`` control the SPMD dispatch axis:
+    megastep K candidates (default :data:`MEGASTEP_SPACE`) filtered to
+    divisors of ``steps`` when given — checkpoint/preemption hooks run
+    at megastep boundaries, so K must divide the hook cadence; an
+    all-indivisible request yields an EMPTY frontier rather than a
+    silently-adjusted one.
 
     ``pipe`` is a :class:`~torchgpipe_tpu.spmd.SpmdGPipe` or
     :class:`~torchgpipe_tpu.gpipe.GPipe`; ``batch`` a representative
@@ -844,6 +936,7 @@ def plan(
     return _plan_spmd(
         pipe, batch, hbm_budget_bytes, target=target,
         schedules=schedules, chunks_options=chunks_options,
+        megastep_opts=megastep_options, steps=steps,
         overhead_bytes=overhead, param_scale=scale,
     )
 
@@ -874,6 +967,8 @@ def apply_plan(pipe: Any, chosen: Plan) -> Any:
         checkpoint=chosen.checkpoint,
         remat_policy=tune.resolve_policy(chosen.policy),
         chunks=chosen.chunks,
+        megastep=chosen.megastep,
+        scan_unroll=chosen.scan_unroll,
     )
 
 
@@ -929,16 +1024,27 @@ def _spmd_policy_label(pipe: Any) -> Optional[str]:
     return f"<custom:{getattr(policy, 'label', policy)!r}>"
 
 
+def _unroll_key(u: Any) -> Any:
+    """Disambiguating key for a scan_unroll value: ``True == 1`` in
+    Python, so raw tuple comparison would conflate the full-unroll
+    candidate with the default — and the drift rule would resolve a
+    pipe onto the wrong candidate's MFU."""
+    return "full" if u is True else int(u)
+
+
 def _config_of(pipe: Any) -> Tuple:
-    """The (schedule, checkpoint, policy-label, chunks, balance) key a
-    pipe actually runs — matched against the planner's candidates."""
+    """The (schedule, checkpoint, policy-label, chunks, balance,
+    megastep, scan_unroll-key) key a pipe actually runs — matched
+    against the planner's candidates."""
     from torchgpipe_tpu.gpipe import GPipe
 
     if isinstance(pipe, GPipe):
         return (pipe.schedule, pipe.checkpoint, None, pipe.chunks,
-                tuple(pipe.balance))
+                tuple(pipe.balance), getattr(pipe, "megastep", 1),
+                _unroll_key(1))
     return (pipe.schedule, pipe.checkpoint, _spmd_policy_label(pipe),
-            pipe.chunks, None)
+            pipe.chunks, None, pipe.megastep,
+            _unroll_key(pipe.scan_unroll))
 
 
 def check_plan_drift(trace: Any) -> List[Finding]:
@@ -956,22 +1062,36 @@ def check_plan_drift(trace: Any) -> List[Finding]:
         report = plan(trace.pipe, trace.x_spec, budget)
     except Exception:  # noqa: BLE001 - the planner stands down, not lint
         return []
+    # Dispatch-granularity coherence with the dispatch-per-step rule:
+    # unless the pipe built a DONATED train step (which already forfeits
+    # per-step StepGuard retry), the user may be keeping megastep=1 /
+    # scan_unroll for per-step guard semantics — compare only against
+    # candidates at the pipe's OWN dispatch granularity rather than
+    # recommending the coarsening that rule deliberately stands down
+    # for.  A donated step makes the full K x unroll space fair game.
+    if getattr(trace.pipe, "_train_step_donate", None) is not True:
+        own_k = getattr(trace.pipe, "megastep", 1)
+        own_u = _unroll_key(getattr(trace.pipe, "scan_unroll", 1))
+        candidates = [
+            p for p in report.candidates
+            if p.megastep == own_k and _unroll_key(p.scan_unroll) == own_u
+        ]
+        report = dataclasses.replace(report, candidates=candidates)
     top = report.best
     if top is None or top.predicted_mfu is None:
         return []
+    def plan_key(p: Plan) -> Tuple:
+        return (p.schedule, p.checkpoint, p.policy, p.chunks, p.balance,
+                p.megastep, _unroll_key(p.scan_unroll))
+
     actual_key = _config_of(trace.pipe)
     actual = next(
-        (
-            p for p in report.candidates
-            if (p.schedule, p.checkpoint, p.policy, p.chunks,
-                p.balance) == actual_key
-        ),
+        (p for p in report.candidates if plan_key(p) == actual_key),
         None,
     )
     if actual is None or actual.predicted_mfu is None:
         return []
-    top_key = (top.schedule, top.checkpoint, top.policy, top.chunks,
-               top.balance)
+    top_key = plan_key(top)
     if top_key == actual_key:
         return []
     drift = 1.0 - actual.predicted_mfu / top.predicted_mfu
@@ -989,11 +1109,13 @@ def check_plan_drift(trace: Any) -> List[Finding]:
         message=(
             f"the configured plan (schedule={actual.schedule!r}, "
             f"checkpoint={actual.checkpoint!r}, "
-            f"policy={actual.policy or '-'}, chunks={actual.chunks}"
+            f"policy={actual.policy or '-'}, chunks={actual.chunks}, "
+            f"megastep={actual.megastep}"
             + (f", balance={list(actual.balance)}" if actual.balance else "")
             + f") {what} than the certified top plan "
             f"(schedule={top.schedule!r}, checkpoint={top.checkpoint!r}, "
-            f"policy={top.policy or '-'}, chunks={top.chunks}"
+            f"policy={top.policy or '-'}, chunks={top.chunks}, "
+            f"megastep={top.megastep}"
             + (f", balance={list(top.balance)}" if top.balance else "")
             + f", predicted MFU {top.predicted_mfu:.4f}, certified "
             f"HWM {top.hwm_bytes / GiB:.2f} GiB) — the drift threshold "

@@ -497,6 +497,71 @@ def _check_remat_policy_names(trace: PipelineTrace) -> List[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# dispatch-per-step                                                     #
+# --------------------------------------------------------------------- #
+
+
+def _check_dispatch_per_step(trace: PipelineTrace) -> List[Finding]:
+    """WARNING: a guarded train loop that re-enters Python once per
+    optimizer step on a pipe where ``megastep`` is available and
+    certified.
+
+    Fires when the pipe declares ``megastep == 1`` AND a DONATED train
+    step was built (``make_train_step(donate=True)`` — the engines
+    record ``_train_step_donate``): donation already forfeits
+    StepGuard's per-step retry/skip-restore (retry needs undonated
+    inputs, and skip-restore needs the old params to survive), so
+    nothing is lost by compiling K steps into one program — the
+    per-step Python dispatch and host sync are pure overhead.
+
+    Stand-downs (each deliberate):
+
+    * ``donate=False`` — the user opted into StepGuard's per-step
+      retry/skip-restore semantics, which NEED the Python boundary
+      between steps; megastep would coarsen the retry granularity they
+      asked for;
+    * no train step built — nothing to judge;
+    * MPMD per-cell scheduler (``fused=False``) — megastep requires the
+      whole step to be one program;
+    * the pipe's own schedule graph fails ``verify_ordering`` — do not
+      recommend compiling K copies of a broken schedule.
+    """
+    pipe = trace.pipe
+    if int(getattr(pipe, "megastep", 1) or 1) > 1:
+        return []
+    if getattr(pipe, "_train_step_donate", None) is not True:
+        return []
+    if trace.engine == "mpmd" and not getattr(pipe, "fused", False):
+        return []
+    try:
+        from torchgpipe_tpu.analysis import events as ev
+        from torchgpipe_tpu.analysis import schedule as sched
+
+        if sched.verify_ordering(ev.events_for(pipe)):
+            return []
+    except Exception:  # noqa: BLE001 - can't certify, stand down
+        return []
+    return [Finding(
+        rule="dispatch-per-step",
+        severity=Severity.WARNING,
+        path=f"{trace.engine}/train_step",
+        message=(
+            "the training loop re-enters Python once per optimizer step "
+            "(megastep=1) on a pipe whose donated train step already "
+            "forfeits per-step StepGuard retry — compile K steps into "
+            "one program with make_train_step(megastep=K) (or declare "
+            "megastep= on the pipe): per-step dispatch, host sync and "
+            "guard bookkeeping drop K-fold, NaN skip-step moves inside "
+            "the scan, and checkpoint/preemption hooks run at megastep "
+            "boundaries (docs/tuning.md, megastep section).  Keep "
+            "megastep=1 only when StepGuard's per-step transient-retry "
+            "granularity is required — then build the step with "
+            "donate=False, which stands this rule down"
+        ),
+    )]
+
+
+# --------------------------------------------------------------------- #
 # registry + runner                                                     #
 # --------------------------------------------------------------------- #
 
@@ -540,6 +605,14 @@ RULES: List[Rule] = [
         "a named-save remat policy must reference checkpoint names that "
         "occur in the traced program (no silent no-op policies)",
         _check_remat_policy_names,
+    ),
+    Rule(
+        "dispatch-per-step",
+        "a donated train step on a megastep-capable pipe should not "
+        "re-enter Python per optimizer step (make_train_step(megastep=K) "
+        "compiles K steps into one program); stands down when "
+        "donate=False keeps StepGuard's per-step retry semantics",
+        _check_dispatch_per_step,
     ),
 ]
 

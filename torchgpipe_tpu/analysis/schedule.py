@@ -797,6 +797,11 @@ def selfcheck(verbose: bool = False) -> List[Finding]:
             ev.distributed_events(n, m, stop=m - 1),
             ev.spmd_fill_drain_events(n, m),
             ev.spmd_1f1b_events(n, m),
+            # The send-ahead (overlapped ppermute) shapes must verify
+            # identically: same nodes/edges, only the cost-model flag on
+            # the ring transfers differs.
+            ev.spmd_fill_drain_events(n, m, send_ahead=True),
+            ev.spmd_1f1b_events(n, m, send_ahead=True),
             ev.spmd_zb_events(n, m),
         ]
         if m % n == 0:

@@ -72,7 +72,10 @@ def _drive_signatures(
                 "n_valid": n_valid, "key": key,
             }))
             # Token 0 for every slot: requests terminate by budget.
-            return jnp.zeros((S,), jnp.int32), cache, key
+            # Same output arity as the real bodies (tok, cache,
+            # advanced lengths, key) — the engine adopts the advanced
+            # frontiers as its device-resident lengths.
+            return jnp.zeros((S,), jnp.int32), cache, lengths + n_valid, key
         return fn
 
     real = engine._prefill_fn, engine._decode_fn

@@ -70,6 +70,7 @@ class GPipe:
         remat_policy: Any = None,
         tracer: Any = None,
         hbm_budget_bytes: Optional[int] = None,
+        megastep: int = 1,
     ) -> None:
         if balance is None:
             raise ValueError(
@@ -214,6 +215,22 @@ class GPipe:
             )
         self.fused = fused
         self.remat_policy = remat_policy
+        # Default megastep K for make_train_step (K optimizer steps in one
+        # compiled program).  Declared at the pipe so static analysis (the
+        # dispatch-per-step lint rule) sees the dispatch granularity.
+        if not (isinstance(megastep, int) and not isinstance(megastep, bool)
+                and megastep >= 1):
+            raise ValueError(f"megastep must be an int >= 1, got {megastep!r}")
+        if megastep > 1 and not fused:
+            raise ValueError(
+                "megastep compiles K optimizer steps into ONE program "
+                "(lax.scan over the full step), which needs the whole step "
+                "to BE one program: the per-cell scheduler dispatches each "
+                "cell separately across stage devices and cannot be "
+                "scanned.  Pass fused=True (single-device), or use the "
+                "SPMD engine (SpmdGPipe.megastep), or megastep=1"
+            )
+        self.megastep = megastep
         self._pipeline = Pipeline(
             stages, self.skip_layout, tracer=tracer, remat_policy=remat_policy
         )
@@ -426,7 +443,8 @@ class GPipe:
         )
 
     def make_train_step(
-        self, optimizer: Any, loss_fn: Any, *, donate: bool = True
+        self, optimizer: Any, loss_fn: Any, *, donate: bool = True,
+        megastep: Optional[int] = None,
     ) -> Any:
         """Training step with the optimizer applied PER STAGE.
 
@@ -447,7 +465,31 @@ class GPipe:
         The SPMD twin (:meth:`SpmdGPipe.make_train_step
         <torchgpipe_tpu.spmd.SpmdGPipe.make_train_step>`) fuses the
         whole update into ONE program instead — possible there because
-        all params live in one mesh computation."""
+        all params live in one mesh computation.
+
+        ``megastep`` (default: the pipe's ``megastep`` ctor arg)
+        compiles K optimizer steps into one scanned program with a
+        donated ``(params, opt_state)`` carry — fused path only (the
+        per-cell scheduler cannot be scanned; the ctor enforces it).
+        The megastep step consumes ``[K, ...]``-stacked ``x``/``target``
+        and returns ``(loss[K], params, opt_state, state, aux[K],
+        finite[K])``: NaN skip-step moves inside the scan (a non-finite
+        inner step passes its input params/opt_state/state through,
+        bitwise what a StepGuard-wrapped single step returns), and
+        checkpoint/preemption/retry granularity becomes the megastep —
+        the same contract as the SPMD twin."""
+        K = self.megastep if megastep is None else int(megastep)
+        if K < 1:
+            raise ValueError(f"megastep must be >= 1, got {K}")
+        if K > 1 and not self._use_fused():
+            raise ValueError(
+                "make_train_step(megastep>1) needs GPipe(fused=True): "
+                "the per-cell scheduler dispatches each cell separately "
+                "and cannot be compiled into one scanned program; use "
+                "fused=True or the SPMD engine"
+            )
+        if K > 1:
+            return self._make_megastep_fused(optimizer, loss_fn, K, donate)
 
         def _upd(g: Pytree, os: Pytree, p: Pytree) -> Tuple[Pytree, Pytree]:
             u, nos = optimizer.update(g, os, p)
@@ -488,6 +530,97 @@ class GPipe:
                 new_os.append(nos_j)
             return loss, tuple(new_p), tuple(new_os), new_state, aux
 
+        step.megastep = 1  # type: ignore[attr-defined]
+        return step
+
+    def _make_megastep_fused(
+        self, optimizer: Any, loss_fn: Any, K: int, donate: bool
+    ) -> Any:
+        """K fused steps as one scanned program (see
+        :meth:`make_train_step`'s ``megastep`` contract)."""
+        import jax.numpy as jnp
+
+        from torchgpipe_tpu.utils import tree_finite
+
+        tmap = jax.tree_util.tree_map
+
+        def whole(
+            params: Tuple,
+            opt_state: Tuple,
+            states: Tuple,
+            x: Pytree,
+            target: Pytree,
+            rng: Optional[jax.Array],
+        ) -> Tuple:
+            def body(carry: Tuple, xs: Tuple) -> Tuple:
+                p, o, st = carry
+                x_k, tgt_k, k = xs
+                key = (
+                    jax.random.fold_in(rng, k) if rng is not None else None
+                )
+                mbatches, stop = self._split_microbatches(x_k)
+                loss, grads, new_st, aux = self._pipeline.run_train_fused(
+                    list(p), list(st), mbatches, tgt_k, loss_fn, key, stop
+                )
+                new_p, new_o = [], []
+                for p_j, g_j, o_j in zip(p, grads, o):
+                    u_j, no_j = optimizer.update(g_j, o_j, p_j)
+                    new_p.append(tmap(
+                        lambda a, b: (a + b).astype(a.dtype), p_j, u_j
+                    ))
+                    new_o.append(no_j)
+                new_p, new_o = tuple(new_p), tuple(new_o)
+                # The fused loop may hand stage states back in different
+                # CONTAINER types (tuple vs list) than init produced; the
+                # scan carry needs one stable treedef, so rebuild on the
+                # input state's structure (same leaves, same order).
+                new_st = jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(st),
+                    jax.tree_util.tree_leaves(new_st),
+                )
+                # In-scan skip-step over exactly what StepGuard's
+                # host-side check covers for the K=1 step: the whole
+                # output tuple (loss, params, opt state, model state,
+                # aux).  On skip the INPUT state passes through — what
+                # StepGuard(extra_state_argnums=(2,)) restores.
+                ok = tree_finite((loss, new_p, new_o, new_st, aux))
+                sel = lambda a, b: jnp.where(ok, a, b)  # noqa: E731
+                new_p = tmap(sel, new_p, p)
+                new_o = tmap(sel, new_o, o)
+                new_st = tmap(sel, new_st, st)
+                return (new_p, new_o, new_st), (loss, aux, ok)
+
+            (p, o, st), (losses, auxs, finite) = jax.lax.scan(
+                body, (params, opt_state, states),
+                (x, target, jnp.arange(K)),
+            )
+            return losses, p, o, st, auxs, finite
+
+        compiled = jax.jit(whole, donate_argnums=(0, 1) if donate else ())
+        self._train_step_donate = donate
+
+        def step(
+            params: Tuple[Pytree, ...],
+            opt_state: Tuple[Pytree, ...],
+            state: Tuple[Pytree, ...],
+            x: Pytree,
+            target: Pytree,
+            rng: Optional[jax.Array] = None,
+        ) -> Tuple[jax.Array, Tuple, Tuple, Tuple, Dict, jax.Array]:
+            for leaf in jax.tree_util.tree_leaves(x):
+                if leaf.shape[:1] != (K,):
+                    raise ValueError(
+                        f"megastep={K} consumes [K, ...]-stacked batches "
+                        f"(K steps in one program), got a leading dim of "
+                        f"{leaf.shape[0]} — stack K per-step batches with "
+                        "jnp.stack, or pass megastep=1"
+                    )
+                break
+            return compiled(
+                tuple(params), tuple(opt_state), tuple(state), x, target, rng
+            )
+
+        step.megastep = K  # type: ignore[attr-defined]
         return step
 
     def value_and_grad_with_loss_params(

@@ -32,6 +32,17 @@ buffers**: build the step with ``donate=False`` (both engines'
 step would have consumed, and a retry must re-feed inputs the failed
 attempt would have donated (the guard detects consumed buffers and
 refuses the retry didactically rather than crash on deleted arrays).
+
+**Megastep steps** (``make_train_step(megastep=K)``, detected via the
+step's ``megastep`` attribute) move the finite check INSIDE the
+compiled scan: the engine gates each inner step's update on a traced
+all-finite reduction and reports the per-step mask as the step's last
+output, so skip-step works even under ``donate=True`` (the returned
+params are already protected — nothing needs restoring).  The guard
+then only folds the mask into its statistics and backs the loss scale
+off at MEGASTEP granularity; transient RETRY still needs
+``donate=False``, and retries re-run the whole K-step program — the
+documented granularity change of compiling K steps into one dispatch.
 """
 
 from __future__ import annotations
@@ -201,6 +212,41 @@ class StepGuard:
                 f"{len(out) if isinstance(out, tuple) else 'n/a'}"
             )
         loss = out[0]
+        megastep = int(getattr(self._step, "megastep", 1) or 1)
+        if megastep > 1:
+            # A megastep step already ran the skip-step INSIDE its scan
+            # (the engines' traced all-finite check gates the carry per
+            # inner step — an UNCONDITIONAL property of the compiled
+            # program; ``GuardPolicy.skip_nonfinite`` only controls the
+            # K=1 host-side check and cannot reach inside) and reports
+            # the per-step mask as its LAST output.  The guard's job
+            # shrinks to the scan boundary: fold the mask into the
+            # statistics — skips that HAPPENED are always counted, so
+            # no optimizer step vanishes from the accounting whatever
+            # the policy says — and back the loss scale off once per
+            # megastep containing any skip: the documented granularity
+            # change (docs/robustness.md).  The whole-output finite
+            # check would be wrong here: the loss VECTOR legitimately
+            # carries the skipped steps' non-finite losses while the
+            # params stayed protected.
+            mask = np.asarray(jax.device_get(out[-1])).astype(bool).ravel()
+            skipped = int(mask.size - mask.sum())
+            self.stats.steps += int(mask.sum())
+            if skipped:
+                self.stats.skipped += skipped
+                if self.loss_scale is not None:
+                    self.loss_scale = self.loss_scale.bad()
+                self._event(
+                    "skip", loss=loss, skipped=self.stats.skipped,
+                    megastep=megastep,
+                    loss_scale=(
+                        self.loss_scale.scale
+                        if self.loss_scale is not None else None
+                    ),
+                )
+            elif self.loss_scale is not None:
+                self.loss_scale = self.loss_scale.ok()
+            return out
         if self.policy.skip_nonfinite:
             checked = (
                 self._finite_of(out) if self._finite_of is not None else out

@@ -56,6 +56,19 @@ from torchgpipe_tpu.serving.scheduler import Request, Scheduler
 Pytree = Any
 
 
+def _start_host_copy(arr: Any) -> None:
+    """Begin an ASYNC device→host copy of ``arr`` (best-effort: not
+    every backend/array exposes it).  The engine calls this right after
+    a step so the sampled-token transfer rides under the host-side
+    bookkeeping between dispatch and the blocking ``np.asarray``."""
+    start = getattr(arr, "copy_to_host_async", None)
+    if start is not None:
+        try:
+            start()
+        except Exception:  # noqa: BLE001 - a hint, never a failure
+            pass
+
+
 class Engine:
     """Continuous-batching inference engine over a slot-pooled KV cache.
 
@@ -162,6 +175,15 @@ class Engine:
             preemption.add_callback(self.request_drain)
         self._requests: Dict[str, Request] = {}
         self._cur_tok = np.zeros((num_slots,), np.int32)
+        # Device-resident slot frontiers: the compiled steps RETURN the
+        # advanced lengths vector, so steady-state decode re-feeds the
+        # previous step's output instead of uploading the host mirror
+        # every iteration.  ``_lengths_shadow`` records what the device
+        # array holds; any host-side mutation the step didn't mirror
+        # (slot alloc/free on admission, eviction, drain) makes the
+        # cheap per-step compare miss and triggers ONE re-upload.
+        self._lengths_dev: Optional[jnp.ndarray] = None
+        self._lengths_shadow: Optional[np.ndarray] = None
         self._rid_counter = 0
         self.trace_counts = {"prefill": 0, "decode": 0}
         # ONE source of truth for the token-buffer shapes: the real steps
@@ -200,7 +222,11 @@ class Engine:
                 logits, last[:, None, None], axis=1
             )[:, 0]
             tok, key = sample_row(row_logits, key)
-            return tok, cache, key
+            # Advance the frontiers ON DEVICE (lengths += the rows each
+            # slot consumed): the next step reuses this array instead of
+            # re-uploading the host mirror — the per-step host→device
+            # lengths copy disappears from the steady-state decode path.
+            return tok, cache, lengths + n_valid, key
 
         def decode_body(params, cache, lengths, tokens, n_valid, key):
             counts["decode"] += 1
@@ -208,7 +234,7 @@ class Engine:
                 cfg, params, tokens, cache, lengths, n_valid, moe=moe
             )
             tok, key = sample_row(logits[:, 0], key)
-            return tok, cache, key
+            return tok, cache, lengths + n_valid, key
 
         donate = (1,) if self.donate else ()
         self._prefill_fn = jax.jit(prefill_body, donate_argnums=donate)
@@ -238,6 +264,29 @@ class Engine:
 
     def _token_buffer(self, kind: str) -> np.ndarray:
         return np.zeros(self._token_shapes[kind], np.int32)
+
+    def _lengths_for_step(self) -> jnp.ndarray:
+        """The frontier vector for the next compiled step: the previous
+        step's device output when the host mirror still matches its
+        shadow, else one fresh upload (``pool.lengths_device()``'s copy
+        semantics).  Steady-state decode — no admissions, no evictions —
+        pays ZERO host→device lengths transfers."""
+        if self._lengths_dev is None or not np.array_equal(
+            self.pool.lengths, self._lengths_shadow
+        ):
+            self._lengths_dev = self.pool.lengths_device()
+            self._lengths_shadow = np.array(self.pool.lengths, copy=True)
+        return self._lengths_dev
+
+    def _commit_lengths(self, lengths_dev: jnp.ndarray,
+                        n_valid: np.ndarray) -> None:
+        """Adopt the step's advanced device frontiers and mirror the
+        advance into the shadow (the engine's own per-row host
+        bookkeeping applies the same ``+= n_valid`` to
+        ``pool.lengths``, so the compare in :meth:`_lengths_for_step`
+        keeps matching until something OTHER than a step mutates it)."""
+        self._lengths_dev = lengths_dev
+        self._lengths_shadow = self._lengths_shadow + n_valid
 
     def _dispatch(self, fn: Callable[..., Tuple], *args: Any) -> Tuple:
         """Run a compiled step under the transient-retry policy (the
@@ -350,13 +399,18 @@ class Engine:
             tokens[r.slot, :take] = r.prompt[r.prefilled:r.prefilled + take]
             n_valid[r.slot] = take
             takes.append((r, take))
-        tok, cache, key = self._dispatch(
+        tok, cache, lengths_dev, key = self._dispatch(
             self._prefill_fn, self.params, self.pool.cache,
-            self.pool.lengths_device(), jnp.asarray(tokens),
+            self._lengths_for_step(), jnp.asarray(tokens),
             jnp.asarray(n_valid), self._key,
         )
         self.pool.cache = cache
         self._key = key
+        # Start the device→host token copy NOW; the per-row bookkeeping
+        # below runs while it is in flight (copy_to_host_async is a hint
+        # — np.asarray below is the one materialization point).
+        _start_host_copy(tok)
+        self._commit_lengths(lengths_dev, n_valid)
         self.metrics.step("prefill", len(reqs), self.pool.num_slots)
         tok_host: Optional[np.ndarray] = None
         for r, take in takes:
@@ -374,13 +428,15 @@ class Engine:
         for r in reqs:
             tokens[r.slot, 0] = self._cur_tok[r.slot]
             n_valid[r.slot] = 1
-        tok, cache, key = self._dispatch(
+        tok, cache, lengths_dev, key = self._dispatch(
             self._decode_fn, self.params, self.pool.cache,
-            self.pool.lengths_device(), jnp.asarray(tokens),
+            self._lengths_for_step(), jnp.asarray(tokens),
             jnp.asarray(n_valid), self._key,
         )
         self.pool.cache = cache
         self._key = key
+        _start_host_copy(tok)           # overlap D2H with the bookkeeping
+        self._commit_lengths(lengths_dev, n_valid)
         self.metrics.step("decode", len(reqs), self.pool.num_slots)
         tok_host = np.asarray(tok)      # the ONE host fetch per step
         for r in reqs:

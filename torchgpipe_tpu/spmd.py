@@ -495,6 +495,25 @@ class SpmdGPipe:
     # interleaved); fill-drain's remat-structured scans measured SLOWER
     # fully unrolled at large cells — leave fill_drain at the default.
     scan_unroll: Union[int, bool] = 1
+    # Send-ahead communication/compute overlap (the JaxPP latency-hiding
+    # shape, arXiv:2412.14374): the fill_drain and 1f1b tick bodies issue
+    # the ``ppermute`` of tick t's output at tick t's TAIL — right after
+    # the cell compute that produced it — instead of at tick t+1's head,
+    # carrying the already-permuted value through the scan.  The values
+    # flowing are identical (bitwise-tested against send_ahead=False),
+    # but the transfer no longer sits between two ticks' compute in
+    # program order, so XLA's async collective-permute can hide it under
+    # the neighbouring tick's independent work.  zb/interleaved keep
+    # their head-of-tick shape (their static tables are not yet
+    # software-pipelined); the flag is ignored there.
+    send_ahead: bool = True
+    # Default megastep K for :meth:`make_train_step`: K optimizer steps
+    # compiled into ONE program (``lax.scan`` over the full pipelined
+    # step with a donated carry).  Declared here — rather than only at
+    # make_train_step call sites — so the static analyses (the
+    # ``dispatch-per-step`` lint rule, the planner's megastep axis) can
+    # see the configured dispatch granularity.
+    megastep: int = 1
     # Declared per-chip HBM budget (bytes).  Opt-in: the schedule
     # verifier's memory certification ERRORs on overrun, and the
     # plan-drift lint rule compares the running configuration against
@@ -513,6 +532,8 @@ class SpmdGPipe:
                 ("schedule", self.schedule, "fill_drain"),
                 ("virtual_stages", self.virtual_stages, 1),
                 ("scan_unroll", self.scan_unroll, 1),
+                ("send_ahead", self.send_ahead, True),
+                ("megastep", self.megastep, 1),
             )
             if v != default
         )
@@ -580,6 +601,14 @@ class SpmdGPipe:
             raise ValueError(
                 f"scan_unroll must be True or an int >= 1, got "
                 f"{self.scan_unroll!r}"
+            )
+        if not (
+            isinstance(self.megastep, int)
+            and not isinstance(self.megastep, bool)
+            and self.megastep >= 1
+        ):
+            raise ValueError(
+                f"megastep must be an int >= 1, got {self.megastep!r}"
             )
         if self.mesh.shape[self.pp_axis] != self.n_stages:
             raise ValueError(
@@ -1295,13 +1324,20 @@ class SpmdGPipe:
             lambda a: jnp.zeros(a.shape[1:], a.dtype), x_mb
         )
 
-        def cell_input(act, t):
+        def ring(act):
+            return jax.tree_util.tree_map(
+                lambda a: lax.ppermute(a, self.pp_axis, perm), act
+            )
+
+        def splice(recv, t):
+            """Everything after the hand-off: splice stage 0's fresh
+            micro-batch over the received activation, derive the cell key
+            and validity scale.  ``recv`` is the ALREADY-PERMUTED
+            neighbour output — under ``send_ahead`` the permute happened
+            at the producing tick's tail, otherwise just above."""
             idx = jnp.clip(t, 0, m - 1)
             inp0 = jax.tree_util.tree_map(
                 lambda a: lax.dynamic_index_in_dim(a, idx, 0, keepdims=False), x_mb
-            )
-            recv = jax.tree_util.tree_map(
-                lambda a: lax.ppermute(a, self.pp_axis, perm), act
             )
             x_in = jax.tree_util.tree_map(
                 lambda a, b: jnp.where(stage == 0, a, b), inp0, recv
@@ -1328,10 +1364,25 @@ class SpmdGPipe:
                 x_in = _faults.spmd_corrupt_cell_input(stage, mb, x_in)
             return x_in, key, valid_scale
 
-        def tick(act, t):
-            x_in, key, valid_scale = cell_input(act, t)
+        # Two scan-carry conventions, same math (bitwise-tested):
+        #
+        # * legacy (send_ahead=False): the carry is the RAW cell output;
+        #   each tick permutes it at its HEAD, serializing the hand-off
+        #   between tick t's compute and tick t+1's compute;
+        # * send-ahead (default): the carry is the output ALREADY
+        #   PERMUTED — the ``ppermute`` issues at the producing tick's
+        #   TAIL, right after the compute that made it, so the async
+        #   collective-permute-start sits next to its producer and can
+        #   overlap tick t+1's independent work (input splice, stage-0
+        #   gather) instead of gating it.  Initial carry: zeros either
+        #   way (``ppermute`` of zeros is zeros — same values).
+        send_ahead = self.send_ahead
+
+        def tick(carry, t):
+            recv = carry if send_ahead else ring(carry)
+            x_in, key, valid_scale = splice(recv, t)
             y = self._block_fn(params_local, x_in, key, valid_scale, train)
-            return y, y
+            return (ring(y) if send_ahead else y), y
 
         if self.checkpoint == "except_last" and train:
             # Remat'd prefix: every cell in ticks 0..m-2 is micro-batch
@@ -1346,8 +1397,9 @@ class SpmdGPipe:
             # pipeline depth.  Residual behavior is identical: the scan
             # stacks each tick's cond residuals, exactly what the unrolled
             # form stored.
-            def tail_tick(act, t):
-                x_in, key, valid_scale = cell_input(act, t)
+            def tail_tick(carry, t):
+                recv = carry if send_ahead else ring(carry)
+                x_in, key, valid_scale = splice(recv, t)
                 own = t - (m - 1)  # the stage whose cell is micro-batch m-1
 
                 def plain_cell(x):
@@ -1361,7 +1413,7 @@ class SpmdGPipe:
                     )
 
                 y = lax.cond(stage == own, plain_cell, remat_cell, x_in)
-                return y, y
+                return (ring(y) if send_ahead else y), y
 
             _, ys_tail = lax.scan(
                 tail_tick, act, jnp.arange(m - 1, T), unroll=self.scan_unroll
@@ -1596,16 +1648,31 @@ class SpmdGPipe:
                 carry0["buf"] = tmap(
                     lambda s: jnp.zeros((n,) + s.shape, s.dtype), act_spec
                 )
+            send_ahead = self.send_ahead
+            if send_ahead:
+                # Send-ahead overlap: the carry ALSO holds the permuted
+                # act/gact, produced at the previous tick's tail (right
+                # after the switch that computed them) instead of at this
+                # tick's head — the hand-off collective sits next to its
+                # producer, off the head-of-tick critical path.  Initial
+                # values: permutes of the zero act/gact, i.e. zeros —
+                # bitwise what the legacy head permute computes at t=0.
+                carry0["recv_f"] = act0
+                carry0["recv_b"] = act0
 
             def tick(carry, t):
-                recv_f = tmap(
-                    lambda a: lax.ppermute(a, self.pp_axis, perm_f),
-                    carry["act"],
-                )
-                recv_b = tmap(
-                    lambda a: lax.ppermute(a, self.pp_axis, perm_b),
-                    carry["gact"],
-                )
+                if send_ahead:
+                    recv_f = carry["recv_f"]
+                    recv_b = carry["recv_b"]
+                else:
+                    recv_f = tmap(
+                        lambda a: lax.ppermute(a, self.pp_axis, perm_f),
+                        carry["act"],
+                    )
+                    recv_b = tmap(
+                        lambda a: lax.ppermute(a, self.pp_axis, perm_b),
+                        carry["gact"],
+                    )
                 tj = t - stage
                 warm = (tj >= 0) & (tj <= n - 1 - stage) & (tj < m)
                 i_s = jnp.where(tj >= 0, tj // 2, 0)
@@ -1785,6 +1852,22 @@ class SpmdGPipe:
                 carry = lax.switch(
                     idx, [fwd_branch, bwd_branch, lambda c: c], carry
                 )
+                if send_ahead:
+                    # Issue next tick's hand-offs NOW, right after the
+                    # switch produced act/gact (unconditional — collective
+                    # participation stays global).  Values equal the
+                    # legacy head permute of the SAME carried act/gact.
+                    carry = dict(
+                        carry,
+                        recv_f=tmap(
+                            lambda a: lax.ppermute(a, self.pp_axis, perm_f),
+                            carry["act"],
+                        ),
+                        recv_b=tmap(
+                            lambda a: lax.ppermute(a, self.pp_axis, perm_b),
+                            carry["gact"],
+                        ),
+                    )
                 return carry, ()
 
             carry, _ = lax.scan(
@@ -3135,7 +3218,8 @@ class SpmdGPipe:
             return self._train_step_fns[key](*args)
 
     def make_train_step(
-        self, optimizer: Any, *, donate: bool = True
+        self, optimizer: Any, *, donate: bool = True,
+        megastep: Optional[int] = None,
     ) -> Callable[..., Tuple[jax.Array, Pytree, Pytree]]:
         """The whole update as ONE compiled program: pipelined
         forward+backward plus the optimizer, fused by XLA.
@@ -3163,7 +3247,37 @@ class SpmdGPipe:
         The returned callable re-traces per distinct input shape
         signature (ragged batch buckets, rng presence), exactly like
         :meth:`train_step`.
+
+        ``megastep`` (default: the pipe's declared ``megastep`` field)
+        compiles K optimizer steps into ONE program — a ``lax.scan``
+        over the full pipelined step with the ``(params, opt_state)``
+        carry donated, killing the per-step Python dispatch, host sync
+        and guard bookkeeping K-fold.  The returned step then consumes
+        ``[K, ...]``-stacked batches and returns ``(loss[K], new_params,
+        new_opt_state, finite[K])``:
+
+        * NaN skip-step semantics move INSIDE the scan: after each inner
+          step a traced all-finite check over exactly what
+          :class:`~torchgpipe_tpu.resilience.guard.StepGuard` would
+          check (loss, updated params, updated optimizer state) gates
+          the carry — a non-finite step k hands step k+1 the step-k
+          input state, bitwise what K guarded single steps produce.
+          The gate is UNCONDITIONAL (baked into the compiled program —
+          ``GuardPolicy.skip_nonfinite`` cannot reach inside it); a
+          wrapping guard always counts the skips that happened.
+          ``finite[K]`` reports the mask so a wrapping StepGuard (which
+          reads ``step.megastep``) can keep its skip statistics and
+          loss-scale backoff at scan — not step — granularity.
+        * RETRY GRANULARITY CHANGES (documented contract): a transient
+          failure retries the whole K-step megastep, and checkpoint /
+          preemption hooks run at megastep boundaries only.  With
+          ``rng``, inner step k derives its key as ``fold_in(rng, k)``.
         """
+        K = self.megastep if megastep is None else int(megastep)
+        if K < 1:
+            raise ValueError(f"megastep must be >= 1, got {K}")
+        if K > 1:
+            return self._make_megastep(optimizer, K, donate)
 
         def whole(
             params: Pytree,
@@ -3205,6 +3319,82 @@ class SpmdGPipe:
                 params, opt_state, x, target, rng, _faults.plan_token()
             )
 
+        step.megastep = 1  # type: ignore[attr-defined]
+        return step
+
+    def _make_megastep(
+        self, optimizer: Any, K: int, donate: bool
+    ) -> Callable[..., Tuple[jax.Array, Pytree, Pytree, jax.Array]]:
+        """K optimizer steps as one scanned program (see
+        :meth:`make_train_step`'s ``megastep`` contract)."""
+        from torchgpipe_tpu.utils import tree_finite
+
+        tmap = jax.tree_util.tree_map
+
+        def whole(
+            params: Pytree,
+            opt_state: Pytree,
+            x: Pytree,
+            target: Pytree,
+            rng: Optional[jax.Array],
+            plan_token: Optional[int],
+        ) -> Tuple[jax.Array, Pytree, Pytree, jax.Array]:
+            del plan_token  # static jit-cache key, as in the K=1 step
+
+            def body(carry: Tuple, xs: Tuple) -> Tuple[Tuple, Tuple]:
+                p, o = carry
+                x_k, tgt_k, k = xs
+                key = (
+                    jax.random.fold_in(rng, k) if rng is not None else None
+                )
+                loss, grads = self.train_step(p, x_k, tgt_k, key)
+                updates, new_o = optimizer.update(grads, o, p)
+                new_p = tmap(
+                    lambda a, u: (a + u).astype(a.dtype), p, updates
+                )
+                # The in-scan skip-step: cover EXACTLY what StepGuard's
+                # host-side check covers on the K=1 step's output tuple
+                # (loss, new params, new opt state) so megastep(K) is
+                # bitwise K guarded steps.  jnp.where(True, a, b) IS a —
+                # applied steps pass through untouched.
+                ok = tree_finite((loss, new_p, new_o))
+                new_p = tmap(lambda a, b: jnp.where(ok, a, b), new_p, p)
+                new_o = tmap(lambda a, b: jnp.where(ok, a, b), new_o, o)
+                return (new_p, new_o), (loss, ok)
+
+            (new_p, new_o), (losses, finite) = lax.scan(
+                body, (params, opt_state), (x, target, jnp.arange(K))
+            )
+            return losses, new_p, new_o, finite
+
+        compiled = jax.jit(
+            whole,
+            static_argnums=(5,),
+            donate_argnums=(0, 1) if donate else (),
+        )
+        self._train_step_donate = donate
+
+        def step(
+            params: Pytree,
+            opt_state: Pytree,
+            x: Pytree,
+            target: Pytree,
+            rng: Optional[jax.Array] = None,
+        ) -> Tuple[jax.Array, Pytree, Pytree, jax.Array]:
+            for leaf in jax.tree_util.tree_leaves(x):
+                if leaf.shape[:1] != (K,):
+                    raise ValueError(
+                        f"megastep={K} consumes [K, ...]-stacked batches "
+                        f"(K steps in one program), got a leading dim of "
+                        f"{leaf.shape[0]} — stack K per-step batches with "
+                        "jnp.stack, or pass megastep=1"
+                    )
+                break
+            return compiled(
+                params, opt_state, x, target, rng, _faults.plan_token()
+            )
+
+        step.megastep = K  # type: ignore[attr-defined]
         return step
 
     def _build_apply(self, with_loss: bool = False) -> Callable:

@@ -59,6 +59,25 @@ DEFAULT_OVERHEAD_BYTES = int(2.4 * GiB)
 # role as balance/profile.py's ``param_scale``).
 DEFAULT_PARAM_SCALE = 4.0
 
+# Host overhead of ONE compiled-program launch (Python dispatch, arg
+# flattening, the guard's per-step host sync), expressed in the same
+# walker-FLOP unit the planner's makespan uses: ~1 ms of wall clock at
+# the v5e's 197 TFLOP/s bf16 peak — the remote-attached dispatch
+# latency the BENCH_NOTES rounds repeatedly measured.  The megastep
+# axis amortizes it as ``DISPATCH_OVERHEAD_FLOPS / K`` per optimizer
+# step; like OFFLOAD_RANK_TAX this is a documented RANKING device, not
+# a wall-clock promise — bench.py's --megastep rung validates the
+# direction on real hardware.
+DISPATCH_OVERHEAD_FLOPS = 2.0e11
+
+# Lane-time discount the slot-buffer schedules (1f1b/zb/interleaved)
+# earn from scan_unroll=True: static slot/ring indices let XLA fold the
+# buffer machinery and fuse across ticks — measured -14%..-33% step
+# time (BENCH_NOTES round 4), modeled as a flat 20% discount.
+# fill_drain measured SLOWER fully unrolled, so its unroll axis is just
+# {1} and the discount never applies there.
+UNROLL_LANE_DISCOUNT = 0.8
+
 
 # --------------------------------------------------------------------- #
 # probes: flops, bytes, memory analysis                                 #
@@ -449,6 +468,29 @@ def _chunk_options(pipe: Any, batch: int, requested: Optional[Sequence[int]]) ->
     from torchgpipe_tpu.analysis.planner import spmd_chunk_options
 
     return spmd_chunk_options(pipe, batch, requested)
+
+
+def megastep_options(
+    requested: Optional[Sequence[int]] = None,
+    steps: Optional[int] = None,
+) -> List[int]:
+    """Megastep K candidates — delegates to the planner's canonical
+    space (:func:`torchgpipe_tpu.analysis.planner.megastep_options`),
+    so the sweep, the lint rules and ``bench.py --megastep``'s ladder
+    all share ONE definition."""
+    from torchgpipe_tpu.analysis.planner import megastep_options as opts
+
+    return opts(requested, steps)
+
+
+def scan_unroll_options(schedule: str) -> List[Any]:
+    """scan_unroll candidates per schedule (the planner's canonical
+    space; see :data:`UNROLL_LANE_DISCOUNT` for the measured basis)."""
+    from torchgpipe_tpu.analysis.planner import (
+        scan_unroll_options as opts,
+    )
+
+    return opts(schedule)
 
 
 def tune_step(

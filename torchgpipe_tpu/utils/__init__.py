@@ -27,6 +27,21 @@ def param_count(tree: Pytree) -> int:
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
 
 
+def tree_finite(tree: Pytree) -> jax.Array:
+    """TRACEABLE all-finite reduction over every inexact leaf — the
+    in-program twin of :func:`torchgpipe_tpu.resilience.guard._all_finite`
+    (which host-syncs).  The megastep scan threads this through its carry
+    so NaN skip-step semantics survive inside one compiled program: it
+    must cover exactly what the StepGuard's host-side check covers (the
+    whole step output) for megastep(K) to bitwise-match K guarded steps.
+    """
+    ok = jnp.bool_(True)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if jnp.issubdtype(jnp.result_type(leaf), jnp.inexact):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
 def host_device() -> Any:
     """Context placing computation on the host CPU backend (no-op fallback
     when unavailable).

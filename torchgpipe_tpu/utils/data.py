@@ -54,6 +54,65 @@ def prefetch_to_device(
         enqueue(1)
 
 
+def pipe_data_sharding(pipe: Any, *, stacked: bool = False) -> Any:
+    """The right host→device placement for FULL training batches of
+    ``pipe`` — what :func:`prefetch_to_device`'s ``device`` should be.
+
+    * :class:`~torchgpipe_tpu.spmd.SpmdGPipe`: a ``NamedSharding`` over
+      the pipe's mesh with the batch dimension split across the data
+      axes (dp, ep) — the engine's own data convention, so the compiled
+      step consumes the prefetched array without a resharding copy.
+      ``stacked=True`` shifts the spec right by one for megastep's
+      ``[K, ...]``-stacked batches (the K axis stays unsharded).
+    * :class:`~torchgpipe_tpu.gpipe.GPipe`: stage 0's device (micro-
+      batches enter the pipeline there); remaining dims ride along.
+
+    Placement is a PERFORMANCE property, not a correctness one — the
+    engines' ``jit``/``shard_map`` in-specs reshard mismatched inputs —
+    so this helper only has to be good, never exact.
+    """
+    from torchgpipe_tpu.gpipe import GPipe
+
+    if isinstance(pipe, GPipe):
+        return pipe.devices[0]
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    batch_axes = tuple(
+        a for a in (pipe.dp_axis, pipe.ep_axis) if a is not None
+    )
+    batch = batch_axes if batch_axes else None
+    spec = (
+        PartitionSpec(None, batch) if stacked else PartitionSpec(batch)
+    )
+    return NamedSharding(pipe.mesh, spec)
+
+
+def prefetch_to_pipe(
+    iterable: Iterable[Pytree],
+    pipe: Any,
+    size: int = 2,
+    *,
+    stacked: bool = False,
+) -> Iterator[Pytree]:
+    """:func:`prefetch_to_device` with the placement resolved from the
+    pipe (:func:`pipe_data_sharding`) — the one-liner the training-loop
+    call sites use::
+
+        for x, y in prefetch_to_pipe(loader, pipe):
+            loss, params, opt_state = guard(params, opt_state, x, y)
+
+    Each yielded batch (any pytree — ``(x, y)`` tuples included) is
+    already committed to the engine's devices while the PREVIOUS step
+    computes, so the step dispatch never waits on a host→device copy
+    and the iterator's host-side work (tokenization, augmentation)
+    overlaps device compute.  ``stacked=True`` places megastep's
+    ``[K, ...]``-stacked batches (leading K axis unsharded).
+    """
+    return prefetch_to_device(
+        iterable, size, device=pipe_data_sharding(pipe, stacked=stacked)
+    )
+
+
 def global_batch_from_local(
     mesh: Any,
     spec: Any,
