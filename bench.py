@@ -889,6 +889,20 @@ def _megastep_entry() -> None:
     raise SystemExit(megastep_main())
 
 
+def _obs_overhead_entry() -> None:
+    """The ``obs-overhead`` rung: CPU tiny-llama step time with the
+    telemetry layer fully on (sync=False Timeline + MetricsRegistry +
+    StepReporter) vs bare, interleaved A/B rounds, medians compared
+    (benchmarks/obs_overhead.py).  Gated at <2% overhead — exits
+    non-zero past the gate.  Emits one JSON line::
+
+        env JAX_PLATFORMS=cpu python bench.py --obs-overhead
+    """
+    from benchmarks.obs_overhead import main as obs_overhead_main
+
+    raise SystemExit(obs_overhead_main())
+
+
 def _plan_validate_entry() -> None:
     """The ``plan-validate`` rung: predicted-vs-measured rank-order check
     of the static planner on the CPU tiny-llama preset
@@ -905,7 +919,9 @@ def _plan_validate_entry() -> None:
 
 
 if __name__ == "__main__":
-    if "--plan-validate" in sys.argv:
+    if "--obs-overhead" in sys.argv:
+        _obs_overhead_entry()
+    elif "--plan-validate" in sys.argv:
         _plan_validate_entry()
     elif "--megastep" in sys.argv:
         _megastep_entry()

@@ -251,6 +251,7 @@ def run_speed(
     skip_epochs: int = 1,
     label: str = "experiment",
     after: Optional[Callable] = None,
+    reporter=None,
 ) -> float:
     """Timed SGD epochs through the GPipe engine; steady-state samples/sec.
 
@@ -258,11 +259,28 @@ def run_speed(
     loop finishes — e.g. the MoE driver prints router balance stats.  On a
     chip with a known bf16 peak an ``MFU`` line follows the epoch lines
     (:func:`print_mfu`).
+
+    ``reporter`` is a :class:`torchgpipe_tpu.obs.StepReporter` (one is
+    created by default): every driver step ticks it, and one structured
+    ``OBS |`` summary line (step-time p50/p95, samples/s, first-step
+    compile time) closes the run — the telemetry every speed benchmark
+    reports against.  Dispatch-granularity times: the loop blocks per
+    epoch, so per-step figures include async overlap (throughput truth
+    lives in the epoch lines; the percentiles catch recompiles and
+    stragglers).
     """
     in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
     params, state = model.init(jax.random.PRNGKey(0), in_spec)
     rng = jax.random.PRNGKey(1)
     carry = {"params": params, "state": state}
+
+    if reporter is None:
+        from torchgpipe_tpu.obs import StepReporter
+
+        reporter = StepReporter(
+            items_per_step=x.shape[0], items_label="samples",
+            label=label, log_every=0,
+        )
 
     # The input pipeline the drivers measure WITH, not around: batches
     # stream through the double-buffered prefetcher (utils.data), so the
@@ -285,12 +303,14 @@ def run_speed(
             for ps, gs in zip(carry["params"], grads)
         )
         carry["state"] = new_state
+        reporter.step()
         return loss, carry["params"]
 
     tput = run_epoch_loop(
         step_fn, x.shape[0], epochs=epochs, steps_per_epoch=steps_per_epoch,
         skip_epochs=skip_epochs, label=label,
     )
+    print(reporter.line(), flush=True)
     print_mfu(
         lambda: sequential_step_flops(
             model, params, state, x, y, loss_fn, rng

@@ -127,6 +127,18 @@ def main() -> None:
     ckpt_dir = tempfile.mkdtemp(prefix="hf_finetune_ckpt_")
     mgr = CheckpointManager(ckpt_dir, keep_last_k=2)
 
+    # Telemetry (docs/observability.md): a StepReporter on the obs
+    # registry ticks once per guarded step — step-time percentiles,
+    # tokens/s, and the guard's skip/retry counters land in ONE
+    # structured log line per step (log_every=1 because this example
+    # runs 6 steps; production loops use 50-500).
+    from torchgpipe_tpu.obs import StepReporter
+
+    reporter = StepReporter(
+        guard=guard, items_per_step=float(inputs.size),
+        items_label="tokens", label="hf_finetune", log_every=1,
+    )
+
     def pack(params, opt_state, i):
         return {"params": params, "opt": opt_state,
                 "step": jnp.asarray(i, jnp.int32)}
@@ -154,6 +166,7 @@ def main() -> None:
                     params, opt_state, x_i, y_i
                 )
                 mgr.save(i, pack(params, opt_state, i))
+                reporter.step(loss=float(loss))
                 print(f"step {i}: loss {float(loss):.4f}", flush=True)
                 if stop.check(i):
                     print(f"preempted at step {i}: checkpointed, exiting",
@@ -170,8 +183,10 @@ def main() -> None:
     for i, (x_i, y_i) in zip(range(start, total), batches):
         loss, params, opt_state = guard(params, opt_state, x_i, y_i)
         mgr.save(i, pack(params, opt_state, i))
+        reporter.step(loss=float(loss))
         print(f"step {i} (resumed): loss {float(loss):.4f}", flush=True)
     print(f"guard stats: {guard.stats}", flush=True)
+    print(reporter.line(), flush=True)
     shutil.rmtree(ckpt_dir, ignore_errors=True)
 
     # 4. Decode from the trained weights (single-host, KV-cache scan).

@@ -696,3 +696,40 @@ def test_dispatch_per_step_stands_down_on_per_cell_mpmd():
     model.make_train_step(optax.sgd(1e-2), mse, donate=True)
     assert analysis.lint(model, X, target=Y, loss_fn=mse,
                          rules=["dispatch-per-step"]) == []
+
+
+# --------------------------------------------------------------------- #
+# dispatch-only-timeline (obs trace-spine hygiene)                      #
+# --------------------------------------------------------------------- #
+
+
+def test_dispatch_only_timeline_fires_on_async_tracer():
+    # The seeded hazard: a sync=False timeline records dispatch
+    # intervals, whose simulate_pipeline/obs.reconcile projections would
+    # be meaningless — the rule names the fix.
+    from torchgpipe_tpu.utils.tracing import Timeline
+
+    model = GPipe(_mpmd_layers(), balance=[2, 1], chunks=2,
+                  tracer=Timeline(sync=False))
+    found = _by_rule(
+        analysis.lint(model, X, target=Y, loss_fn=mse,
+                      rules=["dispatch-only-timeline"]),
+        "dispatch-only-timeline",
+    )
+    assert found and found[0].severity == Severity.WARNING
+    assert "sync=True" in found[0].message
+
+
+def test_dispatch_only_timeline_stands_down_on_sync_tracer():
+    from torchgpipe_tpu.utils.tracing import Timeline
+
+    model = GPipe(_mpmd_layers(), balance=[2, 1], chunks=2,
+                  tracer=Timeline(sync=True))
+    assert analysis.lint(model, X, target=Y, loss_fn=mse,
+                         rules=["dispatch-only-timeline"]) == []
+
+
+def test_dispatch_only_timeline_stands_down_without_tracer():
+    model = GPipe(_mpmd_layers(), balance=[2, 1], chunks=2)
+    assert analysis.lint(model, X, target=Y, loss_fn=mse,
+                         rules=["dispatch-only-timeline"]) == []

@@ -1,0 +1,487 @@
+"""Tests for torchgpipe_tpu.obs: metrics registry, re-based counters,
+step reporter, trace spine, and measured-vs-predicted reconciliation.
+
+The reconciliation tests are the acceptance spine of the obs layer: a
+``sync=True`` CPU tiny-llama run must map >=95% of its measured fwd/bwd
+spans onto event-graph nodes and report a measured bubble fraction
+within the documented tolerance (``obs.BUBBLE_TOLERANCE``) of
+``analysis.events.bubble_fraction``'s prediction, for BOTH fill-drain
+and 1F1B; an artificially serialized run must trip the ``plan-drift``
+WARNING through the lint path while the normal run stands down.
+"""
+
+import dataclasses
+import io
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchgpipe_tpu import GPipe, SpmdGPipe, analysis, make_mesh, obs
+from torchgpipe_tpu.analysis import Severity
+from torchgpipe_tpu.analysis.events import events_for
+from torchgpipe_tpu.layers import chain
+from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+from torchgpipe_tpu.ops import dense, layer_norm
+from torchgpipe_tpu.utils.tracing import Timeline
+
+
+def mse(out, tgt):
+    return jnp.mean((out.astype(jnp.float32) - tgt) ** 2)
+
+
+# --------------------------------------------------------------------- #
+# registry                                                              #
+# --------------------------------------------------------------------- #
+
+
+def test_registry_counters_gauges_histograms():
+    reg = obs.MetricsRegistry()
+    c = reg.counter("steps", help="steps")
+    c.inc()
+    c.inc(2)
+    assert c.value() == 3
+    g = reg.gauge("occupancy")
+    g.set(0.75)
+    assert g.value() == 0.75
+    h = reg.histogram("lat")
+    for i in range(100):
+        h.observe(i / 100.0)
+    s = h.summary()
+    assert s["count"] == 100 and abs(s["p50"] - 0.495) < 0.02
+    assert abs(s["p95"] - 0.94) < 0.02 and abs(s["p99"] - 0.98) < 0.02
+    # Create-or-get is idempotent; type/label conflicts are didactic.
+    assert reg.counter("steps") is c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("steps")
+
+
+def test_registry_labels():
+    reg = obs.MetricsRegistry()
+    c = reg.counter("reqs", labels=("tenant",))
+    c.inc(tenant="a")
+    c.inc(2, tenant="b")
+    assert c.value(tenant="a") == 1 and c.value(tenant="b") == 2
+    with pytest.raises(ValueError, match="declares labels"):
+        c.inc()  # missing label
+
+
+def test_registry_prometheus_and_jsonl_export():
+    reg = obs.MetricsRegistry(clock=lambda: 42.0)
+    reg.counter("steps", help="applied steps").inc(5)
+    h = reg.histogram("ttft")
+    h.observe(0.1)
+    h.observe(0.3)
+    text = reg.to_prometheus()
+    assert "# TYPE steps counter" in text and "steps 5" in text
+    assert '# HELP steps applied steps' in text
+    assert 'ttft{quantile="0.5"}' in text
+    assert "ttft_count 2" in text and "ttft_sum 0.4" in text
+    buf = io.StringIO()
+    n = reg.write_jsonl(buf)
+    lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert n == len(lines) == 2
+    by_name = {rec["metric"]: rec for rec in lines}
+    assert by_name["steps"]["value"] == 5.0
+    assert by_name["ttft"]["count"] == 2.0
+    assert by_name["steps"]["time"] == 42.0
+
+
+def test_histogram_reservoir_caps_memory():
+    h = obs.Histogram("h", capacity=64)
+    for i in range(10_000):
+        h.observe(float(i))
+    assert h.count() == 10_000 and len(h.series()[()].sample) == 64
+    # Percentiles stay order-of-magnitude right under sampling.
+    assert 2_000 < h.percentile(0.5) < 8_000
+
+
+# --------------------------------------------------------------------- #
+# re-based GuardStats / ServingMetrics                                  #
+# --------------------------------------------------------------------- #
+
+
+def test_guard_stats_registry_backed():
+    from torchgpipe_tpu.resilience.guard import GuardStats
+
+    reg = obs.MetricsRegistry()
+    stats = GuardStats(reg)
+    stats.steps += 2
+    stats.skipped += 1
+    stats.retries += 3
+    # Legacy attribute API intact...
+    assert (stats.steps, stats.skipped, stats.retries) == (2, 1, 3)
+    assert "steps=2" in repr(stats)
+    # ...and the same numbers are registry series, exportable.
+    assert reg.counter("guard_steps").value() == 2
+    assert "guard_retries 3" in reg.to_prometheus()
+
+
+def test_serving_metrics_percentiles_in_snapshot():
+    from torchgpipe_tpu.serving.metrics import ServingMetrics
+
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.5
+        return t[0]
+
+    m = ServingMetrics(clock=clock)
+    for rid in ("a", "b", "c"):
+        m.arrived(rid)
+        m.admitted(rid)
+        for _ in range(4):
+            m.token(rid)
+        m.finished(rid)
+    snap = m.snapshot()
+    for key in ("ttft_p50", "ttft_p95", "ttft_p99",
+                "tpot_p50", "tpot_p95", "tpot_p99"):
+        assert snap[key] is not None and snap[key] > 0
+    # TPOT: finished - first_token = 4 clock ticks of 0.5s over 3
+    # decode tokens.
+    assert abs(snap["tpot_p50"] - 2.0 / 3.0) < 1e-9
+    # Legacy keys and attribute writes still live.
+    assert snap["tokens_out"] == 12
+    m.retries += 1
+    assert m.snapshot()["retries"] == 1
+    # The registry view exports the same series.
+    assert m.registry.histogram("serving_ttft_seconds").count() == 3
+
+
+# --------------------------------------------------------------------- #
+# StepReporter                                                          #
+# --------------------------------------------------------------------- #
+
+
+def test_step_reporter_percentiles_and_log_lines():
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    lines = []
+    rep = obs.StepReporter(items_per_step=8, items_label="samples",
+                           clock=clock, emit=lines.append, log_every=2,
+                           peak_flops=None)
+    for i in range(5):
+        rep.step(loss=float(i))
+    assert rep.steps == 5
+    # Construction is the baseline: the FIRST step's dt (compile) lands
+    # in train_first_step_seconds, the other 4 in the steady histogram.
+    # Series are keyed by the run label, so two reporters sharing a
+    # registry stay separable.
+    first = rep.registry.gauge("train_first_step_seconds",
+                               labels=("run",))
+    assert first.value(run="train") == 1.0
+    hist = rep.registry.histogram("train_step_seconds", labels=("run",))
+    assert hist.count(run="train") == 4
+    other = obs.StepReporter(registry=rep.registry, label="eval",
+                             clock=clock, log_every=0, peak_flops=None)
+    other.step()
+    other.step()
+    assert rep.steps == 5 and other.steps == 2  # no merged series
+    assert len(lines) == 2 and lines[0].startswith("OBS | {")
+    payload = json.loads(lines[-1].split("OBS | ", 1)[1])
+    assert payload["steps"] == 4 and payload["samples_per_sec"] == 8.0
+    assert payload["loss"] == 3.0
+    s = rep.summary()
+    assert s["step_s_p50"] == 1.0 and s["first_step_s"] == 1.0
+
+
+def test_step_reporter_reads_guard_counters():
+    class FakeGuard:
+        class stats:
+            skipped = 2
+            retries = 1
+
+        loss_scale = None
+
+    rep = obs.StepReporter(guard=FakeGuard(), log_every=0,
+                           peak_flops=None)
+    rep.step()
+    rep.step()
+    assert rep.summary()["skipped"] == 2
+    assert rep.summary()["retries"] == 1
+
+
+def test_measured_step_flops_matches_walker():
+    def step(x):
+        return (x @ x).sum()
+
+    x = jnp.zeros((16, 16), jnp.float32)
+    got = obs.measured_step_flops(step, x)
+    assert got == pytest.approx(2 * 16 ** 3, rel=0.01)
+    assert obs.measured_step_flops(lambda a: a.undefined, x) is None
+
+
+def test_measured_mfu_gauge():
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.5
+        return t[0]
+
+    rep = obs.StepReporter(flops_per_step=1e9, peak_flops=1e10,
+                           clock=clock, log_every=0)
+    for _ in range(3):
+        rep.step()
+    # dt=0.5s -> mfu = 1e9 / (0.5 * 1e10) = 0.2
+    assert rep.summary()["measured_mfu"] == pytest.approx(0.2)
+
+
+# --------------------------------------------------------------------- #
+# trace spine: chrome export round-trip + SPMD step spans               #
+# --------------------------------------------------------------------- #
+
+
+def _uniform_blocks(n_stages, tracer, schedule="gpipe", chunks=4,
+                    dim=128, seq=32):
+    # dim/seq sized so each cell is ~1-4ms on CPU: at sub-ms cells the
+    # per-cell dispatch overhead dominates and the measured bubble
+    # fraction is noise, not schedule (calibration runs: dim 64/seq 16
+    # drifts 0.06-0.21 run to run, dim 128/seq 32 stays within 0.07).
+    cfg = TransformerConfig(
+        vocab=128, dim=dim, n_layers=2 * n_stages, n_heads=4,
+        n_kv_heads=2, mlp_ratio=2.0,
+    )
+    blocks = llama(cfg)[1:-1]  # uniform stack: no embed/head imbalance
+    kw = {"loss_reduction": "mean"} if schedule == "1f1b" else {}
+    model = GPipe(blocks, balance=[2] * n_stages, chunks=chunks,
+                  checkpoint="except_last", schedule=schedule,
+                  tracer=tracer, **kw)
+    x = jnp.zeros((8, seq, cfg.dim), jnp.float32)
+    return model, x
+
+
+def _run_traced(model, x, tracer, steps=2):
+    in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    out = model.value_and_grad(params, state, x, x, mse)
+    jax.block_until_ready(out[:2])
+    tracer.reset()
+    for _ in range(steps):
+        out = model.value_and_grad(params, state, x, x, mse)
+        jax.block_until_ready(out[:2])
+    return params, state
+
+
+@pytest.fixture(scope="module", params=["gpipe", "1f1b"])
+def traced_run(request):
+    """ONE sync=True measured run per schedule, shared by every test in
+    this module that only READS the trace (3 steps averaged — the same
+    warm-up + multi-step protocol tools/trace_report.py uses)."""
+    tracer = Timeline(sync=True)
+    model, x = _uniform_blocks(2, tracer, schedule=request.param)
+    _run_traced(model, x, tracer, steps=3)
+    return request.param, model, x, tracer
+
+
+def test_chrome_trace_round_trip(traced_run, tmp_path):
+    schedule, _model, _x, tracer = traced_run
+    path = os.path.join(tmp_path, "trace.json")
+    tracer.to_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    slices = [e for e in events if e["ph"] == "X"]
+    # Metadata rows name every stage row.
+    assert {m["tid"] for m in meta} == {0, 1}
+    assert all(m["name"] == "thread_name" for m in meta)
+    # Every slice carries the event-graph node id args.
+    assert slices
+    for s in slices:
+        assert {"stage", "micro_batch", "kind"} <= set(s["args"])
+        assert s["dur"] > 0
+    kinds = {s["args"]["kind"] for s in slices}
+    assert {"fwd", "bwd"} <= kinds
+
+
+def test_spmd_tracer_records_scan_granularity_spans(cpu_devices):
+    import optax
+
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")],
+                  name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    tracer = Timeline(sync=True)
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="always", tracer=tracer)
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 16))
+    params = pipe.init(jax.random.PRNGKey(1), x)
+    opt = optax.sgd(1e-2)
+    step = pipe.make_train_step(opt, donate=False)
+    opt_state = pipe.place_tree(opt.init(params))
+    for _ in range(3):
+        _, params, opt_state = step(params, opt_state, x, x)
+    assert [e.name for e in tracer.events] == ["step"] * 3
+    assert all(e.stage == -1 for e in tracer.events)
+    assert all(e.duration > 0 for e in tracer.events)
+    # The megastep path records at its own (K-step) granularity.
+    tracer.reset()
+    kstep = pipe.make_train_step(opt, donate=False, megastep=2)
+    xs = jnp.stack([x, x])
+    kstep(params, opt_state, xs, xs)
+    assert [e.name for e in tracer.events] == ["megastep"]
+    # Chrome export labels the scan-granularity row "program".
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "t.json")
+        tracer.to_chrome_trace(p)
+        with open(p) as f:
+            doc = json.load(f)
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert meta[0]["args"]["name"] == "program"
+
+
+# --------------------------------------------------------------------- #
+# reconciliation (the acceptance spine)                                 #
+# --------------------------------------------------------------------- #
+
+
+def test_reconcile_tiny_llama_within_tolerance(traced_run):
+    """sync=True CPU run: >=95% span coverage and measured bubble within
+    the documented tolerance of the event-graph prediction — for BOTH
+    fill-drain and 1F1B (the fixture parametrizes the schedule)."""
+    schedule, model, x, tracer = traced_run
+    g = events_for(model)
+    assert g.schedule == schedule
+    report = obs.reconcile(tracer, g, pipe=model)
+    assert report.coverage >= 0.95
+    assert not report.dispatch_only
+    assert report.measured_makespan > 0
+    assert abs(report.bubble_drift) <= obs.BUBBLE_TOLERANCE, (
+        report.summary()
+    )
+    # Every stage accumulated busy time.
+    assert set(report.stage_busy) == {0, 1}
+    assert report.drift_findings() == []
+    # The normal run, attached to the pipe, stands down through lint.
+    found = [
+        f for f in analysis.lint(
+            model, jax.ShapeDtypeStruct(x.shape, x.dtype),
+            rules=["plan-drift"],
+        )
+        if f.rule == "plan-drift"
+    ]
+    assert found == []
+
+
+def test_reconcile_serialized_run_trips_plan_drift(traced_run):
+    """An artificially serialized run (one stage's cells inflated — the
+    straggler/serialization signature) must trip the plan-drift WARNING
+    through the lint path; the measured figure, not a static one."""
+    _schedule, model, x, tracer = traced_run
+    g = events_for(model)
+    slow = [
+        dataclasses.replace(
+            e, t_end=e.t_start + e.duration * (25 if e.stage == 0 else 1)
+        )
+        for e in tracer.events
+    ]
+    serialized = Timeline(sync=True)
+    serialized.events = slow
+    try:
+        report = obs.reconcile(serialized, g, pipe=model)
+        assert report.bubble_drift > obs.BUBBLE_TOLERANCE
+        findings = report.drift_findings()
+        assert findings and findings[0].rule == "plan-drift"
+        assert findings[0].severity == Severity.WARNING
+        assert "measured bubble" in findings[0].message
+        # Through lint: check_plan_drift consumes the attached report.
+        found = [
+            f for f in analysis.lint(
+                model, jax.ShapeDtypeStruct(x.shape, x.dtype),
+                rules=["plan-drift"],
+            )
+            if f.rule == "plan-drift"
+        ]
+        assert found and "measured bubble" in found[0].message
+    finally:
+        # The fixture's model is shared module-wide: never leave the
+        # doctored measurement attached.
+        del model._measured_reconcile
+
+
+def test_reconcile_dispatch_only_stands_down(traced_run):
+    """A sync=False timeline yields no drift findings (its durations are
+    dispatch intervals) — the dispatch-only-timeline rule owns that."""
+    _schedule, model, _x, tracer = traced_run
+    async_tl = Timeline(sync=False)
+    async_tl.events = list(tracer.events)
+    report = obs.reconcile(async_tl, events_for(model))
+    assert report.dispatch_only
+    assert report.drift_findings() == []
+
+
+def test_reconcile_unmatched_and_unmeasured_accounting(traced_run):
+    _schedule, model, _x, tracer = traced_run
+    g = events_for(model)
+    # A span from a stage the graph doesn't know -> unmatched.
+    stray = dataclasses.replace(tracer.events[0], stage=7)
+    tl = Timeline(sync=True)
+    tl.events = list(tracer.events) + [stray]
+    report = obs.reconcile(tl, g)
+    assert (7, stray.mbatch, stray.name) in report.unmatched_spans
+    assert report.coverage < 1.0
+    # Dropping every bwd span -> those graph cells report unmeasured.
+    tl2 = Timeline(sync=True)
+    tl2.events = [e for e in tracer.events if e.name == "fwd"]
+    report2 = obs.reconcile(tl2, g)
+    assert report2.coverage == 1.0  # all remaining spans map
+    assert all(ph == "bwd" for (_s, _m, ph) in report2.unmeasured_cells)
+
+
+def test_overlay_chrome_trace_two_processes(traced_run, tmp_path):
+    _schedule, model, _x, tracer = traced_run
+    report = obs.reconcile(tracer, events_for(model))
+    path = os.path.join(tmp_path, "overlay.json")
+    obs.overlay_chrome_trace(report, path)
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    pids = {e["pid"] for e in events}
+    assert pids == {0, 1}
+    measured = [e for e in events
+                if e["ph"] == "X" and e["args"].get("side") == "measured"]
+    predicted = [e for e in events
+                 if e["ph"] == "X" and e["args"].get("side") == "predicted"]
+    assert measured and predicted
+    # Both sides keyed by the same node-id vocabulary.
+    m_names = {e["name"] for e in measured}
+    p_names = {e["name"] for e in predicted}
+    assert m_names == p_names
+
+
+# --------------------------------------------------------------------- #
+# trace_report CLI (the trace-verify gate)                              #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow  # a second full measured run beyond the fixture's
+def test_trace_report_cli_ok_and_chrome(tmp_path, capsys):
+    from tools.trace_report import main as trace_main
+
+    chrome = os.path.join(tmp_path, "t.json")
+    rc = trace_main(["--reconcile", "--chrome", chrome, "--steps", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "coverage 100%" in out and "[trace-verify] OK" in out
+    with open(chrome) as f:
+        assert json.load(f)["traceEvents"]
+
+
+@pytest.mark.slow  # a second full measured run beyond the fixture's
+def test_trace_report_cli_gate_failure(capsys):
+    from tools.trace_report import main as trace_main
+
+    # An impossible coverage floor makes the gate fail deterministically
+    # without a second (expensive) measured run shape.
+    rc = trace_main(["--reconcile", "--steps", "1",
+                     "--min-coverage", "1.01"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "DRIFT" in err and "coverage" in err

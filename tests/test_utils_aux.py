@@ -430,8 +430,15 @@ def test_timeline_chrome_trace_export(tmp_path):
     meta = [e for e in events if e["ph"] == "M"]
     slices = [e for e in events if e["ph"] == "X"]
     assert {m["args"]["name"] for m in meta} == {"stage 0", "stage 1"}
-    # 2 chunks x 2 stages, fwd + bwd.
-    assert len(slices) == 2 * 2 * 2, slices
+    # 2 chunks x 2 stages, fwd + bwd — plus the gathered-loss barrier's
+    # own span on the last stage (mb -1; see obs.reconcile, which needs
+    # the loss kept out of the first backward cell's measured time).
+    assert len(slices) == 2 * 2 * 2 + 1, slices
+    cells = [s for s in slices if s["args"]["kind"] != "loss"]
+    assert len(cells) == 2 * 2 * 2
+    (loss_slice,) = [s for s in slices if s["args"]["kind"] == "loss"]
+    assert loss_slice["args"]["stage"] == 1
+    assert loss_slice["args"]["micro_batch"] == -1
     assert all(s["ts"] >= 0 for s in slices)
     # Durations must faithfully reflect the recorded events (the 0.01us
     # render floor only applies to genuinely sub-resolution intervals).
@@ -444,7 +451,7 @@ def test_timeline_chrome_trace_export(tmp_path):
         key = (a["kind"], a["stage"], a["micro_batch"])
         assert abs(s["dur"] - want[key]) < 1e-6, (s, want[key])
     kinds = {s["args"]["kind"] for s in slices}
-    assert kinds == {"fwd", "bwd"}
+    assert kinds == {"fwd", "bwd", "loss"}
 
 
 def test_global_batch_from_local_single_process(cpu_devices):
@@ -753,3 +760,25 @@ def test_recommend_schedule_on_real_engine_timeline():
     for r in rows:
         assert np.isfinite(r.makespan) and r.makespan > 0
         assert 0.0 < r.busy <= 1.0
+
+
+def test_simulate_pipeline_survives_train_trace_with_barrier_spans():
+    """The engine's gathered-loss barrier records at mb -1 (and SPMD
+    step spans at stage -1); simulate_pipeline must project the CELLS
+    and ignore aggregate spans — a traced training run is the function's
+    documented input (benchmarks/unet_timeline.py feeds one directly)."""
+    tracer = Timeline(sync=True)
+    model = GPipe(_layers(), balance=[2, 2], chunks=4, tracer=tracer)
+    in_spec = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+    y = jax.random.normal(jax.random.PRNGKey(2), (8, 4))
+    model.value_and_grad(params, state, x, y, _mse)
+    assert any(e.mbatch < 0 for e in tracer.events)  # the loss barrier
+    res = simulate_pipeline(tracer.events, n_stages=2)
+    assert res is not None
+    makespan, busy, bubble = res
+    assert makespan > 0 and 0.0 < busy <= 1.0
+    # Identical to projecting the cell spans alone.
+    cells = [e for e in tracer.events if e.mbatch >= 0 and e.stage >= 0]
+    assert simulate_pipeline(cells, n_stages=2) == res

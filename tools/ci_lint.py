@@ -33,11 +33,17 @@ differently and must not share backend state):
    × remat for the fast llama presets and re-runs the event-graph
    verifier (ordering + donation + engine equivalence) on each preset's
    TOP plan: the plan the planner would hand a user must itself verify
-   clean (docs/analysis.md, planner section).
+   clean (docs/analysis.md, planner section);
+6. ``tools/trace_report.py --reconcile`` (trace-verify) — the runtime
+   telemetry layer's end-to-end contract on a tiny CPU run: a
+   ``sync=True`` measured timeline must map ≥95% of its fwd/bwd spans
+   onto the schedule's event-graph nodes and report a measured bubble
+   fraction within the documented tolerance of the static prediction
+   (``obs.reconcile``; docs/observability.md).
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
-/ ``--skip-serving`` / ``--skip-plan`` to run a subset, ``-v`` for
-per-target reports.
+/ ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` to run a
+subset, ``-v`` for per-target reports.
 """
 
 from __future__ import annotations
@@ -68,6 +74,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-pipeline", action="store_true")
     ap.add_argument("--skip-serving", action="store_true")
     ap.add_argument("--skip-plan", action="store_true")
+    ap.add_argument("--skip-trace", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -117,6 +124,12 @@ def main(argv: Sequence[str] | None = None) -> int:
             sys.executable, str(REPO / "tools" / "plan_report.py"), "--ci",
         ]
         failures += _run("plan-verify", cmd) != 0
+    if not args.skip_trace:
+        cmd = [
+            sys.executable, str(REPO / "tools" / "trace_report.py"),
+            "--reconcile",
+        ]
+        failures += _run("trace-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

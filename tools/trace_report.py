@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Measured-trace report + reconciliation gate over a tiny CPU run.
+
+The CLI face of :mod:`torchgpipe_tpu.obs`: build a tiny llama pipeline
+with a ``sync=True`` timeline, run a few training steps on the CPU
+backend, and reconcile the measured spans against the schedule's event
+graph (:func:`torchgpipe_tpu.obs.reconcile`)::
+
+    python tools/trace_report.py                      # summary table
+    python tools/trace_report.py --schedule 1f1b      # PipeDream-flush
+    python tools/trace_report.py --chrome trace.json  # Perfetto overlay
+    python tools/trace_report.py --reconcile          # drift gate
+
+``--reconcile`` exits non-zero when the measured run drifts from the
+prediction: span coverage below ``--min-coverage`` (default 0.95 — at
+least 95% of measured fwd/bwd spans must map onto event-graph nodes) or
+measured bubble fraction exceeding the predicted one by more than
+``--drift-threshold`` (default ``obs.BUBBLE_TOLERANCE``, the documented
+band — see its definition for the calibration).  This is the ``trace-verify`` step of
+``tools/ci_lint.py``: the telemetry layer's one end-to-end contract —
+measure a real run, map it onto the predicted graph, agree — checked on
+every CI run with hardware anyone has.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+from typing import Any, Optional, Sequence, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def build_tiny(schedule: str, chunks: int, n_stages: int) -> Tuple[Any, Any, Any]:
+    """A deliberately small llama BLOCK stack (far below the bench
+    'tiny' preset: this runs per-cell blocked on every CI invocation)
+    on the MPMD per-cell engine — the engine whose tracer sees
+    individual cells.  Blocks only, no embed/head: those stages are
+    intrinsically imbalanced (a BALANCE property the planner handles),
+    and this gate verifies SCHEDULE agreement — measured bubble vs the
+    graph's prediction — which wants near-uniform cells."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchgpipe_tpu.gpipe import GPipe
+    from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+    from torchgpipe_tpu.utils.tracing import Timeline
+
+    cfg = TransformerConfig(
+        vocab=256, dim=128, n_layers=2 * n_stages, n_heads=4,
+        n_kv_heads=2, mlp_ratio=2.0,
+    )
+    blocks = llama(cfg)[1:-1]  # strip token embed + lm head
+    balance = [2] * n_stages
+    tracer = Timeline(sync=True)
+    kw = {"loss_reduction": "mean"} if schedule == "1f1b" else {}
+    model = GPipe(blocks, balance=balance, chunks=chunks,
+                  checkpoint="except_last", schedule=schedule,
+                  tracer=tracer, **kw)
+    x = jnp.zeros((8, 32, cfg.dim), jnp.float32)
+    return model, x, tracer
+
+
+def measure(model: Any, x: Any, tracer: Any, steps: int) -> None:
+    """One warm-up step (compiles stay out of the trace), then ``steps``
+    recorded steps; every cell blocks to completion (``sync=True``)."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(out: Any, tgt: Any) -> Any:
+        return jnp.mean((out.astype(jnp.float32) - tgt) ** 2)
+
+    in_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    params, state = model.init(jax.random.PRNGKey(0), in_spec)
+    rng = jax.random.PRNGKey(1)
+    loss, grads, state, _ = model.value_and_grad(
+        params, state, x, x, loss_fn, rng=rng
+    )
+    jax.block_until_ready((loss, grads))
+    tracer.reset()
+    for i in range(steps):
+        loss, grads, state, _ = model.value_and_grad(
+            params, state, x, x, loss_fn, rng=jax.random.fold_in(rng, i)
+        )
+        jax.block_until_ready((loss, grads))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="measured-trace summary + reconciliation drift gate"
+    )
+    ap.add_argument("--schedule", choices=("gpipe", "1f1b"),
+                    default="gpipe")
+    ap.add_argument("--chunks", type=int, default=4)
+    ap.add_argument("--stages", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=2,
+                    help="recorded steps (after one warm-up)")
+    ap.add_argument("--chrome", metavar="OUT.json",
+                    help="write the measured-vs-predicted Perfetto "
+                         "overlay trace")
+    ap.add_argument("--reconcile", action="store_true",
+                    help="exit 1 on coverage/drift gate failure")
+    ap.add_argument("--drift-threshold", type=float, default=None,
+                    help="measured-minus-predicted bubble tolerance "
+                         "(default: obs.BUBBLE_TOLERANCE)")
+    ap.add_argument("--min-coverage", type=float, default=0.95)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from torchgpipe_tpu import obs
+    from torchgpipe_tpu.analysis.events import events_for
+
+    threshold = (
+        args.drift_threshold if args.drift_threshold is not None
+        else obs.BUBBLE_TOLERANCE
+    )
+    model, x, tracer = build_tiny(args.schedule, args.chunks, args.stages)
+    measure(model, x, tracer, args.steps)
+    graph = events_for(model)
+    report = obs.reconcile(tracer, graph, pipe=model)
+    print(report.summary(), flush=True)
+    if args.chrome:
+        obs.overlay_chrome_trace(report, args.chrome)
+        print(f"chrome trace: {args.chrome} (open in ui.perfetto.dev)",
+              flush=True)
+    if not args.reconcile:
+        return 0
+    failures = []
+    if report.coverage < args.min_coverage:
+        failures.append(
+            f"coverage {report.coverage:.0%} < {args.min_coverage:.0%}: "
+            "measured spans did not map onto the event graph"
+        )
+    if report.bubble_drift > threshold:
+        failures.append(
+            f"measured bubble {report.measured_bubble:.3f} exceeds "
+            f"predicted {report.predicted_bubble:.3f} by "
+            f"{report.bubble_drift:.3f} (> {threshold:.2f})"
+        )
+    for f in failures:
+        print(f"[trace-verify] DRIFT: {f}", file=sys.stderr, flush=True)
+    if not failures:
+        print(
+            f"[trace-verify] OK: coverage {report.coverage:.0%}, "
+            f"bubble drift {report.bubble_drift:+.3f} "
+            f"(tolerance {threshold:.2f})",
+            flush=True,
+        )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -9,7 +9,9 @@ expresses the whole schedule as one compiled ``shard_map`` program.
 Public API (reference: torchgpipe/__init__.py:1-6 exports ``GPipe``,
 ``is_checkpointing``, ``is_recomputing``).  Long-run production concerns
 (crash-safe checkpointing, guarded steps, preemption, fault injection)
-live in :mod:`torchgpipe_tpu.resilience`.
+live in :mod:`torchgpipe_tpu.resilience`; runtime telemetry (metrics
+registry, trace spine, measured-vs-predicted reconciliation) in
+:mod:`torchgpipe_tpu.obs`.
 """
 
 from torchgpipe_tpu.checkpoint import is_checkpointing, is_recomputing

@@ -684,6 +684,7 @@ def makespan(
     g: EventGraph,
     cost_of: Callable[[Event], float],
     comm_cost_of: Optional[Callable[[Transfer], float]] = None,
+    record_starts: Optional[Dict[Event, float]] = None,
 ) -> Tuple[float, List[float]]:
     """Critical-path makespan of the schedule under per-event costs.
 
@@ -704,6 +705,12 @@ def makespan(
     transfer (``Transfer.overlapped``, the send-ahead shape) delays only
     its receiver, hiding under the sender's next compute.  Omitting
     ``comm_cost_of`` reproduces the historical zero-cost-comm model.
+
+    ``record_starts`` (optional): a dict the relaxation fills with each
+    event's critical-path START time — the per-event placement
+    :func:`torchgpipe_tpu.obs.overlay_chrome_trace` lays its predicted
+    lane out with, kept here so overlay and makespan can never disagree
+    on edge semantics.
 
     Raises ``ValueError`` on a cyclic graph (run
     :func:`torchgpipe_tpu.analysis.schedule.verify_ordering` first — a
@@ -753,6 +760,10 @@ def makespan(
         raise ValueError(
             "makespan needs an acyclic schedule — the happens-before "
             "relation has a cycle (verify_ordering reports it)"
+        )
+    if record_starts is not None:
+        record_starts.update(
+            {e: finish[e] - float(cost_of(e)) for e in events}
         )
     busy = [
         sum(float(cost_of(e)) for e in rank_order)

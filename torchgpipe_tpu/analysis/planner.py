@@ -1054,14 +1054,36 @@ def check_plan_drift(trace: Any) -> List[Finding]:
 
     Opt-in by construction: without a declared budget the planner cannot
     certify feasibility, so the rule stands down (the same gate the
-    memory-certification budget check uses)."""
+    memory-certification budget check uses).
+
+    MEASURED drift: when the pipe carries a runtime reconciliation
+    (:func:`torchgpipe_tpu.obs.reconcile` called with ``pipe=`` attaches
+    its report), the rule also consumes the MEASURED bubble fraction —
+    a run whose measured bubble exceeds the schedule's prediction by
+    more than the documented tolerance WARNs even without a declared
+    budget (the report's own :meth:`~torchgpipe_tpu.obs.
+    ReconcileReport.drift_findings`, which stands down on dispatch-only
+    timelines and <50% span coverage)."""
+    measured: List[Finding] = []
+    recon = getattr(trace.pipe, "_measured_reconcile", None)
+    if recon is not None:
+        # Stale-measurement guard: the attached report describes ONE
+        # (schedule, chunks) configuration; if the pipe was reconfigured
+        # since it was measured, its figures no longer apply — stand
+        # down rather than re-emit findings about the old plan.  (A
+        # rebalance at the same schedule/chunks is not detectable here;
+        # re-run obs.reconcile after any reconfiguration.)
+        g = recon.graph
+        sched = getattr(trace.pipe, "schedule", g.schedule)
+        if g.schedule == sched and g.chunks == trace.pipe.chunks:
+            measured = list(recon.drift_findings())
     budget = getattr(trace.pipe, "hbm_budget_bytes", None)
     if budget is None:
-        return []
+        return measured
     try:
         report = plan(trace.pipe, trace.x_spec, budget)
     except Exception:  # noqa: BLE001 - the planner stands down, not lint
-        return []
+        return measured
     # Dispatch-granularity coherence with the dispatch-per-step rule:
     # unless the pipe built a DONATED train step (which already forfeits
     # per-step StepGuard retry), the user may be keeping megastep=1 /
@@ -1079,7 +1101,7 @@ def check_plan_drift(trace: Any) -> List[Finding]:
         report = dataclasses.replace(report, candidates=candidates)
     top = report.best
     if top is None or top.predicted_mfu is None:
-        return []
+        return measured
     def plan_key(p: Plan) -> Tuple:
         return (p.schedule, p.checkpoint, p.policy, p.chunks, p.balance,
                 p.megastep, _unroll_key(p.scan_unroll))
@@ -1090,19 +1112,19 @@ def check_plan_drift(trace: Any) -> List[Finding]:
         None,
     )
     if actual is None or actual.predicted_mfu is None:
-        return []
+        return measured
     top_key = plan_key(top)
     if top_key == actual_key:
-        return []
+        return measured
     drift = 1.0 - actual.predicted_mfu / top.predicted_mfu
     if drift <= PLAN_DRIFT_THRESHOLD and actual.feasible:
-        return []
+        return measured
     what = (
         "is over the declared HBM budget"
         if not actual.feasible
         else f"predicts {drift:.0%} lower MFU"
     )
-    return [Finding(
+    return measured + [Finding(
         rule="plan-drift",
         severity=Severity.WARNING,
         path=f"plan/{trace.engine}",

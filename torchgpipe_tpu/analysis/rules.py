@@ -672,6 +672,30 @@ def _register_planner_rules() -> None:
 
 _register_planner_rules()
 
+
+def _check_dispatch_only_timeline(trace: PipelineTrace) -> List[Finding]:
+    # Imported at CALL time: obs.reconciliation itself imports the analysis
+    # package (for the event-graph cost model), so binding it at module
+    # import would be a cycle.
+    from torchgpipe_tpu.obs.reconciliation import check_dispatch_only_timeline
+
+    return check_dispatch_only_timeline(trace)
+
+
+def _register_obs_rules() -> None:
+    """The runtime-telemetry rule (obs.reconcile) — same single-registry
+    treatment as the schedule and planner families."""
+    RULES.append(Rule(
+        "dispatch-only-timeline",
+        "a sync=False Timeline records dispatch intervals, not device "
+        "durations — simulate_pipeline/obs.reconcile projections over it "
+        "assume true per-cell device times; stands down on sync=True",
+        _check_dispatch_only_timeline,
+    ))
+
+
+_register_obs_rules()
+
 RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULES}
 
 

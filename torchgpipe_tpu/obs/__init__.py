@@ -1,0 +1,88 @@
+"""Unified runtime telemetry: metrics registry, trace spine, reconciliation.
+
+The analysis stack (:mod:`torchgpipe_tpu.analysis`) *predicts* — makespan,
+bubble fraction, per-rank memory, MFU — from static event graphs; this
+package *measures* a real run in the same vocabulary and reconciles the
+two (the runtime counterpart the reference approximates with an
+``nvidia-smi`` side process, reference benchmarks/unet-timeline).  Three
+layers:
+
+* **Metrics registry** (:mod:`~torchgpipe_tpu.obs.registry`) — labeled
+  counters / gauges / histograms with an injectable clock, JSONL and
+  Prometheus-text exporters, and percentile summaries.
+  :class:`~torchgpipe_tpu.serving.metrics.ServingMetrics` and
+  :class:`~torchgpipe_tpu.resilience.guard.GuardStats` are re-based on
+  it (public APIs unchanged).
+* **Trace spine** — :class:`~torchgpipe_tpu.utils.tracing.Timeline`
+  records per-cell spans in the MPMD engine and scan-granularity
+  ``step``/``megastep`` spans in :class:`~torchgpipe_tpu.spmd.SpmdGPipe`
+  (compiled scan bodies are not host-visible; the honest granularity is
+  the dispatch, with :func:`device_trace` for the XLA interior);
+  :func:`overlay_chrome_trace` exports measured-vs-predicted Perfetto
+  traces keyed by event-graph node ids ``(stage, micro_batch, phase)``.
+* **Reconciliation** (:func:`reconcile`) — maps measured spans onto
+  :mod:`analysis.events` nodes and reports measured-vs-predicted
+  makespan / bubble fraction / per-stage busy time; its measured drift
+  feeds the ``plan-drift`` lint rule.  :class:`StepReporter` is the
+  training-loop face: step wall time, tokens/s, measured MFU, guard
+  counters, periodic structured log lines.
+
+Full story: ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from torchgpipe_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from torchgpipe_tpu.obs.reporter import StepReporter, measured_step_flops
+from torchgpipe_tpu.utils.tracing import Timeline, device_trace
+
+# The reconciliation half pulls in the whole analysis stack (event
+# graphs, planner, rules); the registry/reporter half is what the
+# RUNTIME modules (resilience.guard, serving.metrics) import on their
+# hot import path.  PEP 562 lazy attributes keep the latter light.
+_RECONCILE_EXPORTS = (
+    "BUBBLE_TOLERANCE",
+    "ReconcileReport",
+    "check_dispatch_only_timeline",
+    "overlay_chrome_trace",
+    "reconcile",
+)
+
+
+def __getattr__(name: str) -> Any:
+    if name in _RECONCILE_EXPORTS:
+        import importlib
+
+        mod = importlib.import_module("torchgpipe_tpu.obs.reconciliation")
+        # Bind the resolved names into the package namespace so the
+        # lookup happens once.  (The submodule is deliberately named
+        # ``reconciliation`` — a submodule named ``reconcile`` would
+        # CLOBBER the public ``obs.reconcile`` function on the package
+        # whenever anything imported the submodule path directly.)
+        for export in _RECONCILE_EXPORTS:
+            globals()[export] = getattr(mod, export)
+        return globals()[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+__all__ = [
+    "BUBBLE_TOLERANCE",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "ReconcileReport",
+    "StepReporter",
+    "Timeline",
+    "check_dispatch_only_timeline",
+    "device_trace",
+    "measured_step_flops",
+    "overlay_chrome_trace",
+    "reconcile",
+]

@@ -509,6 +509,12 @@ class Pipeline:
             )
         else:
             loss, gys_last, aux = self._loss_and_grads(outs, target, loss_fn)
+        if self.tracer is not None:
+            # Record the gathered-loss barrier as its OWN span (mb -1):
+            # under sync=True this blocks here, so the loss work is not
+            # silently absorbed into the first backward cell's measured
+            # time (obs.reconcile would read that as stage imbalance).
+            self.tracer.record("loss", n - 1, -1, (loss, gys_last))
 
         # ---- backward schedule (reverse clock cycles) ------------------------
         gys: Dict[Tuple[int, int], Pytree] = {
@@ -562,7 +568,12 @@ class Pipeline:
                 gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
                 gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
             if self.tracer is not None:
-                self.tracer.record("bwd", j, i, gx)
+                # Block on the WHOLE cell output (param grads included):
+                # gx alone is None/trivial at stage 0, which would let
+                # that stage's backward work escape a sync=True
+                # measurement — obs.reconcile would then see a fake
+                # stage imbalance.
+                self.tracer.record("bwd", j, i, (gparams, gx))
             acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
             if j > 0:
                 gys[(i, j - 1)] = _transfer(gx, self.stages[j - 1].device)
@@ -658,6 +669,12 @@ class Pipeline:
                     y, _transfer(target_mbs[i], stage.device),
                     loss_weights[i], loss_fn,
                 )
+                if self.tracer is not None:
+                    # Own span (the fill-drain gathered-loss treatment,
+                    # per micro-batch here): under sync=True the loss
+                    # work blocks HERE instead of inflating the next
+                    # recorded backward cell's measured duration.
+                    self.tracer.record("loss", j, i, (loss_i, gy))
                 losses[i] = loss_i
                 auxes[i] = aux
                 gys[(i, j)] = gy
@@ -678,7 +695,12 @@ class Pipeline:
                 gext = {k: gskips.pop((i, k)) for k in stage.ext_stash_keys}
                 gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
             if self.tracer is not None:
-                self.tracer.record("bwd", j, i, gx)
+                # Block on the WHOLE cell output (param grads included):
+                # gx alone is None/trivial at stage 0, which would let
+                # that stage's backward work escape a sync=True
+                # measurement — obs.reconcile would then see a fake
+                # stage imbalance.
+                self.tracer.record("bwd", j, i, (gparams, gx))
             acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
             if j > 0:
                 gys[(i, j - 1)] = _transfer(gx, self.stages[j - 1].device)

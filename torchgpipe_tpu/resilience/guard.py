@@ -55,6 +55,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# The standalone registry module only (obs/__init__ lazy-loads the
+# reconcile half, so this does NOT drag the analysis stack in here).
+from torchgpipe_tpu.obs.registry import (
+    MetricsRegistry,
+    counter_property as _counter_property,
+)
 from torchgpipe_tpu.precision import DynamicLossScale
 
 Pytree = Any
@@ -104,13 +110,44 @@ class GuardPolicy:
         return min(self.backoff_base * (2.0 ** attempt), self.backoff_max)
 
 
-@dataclasses.dataclass
 class GuardStats:
-    """Counters the guard maintains across steps."""
+    """Counters the guard maintains across steps — registry-backed.
 
-    steps: int = 0      # successful (applied) steps
-    skipped: int = 0    # non-finite steps skipped
-    retries: int = 0    # transient retries performed
+    Re-based on :class:`torchgpipe_tpu.obs.MetricsRegistry` so guard
+    skips/retries export next to every other telemetry series (JSONL /
+    Prometheus via ``stats.registry``), while the original attribute
+    API — ``stats.steps``, ``stats.skipped``, ``stats.retries``, read
+    and ``+=``-assigned as plain ints — is unchanged.  Series names are
+    fixed (``guard_*``): ONE guard per shared registry (a second guard
+    on the same registry writes the same series); give concurrent
+    guards their own registries.
+    """
+
+    def __init__(self, registry: Any = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        self._steps = self.registry.counter(
+            "guard_steps", help="successful (applied) steps")
+        self._skipped = self.registry.counter(
+            "guard_skipped", help="non-finite steps skipped")
+        self._retries = self.registry.counter(
+            "guard_retries", help="transient retries performed")
+
+    steps = _counter_property("_steps")
+    skipped = _counter_property("_skipped")
+    retries = _counter_property("_retries")
+
+    def __repr__(self) -> str:
+        return (
+            f"GuardStats(steps={self.steps}, skipped={self.skipped}, "
+            f"retries={self.retries})"
+        )
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, GuardStats):
+            return NotImplemented
+        return (self.steps, self.skipped, self.retries) == (
+            other.steps, other.skipped, other.retries
+        )
 
 
 def _any_deleted(tree: Pytree) -> bool:
@@ -185,6 +222,7 @@ class StepGuard:
         classify: Callable[[BaseException], str] = classify_error,
         sleep: Callable[[float], None] = time.sleep,
         on_event: Optional[Callable[[str, dict], None]] = None,
+        registry: Any = None,
     ) -> None:
         self._step = step
         self.loss_scale = loss_scale
@@ -194,7 +232,10 @@ class StepGuard:
         self._classify = classify
         self._sleep = sleep
         self._on_event = on_event
-        self.stats = GuardStats()
+        # ``registry`` (torchgpipe_tpu.obs.MetricsRegistry) shares the
+        # guard's counters with the rest of the run's telemetry; None
+        # gives the stats their own private registry (legacy shape).
+        self.stats = GuardStats(registry)
 
     def _event(self, kind: str, **info: Any) -> None:
         if self._on_event is not None:

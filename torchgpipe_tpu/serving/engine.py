@@ -113,6 +113,8 @@ class Engine:
         overhead_bytes: int = 0,
         wave_admission: bool = False,
         metrics: Optional[ServingMetrics] = None,
+        registry: Optional[Any] = None,
+        reporter: Optional[Any] = None,
         clock: Callable[[], float] = time.monotonic,
         preemption: Optional[Any] = None,
         checkpoint_manager: Optional[Any] = None,
@@ -163,7 +165,15 @@ class Engine:
             self.pool, prefill_chunk=self.prefill_chunk,
             max_active=max_active, wave_admission=wave_admission,
         )
-        self.metrics = metrics or ServingMetrics(clock=clock)
+        # ``registry`` (torchgpipe_tpu.obs.MetricsRegistry) shares the
+        # engine's counters + TTFT/TPOT histograms with the rest of the
+        # process's telemetry; ``reporter`` (obs.StepReporter) ticks per
+        # engine iteration — periodic structured log lines for the
+        # serving loop (docs/observability.md).
+        self.metrics = metrics or ServingMetrics(
+            clock=clock, registry=registry
+        )
+        self.reporter = reporter
         self.guard_policy = guard_policy or GuardPolicy()
         self._sleep = sleep
         self._preemption = preemption
@@ -387,6 +397,8 @@ class Engine:
             self._run_prefill()
         else:
             self._run_decode()
+        if self.reporter is not None:
+            self.reporter.step()
         return True
 
     def _run_prefill(self) -> None:

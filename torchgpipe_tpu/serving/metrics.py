@@ -13,6 +13,13 @@ arXiv:2309.06180):
   batch dim full), tokens emitted, transient retries, drains and the
   requests they preempted.
 
+Re-based on :class:`torchgpipe_tpu.obs.MetricsRegistry`: every counter
+is a registry series and TTFT/TPOT/queue-wait stream into registry
+histograms, so ``snapshot()`` now also reports **p50/p95/p99 TTFT and
+TPOT** and the whole set exports as JSONL or Prometheus text through
+``metrics.registry``.  The public API is unchanged — attributes read
+and assign as plain numbers, ``snapshot()`` keeps every legacy key.
+
 Everything is host-side bookkeeping around the engine loop — no device
 work, no effect on the two compiled programs.  ``snapshot()`` returns a
 plain-dict view the tests and ``bench.py --decode-serving`` read; the
@@ -24,6 +31,11 @@ from __future__ import annotations
 import dataclasses
 import time
 from typing import Any, Callable, Dict, List, Optional
+
+from torchgpipe_tpu.obs.registry import (
+    MetricsRegistry,
+    counter_property as _counter_property,
+)
 
 
 @dataclasses.dataclass
@@ -61,20 +73,58 @@ class RequestTimes:
 
 
 class ServingMetrics:
-    """Counters the serving engine maintains; see the module docstring."""
+    """Counters the serving engine maintains; see the module docstring.
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+    Series names are fixed (``serving_*``): ONE engine per shared
+    registry — a second engine on the same registry merges into the
+    same series (its snapshot then reports combined totals).  Give each
+    engine its own registry, or its own ``ServingMetrics``, when you
+    need them separable.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[MetricsRegistry] = None) -> None:
         self._clock = clock
+        self.registry = registry or MetricsRegistry(clock=clock)
         self.requests: Dict[str, RequestTimes] = {}
-        self.prefill_steps = 0
-        self.decode_steps = 0
-        self.occupied_slot_steps = 0   # slot-steps doing useful work
-        self.total_slot_steps = 0      # slot-steps available (steps * slots)
-        self.tokens_out = 0
-        self.retries = 0
-        self.drains = 0
-        self.preempted_requests = 0    # unfinished requests at drain time
+        reg = self.registry
+        self._c_prefill = reg.counter(
+            "serving_prefill_steps", help="compiled prefill steps run")
+        self._c_decode = reg.counter(
+            "serving_decode_steps", help="compiled decode steps run")
+        self._c_occupied = reg.counter(
+            "serving_occupied_slot_steps",
+            help="slot-steps doing useful work")
+        self._c_total = reg.counter(
+            "serving_total_slot_steps",
+            help="slot-steps available (steps * slots)")
+        self._c_tokens = reg.counter(
+            "serving_tokens_out", help="tokens emitted")
+        self._c_retries = reg.counter(
+            "serving_retries", help="transient step retries")
+        self._c_drains = reg.counter(
+            "serving_drains", help="cooperative drains")
+        self._c_preempted = reg.counter(
+            "serving_preempted_requests",
+            help="unfinished requests at drain time")
+        self._h_ttft = reg.histogram(
+            "serving_ttft_seconds", help="time to first token (arrival→)")
+        self._h_tpot = reg.histogram(
+            "serving_tpot_seconds", help="time per output token (decode)")
+        self._h_queue = reg.histogram(
+            "serving_queue_wait_seconds", help="arrival→admission wait")
         self.started = clock()
+
+    # Legacy attribute API (all read/assignable ints), registry-backed
+    # through the shared facade (obs.registry.counter_property).
+    prefill_steps = _counter_property("_c_prefill")
+    decode_steps = _counter_property("_c_decode")
+    occupied_slot_steps = _counter_property("_c_occupied")
+    total_slot_steps = _counter_property("_c_total")
+    tokens_out = _counter_property("_c_tokens")
+    retries = _counter_property("_c_retries")
+    drains = _counter_property("_c_drains")
+    preempted_requests = _counter_property("_c_preempted")
 
     # ------------------------------------------------------------------ #
     # request lifecycle                                                  #
@@ -90,19 +140,28 @@ class ServingMetrics:
         r = self.requests[rid]
         r.admitted = self._clock()
         r.status = "active"
+        wait = r.queue_wait
+        if wait is not None:
+            self._h_queue.observe(wait)
 
     def token(self, rid: str) -> None:
         r = self.requests[rid]
         t = self._clock()
         if r.first_token is None:
             r.first_token = t
+            ttft = r.ttft
+            if ttft is not None:
+                self._h_ttft.observe(ttft)
         r.tokens += 1
-        self.tokens_out += 1
+        self._c_tokens.inc()
 
     def finished(self, rid: str, status: str = "finished") -> None:
         r = self.requests[rid]
         r.finished = self._clock()
         r.status = status
+        tpot = r.tpot
+        if tpot is not None and status == "finished":
+            self._h_tpot.observe(tpot)
 
     # ------------------------------------------------------------------ #
     # engine iterations                                                  #
@@ -110,15 +169,15 @@ class ServingMetrics:
 
     def step(self, kind: str, active_slots: int, num_slots: int) -> None:
         if kind == "prefill":
-            self.prefill_steps += 1
+            self._c_prefill.inc()
         else:
-            self.decode_steps += 1
-        self.occupied_slot_steps += active_slots
-        self.total_slot_steps += num_slots
+            self._c_decode.inc()
+        self._c_occupied.inc(active_slots)
+        self._c_total.inc(num_slots)
 
     def drained(self, unfinished: int) -> None:
-        self.drains += 1
-        self.preempted_requests += unfinished
+        self._c_drains.inc()
+        self._c_preempted.inc(unfinished)
 
     # ------------------------------------------------------------------ #
     # snapshot                                                           #
@@ -136,7 +195,9 @@ class ServingMetrics:
         return self.occupied_slot_steps / self.total_slot_steps
 
     def snapshot(self) -> Dict[str, Any]:
-        """A plain-dict view: engine aggregates + per-request rows."""
+        """A plain-dict view: engine aggregates, latency percentiles
+        (p50/p95/p99 TTFT and TPOT from the registry histograms — None
+        until a request reaches the milestone) + per-request rows."""
         now = self._clock()
         elapsed = max(now - self.started, 1e-9)
         per_request: List[Dict[str, Any]] = []
@@ -163,6 +224,14 @@ class ServingMetrics:
             "retries": self.retries,
             "drains": self.drains,
             "preempted_requests": self.preempted_requests,
+            "ttft_p50": self._h_ttft.percentile(0.50),
+            "ttft_p95": self._h_ttft.percentile(0.95),
+            "ttft_p99": self._h_ttft.percentile(0.99),
+            "tpot_p50": self._h_tpot.percentile(0.50),
+            "tpot_p95": self._h_tpot.percentile(0.95),
+            "tpot_p99": self._h_tpot.percentile(0.99),
+            "queue_wait_p50": self._h_queue.percentile(0.50),
+            "queue_wait_p95": self._h_queue.percentile(0.95),
             "requests": per_request,
         }
 

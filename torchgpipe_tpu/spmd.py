@@ -519,6 +519,14 @@ class SpmdGPipe:
     # plan-drift lint rule compares the running configuration against
     # analysis.planner's certified top plan under it.
     hbm_budget_bytes: Optional[int] = None
+    # Optional runtime timeline (utils.tracing.Timeline — the obs trace
+    # spine).  The compiled scan's cells are not host-visible, so the
+    # HONEST recording granularity is the dispatch: make_train_step's
+    # returned callable records one "step" (K=1) or "megastep" span per
+    # call, at stage -1 (the whole-program row).  With sync=True the
+    # span is true device time (the tracer blocks on the step outputs);
+    # use obs.device_trace for the XLA-level interior of the scan.
+    tracer: Any = None
 
     def __repr__(self) -> str:
         axes = {
@@ -3315,9 +3323,13 @@ class SpmdGPipe:
             target: Pytree,
             rng: Optional[jax.Array] = None,
         ) -> Tuple[jax.Array, Pytree, Pytree]:
-            return compiled(
+            out = compiled(
                 params, opt_state, x, target, rng, _faults.plan_token()
             )
+            if self.tracer is not None:
+                # Scan-granularity span (see the ``tracer`` field note).
+                self.tracer.record("step", -1, -1, out)
+            return out
 
         step.megastep = 1  # type: ignore[attr-defined]
         return step
@@ -3390,9 +3402,13 @@ class SpmdGPipe:
                         "jnp.stack, or pass megastep=1"
                     )
                 break
-            return compiled(
+            out = compiled(
                 params, opt_state, x, target, rng, _faults.plan_token()
             )
+            if self.tracer is not None:
+                # One span per K-step program (scan granularity).
+                self.tracer.record("megastep", -1, -1, out)
+            return out
 
         step.megastep = K  # type: ignore[attr-defined]
         return step

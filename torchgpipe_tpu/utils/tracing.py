@@ -69,8 +69,10 @@ class Timeline:
         stage: int,
         mbatch: int,
         out: Any = None,
-    ) -> None:
-        """Record one cell; blocks on ``out`` when ``sync`` is set."""
+    ) -> Any:
+        """Record one cell and return ``out`` (so engines can chain
+        ``y = tracer.record("fwd", j, i, y)``); blocks on ``out`` when
+        ``sync`` is set."""
         t_start = time.perf_counter() - self._t0
         if self.sync and out is not None:
             jax.block_until_ready(out)
@@ -98,7 +100,13 @@ class Timeline:
                 "ph": "M",
                 "pid": 0,
                 "tid": stage,
-                "args": {"name": f"stage {stage}"},
+                "args": {
+                    # stage -1 is the SPMD engines' scan-granularity row
+                    # (whole compiled-step spans; the scanned cells are
+                    # not host-visible — obs.device_trace shows the
+                    # XLA interior).
+                    "name": f"stage {stage}" if stage >= 0 else "program",
+                },
             }
             for stage in sorted({e.stage for e in self.events})
         ]
@@ -204,6 +212,11 @@ def simulate_pipeline(
             )
     elif virtual_stages != 1:
         raise ValueError("virtual_stages only applies to 'interleaved'")
+    # Aggregate/barrier spans (negative micro-batch or stage: the
+    # fill-drain engine's gathered-loss barrier at mb -1, the SPMD
+    # engines' whole-program "step" spans at stage -1) are not per-cell
+    # observations — the projection is defined over cells only.
+    events = [e for e in events if e.mbatch >= 0 and e.stage >= 0]
     if not events:
         return None
     # A timeline spanning several training steps observes each (i, j) cell
