@@ -903,6 +903,20 @@ def _obs_overhead_entry() -> None:
     raise SystemExit(obs_overhead_main())
 
 
+def _flightrec_overhead_entry() -> None:
+    """The ``flightrec-overhead`` rung: 2-rank LocalTransport llama-block
+    step time with the flight recorder + stall watchdog fully on vs
+    bare, interleaved A/B rounds, medians compared
+    (benchmarks/flightrec_overhead.py).  Gated at <2% overhead — exits
+    non-zero past the gate.  Emits one JSON line::
+
+        env JAX_PLATFORMS=cpu python bench.py --flightrec-overhead
+    """
+    from benchmarks.flightrec_overhead import main as flightrec_main
+
+    raise SystemExit(flightrec_main())
+
+
 def _plan_validate_entry() -> None:
     """The ``plan-validate`` rung: predicted-vs-measured rank-order check
     of the static planner on the CPU tiny-llama preset
@@ -921,6 +935,8 @@ def _plan_validate_entry() -> None:
 if __name__ == "__main__":
     if "--obs-overhead" in sys.argv:
         _obs_overhead_entry()
+    elif "--flightrec-overhead" in sys.argv:
+        _flightrec_overhead_entry()
     elif "--plan-validate" in sys.argv:
         _plan_validate_entry()
     elif "--megastep" in sys.argv:

@@ -39,11 +39,19 @@ differently and must not share backend state):
    ``sync=True`` measured timeline must map ≥95% of its fwd/bwd spans
    onto the schedule's event-graph nodes and report a measured bubble
    fraction within the documented tolerance of the static prediction
-   (``obs.reconcile``; docs/observability.md).
+   (``obs.reconcile``; docs/observability.md);
+7. ``tools/postmortem.py --ci`` (postmortem-verify) — the flight
+   recorder's end-to-end contract: a REAL induced hang (a 2-rank
+   LocalTransport pipeline whose ``('forward', 1)`` send blocks forever
+   via ``FaultyTransport(hang_at=...)``) in a bounded-timeout
+   subprocess must leave dumps from which the postmortem analyzer
+   names EXACTLY the injected blocking edge — rank 1 waiting on recv
+   (stage 1, mb 1, fwd) from rank 0 — with the stall watchdog having
+   flagged the hung rank (docs/observability.md).
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
-/ ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` to run a
-subset, ``-v`` for per-target reports.
+/ ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` /
+``--skip-postmortem`` to run a subset, ``-v`` for per-target reports.
 """
 
 from __future__ import annotations
@@ -75,6 +83,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-serving", action="store_true")
     ap.add_argument("--skip-plan", action="store_true")
     ap.add_argument("--skip-trace", action="store_true")
+    ap.add_argument("--skip-postmortem", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -130,6 +139,13 @@ def main(argv: Sequence[str] | None = None) -> int:
             "--reconcile",
         ]
         failures += _run("trace-verify", cmd) != 0
+    if not args.skip_postmortem:
+        cmd = [
+            sys.executable, str(REPO / "tools" / "postmortem.py"), "--ci",
+        ]
+        if args.verbose:
+            cmd.append("-v")
+        failures += _run("postmortem-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

@@ -10,6 +10,14 @@ graph (:func:`torchgpipe_tpu.obs.reconcile`)::
     python tools/trace_report.py --schedule 1f1b      # PipeDream-flush
     python tools/trace_report.py --chrome trace.json  # Perfetto overlay
     python tools/trace_report.py --reconcile          # drift gate
+    python tools/trace_report.py --dumps rank*.json --chrome merged.json
+
+``--dumps`` switches the --chrome export to the MULTI-RANK overlay:
+instead of running the tiny model, the given per-rank flight-recorder
+dumps (:mod:`torchgpipe_tpu.obs.flightrec`) merge into one Perfetto
+trace — one process (pid) per rank, clock-aligned timestamps — the
+cross-rank timeline a hung distributed run leaves behind
+(``tools/postmortem.py`` names the blocking edge over the same dumps).
 
 ``--reconcile`` exits non-zero when the measured run drifts from the
 prediction: span coverage below ``--min-coverage`` (default 0.95 — at
@@ -107,7 +115,47 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="measured-minus-predicted bubble tolerance "
                          "(default: obs.BUBBLE_TOLERANCE)")
     ap.add_argument("--min-coverage", type=float, default=0.95)
+    ap.add_argument("--dumps", nargs="+", metavar="DUMP.json",
+                    help="merge these per-rank flight-recorder dumps "
+                         "into the --chrome trace instead of running "
+                         "the tiny model")
     args = ap.parse_args(argv)
+
+    if args.dumps:
+        # Pure-stdlib path: flight dumps need no model, no jax — so
+        # flightrec.py is loaded STANDALONE (its own imports are all
+        # stdlib); going through the torchgpipe_tpu package __init__
+        # would drag jax in, and the natural place to inspect dumps a
+        # dead cluster left behind may not have it installed.
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_flightrec_standalone",
+            REPO / "torchgpipe_tpu" / "obs" / "flightrec.py",
+        )
+        assert spec is not None and spec.loader is not None
+        flightrec = sys.modules.get(spec.name)
+        if flightrec is None:
+            flightrec = importlib.util.module_from_spec(spec)
+            # Registered BEFORE exec: dataclasses resolves the module's
+            # stringified annotations through sys.modules[__module__].
+            sys.modules[spec.name] = flightrec
+            spec.loader.exec_module(flightrec)
+        load_dump = flightrec.load_dump
+        merged_chrome_trace = flightrec.merged_chrome_trace
+
+        if not args.chrome:
+            ap.error("--dumps needs --chrome OUT.json")
+        loaded = [load_dump(p) for p in args.dumps]
+        merged_chrome_trace(loaded, args.chrome)
+        # Transport-only recorders may carry no rank; keep file order.
+        ranks = [d.rank for d in loaded]
+        print(
+            f"merged chrome trace: {args.chrome} — {len(loaded)} rank "
+            f"dump(s) {ranks} (open in ui.perfetto.dev)",
+            flush=True,
+        )
+        return 0
 
     import jax
 

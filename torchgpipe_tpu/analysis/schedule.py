@@ -586,6 +586,114 @@ def _execution_order(g: EventGraph) -> List[Event]:
 
 
 # --------------------------------------------------------------------- #
+# frontier replay (runtime postmortem support)                          #
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class FrontierBlock:
+    """One rank that cannot progress when the blocking-FIFO simulation
+    resumes from a recorded frontier: its next event, the inbound
+    transfers whose channels are empty, and the unexecuted same-graph
+    dependencies — the named blocking edge of a live hang."""
+
+    rank: int
+    event: Event
+    waiting: List[Transfer]
+    missing_deps: List[Event]
+
+
+def replay_frontier(
+    g: EventGraph,
+    cursors: Sequence[int],
+    channel_payloads: Optional[Dict[Tuple, int]] = None,
+) -> Tuple[List[Event], List[FrontierBlock]]:
+    """Resume the deadlock verifier's blocking-FIFO simulation from a
+    RECORDED frontier instead of the schedule's start.
+
+    ``cursors[r]`` is how far rank ``r`` provably got (its executed
+    prefix of ``g.order[r]`` — from a flight-recorder dump);
+    ``channel_payloads`` maps channel keys ``(kind, index, src, dst)``
+    to the number of messages delivered but not yet consumed (receiver
+    -side arrivals minus matches).  Events executed during the replay
+    produce their sends normally — the replay is OPTIMISTIC about the
+    future, so a stall is structural: some rank's next event waits on a
+    message that no remaining execution can produce.  Returns
+    ``(progressed, blocked)``: the events the replay could still
+    execute, and one :class:`FrontierBlock` per stuck rank (empty
+    ``blocked`` == the run was slow, not deadlocked).
+
+    This is :func:`verify_ordering`'s operational model applied at
+    runtime — the same per-rank program orders, the same blocking FIFO
+    channels — which is what lets a postmortem dump reuse the deadlock
+    machinery the static verifier already trusts
+    (:mod:`torchgpipe_tpu.obs.postmortem`).
+    """
+    if len(cursors) != g.n_ranks:
+        raise ValueError(
+            f"cursors names {len(cursors)} ranks but the graph has "
+            f"{g.n_ranks}"
+        )
+    inbound: Dict[Event, List[Transfer]] = {}
+    outbound: Dict[Event, List[Transfer]] = {}
+    for t in g.transfers:
+        inbound.setdefault(t.dst, []).append(t)
+        outbound.setdefault(t.src, []).append(t)
+    dep_of: Dict[Event, List[Event]] = {}
+    for a, b in g.deps:
+        dep_of.setdefault(b, []).append(a)
+
+    def ckey(t: Transfer) -> Tuple:
+        return (t.channel.kind, t.channel.index, t.channel.src,
+                t.channel.dst)
+
+    chan: Dict[Tuple, int] = dict(channel_payloads or {})
+    executed: Set[Event] = set()
+    pos = [min(int(c), len(g.order[r])) for r, c in enumerate(cursors)]
+    for r in range(g.n_ranks):
+        executed.update(g.order[r][:pos[r]])
+    progressed: List[Event] = []
+    total = sum(len(o) for o in g.order)
+    while len(executed) < total:
+        moved = False
+        for r in range(g.n_ranks):
+            while pos[r] < len(g.order[r]):
+                e = g.order[r][pos[r]]
+                if any(d not in executed for d in dep_of.get(e, [])):
+                    break
+                if any(chan.get(ckey(t), 0) <= 0
+                       for t in inbound.get(e, [])):
+                    break
+                for t in inbound.get(e, []):
+                    chan[ckey(t)] -= 1
+                for t in outbound.get(e, []):
+                    if not t.lost:
+                        chan[ckey(t)] = chan.get(ckey(t), 0) + 1
+                executed.add(e)
+                progressed.append(e)
+                pos[r] += 1
+                moved = True
+        if not moved:
+            break
+
+    blocked: List[FrontierBlock] = []
+    if len(executed) < total:
+        for r in range(g.n_ranks):
+            if pos[r] >= len(g.order[r]):
+                continue
+            e = g.order[r][pos[r]]
+            blocked.append(FrontierBlock(
+                rank=r,
+                event=e,
+                waiting=[t for t in inbound.get(e, [])
+                         if chan.get(ckey(t), 0) <= 0],
+                missing_deps=[d for d in dep_of.get(e, [])
+                              if d not in executed],
+            ))
+    return progressed, blocked
+
+
+# --------------------------------------------------------------------- #
 # 4. engine equivalence                                                 #
 # --------------------------------------------------------------------- #
 

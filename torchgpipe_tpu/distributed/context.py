@@ -75,10 +75,19 @@ class Mailbox:
     Reference: torchgpipe/distributed/context.py:19-26 (``TrainingContext``
     holds ``chunks`` forward + ``chunks`` backward queues + a target queue);
     here channels are created on demand, which also carries skip tensors.
+
+    ``recorder`` (an :class:`~torchgpipe_tpu.obs.flightrec.
+    FlightRecorder`, attached by the owning rank) turns every delivery
+    into a ``mail_put`` flight event carrying the post-put channel depth
+    — the RECEIVER-side arrival evidence the postmortem analyzer pairs
+    against the sender's ``send`` event: a send with no matching arrival
+    is a message lost (or hung) in transport.  ``put`` runs on sender /
+    listener threads, which is why the recorder is thread-safe.
     """
 
     def __init__(self, name: str) -> None:
         self.name = name
+        self.recorder: Optional[Any] = None
         self._channels: Dict[ChannelKey, queue.Queue] = {}
         self._lock = threading.Lock()
 
@@ -90,8 +99,20 @@ class Mailbox:
                 ch = self._channels[key] = queue.Queue()
             return ch
 
+    def depth(self, kind: Any, index: int) -> int:
+        """Approximate queued-message count on one channel (``qsize`` —
+        exact for the single-consumer engine loops)."""
+        with self._lock:
+            ch = self._channels.get((kind, index))
+        return ch.qsize() if ch is not None else 0
+
     def put(self, kind: Any, index: int, payload: Payload) -> None:
-        self._channel(kind, index).put(payload)
+        ch = self._channel(kind, index)
+        ch.put(payload)
+        rec = self.recorder
+        if rec is not None:
+            rec.record("mail_put", channel=(kind, index),
+                       detail=f"depth={ch.qsize()}")
 
     def get(self, kind: Any, index: int, timeout: Optional[float] = None) -> Payload:
         try:
@@ -172,6 +193,13 @@ class TcpTransport:
 
     ``addresses`` maps every worker name to ``(host, port)``; this worker
     binds its own address and receives into its :class:`Mailbox`.
+
+    ``recorder`` (optional :class:`~torchgpipe_tpu.obs.flightrec.
+    FlightRecorder`) is attached to the mailbox (arrival events) and
+    records the transport's OWN failure anatomy: every connect-retry
+    attempt, the final connect timeout, and a send-timeout — each
+    recorded BEFORE its exception is raised, so a dump from a half-dead
+    pipeline shows the retry history instead of ending mid-air.
     """
 
     def __init__(
@@ -181,12 +209,15 @@ class TcpTransport:
         *,
         connect_timeout: float = 120.0,
         send_timeout: Optional[float] = None,
+        recorder: Optional[Any] = None,
     ) -> None:
         self.name = name
         self.addresses = dict(addresses)
         self.connect_timeout = connect_timeout
         self.send_timeout = send_timeout
+        self.recorder = recorder
         self.mailbox = Mailbox(name)
+        self.mailbox.recorder = recorder
         host, port = self.addresses[name]
         self._server = socketserver.ThreadingTCPServer(
             (host, port), _MsgHandler, bind_and_activate=False
@@ -218,6 +249,7 @@ class TcpTransport:
         # listener may not be up yet — retry refused connections until
         # connect_timeout instead of crashing the first sender.
         deadline = time.monotonic() + self.connect_timeout
+        attempt = 0
         while True:
             # Clamp each attempt to the REMAINING deadline budget: a bare
             # 30s per-attempt timeout could overshoot connect_timeout by up
@@ -237,7 +269,23 @@ class TcpTransport:
                 # refused — equally transient during rendezvous.
                 # Only genuinely transient rendezvous failures are retried;
                 # misconfiguration (bad hostname etc.) raises immediately.
+                attempt += 1
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "connect_retry", channel=(kind, index), peer=dst,
+                        detail=f"attempt={attempt} {type(err).__name__}",
+                    )
                 if time.monotonic() >= deadline:
+                    if self.recorder is not None:
+                        # Final flight event BEFORE raising: the dump of
+                        # a rank that died mid-rendezvous must show the
+                        # whole retry history, not end mid-air.
+                        self.recorder.record(
+                            "connect_timeout", channel=(kind, index),
+                            peer=dst,
+                            detail=f"{attempt} attempts over "
+                                   f"{self.connect_timeout}s",
+                        )
                     raise TimeoutError(
                         f"worker {self.name!r} could not reach {dst!r} at "
                         f"{host}:{port} within {self.connect_timeout}s — is "
@@ -259,6 +307,12 @@ class TcpTransport:
             try:
                 sock.sendall(struct.pack("!Q", len(blob)) + blob)
             except socket.timeout:
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "send_timeout", channel=(kind, index), peer=dst,
+                        detail=f"{len(blob)} bytes, "
+                               f"send_timeout={self.send_timeout}s",
+                    )
                 raise TimeoutError(
                     f"worker {self.name!r}: send of {len(blob)} bytes to "
                     f"{dst!r} did not complete within {self.send_timeout}s "

@@ -52,6 +52,7 @@ def _recv_probing_peer(
     timeout: Optional[float],
     src_rank: int,
     workers: Sequence[str],
+    recorder: Optional[Any] = None,
 ) -> Pytree:
     """Mailbox receive that converts a timeout into a
     :class:`~torchgpipe_tpu.distributed.context.PeerDiedError` when the
@@ -62,11 +63,27 @@ def _recv_probing_peer(
     (and only then — zero steady-state cost) names the dead rank so the
     supervisor restarts the right process.  A slow-but-alive peer still
     surfaces as the original ``TimeoutError``.
+
+    With a ``recorder`` (:class:`torchgpipe_tpu.obs.flightrec.
+    FlightRecorder`) the receive becomes a pair of flight events —
+    ``recv_wait`` (with the channel's mailbox depth) and ``recv_match``
+    (with the measured wait) — and every failure path records its final
+    event (``recv_timeout`` / ``peer_died``) and triggers
+    :meth:`~torchgpipe_tpu.obs.flightrec.FlightRecorder.crash_dump`
+    BEFORE raising, so the dump names the exact blocking channel.
     """
+    name = workers[src_rank]
+    t0 = 0.0
+    if recorder is not None:
+        depth = getattr(mailbox, "depth", None)
+        t0 = recorder.clock()
+        recorder.record(
+            "recv_wait", channel=(kind, index), peer=name,
+            detail=f"depth={depth(kind, index)}" if depth else "",
+        )
     try:
-        return mailbox.get(kind, index, timeout=timeout)
+        payload = mailbox.get(kind, index, timeout=timeout)
     except TimeoutError as err:
-        name = workers[src_rank]
         probe = getattr(transport, "is_alive", None)
         if probe is not None:
             try:
@@ -74,13 +91,39 @@ def _recv_probing_peer(
             except Exception:  # noqa: BLE001 — a broken probe must not
                 alive = True   # mask the original timeout
             if not alive:
+                if recorder is not None:
+                    recorder.record(
+                        "peer_died", channel=(kind, index), peer=name,
+                        dur=recorder.clock() - t0,
+                        detail=f"rank {src_rank} endpoint gone",
+                    )
+                    recorder.crash_dump(
+                        f"peer_died rank={src_rank} "
+                        f"channel={(kind, index)!r}"
+                    )
                 raise PeerDiedError(
                     src_rank,
                     name,
                     f"no message on channel {(kind, index)!r} within "
                     f"{timeout}s and its transport endpoint is gone",
                 ) from err
+        if recorder is not None:
+            recorder.record(
+                "recv_timeout", channel=(kind, index), peer=name,
+                dur=recorder.clock() - t0,
+                detail=f"timeout={timeout}s, peer alive",
+            )
+            recorder.crash_dump(
+                f"recv_timeout channel={(kind, index)!r} "
+                f"from rank {src_rank}"
+            )
         raise
+    if recorder is not None:
+        recorder.record(
+            "recv_match", channel=(kind, index), peer=name,
+            dur=recorder.clock() - t0,
+        )
+    return payload
 
 
 class DistributedGPipe:
@@ -106,6 +149,7 @@ class DistributedGPipe:
         checkpoint: str = 'except_last',
         deferred_batch_norm: bool = False,
         recv_timeout: Optional[float] = None,
+        recorder: Optional[Any] = None,
     ) -> None:
         # recv_timeout (opt-in) bounds every cross-rank receive: a dead or
         # wedged peer surfaces as a TimeoutError naming the missing channel
@@ -156,6 +200,14 @@ class DistributedGPipe:
         self.transport = transport
         self.mailbox = mailbox
         self.recv_timeout = recv_timeout
+        # Flight recorder (torchgpipe_tpu.obs.flightrec.FlightRecorder):
+        # every send enqueue, receive wait/match, cell completion and
+        # loop boundary becomes a ring-buffer event, and the mailbox
+        # records arrivals with channel depth — the black box the
+        # postmortem analyzer (tools/postmortem.py) reads after a hang.
+        self.recorder = recorder
+        if recorder is not None and getattr(mailbox, "recorder", None) is None:
+            mailbox.recorder = recorder
 
         partitions = split_layers(layers, balance)
         self.layout = inspect_skip_layout(partitions)
@@ -174,6 +226,30 @@ class DistributedGPipe:
         }
         self._ctx: Optional[Dict[str, Any]] = None
         self._loss_grad = LossGradRunner()
+        if recorder is not None:
+            # Everything the postmortem analyzer needs to rebuild this
+            # schedule's event graph from the dump alone (the same
+            # inputs analysis.events.events_for reads off a live pipe).
+            recorder.set_meta(
+                engine="distributed",
+                rank=rank,
+                worker=self.workers[rank],
+                workers=list(self.workers),
+                chunks=chunks,
+                checkpoint=checkpoint,
+                skips=[
+                    [str(key), src, dst]
+                    for key, (src, dst) in sorted(
+                        self.layout.by_key.items(),
+                        key=lambda kv: str(kv[0]),
+                    )
+                    if src != dst
+                ],
+            )
+            if recorder.rank is None:
+                recorder.rank = rank
+            if recorder.worker is None:
+                recorder.worker = self.workers[rank]
 
     # ------------------------------------------------------------------ #
 
@@ -196,9 +272,29 @@ class DistributedGPipe:
             _recv_probing_peer(
                 self.mailbox, self.transport, kind, index,
                 self.recv_timeout, src_rank, self.workers,
+                recorder=self.recorder,
             ),
             self.device,
         )
+
+    def _send(self, dst_rank: int, kind: Any, index: int,
+              payload: Pytree) -> None:
+        """Transport send with a ``send`` flight event recorded FIRST —
+        a send that then hangs or dies in the transport leaves its
+        enqueue on the ring (the sender-side half the postmortem pairs
+        with the receiver's ``mail_put`` arrival)."""
+        dst = self.workers[dst_rank]
+        if self.recorder is not None:
+            self.recorder.record("send", channel=(kind, index), peer=dst)
+        try:
+            self.transport.send(dst, kind, index, payload)
+        except Exception as err:
+            if self.recorder is not None:
+                self.recorder.record(
+                    "send_fail", channel=(kind, index), peer=dst,
+                    detail=type(err).__name__,
+                )
+            raise
 
     def init(
         self, rng: jax.Array, in_spec: Pytree
@@ -245,6 +341,14 @@ class DistributedGPipe:
         torchgpipe/distributed/gpipe.py:159-178).  Returns the per-micro-batch
         outputs on the last rank, else ``None``.
         """
+        rec = self.recorder
+        if rec is not None:
+            # Step boundary FIRST — before the meta exchange — so one
+            # recorded step is everything from here through backward_end:
+            # the postmortem's frontier window (a ring holding several
+            # steps must not let a past step's cells mask the current
+            # step's frontier).
+            rec.record("forward_begin", detail=f"train={train}")
         if self.is_first:
             if batch is None:
                 raise ValueError("rank 0 must be given the input batch")
@@ -257,7 +361,7 @@ class DistributedGPipe:
             # for micro-batches that never come.  Channels are FIFO per key,
             # so index 0 is safe across steps.
             for r in range(1, len(self.workers)):
-                self.transport.send(self.workers[r], "meta", 0, m)
+                self._send(r, "meta", 0, m)
         else:
             if batch is not None:
                 raise ValueError("only rank 0 feeds the input batch")
@@ -266,9 +370,15 @@ class DistributedGPipe:
                 _recv_probing_peer(
                     self.mailbox, self.transport, "meta", 0,
                     self.recv_timeout, 0, self.workers,
+                    recorder=self.recorder,
                 )
             )
 
+        if rec is not None:
+            # The agreed micro-batch count, recorded once it is known
+            # (after the meta broadcast/receive) — what the postmortem
+            # rebuilds the step's event graph with.
+            rec.record("forward_plan", detail=f"m={m}")
         stop = checkpoint_stop(self.checkpoint, m, train=train)
         stage = self.stage
         cur_state = list(state)
@@ -287,6 +397,7 @@ class DistributedGPipe:
                 for k in stage.ext_pop_keys
             }
             rng_i = jax.random.fold_in(rng, i) if rng is not None else None
+            t_cell = rec.clock() if rec is not None else 0.0
             if train and i < stop:
                 y, ext, new_state = stage.fwd_ckpt(
                     params, cur_state, x, skips_in, rng_i, 1.0 / m
@@ -301,14 +412,22 @@ class DistributedGPipe:
                 y, ext, new_state = stage.fwd_eval(
                     params, cur_state, x, skips_in, rng_i, 1.0 / m
                 )
+            if rec is not None:
+                # Dispatch-granularity duration (JAX is async; the
+                # transport's host staging is what forces completion) —
+                # honest for ordering and for the straggler MEDIANS the
+                # postmortem compares across ranks.
+                rec.record("fwd", stage=self.rank, mb=i,
+                           dur=rec.clock() - t_cell)
             cur_state = list(new_state)
             for k, v in ext.items():
-                dst = self.workers[self._skip_pop_rank[k]]
-                self.transport.send(dst, ("skip", k), i, v)
+                self._send(self._skip_pop_rank[k], ("skip", k), i, v)
             if self.is_last:
                 outs.append(y)
             else:
-                self.transport.send(self.workers[self.rank + 1], "forward", i, y)
+                self._send(self.rank + 1, "forward", i, y)
+        if rec is not None:
+            rec.record("forward_end", detail=f"m={m}")
 
         self._ctx = {
             "m": m,
@@ -375,6 +494,9 @@ class DistributedGPipe:
                 "receive theirs from the next rank's backward"
             )
 
+        rec = self.recorder
+        if rec is not None:
+            rec.record("backward_begin", detail=f"m={m}")
         for i in reversed(range(m)):
             if self.is_last:
                 gy = grad_outputs[i]
@@ -384,6 +506,7 @@ class DistributedGPipe:
                 k: self._recv(("skip_grad", k), i, self._skip_pop_rank[k])
                 for k in stage.ext_stash_keys
             }
+            t_cell = rec.clock() if rec is not None else 0.0
             if i in ctx["saved"]:
                 x, skips_in, state_in, rng_i = ctx["saved"].pop(i)
                 # Recompute-ahead (reference: torchgpipe/checkpoint.py:1-19).
@@ -394,14 +517,16 @@ class DistributedGPipe:
             else:
                 pull = ctx["pulls"].pop(i)
             gparams, gx, gsk_in = stage.bwd(pull, (gy, gext))
+            if rec is not None:
+                rec.record("bwd", stage=self.rank, mb=i,
+                           dur=rec.clock() - t_cell)
             acc = gparams if acc is None else stage.accum(acc, gparams)
             if not self.is_first:
-                self.transport.send(
-                    self.workers[self.rank - 1], "backward", i, gx
-                )
+                self._send(self.rank - 1, "backward", i, gx)
             for k, g in gsk_in.items():
-                dst = self.workers[self._skip_stash_rank[k]]
-                self.transport.send(dst, ("skip_grad", k), i, g)
+                self._send(self._skip_stash_rank[k], ("skip_grad", k), i, g)
+        if rec is not None:
+            rec.record("backward_end", detail=f"m={m}")
 
         return list(acc), ctx["state"]
 

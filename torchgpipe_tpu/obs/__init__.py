@@ -26,6 +26,13 @@ layers:
   feeds the ``plan-drift`` lint rule.  :class:`StepReporter` is the
   training-loop face: step wall time, tokens/s, measured MFU, guard
   counters, periodic structured log lines.
+* **Flight recorder + postmortem** (:mod:`~torchgpipe_tpu.obs.
+  flightrec`, :mod:`~torchgpipe_tpu.obs.postmortem`) — a fixed-size
+  per-rank event ring inside the multi-process engine and transports
+  (dump on crash / SIGTERM / stall-watchdog timeout, cross-rank clock
+  alignment), and the analyzer that replays the deadlock verifier's
+  blocking-FIFO simulation from the recorded frontier to NAME the
+  blocking edge of a live hang.
 
 Full story: ``docs/observability.md``.
 """
@@ -34,6 +41,15 @@ from __future__ import annotations
 
 from typing import Any
 
+from torchgpipe_tpu.obs.flightrec import (
+    FlightEvent,
+    FlightRecorder,
+    RankDump,
+    StallWatchdog,
+    align_clocks,
+    load_dump,
+    merged_chrome_trace,
+)
 from torchgpipe_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -43,46 +59,65 @@ from torchgpipe_tpu.obs.registry import (
 from torchgpipe_tpu.obs.reporter import StepReporter, measured_step_flops
 from torchgpipe_tpu.utils.tracing import Timeline, device_trace
 
-# The reconciliation half pulls in the whole analysis stack (event
-# graphs, planner, rules); the registry/reporter half is what the
-# RUNTIME modules (resilience.guard, serving.metrics) import on their
-# hot import path.  PEP 562 lazy attributes keep the latter light.
-_RECONCILE_EXPORTS = (
-    "BUBBLE_TOLERANCE",
-    "ReconcileReport",
-    "check_dispatch_only_timeline",
-    "overlay_chrome_trace",
-    "reconcile",
-)
+# The reconciliation and postmortem halves pull in the whole analysis
+# stack (event graphs, planner, rules); the registry/reporter/flightrec
+# half is what the RUNTIME modules (resilience.guard, serving.metrics,
+# distributed.gpipe) import on their hot import path.  PEP 562 lazy
+# attributes keep the latter light.  (The reconciliation submodule is
+# deliberately NOT named ``reconcile``: a submodule sharing the public
+# function's name would clobber ``obs.reconcile`` on any direct
+# submodule import.  The postmortem analyzer keeps the standard layout
+# instead — ``obs.postmortem`` IS the submodule; its entry point is
+# ``obs.postmortem.postmortem(dumps)``, so the package never exports a
+# same-named function attribute that an import could clobber.)
+_LAZY_EXPORTS = {
+    "BUBBLE_TOLERANCE": "torchgpipe_tpu.obs.reconciliation",
+    "ReconcileReport": "torchgpipe_tpu.obs.reconciliation",
+    "check_dispatch_only_timeline": "torchgpipe_tpu.obs.reconciliation",
+    "overlay_chrome_trace": "torchgpipe_tpu.obs.reconciliation",
+    "reconcile": "torchgpipe_tpu.obs.reconciliation",
+    "uniform_cost": "torchgpipe_tpu.obs.reconciliation",
+    "BlockingEdge": "torchgpipe_tpu.obs.postmortem",
+    "PostmortemReport": "torchgpipe_tpu.obs.postmortem",
+}
 
 
 def __getattr__(name: str) -> Any:
-    if name in _RECONCILE_EXPORTS:
+    modname = _LAZY_EXPORTS.get(name)
+    if modname is not None:
         import importlib
 
-        mod = importlib.import_module("torchgpipe_tpu.obs.reconciliation")
+        mod = importlib.import_module(modname)
         # Bind the resolved names into the package namespace so the
-        # lookup happens once.  (The submodule is deliberately named
-        # ``reconciliation`` — a submodule named ``reconcile`` would
-        # CLOBBER the public ``obs.reconcile`` function on the package
-        # whenever anything imported the submodule path directly.)
-        for export in _RECONCILE_EXPORTS:
-            globals()[export] = getattr(mod, export)
+        # lookup happens once.
+        for export, m in _LAZY_EXPORTS.items():
+            if m == modname:
+                globals()[export] = getattr(mod, export)
         return globals()[name]
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "BUBBLE_TOLERANCE",
+    "BlockingEdge",
     "Counter",
+    "FlightEvent",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "PostmortemReport",
+    "RankDump",
     "ReconcileReport",
+    "StallWatchdog",
     "StepReporter",
     "Timeline",
+    "align_clocks",
     "check_dispatch_only_timeline",
     "device_trace",
+    "load_dump",
     "measured_step_flops",
+    "merged_chrome_trace",
     "overlay_chrome_trace",
     "reconcile",
+    "uniform_cost",
 ]

@@ -1,0 +1,456 @@
+"""Cross-rank postmortem: merged flight dumps -> the named blocking edge.
+
+A stalled multi-rank pipeline leaves one dump per rank
+(:mod:`torchgpipe_tpu.obs.flightrec`); this module turns them back into
+the vocabulary the repo already reasons in:
+
+1. **Rebuild the schedule** — each dump carries the engine's
+   configuration (workers, chunks, checkpoint, skip layout), so the
+   exact event graph the run was executing comes from
+   :func:`torchgpipe_tpu.analysis.events.distributed_events`, the same
+   builder the static deadlock verifier trusts.
+2. **Recover the frontier** — recorded ``fwd``/``bwd`` cell completions
+   give each rank's executed prefix; receiver-side ``mail_put``
+   arrivals minus ``recv_match`` consumptions give the channel
+   occupancy at the moment of the dump.
+3. **Replay** — :func:`torchgpipe_tpu.analysis.schedule.replay_frontier`
+   resumes the blocking-FIFO simulation from that frontier.  If it
+   completes, the run was slow, not stuck; if it stalls, each stuck
+   rank's next event IS the blocking edge, and the dumps say why:
+   the peer never sent, or sent into a transport that never delivered.
+4. **Name it** — ``"rank 1 waiting on recv (stage 1, mb 1, fwd) from
+   rank 0, which sent but the message never arrived; rank 0 last
+   event: send ('forward', 1) at +0.42s"`` — plus a straggler table
+   (per-rank per-phase median / p99, skew against the fleet median,
+   priced with :func:`torchgpipe_tpu.obs.reconciliation.uniform_cost`
+   so phases are comparable the way reconciliation compares them).
+
+CLI face: ``tools/postmortem.py`` (including the ``postmortem-verify``
+CI gate that induces a real hang and requires this module to name the
+injected edge exactly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from torchgpipe_tpu.analysis import events as ev
+from torchgpipe_tpu.analysis import schedule as sched
+from torchgpipe_tpu.obs.flightrec import FlightEvent, RankDump
+from torchgpipe_tpu.obs.reconciliation import uniform_cost
+
+# Recorded cell-completion kinds — deliberately the event-graph phase
+# names, so dump events and graph nodes share one vocabulary.
+_CELL_KINDS = (ev.FWD, ev.BWD)
+
+
+def _fmt_event(e: Optional[FlightEvent]) -> str:
+    if e is None:
+        return "<no events recorded>"
+    if e.kind in _CELL_KINDS and e.stage is not None:
+        return f"{e.kind} ({e.stage}, {e.mb})"
+    out = e.kind
+    if e.channel is not None:
+        out += f" {e.channel!r}"
+    if e.detail:
+        out += f" [{e.detail}]"
+    return out
+
+
+@dataclasses.dataclass
+class BlockingEdge:
+    """One stuck rank's named wait: the event-graph node it cannot
+    execute, the channel it is waiting on, and what the peer's own dump
+    says happened to the missing message."""
+
+    rank: int
+    worker: Optional[str]
+    event: ev.Event
+    channel: Optional[Tuple[Any, int]]
+    peer_rank: Optional[int]
+    peer_worker: Optional[str]
+    peer_sent: bool
+    peer_last: str
+    peer_last_t: Optional[float]  # aligned seconds from run start
+    wait_s: Optional[float]       # how long the rank had been waiting
+    missing_dep: Optional[ev.Event] = None
+    # Root-cause edge: the missing message is not explained by the peer
+    # being stuck itself — either it was sent and lost/hung in
+    # transport, or the peer is not blocked.  Secondary edges are the
+    # downstream dominoes; the report lists roots first.
+    root: bool = True
+
+    def describe(self) -> str:
+        s, mb, ph = self.event.cell
+        if self.channel is None and self.missing_dep is not None:
+            return (
+                f"rank {self.rank} blocked at {ph} (stage {s}, mb {mb}) "
+                f"on unexecuted dependency {self.missing_dep!r}"
+            )
+        head = f"rank {self.rank} waiting on recv (stage {s}, mb {mb}, {ph})"
+        if self.channel is not None:
+            head += f" on channel {self.channel!r}"
+        if self.peer_rank is not None:
+            head += f" from rank {self.peer_rank}, "
+            head += (
+                "which sent but the message never arrived (lost or hung "
+                "in transport)" if self.peer_sent else "which never sent"
+            )
+            head += f"; rank {self.peer_rank} last event: {self.peer_last}"
+            if self.peer_last_t is not None:
+                head += f" at +{self.peer_last_t:.2f}s"
+        if self.wait_s is not None:
+            head += f" (waited {self.wait_s:.2f}s)"
+        return head
+
+
+@dataclasses.dataclass
+class StragglerRow:
+    """Per-rank per-phase cell-duration summary.  ``skew`` is the
+    rank's median over the fleet median of the same phase (1.0 = on
+    pace); ``unit_s`` divides by the reconciliation cost model
+    (``fwd``=1, ``bwd``=2) so phases compare on one scale."""
+
+    rank: int
+    phase: str
+    n: int
+    median_s: float
+    p99_s: float
+    skew: float
+    unit_s: float
+
+
+@dataclasses.dataclass
+class PostmortemReport:
+    """What :func:`postmortem` hands back."""
+
+    graph: ev.EventGraph
+    dumps: Dict[int, RankDump]
+    cursors: List[int]
+    replayed: int                  # events the optimistic replay executed
+    blocking: List[BlockingEdge]
+    stragglers: List[StragglerRow]
+
+    @property
+    def hang_suspected(self) -> bool:
+        return bool(self.blocking)
+
+    def summary(self) -> str:
+        g = self.graph
+        lines = [
+            f"postmortem: {g.engine}/{g.schedule} n={g.n_stages} "
+            f"m={g.chunks} — {len(self.dumps)} rank dump(s)"
+        ]
+        for r in range(g.n_ranks):
+            total = len(g.order[r])
+            lines.append(
+                f"  rank {r}: executed {self.cursors[r]}/{total} "
+                "scheduled events"
+                + ("" if r in self.dumps else " (NO DUMP — assumed at 0)")
+            )
+        if self.blocking:
+            lines.append(
+                f"  HANG: replay stalls with {len(self.blocking)} "
+                "blocking edge(s), root cause(s) first:"
+            )
+            lines.extend(
+                f"    [{'ROOT' if b.root else 'downstream'}] "
+                f"{b.describe()}"
+                for b in self.blocking
+            )
+        else:
+            lines.append(
+                "  replay from the recorded frontier completes "
+                f"({self.replayed} remaining events): the run was slow "
+                "or interrupted, not structurally stuck"
+            )
+        if self.stragglers:
+            lines.append(
+                "  stragglers (median/p99 per phase; skew vs fleet "
+                "median):"
+            )
+            lines.extend(
+                f"    rank {s.rank} {s.phase}: n={s.n} "
+                f"median {s.median_s * 1e3:.2f}ms "
+                f"p99 {s.p99_s * 1e3:.2f}ms skew {s.skew:.2f}"
+                for s in self.stragglers
+            )
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------- #
+# dump -> graph/frontier extraction                                     #
+# --------------------------------------------------------------------- #
+
+
+def _by_rank(dumps: Sequence[RankDump]) -> Dict[int, RankDump]:
+    out: Dict[int, RankDump] = {}
+    for d in dumps:
+        rank = d.rank if d.rank is not None else d.meta.get("rank")
+        if rank is None:
+            raise ValueError(
+                "dump carries no rank (neither the recorder's rank nor "
+                "meta['rank']) — was the recorder attached to a "
+                "DistributedGPipe?"
+            )
+        out[int(rank)] = d
+    return out
+
+
+def _current_step(events: Sequence[FlightEvent]) -> List[FlightEvent]:
+    """The CURRENT step's events: everything from the last recorded
+    ``forward_begin`` on (the engine records it before the meta
+    exchange, so the slice holds the whole step).  A ring buffer holds
+    several steps of history; frontier and channel extraction must not
+    let a PAST step's completed cells mask where the current step
+    actually is.  Falls back to the full dump when no step boundary was
+    recorded (partial rings, foreign recorders)."""
+    for k in range(len(events) - 1, -1, -1):
+        if events[k].kind == "forward_begin":
+            return list(events[k:])
+    return list(events)
+
+
+def _recorded_m(dumps: Sequence[RankDump]) -> Optional[int]:
+    for d in dumps:
+        for e in _current_step(d.events):
+            if e.kind == "forward_plan" and e.detail.startswith("m="):
+                try:
+                    return int(e.detail.split()[0][2:])
+                except ValueError:
+                    continue
+    return None
+
+
+def graph_from_dumps(dumps: Sequence[RankDump]) -> ev.EventGraph:
+    """Rebuild the run's event graph from the dumps' recorded engine
+    configuration (the same inputs ``events_for`` reads off a live
+    pipe; ``m`` prefers the recorded ``forward_plan`` event over the
+    configured ``chunks`` — ragged batches scatter fewer)."""
+    from torchgpipe_tpu.checkpoint import checkpoint_stop
+
+    meta = next(
+        (d.meta for d in dumps if d.meta.get("engine") == "distributed"),
+        None,
+    )
+    if meta is None:
+        raise ValueError(
+            "no dump carries distributed-engine meta (workers/chunks/"
+            "checkpoint) — postmortem needs at least one recorder that "
+            "was attached to a DistributedGPipe"
+        )
+    workers = list(meta["workers"])
+    m = _recorded_m(dumps) or int(meta["chunks"])
+    stop = checkpoint_stop(
+        str(meta.get("checkpoint", "except_last")), m, train=True
+    )
+    skips = [(k, int(s), int(d)) for k, s, d in meta.get("skips", [])]
+    return ev.distributed_events(
+        len(workers), m, stop, skips=skips, workers=workers
+    )
+
+
+def _cursors(g: ev.EventGraph, dumps: Dict[int, RankDump]) -> List[int]:
+    """Each rank's executed prefix of its program order, from the
+    CURRENT step's recorded cell completions (and the meta
+    broadcast)."""
+    cursors: List[int] = []
+    for r in range(g.n_ranks):
+        d = dumps.get(r)
+        cells: set = set()
+        meta_done = False
+        if d is not None:
+            for e in _current_step(d.events):
+                if (e.kind in _CELL_KINDS and e.stage is not None
+                        and e.mb is not None):
+                    cells.add((e.stage, e.mb, e.kind))
+                elif (e.kind in ("send", "recv_match")
+                      and e.channel is not None
+                      and e.channel[0] == "meta"):
+                    meta_done = True
+        k = 0
+        for node in g.order[r]:
+            if node.phase == ev.META and meta_done:
+                k += 1
+            elif node.phase in _CELL_KINDS and node.cell in cells:
+                k += 1
+            else:
+                break
+        cursors.append(k)
+    return cursors
+
+
+def _channel_payloads(
+    g: ev.EventGraph,
+    dumps: Dict[int, RankDump],
+    executed: set,
+) -> Dict[Tuple, int]:
+    """Receiver-side channel occupancy within the CURRENT step, per
+    mailbox key, attributed to the graph's channel (src/dst ride along
+    from the transfer table).  Windowed like the cursors: mailbox keys
+    are reused every step, so a past step's balanced traffic must not
+    be re-counted (a stale duplicate surviving ACROSS steps is the
+    verifier's ``duplicate`` analysis, not a hang).
+
+    A message counts as AVAILABLE to the replay unless its consuming
+    event actually completed: the frontier replay will re-execute an
+    in-progress event, so a ``recv_match`` performed by an event that
+    never finished must not deduct the payload (the message provably
+    arrived — blaming its transport would misname the edge; the peer's
+    true wedge point is downstream of the matched receive).  Hence per
+    key: ``arrivals − matches`` when the consumer executed, else
+    ``max(arrivals, matches)`` (a match is delivery evidence even when
+    the arrival landed before this step's window opened)."""
+    consumer_of: Dict[Tuple[Any, int, int], ev.Event] = {}
+    src_of: Dict[Tuple[Any, int, int], int] = {}
+    for t in g.transfers:
+        ckey = (t.channel.kind, t.channel.index, t.channel.dst)
+        src_of[ckey] = t.channel.src
+        consumer_of[ckey] = t.dst
+    arrivals: Dict[Tuple, int] = {}
+    matches: Dict[Tuple, int] = {}
+    for r, d in dumps.items():
+        for e in _current_step(d.events):
+            if e.channel is None or e.kind not in ("mail_put", "recv_match"):
+                continue
+            kind, index = e.channel
+            src = src_of.get((kind, index, r))
+            if src is None:
+                continue  # clock-handshake or foreign channels
+            key = (kind, index, src, r)
+            table = arrivals if e.kind == "mail_put" else matches
+            table[key] = table.get(key, 0) + 1
+    counts: Dict[Tuple, int] = {}
+    for key in set(arrivals) | set(matches):
+        kind, index, _src, dst = key
+        a = arrivals.get(key, 0)
+        m = matches.get(key, 0)
+        consumer = consumer_of.get((kind, index, dst))
+        if consumer is not None and consumer in executed:
+            counts[key] = a - m
+        else:
+            counts[key] = max(a, m)
+    return {k: v for k, v in counts.items() if v > 0}
+
+
+def _p99(durs: Sequence[float]) -> float:
+    ds = sorted(durs)
+    return ds[min(len(ds) - 1, round(0.99 * (len(ds) - 1)))]
+
+
+def _stragglers(dumps: Dict[int, RankDump]) -> List[StragglerRow]:
+    per: Dict[Tuple[int, str], List[float]] = {}
+    for r, d in dumps.items():
+        for e in d.events:
+            if e.kind in _CELL_KINDS and e.dur is not None:
+                per.setdefault((r, e.kind), []).append(e.dur)
+    if not per:
+        return []
+    medians = {k: statistics.median(v) for k, v in per.items()}
+    fleet: Dict[str, List[float]] = {}
+    for (_r, ph), med in medians.items():
+        fleet.setdefault(ph, []).append(med)
+    fleet_med = {ph: statistics.median(v) for ph, v in fleet.items()}
+    rows: List[StragglerRow] = []
+    for (r, ph), durs in sorted(per.items()):
+        med = medians[(r, ph)]
+        base = fleet_med[ph]
+        cost = uniform_cost(ph) or 1.0
+        rows.append(StragglerRow(
+            rank=r, phase=ph, n=len(durs), median_s=med,
+            p99_s=_p99(durs),
+            skew=(med / base) if base > 0 else 1.0,
+            unit_s=med / cost,
+        ))
+    return rows
+
+
+# --------------------------------------------------------------------- #
+# the analyzer                                                          #
+# --------------------------------------------------------------------- #
+
+
+def postmortem(dumps: Sequence[RankDump]) -> PostmortemReport:
+    """Merge per-rank flight dumps, replay the blocking-FIFO simulation
+    from the recorded frontier, and name every blocking edge (see the
+    module docstring for the pipeline)."""
+    by_rank = _by_rank(dumps)
+    g = graph_from_dumps(dumps)
+    cursors = _cursors(g, by_rank)
+    executed = {
+        e for r in range(g.n_ranks) for e in g.order[r][:cursors[r]]
+    }
+    payloads = _channel_payloads(g, by_rank, executed)
+    progressed, blocks = sched.replay_frontier(g, cursors, payloads)
+
+    t_zero = min(
+        (d.aligned(e.t) for d in by_rank.values() for e in d.events),
+        default=0.0,
+    )
+    edges: List[BlockingEdge] = []
+    for b in blocks:
+        d = by_rank.get(b.rank)
+        worker = d.worker if d is not None else None
+        if not b.waiting and b.missing_deps:
+            edges.append(BlockingEdge(
+                rank=b.rank, worker=worker, event=b.event, channel=None,
+                peer_rank=None, peer_worker=None, peer_sent=False,
+                peer_last="", peer_last_t=None, wait_s=None,
+                missing_dep=b.missing_deps[0],
+            ))
+            continue
+        for t in b.waiting:
+            key = (t.channel.kind, t.channel.index)
+            peer_rank = t.channel.src
+            peer = by_rank.get(peer_rank)
+            # Windowed like the frontier: a PAST step's send on the
+            # same (reused) mailbox key must not fake current-step
+            # transport loss.
+            peer_sent = peer is not None and any(
+                e.kind == "send" and e.channel == key
+                for e in _current_step(peer.events)
+            )
+            last = peer.last_event() if peer is not None else None
+            wait_s: Optional[float] = None
+            if d is not None:
+                waits = [e for e in d.events
+                         if e.kind == "recv_wait" and e.channel == key]
+                if waits:
+                    wait_s = max(0.0, d.t_dump - waits[-1].t)
+            edges.append(BlockingEdge(
+                rank=b.rank, worker=worker, event=b.event, channel=key,
+                peer_rank=peer_rank,
+                peer_worker=peer.worker if peer is not None else None,
+                peer_sent=peer_sent,
+                peer_last=_fmt_event(last),
+                peer_last_t=(
+                    peer.aligned(last.t) - t_zero
+                    if peer is not None and last is not None else None
+                ),
+                wait_s=wait_s,
+            ))
+    blocked_ranks = {b.rank for b in blocks}
+    for e in edges:
+        e.root = e.missing_dep is None and (
+            e.peer_sent or e.peer_rank not in blocked_ranks
+        )
+    edges.sort(key=lambda e: (not e.root, e.rank))
+    return PostmortemReport(
+        graph=g,
+        dumps=by_rank,
+        cursors=cursors,
+        replayed=len(progressed),
+        blocking=edges,
+        stragglers=_stragglers(by_rank),
+    )
+
+
+__all__ = [
+    "BlockingEdge",
+    "PostmortemReport",
+    "StragglerRow",
+    "graph_from_dumps",
+    "postmortem",
+]

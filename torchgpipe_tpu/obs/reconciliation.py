@@ -73,6 +73,14 @@ def _default_predicted_cost(event: ev.Event) -> float:
     return _UNIFORM_COST.get(event.phase, 0.0)
 
 
+def uniform_cost(phase: str) -> float:
+    """The uniform-cell predicted cost of one phase (``fwd`` = 1,
+    ``bwd`` = 2, ``wgt`` = 1 — see the module docstring).  Public so
+    other measured-vs-predicted comparisons (the postmortem straggler
+    report) price phases with exactly this module's model."""
+    return _UNIFORM_COST.get(phase, 0.0)
+
+
 @dataclasses.dataclass
 class ReconcileReport:
     """What :func:`reconcile` hands back; all times in seconds except
@@ -375,4 +383,5 @@ __all__ = [
     "check_dispatch_only_timeline",
     "overlay_chrome_trace",
     "reconcile",
+    "uniform_cost",
 ]

@@ -202,18 +202,52 @@ class FaultyTransport:
     ``recv_timeout``).  ``delay`` sleeps before delivering; ``duplicate``
     delivers twice.  Everything else (register/unregister/close/is_alive)
     delegates to the wrapped transport.
+
+    ``hang_at=(kind, index)`` is the HANG fault: a send matching that
+    mailbox key blocks forever — the wedged-peer failure mode that never
+    raises, which ``drop``/``lose`` cannot reproduce (the sender
+    continues past a lose; a real hang pins the sender's schedule too).
+    It is the first-class witness for stall-watchdog and postmortem
+    tests, replacing ad-hoc sleeps.  Cooperatively interruptible:
+    :meth:`release` unblocks every hung sender (which then returns
+    WITHOUT delivering — the message was lost to the hang); hung test
+    threads must be daemons or released in teardown.  A hang is
+    transport-level and traces nothing, so it never tokens the compiled
+    -program caches (:func:`plan_token` stays None — inert plans don't
+    invalidate programs).
     """
 
-    def __init__(self, inner: Any, faults: Sequence[SendFault] = ()) -> None:
+    def __init__(
+        self,
+        inner: Any,
+        faults: Sequence[SendFault] = (),
+        *,
+        hang_at: Optional[Tuple[Any, int]] = None,
+    ) -> None:
         self.inner = inner
         self.faults: List[SendFault] = list(faults)
+        self.hang_at = hang_at
         self.log: List[Tuple[str, str, Any, int]] = []  # (action, dst, kind, i)
+        self._hang_release = threading.Event()
 
     def add(self, fault: SendFault) -> "FaultyTransport":
         self.faults.append(fault)
         return self
 
+    def release(self) -> None:
+        """Unblock every sender currently hung by ``hang_at`` (their
+        messages stay undelivered) and let future matches pass through."""
+        self._hang_release.set()
+
     def send(self, dst: str, kind: Any, index: int, payload: Any) -> None:
+        if (
+            self.hang_at is not None
+            and self.hang_at == (kind, index)
+            and not self._hang_release.is_set()
+        ):
+            self.log.append(("hang", dst, kind, index))
+            self._hang_release.wait()  # block until cooperatively released
+            return  # the hung message is never delivered
         sends = 1
         for f in self.faults:
             if not f.matches(dst, kind, index):

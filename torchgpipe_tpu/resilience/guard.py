@@ -131,10 +131,36 @@ class GuardStats:
             "guard_skipped", help="non-finite steps skipped")
         self._retries = self.registry.counter(
             "guard_retries", help="transient retries performed")
+        # Labeled error anatomy: every exception the guard sees, by the
+        # classification that decided its fate and the concrete type —
+        # and, for PeerDiedError, the OFFENDING RANK, so guard retries
+        # and the flight-recorder postmortem dumps cross-reference the
+        # same incident instead of telling disjoint stories.
+        self._errors = self.registry.counter(
+            "guard_errors",
+            help="step exceptions seen, by classification and type",
+            labels=("classification", "error"),
+        )
+        self._peer_died = self.registry.counter(
+            "guard_peer_died",
+            help="PeerDiedError occurrences by offending rank",
+            labels=("rank",),
+        )
 
     steps = _counter_property("_steps")
     skipped = _counter_property("_skipped")
     retries = _counter_property("_retries")
+
+    def record_error(self, classification: str, err: BaseException) -> None:
+        """Count one step exception under its classification/type; a
+        :class:`~torchgpipe_tpu.distributed.context.PeerDiedError` also
+        names its dead rank in the ``guard_peer_died`` series."""
+        self._errors.inc(
+            classification=classification, error=type(err).__name__
+        )
+        rank = getattr(err, "rank", None)
+        if rank is not None:
+            self._peer_died.inc(rank=str(rank))
 
     def __repr__(self) -> str:
         return (
@@ -326,8 +352,10 @@ class StepGuard:
             try:
                 return self._step(*args, **kwargs)
             except Exception as err:  # noqa: BLE001 — classified below
+                classification = self._classify(err)
+                self.stats.record_error(classification, err)
                 if (
-                    self._classify(err) != "transient"
+                    classification != "transient"
                     or attempt >= self.policy.max_retries
                 ):
                     if attempt > 0 and hasattr(err, "add_note"):
