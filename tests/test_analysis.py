@@ -733,3 +733,94 @@ def test_dispatch_only_timeline_stands_down_without_tracer():
     model = GPipe(_mpmd_layers(), balance=[2, 1], chunks=2)
     assert analysis.lint(model, X, target=Y, loss_fn=mse,
                          rules=["dispatch-only-timeline"]) == []
+
+
+# --------------------------------------------------------------------- #
+# implicit-reshard (the sharding verifier's lint rule; see              #
+# tests/test_sharding.py for the verifier itself)                       #
+# --------------------------------------------------------------------- #
+
+
+def _sharded_bias_block(spec_b):
+    from jax.sharding import PartitionSpec as P  # noqa: F401
+
+    def init(rng, spec):
+        d = spec.shape[-1]
+        return {"w": jax.random.normal(rng, (d, d)) * 0.02,
+                "b": jnp.zeros((d,))}, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        return x @ params["w"] + params["b"], state
+
+    return Layer(name="bd", init=init, apply=apply,
+                 meta={"param_specs": {"w": P(), "b": spec_b}})
+
+
+def test_implicit_reshard_warns_on_layout_induced_gather(cpu_devices):
+    """Broken: a tp-sharded bias leaks sharding to the block output,
+    which the replicated pipeline carry must gather EVERY schedule tick
+    — the rule WARNs through the lint path with the fix named."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(2, 1, tp=2, devices=cpu_devices[:4])
+    pipe = SpmdGPipe(_sharded_bias_block(P("tp")), 2, mesh, chunks=2,
+                     loss_fn=mse, tp_axis="tp")
+    found = _by_rule(
+        analysis.lint(pipe, jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                      rules=["implicit-reshard"]),
+        "implicit-reshard",
+    )
+    assert found
+    warns = [f for f in found if f.severity == Severity.WARNING]
+    assert any("stage boundary" in f.message for f in warns)
+    assert any("psum_value" in f.message for f in warns)  # the fix
+
+
+def test_implicit_reshard_errors_on_unmatched_leaf(cpu_devices):
+    """Broken: a user partition-rule table that names no rule for a
+    leaf — silent replication — is an ERROR, anchored at the leaf."""
+    from jax.sharding import PartitionSpec as P
+    from torchgpipe_tpu.analysis import partition_rules as pr
+
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(
+        _sharded_bias_block(P()), 2, mesh, chunks=2, loss_fn=mse,
+        partition_rules=pr.RuleTable(rules=(
+            pr.PartitionRule(r"blocks/w$", P("pp")),
+        )),
+    )
+    found = _by_rule(
+        analysis.lint(pipe, jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                      rules=["implicit-reshard"]),
+        "implicit-reshard",
+    )
+    errors = [f for f in found if f.severity == Severity.ERROR]
+    assert errors and "blocks/b" in errors[0].path
+    assert "silently replicate" in errors[0].message
+
+
+def test_implicit_reshard_clean_on_replicated_and_closed_tp(cpu_devices):
+    """Fixed twins: a replicated layout, and a PROPERLY CLOSED Megatron
+    tp block (psum_value after the row-parallel matmuls), both lint
+    clean — the required tp psums are priced, not flagged."""
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig, cross_entropy, llama_spmd,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    plain = SpmdGPipe(_sharded_bias_block(P()), 2, mesh, chunks=2,
+                      loss_fn=mse)
+    assert analysis.lint(plain, jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                         rules=["implicit-reshard"]) == []
+
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, tp_axis="tp")
+    block, pre, post = llama_spmd(cfg, 2)
+    tp_mesh = make_mesh(2, 1, tp=2, devices=cpu_devices[:4])
+    tp_pipe = SpmdGPipe(block, 2, tp_mesh, chunks=2,
+                        loss_fn=cross_entropy, pre=pre, post=post,
+                        tp_axis="tp")
+    assert analysis.lint(tp_pipe, jax.ShapeDtypeStruct((8, 8), jnp.int32),
+                         rules=["implicit-reshard"]) == []

@@ -607,6 +607,7 @@ def test_spmd_applied_plan_with_policy_is_drift_clean(cpu_devices):
         with_policy.schedule, with_policy.checkpoint, with_policy.policy,
         with_policy.chunks, None, with_policy.megastep,
         planner._unroll_key(with_policy.scan_unroll),
+        with_policy.dp, with_policy.tp, with_policy.zero,
     )
     # True == 1 in Python: the key must NOT conflate full unroll with
     # the default, or drift matching resolves onto the wrong candidate.
@@ -626,3 +627,197 @@ def test_mpmd_indivisible_batch_yields_no_candidates():
     assert report.best is None and report.candidates == []
     # An explicit user override is honored as-given.
     assert planner.mpmd_chunk_options(7, (7,), 4) == [7]
+
+
+# --------------------------------------------------------------------- #
+# 3D search: dp x tp x pp widths, sharding certification, ZeRO          #
+# --------------------------------------------------------------------- #
+
+
+def _tp_bias_block(spec_b):
+    """A block whose bias sharding the 3D-reject tests vary."""
+    from jax.sharding import PartitionSpec as P  # noqa: F401
+    from torchgpipe_tpu.layers import Layer
+
+    def init(rng, spec):
+        d = spec.shape[-1]
+        return {"w": jax.random.normal(rng, (d, d)) * 0.02,
+                "b": jnp.zeros((d,))}, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        return x @ params["w"] + params["b"], state
+
+    return Layer(name="bd", init=init, apply=apply,
+                 meta={"param_specs": {"w": P(), "b": spec_b}})
+
+
+def _llama_dp_pipe(cpu_devices):
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig, cross_entropy, llama_spmd,
+    )
+
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 2, devices=cpu_devices[:4])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+                     pre=pre, post=post, dp_axis="dp")
+    return pipe, jax.ShapeDtypeStruct((8, 8), jnp.int32)
+
+
+def test_plan_3d_enumerates_and_certifies_widths(cpu_devices):
+    """planner.plan over mesh_options: dp x tp x pp candidates appear,
+    every ranked (certified) candidate passed the sharding verifier,
+    and the ZeRO candidates' optimizer-state bytes drop ~N_dp x
+    (arXiv:2004.13336 — the planner's memory certification models the
+    sharded update)."""
+    pipe, x = _llama_dp_pipe(cpu_devices)
+    report = planner.plan(
+        pipe, x, hbm_budget_bytes=15 << 30,
+        mesh_options=[(1, 1), (2, 1)], megastep_options=[1],
+        chunks_options=[2], schedules=["fill_drain"],
+    )
+    widths = {(p.dp, p.tp) for p in report.candidates}
+    assert widths == {(1, 1), (2, 1)}
+    assert all(p.certified for p in report.candidates if p.feasible)
+    at2 = [p for p in report.candidates if p.dp == 2 and p.certified]
+    assert {p.zero for p in at2} == {False, True}
+    z = {p.zero: p.opt_state_bytes for p in at2}
+    assert z[False] == pytest.approx(2 * z[True], rel=0.01)
+    # dp=2 candidates carry the priced gradient all-reduce volume.
+    assert all(p.comm_bytes > 0 for p in at2)
+    assert all(p.comm_bytes == 0 for p in report.candidates
+               if p.dp == 1 and p.certified)
+
+
+def test_plan_3d_rejects_implicit_reshard_candidate(cpu_devices):
+    """Acceptance: a tp=2 width whose layout leaks sharding across the
+    stage boundary is REJECTED with an implicit-reshard reason, never
+    ranked."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(2, 1, tp=2, devices=cpu_devices[:4])
+    pipe = SpmdGPipe(
+        _tp_bias_block(P("tp")), 2, mesh, chunks=2, loss_fn=mse,
+        tp_axis="tp",
+    )
+    x = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    report = planner.plan(
+        pipe, x, hbm_budget_bytes=15 << 30,
+        mesh_options=[(1, 2)], megastep_options=[1],
+    )
+    assert report.best is None
+    assert report.candidates
+    assert all(not p.certified for p in report.candidates)
+    assert any("implicit reshard" in p.reason for p in report.candidates)
+
+
+def test_plan_3d_rejects_memory_overrun_candidate(cpu_devices):
+    """Acceptance: a width whose certified per-device HWM exceeds the
+    budget is REJECTED ('over HBM budget'), not ranked; the sharding +
+    schedule certification itself ran clean."""
+    pipe, x = _llama_dp_pipe(cpu_devices)
+    report = planner.plan(
+        pipe, x, hbm_budget_bytes=1 << 20,  # 1 MiB: nothing fits
+        mesh_options=[(2, 1)], megastep_options=[1],
+        chunks_options=[2], schedules=["fill_drain"],
+    )
+    assert report.best is None
+    assert any(p.reason == "over HBM budget" for p in report.candidates)
+    assert any(p.certified and not p.feasible for p in report.candidates)
+
+
+def test_apply_plan_refuses_foreign_widths_and_roundtrips_zero(cpu_devices):
+    """apply_plan cannot resize a device mesh: a plan at widths the
+    pipe's mesh doesn't have is a didactic error; a same-width ZeRO
+    plan round-trips into the pipe's zero_update field (which
+    make_train_step reads as its default)."""
+    import dataclasses as dc
+
+    pipe, x = _llama_dp_pipe(cpu_devices)
+    report = planner.plan(
+        pipe, x, hbm_budget_bytes=15 << 30, megastep_options=[1],
+        chunks_options=[2], schedules=["fill_drain"],
+    )
+    best = report.best
+    assert (best.dp, best.tp) == (2, 1)  # defaults: the pipe's widths
+    zero_plan = next(p for p in report.candidates
+                     if p.certified and p.feasible and p.zero)
+    applied = planner.apply_plan(pipe, zero_plan)
+    assert applied.zero_update is True
+    foreign = dc.replace(best, dp=4)
+    with pytest.raises(ValueError, match="cannot resize"):
+        planner.apply_plan(pipe, foreign)
+
+
+def test_plan_3d_rejects_phantom_axis_widths(cpu_devices):
+    """A width > 1 on an axis the pipe never declared must be REJECTED:
+    an undeclared axis shards nothing, and dividing per-chip compute by
+    it would certify fictitious speedup."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(_tp_bias_block(P()), 2, mesh, chunks=2, loss_fn=mse)
+    x = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    report = planner.plan(
+        pipe, x, hbm_budget_bytes=15 << 30,
+        mesh_options=[(1, 2), (2, 1)], megastep_options=[1],
+    )
+    assert report.best is None
+    assert all(not p.certified for p in report.candidates)
+    reasons = {p.reason for p in report.candidates}
+    assert any("tp_axis" in r for r in reasons)
+    assert any("dp_axis" in r for r in reasons)
+
+
+def test_plan_3d_never_ranks_zero_for_fsdp_or_dp_sharded_layouts(cpu_devices):
+    """The ZeRO update refuses fsdp and dp-sharded layouts at
+    make_train_step; the frontier must never rank a zero=True plan its
+    own engine would crash on — the zero axis is dropped for them."""
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig, cross_entropy, llama_spmd,
+    )
+
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 2, devices=cpu_devices[:4])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+                     pre=pre, post=post, dp_axis="dp", fsdp=True)
+    x = jax.ShapeDtypeStruct((8, 8), jnp.int32)
+    report = planner.plan(
+        pipe, x, hbm_budget_bytes=15 << 30, megastep_options=[1],
+        chunks_options=[2], schedules=["fill_drain"],
+    )
+    certified = [p for p in report.candidates if p.certified]
+    assert certified and all(not p.zero for p in certified)
+    # An explicit zero_options=[True] request is an honest REJECT row,
+    # not a crash-later plan.
+    report2 = planner.plan(
+        pipe, x, hbm_budget_bytes=15 << 30, megastep_options=[1],
+        chunks_options=[2], schedules=["fill_drain"],
+        zero_options=[True],
+    )
+    assert report2.best is None
+    assert any("zero=True is incompatible" in p.reason
+               for p in report2.candidates)
+
+
+def test_plan_3d_rejects_explicit_zero_without_dp(cpu_devices):
+    """An explicit zero_options=[True] request on a dp=1 pipe is an
+    honest REJECT row — never a certified plan make_train_step would
+    crash on."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(_tp_bias_block(P()), 2, mesh, chunks=2, loss_fn=mse)
+    x = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    report = planner.plan(
+        pipe, x, hbm_budget_bytes=15 << 30, megastep_options=[1],
+        chunks_options=[2], schedules=["fill_drain"],
+        zero_options=[True],
+    )
+    assert report.best is None
+    assert any("zero=True is incompatible" in p.reason
+               for p in report.candidates)

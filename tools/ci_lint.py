@@ -47,11 +47,19 @@ differently and must not share backend state):
    subprocess must leave dumps from which the postmortem analyzer
    names EXACTLY the injected blocking edge — rank 1 waiting on recv
    (stage 1, mb 1, fwd) from rank 0 — with the stall watchdog having
-   flagged the hung rank (docs/observability.md).
+   flagged the hung rank (docs/observability.md);
+8. ``tools/sharding_report.py --ci`` (sharding-verify) — the static
+   3D-layout verifier's contract on the tiny + small llama presets:
+   every param leaf resolves through the unified partition-rule table,
+   resolved specs name only existing mesh axes, the propagated block
+   layout induces no implicit reshard, and the 3D planner's TOP
+   (dp × tp × pp) plan re-verifies at its widths with per-device
+   memory under budget (docs/analysis.md, sharding section).
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
 / ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` /
-``--skip-postmortem`` to run a subset, ``-v`` for per-target reports.
+``--skip-postmortem`` / ``--skip-sharding`` to run a subset, ``-v`` for
+per-target reports.
 """
 
 from __future__ import annotations
@@ -84,6 +92,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-plan", action="store_true")
     ap.add_argument("--skip-trace", action="store_true")
     ap.add_argument("--skip-postmortem", action="store_true")
+    ap.add_argument("--skip-sharding", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -146,6 +155,12 @@ def main(argv: Sequence[str] | None = None) -> int:
         if args.verbose:
             cmd.append("-v")
         failures += _run("postmortem-verify", cmd) != 0
+    if not args.skip_sharding:
+        cmd = [
+            sys.executable, str(REPO / "tools" / "sharding_report.py"),
+            "--ci",
+        ]
+        failures += _run("sharding-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

@@ -51,7 +51,22 @@ from torchgpipe_tpu.analysis.trace import (
     trace_spmd,
 )
 from torchgpipe_tpu.analysis import events, planner, schedule
+from torchgpipe_tpu.analysis import partition_rules, sharding
 from torchgpipe_tpu.analysis import serving as serving_lint
+from torchgpipe_tpu.analysis.partition_rules import (
+    PartitionRule,
+    RuleTable,
+    match_partition_rules,
+    rules_from_specs,
+)
+from torchgpipe_tpu.analysis.sharding import (
+    CommEvent,
+    LayoutReport,
+    MeshSpec,
+    layout_bytes,
+    propagate_shardings,
+    verify_layout,
+)
 from torchgpipe_tpu.analysis.events import (
     EventGraph,
     bubble_fraction,
@@ -73,6 +88,18 @@ __all__ = [
     "Rule",
     "RULES",
     "RULES_BY_NAME",
+    "PartitionRule",
+    "RuleTable",
+    "match_partition_rules",
+    "rules_from_specs",
+    "partition_rules",
+    "sharding",
+    "CommEvent",
+    "LayoutReport",
+    "MeshSpec",
+    "layout_bytes",
+    "propagate_shardings",
+    "verify_layout",
     "PipelineTrace",
     "TracedProgram",
     "EventGraph",

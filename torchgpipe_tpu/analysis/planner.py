@@ -69,6 +69,7 @@ import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
+from jax.sharding import PartitionSpec as _P
 
 from torchgpipe_tpu.analysis import events as ev
 from torchgpipe_tpu.analysis import schedule as sched
@@ -114,14 +115,18 @@ def spmd_remat_space(pipe: Any) -> List[Tuple[str, Optional[str], Any]]:
 
 
 def spmd_chunk_options(
-    pipe: Any, batch_size: int, requested: Optional[Sequence[int]]
+    pipe: Any, batch_size: int, requested: Optional[Sequence[int]],
+    dp: Optional[int] = None, ep: Optional[int] = None,
 ) -> List[int]:
     """Micro-batch counts to sweep: divisors of the per-(dp, ep) batch
-    drawn from {2, 4, 8, 16, 32, pipe.chunks}."""
+    drawn from {2, 4, 8, 16, 32, pipe.chunks}.  ``dp``/``ep`` override
+    the pipe's own widths (the 3D planner's candidate meshes)."""
     if requested is not None:
         return list(requested)
-    dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
-    ep = pipe.mesh.shape[pipe.ep_axis] if pipe.ep_axis else 1
+    if dp is None:
+        dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
+    if ep is None:
+        ep = pipe.mesh.shape[pipe.ep_axis] if pipe.ep_axis else 1
     per = batch_size // (dp * ep)
     opts = sorted({
         c for c in (2, 4, 8, 16, 32, pipe.chunks)
@@ -180,6 +185,32 @@ def scan_unroll_options(schedule: str) -> List[Any]:
     return [1, True]
 
 
+def mesh_width_options(
+    pipe: Any, requested: Optional[Sequence[Sequence[int]]]
+) -> List[Tuple[int, int]]:
+    """(dp, tp) width candidates for the 3D search.  Default: the
+    pipe's OWN widths only — the planner never silently plans a mesh
+    the user didn't ask about; pass ``mesh_options=[(1, 1), (2, 1),
+    (2, 2)]`` to open the axis.  Candidate meshes are ABSTRACT (axis
+    sizes only, no devices), so widths beyond the host are searchable;
+    ``apply_plan`` refuses a width the pipe's real mesh doesn't have."""
+    own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
+    own_tp = pipe.mesh.shape[pipe.tp_axis] if pipe.tp_axis else 1
+    if requested is None:
+        return [(own_dp, own_tp)]
+    return [(int(d), int(t)) for d, t in requested]
+
+
+def zero_options_for(
+    requested: Optional[Sequence[bool]], dp: int
+) -> List[bool]:
+    """ZeRO optimizer-state-sharding candidates: with one data replica
+    there is nothing to shard, so the axis only opens at dp > 1."""
+    if requested is not None:
+        return [bool(z) for z in requested]
+    return [False, True] if dp > 1 else [False]
+
+
 def spmd_schedule_space(pipe: Any) -> List[str]:
     """Schedules an existing SPMD pipe can be re-planned onto WITHOUT
     changing the model: a pipe built interleaved keeps its block
@@ -235,13 +266,23 @@ class Plan:
     hwm_bytes: int  # certified per-rank device high-water mark (worst rank)
     host_bytes: int  # host-offloaded bytes at the peak (checkpoint='offload')
     feasible: bool
-    certified: bool  # ordering + memory certification both ran clean
+    certified: bool  # ordering + memory + sharding certification ran clean
     # Dispatch-granularity axes (SPMD engine): K optimizer steps per
     # compiled program and the tick scan's unroll factor.  MPMD plans
     # keep the defaults (megastep needs the fused single-device path,
     # which the planner's per-cell candidates don't build).
     megastep: int = 1
     scan_unroll: Any = 1
+    # 3D axes (SPMD engine): data/tensor widths of the candidate mesh
+    # (pp is n_stages), the ZeRO optimizer-state sharding flag, the
+    # layout-certified per-device optimizer-state bytes (drops ~N_dp×
+    # under zero=True — the acceptance the ZeRO gate pins), and the
+    # priced per-lane collective volume charged against the makespan.
+    dp: int = 1
+    tp: int = 1
+    zero: bool = False
+    opt_state_bytes: int = 0
+    comm_bytes: int = 0
     reason: str = ""
 
     def describe(self) -> str:
@@ -262,10 +303,12 @@ class Plan:
             f" +{self.host_bytes / GiB:.2f} host" if self.host_bytes else ""
         )
         unroll = "full" if self.scan_unroll is True else self.scan_unroll
+        mesh3d = f"{self.dp}x{self.tp}" + ("Z" if self.zero else "")
         return (
             f"{self.schedule:<11} {self.checkpoint:<12} "
             f"{self.policy or '-':<20} m={self.chunks:<3} "
-            f"K={self.megastep:<3} u={unroll:<4} bal={bal:<9} "
+            f"K={self.megastep:<3} u={unroll:<4} dxt={mesh3d:<6} "
+            f"bal={bal:<9} "
             f"mfu~{mfu:<8} bubble={bub:<6} "
             f"hwm={self.hwm_bytes / GiB:6.2f} GiB{host}  {status}"
         )
@@ -288,7 +331,7 @@ class PlanReport:
     def table(self) -> str:
         head = (
             f"{'schedule':<11} {'checkpoint':<12} {'policy':<20} "
-            f"{'m':<5} {'K':<5} {'u':<6} {'balance':<13} "
+            f"{'m':<5} {'K':<5} {'u':<6} {'dpxtp':<10} {'balance':<13} "
             f"{'pred-mfu':<13} {'bubble':<13} "
             f"per-rank HWM (budget {self.hbm_budget_bytes / GiB:.2f} GiB)"
         )
@@ -429,6 +472,34 @@ def _spmd_cost_fn(
     return cost
 
 
+def _layout_reject_reason(layout: Any) -> Optional[str]:
+    """Why a candidate layout fails sharding certification, or None.
+
+    ERROR findings (unmatched leaf, unknown mesh axis, indivisible dim)
+    reject outright; a propagation ``reshard`` event rejects because a
+    per-tick gather would silently dominate the step; an unused
+    declared axis rejects because the candidate width buys nothing
+    (accidental full replication)."""
+    from torchgpipe_tpu.analysis.diagnostics import Severity
+
+    for f in layout.findings:
+        if f.severity >= Severity.ERROR:
+            return f"layout: {f.message[:90]}"
+    reshards = layout.reshards()
+    if reshards:
+        e = reshards[0]
+        return (
+            f"implicit reshard: {e.detail or e.primitive} over "
+            f"{list(e.axes)}"
+        )
+    if layout.unused_axes:
+        return (
+            f"layout: declared axis {layout.unused_axes} of size > 1 "
+            "shards no param leaf (accidental full replication)"
+        )
+    return None
+
+
 def _plan_spmd(
     pipe: Any,
     batch: Pytree,
@@ -439,19 +510,21 @@ def _plan_spmd(
     chunks_options: Optional[Sequence[int]],
     megastep_opts: Optional[Sequence[int]],
     steps: Optional[int],
+    mesh_options: Optional[Sequence[Sequence[int]]],
+    zero_options: Optional[Sequence[bool]],
     overhead_bytes: int,
     param_scale: float,
 ) -> PlanReport:
     from torchgpipe_tpu import tune
+    from torchgpipe_tpu.analysis import sharding as shd
     from torchgpipe_tpu.checkpoint import checkpoint_stop
 
     x_spec = avalify(batch)
     tgt_spec = avalify(target) if target is not None else x_spec
     n = pipe.n_stages
     v = pipe.virtual_stages
-    dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
     ep = pipe.mesh.shape[pipe.ep_axis] if pipe.ep_axis else 1
-    n_chips = int(pipe.mesh.devices.size)
+    sp = pipe.mesh.shape[pipe.sp_axis] if pipe.sp_axis else 1
     B = jax.tree_util.tree_leaves(x_spec)[0].shape[0]
 
     plain_step, params_spec = tune._spmd_plain_step(pipe, x_spec, tgt_spec)
@@ -466,13 +539,6 @@ def _plan_spmd(
         )
         if params_spec is not None else None
     )
-    param_bytes = 0
-    if params_spec is not None:
-        param_bytes = tune.tree_bytes(stage_params_spec) + sum(
-            tune.tree_bytes(params_spec[k])
-            for k in ("pre", "post", "loss")
-            if k in params_spec
-        )
     block_in_spec = x_spec
     if pipe.pre is not None and params_spec is not None:
         try:
@@ -484,221 +550,343 @@ def _plan_spmd(
             block_in_spec = None
 
     sched_space = list(schedules or spmd_schedule_space(pipe))
-    lane_flops = (
-        model_flops / (dp * ep) if model_flops is not None else None
-    )
     # The dispatch-granularity axis: an all-indivisible megastep request
     # (K not dividing the hook cadence) yields the honest EMPTY frontier.
     mega_space = megastep_options(megastep_opts, steps)
+    dp_name = pipe.dp_axis or "dp"
+    tp_name = pipe.tp_axis or "tp"
+    # The block trace is width-independent; one cache serves every
+    # candidate width's layout verification.
+    layout_cache: Dict[str, Any] = {}
     plans: List[Plan] = []
-    for chunks in spmd_chunk_options(pipe, B, chunks_options):
-        mb_spec = (
-            jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(
-                    (a.shape[0] // (chunks * dp * ep),) + a.shape[1:],
-                    a.dtype,
-                ),
-                block_in_spec,
-            )
-            if block_in_spec is not None else None
+
+    def rejected(
+        dp: int, tp: int, reason: str, *,
+        schedule: str = "*", mode: str = "-", label: Optional[str] = None,
+        chunks: Optional[int] = None, zero: bool = False,
+    ) -> Plan:
+        return Plan(
+            engine="spmd", schedule=schedule, balance=None,
+            chunks=pipe.chunks if chunks is None else chunks,
+            checkpoint=mode, policy=label, virtual_stages=v,
+            predicted_mfu=None, bubble_fraction=None, hwm_bytes=0,
+            host_bytes=0, feasible=False, certified=False,
+            dp=dp, tp=tp, zero=zero, reason=reason,
         )
-        mb_bytes = tune.tree_bytes(mb_spec) if mb_spec is not None else 0
-        atom_cache: Dict[Any, Optional[Tuple[float, float]]] = {}
-        resid_cache: Dict[Any, Optional[int]] = {}
 
-        def atoms(variant: Any, plain: bool, key: Any) -> Optional[Tuple[float, float]]:
-            if key not in atom_cache:
-                atom_cache[key] = _spmd_cell_atoms(
-                    variant, stage_params_spec, mb_spec, plain=plain
-                )
-            return atom_cache[key]
+    for dp, tp in mesh_width_options(pipe, mesh_options):
+        n_chips = n * dp * tp * ep * sp
+        # A width > 1 on an axis the pipe never declared would append a
+        # PHANTOM mesh axis: no leaf shards over it, the replication
+        # check cannot see it (it keys on the declared axis names), and
+        # the per-chip compute division would certify fictitious
+        # speedup.  Reject the width outright.
+        if dp > 1 and pipe.dp_axis is None:
+            plans.append(rejected(
+                dp, tp,
+                f"dp={dp} needs the pipe to declare dp_axis (an "
+                "undeclared axis shards nothing — the width would "
+                "certify fictitious speedup)",
+            ))
+            continue
+        if tp > 1 and pipe.tp_axis is None:
+            plans.append(rejected(
+                dp, tp,
+                f"tp={tp} needs the pipe to declare tp_axis (an "
+                "undeclared axis shards nothing — the width would "
+                "certify fictitious speedup)",
+            ))
+            continue
+        # Cheap rejections BEFORE the (retraced) layout verification.
+        if B % (dp * ep) != 0:
+            plans.append(rejected(
+                dp, tp, f"batch {B} does not divide by dp*ep={dp * ep}"
+            ))
+            continue
+        # ---- sharding certification of the candidate layout (3D) ---- #
+        overrides = {dp_name: dp, tp_name: tp}
+        try:
+            layout = shd.verify_layout(
+                pipe, batch, params_spec=params_spec,
+                mesh_sizes=overrides, jaxpr_cache=layout_cache,
+            )
+        except Exception as e:  # noqa: BLE001 - stand down -> reject
+            plans.append(rejected(dp, tp, f"layout: {e}"))
+            continue
+        reason = _layout_reject_reason(layout)
+        if reason is not None:
+            plans.append(rejected(dp, tp, reason))
+            continue
+        param_bytes = layout.param_bytes_local
+        cell_comm_probe = layout.comm_bytes()
+        probe_rows = max(B // max(pipe.chunks, 1), 1)
+        grad_sync_lane = (
+            2.0 * (dp - 1) / dp * param_bytes if dp > 1 else 0.0
+        )
+        lane_flops = (
+            model_flops / (dp * ep * tp)
+            if model_flops is not None else None
+        )
+        zero_space = zero_options_for(zero_options, dp)
+        # The ZeRO update itself refuses dp < 2 / no dp_axis, fsdp
+        # (state already sharded beside the fsdp'd params) and layouts
+        # that shard a leaf over dp (the segment math needs
+        # dp-replicated params) — a frontier must never rank a plan its
+        # own engine would crash on; an explicit zero_options=[True]
+        # request gets an honest REJECT row instead.
+        zero_incompatible = (
+            dp < 2
+            or pipe.dp_axis is None
+            or pipe.fsdp
+            or any(
+                pipe.dp_axis in shd.spec_axes(s)
+                for _, s in shd.tree_leaf_paths(layout.specs)
+                if isinstance(s, _P)
+            )
+        )
+        if zero_incompatible:
+            zero_space = [z for z in zero_space if not z]
+            if not zero_space:
+                plans.append(rejected(
+                    dp, tp,
+                    "zero=True is incompatible here (needs dp >= 2 and "
+                    "dp-replicated params; fsdp/dp-sharded layouts "
+                    "already shard their state); drop it from "
+                    "zero_options",
+                ))
+                continue
 
-        def resid(variant: Any, plain: bool, key: Any) -> Optional[int]:
-            if key not in resid_cache:
-                resid_cache[key] = tune._spmd_cell_residual_bytes(
-                    variant, stage_params_spec, mb_spec, plain=plain
-                )
-            return resid_cache[key]
-
-        for schedule in sched_space:
-            for mode, label, policy in remat_space_for(pipe, schedule):
-                try:
-                    variant = dataclasses.replace(
-                        pipe, schedule=schedule, checkpoint=mode,
-                        remat_policy=policy, chunks=chunks,
-                    )
-                except Exception as e:  # noqa: BLE001 - invalid combo
-                    plans.append(Plan(
-                        engine="spmd", schedule=schedule, balance=None,
-                        chunks=chunks, checkpoint=mode, policy=label,
-                        virtual_stages=v, predicted_mfu=None,
-                        bubble_fraction=None, hwm_bytes=0, host_bytes=0,
-                        feasible=False, certified=False,
-                        reason=f"build: {e}",
-                    ))
-                    continue
-                stop = checkpoint_stop(mode, chunks, train=True)
-                try:
-                    g = _spmd_graph(schedule, n, chunks, stop, v)
-                except Exception as e:  # noqa: BLE001 - e.g. m % n != 0
-                    plans.append(Plan(
-                        engine="spmd", schedule=schedule, balance=None,
-                        chunks=chunks, checkpoint=mode, policy=label,
-                        virtual_stages=v, predicted_mfu=None,
-                        bubble_fraction=None, hwm_bytes=0, host_bytes=0,
-                        feasible=False, certified=False,
-                        reason=f"schedule: {e}",
-                    ))
-                    continue
-                remat = mode in ("always", "offload", "except_last")
-                plain_atoms = atoms(variant, True, "plain")
-                remat_atoms = (
-                    atoms(variant, False, ("remat", label))
-                    if remat else plain_atoms
-                )
-                resid_full = resid(variant, True, "plain")
-                resid_cell = (
-                    resid(variant, False, ("remat", label))
-                    if remat else resid_full
-                )
-                if (
-                    plain_atoms is None or remat_atoms is None
-                    or resid_full is None or resid_cell is None
-                ):
-                    plans.append(Plan(
-                        engine="spmd", schedule=schedule, balance=None,
-                        chunks=chunks, checkpoint=mode, policy=label,
-                        virtual_stages=v, predicted_mfu=None,
-                        bubble_fraction=None, hwm_bytes=0, host_bytes=0,
-                        feasible=False, certified=False,
-                        reason="cell probe failed",
-                    ))
-                    continue
-                fwd, bwd = plain_atoms
-                bwd_remat = remat_atoms[1]
-                # Offload: named points ride to host; the device keeps
-                # what a nothing-saveable remat would (tune's law).
-                host_cell = 0
-                if mode == "offload" and getattr(
-                    variant.remat_policy, "offload", False
-                ):
-                    nothing = dataclasses.replace(
-                        pipe, schedule=schedule, checkpoint="always",
-                        remat_policy=None, chunks=chunks,
-                    )
-                    device_cell = resid(nothing, False, ("remat", None))
-                    if device_cell is not None:
-                        host_cell = max(resid_cell - device_cell, 0)
-                        resid_cell = device_cell
-
-                def bytes_of(
-                    buf: ev.Buffer,
-                    _rf: int = resid_full,
-                    _rc: int = resid_cell,
-                    _mode: str = mode,
-                    _mb: int = mb_bytes,
-                ) -> int:
-                    if buf.kind == "resid":
-                        # Interleaved annotates every cell "resid".
-                        return _rc if _mode != "never" else _rf
-                    if buf.kind == "saved":
-                        return _rc
-                    if buf.kind == "out":
-                        return _mb
-                    return 0
-
-                cert, findings = _certify(g, bytes_of)
-                if cert is None:
-                    plans.append(Plan(
-                        engine="spmd", schedule=schedule, balance=None,
-                        chunks=chunks, checkpoint=mode, policy=label,
-                        virtual_stages=v, predicted_mfu=None,
-                        bubble_fraction=None, hwm_bytes=0, host_bytes=0,
-                        feasible=False, certified=False,
-                        reason=f"verifier: {findings[0].message[:80]}",
-                    ))
-                    continue
-                # Fixed per-lane residents beyond the schedule-managed
-                # buffers: params (× optimizer head-room), the stacked
-                # per-tick scan outputs (fill-drain's ys; the explicit-
-                # gradient schedules keep an O(n) ring instead), and the
-                # allocator/temp overhead allowance.
-                ticks = (
-                    chunks + n - 1 if schedule == "fill_drain" else n
-                )
-                # Send-ahead on the slot-buffer 1f1b schedule carries
-                # the permuted act/gact BESIDE the raw ones (two extra
-                # activation-sized pytrees per lane; fill_drain's
-                # send-ahead carry REPLACES the raw one — no growth).
-                send_ahead_carry = (
-                    2 * mb_bytes
-                    if schedule == "1f1b"
-                    and bool(getattr(pipe, "send_ahead", False))
-                    else 0
-                )
-                fixed = int(
-                    param_bytes * param_scale
-                    + ticks * mb_bytes
-                    + send_ahead_carry
-                    + overhead_bytes
-                )
-                hwm = cert.high_water + fixed
-                host_peak = max(
-                    (
-                        pl.get("saved", 0) + pl.get("resid", 0)
-                        for pl in cert.peak_live
+        for chunks in spmd_chunk_options(
+            pipe, B, chunks_options, dp=dp, ep=ep
+        ):
+            if B % (chunks * dp * ep) != 0:
+                continue
+            mb_spec = (
+                jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        (a.shape[0] // (chunks * dp * ep),) + a.shape[1:],
+                        a.dtype,
                     ),
-                    default=0,
-                ) * host_cell
-                feasible = hwm <= hbm_budget_bytes
-                # SPMD 'offload' remats EVERY cell (offload save policy):
-                # the replay is charged for all micro-batches even though
-                # the buffer annotation's stop is 0 (residuals stored,
-                # host-side).
-                cost_stop = chunks if mode == "offload" else stop
-                cost_of = _spmd_cost_fn(
-                    schedule, cost_stop, fwd, bwd, bwd_remat
+                    block_in_spec,
                 )
-                epilogue = 0.0
-                if lane_flops is not None:
-                    useful_cells = n * chunks * (fwd + bwd)
-                    epilogue = max(lane_flops - useful_cells, 0.0) / n
-                # One graph walk per base candidate; the megastep ×
-                # scan_unroll refinements are arithmetic over the same
-                # span (the graph/cert/atoms do not depend on K or the
-                # unroll factor — only the lane-time model does).
-                try:
-                    span, busy = ev.makespan(g, cost_of)
-                except ValueError:
-                    span = None
-                bubble = None
-                if span is not None and g.n_ranks * span > 0:
-                    bubble = max(
-                        0.0, 1.0 - sum(busy) / (g.n_ranks * span)
+                if block_in_spec is not None else None
+            )
+            mb_bytes = tune.tree_bytes(mb_spec) if mb_spec is not None else 0
+            mb_rows = B // (chunks * dp * ep)
+            cell_comm = cell_comm_probe * mb_rows / probe_rows
+            atom_cache: Dict[Any, Optional[Tuple[float, float]]] = {}
+            resid_cache: Dict[Any, Optional[int]] = {}
+
+            def atoms(variant: Any, plain: bool, key: Any) -> Optional[Tuple[float, float]]:
+                if key not in atom_cache:
+                    atom_cache[key] = _spmd_cell_atoms(
+                        variant, stage_params_spec, mb_spec, plain=plain
                     )
-                for K in mega_space:
-                    for u in scan_unroll_options(schedule):
-                        mfu = None
-                        if span is not None and model_flops is not None:
-                            disc = (
-                                tune.UNROLL_LANE_DISCOUNT
-                                if u is True else 1.0
-                            )
-                            lane = (
-                                span * disc + epilogue
-                                + tune.DISPATCH_OVERHEAD_FLOPS / K
-                            )
-                            if lane > 0:
-                                mfu = model_flops / (n_chips * lane)
-                        plans.append(Plan(
-                            engine="spmd", schedule=schedule, balance=None,
-                            chunks=chunks, checkpoint=mode, policy=label,
-                            virtual_stages=v, predicted_mfu=mfu,
-                            bubble_fraction=bubble, hwm_bytes=hwm,
-                            host_bytes=host_peak, feasible=feasible,
-                            certified=True, megastep=K, scan_unroll=u,
-                            reason="" if feasible else "over HBM budget",
+                return atom_cache[key]
+
+            def resid(variant: Any, plain: bool, key: Any) -> Optional[int]:
+                if key not in resid_cache:
+                    resid_cache[key] = tune._spmd_cell_residual_bytes(
+                        variant, stage_params_spec, mb_spec, plain=plain
+                    )
+                return resid_cache[key]
+
+            for schedule in sched_space:
+                for mode, label, policy in remat_space_for(pipe, schedule):
+                    try:
+                        variant = dataclasses.replace(
+                            pipe, schedule=schedule, checkpoint=mode,
+                            remat_policy=policy, chunks=chunks,
+                        )
+                    except Exception as e:  # noqa: BLE001 - invalid combo
+                        plans.append(rejected(
+                            dp, tp, f"build: {e}", schedule=schedule,
+                            mode=mode, label=label, chunks=chunks,
                         ))
+                        continue
+                    stop = checkpoint_stop(mode, chunks, train=True)
+                    try:
+                        g = _spmd_graph(schedule, n, chunks, stop, v)
+                    except Exception as e:  # noqa: BLE001 - e.g. m % n != 0
+                        plans.append(rejected(
+                            dp, tp, f"schedule: {e}", schedule=schedule,
+                            mode=mode, label=label, chunks=chunks,
+                        ))
+                        continue
+                    remat = mode in ("always", "offload", "except_last")
+                    plain_atoms = atoms(variant, True, "plain")
+                    remat_atoms = (
+                        atoms(variant, False, ("remat", label))
+                        if remat else plain_atoms
+                    )
+                    resid_full = resid(variant, True, "plain")
+                    resid_cell = (
+                        resid(variant, False, ("remat", label))
+                        if remat else resid_full
+                    )
+                    if (
+                        plain_atoms is None or remat_atoms is None
+                        or resid_full is None or resid_cell is None
+                    ):
+                        plans.append(rejected(
+                            dp, tp, "cell probe failed", schedule=schedule,
+                            mode=mode, label=label, chunks=chunks,
+                        ))
+                        continue
+                    # Per-CHIP cell atoms: tensor parallelism splits each
+                    # cell's matmuls over tp lanes.
+                    fwd, bwd = (a / tp for a in plain_atoms)
+                    bwd_remat = remat_atoms[1] / tp
+                    # Offload: named points ride to host; the device keeps
+                    # what a nothing-saveable remat would (tune's law).
+                    host_cell = 0
+                    if mode == "offload" and getattr(
+                        variant.remat_policy, "offload", False
+                    ):
+                        nothing = dataclasses.replace(
+                            pipe, schedule=schedule, checkpoint="always",
+                            remat_policy=None, chunks=chunks,
+                        )
+                        device_cell = resid(nothing, False, ("remat", None))
+                        if device_cell is not None:
+                            host_cell = max(resid_cell - device_cell, 0)
+                            resid_cell = device_cell
+
+                    def bytes_of(
+                        buf: ev.Buffer,
+                        _rf: int = resid_full,
+                        _rc: int = resid_cell,
+                        _mode: str = mode,
+                        _mb: int = mb_bytes,
+                    ) -> int:
+                        if buf.kind == "resid":
+                            # Interleaved annotates every cell "resid".
+                            return _rc if _mode != "never" else _rf
+                        if buf.kind == "saved":
+                            return _rc
+                        if buf.kind == "out":
+                            return _mb
+                        return 0
+
+                    cert, findings = _certify(g, bytes_of)
+                    if cert is None:
+                        plans.append(rejected(
+                            dp, tp,
+                            f"verifier: {findings[0].message[:80]}",
+                            schedule=schedule, mode=mode, label=label,
+                            chunks=chunks,
+                        ))
+                        continue
+                    # Fixed per-lane residents beyond the schedule-managed
+                    # buffers: params + optimizer state under the LAYOUT
+                    # (tp-sharded leaves store 1/tp per chip; ZeRO divides
+                    # the optimizer state by dp), the stacked per-tick
+                    # scan outputs (fill-drain's ys; the explicit-
+                    # gradient schedules keep an O(n) ring instead), and
+                    # the allocator/temp overhead allowance.
+                    ticks = (
+                        chunks + n - 1 if schedule == "fill_drain" else n
+                    )
+                    # Send-ahead on the slot-buffer 1f1b schedule carries
+                    # the permuted act/gact BESIDE the raw ones (two extra
+                    # activation-sized pytrees per lane; fill_drain's
+                    # send-ahead carry REPLACES the raw one — no growth).
+                    send_ahead_carry = (
+                        2 * mb_bytes
+                        if schedule == "1f1b"
+                        and bool(getattr(pipe, "send_ahead", False))
+                        else 0
+                    )
+                    host_peak = max(
+                        (
+                            pl.get("saved", 0) + pl.get("resid", 0)
+                            for pl in cert.peak_live
+                        ),
+                        default=0,
+                    ) * host_cell
+                    # SPMD 'offload' remats EVERY cell (offload save
+                    # policy): the replay is charged for all micro-
+                    # batches even though the buffer annotation's stop
+                    # is 0 (residuals stored, host-side).
+                    cost_stop = chunks if mode == "offload" else stop
+                    cost_of = _spmd_cost_fn(
+                        schedule, cost_stop, fwd, bwd, bwd_remat
+                    )
+                    epilogue = 0.0
+                    if lane_flops is not None:
+                        useful_cells = n * chunks * (fwd + bwd)
+                        epilogue = max(lane_flops - useful_cells, 0.0) / n
+                    # One graph walk per base candidate; the megastep ×
+                    # scan_unroll × zero refinements are arithmetic over
+                    # the same span (the graph/cert/atoms do not depend
+                    # on K, the unroll factor or the optimizer layout —
+                    # only the lane-time/memory models do).
+                    try:
+                        span, busy = ev.makespan(g, cost_of)
+                    except ValueError:
+                        span = None
+                    bubble = None
+                    if span is not None and g.n_ranks * span > 0:
+                        bubble = max(
+                            0.0, 1.0 - sum(busy) / (g.n_ranks * span)
+                        )
+                    lane_comm = chunks * cell_comm + grad_sync_lane
+                    comm_flops = shd.COMM_FLOPS_PER_BYTE * lane_comm
+                    # param_scale's head-room splits into the gradient
+                    # tree (~1x params, per-lane EITHER WAY — the ZeRO
+                    # update still consumes full grads) and the
+                    # optimizer moments (the rest) — ONLY the moments
+                    # shard over dp under zero=True.
+                    grad_share = param_bytes * min(
+                        max(param_scale - 1.0, 0.0), 1.0
+                    )
+                    moment_total = param_bytes * max(
+                        param_scale - 2.0, 0.0
+                    )
+                    for zero in zero_space:
+                        opt_bytes = int(
+                            moment_total / (dp if zero else 1)
+                        )
+                        fixed = int(
+                            param_bytes + grad_share + opt_bytes
+                            + ticks * mb_bytes
+                            + send_ahead_carry
+                            + overhead_bytes
+                        )
+                        hwm = cert.high_water + fixed
+                        feasible = hwm <= hbm_budget_bytes
+                        for K in mega_space:
+                            for u in scan_unroll_options(schedule):
+                                mfu = None
+                                if span is not None and model_flops is not None:
+                                    disc = (
+                                        tune.UNROLL_LANE_DISCOUNT
+                                        if u is True else 1.0
+                                    )
+                                    lane = (
+                                        span * disc + epilogue
+                                        + comm_flops
+                                        + tune.DISPATCH_OVERHEAD_FLOPS / K
+                                    )
+                                    if lane > 0:
+                                        mfu = model_flops / (n_chips * lane)
+                                plans.append(Plan(
+                                    engine="spmd", schedule=schedule,
+                                    balance=None,
+                                    chunks=chunks, checkpoint=mode,
+                                    policy=label,
+                                    virtual_stages=v, predicted_mfu=mfu,
+                                    bubble_fraction=bubble, hwm_bytes=hwm,
+                                    host_bytes=host_peak, feasible=feasible,
+                                    certified=True, megastep=K,
+                                    scan_unroll=u, dp=dp, tp=tp, zero=zero,
+                                    opt_state_bytes=opt_bytes,
+                                    comm_bytes=int(lane_comm),
+                                    reason=(
+                                        "" if feasible
+                                        else "over HBM budget"
+                                    ),
+                                ))
     return _ranked(plans, hbm_budget_bytes)
 
 
@@ -894,11 +1082,14 @@ def plan(
     balance_options: Optional[Sequence[Sequence[int]]] = None,
     megastep_options: Optional[Sequence[int]] = None,
     steps: Optional[int] = None,
+    mesh_options: Optional[Sequence[Sequence[int]]] = None,
+    zero_options: Optional[Sequence[bool]] = None,
     overhead_bytes: Optional[int] = None,
     param_scale: Optional[float] = None,
 ) -> PlanReport:
     """Search balance × schedule × chunks × remat × dispatch granularity
-    statically and return the certified frontier.
+    × (dp, tp) mesh width × ZeRO statically and return the certified
+    frontier.
 
     ``megastep_options`` / ``steps`` control the SPMD dispatch axis:
     megastep K candidates (default :data:`MEGASTEP_SPACE`) filtered to
@@ -907,14 +1098,28 @@ def plan(
     all-indivisible request yields an EMPTY frontier rather than a
     silently-adjusted one.
 
+    ``mesh_options`` (SPMD) opens the 3D axis: a list of ``(dp, tp)``
+    width pairs to search (default: the pipe's own widths only).  Every
+    width candidate is certified by the static sharding verifier
+    (:func:`torchgpipe_tpu.analysis.sharding.verify_layout`) — an
+    unmatched param leaf, a mesh-axis mismatch, an implicit reshard or
+    an unused declared axis REJECTS the width — and its collective
+    volume (required tp psums from the propagation + the dp gradient
+    all-reduce) is priced into the lane time at
+    :data:`~torchgpipe_tpu.analysis.sharding.COMM_FLOPS_PER_BYTE`.
+    ``zero_options`` controls the ZeRO optimizer-state axis (default:
+    both at dp > 1): ``zero=True`` candidates charge optimizer state
+    ÷ N_dp in the memory certification (``Plan.opt_state_bytes``).
+
     ``pipe`` is a :class:`~torchgpipe_tpu.spmd.SpmdGPipe` or
     :class:`~torchgpipe_tpu.gpipe.GPipe`; ``batch`` a representative
     batch (arrays or ``ShapeDtypeStruct`` — only shapes/dtypes are
     read).  No device is timed, nothing compiles for an accelerator:
     the whole search is traced jaxprs + ``eval_shape`` + pure-Python
-    event graphs.  Every emitted feasible plan passed the schedule
-    verifier's ordering rules and the memory-certification pass against
-    ``hbm_budget_bytes``.
+    event graphs (candidate meshes are abstract axis-size maps).  Every
+    emitted feasible plan passed the schedule verifier's ordering
+    rules, the sharding certification and the memory-certification
+    pass against ``hbm_budget_bytes``.
     """
     from torchgpipe_tpu import tune
     from torchgpipe_tpu.gpipe import GPipe
@@ -937,6 +1142,7 @@ def plan(
         pipe, batch, hbm_budget_bytes, target=target,
         schedules=schedules, chunks_options=chunks_options,
         megastep_opts=megastep_options, steps=steps,
+        mesh_options=mesh_options, zero_options=zero_options,
         overhead_bytes=overhead, param_scale=scale,
     )
 
@@ -961,6 +1167,16 @@ def apply_plan(pipe: Any, chosen: Plan) -> Any:
             ),
             hbm_budget_bytes=getattr(pipe, "hbm_budget_bytes", None),
         )
+    own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
+    own_tp = pipe.mesh.shape[pipe.tp_axis] if pipe.tp_axis else 1
+    if (chosen.dp, chosen.tp) != (own_dp, own_tp):
+        raise ValueError(
+            f"the chosen plan wants a dp×tp width of "
+            f"{chosen.dp}x{chosen.tp} but this pipe's mesh is "
+            f"{own_dp}x{own_tp}: apply_plan cannot resize a device "
+            "mesh — build one with make_mesh(n_stages, dp, tp=tp) and "
+            "construct the pipe on it, then apply the plan there"
+        )
     return dataclasses.replace(
         pipe,
         schedule=chosen.schedule,
@@ -969,21 +1185,38 @@ def apply_plan(pipe: Any, chosen: Plan) -> Any:
         chunks=chosen.chunks,
         megastep=chosen.megastep,
         scan_unroll=chosen.scan_unroll,
+        zero_update=chosen.zero,
     )
 
 
-def verify_plan(pipe: Any, chosen: Plan) -> List[Finding]:
+def verify_plan(
+    pipe: Any, chosen: Plan, batch: Optional[Pytree] = None
+) -> List[Finding]:
     """Re-run the event-graph verifier on a chosen plan: build the
     plan's engine, extract its event graph, and return the ordering +
     donation + equivalence findings (empty = the plan is certified by
-    the SAME rules ``analysis.lint`` enforces).  The ``plan-verify`` CI
-    step calls this on the top plan of each llama preset."""
+    the SAME rules ``analysis.lint`` enforces).  With ``batch`` given,
+    an SPMD plan's layout is ALSO re-verified by the static sharding
+    analysis at the plan's (dp, tp) widths — the ``sharding-verify`` CI
+    gate's shape.  The ``plan-verify`` CI step calls this on the top
+    plan of each llama preset."""
     applied = apply_plan(pipe, chosen)
     m = chosen.chunks
     g = ev.events_for(applied, chunks=m)
     findings = sched.verify_ordering(g)
     findings.extend(sched.verify_buffers(ev.with_update(g, donate=True)))
     findings.extend(sched.verify_equivalence(g))
+    if batch is not None and chosen.engine == "spmd":
+        from torchgpipe_tpu.analysis import sharding as shd
+
+        overrides = {
+            (pipe.dp_axis or "dp"): chosen.dp,
+            (pipe.tp_axis or "tp"): chosen.tp,
+        }
+        report = shd.verify_layout(
+            applied, batch, mesh_sizes=overrides
+        )
+        findings.extend(report.findings)
     return findings
 
 
@@ -1034,17 +1267,20 @@ def _unroll_key(u: Any) -> Any:
 
 def _config_of(pipe: Any) -> Tuple:
     """The (schedule, checkpoint, policy-label, chunks, balance,
-    megastep, scan_unroll-key) key a pipe actually runs — matched
-    against the planner's candidates."""
+    megastep, scan_unroll-key, dp, tp, zero) key a pipe actually runs —
+    matched against the planner's candidates."""
     from torchgpipe_tpu.gpipe import GPipe
 
     if isinstance(pipe, GPipe):
         return (pipe.schedule, pipe.checkpoint, None, pipe.chunks,
                 tuple(pipe.balance), getattr(pipe, "megastep", 1),
-                _unroll_key(1))
+                _unroll_key(1), 1, 1, False)
+    own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
+    own_tp = pipe.mesh.shape[pipe.tp_axis] if pipe.tp_axis else 1
     return (pipe.schedule, pipe.checkpoint, _spmd_policy_label(pipe),
             pipe.chunks, None, pipe.megastep,
-            _unroll_key(pipe.scan_unroll))
+            _unroll_key(pipe.scan_unroll), own_dp, own_tp,
+            bool(getattr(pipe, "zero_update", False)))
 
 
 def check_plan_drift(trace: Any) -> List[Finding]:
@@ -1104,7 +1340,8 @@ def check_plan_drift(trace: Any) -> List[Finding]:
         return measured
     def plan_key(p: Plan) -> Tuple:
         return (p.schedule, p.checkpoint, p.policy, p.chunks, p.balance,
-                p.megastep, _unroll_key(p.scan_unroll))
+                p.megastep, _unroll_key(p.scan_unroll), p.dp, p.tp,
+                p.zero)
 
     actual_key = _config_of(trace.pipe)
     actual = next(

@@ -673,6 +673,24 @@ def _register_planner_rules() -> None:
 _register_planner_rules()
 
 
+def _register_sharding_rules() -> None:
+    """The sharding-layout rule family (analysis.sharding) — same
+    single-registry treatment as the schedule and planner families."""
+    from torchgpipe_tpu.analysis import sharding as shd
+
+    RULES.append(Rule(
+        "implicit-reshard",
+        "every param leaf must resolve through the partition-rule table "
+        "(unmatched leaf = silent replication: ERROR), resolved specs "
+        "must name existing mesh axes, and the propagated layout must "
+        "induce no resharding collective inside the step (WARNING)",
+        shd.check_implicit_reshard,
+    ))
+
+
+_register_sharding_rules()
+
+
 def _check_dispatch_only_timeline(trace: PipelineTrace) -> List[Finding]:
     # Imported at CALL time: obs.reconciliation itself imports the analysis
     # package (for the event-graph cost model), so binding it at module

@@ -1,0 +1,1131 @@
+"""Static 3D-layout verifier + communication-cost model.
+
+Extends the certified-static-analysis family (schedule verifier PR 4,
+joint planner PR 6) to the SHARDING axis: given a pipe's declared
+layout — resolved through the unified partition-rule layer
+(:mod:`torchgpipe_tpu.analysis.partition_rules`) — this module verifies,
+with ZERO device probes, that a dp × tp × pp layout is coherent:
+
+* **rule coverage** — every param leaf resolves through the rule table;
+  an unmatched leaf is an ERROR (silent replication is the failure mode
+  the rule layer exists to kill);
+* **mesh validity** — every axis a resolved spec mentions exists on the
+  (candidate) mesh, and every sharded dim divides by its axis size;
+* **no accidental full replication** — a declared tp/ep axis of size > 1
+  that NO resolved spec uses is a WARNING: the user asked for sharding
+  and got silent replication;
+* **propagation** — an abstract interpretation over the block's traced
+  jaxpr (GSPMD-style whole-program layout reasoning, the family
+  arXiv:2004.13336 builds on) that pushes the per-leaf shardings through
+  ops, detecting *implicit reshards* (an elementwise op over operands
+  sharded differently on one dim, a reshape that destroys a sharded dim,
+  a mismatched contraction) and collecting the *required* collectives
+  (a contraction over a same-axis-sharded dim needs a ``psum`` — the
+  Megatron row-parallel shape) with their priced volume
+  (:func:`torchgpipe_tpu.analysis.jaxpr.comm_bytes_estimate`'s per-op
+  model);
+* **memory** — the per-device bytes of a tree under the layout
+  (:func:`layout_bytes`), feeding the planner's memory certification
+  and the ZeRO optimizer-state accounting (state ÷ N_dp).
+
+The propagation is deliberately conservative: primitives it does not
+model leave their outputs replicated and are recorded as ``opaque``
+events, never as findings — the verifier errs toward silence, the
+priced comm model toward under-counting (documented; the planner's
+ranking only needs relative order).  Programs that contain axis-name
+collectives outside any mesh binding (tp-explicit blocks traced
+globally) cannot be traced abstractly; :func:`verify_layout` then
+stands down from propagation and reports the structural checks only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from torchgpipe_tpu.analysis import jaxpr as jx
+from torchgpipe_tpu.analysis.diagnostics import Finding, Severity
+from torchgpipe_tpu.analysis.partition_rules import (
+    RuleTable,
+    tree_leaf_paths,
+)
+
+Pytree = Any
+
+# FLOP-equivalents charged per byte of collective traffic when the
+# planner folds comm volume into a candidate's lane time.  A RANKING
+# device (the OFFLOAD_RANK_TAX / DISPATCH_OVERHEAD_FLOPS precedent),
+# not a hardware claim: ~peak-bf16-FLOPs / ICI-bandwidth for a current
+# TPU generation, biased low so comm never dominates a ranking unless
+# the volume is genuinely large.
+COMM_FLOPS_PER_BYTE = 1000.0
+
+
+# --------------------------------------------------------------------- #
+# mesh + layout byte accounting                                         #
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A mesh as the static analyses see it: ordered (axis, size) pairs.
+
+    Candidate meshes for the 3D planner are plain ``with_sizes``
+    overrides — no devices are touched, which is what lets the planner
+    search widths the host doesn't have."""
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def from_mesh(cls, mesh: Any) -> "MeshSpec":
+        return cls(axes=tuple(
+            (str(name), int(mesh.shape[name])) for name in mesh.axis_names
+        ))
+
+    @classmethod
+    def from_sizes(cls, sizes: Mapping[str, int]) -> "MeshSpec":
+        return cls(axes=tuple((str(k), int(v)) for k, v in sizes.items()))
+
+    @property
+    def sizes(self) -> Dict[str, int]:
+        return dict(self.axes)
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    def size(self, name: Optional[str], default: int = 1) -> int:
+        if name is None:
+            return default
+        return dict(self.axes).get(name, default)
+
+    def with_sizes(self, **overrides: int) -> "MeshSpec":
+        """A candidate mesh: existing axes resized, new axes appended."""
+        known = dict(self.axes)
+        known.update({k: int(v) for k, v in overrides.items()})
+        order = list(self.names) + [
+            k for k in overrides if k not in dict(self.axes)
+        ]
+        return MeshSpec(axes=tuple((k, known[k]) for k in order))
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for _, s in self.axes:
+            n *= s
+        return n
+
+
+def spec_axes(spec: P) -> Tuple[str, ...]:
+    """Every mesh-axis name a PartitionSpec mentions, flattened."""
+    out: List[str] = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            out.append(str(a))
+    return tuple(out)
+
+
+def _leaf_bytes(leaf: Any) -> int:
+    shape = getattr(leaf, "shape", ())
+    dtype = getattr(leaf, "dtype", None)
+    if dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    import jax.numpy as jnp
+
+    return n * jnp.dtype(dtype).itemsize
+
+
+def leaf_layout_bytes(leaf: Any, spec: P, mesh: MeshSpec) -> int:
+    """Per-device bytes of one leaf under ``spec`` on ``mesh``: full
+    bytes divided by the product of its sharding axes' sizes."""
+    total = _leaf_bytes(leaf)
+    denom = 1
+    for a in spec_axes(spec):
+        denom *= mesh.size(a)
+    return total // max(denom, 1)
+
+
+def layout_bytes(tree: Pytree, specs: Pytree, mesh: MeshSpec) -> int:
+    """Per-device bytes of a whole tree under a resolved per-leaf layout
+    — the memory model the 3D planner's certification and the ZeRO
+    optimizer-state accounting share."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    return sum(
+        leaf_layout_bytes(leaf, spec, mesh)
+        for leaf, spec in zip(leaves, spec_leaves)
+    )
+
+
+# --------------------------------------------------------------------- #
+# sharding propagation (abstract interpretation over a jaxpr)           #
+# --------------------------------------------------------------------- #
+
+# A var's sharding: one tuple of mesh-axis names per dim (() = that dim
+# is replicated).  The normalized form of a PartitionSpec.
+DimSharding = Tuple[Tuple[str, ...], ...]
+
+
+def _norm(spec: Optional[P], ndim: int) -> DimSharding:
+    entries: List[Tuple[str, ...]] = []
+    for e in tuple(spec or ()):
+        if e is None:
+            entries.append(())
+        elif isinstance(e, tuple):
+            entries.append(tuple(str(a) for a in e))
+        else:
+            entries.append((str(e),))
+    while len(entries) < ndim:
+        entries.append(())
+    return tuple(entries[:ndim])
+
+
+def _replicated(ndim: int) -> DimSharding:
+    return ((),) * ndim
+
+
+@dataclasses.dataclass(frozen=True)
+class CommEvent:
+    """One communication requirement or hazard the propagation found.
+
+    Kinds: ``psum`` (a contraction/reduction over a same-axis-sharded
+    dim — required, legitimate TP math, priced), ``reshard`` (a
+    LAYOUT-INDUCED gather: operands sharded incompatibly, a sharded dim
+    destroyed by reshape/slice — the ``implicit-reshard`` hazard),
+    ``collective`` (an explicit collective in the program, priced),
+    ``opaque`` (an unmodeled primitive consumed sharded inputs; the
+    analysis dropped to replicated conservatively, unpriced)."""
+
+    kind: str
+    axes: Tuple[str, ...]
+    bytes: int
+    eqn_index: int
+    primitive: str
+    path: str
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class PropagationResult:
+    """What the abstract interpretation learned about one program."""
+
+    findings: List[Finding]
+    comm: List[CommEvent]
+    out_shardings: List[DimSharding]
+
+    def reshards(self) -> List[CommEvent]:
+        return [e for e in self.comm if e.kind == "reshard"]
+
+    def comm_bytes(self, mesh: MeshSpec) -> float:
+        """Priced volume of the required/explicit collectives, through
+        the SAME per-primitive table as
+        :func:`analysis.jaxpr.eqn_comm_bytes`
+        (:func:`analysis.jaxpr.collective_comm_bytes` — one pricing
+        model, never two), re-evaluable under any candidate mesh
+        widths.  Required ``psum`` events (contractions over sharded
+        dims) price as the reducing family; ``reshard`` hazards as a
+        one-sided gather."""
+        total = 0.0
+        for e in self.comm:
+            if e.kind == "opaque":
+                continue
+            n = 1
+            for a in e.axes:
+                n *= mesh.size(a)
+            name = "psum" if e.kind == "psum" else e.primitive
+            if e.kind == "reshard":
+                name = "all_to_all"  # one-sided redistribute: frac x bytes
+            total += jx.collective_comm_bytes(name, n, e.bytes)
+        return total
+
+
+_ELEMENTWISE_SAFE = frozenset((
+    "add", "sub", "mul", "div", "rem", "max", "min", "pow", "atan2",
+    "and", "or", "xor", "not", "neg", "sign", "floor", "ceil", "round",
+    "exp", "log", "log1p", "expm1", "tanh", "sin", "cos", "logistic",
+    "sqrt", "rsqrt", "cbrt", "abs", "erf", "erf_inv", "erfc",
+    "integer_pow", "is_finite", "eq", "ne", "lt", "le", "gt", "ge",
+    "select_n", "clamp", "convert_element_type", "stop_gradient",
+    "copy", "real", "imag", "nextafter", "square", "tan", "asin",
+    "acos", "atan", "sinh", "cosh", "asinh", "acosh", "atanh",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+))
+
+_REDUCE_PRIMS = frozenset((
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "argmax", "argmin",
+))
+
+
+def _out_bytes(eqn: Any) -> int:
+    return sum(jx.aval_bytes(v) for v in eqn.outvars)
+
+
+def _in_bytes(eqn: Any) -> int:
+    return sum(jx.aval_bytes(v) for v in eqn.invars)
+
+
+class _Propagator:
+    def __init__(self, mesh: MeshSpec, path: str) -> None:
+        self.mesh = mesh
+        self.path = path
+        self.findings: List[Finding] = []
+        self.comm: List[CommEvent] = []
+
+    # -- bookkeeping -------------------------------------------------- #
+
+    def _event(
+        self, kind: str, axes: Sequence[str], nbytes: int, site: Any,
+        detail: str = "",
+    ) -> None:
+        self.comm.append(CommEvent(
+            kind=kind, axes=tuple(axes), bytes=int(nbytes),
+            eqn_index=site[0], primitive=site[1], path=self.path,
+            detail=detail,
+        ))
+
+    def _reshard_finding(self, site: Any, detail: str) -> None:
+        self.findings.append(Finding(
+            rule="implicit-reshard",
+            severity=Severity.WARNING,
+            path=self.path,
+            eqn=site[0],
+            primitive=site[1],
+            message=(
+                f"layout-induced resharding: {detail} — the compiler "
+                "must gather/redistribute here every step; align the "
+                "operand shardings (or reshard explicitly where you "
+                "choose, outside the hot loop)"
+            ),
+        ))
+
+    # -- env helpers -------------------------------------------------- #
+
+    @staticmethod
+    def _ndim(v: Any) -> int:
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", ())
+        return len(shape)
+
+    @staticmethod
+    def _shape(v: Any) -> Tuple[int, ...]:
+        aval = getattr(v, "aval", None)
+        return tuple(int(d) for d in getattr(aval, "shape", ()))
+
+    def read(self, env: Dict[Any, DimSharding], v: Any) -> DimSharding:
+        if type(v).__name__ == "Literal":
+            return _replicated(self._ndim(v))
+        return env.get(v, _replicated(self._ndim(v)))
+
+    # -- the interpreter ---------------------------------------------- #
+
+    def run(
+        self, jaxpr: Any, in_shardings: Sequence[DimSharding]
+    ) -> List[DimSharding]:
+        body = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+        env: Dict[Any, DimSharding] = {}
+        for var, sh in zip(body.invars, in_shardings):
+            env[var] = tuple(sh)[: self._ndim(var)] or _replicated(
+                self._ndim(var)
+            )
+        for var in getattr(body, "constvars", ()):
+            env[var] = _replicated(self._ndim(var))
+        for i, eqn in enumerate(body.eqns):
+            outs = self._eqn(env, eqn, i)
+            for ov, sh in zip(eqn.outvars, outs):
+                env[ov] = sh
+        return [self.read(env, v) for v in body.outvars]
+
+    def _eqn(
+        self, env: Dict[Any, DimSharding], eqn: Any, i: int
+    ) -> List[DimSharding]:
+        name = eqn.primitive.name
+        site = (i, name)
+        ins = [self.read(env, v) for v in eqn.invars]
+        subs = jx.subjaxprs(eqn)
+
+        if name in jx.COLLECTIVE_PRIMS:
+            return self._collective(eqn, ins, site)
+        if name == "dot_general":
+            return self._dot_general(eqn, ins, site)
+        if name == "transpose":
+            perm = eqn.params["permutation"]
+            return [tuple(ins[0][p] for p in perm)]
+        if name == "broadcast_in_dim":
+            return self._broadcast_in_dim(eqn, ins)
+        if name == "squeeze":
+            dims = set(eqn.params["dimensions"])
+            return [tuple(
+                e for d, e in enumerate(ins[0]) if d not in dims
+            )]
+        if name == "expand_dims":
+            dims = set(eqn.params["dimensions"])
+            out: List[Tuple[str, ...]] = []
+            it = iter(ins[0])
+            for d in range(self._ndim(eqn.outvars[0])):
+                out.append(() if d in dims else next(it, ()))
+            return [tuple(out)]
+        if name == "reshape":
+            return self._reshape(eqn, ins, site)
+        if name in _REDUCE_PRIMS:
+            return self._reduce(eqn, ins, site)
+        if name in ("slice", "dynamic_slice", "gather", "dynamic_update_slice"):
+            return self._slice_like(eqn, ins, site)
+        if name == "concatenate":
+            return self._concatenate(eqn, ins, site)
+        if name in ("remat2", "remat", "checkpoint", "pjit", "closed_call",
+                    "custom_vjp_call", "custom_vjp_call_jaxpr",
+                    "custom_jvp_call", "custom_jvp_call_jaxpr") and subs:
+            sub = subs[0]
+            n_in = len(sub.invars)
+            if n_in <= len(ins):
+                inner = _Propagator(self.mesh, self.path)
+                outs = inner.run(sub, ins[len(ins) - n_in:])
+                self.findings.extend(inner.findings)
+                self.comm.extend(inner.comm)
+                if len(outs) >= len(eqn.outvars):
+                    return outs[: len(eqn.outvars)]
+            return self._opaque(eqn, ins, site)
+        if name in _ELEMENTWISE_SAFE or self._looks_elementwise(eqn):
+            return self._elementwise(eqn, ins, site)
+        return self._opaque(eqn, ins, site)
+
+    # -- handlers ------------------------------------------------------ #
+
+    def _looks_elementwise(self, eqn: Any) -> bool:
+        if len(eqn.outvars) != 1:
+            return False
+        out_shape = self._shape(eqn.outvars[0])
+        shapes = [self._shape(v) for v in eqn.invars]
+        return bool(shapes) and all(
+            s == out_shape or s == () for s in shapes
+        )
+
+    def _elementwise(
+        self, eqn: Any, ins: List[DimSharding], site: Any
+    ) -> List[DimSharding]:
+        out_shape = self._shape(eqn.outvars[0])
+        nd = len(out_shape)
+        merged: List[Tuple[str, ...]] = []
+        for d in range(nd):
+            entries = set()
+            for v, sh in zip(eqn.invars, ins):
+                vshape = self._shape(v)
+                off = nd - len(vshape)
+                if d - off < 0:
+                    continue
+                if vshape[d - off] != out_shape[d]:
+                    continue  # broadcasting dim — sliced for free
+                e = sh[d - off]
+                if e:
+                    entries.add(e)
+            if len(entries) > 1:
+                self._event(
+                    "reshard", sorted({a for e in entries for a in e}),
+                    _out_bytes(eqn), site,
+                    detail=f"dim {d} sharded {sorted(entries)} across "
+                    "operands",
+                )
+                self._reshard_finding(
+                    site,
+                    f"{eqn.primitive.name} combines operands sharded "
+                    f"differently on dim {d} ({sorted(entries)})",
+                )
+                merged.append(())
+            else:
+                merged.append(next(iter(entries)) if entries else ())
+        return [tuple(merged)] * len(eqn.outvars)
+
+    def _broadcast_in_dim(
+        self, eqn: Any, ins: List[DimSharding]
+    ) -> List[DimSharding]:
+        bd = eqn.params["broadcast_dimensions"]
+        in_shape = self._shape(eqn.invars[0])
+        out_shape = self._shape(eqn.outvars[0])
+        out = [()] * len(out_shape)
+        for i_dim, o_dim in enumerate(bd):
+            if in_shape[i_dim] == out_shape[o_dim]:
+                out[o_dim] = ins[0][i_dim]
+        return [tuple(out)]
+
+    def _reshape(
+        self, eqn: Any, ins: List[DimSharding], site: Any
+    ) -> List[DimSharding]:
+        in_shape = self._shape(eqn.invars[0])
+        out_shape = self._shape(eqn.outvars[0])
+        sh = ins[0]
+        if all(e == () for e in sh):
+            return [_replicated(len(out_shape))]
+        # A sharded input dim survives iff an output dim starts at the
+        # same flattened offset with a size that KEEPS the shard
+        # boundary: equal size, a merge whose leading factor is the
+        # sharded dim ([a, b] -> [a*b] with a sharded), or a split whose
+        # leading factor still divides by the shard count
+        # ([h*hd] -> [h, hd] with h % n_shards == 0 — the attention
+        # head split).
+        def prefix(shape: Sequence[int]) -> List[int]:
+            out, p = [], 1
+            for d in shape:
+                out.append(p)
+                p *= int(d)
+            return out
+
+        pin, pout = prefix(in_shape), prefix(out_shape)
+        out = [()] * len(out_shape)
+        ok = True
+        for d, e in enumerate(sh):
+            if not e:
+                continue
+            n_shards = 1
+            for a in e:
+                n_shards *= self.mesh.size(a)
+            placed = False
+            for od, osz in enumerate(out_shape):
+                if pout[od] != pin[d]:
+                    continue
+                merge_ok = osz >= in_shape[d] and osz % in_shape[d] == 0
+                split_ok = (
+                    osz < in_shape[d]
+                    and in_shape[d] % osz == 0
+                    and osz % max(n_shards, 1) == 0
+                )
+                if osz == in_shape[d] or merge_ok or split_ok:
+                    out[od] = e
+                    placed = True
+                    break
+            if not placed:
+                ok = False
+        if not ok:
+            self._event(
+                "reshard", sorted({a for e in sh for a in e}),
+                _in_bytes(eqn), site,
+                detail="reshape destroys a sharded dim",
+            )
+            self._reshard_finding(
+                site,
+                f"reshape {in_shape} -> {out_shape} splits/merges a "
+                "sharded dim across the shard boundary",
+            )
+            return [_replicated(len(out_shape))]
+        return [tuple(out)]
+
+    def _reduce(
+        self, eqn: Any, ins: List[DimSharding], site: Any
+    ) -> List[DimSharding]:
+        axes = set(eqn.params.get("axes", ()))
+        reduced_axes: List[str] = []
+        for d in axes:
+            if d < len(ins[0]) and ins[0][d]:
+                reduced_axes.extend(ins[0][d])
+        if reduced_axes and eqn.primitive.name == "reduce_sum":
+            self._event(
+                "psum", sorted(set(reduced_axes)), _out_bytes(eqn), site,
+                detail="sum over a sharded dim needs a cross-lane psum",
+            )
+        elif reduced_axes:
+            self._event(
+                "reshard", sorted(set(reduced_axes)), _in_bytes(eqn), site,
+                detail=f"{eqn.primitive.name} over a sharded dim",
+            )
+            self._reshard_finding(
+                site,
+                f"{eqn.primitive.name} reduces over a dim sharded on "
+                f"{sorted(set(reduced_axes))} (no cheap collective form)",
+            )
+        out = tuple(
+            e for d, e in enumerate(ins[0]) if d not in axes
+        )
+        return [out] * len(eqn.outvars)
+
+    def _slice_like(
+        self, eqn: Any, ins: List[DimSharding], site: Any
+    ) -> List[DimSharding]:
+        in_shape = self._shape(eqn.invars[0])
+        out_shape = self._shape(eqn.outvars[0])
+        sh = ins[0]
+        out: List[Tuple[str, ...]] = []
+        nd = min(len(in_shape), len(out_shape))
+        for d in range(len(out_shape)):
+            if d < nd and d < len(sh) and sh[d]:
+                if out_shape[d] == in_shape[d]:
+                    out.append(sh[d])
+                    continue
+                self._event(
+                    "reshard", sh[d], _in_bytes(eqn), site,
+                    detail=f"{eqn.primitive.name} cuts a sharded dim",
+                )
+                self._reshard_finding(
+                    site,
+                    f"{eqn.primitive.name} slices dim {d}, which is "
+                    f"sharded on {list(sh[d])}",
+                )
+            out.append(())
+        return [tuple(out[: len(out_shape)])] * len(eqn.outvars)
+
+    def _concatenate(
+        self, eqn: Any, ins: List[DimSharding], site: Any
+    ) -> List[DimSharding]:
+        first = ins[0]
+        if all(sh == first for sh in ins):
+            cat = eqn.params.get("dimension", 0)
+            out = list(first)
+            if cat < len(out) and out[cat]:
+                self._event(
+                    "reshard", out[cat], _out_bytes(eqn), site,
+                    detail="concatenate along a sharded dim",
+                )
+                self._reshard_finding(
+                    site,
+                    f"concatenate along dim {cat}, which is sharded on "
+                    f"{list(out[cat])}",
+                )
+                out[cat] = ()
+            return [tuple(out)]
+        self._event(
+            "reshard",
+            sorted({a for sh in ins for e in sh for a in e}),
+            _out_bytes(eqn), site, detail="concatenate of mixed layouts",
+        )
+        return [_replicated(self._ndim(eqn.outvars[0]))]
+
+    def _dot_general(
+        self, eqn: Any, ins: List[DimSharding], site: Any
+    ) -> List[DimSharding]:
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lsh, rsh = ins[0], ins[1]
+        out_b = _out_bytes(eqn)
+        # Contracted dims: same axis both sides -> required psum;
+        # one-sided/mismatched sharding -> implicit reshard (gather).
+        psum_axes: List[str] = []
+        for ld, rd in zip(lc, rc):
+            le = lsh[ld] if ld < len(lsh) else ()
+            re_ = rsh[rd] if rd < len(rsh) else ()
+            if le == re_ and le:
+                psum_axes.extend(le)
+            elif le or re_:
+                axes = sorted(set(le) | set(re_))
+                self._event(
+                    "reshard", axes, _in_bytes(eqn), site,
+                    detail="mismatched contraction sharding",
+                )
+                self._reshard_finding(
+                    site,
+                    "dot_general contracts a dim sharded "
+                    f"{list(le) or '-'} (lhs) vs {list(re_) or '-'} "
+                    "(rhs); one operand must gather",
+                )
+        if psum_axes:
+            self._event(
+                "psum", sorted(set(psum_axes)), out_b, site,
+                detail="contraction over a same-axis-sharded dim "
+                "(row-parallel partial sums)",
+            )
+        used = set(psum_axes)
+        out: List[Tuple[str, ...]] = []
+        for ld, rd in zip(lb, rb):
+            le = lsh[ld] if ld < len(lsh) else ()
+            out.append(le)
+            used.update(le)
+        for d in range(len(lsh)):
+            if d in lc or d in lb:
+                continue
+            entry = tuple(a for a in lsh[d] if a not in used)
+            out.append(entry)
+            used.update(entry)
+        for d in range(len(rsh)):
+            if d in rc or d in rb:
+                continue
+            entry = tuple(a for a in rsh[d] if a not in used)
+            out.append(entry)
+            used.update(entry)
+        nd = self._ndim(eqn.outvars[0])
+        while len(out) < nd:
+            out.append(())
+        return [tuple(out[:nd])]
+
+    def _collective(
+        self, eqn: Any, ins: List[DimSharding], site: Any
+    ) -> List[DimSharding]:
+        name = eqn.primitive.name
+        axes = jx.collective_axes(eqn)
+        unknown = [a for a in axes if a not in self.mesh.names]
+        if unknown:
+            self.findings.append(Finding(
+                rule="implicit-reshard",
+                severity=Severity.ERROR,
+                path=self.path,
+                eqn=site[0],
+                primitive=name,
+                message=(
+                    f"{name} over mesh axis {unknown} which does not "
+                    f"exist on the declared mesh (axes "
+                    f"{list(self.mesh.names)})"
+                ),
+            ))
+        self._event("collective", axes, _in_bytes(eqn), site)
+
+        def per_output(map_one: Any) -> List[DimSharding]:
+            """Each output shaded from its OWN operand (collectives are
+            variadic: psum((a, b), axis) is one eqn with paired
+            invars/outvars); outputs past the operand list — or whose
+            operand's rank doesn't match — fall back to replicated."""
+            outs: List[DimSharding] = []
+            for i, ov in enumerate(eqn.outvars):
+                nd = self._ndim(ov)
+                if i < len(ins) and len(ins[i]) == nd:
+                    outs.append(map_one(ins[i]))
+                else:
+                    outs.append(_replicated(nd))
+            return outs
+
+        if name in jx.REDUCING_COLLECTIVE_PRIMS:
+            return per_output(lambda sh: tuple(
+                tuple(a for a in e if a not in axes) for e in sh
+            ))
+        if name == "all_gather":
+            dim = int(eqn.params.get("all_gather_dimension", 0))
+
+            def gathered(sh: DimSharding) -> DimSharding:
+                out = list(sh)
+                if dim < len(out):
+                    out[dim] = tuple(a for a in out[dim] if a not in axes)
+                return tuple(out)
+
+            return per_output(gathered)
+        return per_output(lambda sh: tuple(sh))
+
+    def _opaque(
+        self, eqn: Any, ins: List[DimSharding], site: Any
+    ) -> List[DimSharding]:
+        if any(any(e for e in sh) for sh in ins):
+            self._event(
+                "opaque",
+                sorted({a for sh in ins for e in sh for a in e}),
+                _in_bytes(eqn), site,
+                detail=f"unmodeled primitive {eqn.primitive.name}",
+            )
+        return [
+            _replicated(self._ndim(v)) for v in eqn.outvars
+        ]
+
+
+def propagate_shardings(
+    jaxpr: Any,
+    in_shardings: Sequence[Any],
+    mesh: MeshSpec,
+    *,
+    path: str = "block",
+) -> PropagationResult:
+    """Abstract-interpret ``jaxpr`` (a ClosedJaxpr) pushing the given
+    input shardings (PartitionSpecs or normalized dim tuples) through
+    every equation.  Returns findings (implicit reshards, unknown mesh
+    axes), the priced comm events, and the output shardings."""
+    body = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    prop = _Propagator(mesh, path)
+    norm: List[DimSharding] = []
+    for var, sh in zip(body.invars, in_shardings):
+        nd = prop._ndim(var)
+        if isinstance(sh, P) or sh is None:
+            norm.append(_norm(sh, nd))
+        else:
+            norm.append(tuple(sh))
+    outs = prop.run(jaxpr, norm)
+    return PropagationResult(
+        findings=prop.findings, comm=prop.comm, out_shardings=outs
+    )
+
+
+# --------------------------------------------------------------------- #
+# the layout verifier                                                   #
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class LayoutReport:
+    """One layout, verified: rule coverage, mesh validity, propagation
+    hazards, per-device bytes, priced comm volume."""
+
+    mesh: MeshSpec
+    table: RuleTable
+    specs: Pytree
+    unmatched: List[str]
+    findings: List[Finding]
+    comm: List[CommEvent]
+    param_bytes_local: int
+    propagated: bool  # False when the block could not trace abstractly
+    notes: List[str] = dataclasses.field(default_factory=list)
+    # Declared tp/ep axes of size > 1 that NO param leaf shards over
+    # (accidental full replication) — structured, so callers (the 3D
+    # planner's width rejection) never key off finding prose.
+    unused_axes: List[str] = dataclasses.field(default_factory=list)
+
+    def ok(self) -> bool:
+        return not any(f.severity >= Severity.ERROR for f in self.findings)
+
+    def reshards(self) -> List[CommEvent]:
+        return [e for e in self.comm if e.kind == "reshard"]
+
+    def comm_bytes(self) -> float:
+        return PropagationResult(
+            findings=[], comm=self.comm, out_shardings=[]
+        ).comm_bytes(self.mesh)
+
+
+def _coverage_findings(
+    table: RuleTable,
+    unmatched: Sequence[str],
+    specs: Pytree,
+    params: Pytree,
+    mesh: MeshSpec,
+    *,
+    path: str,
+) -> List[Finding]:
+    out: List[Finding] = []
+    for leaf_path in unmatched:
+        out.append(Finding(
+            rule="implicit-reshard",
+            severity=Severity.ERROR,
+            path=f"{path}/{leaf_path}",
+            message=(
+                f"param leaf {leaf_path!r} matches NO rule in the "
+                f"partition table {table.name or '<anonymous>'!r} and "
+                "would silently replicate on every device; add a rule "
+                "(make replication explicit with a final ('.*', P()))"
+            ),
+        ))
+    known = set(mesh.names)
+    spec_pairs = tree_leaf_paths(specs)  # PartitionSpec IS a pytree leaf
+    leaf_pairs = dict(tree_leaf_paths(params))
+    for leaf_path, spec in spec_pairs:
+        if not isinstance(spec, P):
+            continue
+        missing = [a for a in spec_axes(spec) if a not in known]
+        if missing:
+            out.append(Finding(
+                rule="implicit-reshard",
+                severity=Severity.ERROR,
+                path=f"{path}/{leaf_path}",
+                message=(
+                    f"resolved spec {spec} mentions mesh axis "
+                    f"{missing} which the mesh (axes "
+                    f"{list(mesh.names)}) does not have"
+                ),
+            ))
+            continue
+        leaf = leaf_pairs.get(leaf_path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(tuple(spec)) > len(shape):
+            out.append(Finding(
+                rule="implicit-reshard",
+                severity=Severity.ERROR,
+                path=f"{path}/{leaf_path}",
+                message=(
+                    f"resolved spec {spec} names {len(tuple(spec))} "
+                    f"dims but the leaf has shape {shape} — a rule's "
+                    "spec must rank-match every leaf its pattern "
+                    "catches (split the rule, or order a narrower one "
+                    "first)"
+                ),
+            ))
+            continue
+        for d, entry in enumerate(tuple(spec)):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            size = 1
+            for a in axes:
+                size *= mesh.size(str(a))
+            if size > 1 and shape[d] % size != 0:
+                out.append(Finding(
+                    rule="implicit-reshard",
+                    severity=Severity.ERROR,
+                    path=f"{path}/{leaf_path}",
+                    message=(
+                        f"dim {d} of shape {shape} is sharded over "
+                        f"{list(axes)} (size {size}) but does not "
+                        "divide by it"
+                    ),
+                ))
+    return out
+
+
+def _replication_findings(
+    pipe: Any, specs: Pytree, mesh: MeshSpec, *, path: str
+) -> Tuple[List[Finding], List[str]]:
+    """A declared tp/ep axis of size > 1 that no param leaf uses is
+    accidental full replication — the user asked for sharding.
+    Returns ``(findings, unused_axes)`` — the axis list is the
+    STRUCTURED signal (LayoutReport.unused_axes)."""
+    out: List[Finding] = []
+    unused: List[str] = []
+    used: set = set()
+    for _, spec in tree_leaf_paths(specs):
+        if isinstance(spec, P):
+            used.update(spec_axes(spec))
+    for label in ("tp_axis", "ep_axis"):
+        ax = getattr(pipe, label, None)
+        if ax is None or mesh.size(ax) <= 1:
+            continue
+        if ax not in used:
+            unused.append(ax)
+            out.append(Finding(
+                rule="implicit-reshard",
+                severity=Severity.WARNING,
+                path=path,
+                message=(
+                    f"{label}={ax!r} has size {mesh.size(ax)} but NO "
+                    "param leaf shards over it — the layout fully "
+                    "replicates what the axis was declared to shard "
+                    "(accidental replication: each lane stores and "
+                    "computes the whole thing)"
+                ),
+            ))
+    return out, unused
+
+
+def _block_propagation(
+    pipe: Any,
+    params_spec: Pytree,
+    specs: Pytree,
+    mesh: MeshSpec,
+    x_spec: Pytree,
+    jaxpr_cache: Optional[Dict[str, Any]] = None,
+) -> Tuple[Optional[PropagationResult], Optional[str]]:
+    """Trace the plain block abstractly and push the per-stage layout
+    through it.  Returns (result, stand-down note).  ``jaxpr_cache``
+    (the 3D planner's) reuses the traced jaxpr across candidate widths
+    — the trace is width-independent, only the propagation's mesh sizes
+    change."""
+    blocks = params_spec.get("blocks") if isinstance(params_spec, dict) else None
+    if blocks is None:
+        return None, "no stacked blocks to propagate through"
+    stage_params = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(tuple(a.shape[1:]), a.dtype), blocks
+    )
+    block_specs = (
+        specs.get("blocks") if isinstance(specs, dict) else None
+    )
+    if block_specs is None:
+        return None, "no resolved block specs"
+    dp_ax = getattr(pipe, "dp_axis", None)
+    fsdp = bool(getattr(pipe, "fsdp", False))
+
+    def stage_spec(s: P) -> P:
+        entries = list(tuple(s)[1:])  # strip the stacked stage dim
+        if fsdp and dp_ax is not None:
+            # fsdp is a STORAGE layout: params are all-gathered over dp
+            # before the block consumes them, so the block-math layout
+            # drops the dp entries (the gather is the declared, priced
+            # collective — not an implicit reshard).
+            def drop_dp(e: Any) -> Any:
+                if e is None:
+                    return None
+                if isinstance(e, tuple):
+                    kept = tuple(a for a in e if a != dp_ax)
+                    return kept if kept else None
+                return None if e == dp_ax else e
+
+            entries = [drop_dp(e) for e in entries]
+        return P(*entries)
+
+    stage_specs = jax.tree_util.tree_map(
+        stage_spec, block_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+    def f(p: Pytree, x: Pytree) -> Pytree:
+        return pipe._block_fn_plain(p, x, None, 1.0, True)
+
+    closed = (
+        jaxpr_cache.get("block_jaxpr") if jaxpr_cache is not None else None
+    )
+    if closed is None:
+        try:
+            closed = jax.make_jaxpr(f)(stage_params, x_spec)
+        except Exception as e:  # noqa: BLE001 - tp blocks stand down
+            return None, (
+                "block propagation stood down (trace failed: "
+                f"{type(e).__name__}) — structural checks still apply"
+            )
+        if jaxpr_cache is not None:
+            jaxpr_cache["block_jaxpr"] = closed
+    dp = getattr(pipe, "dp_axis", None)
+    in_specs: List[Any] = []
+    flat_specs = jax.tree_util.tree_leaves(
+        stage_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    in_specs.extend(flat_specs)
+    for leaf in jax.tree_util.tree_leaves(x_spec):
+        nd = len(getattr(leaf, "shape", ()))
+        sh = [()] * nd
+        if dp is not None and nd > 0 and mesh.size(dp) > 1:
+            sh[0] = (dp,)
+        in_specs.append(tuple(sh))
+    result = propagate_shardings(closed, in_specs, mesh, path="spmd/block")
+    # Boundary contract: the schedule's carry (the activation handed to
+    # the next stage over the pp ring) is replicated over every axis but
+    # dp — a block OUTPUT still sharded over tp/ep must be gathered
+    # every tick, the classic implicit reshard.
+    out_leaves = [
+        v for v in (
+            closed.jaxpr.outvars if hasattr(closed, "jaxpr")
+            else closed.outvars
+        )
+    ]
+    for sh, v in zip(result.out_shardings, out_leaves):
+        stray = sorted({
+            a for e in sh for a in e if dp is None or a != dp
+        })
+        if stray:
+            nbytes = jx.aval_bytes(v)
+            result.comm.append(CommEvent(
+                kind="reshard", axes=tuple(stray), bytes=nbytes,
+                eqn_index=-1, primitive="output", path="spmd/block",
+                detail="block output sharded at the stage boundary",
+            ))
+            result.findings.append(Finding(
+                rule="implicit-reshard",
+                severity=Severity.WARNING,
+                path="spmd/block",
+                message=(
+                    f"the block output is sharded over {stray} at the "
+                    "stage boundary, but the pipeline carry is "
+                    "replicated there — the value is gathered every "
+                    "schedule tick; close the parallel region inside "
+                    "the block (e.g. Megatron row-parallel + psum via "
+                    "parallel.tensor.psum_value) or replicate the "
+                    "offending param"
+                ),
+            ))
+    return result, None
+
+
+def verify_layout(
+    pipe: Any,
+    sample_input: Optional[Pytree] = None,
+    *,
+    params_spec: Optional[Pytree] = None,
+    mesh_sizes: Optional[Mapping[str, int]] = None,
+    propagate: bool = True,
+    jaxpr_cache: Optional[Dict[str, Any]] = None,
+) -> LayoutReport:
+    """Statically verify a pipe's dp × tp × pp param layout.
+
+    ``mesh_sizes`` overrides axis widths (the 3D planner's candidate
+    meshes — no devices are touched); ``params_spec`` skips the abstract
+    init when the caller already holds one (the lint rule does);
+    ``jaxpr_cache`` (a caller-held dict) reuses the width-independent
+    block trace across candidate widths (the planner's loop).
+    Returns a :class:`LayoutReport`; ``report.ok()`` is the
+    certification the planner requires of every ranked candidate.
+    """
+    if params_spec is None:
+        if sample_input is None:
+            raise ValueError("pass sample_input or params_spec")
+        x_in = jx.avalify(sample_input)
+        params_spec = jax.eval_shape(
+            lambda r: pipe._init_host(r, x_in), jax.random.PRNGKey(0)
+        )
+    mesh = MeshSpec.from_mesh(pipe.mesh)
+    if mesh_sizes:
+        mesh = mesh.with_sizes(**dict(mesh_sizes))
+    table = pipe.rule_table(params_spec)
+    specs, unmatched = table.resolve(params_spec)
+    findings = _coverage_findings(
+        table, unmatched, specs, params_spec, mesh, path="layout"
+    )
+    repl_findings, unused_axes = _replication_findings(
+        pipe, specs, mesh, path="layout"
+    )
+    findings.extend(repl_findings)
+    comm: List[CommEvent] = []
+    notes: List[str] = []
+    propagated = False
+    if propagate and not unmatched:
+        x_for_block = (
+            jaxpr_cache.get("block_in") if jaxpr_cache is not None else None
+        )
+        if x_for_block is None and sample_input is not None:
+            x_for_block = _block_input_spec(pipe, sample_input)
+            if jaxpr_cache is not None and x_for_block is not None:
+                jaxpr_cache["block_in"] = x_for_block
+        if x_for_block is not None:
+            result, note = _block_propagation(
+                pipe, params_spec, specs, mesh, x_for_block, jaxpr_cache
+            )
+            if note:
+                notes.append(note)
+            if result is not None:
+                propagated = True
+                findings.extend(result.findings)
+                comm.extend(result.comm)
+    return LayoutReport(
+        mesh=mesh,
+        table=table,
+        specs=specs,
+        unmatched=list(unmatched),
+        findings=findings,
+        comm=comm,
+        param_bytes_local=layout_bytes(params_spec, specs, mesh),
+        propagated=propagated,
+        notes=notes,
+        unused_axes=unused_axes,
+    )
+
+
+def _block_input_spec(pipe: Any, sample_input: Pytree) -> Optional[Pytree]:
+    """The abstract per-micro-batch block input (post-``pre``), shaped
+    like one schedule cell's activation."""
+    x_spec = jx.avalify(sample_input)
+    try:
+        if pipe.pre is not None:
+            params_pre = jax.eval_shape(
+                lambda r: pipe.pre.init(r, x_spec)[0], jax.random.PRNGKey(0)
+            )
+            x_spec, _ = jax.eval_shape(
+                lambda p, xx: pipe.pre.apply(p, (), xx, rng=None, train=True),
+                params_pre, x_spec,
+            )
+        chunks = max(int(getattr(pipe, "chunks", 1)), 1)
+
+        def cut(a: Any) -> jax.ShapeDtypeStruct:
+            b = int(a.shape[0])
+            mb = max(b // chunks, 1)
+            return jax.ShapeDtypeStruct((mb,) + tuple(a.shape[1:]), a.dtype)
+
+        return jax.tree_util.tree_map(cut, x_spec)
+    except Exception:  # noqa: BLE001 - propagation is best-effort
+        return None
+
+
+# --------------------------------------------------------------------- #
+# the implicit-reshard lint rule                                        #
+# --------------------------------------------------------------------- #
+
+
+def check_implicit_reshard(trace: Any) -> List[Finding]:
+    """Lint rule: ERROR on a param leaf the partition-rule table leaves
+    unmatched (silent replication), ERROR on a resolved spec naming a
+    mesh axis that doesn't exist, WARNING on a layout-induced resharding
+    collective inside the step (operands sharded incompatibly — the
+    propagation's ``reshard`` events) and on a declared tp/ep axis no
+    leaf uses (accidental full replication).  MPMD pipes have no
+    declarative layout — the rule stands down."""
+    if trace.engine != "spmd":
+        return []
+    try:
+        report = verify_layout(
+            trace.pipe, trace.x_spec, propagate=True
+        )
+    except Exception:  # noqa: BLE001 - the verifier stands down, not lint
+        return []
+    return list(report.findings)

@@ -22,11 +22,35 @@ backwards around the ring automatically.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def partition_rules(sp_axis: str, pp_axis: str = "pp") -> Any:
+    """Ring attention's param layout as a rule table (the unified layer
+    of :mod:`torchgpipe_tpu.analysis.partition_rules`): like Ulysses,
+    the ring shards the SEQUENCE (K/V blocks rotate over ``sp``), never
+    parameters — every param leaf replicates over ``sp`` (stage dim
+    over ``pp``)."""
+    from torchgpipe_tpu.analysis.partition_rules import (
+        PartitionRule,
+        RuleTable,
+    )
+
+    del sp_axis  # declared for symmetry: no param leaf mentions it
+    return RuleTable(
+        name="ring-attention-sequence-parallel",
+        rules=(
+            PartitionRule(
+                r".*", P(pp_axis),
+                note="sp shards activations, not params",
+            ),
+        ),
+    )
 
 _NEG = -1e30  # large negative instead of -inf: keeps grads NaN-free
 

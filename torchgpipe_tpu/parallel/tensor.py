@@ -40,8 +40,54 @@ from typing import Any, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 Pytree = Any
+
+
+def partition_rules(tp_axis: str, pp_axis: str = "pp") -> Any:
+    """The Megatron tensor-parallel layout as an ordered regex →
+    PartitionSpec rule table over STACKED block param paths (the
+    unified layer of :mod:`torchgpipe_tpu.analysis.partition_rules`).
+
+    Column-parallel projections (attention q/k/v, MLP up/gate) shard
+    their OUTPUT dim over tp; row-parallel projections (attention
+    output, MLP down) their INPUT dim; per-hidden biases shard with the
+    hidden dim; everything else replicates across tp (stage dim over
+    pp).  This is the same layout the framework transformer block
+    declares structurally (``meta['param_specs']``) — the unified-layer
+    tests pin the two resolving identically, so either form is THE
+    layout."""
+    from torchgpipe_tpu.analysis.partition_rules import (
+        PartitionRule,
+        RuleTable,
+    )
+
+    return RuleTable(
+        name=f"tensor-parallel:{tp_axis}",
+        rules=(
+            PartitionRule(
+                r"(^|/)(wq|wk|wv|w_gate|w_up|w_fc|qb|kb|vb)$",
+                P(pp_axis, None, tp_axis),
+                note="column-parallel: output dim over tp",
+            ),
+            PartitionRule(
+                r"(^|/)(wo|w_down|w_proj|oa)$",
+                P(pp_axis, tp_axis, None),
+                note="row-parallel: input dim over tp",
+            ),
+            PartitionRule(
+                r"(^|/)(bq|bk|bv|b_fc)$",
+                P(pp_axis, tp_axis),
+                note="per-hidden biases shard with the hidden dim",
+            ),
+            PartitionRule(
+                r".*",
+                P(pp_axis),
+                note="norm scales / post-psum biases replicate over tp",
+            ),
+        ),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))

@@ -27,10 +27,36 @@ lists ring/Ulysses as absent) — this module is TPU-native new capability.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def partition_rules(sp_axis: str, pp_axis: str = "pp") -> Any:
+    """Ulysses' param layout as a rule table (the unified layer of
+    :mod:`torchgpipe_tpu.analysis.partition_rules`): sequence
+    parallelism shards ACTIVATIONS (the sequence dim, swapped to heads
+    around attention), never parameters — every param leaf replicates
+    over ``sp`` (stage dim over ``pp``).  Emitted so the static
+    sharding verifier can certify an sp layout by the same resolution
+    path as tp/ep ones."""
+    from torchgpipe_tpu.analysis.partition_rules import (
+        PartitionRule,
+        RuleTable,
+    )
+
+    del sp_axis  # declared for symmetry: no param leaf mentions it
+    return RuleTable(
+        name="ulysses-sequence-parallel",
+        rules=(
+            PartitionRule(
+                r".*", P(pp_axis),
+                note="sp shards activations, not params",
+            ),
+        ),
+    )
 
 
 def _swap_to_heads(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:

@@ -188,6 +188,19 @@ def _sub_key(base: Optional[jax.Array], i: jax.Array) -> Optional[jax.Array]:
     return None if base is None else jax.random.fold_in(base, i)
 
 
+def _rule_leaf_specs(spec_tree: Pytree) -> list:
+    """(path, PartitionSpec) pairs of a resolved per-leaf spec tree
+    (PartitionSpec is itself a pytree leaf, so a plain path flatten
+    yields exactly the per-leaf specs)."""
+    from torchgpipe_tpu.analysis.partition_rules import tree_leaf_paths
+
+    return [
+        (path, s)
+        for path, s in tree_leaf_paths(spec_tree)
+        if isinstance(s, P)
+    ]
+
+
 try:  # Literal moved between jax.core and jax.extend.core across versions
     from jax.extend.core import Literal as _JaxprLiteral
 except Exception:  # pragma: no cover - version fallback
@@ -527,6 +540,23 @@ class SpmdGPipe:
     # span is true device time (the tracer blocks on the step outputs);
     # use obs.device_trace for the XLA-level interior of the scan.
     tracer: Any = None
+    # Optional user-declared partition-rule table (an ordered
+    # analysis.partition_rules.RuleTable or (regex, PartitionSpec)
+    # pairs) replacing the structurally-derived layout: ``place()`` and
+    # the static sharding verifier resolve every param leaf through it,
+    # first match wins, and an UNMATCHED leaf is a didactic error (the
+    # ``implicit-reshard`` lint rule's ERROR), never silent replication.
+    # None (default): the engine EMITS the equivalent table from its
+    # structural declarations — see :meth:`rule_table`.
+    partition_rules: Any = None
+    # ZeRO-style sharded optimizer update (arXiv:2004.13336): the
+    # default for :meth:`make_train_step`'s ``zero=`` — optimizer state
+    # partitioned over the dp axis (each data-parallel lane stores and
+    # updates 1/N_dp of every state leaf), updated params all-gathered
+    # at apply.  Bitwise-equal to the unsharded update for elementwise
+    # optimizers (adam/adamw/sgd); declared on the pipe so the planner's
+    # memory certification sees the configured optimizer layout.
+    zero_update: bool = False
 
     def __repr__(self) -> str:
         axes = {
@@ -542,6 +572,7 @@ class SpmdGPipe:
                 ("scan_unroll", self.scan_unroll, 1),
                 ("send_ahead", self.send_ahead, True),
                 ("megastep", self.megastep, 1),
+                ("zero_update", self.zero_update, False),
             )
             if v != default
         )
@@ -1221,30 +1252,74 @@ class SpmdGPipe:
     def _blocks_leaf_specs(self, blocks: Pytree) -> Pytree:
         return self._leaf_specs(self._blocks_spec, blocks, "block")
 
+    # The param-dict keys the engine owns a layout for; place() passes
+    # anything else through untouched (a caller-managed EMA tree, say).
+    _LAYOUT_KEYS: Tuple[str, ...] = ("blocks", "pre", "post", "loss")
+
+    def _structural_specs(self, params: dict) -> dict:
+        """Per-leaf PartitionSpec tree from the structural declarations
+        (the pre-rule-table layout: stacking prefix + meta['param_specs']
+        + fsdp augmentation) — what :meth:`rule_table` emits as rules."""
+        specs: dict = {}
+        prefixes = {
+            "blocks": self._blocks_spec,
+            "pre": self._pre_spec,
+            "post": self._post_spec,
+            "loss": self._loss_spec,
+        }
+        for k in params:
+            if k not in prefixes:
+                continue
+            if k == "blocks" and self.fsdp:
+                self._ensure_fsdp(params[k])
+                specs[k] = self._fsdp_specs
+            else:
+                specs[k] = self._leaf_specs(prefixes[k], params[k], k)
+        return specs
+
+    def rule_table(self, params: Pytree) -> Any:
+        """The pipe's param layout as an ordered regex → PartitionSpec
+        rule table (:mod:`torchgpipe_tpu.analysis.partition_rules`).
+
+        A declared :attr:`partition_rules` is returned as-is; otherwise
+        the table is EMITTED from the structural declarations (stacking
+        prefix over ``pp``, ``meta['param_specs']`` leaf sharding, fsdp
+        augmentation) — resolving it against the same params reproduces
+        the structural layout leaf-for-leaf, which is the round-trip
+        the unified-layer tests pin.  ``place()`` and the static
+        sharding verifier both resolve through this table, so it IS the
+        layout, not documentation of it."""
+        from torchgpipe_tpu.analysis import partition_rules as pr
+
+        if self.partition_rules is not None:
+            return pr.as_rule_table(self.partition_rules)
+        return pr.rules_from_specs(
+            self._structural_specs(params),
+            name=f"spmd:{self.block.name}",
+            note="emitted by SpmdGPipe",
+        )
+
     def place(self, params: dict) -> dict:
         """Commit params to the mesh: blocks stage-sharded over ``pp`` (plus
         any tensor/expert-parallel leaf sharding the layers declare),
         pre/post replicated over pp (with their own declared leaf sharding,
-        e.g. a vocab-parallel embedding table)."""
-        out = dict(params)
-        trees = [("blocks", self._blocks_spec)]
-        if "pre" in params:
-            trees.append(("pre", self._pre_spec))
-        if "post" in params:
-            trees.append(("post", self._post_spec))
-        if "loss" in params:
-            trees.append(("loss", self._loss_spec))
-        for k, prefix in trees:
-            if k == "blocks" and self.fsdp:
-                self._ensure_fsdp(params[k])
-                specs = self._fsdp_specs
-            else:
-                specs = self._leaf_specs(prefix, params[k], k)
-            self._check_spec_shapes(params[k], specs)
+        e.g. a vocab-parallel embedding table).  The layout is resolved
+        through :meth:`rule_table` — an unmatched param leaf raises (no
+        silent replication; the ``implicit-reshard`` lint rule's
+        contract)."""
+        from torchgpipe_tpu.analysis.partition_rules import (
+            match_partition_rules,
+        )
+
+        known = {k: params[k] for k in self._LAYOUT_KEYS if k in params}
+        specs = match_partition_rules(self.rule_table(known), known)
+        self._check_spec_shapes(known, specs)
+        out = dict(params)  # unknown keys (caller state) pass through
+        for k in known:
             out[k] = jax.tree_util.tree_map(
                 lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
                 params[k],
-                specs,
+                specs[k],
             )
         return out
 
@@ -1275,10 +1350,28 @@ class SpmdGPipe:
         eagerly for a didactic error instead of a shard_map failure."""
 
         def chk(a, spec):
+            if len(tuple(spec)) > len(a.shape):
+                raise ValueError(
+                    f"partition spec {spec} names {len(tuple(spec))} "
+                    f"dims but the param has shape {a.shape} "
+                    f"({len(a.shape)} dims); trim the rule's spec (a "
+                    "user partition_rules table must rank-match every "
+                    "leaf its pattern catches — split the rule, or "
+                    "order a narrower one first)"
+                )
             for i, ax in enumerate(spec):
                 if ax is None:
                     continue
                 axes = ax if isinstance(ax, tuple) else (ax,)
+                for a_ in axes:
+                    if a_ not in self.mesh.shape:
+                        raise ValueError(
+                            f"partition spec {spec} mentions mesh axis "
+                            f"{a_!r} which this mesh (axes "
+                            f"{list(self.mesh.axis_names)}) does not "
+                            "have; fix the rule table / param_specs "
+                            "declaration or add the axis to the mesh"
+                        )
                 size = int(np.prod([self.mesh.shape[a_] for a_ in axes]))
                 if a.shape[i] % size != 0:
                     raise ValueError(
@@ -3225,9 +3318,179 @@ class SpmdGPipe:
         with self._annotate_cell_failure(params, x_mb):
             return self._train_step_fns[key](*args)
 
+    # ------------------------------------------------------------------ #
+    # ZeRO-style sharded optimizer update (optimizer state over dp)      #
+    # ------------------------------------------------------------------ #
+
+    def _zero_axes(self) -> Tuple[str, ...]:
+        """The mesh axes the param layout itself uses — the leading
+        explicit dims of the ZeRO state representation (state varies
+        over them because the local param shards do)."""
+        axes = [self.pp_axis]
+        for ax in (self.tp_axis, self.ep_axis):
+            if ax is not None and ax not in axes:
+                axes.append(ax)
+        return tuple(axes)
+
+    def _zero_check(self) -> None:
+        if self.dp_axis is None or self.mesh.shape[self.dp_axis] < 2:
+            raise ValueError(
+                "the ZeRO-sharded optimizer update partitions state over "
+                "the data-parallel lanes: it needs dp_axis set and a dp "
+                "mesh axis of size >= 2 (arXiv:2004.13336 — with one "
+                "replica there is nothing to shard; use zero=False)"
+            )
+        if self.fsdp:
+            raise ValueError(
+                "zero=True has nothing to add under fsdp: parameters "
+                "(and therefore optimizer state built beside them) are "
+                "already sharded over dp — use fsdp alone"
+            )
+
+    def _zero_machinery(
+        self, optimizer: Any, params: Pytree
+    ) -> Tuple[Pytree, Pytree, Callable, Callable]:
+        """(param_specs, state_specs, local_init, local_update) for the
+        ZeRO update's shard_map programs.
+
+        Representation: each optimizer-state leaf that mirrors a param
+        is stored FLAT, padded to a dp multiple, with explicit leading
+        dims for every layout axis — global shape
+        ``(*axis_sizes(zero_axes), Fp)`` sharded
+        ``P(*zero_axes, dp)`` — so each device holds exactly
+        ``local_param_size / N_dp`` elements of state per leaf: the
+        ~N_dp× optimizer-memory drop the planner's certification
+        models.  Scalar state (step counters) stays replicated.
+        """
+        from torchgpipe_tpu.analysis.partition_rules import (
+            match_partition_rules,
+        )
+
+        param_specs = match_partition_rules(self.rule_table(params), params)
+        zaxes = self._zero_axes()
+        dpn = int(self.mesh.shape[self.dp_axis])
+        # The segment math assumes every lane's local param shard is
+        # dp-REPLICATED (each dp lane slices its segment of the same
+        # data); a layout already sharding a leaf over dp would make
+        # to_full reassemble a mixture of different lanes' data —
+        # silently wrong training, refused like fsdp is.
+        for path, spec in _rule_leaf_specs(param_specs):
+            entries = tuple(spec)
+            for e in entries:
+                axes_ = e if isinstance(e, tuple) else (e,)
+                if e is not None and self.dp_axis in axes_:
+                    raise ValueError(
+                        f"zero=True needs dp-replicated parameters, but "
+                        f"the layout shards leaf {path!r} over the dp "
+                        f"axis ({spec}) — its optimizer state is already "
+                        "dp-partitioned alongside the param; use "
+                        "zero=False (or fsdp) for this layout"
+                    )
+
+        def local_shape(a: Any, spec: P) -> Tuple[int, ...]:
+            shape = list(a.shape)
+            for i, ax in enumerate(tuple(spec)):
+                if ax is None:
+                    continue
+                axes_ = ax if isinstance(ax, tuple) else (ax,)
+                for a_ in axes_:
+                    shape[i] //= int(self.mesh.shape[a_])
+            return tuple(shape)
+
+        def seg_len(a: Any, spec: P) -> int:
+            n = 1
+            for d in local_shape(a, spec):
+                n *= int(d)
+            return -(-n // dpn)  # ceil: the dp padding
+
+        seg_spec = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(
+                (1,) * len(zaxes) + (seg_len(a, s),), a.dtype
+            ),
+            params, param_specs,
+        )
+        state_struct = jax.eval_shape(optimizer.init, seg_spec)
+        seg_shapes = {
+            leaf.shape
+            for leaf in jax.tree_util.tree_leaves(seg_spec)
+        }
+
+        def state_spec_of(leaf: Any) -> P:
+            if leaf.ndim == 0:
+                return P()
+            if leaf.shape in seg_shapes or (
+                leaf.ndim == len(zaxes) + 1
+                and leaf.shape[: len(zaxes)] == (1,) * len(zaxes)
+            ):
+                return P(*zaxes, self.dp_axis)
+            raise ValueError(
+                "the ZeRO-sharded update supports optimizers whose "
+                "state mirrors the params leaf-for-leaf plus scalar "
+                "counters (adam/adamw/sgd-momentum shape); this "
+                f"optimizer's state has a leaf of shape {leaf.shape} "
+                "that matches neither — use zero=False for it"
+            )
+
+        state_specs = jax.tree_util.tree_map(state_spec_of, state_struct)
+
+        def to_seg(a: jax.Array) -> jax.Array:
+            flat = a.reshape((-1,))
+            f = flat.shape[0]
+            seg = -(-f // dpn)
+            if seg * dpn > f:
+                flat = jnp.pad(flat, (0, seg * dpn - f))
+            i = lax.axis_index(self.dp_axis)
+            piece = lax.dynamic_slice(flat, (i * seg,), (seg,))
+            return piece.reshape((1,) * len(zaxes) + (seg,))
+
+        def local_init(p_loc: Pytree) -> Pytree:
+            return optimizer.init(jax.tree_util.tree_map(to_seg, p_loc))
+
+        def local_update(
+            p_loc: Pytree, g_loc: Pytree, s_loc: Pytree
+        ) -> Tuple[Pytree, Pytree]:
+            seg_p = jax.tree_util.tree_map(to_seg, p_loc)
+            seg_g = jax.tree_util.tree_map(to_seg, g_loc)
+            updates, new_s = optimizer.update(seg_g, s_loc, seg_p)
+            new_seg = jax.tree_util.tree_map(
+                lambda a, u: (a + u).astype(a.dtype), seg_p, updates
+            )
+
+            def to_full(ns: jax.Array, old: jax.Array) -> jax.Array:
+                flat = lax.all_gather(
+                    ns.reshape((-1,)), self.dp_axis, axis=0, tiled=True
+                )
+                f = 1
+                for d in old.shape:
+                    f *= int(d)
+                return flat[:f].reshape(old.shape)
+
+            new_p = jax.tree_util.tree_map(to_full, new_seg, p_loc)
+            return new_p, new_s
+
+        return param_specs, state_specs, local_init, local_update
+
+    def zero_opt_state(self, optimizer: Any, params: Pytree) -> Pytree:
+        """Initialize dp-SHARDED optimizer state for ``optimizer`` (the
+        ZeRO twin of ``place_tree(optimizer.init(params))``): each
+        data-parallel lane stores 1/N_dp of every state leaf.  Pair with
+        ``make_train_step(optimizer, zero=True)``; the update is
+        bitwise-equal to the unsharded one for elementwise optimizers
+        (adam/adamw/sgd — anything without cross-element coupling like
+        global-norm clipping)."""
+        self._zero_check()
+        param_specs, state_specs, local_init, _ = self._zero_machinery(
+            optimizer, params
+        )
+        fn = shard_map_compat(
+            local_init, self.mesh,
+            in_specs=(param_specs,), out_specs=state_specs,
+        )
+        return jax.jit(fn)(params)
+
     def make_train_step(
         self, optimizer: Any, *, donate: bool = True,
-        megastep: Optional[int] = None,
+        megastep: Optional[int] = None, zero: Optional[bool] = None,
     ) -> Callable[..., Tuple[jax.Array, Pytree, Pytree]]:
         """The whole update as ONE compiled program: pipelined
         forward+backward plus the optimizer, fused by XLA.
@@ -3280,12 +3543,26 @@ class SpmdGPipe:
           failure retries the whole K-step megastep, and checkpoint /
           preemption hooks run at megastep boundaries only.  With
           ``rng``, inner step k derives its key as ``fold_in(rng, k)``.
+
+        ``zero`` (default: the pipe's declared :attr:`zero_update`)
+        switches the optimizer apply to the ZeRO-sharded form
+        (arXiv:2004.13336): optimizer state partitioned over the dp
+        axis — initialize it with :meth:`zero_opt_state` instead of
+        ``place_tree(optimizer.init(params))`` — each lane updates its
+        1/N_dp segment of every param, and the updated params are
+        all-gathered over dp.  Bitwise-equal to the unsharded update
+        for elementwise optimizers; per-device optimizer memory drops
+        ~N_dp×, which the planner's memory certification models.
         """
         K = self.megastep if megastep is None else int(megastep)
         if K < 1:
             raise ValueError(f"megastep must be >= 1, got {K}")
+        use_zero = self.zero_update if zero is None else bool(zero)
+        if use_zero:
+            self._zero_check()
         if K > 1:
-            return self._make_megastep(optimizer, K, donate)
+            return self._make_megastep(optimizer, K, donate, use_zero)
+        apply_update = self._make_apply_update(optimizer, use_zero)
 
         def whole(
             params: Pytree,
@@ -3301,10 +3578,7 @@ class SpmdGPipe:
             # plan ends, or vice versa.
             del plan_token
             loss, grads = self.train_step(params, x, target, rng)
-            updates, new_state = optimizer.update(grads, opt_state, params)
-            new_params = jax.tree_util.tree_map(
-                lambda p, u: (p + u).astype(p.dtype), params, updates
-            )
+            new_params, new_state = apply_update(params, grads, opt_state)
             return loss, new_params, new_state
 
         compiled = jax.jit(
@@ -3334,14 +3608,49 @@ class SpmdGPipe:
         step.megastep = 1  # type: ignore[attr-defined]
         return step
 
+    def _make_apply_update(
+        self, optimizer: Any, use_zero: bool
+    ) -> Callable[[Pytree, Pytree, Pytree], Tuple[Pytree, Pytree]]:
+        """The optimizer-apply half of a fused step: plain whole-tree
+        update, or the ZeRO-sharded shard_map form (each dp lane updates
+        its 1/N_dp flat segment, params all-gathered back)."""
+
+        def plain(
+            params: Pytree, grads: Pytree, opt_state: Pytree
+        ) -> Tuple[Pytree, Pytree]:
+            updates, new_state = optimizer.update(grads, opt_state, params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: (p + u).astype(p.dtype), params, updates
+            )
+            return new_params, new_state
+
+        if not use_zero:
+            return plain
+
+        def sharded(
+            params: Pytree, grads: Pytree, opt_state: Pytree
+        ) -> Tuple[Pytree, Pytree]:
+            pspecs, sspecs, _, local_update = self._zero_machinery(
+                optimizer, params
+            )
+            fn = shard_map_compat(
+                local_update, self.mesh,
+                in_specs=(pspecs, pspecs, sspecs),
+                out_specs=(pspecs, sspecs),
+            )
+            return fn(params, grads, opt_state)
+
+        return sharded
+
     def _make_megastep(
-        self, optimizer: Any, K: int, donate: bool
+        self, optimizer: Any, K: int, donate: bool, use_zero: bool = False
     ) -> Callable[..., Tuple[jax.Array, Pytree, Pytree, jax.Array]]:
         """K optimizer steps as one scanned program (see
         :meth:`make_train_step`'s ``megastep`` contract)."""
         from torchgpipe_tpu.utils import tree_finite
 
         tmap = jax.tree_util.tree_map
+        apply_update = self._make_apply_update(optimizer, use_zero)
 
         def whole(
             params: Pytree,
@@ -3360,10 +3669,7 @@ class SpmdGPipe:
                     jax.random.fold_in(rng, k) if rng is not None else None
                 )
                 loss, grads = self.train_step(p, x_k, tgt_k, key)
-                updates, new_o = optimizer.update(grads, o, p)
-                new_p = tmap(
-                    lambda a, u: (a + u).astype(a.dtype), p, updates
-                )
+                new_p, new_o = apply_update(p, grads, o)
                 # The in-scan skip-step: cover EXACTLY what StepGuard's
                 # host-side check covers on the K=1 step's output tuple
                 # (loss, new params, new opt state) so megastep(K) is

@@ -419,6 +419,35 @@ def _spmd_step_flops(
     return jx.flops_estimate(jaxpr)
 
 
+def spmd_param_layout_bytes(pipe: Any, params_spec: Pytree) -> int:
+    """Per-device param bytes of an SPMD pipe under its RESOLVED layout
+    (rule table → per-leaf spec → bytes ÷ shard widths): the one
+    accounting shared by ``tune_step``'s fixed-resident model and the
+    3D planner's memory certification.  Falls back to the plain
+    stage-share sum if the layout cannot resolve (a user rule table
+    with unmatched leaves fails loudly elsewhere)."""
+    from torchgpipe_tpu.analysis import sharding as shd
+
+    try:
+        table = pipe.rule_table(params_spec)
+        specs, unmatched = table.resolve(params_spec)
+        if not unmatched:
+            return shd.layout_bytes(
+                params_spec, specs, shd.MeshSpec.from_mesh(pipe.mesh)
+            )
+    except Exception:  # noqa: BLE001 - accounting degrades, not tuning
+        pass
+    stage_params_spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+        params_spec["blocks"],
+    )
+    return tree_bytes(stage_params_spec) + sum(
+        tree_bytes(params_spec[k])
+        for k in ("pre", "post", "loss")
+        if k in params_spec
+    )
+
+
 def _spmd_cell_residual_bytes(
     pipe: Any, stage_params_spec: Pytree, mb_spec: Pytree, plain: bool
 ) -> Optional[int]:
@@ -576,14 +605,14 @@ def _tune_spmd(
         else None
     )
     # Per-lane parameter/state residents (stage share + replicated
-    # pre/post/loss), scaled for grads + optimizer moments.
+    # pre/post/loss), scaled for grads + optimizer moments — accounted
+    # UNDER THE LAYOUT via the unified partition-rule layer, so tp/ep-
+    # sharded leaves charge 1/width per chip (identical to the plain
+    # stage-share sum when nothing beyond pp is sharded).  The planner's
+    # 3D certification and ``zero_opt_state`` use the same accounting.
     param_bytes = 0
     if params_spec is not None:
-        param_bytes = tree_bytes(stage_params_spec) + sum(
-            tree_bytes(params_spec[k])
-            for k in ("pre", "post", "loss")
-            if k in params_spec
-        )
+        param_bytes = spmd_param_layout_bytes(pipe, params_spec)
     # The block consumes ACTIVATIONS (pre applied to the raw batch), not
     # the raw inputs — thread the full-batch spec through pre once.
     block_in_spec = x_spec
