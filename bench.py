@@ -889,6 +889,29 @@ def _megastep_entry() -> None:
     raise SystemExit(megastep_main())
 
 
+def _packing_entry() -> None:
+    """The ``packing`` rung: one ragged CPU corpus (~50% natural
+    padding) trained PACKED (utils.data.pack_documents, segment-aware
+    attention) vs PADDED through the same SpmdGPipe — real tokens/s
+    must move toward the 1/(1-pad_fraction) bound (>= 1.3x at this
+    corpus), with per-document losses matched within the pinned
+    tolerance (equivalence always gates) — plus a ragged bursty serving
+    mix with the prefill bucket ladder on vs off, TTFT/TPOT percentiles
+    reported for both (benchmarks/packing_speed.py).  Emits one JSON
+    line::
+
+        env JAX_PLATFORMS=cpu python bench.py --packing
+    """
+    import sys as _sys
+
+    _sys.argv = [_sys.argv[0]] + [
+        a for a in _sys.argv[1:] if a != "--packing"
+    ] + ["--json"]
+    from benchmarks.packing_speed import main as packing_main
+
+    raise SystemExit(packing_main())
+
+
 def _obs_overhead_entry() -> None:
     """The ``obs-overhead`` rung: CPU tiny-llama step time with the
     telemetry layer fully on (sync=False Timeline + MetricsRegistry +
@@ -941,6 +964,8 @@ if __name__ == "__main__":
         _plan_validate_entry()
     elif "--megastep" in sys.argv:
         _megastep_entry()
+    elif "--packing" in sys.argv:
+        _packing_entry()
     elif "--decode-serving" in sys.argv:
         _decode_serving_entry()
     elif "--child" in sys.argv:

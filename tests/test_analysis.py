@@ -244,6 +244,87 @@ def test_recompilation_hazard_clean_on_even_split():
 
 
 # --------------------------------------------------------------------- #
+# pad-waste                                                             #
+# --------------------------------------------------------------------- #
+
+
+def _pad_waste_fixture():
+    import numpy as np
+
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        llama,
+        packed_cross_entropy_sum,
+    )
+    from torchgpipe_tpu.utils import data as D
+
+    cfg = TransformerConfig(vocab=37, dim=16, n_layers=4, n_heads=2)
+    model = GPipe(llama(cfg), balance=[3, 3], chunks=2)
+    rng = np.random.RandomState(0)
+    docs = [
+        rng.randint(1, 37, size=int(rng.randint(2, 9))).astype(np.int32)
+        for _ in range(8)
+    ]
+    return model, docs, D, packed_cross_entropy_sum
+
+
+def test_pad_waste_fires_on_padded_concrete_batch():
+    """Broken: a packing-capable llama linted on a concretely ~60%-
+    padded batch WARNs with the pack_documents pointer."""
+    model, docs, D, loss = _pad_waste_fixture()
+    xt, yt = next(D.padded_batches(docs, 16, batch_rows=8))
+    found = _by_rule(
+        analysis.lint(model, jnp.asarray(xt), target=yt, loss_fn=loss),
+        "pad-waste",
+    )
+    assert len(found) == 1
+    assert found[0].severity == Severity.WARNING
+    assert "pack_documents" in found[0].message
+
+
+def test_pad_waste_stands_down_on_packed_and_abstract():
+    """Fixed: the SAME pipeline on the packed batch lints fully clean
+    (segment_ids present), and an abstract sample (shapes only, no
+    values) cannot fire the rule."""
+    model, docs, D, loss = _pad_waste_fixture()
+    pk = D.pack_documents(docs, 16)
+    # Batch rows padded to a multiple of chunks (all-pad no-op rows),
+    # so the packed example is clean under EVERY rule.
+    x, y = next(D.packed_batches(pk, pk.n_blocks + pk.n_blocks % 2))
+    xj = {k: jnp.asarray(v) for k, v in x.items()}
+    assert analysis.lint(model, xj, target=y, loss_fn=loss) == []
+    assert analysis.lint(
+        model, jax.ShapeDtypeStruct((8, 16), jnp.int32)
+    ) == []
+
+
+def test_pad_waste_detects_nonzero_pad_id():
+    """eos-padded corpora (pad id != 0): the rule probes the batch's
+    most-common final-column token, so a nonzero pad does not let it
+    silently stand down."""
+    import numpy as np
+
+    model, docs, D, loss = _pad_waste_fixture()
+    xt, yt = next(D.padded_batches(docs, 16, batch_rows=8, pad_id=2))
+    assert np.all(np.asarray(xt)[:, -1] == 2)  # eos-style trailing pad
+    found = _by_rule(
+        analysis.lint(model, jnp.asarray(xt), target=yt, loss_fn=loss),
+        "pad-waste",
+    )
+    assert len(found) == 1 and "pad id 2" in found[0].message
+
+
+def test_pad_waste_stands_down_on_non_transformer():
+    """A dense MLP is not packing-capable: heavy zero-padding in a
+    float batch is not this rule's business."""
+    model = GPipe(_mpmd_layers(), balance=[2, 1], chunks=2)
+    x = jnp.zeros((4, 16), jnp.int32)  # int plane, all "pad"
+    assert _by_rule(
+        analysis.lint(model, x), "pad-waste"
+    ) == []
+
+
+# --------------------------------------------------------------------- #
 # host-sync-in-loop                                                     #
 # --------------------------------------------------------------------- #
 

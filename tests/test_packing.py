@@ -1,0 +1,598 @@
+"""Sequence packing: stop paying for padding — the contracts, pinned.
+
+1. **Packer invariants** — deterministic greedy first-fit, no document
+   split across blocks, resume replays the identical stream, didactic
+   errors (oversized / empty documents).
+2. **Equivalence** — per-document losses from a PACKED batch equal the
+   same documents run UNPACKED with pad masking: bitwise at the model
+   level where reduction order agrees, at a pinned tolerance (5e-4,
+   documented in docs/tuning.md) where the packed layout reorders the
+   f32 accumulation; through BOTH engines, including
+   ``checkpoint='except_last'`` and ``megastep(K)``.
+3. **Segment-aware cache attention** — ``_attend_chunk`` /
+   ``_attend_full`` with segment planes equal per-document separate
+   attention (the generation-path hooks).
+4. **Honest accounting** — ``StepReporter``'s measured MFU prices only
+   real tokens: the padded run of a corpus reports LOWER MFU than the
+   packed run at identical step times (the regression this PR fixes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu.layers import sequential_init, sequential_apply
+from torchgpipe_tpu.models.transformer import (
+    TransformerConfig,
+    llama,
+    llama_spmd,
+    packed_cross_entropy,
+    packed_cross_entropy_sum,
+    per_document_losses,
+)
+from torchgpipe_tpu.utils import data as D
+
+CFG = TransformerConfig(vocab=37, dim=16, n_layers=2, n_heads=2)
+S = 16
+DOC_LENS = (5, 9, 3, 16, 7, 2, 11, 6)
+
+# The pinned packed-vs-padded tolerance where reduction order differs
+# (einsum accumulation order over a packed block vs a padded row; the
+# per-position math is identical).  Documented in docs/tuning.md.
+TOL = 5e-4
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """ONE module-scoped packed fixture (tier-1 budget hygiene): the
+    ragged documents, their packing, and the padded twin batches."""
+    rng = np.random.RandomState(0)
+    docs = [
+        rng.randint(1, CFG.vocab, size=n).astype(np.int32)
+        for n in DOC_LENS
+    ]
+    pk = D.pack_documents(docs, S)
+    x, y = next(D.packed_batches(pk, pk.n_blocks))
+    xt, yt = next(D.padded_batches(docs, S, batch_rows=len(docs)))
+    return docs, pk, (x, y), (xt, yt)
+
+
+@pytest.fixture(scope="module")
+def model_and_params(corpus):
+    _, _, (x, _y), _ = corpus
+    layers = llama(CFG)
+    spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), x
+    )
+    params, state, _ = sequential_init(layers, jax.random.PRNGKey(0), spec)
+    return layers, params, state
+
+
+def _fwd(layers, params, state, x):
+    y, _ = sequential_apply(layers, params, state, x, rng=None, train=False)
+    return y
+
+
+def _doc_ref_losses(layers, params, state, docs):
+    """Each document alone: the unpacked pad-free oracle."""
+    out = []
+    for d in docs:
+        lg = _fwd(layers, params, state, jnp.asarray(d)[None, :])
+        logp = jax.nn.log_softmax(lg[:, :-1].astype(jnp.float32), -1)
+        ll = jnp.take_along_axis(
+            logp, jnp.asarray(d[1:])[None, :, None], -1
+        )[..., 0]
+        out.append(float(-jnp.mean(ll)))
+    return out
+
+
+def _seg_number(pk, doc_index):
+    """A document's segment id within its row (arrival order)."""
+    r, off, _ = pk.doc_locs[doc_index]
+    return sum(1 for rr, oo, _n in pk.doc_locs if rr == r and oo <= off)
+
+
+# --------------------------------------------------------------------- #
+# 1. packer invariants                                                  #
+# --------------------------------------------------------------------- #
+
+
+def test_packer_deterministic_and_whole(corpus):
+    docs, pk, _, _ = corpus
+    pk2 = D.pack_documents(docs, S)
+    for f in ("tokens", "segment_ids", "positions", "labels", "weights"):
+        np.testing.assert_array_equal(getattr(pk, f), getattr(pk2, f))
+    # Every document lands whole, positions reset per document, labels
+    # are the within-document shift.
+    for i, (r, off, n) in enumerate(pk.doc_locs):
+        np.testing.assert_array_equal(pk.tokens[r, off:off + n], docs[i])
+        np.testing.assert_array_equal(
+            pk.positions[r, off:off + n], np.arange(n)
+        )
+        np.testing.assert_array_equal(
+            pk.labels[r, off:off + n - 1], docs[i][1:]
+        )
+        assert pk.weights[r, off + n - 1] == 0.0  # last token: no label
+    # First-fit is greedy: no document could fit an EARLIER open block.
+    free = np.full((pk.n_blocks,), S)
+    for i, (r, off, n) in enumerate(pk.doc_locs):
+        assert all(free[:r] < n), f"doc {i} skipped a block with room"
+        free[r] -= n
+
+
+def test_packer_errors():
+    with pytest.raises(ValueError, match="never splits"):
+        D.pack_documents([np.arange(S + 1)], S)
+    with pytest.raises(ValueError, match="empty"):
+        D.pack_documents([np.arange(0)], S)
+    with pytest.raises(ValueError, match="block_len"):
+        D.pack_documents([np.arange(2)], 1)
+
+
+def test_packed_batches_resume_replays(corpus):
+    docs, pk, _, _ = corpus
+    full = list(D.packed_batches(pk, 2))
+    resumed = list(D.packed_batches(pk, 2, start=1))
+    assert len(resumed) == len(full) - 1
+    for (xa, ya), (xb, yb) in zip(full[1:], resumed):
+        jax.tree_util.tree_map(np.testing.assert_array_equal, xa, xb)
+        jax.tree_util.tree_map(np.testing.assert_array_equal, ya, yb)
+    # Fixed shapes: a short tail batch is padded with all-pad rows.
+    assert all(x["tokens"].shape == (2, S) for x, _ in full)
+
+
+def test_real_token_fraction(corpus):
+    docs, pk, (x, _y), (xt, _yt) = corpus
+    packed_frac = D.real_token_fraction(x)
+    assert packed_frac == pytest.approx(1.0 - pk.pad_fraction)
+    padded_frac = D.real_token_fraction(xt)
+    assert padded_frac == pytest.approx(
+        sum(DOC_LENS) / (len(DOC_LENS) * S)
+    )
+    assert packed_frac > padded_frac
+    # Interior pad_id tokens are NOT counted as pad (only trailing runs).
+    a = np.array([[0, 5, 0, 7], [1, 2, 0, 0]], np.int32)
+    assert D.real_token_fraction(a) == pytest.approx(6 / 8)
+
+
+# --------------------------------------------------------------------- #
+# 2. equivalence                                                        #
+# --------------------------------------------------------------------- #
+
+
+def test_packed_per_document_losses_match_unpacked(corpus, model_and_params):
+    """The tentpole gate at the model level: per-document losses from
+    the packed batch equal each document run alone — bitwise for
+    documents whose packed row accumulates in the same order (most),
+    within the pinned tolerance otherwise."""
+    docs, pk, (x, y), _ = corpus
+    layers, params, state = model_and_params
+    xj = {k: jnp.asarray(v) for k, v in x.items()}
+    logits = _fwd(layers, params, state, xj)
+    assert logits.shape == (pk.n_blocks, S, CFG.vocab)
+    max_seg = int(pk.segment_ids.max())
+    pls = np.asarray(per_document_losses(
+        logits, jax.tree_util.tree_map(jnp.asarray, y),
+        jnp.asarray(x["segment_ids"]), max_seg,
+    )).reshape(pk.n_blocks, max_seg)
+    refs = _doc_ref_losses(layers, params, state, docs)
+    for i, ref in enumerate(refs):
+        r, _, _ = pk.doc_locs[i]
+        got = pls[r, _seg_number(pk, i) - 1]
+        assert abs(got - ref) <= TOL, (i, got, ref)
+
+
+def test_packed_weighted_loss_weights_real_tokens(corpus, model_and_params):
+    """The cross-entropy reduction weights by real tokens, not block
+    size: the packed weighted mean equals the real-token-weighted mean
+    of the per-document losses — NOT the mean over block positions."""
+    docs, pk, (x, y), _ = corpus
+    layers, params, state = model_and_params
+    xj = {k: jnp.asarray(v) for k, v in x.items()}
+    yj = jax.tree_util.tree_map(jnp.asarray, y)
+    logits = _fwd(layers, params, state, xj)
+    got = float(packed_cross_entropy(logits, yj))
+    refs = _doc_ref_losses(layers, params, state, docs)
+    # Each document contributes len-1 supervised positions.
+    w = np.array([n - 1 for n in DOC_LENS], np.float64)
+    want = float(np.sum(np.array(refs) * w) / np.sum(w))
+    assert got == pytest.approx(want, abs=TOL)
+    # And the sum variant is the plain weighted sum (decomposes).
+    got_sum = float(packed_cross_entropy_sum(logits, yj))
+    assert got_sum == pytest.approx(want * np.sum(w), rel=1e-5)
+
+
+def test_packed_equivalence_gpipe(corpus):
+    """Both layouts of the same documents through the MPMD engine: the
+    real-token loss SUM agrees at the pinned tolerance and packed
+    gradients are finite."""
+    from torchgpipe_tpu import GPipe
+
+    docs, pk, (x, y), (xt, yt) = corpus
+    model = GPipe(llama(CFG), balance=[2, 2], chunks=2)
+    xj = {k: jnp.asarray(v) for k, v in x.items()}
+    yj = jax.tree_util.tree_map(jnp.asarray, y)
+    spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), xj
+    )
+    params, state = model.init(jax.random.PRNGKey(0), spec)
+    loss_pk, grads, _, _ = model.value_and_grad(
+        params, state, xj, yj, packed_cross_entropy_sum
+    )
+    loss_pd, _, _, _ = model.value_and_grad(
+        params, state, jnp.asarray(xt),
+        jax.tree_util.tree_map(jnp.asarray, yt), packed_cross_entropy_sum
+    )
+    assert abs(float(loss_pk) - float(loss_pd)) <= TOL * max(
+        1.0, abs(float(loss_pd))
+    )
+    for g in jax.tree_util.tree_leaves(grads):
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_packed_equivalence_spmd_except_last(corpus, cpu_devices):
+    """The SPMD engine under checkpoint='except_last': packed and
+    padded runs of the same documents agree on the real-token loss sum;
+    per-document losses through pipe.apply match the packed fixture."""
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    docs, pk, (x, y), (xt, yt) = corpus
+    block, pre, post = llama_spmd(CFG, 2)
+    mesh = make_mesh(2, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(
+        block, 2, mesh, chunks=2, loss_fn=packed_cross_entropy_sum,
+        pre=pre, post=post, loss_reduction="sum",
+        checkpoint="except_last",
+    )
+    xj = {k: jnp.asarray(v) for k, v in x.items()}
+    yj = jax.tree_util.tree_map(jnp.asarray, y)
+    spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), xj
+    )
+    params = pipe.init(jax.random.PRNGKey(0), spec)
+    loss_pk, grads = pipe.train_step(params, xj, yj)
+    loss_pd, _ = pipe.train_step(
+        params, jnp.asarray(xt),
+        jax.tree_util.tree_map(jnp.asarray, yt),
+    )
+    assert abs(float(loss_pk) - float(loss_pd)) <= TOL * max(
+        1.0, abs(float(loss_pd))
+    )
+    for g in jax.tree_util.tree_leaves(grads):
+        assert np.all(np.isfinite(np.asarray(g)))
+    # Per-document, through the engine's apply.
+    logits = pipe.apply(params, xj)
+    max_seg = int(pk.segment_ids.max())
+    pls = np.asarray(per_document_losses(
+        logits, yj, jnp.asarray(x["segment_ids"]), max_seg
+    )).reshape(pk.n_blocks, max_seg)
+    pad_logits = pipe.apply(params, jnp.asarray(xt))
+    logp = np.asarray(
+        jax.nn.log_softmax(np.asarray(pad_logits, np.float32), -1)
+    )
+    nll = -np.take_along_axis(
+        logp, np.asarray(yt["labels"])[..., None], 2
+    )[..., 0]
+    w = np.asarray(yt["weights"])
+    refs = (nll * w).sum(1) / np.maximum(w.sum(1), 1.0)
+    for i in range(len(docs)):
+        r, _, _ = pk.doc_locs[i]
+        got = pls[r, _seg_number(pk, i) - 1]
+        assert abs(got - refs[i]) <= TOL, (i, got, refs[i])
+
+
+@pytest.mark.slow
+def test_packed_equivalence_through_megastep(corpus, cpu_devices):
+    """megastep(K): K packed batches compiled into one donated-carry
+    scan produce the SAME per-batch losses as K padded runs of the same
+    documents through K single steps (sum reduction decomposes)."""
+    import optax
+
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    docs, pk, _, _ = corpus
+    K, B = 2, 2
+    packed = list(D.packed_batches(pk, B))[:K]
+    block, pre, post = llama_spmd(CFG, 2)
+    mesh = make_mesh(2, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(
+        block, 2, mesh, chunks=2, loss_fn=packed_cross_entropy_sum,
+        pre=pre, post=post, loss_reduction="sum",
+        checkpoint="except_last",
+    )
+    xj0 = jax.tree_util.tree_map(jnp.asarray, packed[0][0])
+    spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), xj0
+    )
+    params = pipe.init(jax.random.PRNGKey(0), spec)
+    opt = optax.sgd(1e-3)
+    stack = lambda trees: jax.tree_util.tree_map(  # noqa: E731
+        lambda *ls: jnp.stack([jnp.asarray(a) for a in ls]), *trees
+    )
+    xs = stack([x for x, _ in packed])
+    ys = stack([y for _, y in packed])
+    mega = pipe.make_train_step(opt, donate=False, megastep=K)
+    losses, p_mega, _, finite = mega(
+        params, pipe.place_tree(opt.init(params)), xs, ys
+    )
+    assert bool(np.all(np.asarray(finite)))
+    single = pipe.make_train_step(opt, donate=False, megastep=1)
+    p, s = params, pipe.place_tree(opt.init(params))
+    for k in range(K):
+        loss_k, p, s = single(
+            p, s,
+            jax.tree_util.tree_map(jnp.asarray, packed[k][0]),
+            jax.tree_util.tree_map(jnp.asarray, packed[k][1]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(losses)[k], np.asarray(loss_k)
+        )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        p_mega, p,
+    )
+
+
+def test_packed_learned_positions_guard_max_pos(corpus):
+    """GPT-2-class learned positions: a packed block longer than the
+    table is a didactic error (jnp.take would silently clamp), and a
+    fitting block gathers per-token within-document rows."""
+    from torchgpipe_tpu.models.transformer import token_embedding
+
+    _, pk, (x, _y), _ = corpus
+    xj = {k: jnp.asarray(v) for k, v in x.items()}
+    good = TransformerConfig(
+        vocab=CFG.vocab, dim=16, n_layers=2, n_heads=2,
+        pos_emb="learned", max_pos=S,
+    )
+    emb = token_embedding(good)
+    params, state = emb.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((1, S), jnp.int32)
+    )
+    (h, seg, pos), _ = emb.apply(params, state, xj)
+    # Row 0 starts a document at offset 0: its embedding equals the
+    # unpacked lookup of the same tokens (positions 0..len-1 agree).
+    r, off, n = pk.doc_locs[0]
+    plain, _ = emb.apply(
+        params, state, jnp.asarray(x["tokens"][r:r + 1, :n])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(h[r, :n]), np.asarray(plain[0])
+    )
+    short = dataclasses_replace_max_pos(good, S - 4)
+    emb2 = token_embedding(short)
+    p2, s2 = emb2.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((1, S), jnp.int32)
+    )
+    with pytest.raises(ValueError, match="max_pos"):
+        emb2.apply(p2, s2, xj)
+
+
+def dataclasses_replace_max_pos(cfg, max_pos):
+    import dataclasses
+
+    return dataclasses.replace(cfg, max_pos=max_pos)
+
+
+def test_chunked_lm_loss_packed_targets(corpus):
+    """The fused chunked-vocab loss layer honors the packed target
+    contract: zero-weight positions cannot move the loss, and uniform
+    weights reproduce the plain (unweighted) row means."""
+    from torchgpipe_tpu.models.transformer import chunked_lm_loss
+
+    _, pk, (x, y), _ = corpus
+    layer = chunked_lm_loss(CFG, chunk=16)
+    params, _ = layer.init(
+        jax.random.PRNGKey(3),
+        jax.ShapeDtypeStruct((pk.n_blocks, S, CFG.dim), jnp.float32),
+    )
+    h = jax.random.normal(
+        jax.random.PRNGKey(4), (pk.n_blocks, S, CFG.dim)
+    )
+    yj = jax.tree_util.tree_map(jnp.asarray, y)
+    row_loss = layer.meta["row_loss"]
+    base = np.asarray(row_loss(params, (), (h, yj)))
+    # Zero-weight positions are dead: scrambling their labels changes
+    # nothing.
+    scrambled = dict(
+        yj,
+        labels=jnp.where(
+            yj["weights"] > 0, yj["labels"],
+            (yj["labels"] + 7) % CFG.vocab,
+        ),
+    )
+    np.testing.assert_array_equal(
+        base, np.asarray(row_loss(params, (), (h, scrambled)))
+    )
+    # Uniform weights == the plain unweighted row mean; the packed
+    # activation TUPLE is accepted too.
+    uniform = dict(yj, weights=jnp.ones_like(yj["weights"]))
+    np.testing.assert_allclose(
+        np.asarray(row_loss(params, (), (h, uniform))),
+        np.asarray(row_loss(params, (), (h, yj["labels"]))),
+        rtol=1e-6,
+    )
+    seg = jnp.asarray(x["segment_ids"])
+    pos = jnp.asarray(x["positions"])
+    np.testing.assert_array_equal(
+        base, np.asarray(row_loss(params, (), ((h, seg, pos), yj)))
+    )
+
+
+# --------------------------------------------------------------------- #
+# 3. segment-aware cache attention (generation hooks)                   #
+# --------------------------------------------------------------------- #
+
+
+def test_attend_full_segments_equal_separate_docs():
+    """A packed 2-document row through the dense prefill attention
+    equals each document attended alone — the block-diagonal term."""
+    from torchgpipe_tpu.models.generation import _attend_full
+
+    rng = jax.random.PRNGKey(1)
+    n1, n2, nh, hd = 5, 7, 2, 4
+    s = n1 + n2
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (1, s, nh, hd))
+    k = jax.random.normal(kk, (1, s, nh, hd))
+    v = jax.random.normal(kv, (1, s, nh, hd))
+    seg = jnp.asarray([[1] * n1 + [2] * n2])
+    packed = _attend_full(q, k, v, None, use_flash=False, seg=seg)
+    a1 = _attend_full(
+        q[:, :n1], k[:, :n1], v[:, :n1], None, use_flash=False
+    )
+    a2 = _attend_full(
+        q[:, n1:], k[:, n1:], v[:, n1:], None, use_flash=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(packed), np.asarray(jnp.concatenate([a1, a2], 1)),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_attend_chunk_segments_equal_separate_docs():
+    """_attend_chunk with segment planes: queries of document 2 read
+    only document 2's cache rows (and the flash path is refused)."""
+    from torchgpipe_tpu.models.generation import _attend_chunk
+
+    rng = jax.random.PRNGKey(2)
+    n1, n2, nh, hd, L = 4, 3, 2, 4, 16
+    kq, kk, kv = jax.random.split(rng, 3)
+    q2 = jax.random.normal(kq, (1, n2, nh, hd))
+    cache_k = jnp.zeros((1, L, nh, hd))
+    cache_v = jnp.zeros((1, L, nh, hd))
+    k1 = jax.random.normal(kk, (1, n1 + n2, nh, hd))
+    v1 = jax.random.normal(kv, (1, n1 + n2, nh, hd))
+    cache_k = cache_k.at[:, :n1 + n2].set(k1)
+    cache_v = cache_v.at[:, :n1 + n2].set(v1)
+    seg_k = jnp.asarray([[1] * n1 + [2] * n2 + [0] * (L - n1 - n2)])
+    seg_q = jnp.full((1, n2), 2)
+    # Packed: doc-2 queries at positions n1..n1+n2-1 against the shared
+    # cache, segment-masked.
+    got = _attend_chunk(
+        q2, cache_k, cache_v, jnp.asarray(n1), None,
+        use_flash=False, seg_q=seg_q, seg_k=seg_k,
+    )
+    # Oracle: doc 2 alone in its own cache at positions 0..n2-1.
+    ck2 = jnp.zeros((1, L, nh, hd)).at[:, :n2].set(k1[:, n1:])
+    cv2 = jnp.zeros((1, L, nh, hd)).at[:, :n2].set(v1[:, n1:])
+    ref = _attend_chunk(
+        q2, ck2, cv2, jnp.asarray(0), None, use_flash=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-6
+    )
+    with pytest.raises(ValueError, match="segment-mask hook"):
+        _attend_chunk(
+            q2, cache_k, cache_v, jnp.asarray(n1), None,
+            use_flash=True, seg_q=seg_q, seg_k=seg_k,
+        )
+
+
+def test_packed_attention_rejects_sp_axis(corpus, cpu_devices):
+    """Packed batches + a bound sp axis is a didactic error, not silent
+    shard-local segment masking."""
+    from torchgpipe_tpu.parallel.ring_attention import attention
+
+    def body(q, k, v, seg):
+        return attention(q, k, v, axis_name="sp", seg=seg)
+
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.array(cpu_devices[:2]), ("sp",))
+    q = jnp.zeros((1, 4, 2, 4))
+    seg = jnp.ones((1, 4), jnp.int32)
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"),
+                  P(None, "sp")),
+        out_specs=P(None, "sp"),
+    )
+    with pytest.raises(ValueError, match="sequence-parallel"):
+        fn(q, q, q, seg)
+
+
+# --------------------------------------------------------------------- #
+# 4. honest accounting                                                  #
+# --------------------------------------------------------------------- #
+
+
+def test_measured_mfu_padded_below_packed(corpus):
+    """The regression gate: on the SAME documents at identical step
+    times, the padded run's measured MFU lands BELOW the packed run's —
+    pad tokens are no longer priced as useful work."""
+    from torchgpipe_tpu import obs
+
+    docs, pk, (x, _y), (xt, _yt) = corpus
+
+    class Tick:
+        """Injected clock: a step over B blocks takes B time units —
+        the hardware bills by traced shape, not by useful tokens."""
+
+        def __init__(self, per_step):
+            self.t, self.per_step = 0.0, per_step
+
+        def __call__(self):
+            self.t += self.per_step
+            return self.t
+
+    per_block = 1e6  # traced FLOPs per [B, S] block: layout-independent
+
+    def mfu_of(sample, blocks, real_token_fraction):
+        rep = obs.StepReporter(
+            flops_per_step=per_block * blocks, peak_flops=1e6,
+            clock=Tick(blocks),
+            real_token_fraction=real_token_fraction,
+        )
+        rep.step()
+        rep.step()
+        return rep.summary()["measured_mfu"]
+
+    packed_frac = D.real_token_fraction(x)
+    padded_frac = D.real_token_fraction(xt)
+    packed_mfu = mfu_of(x, pk.n_blocks, packed_frac)
+    padded_mfu = mfu_of(xt, len(docs), padded_frac)
+    # The regression: WITHOUT the real-token scale both layouts report
+    # identical MFU (pad arithmetic priced as work)…
+    assert mfu_of(x, pk.n_blocks, 1.0) == pytest.approx(
+        mfu_of(xt, len(docs), 1.0)
+    )
+    # …with it, the padded layout's MFU is pinned BELOW the packed one
+    # in exactly the ratio of their pad fractions.
+    assert padded_mfu < packed_mfu
+    assert padded_mfu / packed_mfu == pytest.approx(
+        padded_frac / packed_frac, rel=1e-6
+    )
+
+
+def test_measured_step_flops_real_fraction():
+    from torchgpipe_tpu import obs
+
+    def step(a):
+        return a @ a
+
+    x = jnp.zeros((16, 16))
+    full = obs.measured_step_flops(step, x)
+    half = obs.measured_step_flops(step, x, real_token_fraction=0.5)
+    assert full is not None and half == pytest.approx(full * 0.5)
+    with pytest.raises(ValueError, match="real_token_fraction"):
+        obs.measured_step_flops(step, x, real_token_fraction=1.5)
+
+
+def test_reconcile_report_useful_busy_fraction():
+    from torchgpipe_tpu.obs.reconciliation import ReconcileReport
+
+    base = dict(
+        graph=None, coverage=1.0, matched={}, unmatched_spans=[],
+        unmeasured_cells=[], measured_makespan=1.0, measured_bubble=0.2,
+        predicted_makespan=1.0, predicted_bubble=0.2, stage_busy={},
+        wall_span=1.0, dispatch_only=False, step_spans=0,
+    )
+    r = ReconcileReport(**base, real_token_fraction=0.5)
+    assert r.useful_busy_fraction == pytest.approx(0.4)
+    assert ReconcileReport(**base).useful_busy_fraction == pytest.approx(0.8)

@@ -447,6 +447,109 @@ def test_lint_serving_catches_request_sized_buffer(flat_params):
 
 
 # --------------------------------------------------------------------- #
+# prefill bucket ladder                                                 #
+# --------------------------------------------------------------------- #
+
+
+def test_scheduler_bucket_selection():
+    """bucket_for / prefill_bucket: smallest covering bucket; oversized
+    work caps at the ladder max; a bare int stays the classic single
+    chunk."""
+    from torchgpipe_tpu.serving.cache_pool import CachePool
+    from torchgpipe_tpu.serving.scheduler import (
+        Request,
+        Scheduler,
+        normalize_buckets,
+    )
+
+    assert normalize_buckets(8) == (8,)
+    assert normalize_buckets([8, 2, 4, 2, 1]) == (1, 2, 4, 8)
+    with pytest.raises(ValueError, match=">= 1"):
+        normalize_buckets([0, 4])
+
+    pool = CachePool(CFG, 4, 32)
+    sched = Scheduler(pool, prefill_chunk=(2, 4, 16))
+    assert sched.prefill_chunk == 16          # classic attr = ladder max
+    assert [sched.bucket_for(n) for n in (1, 2, 3, 4, 5, 16, 99)] == [
+        2, 2, 4, 4, 16, 16, 16
+    ]
+    # Step bucket covers the LARGEST pending chunk across slots.
+    for rid, plen in (("a", 2), ("b", 7)):
+        r = Request(rid=rid, prompt=np.zeros(plen, np.int32),
+                    max_new_tokens=2)
+        sched.submit(r)
+    sched.admit()
+    assert sched.prefill_bucket() == 16
+
+
+def test_ladder_compile_counter_zero_retrace(flat_params):
+    """The ladder's dynamic proof: a request mix exercising EVERY
+    bucket compiles each bucket's program EXACTLY once (plus decode) —
+    zero retraces across churn — and outputs stay exact vs generate."""
+    eng = Engine(CFG, flat_params, num_slots=3, max_len=32,
+                 prefill_chunk=(1, 2, 4, 8))
+    assert eng.program_count == 5
+    # Served one at a time so each prompt length picks its own bucket:
+    # 1 -> 1, 2 -> 2, 3 -> 4, 7 -> 8, 12 -> 8 then remainder buckets.
+    mix = [(1, 2), (2, 2), (3, 2), (7, 2), (12, 3)]
+    rng = np.random.RandomState(5)
+    results = []
+    for plen, new in mix:
+        prompt = rng.randint(0, 64, (plen,)).astype(np.int32)
+        rid = eng.submit(prompt, new)
+        eng.run()
+        results.append((rid, prompt, new))
+    first = dict(eng.compile_stats)
+    assert set(first) == {
+        "prefill@1", "prefill@2", "prefill@4", "prefill@8", "decode"
+    }
+    assert all(v == 1 for v in first.values()), first
+    # Second pass over the same mix (staggered this time): ZERO new
+    # traces.
+    for plen, new in mix:
+        prompt = rng.randint(0, 64, (plen,)).astype(np.int32)
+        results.append((eng.submit(prompt, new), prompt, new))
+    eng.run()
+    assert eng.compile_stats == first
+    for rid, prompt, new in results:
+        ref = _ref(flat_params, prompt, new)
+        assert eng.result(rid).tolist() == ref.tolist(), rid
+
+
+def test_certify_ladder_clean_and_bound(flat_params):
+    """certify_ladder: the exhaustive pending-chunk walk certifies the
+    declared bound (INFO), and a scheduler whose bucket choice escapes
+    the ladder is an ERROR."""
+    from torchgpipe_tpu.analysis.diagnostics import Severity
+    from torchgpipe_tpu.analysis.serving import certify_ladder
+
+    eng = Engine(CFG, flat_params, num_slots=3, max_len=24,
+                 prefill_chunk=(1, 4))
+    fs = certify_ladder(eng)
+    assert [f.severity for f in fs] == [Severity.INFO]
+    assert "3" in fs[0].message  # len(ladder)+1 programs
+
+    eng.scheduler.bucket_for = lambda n: n  # the bug: request-sized
+    fs = certify_ladder(eng)
+    errors = [f for f in fs if f.severity == Severity.ERROR]
+    assert errors and errors[0].rule == "ladder-bound"
+
+
+def test_lint_serving_clean_with_ladder(flat_params):
+    """The full serve-verify lint over a ladder engine: zero WARNING+
+    findings (every bucket's program traces, no host callbacks, churn
+    stays inside the declared signatures)."""
+    from torchgpipe_tpu.analysis import lint_serving
+    from torchgpipe_tpu.analysis.diagnostics import Severity
+
+    eng = Engine(CFG, flat_params, num_slots=3, max_len=24,
+                 prefill_chunk=(2, 4))
+    findings = lint_serving(eng, grid=[(2, 4), (9, 8), (1, 1)])
+    worst = [f for f in findings if f.severity >= Severity.WARNING]
+    assert not worst, [f.format() for f in findings]
+
+
+# --------------------------------------------------------------------- #
 # soak (slow tier)                                                      #
 # --------------------------------------------------------------------- #
 
@@ -474,6 +577,32 @@ def test_serving_soak_churn(flat_params):
             eng.step()
     eng.run()
     assert eng.compile_stats == {"prefill": 1, "decode": 1}
+    for rid, (prompt, new) in live.items():
+        got = eng.result(rid)
+        assert got.tolist() == _ref(flat_params, prompt, new).tolist(), rid
+
+
+@pytest.mark.slow
+def test_serving_soak_ragged_ladder(flat_params):
+    """Ragged bursty churn through a LADDER engine: the program count
+    stays at the certified bound (each bucket traced at most once) and
+    every output stays exact."""
+    rng = np.random.RandomState(23)
+    eng = Engine(CFG, flat_params, num_slots=4, max_len=32,
+                 prefill_chunk=(1, 2, 4, 8))
+    live = {}
+    for i in range(30):
+        prompt = rng.randint(0, 64, (int(rng.randint(1, 17)),)).astype(
+            np.int32
+        )
+        new = int(rng.randint(1, 9))
+        live[eng.submit(prompt, new)] = (prompt, new)
+        for _ in range(int(rng.randint(0, 4))):
+            eng.step()
+    eng.run()
+    stats = eng.compile_stats
+    assert sum(stats.values()) <= eng.program_count, stats
+    assert all(v <= 1 for v in stats.values()), stats
     for rid, (prompt, new) in live.items():
         got = eng.result(rid)
         assert got.tolist() == _ref(flat_params, prompt, new).tolist(), rid

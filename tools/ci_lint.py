@@ -54,12 +54,21 @@ differently and must not share backend state):
    resolved specs name only existing mesh axes, the propagated block
    layout induces no implicit reshard, and the 3D planner's TOP
    (dp × tp × pp) plan re-verifies at its widths with per-device
-   memory under budget (docs/analysis.md, sharding section).
+   memory under budget (docs/analysis.md, sharding section);
+9. ``tools/pack_verify.py`` (pack-verify) — the sequence-packing +
+   bucket-ladder contract: the deterministic packer's invariants
+   (replay, no document split, resume), the ``pad-waste`` lint rule
+   firing on a padded concrete batch and standing down on the packed
+   one (which must lint fully clean), packed-vs-padded loss-sum
+   equivalence at the pinned tolerance, and the prefill bucket
+   ladder's ``len(ladder)+1`` program-count bound certified by
+   ``analysis.serving`` (docs/tuning.md packing section,
+   docs/serving.md ladder section).
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
 / ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` /
-``--skip-postmortem`` / ``--skip-sharding`` to run a subset, ``-v`` for
-per-target reports.
+``--skip-postmortem`` / ``--skip-sharding`` / ``--skip-pack`` to run a
+subset, ``-v`` for per-target reports.
 """
 
 from __future__ import annotations
@@ -93,6 +102,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-trace", action="store_true")
     ap.add_argument("--skip-postmortem", action="store_true")
     ap.add_argument("--skip-sharding", action="store_true")
+    ap.add_argument("--skip-pack", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -161,6 +171,13 @@ def main(argv: Sequence[str] | None = None) -> int:
             "--ci",
         ]
         failures += _run("sharding-verify", cmd) != 0
+    if not args.skip_pack:
+        cmd = [
+            sys.executable, str(REPO / "tools" / "pack_verify.py"),
+        ]
+        if args.verbose:
+            cmd.append("-v")
+        failures += _run("pack-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""pack-verify: the sequence-packing + bucket-ladder CI gate.
+
+Step 9 of ``tools/ci_lint.py``.  Certifies, on CPU, in seconds:
+
+1. **Packer invariants** — deterministic greedy first-fit
+   (``utils.data.pack_documents``): re-packing replays bit-identically,
+   no document is split across blocks, every document lands whole, and
+   ``packed_batches(start=k)`` resumes bit-identically to the tail of
+   the full stream.
+2. **pad-waste lint, broken + fixed** — a packing-capable tiny llama
+   linted on a concretely ~50%-padded batch must WARN (the rule's
+   reason to exist), and the SAME pipeline on the packed batch must
+   lint fully clean (the rule stands down on ``segment_ids``; the
+   packed activation tuple traces through every other rule).
+3. **Packed-vs-padded equivalence** — the same documents through the
+   same pipeline, packed and padded, must agree on the real-token loss
+   sum within the pinned tolerance (the bitwise per-document version
+   lives in tests/test_packing.py).
+4. **Ladder program-count bound** — a bucket-ladder serving engine
+   (``prefill_chunk=(1, 2, 4, 8)``) must pass ``lint_serving`` with
+   zero WARNING+ findings, including :func:`analysis.serving.
+   certify_ladder`'s exhaustive pending-chunk walk, at exactly
+   ``len(ladder) + 1`` declared programs.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+from typing import List, Optional, Sequence
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import jax  # noqa: E402
+
+if os.environ.get("TGPU_LINT_ON_BACKEND") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _fail(msg: str) -> int:
+    print(f"[pack-verify] FAIL: {msg}")
+    return 1
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    from torchgpipe_tpu import GPipe, analysis
+    from torchgpipe_tpu.analysis.diagnostics import Severity
+    from torchgpipe_tpu.analysis.serving import lint_serving
+    from torchgpipe_tpu.layers import sequential_init
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        llama,
+        packed_cross_entropy_sum,
+    )
+    from torchgpipe_tpu.serving import Engine
+    from torchgpipe_tpu.utils import data as D
+
+    rc = 0
+    S = 16
+    rng = np.random.RandomState(0)
+    docs = [
+        rng.randint(1, 37, size=int(rng.randint(2, S + 1))).astype(np.int32)
+        for _ in range(16)
+    ]
+
+    # 1. packer invariants ------------------------------------------------
+    pk = D.pack_documents(docs, S)
+    pk2 = D.pack_documents(docs, S)
+    if not all(
+        np.array_equal(getattr(pk, f), getattr(pk2, f))
+        for f in ("tokens", "segment_ids", "positions", "labels", "weights")
+    ):
+        rc |= _fail("packing is not deterministic")
+    for i, (r, off, n) in enumerate(pk.doc_locs):
+        if not np.array_equal(pk.tokens[r, off:off + n], docs[i]):
+            rc |= _fail(f"document {i} not placed whole")
+    full = list(D.packed_batches(pk, 2))
+    resumed = list(D.packed_batches(pk, 2, start=1))
+    for (xa, ya), (xb, yb) in zip(full[1:], resumed):
+        for k in xa:
+            if not np.array_equal(xa[k], xb[k]):
+                rc |= _fail(f"resume does not replay batch plane {k}")
+    print(f"[pack-verify] packer: {pk.n_blocks} blocks, "
+          f"pad fraction {pk.pad_fraction:.0%}, deterministic, "
+          "resume replays")
+
+    # 2. pad-waste broken + fixed ----------------------------------------
+    cfg = TransformerConfig(vocab=37, dim=16, n_layers=4, n_heads=2)
+    model = GPipe(llama(cfg), balance=[3, 3], chunks=2)
+    (xt, yt), = list(D.padded_batches(docs, S, batch_rows=len(docs)))
+    broken = analysis.lint(
+        model, jnp.asarray(xt),
+        target=jax.tree_util.tree_map(jnp.asarray, yt),
+        loss_fn=packed_cross_entropy_sum,
+    )
+    if not any(f.rule == "pad-waste" for f in broken):
+        rc |= _fail("pad-waste did not fire on a ~50%-padded batch")
+    x, y = next(D.packed_batches(pk, pk.n_blocks))
+    xj = {k: jnp.asarray(v) for k, v in x.items()}
+    yj = jax.tree_util.tree_map(jnp.asarray, y)
+    fixed = analysis.lint(
+        model, xj, target=yj, loss_fn=packed_cross_entropy_sum
+    )
+    if fixed:
+        for f in fixed:
+            print(f.format())
+        rc |= _fail("packed example does not lint clean")
+    print("[pack-verify] pad-waste: fires padded, stands down packed; "
+          "packed pipeline lints clean")
+
+    # 3. packed-vs-padded loss-sum equivalence ---------------------------
+    spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), xj
+    )
+    params, state = model.init(jax.random.PRNGKey(0), spec)
+    loss_pk, _, _, _ = model.value_and_grad(
+        params, state, xj, yj, packed_cross_entropy_sum
+    )
+    loss_pd, _, _, _ = model.value_and_grad(
+        params, state, jnp.asarray(xt),
+        jax.tree_util.tree_map(jnp.asarray, yt), packed_cross_entropy_sum
+    )
+    diff = abs(float(loss_pk) - float(loss_pd))
+    tol = 5e-4 * max(1.0, abs(float(loss_pd)))
+    if diff > tol:
+        rc |= _fail(
+            f"packed loss sum {float(loss_pk)} != padded "
+            f"{float(loss_pd)} (diff {diff:.2e} > {tol:.2e})"
+        )
+    print(f"[pack-verify] equivalence: |packed - padded| = {diff:.2e} "
+          f"over {int(np.sum(y['weights']))} real tokens")
+
+    # 4. ladder program-count bound --------------------------------------
+    scfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+    )
+    sparams, _, _ = sequential_init(
+        llama(scfg), jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((2, 8), jnp.int32),
+    )
+    ladder = (1, 2, 4, 8)
+    eng = Engine(
+        scfg, sparams, num_slots=4, max_len=48, prefill_chunk=ladder
+    )
+    findings: List = lint_serving(eng)
+    worst = [f for f in findings if f.severity >= Severity.WARNING]
+    if worst or eng.program_count != len(ladder) + 1:
+        for f in findings:
+            print(f.format())
+        rc |= _fail("ladder engine failed certification")
+    if args.verbose:
+        for f in findings:
+            print(f.format())
+    print(f"[pack-verify] ladder {ladder}: {eng.program_count} programs "
+          "statically certified, lint clean")
+
+    print(f"[pack-verify] {'clean' if rc == 0 else 'FAILED'}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

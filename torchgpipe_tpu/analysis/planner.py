@@ -514,6 +514,7 @@ def _plan_spmd(
     zero_options: Optional[Sequence[bool]],
     overhead_bytes: int,
     param_scale: float,
+    real_token_fraction: float = 1.0,
 ) -> PlanReport:
     from torchgpipe_tpu import tune
     from torchgpipe_tpu.analysis import sharding as shd
@@ -532,6 +533,11 @@ def _plan_spmd(
         tune._model_flops(plain_step, params_spec, x_spec, tgt_spec)
         if plain_step is not None else None
     )
+    # real_token_fraction scales ONLY the MFU numerator at the scoring
+    # site below: the pad FLOPs still execute, so lane-time models
+    # (lane_flops epilogue) keep the full traced figure — scaling them
+    # would shrink predicted lane time non-uniformly across candidates
+    # and could reorder the frontier.
     stage_params_spec = (
         jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
@@ -869,7 +875,16 @@ def _plan_spmd(
                                         + tune.DISPATCH_OVERHEAD_FLOPS / K
                                     )
                                     if lane > 0:
-                                        mfu = model_flops / (n_chips * lane)
+                                        # Ragged-data honesty: only the
+                                        # real-token fraction of the
+                                        # traced flops is useful work (a
+                                        # uniform numerator scale —
+                                        # ranking unchanged).
+                                        mfu = (
+                                            model_flops
+                                            * real_token_fraction
+                                            / (n_chips * lane)
+                                        )
                                 plans.append(Plan(
                                     engine="spmd", schedule=schedule,
                                     balance=None,
@@ -929,6 +944,7 @@ def _plan_mpmd(
     balance_options: Optional[Sequence[Sequence[int]]],
     overhead_bytes: int,
     param_scale: float,
+    real_token_fraction: float = 1.0,
 ) -> PlanReport:
     from torchgpipe_tpu import tune
     from torchgpipe_tpu.balance import layer_flops
@@ -942,7 +958,9 @@ def _plan_mpmd(
         layer_fb: Optional[List[float]] = layer_flops(pipe.layers, x_spec)
     except Exception:  # noqa: BLE001 - scoring degrades, memory still runs
         layer_fb = None
-    model_flops = sum(layer_fb) if layer_fb else None
+    model_flops = (
+        sum(layer_fb) * real_token_fraction if layer_fb else None
+    )
     balances = _mpmd_balance_options(pipe, balance_options, layer_fb)
     schedules = ["gpipe"]
     if pipe.schedule == "1f1b" or pipe.loss_reduction in ("mean", "sum"):
@@ -1086,10 +1104,19 @@ def plan(
     zero_options: Optional[Sequence[bool]] = None,
     overhead_bytes: Optional[int] = None,
     param_scale: Optional[float] = None,
+    real_token_fraction: float = 1.0,
 ) -> PlanReport:
     """Search balance × schedule × chunks × remat × dispatch granularity
     × (dp, tp) mesh width × ZeRO statically and return the certified
     frontier.
+
+    ``real_token_fraction`` (``utils.data.real_token_fraction`` of the
+    training batches) keeps predicted MFU honest on ragged data: the
+    analytic FLOPs price the traced (padded) shapes, so only this
+    fraction counts as useful work.  A uniform scale — it never changes
+    candidate RANKING, only the reported ``predicted_mfu``; pack the
+    corpus (``utils.data.pack_documents``) to move the fraction toward
+    1 and the real MFU with it.
 
     ``megastep_options`` / ``steps`` control the SPMD dispatch axis:
     megastep K candidates (default :data:`MEGASTEP_SPACE`) filtered to
@@ -1131,12 +1158,18 @@ def plan(
     scale = (
         tune.DEFAULT_PARAM_SCALE if param_scale is None else param_scale
     )
+    if not 0.0 <= real_token_fraction <= 1.0:
+        raise ValueError(
+            f"real_token_fraction must be in [0, 1], got "
+            f"{real_token_fraction}"
+        )
     if isinstance(pipe, GPipe):
         return _plan_mpmd(
             pipe, batch, hbm_budget_bytes,
             chunks_options=chunks_options,
             balance_options=balance_options,
             overhead_bytes=overhead, param_scale=scale,
+            real_token_fraction=real_token_fraction,
         )
     return _plan_spmd(
         pipe, batch, hbm_budget_bytes, target=target,
@@ -1144,6 +1177,7 @@ def plan(
         megastep_opts=megastep_options, steps=steps,
         mesh_options=mesh_options, zero_options=zero_options,
         overhead_bytes=overhead, param_scale=scale,
+        real_token_fraction=real_token_fraction,
     )
 
 

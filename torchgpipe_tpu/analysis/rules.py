@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from torchgpipe_tpu.analysis import jaxpr as jx
@@ -285,6 +286,111 @@ def _check_recompilation(trace: PipelineTrace) -> List[Finding]:
             "this automatically)"
         ),
     )]
+
+
+# --------------------------------------------------------------------- #
+# pad-waste                                                             #
+# --------------------------------------------------------------------- #
+
+# Fraction of batch positions allowed to be trailing pad before the rule
+# fires.  The threshold is deliberately generous: below it, packing's
+# win rarely beats its (small) masking overhead.
+PAD_WASTE_THRESHOLD = 0.25
+# The default pad id probed first; the rule ALSO probes the batch's own
+# most-common final-column token (tokenizers pad with eos or a dedicated
+# nonzero id — hardcoding 0 would silently stand down on those corpora).
+PAD_WASTE_PAD_ID = 0
+
+
+def _walk_layer_kinds(obj: Any, out: set, depth: int = 0) -> None:
+    """Collect ``meta['kind']`` strings from a Layer, following compound
+    chains (``meta['children']``)."""
+    if depth > 8 or obj is None:
+        return
+    meta = getattr(obj, "meta", None)
+    if isinstance(meta, dict):
+        kind = meta.get("kind")
+        if isinstance(kind, str):
+            out.add(kind)
+        for child in meta.get("children", ()) or ():
+            _walk_layer_kinds(child, out, depth + 1)
+
+
+def _packing_capable(trace: PipelineTrace) -> bool:
+    """True when the model can consume a packed batch: it is built from
+    transformer blocks (segment-aware attention lives there), so the
+    fix for a pad-heavy batch is ``utils.data.pack_documents``, not a
+    model change."""
+    kinds: set = set()
+    pipe = trace.pipe
+    for attr in ("block", "pre", "post"):
+        _walk_layer_kinds(getattr(pipe, attr, None), kinds)
+    for stage_layers in (getattr(pipe, "layers", None) or ()):
+        _walk_layer_kinds(stage_layers, kinds)
+    return "transformer_block" in kinds
+
+
+def _check_pad_waste(trace: PipelineTrace) -> List[Finding]:
+    """WARNING when the traced step's CONCRETE batch carries a trailing-
+    pad fraction above :data:`PAD_WASTE_THRESHOLD` and the model is
+    packing-capable — every pad position bills full attention/MLP FLOPs
+    for zero gradient signal.  Stands down when ``segment_ids`` are
+    present (the batch IS packed), when the sample is abstract (shapes
+    carry no values), and on non-transformer models."""
+    x = trace.x_sample
+    if x is None:
+        return []
+    if isinstance(x, dict) and "segment_ids" in x:
+        return []  # packed batch: the fix is already applied
+    leaves = [
+        leaf for leaf in jax.tree_util.tree_leaves(x)
+        if (
+            hasattr(leaf, "dtype") and hasattr(leaf, "shape")
+            and not isinstance(leaf, jax.ShapeDtypeStruct)
+            and not isinstance(leaf, jax.core.Tracer)
+            and getattr(leaf, "ndim", 0) == 2
+            and jnp.issubdtype(leaf.dtype, jnp.integer)
+        )
+    ]
+    if not leaves or not _packing_capable(trace):
+        return []
+    import numpy as np
+
+    from torchgpipe_tpu.utils.data import real_token_fraction
+
+    out: List[Finding] = []
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        if a.size == 0:
+            continue
+        # Candidate pad ids: the declared default plus the batch's own
+        # most-common final-column value (eos-padded corpora).  ONE
+        # definition of "trailing pad" shared with the MFU scale.
+        last = a[:, -1]
+        vals, counts = np.unique(last, return_counts=True)
+        candidates = {PAD_WASTE_PAD_ID, int(vals[np.argmax(counts)])}
+        frac, pad_id = max(
+            (1.0 - real_token_fraction(a, pad_id=c), c)
+            for c in candidates
+        )
+        if frac > PAD_WASTE_THRESHOLD:
+            out.append(Finding(
+                rule="pad-waste",
+                severity=Severity.WARNING,
+                path="batch",
+                message=(
+                    f"{frac:.0%} of the sample batch's {a.shape} token "
+                    f"positions are trailing pad (pad id {pad_id}) — "
+                    "every one bills full attention/MLP FLOPs for zero "
+                    "gradient signal, and this model is "
+                    "packing-capable: pack the corpus with "
+                    "utils.data.pack_documents (segment-aware "
+                    "attention masks + per-document position resets; "
+                    "docs/tuning.md, packing section)"
+                ),
+            ))
+            break  # one finding per batch, not per token plane
+    return out
 
 
 # --------------------------------------------------------------------- #
@@ -589,6 +695,15 @@ RULES: List[Rule] = [
         "micro-batches must share one shape signature (one compiled "
         "program per stage)",
         _check_recompilation,
+    ),
+    Rule(
+        "pad-waste",
+        "a packing-capable model's concrete sample batch should not "
+        "carry a trailing-pad fraction above the threshold — pack the "
+        "corpus (utils.data.pack_documents) instead of billing pad "
+        "FLOPs; stands down when segment_ids are present or the sample "
+        "is abstract",
+        _check_pad_waste,
     ),
     Rule(
         "host-sync-in-loop",

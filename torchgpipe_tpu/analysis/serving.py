@@ -1,18 +1,26 @@
 """Static verification of the serving engine's steady-state contract.
 
-The serving engine promises exactly TWO compiled programs under
-arbitrary request churn (``docs/serving.md``).  The dynamic half of the
-proof is the compile-counter test in ``tests/test_serving.py``; this
-module is the STATIC half, the serving twin of ``tools/pipeline_lint``:
+The serving engine promises a STATICALLY BOUNDED compiled-program count
+under arbitrary request churn (``docs/serving.md``): one prefill
+program per declared ladder bucket plus one decode program —
+``len(ladder) + 1`` total (the classic single-chunk engine is the
+2-program special case).  The dynamic half of the proof is the
+compile-counter test in ``tests/test_serving.py``; this module is the
+STATIC half, the serving twin of ``tools/pipeline_lint``:
 
 * **recompilation-hazard** — drive a request-churn grid (ragged prompt
   lengths, token budgets, arrival patterns) through the engine's OWN
   input-spec helper (:meth:`~torchgpipe_tpu.serving.engine.Engine.
   step_input_specs` — the same shapes the real step buffers are built
-  from) and certify every admissible request maps onto ONE prefill and
-  ONE decode signature.  A request the pool cannot hold must be
+  from) and certify every admissible request maps onto the declared
+  program signatures.  A request the pool cannot hold must be
   statically REJECTED at submit (a shape-growing admission is exactly
   how a serving engine starts recompiling per request).
+* **ladder-bound** (:func:`certify_ladder`) — the bucket choice is a
+  pure function of the largest pending chunk, so an EXHAUSTIVE walk
+  over every reachable chunk size ``1..max_len`` certifies the
+  program-count bound for arbitrary request mixes, not just the
+  sampled grid.
 * **trace check** — abstractly trace both step programs
   (``jax.make_jaxpr`` over the specs; no device compute, no XLA
   compile) so a model/config combination that cannot build its serving
@@ -59,10 +67,14 @@ def _drive_signatures(
     """Serve ONE request through the engine's real submit/schedule/
     buffer-construction machinery with the compiled programs stubbed
     out (zero device compute), capturing the argument signature of
-    every would-be dispatch.  This is what makes the churn check
-    non-vacuous: an engine that sized a step buffer from the request
-    shows up here, not in production."""
-    sigs: Dict[str, Set[Tuple]] = {"prefill": set(), "decode": set()}
+    every would-be dispatch — keyed by the PROGRAM the engine chose
+    (each prefill ladder bucket is its own program).  This is what
+    makes the churn check non-vacuous: an engine that sized a step
+    buffer from the request shows up here, not in production."""
+    prefill_names = list(engine._prefill_fns)
+    sigs: Dict[str, Set[Tuple]] = {
+        **{name: set() for name in prefill_names}, "decode": set(),
+    }
     S = engine.pool.num_slots
 
     def stub(kind):
@@ -78,14 +90,79 @@ def _drive_signatures(
             return jnp.zeros((S,), jnp.int32), cache, lengths + n_valid, key
         return fn
 
-    real = engine._prefill_fn, engine._decode_fn
-    engine._prefill_fn, engine._decode_fn = stub("prefill"), stub("decode")
+    real = dict(engine._prefill_fns), engine._decode_fn
+    engine._prefill_fns = {n: stub(n) for n in prefill_names}
+    engine._decode_fn = stub("decode")
     try:
         engine.submit(np.zeros((plen,), np.int32), mnew, rid=tag)
         engine.run()
     finally:
-        engine._prefill_fn, engine._decode_fn = real
+        engine._prefill_fns, engine._decode_fn = real
     return sigs
+
+
+def certify_ladder(engine: Any) -> List[Finding]:
+    """Statically certify the prefill bucket ladder's program-count
+    bound against ARBITRARY request mixes — not just a sampled grid.
+
+    A prefill step's bucket is a pure function of its largest pending
+    chunk ``n`` (``Scheduler.bucket_for``), and ``n`` ranges over
+    ``1..max_len`` (admission rejects anything longer), so walking every
+    ``n`` exhaustively proves: every reachable dispatch selects a
+    declared bucket, every bucket's token-buffer shape is a declared
+    program signature, and the steady-state program count is exactly
+    ``len(ladder) + 1`` (``Engine.program_count``).  An INFO finding
+    records the certified bound; any violation is an ERROR."""
+    findings: List[Finding] = []
+    buckets = tuple(getattr(engine, "prefill_buckets",
+                            (engine.prefill_chunk,)))
+    S = engine.pool.num_slots
+    declared = {
+        tuple(spec["tokens"].shape)
+        for kind, spec in engine.step_input_specs().items()
+        if kind != "decode"
+    }
+    bad: Set[int] = set()
+    for n in range(1, engine.pool.max_len + 1):
+        g = engine.scheduler.bucket_for(min(n, buckets[-1]))
+        if g not in buckets or (S, g) not in declared:
+            bad.add(n)
+    if bad:
+        findings.append(Finding(
+            rule="ladder-bound",
+            severity=Severity.ERROR,
+            path="serving/prefill",
+            message=(
+                f"pending-chunk sizes {sorted(bad)[:8]} select a bucket "
+                f"outside the declared ladder {buckets} — the program "
+                "count is not bounded by the ladder"
+            ),
+        ))
+    n_programs = len(engine.step_input_specs())
+    expected = len(buckets) + 1
+    if n_programs != expected:
+        findings.append(Finding(
+            rule="ladder-bound",
+            severity=Severity.ERROR,
+            path="serving/engine",
+            message=(
+                f"engine declares {n_programs} step programs but the "
+                f"ladder {buckets} certifies {expected} (one per bucket "
+                "+ decode)"
+            ),
+        ))
+    else:
+        findings.append(Finding(
+            rule="ladder-bound",
+            severity=Severity.INFO,
+            path="serving/engine",
+            message=(
+                f"prefill ladder {buckets}: steady-state program count "
+                f"statically bounded at {expected} (one per bucket + "
+                "decode) for every admissible request mix"
+            ),
+        ))
+    return findings
 
 
 def lint_serving(
@@ -117,10 +194,17 @@ def lint_serving(
             "real requests"
         )
 
-    # 1. the two steady-state signatures, from the engine's own helper
+    # 1. the steady-state signatures, from the engine's own helper: one
+    # per prefill ladder bucket plus decode — the statically bounded
+    # program set every dispatch must land in.
     base = engine.step_input_specs()
     base_sig = {kind: _signature(spec) for kind, spec in base.items()}
-    if base_sig["prefill"] == base_sig["decode"]:
+    buckets = tuple(getattr(engine, "prefill_buckets",
+                            (engine.prefill_chunk,)))
+    if (
+        len(buckets) == 1
+        and base_sig.get("prefill") == base_sig["decode"]
+    ):
         findings.append(Finding(
             rule="serving-program-split",
             severity=Severity.WARNING,
@@ -128,9 +212,12 @@ def lint_serving(
             message=(
                 "prefill and decode steps share one signature "
                 f"(prefill_chunk={engine.prefill_chunk} == 1?) — legal "
-                "but prompts then absorb one token per iteration"
+                "but prompts then absorb one token per iteration; a "
+                "LADDER with a 1-bucket (prefill_chunk=(1, ..)) keeps "
+                "the fast path for longer prompts"
             ),
         ))
+    findings.extend(certify_ladder(engine))
 
     # 2. churn grid: serve every admissible request through the real
     # submit/schedule/buffer path (programs stubbed, no device compute)
@@ -155,8 +242,8 @@ def lint_serving(
             # lint calls on one engine
             tag=f"lint-{len(engine._requests)}-{plen}-{mnew}",
         )
-        for kind in ("prefill", "decode"):
-            for sig in churn[kind]:
+        for kind, seen in churn.items():
+            for sig in seen:
                 if sig != base_sig[kind]:
                     findings.append(Finding(
                         rule="recompilation-hazard",
@@ -165,15 +252,18 @@ def lint_serving(
                         message=(
                             f"request (prompt={plen}, new={mnew}) "
                             f"dispatches the {kind} step with a "
-                            "signature outside the steady-state pair — "
-                            "every such request compiles a new program; "
-                            "the engine must pad into its fixed "
-                            "(num_slots, prefill_chunk) buffers instead"
+                            "signature outside the declared program set "
+                            f"({len(base_sig)} programs: one per prefill "
+                            "bucket + decode) — every such request "
+                            "compiles a new program; the engine must pad "
+                            "into its fixed (num_slots, bucket) buffers "
+                            "instead"
                         ),
                     ))
 
-    # 3. abstract-trace both programs; walk for host callbacks
-    for kind, fn in (("prefill", engine._prefill_fn),
+    # 3. abstract-trace every program (each ladder bucket + decode);
+    # walk for host callbacks
+    for kind, fn in (*engine._prefill_fns.items(),
                      ("decode", engine._decode_fn)):
         spec = base[kind]
         try:
@@ -238,24 +328,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         jax.ShapeDtypeStruct((1, 8), jnp.int32),
     )
     worst = 0
-    for kv_quant in (False, True):
-        eng = Engine(
-            cfg, params, num_slots=4, max_len=48, prefill_chunk=4,
-            kv_quant=kv_quant,
-        )
+    cases = [
+        ("fp", dict(prefill_chunk=4)),
+        ("int8-kv", dict(prefill_chunk=4, kv_quant=True)),
+        # The bucket LADDER: program count statically bounded at
+        # len(ladder)+1 and certified over the churn grid + the
+        # exhaustive pending-chunk walk (certify_ladder).
+        ("ladder", dict(prefill_chunk=(1, 2, 4, 8))),
+    ]
+    for tag, kw in cases:
+        eng = Engine(cfg, params, num_slots=4, max_len=48, **kw)
         findings = lint_serving(eng)
-        tag = "int8-kv" if kv_quant else "fp"
         errors = [f for f in findings if f.severity >= Severity.WARNING]
         worst = max(worst, len(errors))
         if args.verbose or errors:
             for f in findings:
                 print(f.format())
         print(f"[serving-lint] {tag}: {len(findings)} finding(s), "
-              f"{len(errors)} at warning+")
+              f"{len(errors)} at warning+, "
+              f"{eng.program_count} program(s) certified")
     return 1 if worst else 0
 
 
-__all__ = ["DEFAULT_GRID", "lint_serving", "main"]
+__all__ = ["DEFAULT_GRID", "certify_ladder", "lint_serving", "main"]
 
 
 if __name__ == "__main__":

@@ -67,6 +67,11 @@ class PipelineTrace:
     # The avalified sample input (schedule rules re-derive per-stage byte
     # accounting from it without re-asking the caller).
     x_spec: Any = None
+    # The ORIGINAL sample input as passed to lint() — CONCRETE arrays
+    # when the caller has them (value-aware rules like pad-waste read
+    # real token planes; shape-only callers pass ShapeDtypeStructs and
+    # those rules stand down).
+    x_sample: Any = None
     # Trace-time failures, already converted to findings.
     errors: List[Finding] = dataclasses.field(default_factory=list)
 
@@ -184,6 +189,7 @@ def trace_gpipe(
         n_stages=len(model.partitions),
         compute_dtype=model.compute_dtype,
         x_spec=x_spec,
+        x_sample=sample_input,
     )
     try:
         params_spec, state_spec = jax.eval_shape(
@@ -293,6 +299,7 @@ def trace_spmd(
         mesh_axes=tuple(str(a) for a in pipe.mesh.axis_names),
         pp_axis=pipe.pp_axis,
         x_spec=x_spec,
+        x_sample=sample_input,
     )
     try:
         params_spec = jax.eval_shape(

@@ -26,6 +26,13 @@ Design, TPU-first:
 * **GQA native**: caches store ``n_kv_heads`` (the memory win is the
   point of GQA); queries group at the compute site exactly like the
   training path.
+* **Sequence-packing hooks**: :func:`_attend_full` and
+  :func:`_attend_chunk` take optional segment planes (``seg`` /
+  ``seg_q``+``seg_k``) folding the block-diagonal
+  ``segment_ids[i] == segment_ids[j]`` term into their causal masks —
+  packed documents teacher-forced through the decode path never attend
+  each other (``utils.data.pack_documents``; dense path only, the
+  flash kernels have no segment hook yet).
 * **Sliding-window ready**: with ``cfg.attn_window`` the decode mask
   attends to at most ``window`` trailing positions — the same band the
   training path computes — so a Mistral-style model decodes with its
@@ -363,6 +370,8 @@ def _attend_chunk(
     use_flash: Optional[bool] = None,
     k_scale: Optional[jnp.ndarray] = None,  # int8 cache: f32 [b, nkv, L]
     v_scale: Optional[jnp.ndarray] = None,
+    seg_q: Optional[jnp.ndarray] = None,    # [b, g] packed segment ids
+    seg_k: Optional[jnp.ndarray] = None,    # [b, max_len] cache segments
 ) -> jnp.ndarray:
     """Causal attention of ``g`` consecutive queries against the cache —
     one MXU-friendly einsum instead of g masked cache reads.  Query i
@@ -372,6 +381,13 @@ def _attend_chunk(
     — the serving pool's attention, where each slot sits at its own
     sequence frontier (dense path only: the flash decode kernel takes
     one scalar ``pos0``, so auto-dispatch stays dense per-row).
+
+    ``seg_q``/``seg_k`` fold the sequence-packing mask in: query ``i``
+    additionally requires ``seg_q[b, i] == seg_k[b, j]`` (the
+    block-diagonal term — packed documents teacher-forced through the
+    decode path never attend each other; ``utils.data.pack_documents``).
+    Dense path only: the flash decode kernel has no segment hook, so
+    segments force the masked einsum (the didactic fallback).
 
     ``use_flash=None`` auto-dispatches the Pallas decode kernel on TPU
     when the shapes are eligible (``ops.flash_attention.supports_decode``)
@@ -386,6 +402,19 @@ def _attend_chunk(
     bandwidth win; the dense path dequantizes up front."""
     on_tpu = jax.devices()[0].platform == "tpu"
     per_row = jnp.asarray(pos0).ndim == 1
+    if seg_q is not None or seg_k is not None:
+        if seg_q is None or seg_k is None:
+            raise ValueError(
+                "segment-masked cache attention needs BOTH seg_q and "
+                "seg_k (query and cache segment planes)"
+            )
+        if use_flash:
+            raise ValueError(
+                "the flash decode kernel has no segment-mask hook; "
+                "segment-packed attention runs the dense path "
+                "(use_flash=False or leave it to auto-dispatch)"
+            )
+        use_flash = False
     if use_flash is None:
         from torchgpipe_tpu.ops.flash_attention import supports_decode
 
@@ -424,6 +453,9 @@ def _attend_chunk(
     valid = idx <= qpos                           # [B', g, max_len]
     if window is not None:
         valid &= idx > qpos - window
+    if seg_q is not None:
+        # Block-diagonal packing term: [b, g, 1] == [b, 1, max_len].
+        valid = valid & (seg_q[:, :, None] == seg_k[:, None, :])
     scores = jnp.where(valid[:, None, None, :, :], scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bgrqs,bsgd->bqgrd", p, cv.astype(jnp.float32))
@@ -801,6 +833,7 @@ def _attend_full(
     v: jnp.ndarray,
     window: Optional[int],
     use_flash: Optional[bool] = None,
+    seg: Optional[jnp.ndarray] = None,   # [b, s] packed segment ids
 ) -> jnp.ndarray:
     """Causal (optionally banded) full-sequence attention, GQA-grouped —
     the batched twin of :func:`_attend_chunk` (prefill's one big
@@ -809,9 +842,19 @@ def _attend_full(
     ``use_flash=None`` auto-dispatches the Pallas flash kernel on TPU
     (O(block²) score memory — the long-prompt prefill path) and the
     dense einsum elsewhere; pass True/False to force (True off-TPU runs
-    the kernel in interpret mode — for tests)."""
+    the kernel in interpret mode — for tests).  ``seg`` folds the
+    sequence-packing block-diagonal term (``seg[i] == seg[j]``) into the
+    causal mask — dense path only (the flash kernel has no segment
+    hook), mirroring the training path's didactic fallback."""
     b, s, nh, hd = q.shape
     on_tpu = jax.devices()[0].platform == "tpu"
+    if seg is not None:
+        if use_flash:
+            raise ValueError(
+                "the flash prefill kernel has no segment-mask hook; "
+                "segment-packed attention runs the dense path"
+            )
+        use_flash = False
     if use_flash is None:
         use_flash = on_tpu
     if use_flash:
@@ -832,7 +875,10 @@ def _attend_full(
     valid = kpos <= qpos
     if window is not None:
         valid &= kpos > qpos - window
-    scores = jnp.where(valid[None, None, None, :, :], scores, -jnp.inf)
+    valid = valid[None]                           # [1, s, s]
+    if seg is not None:
+        valid = valid & (seg[:, :, None] == seg[:, None, :])
+    scores = jnp.where(valid[:, None, None, :, :], scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bgrqs,bsgd->bqgrd", p, v.astype(jnp.float32))
     return out.reshape(b, s, nh * hd)

@@ -314,15 +314,21 @@ def _rope(x: jnp.ndarray, theta: float, pos_offset: Any = 0) -> jnp.ndarray:
     offset gives sequence-parallel shards their *global* token positions;
     a ``[b]``-shaped offset gives every batch row its OWN base position —
     the slot-pooled serving decode, where each slot sits at a different
-    sequence frontier."""
+    sequence frontier.  A ``[b, s]``-shaped offset is taken as ABSOLUTE
+    per-token positions (sequence packing: each packed document's
+    positions restart at 0 — ``utils.data.pack_documents``)."""
     b, s, h, d = x.shape
     half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    # [B', s] positions with B' = b (per-row offset) or 1 (shared) — one
-    # rotation body either way; the B'=1 case broadcasts exactly as the
-    # pre-per-row [1, s, 1, half] cos/sin did.
+    # [B', s] positions with B' = b (per-row offset / per-token packed
+    # positions) or 1 (shared) — one rotation body either way; the B'=1
+    # case broadcasts exactly as the pre-per-row [1, s, 1, half] cos/sin
+    # did.
     off = jnp.asarray(pos_offset, jnp.float32)
-    positions = off.reshape(-1, 1) + jnp.arange(s, dtype=jnp.float32)
+    if off.ndim == 2:
+        positions = off                       # absolute per-token [b, s]
+    else:
+        positions = off.reshape(-1, 1) + jnp.arange(s, dtype=jnp.float32)
     ang = positions[..., None] * freqs  # [B', s, half]
     cos = jnp.cos(ang)[:, :, None, :]
     sin = jnp.sin(ang)[:, :, None, :]
@@ -355,6 +361,32 @@ def _maybe_rope(
         [_rope(x[..., :rot], cfg.rope_theta, pos_offset), x[..., rot:]],
         axis=-1,
     )
+
+
+# --------------------------------------------------------------------- #
+# sequence packing: the packed activation contract                      #
+#                                                                       #
+# A packed batch enters the model as a dict                             #
+# {"tokens", "segment_ids", "positions"} (utils.data.pack_documents);   #
+# token_embedding turns it into the PACKED ACTIVATION TUPLE             #
+# (hidden [b, s, dim], segment_ids [b, s], positions [b, s]) that rides #
+# unchanged through every transformer_block — each block folds the      #
+# block-diagonal segment mask into its attention and rotates queries at #
+# the packed per-token positions — until lm_head consumes the tuple and #
+# emits plain logits.  Both pipeline engines move activations as        #
+# pytrees, so the tuple flows through scatter/ring/remat machinery with #
+# no engine changes.                                                    #
+# --------------------------------------------------------------------- #
+
+
+def _is_packed_batch(x: Any) -> bool:
+    """A raw packed input batch (the packer's dict contract)."""
+    return isinstance(x, dict) and "tokens" in x and "segment_ids" in x
+
+
+def _is_packed_act(x: Any) -> bool:
+    """A packed activation tuple between layers: (hidden, seg, pos)."""
+    return isinstance(x, tuple) and len(x) == 3
 
 
 def transformer_block(
@@ -439,6 +471,13 @@ def transformer_block(
         return params, ()
 
     def apply(params, state, x, *, rng=None, train=True):
+        # Sequence packing: a packed activation tuple carries the block-
+        # diagonal mask term (segment_ids) and per-token positions through
+        # the residual stream; both ride out unchanged.
+        packed = _is_packed_act(x)
+        seg = pk_pos = None
+        if packed:
+            x, seg, pk_pos = x
         b, s, _ = x.shape
 
         # Sequence parallelism: when the sp axis is bound (inside the SPMD
@@ -446,9 +485,17 @@ def transformer_block(
         # ring attention; unbound (init-time inference, single-device use)
         # the local array is the whole sequence.
         sp_active = axis_bound(cfg.sp_axis)
+        if packed and sp_active:
+            raise ValueError(
+                "packed batches (segment_ids) do not compose with a bound "
+                "sequence-parallel axis; drop cfg.sp_axis for packed "
+                "training"
+            )
         pos_offset = (
             jax.lax.axis_index(cfg.sp_axis) * s if sp_active else 0
         )
+        if packed:
+            pos_offset = pk_pos  # [b, s] per-token packed positions
         # Tensor parallelism: inside the engine's shard_map the weight leaves
         # arrive pre-sliced (wq holds this lane's heads, w_gate this lane's
         # hidden units), so head counts come from the *local* weight shapes —
@@ -486,6 +533,7 @@ def transformer_block(
         attn = attention(
             q, k, v, axis_name=cfg.sp_axis if sp_active else None,
             causal=cfg.causal, impl=cfg.sp_impl, window=cfg.attn_window,
+            seg=seg,
         )
         attn_flat = attn.reshape(b, s, nh_loc * hd)
         attn_out = attn_flat @ params["wo"]
@@ -542,6 +590,8 @@ def transformer_block(
             x = _block_norm(cfg, params, "ln2", x + mlp_out)
         else:
             x = x + mlp_out
+        if packed:
+            return (x, seg, pk_pos), state
         return x, state
 
     tp = cfg.tp_axis
@@ -732,6 +782,28 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
 
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
+        # Sequence packing: a packed batch dict carries the tokens plus
+        # the segment/position planes; the embedding emits the packed
+        # activation TUPLE the blocks thread through (packed documents
+        # restart their positions at 0, so the learned-position gather
+        # below reads each token's WITHIN-DOCUMENT row).
+        seg = pk_pos = None
+        if _is_packed_batch(x):
+            seg = x["segment_ids"]
+            pk_pos = x.get("positions")
+            if pk_pos is None:
+                raise ValueError(
+                    "packed batch is missing 'positions' (per-token "
+                    "within-document positions); build batches with "
+                    "utils.data.pack_documents/packed_batches"
+                )
+            if axis_bound(cfg.sp_axis):
+                raise ValueError(
+                    "packed batches do not compose with a bound "
+                    "sequence-parallel axis; drop cfg.sp_axis for "
+                    "packed training"
+                )
+            x = x["tokens"]
         table = params["table"]
         if axis_bound(cfg.tp_axis):
             idx, in_range = _local_vocab_ids(x, cfg.tp_axis, table.shape[0])
@@ -745,7 +817,27 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
             # Gemma-style sqrt(dim) scaling; a TIED head still reads the
             # UNSCALED table (matching that family).
             out = out * jnp.asarray(cfg.embed_scale, out.dtype)
-        if "pos" in params:
+        if "pos" in params and seg is not None:
+            # Packed positions are per-token and reset per document, so
+            # the deepest reachable row is block_len - 1 (a document
+            # filling its whole block).  Same hazard as the unpacked
+            # branch below: jnp.take CLAMPS out-of-range rows under
+            # jit, so guard statically on the block length instead of
+            # silently training the tail of a long document on the
+            # table's last row.
+            s = x.shape[-1]
+            if s + cfg.pos_emb_offset > cfg.max_pos:
+                raise ValueError(
+                    f"packed block length {s} + pos_emb_offset "
+                    f"{cfg.pos_emb_offset} exceeds the learned position "
+                    f"table (max_pos={cfg.max_pos} rows): a document "
+                    "filling its block would read clamped rows — pack "
+                    "with block_len <= max_pos - pos_emb_offset"
+                )
+            out = out + jnp.take(
+                params["pos"], cfg.pos_emb_offset + pk_pos, axis=0
+            ).astype(out.dtype)
+        elif "pos" in params:
             s = x.shape[-1]
             sp_active = axis_bound(cfg.sp_axis)
             if not sp_active and s + cfg.pos_emb_offset > cfg.max_pos:
@@ -773,6 +865,8 @@ def token_embedding(cfg: TransformerConfig, *, name: str = "embed") -> Layer:
                 out, params["eln"], cfg.norm_eps,
                 bias=params["elnb"], centered=True,
             )
+        if seg is not None:
+            return (out, seg, pk_pos), state
         return out, state
 
     tp = cfg.tp_axis
@@ -845,6 +939,8 @@ def lm_head(
 
     def apply(params, state, x, *, rng=None, train=True):
         del rng, train
+        if _is_packed_act(x):
+            x = x[0]  # packed tuple: logits come from the hidden plane
         h = _block_norm(cfg, params, "scale", x)
         w = _head_w(cfg, params)
         if axis_bound(cfg.tp_axis):
@@ -947,12 +1043,23 @@ def chunked_lm_loss(
         # sequence length), so the two paths cannot drift.
         del state
         y, labels = y_and_labels
+        if _is_packed_act(y):
+            y = y[0]  # packed tuple: the hidden plane carries the logits
+        weights = None
+        if isinstance(labels, dict):  # packed targets: weight real tokens
+            labels, weights = labels["labels"], labels["weights"]
         h = _block_norm(cfg, params, "scale", y)
         losses = chunked_softmax_xent(
             h.reshape(-1, cfg.dim), _head_w(cfg, params),
             labels.reshape(-1), chunk,
         )
-        return jnp.mean(losses.reshape(labels.shape[0], -1), axis=1)
+        losses = losses.reshape(labels.shape[0], -1)
+        if weights is not None:
+            w = weights.astype(losses.dtype)
+            return jnp.sum(losses * w, axis=1) / jnp.maximum(
+                jnp.sum(w, axis=1), 1.0
+            )
+        return jnp.mean(losses, axis=1)
 
     def apply(params, state, y_and_labels, *, rng=None, train=True):
         del rng, train
@@ -1030,3 +1137,71 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def _packed_token_nll(
+    logits: Any, target: Any
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-position negative log-likelihood and its real-token weights
+    for the packed/padded dict target contract ``{"labels", "weights"}``
+    (``utils.data``): the ONE definition the weighted losses and the
+    per-document extractor share."""
+    if _is_packed_act(logits):
+        logits = logits[0]
+    labels, weights = target["labels"], target["weights"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -ll, weights.astype(jnp.float32)
+
+
+def packed_cross_entropy(logits: Any, target: Any) -> jnp.ndarray:
+    """Cross-entropy weighted by REAL tokens, not block size: the loss
+    for packed (and padded-with-mask) batches whose target is the
+    ``{"labels", "weights"}`` dict from ``utils.data`` — pad positions
+    and document-final tokens carry weight 0, so a 50%-padding batch is
+    not silently diluted to half the gradient signal per step.  Returns
+    ``Σ w·nll / Σ w`` (the token-weighted mean over THIS call).
+
+    For micro-batched/pipelined training where the engine sums or
+    averages per-micro-batch losses, prefer
+    :func:`packed_cross_entropy_sum` with ``loss_reduction='sum'``: the
+    raw weighted SUM decomposes exactly over any batch split, while this
+    mean's denominator is per-call."""
+    nll, w = _packed_token_nll(logits, target)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def packed_cross_entropy_sum(logits: Any, target: Any) -> jnp.ndarray:
+    """``Σ w·nll`` over the call — decomposes EXACTLY over micro-batches
+    and megastep slices (the packed-vs-padded equivalence gates compare
+    this figure).  Pair with the engines' ``loss_reduction='sum'`` and
+    normalize by the corpus' real-token count outside the step (or fold
+    ``1/N_real`` into the packer's weights)."""
+    nll, w = _packed_token_nll(logits, target)
+    return jnp.sum(nll * w)
+
+
+def per_document_losses(
+    logits: Any,
+    target: Any,
+    segment_ids: jnp.ndarray,
+    n_docs: int,
+) -> jnp.ndarray:
+    """Token-mean loss PER PACKED DOCUMENT.
+
+    ``segment_ids`` is the batch's ``[b, s]`` segment plane and
+    ``n_docs`` the (static) maximum segments per row; entry
+    ``r * n_docs + (d - 1)`` of the returned ``[b * n_docs]`` vector is
+    row ``r`` segment ``d``'s mean nll over its REAL supervised
+    positions (0 where the segment is absent).  Map a corpus document to
+    its entry via :class:`~torchgpipe_tpu.utils.data.Packing.doc_locs`
+    (its row, plus its arrival order within that row).  The
+    packed-vs-unpacked equivalence gates compare these against each
+    document run alone with pad masking."""
+    nll, w = _packed_token_nll(logits, target)
+    b = nll.shape[0]
+    out = []
+    for d in range(1, n_docs + 1):
+        m = (segment_ids == d).astype(jnp.float32) * w
+        out.append(jnp.sum(nll * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0))
+    return jnp.stack(out, axis=1).reshape(b * n_docs)

@@ -101,6 +101,20 @@ class ReconcileReport:
     dispatch_only: bool  # timeline.sync was False: durations not honest
     step_spans: int  # scan-granularity spans seen (SPMD step/megastep)
     spans: List[Any] = dataclasses.field(default_factory=list)  # raw fwd/bwd
+    # Fraction of the step's traced positions holding REAL tokens
+    # (utils.data.real_token_fraction): busy time on a padded batch is
+    # busy, but only this fraction of it is USEFUL work — the honest
+    # throughput/MFU scale for ragged data (1.0 = no padding / packed).
+    real_token_fraction: float = 1.0
+
+    @property
+    def useful_busy_fraction(self) -> float:
+        """Busy (non-bubble) fraction scaled to USEFUL work: pad
+        arithmetic keeps the chips busy but trains nothing, so a padded
+        run's effective utilization is ``(1 - bubble) *
+        real_token_fraction`` — the figure to compare against a packed
+        run's."""
+        return (1.0 - self.measured_bubble) * self.real_token_fraction
 
     @property
     def bubble_drift(self) -> float:
@@ -151,6 +165,13 @@ class ReconcileReport:
             f"(drift {self.bubble_drift:+.3f}, tolerance "
             f"{BUBBLE_TOLERANCE:.2f})",
         ]
+        if self.real_token_fraction < 1.0:
+            lines.append(
+                f"  useful:   {self.real_token_fraction:.0%} real tokens "
+                f"-> useful busy fraction "
+                f"{self.useful_busy_fraction:.3f} (pad arithmetic "
+                "discounted; pack the corpus to reclaim it)"
+            )
         for j in sorted(self.stage_busy):
             share = (
                 self.stage_busy[j] / self.measured_makespan
@@ -192,6 +213,7 @@ def reconcile(
     *,
     predicted_cost_of: Optional[Callable[[ev.Event], float]] = None,
     pipe: Any = None,
+    real_token_fraction: float = 1.0,
 ) -> ReconcileReport:
     """Map measured spans onto ``graph``'s nodes and compare figures.
 
@@ -201,7 +223,18 @@ def reconcile(
     ``pipe`` attaches the report to the pipeline object (as
     ``pipe._measured_reconcile``), which is how the ``plan-drift`` lint
     rule finds the measured figure on its next run.
+
+    ``real_token_fraction`` (``utils.data.real_token_fraction`` of the
+    measured run's batches) threads the ragged-data honesty scale into
+    the report: measured busy time on a padded batch includes pad
+    arithmetic, so :attr:`ReconcileReport.useful_busy_fraction` scales
+    it down — packed and padded runs then compare on useful work.
     """
+    if not 0.0 <= real_token_fraction <= 1.0:
+        raise ValueError(
+            f"real_token_fraction must be in [0, 1], got "
+            f"{real_token_fraction}"
+        )
     spans, dispatch_only = _events_of(timeline)
     pred_cost = predicted_cost_of or _default_predicted_cost
 
@@ -271,6 +304,7 @@ def reconcile(
         dispatch_only=dispatch_only,
         step_spans=step_spans,
         spans=cell_spans,
+        real_token_fraction=real_token_fraction,
     )
     if pipe is not None:
         pipe._measured_reconcile = report

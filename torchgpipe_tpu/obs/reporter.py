@@ -7,11 +7,16 @@ they export next to the serving and guard metrics:
   recompiles that a mean hides);
 * **throughput** — items (samples or tokens) per second, windowed over
   the last ``log_every`` steps;
-* **measured MFU** — ``flops_per_step / (dt * peak)`` when both the
-  analytic step FLOPs (:func:`measured_step_flops`, the
-  ``analysis.jaxpr.flops_estimate`` walker — the same numerator the
-  planner predicts with) and a published chip peak
-  (``utils.hw.chip_peak_bf16_flops``) are known; omitted on host CPU;
+* **measured MFU** — ``flops_per_step * real_token_fraction /
+  (dt * peak)`` when both the analytic step FLOPs
+  (:func:`measured_step_flops`, the ``analysis.jaxpr.flops_estimate``
+  walker — the same numerator the planner predicts with) and a
+  published chip peak (``utils.hw.chip_peak_bf16_flops``) are known;
+  omitted on host CPU.  ``real_token_fraction``
+  (``utils.data.real_token_fraction``) keeps ragged-data MFU honest:
+  the traced FLOPs price padded shapes, so pad arithmetic is scaled
+  OUT of the numerator — a padded run reports lower MFU than a packed
+  run over the same documents, which is the truth;
 * **guard counters** — skip/retry/loss-scale read from an attached
   :class:`~torchgpipe_tpu.resilience.guard.StepGuard`, so a NaN squall
   shows up in the same log line as the step-time spike it caused.
@@ -31,20 +36,39 @@ from typing import Any, Callable, Dict, Optional
 from torchgpipe_tpu.obs.registry import MetricsRegistry
 
 
-def measured_step_flops(fn: Callable[..., Any], *args: Any) -> Optional[float]:
+def measured_step_flops(
+    fn: Callable[..., Any],
+    *args: Any,
+    real_token_fraction: float = 1.0,
+) -> Optional[float]:
     """Analytic FLOPs of one ``fn(*args)`` step via the loop-aware
     :func:`torchgpipe_tpu.analysis.jaxpr.flops_estimate` walker (scan
     bodies multiplied by length, cond as max — the convention the
     planner's MFU predictions use, so measured and predicted MFU share
     one numerator).  Abstract tracing only — nothing executes.  Returns
-    ``None`` (never raises) when the step cannot be traced."""
+    ``None`` (never raises) when the step cannot be traced.
+
+    ``real_token_fraction`` scales the estimate to USEFUL flops: the
+    jaxpr prices the traced (padded) shapes, so a batch that is 50% pad
+    would otherwise bill pad arithmetic as model work and inflate MFU —
+    pass :func:`torchgpipe_tpu.utils.data.real_token_fraction` of the
+    batch so padded and packed runs report comparable figures.  ONE
+    scaling site only: a result scaled here goes to
+    ``StepReporter(flops_per_step=...)`` WITHOUT also passing the
+    reporter its own ``real_token_fraction`` (the two compose
+    multiplicatively and would double-discount)."""
     import jax
 
     from torchgpipe_tpu.analysis.jaxpr import avalify, flops_estimate
 
+    if not 0.0 <= real_token_fraction <= 1.0:
+        raise ValueError(
+            f"real_token_fraction must be in [0, 1], got "
+            f"{real_token_fraction}"
+        )
     try:
         jaxpr = jax.make_jaxpr(fn)(*avalify(args))
-        return float(flops_estimate(jaxpr))
+        return float(flops_estimate(jaxpr)) * real_token_fraction
     except Exception:  # noqa: BLE001 — a costing miss never fails the loop
         return None
 
@@ -76,6 +100,7 @@ class StepReporter:
         items_per_step: Optional[float] = None,
         items_label: str = "items",
         flops_per_step: Optional[float] = None,
+        real_token_fraction: float = 1.0,
         peak_flops: Optional[float] = None,
         guard: Any = None,
         label: str = "train",
@@ -87,6 +112,18 @@ class StepReporter:
         self.items_per_step = items_per_step
         self.items_label = items_label
         self.flops_per_step = flops_per_step
+        # Honest MFU for ragged data: ``flops_per_step`` prices the
+        # traced (padded) shapes, so the measured-MFU numerator is
+        # scaled by the batch's real-token fraction
+        # (utils.data.real_token_fraction) — a padded run and a packed
+        # run over the same documents then report comparable MFU
+        # instead of the padded one billing pad arithmetic as work.
+        if not 0.0 <= real_token_fraction <= 1.0:
+            raise ValueError(
+                f"real_token_fraction must be in [0, 1], got "
+                f"{real_token_fraction}"
+            )
+        self.real_token_fraction = float(real_token_fraction)
         self.peak_flops = (
             peak_flops if peak_flops is not None else _default_peak()
         )
@@ -178,8 +215,8 @@ class StepReporter:
         if window_dt > 0 and self._window_items:
             self._g_tput.set(self._window_items / window_dt, **self._run)
         if dt > 0 and self.flops_per_step and self.peak_flops:
-            self._g_mfu.set(self.flops_per_step / (dt * self.peak_flops),
-                            **self._run)
+            useful = self.flops_per_step * self.real_token_fraction
+            self._g_mfu.set(useful / (dt * self.peak_flops), **self._run)
         self._sync_guard()
         self._window_steps += 1
         if self.log_every and self._window_steps >= self.log_every:
@@ -218,6 +255,8 @@ class StepReporter:
             out["loss"] = self._last_loss
         if self.flops_per_step and self.peak_flops:
             out["measured_mfu"] = self._g_mfu.value(**self._run) or None
+            if self.real_token_fraction < 1.0:
+                out["real_token_fraction"] = self.real_token_fraction
         if self.guard is not None:
             out["skipped"] = int(self._g_skipped.value(**self._run))
             out["retries"] = int(self._g_retries.value(**self._run))

@@ -98,6 +98,7 @@ def full_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     window: Optional[int] = None,
+    seg: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Plain dense attention (single-device oracle / sp-disabled path).
 
@@ -106,6 +107,14 @@ def full_attention(
     ``[b, s, h, d]``.  ``window`` (requires ``causal``) keeps only the
     last ``window`` positions: attend iff ``0 <= qpos - kpos < window``
     (Mistral-style sliding-window attention).
+
+    ``seg`` (``[b, s]`` int segment ids, 0 = pad) folds the SEQUENCE-
+    PACKING mask in: position ``i`` attends ``j`` only when
+    ``seg[i] == seg[j]`` — the block-diagonal term that keeps packed
+    documents from attending each other
+    (:func:`torchgpipe_tpu.utils.data.pack_documents`).  All-masked pad
+    rows soften to a uniform distribution (``_NEG``, not ``-inf``), so
+    their garbage outputs stay finite; the packed loss weights them out.
     """
     from torchgpipe_tpu.ops.flash_attention import _validate_window
 
@@ -113,13 +122,19 @@ def full_attention(
     sm_scale = d ** -0.5 if sm_scale is None else sm_scale
     _validate_window(causal, window)
     s = _scores(q, k, sm_scale)
+    sq, sk = q.shape[1], k.shape[1]
+    mask = None
     if causal:
-        sq, sk = q.shape[1], k.shape[1]
         diff = jnp.arange(sq)[:, None] - jnp.arange(sk)[None, :]
         mask = diff >= 0
         if window is not None:
             mask = mask & (diff < window)
-        s = jnp.where(mask[None, None], s, _NEG)
+        mask = mask[None]  # [1, sq, sk]
+    if seg is not None:
+        seg_mask = seg[:, :, None] == seg[:, None, :]  # [b, sq, sk]
+        mask = seg_mask if mask is None else (mask & seg_mask)
+    if mask is not None:
+        s = jnp.where(mask[:, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.transpose(
         _weighted_v(p.astype(v.dtype), v), (0, 2, 1, 3)
@@ -258,6 +273,7 @@ def attention(
     kv_block_size: int = 2048,
     impl: str = "ring",
     window: Optional[int] = None,
+    seg: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Dispatch: sequence-parallel attention when an sp axis is bound —
     ``impl='ring'`` (blockwise ring, O(s/sp) memory) or ``'ulysses'``
@@ -265,12 +281,33 @@ def attention(
     :mod:`torchgpipe_tpu.parallel.ulysses`); on TPU the Pallas
     flash-attention kernel when shapes meet its tiling constraints
     (``TGPU_DISABLE_FLASH=1`` opts out); dense XLA attention otherwise.
-    One call site serves every deployment shape."""
+    One call site serves every deployment shape.
+
+    ``seg`` (``[b, s]`` segment ids — sequence packing, see
+    :func:`full_attention`) takes the DENSE path unconditionally: the
+    Pallas flash kernel has no segment-mask hook yet, so the packed
+    training path falls back didactically to the masked XLA einsum
+    (documented in docs/tuning.md; the dense mask is the oracle the
+    kernel will be tested against when it grows the hook), and the
+    sequence-parallel impls do not compose with packing (shards would
+    need cross-shard segment routing)."""
     from torchgpipe_tpu.ops.flash_attention import _validate_window
 
     if impl not in ("ring", "ulysses"):
         raise ValueError("attention impl must be 'ring' or 'ulysses'")
     _validate_window(causal, window)
+    if seg is not None:
+        if axis_bound(axis_name):
+            raise ValueError(
+                "segment-packed attention does not compose with a bound "
+                "sequence-parallel axis (ring/ulysses shards would need "
+                "cross-shard segment routing); drop sp_axis for packed "
+                "training"
+            )
+        return full_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale, window=window,
+            seg=seg,
+        )
     if not axis_bound(axis_name):
         import os
 

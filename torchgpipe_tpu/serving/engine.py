@@ -1,14 +1,19 @@
-"""The serving loop: two compiled programs, arbitrary request churn.
+"""The serving loop: a statically bounded program set, arbitrary churn.
 
 Steady-state contract (the whole point, and what the compile-counter
 test in ``tests/test_serving.py`` pins): after warmup the engine
-executes exactly TWO compiled programs —
+executes a STATICALLY BOUNDED set of compiled programs — exactly two
+with a single ``prefill_chunk``, ``len(ladder) + 1`` with a prefill
+bucket ladder (``prefill_chunk=(1, 2, 4, 8)``; certified by
+``analysis.serving.certify_ladder``) —
 
-* **prefill** — ``decode_slots`` at ``g = prefill_chunk``: every slot's
-  pending prompt chunk teacher-forced at its own frontier, masked rows
-  no-ops; rows finishing their prompt sample their FIRST token from the
-  chunk's last-valid-position logits (so prefill and decode share one
-  sampling site semantics-wise);
+* **prefill** — ``decode_slots`` at ``g = prefill_chunk`` (one program
+  per ladder bucket; each step dispatches the smallest bucket covering
+  its largest pending chunk): every slot's pending prompt chunk
+  teacher-forced at its own frontier, masked rows no-ops; rows
+  finishing their prompt sample their FIRST token from the chunk's
+  last-valid-position logits (so prefill and decode share one sampling
+  site semantics-wise);
 * **decode** — ``decode_slots`` at ``g = 1``: one token per occupied
   slot, each at its own position.
 
@@ -51,7 +56,11 @@ from torchgpipe_tpu.models.transformer import TransformerConfig
 from torchgpipe_tpu.resilience.guard import GuardPolicy, classify_error
 from torchgpipe_tpu.serving.cache_pool import CachePool
 from torchgpipe_tpu.serving.metrics import ServingMetrics
-from torchgpipe_tpu.serving.scheduler import Request, Scheduler
+from torchgpipe_tpu.serving.scheduler import (
+    Request,
+    Scheduler,
+    normalize_buckets,
+)
 
 Pytree = Any
 
@@ -101,7 +110,7 @@ class Engine:
         *,
         num_slots: int,
         max_len: int,
-        prefill_chunk: int = 8,
+        prefill_chunk: Any = 8,
         kv_quant: bool = False,
         cache_dtype: Optional[Any] = None,
         moe: Optional[Any] = None,
@@ -127,7 +136,15 @@ class Engine:
         _split_params(cfg, self.params)  # validates the per-layer list
         _check_decodable(cfg, max_len)
         self.moe = moe
-        self.prefill_chunk = int(prefill_chunk)
+        # ``prefill_chunk`` may be an int (one prefill program — the
+        # classic configuration) or a LADDER of chunk sizes (e.g.
+        # ``(1, 2, 4, 8)``): one program per bucket, a prefill step
+        # dispatching the smallest bucket that covers its work, so short
+        # prompts stop paying the max chunk's FLOPs while the program
+        # count stays statically bounded at ``len(ladder) + 1``
+        # (certified by ``analysis.serving.lint_serving``).
+        self.prefill_buckets = normalize_buckets(prefill_chunk)
+        self.prefill_chunk = self.prefill_buckets[-1]
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
@@ -162,7 +179,7 @@ class Engine:
             cfg, num_slots, max_len, kv_quant=kv_quant, dtype=cache_dtype
         )
         self.scheduler = Scheduler(
-            self.pool, prefill_chunk=self.prefill_chunk,
+            self.pool, prefill_chunk=self.prefill_buckets,
             max_active=max_active, wave_admission=wave_admission,
         )
         # ``registry`` (torchgpipe_tpu.obs.MetricsRegistry) shares the
@@ -195,12 +212,28 @@ class Engine:
         self._lengths_dev: Optional[jnp.ndarray] = None
         self._lengths_shadow: Optional[np.ndarray] = None
         self._rid_counter = 0
-        self.trace_counts = {"prefill": 0, "decode": 0}
-        # ONE source of truth for the token-buffer shapes: the real steps
-        # and the lint's step_input_specs() both read this, so a shape
-        # that churned with the request mix could not hide.
+        # Program names: the classic single-bucket engine keeps the
+        # historical "prefill" name; a ladder names each bucket's
+        # program "prefill@g".  ONE source of truth for the token-buffer
+        # shapes: the real steps and the lint's step_input_specs() both
+        # read this, so a shape that churned with the request mix could
+        # not hide.
+        self._prefill_names = {
+            g: (
+                "prefill" if len(self.prefill_buckets) == 1
+                else f"prefill@{g}"
+            )
+            for g in self.prefill_buckets
+        }
+        self.trace_counts = {
+            **{name: 0 for name in self._prefill_names.values()},
+            "decode": 0,
+        }
         self._token_shapes = {
-            "prefill": (num_slots, self.prefill_chunk),
+            **{
+                name: (num_slots, g)
+                for g, name in self._prefill_names.items()
+            },
             "decode": (num_slots, 1),
         }
         self._build_programs()
@@ -211,7 +244,6 @@ class Engine:
 
     def _build_programs(self) -> None:
         cfg, moe = self.cfg, self.moe
-        P = self.prefill_chunk
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         counts = self.trace_counts
 
@@ -222,21 +254,27 @@ class Engine:
             key, sub = jax.random.split(key)
             return _sample(logits, sub, temperature, top_k, top_p), key
 
-        def prefill_body(params, cache, lengths, tokens, n_valid, key):
-            counts["prefill"] += 1
-            logits, cache, _ = decode_slots(
-                cfg, params, tokens, cache, lengths, n_valid, moe=moe
-            )
-            last = jnp.clip(n_valid - 1, 0, P - 1)
-            row_logits = jnp.take_along_axis(
-                logits, last[:, None, None], axis=1
-            )[:, 0]
-            tok, key = sample_row(row_logits, key)
-            # Advance the frontiers ON DEVICE (lengths += the rows each
-            # slot consumed): the next step reuses this array instead of
-            # re-uploading the host mirror — the per-step host→device
-            # lengths copy disappears from the steady-state decode path.
-            return tok, cache, lengths + n_valid, key
+        def prefill_body_for(g, name):
+            # One program per ladder bucket: the bucket size g is baked
+            # into the traced shape (tokens [S, g]); the body is
+            # otherwise identical across buckets.
+            def prefill_body(params, cache, lengths, tokens, n_valid, key):
+                counts[name] += 1
+                logits, cache, _ = decode_slots(
+                    cfg, params, tokens, cache, lengths, n_valid, moe=moe
+                )
+                last = jnp.clip(n_valid - 1, 0, g - 1)
+                row_logits = jnp.take_along_axis(
+                    logits, last[:, None, None], axis=1
+                )[:, 0]
+                tok, key = sample_row(row_logits, key)
+                # Advance the frontiers ON DEVICE (lengths += the rows
+                # each slot consumed): the next step reuses this array
+                # instead of re-uploading the host mirror — the per-step
+                # host→device lengths copy disappears from the
+                # steady-state decode path.
+                return tok, cache, lengths + n_valid, key
+            return prefill_body
 
         def decode_body(params, cache, lengths, tokens, n_valid, key):
             counts["decode"] += 1
@@ -247,8 +285,19 @@ class Engine:
             return tok, cache, lengths + n_valid, key
 
         donate = (1,) if self.donate else ()
-        self._prefill_fn = jax.jit(prefill_body, donate_argnums=donate)
+        self._prefill_fns = {
+            name: jax.jit(prefill_body_for(g, name), donate_argnums=donate)
+            for g, name in self._prefill_names.items()
+        }
         self._decode_fn = jax.jit(decode_body, donate_argnums=donate)
+
+    @property
+    def program_count(self) -> int:
+        """The statically bounded compiled-program count: one prefill
+        program per ladder bucket plus the decode program — the figure
+        ``analysis.serving`` certifies and the compile-counter test
+        confirms dynamically."""
+        return len(self.prefill_buckets) + 1
 
     def step_input_specs(self) -> Dict[str, Any]:
         """The (shape, dtype) signature of each compiled program's
@@ -403,16 +452,21 @@ class Engine:
 
     def _run_prefill(self) -> None:
         reqs = self.scheduler.prefill_pending()
-        tokens = self._token_buffer("prefill")
+        # Ladder admission: the smallest bucket covering this step's
+        # largest pending chunk — short prompts dispatch a small program
+        # instead of paying the max chunk's FLOPs.
+        g = self.scheduler.prefill_bucket()
+        name = self._prefill_names[g]
+        tokens = self._token_buffer(name)
         n_valid = np.zeros((self.pool.num_slots,), np.int32)
         takes: List[Tuple[Request, int]] = []
         for r in reqs:
-            take = min(self.prefill_chunk, r.prompt_len - r.prefilled)
+            take = min(g, r.prompt_len - r.prefilled)
             tokens[r.slot, :take] = r.prompt[r.prefilled:r.prefilled + take]
             n_valid[r.slot] = take
             takes.append((r, take))
         tok, cache, lengths_dev, key = self._dispatch(
-            self._prefill_fn, self.params, self.pool.cache,
+            self._prefill_fns[name], self.params, self.pool.cache,
             self._lengths_for_step(), jnp.asarray(tokens),
             jnp.asarray(n_valid), self._key,
         )

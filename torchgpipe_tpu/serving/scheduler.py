@@ -16,7 +16,11 @@ programs never see (they only ever see values, not shapes):
   chunks (``prefill_chunk``) through the same slot-masked step decode
   uses; when both prefill work and decode-ready rows exist, the
   scheduler ALTERNATES so ongoing decodes are never starved behind a
-  long prompt (chunked prefill, Orca §4/Sarathi-style).
+  long prompt (chunked prefill, Orca §4/Sarathi-style).  A bucket
+  LADDER (``prefill_chunk=(1, 2, 4, 8)``) admits each step at the
+  smallest bucket covering its pending work, so short prompts stop
+  paying the max chunk's FLOPs while the compiled-program count stays
+  statically bounded at ``len(ladder) + 1`` (docs/serving.md).
 * **Eviction** — finished (per-row EOS / max-token) and cancelled
   requests release their slot immediately.
 
@@ -26,11 +30,32 @@ Everything here is host-side and O(active + queued) per iteration.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from torchgpipe_tpu.serving.cache_pool import CachePool
+
+
+def normalize_buckets(
+    prefill_chunk: Union[int, Sequence[int]]
+) -> Tuple[int, ...]:
+    """The prefill BUCKET LADDER from a ``prefill_chunk`` declaration:
+    a single int is the classic one-chunk configuration; a sequence is a
+    static ladder of chunk sizes (sorted, deduplicated), each compiling
+    ONE program — a prefill step picks the smallest bucket covering its
+    work, so short prompts stop paying the max chunk's FLOPs while the
+    steady-state program count stays statically bounded at
+    ``len(ladder) + 1`` (``analysis.serving`` certifies this)."""
+    if isinstance(prefill_chunk, (int, np.integer)):
+        buckets: Tuple[int, ...] = (int(prefill_chunk),)
+    else:
+        buckets = tuple(sorted({int(g) for g in prefill_chunk}))
+    if not buckets or buckets[0] < 1:
+        raise ValueError(
+            f"prefill buckets must be >= 1, got {prefill_chunk!r}"
+        )
+    return buckets
 
 
 @dataclasses.dataclass
@@ -79,16 +104,15 @@ class Scheduler:
         self,
         pool: CachePool,
         *,
-        prefill_chunk: int = 8,
+        prefill_chunk: Union[int, Sequence[int]] = 8,
         max_active: Optional[int] = None,
         wave_admission: bool = False,
     ) -> None:
-        if prefill_chunk < 1:
-            raise ValueError(
-                f"prefill_chunk must be >= 1, got {prefill_chunk}"
-            )
+        self.prefill_buckets = normalize_buckets(prefill_chunk)
         self.pool = pool
-        self.prefill_chunk = prefill_chunk
+        # The classic single-chunk attribute stays the LADDER MAX — the
+        # largest program any prefill step can dispatch.
+        self.prefill_chunk = self.prefill_buckets[-1]
         self.max_active = (
             pool.num_slots if max_active is None
             else min(max_active, pool.num_slots)
@@ -176,6 +200,27 @@ class Scheduler:
     def prefill_pending(self) -> List[Request]:
         return [r for r in self.active.values() if not r.prefill_done]
 
+    def bucket_for(self, n: int) -> int:
+        """The smallest ladder bucket covering ``n`` pending prompt
+        tokens (the max bucket when ``n`` exceeds it — the remainder
+        absorbs over further chunked steps)."""
+        for g in self.prefill_buckets:
+            if n <= g:
+                return g
+        return self.prefill_buckets[-1]
+
+    def prefill_bucket(self) -> int:
+        """The bucket THIS prefill step dispatches: the smallest ladder
+        entry covering every pending request's next chunk (each request's
+        chunk is its remaining prompt capped at the ladder max — one
+        shared ``[slots, g]`` buffer serves all slots, masked rows
+        no-ops, so the step's bucket must cover the largest take)."""
+        need = 0
+        cap = self.prefill_buckets[-1]
+        for r in self.prefill_pending():
+            need = max(need, min(r.prompt_len - r.prefilled, cap))
+        return self.bucket_for(max(need, 1))
+
     def decode_ready(self) -> List[Request]:
         return [r for r in self.active.values() if r.prefill_done]
 
@@ -203,4 +248,4 @@ class Scheduler:
         return not self.queue and not self.active
 
 
-__all__ = ["Request", "Scheduler"]
+__all__ = ["Request", "Scheduler", "normalize_buckets"]
