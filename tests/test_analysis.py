@@ -694,6 +694,57 @@ def test_plan_drift_stands_down_without_declared_budget():
 
 
 # --------------------------------------------------------------------- #
+# stale-cost-model (obs.costmodel's lint rule; the measured-pricing     #
+# mirror of the PR 8 stale-report stand-down)                           #
+# --------------------------------------------------------------------- #
+
+
+def _cost_model_for(model):
+    from torchgpipe_tpu.obs.costmodel import (
+        CellCost, CostModel, config_fingerprint,
+    )
+
+    cells = {}
+    for j in range(len(model.balance)):
+        cells[(j, "fwd")] = CellCost(1e-3, 2)
+        cells[(j, "bwd")] = CellCost(2e-3, 2)
+    return CostModel(fingerprint=config_fingerprint(model), cells=cells)
+
+
+def test_stale_cost_model_fires_on_reconfigured_pipe():
+    # Broken: the model was measured under checkpoint='always'; the pipe
+    # now runs 'never' — its measurements describe a plan that no longer
+    # exists, and plan(cost_model=...) silently degrades to analytic.
+    measured = _driftable_model(checkpoint="always")
+    cm = _cost_model_for(measured)
+    current = _driftable_model(checkpoint="never")
+    cm.attach(current)
+    found = _by_rule(
+        analysis.lint(current, X, target=Y, loss_fn=mse,
+                      rules=["stale-cost-model"]),
+        "stale-cost-model",
+    )
+    assert found and found[0].severity == Severity.WARNING
+    assert "STALE" in found[0].message
+    assert "checkpoint" in found[0].message  # names the drifted key
+    assert "Re-measure" in found[0].message  # the fix is named
+
+
+def test_stale_cost_model_fresh_attachment_stands_down():
+    # Fixed: the attachment matches the running configuration.
+    model = _driftable_model(checkpoint="always")
+    _cost_model_for(model).attach(model)
+    assert analysis.lint(model, X, target=Y, loss_fn=mse,
+                         rules=["stale-cost-model"]) == []
+
+
+def test_stale_cost_model_no_attachment_stands_down():
+    model = _driftable_model(checkpoint="always")
+    assert analysis.lint(model, X, target=Y, loss_fn=mse,
+                         rules=["stale-cost-model"]) == []
+
+
+# --------------------------------------------------------------------- #
 # dispatch-per-step (megastep availability)                             #
 # --------------------------------------------------------------------- #
 

@@ -206,7 +206,7 @@ class _BytesTracer(Timeline):
         self.total = 0
         self.peak = 0
 
-    def record(self, name, stage, mbatch, out=None):
+    def record(self, name, stage, mbatch, out=None, settle=0.0):
         b = _tree_bytes(out)
         if name == "fwd":
             self.live[(stage, mbatch)] = b
@@ -214,7 +214,7 @@ class _BytesTracer(Timeline):
             self.peak = max(self.peak, self.total)
         elif name == "bwd":
             self.total -= self.live.pop((stage, mbatch), 0)
-        return super().record(name, stage, mbatch, out)
+        return super().record(name, stage, mbatch, out, settle=settle)
 
 
 def _peak_live_bytes(schedule: str) -> int:

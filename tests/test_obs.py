@@ -457,6 +457,151 @@ def test_overlay_chrome_trace_two_processes(traced_run, tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# cost model: distill / persist / merge / from_dumps (obs.costmodel)    #
+# --------------------------------------------------------------------- #
+
+
+def test_cost_model_distill_and_round_trip(traced_run, tmp_path):
+    """from_report buckets the measured spans per (stage, phase) with
+    the backward split on the measured stop; save/load round-trips the
+    versioned JSON; merge blends sample-weighted."""
+    _schedule, model, _x, tracer = traced_run
+    report = obs.reconcile(tracer, events_for(model))
+    cm = report.cost_model(model)
+    assert cm.fingerprint == obs.config_fingerprint(model)
+    assert cm.stale_reason(model) is None
+    # except_last at chunks=4: mbs 0..2 remat'd, mb 3 plain — both
+    # backward buckets measured for every stage.
+    for j in (0, 1):
+        assert (j, "fwd") in cm.cells
+        assert (j, "bwd") in cm.cells
+        assert (j, "bwd_remat") in cm.cells
+        assert cm.cells[(j, "fwd")].seconds > 0
+    atoms, exact = cm.stage_atoms(2)
+    assert atoms is not None and exact
+    path = os.path.join(tmp_path, "cm.json")
+    cm.save(path)
+    cm2 = obs.CostModel.load(path)
+    assert cm2.fingerprint == cm.fingerprint
+    assert cm2.cells == cm.cells
+    merged = cm.merge(cm2)
+    assert merged.cells[(0, "fwd")].samples == 2 * cm.cells[(0, "fwd")].samples
+    assert merged.cells[(0, "fwd")].seconds == pytest.approx(
+        cm.cells[(0, "fwd")].seconds
+    )
+    # Version discipline: a foreign schema is refused didactically.
+    doc = cm.to_dict()
+    doc["version"] = 99
+    with pytest.raises(ValueError, match="version"):
+        obs.CostModel.from_dict(doc)
+
+
+def test_cost_model_refuses_garbage_sources(traced_run):
+    _schedule, model, _x, tracer = traced_run
+    async_tl = Timeline(sync=False)
+    async_tl.events = list(tracer.events)
+    report = obs.reconcile(async_tl, events_for(model))
+    with pytest.raises(ValueError, match="dispatch-only"):
+        report.cost_model(model)
+    with pytest.raises(ValueError, match="different fingerprints"):
+        good = obs.reconcile(tracer, events_for(model)).cost_model(model)
+        other = dataclasses.replace(
+            good, fingerprint={**good.fingerprint, "chunks": 99}
+        )
+        good.merge(other)
+
+
+def test_cost_model_from_dumps():
+    """Flight-recorder dumps feed the same store: per-cell completions
+    with durations plus the engine meta become a distilled model."""
+    from torchgpipe_tpu.obs.flightrec import FlightRecorder, dump_from_dict
+
+    recs = []
+    for rank in (0, 1):
+        rec = FlightRecorder(rank=rank, worker=f"w{rank}")
+        rec.set_meta(engine="distributed", rank=rank,
+                     workers=["w0", "w1"], chunks=2,
+                     checkpoint="except_last")
+        for mb in (0, 1):
+            rec.record("fwd", stage=rank, mb=mb,
+                       dur=0.010 * (rank + 1))
+            rec.record("bwd", stage=rank, mb=mb,
+                       dur=0.020 * (rank + 1))
+        rec.record("recv_match", channel=("forward", 0), dur=0.003)
+        recs.append(dump_from_dict(rec.to_dict()))
+    cm = obs.CostModel.from_dumps(recs)
+    assert cm.fingerprint["engine"] == "mpmd"
+    assert cm.fingerprint["n_stages"] == 2
+    assert cm.fingerprint["balance"] is None  # cut unknown from dumps
+    # stop = chunks-1 = 1: mb 0 backward is remat'd, mb 1 plain.
+    assert cm.cells[(1, "fwd")].seconds == pytest.approx(0.020)
+    assert cm.cells[(0, "bwd_remat")].seconds == pytest.approx(0.020)
+    assert cm.cells[(0, "bwd")].seconds == pytest.approx(0.020)
+    assert cm.comm_s == pytest.approx(0.003)
+    assert cm.source == "dumps"
+
+
+def test_cost_model_merge_honors_dump_balance_wildcard(traced_run):
+    """A dump-sourced model (balance None — the cut is not in dump
+    meta) merges with a reconcile-sourced model of the same structure,
+    and the merged fingerprint keeps the CONCRETE cut — seeding
+    ReplanOnDrift from a persisted dump model must not raise into the
+    training loop."""
+    _schedule, model, _x, tracer = traced_run
+    concrete = obs.reconcile(tracer, events_for(model)).cost_model(model)
+    dumpish = dataclasses.replace(
+        concrete, fingerprint={**concrete.fingerprint, "balance": None}
+    )
+    assert dumpish.stale_reason(model) is None  # wildcard matches
+    merged = dumpish.merge(concrete)
+    assert merged.fingerprint["balance"] == concrete.fingerprint["balance"]
+    assert merged.stale_reason(model) is None
+    # Symmetric spelling merges too.
+    assert concrete.merge(dumpish).fingerprint["balance"] == (
+        concrete.fingerprint["balance"]
+    )
+    # Provenance stays bounded under repeated merging (ReplanOnDrift
+    # merges every check interval — O(steps) nesting would bloat the
+    # persisted store).
+    rolling = concrete
+    for _ in range(5):
+        rolling = rolling.merge(dumpish.merge(concrete))
+    assert rolling.source == "merge(reconcile)"
+    assert len(rolling.source) < 64
+
+
+def test_read_jsonl_round_trips_write_jsonl(tmp_path):
+    reg = obs.MetricsRegistry(clock=lambda: 7.0)
+    reg.counter("steps").inc(3)
+    h = reg.histogram("lat", labels=("run",))
+    h.observe(0.25, run="train")
+    path = os.path.join(tmp_path, "metrics.jsonl")
+    n = reg.write_jsonl(path)
+    records = obs.read_jsonl(path)
+    assert len(records) == n == 2
+    by_name = {r["metric"]: r for r in records}
+    assert by_name["steps"]["value"] == 3.0
+    assert by_name["steps"]["time"] == 7.0
+    assert by_name["lat"]["labels"] == {"run": "train"}
+    assert by_name["lat"]["count"] == 1.0
+    # The instance alias reads the same records.
+    assert reg.read_jsonl(path) == records
+    import io as _io
+
+    assert obs.read_jsonl(_io.StringIO(open(path).read())) == records
+
+
+def test_step_reporter_mirrors_replan_hook():
+    class FakeHook:
+        events = [object(), object()]
+
+    rep = obs.StepReporter(replan=FakeHook(), log_every=0,
+                           peak_flops=None)
+    rep.step()
+    assert rep.summary()["replans"] == 2
+
+
+# --------------------------------------------------------------------- #
 # trace_report CLI (the trace-verify gate)                              #
 # --------------------------------------------------------------------- #
 

@@ -596,3 +596,54 @@ def test_reconcile_report_useful_busy_fraction():
     r = ReconcileReport(**base, real_token_fraction=0.5)
     assert r.useful_busy_fraction == pytest.approx(0.4)
     assert ReconcileReport(**base).useful_busy_fraction == pytest.approx(0.8)
+
+
+def test_reconcile_real_token_fraction_under_packed_fixture(corpus):
+    """obs.reconcile under the packed fixture (previously only the
+    unpacked path was exercised): a traced GPipe run over the packed
+    batch reconciles with the real-token fraction threading into
+    useful_busy_fraction exactly — and drift findings are UNAFFECTED by
+    packing (the fraction scales usefulness, never the bubble)."""
+    from torchgpipe_tpu import GPipe, obs
+    from torchgpipe_tpu.analysis.events import events_for
+    from torchgpipe_tpu.utils.tracing import Timeline
+
+    docs, pk, (x, y), (xt, yt) = corpus
+    tracer = Timeline(sync=True)
+    model = GPipe(llama(CFG), balance=[2, 2], chunks=2, tracer=tracer)
+    xj = {k: jnp.asarray(v) for k, v in x.items()}
+    yj = jax.tree_util.tree_map(jnp.asarray, y)
+    spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), xj
+    )
+    params, state = model.init(jax.random.PRNGKey(0), spec)
+    out = model.value_and_grad(params, state, xj, yj,
+                               packed_cross_entropy_sum)
+    jax.block_until_ready(out[:2])
+    tracer.reset()
+    for _ in range(2):
+        out = model.value_and_grad(params, state, xj, yj,
+                                   packed_cross_entropy_sum)
+        jax.block_until_ready(out[:2])
+    g = events_for(model)
+    packed_frac = D.real_token_fraction(x)
+    padded_frac = D.real_token_fraction(xt)
+    assert padded_frac < packed_frac
+    base = obs.reconcile(tracer, g)
+    scaled = obs.reconcile(tracer, g, real_token_fraction=packed_frac)
+    # The fraction scales ONLY usefulness; coverage/bubble/drift are
+    # measurement properties of the same spans.
+    assert scaled.coverage >= 0.95
+    assert scaled.measured_bubble == base.measured_bubble
+    assert scaled.useful_busy_fraction == pytest.approx(
+        (1.0 - scaled.measured_bubble) * packed_frac
+    )
+    assert scaled.drift_findings() == base.drift_findings()
+    # The padded twin of the same documents is strictly less useful at
+    # the same measured busy time, and the summary says so.
+    padded = obs.reconcile(tracer, g, real_token_fraction=padded_frac)
+    assert padded.useful_busy_fraction < scaled.useful_busy_fraction
+    assert "useful:" in padded.summary()
+    # And the fraction never leaks into the distilled cost model's
+    # measured durations (pricing is wall-clock, usefulness is not).
+    assert scaled.cost_model(model).cells == base.cost_model(model).cells

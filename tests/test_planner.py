@@ -821,3 +821,228 @@ def test_plan_3d_rejects_explicit_zero_without_dp(cpu_devices):
     assert report.best is None
     assert any("zero=True is incompatible" in p.reason
                for p in report.candidates)
+
+
+# --------------------------------------------------------------------- #
+# profile-guided pricing: plan(cost_model=...)                          #
+# --------------------------------------------------------------------- #
+
+
+def _synthetic_cost_model(pipe, fwd=1e-3, bwd=8e-3, bwd_remat=2e-3):
+    """A deliberately skewed measured profile (storing residuals slow,
+    replaying cheap — unphysical here, which is the point: the analytic
+    model can never produce it)."""
+    from torchgpipe_tpu.obs.costmodel import (
+        CellCost, CostModel, config_fingerprint,
+    )
+
+    n = pipe.n_stages if isinstance(pipe, SpmdGPipe) else len(pipe.balance)
+    cells = {}
+    for j in range(n):
+        cells[(j, "fwd")] = CellCost(fwd, 4)
+        cells[(j, "bwd")] = CellCost(bwd, 4)
+        cells[(j, "bwd_remat")] = CellCost(bwd_remat, 4)
+    return CostModel(fingerprint=config_fingerprint(pipe), cells=cells,
+                     source="synthetic")
+
+
+def test_plan_cost_model_flips_mpmd_winner():
+    """The measured ranking must be able to DISAGREE with the analytic
+    one: under bwd >> bwd_remat the certified winner flips from 'never'
+    (least analytic work) to 'always', priced 'measured', with both
+    makespans on the plan."""
+    pipe = _mpmd_model(checkpoint="never")
+    opts = {"chunks_options": (2,), "balance_options": [pipe.balance]}
+    analytic = planner.plan(pipe, X, 64 << 30, **opts)
+    assert analytic.best.checkpoint == "never"
+    assert analytic.best.priced_by == "analytic"
+    assert analytic.best.makespan_measured is None
+    cm = _synthetic_cost_model(pipe)
+    measured = planner.plan(pipe, X, 64 << 30, cost_model=cm, **opts)
+    best = measured.best
+    assert best.checkpoint == "always"
+    assert best.priced_by == "measured"
+    assert best.makespan_measured is not None
+    assert best.makespan_analytic is not None
+    assert measured.cost_model_stale is None
+    # Certification did not change — same feasible/certified set.
+    assert (
+        {(p.schedule, p.checkpoint, p.chunks, p.certified, p.feasible)
+         for p in analytic.candidates}
+        == {(p.schedule, p.checkpoint, p.chunks, p.certified, p.feasible)
+            for p in measured.candidates}
+    )
+    # The table shows the pricing source + measured span.
+    assert "p=M" in measured.table() and "span=" in measured.table()
+
+
+def test_plan_cost_model_stale_falls_back_to_analytic():
+    pipe = _mpmd_model(checkpoint="never")
+    cm = _synthetic_cost_model(pipe)
+    other = _mpmd_model(checkpoint="always")  # reconfigured pipe
+    report = planner.plan(other, X, 64 << 30, cost_model=cm,
+                          chunks_options=(2,),
+                          balance_options=[other.balance])
+    assert report.cost_model_stale is not None
+    assert "checkpoint" in report.cost_model_stale
+    assert all(p.priced_by == "analytic" for p in report.candidates)
+    assert "STALE" in report.table()
+
+
+def test_plan_cost_model_foreign_balance_prices_analytic():
+    """Measured per-stage atoms are tied to the measured cut: a
+    candidate at a DIFFERENT balance must stay analytic (mixed
+    frontier), in one consistent ranking unit."""
+    pipe = _mpmd_model(checkpoint="never")
+    cm = _synthetic_cost_model(pipe)
+    report = planner.plan(
+        pipe, X, 64 << 30, cost_model=cm, chunks_options=(2,),
+        balance_options=[pipe.balance, (1, 3)],
+    )
+    by_balance = {}
+    for p in report.candidates:
+        by_balance.setdefault(p.balance, set()).add(p.priced_by)
+    assert by_balance[(2, 2)] == {"measured"}
+    assert by_balance[(1, 3)] == {"analytic"}
+
+
+def test_plan_cost_model_spmd_pricing(cpu_devices):
+    """The SPMD frontier prices through the same atoms: candidates at
+    the measured widths re-rank measured; the remat axis flips exactly
+    like the MPMD twin."""
+    block = chain([layer_norm(name="ln"), dense(16, name="fc")],
+                  name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="never")
+    cm = _synthetic_cost_model(pipe)
+    report = planner.plan(
+        pipe, X, 64 << 30, cost_model=cm, chunks_options=(2,),
+        schedules=["fill_drain"], megastep_options=[1],
+    )
+    modes = {p.checkpoint: p for p in report.candidates
+             if p.policy is None and p.feasible}
+    assert modes["always"].priced_by == "measured"
+    assert modes["always"].makespan_measured is not None
+    # bwd >> bwd_remat: full remat must outrank storing residuals.
+    assert (modes["always"].predicted_mfu
+            > modes["never"].predicted_mfu)
+
+
+def test_plan_cost_model_derived_buckets_report_mixed():
+    """A profile measured under 'never' has no remat'd backward: plans
+    needing that bucket price through the documented derivation and
+    must say so (priced_by='mixed', never 'measured')."""
+    from torchgpipe_tpu.obs.costmodel import (
+        CellCost, CostModel, config_fingerprint,
+    )
+
+    pipe = _mpmd_model(checkpoint="never")
+    cells = {}
+    for j in range(2):
+        cells[(j, "fwd")] = CellCost(1e-3, 4)
+        cells[(j, "bwd")] = CellCost(2e-3, 4)  # no bwd_remat bucket
+    cm = CostModel(fingerprint=config_fingerprint(pipe), cells=cells)
+    report = planner.plan(pipe, X, 64 << 30, cost_model=cm,
+                          chunks_options=(2,),
+                          balance_options=[pipe.balance])
+    assert report.candidates
+    assert all(p.priced_by == "mixed" for p in report.candidates
+               if p.predicted_mfu is not None)
+
+
+def test_apply_plan_carries_tracer_for_the_replan_loop():
+    """apply_plan must keep the runtime configuration attached: the
+    per-cell tracer (the NEXT measurement's source), the stage devices,
+    and the declared compute dtype — a mid-training replan must not
+    silently change placement or the precision-drift rule's gating."""
+    from torchgpipe_tpu.utils.tracing import Timeline
+
+    tracer = Timeline(sync=True)
+    pipe = _mpmd_model(checkpoint="always", tracer=tracer,
+                       compute_dtype=jnp.bfloat16,
+                       hbm_budget_bytes=64 << 30)
+    report = planner.plan(pipe, X, 64 << 30, chunks_options=(2,),
+                          balance_options=[pipe.balance])
+    applied = planner.apply_plan(pipe, report.best)
+    assert applied.tracer is tracer
+    assert applied.hbm_budget_bytes == 64 << 30
+    assert applied.devices == pipe.devices
+    assert applied.compute_dtype == jnp.bfloat16
+    # The layers arrive already precision-wrapped; a rebuild must not
+    # double-wrap them.
+    assert applied.layers is pipe.layers or applied.layers == pipe.layers
+
+
+def test_apply_plan_refuses_deferred_batch_norm_rebuild():
+    """Deferred-BN layers were converted for the ORIGINAL chunks (stats
+    commit on the chunks-th micro-batch); a rebuild at the plan's
+    chunks would commit at the wrong cadence — refuse didactically."""
+    pipe = _mpmd_model(checkpoint="always", deferred_batch_norm=True,
+                       hbm_budget_bytes=64 << 30)
+    report = planner.plan(pipe, X, 64 << 30, chunks_options=(2,),
+                          balance_options=[pipe.balance])
+    with pytest.raises(ValueError, match="deferred-batch-norm"):
+        planner.apply_plan(pipe, report.best)
+
+
+@pytest.mark.slow  # two subprocess CLI runs incl. a full measured trace
+def test_cost_model_cli_round_trip(tmp_path):
+    """The CLI pair: trace_report --cost-model persists a measured
+    profile; plan_report --cost-model re-ranks with it (rc 0) and
+    refuses a stale fingerprint (rc 1)."""
+    import pathlib
+    import subprocess
+    import sys
+
+    from tests.subproc_env import REPO, cpu_subproc_env
+
+    cm_path = str(tmp_path / "cm.json")
+    proc = subprocess.run(
+        [sys.executable,
+         str(pathlib.Path(REPO) / "tools" / "trace_report.py"),
+         "--steps", "1", "--cost-model", cm_path],
+        env=cpu_subproc_env(), capture_output=True, text=True,
+        timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "cost model:" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable,
+         str(pathlib.Path(REPO) / "tools" / "plan_report.py"),
+         "--cost-model", cm_path],
+        env=cpu_subproc_env(), capture_output=True, text=True,
+        timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "priced_by=" in proc.stdout
+    # A mismatched configuration is stale: exit 1, didactic message.
+    proc = subprocess.run(
+        [sys.executable,
+         str(pathlib.Path(REPO) / "tools" / "plan_report.py"),
+         "--cost-model", cm_path, "--mpmd-schedule", "1f1b"],
+        env=cpu_subproc_env(), capture_output=True, text=True,
+        timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    assert "STALE" in proc.stderr
+
+
+@pytest.mark.slow  # a full (tiny) planner search in a subprocess
+def test_replan_verify_gate():
+    """ci_lint step 10: the skewed synthetic cost model flips the
+    winner and the flipped plan round-trips through apply_plan."""
+    import pathlib
+    import subprocess
+    import sys
+
+    from tests.subproc_env import REPO, cpu_subproc_env
+
+    proc = subprocess.run(
+        [sys.executable,
+         str(pathlib.Path(REPO) / "tools" / "replan_verify.py")],
+        env=cpu_subproc_env(), capture_output=True, text=True,
+        timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "measured winner 'always'" in proc.stdout

@@ -1,0 +1,288 @@
+"""The observe → replan loop, end to end (obs.costmodel + obs.replan).
+
+The acceptance spine of the profile-guided replanning PR: train with an
+artificially slowed stage (the ``slow_at`` fault-injection hook),
+assert ``ReplanOnDrift`` fires at a megastep boundary, applies a
+CERTIFIED plan via the existing ``apply_plan`` without restarting the
+process, keeps the loss trajectory (params carried), and records the
+replan as an event on the metrics registry AND the flight recorder
+(dump round-trips).  Guard rails — boundary discipline, SPMD
+stand-down (scan-granularity timelines cannot price cells), param
+repartitioning across a balance change — each get their own test.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchgpipe_tpu import GPipe, obs
+from torchgpipe_tpu.layers import named
+from torchgpipe_tpu.obs.costmodel import config_fingerprint
+from torchgpipe_tpu.obs.flightrec import FlightRecorder, load_dump
+from torchgpipe_tpu.obs.replan import ReplanOnDrift
+from torchgpipe_tpu.ops import dense, gelu
+from torchgpipe_tpu.resilience import faults
+from torchgpipe_tpu.utils.tracing import Timeline
+
+
+def mse(out, tgt):
+    return jnp.mean((out.astype(jnp.float32) - tgt) ** 2)
+
+
+def _layers():
+    return named([
+        dense(16, name="fc1"), gelu("a1"),
+        dense(16, name="fc2"), dense(8, name="head"),
+    ])
+
+
+def _data():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 16))
+    y = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+    return x, y
+
+
+# --------------------------------------------------------------------- #
+# the acceptance test: slowed stage -> drift -> certified replan        #
+# --------------------------------------------------------------------- #
+
+
+def test_replan_on_drift_end_to_end(tmp_path):
+    """Deliberately suboptimal start (full recompute at 2 chunks) plus a
+    slowed stage 0: the measured drift trips at the first boundary, the
+    hook applies the planner's certified winner in-process, params ride
+    through, and the loss keeps falling."""
+    x, y = _data()
+    tracer = Timeline(sync=True)
+    pipe = GPipe(_layers(), balance=[2, 2], chunks=4,
+                 checkpoint="always", tracer=tracer,
+                 hbm_budget_bytes=64 << 30)
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    params, state = pipe.init(jax.random.PRNGKey(0), spec)
+    opt = optax.sgd(1e-2)
+    opt_state = pipe.init_opt_state(opt, params)
+    step = pipe.make_train_step(opt, mse, donate=False)
+
+    reg = obs.MetricsRegistry()
+    dump_path = os.path.join(tmp_path, "rank0.json")
+    rec = FlightRecorder(rank=0, dump_path=dump_path)
+    store = os.path.join(tmp_path, "cost_model.json")
+    hook = ReplanOnDrift(
+        spec, interval=2, registry=reg, recorder=rec, store_path=store,
+        planner_options={
+            "chunks_options": (2, 4),
+            "balance_options": [pipe.balance],
+        },
+    )
+
+    losses = []
+    # Warm-up (compiles stay out of the measured spans), then train two
+    # recorded steps with stage 0 slowed ~20ms per cell.
+    out = step(params, opt_state, state, x, y)
+    jax.block_until_ready(out[0])
+    tracer.reset()
+    res = None
+    with faults.inject(slow_at=(0, 0.02)):
+        for i in range(2):
+            loss, params, opt_state, state, _aux = step(
+                params, opt_state, state, x, y
+            )
+            losses.append(float(loss))
+            res = hook.check(
+                pipe, i + 1, params=params, state=state,
+                opt_state=opt_state,
+            )
+            if res is not None:
+                break
+
+    assert res is not None, "the slowed stage did not trigger a replan"
+    assert res.event.step == 2  # interval=2: the first boundary
+    assert hook.events == [res.event]
+    # The applied plan is certified, feasible and genuinely different.
+    assert res.plan.feasible and res.plan.certified
+    assert config_fingerprint(res.pipe) != res.event.from_config
+    assert config_fingerprint(res.pipe) == res.event.to_config
+    assert res.event.from_config["checkpoint"] == "always"
+    # Measured pricing drove it: the winner was priced from the model.
+    assert res.plan.priced_by in ("measured", "mixed")
+    assert res.plan.makespan_measured is not None
+
+    # The replan is a recorded incident on every surface.
+    assert reg.counter("replan_total", labels=("engine",)).value(
+        engine="mpmd") == 1
+    kinds = [e.kind for e in rec.events()]
+    assert "replan" in kinds
+    rec.dump()
+    dumped = load_dump(dump_path)
+    replans = [e for e in dumped.events if e.kind == "replan"]
+    assert replans and "from=" in replans[0].detail
+    assert "to=" in replans[0].detail
+
+    # The persistent store holds the measured profile (fresh for the
+    # MEASURED config, by construction).
+    with open(store) as f:
+        persisted = json.load(f)
+    assert persisted["fingerprint"] == res.event.from_config
+
+    # No restart: params carried (same cut -> pass-through), training
+    # continues on the applied pipe and the loss keeps improving.
+    pipe2, params2, state2 = res.pipe, res.params, res.state
+    assert pipe2.tracer is tracer  # the tracer rides along, reset
+    assert tracer.events == []
+    step2 = pipe2.make_train_step(opt, mse, donate=False)
+    opt_state2 = res.opt_state
+    assert opt_state2 is not None  # same balance: state rode through
+    for i in range(2):
+        loss, params2, opt_state2, state2, _aux = step2(
+            params2, opt_state2, state2, x, y
+        )
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+# --------------------------------------------------------------------- #
+# guard rails                                                           #
+# --------------------------------------------------------------------- #
+
+
+def test_replan_fires_only_at_boundaries():
+    x, _y = _data()
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    tracer = Timeline(sync=True)
+    pipe = GPipe(_layers(), balance=[2, 2], chunks=2,
+                 checkpoint="always", tracer=tracer,
+                 hbm_budget_bytes=64 << 30)
+    hook = ReplanOnDrift(spec, interval=2)
+    # Off-interval steps never even observe (no reconcile attach).
+    assert hook.check(pipe, 1) is None
+    assert hook.check(pipe, 3) is None
+    assert hook.last_report is None
+
+
+def test_megastep_boundary_declared_on_both_engines(cpu_devices):
+    from torchgpipe_tpu import SpmdGPipe, make_mesh
+    from torchgpipe_tpu.layers import chain
+    from torchgpipe_tpu.ops import dense as dense_op, layer_norm
+
+    fused = GPipe(_layers(), balance=[4], chunks=2, fused=True,
+                  devices=[jax.devices()[0]], megastep=4)
+    assert fused.megastep_boundary(4) and fused.megastep_boundary(8)
+    assert not fused.megastep_boundary(3)
+    block = chain([layer_norm(name="ln"), dense_op(16, name="fc")],
+                  name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    spipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                      megastep=2)
+    assert spipe.megastep_boundary(2) and not spipe.megastep_boundary(1)
+
+
+def test_replan_spmd_scan_granularity_stands_down(cpu_devices):
+    """An SPMD pipe's timeline holds scan-granularity 'step' spans only
+    (no per-cell data), so the hook observes nothing priceable and
+    never replans — honestly, without crashing."""
+    from torchgpipe_tpu import SpmdGPipe, make_mesh
+    from torchgpipe_tpu.layers import chain
+    from torchgpipe_tpu.ops import dense as dense_op, layer_norm
+
+    block = chain([layer_norm(name="ln"), dense_op(16, name="fc")],
+                  name="blk")
+    mesh = make_mesh(2, 1, devices=cpu_devices[:2])
+    tracer = Timeline(sync=True)
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=mse,
+                     checkpoint="always", tracer=tracer,
+                     hbm_budget_bytes=64 << 30)
+    xs = jax.random.normal(jax.random.PRNGKey(0), (8, 16))
+    params = pipe.init(jax.random.PRNGKey(1), xs)
+    opt = optax.sgd(1e-2)
+    step = pipe.make_train_step(opt, donate=False)
+    opt_state = pipe.place_tree(opt.init(params))
+    for _ in range(2):
+        _, params, opt_state = step(params, opt_state, xs, xs)
+    hook = ReplanOnDrift(jax.ShapeDtypeStruct(xs.shape, xs.dtype))
+    assert hook.check(pipe, 1) is None
+    # It observed (spans exist) but could not price cells.
+    assert hook.last_report is not None
+    assert hook.last_report.coverage == 0.0
+    assert hook.cost_model is None
+
+
+def test_replan_survives_apply_plan_refusal(monkeypatch):
+    """apply_plan refuses some pipes by design (foreign mesh widths,
+    deferred BN); a refusal must surface as 'no replan', never as an
+    exception into the training loop."""
+    from torchgpipe_tpu.analysis import planner as planner_mod
+
+    x, y = _data()
+    tracer = Timeline(sync=True)
+    pipe = GPipe(_layers(), balance=[2, 2], chunks=4,
+                 checkpoint="always", tracer=tracer,
+                 hbm_budget_bytes=64 << 30)
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    params, state = pipe.init(jax.random.PRNGKey(0), spec)
+    out = pipe.value_and_grad(params, state, x, y, mse)
+    jax.block_until_ready(out[:2])
+    tracer.reset()
+    with faults.inject(slow_at=(0, 0.02)):
+        for _ in range(2):
+            out = pipe.value_and_grad(params, state, x, y, mse)
+            jax.block_until_ready(out[:2])
+
+    def refusing_apply(_pipe, _plan):
+        raise ValueError("apply_plan cannot resize a device mesh")
+
+    monkeypatch.setattr(planner_mod, "apply_plan", refusing_apply)
+    hook = ReplanOnDrift(
+        spec, interval=1,
+        planner_options={"chunks_options": (2, 4),
+                         "balance_options": [pipe.balance]},
+    )
+    assert hook.check(pipe, 1) is None  # refused, not raised
+    assert hook.events == []
+    assert hook.last_report is not None  # it DID observe
+
+
+def test_repartition_round_trip_across_cuts():
+    """Params initialized under one cut, re-split onto another, compute
+    the same forward — the replan carry path for balance changes."""
+    x, _y = _data()
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    a = GPipe(_layers(), balance=[2, 2], chunks=2)
+    b = GPipe(_layers(), balance=[1, 3], chunks=2)
+    params, state = a.init(jax.random.PRNGKey(0), spec)
+    pb = b.place(b.repartition(params))
+    sb = b.place(b.repartition(state))
+    out_a, _ = a.apply(params, state, x)
+    out_b, _ = b.apply(pb, sb, x)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               rtol=1e-6)
+    with pytest.raises(ValueError, match="per-layer entries"):
+        b.repartition((params[0],))  # one stage of a 2-stage layout
+
+
+def test_slow_at_fault_shows_up_in_measured_spans():
+    """The chaos hook's contract: a slow_at plan lands INSIDE the
+    recorded span of exactly the targeted stage."""
+    x, y = _data()
+    tracer = Timeline(sync=True)
+    pipe = GPipe(_layers(), balance=[2, 2], chunks=2,
+                 checkpoint="never", tracer=tracer)
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    params, state = pipe.init(jax.random.PRNGKey(0), spec)
+    out = pipe.value_and_grad(params, state, x, y, mse)
+    jax.block_until_ready(out[:2])
+    tracer.reset()
+    with faults.inject(slow_at=(1, 0.01)):
+        out = pipe.value_and_grad(params, state, x, y, mse)
+        jax.block_until_ready(out[:2])
+    by_stage = {}
+    for e in tracer.events:
+        if e.name in ("fwd", "bwd"):
+            by_stage.setdefault(e.stage, []).append(e.duration)
+    assert min(by_stage[1]) >= 0.01
+    assert max(by_stage[0]) < 0.01

@@ -63,12 +63,19 @@ differently and must not share backend state):
    equivalence at the pinned tolerance, and the prefill bucket
    ladder's ``len(ladder)+1`` program-count bound certified by
    ``analysis.serving`` (docs/tuning.md packing section,
-   docs/serving.md ladder section).
+   docs/serving.md ladder section);
+10. ``tools/replan_verify.py`` (replan-verify) — the profile-guided
+   replanning contract: a deliberately skewed synthetic measured cost
+   model must FLIP the planner's certified winner vs the analytic
+   ranking (priced ``measured``), the flipped winner must round-trip
+   through ``apply_plan`` and re-certify clean, and a stale-fingerprint
+   model must be refused back to analytic pricing
+   (docs/observability.md, "closing the loop").
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
 / ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` /
-``--skip-postmortem`` / ``--skip-sharding`` / ``--skip-pack`` to run a
-subset, ``-v`` for per-target reports.
+``--skip-postmortem`` / ``--skip-sharding`` / ``--skip-pack`` /
+``--skip-replan`` to run a subset, ``-v`` for per-target reports.
 """
 
 from __future__ import annotations
@@ -103,6 +110,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-postmortem", action="store_true")
     ap.add_argument("--skip-sharding", action="store_true")
     ap.add_argument("--skip-pack", action="store_true")
+    ap.add_argument("--skip-replan", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -178,6 +186,11 @@ def main(argv: Sequence[str] | None = None) -> int:
         if args.verbose:
             cmd.append("-v")
         failures += _run("pack-verify", cmd) != 0
+    if not args.skip_replan:
+        cmd = [
+            sys.executable, str(REPO / "tools" / "replan_verify.py"),
+        ]
+        failures += _run("replan-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

@@ -19,6 +19,15 @@ braces check the ``plan-verify`` CI step runs; ``--ci`` loops the fast
 llama presets (tiny, small) with --verify, which is what
 ``tools/ci_lint.py`` invokes.  See docs/analysis.md (planner section)
 and docs/tuning.md.
+
+``--cost-model IN.json`` is the replan half of the profile-guided loop:
+load a measured cost model persisted by ``tools/trace_report.py
+--cost-model`` and re-rank with MEASURED per-cell pricing
+(``planner.plan(cost_model=...)``).  The pipe is rebuilt to the tiny
+MPMD shape the trace tool measures (override with ``--mpmd-schedule`` /
+``--mpmd-chunks`` / ``--mpmd-stages``); a cost model whose fingerprint
+does not match that configuration is STALE and exits 1 — re-measure
+rather than rank on a profile of a different plan.
 """
 
 from __future__ import annotations
@@ -115,6 +124,59 @@ def _plan_one(
     return 0
 
 
+def _plan_with_cost_model(
+    path: str, schedule: str, chunks: int, stages: int, budget_gib: float,
+) -> int:
+    """Re-rank the tiny MPMD pipe with a persisted measured cost model
+    (module docstring).  Exit 1 on a stale fingerprint."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tools.trace_report import build_tiny
+    from torchgpipe_tpu.analysis import planner
+    from torchgpipe_tpu.obs.costmodel import CostModel
+
+    cm = CostModel.load(path)
+    pipe, x, _tracer = build_tiny(schedule, chunks, stages)
+    stale = cm.stale_reason(pipe)
+    if stale is not None:
+        print(
+            f"cost model {path} is STALE for this configuration "
+            f"({stale}); re-measure with tools/trace_report.py "
+            "--cost-model, or match --mpmd-schedule/--mpmd-chunks/"
+            "--mpmd-stages to the measured run",
+            file=sys.stderr,
+        )
+        return 1
+    spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), x
+    )
+    budget = int(budget_gib * 2 ** 30)
+    report = planner.plan(
+        pipe, spec, hbm_budget_bytes=budget, cost_model=cm,
+        balance_options=[pipe.balance],
+    )
+    print(f"# plan_report: measured cost model {path}")
+    print(cm.describe())
+    print(report.table())
+    best = report.best
+    if best is None:
+        print("\nNO certified candidate fits the HBM budget",
+              file=sys.stderr)
+        return 1
+    print(
+        f"best: schedule={best.schedule!r} checkpoint={best.checkpoint!r} "
+        f"chunks={best.chunks} priced_by={best.priced_by} "
+        f"mfu~{best.predicted_mfu:.4f}"
+        + (
+            f" measured-span={best.makespan_measured * 1e3:.2f}ms"
+            if best.makespan_measured is not None else ""
+        )
+    )
+    return 0
+
+
 def main(argv: Sequence[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--preset", default="1b",
@@ -135,7 +197,29 @@ def main(argv: Sequence[str] | None = None) -> int:
                     help="plan-verify gate: search + verify the fast llama "
                          "presets (tiny, small) and exit non-zero on any "
                          "failure")
+    ap.add_argument("--cost-model", metavar="IN.json",
+                    help="re-rank with a measured cost model persisted "
+                         "by tools/trace_report.py --cost-model (exit 1 "
+                         "on a stale fingerprint)")
+    ap.add_argument("--mpmd-schedule", choices=("gpipe", "1f1b"),
+                    default="gpipe",
+                    help="--cost-model pipe: schedule of the measured "
+                         "tiny MPMD run")
+    ap.add_argument("--mpmd-chunks", type=int, default=4,
+                    help="--cost-model pipe: chunks of the measured run")
+    ap.add_argument("--mpmd-stages", type=int, default=2,
+                    help="--cost-model pipe: stages of the measured run")
     args = ap.parse_args(argv)
+
+    if args.cost_model:
+        sys.path.insert(
+            0,
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        return _plan_with_cost_model(
+            args.cost_model, args.mpmd_schedule, args.mpmd_chunks,
+            args.mpmd_stages, args.budget_gib,
+        )
 
     # The pp mesh needs --stages host devices; set the flag BEFORE the
     # first jax import in this process.

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""replan-verify gate: measured-cost replanning must actually replan.
+
+The profile-guided loop (docs/observability.md, "closing the loop") is
+only worth its plumbing if a measured cost model can CHANGE the
+planner's answer and the changed answer round-trips through
+``apply_plan``.  This gate proves both on a tiny CPU pipe with
+deliberately skewed synthetic costs:
+
+1. **Analytic baseline** — ``planner.plan`` over the checkpoint-mode
+   axis of a tiny MPMD pipe ranks ``never`` first (no recompute is the
+   least work; PR 6's rank-order rung measures this on real hardware).
+2. **Skewed measurement flips the winner** — a synthetic
+   :class:`~torchgpipe_tpu.obs.costmodel.CostModel` describing a
+   machine where storing residuals makes the backward slow and the
+   remat'd backward cheap (``bwd >> bwd_remat`` — unphysical on this
+   host, which is the point: the ANALYTIC model can never produce it)
+   must flip the certified winner to ``always``, priced ``measured``.
+3. **apply_plan round-trips** — the measured winner applies onto the
+   pipe, the applied config matches the plan, and the event-graph
+   verifier re-certifies it clean.
+4. **Staleness is honest** — the same model against a reconfigured
+   pipe is refused (analytic fallback + ``cost_model_stale`` note).
+
+Pure host work (traced jaxprs + event graphs; nothing compiles for an
+accelerator), seconds per run::
+
+    python tools/replan_verify.py          # exit 0 iff all hold
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+from typing import Optional, Sequence
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    del argv
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from torchgpipe_tpu.analysis import planner
+    from torchgpipe_tpu.gpipe import GPipe
+    from torchgpipe_tpu.layers import named
+    from torchgpipe_tpu.obs.costmodel import (
+        CellCost,
+        CostModel,
+        config_fingerprint,
+    )
+    from torchgpipe_tpu.ops import dense, gelu
+
+    layers = named([
+        dense(32, name="fc1"), gelu("a1"),
+        dense(32, name="fc2"), dense(16, name="head"),
+    ])
+    pipe = GPipe(layers, balance=[2, 2], chunks=2, checkpoint="never",
+                 hbm_budget_bytes=64 * 2 ** 30)
+    x = jax.ShapeDtypeStruct((8, 32), jax.numpy.float32)
+    budget = 64 * 2 ** 30
+    options = {
+        "chunks_options": (2,),
+        "balance_options": [pipe.balance],
+    }
+
+    def fail(msg: str) -> int:
+        print(f"[replan-verify] FAIL: {msg}", file=sys.stderr, flush=True)
+        return 1
+
+    # 1. analytic baseline: least work wins.
+    analytic = planner.plan(pipe, x, budget, **options)
+    a_best = analytic.best
+    if a_best is None or a_best.checkpoint != "never":
+        return fail(
+            f"analytic baseline should rank checkpoint='never' first, "
+            f"got {a_best and a_best.checkpoint!r}"
+        )
+    if a_best.priced_by != "analytic":
+        return fail(
+            f"no cost model given, yet priced_by={a_best.priced_by!r}"
+        )
+
+    # 2. skewed synthetic measurement: storing residuals is expensive,
+    # replaying is cheap — the measured ranking must flip to 'always'.
+    cells = {}
+    for stage in (0, 1):
+        cells[(stage, "fwd")] = CellCost(1e-3, 4)
+        cells[(stage, "bwd")] = CellCost(8e-3, 4)
+        cells[(stage, "bwd_remat")] = CellCost(2e-3, 4)
+    cm = CostModel(fingerprint=config_fingerprint(pipe), cells=cells,
+                   source="synthetic")
+    measured = planner.plan(pipe, x, budget, cost_model=cm, **options)
+    m_best = measured.best
+    if m_best is None:
+        return fail("measured search produced no certified plan")
+    if m_best.priced_by != "measured":
+        return fail(
+            f"winner should be priced 'measured', got "
+            f"{m_best.priced_by!r}"
+        )
+    if m_best.checkpoint == a_best.checkpoint:
+        return fail(
+            "the skewed cost model did not flip the winner "
+            f"(both rankings chose {m_best.checkpoint!r})"
+        )
+    if m_best.checkpoint != "always":
+        return fail(
+            f"skew bwd>>bwd_remat should rank 'always' first, got "
+            f"{m_best.checkpoint!r}"
+        )
+    if m_best.makespan_measured is None or m_best.makespan_measured <= 0:
+        return fail("measured winner carries no measured makespan")
+
+    # 3. apply_plan round-trips and re-certifies.
+    applied = planner.apply_plan(pipe, m_best)
+    if (applied.checkpoint, applied.chunks, applied.schedule) != (
+        m_best.checkpoint, m_best.chunks, m_best.schedule
+    ):
+        return fail(
+            f"apply_plan did not round-trip: applied "
+            f"({applied.schedule}, {applied.checkpoint}, "
+            f"{applied.chunks}) != plan ({m_best.schedule}, "
+            f"{m_best.checkpoint}, {m_best.chunks})"
+        )
+    findings = planner.verify_plan(pipe, m_best)
+    if findings:
+        return fail(
+            f"measured winner fails re-verification: "
+            f"{findings[0].message[:100]}"
+        )
+
+    # 4. staleness: the model must refuse the reconfigured pipe.
+    stale_report = planner.plan(applied, x, budget, cost_model=cm,
+                                **options)
+    if stale_report.cost_model_stale is None:
+        return fail(
+            "a cost model measured under 'never' was accepted as fresh "
+            "for the replanned 'always' pipe"
+        )
+    if any(p.priced_by != "analytic" for p in stale_report.candidates):
+        return fail("stale model leaked into candidate pricing")
+
+    print(
+        "[replan-verify] OK: analytic winner "
+        f"{a_best.checkpoint!r} -> measured winner "
+        f"{m_best.checkpoint!r} (priced {m_best.priced_by}, span "
+        f"{m_best.makespan_measured * 1e3:.2f}ms), apply_plan "
+        "round-trips + re-certifies, stale model refused",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

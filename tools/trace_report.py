@@ -10,7 +10,16 @@ graph (:func:`torchgpipe_tpu.obs.reconcile`)::
     python tools/trace_report.py --schedule 1f1b      # PipeDream-flush
     python tools/trace_report.py --chrome trace.json  # Perfetto overlay
     python tools/trace_report.py --reconcile          # drift gate
+    python tools/trace_report.py --cost-model cm.json # persist profile
     python tools/trace_report.py --dumps rank*.json --chrome merged.json
+
+``--cost-model OUT.json`` distills the measured reconciliation into a
+persistent :class:`torchgpipe_tpu.obs.costmodel.CostModel` (per-cell
+medians keyed on the run's config fingerprint) — the observe half of
+the profile-guided replanning loop; feed it back with
+``tools/plan_report.py --cost-model OUT.json``.  With ``--dumps`` it
+distills from the flight-recorder dumps instead (the
+``CostModel.from_dumps`` path).
 
 ``--dumps`` switches the --chrome export to the MULTI-RANK overlay:
 instead of running the tiny model, the given per-rank flight-recorder
@@ -115,6 +124,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="measured-minus-predicted bubble tolerance "
                          "(default: obs.BUBBLE_TOLERANCE)")
     ap.add_argument("--min-coverage", type=float, default=0.95)
+    ap.add_argument("--cost-model", metavar="OUT.json",
+                    help="distill and persist a measured cost model "
+                         "from this run (or from --dumps)")
     ap.add_argument("--dumps", nargs="+", metavar="DUMP.json",
                     help="merge these per-rank flight-recorder dumps "
                          "into the --chrome trace instead of running "
@@ -144,17 +156,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         load_dump = flightrec.load_dump
         merged_chrome_trace = flightrec.merged_chrome_trace
 
-        if not args.chrome:
-            ap.error("--dumps needs --chrome OUT.json")
+        if not args.chrome and not args.cost_model:
+            ap.error("--dumps needs --chrome OUT.json and/or "
+                     "--cost-model OUT.json")
         loaded = [load_dump(p) for p in args.dumps]
-        merged_chrome_trace(loaded, args.chrome)
-        # Transport-only recorders may carry no rank; keep file order.
-        ranks = [d.rank for d in loaded]
-        print(
-            f"merged chrome trace: {args.chrome} — {len(loaded)} rank "
-            f"dump(s) {ranks} (open in ui.perfetto.dev)",
-            flush=True,
-        )
+        if args.chrome:
+            merged_chrome_trace(loaded, args.chrome)
+            # Transport-only recorders may carry no rank; keep file order.
+            ranks = [d.rank for d in loaded]
+            print(
+                f"merged chrome trace: {args.chrome} — {len(loaded)} rank "
+                f"dump(s) {ranks} (open in ui.perfetto.dev)",
+                flush=True,
+            )
+        if args.cost_model:
+            # Distillation is a planner-adjacent operation: unlike the
+            # chrome merge above it goes through the full package (the
+            # fingerprint and checkpoint-stop vocabulary live there).
+            from torchgpipe_tpu.obs.costmodel import CostModel
+
+            cm = CostModel.from_dumps(loaded)
+            cm.save(args.cost_model)
+            print(f"cost model: {args.cost_model}", flush=True)
+            print(cm.describe(), flush=True)
         return 0
 
     import jax
@@ -177,6 +201,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         obs.overlay_chrome_trace(report, args.chrome)
         print(f"chrome trace: {args.chrome} (open in ui.perfetto.dev)",
               flush=True)
+    if args.cost_model:
+        cm = report.cost_model(model)
+        cm.save(args.cost_model)
+        print(f"cost model: {args.cost_model}", flush=True)
+        print(cm.describe(), flush=True)
     if not args.reconcile:
         return 0
     failures = []

@@ -283,6 +283,17 @@ class Plan:
     zero: bool = False
     opt_state_bytes: int = 0
     comm_bytes: int = 0
+    # Profile-guided pricing (plan(cost_model=...)): which cost source
+    # ranked this candidate — 'analytic' (walker FLOPs), 'measured'
+    # (every cell priced from the cost model's measured atoms) or
+    # 'mixed' (a missing backward bucket was derived, see
+    # obs.costmodel.CostModel.stage_atoms).  Both makespans are kept so
+    # the report can show prediction vs measurement side by side:
+    # ``makespan_analytic`` in the analytic cost unit (FLOPs of the
+    # critical path), ``makespan_measured`` in SECONDS.
+    priced_by: str = "analytic"
+    makespan_analytic: Optional[float] = None
+    makespan_measured: Optional[float] = None
     reason: str = ""
 
     def describe(self) -> str:
@@ -304,22 +315,37 @@ class Plan:
         )
         unroll = "full" if self.scan_unroll is True else self.scan_unroll
         mesh3d = f"{self.dp}x{self.tp}" + ("Z" if self.zero else "")
+        priced = {"analytic": "a", "measured": "M", "mixed": "x"}.get(
+            self.priced_by, "?"
+        )
+        span = (
+            f"{self.makespan_measured * 1e3:8.2f}ms"
+            if self.makespan_measured is not None else f"{'-':>10}"
+        )
         return (
             f"{self.schedule:<11} {self.checkpoint:<12} "
             f"{self.policy or '-':<20} m={self.chunks:<3} "
             f"K={self.megastep:<3} u={unroll:<4} dxt={mesh3d:<6} "
             f"bal={bal:<9} "
-            f"mfu~{mfu:<8} bubble={bub:<6} "
+            f"mfu~{mfu:<8} bubble={bub:<6} p={priced} span={span} "
             f"hwm={self.hwm_bytes / GiB:6.2f} GiB{host}  {status}"
         )
 
 
 @dataclasses.dataclass
 class PlanReport:
-    """Ranked plans, feasible-and-certified first, best MFU first."""
+    """Ranked plans, feasible-and-certified first, best MFU first.
+
+    ``cost_model_stale`` is set when a ``cost_model=`` was passed whose
+    fingerprint no longer matches the pipe's current configuration: the
+    search then fell back to analytic pricing (every plan
+    ``priced_by='analytic'``) and the note says why — the
+    ``stale-cost-model`` lint rule and ``tools/plan_report.py`` surface
+    it."""
 
     candidates: List[Plan]
     hbm_budget_bytes: int
+    cost_model_stale: Optional[str] = None
 
     @property
     def best(self) -> Optional[Plan]:
@@ -332,10 +358,16 @@ class PlanReport:
         head = (
             f"{'schedule':<11} {'checkpoint':<12} {'policy':<20} "
             f"{'m':<5} {'K':<5} {'u':<6} {'dpxtp':<10} {'balance':<13} "
-            f"{'pred-mfu':<13} {'bubble':<13} "
+            f"{'pred-mfu':<13} {'bubble':<13} {'priced/span':<22} "
             f"per-rank HWM (budget {self.hbm_budget_bytes / GiB:.2f} GiB)"
         )
-        return "\n".join([head] + [p.describe() for p in self.candidates])
+        rows = [head] + [p.describe() for p in self.candidates]
+        if self.cost_model_stale:
+            rows.append(
+                f"# cost model STALE ({self.cost_model_stale}) — "
+                "analytic pricing used"
+            )
+        return "\n".join(rows)
 
 
 def _ranked(candidates: List[Plan], budget: int) -> PlanReport:
@@ -472,6 +504,38 @@ def _spmd_cost_fn(
     return cost
 
 
+def _spmd_measured_cost_fn(
+    schedule: str,
+    stop: int,
+    atoms: Dict[int, Tuple[float, float, float]],
+    scale: float,
+) -> Callable[[ev.Event], float]:
+    """The measured twin of :func:`_spmd_cost_fn`: per-event SECONDS
+    from a cost model's per-stage ``(fwd, bwd, bwd_remat)`` atoms
+    (:meth:`torchgpipe_tpu.obs.costmodel.CostModel.stage_atoms`),
+    ``scale`` carrying the chunks re-scaling (cell rows go as
+    ``1/chunks``).  Same phase structure: checkpointed micro-batches
+    pay the remat'd backward; zero-bubble splits the backward into B
+    (half, plus the measured replay delta when checkpointed) and W."""
+
+    def cost(e: ev.Event) -> float:
+        f, b, br = atoms[e.stage]
+        if e.phase == ev.FWD:
+            s = f
+        elif e.phase == ev.BWD:
+            if schedule == "zb":
+                s = 0.5 * b + (max(br - b, 0.0) if e.mb < stop else 0.0)
+            else:
+                s = br if e.mb < stop else b
+        elif e.phase == ev.WGT:
+            s = 0.5 * b
+        else:
+            s = 0.0
+        return s * scale
+
+    return cost
+
+
 def _layout_reject_reason(layout: Any) -> Optional[str]:
     """Why a candidate layout fails sharding certification, or None.
 
@@ -515,6 +579,7 @@ def _plan_spmd(
     overhead_bytes: int,
     param_scale: float,
     real_token_fraction: float = 1.0,
+    cost_model: Any = None,
 ) -> PlanReport:
     from torchgpipe_tpu import tune
     from torchgpipe_tpu.analysis import sharding as shd
@@ -836,6 +901,56 @@ def _plan_spmd(
                         bubble = max(
                             0.0, 1.0 - sum(busy) / (g.n_ranks * span)
                         )
+                    # Profile-guided pricing: when a fresh cost model
+                    # covers this stage structure at these widths, the
+                    # candidate's makespan is re-priced from measured
+                    # per-stage atoms (seconds), then calibrated back
+                    # into the analytic FLOP unit by pinning the total
+                    # measured forward to the total analytic forward —
+                    # so measured- and analytic-priced candidates rank
+                    # in ONE unit and only the measured RELATIVE
+                    # structure (backward ratios, stage skew) replaces
+                    # the analytic guess.
+                    priced_by = "analytic"
+                    span_rank = span
+                    span_measured = None
+                    # v > 1 stands down: interleaved events carry GLOBAL
+                    # stage ids (c*n + j, model chunks) while the model's
+                    # atoms are per PHYSICAL stage — indexing would lie.
+                    if v == 1 and cost_model is not None and (
+                        cost_model.prices_structure(
+                            engine="spmd", n_stages=n, dp=dp, tp=tp
+                        )
+                    ):
+                        m_atoms, m_exact = cost_model.stage_atoms(n)
+                        k_scale = (
+                            float(cost_model.fingerprint["chunks"]) / chunks
+                        )
+                        if m_atoms is not None:
+                            meas_fwd = sum(
+                                a[0] for a in m_atoms.values()
+                            ) * k_scale
+                            ana_fwd = n * fwd
+                            if meas_fwd > 0 and ana_fwd > 0:
+                                cost_s = _spmd_measured_cost_fn(
+                                    schedule, cost_stop, m_atoms, k_scale
+                                )
+                                try:
+                                    span_s, busy_s = ev.makespan(g, cost_s)
+                                except ValueError:
+                                    span_s = None
+                                if span_s is not None:
+                                    span_measured = span_s
+                                    span_rank = span_s * (ana_fwd / meas_fwd)
+                                    if g.n_ranks * span_s > 0:
+                                        bubble = max(
+                                            0.0,
+                                            1.0 - sum(busy_s)
+                                            / (g.n_ranks * span_s),
+                                        )
+                                    priced_by = (
+                                        "measured" if m_exact else "mixed"
+                                    )
                     lane_comm = chunks * cell_comm + grad_sync_lane
                     comm_flops = shd.COMM_FLOPS_PER_BYTE * lane_comm
                     # param_scale's head-room splits into the gradient
@@ -864,13 +979,16 @@ def _plan_spmd(
                         for K in mega_space:
                             for u in scan_unroll_options(schedule):
                                 mfu = None
-                                if span is not None and model_flops is not None:
+                                if (
+                                    span_rank is not None
+                                    and model_flops is not None
+                                ):
                                     disc = (
                                         tune.UNROLL_LANE_DISCOUNT
                                         if u is True else 1.0
                                     )
                                     lane = (
-                                        span * disc + epilogue
+                                        span_rank * disc + epilogue
                                         + comm_flops
                                         + tune.DISPATCH_OVERHEAD_FLOPS / K
                                     )
@@ -897,6 +1015,9 @@ def _plan_spmd(
                                     scan_unroll=u, dp=dp, tp=tp, zero=zero,
                                     opt_state_bytes=opt_bytes,
                                     comm_bytes=int(lane_comm),
+                                    priced_by=priced_by,
+                                    makespan_analytic=span,
+                                    makespan_measured=span_measured,
                                     reason=(
                                         "" if feasible
                                         else "over HBM budget"
@@ -945,6 +1066,7 @@ def _plan_mpmd(
     overhead_bytes: int,
     param_scale: float,
     real_token_fraction: float = 1.0,
+    cost_model: Any = None,
 ) -> PlanReport:
     from torchgpipe_tpu import tune
     from torchgpipe_tpu.balance import layer_flops
@@ -983,6 +1105,7 @@ def _plan_mpmd(
                         stage_fwd, model_flops, hbm_budget_bytes,
                         overhead_bytes, profile_cache,
                         GPipe, checkpoint_stop, tune,
+                        cost_model=cost_model,
                     ))
     return _ranked(plans, hbm_budget_bytes)
 
@@ -1002,6 +1125,7 @@ def _score_mpmd_candidate(
     GPipe: Any,
     checkpoint_stop: Callable,
     tune: Any,
+    cost_model: Any = None,
 ) -> Plan:
     def rejected(reason: str) -> Plan:
         return Plan(
@@ -1058,6 +1182,8 @@ def _score_mpmd_candidate(
     host = max(cert.host_per_rank, default=0)
     feasible = hwm <= hbm_budget_bytes
     mfu = bubble = None
+    priced_by = "analytic"
+    span_analytic = span_measured = None
     if stage_fwd is not None:
         # stage_fwd is the FULL-batch forward cost; one schedule cell
         # computes a single micro-batch (1/m of the rows).
@@ -1072,14 +1198,56 @@ def _score_mpmd_candidate(
             return 0.0
 
         tax = tune.OFFLOAD_RANK_TAX if offload else 0.0
+        try:
+            span_analytic, _busy = ev.makespan(g, cost_of)
+        except ValueError:
+            span_analytic = None
         mfu, bubble = _graph_score(
             g, cost_of, model_flops, n, 0.0, lane_tax=tax
         )
+        # Profile-guided pricing (see the SPMD twin's comment): measured
+        # per-stage atoms price the candidate in seconds, calibrated
+        # back into the analytic FLOP unit by pinning the total
+        # measured forward to the total analytic forward — one ranking
+        # unit across measured- and analytic-priced candidates.
+        if cost_model is not None and cost_model.prices_structure(
+            engine="mpmd", n_stages=n, balance=tuple(balance)
+        ):
+            m_atoms, m_exact = cost_model.stage_atoms(n)
+            if m_atoms is not None:
+                k_scale = float(cost_model.fingerprint["chunks"]) / m
+
+                def cost_s(e: ev.Event) -> float:
+                    f_s, b_s, br_s = m_atoms[e.stage]
+                    if e.phase == ev.FWD:
+                        s = f_s
+                    elif e.phase == ev.BWD:
+                        s = br_s if e.mb < stop else b_s
+                    else:
+                        s = 0.0
+                    return s * k_scale
+
+                meas_fwd = sum(a[0] for a in m_atoms.values()) * k_scale
+                ana_fwd = sum(cell_fwd)
+                if meas_fwd > 0 and ana_fwd > 0:
+                    cal = ana_fwd / meas_fwd
+                    try:
+                        span_measured, _sb = ev.makespan(g, cost_s)
+                    except ValueError:
+                        span_measured = None
+                    if span_measured is not None:
+                        mfu, bubble = _graph_score(
+                            g, lambda e: cost_s(e) * cal, model_flops,
+                            n, 0.0, lane_tax=tax,
+                        )
+                        priced_by = "measured" if m_exact else "mixed"
     return Plan(
         engine="mpmd", schedule=schedule, balance=balance, chunks=chunks,
         checkpoint=mode, policy=None, virtual_stages=1,
         predicted_mfu=mfu, bubble_fraction=bubble, hwm_bytes=hwm,
         host_bytes=host, feasible=feasible, certified=True,
+        priced_by=priced_by, makespan_analytic=span_analytic,
+        makespan_measured=span_measured,
         reason="" if feasible else "over HBM budget",
     )
 
@@ -1105,10 +1273,27 @@ def plan(
     overhead_bytes: Optional[int] = None,
     param_scale: Optional[float] = None,
     real_token_fraction: float = 1.0,
+    cost_model: Any = None,
 ) -> PlanReport:
     """Search balance × schedule × chunks × remat × dispatch granularity
     × (dp, tp) mesh width × ZeRO statically and return the certified
     frontier.
+
+    ``cost_model`` (a :class:`torchgpipe_tpu.obs.costmodel.CostModel`,
+    distilled from a measured reconciliation or flight-recorder dumps)
+    turns the search profile-guided: candidates sharing the measured
+    stage structure (same engine / stage count / balance cut / mesh
+    widths) are re-priced with MEASURED per-stage atoms — the backward
+    split into plain and remat'd buckets, scaled across chunks —
+    calibrated into the analytic FLOP unit so measured- and
+    analytic-priced candidates rank together (``Plan.priced_by`` says
+    which source ranked each candidate; both makespans ride on the
+    plan).  Certification is UNCHANGED — memory, deadlock and sharding
+    stay static; only the ranking listens to the measurement.  A STALE
+    model (fingerprint no longer matching the pipe's current config —
+    :meth:`~torchgpipe_tpu.obs.costmodel.CostModel.stale_reason`) is
+    ignored with a note on ``PlanReport.cost_model_stale`` (the
+    ``stale-cost-model`` lint rule's condition).
 
     ``real_token_fraction`` (``utils.data.real_token_fraction`` of the
     training batches) keeps predicted MFU honest on ragged data: the
@@ -1163,22 +1348,32 @@ def plan(
             f"real_token_fraction must be in [0, 1], got "
             f"{real_token_fraction}"
         )
+    stale: Optional[str] = None
+    if cost_model is not None:
+        stale = cost_model.stale_reason(pipe)
+        if stale is not None:
+            cost_model = None  # analytic fallback, noted on the report
     if isinstance(pipe, GPipe):
-        return _plan_mpmd(
+        report = _plan_mpmd(
             pipe, batch, hbm_budget_bytes,
             chunks_options=chunks_options,
             balance_options=balance_options,
             overhead_bytes=overhead, param_scale=scale,
             real_token_fraction=real_token_fraction,
+            cost_model=cost_model,
         )
-    return _plan_spmd(
-        pipe, batch, hbm_budget_bytes, target=target,
-        schedules=schedules, chunks_options=chunks_options,
-        megastep_opts=megastep_options, steps=steps,
-        mesh_options=mesh_options, zero_options=zero_options,
-        overhead_bytes=overhead, param_scale=scale,
-        real_token_fraction=real_token_fraction,
-    )
+    else:
+        report = _plan_spmd(
+            pipe, batch, hbm_budget_bytes, target=target,
+            schedules=schedules, chunks_options=chunks_options,
+            megastep_opts=megastep_options, steps=steps,
+            mesh_options=mesh_options, zero_options=zero_options,
+            overhead_bytes=overhead, param_scale=scale,
+            real_token_fraction=real_token_fraction,
+            cost_model=cost_model,
+        )
+    report.cost_model_stale = stale
+    return report
 
 
 def apply_plan(pipe: Any, chosen: Plan) -> Any:
@@ -1190,7 +1385,26 @@ def apply_plan(pipe: Any, chosen: Plan) -> Any:
     if chosen.engine == "mpmd":
         if not isinstance(pipe, GPipe):
             raise TypeError("an mpmd plan applies to a GPipe pipeline")
-        return GPipe(
+        if getattr(pipe, "_deferred_batch_norm", False):
+            raise ValueError(
+                "apply_plan cannot rebuild a deferred-batch-norm "
+                "pipeline: its layers were converted for the ORIGINAL "
+                "chunks (stats commit on the chunks-th micro-batch), so "
+                "a rebuilt pipe at the plan's chunks would commit at the "
+                "wrong cadence — rebuild the GPipe from unconverted "
+                "layers with the plan's settings instead"
+            )
+        # Carry the runtime configuration a replan loop depends on: the
+        # stage devices, the tracer (the NEXT measurement's source) and
+        # — where the chosen plan still supports them — the fused path
+        # and its megastep.  fused cannot express 1f1b or per-cell
+        # offload; the per-cell tracer records nothing under fused.
+        fused = (
+            bool(getattr(pipe, "fused", False))
+            and chosen.schedule == "gpipe"
+            and chosen.checkpoint != "offload"
+        )
+        applied = GPipe(
             pipe.layers,
             balance=list(chosen.balance or pipe.balance),
             chunks=chosen.chunks,
@@ -1199,8 +1413,18 @@ def apply_plan(pipe: Any, chosen: Plan) -> Any:
             loss_reduction=(
                 pipe.loss_reduction if chosen.schedule == "1f1b" else None
             ),
+            devices=list(pipe.devices),
+            fused=fused,
+            megastep=(getattr(pipe, "megastep", 1) if fused else 1),
+            tracer=(None if fused else getattr(pipe, "tracer", None)),
             hbm_budget_bytes=getattr(pipe, "hbm_budget_bytes", None),
         )
+        # pipe.layers already carry the precision policy's wrapping
+        # (applied at the ORIGINAL ctor) — re-passing compute_dtype
+        # would double-wrap, so only the declared attribute is restored
+        # (the precision-drift lint rule reads it off the pipe).
+        applied.compute_dtype = pipe.compute_dtype
+        return applied
     own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
     own_tp = pipe.mesh.shape[pipe.tp_axis] if pipe.tp_axis else 1
     if (chosen.dp, chosen.tp) != (own_dp, own_tp):

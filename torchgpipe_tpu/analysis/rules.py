@@ -815,15 +815,33 @@ def _check_dispatch_only_timeline(trace: PipelineTrace) -> List[Finding]:
     return check_dispatch_only_timeline(trace)
 
 
+def _check_stale_cost_model(trace: PipelineTrace) -> List[Finding]:
+    # Call-time import for the same obs/analysis cycle reason as
+    # _check_dispatch_only_timeline above.
+    from torchgpipe_tpu.obs.costmodel import check_stale_cost_model
+
+    return check_stale_cost_model(trace)
+
+
 def _register_obs_rules() -> None:
-    """The runtime-telemetry rule (obs.reconcile) — same single-registry
-    treatment as the schedule and planner families."""
+    """The runtime-telemetry rules (obs.reconcile / obs.costmodel) —
+    same single-registry treatment as the schedule and planner
+    families."""
     RULES.append(Rule(
         "dispatch-only-timeline",
         "a sync=False Timeline records dispatch intervals, not device "
         "durations — simulate_pipeline/obs.reconcile projections over it "
         "assume true per-cell device times; stands down on sync=True",
         _check_dispatch_only_timeline,
+    ))
+    RULES.append(Rule(
+        "stale-cost-model",
+        "a measured CostModel attached for drift checks must match the "
+        "pipe's current config fingerprint (schedule/chunks/remat/"
+        "balance/mesh widths) — a stale model silently degrades "
+        "planner.plan(cost_model=...) and drift checks to analytic "
+        "pricing; stands down when no model is attached or it is fresh",
+        _check_stale_cost_model,
     ))
 
 

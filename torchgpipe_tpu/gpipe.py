@@ -294,6 +294,43 @@ class GPipe:
             for j, stage_tree in enumerate(per_stage)
         )
 
+    def repartition(
+        self, per_stage: Tuple[Pytree, ...]
+    ) -> Tuple[List[Pytree], ...]:
+        """Regroup per-stage per-layer pytrees (params or state in the
+        :meth:`init` layout, possibly from a DIFFERENT balance cut)
+        onto THIS pipe's cut — the carry path when a replan
+        (:class:`torchgpipe_tpu.obs.replan.ReplanOnDrift`) or a manual
+        rebuild changes the balance: the old cut's stage lists flatten
+        back to the flat layer order and re-split by
+        ``self.partitions``.  Pair with :meth:`place` to commit the new
+        stages to their devices.  Per-stage OPTIMIZER states do not
+        repartition (their trees mirror a whole stage, not a layer) —
+        re-initialize them after a balance change."""
+        flat = [leaf for stage_list in per_stage for leaf in stage_list]
+        if len(flat) != len(self.layers):
+            raise ValueError(
+                f"repartition got {len(flat)} per-layer entries for a "
+                f"{len(self.layers)}-layer pipeline — pass params/state "
+                "exactly as init() (or a previous cut) produced them, "
+                "one entry per layer grouped per stage"
+            )
+        out: List[List[Pytree]] = []
+        i = 0
+        for part in self.partitions:
+            out.append(list(flat[i:i + len(part)]))
+            i += len(part)
+        return tuple(out)
+
+    def megastep_boundary(self, step: int) -> bool:
+        """True when ``step`` completed optimizer steps land on a
+        megastep boundary — the cadence checkpoint/preemption hooks run
+        at, and the only place
+        :class:`torchgpipe_tpu.obs.replan.ReplanOnDrift` may fire (a
+        replan can never land inside a compiled K-step program)."""
+        k = max(int(self.megastep or 1), 1)
+        return step % k == 0
+
     def state_dict(
         self,
         params: Tuple[Pytree, ...],

@@ -55,6 +55,7 @@ from torchgpipe_tpu.obs.registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    read_jsonl,
 )
 from torchgpipe_tpu.obs.reporter import StepReporter, measured_step_flops
 from torchgpipe_tpu.utils.tracing import Timeline, device_trace
@@ -79,6 +80,15 @@ _LAZY_EXPORTS = {
     "uniform_cost": "torchgpipe_tpu.obs.reconciliation",
     "BlockingEdge": "torchgpipe_tpu.obs.postmortem",
     "PostmortemReport": "torchgpipe_tpu.obs.postmortem",
+    # The profile-guided replanning layer (PR: observe -> replan) pulls
+    # in the planner; lazy for the same hot-import-path reason.
+    "COSTMODEL_VERSION": "torchgpipe_tpu.obs.costmodel",
+    "CostModel": "torchgpipe_tpu.obs.costmodel",
+    "check_stale_cost_model": "torchgpipe_tpu.obs.costmodel",
+    "config_fingerprint": "torchgpipe_tpu.obs.costmodel",
+    "ReplanEvent": "torchgpipe_tpu.obs.replan",
+    "ReplanOnDrift": "torchgpipe_tpu.obs.replan",
+    "ReplanResult": "torchgpipe_tpu.obs.replan",
 }
 
 
@@ -99,6 +109,8 @@ def __getattr__(name: str) -> Any:
 __all__ = [
     "BUBBLE_TOLERANCE",
     "BlockingEdge",
+    "COSTMODEL_VERSION",
+    "CostModel",
     "Counter",
     "FlightEvent",
     "FlightRecorder",
@@ -108,16 +120,22 @@ __all__ = [
     "PostmortemReport",
     "RankDump",
     "ReconcileReport",
+    "ReplanEvent",
+    "ReplanOnDrift",
+    "ReplanResult",
     "StallWatchdog",
     "StepReporter",
     "Timeline",
     "align_clocks",
     "check_dispatch_only_timeline",
+    "check_stale_cost_model",
+    "config_fingerprint",
     "device_trace",
     "load_dump",
     "measured_step_flops",
     "merged_chrome_trace",
     "overlay_chrome_trace",
+    "read_jsonl",
     "reconcile",
     "uniform_cost",
 ]

@@ -1,0 +1,570 @@
+"""Persistent measured cost models: the profile half of the BaPipe loop.
+
+The planner prices every candidate with analytic FLOPs
+(:mod:`torchgpipe_tpu.analysis.planner`); :func:`torchgpipe_tpu.obs.
+reconcile` re-prices the running schedule with measured per-cell
+medians — and until this module, that measurement evaporated at process
+exit.  A :class:`CostModel` is the persisted distillation: per
+``(stage, phase)`` measured median durations (seconds) keyed on the
+**config fingerprint** of the run that produced them — the same
+schedule/chunks/remat/balance/mesh-width vocabulary the ``plan-drift``
+rule keys on — with versioned JSON persistence, cross-run ``merge``,
+and a :meth:`CostModel.from_dumps` path so flight-recorder postmortem
+dumps feed the same store.  ``planner.plan(cost_model=...)`` re-ranks
+the full candidate space with it (BaPipe's measured direction,
+arXiv:2012.12544), and :class:`torchgpipe_tpu.obs.replan.ReplanOnDrift`
+closes the loop at runtime.
+
+Conventions (every number depends on them):
+
+* **Phases.** ``fwd`` and ``bwd`` are the timeline's span names; the
+  measured backward spans are SPLIT into ``bwd`` (no recompute) and
+  ``bwd_remat`` (the cell replayed its forward) using the measured
+  config's own checkpoint stop — a median over a mixed bucket would
+  blur exactly the recompute structure the planner re-ranks on.
+* **Chunks scaling.**  Stored durations are per-cell at the
+  fingerprint's ``chunks``; a cell's rows scale as ``1/chunks``, so
+  pricing a candidate at ``m`` chunks multiplies by
+  ``fingerprint_chunks / m`` (the planner does this).
+* **Staleness = fingerprint mismatch.**  A model is *fresh* for a pipe
+  only while the pipe still runs the exact measured configuration
+  (:meth:`stale_reason`); the ``stale-cost-model`` lint rule WARNs on a
+  stale attachment, and ``planner.plan`` falls back to analytic pricing
+  (noting it on the report).  Within one *fresh* ``plan`` call, OTHER
+  candidates (different schedule/chunks/remat at the same stage
+  structure) are priced by scaling the measured atoms — that transfer
+  is the whole point; freshness is about where the measurement was
+  taken, not what it can price.
+* **Derivations.**  A candidate needs both backward buckets; a run
+  measured under one checkpoint mode may only have one.  The missing
+  bucket is derived (``bwd_remat = bwd + fwd``; ``bwd = max(bwd_remat -
+  fwd, 0)``) and any plan priced through a derivation reports
+  ``priced_by='mixed'`` instead of ``'measured'``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import statistics
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from torchgpipe_tpu.analysis.diagnostics import Finding, Severity
+
+# Bump when the JSON schema changes; load() refuses unknown versions
+# didactically instead of mis-reading a future file.
+COSTMODEL_VERSION = 1
+
+# The distilled phase vocabulary (module docstring: the measured "bwd"
+# spans split into plain and remat'd buckets by the measured stop).
+FWD, BWD, BWD_REMAT, WGT = "fwd", "bwd", "bwd_remat", "wgt"
+PHASES = (FWD, BWD, BWD_REMAT, WGT)
+
+# Coverage floor below which a reconciliation is refused as a cost
+# source (mirrors ReconcileReport.drift_findings' stand-down).
+MIN_COVERAGE = 0.5
+
+
+def config_fingerprint(pipe: Any) -> Dict[str, Any]:
+    """The JSON-able configuration key a pipe actually runs — the
+    ``plan-drift`` vocabulary (schedule / chunks / remat / balance /
+    mesh widths / megastep), plus ``n_stages`` so structural
+    compatibility is checkable without a balance."""
+    from torchgpipe_tpu.gpipe import GPipe
+
+    if isinstance(pipe, GPipe):
+        return {
+            "engine": "mpmd",
+            "schedule": pipe.schedule,
+            "checkpoint": pipe.checkpoint,
+            "policy": None,
+            "chunks": int(pipe.chunks),
+            "balance": [int(b) for b in pipe.balance],
+            "n_stages": len(pipe.balance),
+            "megastep": int(getattr(pipe, "megastep", 1) or 1),
+            "dp": 1,
+            "tp": 1,
+        }
+    from torchgpipe_tpu.analysis.planner import _spmd_policy_label
+
+    own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
+    own_tp = pipe.mesh.shape[pipe.tp_axis] if pipe.tp_axis else 1
+    return {
+        "engine": "spmd",
+        "schedule": pipe.schedule,
+        "checkpoint": pipe.checkpoint,
+        "policy": _spmd_policy_label(pipe),
+        "chunks": int(pipe.chunks),
+        "balance": None,
+        "n_stages": int(pipe.n_stages),
+        "megastep": int(pipe.megastep),
+        "dp": int(own_dp),
+        "tp": int(own_tp),
+    }
+
+
+def _fingerprint_diff(a: Dict[str, Any], b: Dict[str, Any]) -> Optional[str]:
+    """Human-readable first differences, or None when equal."""
+    keys = sorted(set(a) | set(b))
+    diffs = [
+        f"{k}: measured {a.get(k)!r} != current {b.get(k)!r}"
+        for k in keys if a.get(k) != b.get(k)
+    ]
+    return "; ".join(diffs[:4]) if diffs else None
+
+
+def _merged_source(a: str, b: str) -> str:
+    """Bounded provenance for merged models: the UNIQUE base sources,
+    not a nested string — ``ReplanOnDrift`` merges a fresh model every
+    check interval, so ``merge(merge(merge(...)))`` would grow O(steps)
+    and be re-serialized into the store on every save."""
+
+    def bases(s: str) -> List[str]:
+        if s.startswith("merge(") and s.endswith(")"):
+            return s[len("merge("):-1].split("+")
+        return [s]
+
+    seen = list(dict.fromkeys(bases(a) + bases(b)))
+    return f"merge({'+'.join(seen)})"
+
+
+@dataclasses.dataclass
+class CellCost:
+    """One distilled cell: measured median seconds over ``samples``
+    observed spans."""
+
+    seconds: float
+    samples: int
+
+
+@dataclasses.dataclass
+class CostModel:
+    """Measured per-``(stage, phase)`` median durations keyed on the
+    config fingerprint of the run that produced them (module
+    docstring).  ``cells`` maps ``(stage, phase)`` to
+    :class:`CellCost`; ``comm_s`` is the median measured per-message
+    communication wait where a source records one (flight-recorder
+    dumps; in-process timelines have no wire, 0.0)."""
+
+    fingerprint: Dict[str, Any]
+    cells: Dict[Tuple[int, str], CellCost]
+    comm_s: float = 0.0
+    coverage: float = 1.0
+    wall_span: float = 0.0
+    created: float = dataclasses.field(default_factory=time.time)
+    source: str = "reconcile"
+    version: int = COSTMODEL_VERSION
+
+    # ------------------------------------------------------------------ #
+    # distillation                                                       #
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_report(
+        cls,
+        report: Any,
+        pipe: Any = None,
+        *,
+        fingerprint: Optional[Dict[str, Any]] = None,
+    ) -> "CostModel":
+        """Distill a :class:`~torchgpipe_tpu.obs.ReconcileReport` into a
+        cost model.  ``pipe`` (or an explicit ``fingerprint``) supplies
+        the configuration key; the report's raw spans are re-bucketed
+        per (stage, phase) with the backward split on the measured
+        config's checkpoint stop.  Refuses dispatch-only timelines and
+        coverage below :data:`MIN_COVERAGE` — garbage measurements must
+        not become a persistent pricing source."""
+        from torchgpipe_tpu.checkpoint import checkpoint_stop
+
+        if fingerprint is None:
+            if pipe is None:
+                raise ValueError(
+                    "CostModel.from_report needs the measured pipe (or an "
+                    "explicit fingerprint=): the cost model is keyed on "
+                    "the configuration the spans were measured under"
+                )
+            fingerprint = config_fingerprint(pipe)
+        if report.dispatch_only:
+            raise ValueError(
+                "refusing to distill a dispatch-only timeline: its "
+                "durations are dispatch intervals, not device time — "
+                "measure with Timeline(sync=True)"
+            )
+        if report.coverage < MIN_COVERAGE:
+            raise ValueError(
+                f"refusing to distill at {report.coverage:.0%} span "
+                f"coverage (< {MIN_COVERAGE:.0%}): too few spans mapped "
+                "onto the event graph to price it"
+            )
+        stop = checkpoint_stop(
+            str(fingerprint["checkpoint"]), int(fingerprint["chunks"]),
+            train=True,
+        )
+        obs: Dict[Tuple[int, str], List[float]] = {}
+        for span in report.spans:
+            phase = span.name
+            if phase == "bwd" and span.mbatch < stop:
+                phase = BWD_REMAT
+            if phase not in PHASES:
+                continue
+            obs.setdefault((span.stage, phase), []).append(span.duration)
+        cells = {
+            key: CellCost(statistics.median(v), len(v))
+            for key, v in obs.items()
+        }
+        return cls(
+            fingerprint=dict(fingerprint), cells=cells,
+            coverage=float(report.coverage),
+            wall_span=float(report.wall_span), source="reconcile",
+        )
+
+    @classmethod
+    def from_dumps(cls, dumps: Any) -> "CostModel":
+        """Distill flight-recorder postmortem dumps
+        (:class:`~torchgpipe_tpu.obs.flightrec.RankDump`) into the same
+        store: the distributed engine records per-cell ``fwd``/``bwd``
+        completions with dispatch-granularity durations, and its dump
+        meta carries the chunks/checkpoint configuration the postmortem
+        analyzer rebuilds the event graph from.  ``comm_s`` is the
+        median ``recv_match`` wait across ranks."""
+        from torchgpipe_tpu.checkpoint import checkpoint_stop
+
+        dumps = list(dumps)
+        if not dumps:
+            raise ValueError("from_dumps needs at least one rank dump")
+        meta = next(
+            (d.meta for d in dumps if d.meta.get("chunks") is not None),
+            None,
+        )
+        if meta is None:
+            raise ValueError(
+                "no dump carries engine meta (chunks/checkpoint): only "
+                "engine-attached recorders record the configuration a "
+                "cost model is keyed on (transport-only dumps cannot)"
+            )
+        chunks = int(meta["chunks"])
+        checkpoint = str(meta.get("checkpoint", "except_last"))
+        n_stages = len(meta.get("workers", ())) or (
+            max(
+                (e.stage for d in dumps for e in d.events
+                 if e.stage is not None),
+                default=0,
+            ) + 1
+        )
+        fingerprint = {
+            "engine": "mpmd",
+            "schedule": "gpipe",  # the distributed engine's schedule
+            "checkpoint": checkpoint,
+            "policy": None,
+            "chunks": chunks,
+            "balance": None,  # layer cut is not in the dump meta
+            "n_stages": int(n_stages),
+            "megastep": 1,
+            "dp": 1,
+            "tp": 1,
+        }
+        stop = checkpoint_stop(checkpoint, chunks, train=True)
+        obs: Dict[Tuple[int, str], List[float]] = {}
+        waits: List[float] = []
+        t_lo: Optional[float] = None
+        t_hi: Optional[float] = None
+        for d in dumps:
+            for e in d.events:
+                if e.kind == "recv_match" and e.dur is not None:
+                    waits.append(float(e.dur))
+                if (
+                    e.kind not in ("fwd", "bwd")
+                    or e.dur is None or e.stage is None or e.mb is None
+                ):
+                    continue
+                phase = e.kind
+                if phase == "bwd" and e.mb < stop:
+                    phase = BWD_REMAT
+                obs.setdefault((int(e.stage), phase), []).append(
+                    float(e.dur)
+                )
+                t = d.aligned(e.t)
+                t_lo = t if t_lo is None else min(t_lo, t)
+                t_hi = t if t_hi is None else max(t_hi, t)
+        if not obs:
+            raise ValueError(
+                "no per-cell fwd/bwd completions with durations in the "
+                "given dumps — nothing to distill"
+            )
+        cells = {
+            key: CellCost(statistics.median(v), len(v))
+            for key, v in obs.items()
+        }
+        return cls(
+            fingerprint=fingerprint, cells=cells,
+            comm_s=statistics.median(waits) if waits else 0.0,
+            wall_span=(t_hi - t_lo) if t_lo is not None else 0.0,
+            source="dumps",
+        )
+
+    # ------------------------------------------------------------------ #
+    # persistence                                                        #
+    # ------------------------------------------------------------------ #
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": self.version,
+            "fingerprint": dict(self.fingerprint),
+            "cells": [
+                {"stage": j, "phase": ph, "seconds": c.seconds,
+                 "samples": c.samples}
+                for (j, ph), c in sorted(self.cells.items())
+            ],
+            "comm_s": self.comm_s,
+            "coverage": self.coverage,
+            "wall_span": self.wall_span,
+            "created": self.created,
+            "source": self.source,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CostModel":
+        version = int(d.get("version", -1))
+        if version != COSTMODEL_VERSION:
+            raise ValueError(
+                f"cost-model version {version} != supported "
+                f"{COSTMODEL_VERSION}: re-distill with this build "
+                "(tools/trace_report.py --cost-model) rather than "
+                "guessing at a foreign schema"
+            )
+        cells = {
+            (int(row["stage"]), str(row["phase"])): CellCost(
+                float(row["seconds"]), int(row["samples"])
+            )
+            for row in d.get("cells", ())
+        }
+        return cls(
+            fingerprint=dict(d["fingerprint"]), cells=cells,
+            comm_s=float(d.get("comm_s", 0.0)),
+            coverage=float(d.get("coverage", 1.0)),
+            wall_span=float(d.get("wall_span", 0.0)),
+            created=float(d.get("created", 0.0)),
+            source=str(d.get("source", "reconcile")),
+            version=version,
+        )
+
+    def save(self, path: str) -> str:
+        """Versioned JSON persistence (the observe half of the loop
+        surviving process exit)."""
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "CostModel":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    # ------------------------------------------------------------------ #
+    # freshness + merge                                                  #
+    # ------------------------------------------------------------------ #
+
+    def stale_reason(self, pipe: Any) -> Optional[str]:
+        """None while ``pipe`` still runs the measured configuration;
+        otherwise the first fingerprint differences.  A ``balance`` of
+        None in the stored fingerprint (dump-sourced models, which
+        cannot see the layer cut) matches any cut of the same
+        ``n_stages``."""
+        current = config_fingerprint(pipe)
+        stored = dict(self.fingerprint)
+        if stored.get("balance") is None:
+            current = dict(current)
+            current["balance"] = None
+        return _fingerprint_diff(stored, current)
+
+    def attach(self, pipe: Any) -> "CostModel":
+        """Attach to ``pipe`` (as ``pipe._cost_model``) for drift
+        checks — how the ``stale-cost-model`` lint rule finds it (the
+        ``obs.reconcile(pipe=...)`` attachment pattern)."""
+        pipe._cost_model = self
+        return self
+
+    def merge(self, other: "CostModel") -> "CostModel":
+        """Blend two models of the SAME fingerprint across runs:
+        per-cell sample-weighted means of the stored medians (true
+        median merging would need the raw spans; the weighted blend is
+        the documented approximation), summed sample counts.  A
+        fingerprint mismatch raises — merging measurements of different
+        configurations would average apples into oranges.  A ``balance``
+        of None on exactly one side (dump-sourced models cannot see the
+        layer cut) matches like :meth:`stale_reason` and the merged
+        model keeps the CONCRETE cut."""
+        a_fp, b_fp = dict(self.fingerprint), dict(other.fingerprint)
+        if (a_fp.get("balance") is None) != (b_fp.get("balance") is None):
+            balance = a_fp.get("balance") or b_fp.get("balance")
+            a_fp["balance"] = b_fp["balance"] = balance
+        else:
+            balance = a_fp.get("balance")
+        diff = _fingerprint_diff(a_fp, b_fp)
+        if diff is not None:
+            raise ValueError(
+                f"cannot merge cost models with different fingerprints "
+                f"({diff}); a changed configuration needs a fresh model"
+            )
+        cells: Dict[Tuple[int, str], CellCost] = {}
+        for key in set(self.cells) | set(other.cells):
+            a, b = self.cells.get(key), other.cells.get(key)
+            if a is None or b is None:
+                cells[key] = dataclasses.replace(a or b)  # type: ignore[arg-type]
+                continue
+            n = a.samples + b.samples
+            cells[key] = CellCost(
+                (a.seconds * a.samples + b.seconds * b.samples) / n, n
+            )
+        n_self = sum(c.samples for c in self.cells.values()) or 1
+        n_other = sum(c.samples for c in other.cells.values()) or 1
+        merged_fp = dict(self.fingerprint)
+        merged_fp["balance"] = balance
+        return CostModel(
+            fingerprint=merged_fp, cells=cells,
+            comm_s=(
+                (self.comm_s * n_self + other.comm_s * n_other)
+                / (n_self + n_other)
+            ),
+            coverage=min(self.coverage, other.coverage),
+            wall_span=max(self.wall_span, other.wall_span),
+            source=_merged_source(self.source, other.source),
+        )
+
+    # ------------------------------------------------------------------ #
+    # pricing support (consumed by analysis.planner)                     #
+    # ------------------------------------------------------------------ #
+
+    def prices_structure(
+        self,
+        *,
+        engine: str,
+        n_stages: int,
+        balance: Optional[Tuple[int, ...]] = None,
+        dp: int = 1,
+        tp: int = 1,
+    ) -> bool:
+        """True when this model can price candidates of the given stage
+        structure: same engine family, same stage count, same balance
+        cut (a None on either side matches — the cut is what ties
+        per-stage costs to stages), same mesh widths, and a measured
+        ``fwd`` for every stage."""
+        fp = self.fingerprint
+        if fp.get("engine") != engine or int(fp.get("n_stages", -1)) != n_stages:
+            return False
+        if int(fp.get("dp", 1)) != dp or int(fp.get("tp", 1)) != tp:
+            return False
+        stored = fp.get("balance")
+        if (
+            stored is not None and balance is not None
+            and [int(b) for b in stored] != [int(b) for b in balance]
+        ):
+            return False
+        return all((j, FWD) in self.cells for j in range(n_stages))
+
+    def stage_atoms(
+        self, n_stages: int
+    ) -> Tuple[Optional[Dict[int, Tuple[float, float, float]]], bool]:
+        """Per-stage measured atoms ``(fwd, bwd, bwd_remat)`` in
+        seconds-per-cell at the fingerprint's chunks, with missing
+        backward buckets derived (module docstring).  Returns
+        ``(atoms, exact)`` — ``exact`` is False when any derivation
+        filled a hole (plans priced through it report ``'mixed'``) —
+        or ``(None, False)`` when a stage has no measured forward."""
+        atoms: Dict[int, Tuple[float, float, float]] = {}
+        exact = True
+        for j in range(n_stages):
+            f = self.cells.get((j, FWD))
+            if f is None:
+                return None, False
+            b = self.cells.get((j, BWD))
+            br = self.cells.get((j, BWD_REMAT))
+            if b is not None and br is not None:
+                atoms[j] = (f.seconds, b.seconds, br.seconds)
+            elif b is not None:
+                atoms[j] = (f.seconds, b.seconds, b.seconds + f.seconds)
+                exact = False
+            elif br is not None:
+                atoms[j] = (
+                    f.seconds,
+                    max(br.seconds - f.seconds, 0.0),
+                    br.seconds,
+                )
+                exact = False
+            else:
+                # No backward at all (forward-only trace): anchor the
+                # classic 2:1 shape on the measured forward.
+                atoms[j] = (f.seconds, 2.0 * f.seconds, 3.0 * f.seconds)
+                exact = False
+        return atoms, exact
+
+    # ------------------------------------------------------------------ #
+
+    def describe(self) -> str:
+        fp = self.fingerprint
+        lines = [
+            f"cost model [{self.source}] v{self.version}: "
+            f"{fp.get('engine')}/{fp.get('schedule')} "
+            f"checkpoint={fp.get('checkpoint')!r} chunks={fp.get('chunks')} "
+            f"balance={fp.get('balance')} dpxtp="
+            f"{fp.get('dp', 1)}x{fp.get('tp', 1)} — "
+            f"{len(self.cells)} cells, coverage {self.coverage:.0%}",
+        ]
+        for (j, ph), c in sorted(self.cells.items()):
+            lines.append(
+                f"  stage {j} {ph:<9} {c.seconds * 1e3:8.3f} ms "
+                f"(n={c.samples})"
+            )
+        if self.comm_s:
+            lines.append(f"  comm wait        {self.comm_s * 1e3:8.3f} ms")
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------- #
+# stale-cost-model lint rule (registered in analysis.rules)             #
+# --------------------------------------------------------------------- #
+
+
+def check_stale_cost_model(trace: Any) -> List[Finding]:
+    """WARNING when a :class:`CostModel` attached for drift checks
+    (``CostModel.attach(pipe)`` — the replan hook does this after each
+    distillation) no longer matches the pipe's current configuration:
+    its measurements describe a plan the pipe no longer runs, so both
+    ``planner.plan(cost_model=...)`` and drift comparisons would fall
+    back to analytic pricing silently.  Stands down when no model is
+    attached or the fingerprint still matches (the PR 8 stale-report
+    stand-down pattern)."""
+    cm = getattr(trace.pipe, "_cost_model", None)
+    if cm is None:
+        return []
+    try:
+        reason = cm.stale_reason(trace.pipe)
+    except Exception:  # noqa: BLE001 - a foreign object stands down
+        return []
+    if reason is None:
+        return []
+    return [Finding(
+        rule="stale-cost-model",
+        severity=Severity.WARNING,
+        path=f"obs/cost_model/{trace.engine}",
+        message=(
+            f"the attached measured cost model is STALE ({reason}): its "
+            "per-cell durations were measured under a configuration this "
+            "pipe no longer runs, so planner.plan(cost_model=...) and "
+            "drift checks fall back to analytic pricing.  Re-measure "
+            "(obs.reconcile on a sync=True timeline, then "
+            "CostModel.from_report(...).attach(pipe)) or drop the stale "
+            "attachment"
+        ),
+    )]
+
+
+__all__ = [
+    "COSTMODEL_VERSION",
+    "CellCost",
+    "CostModel",
+    "MIN_COVERAGE",
+    "check_stale_cost_model",
+    "config_fingerprint",
+]

@@ -149,6 +149,20 @@ class ReconcileReport:
             ),
         )]
 
+    def cost_model(
+        self, pipe: Any = None, *, fingerprint: Any = None
+    ) -> Any:
+        """Distill this measurement into a persistent
+        :class:`~torchgpipe_tpu.obs.costmodel.CostModel` (per-cell
+        medians keyed on the measured config's fingerprint) — the
+        convenience spelling of ``CostModel.from_report(report, pipe)``,
+        kept on the report so the observe → persist step is one call.
+        Raises on dispatch-only timelines and <50% coverage (a garbage
+        measurement must not become a pricing source)."""
+        from torchgpipe_tpu.obs.costmodel import CostModel
+
+        return CostModel.from_report(self, pipe, fingerprint=fingerprint)
+
     def summary(self) -> str:
         """Human-readable reconciliation table."""
         lines = [

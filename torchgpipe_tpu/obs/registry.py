@@ -354,6 +354,11 @@ class MetricsRegistry:
             dest.write(text)
         return len(lines)
 
+    def read_jsonl(self, src: Union[str, IO[str]]) -> List[Dict[str, Any]]:
+        """Instance alias of the module-level :func:`read_jsonl` (kept
+        here so the writer and reader live side by side in the API)."""
+        return read_jsonl(src)
+
     def to_prometheus(self) -> str:
         """The Prometheus text exposition format.  Histograms export as
         summaries (``{quantile="…"}`` rows plus ``_sum``/``_count``) —
@@ -411,6 +416,25 @@ class MetricsRegistry:
         return "\n".join(out) + ("\n" if out else "")
 
 
+def read_jsonl(src: Union[str, IO[str]]) -> List[Dict[str, Any]]:
+    """Round-trip loader for :meth:`MetricsRegistry.write_jsonl`
+    exports: one parsed record per (metric, series) line, exactly the
+    dicts the writer emitted (``metric``/``type``/``time``/``labels``
+    plus ``value`` or the histogram summary fields) — so persisted
+    series and cost-model provenance written next to a trace can be
+    reloaded and diffed offline.  ``src`` is a path or an open text
+    file; blank lines are skipped, a malformed line raises (a torn
+    export should fail loudly, not truncate silently)."""
+    if isinstance(src, str):
+        with open(src) as f:
+            text = f.read()
+    else:
+        text = src.read()
+    return [
+        json.loads(line) for line in text.splitlines() if line.strip()
+    ]
+
+
 __all__ = [
     "Counter",
     "Gauge",
@@ -418,4 +442,5 @@ __all__ = [
     "MetricsRegistry",
     "RESERVOIR_SIZE",
     "counter_property",
+    "read_jsonl",
 ]

@@ -1,0 +1,320 @@
+"""Replan-on-drift: act on measured drift without restarting the process.
+
+PRs 8–9 built the observe half (measured reconciliation, ``plan-drift``
+WARNINGs, flight-recorder dumps); :mod:`torchgpipe_tpu.obs.costmodel`
+made the measurement persistent.  This module is the act half:
+:class:`ReplanOnDrift` is a host-loop hook that, at megastep /
+checkpoint boundaries, reconciles the live timeline against the
+schedule's event graph, distills (and persists/merges) a
+:class:`~torchgpipe_tpu.obs.costmodel.CostModel`, and — when the
+measured drift findings trip — re-runs
+:func:`torchgpipe_tpu.analysis.planner.plan` with the live cost model
+and applies the new certified winner via the existing ``apply_plan``.
+The training loop keeps its params; only the engine object and its
+compiled step are rebuilt.
+
+Guard rails (each deliberate):
+
+* **Never mid-step.**  ``check()`` is called from the host loop BETWEEN
+  dispatched steps (the only place it can be called — the compiled step
+  is one program), and it additionally refuses steps that are not
+  megastep boundaries (``pipe.megastep_boundary``): checkpoint /
+  preemption hooks share that cadence, so a replan always lands where a
+  checkpoint could.
+* **Never an uncertified plan.**  Only ``report.best`` — feasible AND
+  certified by the ordering/memory/sharding verifiers — is ever
+  applied; no candidate, no replan.
+* **Every replan is a recorded event**: a ``replan_total`` counter on
+  the metrics registry, a ``replan`` event on the flight recorder
+  (``{from, to, reason}`` in the detail), and a
+  :class:`ReplanEvent` on ``hook.events`` for tests and reports.
+
+Param carry: SPMD params are one pytree — unchanged across a replan.
+MPMD params are per-stage layer lists; a replan that changes the
+balance re-splits them (:meth:`torchgpipe_tpu.gpipe.GPipe.repartition`)
+and re-places onto the new stage devices.  Optimizer state mirrors the
+per-stage structure and is NOT re-split across a balance change — the
+result's ``opt_state`` is then None and the caller re-initializes it
+(``init_opt_state``); momentum restarts, params and loss trajectory
+continue (documented in docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from torchgpipe_tpu.obs.costmodel import CostModel, config_fingerprint
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class ReplanEvent:
+    """One applied replan, as recorded on the hook."""
+
+    step: int
+    from_config: Dict[str, Any]
+    to_config: Dict[str, Any]
+    reason: str
+
+
+@dataclasses.dataclass
+class ReplanResult:
+    """What :meth:`ReplanOnDrift.check` hands back when a replan fires.
+
+    ``opt_state`` is None when the caller must re-initialize it (an
+    MPMD balance change — see the module docstring); otherwise the
+    passed-in state rides through unchanged."""
+
+    pipe: Any
+    plan: Any
+    event: ReplanEvent
+    params: Optional[Pytree] = None
+    state: Optional[Pytree] = None
+    opt_state: Optional[Pytree] = None
+
+
+class ReplanOnDrift:
+    """The observe → replan loop as one host-loop hook (module
+    docstring).  Call :meth:`check` between steps::
+
+        hook = ReplanOnDrift(batch_spec, interval=50, registry=reg)
+        for step in range(steps):
+            loss, params, opt_state = train_step(params, opt_state, *b)
+            res = hook.check(pipe, step + 1, params=params, state=state)
+            if res is not None:
+                pipe, params = res.pipe, res.params
+                train_step = pipe.make_train_step(opt, loss_fn)
+                opt_state = (res.opt_state
+                             or pipe.init_opt_state(opt, params))
+
+    ``interval`` is the check cadence in steps (the checkpoint-boundary
+    shape); a check additionally requires a megastep boundary.
+    ``store_path`` persists the merged cost model after every
+    measurement, so the NEXT process starts with this run's profile.
+    """
+
+    def __init__(
+        self,
+        batch: Pytree,
+        *,
+        hbm_budget_bytes: Optional[int] = None,
+        interval: int = 1,
+        cooldown: int = 0,
+        tolerance: Optional[float] = None,
+        cost_model: Optional[CostModel] = None,
+        store_path: Optional[str] = None,
+        registry: Any = None,
+        recorder: Any = None,
+        planner_options: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        if interval < 1:
+            raise ValueError(f"interval must be >= 1, got {interval}")
+        self.batch = batch
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.interval = int(interval)
+        self.cooldown = int(cooldown)
+        self.tolerance = tolerance
+        self.cost_model = cost_model
+        self.store_path = store_path
+        self.registry = registry
+        self.recorder = recorder
+        self.planner_options = dict(planner_options or {})
+        self.events: List[ReplanEvent] = []
+        self.last_report: Any = None  # latest ReconcileReport (or None)
+        self._last_replan_step: Optional[int] = None
+        self._counter = (
+            registry.counter(
+                "replan_total",
+                help="plans applied by ReplanOnDrift at megastep "
+                     "boundaries",
+                labels=("engine",),
+            )
+            if registry is not None else None
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def observe(self, pipe: Any) -> Optional[Any]:
+        """Reconcile the pipe's live timeline against its event graph,
+        fold the measurement into the persistent cost model, and return
+        the :class:`~torchgpipe_tpu.obs.ReconcileReport` (None when the
+        pipe has no measurable sync timeline).  Called by :meth:`check`;
+        public so loops can measure without arming the replan."""
+        from torchgpipe_tpu import obs
+        from torchgpipe_tpu.analysis.events import events_for
+
+        tracer = getattr(pipe, "tracer", None)
+        if tracer is None or not getattr(tracer, "events", None):
+            return None
+        try:
+            graph = events_for(pipe)
+            report = obs.reconcile(tracer, graph, pipe=pipe)
+        except Exception:  # noqa: BLE001 - observation must not kill training
+            return None
+        self.last_report = report
+        try:
+            fresh = CostModel.from_report(report, pipe=pipe)
+        except ValueError:
+            # Dispatch-only / low coverage: the report may still carry
+            # drift findings, but it is not a pricing source.
+            return report
+        if (
+            self.cost_model is not None
+            and self.cost_model.stale_reason(pipe) is None
+        ):
+            try:
+                self.cost_model = self.cost_model.merge(fresh)
+            except ValueError:
+                # Observation must not kill training: an unmergeable
+                # seed model (however it got here) is superseded by the
+                # live measurement rather than raised into the loop.
+                self.cost_model = fresh
+        else:
+            self.cost_model = fresh
+        self.cost_model.attach(pipe)
+        if self.store_path is not None:
+            try:
+                self.cost_model.save(self.store_path)
+            except OSError:
+                pass  # persistence is best-effort; training continues
+        return report
+
+    def check(
+        self,
+        pipe: Any,
+        step: int,
+        *,
+        params: Optional[Pytree] = None,
+        state: Optional[Pytree] = None,
+        opt_state: Optional[Pytree] = None,
+    ) -> Optional[ReplanResult]:
+        """Observe, and replan when the measured drift findings trip.
+
+        Returns None (by far the common case) or a
+        :class:`ReplanResult` carrying the rebuilt pipe (and re-split
+        params/state for an MPMD balance change).  See the class
+        docstring for the loop shape."""
+        from torchgpipe_tpu.analysis import planner
+
+        if step % self.interval != 0:
+            return None
+        boundary = getattr(pipe, "megastep_boundary", None)
+        if boundary is not None and not boundary(step):
+            return None
+        if (
+            self._last_replan_step is not None
+            and step - self._last_replan_step <= self.cooldown
+        ):
+            return None
+        report = self.observe(pipe)
+        if report is None:
+            return None
+        findings = (
+            report.drift_findings(self.tolerance)
+            if self.tolerance is not None else report.drift_findings()
+        )
+        if not findings:
+            return None
+        budget = (
+            self.hbm_budget_bytes
+            if self.hbm_budget_bytes is not None
+            else getattr(pipe, "hbm_budget_bytes", None)
+        )
+        if budget is None:
+            return None  # nothing to certify against — observe only
+        try:
+            plan_report = planner.plan(
+                pipe, self.batch, budget, cost_model=self.cost_model,
+                **self.planner_options,
+            )
+        except Exception:  # noqa: BLE001 - a planner miss must not kill training
+            return None
+        best = plan_report.best
+        if best is None or not (best.feasible and best.certified):
+            return None  # never apply an uncertified plan
+        old_fp = config_fingerprint(pipe)
+        try:
+            new_pipe = planner.apply_plan(pipe, best)
+        except (ValueError, TypeError):
+            # apply_plan refuses by design (a foreign mesh width, a
+            # deferred-BN pipe); a refusal must not kill training — the
+            # drift stays visible through the plan-drift lint rule.
+            return None
+        new_fp = config_fingerprint(new_pipe)
+        if new_fp == old_fp:
+            return None  # the measured winner IS the running config
+        reason = findings[0].message.split(":")[0]
+        event = ReplanEvent(
+            step=step, from_config=old_fp, to_config=new_fp, reason=reason,
+        )
+        self.events.append(event)
+        self._last_replan_step = step
+        if self._counter is not None:
+            self._counter.inc(engine=old_fp["engine"])
+        if self.recorder is not None:
+            try:
+                self.recorder.record(
+                    "replan",
+                    detail=(
+                        f"from={_short(old_fp)} to={_short(new_fp)} "
+                        f"reason={reason}"
+                    ),
+                )
+            except Exception:  # noqa: BLE001 - telemetry is best-effort
+                pass
+        # A fresh configuration needs a fresh measurement: drop the old
+        # config's spans so the next observe() prices the new schedule.
+        tracer = getattr(new_pipe, "tracer", None)
+        if tracer is not None and hasattr(tracer, "reset"):
+            tracer.reset()
+        return ReplanResult(
+            pipe=new_pipe,
+            plan=best,
+            event=event,
+            **self._carry(pipe, new_pipe, params, state, opt_state),
+        )
+
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _carry(
+        old_pipe: Any,
+        new_pipe: Any,
+        params: Optional[Pytree],
+        state: Optional[Pytree],
+        opt_state: Optional[Pytree],
+    ) -> Dict[str, Optional[Pytree]]:
+        """Move the training state onto the replanned engine (module
+        docstring: SPMD pytrees ride through; MPMD per-stage lists
+        re-split on a balance change, optimizer state does not)."""
+        from torchgpipe_tpu.gpipe import GPipe
+
+        if not isinstance(new_pipe, GPipe):
+            return {"params": params, "state": state,
+                    "opt_state": opt_state}
+        same_cut = list(old_pipe.balance) == list(new_pipe.balance)
+        if same_cut:
+            return {"params": params, "state": state,
+                    "opt_state": opt_state}
+        out: Dict[str, Optional[Pytree]] = {"opt_state": None}
+        out["params"] = (
+            new_pipe.place(new_pipe.repartition(params))
+            if params is not None else None
+        )
+        out["state"] = (
+            new_pipe.place(new_pipe.repartition(state))
+            if state is not None else None
+        )
+        return out
+
+
+def _short(fp: Dict[str, Any]) -> str:
+    return (
+        f"{fp.get('schedule')}/{fp.get('checkpoint')}"
+        f"/m{fp.get('chunks')}/bal{fp.get('balance')}"
+    )
+
+
+__all__ = ["ReplanEvent", "ReplanOnDrift", "ReplanResult"]

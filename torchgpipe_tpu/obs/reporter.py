@@ -103,6 +103,7 @@ class StepReporter:
         real_token_fraction: float = 1.0,
         peak_flops: Optional[float] = None,
         guard: Any = None,
+        replan: Any = None,
         label: str = "train",
         log_every: int = 0,
         clock: Callable[[], float] = time.perf_counter,
@@ -128,6 +129,10 @@ class StepReporter:
             peak_flops if peak_flops is not None else _default_peak()
         )
         self.guard = guard
+        # Optional obs.replan.ReplanOnDrift hook: its applied-replan
+        # count mirrors into the same log line as the step-time shift
+        # it caused (the guard-counter treatment).
+        self.replan = replan
         self.label = label
         self.log_every = int(log_every)
         self._clock = clock
@@ -179,6 +184,10 @@ class StepReporter:
         self._g_scale = self.registry.gauge(
             "train_loss_scale", help="DynamicLossScale current scale",
             labels=run_l)
+        self._g_replans = self.registry.gauge(
+            "train_replans",
+            help="plans applied by the attached ReplanOnDrift hook",
+            labels=run_l)
 
     # ------------------------------------------------------------------ #
 
@@ -218,6 +227,7 @@ class StepReporter:
             useful = self.flops_per_step * self.real_token_fraction
             self._g_mfu.set(useful / (dt * self.peak_flops), **self._run)
         self._sync_guard()
+        self._sync_replan()
         self._window_steps += 1
         if self.log_every and self._window_steps >= self.log_every:
             self._emit(self.line())
@@ -235,6 +245,13 @@ class StepReporter:
         scale = getattr(self.guard, "loss_scale", None)
         if scale is not None:
             self._g_scale.set(float(scale.scale), **self._run)
+
+    def _sync_replan(self) -> None:
+        if self.replan is None:
+            return
+        events = getattr(self.replan, "events", None)
+        if events is not None:
+            self._g_replans.set(float(len(events)), **self._run)
 
     # ------------------------------------------------------------------ #
 
@@ -262,6 +279,8 @@ class StepReporter:
             out["retries"] = int(self._g_retries.value(**self._run))
             if getattr(self.guard, "loss_scale", None) is not None:
                 out["loss_scale"] = self._g_scale.value(**self._run)
+        if self.replan is not None:
+            out["replans"] = int(self._g_replans.value(**self._run))
         first = self._g_first.value(**self._run)
         if first:
             out["first_step_s"] = first

@@ -384,7 +384,8 @@ class Pipeline:
                         params[j], cur_states[j], x, skips_in, rng_i, 1.0 / m
                     )
                 if self.tracer is not None:
-                    self.tracer.record("fwd", j, i, y)
+                    self.tracer.record("fwd", j, i, y,
+                                       settle=_faults.cell_delay_s(j))
                 cur_states[j] = new_state
                 for k, v in ext.items():
                     dst = self.stages[self.layout.pop_stage(k)].device
@@ -492,7 +493,8 @@ class Pipeline:
                             pull = _to_memory(pull, stage.device, host_kinds[j])
                         pulls[(i, j)] = pull
                 if self.tracer is not None:
-                    self.tracer.record("fwd", j, i, y)
+                    self.tracer.record("fwd", j, i, y,
+                                       settle=_faults.cell_delay_s(j))
                 cur_states[j] = new_state
                 for k, v in ext.items():
                     dst = self.stages[self.layout.pop_stage(k)].device
@@ -573,7 +575,8 @@ class Pipeline:
                 # that stage's backward work escape a sync=True
                 # measurement — obs.reconcile would then see a fake
                 # stage imbalance.
-                self.tracer.record("bwd", j, i, (gparams, gx))
+                self.tracer.record("bwd", j, i, (gparams, gx),
+                                   settle=_faults.cell_delay_s(j))
             acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
             if j > 0:
                 gys[(i, j - 1)] = _transfer(gx, self.stages[j - 1].device)
@@ -658,7 +661,8 @@ class Pipeline:
                     )
                     pulls[(i, j)] = pull
             if self.tracer is not None:
-                self.tracer.record("fwd", j, i, y)
+                self.tracer.record("fwd", j, i, y,
+                                       settle=_faults.cell_delay_s(j))
             cur_states[j] = new_state
             for k, v in ext.items():
                 dst = self.stages[self.layout.pop_stage(k)].device
@@ -700,7 +704,8 @@ class Pipeline:
                 # that stage's backward work escape a sync=True
                 # measurement — obs.reconcile would then see a fake
                 # stage imbalance.
-                self.tracer.record("bwd", j, i, (gparams, gx))
+                self.tracer.record("bwd", j, i, (gparams, gx),
+                                   settle=_faults.cell_delay_s(j))
             acc[j] = gparams if acc[j] is None else stage.accum(acc[j], gparams)
             if j > 0:
                 gys[(i, j - 1)] = _transfer(gx, self.stages[j - 1].device)

@@ -50,6 +50,13 @@ class FaultPlan:
     nan_at: Optional[Tuple[int, int]] = None
     # PreemptionHandler.check(step) reports True for step >= this.
     preempt_at_step: Optional[int] = None
+    # Slow every cell of one stage by (stage, extra_seconds): the
+    # synthetic straggler for the observe->replan loop.  Applied inside
+    # the MPMD per-cell tracer's recorded span (Timeline.record's
+    # ``settle``), so the stage is genuinely slower on the wall clock
+    # AND the measured reconciliation sees it — a traced pipe is
+    # required (the chaos targets the measurement path by design).
+    slow_at: Optional[Tuple[int, float]] = None
 
 
 _lock = threading.Lock()
@@ -65,6 +72,7 @@ def inject(
     *,
     nan_at: Optional[Tuple[int, int]] = None,
     preempt_at_step: Optional[int] = None,
+    slow_at: Optional[Tuple[int, float]] = None,
 ) -> Iterator[FaultPlan]:
     """Activate a :class:`FaultPlan` for the enclosed block.
 
@@ -72,7 +80,8 @@ def inject(
     second concurrent ``inject`` raises.
     """
     global _active, _epoch
-    plan = FaultPlan(nan_at=nan_at, preempt_at_step=preempt_at_step)
+    plan = FaultPlan(nan_at=nan_at, preempt_at_step=preempt_at_step,
+                     slow_at=slow_at)
     with _lock:
         if _active is not None:
             raise RuntimeError(
@@ -141,6 +150,20 @@ def spmd_corrupt_cell_input(
         else a,
         tree,
     )
+
+
+def cell_delay_s(stage: int) -> float:
+    """Extra per-cell seconds the active plan injects into ``stage``
+    (0.0 without a matching ``slow_at`` plan).  The MPMD per-cell
+    schedulers pass this as ``Timeline.record(..., settle=)``, so the
+    slowdown both delays the run and lands INSIDE the measured span —
+    the deterministic straggler the observe->replan tests drive.  Like
+    ``preempt_at_step`` it traces nothing, so it never tokens the
+    compiled-program caches (:func:`plan_token` stays None)."""
+    plan = _active
+    if plan is None or plan.slow_at is None or plan.slow_at[0] != stage:
+        return 0.0
+    return float(plan.slow_at[1])
 
 
 def should_preempt(step: int) -> bool:

@@ -3488,6 +3488,15 @@ class SpmdGPipe:
         )
         return jax.jit(fn)(params)
 
+    def megastep_boundary(self, step: int) -> bool:
+        """True when ``step`` completed optimizer steps land on a
+        megastep boundary — the cadence checkpoint/preemption hooks run
+        at, and the only place
+        :class:`torchgpipe_tpu.obs.replan.ReplanOnDrift` may fire (a
+        replan can never land inside a compiled K-step program)."""
+        k = max(int(self.megastep or 1), 1)
+        return step % k == 0
+
     def make_train_step(
         self, optimizer: Any, *, donate: bool = True,
         megastep: Optional[int] = None, zero: Optional[bool] = None,

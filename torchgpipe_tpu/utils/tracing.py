@@ -69,13 +69,20 @@ class Timeline:
         stage: int,
         mbatch: int,
         out: Any = None,
+        settle: float = 0.0,
     ) -> Any:
         """Record one cell and return ``out`` (so engines can chain
         ``y = tracer.record("fwd", j, i, y)``); blocks on ``out`` when
-        ``sync`` is set."""
+        ``sync`` is set.  ``settle`` (seconds) sleeps INSIDE the span,
+        after the block: the deterministic-straggler slot the MPMD
+        schedulers feed from ``resilience.faults.cell_delay_s`` — a
+        ``slow_at`` fault plan then both delays the run and shows up in
+        the measured per-cell durations the reconciliation reads."""
         t_start = time.perf_counter() - self._t0
         if self.sync and out is not None:
             jax.block_until_ready(out)
+        if settle > 0.0:
+            time.sleep(settle)
         t_end = time.perf_counter() - self._t0
         self.events.append(TimelineEvent(name, stage, mbatch, t_start, t_end))
         return out
