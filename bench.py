@@ -940,6 +940,25 @@ def _flightrec_overhead_entry() -> None:
     raise SystemExit(flightrec_main())
 
 
+def _fleet_entry() -> None:
+    """The ``fleet`` rung: a seeded synthetic trace (ragged, bursty,
+    shared-prefix tenants) through the replica router, the radix prefix
+    cache, and speculative decoding (benchmarks/fleet_trace.py — which
+    owns the measurement contract: all rungs must emit bitwise-identical
+    streams before any number publishes, and the trace generator's
+    skipped-request honesty counters ride in the same JSON line)::
+
+        env JAX_PLATFORMS=cpu python bench.py --fleet
+    """
+    sys.argv = [sys.argv[0]] + [
+        a for a in sys.argv[1:] if a != "--fleet"
+    ] + ["--json"]
+    from benchmarks.fleet_trace import main as fleet_main
+
+    fleet_main()
+    raise SystemExit(0)
+
+
 def _plan_validate_entry() -> None:
     """The ``plan-validate`` rung: predicted-vs-measured rank-order check
     of the static planner on the CPU tiny-llama preset
@@ -962,6 +981,8 @@ if __name__ == "__main__":
         _flightrec_overhead_entry()
     elif "--plan-validate" in sys.argv:
         _plan_validate_entry()
+    elif "--fleet" in sys.argv:
+        _fleet_entry()
     elif "--megastep" in sys.argv:
         _megastep_entry()
     elif "--packing" in sys.argv:

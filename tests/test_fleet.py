@@ -1,0 +1,699 @@
+"""The fleet layer's contracts, pinned (docs/serving.md, fleet section).
+
+1. **Failover is exact** — replica r0 dies mid-generation
+   (``faults.inject(die_at_step=...)``); the router resumes its
+   in-flight requests on r1 and every stream is BITWISE what an
+   undisturbed single-engine run produces.
+2. **Prefix reuse is exact and refcount-safe** — shared-prefix requests
+   reuse donor KV slots (prefill steps drop, reused tokens counted),
+   outputs bitwise vs a cold engine, and a pinned slot is NEVER in the
+   free list (``CachePool.check_refcounts`` under churn).
+3. **Speculation is exact and statically bounded** — the speculative
+   greedy stream equals target-only greedy decode, every program traces
+   at most once across a mixed burst, and
+   ``analysis.serving.certify_speculative`` certifies the fixed
+   steady-state program count.
+4. **The trace generator is deterministic and honest** — two walks of
+   one config are identical; misfit requests are counted, never
+   silently resized.
+
+Tier-1 budget: ONE module-scoped trained-params fixture; the
+trace-scale soak is slow-marked.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu import fleet
+from torchgpipe_tpu.layers import sequential_init
+from torchgpipe_tpu.models.generation import generate
+from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+from torchgpipe_tpu.obs import MetricsRegistry
+from torchgpipe_tpu.resilience import faults
+from torchgpipe_tpu.serving import Engine
+
+CFG = TransformerConfig(
+    vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+)
+DRAFT_CFG = TransformerConfig(
+    vocab=64, dim=16, n_layers=1, n_heads=2, n_kv_heads=2
+)
+
+
+@pytest.fixture(scope="module")
+def flat_params():
+    params, _, _ = sequential_init(
+        llama(CFG), jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((2, 8), jnp.int32),
+    )
+    return params
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    params, _, _ = sequential_init(
+        llama(DRAFT_CFG), jax.random.PRNGKey(1),
+        jax.ShapeDtypeStruct((2, 8), jnp.int32),
+    )
+    return params
+
+
+def _ref(params, prompt, new, max_len=32):
+    return np.asarray(
+        generate(CFG, params, jnp.asarray(prompt)[None, :], new,
+                 max_len=max_len)
+    )[0]
+
+
+def _shared_prefix_workload(seed, n, prefix_len=8, vocab=64):
+    """n requests all opening with one tenant system prompt."""
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, vocab, (prefix_len,)).astype(np.int32)
+    out = []
+    for _ in range(n):
+        suffix = rng.randint(
+            0, vocab, (int(rng.randint(1, 5)),)
+        ).astype(np.int32)
+        out.append((np.concatenate([prefix, suffix]),
+                    int(rng.randint(2, 6))))
+    return out
+
+
+def _mk_engine(params, *, name=None, shared=None, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_chunk", 8)
+    if shared is not None:
+        kw["registry"] = shared.labeled(replica=name)
+    return Engine(CFG, params, **kw)
+
+
+# --------------------------------------------------------------------- #
+# 1. failover / drain                                                   #
+# --------------------------------------------------------------------- #
+
+
+def test_failover_resumes_bitwise_on_survivor(flat_params):
+    """Kill r0 at engine step 3 mid-burst: the router fails its
+    in-flight requests over to r1 and every output is bitwise what an
+    undisturbed run produces — the killer demo."""
+    shared = MetricsRegistry()
+    router = fleet.Router(
+        {n: _mk_engine(flat_params, name=n, shared=shared)
+         for n in ("r0", "r1")},
+        registry=shared, seed=1,
+    )
+    reqs = _shared_prefix_workload(seed=0, n=6)
+    with faults.inject(die_at_step=(0, 3)):
+        rids = [router.submit(p, n) for p, n in reqs]
+        assert router.run() == "idle"
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            router.result(rid), _ref(flat_params, p, n)
+        ), rid
+    assert not router.replicas["r0"].alive
+    assert router._c_failovers.value() == 1
+    assert router._c_moved.value() > 0
+    # the shared registry holds both replicas' series, separable
+    prom = shared.to_prometheus()
+    assert 'replica="r0"' in prom and 'replica="r1"' in prom
+
+
+def test_drain_replica_graceful_scale_down(flat_params):
+    """drain_replica = failover minus the death: cooperative drain,
+    resume on the survivor, replica out of rotation but alive."""
+    router = fleet.Router(
+        {n: _mk_engine(flat_params) for n in ("r0", "r1")}, seed=0
+    )
+    reqs = _shared_prefix_workload(seed=3, n=4)
+    # session affinity pins the whole burst onto ONE replica
+    rids = [router.submit(p, n, session="s0") for p, n in reqs]
+    pinned = router._records[rids[0]].replica
+    survivor = "r1" if pinned == "r0" else "r0"
+    for _ in range(2):
+        router.step()
+    moved = router.drain_replica(pinned)
+    assert moved                       # something was in flight
+    assert router.replicas[pinned].draining
+    assert router.replicas[pinned].alive
+    assert router.run() == "idle"
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            router.result(rid), _ref(flat_params, p, n)
+        ), rid
+    # nothing routes to a draining replica
+    assert router.pick_replica() == survivor
+
+
+def test_engine_initiated_drain_resumes_via_hook(flat_params):
+    """A replica draining ITSELF (preemption handler firing on its
+    engine) is taken out of rotation by the router's drain hook and its
+    in-flight requests resume on the survivor — bitwise."""
+    router = fleet.Router(
+        {n: _mk_engine(flat_params) for n in ("r0", "r1")}, seed=0
+    )
+    reqs = _shared_prefix_workload(seed=5, n=4)
+    rids = [router.submit(p, n, session="s0") for p, n in reqs]
+    pinned = router._records[rids[0]].replica
+    for _ in range(2):
+        router.step()
+    # the engine drains itself — NOT through the router
+    router.replicas[pinned].engine.drain()
+    assert router.replicas[pinned].draining
+    assert router.run() == "idle"
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            router.result(rid), _ref(flat_params, p, n)
+        ), rid
+
+
+def test_submit_rejection_leaves_no_phantom_record(flat_params):
+    """A request the engine refuses (prompt + budget over max_len)
+    leaves NO router state behind: the rid is reusable, status/result
+    never report a request no engine holds."""
+    router = fleet.Router({"r0": _mk_engine(flat_params)})
+    with pytest.raises(ValueError):
+        router.submit(np.arange(30, dtype=np.int32), 30, rid="big")
+    assert "big" not in router._records
+    # the rid is clean for a request that fits
+    rid = router.submit(np.arange(4, dtype=np.int32), 2, rid="big")
+    assert router.run() == "idle"
+    assert router.result(rid).size == 2
+
+
+def test_broken_client_callback_is_not_replica_death(flat_params):
+    """An on_token callback raising (closed client socket) stops the
+    STREAM, not the replica — otherwise one bad client would cascade-
+    evict every replica it gets resubmitted to."""
+    router = fleet.Router({"r0": _mk_engine(flat_params)})
+
+    def bad_callback(rid, tok):
+        raise OSError("client went away")
+
+    p, n = np.arange(4, dtype=np.int32), 4
+    rid = router.submit(p, n, on_token=bad_callback)
+    assert router.run() == "idle"
+    assert router.replicas["r0"].alive          # replica survived
+    assert np.array_equal(router.result(rid), _ref(flat_params, p, n))
+
+
+def test_request_drain_honored_under_router_stepping(flat_params):
+    """A replica's own drain request (SIGTERM preemption path) fires
+    under Router.step — the router, not Engine.run, drives stepping —
+    and its in-flight requests resume on the survivor bitwise."""
+    router = fleet.Router(
+        {n: _mk_engine(flat_params) for n in ("r0", "r1")}, seed=0
+    )
+    reqs = _shared_prefix_workload(seed=9, n=4)
+    rids = [router.submit(p, n, session="s0") for p, n in reqs]
+    pinned = router._records[rids[0]].replica
+    for _ in range(2):
+        router.step()
+    router.replicas[pinned].engine.request_drain()
+    assert router.run() == "idle"
+    assert router.replicas[pinned].draining
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            router.result(rid), _ref(flat_params, p, n)
+        ), rid
+
+
+def test_failover_keeps_a_session_together(flat_params):
+    """Several in-flight requests of ONE session move to the SAME
+    survivor: only a stale pin (naming an out-of-rotation replica) is
+    dropped, and the first re-pick re-pins for the rest."""
+    router = fleet.Router(
+        {n: _mk_engine(flat_params) for n in ("r0", "r1", "r2")},
+        seed=2,
+    )
+    reqs = _shared_prefix_workload(seed=13, n=4)
+    rids = [router.submit(p, n, session="conv") for p, n in reqs]
+    pinned = router._records[rids[0]].replica
+    router.step()
+    moved = router.failover(pinned)
+    assert len(moved) >= 2
+    landed = {router._records[r].replica for r in moved}
+    assert len(landed) == 1 and pinned not in landed
+    assert router.run() == "idle"
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            router.result(rid), _ref(flat_params, p, n)
+        ), rid
+
+
+def test_real_engine_crash_fails_over(flat_params):
+    """A non-ReplicaDied exception escaping an engine's step (a real
+    crash, not fault injection) evicts that replica and resumes its
+    work on the survivor — the documented contract."""
+    router = fleet.Router(
+        {n: _mk_engine(flat_params) for n in ("r0", "r1")}, seed=0
+    )
+    reqs = _shared_prefix_workload(seed=7, n=4)
+    rids = [router.submit(p, n, session="s0") for p, n in reqs]
+    pinned = router._records[rids[0]].replica
+    for _ in range(2):
+        router.step()
+
+    def boom():
+        raise RuntimeError("XLA device lost")
+
+    router.replicas[pinned].engine.step = boom
+    assert router.run() == "idle"
+    assert not router.replicas[pinned].alive
+    assert router._c_failovers.value() == 1
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            router.result(rid), _ref(flat_params, p, n)
+        ), rid
+
+
+def test_single_replica_death_strands_without_crashing(flat_params):
+    """The last replica dying must not crash run(): requests stay in
+    the router's records (status 'queued', tokens kept) instead of a
+    second ReplicaDied escaping the failover."""
+    router = fleet.Router({"r0": _mk_engine(flat_params)})
+    rid = router.submit(np.arange(4, dtype=np.int32), 4)
+    with faults.inject(die_at_step=(0, 1)):
+        assert router.run() == "idle"     # no crash
+    assert not router.replicas["r0"].alive
+    assert router.status(rid) in ("queued", "preempted")
+    # tokens emitted before the death are kept, a greedy-exact prefix
+    got = router.result(rid)
+    ref = _ref(flat_params, np.arange(4, dtype=np.int32), 4)
+    assert np.array_equal(got, ref[:got.size])
+
+
+def test_die_at_step_counts_the_replicas_own_steps(flat_params):
+    """Death timing keys on the ROUTER's per-replica step counter, not
+    on ServingMetrics — two replicas sharing one metrics instance (the
+    bench's fleet-wide latency setup) still die at their OWN step."""
+    from torchgpipe_tpu.serving import ServingMetrics
+
+    shared_metrics = ServingMetrics()
+    router = fleet.Router({
+        n: _mk_engine(flat_params, metrics=shared_metrics)
+        for n in ("r0", "r1")
+    }, seed=1)
+    reqs = _shared_prefix_workload(seed=0, n=6)
+    with faults.inject(die_at_step=(0, 3)):
+        rids = [router.submit(p, n) for p, n in reqs]
+        assert router.run() == "idle"
+    # r0 survived exactly its own 3 productive steps, though the shared
+    # metrics instance counted both replicas' (strictly more) by then
+    assert router._replica_steps["r0"] == 3
+    assert shared_metrics.engine_steps > 3
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            router.result(rid), _ref(flat_params, p, n)
+        ), rid
+
+
+def test_die_at_step_is_trace_inert():
+    """A die_at_step plan never tokens the compiled-program caches
+    (entering/leaving must not force recompiles) and trips exactly at
+    its (replica, step) threshold."""
+    with faults.inject(die_at_step=(1, 5)) as plan:
+        assert plan.die_at_step == (1, 5)
+        assert faults.plan_token() is None        # cache-inert
+        assert not faults.should_die(0, 99)       # other replica
+        assert not faults.should_die(1, 4)        # before the step
+        assert faults.should_die(1, 5)
+        assert faults.should_die(1, 6)            # at-or-after
+    assert not faults.should_die(1, 5)            # plan left with the ctx
+
+
+def test_router_restore_onto_fresh_int8_engine(flat_params, tmp_path):
+    """The cross-replica restore path with a QuantKVCache pool: drain an
+    int8 engine through its CheckpointManager, restore onto a FRESH
+    int8 engine instance, streams continue exactly."""
+    from torchgpipe_tpu.resilience.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path))
+    reqs = _shared_prefix_workload(seed=5, n=4)
+    eng = _mk_engine(flat_params, num_slots=2, kv_quant=True,
+                     checkpoint_manager=mgr)
+    rids = [eng.submit(p, n) for p, n in reqs]
+    for _ in range(4):
+        eng.step()
+    eng.drain()
+    fresh = _mk_engine(flat_params, num_slots=2, kv_quant=True)
+    restored = Engine.restore_requests(mgr)
+    assert restored, "drain checkpointed nothing"
+    for kw in restored:
+        fresh.submit(kw.pop("prompt"), kw.pop("max_new_tokens"), **kw)
+    fresh.run()
+    for rid, (p, n) in zip(rids, reqs):
+        got = (
+            fresh.result(rid) if rid in fresh._requests
+            else eng.result(rid)
+        )
+        # int8 engines bit-match an int8 reference (quantization changes
+        # logits vs fp, but drain/restore must not change them further)
+        want = np.asarray(generate(
+            CFG, flat_params, jnp.asarray(p)[None, :], n,
+            max_len=32, kv_quant=True,
+        ))[0]
+        assert np.array_equal(got, want), rid
+
+
+def test_router_p2c_and_session_affinity(flat_params):
+    """Power-of-two-choices spreads sessionless load across replicas;
+    session= pins all turns of one conversation to one replica."""
+    shared = MetricsRegistry()
+    router = fleet.Router(
+        {n: _mk_engine(flat_params, name=n, shared=shared)
+         for n in ("r0", "r1")},
+        registry=shared, seed=7,
+    )
+    reqs = _shared_prefix_workload(seed=9, n=8)
+    for p, n in reqs:
+        router.submit(p, n)
+        router.step()               # interleave so occupancy matters
+    router.run()
+    routed = {
+        name: router._c_routed.value(replica=name)
+        for name in ("r0", "r1")
+    }
+    assert routed["r0"] > 0 and routed["r1"] > 0, routed
+    # affinity: one session, one replica
+    sess = [router.submit(p, n, session="conv") for p, n in reqs[:4]]
+    replicas = {router._records[r].replica for r in sess}
+    assert len(replicas) == 1
+    router.run()
+
+
+# --------------------------------------------------------------------- #
+# 2. prefix cache                                                       #
+# --------------------------------------------------------------------- #
+
+
+def test_prefix_reuse_bitwise_with_fewer_prefill_steps(flat_params):
+    """Shared-prefix requests through a prefix-cached engine: outputs
+    bitwise vs a cold engine AND vs generate; measured prefill steps
+    drop (the KV copy absorbs the shared prompt); reuse counters move."""
+    reqs = _shared_prefix_workload(seed=11, n=6, prefix_len=10)
+
+    def serve(eng):
+        rids = [eng.submit(p, n) for p, n in reqs]
+        eng.run()
+        return [eng.result(r).tolist() for r in rids]
+
+    pc = fleet.RadixPrefixCache(min_prefix_len=4, max_entries=2)
+    warm = _mk_engine(flat_params, prefix_cache=pc)
+    cold = _mk_engine(flat_params)
+    got_warm, got_cold = serve(warm), serve(cold)
+    assert got_warm == got_cold
+    for (p, n), toks in zip(reqs, got_warm):
+        assert toks == _ref(flat_params, p, n).tolist()
+    assert pc.hits > 0 and pc.reused_tokens > 0
+    assert warm.metrics.prefix_hits == pc.hits
+    assert warm.metrics.prefix_reused_tokens == pc.reused_tokens
+    # the copy absorbed prefill work: strictly fewer prefill dispatches
+    assert warm.metrics.prefill_steps < cold.metrics.prefill_steps
+    # one extra program, statically declared and certified
+    assert warm.program_count == cold.program_count + 1
+    from torchgpipe_tpu.analysis import Severity, lint_serving
+    entries_before = {e.slot: e.tokens for e in pc.entries()}
+    stats_before = pc.stats()
+    pinned_before = warm.pool.num_pinned
+    assert all(
+        f.severity != Severity.ERROR for f in lint_serving(warm)
+    )
+    # the lint's stubbed drive must NOT poison the live trie: its probe
+    # prompts carry no real KV, so they are driven against a scratch
+    # cache — entries, hit counters, and pool pins are untouched
+    assert {e.slot: e.tokens for e in pc.entries()} == entries_before
+    assert pc.stats() == stats_before
+    assert warm.pool.num_pinned == pinned_before
+    warm.pool.check_refcounts()
+
+
+def test_prefix_reuse_bitwise_int8(flat_params):
+    """The QuantKVCache branch of prefix_copy — K/V banks plus the
+    scale banks, whose LENGTH axis sits elsewhere ([b, n_kv, L]) — is
+    bitwise against a cold int8 engine.  Guards the scale-copy axis
+    arithmetic no other gate touches."""
+    reqs = _shared_prefix_workload(seed=17, n=4, prefix_len=10)
+    pc = fleet.RadixPrefixCache(min_prefix_len=4, max_entries=2)
+    warm = _mk_engine(flat_params, kv_quant=True, prefix_cache=pc)
+    cold = _mk_engine(flat_params, kv_quant=True)
+
+    def serve(eng):
+        # the first request completes alone so its slot donates
+        first = eng.submit(*reqs[0])
+        eng.run()
+        rids = [first] + [eng.submit(p, n) for p, n in reqs[1:]]
+        eng.run()
+        return [eng.result(r).tolist() for r in rids]
+
+    assert serve(warm) == serve(cold)
+    assert pc.hits > 0 and pc.reused_tokens > 0
+    warm.pool.check_refcounts()
+
+
+def test_prefix_refcounts_never_recycle_referenced_slots(flat_params):
+    """Churn grid: bursts of shared-prefix requests through a tiny pool.
+    After every burst the pool's refcount invariants hold, and a donor
+    slot pinned by the trie is never in the free list."""
+    pc = fleet.RadixPrefixCache(min_prefix_len=4, max_entries=2)
+    eng = _mk_engine(flat_params, num_slots=2, prefix_cache=pc)
+    for burst in range(4):
+        for p, n in _shared_prefix_workload(seed=20 + burst, n=3):
+            eng.submit(p, n)
+        eng.run()
+        eng.pool.check_refcounts()
+        for entry in pc.entries():
+            assert entry.slot not in eng.pool._free, (
+                "pinned donor slot leaked into the free list"
+            )
+            assert eng.pool.refcount(entry.slot) >= 1
+    # dropping the trie releases every pin: the pool drains to all-free
+    pc.clear(eng.pool)
+    eng.pool.check_refcounts()
+    assert eng.pool.num_free == eng.pool.num_slots
+
+
+def test_radix_trie_semantics():
+    """Trie units: LCP matching, min-length miss, covered-insert no-op,
+    LRU eviction, reclaim only idle pins."""
+    from torchgpipe_tpu.serving.cache_pool import CachePool
+
+    pool = CachePool(CFG, 4, 32)
+    pc = fleet.RadixPrefixCache(min_prefix_len=3, max_entries=2)
+    s0 = pool.alloc("a")
+    assert pc.insert([1, 2, 3, 4], s0, pool)
+    assert pool.refcount(s0) == 2
+    # exact/partial/limited matches
+    assert pc.match([1, 2, 3, 4]) == (4, s0)
+    assert pc.match([1, 2, 3, 9]) == (3, s0)
+    assert pc.match([1, 2, 3, 4], limit=3) == (3, s0)
+    assert pc.match([1, 2, 9]) == (0, None)        # < min_prefix_len
+    assert pc.match([9, 9, 9, 9]) == (0, None)
+    # a prefix of a cached prompt is already covered: no new pin
+    s1 = pool.alloc("b")
+    assert not pc.insert([1, 2, 3], s1, pool)
+    assert pool.refcount(s1) == 1
+    # LRU eviction at capacity: refresh s0 so s2 is the LRU victim
+    s2 = pool.alloc("c")
+    assert pc.insert([5, 6, 7, 8], s2, pool)
+    assert pc.match([1, 2, 3, 4]) == (4, s0)       # s0 now freshest
+    s3 = pool.alloc("d")
+    assert pc.insert([7, 7, 7, 7], s3, pool)       # evicts LRU (s2)
+    assert len(pc) == 2 and s2 not in {e.slot for e in pc.entries()}
+    assert pool.refcount(s2) == 1                  # pin released
+    # reclaim skips entries whose request still runs (owner alive)
+    assert pc.reclaim(pool, want=2) == 0
+    pool.free(s0)                                  # owner done, pin holds
+    assert pool.refcount(s0) == 1
+    assert s0 not in pool._free
+    assert pc.reclaim(pool, want=2) == 1           # idle donor evicted
+    assert s0 in pool._free
+    pool.check_refcounts()
+
+
+def test_prefix_cache_ctor_validation():
+    with pytest.raises(ValueError, match="min_prefix_len"):
+        fleet.RadixPrefixCache(min_prefix_len=0)
+    with pytest.raises(ValueError, match="max_entries"):
+        fleet.RadixPrefixCache(max_entries=0)
+
+
+# --------------------------------------------------------------------- #
+# 3. speculative decoding                                               #
+# --------------------------------------------------------------------- #
+
+
+def test_speculative_exact_and_fixed_program_count(
+    flat_params, draft_params
+):
+    """A REAL small draft model (half width, one layer): the speculative
+    greedy stream equals target-only greedy decode token for token;
+    every program traces at most once across a ragged burst; a second
+    burst retraces nothing."""
+    reqs = _shared_prefix_workload(seed=31, n=6)
+    se = fleet.SpeculativeEngine(
+        CFG, flat_params, DRAFT_CFG, draft_params, gamma=2,
+        num_slots=4, max_len=32, prefill_chunk=8,
+    )
+    rids = [se.submit(p, n) for p, n in reqs]
+    se.run()
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            se.result(rid), _ref(flat_params, p, n)
+        ), rid
+    assert all(v <= 1 for v in se.trace_counts.values()), se.trace_counts
+    first = dict(se.trace_counts)
+    rids = [se.submit(p, n) for p, n in reqs]
+    se.run()
+    assert se.trace_counts == first          # zero retraces on reuse
+    assert 0.0 <= se.acceptance_rate <= 1.0
+    assert se._c_rounds.value() > 0
+
+
+def test_speculative_self_draft_accepts_everything(flat_params):
+    """Draft == target: every proposal is accepted (acceptance rate 1),
+    and the output is still exact — the degenerate upper bound."""
+    reqs = _shared_prefix_workload(seed=37, n=4)
+    se = fleet.SpeculativeEngine(
+        CFG, flat_params, CFG, flat_params, gamma=3,
+        num_slots=4, max_len=32, prefill_chunk=8,
+    )
+    rids = [se.submit(p, n) for p, n in reqs]
+    se.run()
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            se.result(rid), _ref(flat_params, p, n)
+        ), rid
+    assert se.acceptance_rate == 1.0
+
+
+def test_speculative_statically_certified(flat_params, draft_params):
+    """certify_speculative: INFO bound on a well-formed engine (and the
+    full lint_serving churn grid stays clean); ERROR on an engine with
+    no draft program set; didactic ctor refusals for the unsupported
+    configurations."""
+    from torchgpipe_tpu.analysis import (
+        Severity, certify_speculative, lint_serving,
+    )
+
+    se = fleet.SpeculativeEngine(
+        CFG, flat_params, DRAFT_CFG, draft_params, gamma=2,
+        num_slots=4, max_len=32, prefill_chunk=(1, 2, 4, 8),
+    )
+    fs = certify_speculative(se)
+    assert [f.severity for f in fs] == [Severity.INFO]
+    assert str(se.program_count) in fs[0].message
+    fs = lint_serving(se)
+    assert all(f.severity != Severity.ERROR for f in fs), fs
+    # a plain engine has no draft program set
+    plain = _mk_engine(flat_params)
+    fs = certify_speculative(plain)
+    assert fs[0].severity == Severity.ERROR
+    # didactic refusals
+    with pytest.raises(ValueError, match="verify chunk"):
+        fleet.SpeculativeEngine(
+            CFG, flat_params, CFG, flat_params, gamma=8,
+            num_slots=4, max_len=32, prefill_chunk=4,
+        )
+    with pytest.raises(ValueError, match="greedy-only"):
+        fleet.SpeculativeEngine(
+            CFG, flat_params, CFG, flat_params, gamma=2,
+            num_slots=4, max_len=32, temperature=0.5,
+            rng=jax.random.PRNGKey(0),
+        )
+    with pytest.raises(ValueError, match="prefix_cache"):
+        fleet.SpeculativeEngine(
+            CFG, flat_params, CFG, flat_params, gamma=2,
+            num_slots=4, max_len=32,
+            prefix_cache=fleet.RadixPrefixCache(),
+        )
+
+
+# --------------------------------------------------------------------- #
+# 4. the synthetic trace                                                #
+# --------------------------------------------------------------------- #
+
+
+def test_trace_deterministic_and_honest():
+    """Two walks of one config are identical; misfit requests are
+    counted in skipped_too_long, never silently resized; tenant
+    prefixes reconstruct independently of the walk."""
+    cfg = fleet.TraceConfig(n_requests=200, seed=42, max_len=24)
+    s1, s2 = fleet.TraceStats(), fleet.TraceStats()
+    a = list(fleet.synthetic_trace(cfg, s1))
+    b = list(fleet.synthetic_trace(cfg, s2))
+    assert len(a) == len(b) == 200
+    for ra, rb in zip(a, b):
+        assert ra.arrival_s == rb.arrival_s
+        assert ra.session == rb.session
+        assert np.array_equal(ra.prompt, rb.prompt)
+        assert ra.max_new_tokens == rb.max_new_tokens
+    assert s1.skipped_too_long == s2.skipped_too_long
+    assert s1.skipped_too_long > 0        # tight max_len: honesty fires
+    prefixes = fleet.tenant_prefixes(cfg)
+    for r in a[:32]:
+        assert np.array_equal(
+            r.prompt[:r.prefix_len], prefixes[r.tenant]
+        )
+        assert r.prompt.size + r.max_new_tokens <= cfg.max_len
+    assert 0.0 < s1.shareable_fraction < 1.0
+    # arrivals are monotone; bursts exist
+    assert all(
+        x.arrival_s <= y.arrival_s for x, y in zip(a, a[1:])
+    )
+    assert s1.burst_arrivals > 0
+    # burst_arrivals shares generated's population (counted after the
+    # skip check), so burst_fraction is a real fraction even under the
+    # heavy skipping this tight max_len forces
+    assert s1.burst_arrivals <= s1.generated
+    summary = fleet.trace_summary(cfg)
+    assert summary["burst_fraction"] <= 1.0
+    assert summary["requests"] == 200.0
+    assert summary["shareable_fraction"] == pytest.approx(
+        s1.shareable_fraction
+    )
+
+
+@pytest.mark.slow
+def test_fleet_trace_soak(flat_params):
+    """Trace-scale churn: 60 seeded trace requests through a 2-replica
+    prefix-cached fleet with a mid-trace replica death — every output
+    exact, refcount invariants hold on the survivor."""
+    cfg = fleet.TraceConfig(n_requests=60, seed=3, max_len=28,
+                            new_tokens=(2, 6))
+    stats = fleet.TraceStats()
+    shared = MetricsRegistry()
+    engines = {
+        n: Engine(
+            CFG, flat_params, num_slots=4, max_len=32, prefill_chunk=8,
+            prefix_cache=fleet.RadixPrefixCache(min_prefix_len=4),
+            registry=shared.labeled(replica=n),
+        )
+        for n in ("r0", "r1")
+    }
+    router = fleet.Router(engines, registry=shared, seed=5)
+    wants = {}
+    with faults.inject(die_at_step=(0, 40)):
+        for req in fleet.synthetic_trace(cfg, stats):
+            rid = router.submit(
+                req.prompt, req.max_new_tokens, session=req.session
+            )
+            wants[rid] = (req.prompt, req.max_new_tokens)
+            router.step()
+        assert router.run() == "idle"
+    assert router._c_failovers.value() == 1
+    for rid, (p, n) in wants.items():
+        assert np.array_equal(
+            router.result(rid), _ref(flat_params, p, n)
+        ), rid
+    for rep in router.replicas.values():
+        if rep.alive:
+            rep.engine.pool.check_refcounts()
+    hits = sum(
+        eng._prefix_cache.hits for eng in
+        (rep.engine for rep in router.replicas.values())
+    )
+    assert hits > 0                       # the tenants actually shared

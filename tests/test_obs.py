@@ -89,6 +89,93 @@ def test_registry_prometheus_and_jsonl_export():
     assert by_name["steps"]["time"] == 42.0
 
 
+def test_prometheus_label_values_escape_and_round_trip():
+    """Exposition-format escaping: a label value holding a quote, a
+    newline, and a backslash survives the scrape — the multi-replica
+    labels (replica="r0") the fleet router relies on round-trip."""
+    reg = obs.MetricsRegistry()
+    c = reg.counter("reqs", labels=("replica",))
+    nasty = 'r"0\n\\x'
+    c.inc(3, replica=nasty)
+    c.inc(1, replica="r1")
+    text = reg.to_prometheus()
+    assert r'reqs{replica="r\"0\n\\x"} 3' in text
+    assert 'reqs{replica="r1"} 1' in text
+    # round-trip: un-escape every label value and recover the original
+    import re
+
+    values = re.findall(r'replica="((?:[^"\\]|\\.)*)"', text)
+    decoded = {
+        v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+        for v in values
+    }
+    assert decoded == {nasty, "r1"}
+
+
+def test_export_ordering_is_deterministic():
+    """Two registries whose series were created in OPPOSITE order (fleet
+    replicas racing their first request) export byte-identical text."""
+
+    def build(order):
+        reg = obs.MetricsRegistry(clock=lambda: 1.0)
+        for name in order:
+            reg.counter("b_requests", labels=("replica",)).inc(
+                replica=name
+            )
+            reg.gauge("a_occupancy", labels=("replica",)).set(
+                0.5, replica=name
+            )
+        return reg
+
+    fwd = build(["r0", "r1"])
+    rev = build(["r1", "r0"])
+    assert fwd.to_prometheus() == rev.to_prometheus()
+    bf, br = io.StringIO(), io.StringIO()
+    fwd.write_jsonl(bf)
+    rev.write_jsonl(br)
+    assert bf.getvalue() == br.getvalue()
+    # and the order is actually sorted: metric a_* before b_*
+    text = fwd.to_prometheus()
+    assert text.index("a_occupancy") < text.index("b_requests")
+
+
+def test_labeled_registry_views_share_one_namespace():
+    """labeled() views stamp fixed labels on every series: two
+    ServingMetrics-style components share ONE registry, separable by
+    replica, with overlap/narrowing rules enforced."""
+    shared = obs.MetricsRegistry()
+    v0 = shared.labeled(replica="r0")
+    v1 = shared.labeled(replica="r1")
+    c0 = v0.counter("served", help="requests")
+    c1 = v1.counter("served")
+    c0.inc(2)
+    c1.inc(5)
+    assert c0.value() == 2 and c1.value() == 5
+    base = shared.get("served")
+    assert base.value(replica="r0") == 2
+    assert base.value(replica="r1") == 5
+    # extra per-call labels compose with the fixed ones
+    h0 = v0.histogram("lat", labels=("phase",))
+    h0.observe(0.25, phase="decode")
+    assert shared.get("lat").count(
+        replica="r0", phase="decode"
+    ) == 1
+    # fixed labels cannot be overridden or re-fixed
+    with pytest.raises(ValueError, match="fixed"):
+        v0.counter("served2", labels=("replica",))
+    with pytest.raises(ValueError, match="at least one"):
+        shared.labeled()
+    # narrowing chains — but may only ADD labels: silently re-stamping
+    # replica= would file every series under the wrong replica
+    t = v0.labeled(tenant="acme")
+    t.counter("tok").inc(7)
+    assert shared.get("tok").value(replica="r0", tenant="acme") == 7
+    with pytest.raises(ValueError, match="already fixed"):
+        v0.labeled(replica="r1")
+    # exports on a view read the WHOLE base namespace
+    assert 'replica="r1"' in v0.to_prometheus()
+
+
 def test_histogram_reservoir_caps_memory():
     h = obs.Histogram("h", capacity=64)
     for i in range(10_000):

@@ -70,12 +70,21 @@ differently and must not share backend state):
    ranking (priced ``measured``), the flipped winner must round-trip
    through ``apply_plan`` and re-certify clean, and a stale-fingerprint
    model must be refused back to analytic pricing
-   (docs/observability.md, "closing the loop").
+   (docs/observability.md, "closing the loop");
+11. ``tools/fleet_verify.py`` (fleet-verify) — the fleet layer's three
+   exactness contracts on a tiny CPU llama: an induced replica death
+   (``die_at_step``) must reroute and resume every in-flight request
+   BITWISE on the survivor, prefix-cache reuse must be bitwise vs cold
+   prefill with the pool refcount invariants holding under a churn
+   grid, and the speculative steady-state program count must be
+   statically certified by ``analysis.serving.certify_speculative``
+   (docs/serving.md, fleet section).
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
 / ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` /
 ``--skip-postmortem`` / ``--skip-sharding`` / ``--skip-pack`` /
-``--skip-replan`` to run a subset, ``-v`` for per-target reports.
+``--skip-replan`` / ``--skip-fleet`` to run a subset, ``-v`` for
+per-target reports.
 """
 
 from __future__ import annotations
@@ -111,6 +120,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-sharding", action="store_true")
     ap.add_argument("--skip-pack", action="store_true")
     ap.add_argument("--skip-replan", action="store_true")
+    ap.add_argument("--skip-fleet", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -191,6 +201,11 @@ def main(argv: Sequence[str] | None = None) -> int:
             sys.executable, str(REPO / "tools" / "replan_verify.py"),
         ]
         failures += _run("replan-verify", cmd) != 0
+    if not args.skip_fleet:
+        cmd = [
+            sys.executable, str(REPO / "tools" / "fleet_verify.py"),
+        ]
+        failures += _run("fleet-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

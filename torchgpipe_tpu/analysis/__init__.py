@@ -74,7 +74,11 @@ from torchgpipe_tpu.analysis.events import (
     makespan,
 )
 from torchgpipe_tpu.analysis.planner import Plan, PlanReport, apply_plan
-from torchgpipe_tpu.analysis.serving import lint_serving
+from torchgpipe_tpu.analysis.serving import (
+    certify_ladder,
+    certify_speculative,
+    lint_serving,
+)
 from torchgpipe_tpu.analysis.schedule import (
     certify_memory,
     verify_buffers,
@@ -119,6 +123,8 @@ __all__ = [
     "apply_suppressions",
     "format_findings",
     "lint",
+    "certify_ladder",
+    "certify_speculative",
     "lint_serving",
     "serving_lint",
     "max_severity",

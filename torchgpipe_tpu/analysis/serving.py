@@ -84,21 +84,68 @@ def _drive_signatures(
                 "n_valid": n_valid, "key": key,
             }))
             # Token 0 for every slot: requests terminate by budget.
-            # Same output arity as the real bodies (tok, cache,
-            # advanced lengths, key) — the engine adopts the advanced
-            # frontiers as its device-resident lengths.
-            return jnp.zeros((S,), jnp.int32), cache, lengths + n_valid, key
+            # Same output arity as the real bodies — prefill returns
+            # (tok, per-position grid, cache, advanced lengths, key),
+            # decode (tok, cache, advanced lengths, key); the engine
+            # adopts the advanced frontiers as its device-resident
+            # lengths.
+            tok = jnp.zeros((S,), jnp.int32)
+            if kind == "decode":
+                return tok, cache, lengths + n_valid, key
+            grid = jnp.zeros(tokens.shape, jnp.int32)
+            return tok, grid, cache, lengths + n_valid, key
         return fn
 
-    real = dict(engine._prefill_fns), engine._decode_fn
+    def copy_stub(cache, src, dst, n):
+        sigs.setdefault("prefix_copy", set()).add(_signature({
+            "cache": cache, "src": src, "dst": dst, "n": n,
+        }))
+        return cache
+
+    def draft_stub(kind):
+        def fn(params, cache, lengths, tokens, n_valid):
+            sigs.setdefault(kind, set()).add(_signature({
+                "cache": cache, "lengths": lengths, "tokens": tokens,
+                "n_valid": n_valid,
+            }))
+            return (jnp.zeros((S,), jnp.int32), cache,
+                    lengths + n_valid)
+        return fn
+
+    draft_fns = getattr(engine, "_draft_fns", None)
+    real = (
+        dict(engine._prefill_fns), engine._decode_fn,
+        engine._prefix_copy_fn,
+        dict(draft_fns) if draft_fns is not None else None,
+    )
     engine._prefill_fns = {n: stub(n) for n in prefill_names}
     engine._decode_fn = stub("decode")
+    if engine._prefix_copy_fn is not None:
+        engine._prefix_copy_fn = copy_stub
+    if draft_fns is not None:
+        engine._draft_fns = {n: draft_stub(n) for n in draft_fns}
     try:
         engine.submit(np.zeros((plen,), np.int32), mnew, rid=tag)
         engine.run()
     finally:
-        engine._prefill_fns, engine._decode_fn = real
+        engine._prefill_fns, engine._decode_fn = real[0], real[1]
+        engine._prefix_copy_fn = real[2]
+        if real[3] is not None:
+            engine._draft_fns = real[3]
     return sigs
+
+
+def _program_parts(engine: Any) -> str:
+    """ONE human description of an engine's declared program set, used
+    by every message that cites it — prefix-cached and speculative
+    engines carry more than 'one per bucket + decode'."""
+    has_prefix = getattr(engine, "_prefix_copy_fn", None) is not None
+    n_draft = len(getattr(engine, "draft_buckets", ()))
+    return "one per bucket + decode" + (
+        " + prefix_copy" if has_prefix else ""
+    ) + (
+        f" + {n_draft} draft" if n_draft else ""
+    )
 
 
 def certify_ladder(engine: Any) -> List[Finding]:
@@ -120,7 +167,7 @@ def certify_ladder(engine: Any) -> List[Finding]:
     declared = {
         tuple(spec["tokens"].shape)
         for kind, spec in engine.step_input_specs().items()
-        if kind != "decode"
+        if kind.startswith("prefill")
     }
     bad: Set[int] = set()
     for n in range(1, engine.pool.max_len + 1):
@@ -139,7 +186,10 @@ def certify_ladder(engine: Any) -> List[Finding]:
             ),
         ))
     n_programs = len(engine.step_input_specs())
-    expected = len(buckets) + 1
+    has_prefix = getattr(engine, "_prefix_copy_fn", None) is not None
+    n_draft = len(getattr(engine, "draft_buckets", ()))
+    expected = len(buckets) + 1 + (1 if has_prefix else 0) + n_draft
+    parts = _program_parts(engine)
     if n_programs != expected:
         findings.append(Finding(
             rule="ladder-bound",
@@ -147,8 +197,7 @@ def certify_ladder(engine: Any) -> List[Finding]:
             path="serving/engine",
             message=(
                 f"engine declares {n_programs} step programs but the "
-                f"ladder {buckets} certifies {expected} (one per bucket "
-                "+ decode)"
+                f"ladder {buckets} certifies {expected} ({parts})"
             ),
         ))
     else:
@@ -158,8 +207,89 @@ def certify_ladder(engine: Any) -> List[Finding]:
             path="serving/engine",
             message=(
                 f"prefill ladder {buckets}: steady-state program count "
-                f"statically bounded at {expected} (one per bucket + "
-                "decode) for every admissible request mix"
+                f"statically bounded at {expected} ({parts}) for every "
+                "admissible request mix"
+            ),
+        ))
+    return findings
+
+
+def certify_speculative(engine: Any) -> List[Finding]:
+    """Statically certify a ``fleet.SpeculativeEngine``'s fixed
+    steady-state program count (the ``certify_ladder`` exhaustive-walk
+    shape, applied to speculation's three dispatch sites):
+
+    1. the VERIFY pass must land in an EXISTING prefill program — the
+       chunk ``gamma + 1`` maps onto a declared ladder bucket, so
+       speculation adds zero target programs;
+    2. every reachable draft CATCH-UP lag maps onto a declared draft
+       bucket: lags are ``1..gamma + 1`` (bounded by construction — the
+       round consumes every accepted token), walked exhaustively;
+    3. every prefill MIRROR chunk (sizes ``1..ladder max``, same walk
+       as ``certify_ladder``) maps onto a declared draft bucket.
+
+    Passing all three bounds the total program set at
+    ``engine.program_count`` for every request mix and every acceptance
+    history; an INFO finding records the certified figure."""
+    findings: List[Finding] = []
+    buckets = tuple(engine.prefill_buckets)
+    draft_buckets = tuple(getattr(engine, "draft_buckets", ()))
+    gamma = getattr(engine, "gamma", None)
+    if gamma is None or not draft_buckets:
+        findings.append(Finding(
+            rule="speculative-bound",
+            severity=Severity.ERROR,
+            path="fleet/speculative",
+            message=(
+                "engine declares no draft program set (gamma/"
+                "draft_buckets missing) — not a SpeculativeEngine"
+            ),
+        ))
+        return findings
+    bad: List[str] = []
+    # 1. verify chunk lands in a declared target prefill bucket
+    g_v = engine.scheduler.bucket_for(gamma + 1)
+    if g_v < gamma + 1 or g_v not in buckets:
+        bad.append(
+            f"verify chunk gamma+1={gamma + 1} does not fit a declared "
+            f"prefill bucket {buckets} — the verify pass would need a "
+            "NEW target program"
+        )
+    # 2. exhaustive catch-up lag walk (1..gamma+1)
+    for lag in range(1, gamma + 2):
+        g = engine.scheduler.bucket_for(lag)
+        if g < lag or g not in draft_buckets:
+            bad.append(
+                f"catch-up lag {lag} selects bucket {g} outside the "
+                f"declared draft set {draft_buckets}"
+            )
+    # 3. exhaustive prefill-mirror walk (every reachable target chunk)
+    for n in range(1, buckets[-1] + 1):
+        g = engine.scheduler.bucket_for(n)
+        if g not in draft_buckets:
+            bad.append(
+                f"prefill mirror chunk {n} dispatches target bucket "
+                f"{g} with no matching draft program"
+            )
+    for msg in bad:
+        findings.append(Finding(
+            rule="speculative-bound",
+            severity=Severity.ERROR,
+            path="fleet/speculative",
+            message=msg,
+        ))
+    if not bad:
+        total = engine.program_count
+        findings.append(Finding(
+            rule="speculative-bound",
+            severity=Severity.INFO,
+            path="fleet/speculative",
+            message=(
+                f"speculative steady state statically bounded at "
+                f"{total} programs ({len(buckets)} target prefill + "
+                f"decode + {len(draft_buckets)} draft; verify reuses "
+                f"prefill@{g_v}) for every request mix and acceptance "
+                "history"
             ),
         ))
     return findings
@@ -218,61 +348,101 @@ def lint_serving(
             ),
         ))
     findings.extend(certify_ladder(engine))
+    if getattr(engine, "draft_buckets", None):
+        findings.extend(certify_speculative(engine))
 
     # 2. churn grid: serve every admissible request through the real
     # submit/schedule/buffer path (programs stubbed, no device compute)
     # and require every captured dispatch to hit the two signatures.
-    max_len = engine.pool.max_len
-    for i, (plen, mnew) in enumerate(grid):
-        if plen < 1 or mnew < 1 or plen + mnew > max_len:
-            findings.append(Finding(
-                rule="serving-admission",
-                severity=Severity.INFO,
-                path="serving/scheduler",
-                message=(
-                    f"request (prompt={plen}, new={mnew}) is statically "
-                    f"rejected (pool max_len={max_len}) — shapes stay "
-                    "fixed because admission refuses what cannot fit"
-                ),
-            ))
-            continue
-        churn = _drive_signatures(
-            engine, plen, mnew,
-            # request-log length makes the rid unique across repeated
-            # lint calls on one engine
-            tag=f"lint-{len(engine._requests)}-{plen}-{mnew}",
+    # A live prefix cache is swapped for a SCRATCH trie for the drive:
+    # the stubs write no KV, so letting the probes insert into the real
+    # trie would index garbage rows as donors (and pin slots past the
+    # lint).  The scratch accumulates across grid points, so later
+    # probes still hit earlier ones and the prefix-copy dispatch
+    # signature is exercised; its pins are dropped afterwards.
+    real_prefix_cache = getattr(engine, "_prefix_cache", None)
+    if real_prefix_cache is not None:
+        engine._prefix_cache = type(real_prefix_cache)(
+            min_prefix_len=real_prefix_cache.min_prefix_len,
+            max_entries=real_prefix_cache.max_entries,
         )
-        for kind, seen in churn.items():
-            for sig in seen:
-                if sig != base_sig[kind]:
-                    findings.append(Finding(
-                        rule="recompilation-hazard",
-                        severity=Severity.ERROR,
-                        path=f"serving/{kind}",
-                        message=(
-                            f"request (prompt={plen}, new={mnew}) "
-                            f"dispatches the {kind} step with a "
-                            "signature outside the declared program set "
-                            f"({len(base_sig)} programs: one per prefill "
-                            "bucket + decode) — every such request "
-                            "compiles a new program; the engine must pad "
-                            "into its fixed (num_slots, bucket) buffers "
-                            "instead"
-                        ),
-                    ))
+    max_len = engine.pool.max_len
+    try:
+        for i, (plen, mnew) in enumerate(grid):
+            if plen < 1 or mnew < 1 or plen + mnew > max_len:
+                findings.append(Finding(
+                    rule="serving-admission",
+                    severity=Severity.INFO,
+                    path="serving/scheduler",
+                    message=(
+                        f"request (prompt={plen}, new={mnew}) is "
+                        f"statically rejected (pool max_len={max_len}) "
+                        "— shapes stay fixed because admission refuses "
+                        "what cannot fit"
+                    ),
+                ))
+                continue
+            churn = _drive_signatures(
+                engine, plen, mnew,
+                # request-log length makes the rid unique across
+                # repeated lint calls on one engine
+                tag=f"lint-{len(engine._requests)}-{plen}-{mnew}",
+            )
+            for kind, seen in churn.items():
+                for sig in seen:
+                    if sig != base_sig[kind]:
+                        findings.append(Finding(
+                            rule="recompilation-hazard",
+                            severity=Severity.ERROR,
+                            path=f"serving/{kind}",
+                            message=(
+                                f"request (prompt={plen}, new={mnew}) "
+                                f"dispatches the {kind} step with a "
+                                "signature outside the declared program "
+                                f"set ({len(base_sig)} programs: "
+                                f"{_program_parts(engine)}) — every "
+                                "such request compiles a new program; "
+                                "the engine must pad into its fixed "
+                                "(num_slots, bucket) buffers instead"
+                            ),
+                        ))
+    finally:
+        if real_prefix_cache is not None:
+            # Drop the scratch trie's pins and put the real one back —
+            # the lint leaves trie and pool refcounts untouched.
+            engine._prefix_cache.clear(engine.pool)
+            engine._prefix_cache = real_prefix_cache
 
-    # 3. abstract-trace every program (each ladder bucket + decode);
-    # walk for host callbacks
-    for kind, fn in (*engine._prefill_fns.items(),
-                     ("decode", engine._decode_fn)):
+    # 3. abstract-trace every program (each ladder bucket + decode +
+    # the prefix-copy program when a prefix cache is attached); walk
+    # for host callbacks
+    programs: List[Tuple[str, Any]] = [
+        *engine._prefill_fns.items(), ("decode", engine._decode_fn),
+    ]
+    if getattr(engine, "_prefix_copy_fn", None) is not None:
+        programs.append(("prefix_copy", engine._prefix_copy_fn))
+    programs.extend(getattr(engine, "_draft_fns", {}).items())
+    for kind, fn in programs:
         spec = base[kind]
         try:
-            traced = jax.make_jaxpr(
-                lambda c, l, t, n, k, _fn=fn: _fn(
-                    engine.params, c, l, t, n, k
+            if kind == "prefix_copy":
+                traced = jax.make_jaxpr(fn)(
+                    spec["cache"], spec["src"], spec["dst"], spec["n"]
                 )
-            )(spec["cache"], spec["lengths"], spec["tokens"],
-              spec["n_valid"], spec["key"])
+            elif kind.startswith("draft@"):
+                traced = jax.make_jaxpr(
+                    lambda c, l, t, n, _fn=fn: _fn(
+                        engine.draft_params, c, l, t, n
+                    )
+                )(spec["cache"], spec["lengths"], spec["tokens"],
+                  spec["n_valid"])
+            else:
+                traced = jax.make_jaxpr(
+                    lambda c, l, t, n, k, _fn=fn: _fn(
+                        engine.params, c, l, t, n, k
+                    )
+                )(spec["cache"], spec["lengths"], spec["tokens"],
+                  spec["n_valid"], spec["key"])
         except Exception as exc:  # noqa: BLE001 — converted to a finding
             findings.append(Finding(
                 rule="serving-trace",
@@ -350,7 +520,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     return 1 if worst else 0
 
 
-__all__ = ["DEFAULT_GRID", "certify_ladder", "lint_serving", "main"]
+__all__ = [
+    "DEFAULT_GRID",
+    "certify_ladder",
+    "certify_speculative",
+    "lint_serving",
+    "main",
+]
 
 
 if __name__ == "__main__":

@@ -1,0 +1,72 @@
+"""Fleet serving: the layer above one engine.
+
+:mod:`torchgpipe_tpu.serving` ends at ONE continuous-batching engine on
+one set of params.  This package is the horizontal story on top of it —
+the "millions of users" tier (docs/serving.md, fleet section):
+
+* :mod:`~torchgpipe_tpu.fleet.router` — N replicas behind one
+  ``submit()``: session affinity, power-of-two-choices balancing on the
+  shared :class:`~torchgpipe_tpu.obs.MetricsRegistry` occupancy/TPOT
+  series, and drain-aware failover riding the existing
+  ``CheckpointManager`` / ``Engine.restore_requests`` path — a replica
+  dying mid-generation resumes its in-flight requests on a SURVIVOR,
+  greedy outputs bitwise-equal to an undisturbed run.
+* :mod:`~torchgpipe_tpu.fleet.prefix_cache` — a radix trie over
+  :class:`~torchgpipe_tpu.serving.cache_pool.CachePool`: requests
+  sharing a system prompt reuse KV slots through refcounted donor pins
+  and one fixed-shape copy program; reuse is bitwise vs cold prefill.
+* :mod:`~torchgpipe_tpu.fleet.speculative` — a draft model through the
+  same pipelined decode path, target-verified in one chunked
+  ``decode_slots`` step that REUSES the engine's ``g > 1`` prefill
+  program, so the steady-state program count stays fixed
+  (``analysis.serving.certify_speculative``).
+* :mod:`~torchgpipe_tpu.fleet.trace` — a deterministic synthetic
+  million-request trace generator (ragged, bursty, shared-prefix
+  tenants) driving ``bench.py --fleet``, so fleet claims are measured,
+  not asserted.
+
+    from torchgpipe_tpu import fleet, serving
+    shared = obs.MetricsRegistry()
+    router = fleet.Router({
+        name: serving.Engine(cfg, flat, num_slots=4, max_len=64,
+                             registry=shared.labeled(replica=name))
+        for name in ("r0", "r1")
+    }, registry=shared)
+    rid = router.submit(prompt, 32, session="user-1")
+    router.run()
+    tokens = router.result(rid)
+"""
+
+from __future__ import annotations
+
+from torchgpipe_tpu.fleet.prefix_cache import RadixPrefixCache
+from torchgpipe_tpu.fleet.router import (
+    Replica,
+    ReplicaDied,
+    Router,
+    RouterRecord,
+)
+from torchgpipe_tpu.fleet.speculative import SpeculativeEngine
+from torchgpipe_tpu.fleet.trace import (
+    TraceConfig,
+    TraceRequest,
+    TraceStats,
+    synthetic_trace,
+    tenant_prefixes,
+    trace_summary,
+)
+
+__all__ = [
+    "RadixPrefixCache",
+    "Replica",
+    "ReplicaDied",
+    "Router",
+    "RouterRecord",
+    "SpeculativeEngine",
+    "TraceConfig",
+    "TraceRequest",
+    "TraceStats",
+    "synthetic_trace",
+    "tenant_prefixes",
+    "trace_summary",
+]

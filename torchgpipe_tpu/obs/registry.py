@@ -211,6 +211,142 @@ class Histogram(_Metric):
 MetricType = Union[Counter, Gauge, Histogram]
 
 
+class _BoundMetric:
+    """A metric with fixed label values pre-applied — what
+    :meth:`MetricsRegistry.labeled` hands out so per-replica components
+    (e.g. one ``ServingMetrics`` per fleet replica) share ONE registry
+    namespace while every series they touch carries its identity
+    (``replica="r0"``) without the component knowing about labels."""
+
+    def __init__(self, metric: _Metric, fixed: Dict[str, str]) -> None:
+        self.metric = metric
+        self.fixed = dict(fixed)
+
+    @property
+    def name(self) -> str:
+        return self.metric.name
+
+    @property
+    def kind(self) -> str:
+        return self.metric.kind
+
+    def _merge(self, labels: Dict[str, Any]) -> Dict[str, Any]:
+        overlap = set(self.fixed) & set(labels)
+        if overlap:
+            raise ValueError(
+                f"labels {sorted(overlap)} are fixed by the labeled view "
+                f"({self.fixed}) and cannot be overridden per call"
+            )
+        return {**self.fixed, **labels}
+
+
+class BoundCounter(_BoundMetric):
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        self.metric.inc(amount, **self._merge(labels))  # type: ignore[union-attr]
+
+    def set(self, value: float, **labels: Any) -> None:
+        self.metric.set(value, **self._merge(labels))  # type: ignore[union-attr]
+
+    def value(self, **labels: Any) -> float:
+        return self.metric.value(**self._merge(labels))  # type: ignore[union-attr]
+
+
+class BoundGauge(BoundCounter):
+    pass
+
+
+class BoundHistogram(_BoundMetric):
+    def observe(self, value: float, **labels: Any) -> None:
+        self.metric.observe(value, **self._merge(labels))  # type: ignore[union-attr]
+
+    def count(self, **labels: Any) -> int:
+        return self.metric.count(**self._merge(labels))  # type: ignore[union-attr]
+
+    def sum(self, **labels: Any) -> float:
+        return self.metric.sum(**self._merge(labels))  # type: ignore[union-attr]
+
+    def percentile(self, q: float, **labels: Any) -> Optional[float]:
+        return self.metric.percentile(q, **self._merge(labels))  # type: ignore[union-attr]
+
+    def summary(self, **labels: Any) -> Dict[str, Optional[float]]:
+        return self.metric.summary(**self._merge(labels))  # type: ignore[union-attr]
+
+
+class LabeledRegistry:
+    """A view of a :class:`MetricsRegistry` that stamps fixed labels on
+    every metric created through it (see :meth:`MetricsRegistry.labeled`).
+    Quacks like the registry for metric creation — components taking
+    ``registry=`` (``ServingMetrics``, ``GuardStats``, ``StepReporter``)
+    work unchanged — while reads/exports go through the BASE registry,
+    where all views' series live side by side, separable by label."""
+
+    def __init__(self, base: "MetricsRegistry",
+                 labels: Dict[str, Any]) -> None:
+        if not labels:
+            raise ValueError("labeled() needs at least one fixed label")
+        self.base = base
+        self.labels: Dict[str, str] = {
+            str(k): str(v) for k, v in sorted(labels.items())
+        }
+
+    @property
+    def clock(self) -> Callable[[], float]:
+        return self.base.clock
+
+    def _names(self, labels: Sequence[str]) -> Tuple[str, ...]:
+        overlap = set(self.labels) & set(labels)
+        if overlap:
+            raise ValueError(
+                f"labels {sorted(overlap)} are already fixed by this view "
+                f"({self.labels})"
+            )
+        return tuple(self.labels) + tuple(labels)
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> BoundCounter:
+        return BoundCounter(
+            self.base.counter(name, help, self._names(labels)), self.labels
+        )
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = ()) -> BoundGauge:
+        return BoundGauge(
+            self.base.gauge(name, help, self._names(labels)), self.labels
+        )
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Sequence[str] = ()) -> BoundHistogram:
+        return BoundHistogram(
+            self.base.histogram(name, help, self._names(labels)),
+            self.labels,
+        )
+
+    def labeled(self, **labels: Any) -> "LabeledRegistry":
+        """Narrow further (e.g. per-replica view narrowed per-tenant).
+        Already-fixed labels cannot be re-fixed — silently re-stamping
+        ``replica=`` would file every series under the wrong replica."""
+        overlap = set(self.labels) & set(labels)
+        if overlap:
+            raise ValueError(
+                f"labels {sorted(overlap)} are already fixed by this "
+                f"view ({self.labels}) — narrowing may only ADD labels"
+            )
+        return LabeledRegistry(self.base, {**self.labels, **labels})
+
+    # Export/read paths delegate to the base: the whole namespace.
+    def get(self, name: str) -> Optional[MetricType]:
+        return self.base.get(name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return self.base.snapshot()
+
+    def write_jsonl(self, dest: Union[str, IO[str]]) -> int:
+        return self.base.write_jsonl(dest)
+
+    def to_prometheus(self) -> str:
+        return self.base.to_prometheus()
+
+
 def counter_property(attr: str) -> property:
     """A legacy int-attribute facade over a registry :class:`Counter`
     stored at ``self.<attr>``: reads return the counter's value as an
@@ -301,6 +437,21 @@ class MetricsRegistry:
         with self._lock:
             return list(self._metrics.values())
 
+    def labeled(self, **labels: Any) -> LabeledRegistry:
+        """A view stamping ``labels`` on every metric created through it
+        — how N fleet replicas share one registry while staying
+        separable::
+
+            shared = MetricsRegistry()
+            m0 = ServingMetrics(registry=shared.labeled(replica="r0"))
+            m1 = ServingMetrics(registry=shared.labeled(replica="r1"))
+            shared.to_prometheus()   # serving_*{replica="r0"} + ...="r1"
+
+        Series created through different views of one name must agree on
+        the label SCHEMA (the create-or-get check); values differ per
+        view.  Exports on the view read the whole base namespace."""
+        return LabeledRegistry(self, labels)
+
     # ------------------------------------------------------------------ #
     # export                                                             #
     # ------------------------------------------------------------------ #
@@ -324,14 +475,23 @@ class MetricsRegistry:
             out[m.name] = rows.get("", rows) if list(rows) == [""] else rows
         return out
 
+    def _ordered_metrics(self) -> List[MetricType]:
+        """Exporter iteration order: metrics sorted by name, so two
+        processes (or two runs) that created the same series in a
+        different order — e.g. fleet replicas racing their first
+        request — emit byte-identical exports.  Series within a metric
+        are sorted by label-value tuple at each use site."""
+        return sorted(self.metrics(), key=lambda m: m.name)
+
     def write_jsonl(self, dest: Union[str, IO[str]]) -> int:
-        """One JSON object per (metric, series) line; returns the line
-        count.  ``dest`` is a path or an open text file."""
+        """One JSON object per (metric, series) line, in deterministic
+        order (metrics by name, series by label values); returns the
+        line count.  ``dest`` is a path or an open text file."""
         lines: List[str] = []
         t = self.clock()
-        for m in self.metrics():
+        for m in self._ordered_metrics():
             if isinstance(m, Histogram):
-                for key in m.series():
+                for key in sorted(m.series()):
                     labels = dict(zip(m.label_names, key))
                     rec: Dict[str, Any] = {
                         "metric": m.name, "type": m.kind, "time": t,
@@ -340,7 +500,7 @@ class MetricsRegistry:
                     rec.update(m.summary(**labels))
                     lines.append(json.dumps(rec))
             else:
-                for key, v in m.series().items():
+                for key, v in sorted(m.series().items()):
                     lines.append(json.dumps({
                         "metric": m.name, "type": m.kind, "time": t,
                         "labels": dict(zip(m.label_names, key)),
@@ -360,10 +520,14 @@ class MetricsRegistry:
         return read_jsonl(src)
 
     def to_prometheus(self) -> str:
-        """The Prometheus text exposition format.  Histograms export as
-        summaries (``{quantile="…"}`` rows plus ``_sum``/``_count``) —
-        the percentile-first shape, matching what :class:`Histogram`
-        actually stores."""
+        """The Prometheus text exposition format, in deterministic order
+        (metrics by name, series by label values — a multi-replica
+        registry scrapes identically however the replicas raced).
+        Histograms export as summaries (``{quantile="…"}`` rows plus
+        ``_sum``/``_count``) — the percentile-first shape, matching what
+        :class:`Histogram` actually stores.  Label values are escaped
+        per the exposition rules (backslash, quote, newline), so values
+        like ``replica="r0"`` round-trip through a scrape."""
 
         def esc(v: str) -> str:
             # The exposition format requires escaping backslash, quote
@@ -383,13 +547,13 @@ class MetricsRegistry:
             return "{" + ",".join(pairs) + "}" if pairs else ""
 
         out: List[str] = []
-        for m in self.metrics():
+        for m in self._ordered_metrics():
             if m.help:
                 out.append(f"# HELP {m.name} {m.help}")
             kind = "summary" if isinstance(m, Histogram) else m.kind
             out.append(f"# TYPE {m.name} {kind}")
             if isinstance(m, Histogram):
-                for key in m.series():
+                for key in sorted(m.series()):
                     labels = dict(zip(m.label_names, key))
                     for q in (0.5, 0.95, 0.99):
                         v = m.percentile(q, **labels)
@@ -409,7 +573,7 @@ class MetricsRegistry:
                         f"{m.count(**labels)}"
                     )
             else:
-                for key, v in m.series().items():
+                for key, v in sorted(m.series().items()):
                     out.append(
                         f"{m.name}{fmt_labels(m.label_names, key)} {v:g}"
                     )
@@ -436,9 +600,13 @@ def read_jsonl(src: Union[str, IO[str]]) -> List[Dict[str, Any]]:
 
 
 __all__ = [
+    "BoundCounter",
+    "BoundGauge",
+    "BoundHistogram",
     "Counter",
     "Gauge",
     "Histogram",
+    "LabeledRegistry",
     "MetricsRegistry",
     "RESERVOIR_SIZE",
     "counter_property",

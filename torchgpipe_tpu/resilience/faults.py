@@ -57,6 +57,15 @@ class FaultPlan:
     # AND the measured reconciliation sees it — a traced pipe is
     # required (the chaos targets the measurement path by design).
     slow_at: Optional[Tuple[int, float]] = None
+    # Kill serving-fleet replica ``replica`` at its engine step ``step``
+    # (replica, step): the fleet router checks :func:`should_die` before
+    # every replica iteration and raises
+    # :class:`torchgpipe_tpu.fleet.router.ReplicaDied` — the cooperative
+    # replica-death the failover tests drive (mid-generation when
+    # ``step`` lands between a request's first and last token).  Like
+    # ``slow_at`` it is host-side only: traces nothing, never tokens
+    # the compiled-program caches (:func:`plan_token` stays None).
+    die_at_step: Optional[Tuple[int, int]] = None
 
 
 _lock = threading.Lock()
@@ -73,6 +82,7 @@ def inject(
     nan_at: Optional[Tuple[int, int]] = None,
     preempt_at_step: Optional[int] = None,
     slow_at: Optional[Tuple[int, float]] = None,
+    die_at_step: Optional[Tuple[int, int]] = None,
 ) -> Iterator[FaultPlan]:
     """Activate a :class:`FaultPlan` for the enclosed block.
 
@@ -81,7 +91,7 @@ def inject(
     """
     global _active, _epoch
     plan = FaultPlan(nan_at=nan_at, preempt_at_step=preempt_at_step,
-                     slow_at=slow_at)
+                     slow_at=slow_at, die_at_step=die_at_step)
     with _lock:
         if _active is not None:
             raise RuntimeError(
@@ -164,6 +174,21 @@ def cell_delay_s(stage: int) -> float:
     if plan is None or plan.slow_at is None or plan.slow_at[0] != stage:
         return 0.0
     return float(plan.slow_at[1])
+
+
+def should_die(replica: int, step: int) -> bool:
+    """True iff the active plan kills serving replica ``replica`` at or
+    before its engine step ``step`` — the fleet router's cooperative
+    death check (``Router.step`` raises ``ReplicaDied`` on a hit).
+    Host-side only: inert for tracing, so compiled-program caches are
+    never invalidated by entering/leaving the plan."""
+    plan = _active
+    return (
+        plan is not None
+        and plan.die_at_step is not None
+        and plan.die_at_step[0] == replica
+        and step >= plan.die_at_step[1]
+    )
 
 
 def should_preempt(step: int) -> bool:

@@ -80,6 +80,13 @@ class CachePool:
         # exercising the stale-row masking continuously.
         self._free: List[int] = list(range(num_slots - 1, -1, -1))
         self._owner: Dict[int, str] = {}
+        # Per-slot refcounts: alloc() hands the owner one reference;
+        # retain() adds more (the fleet prefix cache pins donor slots
+        # this way).  A slot re-enters the LIFO free list only when the
+        # LAST reference releases — a pinned slot outlives its request
+        # and can never be recycled while something still reads its
+        # rows (the refcount invariant tools/fleet_verify.py churns).
+        self._refs: Dict[int, int] = {}
 
     # ------------------------------------------------------------------ #
     # allocation                                                         #
@@ -91,24 +98,58 @@ class CachePool:
 
     @property
     def num_active(self) -> int:
-        return self.num_slots - len(self._free)
+        """Slots owned by a live request (pinned-only slots excluded)."""
+        return len(self._owner)
+
+    @property
+    def num_pinned(self) -> int:
+        """Slots kept out of the free list ONLY by extra references
+        (``retain``) — typically prefix-cache donors whose request has
+        finished."""
+        return self.num_slots - len(self._free) - len(self._owner)
 
     def alloc(self, owner: str) -> Optional[int]:
-        """Hand a free slot to ``owner`` (its frontier reset to 0), or
-        ``None`` when the pool is exhausted."""
+        """Hand a free slot to ``owner`` (its frontier reset to 0, one
+        reference), or ``None`` when the pool is exhausted."""
         if not self._free:
             return None
         slot = self._free.pop()
         self._owner[slot] = owner
+        self._refs[slot] = 1
         self.lengths[slot] = 0
         return slot
 
+    def retain(self, slot: int) -> int:
+        """Add a reference to an allocated/pinned slot (the prefix
+        cache's donor pin); returns the new refcount."""
+        if slot not in self._refs:
+            raise KeyError(f"slot {slot} is not allocated")
+        self._refs[slot] += 1
+        return self._refs[slot]
+
+    def refcount(self, slot: int) -> int:
+        return self._refs.get(slot, 0)
+
     def free(self, slot: int) -> None:
-        """Recycle a slot.  No device work: stale rows are dead by
-        masking (see the module docstring)."""
+        """The OWNER's release: the slot loses its request but recycles
+        only when no extra references pin it (refcount 0).  No device
+        work either way: stale rows are dead by masking (see the module
+        docstring)."""
         if slot not in self._owner:
             raise KeyError(f"slot {slot} is not allocated")
         del self._owner[slot]
+        self.release(slot)
+
+    def release(self, slot: int) -> None:
+        """Drop one (non-owner) reference; at refcount 0 the slot
+        re-enters the LIFO free list with its frontier zeroed."""
+        refs = self._refs.get(slot)
+        if refs is None:
+            raise KeyError(f"slot {slot} is not allocated")
+        if refs > 1:
+            self._refs[slot] = refs - 1
+            return
+        del self._refs[slot]
         self.lengths[slot] = 0
         self._free.append(slot)
 
@@ -117,6 +158,30 @@ class CachePool:
 
     def active_slots(self) -> List[int]:
         return sorted(self._owner)
+
+    def check_refcounts(self) -> None:
+        """Structural invariants, for tests and the fleet-verify churn
+        grid: free/referenced partition the slots, every owned slot is
+        referenced, refcounts are positive.  Raises (never ``assert``
+        — the gate must stay live under ``python -O``)."""
+        free = set(self._free)
+        reffed = set(self._refs)
+        if free & reffed:
+            raise RuntimeError(
+                f"slots both free and referenced: {sorted(free & reffed)}"
+            )
+        if free | reffed != set(range(self.num_slots)):
+            raise RuntimeError(
+                f"free {sorted(free)} + referenced {sorted(reffed)} do "
+                f"not partition the {self.num_slots} slots"
+            )
+        if not set(self._owner) <= reffed:
+            raise RuntimeError(
+                f"owned slots {sorted(set(self._owner) - reffed)} carry "
+                "no reference"
+            )
+        if any(n < 1 for n in self._refs.values()):
+            raise RuntimeError(f"non-positive refcount: {self._refs}")
 
     # ------------------------------------------------------------------ #
     # accounting                                                         #

@@ -47,6 +47,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchgpipe_tpu.models.generation import (
+    KVCache,
+    QuantKVCache,
     _check_decodable,
     _sample,
     _split_params,
@@ -117,6 +119,7 @@ class Engine:
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        prefix_cache: Optional[Any] = None,
         rng: Optional[jnp.ndarray] = None,
         hbm_budget_bytes: Optional[int] = None,
         overhead_bytes: int = 0,
@@ -191,6 +194,17 @@ class Engine:
             clock=clock, registry=registry
         )
         self.reporter = reporter
+        # Radix prefix-sharing KV cache (torchgpipe_tpu.fleet.
+        # prefix_cache): admission consults the trie before prefilling —
+        # a request whose prompt extends a cached prefix COPIES the
+        # donor slot's KV rows (one fixed-shape compiled program) and
+        # prefills only the remainder; completed prefills insert their
+        # prompt, pinning the slot via the pool refcounts.
+        self._prefix_cache = prefix_cache
+        # drain hooks: called with the snapshot dict after every drain —
+        # the fleet router registers here so a draining replica's
+        # in-flight requests can resume elsewhere.
+        self.drain_hooks: List[Callable[[Dict[str, Any]], None]] = []
         self.guard_policy = guard_policy or GuardPolicy()
         self._sleep = sleep
         self._preemption = preemption
@@ -268,12 +282,19 @@ class Engine:
                     logits, last[:, None, None], axis=1
                 )[:, 0]
                 tok, key = sample_row(row_logits, key)
+                # Per-POSITION greedy tokens [S, g]: what the target
+                # model would emit after consuming each input position.
+                # Chunked prefill ignores it; speculative decoding's
+                # verify pass IS this program — the grid is the
+                # acceptance oracle, so speculation adds ZERO target
+                # programs (fleet/speculative.py).
+                grid = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 # Advance the frontiers ON DEVICE (lengths += the rows
                 # each slot consumed): the next step reuses this array
                 # instead of re-uploading the host mirror — the per-step
                 # host→device lengths copy disappears from the
                 # steady-state decode path.
-                return tok, cache, lengths + n_valid, key
+                return tok, grid, cache, lengths + n_valid, key
             return prefill_body
 
         def decode_body(params, cache, lengths, tokens, n_valid, key):
@@ -291,13 +312,58 @@ class Engine:
         }
         self._decode_fn = jax.jit(decode_body, donate_argnums=donate)
 
+        self._prefix_copy_fn = None
+        if self._prefix_cache is not None:
+            counts["prefix_copy"] = 0
+            L = self.pool.max_len
+
+            def prefix_copy_body(cache, src, dst, n):
+                # Copy rows [0, n) of slot ``src`` into slot ``dst``
+                # for every layer (K, V, and int8 scales).  src/dst/n
+                # are traced VALUES — one fixed-shape program serves
+                # every reuse, preserving the static program count.
+                # Bitwise: the donor's rows are exactly what a cold
+                # prefill of the same tokens at the same positions
+                # writes, so a reused request's cache equals the cold
+                # one bit-for-bit (the fleet-verify gate).
+                counts["prefix_copy"] += 1
+                row_mask = jnp.arange(L) < n          # [L]
+
+                def copy_len_axis(bank, axis):
+                    # mask shaped to broadcast along the length axis
+                    shape = [1] * (bank.ndim - 1)
+                    shape[axis - 1] = L
+                    m = row_mask.reshape(shape)
+                    merged = jnp.where(m, bank[src], bank[dst])
+                    return bank.at[dst].set(merged)
+
+                k = [copy_len_axis(b, 1) for b in cache.k]
+                v = [copy_len_axis(b, 1) for b in cache.v]
+                if isinstance(cache, QuantKVCache):
+                    return QuantKVCache(
+                        k=k, v=v,
+                        k_scale=[copy_len_axis(b, 2)
+                                 for b in cache.k_scale],
+                        v_scale=[copy_len_axis(b, 2)
+                                 for b in cache.v_scale],
+                        length=cache.length,
+                    )
+                return KVCache(k=k, v=v, length=cache.length)
+
+            self._prefix_copy_fn = jax.jit(
+                prefix_copy_body,
+                donate_argnums=(0,) if self.donate else (),
+            )
+
     @property
     def program_count(self) -> int:
         """The statically bounded compiled-program count: one prefill
-        program per ladder bucket plus the decode program — the figure
-        ``analysis.serving`` certifies and the compile-counter test
-        confirms dynamically."""
-        return len(self.prefill_buckets) + 1
+        program per ladder bucket plus the decode program (plus the one
+        fixed-shape ``prefix_copy`` program when a prefix cache is
+        attached) — the figure ``analysis.serving`` certifies and the
+        compile-counter test confirms dynamically."""
+        extra = 1 if self._prefix_cache is not None else 0
+        return len(self.prefill_buckets) + 1 + extra
 
     def step_input_specs(self) -> Dict[str, Any]:
         """The (shape, dtype) signature of each compiled program's
@@ -316,10 +382,17 @@ class Engine:
             "n_valid": sds((S,), np.int32),
             "key": sds(self._key.shape, self._key.dtype),
         }
-        return {
+        specs = {
             kind: dict(common, tokens=sds(shape, np.int32))
             for kind, shape in self._token_shapes.items()
         }
+        if self._prefix_copy_fn is not None:
+            scalar = sds((), np.int32)
+            specs["prefix_copy"] = {
+                "cache": cache_spec, "src": scalar, "dst": scalar,
+                "n": scalar,
+            }
+        return specs
 
     def _token_buffer(self, kind: str) -> np.ndarray:
         return np.zeros(self._token_shapes[kind], np.int32)
@@ -437,8 +510,20 @@ class Engine:
         """ONE engine iteration: admit, pick a phase, run its compiled
         program, emit/evict.  Returns False when idle (nothing ran)."""
         if not self._draining:
+            if (
+                self._prefix_cache is not None
+                and self.scheduler.queue
+                and self.pool.num_free == 0
+            ):
+                # Admission pressure: evict idle prefix entries (their
+                # pins are the only remaining references) so queued
+                # requests beat cached prefixes to slots.
+                self._prefix_cache.reclaim(
+                    self.pool, len(self.scheduler.queue)
+                )
             for req in self.scheduler.admit():
                 self.metrics.admitted(req.rid)
+                self._on_admit(req)
         action = self.scheduler.next_action()
         if action is None:
             return False
@@ -449,6 +534,34 @@ class Engine:
         if self.reporter is not None:
             self.reporter.step()
         return True
+
+    def _on_admit(self, req: Request) -> None:
+        """Per-admission hook: prefix-cache consult here; subclasses
+        extend (``fleet.SpeculativeEngine`` resets the recycled slot's
+        draft frontier)."""
+        if self._prefix_cache is not None:
+            self._apply_prefix_reuse(req)
+
+    def _apply_prefix_reuse(self, req: Request) -> None:
+        """Admission-time trie consult: when the prompt extends a cached
+        prefix, copy the donor slot's KV rows into the request's slot
+        (one fixed-shape compiled dispatch, bitwise-equal to cold
+        prefill of the same tokens) and mark the prefix absorbed.  At
+        most ``prompt_len - 1`` tokens reuse — the LAST prompt token
+        always prefills, producing the first-token logits."""
+        pc = self._prefix_cache
+        m, donor = pc.match(req.prompt, limit=req.prompt_len - 1)
+        if m <= 0 or donor is None:
+            return
+        assert req.slot is not None
+        new_cache = self._dispatch(
+            self._prefix_copy_fn, self.pool.cache,
+            jnp.int32(donor), jnp.int32(req.slot), jnp.int32(m),
+        )
+        self.pool.cache = new_cache
+        self.pool.lengths[req.slot] = m      # shadow miss -> re-upload
+        req.prefilled = m
+        self.metrics.prefix_hit(m)
 
     def _run_prefill(self) -> None:
         reqs = self.scheduler.prefill_pending()
@@ -465,7 +578,7 @@ class Engine:
             tokens[r.slot, :take] = r.prompt[r.prefilled:r.prefilled + take]
             n_valid[r.slot] = take
             takes.append((r, take))
-        tok, cache, lengths_dev, key = self._dispatch(
+        tok, _grid, cache, lengths_dev, key = self._dispatch(
             self._prefill_fns[name], self.params, self.pool.cache,
             self._lengths_for_step(), jnp.asarray(tokens),
             jnp.asarray(n_valid), self._key,
@@ -476,6 +589,10 @@ class Engine:
         # below runs while it is in flight (copy_to_host_async is a hint
         # — np.asarray below is the one materialization point).
         _start_host_copy(tok)
+        # Subclass hook: speculative decoding mirrors every prefill
+        # chunk into its draft model's cache (same bucket, same buffer)
+        # so draft and target stay frontier-aligned.
+        self._after_prefill_dispatch(g, tokens, n_valid)
         self._commit_lengths(lengths_dev, n_valid)
         self.metrics.step("prefill", len(reqs), self.pool.num_slots)
         tok_host: Optional[np.ndarray] = None
@@ -483,9 +600,22 @@ class Engine:
             self.pool.lengths[r.slot] += take
             r.prefilled += take
             if r.prefill_done:
+                if self._prefix_cache is not None:
+                    # The slot now holds the full prompt's KV: it
+                    # becomes a donor (the insert pins it via the pool
+                    # refcounts, so recycling waits for eviction).
+                    self._prefix_cache.insert(
+                        r.prompt, r.slot, self.pool
+                    )
                 if tok_host is None:
                     tok_host = np.asarray(tok)  # ONE host fetch per step
                 self._emit(r, int(tok_host[r.slot]))
+
+    def _after_prefill_dispatch(
+        self, g: int, tokens: np.ndarray, n_valid: np.ndarray
+    ) -> None:
+        """No-op hook; ``fleet.SpeculativeEngine`` overrides it to
+        teacher-force the same prompt chunk into the draft cache."""
 
     def _run_decode(self) -> None:
         reqs = self.scheduler.decode_ready()
@@ -606,7 +736,10 @@ class Engine:
             )
             self._last_drain_sid = sid
         self._drain_requested = False
-        return {"tree": tree, "requests": meta}
+        snapshot = {"tree": tree, "requests": meta}
+        for hook in list(self.drain_hooks):
+            hook(snapshot)
+        return snapshot
 
     @staticmethod
     def restore_requests(source: Any) -> List[Dict[str, Any]]:

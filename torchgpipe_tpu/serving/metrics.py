@@ -107,6 +107,13 @@ class ServingMetrics:
         self._c_preempted = reg.counter(
             "serving_preempted_requests",
             help="unfinished requests at drain time")
+        self._c_prefix_hits = reg.counter(
+            "serving_prefix_hits",
+            help="admissions that reused a cached KV prefix")
+        self._c_prefix_tokens = reg.counter(
+            "serving_prefix_reused_tokens",
+            help="prompt tokens absorbed by KV-prefix copies "
+                 "(prefill FLOPs avoided)")
         self._h_ttft = reg.histogram(
             "serving_ttft_seconds", help="time to first token (arrival→)")
         self._h_tpot = reg.histogram(
@@ -125,6 +132,8 @@ class ServingMetrics:
     retries = _counter_property("_c_retries")
     drains = _counter_property("_c_drains")
     preempted_requests = _counter_property("_c_preempted")
+    prefix_hits = _counter_property("_c_prefix_hits")
+    prefix_reused_tokens = _counter_property("_c_prefix_tokens")
 
     # ------------------------------------------------------------------ #
     # request lifecycle                                                  #
@@ -179,6 +188,12 @@ class ServingMetrics:
         self._c_drains.inc()
         self._c_preempted.inc(unfinished)
 
+    def prefix_hit(self, reused_tokens: int) -> None:
+        """One admission reused ``reused_tokens`` prompt tokens from the
+        KV prefix cache (prefill work avoided)."""
+        self._c_prefix_hits.inc()
+        self._c_prefix_tokens.inc(reused_tokens)
+
     # ------------------------------------------------------------------ #
     # snapshot                                                           #
     # ------------------------------------------------------------------ #
@@ -224,6 +239,8 @@ class ServingMetrics:
             "retries": self.retries,
             "drains": self.drains,
             "preempted_requests": self.preempted_requests,
+            "prefix_hits": self.prefix_hits,
+            "prefix_reused_tokens": self.prefix_reused_tokens,
             "ttft_p50": self._h_ttft.percentile(0.50),
             "ttft_p95": self._h_ttft.percentile(0.95),
             "ttft_p99": self._h_ttft.percentile(0.99),
