@@ -13,6 +13,18 @@ burst.  Four rungs serve the SAME trace:
 * ``failover``  — the baseline fleet with replica r0 killed mid-trace
   (``faults.inject(die_at_step=...)``).
 
+A fifth **telemetry-overhead** rung then gates the request-tracing +
+SLO layer (``obs.reqtrace``/``obs.slo``): ONE pre-warmed instrumented
+fleet replays the SAME trace with its telemetry toggled ON (per-replica
+flight recorders recording rid-threaded request spans + a ticking
+``SloMonitor``) and OFF (the production off-switch: the attributes set
+to None), in order-alternated gc-hygienic rounds, and the ratio of
+median times must stay under the repo's established <2% telemetry
+gate, outputs bitwise-identical.  Toggling one fleet rather than
+comparing two separately built ones is deliberate: fleet-object
+identity (allocator layout, history) measured 2-8% of noise on CPU —
+far above the real per-event cost (see BENCH_NOTES round 19).
+
 Measurement contract:
 
 * **Exactness is the hard gate** — all four rungs must emit BITWISE
@@ -221,6 +233,117 @@ def _serve(mk_engine, reqs, label: str, *,
     }
 
 
+def _replay(router: "fleet.Router", reqs: List[Tuple],
+            label: str) -> Tuple[List[List[int]], float]:
+    """One timed closed-loop replay of the trace through a pre-warmed
+    fleet (submit in arrival order, one router step between arrivals,
+    run to idle) — the telemetry-overhead rung's unit of work."""
+    rids = []
+    t0 = time.perf_counter()
+    for i, (p, n, sess) in enumerate(reqs):
+        rids.append(router.submit(p, n, rid=f"{label}{i}", session=sess))
+        router.step()
+    router.run()
+    dt = time.perf_counter() - t0
+    return [router.result(r).tolist() for r in rids], dt
+
+
+def _telemetry_overhead(cfg, params, reqs, common, rounds: int) -> Dict:
+    """Toggle-based A/B on ONE fleet: the same instrumented router
+    replays the trace with its telemetry armed (per-replica
+    FlightRecorders recording rid-threaded request spans + a ticking
+    SloMonitor + the router recorder) and disarmed (the attributes set
+    to None — the exact production off-switch), in order-alternated
+    gc-hygienic rounds.  Sharing one fleet object between A and B is
+    the point: two separately built fleets differ by allocator layout
+    and object history, and that identity noise measured 2-8% on this
+    CPU — far above the real telemetry cost (~1 µs per ring event).
+    Ratio of median times, gated <2%."""
+    import gc
+
+    from torchgpipe_tpu import obs
+    from torchgpipe_tpu.obs.flightrec import FlightRecorder
+
+    shared = obs.MetricsRegistry()
+    recorders = {n: FlightRecorder(worker=n) for n in ("r0", "r1")}
+    engines = {
+        n: Engine(cfg, params, registry=shared.labeled(replica=n),
+                  recorder=recorders[n], **common)
+        for n in ("r0", "r1")
+    }
+    # Thresholds far above any CPU latency here: the rung measures the
+    # EVALUATION cost (throttled ticks, window math, exact over-
+    # threshold counting), not alert handling — no eviction may fire.
+    monitor = obs.SloMonitor(
+        shared,
+        [obs.Objective(name="ttft-p95", threshold=30.0,
+                       target=0.95, series="serving_ttft_seconds"),
+         obs.Objective(name="tpot-p95", threshold=30.0,
+                       target=0.95, series="serving_tpot_seconds")],
+        short_window=2.0, long_window=8.0,
+    )
+    router_rec = FlightRecorder(worker="router")
+    router = fleet.Router(
+        engines, registry=shared, seed=1, slo=monitor,
+        recorder=router_rec,
+    )
+
+    def arm(on: bool) -> None:
+        for n, rep in router.replicas.items():
+            rep.engine.recorder = recorders[n] if on else None
+        router.slo = monitor if on else None
+        router.recorder = router_rec if on else None
+
+    def timed(label: str) -> Tuple[List[List[int]], float]:
+        # One collection BEFORE the timed region, none inside: a GC
+        # pause landing in one variant's window is the largest single
+        # noise source at this effect size.
+        gc.collect()
+        gc.disable()
+        try:
+            return _replay(router, reqs, label)
+        finally:
+            gc.enable()
+
+    _replay(router, reqs, "tw")     # full warm pass: compiles out
+    times_on: List[float] = []
+    times_off: List[float] = []
+    outs_on = outs_off = None
+    for k in range(rounds):
+        for phase in (0, 1):
+            on = (k % 2 == 0) == (phase == 0)
+            arm(on)
+            outs, dt = timed(f"{'a' if on else 'b'}{k}-")
+            if on:
+                outs_on = outs
+                times_on.append(dt)
+            else:
+                outs_off = outs
+                times_off.append(dt)
+    arm(True)
+    if outs_on != outs_off:
+        raise SystemExit(
+            "EXACTNESS FAIL: telemetry changed an output stream"
+        )
+    if any(rep.degraded for rep in router.replicas.values()):
+        raise SystemExit(
+            "telemetry rung evicted a replica — the no-alert "
+            "thresholds are wrong"
+        )
+
+    from statistics import median
+
+    ratio = median(times_on) / median(times_off)
+    ratios = [t / p for t, p in zip(times_on, times_off)]
+    return {
+        "rounds": rounds,
+        "ratio_median": round(ratio, 4),
+        "ratio_range": [round(min(ratios), 4), round(max(ratios), 4)],
+        "overhead_pct_median": round((ratio - 1.0) * 100.0, 2),
+        "within_gate": ratio < 1.02,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=48)
@@ -232,6 +355,11 @@ def main() -> None:
     ap.add_argument("--die-at-step", type=int, default=None,
                     help="failover rung's (r0, step); default: "
                     "mid-trace (requests // 2)")
+    ap.add_argument("--overhead-rounds", type=int, default=12,
+                    help="paired A/B rounds for the telemetry-overhead "
+                    "rung (0 disables it); run on an OTHERWISE IDLE "
+                    "host — single-round CPU noise exceeds the effect "
+                    "(BENCH_NOTES round 19)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line (bench.py --fleet)")
     args = ap.parse_args()
@@ -332,6 +460,20 @@ def main() -> None:
             f"{fo._c_failovers.value()}, moved={fo._c_moved.value()})"
         )
 
+    # HARD GATE 3: request tracing + SLO evaluation must stay within
+    # the repo's established <2% telemetry-overhead budget.
+    telemetry = None
+    if args.overhead_rounds > 0:
+        telemetry = _telemetry_overhead(
+            cfg, params, reqs, common, args.overhead_rounds
+        )
+        if not telemetry["within_gate"]:
+            raise SystemExit(
+                f"telemetry overhead {telemetry['overhead_pct_median']:+.2f}% "
+                f"(median of {telemetry['rounds']} paired rounds, range "
+                f"{telemetry['ratio_range']}) exceeds the 2% gate"
+            )
+
     base, px, sp, fv = (
         rungs["baseline"], rungs["prefix"], rungs["spec"],
         rungs["failover"],
@@ -386,6 +528,7 @@ def main() -> None:
                                            1e-9), 3
             ),
         },
+        "telemetry_overhead": telemetry,
         "exactness_gated": True,
         # every non-failover rung's timed region compiled nothing new
         "steady_state_stable": {
@@ -393,7 +536,7 @@ def main() -> None:
         },
         "validated": all(
             r["steady_state_stable"] for r in rungs.values()
-        ),
+        ) and (telemetry is None or telemetry["within_gate"]),
     }
     if args.json:
         print(json.dumps(out), flush=True)
@@ -423,7 +566,15 @@ def main() -> None:
         f"ttft x{out['speedups']['prefix_ttft']:.2f} (prefix), "
         f"tpot x{out['speedups']['spec_tpot']:.2f} / "
         f"throughput x{out['speedups']['spec_tokens_per_sec']:.2f} "
-        f"(spec)",
+        f"(spec)"
+        + (
+            f"\n  telemetry  {telemetry['overhead_pct_median']:+.2f}% "
+            f"median overhead over {telemetry['rounds']} paired rounds "
+            f"(range {telemetry['ratio_range']}) — "
+            f"{'within' if telemetry['within_gate'] else 'OVER'} the "
+            f"2% gate"
+            if telemetry is not None else ""
+        ),
         flush=True,
     )
 

@@ -613,7 +613,165 @@ def test_speculative_statically_certified(flat_params, draft_params):
 
 
 # --------------------------------------------------------------------- #
-# 4. the synthetic trace                                                #
+# 4. request tracing + SLO observe->act (obs.reqtrace / obs.slo)        #
+# --------------------------------------------------------------------- #
+
+
+def test_failover_stitches_one_request_trace(flat_params):
+    """An induced mid-generation death leaves rid-correlated flight
+    events on BOTH replicas' recorders; the stitcher rebuilds ONE span
+    tree spanning them with the migration explicit and no orphans —
+    and threading the recorder is trace-inert (no program retraced)."""
+    from torchgpipe_tpu import obs
+    from torchgpipe_tpu.obs.flightrec import FlightRecorder, dump_from_dict
+
+    recs = {n: FlightRecorder(worker=n) for n in ("r0", "r1")}
+    router_rec = FlightRecorder(worker="router")
+    router = fleet.Router(
+        {n: _mk_engine(flat_params, recorder=recs[n])
+         for n in ("r0", "r1")},
+        seed=1, recorder=router_rec,
+    )
+    reqs = _shared_prefix_workload(seed=0, n=6)
+    with faults.inject(die_at_step=(0, 3)):
+        rids = [router.submit(p, n) for p, n in reqs]
+        assert router.run() == "idle"
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            router.result(rid), _ref(flat_params, p, n)
+        ), rid
+    # recorder threading never tokens the compiled-program caches
+    for rep in router.replicas.values():
+        assert all(v <= 1 for v in rep.engine.trace_counts.values())
+    moved = [r for r in rids if router._records[r].moves > 0]
+    assert moved, "death at step 3 moved nothing"
+    dumps = [dump_from_dict(r.to_dict())
+             for r in (*recs.values(), router_rec)]
+    # every engine-side event carries the correlation key
+    for d in dumps[:2]:
+        assert all(
+            e.rid is not None
+            for e in d.events if e.kind.startswith("req_")
+        )
+    trace = obs.stitch_request(dumps, moved[0])
+    assert trace.replicas == ["r0", "r1"]
+    assert trace.migrations == 1
+    assert trace.orphans == [] and trace.complete
+    names = [s.name for s in trace.root.children]
+    assert "migration r0->r1" in names
+    attempt0 = next(s for s in trace.root.children
+                    if s.name == "attempt@r0")
+    kinds = [c.name for c in attempt0.children]
+    assert "queue" in kinds and "prefill" in kinds
+    assert kinds[-1] == "preempt"      # r0's story ends at the drain
+    tree = obs.format_request_tree(trace)
+    assert "migration r0->r1" in tree
+    # an unmoved request stays a one-replica, zero-migration tree
+    solo = next(r for r in rids if router._records[r].moves == 0)
+    solo_trace = obs.stitch_request(dumps, solo)
+    assert len(solo_trace.replicas) == 1
+    assert solo_trace.migrations == 0 and solo_trace.complete
+
+
+@pytest.mark.slow  # real SLO windows drain on the wall clock (~3s)
+def test_slo_monitor_evicts_slow_replica_then_readmits(flat_params):
+    """The serving observe->act loop on live engines: a slow_replica_at
+    fault degrades exactly the slowed replica, its in-flight requests
+    resume bitwise on the survivor, and after the fault clears its
+    windows drain and the router re-admits it."""
+    import time as _time
+
+    from torchgpipe_tpu import obs
+
+    shared = MetricsRegistry()
+    engines = {
+        n: _mk_engine(flat_params, name=n, shared=shared)
+        for n in ("r0", "r1")
+    }
+    # warm compiles BEFORE the monitor attaches: over-threshold
+    # counting starts at attach, so compile latencies are not "bad"
+    for eng in engines.values():
+        eng.submit(np.arange(6, dtype=np.int32), 2, rid="warm")
+        eng.run()
+    monitor = obs.SloMonitor(
+        shared,
+        [obs.Objective(name="ttft-p95", threshold=0.03, target=0.95,
+                       series="serving_ttft_seconds"),
+         obs.Objective(name="tpot-p95", threshold=0.03, target=0.95,
+                       series="serving_tpot_seconds")],
+        short_window=0.25, long_window=0.8, burn_threshold=2.0,
+        min_count=2,
+    )
+    router = fleet.Router(engines, registry=shared, seed=1, slo=monitor)
+    router._sessions["sick"] = "r0"      # pin the burst to the victim
+    reqs = _shared_prefix_workload(seed=21, n=4)
+    with faults.inject(slow_replica_at=(0, 0.04)):
+        rids = [router.submit(p, n, session="sick") for p, n in reqs]
+        assert router.run() == "idle"
+    assert router.replicas["r0"].degraded
+    assert not router.replicas["r1"].degraded
+    assert router._c_slo_evicted.value(replica="r0") == 1
+    assert shared.get("fleet_degraded").value(replica="r0") == 1.0
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(
+            router.result(rid), _ref(flat_params, p, n)
+        ), rid
+    # fault gone: windows drain, the replica re-admits and serves again
+    deadline = _time.monotonic() + 10.0
+    while router.replicas["r0"].degraded:
+        assert _time.monotonic() < deadline, "never re-admitted"
+        router.step()
+        _time.sleep(0.05)
+    assert router._c_slo_readmitted.value(replica="r0") == 1
+    assert shared.get("fleet_degraded").value(replica="r0") == 0.0
+    p, n = np.arange(5, dtype=np.int32), 3
+    router._sessions["back"] = "r0"
+    rid = router.submit(p, n, session="back")
+    assert router.run() == "idle"
+    assert np.array_equal(router.result(rid), _ref(flat_params, p, n))
+
+
+@pytest.mark.slow  # sleeps under a real wall-clock latency fault
+def test_slo_never_evicts_last_replica(flat_params):
+    """The min-in-rotation brake: a single-replica fleet breaching its
+    objective stays in rotation (degrading the whole fleet to protect
+    latency serves nobody) — the skip is a recorded flight event."""
+    import time as _time
+
+    from torchgpipe_tpu import obs
+    from torchgpipe_tpu.obs.flightrec import FlightRecorder
+
+    shared = MetricsRegistry()
+    eng = _mk_engine(flat_params, name="r0", shared=shared)
+    eng.submit(np.arange(6, dtype=np.int32), 2, rid="warm")
+    eng.run()
+    monitor = obs.SloMonitor(
+        shared,
+        [obs.Objective(name="tpot-p95", threshold=0.005, target=0.9,
+                       series="serving_tpot_seconds")],
+        short_window=0.1, long_window=0.3, burn_threshold=1.0,
+        min_count=1,
+    )
+    rec = FlightRecorder(worker="router")
+    router = fleet.Router({"r0": eng}, registry=shared, slo=monitor,
+                          recorder=rec)
+    with faults.inject(slow_replica_at=(0, 0.03)):
+        rid = router.submit(np.arange(6, dtype=np.int32), 4)
+        assert router.run() == "idle"
+        for _ in range(4):          # keep ticking on the idle fleet
+            router.step()
+            _time.sleep(0.03)
+    # the alert DID fire at least once ...
+    assert shared.get("slo_alerts_total").value(
+        objective="tpot-p95", split="r0") >= 1
+    assert not router.replicas["r0"].degraded  # ... but nobody evicted
+    assert router._c_slo_evicted.value(replica="r0") == 0
+    assert any(e.kind == "slo_evict_skipped" for e in rec.events())
+    assert router.result(rid).size == 4
+
+
+# --------------------------------------------------------------------- #
+# 5. the synthetic trace                                                #
 # --------------------------------------------------------------------- #
 
 

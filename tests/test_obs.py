@@ -717,3 +717,68 @@ def test_trace_report_cli_gate_failure(capsys):
     err = capsys.readouterr().err
     assert rc == 1
     assert "DRIFT" in err and "coverage" in err
+
+
+# --------------------------------------------------------------------- #
+# histogram reservoir determinism (the SLO layer's substrate)           #
+# --------------------------------------------------------------------- #
+
+
+def test_histogram_reservoir_deterministic_under_labeled_views():
+    """Two identical runs feeding per-replica labeled() views — past
+    the reservoir capacity, so algorithm-R replacement is exercised —
+    summarize IDENTICALLY: the percentile substrate the SLO monitor
+    and the fleet bench read must not wobble run to run."""
+    from torchgpipe_tpu.obs.registry import RESERVOIR_SIZE
+
+    def run():
+        reg = obs.MetricsRegistry(clock=lambda: 0.0)
+        views = {n: reg.labeled(replica=n) for n in ("r0", "r1")}
+        hists = {
+            n: v.histogram("serving_ttft_seconds")
+            for n, v in views.items()
+        }
+        for i in range(RESERVOIR_SIZE + 500):
+            hists["r0"].observe((i * 37 % 1000) / 1000.0)
+            hists["r1"].observe((i * 53 % 997) / 997.0)
+        return reg
+
+    a, b = run(), run()
+    ha, hb = a.get("serving_ttft_seconds"), b.get("serving_ttft_seconds")
+    for n in ("r0", "r1"):
+        sa, sb = ha.summary(replica=n), hb.summary(replica=n)
+        assert sa == sb
+        for q in (0.50, 0.95, 0.99):
+            assert ha.percentile(q, replica=n) == hb.percentile(
+                q, replica=n
+            )
+    # and the two runs' exports are byte-identical
+    assert a.to_prometheus() == b.to_prometheus()
+
+
+def test_histogram_percentiles_survive_jsonl_round_trip(tmp_path):
+    """write_jsonl -> read_jsonl preserves every summary field of a
+    replacement-stressed per-replica histogram, identically across two
+    identical runs — persisted percentiles are diffable artifacts."""
+    from torchgpipe_tpu.obs.registry import RESERVOIR_SIZE
+
+    def run(path):
+        reg = obs.MetricsRegistry(clock=lambda: 3.0)
+        view = reg.labeled(replica="r0")
+        h = view.histogram("serving_tpot_seconds")
+        for i in range(RESERVOIR_SIZE + 200):
+            h.observe((i * 7919 % 10007) / 10007.0)
+        reg.write_jsonl(path)
+        return reg, obs.read_jsonl(path)
+
+    p1 = os.path.join(tmp_path, "a.jsonl")
+    p2 = os.path.join(tmp_path, "b.jsonl")
+    reg1, rec1 = run(p1)
+    _reg2, rec2 = run(p2)
+    assert rec1 == rec2                      # runs identical end to end
+    (row,) = rec1
+    live = reg1.get("serving_tpot_seconds").summary(replica="r0")
+    assert row["labels"] == {"replica": "r0"}
+    for field in ("count", "sum", "mean", "min", "max",
+                  "p50", "p95", "p99"):
+        assert row[field] == live[field], field

@@ -1,0 +1,370 @@
+"""SLO burn-rate monitoring + request-trace stitching, pinned.
+
+All host-side: a hand-stepped clock drives the whole breach/recovery
+cycle (no sleeps, no engines), and the stitcher is exercised over
+synthetic flight recorders — the fleet-integrated halves (a real
+slowed replica evicted and re-admitted; a die_at_step failover
+stitched across live engines) live in ``tests/test_fleet.py`` against
+the shared trained fixture, and end-to-end in ``tools/slo_verify.py``
+(ci_lint step 12).
+"""
+
+import json
+
+import pytest
+
+from torchgpipe_tpu.obs import (
+    MetricsRegistry,
+    Objective,
+    SloMonitor,
+    format_request_tree,
+    request_chrome_trace,
+    request_ids,
+    stitch_request,
+)
+from torchgpipe_tpu.obs.flightrec import FlightRecorder, dump_from_dict
+
+
+class Clock:
+    """A hand-stepped clock for registry + monitor determinism."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _latency_monitor(reg, **kw):
+    kw.setdefault("short_window", 10.0)
+    kw.setdefault("long_window", 40.0)
+    kw.setdefault("burn_threshold", 2.0)
+    kw.setdefault("min_count", 2)
+    return SloMonitor(
+        reg,
+        [Objective(name="ttft-p95", series="serving_ttft_seconds",
+                   threshold=0.1, target=0.95)],
+        **kw,
+    )
+
+
+# --------------------------------------------------------------------- #
+# objectives + threshold counters                                       #
+# --------------------------------------------------------------------- #
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="threshold"):
+        Objective(name="x", series="s", threshold=0.0)
+    with pytest.raises(ValueError, match="target"):
+        Objective(name="x", series="s", threshold=0.1, target=1.0)
+    with pytest.raises(ValueError, match="total_series"):
+        Objective(name="x", series="s", kind="error_rate", budget=0.1)
+    with pytest.raises(ValueError, match="budget"):
+        Objective(name="x", series="s", kind="error_rate",
+                  total_series="t")
+    with pytest.raises(ValueError, match="kind"):
+        Objective(name="x", series="s", kind="latency_p95")
+
+
+def test_histogram_track_threshold_exact_counts():
+    """Exact over-threshold counting from registration onward, per
+    label set, with a didactic refusal for untracked thresholds."""
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", labels=("replica",))
+    h.observe(9.0, replica="r0")           # BEFORE tracking: not counted
+    h.track_threshold(0.5)
+    h.track_threshold(0.5)                 # idempotent
+    for v in (0.1, 0.6, 0.7, 0.5):         # strictly-above semantics
+        h.observe(v, replica="r0")
+    h.observe(0.9, replica="r1")
+    assert h.count_over(0.5, replica="r0") == 2
+    assert h.count_over(0.5, replica="r1") == 1
+    assert h.count_over(0.5, replica="r9") == 0   # unseen series
+    with pytest.raises(ValueError, match="not tracked"):
+        h.count_over(0.25, replica="r0")
+    # the labeled-view proxy reaches the same counters
+    view = reg.labeled(tenant="a")
+    h2 = view.histogram("lat2")
+    h2.track_threshold(1.0)
+    h2.observe(2.0)
+    assert h2.count_over(1.0) == 1
+
+
+# --------------------------------------------------------------------- #
+# the multi-window burn-rate monitor                                    #
+# --------------------------------------------------------------------- #
+
+
+def test_monitor_quiet_on_healthy_series():
+    clock = Clock()
+    reg = MetricsRegistry(clock=clock)
+    h = reg.histogram("serving_ttft_seconds", labels=("replica",))
+    mon = _latency_monitor(reg)
+    for _ in range(20):
+        clock.advance(1.0)
+        h.observe(0.01, replica="r0")
+        h.observe(0.02, replica="r1")
+        assert mon.tick() == []
+    assert mon.active_alerts() == []
+    assert mon.breaching() == set()
+    assert reg.get("slo_alerts_total").series() == {}
+
+
+def test_monitor_needs_both_windows_and_blames_one_replica():
+    """A short burst of badness trips the SHORT window only (no alert);
+    sustained badness trips both and blames exactly the bad replica.
+    The multi-window rule is the whole point: one spike must not page.
+    """
+    clock = Clock()
+    reg = MetricsRegistry(clock=clock)
+    h = reg.histogram("serving_ttft_seconds", labels=("replica",))
+    mon = _latency_monitor(reg)
+    # 40s of clean history on both replicas.
+    for _ in range(40):
+        clock.advance(1.0)
+        h.observe(0.01, replica="r0")
+        h.observe(0.01, replica="r1")
+        assert mon.tick() == []
+    # 2s of badness on r0: short burn fires, long still clean -> quiet.
+    for _ in range(2):
+        clock.advance(1.0)
+        h.observe(9.0, replica="r0")
+        h.observe(0.01, replica="r1")
+        events = mon.tick()
+        assert events == []
+    burn = reg.get("slo_burn_rate")
+    assert burn.value(objective="ttft-p95", split="r0",
+                      window="short") >= 2.0
+    assert burn.value(objective="ttft-p95", split="r0",
+                      window="long") < 2.0
+    # sustained badness: the long window catches up -> ONE breach, r0.
+    events = []
+    for _ in range(30):
+        clock.advance(1.0)
+        h.observe(9.0, replica="r0")
+        h.observe(0.01, replica="r1")
+        events += mon.tick()
+    assert [
+        (e.objective, e.split, e.kind) for e in events
+    ] == [("ttft-p95", "r0", "breach")]
+    assert mon.breaching() == {"r0"}
+    assert reg.get("slo_alerts_total").value(
+        objective="ttft-p95", split="r0") == 1
+    assert reg.get("slo_alert_active").value(
+        objective="ttft-p95", split="r0") == 1.0
+    # recovery: r0 goes silent (evicted), windows drain -> recovery.
+    events = []
+    for _ in range(45):
+        clock.advance(1.0)
+        h.observe(0.01, replica="r1")
+        events += mon.tick()
+    assert [(e.split, e.kind) for e in events] == [("r0", "recovery")]
+    assert mon.breaching() == set()
+    assert "breach" in events[0].describe() or events[0].describe()
+
+
+def test_monitor_min_count_guard():
+    """One slow request must not page: fewer than min_count events in
+    a window means burn 0."""
+    clock = Clock()
+    reg = MetricsRegistry(clock=clock)
+    h = reg.histogram("serving_ttft_seconds", labels=("replica",))
+    mon = _latency_monitor(reg, min_count=3)
+    clock.advance(1.0)
+    h.observe(9.0, replica="r0")
+    h.observe(9.0, replica="r0")
+    assert mon.tick() == []
+    assert mon.breaching() == set()
+
+
+def test_monitor_error_rate_objective():
+    clock = Clock()
+    reg = MetricsRegistry(clock=clock)
+    bad = reg.counter("serving_retries_by", labels=("replica",))
+    total = reg.counter("serving_steps_by", labels=("replica",))
+    mon = SloMonitor(
+        reg,
+        [Objective(name="retries", kind="error_rate",
+                   series="serving_retries_by",
+                   total_series="serving_steps_by", budget=0.05)],
+        short_window=10.0, long_window=40.0, burn_threshold=2.0,
+        min_count=2,
+    )
+    for _ in range(50):
+        clock.advance(1.0)
+        total.inc(replica="r0")
+        assert mon.tick() == []
+    events = []
+    for _ in range(50):
+        clock.advance(1.0)
+        total.inc(replica="r0")
+        bad.inc(replica="r0")       # 100% failure rate vs 5% budget
+        events += mon.tick()
+    assert [(e.split, e.kind) for e in events] == [("r0", "breach")]
+
+
+def test_monitor_ctor_validation():
+    reg = MetricsRegistry()
+    obj = Objective(name="x", series="s", threshold=0.1)
+    with pytest.raises(ValueError, match="objective"):
+        SloMonitor(reg, [])
+    with pytest.raises(ValueError, match="short"):
+        SloMonitor(reg, [obj], short_window=10.0, long_window=5.0)
+    with pytest.raises(ValueError, match="burn_threshold"):
+        SloMonitor(reg, [obj], burn_threshold=0.0)
+    with pytest.raises(ValueError, match="min_count"):
+        SloMonitor(reg, [obj], min_count=0)
+    with pytest.raises(ValueError, match="duplicate"):
+        SloMonitor(reg, [obj, obj])
+
+
+def test_breaching_filters_by_split_domain():
+    """A per-TENANT breach whose tenant id collides with a replica
+    name must not read as that replica's verdict: the router asks
+    breaching(split_by='replica') and tenant-split objectives are
+    filtered out."""
+    clock = Clock()
+    reg = MetricsRegistry(clock=clock)
+    h = reg.histogram("tenant_ttft_seconds", labels=("tenant",))
+    mon = SloMonitor(
+        reg,
+        [Objective(name="tenant-ttft", series="tenant_ttft_seconds",
+                   threshold=0.1, target=0.95, split_by="tenant")],
+        short_window=10.0, long_window=40.0, burn_threshold=2.0,
+        min_count=2,
+    )
+    for _ in range(50):
+        clock.advance(1.0)
+        h.observe(9.0, tenant="r1")    # tenant literally named "r1"
+        mon.tick()
+    assert mon.breaching() == {"r1"}                    # unfiltered
+    assert mon.breaching(split_by="replica") == set()   # router's view
+    assert mon.breaching(split_by="tenant") == {"r1"}
+
+
+# --------------------------------------------------------------------- #
+# request-trace stitching                                               #
+# --------------------------------------------------------------------- #
+
+
+def _record_attempt(rec, rid, t0, *, finish=True, clock=None):
+    """A canonical engine-side attempt on one recorder: submit, admit,
+    two prefill chunks, a decode group, then finish or preempt."""
+    clock.t = t0
+    rec.record("req_submit", rid=rid, detail="prompt=10 new=5 queued=0")
+    clock.advance(0.001)
+    rec.record("req_admit", rid=rid, dur=0.001, detail="slot=0")
+    clock.advance(0.002)
+    rec.record("req_prefill", rid=rid, dur=0.002, detail="g=8 take=8")
+    clock.advance(0.002)
+    rec.record("req_prefill", rid=rid, dur=0.002, detail="g=8 take=2")
+    clock.advance(0.004)
+    rec.record("req_decode", rid=rid, dur=0.004, detail="steps=4")
+    if finish:
+        rec.record("req_finish", rid=rid,
+                   detail="status=finished tokens=5")
+    else:
+        rec.record("req_preempt", rid=rid, detail="drain emitted=4")
+
+
+def test_stitch_failover_spans_both_replicas(tmp_path):
+    clock = Clock()
+    r0 = FlightRecorder(worker="r0", clock=clock)
+    r1 = FlightRecorder(worker="r1", clock=clock)
+    router = FlightRecorder(worker="router", clock=clock)
+    clock.t = 1.0
+    router.record("route", rid="q1", detail="q1->r0")
+    _record_attempt(r0, "q1", 1.0, finish=False, clock=clock)
+    clock.advance(0.003)
+    router.record("req_move", rid="q1", detail="r0->r1")
+    _record_attempt(r1, "q1", clock.t + 0.001, finish=True, clock=clock)
+    dumps = [dump_from_dict(r.to_dict()) for r in (r0, r1, router)]
+    trace = stitch_request(dumps, "q1")
+    assert trace.replicas == ["r0", "r1"]
+    assert trace.migrations == 1
+    assert trace.orphans == []
+    assert trace.complete
+    names = [s.name for s in trace.root.children]
+    assert "attempt@r0" in names and "attempt@r1" in names
+    assert "migration r0->r1" in names
+    attempt0 = next(s for s in trace.root.children
+                    if s.name == "attempt@r0")
+    assert [c.name for c in attempt0.children] == [
+        "queue", "prefill", "prefill", "decode", "preempt",
+    ]
+    decode = attempt0.children[3]
+    assert decode.dur == pytest.approx(0.004)
+    assert "steps=4" in decode.detail
+    tree = format_request_tree(trace)
+    assert "migration r0->r1" in tree and "INCOMPLETE" not in tree
+    out = tmp_path / "req.json"
+    request_chrome_trace(trace, str(out))
+    payload = json.loads(out.read_text())
+    assert any(e.get("name") == "migration r0->r1"
+               for e in payload["traceEvents"])
+
+
+def test_stitch_applies_clock_offsets():
+    """A replica whose clock runs 100s ahead still stitches in causal
+    order once its dump carries the align_clocks offset."""
+    c0, c1 = Clock(), Clock()
+    r0 = FlightRecorder(worker="r0", clock=c0)
+    r1 = FlightRecorder(worker="r1", clock=c1)
+    _record_attempt(r0, "q1", 1.0, finish=False, clock=c0)
+    # r1's local clock is +100s skewed; its offset maps it back.
+    _record_attempt(r1, "q1", 102.0, finish=True, clock=c1)
+    d0, d1 = (dump_from_dict(r.to_dict()) for r in (r0, r1))
+    d1.clock_offset = -100.0
+    trace = stitch_request([d0, d1], "q1")
+    assert trace.replicas == ["r0", "r1"]     # r0 first, post-alignment
+    assert trace.root.dur < 10.0              # not a 100s-wide trace
+    assert trace.migrations == 1
+
+
+def test_stitch_orphans_and_unknown_rid():
+    clock = Clock()
+    rec = FlightRecorder(worker="r0", clock=clock)
+    clock.t = 1.0
+    rec.record("req_decode", rid="ghost", dur=0.01, detail="steps=3")
+    dumps = [dump_from_dict(rec.to_dict())]
+    trace = stitch_request(dumps, "ghost")
+    assert len(trace.orphans) == 1
+    assert trace.orphans[0].kind == "req_decode"
+    assert not trace.complete
+    assert "ORPHAN" in format_request_tree(trace)
+    with pytest.raises(ValueError, match="no dump mentions"):
+        stitch_request(dumps, "nope")
+    assert request_ids(dumps) == ["ghost"]
+
+
+def test_trace_report_request_cli(tmp_path):
+    """The pure-stdlib CLI face: exit 0 + tree on a clean trace, exit 1
+    on orphans and on an unknown rid."""
+    from tools.trace_report import main as trace_report_main
+
+    clock = Clock()
+    rec = FlightRecorder(worker="r0", clock=clock)
+    _record_attempt(rec, "q1", 1.0, finish=True, clock=clock)
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(rec.to_dict()))
+    chrome = tmp_path / "req_chrome.json"
+    assert trace_report_main(
+        ["--dumps", str(good), "--request", "q1",
+         "--chrome", str(chrome)]
+    ) == 0
+    assert json.loads(chrome.read_text())["traceEvents"]
+    assert trace_report_main(
+        ["--dumps", str(good), "--request", "missing"]
+    ) == 1
+    orphan_rec = FlightRecorder(worker="r1", clock=clock)
+    orphan_rec.record("req_decode", rid="q9", dur=0.01)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(orphan_rec.to_dict()))
+    assert trace_report_main(
+        ["--dumps", str(bad), "--request", "q9"]
+    ) == 1

@@ -78,13 +78,23 @@ differently and must not share backend state):
    prefill with the pool refcount invariants holding under a churn
    grid, and the speculative steady-state program count must be
    statically certified by ``analysis.serving.certify_speculative``
-   (docs/serving.md, fleet section).
+   (docs/serving.md, fleet section);
+12. ``tools/slo_verify.py`` (slo-verify) — the serving observe→act
+   loop: a healthy fleet trace under declared TTFT/TPOT objectives
+   must alert nothing; an injected ``slow_replica_at`` latency fault
+   must trip the multi-window burn-rate alert, degrade exactly the
+   slowed replica out of rotation with its in-flight requests resuming
+   bitwise on the survivor, and re-admit it after its windows drain;
+   and an induced mid-generation replica death must yield ONE stitched
+   request trace spanning both replicas with the migration span
+   explicit and zero orphan spans (docs/observability.md, serving
+   section).
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
 / ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` /
 ``--skip-postmortem`` / ``--skip-sharding`` / ``--skip-pack`` /
-``--skip-replan`` / ``--skip-fleet`` to run a subset, ``-v`` for
-per-target reports.
+``--skip-replan`` / ``--skip-fleet`` / ``--skip-slo`` to run a subset,
+``-v`` for per-target reports.
 """
 
 from __future__ import annotations
@@ -121,6 +131,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-pack", action="store_true")
     ap.add_argument("--skip-replan", action="store_true")
     ap.add_argument("--skip-fleet", action="store_true")
+    ap.add_argument("--skip-slo", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -206,6 +217,11 @@ def main(argv: Sequence[str] | None = None) -> int:
             sys.executable, str(REPO / "tools" / "fleet_verify.py"),
         ]
         failures += _run("fleet-verify", cmd) != 0
+    if not args.skip_slo:
+        cmd = [
+            sys.executable, str(REPO / "tools" / "slo_verify.py"),
+        ]
+        failures += _run("slo-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

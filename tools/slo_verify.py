@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""slo-verify gate: the serving observe→act loop, end to end.
+
+PR 8→12 closed the training loop (measure → reconcile → replan); this
+gate proves the SERVING mirror (docs/observability.md, "serving:
+request tracing + SLOs") on a tiny CPU llama fleet:
+
+1. **A healthy trace alerts nothing** — a 2-replica fleet under the
+   declared TTFT/TPOT objectives serves a burst with zero burn-rate
+   alerts, zero evictions, outputs bitwise vs ``generate``.
+2. **A latency fault trips the loop** — ``faults.inject(
+   slow_replica_at=...)`` makes one replica wall-clock slow; the
+   multi-window burn-rate alert fires for THAT replica only, the
+   router degrades it out of power-of-two-choices rotation, its
+   in-flight requests resume on the survivor BITWISE, and once the
+   fault clears and its windows drain the replica is re-admitted.
+3. **A failover request stitches to ONE trace spanning both
+   replicas** — ``die_at_step`` kills a replica mid-generation; the
+   moved request's flight events (rid-correlated across both
+   replicas' recorders) stitch into a single span tree with the
+   migration span explicit and zero orphans, and
+   ``tools/trace_report.py --dumps ... --request RID`` renders it
+   (exit 0; a rid-less dump set exits 1).
+
+Tiny-model CPU compiles only::
+
+    python tools/slo_verify.py            # exit 0 iff all hold
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+import tempfile
+import time
+from typing import Optional, Sequence
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    del argv
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import json
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchgpipe_tpu import fleet, obs
+    from torchgpipe_tpu.layers import sequential_init
+    from torchgpipe_tpu.models.generation import generate
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig,
+        llama,
+    )
+    from torchgpipe_tpu.obs.flightrec import FlightRecorder, dump_from_dict
+    from torchgpipe_tpu.resilience import faults
+    from torchgpipe_tpu.serving import Engine
+
+    cfg = TransformerConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+    )
+    params, _, _ = sequential_init(
+        llama(cfg), jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((2, 8), jnp.int32),
+    )
+
+    def fail(msg: str) -> int:
+        print(f"[slo-verify] FAIL: {msg}", file=sys.stderr, flush=True)
+        return 1
+
+    def ref(prompt, new):
+        return np.asarray(generate(
+            cfg, params, jnp.asarray(prompt)[None, :], new, max_len=32,
+        ))[0]
+
+    def workload(seed, n):
+        rng = np.random.RandomState(seed)
+        return [
+            (rng.randint(0, 64, (int(rng.randint(6, 12)),))
+             .astype(np.int32), int(rng.randint(3, 6)))
+            for _ in range(n)
+        ]
+
+    # The declared objectives: generous thresholds a healthy CPU step
+    # (~ms) never crosses and the 50ms injected fault always does.
+    def objectives():
+        return [
+            obs.Objective(name="ttft-p95", threshold=0.03, target=0.95,
+                          series="serving_ttft_seconds"),
+            obs.Objective(name="tpot-p95", threshold=0.03, target=0.95,
+                          series="serving_tpot_seconds"),
+        ]
+
+    def build_fleet(*, with_recorders=False):
+        shared = obs.MetricsRegistry()
+        recorders = {
+            n: FlightRecorder(worker=n) for n in ("r0", "r1")
+        } if with_recorders else {}
+        engines = {
+            n: Engine(
+                cfg, params, num_slots=4, max_len=32, prefill_chunk=8,
+                registry=shared.labeled(replica=n),
+                recorder=recorders.get(n),
+            )
+            for n in ("r0", "r1")
+        }
+        # Warm every compiled program BEFORE the monitor attaches: the
+        # exact over-threshold counters start at attach time, so
+        # compile-dominated warmup latencies never count against the
+        # budget — the production shape (arm SLOs after readiness).
+        for eng in engines.values():
+            for i, (p, n) in enumerate(workload(seed=99, n=2)):
+                eng.submit(p, n, rid=f"warm{i}")
+            eng.run()
+        monitor = obs.SloMonitor(
+            shared, objectives(),
+            short_window=0.3, long_window=1.0,
+            burn_threshold=2.0, min_count=2,
+        )
+        router_rec = FlightRecorder(worker="router")
+        router = fleet.Router(
+            engines, registry=shared, seed=1, slo=monitor,
+            recorder=router_rec if with_recorders else None,
+        )
+        return router, monitor, recorders, router_rec
+
+    # ------------------------------------------------------------------ #
+    # 1. healthy trace: no alerts, no evictions, bitwise                 #
+    # ------------------------------------------------------------------ #
+    router, monitor, _, _ = build_fleet()
+    reqs = workload(seed=0, n=8)
+    rids = [router.submit(p, n) for p, n in reqs]
+    for _ in range(4):
+        router.step()
+    router.run()
+    if monitor.active_alerts():
+        return fail(
+            f"healthy trace raised alerts: {monitor.active_alerts()}"
+        )
+    alerts = router.registry.get("slo_alerts_total")
+    if alerts is not None and any(alerts.series().values()):
+        return fail("healthy trace incremented slo_alerts_total")
+    if any(rep.degraded for rep in router.replicas.values()):
+        return fail("healthy trace degraded a replica")
+    for rid, (p, n) in zip(rids, reqs):
+        if not np.array_equal(router.result(rid), ref(p, n)):
+            return fail(f"healthy stream {rid} diverged")
+
+    # ------------------------------------------------------------------ #
+    # 2. latency fault -> alert -> evict -> bitwise resume -> readmit    #
+    # ------------------------------------------------------------------ #
+    router, monitor, _, _ = build_fleet()
+    # pin the faulted burst to r0 (replica index 0 = slow_replica_at 0)
+    router._sessions["sick"] = "r0"
+    reqs = workload(seed=1, n=5)
+    with faults.inject(slow_replica_at=(0, 0.05)):
+        rids = [router.submit(p, n, session="sick") for p, n in reqs]
+        if router.run() != "idle":
+            return fail("faulted fleet did not drain to idle")
+    if not router.replicas["r0"].degraded:
+        return fail(
+            "the slowed replica was not degraded (burn-rate alert "
+            f"never tripped; alerts={monitor.active_alerts()})"
+        )
+    if router.replicas["r1"].degraded:
+        return fail("the HEALTHY survivor was degraded too")
+    if router._c_slo_evicted.value(replica="r0") != 1:
+        return fail("fleet_slo_evictions{replica=r0} != 1")
+    for rid, (p, n) in zip(rids, reqs):
+        got, want = router.result(rid), ref(p, n)
+        if not np.array_equal(got, want):
+            return fail(
+                f"evicted-replica stream {rid} diverged after the "
+                f"move: got {got.tolist()} want {want.tolist()}"
+            )
+    # Fault gone: keep ticking; r0's windows drain and it re-admits.
+    deadline = time.monotonic() + 10.0
+    while router.replicas["r0"].degraded:
+        if time.monotonic() > deadline:
+            return fail("degraded replica was never re-admitted after "
+                        "its windows drained")
+        router.step()
+        time.sleep(0.05)
+    if router._c_slo_readmitted.value(replica="r0") != 1:
+        return fail("fleet_slo_readmissions{replica=r0} != 1")
+    # and it actually serves again
+    p, n = workload(seed=2, n=1)[0]
+    router._sessions["back"] = "r0"
+    rid = router.submit(p, n, session="back")
+    router.run()
+    if not np.array_equal(router.result(rid), ref(p, n)):
+        return fail("re-admitted replica served a diverged stream")
+
+    # ------------------------------------------------------------------ #
+    # 3. failover -> ONE stitched trace spanning both replicas           #
+    # ------------------------------------------------------------------ #
+    router, monitor, recorders, router_rec = build_fleet(
+        with_recorders=True
+    )
+    reqs = workload(seed=3, n=6)
+    with faults.inject(die_at_step=(0, 3)):
+        rids = [router.submit(p, n) for p, n in reqs]
+        router.run()
+    if router._c_failovers.value() != 1:
+        return fail("die_at_step did not kill replica r0")
+    for rid, (p, n) in zip(rids, reqs):
+        if not np.array_equal(router.result(rid), ref(p, n)):
+            return fail(f"failover stream {rid} diverged")
+    moved = [r for r in rids if router._records[r].moves > 0]
+    if not moved:
+        return fail("failover moved no in-flight request")
+    dumps = [
+        dump_from_dict(rec.to_dict())
+        for rec in (*recorders.values(), router_rec)
+    ]
+    trace = obs.stitch_request(dumps, moved[0])
+    if sorted(trace.replicas) != ["r0", "r1"]:
+        return fail(
+            f"stitched trace for {moved[0]} does not span both "
+            f"replicas: {trace.replicas}"
+        )
+    if trace.migrations != 1:
+        return fail(
+            f"expected exactly one explicit migration span, got "
+            f"{trace.migrations}"
+        )
+    if trace.orphans:
+        return fail(f"stitched trace has orphans: {trace.orphans}")
+    if not trace.complete:
+        return fail("stitched trace never reached req_finish")
+    tree = obs.format_request_tree(trace)
+    for needle in ("migration r0->r1", "attempt@r0", "attempt@r1",
+                   "finish"):
+        if needle not in tree:
+            return fail(f"span tree is missing {needle!r}:\n{tree}")
+    # The CLI face over the same dumps (the pure-stdlib path).
+    from tools.trace_report import main as trace_report_main
+
+    with tempfile.TemporaryDirectory() as td:
+        paths = []
+        for i, d in enumerate((*recorders.values(), router_rec)):
+            path = str(pathlib.Path(td) / f"replica{i}.json")
+            with open(path, "w") as f:
+                json.dump(d.to_dict(), f)
+            paths.append(path)
+        if trace_report_main(["--dumps", *paths,
+                              "--request", moved[0]]) != 0:
+            return fail("trace_report --request exited non-zero on a "
+                        "clean stitched trace")
+        if trace_report_main(["--dumps", *paths,
+                              "--request", "no-such-rid"]) == 0:
+            return fail("trace_report --request exited 0 for an "
+                        "unknown rid")
+
+    print(
+        f"[slo-verify] OK: healthy trace quiet; latency fault tripped "
+        f"the burn-rate alert, evicted r0, resumed bitwise on the "
+        f"survivor and re-admitted after recovery; failover request "
+        f"{moved[0]} stitched to ONE trace spanning {trace.replicas} "
+        f"with {trace.migrations} explicit migration span",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,6 +12,7 @@ graph (:func:`torchgpipe_tpu.obs.reconcile`)::
     python tools/trace_report.py --reconcile          # drift gate
     python tools/trace_report.py --cost-model cm.json # persist profile
     python tools/trace_report.py --dumps rank*.json --chrome merged.json
+    python tools/trace_report.py --dumps r*.json --request q7  # span tree
 
 ``--cost-model OUT.json`` distills the measured reconciliation into a
 persistent :class:`torchgpipe_tpu.obs.costmodel.CostModel` (per-cell
@@ -27,6 +28,18 @@ dumps (:mod:`torchgpipe_tpu.obs.flightrec`) merge into one Perfetto
 trace — one process (pid) per rank, clock-aligned timestamps — the
 cross-rank timeline a hung distributed run leaves behind
 (``tools/postmortem.py`` names the blocking edge over the same dumps).
+
+``--dumps ... --request RID`` prints ONE request's stitched span tree
+(:mod:`torchgpipe_tpu.obs.reqtrace`): routing, queue wait, prefix-cache
+copy, every prefill chunk, coalesced decode groups, speculative rounds
+with accepted counts, and — after a failover — the explicit migration
+span between replica attempts, clock-aligned across the replicas'
+dumps.  Exits non-zero on an ORPHAN span (a rid-keyed event no
+``req_submit`` parents: a rotated ring or a broken correlation chain —
+a tree with silent holes must not read as healthy); ``--chrome OUT``
+additionally writes the per-request Perfetto trace.  Like the chrome
+merge, this path is pure-stdlib — no jax required to read what a dead
+fleet left behind.
 
 ``--reconcile`` exits non-zero when the measured run drifts from the
 prediction: span coverage below ``--min-coverage`` (default 0.95 — at
@@ -131,36 +144,73 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="merge these per-rank flight-recorder dumps "
                          "into the --chrome trace instead of running "
                          "the tiny model")
+    ap.add_argument("--request", metavar="RID",
+                    help="with --dumps: print this request's stitched "
+                         "cross-replica span tree (exit 1 on orphan "
+                         "spans); --chrome then writes the per-request "
+                         "Perfetto trace")
     args = ap.parse_args(argv)
+
+    if args.request and not args.dumps:
+        ap.error("--request needs --dumps (per-replica flight dumps)")
 
     if args.dumps:
         # Pure-stdlib path: flight dumps need no model, no jax — so
-        # flightrec.py is loaded STANDALONE (its own imports are all
-        # stdlib); going through the torchgpipe_tpu package __init__
-        # would drag jax in, and the natural place to inspect dumps a
-        # dead cluster left behind may not have it installed.
+        # flightrec.py (and the request stitcher, reqtrace.py) is
+        # loaded STANDALONE (their own imports are all stdlib); going
+        # through the torchgpipe_tpu package __init__ would drag jax
+        # in, and the natural place to inspect dumps a dead cluster
+        # left behind may not have it installed.
         import importlib.util
 
-        spec = importlib.util.spec_from_file_location(
-            "_flightrec_standalone",
-            REPO / "torchgpipe_tpu" / "obs" / "flightrec.py",
-        )
-        assert spec is not None and spec.loader is not None
-        flightrec = sys.modules.get(spec.name)
-        if flightrec is None:
-            flightrec = importlib.util.module_from_spec(spec)
-            # Registered BEFORE exec: dataclasses resolves the module's
-            # stringified annotations through sys.modules[__module__].
-            sys.modules[spec.name] = flightrec
-            spec.loader.exec_module(flightrec)
+        def load_standalone(alias: str, filename: str) -> Any:
+            spec = importlib.util.spec_from_file_location(
+                alias, REPO / "torchgpipe_tpu" / "obs" / filename,
+            )
+            assert spec is not None and spec.loader is not None
+            mod = sys.modules.get(spec.name)
+            if mod is None:
+                mod = importlib.util.module_from_spec(spec)
+                # Registered BEFORE exec: dataclasses resolves the
+                # module's stringified annotations through
+                # sys.modules[__module__].
+                sys.modules[spec.name] = mod
+                spec.loader.exec_module(mod)
+            return mod
+
+        flightrec = load_standalone("_flightrec_standalone",
+                                    "flightrec.py")
         load_dump = flightrec.load_dump
         merged_chrome_trace = flightrec.merged_chrome_trace
 
-        if not args.chrome and not args.cost_model:
-            ap.error("--dumps needs --chrome OUT.json and/or "
-                     "--cost-model OUT.json")
+        if not args.chrome and not args.cost_model and not args.request:
+            ap.error("--dumps needs --chrome OUT.json, --cost-model "
+                     "OUT.json and/or --request RID")
         loaded = [load_dump(p) for p in args.dumps]
-        if args.chrome:
+        rc = 0
+        if args.request:
+            reqtrace = load_standalone("_reqtrace_standalone",
+                                       "reqtrace.py")
+            try:
+                trace = reqtrace.stitch_request(loaded, args.request)
+            except ValueError as err:
+                print(f"[trace-report] {err}", file=sys.stderr,
+                      flush=True)
+                return 1
+            print(reqtrace.format_request_tree(trace), flush=True)
+            if args.chrome:
+                reqtrace.request_chrome_trace(trace, args.chrome)
+                print(f"request chrome trace: {args.chrome} "
+                      "(open in ui.perfetto.dev)", flush=True)
+            if trace.orphans:
+                print(
+                    f"[trace-report] {len(trace.orphans)} orphan "
+                    "span(s): the rid correlation chain is broken "
+                    "(rotated ring or unthreaded rid)",
+                    file=sys.stderr, flush=True,
+                )
+                rc = 1
+        elif args.chrome:
             merged_chrome_trace(loaded, args.chrome)
             # Transport-only recorders may carry no rank; keep file order.
             ranks = [d.rank for d in loaded]
@@ -179,7 +229,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             cm.save(args.cost_model)
             print(f"cost model: {args.cost_model}", flush=True)
             print(cm.describe(), flush=True)
-        return 0
+        return rc
 
     import jax
 

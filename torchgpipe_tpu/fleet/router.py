@@ -41,6 +41,7 @@ named edge in the dump, not a mystery.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -85,16 +86,24 @@ class RouterRecord:
 
 @dataclasses.dataclass
 class Replica:
-    """One engine behind the router."""
+    """One engine behind the router.
+
+    ``degraded`` is the SLO layer's verdict (:mod:`torchgpipe_tpu.obs.
+    slo`): the replica is alive and could serve, but its burn-rate
+    alert is (or recently was) firing, so it is held out of
+    power-of-two-choices rotation until its windows come back clean —
+    the serving mirror of ``ReplanOnDrift`` acting on measured drift.
+    """
 
     name: str
     engine: Engine
     alive: bool = True
     draining: bool = False
+    degraded: bool = False
 
     @property
     def in_rotation(self) -> bool:
-        return self.alive and not self.draining
+        return self.alive and not self.draining and not self.degraded
 
 
 class Router:
@@ -117,6 +126,9 @@ class Router:
         seed: int = 0,
         session_affinity: bool = True,
         recorder: Optional[Any] = None,
+        slo: Optional[Any] = None,
+        slo_min_in_rotation: int = 1,
+        slo_cooldown_s: float = 0.0,
     ) -> None:
         if not replicas:
             raise ValueError("a router needs at least one replica")
@@ -164,14 +176,44 @@ class Router:
         self._c_moved = registry.counter(
             "fleet_moved_requests",
             help="in-flight requests resumed on another replica")
+        # SLO observe->act wiring (obs.slo.SloMonitor): the router
+        # ticks the monitor once per step() and acts on its verdicts —
+        # a breaching replica is degraded out of rotation (in-flight
+        # requests drained onto survivors), a clean one re-admitted
+        # after the cooldown.  ``slo_min_in_rotation`` is the brake:
+        # the SLO layer may never evict the last healthy replica
+        # (degrading the whole fleet to protect latency serves nobody).
+        self.slo = slo
+        self.slo_min_in_rotation = int(slo_min_in_rotation)
+        self.slo_cooldown_s = float(slo_cooldown_s)
+        self._degraded_at: Dict[str, float] = {}
+        self._clock: Callable[[], float] = getattr(
+            registry, "clock", time.monotonic
+        )
+        self._c_slo_evicted = registry.counter(
+            "fleet_slo_evictions",
+            help="replicas degraded out of rotation by a burn-rate "
+                 "alert", labels=("replica",),
+        )
+        self._c_slo_readmitted = registry.counter(
+            "fleet_slo_readmissions",
+            help="degraded replicas re-admitted after recovery",
+            labels=("replica",),
+        )
+        self._g_degraded = registry.gauge(
+            "fleet_degraded",
+            help="1 while a replica is held out of rotation by the "
+                 "SLO layer", labels=("replica",),
+        )
 
     # ------------------------------------------------------------------ #
     # placement                                                          #
     # ------------------------------------------------------------------ #
 
-    def _record_event(self, kind: str, detail: str = "") -> None:
+    def _record_event(self, kind: str, detail: str = "",
+                      rid: Optional[str] = None) -> None:
         if self.recorder is not None:
-            self.recorder.record(kind, detail=detail)
+            self.recorder.record(kind, detail=detail, rid=rid)
 
     def _update_load_gauges(self) -> None:
         for rep in self.replicas.values():
@@ -295,6 +337,7 @@ class Router:
                     self._record_event(
                         "callback_error",
                         detail=f"{rid}: {exc!r} — streaming stopped",
+                        rid=rid,
                     )
 
         self.replicas[name].engine.submit(
@@ -305,7 +348,7 @@ class Router:
         )
         self._c_routed.inc(replica=name)
         self._record_event(
-            "route", detail=f"{record.rid}->{name}"
+            "route", detail=f"{record.rid}->{name}", rid=record.rid
         )
 
     def result(self, rid: str) -> np.ndarray:
@@ -349,6 +392,14 @@ class Router:
                     index, self._replica_steps[rep.name]
                 ):
                     raise ReplicaDied(rep.name, "fault injection")
+                # The serving latency fault (slow_replica_at): sleep
+                # BEFORE the engine step so every token this replica
+                # emits is wall-clock late — the deterministic
+                # straggler the SLO burn-rate gate drives.  Host-side
+                # only; never touches a traced value.
+                delay = faults.replica_delay_s(index)
+                if delay > 0.0:
+                    time.sleep(delay)
                 if rep.engine._preempted():
                     # The replica's own drain request (SIGTERM via its
                     # PreemptionHandler, or request_drain()) — honored
@@ -368,6 +419,7 @@ class Router:
                 # surfaced by its engine step" contract).
                 self.failover(rep.name, death)
                 did = True
+        self._slo_tick()
         return did
 
     def reset_replica_steps(self) -> None:
@@ -472,6 +524,7 @@ class Router:
                 )
                 if pinned is None or not pinned.in_rotation:
                     self._sessions.pop(record.session, None)
+            source = record.replica
             target = self.pick_replica(record.session)
             self._submit_to(
                 target, record, kw["prompt"], kw["max_new_tokens"],
@@ -479,6 +532,9 @@ class Router:
             )
             record.moves += 1
             self._c_moved.inc()
+            self._record_event(
+                "req_move", detail=f"{source}->{target}", rid=rid
+            )
 
     def failover(self, name: str,
                  error: Optional[BaseException] = None) -> List[str]:
@@ -546,6 +602,98 @@ class Router:
         kwargs = Engine.restore_requests(snapshot)
         self._resubmit(kwargs)
         return [kw["rid"] for kw in kwargs]
+
+    # ------------------------------------------------------------------ #
+    # SLO observe -> act                                                 #
+    # ------------------------------------------------------------------ #
+
+    def degrade(self, name: str, reason: str = "slo breach") -> List[str]:
+        """Take a BREACHING replica out of rotation without killing it:
+        mark it degraded, drain it cooperatively, and resume its
+        in-flight requests on the survivors (the exact failover path —
+        greedy streams stay bitwise).  Recorded on the registry
+        (``fleet_slo_evictions``/``fleet_degraded``) and the flight
+        recorder (``slo_evict``); :meth:`readmit` is the inverse."""
+        rep = self.replicas[name]
+        if rep.degraded:
+            return []
+        rep.degraded = True
+        self._degraded_at[name] = self._clock()
+        self._c_slo_evicted.inc(replica=name)
+        self._g_degraded.set(1.0, replica=name)
+        pending = self._unfinished_on(name)
+        self._record_event(
+            "slo_evict",
+            detail=f"{name}: {reason} ({len(pending)} in-flight moved)",
+        )
+        self._router_drains.add(name)
+        try:
+            snapshot = rep.engine.drain()
+        except Exception:  # noqa: BLE001 — a replica too broken to
+            snapshot = None  # drain falls back to the router's records
+        finally:
+            self._router_drains.discard(name)
+        if snapshot is None or set(snapshot["requests"]) != set(pending):
+            snapshot = self._router_snapshot(pending)
+        kwargs = Engine.restore_requests(snapshot)
+        try:
+            self._resubmit(kwargs)
+        except ReplicaDied:
+            # No survivor (the min-in-rotation brake should prevent
+            # this, but a concurrent death can race it): the requests
+            # stay recorded, same contract as failover.
+            self._record_event(
+                "slo_evict",
+                detail=f"{name}: no survivor to resume on",
+            )
+            kwargs = []
+        return [kw["rid"] for kw in kwargs]
+
+    def readmit(self, name: str) -> None:
+        """Return a recovered degraded replica to rotation: its windows
+        came back clean, so it may serve again (its compiled programs
+        and pool are intact — :meth:`Engine.resume_serving` just
+        re-opens admissions)."""
+        rep = self.replicas[name]
+        if not rep.degraded:
+            return
+        rep.degraded = False
+        self._degraded_at.pop(name, None)
+        rep.engine.resume_serving()
+        self._c_slo_readmitted.inc(replica=name)
+        self._g_degraded.set(0.0, replica=name)
+        self._record_event("slo_readmit", detail=name)
+
+    def _slo_tick(self) -> None:
+        """One SLO evaluation + act pass (end of every :meth:`step`).
+        Breaching replicas degrade (never below ``slo_min_in_rotation``
+        healthy ones); degraded replicas whose alerts cleared re-admit
+        after the cooldown."""
+        if self.slo is None:
+            return
+        self.slo.tick()
+        # Only replica-split objectives may drive eviction: a tenant-
+        # split breach whose tenant id collides with a replica name
+        # must not read as that replica's verdict.
+        breaching = self.slo.breaching(split_by="replica")
+        now = self._clock()
+        for name, rep in self.replicas.items():
+            if rep.degraded and rep.alive and name not in breaching:
+                since = now - self._degraded_at.get(name, now)
+                if since >= self.slo_cooldown_s:
+                    self.readmit(name)
+            elif rep.in_rotation and name in breaching:
+                in_rotation = sum(
+                    1 for r in self.replicas.values() if r.in_rotation
+                )
+                if in_rotation <= self.slo_min_in_rotation:
+                    self._record_event(
+                        "slo_evict_skipped",
+                        detail=f"{name}: breaching but only "
+                               f"{in_rotation} replica(s) in rotation",
+                    )
+                    continue
+                self.degrade(name)
 
 
 __all__ = ["Replica", "ReplicaDied", "Router", "RouterRecord"]

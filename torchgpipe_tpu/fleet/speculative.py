@@ -276,6 +276,7 @@ class SpeculativeEngine(Engine):
         reqs = self.scheduler.decode_ready()
         S = self.pool.num_slots
         gamma = self.gamma
+        t_round = self._rec_clock()
 
         # Phase A1 — draft catch-up: feed each row the accepted tokens
         # the draft has not consumed yet, INCLUDING the current last
@@ -353,6 +354,15 @@ class SpeculativeEngine(Engine):
                 n += 1
             emitted = [int(t) for t in proposals[s, :n]] + [int(target[n])]
             self._c_accepted.inc(n)
+            # One rid-keyed span per speculative round: draft catch-up
+            # + proposals + the chunked verify, with the acceptance
+            # count — the request-trace twin of the round counters.
+            if self.recorder is not None:
+                self._rec(
+                    "req_spec_round", r.rid,
+                    dur=max(self._rec_clock() - t_round, 0.0),
+                    detail=f"proposed={gamma} accepted={n}",
+                )
             # Frontiers BEFORE emission (emission may free the slot):
             # target keeps [.., cur_tok, d1..dn]; rejected rows above
             # the frontier are dead by masking.  The draft consumed

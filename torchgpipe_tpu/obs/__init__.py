@@ -58,6 +58,15 @@ from torchgpipe_tpu.obs.registry import (
     read_jsonl,
 )
 from torchgpipe_tpu.obs.reporter import StepReporter, measured_step_flops
+from torchgpipe_tpu.obs.reqtrace import (
+    RequestTrace,
+    Span,
+    format_request_tree,
+    request_chrome_trace,
+    request_ids,
+    stitch_request,
+)
+from torchgpipe_tpu.obs.slo import Objective, SloEvent, SloMonitor
 from torchgpipe_tpu.utils.tracing import Timeline, device_trace
 
 # The reconciliation and postmortem halves pull in the whole analysis
@@ -117,12 +126,17 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "Objective",
     "PostmortemReport",
     "RankDump",
     "ReconcileReport",
     "ReplanEvent",
     "ReplanOnDrift",
     "ReplanResult",
+    "RequestTrace",
+    "SloEvent",
+    "SloMonitor",
+    "Span",
     "StallWatchdog",
     "StepReporter",
     "Timeline",
@@ -131,11 +145,15 @@ __all__ = [
     "check_stale_cost_model",
     "config_fingerprint",
     "device_trace",
+    "format_request_tree",
     "load_dump",
     "measured_step_flops",
     "merged_chrome_trace",
     "overlay_chrome_trace",
     "read_jsonl",
     "reconcile",
+    "request_chrome_trace",
+    "request_ids",
+    "stitch_request",
     "uniform_cost",
 ]

@@ -79,7 +79,12 @@ class FlightEvent:
     0's timeline.  ``channel`` is the transport mailbox key ``(kind,
     index)`` for comm events; ``stage``/``mb`` identify compute cells
     (the event-graph node vocabulary); ``dur`` is a measured duration in
-    seconds where one exists (cell compute, receive wait)."""
+    seconds where one exists (cell compute, receive wait).  ``rid`` is
+    the REQUEST correlation key serving-side events carry (``req_*``
+    spans from the engine, ``route``/``req_move`` from the fleet
+    router): every event of one request shares one rid across however
+    many replicas served it, which is what
+    :mod:`torchgpipe_tpu.obs.reqtrace` stitches on."""
 
     seq: int
     t: float
@@ -90,13 +95,14 @@ class FlightEvent:
     mb: Optional[int] = None
     dur: Optional[float] = None
     detail: str = ""
+    rid: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"seq": self.seq, "t": self.t,
                                "kind": self.kind}
         if self.channel is not None:
             out["channel"] = _jsonable(list(self.channel))
-        for k in ("peer", "stage", "mb", "dur"):
+        for k in ("peer", "stage", "mb", "dur", "rid"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v
@@ -117,6 +123,7 @@ class FlightEvent:
             seq=int(d["seq"]), t=float(d["t"]), kind=str(d["kind"]),
             channel=ch, peer=d.get("peer"), stage=d.get("stage"),
             mb=d.get("mb"), dur=d.get("dur"), detail=d.get("detail", ""),
+            rid=d.get("rid"),
         )
 
 
@@ -168,12 +175,13 @@ class FlightRecorder:
         mb: Optional[int] = None,
         dur: Optional[float] = None,
         detail: str = "",
+        rid: Optional[str] = None,
         activity: bool = True,
     ) -> FlightEvent:
         now = self.clock()
         with self._lock:
             ev = FlightEvent(self._seq, now, kind, channel, peer, stage,
-                             mb, dur, detail)
+                             mb, dur, detail, rid)
             self._seq += 1
             self._ring.append(ev)
             if activity:
@@ -447,9 +455,13 @@ def align_clocks(
 # Events rendered as duration slices (they carry ``dur``: cell
 # completions, and recv_match whose dur is the measured WAIT, so the
 # slice shows the blocked interval ending at the match); everything
-# else becomes a thread-scoped instant tick.
+# else becomes a thread-scoped instant tick.  Serving-side request
+# events (kind ``req_*``, carrying a ``rid``) get their own
+# ``requests`` thread row — slices when they carry a dur (prefill
+# chunks, decode groups, speculative rounds), instants otherwise.
 _SLICE_KINDS = ("fwd", "bwd", "recv_match")
 _COMPUTE_KINDS = ("fwd", "bwd")
+_REQUEST_PREFIX = "req_"
 
 
 def merged_chrome_trace(
@@ -484,6 +496,8 @@ def merged_chrome_trace(
                       "tid": 0, "args": {"name": "compute"}})
         trace.append({"name": "thread_name", "ph": "M", "pid": pid,
                       "tid": 1, "args": {"name": "comm"}})
+        trace.append({"name": "thread_name", "ph": "M", "pid": pid,
+                      "tid": 2, "args": {"name": "requests"}})
         for e in d.events:
             ts = (d.aligned(e.t) - t_zero) * 1e6
             args: Dict[str, Any] = {"kind": e.kind, "seq": e.seq}
@@ -495,8 +509,26 @@ def merged_chrome_trace(
                 args["channel"] = repr(e.channel)
             if e.peer is not None:
                 args["peer"] = e.peer
+            if e.rid is not None:
+                args["rid"] = e.rid
             if e.detail:
                 args["detail"] = e.detail
+            if e.kind.startswith(_REQUEST_PREFIX):
+                label = (f"{e.kind}({e.rid})" if e.rid is not None
+                         else e.kind)
+                if e.dur is not None:
+                    trace.append({
+                        "name": label, "ph": "X", "pid": pid, "tid": 2,
+                        "ts": ts - e.dur * 1e6,
+                        "dur": max(e.dur * 1e6, 0.01),
+                        "args": args,
+                    })
+                else:
+                    trace.append({
+                        "name": label, "ph": "i", "s": "t", "pid": pid,
+                        "tid": 2, "ts": ts, "args": args,
+                    })
+                continue
             if e.kind in _SLICE_KINDS and e.dur is not None:
                 label = (
                     f"{e.kind}(s{e.stage},mb{e.mb})"

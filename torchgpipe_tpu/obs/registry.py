@@ -111,7 +111,8 @@ class _Reservoir:
     """Exact count/sum/min/max plus a bounded uniform sample of the
     observations (algorithm R, deterministic seed) for percentiles."""
 
-    def __init__(self, capacity: int = RESERVOIR_SIZE) -> None:
+    def __init__(self, capacity: int = RESERVOIR_SIZE,
+                 thresholds: Sequence[float] = ()) -> None:
         self.count = 0
         self.total = 0.0
         self.vmin: Optional[float] = None
@@ -119,11 +120,19 @@ class _Reservoir:
         self.capacity = capacity
         self.sample: List[float] = []
         self._rng = random.Random(0x0B5)
+        # EXACT over-threshold counts (one compare per observation per
+        # tracked threshold) — the SLO layer's "bad event" tallies,
+        # which a bounded reservoir cannot reconstruct.  Keys are the
+        # thresholds registered via Histogram.track_threshold.
+        self.over: Dict[float, int] = {float(t): 0 for t in thresholds}
 
     def observe(self, value: float) -> None:
         v = float(value)
         self.count += 1
         self.total += v
+        for t in self.over:
+            if v > t:
+                self.over[t] += 1
         self.vmin = v if self.vmin is None else min(self.vmin, v)
         self.vmax = v if self.vmax is None else max(self.vmax, v)
         if len(self.sample) < self.capacity:
@@ -158,12 +167,15 @@ class Histogram(_Metric):
         super().__init__(name, help, label_names, lock)
         self._capacity = capacity
         self._series: Dict[LabelValues, _Reservoir] = {}
+        self._thresholds: List[float] = []
 
     def _res(self, labels: Dict[str, Any]) -> _Reservoir:
         key = self._key(labels)
         res = self._series.get(key)
         if res is None:
-            res = self._series[key] = _Reservoir(self._capacity)
+            res = self._series[key] = _Reservoir(
+                self._capacity, self._thresholds
+            )
         return res
 
     # Read paths use a THROWAWAY empty reservoir for unseen label sets
@@ -177,6 +189,36 @@ class Histogram(_Metric):
     def observe(self, value: float, **labels: Any) -> None:
         with self._lock:
             self._res(labels).observe(value)
+
+    def track_threshold(self, threshold: float) -> None:
+        """Start EXACT over-threshold counting for every series of this
+        histogram (one compare per observation).  Only observations
+        AFTER registration count — attach the SLO monitor before
+        traffic, not after — and registration is idempotent.  The
+        bounded reservoir cannot answer "how many observations exceeded
+        t" exactly; this can, which is what windowed burn rates need
+        (:mod:`torchgpipe_tpu.obs.slo`)."""
+        t = float(threshold)
+        with self._lock:
+            if t not in self._thresholds:
+                self._thresholds.append(t)
+                for res in self._series.values():
+                    res.over.setdefault(t, 0)
+
+    def count_over(self, threshold: float, **labels: Any) -> int:
+        """Observations strictly above a TRACKED threshold for one
+        series (0 for an unseen series).  Raises didactically for a
+        threshold :meth:`track_threshold` never registered — silently
+        returning 0 would read as a perfect SLI."""
+        t = float(threshold)
+        with self._lock:
+            if t not in self._thresholds:
+                raise ValueError(
+                    f"threshold {t!r} is not tracked on {self.name!r} — "
+                    "call track_threshold(threshold) before the "
+                    "observations you want counted"
+                )
+            return self._peek(labels).over.get(t, 0)
 
     def count(self, **labels: Any) -> int:
         with self._lock:
@@ -258,6 +300,14 @@ class BoundGauge(BoundCounter):
 class BoundHistogram(_BoundMetric):
     def observe(self, value: float, **labels: Any) -> None:
         self.metric.observe(value, **self._merge(labels))  # type: ignore[union-attr]
+
+    def track_threshold(self, threshold: float) -> None:
+        self.metric.track_threshold(threshold)  # type: ignore[union-attr]
+
+    def count_over(self, threshold: float, **labels: Any) -> int:
+        return self.metric.count_over(  # type: ignore[union-attr]
+            threshold, **self._merge(labels)
+        )
 
     def count(self, **labels: Any) -> int:
         return self.metric.count(**self._merge(labels))  # type: ignore[union-attr]

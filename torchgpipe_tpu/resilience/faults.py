@@ -66,6 +66,15 @@ class FaultPlan:
     # ``slow_at`` it is host-side only: traces nothing, never tokens
     # the compiled-program caches (:func:`plan_token` stays None).
     die_at_step: Optional[Tuple[int, int]] = None
+    # Slow one serving-fleet replica by (replica, extra_seconds) per
+    # engine step — ``slow_at``'s serving twin: the fleet router sleeps
+    # ``extra_seconds`` BEFORE each of that replica's engine steps, so
+    # every token it emits is wall-clock late and the per-replica
+    # TTFT/TPOT histograms genuinely degrade — the deterministic
+    # latency fault the SLO burn-rate gate (``tools/slo_verify.py``)
+    # drives.  Host-side only: traces nothing, never tokens the
+    # compiled-program caches (:func:`plan_token` stays None).
+    slow_replica_at: Optional[Tuple[int, float]] = None
 
 
 _lock = threading.Lock()
@@ -83,6 +92,7 @@ def inject(
     preempt_at_step: Optional[int] = None,
     slow_at: Optional[Tuple[int, float]] = None,
     die_at_step: Optional[Tuple[int, int]] = None,
+    slow_replica_at: Optional[Tuple[int, float]] = None,
 ) -> Iterator[FaultPlan]:
     """Activate a :class:`FaultPlan` for the enclosed block.
 
@@ -91,7 +101,8 @@ def inject(
     """
     global _active, _epoch
     plan = FaultPlan(nan_at=nan_at, preempt_at_step=preempt_at_step,
-                     slow_at=slow_at, die_at_step=die_at_step)
+                     slow_at=slow_at, die_at_step=die_at_step,
+                     slow_replica_at=slow_replica_at)
     with _lock:
         if _active is not None:
             raise RuntimeError(
@@ -189,6 +200,24 @@ def should_die(replica: int, step: int) -> bool:
         and plan.die_at_step[0] == replica
         and step >= plan.die_at_step[1]
     )
+
+
+def replica_delay_s(replica: int) -> float:
+    """Extra per-step seconds the active plan injects into serving
+    replica ``replica`` (0.0 without a matching ``slow_replica_at``
+    plan).  The fleet router sleeps this long BEFORE each of that
+    replica's engine steps, so every token it emits is wall-clock late
+    — the deterministic latency fault the SLO burn-rate monitor acts
+    on.  Like ``die_at_step`` it is host-side only and never tokens the
+    compiled-program caches (:func:`plan_token` stays None)."""
+    plan = _active
+    if (
+        plan is None
+        or plan.slow_replica_at is None
+        or plan.slow_replica_at[0] != replica
+    ):
+        return 0.0
+    return float(plan.slow_replica_at[1])
 
 
 def should_preempt(step: int) -> bool:

@@ -127,6 +127,7 @@ class Engine:
         metrics: Optional[ServingMetrics] = None,
         registry: Optional[Any] = None,
         reporter: Optional[Any] = None,
+        recorder: Optional[Any] = None,
         clock: Callable[[], float] = time.monotonic,
         preemption: Optional[Any] = None,
         checkpoint_manager: Optional[Any] = None,
@@ -194,6 +195,19 @@ class Engine:
             clock=clock, registry=registry
         )
         self.reporter = reporter
+        # ``recorder`` (obs.FlightRecorder) threads a per-request span
+        # record through the serving loop: submit/admit, the prefix-
+        # cache copy, each prefill chunk, coalesced decode-step groups,
+        # and finish/preemption — every event carrying ``rid=`` as the
+        # correlation key ``obs.reqtrace.stitch_request`` rebuilds a
+        # request's cross-replica span tree from.  Pure host-side ring
+        # appends: trace-inert (never a traced value, never a program-
+        # cache token) and zero-cost when None.
+        self.recorder = recorder
+        # Per-request coalescing of decode steps: one ``req_decode``
+        # flight event per GROUP (flushed at finish/preempt), not one
+        # per token — a 4096-event ring must hold whole requests.
+        self._decode_groups: Dict[str, List[float]] = {}
         # Radix prefix-sharing KV cache (torchgpipe_tpu.fleet.
         # prefix_cache): admission consults the trie before prefilling —
         # a request whose prompt extends a cached prefix COPIES the
@@ -454,6 +468,33 @@ class Engine:
         return dict(self.trace_counts)
 
     # ------------------------------------------------------------------ #
+    # request-scoped flight recording                                    #
+    # ------------------------------------------------------------------ #
+
+    def _rec(self, kind: str, rid: str, *, dur: Optional[float] = None,
+             detail: str = "") -> None:
+        """One rid-keyed flight event (no-op without a recorder)."""
+        if self.recorder is not None:
+            self.recorder.record(kind, rid=rid, dur=dur, detail=detail)
+
+    def _rec_clock(self) -> float:
+        """The recorder's clock (0.0 without one — callers only use the
+        value when a recorder exists, so durs stay self-consistent with
+        the recorder's own event timestamps)."""
+        return self.recorder.clock() if self.recorder is not None else 0.0
+
+    def _flush_decode_group(self, rid: str) -> None:
+        """Emit the coalesced decode-step span for ``rid`` (if any):
+        dur spans first-step start to last-step end, detail carries the
+        step count."""
+        group = self._decode_groups.pop(rid, None)
+        if group is None or self.recorder is None:
+            return
+        t0, t1, steps = group
+        self._rec("req_decode", rid, dur=max(t1 - t0, 0.0),
+                  detail=f"steps={int(steps)}")
+
+    # ------------------------------------------------------------------ #
     # request API                                                        #
     # ------------------------------------------------------------------ #
 
@@ -486,12 +527,24 @@ class Engine:
         self.scheduler.submit(req)   # validates before registration
         self._requests[rid] = req
         self.metrics.arrived(rid)
+        # Recorded only AFTER validation accepted the request — a
+        # rejected submit must leave no phantom span behind (the same
+        # contract the router keeps for its records).
+        self._rec(
+            "req_submit", rid,
+            detail=(
+                f"prompt={req.prompt_len} new={req.max_new_tokens} "
+                f"queued={self.scheduler.queue_depth}"
+            ),
+        )
         return rid
 
     def cancel(self, rid: str) -> bool:
         ok = self.scheduler.cancel(rid)
         if ok:
             self.metrics.finished(rid, status="cancelled")
+            self._flush_decode_group(rid)
+            self._rec("req_finish", rid, detail="status=cancelled")
         return ok
 
     def result(self, rid: str) -> np.ndarray:
@@ -539,6 +592,11 @@ class Engine:
         """Per-admission hook: prefix-cache consult here; subclasses
         extend (``fleet.SpeculativeEngine`` resets the recycled slot's
         draft frontier)."""
+        if self.recorder is not None:
+            times = self.metrics.requests.get(req.rid)
+            wait = times.queue_wait if times is not None else None
+            self._rec("req_admit", req.rid, dur=wait,
+                      detail=f"slot={req.slot}")
         if self._prefix_cache is not None:
             self._apply_prefix_reuse(req)
 
@@ -554,6 +612,7 @@ class Engine:
         if m <= 0 or donor is None:
             return
         assert req.slot is not None
+        t0 = self._rec_clock()
         new_cache = self._dispatch(
             self._prefix_copy_fn, self.pool.cache,
             jnp.int32(donor), jnp.int32(req.slot), jnp.int32(m),
@@ -562,6 +621,9 @@ class Engine:
         self.pool.lengths[req.slot] = m      # shadow miss -> re-upload
         req.prefilled = m
         self.metrics.prefix_hit(m)
+        self._rec("req_prefix_copy", req.rid,
+                  dur=max(self._rec_clock() - t0, 0.0),
+                  detail=f"reused={m} donor_slot={donor}")
 
     def _run_prefill(self) -> None:
         reqs = self.scheduler.prefill_pending()
@@ -578,6 +640,7 @@ class Engine:
             tokens[r.slot, :take] = r.prompt[r.prefilled:r.prefilled + take]
             n_valid[r.slot] = take
             takes.append((r, take))
+        t0 = self._rec_clock()
         tok, _grid, cache, lengths_dev, key = self._dispatch(
             self._prefill_fns[name], self.params, self.pool.cache,
             self._lengths_for_step(), jnp.asarray(tokens),
@@ -585,6 +648,11 @@ class Engine:
         )
         self.pool.cache = cache
         self._key = key
+        if self.recorder is not None:
+            dur = max(self._rec_clock() - t0, 0.0)
+            for r, take in takes:
+                self._rec("req_prefill", r.rid, dur=dur,
+                          detail=f"g={g} take={take}")
         # Start the device→host token copy NOW; the per-row bookkeeping
         # below runs while it is in flight (copy_to_host_async is a hint
         # — np.asarray below is the one materialization point).
@@ -624,6 +692,7 @@ class Engine:
         for r in reqs:
             tokens[r.slot, 0] = self._cur_tok[r.slot]
             n_valid[r.slot] = 1
+        t0 = self._rec_clock()
         tok, cache, lengths_dev, key = self._dispatch(
             self._decode_fn, self.params, self.pool.cache,
             self._lengths_for_step(), jnp.asarray(tokens),
@@ -634,6 +703,15 @@ class Engine:
         _start_host_copy(tok)           # overlap D2H with the bookkeeping
         self._commit_lengths(lengths_dev, n_valid)
         self.metrics.step("decode", len(reqs), self.pool.num_slots)
+        if self.recorder is not None:
+            t1 = self._rec_clock()
+            for r in reqs:
+                group = self._decode_groups.get(r.rid)
+                if group is None:
+                    self._decode_groups[r.rid] = [t0, t1, 1.0]
+                else:
+                    group[1] = t1
+                    group[2] += 1.0
         tok_host = np.asarray(tok)      # the ONE host fetch per step
         for r in reqs:
             self.pool.lengths[r.slot] += 1
@@ -654,6 +732,11 @@ class Engine:
             req.status = "finished"
             self.scheduler.release(req)
             self.metrics.finished(req.rid)
+            self._flush_decode_group(req.rid)
+            self._rec(
+                "req_finish", req.rid,
+                detail=f"status=finished tokens={len(req.tokens())}",
+            )
         else:
             self._cur_tok[req.slot] = token
 
@@ -680,6 +763,16 @@ class Engine:
         """Ask the engine to drain at the next iteration boundary (safe
         from a PreemptionHandler callback or another thread)."""
         self._drain_requested = True
+
+    def resume_serving(self) -> None:
+        """Re-open a drained engine for admissions.  A drain empties the
+        scheduler and frees every slot but leaves the engine refusing
+        new work; the fleet router calls this when it re-admits a
+        recovered (SLO-degraded) replica into rotation — the compiled
+        programs and pool are unchanged, so serving resumes without a
+        rebuild."""
+        self._draining = False
+        self._drain_requested = False
 
     def _preempted(self) -> bool:
         if self._drain_requested:
@@ -710,6 +803,14 @@ class Engine:
                 "prompt_len": r.prompt_len,
                 "generated_len": len(r.generated),
             }
+        if self.recorder is not None:
+            for r in unfinished:
+                self._flush_decode_group(r.rid)
+                self._rec("req_preempt", r.rid,
+                          detail=f"drain emitted={len(r.generated)}")
+            self.recorder.record(
+                "drain", detail=f"{len(unfinished)} in-flight"
+            )
         for r in list(self.scheduler.active.values()):
             r.status = "preempted"
             self.scheduler.release(r)

@@ -244,6 +244,13 @@ class Scheduler:
         return action
 
     @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot — recorded on each request's
+        ``req_submit`` flight event so a stitched trace can say how
+        deep the line was when this request joined it."""
+        return len(self.queue)
+
+    @property
     def idle(self) -> bool:
         return not self.queue and not self.active
 
