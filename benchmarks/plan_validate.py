@@ -27,8 +27,19 @@ measured-cost ranking to agree at least as well as the analytic one —
 feeding the planner real measurements must never make its ranking
 worse.
 
+**ZeRO rung** (the fully-sharded planner axis's gate): the tiny-llama
+SPMD pipe on a pp=2 × dp=2 CPU mesh is stepped replicated and fully
+sharded (``zero=3`` — params/grads/state stored at the fsdp layout,
+gathered at use) from MATCHED params.  The gate is BITWISE-equal loss
+at the matched params (the fsdp forward gathers exact copies, so the
+first step's loss must be bit-identical; later steps drift at ULP
+through psum-vs-reduce-scatter summation order and are only checked
+finite).  The record reports the certifier's resident-bytes delta
+(replicated param bytes vs the sharded residents, window beside it)
+next to the measured wall ratio — BENCH_NOTES carries both.
+
 Emits one JSON line (the bench contract) and exits non-zero on a rank
-mismatch or an agreement regression::
+mismatch, an agreement regression, or a ZeRO gate failure::
 
     env JAX_PLATFORMS=cpu python bench.py --plan-validate
 """
@@ -166,6 +177,104 @@ def _distill_cost_model(steps: int) -> Any:
     return report.cost_model(model)
 
 
+def _zero3_rung(steps: int = 5) -> Dict[str, Any]:
+    """Replicated vs fully-sharded (``zero=3``) measured step time at
+    MATCHED params on the pp=2 × dp=2 CPU mesh (module docstring, ZeRO
+    rung).  Returns the rung's record; ``{"skipped": ...}`` when the
+    host exposes fewer than 4 devices."""
+    import dataclasses as dc
+
+    import jax
+    import numpy as np
+    import optax
+
+    from benchmarks.llama_speed import PRESETS
+    from torchgpipe_tpu.analysis import sharding as shd
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig, cross_entropy, llama_spmd,
+    )
+    from torchgpipe_tpu.spmd import SpmdGPipe, make_mesh
+
+    if len(jax.devices()) < 4:
+        return {"skipped": "needs >= 4 host devices (pp=2 x dp=2)"}
+    dim, n_layers, n_heads, n_kv, vocab, mlp_ratio = PRESETS["tiny"]
+    cfg = TransformerConfig(
+        vocab=vocab, dim=dim, n_layers=n_layers, n_heads=n_heads,
+        n_kv_heads=n_kv, mlp_ratio=mlp_ratio,
+    )
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 2)
+
+    def loss_fn(out: Any, tok: Any) -> Any:
+        return cross_entropy(out[:, :-1, :], tok[:, 1:])
+
+    rep = SpmdGPipe(block, 2, mesh, chunks=CHUNKS, loss_fn=loss_fn,
+                    pre=pre, post=post, dp_axis="dp")
+    shp = dc.replace(rep, fsdp=True, zero_update=3)
+    x = jax.random.randint(jax.random.PRNGKey(1), (8, 128), 0, vocab)
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    host = rep.init(jax.random.PRNGKey(0), spec)
+    opt = optax.adamw(1e-3)
+    tmap = jax.tree_util.tree_map
+
+    runners: Dict[str, Any] = {}
+    first_losses: Dict[str, Any] = {}
+    for name, pipe, zero in (("replicated", rep, 0), ("zero3", shp, 3)):
+        params = pipe.place(tmap(np.asarray, host))
+        step = pipe.make_train_step(opt, donate=False, zero=zero)
+        state = pipe.zero_opt_state(opt, params, zero=zero)
+        # Compile + the matched-params step whose loss the gate pins.
+        loss, params, state = step(params, state, x, x)
+        first_losses[name] = np.asarray(jax.block_until_ready(loss))
+
+        def run_one(
+            i: int, _step: Any = step, _box: List[Any] = [params, state]
+        ) -> Tuple[float, float]:
+            t0 = time.perf_counter()
+            loss, _box[0], _box[1] = _step(_box[0], _box[1], x, x)
+            jax.block_until_ready(loss)
+            return time.perf_counter() - t0, float(loss)
+
+        runners[name] = run_one
+    bitwise = bool(np.array_equal(
+        first_losses["replicated"], first_losses["zero3"]
+    ))
+    # Paired rounds (the _measure_paired treatment): host-load drift
+    # shifts both variants' round together.
+    times: Dict[str, List[float]] = {n: [] for n in runners}
+    finite = True
+    for i in range(steps):
+        for name, run_one in runners.items():
+            dt, lv = run_one(i)
+            times[name].append(dt)
+            finite = finite and bool(np.isfinite(lv))
+    med: Dict[str, float] = {}
+    for name, ts in times.items():
+        ts.sort()
+        med[name] = ts[len(ts) // 2]
+    # The certifier's resident-bytes story, reported beside the wall
+    # ratio: replicated residents vs sharded residents (+ the transient
+    # gathered window the memory certification charges).
+    lay_r = shd.verify_layout(rep, spec)
+    lay_s = shd.verify_layout(shp, spec)
+    return {
+        "bitwise_matched_loss": bitwise,
+        "finite": finite,
+        "step_s": {n: round(t, 4) for n, t in med.items()},
+        "wall_ratio_zero3_over_replicated": round(
+            med["zero3"] / med["replicated"], 3
+        ),
+        "resident_param_bytes": {
+            "replicated": int(lay_r.param_bytes_local),
+            "zero3_sharded": int(lay_s.param_bytes_local),
+            "zero3_gathered_window": int(lay_s.gathered_window_bytes),
+        },
+        "resident_bytes_delta": int(
+            lay_r.param_bytes_local - lay_s.param_bytes_local
+        ),
+    }
+
+
 def run(steps: int = 5) -> Dict[str, Any]:
     """Plan, measure, compare.  Returns the result record (bench JSON)."""
     import jax
@@ -220,7 +329,12 @@ def run(steps: int = 5) -> Dict[str, Any]:
     agree_measured = _rank_agreement(predicted_m, measured_times)
     no_regression = agree_measured >= agree_analytic
     priced_by = {m: scored_m[m].priced_by for m in MODES}
-    ok = match and no_regression
+    zero3 = _zero3_rung(steps=steps)
+    zero3_ok = (
+        "skipped" in zero3
+        or (zero3["bitwise_matched_loss"] and zero3["finite"])
+    )
+    ok = match and no_regression and zero3_ok
     return {
         "metric": "plan-validate rank-order [tiny llama, cpu]",
         "value": 1.0 if ok else 0.0,
@@ -244,10 +358,22 @@ def run(steps: int = 5) -> Dict[str, Any]:
         "rank_agreement_analytic": round(agree_analytic, 4),
         "rank_agreement_measured": round(agree_measured, 4),
         "measured_not_worse": no_regression,
+        "zero3": zero3,
     }
 
 
 def main() -> int:
+    import os
+    import sys
+
+    # The ZeRO rung needs a pp=2 x dp=2 host mesh; the flag only works
+    # BEFORE the first jax import in this process (the rung degrades to
+    # a skip note otherwise).
+    if "jax" not in sys.modules:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")

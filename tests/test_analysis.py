@@ -956,3 +956,96 @@ def test_implicit_reshard_clean_on_replicated_and_closed_tp(cpu_devices):
                         tp_axis="tp")
     assert analysis.lint(tp_pipe, jax.ShapeDtypeStruct((8, 8), jnp.int32),
                          rules=["implicit-reshard"]) == []
+
+
+# --------------------------------------------------------------------- #
+# redundant-gather (gather-at-use / ZeRO-3 hygiene)                     #
+# --------------------------------------------------------------------- #
+
+
+def _double_use_block():
+    """A block whose weight feeds TWO matmuls — under
+    gather_schedule='use' each consumption would re-gather it."""
+    from jax.sharding import PartitionSpec as P
+
+    def init(rng, spec):
+        d = spec.shape[-1]
+        return {"w": jax.random.normal(rng, (d, d)) * 0.02}, ()
+
+    def apply(params, state, x, *, rng=None, train=True):
+        del rng, train
+        return x @ params["w"] @ params["w"], state
+
+    return Layer(name="dw", init=init, apply=apply,
+                 meta={"param_specs": {"w": P()}})
+
+
+def test_redundant_gather_warns_on_per_use_schedule(cpu_devices):
+    """Broken: an fsdp (gather-at-use) leaf consumed by two equations of
+    the block body under gather_schedule='use' — block params are
+    read-only, so the second gather is pure wasted all_gather traffic;
+    the rule names the fix (gather once per block)."""
+    pipe = SpmdGPipe(_double_use_block(), 2,
+                     make_mesh(2, 2, devices=cpu_devices[:4]), chunks=2,
+                     loss_fn=mse, dp_axis="dp", fsdp=True,
+                     gather_schedule="use")
+    found = _by_rule(
+        analysis.lint(pipe, jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                      rules=["redundant-gather"]),
+        "redundant-gather",
+    )
+    warns = [f for f in found if f.severity == Severity.WARNING]
+    assert warns and any("blocks/w" in f.path for f in warns)
+    assert "gather_schedule='block'" in warns[0].message  # the fix
+
+
+def test_redundant_gather_clean_on_block_schedule(cpu_devices):
+    """Fixed twin: the same double-use layout under the compiled
+    gather_schedule='block' (one gather per block body) lints clean."""
+    pipe = SpmdGPipe(_double_use_block(), 2,
+                     make_mesh(2, 2, devices=cpu_devices[:4]), chunks=2,
+                     loss_fn=mse, dp_axis="dp", fsdp=True)
+    assert analysis.lint(pipe, jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                         rules=["redundant-gather"]) == []
+
+
+def test_redundant_gather_errors_when_window_exceeds_budget(cpu_devices):
+    """Broken: the ZeRO-3 gathered window ALONE over the declared
+    hbm_budget_bytes is an ERROR — sharded storage cannot save a model
+    whose transient gathered copies don't fit.  Fixed twin: a budget
+    with head-room for the window lints clean."""
+    pipe = SpmdGPipe(_double_use_block(), 2,
+                     make_mesh(2, 2, devices=cpu_devices[:4]), chunks=2,
+                     loss_fn=mse, dp_axis="dp", fsdp=True,
+                     hbm_budget_bytes=64)
+    found = _by_rule(
+        analysis.lint(pipe, jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                      rules=["redundant-gather"]),
+        "redundant-gather",
+    )
+    errors = [f for f in found if f.severity == Severity.ERROR]
+    assert errors and "gathered window alone" in errors[0].message
+    import dataclasses as dc
+
+    roomy = dc.replace(pipe, hbm_budget_bytes=1 << 30)
+    assert analysis.lint(roomy, jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                         rules=["redundant-gather"]) == []
+
+
+def test_redundant_gather_stands_down_without_gather_leaves(cpu_devices):
+    """Stand-downs: a replicated (non-fsdp, no declared rules) pipe has
+    no gather-at-use leaves; and single-use fsdp leaves under
+    gather_schedule='use' gather once — nothing is redundant."""
+    from jax.sharding import PartitionSpec as P
+
+    plain = SpmdGPipe(_sharded_bias_block(P()), 2,
+                      make_mesh(2, 1, devices=cpu_devices[:2]), chunks=2,
+                      loss_fn=mse)
+    assert analysis.lint(plain, jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                         rules=["redundant-gather"]) == []
+    single = SpmdGPipe(_sharded_bias_block(P()), 2,
+                       make_mesh(2, 2, devices=cpu_devices[:4]), chunks=2,
+                       loss_fn=mse, dp_axis="dp", fsdp=True,
+                       gather_schedule="use")
+    assert analysis.lint(single, jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                         rules=["redundant-gather"]) == []

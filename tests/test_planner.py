@@ -771,10 +771,12 @@ def test_plan_3d_rejects_phantom_axis_widths(cpu_devices):
     assert any("dp_axis" in r for r in reasons)
 
 
-def test_plan_3d_never_ranks_zero_for_fsdp_or_dp_sharded_layouts(cpu_devices):
-    """The ZeRO update refuses fsdp and dp-sharded layouts at
-    make_train_step; the frontier must never rank a zero=True plan its
-    own engine would crash on — the zero axis is dropped for them."""
+def test_plan_3d_never_ranks_zero1_for_fsdp_or_dp_sharded_layouts(cpu_devices):
+    """The ZeRO-1 update refuses fsdp and dp-sharded layouts at
+    make_train_step; the frontier must never rank a zero=1 plan its own
+    engine would crash on.  An fsdp pipe's certified candidates carry
+    the HONEST level instead — zero=3, the label its plain update
+    actually runs as."""
     from torchgpipe_tpu.models.transformer import (
         TransformerConfig, cross_entropy, llama_spmd,
     )
@@ -791,23 +793,23 @@ def test_plan_3d_never_ranks_zero_for_fsdp_or_dp_sharded_layouts(cpu_devices):
         chunks_options=[2], schedules=["fill_drain"],
     )
     certified = [p for p in report.candidates if p.certified]
-    assert certified and all(not p.zero for p in certified)
-    # An explicit zero_options=[True] request is an honest REJECT row,
-    # not a crash-later plan.
+    assert certified and all(p.zero == 3 for p in certified)
+    # An explicit zero_options=[True] (level 1) request is an honest
+    # REJECT row, not a crash-later plan.
     report2 = planner.plan(
         pipe, x, hbm_budget_bytes=15 << 30, megastep_options=[1],
         chunks_options=[2], schedules=["fill_drain"],
         zero_options=[True],
     )
     assert report2.best is None
-    assert any("zero=True is incompatible" in p.reason
-               for p in report2.candidates)
+    assert any("zero=1 is incompatible" in p.reason
+               and "fsdp" in p.reason for p in report2.candidates)
 
 
 def test_plan_3d_rejects_explicit_zero_without_dp(cpu_devices):
     """An explicit zero_options=[True] request on a dp=1 pipe is an
     honest REJECT row — never a certified plan make_train_step would
-    crash on."""
+    crash on.  Level 2 is refused at the option-normalization layer."""
     from jax.sharding import PartitionSpec as P
 
     mesh = make_mesh(2, 1, devices=cpu_devices[:2])
@@ -819,8 +821,59 @@ def test_plan_3d_rejects_explicit_zero_without_dp(cpu_devices):
         zero_options=[True],
     )
     assert report.best is None
-    assert any("zero=True is incompatible" in p.reason
+    assert any("zero=1 is incompatible" in p.reason
                for p in report.candidates)
+    with pytest.raises(ValueError, match="levels 0, 1 or 3"):
+        planner.zero_options_for([2], dp=2)
+
+
+def test_plan_zero3_certifies_where_replicated_is_over_budget(cpu_devices):
+    """Acceptance (ZeRO-3 pricing, arXiv:1910.02054): on a budget the
+    REPLICATED layout cannot fit, the frontier keeps an honest
+    'over HBM budget' REJECT row for zero=0 and ranks a CERTIFIED
+    zero=3 winner whose per-rank HWM — sharded residents plus the
+    transient gathered window from the sharding verifier — fits.
+    apply_plan on the winner flips fsdp on."""
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig, cross_entropy, llama_spmd,
+    )
+
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 4, devices=cpu_devices[:8])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+                     pre=pre, post=post, dp_axis="dp")
+    x = jax.ShapeDtypeStruct((16, 8), jnp.int32)
+    kw = dict(
+        megastep_options=[1], chunks_options=[2],
+        schedules=["fill_drain"], zero_options=[0, 3],
+        overhead_bytes=0,
+    )
+    # Scout pass at an unconstrained budget to read both levels' HWMs.
+    wide = planner.plan(pipe, x, hbm_budget_bytes=1 << 40, **kw)
+    by_level = {p.zero: p for p in wide.candidates if p.certified}
+    assert set(by_level) == {0, 3}
+    hwm0, hwm3 = by_level[0].hwm_bytes, by_level[3].hwm_bytes
+    assert hwm3 < hwm0  # sharded residents + window < replicated
+    # zero=3 stores optimizer state against the SHARDED params.
+    assert by_level[3].opt_state_bytes < by_level[0].opt_state_bytes
+    # ...and pays for it in priced collective volume (per-step
+    # all_gather + reduce-scatter grad sync).
+    assert by_level[3].comm_bytes > 0
+    report = planner.plan(
+        pipe, x, hbm_budget_bytes=(hwm0 + hwm3) // 2, **kw
+    )
+    rows0 = [p for p in report.candidates if p.zero == 0]
+    assert rows0 and all(
+        p.certified and not p.feasible and p.reason == "over HBM budget"
+        for p in rows0
+    )
+    best = report.best
+    assert best is not None and best.zero == 3
+    assert best.certified and best.feasible
+    applied = planner.apply_plan(pipe, best)
+    assert applied.fsdp is True and applied.zero_update == 3
 
 
 # --------------------------------------------------------------------- #

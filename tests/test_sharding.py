@@ -161,6 +161,51 @@ def test_emitted_table_round_trips_the_structural_layout(tp_llama):
         )
 
 
+def test_fsdp_emitted_table_round_trips_storage_and_gather(cpu_devices):
+    """The ZeRO-3 unification gate: the fsdp augmentation is ordinary
+    ordered rules — the emitted table carries each matched leaf's
+    STORAGE layout (``P(..., dp, ...)``) plus the declared
+    gather-at-use attribute, and resolving it reproduces
+    ``_structural_layout`` exactly for every leaf, specs AND gathers.
+    ``compute_spec()`` drops the gather axes (what the block jaxpr
+    sees); a planner-candidate ``dp_size`` override round-trips too."""
+    cfg = TransformerConfig(vocab=64, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 2, devices=cpu_devices[:4])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+                     pre=pre, post=post, dp_axis="dp", fsdp=True)
+    params_spec = jax.eval_shape(
+        lambda r: pipe._init_host(r, TOK), jax.random.PRNGKey(0)
+    )
+
+    def flat(t):
+        return jax.tree_util.tree_leaves(
+            t, is_leaf=lambda s: isinstance(s, P)
+        )
+
+    for dp_size in (None, 4):
+        table = pipe.rule_table(params_spec, dp_size=dp_size)
+        specs, gathers, unmatched = table.resolve_layout(params_spec)
+        assert unmatched == []
+        want_specs, want_gathers = pipe._structural_layout(
+            params_spec, dp_size=dp_size
+        )
+        assert flat(specs) == flat(want_specs)
+        assert gathers == want_gathers
+        gathered = {p: a for p, a in gathers.items() if a}
+        assert gathered and all(a == ("dp",) for a in gathered.values())
+        for path, axes in gathered.items():
+            rule = table.rule_for(path)
+            assert rule.gather == axes
+            assert "dp" in shd.spec_axes(rule.spec)  # storage layout
+            assert "dp" not in shd.spec_axes(rule.compute_spec())
+        # Non-block leaves (pre/post) stay replicated-over-dp with no
+        # gather attribute.
+        assert all(not gathers[p] for p in gathers
+                   if not p.startswith("blocks/"))
+
+
 def test_parallel_tensor_rules_match_the_declared_tp_layout(tp_llama):
     """parallel.tensor.partition_rules: the hand-written Megatron table
     resolves a tp transformer's STACKED block params to exactly the
@@ -294,6 +339,37 @@ def test_eqn_comm_bytes_reduce_scatter_and_all_to_all():
     assert _first_comm(closed, {"tp": 4}) == pytest.approx(3 / 4 * local)
 
 
+def test_collective_comm_bytes_zero3_grad_path_conventions():
+    """Broken twins pinning the two sides of the ZeRO-3 grad path under
+    a dp axis: ``all_gather`` prices (N-1)/N × OUTPUT bytes (the input
+    convention reads N× too little — each device RECEIVES every other
+    shard), ``reduce_scatter`` prices (N-1)/N × INPUT bytes (the output
+    convention reads N× too little — every full-grad shard but your own
+    goes on the wire).  Only the ring all-reduce side was pinned by the
+    optimizer gates before."""
+    n, shard = 4, 1024.0  # bytes of one stored (1/N) param shard
+    full = n * shard
+    up = jx.collective_comm_bytes("all_gather", n, shard)
+    assert up == pytest.approx((n - 1) / n * full)
+    assert up != pytest.approx((n - 1) / n * shard)  # broken: input conv
+    # An explicit out_bytes must agree with the tiled n×in derivation.
+    assert jx.collective_comm_bytes("all_gather", n, shard, full) == up
+    down = jx.collective_comm_bytes("reduce_scatter", n, full)
+    assert down == pytest.approx((n - 1) / n * full)
+    assert down != pytest.approx((n - 1) / n * shard)  # broken: out conv
+    assert jx.collective_comm_bytes("psum_scatter", n, full) == down
+    # The ZeRO-3 round trip (gather params up, reduce-scatter grads
+    # down) moves exactly the ring all-reduce volume the replicated
+    # layout pays in its ONE grad psum — the wire cost is layout-
+    # invariant; only the RESIDENT bytes change.
+    assert up + down == pytest.approx(
+        jx.collective_comm_bytes("psum", n, full)
+    )
+    # dp width 1: nothing to move on either side.
+    assert jx.collective_comm_bytes("all_gather", 1, shard) == 0.0
+    assert jx.collective_comm_bytes("reduce_scatter", 1, full) == 0.0
+
+
 # --------------------------------------------------------------------- #
 # propagation: implicit reshard, mesh mismatch, memory under layout     #
 # --------------------------------------------------------------------- #
@@ -400,19 +476,33 @@ def test_accidental_full_replication_warns(cpu_devices):
 # --------------------------------------------------------------------- #
 
 
-def test_zero_refused_without_dp_and_under_fsdp(cpu_devices):
+def test_zero_levels_validate_against_the_layout(cpu_devices):
+    """The zero= LEVEL contract: no dp axis refuses any sharded level;
+    zero=1 under fsdp and zero=3 without fsdp are refused didactically
+    (level/layout mismatch); zero=True resolves to the layout's natural
+    level (3 under fsdp, 1 otherwise); level 2 does not exist."""
     import optax
 
     mesh = make_mesh(2, 1, devices=cpu_devices[:2])
     pipe = SpmdGPipe(biased_dense(P()), 2, mesh, chunks=2, loss_fn=mse)
     with pytest.raises(ValueError, match="needs dp_axis"):
         pipe.make_train_step(optax.sgd(1e-2), zero=True)
+    with pytest.raises(ValueError, match="fsdp=True"):
+        pipe.make_train_step(optax.sgd(1e-2), zero=3)
+    with pytest.raises(ValueError, match="not a supported ZeRO level"):
+        pipe.make_train_step(optax.sgd(1e-2), zero=2)
     import dataclasses as dc
 
     mesh2 = make_mesh(2, 2, devices=cpu_devices[:4])
     fpipe = dc.replace(pipe, mesh=mesh2, dp_axis="dp", fsdp=True)
-    with pytest.raises(ValueError, match="already sharded over dp"):
-        fpipe.make_train_step(optax.sgd(1e-2), zero=True)
+    # fsdp + zero is no longer refused: True resolves to the fully-
+    # sharded level 3; the incoherent segment level 1 still raises.
+    assert fpipe._zero_level(True) == 3
+    assert fpipe._zero_level(None) == 0  # declared zero_update=False
+    with pytest.raises(ValueError, match="zero=1 under fsdp"):
+        fpipe.make_train_step(optax.sgd(1e-2), zero=1)
+    rpipe = dc.replace(pipe, mesh=mesh2, dp_axis="dp")
+    assert rpipe._zero_level(True) == 1
 
 
 @pytest.mark.slow  # full tiny-llama 3D searches across 3 widths

@@ -52,9 +52,13 @@ differently and must not share backend state):
    3D-layout verifier's contract on the tiny + small llama presets:
    every param leaf resolves through the unified partition-rule table,
    resolved specs name only existing mesh axes, the propagated block
-   layout induces no implicit reshard, and the 3D planner's TOP
+   layout induces no implicit reshard, the 3D planner's TOP
    (dp × tp × pp) plan re-verifies at its widths with per-device
-   memory under budget (docs/analysis.md, sharding section);
+   memory under budget, and the top ZeRO-3 (fully-sharded) plan
+   certifies — its fsdp gather-at-use layout re-verifies at the plan's
+   widths and a re-planned singleton reproduces the certified per-rank
+   HWM (memory-certification drift, or an uncertified applied plan,
+   exits 1) (docs/analysis.md, sharding section);
 9. ``tools/pack_verify.py`` (pack-verify) — the sequence-packing +
    bucket-ladder contract: the deterministic packer's invariants
    (replay, no document split, resume), the ``pad-waste`` lint rule

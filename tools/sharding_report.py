@@ -5,15 +5,18 @@
 Resolves a llama preset's param layout through the unified
 partition-rule layer, verifies it statically (rule coverage, mesh
 validity, propagation — no device probes), runs the 3D planner over a
-small (dp, tp) width grid and re-verifies the TOP plan's layout at its
-widths::
+small (dp, tp) width grid, re-verifies the TOP plan's layout at its
+widths, and re-verifies the top ZeRO-3 (fully-sharded, gather-at-use)
+plan — its fsdp layout must certify at the plan's widths and a
+re-planned singleton must reproduce the certified per-rank HWM::
 
     python tools/sharding_report.py --preset tiny --stages 4 --batch 8
 
-Exit codes: 0 — the layout and the top 3D plan verify clean; 1 — an
-unmatched param leaf, a mesh-axis mismatch, an implicit reshard, or a
-per-device memory overrun (no certified candidate fits the budget);
-2 — bad usage.
+Exit codes: 0 — the layout, the top 3D plan and the top ZeRO-3 plan
+verify clean; 1 — an unmatched param leaf, a mesh-axis mismatch, an
+implicit reshard, a per-device memory overrun (no certified candidate
+fits the budget), an uncertified ZeRO-3 plan, or ZeRO-3
+memory-certification drift; 2 — bad usage.
 
 ``--ci`` loops the fast llama presets (tiny, small) — the
 ``sharding-verify`` step in ``tools/ci_lint.py``, mirroring the
@@ -106,9 +109,14 @@ def _report_one(
     # 2. The 3D planner over the width grid; the top plan must exist
     # (memory under budget) and re-verify at its widths.
     budget = int(budget_gib * 2 ** 30)
+    # ONE search covers both gates: the top-3D-plan check (step 2) and
+    # the ZeRO-3 certification (step 3) — the explicit level space
+    # (0, 1, 3) adds the fully-sharded candidates to the same frontier
+    # at a fraction of a second search's cost (traces are shared).
     plan_report = planner.plan(
         pipe, x, hbm_budget_bytes=budget,
         mesh_options=mesh_options, megastep_options=(1,),
+        zero_options=(0, 1, 3),
     )
     best = plan_report.best
     if best is None:
@@ -141,6 +149,70 @@ def _report_one(
         return 1
     print("sharding-verify: top 3D plan clean "
           "(rule coverage + mesh validity + memory)")
+
+    # 3. The fully-sharded frontier: the top ZeRO-3 plan must certify,
+    # its fsdp (gather-at-use) layout must re-verify at the plan's
+    # widths, and a re-planned singleton at its exact coordinates must
+    # reproduce the certified per-rank HWM — memory-certification
+    # DRIFT, or an uncertified applied plan, fails the gate.
+    import dataclasses as dc
+
+    best3 = next(
+        (p for p in plan_report.candidates
+         if p.zero == 3 and p.certified and p.feasible),
+        None,
+    )
+    if best3 is None:
+        reasons = sorted({
+            p.reason for p in plan_report.candidates if p.zero == 3
+        })
+        print("\nNO certified ZeRO-3 candidate "
+              f"(reject reasons: {reasons[:3]})", file=sys.stderr)
+        return 1
+    layout3 = sharding.verify_layout(
+        dc.replace(pipe, fsdp=True, zero_update=3), x,
+        mesh_sizes={
+            (pipe.dp_axis or "dp"): best3.dp,
+            (pipe.tp_axis or "tp"): best3.tp,
+        },
+    )
+    errors = [
+        f for f in layout3.findings if f.severity >= Severity.ERROR
+    ]
+    if errors or layout3.reshards():
+        print(format_findings(layout3.findings), file=sys.stderr)
+        print("\nZeRO-3 layout verification FAILED", file=sys.stderr)
+        return 1
+    redo = planner.plan(
+        pipe, x, hbm_budget_bytes=budget,
+        mesh_options=[(best3.dp, best3.tp)],
+        schedules=[best3.schedule], chunks_options=[best3.chunks],
+        megastep_options=(1,), zero_options=(3,),
+    )
+    twin = next(
+        (p for p in redo.candidates
+         if p.zero == 3 and p.checkpoint == best3.checkpoint
+         and p.policy == best3.policy
+         and p.scan_unroll == best3.scan_unroll),
+        None,
+    )
+    if (
+        twin is None or not (twin.certified and twin.feasible)
+        or twin.hwm_bytes != best3.hwm_bytes
+    ):
+        print(
+            "\nZeRO-3 memory-certification DRIFT: the re-planned "
+            f"candidate reads {getattr(twin, 'hwm_bytes', None)} bytes "
+            f"vs the frontier's {best3.hwm_bytes}", file=sys.stderr,
+        )
+        return 1
+    print(
+        f"sharding-verify: top ZeRO-3 plan certified "
+        f"(dpxtp={best3.dp}x{best3.tp} "
+        f"hwm={best3.hwm_bytes / 2 ** 30:.2f} GiB, gathered window "
+        f"{layout3.gathered_window_bytes / 2 ** 20:.1f} MiB, "
+        f"{len(layout3.gather_paths)} gather-at-use leaves)"
+    )
     return 0
 
 

@@ -62,14 +62,38 @@ def tree_leaf_paths(tree: Pytree) -> List[Tuple[str, Any]]:
 class PartitionRule:
     """One ordered layout rule: leaf paths matching ``pattern`` (by
     ``re.search``) shard as ``spec``.  ``note`` documents intent in
-    emitted tables (e.g. which layer declared the underlying spec)."""
+    emitted tables (e.g. which layer declared the underlying spec).
+
+    ``gather`` names the **gather-at-use** axes: mesh axes over which
+    ``spec`` is a *storage* layout only — the leaf lives sharded over
+    them at rest (ZeRO-3/fsdp) but is ``all_gather``-ed before compute
+    consumes it, so block math sees the spec with those axes removed.
+    An empty ``gather`` (the default) means storage and compute layouts
+    coincide (replicated or ZeRO-1 params, tp-sharded weights)."""
 
     pattern: str
     spec: P
     note: str = ""
+    gather: Tuple[str, ...] = ()
 
     def matches(self, path: str) -> bool:
         return re.search(self.pattern, path) is not None
+
+    def compute_spec(self) -> P:
+        """``spec`` with the gather-at-use axes removed — the layout the
+        block jaxpr actually consumes (``spec`` itself is storage)."""
+        if not self.gather:
+            return self.spec
+
+        def drop(entry: Any) -> Any:
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a not in self.gather)
+                return kept if kept else None
+            return None if entry in self.gather else entry
+
+        return P(*(drop(e) for e in self.spec))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,6 +125,17 @@ class RuleTable:
                 return rule.spec
         return None
 
+    def rule_for(self, path: str, ndim: Optional[int] = None) -> Optional[
+            PartitionRule]:
+        """The first matching rule for one leaf path, or None (``ndim=0``
+        resolves to a synthetic scalar rule: ``P()``, no gather)."""
+        if ndim == 0:
+            return PartitionRule(pattern="", spec=P())
+        for rule in self.rules:
+            if rule.matches(path):
+                return rule
+        return None
+
     def resolve(self, tree: Pytree) -> Tuple[Pytree, List[str]]:
         """Resolve ``tree``'s layout: a spec-per-leaf pytree plus the list
         of UNMATCHED leaf paths (those fall back to ``P()`` in the spec
@@ -123,11 +158,49 @@ class RuleTable:
             specs.append(spec)
         return jax.tree_util.tree_unflatten(tdef, specs), unmatched
 
+    def resolve_layout(
+        self, tree: Pytree
+    ) -> Tuple[Pytree, Dict[str, Tuple[str, ...]], List[str]]:
+        """Resolve ``tree``'s FULL layout: ``(specs, gathers, unmatched)``.
+
+        ``specs`` is the storage spec-per-leaf pytree (exactly
+        :meth:`resolve`'s first result); ``gathers`` maps each leaf
+        *path* to its gather-at-use axis tuple (``()`` for ordinary
+        leaves, e.g. ``("dp",)`` for a ZeRO-3 param) — a flat
+        path-keyed dict rather than a pytree because axis tuples are
+        pytree containers and would not survive a re-flatten.  Unmatched
+        leaves fall back to ``(P(), ())`` and are reported, same
+        contract as :meth:`resolve`."""
+        flat, tdef = jax.tree_util.tree_flatten_with_path(tree)
+        specs: List[P] = []
+        gathers: Dict[str, Tuple[str, ...]] = {}
+        unmatched: List[str] = []
+        for kp, leaf in flat:
+            path = leaf_path(kp)
+            ndim = getattr(leaf, "ndim", None)
+            if ndim is None:
+                shape = getattr(leaf, "shape", None)
+                ndim = len(shape) if shape is not None else None
+            rule = self.rule_for(path, ndim)
+            if rule is None:
+                unmatched.append(path)
+                specs.append(P())
+                gathers[path] = ()
+            else:
+                specs.append(rule.spec)
+                gathers[path] = tuple(rule.gather)
+        return (
+            jax.tree_util.tree_unflatten(tdef, specs),
+            gathers,
+            unmatched,
+        )
+
     def describe(self) -> str:
         """Human-readable table (the docs' rule-table reference form)."""
         head = f"# rule table {self.name or '<anonymous>'}"
         rows = [
             f"{i:3d}  {r.pattern:<48} -> {r.spec}"
+            + (f"   gather-at-use over {r.gather}" if r.gather else "")
             + (f"   # {r.note}" if r.note else "")
             for i, r in enumerate(self.rules)
         ]
@@ -170,7 +243,10 @@ def _spec_key(spec: P) -> Tuple:
 
 
 def rules_from_specs(
-    specs_tree: Pytree, name: str = "", note: str = ""
+    specs_tree: Pytree,
+    name: str = "",
+    note: str = "",
+    gathers: Optional[Dict[str, Tuple[str, ...]]] = None,
 ) -> RuleTable:
     """Derive an ordered rule table from a resolved per-leaf spec pytree.
 
@@ -178,8 +254,15 @@ def rules_from_specs(
     sharing a spec are grouped (first-seen order) into one anchored
     alternation rule, so resolving the emitted table against the same
     tree reproduces the input specs exactly — the round-trip the
-    unified-layer tests pin."""
-    groups: Dict[Tuple, Tuple[P, List[str]]] = {}
+    unified-layer tests pin.
+
+    ``gathers`` (optional) maps leaf *paths* to gather-at-use axis
+    tuples (a missing path means ``()`` — no gather); when given,
+    grouping keys on ``(spec, gather)`` so ZeRO-3 storage rules stay
+    distinct from plain rules sharing the same spec, and
+    :meth:`RuleTable.resolve_layout` round-trips both attributes."""
+    gathers = gathers or {}
+    groups: Dict[Tuple, Tuple[P, Tuple[str, ...], List[str]]] = {}
     for path, spec in tree_leaf_paths(specs_tree):
         if not isinstance(spec, P):
             raise TypeError(
@@ -187,16 +270,18 @@ def rules_from_specs(
                 "expected a PartitionSpec (resolve prefixes with "
                 "broadcast_specs first)"
             )
-        key = _spec_key(spec)
+        gather = tuple(gathers.get(path, ()))
+        key = (_spec_key(spec), gather)
         if key not in groups:
-            groups[key] = (spec, [])
-        groups[key][1].append(path)
+            groups[key] = (spec, gather, [])
+        groups[key][2].append(path)
     rules = tuple(
         PartitionRule(
             pattern="^(?:" + "|".join(re.escape(p) for p in paths) + ")$",
             spec=spec,
             note=note,
+            gather=gather,
         )
-        for spec, paths in groups.values()
+        for spec, gather, paths in groups.values()
     )
     return RuleTable(rules=rules, name=name)

@@ -66,7 +66,7 @@ Prediction model (auditable):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 from jax.sharding import PartitionSpec as _P
@@ -202,13 +202,28 @@ def mesh_width_options(
 
 
 def zero_options_for(
-    requested: Optional[Sequence[bool]], dp: int
-) -> List[bool]:
-    """ZeRO optimizer-state-sharding candidates: with one data replica
-    there is nothing to shard, so the axis only opens at dp > 1."""
+    requested: Optional[Sequence[Union[bool, int]]], dp: int
+) -> List[int]:
+    """ZeRO sharding-LEVEL candidates: 0 (replicated), 1 (optimizer
+    state ÷ N_dp) or 3 (fully sharded — params/grads/state stored at
+    the fsdp layout, gathered at use).  Bools normalize to the levels
+    they historically meant (``False`` → 0, ``True`` → 1).  With one
+    data replica there is nothing to shard, so the axis only opens at
+    dp > 1; level 3 is opt-in (``zero_options=[0, 3]``) because it
+    changes the STORAGE layout, not just the optimizer state."""
     if requested is not None:
-        return [bool(z) for z in requested]
-    return [False, True] if dp > 1 else [False]
+        out: List[int] = []
+        for z in requested:
+            level = int(z) if not isinstance(z, bool) else (1 if z else 0)
+            if level not in (0, 1, 3):
+                raise ValueError(
+                    f"zero_options entries must be levels 0, 1 or 3 "
+                    f"(got {z!r}); level 2 does not exist here — see "
+                    "SpmdGPipe.make_train_step"
+                )
+            out.append(level)
+        return out
+    return [0, 1] if dp > 1 else [0]
 
 
 def spmd_schedule_space(pipe: Any) -> List[str]:
@@ -274,13 +289,16 @@ class Plan:
     megastep: int = 1
     scan_unroll: Any = 1
     # 3D axes (SPMD engine): data/tensor widths of the candidate mesh
-    # (pp is n_stages), the ZeRO optimizer-state sharding flag, the
-    # layout-certified per-device optimizer-state bytes (drops ~N_dp×
-    # under zero=True — the acceptance the ZeRO gate pins), and the
-    # priced per-lane collective volume charged against the makespan.
+    # (pp is n_stages), the ZeRO sharding LEVEL (0 replicated; 1 =
+    # optimizer state ÷ N_dp — the acceptance the ZeRO gate pins; 3 =
+    # fully sharded, params/grads/state stored at the fsdp layout and
+    # gathered at use), the layout-certified per-device optimizer-state
+    # bytes, and the priced per-lane collective volume charged against
+    # the makespan (level 3 adds the per-step all_gather plus the
+    # reduce-scatter grad sync).
     dp: int = 1
     tp: int = 1
-    zero: bool = False
+    zero: int = 0
     opt_state_bytes: int = 0
     comm_bytes: int = 0
     # Profile-guided pricing (plan(cost_model=...)): which cost source
@@ -314,7 +332,9 @@ class Plan:
             f" +{self.host_bytes / GiB:.2f} host" if self.host_bytes else ""
         )
         unroll = "full" if self.scan_unroll is True else self.scan_unroll
-        mesh3d = f"{self.dp}x{self.tp}" + ("Z" if self.zero else "")
+        mesh3d = f"{self.dp}x{self.tp}" + {1: "Z", 3: "Z3"}.get(
+            int(self.zero), ""
+        )
         priced = {"analytic": "a", "measured": "M", "mixed": "x"}.get(
             self.priced_by, "?"
         )
@@ -575,7 +595,7 @@ def _plan_spmd(
     megastep_opts: Optional[Sequence[int]],
     steps: Optional[int],
     mesh_options: Optional[Sequence[Sequence[int]]],
-    zero_options: Optional[Sequence[bool]],
+    zero_options: Optional[Sequence[Union[bool, int]]],
     overhead_bytes: int,
     param_scale: float,
     real_token_fraction: float = 1.0,
@@ -634,7 +654,7 @@ def _plan_spmd(
     def rejected(
         dp: int, tp: int, reason: str, *,
         schedule: str = "*", mode: str = "-", label: Optional[str] = None,
-        chunks: Optional[int] = None, zero: bool = False,
+        chunks: Optional[int] = None, zero: int = 0,
     ) -> Plan:
         return Plan(
             engine="spmd", schedule=schedule, balance=None,
@@ -698,34 +718,110 @@ def _plan_spmd(
             model_flops / (dp * ep * tp)
             if model_flops is not None else None
         )
-        zero_space = zero_options_for(zero_options, dp)
-        # The ZeRO update itself refuses dp < 2 / no dp_axis, fsdp
-        # (state already sharded beside the fsdp'd params) and layouts
-        # that shard a leaf over dp (the segment math needs
-        # dp-replicated params) — a frontier must never rank a plan its
-        # own engine would crash on; an explicit zero_options=[True]
-        # request gets an honest REJECT row instead.
-        zero_incompatible = (
-            dp < 2
-            or pipe.dp_axis is None
-            or pipe.fsdp
-            or any(
-                pipe.dp_axis in shd.spec_axes(s)
-                for _, s in shd.tree_leaf_paths(layout.specs)
-                if isinstance(s, _P)
+        zero_space = list(dict.fromkeys(zero_options_for(zero_options, dp)))
+        explicit_zero = zero_options is not None
+        # Per-LEVEL compatibility, mirroring the engine's own refusals
+        # (a frontier must never rank a plan its own engine would crash
+        # on).  Level 1 needs dp >= 2 and dp-REPLICATED params (the
+        # segment math shards replicated state); level 3 needs a
+        # certifiable fsdp storage layout at this width.  An explicitly
+        # requested incompatible level gets an honest REJECT row; the
+        # default space just drops it.
+        z1_reason: Optional[str] = None
+        if dp < 2 or pipe.dp_axis is None:
+            z1_reason = (
+                "zero=1 is incompatible here (needs dp >= 2 and a "
+                "declared dp_axis); drop it from zero_options"
             )
-        )
-        if zero_incompatible:
-            zero_space = [z for z in zero_space if not z]
-            if not zero_space:
-                plans.append(rejected(
-                    dp, tp,
-                    "zero=True is incompatible here (needs dp >= 2 and "
-                    "dp-replicated params; fsdp/dp-sharded layouts "
-                    "already shard their state); drop it from "
-                    "zero_options",
-                ))
+        elif pipe.fsdp:
+            z1_reason = (
+                "zero=1 is incompatible here (the fsdp layout already "
+                "shards params/grads/state over dp — zero=3 IS this "
+                "layout's update); drop it from zero_options"
+            )
+        elif any(
+            pipe.dp_axis in shd.spec_axes(s)
+            for _, s in shd.tree_leaf_paths(layout.specs)
+            if isinstance(s, _P)
+        ):
+            z1_reason = (
+                "zero=1 is incompatible here (a param leaf is sharded "
+                "over the dp axis; the segment math needs dp-replicated "
+                "params); drop it from zero_options"
+            )
+        # On an fsdp pipe at dp > 1, level 0 and level 3 are the SAME
+        # program (the plain update against the stored-sharded layout)
+        # — relabel 0 as 3 so the frontier carries the honest level.
+        if pipe.fsdp and dp > 1:
+            zero_space = list(dict.fromkeys(
+                3 if z in (0, 3) else z for z in zero_space
+            ))
+        layout3: Optional[Any] = None
+        z3_reason: Optional[str] = None
+        if 3 in zero_space:
+            if dp < 2 or pipe.dp_axis is None:
+                z3_reason = (
+                    "zero=3 is incompatible here (needs dp >= 2 and a "
+                    "declared dp_axis); drop it from zero_options"
+                )
+            elif pipe.fsdp:
+                layout3 = layout
+            else:
+                try:
+                    pipe3 = dataclasses.replace(
+                        pipe, fsdp=True, zero_update=3
+                    )
+                    layout3 = shd.verify_layout(
+                        pipe3, batch, params_spec=params_spec,
+                        mesh_sizes=overrides, jaxpr_cache=layout_cache,
+                    )
+                except Exception as e:  # noqa: BLE001 - honest reject
+                    z3_reason = f"zero=3 layout: {e}"
+                if layout3 is not None:
+                    r3 = _layout_reject_reason(layout3)
+                    if r3 is not None:
+                        layout3, z3_reason = None, f"zero=3 {r3}"
+        kept: List[int] = []
+        for z in zero_space:
+            if z == 1 and z1_reason is not None:
+                if explicit_zero:
+                    plans.append(rejected(dp, tp, z1_reason, zero=1))
                 continue
+            if z == 3 and layout3 is None:
+                if explicit_zero:
+                    plans.append(rejected(
+                        dp, tp, z3_reason or "zero=3 unavailable",
+                        zero=3,
+                    ))
+                continue
+            kept.append(z)
+        zero_space = kept
+        if not zero_space:
+            if not explicit_zero:
+                plans.append(rejected(
+                    dp, tp, "no compatible ZeRO level at this width"
+                ))
+            continue
+        # Level-3 pricing inputs: the fully-sharded layout's resident
+        # bytes, its transient gathered window, and the split of the
+        # grad sync into replicated leaves (psum, 2(dp-1)/dp) vs
+        # gathered leaves (reduce_scatter of the FULL grads, (dp-1)/dp).
+        # The per-step all_gather itself rides on gather_lane3 — charged
+        # ONCE per step (the compiled gather_schedule='block' gathers
+        # before the tick scan), never scaled by chunks.
+        pbl3 = gwin3 = gfull3 = 0
+        cell_comm_probe3 = gather_lane3 = grad_sync_lane3 = 0.0
+        if 3 in zero_space and layout3 is not None:
+            pbl3 = layout3.param_bytes_local
+            gwin3 = layout3.gathered_window_bytes
+            gfull3 = layout3.gather_full_bytes
+            cell_comm_probe3 = layout3.comm_bytes()
+            gather_lane3 = float(layout3.gather_comm_bytes())
+            rest3 = max(pbl3 - layout3.gather_stored_bytes, 0)
+            grad_sync_lane3 = (
+                (2.0 * (dp - 1) / dp * rest3 + (dp - 1) / dp * gfull3)
+                if dp > 1 else 0.0
+            )
 
         for chunks in spmd_chunk_options(
             pipe, B, chunks_options, dp=dp, ep=ep
@@ -745,6 +841,7 @@ def _plan_spmd(
             mb_bytes = tune.tree_bytes(mb_spec) if mb_spec is not None else 0
             mb_rows = B // (chunks * dp * ep)
             cell_comm = cell_comm_probe * mb_rows / probe_rows
+            cell_comm3 = cell_comm_probe3 * mb_rows / probe_rows
             atom_cache: Dict[Any, Optional[Tuple[float, float]]] = {}
             resid_cache: Dict[Any, Optional[int]] = {}
 
@@ -951,13 +1048,12 @@ def _plan_spmd(
                                     priced_by = (
                                         "measured" if m_exact else "mixed"
                                     )
-                    lane_comm = chunks * cell_comm + grad_sync_lane
-                    comm_flops = shd.COMM_FLOPS_PER_BYTE * lane_comm
                     # param_scale's head-room splits into the gradient
-                    # tree (~1x params, per-lane EITHER WAY — the ZeRO
-                    # update still consumes full grads) and the
-                    # optimizer moments (the rest) — ONLY the moments
-                    # shard over dp under zero=True.
+                    # tree (~1x params) and the optimizer moments (the
+                    # rest).  Level 1 shards ONLY the moments over dp;
+                    # level 3 stores params, grads AND moments at the
+                    # fsdp layout (everything scales with the SHARDED
+                    # param bytes) plus the transient gathered window.
                     grad_share = param_bytes * min(
                         max(param_scale - 1.0, 0.0), 1.0
                     )
@@ -965,15 +1061,36 @@ def _plan_spmd(
                         param_scale - 2.0, 0.0
                     )
                     for zero in zero_space:
-                        opt_bytes = int(
-                            moment_total / (dp if zero else 1)
-                        )
-                        fixed = int(
-                            param_bytes + grad_share + opt_bytes
-                            + ticks * mb_bytes
-                            + send_ahead_carry
-                            + overhead_bytes
-                        )
+                        if zero == 3:
+                            opt_bytes = int(
+                                pbl3 * max(param_scale - 2.0, 0.0)
+                            )
+                            fixed = int(
+                                pbl3 + gwin3
+                                + pbl3 * min(
+                                    max(param_scale - 1.0, 0.0), 1.0
+                                )
+                                + opt_bytes
+                                + ticks * mb_bytes
+                                + send_ahead_carry
+                                + overhead_bytes
+                            )
+                            lane_comm = (
+                                chunks * cell_comm3
+                                + grad_sync_lane3 + gather_lane3
+                            )
+                        else:
+                            opt_bytes = int(
+                                moment_total / (dp if zero else 1)
+                            )
+                            fixed = int(
+                                param_bytes + grad_share + opt_bytes
+                                + ticks * mb_bytes
+                                + send_ahead_carry
+                                + overhead_bytes
+                            )
+                            lane_comm = chunks * cell_comm + grad_sync_lane
+                        comm_flops = shd.COMM_FLOPS_PER_BYTE * lane_comm
                         hwm = cert.high_water + fixed
                         feasible = hwm <= hbm_budget_bytes
                         for K in mega_space:
@@ -1269,7 +1386,7 @@ def plan(
     megastep_options: Optional[Sequence[int]] = None,
     steps: Optional[int] = None,
     mesh_options: Optional[Sequence[Sequence[int]]] = None,
-    zero_options: Optional[Sequence[bool]] = None,
+    zero_options: Optional[Sequence[Union[bool, int]]] = None,
     overhead_bytes: Optional[int] = None,
     param_scale: Optional[float] = None,
     real_token_fraction: float = 1.0,
@@ -1319,9 +1436,19 @@ def plan(
     volume (required tp psums from the propagation + the dp gradient
     all-reduce) is priced into the lane time at
     :data:`~torchgpipe_tpu.analysis.sharding.COMM_FLOPS_PER_BYTE`.
-    ``zero_options`` controls the ZeRO optimizer-state axis (default:
-    both at dp > 1): ``zero=True`` candidates charge optimizer state
-    ÷ N_dp in the memory certification (``Plan.opt_state_bytes``).
+    ``zero_options`` controls the ZeRO sharding-level axis (levels
+    ``0``/``1``/``3``; bools normalize ``False`` → 0, ``True`` → 1;
+    default ``[0, 1]`` at dp > 1): level-1 candidates charge optimizer
+    state ÷ N_dp in the memory certification
+    (``Plan.opt_state_bytes``); level-3 candidates are priced against
+    the FULLY-SHARDED (fsdp / gather-at-use) layout — resident
+    params/grads/state ÷ N_dp plus the transient gathered window from
+    the sharding verifier's gather accounting, with the per-step
+    ``all_gather`` and the reduce-scatter grad sync charged into the
+    lane time at :data:`~torchgpipe_tpu.analysis.sharding.
+    COMM_FLOPS_PER_BYTE`.  ``apply_plan`` on a level-3 winner flips
+    ``fsdp=True``; an fsdp pipe's own candidates carry level 3
+    natively (its plain update IS the zero=3 update).
 
     ``pipe`` is a :class:`~torchgpipe_tpu.spmd.SpmdGPipe` or
     :class:`~torchgpipe_tpu.gpipe.GPipe`; ``batch`` a representative
@@ -1435,6 +1562,11 @@ def apply_plan(pipe: Any, chosen: Plan) -> Any:
             "mesh — build one with make_mesh(n_stages, dp, tp=tp) and "
             "construct the pipe on it, then apply the plan there"
         )
+    # Level 3 is a STORAGE-layout decision: applying it flips fsdp on
+    # (params/grads/state stored sharded, gathered at use).  Levels 0/1
+    # keep the pipe's own storage layout; zero_update carries the
+    # historical bool spelling for them so round-trips stay stable.
+    level = int(chosen.zero)
     return dataclasses.replace(
         pipe,
         schedule=chosen.schedule,
@@ -1443,7 +1575,8 @@ def apply_plan(pipe: Any, chosen: Plan) -> Any:
         chunks=chosen.chunks,
         megastep=chosen.megastep,
         scan_unroll=chosen.scan_unroll,
-        zero_update=chosen.zero,
+        fsdp=(True if level == 3 else pipe.fsdp),
+        zero_update=(3 if level == 3 else bool(level)),
     )
 
 
@@ -1523,22 +1656,41 @@ def _unroll_key(u: Any) -> Any:
     return "full" if u is True else int(u)
 
 
+def effective_zero_level(pipe: Any) -> int:
+    """The ZeRO level an SPMD pipe ACTUALLY runs, in the planner's
+    ``Plan.zero`` vocabulary: bools resolve through the layout
+    (``True`` → 3 under fsdp, else 1), and an fsdp pipe at dp > 1 runs
+    the zero=3 program even when ``zero_update`` is 0/``False`` (the
+    plain update against the stored-sharded layout IS the zero=3
+    update) — matching the planner's 0 → 3 relabel on fsdp pipes."""
+    own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
+    zu = getattr(pipe, "zero_update", False)
+    fsdp = bool(getattr(pipe, "fsdp", False))
+    if isinstance(zu, bool):
+        level = (3 if fsdp else 1) if zu else 0
+    else:
+        level = int(zu)
+    if fsdp and own_dp > 1 and level == 0:
+        level = 3
+    return level
+
+
 def _config_of(pipe: Any) -> Tuple:
     """The (schedule, checkpoint, policy-label, chunks, balance,
-    megastep, scan_unroll-key, dp, tp, zero) key a pipe actually runs —
-    matched against the planner's candidates."""
+    megastep, scan_unroll-key, dp, tp, zero-level) key a pipe actually
+    runs — matched against the planner's candidates."""
     from torchgpipe_tpu.gpipe import GPipe
 
     if isinstance(pipe, GPipe):
         return (pipe.schedule, pipe.checkpoint, None, pipe.chunks,
                 tuple(pipe.balance), getattr(pipe, "megastep", 1),
-                _unroll_key(1), 1, 1, False)
+                _unroll_key(1), 1, 1, 0)
     own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
     own_tp = pipe.mesh.shape[pipe.tp_axis] if pipe.tp_axis else 1
     return (pipe.schedule, pipe.checkpoint, _spmd_policy_label(pipe),
             pipe.chunks, None, pipe.megastep,
             _unroll_key(pipe.scan_unroll), own_dp, own_tp,
-            bool(getattr(pipe, "zero_update", False)))
+            effective_zero_level(pipe))
 
 
 def check_plan_drift(trace: Any) -> List[Finding]:

@@ -801,6 +801,16 @@ def _register_sharding_rules() -> None:
         "induce no resharding collective inside the step (WARNING)",
         shd.check_implicit_reshard,
     ))
+    RULES.append(Rule(
+        "redundant-gather",
+        "a gather-at-use (ZeRO-3/fsdp storage) leaf must not be "
+        "re-gathered per use-site inside one block body when no write "
+        "intervenes (WARNING under gather_schedule='use'), and the "
+        "gathered window alone must fit the declared hbm_budget_bytes "
+        "(ERROR — sharded storage cannot save a layout whose transient "
+        "gathered copies don't fit)",
+        shd.check_redundant_gather,
+    ))
 
 
 _register_sharding_rules()

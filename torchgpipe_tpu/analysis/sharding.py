@@ -204,7 +204,12 @@ class CommEvent:
     destroyed by reshape/slice — the ``implicit-reshard`` hazard),
     ``collective`` (an explicit collective in the program, priced),
     ``opaque`` (an unmodeled primitive consumed sharded inputs; the
-    analysis dropped to replicated conservatively, unpriced)."""
+    analysis dropped to replicated conservatively, unpriced),
+    ``gather`` (a DECLARED gather-at-use materialization: a ZeRO-3/fsdp
+    storage leaf all-gathered before block compute — required and
+    priced, but once per STEP rather than per schedule cell, so it
+    lives in ``LayoutReport.gather_comm``, never in the per-cell
+    ``comm`` list the planner scales by chunks)."""
 
     kind: str
     axes: Tuple[str, ...]
@@ -768,6 +773,29 @@ class LayoutReport:
     # (accidental full replication) — structured, so callers (the 3D
     # planner's width rejection) never key off finding prose.
     unused_axes: List[str] = dataclasses.field(default_factory=list)
+    # ---- gather-at-use (ZeRO-3/fsdp storage layouts) accounting ----
+    # Param leaf paths whose rule declares gather-at-use axes.
+    gather_paths: List[str] = dataclasses.field(default_factory=list)
+    # Per gather-leaf use-site count inside the block jaxpr (how many
+    # eqns consume the leaf's invar) — the redundant-gather lint rule's
+    # signal under gather_schedule='use'.
+    gather_use_counts: Dict[str, int] = dataclasses.field(
+        default_factory=dict
+    )
+    # Per-rank bytes of the gather leaves in their sharded STORAGE
+    # layout (what ``param_bytes_local`` already counts them at) vs the
+    # gathered COMPUTE layout (storage x the gather axes' sizes).
+    gather_stored_bytes: int = 0
+    gather_full_bytes: int = 0
+    # The transient gathered window the live-interval memory model
+    # charges on top of the sharded residents: under
+    # gather_schedule='block' every gather leaf's gathered copy is live
+    # for the block's compute (sum); under 'use' only one gathered leaf
+    # is live at a time (max).
+    gathered_window_bytes: int = 0
+    # The declared gather collectives, priced per STEP — kept separate
+    # from ``comm`` (cell comm), which the planner scales by chunks.
+    gather_comm: List[CommEvent] = dataclasses.field(default_factory=list)
 
     def ok(self) -> bool:
         return not any(f.severity >= Severity.ERROR for f in self.findings)
@@ -778,6 +806,15 @@ class LayoutReport:
     def comm_bytes(self) -> float:
         return PropagationResult(
             findings=[], comm=self.comm, out_shardings=[]
+        ).comm_bytes(self.mesh)
+
+    def gather_comm_bytes(self) -> float:
+        """Priced per-step volume of the declared gather-at-use
+        collectives — same per-primitive pricing table as
+        :meth:`comm_bytes` (``collective_comm_bytes``'s ring
+        all_gather: (n-1)/n x gathered bytes)."""
+        return PropagationResult(
+            findings=[], comm=self.gather_comm, out_shardings=[]
         ).comm_bytes(self.mesh)
 
 
@@ -900,15 +937,27 @@ def _block_propagation(
     mesh: MeshSpec,
     x_spec: Pytree,
     jaxpr_cache: Optional[Dict[str, Any]] = None,
-) -> Tuple[Optional[PropagationResult], Optional[str]]:
+    gathers: Optional[Dict[str, Tuple[str, ...]]] = None,
+) -> Tuple[Optional[PropagationResult], Optional[str], Dict[str, int]]:
     """Trace the plain block abstractly and push the per-stage layout
-    through it.  Returns (result, stand-down note).  ``jaxpr_cache``
-    (the 3D planner's) reuses the traced jaxpr across candidate widths
-    — the trace is width-independent, only the propagation's mesh sizes
-    change."""
+    through it.  Returns (result, stand-down note, gather use counts).
+    ``jaxpr_cache`` (the 3D planner's) reuses the traced jaxpr across
+    candidate widths — the trace is width-independent, only the
+    propagation's mesh sizes change.
+
+    ``gathers`` (path -> gather-at-use axes, from
+    :meth:`RuleTable.resolve_layout`) drives the storage-vs-compute
+    distinction: a gather-at-use leaf enters the block jaxpr at its
+    GATHERED spec (the storage spec with the gather axes removed) — the
+    gather is a declared, priced collective, not an implicit reshard.
+    The returned use counts map each gather leaf's path to the number
+    of block-jaxpr equations consuming it (the ``redundant-gather``
+    lint signal under ``gather_schedule='use'``)."""
+    from torchgpipe_tpu.analysis.partition_rules import leaf_path
+
     blocks = params_spec.get("blocks") if isinstance(params_spec, dict) else None
     if blocks is None:
-        return None, "no stacked blocks to propagate through"
+        return None, "no stacked blocks to propagate through", {}
     stage_params = jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(tuple(a.shape[1:]), a.dtype), blocks
     )
@@ -916,31 +965,47 @@ def _block_propagation(
         specs.get("blocks") if isinstance(specs, dict) else None
     )
     if block_specs is None:
-        return None, "no resolved block specs"
+        return None, "no resolved block specs", {}
     dp_ax = getattr(pipe, "dp_axis", None)
     fsdp = bool(getattr(pipe, "fsdp", False))
+    is_p = lambda x: isinstance(x, P)  # noqa: E731
 
-    def stage_spec(s: P) -> P:
+    flat_bs, bs_tdef = jax.tree_util.tree_flatten_with_path(
+        block_specs, is_leaf=is_p
+    )
+    block_paths = ["blocks/" + leaf_path(kp) for kp, _ in flat_bs]
+    gaxes_list = [
+        tuple((gathers or {}).get(p, ())) for p in block_paths
+    ]
+    if fsdp and dp_ax is not None and not any(gaxes_list):
+        # Legacy fallback: an fsdp pipe whose table carries no gather
+        # attributes (a user-declared partition_rules table) — treat
+        # every dp entry as gathered-at-use, the pre-rule-attribute
+        # behavior.
+        gaxes_list = [(dp_ax,)] * len(flat_bs)
+
+    def stage_spec(s: P, gaxes: Tuple[str, ...]) -> P:
         entries = list(tuple(s)[1:])  # strip the stacked stage dim
-        if fsdp and dp_ax is not None:
-            # fsdp is a STORAGE layout: params are all-gathered over dp
-            # before the block consumes them, so the block-math layout
-            # drops the dp entries (the gather is the declared, priced
-            # collective — not an implicit reshard).
-            def drop_dp(e: Any) -> Any:
+        if gaxes:
+            # Gather-at-use STORAGE layout: the leaf is all-gathered
+            # over its gather axes before the block consumes it, so the
+            # block-math layout drops those entries (the gather is the
+            # declared, priced collective — not an implicit reshard).
+            def drop(e: Any) -> Any:
                 if e is None:
                     return None
                 if isinstance(e, tuple):
-                    kept = tuple(a for a in e if a != dp_ax)
+                    kept = tuple(a for a in e if a not in gaxes)
                     return kept if kept else None
-                return None if e == dp_ax else e
+                return None if e in gaxes else e
 
-            entries = [drop_dp(e) for e in entries]
+            entries = [drop(e) for e in entries]
         return P(*entries)
 
-    stage_specs = jax.tree_util.tree_map(
-        stage_spec, block_specs, is_leaf=lambda x: isinstance(x, P)
-    )
+    stage_specs_flat = [
+        stage_spec(s, g) for (_, s), g in zip(flat_bs, gaxes_list)
+    ]
+    stage_specs = jax.tree_util.tree_unflatten(bs_tdef, stage_specs_flat)
 
     def f(p: Pytree, x: Pytree) -> Pytree:
         return pipe._block_fn_plain(p, x, None, 1.0, True)
@@ -955,15 +1020,27 @@ def _block_propagation(
             return None, (
                 "block propagation stood down (trace failed: "
                 f"{type(e).__name__}) — structural checks still apply"
-            )
+            ), {}
         if jaxpr_cache is not None:
             jaxpr_cache["block_jaxpr"] = closed
+    # Per-gather-leaf use-site counts: how many equations of the block
+    # jaxpr consume each param invar (a sub-jaxpr call counts once —
+    # the gather schedule's unit is the outer scan body).
+    body = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    use_counts: Dict[str, int] = {}
+    param_var_paths = {
+        id(v): p for v, p in zip(body.invars, block_paths)
+    }
+    raw_counts: Dict[int, int] = {}
+    for eqn in body.eqns:
+        for v in eqn.invars:
+            if id(v) in param_var_paths:
+                raw_counts[id(v)] = raw_counts.get(id(v), 0) + 1
+    for vid, p in param_var_paths.items():
+        use_counts[p] = raw_counts.get(vid, 0)
     dp = getattr(pipe, "dp_axis", None)
     in_specs: List[Any] = []
-    flat_specs = jax.tree_util.tree_leaves(
-        stage_specs, is_leaf=lambda x: isinstance(x, P)
-    )
-    in_specs.extend(flat_specs)
+    in_specs.extend(stage_specs_flat)
     for leaf in jax.tree_util.tree_leaves(x_spec):
         nd = len(getattr(leaf, "shape", ()))
         sh = [()] * nd
@@ -1006,7 +1083,7 @@ def _block_propagation(
                     "offending param"
                 ),
             ))
-    return result, None
+    return result, None, use_counts
 
 
 def verify_layout(
@@ -1038,8 +1115,18 @@ def verify_layout(
     mesh = MeshSpec.from_mesh(pipe.mesh)
     if mesh_sizes:
         mesh = mesh.with_sizes(**dict(mesh_sizes))
-    table = pipe.rule_table(params_spec)
-    specs, unmatched = table.resolve(params_spec)
+    # Emit the table at the CANDIDATE dp width: the fsdp dim chooser's
+    # divisibility test must run against the width being verified, not
+    # the machine's (the planner searches widths the host doesn't have).
+    dp_ax = getattr(pipe, "dp_axis", None)
+    try:
+        table = pipe.rule_table(
+            params_spec,
+            dp_size=mesh.size(dp_ax) if dp_ax is not None else None,
+        )
+    except TypeError:  # a pipe whose rule_table predates dp_size
+        table = pipe.rule_table(params_spec)
+    specs, gathers, unmatched = table.resolve_layout(params_spec)
     findings = _coverage_findings(
         table, unmatched, specs, params_spec, mesh, path="layout"
     )
@@ -1050,6 +1137,7 @@ def verify_layout(
     comm: List[CommEvent] = []
     notes: List[str] = []
     propagated = False
+    use_counts: Dict[str, int] = {}
     if propagate and not unmatched:
         x_for_block = (
             jaxpr_cache.get("block_in") if jaxpr_cache is not None else None
@@ -1059,8 +1147,9 @@ def verify_layout(
             if jaxpr_cache is not None and x_for_block is not None:
                 jaxpr_cache["block_in"] = x_for_block
         if x_for_block is not None:
-            result, note = _block_propagation(
-                pipe, params_spec, specs, mesh, x_for_block, jaxpr_cache
+            result, note, use_counts = _block_propagation(
+                pipe, params_spec, specs, mesh, x_for_block, jaxpr_cache,
+                gathers=gathers,
             )
             if note:
                 notes.append(note)
@@ -1068,6 +1157,9 @@ def verify_layout(
                 propagated = True
                 findings.extend(result.findings)
                 comm.extend(result.comm)
+    gacct = _gather_accounting(
+        pipe, params_spec, specs, gathers, mesh, use_counts
+    )
     return LayoutReport(
         mesh=mesh,
         table=table,
@@ -1079,7 +1171,78 @@ def verify_layout(
         propagated=propagated,
         notes=notes,
         unused_axes=unused_axes,
+        gather_paths=gacct[0],
+        gather_use_counts={
+            p: use_counts.get(p, 0) for p in gacct[0]
+        },
+        gather_stored_bytes=gacct[1],
+        gather_full_bytes=gacct[2],
+        gathered_window_bytes=gacct[3],
+        gather_comm=gacct[4],
     )
+
+
+def _gather_accounting(
+    pipe: Any,
+    params_spec: Pytree,
+    specs: Pytree,
+    gathers: Dict[str, Tuple[str, ...]],
+    mesh: MeshSpec,
+    use_counts: Dict[str, int],
+) -> Tuple[List[str], int, int, int, List[CommEvent]]:
+    """Storage-vs-compute byte accounting for the gather-at-use leaves:
+    ``(paths, stored_bytes, full_bytes, window_bytes, gather_comm)``.
+
+    Each gather leaf is resident per-rank at its sharded STORAGE bytes
+    (``param_bytes_local`` counts it there) and transiently materialized
+    at its gathered COMPUTE bytes.  The window is schedule-dependent:
+    ``gather_schedule='block'`` keeps every gathered copy live through
+    the block's compute (sum); ``'use'`` re-gathers per use-site, so
+    only one gathered leaf is live at a time (max) — at the price of
+    use-count x the all_gather bytes, which is exactly what the emitted
+    ``gather`` comm events carry."""
+    gather_paths = [p for p, g in gathers.items() if g]
+    if not gather_paths:
+        return [], 0, 0, 0, []
+    schedule = getattr(pipe, "gather_schedule", "block")
+    leaf_pairs = dict(tree_leaf_paths(params_spec))
+    spec_pairs = dict(tree_leaf_paths(specs))
+    stored_total = 0
+    full_total = 0
+    per_leaf_full: List[int] = []
+    events: List[CommEvent] = []
+    for p in gather_paths:
+        leaf, spec = leaf_pairs.get(p), spec_pairs.get(p)
+        if leaf is None or not isinstance(spec, P):
+            continue
+        stored = leaf_layout_bytes(leaf, spec, mesh)
+        mult = 1
+        for a in gathers[p]:
+            mult *= mesh.size(a)
+        full = stored * mult
+        stored_total += stored
+        full_total += full
+        per_leaf_full.append(full)
+        n_gathers = (
+            max(use_counts.get(p, 1), 1) if schedule == "use" else 1
+        )
+        events.append(CommEvent(
+            kind="gather",
+            axes=tuple(gathers[p]),
+            bytes=stored * n_gathers,
+            eqn_index=-1,
+            primitive="all_gather",
+            path=f"layout/{p}",
+            detail=(
+                f"gather-at-use storage leaf: {n_gathers} all_gather(s) "
+                f"per step (gather_schedule={schedule!r})"
+            ),
+        ))
+    window = (
+        full_total if schedule == "block"
+        else max(per_leaf_full, default=0)
+    )
+    return gather_paths, stored_total, full_total, window, events
 
 
 def _block_input_spec(pipe: Any, sample_input: Pytree) -> Optional[Pytree]:
@@ -1110,6 +1273,73 @@ def _block_input_spec(pipe: Any, sample_input: Pytree) -> Optional[Pytree]:
 # --------------------------------------------------------------------- #
 # the implicit-reshard lint rule                                        #
 # --------------------------------------------------------------------- #
+
+
+def check_redundant_gather(trace: Any) -> List[Finding]:
+    """Lint rule: the gather-at-use hygiene checks.
+
+    WARNING when a gather-at-use (ZeRO-3/fsdp storage) leaf would be
+    gathered MORE THAN ONCE inside a single block scan body under
+    ``gather_schedule='use'`` — params are read-only inside the
+    functional block (no interleaving write can invalidate the gathered
+    copy), so every re-gather after the first is pure wasted all_gather
+    traffic; gather once per block instead.  ERROR when the layout's
+    gathered window ALONE exceeds the pipe's declared
+    ``hbm_budget_bytes`` — sharding storage cannot save a model whose
+    transient gathered copies don't fit.  Stands down for non-SPMD
+    pipes and for layouts with no gather-at-use leaves."""
+    if trace.engine != "spmd":
+        return []
+    pipe = trace.pipe
+    if not (
+        getattr(pipe, "fsdp", False)
+        or getattr(pipe, "partition_rules", None) is not None
+    ):
+        return []
+    try:
+        report = verify_layout(pipe, trace.x_spec, propagate=True)
+    except Exception:  # noqa: BLE001 - the verifier stands down, not lint
+        return []
+    if not report.gather_paths:
+        return []
+    out: List[Finding] = []
+    if getattr(pipe, "gather_schedule", "block") == "use":
+        for p in report.gather_paths:
+            n = report.gather_use_counts.get(p, 0)
+            if n > 1:
+                out.append(Finding(
+                    rule="redundant-gather",
+                    severity=Severity.WARNING,
+                    path=f"layout/{p}",
+                    message=(
+                        f"gather-at-use leaf {p!r} is consumed by {n} "
+                        "equations of the block body under "
+                        "gather_schedule='use' — each use re-gathers it "
+                        "with NO interleaving write (block params are "
+                        "read-only), so every gather after the first is "
+                        "wasted all_gather traffic; use "
+                        "gather_schedule='block' to gather once per "
+                        "block body"
+                    ),
+                ))
+    budget = getattr(pipe, "hbm_budget_bytes", None)
+    if budget is not None and report.gathered_window_bytes > budget:
+        out.append(Finding(
+            rule="redundant-gather",
+            severity=Severity.ERROR,
+            path="layout",
+            message=(
+                f"the ZeRO-3 gathered window alone — "
+                f"{report.gathered_window_bytes} bytes of transiently "
+                "materialized gather-at-use params "
+                f"(gather_schedule={pipe.gather_schedule!r}) — exceeds "
+                f"the declared HBM budget {budget} bytes: sharded "
+                "STORAGE cannot save a layout whose gathered compute "
+                "copies don't fit; shard the compute layout too (tp) or "
+                "raise the budget"
+            ),
+        ))
+    return out
 
 
 def check_implicit_reshard(trace: Any) -> List[Finding]:

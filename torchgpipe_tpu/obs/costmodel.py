@@ -85,8 +85,11 @@ def config_fingerprint(pipe: Any) -> Dict[str, Any]:
             "megastep": int(getattr(pipe, "megastep", 1) or 1),
             "dp": 1,
             "tp": 1,
+            "zero": 0,
         }
-    from torchgpipe_tpu.analysis.planner import _spmd_policy_label
+    from torchgpipe_tpu.analysis.planner import (
+        _spmd_policy_label, effective_zero_level,
+    )
 
     own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
     own_tp = pipe.mesh.shape[pipe.tp_axis] if pipe.tp_axis else 1
@@ -101,6 +104,11 @@ def config_fingerprint(pipe: Any) -> Dict[str, Any]:
         "megastep": int(pipe.megastep),
         "dp": int(own_dp),
         "tp": int(own_tp),
+        # The EFFECTIVE ZeRO level (planner Plan.zero vocabulary): a
+        # level-3 (fsdp) relayout changes the step's collective
+        # structure, so a model measured replicated must read as STALE
+        # against a fully-sharded pipe and vice versa.
+        "zero": effective_zero_level(pipe),
     }
 
 

@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -549,14 +549,39 @@ class SpmdGPipe:
     # None (default): the engine EMITS the equivalent table from its
     # structural declarations — see :meth:`rule_table`.
     partition_rules: Any = None
-    # ZeRO-style sharded optimizer update (arXiv:2004.13336): the
-    # default for :meth:`make_train_step`'s ``zero=`` — optimizer state
-    # partitioned over the dp axis (each data-parallel lane stores and
-    # updates 1/N_dp of every state leaf), updated params all-gathered
-    # at apply.  Bitwise-equal to the unsharded update for elementwise
-    # optimizers (adam/adamw/sgd); declared on the pipe so the planner's
-    # memory certification sees the configured optimizer layout.
-    zero_update: bool = False
+    # ZeRO-style sharded optimizer update (arXiv:2004.13336 /
+    # arXiv:1910.02054): the default for :meth:`make_train_step`'s
+    # ``zero=`` — a LEVEL, not a flag (``bool`` accepted for
+    # compatibility and normalized by :meth:`_zero_level`):
+    #   0 / False  — replicated optimizer state, plain update;
+    #   1 / True   — optimizer state partitioned over the dp axis (each
+    #                data-parallel lane stores and updates 1/N_dp of
+    #                every state leaf), updated params all-gathered at
+    #                apply; needs dp-replicated params;
+    #   3          — fully-sharded (ZeRO-3/fsdp): params, grads AND
+    #                optimizer state all live sharded over dp
+    #                (gather-at-use storage layout); requires
+    #                ``fsdp=True`` — the update itself is the plain
+    #                elementwise apply, which GSPMD keeps sharded
+    #                end-to-end because grads exit the step in the fsdp
+    #                storage layout (the all_gather's transpose IS the
+    #                reduce-scatter).
+    # Bitwise-equal to the unsharded update for elementwise optimizers
+    # (adam/adamw/sgd) at every level; declared on the pipe so the
+    # planner's memory certification sees the configured optimizer
+    # layout.
+    zero_update: Union[bool, int] = False
+    # How the engine materializes gather-at-use (ZeRO-3/fsdp) params:
+    # 'block' (default) — all params are gathered ONCE per block scan
+    # body and the gathered copies are live for the block's compute
+    # window (what ``_gather_fsdp`` compiles today); 'use' — modeled
+    # per-use-site gathering (each consuming eqn re-gathers), trading
+    # repeated all_gather bytes for a smaller transient window.  The
+    # static stack (sharding verifier's gather schedule accounting, the
+    # ``redundant-gather`` lint rule, the planner's gathered-window
+    # memory term) prices both; the compiled program currently always
+    # uses the 'block' shape.
+    gather_schedule: str = "block"
 
     def __repr__(self) -> str:
         axes = {
@@ -573,6 +598,7 @@ class SpmdGPipe:
                 ("send_ahead", self.send_ahead, True),
                 ("megastep", self.megastep, 1),
                 ("zero_update", self.zero_update, False),
+                ("gather_schedule", self.gather_schedule, "block"),
             )
             if v != default
         )
@@ -680,6 +706,13 @@ class SpmdGPipe:
                 "fsdp + ep is not supported: expert weights are already "
                 "sharded over ep; shard the rest with tp instead"
             )
+        if self.gather_schedule not in ("block", "use"):
+            raise ValueError(
+                "gather_schedule must be 'block' (gather each param once "
+                "per block scan body) or 'use' (model per-use-site "
+                f"gathering), got {self.gather_schedule!r}"
+            )
+        self._zero_level(self.zero_update)  # validate the declared level
         if self.sp_axis is not None and self.loss_reduction is None:
             raise ValueError(
                 "sequence parallelism needs a batch/token-decomposable loss: "
@@ -866,10 +899,15 @@ class SpmdGPipe:
     # FSDP (ZeRO-3-style parameter sharding over dp)                     #
     # ------------------------------------------------------------------ #
 
-    def _ensure_fsdp(self, blocks: Pytree) -> None:
-        if not self.fsdp or self._fsdp_dims is not None:
-            return
-        dp = self.mesh.shape[self.dp_axis]
+    def _fsdp_layout(
+        self, blocks: Pytree, dp: int
+    ) -> Tuple[Pytree, Pytree]:
+        """The fsdp storage layout at data-parallel width ``dp``: per
+        block leaf, the dim sharded over dp (-1 = replicated) and the
+        augmented storage specs.  Pure in ``dp`` so the planner can
+        evaluate candidate mesh widths that differ from the real mesh
+        (divisibility is checked at the CANDIDATE width, not the
+        machine's)."""
         base = self._blocks_leaf_specs(blocks)
         is_p = lambda x: isinstance(x, P)  # noqa: E731
 
@@ -883,9 +921,7 @@ class SpmdGPipe:
                     return i
             return -1
 
-        self._fsdp_dims = jax.tree_util.tree_map(
-            choose, base, blocks, is_leaf=is_p
-        )
+        dims = jax.tree_util.tree_map(choose, base, blocks, is_leaf=is_p)
 
         def augment(spec, dim):
             if dim < 0:
@@ -894,9 +930,14 @@ class SpmdGPipe:
             parts[dim] = self.dp_axis
             return P(*parts)
 
-        self._fsdp_specs = jax.tree_util.tree_map(
-            augment, base, self._fsdp_dims, is_leaf=is_p
-        )
+        specs = jax.tree_util.tree_map(augment, base, dims, is_leaf=is_p)
+        return dims, specs
+
+    def _ensure_fsdp(self, blocks: Pytree) -> None:
+        if not self.fsdp or self._fsdp_dims is not None:
+            return
+        dp = self.mesh.shape[self.dp_axis]
+        self._fsdp_dims, self._fsdp_specs = self._fsdp_layout(blocks, dp)
 
     def _gather_fsdp(self, blocks_local: Pytree) -> Pytree:
         """Reassemble full block params from dp shards (inside shard_map).
@@ -1256,11 +1297,24 @@ class SpmdGPipe:
     # anything else through untouched (a caller-managed EMA tree, say).
     _LAYOUT_KEYS: Tuple[str, ...] = ("blocks", "pre", "post", "loss")
 
-    def _structural_specs(self, params: dict) -> dict:
-        """Per-leaf PartitionSpec tree from the structural declarations
+    def _structural_layout(
+        self, params: dict, dp_size: Optional[int] = None
+    ) -> Tuple[dict, dict]:
+        """``(specs, gathers)`` trees from the structural declarations
         (the pre-rule-table layout: stacking prefix + meta['param_specs']
-        + fsdp augmentation) — what :meth:`rule_table` emits as rules."""
+        + fsdp augmentation) — what :meth:`rule_table` emits as rules.
+
+        ``specs`` is the STORAGE layout (fsdp leaves carry their
+        ``P(dp, ...)`` augmentation); ``gathers`` maps leaf paths
+        (``"blocks/wq"``) to gather-at-use axis tuples: ``(dp_axis,)``
+        for each fsdp-sharded leaf, ``()`` everywhere else.  ``dp_size``
+        overrides the dp width the fsdp dim chooser checks divisibility
+        against (the planner's candidate meshes differ from the real
+        one); None = the real mesh's dp axis size."""
+        from torchgpipe_tpu.analysis import partition_rules as pr
+
         specs: dict = {}
+        gathers: Dict[str, Tuple[str, ...]] = {}
         prefixes = {
             "blocks": self._blocks_spec,
             "pre": self._pre_spec,
@@ -1271,13 +1325,33 @@ class SpmdGPipe:
             if k not in prefixes:
                 continue
             if k == "blocks" and self.fsdp:
-                self._ensure_fsdp(params[k])
-                specs[k] = self._fsdp_specs
+                real_dp = self.mesh.shape[self.dp_axis]
+                if dp_size is None or dp_size == real_dp:
+                    self._ensure_fsdp(params[k])
+                    dims, specs[k] = self._fsdp_dims, self._fsdp_specs
+                else:
+                    dims, specs[k] = self._fsdp_layout(params[k], dp_size)
+                paths = [p for p, _ in pr.tree_leaf_paths(params[k])]
+                for p, dim in zip(paths, jax.tree_util.tree_leaves(dims)):
+                    gathers[f"{k}/{p}"] = (
+                        (self.dp_axis,) if dim >= 0 else ()
+                    )
             else:
                 specs[k] = self._leaf_specs(prefixes[k], params[k], k)
-        return specs
+                for p, _ in pr.tree_leaf_paths(params[k]):
+                    gathers[f"{k}/{p}"] = ()
+        return specs, gathers
 
-    def rule_table(self, params: Pytree) -> Any:
+    def _structural_specs(
+        self, params: dict, dp_size: Optional[int] = None
+    ) -> dict:
+        """Per-leaf PartitionSpec STORAGE tree — see
+        :meth:`_structural_layout` (this is its first result)."""
+        return self._structural_layout(params, dp_size=dp_size)[0]
+
+    def rule_table(
+        self, params: Pytree, dp_size: Optional[int] = None
+    ) -> Any:
         """The pipe's param layout as an ordered regex → PartitionSpec
         rule table (:mod:`torchgpipe_tpu.analysis.partition_rules`).
 
@@ -1286,17 +1360,24 @@ class SpmdGPipe:
         prefix over ``pp``, ``meta['param_specs']`` leaf sharding, fsdp
         augmentation) — resolving it against the same params reproduces
         the structural layout leaf-for-leaf, which is the round-trip
-        the unified-layer tests pin.  ``place()`` and the static
+        the unified-layer tests pin.  The ONE table covers every layout
+        level: replicated and ZeRO-1 leaves are plain rules, ZeRO-3/fsdp
+        leaves are storage rules ``P(dp, ...)`` carrying the
+        ``gather``-at-use attribute.  ``place()`` and the static
         sharding verifier both resolve through this table, so it IS the
-        layout, not documentation of it."""
+        layout, not documentation of it.  ``dp_size`` overrides the dp
+        width used for the fsdp dim chooser (planner candidate meshes);
+        ignored for declared :attr:`partition_rules`."""
         from torchgpipe_tpu.analysis import partition_rules as pr
 
         if self.partition_rules is not None:
             return pr.as_rule_table(self.partition_rules)
+        specs, gathers = self._structural_layout(params, dp_size=dp_size)
         return pr.rules_from_specs(
-            self._structural_specs(params),
+            specs,
             name=f"spmd:{self.block.name}",
             note="emitted by SpmdGPipe",
+            gathers=gathers,
         )
 
     def place(self, params: dict) -> dict:
@@ -3332,19 +3413,67 @@ class SpmdGPipe:
                 axes.append(ax)
         return tuple(axes)
 
-    def _zero_check(self) -> None:
+    def _zero_level(self, zero: Any = None) -> int:
+        """Normalize a ``zero=`` argument to a ZeRO LEVEL (0, 1 or 3).
+
+        ``None`` reads the pipe's declared :attr:`zero_update`; a bool
+        maps ``False -> 0`` and ``True`` to the natural level for the
+        layout (3 under fsdp — params are already gather-at-use sharded,
+        so the fully-sharded update is the only coherent one — else 1).
+        Levels and layouts must agree: ZeRO-1's segment math needs
+        dp-REPLICATED params, and ZeRO-3 IS the fsdp storage layout's
+        update, so ``zero=1`` under fsdp and ``zero=3`` without fsdp are
+        both refused didactically (there is no ZeRO-2 here: grads
+        already leave the step reduce-scattered under fsdp, and without
+        fsdp the grad buffer is transient inside one compiled program —
+        nothing to shard)."""
+        if zero is None:
+            zero = self.zero_update
+        if isinstance(zero, bool):
+            level = ((3 if self.fsdp else 1) if zero else 0)
+        elif isinstance(zero, int):
+            level = zero
+        else:
+            raise ValueError(
+                f"zero must be a bool or a ZeRO level int, got {zero!r}"
+            )
+        if level not in (0, 1, 3):
+            raise ValueError(
+                f"zero={level} is not a supported ZeRO level: use 0/False "
+                "(replicated update), 1/True (optimizer state sharded "
+                "over dp), or 3 (fully-sharded params+grads+state, "
+                "requires fsdp=True).  Level 2 does not exist here: "
+                "gradients already leave the fsdp step reduce-scattered, "
+                "and without fsdp the grad tree is transient inside the "
+                "fused step program"
+            )
+        if level == 1 and self.fsdp:
+            raise ValueError(
+                "zero=1 under fsdp is incoherent: the ZeRO-1 segment math "
+                "assumes dp-REPLICATED params, but fsdp stores them "
+                "sharded over dp (their optimizer state is already "
+                "dp-partitioned alongside).  Use zero=3 (or zero=True, "
+                "which resolves to 3 under fsdp)"
+            )
+        if level == 3 and not self.fsdp:
+            raise ValueError(
+                "zero=3 IS the fully-sharded (gather-at-use) layout's "
+                "update: params, grads and optimizer state all live "
+                "sharded over dp.  Construct the pipe with fsdp=True to "
+                "get that storage layout (zero=1 shards optimizer state "
+                "only and works with replicated params)"
+            )
+        return level
+
+    def _zero_check(self, level: int = 1) -> None:
+        if level == 0:
+            return
         if self.dp_axis is None or self.mesh.shape[self.dp_axis] < 2:
             raise ValueError(
                 "the ZeRO-sharded optimizer update partitions state over "
                 "the data-parallel lanes: it needs dp_axis set and a dp "
                 "mesh axis of size >= 2 (arXiv:2004.13336 — with one "
                 "replica there is nothing to shard; use zero=False)"
-            )
-        if self.fsdp:
-            raise ValueError(
-                "zero=True has nothing to add under fsdp: parameters "
-                "(and therefore optimizer state built beside them) are "
-                "already sharded over dp — use fsdp alone"
             )
 
     def _zero_machinery(
@@ -3369,11 +3498,12 @@ class SpmdGPipe:
         param_specs = match_partition_rules(self.rule_table(params), params)
         zaxes = self._zero_axes()
         dpn = int(self.mesh.shape[self.dp_axis])
-        # The segment math assumes every lane's local param shard is
-        # dp-REPLICATED (each dp lane slices its segment of the same
+        # The ZeRO-1 segment math assumes every lane's local param shard
+        # is dp-REPLICATED (each dp lane slices its segment of the same
         # data); a layout already sharding a leaf over dp would make
         # to_full reassemble a mixture of different lanes' data —
-        # silently wrong training, refused like fsdp is.
+        # silently wrong training.  (fsdp layouts take the zero=3 path,
+        # which never builds segments — see _make_apply_update.)
         for path, spec in _rule_leaf_specs(param_specs):
             entries = tuple(spec)
             for e in entries:
@@ -3470,15 +3600,32 @@ class SpmdGPipe:
 
         return param_specs, state_specs, local_init, local_update
 
-    def zero_opt_state(self, optimizer: Any, params: Pytree) -> Pytree:
+    def zero_opt_state(
+        self, optimizer: Any, params: Pytree, zero: Any = None
+    ) -> Pytree:
         """Initialize dp-SHARDED optimizer state for ``optimizer`` (the
         ZeRO twin of ``place_tree(optimizer.init(params))``): each
         data-parallel lane stores 1/N_dp of every state leaf.  Pair with
-        ``make_train_step(optimizer, zero=True)``; the update is
-        bitwise-equal to the unsharded one for elementwise optimizers
-        (adam/adamw/sgd — anything without cross-element coupling like
-        global-norm clipping)."""
-        self._zero_check()
+        ``make_train_step(optimizer, zero=...)`` at the same level; the
+        update is bitwise-equal to the unsharded one for elementwise
+        optimizers (adam/adamw/sgd — anything without cross-element
+        coupling like global-norm clipping).
+
+        ``zero=None`` defaults to ``True`` — the pipe's natural level
+        (3 under fsdp, else 1).  At level 3 the state layout IS the
+        param layout: ``optimizer.init``'s ``zeros_like`` moments
+        inherit the fsdp storage sharding, so this is exactly
+        ``place_tree(optimizer.init(params))`` — each lane already
+        stores 1/N_dp of every mirrored leaf without any segment
+        machinery."""
+        level = self._zero_level(True if zero is None else zero)
+        self._zero_check(level)
+        if level == 0:
+            return self.place_tree(optimizer.init(params))
+        if level == 3:
+            # Params are stored sharded (gather-at-use); zeros_like-built
+            # state inherits their NamedShardings leaf-for-leaf.
+            return self.place_tree(optimizer.init(params))
         param_specs, state_specs, local_init, _ = self._zero_machinery(
             optimizer, params
         )
@@ -3499,7 +3646,8 @@ class SpmdGPipe:
 
     def make_train_step(
         self, optimizer: Any, *, donate: bool = True,
-        megastep: Optional[int] = None, zero: Optional[bool] = None,
+        megastep: Optional[int] = None,
+        zero: Optional[Union[bool, int]] = None,
     ) -> Callable[..., Tuple[jax.Array, Pytree, Pytree]]:
         """The whole update as ONE compiled program: pipelined
         forward+backward plus the optimizer, fused by XLA.
@@ -3554,24 +3702,37 @@ class SpmdGPipe:
           ``rng``, inner step k derives its key as ``fold_in(rng, k)``.
 
         ``zero`` (default: the pipe's declared :attr:`zero_update`)
-        switches the optimizer apply to the ZeRO-sharded form
-        (arXiv:2004.13336): optimizer state partitioned over the dp
-        axis — initialize it with :meth:`zero_opt_state` instead of
-        ``place_tree(optimizer.init(params))`` — each lane updates its
-        1/N_dp segment of every param, and the updated params are
-        all-gathered over dp.  Bitwise-equal to the unsharded update
-        for elementwise optimizers; per-device optimizer memory drops
-        ~N_dp×, which the planner's memory certification models.
+        selects the ZeRO level of the optimizer apply
+        (arXiv:2004.13336 / arXiv:1910.02054):
+
+        * ``0``/``False`` — replicated state, plain elementwise update;
+        * ``1``/``True`` (non-fsdp) — optimizer state partitioned over
+          the dp axis — initialize it with :meth:`zero_opt_state`
+          instead of ``place_tree(optimizer.init(params))`` — each lane
+          updates its 1/N_dp segment of every param, and the updated
+          params are all-gathered over dp;
+        * ``3``/``True`` (fsdp) — the fully-sharded update: grads
+          already leave the pipelined step reduce-scattered into the
+          fsdp storage layout (the block all_gather's transpose), so
+          the plain elementwise apply updates sharded state against
+          sharded params with no extra collective — GSPMD keeps every
+          leaf in its ``P(dp, ...)`` storage spec end-to-end.
+          Initialize state with :meth:`zero_opt_state` (at level 3
+          that is exactly ``place_tree(optimizer.init(params))``).
+
+        Every level is bitwise-equal to the unsharded update for
+        elementwise optimizers; per-device optimizer memory drops
+        ~N_dp× (level 3 additionally drops params and grads ~N_dp×),
+        which the planner's memory certification models.
         """
         K = self.megastep if megastep is None else int(megastep)
         if K < 1:
             raise ValueError(f"megastep must be >= 1, got {K}")
-        use_zero = self.zero_update if zero is None else bool(zero)
-        if use_zero:
-            self._zero_check()
+        level = self._zero_level(zero)
+        self._zero_check(level)
         if K > 1:
-            return self._make_megastep(optimizer, K, donate, use_zero)
-        apply_update = self._make_apply_update(optimizer, use_zero)
+            return self._make_megastep(optimizer, K, donate, level)
+        apply_update = self._make_apply_update(optimizer, level)
 
         def whole(
             params: Pytree,
@@ -3618,11 +3779,14 @@ class SpmdGPipe:
         return step
 
     def _make_apply_update(
-        self, optimizer: Any, use_zero: bool
+        self, optimizer: Any, level: int
     ) -> Callable[[Pytree, Pytree, Pytree], Tuple[Pytree, Pytree]]:
-        """The optimizer-apply half of a fused step: plain whole-tree
-        update, or the ZeRO-sharded shard_map form (each dp lane updates
-        its 1/N_dp flat segment, params all-gathered back)."""
+        """The optimizer-apply half of a fused step for ZeRO ``level``:
+        the plain whole-tree elementwise update (levels 0 and 3 — at
+        level 3 params/grads/state are all in the fsdp storage layout
+        and GSPMD keeps the elementwise math sharded end-to-end), or
+        the ZeRO-1 shard_map form (each dp lane updates its 1/N_dp flat
+        segment, params all-gathered back)."""
 
         def plain(
             params: Pytree, grads: Pytree, opt_state: Pytree
@@ -3633,7 +3797,7 @@ class SpmdGPipe:
             )
             return new_params, new_state
 
-        if not use_zero:
+        if level != 1:
             return plain
 
         def sharded(
@@ -3652,14 +3816,14 @@ class SpmdGPipe:
         return sharded
 
     def _make_megastep(
-        self, optimizer: Any, K: int, donate: bool, use_zero: bool = False
+        self, optimizer: Any, K: int, donate: bool, level: int = 0
     ) -> Callable[..., Tuple[jax.Array, Pytree, Pytree, jax.Array]]:
         """K optimizer steps as one scanned program (see
         :meth:`make_train_step`'s ``megastep`` contract)."""
         from torchgpipe_tpu.utils import tree_finite
 
         tmap = jax.tree_util.tree_map
-        apply_update = self._make_apply_update(optimizer, use_zero)
+        apply_update = self._make_apply_update(optimizer, level)
 
         def whole(
             params: Pytree,
