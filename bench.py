@@ -959,6 +959,27 @@ def _fleet_entry() -> None:
     raise SystemExit(0)
 
 
+def _elastic_entry() -> None:
+    """The ``elastic`` rung: the SLO-priced fleet autoscaler vs static
+    peak provisioning on the same bursty MMPP trace
+    (benchmarks/elastic_autoscale.py — which owns the measurement
+    contract: both rungs must emit bitwise-identical streams before any
+    number publishes, the fleet must breathe BOTH ways above the floor,
+    the autoscaled integral of in-rotation replicas over trace time
+    must undercut the static peak bill, and the declared TPOT p95
+    objective must hold while scaled)::
+
+        env JAX_PLATFORMS=cpu python bench.py --elastic
+    """
+    sys.argv = [sys.argv[0]] + [
+        a for a in sys.argv[1:] if a != "--elastic"
+    ] + ["--json"]
+    from benchmarks.elastic_autoscale import main as elastic_main
+
+    elastic_main()
+    raise SystemExit(0)
+
+
 def _plan_validate_entry() -> None:
     """The ``plan-validate`` rung: predicted-vs-measured rank-order check
     of the static planner on the CPU tiny-llama preset
@@ -983,6 +1004,8 @@ if __name__ == "__main__":
         _plan_validate_entry()
     elif "--fleet" in sys.argv:
         _fleet_entry()
+    elif "--elastic" in sys.argv:
+        _elastic_entry()
     elif "--megastep" in sys.argv:
         _megastep_entry()
     elif "--packing" in sys.argv:

@@ -92,13 +92,26 @@ differently and must not share backend state):
    and an induced mid-generation replica death must yield ONE stitched
    request trace spanning both replicas with the migration span
    explicit and zero orphan spans (docs/observability.md, serving
-   section).
+   section);
+13. ``tools/elastic_verify.py`` (elastic-verify) — the elastic
+   world-size contract: a REAL rank death (a 2-rank LocalTransport
+   pipeline whose peer is unregistered mid-run, surfacing as
+   ``PeerDiedError``) must be survived by the training
+   :class:`~torchgpipe_tpu.resilience.supervisor.Supervisor` — restore
+   the last world-size-aware snapshot, re-plan CERTIFIED at the
+   surviving stage count, resume through ``repartition`` with finite
+   losses and the decision in the flight dump — and the SLO-priced
+   fleet :class:`~torchgpipe_tpu.fleet.autoscaler.Autoscaler` must
+   breathe BOTH ways on a bursty MMPP trace with a deterministic
+   replica-count trajectory, never below the floor, every in-flight
+   stream completing bitwise vs ``generate`` (docs/robustness.md
+   elastic section; docs/serving.md autoscaler section).
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
 / ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` /
 ``--skip-postmortem`` / ``--skip-sharding`` / ``--skip-pack`` /
-``--skip-replan`` / ``--skip-fleet`` / ``--skip-slo`` to run a subset,
-``-v`` for per-target reports.
+``--skip-replan`` / ``--skip-fleet`` / ``--skip-slo`` /
+``--skip-elastic`` to run a subset, ``-v`` for per-target reports.
 """
 
 from __future__ import annotations
@@ -136,6 +149,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-replan", action="store_true")
     ap.add_argument("--skip-fleet", action="store_true")
     ap.add_argument("--skip-slo", action="store_true")
+    ap.add_argument("--skip-elastic", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -226,6 +240,11 @@ def main(argv: Sequence[str] | None = None) -> int:
             sys.executable, str(REPO / "tools" / "slo_verify.py"),
         ]
         failures += _run("slo-verify", cmd) != 0
+    if not args.skip_elastic:
+        cmd = [
+            sys.executable, str(REPO / "tools" / "elastic_verify.py"),
+        ]
+        failures += _run("elastic-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

@@ -30,11 +30,13 @@ from __future__ import annotations
 import contextlib
 import pickle
 import queue
+import random
 import socket
 import socketserver
 import struct
 import threading
 import time
+import zlib
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -42,6 +44,27 @@ import numpy as np
 
 Payload = Any
 ChannelKey = Tuple[Any, int]
+
+# Connect-retry backoff: exponential from BASE, CAPPED at CAP — the cap
+# is the contract (a rank that has been retrying for a while still
+# probes at least every RETRY_BACKOFF_CAP_S seconds, so a late-booting
+# peer is picked up within one cap interval, never minutes).  Jitter
+# (equal-jitter: half fixed, half uniform) keeps a fleet of ranks that
+# all lost the same peer from re-connecting in lockstep and SYN-flooding
+# its freshly restarted listener.
+RETRY_BACKOFF_BASE_S = 0.5
+RETRY_BACKOFF_CAP_S = 5.0
+
+
+def _retry_sleep_s(attempt: int, rng: random.Random) -> float:
+    """Sleep before connect retry ``attempt`` (1-based): equal-jitter
+    exponential backoff, ``base * 2**(attempt-1)`` capped at
+    :data:`RETRY_BACKOFF_CAP_S`, half of it jittered uniformly."""
+    ceiling = min(
+        RETRY_BACKOFF_CAP_S,
+        RETRY_BACKOFF_BASE_S * (2.0 ** max(attempt - 1, 0)),
+    )
+    return ceiling / 2.0 + rng.random() * ceiling / 2.0
 
 
 class PeerDiedError(TimeoutError):
@@ -200,6 +223,14 @@ class TcpTransport:
     attempt, the final connect timeout, and a send-timeout — each
     recorded BEFORE its exception is raised, so a dump from a half-dead
     pipeline shows the retry history instead of ending mid-air.
+
+    ``registry`` (optional :class:`~torchgpipe_tpu.obs.registry.
+    MetricsRegistry`) adds a ``retries_total{rank}`` counter over the
+    same connect-retry attempts, so an elastic supervisor's resize
+    decisions and the transport flapping that caused them cross-
+    reference one incident.  Retries back off exponentially with
+    equal-jitter from :data:`RETRY_BACKOFF_BASE_S`, capped at
+    :data:`RETRY_BACKOFF_CAP_S` (see :func:`_retry_sleep_s`).
     """
 
     def __init__(
@@ -210,12 +241,24 @@ class TcpTransport:
         connect_timeout: float = 120.0,
         send_timeout: Optional[float] = None,
         recorder: Optional[Any] = None,
+        registry: Optional[Any] = None,
     ) -> None:
         self.name = name
         self.addresses = dict(addresses)
         self.connect_timeout = connect_timeout
         self.send_timeout = send_timeout
         self.recorder = recorder
+        # Deterministic per-rank jitter stream (crc32, not hash(): str
+        # hashing is salted per process, and two runs of the same rank
+        # should back off identically for reproducible traces).
+        self._retry_rng = random.Random(zlib.crc32(name.encode("utf-8")))
+        self._c_retries = (
+            registry.counter(
+                "retries_total",
+                help="connect-retry attempts by the retrying rank",
+                labels=("rank",),
+            ) if registry is not None else None
+        )
         self.mailbox = Mailbox(name)
         self.mailbox.recorder = recorder
         host, port = self.addresses[name]
@@ -270,6 +313,8 @@ class TcpTransport:
                 # Only genuinely transient rendezvous failures are retried;
                 # misconfiguration (bad hostname etc.) raises immediately.
                 attempt += 1
+                if self._c_retries is not None:
+                    self._c_retries.inc(rank=self.name)
                 if self.recorder is not None:
                     self.recorder.record(
                         "connect_retry", channel=(kind, index), peer=dst,
@@ -291,7 +336,7 @@ class TcpTransport:
                         f"{host}:{port} within {self.connect_timeout}s — is "
                         "that rank running?"
                     ) from err
-                time.sleep(0.5)
+                time.sleep(_retry_sleep_s(attempt, self._retry_rng))
         with sock:
             # The connect timeout must not govern the transfer itself
             # (large activation blobs to a busy peer legitimately take

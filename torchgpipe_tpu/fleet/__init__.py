@@ -24,6 +24,12 @@ the "millions of users" tier (docs/serving.md, fleet section):
   million-request trace generator (ragged, bursty, shared-prefix
   tenants) driving ``bench.py --fleet``, so fleet claims are measured,
   not asserted.
+* :mod:`~torchgpipe_tpu.fleet.autoscaler` — :class:`Autoscaler`:
+  replica count as a control loop — Little's-law pricing off the
+  measured ``CostModel`` + MMPP arrival rates, SLO-burn override,
+  hysteresis/cooldown damping; scale-down reuses the router's drain
+  path (no in-flight request dropped), scale-up re-opens a parked
+  replica's admissions.
 
     from torchgpipe_tpu import fleet, serving
     shared = obs.MetricsRegistry()
@@ -39,6 +45,7 @@ the "millions of users" tier (docs/serving.md, fleet section):
 
 from __future__ import annotations
 
+from torchgpipe_tpu.fleet.autoscaler import Autoscaler
 from torchgpipe_tpu.fleet.prefix_cache import RadixPrefixCache
 from torchgpipe_tpu.fleet.router import (
     Replica,
@@ -57,6 +64,7 @@ from torchgpipe_tpu.fleet.trace import (
 )
 
 __all__ = [
+    "Autoscaler",
     "RadixPrefixCache",
     "Replica",
     "ReplicaDied",

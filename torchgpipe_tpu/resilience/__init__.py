@@ -24,9 +24,15 @@ preemption-aware (SIGTERM -> checkpoint-and-exit), and all of it testable
   between-steps flag for cooperative checkpoint-and-exit.
 * :mod:`~torchgpipe_tpu.resilience.faults` — :func:`inject` (NaN at a
   chosen (stage, micro-batch) in either engine, simulated preemption at
-  step k) and :class:`FaultyTransport` (drop/lose/delay/duplicate sends)
-  — the test harness for the three modules above, and a user-facing
-  chaos tool.
+  step k, cooperative rank death at a megastep boundary) and
+  :class:`FaultyTransport` (drop/lose/delay/duplicate sends) — the test
+  harness for the three modules above, and a user-facing chaos tool.
+* :mod:`~torchgpipe_tpu.resilience.supervisor` — :class:`Supervisor`:
+  the elastic closed loop over all of the above — on a dead or stalled
+  rank, checkpoint from the survivors (or restore the last good
+  snapshot), re-plan CERTIFIED under the surviving world size, rebuild
+  via ``GPipe.repartition`` and resume; re-absorb returned capacity at
+  a megastep boundary.
 
 See docs/robustness.md for the end-to-end recovery story.
 """
@@ -49,6 +55,12 @@ from torchgpipe_tpu.resilience.guard import (
     classify_error,
 )
 from torchgpipe_tpu.resilience.preemption import PreemptionHandler
+from torchgpipe_tpu.resilience.supervisor import (
+    ResizeEvent,
+    Supervisor,
+    SupervisorError,
+    SupervisorResult,
+)
 
 __all__ = [
     "CheckpointError",
@@ -63,4 +75,8 @@ __all__ = [
     "StepGuard",
     "classify_error",
     "PreemptionHandler",
+    "ResizeEvent",
+    "Supervisor",
+    "SupervisorError",
+    "SupervisorResult",
 ]

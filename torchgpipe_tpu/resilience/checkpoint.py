@@ -178,11 +178,20 @@ class CheckpointManager:
         *,
         metadata: Optional[Dict[str, Any]] = None,
         sharded: bool = False,
+        world_size: Optional[int] = None,
+        balance: Optional[List[int]] = None,
     ) -> str:
         """Write snapshot ``step_<step>`` atomically; returns its path.
 
         ``metadata`` must be JSON-serializable (step counters, rng seeds,
         loss-scale state, ...); arrays belong in ``tree``.
+
+        ``world_size``/``balance`` record the stage count and layer cut
+        the snapshot was taken under (stored in the manifest metadata).
+        An elastic run restoring into a DIFFERENT world size can then be
+        detected up front (:meth:`restore_latest` with ``world_size=``)
+        and routed through ``GPipe.repartition`` explicitly, instead of
+        failing deep inside ``_unflatten_like`` on a shape mismatch.
         """
         if step < 0:
             raise ValueError(f"step must be >= 0, got {step}")
@@ -190,11 +199,16 @@ class CheckpointManager:
         tmp = os.path.join(
             self.directory, f"{_TMP_PREFIX}{_STEP_PREFIX}{step:010d}"
         )
+        meta = dict(metadata or {})
+        if world_size is not None:
+            meta["world_size"] = int(world_size)
+        if balance is not None:
+            meta["balance"] = [int(b) for b in balance]
         manifest: Dict[str, Any] = {
             "format": _FORMAT_VERSION,
             "step": int(step),
             "backend": _SHARDED if sharded else "npz",
-            "metadata": dict(metadata or {}),
+            "metadata": meta,
         }
         if jax.process_index() == 0:
             shutil.rmtree(tmp, ignore_errors=True)
@@ -254,7 +268,10 @@ class CheckpointManager:
     # ------------------------------------------------------------------ #
 
     def restore_latest(
-        self, template: Optional[Pytree] = None
+        self,
+        template: Optional[Pytree] = None,
+        *,
+        world_size: Optional[int] = None,
     ) -> Optional[Snapshot]:
         """The newest snapshot that passes verification, or ``None``.
 
@@ -268,9 +285,24 @@ class CheckpointManager:
         (required for ``sharded`` snapshots, where it also supplies the
         shardings — pass the live initialized tree); without it the flat
         ``{keystr: ndarray}`` dict is returned.
-        """
+
+        ``world_size=`` declares the stage count the CALLER is restoring
+        into.  A snapshot whose manifest records a different
+        ``world_size`` (see :meth:`save`) is returned FLAT — its metadata
+        carries the recorded ``balance`` — so an elastic caller can
+        rebuild under the old cut and route through
+        ``GPipe.repartition`` explicitly, instead of ``template``
+        unflattening failing on a per-stage shape mismatch.  Snapshots
+        written without the record restore through ``template`` as
+        before (no way to tell; the strict path's shape check still
+        protects the caller)."""
         for step in sorted(self.steps(), reverse=True):
-            snap = self._try_restore(step, template)
+            use_template = template
+            if world_size is not None and template is not None:
+                recorded = self._recorded_world_size(step)
+                if recorded is not None and recorded != int(world_size):
+                    use_template = None
+            snap = self._try_restore(step, use_template)
             if snap is not None:
                 return snap
         return None
@@ -305,6 +337,21 @@ class CheckpointManager:
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"{_STEP_PREFIX}{step:010d}")
+
+    def _recorded_world_size(self, step: int) -> Optional[int]:
+        """The ``world_size`` snapshot ``step`` was taken under, read
+        from its manifest (``.old`` fallback included) without loading
+        any array — ``None`` when unrecorded or unreadable."""
+        primary = self._step_dir(step)
+        for path in (primary, primary + ".old"):
+            manifest = self._read_manifest(path)
+            if manifest is not None:
+                ws = manifest.get("metadata", {}).get("world_size")
+                try:
+                    return int(ws) if ws is not None else None
+                except (TypeError, ValueError):
+                    return None
+        return None
 
     def _hash_dir(
         self, root: str, *, fsync: bool = False

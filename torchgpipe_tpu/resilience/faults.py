@@ -66,6 +66,18 @@ class FaultPlan:
     # ``slow_at`` it is host-side only: traces nothing, never tokens
     # the compiled-program caches (:func:`plan_token` stays None).
     die_at_step: Optional[Tuple[int, int]] = None
+    # Kill TRAINING rank ``rank`` at megastep boundary ``k`` —
+    # ``die_at_step``'s training twin: (rank, k).  The resilience
+    # supervisor checks :func:`should_die_at_megastep` at every
+    # megastep boundary (the only place training state is consistent —
+    # checkpoint/preemption/replan hooks share that cadence) and treats
+    # a hit as that rank's cooperative death: checkpoint from the
+    # survivors, re-plan under the surviving world size, resume.  Like
+    # ``die_at_step`` it is host-side only: traces nothing, never
+    # tokens the compiled-program caches (:func:`plan_token` stays
+    # None), so the kill-and-resume tests run without recompiles or
+    # real process kills.
+    die_at_megastep: Optional[Tuple[int, int]] = None
     # Slow one serving-fleet replica by (replica, extra_seconds) per
     # engine step — ``slow_at``'s serving twin: the fleet router sleeps
     # ``extra_seconds`` BEFORE each of that replica's engine steps, so
@@ -92,6 +104,7 @@ def inject(
     preempt_at_step: Optional[int] = None,
     slow_at: Optional[Tuple[int, float]] = None,
     die_at_step: Optional[Tuple[int, int]] = None,
+    die_at_megastep: Optional[Tuple[int, int]] = None,
     slow_replica_at: Optional[Tuple[int, float]] = None,
 ) -> Iterator[FaultPlan]:
     """Activate a :class:`FaultPlan` for the enclosed block.
@@ -102,6 +115,7 @@ def inject(
     global _active, _epoch
     plan = FaultPlan(nan_at=nan_at, preempt_at_step=preempt_at_step,
                      slow_at=slow_at, die_at_step=die_at_step,
+                     die_at_megastep=die_at_megastep,
                      slow_replica_at=slow_replica_at)
     with _lock:
         if _active is not None:
@@ -199,6 +213,22 @@ def should_die(replica: int, step: int) -> bool:
         and plan.die_at_step is not None
         and plan.die_at_step[0] == replica
         and step >= plan.die_at_step[1]
+    )
+
+
+def should_die_at_megastep(rank: int, megasteps: int) -> bool:
+    """True iff the active plan kills TRAINING rank ``rank`` at or
+    before megastep boundary ``megasteps`` (completed megasteps) — the
+    resilience supervisor's cooperative death check, ``die_at_step``'s
+    training twin.  Host-side only: inert for tracing, so compiled
+    -program caches are never invalidated by entering/leaving the plan
+    (:func:`plan_token` stays None)."""
+    plan = _active
+    return (
+        plan is not None
+        and plan.die_at_megastep is not None
+        and plan.die_at_megastep[0] == rank
+        and megasteps >= plan.die_at_megastep[1]
     )
 
 
