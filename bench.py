@@ -980,6 +980,28 @@ def _elastic_entry() -> None:
     raise SystemExit(0)
 
 
+def _disagg_entry() -> None:
+    """The ``disagg`` rung: phase-disaggregated serving (1 prefill + 1
+    decode replica, KV migrated through the fixed-shape
+    ``migrate_ingest`` program) vs a unified 2-replica fleet on the same
+    prefill-heavy MMPP trace (benchmarks/disagg_trace.py — which owns
+    the measurement contract: both rungs must emit bitwise-identical
+    streams before any number publishes, TPOT is measured on per-replica
+    step clocks so the figure is deterministic, and the headline gate is
+    isolation — the disagg decode pool must hold the 1 step/token floor
+    under the prefill burst while unified measurably degrades)::
+
+        env JAX_PLATFORMS=cpu python bench.py --disagg
+    """
+    sys.argv = [sys.argv[0]] + [
+        a for a in sys.argv[1:] if a != "--disagg"
+    ] + ["--json"]
+    from benchmarks.disagg_trace import main as disagg_main
+
+    disagg_main()
+    raise SystemExit(0)
+
+
 def _plan_validate_entry() -> None:
     """The ``plan-validate`` rung: predicted-vs-measured rank-order check
     of the static planner on the CPU tiny-llama preset
@@ -1006,6 +1028,8 @@ if __name__ == "__main__":
         _fleet_entry()
     elif "--elastic" in sys.argv:
         _elastic_entry()
+    elif "--disagg" in sys.argv:
+        _disagg_entry()
     elif "--megastep" in sys.argv:
         _megastep_entry()
     elif "--packing" in sys.argv:

@@ -105,13 +105,25 @@ differently and must not share backend state):
    breathe BOTH ways on a bursty MMPP trace with a deterministic
    replica-count trajectory, never below the floor, every in-flight
    stream completing bitwise vs ``generate`` (docs/robustness.md
-   elastic section; docs/serving.md autoscaler section).
+   elastic section; docs/serving.md autoscaler section);
+14. ``tools/disagg_verify.py`` (disagg-verify) — phase-disaggregated
+   serving's exactness contracts on a tiny CPU llama: greedy streams
+   from a 1-prefill + 1-decode fleet (KV rows migrated through the
+   fixed-shape ``migrate_ingest`` program at each prompt completion)
+   must be BITWISE equal to both the single-engine reference and a
+   unified fleet, with the per-role program counts statically
+   certified by ``analysis.serving.certify_disagg`` (prefill: ladder
+   only; decode: exactly 2); a prefill replica killed mid-prompt must
+   re-prefill its half-done prompts on the surviving prefill replica
+   and a decode replica killed mid-stream must resume via re-prefill +
+   re-migrate, both bitwise (docs/serving.md, disaggregation section).
 
 Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
 / ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` /
 ``--skip-postmortem`` / ``--skip-sharding`` / ``--skip-pack`` /
 ``--skip-replan`` / ``--skip-fleet`` / ``--skip-slo`` /
-``--skip-elastic`` to run a subset, ``-v`` for per-target reports.
+``--skip-elastic`` / ``--skip-disagg`` to run a subset, ``-v`` for
+per-target reports.
 """
 
 from __future__ import annotations
@@ -150,6 +162,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--skip-fleet", action="store_true")
     ap.add_argument("--skip-slo", action="store_true")
     ap.add_argument("--skip-elastic", action="store_true")
+    ap.add_argument("--skip-disagg", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
@@ -245,6 +258,11 @@ def main(argv: Sequence[str] | None = None) -> int:
             sys.executable, str(REPO / "tools" / "elastic_verify.py"),
         ]
         failures += _run("elastic-verify", cmd) != 0
+    if not args.skip_disagg:
+        cmd = [
+            sys.executable, str(REPO / "tools" / "disagg_verify.py"),
+        ]
+        failures += _run("disagg-verify", cmd) != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
     return 1 if failures else 0
 

@@ -119,7 +119,8 @@ def _drive_signatures(
         dict(draft_fns) if draft_fns is not None else None,
     )
     engine._prefill_fns = {n: stub(n) for n in prefill_names}
-    engine._decode_fn = stub("decode")
+    if engine._decode_fn is not None:
+        engine._decode_fn = stub("decode")
     if engine._prefix_copy_fn is not None:
         engine._prefix_copy_fn = copy_stub
     if draft_fns is not None:
@@ -127,6 +128,11 @@ def _drive_signatures(
     try:
         engine.submit(np.zeros((plen,), np.int32), mnew, rid=tag)
         engine.run()
+        # A prefill-role engine parks the probe at prompt completion
+        # (status "migrating", slot held); nobody migrates it during a
+        # lint, so complete the handoff to release the slot and pins.
+        for req in engine.take_migration_ready():
+            engine.complete_migration(req)
     finally:
         engine._prefill_fns, engine._decode_fn = real[0], real[1]
         engine._prefix_copy_fn = real[2]
@@ -137,11 +143,17 @@ def _drive_signatures(
 
 def _program_parts(engine: Any) -> str:
     """ONE human description of an engine's declared program set, used
-    by every message that cites it — prefix-cached and speculative
-    engines carry more than 'one per bucket + decode'."""
+    by every message that cites it — prefix-cached, speculative and
+    phase-role engines carry other mixes than 'one per bucket +
+    decode'."""
+    if getattr(engine, "role", "unified") == "decode":
+        return "decode + migrate_ingest"
     has_prefix = getattr(engine, "_prefix_copy_fn", None) is not None
     n_draft = len(getattr(engine, "draft_buckets", ()))
-    return "one per bucket + decode" + (
+    has_decode = getattr(engine, "_decode_fn", True) is not None
+    return "one per bucket" + (
+        " + decode" if has_decode else " (prefill role: no decode)"
+    ) + (
         " + prefix_copy" if has_prefix else ""
     ) + (
         f" + {n_draft} draft" if n_draft else ""
@@ -159,8 +171,41 @@ def certify_ladder(engine: Any) -> List[Finding]:
     declared bucket, every bucket's token-buffer shape is a declared
     program signature, and the steady-state program count is exactly
     ``len(ladder) + 1`` (``Engine.program_count``).  An INFO finding
-    records the certified bound; any violation is an ERROR."""
+    records the certified bound; any violation is an ERROR.
+
+    Phase roles shrink the set and the walk follows: a prefill-role
+    engine certifies at ``len(ladder)`` (no decode program — streams
+    leave at the first token), a decode-role engine at exactly 2
+    (``decode`` + ``migrate_ingest``; it owns no ladder, so the
+    chunk walk is vacuous and skipped)."""
     findings: List[Finding] = []
+    role = getattr(engine, "role", "unified")
+    if role == "decode":
+        n_programs = len(engine.step_input_specs())
+        if n_programs != 2 or engine.program_count != 2:
+            findings.append(Finding(
+                rule="ladder-bound",
+                severity=Severity.ERROR,
+                path="serving/engine",
+                message=(
+                    f"decode-role engine declares {n_programs} step "
+                    f"programs (program_count="
+                    f"{engine.program_count}) but the role certifies "
+                    "exactly 2 (decode + migrate_ingest)"
+                ),
+            ))
+        else:
+            findings.append(Finding(
+                rule="ladder-bound",
+                severity=Severity.INFO,
+                path="serving/engine",
+                message=(
+                    "decode role: steady-state program count "
+                    "statically bounded at 2 (decode + migrate_ingest) "
+                    "for every migration mix"
+                ),
+            ))
+        return findings
     buckets = tuple(getattr(engine, "prefill_buckets",
                             (engine.prefill_chunk,)))
     S = engine.pool.num_slots
@@ -188,7 +233,11 @@ def certify_ladder(engine: Any) -> List[Finding]:
     n_programs = len(engine.step_input_specs())
     has_prefix = getattr(engine, "_prefix_copy_fn", None) is not None
     n_draft = len(getattr(engine, "draft_buckets", ()))
-    expected = len(buckets) + 1 + (1 if has_prefix else 0) + n_draft
+    has_decode = getattr(engine, "_decode_fn", True) is not None
+    expected = (
+        len(buckets) + (1 if has_decode else 0)
+        + (1 if has_prefix else 0) + n_draft
+    )
     parts = _program_parts(engine)
     if n_programs != expected:
         findings.append(Finding(
@@ -295,6 +344,69 @@ def certify_speculative(engine: Any) -> List[Finding]:
     return findings
 
 
+def certify_disagg(
+    prefill_engine: Any, decode_engine: Any,
+) -> List[Finding]:
+    """Statically certify a prefill/decode pool pair for
+    phase-disaggregated serving (the ``certify_ladder`` shape applied
+    to both roles at once):
+
+    1. **per-role program bounds** — the prefill engine certifies its
+       ladder with NO decode program (streams leave at the first
+       token: a decode fn on a prefill replica means the split is not
+       real), the decode engine certifies at exactly 2 programs
+       (``decode`` + ``migrate_ingest``) — disaggregation SHRINKS each
+       replica's compiled set below the unified ``len(ladder) + 1``;
+    2. **migration compatibility** — the pair passes
+       :func:`fleet.migration.validate_pools`: equal ``max_len`` and
+       bit-identical per-slot KV row specs, so every exported payload
+       fits the ingest program without a reshape (a mismatch here is
+       a per-handoff recompile in production).
+
+    An INFO finding records the certified pair; violations are ERROR.
+    """
+    findings: List[Finding] = []
+    findings.extend(certify_ladder(prefill_engine))
+    findings.extend(certify_ladder(decode_engine))
+    if getattr(prefill_engine, "_decode_fn", None) is not None:
+        findings.append(Finding(
+            rule="disagg-bound",
+            severity=Severity.ERROR,
+            path="serving/engine",
+            message=(
+                "prefill-role engine carries a compiled decode program "
+                "— the phase split is not real; streams must leave at "
+                "the first token"
+            ),
+        ))
+    from torchgpipe_tpu.fleet import migration as _migration
+    try:
+        _migration.validate_pools(prefill_engine, decode_engine)
+    except _migration.MigrationError as exc:
+        findings.append(Finding(
+            rule="disagg-bound",
+            severity=Severity.ERROR,
+            path="fleet/migration",
+            message=str(exc),
+        ))
+    if not any(f.severity >= Severity.WARNING for f in findings):
+        buckets = tuple(prefill_engine.prefill_buckets)
+        findings.append(Finding(
+            rule="disagg-bound",
+            severity=Severity.INFO,
+            path="fleet/migration",
+            message=(
+                f"disaggregated pair certified: prefill pool "
+                f"{prefill_engine.program_count} program(s) (ladder "
+                f"{buckets}, no decode), decode pool 2 (decode + "
+                "migrate_ingest), KV row specs bit-compatible at "
+                f"max_len={prefill_engine.pool.max_len}"
+            ),
+        ))
+    findings.sort(key=lambda f: (-int(f.severity), f.path, f.rule))
+    return findings
+
+
 def lint_serving(
     engine: Any,
     grid: Optional[Sequence[Tuple[int, int]]] = None,
@@ -333,6 +445,7 @@ def lint_serving(
                             (engine.prefill_chunk,)))
     if (
         len(buckets) == 1
+        and "decode" in base_sig
         and base_sig.get("prefill") == base_sig["decode"]
     ):
         findings.append(Finding(
@@ -360,6 +473,7 @@ def lint_serving(
     # lint).  The scratch accumulates across grid points, so later
     # probes still hit earlier ones and the prefix-copy dispatch
     # signature is exercised; its pins are dropped afterwards.
+    role = getattr(engine, "role", "unified")
     real_prefix_cache = getattr(engine, "_prefix_cache", None)
     if real_prefix_cache is not None:
         engine._prefix_cache = type(real_prefix_cache)(
@@ -369,6 +483,21 @@ def lint_serving(
     max_len = engine.pool.max_len
     try:
         for i, (plen, mnew) in enumerate(grid):
+            if role == "decode":
+                # submit() refuses by contract (work arrives only via
+                # ingest_migration); the churn grid is vacuous here and
+                # the abstract trace below still covers both programs.
+                findings.append(Finding(
+                    rule="serving-admission",
+                    severity=Severity.INFO,
+                    path="serving/scheduler",
+                    message=(
+                        "decode role refuses submit() — churn grid "
+                        "skipped; decode + migrate_ingest certified by "
+                        "the role bound and the abstract trace"
+                    ),
+                ))
+                break
             if plen < 1 or mnew < 1 or plen + mnew > max_len:
                 findings.append(Finding(
                     rule="serving-admission",
@@ -416,11 +545,13 @@ def lint_serving(
     # 3. abstract-trace every program (each ladder bucket + decode +
     # the prefix-copy program when a prefix cache is attached); walk
     # for host callbacks
-    programs: List[Tuple[str, Any]] = [
-        *engine._prefill_fns.items(), ("decode", engine._decode_fn),
-    ]
+    programs: List[Tuple[str, Any]] = list(engine._prefill_fns.items())
+    if engine._decode_fn is not None:
+        programs.append(("decode", engine._decode_fn))
     if getattr(engine, "_prefix_copy_fn", None) is not None:
         programs.append(("prefix_copy", engine._prefix_copy_fn))
+    if getattr(engine, "_ingest_fn", None) is not None:
+        programs.append(("migrate_ingest", engine._ingest_fn))
     programs.extend(getattr(engine, "_draft_fns", {}).items())
     for kind, fn in programs:
         spec = base[kind]
@@ -428,6 +559,10 @@ def lint_serving(
             if kind == "prefix_copy":
                 traced = jax.make_jaxpr(fn)(
                     spec["cache"], spec["src"], spec["dst"], spec["n"]
+                )
+            elif kind == "migrate_ingest":
+                traced = jax.make_jaxpr(fn)(
+                    spec["cache"], spec["rows"], spec["dst"], spec["n"]
                 )
             elif kind.startswith("draft@"):
                 traced = jax.make_jaxpr(
@@ -505,9 +640,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # len(ladder)+1 and certified over the churn grid + the
         # exhaustive pending-chunk walk (certify_ladder).
         ("ladder", dict(prefill_chunk=(1, 2, 4, 8))),
+        # Phase roles: prefill drops decode, decode drops the ladder.
+        ("prefill-role", dict(prefill_chunk=(1, 2, 4, 8),
+                              role="prefill")),
+        ("decode-role", dict(prefill_chunk=4, role="decode")),
     ]
+    engines = {}
     for tag, kw in cases:
         eng = Engine(cfg, params, num_slots=4, max_len=48, **kw)
+        engines[tag] = eng
         findings = lint_serving(eng)
         errors = [f for f in findings if f.severity >= Severity.WARNING]
         worst = max(worst, len(errors))
@@ -517,11 +658,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"[serving-lint] {tag}: {len(findings)} finding(s), "
               f"{len(errors)} at warning+, "
               f"{eng.program_count} program(s) certified")
+    # The pair certification the disaggregated router runs at build.
+    findings = certify_disagg(
+        engines["prefill-role"], engines["decode-role"]
+    )
+    errors = [f for f in findings if f.severity >= Severity.WARNING]
+    worst = max(worst, len(errors))
+    if args.verbose or errors:
+        for f in findings:
+            print(f.format())
+    print(f"[serving-lint] disagg-pair: {len(findings)} finding(s), "
+          f"{len(errors)} at warning+")
     return 1 if worst else 0
 
 
 __all__ = [
     "DEFAULT_GRID",
+    "certify_disagg",
     "certify_ladder",
     "certify_speculative",
     "lint_serving",

@@ -30,6 +30,12 @@ the "millions of users" tier (docs/serving.md, fleet section):
   hysteresis/cooldown damping; scale-down reuses the router's drain
   path (no in-flight request dropped), scale-up re-opens a parked
   replica's admissions.
+* :mod:`~torchgpipe_tpu.fleet.migration` — phase-disaggregated
+  serving's handoff: a prefill replica's finished prompt (KV rows +
+  first token) ships to a decode replica through one fixed-shape
+  ``migrate_ingest`` program; the continued greedy stream is bitwise
+  what a unified replica would have produced.  The router drives it
+  when its replicas declare ``role="prefill"`` / ``role="decode"``.
 
     from torchgpipe_tpu import fleet, serving
     shared = obs.MetricsRegistry()
@@ -46,6 +52,12 @@ the "millions of users" tier (docs/serving.md, fleet section):
 from __future__ import annotations
 
 from torchgpipe_tpu.fleet.autoscaler import Autoscaler
+from torchgpipe_tpu.fleet.migration import (
+    MigrationError,
+    migrate,
+    stage_rows,
+    validate_pools,
+)
 from torchgpipe_tpu.fleet.prefix_cache import RadixPrefixCache
 from torchgpipe_tpu.fleet.router import (
     Replica,
@@ -58,6 +70,7 @@ from torchgpipe_tpu.fleet.trace import (
     TraceConfig,
     TraceRequest,
     TraceStats,
+    prefill_heavy_config,
     synthetic_trace,
     tenant_prefixes,
     trace_summary,
@@ -65,6 +78,7 @@ from torchgpipe_tpu.fleet.trace import (
 
 __all__ = [
     "Autoscaler",
+    "MigrationError",
     "RadixPrefixCache",
     "Replica",
     "ReplicaDied",
@@ -74,7 +88,11 @@ __all__ = [
     "TraceConfig",
     "TraceRequest",
     "TraceStats",
+    "migrate",
+    "prefill_heavy_config",
+    "stage_rows",
     "synthetic_trace",
     "tenant_prefixes",
     "trace_summary",
+    "validate_pools",
 ]

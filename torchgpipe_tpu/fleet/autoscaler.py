@@ -29,6 +29,16 @@ layer (:class:`~torchgpipe_tpu.obs.slo.SloMonitor`) measures burn.
   below ``max(min_replicas, router.slo_min_in_rotation)`` — the same
   brake that stops the SLO layer from degrading the last healthy
   replica stops the autoscaler from parking it.
+* **Per-role pools.**  On a phase-disaggregated fleet (see
+  :mod:`torchgpipe_tpu.fleet.migration`) every term above goes
+  per-pool: the prefill pool is priced by the ADMISSION window (TTFT
+  lives there), the decode pool by the fleet's migration rate — each
+  completed prompt is one decode arrival, read off the router's
+  ``fleet_migrations`` counter — and SLO-burn alerts bump only the
+  pool their objective's ``phase`` blames.  Floors are per-pool too:
+  the decode pool is never parked below its own floor to feed
+  prefill — decode replicas hold live token streams, and a starved
+  decode pool turns a TTFT problem into a TPOT outage.
 
 Scale-down reuses :meth:`Router.drain_replica` verbatim (the
 acceptance property "never drops an in-flight request across a
@@ -124,8 +134,32 @@ class Autoscaler:
         self.parked: List[str] = []
         self._clock = router._clock
         self._arrivals: Deque[float] = collections.deque()
-        self._trend_dir = 0       # sign of the pending resize
-        self._trend_ticks = 0     # consecutive ticks agreeing with it
+        # Phase-disaggregated fleets are priced per pool; a unified
+        # fleet is the degenerate single-pool case of the same loop.
+        self.disaggregated = bool(getattr(router, "disaggregated", False))
+        self.roles = dict(getattr(router, "roles", {})) or {
+            name: "unified" for name in router.replicas
+        }
+        self.role_order = (
+            ("prefill", "decode") if self.disaggregated else ("unified",)
+        )
+        for role in self.role_order:
+            n_pool = sum(1 for v in self.roles.values() if v == role)
+            if self.disaggregated and self.min_replicas > n_pool:
+                raise ValueError(
+                    f"min_replicas {self.min_replicas} (after the "
+                    f"slo_min_in_rotation floor) exceeds the {role} "
+                    f"pool's {n_pool} replicas"
+                )
+        # Per-role hysteresis state: [pending direction, agreeing ticks].
+        self._trend = {role: [0, 0] for role in self.role_order}
+        # Decode arrivals = migration handoffs; rate is read as counter
+        # deltas over the window, sampled each tick: (t, count) pairs.
+        self._migrations: Deque[tuple] = collections.deque([(
+            -math.inf,
+            float(getattr(router, "_c_migrations", None).value())
+            if getattr(router, "_c_migrations", None) is not None else 0.0,
+        )])
         self._last_resize_at: Optional[float] = None
         registry = router.registry
         self._g_desired = registry.gauge(
@@ -164,6 +198,28 @@ class Autoscaler:
             self._arrivals.popleft()
         return len(self._arrivals) / self.window_s
 
+    def migration_rate(self, now: Optional[float] = None) -> float:
+        """Prefill→decode handoffs per second over the trailing
+        ``window_s`` — the decode pool's OWN arrival rate, sampled as
+        deltas of the router's ``fleet_migrations`` counter.  Nobody
+        calls :meth:`observe_arrival` for migrations; the router's
+        counter is the ground truth, so the decode pool cannot be
+        mis-priced by a caller forgetting to report handoffs."""
+        counter = getattr(self.router, "_c_migrations", None)
+        if counter is None:
+            return 0.0
+        t = self._clock() if now is None else float(now)
+        self._migrations.append((t, float(counter.value())))
+        cutoff = t - self.window_s
+        # Keep one sample at/before the cutoff as the window baseline.
+        while len(self._migrations) >= 2 and self._migrations[1][0] <= cutoff:
+            self._migrations.popleft()
+        return max(
+            0.0,
+            (self._migrations[-1][1] - self._migrations[0][1])
+            / self.window_s,
+        )
+
     def request_service_time_s(self) -> float:
         """Seconds of replica time one request costs — the measured
         cost model's summed per-stage forward atoms × tokens per
@@ -196,66 +252,114 @@ class Autoscaler:
     # policy                                                             #
     # ------------------------------------------------------------------ #
 
-    def _active(self) -> int:
+    def _active(self, role: Optional[str] = None) -> int:
         return sum(
-            1 for r in self.router.replicas.values() if r.in_rotation
+            1 for name, r in self.router.replicas.items()
+            if r.in_rotation
+            and (role is None or self.roles.get(name) == role)
         )
 
-    def _slots_per_replica(self) -> int:
-        for rep in self.router.replicas.values():
+    def _pool_size(self, role: str) -> int:
+        return sum(1 for v in self.roles.values() if v == role)
+
+    def _slots_per_replica(self, role: Optional[str] = None) -> int:
+        for name, rep in self.router.replicas.items():
+            if role is not None and self.roles.get(name) != role:
+                continue
             pool = getattr(rep.engine, "pool", None)
             slots = getattr(pool, "num_slots", None)
             if slots:
                 return int(slots)
         return 1
 
-    def desired_replicas(self, now: Optional[float] = None) -> int:
+    def _alert_blames(self, role: str) -> bool:
+        """Whether any firing SLO alert's objective blames ``role`` —
+        phase-less objectives blame every pool (and a unified fleet's
+        single pool absorbs everything)."""
+        alerts = self.slo.active_alerts()
+        if not alerts:
+            return False
+        if not self.disaggregated:
+            return True
+        phase_of = {
+            o.name: getattr(o, "phase", None)
+            for o in getattr(self.slo, "objectives", ())
+        }
+        for alert in alerts:
+            name = alert[0] if isinstance(alert, tuple) else alert
+            if phase_of.get(name) in (None, role):
+                return True
+        return False
+
+    def desired_replicas(
+        self, now: Optional[float] = None, role: Optional[str] = None,
+    ) -> int:
         """The UNDAMPED verdict this tick: Little's-law demand, bumped
-        above active while an SLO alert burns, clamped to bounds."""
-        lam = self.arrival_rate(now)
+        above active while an SLO alert burns, clamped to bounds.  On a
+        disaggregated fleet pass ``role`` — the prefill pool is priced
+        by the admission window, the decode pool by the migration rate
+        (omitting it sums both pools' verdicts, the fleet total)."""
+        if role is None and self.disaggregated:
+            return sum(
+                self.desired_replicas(now, r) for r in self.role_order
+            )
+        lam = (
+            self.migration_rate(now) if role == "decode"
+            else self.arrival_rate(now)
+        )
         demand = lam * self.request_service_time_s() * self.headroom
         want = max(
             self.min_replicas,
-            math.ceil(demand / self._slots_per_replica() - 1e-9),
+            math.ceil(demand / self._slots_per_replica(role) - 1e-9),
         )
-        if self.slo is not None and self.slo.active_alerts():
-            want = max(want, self._active() + 1)
-        return min(max(want, self.min_replicas), self.max_replicas)
+        if self.slo is not None and self._alert_blames(role or "unified"):
+            want = max(want, self._active(role) + 1)
+        cap = (
+            min(self.max_replicas, self._pool_size(role))
+            if role is not None and self.disaggregated
+            else self.max_replicas
+        )
+        return min(max(want, self.min_replicas), cap)
 
     def tick(self, now: Optional[float] = None) -> Optional[str]:
-        """One policy evaluation: damp the instantaneous desired count
-        through hysteresis + cooldown, then park or un-park at most ONE
-        replica.  Returns the action taken or ``None``."""
+        """One policy evaluation: damp each pool's instantaneous
+        desired count through hysteresis + cooldown, then park or
+        un-park at most ONE replica fleet-wide.  Pools are visited in
+        fixed order (prefill first) so a tick where both pools want to
+        move is deterministic.  Returns the action taken or ``None``."""
         t = self._clock() if now is None else float(now)
-        desired = self.desired_replicas(t)
-        active = self._active()
-        self._g_desired.set(float(desired))
-        self._g_active.set(float(active))
-        direction = (desired > active) - (desired < active)
-        if direction == 0:
-            self._trend_dir = 0
-            self._trend_ticks = 0
-            return None
-        if direction == self._trend_dir:
-            self._trend_ticks += 1
-        else:
-            self._trend_dir = direction
-            self._trend_ticks = 1
-        if self._trend_ticks < self.hold_ticks:
-            return None
-        if (
-            self._last_resize_at is not None
-            and t - self._last_resize_at < self.cooldown_s
-        ):
-            return None
-        action = (
-            self._scale_up() if direction > 0 else self._scale_down()
-        )
-        if action is not None:
-            self._last_resize_at = t
-            self._trend_dir = 0
-            self._trend_ticks = 0
-            self._g_active.set(float(self._active()))
+        total_desired = 0
+        action: Optional[str] = None
+        for role in self.role_order:
+            pool = None if not self.disaggregated else role
+            desired = self.desired_replicas(t, pool)
+            active = self._active(pool)
+            total_desired += desired
+            trend = self._trend[role]
+            direction = (desired > active) - (desired < active)
+            if direction == 0:
+                trend[0] = trend[1] = 0
+                continue
+            if direction == trend[0]:
+                trend[1] += 1
+            else:
+                trend[0], trend[1] = direction, 1
+            if action is not None or trend[1] < self.hold_ticks:
+                continue
+            if (
+                self._last_resize_at is not None
+                and t - self._last_resize_at < self.cooldown_s
+            ):
+                continue
+            action = (
+                self._scale_up(pool) if direction > 0
+                else self._scale_down(pool)
+            )
+            if action is not None:
+                self._last_resize_at = t
+                trend[0] = trend[1] = 0
+        self._g_desired.set(float(total_desired))
+        self._g_active.set(float(self._active()))
         return action
 
     # ------------------------------------------------------------------ #
@@ -269,8 +373,10 @@ class Autoscaler:
             except Exception:  # noqa: BLE001 - telemetry is best-effort
                 pass
 
-    def _scale_down(self) -> Optional[str]:
-        if self._active() <= self.min_replicas:
+    def _scale_down(self, role: Optional[str] = None) -> Optional[str]:
+        # The floor is PER POOL: a starved decode pool cannot be robbed
+        # to feed prefill, however hard the admission window burns.
+        if self._active(role) <= self.min_replicas:
             return None
         # Deterministic victim: the last in-rotation replica by name —
         # scale-up un-parks in the reverse order, so the fleet breathes
@@ -278,26 +384,42 @@ class Autoscaler:
         candidates = sorted(
             name for name, rep in self.router.replicas.items()
             if rep.in_rotation
+            and (role is None or self.roles.get(name) == role)
         )
         victim = candidates[-1]
         moved = self.router.drain_replica(victim)
         self.parked.append(victim)
         self._c_resizes.inc(direction="down")
+        pool = "" if role is None else f" [{role}]"
         self._record(
-            f"down {victim}: {len(moved)} in-flight moved, "
+            f"down {victim}{pool}: {len(moved)} in-flight moved, "
             f"{self._active()} active"
         )
         return f"down:{victim}"
 
-    def _scale_up(self) -> Optional[str]:
-        if not self.parked or self._active() >= self.max_replicas:
+    def _scale_up(self, role: Optional[str] = None) -> Optional[str]:
+        cap = (
+            min(self.max_replicas, self._pool_size(role))
+            if role is not None else self.max_replicas
+        )
+        if self._active(role) >= cap:
             return None
-        name = self.parked.pop()
+        # LIFO within the pool: the most recently parked (warmest)
+        # compatible replica returns first.
+        name = next(
+            (n for n in reversed(self.parked)
+             if role is None or self.roles.get(n) == role),
+            None,
+        )
+        if name is None:
+            return None
+        self.parked.remove(name)
         rep = self.router.replicas[name]
         rep.draining = False
         rep.engine.resume_serving()
         self._c_resizes.inc(direction="up")
-        self._record(f"up {name}: {self._active()} active")
+        pool = "" if role is None else f" [{role}]"
+        self._record(f"up {name}{pool}: {self._active()} active")
         return f"up:{name}"
 
 

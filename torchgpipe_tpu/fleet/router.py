@@ -46,8 +46,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from torchgpipe_tpu.fleet import migration as _migration
 from torchgpipe_tpu.resilience import faults
 from torchgpipe_tpu.serving.engine import Engine
+from torchgpipe_tpu.serving.scheduler import Request
 
 
 class ReplicaDied(RuntimeError):
@@ -136,6 +138,42 @@ class Router:
             name: Replica(name=name, engine=eng)
             for name, eng in replicas.items()
         }
+        # Phase roles (disaggregated serving): the fleet is either all
+        # unified or a prefill pool + a decode pool — a mixed fleet
+        # would make placement ambiguous (may a unified replica take
+        # admissions? migrations?), so it is refused didactically.
+        self.roles: Dict[str, str] = {
+            name: getattr(eng, "role", "unified")
+            for name, eng in replicas.items()
+        }
+        role_set = set(self.roles.values())
+        self.disaggregated = role_set != {"unified"}
+        if self.disaggregated:
+            if "unified" in role_set:
+                raise ValueError(
+                    "mixed fleet: unified replicas cannot serve beside "
+                    "prefill/decode pools — build the whole fleet one "
+                    "way or the other"
+                )
+            if role_set != {"prefill", "decode"}:
+                missing = {"prefill", "decode"} - role_set
+                raise ValueError(
+                    f"disaggregated fleet needs both pools; missing "
+                    f"{sorted(missing)} — every admission prefills in "
+                    "the prefill pool and decodes in the decode pool"
+                )
+        self.pools: Dict[str, List[str]] = {}
+        for name, role in self.roles.items():
+            self.pools.setdefault(role, []).append(name)
+        if self.disaggregated:
+            # Fail an incompatible fleet at BUILD time, not mid-handoff:
+            # every prefill replica must be able to migrate to every
+            # decode replica (same cfg/max_len/kv layout).
+            for p in self.pools["prefill"]:
+                for d in self.pools["decode"]:
+                    _migration.validate_pools(
+                        replicas[p], replicas[d]
+                    )
         if registry is None:
             from torchgpipe_tpu.obs.registry import MetricsRegistry
 
@@ -176,6 +214,9 @@ class Router:
         self._c_moved = registry.counter(
             "fleet_moved_requests",
             help="in-flight requests resumed on another replica")
+        self._c_migrations = registry.counter(
+            "fleet_migrations",
+            help="prefill→decode KV handoffs at prompt completion")
         # SLO observe->act wiring (obs.slo.SloMonitor): the router
         # ticks the monitor once per step() and acts on its verdicts —
         # a breaching replica is degraded out of rotation (in-flight
@@ -237,14 +278,35 @@ class Router:
             tpot = got if got is not None else 0.0
         return float(occ), float(tpot)
 
-    def pick_replica(self, session: Optional[str] = None) -> str:
+    def pick_replica(
+        self, session: Optional[str] = None,
+        role: Optional[str] = None,
+    ) -> str:
         """Power-of-two-choices over in-rotation replicas (session
-        affinity first, when enabled and the pinned replica survives)."""
-        live = [r.name for r in self.replicas.values() if r.in_rotation]
+        affinity first, when enabled and the pinned replica survives).
+
+        In a disaggregated fleet the pick is POOL-scoped: admissions
+        and resumptions default to the prefill pool (every entry into
+        the fleet prefills first), and session pins bind only the
+        DECODE placement — sessions re-prefill anywhere, but their
+        multi-turn continuation rows live in one decode replica's pool,
+        so a pin names a decode replica and prefill picks neither read
+        nor write it."""
+        if role is None and self.disaggregated:
+            role = "prefill"
+        pool = (
+            [r.name for r in self.replicas.values()]
+            if role is None else self.pools.get(role, [])
+        )
+        live = [n for n in pool if self.replicas[n].in_rotation]
         if not live:
-            raise ReplicaDied("<all>", "no replica in rotation")
+            what = f"{role} replica" if role else "replica"
+            raise ReplicaDied("<all>", f"no {what} in rotation")
+        pin_applies = session is not None and (
+            not self.disaggregated or role == "decode"
+        )
         if (
-            session is not None
+            pin_applies
             and self.session_affinity
             and self._sessions.get(session) in live
         ):
@@ -256,7 +318,45 @@ class Router:
             i, j = self._rng.choice(len(live), size=2, replace=False)
             a, b = live[int(i)], live[int(j)]
             choice = min(a, b, key=self._load)
-        if session is not None:
+        if pin_applies:
+            self._sessions[session] = choice
+        return choice
+
+    def _decode_target(self, session: Optional[str]) -> Optional[str]:
+        """The decode replica to ingest one parked request: the
+        session-pinned replica when its pin survives (waiting for ITS
+        slot preserves multi-turn KV locality), else power-of-two-
+        choices over decode replicas WITH a free slot (ingest cannot
+        queue — the KV payload needs a slot now).  ``None`` means the
+        pool is momentarily full: re-park and retry next step (decode
+        progresses every step, so slots free up — no deadlock).
+        Raises :class:`ReplicaDied` when no decode replica is in
+        rotation at all."""
+        live = [
+            n for n in self.pools.get("decode", ())
+            if self.replicas[n].in_rotation
+        ]
+        if not live:
+            raise ReplicaDied("<all>", "no decode replica in rotation")
+        if session is not None and self.session_affinity:
+            pinned = self._sessions.get(session)
+            if pinned in live:
+                if self.replicas[pinned].engine.pool.num_free > 0:
+                    return pinned
+                return None      # wait for the pinned replica's slot
+        free = [
+            n for n in live
+            if self.replicas[n].engine.pool.num_free > 0
+        ]
+        if not free:
+            return None
+        self._update_load_gauges()
+        if len(free) == 1:
+            choice = free[0]
+        else:
+            i, j = self._rng.choice(len(free), size=2, replace=False)
+            choice = min(free[int(i)], free[int(j)], key=self._load)
+        if session is not None and self.session_affinity:
             self._sessions[session] = choice
         return choice
 
@@ -310,15 +410,14 @@ class Router:
         self._records[rid] = record
         return rid
 
-    def _submit_to(
-        self,
-        name: str,
-        record: RouterRecord,
-        prompt: np.ndarray,
-        max_new_tokens: int,
-        emitted_prefix: Sequence[int],
-    ) -> None:
-        record.replica = name
+    def _recording_on_token(
+        self, record: RouterRecord
+    ) -> Callable[[str, int], None]:
+        """The engine-facing token callback for one record: accumulate
+        into the router's own view (failover's source of truth), relay
+        to the client.  Re-created per placement — submission AND
+        migration ingest — always closing over the same record, so the
+        token list is continuous across replicas."""
 
         def recording_on_token(rid: str, tok: int) -> None:
             record.tokens.append(int(tok))
@@ -340,10 +439,21 @@ class Router:
                         rid=rid,
                     )
 
+        return recording_on_token
+
+    def _submit_to(
+        self,
+        name: str,
+        record: RouterRecord,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        emitted_prefix: Sequence[int],
+    ) -> None:
+        record.replica = name
         self.replicas[name].engine.submit(
             prompt, max_new_tokens,
             rid=record.rid, eos_id=record.eos_id,
-            on_token=recording_on_token,
+            on_token=self._recording_on_token(record),
             emitted_prefix=list(emitted_prefix),
         )
         self._c_routed.inc(replica=name)
@@ -376,6 +486,7 @@ class Router:
     def idle(self) -> bool:
         return all(
             rep.engine.scheduler.idle
+            and not rep.engine.migration_pending
             for rep in self.replicas.values()
             if rep.alive
         )
@@ -411,6 +522,11 @@ class Router:
                 ran = rep.engine.step()
                 if ran:
                     self._replica_steps[rep.name] += 1
+                if self.disaggregated and rep.engine.role == "prefill":
+                    # Hand freshly completed prompts to the decode pool
+                    # right after this replica's step — a prompt never
+                    # waits a full router round parked.
+                    ran = self._drive_migrations(rep) or ran
                 did = ran or did
             except Exception as death:  # noqa: BLE001 — any engine
                 # error that escapes the engine's own transient-retry
@@ -421,6 +537,67 @@ class Router:
                 did = True
         self._slo_tick()
         return did
+
+    def _drive_migrations(self, rep: Replica) -> bool:
+        """Migrate every request ``rep`` (a prefill replica) has parked
+        at prompt completion to the decode pool.  A request whose
+        target pool is momentarily full — or whose session-pinned
+        decode replica has no slot yet — re-parks and retries next
+        step; a decode replica that FAILS mid-ingest is failed over
+        (its own failover path) and the request re-parks, donor slot
+        intact.  Returns True when at least one handoff completed."""
+        eng = rep.engine
+        if not eng.migration_pending:
+            return False
+        moved = False
+        parked: List[Request] = []
+        for req in eng.take_migration_ready():
+            record = self._records.get(req.rid)
+            session = record.session if record is not None else None
+            try:
+                target = self._decode_target(session)
+            except ReplicaDied:
+                # No decode replica in rotation: stay parked — the
+                # pool coming back (readmit / scale-up) picks these up.
+                self._record_event(
+                    "migrate_wait",
+                    detail=f"{req.rid}: no decode replica in rotation",
+                    rid=req.rid,
+                )
+                parked.append(req)
+                continue
+            if target is None:          # decode pool full right now
+                parked.append(req)
+                continue
+            try:
+                _migration.migrate(
+                    eng, self.replicas[target].engine, req,
+                    on_token=(
+                        self._recording_on_token(record)
+                        if record is not None else None
+                    ),
+                )
+            except Exception as death:  # noqa: BLE001 — the TARGET
+                # broke mid-ingest (the donor slot is untouched: the
+                # handoff frees it only after ingest succeeds).  Evict
+                # the decode replica and re-park the request.
+                self.failover(target, death)
+                parked.append(req)
+                continue
+            if record is not None:
+                record.replica = target
+            self._c_migrations.inc()
+            self._record_event(
+                "kv_migrate",
+                detail=(
+                    f"{req.rid}: {rep.name}->{target} "
+                    f"rows={req.prompt_len}"
+                ),
+                rid=req.rid,
+            )
+            moved = True
+        eng._migration_ready.extend(parked)
+        return moved
 
     def reset_replica_steps(self) -> None:
         """Re-zero the per-replica step clocks ``die_at_step`` keys on
@@ -507,7 +684,10 @@ class Router:
         return [
             r.rid
             for r in (*eng.scheduler.queue,
-                      *eng.scheduler.active.values())
+                      *eng.scheduler.active.values(),
+                      # migration-parked work (prefill role; absent on
+                      # policy-test engine facades)
+                      *getattr(eng, "_migration_ready", ()))
         ]
 
     def _resubmit(self, kwargs: List[Dict[str, Any]]) -> None:
@@ -525,7 +705,16 @@ class Router:
                 if pinned is None or not pinned.in_rotation:
                     self._sessions.pop(record.session, None)
             source = record.replica
-            target = self.pick_replica(record.session)
+            # EVERY resumption re-prefills (the snapshot teacher-forces
+            # prompt + emitted tokens), so in a disaggregated fleet the
+            # target is always the PREFILL pool — decode replicas never
+            # run prefill programs.  A resumed stream then re-migrates
+            # to a decode survivor at prompt completion, which is where
+            # "decode in-flight resumes on decode survivors" lands.
+            target = self.pick_replica(
+                record.session,
+                role="prefill" if self.disaggregated else None,
+            )
             self._submit_to(
                 target, record, kw["prompt"], kw["max_new_tokens"],
                 emitted_prefix=kw["emitted_prefix"],
@@ -674,8 +863,22 @@ class Router:
         self.slo.tick()
         # Only replica-split objectives may drive eviction: a tenant-
         # split breach whose tenant id collides with a replica name
-        # must not read as that replica's verdict.
-        breaching = self.slo.breaching(split_by="replica")
+        # must not read as that replica's verdict.  In a disaggregated
+        # fleet the verdict is additionally PHASE-SCOPED: a replica is
+        # blamed only by objectives declared for its own pool (TTFT →
+        # prefill, TPOT → decode; phase-less objectives blame anyone),
+        # so a prefill burst inflating TTFT can never evict a healthy
+        # decode replica.
+        if self.disaggregated:
+            breaching = set()
+            for role, names in self.pools.items():
+                breaching |= (
+                    set(self.slo.breaching(split_by="replica",
+                                           phase=role))
+                    & set(names)
+                )
+        else:
+            breaching = self.slo.breaching(split_by="replica")
         now = self._clock()
         for name, rep in self.replicas.items():
             if rep.degraded and rep.alive and name not in breaching:
@@ -683,8 +886,14 @@ class Router:
                 if since >= self.slo_cooldown_s:
                     self.readmit(name)
             elif rep.in_rotation and name in breaching:
+                # The min-in-rotation brake counts the breacher's OWN
+                # pool: evicting the last prefill replica (or the last
+                # decode one) stops the whole fleet just as surely as
+                # evicting the last unified replica.
                 in_rotation = sum(
-                    1 for r in self.replicas.values() if r.in_rotation
+                    1 for r in self.replicas.values()
+                    if r.in_rotation
+                    and self.roles[r.name] == self.roles[name]
                 )
                 if in_rotation <= self.slo_min_in_rotation:
                     self._record_event(

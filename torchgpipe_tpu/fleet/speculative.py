@@ -97,6 +97,14 @@ class SpeculativeEngine(Engine):
                 "models.generation.speculative_generate, which "
                 "implements the sampled acceptance rule) for sampling"
             )
+        if engine_kwargs.get("role", "unified") != "unified":
+            raise ValueError(
+                "SpeculativeEngine is unified-only: a speculative round "
+                "interleaves draft decode with a verify pass through "
+                "the prefill program, so neither phase-role's reduced "
+                "program set can host it — disaggregate at the fleet "
+                "level with plain prefill/decode engines instead"
+            )
         if engine_kwargs.get("prefix_cache") is not None:
             raise ValueError(
                 "prefix_cache + speculative decoding in ONE engine is "

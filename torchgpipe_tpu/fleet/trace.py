@@ -61,6 +61,7 @@ class TraceStats:
     burst_arrivals: int = 0
     total_prompt_tokens: int = 0
     shared_prefix_tokens: int = 0
+    burst_prompt_tokens: int = 0
     last_arrival_s: float = 0.0
     per_tenant: Dict[int, int] = dataclasses.field(default_factory=dict)
 
@@ -101,6 +102,14 @@ class TraceConfig:
     p_exit_burst: float = 0.3
     # Fraction of requests that continue an existing tenant session.
     p_continue_session: float = 0.3
+    # Burst-state length overrides (None = bursts change ONLY arrival
+    # timing, the pre-disaggregation behaviour — traces generated under
+    # old configs stay byte-identical).  Set to shift the burst state's
+    # suffix-length / generation-budget ranges, e.g. long-prompt
+    # prefill storms over a short-prompt base load — the mix
+    # phase-disaggregated serving exists for.
+    burst_suffix_len: Optional[Tuple[int, int]] = None
+    burst_new_tokens: Optional[Tuple[int, int]] = None
 
 
 def tenant_prefixes(cfg: TraceConfig) -> List[np.ndarray]:
@@ -157,11 +166,17 @@ def synthetic_trace(
             if len(sessions) > 64:      # bounded memory at 1e6 requests
                 sessions.pop(0)
         prefix = prefixes[tenant]
-        suffix_n = int(rng.randint(cfg.suffix_len[0],
-                                   cfg.suffix_len[1] + 1))
+        s_lo, s_hi = cfg.suffix_len
+        n_lo, n_hi = cfg.new_tokens
+        if burst:
+            if cfg.burst_suffix_len is not None:
+                s_lo, s_hi = cfg.burst_suffix_len
+            if cfg.burst_new_tokens is not None:
+                n_lo, n_hi = cfg.burst_new_tokens
+        suffix_n = int(rng.randint(s_lo, s_hi + 1))
         suffix = rng.randint(0, cfg.vocab, (suffix_n,)).astype(np.int32)
         prompt = np.concatenate([prefix, suffix])
-        new = int(rng.randint(cfg.new_tokens[0], cfg.new_tokens[1] + 1))
+        new = int(rng.randint(n_lo, n_hi + 1))
         if prompt.size + new > cfg.max_len:
             # The honesty rule: count, never silently shrink.
             if stats is not None:
@@ -182,6 +197,7 @@ def synthetic_trace(
             # under heavy skipping.
             if burst:
                 stats.burst_arrivals += 1
+                stats.burst_prompt_tokens += int(prompt.size)
             stats.generated += 1
             stats.total_prompt_tokens += int(prompt.size)
             stats.shared_prefix_tokens += int(prefix.size)
@@ -191,6 +207,36 @@ def synthetic_trace(
             )
         emitted += 1
         yield req
+
+
+def prefill_heavy_config(
+    n_requests: int,
+    seed: int = 0,
+    max_len: int = 64,
+    **overrides: object,
+) -> TraceConfig:
+    """The disaggregation stress mix: a short-prompt, decode-dominated
+    base load punctuated by bursts of LONG prompts with small budgets —
+    prefill storms.  On a unified fleet every storm steals decode
+    iterations from in-flight streams (TPOT spikes); a phase-split
+    fleet absorbs it in the prefill pool (``bench.py --disagg``
+    measures exactly this).  Deterministic per (n_requests, seed,
+    max_len); keyword overrides replace any field."""
+    burst_lo = max_len // 2
+    cfg = dict(
+        n_requests=n_requests,
+        seed=seed,
+        max_len=max_len,
+        prefix_len=(4, 6),
+        suffix_len=(1, 4),
+        new_tokens=(6, 12),
+        burst_suffix_len=(burst_lo, max(burst_lo, max_len - 14)),
+        burst_new_tokens=(2, 4),
+        p_enter_burst=0.15,
+        p_exit_burst=0.35,
+    )
+    cfg.update(overrides)
+    return TraceConfig(**cfg)  # type: ignore[arg-type]
 
 
 def trace_summary(cfg: TraceConfig,
@@ -219,6 +265,7 @@ __all__ = [
     "TraceConfig",
     "TraceRequest",
     "TraceStats",
+    "prefill_heavy_config",
     "synthetic_trace",
     "tenant_prefixes",
     "trace_summary",

@@ -47,8 +47,9 @@ import json
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # Engine-side event kinds that belong INSIDE a replica attempt (must be
-# parented by a req_submit on the same replica).  Router-side kinds
-# (route, req_move, callback_error) attach to the request root.
+# parented by a req_submit — or, on a decode-pool replica, a req_ingest
+# — on the same replica).  Router-side kinds (route, req_move,
+# kv_migrate, callback_error) attach to the request root.
 ATTEMPT_KINDS = (
     "req_admit",
     "req_prefix_copy",
@@ -57,7 +58,22 @@ ATTEMPT_KINDS = (
     "req_spec_round",
     "req_finish",
     "req_preempt",
+    "req_handoff",
 )
+
+# Event kinds that OPEN a replica attempt: req_submit on an admission
+# (unified or prefill pool), req_ingest when a migrated request arrives
+# mid-stream on a decode replica (which never sees a submit).
+_ATTEMPT_OPENERS = ("req_submit", "req_ingest")
+
+
+def _phase_of(detail: str) -> str:
+    """The ``phase=<pool>`` tag a disaggregated engine stamps on its
+    attempt-opening events (empty for unified replicas)."""
+    for tok in detail.split():
+        if tok.startswith("phase="):
+            return tok[len("phase="):]
+    return ""
 
 
 @dataclasses.dataclass
@@ -161,6 +177,7 @@ def _child_span(replica: str, kind: str, at: float,
         "req_spec_round": "spec_round",
         "req_finish": "finish",
         "req_preempt": "preempt",
+        "req_handoff": "handoff",
     }.get(kind, kind)
     if dur is not None:
         return Span(name, replica, at - float(dur), at, detail)
@@ -186,8 +203,11 @@ def stitch_request(dumps: Sequence[Any], rid: str) -> RequestTrace:
         )
     rows.sort(key=lambda r: (r[0], r[1]))
 
-    # Attempts: one per req_submit, in aligned-time order.
+    # Attempts: one per opener (req_submit / req_ingest), in
+    # aligned-time order; openers remembered so the migration span
+    # between two attempts can say WHICH kind of move it was.
     attempts: List[Span] = []
+    opened_by: List[str] = []
     # Latest open attempt per replica (attempt events parent into it).
     open_attempt: Dict[str, Span] = {}
     root_children: List[Span] = []
@@ -196,9 +216,12 @@ def stitch_request(dumps: Sequence[Any], rid: str) -> RequestTrace:
         kind = str(e.kind)
         dur = getattr(e, "dur", None)
         detail = str(getattr(e, "detail", "") or "")
-        if kind == "req_submit":
-            span = Span(f"attempt@{replica}", replica, at, at, detail)
+        if kind in _ATTEMPT_OPENERS:
+            phase = _phase_of(detail)
+            label = f"attempt@{replica}" + (f":{phase}" if phase else "")
+            span = Span(label, replica, at, at, detail)
             attempts.append(span)
+            opened_by.append(kind)
             open_attempt[replica] = span
         elif kind in ATTEMPT_KINDS:
             parent = open_attempt.get(replica)
@@ -229,7 +252,11 @@ def stitch_request(dumps: Sequence[Any], rid: str) -> RequestTrace:
                 attempt.replica,
                 prev.t1,
                 max(attempt.t0, prev.t1),
-                "in-flight move (failover/drain)",
+                (
+                    "kv handoff (prefill→decode)"
+                    if opened_by[i] == "req_ingest"
+                    else "in-flight move (failover/drain)"
+                ),
             ))
         children.append(attempt)
     # Router instants slot in by time, after the attempt list is built.

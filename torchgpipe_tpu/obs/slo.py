@@ -71,8 +71,21 @@ class Objective:
     total_series: Optional[str] = None
     budget: Optional[float] = None
     split_by: str = "replica"
+    # Which pool of a phase-disaggregated fleet this objective judges:
+    # TTFT objectives belong to the prefill pool (first tokens sample
+    # there), TPOT to the decode pool (streams finish there).  ``None``
+    # judges every replica — the only sensible setting for a unified
+    # fleet.  The router's evict decision filters on it (and the
+    # autoscaler prices each pool by its own phase's objectives), so
+    # burn blame lands on the pool that owns the latency.
+    phase: Optional[str] = None
 
     def __post_init__(self) -> None:
+        if self.phase not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"objective phase must be None | 'prefill' | 'decode', "
+                f"got {self.phase!r}"
+            )
         if self.kind not in ("latency", "error_rate"):
             raise ValueError(
                 f"objective kind must be 'latency' or 'error_rate', "
@@ -375,17 +388,24 @@ class SloMonitor:
         """Currently firing (objective, split) pairs."""
         return sorted(self._active)
 
-    def breaching(self, split_by: Optional[str] = None) -> Set[str]:
+    def breaching(
+        self, split_by: Optional[str] = None,
+        phase: Optional[str] = None,
+    ) -> Set[str]:
         """Split values with ANY objective currently firing.  Pass
         ``split_by="replica"`` to restrict to objectives split on that
         label — the router's evict decision does, so a per-TENANT
         objective whose tenant id happens to equal a replica name can
-        never evict that replica."""
+        never evict that replica.  ``phase`` additionally restricts to
+        objectives declared for that pool (phase-less objectives always
+        qualify) — a disaggregated router asks per pool, so a TTFT
+        breach can only ever blame prefill replicas."""
         by_name = {o.name: o for o in self.objectives}
         return {
             split
             for name, split in self._active
-            if split_by is None or by_name[name].split_by == split_by
+            if (split_by is None or by_name[name].split_by == split_by)
+            and (phase is None or by_name[name].phase in (None, phase))
         }
 
 

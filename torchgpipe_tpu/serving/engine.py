@@ -134,6 +134,7 @@ class Engine:
         guard_policy: Optional[GuardPolicy] = None,
         sleep: Callable[[float], None] = time.sleep,
         donate: bool = False,
+        role: str = "unified",
     ) -> None:
         self.cfg = cfg
         self.params = list(params)
@@ -149,6 +150,26 @@ class Engine:
         # (certified by ``analysis.serving.lint_serving``).
         self.prefill_buckets = normalize_buckets(prefill_chunk)
         self.prefill_chunk = self.prefill_buckets[-1]
+        # Phase role (disaggregated serving, DistServe/Splitwise-style):
+        # a ``prefill`` engine runs ONLY the bucket ladder and parks each
+        # request at prompt completion for migration to a decode replica;
+        # a ``decode`` engine runs ONLY ``decode`` + the fixed-shape
+        # ``migrate_ingest`` program and receives work exclusively via
+        # :meth:`ingest_migration`.  ``unified`` is the classic engine.
+        # Disaggregation strictly SHRINKS each replica's program set —
+        # ``analysis.serving.certify_disagg`` proves the per-role bound.
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'unified' | 'prefill' | 'decode', "
+                f"got {role!r}"
+            )
+        self.role = role
+        if role == "decode" and prefix_cache is not None:
+            raise ValueError(
+                "a decode-role engine never prefills, so a prefix cache "
+                "would never be consulted — attach it to the prefill "
+                "pool, whose completed prompts become the donors"
+            )
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
@@ -229,6 +250,11 @@ class Engine:
         if preemption is not None and hasattr(preemption, "add_callback"):
             preemption.add_callback(self.request_drain)
         self._requests: Dict[str, Request] = {}
+        # Requests parked at prompt completion on a prefill-role engine,
+        # awaiting handoff to the decode pool: OUT of the scheduler (no
+        # step touches them) but still holding their slot — the KV rows
+        # ARE the migration payload, freed by :meth:`complete_migration`.
+        self._migration_ready: List[Request] = []
         self._cur_tok = np.zeros((num_slots,), np.int32)
         # Device-resident slot frontiers: the compiled steps RETURN the
         # advanced lengths vector, so steady-state decode re-feeds the
@@ -246,24 +272,25 @@ class Engine:
         # shapes: the real steps and the lint's step_input_specs() both
         # read this, so a shape that churned with the request mix could
         # not hide.
-        self._prefill_names = {
-            g: (
-                "prefill" if len(self.prefill_buckets) == 1
-                else f"prefill@{g}"
-            )
-            for g in self.prefill_buckets
-        }
+        self._prefill_names = (
+            {} if role == "decode" else {
+                g: (
+                    "prefill" if len(self.prefill_buckets) == 1
+                    else f"prefill@{g}"
+                )
+                for g in self.prefill_buckets
+            }
+        )
         self.trace_counts = {
-            **{name: 0 for name in self._prefill_names.values()},
-            "decode": 0,
+            name: 0 for name in self._prefill_names.values()
         }
         self._token_shapes = {
-            **{
-                name: (num_slots, g)
-                for g, name in self._prefill_names.items()
-            },
-            "decode": (num_slots, 1),
+            name: (num_slots, g)
+            for g, name in self._prefill_names.items()
         }
+        if role != "prefill":
+            self.trace_counts["decode"] = 0
+            self._token_shapes["decode"] = (num_slots, 1)
         self._build_programs()
 
     # ------------------------------------------------------------------ #
@@ -324,7 +351,62 @@ class Engine:
             name: jax.jit(prefill_body_for(g, name), donate_argnums=donate)
             for g, name in self._prefill_names.items()
         }
-        self._decode_fn = jax.jit(decode_body, donate_argnums=donate)
+        self._decode_fn = (
+            None if self.role == "prefill"
+            else jax.jit(decode_body, donate_argnums=donate)
+        )
+
+        self._ingest_fn = None
+        if self.role == "decode":
+            counts["migrate_ingest"] = 0
+            L = self.pool.max_len
+
+            def ingest_body(cache, rows, dst, n):
+                # The cross-pool twin of ``prefix_copy_body``: write a
+                # migrated request's shipped KV rows (one slot's worth,
+                # slot axis sliced away — see ``export_kv_rows``) into
+                # rows [0, n) of slot ``dst``, every layer, K, V and
+                # int8 scales.  dst/n are traced VALUES — ONE
+                # fixed-shape program serves every migration, keeping
+                # the decode pool's program count at exactly two.
+                # Bitwise: the donor rows are what this pool's own
+                # prefill of the same tokens at the same positions
+                # would have written (prefill is replica-independent —
+                # the disagg-verify gate), so decode resumes the greedy
+                # stream unchanged.
+                counts["migrate_ingest"] += 1
+                row_mask = jnp.arange(L) < n          # [L]
+
+                def put_len_axis(bank, row, axis):
+                    # ``axis`` is the BANK's length axis; the shipped
+                    # row lost the slot axis, so its length axis (and
+                    # the mask's) sits at ``axis - 1``.
+                    shape = [1] * (bank.ndim - 1)
+                    shape[axis - 1] = L
+                    m = row_mask.reshape(shape)
+                    merged = jnp.where(m, row, bank[dst])
+                    return bank.at[dst].set(merged)
+
+                k = [put_len_axis(b, r, 1)
+                     for b, r in zip(cache.k, rows["k"])]
+                v = [put_len_axis(b, r, 1)
+                     for b, r in zip(cache.v, rows["v"])]
+                if isinstance(cache, QuantKVCache):
+                    return QuantKVCache(
+                        k=k, v=v,
+                        k_scale=[put_len_axis(b, r, 2)
+                                 for b, r in zip(cache.k_scale,
+                                                 rows["k_scale"])],
+                        v_scale=[put_len_axis(b, r, 2)
+                                 for b, r in zip(cache.v_scale,
+                                                 rows["v_scale"])],
+                        length=cache.length,
+                    )
+                return KVCache(k=k, v=v, length=cache.length)
+
+            self._ingest_fn = jax.jit(
+                ingest_body, donate_argnums=(0,) if self.donate else ()
+            )
 
         self._prefix_copy_fn = None
         if self._prefix_cache is not None:
@@ -375,8 +457,15 @@ class Engine:
         program per ladder bucket plus the decode program (plus the one
         fixed-shape ``prefix_copy`` program when a prefix cache is
         attached) — the figure ``analysis.serving`` certifies and the
-        compile-counter test confirms dynamically."""
+        compile-counter test confirms dynamically.  Disaggregation
+        SHRINKS the bound per replica: a prefill pool drops the decode
+        program, a decode pool is exactly ``decode`` +
+        ``migrate_ingest``."""
         extra = 1 if self._prefix_cache is not None else 0
+        if self.role == "prefill":
+            return len(self.prefill_buckets) + extra
+        if self.role == "decode":
+            return 2
         return len(self.prefill_buckets) + 1 + extra
 
     def step_input_specs(self) -> Dict[str, Any]:
@@ -406,7 +495,32 @@ class Engine:
                 "cache": cache_spec, "src": scalar, "dst": scalar,
                 "n": scalar,
             }
+        if self._ingest_fn is not None:
+            scalar = sds((), np.int32)
+            specs["migrate_ingest"] = {
+                "cache": cache_spec, "rows": self.kv_row_specs(),
+                "dst": scalar, "n": scalar,
+            }
         return specs
+
+    def kv_row_specs(self) -> Dict[str, Any]:
+        """The (shape, dtype) signature of ONE slot's migration payload:
+        per-layer KV rows (+ int8 scale rows) with the slot axis sliced
+        away — exactly what :meth:`export_kv_rows` produces and the
+        ``migrate_ingest`` program consumes.  Cross-pool compatibility
+        in a disaggregated fleet is certified by comparing these specs
+        between the prefill and decode engines
+        (``analysis.serving.certify_disagg``)."""
+        sds = jax.ShapeDtypeStruct
+        c = self.pool.cache
+        rows: Dict[str, Any] = {
+            "k": [sds(b.shape[1:], b.dtype) for b in c.k],
+            "v": [sds(b.shape[1:], b.dtype) for b in c.v],
+        }
+        if isinstance(c, QuantKVCache):
+            rows["k_scale"] = [sds(b.shape[1:], b.dtype) for b in c.k_scale]
+            rows["v_scale"] = [sds(b.shape[1:], b.dtype) for b in c.v_scale]
+        return rows
 
     def _token_buffer(self, kind: str) -> np.ndarray:
         return np.zeros(self._token_shapes[kind], np.int32)
@@ -511,11 +625,16 @@ class Engine:
         """Queue a request; returns its id.  Admission happens between
         engine iterations (a free slot + the admission cap permitting).
         """
+        if self.role == "decode":
+            raise ValueError(
+                "decode-role engine: work arrives via ingest_migration() "
+                "from a prefill replica, never submit() — route "
+                "admissions to the prefill pool"
+            )
         if rid is None:
             self._rid_counter += 1
             rid = f"r{self._rid_counter}"
-        if rid in self._requests:
-            raise ValueError(f"duplicate request id {rid!r}")
+        self._check_rid_free(rid)
         req = Request(
             rid=rid,
             prompt=np.asarray(prompt, np.int32).reshape(-1),
@@ -530,14 +649,28 @@ class Engine:
         # Recorded only AFTER validation accepted the request — a
         # rejected submit must leave no phantom span behind (the same
         # contract the router keeps for its records).
+        phase = "" if self.role == "unified" else f" phase={self.role}"
         self._rec(
             "req_submit", rid,
             detail=(
                 f"prompt={req.prompt_len} new={req.max_new_tokens} "
-                f"queued={self.scheduler.queue_depth}"
+                f"queued={self.scheduler.queue_depth}{phase}"
             ),
         )
         return rid
+
+    def _check_rid_free(self, rid: str) -> None:
+        """A rid may legitimately RETURN to an engine that served it
+        before — failover and drain/unpark cycles bounce unfinished
+        requests between replicas, and in a disaggregated fleet every
+        resumption re-prefills before re-migrating — but only once its
+        prior incarnation here is inert.  A still-live duplicate is a
+        real bug and stays an error."""
+        old = self._requests.get(rid)
+        if old is not None and old.status in (
+            "queued", "active", "migrating", "finished"
+        ):
+            raise ValueError(f"duplicate request id {rid!r}")
 
     def cancel(self, rid: str) -> bool:
         ok = self.scheduler.cancel(rid)
@@ -737,8 +870,159 @@ class Engine:
                 "req_finish", req.rid,
                 detail=f"status=finished tokens={len(req.tokens())}",
             )
+        elif self.role == "prefill":
+            # Prompt complete, stream live: the decode phase belongs to
+            # the decode pool.  Park the request OUT of the scheduler
+            # (no step may touch it again here) with its slot still
+            # held — the KV rows are the migration payload, released by
+            # complete_migration() once a decode replica has ingested
+            # them.  Requests finishing on their first token never park.
+            req.status = "migrating"
+            self.scheduler.active.pop(req.rid, None)
+            self._migration_ready.append(req)
+            self._flush_decode_group(req.rid)
         else:
             self._cur_tok[req.slot] = token
+
+    # ------------------------------------------------------------------ #
+    # KV migration (disaggregated serving)                               #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def migration_pending(self) -> bool:
+        """Requests parked at prompt completion, awaiting handoff to a
+        decode replica (prefill role only)."""
+        return bool(self._migration_ready)
+
+    def take_migration_ready(self) -> List[Request]:
+        """Pop the parked requests (the router hands each to
+        :func:`torchgpipe_tpu.fleet.migration.migrate`); append back to
+        ``_migration_ready`` to re-park one the decode pool cannot take
+        yet."""
+        out = self._migration_ready
+        self._migration_ready = []
+        return out
+
+    def export_kv_rows(self, req: Request) -> Dict[str, Any]:
+        """One slot's migration payload: per-layer KV rows (+ int8
+        scale rows) with the slot axis sliced away.  Device-array views
+        — zero-copy for an in-process handoff; ``np.asarray`` each leaf
+        to stage the snapshot across a process boundary (the
+        drain-schema flavor).  Shapes/dtypes match
+        :meth:`kv_row_specs`."""
+        if req.slot is None:
+            raise ValueError(
+                f"request {req.rid!r} holds no slot — nothing to export"
+            )
+        slot = req.slot
+        c = self.pool.cache
+        rows: Dict[str, Any] = {
+            "k": [b[slot] for b in c.k],
+            "v": [b[slot] for b in c.v],
+        }
+        if isinstance(c, QuantKVCache):
+            rows["k_scale"] = [b[slot] for b in c.k_scale]
+            rows["v_scale"] = [b[slot] for b in c.v_scale]
+        return rows
+
+    def complete_migration(self, req: Request) -> None:
+        """Donor-side epilogue: the decode replica has ingested the KV
+        rows — free the slot (a prefix-cache donor pin, if any, keeps
+        the rows alive for future hits) and close the books here."""
+        req.status = "migrated"
+        self.scheduler.release(req)
+        self.metrics.migrated_out(req.rid)
+        self._rec(
+            "req_handoff", req.rid,
+            detail=f"phase={self.role} emitted={len(req.generated)}",
+        )
+
+    def ingest_migration(
+        self,
+        *,
+        rid: str,
+        prompt: Any,
+        max_new_tokens: int,
+        rows: Dict[str, Any],
+        last_token: int,
+        eos_id: Optional[int] = None,
+        on_token: Optional[Callable[[str, int], None]] = None,
+        emitted_prefix: Sequence[int] = (),
+    ) -> str:
+        """Receive a mid-stream request from a prefill replica: allocate
+        a slot, write the shipped KV ``rows`` through the fixed-shape
+        ``migrate_ingest`` program, and register the request exactly as
+        a unified engine would hold it after emitting its first token
+        (``last_token``) — so the decode stream continues bitwise.
+
+        Deliberately BYPASSES admission: no queue, no prefix-cache
+        consult (a migrated request whose prompt was a prefix hit on
+        the donor must not re-pin donor slots here), no re-fire of
+        ``on_token`` for the carried token (the donor already streamed
+        it).  ``max_new_tokens`` is the request's ORIGINAL budget; the
+        carried token counts against it.  Raises ``RuntimeError`` when
+        the pool has no free slot — the router re-parks and retries
+        once decode slots free up."""
+        if self.role != "decode":
+            raise ValueError(
+                "ingest_migration is the decode pool's entry point — "
+                f"this engine's role is {self.role!r}"
+            )
+        self._check_rid_free(rid)
+        req = Request(
+            rid=rid,
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=int(max_new_tokens),
+            eos_id=eos_id,
+            on_token=on_token,
+            emitted_prefix=list(emitted_prefix),
+        )
+        if req.prompt_len + req.max_new_tokens > self.pool.max_len:
+            raise ValueError(
+                f"request {rid!r}: prompt ({req.prompt_len}) + "
+                f"max_new_tokens ({req.max_new_tokens}) exceeds this "
+                f"pool's max_len={self.pool.max_len} — a disaggregated "
+                "fleet needs equal max_len across roles"
+            )
+        slot = self.pool.alloc(rid)
+        if slot is None:
+            raise RuntimeError(
+                "decode pool full: no free slot for migrated request "
+                f"{rid!r} — retry when a stream finishes"
+            )
+        rows_dev = jax.tree_util.tree_map(jnp.asarray, rows)
+        t0 = self._rec_clock()
+        try:
+            new_cache = self._dispatch(
+                self._ingest_fn, self.pool.cache, rows_dev,
+                jnp.int32(slot), jnp.int32(req.prompt_len),
+            )
+        except Exception:
+            # Register NOTHING on failure: the router's failover of a
+            # replica that broke mid-ingest must find it clean — the
+            # request is still parked on the donor, slot and all.
+            self.pool.free(slot)
+            raise
+        req.slot = slot
+        req.status = "active"
+        req.prefilled = req.prompt_len
+        req.generated = [int(last_token)]   # emitted on the donor
+        self._requests[rid] = req
+        self.scheduler.active[rid] = req
+        self.metrics.arrived(rid)
+        self.metrics.ingested(rid)
+        self.pool.cache = new_cache
+        self.pool.lengths[slot] = req.prompt_len  # shadow miss → upload
+        self._cur_tok[slot] = int(last_token)
+        self._rec(
+            "req_ingest", rid,
+            dur=max(self._rec_clock() - t0, 0.0),
+            detail=(
+                f"phase=decode rows={req.prompt_len} slot={slot} "
+                f"emitted={len(req.generated)}"
+            ),
+        )
+        return rid
 
     def run(self, max_steps: Optional[int] = None) -> str:
         """Iterate until idle, preempted, or ``max_steps``.  Returns
@@ -786,8 +1070,14 @@ class Engine:
         slots, and — when a CheckpointManager is wired — persist the
         snapshot.  Returns the snapshot dict."""
         self._draining = True
-        unfinished = list(self.scheduler.queue) + list(
-            self.scheduler.active.values()
+        # Migration-parked requests (prefill role) are in-flight too:
+        # they left the scheduler but not the replica — a drain must
+        # snapshot them or a dying prefill replica would strand every
+        # prompt caught between completion and handoff.
+        unfinished = (
+            list(self.scheduler.queue)
+            + list(self.scheduler.active.values())
+            + list(self._migration_ready)
         )
         tree: Dict[str, Dict[str, np.ndarray]] = {}
         meta: Dict[str, Dict[str, Any]] = {}
@@ -817,6 +1107,10 @@ class Engine:
         for r in list(self.scheduler.queue):
             r.status = "preempted"
         self.scheduler.queue.clear()
+        for r in self._migration_ready:
+            r.status = "preempted"
+            self.scheduler.release(r)   # frees the held slot
+        self._migration_ready.clear()
         self.metrics.drained(len(unfinished))
         for rid in meta:
             self.metrics.finished(rid, status="preempted")

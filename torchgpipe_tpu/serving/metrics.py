@@ -114,6 +114,13 @@ class ServingMetrics:
             "serving_prefix_reused_tokens",
             help="prompt tokens absorbed by KV-prefix copies "
                  "(prefill FLOPs avoided)")
+        self._c_migr_out = reg.counter(
+            "serving_migrations_out",
+            help="requests handed off to a decode replica at prompt "
+                 "completion (phase-disaggregated fleets)")
+        self._c_migr_in = reg.counter(
+            "serving_migrations_in",
+            help="requests ingested mid-stream from a prefill replica")
         self._h_ttft = reg.histogram(
             "serving_ttft_seconds", help="time to first token (arrival→)")
         self._h_tpot = reg.histogram(
@@ -134,6 +141,8 @@ class ServingMetrics:
     preempted_requests = _counter_property("_c_preempted")
     prefix_hits = _counter_property("_c_prefix_hits")
     prefix_reused_tokens = _counter_property("_c_prefix_tokens")
+    migrations_out = _counter_property("_c_migr_out")
+    migrations_in = _counter_property("_c_migr_in")
 
     # ------------------------------------------------------------------ #
     # request lifecycle                                                  #
@@ -163,6 +172,29 @@ class ServingMetrics:
                 self._h_ttft.observe(ttft)
         r.tokens += 1
         self._c_tokens.inc()
+
+    def ingested(self, rid: str) -> None:
+        """A migrated request arriving mid-stream (disaggregated
+        serving): its FIRST token was emitted on the donor prefill
+        replica, so this engine's first emission must count toward
+        TPOT, never as a second TTFT — ``first_token`` is stamped now
+        and ``tokens`` starts at the one token already streamed."""
+        r = self.requests[rid]
+        t = self._clock()
+        if r.admitted is None:
+            r.admitted = t
+        r.status = "active"
+        r.first_token = t
+        r.tokens = 1
+        self._c_migr_in.inc()
+
+    def migrated_out(self, rid: str) -> None:
+        """The donor side of :meth:`ingested`: the request left this
+        replica at prompt completion.  No latency histogram fires —
+        the stream continues elsewhere; only the handoff is counted."""
+        r = self.requests[rid]
+        r.status = "migrated"
+        self._c_migr_out.inc()
 
     def finished(self, rid: str, status: str = "finished") -> None:
         r = self.requests[rid]
@@ -241,6 +273,8 @@ class ServingMetrics:
             "preempted_requests": self.preempted_requests,
             "prefix_hits": self.prefix_hits,
             "prefix_reused_tokens": self.prefix_reused_tokens,
+            "migrations_out": self.migrations_out,
+            "migrations_in": self.migrations_in,
             "ttft_p50": self._h_ttft.percentile(0.50),
             "ttft_p95": self._h_ttft.percentile(0.95),
             "ttft_p99": self._h_ttft.percentile(0.99),
