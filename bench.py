@@ -1002,6 +1002,26 @@ def _disagg_entry() -> None:
     raise SystemExit(0)
 
 
+def _moe_entry() -> None:
+    """The ``moe`` rung: an E-expert top-k MoE llama vs a dense llama
+    at MATCHED parameter count (dense MLP hidden = E x the expert
+    hidden) through the same SpmdGPipe engine on the same token stream
+    (benchmarks/moe_dense.py — which owns the measurement contract:
+    dropless dispatch so per-step FFN work is exactly ``k*t`` expert
+    rows, parameter counts asserted matched within 2% before any
+    number publishes, tokens/s for both rungs and the active-parameter
+    fraction in one JSON line)::
+
+        env JAX_PLATFORMS=cpu python bench.py --moe
+    """
+    sys.argv = [sys.argv[0]] + [
+        a for a in sys.argv[1:] if a != "--moe"
+    ] + ["--json"]
+    from benchmarks.moe_dense import main as moe_main
+
+    raise SystemExit(moe_main())
+
+
 def _plan_validate_entry() -> None:
     """The ``plan-validate`` rung: predicted-vs-measured rank-order check
     of the static planner on the CPU tiny-llama preset
@@ -1030,6 +1050,8 @@ if __name__ == "__main__":
         _elastic_entry()
     elif "--disagg" in sys.argv:
         _disagg_entry()
+    elif "--moe" in sys.argv:
+        _moe_entry()
     elif "--megastep" in sys.argv:
         _megastep_entry()
     elif "--packing" in sys.argv:

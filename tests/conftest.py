@@ -44,6 +44,16 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 
+# Per-file time-budget lint (opt-in: TGPU_TEST_TIME_BUDGET=<seconds>):
+# fails the session when a file's tests NOT marked 'slow' exceed the
+# budget — how the tier-1 wall-clock target stays enforceable instead
+# of rotting one slow test at a time.  Hooks re-exported so plain
+# `pytest tests/` picks them up without -p.
+from tools.pytest_file_budget import (  # noqa: E402,F401
+    pytest_runtest_logreport,
+    pytest_sessionfinish,
+)
+
 
 @pytest.fixture(autouse=True)
 def _deterministic_seed():

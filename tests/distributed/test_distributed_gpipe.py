@@ -286,3 +286,66 @@ def test_recv_timeout_detects_dead_peer():
     )
     with pytest.raises(TimeoutError, match="meta|forward"):
         rank1.forward(params, state)  # rank 0 never starts
+
+
+def test_first_step_timeout_names_compile_ambiguity():
+    """A timeout on the FIRST step with no grace configured cannot tell
+    'peer hung' from 'peer still jit-compiling'; the error must say so
+    and point at first_step_grace (the stage-compile-context caveat,
+    resolved as a didactic error)."""
+    layers = _mlp()
+    transport = LocalTransport()
+    transport.register(WORKERS[0])  # alive but silent: a bare timeout,
+    box = transport.register(WORKERS[1])  # not PeerDiedError
+    rank1 = DistributedGPipe(
+        layers, 1, WORKERS[:3], [2, 2, 1], chunks=2,
+        transport=transport, mailbox=box, recv_timeout=0.2,
+    )
+    params, state = rank1.init(
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    )
+    with pytest.raises(TimeoutError, match="first_step_grace"):
+        rank1.forward(params, state)
+
+
+def test_first_step_grace_extends_cold_deadline_only():
+    """first_step_grace widens every receive deadline until the first
+    train step completes BOTH legs, then stops applying — the tight
+    steady-state recv_timeout holds from step 1."""
+    layers = _mlp()
+    transport = LocalTransport()
+    ranks = _make_ranks(
+        layers, [2, 2, 1], 2, transport,
+        recv_timeout=0.2, first_step_grace=30.0,
+    )
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (6, 8))
+    y = jax.random.normal(jax.random.PRNGKey(2), (6, 4))
+    for rank in ranks:
+        rank._params, rank._state = rank.init(
+            rng, jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+        assert rank._effective_timeout() == pytest.approx(30.2)
+    _run_step(ranks, x, y, jax.random.PRNGKey(3))
+    for rank in ranks:
+        assert rank._warmed
+        assert rank._effective_timeout() == pytest.approx(0.2)
+
+
+def test_first_step_grace_validation():
+    """The grace is meaningless without a deadline to extend, and must
+    be positive seconds — both are ctor-time didactic errors."""
+    layers = _mlp()
+    transport = LocalTransport()
+    box = transport.register(WORKERS[0])
+    kw = dict(chunks=2, transport=transport, mailbox=box)
+    with pytest.raises(ValueError, match="recv_timeout"):
+        DistributedGPipe(
+            layers, 0, WORKERS[:3], [2, 2, 1],
+            first_step_grace=5.0, **kw,
+        )
+    with pytest.raises(ValueError, match="positive"):
+        DistributedGPipe(
+            layers, 0, WORKERS[:3], [2, 2, 1],
+            recv_timeout=1.0, first_step_grace=0.0, **kw,
+        )

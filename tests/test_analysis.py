@@ -1049,3 +1049,72 @@ def test_redundant_gather_stands_down_without_gather_leaves(cpu_devices):
                        gather_schedule="use")
     assert analysis.lint(single, jax.ShapeDtypeStruct((4, 8), jnp.float32),
                          rules=["redundant-gather"]) == []
+
+
+# --------------------------------------------------------------------- #
+# capacity-overflow                                                     #
+# --------------------------------------------------------------------- #
+
+
+def _moe_mpmd_pipe(capacity_factor, dispatch="dense"):
+    from torchgpipe_tpu.models.moe import MoEConfig, llama_moe
+    from torchgpipe_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab=64, dim=16, n_layers=2, n_heads=2,
+                            n_kv_heads=2)
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=capacity_factor,
+                    dispatch=dispatch)
+    return GPipe(llama_moe(cfg, moe), balance=[2, 2], chunks=2)
+
+
+_MOE_TOK = jax.ShapeDtypeStruct((4, 8), jnp.int32)
+
+
+def test_capacity_overflow_warns_on_tight_factor():
+    """Broken twin: capacity_factor=0.25 at top_k=2 gives the 4 experts
+    2 slots each for 32 routed assignments per lane — even a PERFECT
+    router drops 75% of them, silently, every step.  One WARNING per
+    MoE block, anchored to the meta index, telling the user about the
+    dropless escape hatch."""
+    pipe = _moe_mpmd_pipe(0.25)
+    found = _by_rule(
+        analysis.lint(pipe, _MOE_TOK, rules=["capacity-overflow"]),
+        "capacity-overflow",
+    )
+    assert len(found) == 2  # llama_moe: one MoE feed-forward per block
+    assert all(f.severity == Severity.WARNING for f in found)
+    assert found[0].path == "mpmd/moe[0]"
+    assert found[1].path == "mpmd/moe[1]"
+    assert "capacity_factor=0.25" in found[0].message
+    assert "dropless" in found[0].message  # names the escape hatch
+
+
+def test_capacity_overflow_stands_down_when_slots_suffice():
+    """Fixed twins: a generous factor has slots >= demand (zero forced
+    drops), and dropless dispatch has no capacity buffer at all — both
+    lint clean even with the tight factor that fired above."""
+    assert analysis.lint(_moe_mpmd_pipe(8.0), _MOE_TOK,
+                         rules=["capacity-overflow"]) == []
+    assert analysis.lint(_moe_mpmd_pipe(0.25, dispatch="dropless"),
+                         _MOE_TOK, rules=["capacity-overflow"]) == []
+
+
+def test_capacity_overflow_top_k_exceeds_experts_is_error():
+    """top_k > n_experts cannot arise through `moe_mlp` (its ctor
+    refuses), but layer metas are open — a hand-made record must surface
+    as an ERROR (the iterative top-k would repeat experts and the
+    combine would double-count them), not as a capacity warning."""
+    bad = dataclasses.replace(
+        _stateless("fake_moe", lambda x: x),
+        meta={"moe": {"n_experts": 2, "top_k": 3, "capacity_factor": 1.0}},
+    )
+    pipe = GPipe(named([dense(16, name="fc1"), bad,
+                        dense(8, name="head")]),
+                 balance=[2, 1], chunks=2)
+    found = _by_rule(
+        analysis.lint(pipe, X, rules=["capacity-overflow"]),
+        "capacity-overflow",
+    )
+    assert len(found) == 1
+    assert found[0].severity == Severity.ERROR
+    assert "top_k=3 exceeds n_experts=2" in found[0].message

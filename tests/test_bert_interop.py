@@ -116,6 +116,7 @@ def test_bert_fine_tunes_through_pipeline():
     assert losses[-1] < losses[0] * 0.5, losses
 
 
+@pytest.mark.slow  # tier-1 870s budget: top offender, covered by the CI full job
 def test_generation_rejects_post_norm():
     m = _hf_model(n_layer=1)
     cfg, params = from_hf_bert(m)

@@ -775,6 +775,7 @@ def test_finished_rows_stop_writing_cache():
     assert np.any(k0[:frontier] != 0.0)
 
 
+@pytest.mark.slow  # tier-1 870s budget: top offender, covered by the CI full job
 def test_row_lengths_continuation_matches_solo():
     """Multi-turn continuation with per-row frontiers (row_frontiers +
     generate(row_lengths=...)): after an eos-ragged first turn, a second

@@ -1,0 +1,73 @@
+"""Meta-test for the per-file time-budget lint
+(tools/pytest_file_budget.py): a synthetic test file is run through a
+REAL pytest subprocess with the plugin loaded via ``-p`` (no repo
+conftest, no jax — the subprocesses are milliseconds-cheap), proving
+the lint fails an unmarked over-budget file, exempts ``slow``-marked
+tests, and stays inert with the env var unset."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+SLEEPY = """\
+import time
+
+def test_sleepy():
+    time.sleep(0.25)
+"""
+
+SLEEPY_MARKED = """\
+import time
+import pytest
+
+@pytest.mark.slow
+def test_sleepy():
+    time.sleep(0.25)
+"""
+
+
+def _run(test_file, budget):
+    env = dict(os.environ)
+    env.pop("TGPU_TEST_TIME_BUDGET", None)
+    if budget is not None:
+        env["TGPU_TEST_TIME_BUDGET"] = budget
+    return subprocess.run(
+        [sys.executable, "-m", "pytest", "-p", "tools.pytest_file_budget",
+         "-p", "no:cacheprovider", "-q", str(test_file)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_unmarked_over_budget_file_fails(tmp_path):
+    f = tmp_path / "test_sleepy.py"
+    f.write_text(SLEEPY)
+    res = _run(f, "0.1")
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "[file-budget] FAILED" in res.stdout
+    assert "test_sleepy.py" in res.stdout
+
+
+def test_slow_marked_tests_are_exempt(tmp_path):
+    f = tmp_path / "test_sleepy.py"
+    f.write_text(SLEEPY_MARKED)
+    res = _run(f, "0.1")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "[file-budget]" not in res.stdout
+
+
+def test_budget_off_without_env(tmp_path):
+    f = tmp_path / "test_sleepy.py"
+    f.write_text(SLEEPY)
+    res = _run(f, None)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "[file-budget]" not in res.stdout
+
+
+def test_generous_budget_passes(tmp_path):
+    f = tmp_path / "test_sleepy.py"
+    f.write_text(SLEEPY)
+    res = _run(f, "30")
+    assert res.returncode == 0, res.stdout + res.stderr

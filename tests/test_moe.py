@@ -847,3 +847,131 @@ def test_ragged_batch_composes_with_ep(cpu_devices):
     loss1, grads1 = eng1.train_step(params1, tokens, labels)
     assert abs(float(loss) - float(loss1)) < 1e-5
     _assert_trees_close(grads, grads1, rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------- #
+# dispatch-assignment edges (the sort-based bookkeeping under overflow) #
+# --------------------------------------------------------------------- #
+
+
+def _one_expert_probs(t=8, E=4, expert=2):
+    """Router probabilities where EVERY token's top choice is `expert` —
+    the worst-case load skew the capacity machinery must survive."""
+    logits = jnp.zeros((t, E)).at[:, expert].add(10.0)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def test_sparse_assignment_full_overflow_is_fcfs():
+    """All 8 tokens route to expert 2 with capacity 2: exactly the first
+    `capacity` tokens keep their slot (first-come-first-served in token
+    order — the dense `_top_k_dispatch` contract) and dropped tokens
+    park at slot 0 with keep=False."""
+    from torchgpipe_tpu.models.moe import _sparse_assignment
+
+    probs = _one_expert_probs()
+    experts, gates, keep, slot = _sparse_assignment(probs, k=1, capacity=2)
+    np.testing.assert_array_equal(np.asarray(experts), np.full(8, 2))
+    assert int(keep.sum()) == 2
+    np.testing.assert_array_equal(
+        np.asarray(keep), [True, True] + [False] * 6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(slot), [0, 1, 0, 0, 0, 0, 0, 0]
+    )
+    # k=1 keeps the RAW softmax probability as the gate (Switch) — the
+    # GShard normalization would pin it to 1.0 and kill router grads.
+    np.testing.assert_allclose(
+        np.asarray(gates), np.asarray(probs[:, 2]), rtol=1e-6
+    )
+
+
+def test_sparse_assignment_capacity_equals_tokens_boundary():
+    """capacity == t is the no-drop boundary even under total skew:
+    every assignment keeps, and slots are exactly arrival order."""
+    from torchgpipe_tpu.models.moe import _sparse_assignment
+
+    probs = _one_expert_probs(t=8)
+    _, _, keep, slot = _sparse_assignment(probs, k=1, capacity=8)
+    assert bool(keep.all())
+    np.testing.assert_array_equal(np.asarray(slot), np.arange(8))
+
+
+def test_dropless_assignment_counts_and_k_major_order():
+    """The dropless path under total skew: group_sizes put all tokens in
+    one segment, the expert-stable sort preserves token order, and with
+    k=2 the second-choice round sorts strictly by expert id (k-major
+    flat layout — round 2's uniform-tie argmax picks expert 0, which
+    sorts BEFORE the round-1 expert-2 segment)."""
+    from torchgpipe_tpu.models.moe import _dropless_assignment
+
+    probs = _one_expert_probs(t=8)
+    order, tok_sorted, counts, gates = _dropless_assignment(probs, k=1)
+    np.testing.assert_array_equal(np.asarray(counts), [0, 0, 8, 0])
+    np.testing.assert_array_equal(np.asarray(order), np.arange(8))
+    np.testing.assert_array_equal(np.asarray(tok_sorted), np.arange(8))
+    np.testing.assert_allclose(
+        np.asarray(gates), np.asarray(probs[:, 2]), rtol=1e-6
+    )
+
+    order2, tok2, counts2, _ = _dropless_assignment(probs, k=2)
+    np.testing.assert_array_equal(np.asarray(counts2), [8, 0, 8, 0])
+    # Expert 0 (every token's round-2 pick, k-major indices 8..15) sorts
+    # ahead of expert 2 (round-1 picks, indices 0..7); within each
+    # segment token order is preserved.
+    np.testing.assert_array_equal(
+        np.asarray(tok2), np.concatenate([np.arange(8), np.arange(8)])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(order2),
+        np.concatenate([np.arange(8, 16), np.arange(8)]),
+    )
+
+
+def test_router_stats_counts_selections_pre_capacity():
+    """`router_stats` load is the PRE-capacity selection fraction: a
+    router that sends everything to expert 0 reports load[0] == 1.0 and
+    penalty == E * importance[0] regardless of how tight the capacity
+    factor is (capacity drops depend on token order and would make the
+    monitoring metric discontinuous in it)."""
+    dim, E = 16, 4
+    router = jnp.zeros((dim, E)).at[:, 0].set(1.0)
+    x = jnp.ones((2, 4, dim))
+    tight = MoEConfig(n_experts=E, top_k=1, capacity_factor=0.25)
+    load, importance, penalty = router_stats(router, x, tight)
+    np.testing.assert_allclose(np.asarray(load), [1.0, 0, 0, 0])
+    assert float(jnp.sum(load)) == pytest.approx(1.0)
+    assert float(penalty) == pytest.approx(E * float(importance[0]))
+    # Identical stats under a generous factor — capacity plays no part.
+    loose = MoEConfig(n_experts=E, top_k=1, capacity_factor=8.0)
+    load2, importance2, penalty2 = router_stats(router, x, loose)
+    np.testing.assert_array_equal(np.asarray(load), np.asarray(load2))
+    np.testing.assert_array_equal(
+        np.asarray(importance), np.asarray(importance2)
+    )
+    assert float(penalty) == float(penalty2)
+
+
+def test_moe_capacity_formula_edges():
+    """`events.moe_capacity` re-derives the layer's static per-expert
+    budget without a trace: expert-choice clamps to the token count,
+    token-choice floors at 1 slot, dropless reports no capacity at all —
+    and the formula agrees with the real `moe_mlp` layer's meta."""
+    import math
+
+    from torchgpipe_tpu.analysis import events as ev
+
+    ec = {"n_experts": 4, "top_k": 1, "capacity_factor": 100.0,
+          "router": "expert_choice"}
+    assert ev.moe_capacity(ec, 8) == 8  # ceil(100*8/4)=200, clamped to t
+    tc = {"n_experts": 4, "top_k": 2, "capacity_factor": 1.0}
+    assert ev.moe_capacity(tc, 8) == 4  # ceil(1*2*8/4)
+    tiny = {"n_experts": 4, "top_k": 1, "capacity_factor": 0.01}
+    assert ev.moe_capacity(tiny, 8) == 1  # floored — never a 0-slot buffer
+    dl = {"n_experts": 4, "top_k": 2, "capacity_factor": 1.0,
+          "dispatch": "dropless"}
+    assert ev.moe_capacity(dl, 8) == 0
+
+    layer = moe_mlp(_cfg(), MoEConfig(n_experts=4, top_k=2,
+                                      capacity_factor=2.0))
+    (meta,) = ev.find_moe_meta(layer)
+    assert ev.moe_capacity(meta, 64) == math.ceil(2.0 * 2 * 64 / 4)

@@ -115,6 +115,7 @@ def test_per_cell_dispatch_is_asynchronous():
     )
 
 
+@pytest.mark.slow  # tier-1 870s budget: top offender, covered by the CI full job
 def test_dispatch_detector_catches_serialization(monkeypatch):
     """Discriminating-power control: inject the bug (a host sync on every
     inter-stage transfer) and the same measurement must flip — dispatch

@@ -162,6 +162,7 @@ def test_real_token_fraction(corpus):
 # --------------------------------------------------------------------- #
 
 
+@pytest.mark.slow  # tier-1 870s budget: top offender, covered by the CI full job
 def test_packed_per_document_losses_match_unpacked(corpus, model_and_params):
     """The tentpole gate at the model level: per-document losses from
     the packed batch equal each document run alone — bitwise for

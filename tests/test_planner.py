@@ -491,6 +491,7 @@ def test_plan_report_cli_exit_codes(capsys):
     assert "NO certified candidate" in capsys.readouterr().err
 
 
+@pytest.mark.slow  # tier-1 870s budget: top offender, covered by the CI full job
 def test_ci_lint_wires_the_plan_gate():
     """--skip-plan exists and skipping every gate is clean (wiring)."""
     from tools.ci_lint import main
@@ -607,7 +608,7 @@ def test_spmd_applied_plan_with_policy_is_drift_clean(cpu_devices):
         with_policy.schedule, with_policy.checkpoint, with_policy.policy,
         with_policy.chunks, None, with_policy.megastep,
         planner._unroll_key(with_policy.scan_unroll),
-        with_policy.dp, with_policy.tp, with_policy.zero,
+        with_policy.dp, with_policy.tp, with_policy.ep, with_policy.zero,
     )
     # True == 1 in Python: the key must NOT conflate full unroll with
     # the default, or drift matching resolves onto the wrong candidate.
@@ -1099,3 +1100,109 @@ def test_replan_verify_gate():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "measured winner 'always'" in proc.stdout
+
+
+# --------------------------------------------------------------------- #
+# expert-parallel (ep) width axis                                       #
+# --------------------------------------------------------------------- #
+
+
+def _llama_moe_ep_pipe(cpu_devices, n_experts=4):
+    from torchgpipe_tpu.models.moe import MoEConfig, llama_moe_spmd
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig, cross_entropy,
+    )
+
+    cfg = TransformerConfig(vocab=64, dim=16, n_layers=2, n_heads=2,
+                            n_kv_heads=2)
+    moe = MoEConfig(n_experts=n_experts, top_k=2, capacity_factor=8.0,
+                    ep_axis="ep")
+    block, pre, post = llama_moe_spmd(cfg, moe, 2)
+    mesh = make_mesh(2, 1, ep=2, devices=cpu_devices[:4])
+    pipe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+                     pre=pre, post=post, ep_axis="ep")
+    return pipe, jax.ShapeDtypeStruct((8, 8), jnp.int32)
+
+
+def test_mesh_width_options_pairs_inherit_pipe_ep(cpu_devices):
+    """Back-compat: (dp, tp) pairs stay valid and inherit the pipe's OWN
+    expert width (the pre-MoE call shape); explicit triples override it;
+    anything else is refused loudly."""
+    pipe, _ = _llama_moe_ep_pipe(cpu_devices)
+    assert planner.mesh_width_options(pipe, [(1, 1), (1, 1, 1)]) == [
+        (1, 1, 2), (1, 1, 1),
+    ]
+    with pytest.raises(ValueError, match="mesh_options entries"):
+        planner.mesh_width_options(pipe, [(1, 1, 2, 1)])
+
+
+def test_plan_ep_certifies_and_prices_a2a(cpu_devices):
+    """planner.plan searches the ep width next to dp x tp x pp: the ep=2
+    candidates certify (sharding verifier ran clean over the expert
+    layout) and carry a PRICED all_to_all volume, while the ep=1
+    candidates on the same pipe move no collective bytes at all.  The
+    describe() line names the expert width (xE2)."""
+    pipe, x = _llama_moe_ep_pipe(cpu_devices)
+    report = planner.plan(
+        pipe, x, hbm_budget_bytes=15 << 30,
+        mesh_options=[(1, 1, 1), (1, 1, 2)], megastep_options=[1],
+        chunks_options=[2], schedules=["fill_drain"],
+    )
+    assert {p.ep for p in report.candidates} == {1, 2}
+    at2 = [p for p in report.candidates if p.ep == 2 and p.certified]
+    assert at2, [p.reason for p in report.candidates if not p.feasible]
+    assert all(p.comm_bytes > 0 for p in at2)
+    assert "xE2" in at2[0].describe()
+    at1 = [p for p in report.candidates if p.ep == 1 and p.certified]
+    assert at1
+    assert all(p.comm_bytes == 0 for p in at1)
+
+
+def test_plan_ep_rejections_are_honest(cpu_devices):
+    """Every unplannable ep width gets a REJECT row with the real
+    reason, never a silent drop: a width the expert count cannot divide
+    (validate_mesh would refuse the mesh), a pipe that never declared
+    ep_axis, and a declared axis with no expert-parallel layer to use
+    it."""
+    # E=4 does not divide over ep=3.
+    pipe, x = _llama_moe_ep_pipe(cpu_devices)
+    report = planner.plan(
+        pipe, x, hbm_budget_bytes=15 << 30,
+        mesh_options=[(1, 1, 3)], megastep_options=[1],
+        chunks_options=[2], schedules=["fill_drain"],
+    )
+    (rej,) = [p for p in report.candidates if p.ep == 3]
+    assert not rej.feasible and not rej.certified
+    assert "n_experts=4 does not divide by ep=3" in rej.reason
+    assert "validate_mesh" in rej.reason
+
+    # A dense pipe never declared the axis.
+    dense_pipe, dx = _llama_dp_pipe(cpu_devices)
+    report = planner.plan(
+        dense_pipe, dx, hbm_budget_bytes=15 << 30,
+        mesh_options=[(1, 1, 2)], megastep_options=[1],
+        chunks_options=[2], schedules=["fill_drain"],
+    )
+    (rej,) = [p for p in report.candidates if p.ep == 2]
+    assert "ep=2 needs the pipe to declare ep_axis" in rej.reason
+
+    # Axis declared, but the block holds no expert-parallel MoE layer:
+    # the a2a the width implies would never run.
+    from torchgpipe_tpu.models.transformer import (
+        TransformerConfig, cross_entropy, llama_spmd,
+    )
+
+    cfg = TransformerConfig(vocab=64, dim=16, n_layers=2, n_heads=2,
+                            n_kv_heads=2)
+    block, pre, post = llama_spmd(cfg, 2)
+    mesh = make_mesh(2, 1, ep=2, devices=cpu_devices[:4])
+    no_moe = SpmdGPipe(block, 2, mesh, chunks=2, loss_fn=cross_entropy,
+                       pre=pre, post=post, ep_axis="ep")
+    report = planner.plan(
+        no_moe, jax.ShapeDtypeStruct((8, 8), jnp.int32),
+        hbm_budget_bytes=15 << 30,
+        mesh_options=[(1, 1, 2)], megastep_options=[1],
+        chunks_options=[2], schedules=["fill_drain"],
+    )
+    (rej,) = [p for p in report.candidates if p.ep == 2]
+    assert "ep=2 needs an expert-parallel MoE layer" in rej.reason

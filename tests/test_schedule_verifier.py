@@ -453,6 +453,7 @@ def _run_hang_script(faulty: bool, budget: float, tmp_path):
         proc.wait()
 
 
+@pytest.mark.slow  # tier-1 870s budget: top offender, covered by the CI full job
 def test_deadlock_fixture_provably_hangs_in_subprocess(tmp_path):
     """The constructive witness: the SAME lose-fault whose IR mutation
     the verifier flags as a deadlock, executed for real, hangs the

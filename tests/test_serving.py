@@ -103,6 +103,7 @@ def _spmd_flat():
     return spmd_params_for_generation(pipe, params)
 
 
+@pytest.mark.slow  # tier-1 870s budget: top offender, covered by the CI full job
 @pytest.mark.parametrize("derive", ["mpmd", "spmd"])
 def test_two_compiled_programs_zero_retraces(derive):
     """16+ ragged, staggered requests with mid-flight cancellations:

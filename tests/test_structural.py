@@ -100,6 +100,7 @@ def test_spmd_program_structure():
     assert _count_eqns(jaxpr_nr.jaxpr, REMAT) == 0
 
 
+@pytest.mark.slow  # tier-1 870s budget: top offender, covered by the CI full job
 def test_spmd_except_last_program_structure(cpu_devices):
     """'except_last' peels the schedule: a remat'd scan over the first m-1
     ticks plus a second scan over the final n ticks whose body is a single

@@ -178,6 +178,7 @@ def test_tune_step_ranks_and_candidate_applies(cpu_devices):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow  # tier-1 870s budget: top offender, covered by the CI full job
 def test_tune_llama1b_policy_beats_default_and_flash_in_jaxpr(cpu_devices):
     # The acceptance pair for the MFU stack, on the REAL 1b preset shape
     # (dim 2048, 16 blocks, 32/8 heads -> head_dim 64, vocab 128256) at

@@ -118,21 +118,35 @@ differently and must not share backend state):
    and a decode replica killed mid-stream must resume via re-prefill +
    re-migrate, both bitwise (docs/serving.md, disaggregation section).
 
-Options: ``--skip-typegate`` / ``--skip-schedule`` / ``--skip-pipeline``
-/ ``--skip-serving`` / ``--skip-plan`` / ``--skip-trace`` /
-``--skip-postmortem`` / ``--skip-sharding`` / ``--skip-pack`` /
-``--skip-replan`` / ``--skip-fleet`` / ``--skip-slo`` /
-``--skip-elastic`` / ``--skip-disagg`` to run a subset, ``-v`` for
-per-target reports.
+15. ``tools/moe_verify.py`` (moe-verify) — certified MoE expert
+   parallelism: the planner must return certified feasible ep>1 plans
+   for an expert-parallel pipe (and honestly reject a non-divisible ep
+   width), the TOP ep plan must re-verify through ``verify_plan`` with
+   the expert all_to_all pair priced, the ep=2 train step must be
+   loss-BITWISE vs both the unsharded engine and a sequential
+   single-chip oracle with gathered grads within machine-ULP, the
+   ``capacity-overflow`` lint must fire on an overflowing
+   capacity_factor and stand down on a generous one, and an MoE
+   serving engine must certify the ``len(ladder)+1`` program bound
+   with greedy streams bitwise vs ``generate`` and an expert_choice
+   router refused (docs/analysis.md, MoE section).
+
+Options: ``--skip-<gate>`` (e.g. ``--skip-typegate``,
+``--skip-sharding``) to drop gates, ``--only <gate>`` (repeatable;
+matches the tag names above, e.g. ``--only moe-verify --only
+plan-verify``) to run a subset, ``--json`` for a machine-readable
+summary line on stdout, ``-v`` for per-target reports.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import pathlib
 import subprocess
 import sys
-from typing import List, Sequence
+import time
+from typing import Callable, List, NamedTuple, Optional, Sequence
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
@@ -145,125 +159,131 @@ def _run(tag: str, cmd: List[str]) -> int:
     return rc
 
 
+class Gate(NamedTuple):
+    """One CI gate: a display tag, its ``--skip-*`` argparse attr, and
+    a builder returning the subprocess argv (``None`` aborts the whole
+    run with exit 2 — e.g. nothing to lint)."""
+
+    tag: str
+    skip_attr: str
+    build: Callable[[argparse.Namespace], Optional[List[str]]]
+
+
+def _module_main(module: str, verbose: bool) -> List[str]:
+    # -c instead of -m: runpy would re-execute a module the analysis
+    # package already imported (a RuntimeWarning on every CI run).
+    cmd = [
+        sys.executable, "-c",
+        f"import sys; from torchgpipe_tpu.analysis import {module}; "
+        f"sys.exit({module}.main(sys.argv[1:]))",
+    ]
+    if verbose:
+        cmd.append("-v")
+    return cmd
+
+
+def _tool(
+    script: str, *extra: str, verbose_flag: bool = False
+) -> Callable[[argparse.Namespace], List[str]]:
+    def build(args: argparse.Namespace) -> List[str]:
+        cmd = [sys.executable, str(REPO / "tools" / script), *extra]
+        if verbose_flag and args.verbose:
+            cmd.append("-v")
+        return cmd
+
+    return build
+
+
+def _pipeline_cmd(args: argparse.Namespace) -> Optional[List[str]]:
+    examples = sorted(
+        str(p.relative_to(REPO)) for p in (REPO / "examples").glob("*.py")
+    )
+    if not examples:
+        print("[ci_lint] no examples found", file=sys.stderr)
+        return None
+    cmd = [
+        sys.executable, str(REPO / "tools" / "pipeline_lint.py"), *examples,
+    ]
+    if args.verbose:
+        cmd.append("-v")
+    return cmd
+
+
+GATES: List[Gate] = [
+    Gate("typegate", "skip_typegate", _tool("typegate.py")),
+    Gate("schedule-verify", "skip_schedule",
+         lambda a: _module_main("schedule", a.verbose)),
+    Gate("pipeline_lint", "skip_pipeline", _pipeline_cmd),
+    Gate("serve-verify", "skip_serving",
+         lambda a: _module_main("serving", a.verbose)),
+    Gate("plan-verify", "skip_plan", _tool("plan_report.py", "--ci")),
+    Gate("trace-verify", "skip_trace",
+         _tool("trace_report.py", "--reconcile")),
+    Gate("postmortem-verify", "skip_postmortem",
+         _tool("postmortem.py", "--ci", verbose_flag=True)),
+    Gate("sharding-verify", "skip_sharding",
+         _tool("sharding_report.py", "--ci")),
+    Gate("pack-verify", "skip_pack",
+         _tool("pack_verify.py", verbose_flag=True)),
+    Gate("replan-verify", "skip_replan", _tool("replan_verify.py")),
+    Gate("fleet-verify", "skip_fleet", _tool("fleet_verify.py")),
+    Gate("slo-verify", "skip_slo", _tool("slo_verify.py")),
+    Gate("elastic-verify", "skip_elastic", _tool("elastic_verify.py")),
+    Gate("disagg-verify", "skip_disagg", _tool("disagg_verify.py")),
+    Gate("moe-verify", "skip_moe", _tool("moe_verify.py")),
+]
+
+
 def main(argv: Sequence[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="typegate + schedule verifier + pipeline lint gate"
     )
-    ap.add_argument("--skip-typegate", action="store_true")
-    ap.add_argument("--skip-schedule", action="store_true")
-    ap.add_argument("--skip-pipeline", action="store_true")
-    ap.add_argument("--skip-serving", action="store_true")
-    ap.add_argument("--skip-plan", action="store_true")
-    ap.add_argument("--skip-trace", action="store_true")
-    ap.add_argument("--skip-postmortem", action="store_true")
-    ap.add_argument("--skip-sharding", action="store_true")
-    ap.add_argument("--skip-pack", action="store_true")
-    ap.add_argument("--skip-replan", action="store_true")
-    ap.add_argument("--skip-fleet", action="store_true")
-    ap.add_argument("--skip-slo", action="store_true")
-    ap.add_argument("--skip-elastic", action="store_true")
-    ap.add_argument("--skip-disagg", action="store_true")
+    for gate in GATES:
+        ap.add_argument(
+            "--" + gate.skip_attr.replace("_", "-"), action="store_true"
+        )
+    ap.add_argument(
+        "--only", action="append", metavar="GATE", default=None,
+        choices=[g.tag for g in GATES],
+        help="run only the named gate(s); repeatable "
+             f"(choices: {', '.join(g.tag for g in GATES)})",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit a one-line JSON summary of gate results on stdout",
+    )
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="verbose pipeline_lint output")
     args = ap.parse_args(argv)
 
     failures = 0
-    if not args.skip_typegate:
-        failures += _run(
-            "typegate", [sys.executable, str(REPO / "tools" / "typegate.py")]
-        ) != 0
-    if not args.skip_schedule:
-        # -c instead of -m: runpy would re-execute a module the analysis
-        # package already imported (a RuntimeWarning on every CI run).
-        cmd = [
-            sys.executable, "-c",
-            "import sys; from torchgpipe_tpu.analysis import schedule; "
-            "sys.exit(schedule.main(sys.argv[1:]))",
-        ]
-        if args.verbose:
-            cmd.append("-v")
-        failures += _run("schedule-verify", cmd) != 0
-    if not args.skip_pipeline:
-        examples = sorted(
-            str(p.relative_to(REPO)) for p in (REPO / "examples").glob("*.py")
+    results = []
+    for gate in GATES:
+        skipped = (
+            gate.tag not in args.only if args.only
+            else getattr(args, gate.skip_attr)
         )
-        if not examples:
-            print("[ci_lint] no examples found", file=sys.stderr)
+        if skipped:
+            results.append(
+                {"gate": gate.tag, "skipped": True, "rc": None,
+                 "seconds": 0.0}
+            )
+            continue
+        cmd = gate.build(args)
+        if cmd is None:
             return 2
-        cmd = [
-            sys.executable, str(REPO / "tools" / "pipeline_lint.py"),
-            *examples,
-        ]
-        if args.verbose:
-            cmd.append("-v")
-        failures += _run("pipeline_lint", cmd) != 0
-    if not args.skip_serving:
-        # -c instead of -m for the same runpy-reimport reason as above.
-        cmd = [
-            sys.executable, "-c",
-            "import sys; from torchgpipe_tpu.analysis import serving; "
-            "sys.exit(serving.main(sys.argv[1:]))",
-        ]
-        if args.verbose:
-            cmd.append("-v")
-        failures += _run("serve-verify", cmd) != 0
-    if not args.skip_plan:
-        cmd = [
-            sys.executable, str(REPO / "tools" / "plan_report.py"), "--ci",
-        ]
-        failures += _run("plan-verify", cmd) != 0
-    if not args.skip_trace:
-        cmd = [
-            sys.executable, str(REPO / "tools" / "trace_report.py"),
-            "--reconcile",
-        ]
-        failures += _run("trace-verify", cmd) != 0
-    if not args.skip_postmortem:
-        cmd = [
-            sys.executable, str(REPO / "tools" / "postmortem.py"), "--ci",
-        ]
-        if args.verbose:
-            cmd.append("-v")
-        failures += _run("postmortem-verify", cmd) != 0
-    if not args.skip_sharding:
-        cmd = [
-            sys.executable, str(REPO / "tools" / "sharding_report.py"),
-            "--ci",
-        ]
-        failures += _run("sharding-verify", cmd) != 0
-    if not args.skip_pack:
-        cmd = [
-            sys.executable, str(REPO / "tools" / "pack_verify.py"),
-        ]
-        if args.verbose:
-            cmd.append("-v")
-        failures += _run("pack-verify", cmd) != 0
-    if not args.skip_replan:
-        cmd = [
-            sys.executable, str(REPO / "tools" / "replan_verify.py"),
-        ]
-        failures += _run("replan-verify", cmd) != 0
-    if not args.skip_fleet:
-        cmd = [
-            sys.executable, str(REPO / "tools" / "fleet_verify.py"),
-        ]
-        failures += _run("fleet-verify", cmd) != 0
-    if not args.skip_slo:
-        cmd = [
-            sys.executable, str(REPO / "tools" / "slo_verify.py"),
-        ]
-        failures += _run("slo-verify", cmd) != 0
-    if not args.skip_elastic:
-        cmd = [
-            sys.executable, str(REPO / "tools" / "elastic_verify.py"),
-        ]
-        failures += _run("elastic-verify", cmd) != 0
-    if not args.skip_disagg:
-        cmd = [
-            sys.executable, str(REPO / "tools" / "disagg_verify.py"),
-        ]
-        failures += _run("disagg-verify", cmd) != 0
+        t0 = time.monotonic()
+        rc = _run(gate.tag, cmd)
+        results.append(
+            {"gate": gate.tag, "skipped": False, "rc": rc,
+             "seconds": round(time.monotonic() - t0, 3)}
+        )
+        failures += rc != 0
     print(f"[ci_lint] {'clean' if not failures else f'{failures} gate(s) failed'}")
+    if args.json:
+        print(json.dumps(
+            {"ok": not failures, "failures": failures, "gates": results}
+        ))
     return 1 if failures else 0
 
 

@@ -1,0 +1,69 @@
+"""Per-file time-budget lint for the tier-1 test target.
+
+The fast suite (``pytest -m 'not slow'``) runs under a hard wall-clock
+timeout (ROADMAP.md tier-1 line); it stays under it only if no test
+file quietly accumulates minutes of unmarked work.  This plugin charges
+every non-``slow`` test's setup+call+teardown time to its file and, at
+session end, FAILS the run listing each file whose unmarked total
+exceeds the budget — the fix is to mark the offenders
+``@pytest.mark.slow`` (they still run in the CI full job), not to raise
+the budget.
+
+Opt-in by environment variable so local `pytest` stays timing-agnostic::
+
+    TGPU_TEST_TIME_BUDGET=120 python -m pytest tests/ -m 'not slow'
+
+Loaded two ways: ``tests/conftest.py`` re-exports the hooks (so the
+budget applies to the real suite when the variable is set), and
+``-p tools.pytest_file_budget`` works standalone (what the meta-test
+uses).  Tests marked ``slow`` are exempt by definition — the budget
+polices only what the fast gate actually pays for.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import defaultdict
+from typing import Any, Dict
+
+BUDGET_ENV = "TGPU_TEST_TIME_BUDGET"
+
+_file_seconds: Dict[str, float] = defaultdict(float)
+
+
+def _budget_seconds() -> float:
+    try:
+        return float(os.environ.get(BUDGET_ENV, "") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def pytest_runtest_logreport(report: Any) -> None:
+    """Charge each phase (setup/call/teardown) of every unmarked test
+    to its file."""
+    if _budget_seconds() <= 0:
+        return
+    if "slow" in getattr(report, "keywords", {}):
+        return
+    fname = report.nodeid.split("::", 1)[0]
+    _file_seconds[fname] += float(getattr(report, "duration", 0.0))
+
+
+def pytest_sessionfinish(session: Any, exitstatus: int) -> None:
+    budget = _budget_seconds()
+    if budget <= 0:
+        return
+    over = sorted(
+        ((t, f) for f, t in _file_seconds.items() if t > budget),
+        reverse=True,
+    )
+    if not over:
+        return
+    print(
+        f"\n[file-budget] FAILED — {len(over)} test file(s) spend more "
+        f"than {budget:g}s in tests NOT marked 'slow' (mark the "
+        "offenders @pytest.mark.slow; the CI full job still runs them):"
+    )
+    for t, f in over:
+        print(f"[file-budget]   {f}: {t:.1f}s unmarked")
+    session.exitstatus = 1

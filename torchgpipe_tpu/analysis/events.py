@@ -992,3 +992,116 @@ def apply_send_faults(graph: EventGraph, faults: Iterable[Any]) -> EventGraph:
         else:
             raise ValueError(f"unknown fault action {f.action!r}")
     return g
+
+
+# --------------------------------------------------------------------- #
+# expert-parallel (MoE) static layout model                              #
+# --------------------------------------------------------------------- #
+
+# The expert all_to_all inside moe_mlp is gated on a BOUND ep axis
+# (lax.all_to_all only exists inside shard_map), so the planner's block
+# trace — taken OUTSIDE shard_map — never contains it.  These helpers
+# reconstruct the sparse dispatch statically from the layer's declared
+# ``meta['moe']`` hyperparameter record: the per-expert capacity, the
+# transient dispatch/combine buffer bytes the memory certification must
+# charge, and the all_to_all staging volume the comm model prices.  All
+# pure integer arithmetic — no tracing, no jax.
+
+
+def find_moe_meta(layer: Any) -> List[Dict[str, Any]]:
+    """Every ``meta['moe']`` hyperparameter record reachable from
+    ``layer``, depth-first through compound children — one entry per MoE
+    feed-forward in the (stage) block.  The single discovery path the
+    planner, the sharding comm model and the capacity-overflow lint rule
+    share, so they cannot disagree about what the block contains."""
+    out: List[Dict[str, Any]] = []
+    seen: Set[int] = set()
+
+    def walk(obj: Any, depth: int) -> None:
+        if obj is None or depth > 16 or id(obj) in seen:
+            return
+        seen.add(id(obj))
+        meta = getattr(obj, "meta", None)
+        if not isinstance(meta, dict):
+            return
+        moe = meta.get("moe")
+        if isinstance(moe, dict):
+            out.append(moe)
+        children = meta.get("children")
+        if isinstance(children, dict):
+            for c in children.values():
+                walk(c, depth + 1)
+        elif isinstance(children, (list, tuple)):
+            for c in children:
+                walk(c, depth + 1)
+
+    walk(layer, 0)
+    return out
+
+
+def moe_capacity(moe_meta: Dict[str, Any], tokens: int) -> int:
+    """The static per-expert token budget of one MoE layer at a local
+    token count — the same formulas ``models.moe.moe_mlp`` computes at
+    trace time (token-choice: ``ceil(cf * k * t / E)``; expert-choice:
+    ``min(t, ceil(cf * t / E))``), re-derived here so the analyses never
+    need a trace.  Dropless dispatch has no capacity — returns 0."""
+    import math
+
+    E = int(moe_meta["n_experts"])
+    cf = float(moe_meta["capacity_factor"])
+    t = int(tokens)
+    if moe_meta.get("dispatch") == "dropless":
+        return 0
+    if moe_meta.get("router") == "expert_choice":
+        return min(t, max(1, math.ceil(cf * t / E)))
+    return max(1, math.ceil(cf * int(moe_meta["top_k"]) * t / E))
+
+
+def expert_parallel_bytes(
+    moe_meta: Dict[str, Any], tokens: int, ep: int = 1
+) -> int:
+    """Per-lane TRANSIENT bytes one MoE layer's dispatch holds live at
+    its peak — the expert-parallel layout's contribution to the memory
+    certification, charged once per lane (block layers run sequentially,
+    so the widest single layer bounds the transient).
+
+    Capacity paths: the ``[E, C, d]`` dispatch buffer, its ``[E, C, h]``
+    hidden activation and the ``[E, C, d]`` combine buffer; under
+    ``ep > 1`` the two all_to_alls each stage an extra buffer-sized copy
+    (the ``[E/ep, ep*C, d]`` reshuffle holds send+recv live).  Dropless:
+    exactly ``k*t`` ragged rows through (d, h, d) — no capacity buffers,
+    no a2a.  ``tokens`` is the LANE-LOCAL token count (the engine
+    computes capacity from local shapes)."""
+    E = int(moe_meta["n_experts"])
+    d = int(moe_meta["dim"])
+    h = int(moe_meta["hidden"])
+    isz = int(moe_meta["itemsize"])
+    k = int(moe_meta["top_k"])
+    t = int(tokens)
+    if moe_meta.get("dispatch") == "dropless":
+        rows = max(k * t, 1)
+        return rows * (2 * d + h) * isz
+    c = moe_capacity(moe_meta, t)
+    buf = E * c * d * isz
+    hid = E * c * h * isz
+    staging = 2 * buf if ep > 1 else 0
+    return 2 * buf + hid + staging
+
+
+def moe_all_to_all_bytes(moe_meta: Dict[str, Any], tokens: int) -> int:
+    """Bytes of ONE expert all_to_all direction (dispatch == combine):
+    the full ``[E, C, d]`` buffer at the lane-local token count.  The
+    comm model prices it through the house collective table
+    (``all_to_all`` moves ``(ep-1)/ep`` of the buffer off-lane), so this
+    returns the RAW buffer volume, unscaled.  Zero for dispatch modes
+    that never exchange (dropless / expert-choice require local
+    experts)."""
+    if moe_meta.get("dispatch") == "dropless":
+        return 0
+    if moe_meta.get("router") == "expert_choice":
+        return 0
+    E = int(moe_meta["n_experts"])
+    d = int(moe_meta["dim"])
+    isz = int(moe_meta["itemsize"])
+    c = moe_capacity(moe_meta, int(tokens))
+    return E * c * d * isz

@@ -187,18 +187,32 @@ def scan_unroll_options(schedule: str) -> List[Any]:
 
 def mesh_width_options(
     pipe: Any, requested: Optional[Sequence[Sequence[int]]]
-) -> List[Tuple[int, int]]:
-    """(dp, tp) width candidates for the 3D search.  Default: the
+) -> List[Tuple[int, int, int]]:
+    """(dp, tp, ep) width candidates for the mesh search.  Default: the
     pipe's OWN widths only — the planner never silently plans a mesh
     the user didn't ask about; pass ``mesh_options=[(1, 1), (2, 1),
-    (2, 2)]`` to open the axis.  Candidate meshes are ABSTRACT (axis
+    (2, 2)]`` to open the axis.  Entries may be (dp, tp) pairs (ep
+    defaults to the pipe's own expert width — the pre-MoE call shape)
+    or (dp, tp, ep) triples.  Candidate meshes are ABSTRACT (axis
     sizes only, no devices), so widths beyond the host are searchable;
     ``apply_plan`` refuses a width the pipe's real mesh doesn't have."""
     own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
     own_tp = pipe.mesh.shape[pipe.tp_axis] if pipe.tp_axis else 1
+    own_ep = pipe.mesh.shape[pipe.ep_axis] if getattr(pipe, "ep_axis", None) else 1
     if requested is None:
-        return [(own_dp, own_tp)]
-    return [(int(d), int(t)) for d, t in requested]
+        return [(own_dp, own_tp, own_ep)]
+    out: List[Tuple[int, int, int]] = []
+    for entry in requested:
+        widths = tuple(int(w) for w in entry)
+        if len(widths) == 2:
+            widths = widths + (own_ep,)
+        if len(widths) != 3:
+            raise ValueError(
+                f"mesh_options entries must be (dp, tp) or (dp, tp, ep) "
+                f"(got {tuple(entry)!r})"
+            )
+        out.append(widths)  # type: ignore[arg-type]
+    return out
 
 
 def zero_options_for(
@@ -298,6 +312,7 @@ class Plan:
     # reduce-scatter grad sync).
     dp: int = 1
     tp: int = 1
+    ep: int = 1  # expert-parallel width (MoE all_to_all group size)
     zero: int = 0
     opt_state_bytes: int = 0
     comm_bytes: int = 0
@@ -335,6 +350,8 @@ class Plan:
         mesh3d = f"{self.dp}x{self.tp}" + {1: "Z", 3: "Z3"}.get(
             int(self.zero), ""
         )
+        if self.ep != 1:
+            mesh3d += f"xE{self.ep}"
         priced = {"analytic": "a", "measured": "M", "mixed": "x"}.get(
             self.priced_by, "?"
         )
@@ -609,7 +626,7 @@ def _plan_spmd(
     tgt_spec = avalify(target) if target is not None else x_spec
     n = pipe.n_stages
     v = pipe.virtual_stages
-    ep = pipe.mesh.shape[pipe.ep_axis] if pipe.ep_axis else 1
+    own_ep = pipe.mesh.shape[pipe.ep_axis] if pipe.ep_axis else 1
     sp = pipe.mesh.shape[pipe.sp_axis] if pipe.sp_axis else 1
     B = jax.tree_util.tree_leaves(x_spec)[0].shape[0]
 
@@ -646,6 +663,11 @@ def _plan_spmd(
     mega_space = megastep_options(megastep_opts, steps)
     dp_name = pipe.dp_axis or "dp"
     tp_name = pipe.tp_axis or "tp"
+    ep_name = pipe.ep_axis or "ep"
+    # MoE hyperparams declared on the block's meta (static — the ep
+    # all_to_all is gated on axis presence inside shard_map, so it never
+    # appears in the width-independent block trace; pricing is analytic).
+    moe_metas = ev.find_moe_meta(pipe.block)
     # The block trace is width-independent; one cache serves every
     # candidate width's layout verification.
     layout_cache: Dict[str, Any] = {}
@@ -655,6 +677,7 @@ def _plan_spmd(
         dp: int, tp: int, reason: str, *,
         schedule: str = "*", mode: str = "-", label: Optional[str] = None,
         chunks: Optional[int] = None, zero: int = 0,
+        ep: Optional[int] = None,
     ) -> Plan:
         return Plan(
             engine="spmd", schedule=schedule, balance=None,
@@ -662,10 +685,13 @@ def _plan_spmd(
             checkpoint=mode, policy=label, virtual_stages=v,
             predicted_mfu=None, bubble_fraction=None, hwm_bytes=0,
             host_bytes=0, feasible=False, certified=False,
-            dp=dp, tp=tp, zero=zero, reason=reason,
+            dp=dp, tp=tp, ep=cand_ep if ep is None else ep,
+            zero=zero, reason=reason,
         )
 
-    for dp, tp in mesh_width_options(pipe, mesh_options):
+    cand_ep = own_ep  # resolved per candidate below; rejected() reads it
+    for dp, tp, ep in mesh_width_options(pipe, mesh_options):
+        cand_ep = ep
         n_chips = n * dp * tp * ep * sp
         # A width > 1 on an axis the pipe never declared would append a
         # PHANTOM mesh axis: no leaf shards over it, the replication
@@ -688,6 +714,38 @@ def _plan_spmd(
                 "certify fictitious speedup)",
             ))
             continue
+        if ep > 1 and pipe.ep_axis is None:
+            plans.append(rejected(
+                dp, tp,
+                f"ep={ep} needs the pipe to declare ep_axis (an "
+                "undeclared axis shards nothing — the width would "
+                "certify fictitious speedup)",
+            ))
+            continue
+        if ep > 1 and not any(
+            m.get("ep_axis") for m in moe_metas
+        ):
+            plans.append(rejected(
+                dp, tp,
+                f"ep={ep} needs an expert-parallel MoE layer in the "
+                "block (no layer meta declares moe with ep_axis — the "
+                "a2a the width implies would never run)",
+            ))
+            continue
+        moe_ep_bad = next(
+            (
+                m for m in moe_metas
+                if m.get("ep_axis") and int(m["n_experts"]) % ep != 0
+            ),
+            None,
+        ) if ep > 1 else None
+        if moe_ep_bad is not None:
+            plans.append(rejected(
+                dp, tp,
+                f"n_experts={moe_ep_bad['n_experts']} does not divide "
+                f"by ep={ep} (validate_mesh would refuse this mesh)",
+            ))
+            continue
         # Cheap rejections BEFORE the (retraced) layout verification.
         if B % (dp * ep) != 0:
             plans.append(rejected(
@@ -696,6 +754,8 @@ def _plan_spmd(
             continue
         # ---- sharding certification of the candidate layout (3D) ---- #
         overrides = {dp_name: dp, tp_name: tp}
+        if pipe.ep_axis is not None:
+            overrides[ep_name] = ep
         try:
             layout = shd.verify_layout(
                 pipe, batch, params_spec=params_spec,
@@ -842,6 +902,25 @@ def _plan_spmd(
             mb_rows = B // (chunks * dp * ep)
             cell_comm = cell_comm_probe * mb_rows / probe_rows
             cell_comm3 = cell_comm_probe3 * mb_rows / probe_rows
+            # Expert-parallel staging the block trace can't see (the ep
+            # reshuffle holds send+recv live only inside shard_map):
+            # charge the widest MoE layer's delta over the traced
+            # single-chip capacity layout.  Zero at ep=1 by construction.
+            moe_staging = 0
+            if ep > 1 and moe_metas and mb_spec is not None:
+                _wide = [
+                    a for a in jax.tree_util.tree_leaves(mb_spec)
+                    if len(a.shape) >= 2
+                ]
+                if _wide:
+                    lane_tokens = int(_wide[0].shape[0]) * int(
+                        _wide[0].shape[1]
+                    )
+                    moe_staging = max(
+                        ev.expert_parallel_bytes(m, lane_tokens, ep=ep)
+                        - ev.expert_parallel_bytes(m, lane_tokens, ep=1)
+                        for m in moe_metas
+                    )
             atom_cache: Dict[Any, Optional[Tuple[float, float]]] = {}
             resid_cache: Dict[Any, Optional[int]] = {}
 
@@ -1074,6 +1153,7 @@ def _plan_spmd(
                                 + ticks * mb_bytes
                                 + send_ahead_carry
                                 + overhead_bytes
+                                + moe_staging
                             )
                             lane_comm = (
                                 chunks * cell_comm3
@@ -1088,6 +1168,7 @@ def _plan_spmd(
                                 + ticks * mb_bytes
                                 + send_ahead_carry
                                 + overhead_bytes
+                                + moe_staging
                             )
                             lane_comm = chunks * cell_comm + grad_sync_lane
                         comm_flops = shd.COMM_FLOPS_PER_BYTE * lane_comm
@@ -1129,7 +1210,8 @@ def _plan_spmd(
                                     bubble_fraction=bubble, hwm_bytes=hwm,
                                     host_bytes=host_peak, feasible=feasible,
                                     certified=True, megastep=K,
-                                    scan_unroll=u, dp=dp, tp=tp, zero=zero,
+                                    scan_unroll=u, dp=dp, tp=tp, ep=ep,
+                                    zero=zero,
                                     opt_state_bytes=opt_bytes,
                                     comm_bytes=int(lane_comm),
                                     priced_by=priced_by,
@@ -1427,15 +1509,23 @@ def plan(
     all-indivisible request yields an EMPTY frontier rather than a
     silently-adjusted one.
 
-    ``mesh_options`` (SPMD) opens the 3D axis: a list of ``(dp, tp)``
-    width pairs to search (default: the pipe's own widths only).  Every
-    width candidate is certified by the static sharding verifier
+    ``mesh_options`` (SPMD) opens the mesh axis: a list of ``(dp, tp)``
+    width pairs or ``(dp, tp, ep)`` triples to search (default: the
+    pipe's own widths only).  Every width candidate is certified by the
+    static sharding verifier
     (:func:`torchgpipe_tpu.analysis.sharding.verify_layout`) — an
     unmatched param leaf, a mesh-axis mismatch, an implicit reshard or
     an unused declared axis REJECTS the width — and its collective
     volume (required tp psums from the propagation + the dp gradient
-    all-reduce) is priced into the lane time at
+    all-reduce + the MoE expert ``all_to_all`` dispatch/combine pair at
+    ep > 1) is priced into the lane time at
     :data:`~torchgpipe_tpu.analysis.sharding.COMM_FLOPS_PER_BYTE`.
+    An ep > 1 candidate is rejected outright unless the pipe declares
+    ``ep_axis`` AND the block contains an expert-parallel MoE layer
+    whose ``n_experts`` divides by ep (``validate_mesh``'s refusal,
+    surfaced as an honest REJECT row before any tracing); certified
+    MoE candidates additionally charge the a2a staging bytes the
+    block trace cannot see into the memory high-water mark.
     ``zero_options`` controls the ZeRO sharding-level axis (levels
     ``0``/``1``/``3``; bools normalize ``False`` → 0, ``True`` → 1;
     default ``[0, 1]`` at dp > 1): level-1 candidates charge optimizer
@@ -1554,13 +1644,15 @@ def apply_plan(pipe: Any, chosen: Plan) -> Any:
         return applied
     own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
     own_tp = pipe.mesh.shape[pipe.tp_axis] if pipe.tp_axis else 1
-    if (chosen.dp, chosen.tp) != (own_dp, own_tp):
+    own_ep = pipe.mesh.shape[pipe.ep_axis] if getattr(pipe, "ep_axis", None) else 1
+    if (chosen.dp, chosen.tp, chosen.ep) != (own_dp, own_tp, own_ep):
         raise ValueError(
-            f"the chosen plan wants a dp×tp width of "
-            f"{chosen.dp}x{chosen.tp} but this pipe's mesh is "
-            f"{own_dp}x{own_tp}: apply_plan cannot resize a device "
-            "mesh — build one with make_mesh(n_stages, dp, tp=tp) and "
-            "construct the pipe on it, then apply the plan there"
+            f"the chosen plan wants a dp×tp×ep width of "
+            f"{chosen.dp}x{chosen.tp}x{chosen.ep} but this pipe's mesh "
+            f"is {own_dp}x{own_tp}x{own_ep}: apply_plan cannot resize "
+            "a device mesh — build one with make_mesh(n_stages, dp, "
+            "tp=tp, ep=ep) and construct the pipe on it, then apply "
+            "the plan there"
         )
     # Level 3 is a STORAGE-layout decision: applying it flips fsdp on
     # (params/grads/state stored sharded, gathered at use).  Levels 0/1
@@ -1604,6 +1696,8 @@ def verify_plan(
             (pipe.dp_axis or "dp"): chosen.dp,
             (pipe.tp_axis or "tp"): chosen.tp,
         }
+        if getattr(pipe, "ep_axis", None) is not None:
+            overrides[pipe.ep_axis] = chosen.ep
         report = shd.verify_layout(
             applied, batch, mesh_sizes=overrides
         )
@@ -1677,19 +1771,20 @@ def effective_zero_level(pipe: Any) -> int:
 
 def _config_of(pipe: Any) -> Tuple:
     """The (schedule, checkpoint, policy-label, chunks, balance,
-    megastep, scan_unroll-key, dp, tp, zero-level) key a pipe actually
-    runs — matched against the planner's candidates."""
+    megastep, scan_unroll-key, dp, tp, ep, zero-level) key a pipe
+    actually runs — matched against the planner's candidates."""
     from torchgpipe_tpu.gpipe import GPipe
 
     if isinstance(pipe, GPipe):
         return (pipe.schedule, pipe.checkpoint, None, pipe.chunks,
                 tuple(pipe.balance), getattr(pipe, "megastep", 1),
-                _unroll_key(1), 1, 1, 0)
+                _unroll_key(1), 1, 1, 1, 0)
     own_dp = pipe.mesh.shape[pipe.dp_axis] if pipe.dp_axis else 1
     own_tp = pipe.mesh.shape[pipe.tp_axis] if pipe.tp_axis else 1
+    own_ep = pipe.mesh.shape[pipe.ep_axis] if getattr(pipe, "ep_axis", None) else 1
     return (pipe.schedule, pipe.checkpoint, _spmd_policy_label(pipe),
             pipe.chunks, None, pipe.megastep,
-            _unroll_key(pipe.scan_unroll), own_dp, own_tp,
+            _unroll_key(pipe.scan_unroll), own_dp, own_tp, own_ep,
             effective_zero_level(pipe))
 
 
@@ -1751,7 +1846,7 @@ def check_plan_drift(trace: Any) -> List[Finding]:
     def plan_key(p: Plan) -> Tuple:
         return (p.schedule, p.checkpoint, p.policy, p.chunks, p.balance,
                 p.megastep, _unroll_key(p.scan_unroll), p.dp, p.tp,
-                p.zero)
+                p.ep, p.zero)
 
     actual_key = _config_of(trace.pipe)
     actual = next(

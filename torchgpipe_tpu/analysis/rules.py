@@ -668,6 +668,135 @@ def _check_dispatch_per_step(trace: PipelineTrace) -> List[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# capacity-overflow                                                     #
+# --------------------------------------------------------------------- #
+
+# Expected-drop fraction above which a capacity-factor MoE dispatch is
+# flagged: below it the truncation is routing noise the auxiliary
+# balance loss absorbs; above it the layer silently zeroes a material
+# share of its tokens every step (capacity overflow drops tokens, it
+# does not error).
+CAPACITY_OVERFLOW_THRESHOLD = 0.10
+
+# Probe token count when the trace carries no concrete token plane: the
+# capacity formula's ceil() rounds to the same drop fraction for any
+# large t, so one asymptotic probe is representative.
+_CAPACITY_PROBE_TOKENS = 4096
+
+
+def _moe_lane_tokens(trace: PipelineTrace) -> Optional[int]:
+    """Lane-local tokens at the MoE dispatch: per-micro-batch rows
+    (batch over chunks x dp x ep) times sequence length, read off the
+    traced input spec — the shape the engine computes capacity from.
+    None when no 2-D token plane is visible."""
+    leaves = [
+        a for a in jax.tree_util.tree_leaves(trace.x_spec)
+        if getattr(a, "ndim", 0) >= 2
+    ]
+    if not leaves:
+        return None
+    b, s = int(leaves[0].shape[0]), int(leaves[0].shape[1])
+    width = max(int(trace.chunks or 1), 1)
+    pipe = trace.pipe
+    if trace.engine == "spmd":
+        for ax in ("dp_axis", "ep_axis"):
+            name = getattr(pipe, ax, None)
+            if name:
+                width *= int(pipe.mesh.shape[name])
+    rows = max(b // width, 1)
+    return rows * s
+
+
+def _check_capacity_overflow(trace: PipelineTrace) -> List[Finding]:
+    """The MoE dispatch-capacity rule, from the layer's static
+    ``meta['moe']`` record (the same discovery path the planner and the
+    sharding comm model use — :func:`analysis.events.find_moe_meta`):
+
+    * ERROR — ``top_k > n_experts``: the router cannot pick k distinct
+      experts from fewer than k; the top_k selection repeats experts and
+      the combine double-counts them.
+    * ERROR — an expert-parallel layer whose ``n_experts`` does not
+      divide the pipe's ep width: ``validate_mesh`` refuses this mesh at
+      run time; surface it statically.
+    * WARNING — the expected drop fraction under balanced routing,
+      ``1 - slots / demand`` with ``slots = n_experts * capacity`` and
+      ``demand = top_k * tokens`` (token-choice) or ``tokens``
+      (expert-choice), exceeds :data:`CAPACITY_OVERFLOW_THRESHOLD`:
+      even a PERFECT router must drop that share every step.  Dropless
+      dispatch has no capacity and stands down.
+    """
+    from torchgpipe_tpu.analysis import events as ev
+
+    pipe = trace.pipe
+    metas: List[Dict[str, Any]] = []
+    for attr in ("block", "pre", "post"):
+        metas.extend(ev.find_moe_meta(getattr(pipe, attr, None)))
+    for lyr in (getattr(pipe, "layers", None) or ()):
+        metas.extend(ev.find_moe_meta(lyr))
+    if not metas:
+        return []
+    ep = 1
+    if trace.engine == "spmd" and getattr(pipe, "ep_axis", None):
+        ep = int(pipe.mesh.shape[pipe.ep_axis])
+    lane_tokens = _moe_lane_tokens(trace)
+    out: List[Finding] = []
+    for i, m in enumerate(metas):
+        E, K = int(m["n_experts"]), int(m["top_k"])
+        path = f"{trace.engine}/moe[{i}]"
+        if K > E:
+            out.append(Finding(
+                rule="capacity-overflow",
+                severity=Severity.ERROR,
+                path=path,
+                message=(
+                    f"top_k={K} exceeds n_experts={E} — the router "
+                    "cannot select k distinct experts from fewer than "
+                    "k; the top-k picks repeat experts and the combine "
+                    "double-counts their outputs"
+                ),
+            ))
+            continue
+        if m.get("ep_axis") and ep > 1 and E % ep != 0:
+            out.append(Finding(
+                rule="capacity-overflow",
+                severity=Severity.ERROR,
+                path=path,
+                message=(
+                    f"n_experts={E} does not divide by the mesh's "
+                    f"ep={ep} — validate_mesh refuses this mesh at run "
+                    "time (each ep lane owns n_experts/ep experts); "
+                    "choose n_experts divisible by ep or narrow the "
+                    "expert axis"
+                ),
+            ))
+            continue
+        if m.get("dispatch") == "dropless":
+            continue  # no capacity buffer, nothing to drop
+        t = lane_tokens or _CAPACITY_PROBE_TOKENS
+        cap = ev.moe_capacity(m, t)
+        demand = t if m.get("router") == "expert_choice" else K * t
+        drop = max(0.0, 1.0 - (E * cap) / max(demand, 1))
+        if drop > CAPACITY_OVERFLOW_THRESHOLD:
+            cf = float(m["capacity_factor"])
+            out.append(Finding(
+                rule="capacity-overflow",
+                severity=Severity.WARNING,
+                path=path,
+                message=(
+                    f"capacity_factor={cf:g} gives each of the {E} "
+                    f"experts {cap} slots for {demand} routed "
+                    f"assignments per lane ({t} tokens, top_k={K}) — "
+                    f"even a perfectly balanced router must drop "
+                    f"{drop:.0%} of them every step (capacity overflow "
+                    "zeroes tokens silently, it does not error); raise "
+                    "capacity_factor toward 1.0+, or switch to "
+                    "dispatch='dropless' which has no capacity"
+                ),
+            ))
+    return out
+
+
+# --------------------------------------------------------------------- #
 # registry + runner                                                     #
 # --------------------------------------------------------------------- #
 
@@ -728,6 +857,14 @@ RULES: List[Rule] = [
         "compiles K steps into one program); stands down when "
         "donate=False keeps StepGuard's per-step retry semantics",
         _check_dispatch_per_step,
+    ),
+    Rule(
+        "capacity-overflow",
+        "an MoE layer's static capacity must not force a material "
+        "expected drop rate even under balanced routing, top_k must "
+        "not exceed n_experts, and n_experts must divide the ep width "
+        "(validate_mesh's run-time refusal, surfaced statically)",
+        _check_capacity_overflow,
     ),
 ]
 

@@ -1005,6 +1005,29 @@ def _block_propagation(
     stage_specs_flat = [
         stage_spec(s, g) for (_, s), g in zip(flat_bs, gaxes_list)
     ]
+    ep_ax = getattr(pipe, "ep_axis", None)
+    if ep_ax is not None:
+        # Expert-parallel leaves enter the PROPAGATION replicated: the
+        # plain block trace carries no ep collectives (moe_mlp gates its
+        # all_to_all pair on a BOUND ep axis, which only exists inside
+        # shard_map), so pushing P(ep) through the expert einsums would
+        # manufacture psum/reshard hazards the real program resolves
+        # with its a2a pair.  Storage accounting keeps the sharded
+        # layout (param_bytes_local, replication check); the a2a itself
+        # is priced analytically from ``meta['moe']`` — see
+        # :func:`_moe_comm_events`.
+        def _drop_ep(s: P) -> P:
+            def drop(e: Any) -> Any:
+                if e is None:
+                    return None
+                if isinstance(e, tuple):
+                    kept = tuple(a for a in e if a != ep_ax)
+                    return kept if kept else None
+                return None if e == ep_ax else e
+
+            return P(*[drop(e) for e in tuple(s)])
+
+        stage_specs_flat = [_drop_ep(s) for s in stage_specs_flat]
     stage_specs = jax.tree_util.tree_unflatten(bs_tdef, stage_specs_flat)
 
     def f(p: Pytree, x: Pytree) -> Pytree:
@@ -1041,17 +1064,25 @@ def _block_propagation(
     dp = getattr(pipe, "dp_axis", None)
     in_specs: List[Any] = []
     in_specs.extend(stage_specs_flat)
+    # The engine shards the batch dim over BOTH data-like axes: dp and
+    # (for expert-parallel pipes) ep — ep lanes each carry their own
+    # batch shard, routing tokens to remote experts via the a2a.
+    batch_axes = tuple(
+        a for a in (dp, ep_ax)
+        if a is not None and mesh.size(a) > 1
+    )
     for leaf in jax.tree_util.tree_leaves(x_spec):
         nd = len(getattr(leaf, "shape", ()))
         sh = [()] * nd
-        if dp is not None and nd > 0 and mesh.size(dp) > 1:
-            sh[0] = (dp,)
+        if nd > 0 and batch_axes:
+            sh[0] = batch_axes
         in_specs.append(tuple(sh))
     result = propagate_shardings(closed, in_specs, mesh, path="spmd/block")
     # Boundary contract: the schedule's carry (the activation handed to
     # the next stage over the pp ring) is replicated over every axis but
-    # dp — a block OUTPUT still sharded over tp/ep must be gathered
-    # every tick, the classic implicit reshard.
+    # the data-like ones (dp, ep) — a block OUTPUT still sharded over
+    # tp must be gathered every tick, the classic implicit reshard.
+    data_like = {a for a in (dp, ep_ax) if a is not None}
     out_leaves = [
         v for v in (
             closed.jaxpr.outvars if hasattr(closed, "jaxpr")
@@ -1060,7 +1091,7 @@ def _block_propagation(
     ]
     for sh, v in zip(result.out_shardings, out_leaves):
         stray = sorted({
-            a for e in sh for a in e if dp is None or a != dp
+            a for e in sh for a in e if a not in data_like
         })
         if stray:
             nbytes = jx.aval_bytes(v)
@@ -1084,6 +1115,54 @@ def _block_propagation(
                 ),
             ))
     return result, None, use_counts
+
+
+def _moe_comm_events(pipe: Any, x_for_block: Pytree) -> List[CommEvent]:
+    """Synthesized expert-parallel all_to_all events for one block probe.
+
+    ``moe_mlp`` gates its dispatch/combine ``lax.all_to_all`` pair on a
+    BOUND ep axis, so the abstractly-traced block (outside shard_map)
+    never contains them — the comm model reconstructs the pair per MoE
+    layer from the declared ``meta['moe']`` hyperparameter record at the
+    probe's token count instead.  Each direction moves the full
+    ``[E, C, d]`` capacity buffer; :meth:`PropagationResult.comm_bytes`
+    prices it through the house collective table (``all_to_all`` =
+    ``(ep-1)/ep`` of the buffer crosses lanes), so the events price to
+    ZERO at ep width 1 and re-price under any candidate mesh.  The
+    planner's linear rows rescale (``mb_rows / probe_rows``) carries
+    them to candidate chunk counts — exact up to capacity's ceil."""
+    from torchgpipe_tpu.analysis import events as ev_mod
+
+    block = getattr(pipe, "block", None)
+    if block is None:
+        return []
+    metas = ev_mod.find_moe_meta(block)
+    if not metas:
+        return []
+    leaves = [
+        leaf for leaf in jax.tree_util.tree_leaves(x_for_block)
+        if len(getattr(leaf, "shape", ())) >= 2
+    ]
+    if not leaves:
+        return []
+    shape = leaves[0].shape
+    tokens = int(shape[0]) * int(shape[1])
+    out: List[CommEvent] = []
+    for i, m in enumerate(metas):
+        ep_ax = m.get("ep_axis")
+        if ep_ax is None:
+            continue
+        nbytes = ev_mod.moe_all_to_all_bytes(m, tokens)
+        if nbytes <= 0:
+            continue
+        for which in ("dispatch", "combine"):
+            out.append(CommEvent(
+                kind="collective", axes=(str(ep_ax),), bytes=nbytes,
+                eqn_index=-1, primitive="all_to_all",
+                path=f"spmd/block/moe[{i}]",
+                detail=f"expert {which} all_to_all ([E, C, d] buffer)",
+            ))
+    return out
 
 
 def verify_layout(
@@ -1157,6 +1236,10 @@ def verify_layout(
                 propagated = True
                 findings.extend(result.findings)
                 comm.extend(result.comm)
+            # Expert parallelism: the a2a dispatch/combine pair is
+            # invisible to the trace — synthesize it analytically from
+            # the block's declared MoE records (prices to zero at ep=1).
+            comm.extend(_moe_comm_events(pipe, x_for_block))
     gacct = _gather_accounting(
         pipe, params_spec, specs, gathers, mesh, use_counts
     )

@@ -149,18 +149,23 @@ class DistributedGPipe:
         checkpoint: str = 'except_last',
         deferred_batch_norm: bool = False,
         recv_timeout: Optional[float] = None,
+        first_step_grace: Optional[float] = None,
         recorder: Optional[Any] = None,
     ) -> None:
         # recv_timeout (opt-in) bounds every cross-rank receive: a dead or
         # wedged peer surfaces as a TimeoutError naming the missing channel
         # instead of hanging the pipeline forever (the reference's RPC mode
         # has no failure handling at all — torchgpipe/distributed/
-        # context.py:37 TODO).  Leave None (default) when stage compile
-        # times are unknown — the FIRST receive also waits out the
-        # upstream rank's one-time jit compilation.  A TimeoutError is
-        # fatal for this rank's pipeline state: channels may hold stale
-        # messages and peers hold partial sends — recover by restarting
-        # the worker processes, not by retrying the step.
+        # context.py:37 TODO).  The FIRST step's receives also wait out
+        # every upstream rank's one-time jit compilation, which can dwarf
+        # a steady-state timeout; first_step_grace (seconds) is added to
+        # recv_timeout for step 0 only, so the deadline can be tight from
+        # step 1 without the first step tripping it on compile time.  A
+        # first-step timeout WITHOUT a grace configured says so in the
+        # error.  A TimeoutError is fatal for this rank's pipeline state:
+        # channels may hold stale messages and peers hold partial sends —
+        # recover by restarting the worker processes, not by retrying the
+        # step.
         layers = list(layers)
         verify_module(layers)
         verify_skippables(layers)
@@ -199,7 +204,25 @@ class DistributedGPipe:
         self.checkpoint = checkpoint
         self.transport = transport
         self.mailbox = mailbox
+        if first_step_grace is not None:
+            if recv_timeout is None:
+                raise ValueError(
+                    "first_step_grace extends recv_timeout for the "
+                    "compile-heavy first step, but recv_timeout is None "
+                    "(receives already wait forever); set recv_timeout "
+                    "or drop the grace"
+                )
+            if first_step_grace <= 0:
+                raise ValueError(
+                    f"first_step_grace must be positive seconds "
+                    f"(got {first_step_grace!r})"
+                )
         self.recv_timeout = recv_timeout
+        self.first_step_grace = first_step_grace
+        # Flips after the first completed forward: steady-state receives
+        # never pay upstream compile time again, so the grace stops
+        # applying.
+        self._warmed = False
         # Flight recorder (torchgpipe_tpu.obs.flightrec.FlightRecorder):
         # every send enqueue, receive wait/match, cell completion and
         # loop boundary becomes a ring-buffer event, and the mailbox
@@ -261,6 +284,31 @@ class DistributedGPipe:
     def is_last(self) -> bool:
         return self.rank == len(self.workers) - 1
 
+    def _effective_timeout(self) -> Optional[float]:
+        """The receive deadline for the CURRENT step: ``recv_timeout``
+        plus ``first_step_grace`` while the pipeline is still cold (the
+        first step's receives wait out upstream jit compilation too)."""
+        if self.recv_timeout is None:
+            return None
+        if not self._warmed and self.first_step_grace is not None:
+            return self.recv_timeout + self.first_step_grace
+        return self.recv_timeout
+
+    def _first_step_hint(self, err: TimeoutError) -> TimeoutError:
+        """A first-step timeout with NO grace configured is ambiguous —
+        the deadline may simply have measured the upstream rank's
+        one-time jit compile.  Say so in the error instead of letting
+        the user chase a phantom hang."""
+        if self._warmed or self.first_step_grace is not None:
+            return err
+        return TimeoutError(
+            f"{err} (this was the FIRST step: the wait includes the "
+            "upstream rank's one-time jit compilation, which can exceed "
+            "any steady-state deadline — pass first_step_grace=<compile "
+            "budget seconds> to extend recv_timeout for step 0 only, or "
+            "recv_timeout=None to wait compiles out)"
+        )
+
     def _recv(self, kind: Any, index: int, src_rank: int) -> Pytree:
         """Deadline-bounded mailbox receive placed on this rank's device.
 
@@ -268,14 +316,17 @@ class DistributedGPipe:
         for liveness so a dead peer raises a clean
         :class:`~torchgpipe_tpu.distributed.context.PeerDiedError` naming
         the rank instead of an anonymous timeout."""
-        return jax.device_put(
-            _recv_probing_peer(
+        try:
+            payload = _recv_probing_peer(
                 self.mailbox, self.transport, kind, index,
-                self.recv_timeout, src_rank, self.workers,
+                self._effective_timeout(), src_rank, self.workers,
                 recorder=self.recorder,
-            ),
-            self.device,
-        )
+            )
+        except PeerDiedError:
+            raise
+        except TimeoutError as err:
+            raise self._first_step_hint(err) from err
+        return jax.device_put(payload, self.device)
 
     def _send(self, dst_rank: int, kind: Any, index: int,
               payload: Pytree) -> None:
@@ -366,13 +417,18 @@ class DistributedGPipe:
             if batch is not None:
                 raise ValueError("only rank 0 feeds the input batch")
             mbatches = None
-            m = int(
-                _recv_probing_peer(
-                    self.mailbox, self.transport, "meta", 0,
-                    self.recv_timeout, 0, self.workers,
-                    recorder=self.recorder,
+            try:
+                m = int(
+                    _recv_probing_peer(
+                        self.mailbox, self.transport, "meta", 0,
+                        self._effective_timeout(), 0, self.workers,
+                        recorder=self.recorder,
+                    )
                 )
-            )
+            except PeerDiedError:
+                raise
+            except TimeoutError as err:
+                raise self._first_step_hint(err) from err
 
         if rec is not None:
             # The agreed micro-batch count, recorded once it is known
@@ -429,6 +485,12 @@ class DistributedGPipe:
         if rec is not None:
             rec.record("forward_end", detail=f"m={m}")
 
+        if not train:
+            # Eval has no backward leg: everything this rank's receives
+            # can block on has compiled once — the grace stops applying.
+            # (A train-mode step stays cold until backward completes:
+            # step 0's backward waits out DOWNSTREAM compiles too.)
+            self._warmed = True
         self._ctx = {
             "m": m,
             "pulls": pulls,
@@ -528,6 +590,9 @@ class DistributedGPipe:
         if rec is not None:
             rec.record("backward_end", detail=f"m={m}")
 
+        # Both pipeline legs have now compiled on every rank this one
+        # blocks on — steady state from here; the first-step grace ends.
+        self._warmed = True
         return list(acc), ctx["state"]
 
 

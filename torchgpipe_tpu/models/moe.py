@@ -325,7 +325,7 @@ def _sparse_assignment(
 def _dropless_assignment(
     probs: jnp.ndarray,
     k: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Expert-sorted token assignment for the dropless path.
 
     Returns ``(order, tok_sorted, group_sizes, gates)`` where
@@ -547,6 +547,22 @@ def moe_mlp(cfg: TransformerConfig, moe: MoEConfig, *, name: str = "moe") -> Lay
                 "w_up": P(ep),
                 "w_down": P(ep),
             },
+            # Static hyperparameters for the analysis stack: the expert
+            # all_to_all is gated on a BOUND ep axis, so the planner's
+            # block trace (outside shard_map) never sees it — the comm /
+            # memory / capacity-overflow models reconstruct the sparse
+            # dispatch analytically from this record instead.
+            "moe": {
+                "n_experts": E,
+                "top_k": K,
+                "capacity_factor": float(moe.capacity_factor),
+                "dispatch": moe.dispatch,
+                "router": moe.router,
+                "ep_axis": ep,
+                "dim": dim,
+                "hidden": hidden,
+                "itemsize": jnp.dtype(dt).itemsize,
+            },
         },
     )
 
@@ -555,7 +571,7 @@ def router_stats(
     params_router: jnp.ndarray,
     x: jnp.ndarray,
     moe: MoEConfig,
-) -> Dict[str, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Standard router monitoring metrics from hidden states ``[b, s, dim]``:
     ``(load, importance, balance_loss)`` — per-expert assignment fractions
     over all ``top_k`` selection rounds, per-expert mean probabilities, and

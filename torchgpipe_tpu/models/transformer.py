@@ -715,6 +715,11 @@ def transformer_block(
     }
     if "ep_axis" in mlp_meta:
         meta["ep_axis"] = mlp_meta["ep_axis"]
+    if "moe" in mlp_meta:
+        # The mlp's static MoE hyperparameter record rides up so the
+        # analysis stack (planner / sharding / capacity-overflow lint)
+        # can read the sparse dispatch through the block wrapper.
+        meta["moe"] = mlp_meta["moe"]
     if "balance_weight" in mlp_meta:
         # Surfaced so the engine's ragged-batch warning can see a MoE
         # balance penalty through the block wrapper (spmd._row_coupled).

@@ -141,6 +141,17 @@ class Engine:
         _split_params(cfg, self.params)  # validates the per-layer list
         _check_decodable(cfg, max_len)
         self.moe = moe
+        if moe is not None and getattr(moe, "router", "topk") == "expert_choice":
+            raise ValueError(
+                "expert_choice routing selects the top-C tokens PER "
+                "EXPERT across the batch — at decode time the batch is "
+                "one token per slot, so the experts compete over "
+                "UNRELATED streams and a slot's token can be chosen by "
+                "no expert (it silently emits the zero vector, "
+                "corrupting that stream); serve MoE models with "
+                "token-choice routing (router='topk'), which routes "
+                "every token independently of its batch neighbours"
+            )
         # ``prefill_chunk`` may be an int (one prefill program — the
         # classic configuration) or a LADDER of chunk sizes (e.g.
         # ``(1, 2, 4, 8)``): one program per bucket, a prefill step
