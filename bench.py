@@ -1022,6 +1022,26 @@ def _moe_entry() -> None:
     raise SystemExit(moe_main())
 
 
+def _rollout_entry() -> None:
+    """The ``rollout`` rung: live weight rollouts under a mixed-tier
+    MMPP trace — a 2-replica QoS fleet completes two rolling updates
+    and one forced rollback mid-trace vs a no-rollout control on the
+    same requests (benchmarks/rollout_trace.py — which owns the
+    measurement contract: zero dropped streams, every stream bitwise
+    the control's, interactive-tier TPOT p95 within 1.1x control on
+    per-replica step clocks, timed region compile-free)::
+
+        env JAX_PLATFORMS=cpu python bench.py --rollout
+    """
+    sys.argv = [sys.argv[0]] + [
+        a for a in sys.argv[1:] if a != "--rollout"
+    ] + ["--json"]
+    from benchmarks.rollout_trace import main as rollout_main
+
+    rollout_main()
+    raise SystemExit(0)
+
+
 def _plan_validate_entry() -> None:
     """The ``plan-validate`` rung: predicted-vs-measured rank-order check
     of the static planner on the CPU tiny-llama preset
@@ -1052,6 +1072,8 @@ if __name__ == "__main__":
         _disagg_entry()
     elif "--moe" in sys.argv:
         _moe_entry()
+    elif "--rollout" in sys.argv:
+        _rollout_entry()
     elif "--megastep" in sys.argv:
         _megastep_entry()
     elif "--packing" in sys.argv:

@@ -1,0 +1,357 @@
+"""QoS-tier scheduling contracts (docs/serving.md, QoS section).
+
+1. **Tier-ordered admission** — when slots are scarce an interactive
+   request admits before standard/batch work queued ahead of it; an
+   all-default workload admits exactly FIFO (the degenerate case).
+2. **Budgets demote, never drop** — an over-budget tenant's requests
+   land in the batch tier and still run to completion.
+3. **Preemption is exact** — a batch-tier request evicted for
+   interactive work resumes BITWISE what an unpreempted run emits
+   (the drain/teacher-force path, per-request).
+4. **Spend survives migration** — one shared QosPolicy on the base
+   registry keeps a tenant's token count exact across drain/failover,
+   reqtrace-stitched across both replicas.
+5. **Reads mint nothing** — rejected submits with tier/tenant labels
+   and ``breaching(split_by="tenant")`` on an idle fleet leave the
+   registry's series exactly as they were (the PR 8 phantom-series
+   contract).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu import fleet, obs
+from torchgpipe_tpu.layers import sequential_init
+from torchgpipe_tpu.models.generation import generate
+from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+from torchgpipe_tpu.obs import MetricsRegistry
+from torchgpipe_tpu.resilience import faults
+from torchgpipe_tpu.serving import Engine, QosConfig, QosPolicy
+from torchgpipe_tpu.serving.qos import TIERS, check_tier
+
+CFG = TransformerConfig(
+    vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+)
+
+
+@pytest.fixture(scope="module")
+def flat_params():
+    params, _, _ = sequential_init(
+        llama(CFG), jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((2, 8), jnp.int32),
+    )
+    return params
+
+
+def _mk_engine(params, *, name=None, shared=None, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_chunk", 8)
+    if shared is not None:
+        kw["registry"] = shared.labeled(replica=name)
+    return Engine(CFG, params, **kw)
+
+
+def _ref(params, prompt, new, max_len=32):
+    return np.asarray(
+        generate(CFG, params, jnp.asarray(prompt)[None, :], new,
+                 max_len=max_len)
+    )[0]
+
+
+def _series_snapshot(reg):
+    return {m.name: set(m.series().keys()) for m in reg.metrics()}
+
+
+# --------------------------------------------------------------------- #
+# 1. policy units                                                       #
+# --------------------------------------------------------------------- #
+
+
+def test_qos_config_validation():
+    with pytest.raises(ValueError, match="unknown QoS tier"):
+        QosConfig(demote_tier="vip")
+    with pytest.raises(ValueError, match="unknown QoS tier"):
+        QosConfig(preemptible_tiers=("background",))
+    with pytest.raises(ValueError, match="budget must be >= 1"):
+        QosConfig(tenant_budgets={"t": 0})
+    with pytest.raises(ValueError, match="unknown QoS tier"):
+        check_tier("premium")
+    assert TIERS == ("interactive", "standard", "batch")
+
+
+def test_budget_accounting_and_demotion():
+    pol = QosPolicy(QosConfig(tenant_budgets={"acme": 5}))
+    assert pol.spent("acme") == 0 and pol.budget("acme") == 5
+    assert not pol.over_budget("acme")
+    pol.spend("acme", 5)
+    assert pol.over_budget("acme")
+    # over budget -> demoted, but never ABOVE the declared tier
+    assert pol.effective_tier("interactive", "acme") == "batch"
+    assert pol.effective_tier("batch", "acme") == "batch"
+    # unbudgeted tenants and anonymous requests are untouched
+    assert pol.effective_tier("interactive", "other") == "interactive"
+    assert pol.effective_tier("interactive", None) == "interactive"
+    assert not pol.over_budget(None) and pol.budget(None) is None
+    # reads of unseen tenants mint no series
+    before = set(pol._c_tokens.series().keys())
+    assert pol.spent("never-seen") == 0
+    assert set(pol._c_tokens.series().keys()) == before
+
+
+# --------------------------------------------------------------------- #
+# 2. tier-ordered admission                                             #
+# --------------------------------------------------------------------- #
+
+
+def test_interactive_admits_before_earlier_batch(flat_params):
+    """One slot, three tiers queued while it is busy: the free slot
+    goes interactive -> standard -> batch regardless of arrival order
+    (preemption disabled so only ADMISSION ordering is in play)."""
+    pol = QosPolicy(QosConfig(preemptible_tiers=()))
+    eng = _mk_engine(flat_params, num_slots=1, qos=pol)
+    first_token_order = []
+
+    def on_token(rid, tok):
+        if rid not in first_token_order:
+            first_token_order.append(rid)
+
+    eng.submit(np.arange(4, dtype=np.int32), 3, rid="head",
+               on_token=on_token)
+    eng.step()               # head occupies the only slot
+    eng.submit(np.arange(3, dtype=np.int32), 2, rid="bg",
+               tier="batch", on_token=on_token)
+    eng.submit(np.arange(3, dtype=np.int32), 2, rid="std",
+               tier="standard", on_token=on_token)
+    eng.submit(np.arange(3, dtype=np.int32), 2, rid="ia",
+               tier="interactive", on_token=on_token)
+    eng.run()
+    assert first_token_order == ["head", "ia", "std", "bg"]
+    for rid in ("head", "ia", "std", "bg"):
+        assert eng.status(rid) == "finished"
+
+
+def test_uniform_tiers_admit_fifo(flat_params):
+    """All-default tiers with a policy attached == classic FIFO."""
+    pol = QosPolicy()
+    eng = _mk_engine(flat_params, num_slots=1, qos=pol)
+    order = []
+
+    def on_token(rid, tok):
+        if rid not in order:
+            order.append(rid)
+
+    rids = [f"r{i}" for i in range(4)]
+    for rid in rids:
+        eng.submit(np.arange(3, dtype=np.int32), 2, rid=rid,
+                   on_token=on_token)
+    eng.run()
+    assert order == rids
+
+
+def test_over_budget_tenant_demoted_not_dropped(flat_params):
+    """A tenant past its budget keeps being served — its later
+    requests just queue behind standard traffic (batch tier)."""
+    pol = QosPolicy(QosConfig(tenant_budgets={"acme": 2},
+                              preemptible_tiers=()))
+    eng = _mk_engine(flat_params, num_slots=1, qos=pol)
+    order = []
+
+    def on_token(rid, tok):
+        if rid not in order:
+            order.append(rid)
+
+    # burn acme's budget (2 tokens)
+    eng.submit(np.arange(4, dtype=np.int32), 2, rid="a0",
+               tenant="acme", on_token=on_token)
+    eng.run()
+    assert pol.spent("acme") == 2 and pol.over_budget("acme")
+    # now an interactive acme request DEMOTES below plain standard
+    eng.submit(np.arange(4, dtype=np.int32), 3, rid="busy",
+               on_token=on_token)
+    eng.step()
+    eng.submit(np.arange(3, dtype=np.int32), 2, rid="a1",
+               tier="interactive", tenant="acme", on_token=on_token)
+    eng.submit(np.arange(3, dtype=np.int32), 2, rid="other",
+               on_token=on_token)
+    eng.run()
+    assert order == ["a0", "busy", "other", "a1"]
+    assert eng.status("a1") == "finished"        # demoted, not dropped
+    assert pol._c_demotions.value(tenant="acme") >= 1
+    assert pol.spent("acme") == 4                # both requests charged
+
+
+def test_submit_rejects_unknown_tier(flat_params):
+    eng = _mk_engine(flat_params)
+    with pytest.raises(ValueError, match="unknown QoS tier"):
+        eng.submit(np.arange(3, dtype=np.int32), 2, tier="premium")
+    assert eng.scheduler.idle        # nothing registered
+
+
+# --------------------------------------------------------------------- #
+# 3. preemption is exact                                                #
+# --------------------------------------------------------------------- #
+
+
+def test_preempted_batch_stream_resumes_bitwise(flat_params):
+    """Interactive pressure evicts the batch stream mid-decode; the
+    resumed stream is bitwise an unpreempted run (satellite gate)."""
+    pol = QosPolicy()
+    eng = _mk_engine(flat_params, num_slots=1, qos=pol)
+    pb = np.arange(4, dtype=np.int32)
+    pi = (np.arange(4, dtype=np.int32) + 7) % 64
+    rb = eng.submit(pb, 6, tier="batch", tenant="bg")
+    for _ in range(3):
+        eng.step()              # batch is mid-generation
+    ri = eng.submit(pi, 4, tier="interactive", tenant="fg")
+    eng.run()
+    assert np.array_equal(eng.result(rb), _ref(flat_params, pb, 6))
+    assert np.array_equal(eng.result(ri), _ref(flat_params, pi, 4))
+    assert int(pol._c_preemptions.value()) == 1
+    assert pol.spent("bg") == 6 and pol.spent("fg") == 4
+    # the preemption is a first-class trace event with the tier tag
+    # (req_preempt) — checked via the request's recorded status history
+    assert eng.metrics.requests[rb].status == "finished"
+
+
+def test_interactive_never_preempted_for_interactive(flat_params):
+    """Preemption only fires on PREEMPTIBLE tiers: an interactive
+    stream is never evicted, later interactive work just queues."""
+    pol = QosPolicy()
+    eng = _mk_engine(flat_params, num_slots=1, qos=pol)
+    r0 = eng.submit(np.arange(4, dtype=np.int32), 4,
+                    tier="interactive")
+    for _ in range(2):
+        eng.step()
+    r1 = eng.submit(np.arange(3, dtype=np.int32), 2,
+                    tier="interactive")
+    eng.run()
+    assert int(pol._c_preemptions.value()) == 0
+    assert eng.status(r0) == "finished"
+    assert eng.status(r1) == "finished"
+
+
+# --------------------------------------------------------------------- #
+# 4. spend survives drain/failover (one policy, base registry)         #
+# --------------------------------------------------------------------- #
+
+
+def test_tenant_spend_survives_failover_exactly(flat_params):
+    """r0 dies mid-generation; the tenant's requests resume on r1 and
+    the tenant's token counter is EXACT (each emitted token charged
+    once, across both replica incarnations), witnessed by a stitched
+    cross-replica trace carrying the tier/tenant tags."""
+    from torchgpipe_tpu.obs.flightrec import FlightRecorder, dump_from_dict
+    from torchgpipe_tpu.obs.reqtrace import detail_tag
+
+    shared = MetricsRegistry()
+    pol = QosPolicy(QosConfig(tenant_budgets={"acme": 1000}),
+                    registry=shared)         # ONE policy, BASE registry
+    recs = {n: FlightRecorder(worker=n) for n in ("r0", "r1")}
+    router = fleet.Router(
+        {n: _mk_engine(flat_params, name=n, shared=shared, qos=pol,
+                       recorder=recs[n])
+         for n in ("r0", "r1")},
+        registry=shared, seed=1,
+    )
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(0, 64, (5,)).astype(np.int32),
+             int(rng.randint(3, 6))) for _ in range(6)]
+    with faults.inject(die_at_step=(0, 3)):
+        rids = [router.submit(p, n, tenant="acme", tier="standard")
+                for p, n in reqs]
+        assert router.run() == "idle"
+    assert router._c_failovers.value() == 1
+    # every stream finished in full, bitwise
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(router.result(rid),
+                              _ref(flat_params, p, n)), rid
+    # counters exact: total tokens emitted == total charged — a token
+    # emitted before the death is not re-charged by the resumed
+    # incarnation (the teacher-forced prefix emits no on_token)
+    total = sum(n for _, n in reqs)
+    assert pol.spent("acme") == total
+    # stitched trace: the moved request's spans live on BOTH replicas
+    # and carry the QoS tags
+    moved = [r for r in rids if router._records[r].moves > 0]
+    assert moved
+    dumps = [dump_from_dict(r.to_dict()) for r in recs.values()]
+    trace = obs.stitch_request(dumps, moved[0])
+    assert trace.replicas == ["r0", "r1"]
+    assert trace.orphans == [] and trace.complete
+    for attempt in trace.root.children:
+        if attempt.name.startswith("attempt@"):
+            assert detail_tag(attempt.detail, "tier") == "standard"
+            assert detail_tag(attempt.detail, "tenant") == "acme"
+
+
+def test_tier_survives_drain_snapshot(flat_params):
+    """drain()/restore_requests round-trips tier and tenant, so a
+    migrated request keeps its class (and old snapshots default)."""
+    eng = _mk_engine(flat_params, num_slots=2)
+    eng.submit(np.arange(4, dtype=np.int32), 4, rid="a",
+               tier="batch", tenant="bg")
+    eng.step()
+    snap = eng.drain()
+    kwargs = {kw["rid"]: kw for kw in Engine.restore_requests(snap)}
+    assert kwargs["a"]["tier"] == "batch"
+    assert kwargs["a"]["tenant"] == "bg"
+    # backward compat: a pre-QoS snapshot restores to defaults
+    for meta in snap["requests"].values():
+        meta.pop("tier"), meta.pop("tenant")
+    kwargs = {kw["rid"]: kw for kw in Engine.restore_requests(snap)}
+    assert kwargs["a"]["tier"] == "standard"
+    assert kwargs["a"]["tenant"] is None
+
+
+# --------------------------------------------------------------------- #
+# 5. reads mint nothing (phantom-series contract)                       #
+# --------------------------------------------------------------------- #
+
+
+def test_rejected_submit_and_tenant_breaching_mint_no_series(
+    flat_params,
+):
+    """The PR 8 contract extended to the QoS labels: a REJECTED submit
+    carrying tier/tenant, and ``breaching(split_by="tenant")`` on an
+    idle fleet, leave every registry series set exactly as it was."""
+    shared = MetricsRegistry()
+    pol = QosPolicy(QosConfig(tenant_budgets={"acme": 10}),
+                    registry=shared)
+    monitor = obs.SloMonitor(
+        shared,
+        [obs.Objective(name="tenant-ttft", threshold=0.03, target=0.95,
+                       series="serving_ttft_seconds",
+                       split_by="tenant")],
+        short_window=0.3, long_window=1.0,
+        burn_threshold=2.0, min_count=2,
+    )
+    router = fleet.Router(
+        {n: _mk_engine(flat_params, name=n, shared=shared, qos=pol)
+         for n in ("r0", "r1")},
+        registry=shared, seed=1, slo=monitor,
+    )
+    # settle construction- and placement-time writes (occupancy
+    # gauges, serving series) with one real request, then snapshot
+    router.submit(np.arange(3, dtype=np.int32), 2,
+                  tier="interactive", tenant="acme")
+    assert router.run() == "idle"
+    router.step()
+    idle = _series_snapshot(shared)
+    # rejected: over max_len, with QoS labels attached
+    with pytest.raises(ValueError):
+        router.submit(np.arange(30, dtype=np.int32), 30,
+                      tier="interactive", tenant="acme")
+    # rejected: unknown tier, with a tenant attached
+    with pytest.raises(ValueError):
+        router.submit(np.arange(3, dtype=np.int32), 2,
+                      tier="premium", tenant="acme")
+    assert len(router._records) == 1  # only the settled request
+    # tenant-split breach evaluation on an idle fleet is a pure read
+    assert monitor.breaching(split_by="tenant") == set()
+    monitor.tick()
+    router.step()
+    assert _series_snapshot(shared) == idle

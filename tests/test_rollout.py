@@ -1,0 +1,326 @@
+"""Live weight rollout contracts (docs/serving.md, continuous rollout).
+
+1. **A swap is a pointer, not a compile** — :meth:`Engine.swap_params`
+   on a same-signature pytree changes ZERO compiled programs and the
+   swapped engine's streams are BITWISE a cold-started engine's on the
+   published params.
+2. **A re-shaped publish is refused** — ``swap_params`` raises,
+   ``analysis.serving.certify_swap`` names the mismatching leaf, and
+   the fleet keeps serving the old version untouched.
+3. **The rolling update never drops a request** — the
+   :class:`~torchgpipe_tpu.fleet.rollout.RolloutController` visits one
+   replica per tick through the router drain path; mid-rollout the
+   fleet serves two versions CONCURRENTLY and every stream finishes.
+4. **Rollback is automatic** — a published version that burns the SLO
+   on the replicas running it (``faults.inject(bad_version_at=...)``)
+   is rolled back to the baseline, one action per tick, zero drops.
+
+Tier-1 budget: one module-scoped params fixture; the wall-clock SLO
+burn scenario is slow-marked (tools/rollout_verify.py gates it in CI).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchgpipe_tpu import fleet
+from torchgpipe_tpu.layers import sequential_init
+from torchgpipe_tpu.models.generation import generate
+from torchgpipe_tpu.models.transformer import TransformerConfig, llama
+from torchgpipe_tpu.obs import MetricsRegistry
+from torchgpipe_tpu.resilience import faults
+from torchgpipe_tpu.serving import Engine
+
+CFG = TransformerConfig(
+    vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2
+)
+
+
+@pytest.fixture(scope="module")
+def flat_params():
+    params, _, _ = sequential_init(
+        llama(CFG), jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((2, 8), jnp.int32),
+    )
+    return params
+
+
+@pytest.fixture(scope="module")
+def v1_params(flat_params):
+    """A genuinely different same-signature param set (the 'trained'
+    candidate a publish ships)."""
+    return jax.tree_util.tree_map(lambda a: a * 1.01, flat_params)
+
+
+def _mk_engine(params, *, name=None, shared=None, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_chunk", 8)
+    if shared is not None:
+        kw["registry"] = shared.labeled(replica=name)
+    return Engine(CFG, params, **kw)
+
+
+def _ref(params, prompt, new, max_len=32):
+    return np.asarray(
+        generate(CFG, params, jnp.asarray(prompt)[None, :], new,
+                 max_len=max_len)
+    )[0]
+
+
+def _workload(seed, n):
+    rng = np.random.RandomState(seed)
+    return [
+        (rng.randint(0, 64, (int(rng.randint(3, 7)),)).astype(np.int32),
+         int(rng.randint(3, 6)))
+        for _ in range(n)
+    ]
+
+
+# --------------------------------------------------------------------- #
+# 1. swap_params: bitwise, compile-free, refusal                        #
+# --------------------------------------------------------------------- #
+
+
+def test_swap_params_bitwise_and_compile_free(flat_params, v1_params):
+    eng = _mk_engine(flat_params, num_slots=2)
+    reqs = _workload(seed=0, n=3)
+    for p, n in reqs:
+        eng.submit(p, n)
+    eng.run()
+    before = dict(eng.trace_counts)
+    assert eng.version == 0
+    eng.swap_params(v1_params, 1)
+    assert eng.version == 1
+    rids = [eng.submit(p, n) for p, n in reqs]
+    eng.run()
+    # zero recompiles: params are a call argument, not a constant
+    assert dict(eng.trace_counts) == before
+    for rid, (p, n) in zip(rids, reqs):
+        assert np.array_equal(eng.result(rid), _ref(v1_params, p, n))
+
+
+def test_swap_refuses_reshaped_model(flat_params):
+    from torchgpipe_tpu.analysis import Severity, certify_swap
+
+    bad_cfg = dataclasses.replace(CFG, dim=64)
+    bad_params, _, _ = sequential_init(
+        llama(bad_cfg), jax.random.PRNGKey(2),
+        jax.ShapeDtypeStruct((2, 8), jnp.int32),
+    )
+    eng = _mk_engine(flat_params, num_slots=2)
+    with pytest.raises(ValueError, match="compile is refused"):
+        eng.swap_params(bad_params, 1)
+    assert eng.version == 0          # nothing changed
+    findings = certify_swap(eng, bad_params)
+    assert any(f.severity >= Severity.ERROR and f.rule == "swap-bound"
+               for f in findings)
+    # the matching signature certifies clean
+    ok = certify_swap(eng, flat_params)
+    assert not any(f.severity >= Severity.WARNING for f in ok)
+
+
+def test_bad_version_fault_is_trace_inert():
+    """``bad_version_at`` is host-side latency only: plan_token stays
+    None (no program-cache invalidation) and the delay matches exactly
+    the (replica, version) pair."""
+    with faults.inject(bad_version_at=(1, 3), bad_version_delay=0.02):
+        assert faults.plan_token() is None
+        assert faults.bad_version_delay_s(1, 3) == pytest.approx(0.02)
+        assert faults.bad_version_delay_s(1, 2) == 0.0
+        assert faults.bad_version_delay_s(0, 3) == 0.0
+    assert faults.bad_version_delay_s(1, 3) == 0.0
+
+
+# --------------------------------------------------------------------- #
+# 2. the rolling update                                                 #
+# --------------------------------------------------------------------- #
+
+
+def test_rolling_update_two_versions_zero_drops(flat_params, v1_params):
+    """One swap per tick through the drain path: mid-rollout the fleet
+    serves v0 and v1 concurrently, nothing is dropped, and the
+    request trace spans carry the version that served them."""
+    from torchgpipe_tpu.obs.flightrec import FlightRecorder
+    from torchgpipe_tpu.obs.reqtrace import detail_tag
+
+    shared = MetricsRegistry()
+    recs = {n: FlightRecorder(worker=n) for n in ("r0", "r1")}
+    router = fleet.Router(
+        {n: _mk_engine(flat_params, name=n, shared=shared,
+                       recorder=recs[n])
+         for n in ("r0", "r1")},
+        registry=shared, seed=1,
+    )
+    ctl = fleet.RolloutController(router)
+    reqs = _workload(seed=1, n=6)
+    rids = [router.submit(p, n) for p, n in reqs]
+    assert ctl.publish(v1_params, 1) == 2
+    mixed = False
+    actions = []
+    for _ in range(200):
+        router.step()
+        act = ctl.tick()
+        if act:
+            actions.append(act)
+        if len(set(ctl.versions().values())) == 2:
+            mixed = True          # v0 and v1 serving CONCURRENTLY
+        if router.idle and not ctl._pending() \
+                and ctl.baseline == ctl.target == 1:
+            break
+    assert router.run() == "idle"
+    assert mixed, f"never observed a mixed-version fleet: {actions}"
+    assert actions[:2] == ["swap:r0:v1", "swap:r1:v1"]
+    assert actions[-1] == "complete:v1"
+    assert ctl.versions() == {"r0": 1, "r1": 1}
+    # zero dropped requests: every stream ran to its full budget
+    for rid, (p, n) in zip(rids, reqs):
+        assert len(router.result(rid)) == n, rid
+    assert shared.get("rollout_swaps_total").value(replica="r0") == 1
+    assert shared.get("rollout_target_version").value() == 1.0
+    # version labels on the trace spans (obs satellite)
+    versions_seen = set()
+    for rec in recs.values():
+        for ev in rec.to_dict()["events"]:
+            if ev["kind"] in ("req_submit", "req_finish"):
+                v = detail_tag(ev.get("detail", ""), "version")
+                assert v != "", ev
+                versions_seen.add(v)
+    assert versions_seen == {"0", "1"}
+
+
+def test_publish_monotonic_and_certified(flat_params, v1_params):
+    router = fleet.Router({"r0": _mk_engine(flat_params)})
+    ctl = fleet.RolloutController(router)
+    # at-or-below the target is refused (rollback is not a re-publish)
+    with pytest.raises(ValueError, match="monotonic"):
+        ctl.publish(v1_params, 0)
+    # a re-shaped candidate is refused with the fleet untouched
+    bad_cfg = dataclasses.replace(CFG, n_heads=2, n_kv_heads=2)
+    bad_params, _, _ = sequential_init(
+        llama(bad_cfg), jax.random.PRNGKey(3),
+        jax.ShapeDtypeStruct((2, 8), jnp.int32),
+    )
+    with pytest.raises(ValueError, match="publish refused"):
+        ctl.publish(bad_params, 1)
+    assert ctl.target == ctl.baseline == 0
+    assert ctl.versions() == {"r0": 0}
+
+
+def test_forced_rollback_swaps_back_zero_drops(flat_params, v1_params):
+    """rollback() re-targets the baseline and the per-tick swaps take
+    the fleet back down — in-flight requests still finish in full."""
+    shared = MetricsRegistry()
+    router = fleet.Router(
+        {n: _mk_engine(flat_params, name=n, shared=shared)
+         for n in ("r0", "r1")},
+        registry=shared, seed=1,
+    )
+    ctl = fleet.RolloutController(router)
+    ctl.publish(v1_params, 1)
+    reqs = _workload(seed=2, n=5)
+    rids = [router.submit(p, n) for p, n in reqs]
+    # advance until r0 is swapped, then force the rollback mid-rollout
+    while ctl.tick() != "swap:r0:v1":
+        router.step()
+    assert ctl.versions() == {"r0": 1, "r1": 0}
+    assert ctl.rollback("operator abort") == "rollback:v0"
+    acts = []
+    for _ in range(200):
+        router.step()
+        act = ctl.tick()
+        if act:
+            acts.append(act)
+        if router.idle and not ctl._pending():
+            break
+    assert router.run() == "idle"
+    assert ctl.versions() == {"r0": 0, "r1": 0}
+    assert "swap:r0:v0" in acts
+    assert shared.get("rollout_rollbacks_total").value() == 1
+    for rid, (p, n) in zip(rids, reqs):
+        assert len(router.result(rid)) == n, rid
+
+
+def test_single_replica_fleet_rolls_without_dropping(
+    flat_params, v1_params
+):
+    """The degenerate fleet: the only replica drains, swaps, readmits,
+    and its own in-flight requests resume ON IT — nothing is lost and
+    the resumed streams are bitwise the new version's cold output."""
+    router = fleet.Router({"r0": _mk_engine(flat_params, num_slots=2)})
+    ctl = fleet.RolloutController(router)
+    p, n = np.arange(5, dtype=np.int32), 6
+    rid = router.submit(p, n)
+    for _ in range(3):
+        router.step()
+    emitted_before = len(router.result(rid))
+    assert 0 < emitted_before < n       # genuinely mid-generation
+    ctl.publish(v1_params, 1)
+    assert ctl.tick() == "swap:r0:v1"
+    assert router.run() == "idle"
+    got = router.result(rid)
+    assert len(got) == n
+    # prefix emitted at v0, continuation teacher-forced at v1: the
+    # continuation equals v1 generating from prompt + v0 prefix
+    resumed_prompt = np.concatenate([p, got[:emitted_before]])
+    want_tail = _ref(v1_params, resumed_prompt, n - emitted_before)
+    assert np.array_equal(got[emitted_before:], want_tail)
+
+
+# --------------------------------------------------------------------- #
+# 3. the automatic rollback (SLO burn on the new version)               #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow  # real SLO windows burn on the wall clock (~4s);
+# tools/rollout_verify.py gates the same scenario in CI
+def test_bad_version_auto_rolls_back(flat_params, v1_params):
+    from torchgpipe_tpu import obs
+
+    shared = MetricsRegistry()
+    engines = {
+        n: _mk_engine(flat_params, name=n, shared=shared)
+        for n in ("r0", "r1")
+    }
+    for eng in engines.values():     # warm compiles before SLO attach
+        eng.submit(np.arange(6, dtype=np.int32), 2, rid="warm")
+        eng.run()
+    monitor = obs.SloMonitor(
+        shared,
+        [obs.Objective(name="ttft-p95", threshold=0.03, target=0.95,
+                       series="serving_ttft_seconds"),
+         obs.Objective(name="tpot-p95", threshold=0.03, target=0.95,
+                       series="serving_tpot_seconds")],
+        short_window=0.3, long_window=1.0,
+        burn_threshold=2.0, min_count=2,
+    )
+    router = fleet.Router(engines, registry=shared, seed=1, slo=monitor)
+    ctl = fleet.RolloutController(router)
+    rng = np.random.RandomState(3)
+    rids = []
+    rolled_back = False
+    with faults.inject(bad_version_at=(0, 1), bad_version_delay=0.05):
+        ctl.publish(v1_params, 1)
+        for k in range(400):
+            if k % 2 == 0 and len(rids) < 40:
+                rids.append(router.submit(
+                    rng.randint(0, 64, (6,)).astype(np.int32), 4))
+            router.step()
+            act = ctl.tick()
+            if act and act.startswith("rollback"):
+                rolled_back = True
+            if (rolled_back and not ctl._pending()
+                    and len(rids) >= 40 and router.idle):
+                break
+        assert router.run() == "idle"
+    assert rolled_back, "SLO burn on the bad version never rolled back"
+    assert shared.get("rollout_rollbacks_total").value() == 1
+    assert ctl.versions() == {"r0": 0, "r1": 0}
+    assert ctl.target == ctl.baseline == 0
+    # zero dropped requests through swap + burn + rollback
+    for rid in rids:
+        assert len(router.result(rid)) == 4, rid

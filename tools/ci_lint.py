@@ -131,6 +131,20 @@ differently and must not share backend state):
    with greedy streams bitwise vs ``generate`` and an expert_choice
    router refused (docs/analysis.md, MoE section).
 
+16. ``tools/rollout_verify.py`` (rollout-verify) — continuous rollout +
+   QoS, the live train→serve loop's exactness contracts on a tiny CPU
+   llama: a published same-signature param set must swap into a
+   serving engine with ZERO recompiles and streams BITWISE a
+   cold-started engine on the new params (a re-shaped publish refused
+   by ``analysis.serving.certify_swap`` and ``Engine.swap_params``
+   alike); a 2-replica rolling update must serve two versions
+   CONCURRENTLY mid-rollout with zero dropped requests; an induced bad
+   version (``faults.inject(bad_version_at=...)``) must burn the SLO
+   on exactly the updated replica and auto-roll the fleet back to the
+   baseline, again with zero drops; and a preempted batch-tier stream
+   (QoS pressure eviction) must resume bitwise (docs/serving.md,
+   continuous rollout + QoS section).
+
 Options: ``--skip-<gate>`` (e.g. ``--skip-typegate``,
 ``--skip-sharding``) to drop gates, ``--only <gate>`` (repeatable;
 matches the tag names above, e.g. ``--only moe-verify --only
@@ -231,6 +245,7 @@ GATES: List[Gate] = [
     Gate("elastic-verify", "skip_elastic", _tool("elastic_verify.py")),
     Gate("disagg-verify", "skip_disagg", _tool("disagg_verify.py")),
     Gate("moe-verify", "skip_moe", _tool("moe_verify.py")),
+    Gate("rollout-verify", "skip_rollout", _tool("rollout_verify.py")),
 ]
 
 

@@ -77,6 +77,7 @@ from torchgpipe_tpu.analysis.planner import Plan, PlanReport, apply_plan
 from torchgpipe_tpu.analysis.serving import (
     certify_ladder,
     certify_speculative,
+    certify_swap,
     lint_serving,
 )
 from torchgpipe_tpu.analysis.schedule import (
@@ -125,6 +126,7 @@ __all__ = [
     "lint",
     "certify_ladder",
     "certify_speculative",
+    "certify_swap",
     "lint_serving",
     "serving_lint",
     "max_severity",

@@ -407,6 +407,58 @@ def certify_disagg(
     return findings
 
 
+def certify_swap(engine: Any, new_params: Any) -> List[Finding]:
+    """Statically certify a live param swap (``Engine.swap_params`` —
+    the rolling-rollout path, ``fleet/rollout.py``).
+
+    The compiled serving programs take ``params`` as a traced ARGUMENT:
+    a swap is retrace-free iff every leaf of the published version keeps
+    the serving params' exact (shape, dtype) signature.  A mismatch is
+    an ERROR — swapping it in would recompile every program mid-serve,
+    so the engine refuses and the rollout controller must not publish
+    it (a re-shaped model cold-starts a fresh engine instead).  An INFO
+    finding records the certified leaf count.
+    """
+    findings: List[Finding] = []
+    old_sig = _signature(list(engine.params))
+    new_sig = _signature(list(new_params))
+    if old_sig != new_sig:
+        n = min(len(old_sig), len(new_sig))
+        detail = f"leaf count {len(old_sig)} vs {len(new_sig)}"
+        for i in range(n):
+            if old_sig[i] != new_sig[i]:
+                detail = (
+                    f"leaf {i}: serving {old_sig[i]} vs "
+                    f"published {new_sig[i]}"
+                )
+                break
+        findings.append(Finding(
+            rule="swap-bound",
+            severity=Severity.ERROR,
+            path="serving/engine",
+            message=(
+                "published params change the serving leaf signature "
+                f"({detail}) — an in-place swap would retrace every "
+                "compiled program mid-serve; new-version compile "
+                "refused (cold-start a fresh engine for a re-shaped "
+                "model)"
+            ),
+        ))
+    else:
+        findings.append(Finding(
+            rule="swap-bound",
+            severity=Severity.INFO,
+            path="serving/engine",
+            message=(
+                f"param swap certified retrace-free: {len(old_sig)} "
+                "leaves keep their (shape, dtype) signatures — KV pool "
+                "and compiled programs untouched"
+            ),
+        ))
+    findings.sort(key=lambda f: (-int(f.severity), f.path, f.rule))
+    return findings
+
+
 def lint_serving(
     engine: Any,
     grid: Optional[Sequence[Tuple[int, int]]] = None,
@@ -610,6 +662,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     flat schema and lint it over the default churn grid plus a
     shape-churny stress grid.  Exit 0 iff no finding reaches WARNING."""
     import argparse
+    import dataclasses
     import os
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -669,6 +722,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f.format())
     print(f"[serving-lint] disagg-pair: {len(findings)} finding(s), "
           f"{len(errors)} at warning+")
+    # The swap certification the rollout controller runs at publish:
+    # same-signature params certify, a re-shaped model is refused.
+    swap_ok = certify_swap(engines["fp"], params)
+    bad_params, _, _ = sequential_init(
+        llama(dataclasses.replace(cfg, dim=64)), jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, 8), jnp.int32),
+    )
+    swap_bad = certify_swap(engines["fp"], bad_params)
+    ok = (
+        not any(f.severity >= Severity.WARNING for f in swap_ok)
+        and any(f.severity >= Severity.ERROR for f in swap_bad)
+    )
+    if not ok:
+        worst += 1
+    if args.verbose or not ok:
+        for f in swap_ok + swap_bad:
+            print(f.format())
+    print(f"[serving-lint] swap: same-signature certified="
+          f"{not any(f.severity >= Severity.WARNING for f in swap_ok)}, "
+          f"re-shaped refused="
+          f"{any(f.severity >= Severity.ERROR for f in swap_bad)}")
     return 1 if worst else 0
 
 
@@ -677,6 +751,7 @@ __all__ = [
     "certify_disagg",
     "certify_ladder",
     "certify_speculative",
+    "certify_swap",
     "lint_serving",
     "main",
 ]

@@ -59,6 +59,7 @@ from torchgpipe_tpu.fleet.migration import (
     validate_pools,
 )
 from torchgpipe_tpu.fleet.prefix_cache import RadixPrefixCache
+from torchgpipe_tpu.fleet.rollout import RolloutController
 from torchgpipe_tpu.fleet.router import (
     Replica,
     ReplicaDied,
@@ -82,6 +83,7 @@ __all__ = [
     "RadixPrefixCache",
     "Replica",
     "ReplicaDied",
+    "RolloutController",
     "Router",
     "RouterRecord",
     "SpeculativeEngine",

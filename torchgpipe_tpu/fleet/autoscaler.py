@@ -56,7 +56,7 @@ from __future__ import annotations
 
 import collections
 import math
-from typing import Any, Deque, List, Optional
+from typing import Any, Deque, List, Mapping, Optional, Tuple
 
 from torchgpipe_tpu.fleet.router import Router
 
@@ -90,6 +90,7 @@ class Autoscaler:
         hold_ticks: int = 3,
         cooldown_s: float = 0.0,
         recorder: Optional[Any] = None,
+        tier_weights: Optional[Mapping[str, float]] = None,
     ) -> None:
         if cost_model is None and service_time_s is None:
             raise ValueError(
@@ -133,7 +134,19 @@ class Autoscaler:
         )
         self.parked: List[str] = []
         self._clock = router._clock
-        self._arrivals: Deque[float] = collections.deque()
+        # QoS-tier demand pricing (serving/qos.py): each arrival enters
+        # the window with its tier's weight, so an interactive-heavy
+        # mix — which must hold a tighter latency SLO — prices more
+        # replicas than the same λ of batch traffic.  Unweighted (every
+        # tier 1.0) without a map; unknown tiers weigh 1.0.
+        self.tier_weights = (
+            dict(tier_weights) if tier_weights is not None else None
+        )
+        if self.tier_weights is not None:
+            for w in self.tier_weights.values():
+                if float(w) <= 0.0:
+                    raise ValueError("tier weights must be > 0")
+        self._arrivals: Deque[Tuple[float, float]] = collections.deque()
         # Phase-disaggregated fleets are priced per pool; a unified
         # fleet is the degenerate single-pool case of the same loop.
         self.disaggregated = bool(getattr(router, "disaggregated", False))
@@ -182,21 +195,28 @@ class Autoscaler:
     # ------------------------------------------------------------------ #
 
     def observe_arrival(
-        self, n: int = 1, now: Optional[float] = None
+        self, n: int = 1, now: Optional[float] = None,
+        tier: Optional[str] = None,
     ) -> None:
         """Record ``n`` request arrivals (at ``now``, default the
-        router's clock) into the sliding rate window."""
+        router's clock) into the sliding rate window.  With
+        ``tier_weights`` configured, each arrival carries its tier's
+        weight into the demand math (``tier=None`` weighs 1.0)."""
         t = self._clock() if now is None else float(now)
+        w = 1.0
+        if self.tier_weights is not None and tier is not None:
+            w = float(self.tier_weights.get(tier, 1.0))
         for _ in range(max(int(n), 0)):
-            self._arrivals.append(t)
+            self._arrivals.append((t, w))
 
     def arrival_rate(self, now: Optional[float] = None) -> float:
-        """Arrivals per second over the trailing ``window_s``."""
+        """WEIGHTED arrivals per second over the trailing ``window_s``
+        (plain arrivals/s when no tier weights are configured)."""
         t = self._clock() if now is None else float(now)
         cutoff = t - self.window_s
-        while self._arrivals and self._arrivals[0] < cutoff:
+        while self._arrivals and self._arrivals[0][0] < cutoff:
             self._arrivals.popleft()
-        return len(self._arrivals) / self.window_s
+        return sum(w for _, w in self._arrivals) / self.window_s
 
     def migration_rate(self, now: Optional[float] = None) -> float:
         """Prefill→decode handoffs per second over the trailing

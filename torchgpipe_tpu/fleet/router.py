@@ -76,6 +76,14 @@ class RouterRecord:
     tokens: List[int] = dataclasses.field(default_factory=list)
     on_token: Optional[Callable[[str, int], None]] = None
     moves: int = 0          # failover/drain resubmissions
+    # QoS identity (serving/qos.py): carried through every failover /
+    # drain resubmission, so a request keeps its latency class and its
+    # tenant keeps being charged wherever the request lands.  ``tier``
+    # tracks the EFFECTIVE tier (an over-budget demotion sticks here
+    # via the drain snapshot, so a migrated request does not silently
+    # re-promote).
+    tier: str = "standard"
+    tenant: Optional[str] = None
 
     @property
     def done(self) -> bool:
@@ -373,6 +381,8 @@ class Router:
         session: Optional[str] = None,
         eos_id: Optional[int] = None,
         on_token: Optional[Callable[[str, int], None]] = None,
+        tier: str = "standard",
+        tenant: Optional[str] = None,
     ) -> str:
         """Route one request; returns its fleet-wide id."""
         if rid is None:
@@ -392,6 +402,8 @@ class Router:
             replica=name,
             session=session,
             on_token=on_token,
+            tier=tier,
+            tenant=tenant,
         )
         # Register only after the engine ACCEPTS the request — like
         # Engine.submit, validation failures (e.g. prompt + budget
@@ -455,6 +467,7 @@ class Router:
             rid=record.rid, eos_id=record.eos_id,
             on_token=self._recording_on_token(record),
             emitted_prefix=list(emitted_prefix),
+            tier=record.tier, tenant=record.tenant,
         )
         self._c_routed.inc(replica=name)
         self._record_event(
@@ -509,6 +522,13 @@ class Router:
                 # straggler the SLO burn-rate gate drives.  Host-side
                 # only; never touches a traced value.
                 delay = faults.replica_delay_s(index)
+                # The rollout regression fault (bad_version_at): extra
+                # latency WHILE this replica runs the bad param version
+                # — activates the moment swap_params lands it, clears
+                # the moment a rollback swaps it away.
+                delay += faults.bad_version_delay_s(
+                    index, int(getattr(rep.engine, "version", 0))
+                )
                 if delay > 0.0:
                     time.sleep(delay)
                 if rep.engine._preempted():
@@ -676,6 +696,8 @@ class Router:
                 "emitted_prefix": [],
                 "prompt_len": int(r.prompt.size),
                 "generated_len": len(r.tokens),
+                "tier": r.tier,
+                "tenant": r.tenant,
             }
         return {"tree": tree, "requests": meta}
 
@@ -704,6 +726,11 @@ class Router:
                 )
                 if pinned is None or not pinned.in_rotation:
                     self._sessions.pop(record.session, None)
+            # The snapshot carries the EFFECTIVE tier (an over-budget
+            # demotion mutated on the scheduler's Request): fold it
+            # back into the record so the resubmission — and any later
+            # failover — keeps the class the request actually ran at.
+            record.tier = kw.get("tier", record.tier)
             source = record.replica
             # EVERY resumption re-prefills (the snapshot teacher-forces
             # prompt + emitted tokens), so in a disaggregated fleet the

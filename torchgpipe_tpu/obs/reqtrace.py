@@ -67,13 +67,24 @@ ATTEMPT_KINDS = (
 _ATTEMPT_OPENERS = ("req_submit", "req_ingest")
 
 
+def detail_tag(detail: str, key: str) -> str:
+    """The ``<key>=<value>`` tag in a flight event's space-separated
+    ``detail`` string ("" when absent) — the one parser for every tag
+    the serving stack stamps (``phase=`` for disaggregated pools,
+    ``tier=`` / ``tenant=`` for QoS classes, ``version=`` for the
+    rollout's param version), so a stitched trace can answer "which
+    weights served this token" without each caller re-splitting."""
+    prefix = key + "="
+    for tok in detail.split():
+        if tok.startswith(prefix):
+            return tok[len(prefix):]
+    return ""
+
+
 def _phase_of(detail: str) -> str:
     """The ``phase=<pool>`` tag a disaggregated engine stamps on its
     attempt-opening events (empty for unified replicas)."""
-    for tok in detail.split():
-        if tok.startswith("phase="):
-            return tok[len("phase="):]
-    return ""
+    return detail_tag(detail, "phase")
 
 
 @dataclasses.dataclass
@@ -361,6 +372,7 @@ __all__ = [
     "Orphan",
     "RequestTrace",
     "Span",
+    "detail_tag",
     "format_request_tree",
     "request_chrome_trace",
     "request_ids",

@@ -87,6 +87,22 @@ class FaultPlan:
     # drives.  Host-side only: traces nothing, never tokens the
     # compiled-program caches (:func:`plan_token` stays None).
     slow_replica_at: Optional[Tuple[int, float]] = None
+    # A PUBLISHED PARAM VERSION that degrades on swap: (replica_index,
+    # version).  While serving replica ``replica_index`` runs at param
+    # version ``version`` (``Engine.version``, set by ``swap_params``),
+    # the fleet router sleeps ``bad_version_delay`` extra seconds before
+    # each of its engine steps — the deterministic quality/SLO
+    # regression a live rollout must catch, and the rollback witness
+    # ``tools/rollout_verify.py`` drives (SLO burn on the updated
+    # replica → RolloutController rolls the fleet back to version N).
+    # Latency-shaped ON PURPOSE: token VALUES stay bitwise (greedy
+    # streams still match the cold-start gate), only the wall clock
+    # degrades, exactly like ``slow_replica_at``.  Host-side only:
+    # traces nothing, never tokens the compiled-program caches
+    # (:func:`plan_token` stays None).
+    bad_version_at: Optional[Tuple[int, int]] = None
+    # Extra seconds per step while ``bad_version_at`` matches.
+    bad_version_delay: float = 0.05
 
 
 _lock = threading.Lock()
@@ -106,6 +122,8 @@ def inject(
     die_at_step: Optional[Tuple[int, int]] = None,
     die_at_megastep: Optional[Tuple[int, int]] = None,
     slow_replica_at: Optional[Tuple[int, float]] = None,
+    bad_version_at: Optional[Tuple[int, int]] = None,
+    bad_version_delay: float = 0.05,
 ) -> Iterator[FaultPlan]:
     """Activate a :class:`FaultPlan` for the enclosed block.
 
@@ -116,7 +134,9 @@ def inject(
     plan = FaultPlan(nan_at=nan_at, preempt_at_step=preempt_at_step,
                      slow_at=slow_at, die_at_step=die_at_step,
                      die_at_megastep=die_at_megastep,
-                     slow_replica_at=slow_replica_at)
+                     slow_replica_at=slow_replica_at,
+                     bad_version_at=bad_version_at,
+                     bad_version_delay=bad_version_delay)
     with _lock:
         if _active is not None:
             raise RuntimeError(
@@ -248,6 +268,28 @@ def replica_delay_s(replica: int) -> float:
     ):
         return 0.0
     return float(plan.slow_replica_at[1])
+
+
+def bad_version_delay_s(replica: int, version: int) -> float:
+    """Extra per-step seconds the active plan injects into serving
+    replica ``replica`` WHILE it runs at param version ``version``
+    (0.0 without a matching ``bad_version_at`` plan) —
+    ``slow_replica_at``'s rollout twin.  The fleet router consults the
+    replica engine's current ``version`` attribute before each step, so
+    the fault activates the moment ``swap_params`` lands the bad
+    version and deactivates the moment a rollback swaps it away: the
+    deterministic SLO regression :class:`fleet.rollout.RolloutController`'s
+    health gate must catch.  Host-side only: traces nothing, never
+    tokens the compiled-program caches (:func:`plan_token` stays
+    None)."""
+    plan = _active
+    if (
+        plan is None
+        or plan.bad_version_at is None
+        or plan.bad_version_at != (replica, version)
+    ):
+        return 0.0
+    return float(plan.bad_version_delay)
 
 
 def should_preempt(step: int) -> bool:

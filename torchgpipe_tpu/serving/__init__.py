@@ -28,11 +28,14 @@ from __future__ import annotations
 from torchgpipe_tpu.serving.cache_pool import CachePool
 from torchgpipe_tpu.serving.engine import Engine
 from torchgpipe_tpu.serving.metrics import RequestTimes, ServingMetrics
+from torchgpipe_tpu.serving.qos import QosConfig, QosPolicy
 from torchgpipe_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = [
     "CachePool",
     "Engine",
+    "QosConfig",
+    "QosPolicy",
     "Request",
     "RequestTimes",
     "Scheduler",

@@ -58,6 +58,7 @@ from torchgpipe_tpu.models.transformer import TransformerConfig
 from torchgpipe_tpu.resilience.guard import GuardPolicy, classify_error
 from torchgpipe_tpu.serving.cache_pool import CachePool
 from torchgpipe_tpu.serving.metrics import ServingMetrics
+from torchgpipe_tpu.serving.qos import check_tier
 from torchgpipe_tpu.serving.scheduler import (
     Request,
     Scheduler,
@@ -135,10 +136,15 @@ class Engine:
         sleep: Callable[[float], None] = time.sleep,
         donate: bool = False,
         role: str = "unified",
+        qos: Optional[Any] = None,
     ) -> None:
         self.cfg = cfg
         self.params = list(params)
         _split_params(cfg, self.params)  # validates the per-layer list
+        # Param VERSION label (live rollout, fleet/rollout.py): every
+        # response/flight event stamps the version its tokens were
+        # produced under; :meth:`swap_params` bumps it in place.
+        self.version = 0
         _check_decodable(cfg, max_len)
         self.moe = moe
         if moe is not None and getattr(moe, "router", "topk") == "expert_choice":
@@ -214,9 +220,16 @@ class Engine:
         self.pool = CachePool(
             cfg, num_slots, max_len, kv_quant=kv_quant, dtype=cache_dtype
         )
+        # ``qos`` (serving.qos.QosPolicy) — ONE shared instance across a
+        # fleet's engines: tier-ordered admission, per-tenant token
+        # budgets, and pressure preemption of batch-tier streams.  The
+        # policy object must sit on the BASE registry so a tenant's
+        # spend survives its requests migrating replicas.
+        self.qos = qos
         self.scheduler = Scheduler(
             self.pool, prefill_chunk=self.prefill_buckets,
             max_active=max_active, wave_admission=wave_admission,
+            qos=qos,
         )
         # ``registry`` (torchgpipe_tpu.obs.MetricsRegistry) shares the
         # engine's counters + TTFT/TPOT histograms with the rest of the
@@ -593,6 +606,53 @@ class Engine:
         return dict(self.trace_counts)
 
     # ------------------------------------------------------------------ #
+    # live param rollout (fleet/rollout.py)                              #
+    # ------------------------------------------------------------------ #
+
+    def swap_params(self, params: Sequence[Pytree], version: int) -> None:
+        """In-place param refresh: serve a NEW weight version with zero
+        rebuild.  The compiled programs take ``params`` as a traced
+        ARGUMENT, so replacing the list with one whose every leaf keeps
+        its (shape, dtype) signature triggers ZERO retraces — the KV
+        pool, the program cache and every in-flight request are
+        untouched, and subsequent steps simply read the new weights
+        (``analysis.serving.certify_swap`` is the static twin of this
+        check).  A swap that changes any leaf signature would recompile
+        every program mid-serve and is REFUSED — cold-start a fresh
+        engine for a re-shaped model.
+
+        Call only on a drained/idle replica (the rollout controller
+        drains first): swapping under live decode would splice two
+        versions into one stream.  After the swap the engine's streams
+        are bitwise what a fresh engine cold-started on ``params``
+        produces — the ``rollout-verify`` gate.
+        """
+        new = list(params)
+        _split_params(self.cfg, new)    # validates the per-layer list
+
+        def sig(tree: Any) -> List[Tuple[Tuple[int, ...], str]]:
+            return [
+                (tuple(a.shape), str(a.dtype))
+                for a in jax.tree_util.tree_leaves(tree)
+            ]
+
+        if sig(new) != sig(self.params):
+            raise ValueError(
+                "swap_params: the published params change a leaf "
+                "(shape, dtype) signature — an in-place swap would "
+                "retrace every compiled program mid-serve, so a "
+                "new-version compile is refused; cold-start a fresh "
+                "Engine for a re-shaped model "
+                "(analysis.serving.certify_swap names the mismatch)"
+            )
+        self.params = new
+        self.version = int(version)
+        if self.recorder is not None:
+            self.recorder.record(
+                "param_swap", detail=f"version={self.version}"
+            )
+
+    # ------------------------------------------------------------------ #
     # request-scoped flight recording                                    #
     # ------------------------------------------------------------------ #
 
@@ -632,6 +692,8 @@ class Engine:
         eos_id: Optional[int] = None,
         on_token: Optional[Callable[[str, int], None]] = None,
         emitted_prefix: Sequence[int] = (),
+        tier: str = "standard",
+        tenant: Optional[str] = None,
     ) -> str:
         """Queue a request; returns its id.  Admission happens between
         engine iterations (a free slot + the admission cap permitting).
@@ -642,6 +704,7 @@ class Engine:
                 "from a prefill replica, never submit() — route "
                 "admissions to the prefill pool"
             )
+        check_tier(tier)     # before any registration (no phantom state)
         if rid is None:
             self._rid_counter += 1
             rid = f"r{self._rid_counter}"
@@ -653,6 +716,8 @@ class Engine:
             eos_id=eos_id,
             on_token=on_token,
             emitted_prefix=list(emitted_prefix),
+            tier=tier,
+            tenant=tenant,
         )
         self.scheduler.submit(req)   # validates before registration
         self._requests[rid] = req
@@ -661,11 +726,14 @@ class Engine:
         # rejected submit must leave no phantom span behind (the same
         # contract the router keeps for its records).
         phase = "" if self.role == "unified" else f" phase={self.role}"
+        tenant_tag = "" if tenant is None else f" tenant={tenant}"
         self._rec(
             "req_submit", rid,
             detail=(
                 f"prompt={req.prompt_len} new={req.max_new_tokens} "
-                f"queued={self.scheduler.queue_depth}{phase}"
+                f"queued={self.scheduler.queue_depth}"
+                f" tier={tier}{tenant_tag}"
+                f" version={self.version}{phase}"
             ),
         )
         return rid
@@ -707,6 +775,8 @@ class Engine:
         """ONE engine iteration: admit, pick a phase, run its compiled
         program, emit/evict.  Returns False when idle (nothing ran)."""
         if not self._draining:
+            if self.qos is not None:
+                self._preempt_for_pressure()
             if (
                 self._prefix_cache is not None
                 and self.scheduler.queue
@@ -731,6 +801,77 @@ class Engine:
         if self.reporter is not None:
             self.reporter.step()
         return True
+
+    def _preempt_for_pressure(self) -> None:
+        """QoS pressure valve (runs before admission): when queued work
+        OUTRANKS an active preemptible stream and admission is blocked
+        (no free slot, or the cap is reached), evict ONE preemptible
+        active request through the same teacher-forced snapshot path
+        drain uses and requeue it here — it resumes bitwise (greedy
+        decode is prefix-deterministic) once pressure clears.  At most
+        one eviction per engine iteration; interactive/standard streams
+        are never preempted."""
+        sched = self.scheduler
+        if not sched.queue:
+            return
+        if sched.pool.num_free > 0 and len(sched.active) < sched.max_active:
+            return      # admission can proceed — nothing to yield
+        from torchgpipe_tpu.serving.qos import TIER_PRIORITY
+
+        want = min(
+            TIER_PRIORITY[self.qos.effective_tier(r.tier, r.tenant)]
+            for r in sched.queue
+        )
+        victims = [
+            r for r in sched.active.values()
+            if self.qos.preemptible(r.tier)
+            and TIER_PRIORITY[r.tier] > want
+        ]
+        if not victims:
+            return
+        # Most recently admitted among the worst-priority preemptibles:
+        # deterministic, and the stream with the least progress to redo.
+        worst = max(TIER_PRIORITY[r.tier] for r in victims)
+        victim = [r for r in victims if TIER_PRIORITY[r.tier] == worst][-1]
+        kwargs = self.preempt_request(victim.rid)
+        self.qos.note_preemption()
+        self.submit(**kwargs)
+
+    def preempt_request(self, rid: str) -> Dict[str, Any]:
+        """Evict one ACTIVE request NOW (its slot frees immediately) and
+        return the ``submit()`` kwargs that resume it: prompt extended
+        by the tokens already emitted (teacher-forced), budget shrunk,
+        ``emitted_prefix`` extended — exactly the drain/restore schema,
+        per-request.  Greedy decode is prefix-deterministic, so the
+        resumed stream is bitwise the unpreempted one."""
+        req = self.scheduler.active.get(rid)
+        if req is None:
+            raise ValueError(
+                f"request {rid!r} is not active — nothing to preempt"
+            )
+        generated = list(req.generated)
+        kwargs: Dict[str, Any] = {
+            "rid": req.rid,
+            "prompt": np.concatenate([
+                np.asarray(req.prompt, np.int32),
+                np.asarray(generated, np.int32),
+            ]) if generated else np.asarray(req.prompt, np.int32),
+            "max_new_tokens": req.max_new_tokens - len(generated),
+            "eos_id": req.eos_id,
+            "on_token": req.on_token,
+            "emitted_prefix": list(req.emitted_prefix) + generated,
+            "tier": req.tier,
+            "tenant": req.tenant,
+        }
+        req.status = "preempted"
+        self.scheduler.release(req)
+        self.metrics.finished(rid, status="preempted")
+        self._flush_decode_group(rid)
+        self._rec(
+            "req_preempt", rid,
+            detail=f"qos tier={req.tier} emitted={len(generated)}",
+        )
+        return kwargs
 
     def _on_admit(self, req: Request) -> None:
         """Per-admission hook: prefix-cache consult here; subclasses
@@ -866,6 +1007,8 @@ class Engine:
         the iteration-level eviction continuous batching is made of."""
         req.generated.append(token)
         self.metrics.token(req.rid)
+        if self.qos is not None:
+            self.qos.spend(req.tenant, 1)
         if req.on_token is not None:
             req.on_token(req.rid, token)
         done = (
@@ -879,7 +1022,10 @@ class Engine:
             self._flush_decode_group(req.rid)
             self._rec(
                 "req_finish", req.rid,
-                detail=f"status=finished tokens={len(req.tokens())}",
+                detail=(
+                    f"status=finished tokens={len(req.tokens())} "
+                    f"version={self.version}"
+                ),
             )
         elif self.role == "prefill":
             # Prompt complete, stream live: the decode phase belongs to
@@ -959,6 +1105,8 @@ class Engine:
         eos_id: Optional[int] = None,
         on_token: Optional[Callable[[str, int], None]] = None,
         emitted_prefix: Sequence[int] = (),
+        tier: str = "standard",
+        tenant: Optional[str] = None,
     ) -> str:
         """Receive a mid-stream request from a prefill replica: allocate
         a slot, write the shipped KV ``rows`` through the fixed-shape
@@ -980,6 +1128,7 @@ class Engine:
                 f"this engine's role is {self.role!r}"
             )
         self._check_rid_free(rid)
+        check_tier(tier)
         req = Request(
             rid=rid,
             prompt=np.asarray(prompt, np.int32).reshape(-1),
@@ -987,6 +1136,8 @@ class Engine:
             eos_id=eos_id,
             on_token=on_token,
             emitted_prefix=list(emitted_prefix),
+            tier=tier,
+            tenant=tenant,
         )
         if req.prompt_len + req.max_new_tokens > self.pool.max_len:
             raise ValueError(
@@ -1103,6 +1254,8 @@ class Engine:
                 "emitted_prefix": list(r.emitted_prefix),
                 "prompt_len": r.prompt_len,
                 "generated_len": len(r.generated),
+                "tier": r.tier,
+                "tenant": r.tenant,
             }
         if self.recorder is not None:
             for r in unfinished:
@@ -1185,6 +1338,10 @@ class Engine:
                 "emitted_prefix": (
                     list(m["emitted_prefix"]) + generated.tolist()
                 ),
+                # QoS identity rides the snapshot (absent in pre-QoS
+                # snapshots — the defaults keep them restorable).
+                "tier": m.get("tier", "standard"),
+                "tenant": m.get("tenant"),
             })
         return out
 

@@ -30,7 +30,16 @@ Everything here is host-side and O(active + queued) per iteration.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Union,
+)
 
 import numpy as np
 
@@ -73,6 +82,12 @@ class Request:
     eos_id: Optional[int] = None
     on_token: Optional[Callable[[str, int], None]] = None
     emitted_prefix: List[int] = dataclasses.field(default_factory=list)
+    # QoS identity (serving/qos.py): the latency tier admission orders
+    # by, and the tenant token budgets are charged to.  Both ride the
+    # drain/restore snapshot, so a preempted or migrated request keeps
+    # its class wherever it resumes.
+    tier: str = "standard"
+    tenant: Optional[str] = None
 
     # runtime state (engine/scheduler owned)
     status: str = "queued"   # queued|active|finished|cancelled|preempted
@@ -107,6 +122,7 @@ class Scheduler:
         prefill_chunk: Union[int, Sequence[int]] = 8,
         max_active: Optional[int] = None,
         wave_admission: bool = False,
+        qos: Optional[Any] = None,
     ) -> None:
         self.prefill_buckets = normalize_buckets(prefill_chunk)
         self.pool = pool
@@ -124,6 +140,11 @@ class Scheduler:
                 "budget (tune.serving_max_slots accounting)"
             )
         self.wave_admission = wave_admission
+        # ``qos`` (serving.qos.QosPolicy) turns FIFO admission into
+        # tier-ordered admission and resolves over-budget demotion at
+        # pick time; None keeps classic FIFO exactly (and requests with
+        # uniform tiers admit FIFO either way — the stable tie-break).
+        self.qos = qos
         self.queue: List[Request] = []
         self.active: Dict[str, Request] = {}
         self._last_action = "decode"  # alternation seed: prefill first
@@ -176,7 +197,7 @@ class Scheduler:
             and self.pool.num_free > 0
             and len(self.active) < self.max_active
         ):
-            req = self.queue.pop(0)
+            req = self.queue.pop(self._pick_next())
             slot = self.pool.alloc(req.rid)
             assert slot is not None
             req.slot = slot
@@ -184,6 +205,29 @@ class Scheduler:
             self.active[req.rid] = req
             admitted.append(req)
         return admitted
+
+    def _pick_next(self) -> int:
+        """The queue index the next free slot admits: highest tier
+        priority first (interactive < standard < batch), arrival order
+        within a tier — without a QoS policy, plain FIFO.  Over-budget
+        demotion resolves HERE, against the tenant's LATEST spend: the
+        demotion sticks on the request (``req.tier``), so its drain and
+        migration snapshots carry the class it actually ran at, and it
+        is counted once per request."""
+        if self.qos is None:
+            return 0
+        from torchgpipe_tpu.serving.qos import TIER_PRIORITY
+
+        best, best_rank = 0, None
+        for i, req in enumerate(self.queue):
+            eff = self.qos.effective_tier(req.tier, req.tenant)
+            if eff != req.tier:
+                req.tier = eff
+                self.qos.note_demotion(req.tenant)
+            rank = TIER_PRIORITY[req.tier]
+            if best_rank is None or rank < best_rank:
+                best, best_rank = i, rank
+        return best
 
     def release(self, req: Request) -> None:
         """Free a finished/cancelled/preempted request's slot NOW — the
